@@ -1,0 +1,28 @@
+"""elasticsearch_tpu — a TPU-native distributed search & analytics engine.
+
+A from-scratch re-design of the Elasticsearch capability surface
+(reference: infusionsoft/elasticsearch, ES 3.0.0-SNAPSHOT on Lucene 5.4)
+for TPU hardware:
+
+* The Lucene-equivalent index/score/top-k kernels are JAX/XLA programs over
+  **dense, padded columnar segments resident in HBM** (see
+  :mod:`elasticsearch_tpu.index.segment` and :mod:`elasticsearch_tpu.ops`).
+  Queries compile to dense compares / reductions / matmuls producing per-doc
+  ``(score, mask)`` vectors, then ``lax.top_k`` — no pointer chasing, no
+  dynamic shapes, exact results.
+* Sharding (the reference's hash-partitioned shards,
+  core/cluster/routing/OperationRouting.java:238) maps to a mesh axis:
+  scatter-gather query fan-out + top-k merge
+  (core/action/search/type/TransportSearchTypeAction.java:137,
+  core/search/controller/SearchPhaseController.java:165) becomes
+  ``shard_map`` + ``all_gather`` inside a single jitted program
+  (:mod:`elasticsearch_tpu.parallel`).
+* The host side (Python) owns what the reference's JVM owns: REST API,
+  cluster state, mapping/analysis, segment building, translog, recovery.
+"""
+
+__version__ = "0.1.0"
+
+from elasticsearch_tpu.common.versioning import Version, CURRENT_VERSION
+
+__all__ = ["Version", "CURRENT_VERSION", "__version__"]

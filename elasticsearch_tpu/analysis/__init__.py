@@ -1,0 +1,15 @@
+from elasticsearch_tpu.analysis.analyzers import (
+    AnalysisRegistry,
+    Analyzer,
+    Token,
+    standard_tokenizer,
+    whitespace_tokenizer,
+)
+
+__all__ = [
+    "AnalysisRegistry",
+    "Analyzer",
+    "Token",
+    "standard_tokenizer",
+    "whitespace_tokenizer",
+]

@@ -1,0 +1,361 @@
+"""Analysis: tokenizers, token filters, analyzers, per-index registry.
+
+Mirrors the reference's analysis module (core/index/analysis/AnalysisModule.java:39,
+~150 providers bridging Lucene analyzers): named tokenizers + filter chains are
+registered globally, and each index can define custom analyzers in its settings
+(``analysis.analyzer.<name>.{type,tokenizer,filter}``), resolved by
+:class:`AnalysisRegistry`.
+
+This runs host-side at both index time (SegmentBuilder) and query time
+(match-query analysis); the produced term streams are what get packed into
+the device-resident columnar segments.
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from dataclasses import dataclass
+from typing import Callable, Iterable, Sequence
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.common.settings import Settings
+
+
+@dataclass
+class Token:
+    term: str
+    position: int      # token position (phrase queries use this)
+    start_offset: int  # char offsets (highlighting uses these)
+    end_offset: int
+
+
+# ---------------------------------------------------------------------------
+# Tokenizers
+# ---------------------------------------------------------------------------
+
+# Word characters: letters and digits of any script (approximates Lucene's
+# StandardTokenizer UAX#29 word-break rules closely enough for parity tests).
+_STANDARD_RE = re.compile(r"[^\W_]+(?:['’][^\W_]+)*", re.UNICODE)
+_WHITESPACE_RE = re.compile(r"\S+")
+_LETTER_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
+
+
+def _regex_tokenize(text: str, pattern: re.Pattern) -> list[Token]:
+    out = []
+    for pos, m in enumerate(pattern.finditer(text)):
+        out.append(Token(m.group(0), pos, m.start(), m.end()))
+    return out
+
+
+def standard_tokenizer(text: str) -> list[Token]:
+    return _regex_tokenize(text, _STANDARD_RE)
+
+
+def whitespace_tokenizer(text: str) -> list[Token]:
+    return _regex_tokenize(text, _WHITESPACE_RE)
+
+
+def letter_tokenizer(text: str) -> list[Token]:
+    return _regex_tokenize(text, _LETTER_RE)
+
+
+def keyword_tokenizer(text: str) -> list[Token]:
+    return [Token(text, 0, 0, len(text))] if text else []
+
+
+def ngram_tokenizer_factory(min_gram: int = 1, max_gram: int = 2) -> "Tokenizer":
+    def tok(text: str) -> list[Token]:
+        out = []
+        pos = 0
+        for n in range(min_gram, max_gram + 1):
+            for i in range(0, len(text) - n + 1):
+                out.append(Token(text[i:i + n], pos, i, i + n))
+                pos += 1
+        return out
+    return tok
+
+
+Tokenizer = Callable[[str], list[Token]]
+
+TOKENIZERS: dict[str, Tokenizer] = {
+    "standard": standard_tokenizer,
+    "whitespace": whitespace_tokenizer,
+    "letter": letter_tokenizer,
+    "keyword": keyword_tokenizer,
+}
+
+
+# ---------------------------------------------------------------------------
+# Token filters
+# ---------------------------------------------------------------------------
+
+# Lucene's default English stopword set (StandardAnalyzer.STOP_WORDS_SET).
+ENGLISH_STOPWORDS = frozenset(
+    "a an and are as at be but by for if in into is it no not of on or such "
+    "that the their then there these they this to was will with".split()
+)
+
+
+def lowercase_filter(tokens: Iterable[Token]) -> list[Token]:
+    return [Token(t.term.lower(), t.position, t.start_offset, t.end_offset) for t in tokens]
+
+
+def uppercase_filter(tokens: Iterable[Token]) -> list[Token]:
+    return [Token(t.term.upper(), t.position, t.start_offset, t.end_offset) for t in tokens]
+
+
+def asciifolding_filter(tokens: Iterable[Token]) -> list[Token]:
+    def fold(s: str) -> str:
+        return "".join(
+            c for c in unicodedata.normalize("NFKD", s) if not unicodedata.combining(c)
+        )
+    return [Token(fold(t.term), t.position, t.start_offset, t.end_offset) for t in tokens]
+
+
+def stop_filter_factory(stopwords: frozenset[str] = ENGLISH_STOPWORDS) -> "TokenFilter":
+    """Removes stopwords; positions are preserved (position gaps matter for
+    phrase queries, matching Lucene StopFilter's enablePositionIncrements)."""
+    def f(tokens: Iterable[Token]) -> list[Token]:
+        return [t for t in tokens if t.term not in stopwords]
+    return f
+
+
+def length_filter_factory(min_len: int = 0, max_len: int = 255) -> "TokenFilter":
+    def f(tokens: Iterable[Token]) -> list[Token]:
+        return [t for t in tokens if min_len <= len(t.term) <= max_len]
+    return f
+
+
+def unique_filter(tokens: Iterable[Token]) -> list[Token]:
+    seen: set[str] = set()
+    out = []
+    for t in tokens:
+        if t.term not in seen:
+            seen.add(t.term)
+            out.append(t)
+    return out
+
+
+def shingle_filter_factory(min_size: int = 2, max_size: int = 2,
+                           separator: str = " ") -> "TokenFilter":
+    def f(tokens: Iterable[Token]) -> list[Token]:
+        toks = list(tokens)
+        out = list(toks)
+        for n in range(min_size, max_size + 1):
+            for i in range(len(toks) - n + 1):
+                grp = toks[i:i + n]
+                out.append(Token(separator.join(t.term for t in grp),
+                                 grp[0].position, grp[0].start_offset, grp[-1].end_offset))
+        out.sort(key=lambda t: (t.position, t.end_offset))
+        return out
+    return f
+
+
+# --- Porter stemmer (Porter 1980; equivalent of Lucene PorterStemFilter) ----
+
+_VOWELS = "aeiou"
+
+
+def _is_cons(word: str, i: int) -> bool:
+    c = word[i]
+    if c in _VOWELS:
+        return False
+    if c == "y":
+        return i == 0 or not _is_cons(word, i - 1)
+    return True
+
+
+def _measure(stem: str) -> int:
+    """Number of VC sequences."""
+    m, prev_cons = 0, True
+    for i in range(len(stem)):
+        cons = _is_cons(stem, i)
+        if prev_cons and not cons:
+            pass
+        elif not prev_cons and cons:
+            m += 1
+        prev_cons = cons
+    return m
+
+
+def _has_vowel(stem: str) -> bool:
+    return any(not _is_cons(stem, i) for i in range(len(stem)))
+
+
+def _ends_double_cons(word: str) -> bool:
+    return (len(word) >= 2 and word[-1] == word[-2] and _is_cons(word, len(word) - 1))
+
+
+def _cvc(word: str) -> bool:
+    if len(word) < 3:
+        return False
+    return (_is_cons(word, len(word) - 3) and not _is_cons(word, len(word) - 2)
+            and _is_cons(word, len(word) - 1) and word[-1] not in "wxy")
+
+
+def porter_stem(word: str) -> str:  # noqa: C901 — the algorithm is one long rule table
+    if len(word) <= 2:
+        return word
+    w = word
+    # Step 1a
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith("ies"):
+        w = w[:-2]
+    elif w.endswith("ss"):
+        pass
+    elif w.endswith("s"):
+        w = w[:-1]
+    # Step 1b
+    flag = False
+    if w.endswith("eed"):
+        if _measure(w[:-3]) > 0:
+            w = w[:-1]
+    elif w.endswith("ed"):
+        if _has_vowel(w[:-2]):
+            w, flag = w[:-2], True
+    elif w.endswith("ing"):
+        if _has_vowel(w[:-3]):
+            w, flag = w[:-3], True
+    if flag:
+        if w.endswith(("at", "bl", "iz")):
+            w += "e"
+        elif _ends_double_cons(w) and not w.endswith(("l", "s", "z")):
+            w = w[:-1]
+        elif _measure(w) == 1 and _cvc(w):
+            w += "e"
+    # Step 1c
+    if w.endswith("y") and _has_vowel(w[:-1]):
+        w = w[:-1] + "i"
+    # Step 2
+    step2 = [("ational", "ate"), ("tional", "tion"), ("enci", "ence"), ("anci", "ance"),
+             ("izer", "ize"), ("bli", "ble"), ("alli", "al"), ("entli", "ent"),
+             ("eli", "e"), ("ousli", "ous"), ("ization", "ize"), ("ation", "ate"),
+             ("ator", "ate"), ("alism", "al"), ("iveness", "ive"), ("fulness", "ful"),
+             ("ousness", "ous"), ("aliti", "al"), ("iviti", "ive"), ("biliti", "ble"),
+             ("logi", "log")]
+    for suf, rep in step2:
+        if w.endswith(suf):
+            if _measure(w[:-len(suf)]) > 0:
+                w = w[:-len(suf)] + rep
+            break
+    # Step 3
+    step3 = [("icate", "ic"), ("ative", ""), ("alize", "al"), ("iciti", "ic"),
+             ("ical", "ic"), ("ful", ""), ("ness", "")]
+    for suf, rep in step3:
+        if w.endswith(suf):
+            if _measure(w[:-len(suf)]) > 0:
+                w = w[:-len(suf)] + rep
+            break
+    # Step 4
+    step4 = ["al", "ance", "ence", "er", "ic", "able", "ible", "ant", "ement",
+             "ment", "ent", "ion", "ou", "ism", "ate", "iti", "ous", "ive", "ize"]
+    for suf in step4:
+        if w.endswith(suf):
+            stem = w[:-len(suf)]
+            if _measure(stem) > 1:
+                if suf == "ion" and not stem.endswith(("s", "t")):
+                    break
+                w = stem
+            break
+    # Step 5a
+    if w.endswith("e"):
+        stem = w[:-1]
+        if _measure(stem) > 1 or (_measure(stem) == 1 and not _cvc(stem)):
+            w = stem
+    # Step 5b
+    if _measure(w) > 1 and _ends_double_cons(w) and w.endswith("l"):
+        w = w[:-1]
+    return w
+
+
+def porter_stem_filter(tokens: Iterable[Token]) -> list[Token]:
+    return [Token(porter_stem(t.term), t.position, t.start_offset, t.end_offset)
+            for t in tokens]
+
+
+TokenFilter = Callable[[Iterable[Token]], list[Token]]
+
+TOKEN_FILTERS: dict[str, TokenFilter] = {
+    "lowercase": lowercase_filter,
+    "uppercase": uppercase_filter,
+    "asciifolding": asciifolding_filter,
+    "stop": stop_filter_factory(),
+    "porter_stem": porter_stem_filter,
+    "stemmer": porter_stem_filter,
+    "unique": unique_filter,
+}
+
+
+# ---------------------------------------------------------------------------
+# Analyzers
+# ---------------------------------------------------------------------------
+
+class Analyzer:
+    def __init__(self, name: str, tokenizer: Tokenizer,
+                 filters: Sequence[TokenFilter] = ()):
+        self.name = name
+        self.tokenizer = tokenizer
+        self.filters = list(filters)
+
+    def analyze(self, text: str) -> list[Token]:
+        tokens: list[Token] = self.tokenizer(text)
+        for f in self.filters:
+            tokens = f(tokens)
+        return tokens
+
+    def terms(self, text: str) -> list[str]:
+        return [t.term for t in self.analyze(text)]
+
+
+BUILTIN_ANALYZERS: dict[str, Analyzer] = {
+    # StandardAnalyzer in ES 2.x default has NO stopwords (stopwords=_none_).
+    "standard": Analyzer("standard", standard_tokenizer, [lowercase_filter]),
+    "simple": Analyzer("simple", letter_tokenizer, [lowercase_filter]),
+    "whitespace": Analyzer("whitespace", whitespace_tokenizer),
+    "keyword": Analyzer("keyword", keyword_tokenizer),
+    "stop": Analyzer("stop", letter_tokenizer,
+                     [lowercase_filter, stop_filter_factory()]),
+    "english": Analyzer("english", standard_tokenizer,
+                        [lowercase_filter, stop_filter_factory(), porter_stem_filter]),
+}
+
+
+class AnalysisRegistry:
+    """Per-index analyzer resolution: builtins + custom chains from index
+    settings (``analysis.analyzer.<name>...``), mirroring AnalysisModule."""
+
+    def __init__(self, index_settings: Settings = Settings.EMPTY):
+        self.analyzers: dict[str, Analyzer] = dict(BUILTIN_ANALYZERS)
+        self._build_custom(index_settings)
+
+    def _build_custom(self, settings: Settings) -> None:
+        names = set()
+        for key in settings:
+            if key.startswith("analysis.analyzer."):
+                names.add(key.split(".")[2])
+        for name in sorted(names):
+            sub = settings.get_by_prefix(f"analysis.analyzer.{name}.")
+            atype = sub.get("type", "custom")
+            if atype != "custom" and atype in BUILTIN_ANALYZERS:
+                self.analyzers[name] = BUILTIN_ANALYZERS[atype]
+                continue
+            tok_name = sub.get("tokenizer", "standard")
+            if tok_name not in TOKENIZERS:
+                raise IllegalArgumentError(f"unknown tokenizer [{tok_name}] for analyzer [{name}]")
+            filters = []
+            raw_filters = sub.get("filter", [])
+            if isinstance(raw_filters, str):
+                raw_filters = [f.strip() for f in raw_filters.split(",") if f.strip()]
+            for fname in raw_filters:
+                if fname not in TOKEN_FILTERS:
+                    raise IllegalArgumentError(f"unknown filter [{fname}] for analyzer [{name}]")
+                filters.append(TOKEN_FILTERS[fname])
+            self.analyzers[name] = Analyzer(name, TOKENIZERS[tok_name], filters)
+
+    def get(self, name: str) -> Analyzer:
+        try:
+            return self.analyzers[name]
+        except KeyError:
+            raise IllegalArgumentError(f"unknown analyzer [{name}]") from None

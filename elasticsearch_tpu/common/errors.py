@@ -1,0 +1,128 @@
+"""Error taxonomy.
+
+The reference maps exceptions to HTTP status codes via
+``ElasticsearchException.status()`` (core/ElasticsearchException.java); each
+error here carries its REST status so the REST layer
+(:mod:`elasticsearch_tpu.rest`) can serialize ES-compatible error bodies.
+"""
+
+from __future__ import annotations
+
+
+class ElasticsearchTpuError(Exception):
+    """Base class; mirrors core/ElasticsearchException.java."""
+
+    status = 500
+    error_type = "exception"
+
+    def __init__(self, message: str, index: str | None = None, shard: int | None = None):
+        super().__init__(message)
+        self.message = message
+        self.index = index
+        self.shard = shard
+
+    def to_xcontent(self) -> dict:
+        body: dict = {"type": self.error_type, "reason": self.message}
+        if self.index is not None:
+            body["index"] = self.index
+        if self.shard is not None:
+            body["shard"] = self.shard
+        return body
+
+
+class IllegalArgumentError(ElasticsearchTpuError):
+    status = 400
+    error_type = "illegal_argument_exception"
+
+
+class IndexNotFoundError(ElasticsearchTpuError):
+    status = 404
+    error_type = "index_not_found_exception"
+
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]", index=index)
+
+
+class IndexAlreadyExistsError(ElasticsearchTpuError):
+    status = 400
+    error_type = "index_already_exists_exception"
+
+    def __init__(self, index: str):
+        super().__init__(f"already exists [{index}]", index=index)
+
+
+class DocumentMissingError(ElasticsearchTpuError):
+    status = 404
+    error_type = "document_missing_exception"
+
+    def __init__(self, index: str, doc_id: str):
+        super().__init__(f"[{doc_id}]: document missing", index=index)
+        self.doc_id = doc_id
+
+
+class VersionConflictError(ElasticsearchTpuError):
+    """Optimistic-concurrency failure (reference: VersionConflictEngineException,
+    raised from InternalEngine.innerIndex version check,
+    core/index/engine/InternalEngine.java:359)."""
+
+    status = 409
+    error_type = "version_conflict_engine_exception"
+
+    def __init__(self, index: str, doc_id: str, current: int, expected: int):
+        super().__init__(
+            f"[{doc_id}]: version conflict, current [{current}], provided [{expected}]",
+            index=index,
+        )
+        self.doc_id = doc_id
+        self.current_version = current
+        self.expected_version = expected
+
+
+class MapperParsingError(ElasticsearchTpuError):
+    status = 400
+    error_type = "mapper_parsing_exception"
+
+
+class QueryParsingError(ElasticsearchTpuError):
+    status = 400
+    error_type = "query_parsing_exception"
+
+
+class ShardNotFoundError(ElasticsearchTpuError):
+    status = 404
+    error_type = "shard_not_found_exception"
+
+
+class EngineClosedError(ElasticsearchTpuError):
+    status = 409
+    error_type = "engine_closed_exception"
+
+
+class TranslogCorruptedError(ElasticsearchTpuError):
+    """Checksum/frame failure replaying the WAL (reference:
+    TranslogCorruptedException, core/index/translog/)."""
+
+    status = 500
+    error_type = "translog_corrupted_exception"
+
+
+class SearchContextMissingError(ElasticsearchTpuError):
+    """Scroll id refers to an expired/freed context (reference:
+    SearchContextMissingException; contexts registry
+    core/search/SearchService.java:533-558)."""
+
+    status = 404
+    error_type = "search_context_missing_exception"
+
+
+class CircuitBreakingError(ElasticsearchTpuError):
+    """Memory circuit breaker tripped (reference:
+    core/common/breaker/CircuitBreakingException.java)."""
+
+    status = 429
+    error_type = "circuit_breaking_exception"
+
+    def __init__(self, message: str, bytes_wanted: int = 0, bytes_limit: int = 0):
+        super().__init__(message)
+        self.bytes_wanted = bytes_wanted
+        self.bytes_limit = bytes_limit

@@ -1,0 +1,15 @@
+from elasticsearch_tpu.mapping.mapper import (
+    MapperService,
+    DocumentMapper,
+    FieldMapper,
+    ParsedDocument,
+    ParsedField,
+)
+
+__all__ = [
+    "MapperService",
+    "DocumentMapper",
+    "FieldMapper",
+    "ParsedDocument",
+    "ParsedField",
+]

@@ -1,0 +1,337 @@
+"""Mapping: JSON documents → typed, indexable field values.
+
+The reference's mapper (core/index/mapper/MapperService.java,
+DocumentMapper.java) turns a JSON source into Lucene fields, infers mappings
+dynamically for unseen fields, and merges mapping updates. Ours turns JSON
+into **columnar segment inputs**:
+
+* ``text``      → analyzed token stream (positions kept) → token matrix rows
+* ``keyword``   → exact values → ordinal doc-values column (also ES 2.x
+                  ``string`` with ``index: not_analyzed``)
+* numerics/date/boolean → float64 doc-values column + exists bitmap
+* ``dense_vector`` → fixed-dim float32 row in the vector matrix
+* ``geo_point`` → (lat, lon) pair of float64 columns
+
+Metadata fields (_id, _source, _routing, _version) are handled by the engine,
+matching the reference's internal mappers (core/index/mapper/internal/).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import numbers
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+import numpy as np
+
+from elasticsearch_tpu.analysis import AnalysisRegistry, Token
+from elasticsearch_tpu.common.errors import MapperParsingError, IllegalArgumentError
+
+# Field kinds the segment builder understands.
+KIND_TEXT = "text"
+KIND_KEYWORD = "keyword"
+KIND_NUMERIC = "numeric"   # long/integer/short/byte/double/float/date/boolean
+KIND_VECTOR = "vector"
+KIND_GEO = "geo"
+
+NUMERIC_TYPES = {"long", "integer", "short", "byte", "double", "float",
+                 "half_float", "date", "boolean"}
+
+
+def parse_date(value: Any) -> float:
+    """→ epoch millis (float). Accepts epoch millis, ISO-8601, yyyy-MM-dd."""
+    if isinstance(value, bool):
+        raise MapperParsingError(f"cannot parse date from boolean [{value}]")
+    if isinstance(value, numbers.Number):
+        return float(value)
+    s = str(value)
+    for parser in (
+        lambda v: _dt.datetime.fromisoformat(v.replace("Z", "+00:00")),
+        lambda v: _dt.datetime.strptime(v, "%Y-%m-%d"),
+        lambda v: _dt.datetime.strptime(v, "%Y-%m-%d %H:%M:%S"),
+    ):
+        try:
+            dt = parser(s)
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=_dt.timezone.utc)
+            return dt.timestamp() * 1000.0
+        except ValueError:
+            continue
+    try:
+        return float(s)  # epoch millis as string
+    except ValueError:
+        raise MapperParsingError(f"failed to parse date field [{value}]") from None
+
+
+@dataclass
+class ParsedField:
+    name: str
+    kind: str
+    tokens: list[Token] = field(default_factory=list)      # KIND_TEXT
+    keywords: list[str] = field(default_factory=list)       # KIND_KEYWORD
+    numerics: list[float] = field(default_factory=list)     # KIND_NUMERIC
+    vector: np.ndarray | None = None                        # KIND_VECTOR
+    geo: tuple[float, float] | None = None                  # KIND_GEO (lat, lon)
+
+
+@dataclass
+class ParsedDocument:
+    doc_id: str
+    source: dict
+    fields: dict[str, ParsedField]
+    routing: str | None = None
+
+
+class FieldMapper:
+    """One field's mapping entry."""
+
+    def __init__(self, name: str, ftype: str, params: Mapping[str, Any],
+                 analysis: AnalysisRegistry):
+        self.name = name
+        self.type = ftype
+        self.params = dict(params)
+        # ES 2.x "string" splits into text vs keyword on index: not_analyzed
+        # (reference: core/index/mapper/core/StringFieldMapper.java).
+        if ftype == "string":
+            self.type = "keyword" if params.get("index") == "not_analyzed" else "text"
+        if self.type == "text":
+            self.kind = KIND_TEXT
+            self.analyzer = analysis.get(params.get("analyzer", "standard"))
+            self.search_analyzer = analysis.get(
+                params.get("search_analyzer", params.get("analyzer", "standard")))
+        elif self.type == "keyword":
+            self.kind = KIND_KEYWORD
+        elif self.type in NUMERIC_TYPES:
+            self.kind = KIND_NUMERIC
+        elif self.type == "dense_vector":
+            self.kind = KIND_VECTOR
+            self.dims = int(params.get("dims", 0))
+            if self.dims <= 0:
+                raise MapperParsingError(f"dense_vector field [{name}] requires dims")
+        elif self.type == "geo_point":
+            self.kind = KIND_GEO
+        else:
+            raise MapperParsingError(f"no handler for type [{ftype}] on field [{name}]")
+        # Multi-fields: {"fields": {"raw": {"type": "keyword"}}}
+        self.sub_fields: dict[str, FieldMapper] = {}
+        for sub_name, sub_def in params.get("fields", {}).items():
+            self.sub_fields[sub_name] = FieldMapper(
+                f"{name}.{sub_name}", sub_def.get("type", "keyword"), sub_def, analysis)
+
+    def to_dict(self) -> dict:
+        out = {"type": self.type, **{k: v for k, v in self.params.items()
+                                     if k not in ("type", "fields")}}
+        if self.sub_fields:
+            out["fields"] = {n.split(".")[-1]: m.to_dict()
+                             for n, m in self.sub_fields.items()}
+        return out
+
+    # ---- value parsing ----------------------------------------------------
+
+    def parse_value(self, value: Any) -> ParsedField:
+        pf = ParsedField(self.name, self.kind)
+        values = value if isinstance(value, list) and self.kind != KIND_VECTOR else [value]
+        if self.kind == KIND_TEXT:
+            position = 0
+            for v in values:
+                if v is None:
+                    continue
+                toks = self.analyzer.analyze(str(v))
+                # position gap of 100 between array elements (Lucene default)
+                for t in toks:
+                    pf.tokens.append(Token(t.term, t.position + position,
+                                           t.start_offset, t.end_offset))
+                if toks:
+                    position += toks[-1].position + 100
+        elif self.kind == KIND_KEYWORD:
+            pf.keywords = [str(v) for v in values if v is not None]
+        elif self.kind == KIND_NUMERIC:
+            for v in values:
+                if v is None:
+                    continue
+                if self.type == "date":
+                    pf.numerics.append(parse_date(v))
+                elif self.type == "boolean":
+                    if isinstance(v, str):
+                        v = v.lower() in ("true", "1", "on", "yes")
+                    pf.numerics.append(1.0 if v else 0.0)
+                else:
+                    try:
+                        pf.numerics.append(float(v))
+                    except (TypeError, ValueError):
+                        raise MapperParsingError(
+                            f"failed to parse [{self.name}] value [{v}] as {self.type}"
+                        ) from None
+        elif self.kind == KIND_VECTOR:
+            arr = np.asarray(value, dtype=np.float32)
+            if arr.shape != (self.dims,):
+                raise MapperParsingError(
+                    f"dense_vector [{self.name}] expects dims [{self.dims}], "
+                    f"got shape {arr.shape}")
+            pf.vector = arr
+        elif self.kind == KIND_GEO:
+            v = values[0]
+            if isinstance(v, dict):
+                pf.geo = (float(v["lat"]), float(v["lon"]))
+            elif isinstance(v, str):
+                lat, lon = v.split(",")
+                pf.geo = (float(lat), float(lon))
+            elif isinstance(v, (list, tuple)):  # GeoJSON order [lon, lat]
+                pf.geo = (float(v[1]), float(v[0]))
+            else:
+                raise MapperParsingError(f"cannot parse geo_point [{value}]")
+        return pf
+
+
+class DocumentMapper:
+    """Per-type document mapping (reference: DocumentMapper.java)."""
+
+    def __init__(self, type_name: str, mapping_def: Mapping[str, Any],
+                 analysis: AnalysisRegistry, dynamic: bool = True):
+        self.type_name = type_name
+        self.analysis = analysis
+        self.root: dict[str, Any] = dict(mapping_def)
+        self.dynamic = {"true": True, "false": False, "strict": "strict"}.get(
+            str(mapping_def.get("dynamic", dynamic)).lower(), True)
+        self.mappers: dict[str, FieldMapper] = {}
+        self._build(mapping_def.get("properties", {}), prefix="")
+
+    def _build(self, properties: Mapping[str, Any], prefix: str) -> None:
+        for name, fdef in properties.items():
+            full = f"{prefix}{name}"
+            if "properties" in fdef and "type" not in fdef:   # object field
+                self._build(fdef["properties"], prefix=f"{full}.")
+                continue
+            self.add_mapper(FieldMapper(full, fdef.get("type", "text"), fdef,
+                                        self.analysis))
+
+    def add_mapper(self, mapper: FieldMapper) -> None:
+        self.mappers[mapper.name] = mapper
+        for sub in mapper.sub_fields.values():
+            self.mappers[sub.name] = sub
+
+    # ---- dynamic mapping inference (DocumentParser dynamic templates) -----
+
+    def _infer(self, name: str, value: Any) -> FieldMapper | None:
+        if value is None:
+            return None
+        if isinstance(value, list):
+            if not value:
+                return None
+            value = value[0]
+        if isinstance(value, bool):
+            ftype = "boolean"
+        elif isinstance(value, int):
+            ftype = "long"
+        elif isinstance(value, float):
+            ftype = "double"
+        elif isinstance(value, str):
+            # date detection mirrors the reference's dynamic date formats
+            try:
+                parse_date(value)
+                is_date = any(c in value for c in "-:T") and value[:4].isdigit()
+            except MapperParsingError:
+                is_date = False
+            ftype = "date" if is_date else "text"
+        else:
+            return None
+        params = {"type": ftype}
+        if ftype == "text":
+            # dynamic strings get a .keyword sub-field (modern ES default)
+            params["fields"] = {"keyword": {"type": "keyword"}}
+        return FieldMapper(name, ftype, params, self.analysis)
+
+    # ---- parse ------------------------------------------------------------
+
+    def parse(self, doc_id: str, source: Mapping[str, Any],
+              routing: str | None = None) -> ParsedDocument:
+        fields: dict[str, ParsedField] = {}
+        new_mappers: list[FieldMapper] = []
+        self._parse_object(source, "", fields, new_mappers)
+        for m in new_mappers:        # dynamic mapping update
+            self.add_mapper(m)
+        return ParsedDocument(doc_id=doc_id, source=dict(source), fields=fields,
+                              routing=routing)
+
+    def _parse_object(self, obj: Mapping[str, Any], prefix: str,
+                      out: dict[str, ParsedField],
+                      new_mappers: list[FieldMapper]) -> None:
+        for key, value in obj.items():
+            full = f"{prefix}{key}"
+            if isinstance(value, Mapping) and full not in self.mappers:
+                self._parse_object(value, f"{full}.", out, new_mappers)
+                continue
+            mapper = self.mappers.get(full)
+            if mapper is None:
+                if self.dynamic == "strict":
+                    raise MapperParsingError(
+                        f"mapping set to strict, dynamic introduction of [{full}] "
+                        f"within [{self.type_name}] is not allowed")
+                if not self.dynamic:
+                    continue
+                mapper = self._infer(full, value)
+                if mapper is None:
+                    continue
+                new_mappers.append(mapper)
+            out[full] = mapper.parse_value(value)
+            for sub in mapper.sub_fields.values():
+                out[sub.name] = sub.parse_value(value)
+
+    def mapping_dict(self) -> dict:
+        props: dict[str, Any] = {}
+        for name, m in self.mappers.items():
+            if "." in name and name.rsplit(".", 1)[0] in self.mappers:
+                continue  # sub-field, rendered inside parent
+            node = props
+            parts = name.split(".")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {}).setdefault("properties", {})
+            node[parts[-1]] = m.to_dict()
+        return {"properties": props}
+
+
+class MapperService:
+    """Per-index mapping registry + merge (reference: MapperService.java).
+
+    ES 2.x is multi-type; modern ES is single-type. We accept any type name
+    but default to ``_doc``.
+    """
+
+    DEFAULT_TYPE = "_doc"
+
+    def __init__(self, analysis: AnalysisRegistry | None = None):
+        self.analysis = analysis or AnalysisRegistry()
+        self.mappers: dict[str, DocumentMapper] = {}
+
+    def merge(self, type_name: str, mapping_def: Mapping[str, Any]) -> DocumentMapper:
+        existing = self.mappers.get(type_name)
+        if existing is None:
+            dm = DocumentMapper(type_name, mapping_def, self.analysis)
+            self.mappers[type_name] = dm
+            return dm
+        # merge: new fields added; conflicting type changes rejected
+        for name, fdef in mapping_def.get("properties", {}).items():
+            old = existing.mappers.get(name)
+            new = FieldMapper(name, fdef.get("type", "text"), fdef, self.analysis)
+            if old is not None and old.type != new.type:
+                raise IllegalArgumentError(
+                    f"mapper [{name}] cannot be changed from type "
+                    f"[{old.type}] to [{new.type}]")
+            existing.add_mapper(new)
+        return existing
+
+    def document_mapper(self, type_name: str | None = None) -> DocumentMapper:
+        tname = type_name or self.DEFAULT_TYPE
+        if tname not in self.mappers:
+            self.mappers[tname] = DocumentMapper(tname, {}, self.analysis)
+        return self.mappers[tname]
+
+    def field_mapper(self, field_name: str) -> FieldMapper | None:
+        for dm in self.mappers.values():
+            if field_name in dm.mappers:
+                return dm.mappers[field_name]
+        return None
+
+    def mapping_dict(self) -> dict:
+        return {t: dm.mapping_dict() for t, dm in self.mappers.items()}

@@ -1,0 +1,3 @@
+from elasticsearch_tpu.utils.hashing import murmur3_hash32
+
+__all__ = ["murmur3_hash32"]

@@ -1,0 +1,31 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+Mirrors the reference's in-process multi-node test strategy
+(test/test/InternalTestCluster.java:146 runs N nodes in one JVM over
+LocalTransport): we run N "chips" in one process over XLA's host platform,
+so every sharding/collective path is exercised without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def tmp_index_path(tmp_path):
+    p = tmp_path / "index0"
+    p.mkdir()
+    return p

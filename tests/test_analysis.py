@@ -1,0 +1,93 @@
+"""Analysis chain tests (tokenizers, filters, custom analyzers, stemming)."""
+
+import pytest
+
+from elasticsearch_tpu.analysis.analyzers import (
+    AnalysisRegistry, BUILTIN_ANALYZERS, porter_stem, standard_tokenizer,
+    whitespace_tokenizer, keyword_tokenizer, shingle_filter_factory,
+    asciifolding_filter, Token)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+
+class TestTokenizers:
+    def test_standard(self):
+        toks = standard_tokenizer("The quick-brown fox, jumps! 42 times")
+        assert [t.term for t in toks] == ["The", "quick", "brown", "fox",
+                                          "jumps", "42", "times"]
+        assert toks[0].position == 0 and toks[2].position == 2
+
+    def test_standard_apostrophe(self):
+        assert [t.term for t in standard_tokenizer("it's O'Brien")] == ["it's", "O'Brien"]
+
+    def test_offsets(self):
+        toks = standard_tokenizer("ab cd")
+        assert (toks[1].start_offset, toks[1].end_offset) == (3, 5)
+
+    def test_whitespace_keyword(self):
+        assert [t.term for t in whitespace_tokenizer("Foo-Bar baz")] == ["Foo-Bar", "baz"]
+        assert [t.term for t in keyword_tokenizer("New York")] == ["New York"]
+
+
+class TestAnalyzers:
+    def test_standard_analyzer_keeps_stopwords(self):
+        # ES 2.x standard analyzer: lowercase, no stopword removal.
+        a = BUILTIN_ANALYZERS["standard"]
+        assert a.terms("The Quick Fox") == ["the", "quick", "fox"]
+
+    def test_english_analyzer(self):
+        a = BUILTIN_ANALYZERS["english"]
+        assert a.terms("The running foxes jumped") == ["run", "fox", "jump"]
+
+    def test_stop_positions_preserved(self):
+        a = BUILTIN_ANALYZERS["english"]
+        toks = a.analyze("the quick brown fox")
+        # "the" removed but "quick" keeps position 1 → phrase gaps correct
+        assert [(t.term, t.position) for t in toks] == [
+            ("quick", 1), ("brown", 2), ("fox", 3)]
+
+    def test_custom_analyzer_from_settings(self):
+        reg = AnalysisRegistry(Settings({
+            "analysis": {"analyzer": {"my_shout": {
+                "type": "custom", "tokenizer": "whitespace",
+                "filter": ["uppercase"]}}}}))
+        assert reg.get("my_shout").terms("hello world") == ["HELLO", "WORLD"]
+
+    def test_unknown_analyzer(self):
+        with pytest.raises(IllegalArgumentError):
+            AnalysisRegistry().get("nope")
+
+
+class TestFilters:
+    def test_asciifolding(self):
+        toks = [Token("café", 0, 0, 4), Token("über", 1, 5, 9)]
+        assert [t.term for t in asciifolding_filter(toks)] == ["cafe", "uber"]
+
+    def test_shingles(self):
+        toks = [Token("quick", 0, 0, 5), Token("fox", 1, 6, 9)]
+        out = shingle_filter_factory(2, 2)(toks)
+        assert "quick fox" in [t.term for t in out]
+
+
+class TestPorterStemmer:
+    @pytest.mark.parametrize("word,stem", [
+        ("caresses", "caress"), ("ponies", "poni"), ("cats", "cat"),
+        ("feed", "feed"), ("agreed", "agre"), ("plastered", "plaster"),
+        ("motoring", "motor"), ("sing", "sing"), ("conflated", "conflat"),
+        ("troubled", "troubl"), ("sized", "size"), ("hopping", "hop"),
+        ("falling", "fall"), ("hissing", "hiss"), ("failing", "fail"),
+        ("happy", "happi"), ("relational", "relat"), ("conditional", "condit"),
+        ("vietnamization", "vietnam"), ("predication", "predic"),
+        ("operator", "oper"), ("feudalism", "feudal"),
+        ("decisiveness", "decis"), ("hopefulness", "hope"),
+        ("formaliti", "formal"), ("triplicate", "triplic"),
+        ("formative", "form"), ("formalize", "formal"),
+        ("electriciti", "electr"), ("electrical", "electr"),
+        ("hopeful", "hope"), ("goodness", "good"),
+        ("revival", "reviv"), ("allowance", "allow"), ("inference", "infer"),
+        ("airliner", "airlin"), ("adjustable", "adjust"),
+        ("effective", "effect"), ("probate", "probat"), ("rate", "rate"),
+        ("controll", "control"), ("roll", "roll"),
+    ])
+    def test_vocabulary(self, word, stem):
+        assert porter_stem(word) == stem

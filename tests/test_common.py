@@ -1,0 +1,92 @@
+"""Unit tests for the common substrate (settings, errors, versioning, hashing)."""
+
+import pytest
+
+from elasticsearch_tpu.common.settings import (
+    Settings, Setting, parse_time_value, parse_bytes_value, parse_bool)
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError, VersionConflictError, IndexNotFoundError)
+from elasticsearch_tpu.common.versioning import CURRENT_VERSION, Version
+from elasticsearch_tpu.utils import murmur3_hash32
+
+
+class TestSettings:
+    def test_flatten_nested(self):
+        s = Settings({"index": {"number_of_shards": 2, "refresh_interval": "1s"}})
+        assert s.get_as_int("index.number_of_shards", 5) == 2
+        assert s.get_as_time("index.refresh_interval", 5.0) == 1.0
+
+    def test_defaults(self):
+        s = Settings.EMPTY
+        assert s.get_as_int("missing", 7) == 7
+        assert s.get_as_bool("missing", True) is True
+
+    def test_time_values(self):
+        assert parse_time_value("30s") == 30.0
+        assert parse_time_value("100ms") == 0.1
+        assert parse_time_value("2m") == 120.0
+        assert parse_time_value(1500) == 1.5  # raw millis
+        with pytest.raises(IllegalArgumentError):
+            parse_time_value("5 parsecs")
+
+    def test_bytes_values(self):
+        assert parse_bytes_value("512mb") == 512 * 1024 * 1024
+        assert parse_bytes_value("1g") == 1024 ** 3
+        assert parse_bytes_value(123) == 123
+
+    def test_bool(self):
+        assert parse_bool("true") and parse_bool("on") and parse_bool("1")
+        assert not parse_bool("false") and not parse_bool("off")
+        with pytest.raises(IllegalArgumentError):
+            parse_bool("maybe")
+
+    def test_typed_setting(self):
+        refresh = Setting.time_setting("test.index.refresh_interval", 1.0,
+                                       scope="index", dynamic=True)
+        assert refresh.get(Settings.EMPTY) == 1.0
+        assert refresh.get(Settings({"test.index.refresh_interval": "5s"})) == 5.0
+        assert refresh.dynamic
+
+    def test_merge_right_biased(self):
+        a = Settings({"x": 1, "y": 2})
+        b = a.merge({"y": 3, "z": 4})
+        assert b.get("x") == 1 and b.get("y") == 3 and b.get("z") == 4
+        assert a.get("y") == 2  # immutable
+
+    def test_prefix(self):
+        s = Settings({"analysis.analyzer.my.type": "custom", "other": 1})
+        sub = s.get_by_prefix("analysis.analyzer.my.")
+        assert sub.get("type") == "custom" and len(sub) == 1
+
+
+class TestErrors:
+    def test_status_codes(self):
+        assert IndexNotFoundError("idx").status == 404
+        assert VersionConflictError("idx", "1", 3, 2).status == 409
+
+    def test_xcontent(self):
+        e = IndexNotFoundError("idx")
+        body = e.to_xcontent()
+        assert body["type"] == "index_not_found_exception"
+        assert body["index"] == "idx"
+
+
+class TestVersioning:
+    def test_ordering(self):
+        v1, v2 = Version.from_id(100), Version.from_id(200)
+        assert v1.before(v2) and v2.on_or_after(v1)
+        assert CURRENT_VERSION.is_compatible(Version.from_id(199))
+
+
+class TestMurmur3:
+    def test_known_vectors(self):
+        # Reference vectors for murmur3 x86_32 seed 0 (public test vectors).
+        assert murmur3_hash32(b"") == 0
+        assert murmur3_hash32(b"hello") == 0x248BFA47
+        assert murmur3_hash32(b"aaaa", 0x9747B28C) == 0x5A97808A
+
+    def test_routing_stability(self):
+        # Shard routing must be deterministic forever (index-time contract).
+        assert murmur3_hash32("doc-1") % 5 == murmur3_hash32("doc-1") % 5
+        shards = {murmur3_hash32(f"doc-{i}") % 8 for i in range(100)}
+        assert len(shards) == 8  # spreads across shards

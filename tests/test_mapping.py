@@ -1,0 +1,127 @@
+"""Mapping layer tests: field types, dynamic inference, merge, multi-fields."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.mapping.mapper import parse_date
+from elasticsearch_tpu.common.errors import MapperParsingError, IllegalArgumentError
+
+
+def make_service(mapping=None):
+    svc = MapperService()
+    if mapping:
+        svc.merge("_doc", mapping)
+    return svc
+
+
+class TestExplicitMapping:
+    MAPPING = {"properties": {
+        "title": {"type": "text", "analyzer": "standard"},
+        "tags": {"type": "keyword"},
+        "views": {"type": "long"},
+        "score": {"type": "double"},
+        "published": {"type": "date"},
+        "active": {"type": "boolean"},
+        "embedding": {"type": "dense_vector", "dims": 4},
+        "location": {"type": "geo_point"},
+    }}
+
+    def test_parse_all_kinds(self):
+        svc = make_service(self.MAPPING)
+        doc = svc.document_mapper("_doc").parse("1", {
+            "title": "Quick Brown Fox",
+            "tags": ["a", "b"],
+            "views": 42,
+            "score": 1.5,
+            "published": "2015-10-01T12:00:00Z",
+            "active": True,
+            "embedding": [1.0, 0.0, 0.0, 0.0],
+            "location": {"lat": 40.7, "lon": -74.0},
+        })
+        f = doc.fields
+        assert [t.term for t in f["title"].tokens] == ["quick", "brown", "fox"]
+        assert f["tags"].keywords == ["a", "b"]
+        assert f["views"].numerics == [42.0]
+        assert f["active"].numerics == [1.0]
+        assert f["published"].numerics[0] == parse_date("2015-10-01T12:00:00Z")
+        np.testing.assert_array_equal(f["embedding"].vector,
+                                      np.array([1, 0, 0, 0], np.float32))
+        assert f["location"].geo == (40.7, -74.0)
+
+    def test_text_array_position_gap(self):
+        svc = make_service({"properties": {"t": {"type": "text"}}})
+        doc = svc.document_mapper().parse("1", {"t": ["one two", "three"]})
+        positions = [t.position for t in doc.fields["t"].tokens]
+        assert positions[0] == 0 and positions[1] == 1
+        assert positions[2] >= 100  # gap blocks phrases across array elements
+
+    def test_bad_vector_dims(self):
+        svc = make_service({"properties": {"v": {"type": "dense_vector", "dims": 3}}})
+        with pytest.raises(MapperParsingError):
+            svc.document_mapper().parse("1", {"v": [1.0, 2.0]})
+
+    def test_string_not_analyzed_compat(self):
+        # ES 2.x style: string + not_analyzed == keyword
+        svc = make_service({"properties": {
+            "s": {"type": "string", "index": "not_analyzed"}}})
+        doc = svc.document_mapper().parse("1", {"s": "Foo Bar"})
+        assert doc.fields["s"].keywords == ["Foo Bar"]
+
+
+class TestDynamicMapping:
+    def test_inference(self):
+        svc = make_service()
+        dm = svc.document_mapper()
+        doc = dm.parse("1", {"name": "alice smith", "age": 30, "pi": 3.14,
+                             "ok": True, "ts": "2020-01-02T03:04:05"})
+        assert dm.mappers["name"].type == "text"
+        assert dm.mappers["name.keyword"].type == "keyword"  # auto sub-field
+        assert dm.mappers["age"].type == "long"
+        assert dm.mappers["pi"].type == "double"
+        assert dm.mappers["ok"].type == "boolean"
+        assert dm.mappers["ts"].type == "date"
+        assert doc.fields["name.keyword"].keywords == ["alice smith"]
+
+    def test_nested_objects_flatten(self):
+        svc = make_service()
+        dm = svc.document_mapper()
+        dm.parse("1", {"user": {"name": "bob", "stats": {"age": 4}}})
+        assert dm.mappers["user.name"].type == "text"
+        assert dm.mappers["user.stats.age"].type == "long"
+
+    def test_strict_dynamic(self):
+        svc = make_service({"dynamic": "strict", "properties": {
+            "a": {"type": "long"}}})
+        with pytest.raises(MapperParsingError):
+            svc.document_mapper().parse("1", {"b": 1})
+
+
+class TestMerge:
+    def test_add_field(self):
+        svc = make_service({"properties": {"a": {"type": "long"}}})
+        svc.merge("_doc", {"properties": {"b": {"type": "keyword"}}})
+        dm = svc.document_mapper()
+        assert dm.mappers["a"].type == "long" and dm.mappers["b"].type == "keyword"
+
+    def test_conflicting_type_rejected(self):
+        svc = make_service({"properties": {"a": {"type": "long"}}})
+        with pytest.raises(IllegalArgumentError):
+            svc.merge("_doc", {"properties": {"a": {"type": "keyword"}}})
+
+    def test_roundtrip_dict(self):
+        m = {"properties": {"title": {"type": "text",
+                                      "fields": {"raw": {"type": "keyword"}}}}}
+        svc = make_service(m)
+        out = svc.mapping_dict()["_doc"]
+        assert out["properties"]["title"]["type"] == "text"
+        assert out["properties"]["title"]["fields"]["raw"]["type"] == "keyword"
+
+
+class TestDates:
+    def test_formats(self):
+        assert parse_date(1000) == 1000.0
+        assert parse_date("1970-01-01T00:00:01Z") == 1000.0
+        assert parse_date("1970-01-02") == 86400000.0
+        with pytest.raises(MapperParsingError):
+            parse_date("not a date")
