@@ -285,6 +285,27 @@ TOKEN_FILTERS: dict[str, TokenFilter] = {
     "porter_stem": porter_stem_filter,
     "stemmer": porter_stem_filter,
     "unique": unique_filter,
+    "shingle": shingle_filter_factory(),
+    "length": length_filter_factory(),
+}
+
+# Parameterized component factories, used for custom definitions in index
+# settings (``analysis.tokenizer.<name>.type`` / ``analysis.filter.<name>.type``).
+TOKENIZER_FACTORIES: dict[str, Callable[..., Tokenizer]] = {
+    "ngram": lambda params: ngram_tokenizer_factory(
+        int(params.get("min_gram", 1)), int(params.get("max_gram", 2))),
+}
+
+TOKEN_FILTER_FACTORIES: dict[str, Callable[..., TokenFilter]] = {
+    "stop": lambda params: stop_filter_factory(
+        frozenset(params["stopwords"]) if isinstance(params.get("stopwords"), list)
+        else ENGLISH_STOPWORDS),
+    "length": lambda params: length_filter_factory(
+        int(params.get("min", 0)), int(params.get("max", 255))),
+    "shingle": lambda params: shingle_filter_factory(
+        int(params.get("min_shingle_size", 2)),
+        int(params.get("max_shingle_size", 2)),
+        params.get("token_separator", " ")),
 }
 
 
@@ -328,13 +349,38 @@ class AnalysisRegistry:
 
     def __init__(self, index_settings: Settings = Settings.EMPTY):
         self.analyzers: dict[str, Analyzer] = dict(BUILTIN_ANALYZERS)
+        self.tokenizers: dict[str, Tokenizer] = dict(TOKENIZERS)
+        self.tokenizers["ngram"] = ngram_tokenizer_factory()
+        self.filters: dict[str, TokenFilter] = dict(TOKEN_FILTERS)
+        self._build_components(index_settings)
         self._build_custom(index_settings)
 
+    def _component_names(self, settings: Settings, prefix: str) -> set[str]:
+        return {key.split(".")[2] for key in settings if key.startswith(prefix)}
+
+    def _build_components(self, settings: Settings) -> None:
+        """Custom tokenizer/filter definitions with parameters."""
+        for name in sorted(self._component_names(settings, "analysis.tokenizer.")):
+            sub = settings.get_by_prefix(f"analysis.tokenizer.{name}.")
+            ttype = sub.get("type")
+            if ttype in TOKENIZER_FACTORIES:
+                self.tokenizers[name] = TOKENIZER_FACTORIES[ttype](sub.as_dict())
+            elif ttype in TOKENIZERS:
+                self.tokenizers[name] = TOKENIZERS[ttype]
+            else:
+                raise IllegalArgumentError(f"unknown tokenizer type [{ttype}]")
+        for name in sorted(self._component_names(settings, "analysis.filter.")):
+            sub = settings.get_by_prefix(f"analysis.filter.{name}.")
+            ftype = sub.get("type")
+            if ftype in TOKEN_FILTER_FACTORIES:
+                self.filters[name] = TOKEN_FILTER_FACTORIES[ftype](sub.as_dict())
+            elif ftype in TOKEN_FILTERS:
+                self.filters[name] = TOKEN_FILTERS[ftype]
+            else:
+                raise IllegalArgumentError(f"unknown filter type [{ftype}]")
+
     def _build_custom(self, settings: Settings) -> None:
-        names = set()
-        for key in settings:
-            if key.startswith("analysis.analyzer."):
-                names.add(key.split(".")[2])
+        names = self._component_names(settings, "analysis.analyzer.")
         for name in sorted(names):
             sub = settings.get_by_prefix(f"analysis.analyzer.{name}.")
             atype = sub.get("type", "custom")
@@ -342,17 +388,17 @@ class AnalysisRegistry:
                 self.analyzers[name] = BUILTIN_ANALYZERS[atype]
                 continue
             tok_name = sub.get("tokenizer", "standard")
-            if tok_name not in TOKENIZERS:
+            if tok_name not in self.tokenizers:
                 raise IllegalArgumentError(f"unknown tokenizer [{tok_name}] for analyzer [{name}]")
             filters = []
             raw_filters = sub.get("filter", [])
             if isinstance(raw_filters, str):
                 raw_filters = [f.strip() for f in raw_filters.split(",") if f.strip()]
             for fname in raw_filters:
-                if fname not in TOKEN_FILTERS:
+                if fname not in self.filters:
                     raise IllegalArgumentError(f"unknown filter [{fname}] for analyzer [{name}]")
-                filters.append(TOKEN_FILTERS[fname])
-            self.analyzers[name] = Analyzer(name, TOKENIZERS[tok_name], filters)
+                filters.append(self.filters[fname])
+            self.analyzers[name] = Analyzer(name, self.tokenizers[tok_name], filters)
 
     def get(self, name: str) -> Analyzer:
         try:

@@ -1,0 +1,5 @@
+from elasticsearch_tpu.index.segment import Segment, SegmentBuilder, TextFieldColumn
+from elasticsearch_tpu.index.translog import Translog
+from elasticsearch_tpu.index.engine import Engine
+
+__all__ = ["Segment", "SegmentBuilder", "TextFieldColumn", "Translog", "Engine"]

@@ -1,0 +1,407 @@
+"""Engine — per-shard versioned CRUD orchestration.
+
+The TPU-native counterpart of the reference's InternalEngine
+(core/index/engine/InternalEngine.java): it owns
+
+* an in-memory write buffer (:class:`SegmentBuilder`) — Lucene IndexWriter's
+  RAM buffer;
+* the committed immutable segment list + per-segment live bitmaps;
+* the **version map** (doc _id → version/location) backing realtime get and
+  optimistic concurrency (LiveVersionMap, InternalEngine.java:97,359,408);
+* the :class:`Translog` WAL (add on every op, InternalEngine.java:335→
+  translog.add);
+* ``refresh()`` — turn the buffer into a searchable segment and swap the
+  reader (InternalEngine.java:558);
+* ``flush()`` — persist segments + commit point, roll the translog
+  (InternalEngine.java:616);
+* recovery — reopen last commit and replay uncommitted translog ops
+  (InternalEngine.java:215).
+
+Deletes against committed segments flip bits in the per-segment live bitmap
+at refresh time (Lucene .liv semantics: visible to search after refresh,
+immediately visible to realtime get via the version map).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import (
+    DocumentMissingError, EngineClosedError, VersionConflictError)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.segment import Segment, SegmentBuilder, merge_segments
+from elasticsearch_tpu.index.translog import (
+    Translog, TranslogOp, OP_INDEX, OP_DELETE, DURABILITY_REQUEST)
+from elasticsearch_tpu.mapping import MapperService
+
+# Versioning ops match the reference's VersionType.INTERNAL semantics.
+MATCH_ANY = -3  # Versions.MATCH_ANY
+NOT_FOUND = -1
+
+
+@dataclass
+class VersionEntry:
+    version: int
+    deleted: bool
+    seg_id: int      # -1 = in the uncommitted buffer
+    local_doc: int   # position within segment/buffer
+
+
+@dataclass
+class GetResult:
+    found: bool
+    doc_id: str
+    version: int = 0
+    source: dict | None = None
+
+
+@dataclass
+class EngineStats:
+    index_total: int = 0
+    delete_total: int = 0
+    refresh_total: int = 0
+    flush_total: int = 0
+    merge_total: int = 0
+    index_time_ms: float = 0.0
+
+
+class SearcherView:
+    """An immutable point-in-time view: segments + live masks.
+
+    The analog of an NRT reader acquired via IndexShard.acquireSearcher
+    (core/index/shard/IndexShard.java:707). DeviceReader (ops layer) packs
+    this onto the device.
+    """
+
+    def __init__(self, segments: list[Segment], live_masks: list[np.ndarray],
+                 generation: int):
+        self.segments = segments
+        self.live_masks = live_masks   # [padded_docs] bool per segment
+        self.generation = generation
+
+    @property
+    def num_docs(self) -> int:
+        return int(sum(m[:s.num_docs].sum() for s, m in
+                       zip(self.segments, self.live_masks)))
+
+    @property
+    def max_doc(self) -> int:
+        return sum(s.num_docs for s in self.segments)
+
+
+class Engine:
+    def __init__(self, shard_path: Path, mapper_service: MapperService,
+                 settings: Settings = Settings.EMPTY):
+        self.path = Path(shard_path)
+        self.path.mkdir(parents=True, exist_ok=True)
+        self.mapper_service = mapper_service
+        self.settings = settings
+        self.stats = EngineStats()
+        self._lock = threading.RLock()
+        self._closed = False
+
+        durability = settings.get("index.translog.durability", DURABILITY_REQUEST)
+        self.translog = Translog(self.path / "translog", durability=durability)
+
+        self._segments: list[Segment] = []
+        self._live_masks: list[np.ndarray] = []
+        self._buffer = SegmentBuilder(seg_id=0)
+        self._buffer_docs: dict[str, int] = {}      # _id → buffer local doc
+        self._versions: dict[str, VersionEntry] = {}
+        # (seg_id, local_doc) → doc_id: committed copies superseded since the
+        # last refresh; their live bits are cleared at the next refresh.
+        self._pending_seg_deletes: dict[tuple[int, int], str] = {}
+        self._next_seg_id = 1
+        self._reader_gen = 0
+        self._commit_gen = self._load_commit()
+        self._replay_translog()
+        # End recovery with a refresh (reference: recoverFromTranslog ends
+        # with refresh, InternalEngine.java:215ff) so replayed ops — and
+        # replayed *deletes* queued in _pending_seg_deletes — are visible to
+        # the first searcher.
+        self._reader = SearcherView([], [], 0)
+        self.refresh()
+
+    # ------------------------------------------------------------------ CRUD
+
+    def index(self, doc_id: str, source: dict, version: int = MATCH_ANY,
+              routing: str | None = None, op_type: str = "index",
+              from_translog: bool = False) -> tuple[int, bool]:
+        """→ (new_version, created). Version semantics follow
+        InternalEngine.innerIndex (version check → write → versionMap put)."""
+        t0 = time.perf_counter()
+        with self._lock:
+            self._ensure_open()
+            entry = self._versions.get(doc_id)
+            current = NOT_FOUND if entry is None or entry.deleted else entry.version
+            if op_type == "create" and current != NOT_FOUND:
+                raise VersionConflictError("", doc_id, current, 0)
+            if version != MATCH_ANY and version != current:
+                raise VersionConflictError("", doc_id, current, version)
+            new_version = 1 if current == NOT_FOUND else current + 1
+
+            parsed = self.mapper_service.document_mapper().parse(
+                doc_id, source, routing=routing)
+            # supersede any buffered copy of the same doc
+            old_buf = self._buffer_docs.get(doc_id)
+            if old_buf is not None:
+                self._buffer.docs[old_buf] = None  # tombstone slot
+            if entry is not None and entry.seg_id >= 0:
+                self._pending_seg_deletes[(entry.seg_id, entry.local_doc)] = doc_id
+            local = self._buffer.add(parsed)
+            self._buffer_docs[doc_id] = local
+            self._versions[doc_id] = VersionEntry(new_version, False, -1, local)
+            if not from_translog:
+                self.translog.add(TranslogOp(OP_INDEX, doc_id, new_version,
+                                             source=source, routing=routing))
+            self.stats.index_total += 1
+            self.stats.index_time_ms += (time.perf_counter() - t0) * 1e3
+            return new_version, current == NOT_FOUND
+
+    def delete(self, doc_id: str, version: int = MATCH_ANY,
+               from_translog: bool = False) -> int:
+        with self._lock:
+            self._ensure_open()
+            entry = self._versions.get(doc_id)
+            current = NOT_FOUND if entry is None or entry.deleted else entry.version
+            if version != MATCH_ANY and version != current:
+                raise VersionConflictError("", doc_id, current, version)
+            if current == NOT_FOUND:
+                raise DocumentMissingError("", doc_id)
+            new_version = current + 1
+            if entry.seg_id == -1:
+                self._buffer.docs[entry.local_doc] = None
+                self._buffer_docs.pop(doc_id, None)
+            elif entry.seg_id >= 0:
+                self._pending_seg_deletes[(entry.seg_id, entry.local_doc)] = doc_id
+            self._versions[doc_id] = VersionEntry(new_version, True, -2, -1)
+            if not from_translog:
+                self.translog.add(TranslogOp(OP_DELETE, doc_id, new_version))
+            self.stats.delete_total += 1
+            return new_version
+
+    def get(self, doc_id: str) -> GetResult:
+        """Realtime get (reference: ShardGetService.java:68 — reads from the
+        version map / translog without waiting for refresh)."""
+        with self._lock:
+            self._ensure_open()
+            entry = self._versions.get(doc_id)
+            if entry is None or entry.deleted:
+                return GetResult(found=False, doc_id=doc_id)
+            if entry.seg_id == -1:
+                doc = self._buffer.docs[entry.local_doc]
+                return GetResult(True, doc_id, entry.version, doc.source)
+            for seg in self._segments:
+                if seg.seg_id == entry.seg_id:
+                    return GetResult(True, doc_id, entry.version,
+                                     seg.sources[entry.local_doc])
+            return GetResult(found=False, doc_id=doc_id)
+
+    # --------------------------------------------------------------- refresh
+
+    def refresh(self) -> SearcherView:
+        """Make buffered writes searchable: build a segment from the buffer,
+        apply pending deletes to live bitmaps, swap the reader."""
+        with self._lock:
+            self._ensure_open()
+            live_docs = [d for d in self._buffer.docs if d is not None]
+            if live_docs:
+                builder = SegmentBuilder(self._next_seg_id,
+                                         max_tokens=self._buffer.max_tokens)
+                for d in live_docs:
+                    builder.add(d)
+                seg = builder.build()
+                mask = np.zeros(seg.padded_docs, dtype=bool)
+                mask[:seg.num_docs] = True
+                for local, d in enumerate(live_docs):
+                    e = self._versions.get(d.doc_id)
+                    if e is not None and not e.deleted and e.seg_id == -1:
+                        self._versions[d.doc_id] = VersionEntry(
+                            e.version, False, seg.seg_id, local)
+                self._segments.append(seg)
+                self._live_masks.append(mask)
+                self._next_seg_id += 1
+                self._buffer = SegmentBuilder(seg_id=0,
+                                              max_tokens=self._buffer.max_tokens)
+                self._buffer_docs = {}
+            # apply deletes & updates to committed segments (only docs whose
+            # committed copy was superseded since the last refresh)
+            if self._pending_seg_deletes:
+                by_seg = {s.seg_id: (s, m) for s, m in
+                          zip(self._segments, self._live_masks)}
+                for (seg_id, local), did in self._pending_seg_deletes.items():
+                    pair = by_seg.get(seg_id)
+                    if pair is None:
+                        continue
+                    seg, mask = pair
+                    e = self._versions.get(did)
+                    if e is None or e.deleted or e.seg_id != seg_id \
+                            or e.local_doc != local:
+                        mask[local] = False
+                self._pending_seg_deletes = {}
+            self._reader_gen += 1
+            self.stats.refresh_total += 1
+            self._reader = SearcherView(list(self._segments),
+                                        [m.copy() for m in self._live_masks],
+                                        self._reader_gen)
+            return self._reader
+
+    def acquire_searcher(self) -> SearcherView:
+        with self._lock:
+            self._ensure_open()
+            return self._reader
+
+    # ----------------------------------------------------------------- flush
+
+    def flush(self) -> None:
+        """Persist segments + commit point; roll translog
+        (InternalEngine.java:616: Lucene commit + translog roll)."""
+        with self._lock:
+            self._ensure_open()
+            self.refresh()
+            for seg, mask in zip(self._segments, self._live_masks):
+                seg_dir = self.path / f"seg_{seg.seg_id}"
+                if not (seg_dir / "meta.json").exists():
+                    seg.write(seg_dir)
+                np.save(seg_dir / "live.tmp.npy", mask)
+                os.replace(seg_dir / "live.tmp.npy", seg_dir / "live.npy")
+            self._commit_gen += 1
+            commit = {
+                "generation": self._commit_gen,
+                "segments": [s.seg_id for s in self._segments],
+                "next_seg_id": self._next_seg_id,
+                "versions": {did: [e.version, e.deleted, e.seg_id, e.local_doc]
+                             for did, e in self._versions.items()},
+            }
+            tmp = self.path / "commit.json.tmp"
+            tmp.write_text(json.dumps(commit))
+            os.replace(tmp, self.path / "commit.json")
+            self.translog.roll(committed=True)
+            self.stats.flush_total += 1
+
+    def force_merge(self, max_num_segments: int = 1) -> None:
+        """_optimize / force-merge: rewrite segments into one, dropping
+        deleted docs (ElasticsearchConcurrentMergeScheduler's job)."""
+        with self._lock:
+            self._ensure_open()
+            self.refresh()
+            if len(self._segments) <= max_num_segments:
+                return
+            builder = merge_segments(self._next_seg_id, self._segments,
+                                     self._live_masks,
+                                     self.mapper_service.document_mapper())
+            merged = builder.build()
+            mask = np.zeros(merged.padded_docs, dtype=bool)
+            mask[:merged.num_docs] = True
+            for local, did in enumerate(merged.ids):
+                e = self._versions.get(did)
+                if e is not None and not e.deleted:
+                    self._versions[did] = VersionEntry(e.version, False,
+                                                       merged.seg_id, local)
+            old = self._segments
+            was_committed = any((self.path / f"seg_{s.seg_id}" / "meta.json").exists()
+                                for s in old)
+            self._segments = [merged]
+            self._live_masks = [mask]
+            self._next_seg_id += 1
+            self._reader_gen += 1
+            self.stats.merge_total += 1
+            self._reader = SearcherView(list(self._segments), [mask.copy()],
+                                        self._reader_gen)
+            if was_committed:
+                # Persist the merged segment and a new commit point FIRST;
+                # only then is it safe to delete the merged-away segment
+                # files (otherwise a crash here loses committed docs).
+                self.flush()
+            for seg in old:  # remove persisted files of merged-away segments
+                seg_dir = self.path / f"seg_{seg.seg_id}"
+                if seg_dir.exists():
+                    for f in seg_dir.iterdir():
+                        f.unlink()
+                    seg_dir.rmdir()
+
+    # -------------------------------------------------------------- recovery
+
+    def _load_commit(self) -> int:
+        commit_file = self.path / "commit.json"
+        if not commit_file.exists():
+            return 0
+        commit = json.loads(commit_file.read_text())
+        for seg_id in commit["segments"]:
+            seg_dir = self.path / f"seg_{seg_id}"
+            seg = Segment.read(seg_dir)
+            live_file = seg_dir / "live.npy"
+            mask = (np.load(live_file) if live_file.exists()
+                    else np.concatenate([np.ones(seg.num_docs, bool),
+                                         np.zeros(seg.padded_docs - seg.num_docs,
+                                                  bool)]))
+            self._segments.append(seg)
+            self._live_masks.append(mask)
+        self._next_seg_id = commit["next_seg_id"]
+        self._versions = {
+            did: VersionEntry(v[0], v[1], v[2], v[3])
+            for did, v in commit["versions"].items()}
+        return commit["generation"]
+
+    def _replay_translog(self) -> None:
+        for op in self.translog.uncommitted_ops():
+            if op.op == OP_INDEX:
+                entry = self._versions.get(op.doc_id)
+                if entry is not None and entry.version >= op.version \
+                        and not entry.deleted:
+                    continue  # already applied in a newer state
+                self._apply_replayed_index(op)
+            elif op.op == OP_DELETE:
+                entry = self._versions.get(op.doc_id)
+                if entry is not None and entry.version >= op.version and entry.deleted:
+                    continue
+                if entry is not None and entry.seg_id == -1:
+                    self._buffer.docs[entry.local_doc] = None
+                    self._buffer_docs.pop(op.doc_id, None)
+                elif entry is not None and entry.seg_id >= 0:
+                    self._pending_seg_deletes[(entry.seg_id, entry.local_doc)] \
+                        = op.doc_id
+                self._versions[op.doc_id] = VersionEntry(op.version, True, -2, -1)
+
+    def _apply_replayed_index(self, op: TranslogOp) -> None:
+        parsed = self.mapper_service.document_mapper().parse(
+            op.doc_id, op.source, routing=op.routing)
+        old_buf = self._buffer_docs.get(op.doc_id)
+        if old_buf is not None:
+            self._buffer.docs[old_buf] = None
+        prev = self._versions.get(op.doc_id)
+        if prev is not None and prev.seg_id >= 0:
+            self._pending_seg_deletes[(prev.seg_id, prev.local_doc)] = op.doc_id
+        local = self._buffer.add(parsed)
+        self._buffer_docs[op.doc_id] = local
+        self._versions[op.doc_id] = VersionEntry(op.version, False, -1, local)
+
+    # ------------------------------------------------------------- lifecycle
+
+    @property
+    def num_docs(self) -> int:
+        with self._lock:
+            return sum(1 for e in self._versions.values() if not e.deleted)
+
+    def segment_stats(self) -> list[dict]:
+        return [{"seg_id": s.seg_id, "num_docs": s.num_docs,
+                 "live_docs": int(m[:s.num_docs].sum()),
+                 "memory_bytes": s.memory_bytes()}
+                for s, m in zip(self._segments, self._live_masks)]
+
+    def _ensure_open(self) -> None:
+        if self._closed:
+            raise EngineClosedError("engine is closed")
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self.translog.close()
+                self._closed = True

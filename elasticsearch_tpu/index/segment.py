@@ -1,0 +1,432 @@
+"""The columnar segment — this framework's Lucene-equivalent index format.
+
+The reference's per-shard index is a set of immutable Lucene segments
+(postings lists + doc values + stored fields; written by IndexWriter, read
+via NRT readers — core/index/engine/InternalEngine.java). Pointer-chasing,
+variable-length postings don't map to XLA/TPU, so the segment here is a set
+of **dense, padded, fixed-shape matrices** designed for HBM residency and
+vectorized scoring (SURVEY.md §7 step 2, BM25S-style eager scoring,
+PAPERS.md):
+
+Per analyzed text field:
+  * ``tokens[N, L]`` int32 — term ids in position order (-1 pad). With
+    ``positions[N, L]`` this is the positional index: phrase matching is a
+    shifted dense compare, replacing Lucene's position postings.
+  * ``uterms[N, U]`` int32 / ``utf[N, U]`` float32 — unique terms per doc and
+    their term frequencies: the *forward impact index*. BM25 scoring reads
+    these as dense vector ops (no scatter); equivalent of the term-frequency
+    postings + norms that Lucene's TermScorer/BM25Similarity consume.
+  * per-segment term dictionary + ``df`` counts (idf is computed at query
+    time from df aggregated across segments/shards, matching Lucene's
+    query-time IDF and enabling the DFS distributed-stats mode).
+
+Per keyword field: sorted vocab + ordinal matrix ``ords[N, K]`` (-1 pad) —
+the equivalent of SORTED_SET doc values (ordinal order == lexical order, so
+range/sort/terms-agg work on ordinals).
+
+Per numeric field: ``values[N]`` float64 + ``exists[N]`` — NUMERIC doc values.
+Per dense_vector field: ``vecs[N, D]`` float32 — row-major for MXU matmuls.
+
+All row counts are padded to tiling-friendly multiples; readers carry the
+true ``num_docs``. Segments are immutable after build; deletes live in the
+engine as per-segment live-bitmaps (Lucene's .liv files).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field as dc_field
+from pathlib import Path
+from typing import Any, Iterable
+
+import numpy as np
+
+from elasticsearch_tpu.common.versioning import CURRENT_VERSION
+from elasticsearch_tpu.mapping.mapper import (
+    ParsedDocument, KIND_TEXT, KIND_KEYWORD, KIND_NUMERIC, KIND_VECTOR, KIND_GEO)
+
+# Position-slot cap per text field (docs longer than this are truncated at
+# index time; reference analog: index.mapping.depth/field limits). Padded to
+# a multiple of _ROW_PAD for TPU lane tiling.
+DEFAULT_MAX_TOKENS = 512
+_ROW_PAD = 8
+
+
+def pad_to(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def doc_count_bucket(n: int) -> int:
+    """Bucketized row padding: bounds the number of distinct compiled shapes
+    as segments grow (SURVEY.md §7 'Incrementality'). Geometric buckets:
+    128, 256, 512, ... so at most ~2x memory overhead and O(log N) shapes."""
+    b = 128
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class TextFieldColumn:
+    """Device-layout columns for one analyzed text field of one segment."""
+    terms: list[str]                 # tid → term (sorted; per-segment dict)
+    tokens: np.ndarray               # [Np, L] int32, -1 pad (positional view)
+    positions: np.ndarray            # [Np, L] int32
+    uterms: np.ndarray               # [Np, U] int32, -1 pad (scoring view)
+    utf: np.ndarray                  # [Np, U] float32
+    doc_len: np.ndarray              # [Np] int32 (token count incl. truncation)
+    df: np.ndarray                   # [V] int32 docs-containing-term
+    total_tokens: int                # Σ doc_len over real docs (for avgdl)
+    term_index: dict[str, int] = dc_field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.term_index:
+            self.term_index = {t: i for i, t in enumerate(self.terms)}
+
+    def tid(self, term: str) -> int:
+        """Query-time term lookup; -1 = term absent from this segment."""
+        return self.term_index.get(term, -1)
+
+
+@dataclass
+class KeywordFieldColumn:
+    vocab: list[str]                 # sorted: ordinal order == lexical order
+    ords: np.ndarray                 # [Np, K] int32, -1 pad
+    index: dict[str, int] = dc_field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.index:
+            self.index = {v: i for i, v in enumerate(self.vocab)}
+
+    def ord(self, value: str) -> int:
+        return self.index.get(value, -1)
+
+
+@dataclass
+class NumericFieldColumn:
+    values: np.ndarray               # [Np] float64
+    exists: np.ndarray               # [Np] bool
+
+
+@dataclass
+class VectorFieldColumn:
+    vecs: np.ndarray                 # [Np, D] float32
+    exists: np.ndarray               # [Np] bool
+    dims: int
+
+
+@dataclass
+class GeoFieldColumn:
+    lat: np.ndarray                  # [Np] float64
+    lon: np.ndarray                  # [Np] float64
+    exists: np.ndarray               # [Np] bool
+
+
+@dataclass
+class Segment:
+    seg_id: int
+    num_docs: int                    # true doc count (rows beyond are pad)
+    padded_docs: int
+    ids: list[str]                   # local doc → _id
+    sources: list[dict]              # stored fields (_source)
+    text_fields: dict[str, TextFieldColumn]
+    keyword_fields: dict[str, KeywordFieldColumn]
+    numeric_fields: dict[str, NumericFieldColumn]
+    vector_fields: dict[str, VectorFieldColumn]
+    geo_fields: dict[str, GeoFieldColumn]
+    version_id: int = CURRENT_VERSION.id
+
+    def memory_bytes(self) -> int:
+        total = 0
+        for col in self.text_fields.values():
+            total += col.tokens.nbytes + col.positions.nbytes
+            total += col.uterms.nbytes + col.utf.nbytes + col.doc_len.nbytes
+            total += col.df.nbytes
+        for col in self.keyword_fields.values():
+            total += col.ords.nbytes
+        for col in self.numeric_fields.values():
+            total += col.values.nbytes + col.exists.nbytes
+        for col in self.vector_fields.values():
+            total += col.vecs.nbytes
+        for col in self.geo_fields.values():
+            total += col.lat.nbytes + col.lon.nbytes
+        return total
+
+    # ---- persistence ------------------------------------------------------
+
+    def write(self, path: Path) -> None:
+        """Persist as npz + json (write-tmp-then-rename like the reference's
+        MetaDataStateFormat, core/gateway/MetaDataStateFormat.java)."""
+        path.mkdir(parents=True, exist_ok=True)
+        arrays: dict[str, np.ndarray] = {}
+        meta: dict[str, Any] = {
+            "seg_id": self.seg_id, "num_docs": self.num_docs,
+            "padded_docs": self.padded_docs, "version_id": self.version_id,
+            "text_fields": {}, "keyword_fields": {}, "numeric_fields": [],
+            "vector_fields": {}, "geo_fields": [],
+        }
+        for name, c in self.text_fields.items():
+            meta["text_fields"][name] = {"terms": c.terms,
+                                         "total_tokens": c.total_tokens}
+            for a in ("tokens", "positions", "uterms", "utf", "doc_len", "df"):
+                arrays[f"t.{name}.{a}"] = getattr(c, a)
+        for name, c in self.keyword_fields.items():
+            meta["keyword_fields"][name] = {"vocab": c.vocab}
+            arrays[f"k.{name}.ords"] = c.ords
+        for name, c in self.numeric_fields.items():
+            meta["numeric_fields"].append(name)
+            arrays[f"n.{name}.values"] = c.values
+            arrays[f"n.{name}.exists"] = c.exists
+        for name, c in self.vector_fields.items():
+            meta["vector_fields"][name] = {"dims": c.dims}
+            arrays[f"v.{name}.vecs"] = c.vecs
+            arrays[f"v.{name}.exists"] = c.exists
+        for name, c in self.geo_fields.items():
+            meta["geo_fields"].append(name)
+            arrays[f"g.{name}.lat"] = c.lat
+            arrays[f"g.{name}.lon"] = c.lon
+            arrays[f"g.{name}.exists"] = c.exists
+
+        tmp_npz, tmp_meta, tmp_src = (path / "arrays.npz.tmp", path / "meta.json.tmp",
+                                      path / "source.jsonl.tmp")
+        with open(tmp_npz, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        tmp_meta.write_text(json.dumps(meta))
+        with open(tmp_src, "w") as f:
+            for doc_id, src in zip(self.ids, self.sources):
+                f.write(json.dumps({"_id": doc_id, "_source": src}) + "\n")
+        # meta.json is the "segment fully persisted" sentinel (Engine.flush
+        # checks it) — rename it LAST so a crash between renames can never
+        # produce a sentinel-present-but-incomplete segment.
+        tmp_npz.rename(path / "arrays.npz")
+        tmp_src.rename(path / "source.jsonl")
+        tmp_meta.rename(path / "meta.json")
+
+    @staticmethod
+    def read(path: Path) -> "Segment":
+        meta = json.loads((path / "meta.json").read_text())
+        arrays = np.load(path / "arrays.npz")
+        ids, sources = [], []
+        with open(path / "source.jsonl") as f:
+            for line in f:
+                rec = json.loads(line)
+                ids.append(rec["_id"])
+                sources.append(rec["_source"])
+        text_fields = {
+            name: TextFieldColumn(
+                terms=info["terms"], total_tokens=info["total_tokens"],
+                tokens=arrays[f"t.{name}.tokens"],
+                positions=arrays[f"t.{name}.positions"],
+                uterms=arrays[f"t.{name}.uterms"], utf=arrays[f"t.{name}.utf"],
+                doc_len=arrays[f"t.{name}.doc_len"], df=arrays[f"t.{name}.df"])
+            for name, info in meta["text_fields"].items()}
+        keyword_fields = {
+            name: KeywordFieldColumn(vocab=info["vocab"],
+                                     ords=arrays[f"k.{name}.ords"])
+            for name, info in meta["keyword_fields"].items()}
+        numeric_fields = {
+            name: NumericFieldColumn(values=arrays[f"n.{name}.values"],
+                                     exists=arrays[f"n.{name}.exists"])
+            for name in meta["numeric_fields"]}
+        vector_fields = {
+            name: VectorFieldColumn(vecs=arrays[f"v.{name}.vecs"],
+                                    exists=arrays[f"v.{name}.exists"],
+                                    dims=info["dims"])
+            for name, info in meta["vector_fields"].items()}
+        geo_fields = {
+            name: GeoFieldColumn(lat=arrays[f"g.{name}.lat"],
+                                 lon=arrays[f"g.{name}.lon"],
+                                 exists=arrays[f"g.{name}.exists"])
+            for name in meta["geo_fields"]}
+        return Segment(seg_id=meta["seg_id"], num_docs=meta["num_docs"],
+                       padded_docs=meta["padded_docs"], ids=ids, sources=sources,
+                       text_fields=text_fields, keyword_fields=keyword_fields,
+                       numeric_fields=numeric_fields, vector_fields=vector_fields,
+                       geo_fields=geo_fields, version_id=meta["version_id"])
+
+
+class SegmentBuilder:
+    """Accumulates parsed documents, emits an immutable :class:`Segment`.
+
+    The in-memory analog of Lucene's DocumentsWriter per-thread buffers; a
+    refresh (core/index/engine/InternalEngine.java:558) turns the buffer into
+    a segment and swaps the reader.
+    """
+
+    def __init__(self, seg_id: int, max_tokens: int = DEFAULT_MAX_TOKENS):
+        self.seg_id = seg_id
+        self.max_tokens = max_tokens
+        self.docs: list[ParsedDocument] = []
+
+    def add(self, doc: ParsedDocument) -> int:
+        """→ local doc number."""
+        self.docs.append(doc)
+        return len(self.docs) - 1
+
+    def __len__(self) -> int:
+        return len(self.docs)
+
+    @property
+    def num_docs(self) -> int:
+        return len(self.docs)
+
+    def build(self) -> Segment:
+        n = len(self.docs)
+        np_docs = doc_count_bucket(max(n, 1))
+        field_kinds: dict[str, str] = {}
+        for d in self.docs:
+            for fname, pf in d.fields.items():
+                field_kinds.setdefault(fname, pf.kind)
+
+        text_fields: dict[str, TextFieldColumn] = {}
+        keyword_fields: dict[str, KeywordFieldColumn] = {}
+        numeric_fields: dict[str, NumericFieldColumn] = {}
+        vector_fields: dict[str, VectorFieldColumn] = {}
+        geo_fields: dict[str, GeoFieldColumn] = {}
+
+        for fname, kind in field_kinds.items():
+            if kind == KIND_TEXT:
+                text_fields[fname] = self._build_text(fname, n, np_docs)
+            elif kind == KIND_KEYWORD:
+                keyword_fields[fname] = self._build_keyword(fname, n, np_docs)
+            elif kind == KIND_NUMERIC:
+                numeric_fields[fname] = self._build_numeric(fname, n, np_docs)
+            elif kind == KIND_VECTOR:
+                vector_fields[fname] = self._build_vector(fname, n, np_docs)
+            elif kind == KIND_GEO:
+                geo_fields[fname] = self._build_geo(fname, n, np_docs)
+
+        return Segment(
+            seg_id=self.seg_id, num_docs=n, padded_docs=np_docs,
+            ids=[d.doc_id for d in self.docs],
+            sources=[d.source for d in self.docs],
+            text_fields=text_fields, keyword_fields=keyword_fields,
+            numeric_fields=numeric_fields, vector_fields=vector_fields,
+            geo_fields=geo_fields)
+
+    # ---- per-kind builders ------------------------------------------------
+
+    def _field(self, doc: ParsedDocument, fname: str):
+        return doc.fields.get(fname)
+
+    def _build_text(self, fname: str, n: int, np_docs: int) -> TextFieldColumn:
+        # First pass: vocabulary over the segment.
+        vocab: dict[str, int] = {}
+        doc_tokens: list[list[tuple[int, int]]] = []  # per doc: (tid, position)
+        max_len = 0
+        max_unique = 0
+        total_tokens = 0
+        for d in self.docs:
+            pf = self._field(d, fname)
+            toks = pf.tokens[: self.max_tokens] if pf else []
+            pairs = []
+            for t in toks:
+                tid = vocab.setdefault(t.term, len(vocab))
+                pairs.append((tid, t.position))
+            doc_tokens.append(pairs)
+            max_len = max(max_len, len(pairs))
+            max_unique = max(max_unique, len({tid for tid, _ in pairs}))
+            total_tokens += len(pairs)
+
+        terms = sorted(vocab)  # sorted dictionary; remap ids to sorted order
+        remap = np.empty(max(len(vocab), 1), dtype=np.int32)
+        for new_id, term in enumerate(terms):
+            remap[vocab[term]] = new_id
+
+        L = pad_to(max(max_len, 1), _ROW_PAD)
+        U = pad_to(max(max_unique, 1), _ROW_PAD)
+        tokens = np.full((np_docs, L), -1, dtype=np.int32)
+        positions = np.full((np_docs, L), -1, dtype=np.int32)
+        uterms = np.full((np_docs, U), -1, dtype=np.int32)
+        utf = np.zeros((np_docs, U), dtype=np.float32)
+        doc_len = np.zeros(np_docs, dtype=np.int32)
+        df = np.zeros(max(len(vocab), 1), dtype=np.int32)
+
+        for i, pairs in enumerate(doc_tokens):
+            counts: dict[int, int] = {}
+            for j, (tid, pos) in enumerate(pairs):
+                tid = int(remap[tid])
+                tokens[i, j] = tid
+                positions[i, j] = pos
+                counts[tid] = counts.get(tid, 0) + 1
+            for u, (tid, tf) in enumerate(sorted(counts.items())):
+                uterms[i, u] = tid
+                utf[i, u] = tf
+                df[tid] += 1
+            doc_len[i] = len(pairs)
+
+        return TextFieldColumn(terms=terms, tokens=tokens, positions=positions,
+                               uterms=uterms, utf=utf, doc_len=doc_len, df=df,
+                               total_tokens=total_tokens)
+
+    def _build_keyword(self, fname: str, n: int, np_docs: int) -> KeywordFieldColumn:
+        values: set[str] = set()
+        per_doc: list[list[str]] = []
+        kmax = 1
+        for d in self.docs:
+            pf = self._field(d, fname)
+            kws = pf.keywords if pf else []
+            per_doc.append(kws)
+            values.update(kws)
+            kmax = max(kmax, len(kws))
+        vocab = sorted(values)
+        index = {v: i for i, v in enumerate(vocab)}
+        ords = np.full((np_docs, kmax), -1, dtype=np.int32)
+        for i, kws in enumerate(per_doc):
+            for j, v in enumerate(kws):
+                ords[i, j] = index[v]
+        return KeywordFieldColumn(vocab=vocab, ords=ords, index=index)
+
+    def _build_numeric(self, fname: str, n: int, np_docs: int) -> NumericFieldColumn:
+        values = np.zeros(np_docs, dtype=np.float64)
+        exists = np.zeros(np_docs, dtype=bool)
+        for i, d in enumerate(self.docs):
+            pf = self._field(d, fname)
+            if pf and pf.numerics:
+                values[i] = pf.numerics[0]
+                exists[i] = True
+        return NumericFieldColumn(values=values, exists=exists)
+
+    def _build_vector(self, fname: str, n: int, np_docs: int) -> VectorFieldColumn:
+        dims = 0
+        for d in self.docs:
+            pf = self._field(d, fname)
+            if pf is not None and pf.vector is not None:
+                dims = int(pf.vector.shape[0])
+                break
+        vecs = np.zeros((np_docs, max(dims, 1)), dtype=np.float32)
+        exists = np.zeros(np_docs, dtype=bool)
+        for i, d in enumerate(self.docs):
+            pf = self._field(d, fname)
+            if pf is not None and pf.vector is not None:
+                vecs[i] = pf.vector
+                exists[i] = True
+        return VectorFieldColumn(vecs=vecs, exists=exists, dims=dims)
+
+    def _build_geo(self, fname: str, n: int, np_docs: int) -> GeoFieldColumn:
+        lat = np.zeros(np_docs, dtype=np.float64)
+        lon = np.zeros(np_docs, dtype=np.float64)
+        exists = np.zeros(np_docs, dtype=bool)
+        for i, d in enumerate(self.docs):
+            pf = self._field(d, fname)
+            if pf is not None and pf.geo is not None:
+                lat[i], lon[i] = pf.geo
+                exists[i] = True
+        return GeoFieldColumn(lat=lat, lon=lon, exists=exists)
+
+
+def merge_segments(seg_id: int, segments: Iterable[Segment],
+                   live_masks: Iterable[np.ndarray] | None = None,
+                   mapper=None) -> "SegmentBuilder":
+    """Background-merge equivalent (ElasticsearchConcurrentMergeScheduler):
+    re-parse surviving docs into a fresh builder. Requires the mapper to
+    re-analyze; engine calls this with its DocumentMapper."""
+    builder = SegmentBuilder(seg_id)
+    masks = list(live_masks) if live_masks is not None else None
+    for si, seg in enumerate(segments):
+        for local in range(seg.num_docs):
+            if masks is not None and not masks[si][local]:
+                continue
+            doc = mapper.parse(seg.ids[local], seg.sources[local])
+            builder.add(doc)
+    return builder

@@ -27,6 +27,7 @@ import numpy as np
 
 from elasticsearch_tpu.analysis import AnalysisRegistry, Token
 from elasticsearch_tpu.common.errors import MapperParsingError, IllegalArgumentError
+from elasticsearch_tpu.common.settings import parse_bool
 
 # Field kinds the segment builder understands.
 KIND_TEXT = "text"
@@ -131,7 +132,16 @@ class FieldMapper:
 
     def parse_value(self, value: Any) -> ParsedField:
         pf = ParsedField(self.name, self.kind)
-        values = value if isinstance(value, list) and self.kind != KIND_VECTOR else [value]
+        if self.kind == KIND_VECTOR:
+            values = [value]
+        elif self.kind == KIND_GEO and isinstance(value, (list, tuple)) \
+                and len(value) == 2 and all(isinstance(x, numbers.Number)
+                                            for x in value):
+            values = [value]  # flat GeoJSON pair [lon, lat], not a multi-value
+        elif isinstance(value, list):
+            values = value
+        else:
+            values = [value]
         if self.kind == KIND_TEXT:
             position = 0
             for v in values:
@@ -153,9 +163,12 @@ class FieldMapper:
                 if self.type == "date":
                     pf.numerics.append(parse_date(v))
                 elif self.type == "boolean":
-                    if isinstance(v, str):
-                        v = v.lower() in ("true", "1", "on", "yes")
-                    pf.numerics.append(1.0 if v else 0.0)
+                    try:
+                        pf.numerics.append(1.0 if parse_bool(v, self.name) else 0.0)
+                    except IllegalArgumentError:
+                        raise MapperParsingError(
+                            f"failed to parse [{self.name}] value [{v}] as boolean"
+                        ) from None
                 else:
                     try:
                         pf.numerics.append(float(v))
@@ -310,16 +323,25 @@ class MapperService:
             dm = DocumentMapper(type_name, mapping_def, self.analysis)
             self.mappers[type_name] = dm
             return dm
-        # merge: new fields added; conflicting type changes rejected
-        for name, fdef in mapping_def.get("properties", {}).items():
-            old = existing.mappers.get(name)
-            new = FieldMapper(name, fdef.get("type", "text"), fdef, self.analysis)
+        # merge: new fields added; conflicting type changes rejected;
+        # object fields (properties w/o type) recurse like DocumentMapper._build
+        self._merge_properties(existing, mapping_def.get("properties", {}), "")
+        return existing
+
+    def _merge_properties(self, existing: DocumentMapper,
+                          properties: Mapping[str, Any], prefix: str) -> None:
+        for name, fdef in properties.items():
+            full = f"{prefix}{name}"
+            if "properties" in fdef and "type" not in fdef:   # object field
+                self._merge_properties(existing, fdef["properties"], f"{full}.")
+                continue
+            old = existing.mappers.get(full)
+            new = FieldMapper(full, fdef.get("type", "text"), fdef, self.analysis)
             if old is not None and old.type != new.type:
                 raise IllegalArgumentError(
-                    f"mapper [{name}] cannot be changed from type "
+                    f"mapper [{full}] cannot be changed from type "
                     f"[{old.type}] to [{new.type}]")
             existing.add_mapper(new)
-        return existing
 
     def document_mapper(self, type_name: str | None = None) -> DocumentMapper:
         tname = type_name or self.DEFAULT_TYPE

@@ -1,0 +1,149 @@
+"""Engine tests: versioned CRUD, realtime get, refresh/flush, recovery, merge."""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    DocumentMissingError, VersionConflictError)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.mapping import MapperService
+
+
+@pytest.fixture
+def engine(tmp_path):
+    svc = MapperService()
+    svc.merge("_doc", {"properties": {"body": {"type": "text"},
+                                      "n": {"type": "long"}}})
+    e = Engine(tmp_path / "shard0", svc)
+    yield e
+    e.close()
+
+
+def reopen(engine, tmp_path):
+    engine.close()
+    return Engine(tmp_path / "shard0", engine.mapper_service)
+
+
+class TestCrud:
+    def test_index_and_get_realtime(self, engine):
+        v, created = engine.index("1", {"body": "hello"})
+        assert v == 1 and created
+        # realtime get without refresh
+        r = engine.get("1")
+        assert r.found and r.source == {"body": "hello"} and r.version == 1
+
+    def test_update_increments_version(self, engine):
+        engine.index("1", {"body": "a"})
+        v, created = engine.index("1", {"body": "b"})
+        assert v == 2 and not created
+        assert engine.get("1").source == {"body": "b"}
+
+    def test_version_conflict(self, engine):
+        engine.index("1", {"body": "a"})
+        with pytest.raises(VersionConflictError):
+            engine.index("1", {"body": "b"}, version=99)
+        # correct version works
+        v, _ = engine.index("1", {"body": "b"}, version=1)
+        assert v == 2
+
+    def test_create_op_type(self, engine):
+        engine.index("1", {"body": "a"}, op_type="create")
+        with pytest.raises(VersionConflictError):
+            engine.index("1", {"body": "b"}, op_type="create")
+
+    def test_delete(self, engine):
+        engine.index("1", {"body": "a"})
+        engine.delete("1")
+        assert not engine.get("1").found
+        with pytest.raises(DocumentMissingError):
+            engine.delete("1")
+
+    def test_num_docs(self, engine):
+        engine.index("1", {"body": "a"})
+        engine.index("2", {"body": "b"})
+        engine.delete("1")
+        assert engine.num_docs == 1
+
+
+class TestRefresh:
+    def test_refresh_builds_segment(self, engine):
+        engine.index("1", {"body": "hello world"})
+        engine.index("2", {"body": "goodbye"})
+        view = engine.refresh()
+        assert len(view.segments) == 1
+        assert view.num_docs == 2
+        assert view.segments[0].ids == ["1", "2"]
+
+    def test_update_masks_old_copy(self, engine):
+        engine.index("1", {"body": "old"})
+        engine.refresh()
+        engine.index("1", {"body": "new"})
+        view = engine.refresh()
+        # two segments: old copy dead, new copy live
+        assert view.num_docs == 1
+        assert not view.live_masks[0][0]
+        assert view.segments[1].sources[0] == {"body": "new"}
+
+    def test_delete_visible_after_refresh(self, engine):
+        engine.index("1", {"body": "x"})
+        engine.refresh()
+        engine.delete("1")
+        view = engine.refresh()
+        assert view.num_docs == 0
+
+    def test_empty_refresh_noop_segments(self, engine):
+        engine.index("1", {"body": "x"})
+        engine.refresh()
+        view = engine.refresh()
+        assert len(view.segments) == 1
+
+
+class TestDurability:
+    def test_recovery_from_translog(self, engine, tmp_path):
+        engine.index("1", {"body": "persisted"})
+        engine.index("2", {"body": "also"})
+        engine.delete("2")
+        e2 = reopen(engine, tmp_path)
+        assert e2.get("1").found
+        assert e2.get("1").source == {"body": "persisted"}
+        assert not e2.get("2").found
+        assert e2.num_docs == 1
+        e2.close()
+
+    def test_flush_and_recover_from_commit(self, engine, tmp_path):
+        engine.index("1", {"body": "committed"})
+        engine.flush()
+        engine.index("2", {"body": "in translog"})
+        e2 = reopen(engine, tmp_path)
+        assert e2.get("1").found and e2.get("2").found
+        view = e2.refresh()
+        assert view.num_docs == 2
+        # version preserved across restart
+        assert e2.get("1").version == 1
+        e2.close()
+
+    def test_update_of_committed_doc_after_restart(self, engine, tmp_path):
+        engine.index("1", {"body": "v1"})
+        engine.flush()
+        engine.index("1", {"body": "v2"})
+        e2 = reopen(engine, tmp_path)
+        assert e2.get("1").source == {"body": "v2"}
+        assert e2.get("1").version == 2
+        view = e2.refresh()
+        assert view.num_docs == 1
+        e2.close()
+
+
+class TestMerge:
+    def test_force_merge_drops_deletes(self, engine):
+        for i in range(5):
+            engine.index(str(i), {"body": f"doc {i}"})
+            engine.refresh()
+        engine.delete("0")
+        engine.delete("1")
+        engine.force_merge(max_num_segments=1)
+        view = engine.acquire_searcher()
+        assert len(view.segments) == 1
+        assert view.num_docs == 3
+        assert view.segments[0].num_docs == 3  # deletes physically gone
+        assert engine.get("2").found

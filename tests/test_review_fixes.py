@@ -1,0 +1,109 @@
+"""Regression tests for code-review findings (durability, mapping merge,
+geo parsing, analysis registry reachability)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+from elasticsearch_tpu.common.errors import MapperParsingError
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.translog import Translog, TranslogOp, OP_INDEX
+from elasticsearch_tpu.mapping import MapperService
+
+
+def make_engine(path):
+    svc = MapperService()
+    svc.merge("_doc", {"properties": {"body": {"type": "text"}}})
+    return Engine(path / "shard0", svc), svc
+
+
+def test_force_merge_survives_crash_after_commit(tmp_path):
+    """force_merge must write a new commit point before deleting old segment
+    dirs — a restart right after merge must recover every doc."""
+    e, svc = make_engine(tmp_path)
+    for i in range(3):
+        e.index(str(i), {"body": f"doc {i}"})
+        e.refresh()
+    e.flush()
+    e.force_merge(1)
+    # simulate crash: reopen without close/flush
+    e2 = Engine(tmp_path / "shard0", svc)
+    assert e2.num_docs == 3
+    assert e2.get("0").found and e2.get("2").found
+    view = e2.acquire_searcher()
+    assert view.num_docs == 3
+    e2.close()
+
+
+def test_translog_truncates_torn_tail_before_append(tmp_path):
+    """Acked ops appended after a torn tail frame must survive the next
+    replay (the torn frame is truncated away at open)."""
+    tl = Translog(tmp_path)
+    tl.add(TranslogOp(OP_INDEX, "1", 1, source={}))
+    tl.close()
+    f = tmp_path / "translog-1.tlog"
+    f.write_bytes(f.read_bytes() + b"\x55\x66")  # torn partial frame
+    tl2 = Translog(tmp_path)
+    tl2.add(TranslogOp(OP_INDEX, "2", 1, source={}))  # acked after torn tail
+    tl2.close()
+    tl3 = Translog(tmp_path)
+    assert [o.doc_id for o in tl3.uncommitted_ops()] == ["1", "2"]
+    tl3.close()
+
+
+def test_deletes_visible_after_crash_recovery(tmp_path):
+    """Recovery ends with a refresh: a replayed delete of a committed doc is
+    not searchable on the first reader after reopen."""
+    e, svc = make_engine(tmp_path)
+    e.index("1", {"body": "x"})
+    e.flush()
+    e.delete("1")
+    e2 = Engine(tmp_path / "shard0", svc)  # no explicit refresh
+    assert e2.acquire_searcher().num_docs == 0
+    e2.close()
+
+
+def test_mapping_merge_recurses_objects():
+    svc = MapperService()
+    svc.merge("_doc", {"properties": {"a": {"type": "long"}}})
+    svc.merge("_doc", {"properties": {
+        "user": {"properties": {"name": {"type": "keyword"}}}}})
+    dm = svc.document_mapper()
+    assert dm.mappers["user.name"].type == "keyword"
+    assert "user" not in dm.mappers
+    doc = dm.parse("1", {"user": {"name": "alice"}})
+    assert doc.fields["user.name"].keywords == ["alice"]
+
+
+def test_geo_point_flat_pair():
+    svc = MapperService()
+    svc.merge("_doc", {"properties": {"loc": {"type": "geo_point"}}})
+    doc = svc.document_mapper().parse("1", {"loc": [13.38, 52.52]})
+    assert doc.fields["loc"].geo == (52.52, 13.38)  # (lat, lon) from [lon, lat]
+
+
+def test_boolean_rejects_garbage():
+    svc = MapperService()
+    svc.merge("_doc", {"properties": {"ok": {"type": "boolean"}}})
+    with pytest.raises(MapperParsingError):
+        svc.document_mapper().parse("1", {"ok": "maybe"})
+
+
+def test_ngram_shingle_length_reachable():
+    reg = AnalysisRegistry(Settings({
+        "analysis": {
+            "tokenizer": {"grams": {"type": "ngram", "min_gram": 2,
+                                    "max_gram": 3}},
+            "filter": {"shorty": {"type": "length", "min": 2, "max": 4}},
+            "analyzer": {
+                "ng": {"type": "custom", "tokenizer": "grams"},
+                "sh": {"type": "custom", "tokenizer": "whitespace",
+                       "filter": ["shingle"]},
+                "ln": {"type": "custom", "tokenizer": "whitespace",
+                       "filter": ["shorty"]},
+            },
+        }}))
+    assert "ab" in reg.get("ng").terms("abc")
+    assert "quick fox" in reg.get("sh").terms("quick fox")
+    assert reg.get("ln").terms("a quick extravagant fox") == ["fox"]

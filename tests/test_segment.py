@@ -1,0 +1,105 @@
+"""Columnar segment build + persistence tests."""
+
+import numpy as np
+
+from elasticsearch_tpu.index.segment import (
+    SegmentBuilder, Segment, doc_count_bucket)
+from elasticsearch_tpu.mapping import MapperService
+
+
+def build_docs(docs):
+    svc = MapperService()
+    svc.merge("_doc", {"properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "n": {"type": "long"},
+        "v": {"type": "dense_vector", "dims": 2},
+    }})
+    b = SegmentBuilder(seg_id=1)
+    for i, d in enumerate(docs):
+        b.add(svc.document_mapper().parse(str(i), d))
+    return b.build()
+
+
+class TestBucketing:
+    def test_geometric(self):
+        assert doc_count_bucket(1) == 128
+        assert doc_count_bucket(128) == 128
+        assert doc_count_bucket(129) == 256
+        assert doc_count_bucket(1000) == 1024
+
+
+class TestTextColumns:
+    def test_token_and_unique_views(self):
+        seg = build_docs([
+            {"body": "quick brown fox fox"},
+            {"body": "lazy dog"},
+        ])
+        col = seg.text_fields["body"]
+        # vocabulary sorted
+        assert col.terms == sorted(col.terms)
+        tid = {t: i for i, t in enumerate(col.terms)}
+        # positional view
+        assert col.tokens[0, :4].tolist() == [
+            tid["quick"], tid["brown"], tid["fox"], tid["fox"]]
+        assert col.positions[0, :4].tolist() == [0, 1, 2, 3]
+        assert col.tokens[0, 4] == -1  # padding
+        # unique view: fox has tf=2
+        row0 = {int(t): float(f) for t, f in zip(col.uterms[0], col.utf[0])
+                if t >= 0}
+        assert row0[tid["fox"]] == 2.0
+        assert row0[tid["quick"]] == 1.0
+        # df counts docs, not occurrences
+        assert col.df[tid["fox"]] == 1
+        assert col.doc_len[0] == 4 and col.doc_len[1] == 2
+        assert col.total_tokens == 6
+        # padded rows empty
+        assert seg.padded_docs == 128
+        assert col.tokens[2:].max() == -1
+
+    def test_term_lookup(self):
+        seg = build_docs([{"body": "alpha beta"}])
+        col = seg.text_fields["body"]
+        assert col.tid("alpha") >= 0
+        assert col.tid("zzz") == -1
+
+
+class TestOtherColumns:
+    def test_keyword_ordinals_sorted(self):
+        seg = build_docs([{"tag": "zebra"}, {"tag": "apple"},
+                          {"tag": ["mango", "apple"]}])
+        col = seg.keyword_fields["tag"]
+        assert col.vocab == ["apple", "mango", "zebra"]
+        assert col.ords[0, 0] == 2 and col.ords[1, 0] == 0
+        assert sorted(col.ords[2][col.ords[2] >= 0].tolist()) == [0, 1]
+
+    def test_numeric_exists(self):
+        seg = build_docs([{"n": 5}, {"body": "no n here"}])
+        col = seg.numeric_fields["n"]
+        assert col.values[0] == 5.0
+        assert col.exists[0] and not col.exists[1]
+
+    def test_vector(self):
+        seg = build_docs([{"v": [1.0, 2.0]}])
+        col = seg.vector_fields["v"]
+        np.testing.assert_array_equal(col.vecs[0], [1.0, 2.0])
+        assert col.dims == 2
+
+
+class TestPersistence:
+    def test_roundtrip(self, tmp_path):
+        seg = build_docs([
+            {"body": "hello world", "tag": "a", "n": 1, "v": [0.5, 0.5]},
+            {"body": "goodbye world", "tag": "b", "n": 2, "v": [1.0, 0.0]},
+        ])
+        seg.write(tmp_path / "seg_1")
+        back = Segment.read(tmp_path / "seg_1")
+        assert back.num_docs == 2 and back.ids == ["0", "1"]
+        assert back.sources[0]["body"] == "hello world"
+        col, bcol = seg.text_fields["body"], back.text_fields["body"]
+        assert bcol.terms == col.terms
+        np.testing.assert_array_equal(bcol.tokens, col.tokens)
+        np.testing.assert_array_equal(bcol.utf, col.utf)
+        assert back.keyword_fields["tag"].vocab == ["a", "b"]
+        np.testing.assert_array_equal(back.vector_fields["v"].vecs,
+                                      seg.vector_fields["v"].vecs)
