@@ -1,0 +1,6 @@
+from elasticsearch_tpu.cluster.state import (
+    ClusterState, IndexMetadata, RoutingTable, ShardRouting, ShardRoutingState)
+from elasticsearch_tpu.cluster.routing import OperationRouting
+
+__all__ = ["ClusterState", "IndexMetadata", "RoutingTable", "ShardRouting",
+           "ShardRoutingState", "OperationRouting"]

@@ -1,0 +1,31 @@
+"""OperationRouting — the document partitioner.
+
+Reference: core/cluster/routing/OperationRouting.java:238-258 —
+``shard = MathUtils.mod(murmur3(routing_key), num_shards)`` with the routing
+key defaulting to the document id (Murmur3HashFunction). Deterministic
+forever: the hash is part of the on-disk contract.
+
+In the TPU mapping (SURVEY.md §2.10), the shard axis is a mesh axis: this
+same function decides which mesh-axis partition owns a document.
+"""
+
+from __future__ import annotations
+
+from elasticsearch_tpu.utils.hashing import murmur3_hash32
+
+
+class OperationRouting:
+    @staticmethod
+    def shard_id(doc_id: str, num_shards: int, routing: str | None = None) -> int:
+        key = routing if routing is not None else doc_id
+        h = murmur3_hash32(key)
+        return h % num_shards if h >= 0 else (h % num_shards + num_shards) % num_shards
+
+    @staticmethod
+    def search_shards(num_shards: int, preference: str | None = None,
+                      routing: str | None = None) -> list[int]:
+        """Which shards a search fans out to (one copy of every shard;
+        routing narrows to the owning shard — reference :67-71)."""
+        if routing is not None:
+            return [OperationRouting.shard_id(routing, num_shards)]
+        return list(range(num_shards))

@@ -1,0 +1,44 @@
+"""ClusterService — the single-writer state-update executor.
+
+Reference: core/cluster/service/InternalClusterService.java:60 — all cluster
+state mutations are serialized through one prioritized executor
+(`submitStateUpdateTask` :267-272); listeners observe each new immutable
+state. Round 1 runs it synchronously under a lock (single node); the
+publish seam is where multi-node diff replication attaches
+(PublishClusterStateAction analog).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from elasticsearch_tpu.cluster.state import ClusterState
+
+
+class ClusterService:
+    def __init__(self, initial: ClusterState):
+        self._state = initial
+        self._lock = threading.Lock()
+        self._listeners: list[Callable[[ClusterState, ClusterState], None]] = []
+
+    def state(self) -> ClusterState:
+        return self._state
+
+    def add_listener(self, fn: Callable[[ClusterState, ClusterState], None]):
+        self._listeners.append(fn)
+
+    def submit_state_update(self, source: str,
+                            update: Callable[[ClusterState], ClusterState]
+                            ) -> ClusterState:
+        """Apply an update task; notify listeners with (old, new)."""
+        with self._lock:
+            old = self._state
+            new = update(old)
+            if new is old:
+                return old
+            self._state = new
+        for fn in self._listeners:
+            fn(old, new)
+        return new

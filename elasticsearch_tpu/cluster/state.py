@@ -1,0 +1,178 @@
+"""Cluster state model — immutable snapshots, versioned and diffable.
+
+Reference: core/cluster/ClusterState.java:91,155-161 — {version, nodes,
+metaData (indices/mappings/settings/templates), routingTable, blocks} with
+incremental diff publish (Diffable, :746). Round 1 runs a single node, but
+the model is the multi-node one: every mutation goes through the
+single-writer ClusterService (service.py) producing a new versioned state,
+and the routing table tracks per-shard state machines
+(core/cluster/routing/ShardRoutingState.java:27-44).
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import json
+from dataclasses import dataclass, field, replace
+from pathlib import Path
+from typing import Any
+
+
+class ShardRoutingState(str, enum.Enum):
+    UNASSIGNED = "UNASSIGNED"
+    INITIALIZING = "INITIALIZING"
+    STARTED = "STARTED"
+    RELOCATING = "RELOCATING"
+
+
+@dataclass(frozen=True)
+class ShardRouting:
+    index: str
+    shard: int
+    node_id: str | None
+    primary: bool
+    state: ShardRoutingState
+
+    def started(self) -> "ShardRouting":
+        return replace(self, state=ShardRoutingState.STARTED)
+
+
+@dataclass(frozen=True)
+class IndexMetadata:
+    name: str
+    number_of_shards: int
+    number_of_replicas: int
+    settings: dict = field(default_factory=dict)
+    mappings: dict = field(default_factory=dict)
+    aliases: dict = field(default_factory=dict)
+    state: str = "open"                      # open | close
+    creation_date: int = 0
+    uuid: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "settings": {"index": {
+                "number_of_shards": str(self.number_of_shards),
+                "number_of_replicas": str(self.number_of_replicas),
+                "uuid": self.uuid,
+                "creation_date": str(self.creation_date),
+                **{k: v for k, v in self.settings.items()
+                   if not k.startswith("index.")},
+            }},
+            "mappings": self.mappings,
+            "aliases": self.aliases,
+        }
+
+
+@dataclass(frozen=True)
+class RoutingTable:
+    shards: tuple[ShardRouting, ...] = ()
+
+    def index_shards(self, index: str) -> list[ShardRouting]:
+        return [s for s in self.shards if s.index == index]
+
+    def add_index(self, meta: IndexMetadata, node_id: str) -> "RoutingTable":
+        new = list(self.shards)
+        for sid in range(meta.number_of_shards):
+            new.append(ShardRouting(meta.name, sid, node_id, True,
+                                    ShardRoutingState.STARTED))
+            for _ in range(meta.number_of_replicas):
+                new.append(ShardRouting(meta.name, sid, None, False,
+                                        ShardRoutingState.UNASSIGNED))
+        return RoutingTable(tuple(new))
+
+    def remove_index(self, index: str) -> "RoutingTable":
+        return RoutingTable(tuple(s for s in self.shards if s.index != index))
+
+
+@dataclass(frozen=True)
+class ClusterState:
+    cluster_name: str = "elasticsearch-tpu"
+    version: int = 0
+    master_node_id: str | None = None
+    nodes: dict = field(default_factory=dict)       # node_id → {name, ...}
+    indices: dict = field(default_factory=dict)     # name → IndexMetadata
+    routing_table: RoutingTable = field(default_factory=RoutingTable)
+    templates: dict = field(default_factory=dict)
+    blocks: frozenset = frozenset()
+
+    def with_(self, **kw) -> "ClusterState":
+        kw.setdefault("version", self.version + 1)
+        return replace(self, **kw)
+
+    def health(self) -> dict:
+        counts = {s: 0 for s in ShardRoutingState}
+        for sh in self.routing_table.shards:
+            counts[sh.state] += 1
+        unassigned = counts[ShardRoutingState.UNASSIGNED]
+        primaries_ok = all(
+            s.state == ShardRoutingState.STARTED
+            for s in self.routing_table.shards if s.primary)
+        if not primaries_ok:
+            status = "red"
+        elif unassigned > 0:
+            status = "yellow"
+        else:
+            status = "green"
+        active = counts[ShardRoutingState.STARTED]
+        total = len(self.routing_table.shards)
+        return {
+            "cluster_name": self.cluster_name,
+            "status": status,
+            "timed_out": False,
+            "number_of_nodes": len(self.nodes),
+            "number_of_data_nodes": len(self.nodes),
+            "active_primary_shards": sum(
+                1 for s in self.routing_table.shards
+                if s.primary and s.state == ShardRoutingState.STARTED),
+            "active_shards": active,
+            "relocating_shards": counts[ShardRoutingState.RELOCATING],
+            "initializing_shards": counts[ShardRoutingState.INITIALIZING],
+            "unassigned_shards": unassigned,
+            "active_shards_percent_as_number":
+                100.0 * active / total if total else 100.0,
+        }
+
+    # ---- persistence (gateway analog: MetaDataStateFormat) -----------------
+
+    def persist(self, path: Path) -> None:
+        state = {
+            "version": self.version,
+            "cluster_name": self.cluster_name,
+            "indices": {
+                name: {"number_of_shards": m.number_of_shards,
+                       "number_of_replicas": m.number_of_replicas,
+                       "settings": m.settings, "mappings": m.mappings,
+                       "aliases": m.aliases, "state": m.state,
+                       "creation_date": m.creation_date, "uuid": m.uuid}
+                for name, m in self.indices.items()},
+            "templates": self.templates,
+        }
+        path.mkdir(parents=True, exist_ok=True)
+        tmp = path / "global-state.json.tmp"
+        tmp.write_text(json.dumps(state))
+        tmp.replace(path / "global-state.json")
+
+    @staticmethod
+    def load(path: Path, node_id: str) -> "ClusterState":
+        f = path / "global-state.json"
+        if not f.exists():
+            return ClusterState()
+        raw = json.loads(f.read_text())
+        indices = {}
+        routing = RoutingTable()
+        for name, m in raw.get("indices", {}).items():
+            meta = IndexMetadata(
+                name=name, number_of_shards=m["number_of_shards"],
+                number_of_replicas=m["number_of_replicas"],
+                settings=m.get("settings", {}), mappings=m.get("mappings", {}),
+                aliases=m.get("aliases", {}), state=m.get("state", "open"),
+                creation_date=m.get("creation_date", 0), uuid=m.get("uuid", ""))
+            indices[name] = meta
+            routing = routing.add_index(meta, node_id)
+        return ClusterState(version=raw.get("version", 0),
+                            cluster_name=raw.get("cluster_name",
+                                                 "elasticsearch-tpu"),
+                            indices=indices, routing_table=routing,
+                            templates=raw.get("templates", {}))

@@ -1,0 +1,211 @@
+"""DeviceReader — an engine reader view packed into device (HBM) arrays.
+
+The analog of acquiring an NRT searcher (IndexShard.acquireSearcher,
+core/index/shard/IndexShard.java:707): an immutable point-in-time set of
+segments, resident on the accelerator. Columns are uploaded once per refresh
+generation and cached; queries then run entirely on-device until the final
+top-k docs come back for fetch.
+
+Also aggregates per-field corpus statistics across segments host-side
+(doc counts, Σ field length, per-term df on demand) — what Lucene exposes as
+CollectionStatistics/TermStatistics for query-time IDF.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.index.engine import SearcherView
+from elasticsearch_tpu.index.segment import Segment
+
+
+@dataclass
+class DeviceTextField:
+    tokens: Any      # [Np, L] i32 (position-indexed)
+    uterms: Any      # [Np, U] i32
+    utf: Any         # [Np, U] f32
+    doc_len: Any     # [Np] i32
+    column: Any      # host TextFieldColumn (term dict, df)
+
+
+@dataclass
+class DeviceKeywordField:
+    ords: Any        # [Np, K] i32
+    column: Any      # host KeywordFieldColumn (vocab)
+
+
+@dataclass
+class DeviceNumericField:
+    """Numeric doc values as a double-double split: ``hi = f32(v)``,
+    ``lo = f32(v - hi)``. TPUs have no fast f64, but lexicographic compare on
+    (hi, lo) reproduces exact f64 ordering — epoch-millis dates and large
+    longs filter exactly. ``hi`` alone feeds scoring/aggregations."""
+    hi: Any          # [Np] f32
+    lo: Any          # [Np] f32
+    exists: Any      # [Np] bool
+    column: Any
+
+
+def dd_split(v: np.ndarray | float) -> tuple[np.ndarray, np.ndarray]:
+    hi = np.float32(v)
+    with np.errstate(invalid="ignore"):
+        lo = np.float32(np.float64(v) - np.float64(hi))
+    # ±inf bounds: inf - inf = nan would poison comparisons; lo 0 keeps the
+    # (hi, lo) pair correctly ordered.
+    lo = np.where(np.isfinite(np.float64(v)), lo, np.float32(0.0)) \
+        if isinstance(v, np.ndarray) else \
+        (lo if np.isfinite(v) else np.float32(0.0))
+    return hi, lo
+
+
+@dataclass
+class DeviceVectorField:
+    vecs: Any        # [Np, D] f32, L2-normalized rows (cosine = dot)
+    exists: Any
+    column: Any
+
+
+@dataclass
+class DeviceGeoField:
+    lat: Any
+    lon: Any
+    exists: Any
+    column: Any
+
+
+@dataclass
+class DeviceSegment:
+    seg: Segment
+    live: Any                       # [Np] bool (padding & deletes False)
+    doc_base: int                   # global doc id of row 0 within the reader
+    text: dict[str, DeviceTextField]
+    keyword: dict[str, DeviceKeywordField]
+    numeric: dict[str, DeviceNumericField]
+    vector: dict[str, DeviceVectorField]
+    geo: dict[str, DeviceGeoField]
+
+    @property
+    def padded_docs(self) -> int:
+        return self.seg.padded_docs
+
+
+@dataclass
+class TextFieldStats:
+    doc_count: int          # docs in reader (incl. not-yet-merged deletes)
+    docs_with_field: int
+    total_tokens: int
+
+    @property
+    def avgdl(self) -> float:
+        return self.total_tokens / max(self.docs_with_field, 1)
+
+
+class DeviceReader:
+    def __init__(self, view: SearcherView, device=None):
+        self.generation = view.generation
+        self.segments: list[DeviceSegment] = []
+        self._text_stats: dict[str, TextFieldStats] = {}
+        doc_base = 0
+        put = (lambda x: jax.device_put(x, device)) if device is not None \
+            else jax.device_put
+        for seg, live in zip(view.segments, view.live_masks):
+            self.segments.append(self._pack_segment(seg, live, doc_base, put))
+            doc_base += seg.padded_docs
+        self.max_doc = doc_base
+        self._collect_stats(view)
+
+    # ---- packing ----------------------------------------------------------
+
+    def _pack_segment(self, seg: Segment, live: np.ndarray, doc_base: int,
+                      put) -> DeviceSegment:
+        text = {}
+        for name, c in seg.text_fields.items():
+            text[name] = DeviceTextField(
+                tokens=put(c.tokens), uterms=put(c.uterms),
+                utf=put(c.utf), doc_len=put(c.doc_len), column=c)
+        keyword = {name: DeviceKeywordField(ords=put(c.ords), column=c)
+                   for name, c in seg.keyword_fields.items()}
+        numeric = {}
+        for name, c in seg.numeric_fields.items():
+            hi, lo = dd_split(c.values)
+            numeric[name] = DeviceNumericField(
+                hi=put(hi), lo=put(lo), exists=put(c.exists), column=c)
+        vector = {}
+        for name, c in seg.vector_fields.items():
+            norms = np.linalg.norm(c.vecs, axis=1, keepdims=True)
+            normed = c.vecs / np.maximum(norms, 1e-12)
+            vector[name] = DeviceVectorField(vecs=put(normed.astype(np.float32)),
+                                             exists=put(c.exists), column=c)
+        geo = {name: DeviceGeoField(lat=put(c.lat.astype(np.float32)),
+                                    lon=put(c.lon.astype(np.float32)),
+                                    exists=put(c.exists), column=c)
+               for name, c in seg.geo_fields.items()}
+        return DeviceSegment(seg=seg, live=put(live), doc_base=doc_base,
+                             text=text, keyword=keyword, numeric=numeric,
+                             vector=vector, geo=geo)
+
+    def _collect_stats(self, view: SearcherView) -> None:
+        for seg in view.segments:
+            for name, c in seg.text_fields.items():
+                st = self._text_stats.setdefault(
+                    name, TextFieldStats(0, 0, 0))
+                st.doc_count += seg.num_docs
+                st.docs_with_field += int((c.doc_len[:seg.num_docs] > 0).sum())
+                st.total_tokens += c.total_tokens
+
+    # ---- stats (CollectionStatistics / TermStatistics analog) -------------
+
+    @property
+    def num_docs(self) -> int:
+        return sum(s.seg.num_docs for s in self.segments)
+
+    def text_stats(self, field: str) -> TextFieldStats:
+        return self._text_stats.get(field, TextFieldStats(self.num_docs, 0, 0))
+
+    def df(self, field: str, term: str) -> int:
+        """Doc frequency aggregated across this reader's segments."""
+        total = 0
+        for s in self.segments:
+            col = s.seg.text_fields.get(field)
+            if col is not None:
+                tid = col.tid(term)
+                if tid >= 0:
+                    total += int(col.df[tid])
+        return total
+
+    # ---- doc id resolution -------------------------------------------------
+
+    def resolve(self, global_doc: int) -> tuple[DeviceSegment, int]:
+        """global doc id → (device segment, local row)."""
+        for s in self.segments:
+            if s.doc_base <= global_doc < s.doc_base + s.padded_docs:
+                return s, global_doc - s.doc_base
+        raise IndexError(f"doc {global_doc} out of range")
+
+    def doc_id(self, global_doc: int) -> str:
+        s, local = self.resolve(global_doc)
+        return s.seg.ids[local]
+
+    def source(self, global_doc: int) -> dict:
+        s, local = self.resolve(global_doc)
+        return s.seg.sources[local]
+
+
+def device_reader_for(engine, view: SearcherView | None = None,
+                      device=None) -> DeviceReader:
+    """Reader cache per refresh generation — columns upload to HBM once per
+    refresh, like Lucene's per-commit reader reuse. The cache lives ON the
+    engine object so its device arrays are released with the engine (no
+    global registry to leak HBM across index delete/create churn)."""
+    if view is None:
+        view = engine.acquire_searcher()
+    cached = getattr(engine, "_device_reader_cache", None)
+    if cached is None or cached.generation != view.generation:
+        cached = DeviceReader(view, device=device)
+        engine._device_reader_cache = cached
+    return cached

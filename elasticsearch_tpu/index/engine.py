@@ -296,7 +296,8 @@ class Engine:
                 return
             builder = merge_segments(self._next_seg_id, self._segments,
                                      self._live_masks,
-                                     self.mapper_service.document_mapper())
+                                     self.mapper_service.document_mapper(),
+                                     max_tokens=self._buffer.max_tokens)
             merged = builder.build()
             mask = np.zeros(merged.padded_docs, dtype=bool)
             mask[:merged.num_docs] = True

@@ -9,9 +9,11 @@ vectorized scoring (SURVEY.md §7 step 2, BM25S-style eager scoring,
 PAPERS.md):
 
 Per analyzed text field:
-  * ``tokens[N, L]`` int32 — term ids in position order (-1 pad). With
-    ``positions[N, L]`` this is the positional index: phrase matching is a
-    shifted dense compare, replacing Lucene's position postings.
+  * ``tokens[N, L]`` int32 — **position-indexed**: slot ``p`` holds the term
+    id at token position ``p`` (-1 for holes left by stopword removal, array
+    gaps, and padding). Phrase matching with position gaps becomes a pure
+    shifted dense compare (ops/phrase.py), replacing Lucene's position
+    postings.
   * ``uterms[N, U]`` int32 / ``utf[N, U]`` float32 — unique terms per doc and
     their term frequencies: the *forward impact index*. BM25 scoring reads
     these as dense vector ops (no scatter); equivalent of the term-frequency
@@ -71,7 +73,6 @@ class TextFieldColumn:
     """Device-layout columns for one analyzed text field of one segment."""
     terms: list[str]                 # tid → term (sorted; per-segment dict)
     tokens: np.ndarray               # [Np, L] int32, -1 pad (positional view)
-    positions: np.ndarray            # [Np, L] int32
     uterms: np.ndarray               # [Np, U] int32, -1 pad (scoring view)
     utf: np.ndarray                  # [Np, U] float32
     doc_len: np.ndarray              # [Np] int32 (token count incl. truncation)
@@ -139,7 +140,7 @@ class Segment:
     def memory_bytes(self) -> int:
         total = 0
         for col in self.text_fields.values():
-            total += col.tokens.nbytes + col.positions.nbytes
+            total += col.tokens.nbytes
             total += col.uterms.nbytes + col.utf.nbytes + col.doc_len.nbytes
             total += col.df.nbytes
         for col in self.keyword_fields.values():
@@ -168,7 +169,7 @@ class Segment:
         for name, c in self.text_fields.items():
             meta["text_fields"][name] = {"terms": c.terms,
                                          "total_tokens": c.total_tokens}
-            for a in ("tokens", "positions", "uterms", "utf", "doc_len", "df"):
+            for a in ("tokens", "uterms", "utf", "doc_len", "df"):
                 arrays[f"t.{name}.{a}"] = getattr(c, a)
         for name, c in self.keyword_fields.items():
             meta["keyword_fields"][name] = {"vocab": c.vocab}
@@ -216,7 +217,6 @@ class Segment:
             name: TextFieldColumn(
                 terms=info["terms"], total_tokens=info["total_tokens"],
                 tokens=arrays[f"t.{name}.tokens"],
-                positions=arrays[f"t.{name}.positions"],
                 uterms=arrays[f"t.{name}.uterms"], utf=arrays[f"t.{name}.utf"],
                 doc_len=arrays[f"t.{name}.doc_len"], df=arrays[f"t.{name}.df"])
             for name, info in meta["text_fields"].items()}
@@ -310,21 +310,25 @@ class SegmentBuilder:
         return doc.fields.get(fname)
 
     def _build_text(self, fname: str, n: int, np_docs: int) -> TextFieldColumn:
-        # First pass: vocabulary over the segment.
+        # First pass: vocabulary over the segment. Token positions beyond
+        # max_tokens are truncated (position-indexed layout: slot == position).
         vocab: dict[str, int] = {}
         doc_tokens: list[list[tuple[int, int]]] = []  # per doc: (tid, position)
-        max_len = 0
+        max_pos = 0
         max_unique = 0
         total_tokens = 0
         for d in self.docs:
             pf = self._field(d, fname)
-            toks = pf.tokens[: self.max_tokens] if pf else []
             pairs = []
-            for t in toks:
-                tid = vocab.setdefault(t.term, len(vocab))
-                pairs.append((tid, t.position))
+            if pf is not None:
+                for t in pf.tokens:
+                    if t.position >= self.max_tokens:
+                        break
+                    tid = vocab.setdefault(t.term, len(vocab))
+                    pairs.append((tid, t.position))
             doc_tokens.append(pairs)
-            max_len = max(max_len, len(pairs))
+            if pairs:
+                max_pos = max(max_pos, pairs[-1][1] + 1)
             max_unique = max(max_unique, len({tid for tid, _ in pairs}))
             total_tokens += len(pairs)
 
@@ -333,10 +337,9 @@ class SegmentBuilder:
         for new_id, term in enumerate(terms):
             remap[vocab[term]] = new_id
 
-        L = pad_to(max(max_len, 1), _ROW_PAD)
+        L = pad_to(max(max_pos, 1), _ROW_PAD)
         U = pad_to(max(max_unique, 1), _ROW_PAD)
         tokens = np.full((np_docs, L), -1, dtype=np.int32)
-        positions = np.full((np_docs, L), -1, dtype=np.int32)
         uterms = np.full((np_docs, U), -1, dtype=np.int32)
         utf = np.zeros((np_docs, U), dtype=np.float32)
         doc_len = np.zeros(np_docs, dtype=np.int32)
@@ -344,10 +347,14 @@ class SegmentBuilder:
 
         for i, pairs in enumerate(doc_tokens):
             counts: dict[int, int] = {}
-            for j, (tid, pos) in enumerate(pairs):
+            for tid, pos in pairs:
                 tid = int(remap[tid])
-                tokens[i, j] = tid
-                positions[i, j] = pos
+                if tokens[i, pos] == -1:
+                    # slot == position; first token wins when an analyzer
+                    # emits several terms at one position (shingles/synonyms)
+                    # — those extra terms still score via uterms/utf, they
+                    # just don't participate in positional (phrase) matching
+                    tokens[i, pos] = tid
                 counts[tid] = counts.get(tid, 0) + 1
             for u, (tid, tf) in enumerate(sorted(counts.items())):
                 uterms[i, u] = tid
@@ -355,7 +362,7 @@ class SegmentBuilder:
                 df[tid] += 1
             doc_len[i] = len(pairs)
 
-        return TextFieldColumn(terms=terms, tokens=tokens, positions=positions,
+        return TextFieldColumn(terms=terms, tokens=tokens,
                                uterms=uterms, utf=utf, doc_len=doc_len, df=df,
                                total_tokens=total_tokens)
 
@@ -417,11 +424,12 @@ class SegmentBuilder:
 
 def merge_segments(seg_id: int, segments: Iterable[Segment],
                    live_masks: Iterable[np.ndarray] | None = None,
-                   mapper=None) -> "SegmentBuilder":
+                   mapper=None,
+                   max_tokens: int = DEFAULT_MAX_TOKENS) -> "SegmentBuilder":
     """Background-merge equivalent (ElasticsearchConcurrentMergeScheduler):
     re-parse surviving docs into a fresh builder. Requires the mapper to
     re-analyze; engine calls this with its DocumentMapper."""
-    builder = SegmentBuilder(seg_id)
+    builder = SegmentBuilder(seg_id, max_tokens=max_tokens)
     masks = list(live_masks) if live_masks is not None else None
     for si, seg in enumerate(segments):
         for local in range(seg.num_docs):

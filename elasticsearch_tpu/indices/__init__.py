@@ -1,0 +1,3 @@
+from elasticsearch_tpu.indices.service import IndicesService, IndexService
+
+__all__ = ["IndicesService", "IndexService"]

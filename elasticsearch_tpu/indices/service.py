@@ -1,0 +1,273 @@
+"""IndicesService / IndexService — node-level index containers.
+
+Reference: core/indices/IndicesService.java creates a per-index injector and
+per-shard IndexShard instances; IndicesClusterStateService
+(core/indices/cluster/IndicesClusterStateService.java:71) reconciles the
+published cluster state against local shards. Here the reconciler listens on
+ClusterService and creates/removes IndexService objects, each owning one
+Engine per local shard.
+"""
+
+from __future__ import annotations
+
+import shutil
+import time
+import uuid
+from pathlib import Path
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.cluster.routing import OperationRouting
+from elasticsearch_tpu.cluster.state import ClusterState, IndexMetadata
+from elasticsearch_tpu.common.errors import (
+    IndexAlreadyExistsError, IndexNotFoundError, IllegalArgumentError)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.mapping import MapperService
+
+
+class IndexService:
+    """Per-index container: mapper service + one engine per local shard."""
+
+    def __init__(self, meta: IndexMetadata, path: Path):
+        self.name = meta.name
+        self.meta = meta
+        self.path = path
+        index_settings = Settings(meta.settings)
+        self.analysis = AnalysisRegistry(index_settings)
+        self.mapper_service = MapperService(self.analysis)
+        for type_name, mapping in (meta.mappings or {}).items():
+            self.mapper_service.merge(type_name, mapping)
+        self.shard_engines: list[Engine] = []
+        for sid in range(meta.number_of_shards):
+            self.shard_engines.append(
+                Engine(path / str(sid), self.mapper_service, index_settings))
+
+    def shard_for(self, doc_id: str, routing: str | None = None) -> Engine:
+        sid = OperationRouting.shard_id(doc_id, self.meta.number_of_shards,
+                                        routing)
+        return self.shard_engines[sid]
+
+    def refresh(self):
+        for e in self.shard_engines:
+            e.refresh()
+
+    def flush(self):
+        for e in self.shard_engines:
+            e.flush()
+
+    def force_merge(self, max_num_segments: int = 1):
+        for e in self.shard_engines:
+            e.force_merge(max_num_segments)
+
+    def num_docs(self) -> int:
+        return sum(e.num_docs for e in self.shard_engines)
+
+    def stats(self) -> dict:
+        agg = {"index_total": 0, "delete_total": 0, "refresh_total": 0,
+               "flush_total": 0, "merge_total": 0, "index_time_ms": 0.0}
+        segs = []
+        for e in self.shard_engines:
+            s = e.stats
+            agg["index_total"] += s.index_total
+            agg["delete_total"] += s.delete_total
+            agg["refresh_total"] += s.refresh_total
+            agg["flush_total"] += s.flush_total
+            agg["merge_total"] += s.merge_total
+            agg["index_time_ms"] += s.index_time_ms
+            segs.extend(e.segment_stats())
+        return {
+            "docs": {"count": self.num_docs()},
+            "indexing": {"index_total": agg["index_total"],
+                         "delete_total": agg["delete_total"],
+                         "index_time_in_millis": int(agg["index_time_ms"])},
+            "refresh": {"total": agg["refresh_total"]},
+            "flush": {"total": agg["flush_total"]},
+            "merges": {"total": agg["merge_total"]},
+            "segments": {"count": len(segs),
+                         "memory_in_bytes": sum(s["memory_bytes"] for s in segs)},
+        }
+
+    def close(self):
+        for e in self.shard_engines:
+            e.close()
+
+
+class IndicesService:
+    def __init__(self, data_path: Path, cluster_service, node_id: str):
+        self.data_path = Path(data_path)
+        self.cluster_service = cluster_service
+        self.node_id = node_id
+        self.indices: dict[str, IndexService] = {}
+        cluster_service.add_listener(self._cluster_changed)
+        # reconcile initial (recovered) state
+        self._cluster_changed(ClusterState(), cluster_service.state())
+
+    # ---- reconciler (IndicesClusterStateService.clusterChanged analog) ----
+
+    def _cluster_changed(self, old: ClusterState, new: ClusterState) -> None:
+        for name, meta in new.indices.items():
+            if name not in self.indices and meta.state == "open":
+                self.indices[name] = IndexService(
+                    meta, self.data_path / "indices" / name)
+            elif name in self.indices:
+                svc = self.indices[name]
+                if meta.state == "close":
+                    svc.close()
+                    del self.indices[name]
+                elif meta.mappings != svc.meta.mappings:
+                    for t, m in (meta.mappings or {}).items():
+                        svc.mapper_service.merge(t, m)
+                    svc.meta = meta
+                else:
+                    svc.meta = meta
+        for name in list(self.indices):
+            if name not in new.indices:
+                self.indices[name].close()
+                shutil.rmtree(self.data_path / "indices" / name,
+                              ignore_errors=True)
+                del self.indices[name]
+
+    # ---- metadata CRUD (MetaDataCreateIndexService analog) ----------------
+
+    def create_index(self, name: str, body: dict | None = None) -> IndexService:
+        body = body or {}
+        if not name or name.startswith(("_", "-")) or name != name.lower() \
+                or any(c in name for c in ' "\\/,|<>?*'):
+            raise IllegalArgumentError(f"invalid index name [{name}]")
+
+        def update(state: ClusterState) -> ClusterState:
+            if name in state.indices:
+                raise IndexAlreadyExistsError(name)
+            settings = dict(Settings(body.get("settings", {})))
+            mappings = dict(body.get("mappings", {}))
+            if mappings and "properties" in mappings:
+                mappings = {"_doc": mappings}   # typeless API compat
+            # apply matching templates (MetaDataCreateIndexService template merge)
+            for tname, tmpl in sorted(state.templates.items(),
+                                      key=lambda kv: kv[1].get("order", 0)):
+                import fnmatch as _fn
+                patterns = tmpl.get("index_patterns") or [tmpl.get("template", "")]
+                if any(_fn.fnmatch(name, p) for p in patterns if p):
+                    for k, v in Settings(tmpl.get("settings", {})).as_dict().items():
+                        settings.setdefault(k, v)
+                    tmap = tmpl.get("mappings", {})
+                    if tmap and "properties" in tmap:
+                        tmap = {"_doc": tmap}
+                    for t, m in tmap.items():
+                        base = mappings.setdefault(t, {"properties": {}})
+                        for fname, fdef in m.get("properties", {}).items():
+                            base.setdefault("properties", {}).setdefault(fname, fdef)
+            sett = Settings(settings)
+            meta = IndexMetadata(
+                name=name,
+                number_of_shards=sett.get_as_int("index.number_of_shards", 1),
+                number_of_replicas=sett.get_as_int("index.number_of_replicas", 0),
+                settings=settings, mappings=mappings,
+                aliases={a: (v or {}) for a, v in body.get("aliases", {}).items()},
+                creation_date=int(time.time() * 1000),
+                uuid=uuid.uuid4().hex[:22])
+            return state.with_(
+                indices={**state.indices, name: meta},
+                routing_table=state.routing_table.add_index(meta, self.node_id))
+
+        self.cluster_service.submit_state_update(f"create-index [{name}]", update)
+        return self.indices[name]
+
+    def delete_index(self, name: str) -> None:
+        def update(state: ClusterState) -> ClusterState:
+            names = self._resolve(state, name)
+            indices = dict(state.indices)
+            routing = state.routing_table
+            for n in names:
+                del indices[n]
+                routing = routing.remove_index(n)
+            return state.with_(indices=indices, routing_table=routing)
+        self.cluster_service.submit_state_update(f"delete-index [{name}]", update)
+
+    def put_mapping(self, name: str, type_name: str, mapping: dict) -> None:
+        def update(state: ClusterState) -> ClusterState:
+            if name not in state.indices:
+                raise IndexNotFoundError(name)
+            meta = state.indices[name]
+            # validate merge against a scratch mapper first (reference:
+            # dry-run merge before committing the mapping update)
+            self.indices[name].mapper_service.merge(type_name, mapping)
+            merged = self.indices[name].mapper_service.mapping_dict()[type_name]
+            new_meta = IndexMetadata(
+                **{**meta.__dict__,
+                   "mappings": {**meta.mappings, type_name: merged}})
+            return state.with_(indices={**state.indices, name: new_meta})
+        self.cluster_service.submit_state_update(f"put-mapping [{name}]", update)
+
+    def put_alias(self, index: str, alias: str, body: dict | None = None):
+        def update(state: ClusterState) -> ClusterState:
+            if index not in state.indices:
+                raise IndexNotFoundError(index)
+            meta = state.indices[index]
+            new_meta = IndexMetadata(
+                **{**meta.__dict__,
+                   "aliases": {**meta.aliases, alias: body or {}}})
+            return state.with_(indices={**state.indices, index: new_meta})
+        self.cluster_service.submit_state_update(f"put-alias [{alias}]", update)
+
+    def delete_alias(self, index: str, alias: str):
+        def update(state: ClusterState) -> ClusterState:
+            if index not in state.indices:
+                raise IndexNotFoundError(index)
+            meta = state.indices[index]
+            aliases = {k: v for k, v in meta.aliases.items() if k != alias}
+            new_meta = IndexMetadata(**{**meta.__dict__, "aliases": aliases})
+            return state.with_(indices={**state.indices, index: new_meta})
+        self.cluster_service.submit_state_update(f"delete-alias [{alias}]", update)
+
+    # ---- resolution -------------------------------------------------------
+
+    def _resolve(self, state: ClusterState, expr: str) -> list[str]:
+        """Index expression → concrete names (aliases + wildcards;
+        reference: IndexNameExpressionResolver)."""
+        import fnmatch as _fn
+        names: list[str] = []
+        for part in expr.split(","):
+            part = part.strip()
+            if part in ("_all", "*", ""):
+                names.extend(state.indices)
+                continue
+            if "*" in part:
+                matched = [n for n in state.indices if _fn.fnmatch(n, part)]
+                names.extend(matched)
+                continue
+            if part in state.indices:
+                names.append(part)
+                continue
+            via_alias = [n for n, m in state.indices.items()
+                         if part in m.aliases]
+            if via_alias:
+                names.extend(via_alias)
+                continue
+            raise IndexNotFoundError(part)
+        seen = set()
+        out = []
+        for n in names:
+            if n not in seen:
+                seen.add(n)
+                out.append(n)
+        return out
+
+    def resolve(self, expr: str) -> list[str]:
+        return self._resolve(self.cluster_service.state(), expr)
+
+    def index(self, name: str) -> IndexService:
+        names = self.resolve(name)
+        if not names:
+            raise IndexNotFoundError(name)
+        return self.indices[names[0]]
+
+    def has_index(self, name: str) -> bool:
+        try:
+            return bool(self.resolve(name))
+        except IndexNotFoundError:
+            return False
+
+    def close(self):
+        for svc in self.indices.values():
+            svc.close()

@@ -39,6 +39,8 @@ KIND_GEO = "geo"
 NUMERIC_TYPES = {"long", "integer", "short", "byte", "double", "float",
                  "half_float", "date", "boolean"}
 
+POSITION_INCREMENT_GAP = 16
+
 
 def parse_date(value: Any) -> float:
     """→ epoch millis (float). Accepts epoch millis, ISO-8601, yyyy-MM-dd."""
@@ -148,12 +150,15 @@ class FieldMapper:
                 if v is None:
                     continue
                 toks = self.analyzer.analyze(str(v))
-                # position gap of 100 between array elements (Lucene default)
+                # Position gap between array elements blocks phrase matches
+                # across elements (Lucene's position_increment_gap, default
+                # 100 there; 16 here because the segment layout is
+                # position-indexed and slots are memory).
                 for t in toks:
                     pf.tokens.append(Token(t.term, t.position + position,
                                            t.start_offset, t.end_offset))
                 if toks:
-                    position += toks[-1].position + 100
+                    position += toks[-1].position + POSITION_INCREMENT_GAP
         elif self.kind == KIND_KEYWORD:
             pf.keywords = [str(v) for v in values if v is not None]
         elif self.kind == KIND_NUMERIC:

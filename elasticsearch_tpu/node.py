@@ -1,0 +1,275 @@
+"""Node — the service container and lifecycle.
+
+Reference: core/node/Node.java:129-315 — module assembly (:161-198), ordered
+start (:230-275: indices → cluster → search → discovery → gateway → http).
+One Node owns: persisted cluster state (gateway), ClusterService,
+IndicesService (reconciler), SearchService, and the document/bulk action
+entry points (the action layer, core/action/) that the REST layer and the
+Python client both call — mirroring how NodeClient and RestController share
+TransportAction instances.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from pathlib import Path
+
+from elasticsearch_tpu import __version__
+from elasticsearch_tpu.cluster.service import ClusterService
+from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.common.errors import DocumentMissingError
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.engine import MATCH_ANY
+from elasticsearch_tpu.search.service import SearchService
+
+
+class Node:
+    def __init__(self, settings: Settings | dict | None = None,
+                 data_path: str | Path | None = None):
+        if not isinstance(settings, Settings):
+            settings = Settings(settings or {})
+        self.settings = settings
+        self.node_id = uuid.uuid4().hex[:20]
+        self.node_name = settings.get("node.name", f"node-{self.node_id[:7]}")
+        self.data_path = Path(data_path or settings.get("path.data", "data"))
+        self.data_path.mkdir(parents=True, exist_ok=True)
+        self._started = False
+
+    # ---- lifecycle (Node.start order) --------------------------------------
+
+    def start(self) -> "Node":
+        state = ClusterState.load(self.data_path / "_state", self.node_id)
+        state = state.with_(
+            version=state.version,
+            master_node_id=self.node_id,
+            nodes={self.node_id: {"name": self.node_name,
+                                  "version": __version__}})
+        self.cluster_service = ClusterService(state)
+        self.cluster_service.add_listener(self._persist_state)
+        from elasticsearch_tpu.indices.service import IndicesService
+        self.indices_service = IndicesService(self.data_path,
+                                              self.cluster_service,
+                                              self.node_id)
+        self.search_service = SearchService()
+        self._started = True
+        return self
+
+    def _persist_state(self, old: ClusterState, new: ClusterState) -> None:
+        new.persist(self.data_path / "_state")
+
+    def close(self) -> None:
+        if self._started:
+            self.indices_service.close()
+            self._started = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---- document action layer (core/action/{index,get,delete,update}) ----
+
+    def index_doc(self, index: str, doc_id: str | None, source: dict,
+                  routing: str | None = None, version: int | None = None,
+                  op_type: str = "index", refresh: bool = False) -> dict:
+        svc = self.indices_service.index(index) if \
+            self.indices_service.has_index(index) else \
+            self.indices_service.create_index(index)  # auto-create
+        created_id = doc_id or uuid.uuid4().hex[:20]
+        engine = svc.shard_for(created_id, routing)
+        v, created = engine.index(
+            created_id, source,
+            version=MATCH_ANY if version is None else version,
+            routing=routing, op_type=op_type)
+        if refresh:
+            engine.refresh()
+        return {
+            "_index": svc.name, "_type": "_doc", "_id": created_id,
+            "_version": v,
+            "result": "created" if created else "updated",
+            "created": created,
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+        }
+
+    def get_doc(self, index: str, doc_id: str,
+                routing: str | None = None) -> dict:
+        svc = self.indices_service.index(index)
+        r = svc.shard_for(doc_id, routing).get(doc_id)
+        out = {"_index": svc.name, "_type": "_doc", "_id": doc_id,
+               "found": r.found}
+        if r.found:
+            out["_version"] = r.version
+            out["_source"] = r.source
+        return out
+
+    def delete_doc(self, index: str, doc_id: str,
+                   routing: str | None = None, version: int | None = None,
+                   refresh: bool = False) -> dict:
+        svc = self.indices_service.index(index)
+        engine = svc.shard_for(doc_id, routing)
+        v = engine.delete(doc_id,
+                          version=MATCH_ANY if version is None else version)
+        if refresh:
+            engine.refresh()
+        return {"_index": svc.name, "_type": "_doc", "_id": doc_id,
+                "_version": v, "result": "deleted", "found": True,
+                "_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def update_doc(self, index: str, doc_id: str, body: dict,
+                   routing: str | None = None, refresh: bool = False) -> dict:
+        """Get-modify-reindex on the primary (TransportUpdateAction)."""
+        svc = self.indices_service.index(index)
+        engine = svc.shard_for(doc_id, routing)
+        current = engine.get(doc_id)
+        if not current.found:
+            if "upsert" in body:
+                return self.index_doc(index, doc_id, body["upsert"],
+                                      routing=routing, refresh=refresh)
+            raise DocumentMissingError(index, doc_id)
+        if "doc" in body:
+            merged = _deep_merge(dict(current.source), body["doc"])
+        elif "script" in body:
+            merged = _apply_update_script(dict(current.source), body["script"])
+        else:
+            merged = dict(current.source)
+        v, _ = engine.index(doc_id, merged, version=current.version,
+                            routing=routing)
+        if refresh:
+            engine.refresh()
+        return {"_index": svc.name, "_type": "_doc", "_id": doc_id,
+                "_version": v, "result": "updated"}
+
+    def mget(self, body: dict, default_index: str | None = None) -> dict:
+        docs = []
+        for spec in body.get("docs", []):
+            idx = spec.get("_index", default_index)
+            docs.append(self.get_doc(idx, spec["_id"],
+                                     routing=spec.get("routing")))
+        if "ids" in body and default_index:
+            for did in body["ids"]:
+                docs.append(self.get_doc(default_index, str(did)))
+        return {"docs": docs}
+
+    # ---- bulk (TransportBulkAction: split per shard, apply per item) -------
+
+    def bulk(self, operations: list[tuple[str, dict, dict | None]],
+             refresh: bool = False) -> dict:
+        """operations: (action, metadata, source) triples, pre-parsed from
+        NDJSON by the REST layer or built by the client."""
+        items = []
+        errors = False
+        touched: set[tuple[str, int]] = set()
+        for action, meta, source in operations:
+            index = meta.get("_index")
+            doc_id = meta.get("_id")
+            routing = meta.get("routing", meta.get("_routing"))
+            try:
+                if action in ("index", "create"):
+                    r = self.index_doc(index, doc_id, source, routing=routing,
+                                       op_type="create" if action == "create"
+                                       else "index")
+                    status = 201 if r["created"] else 200
+                elif action == "delete":
+                    r = self.delete_doc(index, doc_id, routing=routing)
+                    status = 200
+                elif action == "update":
+                    r = self.update_doc(index, doc_id, source or {},
+                                        routing=routing)
+                    status = 200
+                else:
+                    raise ValueError(f"unknown bulk action [{action}]")
+                items.append({action: {**r, "status": status}})
+            except Exception as e:  # per-item failure (bulk continues)
+                errors = True
+                from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+                err = e.to_xcontent() if isinstance(e, ElasticsearchTpuError) \
+                    else {"type": "exception", "reason": str(e)}
+                status = e.status if isinstance(e, ElasticsearchTpuError) else 500
+                items.append({action: {"_index": index, "_id": doc_id,
+                                       "error": err, "status": status}})
+        if refresh:
+            for name in {m.get("_index") for _, m, _ in operations if m}:
+                if name and self.indices_service.has_index(name):
+                    self.indices_service.index(name).refresh()
+        return {"took": 0, "errors": errors, "items": items}
+
+    # ---- search entry ------------------------------------------------------
+
+    def search(self, index: str, body: dict | None = None,
+               scroll: str | None = None) -> dict:
+        names = self.indices_service.resolve(index)
+        if len(names) == 1:
+            return self.search_service.search(
+                self.indices_service.index(names[0]), body, scroll=scroll)
+        # multi-index search: run per index and merge (coordinator behavior)
+        from elasticsearch_tpu.search.controller import merge_responses
+        from elasticsearch_tpu.search.phase import parse_search_request
+        req = parse_search_request(body)
+        all_results, all_searchers, idx_of = [], [], []
+        t0 = time.perf_counter()
+        for n in names:
+            svc = self.indices_service.index(n)
+            searchers = self.search_service._searchers(svc)
+            for s in searchers:
+                all_searchers.append((n, s))
+                all_results.append(s.query_phase(req))
+        class _SearcherProxy:
+            def __init__(self, name, s):
+                self.name, self.s = name, s
+            def fetch_phase(self, req, result, index_name, positions):
+                return self.s.fetch_phase(req, result, self.name, positions)
+        proxies = [_SearcherProxy(n, s) for n, s in all_searchers]
+        return merge_responses("", req, all_results, proxies,
+                               (time.perf_counter() - t0) * 1e3, req.aggs)
+
+    def count(self, index: str, body: dict | None = None) -> dict:
+        resp = self.search(index, {**(body or {}), "size": 0})
+        return {"count": resp["hits"]["total"]["value"],
+                "_shards": resp["_shards"]}
+
+
+def _deep_merge(base: dict, patch: dict) -> dict:
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            base[k] = _deep_merge(dict(base[k]), v)
+        else:
+            base[k] = v
+    return base
+
+
+def _apply_update_script(source: dict, script) -> dict:
+    """Update scripts: support the common `ctx._source.field = ...` and
+    `ctx._source.field += n` idioms via a restricted evaluator."""
+    import re as _re
+    if isinstance(script, dict):
+        src = script.get("source", script.get("inline", ""))
+        params = script.get("params", {})
+    else:
+        src, params = str(script), {}
+    for stmt in src.split(";"):
+        stmt = stmt.strip()
+        if not stmt:
+            continue
+        m = _re.fullmatch(
+            r"ctx\._source\.(\w+)\s*(=|\+=|-=)\s*(.+)", stmt)
+        if not m:
+            raise ValueError(f"unsupported update script [{stmt}]")
+        fname, op, expr = m.groups()
+        expr = expr.strip()
+        pm = _re.fullmatch(r"params\.(\w+)", expr)
+        if pm:
+            value = params[pm.group(1)]
+        else:
+            try:
+                value = float(expr) if "." in expr else int(expr)
+            except ValueError:
+                value = expr.strip("'\"")
+        if op == "=":
+            source[fname] = value
+        elif op == "+=":
+            source[fname] = source.get(fname, 0) + value
+        elif op == "-=":
+            source[fname] = source.get(fname, 0) - value
+    return source

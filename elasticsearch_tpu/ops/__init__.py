@@ -1,0 +1,26 @@
+"""TPU query kernels.
+
+Pure ``jnp`` functions over the columnar segment arrays. They are composed
+by the query executor (search/execute.py) into ONE traced function per
+query-plan shape, so XLA fuses the whole scoring pipeline — leaf scorers,
+boolean combination, function_score, top-k — into a single device program
+(the analog of Lucene's scorer tree executed in
+core/search/query/QueryPhase.java:314, but with no per-doc virtual calls).
+
+Conventions:
+* every leaf produces ``(scores[N] f32, mask[N] bool)`` over a segment's
+  padded doc axis;
+* padded/dead rows are masked by the segment live bitmap at the end;
+* term ids are per-segment; ``-1`` means "term absent in this segment"
+  (kernels guard against -1 matching the -1 padding in columns).
+"""
+
+from elasticsearch_tpu.ops.similarity import BM25Params, idf as bm25_idf
+from elasticsearch_tpu.ops import lexical, phrase, boolean, filters, topk, vector
+from elasticsearch_tpu.ops import functionscore, aggs_ops
+
+__all__ = [
+    "BM25Params", "bm25_idf",
+    "lexical", "phrase", "boolean", "filters", "topk", "vector",
+    "functionscore", "aggs_ops",
+]

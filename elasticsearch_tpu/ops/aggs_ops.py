@@ -1,0 +1,93 @@
+"""Aggregation reduction kernels.
+
+The reference builds a per-segment collector tree that increments bucket
+counters doc-by-doc (core/search/aggregations/Aggregator.java,
+AggregationPhase.java:44) over BigArrays. On TPU the same reductions are
+masked dense ops over doc-values columns: terms agg = segment_sum over
+ordinals, metrics = masked reductions, histogram = bucketize + segment_sum.
+Per-segment partials are merged host-side through the segment→shard→global
+reduce (InternalAggregations.reduce analog, search/aggregations.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ord_value_counts(ords, mask, num_ords: int):
+    """Terms-agg kernel: per-ordinal doc-value counts.
+
+    ords: [N, K] int32 (-1 pad); mask: [N] bool (docs in agg context).
+    num_ords: static (padded) vocab size. → counts [num_ords] int32.
+    """
+    valid = (ords >= 0) & mask[:, None]
+    flat_ords = jnp.where(valid, ords, num_ords).reshape(-1)  # overflow slot
+    ones = valid.astype(jnp.int32).reshape(-1)
+    counts = jax.ops.segment_sum(ones, flat_ords, num_segments=num_ords + 1)
+    return counts[:num_ords]
+
+
+def ord_metric_sums(ords, mask, metric_values, num_ords: int):
+    """Per-ordinal sum of a metric column (sub-aggregation support):
+    e.g. terms agg bucket → avg(price). → sums [num_ords] f64-ish f32."""
+    valid = (ords >= 0) & mask[:, None]
+    flat_ords = jnp.where(valid, ords, num_ords).reshape(-1)
+    vals = jnp.where(valid, metric_values[:, None], 0.0).reshape(-1)
+    sums = jax.ops.segment_sum(vals, flat_ords, num_segments=num_ords + 1)
+    return sums[:num_ords]
+
+
+def histogram_counts(values, exists, mask, base: float, interval: float,
+                     num_buckets: int):
+    """Histogram kernel. Bucket i covers [base + i·interval, base+(i+1)·interval).
+    base/num_buckets are computed host-side from a min/max pre-pass."""
+    in_ctx = exists & mask
+    idx = jnp.floor((values - base) / interval).astype(jnp.int32)
+    idx = jnp.where(in_ctx & (idx >= 0) & (idx < num_buckets), idx, num_buckets)
+    ones = jnp.where(idx < num_buckets, 1, 0)
+    counts = jax.ops.segment_sum(ones, idx, num_segments=num_buckets + 1)
+    return counts[:num_buckets]
+
+
+def range_counts(values, exists, mask, lows, highs):
+    """range agg: lows/highs [R] f64 device arrays (±inf open ends).
+    → counts [R] int32 (ranges may overlap, matching ES semantics)."""
+    in_ctx = (exists & mask)[:, None]
+    hit = in_ctx & (values[:, None] >= lows[None, :]) & (values[:, None] < highs[None, :])
+    return hit.sum(axis=0).astype(jnp.int32)
+
+
+def stats_metrics(values, exists, mask):
+    """min/max/sum/count in one pass (stats agg; avg derived host-side)."""
+    m = exists & mask
+    cnt = m.sum(dtype=jnp.int32)
+    s = jnp.where(m, values, 0.0).sum()
+    mn = jnp.min(jnp.where(m, values, jnp.inf))
+    mx = jnp.max(jnp.where(m, values, -jnp.inf))
+    return cnt, s, mn, mx
+
+
+def sum_of_squares(values, exists, mask):
+    """extended_stats: Σv² (variance/std derived host-side)."""
+    m = exists & mask
+    return jnp.where(m, values * values, 0.0).sum()
+
+
+def value_count(exists, mask):
+    return (exists & mask).sum(dtype=jnp.int32)
+
+
+def cardinality_ords(ords, mask, num_ords: int):
+    """Exact distinct ordinal count within this segment. Cross-segment union
+    is resolved host-side via vocab strings (exact, unlike the reference's
+    HLL++ — core/search/aggregations/metrics/cardinality/)."""
+    present = ord_value_counts(ords, mask, num_ords) > 0
+    return present, present.sum(dtype=jnp.int32)
+
+
+def masked_sort_values(values, exists, mask, fill: float = jnp.inf):
+    """Sorted live values (percentiles agg: exact quantiles from the sorted
+    array; host interpolates). Fill sinks non-context docs to the end."""
+    m = exists & mask
+    return jnp.sort(jnp.where(m, values, fill)), m.sum(dtype=jnp.int32)

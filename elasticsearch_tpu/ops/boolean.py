@@ -1,0 +1,51 @@
+"""Boolean clause combination.
+
+The reference's BooleanScorer2/ConjunctionScorer docid-iterator merging
+(exercised by core/index/query/BoolQueryParser.java) becomes pure mask
+algebra over dense per-doc vectors — conjunction is ``&``, disjunction score
+accumulation is ``+``, and ``minimum_should_match`` is a count threshold.
+Scoring semantics match Lucene's BooleanWeight:
+
+* must / should clauses contribute their scores (sum);
+* filter / must_not contribute no score;
+* a doc matches iff all musts match, no must_not matches, and at least
+  ``minimum_should_match`` shoulds match (default 1 if there are shoulds and
+  no must/filter, else 0).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def combine_bool(n: int,
+                 must: list, should: list, must_not: list, filters: list,
+                 minimum_should_match: int):
+    """Combine clause results into (scores[N], mask[N]).
+
+    Each element of must/should is a (scores, mask) pair; must_not/filters
+    are masks. All lists are static-length (part of the compiled shape).
+    """
+    scores = jnp.zeros(n, dtype=jnp.float32)
+    mask = jnp.ones(n, dtype=bool)
+    for s, m in must:
+        scores = scores + jnp.where(m, s, 0.0)
+        mask = mask & m
+    for m in filters:
+        mask = mask & m
+    for m in must_not:
+        mask = mask & ~m
+    if should:
+        should_count = jnp.zeros(n, dtype=jnp.int32)
+        for s, m in should:
+            scores = scores + jnp.where(m, s, 0.0)
+            should_count = should_count + m.astype(jnp.int32)
+        if minimum_should_match > 0:
+            mask = mask & (should_count >= minimum_should_match)
+    return scores, mask
+
+
+def constant_score(mask, boost: float):
+    """filter wrapped in constant_score → every matching doc scores `boost`
+    (reference: ConstantScoreQuery)."""
+    return jnp.where(mask, jnp.float32(boost), 0.0), mask

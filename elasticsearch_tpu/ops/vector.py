@@ -1,0 +1,54 @@
+"""Dense-vector scoring — brute-force exact kNN on the MXU.
+
+f32 is the default (bf16 input rounding visibly reorders near-tie cosine
+rankings — recall parity first); pass use_bf16=True to trade exactness for
+~2x MXU throughput when the corpus tolerates it.
+
+The reference era has no dense_vector type; its equivalent is binary doc
+values + script cosine (BASELINE.md config 4,
+core/common/lucene/search/function/ScriptScoreFunction.java). Here vectors
+are first-class [N, D] matrices: batched cosine/dot scoring is a single
+bf16 matmul — exactly what the 128×128 systolic array is built for.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def l2_normalize(x, axis=-1, eps=1e-12):
+    return x / jnp.sqrt((x * x).sum(axis=axis, keepdims=True) + eps)
+
+
+def cosine_scores(vecs, exists, q, use_bf16: bool = False):
+    """Cosine similarity of one query vector against all docs.
+
+    vecs: [N, D] f32 (pre-normalized at reader build); q: [D] f32.
+    Returns scores[N] f32 in [-1, 1]; non-existent rows score 0.
+    """
+    qn = l2_normalize(q)
+    if use_bf16:
+        s = (vecs.astype(jnp.bfloat16) @ qn.astype(jnp.bfloat16)).astype(jnp.float32)
+    else:
+        s = vecs @ qn
+    return jnp.where(exists, s, 0.0)
+
+
+def cosine_scores_batch(vecs, exists, qs, use_bf16: bool = False):
+    """qs: [Q, D] → scores [Q, N]. One MXU matmul for the whole batch."""
+    qn = l2_normalize(qs, axis=-1)
+    if use_bf16:
+        s = (qn.astype(jnp.bfloat16) @ vecs.astype(jnp.bfloat16).T).astype(jnp.float32)
+    else:
+        s = qn @ vecs.T
+    return jnp.where(exists[None, :], s, 0.0)
+
+
+def dot_scores(vecs, exists, q):
+    return jnp.where(exists, vecs @ q, 0.0)
+
+
+def script_cosine_scores(vecs, exists, q):
+    """`script_score: cosineSimilarity(params.query_vector, 'field') + 1.0`
+    — the ES idiom for non-negative cosine ranking (BASELINE config 4)."""
+    return jnp.where(exists, cosine_scores(vecs, exists, q) + 1.0, 0.0)

@@ -1,0 +1,4 @@
+from elasticsearch_tpu.search.query_dsl import parse_query
+from elasticsearch_tpu.search.service import SearchService
+
+__all__ = ["parse_query", "SearchService"]

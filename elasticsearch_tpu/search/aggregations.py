@@ -1,0 +1,559 @@
+"""Aggregations: parse → per-shard collect → cross-shard reduce → render.
+
+Reference: the aggregation framework (core/search/aggregations/, 335 files):
+Aggregator collector trees per segment, `InternalAggregation.reduce`
+(InternalAggregations.java:133) merging shard partials at the coordinator.
+
+Here a shard's collect phase consumes the **device-computed query mask**
+(one [N] bool transfer per shard) and reduces over the columnar doc values
+with vectorized numpy; partials are plain dicts merged by the same `reduce`
+tree the coordinator applies across shards (segment→shard→global, SURVEY.md
+§2.10 "aggregation tree reduce"). The dense-kernel equivalents live in
+ops/aggs_ops.py and take over on-device for the hot aggs as a perf pass.
+
+Supported: terms, histogram, date_histogram (fixed + calendar intervals),
+range, date_range, filter, filters, global, missing (bucket);
+min/max/sum/avg/stats/extended_stats/value_count/cardinality/percentiles/
+top_hits (metrics); avg_bucket/max_bucket/min_bucket/sum_bucket/
+cumulative_sum/derivative (pipeline).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import QueryParsingError
+from elasticsearch_tpu.common.settings import parse_time_value
+from elasticsearch_tpu.mapping.mapper import parse_date
+
+BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "date_range",
+               "filter", "filters", "global", "missing"}
+METRIC_AGGS = {"min", "max", "sum", "avg", "stats", "extended_stats",
+               "value_count", "cardinality", "percentiles", "top_hits"}
+PIPELINE_AGGS = {"avg_bucket", "max_bucket", "min_bucket", "sum_bucket",
+                 "cumulative_sum", "derivative"}
+
+_CALENDAR = {"year": "Y", "1y": "Y", "quarter": "Q", "1q": "Q",
+             "month": "M", "1M": "M", "week": "W", "1w": "W"}
+
+
+@dataclass
+class AggNode:
+    name: str
+    type: str
+    params: dict
+    subs: list["AggNode"] = field(default_factory=list)
+    pipelines: list["AggNode"] = field(default_factory=list)
+
+
+def parse_aggs(body: dict | None) -> list[AggNode]:
+    out: list[AggNode] = []
+    if not body:
+        return out
+    for name, spec in body.items():
+        sub_specs = spec.get("aggs", spec.get("aggregations")) or {}
+        atype = None
+        params: dict = {}
+        for key, val in spec.items():
+            if key in ("aggs", "aggregations", "meta"):
+                continue
+            atype, params = key, val
+        if atype is None:
+            raise QueryParsingError(f"aggregation [{name}] missing type")
+        node = AggNode(name=name, type=atype, params=params or {})
+        for sub in parse_aggs(sub_specs):
+            (node.pipelines if sub.type in PIPELINE_AGGS else node.subs).append(sub)
+        out.append(node)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# collect phase (per shard)
+# ---------------------------------------------------------------------------
+
+class ShardAggContext:
+    """Host views of one shard's reader for aggregation collection."""
+
+    def __init__(self, reader, mapper_service, execute_filter):
+        self.reader = reader
+        self.mapper_service = mapper_service
+        self.execute_filter = execute_filter  # (Query) → list[np mask per seg]
+
+    def numeric_values(self, fname: str):
+        """→ (values f64 concat over segments, exists concat)."""
+        vals, exists = [], []
+        for s in self.reader.segments:
+            col = s.seg.numeric_fields.get(fname)
+            if col is None:
+                vals.append(np.zeros(s.padded_docs))
+                exists.append(np.zeros(s.padded_docs, bool))
+            else:
+                vals.append(col.values)
+                exists.append(col.exists)
+        return np.concatenate(vals), np.concatenate(exists)
+
+    def keyword_values(self, fname: str):
+        """→ (ords [N,K] concat (ord remapped to per-shard union), vocab)."""
+        union: dict[str, int] = {}
+        seg_cols = []
+        kmax = 1
+        for s in self.reader.segments:
+            col = s.seg.keyword_fields.get(fname)
+            seg_cols.append(col)
+            if col is not None:
+                kmax = max(kmax, col.ords.shape[1])
+                for v in col.vocab:
+                    union.setdefault(v, len(union))
+        rows = []
+        for s, col in zip(self.reader.segments, seg_cols):
+            if col is None:
+                rows.append(np.full((s.padded_docs, kmax), -1, np.int32))
+                continue
+            remap = np.array([union[v] for v in col.vocab] or [0], np.int32)
+            ords = col.ords
+            out = np.full((ords.shape[0], kmax), -1, np.int32)
+            valid = ords >= 0
+            out[:, :ords.shape[1]] = np.where(valid, remap[np.clip(ords, 0, None)], -1)
+            rows.append(out)
+        vocab = [None] * len(union)
+        for v, i in union.items():
+            vocab[i] = v
+        return np.concatenate(rows), vocab
+
+
+def collect(node: AggNode, mask: np.ndarray, ctx: ShardAggContext) -> dict:
+    """→ shard partial for this agg (merged by reduce())."""
+    fn = _COLLECTORS.get(node.type)
+    if fn is None:
+        raise QueryParsingError(f"unknown aggregation type [{node.type}]")
+    return fn(node, mask, ctx)
+
+
+def _collect_subs(node: AggNode, mask: np.ndarray, ctx: ShardAggContext) -> dict:
+    return {sub.name: collect(sub, mask, ctx) for sub in node.subs}
+
+
+def _field_numeric(node: AggNode, ctx: ShardAggContext):
+    fname = node.params.get("field")
+    if fname is None:
+        raise QueryParsingError(f"agg [{node.name}] requires a field")
+    return ctx.numeric_values(fname)
+
+
+def _c_metric(node, mask, ctx):
+    vals, exists = _field_numeric(node, ctx)
+    m = mask & exists
+    v = vals[m]
+    out = {"count": int(v.size)}
+    if v.size:
+        out.update(sum=float(v.sum()), min=float(v.min()), max=float(v.max()),
+                   sum_sq=float((v * v).sum()))
+    else:
+        out.update(sum=0.0, min=None, max=None, sum_sq=0.0)
+    return out
+
+
+def _c_value_count(node, mask, ctx):
+    fname = node.params.get("field")
+    ncol_vals, exists = ctx.numeric_values(fname)
+    if exists.any():
+        return {"count": int((mask & exists).sum())}
+    ords, _ = ctx.keyword_values(fname)
+    valid = (ords >= 0).any(axis=1)
+    return {"count": int((mask & valid).sum())}
+
+
+def _c_cardinality(node, mask, ctx):
+    fname = node.params.get("field")
+    ords, vocab = ctx.keyword_values(fname)
+    if vocab:
+        sel = ords[mask]
+        present = np.unique(sel[sel >= 0])
+        return {"values": [vocab[i] for i in present]}
+    vals, exists = ctx.numeric_values(fname)
+    return {"values": np.unique(vals[mask & exists]).tolist()}
+
+
+def _c_percentiles(node, mask, ctx):
+    vals, exists = _field_numeric(node, ctx)
+    return {"values": vals[mask & exists].tolist(),
+            "percents": node.params.get("percents",
+                                        [1, 5, 25, 50, 75, 95, 99])}
+
+
+def _c_top_hits(node, mask, ctx):
+    size = int(node.params.get("size", 3))
+    idx = np.nonzero(mask)[0][:size]
+    hits = []
+    for gid in idx:
+        hits.append({"_id": ctx.reader.doc_id(int(gid)),
+                     "_source": ctx.reader.source(int(gid))})
+    return {"hits": hits, "total": int(mask.sum()), "size": size}
+
+
+def _c_terms(node, mask, ctx):
+    fname = node.params.get("field")
+    ords, vocab = ctx.keyword_values(fname)
+    if vocab:
+        sel = ords[mask]
+        sel = sel[sel >= 0]
+        counts = np.bincount(sel, minlength=len(vocab))
+        buckets = {}
+        present = np.nonzero(counts)[0]
+        # shard_size: collect more than size for accurate cross-shard merge
+        # (reference: terms agg shard_size heuristics)
+        order = node.params.get("order")
+        for oid in present:
+            key = vocab[oid]
+            b = {"doc_count": int(counts[oid])}
+            if node.subs:
+                bmask = mask & (ords == oid).any(axis=1)
+                b["subs"] = _collect_subs(node, bmask, ctx)
+            buckets[key] = b
+        return {"buckets": buckets, "doc_count_error_upper_bound": 0}
+    # numeric terms
+    vals, exists = ctx.numeric_values(fname)
+    sel = vals[mask & exists]
+    uniq, counts = np.unique(sel, return_counts=True)
+    buckets = {}
+    for u, c in zip(uniq, counts):
+        key = int(u) if float(u).is_integer() else float(u)
+        b = {"doc_count": int(c)}
+        if node.subs:
+            bmask = mask & exists & (vals == u)
+            b["subs"] = _collect_subs(node, bmask, ctx)
+        buckets[key] = b
+    return {"buckets": buckets, "doc_count_error_upper_bound": 0}
+
+
+def _c_histogram(node, mask, ctx):
+    vals, exists = _field_numeric(node, ctx)
+    interval = float(node.params["interval"])
+    offset = float(node.params.get("offset", 0.0))
+    m = mask & exists
+    v = vals[m]
+    buckets = {}
+    if v.size:
+        keys = np.floor((v - offset) / interval) * interval + offset
+        uniq, counts = np.unique(keys, return_counts=True)
+        for u, c in zip(uniq, counts):
+            b = {"doc_count": int(c)}
+            if node.subs:
+                kk = np.floor((vals - offset) / interval) * interval + offset
+                bmask = m.copy()
+                bmask[m] = False  # rebuilt below
+                bmask = mask & exists & (kk == u)
+                b["subs"] = _collect_subs(node, bmask, ctx)
+            buckets[float(u)] = b
+    return {"buckets": buckets, "interval": interval,
+            "min_doc_count": int(node.params.get("min_doc_count", 0))}
+
+
+def _c_date_histogram(node, mask, ctx):
+    vals, exists = _field_numeric(node, ctx)
+    interval = node.params.get("interval") or \
+        node.params.get("calendar_interval") or \
+        node.params.get("fixed_interval")
+    m = mask & exists
+    v = vals[m]
+    buckets = {}
+    cal = _CALENDAR.get(str(interval))
+    if cal is not None:
+        if v.size:
+            dt = v.astype("datetime64[ms]").astype(f"datetime64[{cal}]")
+            keys = dt.astype("datetime64[ms]").astype(np.int64)
+            uniq, counts = np.unique(keys, return_counts=True)
+            all_dt = vals.astype("datetime64[ms]").astype(f"datetime64[{cal}]") \
+                .astype("datetime64[ms]").astype(np.int64)
+            for u, c in zip(uniq, counts):
+                b = {"doc_count": int(c)}
+                if node.subs:
+                    b["subs"] = _collect_subs(
+                        node, mask & exists & (all_dt == u), ctx)
+                buckets[int(u)] = b
+        return {"buckets": buckets, "date": True}
+    ms = parse_time_value(interval) * 1000.0
+    if v.size:
+        keys = np.floor(v / ms) * ms
+        uniq, counts = np.unique(keys, return_counts=True)
+        for u, c in zip(uniq, counts):
+            b = {"doc_count": int(c)}
+            if node.subs:
+                kk = np.floor(vals / ms) * ms
+                b["subs"] = _collect_subs(node, mask & exists & (kk == u), ctx)
+            buckets[int(u)] = b
+    return {"buckets": buckets, "date": True}
+
+
+def _range_bounds(node, is_date: bool):
+    bounds = []
+    for r in node.params.get("ranges", []):
+        frm = r.get("from")
+        to = r.get("to")
+        if is_date:
+            frm = parse_date(frm) if frm is not None else None
+            to = parse_date(to) if to is not None else None
+        key = r.get("key")
+        if key is None:
+            key = f"{frm if frm is not None else '*'}-{to if to is not None else '*'}"
+        bounds.append((key, -np.inf if frm is None else float(frm),
+                       np.inf if to is None else float(to)))
+    return bounds
+
+
+def _c_range(node, mask, ctx, is_date=False):
+    vals, exists = _field_numeric(node, ctx)
+    m = mask & exists
+    buckets = {}
+    for key, lo, hi in _range_bounds(node, is_date):
+        bmask = m & (vals >= lo) & (vals < hi)
+        b = {"doc_count": int(bmask.sum()), "from": None if lo == -np.inf else lo,
+             "to": None if hi == np.inf else hi}
+        if node.subs:
+            b["subs"] = _collect_subs(node, bmask, ctx)
+        buckets[key] = b
+    return {"buckets": buckets, "keyed_order": [b[0] for b in
+                                                _range_bounds(node, is_date)]}
+
+
+def _c_filter(node, mask, ctx):
+    from elasticsearch_tpu.search.query_dsl import parse_query
+    fmask = ctx.execute_filter(parse_query(node.params))
+    bmask = mask & fmask
+    out = {"doc_count": int(bmask.sum())}
+    if node.subs:
+        out["subs"] = _collect_subs(node, bmask, ctx)
+    return out
+
+
+def _c_filters(node, mask, ctx):
+    from elasticsearch_tpu.search.query_dsl import parse_query
+    buckets = {}
+    specs = node.params.get("filters", {})
+    items = specs.items() if isinstance(specs, dict) else \
+        ((str(i), s) for i, s in enumerate(specs))
+    for key, spec in items:
+        fmask = ctx.execute_filter(parse_query(spec))
+        bmask = mask & fmask
+        b = {"doc_count": int(bmask.sum())}
+        if node.subs:
+            b["subs"] = _collect_subs(node, bmask, ctx)
+        buckets[key] = b
+    return {"buckets": buckets}
+
+
+def _c_global(node, mask, ctx):
+    gmask = np.ones_like(mask)
+    # global agg ignores the query, but not deletes/padding: rebuild liveness
+    live = np.concatenate([np.asarray(s.live) for s in ctx.reader.segments]) \
+        if ctx.reader.segments else mask
+    out = {"doc_count": int(live.sum())}
+    if node.subs:
+        out["subs"] = _collect_subs(node, live, ctx)
+    return out
+
+
+def _c_missing(node, mask, ctx):
+    fname = node.params.get("field")
+    vals, exists = ctx.numeric_values(fname)
+    if not exists.any():
+        ords, vocab = ctx.keyword_values(fname)
+        exists = (ords >= 0).any(axis=1)
+    bmask = mask & ~exists
+    out = {"doc_count": int(bmask.sum())}
+    if node.subs:
+        out["subs"] = _collect_subs(node, bmask, ctx)
+    return out
+
+
+_COLLECTORS = {
+    "min": _c_metric, "max": _c_metric, "sum": _c_metric, "avg": _c_metric,
+    "stats": _c_metric, "extended_stats": _c_metric,
+    "value_count": _c_value_count, "cardinality": _c_cardinality,
+    "percentiles": _c_percentiles, "top_hits": _c_top_hits,
+    "terms": _c_terms, "histogram": _c_histogram,
+    "date_histogram": _c_date_histogram,
+    "range": _c_range, "date_range": lambda n, m, c: _c_range(n, m, c, True),
+    "filter": _c_filter, "filters": _c_filters,
+    "global": _c_global, "missing": _c_missing,
+}
+
+
+# ---------------------------------------------------------------------------
+# reduce phase (coordinator; InternalAggregations.reduce analog)
+# ---------------------------------------------------------------------------
+
+def reduce_aggs(nodes: list[AggNode], partials_per_shard: list[dict]) -> dict:
+    out = {}
+    for node in nodes:
+        shard_parts = [p[node.name] for p in partials_per_shard if node.name in p]
+        out[node.name] = _reduce_node(node, shard_parts)
+    return out
+
+
+def _merge_metric(parts: list[dict]) -> dict:
+    count = sum(p["count"] for p in parts)
+    s = sum(p["sum"] for p in parts)
+    mins = [p["min"] for p in parts if p["min"] is not None]
+    maxs = [p["max"] for p in parts if p["max"] is not None]
+    return {"count": count, "sum": s,
+            "min": min(mins) if mins else None,
+            "max": max(maxs) if maxs else None,
+            "sum_sq": sum(p.get("sum_sq", 0.0) for p in parts)}
+
+
+def _merge_buckets(node: AggNode, parts: list[dict]) -> dict:
+    merged: dict = {}
+    for p in parts:
+        for key, b in p.get("buckets", {}).items():
+            cur = merged.setdefault(key, {"doc_count": 0, "_parts": []})
+            cur["doc_count"] += b["doc_count"]
+            for extra in ("from", "to"):
+                if extra in b:
+                    cur[extra] = b[extra]
+            if "subs" in b:
+                cur["_parts"].append(b["subs"])
+    for key, b in merged.items():
+        if b.pop("_parts", None) or node.subs:
+            parts_list = [p.get("buckets", {}).get(key, {}).get("subs", {})
+                          for p in parts if key in p.get("buckets", {})]
+            b["aggs"] = reduce_aggs(node.subs, [pl for pl in parts_list if pl])
+    return merged
+
+
+def _render_pipeline(node: AggNode, buckets: list[dict]) -> None:
+    for pipe in node.pipelines:
+        path = pipe.params.get("buckets_path", "_count")
+        def bucket_value(b):
+            if path == "_count":
+                return b["doc_count"]
+            head = path.split(">")[0].split(".")[0]
+            sub = b.get(node.name, b).get(head) if isinstance(b.get(node.name), dict) \
+                else b.get(head)
+            agg = b.get("aggs_rendered", {}).get(head, {})
+            return agg.get("value", agg.get("avg"))
+        values = [bucket_value(b) for b in buckets]
+        if pipe.type == "cumulative_sum":
+            acc = 0.0
+            for b, v in zip(buckets, values):
+                acc += (v or 0.0)
+                b.setdefault("pipeline", {})[pipe.name] = {"value": acc}
+        elif pipe.type == "derivative":
+            prev = None
+            for b, v in zip(buckets, values):
+                if prev is not None and v is not None:
+                    b.setdefault("pipeline", {})[pipe.name] = {"value": v - prev}
+                prev = v
+
+
+def _reduce_node(node: AggNode, parts: list[dict]) -> dict:
+    t = node.type
+    if t in ("min", "max", "sum", "avg"):
+        m = _merge_metric(parts)
+        if t == "avg":
+            value = m["sum"] / m["count"] if m["count"] else None
+        elif t == "sum":
+            value = m["sum"]
+        else:
+            value = m[t]
+        return {"value": value}
+    if t == "stats" or t == "extended_stats":
+        m = _merge_metric(parts)
+        avg = m["sum"] / m["count"] if m["count"] else None
+        out = {"count": m["count"], "min": m["min"], "max": m["max"],
+               "sum": m["sum"], "avg": avg}
+        if t == "extended_stats":
+            if m["count"]:
+                var = max(m["sum_sq"] / m["count"] - (avg or 0.0) ** 2, 0.0)
+            else:
+                var = None
+            out.update(sum_of_squares=m["sum_sq"], variance=var,
+                       std_deviation=math.sqrt(var) if var is not None else None)
+        return out
+    if t == "value_count":
+        return {"value": sum(p["count"] for p in parts)}
+    if t == "cardinality":
+        values: set = set()
+        for p in parts:
+            values.update(map(str, p["values"]))
+        return {"value": len(values)}
+    if t == "percentiles":
+        allv = np.sort(np.concatenate([np.asarray(p["values"], np.float64)
+                                       for p in parts])) if parts else np.array([])
+        percents = parts[0]["percents"] if parts else []
+        vals = {}
+        for pc in percents:
+            vals[f"{float(pc)}"] = (float(np.percentile(allv, pc))
+                                    if allv.size else None)
+        return {"values": vals}
+    if t == "top_hits":
+        size = parts[0]["size"] if parts else 3
+        hits = [h for p in parts for h in p["hits"]][:size]
+        return {"hits": {"total": sum(p["total"] for p in parts),
+                         "hits": hits}}
+    if t in ("filter", "global", "missing"):
+        out = {"doc_count": sum(p["doc_count"] for p in parts)}
+        sub_parts = [p["subs"] for p in parts if "subs" in p]
+        if node.subs:
+            out.update(reduce_aggs(node.subs, sub_parts))
+        return out
+    if t == "filters":
+        merged = _merge_buckets(node, parts)
+        return {"buckets": {k: _final_bucket(b) for k, b in merged.items()}}
+    if t == "terms":
+        merged = _merge_buckets(node, parts)
+        size = int(node.params.get("size", 10) or 0) or len(merged)
+        order = node.params.get("order", {"_count": "desc"})
+        (okey, odir), = order.items() if isinstance(order, dict) else \
+            (("_count", "desc"),)
+        rev = str(odir).lower() == "desc"
+        def sort_key(item):
+            key, b = item
+            if okey in ("_count",):
+                return b["doc_count"]
+            if okey in ("_term", "_key"):
+                return key
+            agg = b.get("aggs", {}).get(okey, {})
+            return agg.get("value") or 0
+        items = sorted(merged.items(), key=sort_key, reverse=rev)
+        if okey == "_count":  # secondary order: term asc (ES tie-break)
+            items = sorted(items, key=lambda kv: str(kv[0]))
+            items = sorted(items, key=lambda kv: kv[1]["doc_count"],
+                           reverse=rev)
+        buckets = [{"key": k, **_final_bucket(b)} for k, b in items[:size]]
+        sum_other = sum(b["doc_count"] for _, b in items[size:])
+        _render_pipeline(node, buckets)
+        return {"buckets": buckets, "sum_other_doc_count": sum_other,
+                "doc_count_error_upper_bound": 0}
+    if t in ("histogram", "date_histogram"):
+        merged = _merge_buckets(node, parts)
+        min_dc = int(node.params.get("min_doc_count",
+                                     1 if t == "date_histogram" else 0))
+        keys = sorted(merged)
+        buckets = [{"key": k, **_final_bucket(merged[k])} for k in keys
+                   if merged[k]["doc_count"] >= max(min_dc, 1) or min_dc == 0]
+        _render_pipeline(node, buckets)
+        return {"buckets": buckets}
+    if t in ("range", "date_range"):
+        merged = _merge_buckets(node, parts)
+        order = parts[0].get("keyed_order", list(merged)) if parts else []
+        buckets = [{"key": k, **_final_bucket(merged[k])} for k in order
+                   if k in merged]
+        return {"buckets": buckets}
+    raise QueryParsingError(f"cannot reduce aggregation type [{node.type}]")
+
+
+def _final_bucket(b: dict) -> dict:
+    out = {"doc_count": b["doc_count"]}
+    for extra in ("from", "to"):
+        if extra in b and b[extra] is not None:
+            out[extra] = b[extra]
+    if "aggs" in b:
+        out.update(b["aggs"])
+    if "pipeline" in b:
+        out.update(b["pipeline"])
+    return out

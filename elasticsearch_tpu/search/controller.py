@@ -1,0 +1,94 @@
+"""SearchPhaseController — cross-shard reduce at the coordinator.
+
+Reference: core/search/controller/SearchPhaseController.java —
+``sortDocs`` (:165, TopDocs.merge semantics), ``fillDocIdsToLoad`` (:289),
+final ``merge`` (:300-431) assembling hits + reducing aggregations.
+
+Shard results arrive as host arrays (k entries per shard); the merge is a
+numpy stable sort in shard order, reproducing the (score desc, shard index,
+position) merge order of the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from elasticsearch_tpu.search.aggregations import reduce_aggs
+from elasticsearch_tpu.search.phase import ParsedSearchRequest, ShardQueryResult
+
+
+@dataclass
+class MergedHitRef:
+    shard_idx: int      # position in the results list
+    position: int       # hit position within that shard's result
+    score: float | None
+    sort_values: list | None
+
+
+def sort_docs(results: list[ShardQueryResult],
+              req: ParsedSearchRequest) -> list[MergedHitRef]:
+    """Merge per-shard rankings → global [from, from+size) slice."""
+    refs: list[MergedHitRef] = []
+    for si, r in enumerate(results):
+        for pos in range(len(r.doc_ids)):
+            refs.append(MergedHitRef(
+                shard_idx=si, position=pos,
+                score=float(r.scores[pos]) if r.sort_values is None else None,
+                sort_values=r.sort_values[pos] if r.sort_values is not None
+                else None))
+    if not refs:
+        return []
+    if refs[0].sort_values is not None:
+        orders = [(list(spec.values())[0].get("order", "asc")) == "desc"
+                  for spec in req.sort]
+        def key(ref):
+            out = []
+            for v, desc in zip(ref.sort_values, orders):
+                v = float("inf") if v is None else v
+                out.append(-v if desc else v)
+            return out
+        refs.sort(key=lambda r: (key(r), r.shard_idx, r.position))
+    else:
+        # stable sort keeps (shard order, position) for ties — TopDocs.merge
+        refs.sort(key=lambda r: (-(r.score if r.score is not None else -np.inf),
+                                 r.shard_idx, r.position))
+    return refs[req.from_: req.from_ + req.size]
+
+
+def merge_responses(index_name: str, req: ParsedSearchRequest,
+                    results: list[ShardQueryResult], searchers,
+                    took_ms: float, agg_nodes) -> dict:
+    page = sort_docs(results, req)
+    # fetch phase only on shards owning winning docs (fillDocIdsToLoad)
+    by_shard: dict[int, list[int]] = {}
+    for ref in page:
+        by_shard.setdefault(ref.shard_idx, []).append(ref.position)
+    fetched: dict[tuple[int, int], dict] = {}
+    for si, positions in by_shard.items():
+        hits = searchers[si].fetch_phase(req, results[si], index_name, positions)
+        for pos, hit in zip(positions, hits):
+            fetched[(si, pos)] = hit
+    hits_out = [fetched[(ref.shard_idx, ref.position)] for ref in page]
+
+    total = sum(r.total for r in results)
+    max_scores = [r.max_score for r in results if r.max_score is not None]
+    max_score = max(max_scores) if max_scores and req.size > 0 and not req.sort \
+        else None
+
+    response = {
+        "took": int(took_ms),
+        "timed_out": False,
+        "_shards": {"total": len(results), "successful": len(results),
+                    "skipped": 0, "failed": 0},
+        "hits": {
+            "total": {"value": total, "relation": "eq"},
+            "max_score": max_score,
+            "hits": hits_out,
+        },
+    }
+    if agg_nodes:
+        response["aggregations"] = reduce_aggs(
+            agg_nodes, [r.agg_partials for r in results])
+    return response

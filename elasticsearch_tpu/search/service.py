@@ -1,0 +1,171 @@
+"""SearchService — per-index search orchestration + scroll contexts.
+
+Reference: core/search/SearchService.java — the stateful `activeContexts`
+registry keyed by context id (:533-558) with a keep-alive reaper (:1113),
+and the query/fetch phase entry points driven by the coordinator
+(TransportSearchTypeAction fan-out, §3.2 of SURVEY.md).
+
+Here the shard fan-out is a host loop over shard searchers (the distributed
+version runs the same phases under shard_map — parallel/distributed.py);
+scroll is implemented as search_after continuation: the context stores the
+request + last sort tuple, so each page is a fresh device query with a
+continuation mask — no long-lived per-shard cursors pinning memory (the
+TPU-friendly redesign of ScrollContext/MinDocQuery,
+core/search/query/QueryPhase.java:161-186).
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+import threading
+import time
+
+from elasticsearch_tpu.common.errors import SearchContextMissingError
+from elasticsearch_tpu.common.settings import parse_time_value
+from elasticsearch_tpu.index.device_reader import device_reader_for
+from elasticsearch_tpu.search.controller import merge_responses
+from elasticsearch_tpu.search.phase import (
+    ParsedSearchRequest, ShardSearcher, parse_search_request)
+
+
+class ScrollContext:
+    def __init__(self, index: str, body: dict, keep_alive_s: float):
+        self.index = index
+        self.body = dict(body)
+        self.keep_alive_s = keep_alive_s
+        self.expires_at = time.monotonic() + keep_alive_s
+        self.last_sort_key: list | None = None
+        self.finished = False
+
+    def touch(self, keep_alive_s: float | None = None):
+        if keep_alive_s is not None:
+            self.keep_alive_s = keep_alive_s
+        self.expires_at = time.monotonic() + self.keep_alive_s
+
+
+class SearchService:
+    def __init__(self):
+        self._contexts: dict[str, ScrollContext] = {}
+        self._ctx_ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- search
+
+    def _searchers(self, index) -> list[ShardSearcher]:
+        out = []
+        for shard_id, engine in enumerate(index.shard_engines):
+            reader = device_reader_for(engine)
+            out.append(ShardSearcher(shard_id, reader, index.mapper_service))
+        return out
+
+    def search(self, index, body: dict | None, scroll: str | None = None) -> dict:
+        t0 = time.perf_counter()
+        req = parse_search_request(body)
+        searchers = self._searchers(index)
+        results = [s.query_phase(req) for s in searchers]
+        resp = merge_responses(index.name, req, results, searchers,
+                               (time.perf_counter() - t0) * 1e3, req.aggs)
+        if scroll is not None:
+            resp["_scroll_id"] = self._open_scroll(index.name, body or {},
+                                                   scroll, resp, req)
+        return resp
+
+    def count(self, index, body: dict | None) -> dict:
+        body = dict(body or {})
+        body["size"] = 0
+        resp = self.search(index, body)
+        return {"count": resp["hits"]["total"]["value"],
+                "_shards": resp["_shards"]}
+
+    # ------------------------------------------------------------- scroll
+
+    def _open_scroll(self, index_name: str, body: dict, scroll: str,
+                     first_page: dict, req: ParsedSearchRequest) -> str:
+        keep = parse_time_value(scroll, "scroll")
+        ctx = ScrollContext(index_name, body, keep)
+        self._note_page(ctx, first_page, req)
+        with self._lock:
+            cid = f"ctx{next(self._ctx_ids)}"
+            self._contexts[cid] = ctx
+        return base64.b64encode(json.dumps({"id": cid}).encode()).decode()
+
+    def _note_page(self, ctx: ScrollContext, page: dict,
+                   req: ParsedSearchRequest):
+        hits = page["hits"]["hits"]
+        if not hits:
+            ctx.finished = True
+            return
+        last = hits[-1]
+        if req.sort:
+            ctx.last_sort_key = last.get("sort")
+        else:
+            # (score, global doc id) continuation; doc id recovered via the
+            # per-shard ordering — we use the score alone plus doc tiebreak
+            # carried in the response assembly
+            ctx.last_sort_key = [last["_score"], last.get("_shard_doc", -1)]
+
+    def scroll(self, indices_service, scroll_id: str,
+               scroll: str | None = None) -> dict:
+        try:
+            cid = json.loads(base64.b64decode(scroll_id))["id"]
+        except Exception:
+            raise SearchContextMissingError(f"invalid scroll id") from None
+        with self._lock:
+            ctx = self._contexts.get(cid)
+        if ctx is None or ctx.expires_at < time.monotonic():
+            self._contexts.pop(cid, None)
+            raise SearchContextMissingError(f"No search context found for id [{cid}]")
+        ctx.touch(parse_time_value(scroll, "scroll") if scroll else None)
+        index = indices_service.index(ctx.index)
+        if ctx.finished:
+            body = dict(ctx.body)
+            body["size"] = 0
+            resp = self.search(index, body)
+            resp["hits"]["hits"] = []
+            resp["_scroll_id"] = scroll_id
+            return resp
+        body = dict(ctx.body)
+        if ctx.last_sort_key is not None:
+            body["search_after"] = ctx.last_sort_key
+        body.setdefault("sort", [{"_doc": {"order": "asc"}}]
+                        if "sort" not in ctx.body and "query" not in ctx.body
+                        else ctx.body.get("sort", []))
+        # score-ordered scrolls continue via (score, doc) search_after;
+        # doc-ordered (_doc sort) scrolls via sort tuple
+        req = parse_search_request(body)
+        searchers = self._searchers(index)
+        t0 = time.perf_counter()
+        results = [s.query_phase(req) for s in searchers]
+        resp = merge_responses(index.name, req, results, searchers,
+                               (time.perf_counter() - t0) * 1e3, req.aggs)
+        self._note_page(ctx, resp, req)
+        resp["_scroll_id"] = scroll_id
+        return resp
+
+    def clear_scroll(self, scroll_id: str | None) -> int:
+        with self._lock:
+            if scroll_id is None:
+                n = len(self._contexts)
+                self._contexts.clear()
+                return n
+            try:
+                cid = json.loads(base64.b64decode(scroll_id))["id"]
+            except Exception:
+                return 0
+            return 1 if self._contexts.pop(cid, None) is not None else 0
+
+    def reap_expired(self) -> int:
+        """Keep-alive reaper (SearchService.java:1113)."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [cid for cid, c in self._contexts.items()
+                    if c.expires_at < now]
+            for cid in dead:
+                del self._contexts[cid]
+        return len(dead)
+
+    @property
+    def active_contexts(self) -> int:
+        return len(self._contexts)

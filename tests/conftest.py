@@ -8,7 +8,17 @@ so every sharding/collective path is exercised without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the container pre-sets JAX_PLATFORMS=axon (TPU tunnel), which is
+# slow to initialize and may be unavailable; tests always run on the virtual
+# CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+# The axon sitecustomize hook calls jax.config.update("jax_platforms",
+# "axon,cpu") at interpreter start, which OVERRIDES the env var and makes the
+# first backend init block on the TPU tunnel. Override it back at the config
+# level before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

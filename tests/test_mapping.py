@@ -54,7 +54,8 @@ class TestExplicitMapping:
         doc = svc.document_mapper().parse("1", {"t": ["one two", "three"]})
         positions = [t.position for t in doc.fields["t"].tokens]
         assert positions[0] == 0 and positions[1] == 1
-        assert positions[2] >= 100  # gap blocks phrases across array elements
+        # POSITION_INCREMENT_GAP blocks phrases across array elements
+        assert positions[2] >= 16
 
     def test_bad_vector_dims(self):
         svc = make_service({"properties": {"v": {"type": "dense_vector", "dims": 3}}})

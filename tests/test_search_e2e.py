@@ -1,0 +1,295 @@
+"""End-to-end search tests through the Node API: the reference's
+query-then-fetch path (SURVEY.md §3.2) against a live index."""
+
+import math
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+DOCS = [
+    {"title": "The quick brown fox", "body": "quick foxes jump over lazy dogs",
+     "tags": ["animal", "speed"], "views": 100, "price": 10.0,
+     "published": "2015-01-01T00:00:00Z"},
+    {"title": "Lazy dogs sleep", "body": "dogs sleep all day long",
+     "tags": ["animal"], "views": 50, "price": 20.0,
+     "published": "2015-06-01T00:00:00Z"},
+    {"title": "Quick sort algorithm", "body": "the quick sort algorithm is fast",
+     "tags": ["code"], "views": 500, "price": 5.0,
+     "published": "2016-01-01T00:00:00Z"},
+    {"title": "Brown bread recipe", "body": "bake quick brown bread",
+     "tags": ["food"], "views": 10, "price": 2.5,
+     "published": "2016-06-01T00:00:00Z"},
+]
+
+MAPPING = {"mappings": {"properties": {
+    "title": {"type": "text"},
+    "body": {"type": "text"},
+    "tags": {"type": "keyword"},
+    "views": {"type": "long"},
+    "price": {"type": "double"},
+    "published": {"type": "date"},
+}}, "settings": {"index": {"number_of_shards": 2}}}
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node(data_path=tmp_path_factory.mktemp("node")).start()
+    n.indices_service.create_index("articles", MAPPING)
+    for i, d in enumerate(DOCS):
+        n.index_doc("articles", str(i), d)
+    n.indices_service.index("articles").refresh()
+    yield n
+    n.close()
+
+
+def ids(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+class TestMatch:
+    def test_match_basic(self, node):
+        r = node.search("articles", {"query": {"match": {"body": "quick"}}})
+        assert set(ids(r)) == {"0", "2", "3"}
+        assert r["hits"]["total"]["value"] == 3
+        assert r["hits"]["hits"][0]["_score"] > 0
+        assert r["hits"]["hits"][0]["_source"]["title"]
+
+    def test_match_scoring_idf(self, node):
+        # "sleep" appears in 1 doc -> high idf; matching doc must rank first
+        r = node.search("articles",
+                        {"query": {"match": {"body": "dogs sleep"}}})
+        assert ids(r)[0] == "1"
+
+    def test_match_operator_and(self, node):
+        r = node.search("articles", {"query": {"match": {
+            "body": {"query": "quick dogs", "operator": "and"}}}})
+        assert set(ids(r)) == {"0"}
+
+    def test_match_all_and_none(self, node):
+        assert node.search("articles", {"query": {"match_all": {}}}
+                           )["hits"]["total"]["value"] == 4
+        assert node.search("articles", {"query": {"match_none": {}}}
+                           )["hits"]["total"]["value"] == 0
+
+    def test_match_phrase(self, node):
+        r = node.search("articles",
+                        {"query": {"match_phrase": {"title": "quick brown"}}})
+        assert ids(r) == ["0"]
+        r = node.search("articles",
+                        {"query": {"match_phrase": {"title": "brown quick"}}})
+        assert ids(r) == []
+
+    def test_multi_match(self, node):
+        r = node.search("articles", {"query": {"multi_match": {
+            "query": "quick", "fields": ["title^2", "body"]}}})
+        assert set(ids(r)) == {"0", "2", "3"}
+
+
+class TestStructured:
+    def test_term_keyword(self, node):
+        r = node.search("articles", {"query": {"term": {"tags": "code"}}})
+        assert ids(r) == ["2"]
+        assert r["hits"]["hits"][0]["_score"] == 1.0  # constant score
+
+    def test_terms(self, node):
+        r = node.search("articles",
+                        {"query": {"terms": {"tags": ["code", "food"]}}})
+        assert set(ids(r)) == {"2", "3"}
+
+    def test_range_numeric(self, node):
+        r = node.search("articles",
+                        {"query": {"range": {"views": {"gte": 50, "lte": 100}}}})
+        assert set(ids(r)) == {"0", "1"}
+        r = node.search("articles", {"query": {"range": {"views": {"gt": 50}}}})
+        assert set(ids(r)) == {"0", "2"}
+
+    def test_range_date(self, node):
+        r = node.search("articles", {"query": {"range": {
+            "published": {"gte": "2016-01-01"}}}})
+        assert set(ids(r)) == {"2", "3"}
+
+    def test_exists(self, node):
+        r = node.search("articles", {"query": {"exists": {"field": "views"}}})
+        assert r["hits"]["total"]["value"] == 4
+
+    def test_prefix_wildcard_fuzzy(self, node):
+        r = node.search("articles", {"query": {"prefix": {"tags": "ani"}}})
+        assert set(ids(r)) == {"0", "1"}
+        r = node.search("articles", {"query": {"wildcard": {"tags": "*oo*"}}})
+        assert set(ids(r)) == {"3"}
+        r = node.search("articles", {"query": {"fuzzy": {"body": "qick"}}})
+        assert "0" in ids(r)
+
+    def test_ids_query(self, node):
+        r = node.search("articles", {"query": {"ids": {"values": ["1", "3"]}}})
+        assert set(ids(r)) == {"1", "3"}
+
+
+class TestBool:
+    def test_bool_combo(self, node):
+        r = node.search("articles", {"query": {"bool": {
+            "must": [{"match": {"body": "quick"}}],
+            "filter": [{"range": {"views": {"gte": 50}}}],
+            "must_not": [{"term": {"tags": "code"}}],
+        }}})
+        assert ids(r) == ["0"]
+
+    def test_bool_should_msm(self, node):
+        r = node.search("articles", {"query": {"bool": {
+            "should": [{"match": {"body": "quick"}},
+                       {"match": {"body": "dogs"}},
+                       {"term": {"tags": "food"}}],
+            "minimum_should_match": 2,
+        }}})
+        assert set(ids(r)) == {"0", "3"}
+
+    def test_constant_score(self, node):
+        r = node.search("articles", {"query": {"constant_score": {
+            "filter": {"term": {"tags": "animal"}}, "boost": 3.0}}})
+        assert all(h["_score"] == 3.0 for h in r["hits"]["hits"])
+
+
+class TestPaginationAndSort:
+    def test_from_size(self, node):
+        full = node.search("articles", {"query": {"match_all": {}},
+                                        "sort": [{"views": "desc"}], "size": 10})
+        page = node.search("articles", {"query": {"match_all": {}},
+                                        "sort": [{"views": "desc"}],
+                                        "from": 1, "size": 2})
+        assert ids(page) == ids(full)[1:3]
+
+    def test_sort_field(self, node):
+        r = node.search("articles", {"query": {"match_all": {}},
+                                     "sort": [{"views": {"order": "desc"}}]})
+        assert ids(r) == ["2", "0", "1", "3"]
+        assert r["hits"]["hits"][0]["sort"] == [500]
+
+    def test_sort_asc(self, node):
+        r = node.search("articles", {"query": {"match_all": {}},
+                                     "sort": [{"price": "asc"}]})
+        assert ids(r) == ["3", "2", "0", "1"]
+
+    def test_search_after(self, node):
+        r1 = node.search("articles", {"query": {"match_all": {}},
+                                      "sort": [{"views": "desc"}], "size": 2})
+        after = r1["hits"]["hits"][-1]["sort"]
+        r2 = node.search("articles", {"query": {"match_all": {}},
+                                      "sort": [{"views": "desc"}],
+                                      "search_after": after, "size": 2})
+        assert ids(r1) + ids(r2) == ["2", "0", "1", "3"]
+
+
+class TestSourceFiltering:
+    def test_source_false(self, node):
+        r = node.search("articles", {"query": {"match_all": {}},
+                                     "_source": False})
+        assert "_source" not in r["hits"]["hits"][0]
+
+    def test_source_includes(self, node):
+        r = node.search("articles", {"query": {"match_all": {}},
+                                     "_source": ["title", "vi*"]})
+        src = r["hits"]["hits"][0]["_source"]
+        assert set(src) <= {"title", "views"}
+
+
+class TestFunctionScore:
+    def test_field_value_factor(self, node):
+        r = node.search("articles", {"query": {"function_score": {
+            "query": {"match_all": {}},
+            "field_value_factor": {"field": "views", "modifier": "log1p",
+                                   "factor": 1.0},
+            "boost_mode": "replace",
+        }}})
+        assert ids(r)[0] == "2"  # highest views
+        expect = math.log10(501.0)
+        assert r["hits"]["hits"][0]["_score"] == pytest.approx(expect, rel=1e-5)
+
+    def test_decay_gauss(self, node):
+        r = node.search("articles", {"query": {"function_score": {
+            "query": {"match_all": {}},
+            "functions": [{"gauss": {"views": {
+                "origin": 100, "scale": 50, "decay": 0.5}}}],
+            "boost_mode": "replace",
+        }}})
+        assert ids(r)[0] == "0"  # views == origin
+
+    def test_script_score_function(self, node):
+        r = node.search("articles", {"query": {"function_score": {
+            "query": {"match_all": {}},
+            "functions": [{"script_score": {"script":
+                           "doc['price'].value * 2"}}],
+            "boost_mode": "replace",
+        }}})
+        assert ids(r)[0] == "1"
+        assert r["hits"]["hits"][0]["_score"] == pytest.approx(40.0)
+
+    def test_weight_and_score_mode(self, node):
+        r = node.search("articles", {"query": {"function_score": {
+            "query": {"term": {"tags": "animal"}},
+            "functions": [{"weight": 5}, {"weight": 2}],
+            "score_mode": "sum", "boost_mode": "multiply",
+        }}})
+        assert all(h["_score"] == pytest.approx(7.0)
+                   for h in r["hits"]["hits"])
+
+
+class TestScriptScoreQuery:
+    def test_script_score(self, node):
+        r = node.search("articles", {"query": {"script_score": {
+            "query": {"match_all": {}},
+            "script": {"source": "_score + params.bonus / doc['price'].value",
+                       "params": {"bonus": 10.0}},
+        }}})
+        assert ids(r)[0] == "3"  # lowest price → biggest bonus
+
+
+class TestHighlightAndCount:
+    def test_highlight(self, node):
+        r = node.search("articles", {
+            "query": {"match": {"body": "quick"}},
+            "highlight": {"fields": {"body": {}}}})
+        h0 = r["hits"]["hits"][0]
+        assert any("<em>quick</em>" in f for f in h0["highlight"]["body"])
+
+    def test_count(self, node):
+        assert node.count("articles",
+                          {"query": {"match": {"body": "quick"}}})["count"] == 3
+
+
+class TestPostFilter:
+    def test_post_filter(self, node):
+        r = node.search("articles", {
+            "query": {"match": {"body": "quick"}},
+            "post_filter": {"term": {"tags": "food"}}})
+        # post_filter applies to hits and total; aggs (none here) see the
+        # pre-filter set (ES semantics)
+        assert r["hits"]["total"]["value"] == 1
+        assert ids(r) == ["3"]
+
+
+class TestQueryString:
+    def test_query_string(self, node):
+        r = node.search("articles", {"query": {"query_string": {
+            "query": "body:quick AND tags:food"}}})
+        assert ids(r) == ["3"]
+
+    def test_phrase_and_negation(self, node):
+        r = node.search("articles", {"query": {"query_string": {
+            "query": '"quick brown" -tags:food', "default_field": "title"}}})
+        assert ids(r) == ["0"]
+
+
+class TestMultiIndex:
+    def test_wildcard_index(self, node):
+        node.indices_service.create_index(
+            "articles2", {"mappings": {"properties": {
+                "body": {"type": "text"}}}})
+        node.index_doc("articles2", "x", {"body": "quick unique"})
+        node.indices_service.index("articles2").refresh()
+        r = node.search("articles*", {"query": {"match": {"body": "quick"}}})
+        assert len(ids(r)) == 4
+        indices = {h["_index"] for h in r["hits"]["hits"]}
+        assert indices == {"articles", "articles2"}
+        node.indices_service.delete_index("articles2")

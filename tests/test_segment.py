@@ -42,7 +42,6 @@ class TestTextColumns:
         # positional view
         assert col.tokens[0, :4].tolist() == [
             tid["quick"], tid["brown"], tid["fox"], tid["fox"]]
-        assert col.positions[0, :4].tolist() == [0, 1, 2, 3]
         assert col.tokens[0, 4] == -1  # padding
         # unique view: fox has tf=2
         row0 = {int(t): float(f) for t, f in zip(col.uterms[0], col.utf[0])
