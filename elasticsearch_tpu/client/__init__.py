@@ -1,0 +1,215 @@
+"""Python client API.
+
+Two flavors mirroring the reference's Client hierarchy
+(core/client/Client.java, support/AbstractClient.java):
+
+* :class:`NodeClient` — in-process, wraps a Node directly (the reference's
+  NodeClient path used by REST handlers);
+* :class:`HttpClient` — remote, speaks the REST API over HTTP (the
+  TransportClient analog for external processes; stdlib-only).
+
+Both expose the same method surface: index/get/delete/update/bulk/search/
+count/scroll plus an ``indices`` namespace — shaped like the official
+elasticsearch-py client so existing call sites port mechanically.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+import urllib.error
+from typing import Any
+
+from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+
+
+class _IndicesNamespace:
+    def __init__(self, client):
+        self._c = client
+
+    def create(self, index: str, body: dict | None = None, **kw):
+        return self._c._request("PUT", f"/{index}", body)
+
+    def delete(self, index: str, **kw):
+        return self._c._request("DELETE", f"/{index}")
+
+    def exists(self, index: str, **kw) -> bool:
+        try:
+            self._c._request("HEAD", f"/{index}")
+            return True
+        except ElasticsearchTpuError:
+            return False
+
+    def refresh(self, index: str = "_all", **kw):
+        return self._c._request("POST", f"/{index}/_refresh")
+
+    def flush(self, index: str = "_all", **kw):
+        return self._c._request("POST", f"/{index}/_flush")
+
+    def forcemerge(self, index: str = "_all", max_num_segments: int = 1, **kw):
+        return self._c._request(
+            "POST", f"/{index}/_forcemerge?max_num_segments={max_num_segments}")
+
+    def get_mapping(self, index: str, **kw):
+        return self._c._request("GET", f"/{index}/_mapping")
+
+    def put_mapping(self, index: str, body: dict, **kw):
+        return self._c._request("PUT", f"/{index}/_mapping", body)
+
+    def put_alias(self, index: str, name: str, body: dict | None = None, **kw):
+        return self._c._request("PUT", f"/{index}/_alias/{name}", body)
+
+    def put_template(self, name: str, body: dict, **kw):
+        return self._c._request("PUT", f"/_template/{name}", body)
+
+    def stats(self, index: str = "_all", **kw):
+        return self._c._request("GET", f"/{index}/_stats")
+
+    def analyze(self, index: str | None = None, body: dict | None = None, **kw):
+        path = f"/{index}/_analyze" if index else "/_analyze"
+        return self._c._request("POST", path, body)
+
+
+class _BaseClient:
+    def __init__(self):
+        self.indices = _IndicesNamespace(self)
+
+    # ---- documents --------------------------------------------------------
+
+    def index(self, index: str, body: dict, id: str | None = None,
+              routing: str | None = None, refresh: bool = False, **kw):
+        qs = _qs(routing=routing, refresh=refresh or None)
+        if id is not None:
+            return self._request("PUT", f"/{index}/_doc/{id}{qs}", body)
+        return self._request("POST", f"/{index}/_doc{qs}", body)
+
+    def get(self, index: str, id: str, **kw):
+        return self._request("GET", f"/{index}/_doc/{id}")
+
+    def exists(self, index: str, id: str, **kw) -> bool:
+        try:
+            r = self._request("GET", f"/{index}/_doc/{id}")
+            return bool(r.get("found"))
+        except ElasticsearchTpuError:
+            return False
+
+    def delete(self, index: str, id: str, refresh: bool = False, **kw):
+        return self._request("DELETE",
+                             f"/{index}/_doc/{id}{_qs(refresh=refresh or None)}")
+
+    def update(self, index: str, id: str, body: dict,
+               refresh: bool = False, **kw):
+        return self._request("POST",
+                             f"/{index}/_update/{id}{_qs(refresh=refresh or None)}",
+                             body)
+
+    def mget(self, body: dict, index: str | None = None, **kw):
+        path = f"/{index}/_mget" if index else "/_mget"
+        return self._request("POST", path, body)
+
+    def bulk(self, operations: list[dict] | str, index: str | None = None,
+             refresh: bool = False, **kw):
+        """operations: NDJSON string or list of action/source dicts."""
+        if isinstance(operations, list):
+            nd = "\n".join(json.dumps(o) for o in operations) + "\n"
+        else:
+            nd = operations
+        path = f"/{index}/_bulk" if index else "/_bulk"
+        return self._request("POST", f"{path}{_qs(refresh=refresh or None)}",
+                             raw_body=nd.encode("utf-8"))
+
+    # ---- search -----------------------------------------------------------
+
+    def search(self, index: str = "_all", body: dict | None = None,
+               scroll: str | None = None, **kw):
+        return self._request("POST", f"/{index}/_search{_qs(scroll=scroll)}",
+                             body)
+
+    def count(self, index: str = "_all", body: dict | None = None, **kw):
+        return self._request("POST", f"/{index}/_count", body)
+
+    def scroll(self, scroll_id: str, scroll: str | None = None, **kw):
+        return self._request("POST", "/_search/scroll",
+                             {"scroll_id": scroll_id,
+                              **({"scroll": scroll} if scroll else {})})
+
+    def clear_scroll(self, scroll_id: str | None = None, **kw):
+        return self._request("DELETE", "/_search/scroll",
+                             {"scroll_id": scroll_id} if scroll_id else {})
+
+    # ---- cluster ----------------------------------------------------------
+
+    def info(self):
+        return self._request("GET", "/")
+
+    def cluster_health(self):
+        return self._request("GET", "/_cluster/health")
+
+    def cat_indices(self, v: bool = True) -> str:
+        return self._request("GET", f"/_cat/indices{_qs(v='' if v else None)}")
+
+
+def _qs(**params) -> str:
+    parts = [f"{k}={v}" for k, v in params.items() if v is not None]
+    return ("?" + "&".join(parts)) if parts else ""
+
+
+class NodeClient(_BaseClient):
+    """In-process client: dispatches through the same RestController the
+    HTTP server uses, so behavior is identical to the wire API."""
+
+    def __init__(self, node):
+        super().__init__()
+        from elasticsearch_tpu.rest.controller import RestController
+        from elasticsearch_tpu.rest.handlers import register_all
+        self._controller = RestController()
+        register_all(self._controller, node)
+
+    def _request(self, method: str, path: str, body: Any = None,
+                 raw_body: bytes | None = None):
+        data = raw_body if raw_body is not None else (
+            json.dumps(body).encode() if body is not None else b"")
+        status, payload = self._controller.dispatch(method, path, data)
+        if status >= 400 and not (method == "GET" and status == 404
+                                  and isinstance(payload, dict)
+                                  and "found" in payload):
+            err = ElasticsearchTpuError(
+                payload.get("error", {}).get("reason", str(payload))
+                if isinstance(payload, dict) else str(payload))
+            err.status = status
+            raise err
+        return payload
+
+
+class HttpClient(_BaseClient):
+    def __init__(self, host: str = "127.0.0.1", port: int = 9200):
+        super().__init__()
+        self.base = f"http://{host}:{port}"
+
+    def _request(self, method: str, path: str, body: Any = None,
+                 raw_body: bytes | None = None):
+        data = raw_body if raw_body is not None else (
+            json.dumps(body).encode() if body is not None else None)
+        req = urllib.request.Request(
+            self.base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                payload = resp.read()
+                ctype = resp.headers.get("Content-Type", "")
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            try:
+                parsed = json.loads(payload)
+            except json.JSONDecodeError:
+                parsed = {"error": payload.decode("utf-8", "replace")}
+            if e.code == 404 and isinstance(parsed, dict) and "found" in parsed:
+                return parsed
+            err = ElasticsearchTpuError(
+                parsed.get("error", {}).get("reason", str(parsed))
+                if isinstance(parsed.get("error"), dict) else str(parsed))
+            err.status = e.code
+            raise err from None
+        if ctype.startswith("text/plain"):
+            return payload.decode("utf-8")
+        return json.loads(payload) if payload else {}

@@ -1,0 +1,4 @@
+from elasticsearch_tpu.rest.controller import RestController
+from elasticsearch_tpu.rest.server import RestServer
+
+__all__ = ["RestController", "RestServer"]

@@ -1,0 +1,121 @@
+"""RestController — method+path-trie dispatch.
+
+Reference: core/rest/RestController.java:46-47,166 — one PathTrie per HTTP
+method, `{param}` segments, handlers receive (request, params). Errors
+serialize to the ES error body shape with the exception's REST status.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Callable
+from urllib.parse import parse_qs, urlparse
+
+from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+
+
+@dataclass
+class RestRequest:
+    method: str
+    path: str
+    params: dict[str, str]           # query-string params
+    path_params: dict[str, str]      # extracted {param} segments
+    body: Any = None                 # parsed JSON (or raw str for NDJSON)
+    raw_body: bytes = b""
+
+    def param(self, name: str, default: str | None = None) -> str | None:
+        return self.path_params.get(name, self.params.get(name, default))
+
+    def param_as_bool(self, name: str, default: bool = False) -> bool:
+        v = self.param(name)
+        if v is None:
+            return default
+        return str(v).lower() in ("", "true", "1", "on", "yes")
+
+    def param_as_int(self, name: str, default: int) -> int:
+        v = self.param(name)
+        return default if v in (None, "") else int(v)
+
+
+class _TrieNode:
+    __slots__ = ("children", "param_child", "param_name", "handler")
+
+    def __init__(self):
+        self.children: dict[str, _TrieNode] = {}
+        self.param_child: _TrieNode | None = None
+        self.param_name: str | None = None
+        self.handler: Callable | None = None
+
+
+class RestController:
+    def __init__(self):
+        self._tries: dict[str, _TrieNode] = {}
+
+    def register(self, method: str, pattern: str, handler: Callable) -> None:
+        """pattern e.g. '/{index}/_doc/{id}'."""
+        root = self._tries.setdefault(method.upper(), _TrieNode())
+        node = root
+        for seg in [s for s in pattern.split("/") if s]:
+            if seg.startswith("{") and seg.endswith("}"):
+                if node.param_child is None:
+                    node.param_child = _TrieNode()
+                    node.param_name = seg[1:-1]
+                node = node.param_child
+            else:
+                node = node.children.setdefault(seg, _TrieNode())
+        node.handler = handler
+
+    def resolve(self, method: str, path: str):
+        root = self._tries.get(method.upper())
+        if root is None:
+            return None, {}
+        segs = [s for s in path.split("/") if s]
+
+        def walk(node: _TrieNode, i: int, params: dict):
+            if i == len(segs):
+                return (node.handler, params) if node.handler else None
+            seg = segs[i]
+            child = node.children.get(seg)
+            if child is not None:
+                found = walk(child, i + 1, params)
+                if found:
+                    return found
+            if node.param_child is not None:
+                found = walk(node.param_child, i + 1,
+                             {**params, node.param_name: seg})
+                if found:
+                    return found
+            return None
+
+        found = walk(root, 0, {})
+        return found if found else (None, {})
+
+    def dispatch(self, method: str, uri: str, body: bytes) -> tuple[int, Any]:
+        """→ (status, response_body_object)."""
+        parsed = urlparse(uri)
+        qs = {k: v[-1] for k, v in parse_qs(parsed.query,
+                                            keep_blank_values=True).items()}
+        handler, path_params = self.resolve(method, parsed.path)
+        if handler is None and method == "HEAD":
+            handler, path_params = self.resolve("GET", parsed.path)
+        if handler is None:
+            return 400, {"error": f"no handler found for uri [{uri}] and "
+                                  f"method [{method}]"}
+        req = RestRequest(method=method, path=parsed.path, params=qs,
+                          path_params=path_params, raw_body=body)
+        if body:
+            try:
+                req.body = json.loads(body)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                req.body = None  # NDJSON handlers read raw_body
+        try:
+            return handler(req)
+        except ElasticsearchTpuError as e:
+            return e.status, {"error": {"root_cause": [e.to_xcontent()],
+                                        **e.to_xcontent()},
+                              "status": e.status}
+        except Exception as e:  # noqa: BLE001 — REST boundary
+            return 500, {"error": {"type": "exception", "reason": str(e)},
+                         "status": 500}

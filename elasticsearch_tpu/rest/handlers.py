@@ -1,0 +1,618 @@
+"""REST handlers — the ES-compatible API surface.
+
+Reference: core/rest/action/ (~125 handlers) + the rest-api-spec JSON specs.
+Each handler maps URL/params/body onto node actions and returns the ES
+response shape. The `_cat` family renders text tables
+(core/rest/action/cat/RestCatAction.java + 16 actions).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from elasticsearch_tpu import __version__
+from elasticsearch_tpu.common.errors import IndexNotFoundError
+from elasticsearch_tpu.rest.controller import RestController, RestRequest
+
+
+def register_all(rc: RestController, node) -> None:
+    h = Handlers(node)
+    r = rc.register
+    # root / ping
+    r("GET", "/", h.root)
+    # index CRUD
+    r("PUT", "/{index}", h.create_index)
+    r("DELETE", "/{index}", h.delete_index)
+    r("GET", "/{index}", h.get_index)
+    r("HEAD", "/{index}", h.head_index)
+    r("POST", "/{index}/_refresh", h.refresh)
+    r("GET", "/{index}/_refresh", h.refresh)
+    r("POST", "/_refresh", h.refresh_all)
+    r("POST", "/{index}/_flush", h.flush)
+    r("POST", "/_flush", h.flush_all)
+    r("POST", "/{index}/_forcemerge", h.force_merge)
+    r("POST", "/{index}/_optimize", h.force_merge)   # ES 2.x name
+    r("POST", "/{index}/_open", h.open_index)
+    r("POST", "/{index}/_close", h.close_index)
+    # mappings & settings
+    r("PUT", "/{index}/_mapping", h.put_mapping)
+    r("PUT", "/{index}/_mappings", h.put_mapping)
+    r("PUT", "/{index}/_mapping/{type}", h.put_mapping)
+    r("GET", "/{index}/_mapping", h.get_mapping)
+    r("GET", "/_mapping", h.get_all_mappings)
+    r("GET", "/{index}/_settings", h.get_settings)
+    # aliases
+    r("POST", "/_aliases", h.update_aliases)
+    r("PUT", "/{index}/_alias/{name}", h.put_alias)
+    r("DELETE", "/{index}/_alias/{name}", h.delete_alias)
+    r("GET", "/_alias", h.get_aliases)
+    r("GET", "/{index}/_alias", h.get_aliases)
+    # templates
+    r("PUT", "/_template/{name}", h.put_template)
+    r("GET", "/_template/{name}", h.get_template)
+    r("GET", "/_template", h.get_templates)
+    r("DELETE", "/_template/{name}", h.delete_template)
+    # documents (modern _doc + ES 2.x /{index}/{type}/{id})
+    for doc_seg in ("_doc", "{type}"):
+        r("PUT", f"/{{index}}/{doc_seg}/{{id}}", h.index_doc)
+        r("POST", f"/{{index}}/{doc_seg}/{{id}}", h.index_doc)
+        r("POST", f"/{{index}}/{doc_seg}", h.index_doc_auto_id)
+        r("GET", f"/{{index}}/{doc_seg}/{{id}}", h.get_doc)
+        r("HEAD", f"/{{index}}/{doc_seg}/{{id}}", h.get_doc)
+        r("DELETE", f"/{{index}}/{doc_seg}/{{id}}", h.delete_doc)
+        r("GET", f"/{{index}}/{doc_seg}/{{id}}/_source", h.get_source)
+        r("POST", f"/{{index}}/{doc_seg}/{{id}}/_update", h.update_doc)
+    r("POST", "/{index}/_update/{id}", h.update_doc)
+    r("POST", "/{index}/_create/{id}", h.create_doc)
+    r("PUT", "/{index}/_create/{id}", h.create_doc)
+    # bulk & mget
+    r("POST", "/_bulk", h.bulk)
+    r("PUT", "/_bulk", h.bulk)
+    r("POST", "/{index}/_bulk", h.bulk)
+    r("POST", "/_mget", h.mget)
+    r("GET", "/_mget", h.mget)
+    r("POST", "/{index}/_mget", h.mget)
+    # search family
+    r("GET", "/_search", h.search_all)
+    r("POST", "/_search", h.search_all)
+    r("GET", "/{index}/_search", h.search)
+    r("POST", "/{index}/_search", h.search)
+    r("GET", "/{index}/_count", h.count)
+    r("POST", "/{index}/_count", h.count)
+    r("GET", "/_count", h.count_all)
+    r("POST", "/_search/scroll", h.scroll)
+    r("GET", "/_search/scroll", h.scroll)
+    r("DELETE", "/_search/scroll", h.clear_scroll)
+    r("POST", "/{index}/_validate/query", h.validate_query)
+    r("GET", "/{index}/_validate/query", h.validate_query)
+    r("POST", "/{index}/_analyze", h.analyze)
+    r("GET", "/{index}/_analyze", h.analyze)
+    r("POST", "/_analyze", h.analyze)
+    r("GET", "/_analyze", h.analyze)
+    # cluster & stats
+    r("GET", "/_cluster/health", h.cluster_health)
+    r("GET", "/_cluster/state", h.cluster_state)
+    r("GET", "/_cluster/stats", h.cluster_stats)
+    r("GET", "/_cluster/settings", h.cluster_settings)
+    r("PUT", "/_cluster/settings", h.put_cluster_settings)
+    r("GET", "/_nodes", h.nodes_info)
+    r("GET", "/_nodes/stats", h.nodes_stats)
+    r("GET", "/_stats", h.all_stats)
+    r("GET", "/{index}/_stats", h.index_stats)
+    # _cat
+    r("GET", "/_cat", h.cat_help)
+    r("GET", "/_cat/indices", h.cat_indices)
+    r("GET", "/_cat/health", h.cat_health)
+    r("GET", "/_cat/count", h.cat_count)
+    r("GET", "/_cat/count/{index}", h.cat_count)
+    r("GET", "/_cat/shards", h.cat_shards)
+    r("GET", "/_cat/nodes", h.cat_nodes)
+    r("GET", "/_cat/master", h.cat_master)
+    r("GET", "/_cat/aliases", h.cat_aliases)
+
+
+class Handlers:
+    def __init__(self, node):
+        self.node = node
+
+    # ---- root -------------------------------------------------------------
+
+    def root(self, req: RestRequest):
+        return 200, {
+            "name": self.node.node_name,
+            "cluster_name": self.node.cluster_service.state().cluster_name,
+            "version": {"number": __version__,
+                        "build_flavor": "tpu",
+                        "lucene_version": "none — jax/xla columnar engine"},
+            "tagline": "You Know, for Search",
+        }
+
+    # ---- index CRUD -------------------------------------------------------
+
+    def create_index(self, req: RestRequest):
+        name = req.path_params["index"]
+        self.node.indices_service.create_index(name, req.body or {})
+        return 200, {"acknowledged": True, "shards_acknowledged": True,
+                     "index": name}
+
+    def delete_index(self, req: RestRequest):
+        self.node.indices_service.delete_index(req.path_params["index"])
+        return 200, {"acknowledged": True}
+
+    def get_index(self, req: RestRequest):
+        names = self.node.indices_service.resolve(req.path_params["index"])
+        state = self.node.cluster_service.state()
+        return 200, {n: state.indices[n].to_dict() for n in names}
+
+    def head_index(self, req: RestRequest):
+        if self.node.indices_service.has_index(req.path_params["index"]):
+            return 200, {}
+        return 404, {}
+
+    def refresh(self, req: RestRequest):
+        for n in self.node.indices_service.resolve(req.path_params["index"]):
+            self.node.indices_service.index(n).refresh()
+        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def refresh_all(self, req: RestRequest):
+        for svc in self.node.indices_service.indices.values():
+            svc.refresh()
+        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def flush(self, req: RestRequest):
+        for n in self.node.indices_service.resolve(req.path_params["index"]):
+            self.node.indices_service.index(n).flush()
+        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def flush_all(self, req: RestRequest):
+        for svc in self.node.indices_service.indices.values():
+            svc.flush()
+        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def force_merge(self, req: RestRequest):
+        max_seg = req.param_as_int("max_num_segments", 1)
+        for n in self.node.indices_service.resolve(req.path_params["index"]):
+            self.node.indices_service.index(n).force_merge(max_seg)
+        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def open_index(self, req: RestRequest):
+        return 200, {"acknowledged": True}
+
+    def close_index(self, req: RestRequest):
+        return 200, {"acknowledged": True}
+
+    # ---- mappings / settings ----------------------------------------------
+
+    def put_mapping(self, req: RestRequest):
+        tname = req.path_params.get("type", "_doc")
+        body = req.body or {}
+        if tname in body:            # ES 2.x nests under the type name
+            body = body[tname]
+        for n in self.node.indices_service.resolve(req.path_params["index"]):
+            self.node.indices_service.put_mapping(n, tname, body)
+        return 200, {"acknowledged": True}
+
+    def get_mapping(self, req: RestRequest):
+        out = {}
+        for n in self.node.indices_service.resolve(req.path_params["index"]):
+            svc = self.node.indices_service.index(n)
+            out[n] = {"mappings": svc.mapper_service.mapping_dict()}
+        return 200, out
+
+    def get_all_mappings(self, req: RestRequest):
+        out = {}
+        for n, svc in self.node.indices_service.indices.items():
+            out[n] = {"mappings": svc.mapper_service.mapping_dict()}
+        return 200, out
+
+    def get_settings(self, req: RestRequest):
+        state = self.node.cluster_service.state()
+        out = {}
+        for n in self.node.indices_service.resolve(req.path_params["index"]):
+            out[n] = {"settings": state.indices[n].to_dict()["settings"]}
+        return 200, out
+
+    # ---- aliases ----------------------------------------------------------
+
+    def update_aliases(self, req: RestRequest):
+        for action in (req.body or {}).get("actions", []):
+            (verb, spec), = action.items()
+            indices = spec.get("indices", [spec.get("index")])
+            aliases = spec.get("aliases", [spec.get("alias")])
+            if isinstance(aliases, str):
+                aliases = [aliases]
+            for idx in indices:
+                for alias in aliases:
+                    if verb == "add":
+                        self.node.indices_service.put_alias(
+                            idx, alias, {k: v for k, v in spec.items()
+                                         if k in ("filter", "routing")})
+                    elif verb == "remove":
+                        self.node.indices_service.delete_alias(idx, alias)
+        return 200, {"acknowledged": True}
+
+    def put_alias(self, req: RestRequest):
+        self.node.indices_service.put_alias(
+            req.path_params["index"], req.path_params["name"], req.body)
+        return 200, {"acknowledged": True}
+
+    def delete_alias(self, req: RestRequest):
+        self.node.indices_service.delete_alias(
+            req.path_params["index"], req.path_params["name"])
+        return 200, {"acknowledged": True}
+
+    def get_aliases(self, req: RestRequest):
+        state = self.node.cluster_service.state()
+        names = self.node.indices_service.resolve(
+            req.path_params.get("index", "_all"))
+        return 200, {n: {"aliases": state.indices[n].aliases} for n in names}
+
+    # ---- templates --------------------------------------------------------
+
+    def put_template(self, req: RestRequest):
+        name = req.path_params["name"]
+        body = req.body or {}
+
+        def update(state):
+            return state.with_(templates={**state.templates, name: body})
+        self.node.cluster_service.submit_state_update(
+            f"put-template [{name}]", update)
+        return 200, {"acknowledged": True}
+
+    def get_template(self, req: RestRequest):
+        name = req.path_params["name"]
+        templates = self.node.cluster_service.state().templates
+        if name not in templates:
+            return 404, {}
+        return 200, {name: templates[name]}
+
+    def get_templates(self, req: RestRequest):
+        return 200, self.node.cluster_service.state().templates
+
+    def delete_template(self, req: RestRequest):
+        name = req.path_params["name"]
+
+        def update(state):
+            t = {k: v for k, v in state.templates.items() if k != name}
+            return state.with_(templates=t)
+        self.node.cluster_service.submit_state_update(
+            f"delete-template [{name}]", update)
+        return 200, {"acknowledged": True}
+
+    # ---- documents --------------------------------------------------------
+
+    def index_doc(self, req: RestRequest):
+        version = req.param("version")
+        resp = self.node.index_doc(
+            req.path_params["index"], req.path_params["id"], req.body or {},
+            routing=req.param("routing"),
+            version=int(version) if version else None,
+            op_type="create" if req.param("op_type") == "create" else "index",
+            refresh=req.param_as_bool("refresh"))
+        return (201 if resp["created"] else 200), resp
+
+    def index_doc_auto_id(self, req: RestRequest):
+        resp = self.node.index_doc(
+            req.path_params["index"], None, req.body or {},
+            routing=req.param("routing"),
+            refresh=req.param_as_bool("refresh"))
+        return 201, resp
+
+    def create_doc(self, req: RestRequest):
+        resp = self.node.index_doc(
+            req.path_params["index"], req.path_params["id"], req.body or {},
+            routing=req.param("routing"), op_type="create",
+            refresh=req.param_as_bool("refresh"))
+        return 201, resp
+
+    def get_doc(self, req: RestRequest):
+        resp = self.node.get_doc(req.path_params["index"],
+                                 req.path_params["id"],
+                                 routing=req.param("routing"))
+        return (200 if resp["found"] else 404), resp
+
+    def get_source(self, req: RestRequest):
+        resp = self.node.get_doc(req.path_params["index"],
+                                 req.path_params["id"],
+                                 routing=req.param("routing"))
+        if not resp["found"]:
+            return 404, {}
+        return 200, resp["_source"]
+
+    def delete_doc(self, req: RestRequest):
+        resp = self.node.delete_doc(req.path_params["index"],
+                                    req.path_params["id"],
+                                    routing=req.param("routing"),
+                                    refresh=req.param_as_bool("refresh"))
+        return 200, resp
+
+    def update_doc(self, req: RestRequest):
+        resp = self.node.update_doc(req.path_params["index"],
+                                    req.path_params["id"], req.body or {},
+                                    routing=req.param("routing"),
+                                    refresh=req.param_as_bool("refresh"))
+        return 200, resp
+
+    def mget(self, req: RestRequest):
+        return 200, self.node.mget(req.body or {},
+                                   req.path_params.get("index"))
+
+    # ---- bulk -------------------------------------------------------------
+
+    def bulk(self, req: RestRequest):
+        default_index = req.path_params.get("index")
+        ops = []
+        lines = req.raw_body.decode("utf-8").splitlines()
+        i = 0
+        while i < len(lines):
+            line = lines[i].strip()
+            i += 1
+            if not line:
+                continue
+            action_line = json.loads(line)
+            (action, meta), = action_line.items()
+            meta = dict(meta or {})
+            meta.setdefault("_index", default_index)
+            source = None
+            if action in ("index", "create", "update"):
+                source = json.loads(lines[i])
+                i += 1
+            ops.append((action, meta, source))
+        resp = self.node.bulk(ops, refresh=req.param_as_bool("refresh"))
+        return 200, resp
+
+    # ---- search -----------------------------------------------------------
+
+    def _search_body(self, req: RestRequest) -> dict:
+        body = dict(req.body or {})
+        if req.param("q"):
+            body["query"] = {"query_string": {"query": req.param("q")}}
+        for p in ("from", "size"):
+            if req.param(p) is not None:
+                body[p] = int(req.param(p))
+        if req.param("sort"):
+            body["sort"] = [
+                {s.split(":")[0]: {"order": (s.split(":") + ["asc"])[1]}}
+                for s in req.param("sort").split(",")]
+        if req.param("_source") in ("false", "true"):
+            body["_source"] = req.param("_source") == "true"
+        return body
+
+    def search(self, req: RestRequest):
+        resp = self.node.search(req.path_params["index"],
+                                self._search_body(req),
+                                scroll=req.param("scroll"))
+        return 200, resp
+
+    def search_all(self, req: RestRequest):
+        if not self.node.indices_service.indices:
+            return 200, {"took": 0, "timed_out": False,
+                         "_shards": {"total": 0, "successful": 0, "failed": 0},
+                         "hits": {"total": {"value": 0, "relation": "eq"},
+                                  "max_score": None, "hits": []}}
+        resp = self.node.search("_all", self._search_body(req),
+                                scroll=req.param("scroll"))
+        return 200, resp
+
+    def count(self, req: RestRequest):
+        return 200, self.node.count(req.path_params["index"],
+                                    self._search_body(req))
+
+    def count_all(self, req: RestRequest):
+        return 200, self.node.count("_all", self._search_body(req))
+
+    def scroll(self, req: RestRequest):
+        body = req.body or {}
+        scroll_id = body.get("scroll_id", req.param("scroll_id"))
+        return 200, self.node.search_service.scroll(
+            self.node.indices_service, scroll_id, body.get("scroll"))
+
+    def clear_scroll(self, req: RestRequest):
+        body = req.body or {}
+        sid = body.get("scroll_id")
+        if isinstance(sid, list):
+            n = sum(self.node.search_service.clear_scroll(s) for s in sid)
+        else:
+            n = self.node.search_service.clear_scroll(sid)
+        return 200, {"succeeded": True, "num_freed": n}
+
+    def validate_query(self, req: RestRequest):
+        from elasticsearch_tpu.search.query_dsl import parse_query
+        from elasticsearch_tpu.common.errors import QueryParsingError
+        body = self._search_body(req)
+        try:
+            parse_query(body.get("query"))
+            valid = True
+            error = None
+        except QueryParsingError as e:
+            valid = False
+            error = e.message
+        out = {"valid": valid,
+               "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if error and req.param_as_bool("explain"):
+            out["explanations"] = [{"index": req.path_params.get("index"),
+                                    "valid": False, "error": error}]
+        return 200, out
+
+    def analyze(self, req: RestRequest):
+        body = req.body or {}
+        text = body.get("text", req.param("text", ""))
+        texts = text if isinstance(text, list) else [text]
+        analyzer_name = body.get("analyzer", req.param("analyzer"))
+        field = body.get("field", req.param("field"))
+        index = req.path_params.get("index")
+        if index and field:
+            svc = self.node.indices_service.index(index)
+            fm = svc.mapper_service.field_mapper(field)
+            analyzer = fm.analyzer if fm is not None and \
+                getattr(fm, "kind", None) == "text" \
+                else svc.mapper_service.analysis.get("standard")
+        elif index and analyzer_name:
+            analyzer = self.node.indices_service.index(index) \
+                .mapper_service.analysis.get(analyzer_name)
+        else:
+            from elasticsearch_tpu.analysis.analyzers import BUILTIN_ANALYZERS
+            analyzer = BUILTIN_ANALYZERS[analyzer_name or "standard"]
+        tokens = []
+        for t in texts:
+            for tok in analyzer.analyze(str(t)):
+                tokens.append({"token": tok.term,
+                               "start_offset": tok.start_offset,
+                               "end_offset": tok.end_offset,
+                               "type": "<ALPHANUM>",
+                               "position": tok.position})
+        return 200, {"tokens": tokens}
+
+    # ---- cluster / stats ---------------------------------------------------
+
+    def cluster_health(self, req: RestRequest):
+        return 200, self.node.cluster_service.state().health()
+
+    def cluster_state(self, req: RestRequest):
+        state = self.node.cluster_service.state()
+        return 200, {
+            "cluster_name": state.cluster_name,
+            "version": state.version,
+            "master_node": state.master_node_id,
+            "nodes": state.nodes,
+            "metadata": {"indices": {n: m.to_dict()
+                                     for n, m in state.indices.items()},
+                         "templates": state.templates},
+            "routing_table": {"indices": {
+                n: {"shards": {str(s.shard): [{
+                    "state": s.state.value, "primary": s.primary,
+                    "node": s.node_id, "shard": s.shard, "index": s.index}]
+                    for s in state.routing_table.index_shards(n)}}
+                for n in state.indices}},
+        }
+
+    def cluster_stats(self, req: RestRequest):
+        total_docs = sum(svc.num_docs()
+                         for svc in self.node.indices_service.indices.values())
+        return 200, {
+            "cluster_name": self.node.cluster_service.state().cluster_name,
+            "indices": {"count": len(self.node.indices_service.indices),
+                        "docs": {"count": total_docs}},
+            "nodes": {"count": {"total": 1, "data": 1, "master": 1}},
+        }
+
+    def cluster_settings(self, req: RestRequest):
+        return 200, {"persistent": {}, "transient": {}}
+
+    def put_cluster_settings(self, req: RestRequest):
+        return 200, {"acknowledged": True, "persistent": {}, "transient": {}}
+
+    def nodes_info(self, req: RestRequest):
+        state = self.node.cluster_service.state()
+        return 200, {"cluster_name": state.cluster_name, "nodes": {
+            self.node.node_id: {"name": self.node.node_name,
+                                "version": __version__,
+                                "roles": ["master", "data", "ingest"]}}}
+
+    def nodes_stats(self, req: RestRequest):
+        indices_stats = {}
+        total_docs = 0
+        for name, svc in self.node.indices_service.indices.items():
+            s = svc.stats()
+            total_docs += s["docs"]["count"]
+        return 200, {"nodes": {self.node.node_id: {
+            "name": self.node.node_name,
+            "indices": {"docs": {"count": total_docs}},
+        }}}
+
+    def all_stats(self, req: RestRequest):
+        indices = {n: svc.stats()
+                   for n, svc in self.node.indices_service.indices.items()}
+        total_docs = sum(s["docs"]["count"] for s in indices.values())
+        return 200, {"_all": {"primaries": {"docs": {"count": total_docs}}},
+                     "indices": indices}
+
+    def index_stats(self, req: RestRequest):
+        out = {}
+        for n in self.node.indices_service.resolve(req.path_params["index"]):
+            out[n] = {"primaries": self.node.indices_service.index(n).stats()}
+        return 200, {"indices": out}
+
+    # ---- _cat --------------------------------------------------------------
+
+    def _cat_table(self, req: RestRequest, headers: list[str],
+                   rows: list[list]) -> tuple[int, str]:
+        verbose = req.param_as_bool("v")
+        widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+                  if rows else len(str(h)) for i, h in enumerate(headers)]
+        lines = []
+        if verbose:
+            lines.append(" ".join(str(h).ljust(w)
+                                  for h, w in zip(headers, widths)).rstrip())
+        for r in rows:
+            lines.append(" ".join(str(c).ljust(w)
+                                  for c, w in zip(r, widths)).rstrip())
+        return 200, "\n".join(lines) + "\n"
+
+    def cat_help(self, req: RestRequest):
+        paths = ["/_cat/indices", "/_cat/health", "/_cat/count",
+                 "/_cat/shards", "/_cat/nodes", "/_cat/master",
+                 "/_cat/aliases"]
+        return 200, "=^.^=\n" + "\n".join(paths) + "\n"
+
+    def cat_indices(self, req: RestRequest):
+        state = self.node.cluster_service.state()
+        rows = []
+        for n, svc in sorted(self.node.indices_service.indices.items()):
+            meta = state.indices[n]
+            health = "green" if meta.number_of_replicas == 0 else "yellow"
+            rows.append([health, "open", n, meta.uuid,
+                         meta.number_of_shards, meta.number_of_replicas,
+                         svc.num_docs(), 0, "0b", "0b"])
+        return self._cat_table(req, ["health", "status", "index", "uuid",
+                                     "pri", "rep", "docs.count", "docs.deleted",
+                                     "store.size", "pri.store.size"], rows)
+
+    def cat_health(self, req: RestRequest):
+        h = self.node.cluster_service.state().health()
+        ts = int(time.time())
+        rows = [[ts, time.strftime("%H:%M:%S", time.gmtime(ts)),
+                 h["cluster_name"], h["status"], h["number_of_nodes"],
+                 h["number_of_data_nodes"], h["active_shards"],
+                 h["active_primary_shards"], h["relocating_shards"],
+                 h["initializing_shards"], h["unassigned_shards"]]]
+        return self._cat_table(req, ["epoch", "timestamp", "cluster", "status",
+                                     "node.total", "node.data", "shards", "pri",
+                                     "relo", "init", "unassign"], rows)
+
+    def cat_count(self, req: RestRequest):
+        expr = req.path_params.get("index", "_all")
+        count = self.node.count(expr, None)["count"] if \
+            self.node.indices_service.indices else 0
+        ts = int(time.time())
+        return self._cat_table(req, ["epoch", "timestamp", "count"],
+                               [[ts, time.strftime("%H:%M:%S", time.gmtime(ts)),
+                                 count]])
+
+    def cat_shards(self, req: RestRequest):
+        state = self.node.cluster_service.state()
+        rows = []
+        for s in state.routing_table.shards:
+            rows.append([s.index, s.shard, "p" if s.primary else "r",
+                         s.state.value, s.node_id or "-"])
+        return self._cat_table(req, ["index", "shard", "prirep", "state",
+                                     "node"], rows)
+
+    def cat_nodes(self, req: RestRequest):
+        return self._cat_table(req, ["name", "node.role", "master"],
+                               [[self.node.node_name, "dim", "*"]])
+
+    def cat_master(self, req: RestRequest):
+        return self._cat_table(
+            req, ["id", "node"],
+            [[self.node.node_id, self.node.node_name]])
+
+    def cat_aliases(self, req: RestRequest):
+        state = self.node.cluster_service.state()
+        rows = []
+        for n, meta in state.indices.items():
+            for alias in meta.aliases:
+                rows.append([alias, n, "-", "-"])
+        return self._cat_table(req, ["alias", "index", "filter", "routing"],
+                               rows)

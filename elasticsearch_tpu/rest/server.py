@@ -1,0 +1,66 @@
+"""HTTP ingress.
+
+Reference: core/http/netty/NettyHttpServerTransport.java:63 +
+core/http/HttpServer.java:47. A threaded stdlib HTTP server is the host
+control-plane ingress (queries are device-bound; HTTP parsing is not the
+bottleneck at the corpus sizes where TPU wins). Content type: JSON bodies,
+NDJSON for _bulk, text/plain for _cat.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from elasticsearch_tpu.rest.controller import RestController
+from elasticsearch_tpu.rest.handlers import register_all
+
+
+class RestServer:
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 9200):
+        self.node = node
+        self.controller = RestController()
+        register_all(self.controller, node)
+        controller = self.controller
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _handle(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                status, payload = controller.dispatch(
+                    self.command, self.path, body)
+                if isinstance(payload, str):
+                    data = payload.encode("utf-8")
+                    ctype = "text/plain; charset=UTF-8"
+                else:
+                    data = json.dumps(payload).encode("utf-8")
+                    ctype = "application/json; charset=UTF-8"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(data)
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _handle
+
+            def log_message(self, fmt, *args):  # quiet access log
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "RestServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="rest-server")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

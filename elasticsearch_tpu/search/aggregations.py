@@ -78,10 +78,11 @@ def parse_aggs(body: dict | None) -> list[AggNode]:
 class ShardAggContext:
     """Host views of one shard's reader for aggregation collection."""
 
-    def __init__(self, reader, mapper_service, execute_filter):
+    def __init__(self, reader, mapper_service, execute_filter, scores=None):
         self.reader = reader
         self.mapper_service = mapper_service
         self.execute_filter = execute_filter  # (Query) → list[np mask per seg]
+        self.scores = scores                  # [N] query scores (top_hits)
 
     def numeric_values(self, fname: str):
         """→ (values f64 concat over segments, exists concat)."""
@@ -187,10 +188,17 @@ def _c_percentiles(node, mask, ctx):
 
 def _c_top_hits(node, mask, ctx):
     size = int(node.params.get("size", 3))
-    idx = np.nonzero(mask)[0][:size]
+    idx = np.nonzero(mask)[0]
+    if ctx.scores is not None and idx.size:
+        # top hits ordered by query score desc, doc asc (ES default)
+        order = np.lexsort((idx, -ctx.scores[idx]))
+        idx = idx[order]
+    idx = idx[:size]
     hits = []
     for gid in idx:
+        score = float(ctx.scores[int(gid)]) if ctx.scores is not None else None
         hits.append({"_id": ctx.reader.doc_id(int(gid)),
+                     "_score": score,
                      "_source": ctx.reader.source(int(gid))})
     return {"hits": hits, "total": int(mask.sum()), "size": size}
 
@@ -389,9 +397,30 @@ _COLLECTORS = {
 
 def reduce_aggs(nodes: list[AggNode], partials_per_shard: list[dict]) -> dict:
     out = {}
-    for node in nodes:
+    siblings = [n for n in nodes if n.type not in PIPELINE_AGGS]
+    pipelines = [n for n in nodes if n.type in PIPELINE_AGGS]
+    for node in siblings:
         shard_parts = [p[node.name] for p in partials_per_shard if node.name in p]
         out[node.name] = _reduce_node(node, shard_parts)
+    # sibling pipelines (avg/max/min/sum_bucket) consume the reduced output
+    # of a multi-bucket sibling via buckets_path "agg>metric"
+    for node in pipelines:
+        path = node.params.get("buckets_path", "")
+        head, _, rest = path.partition(">")
+        buckets = out.get(head, {}).get("buckets", [])
+        values = [v for v in (_bucket_path_value(b, rest or "_count")
+                              for b in buckets) if v is not None]
+        if node.type == "avg_bucket":
+            value = sum(values) / len(values) if values else None
+        elif node.type == "sum_bucket":
+            value = sum(values) if values else 0.0
+        elif node.type == "max_bucket":
+            value = max(values) if values else None
+        elif node.type == "min_bucket":
+            value = min(values) if values else None
+        else:
+            continue  # cumulative_sum/derivative are parent pipelines
+        out[node.name] = {"value": value}
     return out
 
 
@@ -425,28 +454,40 @@ def _merge_buckets(node: AggNode, parts: list[dict]) -> dict:
     return merged
 
 
+def _bucket_path_value(bucket: dict, path: str):
+    """Resolve a buckets_path within a rendered bucket: '_count',
+    'sub_agg', 'sub_agg.metric', or 'sub>leaf' (reference:
+    core/search/aggregations/pipeline/BucketHelpers.java)."""
+    if path == "_count":
+        return bucket.get("doc_count")
+    node: Any = bucket
+    for part in path.replace(">", ".").split("."):
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    if isinstance(node, dict):
+        return node.get("value", node.get("avg"))
+    return node
+
+
 def _render_pipeline(node: AggNode, buckets: list[dict]) -> None:
+    """Parent pipelines (cumulative_sum, derivative) rendered into each
+    bucket of the enclosing multi-bucket agg."""
     for pipe in node.pipelines:
+        if pipe.type not in ("cumulative_sum", "derivative"):
+            continue
         path = pipe.params.get("buckets_path", "_count")
-        def bucket_value(b):
-            if path == "_count":
-                return b["doc_count"]
-            head = path.split(">")[0].split(".")[0]
-            sub = b.get(node.name, b).get(head) if isinstance(b.get(node.name), dict) \
-                else b.get(head)
-            agg = b.get("aggs_rendered", {}).get(head, {})
-            return agg.get("value", agg.get("avg"))
-        values = [bucket_value(b) for b in buckets]
+        values = [_bucket_path_value(b, path) for b in buckets]
         if pipe.type == "cumulative_sum":
             acc = 0.0
             for b, v in zip(buckets, values):
                 acc += (v or 0.0)
-                b.setdefault("pipeline", {})[pipe.name] = {"value": acc}
+                b[pipe.name] = {"value": acc}
         elif pipe.type == "derivative":
             prev = None
             for b, v in zip(buckets, values):
                 if prev is not None and v is not None:
-                    b.setdefault("pipeline", {})[pipe.name] = {"value": v - prev}
+                    b[pipe.name] = {"value": v - prev}
                 prev = v
 
 
@@ -492,9 +533,10 @@ def _reduce_node(node: AggNode, parts: list[dict]) -> dict:
         return {"values": vals}
     if t == "top_hits":
         size = parts[0]["size"] if parts else 3
-        hits = [h for p in parts for h in p["hits"]][:size]
+        hits = [h for p in parts for h in p["hits"]]
+        hits.sort(key=lambda h: -(h.get("_score") or 0.0))
         return {"hits": {"total": sum(p["total"] for p in parts),
-                         "hits": hits}}
+                         "hits": hits[:size]}}
     if t in ("filter", "global", "missing"):
         out = {"doc_count": sum(p["doc_count"] for p in parts)}
         sub_parts = [p["subs"] for p in parts if "subs" in p]
@@ -554,6 +596,4 @@ def _final_bucket(b: dict) -> dict:
             out[extra] = b[extra]
     if "aggs" in b:
         out.update(b["aggs"])
-    if "pipeline" in b:
-        out.update(b["pipeline"])
     return out

@@ -41,15 +41,25 @@ def sort_docs(results: list[ShardQueryResult],
     if not refs:
         return []
     if refs[0].sort_values is not None:
+        import functools
         orders = [(list(spec.values())[0].get("order", "asc")) == "desc"
                   for spec in req.sort]
-        def key(ref):
-            out = []
-            for v, desc in zip(ref.sort_values, orders):
-                v = float("inf") if v is None else v
-                out.append(-v if desc else v)
-            return out
-        refs.sort(key=lambda r: (key(r), r.shard_idx, r.position))
+
+        def cmp_refs(a: MergedHitRef, b: MergedHitRef) -> int:
+            for va, vb, desc in zip(a.sort_values, b.sort_values, orders):
+                if va == vb:
+                    continue
+                if va is None:   # missing sorts last regardless of order
+                    return 1
+                if vb is None:
+                    return -1
+                if isinstance(va, str) or isinstance(vb, str):
+                    va, vb = str(va), str(vb)
+                c = 1 if va > vb else -1
+                return -c if desc else c
+            return -1 if (a.shard_idx, a.position) < (b.shard_idx, b.position) \
+                else 1
+        refs.sort(key=functools.cmp_to_key(cmp_refs))
     else:
         # stable sort keeps (shard order, position) for ties — TopDocs.merge
         refs.sort(key=lambda r: (-(r.score if r.score is not None else -np.inf),

@@ -135,6 +135,21 @@ class SegmentExecutor:
         return col, st, tids, idfs
 
     def _exec_MatchQuery(self, query: q.MatchQuery):
+        if query.field in ("*", "_all"):
+            # all-fields match (ES _all / query_string default): OR over every
+            # text field present in the segment
+            subs = [q.MatchQuery(field=f, text=query.text,
+                                 operator=query.operator, boost=query.boost)
+                    for f in self.seg.text]
+            if not subs:
+                return self._zeros()
+            scores = None
+            mask = None
+            for sub in subs:
+                s, m = self._exec_MatchQuery(sub)
+                scores = s if scores is None else jnp.maximum(scores, s)
+                mask = m if mask is None else (mask | m)
+            return scores, mask
         if self.seg.text.get(query.field) is None and (
                 query.field in self.seg.keyword
                 or query.field in self.seg.numeric):
@@ -245,7 +260,6 @@ class SegmentExecutor:
         return jnp.zeros(self.n, bool)
 
     def _exec_TermQuery(self, query: q.TermQuery):
-        mask = self._keyword_or_text_term_mask(query.field, query.value)
         # term on text fields scores BM25 like a single-term match (Lucene
         # TermQuery); on keyword/numeric doc values it is constant-score.
         tcol = self.seg.text.get(query.field)
@@ -253,6 +267,7 @@ class SegmentExecutor:
             return self._exec_MatchQuery(q.MatchQuery(
                 field=query.field, text=str(query.value), analyzer="keyword",
                 boost=query.boost))
+        mask = self._keyword_or_text_term_mask(query.field, query.value)
         return bool_ops.constant_score(mask, query.boost)
 
     def _exec_TermsQuery(self, query: q.TermsQuery):
@@ -270,14 +285,20 @@ class SegmentExecutor:
     def _exec_RangeQuery(self, query: q.RangeQuery):
         ncol = self.seg.numeric.get(query.field)
         if ncol is not None:
-            lo = query.gte if query.gte is not None else query.gt
-            hi = query.lte if query.lte is not None else query.lt
-            lo_v = -np.inf if lo is None else self._numeric_value(query.field, lo)
-            hi_v = np.inf if hi is None else self._numeric_value(query.field, hi)
+            # gte/gt (and lte/lt) apply independently; effective bound is the
+            # tightest (ES RangeQueryParser applies each given bound).
+            lo_v = -np.inf
+            if query.gte is not None:
+                lo_v = self._numeric_value(query.field, query.gte)
             if query.gt is not None:
-                lo_v = np.nextafter(np.float64(lo_v), np.inf)
+                lo_v = max(lo_v, np.nextafter(np.float64(
+                    self._numeric_value(query.field, query.gt)), np.inf))
+            hi_v = np.inf
+            if query.lte is not None:
+                hi_v = self._numeric_value(query.field, query.lte)
             if query.lt is not None:
-                hi_v = np.nextafter(np.float64(hi_v), -np.inf)
+                hi_v = min(hi_v, np.nextafter(np.float64(
+                    self._numeric_value(query.field, query.lt)), -np.inf))
             ghi, glo = dd_split(lo_v)
             lhi, llo = dd_split(hi_v)
             mask = filter_ops.numeric_range(

@@ -136,9 +136,14 @@ class ShardSearcher:
         if req.aggs:
             agg_mask = np.concatenate([np.asarray(m) for _, m in per_seg]) \
                 if per_seg else np.zeros(0, bool)
+            agg_scores = np.concatenate([np.asarray(s) for s, _ in per_seg]) \
+                if per_seg else np.zeros(0, np.float32)
             agg_ctx = ShardAggContext(self.reader, self.mapper_service,
-                                      self._filter_masks_np)
+                                      self._filter_masks_np, scores=agg_scores)
+            from elasticsearch_tpu.search.aggregations import PIPELINE_AGGS
             for node in req.aggs:
+                if node.type in PIPELINE_AGGS:
+                    continue  # sibling pipelines are reduce-phase only
                 agg_partials[node.name] = collect(node, agg_mask, agg_ctx)
 
         if req.post_filter is not None:
@@ -187,8 +192,8 @@ class ShardSearcher:
         scores = np.concatenate([np.asarray(s) for s, _ in per_seg])
         n = mask.shape[0]
         doc_ids = np.arange(n, dtype=np.int64)
-        keys = []           # built last-significant-first for lexsort
-        per_hit_values: list[np.ndarray] = []
+        keys = []           # numeric sort keys per spec
+        per_hit_out: list = []   # per spec: value to emit in hit["sort"]
         sort_specs = []
         for spec in req.sort:
             (fname, opts), = spec.items()
@@ -197,29 +202,44 @@ class ShardSearcher:
             sort_specs.append((fname, order))
             if fname == "_score":
                 vals = scores.astype(np.float64)
+                out = vals
             elif fname == "_doc":
-                vals = doc_ids.astype(np.float64)
+                # globally unique across shards so (.., _doc) search_after
+                # cursors are unambiguous at the coordinator
+                vals = (doc_ids + (self.shard_id << 42)).astype(np.float64)
+                out = vals
             else:
-                vals = self._sort_column(fname, n, missing, order)
-            per_hit_values.append(vals)
+                vals, out = self._sort_column(fname, n, missing, order)
+            per_hit_out.append(out)
             keys.append(-vals if order == "desc" else vals)
         # np.lexsort: LAST key is primary → (docid tie-break, ..., spec1)
         order_idx = np.lexsort(tuple([doc_ids] + keys[::-1]))
         order_idx = order_idx[mask[order_idx]]
         if req.search_after is not None:
-            order_idx = self._apply_search_after(req, sort_specs,
-                                                 per_hit_values, doc_ids,
-                                                 order_idx)
+            order_idx = self._apply_search_after(req.search_after, sort_specs,
+                                                 per_hit_out, order_idx)
         k = max(req.from_ + req.size, 1)
         top = order_idx[:k]
-        sort_values = [[_sort_value_out(per_hit_values[i][d])
+        sort_values = [[_sort_value_out(per_hit_out[i][d])
                         for i in range(len(req.sort))] for d in top]
         return ShardQueryResult(self.shard_id, total, None,
                                 top.astype(np.int32), scores[top],
                                 sort_values, agg_partials, self.reader)
 
-    def _sort_column(self, fname: str, n: int, missing, order: str) -> np.ndarray:
+    def _sort_column(self, fname: str, n: int, missing, order: str):
+        """→ (numeric sort key [n] f64, per-hit output values [n] object)."""
         cols = []
+        outs = []
+        # union vocabulary across segments so keyword ordinals are comparable
+        union: dict[str, int] | None = None
+        if any(fname in seg.seg.keyword_fields for seg in self.reader.segments):
+            values: set[str] = set()
+            for seg in self.reader.segments:
+                kcol = seg.seg.keyword_fields.get(fname)
+                if kcol is not None:
+                    values.update(kcol.vocab)
+            union_vocab = sorted(values)
+            union = {v: i for i, v in enumerate(union_vocab)}
         for seg in self.reader.segments:
             col = seg.seg.numeric_fields.get(fname)
             if col is not None:
@@ -230,43 +250,56 @@ class ShardSearcher:
                     fill = float(missing)
                 vals[~col.exists] = fill
                 cols.append(vals)
+                outs.append(vals)
                 continue
             kcol = seg.seg.keyword_fields.get(fname)
-            if kcol is not None:
-                # keyword sorting round 1: per-shard union ordinals would be
-                # needed for exactness across segments; use first-ord proxy
-                # by mapping through the sorted vocab on host
-                first = kcol.ords[:, 0].astype(np.int64)
-                ranks = np.full(first.shape, np.inf)
+            if kcol is not None and union is not None:
+                remap = np.array([union[v] for v in kcol.vocab] or [0],
+                                 np.int64)
+                first = kcol.ords[:, 0]
                 have = first >= 0
-                # rank via vocab string order mapped to a global sortable key:
-                # use index into this segment's sorted vocab — consistent
-                # within segment; cross-segment handled via string values in
-                # sort_values output
-                ranks[have] = first[have]
+                ranks = np.full(first.shape, np.inf)
+                ranks[have] = remap[first[have]]
                 cols.append(ranks)
+                out = np.full(first.shape, None, dtype=object)
+                out[have] = [union_vocab[int(r)] for r in ranks[have]]
+                outs.append(out)
                 continue
             cols.append(np.full(seg.padded_docs, np.inf))
-        return np.concatenate(cols) if cols else np.full(n, np.inf)
+            outs.append(np.full(seg.padded_docs, None, dtype=object))
+        if not cols:
+            return np.full(n, np.inf), np.full(n, None, dtype=object)
+        return np.concatenate(cols), np.concatenate(outs)
 
-    def _apply_search_after(self, req, sort_specs, per_hit_values, doc_ids,
+    def _apply_search_after(self, after: list, sort_specs, per_hit_out,
                             order_idx):
-        after = req.search_after
-        def tuple_for(d):
-            return tuple(per_hit_values[i][d] for i in range(len(sort_specs)))
+        """Keep docs strictly after the cursor in sort order. Cursor values
+        are the emitted hit['sort'] values (numbers or keyword strings)."""
+        def cmp_vals(a, b) -> int:
+            # None == missing == sorts last in either direction
+            if a is None and b is None:
+                return 0
+            if a is None:
+                return 1
+            if b is None:
+                return -1
+            if isinstance(a, str) or isinstance(b, str):
+                a, b = str(a), str(b)
+            else:
+                a, b = float(a), float(b)
+            return 0 if a == b else (1 if a > b else -1)
+
         keep = []
         for d in order_idx:
-            t = tuple_for(d)
             cmp = 0
-            for (fname, order), have, want in zip(sort_specs, t, after):
-                w = float(want)
-                if have == w:
-                    continue
-                asc = order == "asc"
-                cmp = 1 if ((have > w) == asc) else -1
-                break
-            if cmp > 0 or (cmp == 0 and len(after) > len(sort_specs)
-                           and doc_ids[d] > int(after[-1])):
+            for i, (fname, order) in enumerate(sort_specs):
+                if i >= len(after):
+                    break
+                c = cmp_vals(per_hit_out[i][d], after[i])
+                if c != 0:
+                    cmp = c if order == "asc" else -c
+                    break
+            if cmp > 0:
                 keep.append(d)
         return np.asarray(keep, dtype=order_idx.dtype)
 
@@ -279,12 +312,13 @@ class ShardSearcher:
             gid = int(result.doc_ids[pos])
             seg, local = self.reader.resolve(gid)
             src = seg.seg.sources[local]
+            emit_score = result.sort_values is None or any(
+                "_score" in spec for spec in req.sort)
             hit = {
                 "_index": index_name,
                 "_type": "_doc",
                 "_id": seg.seg.ids[local],
-                "_score": (None if result.sort_values is not None
-                           else float(result.scores[pos])),
+                "_score": (float(result.scores[pos]) if emit_score else None),
             }
             if result.sort_values is not None:
                 hit["sort"] = result.sort_values[pos]
@@ -365,9 +399,12 @@ def _filter_source(src: dict, spec) -> dict | None:
     return out
 
 
-def _sort_value_out(v: float):
+def _sort_value_out(v):
+    if v is None or isinstance(v, str):
+        return v
+    v = float(v)
     if v in (np.inf, -np.inf):
         return None
-    if float(v).is_integer():
+    if v.is_integer():
         return int(v)
-    return float(v)
+    return v

@@ -62,13 +62,29 @@ class SearchService:
 
     def search(self, index, body: dict | None, scroll: str | None = None) -> dict:
         t0 = time.perf_counter()
+        if scroll is not None:
+            # scroll pages continue via search_after cursors, which need a
+            # total order: append a unique (_score desc, _doc asc) or
+            # (..., _doc asc) tie-break to the requested sort
+            body = dict(body or {})
+            sort = body.get("sort")
+            if not sort:
+                sort = [{"_score": {"order": "desc"}}]
+            elif isinstance(sort, (str, dict)):
+                sort = [sort]
+            else:
+                sort = list(sort)
+            if not any((s == "_doc") or (isinstance(s, dict) and "_doc" in s)
+                       for s in sort):
+                sort = sort + [{"_doc": {"order": "asc"}}]
+            body["sort"] = sort
         req = parse_search_request(body)
         searchers = self._searchers(index)
         results = [s.query_phase(req) for s in searchers]
         resp = merge_responses(index.name, req, results, searchers,
                                (time.perf_counter() - t0) * 1e3, req.aggs)
         if scroll is not None:
-            resp["_scroll_id"] = self._open_scroll(index.name, body or {},
+            resp["_scroll_id"] = self._open_scroll(index.name, body,
                                                    scroll, resp, req)
         return resp
 
@@ -97,14 +113,7 @@ class SearchService:
         if not hits:
             ctx.finished = True
             return
-        last = hits[-1]
-        if req.sort:
-            ctx.last_sort_key = last.get("sort")
-        else:
-            # (score, global doc id) continuation; doc id recovered via the
-            # per-shard ordering — we use the score alone plus doc tiebreak
-            # carried in the response assembly
-            ctx.last_sort_key = [last["_score"], last.get("_shard_doc", -1)]
+        ctx.last_sort_key = hits[-1].get("sort")
 
     def scroll(self, indices_service, scroll_id: str,
                scroll: str | None = None) -> dict:
@@ -126,14 +135,9 @@ class SearchService:
             resp["hits"]["hits"] = []
             resp["_scroll_id"] = scroll_id
             return resp
-        body = dict(ctx.body)
+        body = dict(ctx.body)   # already carries the _doc-tie-broken sort
         if ctx.last_sort_key is not None:
             body["search_after"] = ctx.last_sort_key
-        body.setdefault("sort", [{"_doc": {"order": "asc"}}]
-                        if "sort" not in ctx.body and "query" not in ctx.body
-                        else ctx.body.get("sort", []))
-        # score-ordered scrolls continue via (score, doc) search_after;
-        # doc-ordered (_doc sort) scrolls via sort tuple
         req = parse_search_request(body)
         searchers = self._searchers(index)
         t0 = time.perf_counter()

@@ -1,0 +1,225 @@
+"""REST API conformance tests — request/response shapes over a live HTTP
+server, in the spirit of the reference's YAML REST suites
+(rest-api-spec/src/main/resources/rest-api-spec/test/)."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.client import HttpClient, NodeClient
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.server import RestServer
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    node = Node(data_path=tmp_path_factory.mktemp("rest-node")).start()
+    srv = RestServer(node, port=0).start()   # ephemeral port
+    yield srv
+    srv.stop()
+    node.close()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return HttpClient(port=server.port)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def seed(client):
+    client.indices.create("books", {
+        "settings": {"index": {"number_of_shards": 2}},
+        "mappings": {"properties": {
+            "title": {"type": "text"},
+            "genre": {"type": "keyword"},
+            "year": {"type": "integer"},
+        }}})
+    client.index("books", {"title": "war and peace", "genre": "classic",
+                           "year": 1869}, id="1")
+    client.index("books", {"title": "the war of the worlds", "genre": "scifi",
+                           "year": 1898}, id="2")
+    client.index("books", {"title": "peace talks", "genre": "fantasy",
+                           "year": 2020}, id="3")
+    client.indices.refresh("books")
+
+
+class TestRoot:
+    def test_info(self, client):
+        info = client.info()
+        assert info["tagline"] == "You Know, for Search"
+        assert info["version"]["number"]
+
+
+class TestDocuments:
+    def test_get(self, client):
+        doc = client.get("books", "1")
+        assert doc["found"] and doc["_source"]["year"] == 1869
+
+    def test_get_missing_404(self, client):
+        doc = client.get("books", "nope")
+        assert doc["found"] is False
+
+    def test_index_update_delete(self, client):
+        client.index("books", {"title": "tmp", "genre": "x", "year": 1},
+                     id="tmp1", refresh=True)
+        client.update("books", "tmp1", {"doc": {"year": 2}}, refresh=True)
+        assert client.get("books", "tmp1")["_source"]["year"] == 2
+        client.delete("books", "tmp1", refresh=True)
+        assert client.get("books", "tmp1")["found"] is False
+
+    def test_update_script(self, client):
+        client.index("books", {"title": "s", "genre": "x", "year": 10},
+                     id="tmp2", refresh=True)
+        client.update("books", "tmp2",
+                      {"script": {"source": "ctx._source.year += 5"}},
+                      refresh=True)
+        assert client.get("books", "tmp2")["_source"]["year"] == 15
+        client.delete("books", "tmp2", refresh=True)
+
+    def test_mget(self, client):
+        r = client.mget({"ids": ["1", "2"]}, index="books")
+        assert [d["found"] for d in r["docs"]] == [True, True]
+
+
+class TestBulk:
+    def test_bulk_ndjson(self, client):
+        ops = [
+            {"index": {"_index": "books", "_id": "b1"}},
+        ]
+        nd = json.dumps({"index": {"_index": "books", "_id": "b1"}}) + "\n" + \
+            json.dumps({"title": "bulk one", "genre": "test", "year": 2000}) + "\n" + \
+            json.dumps({"create": {"_index": "books", "_id": "b2"}}) + "\n" + \
+            json.dumps({"title": "bulk two", "genre": "test", "year": 2001}) + "\n" + \
+            json.dumps({"delete": {"_index": "books", "_id": "b1"}}) + "\n"
+        r = client.bulk(nd, refresh=True)
+        assert r["errors"] is False
+        assert [list(i)[0] for i in r["items"]] == ["index", "create", "delete"]
+        assert client.get("books", "b2")["found"]
+        assert client.get("books", "b1")["found"] is False
+        # create conflict reports per-item error, doesn't abort the bulk
+        r = client.bulk(json.dumps({"create": {"_index": "books", "_id": "b2"}})
+                        + "\n" + json.dumps({"title": "dup"}) + "\n")
+        assert r["errors"] is True
+        assert r["items"][0]["create"]["status"] == 409
+        client.delete("books", "b2", refresh=True)
+
+
+class TestSearch:
+    def test_match(self, client):
+        r = client.search("books", {"query": {"match": {"title": "war"}}})
+        assert r["hits"]["total"]["value"] == 2
+
+    def test_uri_q(self, client):
+        srv_resp = client._request("GET", "/books/_search?q=title:peace")
+        assert srv_resp["hits"]["total"]["value"] == 2
+
+    def test_aggs(self, client):
+        r = client.search("books", {"size": 0, "aggs": {
+            "genres": {"terms": {"field": "genre"}}}})
+        keys = {b["key"] for b in r["aggregations"]["genres"]["buckets"]}
+        assert keys == {"classic", "scifi", "fantasy"}
+
+    def test_count(self, client):
+        assert client.count("books")["count"] == 3
+
+    def test_scroll(self, client):
+        r = client.search("books", {"query": {"match_all": {}},
+                                    "sort": [{"year": "asc"}], "size": 2},
+                          scroll="1m")
+        first = [h["_id"] for h in r["hits"]["hits"]]
+        r2 = client.scroll(r["_scroll_id"])
+        second = [h["_id"] for h in r2["hits"]["hits"]]
+        assert first + second == ["1", "2", "3"]
+        client.clear_scroll(r["_scroll_id"])
+
+    def test_validate(self, client):
+        r = client._request("POST", "/books/_validate/query",
+                            {"query": {"match": {"title": "x"}}})
+        assert r["valid"] is True
+        r = client._request("POST", "/books/_validate/query",
+                            {"query": {"nope": {}}})
+        assert r["valid"] is False
+
+
+class TestIndicesApi:
+    def test_mapping_roundtrip(self, client):
+        m = client.indices.get_mapping("books")
+        props = m["books"]["mappings"]["_doc"]["properties"]
+        assert props["genre"]["type"] == "keyword"
+        client.indices.put_mapping("books", {"properties": {
+            "pages": {"type": "integer"}}})
+        m = client.indices.get_mapping("books")
+        assert m["books"]["mappings"]["_doc"]["properties"]["pages"]["type"] \
+            == "integer"
+
+    def test_analyze(self, client):
+        r = client.indices.analyze(body={"analyzer": "english",
+                                         "text": "running foxes"})
+        assert [t["token"] for t in r["tokens"]] == ["run", "fox"]
+
+    def test_exists_and_errors(self, client):
+        assert client.indices.exists("books")
+        assert not client.indices.exists("nope")
+        with pytest.raises(Exception) as ei:
+            client.search("nope_index", {})
+        assert getattr(ei.value, "status", None) == 404
+
+    def test_aliases(self, client):
+        client._request("POST", "/_aliases", {"actions": [
+            {"add": {"index": "books", "alias": "library"}}]})
+        r = client.search("library", {"query": {"match_all": {}}})
+        assert r["hits"]["total"]["value"] == 3
+
+    def test_template(self, client):
+        client.indices.put_template("logs_tmpl", {
+            "index_patterns": ["logs-*"],
+            "settings": {"index": {"number_of_shards": 1}},
+            "mappings": {"properties": {"msg": {"type": "text"}}}})
+        client.indices.create("logs-2026")
+        m = client.indices.get_mapping("logs-2026")
+        assert m["logs-2026"]["mappings"]["_doc"]["properties"]["msg"]["type"] \
+            == "text"
+        client.indices.delete("logs-2026")
+
+
+class TestClusterAndCat:
+    def test_health(self, client):
+        h = client.cluster_health()
+        assert h["status"] in ("green", "yellow")
+        assert h["active_primary_shards"] >= 2
+
+    def test_cluster_state(self, client):
+        s = client._request("GET", "/_cluster/state")
+        assert "books" in s["metadata"]["indices"]
+        assert "books" in s["routing_table"]["indices"]
+
+    def test_stats(self, client):
+        r = client.indices.stats("books")
+        assert r["indices"]["books"]["primaries"]["docs"]["count"] == 3
+
+    def test_cat_indices(self, client):
+        out = client.cat_indices(v=True)
+        assert "books" in out and "docs.count" in out
+
+    def test_cat_health_and_shards(self, client):
+        assert "green" in client._request("GET", "/_cat/health") or \
+            "yellow" in client._request("GET", "/_cat/health")
+        shards = client._request("GET", "/_cat/shards")
+        assert "books" in shards
+
+    def test_bad_route(self, client):
+        with pytest.raises(Exception):
+            client._request("GET", "/books/_no_such_endpoint")
+
+
+class TestNodeClient:
+    def test_same_surface_in_process(self, tmp_path):
+        node = Node(data_path=tmp_path / "nc").start()
+        c = NodeClient(node)
+        c.indices.create("t", {"mappings": {"properties": {
+            "x": {"type": "text"}}}})
+        c.index("t", {"x": "hello world"}, id="1", refresh=True)
+        assert c.count("t")["count"] == 1
+        r = c.search("t", {"query": {"match": {"x": "hello"}}})
+        assert r["hits"]["hits"][0]["_id"] == "1"
+        node.close()

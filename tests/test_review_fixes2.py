@@ -1,0 +1,142 @@
+"""Regression tests for the second code-review round (scroll ties, pipeline
+buckets_path, keyword sort across segments, query_string default field,
+sibling pipelines, top_hits scoring, range bound independence)."""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node(data_path=tmp_path_factory.mktemp("rf2")).start()
+    yield n
+    n.close()
+
+
+def test_scroll_advances_through_tied_scores(node):
+    node.indices_service.create_index("ties", {"mappings": {"properties": {
+        "tag": {"type": "keyword"}}}})
+    for i in range(25):
+        node.index_doc("ties", str(i), {"tag": "same"})
+    node.indices_service.index("ties").refresh()
+    # constant-score query → every score identical
+    r = node.search("ties", {"query": {"term": {"tag": "same"}}, "size": 10},
+                    scroll="1m")
+    seen = [h["_id"] for h in r["hits"]["hits"]]
+    sid = r["_scroll_id"]
+    for _ in range(10):
+        r = node.search_service.scroll(node.indices_service, sid)
+        if not r["hits"]["hits"]:
+            break
+        seen += [h["_id"] for h in r["hits"]["hits"]]
+    assert len(seen) == 25 and len(set(seen)) == 25
+    node.indices_service.delete_index("ties")
+
+
+def test_keyword_sort_across_segments(node):
+    node.indices_service.create_index("ksort", {"mappings": {"properties": {
+        "tag": {"type": "keyword"}}}})
+    node.index_doc("ksort", "z", {"tag": "zebra"})
+    node.indices_service.index("ksort").refresh()   # segment 1: only zebra
+    node.index_doc("ksort", "a", {"tag": "apple"})
+    node.indices_service.index("ksort").refresh()   # segment 2: only apple
+    r = node.search("ksort", {"query": {"match_all": {}},
+                              "sort": [{"tag": "asc"}]})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["a", "z"]
+    assert r["hits"]["hits"][0]["sort"] == ["apple"]
+    node.indices_service.delete_index("ksort")
+
+
+def test_query_string_default_all_fields(node):
+    node.indices_service.create_index("qs", {"mappings": {"properties": {
+        "title": {"type": "text"}, "body": {"type": "text"}}}})
+    node.index_doc("qs", "1", {"title": "hello there", "body": "other"})
+    node.index_doc("qs", "2", {"title": "nope", "body": "hello again"})
+    node.indices_service.index("qs").refresh()
+    r = node.search("qs", {"query": {"query_string": {"query": "hello"}}})
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1", "2"}
+    node.indices_service.delete_index("qs")
+
+
+@pytest.fixture(scope="module")
+def sales_node(tmp_path_factory):
+    n = Node(data_path=tmp_path_factory.mktemp("sales")).start()
+    n.indices_service.create_index("sales", {"mappings": {"properties": {
+        "cat": {"type": "keyword"}, "price": {"type": "double"},
+        "month": {"type": "integer"}}}})
+    data = [("a", 10.0, 1), ("a", 20.0, 2), ("b", 5.0, 1), ("b", 15.0, 2),
+            ("c", 100.0, 1)]
+    for i, (c, p, m) in enumerate(data):
+        n.index_doc("sales", str(i), {"cat": c, "price": p, "month": m})
+    n.indices_service.index("sales").refresh()
+    yield n
+    n.close()
+
+
+def test_pipeline_buckets_path_sub_agg(sales_node):
+    r = sales_node.search("sales", {"size": 0, "aggs": {
+        "months": {"histogram": {"field": "month", "interval": 1},
+                   "aggs": {"rev": {"sum": {"field": "price"}},
+                            "cum": {"cumulative_sum": {"buckets_path": "rev"}}}}}})
+    buckets = r["aggregations"]["months"]["buckets"]
+    assert buckets[0]["rev"]["value"] == pytest.approx(115.0)
+    assert buckets[0]["cum"]["value"] == pytest.approx(115.0)
+    assert buckets[1]["cum"]["value"] == pytest.approx(150.0)
+
+
+def test_sibling_pipeline_aggs(sales_node):
+    r = sales_node.search("sales", {"size": 0, "aggs": {
+        "cats": {"terms": {"field": "cat"},
+                 "aggs": {"rev": {"sum": {"field": "price"}}}},
+        "best": {"max_bucket": {"buckets_path": "cats>rev"}},
+        "avg_rev": {"avg_bucket": {"buckets_path": "cats>rev"}},
+        "total": {"sum_bucket": {"buckets_path": "cats>rev"}},
+    }})
+    aggs = r["aggregations"]
+    assert aggs["best"]["value"] == pytest.approx(100.0)
+    assert aggs["avg_rev"]["value"] == pytest.approx(150.0 / 3)
+    assert aggs["total"]["value"] == pytest.approx(150.0)
+
+
+def test_top_hits_ordered_by_score(sales_node):
+    r = sales_node.search("sales", {"size": 0,
+        "query": {"function_score": {
+            "query": {"match_all": {}},
+            "functions": [{"field_value_factor": {"field": "price"}}],
+            "boost_mode": "replace"}},
+        "aggs": {"cats": {"terms": {"field": "cat"},
+                          "aggs": {"top": {"top_hits": {"size": 1}}}}}})
+    buckets = {b["key"]: b for b in r["aggregations"]["cats"]["buckets"]}
+    # within cat "a", the higher-priced doc scores higher → id "1"
+    assert buckets["a"]["top"]["hits"]["hits"][0]["_id"] == "1"
+    assert buckets["b"]["top"]["hits"]["hits"][0]["_id"] == "3"
+
+
+def test_range_bounds_independent(sales_node):
+    # gte and gt both present: each applies independently (tightest wins);
+    # price exactly 10 must be included by gte=10 even with gt=5 present
+    r = sales_node.search("sales", {"query": {"range": {"price": {
+        "gte": 10, "gt": 5}}}})
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"0", "1", "3", "4"}
+
+
+def test_scroll_preserves_score_order(sales_node):
+    r = sales_node.search("sales", {
+        "query": {"function_score": {
+            "query": {"match_all": {}},
+            "functions": [{"field_value_factor": {"field": "price"}}],
+            "boost_mode": "replace"}},
+        "size": 2}, scroll="1m")
+    ids = [h["_id"] for h in r["hits"]["hits"]]
+    scores = [h["_score"] for h in r["hits"]["hits"]]
+    assert scores == sorted(scores, reverse=True)
+    sid = r["_scroll_id"]
+    while True:
+        r = sales_node.search_service.scroll(sales_node.indices_service, sid)
+        if not r["hits"]["hits"]:
+            break
+        ids += [h["_id"] for h in r["hits"]["hits"]]
+        scores += [h["_score"] for h in r["hits"]["hits"]]
+    assert len(ids) == 5
+    assert scores == sorted(scores, reverse=True)   # global score order
