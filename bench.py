@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Benchmark: MS-MARCO-shaped BM25 top-1000, QPS per chip.
+
+The driver-defined headline metric (BASELINE.json): batched BM25 top-k over
+a passage-scale corpus on one chip, vs a CPU lexical-engine baseline.
+
+Corpus: synthetic Zipf corpus shaped like MS-MARCO passages (default 200k
+docs — overridable via BENCH_DOCS — ~56 tokens/doc, 30k vocab). Queries:
+4-term Zipf-sampled batches (BENCH_BATCH, default 64).
+
+CPU baseline: scipy CSR eager-impact scoring (the BM25S formulation,
+PAPERS.md — generally *faster* than Lucene's postings iteration, so the
+ratio is conservative) + argpartition top-k.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": QPS, "unit": "qps", "vs_baseline": ratio}
+Everything else goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def pick_platform() -> str:
+    """Probe the default JAX backend in a subprocess (the axon TPU tunnel can
+    block indefinitely when down); fall back to cpu."""
+    if os.environ.get("BENCH_PLATFORM"):
+        return os.environ["BENCH_PLATFORM"]
+    probe = ("import jax,sys;"
+             "sys.stdout.write(jax.devices()[0].platform)")
+    try:
+        out = subprocess.run([sys.executable, "-c", probe], timeout=240,
+                             capture_output=True, text=True)
+        if out.returncode == 0 and out.stdout.strip():
+            return "default"
+    except subprocess.TimeoutExpired:
+        pass
+    log("[bench] default backend unavailable; falling back to CPU")
+    return "cpu"
+
+
+def make_corpus(rng, n_docs: int, vocab: int, mean_len: int, max_unique: int):
+    """Vectorized Zipf corpus directly in packed column form."""
+    lens = np.clip(rng.poisson(mean_len, n_docs), 8, 112).astype(np.int32)
+    L = int(lens.max())
+    # zipf-ish: sample from a power-law over the vocab
+    ranks = (rng.pareto(1.1, size=(n_docs, L)) + 1).astype(np.float64)
+    toks = np.minimum((ranks * 3).astype(np.int64), vocab - 1).astype(np.int32)
+    mask = np.arange(L)[None, :] < lens[:, None]
+    toks = np.where(mask, toks, -1)
+
+    # unique terms + counts per row (vectorized)
+    order = np.argsort(toks, axis=1, kind="stable")
+    st = np.take_along_axis(toks, order, axis=1)
+    new = np.ones_like(st, dtype=bool)
+    new[:, 1:] = st[:, 1:] != st[:, :-1]
+    new &= st >= 0
+    uidx = np.cumsum(new, axis=1) - 1              # unique slot per token
+    U = int(new.sum(axis=1).max())
+    U = min(U, max_unique)
+    uterms = np.full((n_docs, U), -1, np.int32)
+    utf = np.zeros((n_docs, U), np.float32)
+    rows = np.repeat(np.arange(n_docs), L).reshape(n_docs, L)
+    valid = (st >= 0) & (uidx < U)
+    np.add.at(utf, (rows[valid], uidx[valid]), 1.0)
+    first = new & valid
+    uterms[rows[first], uidx[first]] = st[first]
+
+    df = np.zeros(vocab, np.int64)
+    np.add.at(df, uterms[uterms >= 0], 1)
+    return uterms, utf, lens, df
+
+
+def make_queries(rng, n_queries: int, vocab: int, terms: int, df):
+    """Query terms sampled from the corpus distribution (common + rare mix)."""
+    present = np.nonzero(df > 0)[0]
+    w = df[present].astype(np.float64)
+    w /= w.sum()
+    qtids = rng.choice(present, size=(n_queries, terms), p=w).astype(np.int32)
+    return qtids
+
+
+def main() -> int:
+    n_docs = int(os.environ.get("BENCH_DOCS", 200_000))
+    vocab = int(os.environ.get("BENCH_VOCAB", 30_000))
+    n_queries = int(os.environ.get("BENCH_QUERIES", 512))
+    batch = int(os.environ.get("BENCH_BATCH", 64))
+    k = int(os.environ.get("BENCH_K", 1000))
+    terms = int(os.environ.get("BENCH_TERMS", 4))
+    max_unique = int(os.environ.get("BENCH_MAX_UNIQUE", 80))
+
+    platform = pick_platform()
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from elasticsearch_tpu.models.bm25 import bm25_topk_batch
+    from elasticsearch_tpu.ops.similarity import BM25Params
+
+    dev = jax.devices()[0]
+    log(f"[bench] device: {dev.platform} ({dev})  corpus={n_docs} docs, "
+        f"vocab={vocab}, k={k}, batch={batch}")
+
+    rng = np.random.default_rng(1234)
+    t0 = time.perf_counter()
+    uterms, utf, lens, df = make_corpus(rng, n_docs, vocab, 56, max_unique)
+    avgdl = float(lens.sum()) / n_docs
+    log(f"[bench] corpus built in {time.perf_counter()-t0:.1f}s  "
+        f"avgdl={avgdl:.1f} U={uterms.shape[1]}")
+
+    qtids_all = make_queries(rng, n_queries, vocab, terms, df)
+    p = BM25Params()
+    idf_table = np.where(
+        df > 0, np.log1p((n_docs - df + 0.5) / (df + 0.5)), 0.0
+    ).astype(np.float32)
+    qidf_all = idf_table[qtids_all]
+
+    # ---- CPU baseline: BM25S-style eager CSR impact scoring ---------------
+    cpu_queries = min(n_queries, int(os.environ.get("BENCH_CPU_QUERIES", 64)))
+    from scipy import sparse
+    valid = uterms >= 0
+    rows = np.repeat(np.arange(n_docs), uterms.shape[1]).reshape(uterms.shape)
+    norm = p.k1 * (1 - p.b + p.b * lens.astype(np.float64) / avgdl)
+    impact = (utf * (p.k1 + 1) / (utf + norm[:, None])).astype(np.float32)
+    mat = sparse.csc_matrix(
+        (impact[valid], (rows[valid], uterms[valid])),
+        shape=(n_docs, vocab))
+    t0 = time.perf_counter()
+    for qi in range(cpu_queries):
+        scores = np.zeros(n_docs, np.float32)
+        for t, w in zip(qtids_all[qi], qidf_all[qi]):
+            col = mat.getcol(int(t))
+            scores[col.indices] += w * col.data
+        top = np.argpartition(scores, -k)[-k:] if n_docs > k else \
+            np.arange(n_docs)
+        top[np.argsort(-scores[top], kind="stable")]
+    cpu_time = time.perf_counter() - t0
+    cpu_qps = cpu_queries / cpu_time
+    log(f"[bench] CPU baseline: {cpu_qps:.1f} QPS "
+        f"({cpu_time*1000/cpu_queries:.2f} ms/query)")
+
+    # ---- device run --------------------------------------------------------
+    d_uterms = jax.device_put(jnp.asarray(uterms), dev)
+    d_utf = jax.device_put(jnp.asarray(utf), dev)
+    d_len = jax.device_put(jnp.asarray(lens), dev)
+    d_live = jax.device_put(jnp.ones(n_docs, bool), dev)
+
+    def run_batch(qt, qi):
+        return bm25_topk_batch(d_uterms, d_utf, d_len, d_live, qt, qi,
+                               np.float32(avgdl), k, p.k1, p.b)
+
+    # warmup/compile
+    qt0 = jax.device_put(jnp.asarray(qtids_all[:batch]), dev)
+    qi0 = jax.device_put(jnp.asarray(qidf_all[:batch]), dev)
+    t0 = time.perf_counter()
+    s, d = run_batch(qt0, qi0)
+    s.block_until_ready()
+    log(f"[bench] compile+first batch: {time.perf_counter()-t0:.1f}s")
+
+    n_batches = max(n_queries // batch, 1)
+    batches = [(jax.device_put(jnp.asarray(qtids_all[i*batch:(i+1)*batch]), dev),
+                jax.device_put(jnp.asarray(qidf_all[i*batch:(i+1)*batch]), dev))
+               for i in range(n_batches)]
+    t0 = time.perf_counter()
+    outs = []
+    for qt, qi in batches:
+        outs.append(run_batch(qt, qi))
+    outs[-1][0].block_until_ready()
+    dt = time.perf_counter() - t0
+    qps = (n_batches * batch) / dt
+    p50 = dt / n_batches * 1000.0   # per-batch latency
+    log(f"[bench] device: {qps:.1f} QPS  ({p50:.1f} ms / {batch}-query batch)")
+
+    # recall sanity: device top-k must match CPU scoring for a few queries
+    s0 = np.asarray(outs[0][0][0])
+    d0 = np.asarray(outs[0][1][0])
+    ref_scores = np.zeros(n_docs, np.float32)
+    for t, w in zip(qtids_all[0], qidf_all[0]):
+        col = mat.getcol(int(t))
+        ref_scores[col.indices] += w * col.data
+    kk = min(k, int((ref_scores > 0).sum()))
+    ref_top = np.sort(ref_scores)[::-1][:kk]
+    got = s0[d0 >= 0][:kk]
+    recall_ok = np.allclose(np.sort(got)[::-1][:kk], ref_top, rtol=2e-4,
+                            atol=1e-5)
+    log(f"[bench] recall parity vs CPU scoring: {recall_ok}")
+
+    print(json.dumps({
+        "metric": "bm25_top1000_qps_per_chip",
+        "value": round(qps, 2),
+        "unit": "qps",
+        "vs_baseline": round(qps / cpu_qps, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

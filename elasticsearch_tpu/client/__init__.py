@@ -173,9 +173,12 @@ class NodeClient(_BaseClient):
         if status >= 400 and not (method == "GET" and status == 404
                                   and isinstance(payload, dict)
                                   and "found" in payload):
-            err = ElasticsearchTpuError(
-                payload.get("error", {}).get("reason", str(payload))
-                if isinstance(payload, dict) else str(payload))
+            reason = str(payload)
+            if isinstance(payload, dict):
+                error = payload.get("error", {})
+                reason = error.get("reason", str(payload)) \
+                    if isinstance(error, dict) else str(error)
+            err = ElasticsearchTpuError(reason)
             err.status = status
             raise err
         return payload

@@ -1,0 +1,21 @@
+"""Model families — packaged retrieval pipelines.
+
+The framework's "models" are retrieval programs over columnar indexes (the
+way the reference's capability surface is BM25 lexical search, scripted
+re-scoring, and script-based vector search — BASELINE.json configs):
+
+* :class:`~elasticsearch_tpu.models.bm25.BM25Retriever` — lexical BM25
+  (configs 1, 2, 5): batched multi-term scoring + top-k, single jitted
+  program per (corpus bucket, T, k) shape.
+* :class:`~elasticsearch_tpu.models.dense.DenseRetriever` — dense-vector
+  brute-force cosine (config 4): one MXU matmul + top-k.
+* :class:`~elasticsearch_tpu.models.hybrid.HybridRetriever` — weighted
+  linear / RRF fusion of the two.
+"""
+
+from elasticsearch_tpu.models.bm25 import BM25Retriever, PackedTextIndex
+from elasticsearch_tpu.models.dense import DenseRetriever
+from elasticsearch_tpu.models.hybrid import HybridRetriever
+
+__all__ = ["BM25Retriever", "PackedTextIndex", "DenseRetriever",
+           "HybridRetriever"]

@@ -1,0 +1,139 @@
+"""BM25Retriever — the flagship lexical scoring pipeline.
+
+The standalone, benchable form of the engine's match-query path
+(BASELINE.json configs 1/2/5): a packed text index (forward impact layout,
+index/segment.py) + one jitted XLA program computing batched BM25 scores and
+top-k. ``__graft_entry__.entry()`` exposes exactly this program.
+
+Reference path being replaced: QueryPhase's collector loop over Lucene
+TermScorers (core/search/query/QueryPhase.java:314) and the per-shard
+fan-out/merge (SearchPhaseController.java:165) — here one device program
+scores Q queries against N docs with zero host round-trips.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.analysis.analyzers import Analyzer, BUILTIN_ANALYZERS
+from elasticsearch_tpu.ops import lexical, topk as topk_ops
+from elasticsearch_tpu.ops.similarity import BM25Params, idf as bm25_idf
+
+
+@dataclass
+class PackedTextIndex:
+    """One field's forward impact index in packed (device-ready) form."""
+    terms: dict[str, int]            # term → id
+    uterms: np.ndarray               # [Np, U] int32
+    utf: np.ndarray                  # [Np, U] float32
+    doc_len: np.ndarray              # [Np] int32
+    live: np.ndarray                 # [Np] bool
+    df: np.ndarray                   # [V] int32
+    num_docs: int
+    total_tokens: int
+
+    @property
+    def avgdl(self) -> float:
+        return self.total_tokens / max(self.num_docs, 1)
+
+    @staticmethod
+    def from_texts(texts: list[str], analyzer: Analyzer | None = None,
+                   pad_docs: int | None = None,
+                   max_unique: int | None = None) -> "PackedTextIndex":
+        analyzer = analyzer or BUILTIN_ANALYZERS["english"]
+        vocab: dict[str, int] = {}
+        doc_counts = []
+        doc_lens = []
+        for text in texts:
+            counts: dict[int, int] = {}
+            toks = analyzer.terms(text)
+            for t in toks:
+                tid = vocab.setdefault(t, len(vocab))
+                counts[tid] = counts.get(tid, 0) + 1
+            doc_counts.append(counts)
+            doc_lens.append(len(toks))
+        n = len(texts)
+        np_docs = pad_docs or n
+        u = max_unique or max((len(c) for c in doc_counts), default=1)
+        uterms = np.full((np_docs, u), -1, np.int32)
+        utf = np.zeros((np_docs, u), np.float32)
+        df = np.zeros(max(len(vocab), 1), np.int32)
+        for i, counts in enumerate(doc_counts):
+            for j, (tid, tf) in enumerate(sorted(counts.items())[:u]):
+                uterms[i, j] = tid
+                utf[i, j] = tf
+                df[tid] += 1
+        doc_len = np.zeros(np_docs, np.int32)
+        doc_len[:n] = doc_lens
+        live = np.zeros(np_docs, bool)
+        live[:n] = True
+        return PackedTextIndex(terms=vocab, uterms=uterms, utf=utf,
+                               doc_len=doc_len, live=live, df=df, num_docs=n,
+                               total_tokens=int(sum(doc_lens)))
+
+
+@partial(jax.jit, static_argnames=("k", "k1", "b"))
+def bm25_topk_batch(uterms, utf, doc_len, live, qtids, qidf, avgdl,
+                    k: int, k1: float = 1.2, b: float = 0.75):
+    """The flagship forward program: Q queries → top-k (scores, doc ids).
+
+    uterms/utf: [N, U]; doc_len/live: [N]; qtids/qidf: [Q, T]; avgdl scalar.
+    Returns (top_scores [Q, k], top_docs [Q, k]).
+    """
+    def one(qt, qi):
+        scores, _ = lexical.bm25_match(
+            uterms, utf, doc_len, qt, qi,
+            jnp.ones(qt.shape[0], jnp.float32), k1, b, avgdl)
+        return topk_ops.top_k(scores, live & (scores > 0), k)
+    return jax.vmap(one)(qtids, qidf)
+
+
+class BM25Retriever:
+    def __init__(self, index: PackedTextIndex,
+                 analyzer: Analyzer | None = None,
+                 params: BM25Params = BM25Params(), device=None):
+        self.index = index
+        self.analyzer = analyzer or BUILTIN_ANALYZERS["english"]
+        self.params = params
+        put = (lambda x: jax.device_put(x, device)) if device is not None \
+            else jax.device_put
+        self.d_uterms = put(index.uterms)
+        self.d_utf = put(index.utf)
+        self.d_doc_len = put(index.doc_len)
+        self.d_live = put(index.live)
+
+    def encode_queries(self, queries: list[str], pad_terms: int | None = None):
+        """Analyze + resolve term ids and idf → packed [Q, T] arrays."""
+        per_q = [self.analyzer.terms(q) for q in queries]
+        t = pad_terms or max((len(x) for x in per_q), default=1)
+        qtids = np.full((len(queries), t), -1, np.int32)
+        qidf = np.zeros((len(queries), t), np.float32)
+        n = self.index.num_docs
+        for i, terms in enumerate(per_q):
+            for j, term in enumerate(terms[:t]):
+                tid = self.index.terms.get(term, -1)
+                qtids[i, j] = tid
+                if tid >= 0:
+                    qidf[i, j] = bm25_idf(float(self.index.df[tid]), n)
+        return qtids, qidf
+
+    def search(self, queries: list[str], k: int = 10):
+        qtids, qidf = self.encode_queries(queries)
+        scores, docs = bm25_topk_batch(
+            self.d_uterms, self.d_utf, self.d_doc_len, self.d_live,
+            jnp.asarray(qtids), jnp.asarray(qidf),
+            np.float32(self.index.avgdl), k,
+            self.params.k1, self.params.b)
+        return np.asarray(scores), np.asarray(docs)
+
+    def search_packed(self, qtids, qidf, k: int = 10):
+        """Pre-encoded query path (bench hot loop — no host analysis)."""
+        return bm25_topk_batch(
+            self.d_uterms, self.d_utf, self.d_doc_len, self.d_live,
+            qtids, qidf, np.float32(self.index.avgdl), k,
+            self.params.k1, self.params.b)

@@ -1,0 +1,55 @@
+"""DenseRetriever — brute-force exact cosine kNN (BASELINE config 4).
+
+Reference-equivalent: script_score cosine over binary doc values
+(core/common/lucene/search/function/ScriptScoreFunction.java), which is a
+per-doc interpreted loop on the JVM. Here the whole batch is one
+[Q, D] × [D, N] MXU matmul + top-k.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.ops import topk as topk_ops
+from elasticsearch_tpu.ops.vector import l2_normalize
+
+
+@partial(jax.jit, static_argnames=("k", "use_bf16"))
+def cosine_topk_batch(vecs, live, qs, k: int, use_bf16: bool = False):
+    """vecs: [N, D] row-normalized; qs: [Q, D] → (scores [Q,k], docs [Q,k])."""
+    qn = l2_normalize(qs, axis=-1)
+    if use_bf16:
+        scores = (qn.astype(jnp.bfloat16) @ vecs.astype(jnp.bfloat16).T
+                  ).astype(jnp.float32)
+    else:
+        scores = qn @ vecs.T
+    def one(s):
+        return topk_ops.top_k(s, live, k)
+    return jax.vmap(one)(scores)
+
+
+class DenseRetriever:
+    def __init__(self, vectors: np.ndarray, num_docs: int | None = None,
+                 device=None, use_bf16: bool = False):
+        n = num_docs if num_docs is not None else vectors.shape[0]
+        norms = np.linalg.norm(vectors, axis=1, keepdims=True)
+        normed = (vectors / np.maximum(norms, 1e-12)).astype(np.float32)
+        live = np.zeros(vectors.shape[0], bool)
+        live[:n] = True
+        put = (lambda x: jax.device_put(x, device)) if device is not None \
+            else jax.device_put
+        self.d_vecs = put(normed)
+        self.d_live = put(live)
+        self.use_bf16 = use_bf16
+        self.num_docs = n
+        self.dims = vectors.shape[1]
+
+    def search(self, queries: np.ndarray, k: int = 10):
+        scores, docs = cosine_topk_batch(self.d_vecs, self.d_live,
+                                         jnp.asarray(queries, jnp.float32),
+                                         k, self.use_bf16)
+        return np.asarray(scores), np.asarray(docs)

@@ -33,10 +33,15 @@ def top_k(scores, mask, k: int, doc_base: int = 0):
     have score -inf and doc id -1.
     """
     masked = jnp.where(mask, scores, NEG_INF)
-    top_scores, idx = jax.lax.top_k(masked, k)
+    kk = min(k, masked.shape[-1])
+    top_scores, idx = jax.lax.top_k(masked, kk)
     valid = top_scores > NEG_INF
     top_docs = jnp.where(valid, idx.astype(jnp.int32) + doc_base, -1)
-    return jnp.where(valid, top_scores, NEG_INF), top_docs
+    top_scores = jnp.where(valid, top_scores, NEG_INF)
+    if kk < k:   # corpus smaller than k: pad to the requested static width
+        top_scores = jnp.pad(top_scores, (0, k - kk), constant_values=NEG_INF)
+        top_docs = jnp.pad(top_docs, (0, k - kk), constant_values=-1)
+    return top_scores, top_docs
 
 
 def merge_top_k(scores_list, docs_list, k: int):

@@ -1,0 +1,5 @@
+from elasticsearch_tpu.parallel.mesh import make_mesh
+from elasticsearch_tpu.parallel.distributed import (
+    DistributedBM25, distributed_bm25_step)
+
+__all__ = ["make_mesh", "DistributedBM25", "distributed_bm25_step"]

@@ -1,0 +1,184 @@
+"""Distributed query execution — shard_map over the ("dp", "shard") mesh.
+
+This is the TPU-native replacement for the reference's scatter-gather data
+plane (SURVEY.md §2.2/§2.10): where Elasticsearch fans a query out over
+per-shard RPCs (TransportSearchTypeAction.java:137) and merges top-k at the
+coordinator (SearchPhaseController.sortDocs:165), here the whole
+fan-out → score → local top-k → merge runs as ONE jitted SPMD program:
+
+* corpus columns are sharded over the ``shard`` mesh axis (doc partition =
+  the reference's hash-routed shard, cluster/routing.py);
+* the query batch is sharded over ``dp`` (concurrent-searches axis);
+* global term statistics (the DFS_QUERY_THEN_FETCH round, DfsPhase.java:45 +
+  aggregateDfs SearchPhaseController.java:105-154) are one ``psum`` over
+  the shard axis;
+* the cross-shard top-k merge is ``all_gather`` over ICI + re-top-k,
+  replicated — no host round-trip, no RPC, no serialization.
+
+Per-shard term ids differ (per-segment dictionaries), so query arrays carry
+a leading shard axis resolved host-side: qtids[S, Q, T]. df[S, Q, T] is the
+shard-local doc frequency of each query term; idf is computed *inside* the
+program from psum'd df — exactly the reference's two-phase DFS collapsed
+into the scoring program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from elasticsearch_tpu.ops import lexical, topk as topk_ops
+
+
+def _local_bm25_topk(uterms, utf, doc_len, live, qtids, qidf, avgdl,
+                     k: int, k1: float, b: float, doc_base):
+    """Per-device: score Qd queries over the local doc partition, local top-k."""
+    def one(qt, qi):
+        scores, _ = lexical.bm25_match(
+            uterms, utf, doc_len, qt, qi,
+            jnp.ones(qt.shape[0], jnp.float32), k1, b, avgdl)
+        return topk_ops.top_k(scores, live & (scores > 0), k,
+                              doc_base=doc_base)
+    return jax.vmap(one)(qtids, qidf)
+
+
+def distributed_bm25_step(mesh: Mesh, k: int, k1: float = 1.2, b: float = 0.75):
+    """Build the jitted distributed query step for a given mesh/k.
+
+    Returns ``step(uterms, utf, doc_len, live, qtids, qdf, num_docs,
+    total_tokens) -> (scores [Q, k], docs [Q, k], total_hits [Q])`` where:
+      uterms/utf: [S·Np, U] sharded P("shard");  doc_len/live: [S·Np];
+      qtids: [S, Q, T] (per-shard term ids) sharded P("shard", "dp");
+      qdf:   [S, Q, T] shard-local df, psum'd in-program → global idf;
+      num_docs / total_tokens: [S] per-shard scalars (psum'd → global stats).
+    """
+    def step_local(uterms, utf, doc_len, live, qtids, qdf, num_docs,
+                   total_tokens):
+        # ---- DFS phase: global collection statistics via psum over ICI ----
+        n_total = jax.lax.psum(num_docs[0], "shard")               # scalar
+        toks_total = jax.lax.psum(total_tokens[0], "shard")
+        df_total = jax.lax.psum(qdf[0], "shard")                   # [Qd, T]
+        avgdl = toks_total.astype(jnp.float32) / jnp.maximum(n_total, 1)
+        nf = n_total.astype(jnp.float32)
+        qidf = jnp.where(df_total > 0,
+                         jnp.log1p((nf - df_total + 0.5) / (df_total + 0.5)),
+                         0.0)
+        # ---- query phase: local scoring + local top-k ---------------------
+        shard_idx = jax.lax.axis_index("shard")
+        doc_base = shard_idx.astype(jnp.int32) * uterms.shape[0]
+        qt = qtids[0]                                              # [Qd, T]
+        local_scores, local_docs = _local_bm25_topk(
+            uterms, utf, doc_len, live, qt, qidf, avgdl, k, k1, b, doc_base)
+        # total hits (count phase) — psum of local match counts
+        def count_one(qrow):
+            nmatch = jnp.zeros(uterms.shape[0], jnp.int32)
+            for t in range(qrow.shape[0]):
+                hit = ((uterms == qrow[t]) & (qrow[t] >= 0)).any(axis=1)
+                nmatch = nmatch | hit.astype(jnp.int32)
+            return (nmatch.astype(jnp.bool_) & live).sum(dtype=jnp.int32)
+        local_hits = jax.vmap(count_one)(qt)                       # [Qd]
+        total_hits = jax.lax.psum(local_hits, "shard")
+        # ---- reduce phase: all_gather over ICI + re-top-k -----------------
+        all_scores = jax.lax.all_gather(local_scores, "shard")     # [S, Qd, k]
+        all_docs = jax.lax.all_gather(local_docs, "shard")
+        s = all_scores.shape[0]
+        flat_scores = jnp.moveaxis(all_scores, 0, 1).reshape(-1, s * k)
+        flat_docs = jnp.moveaxis(all_docs, 0, 1).reshape(-1, s * k)
+        top_scores, pos = jax.lax.top_k(flat_scores, k)            # [Qd, k]
+        top_docs = jnp.take_along_axis(flat_docs, pos, axis=1)
+        return top_scores, top_docs, total_hits
+
+    mapped = shard_map(
+        step_local, mesh=mesh,
+        in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
+                  P("shard", "dp"), P("shard", "dp"), P("shard"), P("shard")),
+        out_specs=(P("dp"), P("dp"), P("dp")),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+class DistributedBM25:
+    """Host-side wrapper: packs per-shard indexes onto the mesh and drives
+    the distributed step (the coordinator role, minus all its RPCs)."""
+
+    def __init__(self, mesh: Mesh, shard_indexes, analyzer=None,
+                 k1: float = 1.2, b: float = 0.75):
+        from elasticsearch_tpu.analysis.analyzers import BUILTIN_ANALYZERS
+        self.mesh = mesh
+        self.analyzer = analyzer or BUILTIN_ANALYZERS["english"]
+        self.k1, self.b = k1, b
+        self.shards = list(shard_indexes)        # list[PackedTextIndex], len S
+        s = len(self.shards)
+        if s != mesh.shape["shard"]:
+            raise ValueError(f"{s} shards != mesh shard axis "
+                             f"{mesh.shape['shard']}")
+        np_docs = max(sh.uterms.shape[0] for sh in self.shards)
+        u = max(sh.uterms.shape[1] for sh in self.shards)
+
+        def pad(a, rows, cols=None, fill=0):
+            out_shape = (rows,) if cols is None else (rows, cols)
+            out = np.full(out_shape, fill, a.dtype)
+            out[tuple(slice(0, d) for d in a.shape)] = a
+            return out
+
+        uterms = np.concatenate([pad(sh.uterms, np_docs, u, -1)
+                                 for sh in self.shards])
+        utf = np.concatenate([pad(sh.utf, np_docs, u, 0)
+                              for sh in self.shards])
+        doc_len = np.concatenate([pad(sh.doc_len, np_docs, fill=0)
+                                  for sh in self.shards])
+        live = np.concatenate([pad(sh.live, np_docs, fill=False)
+                               for sh in self.shards])
+        self.np_docs = np_docs
+        shard_sharding = NamedSharding(mesh, P("shard"))
+        self.d_uterms = jax.device_put(uterms, shard_sharding)
+        self.d_utf = jax.device_put(utf, shard_sharding)
+        self.d_doc_len = jax.device_put(doc_len, shard_sharding)
+        self.d_live = jax.device_put(live, shard_sharding)
+        self.d_num_docs = jax.device_put(
+            np.asarray([sh.num_docs for sh in self.shards], np.int32),
+            shard_sharding)
+        self.d_total_tokens = jax.device_put(
+            np.asarray([sh.total_tokens for sh in self.shards], np.int64)
+            .astype(np.int32), shard_sharding)
+        self._steps: dict[int, callable] = {}
+
+    def encode_queries(self, queries: list[str], pad_terms: int | None = None):
+        """→ qtids [S, Q, T] per-shard ids, qdf [S, Q, T] shard-local df."""
+        per_q = [self.analyzer.terms(q) for q in queries]
+        t = pad_terms or max((len(x) for x in per_q), default=1)
+        s = len(self.shards)
+        qtids = np.full((s, len(queries), t), -1, np.int32)
+        qdf = np.zeros((s, len(queries), t), np.float32)
+        for si, sh in enumerate(self.shards):
+            for i, terms in enumerate(per_q):
+                for j, term in enumerate(terms[:t]):
+                    tid = sh.terms.get(term, -1)
+                    qtids[si, i, j] = tid
+                    if tid >= 0:
+                        qdf[si, i, j] = sh.df[tid]
+        return qtids, qdf
+
+    def step_for(self, k: int):
+        if k not in self._steps:
+            self._steps[k] = distributed_bm25_step(self.mesh, k, self.k1, self.b)
+        return self._steps[k]
+
+    def search(self, queries: list[str], k: int = 10):
+        qtids, qdf = self.encode_queries(queries)
+        q_sharding = NamedSharding(self.mesh, P("shard", "dp"))
+        scores, docs, totals = self.step_for(k)(
+            self.d_uterms, self.d_utf, self.d_doc_len, self.d_live,
+            jax.device_put(qtids, q_sharding),
+            jax.device_put(qdf, q_sharding),
+            self.d_num_docs, self.d_total_tokens)
+        return np.asarray(scores), np.asarray(docs), np.asarray(totals)
+
+    def resolve(self, global_doc: int) -> tuple[int, int]:
+        """global doc id → (shard, local doc)."""
+        return divmod(int(global_doc), self.np_docs)

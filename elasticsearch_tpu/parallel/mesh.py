@@ -1,0 +1,32 @@
+"""Device mesh construction.
+
+The shard axis maps the reference's doc-partitioned shards
+(OperationRouting.java:238) onto devices; the dp axis parallelizes the query
+batch (the analog of concurrent search requests spread over replicas,
+IndexShardRoutingTable copy rotation). Multi-host: `jax.devices()` already
+spans hosts under jax.distributed, and the same named axes ride ICI within
+a slice and DCN across slices — collectives need no code change.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(dp: int | None = None, shard: int | None = None,
+              devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shard is None and dp is None:
+        dp = 1
+        shard = n
+    elif shard is None:
+        shard = n // dp
+    elif dp is None:
+        dp = n // shard
+    if dp * shard != n:
+        raise ValueError(f"mesh {dp}x{shard} != {n} devices")
+    arr = np.asarray(devices).reshape(dp, shard)
+    return Mesh(arr, ("dp", "shard"))

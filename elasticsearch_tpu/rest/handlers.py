@@ -116,6 +116,19 @@ class Handlers:
     def __init__(self, node):
         self.node = node
 
+    @staticmethod
+    def _check_type(req: RestRequest) -> None:
+        """The ES 2.x /{index}/{type}/... document routes must not swallow
+        unimplemented _-prefixed admin endpoints (e.g. /idx/_cache/clear):
+        type names may not start with '_' (reference: MapperService type
+        validation)."""
+        t = req.path_params.get("type")
+        if t is not None and t.startswith("_"):
+            from elasticsearch_tpu.common.errors import IllegalArgumentError
+            raise IllegalArgumentError(
+                f"no handler for path [{req.path}]: type name [{t}] "
+                f"must not start with '_'")
+
     # ---- root -------------------------------------------------------------
 
     def root(self, req: RestRequest):
@@ -283,6 +296,7 @@ class Handlers:
     # ---- documents --------------------------------------------------------
 
     def index_doc(self, req: RestRequest):
+        self._check_type(req)
         version = req.param("version")
         resp = self.node.index_doc(
             req.path_params["index"], req.path_params["id"], req.body or {},
@@ -293,6 +307,7 @@ class Handlers:
         return (201 if resp["created"] else 200), resp
 
     def index_doc_auto_id(self, req: RestRequest):
+        self._check_type(req)
         resp = self.node.index_doc(
             req.path_params["index"], None, req.body or {},
             routing=req.param("routing"),
@@ -307,12 +322,14 @@ class Handlers:
         return 201, resp
 
     def get_doc(self, req: RestRequest):
+        self._check_type(req)
         resp = self.node.get_doc(req.path_params["index"],
                                  req.path_params["id"],
                                  routing=req.param("routing"))
         return (200 if resp["found"] else 404), resp
 
     def get_source(self, req: RestRequest):
+        self._check_type(req)
         resp = self.node.get_doc(req.path_params["index"],
                                  req.path_params["id"],
                                  routing=req.param("routing"))
@@ -321,6 +338,7 @@ class Handlers:
         return 200, resp["_source"]
 
     def delete_doc(self, req: RestRequest):
+        self._check_type(req)
         resp = self.node.delete_doc(req.path_params["index"],
                                     req.path_params["id"],
                                     routing=req.param("routing"),
@@ -328,6 +346,7 @@ class Handlers:
         return 200, resp
 
     def update_doc(self, req: RestRequest):
+        self._check_type(req)
         resp = self.node.update_doc(req.path_params["index"],
                                     req.path_params["id"], req.body or {},
                                     routing=req.param("routing"),
@@ -341,24 +360,33 @@ class Handlers:
     # ---- bulk -------------------------------------------------------------
 
     def bulk(self, req: RestRequest):
+        from elasticsearch_tpu.common.errors import IllegalArgumentError
         default_index = req.path_params.get("index")
         ops = []
         lines = req.raw_body.decode("utf-8").splitlines()
         i = 0
-        while i < len(lines):
-            line = lines[i].strip()
-            i += 1
-            if not line:
-                continue
-            action_line = json.loads(line)
-            (action, meta), = action_line.items()
-            meta = dict(meta or {})
-            meta.setdefault("_index", default_index)
-            source = None
-            if action in ("index", "create", "update"):
-                source = json.loads(lines[i])
+        try:
+            while i < len(lines):
+                line = lines[i].strip()
                 i += 1
-            ops.append((action, meta, source))
+                if not line:
+                    continue
+                action_line = json.loads(line)
+                (action, meta), = action_line.items()
+                meta = dict(meta or {})
+                meta.setdefault("_index", default_index)
+                source = None
+                if action in ("index", "create", "update"):
+                    if i >= len(lines):
+                        raise IllegalArgumentError(
+                            f"malformed bulk body: action [{action}] "
+                            f"without a source line")
+                    source = json.loads(lines[i])
+                    i += 1
+                ops.append((action, meta, source))
+        except (json.JSONDecodeError, ValueError) as e:
+            raise IllegalArgumentError(
+                f"malformed bulk body: {e}") from None
         resp = self.node.bulk(ops, refresh=req.param_as_bool("refresh"))
         return 200, resp
 

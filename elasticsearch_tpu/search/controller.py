@@ -44,15 +44,18 @@ def sort_docs(results: list[ShardQueryResult],
         import functools
         orders = [(list(spec.values())[0].get("order", "asc")) == "desc"
                   for spec in req.sort]
+        missing_first = [(list(spec.values())[0].get("missing", "_last"))
+                         == "_first" for spec in req.sort]
 
         def cmp_refs(a: MergedHitRef, b: MergedHitRef) -> int:
-            for va, vb, desc in zip(a.sort_values, b.sort_values, orders):
+            for va, vb, desc, mfirst in zip(a.sort_values, b.sort_values,
+                                            orders, missing_first):
                 if va == vb:
                     continue
-                if va is None:   # missing sorts last regardless of order
-                    return 1
+                if va is None:   # missing placement per the sort spec
+                    return -1 if mfirst else 1
                 if vb is None:
-                    return -1
+                    return 1 if mfirst else -1
                 if isinstance(va, str) or isinstance(vb, str):
                     va, vb = str(va), str(vb)
                 c = 1 if va > vb else -1

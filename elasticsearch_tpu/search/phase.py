@@ -258,7 +258,10 @@ class ShardSearcher:
                                  np.int64)
                 first = kcol.ords[:, 0]
                 have = first >= 0
-                ranks = np.full(first.shape, np.inf)
+                # same missing semantics as the numeric branch: _last default
+                fill = np.inf if (missing == "_last") == (order == "asc") \
+                    else -np.inf
+                ranks = np.full(first.shape, fill, np.float64)
                 ranks[have] = remap[first[have]]
                 cols.append(ranks)
                 out = np.full(first.shape, None, dtype=object)
@@ -275,30 +278,31 @@ class ShardSearcher:
                             order_idx):
         """Keep docs strictly after the cursor in sort order. Cursor values
         are the emitted hit['sort'] values (numbers or keyword strings)."""
-        def cmp_vals(a, b) -> int:
-            # None == missing == sorts last in either direction
-            if a is None and b is None:
-                return 0
-            if a is None:
-                return 1
-            if b is None:
-                return -1
-            if isinstance(a, str) or isinstance(b, str):
-                a, b = str(a), str(b)
-            else:
-                a, b = float(a), float(b)
-            return 0 if a == b else (1 if a > b else -1)
-
         keep = []
         for d in order_idx:
             cmp = 0
             for i, (fname, order) in enumerate(sort_specs):
                 if i >= len(after):
                     break
-                c = cmp_vals(per_hit_out[i][d], after[i])
-                if c != 0:
-                    cmp = c if order == "asc" else -c
+                a, b = per_hit_out[i][d], after[i]
+                if a is None and b is None:
+                    continue
+                # missing sorts last REGARDLESS of order — no desc negation
+                if a is None:
+                    cmp = 1
                     break
+                if b is None:
+                    cmp = -1
+                    break
+                if isinstance(a, str) or isinstance(b, str):
+                    a, b = str(a), str(b)
+                else:
+                    a, b = float(a), float(b)
+                if a == b:
+                    continue
+                c = 1 if a > b else -1
+                cmp = c if order == "asc" else -c
+                break
             if cmp > 0:
                 keep.append(d)
         return np.asarray(keep, dtype=order_idx.dtype)

@@ -136,6 +136,10 @@ class SearchService:
             resp["_scroll_id"] = scroll_id
             return resp
         body = dict(ctx.body)   # already carries the _doc-tie-broken sort
+        # aggregations are computed once on the first page only (ES behavior;
+        # re-running them every page would repeat the full collection)
+        body.pop("aggs", None)
+        body.pop("aggregations", None)
         if ctx.last_sort_key is not None:
             body["search_after"] = ctx.last_sort_key
         req = parse_search_request(body)
