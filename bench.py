@@ -203,8 +203,11 @@ def main() -> int:
         "value": round(qps, 2),
         "unit": "qps",
         "vs_baseline": round(qps / cpu_qps, 3),
+        "recall_ok": bool(recall_ok),
     }))
-    return 0
+    # the parity check gates the metric: a fast-but-wrong result must not
+    # be recorded as a pass
+    return 0 if recall_ok else 1
 
 
 if __name__ == "__main__":

@@ -61,9 +61,10 @@ def distributed_bm25_step(mesh: Mesh, k: int, k1: float = 1.2, b: float = 0.75):
                    total_tokens):
         # ---- DFS phase: global collection statistics via psum over ICI ----
         n_total = jax.lax.psum(num_docs[0], "shard")               # scalar
-        toks_total = jax.lax.psum(total_tokens[0], "shard")
+        toks_total = jax.lax.psum(total_tokens[0].astype(jnp.float32),
+                                  "shard")
         df_total = jax.lax.psum(qdf[0], "shard")                   # [Qd, T]
-        avgdl = toks_total.astype(jnp.float32) / jnp.maximum(n_total, 1)
+        avgdl = toks_total / jnp.maximum(n_total, 1).astype(jnp.float32)
         nf = n_total.astype(jnp.float32)
         qidf = jnp.where(df_total > 0,
                          jnp.log1p((nf - df_total + 0.5) / (df_total + 0.5)),
@@ -143,9 +144,12 @@ class DistributedBM25:
         self.d_num_docs = jax.device_put(
             np.asarray([sh.num_docs for sh in self.shards], np.int32),
             shard_sharding)
+        # float32, not int32: shards beyond ~2.1B tokens would wrap an int32
+        # psum and silently invert BM25 length normalization; float32's
+        # ~1e-7 relative rounding is harmless in avgdl
         self.d_total_tokens = jax.device_put(
-            np.asarray([sh.total_tokens for sh in self.shards], np.int64)
-            .astype(np.int32), shard_sharding)
+            np.asarray([sh.total_tokens for sh in self.shards], np.float32),
+            shard_sharding)
         self._steps: dict[int, callable] = {}
 
     def encode_queries(self, queries: list[str], pad_terms: int | None = None):
@@ -171,13 +175,26 @@ class DistributedBM25:
 
     def search(self, queries: list[str], k: int = 10):
         qtids, qdf = self.encode_queries(queries)
+        # pad the query batch up to a multiple of the dp axis (the batch is
+        # sharded over dp; XLA requires even divisibility), trim after
+        dp = self.mesh.shape["dp"]
+        nq = len(queries)
+        padded_q = -(-nq // dp) * dp
+        if padded_q != nq:
+            qtids = np.concatenate(
+                [qtids, np.full((qtids.shape[0], padded_q - nq,
+                                 qtids.shape[2]), -1, qtids.dtype)], axis=1)
+            qdf = np.concatenate(
+                [qdf, np.zeros((qdf.shape[0], padded_q - nq, qdf.shape[2]),
+                               qdf.dtype)], axis=1)
         q_sharding = NamedSharding(self.mesh, P("shard", "dp"))
         scores, docs, totals = self.step_for(k)(
             self.d_uterms, self.d_utf, self.d_doc_len, self.d_live,
             jax.device_put(qtids, q_sharding),
             jax.device_put(qdf, q_sharding),
             self.d_num_docs, self.d_total_tokens)
-        return np.asarray(scores), np.asarray(docs), np.asarray(totals)
+        return (np.asarray(scores)[:nq], np.asarray(docs)[:nq],
+                np.asarray(totals)[:nq])
 
     def resolve(self, global_doc: int) -> tuple[int, int]:
         """global doc id → (shard, local doc)."""

@@ -372,7 +372,16 @@ class Handlers:
                 if not line:
                     continue
                 action_line = json.loads(line)
+                if not isinstance(action_line, dict) or \
+                        len(action_line) != 1:
+                    raise IllegalArgumentError(
+                        "malformed bulk body: expected a single-key action "
+                        f"object, got [{line[:80]}]")
                 (action, meta), = action_line.items()
+                if meta is not None and not isinstance(meta, dict):
+                    raise IllegalArgumentError(
+                        f"malformed bulk body: action [{action}] metadata "
+                        "must be an object")
                 meta = dict(meta or {})
                 meta.setdefault("_index", default_index)
                 source = None

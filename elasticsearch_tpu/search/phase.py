@@ -199,7 +199,7 @@ class ShardSearcher:
             (fname, opts), = spec.items()
             order = opts.get("order", "asc")
             missing = opts.get("missing", "_last")
-            sort_specs.append((fname, order))
+            sort_specs.append((fname, order, missing))
             if fname == "_score":
                 vals = scores.astype(np.float64)
                 out = vals
@@ -281,18 +281,21 @@ class ShardSearcher:
         keep = []
         for d in order_idx:
             cmp = 0
-            for i, (fname, order) in enumerate(sort_specs):
+            for i, (fname, order, missing) in enumerate(sort_specs):
                 if i >= len(after):
                     break
                 a, b = per_hit_out[i][d], after[i]
                 if a is None and b is None:
                     continue
-                # missing sorts last REGARDLESS of order — no desc negation
-                if a is None:
-                    cmp = 1
-                    break
-                if b is None:
-                    cmp = -1
+                # missing docs sit first or last in *result order* per the
+                # `missing` option (matches _sort_column's fill and the
+                # coordinator merge) — no desc negation
+                if a is None or b is None:
+                    missing_after = missing != "_first"
+                    if a is None:
+                        cmp = 1 if missing_after else -1
+                    else:
+                        cmp = -1 if missing_after else 1
                     break
                 if isinstance(a, str) or isinstance(b, str):
                     a, b = str(a), str(b)

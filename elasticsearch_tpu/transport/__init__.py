@@ -1,0 +1,28 @@
+"""Transport — the distributed communication backend.
+
+Reference: core/transport/ — `TransportService` (TransportService.java)
+request/response RPC over named actions; `NettyTransport`
+(netty/NettyTransport.java:142) length-framed binary TCP; `LocalTransport`
+(local/LocalTransport.java) in-process seam used by the whole test strategy.
+
+TPU-native stance (SURVEY.md §2.2): this layer is the *control plane* —
+cluster state publish, replication verbs, recovery streams, admin fan-out.
+The query *data plane* inside a slice rides ICI collectives
+(parallel/distributed.py shard_map programs), not per-shard RPC.
+"""
+
+from elasticsearch_tpu.transport.stream import StreamInput, StreamOutput
+from elasticsearch_tpu.transport.service import (
+    TransportService, TransportException, ActionNotFoundError,
+    ConnectTransportError, ReceiveTimeoutError, RemoteTransportError,
+    NodeDisconnectedError, TransportAddress, DiscoveryNode,
+)
+from elasticsearch_tpu.transport.local import LocalTransport, LocalTransportHub
+from elasticsearch_tpu.transport.tcp import TcpTransport
+
+__all__ = [
+    "StreamInput", "StreamOutput", "TransportService", "TransportException",
+    "ActionNotFoundError", "ConnectTransportError", "ReceiveTimeoutError",
+    "RemoteTransportError", "NodeDisconnectedError", "TransportAddress",
+    "DiscoveryNode", "LocalTransport", "LocalTransportHub", "TcpTransport",
+]

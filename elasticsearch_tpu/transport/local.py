@@ -1,0 +1,147 @@
+"""LocalTransport — in-process transport for tests and embedded clusters.
+
+Reference: core/transport/local/LocalTransport.java — nodes in one JVM wired
+through a static address registry; messages still serialized, delivered on a
+worker pool. This is the seam that makes the entire distributed system
+testable in one process (SURVEY.md §4: InternalTestCluster runs N full nodes
+over LocalTransport), and it carries the disruption hooks
+(test/test/transport/MockTransportService.java analog): an outbound rule
+callback may DROP a message, DELAY it, or let it pass.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+from elasticsearch_tpu.transport.service import (
+    ConnectTransportError, DiscoveryNode, TransportAddress)
+
+DROP = "drop"
+
+
+class LocalTransportHub:
+    """Shared address registry — one per in-process cluster
+    (LocalTransport.java `transports` static map, scoped per test cluster
+    so parallel clusters don't collide)."""
+
+    _ports = itertools.count(9300)
+
+    def __init__(self):
+        self._transports: dict[TransportAddress, LocalTransport] = {}
+        self._lock = threading.Lock()
+
+    def register(self, t: "LocalTransport") -> TransportAddress:
+        with self._lock:
+            addr = TransportAddress("local", next(self._ports))
+            self._transports[addr] = t
+            return addr
+
+    def unregister(self, addr: TransportAddress) -> None:
+        with self._lock:
+            self._transports.pop(addr, None)
+
+    def lookup(self, addr: TransportAddress) -> Optional["LocalTransport"]:
+        with self._lock:
+            return self._transports.get(addr)
+
+    def addresses(self) -> list[TransportAddress]:
+        with self._lock:
+            return list(self._transports)
+
+
+class LocalTransport:
+    """One per node. Delivery happens on the receiving node's worker pool so
+    caller threads never run remote handlers inline (matching the async
+    delivery of LocalTransport.java `workers`)."""
+
+    def __init__(self, hub: LocalTransportHub):
+        self.hub = hub
+        self._service = None
+        self._address: TransportAddress | None = None
+        self._pool = ThreadPoolExecutor(max_workers=4,
+                                        thread_name_prefix="local_transport")
+        self._closed = False
+        # Disruption hook: rule(to_address, action) -> None | DROP | float
+        # (seconds of delay). Set by disruption schemes (test support).
+        self.outbound_rule: Callable | None = None
+
+    # ---- Transport interface ----------------------------------------------
+
+    def bind(self, service) -> None:
+        self._service = service
+        self._address = self.hub.register(self)
+
+    def bound_address(self) -> TransportAddress:
+        return self._address
+
+    def close(self) -> None:
+        self._closed = True
+        self.hub.unregister(self._address)
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def send_request(self, node: DiscoveryNode, request_id: int, action: str,
+                     payload: bytes) -> None:
+        target = self._ruled_lookup(node.address, action)
+        if target is None:
+            return                              # dropped by disruption rule
+        version = min(self._service.local_node.version, node.version)
+        source = self._service.local_node
+        target._deliver(
+            lambda: target._service.on_request(source, request_id, action,
+                                               payload, version))
+
+    def send_response(self, node: DiscoveryNode, request_id: int,
+                      payload: bytes | None, error) -> None:
+        # Responses ride the same disruption rules (a partition cuts both
+        # directions; NetworkPartition.java severs request and response).
+        target = self._ruled_lookup(node.address, "<response>",
+                                    raise_on_missing=False)
+        if target is None:
+            return
+        version = min(self._service.local_node.version, node.version)
+        target._deliver(
+            lambda: target._service.on_response(request_id, payload, error,
+                                                version))
+
+    # ---- internals ---------------------------------------------------------
+
+    def _ruled_lookup(self, addr: TransportAddress, action: str,
+                      raise_on_missing: bool = True):
+        if self._closed:
+            raise ConnectTransportError("transport closed")
+        rule = self.outbound_rule
+        delay = None
+        if rule is not None:
+            verdict = rule(addr, action)
+            if verdict == DROP:
+                return None
+            if isinstance(verdict, (int, float)) and verdict > 0:
+                delay = float(verdict)
+        target = self.hub.lookup(addr)
+        if target is None or target._closed:
+            if raise_on_missing:
+                raise ConnectTransportError(f"no node at {addr}")
+            return None
+        if delay:
+            timer = threading.Timer(delay, lambda: None)
+            # Delayed delivery: re-dispatch after the timer fires.
+            real_target = target
+
+            class _Delayed:
+                def _deliver(self, fn):
+                    t = threading.Timer(delay, real_target._deliver, (fn,))
+                    t.daemon = True
+                    t.start()
+            return _Delayed()
+        return target
+
+    def _deliver(self, fn) -> None:
+        if self._closed:
+            return
+        try:
+            self._pool.submit(fn)
+        except RuntimeError:
+            pass                                # pool shut down during close

@@ -1,0 +1,328 @@
+"""TransportService — request/response RPC over named actions.
+
+Reference: core/transport/TransportService.java — handler registry
+(`registerRequestHandler`), `sendRequest` with timeout handling
+(TimeoutHandler), response-handler table keyed by request id, tracer hook
+(`transport.tracer.include`), and the local-node shortcut. Payloads always
+round-trip through the wire codec (stream.py) even in-process, so the
+LocalTransport test seam exercises the same serialization as TCP —
+mirroring how LocalTransport.java still serializes messages.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable
+
+from elasticsearch_tpu.transport.stream import (
+    CURRENT_VERSION, StreamInput, StreamOutput)
+
+
+class TransportException(Exception):
+    pass
+
+
+class ActionNotFoundError(TransportException):
+    pass
+
+
+class ConnectTransportError(TransportException):
+    pass
+
+
+class NodeDisconnectedError(ConnectTransportError):
+    pass
+
+
+class ReceiveTimeoutError(TransportException):
+    pass
+
+
+class RemoteTransportError(TransportException):
+    """Failure raised by the remote handler; carries the remote error type."""
+
+    def __init__(self, node_name: str, action: str, error_type: str,
+                 reason: str):
+        super().__init__(f"[{node_name}][{action}] {error_type}: {reason}")
+        self.node_name = node_name
+        self.action = action
+        self.error_type = error_type
+        self.reason = reason
+
+
+@dataclass(frozen=True)
+class TransportAddress:
+    host: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+@dataclass(frozen=True)
+class DiscoveryNode:
+    """Reference: core/cluster/node/DiscoveryNode.java — id, name, address,
+    attributes (data/master roles), wire version."""
+    node_id: str
+    name: str
+    address: TransportAddress
+    attributes: tuple = ()
+    version: int = CURRENT_VERSION
+
+    @property
+    def master_eligible(self) -> bool:
+        return dict(self.attributes).get("master", "true") == "true"
+
+    @property
+    def data_node(self) -> bool:
+        return dict(self.attributes).get("data", "true") == "true"
+
+    def to_wire(self, out: StreamOutput) -> None:
+        out.write_string(self.node_id)
+        out.write_string(self.name)
+        out.write_string(self.address.host)
+        out.write_int(self.address.port)
+        out.write_value(dict(self.attributes))
+        out.write_vint(self.version)
+
+    @staticmethod
+    def from_wire(inp: StreamInput) -> "DiscoveryNode":
+        return DiscoveryNode(
+            node_id=inp.read_string(), name=inp.read_string(),
+            address=TransportAddress(inp.read_string(), inp.read_int()),
+            attributes=tuple(sorted(inp.read_value().items())),
+            version=inp.read_vint())
+
+
+class TransportChannel:
+    """Reply channel handed to request handlers (TransportChannel.java)."""
+
+    def __init__(self, service: "TransportService", source: DiscoveryNode,
+                 request_id: int, action: str):
+        self._service = service
+        self.source_node = source
+        self.request_id = request_id
+        self.action = action
+        self._done = False
+
+    def send_response(self, response: dict | None) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._service._reply(self.source_node, self.request_id,
+                             response or {}, None)
+
+    def send_failure(self, error: Exception) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._service._reply(self.source_node, self.request_id, None, error)
+
+
+@dataclass
+class _RequestHandler:
+    action: str
+    handler: Callable                       # (request: dict, channel) -> None
+    executor: str = "generic"               # "same" = run on delivery thread
+
+
+@dataclass
+class _ResponseContext:
+    future: Future
+    node: DiscoveryNode
+    action: str
+    timer: threading.Timer | None = None
+    sent_at: float = field(default_factory=time.monotonic)
+
+
+class TransportService:
+    """One per node. Owns the handler registry and in-flight request table;
+    delegates byte movement to a Transport (local.py / tcp.py)."""
+
+    def __init__(self, transport, local_node_factory, executor=None):
+        """`local_node_factory(bound_address) -> DiscoveryNode` — the node
+        identity depends on the port the transport binds."""
+        self.transport = transport
+        self._handlers: dict[str, _RequestHandler] = {}
+        self._responses: dict[int, _ResponseContext] = {}
+        self._request_id = 0
+        self._lock = threading.Lock()
+        self._executor = executor or ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="transport")
+        self._owns_executor = executor is None
+        self.tracers: list[Callable[[str, str, str], None]] = []
+        self._closed = False
+        transport.bind(self)
+        self.local_node: DiscoveryNode = local_node_factory(
+            transport.bound_address())
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        with self._lock:
+            pending = list(self._responses.values())
+            self._responses.clear()
+        for ctx in pending:
+            if ctx.timer:
+                ctx.timer.cancel()
+            if not ctx.future.done():
+                ctx.future.set_exception(
+                    NodeDisconnectedError("transport closed"))
+        self.transport.close()
+        if self._owns_executor:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+
+    # ---- registry ----------------------------------------------------------
+
+    def register_request_handler(self, action: str, handler,
+                                 executor: str = "generic",
+                                 sync: bool = False) -> None:
+        """`handler(request: dict, channel: TransportChannel)`; with
+        `sync=True`, `handler(request: dict, source: DiscoveryNode) -> dict`
+        and the response/failure is sent automatically."""
+        if sync:
+            inner = handler
+
+            def handler(request, channel, _fn=inner):
+                try:
+                    channel.send_response(_fn(request, channel.source_node))
+                except Exception as e:          # noqa: BLE001 — crosses RPC
+                    channel.send_failure(e)
+        self._handlers[action] = _RequestHandler(action, handler, executor)
+
+    # ---- outbound ----------------------------------------------------------
+
+    def send_request(self, node: DiscoveryNode, action: str, request: dict,
+                     timeout: float | None = None) -> Future:
+        """Returns a Future resolving to the response dict."""
+        fut: Future = Future()
+        if self._closed:
+            fut.set_exception(NodeDisconnectedError("transport closed"))
+            return fut
+        with self._lock:
+            self._request_id += 1
+            rid = self._request_id
+            ctx = _ResponseContext(fut, node, action)
+            self._responses[rid] = ctx
+        self._trace("send_request", action, node.node_id)
+        if timeout is not None:
+            ctx.timer = threading.Timer(timeout, self._on_timeout, (rid,))
+            ctx.timer.daemon = True
+            ctx.timer.start()
+        out = StreamOutput(min(self.local_node.version, node.version))
+        out.write_value(request)
+        try:
+            self.transport.send_request(node, rid, action, out.bytes())
+        except Exception as e:                  # noqa: BLE001 — connect errors
+            self._complete(rid, None, e if isinstance(e, TransportException)
+                           else ConnectTransportError(str(e)))
+        return fut
+
+    def submit_request(self, node, action, request, timeout=None) -> dict:
+        """Blocking convenience (TransportFuture.txGet analog)."""
+        return self.send_request(node, action, request, timeout).result(
+            timeout=None if timeout is None else timeout + 5.0)
+
+    # ---- inbound (called by the Transport impl) ----------------------------
+
+    def on_request(self, source: DiscoveryNode, request_id: int, action: str,
+                   payload: bytes, wire_version: int) -> None:
+        self._trace("recv_request", action, source.node_id)
+        channel = TransportChannel(self, source, request_id, action)
+        reg = self._handlers.get(action)
+        if reg is None:
+            channel.send_failure(ActionNotFoundError(action))
+            return
+        request = StreamInput(payload, wire_version).read_value()
+
+        def run():
+            try:
+                reg.handler(request, channel)
+            except Exception as e:              # noqa: BLE001 — crosses RPC
+                channel.send_failure(e)
+
+        if reg.executor == "same" or self._closed:
+            run()
+        else:
+            self._executor.submit(run)
+
+    def on_response(self, request_id: int, payload: bytes | None,
+                    error: tuple[str, str] | None,
+                    wire_version: int) -> None:
+        if error is not None:
+            with self._lock:
+                ctx = self._responses.get(request_id)
+            name = ctx.node.name if ctx else "?"
+            action = ctx.action if ctx else "?"
+            self._complete(request_id, None,
+                           RemoteTransportError(name, action, *error))
+        else:
+            self._complete(
+                request_id, StreamInput(payload, wire_version).read_value(),
+                None)
+
+    def on_node_disconnected(self, node: DiscoveryNode) -> None:
+        """Fail all in-flight requests targeting a dropped node
+        (TransportService.java connection listener)."""
+        with self._lock:
+            dropped = [rid for rid, ctx in self._responses.items()
+                       if ctx.node.node_id == node.node_id]
+        for rid in dropped:
+            self._complete(rid, None,
+                           NodeDisconnectedError(f"[{node.name}] disconnected"))
+
+    # ---- internals ---------------------------------------------------------
+
+    def _reply(self, to_node: DiscoveryNode, request_id: int,
+               response: dict | None, error: Exception | None) -> None:
+        self._trace("send_response", str(request_id), to_node.node_id)
+        if error is not None:
+            wire_err = (type(error).__name__, str(error))
+            self.transport.send_response(to_node, request_id, None, wire_err)
+        else:
+            out = StreamOutput(min(self.local_node.version, to_node.version))
+            out.write_value(response)
+            self.transport.send_response(to_node, request_id, out.bytes(),
+                                         None)
+
+    def _complete(self, request_id: int, response: dict | None,
+                  error: Exception | None) -> None:
+        with self._lock:
+            ctx = self._responses.pop(request_id, None)
+        if ctx is None:
+            return                               # late response after timeout
+        if ctx.timer:
+            ctx.timer.cancel()
+        if ctx.future.done():
+            return
+        if error is not None:
+            ctx.future.set_exception(error)
+        else:
+            ctx.future.set_result(response)
+
+    def _on_timeout(self, request_id: int) -> None:
+        with self._lock:
+            ctx = self._responses.get(request_id)
+        if ctx is None:
+            return
+        elapsed = time.monotonic() - ctx.sent_at
+        self._complete(
+            request_id, None,
+            ReceiveTimeoutError(
+                f"[{ctx.node.name}][{ctx.action}] request timed out after "
+                f"{elapsed * 1e3:.0f}ms"))
+
+    def _trace(self, event: str, action: str, node_id: str) -> None:
+        for t in self.tracers:
+            t(event, action, node_id)
+
+
+def random_node_id() -> str:
+    return uuid.uuid4().hex[:20]

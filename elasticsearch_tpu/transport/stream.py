@@ -1,0 +1,240 @@
+"""Version-conditional binary wire format.
+
+Reference: core/common/io/stream/{StreamInput,StreamOutput}.java — hand-rolled
+binary streams where every stream carries the remote node's wire version
+(StreamInput.java:58 `setVersion`) so readers/writers can gate fields for
+rolling-upgrade compatibility, plus a tagged `writeGenericValue` for
+heterogeneous maps (StreamOutput `writeGenericValue`).
+
+The codec is deliberately self-contained (no pickle — payloads cross real
+sockets in TcpTransport, and unpickling remote bytes would be an RCE).
+"""
+
+from __future__ import annotations
+
+import struct
+
+# Wire version of this codec generation; bump when adding gated fields.
+# Mirrors org.elasticsearch.Version ids (Version.java) in spirit: an int that
+# both sides exchange during the handshake, min(local, remote) governs the
+# stream (NettyTransport sets the stream version from the channel handshake).
+CURRENT_VERSION = 1_000_099
+MINIMUM_COMPATIBLE_VERSION = 1_000_000
+
+_NULL = 0
+_STRING = 1
+_INT = 2
+_LONG = 3
+_FLOAT = 4
+_DOUBLE = 5
+_BOOL = 6
+_BYTES = 7
+_LIST = 8
+_MAP = 9
+
+
+class StreamOutput:
+    """Append-only binary writer (StreamOutput.java analog)."""
+
+    def __init__(self, version: int = CURRENT_VERSION):
+        self.version = version
+        self._parts: list[bytes] = []
+
+    # ---- primitives --------------------------------------------------------
+
+    def write_byte(self, b: int) -> None:
+        self._parts.append(bytes((b & 0xFF,)))
+
+    def write_bool(self, v: bool) -> None:
+        self.write_byte(1 if v else 0)
+
+    def write_int(self, v: int) -> None:
+        self._parts.append(struct.pack(">i", v))
+
+    def write_long(self, v: int) -> None:
+        self._parts.append(struct.pack(">q", v))
+
+    def write_double(self, v: float) -> None:
+        self._parts.append(struct.pack(">d", v))
+
+    def write_vint(self, v: int) -> None:
+        """LEB128-style varint (StreamOutput.writeVInt)."""
+        if v < 0:
+            raise ValueError(f"negative vint {v}")
+        while v >= 0x80:
+            self._parts.append(bytes(((v & 0x7F) | 0x80,)))
+            v >>= 7
+        self._parts.append(bytes((v,)))
+
+    def write_zlong(self, v: int) -> None:
+        """Zigzag-encoded signed varint (writeZLong)."""
+        self.write_vlong((v << 1) ^ (v >> 63) if v < 0 else v << 1)
+
+    def write_vlong(self, v: int) -> None:
+        self.write_vint(v)
+
+    def write_bytes(self, b: bytes) -> None:
+        self.write_vint(len(b))
+        self._parts.append(b)
+
+    def write_raw(self, b: bytes) -> None:
+        self._parts.append(b)
+
+    def write_string(self, s: str) -> None:
+        self.write_bytes(s.encode("utf-8"))
+
+    def write_optional_string(self, s: str | None) -> None:
+        self.write_bool(s is not None)
+        if s is not None:
+            self.write_string(s)
+
+    def write_string_list(self, items) -> None:
+        self.write_vint(len(items))
+        for s in items:
+            self.write_string(s)
+
+    # ---- tagged generic values (writeGenericValue) -------------------------
+
+    def write_value(self, v) -> None:
+        if v is None:
+            self.write_byte(_NULL)
+        elif isinstance(v, bool):                 # before int: bool⊂int in py
+            self.write_byte(_BOOL)
+            self.write_bool(v)
+        elif isinstance(v, str):
+            self.write_byte(_STRING)
+            self.write_string(v)
+        elif isinstance(v, int):
+            if -(2**31) <= v < 2**31:
+                self.write_byte(_INT)
+                self.write_int(v)
+            else:
+                self.write_byte(_LONG)
+                self.write_long(v)
+        elif isinstance(v, float):
+            self.write_byte(_DOUBLE)
+            self.write_double(v)
+        elif isinstance(v, (bytes, bytearray)):
+            self.write_byte(_BYTES)
+            self.write_bytes(bytes(v))
+        elif isinstance(v, (list, tuple)):
+            self.write_byte(_LIST)
+            self.write_vint(len(v))
+            for item in v:
+                self.write_value(item)
+        elif isinstance(v, dict):
+            self.write_byte(_MAP)
+            self.write_vint(len(v))
+            for k, item in v.items():
+                self.write_string(str(k))
+                self.write_value(item)
+        else:
+            # numpy scalars and other number-likes degrade to float/int
+            try:
+                import numpy as np
+                if isinstance(v, np.integer):
+                    return self.write_value(int(v))
+                if isinstance(v, np.floating):
+                    return self.write_value(float(v))
+                if isinstance(v, np.ndarray):
+                    return self.write_value(v.tolist())
+            except ImportError:
+                pass
+            raise TypeError(f"cannot serialize {type(v)!r} to wire")
+
+    def bytes(self) -> bytes:
+        return b"".join(self._parts)
+
+    def __len__(self) -> int:
+        return sum(len(p) for p in self._parts)
+
+
+class StreamInput:
+    """Binary reader over a bytes buffer (StreamInput.java analog)."""
+
+    def __init__(self, data: bytes, version: int = CURRENT_VERSION):
+        self._data = data
+        self._pos = 0
+        self.version = version
+
+    def _take(self, n: int) -> bytes:
+        if self._pos + n > len(self._data):
+            raise EOFError(
+                f"stream truncated: need {n} bytes at {self._pos}, "
+                f"have {len(self._data)}")
+        b = self._data[self._pos:self._pos + n]
+        self._pos += n
+        return b
+
+    def read_byte(self) -> int:
+        return self._take(1)[0]
+
+    def read_bool(self) -> bool:
+        return self.read_byte() != 0
+
+    def read_int(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def read_long(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def read_double(self) -> float:
+        return struct.unpack(">d", self._take(8))[0]
+
+    def read_vint(self) -> int:
+        v = shift = 0
+        while True:
+            b = self.read_byte()
+            v |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return v
+            shift += 7
+            if shift > 70:
+                raise ValueError("vint too long")
+
+    def read_vlong(self) -> int:
+        return self.read_vint()
+
+    def read_zlong(self) -> int:
+        v = self.read_vlong()
+        return (v >> 1) ^ -(v & 1)
+
+    def read_bytes(self) -> bytes:
+        return self._take(self.read_vint())
+
+    def read_string(self) -> str:
+        return self.read_bytes().decode("utf-8")
+
+    def read_optional_string(self) -> str | None:
+        return self.read_string() if self.read_bool() else None
+
+    def read_string_list(self) -> list[str]:
+        return [self.read_string() for _ in range(self.read_vint())]
+
+    def read_value(self):
+        tag = self.read_byte()
+        if tag == _NULL:
+            return None
+        if tag == _STRING:
+            return self.read_string()
+        if tag == _INT:
+            return self.read_int()
+        if tag == _LONG:
+            return self.read_long()
+        if tag == _DOUBLE:
+            return self.read_double()
+        if tag == _FLOAT:
+            return struct.unpack(">f", self._take(4))[0]
+        if tag == _BOOL:
+            return self.read_bool()
+        if tag == _BYTES:
+            return self.read_bytes()
+        if tag == _LIST:
+            return [self.read_value() for _ in range(self.read_vint())]
+        if tag == _MAP:
+            return {self.read_string(): self.read_value()
+                    for _ in range(self.read_vint())}
+        raise ValueError(f"unknown wire tag {tag}")
+
+    def remaining(self) -> int:
+        return len(self._data) - self._pos

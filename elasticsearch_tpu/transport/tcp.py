@@ -1,0 +1,220 @@
+"""TcpTransport — length-framed binary RPC over real sockets.
+
+Reference: core/transport/netty/NettyTransport.java:142 — 'E','S' marker +
+4-byte length framing (NettyHeader.java, SizeHeaderFrameDecoder.java),
+request/response status byte, request-id correlation, per-node channel
+reuse (:871 `connectToNode`), version negotiation via min(local, remote)
+on each frame. Threading: an accept loop + one reader thread per inbound
+connection replaces the Netty event loop; handler dispatch happens on the
+TransportService executor, matching the reference's worker offload.
+
+Frame layout (after the 2-byte marker b"ET" and 4-byte big-endian length):
+  StreamOutput[ byte msg_type (0=req, 1=resp, 2=resp_error),
+                long request_id, vint wire_version, then per type:
+    req:        DiscoveryNode source, string action, bytes payload
+    resp:       bytes payload
+    resp_error: string error_type, string reason ]
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from elasticsearch_tpu.transport.service import (
+    ConnectTransportError, DiscoveryNode, TransportAddress)
+from elasticsearch_tpu.transport.stream import (
+    CURRENT_VERSION, StreamInput, StreamOutput)
+
+_MARKER = b"ET"
+_REQ, _RESP, _RESP_ERR = 0, 1, 2
+
+
+class TcpTransport:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host, self._want_port = host, port
+        self._service = None
+        self._address: TransportAddress | None = None
+        self._server: socket.socket | None = None
+        self._closed = False
+        self._lock = threading.Lock()
+        self._outbound: dict[TransportAddress, socket.socket] = {}
+        self._inbound_channels: dict[int, socket.socket] = {}
+        self._threads: list[threading.Thread] = []
+
+    # ---- Transport interface ----------------------------------------------
+
+    def bind(self, service) -> None:
+        self._service = service
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self._host, self._want_port))
+        srv.listen(64)
+        self._server = srv
+        self._address = TransportAddress(self._host, srv.getsockname()[1])
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name=f"tcp_accept[{self._address}]")
+        t.start()
+        self._threads.append(t)
+
+    def bound_address(self) -> TransportAddress:
+        return self._address
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._lock:
+            socks = list(self._outbound.values())
+            self._outbound.clear()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def send_request(self, node: DiscoveryNode, request_id: int, action: str,
+                     payload: bytes) -> None:
+        out = StreamOutput()
+        out.write_byte(_REQ)
+        out.write_long(request_id)
+        out.write_vint(min(self._service.local_node.version, node.version))
+        self._service.local_node.to_wire(out)
+        out.write_string(action)
+        out.write_bytes(payload)
+        self._send_frame(node.address, out.bytes())
+
+    def send_response(self, node: DiscoveryNode, request_id: int,
+                      payload: bytes | None, error) -> None:
+        out = StreamOutput()
+        if error is None:
+            out.write_byte(_RESP)
+            out.write_long(request_id)
+            out.write_vint(min(self._service.local_node.version,
+                               node.version))
+            out.write_bytes(payload)
+        else:
+            out.write_byte(_RESP_ERR)
+            out.write_long(request_id)
+            out.write_vint(min(self._service.local_node.version,
+                               node.version))
+            out.write_string(error[0])
+            out.write_string(error[1])
+        # Prefer the inbound channel the request arrived on (the reference
+        # replies on the request's channel); fall back to an outbound conn.
+        with self._lock:
+            chan = self._inbound_channels.pop(request_id, None)
+        if chan is not None:
+            try:
+                self._write_framed(chan, out.bytes())
+                return
+            except OSError:
+                pass
+        try:
+            self._send_frame(node.address, out.bytes())
+        except ConnectTransportError:
+            pass                                 # requester is gone
+
+    # ---- socket plumbing ---------------------------------------------------
+
+    def _send_frame(self, addr: TransportAddress, body: bytes) -> None:
+        sock = self._connect(addr)
+        try:
+            self._write_framed(sock, body)
+        except OSError as e:
+            with self._lock:
+                self._outbound.pop(addr, None)
+            raise ConnectTransportError(f"send to {addr} failed: {e}") from e
+
+    @staticmethod
+    def _write_framed(sock: socket.socket, body: bytes) -> None:
+        sock.sendall(_MARKER + struct.pack(">i", len(body)) + body)
+
+    def _connect(self, addr: TransportAddress) -> socket.socket:
+        with self._lock:
+            sock = self._outbound.get(addr)
+        if sock is not None:
+            return sock
+        try:
+            sock = socket.create_connection((addr.host, addr.port),
+                                            timeout=5.0)
+        except OSError as e:
+            raise ConnectTransportError(f"connect to {addr} failed: {e}") \
+                from e
+        sock.settimeout(None)
+        with self._lock:
+            existing = self._outbound.get(addr)
+            if existing is not None:
+                sock.close()
+                return existing
+            self._outbound[addr] = sock
+        t = threading.Thread(target=self._read_loop, args=(sock,),
+                             daemon=True, name=f"tcp_read[{addr}]")
+        t.start()
+        self._threads.append(t)
+        return sock
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._read_loop, args=(conn,),
+                                 daemon=True, name="tcp_read[inbound]")
+            t.start()
+            self._threads.append(t)
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        try:
+            while not self._closed:
+                header = self._read_exact(sock, 6)
+                if header is None:
+                    return
+                if header[:2] != _MARKER:
+                    return                       # corrupt stream: drop conn
+                size = struct.unpack(">i", header[2:])[0]
+                body = self._read_exact(sock, size)
+                if body is None:
+                    return
+                self._handle_frame(sock, body)
+        except OSError:
+            return
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _read_exact(sock: socket.socket, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _handle_frame(self, sock: socket.socket, body: bytes) -> None:
+        inp = StreamInput(body)
+        msg_type = inp.read_byte()
+        request_id = inp.read_long()
+        version = inp.read_vint()
+        if msg_type == _REQ:
+            source = DiscoveryNode.from_wire(inp)
+            action = inp.read_string()
+            payload = inp.read_bytes()
+            with self._lock:
+                self._inbound_channels[request_id] = sock
+            self._service.on_request(source, request_id, action, payload,
+                                     version)
+        elif msg_type == _RESP:
+            self._service.on_response(request_id, inp.read_bytes(), None,
+                                      version)
+        elif msg_type == _RESP_ERR:
+            err = (inp.read_string(), inp.read_string())
+            self._service.on_response(request_id, None, err, version)

@@ -121,6 +121,21 @@ class TestDistributed:
         best = texts[int(np.argmax(ref_scores))]
         assert got_text == best
 
+    def test_uneven_query_batch_padded(self, dp, shard):
+        """Query counts not divisible by dp are padded and trimmed."""
+        analyzer = BUILTIN_ANALYZERS["standard"]
+        mesh = make_mesh(dp=dp, shard=shard)
+        parts = [[] for _ in range(shard)]
+        for i, t in enumerate(TEXTS * 4):
+            parts[i % shard].append(t)
+        indexes = [PackedTextIndex.from_texts(p, analyzer, pad_docs=8,
+                                              max_unique=8) for p in parts]
+        dist = DistributedBM25(mesh, indexes, analyzer=analyzer)
+        scores, docs, totals = dist.search(["quick fox"], k=3)  # 1 query
+        assert scores.shape == (1, 3) and docs.shape == (1, 3)
+        assert totals.shape == (1,)
+        assert float(scores[0, 0]) > 0
+
     def test_df_is_global(self, dp, shard):
         """IDF must come from psum'd global df, not shard-local df."""
         analyzer = BUILTIN_ANALYZERS["standard"]
