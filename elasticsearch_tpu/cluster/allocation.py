@@ -1,0 +1,510 @@
+"""Shard allocation — the cluster's placement scheduler.
+
+Reference: core/cluster/routing/allocation/AllocationService.java (reroute,
+applyStartedShards, applyFailedShards), the pluggable decider pipeline
+(allocation/decider/*.java — AllocationDeciders composite over 16 deciders)
+and the weighted BalancedShardsAllocator
+(allocation/allocator/BalancedShardsAllocator.java: weight = shard-count
+balance + per-index balance, threshold-gated rebalance).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import time
+from dataclasses import dataclass, field
+
+from elasticsearch_tpu.cluster.state import (
+    ClusterState, RoutingTable, ShardRouting, ShardRoutingState,
+    UnassignedReason)
+from elasticsearch_tpu.common.settings import parse_time_millis as \
+    _parse_millis
+
+YES, NO, THROTTLE = "YES", "NO", "THROTTLE"
+
+# UnassignedInfo.INDEX_DELAYED_NODE_LEFT_TIMEOUT_SETTING analog
+DELAYED_ALLOCATION_SETTING = "index.unassigned.node_left.delayed_timeout"
+MAX_RETRIES_SETTING = "index.allocation.max_retries"
+
+
+@dataclass
+class RoutingAllocation:
+    """Context handed to deciders (allocation/RoutingAllocation.java)."""
+    state: ClusterState
+    routing: RoutingTable
+    disk_usage: dict = field(default_factory=dict)  # node_id → used fraction
+    explanations: list = field(default_factory=list)
+
+    def node_shards(self, node_id: str) -> list[ShardRouting]:
+        return [s for s in self.routing.shards if s.node_id == node_id]
+
+    def explain(self, decider: str, shard: ShardRouting, node_id: str,
+                verdict: str, why: str) -> str:
+        self.explanations.append(
+            {"decider": decider, "shard": f"[{shard.index}][{shard.shard}]",
+             "node": node_id, "decision": verdict, "explanation": why})
+        return verdict
+
+
+class AllocationDecider:
+    name = "base"
+
+    def can_allocate(self, shard: ShardRouting, node_id: str,
+                     alloc: RoutingAllocation) -> str:
+        return YES
+
+    def can_rebalance(self, shard: ShardRouting,
+                      alloc: RoutingAllocation) -> str:
+        return YES
+
+
+class SameShardAllocationDecider(AllocationDecider):
+    """No two copies of a shard on one node
+    (decider/SameShardAllocationDecider.java)."""
+    name = "same_shard"
+
+    def can_allocate(self, shard, node_id, alloc):
+        for s in alloc.node_shards(node_id):
+            if s.index == shard.index and s.shard == shard.shard:
+                return alloc.explain(
+                    self.name, shard, node_id, NO,
+                    "a copy of this shard is already allocated to this node")
+        return YES
+
+
+class ReplicaAfterPrimaryActiveDecider(AllocationDecider):
+    """Replicas only allocate once their primary is active
+    (decider/ReplicaAfterPrimaryActiveAllocationDecider.java)."""
+    name = "replica_after_primary_active"
+
+    def can_allocate(self, shard, node_id, alloc):
+        if shard.primary:
+            return YES
+        primary = None
+        for s in alloc.routing.shards:
+            if s.index == shard.index and s.shard == shard.shard and s.primary:
+                primary = s
+                break
+        if primary is None or not primary.active:
+            return alloc.explain(self.name, shard, node_id, NO,
+                                 "primary shard is not active")
+        return YES
+
+
+class FilterAllocationDecider(AllocationDecider):
+    """include/exclude/require node filters, index- and cluster-level
+    (decider/FilterAllocationDecider.java). Filters match node name, id,
+    or any node attribute."""
+    name = "filter"
+
+    def _node_matches(self, node, patterns: dict) -> bool:
+        for attr, want in patterns.items():
+            if attr == "_name":
+                values = [node.name]
+            elif attr == "_id":
+                values = [node.node_id]
+            else:
+                values = [dict(node.attributes).get(attr, "")]
+            if not any(fnmatch.fnmatch(v, p) for v in values
+                       for p in str(want).split(",")):
+                return False
+        return True
+
+    def can_allocate(self, shard, node_id, alloc):
+        node = alloc.state.node(node_id)
+        if node is None:
+            return NO
+        meta = alloc.state.indices.get(shard.index)
+        settings_layers = []
+        if meta is not None:
+            settings_layers.append(("index.routing.allocation.",
+                                    meta.settings))
+        settings_layers.append(("cluster.routing.allocation.",
+                                {**alloc.state.persistent_settings,
+                                 **alloc.state.transient_settings}))
+        for prefix, settings in settings_layers:
+            for kind in ("require", "include", "exclude"):
+                patterns = {k[len(prefix) + len(kind) + 1:]: v
+                            for k, v in settings.items()
+                            if k.startswith(prefix + kind + ".")}
+                if not patterns:
+                    continue
+                matches = self._node_matches(node, patterns)
+                if kind == "require" and not matches:
+                    return alloc.explain(self.name, shard, node_id, NO,
+                                         f"does not match required {patterns}")
+                if kind == "include" and not matches:
+                    return alloc.explain(self.name, shard, node_id, NO,
+                                         f"not in include filter {patterns}")
+                if kind == "exclude":
+                    # exclude matches on ANY listed attribute
+                    for attr, want in patterns.items():
+                        if self._node_matches(node, {attr: want}):
+                            return alloc.explain(
+                                self.name, shard, node_id, NO,
+                                f"matches exclude filter {patterns}")
+        return YES
+
+
+class EnableAllocationDecider(AllocationDecider):
+    """cluster.routing.allocation.enable: all|primaries|new_primaries|none
+    (decider/EnableAllocationDecider.java)."""
+    name = "enable"
+
+    def can_allocate(self, shard, node_id, alloc):
+        enable = {**alloc.state.persistent_settings,
+                  **alloc.state.transient_settings}.get(
+            "cluster.routing.allocation.enable", "all")
+        if enable == "all":
+            return YES
+        if enable == "none":
+            return alloc.explain(self.name, shard, node_id, NO,
+                                 "allocation is disabled")
+        if enable == "primaries":
+            return YES if shard.primary else alloc.explain(
+                self.name, shard, node_id, NO,
+                "replica allocation is disabled")
+        if enable == "new_primaries":
+            if shard.primary and shard.unassigned_info is not None and \
+                    shard.unassigned_info.reason == \
+                    UnassignedReason.INDEX_CREATED:
+                return YES
+            return alloc.explain(self.name, shard, node_id, NO,
+                                 "only new primaries may allocate")
+        return YES
+
+
+class ThrottlingAllocationDecider(AllocationDecider):
+    """Bound concurrent incoming recoveries per node
+    (decider/ThrottlingAllocationDecider.java)."""
+    name = "throttling"
+    DEFAULT_CONCURRENT_RECOVERIES = 2
+
+    def can_allocate(self, shard, node_id, alloc):
+        limit = int({**alloc.state.persistent_settings,
+                     **alloc.state.transient_settings}.get(
+            "cluster.routing.allocation.node_concurrent_recoveries",
+            self.DEFAULT_CONCURRENT_RECOVERIES))
+        initializing = sum(
+            1 for s in alloc.node_shards(node_id)
+            if s.state == ShardRoutingState.INITIALIZING)
+        if initializing >= limit:
+            return alloc.explain(
+                self.name, shard, node_id, THROTTLE,
+                f"{initializing} concurrent recoveries >= limit {limit}")
+        return YES
+
+
+class AwarenessAllocationDecider(AllocationDecider):
+    """Spread copies across awareness attribute values (zones)
+    (decider/AwarenessAllocationDecider.java)."""
+    name = "awareness"
+
+    def can_allocate(self, shard, node_id, alloc):
+        attrs = {**alloc.state.persistent_settings,
+                 **alloc.state.transient_settings}.get(
+            "cluster.routing.allocation.awareness.attributes", "")
+        node = alloc.state.node(node_id)
+        if not attrs or node is None:
+            return YES
+        for attr in (a.strip() for a in attrs.split(",") if a.strip()):
+            my_value = dict(node.attributes).get(attr)
+            if my_value is None:
+                continue
+            zone_values = {dict(n.attributes).get(attr)
+                           for n in alloc.state.nodes.values()
+                           if dict(n.attributes).get(attr) is not None}
+            if not zone_values:
+                continue
+            copies = [s for s in alloc.routing.shards
+                      if s.index == shard.index and s.shard == shard.shard
+                      and s.assigned]
+            per_zone: dict[str, int] = {}
+            for c in copies:
+                n = alloc.state.node(c.node_id)
+                if n is not None:
+                    z = dict(n.attributes).get(attr)
+                    if z is not None:
+                        per_zone[z] = per_zone.get(z, 0) + 1
+            total_copies = len(copies) + 1
+            max_per_zone = -(-total_copies // len(zone_values))
+            if per_zone.get(my_value, 0) + 1 > max_per_zone:
+                return alloc.explain(
+                    self.name, shard, node_id, NO,
+                    f"zone [{attr}={my_value}] already holds "
+                    f"{per_zone.get(my_value, 0)} copies (max {max_per_zone})")
+        return YES
+
+
+class DiskThresholdDecider(AllocationDecider):
+    """Refuse allocation to nodes over the high watermark
+    (decider/DiskThresholdDecider.java; usage fed by ClusterInfoService —
+    here injected by the caller via RoutingAllocation.disk_usage)."""
+    name = "disk_threshold"
+    DEFAULT_HIGH = 0.90
+    DEFAULT_LOW = 0.85
+
+    def can_allocate(self, shard, node_id, alloc):
+        usage = alloc.disk_usage.get(node_id)
+        if usage is None:
+            return YES
+        settings = {**alloc.state.persistent_settings,
+                    **alloc.state.transient_settings}
+        low = float(settings.get(
+            "cluster.routing.allocation.disk.watermark.low",
+            self.DEFAULT_LOW))
+        if usage >= low:
+            return alloc.explain(
+                self.name, shard, node_id, NO,
+                f"disk usage {usage:.0%} over low watermark {low:.0%}")
+        return YES
+
+
+class NodeVersionAllocationDecider(AllocationDecider):
+    """Replicas never allocate to a node older than their primary's node
+    (decider/NodeVersionAllocationDecider.java — rolling upgrades)."""
+    name = "node_version"
+
+    def can_allocate(self, shard, node_id, alloc):
+        if shard.primary:
+            return YES
+        target = alloc.state.node(node_id)
+        primary = None
+        for s in alloc.routing.shards:
+            if s.index == shard.index and s.shard == shard.shard and s.primary:
+                primary = s
+                break
+        if primary is None or primary.node_id is None or target is None:
+            return YES
+        pnode = alloc.state.node(primary.node_id)
+        if pnode is not None and target.version < pnode.version:
+            return alloc.explain(
+                self.name, shard, node_id, NO,
+                f"target version {target.version} < primary node "
+                f"{pnode.version}")
+        return YES
+
+
+class MaxRetryAllocationDecider(AllocationDecider):
+    """Give up after N failed allocation attempts
+    (decider/MaxRetryAllocationDecider.java)."""
+    name = "max_retry"
+    DEFAULT_MAX = 5
+
+    def can_allocate(self, shard, node_id, alloc):
+        if shard.unassigned_info is None:
+            return YES
+        meta = alloc.state.indices.get(shard.index)
+        limit = int((meta.settings if meta else {}).get(
+            MAX_RETRIES_SETTING, self.DEFAULT_MAX))
+        if shard.unassigned_info.failed_allocations >= limit:
+            return alloc.explain(
+                self.name, shard, node_id, NO,
+                f"{shard.unassigned_info.failed_allocations} failed "
+                f"allocation attempts >= limit {limit}")
+        return YES
+
+
+class DelayedAllocationDecider(AllocationDecider):
+    """NODE_LEFT shards wait out the delayed-allocation window before
+    reallocating elsewhere (UnassignedInfo.java:45,195 — avoids shuffling
+    data for a node that promptly comes back)."""
+    name = "delayed"
+
+    def can_allocate(self, shard, node_id, alloc):
+        if shard.primary or shard.unassigned_info is None:
+            return YES
+        info = shard.unassigned_info
+        if info.reason != UnassignedReason.NODE_LEFT:
+            return YES
+        meta = alloc.state.indices.get(shard.index)
+        delay = _parse_millis((meta.settings if meta else {}).get(
+            DELAYED_ALLOCATION_SETTING, "0ms"))
+        if delay <= 0:
+            return YES
+        elapsed = int(time.time() * 1000) - info.at_millis
+        if elapsed < delay:
+            return alloc.explain(
+                self.name, shard, node_id, THROTTLE,
+                f"delaying allocation for {delay - elapsed}ms more")
+        return YES
+
+
+
+
+DEFAULT_DECIDERS = (
+    MaxRetryAllocationDecider(),
+    SameShardAllocationDecider(),
+    ReplicaAfterPrimaryActiveDecider(),
+    EnableAllocationDecider(),
+    FilterAllocationDecider(),
+    AwarenessAllocationDecider(),
+    NodeVersionAllocationDecider(),
+    DelayedAllocationDecider(),
+    ThrottlingAllocationDecider(),
+    DiskThresholdDecider(),
+)
+
+
+class BalancedShardsAllocator:
+    """Pick the allowed node with minimum weight; weight combines total
+    shard count and same-index shard count
+    (BalancedShardsAllocator.java WeightFunction: theta0·shardBalance +
+    theta1·indexBalance, defaults 0.45/0.55)."""
+
+    def __init__(self, shard_balance: float = 0.45,
+                 index_balance: float = 0.55, threshold: float = 1.0):
+        self.shard_balance = shard_balance
+        self.index_balance = index_balance
+        self.threshold = threshold
+
+    def weight(self, alloc: RoutingAllocation, node_id: str,
+               index: str) -> float:
+        node_shards = alloc.node_shards(node_id)
+        return (self.shard_balance * len(node_shards) +
+                self.index_balance * sum(1 for s in node_shards
+                                         if s.index == index))
+
+    def choose_node(self, shard: ShardRouting, candidates: list[str],
+                    alloc: RoutingAllocation) -> str | None:
+        if not candidates:
+            return None
+        return min(candidates,
+                   key=lambda nid: (self.weight(alloc, nid, shard.index), nid))
+
+
+class AllocationService:
+    """reroute() drives the routing table toward full assignment on every
+    cluster state change (AllocationService.java:reroute,
+    applyStartedShards, applyFailedShards)."""
+
+    def __init__(self, deciders=DEFAULT_DECIDERS,
+                 allocator: BalancedShardsAllocator | None = None):
+        self.deciders = tuple(deciders)
+        self.allocator = allocator or BalancedShardsAllocator()
+        self.disk_usage: dict[str, float] = {}   # fed by ClusterInfoService
+
+    # ---- public entry points ----------------------------------------------
+
+    def reroute(self, state: ClusterState, reason: str = "") -> ClusterState:
+        routing = self._fail_shards_on_missing_nodes(state,
+                                                     state.routing_table)
+        routing = self._allocate_unassigned(state, routing)
+        if routing is state.routing_table:
+            return state
+        return state.with_(routing_table=routing)
+
+    def apply_started_shards(self, state: ClusterState,
+                             started: list[ShardRouting]) -> ClusterState:
+        routing = state.routing_table
+        for s in started:
+            current = self._find(routing, s)
+            if current is not None and \
+                    current.state == ShardRoutingState.INITIALIZING:
+                routing = routing.replace_shard(current, current.started())
+        if routing is state.routing_table:
+            return state
+        return self.reroute(state.with_(routing_table=routing),
+                            "shards started")
+
+    def apply_failed_shards(self, state: ClusterState,
+                            failed: list[tuple[ShardRouting, str]]
+                            ) -> ClusterState:
+        routing = state.routing_table
+        for s, details in failed:
+            current = self._find(routing, s)
+            if current is not None and current.assigned:
+                prev_failures = (current.unassigned_info.failed_allocations
+                                 if current.unassigned_info else 0)
+                routing = routing.replace_shard(
+                    current,
+                    current.failed(UnassignedReason.ALLOCATION_FAILED,
+                                   details, prev_failures + 1))
+        if routing is state.routing_table:
+            return state
+        return self.reroute(state.with_(routing_table=routing),
+                            "shards failed")
+
+    def next_delayed_reroute_millis(self, state: ClusterState) -> int | None:
+        """Remaining millis until the earliest NODE_LEFT delayed-allocation
+        window expires — the caller schedules a reroute then
+        (RoutingService.scheduleDelayedReroute analog)."""
+        now = int(time.time() * 1000)
+        best = None
+        for s in state.routing_table.unassigned():
+            if s.primary or s.unassigned_info is None:
+                continue
+            if s.unassigned_info.reason != UnassignedReason.NODE_LEFT:
+                continue
+            meta = state.indices.get(s.index)
+            delay = _parse_millis((meta.settings if meta else {}).get(
+                DELAYED_ALLOCATION_SETTING, "0ms"))
+            if delay <= 0:
+                continue
+            remaining = s.unassigned_info.at_millis + delay - now
+            if remaining > 0 and (best is None or remaining < best):
+                best = remaining
+        return best
+
+    def explain(self, state: ClusterState,
+                shard: ShardRouting) -> list[dict]:
+        """Allocation explain API: run every decider against every node."""
+        alloc = RoutingAllocation(state, state.routing_table,
+                                  dict(self.disk_usage))
+        for node_id in state.nodes:
+            self._decide(shard, node_id, alloc)
+        return alloc.explanations
+
+    # ---- internals ---------------------------------------------------------
+
+    @staticmethod
+    def _find(routing: RoutingTable, target: ShardRouting):
+        for s in routing.shards:
+            if s.key == target.key:
+                return s
+        # fall back to (index, shard, allocation_id) — routing entry may
+        # have advanced state since the report was sent
+        for s in routing.shards:
+            if (s.index == target.index and s.shard == target.shard and
+                    s.allocation_id == target.allocation_id and
+                    s.allocation_id is not None):
+                return s
+        return None
+
+    def _fail_shards_on_missing_nodes(self, state: ClusterState,
+                                      routing: RoutingTable) -> RoutingTable:
+        for s in list(routing.shards):
+            if s.assigned and s.node_id not in state.nodes:
+                routing = routing.replace_shard(
+                    s, s.failed(UnassignedReason.NODE_LEFT,
+                                f"node [{s.node_id}] left"))
+        return routing
+
+    def _decide(self, shard: ShardRouting, node_id: str,
+                alloc: RoutingAllocation) -> str:
+        verdict = YES
+        for d in self.deciders:
+            v = d.can_allocate(shard, node_id, alloc)
+            if v == NO:
+                return NO
+            if v == THROTTLE:
+                verdict = THROTTLE
+        return verdict
+
+    def _allocate_unassigned(self, state: ClusterState,
+                             routing: RoutingTable) -> RoutingTable:
+        alloc = RoutingAllocation(state, routing, dict(self.disk_usage))
+        data_nodes = list(state.data_nodes())
+        # primaries first (PriorityComparator), then replicas
+        pending = sorted(routing.unassigned(),
+                         key=lambda s: (not s.primary, s.index, s.shard))
+        for shard in pending:
+            candidates = [nid for nid in data_nodes
+                          if self._decide(shard, nid, alloc) == YES]
+            chosen = self.allocator.choose_node(shard, candidates, alloc)
+            if chosen is None:
+                continue
+            initialized = shard.initialize(chosen)
+            routing = routing.replace_shard(shard, initialized)
+            alloc.routing = routing
+        return routing

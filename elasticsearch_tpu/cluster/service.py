@@ -1,27 +1,67 @@
 """ClusterService — the single-writer state-update executor.
 
 Reference: core/cluster/service/InternalClusterService.java:60 — all cluster
-state mutations are serialized through one prioritized executor
-(`submitStateUpdateTask` :267-272); listeners observe each new immutable
-state. Round 1 runs it synchronously under a lock (single node); the
-publish seam is where multi-node diff replication attaches
-(PublishClusterStateAction analog).
+state mutations are serialized through ONE prioritized executor
+(`submitStateUpdateTask` :267-272, PrioritizedEsThreadPoolExecutor), each
+task producing a new immutable state that is published (Discovery.publish)
+and then applied locally; listeners observe (old, new). Non-master nodes
+never mutate: they receive published states via `apply_published_state`
+(the ZenDiscovery → ClusterService applier path).
+
+Two roles in one class, exactly like the reference:
+  * master service: submit_state_update → compute → publish → apply
+  * applier service: apply_published_state → listeners
 """
 
 from __future__ import annotations
 
+import queue
 import threading
-import time
+import traceback
+from concurrent.futures import Future
+from dataclasses import dataclass, field
 from typing import Callable
 
 from elasticsearch_tpu.cluster.state import ClusterState
 
+URGENT, HIGH, NORMAL, LOW = 0, 1, 2, 3
+
+
+@dataclass(order=True)
+class _Task:
+    priority: int
+    seq: int
+    source: str = field(compare=False)
+    run: Callable = field(compare=False)
+
 
 class ClusterService:
-    def __init__(self, initial: ClusterState):
+    def __init__(self, initial: ClusterState, node_id: str | None = None):
         self._state = initial
-        self._lock = threading.Lock()
+        self.node_id = node_id
         self._listeners: list[Callable[[ClusterState, ClusterState], None]] = []
+        self._state_lock = threading.Lock()
+        # publish hook — set by Discovery; publish(new_state, old_state)
+        # must deliver to all nodes (including self via
+        # apply_published_state). None → single-node: apply locally.
+        self.publish: Callable[[ClusterState, ClusterState], None] | None = None
+        self._queue: queue.PriorityQueue[_Task] = queue.PriorityQueue()
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._pending: dict[int, str] = {}
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"clusterService[{node_id}]")
+        self._thread.start()
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self._stopped = True
+        self._queue.put(_Task(URGENT, -1, "_close", lambda: None))
+        self._thread.join(timeout=5.0)
+
+    # ---- read side ---------------------------------------------------------
 
     def state(self) -> ClusterState:
         return self._state
@@ -29,16 +69,107 @@ class ClusterService:
     def add_listener(self, fn: Callable[[ClusterState, ClusterState], None]):
         self._listeners.append(fn)
 
-    def submit_state_update(self, source: str,
-                            update: Callable[[ClusterState], ClusterState]
-                            ) -> ClusterState:
-        """Apply an update task; notify listeners with (old, new)."""
-        with self._lock:
+    def pending_tasks(self) -> list[dict]:
+        with self._seq_lock:
+            snapshot = sorted(self._pending.items())
+        return [{"insert_order": seq, "source": src, "priority": "NORMAL"}
+                for seq, src in snapshot]
+
+    # ---- master service ----------------------------------------------------
+
+    def submit_state_update(
+            self, source: str,
+            update: Callable[[ClusterState], ClusterState],
+            priority: int = NORMAL) -> Future:
+        """Enqueue a state mutation; the Future resolves to the applied
+        state (or the unchanged state for a no-op), raising the task's
+        exception on failure."""
+        fut: Future = Future()
+
+        def run():
             old = self._state
-            new = update(old)
-            if new is old:
-                return old
+            try:
+                new = update(old)
+            except Exception as e:              # noqa: BLE001 → future
+                fut.set_exception(e)
+                return
+            if new is old or new == old:
+                fut.set_result(old)
+                return
+            try:
+                if self.publish is not None:
+                    self.publish(new, old)
+                else:
+                    self.apply_new_state(new)
+            except Exception as e:              # noqa: BLE001 → future
+                fut.set_exception(e)
+                return
+            fut.set_result(new)
+
+        self._enqueue(source, run, priority)
+        return fut
+
+    def submit_and_wait(self, source: str, update, priority: int = NORMAL,
+                        timeout: float = 30.0) -> ClusterState:
+        return self.submit_state_update(source, update, priority).result(
+            timeout)
+
+    # ---- applier service ---------------------------------------------------
+
+    def apply_published_state(self, new: ClusterState) -> Future:
+        """Called by Discovery when a (committed) state arrives from the
+        master. Runs on the executor to preserve single-threaded apply."""
+        fut: Future = Future()
+
+        def run():
+            try:
+                if new.version > self._state.version:
+                    self.apply_new_state(new)
+                fut.set_result(self._state)
+            except Exception as e:              # noqa: BLE001 → future
+                fut.set_exception(e)
+
+        self._enqueue(f"apply published state [{new.version}]", run, HIGH)
+        return fut
+
+    def apply_new_state(self, new: ClusterState) -> None:
+        """Swap the state and fan out to listeners. Must run on the
+        executor thread (or before the node is wired up)."""
+        with self._state_lock:
+            old = self._state
             self._state = new
-        for fn in self._listeners:
-            fn(old, new)
-        return new
+        for fn in list(self._listeners):
+            try:
+                fn(old, new)
+            except Exception:                   # noqa: BLE001 — isolate
+                traceback.print_exc()
+
+    # ---- internals ---------------------------------------------------------
+
+    def _enqueue(self, source: str, run: Callable, priority: int) -> None:
+        if self._stopped:
+            raise RuntimeError("cluster service is closed")
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+            self._pending[seq] = source
+        task = _Task(priority, seq, source, run)
+
+        def wrapped():
+            try:
+                run()
+            finally:
+                with self._seq_lock:
+                    self._pending.pop(seq, None)
+        task.run = wrapped
+        self._queue.put(task)
+
+    def _loop(self) -> None:
+        while not self._stopped:
+            task = self._queue.get()
+            if self._stopped:
+                return
+            try:
+                task.run()
+            except Exception:                   # noqa: BLE001 — keep looping
+                traceback.print_exc()

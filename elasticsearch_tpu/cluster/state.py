@@ -2,21 +2,24 @@
 
 Reference: core/cluster/ClusterState.java:91,155-161 — {version, nodes,
 metaData (indices/mappings/settings/templates), routingTable, blocks} with
-incremental diff publish (Diffable, :746). Round 1 runs a single node, but
-the model is the multi-node one: every mutation goes through the
-single-writer ClusterService (service.py) producing a new versioned state,
-and the routing table tracks per-shard state machines
-(core/cluster/routing/ShardRoutingState.java:27-44).
+incremental diff publish (Diffable, ClusterState.java:746). The routing
+table tracks per-shard-copy state machines
+(core/cluster/routing/ShardRoutingState.java:27-44) with allocation ids
+(core/cluster/routing/AllocationId.java) and unassigned metadata
+(core/cluster/routing/UnassignedInfo.java:41-45, incl. the delayed-
+allocation window on node-left).
 """
 
 from __future__ import annotations
 
-import copy
 import enum
 import json
+import time
+import uuid
 from dataclasses import dataclass, field, replace
 from pathlib import Path
-from typing import Any
+
+from elasticsearch_tpu.transport.service import DiscoveryNode, TransportAddress
 
 
 class ShardRoutingState(str, enum.Enum):
@@ -26,6 +29,24 @@ class ShardRoutingState(str, enum.Enum):
     RELOCATING = "RELOCATING"
 
 
+class UnassignedReason(str, enum.Enum):
+    """UnassignedInfo.Reason (core/cluster/routing/UnassignedInfo.java:47)."""
+    INDEX_CREATED = "INDEX_CREATED"
+    CLUSTER_RECOVERED = "CLUSTER_RECOVERED"
+    NODE_LEFT = "NODE_LEFT"
+    ALLOCATION_FAILED = "ALLOCATION_FAILED"
+    REPLICA_ADDED = "REPLICA_ADDED"
+    REROUTE_CANCELLED = "REROUTE_CANCELLED"
+
+
+@dataclass(frozen=True)
+class UnassignedInfo:
+    reason: UnassignedReason = UnassignedReason.INDEX_CREATED
+    at_millis: int = 0
+    details: str = ""
+    failed_allocations: int = 0
+
+
 @dataclass(frozen=True)
 class ShardRouting:
     index: str
@@ -33,9 +54,74 @@ class ShardRouting:
     node_id: str | None
     primary: bool
     state: ShardRoutingState
+    allocation_id: str | None = None
+    unassigned_info: UnassignedInfo | None = None
+    relocating_node_id: str | None = None
+
+    @property
+    def active(self) -> bool:
+        return self.state in (ShardRoutingState.STARTED,
+                              ShardRoutingState.RELOCATING)
+
+    @property
+    def assigned(self) -> bool:
+        return self.node_id is not None
+
+    def initialize(self, node_id: str) -> "ShardRouting":
+        """Keeps unassigned_info until STARTED so failure counts survive
+        re-allocation attempts (UnassignedInfo.java — the info travels with
+        the shard until it starts)."""
+        assert self.state == ShardRoutingState.UNASSIGNED
+        return replace(self, node_id=node_id,
+                       state=ShardRoutingState.INITIALIZING,
+                       allocation_id=uuid.uuid4().hex[:20])
 
     def started(self) -> "ShardRouting":
-        return replace(self, state=ShardRoutingState.STARTED)
+        return replace(self, state=ShardRoutingState.STARTED,
+                       relocating_node_id=None, unassigned_info=None)
+
+    def failed(self, reason: UnassignedReason, details: str = "",
+               failed_allocations: int = 0) -> "ShardRouting":
+        return replace(
+            self, node_id=None, state=ShardRoutingState.UNASSIGNED,
+            allocation_id=None, relocating_node_id=None,
+            unassigned_info=UnassignedInfo(
+                reason, int(time.time() * 1000), details,
+                failed_allocations))
+
+    @property
+    def key(self) -> tuple:
+        """Identity of this shard copy within a routing table."""
+        return (self.index, self.shard, self.primary, self.allocation_id,
+                self.node_id)
+
+    def to_dict(self) -> dict:
+        d = {"index": self.index, "shard": self.shard,
+             "node": self.node_id, "primary": self.primary,
+             "state": self.state.value, "allocation_id": self.allocation_id,
+             "relocating_node": self.relocating_node_id}
+        if self.unassigned_info is not None:
+            d["unassigned_info"] = {
+                "reason": self.unassigned_info.reason.value,
+                "at": self.unassigned_info.at_millis,
+                "details": self.unassigned_info.details,
+                "failed_allocations":
+                    self.unassigned_info.failed_allocations}
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "ShardRouting":
+        ui = None
+        if d.get("unassigned_info"):
+            u = d["unassigned_info"]
+            ui = UnassignedInfo(UnassignedReason(u["reason"]), u["at"],
+                                u.get("details", ""),
+                                u.get("failed_allocations", 0))
+        return ShardRouting(
+            index=d["index"], shard=d["shard"], node_id=d.get("node"),
+            primary=d["primary"], state=ShardRoutingState(d["state"]),
+            allocation_id=d.get("allocation_id"), unassigned_info=ui,
+            relocating_node_id=d.get("relocating_node"))
 
 
 @dataclass(frozen=True)
@@ -49,6 +135,7 @@ class IndexMetadata:
     state: str = "open"                      # open | close
     creation_date: int = 0
     uuid: str = ""
+    version: int = 1                         # bumped on mapping/settings edit
 
     def to_dict(self) -> dict:
         return {
@@ -64,6 +151,24 @@ class IndexMetadata:
             "aliases": self.aliases,
         }
 
+    def to_state_dict(self) -> dict:
+        return {"number_of_shards": self.number_of_shards,
+                "number_of_replicas": self.number_of_replicas,
+                "settings": self.settings, "mappings": self.mappings,
+                "aliases": self.aliases, "state": self.state,
+                "creation_date": self.creation_date, "uuid": self.uuid,
+                "version": self.version}
+
+    @staticmethod
+    def from_state_dict(name: str, m: dict) -> "IndexMetadata":
+        return IndexMetadata(
+            name=name, number_of_shards=m["number_of_shards"],
+            number_of_replicas=m["number_of_replicas"],
+            settings=m.get("settings", {}), mappings=m.get("mappings", {}),
+            aliases=m.get("aliases", {}), state=m.get("state", "open"),
+            creation_date=m.get("creation_date", 0), uuid=m.get("uuid", ""),
+            version=m.get("version", 1))
+
 
 @dataclass(frozen=True)
 class RoutingTable:
@@ -72,82 +177,245 @@ class RoutingTable:
     def index_shards(self, index: str) -> list[ShardRouting]:
         return [s for s in self.shards if s.index == index]
 
-    def add_index(self, meta: IndexMetadata, node_id: str) -> "RoutingTable":
+    def shard_copies(self, index: str, shard: int) -> list[ShardRouting]:
+        return [s for s in self.shards
+                if s.index == index and s.shard == shard]
+
+    def primary(self, index: str, shard: int) -> ShardRouting | None:
+        for s in self.shards:
+            if s.index == index and s.shard == shard and s.primary:
+                return s
+        return None
+
+    def on_node(self, node_id: str) -> list[ShardRouting]:
+        return [s for s in self.shards if s.node_id == node_id]
+
+    def unassigned(self) -> list[ShardRouting]:
+        return [s for s in self.shards
+                if s.state == ShardRoutingState.UNASSIGNED]
+
+    def add_index(self, meta: IndexMetadata) -> "RoutingTable":
+        """All new shard copies start UNASSIGNED; the AllocationService
+        assigns them (MetaDataCreateIndexService → AllocationService.reroute)."""
         new = list(self.shards)
+        now = int(time.time() * 1000)
         for sid in range(meta.number_of_shards):
-            new.append(ShardRouting(meta.name, sid, node_id, True,
-                                    ShardRoutingState.STARTED))
+            new.append(ShardRouting(
+                meta.name, sid, None, True, ShardRoutingState.UNASSIGNED,
+                unassigned_info=UnassignedInfo(
+                    UnassignedReason.INDEX_CREATED, now)))
             for _ in range(meta.number_of_replicas):
-                new.append(ShardRouting(meta.name, sid, None, False,
-                                        ShardRoutingState.UNASSIGNED))
+                new.append(ShardRouting(
+                    meta.name, sid, None, False, ShardRoutingState.UNASSIGNED,
+                    unassigned_info=UnassignedInfo(
+                        UnassignedReason.INDEX_CREATED, now)))
         return RoutingTable(tuple(new))
 
     def remove_index(self, index: str) -> "RoutingTable":
         return RoutingTable(tuple(s for s in self.shards if s.index != index))
+
+    def update_replica_count(self, index: str, replicas: int) -> "RoutingTable":
+        """Add/remove replica copies (update number_of_replicas setting)."""
+        new = [s for s in self.shards if s.index != index]
+        now = int(time.time() * 1000)
+        by_shard: dict[int, list[ShardRouting]] = {}
+        for s in self.index_shards(index):
+            by_shard.setdefault(s.shard, []).append(s)
+        for sid, copies in sorted(by_shard.items()):
+            prim = [c for c in copies if c.primary]
+            reps = [c for c in copies if not c.primary]
+            # when shrinking, drop unassigned/inactive copies before live
+            # ones (never discard a healthy copy while a dead one remains)
+            reps.sort(key=lambda c: (not c.active, not c.assigned))
+            new.extend(prim)
+            new.extend(reps[:replicas])
+            for _ in range(replicas - len(reps)):
+                new.append(ShardRouting(
+                    index, sid, None, False, ShardRoutingState.UNASSIGNED,
+                    unassigned_info=UnassignedInfo(
+                        UnassignedReason.REPLICA_ADDED, now)))
+        return RoutingTable(tuple(new))
+
+    def replace_shard(self, old: ShardRouting,
+                      new: ShardRouting) -> "RoutingTable":
+        out = []
+        replaced = False
+        for s in self.shards:
+            if not replaced and s.key == old.key:
+                out.append(new)
+                replaced = True
+            else:
+                out.append(s)
+        if not replaced:
+            raise ValueError(f"shard not in table: {old}")
+        return RoutingTable(tuple(out))
+
+    def to_dict(self) -> dict:
+        return {"shards": [s.to_dict() for s in self.shards]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "RoutingTable":
+        return RoutingTable(tuple(ShardRouting.from_dict(s)
+                                  for s in d.get("shards", [])))
+
+
+# Cluster-level blocks (core/cluster/block/ClusterBlocks.java)
+STATE_NOT_RECOVERED_BLOCK = "state_not_recovered"
+NO_MASTER_BLOCK = "no_master"
 
 
 @dataclass(frozen=True)
 class ClusterState:
     cluster_name: str = "elasticsearch-tpu"
     version: int = 0
+    state_uuid: str = ""
     master_node_id: str | None = None
-    nodes: dict = field(default_factory=dict)       # node_id → {name, ...}
+    nodes: dict = field(default_factory=dict)   # node_id → DiscoveryNode
     indices: dict = field(default_factory=dict)     # name → IndexMetadata
     routing_table: RoutingTable = field(default_factory=RoutingTable)
     templates: dict = field(default_factory=dict)
+    persistent_settings: dict = field(default_factory=dict)
+    transient_settings: dict = field(default_factory=dict)
     blocks: frozenset = frozenset()
+    customs: dict = field(default_factory=dict)  # e.g. snapshots-in-progress
 
     def with_(self, **kw) -> "ClusterState":
         kw.setdefault("version", self.version + 1)
+        kw.setdefault("state_uuid", uuid.uuid4().hex[:22])
         return replace(self, **kw)
 
-    def health(self) -> dict:
+    def node(self, node_id: str) -> DiscoveryNode | None:
+        return self.nodes.get(node_id)
+
+    @property
+    def master_node(self) -> DiscoveryNode | None:
+        return self.nodes.get(self.master_node_id) \
+            if self.master_node_id else None
+
+    def data_nodes(self) -> dict:
+        return {nid: n for nid, n in self.nodes.items() if n.data_node}
+
+    def health(self, pending_tasks: int = 0) -> dict:
         counts = {s: 0 for s in ShardRoutingState}
         for sh in self.routing_table.shards:
             counts[sh.state] += 1
         unassigned = counts[ShardRoutingState.UNASSIGNED]
         primaries_ok = all(
-            s.state == ShardRoutingState.STARTED
-            for s in self.routing_table.shards if s.primary)
-        if not primaries_ok:
+            s.active for s in self.routing_table.shards if s.primary)
+        if not primaries_ok or STATE_NOT_RECOVERED_BLOCK in self.blocks:
             status = "red"
-        elif unassigned > 0:
+        elif unassigned > 0 or counts[ShardRoutingState.INITIALIZING] > 0:
             status = "yellow"
         else:
             status = "green"
-        active = counts[ShardRoutingState.STARTED]
+        active = counts[ShardRoutingState.STARTED] + \
+            counts[ShardRoutingState.RELOCATING]
         total = len(self.routing_table.shards)
         return {
             "cluster_name": self.cluster_name,
             "status": status,
             "timed_out": False,
             "number_of_nodes": len(self.nodes),
-            "number_of_data_nodes": len(self.nodes),
+            "number_of_data_nodes": len(self.data_nodes()),
             "active_primary_shards": sum(
                 1 for s in self.routing_table.shards
-                if s.primary and s.state == ShardRoutingState.STARTED),
+                if s.primary and s.active),
             "active_shards": active,
             "relocating_shards": counts[ShardRoutingState.RELOCATING],
             "initializing_shards": counts[ShardRoutingState.INITIALIZING],
             "unassigned_shards": unassigned,
+            "number_of_pending_tasks": pending_tasks,
             "active_shards_percent_as_number":
                 100.0 * active / total if total else 100.0,
         }
 
+    # ---- wire serialization (publish) --------------------------------------
+
+    def to_wire_dict(self) -> dict:
+        return {
+            "cluster_name": self.cluster_name,
+            "version": self.version,
+            "state_uuid": self.state_uuid,
+            "master_node_id": self.master_node_id,
+            "nodes": {nid: {"name": n.name, "host": n.address.host,
+                            "port": n.address.port,
+                            "attributes": dict(n.attributes),
+                            "version": n.version}
+                      for nid, n in self.nodes.items()},
+            "indices": {n: m.to_state_dict() for n, m in self.indices.items()},
+            "routing_table": self.routing_table.to_dict(),
+            "templates": self.templates,
+            "persistent_settings": self.persistent_settings,
+            "transient_settings": self.transient_settings,
+            "blocks": sorted(self.blocks),
+            "customs": self.customs,
+        }
+
+    @staticmethod
+    def from_wire_dict(d: dict) -> "ClusterState":
+        nodes = {nid: DiscoveryNode(
+            node_id=nid, name=n["name"],
+            address=TransportAddress(n["host"], n["port"]),
+            attributes=tuple(sorted(n.get("attributes", {}).items())),
+            version=n.get("version", 0))
+            for nid, n in d.get("nodes", {}).items()}
+        return ClusterState(
+            cluster_name=d.get("cluster_name", "elasticsearch-tpu"),
+            version=d["version"],
+            state_uuid=d.get("state_uuid", ""),
+            master_node_id=d.get("master_node_id"),
+            nodes=nodes,
+            indices={n: IndexMetadata.from_state_dict(n, m)
+                     for n, m in d.get("indices", {}).items()},
+            routing_table=RoutingTable.from_dict(
+                d.get("routing_table", {})),
+            templates=d.get("templates", {}),
+            persistent_settings=d.get("persistent_settings", {}),
+            transient_settings=d.get("transient_settings", {}),
+            blocks=frozenset(d.get("blocks", [])),
+            customs=d.get("customs", {}))
+
+    # ---- diffs (PublishClusterStateAction diff vs full, :167-169) ----------
+
+    _DIFF_PARTS = ("nodes", "indices", "routing_table", "templates",
+                   "persistent_settings", "transient_settings", "blocks",
+                   "customs", "master_node_id")
+
+    def diff_from(self, prev: "ClusterState") -> dict:
+        """Section-level diff: only parts whose content changed are shipped
+        (coarser than the reference's per-index diffs but the same protocol:
+        applicable only on top of exactly `from_uuid`)."""
+        mine = self.to_wire_dict()
+        theirs = prev.to_wire_dict()
+        changed = {p: mine[p] for p in self._DIFF_PARTS
+                   if mine[p] != theirs[p]}
+        return {"from_version": prev.version, "from_uuid": prev.state_uuid,
+                "to_version": self.version, "to_uuid": self.state_uuid,
+                "cluster_name": self.cluster_name, "parts": changed}
+
+    @staticmethod
+    def apply_diff(base: "ClusterState", diff: dict) -> "ClusterState":
+        if base.state_uuid != diff["from_uuid"]:
+            raise IncompatibleClusterStateVersionError(
+                f"diff base {diff['from_uuid']} != local {base.state_uuid}")
+        d = base.to_wire_dict()
+        d.update(diff["parts"])
+        d["version"] = diff["to_version"]
+        d["state_uuid"] = diff["to_uuid"]
+        return ClusterState.from_wire_dict(d)
+
     # ---- persistence (gateway analog: MetaDataStateFormat) -----------------
 
     def persist(self, path: Path) -> None:
+        """Metadata only — routing/nodes are runtime state, recomputed on
+        recovery (GatewayMetaState persists MetaData, not RoutingTable)."""
         state = {
             "version": self.version,
             "cluster_name": self.cluster_name,
-            "indices": {
-                name: {"number_of_shards": m.number_of_shards,
-                       "number_of_replicas": m.number_of_replicas,
-                       "settings": m.settings, "mappings": m.mappings,
-                       "aliases": m.aliases, "state": m.state,
-                       "creation_date": m.creation_date, "uuid": m.uuid}
-                for name, m in self.indices.items()},
+            "indices": {n: m.to_state_dict()
+                        for n, m in self.indices.items()},
             "templates": self.templates,
+            "persistent_settings": self.persistent_settings,
         }
         path.mkdir(parents=True, exist_ok=True)
         tmp = path / "global-state.json.tmp"
@@ -155,24 +423,14 @@ class ClusterState:
         tmp.replace(path / "global-state.json")
 
     @staticmethod
-    def load(path: Path, node_id: str) -> "ClusterState":
+    def load_metadata(path: Path) -> dict | None:
+        """→ raw persisted metadata dict, or None (gateway recovery input)."""
         f = path / "global-state.json"
         if not f.exists():
-            return ClusterState()
-        raw = json.loads(f.read_text())
-        indices = {}
-        routing = RoutingTable()
-        for name, m in raw.get("indices", {}).items():
-            meta = IndexMetadata(
-                name=name, number_of_shards=m["number_of_shards"],
-                number_of_replicas=m["number_of_replicas"],
-                settings=m.get("settings", {}), mappings=m.get("mappings", {}),
-                aliases=m.get("aliases", {}), state=m.get("state", "open"),
-                creation_date=m.get("creation_date", 0), uuid=m.get("uuid", ""))
-            indices[name] = meta
-            routing = routing.add_index(meta, node_id)
-        return ClusterState(version=raw.get("version", 0),
-                            cluster_name=raw.get("cluster_name",
-                                                 "elasticsearch-tpu"),
-                            indices=indices, routing_table=routing,
-                            templates=raw.get("templates", {}))
+            return None
+        return json.loads(f.read_text())
+
+
+class IncompatibleClusterStateVersionError(Exception):
+    """Diff cannot apply; the publisher falls back to full state
+    (PublishClusterStateAction.java IncompatibleClusterStateVersionException)."""

@@ -205,3 +205,14 @@ class Settings(Mapping[str, Any]):
 
 
 Settings.EMPTY = Settings()
+
+
+def parse_time_millis(v) -> int:
+    """'100ms' / '30s' / '1m' / '2h' / bare number → milliseconds
+    (TimeValue.parseTimeValue, core/common/unit/TimeValue.java)."""
+    s = str(v)
+    for suffix, mult in (("ms", 1), ("s", 1000), ("m", 60000),
+                         ("h", 3600000), ("d", 86400000)):
+        if s.endswith(suffix):
+            return int(float(s[:-len(suffix)]) * mult)
+    return int(float(s))

@@ -2,10 +2,15 @@
 
 Reference: core/indices/IndicesService.java creates a per-index injector and
 per-shard IndexShard instances; IndicesClusterStateService
-(core/indices/cluster/IndicesClusterStateService.java:71) reconciles the
-published cluster state against local shards. Here the reconciler listens on
-ClusterService and creates/removes IndexService objects, each owning one
-Engine per local shard.
+(core/indices/cluster/IndicesClusterStateService.java:71,140,171-251)
+reconciles every published cluster state against local shards: create
+indices/shards newly assigned here, remove ones no longer local, apply
+mapping updates, and report INITIALIZING→STARTED to the master
+(ShardStateAction analog via the `on_shard_started` callback).
+
+Metadata mutations (create/delete index, mappings, aliases) are master-side
+state updates (MetaDataCreateIndexService / MetaDataMappingService) that end
+with an AllocationService.reroute so new shards get assigned.
 """
 
 from __future__ import annotations
@@ -16,8 +21,10 @@ import uuid
 from pathlib import Path
 
 from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.cluster.allocation import AllocationService
 from elasticsearch_tpu.cluster.routing import OperationRouting
-from elasticsearch_tpu.cluster.state import ClusterState, IndexMetadata
+from elasticsearch_tpu.cluster.state import (
+    ClusterState, IndexMetadata, ShardRouting, ShardRoutingState)
 from elasticsearch_tpu.common.errors import (
     IndexAlreadyExistsError, IndexNotFoundError, IllegalArgumentError)
 from elasticsearch_tpu.common.settings import Settings
@@ -25,27 +32,82 @@ from elasticsearch_tpu.index.engine import Engine
 from elasticsearch_tpu.mapping import MapperService
 
 
-class IndexService:
-    """Per-index container: mapper service + one engine per local shard."""
+def _normalize_index_settings(raw: dict) -> dict:
+    """Create-index bodies accept both `number_of_shards` and
+    `index.number_of_shards` — the reference prefixes bare keys with
+    `index.` (IndexMetaData settings normalization)."""
+    flat = dict(Settings(raw))
+    return {k if k.startswith("index.") else f"index.{k}": v
+            for k, v in flat.items()}
 
-    def __init__(self, meta: IndexMetadata, path: Path):
+
+class ShardNotLocalError(Exception):
+    """The target shard copy lives on another node — the action layer must
+    route the operation over the transport."""
+
+    def __init__(self, index: str, shard: int):
+        super().__init__(f"shard [{index}][{shard}] is not on this node")
+        self.index = index
+        self.shard = shard
+
+
+class IndexService:
+    """Per-index container: mapper service + one engine per LOCAL shard."""
+
+    def __init__(self, meta: IndexMetadata, path: Path,
+                 local_shards: list[int] | None = None):
         self.name = meta.name
         self.meta = meta
         self.path = path
         index_settings = Settings(meta.settings)
+        self.index_settings = index_settings
         self.analysis = AnalysisRegistry(index_settings)
         self.mapper_service = MapperService(self.analysis)
         for type_name, mapping in (meta.mappings or {}).items():
             self.mapper_service.merge(type_name, mapping)
-        self.shard_engines: list[Engine] = []
-        for sid in range(meta.number_of_shards):
-            self.shard_engines.append(
-                Engine(path / str(sid), self.mapper_service, index_settings))
+        self.engines: dict[int, Engine] = {}
+        if local_shards is None:
+            local_shards = list(range(meta.number_of_shards))
+        for sid in local_shards:
+            self.add_local_shard(sid)
+
+    # ---- local shard management -------------------------------------------
+
+    def add_local_shard(self, sid: int) -> Engine:
+        if sid not in self.engines:
+            self.engines[sid] = Engine(self.path / str(sid),
+                                       self.mapper_service,
+                                       self.index_settings)
+        return self.engines[sid]
+
+    def remove_local_shard(self, sid: int, delete_files: bool = False) -> None:
+        engine = self.engines.pop(sid, None)
+        if engine is not None:
+            engine.close()
+        if delete_files:
+            shutil.rmtree(self.path / str(sid), ignore_errors=True)
+
+    @property
+    def shard_engines(self) -> list[Engine]:
+        """Local engines in shard order (search iterates these)."""
+        return [self.engines[sid] for sid in sorted(self.engines)]
+
+    def shard_id_for(self, doc_id: str, routing: str | None = None) -> int:
+        return OperationRouting.shard_id(doc_id, self.meta.number_of_shards,
+                                         routing)
 
     def shard_for(self, doc_id: str, routing: str | None = None) -> Engine:
-        sid = OperationRouting.shard_id(doc_id, self.meta.number_of_shards,
-                                        routing)
-        return self.shard_engines[sid]
+        sid = self.shard_id_for(doc_id, routing)
+        engine = self.engines.get(sid)
+        if engine is None:
+            raise ShardNotLocalError(self.name, sid)
+        return engine
+
+    def engine(self, sid: int) -> Engine:
+        e = self.engines.get(sid)
+        if e is None:
+            raise ShardNotLocalError(self.name, sid)
+        return e
 
     def refresh(self):
         for e in self.shard_engines:
@@ -84,7 +146,8 @@ class IndexService:
             "flush": {"total": agg["flush_total"]},
             "merges": {"total": agg["merge_total"]},
             "segments": {"count": len(segs),
-                         "memory_in_bytes": sum(s["memory_bytes"] for s in segs)},
+                         "memory_in_bytes": sum(s["memory_bytes"]
+                                                for s in segs)},
         }
 
     def close(self):
@@ -93,11 +156,22 @@ class IndexService:
 
 
 class IndicesService:
-    def __init__(self, data_path: Path, cluster_service, node_id: str):
+    def __init__(self, data_path: Path, cluster_service, node_id: str,
+                 allocation: AllocationService | None = None):
         self.data_path = Path(data_path)
         self.cluster_service = cluster_service
         self.node_id = node_id
+        self.allocation = allocation or AllocationService()
         self.indices: dict[str, IndexService] = {}
+        # allocation ids this node has already reported as started
+        self._reported_started: set[str] = set()
+        # Node wires this to the ShardStateAction path:
+        # on_shard_started(shard_routing) → master applies started
+        self.on_shard_started = None
+        # recovery hook (peer recovery, task: recovery module):
+        # prepare_shard(shard_routing, engine) → None; may pull files/ops
+        # from the primary before the shard is reported started
+        self.prepare_shard = None
         cluster_service.add_listener(self._cluster_changed)
         # reconcile initial (recovered) state
         self._cluster_changed(ClusterState(), cluster_service.state())
@@ -105,21 +179,55 @@ class IndicesService:
     # ---- reconciler (IndicesClusterStateService.clusterChanged analog) ----
 
     def _cluster_changed(self, old: ClusterState, new: ClusterState) -> None:
+        # shards the routing table places on this node
+        local_by_index: dict[str, list[ShardRouting]] = {}
+        for s in new.routing_table.on_node(self.node_id):
+            local_by_index.setdefault(s.index, []).append(s)
+
         for name, meta in new.indices.items():
-            if name not in self.indices and meta.state == "open":
-                self.indices[name] = IndexService(
-                    meta, self.data_path / "indices" / name)
-            elif name in self.indices:
-                svc = self.indices[name]
-                if meta.state == "close":
+            local = local_by_index.get(name, [])
+            if meta.state != "open":
+                svc = self.indices.pop(name, None)
+                if svc is not None:
                     svc.close()
-                    del self.indices[name]
-                elif meta.mappings != svc.meta.mappings:
-                    for t, m in (meta.mappings or {}).items():
-                        svc.mapper_service.merge(t, m)
-                    svc.meta = meta
-                else:
-                    svc.meta = meta
+                continue
+            if name not in self.indices:
+                if not local:
+                    continue                     # nothing of it lives here
+                self.indices[name] = IndexService(
+                    meta, self.data_path / "indices" / name,
+                    local_shards=[s.shard for s in local])
+            svc = self.indices[name]
+            if meta.mappings != svc.meta.mappings:
+                for t, m in (meta.mappings or {}).items():
+                    svc.mapper_service.merge(t, m)
+            svc.meta = meta
+            # create newly assigned shards / drop moved-away ones
+            want = {s.shard for s in local}
+            for sid in want - set(svc.engines):
+                svc.add_local_shard(sid)
+            for sid in set(svc.engines) - want:
+                svc.remove_local_shard(sid)
+            # report INITIALIZING shards as started (ShardStateAction).
+            # Only mark reported when the callback actually fired — during
+            # the constructor reconcile it is not wired yet and the Node's
+            # follow-up recheck must pick these shards up.
+            for s in local:
+                if s.state == ShardRoutingState.INITIALIZING and \
+                        s.allocation_id not in self._reported_started and \
+                        self.on_shard_started is not None:
+                    engine = svc.engines[s.shard]
+                    if self.prepare_shard is not None:
+                        try:
+                            self.prepare_shard(s, engine)
+                        except Exception as e:  # noqa: BLE001 — report fail
+                            self._reported_started.add(s.allocation_id)
+                            if self.on_shard_failed is not None:
+                                self.on_shard_failed(s, str(e))
+                            continue
+                    self._reported_started.add(s.allocation_id)
+                    self.on_shard_started(s)
+
         for name in list(self.indices):
             if name not in new.indices:
                 self.indices[name].close()
@@ -127,9 +235,12 @@ class IndicesService:
                               ignore_errors=True)
                 del self.indices[name]
 
+    on_shard_failed = None
+
     # ---- metadata CRUD (MetaDataCreateIndexService analog) ----------------
 
-    def create_index(self, name: str, body: dict | None = None) -> IndexService:
+    def create_index(self, name: str,
+                     body: dict | None = None) -> IndexService | None:
         body = body or {}
         if not name or name.startswith(("_", "-")) or name != name.lower() \
                 or any(c in name for c in ' "\\/,|<>?*'):
@@ -138,17 +249,21 @@ class IndicesService:
         def update(state: ClusterState) -> ClusterState:
             if name in state.indices:
                 raise IndexAlreadyExistsError(name)
-            settings = dict(Settings(body.get("settings", {})))
+            settings = _normalize_index_settings(body.get("settings", {}))
             mappings = dict(body.get("mappings", {}))
             if mappings and "properties" in mappings:
                 mappings = {"_doc": mappings}   # typeless API compat
-            # apply matching templates (MetaDataCreateIndexService template merge)
+            # apply matching templates; highest order wins conflicts, so
+            # with setdefault-application it must be applied FIRST
+            # (MetaDataCreateIndexService.java sorts by order descending)
             for tname, tmpl in sorted(state.templates.items(),
-                                      key=lambda kv: kv[1].get("order", 0)):
+                                      key=lambda kv: -kv[1].get("order", 0)):
                 import fnmatch as _fn
-                patterns = tmpl.get("index_patterns") or [tmpl.get("template", "")]
+                patterns = tmpl.get("index_patterns") or \
+                    [tmpl.get("template", "")]
                 if any(_fn.fnmatch(name, p) for p in patterns if p):
-                    for k, v in Settings(tmpl.get("settings", {})).as_dict().items():
+                    for k, v in Settings(
+                            tmpl.get("settings", {})).as_dict().items():
                         settings.setdefault(k, v)
                     tmap = tmpl.get("mappings", {})
                     if tmap and "properties" in tmap:
@@ -156,22 +271,26 @@ class IndicesService:
                     for t, m in tmap.items():
                         base = mappings.setdefault(t, {"properties": {}})
                         for fname, fdef in m.get("properties", {}).items():
-                            base.setdefault("properties", {}).setdefault(fname, fdef)
+                            base.setdefault("properties", {}).setdefault(
+                                fname, fdef)
             sett = Settings(settings)
             meta = IndexMetadata(
                 name=name,
                 number_of_shards=sett.get_as_int("index.number_of_shards", 1),
-                number_of_replicas=sett.get_as_int("index.number_of_replicas", 0),
+                number_of_replicas=sett.get_as_int(
+                    "index.number_of_replicas", 0),
                 settings=settings, mappings=mappings,
-                aliases={a: (v or {}) for a, v in body.get("aliases", {}).items()},
+                aliases={a: (v or {})
+                         for a, v in body.get("aliases", {}).items()},
                 creation_date=int(time.time() * 1000),
                 uuid=uuid.uuid4().hex[:22])
-            return state.with_(
+            new = state.with_(
                 indices={**state.indices, name: meta},
-                routing_table=state.routing_table.add_index(meta, self.node_id))
+                routing_table=state.routing_table.add_index(meta))
+            return self.allocation.reroute(new, f"index created [{name}]")
 
-        self.cluster_service.submit_state_update(f"create-index [{name}]", update)
-        return self.indices[name]
+        self.cluster_service.submit_and_wait(f"create-index [{name}]", update)
+        return self.indices.get(name)
 
     def delete_index(self, name: str) -> None:
         def update(state: ClusterState) -> ClusterState:
@@ -182,22 +301,55 @@ class IndicesService:
                 del indices[n]
                 routing = routing.remove_index(n)
             return state.with_(indices=indices, routing_table=routing)
-        self.cluster_service.submit_state_update(f"delete-index [{name}]", update)
+        self.cluster_service.submit_and_wait(f"delete-index [{name}]", update)
 
     def put_mapping(self, name: str, type_name: str, mapping: dict) -> None:
         def update(state: ClusterState) -> ClusterState:
             if name not in state.indices:
                 raise IndexNotFoundError(name)
             meta = state.indices[name]
-            # validate merge against a scratch mapper first (reference:
-            # dry-run merge before committing the mapping update)
-            self.indices[name].mapper_service.merge(type_name, mapping)
-            merged = self.indices[name].mapper_service.mapping_dict()[type_name]
+            if name in self.indices:
+                # validate merge against the live mapper first (reference:
+                # dry-run merge before committing the mapping update)
+                self.indices[name].mapper_service.merge(type_name, mapping)
+                merged = self.indices[name].mapper_service.mapping_dict()[
+                    type_name]
+            else:
+                scratch = MapperService(AnalysisRegistry(
+                    Settings(meta.settings)))
+                for t, m in (meta.mappings or {}).items():
+                    scratch.merge(t, m)
+                scratch.merge(type_name, mapping)
+                merged = scratch.mapping_dict()[type_name]
             new_meta = IndexMetadata(
-                **{**meta.__dict__,
+                **{**meta.__dict__, "version": meta.version + 1,
                    "mappings": {**meta.mappings, type_name: merged}})
             return state.with_(indices={**state.indices, name: new_meta})
-        self.cluster_service.submit_state_update(f"put-mapping [{name}]", update)
+        self.cluster_service.submit_and_wait(f"put-mapping [{name}]", update)
+
+    def update_settings(self, name: str, settings: dict) -> None:
+        """Per-index dynamic settings (IndexSettingsService analog);
+        number_of_replicas changes resize the routing table."""
+        def update(state: ClusterState) -> ClusterState:
+            new_indices = dict(state.indices)
+            routing = state.routing_table
+            for n in self._resolve(state, name):
+                meta = state.indices[n]
+                merged = {**meta.settings,
+                          **_normalize_index_settings(settings)}
+                replicas = Settings(merged).get_as_int(
+                    "index.number_of_replicas", meta.number_of_replicas)
+                new_meta = IndexMetadata(
+                    **{**meta.__dict__, "settings": merged,
+                       "version": meta.version + 1,
+                       "number_of_replicas": replicas})
+                new_indices[n] = new_meta
+                if replicas != meta.number_of_replicas:
+                    routing = routing.update_replica_count(n, replicas)
+            new = state.with_(indices=new_indices, routing_table=routing)
+            return self.allocation.reroute(new, "settings updated")
+        self.cluster_service.submit_and_wait(f"update-settings [{name}]",
+                                             update)
 
     def put_alias(self, index: str, alias: str, body: dict | None = None):
         def update(state: ClusterState) -> ClusterState:
@@ -208,7 +360,7 @@ class IndicesService:
                 **{**meta.__dict__,
                    "aliases": {**meta.aliases, alias: body or {}}})
             return state.with_(indices={**state.indices, index: new_meta})
-        self.cluster_service.submit_state_update(f"put-alias [{alias}]", update)
+        self.cluster_service.submit_and_wait(f"put-alias [{alias}]", update)
 
     def delete_alias(self, index: str, alias: str):
         def update(state: ClusterState) -> ClusterState:
@@ -218,7 +370,8 @@ class IndicesService:
             aliases = {k: v for k, v in meta.aliases.items() if k != alias}
             new_meta = IndexMetadata(**{**meta.__dict__, "aliases": aliases})
             return state.with_(indices={**state.indices, index: new_meta})
-        self.cluster_service.submit_state_update(f"delete-alias [{alias}]", update)
+        self.cluster_service.submit_and_wait(f"delete-alias [{alias}]",
+                                             update)
 
     # ---- resolution -------------------------------------------------------
 
@@ -260,7 +413,10 @@ class IndicesService:
         names = self.resolve(name)
         if not names:
             raise IndexNotFoundError(name)
-        return self.indices[names[0]]
+        svc = self.indices.get(names[0])
+        if svc is None:
+            raise IndexNotFoundError(names[0])
+        return svc
 
     def has_index(self, name: str) -> bool:
         try:

@@ -15,18 +15,22 @@ import time
 import uuid
 from pathlib import Path
 
-from elasticsearch_tpu import __version__
-from elasticsearch_tpu.cluster.service import ClusterService
-from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.cluster.allocation import AllocationService
+from elasticsearch_tpu.cluster.service import URGENT, ClusterService
+from elasticsearch_tpu.cluster.state import (
+    ClusterState, IndexMetadata, RoutingTable)
 from elasticsearch_tpu.common.errors import DocumentMissingError
 from elasticsearch_tpu.common.settings import Settings
 from elasticsearch_tpu.index.engine import MATCH_ANY
 from elasticsearch_tpu.search.service import SearchService
+from elasticsearch_tpu.transport import (
+    DiscoveryNode, LocalTransport, LocalTransportHub, TransportService)
 
 
 class Node:
     def __init__(self, settings: Settings | dict | None = None,
-                 data_path: str | Path | None = None):
+                 data_path: str | Path | None = None,
+                 transport_hub: LocalTransportHub | None = None):
         if not isinstance(settings, Settings):
             settings = Settings(settings or {})
         self.settings = settings
@@ -34,34 +38,133 @@ class Node:
         self.node_name = settings.get("node.name", f"node-{self.node_id[:7]}")
         self.data_path = Path(data_path or settings.get("path.data", "data"))
         self.data_path.mkdir(parents=True, exist_ok=True)
+        self._hub = transport_hub
         self._started = False
 
-    # ---- lifecycle (Node.start order) --------------------------------------
+    # ---- lifecycle (Node.start order, core/node/Node.java:230-275) ---------
 
     def start(self) -> "Node":
-        state = ClusterState.load(self.data_path / "_state", self.node_id)
-        state = state.with_(
-            version=state.version,
-            master_node_id=self.node_id,
-            nodes={self.node_id: {"name": self.node_name,
-                                  "version": __version__}})
-        self.cluster_service = ClusterService(state)
+        hub = self._hub or LocalTransportHub()
+        attrs = (("data", self.settings.get("node.data", "true")),
+                 ("master", self.settings.get("node.master", "true")))
+        self.transport_service = TransportService(
+            LocalTransport(hub),
+            lambda addr: DiscoveryNode(self.node_id, self.node_name, addr,
+                                       attributes=attrs))
+        self.allocation = AllocationService()
+        self.cluster_service = ClusterService(self._recover_state(),
+                                              self.node_id)
         self.cluster_service.add_listener(self._persist_state)
         from elasticsearch_tpu.indices.service import IndicesService
         self.indices_service = IndicesService(self.data_path,
                                               self.cluster_service,
-                                              self.node_id)
+                                              self.node_id,
+                                              self.allocation)
+        self.indices_service.on_shard_started = self._on_shard_started
+        self.indices_service.on_shard_failed = self._on_shard_failed
+        # report shards created during the initial reconcile (callback was
+        # not yet wired when IndicesService reconciled in its constructor)
+        self.indices_service._cluster_changed(
+            self.cluster_service.state(), self.cluster_service.state())
         self.search_service = SearchService()
+        self._delayed_reroute_timer = None
+        self.cluster_service.add_listener(self._schedule_delayed_reroute)
         self._started = True
         return self
+
+    def _recover_state(self) -> ClusterState:
+        """Gateway recovery (GatewayMetaState): persisted metadata → fresh
+        routing table (all UNASSIGNED) → allocation."""
+        local = self.transport_service.local_node
+        raw = ClusterState.load_metadata(self.data_path / "_state")
+        state = ClusterState(
+            cluster_name=self.settings.get("cluster.name",
+                                           "elasticsearch-tpu"),
+            master_node_id=self.node_id,
+            nodes={self.node_id: local})
+        if raw:
+            indices = {}
+            routing = RoutingTable()
+            for name, m in raw.get("indices", {}).items():
+                meta = IndexMetadata.from_state_dict(name, m)
+                indices[name] = meta
+                routing = routing.add_index(meta)
+            state = state.with_(
+                version=raw.get("version", 0),
+                indices=indices, routing_table=routing,
+                templates=raw.get("templates", {}),
+                persistent_settings=raw.get("persistent_settings", {}))
+        return self.allocation.reroute(state, "cluster recovered")
+
+    def _on_shard_started(self, shard) -> None:
+        """ShardStateAction analog: master applies the started shard."""
+        self.cluster_service.submit_state_update(
+            f"shard-started [{shard.index}][{shard.shard}]",
+            lambda st: self.allocation.apply_started_shards(st, [shard]),
+            priority=URGENT)
+
+    def _on_shard_failed(self, shard, details: str) -> None:
+        self.cluster_service.submit_state_update(
+            f"shard-failed [{shard.index}][{shard.shard}]",
+            lambda st: self.allocation.apply_failed_shards(
+                st, [(shard, details)]),
+            priority=URGENT)
 
     def _persist_state(self, old: ClusterState, new: ClusterState) -> None:
         new.persist(self.data_path / "_state")
 
+    def _schedule_delayed_reroute(self, old, new) -> None:
+        """RoutingService.scheduleDelayedReroute analog: when NODE_LEFT
+        shards are waiting out their delayed-allocation window, arrange a
+        reroute at expiry (only the master reroutes)."""
+        import threading
+        if new.master_node_id != self.node_id:
+            return
+        remaining = self.allocation.next_delayed_reroute_millis(new)
+        if remaining is None:
+            return
+        if self._delayed_reroute_timer is not None and \
+                self._delayed_reroute_timer.is_alive():
+            return
+        t = threading.Timer(remaining / 1000.0 + 0.05, self._delayed_reroute)
+        t.daemon = True
+        t.start()
+        self._delayed_reroute_timer = t
+
+    def _delayed_reroute(self) -> None:
+        if not self._started:
+            return
+        try:
+            self.cluster_service.submit_state_update(
+                "delayed reroute",
+                lambda st: self.allocation.reroute(st, "delay expired"),
+                priority=URGENT)
+        except RuntimeError:
+            pass                                 # cluster service closed
+
+    def wait_for_health(self, status: str = "green",
+                        timeout: float = 10.0) -> dict:
+        """Health wait (wait_for_status param of the health API)."""
+        want = {"green": ("green",), "yellow": ("green", "yellow")}[status]
+        deadline = time.monotonic() + timeout
+        while True:
+            h = self.cluster_service.state().health(
+                len(self.cluster_service.pending_tasks()))
+            if h["status"] in want and h["number_of_pending_tasks"] == 0:
+                return h
+            if time.monotonic() > deadline:
+                h["timed_out"] = True
+                return h
+            time.sleep(0.01)
+
     def close(self) -> None:
         if self._started:
-            self.indices_service.close()
             self._started = False
+            if self._delayed_reroute_timer is not None:
+                self._delayed_reroute_timer.cancel()
+            self.indices_service.close()
+            self.cluster_service.close()
+            self.transport_service.close()
 
     def __enter__(self):
         return self.start()

@@ -504,7 +504,14 @@ class Handlers:
     # ---- cluster / stats ---------------------------------------------------
 
     def cluster_health(self, req: RestRequest):
-        return 200, self.node.cluster_service.state().health()
+        want = req.params.get("wait_for_status")
+        if want in ("green", "yellow"):
+            from elasticsearch_tpu.common.settings import parse_time_millis
+            timeout = parse_time_millis(
+                req.params.get("timeout", "30s")) / 1000.0
+            return 200, self.node.wait_for_health(want, timeout)
+        return 200, self.node.cluster_service.state().health(
+            len(self.node.cluster_service.pending_tasks()))
 
     def cluster_state(self, req: RestRequest):
         state = self.node.cluster_service.state()
@@ -512,7 +519,10 @@ class Handlers:
             "cluster_name": state.cluster_name,
             "version": state.version,
             "master_node": state.master_node_id,
-            "nodes": state.nodes,
+            "nodes": {nid: {"name": n.name,
+                            "transport_address": str(n.address),
+                            "attributes": dict(n.attributes)}
+                      for nid, n in state.nodes.items()},
             "metadata": {"indices": {n: m.to_dict()
                                      for n, m in state.indices.items()},
                          "templates": state.templates},

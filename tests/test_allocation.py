@@ -1,0 +1,234 @@
+"""Allocation-logic unit tests against synthetic cluster states —
+the ESAllocationTestCase approach (test/test/ESAllocationTestCase.java):
+allocation is fully unit-testable without nodes or engines."""
+
+import pytest
+
+from elasticsearch_tpu.cluster.allocation import (
+    AllocationService, DELAYED_ALLOCATION_SETTING, MAX_RETRIES_SETTING)
+from elasticsearch_tpu.cluster.state import (
+    ClusterState, IncompatibleClusterStateVersionError, IndexMetadata,
+    RoutingTable, ShardRoutingState, UnassignedReason)
+from elasticsearch_tpu.transport.service import DiscoveryNode, TransportAddress
+
+
+def mknode(nid, attrs=()):
+    return DiscoveryNode(nid, nid, TransportAddress("local", 1),
+                         attributes=tuple(sorted(dict(attrs).items())))
+
+
+def mkstate(node_ids, index="idx", shards=2, replicas=1, settings=None,
+            cluster_settings=None, attrs=None):
+    nodes = {nid: mknode(nid, (attrs or {}).get(nid, ())) for nid in node_ids}
+    meta = IndexMetadata(index, shards, replicas, settings=settings or {})
+    return ClusterState(
+        master_node_id=node_ids[0] if node_ids else None, nodes=nodes,
+        indices={index: meta},
+        routing_table=RoutingTable().add_index(meta),
+        persistent_settings=cluster_settings or {})
+
+
+def start_all(svc, state):
+    """Drive INITIALIZING shards to STARTED until a fixpoint (the
+    reconciler/ShardStateAction loop collapsed)."""
+    for _ in range(10):
+        init = [s for s in state.routing_table.shards
+                if s.state == ShardRoutingState.INITIALIZING]
+        if not init:
+            return state
+        state = svc.apply_started_shards(state, init)
+    return state
+
+
+def test_allocates_primaries_then_replicas():
+    svc = AllocationService()
+    state = mkstate(["n1", "n2"], shards=2, replicas=1)
+    state = svc.reroute(state)
+    init = [s for s in state.routing_table.shards
+            if s.state == ShardRoutingState.INITIALIZING]
+    # primaries allocate immediately; replicas wait for active primaries
+    assert sorted(s.primary for s in init) == [True, True]
+    state = start_all(svc, state)
+    assert all(s.state == ShardRoutingState.STARTED
+               for s in state.routing_table.shards)
+    # same-shard anti-affinity: copies of a shard on different nodes
+    for sid in (0, 1):
+        nodes = {s.node_id for s in state.routing_table.shard_copies("idx",
+                                                                     sid)}
+        assert len(nodes) == 2
+
+
+def test_single_node_leaves_replicas_unassigned():
+    svc = AllocationService()
+    state = start_all(svc, svc.reroute(mkstate(["n1"], shards=2, replicas=1)))
+    assert state.health()["status"] == "yellow"
+    assert len(state.routing_table.unassigned()) == 2
+    assert all(not s.primary for s in state.routing_table.unassigned())
+
+
+def test_node_left_fails_shards_and_reallocates():
+    svc = AllocationService()
+    state = start_all(svc, svc.reroute(mkstate(["n1", "n2", "n3"], shards=3,
+                                               replicas=1)))
+    assert state.health()["status"] == "green"
+    gone = "n2"
+    survivors = {nid: n for nid, n in state.nodes.items() if nid != gone}
+    state = svc.reroute(state.with_(nodes=survivors))
+    # shards that lived on n2 must be unassigned(NODE_LEFT) or reallocated
+    for s in state.routing_table.shards:
+        assert s.node_id != gone
+    state = start_all(svc, state)
+    assert state.health()["status"] == "green"
+
+
+def test_delayed_allocation_holds_replicas():
+    svc = AllocationService()
+    settings = {DELAYED_ALLOCATION_SETTING: "60s"}
+    state = start_all(svc, svc.reroute(
+        mkstate(["n1", "n2", "n3"], shards=1, replicas=1, settings=settings)))
+    replica = next(s for s in state.routing_table.shards if not s.primary)
+    survivors = {nid: n for nid, n in state.nodes.items()
+                 if nid != replica.node_id}
+    state = svc.reroute(state.with_(nodes=survivors))
+    held = state.routing_table.unassigned()
+    assert len(held) == 1
+    assert held[0].unassigned_info.reason == UnassignedReason.NODE_LEFT
+    # primaries reallocate immediately even with the delay setting
+    assert all(s.active for s in state.routing_table.shards if s.primary)
+
+
+def test_max_retry_gives_up():
+    svc = AllocationService()
+    state = svc.reroute(mkstate(["n1"], shards=1, replicas=0,
+                                settings={MAX_RETRIES_SETTING: 2}))
+    for _ in range(3):
+        assigned = [s for s in state.routing_table.shards if s.assigned]
+        if not assigned:
+            break
+        state = svc.apply_failed_shards(
+            state, [(assigned[0], "engine failure")])
+    stuck = state.routing_table.unassigned()
+    assert len(stuck) == 1
+    assert stuck[0].unassigned_info.failed_allocations >= 2
+    # no further assignment happens
+    assert svc.reroute(state).routing_table.unassigned() == stuck
+
+
+def test_filter_decider_require():
+    svc = AllocationService()
+    settings = {"index.routing.allocation.require.box": "hot"}
+    state = mkstate(["n1", "n2"], shards=2, replicas=0, settings=settings,
+                    attrs={"n1": {"box": "hot"}, "n2": {"box": "cold"}})
+    state = start_all(svc, svc.reroute(state))
+    assert {s.node_id for s in state.routing_table.shards} == {"n1"}
+
+
+def test_filter_decider_exclude():
+    svc = AllocationService()
+    settings = {"index.routing.allocation.exclude._name": "n1"}
+    state = mkstate(["n1", "n2"], shards=2, replicas=0, settings=settings)
+    state = start_all(svc, svc.reroute(state))
+    assert {s.node_id for s in state.routing_table.shards} == {"n2"}
+
+
+def test_enable_none_blocks_allocation():
+    svc = AllocationService()
+    state = mkstate(["n1"], shards=1, replicas=0,
+                    cluster_settings={
+                        "cluster.routing.allocation.enable": "none"})
+    state = svc.reroute(state)
+    assert len(state.routing_table.unassigned()) == 1
+
+
+def test_awareness_spreads_zones():
+    svc = AllocationService()
+    state = mkstate(
+        ["n1", "n2", "n3", "n4"], shards=1, replicas=1,
+        cluster_settings={
+            "cluster.routing.allocation.awareness.attributes": "zone"},
+        attrs={"n1": {"zone": "a"}, "n2": {"zone": "a"},
+               "n3": {"zone": "b"}, "n4": {"zone": "b"}})
+    state = start_all(svc, svc.reroute(state))
+    zones = set()
+    for s in state.routing_table.shards:
+        node = state.node(s.node_id)
+        zones.add(dict(node.attributes)["zone"])
+    assert zones == {"a", "b"}
+
+
+def test_balanced_allocator_spreads_load():
+    svc = AllocationService()
+    state = start_all(svc, svc.reroute(mkstate(["n1", "n2", "n3", "n4"],
+                                               shards=8, replicas=0)))
+    per_node = {}
+    for s in state.routing_table.shards:
+        per_node[s.node_id] = per_node.get(s.node_id, 0) + 1
+    assert all(c == 2 for c in per_node.values()), per_node
+
+
+def test_throttling_limits_concurrent_recoveries():
+    svc = AllocationService()
+    state = svc.reroute(mkstate(["n1"], shards=8, replicas=0))
+    init = [s for s in state.routing_table.shards
+            if s.state == ShardRoutingState.INITIALIZING]
+    assert len(init) == 2          # default node_concurrent_recoveries
+    state = start_all(svc, state)  # fixpoint drives the rest through
+    assert sum(1 for s in state.routing_table.shards
+               if s.state == ShardRoutingState.STARTED) == 8
+
+
+def test_replica_count_update():
+    svc = AllocationService()
+    state = start_all(svc, svc.reroute(mkstate(["n1", "n2", "n3"], shards=2,
+                                               replicas=0)))
+    meta = state.indices["idx"]
+    state = state.with_(
+        indices={"idx": IndexMetadata(
+            **{**meta.__dict__, "number_of_replicas": 1})},
+        routing_table=state.routing_table.update_replica_count("idx", 1))
+    state = start_all(svc, svc.reroute(state))
+    assert state.health()["status"] == "green"
+    assert len(state.routing_table.shards) == 4
+
+
+def test_allocation_explain():
+    svc = AllocationService()
+    state = start_all(svc, svc.reroute(mkstate(["n1"], shards=1, replicas=1)))
+    replica = state.routing_table.unassigned()[0]
+    ex = svc.explain(state, replica)
+    assert any(e["decider"] == "same_shard" and e["decision"] == "NO"
+               for e in ex)
+
+
+# ---- cluster state wire/diff ----------------------------------------------
+
+def test_state_wire_roundtrip():
+    svc = AllocationService()
+    state = start_all(svc, svc.reroute(mkstate(["n1", "n2"], shards=2,
+                                               replicas=1)))
+    state = state.with_(templates={"t1": {"order": 0}},
+                        blocks=frozenset({"x"}),
+                        customs={"snapshots": {"a": 1}})
+    back = ClusterState.from_wire_dict(state.to_wire_dict())
+    assert back == state
+
+
+def test_state_diff_apply():
+    svc = AllocationService()
+    s1 = svc.reroute(mkstate(["n1"], shards=1, replicas=0))
+    s2 = start_all(svc, s1)
+    diff = s2.diff_from(s1)
+    assert "routing_table" in diff["parts"]
+    assert "templates" not in diff["parts"]
+    applied = ClusterState.apply_diff(s1, diff)
+    assert applied == s2
+
+
+def test_state_diff_wrong_base_rejected():
+    svc = AllocationService()
+    s1 = svc.reroute(mkstate(["n1"], shards=1, replicas=0))
+    s2 = start_all(svc, s1)
+    diff = s2.diff_from(s1)
+    other = mkstate(["n9"], shards=1, replicas=0)
+    with pytest.raises(IncompatibleClusterStateVersionError):
+        ClusterState.apply_diff(other, diff)
