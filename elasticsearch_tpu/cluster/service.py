@@ -114,6 +114,13 @@ class ClusterService:
         return self.submit_state_update(source, update, priority).result(
             timeout)
 
+    def run_task(self, source: str, fn: Callable,
+                 priority: int = NORMAL) -> None:
+        """Run an arbitrary callable on the state-executor thread (for work
+        that must be serialized with state application, e.g. reconciler
+        re-checks)."""
+        self._enqueue(source, fn, priority)
+
     # ---- applier service ---------------------------------------------------
 
     def apply_published_state(self, new: ClusterState) -> Future:

@@ -1,0 +1,15 @@
+"""Discovery — membership, master election, state publish, fault detection.
+
+Reference: core/discovery/zen/ — ZenDiscovery.java:76 (election/join/rejoin),
+publish/PublishClusterStateAction.java (two-phase diff publish),
+fd/{MasterFaultDetection,NodesFaultDetection}.java (mutual liveness pings),
+elect/ElectMasterService.java (min_master_nodes quorum + ordered election).
+"""
+
+from elasticsearch_tpu.discovery.zen import ZenDiscovery
+from elasticsearch_tpu.discovery.publish import PublishClusterStateAction
+from elasticsearch_tpu.discovery.fd import (
+    MasterFaultDetection, NodesFaultDetection)
+
+__all__ = ["ZenDiscovery", "PublishClusterStateAction",
+           "MasterFaultDetection", "NodesFaultDetection"]

@@ -1,0 +1,179 @@
+"""Fault detection — mutual liveness pings.
+
+Reference: core/discovery/zen/fd/ — MasterFaultDetection.java (every node
+pings its master; on N consecutive failures it notifies listeners → rejoin)
+and NodesFaultDetection.java (the master pings every node; on failure the
+node is removed from the cluster state). Wired in ZenDiscovery.java:97-98,
+177-181. Ping handlers validate identity: a ping for a node id that is no
+longer who we think it is fails fast (ThisIsNotTheMasterYouAreLookingForException).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from elasticsearch_tpu.transport.service import DiscoveryNode, TransportService
+
+MASTER_PING_ACTION = "internal:discovery/zen/fd/master_ping"
+NODE_PING_ACTION = "internal:discovery/zen/fd/ping"
+
+
+class _Pinger(threading.Thread):
+    def __init__(self, name: str, interval: float, fn):
+        super().__init__(daemon=True, name=name)
+        self._interval = interval
+        self._fn = fn
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self._fn()
+            except Exception:                    # noqa: BLE001 — keep pinging
+                pass
+
+    def stop(self):
+        self._stop.set()
+
+
+class MasterFaultDetection:
+    """Runs on every non-master node; pings the master."""
+
+    def __init__(self, transport: TransportService, interval: float = 0.5,
+                 timeout: float = 1.0, retries: int = 3):
+        self.transport = transport
+        self.interval = interval
+        self.timeout = timeout
+        self.retries = retries
+        self.on_master_failure = None            # callback(master_node)
+        self._master: DiscoveryNode | None = None
+        self._failures = 0
+        self._pinger: _Pinger | None = None
+        transport.register_request_handler(
+            MASTER_PING_ACTION, self._handle_ping, executor="same",
+            sync=True)
+        self._is_master_fn = lambda: False       # set by discovery
+
+    def restart(self, master: DiscoveryNode | None) -> None:
+        self.stop()
+        self._master = master
+        self._failures = 0
+        if master is None or \
+                master.node_id == self.transport.local_node.node_id:
+            return
+        self._pinger = _Pinger(
+            f"masterFD[{master.name}]", self.interval, self._ping_once)
+        self._pinger.start()
+
+    def stop(self) -> None:
+        if self._pinger is not None:
+            self._pinger.stop()
+            self._pinger = None
+
+    def _ping_once(self) -> None:
+        master = self._master
+        if master is None:
+            return
+        try:
+            self.transport.submit_request(
+                master, MASTER_PING_ACTION,
+                {"master_id": master.node_id,
+                 "source_id": self.transport.local_node.node_id},
+                timeout=self.timeout)
+            self._failures = 0
+        except Exception:                        # noqa: BLE001 — count it
+            self._failures += 1
+            if self._failures >= self.retries:
+                self.stop()
+                if self.on_master_failure is not None:
+                    self.on_master_failure(master)
+
+    def _handle_ping(self, request: dict, source) -> dict:
+        # verify we actually are the master this node believes in
+        if request["master_id"] != self.transport.local_node.node_id or \
+                not self._is_master_fn():
+            raise NotTheMasterError(
+                f"[{self.transport.local_node.name}] is not the master")
+        return {"ok": True}
+
+
+class NotTheMasterError(Exception):
+    pass
+
+
+class NodeNotPartOfClusterError(Exception):
+    pass
+
+
+class NodesFaultDetection:
+    """Runs on the master; pings every other cluster node."""
+
+    def __init__(self, transport: TransportService, interval: float = 0.5,
+                 timeout: float = 1.0, retries: int = 3):
+        self.transport = transport
+        self.interval = interval
+        self.timeout = timeout
+        self.retries = retries
+        self.on_node_failure = None              # callback(node)
+        self._nodes: dict[str, DiscoveryNode] = {}
+        self._failures: dict[str, int] = {}
+        self._pinger: _Pinger | None = None
+        self._lock = threading.Lock()
+        transport.register_request_handler(
+            NODE_PING_ACTION, self._handle_ping, executor="same", sync=True)
+        # wired by discovery: the master id this node currently follows
+        self._current_master_fn = lambda: None
+
+    def update_nodes(self, nodes: dict[str, DiscoveryNode]) -> None:
+        local = self.transport.local_node.node_id
+        with self._lock:
+            self._nodes = {nid: n for nid, n in nodes.items() if nid != local}
+            self._failures = {nid: f for nid, f in self._failures.items()
+                              if nid in self._nodes}
+
+    def start(self) -> None:
+        if self._pinger is None:
+            self._pinger = _Pinger("nodesFD", self.interval, self._ping_all)
+            self._pinger.start()
+
+    def stop(self) -> None:
+        if self._pinger is not None:
+            self._pinger.stop()
+            self._pinger = None
+        with self._lock:
+            self._failures.clear()
+
+    def _ping_all(self) -> None:
+        with self._lock:
+            targets = list(self._nodes.values())
+        for node in targets:
+            try:
+                self.transport.submit_request(
+                    node, NODE_PING_ACTION,
+                    {"node_id": node.node_id,
+                     "master_id": self.transport.local_node.node_id},
+                    timeout=self.timeout)
+                with self._lock:
+                    self._failures[node.node_id] = 0
+            except Exception:                    # noqa: BLE001 — count it
+                with self._lock:
+                    self._failures[node.node_id] = \
+                        self._failures.get(node.node_id, 0) + 1
+                    tripped = self._failures[node.node_id] >= self.retries
+                    if tripped:
+                        self._nodes.pop(node.node_id, None)
+                if tripped and self.on_node_failure is not None:
+                    self.on_node_failure(node)
+
+    def _handle_ping(self, request: dict, source) -> dict:
+        if request["node_id"] != self.transport.local_node.node_id:
+            raise NodeNotPartOfClusterError("wrong node id")
+        # A ping from a master we no longer follow must fail — this is how
+        # a deposed master learns the cluster moved on (the reference
+        # compares the ping's cluster state master and throws)
+        current = self._current_master_fn()
+        if current is not None and current != request.get("master_id"):
+            raise NodeNotPartOfClusterError(
+                f"ping from [{request.get('master_id')}] but current master "
+                f"is [{current}]")
+        return {"ok": True}

@@ -1,0 +1,125 @@
+"""Two-phase diff-based cluster state publish.
+
+Reference: core/discovery/zen/publish/PublishClusterStateAction.java:54,
+138-169 — the master sends each node a DIFF when the node is known to hold
+the previous state (or the FULL state otherwise, :167-169), waits for acks,
+then sends COMMIT; nodes buffer the received state and only apply it on
+commit. A node that cannot apply a diff answers with
+IncompatibleClusterStateVersionException and the master resends the full
+state (:155-163). Sends to all peers run in PARALLEL (the reference fans
+out on the generic pool) so one unresponsive node costs one timeout, not
+one per node; pending uncommitted states are bounded.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from elasticsearch_tpu.cluster.state import (
+    ClusterState, IncompatibleClusterStateVersionError)
+from elasticsearch_tpu.transport.service import (
+    DiscoveryNode, RemoteTransportError, TransportService)
+
+PUBLISH_ACTION = "internal:discovery/zen/publish"
+COMMIT_ACTION = "internal:discovery/zen/publish/commit"
+
+# max buffered uncommitted states per node (reference bounds its queue)
+MAX_PENDING_STATES = 25
+
+
+class PublishClusterStateAction:
+    def __init__(self, transport: TransportService, cluster_service,
+                 publish_timeout: float = 10.0):
+        self.transport = transport
+        self.cluster_service = cluster_service
+        self.publish_timeout = publish_timeout
+        self._lock = threading.Lock()
+        self._pending: OrderedDict[str, ClusterState] = OrderedDict()
+        # last state each peer acked — governs diff vs full (the reference
+        # tracks this via nodes' committed state versions)
+        self._peer_state: dict[str, tuple[int, str]] = {}
+        transport.register_request_handler(
+            PUBLISH_ACTION, self._handle_publish, sync=True)
+        transport.register_request_handler(
+            COMMIT_ACTION, self._handle_commit, sync=True)
+
+    # ---- master side -------------------------------------------------------
+
+    def publish(self, new: ClusterState, old: ClusterState) -> None:
+        """Fan the state out to every other node in `new` (parallel), then
+        commit on the ackers (parallel) and apply locally."""
+        local_id = self.transport.local_node.node_id
+        targets = [n for nid, n in new.nodes.items() if nid != local_id]
+        diff = new.diff_from(old)
+        full = new.to_wire_dict()
+
+        # phase 1: send (diff where possible), all nodes concurrently
+        first = {}
+        for node in targets:
+            peer = self._peer_state.get(node.node_id)
+            use_diff = peer == (old.version, old.state_uuid)
+            payload = {"diff": diff} if use_diff else {"full": full}
+            first[node.node_id] = (node, self.transport.send_request(
+                node, PUBLISH_ACTION, payload, timeout=self.publish_timeout))
+        retry = []
+        acked: list[DiscoveryNode] = []
+        for node, fut in first.values():
+            try:
+                fut.result(self.publish_timeout + 5.0)
+                acked.append(node)
+            except RemoteTransportError as e:
+                if e.error_type == "IncompatibleClusterStateVersionError":
+                    retry.append(node)
+                else:
+                    self._peer_state.pop(node.node_id, None)
+            except Exception:                    # noqa: BLE001 — peer down
+                self._peer_state.pop(node.node_id, None)
+        # phase 1b: full-state resend to diff-incompatible nodes
+        second = [(node, self.transport.send_request(
+            node, PUBLISH_ACTION, {"full": full},
+            timeout=self.publish_timeout)) for node in retry]
+        for node, fut in second:
+            try:
+                fut.result(self.publish_timeout + 5.0)
+                acked.append(node)
+            except Exception:                    # noqa: BLE001 — peer down
+                self._peer_state.pop(node.node_id, None)
+        for node in acked:
+            self._peer_state[node.node_id] = (new.version, new.state_uuid)
+
+        # phase 2: commit — apply locally first (master applies what it
+        # publishes even if some peers missed it; FD will handle them)
+        self.cluster_service.apply_new_state(new)
+        commits = [(node, self.transport.send_request(
+            node, COMMIT_ACTION, {"uuid": new.state_uuid},
+            timeout=self.publish_timeout)) for node in acked]
+        for node, fut in commits:
+            try:
+                fut.result(self.publish_timeout + 5.0)
+            except Exception:                    # noqa: BLE001 — peer down
+                self._peer_state.pop(node.node_id, None)
+
+    # ---- receiving side ----------------------------------------------------
+
+    def _handle_publish(self, request: dict, source) -> dict:
+        if "diff" in request:
+            diff = request["diff"]
+            base = self.cluster_service.state()
+            state = ClusterState.apply_diff(base, diff)   # raises → resend
+        else:
+            state = ClusterState.from_wire_dict(request["full"])
+        with self._lock:
+            self._pending[state.state_uuid] = state
+            while len(self._pending) > MAX_PENDING_STATES:
+                self._pending.popitem(last=False)
+        return {"version": state.version}
+
+    def _handle_commit(self, request: dict, source) -> dict:
+        with self._lock:
+            state = self._pending.pop(request["uuid"], None)
+        if state is None:
+            raise IncompatibleClusterStateVersionError(
+                f"no pending state {request['uuid']}")
+        self.cluster_service.apply_published_state(state).result(30.0)
+        return {}

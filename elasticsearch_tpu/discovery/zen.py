@@ -1,0 +1,372 @@
+"""ZenDiscovery — ping-based membership, master election, join/rejoin.
+
+Reference: core/discovery/zen/ZenDiscovery.java:76 — unicast ping
+(ping/UnicastZenPing.java), ElectMasterService ordered election gated on
+minimum_master_nodes (elect/ElectMasterService.java), join via
+MembershipAction + NodeJoinController (accumulate joins until quorum, then
+become master), two-way fault detection (:97-98,177-181), rejoin on master
+loss (:78,129), master step-down when it loses its quorum
+(handleMinimumMasterNodesChanged / NodesFaultDetection path).
+
+The publish data path is PublishClusterStateAction (publish.py); the
+master's ClusterService.publish hook points at ZenDiscovery.publish.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from elasticsearch_tpu.cluster.state import (
+    ClusterState, NO_MASTER_BLOCK)
+from elasticsearch_tpu.cluster.service import URGENT, ClusterService
+from elasticsearch_tpu.discovery.fd import (
+    MasterFaultDetection, NodesFaultDetection, NotTheMasterError)
+from elasticsearch_tpu.discovery.publish import PublishClusterStateAction
+from elasticsearch_tpu.transport.service import (
+    DiscoveryNode, TransportAddress, TransportService)
+
+PING_ACTION = "internal:discovery/zen/ping"
+JOIN_ACTION = "internal:discovery/zen/join"
+LEAVE_ACTION = "internal:discovery/zen/leave"
+
+
+class ZenDiscovery:
+    def __init__(self, transport: TransportService,
+                 cluster_service: ClusterService, allocation,
+                 seed_provider, cluster_name: str = "elasticsearch-tpu",
+                 min_master_nodes: int = 1, gateway_fn=None,
+                 ping_timeout: float = 1.0, fd_interval: float = 0.5,
+                 fd_timeout: float = 1.0, fd_retries: int = 3,
+                 publish_timeout: float = 10.0):
+        self.transport = transport
+        self.cluster_service = cluster_service
+        self.allocation = allocation
+        self.seed_provider = seed_provider
+        self.cluster_name = cluster_name
+        self.min_master_nodes = min_master_nodes
+        self.gateway_fn = gateway_fn             # state → state (metadata)
+        self.ping_timeout = ping_timeout
+        self.publisher = PublishClusterStateAction(transport, cluster_service,
+                                                   publish_timeout)
+        self.master_fd = MasterFaultDetection(transport, fd_interval,
+                                              fd_timeout, fd_retries)
+        self.nodes_fd = NodesFaultDetection(transport, fd_interval,
+                                            fd_timeout, fd_retries)
+        self.master_fd.on_master_failure = self._on_master_failure
+        self.master_fd._is_master_fn = self.is_master
+        self.nodes_fd.on_node_failure = self._on_node_failure
+        self.nodes_fd._current_master_fn = \
+            lambda: self.cluster_service.state().master_node_id
+        self._running = False
+        self._join_thread: threading.Thread | None = None
+        self._join_lock = threading.Lock()
+        # node_id → (node, vote timestamp); votes expire so dead electors
+        # can't satisfy a later quorum (NodeJoinController election context)
+        self._pending_joins: dict[str, tuple[DiscoveryNode, float]] = {}
+        self._votes_lock = threading.Lock()
+        self.JOIN_VOTE_TTL = 10.0
+        self._last_master_id: str | None = None
+        transport.register_request_handler(PING_ACTION, self._handle_ping,
+                                           executor="same", sync=True)
+        transport.register_request_handler(JOIN_ACTION, self._handle_join)
+        transport.register_request_handler(LEAVE_ACTION, self._handle_leave,
+                                           sync=True)
+        cluster_service.add_listener(self._cluster_changed)
+        cluster_service.publish = self.publish
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def start(self, initial_state_timeout: float = 10.0) -> None:
+        """Start the join loop and block until a master is known
+        (Node.start waitForInitialState, core/node/Node.java:261)."""
+        self._running = True
+        self._ensure_join_thread()
+        deadline = time.monotonic() + initial_state_timeout
+        while time.monotonic() < deadline:
+            if self.cluster_service.state().master_node_id is not None:
+                return
+            time.sleep(0.01)
+        raise TimeoutError("discovery: no master elected within timeout")
+
+    def stop(self) -> None:
+        self._running = False
+        self.master_fd.stop()
+        self.nodes_fd.stop()
+        # best-effort leave notification (ZenDiscovery.doStop sends leave)
+        state = self.cluster_service.state()
+        master = state.master_node
+        local_id = self.transport.local_node.node_id
+        if master is not None and master.node_id != local_id:
+            try:
+                self.transport.submit_request(
+                    master, LEAVE_ACTION, {"node_id": local_id}, timeout=1.0)
+            except Exception:                    # noqa: BLE001 — going down
+                pass
+
+    def is_master(self) -> bool:
+        state = self.cluster_service.state()
+        return state.master_node_id == self.transport.local_node.node_id
+
+    # ---- publish (master → everyone) --------------------------------------
+
+    def publish(self, new: ClusterState, old: ClusterState) -> None:
+        self.publisher.publish(new, old)
+
+    # ---- ping / election ---------------------------------------------------
+
+    def _ping_all(self) -> list[dict]:
+        local = self.transport.local_node
+        responses = []
+        for addr in self.seed_provider():
+            if addr == local.address:
+                continue
+            probe = DiscoveryNode("?", "?", addr)
+            try:
+                r = self.transport.submit_request(
+                    probe, PING_ACTION, {"cluster_name": self.cluster_name},
+                    timeout=self.ping_timeout)
+            except Exception:                    # noqa: BLE001 — dead seed
+                continue
+            if r.get("cluster_name") == self.cluster_name:
+                responses.append(r)
+        return responses
+
+    @staticmethod
+    def _node_from_ping(r: dict) -> DiscoveryNode:
+        return DiscoveryNode(
+            r["node_id"], r["name"], TransportAddress(r["host"], r["port"]),
+            attributes=tuple(sorted(r.get("attributes", {}).items())),
+            version=r.get("version", 0))
+
+    def _ensure_join_thread(self) -> None:
+        with self._join_lock:
+            if self._join_thread is not None and self._join_thread.is_alive():
+                return
+            self._join_thread = threading.Thread(
+                target=self._join_loop, daemon=True,
+                name=f"zen_join[{self.transport.local_node.name}]")
+            self._join_thread.start()
+
+    def _join_loop(self) -> None:
+        while self._running and \
+                self.cluster_service.state().master_node_id is None:
+            try:
+                self._find_master_and_join()
+            except Exception:                    # noqa: BLE001 — retry
+                pass
+            time.sleep(0.1)
+
+    def _find_master_and_join(self) -> None:
+        local = self.transport.local_node
+        responses = self._ping_all()
+        # 1) an active master already exists → join it
+        active_master_ids = {r["master_id"] for r in responses
+                             if r.get("master_id")} - {local.node_id}
+        if active_master_ids:
+            by_id = {r["node_id"]: self._node_from_ping(r)
+                     for r in responses}
+            master_id = sorted(active_master_ids)[0]
+            master = by_id.get(master_id)
+            if master is None:
+                for r in responses:
+                    if r.get("master_id") == master_id:
+                        # the master itself didn't answer our ping; join via
+                        # any node that knows it? → retry next round
+                        return
+            if master is not None:
+                self._send_join(master)
+                return
+        # 2) full election among master-eligible candidates
+        candidates = {local.node_id: local} if local.master_eligible else {}
+        for r in responses:
+            n = self._node_from_ping(r)
+            if n.master_eligible:
+                candidates[n.node_id] = n
+        if len(candidates) < self.min_master_nodes:
+            return                               # not enough nodes yet
+        winner_id = sorted(candidates)[0]        # ElectMasterService ordering
+        if winner_id == local.node_id:
+            self._become_master()
+        else:
+            self._send_join(candidates[winner_id])
+
+    def _send_join(self, master: DiscoveryNode) -> None:
+        local = self.transport.local_node
+        self.transport.submit_request(
+            master, JOIN_ACTION,
+            {"node": {"node_id": local.node_id, "name": local.name,
+                      "host": local.address.host, "port": local.address.port,
+                      "attributes": dict(local.attributes),
+                      "version": local.version}},
+            timeout=5.0)
+        # wait for the resulting publish to land (we appear in state)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            st = self.cluster_service.state()
+            if st.master_node_id == master.node_id and \
+                    local.node_id in st.nodes:
+                return
+            time.sleep(0.01)
+
+    def _become_master(self, extra_joiners: list[DiscoveryNode] = ()) -> None:
+        local = self.transport.local_node
+        joiners = list(extra_joiners)
+
+        def update(state: ClusterState) -> ClusterState:
+            if state.master_node_id == local.node_id:
+                return state
+            nodes = dict(state.nodes)
+            nodes[local.node_id] = local
+            for j in joiners:
+                nodes[j.node_id] = j
+            new = state.with_(master_node_id=local.node_id, nodes=nodes,
+                              blocks=state.blocks - {NO_MASTER_BLOCK})
+            if self.gateway_fn is not None and not new.indices:
+                new = self.gateway_fn(new)
+            return self.allocation.reroute(new, "elected as master")
+
+        self.cluster_service.submit_state_update(
+            "zen-disco-elected-as-master", update, priority=URGENT)
+
+    # ---- inbound handlers --------------------------------------------------
+
+    def _handle_ping(self, request: dict, source) -> dict:
+        local = self.transport.local_node
+        state = self.cluster_service.state()
+        return {"cluster_name": self.cluster_name,
+                "node_id": local.node_id, "name": local.name,
+                "host": local.address.host, "port": local.address.port,
+                "attributes": dict(local.attributes),
+                "version": local.version,
+                "master_id": state.master_node_id}
+
+    def _handle_join(self, request: dict, channel) -> None:
+        """NodeJoinController: as master, add the node; while electing,
+        accumulate joins as votes until quorum."""
+        n = request["node"]
+        joiner = DiscoveryNode(
+            n["node_id"], n["name"], TransportAddress(n["host"], n["port"]),
+            attributes=tuple(sorted(n.get("attributes", {}).items())),
+            version=n.get("version", 0))
+        local = self.transport.local_node
+        state = self.cluster_service.state()
+        if state.master_node_id == local.node_id:
+            def update(st: ClusterState) -> ClusterState:
+                if joiner.node_id in st.nodes and \
+                        st.nodes[joiner.node_id].address == joiner.address:
+                    return st
+                nodes = dict(st.nodes)
+                nodes[joiner.node_id] = joiner
+                return self.allocation.reroute(
+                    st.with_(nodes=nodes),
+                    f"node joined [{joiner.name}]")
+            fut = self.cluster_service.submit_state_update(
+                f"zen-disco-join [{joiner.name}]", update, priority=URGENT)
+            fut.add_done_callback(
+                lambda f: channel.send_response({"ok": True})
+                if f.exception() is None else channel.send_failure(
+                    f.exception()))
+            return
+        if state.master_node_id is None and local.master_eligible:
+            # election in progress: count the join as a vote — but only
+            # MASTER-ELIGIBLE joiners count toward minimum_master_nodes
+            # (ElectMasterService counts master nodes only), and votes
+            # expire so dead electors can't satisfy a later quorum
+            now = time.monotonic()
+            with self._votes_lock:
+                self._pending_joins[joiner.node_id] = (joiner, now)
+                live = {nid: (n, ts)
+                        for nid, (n, ts) in self._pending_joins.items()
+                        if now - ts < self.JOIN_VOTE_TTL}
+                self._pending_joins = live
+                votes = sum(1 for n, _ in live.values()
+                            if n.master_eligible) + 1          # + self
+                joiners = [n for n, _ in live.values()]
+                elect = votes >= self.min_master_nodes
+                if elect:
+                    self._pending_joins = {}
+            if elect:
+                self._become_master(joiners)
+                channel.send_response({"ok": True})
+                return
+        channel.send_failure(NotTheMasterError(
+            f"[{local.name}] is not the master"))
+
+    def _handle_leave(self, request: dict, source) -> dict:
+        self._remove_node(request["node_id"], "node left (shutdown)")
+        return {}
+
+    # ---- failure paths -----------------------------------------------------
+
+    def _on_node_failure(self, node: DiscoveryNode) -> None:
+        self._remove_node(node.node_id, "fault detection ping failures")
+
+    def _remove_node(self, node_id: str, reason: str) -> None:
+        if not self.is_master():
+            return
+
+        def update(state: ClusterState) -> ClusterState:
+            if node_id not in state.nodes:
+                return state
+            nodes = {nid: n for nid, n in state.nodes.items()
+                     if nid != node_id}
+            eligible = sum(1 for n in nodes.values() if n.master_eligible)
+            if eligible < self.min_master_nodes:
+                # quorum lost → step down (rejoin path runs via listener)
+                return state.with_(
+                    master_node_id=None, nodes=nodes,
+                    blocks=state.blocks | {NO_MASTER_BLOCK})
+            return self.allocation.reroute(
+                state.with_(nodes=nodes), f"node removed: {reason}")
+
+        try:
+            self.cluster_service.submit_state_update(
+                f"zen-disco-node-failed [{node_id}]", update,
+                priority=URGENT)
+        except RuntimeError:
+            pass                                 # shutting down
+
+    def _on_master_failure(self, master: DiscoveryNode) -> None:
+        """Master stopped answering → drop it locally and rejoin
+        (ZenDiscovery.handleMasterGone → rejoin :78,129)."""
+        def update(state: ClusterState) -> ClusterState:
+            if state.master_node_id != master.node_id:
+                return state
+            nodes = {nid: n for nid, n in state.nodes.items()
+                     if nid != master.node_id}
+            return state.with_(master_node_id=None, nodes=nodes,
+                               blocks=state.blocks | {NO_MASTER_BLOCK})
+        try:
+            # local-only mutation: this node's view drops the master; the
+            # join loop then re-elects (no publish — we are not master)
+            current = self.cluster_service.state()
+            new = update(current)
+            if new is not current:
+                self.cluster_service.apply_published_state(new)
+        except RuntimeError:
+            return
+        self._ensure_join_thread()
+
+    # ---- reacting to applied states ---------------------------------------
+
+    def _cluster_changed(self, old: ClusterState, new: ClusterState) -> None:
+        local_id = self.transport.local_node.node_id
+        master_id = new.master_node_id
+        if master_id is not None:
+            with self._votes_lock:
+                self._pending_joins = {}         # election settled
+        if master_id == local_id:
+            self.master_fd.stop()
+            self.nodes_fd.update_nodes(new.nodes)
+            self.nodes_fd.start()
+        elif master_id is not None:
+            self.nodes_fd.stop()
+            if master_id != self._last_master_id:
+                self.master_fd.restart(new.master_node)
+        else:
+            self.nodes_fd.stop()
+            self.master_fd.stop()
+            if self._running:
+                self._ensure_join_thread()
+        self._last_master_id = master_id

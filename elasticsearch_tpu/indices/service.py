@@ -237,6 +237,12 @@ class IndicesService:
 
     on_shard_failed = None
 
+    def unreport(self, allocation_id: str) -> None:
+        """Forget a started-report that failed to reach the master so the
+        next reconcile re-sends it (the reference resends shardStarted for
+        shards still INITIALIZING in a new state)."""
+        self._reported_started.discard(allocation_id)
+
     # ---- metadata CRUD (MetaDataCreateIndexService analog) ----------------
 
     def create_index(self, name: str,

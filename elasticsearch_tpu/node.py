@@ -43,6 +43,9 @@ class Node:
 
     # ---- lifecycle (Node.start order, core/node/Node.java:230-275) ---------
 
+    SHARD_STARTED_ACTION = "internal:cluster/shard/started"
+    SHARD_FAILED_ACTION = "internal:cluster/shard/failure"
+
     def start(self) -> "Node":
         hub = self._hub or LocalTransportHub()
         attrs = (("data", self.settings.get("node.data", "true")),
@@ -52,8 +55,9 @@ class Node:
             lambda addr: DiscoveryNode(self.node_id, self.node_name, addr,
                                        attributes=attrs))
         self.allocation = AllocationService()
-        self.cluster_service = ClusterService(self._recover_state(),
-                                              self.node_id)
+        cluster_name = self.settings.get("cluster.name", "elasticsearch-tpu")
+        self.cluster_service = ClusterService(
+            ClusterState(cluster_name=cluster_name), self.node_id)
         self.cluster_service.add_listener(self._persist_state)
         from elasticsearch_tpu.indices.service import IndicesService
         self.indices_service = IndicesService(self.data_path,
@@ -62,53 +66,136 @@ class Node:
                                               self.allocation)
         self.indices_service.on_shard_started = self._on_shard_started
         self.indices_service.on_shard_failed = self._on_shard_failed
-        # report shards created during the initial reconcile (callback was
-        # not yet wired when IndicesService reconciled in its constructor)
-        self.indices_service._cluster_changed(
-            self.cluster_service.state(), self.cluster_service.state())
+        # ShardStateAction RPC endpoints (master side)
+        self.transport_service.register_request_handler(
+            self.SHARD_STARTED_ACTION, self._handle_shard_started, sync=True)
+        self.transport_service.register_request_handler(
+            self.SHARD_FAILED_ACTION, self._handle_shard_failed, sync=True)
         self.search_service = SearchService()
         self._delayed_reroute_timer = None
         self.cluster_service.add_listener(self._schedule_delayed_reroute)
+        from elasticsearch_tpu.discovery import ZenDiscovery
+        self.discovery = ZenDiscovery(
+            self.transport_service, self.cluster_service, self.allocation,
+            seed_provider=hub.addresses, cluster_name=cluster_name,
+            min_master_nodes=self.settings.get_as_int(
+                "discovery.zen.minimum_master_nodes", 1),
+            gateway_fn=self._gateway_recover,
+            ping_timeout=self.settings.get_as_float(
+                "discovery.zen.ping_timeout", 1.0),
+            fd_interval=self.settings.get_as_float("fd.ping_interval", 0.5),
+            fd_timeout=self.settings.get_as_float("fd.ping_timeout", 1.0),
+            fd_retries=self.settings.get_as_int("fd.ping_retries", 3),
+            publish_timeout=self.settings.get_as_float(
+                "discovery.zen.publish_timeout", 10.0))
         self._started = True
+        self.discovery.start(self.settings.get_as_float(
+            "discovery.initial_state_timeout", 30.0))
         return self
 
-    def _recover_state(self) -> ClusterState:
-        """Gateway recovery (GatewayMetaState): persisted metadata → fresh
-        routing table (all UNASSIGNED) → allocation."""
-        local = self.transport_service.local_node
+    def _gateway_recover(self, state: ClusterState) -> ClusterState:
+        """Gateway recovery (GatewayMetaState): merge persisted metadata
+        into the state when this node becomes master of a fresh cluster."""
         raw = ClusterState.load_metadata(self.data_path / "_state")
-        state = ClusterState(
-            cluster_name=self.settings.get("cluster.name",
-                                           "elasticsearch-tpu"),
-            master_node_id=self.node_id,
-            nodes={self.node_id: local})
-        if raw:
-            indices = {}
-            routing = RoutingTable()
-            for name, m in raw.get("indices", {}).items():
-                meta = IndexMetadata.from_state_dict(name, m)
-                indices[name] = meta
-                routing = routing.add_index(meta)
-            state = state.with_(
-                version=raw.get("version", 0),
-                indices=indices, routing_table=routing,
-                templates=raw.get("templates", {}),
-                persistent_settings=raw.get("persistent_settings", {}))
-        return self.allocation.reroute(state, "cluster recovered")
+        if not raw:
+            return state
+        indices = dict(state.indices)
+        routing = state.routing_table
+        for name, m in raw.get("indices", {}).items():
+            if name in indices:
+                continue
+            meta = IndexMetadata.from_state_dict(name, m)
+            indices[name] = meta
+            routing = routing.add_index(meta)
+        return state.with_(
+            version=max(state.version, raw.get("version", 0)),
+            indices=indices, routing_table=routing,
+            templates={**raw.get("templates", {}), **state.templates},
+            persistent_settings={**raw.get("persistent_settings", {}),
+                                 **state.persistent_settings})
+
+    # ---- ShardStateAction (core/cluster/action/shard/ShardStateAction.java)
 
     def _on_shard_started(self, shard) -> None:
-        """ShardStateAction analog: master applies the started shard."""
-        self.cluster_service.submit_state_update(
-            f"shard-started [{shard.index}][{shard.shard}]",
-            lambda st: self.allocation.apply_started_shards(st, [shard]),
-            priority=URGENT)
+        """Report to the master; locally if we are it."""
+        state = self.cluster_service.state()
+        if state.master_node_id == self.node_id:
+            self.cluster_service.submit_state_update(
+                f"shard-started [{shard.index}][{shard.shard}]",
+                lambda st: self.allocation.apply_started_shards(st, [shard]),
+                priority=URGENT)
+            return
+        master = state.master_node
+        if master is None:
+            self.indices_service.unreport(shard.allocation_id)
+            return
+        fut = self.transport_service.send_request(
+            master, self.SHARD_STARTED_ACTION, {"shard": shard.to_dict()},
+            timeout=10.0)
+        fut.add_done_callback(
+            lambda f: self._retry_shard_report(shard)
+            if f.exception() is not None else None)
+
+    def _retry_shard_report(self, shard) -> None:
+        """A lost started-report must be re-sent even on a quiescent
+        cluster (the reference resends on every applied state AND the
+        master re-pings INITIALIZING shards)."""
+        import threading
+        self.indices_service.unreport(shard.allocation_id)
+        t = threading.Timer(1.0, self._recheck_shards)
+        t.daemon = True
+        t.start()
+
+    def _recheck_shards(self) -> None:
+        if not self._started:
+            return
+        try:
+            self.cluster_service.run_task(
+                "recheck-shards",
+                lambda: self.indices_service._cluster_changed(
+                    self.cluster_service.state(),
+                    self.cluster_service.state()))
+        except RuntimeError:
+            pass                                 # shutting down
 
     def _on_shard_failed(self, shard, details: str) -> None:
+        state = self.cluster_service.state()
+        if state.master_node_id == self.node_id:
+            self.cluster_service.submit_state_update(
+                f"shard-failed [{shard.index}][{shard.shard}]",
+                lambda st: self.allocation.apply_failed_shards(
+                    st, [(shard, details)]),
+                priority=URGENT)
+            return
+        master = state.master_node
+        if master is not None:
+            self.transport_service.send_request(
+                master, self.SHARD_FAILED_ACTION,
+                {"shard": shard.to_dict(), "details": details}, timeout=10.0)
+
+    def _handle_shard_started(self, request: dict, source) -> dict:
+        from elasticsearch_tpu.cluster.state import ShardRouting
+        shard = ShardRouting.from_dict(request["shard"])
         self.cluster_service.submit_state_update(
-            f"shard-failed [{shard.index}][{shard.shard}]",
+            f"shard-started [{shard.index}][{shard.shard}] (remote)",
+            lambda st: self.allocation.apply_started_shards(st, [shard]),
+            priority=URGENT).result(10.0)
+        return {}
+
+    def _handle_shard_failed(self, request: dict, source) -> dict:
+        from elasticsearch_tpu.cluster.state import ShardRouting
+        shard = ShardRouting.from_dict(request["shard"])
+        details = request.get("details", "")
+        self.cluster_service.submit_state_update(
+            f"shard-failed [{shard.index}][{shard.shard}] (remote)",
             lambda st: self.allocation.apply_failed_shards(
                 st, [(shard, details)]),
-            priority=URGENT)
+            priority=URGENT).result(10.0)
+        return {}
+
+    @property
+    def is_master(self) -> bool:
+        return self.cluster_service.state().master_node_id == self.node_id
 
     def _persist_state(self, old: ClusterState, new: ClusterState) -> None:
         new.persist(self.data_path / "_state")
@@ -142,15 +229,21 @@ class Node:
         except RuntimeError:
             pass                                 # cluster service closed
 
-    def wait_for_health(self, status: str = "green",
-                        timeout: float = 10.0) -> dict:
-        """Health wait (wait_for_status param of the health API)."""
-        want = {"green": ("green",), "yellow": ("green", "yellow")}[status]
+    def wait_for_health(self, status: str | None = "green",
+                        timeout: float = 10.0,
+                        wait_for_nodes: str | int | None = None) -> dict:
+        """Health wait (wait_for_status / wait_for_nodes params of the
+        health API). `wait_for_nodes` accepts N, '>=N', '<=N', '>N', '<N';
+        status=None waits only on the node predicate."""
+        want = {"green": ("green",), "yellow": ("green", "yellow"),
+                None: ("green", "yellow", "red")}[status]
         deadline = time.monotonic() + timeout
         while True:
             h = self.cluster_service.state().health(
                 len(self.cluster_service.pending_tasks()))
-            if h["status"] in want and h["number_of_pending_tasks"] == 0:
+            nodes_ok = _nodes_predicate(wait_for_nodes, h["number_of_nodes"])
+            if h["status"] in want and nodes_ok and \
+                    h["number_of_pending_tasks"] == 0:
                 return h
             if time.monotonic() > deadline:
                 h["timed_out"] = True
@@ -158,13 +251,30 @@ class Node:
             time.sleep(0.01)
 
     def close(self) -> None:
+        """Graceful shutdown: leave the cluster, then stop services."""
         if self._started:
             self._started = False
             if self._delayed_reroute_timer is not None:
                 self._delayed_reroute_timer.cancel()
+            self.discovery.stop()
             self.indices_service.close()
             self.cluster_service.close()
             self.transport_service.close()
+
+    def kill(self) -> None:
+        """Abrupt death — no leave notification, no flush ordering; the
+        cluster must detect the loss via fault detection (test disruption
+        helper, mirrors InternalTestCluster restartNode(KILL))."""
+        if self._started:
+            self._started = False
+            if self._delayed_reroute_timer is not None:
+                self._delayed_reroute_timer.cancel()
+            self.transport_service.close()
+            self.discovery.master_fd.stop()
+            self.discovery.nodes_fd.stop()
+            self.discovery._running = False
+            self.cluster_service.close()
+            self.indices_service.close()
 
     def __enter__(self):
         return self.start()
@@ -331,6 +441,17 @@ class Node:
         resp = self.search(index, {**(body or {}), "size": 0})
         return {"count": resp["hits"]["total"]["value"],
                 "_shards": resp["_shards"]}
+
+
+def _nodes_predicate(expr, actual: int) -> bool:
+    if expr is None:
+        return True
+    s = str(expr)
+    for op, fn in ((">=", lambda a, b: a >= b), ("<=", lambda a, b: a <= b),
+                   (">", lambda a, b: a > b), ("<", lambda a, b: a < b)):
+        if s.startswith(op):
+            return fn(actual, int(s[len(op):]))
+    return actual == int(s)
 
 
 def _deep_merge(base: dict, patch: dict) -> dict:

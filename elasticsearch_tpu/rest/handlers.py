@@ -505,11 +505,13 @@ class Handlers:
 
     def cluster_health(self, req: RestRequest):
         want = req.params.get("wait_for_status")
-        if want in ("green", "yellow"):
+        wait_nodes = req.params.get("wait_for_nodes")
+        if want in ("green", "yellow") or wait_nodes is not None:
             from elasticsearch_tpu.common.settings import parse_time_millis
             timeout = parse_time_millis(
                 req.params.get("timeout", "30s")) / 1000.0
-            return 200, self.node.wait_for_health(want, timeout)
+            return 200, self.node.wait_for_health(
+                want, timeout, wait_for_nodes=wait_nodes)
         return 200, self.node.cluster_service.state().health(
             len(self.node.cluster_service.pending_tasks()))
 

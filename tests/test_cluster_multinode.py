@@ -1,0 +1,181 @@
+"""Multi-node cluster integration tests over LocalTransport — the
+ESIntegTestCase / InternalTestCluster tier (SURVEY.md §4): cluster
+formation, state publish convergence, node leave/join reallocation,
+master failover under partition."""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.state import ShardRoutingState
+from elasticsearch_tpu.testing import (
+    NetworkPartition, InternalTestCluster)
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    with InternalTestCluster(3, base_path=tmp_path) as c:
+        c.wait_for_nodes(3)
+        yield c
+
+
+def test_cluster_forms(cluster3):
+    c = cluster3
+    masters = {n.cluster_service.state().master_node_id for n in c.nodes}
+    assert len(masters) == 1
+    assert all(len(n.cluster_service.state().nodes) == 3 for n in c.nodes)
+    # first node (lowest-ordered id among initial candidates) is master
+    assert c.master() in c.nodes
+
+
+def test_state_publish_reaches_all_nodes(cluster3):
+    c = cluster3
+    master = c.master()
+    master.indices_service.create_index(
+        "events", {"settings": {"number_of_shards": 3,
+                                "number_of_replicas": 1}})
+    c.wait_for_health("green")
+    c.wait_converged_version()
+    for n in c.nodes:
+        st = n.cluster_service.state()
+        assert "events" in st.indices
+        assert len(st.routing_table.shards) == 6
+    # shards are spread across nodes (balanced allocator)
+    placements = {s.node_id
+                  for s in master.cluster_service.state().routing_table.shards}
+    assert len(placements) == 3
+
+
+def test_local_engines_created_only_where_assigned(cluster3):
+    c = cluster3
+    master = c.master()
+    master.indices_service.create_index(
+        "logs", {"settings": {"number_of_shards": 2,
+                              "number_of_replicas": 0}})
+    c.wait_for_health("green")
+    c.wait_converged_version()
+    st = master.cluster_service.state()
+    owners = {s.shard: s.node_id for s in st.routing_table.shards}
+    for n in c.nodes:
+        svc = n.indices_service.indices.get("logs")
+        expect = {sid for sid, nid in owners.items() if nid == n.node_id}
+        got = set(svc.engines) if svc else set()
+        assert got == expect, (n.node_name, got, expect)
+
+
+def test_graceful_node_leave_reallocates(cluster3):
+    c = cluster3
+    master = c.master()
+    master.indices_service.create_index(
+        "d", {"settings": {"number_of_shards": 2,
+                           "number_of_replicas": 1}})
+    c.wait_for_health("green")
+    victim = c.non_masters()[0]
+    c.stop_node(victim, graceful=True)
+    c.wait_for_nodes(2)
+    h = c.wait_for_health("green", timeout=20.0)
+    assert h["active_shards"] == 4
+    st = c.master().cluster_service.state()
+    assert all(s.node_id != victim.node_id
+               for s in st.routing_table.shards)
+
+
+def test_node_crash_detected_and_recovered(cluster3):
+    c = cluster3
+    master = c.master()
+    master.indices_service.create_index(
+        "d", {"settings": {"number_of_shards": 2,
+                           "number_of_replicas": 1}})
+    c.wait_for_health("green")
+    victim = c.non_masters()[0]
+    c.stop_node(victim, graceful=False)       # no leave — FD must notice
+    c.wait_for_nodes(2, timeout=20.0)
+    c.wait_for_health("green", timeout=20.0)
+
+
+def test_master_failover(cluster3):
+    c = cluster3
+    old_master = c.master()
+    c.stop_node(old_master, graceful=False)
+    deadline = time.monotonic() + 20.0
+    new_master = None
+    while time.monotonic() < deadline:
+        try:
+            c.wait_for_nodes(2, timeout=1.0)
+            new_master = c.master()
+            break
+        except (TimeoutError, RuntimeError):
+            continue
+    assert new_master is not None and new_master is not old_master
+    # new master can mutate state
+    new_master.indices_service.create_index(
+        "after", {"settings": {"number_of_shards": 1}})
+    c.wait_for_health("green", timeout=20.0)
+    for n in c.nodes:
+        assert "after" in n.cluster_service.state().indices
+
+
+def test_new_node_joins_running_cluster(cluster3):
+    c = cluster3
+    c.master().indices_service.create_index(
+        "x", {"settings": {"number_of_shards": 4,
+                           "number_of_replicas": 0}})
+    c.wait_for_health("green")
+    c.add_node()
+    c.wait_for_nodes(4)
+    for n in c.nodes:
+        assert "x" in n.cluster_service.state().indices
+
+
+def test_partition_minority_master_steps_down(tmp_path):
+    with InternalTestCluster(3, base_path=tmp_path,
+                     settings={"discovery.zen.minimum_master_nodes": 2}) as c:
+        c.wait_for_nodes(3)
+        master = c.master()
+        others = c.non_masters()
+        part = NetworkPartition([master], others)
+        part.start_disrupting()
+        # majority side elects a new master; old master (minority) loses
+        # its quorum and steps down
+        deadline = time.monotonic() + 20.0
+        ok = False
+        while time.monotonic() < deadline:
+            majority_masters = {n.cluster_service.state().master_node_id
+                                for n in others}
+            minority_view = master.cluster_service.state().master_node_id
+            if (len(majority_masters) == 1 and
+                    None not in majority_masters and
+                    majority_masters != {master.node_id} and
+                    minority_view != master.node_id):
+                ok = True
+                break
+            time.sleep(0.05)
+        assert ok, (
+            {n.node_name: n.cluster_service.state().master_node_id
+             for n in c.nodes})
+        part.stop_disrupting()
+        # after healing, the old master rejoins the new master's cluster
+        c.wait_for_nodes(3, timeout=20.0)
+
+
+def test_single_node_cluster_still_works(tmp_path):
+    with InternalTestCluster(1, base_path=tmp_path) as c:
+        n = c.nodes[0]
+        n.indices_service.create_index("solo", {})
+        n.index_doc("solo", "1", {"a": 1}, refresh=True)
+        assert n.search("solo", {"query": {"match_all": {}}}
+                        )["hits"]["total"]["value"] == 1
+
+
+def test_shard_state_travels_reconciler_to_master(cluster3):
+    """Non-master nodes report shard-started over the transport; the
+    master's routing table converges to STARTED for every copy."""
+    c = cluster3
+    c.master().indices_service.create_index(
+        "r", {"settings": {"number_of_shards": 3,
+                           "number_of_replicas": 2}})
+    c.wait_for_health("green", timeout=20.0)
+    st = c.master().cluster_service.state()
+    assert all(s.state == ShardRoutingState.STARTED
+               for s in st.routing_table.shards)
+    assert len(st.routing_table.shards) == 9
