@@ -1,0 +1,23 @@
+"""Action layer — the distributed RPC verbs over the transport.
+
+Reference: core/action/ (~72k LoC). The reusable bases map as:
+
+* :class:`~elasticsearch_tpu.action.replication.DocumentActions` —
+  TransportReplicationAction (core/action/support/replication/
+  TransportReplicationAction.java:81): reroute → primary → replicas.
+* :class:`~elasticsearch_tpu.action.replication.BroadcastActions` —
+  TransportBroadcastAction (core/action/support/broadcast/
+  TransportBroadcastAction.java:48): one copy of every shard.
+* :class:`~elasticsearch_tpu.action.search_action.SearchActions` —
+  TransportSearchTypeAction (core/action/search/type/
+  TransportSearchTypeAction.java:87): scatter query/fetch + reduce.
+* Master forwarding lives on the Node (`_execute_master_action`) —
+  TransportMasterNodeAction (core/action/support/master/
+  TransportMasterNodeAction.java:50).
+"""
+
+from elasticsearch_tpu.action.replication import (
+    BroadcastActions, DocumentActions)
+from elasticsearch_tpu.action.search_action import SearchActions
+
+__all__ = ["DocumentActions", "BroadcastActions", "SearchActions"]

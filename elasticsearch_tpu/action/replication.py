@@ -1,0 +1,600 @@
+"""Write replication + routed reads over the transport.
+
+Reference: core/action/support/replication/TransportReplicationAction.java:81
+— ReroutePhase resolves the primary's node from cluster state and forwards
+(:366), PrimaryPhase applies the op locally (:346,578), ReplicationPhase
+fans the op out to every assigned copy (:689,828-864) and reports failed
+replicas to the master; core/action/bulk/TransportShardBulkAction.java:116
+(primary loop) / :448 (replica); core/action/support/single/shard/
+TransportSingleShardAction.java:53 (routed get with copy failover);
+core/action/support/broadcast/TransportBroadcastAction.java:48.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+from elasticsearch_tpu.cluster.routing import OperationRouting
+from elasticsearch_tpu.cluster.state import ShardRouting
+from elasticsearch_tpu.common.errors import (
+    DocumentMissingError, ElasticsearchTpuError, IndexAlreadyExistsError,
+    UnavailableShardsError, reconstruct_error)
+from elasticsearch_tpu.index.engine import MATCH_ANY
+from elasticsearch_tpu.transport.service import (
+    RemoteTransportError, TransportException)
+
+
+def unwrap_remote(e: Exception) -> Exception:
+    """RemoteTransportException.unwrapCause analog."""
+    if isinstance(e, RemoteTransportError):
+        return reconstruct_error(e.error_type, e.reason)
+    return e
+
+
+class DocumentActions:
+    """Document CRUD + bulk with primary→replica synchronous replication."""
+
+    INDEX_P = "indices:data/write/index[p]"
+    INDEX_R = "indices:data/write/index[r]"
+    DELETE_P = "indices:data/write/delete[p]"
+    DELETE_R = "indices:data/write/delete[r]"
+    UPDATE_P = "indices:data/write/update"
+    BULK_P = "indices:data/write/bulk[s][p]"
+    BULK_R = "indices:data/write/bulk[s][r]"
+    GET_S = "indices:data/read/get[s]"
+
+    #: how long the reroute phase waits for an active primary (the
+    #: reference's default index timeout is 1m; tests want seconds)
+    PRIMARY_TIMEOUT = 15.0
+    REPLICA_TIMEOUT = 30.0
+
+    def __init__(self, node):
+        self.node = node
+        ts = node.transport_service
+        # Primary-phase handlers block waiting for replica acks, so they
+        # run on the "index" pool while replica appliers run on "replica" —
+        # distinct pools per workload class (ThreadPool.java:70-129), which
+        # is what prevents a cross-node write-write thread-pool deadlock.
+        ts.register_request_handler(self.INDEX_P, self._handle_index_p,
+                                    executor="index", sync=True)
+        ts.register_request_handler(self.INDEX_R, self._handle_index_r,
+                                    executor="replica", sync=True)
+        ts.register_request_handler(self.DELETE_P, self._handle_delete_p,
+                                    executor="index", sync=True)
+        ts.register_request_handler(self.DELETE_R, self._handle_delete_r,
+                                    executor="replica", sync=True)
+        ts.register_request_handler(self.UPDATE_P, self._handle_update,
+                                    executor="index", sync=True)
+        ts.register_request_handler(self.BULK_P, self._handle_bulk_p,
+                                    executor="bulk", sync=True)
+        ts.register_request_handler(self.BULK_R, self._handle_bulk_r,
+                                    executor="replica", sync=True)
+        ts.register_request_handler(self.GET_S, self._handle_get,
+                                    executor="get", sync=True)
+
+    # ---- routing helpers ---------------------------------------------------
+
+    def _state(self):
+        return self.node.cluster_service.state()
+
+    def _resolve_write_index(self, index: str, auto_create: bool = True) -> str:
+        isvc = self.node.indices_service
+        if auto_create and not isvc.has_index(index):
+            try:
+                isvc.create_index(index, {})
+            except IndexAlreadyExistsError:
+                pass                             # concurrent auto-create race
+        names = isvc.resolve(index)
+        return names[0]
+
+    def _shard_id(self, name: str, doc_id: str, routing: str | None) -> int:
+        meta = self._state().indices[name]
+        return OperationRouting.shard_id(doc_id, meta.number_of_shards,
+                                         routing)
+
+    def _await_primary(self, name: str, shard: int) -> ShardRouting:
+        """ReroutePhase: observe cluster state until the primary is active
+        (TransportReplicationAction.java:366 retryBecauseUnavailable)."""
+        deadline = time.monotonic() + self.PRIMARY_TIMEOUT
+        while True:
+            state = self._state()
+            pr = state.routing_table.primary(name, shard)
+            if pr is not None and pr.active and \
+                    state.node(pr.node_id) is not None:
+                return pr
+            if time.monotonic() > deadline:
+                raise UnavailableShardsError(
+                    f"[{name}][{shard}] primary shard is not active "
+                    f"(timeout [{self.PRIMARY_TIMEOUT}s])", index=name,
+                    shard=shard)
+            time.sleep(0.05)
+
+    def _on_primary(self, name: str, shard: int, request: dict, action: str,
+                    local_fn) -> dict:
+        """Route a primary-phase op: execute locally if the primary shard
+        lives here, otherwise forward; retry once per routing change when
+        the target turns out stale."""
+        deadline = time.monotonic() + self.PRIMARY_TIMEOUT
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            pr = self._await_primary(name, shard)
+            if pr.node_id == self.node.node_id:
+                return local_fn(request)
+            target = self._state().node(pr.node_id)
+            try:
+                return self.node.transport_service.send_request(
+                    target, action, request,
+                    timeout=self.PRIMARY_TIMEOUT).result(
+                        self.PRIMARY_TIMEOUT + 5)
+            except RemoteTransportError as e:    # remote application error
+                raise unwrap_remote(e) from None
+            except TransportException as e:
+                last = e                         # stale routing / node left →
+                time.sleep(0.1)                  # wait for new state, retry
+            except Exception as e:               # noqa: BLE001 — remote error
+                raise unwrap_remote(e) from None
+        raise UnavailableShardsError(
+            f"[{name}][{shard}] primary op failed: {last}", index=name,
+            shard=shard)
+
+    # ---- replication fan-out (ReplicationPhase :689) -----------------------
+
+    def _replicas_of(self, name: str, shard: int) -> list[ShardRouting]:
+        """Every assigned copy except the primary — including INITIALIZING
+        ones so recovering shards don't miss concurrent ops (the reference
+        replicates to initializing/relocating copies too)."""
+        state = self._state()
+        return [c for c in state.routing_table.shard_copies(name, shard)
+                if c.assigned and not c.primary]
+
+    def _replicate(self, name: str, shard: int, action: str,
+                   payload: dict) -> tuple[int, int, list[dict]]:
+        """→ (total_copies, successful, failures). Failed replicas are
+        reported shard-failed to the master (onReplicaFailure :864-900)."""
+        copies = self._replicas_of(name, shard)
+        futures = []
+        state = self._state()
+        for c in copies:
+            target = state.node(c.node_id)
+            if target is None:
+                continue
+            fut = self.node.transport_service.send_request(
+                target, action, payload, timeout=self.REPLICA_TIMEOUT)
+            futures.append((c, fut))
+        ok, failures = 1, []                     # primary already succeeded
+        for c, fut in futures:
+            try:
+                fut.result(self.REPLICA_TIMEOUT + 5)
+                ok += 1
+            except Exception as e:               # noqa: BLE001 — report it
+                failures.append({"shard": shard, "index": name,
+                                 "node": c.node_id, "status": "INTERNAL",
+                                 "reason": str(unwrap_remote(e))})
+                self.node._on_shard_failed(
+                    c, f"replication op failed: {unwrap_remote(e)}")
+        return 1 + len(futures), ok, failures
+
+    def _shards_header(self, total: int, ok: int,
+                       failures: list[dict]) -> dict:
+        out = {"total": total, "successful": ok, "failed": len(failures)}
+        if failures:
+            out["failures"] = failures
+        return out
+
+    def _engine(self, name: str, shard: int, wait: float = 2.0):
+        """Local engine for a shard, waiting briefly for the reconciler to
+        catch up with a state the sender already saw."""
+        deadline = time.monotonic() + wait
+        while True:
+            try:
+                return self.node.indices_service.index(name).engine(shard)
+            except Exception:                    # noqa: BLE001 — state lag
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+
+    # ---- index -------------------------------------------------------------
+
+    def index_doc(self, index: str, doc_id: str | None, source: dict,
+                  routing: str | None = None, version: int | None = None,
+                  op_type: str = "index", refresh: bool = False) -> dict:
+        name = self._resolve_write_index(index)
+        doc_id = doc_id or uuid.uuid4().hex[:20]
+        shard = self._shard_id(name, doc_id, routing)
+        request = {"index": name, "shard": shard, "id": doc_id,
+                   "source": source, "routing": routing,
+                   "version": version, "op_type": op_type,
+                   "refresh": refresh}
+        return self._on_primary(name, shard, request, self.INDEX_P,
+                                self._handle_index_p_local)
+
+    def _handle_index_p(self, request: dict, source) -> dict:
+        return self._handle_index_p_local(request)
+
+    def _handle_index_p_local(self, request: dict) -> dict:
+        name, shard = request["index"], request["shard"]
+        engine = self._engine(name, shard)
+        version = request.get("version")
+        v, created = engine.index(
+            request["id"], request["source"],
+            version=MATCH_ANY if version is None else version,
+            routing=request.get("routing"),
+            op_type=request.get("op_type", "index"))
+        if request.get("refresh"):
+            engine.refresh()
+        total, ok, failures = self._replicate(
+            name, shard, self.INDEX_R,
+            {"index": name, "shard": shard, "id": request["id"],
+             "source": request["source"], "routing": request.get("routing"),
+             "version": v, "refresh": bool(request.get("refresh"))})
+        return {"_index": name, "_type": "_doc", "_id": request["id"],
+                "_version": v,
+                "result": "created" if created else "updated",
+                "created": created,
+                "_shards": self._shards_header(total, ok, failures)}
+
+    def _handle_index_r(self, request: dict, source) -> dict:
+        engine = self._engine(request["index"], request["shard"])
+        engine.index_replica(request["id"], request["source"],
+                             request["version"],
+                             routing=request.get("routing"))
+        if request.get("refresh"):
+            engine.refresh()
+        return {}
+
+    # ---- delete ------------------------------------------------------------
+
+    def delete_doc(self, index: str, doc_id: str,
+                   routing: str | None = None, version: int | None = None,
+                   refresh: bool = False) -> dict:
+        names = self.node.indices_service.resolve(index)
+        name = names[0]
+        shard = self._shard_id(name, doc_id, routing)
+        request = {"index": name, "shard": shard, "id": doc_id,
+                   "version": version, "refresh": refresh}
+        return self._on_primary(name, shard, request, self.DELETE_P,
+                                self._handle_delete_p_local)
+
+    def _handle_delete_p(self, request: dict, source) -> dict:
+        return self._handle_delete_p_local(request)
+
+    def _handle_delete_p_local(self, request: dict) -> dict:
+        name, shard = request["index"], request["shard"]
+        engine = self._engine(name, shard)
+        version = request.get("version")
+        v = engine.delete(request["id"],
+                          version=MATCH_ANY if version is None else version)
+        if request.get("refresh"):
+            engine.refresh()
+        total, ok, failures = self._replicate(
+            name, shard, self.DELETE_R,
+            {"index": name, "shard": shard, "id": request["id"],
+             "version": v, "refresh": bool(request.get("refresh"))})
+        return {"_index": name, "_type": "_doc", "_id": request["id"],
+                "_version": v, "result": "deleted", "found": True,
+                "_shards": self._shards_header(total, ok, failures)}
+
+    def _handle_delete_r(self, request: dict, source) -> dict:
+        engine = self._engine(request["index"], request["shard"])
+        engine.delete_replica(request["id"], request["version"])
+        if request.get("refresh"):
+            engine.refresh()
+        return {}
+
+    # ---- update (get-modify-reindex ON the primary's node,
+    # core/action/update/TransportUpdateAction.java) -------------------------
+
+    def update_doc(self, index: str, doc_id: str, body: dict,
+                   routing: str | None = None, refresh: bool = False) -> dict:
+        names = self.node.indices_service.resolve(index)
+        name = names[0]
+        shard = self._shard_id(name, doc_id, routing)
+        request = {"index": name, "shard": shard, "id": doc_id, "body": body,
+                   "routing": routing, "refresh": refresh}
+        return self._on_primary(name, shard, request, self.UPDATE_P,
+                                self._handle_update_local)
+
+    def _handle_update(self, request: dict, source) -> dict:
+        return self._handle_update_local(request)
+
+    def _handle_update_local(self, request: dict) -> dict:
+        from elasticsearch_tpu.node import _apply_update_script, _deep_merge
+        name, shard = request["index"], request["shard"]
+        body = request["body"]
+        engine = self._engine(name, shard)
+        current = engine.get(request["id"])
+        if not current.found:
+            if "upsert" in body:
+                return self._handle_index_p_local(
+                    {"index": name, "shard": shard, "id": request["id"],
+                     "source": body["upsert"],
+                     "routing": request.get("routing"), "version": None,
+                     "op_type": "index",
+                     "refresh": bool(request.get("refresh"))})
+            raise DocumentMissingError(name, request["id"])
+        if "doc" in body:
+            merged = _deep_merge(dict(current.source), body["doc"])
+        elif "script" in body:
+            merged = _apply_update_script(dict(current.source),
+                                          body["script"])
+        else:
+            merged = dict(current.source)
+        out = self._handle_index_p_local(
+            {"index": name, "shard": shard, "id": request["id"],
+             "source": merged, "routing": request.get("routing"),
+             "version": current.version, "op_type": "index",
+             "refresh": bool(request.get("refresh"))})
+        out["result"] = "updated"
+        return out
+
+    # ---- get (TransportSingleShardAction: one copy, failover) --------------
+
+    def get_doc(self, index: str, doc_id: str,
+                routing: str | None = None) -> dict:
+        names = self.node.indices_service.resolve(index)
+        name = names[0]
+        shard = self._shard_id(name, doc_id, routing)
+        state = self._state()
+        copies = [c for c in state.routing_table.shard_copies(name, shard)
+                  if c.active]
+        # prefer the local copy (preference=_local default behavior), then
+        # primary, then replicas
+        copies.sort(key=lambda c: (c.node_id != self.node.node_id,
+                                   not c.primary))
+        if not copies:
+            raise UnavailableShardsError(
+                f"[{name}][{shard}] no active copy", index=name, shard=shard)
+        request = {"index": name, "shard": shard, "id": doc_id}
+        last: Exception | None = None
+        for c in copies:
+            if c.node_id == self.node.node_id:
+                try:
+                    return self._handle_get(request, None)
+                except ElasticsearchTpuError:
+                    raise
+                except Exception as e:           # noqa: BLE001 — failover
+                    last = e
+                    continue
+            target = state.node(c.node_id)
+            if target is None:
+                continue
+            try:
+                return self.node.transport_service.send_request(
+                    target, self.GET_S, request, timeout=10.0).result(15.0)
+            except RemoteTransportError as e:    # remote application error
+                raise unwrap_remote(e) from None
+            except TransportException as e:
+                last = e                         # node gone → next copy
+            except Exception as e:               # noqa: BLE001 — remote error
+                raise unwrap_remote(e) from None
+        raise UnavailableShardsError(
+            f"[{name}][{shard}] get failed on all copies: {last}",
+            index=name, shard=shard)
+
+    def _handle_get(self, request: dict, source) -> dict:
+        name = request["index"]
+        engine = self._engine(name, request["shard"])
+        r = engine.get(request["id"])
+        out = {"_index": name, "_type": "_doc", "_id": request["id"],
+               "found": r.found}
+        if r.found:
+            out["_version"] = r.version
+            out["_source"] = r.source
+        return out
+
+    def mget(self, body: dict, default_index: str | None = None) -> dict:
+        docs = []
+        for spec in body.get("docs", []):
+            idx = spec.get("_index", default_index)
+            try:
+                docs.append(self.get_doc(idx, spec["_id"],
+                                         routing=spec.get("routing")))
+            except ElasticsearchTpuError as e:
+                docs.append({"_index": idx, "_id": spec["_id"],
+                             "error": e.to_xcontent()})
+        if "ids" in body and default_index:
+            for did in body["ids"]:
+                try:
+                    docs.append(self.get_doc(default_index, str(did)))
+                except ElasticsearchTpuError as e:
+                    docs.append({"_index": default_index, "_id": str(did),
+                                 "error": e.to_xcontent()})
+        return {"docs": docs}
+
+    # ---- bulk (TransportBulkAction → one BULK_P per target shard) ----------
+
+    def bulk(self, operations: list[tuple[str, dict, dict | None]],
+             refresh: bool = False) -> dict:
+        t0 = time.perf_counter()
+        # auto-create every target index up front (TransportBulkAction does
+        # a create round-trip per missing index before splitting)
+        resolved: dict[str, str] = {}
+        items: list[dict | None] = [None] * len(operations)
+        errors = False
+        by_shard: dict[tuple[str, int], list[tuple[int, tuple]]] = {}
+        for pos, (action, meta, source) in enumerate(operations):
+            index = meta.get("_index")
+            try:
+                if index not in resolved:
+                    resolved[index] = self._resolve_write_index(index)
+                name = resolved[index]
+                doc_id = meta.get("_id") or uuid.uuid4().hex[:20]
+                routing = meta.get("routing", meta.get("_routing"))
+                shard = self._shard_id(name, doc_id, routing)
+                by_shard.setdefault((name, shard), []).append(
+                    (pos, (action, doc_id, routing, source)))
+            except Exception as e:               # noqa: BLE001 — per item
+                errors = True
+                items[pos] = self._bulk_error_item(action, index,
+                                                   meta.get("_id"), e)
+        for (name, shard), group in by_shard.items():
+            request = {"index": name, "shard": shard, "refresh": refresh,
+                       "items": [
+                           {"action": a, "id": d, "routing": r, "source": s}
+                           for _, (a, d, r, s) in group]}
+            try:
+                resp = self._on_primary(name, shard, request, self.BULK_P,
+                                        self._handle_bulk_p_local)
+                for (pos, (action, *_)), item in zip(group, resp["items"]):
+                    items[pos] = item
+                    act = next(iter(item))
+                    if "error" in item[act]:
+                        errors = True
+            except Exception as e:               # noqa: BLE001 — whole shard
+                errors = True
+                for pos, (action, doc_id, _r, _s) in group:
+                    items[pos] = self._bulk_error_item(action, name, doc_id, e)
+        return {"took": int((time.perf_counter() - t0) * 1e3),
+                "errors": errors, "items": items}
+
+    def _bulk_error_item(self, action: str, index, doc_id, e) -> dict:
+        e = unwrap_remote(e)
+        err = e.to_xcontent() if isinstance(e, ElasticsearchTpuError) \
+            else {"type": "exception", "reason": str(e)}
+        status = e.status if isinstance(e, ElasticsearchTpuError) else 500
+        return {action: {"_index": index, "_id": doc_id, "error": err,
+                         "status": status}}
+
+    def _handle_bulk_p(self, request: dict, source) -> dict:
+        return self._handle_bulk_p_local(request)
+
+    def _handle_bulk_p_local(self, request: dict) -> dict:
+        """Primary bulk loop (TransportShardBulkAction.java:116): apply each
+        item, collect per-item results, then replicate the resolved ops in
+        one replica request."""
+        name, shard = request["index"], request["shard"]
+        engine = self._engine(name, shard)
+        items_out: list[dict] = []
+        replica_ops: list[dict] = []
+        for item in request["items"]:
+            action = item["action"]
+            try:
+                if action in ("index", "create"):
+                    v, created = engine.index(
+                        item["id"], item["source"],
+                        routing=item.get("routing"),
+                        op_type="create" if action == "create" else "index")
+                    replica_ops.append({"op": "index", "id": item["id"],
+                                        "source": item["source"],
+                                        "routing": item.get("routing"),
+                                        "version": v})
+                    r = {"_index": name, "_type": "_doc", "_id": item["id"],
+                         "_version": v,
+                         "result": "created" if created else "updated",
+                         "created": created,
+                         "status": 201 if created else 200}
+                elif action == "delete":
+                    v = engine.delete(item["id"])
+                    replica_ops.append({"op": "delete", "id": item["id"],
+                                        "version": v})
+                    r = {"_index": name, "_type": "_doc", "_id": item["id"],
+                         "_version": v, "result": "deleted", "found": True,
+                         "status": 200}
+                elif action == "update":
+                    r = {**self._handle_update_local(
+                        {"index": name, "shard": shard, "id": item["id"],
+                         "body": item.get("source") or {},
+                         "routing": item.get("routing"), "refresh": False}),
+                        "status": 200}
+                    # update replicates itself via _handle_index_p_local
+                else:
+                    raise ValueError(f"unknown bulk action [{action}]")
+                items_out.append({action: r})
+            except Exception as e:               # noqa: BLE001 — per item
+                items_out.append(self._bulk_error_item(action, name,
+                                                       item["id"], e))
+        if request.get("refresh"):
+            engine.refresh()
+        if replica_ops:
+            self._replicate(name, shard, self.BULK_R,
+                            {"index": name, "shard": shard,
+                             "ops": replica_ops,
+                             "refresh": bool(request.get("refresh"))})
+        return {"items": items_out}
+
+    def _handle_bulk_r(self, request: dict, source) -> dict:
+        engine = self._engine(request["index"], request["shard"])
+        for op in request["ops"]:
+            if op["op"] == "index":
+                engine.index_replica(op["id"], op["source"], op["version"],
+                                     routing=op.get("routing"))
+            else:
+                engine.delete_replica(op["id"], op["version"])
+        if request.get("refresh"):
+            engine.refresh()
+        return {}
+
+
+class BroadcastActions:
+    """Shard-broadcast admin verbs: refresh / flush / force-merge hit one
+    node per index copy-holder (TransportBroadcastAction.java:48 — here
+    per-node grouping since the op applies to all local shards at once)."""
+
+    ACTION = "indices:admin/broadcast[n]"
+
+    def __init__(self, node):
+        self.node = node
+        node.transport_service.register_request_handler(
+            self.ACTION, self._handle, executor="management", sync=True)
+
+    def _fan_out(self, index_expr: str, op: str, **kw) -> dict:
+        names = self.node.indices_service.resolve(index_expr)
+        state = self.node.cluster_service.state()
+        shards_per_node: dict[str, int] = {}
+        for name in names:
+            for s in state.routing_table.index_shards(name):
+                if s.assigned:
+                    shards_per_node[s.node_id] = \
+                        shards_per_node.get(s.node_id, 0) + 1
+        total_shards = sum(shards_per_node.values())
+        futures = []
+        ok = failed = 0
+        for nid, nshards in shards_per_node.items():
+            request = {"indices": names, "op": op, **kw}
+            if nid == self.node.node_id:
+                try:
+                    self._handle(request, None)
+                    ok += nshards
+                except Exception:                # noqa: BLE001 — count it
+                    failed += nshards
+                continue
+            target = state.node(nid)
+            if target is None:
+                failed += nshards
+                continue
+            futures.append((nshards, self.node.transport_service.send_request(
+                target, self.ACTION, request, timeout=30.0)))
+        for nshards, fut in futures:
+            try:
+                fut.result(35.0)
+                ok += nshards
+            except Exception:                    # noqa: BLE001 — count it
+                failed += nshards
+        return {"_shards": {"total": total_shards, "successful": ok,
+                            "failed": failed}}
+
+    def _handle(self, request: dict, source) -> dict:
+        isvc = self.node.indices_service
+        for name in request["indices"]:
+            svc = isvc.indices.get(name)
+            if svc is None:
+                continue
+            if request["op"] == "refresh":
+                svc.refresh()
+            elif request["op"] == "flush":
+                svc.flush()
+            elif request["op"] == "force_merge":
+                svc.force_merge(request.get("max_num_segments", 1))
+        return {}
+
+    def refresh(self, index_expr: str) -> dict:
+        return self._fan_out(index_expr, "refresh")
+
+    def flush(self, index_expr: str) -> dict:
+        return self._fan_out(index_expr, "flush")
+
+    def force_merge(self, index_expr: str,
+                    max_num_segments: int = 1) -> dict:
+        return self._fan_out(index_expr, "force_merge",
+                             max_num_segments=max_num_segments)

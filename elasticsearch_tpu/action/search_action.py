@@ -1,0 +1,298 @@
+"""Distributed search: scatter query+fetch per shard, reduce at the
+coordinator.
+
+Reference: core/action/search/type/TransportSearchTypeAction.java:87-247 —
+`start` (:137) fans one request per shard group to the next copy
+(`performFirstPhase` :156), failed shards retry the next copy (:205-247),
+and `SearchPhaseController` merges (sortDocs :165, merge :300). Each shard
+executes query AND fetch of its own top `from+size` hits in one round
+(QUERY_AND_FETCH semantics, SearchType.java:29 — correct for any
+single-round request and chosen here because fetch-phase hits are small
+columnar reads on the TPU host, so the second fan-out round of
+QUERY_THEN_FETCH buys nothing); the coordinator reduce then keeps the
+global [from, from+size) slice, which is identical to what
+query_then_fetch returns.
+
+Scroll is a coordinator-side cursor (search_after continuation re-running
+the scatter) instead of server-side per-shard contexts — the TPU-friendly
+redesign of ScrollContext (no pinned per-shard readers; see
+search/service.py for the single-node variant and rationale).
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuError, SearchContextMissingError)
+from elasticsearch_tpu.common.settings import parse_time_value
+from elasticsearch_tpu.index.device_reader import device_reader_for
+from elasticsearch_tpu.search.controller import merge_shard_payloads
+from elasticsearch_tpu.search.phase import ShardSearcher, parse_search_request
+
+
+def wire_safe(obj):
+    """Make agg partials transport-serializable (sets → lists, numpy →
+    python) without changing what reduce_aggs consumes."""
+    if isinstance(obj, dict):
+        return {k: wire_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (set, frozenset)):
+        return sorted(str(x) for x in obj)
+    if isinstance(obj, (list, tuple)):
+        return [wire_safe(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
+    return obj
+
+
+class _ScrollContext:
+    def __init__(self, index_expr: str, body: dict, keep_alive_s: float):
+        self.index_expr = index_expr
+        self.body = dict(body)
+        self.keep_alive_s = keep_alive_s
+        self.expires_at = time.monotonic() + keep_alive_s
+        self.last_sort_key: list | None = None
+        self.finished = False
+
+    def touch(self, keep_alive_s: float | None = None):
+        if keep_alive_s is not None:
+            self.keep_alive_s = keep_alive_s
+        self.expires_at = time.monotonic() + self.keep_alive_s
+
+
+class SearchActions:
+    QUERY_FETCH = "indices:data/read/search[phase/query+fetch]"
+
+    def __init__(self, node):
+        self.node = node
+        self._pool = ThreadPoolExecutor(max_workers=16,
+                                        thread_name_prefix="search")
+        self._rotation = itertools.count()
+        self._contexts: dict[str, _ScrollContext] = {}
+        self._ctx_ids = itertools.count(1)
+        self._lock = threading.Lock()
+        node.transport_service.register_request_handler(
+            self.QUERY_FETCH, self._handle_shard_query, executor="search",
+            sync=True)
+
+    def close(self):
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    # ---- data-node side ----------------------------------------------------
+
+    def _handle_shard_query(self, request: dict, source) -> dict:
+        return self._execute_shard(request["index"], request["shard"],
+                                   request["body"])
+
+    def _execute_shard(self, name: str, shard: int, body: dict) -> dict:
+        svc = self.node.indices_service.index(name)
+        engine = svc.engine(shard)
+        reader = device_reader_for(engine)
+        searcher = ShardSearcher(shard, reader, svc.mapper_service)
+        req = parse_search_request(body)
+        result = searcher.query_phase(req)
+        k = min(len(result.doc_ids), req.from_ + req.size)
+        hits = searcher.fetch_phase(req, result, name, list(range(k)))
+        return {"total": result.total,
+                "max_score": (float(result.max_score)
+                              if result.max_score is not None else None),
+                "hits": hits,
+                "aggs": wire_safe(result.agg_partials)}
+
+    # ---- coordinator -------------------------------------------------------
+
+    def _shard_groups(self, state, names: list[str]):
+        """→ [(index, shard, [copies in try-order])] — active copies only,
+        local first, then rotated (preference/rotation,
+        performFirstPhase :156)."""
+        rot = next(self._rotation)
+        groups = []
+        for name in names:
+            meta = state.indices[name]
+            for sid in range(meta.number_of_shards):
+                copies = [c for c in
+                          state.routing_table.shard_copies(name, sid)
+                          if c.active]
+                local = [c for c in copies
+                         if c.node_id == self.node.node_id]
+                rest = [c for c in copies
+                        if c.node_id != self.node.node_id]
+                if rest:
+                    k = rot % len(rest)
+                    rest = rest[k:] + rest[:k]
+                groups.append((name, sid, local + rest))
+        return groups
+
+    def _try_shard(self, state, name: str, sid: int, copies: list,
+                   body: dict):
+        """→ ("ok", payload) or ("fail", reason-dict). Walks the copy list
+        (shard-failover retry, TransportSearchTypeAction.java:205-247)."""
+        from elasticsearch_tpu.action.replication import unwrap_remote
+        from elasticsearch_tpu.common.errors import (
+            IllegalArgumentError, MapperParsingError, QueryParsingError)
+        last: Exception | None = None
+        for c in copies:
+            try:
+                if c.node_id == self.node.node_id:
+                    return "ok", self._execute_shard(name, sid, body)
+                target = state.node(c.node_id)
+                if target is None:
+                    continue
+                return "ok", self.node.transport_service.send_request(
+                    target, self.QUERY_FETCH,
+                    {"index": name, "shard": sid, "body": body},
+                    timeout=30.0).result(35.0)
+            except Exception as e:               # noqa: BLE001 — classify
+                e = unwrap_remote(e)
+                # Deterministic request errors fail the same way on every
+                # copy — abort the whole search with the real status.
+                # Anything else (engine closed mid-relocation, node gone,
+                # state lag) fails over to the next copy.
+                if isinstance(e, (QueryParsingError, IllegalArgumentError,
+                                  MapperParsingError)):
+                    raise e from None
+                last = e
+        fail = {"shard": sid, "index": name,
+                "reason": {"type": "shard_search_failure",
+                           "reason": str(last) if last
+                           else "no active copy"}}
+        if isinstance(last, ElasticsearchTpuError):
+            fail["reason"] = last.to_xcontent()
+            fail["status"] = last.status
+        return "fail", fail
+
+    def search(self, index_expr: str, body: dict | None = None,
+               scroll: str | None = None) -> dict:
+        t0 = time.perf_counter()
+        body = dict(body or {})
+        if scroll is not None:
+            body["sort"] = self._scroll_sort(body.get("sort"))
+        resp = self._search_once(index_expr, body, t0)
+        if scroll is not None:
+            resp["_scroll_id"] = self._open_scroll(index_expr, body, scroll,
+                                                   resp)
+        return resp
+
+    def _search_once(self, index_expr: str, body: dict, t0: float) -> dict:
+        names = self.node.indices_service.resolve(index_expr)
+        state = self.node.cluster_service.state()
+        req = parse_search_request(body)
+        groups = self._shard_groups(state, names)
+        futures = [self._pool.submit(self._try_shard, state, n, s, copies,
+                                     body)
+                   for n, s, copies in groups]
+        payloads, failures = [], []
+        for fut in futures:
+            status, payload = fut.result()
+            if status == "ok":
+                payloads.append(payload)
+            else:
+                failures.append(payload)
+        return merge_shard_payloads(
+            req, payloads, (time.perf_counter() - t0) * 1e3,
+            total_shards=len(groups), failures=failures)
+
+    def count(self, index_expr: str, body: dict | None = None) -> dict:
+        resp = self.search(index_expr, {**(body or {}), "size": 0})
+        return {"count": resp["hits"]["total"]["value"],
+                "_shards": resp["_shards"]}
+
+    # ---- scroll ------------------------------------------------------------
+
+    @staticmethod
+    def _scroll_sort(sort) -> list:
+        """Scroll pages continue via search_after, which needs a total
+        order: append a `_doc` tie-break."""
+        if not sort:
+            sort = [{"_score": {"order": "desc"}}]
+        elif isinstance(sort, (str, dict)):
+            sort = [sort]
+        else:
+            sort = list(sort)
+        if not any((s == "_doc") or (isinstance(s, dict) and "_doc" in s)
+                   for s in sort):
+            sort = sort + [{"_doc": {"order": "asc"}}]
+        return sort
+
+    def _open_scroll(self, index_expr: str, body: dict, scroll: str,
+                     first_page: dict) -> str:
+        keep = parse_time_value(scroll, "scroll")
+        ctx = _ScrollContext(index_expr, body, keep)
+        self._note_page(ctx, first_page)
+        with self._lock:
+            cid = f"ctx{next(self._ctx_ids)}"
+            self._contexts[cid] = ctx
+        return base64.b64encode(json.dumps({"id": cid}).encode()).decode()
+
+    @staticmethod
+    def _note_page(ctx: _ScrollContext, page: dict):
+        hits = page["hits"]["hits"]
+        if not hits:
+            ctx.finished = True
+            return
+        ctx.last_sort_key = hits[-1].get("sort")
+
+    def scroll(self, scroll_id: str, scroll: str | None = None) -> dict:
+        try:
+            cid = json.loads(base64.b64decode(scroll_id))["id"]
+        except Exception:                        # noqa: BLE001 — bad id
+            raise SearchContextMissingError(
+                f"invalid scroll id [{scroll_id}]") from None
+        with self._lock:
+            ctx = self._contexts.get(cid)
+        if ctx is None or ctx.expires_at < time.monotonic():
+            with self._lock:
+                self._contexts.pop(cid, None)
+            raise SearchContextMissingError(f"No search context found for "
+                                            f"id [{cid}]")
+        ctx.touch(parse_time_value(scroll, "scroll")
+                  if scroll is not None else None)
+        if ctx.finished:
+            resp = {"took": 0, "timed_out": False,
+                    "_shards": {"total": 0, "successful": 0, "failed": 0},
+                    "hits": {"total": {"value": 0, "relation": "eq"},
+                             "max_score": None, "hits": []}}
+            resp["_scroll_id"] = scroll_id
+            return resp
+        body = dict(ctx.body)
+        body["from"] = 0
+        if ctx.last_sort_key is not None:
+            body["search_after"] = ctx.last_sort_key
+        resp = self._search_once(ctx.index_expr, body, time.perf_counter())
+        self._note_page(ctx, resp)
+        resp["_scroll_id"] = scroll_id
+        return resp
+
+    def clear_scroll(self, scroll_id: str | None) -> int:
+        with self._lock:
+            if scroll_id is None:
+                n = len(self._contexts)
+                self._contexts.clear()
+                return n
+            try:
+                cid = json.loads(base64.b64decode(scroll_id))["id"]
+            except Exception:                    # noqa: BLE001 — bad id
+                return 0
+            return 1 if self._contexts.pop(cid, None) is not None else 0
+
+    def reap_expired(self) -> int:
+        now = time.monotonic()
+        with self._lock:
+            dead = [k for k, c in self._contexts.items()
+                    if c.expires_at < now]
+            for k in dead:
+                del self._contexts[k]
+        return len(dead)
+
+    def active_contexts(self) -> int:
+        with self._lock:
+            return len(self._contexts)

@@ -389,6 +389,7 @@ class AllocationService:
     def reroute(self, state: ClusterState, reason: str = "") -> ClusterState:
         routing = self._fail_shards_on_missing_nodes(state,
                                                      state.routing_table)
+        routing = self._promote_replicas(routing)
         routing = self._allocate_unassigned(state, routing)
         if routing is state.routing_table:
             return state
@@ -470,6 +471,30 @@ class AllocationService:
                     s.allocation_id is not None):
                 return s
         return None
+
+    @staticmethod
+    def _promote_replicas(routing: RoutingTable) -> RoutingTable:
+        """When a primary copy is unassigned but an active replica exists,
+        swap roles: the replica becomes primary, the unassigned entry
+        becomes a replica slot (reference:
+        RoutingNodes.promoteActiveReplicaShardToPrimary, driven by
+        AllocationService.applyFailedShard — without this a primary loss
+        would re-create an EMPTY primary while live replicas hold the
+        data)."""
+        from dataclasses import replace as _replace
+        groups = {(s.index, s.shard) for s in routing.unassigned()
+                  if s.primary}
+        for index, sid in groups:
+            copies = routing.shard_copies(index, sid)
+            dead = next(c for c in copies if c.primary and not c.assigned)
+            live = [c for c in copies if not c.primary and c.active]
+            if not live:
+                continue
+            routing = routing.replace_shard(
+                live[0], _replace(live[0], primary=True))
+            routing = routing.replace_shard(
+                dead, _replace(dead, primary=False))
+        return routing
 
     def _fail_shards_on_missing_nodes(self, state: ClusterState,
                                       routing: RoutingTable) -> RoutingTable:

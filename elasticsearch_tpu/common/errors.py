@@ -126,3 +126,43 @@ class CircuitBreakingError(ElasticsearchTpuError):
         super().__init__(message)
         self.bytes_wanted = bytes_wanted
         self.bytes_limit = bytes_limit
+
+
+class UnavailableShardsError(ElasticsearchTpuError):
+    """No active copy of the target shard (reference:
+    UnavailableShardsException, raised by TransportReplicationAction when
+    the primary never becomes active within the timeout)."""
+
+    status = 503
+    error_type = "unavailable_shards_exception"
+
+
+class MasterNotDiscoveredError(ElasticsearchTpuError):
+    """No elected master to forward a metadata operation to (reference:
+    MasterNotDiscoveredException, TransportMasterNodeAction.java:50)."""
+
+    status = 503
+    error_type = "master_not_discovered_exception"
+
+
+def _all_subclasses(cls) -> list:
+    out = []
+    for sub in cls.__subclasses__():
+        out.append(sub)
+        out.extend(_all_subclasses(sub))
+    return out
+
+
+def reconstruct_error(py_class_name: str, reason: str) -> ElasticsearchTpuError:
+    """Rebuild a local error instance from a remote failure that crossed
+    the transport as (class name, reason) — the analog of the reference's
+    RemoteTransportException.unwrapCause() so callers (and the REST layer)
+    see the original status/type regardless of which node raised it."""
+    cls = next((c for c in _all_subclasses(ElasticsearchTpuError)
+                if c.__name__ == py_class_name), ElasticsearchTpuError)
+    err = cls.__new__(cls)
+    Exception.__init__(err, reason)
+    err.message = reason
+    err.index = None
+    err.shard = None
+    return err

@@ -165,6 +165,52 @@ class Engine:
             self.stats.index_time_ms += (time.perf_counter() - t0) * 1e3
             return new_version, current == NOT_FOUND
 
+    def index_replica(self, doc_id: str, source: dict, version: int,
+                      routing: str | None = None) -> int:
+        """Apply a replicated index op with the version the primary
+        resolved (TransportShardBulkAction replica path: no version
+        conflict re-check, core/action/bulk/TransportShardBulkAction.java:448).
+        Idempotent: ops at or below the locally known version are skipped,
+        which also dedupes recovery-replay vs. live-replication overlap."""
+        with self._lock:
+            self._ensure_open()
+            entry = self._versions.get(doc_id)
+            if entry is not None and entry.version >= version:
+                return entry.version
+            parsed = self.mapper_service.document_mapper().parse(
+                doc_id, source, routing=routing)
+            old_buf = self._buffer_docs.get(doc_id)
+            if old_buf is not None:
+                self._buffer.docs[old_buf] = None
+            if entry is not None and entry.seg_id >= 0:
+                self._pending_seg_deletes[(entry.seg_id, entry.local_doc)] \
+                    = doc_id
+            local = self._buffer.add(parsed)
+            self._buffer_docs[doc_id] = local
+            self._versions[doc_id] = VersionEntry(version, False, -1, local)
+            self.translog.add(TranslogOp(OP_INDEX, doc_id, version,
+                                         source=source, routing=routing))
+            self.stats.index_total += 1
+            return version
+
+    def delete_replica(self, doc_id: str, version: int) -> int:
+        """Apply a replicated delete with the primary-resolved version."""
+        with self._lock:
+            self._ensure_open()
+            entry = self._versions.get(doc_id)
+            if entry is not None and entry.version >= version:
+                return entry.version
+            if entry is not None and entry.seg_id == -1:
+                self._buffer.docs[entry.local_doc] = None
+                self._buffer_docs.pop(doc_id, None)
+            elif entry is not None and entry.seg_id >= 0:
+                self._pending_seg_deletes[(entry.seg_id, entry.local_doc)] \
+                    = doc_id
+            self._versions[doc_id] = VersionEntry(version, True, -2, -1)
+            self.translog.add(TranslogOp(OP_DELETE, doc_id, version))
+            self.stats.delete_total += 1
+            return version
+
     def delete(self, doc_id: str, version: int = MATCH_ANY,
                from_translog: bool = False) -> int:
         with self._lock:

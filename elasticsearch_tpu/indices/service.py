@@ -163,6 +163,10 @@ class IndicesService:
         self.node_id = node_id
         self.allocation = allocation or AllocationService()
         self.indices: dict[str, IndexService] = {}
+        # Master forwarding seam (TransportMasterNodeAction.java:50): when
+        # set by the Node, metadata mutations on a non-master route to the
+        # elected master; signature (action, request, local_fn) → result.
+        self.master_executor = None
         # allocation ids this node has already reported as started
         self._reported_started: set[str] = set()
         # Node wires this to the ShardStateAction path:
@@ -245,9 +249,19 @@ class IndicesService:
 
     # ---- metadata CRUD (MetaDataCreateIndexService analog) ----------------
 
+    def _master_op(self, action: str, request: dict, local):
+        if self.master_executor is not None:
+            return self.master_executor(action, request, local)
+        return local()
+
     def create_index(self, name: str,
                      body: dict | None = None) -> IndexService | None:
         body = body or {}
+        return self._master_op("create-index", {"name": name, "body": body},
+                               lambda: self._create_index_local(name, body))
+
+    def _create_index_local(self, name: str,
+                            body: dict) -> IndexService | None:
         if not name or name.startswith(("_", "-")) or name != name.lower() \
                 or any(c in name for c in ' "\\/,|<>?*'):
             raise IllegalArgumentError(f"invalid index name [{name}]")
@@ -299,6 +313,10 @@ class IndicesService:
         return self.indices.get(name)
 
     def delete_index(self, name: str) -> None:
+        self._master_op("delete-index", {"name": name},
+                        lambda: self._delete_index_local(name))
+
+    def _delete_index_local(self, name: str) -> None:
         def update(state: ClusterState) -> ClusterState:
             names = self._resolve(state, name)
             indices = dict(state.indices)
@@ -310,6 +328,13 @@ class IndicesService:
         self.cluster_service.submit_and_wait(f"delete-index [{name}]", update)
 
     def put_mapping(self, name: str, type_name: str, mapping: dict) -> None:
+        self._master_op(
+            "put-mapping",
+            {"name": name, "type": type_name, "mapping": mapping},
+            lambda: self._put_mapping_local(name, type_name, mapping))
+
+    def _put_mapping_local(self, name: str, type_name: str,
+                           mapping: dict) -> None:
         def update(state: ClusterState) -> ClusterState:
             if name not in state.indices:
                 raise IndexNotFoundError(name)
@@ -336,6 +361,11 @@ class IndicesService:
     def update_settings(self, name: str, settings: dict) -> None:
         """Per-index dynamic settings (IndexSettingsService analog);
         number_of_replicas changes resize the routing table."""
+        self._master_op(
+            "update-settings", {"name": name, "settings": settings},
+            lambda: self._update_settings_local(name, settings))
+
+    def _update_settings_local(self, name: str, settings: dict) -> None:
         def update(state: ClusterState) -> ClusterState:
             new_indices = dict(state.indices)
             routing = state.routing_table
@@ -358,6 +388,12 @@ class IndicesService:
                                              update)
 
     def put_alias(self, index: str, alias: str, body: dict | None = None):
+        self._master_op(
+            "put-alias", {"index": index, "alias": alias, "body": body},
+            lambda: self._put_alias_local(index, alias, body))
+
+    def _put_alias_local(self, index: str, alias: str,
+                         body: dict | None = None):
         def update(state: ClusterState) -> ClusterState:
             if index not in state.indices:
                 raise IndexNotFoundError(index)
@@ -369,6 +405,11 @@ class IndicesService:
         self.cluster_service.submit_and_wait(f"put-alias [{alias}]", update)
 
     def delete_alias(self, index: str, alias: str):
+        self._master_op(
+            "delete-alias", {"index": index, "alias": alias},
+            lambda: self._delete_alias_local(index, alias))
+
+    def _delete_alias_local(self, index: str, alias: str):
         def update(state: ClusterState) -> ClusterState:
             if index not in state.indices:
                 raise IndexNotFoundError(index)

@@ -19,10 +19,7 @@ from elasticsearch_tpu.cluster.allocation import AllocationService
 from elasticsearch_tpu.cluster.service import URGENT, ClusterService
 from elasticsearch_tpu.cluster.state import (
     ClusterState, IndexMetadata, RoutingTable)
-from elasticsearch_tpu.common.errors import DocumentMissingError
 from elasticsearch_tpu.common.settings import Settings
-from elasticsearch_tpu.index.engine import MATCH_ANY
-from elasticsearch_tpu.search.service import SearchService
 from elasticsearch_tpu.transport import (
     DiscoveryNode, LocalTransport, LocalTransportHub, TransportService)
 
@@ -71,7 +68,17 @@ class Node:
             self.SHARD_STARTED_ACTION, self._handle_shard_started, sync=True)
         self.transport_service.register_request_handler(
             self.SHARD_FAILED_ACTION, self._handle_shard_failed, sync=True)
-        self.search_service = SearchService()
+        # master-forwarding seam (TransportMasterNodeAction analog)
+        self.indices_service.master_executor = self._execute_master_action
+        self.transport_service.register_request_handler(
+            self.MASTER_FORWARD_ACTION, self._handle_master_forward,
+            executor="management", sync=True)
+        # distributed action layer (core/action/)
+        from elasticsearch_tpu.action import (
+            BroadcastActions, DocumentActions, SearchActions)
+        self.document_actions = DocumentActions(self)
+        self.search_actions = SearchActions(self)
+        self.broadcast_actions = BroadcastActions(self)
         self._delayed_reroute_timer = None
         self.cluster_service.add_listener(self._schedule_delayed_reroute)
         from elasticsearch_tpu.discovery import ZenDiscovery
@@ -113,6 +120,109 @@ class Node:
             templates={**raw.get("templates", {}), **state.templates},
             persistent_settings={**raw.get("persistent_settings", {}),
                                  **state.persistent_settings})
+
+    # ---- master forwarding (TransportMasterNodeAction.java:50) -------------
+
+    MASTER_FORWARD_ACTION = "cluster:admin/forward"
+
+    def _execute_master_action(self, action: str, request: dict, local):
+        """Run a metadata op on the elected master: locally when we are it,
+        else forward over the transport and wait for the ack (the published
+        state reaches us before the master responds, because publish acks
+        gate the response — PublishClusterStateAction two-phase commit)."""
+        from elasticsearch_tpu.action.replication import unwrap_remote
+        from elasticsearch_tpu.common.errors import MasterNotDiscoveredError
+        from elasticsearch_tpu.transport.service import (
+            RemoteTransportError, TransportException)
+        deadline = time.monotonic() + 30.0
+        while True:
+            state = self.cluster_service.state()
+            if state.master_node_id == self.node_id or \
+                    state.master_node is None and not self._started:
+                return local()
+            master = state.master_node
+            if master is None:
+                if time.monotonic() > deadline:
+                    raise MasterNotDiscoveredError(
+                        f"no master to forward [{action}] to")
+                time.sleep(0.05)
+                continue
+            try:
+                self.transport_service.send_request(
+                    master, self.MASTER_FORWARD_ACTION,
+                    {"action": action, "request": request},
+                    timeout=30.0).result(35.0)
+                return None
+            except Exception as e:               # noqa: BLE001 — unwrap
+                if isinstance(e, TransportException) and \
+                        not isinstance(e, RemoteTransportError):
+                    # master died mid-request: retry across elections
+                    if time.monotonic() > deadline:
+                        raise MasterNotDiscoveredError(
+                            f"[{action}] failed: {e}") from None
+                    time.sleep(0.1)
+                    continue
+                raise unwrap_remote(e) from None
+
+    def _handle_master_forward(self, request: dict, source) -> dict:
+        isvc = self.indices_service
+        action, req = request["action"], request["request"]
+        dispatch = {
+            "create-index": lambda: isvc.create_index(req["name"],
+                                                      req["body"]),
+            "delete-index": lambda: isvc.delete_index(req["name"]),
+            "put-mapping": lambda: isvc.put_mapping(req["name"], req["type"],
+                                                    req["mapping"]),
+            "update-settings": lambda: isvc.update_settings(req["name"],
+                                                            req["settings"]),
+            "put-alias": lambda: isvc.put_alias(req["index"], req["alias"],
+                                                req.get("body")),
+            "delete-alias": lambda: isvc.delete_alias(req["index"],
+                                                      req["alias"]),
+            "put-template": lambda: self.put_template(req["name"],
+                                                      req["body"]),
+            "delete-template": lambda: self.delete_template(req["name"]),
+            "cluster-settings": lambda: self.update_cluster_settings(
+                req["body"]),
+        }
+        fn = dispatch.get(action)
+        if fn is None:
+            raise ValueError(f"unknown master action [{action}]")
+        fn()
+        return {"acknowledged": True}
+
+    # ---- cluster-level metadata (master ops) -------------------------------
+
+    def put_template(self, name: str, body: dict) -> None:
+        self.indices_service._master_op(
+            "put-template", {"name": name, "body": body},
+            lambda: self.cluster_service.submit_and_wait(
+                f"put-template [{name}]",
+                lambda st: st.with_(templates={**st.templates, name: body})))
+
+    def delete_template(self, name: str) -> None:
+        self.indices_service._master_op(
+            "delete-template", {"name": name},
+            lambda: self.cluster_service.submit_and_wait(
+                f"delete-template [{name}]",
+                lambda st: st.with_(templates={
+                    k: v for k, v in st.templates.items() if k != name})))
+
+    def update_cluster_settings(self, body: dict) -> None:
+        """PUT /_cluster/settings — persistent + transient scopes stored in
+        cluster state (DynamicSettings / NodeSettingsService analog)."""
+        def local():
+            def update(st: ClusterState) -> ClusterState:
+                persistent = {**st.persistent_settings,
+                              **Settings(body.get("persistent",
+                                                  {})).as_dict()}
+                transient = {**st.transient_settings,
+                             **Settings(body.get("transient", {})).as_dict()}
+                return st.with_(persistent_settings=persistent,
+                                transient_settings=transient)
+            self.cluster_service.submit_and_wait("cluster-settings", update)
+        self.indices_service._master_op("cluster-settings", {"body": body},
+                                        local)
 
     # ---- ShardStateAction (core/cluster/action/shard/ShardStateAction.java)
 
@@ -256,6 +366,7 @@ class Node:
             self._started = False
             if self._delayed_reroute_timer is not None:
                 self._delayed_reroute_timer.cancel()
+            self.search_actions.close()
             self.discovery.stop()
             self.indices_service.close()
             self.cluster_service.close()
@@ -287,160 +398,42 @@ class Node:
     def index_doc(self, index: str, doc_id: str | None, source: dict,
                   routing: str | None = None, version: int | None = None,
                   op_type: str = "index", refresh: bool = False) -> dict:
-        svc = self.indices_service.index(index) if \
-            self.indices_service.has_index(index) else \
-            self.indices_service.create_index(index)  # auto-create
-        created_id = doc_id or uuid.uuid4().hex[:20]
-        engine = svc.shard_for(created_id, routing)
-        v, created = engine.index(
-            created_id, source,
-            version=MATCH_ANY if version is None else version,
-            routing=routing, op_type=op_type)
-        if refresh:
-            engine.refresh()
-        return {
-            "_index": svc.name, "_type": "_doc", "_id": created_id,
-            "_version": v,
-            "result": "created" if created else "updated",
-            "created": created,
-            "_shards": {"total": 1, "successful": 1, "failed": 0},
-        }
+        return self.document_actions.index_doc(
+            index, doc_id, source, routing=routing, version=version,
+            op_type=op_type, refresh=refresh)
 
     def get_doc(self, index: str, doc_id: str,
                 routing: str | None = None) -> dict:
-        svc = self.indices_service.index(index)
-        r = svc.shard_for(doc_id, routing).get(doc_id)
-        out = {"_index": svc.name, "_type": "_doc", "_id": doc_id,
-               "found": r.found}
-        if r.found:
-            out["_version"] = r.version
-            out["_source"] = r.source
-        return out
+        return self.document_actions.get_doc(index, doc_id, routing=routing)
 
     def delete_doc(self, index: str, doc_id: str,
                    routing: str | None = None, version: int | None = None,
                    refresh: bool = False) -> dict:
-        svc = self.indices_service.index(index)
-        engine = svc.shard_for(doc_id, routing)
-        v = engine.delete(doc_id,
-                          version=MATCH_ANY if version is None else version)
-        if refresh:
-            engine.refresh()
-        return {"_index": svc.name, "_type": "_doc", "_id": doc_id,
-                "_version": v, "result": "deleted", "found": True,
-                "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        return self.document_actions.delete_doc(
+            index, doc_id, routing=routing, version=version, refresh=refresh)
 
     def update_doc(self, index: str, doc_id: str, body: dict,
                    routing: str | None = None, refresh: bool = False) -> dict:
-        """Get-modify-reindex on the primary (TransportUpdateAction)."""
-        svc = self.indices_service.index(index)
-        engine = svc.shard_for(doc_id, routing)
-        current = engine.get(doc_id)
-        if not current.found:
-            if "upsert" in body:
-                return self.index_doc(index, doc_id, body["upsert"],
-                                      routing=routing, refresh=refresh)
-            raise DocumentMissingError(index, doc_id)
-        if "doc" in body:
-            merged = _deep_merge(dict(current.source), body["doc"])
-        elif "script" in body:
-            merged = _apply_update_script(dict(current.source), body["script"])
-        else:
-            merged = dict(current.source)
-        v, _ = engine.index(doc_id, merged, version=current.version,
-                            routing=routing)
-        if refresh:
-            engine.refresh()
-        return {"_index": svc.name, "_type": "_doc", "_id": doc_id,
-                "_version": v, "result": "updated"}
+        return self.document_actions.update_doc(
+            index, doc_id, body, routing=routing, refresh=refresh)
 
     def mget(self, body: dict, default_index: str | None = None) -> dict:
-        docs = []
-        for spec in body.get("docs", []):
-            idx = spec.get("_index", default_index)
-            docs.append(self.get_doc(idx, spec["_id"],
-                                     routing=spec.get("routing")))
-        if "ids" in body and default_index:
-            for did in body["ids"]:
-                docs.append(self.get_doc(default_index, str(did)))
-        return {"docs": docs}
-
-    # ---- bulk (TransportBulkAction: split per shard, apply per item) -------
+        return self.document_actions.mget(body, default_index)
 
     def bulk(self, operations: list[tuple[str, dict, dict | None]],
              refresh: bool = False) -> dict:
         """operations: (action, metadata, source) triples, pre-parsed from
         NDJSON by the REST layer or built by the client."""
-        items = []
-        errors = False
-        touched: set[tuple[str, int]] = set()
-        for action, meta, source in operations:
-            index = meta.get("_index")
-            doc_id = meta.get("_id")
-            routing = meta.get("routing", meta.get("_routing"))
-            try:
-                if action in ("index", "create"):
-                    r = self.index_doc(index, doc_id, source, routing=routing,
-                                       op_type="create" if action == "create"
-                                       else "index")
-                    status = 201 if r["created"] else 200
-                elif action == "delete":
-                    r = self.delete_doc(index, doc_id, routing=routing)
-                    status = 200
-                elif action == "update":
-                    r = self.update_doc(index, doc_id, source or {},
-                                        routing=routing)
-                    status = 200
-                else:
-                    raise ValueError(f"unknown bulk action [{action}]")
-                items.append({action: {**r, "status": status}})
-            except Exception as e:  # per-item failure (bulk continues)
-                errors = True
-                from elasticsearch_tpu.common.errors import ElasticsearchTpuError
-                err = e.to_xcontent() if isinstance(e, ElasticsearchTpuError) \
-                    else {"type": "exception", "reason": str(e)}
-                status = e.status if isinstance(e, ElasticsearchTpuError) else 500
-                items.append({action: {"_index": index, "_id": doc_id,
-                                       "error": err, "status": status}})
-        if refresh:
-            for name in {m.get("_index") for _, m, _ in operations if m}:
-                if name and self.indices_service.has_index(name):
-                    self.indices_service.index(name).refresh()
-        return {"took": 0, "errors": errors, "items": items}
+        return self.document_actions.bulk(operations, refresh=refresh)
 
     # ---- search entry ------------------------------------------------------
 
     def search(self, index: str, body: dict | None = None,
                scroll: str | None = None) -> dict:
-        names = self.indices_service.resolve(index)
-        if len(names) == 1:
-            return self.search_service.search(
-                self.indices_service.index(names[0]), body, scroll=scroll)
-        # multi-index search: run per index and merge (coordinator behavior)
-        from elasticsearch_tpu.search.controller import merge_responses
-        from elasticsearch_tpu.search.phase import parse_search_request
-        req = parse_search_request(body)
-        all_results, all_searchers, idx_of = [], [], []
-        t0 = time.perf_counter()
-        for n in names:
-            svc = self.indices_service.index(n)
-            searchers = self.search_service._searchers(svc)
-            for s in searchers:
-                all_searchers.append((n, s))
-                all_results.append(s.query_phase(req))
-        class _SearcherProxy:
-            def __init__(self, name, s):
-                self.name, self.s = name, s
-            def fetch_phase(self, req, result, index_name, positions):
-                return self.s.fetch_phase(req, result, self.name, positions)
-        proxies = [_SearcherProxy(n, s) for n, s in all_searchers]
-        return merge_responses("", req, all_results, proxies,
-                               (time.perf_counter() - t0) * 1e3, req.aggs)
+        return self.search_actions.search(index, body, scroll=scroll)
 
     def count(self, index: str, body: dict | None = None) -> dict:
-        resp = self.search(index, {**(body or {}), "size": 0})
-        return {"count": resp["hits"]["total"]["value"],
-                "_shards": resp["_shards"]}
+        return self.search_actions.count(index, body)
 
 
 def _nodes_predicate(expr, actual: int) -> bool:

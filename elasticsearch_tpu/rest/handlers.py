@@ -164,30 +164,23 @@ class Handlers:
         return 404, {}
 
     def refresh(self, req: RestRequest):
-        for n in self.node.indices_service.resolve(req.path_params["index"]):
-            self.node.indices_service.index(n).refresh()
-        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+        return 200, self.node.broadcast_actions.refresh(
+            req.path_params["index"])
 
     def refresh_all(self, req: RestRequest):
-        for svc in self.node.indices_service.indices.values():
-            svc.refresh()
-        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+        return 200, self.node.broadcast_actions.refresh("_all")
 
     def flush(self, req: RestRequest):
-        for n in self.node.indices_service.resolve(req.path_params["index"]):
-            self.node.indices_service.index(n).flush()
-        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+        return 200, self.node.broadcast_actions.flush(
+            req.path_params["index"])
 
     def flush_all(self, req: RestRequest):
-        for svc in self.node.indices_service.indices.values():
-            svc.flush()
-        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+        return 200, self.node.broadcast_actions.flush("_all")
 
     def force_merge(self, req: RestRequest):
         max_seg = req.param_as_int("max_num_segments", 1)
-        for n in self.node.indices_service.resolve(req.path_params["index"]):
-            self.node.indices_service.index(n).force_merge(max_seg)
-        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+        return 200, self.node.broadcast_actions.force_merge(
+            req.path_params["index"], max_seg)
 
     def open_index(self, req: RestRequest):
         return 200, {"acknowledged": True}
@@ -267,10 +260,7 @@ class Handlers:
         name = req.path_params["name"]
         body = req.body or {}
 
-        def update(state):
-            return state.with_(templates={**state.templates, name: body})
-        self.node.cluster_service.submit_state_update(
-            f"put-template [{name}]", update)
+        self.node.put_template(name, body)
         return 200, {"acknowledged": True}
 
     def get_template(self, req: RestRequest):
@@ -286,11 +276,7 @@ class Handlers:
     def delete_template(self, req: RestRequest):
         name = req.path_params["name"]
 
-        def update(state):
-            t = {k: v for k, v in state.templates.items() if k != name}
-            return state.with_(templates=t)
-        self.node.cluster_service.submit_state_update(
-            f"delete-template [{name}]", update)
+        self.node.delete_template(name)
         return 200, {"acknowledged": True}
 
     # ---- documents --------------------------------------------------------
@@ -442,16 +428,16 @@ class Handlers:
     def scroll(self, req: RestRequest):
         body = req.body or {}
         scroll_id = body.get("scroll_id", req.param("scroll_id"))
-        return 200, self.node.search_service.scroll(
-            self.node.indices_service, scroll_id, body.get("scroll"))
+        return 200, self.node.search_actions.scroll(
+            scroll_id, body.get("scroll"))
 
     def clear_scroll(self, req: RestRequest):
         body = req.body or {}
         sid = body.get("scroll_id")
         if isinstance(sid, list):
-            n = sum(self.node.search_service.clear_scroll(s) for s in sid)
+            n = sum(self.node.search_actions.clear_scroll(s) for s in sid)
         else:
-            n = self.node.search_service.clear_scroll(sid)
+            n = self.node.search_actions.clear_scroll(sid)
         return 200, {"succeeded": True, "num_freed": n}
 
     def validate_query(self, req: RestRequest):
@@ -550,7 +536,12 @@ class Handlers:
         return 200, {"persistent": {}, "transient": {}}
 
     def put_cluster_settings(self, req: RestRequest):
-        return 200, {"acknowledged": True, "persistent": {}, "transient": {}}
+        body = req.body or {}
+        self.node.update_cluster_settings(body)
+        st = self.node.cluster_service.state()
+        return 200, {"acknowledged": True,
+                     "persistent": st.persistent_settings,
+                     "transient": st.transient_settings}
 
     def nodes_info(self, req: RestRequest):
         state = self.node.cluster_service.state()

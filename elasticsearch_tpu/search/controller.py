@@ -40,19 +40,29 @@ def sort_docs(results: list[ShardQueryResult],
                 else None))
     if not refs:
         return []
-    if refs[0].sort_values is not None:
-        import functools
-        orders = [(list(spec.values())[0].get("order", "asc")) == "desc"
-                  for spec in req.sort]
-        missing_first = [(list(spec.values())[0].get("missing", "_last"))
-                         == "_first" for spec in req.sort]
+    keyfn = _hit_comparator(req)
+    refs.sort(key=lambda r: keyfn((r.sort_values, r.score, r.shard_idx,
+                                   r.position)))
+    return refs[req.from_: req.from_ + req.size]
 
-        def cmp_refs(a: MergedHitRef, b: MergedHitRef) -> int:
-            for va, vb, desc, mfirst in zip(a.sort_values, b.sort_values,
-                                            orders, missing_first):
+
+def _hit_comparator(req: ParsedSearchRequest):
+    """Ordering over (sort_values | score, shard_idx, position) tuples —
+    shared by the in-process and the serialized (distributed) merges."""
+    import functools
+    orders = [(list(spec.values())[0].get("order", "asc")) == "desc"
+              for spec in req.sort]
+    missing_first = [(list(spec.values())[0].get("missing", "_last"))
+                     == "_first" for spec in req.sort]
+
+    def cmp_entries(a, b) -> int:
+        # entry: (sort_values|None, score|None, shard_idx, position)
+        if a[0] is not None:
+            for va, vb, desc, mfirst in zip(a[0], b[0], orders,
+                                            missing_first):
                 if va == vb:
                     continue
-                if va is None:   # missing placement per the sort spec
+                if va is None:
                     return -1 if mfirst else 1
                 if vb is None:
                     return 1 if mfirst else -1
@@ -60,14 +70,55 @@ def sort_docs(results: list[ShardQueryResult],
                     va, vb = str(va), str(vb)
                 c = 1 if va > vb else -1
                 return -c if desc else c
-            return -1 if (a.shard_idx, a.position) < (b.shard_idx, b.position) \
-                else 1
-        refs.sort(key=functools.cmp_to_key(cmp_refs))
-    else:
-        # stable sort keeps (shard order, position) for ties — TopDocs.merge
-        refs.sort(key=lambda r: (-(r.score if r.score is not None else -np.inf),
-                                 r.shard_idx, r.position))
-    return refs[req.from_: req.from_ + req.size]
+            return -1 if (a[2], a[3]) < (b[2], b[3]) else 1
+        sa = a[1] if a[1] is not None else -np.inf
+        sb = b[1] if b[1] is not None else -np.inf
+        if sa != sb:
+            return -1 if sa > sb else 1
+        return -1 if (a[2], a[3]) < (b[2], b[3]) else 1
+
+    return functools.cmp_to_key(cmp_entries)
+
+
+def merge_shard_payloads(req: ParsedSearchRequest, payloads: list[dict],
+                         took_ms: float, total_shards: int,
+                         failures: list[dict]) -> dict:
+    """Reduce serialized per-shard query+fetch payloads
+    ({total, max_score, hits, aggs}) arriving over the transport — the
+    distributed twin of :func:`merge_responses`
+    (SearchPhaseController.merge :300-431)."""
+    entries = []
+    for si, p in enumerate(payloads):
+        for pos, hit in enumerate(p["hits"]):
+            entries.append((hit.get("sort") if req.sort else None,
+                            hit.get("_score"), si, pos, hit))
+    keyfn = _hit_comparator(req)
+    entries.sort(key=lambda e: keyfn((e[0], e[1], e[2], e[3])))
+    page = entries[req.from_: req.from_ + req.size]
+
+    total = sum(p["total"] for p in payloads)
+    max_scores = [p["max_score"] for p in payloads
+                  if p.get("max_score") is not None]
+    max_score = max(max_scores) if max_scores and req.size > 0 \
+        and not req.sort else None
+    shards = {"total": total_shards, "successful": len(payloads),
+              "skipped": 0, "failed": len(failures)}
+    if failures:
+        shards["failures"] = failures
+    response = {
+        "took": int(took_ms),
+        "timed_out": False,
+        "_shards": shards,
+        "hits": {
+            "total": {"value": total, "relation": "eq"},
+            "max_score": max_score,
+            "hits": [e[4] for e in page],
+        },
+    }
+    if req.aggs:
+        response["aggregations"] = reduce_aggs(
+            req.aggs, [p["aggs"] for p in payloads])
+    return response
 
 
 def merge_responses(index_name: str, req: ParsedSearchRequest,

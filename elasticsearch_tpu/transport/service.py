@@ -154,6 +154,13 @@ class TransportService:
         self._executor = executor or ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="transport")
         self._owns_executor = executor is None
+        # Named per-workload pools (ThreadPool.java:70-129: index/bulk/
+        # search/management...). Handlers that BLOCK on further RPCs (e.g.
+        # a primary waiting for replica acks) must not share a pool with
+        # the handlers they wait on, or two nodes writing to each other
+        # deadlock when one pool saturates.
+        self._pools: dict[str, ThreadPoolExecutor] = {}
+        self._pools_lock = threading.Lock()
         self.tracers: list[Callable[[str, str, str], None]] = []
         self._closed = False
         transport.bind(self)
@@ -178,6 +185,10 @@ class TransportService:
         self.transport.close()
         if self._owns_executor:
             self._executor.shutdown(wait=False, cancel_futures=True)
+        with self._pools_lock:
+            for pool in self._pools.values():
+                pool.shutdown(wait=False, cancel_futures=True)
+            self._pools.clear()
 
     # ---- registry ----------------------------------------------------------
 
@@ -250,8 +261,10 @@ class TransportService:
 
         if reg.executor == "same" or self._closed:
             run()
-        else:
+        elif reg.executor == "generic":
             self._executor.submit(run)
+        else:
+            self._pool_for(reg.executor).submit(run)
 
     def on_response(self, request_id: int, payload: bytes | None,
                     error: tuple[str, str] | None,
@@ -291,6 +304,15 @@ class TransportService:
             out.write_value(response)
             self.transport.send_response(to_node, request_id, out.bytes(),
                                          None)
+
+    def _pool_for(self, name: str) -> ThreadPoolExecutor:
+        with self._pools_lock:
+            pool = self._pools.get(name)
+            if pool is None:
+                pool = ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix=f"transport-{name}")
+                self._pools[name] = pool
+            return pool
 
     def _complete(self, request_id: int, response: dict | None,
                   error: Exception | None) -> None:

@@ -1,0 +1,218 @@
+"""Distributed action layer tests: write replication, routed reads,
+scatter-gather search over the transport.
+
+Reference test tier: ESIntegTestCase suites exercising
+TransportReplicationAction / TransportSearchTypeAction behavior
+(core/action/support/replication/, §3.2/§3.3 of SURVEY.md).
+"""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.testing import InternalTestCluster
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    with InternalTestCluster(3, base_path=tmp_path) as c:
+        c.wait_for_nodes(3)
+        yield c
+
+
+def _spread_index(c, name="docs", shards=4, replicas=1):
+    master = c.master()
+    master.indices_service.create_index(name, {"settings": {
+        "number_of_shards": shards, "number_of_replicas": replicas}})
+    c.wait_for_health("green" if replicas else "yellow")
+    if replicas:
+        c.wait_for_health("green")
+    return master
+
+
+def test_write_from_any_node_routes_to_primary(cluster3):
+    c = cluster3
+    _spread_index(c, shards=4, replicas=0)
+    st = c.master().cluster_service.state()
+    assert len({s.node_id for s in st.routing_table.shards}) > 1
+    coordinator = c.non_masters()[0]
+    for i in range(20):
+        r = coordinator.index_doc("docs", str(i), {"title": f"doc {i}",
+                                                   "n": i})
+        assert r["_shards"]["failed"] == 0
+    coordinator.broadcast_actions.refresh("docs")
+    # every node sees every doc via distributed search
+    for n in c.nodes:
+        resp = n.search("docs", {"query": {"match_all": {}}, "size": 50})
+        assert resp["hits"]["total"]["value"] == 20
+        assert resp["_shards"]["failed"] == 0
+        assert resp["_shards"]["total"] == 4
+
+
+def test_get_routed_across_nodes(cluster3):
+    c = cluster3
+    _spread_index(c, shards=4, replicas=0)
+    writer = c.nodes[1]
+    for i in range(10):
+        writer.index_doc("docs", str(i), {"n": i})
+    for n in c.nodes:
+        for i in range(10):
+            g = n.get_doc("docs", str(i))
+            assert g["found"] and g["_source"]["n"] == i
+
+
+def test_replicas_receive_ops_and_serve_after_primary_loss(cluster3):
+    c = cluster3
+    _spread_index(c, shards=2, replicas=2)    # every node holds every shard
+    m = c.master()
+    for i in range(30):
+        m.index_doc("docs", str(i), {"title": f"event {i}", "n": i})
+    m.broadcast_actions.refresh("docs")
+    victim = c.non_masters()[0]
+    c.stop_node(victim, graceful=False)
+    c.wait_for_nodes(2)
+    c.wait_for_health("yellow")
+    survivor = c.nodes[0]
+    # replicas were kept in sync synchronously → zero loss
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        resp = survivor.search("docs", {"query": {"match_all": {}},
+                                        "size": 50})
+        if resp["hits"]["total"]["value"] == 30 and \
+                resp["_shards"]["failed"] == 0:
+            break
+        time.sleep(0.2)
+    assert resp["hits"]["total"]["value"] == 30
+    for i in range(30):
+        assert survivor.get_doc("docs", str(i))["found"]
+
+
+def test_bulk_spread_shards(cluster3):
+    c = cluster3
+    _spread_index(c, shards=4, replicas=1)
+    coord = c.non_masters()[-1]
+    ops = [("index", {"_index": "docs", "_id": str(i)}, {"n": i})
+           for i in range(40)]
+    resp = coord.bulk(ops, refresh=True)
+    assert not resp["errors"]
+    assert len(resp["items"]) == 40
+    # per-item responses arrive in submission order
+    assert [it["index"]["_id"] for it in resp["items"]] == \
+        [str(i) for i in range(40)]
+    total = coord.count("docs")["count"]
+    assert total == 40
+    # delete + update through bulk from another node
+    resp2 = c.nodes[0].bulk(
+        [("delete", {"_index": "docs", "_id": "0"}, None),
+         ("update", {"_index": "docs", "_id": "1"}, {"doc": {"n": 100}})],
+        refresh=True)
+    assert not resp2["errors"]
+    assert c.nodes[1].get_doc("docs", "1")["_source"]["n"] == 100
+    assert not c.nodes[1].get_doc("docs", "0")["found"]
+
+
+def test_metadata_ops_forward_to_master(cluster3):
+    c = cluster3
+    non_master = c.non_masters()[0]
+    non_master.indices_service.create_index("fwd", {"settings": {
+        "number_of_shards": 2, "number_of_replicas": 0}})
+    c.wait_converged_version()
+    assert "fwd" in c.master().cluster_service.state().indices
+    # mapping + alias + template through the forwarding path
+    non_master.indices_service.put_mapping("fwd", "_doc", {"properties": {
+        "tag": {"type": "keyword"}}})
+    non_master.indices_service.put_alias("fwd", "fwd-alias")
+    non_master.put_template("tpl1", {"index_patterns": ["zzz-*"],
+                                     "settings": {"number_of_shards": 1}})
+    c.wait_converged_version()
+    st = c.master().cluster_service.state()
+    assert "tag" in st.indices["fwd"].mappings["_doc"]["properties"]
+    assert "fwd-alias" in st.indices["fwd"].aliases
+    assert "tpl1" in st.templates
+    non_master.indices_service.delete_index("fwd")
+    c.wait_converged_version()
+    assert "fwd" not in c.master().cluster_service.state().indices
+
+
+def test_distributed_scroll(cluster3):
+    c = cluster3
+    _spread_index(c, shards=3, replicas=0)
+    coord = c.non_masters()[0]
+    for i in range(25):
+        coord.index_doc("docs", str(i), {"n": i})
+    coord.broadcast_actions.refresh("docs")
+    r = coord.search("docs", {"query": {"match_all": {}}, "size": 10},
+                     scroll="1m")
+    seen = [h["_id"] for h in r["hits"]["hits"]]
+    sid = r["_scroll_id"]
+    for _ in range(10):
+        r = coord.search_actions.scroll(sid)
+        if not r["hits"]["hits"]:
+            break
+        seen += [h["_id"] for h in r["hits"]["hits"]]
+    assert sorted(seen, key=int) == [str(i) for i in range(25)]
+    assert len(set(seen)) == 25
+
+
+def test_distributed_aggregations(cluster3):
+    c = cluster3
+    master = c.master()
+    master.indices_service.create_index("docs", {
+        "settings": {"number_of_shards": 4, "number_of_replicas": 0},
+        "mappings": {"properties": {"group": {"type": "keyword"},
+                                    "v": {"type": "integer"}}}})
+    c.wait_for_health("green")
+    coord = c.nodes[2]
+    for i in range(24):
+        coord.index_doc("docs", str(i), {"group": f"g{i % 3}", "v": i})
+    coord.broadcast_actions.refresh("docs")
+    resp = coord.search("docs", {"size": 0, "aggs": {
+        "by_group": {"terms": {"field": "group"}},
+        "total_v": {"sum": {"field": "v"}}}})
+    assert resp["aggregations"]["total_v"]["value"] == sum(range(24))
+    buckets = {b["key"]: b["doc_count"]
+               for b in resp["aggregations"]["by_group"]["buckets"]}
+    assert buckets == {"g0": 8, "g1": 8, "g2": 8}
+
+
+def test_version_conflict_travels_the_wire(cluster3):
+    c = cluster3
+    _spread_index(c, shards=2, replicas=0)
+    from elasticsearch_tpu.common.errors import VersionConflictError
+    writer = c.nodes[0]
+    other = c.nodes[2]
+    for i in range(8):
+        writer.index_doc("docs", str(i), {"n": 1})
+    with pytest.raises(VersionConflictError):
+        # at least one of these ids lives on a remote primary
+        for i in range(8):
+            other.index_doc("docs", str(i), {"n": 2}, version=99)
+
+
+def test_concurrent_cross_writes_no_deadlock(cluster3):
+    """Two nodes writing to each other's primaries concurrently must not
+    deadlock the transport pools (primary handlers block on replica acks;
+    they run on distinct executors — ThreadPool.java:70-129 rationale)."""
+    import threading
+    c = cluster3
+    _spread_index(c, shards=4, replicas=1)
+    errs = []
+
+    def writer(node, lo):
+        try:
+            for i in range(lo, lo + 20):
+                r = node.index_doc("docs", str(i), {"n": i})
+                assert r["_shards"]["failed"] == 0
+        except Exception as e:                   # noqa: BLE001 — collect
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(n, k * 100))
+               for k, n in enumerate(c.nodes)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "writer thread hung — pool deadlock"
+    assert not errs, errs
+    c.nodes[0].broadcast_actions.refresh("docs")
+    assert c.nodes[0].count("docs")["count"] == 60

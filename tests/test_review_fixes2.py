@@ -26,7 +26,7 @@ def test_scroll_advances_through_tied_scores(node):
     seen = [h["_id"] for h in r["hits"]["hits"]]
     sid = r["_scroll_id"]
     for _ in range(10):
-        r = node.search_service.scroll(node.indices_service, sid)
+        r = node.search_actions.scroll(sid)
         if not r["hits"]["hits"]:
             break
         seen += [h["_id"] for h in r["hits"]["hits"]]
@@ -133,7 +133,7 @@ def test_scroll_preserves_score_order(sales_node):
     assert scores == sorted(scores, reverse=True)
     sid = r["_scroll_id"]
     while True:
-        r = sales_node.search_service.scroll(sales_node.indices_service, sid)
+        r = sales_node.search_actions.scroll(sid)
         if not r["hits"]["hits"]:
             break
         ids += [h["_id"] for h in r["hits"]["hits"]]
