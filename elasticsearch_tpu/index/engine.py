@@ -106,6 +106,10 @@ class Engine:
         self.stats = EngineStats()
         self._lock = threading.RLock()
         self._closed = False
+        # set by the peer-recovery target for the duration of a recovery:
+        # a flush would overwrite the commit the source just streamed in
+        # (the reference refuses flush on RECOVERING shards)
+        self.recovery_in_progress = False
 
         durability = settings.get("index.translog.durability", DURABILITY_REQUEST)
         self.translog = Translog(self.path / "translog", durability=durability)
@@ -311,6 +315,8 @@ class Engine:
         (InternalEngine.java:616: Lucene commit + translog roll)."""
         with self._lock:
             self._ensure_open()
+            if self.recovery_in_progress:
+                return                           # see recovery_in_progress
             self.refresh()
             for seg, mask in zip(self._segments, self._live_masks):
                 seg_dir = self.path / f"seg_{seg.seg_id}"
@@ -337,6 +343,8 @@ class Engine:
         deleted docs (ElasticsearchConcurrentMergeScheduler's job)."""
         with self._lock:
             self._ensure_open()
+            if self.recovery_in_progress:
+                return                           # see recovery_in_progress
             self.refresh()
             if len(self._segments) <= max_num_segments:
                 return
@@ -429,6 +437,51 @@ class Engine:
         local = self._buffer.add(parsed)
         self._buffer_docs[op.doc_id] = local
         self._versions[op.doc_id] = VersionEntry(op.version, False, -1, local)
+
+    # ------------------------------------------------ peer recovery (source)
+
+    def file_manifest(self) -> dict[str, list[int]]:
+        """Relative path → [size, crc32] of every committed file (commit
+        point + segment files). The analog of Store.MetadataSnapshot
+        (core/index/store/Store.java:87) — the checksum diff that lets
+        phase1 skip files the target already holds."""
+        import zlib
+        with self._lock:
+            self._ensure_open()
+            out: dict[str, list[int]] = {}
+            commit = self.path / "commit.json"
+            files = [commit] if commit.exists() else []
+            for seg_dir in sorted(self.path.glob("seg_*")):
+                files.extend(sorted(seg_dir.iterdir()))
+            for f in files:
+                data = f.read_bytes()
+                out[str(f.relative_to(self.path))] = \
+                    [len(data), zlib.crc32(data) & 0xFFFFFFFF]
+            return out
+
+    # ------------------------------------------------ peer recovery (target)
+
+    def install_recovered_commit(self) -> None:
+        """Swap in a commit whose files phase1 just wrote under this
+        engine's path, discarding all in-memory state. Safe against live
+        replicated writes racing the file copy: any op newer than the
+        source's commit is re-delivered by phase2 translog replay (version-
+        deduped), any older op is already inside the commit."""
+        with self._lock:
+            self._ensure_open()
+            self._segments = []
+            self._live_masks = []
+            self._buffer = SegmentBuilder(seg_id=0,
+                                          max_tokens=self._buffer.max_tokens)
+            self._buffer_docs = {}
+            self._versions = {}
+            self._pending_seg_deletes = {}
+            self._commit_gen = self._load_commit()
+            # everything before the installed commit is superseded — mark
+            # the local translog committed so restart-replay can't
+            # resurrect pre-recovery ops
+            self.translog.roll(committed=True)
+            self.refresh()
 
     # ------------------------------------------------------------- lifecycle
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import struct
 import zlib
 from dataclasses import dataclass
@@ -79,6 +80,11 @@ class Translog:
         # to the checkpointed offset; Translog.java:273-276).
         self._ops_in_gen = self._truncate_to_valid(self.generation)
         self._file = open(self._gen_path(self.generation), "ab")
+        self._views: list[int] = []              # pinned view start gens
+        # serializes view bookkeeping against roll/trim: an unsynchronized
+        # acquire_view racing a concurrent flush could register a view for
+        # generations _trim already deleted, silently losing phase2 ops
+        self._views_lock = threading.Lock()
 
     # ---- files ------------------------------------------------------------
 
@@ -176,10 +182,36 @@ class Translog:
     def uncommitted_ops(self) -> list[TranslogOp]:
         """All ops in generations newer than the last commit (replayed on
         engine open — InternalEngine.java:215 recoverFromTranslog)."""
+        return self.ops_since(self.committed_generation)
+
+    def ops_since(self, gen: int) -> list[TranslogOp]:
+        """All ops in generations newer than ``gen`` (peer-recovery phase2
+        reads the ops captured during the file copy through a view —
+        Translog snapshot/views, core/index/translog/Translog.java:506)."""
+        self._file.flush()
         ops: list[TranslogOp] = []
-        for gen in range(self.committed_generation + 1, self.generation + 1):
-            ops.extend(self.read_generation(gen))
+        for g in range(gen + 1, self.generation + 1):
+            ops.extend(self.read_generation(g))
         return ops
+
+    # ---- views (pin generations open during peer recovery) -----------------
+
+    def acquire_view(self) -> int:
+        """Pin every generation after the current commit so a concurrent
+        flush/roll can't trim them while a recovery streams files; returns
+        the generation the view starts after (pass to ops_since)."""
+        with self._views_lock:
+            view_from = self.committed_generation
+            self._views.append(view_from)
+            return view_from
+
+    def release_view(self, view_from: int) -> None:
+        with self._views_lock:
+            try:
+                self._views.remove(view_from)
+            except ValueError:
+                pass
+            self._trim()
 
     @property
     def num_uncommitted(self) -> int:
@@ -198,12 +230,19 @@ class Translog:
         self._file = open(self._gen_path(self.generation), "ab")
         self._ops_in_gen = 0
         self._write_checkpoint()
+        with self._views_lock:
+            self._trim()
+
+    def _trim(self) -> None:
+        """Delete generations at/below the commit point, except ones a
+        recovery view still needs. Caller holds _views_lock."""
+        keep_after = min([self.committed_generation] + list(self._views))
         for p in self.path.glob("translog-*.tlog"):
             try:
                 gen = int(p.stem.split("-")[1])
             except (IndexError, ValueError):
                 continue
-            if gen <= self.committed_generation:
+            if gen <= keep_after:
                 p.unlink(missing_ok=True)
 
     def close(self) -> None:

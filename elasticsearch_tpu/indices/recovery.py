@@ -1,0 +1,243 @@
+"""Peer recovery — bring an initializing shard copy in sync with its
+active primary.
+
+Reference: core/indices/recovery/ — the target sends StartRecoveryRequest
+(RecoveryTarget.doRecovery, RecoveryTarget.java:157); the source answers by
+driving the copy (RecoverySourceHandler.recoverToTarget, :125-152):
+
+* **phase1** (:166) — diff the file sets by checksum (Store.MetadataSnapshot,
+  core/index/store/Store.java:87) and stream only missing/changed files in
+  chunks (RecoveryFileChunkRequest); identical file sets skip the copy
+  entirely (the effect the reference gets from synced-flush sync_ids,
+  SyncedFlushService.java:60);
+* **phase2** (:146) — replay every translog op captured during the copy
+  through a pinned view (Translog.java:506); replica-side apply is
+  version-deduped so overlap with live replicated writes is harmless;
+* finalize (:152) — the target reports shard-started to the master.
+
+Direction matches the reference: the target asks, the source pushes
+file_chunk / clean_files / translog_ops RPCs back to the target.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+
+from elasticsearch_tpu.index.translog import TranslogOp
+from elasticsearch_tpu.transport.service import RemoteTransportError
+
+START_RECOVERY = "internal:index/shard/recovery/start_recovery"
+FILE_CHUNK = "internal:index/shard/recovery/file_chunk"
+CLEAN_FILES = "internal:index/shard/recovery/clean_files"
+TRANSLOG_OPS = "internal:index/shard/recovery/translog_ops"
+
+CHUNK_SIZE = 512 * 1024
+
+
+class RecoveryFailedError(Exception):
+    pass
+
+
+class DelayRecoveryError(Exception):
+    """The source isn't ready (e.g. primary not active here yet) — the
+    target should retry, not fail the shard (RecoveryTarget retry/backoff,
+    RecoveryTarget.java:511)."""
+
+
+class PeerRecoveryService:
+    """Both halves of peer recovery, registered on every node."""
+
+    def __init__(self, node):
+        self.node = node
+        ts = node.transport_service
+        # the source handler blocks while streaming files; keep it off the
+        # pools used by writes (dedicated recovery channels in the
+        # reference, NettyTransport.java:871)
+        ts.register_request_handler(START_RECOVERY, self._handle_start,
+                                    executor="recovery", sync=True)
+        ts.register_request_handler(FILE_CHUNK, self._handle_file_chunk,
+                                    executor="recovery", sync=True)
+        ts.register_request_handler(CLEAN_FILES, self._handle_clean_files,
+                                    executor="recovery", sync=True)
+        ts.register_request_handler(TRANSLOG_OPS, self._handle_translog_ops,
+                                    executor="recovery", sync=True)
+        self.stats = {"recoveries": 0, "files_sent": 0, "files_skipped": 0,
+                      "bytes_sent": 0, "ops_replayed": 0}
+
+    # ---- target side -------------------------------------------------------
+
+    def recover_shard(self, shard_routing, engine) -> None:
+        """IndicesService.prepare_shard hook: called with an INITIALIZING
+        shard before it is reported started. Primaries recover locally
+        (Engine.__init__ already replayed the on-disk commit + translog —
+        StoreRecovery analog); replicas pull from the active primary."""
+        if shard_routing.primary:
+            return                               # local store recovery
+        state = self.node.cluster_service.state()
+        pr = state.routing_table.primary(shard_routing.index,
+                                         shard_routing.shard)
+        if pr is None or not pr.active:
+            raise DelayRecoveryError(
+                f"[{shard_routing.index}][{shard_routing.shard}] primary "
+                "not active yet")
+        source_node = state.node(pr.node_id)
+        if source_node is None:
+            raise DelayRecoveryError("primary node not in cluster state")
+        local = self.node.transport_service.local_node
+        engine.recovery_in_progress = True
+        try:
+            self.node.transport_service.submit_request(
+                source_node, START_RECOVERY,
+                {"index": shard_routing.index, "shard": shard_routing.shard,
+                 "target_node": {"node_id": local.node_id,
+                                 "name": local.name,
+                                 "host": local.address.host,
+                                 "port": local.address.port},
+                 "manifest": engine.file_manifest()},
+                timeout=120.0)
+        except RemoteTransportError as e:
+            # a source-side delay crosses the wire as RemoteTransportError;
+            # surface it as the retryable kind, not a shard failure
+            if e.error_type == "DelayRecoveryError":
+                raise DelayRecoveryError(e.reason) from None
+            raise
+        finally:
+            engine.recovery_in_progress = False
+
+    # ---- source side -------------------------------------------------------
+
+    def _handle_start(self, request: dict, source) -> dict:
+        from elasticsearch_tpu.transport.service import (
+            DiscoveryNode, TransportAddress)
+        index, shard = request["index"], request["shard"]
+        state = self.node.cluster_service.state()
+        pr = state.routing_table.primary(index, shard)
+        if pr is None or pr.node_id != self.node.node_id:
+            raise DelayRecoveryError(
+                f"[{index}][{shard}] primary does not live on this node")
+        svc = self.node.indices_service.indices.get(index)
+        engine = svc.engines.get(shard) if svc is not None else None
+        if engine is None:
+            raise DelayRecoveryError(f"[{index}][{shard}] engine not open")
+        tn = request["target_node"]
+        target = DiscoveryNode(tn["node_id"], tn["name"],
+                               TransportAddress(tn["host"], tn["port"]))
+        t0 = time.perf_counter()
+        # phase1 prologue: pin the translog FIRST (so no flush anywhere can
+        # trim ops we must replay), then make a stable commit. The view
+        # starts at the pre-flush commit, so phase2 re-sends some ops that
+        # ended up inside the new commit — harmless, replica apply is
+        # version-idempotent.
+        view_gen = engine.translog.acquire_view()
+        engine.flush()
+        try:
+            files_sent, bytes_sent, skipped = self._phase1(
+                engine, engine.file_manifest(), target, index, shard,
+                request["manifest"])
+            ops = engine.translog.ops_since(view_gen)
+            self._phase2(engine, target, index, shard, ops)
+        finally:
+            engine.translog.release_view(view_gen)
+        self.stats["recoveries"] += 1
+        self.stats["files_sent"] += files_sent
+        self.stats["files_skipped"] += skipped
+        self.stats["bytes_sent"] += bytes_sent
+        self.stats["ops_replayed"] += len(ops)
+        return {"files_sent": files_sent, "files_skipped": skipped,
+                "bytes_sent": bytes_sent, "ops_replayed": len(ops),
+                "took_ms": int((time.perf_counter() - t0) * 1e3)}
+
+    def _phase1(self, engine, source_manifest: dict, target, index: str,
+                shard: int, target_manifest: dict) -> tuple[int, int, int]:
+        to_send = [rel for rel, sig in source_manifest.items()
+                   if target_manifest.get(rel) != sig]
+        skipped = len(source_manifest) - len(to_send)
+        # commit.json must land last: it is the atomic install point
+        to_send.sort(key=lambda rel: rel == "commit.json")
+        bytes_sent = 0
+        for rel in to_send:
+            data = (engine.path / rel).read_bytes()
+            total = len(data)
+            offsets = range(0, total, CHUNK_SIZE) if total else [0]
+            for off in offsets:
+                chunk = data[off:off + CHUNK_SIZE]
+                self.node.transport_service.submit_request(
+                    target, FILE_CHUNK,
+                    {"index": index, "shard": shard, "path": rel,
+                     "offset": off, "data": chunk, "total": total},
+                    timeout=60.0)
+                bytes_sent += len(chunk)
+        # install: drop stale files, open the commit
+        self.node.transport_service.submit_request(
+            target, CLEAN_FILES,
+            {"index": index, "shard": shard,
+             "keep": sorted(source_manifest)}, timeout=60.0)
+        return len(to_send), bytes_sent, skipped
+
+    def _phase2(self, engine, target, index: str, shard: int,
+                ops: list[TranslogOp], batch: int = 500) -> None:
+        for i in range(0, len(ops), batch):
+            chunk = [{"op": o.op, "id": o.doc_id, "version": o.version,
+                      "source": o.source, "routing": o.routing}
+                     for o in ops[i:i + batch]]
+            self.node.transport_service.submit_request(
+                target, TRANSLOG_OPS,
+                {"index": index, "shard": shard, "ops": chunk},
+                timeout=60.0)
+
+    # ---- target-side handlers (driven by the source) -----------------------
+
+    def _target_engine(self, request: dict):
+        svc = self.node.indices_service.indices.get(request["index"])
+        engine = svc.engines.get(request["shard"]) if svc is not None else None
+        if engine is None:
+            raise RecoveryFailedError(
+                f"[{request['index']}][{request['shard']}] target engine "
+                "not open")
+        return engine
+
+    def _handle_file_chunk(self, request: dict, source) -> dict:
+        engine = self._target_engine(request)
+        rel = request["path"]
+        if ".." in rel or rel.startswith("/"):
+            raise RecoveryFailedError(f"illegal recovery path [{rel}]")
+        dest: Path = engine.path / rel
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        # first chunk of a file replaces any stale copy
+        tmp = dest.with_name(dest.name + ".rec")
+        mode = "r+b" if request["offset"] > 0 and tmp.exists() else "wb"
+        with open(tmp, mode) as f:
+            f.seek(request["offset"])
+            f.write(request["data"])
+            received = f.tell()
+        if received >= request["total"]:
+            os.replace(tmp, dest)
+        return {}
+
+    def _handle_clean_files(self, request: dict, source) -> dict:
+        engine = self._target_engine(request)
+        keep = set(request["keep"])
+        # remove files of stale segments the source's commit doesn't know
+        for seg_dir in engine.path.glob("seg_*"):
+            for f in list(seg_dir.iterdir()):
+                rel = str(f.relative_to(engine.path))
+                if rel not in keep:
+                    f.unlink(missing_ok=True)
+            if not any(seg_dir.iterdir()):
+                seg_dir.rmdir()
+        engine.install_recovered_commit()
+        return {}
+
+    def _handle_translog_ops(self, request: dict, source) -> dict:
+        from elasticsearch_tpu.index.translog import OP_INDEX
+        engine = self._target_engine(request)
+        for op in request["ops"]:
+            if op["op"] == OP_INDEX:
+                engine.index_replica(op["id"], op["source"], op["version"],
+                                     routing=op.get("routing"))
+            else:
+                engine.delete_replica(op["id"], op["version"])
+        engine.refresh()
+        return {}

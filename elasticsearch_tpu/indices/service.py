@@ -16,8 +16,10 @@ with an AllocationService.reroute so new shards get assigned.
 from __future__ import annotations
 
 import shutil
+import threading
 import time
 import uuid
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 
 from elasticsearch_tpu.analysis import AnalysisRegistry
@@ -172,10 +174,16 @@ class IndicesService:
         # Node wires this to the ShardStateAction path:
         # on_shard_started(shard_routing) → master applies started
         self.on_shard_started = None
-        # recovery hook (peer recovery, task: recovery module):
-        # prepare_shard(shard_routing, engine) → None; may pull files/ops
-        # from the primary before the shard is reported started
+        # recovery hook (peer recovery): prepare_shard(shard_routing,
+        # engine) → None; may pull files/ops from the primary before the
+        # shard is reported started. Runs on the recovery executor, NOT the
+        # state-applier thread (the reference recovers on dedicated
+        # RECOVERY threads so a long file copy can't stall state
+        # application).
         self.prepare_shard = None
+        self._recovering: set[str] = set()
+        self._recovery_executor = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix=f"recovery[{node_id[:8]}]")
         cluster_service.add_listener(self._cluster_changed)
         # reconcile initial (recovered) state
         self._cluster_changed(ClusterState(), cluster_service.state())
@@ -212,25 +220,21 @@ class IndicesService:
                 svc.add_local_shard(sid)
             for sid in set(svc.engines) - want:
                 svc.remove_local_shard(sid)
-            # report INITIALIZING shards as started (ShardStateAction).
-            # Only mark reported when the callback actually fired — during
-            # the constructor reconcile it is not wired yet and the Node's
-            # follow-up recheck must pick these shards up.
+            # recover INITIALIZING shards then report started
+            # (ShardStateAction). Only act when the callback is wired —
+            # during the constructor reconcile it is not yet, and the
+            # Node's follow-up recheck must pick these shards up.
             for s in local:
                 if s.state == ShardRoutingState.INITIALIZING and \
                         s.allocation_id not in self._reported_started and \
+                        s.allocation_id not in self._recovering and \
                         self.on_shard_started is not None:
-                    engine = svc.engines[s.shard]
-                    if self.prepare_shard is not None:
-                        try:
-                            self.prepare_shard(s, engine)
-                        except Exception as e:  # noqa: BLE001 — report fail
-                            self._reported_started.add(s.allocation_id)
-                            if self.on_shard_failed is not None:
-                                self.on_shard_failed(s, str(e))
-                            continue
-                    self._reported_started.add(s.allocation_id)
-                    self.on_shard_started(s)
+                    self._recovering.add(s.allocation_id)
+                    try:
+                        self._recovery_executor.submit(
+                            self._do_recovery, s, svc.engines[s.shard])
+                    except RuntimeError:         # node closing
+                        self._recovering.discard(s.allocation_id)
 
         for name in list(self.indices):
             if name not in new.indices:
@@ -240,6 +244,40 @@ class IndicesService:
                 del self.indices[name]
 
     on_shard_failed = None
+
+    def _do_recovery(self, s: ShardRouting, engine) -> None:
+        """Recovery-executor body: run the peer-recovery hook, then report
+        started (or failed) to the master via the Node's callbacks."""
+        from elasticsearch_tpu.indices.recovery import DelayRecoveryError
+        try:
+            if self.prepare_shard is not None:
+                self.prepare_shard(s, engine)
+        except DelayRecoveryError:
+            # source not ready — back off and re-run the reconciler
+            # (RecoveryTarget retry/backoff, RecoveryTarget.java:511)
+            self._recovering.discard(s.allocation_id)
+            t = threading.Timer(0.3, self._retry_reconcile)
+            t.daemon = True
+            t.start()
+            return
+        except Exception as e:                   # noqa: BLE001 — report fail
+            self._recovering.discard(s.allocation_id)
+            self._reported_started.add(s.allocation_id)
+            if self.on_shard_failed is not None:
+                self.on_shard_failed(s, f"recovery failed: {e}")
+            return
+        self._reported_started.add(s.allocation_id)
+        self._recovering.discard(s.allocation_id)
+        self.on_shard_started(s)
+
+    def _retry_reconcile(self) -> None:
+        try:
+            self.cluster_service.run_task(
+                "recovery-retry",
+                lambda: self._cluster_changed(self.cluster_service.state(),
+                                              self.cluster_service.state()))
+        except RuntimeError:
+            pass                                 # shutting down
 
     def unreport(self, allocation_id: str) -> None:
         """Forget a started-report that failed to reach the master so the
@@ -472,5 +510,6 @@ class IndicesService:
             return False
 
     def close(self):
+        self._recovery_executor.shutdown(wait=False, cancel_futures=True)
         for svc in self.indices.values():
             svc.close()

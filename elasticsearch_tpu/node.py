@@ -79,6 +79,12 @@ class Node:
         self.document_actions = DocumentActions(self)
         self.search_actions = SearchActions(self)
         self.broadcast_actions = BroadcastActions(self)
+        # peer recovery (core/indices/recovery/): replicas pull files + ops
+        # from their active primary before reporting started
+        from elasticsearch_tpu.indices.recovery import PeerRecoveryService
+        self.recovery_service = PeerRecoveryService(self)
+        self.indices_service.prepare_shard = \
+            self.recovery_service.recover_shard
         self._delayed_reroute_timer = None
         self.cluster_service.add_listener(self._schedule_delayed_reroute)
         from elasticsearch_tpu.discovery import ZenDiscovery

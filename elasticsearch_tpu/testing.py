@@ -37,6 +37,12 @@ class InternalTestCluster:
         self.base = Path(base_path or tempfile.mkdtemp(prefix="estpu-"))
         self.cluster_name = cluster_name
         self.settings = {**self.DEFAULT_SETTINGS, **(settings or {})}
+        # quorum gate: without it, concurrent startup races let a node whose
+        # first ping round beats its peers' transport registration elect
+        # itself → permanent split-brain (ES requires minimum_master_nodes
+        # for exactly this reason, elect/ElectMasterService.java)
+        self.settings.setdefault("discovery.zen.minimum_master_nodes",
+                                 num_nodes // 2 + 1)
         self.nodes: list[Node] = []
         self._counter = 0
         # initial nodes start concurrently: with minimum_master_nodes > 1
@@ -82,6 +88,17 @@ class InternalTestCluster:
 
     def non_masters(self) -> list[Node]:
         return [n for n in self.nodes if n._started and not n.is_master]
+
+    def primary_node(self, index: str, shard: int) -> Node:
+        """The node holding the primary copy of [index][shard]."""
+        st = self.master().cluster_service.state()
+        pr = st.routing_table.primary(index, shard)
+        if pr is None or pr.node_id is None:
+            raise RuntimeError(f"[{index}][{shard}] primary unassigned")
+        for n in self.nodes:
+            if n.node_id == pr.node_id:
+                return n
+        raise RuntimeError(f"primary node {pr.node_id} not in cluster")
 
     def stop_node(self, node: Node, graceful: bool = True) -> None:
         if graceful:

@@ -1,0 +1,169 @@
+"""Peer recovery integration tests (SURVEY.md §2.7/§3.5): replica
+recovery from an active primary — file copy, checksum skip, translog
+replay, recovery under concurrent writes, and data survival across
+node loss + reallocation."""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.testing import InternalTestCluster
+
+
+@pytest.fixture
+def cluster2(tmp_path):
+    with InternalTestCluster(2, base_path=tmp_path) as c:
+        c.wait_for_nodes(2)
+        yield c
+
+
+def _engine_holders(cluster, index, shard):
+    """[(node, engine)] for every node holding a local copy of the shard."""
+    out = []
+    for n in cluster.nodes:
+        svc = n.indices_service.indices.get(index)
+        if svc is not None and shard in svc.engines:
+            out.append((n, svc.engines[shard]))
+    return out
+
+
+def _wait_doc_count(cluster, index, shard, count, copies, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        holders = _engine_holders(cluster, index, shard)
+        if len(holders) == copies and \
+                all(e.num_docs == count for _, e in holders):
+            return holders
+        time.sleep(0.05)
+    holders = _engine_holders(cluster, index, shard)
+    raise AssertionError(
+        f"doc counts never converged: "
+        f"{[(n.node_name, e.num_docs) for n, e in holders]} want {count} "
+        f"on {copies} copies")
+
+
+def test_replica_recovery_copies_existing_data(cluster2):
+    c = cluster2
+    master = c.master()
+    master.indices_service.create_index(
+        "logs", {"settings": {"number_of_shards": 1,
+                              "number_of_replicas": 0}})
+    c.wait_for_health("green")
+    for i in range(50):
+        master.document_actions.index_doc("logs", f"d{i}", {"n": i})
+    # flush so recovery has committed segment files to copy
+    master.broadcast_actions.flush("logs")
+    # now add a replica — it must recover the 50 docs from the primary
+    master.indices_service.update_settings(
+        "logs", {"index.number_of_replicas": 1})
+    c.wait_for_health("green", timeout=20.0)
+    _wait_doc_count(c, "logs", 0, 50, copies=2)
+
+
+def test_replica_recovery_unflushed_ops_via_translog_replay(cluster2):
+    c = cluster2
+    master = c.master()
+    master.indices_service.create_index(
+        "t", {"settings": {"number_of_shards": 1,
+                           "number_of_replicas": 0}})
+    c.wait_for_health("green")
+    for i in range(20):
+        master.document_actions.index_doc("t", f"d{i}", {"n": i})
+    # NO flush: the 20 ops live only in the translog → phase2 must carry them
+    master.indices_service.update_settings(
+        "t", {"index.number_of_replicas": 1})
+    c.wait_for_health("green", timeout=20.0)
+    _wait_doc_count(c, "t", 0, 20, copies=2)
+
+
+def test_recovery_checksum_skip_on_identical_files(cluster2):
+    c = cluster2
+    master = c.master()
+    master.indices_service.create_index(
+        "s", {"settings": {"number_of_shards": 1,
+                           "number_of_replicas": 0}})
+    c.wait_for_health("green")
+    for i in range(10):
+        master.document_actions.index_doc("s", f"d{i}", {"n": i})
+    master.broadcast_actions.flush("s")
+    master.indices_service.update_settings(
+        "s", {"index.number_of_replicas": 1})
+    c.wait_for_health("green", timeout=20.0)
+    _wait_doc_count(c, "s", 0, 10, copies=2)
+    # bounce the replica count: the second recovery should mostly skip
+    # files the target still has on disk from the first copy
+    src = c.primary_node("s", 0).recovery_service.stats
+    sent_before = src["files_sent"]
+    skipped_before = src["files_skipped"]
+    master.indices_service.update_settings(
+        "s", {"index.number_of_replicas": 0})
+    time.sleep(0.2)
+    master.indices_service.update_settings(
+        "s", {"index.number_of_replicas": 1})
+    c.wait_for_health("green", timeout=20.0)
+    _wait_doc_count(c, "s", 0, 10, copies=2)
+    assert src["files_skipped"] > skipped_before or \
+        src["files_sent"] > sent_before
+
+
+def test_writes_during_recovery_not_lost(cluster2):
+    c = cluster2
+    master = c.master()
+    master.indices_service.create_index(
+        "w", {"settings": {"number_of_shards": 1,
+                           "number_of_replicas": 0}})
+    c.wait_for_health("green")
+    for i in range(30):
+        master.document_actions.index_doc("w", f"a{i}", {"n": i})
+    master.broadcast_actions.flush("w")
+    # start recovery and keep writing while it runs
+    master.indices_service.update_settings(
+        "w", {"index.number_of_replicas": 1})
+    for i in range(30):
+        master.document_actions.index_doc("w", f"b{i}", {"n": i})
+    c.wait_for_health("green", timeout=20.0)
+    _wait_doc_count(c, "w", 0, 60, copies=2)
+
+
+def test_node_loss_reallocates_with_data(tmp_path):
+    with InternalTestCluster(3, base_path=tmp_path) as c:
+        c.wait_for_nodes(3)
+        master = c.master()
+        master.indices_service.create_index(
+            "d", {"settings": {"number_of_shards": 1,
+                               "number_of_replicas": 1}})
+        c.wait_for_health("green")
+        for i in range(40):
+            master.document_actions.index_doc("d", f"d{i}", {"n": i})
+        # kill a non-master node that holds a copy
+        holders = _engine_holders(c, "d", 0)
+        victim = next((n for n, _ in holders if not n.is_master), None)
+        if victim is None:
+            pytest.skip("both copies on master")
+        c.stop_node(victim, graceful=False)
+        c.wait_for_nodes(2, timeout=20.0)
+        c.wait_for_health("green", timeout=30.0)
+        _wait_doc_count(c, "d", 0, 40, copies=2)
+        # the re-recovered copy serves reads: search via any node
+        resp = c.master().search_actions.search(
+            "d", {"query": {"match_all": {}}, "size": 0})
+        assert resp["hits"]["total"]["value"] == 40
+
+
+def test_deletes_replayed_to_recovering_replica(cluster2):
+    c = cluster2
+    master = c.master()
+    master.indices_service.create_index(
+        "del", {"settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0}})
+    c.wait_for_health("green")
+    for i in range(10):
+        master.document_actions.index_doc("del", f"d{i}", {"n": i})
+    master.broadcast_actions.flush("del")
+    for i in range(5):
+        master.document_actions.delete_doc("del", f"d{i}")
+    # deletes are only in the translog → phase2 must replay them
+    master.indices_service.update_settings(
+        "del", {"index.number_of_replicas": 1})
+    c.wait_for_health("green", timeout=20.0)
+    _wait_doc_count(c, "del", 0, 5, copies=2)
