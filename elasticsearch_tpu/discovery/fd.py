@@ -168,9 +168,13 @@ class NodesFaultDetection:
     def _handle_ping(self, request: dict, source) -> dict:
         if request["node_id"] != self.transport.local_node.node_id:
             raise NodeNotPartOfClusterError("wrong node id")
-        # A ping from a master we no longer follow must fail — this is how
-        # a deposed master learns the cluster moved on (the reference
-        # compares the ping's cluster state master and throws)
+        # A ping from a master we follow someone ELSE than must fail —
+        # this is how a deposed master learns the cluster moved on. A
+        # `current is None` answer stays ok: at startup the master pings
+        # while its join-publish to us is still in flight, and rejecting
+        # would evict-and-rejoin-churn the joiner. The stale-member case
+        # (node that never received its join-publish) is healed by the
+        # join handler instead, which re-publishes on duplicate joins.
         current = self._current_master_fn()
         if current is not None and current != request.get("master_id"):
             raise NodeNotPartOfClusterError(

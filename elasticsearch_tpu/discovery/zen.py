@@ -67,6 +67,11 @@ class ZenDiscovery:
         self._votes_lock = threading.Lock()
         self.JOIN_VOTE_TTL = 10.0
         self._last_master_id: str | None = None
+        # who the last ping round said should win; we only accumulate join
+        # votes while we believe that is US — otherwise two nodes can
+        # each assemble an overlapping "quorum" (we voted for A while
+        # counting B's vote for us) and split-brain
+        self._election_winner: str | None = None
         transport.register_request_handler(PING_ACTION, self._handle_ping,
                                            executor="same", sync=True)
         transport.register_request_handler(JOIN_ACTION, self._handle_join)
@@ -175,6 +180,7 @@ class ZenDiscovery:
                         # any node that knows it? → retry next round
                         return
             if master is not None:
+                self._election_winner = master_id
                 self._send_join(master)
                 return
         # 2) full election among master-eligible candidates
@@ -186,8 +192,19 @@ class ZenDiscovery:
         if len(candidates) < self.min_master_nodes:
             return                               # not enough nodes yet
         winner_id = sorted(candidates)[0]        # ElectMasterService ordering
+        self._election_winner = winner_id
         if winner_id == local.node_id:
-            self._become_master()
+            # Do NOT take mastership on ping-knowledge alone: peers may
+            # have settled on another winner (their ping round missed us),
+            # and committing a 1-node master state here creates a
+            # permanent split-brain (nobody pings a settled master again).
+            # Like NodeJoinController.waitToBeElectedAsMaster, wait until a
+            # quorum of peers has actually SENT us join votes — the
+            # _handle_join vote path elects when votes reach
+            # min_master_nodes. Only a true single-node quorum elects
+            # immediately.
+            if self.min_master_nodes <= 1:
+                self._become_master()
         else:
             self._send_join(candidates[winner_id])
 
@@ -214,12 +231,17 @@ class ZenDiscovery:
         joiners = list(extra_joiners)
 
         def update(state: ClusterState) -> ClusterState:
-            if state.master_node_id == local.node_id:
-                return state
             nodes = dict(state.nodes)
             nodes[local.node_id] = local
             for j in joiners:
                 nodes[j.node_id] = j
+            if state.master_node_id == local.node_id:
+                if all(j.node_id in state.nodes for j in joiners):
+                    return state                 # genuinely nothing new
+                # already master but a vote batch carried NEW joiners —
+                # dropping them would orphan nodes that think they joined
+                return self.allocation.reroute(
+                    state.with_(nodes=nodes), "joiners while master")
             new = state.with_(master_node_id=local.node_id, nodes=nodes,
                               blocks=state.blocks - {NO_MASTER_BLOCK})
             if self.gateway_fn is not None and not new.indices:
@@ -253,11 +275,18 @@ class ZenDiscovery:
         state = self.cluster_service.state()
         if state.master_node_id == local.node_id:
             def update(st: ClusterState) -> ClusterState:
-                if joiner.node_id in st.nodes and \
-                        st.nodes[joiner.node_id].address == joiner.address:
-                    return st
                 nodes = dict(st.nodes)
                 nodes[joiner.node_id] = joiner
+                if joiner.node_id in st.nodes and \
+                        st.nodes[joiner.node_id].address == joiner.address:
+                    # Already a member — but a re-join means the joiner
+                    # never RECEIVED the state that added it (its initial
+                    # publish timed out). A no-op here would deadlock: the
+                    # joiner polls for a state that will never be sent
+                    # again. Touch the version so the publish delivers the
+                    # full state to it (NodeJoinController re-publishes on
+                    # existing-node joins for the same reason).
+                    return st.with_(nodes=nodes)
                 return self.allocation.reroute(
                     st.with_(nodes=nodes),
                     f"node joined [{joiner.name}]")
@@ -268,11 +297,16 @@ class ZenDiscovery:
                 if f.exception() is None else channel.send_failure(
                     f.exception()))
             return
-        if state.master_node_id is None and local.master_eligible:
-            # election in progress: count the join as a vote — but only
+        if state.master_node_id is None and local.master_eligible and \
+                self._election_winner == local.node_id:
+            # election in progress AND our own ping round agrees we are
+            # the best candidate: count the join as a vote — but only
             # MASTER-ELIGIBLE joiners count toward minimum_master_nodes
             # (ElectMasterService counts master nodes only), and votes
-            # expire so dead electors can't satisfy a later quorum
+            # expire so dead electors can't satisfy a later quorum.
+            # While we believe someone ELSE should win, reject instead:
+            # counting votes while simultaneously voting elsewhere lets
+            # two nodes assemble overlapping quorums (split-brain).
             now = time.monotonic()
             with self._votes_lock:
                 self._pending_joins[joiner.node_id] = (joiner, now)
@@ -330,20 +364,24 @@ class ZenDiscovery:
     def _on_master_failure(self, master: DiscoveryNode) -> None:
         """Master stopped answering → drop it locally and rejoin
         (ZenDiscovery.handleMasterGone → rejoin :78,129)."""
-        def update(state: ClusterState) -> ClusterState:
-            if state.master_node_id != master.node_id:
-                return state
-            nodes = {nid: n for nid, n in state.nodes.items()
-                     if nid != master.node_id}
-            return state.with_(master_node_id=None, nodes=nodes,
-                               blocks=state.blocks | {NO_MASTER_BLOCK})
-        try:
-            # local-only mutation: this node's view drops the master; the
-            # join loop then re-elects (no publish — we are not master)
+        def task() -> None:
             current = self.cluster_service.state()
-            new = update(current)
-            if new is not current:
-                self.cluster_service.apply_published_state(new)
+            if current.master_node_id != master.node_id:
+                return
+            nodes = {nid: n for nid, n in current.nodes.items()
+                     if nid != master.node_id}
+            # local-only mutation: this node's view drops the master; the
+            # join loop then re-elects. Keep the VERSION where it is — a
+            # non-master running ahead of the master's version would make
+            # the applier (gated on version > local) silently drop the
+            # next publish.
+            self.cluster_service.apply_new_state(current.with_(
+                master_node_id=None, nodes=nodes,
+                blocks=current.blocks | {NO_MASTER_BLOCK},
+                version=current.version))
+        try:
+            self.cluster_service.run_task("zen-disco-master-failed", task,
+                                          priority=URGENT)
         except RuntimeError:
             return
         self._ensure_join_thread()
