@@ -18,8 +18,8 @@ import uuid
 from elasticsearch_tpu.cluster.routing import OperationRouting
 from elasticsearch_tpu.cluster.state import ShardRouting
 from elasticsearch_tpu.common.errors import (
-    DocumentMissingError, ElasticsearchTpuError, IndexAlreadyExistsError,
-    UnavailableShardsError, reconstruct_error)
+    DocumentMissingError, ElasticsearchTpuError, IllegalArgumentError,
+    IndexAlreadyExistsError, UnavailableShardsError, reconstruct_error)
 from elasticsearch_tpu.index.engine import MATCH_ANY
 from elasticsearch_tpu.transport.service import (
     RemoteTransportError, TransportException)
@@ -30,6 +30,20 @@ def unwrap_remote(e: Exception) -> Exception:
     if isinstance(e, RemoteTransportError):
         return reconstruct_error(e.error_type, e.reason)
     return e
+
+
+#: remote failures that mean "the routing I used was stale, not that the
+#: operation is invalid" — retry against fresh state instead of failing
+#: the request (the reference's TransportReplicationAction.retryPrimary
+#: exceptions: shard not started / engine closed / not serving here)
+RETRYABLE_REMOTE = ("ShardNotLocalError", "EngineClosedError",
+                    "UnavailableShardsError", "IndexShardClosedError",
+                    "DelayRecoveryError")
+
+
+def _is_retryable(e: Exception) -> bool:
+    return isinstance(e, RemoteTransportError) and \
+        e.error_type in RETRYABLE_REMOTE
 
 
 class DocumentActions:
@@ -93,6 +107,16 @@ class DocumentActions:
         return OperationRouting.shard_id(doc_id, meta.number_of_shards,
                                          routing)
 
+    def _resolve_single(self, index: str) -> str:
+        """Single-doc ops target exactly one concrete index (the reference
+        rejects multi-index aliases for doc CRUD)."""
+        names = self.node.indices_service.resolve(index)
+        if len(names) != 1:
+            raise IllegalArgumentError(
+                f"[{index}] resolves to {len(names)} indices; single-"
+                "document operations need exactly one")
+        return names[0]
+
     def _await_primary(self, name: str, shard: int) -> ShardRouting:
         """ReroutePhase: observe cluster state until the primary is active
         (TransportReplicationAction.java:366 retryBecauseUnavailable)."""
@@ -127,10 +151,14 @@ class DocumentActions:
                     target, action, request,
                     timeout=self.PRIMARY_TIMEOUT).result(
                         self.PRIMARY_TIMEOUT + 5)
-            except RemoteTransportError as e:    # remote application error
-                raise unwrap_remote(e) from None
+            except RemoteTransportError as e:
+                if _is_retryable(e):             # stale routing at the
+                    last = e                     # target (primary moved) →
+                    time.sleep(0.1)              # wait for new state, retry
+                    continue
+                raise unwrap_remote(e) from None  # real application error
             except TransportException as e:
-                last = e                         # stale routing / node left →
+                last = e                         # node left →
                 time.sleep(0.1)                  # wait for new state, retry
             except Exception as e:               # noqa: BLE001 — remote error
                 raise unwrap_remote(e) from None
@@ -155,14 +183,22 @@ class DocumentActions:
         copies = self._replicas_of(name, shard)
         futures = []
         state = self._state()
+        ok, failures = 1, []                     # primary already succeeded
         for c in copies:
             target = state.node(c.node_id)
             if target is None:
+                # assigned copy whose node just dropped out of the state:
+                # it is MISSING this op — it must be failed, not silently
+                # skipped, or a later promotion serves stale data
+                failures.append({"shard": shard, "index": name,
+                                 "node": c.node_id, "status": "INTERNAL",
+                                 "reason": "node holding copy left cluster"})
+                self.node._on_shard_failed(
+                    c, "replication target node left cluster")
                 continue
             fut = self.node.transport_service.send_request(
                 target, action, payload, timeout=self.REPLICA_TIMEOUT)
             futures.append((c, fut))
-        ok, failures = 1, []                     # primary already succeeded
         for c, fut in futures:
             try:
                 fut.result(self.REPLICA_TIMEOUT + 5)
@@ -173,7 +209,7 @@ class DocumentActions:
                                  "reason": str(unwrap_remote(e))})
                 self.node._on_shard_failed(
                     c, f"replication op failed: {unwrap_remote(e)}")
-        return 1 + len(futures), ok, failures
+        return 1 + len(copies), ok, failures
 
     def _shards_header(self, total: int, ok: int,
                        failures: list[dict]) -> dict:
@@ -248,8 +284,7 @@ class DocumentActions:
     def delete_doc(self, index: str, doc_id: str,
                    routing: str | None = None, version: int | None = None,
                    refresh: bool = False) -> dict:
-        names = self.node.indices_service.resolve(index)
-        name = names[0]
+        name = self._resolve_single(index)
         shard = self._shard_id(name, doc_id, routing)
         request = {"index": name, "shard": shard, "id": doc_id,
                    "version": version, "refresh": refresh}
@@ -287,8 +322,7 @@ class DocumentActions:
 
     def update_doc(self, index: str, doc_id: str, body: dict,
                    routing: str | None = None, refresh: bool = False) -> dict:
-        names = self.node.indices_service.resolve(index)
-        name = names[0]
+        name = self._resolve_single(index)
         shard = self._shard_id(name, doc_id, routing)
         request = {"index": name, "shard": shard, "id": doc_id, "body": body,
                    "routing": routing, "refresh": refresh}
@@ -332,8 +366,7 @@ class DocumentActions:
 
     def get_doc(self, index: str, doc_id: str,
                 routing: str | None = None) -> dict:
-        names = self.node.indices_service.resolve(index)
-        name = names[0]
+        name = self._resolve_single(index)
         shard = self._shard_id(name, doc_id, routing)
         state = self._state()
         copies = [c for c in state.routing_table.shard_copies(name, shard)
@@ -362,8 +395,11 @@ class DocumentActions:
             try:
                 return self.node.transport_service.send_request(
                     target, self.GET_S, request, timeout=10.0).result(15.0)
-            except RemoteTransportError as e:    # remote application error
-                raise unwrap_remote(e) from None
+            except RemoteTransportError as e:
+                if _is_retryable(e):
+                    last = e                     # stale copy → next copy
+                    continue
+                raise unwrap_remote(e) from None  # real application error
             except TransportException as e:
                 last = e                         # node gone → next copy
             except Exception as e:               # noqa: BLE001 — remote error
@@ -495,7 +531,8 @@ class DocumentActions:
                     r = {**self._handle_update_local(
                         {"index": name, "shard": shard, "id": item["id"],
                          "body": item.get("source") or {},
-                         "routing": item.get("routing"), "refresh": False}),
+                         "routing": item.get("routing"),
+                         "refresh": bool(request.get("refresh"))}),
                         "status": 200}
                     # update replicates itself via _handle_index_p_local
                 else:

@@ -83,8 +83,23 @@ class SearchActions:
         node.transport_service.register_request_handler(
             self.QUERY_FETCH, self._handle_shard_query, executor="search",
             sync=True)
+        # keep-alive reaper: abandoned scroll contexts must not accumulate
+        # for the node's lifetime (SearchService keep-alive reaper,
+        # core/search/SearchService.java:1113)
+        self._closed = False
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
+                                        name="scroll-reaper")
+        self._reaper.start()
+
+    def _reap_loop(self) -> None:
+        while not self._closed:
+            time.sleep(5.0)
+            if self._closed:
+                return
+            self.reap_expired()
 
     def close(self):
+        self._closed = True
         self._pool.shutdown(wait=False, cancel_futures=True)
 
     # ---- data-node side ----------------------------------------------------
@@ -97,7 +112,8 @@ class SearchActions:
         svc = self.node.indices_service.index(name)
         engine = svc.engine(shard)
         reader = device_reader_for(engine)
-        searcher = ShardSearcher(shard, reader, svc.mapper_service)
+        searcher = ShardSearcher(shard, reader, svc.mapper_service,
+                                 index_name=name)
         req = parse_search_request(body)
         result = searcher.query_phase(req)
         k = min(len(result.doc_ids), req.from_ + req.size)

@@ -42,6 +42,7 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/{index}/_mapping", h.get_mapping)
     r("GET", "/_mapping", h.get_all_mappings)
     r("GET", "/{index}/_settings", h.get_settings)
+    r("PUT", "/{index}/_settings", h.put_settings)
     # aliases
     r("POST", "/_aliases", h.update_aliases)
     r("PUT", "/{index}/_alias/{name}", h.put_alias)
@@ -218,6 +219,16 @@ class Handlers:
         for n in self.node.indices_service.resolve(req.path_params["index"]):
             out[n] = {"settings": state.indices[n].to_dict()["settings"]}
         return 200, out
+
+    def put_settings(self, req: RestRequest):
+        """PUT /{index}/_settings — dynamic per-index settings update
+        (RestUpdateSettingsAction; accepts both a flat body and one
+        wrapped in "settings", like the reference)."""
+        body = req.body or {}
+        settings = body.get("settings", body)
+        for n in self.node.indices_service.resolve(req.path_params["index"]):
+            self.node.indices_service.update_settings(n, settings)
+        return 200, {"acknowledged": True}
 
     # ---- aliases ----------------------------------------------------------
 

@@ -98,32 +98,53 @@ class ShardAggContext:
         return np.concatenate(vals), np.concatenate(exists)
 
     def keyword_values(self, fname: str):
-        """→ (ords [N,K] concat (ord remapped to per-shard union), vocab)."""
+        """→ (ords [N,K] concat (ord remapped to per-shard union), vocab).
+
+        Resolution order: exact keyword column → `{field}.keyword`
+        multi-field (the dynamic-string mapping) → uninverted text tokens
+        (the reference loads fielddata for an analyzed string and its
+        terms agg yields the analyzed tokens — IndexFieldDataService on a
+        string field, SURVEY §2.5 fielddata)."""
+        segs = self.reader.segments
+        for candidate in (fname, f"{fname}.keyword"):
+            cols = [s.seg.keyword_fields.get(candidate) for s in segs]
+            if any(c is not None for c in cols):
+                return self._union_ords(
+                    [(c.vocab, c.ords) if c is not None else None
+                     for c in cols])
+        tcols = [s.seg.text_fields.get(fname) for s in segs]
+        if any(c is not None for c in tcols):
+            return self._union_ords(
+                [(c.terms, c.uterms) if c is not None else None
+                 for c in tcols])
+        return self._union_ords([None] * len(segs))
+
+    def _union_ords(self, per_seg):
+        """[(vocab, ords[Np,K]) | None per segment] → shard-union view."""
         union: dict[str, int] = {}
-        seg_cols = []
         kmax = 1
-        for s in self.reader.segments:
-            col = s.seg.keyword_fields.get(fname)
-            seg_cols.append(col)
-            if col is not None:
-                kmax = max(kmax, col.ords.shape[1])
-                for v in col.vocab:
+        for item in per_seg:
+            if item is not None:
+                vocab, ords = item
+                kmax = max(kmax, ords.shape[1])
+                for v in vocab:
                     union.setdefault(v, len(union))
         rows = []
-        for s, col in zip(self.reader.segments, seg_cols):
-            if col is None:
+        for s, item in zip(self.reader.segments, per_seg):
+            if item is None:
                 rows.append(np.full((s.padded_docs, kmax), -1, np.int32))
                 continue
-            remap = np.array([union[v] for v in col.vocab] or [0], np.int32)
-            ords = col.ords
+            vocab, ords = item
+            remap = np.array([union[v] for v in vocab] or [0], np.int32)
             out = np.full((ords.shape[0], kmax), -1, np.int32)
             valid = ords >= 0
-            out[:, :ords.shape[1]] = np.where(valid, remap[np.clip(ords, 0, None)], -1)
+            out[:, :ords.shape[1]] = np.where(
+                valid, remap[np.clip(ords, 0, None)], -1)
             rows.append(out)
-        vocab = [None] * len(union)
+        vocab_out = [None] * len(union)
         for v, i in union.items():
-            vocab[i] = v
-        return np.concatenate(rows), vocab
+            vocab_out[i] = v
+        return np.concatenate(rows), vocab_out
 
 
 def collect(node: AggNode, mask: np.ndarray, ctx: ShardAggContext) -> dict:
@@ -222,7 +243,8 @@ def _c_terms(node, mask, ctx):
                 bmask = mask & (ords == oid).any(axis=1)
                 b["subs"] = _collect_subs(node, bmask, ctx)
             buckets[key] = b
-        return {"buckets": buckets, "doc_count_error_upper_bound": 0}
+        return {"buckets": _as_pairs(buckets),
+                "doc_count_error_upper_bound": 0}
     # numeric terms
     vals, exists = ctx.numeric_values(fname)
     sel = vals[mask & exists]
@@ -235,7 +257,8 @@ def _c_terms(node, mask, ctx):
             bmask = mask & exists & (vals == u)
             b["subs"] = _collect_subs(node, bmask, ctx)
         buckets[key] = b
-    return {"buckets": buckets, "doc_count_error_upper_bound": 0}
+    return {"buckets": _as_pairs(buckets),
+            "doc_count_error_upper_bound": 0}
 
 
 def _c_histogram(node, mask, ctx):
@@ -257,7 +280,7 @@ def _c_histogram(node, mask, ctx):
                 bmask = mask & exists & (kk == u)
                 b["subs"] = _collect_subs(node, bmask, ctx)
             buckets[float(u)] = b
-    return {"buckets": buckets, "interval": interval,
+    return {"buckets": _as_pairs(buckets), "interval": interval,
             "min_doc_count": int(node.params.get("min_doc_count", 0))}
 
 
@@ -283,7 +306,7 @@ def _c_date_histogram(node, mask, ctx):
                     b["subs"] = _collect_subs(
                         node, mask & exists & (all_dt == u), ctx)
                 buckets[int(u)] = b
-        return {"buckets": buckets, "date": True}
+        return {"buckets": _as_pairs(buckets), "date": True}
     ms = parse_time_value(interval) * 1000.0
     if v.size:
         keys = np.floor(v / ms) * ms
@@ -294,7 +317,7 @@ def _c_date_histogram(node, mask, ctx):
                 kk = np.floor(vals / ms) * ms
                 b["subs"] = _collect_subs(node, mask & exists & (kk == u), ctx)
             buckets[int(u)] = b
-    return {"buckets": buckets, "date": True}
+    return {"buckets": _as_pairs(buckets), "date": True}
 
 
 def _range_bounds(node, is_date: bool):
@@ -324,7 +347,7 @@ def _c_range(node, mask, ctx, is_date=False):
         if node.subs:
             b["subs"] = _collect_subs(node, bmask, ctx)
         buckets[key] = b
-    return {"buckets": buckets, "keyed_order": [b[0] for b in
+    return {"buckets": _as_pairs(buckets), "keyed_order": [b[0] for b in
                                                 _range_bounds(node, is_date)]}
 
 
@@ -351,7 +374,7 @@ def _c_filters(node, mask, ctx):
         if node.subs:
             b["subs"] = _collect_subs(node, bmask, ctx)
         buckets[key] = b
-    return {"buckets": buckets}
+    return {"buckets": _as_pairs(buckets)}
 
 
 def _c_global(node, mask, ctx):
@@ -435,10 +458,25 @@ def _merge_metric(parts: list[dict]) -> dict:
             "sum_sq": sum(p.get("sum_sq", 0.0) for p in parts)}
 
 
+def _as_pairs(buckets: dict) -> list:
+    """Bucket map → [key, bucket] pairs. Shard partials cross the wire,
+    whose codec stringifies dict KEYS (StreamOutput.write_value); carrying
+    keys as list values keeps numeric histogram/terms keys typed."""
+    return [[k, b] for k, b in buckets.items()]
+
+
+def _bucket_dict(p: dict) -> dict:
+    """Partial's buckets in either form (pairs from a shard, dict from
+    older in-memory paths) → key→bucket dict with typed keys."""
+    b = p.get("buckets", {})
+    return dict(b) if isinstance(b, dict) else {k: v for k, v in b}
+
+
 def _merge_buckets(node: AggNode, parts: list[dict]) -> dict:
+    pdicts = [_bucket_dict(p) for p in parts]
     merged: dict = {}
-    for p in parts:
-        for key, b in p.get("buckets", {}).items():
+    for pd in pdicts:
+        for key, b in pd.items():
             cur = merged.setdefault(key, {"doc_count": 0, "_parts": []})
             cur["doc_count"] += b["doc_count"]
             for extra in ("from", "to"):
@@ -448,8 +486,8 @@ def _merge_buckets(node: AggNode, parts: list[dict]) -> dict:
                 cur["_parts"].append(b["subs"])
     for key, b in merged.items():
         if b.pop("_parts", None) or node.subs:
-            parts_list = [p.get("buckets", {}).get(key, {}).get("subs", {})
-                          for p in parts if key in p.get("buckets", {})]
+            parts_list = [pd[key].get("subs", {})
+                          for pd in pdicts if key in pd]
             b["aggs"] = reduce_aggs(node.subs, [pl for pl in parts_list if pl])
     return merged
 

@@ -96,10 +96,19 @@ class ShardQueryResult:
 class ShardSearcher:
     """Per-shard query execution over a DeviceReader."""
 
-    def __init__(self, shard_id: int, reader: DeviceReader, mapper_service):
+    def __init__(self, shard_id: int, reader: DeviceReader, mapper_service,
+                 index_name: str = ""):
         self.shard_id = shard_id
         self.reader = reader
         self.mapper_service = mapper_service
+        # 11-bit (index, shard) slot for the _doc tie-break: doc ids use
+        # bits 0-41, the slot bits 42-52 — all within float64's 53-bit
+        # mantissa so cross-shard search_after cursors stay exact. The
+        # index hash keeps _doc unique across indices of a multi-index
+        # scroll (same shard id in two indices must not collide).
+        import zlib
+        self._doc_slot = ((zlib.crc32(index_name.encode()) * 31 + shard_id)
+                          & 0x7FF)
         self.ctx = ExecutionContext(reader=reader, mapper_service=mapper_service)
 
     # -- mask/scores over every segment --------------------------------------
@@ -204,9 +213,9 @@ class ShardSearcher:
                 vals = scores.astype(np.float64)
                 out = vals
             elif fname == "_doc":
-                # globally unique across shards so (.., _doc) search_after
-                # cursors are unambiguous at the coordinator
-                vals = (doc_ids + (self.shard_id << 42)).astype(np.float64)
+                # globally unique across shards AND indices so (.., _doc)
+                # search_after cursors are unambiguous at the coordinator
+                vals = (doc_ids + (self._doc_slot << 42)).astype(np.float64)
                 out = vals
             else:
                 vals, out = self._sort_column(fname, n, missing, order)

@@ -57,7 +57,9 @@ class SearchService:
         out = []
         for shard_id, engine in enumerate(index.shard_engines):
             reader = device_reader_for(engine)
-            out.append(ShardSearcher(shard_id, reader, index.mapper_service))
+            out.append(ShardSearcher(shard_id, reader,
+                                     index.mapper_service,
+                                     index_name=index.name))
         return out
 
     def search(self, index, body: dict | None, scroll: str | None = None) -> dict:

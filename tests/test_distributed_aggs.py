@@ -1,0 +1,72 @@
+"""Aggregations reduced across REAL transport boundaries: numeric bucket
+keys (histogram/terms/date_histogram) must survive the wire codec, which
+stringifies dict KEYS — partials carry buckets as [key, bucket] pairs
+(regression: coordinator crashed with TypeError comparing str/float keys
+when shards were split between local and remote nodes)."""
+
+import pytest
+
+from elasticsearch_tpu.testing import InternalTestCluster
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    with InternalTestCluster(
+            2, base_path=tmp_path_factory.mktemp("dagg")) as c:
+        c.wait_for_nodes(2)
+        master = c.master()
+        # enough shards that both nodes hold some → every search mixes
+        # local partials with wire-serialized remote partials
+        master.indices_service.create_index(
+            "metrics", {"settings": {"number_of_shards": 4,
+                                     "number_of_replicas": 0},
+                        "mappings": {"_doc": {"properties": {
+                            "ts": {"type": "date"}}}}})
+        c.wait_for_health("green")
+        ops = []
+        for i in range(120):
+            ops.append(("index", {"_index": "metrics", "_id": f"m{i}"},
+                        {"v": float(i % 10), "group": f"g{i % 3}",
+                         "ts": 1700000000000 + i * 3600_000}))
+        master.document_actions.bulk(ops, refresh=True)
+        yield c
+
+
+def _search(c, body):
+    # search from a NON-master node too, so the coordinator varies
+    return c.non_masters()[0].search_actions.search("metrics", body)
+
+
+def test_histogram_numeric_keys_across_wire(cluster):
+    r = _search(cluster, {"size": 0, "aggs": {
+        "h": {"histogram": {"field": "v", "interval": 2.0}}}})
+    buckets = r["aggregations"]["h"]["buckets"]
+    assert [b["key"] for b in buckets] == [0.0, 2.0, 4.0, 6.0, 8.0]
+    assert all(isinstance(b["key"], float) for b in buckets)
+    assert sum(b["doc_count"] for b in buckets) == 120
+
+
+def test_terms_string_and_numeric_across_wire(cluster):
+    r = _search(cluster, {"size": 0, "aggs": {
+        "g": {"terms": {"field": "group"}},
+        "n": {"terms": {"field": "v", "size": 20}}}})
+    g = {b["key"]: b["doc_count"] for b in r["aggregations"]["g"]["buckets"]}
+    assert g == {"g0": 40, "g1": 40, "g2": 40}
+    n = r["aggregations"]["n"]["buckets"]
+    assert len(n) == 10 and all(b["doc_count"] == 12 for b in n)
+    assert all(isinstance(b["key"], (int, float)) for b in n)
+
+
+def test_date_histogram_with_subagg_across_wire(cluster):
+    r = _search(cluster, {"size": 0, "aggs": {
+        "per_day": {"date_histogram": {"field": "ts", "interval": "1d"},
+                    "aggs": {"avg_v": {"avg": {"field": "v"}}}}}})
+    buckets = r["aggregations"]["per_day"]["buckets"]
+    assert sum(b["doc_count"] for b in buckets) == 120
+    assert len(buckets) == 6                    # 120 hourly points = 5+ days
+    for b in buckets:
+        assert isinstance(b["key"], int)
+        assert b["avg_v"]["value"] is not None
+    # keys ascending (sorted numerically, not lexicographically)
+    keys = [b["key"] for b in buckets]
+    assert keys == sorted(keys)
