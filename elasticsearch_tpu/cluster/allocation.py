@@ -405,8 +405,32 @@ class AllocationService:
                 routing = routing.replace_shard(current, current.started())
         if routing is state.routing_table:
             return state
-        return self.reroute(state.with_(routing_table=routing),
-                            "shards started")
+        state = state.with_(routing_table=routing)
+        state = self._clear_restore_markers(state)
+        return self.reroute(state, "shards started")
+
+    @staticmethod
+    def _clear_restore_markers(state: ClusterState) -> ClusterState:
+        """Once every primary of a restored index is active, drop its
+        index.restore.* settings — the reference clears the restore
+        recovery source when the shard starts; a marker that outlives the
+        repository would otherwise wedge a later re-initialization."""
+        from dataclasses import replace as dc_replace
+        indices = None
+        for name, meta in state.indices.items():
+            if "index.restore.repository" not in meta.settings:
+                continue
+            prims = [sh for sh in state.routing_table.index_shards(name)
+                     if sh.primary]
+            if prims and all(sh.active for sh in prims):
+                settings = {k: v for k, v in meta.settings.items()
+                            if not k.startswith("index.restore.")}
+                if indices is None:
+                    indices = dict(state.indices)
+                indices[name] = dc_replace(meta, settings=settings,
+                                           version=meta.version + 1)
+        return state if indices is None else state.with_(
+            indices=indices, version=state.version)
 
     def apply_failed_shards(self, state: ClusterState,
                             failed: list[tuple[ShardRouting, str]]

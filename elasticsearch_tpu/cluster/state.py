@@ -136,6 +136,12 @@ class IndexMetadata:
     creation_date: int = 0
     uuid: str = ""
     version: int = 1                         # bumped on mapping/settings edit
+    # registered percolator queries {id → query body}. The reference keeps
+    # them as hidden .percolator-type docs per shard
+    # (core/index/percolator/PercolatorQueriesRegistry.java); here they
+    # ride the replicated+persisted metadata instead, which keeps them out
+    # of the document space and recovers them for free.
+    percolators: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {
@@ -152,12 +158,15 @@ class IndexMetadata:
         }
 
     def to_state_dict(self) -> dict:
-        return {"number_of_shards": self.number_of_shards,
-                "number_of_replicas": self.number_of_replicas,
-                "settings": self.settings, "mappings": self.mappings,
-                "aliases": self.aliases, "state": self.state,
-                "creation_date": self.creation_date, "uuid": self.uuid,
-                "version": self.version}
+        out = {"number_of_shards": self.number_of_shards,
+               "number_of_replicas": self.number_of_replicas,
+               "settings": self.settings, "mappings": self.mappings,
+               "aliases": self.aliases, "state": self.state,
+               "creation_date": self.creation_date, "uuid": self.uuid,
+               "version": self.version}
+        if self.percolators:
+            out["percolators"] = self.percolators
+        return out
 
     @staticmethod
     def from_state_dict(name: str, m: dict) -> "IndexMetadata":
@@ -167,7 +176,8 @@ class IndexMetadata:
             settings=m.get("settings", {}), mappings=m.get("mappings", {}),
             aliases=m.get("aliases", {}), state=m.get("state", "open"),
             creation_date=m.get("creation_date", 0), uuid=m.get("uuid", ""),
-            version=m.get("version", 1))
+            version=m.get("version", 1),
+            percolators=m.get("percolators", {}))
 
 
 @dataclass(frozen=True)

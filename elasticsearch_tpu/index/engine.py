@@ -106,10 +106,14 @@ class Engine:
         self.stats = EngineStats()
         self._lock = threading.RLock()
         self._closed = False
-        # set by the peer-recovery target for the duration of a recovery:
-        # a flush would overwrite the commit the source just streamed in
-        # (the reference refuses flush on RECOVERING shards)
-        self.recovery_in_progress = False
+        # While pinned (counter: concurrent recoveries/snapshots may
+        # overlap), flush/force-merge are refused so the committed file
+        # set cannot change underneath a reader of those files: the
+        # peer-recovery TARGET pins while a source streams a commit in,
+        # and recovery sources/snapshot uploads pin while reading the
+        # commit out (the reference holds an IndexCommit ref / blocks
+        # flush on RECOVERING shards for the same windows).
+        self._commit_pins = 0
 
         durability = settings.get("index.translog.durability", DURABILITY_REQUEST)
         self.translog = Translog(self.path / "translog", durability=durability)
@@ -315,8 +319,8 @@ class Engine:
         (InternalEngine.java:616: Lucene commit + translog roll)."""
         with self._lock:
             self._ensure_open()
-            if self.recovery_in_progress:
-                return                           # see recovery_in_progress
+            if self._commit_pins:
+                return                           # commit pinned — no flush
             self.refresh()
             for seg, mask in zip(self._segments, self._live_masks):
                 seg_dir = self.path / f"seg_{seg.seg_id}"
@@ -343,8 +347,8 @@ class Engine:
         deleted docs (ElasticsearchConcurrentMergeScheduler's job)."""
         with self._lock:
             self._ensure_open()
-            if self.recovery_in_progress:
-                return                           # see recovery_in_progress
+            if self._commit_pins:
+                return                           # commit pinned — no merge
             self.refresh()
             if len(self._segments) <= max_num_segments:
                 return
@@ -437,6 +441,24 @@ class Engine:
         local = self._buffer.add(parsed)
         self._buffer_docs[op.doc_id] = local
         self._versions[op.doc_id] = VersionEntry(op.version, False, -1, local)
+
+    @property
+    def recovery_in_progress(self) -> bool:
+        return self._commit_pins > 0
+
+    def pin_commit(self, flush_first: bool = True) -> None:
+        """Freeze the committed file set (refuse flush/merge) until
+        unpin_commit — atomic under the engine lock so no merge can slip
+        between the flush and the pin. Counted: overlapping pins stack."""
+        with self._lock:
+            self._ensure_open()
+            if flush_first and self._commit_pins == 0:
+                self.flush()
+            self._commit_pins += 1
+
+    def unpin_commit(self) -> None:
+        with self._lock:
+            self._commit_pins = max(0, self._commit_pins - 1)
 
     # ------------------------------------------------ peer recovery (source)
 

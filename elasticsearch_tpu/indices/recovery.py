@@ -72,8 +72,21 @@ class PeerRecoveryService:
         """IndicesService.prepare_shard hook: called with an INITIALIZING
         shard before it is reported started. Primaries recover locally
         (Engine.__init__ already replayed the on-disk commit + translog —
-        StoreRecovery analog); replicas pull from the active primary."""
+        StoreRecovery analog) or from a snapshot repository when the index
+        carries a restore marker; replicas pull from the active primary."""
         if shard_routing.primary:
+            repo = engine.settings.get("index.restore.repository")
+            if repo and engine.num_docs == 0:
+                # restore recovery source (RestoreService): pull the
+                # snapshot's files instead of starting empty. Non-empty
+                # engines are already-restored copies re-initializing
+                # after a local restart — leave them alone.
+                self.node.snapshots_service.repository(repo).restore_shard(
+                    engine,
+                    engine.settings.get("index.restore.source_index",
+                                        shard_routing.index),
+                    shard_routing.shard,
+                    engine.settings.get("index.restore.snapshot"))
             return                               # local store recovery
         state = self.node.cluster_service.state()
         pr = state.routing_table.primary(shard_routing.index,
@@ -86,8 +99,8 @@ class PeerRecoveryService:
         if source_node is None:
             raise DelayRecoveryError("primary node not in cluster state")
         local = self.node.transport_service.local_node
-        engine.recovery_in_progress = True
-        try:
+        engine.pin_commit(flush_first=False)     # block local flush/merge
+        try:                                     # while files stream in
             self.node.transport_service.submit_request(
                 source_node, START_RECOVERY,
                 {"index": shard_routing.index, "shard": shard_routing.shard,
@@ -104,7 +117,7 @@ class PeerRecoveryService:
                 raise DelayRecoveryError(e.reason) from None
             raise
         finally:
-            engine.recovery_in_progress = False
+            engine.unpin_commit()
 
     # ---- source side -------------------------------------------------------
 
@@ -126,12 +139,13 @@ class PeerRecoveryService:
                                TransportAddress(tn["host"], tn["port"]))
         t0 = time.perf_counter()
         # phase1 prologue: pin the translog FIRST (so no flush anywhere can
-        # trim ops we must replay), then make a stable commit. The view
+        # trim ops we must replay), then flush AND pin the commit so a
+        # concurrent merge can't delete segment files mid-stream. The view
         # starts at the pre-flush commit, so phase2 re-sends some ops that
         # ended up inside the new commit — harmless, replica apply is
         # version-idempotent.
         view_gen = engine.translog.acquire_view()
-        engine.flush()
+        engine.pin_commit()
         try:
             files_sent, bytes_sent, skipped = self._phase1(
                 engine, engine.file_manifest(), target, index, shard,
@@ -139,6 +153,7 @@ class PeerRecoveryService:
             ops = engine.translog.ops_since(view_gen)
             self._phase2(engine, target, index, shard, ops)
         finally:
+            engine.unpin_commit()
             engine.translog.release_view(view_gen)
         self.stats["recoveries"] += 1
         self.stats["files_sent"] += files_sent

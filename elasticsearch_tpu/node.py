@@ -85,6 +85,9 @@ class Node:
         self.recovery_service = PeerRecoveryService(self)
         self.indices_service.prepare_shard = \
             self.recovery_service.recover_shard
+        # snapshot/restore (core/snapshots/)
+        from elasticsearch_tpu.snapshots import SnapshotsService
+        self.snapshots_service = SnapshotsService(self)
         self._delayed_reroute_timer = None
         self.cluster_service.add_listener(self._schedule_delayed_reroute)
         from elasticsearch_tpu.discovery import ZenDiscovery
@@ -190,6 +193,23 @@ class Node:
             "delete-template": lambda: self.delete_template(req["name"]),
             "cluster-settings": lambda: self.update_cluster_settings(
                 req["body"]),
+            "put-percolator": lambda: isvc.put_percolator(
+                req["index"], req["id"], req["body"]),
+            "delete-percolator": lambda: isvc.delete_percolator(
+                req["index"], req["id"]),
+            "put-repository": lambda: self.snapshots_service.put_repository(
+                req["name"], req["body"]),
+            "delete-repository": lambda:
+                self.snapshots_service.delete_repository(req["name"]),
+            "create-snapshot": lambda:
+                self.snapshots_service._create_on_master(
+                    req["repo"], req["snapshot"], req["body"]),
+            "delete-snapshot": lambda:
+                self.snapshots_service.delete_snapshot(req["repo"],
+                                                       req["snapshot"]),
+            "restore-snapshot": lambda:
+                self.snapshots_service._restore_on_master(
+                    req["repo"], req["snapshot"], req["body"]),
         }
         fn = dispatch.get(action)
         if fn is None:

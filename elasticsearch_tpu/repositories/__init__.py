@@ -1,0 +1,21 @@
+"""Repositories — blob-store persistence for snapshot/restore.
+
+Reference: core/repositories/ — Repository SPI over a BlobStore
+(core/common/blobstore/; fs impl FsBlobStore/FsBlobContainer), with
+BlobStoreRepository (core/repositories/blobstore/BlobStoreRepository.java:118)
+implementing the snapshot format: a repo-level snapshot list, per-snapshot
+global metadata, and per-shard file manifests over content-addressed blobs
+(incremental: a file already present in the repo is never uploaded again —
+BlobStoreIndexShardRepository.java:74 snapshot/restore file dedupe).
+"""
+
+from elasticsearch_tpu.repositories.blobstore import (
+    FsBlobContainer, FsBlobStore)
+from elasticsearch_tpu.repositories.repository import (
+    FsRepository, RepositoryError, RepositoryMissingError,
+    SnapshotMissingError, repository_for)
+
+__all__ = [
+    "FsBlobContainer", "FsBlobStore", "FsRepository", "RepositoryError",
+    "RepositoryMissingError", "SnapshotMissingError", "repository_for",
+]

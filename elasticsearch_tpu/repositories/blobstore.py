@@ -1,0 +1,57 @@
+"""Blob-store abstraction + filesystem implementation.
+
+Reference: core/common/blobstore/BlobStore.java / BlobContainer.java and
+fs/FsBlobStore.java — the minimal contract snapshot/restore needs: named
+byte blobs in hierarchical containers, atomic writes, listing. Cloud
+stores (s3/azure plugins in the reference) implement the same contract.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+class FsBlobContainer:
+    """One directory of blobs; writes are write-tmp-then-rename atomic
+    (the reference's FsBlobContainer + MetaDataStateFormat discipline)."""
+
+    def __init__(self, path: Path):
+        self.path = Path(path)
+
+    def _ensure(self) -> None:
+        self.path.mkdir(parents=True, exist_ok=True)
+
+    def read_blob(self, name: str) -> bytes:
+        return (self.path / name).read_bytes()
+
+    def write_blob(self, name: str, data: bytes) -> None:
+        self._ensure()
+        tmp = self.path / f".{name}.tmp"
+        tmp.write_bytes(data)
+        os.replace(tmp, self.path / name)
+
+    def exists(self, name: str) -> bool:
+        return (self.path / name).exists()
+
+    def list_blobs(self) -> dict[str, int]:
+        if not self.path.exists():
+            return {}
+        return {p.name: p.stat().st_size for p in self.path.iterdir()
+                if p.is_file() and not p.name.startswith(".")}
+
+    def delete_blob(self, name: str) -> None:
+        (self.path / name).unlink(missing_ok=True)
+
+
+class FsBlobStore:
+    def __init__(self, location: str | Path):
+        self.location = Path(location)
+
+    def container(self, *segments: str) -> FsBlobContainer:
+        p = self.location
+        for s in segments:
+            if ".." in s or s.startswith("/"):
+                raise ValueError(f"illegal blob path segment [{s}]")
+            p = p / s
+        return FsBlobContainer(p)

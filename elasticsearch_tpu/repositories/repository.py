@@ -1,0 +1,181 @@
+"""Snapshot repository over a blob store.
+
+Reference: core/repositories/blobstore/BlobStoreRepository.java:118 —
+repo layout:
+
+* ``index.json``                 — snapshot name list (RepositoryData)
+* ``snap-{name}.json``           — global snapshot metadata (indices,
+  their settings/mappings, state, failures, timing)
+* ``indices/{index}/{shard}/``   — per-shard container:
+  * ``blob-{crc:08x}-{size}``    — content-addressed file blobs, shared
+    between snapshots (incremental dedupe,
+    BlobStoreIndexShardRepository.java:74)
+  * ``snap-{name}.json``         — shard manifest: source file → blob
+
+Shard snapshot/restore round-trips the engine's committed files — the
+same checksummed manifest peer recovery uses (Store.MetadataSnapshot
+analog, elasticsearch_tpu/index/engine.py file_manifest).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from elasticsearch_tpu.repositories.blobstore import FsBlobStore
+
+
+class RepositoryError(Exception):
+    pass
+
+
+class RepositoryMissingError(RepositoryError):
+    pass
+
+
+class SnapshotMissingError(RepositoryError):
+    pass
+
+
+class SnapshotAlreadyExistsError(RepositoryError):
+    pass
+
+
+def repository_for(name: str, spec: dict) -> "FsRepository":
+    """Instantiate a repository from its cluster-state registration
+    ({"type": ..., "settings": {...}}). Only "fs" ships in-core, like the
+    reference (s3/azure arrive as plugins via the same contract)."""
+    rtype = spec.get("type", "fs")
+    if rtype != "fs":
+        raise RepositoryError(f"unknown repository type [{rtype}]")
+    location = (spec.get("settings") or {}).get("location")
+    if not location:
+        raise RepositoryError(f"repository [{name}] requires settings.location")
+    return FsRepository(name, location)
+
+
+class FsRepository:
+    def __init__(self, name: str, location: str):
+        self.name = name
+        self.store = FsBlobStore(location)
+        self.root = self.store.container()
+
+    # ---- repo-level metadata ----------------------------------------------
+
+    def snapshot_names(self) -> list[str]:
+        if not self.root.exists("index.json"):
+            return []
+        return json.loads(self.root.read_blob("index.json"))["snapshots"]
+
+    def _write_names(self, names: list[str]) -> None:
+        self.root.write_blob("index.json",
+                             json.dumps({"snapshots": names}).encode())
+
+    def verify(self) -> None:
+        """PUT-time verification (the reference writes a test blob from
+        the master and reads it back from every node)."""
+        probe = self.store.container("tests")
+        probe.write_blob("verify.dat", b"estpu-verify")
+        if probe.read_blob("verify.dat") != b"estpu-verify":
+            raise RepositoryError(f"repository [{self.name}] failed verify")
+        probe.delete_blob("verify.dat")
+
+    # ---- global snapshot metadata -----------------------------------------
+
+    def read_snapshot(self, snapshot: str) -> dict:
+        if not self.root.exists(f"snap-{snapshot}.json"):
+            raise SnapshotMissingError(
+                f"[{self.name}:{snapshot}] is missing")
+        return json.loads(self.root.read_blob(f"snap-{snapshot}.json"))
+
+    def begin_snapshot(self, snapshot: str) -> None:
+        if snapshot in self.snapshot_names() or \
+                self.root.exists(f"snap-{snapshot}.json"):
+            raise SnapshotAlreadyExistsError(
+                f"[{self.name}:{snapshot}] already exists")
+
+    def finalize_snapshot(self, snapshot: str, meta: dict) -> None:
+        self.root.write_blob(f"snap-{snapshot}.json",
+                             json.dumps(meta).encode())
+        names = self.snapshot_names()
+        if snapshot not in names:
+            self._write_names(names + [snapshot])
+
+    def delete_snapshot(self, snapshot: str) -> None:
+        meta = self.read_snapshot(snapshot)
+        self._write_names([n for n in self.snapshot_names() if n != snapshot])
+        self.root.delete_blob(f"snap-{snapshot}.json")
+        # drop shard manifests, then garbage-collect blobs no surviving
+        # manifest references (file-level incremental dedupe means blobs
+        # can be shared between snapshots)
+        for index in meta.get("indices", {}):
+            nshards = meta["indices"][index]["shards"]
+            for shard in range(nshards):
+                c = self.store.container("indices", index, str(shard))
+                c.delete_blob(f"snap-{snapshot}.json")
+                live: set[str] = set()
+                for blob in c.list_blobs():
+                    if blob.startswith("snap-") and blob.endswith(".json"):
+                        manifest = json.loads(c.read_blob(blob))
+                        live.update(f["blob"] for f in manifest["files"])
+                for blob in list(c.list_blobs()):
+                    if blob.startswith("blob-") and blob not in live:
+                        c.delete_blob(blob)
+
+    # ---- shard-level snapshot / restore -----------------------------------
+
+    def snapshot_shard(self, engine, index: str, shard: int,
+                       snapshot: str) -> dict:
+        """Flush + upload the shard's committed files, skipping blobs the
+        repo already holds. The commit stays pinned for the whole upload —
+        a concurrent merge/flush deleting or rewriting committed files
+        mid-read would corrupt the snapshot (the reference holds an
+        IndexCommit reference for the same window). → stats dict."""
+        engine.pin_commit()
+        try:
+            manifest = engine.file_manifest()
+            container = self.store.container("indices", index, str(shard))
+            files, uploaded, reused_bytes = [], 0, 0
+            t0 = time.perf_counter()
+            for rel, (size, crc) in manifest.items():
+                blob = f"blob-{crc:08x}-{size}"
+                if not container.exists(blob):
+                    container.write_blob(blob,
+                                         (engine.path / rel).read_bytes())
+                    uploaded += size
+                else:
+                    reused_bytes += size
+                files.append({"path": rel, "blob": blob, "size": size,
+                              "crc": crc})
+            container.write_blob(f"snap-{snapshot}.json",
+                                 json.dumps({"files": files}).encode())
+        finally:
+            engine.unpin_commit()
+        return {"files": len(files), "uploaded_bytes": uploaded,
+                "reused_bytes": reused_bytes,
+                "took_ms": int((time.perf_counter() - t0) * 1e3)}
+
+    def restore_shard(self, engine, index: str, shard: int,
+                      snapshot: str) -> dict:
+        """Write the snapshot's files under the engine path and swap the
+        commit in (same install path as peer recovery phase1)."""
+        container = self.store.container("indices", index, str(shard))
+        if not container.exists(f"snap-{snapshot}.json"):
+            raise SnapshotMissingError(
+                f"[{self.name}:{snapshot}] has no shard [{index}][{shard}]")
+        manifest = json.loads(container.read_blob(f"snap-{snapshot}.json"))
+        restored = 0
+        for f in manifest["files"]:
+            rel = f["path"]
+            if ".." in rel or rel.startswith("/"):
+                raise RepositoryError(f"illegal restore path [{rel}]")
+            dest = engine.path / rel
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            data = container.read_blob(f["blob"])
+            tmp = dest.with_name(dest.name + ".res")
+            tmp.write_bytes(data)
+            import os
+            os.replace(tmp, dest)
+            restored += f["size"]
+        engine.install_recovered_commit()
+        return {"files": len(manifest["files"]), "restored_bytes": restored}

@@ -1,0 +1,13 @@
+"""Snapshot/restore — master-coordinated backup of indices to a
+repository and recovery back out of it.
+
+Reference: core/snapshots/ — SnapshotsService (master-side coordination,
+progress tracked in the SnapshotsInProgress cluster-state custom),
+SnapshotShardsService (data nodes upload their primary shards),
+RestoreService (indices re-created from snapshot metadata, shards
+recovered from the repository instead of a peer).
+"""
+
+from elasticsearch_tpu.snapshots.service import SnapshotsService
+
+__all__ = ["SnapshotsService"]

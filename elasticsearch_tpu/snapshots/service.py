@@ -1,0 +1,250 @@
+"""SnapshotsService — create/get/delete/restore snapshots.
+
+Reference call shape (core/snapshots/SnapshotsService.java): the master
+records the snapshot in a cluster-state custom (visibility + concurrency
+gate, ``SnapshotsInProgress``), fans shard uploads out to the nodes
+holding each primary (SnapshotShardsService analog — here a transport
+action per shard), then finalizes global metadata in the repository.
+Restore (RestoreService): indices are re-created from the snapshot's
+metadata with an ``index.restore.*`` marker; each primary's recovery then
+pulls files from the repository instead of a peer (the reference's
+restore recovery source), and replicas peer-recover from the restored
+primary as usual.
+
+Repository registrations live in the ``repositories`` cluster-state
+custom ({name → {type, settings}}), the analog of the reference's
+RepositoriesMetaData persisted in MetaData customs.
+"""
+
+from __future__ import annotations
+
+import time
+
+from elasticsearch_tpu.repositories import (
+    RepositoryMissingError, repository_for)
+
+SNAPSHOT_SHARD_ACTION = "internal:snapshot/shard"
+
+
+class SnapshotsService:
+    def __init__(self, node):
+        self.node = node
+        node.transport_service.register_request_handler(
+            SNAPSHOT_SHARD_ACTION, self._handle_snapshot_shard,
+            executor="snapshot", sync=True)
+
+    # ---- repository registry ----------------------------------------------
+
+    def _repos(self) -> dict:
+        return self.node.cluster_service.state().customs.get(
+            "repositories", {})
+
+    def repository(self, name: str):
+        spec = self._repos().get(name)
+        if spec is None:
+            raise RepositoryMissingError(f"[{name}] missing")
+        return repository_for(name, spec)
+
+    def put_repository(self, name: str, body: dict) -> None:
+        repository_for(name, body).verify()      # fail fast on bad config
+
+        def local():
+            def update(st):
+                repos = {**st.customs.get("repositories", {}), name: body}
+                return st.with_(customs={**st.customs,
+                                         "repositories": repos})
+            self.node.cluster_service.submit_and_wait(
+                f"put-repository [{name}]", update)
+        self.node.indices_service._master_op(
+            "put-repository", {"name": name, "body": body}, local)
+
+    def delete_repository(self, name: str) -> None:
+        def local():
+            def update(st):
+                repos = {k: v for k, v in
+                         st.customs.get("repositories", {}).items()
+                         if k != name}
+                return st.with_(customs={**st.customs,
+                                         "repositories": repos})
+            self.node.cluster_service.submit_and_wait(
+                f"delete-repository [{name}]", update)
+        self.node.indices_service._master_op(
+            "delete-repository", {"name": name}, local)
+
+    def get_repositories(self, name: str | None = None) -> dict:
+        repos = self._repos()
+        if name and name not in ("_all", "*"):
+            if name not in repos:
+                raise RepositoryMissingError(f"[{name}] missing")
+            return {name: repos[name]}
+        return dict(repos)
+
+    # ---- create ------------------------------------------------------------
+
+    def create_snapshot(self, repo: str, snapshot: str,
+                        body: dict | None = None) -> dict:
+        body = body or {}
+        request = {"repo": repo, "snapshot": snapshot, "body": body}
+        out: dict = {}
+
+        def local():
+            out.update(self._create_on_master(repo, snapshot, body))
+        self.node.indices_service._master_op("create-snapshot", request,
+                                             local)
+        if not out:                              # ran remotely on master
+            out.update(self.repository(repo).read_snapshot(snapshot))
+        return {"snapshot": out}
+
+    def _create_on_master(self, repo: str, snapshot: str,
+                          body: dict) -> dict:
+        repository = self.repository(repo)
+        repository.begin_snapshot(snapshot)
+        state = self.node.cluster_service.state()
+        expr = ",".join(body.get("indices", ["_all"])) \
+            if isinstance(body.get("indices", "_all"), list) \
+            else body.get("indices", "_all")
+        names = [n for n in self.node.indices_service._resolve(state, expr)
+                 if state.indices[n].state == "open"]
+        t0 = time.time()
+        # visibility + concurrency gate (SnapshotsInProgress custom)
+        self._set_in_progress({"repository": repo, "snapshot": snapshot,
+                               "state": "STARTED", "indices": names})
+        shards_ok = shards_failed = 0
+        failures: list[dict] = []
+        indices_meta: dict = {}
+        try:
+            for name in names:
+                meta = state.indices[name]
+                indices_meta[name] = {
+                    "shards": meta.number_of_shards,
+                    "settings": dict(meta.settings),
+                    "mappings": meta.mappings or {},
+                }
+                for shard in range(meta.number_of_shards):
+                    try:
+                        self._snapshot_one_shard(state, repo, snapshot,
+                                                 name, shard)
+                        shards_ok += 1
+                    except Exception as e:       # noqa: BLE001 — partial
+                        shards_failed += 1
+                        failures.append({"index": name, "shard_id": shard,
+                                         "reason": str(e)})
+        finally:
+            self._set_in_progress(None)
+        meta_out = {
+            "snapshot": snapshot,
+            "repository": repo,
+            "indices": indices_meta,
+            "state": "SUCCESS" if not shards_failed else "PARTIAL",
+            "start_time_in_millis": int(t0 * 1000),
+            "end_time_in_millis": int(time.time() * 1000),
+            "shards": {"total": shards_ok + shards_failed,
+                       "successful": shards_ok, "failed": shards_failed},
+            "failures": failures,
+        }
+        repository.finalize_snapshot(snapshot, meta_out)
+        return meta_out
+
+    def _snapshot_one_shard(self, state, repo: str, snapshot: str,
+                            name: str, shard: int) -> dict:
+        pr = state.routing_table.primary(name, shard)
+        if pr is None or not pr.active:
+            raise RuntimeError(f"primary [{name}][{shard}] not active")
+        request = {"repo": repo, "snapshot": snapshot,
+                   "index": name, "shard": shard}
+        if pr.node_id == self.node.node_id:
+            return self._handle_snapshot_shard(request, None)
+        target = state.node(pr.node_id)
+        return self.node.transport_service.submit_request(
+            target, SNAPSHOT_SHARD_ACTION, request, timeout=120.0)
+
+    def _handle_snapshot_shard(self, request: dict, source) -> dict:
+        svc = self.node.indices_service.indices.get(request["index"])
+        engine = svc.engines.get(request["shard"]) if svc else None
+        if engine is None:
+            raise RuntimeError(
+                f"[{request['index']}][{request['shard']}] not on this node")
+        repository = self.repository(request["repo"])
+        return repository.snapshot_shard(engine, request["index"],
+                                         request["shard"],
+                                         request["snapshot"])
+
+    def _set_in_progress(self, entry: dict | None) -> None:
+        def update(st):
+            customs = dict(st.customs)
+            if entry is None:
+                customs.pop("snapshots_in_progress", None)
+            else:
+                if customs.get("snapshots_in_progress"):
+                    raise RuntimeError(
+                        "a snapshot is already running")
+                customs["snapshots_in_progress"] = entry
+            return st.with_(customs=customs)
+        self.node.cluster_service.submit_and_wait("update-snapshot-state",
+                                                  update)
+
+    # ---- read / delete -----------------------------------------------------
+
+    def get_snapshots(self, repo: str, which: str = "_all") -> dict:
+        repository = self.repository(repo)
+        if which in ("_all", "*"):
+            names = repository.snapshot_names()
+        else:
+            names = which.split(",")
+        return {"snapshots": [repository.read_snapshot(n) for n in names]}
+
+    def snapshot_status(self) -> dict:
+        entry = self.node.cluster_service.state().customs.get(
+            "snapshots_in_progress")
+        return {"snapshots": [entry] if entry else []}
+
+    def delete_snapshot(self, repo: str, snapshot: str) -> None:
+        def local():
+            self.repository(repo).delete_snapshot(snapshot)
+        self.node.indices_service._master_op(
+            "delete-snapshot", {"repo": repo, "snapshot": snapshot}, local)
+
+    # ---- restore -----------------------------------------------------------
+
+    def restore_snapshot(self, repo: str, snapshot: str,
+                         body: dict | None = None) -> dict:
+        body = body or {}
+        request = {"repo": repo, "snapshot": snapshot, "body": body}
+        out: dict = {}
+
+        def local():
+            out.update(self._restore_on_master(repo, snapshot, body))
+        self.node.indices_service._master_op("restore-snapshot", request,
+                                             local)
+        return out or {"accepted": True}
+
+    def _restore_on_master(self, repo: str, snapshot: str,
+                           body: dict) -> dict:
+        meta = self.repository(repo).read_snapshot(snapshot)
+        want = body.get("indices")
+        if isinstance(want, str):
+            want = [s.strip() for s in want.split(",")]
+        rename_pat = body.get("rename_pattern")
+        rename_rep = body.get("rename_replacement", "")
+        restored = []
+        for name, imeta in meta["indices"].items():
+            if want and name not in want:
+                continue
+            target = name
+            if rename_pat:
+                import re
+                target = re.sub(rename_pat, rename_rep, name)
+            settings = dict(imeta["settings"])
+            settings.update(body.get("index_settings", {}))
+            # the restore marker routes primary recovery to the repository
+            # (the reference's restore recovery source on IndexMetaData)
+            settings["index.restore.repository"] = repo
+            settings["index.restore.snapshot"] = snapshot
+            settings["index.restore.source_index"] = name
+            self.node.indices_service.create_index(
+                target, {"settings": settings,
+                         "mappings": imeta["mappings"]})
+            restored.append(target)
+        return {"snapshot": {"snapshot": snapshot,
+                             "indices": restored,
+                             "shards": meta.get("shards", {})}}
