@@ -71,6 +71,7 @@ class _ScrollContext:
 
 class SearchActions:
     QUERY_FETCH = "indices:data/read/search[phase/query+fetch]"
+    FIELD_STATS = "indices:data/read/field_stats[s]"
 
     def __init__(self, node):
         self.node = node
@@ -82,6 +83,9 @@ class SearchActions:
         self._lock = threading.Lock()
         node.transport_service.register_request_handler(
             self.QUERY_FETCH, self._handle_shard_query, executor="search",
+            sync=True)
+        node.transport_service.register_request_handler(
+            self.FIELD_STATS, self._handle_field_stats, executor="search",
             sync=True)
         # keep-alive reaper: abandoned scroll contexts must not accumulate
         # for the node's lifetime (SearchService keep-alive reaper,
@@ -106,23 +110,31 @@ class SearchActions:
 
     def _handle_shard_query(self, request: dict, source) -> dict:
         return self._execute_shard(request["index"], request["shard"],
-                                   request["body"])
+                                   request["body"],
+                                   doc_slot=request.get("doc_slot"))
 
-    def _execute_shard(self, name: str, shard: int, body: dict) -> dict:
+    def _execute_shard(self, name: str, shard: int, body: dict,
+                       doc_slot: int | None = None) -> dict:
         svc = self.node.indices_service.index(name)
         engine = svc.engine(shard)
         reader = device_reader_for(engine)
         searcher = ShardSearcher(shard, reader, svc.mapper_service,
-                                 index_name=name)
+                                 index_name=name, doc_slot=doc_slot)
         req = parse_search_request(body)
         result = searcher.query_phase(req)
         k = min(len(result.doc_ids), req.from_ + req.size)
         hits = searcher.fetch_phase(req, result, name, list(range(k)))
-        return {"total": result.total,
-                "max_score": (float(result.max_score)
-                              if result.max_score is not None else None),
-                "hits": hits,
-                "aggs": wire_safe(result.agg_partials)}
+        out = {"total": result.total,
+               "max_score": (float(result.max_score)
+                             if result.max_score is not None else None),
+               "hits": hits,
+               "aggs": wire_safe(result.agg_partials)}
+        if req.suggest:
+            from elasticsearch_tpu.search.suggest import ShardSuggester
+            sg = ShardSuggester(reader, svc.mapper_service)
+            out["suggest"] = {spec.name: sg.collect(spec)
+                              for spec in req.suggest}
+        return out
 
     # ---- coordinator -------------------------------------------------------
 
@@ -149,7 +161,7 @@ class SearchActions:
         return groups
 
     def _try_shard(self, state, name: str, sid: int, copies: list,
-                   body: dict):
+                   body: dict, doc_slot: int | None = None):
         """→ ("ok", payload) or ("fail", reason-dict). Walks the copy list
         (shard-failover retry, TransportSearchTypeAction.java:205-247)."""
         from elasticsearch_tpu.action.replication import unwrap_remote
@@ -159,13 +171,15 @@ class SearchActions:
         for c in copies:
             try:
                 if c.node_id == self.node.node_id:
-                    return "ok", self._execute_shard(name, sid, body)
+                    return "ok", self._execute_shard(name, sid, body,
+                                                     doc_slot=doc_slot)
                 target = state.node(c.node_id)
                 if target is None:
                     continue
                 return "ok", self.node.transport_service.send_request(
                     target, self.QUERY_FETCH,
-                    {"index": name, "shard": sid, "body": body},
+                    {"index": name, "shard": sid, "body": body,
+                     "doc_slot": doc_slot},
                     timeout=30.0).result(35.0)
             except Exception as e:               # noqa: BLE001 — classify
                 e = unwrap_remote(e)
@@ -203,8 +217,12 @@ class SearchActions:
         state = self.node.cluster_service.state()
         req = parse_search_request(body)
         groups = self._shard_groups(state, names)
+        # dense, deterministic _doc slots per (index, shard): sorted so a
+        # scroll's later pages (same index set) assign identical slots
+        slot_of = {(n, s): i for i, (n, s) in
+                   enumerate(sorted((n, s) for n, s, _ in groups))}
         futures = [self._pool.submit(self._try_shard, state, n, s, copies,
-                                     body)
+                                     body, slot_of[(n, s)])
                    for n, s, copies in groups]
         payloads, failures = [], []
         for fut in futures:
@@ -221,6 +239,119 @@ class SearchActions:
         resp = self.search(index_expr, {**(body or {}), "size": 0})
         return {"count": resp["hits"]["total"]["value"],
                 "_shards": resp["_shards"]}
+
+    # ---- field stats (core/action/fieldstats/TransportFieldStatsAction) ----
+
+    def field_stats(self, index_expr: str, fields: list[str]) -> dict:
+        """Per-field min/max/doc-count over one copy of every shard,
+        reduced cluster-wide (the 2.x _field_stats API, level=cluster)."""
+        names = self.node.indices_service.resolve(index_expr)
+        state = self.node.cluster_service.state()
+        groups = self._shard_groups(state, names)
+        body = {"fields": fields}
+        futures = [self._pool.submit(
+            self._try_shard_action, state, n, s, copies, self.FIELD_STATS,
+            self._handle_field_stats, body) for n, s, copies in groups]
+        merged: dict[str, dict] = {}
+        ok = failed = 0
+        for fut in futures:
+            status, payload = fut.result()
+            if status != "ok":
+                failed += 1
+                continue
+            ok += 1
+            for f, st in payload["fields"].items():
+                cur = merged.get(f)
+                if cur is None:
+                    merged[f] = dict(st)
+                    continue
+                cur["doc_count"] += st["doc_count"]
+                cur["max_doc"] += st["max_doc"]
+                for k, pick in (("min_value", min), ("max_value", max)):
+                    if st.get(k) is None:
+                        continue
+                    if cur.get(k) is None:
+                        cur[k] = st[k]
+                    elif isinstance(st[k], str) != isinstance(cur[k], str):
+                        # same field name mapped to different types across
+                        # indices (numeric vs text) — the values are not
+                        # comparable; flag instead of crashing (the
+                        # reference reports per-field conflicts)
+                        cur[k] = None
+                        cur["type_conflict"] = True
+                    else:
+                        cur[k] = pick(cur[k], st[k])
+        for st in merged.values():
+            st["density"] = int(100 * st["doc_count"] /
+                                max(st["max_doc"], 1))
+        return {"_shards": {"total": len(groups), "successful": ok,
+                            "failed": failed},
+                "indices": {"_all": {"fields": merged}}}
+
+    def _try_shard_action(self, state, name, sid, copies, action,
+                          local_handler, body):
+        """Copy-failover for non-search per-shard actions."""
+        from elasticsearch_tpu.action.replication import unwrap_remote
+        last = None
+        for c in copies:
+            try:
+                request = {"index": name, "shard": sid, "body": body}
+                if c.node_id == self.node.node_id:
+                    return "ok", local_handler(request, None)
+                target = state.node(c.node_id)
+                if target is None:
+                    continue
+                return "ok", self.node.transport_service.send_request(
+                    target, action, request, timeout=30.0).result(35.0)
+            except Exception as e:               # noqa: BLE001 — failover
+                last = unwrap_remote(e)
+        return "fail", {"shard": sid, "index": name, "reason": str(last)}
+
+    def _handle_field_stats(self, request: dict, source) -> dict:
+        import numpy as np
+        name, shard = request["index"], request["shard"]
+        fields = (request.get("body") or {}).get("fields") or []
+        svc = self.node.indices_service.index(name)
+        engine = svc.engine(shard)
+        reader = device_reader_for(engine)
+        out: dict[str, dict] = {}
+        max_doc = reader.num_docs
+        for f in fields:
+            doc_count = 0
+            min_v = max_v = None
+            for s in reader.segments:
+                live = np.asarray(s.live)
+                ncol = s.seg.numeric_fields.get(f)
+                if ncol is not None:
+                    exists = np.asarray(ncol.exists)[:live.shape[0]] & live
+                    doc_count += int(exists.sum())
+                    if exists.any():
+                        vals = np.asarray(ncol.values)[:live.shape[0]][exists]
+                        lo, hi = float(vals.min()), float(vals.max())
+                        min_v = lo if min_v is None else min(min_v, lo)
+                        max_v = hi if max_v is None else max(max_v, hi)
+                    continue
+                tcol = s.seg.text_fields.get(f)
+                if tcol is not None:
+                    has = (np.asarray(tcol.uterms) >= 0).any(axis=1)
+                    doc_count += int((has[:live.shape[0]] & live).sum())
+                    if tcol.terms:
+                        lo, hi = tcol.terms[0], tcol.terms[-1]
+                        min_v = lo if min_v is None else min(min_v, lo)
+                        max_v = hi if max_v is None else max(max_v, hi)
+                    continue
+                kcol = s.seg.keyword_fields.get(f)
+                if kcol is not None:
+                    has = (np.asarray(kcol.ords) >= 0).any(axis=1)
+                    doc_count += int((has[:live.shape[0]] & live).sum())
+                    if kcol.vocab:
+                        lo, hi = kcol.vocab[0], kcol.vocab[-1]
+                        min_v = lo if min_v is None else min(min_v, lo)
+                        max_v = hi if max_v is None else max(max_v, hi)
+            if doc_count:
+                out[f] = {"max_doc": max_doc, "doc_count": doc_count,
+                          "min_value": min_v, "max_value": max_v}
+        return {"fields": out}
 
     # ---- scroll ------------------------------------------------------------
 

@@ -103,7 +103,10 @@ class FieldMapper:
             self.analyzer = analysis.get(params.get("analyzer", "standard"))
             self.search_analyzer = analysis.get(
                 params.get("search_analyzer", params.get("analyzer", "standard")))
-        elif self.type == "keyword":
+        elif self.type in ("keyword", "completion"):
+            # completion (suggest) inputs are stored as exact values; the
+            # suggester prefix-scans the sorted vocab, standing in for the
+            # reference's FST-backed CompletionFieldMapper
             self.kind = KIND_KEYWORD
         elif self.type in NUMERIC_TYPES:
             self.kind = KIND_NUMERIC
@@ -160,7 +163,21 @@ class FieldMapper:
                 if toks:
                     position += toks[-1].position + POSITION_INCREMENT_GAP
         elif self.kind == KIND_KEYWORD:
-            pf.keywords = [str(v) for v in values if v is not None]
+            if self.type == "completion":
+                # completion accepts "text", ["a","b"], or
+                # {"input": [...], "weight": N} (CompletionFieldMapper
+                # parse shapes); weights degrade to doc frequency here
+                flat: list[str] = []
+                for v in values:
+                    if isinstance(v, dict):
+                        inp = v.get("input", [])
+                        flat.extend([inp] if isinstance(inp, str) else
+                                    [str(x) for x in inp])
+                    elif v is not None:
+                        flat.append(str(v))
+                pf.keywords = flat
+            else:
+                pf.keywords = [str(v) for v in values if v is not None]
         elif self.kind == KIND_NUMERIC:
             for v in values:
                 if v is None:

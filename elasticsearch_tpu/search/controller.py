@@ -118,6 +118,10 @@ def merge_shard_payloads(req: ParsedSearchRequest, payloads: list[dict],
     if req.aggs:
         response["aggregations"] = reduce_aggs(
             req.aggs, [p["aggs"] for p in payloads])
+    if req.suggest:
+        from elasticsearch_tpu.search.suggest import reduce_suggest
+        response["suggest"] = reduce_suggest(
+            req.suggest, [p.get("suggest", {}) for p in payloads])
     return response
 
 

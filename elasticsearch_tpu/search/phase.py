@@ -49,6 +49,7 @@ class ParsedSearchRequest:
     track_total_hits: bool = True
     explain: bool = False
     script_fields: dict = field(default_factory=dict)
+    suggest: list = field(default_factory=list)    # [SuggestSpec]
     stored_fields: list = field(default_factory=list)
 
 
@@ -77,6 +78,8 @@ def parse_search_request(body: dict | None) -> ParsedSearchRequest:
     req.explain = bool(body.get("explain", False))
     req.script_fields = body.get("script_fields", {})
     req.stored_fields = body.get("stored_fields", body.get("fields", []))
+    from elasticsearch_tpu.search.suggest import parse_suggest
+    req.suggest = parse_suggest(body.get("suggest"))
     return req
 
 
@@ -97,18 +100,22 @@ class ShardSearcher:
     """Per-shard query execution over a DeviceReader."""
 
     def __init__(self, shard_id: int, reader: DeviceReader, mapper_service,
-                 index_name: str = ""):
+                 index_name: str = "", doc_slot: int | None = None):
         self.shard_id = shard_id
         self.reader = reader
         self.mapper_service = mapper_service
         # 11-bit (index, shard) slot for the _doc tie-break: doc ids use
         # bits 0-41, the slot bits 42-52 — all within float64's 53-bit
         # mantissa so cross-shard search_after cursors stay exact. The
-        # index hash keeps _doc unique across indices of a multi-index
-        # scroll (same shard id in two indices must not collide).
-        import zlib
-        self._doc_slot = ((zlib.crc32(index_name.encode()) * 31 + shard_id)
-                          & 0x7FF)
+        # coordinator assigns DENSE slots (its position in the request's
+        # shard-group enumeration) so multi-index scrolls are collision-
+        # free by construction; the crc fallback only serves local
+        # single-index paths that never mix indices in one cursor.
+        if doc_slot is None:
+            import zlib
+            doc_slot = ((zlib.crc32(index_name.encode()) * 31 + shard_id)
+                        & 0x7FF)
+        self._doc_slot = doc_slot & 0x7FF
         self.ctx = ExecutionContext(reader=reader, mapper_service=mapper_service)
 
     # -- mask/scores over every segment --------------------------------------

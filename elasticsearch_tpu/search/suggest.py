@@ -1,0 +1,312 @@
+"""Suggesters — term, phrase, completion.
+
+Reference: core/search/suggest/ — TermSuggester (per-token edit-distance
+candidates from the shard's term dictionary, DirectSpellChecker-driven),
+PhraseSuggester (candidate generators + language-model scoring over the
+whole input), CompletionSuggester (FST prefix lookup over a dedicated
+completion field). Shard partials reduce at the coordinator
+(Suggest.reduce, used by SearchPhaseController.java:398).
+
+TPU framing: suggestion collection is a host-side dictionary problem
+(string edit distances over the term dict), not an MXU problem — it runs
+on host arrays next to the segment metadata, like the reference runs it
+on Lucene's terms enum, leaving the device path to scoring.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import QueryParsingError
+
+
+# ---- parsing ---------------------------------------------------------------
+
+class SuggestSpec:
+    __slots__ = ("name", "text", "kind", "field", "params")
+
+    def __init__(self, name: str, text: str, kind: str, field: str,
+                 params: dict):
+        self.name = name
+        self.text = text
+        self.kind = kind                         # term | phrase | completion
+        self.field = field
+        self.params = params
+
+    def to_wire(self) -> dict:
+        return {"name": self.name, "text": self.text, "kind": self.kind,
+                "field": self.field, "params": self.params}
+
+    @staticmethod
+    def from_wire(d: dict) -> "SuggestSpec":
+        return SuggestSpec(d["name"], d["text"], d["kind"], d["field"],
+                           d["params"])
+
+
+def parse_suggest(body: dict | None) -> list[SuggestSpec]:
+    """The `suggest` section: {name: {text|prefix, term|phrase|completion:
+    {field, ...}}} (RestSearchAction suggest parsing)."""
+    if not body:
+        return []
+    out = []
+    global_text = body.get("text")
+    for name, spec in body.items():
+        if name == "text":
+            continue
+        if not isinstance(spec, dict):
+            raise QueryParsingError(f"suggest [{name}] must be an object")
+        text = spec.get("text", spec.get("prefix", global_text))
+        for kind in ("term", "phrase", "completion"):
+            if kind in spec:
+                params = dict(spec[kind])
+                field = params.pop("field", None)
+                if field is None:
+                    raise QueryParsingError(
+                        f"suggest [{name}] requires a field")
+                if text is None:
+                    raise QueryParsingError(
+                        f"suggest [{name}] requires text/prefix")
+                out.append(SuggestSpec(name, str(text), kind, field, params))
+                break
+        else:
+            raise QueryParsingError(
+                f"suggest [{name}] has no term/phrase/completion section")
+    return out
+
+
+# ---- edit distance ---------------------------------------------------------
+
+def _damerau(a: str, b: str, max_d: int) -> int:
+    """Bounded Damerau-Levenshtein (transposition-aware, like Lucene's
+    DirectSpellChecker internal distance); returns max_d+1 when exceeded."""
+    la, lb = len(a), len(b)
+    if abs(la - lb) > max_d:
+        return max_d + 1
+    prev2: list[int] = []
+    prev = list(range(lb + 1))
+    for i in range(1, la + 1):
+        cur = [i] + [0] * lb
+        best = cur[0]
+        for j in range(1, lb + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            v = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            if cost and i > 1 and j > 1 and a[i - 1] == b[j - 2] \
+                    and a[i - 2] == b[j - 1]:
+                v = min(v, prev2[j - 2] + 1)
+            cur[j] = v
+            best = min(best, v)
+        if best > max_d:
+            return max_d + 1
+        prev2, prev = prev, cur
+    return prev[lb]
+
+
+# ---- per-shard collection ---------------------------------------------------
+
+class ShardSuggester:
+    """Runs suggest specs against one shard's segments."""
+
+    def __init__(self, reader, mapper_service):
+        self.reader = reader
+        self.mapper_service = mapper_service
+
+    # term dictionary of a text field: term → df summed over live segments
+    def _term_stats(self, field: str) -> dict[str, int]:
+        stats: dict[str, int] = {}
+        for s in self.reader.segments:
+            col = s.seg.text_fields.get(field)
+            if col is None:
+                continue
+            df = np.asarray(col.df)
+            for tid, term in enumerate(col.terms):
+                stats[term] = stats.get(term, 0) + int(df[tid])
+        return stats
+
+    def _analyze(self, field: str, text: str) -> list[str]:
+        mapper = self.mapper_service.document_mapper().mappers.get(field)
+        if mapper is not None and getattr(mapper, "analyzer", None):
+            return [t.term for t in mapper.analyzer.analyze(text)]
+        return text.lower().split()
+
+    def collect(self, spec: SuggestSpec) -> dict:
+        if spec.kind == "term":
+            return self._collect_term(spec)
+        if spec.kind == "phrase":
+            return self._collect_phrase(spec)
+        if spec.kind == "completion":
+            return self._collect_completion(spec)
+        raise QueryParsingError(f"unknown suggester [{spec.kind}]")
+
+    # ---- term ---------------------------------------------------------------
+
+    def _candidates(self, token: str, stats: dict[str, int],
+                    params: dict) -> list[dict]:
+        max_edits = int(params.get("max_edits", 2))
+        prefix_len = int(params.get("prefix_length", 1))
+        min_len = int(params.get("min_word_length", 4))
+        max_terms = int(params.get("max_term_freq", 0)) or None
+        size = int(params.get("size", 5))
+        out = []
+        tok_df = stats.get(token, 0)
+        suggest_mode = params.get("suggest_mode", "missing")
+        if suggest_mode == "missing" and tok_df > 0:
+            return []
+        prefix = token[:prefix_len]
+        for term, df in stats.items():
+            if term == token or not term.startswith(prefix):
+                continue
+            if len(term) < min_len and len(token) >= min_len:
+                continue
+            if suggest_mode == "popular" and df <= tok_df:
+                continue
+            d = _damerau(token, term, max_edits)
+            if d > max_edits:
+                continue
+            score = 1.0 - d / max(len(token), len(term))
+            out.append({"text": term, "freq": df, "score": round(score, 6),
+                        "distance": d})
+        out.sort(key=lambda c: (-c["score"], -c["freq"], c["text"]))
+        if max_terms:
+            out = [c for c in out if c["freq"] <= max_terms]
+        return out[:size]
+
+    def _collect_term(self, spec: SuggestSpec) -> dict:
+        stats = self._term_stats(spec.field)
+        entries = []
+        offset = 0
+        for token in self._analyze(spec.field, spec.text):
+            start = spec.text.lower().find(token, offset)
+            if start < 0:
+                start = offset
+            entries.append({
+                "text": token, "offset": start, "length": len(token),
+                "options": self._candidates(token, stats, spec.params)})
+            offset = start + len(token)
+        return {"kind": "term", "entries": entries}
+
+    # ---- phrase -------------------------------------------------------------
+
+    def _collect_phrase(self, spec: SuggestSpec) -> dict:
+        stats = self._term_stats(spec.field)
+        total = max(sum(stats.values()), 1)
+        tokens = self._analyze(spec.field, spec.text)
+        gen_params = {**spec.params, "suggest_mode": "always",
+                      "size": int(spec.params.get(
+                          "num_candidates", 5))}
+        per_tok: list[list[tuple[str, float]]] = []
+        rwel = float(spec.params.get("real_word_error_likelihood", 0.95))
+        for tok in tokens:
+            opts = [(tok, (stats.get(tok, 0) / total) * rwel
+                     if stats.get(tok) else 1e-9)]
+            for c in self._candidates(tok, stats, gen_params):
+                opts.append((c["text"],
+                             (c["freq"] / total) * c["score"]))
+            per_tok.append(opts)
+        # beam over combinations (the reference scores candidates with a
+        # smoothed word LM; unigram product with error likelihood here)
+        beam: list[tuple[list[str], float]] = [([], 1.0)]
+        width = int(spec.params.get("beam_width", 8))
+        for opts in per_tok:
+            nxt = [(path + [w], p * wp) for path, p in beam
+                   for w, wp in opts]
+            nxt.sort(key=lambda e: -e[1])
+            beam = nxt[:width]
+        size = int(spec.params.get("size", 5))
+        options = []
+        seen = set()
+        for path, p in beam:
+            text = " ".join(path)
+            if text in seen:
+                continue
+            seen.add(text)
+            if text == " ".join(tokens) and len(beam) > 1:
+                continue                         # identity isn't a suggestion
+            opt = {"text": text, "score": p}
+            hl = spec.params.get("highlight")
+            if hl:
+                pre, post = hl.get("pre_tag", ""), hl.get("post_tag", "")
+                opt["highlighted"] = " ".join(
+                    f"{pre}{w}{post}" if w != t else w
+                    for w, t in zip(path, tokens))
+            options.append(opt)
+        return {"kind": "phrase",
+                "entries": [{"text": spec.text, "offset": 0,
+                             "length": len(spec.text),
+                             "options": options[:size]}]}
+
+    # ---- completion ---------------------------------------------------------
+
+    def _collect_completion(self, spec: SuggestSpec) -> dict:
+        prefix = spec.text
+        counts: dict[str, int] = {}
+        for s in self.reader.segments:
+            col = s.seg.keyword_fields.get(spec.field)
+            if col is None:
+                continue
+            vocab = col.vocab                    # sorted → prefix range scan
+            import bisect
+            lo = bisect.bisect_left(vocab, prefix)
+            hi = bisect.bisect_left(vocab, prefix + "￿")
+            if hi <= lo:
+                continue
+            ords = np.asarray(col.ords)
+            live = np.asarray(s.live)[:ords.shape[0]]
+            for o in range(lo, hi):
+                n = int((((ords == o).any(axis=1)) & live).sum())
+                if n:
+                    counts[vocab[o]] = counts.get(vocab[o], 0) + n
+        options = [{"text": t, "score": float(n)}
+                   for t, n in sorted(counts.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))]
+        size = int(spec.params.get("size", 5))
+        return {"kind": "completion",
+                "entries": [{"text": prefix, "offset": 0,
+                             "length": len(prefix),
+                             "options": options[:size]}]}
+
+
+# ---- reduce ----------------------------------------------------------------
+
+def reduce_suggest(specs: list[SuggestSpec], parts: list[dict]) -> dict:
+    """Merge per-shard partials: entries align by (offset, length); options
+    merge by text — term/completion sum freq/score across shards, phrase
+    keeps the max score (Suggest.reduce semantics)."""
+    out: dict = {}
+    for spec in specs:
+        shard_results = [p[spec.name] for p in parts if spec.name in p]
+        if not shard_results:
+            out[spec.name] = []
+            continue
+        by_key: dict[tuple, dict] = {}
+        order: list[tuple] = []
+        for r in shard_results:
+            for e in r["entries"]:
+                key = (e["offset"], e["length"], e["text"])
+                if key not in by_key:
+                    by_key[key] = {"text": e["text"], "offset": e["offset"],
+                                   "length": e["length"], "_opts": {}}
+                    order.append(key)
+                opts = by_key[key]["_opts"]
+                for o in e["options"]:
+                    cur = opts.get(o["text"])
+                    if cur is None:
+                        opts[o["text"]] = dict(o)
+                    elif r["kind"] == "phrase":
+                        cur["score"] = max(cur["score"], o["score"])
+                    elif r["kind"] == "completion":
+                        # score = live doc count → additive across shards
+                        cur["score"] += o["score"]
+                    else:                        # term: df sums, the edit-
+                        cur["freq"] = cur.get("freq", 0) + o.get("freq", 0)
+                        cur["score"] = max(cur["score"], o["score"])
+        size = int(spec.params.get("size", 5))
+        entries = []
+        for key in order:
+            e = by_key[key]
+            opts = sorted(e.pop("_opts").values(),
+                          key=lambda o: (-o["score"], -o.get("freq", 0),
+                                         o["text"]))
+            e["options"] = opts[:size]
+            entries.append(e)
+        out[spec.name] = entries
+    return out

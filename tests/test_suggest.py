@@ -1,0 +1,97 @@
+"""Suggesters (SURVEY.md §2.6): term (edit-distance candidates from the
+term dictionary), phrase (candidate generation + LM scoring), completion
+(prefix scan over a completion field) — including cross-shard reduce."""
+
+import pytest
+
+from elasticsearch_tpu.testing import InternalTestCluster
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    with InternalTestCluster(
+            2, base_path=tmp_path_factory.mktemp("sugg")) as c:
+        c.wait_for_nodes(2)
+        m = c.master()
+        m.indices_service.create_index(
+            "songs", {"settings": {"number_of_shards": 2,
+                                   "number_of_replicas": 0},
+                      "mappings": {"_doc": {"properties": {
+                          "title": {"type": "text"},
+                          "suggest": {"type": "completion"}}}}})
+        c.wait_for_health("green")
+        docs = [
+            {"title": "the amsterdam canals", "suggest": ["amsterdam"]},
+            {"title": "amsterdam nights", "suggest": ["amsterdam"]},
+            {"title": "rotterdam harbour", "suggest": ["rotterdam"]},
+            {"title": "rotterdam skyline", "suggest": ["rotterdam"]},
+            {"title": "the hague beach", "suggest": ["the hague"]},
+            {"title": "amsterdam museums guide", "suggest": ["amsterdam"]},
+        ]
+        ops = [("index", {"_index": "songs", "_id": str(i)}, d)
+               for i, d in enumerate(docs)]
+        m.document_actions.bulk(ops, refresh=True)
+        yield c
+
+
+def test_term_suggester_corrects_typo(cluster):
+    r = cluster.master().search_actions.search("songs", {
+        "size": 0,
+        "suggest": {"fix": {"text": "amsterdan",
+                            "term": {"field": "title"}}}})
+    entries = r["suggest"]["fix"]
+    assert entries[0]["text"] == "amsterdan"
+    opts = [o["text"] for o in entries[0]["options"]]
+    assert opts and opts[0] == "amsterdam"
+    # frequencies summed across both shards
+    top = entries[0]["options"][0]
+    assert top["freq"] == 3
+
+
+def test_term_suggester_missing_mode_skips_known_words(cluster):
+    r = cluster.master().search_actions.search("songs", {
+        "size": 0,
+        "suggest": {"s": {"text": "amsterdam",
+                          "term": {"field": "title"}}}})
+    # the word exists → suggest_mode=missing (default) returns no options
+    assert r["suggest"]["s"][0]["options"] == []
+
+
+def test_phrase_suggester(cluster):
+    r = cluster.master().search_actions.search("songs", {
+        "size": 0,
+        "suggest": {"p": {"text": "amsterdan museums",
+                          "phrase": {"field": "title",
+                                     "highlight": {"pre_tag": "<em>",
+                                                   "post_tag": "</em>"}}}}})
+    opts = r["suggest"]["p"][0]["options"]
+    assert opts
+    assert opts[0]["text"] == "amsterdam museums"
+    assert opts[0]["highlighted"] == "<em>amsterdam</em> museums"
+
+
+def test_completion_suggester_prefix(cluster):
+    r = cluster.master().search_actions.search("songs", {
+        "size": 0,
+        "suggest": {"c": {"prefix": "amst",
+                          "completion": {"field": "suggest"}}}})
+    opts = r["suggest"]["c"][0]["options"]
+    assert [o["text"] for o in opts] == ["amsterdam"]
+    assert opts[0]["score"] == 3.0              # three docs carry the input
+
+
+def test_suggest_rest_endpoint(cluster):
+    import json, subprocess
+    from elasticsearch_tpu.rest.server import RestServer
+    srv = RestServer(cluster.master(), port=19321).start()
+    try:
+        out = subprocess.run(
+            ["curl", "-s", "-X", "POST",
+             "http://127.0.0.1:19321/songs/_suggest",
+             "-d", json.dumps({"mysugg": {"text": "rotterdan",
+                                          "term": {"field": "title"}}})],
+            capture_output=True, text=True).stdout
+        r = json.loads(out)
+        assert r["mysugg"][0]["options"][0]["text"] == "rotterdam"
+    finally:
+        srv.stop()
