@@ -57,6 +57,8 @@ class DocumentActions:
     BULK_P = "indices:data/write/bulk[s][p]"
     BULK_R = "indices:data/write/bulk[s][r]"
     GET_S = "indices:data/read/get[s]"
+    EXPLAIN_S = "indices:data/read/explain[s]"
+    TERMVECTORS_S = "indices:data/read/tv[s]"
 
     #: how long the reroute phase waits for an active primary (the
     #: reference's default index timeout is 1m; tests want seconds)
@@ -85,6 +87,11 @@ class DocumentActions:
         ts.register_request_handler(self.BULK_R, self._handle_bulk_r,
                                     executor="replica", sync=True)
         ts.register_request_handler(self.GET_S, self._handle_get,
+                                    executor="get", sync=True)
+        ts.register_request_handler(self.EXPLAIN_S, self._handle_explain,
+                                    executor="get", sync=True)
+        ts.register_request_handler(self.TERMVECTORS_S,
+                                    self._handle_termvectors,
                                     executor="get", sync=True)
 
     # ---- routing helpers ---------------------------------------------------
@@ -364,26 +371,23 @@ class DocumentActions:
 
     # ---- get (TransportSingleShardAction: one copy, failover) --------------
 
-    def get_doc(self, index: str, doc_id: str,
-                routing: str | None = None) -> dict:
-        name = self._resolve_single(index)
-        shard = self._shard_id(name, doc_id, routing)
+    def _single_shard_read(self, name: str, shard: int, action: str,
+                           request: dict, local_handler) -> dict:
+        """TransportSingleShardAction: try one copy after another — local
+        first (preference=_local default), then primary, then replicas."""
         state = self._state()
         copies = [c for c in state.routing_table.shard_copies(name, shard)
                   if c.active]
-        # prefer the local copy (preference=_local default behavior), then
-        # primary, then replicas
         copies.sort(key=lambda c: (c.node_id != self.node.node_id,
                                    not c.primary))
         if not copies:
             raise UnavailableShardsError(
                 f"[{name}][{shard}] no active copy", index=name, shard=shard)
-        request = {"index": name, "shard": shard, "id": doc_id}
         last: Exception | None = None
         for c in copies:
             if c.node_id == self.node.node_id:
                 try:
-                    return self._handle_get(request, None)
+                    return local_handler(request, None)
                 except ElasticsearchTpuError:
                     raise
                 except Exception as e:           # noqa: BLE001 — failover
@@ -394,7 +398,7 @@ class DocumentActions:
                 continue
             try:
                 return self.node.transport_service.send_request(
-                    target, self.GET_S, request, timeout=10.0).result(15.0)
+                    target, action, request, timeout=10.0).result(15.0)
             except RemoteTransportError as e:
                 if _is_retryable(e):
                     last = e                     # stale copy → next copy
@@ -405,8 +409,116 @@ class DocumentActions:
             except Exception as e:               # noqa: BLE001 — remote error
                 raise unwrap_remote(e) from None
         raise UnavailableShardsError(
-            f"[{name}][{shard}] get failed on all copies: {last}",
+            f"[{name}][{shard}] read failed on all copies: {last}",
             index=name, shard=shard)
+
+    def get_doc(self, index: str, doc_id: str,
+                routing: str | None = None) -> dict:
+        name = self._resolve_single(index)
+        shard = self._shard_id(name, doc_id, routing)
+        return self._single_shard_read(
+            name, shard, self.GET_S,
+            {"index": name, "shard": shard, "id": doc_id},
+            self._handle_get)
+
+    # ---- explain (core/action/explain/TransportExplainAction.java) ---------
+
+    def explain_doc(self, index: str, doc_id: str, body: dict,
+                    routing: str | None = None) -> dict:
+        name = self._resolve_single(index)
+        shard = self._shard_id(name, doc_id, routing)
+        return self._single_shard_read(
+            name, shard, self.EXPLAIN_S,
+            {"index": name, "shard": shard, "id": doc_id, "body": body},
+            self._handle_explain)
+
+    def _doc_location(self, engine, doc_id: str):
+        """→ (reader, global doc id) of a committed doc, refreshing if the
+        doc still sits in the write buffer; None when absent/deleted."""
+        from elasticsearch_tpu.index.device_reader import device_reader_for
+        entry = engine._versions.get(doc_id)
+        if entry is None or entry.deleted:
+            return None
+        if entry.seg_id == -1:
+            engine.refresh()                     # buffered → make visible
+            entry = engine._versions.get(doc_id)
+            if entry is None or entry.deleted or entry.seg_id < 0:
+                return None
+        reader = device_reader_for(engine)
+        for s in reader.segments:
+            if s.seg.seg_id == entry.seg_id:
+                return reader, s.doc_base + entry.local_doc
+        return None
+
+    def _handle_explain(self, request: dict, source) -> dict:
+        from elasticsearch_tpu.search.explain import (
+            explain_query, strip_matched)
+        from elasticsearch_tpu.search.phase import ShardSearcher
+        from elasticsearch_tpu.search.query_dsl import parse_query
+        name = request["index"]
+        base = {"_index": name, "_type": "_doc", "_id": request["id"]}
+        engine = self._engine(name, request["shard"])
+        loc = self._doc_location(engine, request["id"])
+        if loc is None:
+            return {**base, "matched": False, "explanation": {
+                "value": 0.0, "description": "no matching document",
+                "details": []}}
+        reader, gdoc = loc
+        svc = self.node.indices_service.index(name)
+        searcher = ShardSearcher(request["shard"], reader,
+                                 svc.mapper_service, index_name=name)
+        query = parse_query((request.get("body") or {}).get("query"))
+        tree = explain_query(searcher, query, gdoc)
+        return {**base, "matched": tree["matched"],
+                "explanation": strip_matched(tree)}
+
+    # ---- termvectors (core/index/termvectors/ShardTermVectorsService) ------
+
+    def termvectors(self, index: str, doc_id: str,
+                    body: dict | None = None,
+                    routing: str | None = None) -> dict:
+        name = self._resolve_single(index)
+        shard = self._shard_id(name, doc_id, routing)
+        return self._single_shard_read(
+            name, shard, self.TERMVECTORS_S,
+            {"index": name, "shard": shard, "id": doc_id,
+             "body": body or {}},
+            self._handle_termvectors)
+
+    def _handle_termvectors(self, request: dict, source) -> dict:
+        import numpy as np
+        name = request["index"]
+        base = {"_index": name, "_type": "_doc", "_id": request["id"]}
+        engine = self._engine(name, request["shard"])
+        loc = self._doc_location(engine, request["id"])
+        if loc is None:
+            return {**base, "found": False}
+        reader, gdoc = loc
+        seg, local = reader.resolve(gdoc)
+        want = (request.get("body") or {}).get("fields")
+        out_fields: dict = {}
+        for fname, col in seg.seg.text_fields.items():
+            if want and fname not in want:
+                continue
+            uterms = np.asarray(col.uterms[local])
+            utf = np.asarray(col.utf[local])
+            df = np.asarray(col.df)
+            terms = {}
+            for tid, tf in zip(uterms, utf):
+                if tid < 0:
+                    continue
+                term = col.terms[int(tid)]
+                terms[term] = {"term_freq": int(tf),
+                               "doc_freq": int(df[int(tid)])}
+            if terms:
+                out_fields[fname] = {
+                    "field_statistics": {
+                        "sum_doc_freq": int(df.sum()),
+                        "doc_count": int(seg.seg.num_docs),
+                        "sum_ttf": int(col.total_tokens)},
+                    "terms": dict(sorted(terms.items()))}
+        return {**base, "found": True, "took": 0,
+                "term_vectors": out_fields}
 
     def _handle_get(self, request: dict, source) -> dict:
         name = request["index"]

@@ -18,6 +18,7 @@ from __future__ import annotations
 import shutil
 import threading
 import time
+from dataclasses import replace
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
@@ -424,6 +425,45 @@ class IndicesService:
             return self.allocation.reroute(new, "settings updated")
         self.cluster_service.submit_and_wait(f"update-settings [{name}]",
                                              update)
+
+    def put_percolator(self, index: str, qid: str, body: dict) -> None:
+        """Register a percolator query (stored in IndexMetadata — see
+        search/percolator.py for why it is not a hidden doc type here)."""
+        from elasticsearch_tpu.search.query_dsl import parse_query
+        parse_query(body.get("query"))           # validate at register time
+        self._master_op(
+            "put-percolator", {"index": index, "id": qid, "body": body},
+            lambda: self._put_percolator_local(index, qid, body))
+
+    def _put_percolator_local(self, index: str, qid: str,
+                              body: dict) -> None:
+        def update(state: ClusterState) -> ClusterState:
+            if index not in state.indices:
+                raise IndexNotFoundError(index)
+            meta = state.indices[index]
+            new_meta = replace(meta, percolators={**meta.percolators,
+                                                  qid: body},
+                               version=meta.version + 1)
+            return state.with_(indices={**state.indices, index: new_meta})
+        self.cluster_service.submit_and_wait(
+            f"put-percolator [{index}/{qid}]", update)
+
+    def delete_percolator(self, index: str, qid: str) -> None:
+        self._master_op(
+            "delete-percolator", {"index": index, "id": qid},
+            lambda: self._delete_percolator_local(index, qid))
+
+    def _delete_percolator_local(self, index: str, qid: str) -> None:
+        def update(state: ClusterState) -> ClusterState:
+            if index not in state.indices:
+                raise IndexNotFoundError(index)
+            meta = state.indices[index]
+            pq = {k: v for k, v in meta.percolators.items() if k != qid}
+            new_meta = replace(meta, percolators=pq,
+                               version=meta.version + 1)
+            return state.with_(indices={**state.indices, index: new_meta})
+        self.cluster_service.submit_and_wait(
+            f"delete-percolator [{index}/{qid}]", update)
 
     def put_alias(self, index: str, alias: str, body: dict | None = None):
         self._master_op(

@@ -64,6 +64,14 @@ def register_all(rc: RestController, node) -> None:
         r("DELETE", f"/{{index}}/{doc_seg}/{{id}}", h.delete_doc)
         r("GET", f"/{{index}}/{doc_seg}/{{id}}/_source", h.get_source)
         r("POST", f"/{{index}}/{doc_seg}/{{id}}/_update", h.update_doc)
+        r("GET", f"/{{index}}/{doc_seg}/{{id}}/_explain", h.explain)
+        r("POST", f"/{{index}}/{doc_seg}/{{id}}/_explain", h.explain)
+        r("GET", f"/{{index}}/{doc_seg}/{{id}}/_termvectors", h.termvectors)
+        r("POST", f"/{{index}}/{doc_seg}/{{id}}/_termvectors", h.termvectors)
+    r("GET", "/{index}/_field_stats", h.field_stats)
+    r("POST", "/{index}/_field_stats", h.field_stats)
+    r("GET", "/_field_stats", h.field_stats)
+    r("POST", "/_field_stats", h.field_stats)
     r("POST", "/{index}/_update/{id}", h.update_doc)
     r("POST", "/{index}/_create/{id}", h.create_doc)
     r("PUT", "/{index}/_create/{id}", h.create_doc)
@@ -97,6 +105,30 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/_cluster/stats", h.cluster_stats)
     r("GET", "/_cluster/settings", h.cluster_settings)
     r("PUT", "/_cluster/settings", h.put_cluster_settings)
+    # percolator (RestPercolateAction; registrations via .percolator paths)
+    r("PUT", "/{index}/.percolator/{id}", h.put_percolator)
+    r("POST", "/{index}/.percolator/{id}", h.put_percolator)
+    r("DELETE", "/{index}/.percolator/{id}", h.delete_percolator)
+    r("GET", "/{index}/_percolate", h.percolate)
+    r("POST", "/{index}/_percolate", h.percolate)
+    r("GET", "/{index}/_percolate/count", h.percolate_count)
+    r("POST", "/{index}/_percolate/count", h.percolate_count)
+    # suggest (RestSuggestAction)
+    r("POST", "/_suggest", h.suggest)
+    r("GET", "/_suggest", h.suggest)
+    r("POST", "/{index}/_suggest", h.suggest)
+    r("GET", "/{index}/_suggest", h.suggest)
+    # snapshot/restore (RestPutRepositoryAction … RestRestoreSnapshotAction)
+    r("GET", "/_snapshot", h.get_repositories)
+    r("GET", "/_snapshot/_status", h.snapshot_status)
+    r("PUT", "/_snapshot/{repo}", h.put_repository)
+    r("POST", "/_snapshot/{repo}", h.put_repository)
+    r("GET", "/_snapshot/{repo}", h.get_repositories)
+    r("DELETE", "/_snapshot/{repo}", h.delete_repository)
+    r("PUT", "/_snapshot/{repo}/{snapshot}", h.create_snapshot)
+    r("GET", "/_snapshot/{repo}/{snapshot}", h.get_snapshots)
+    r("DELETE", "/_snapshot/{repo}/{snapshot}", h.delete_snapshot)
+    r("POST", "/_snapshot/{repo}/{snapshot}/_restore", h.restore_snapshot)
     r("GET", "/_nodes", h.nodes_info)
     r("GET", "/_nodes/stats", h.nodes_stats)
     r("GET", "/_stats", h.all_stats)
@@ -436,6 +468,84 @@ class Handlers:
     def count_all(self, req: RestRequest):
         return 200, self.node.count("_all", self._search_body(req))
 
+    # ---- explain / termvectors / field_stats ------------------------------
+
+    def explain(self, req: RestRequest):
+        self._check_type(req)
+        body = req.body or {}
+        if "query" not in body and req.param("q"):
+            body = {"query": {"query_string": {"query": req.param("q")}}}
+        out = self.node.document_actions.explain_doc(
+            req.path_params["index"], req.path_params["id"], body,
+            routing=req.param("routing"))
+        return 200, out
+
+    def termvectors(self, req: RestRequest):
+        self._check_type(req)
+        out = self.node.document_actions.termvectors(
+            req.path_params["index"], req.path_params["id"],
+            req.body or {}, routing=req.param("routing"))
+        return (200 if out.get("found") else 404), out
+
+    def field_stats(self, req: RestRequest):
+        fields = req.param("fields")
+        body = req.body or {}
+        flist = body.get("fields") or \
+            ([f.strip() for f in fields.split(",")] if fields else [])
+        index = req.path_params.get("index", "_all")
+        return 200, self.node.search_actions.field_stats(index, flist)
+
+    # ---- percolator -------------------------------------------------------
+
+    def put_percolator(self, req: RestRequest):
+        index = self.node.indices_service.resolve(
+            req.path_params["index"])[0]
+        self.node.indices_service.put_percolator(
+            index, req.path_params["id"], req.body or {})
+        return 201, {"_index": index, "_type": ".percolator",
+                     "_id": req.path_params["id"], "created": True}
+
+    def delete_percolator(self, req: RestRequest):
+        index = self.node.indices_service.resolve(
+            req.path_params["index"])[0]
+        self.node.indices_service.delete_percolator(
+            index, req.path_params["id"])
+        return 200, {"_index": index, "_type": ".percolator",
+                     "_id": req.path_params["id"], "found": True}
+
+    def _percolate(self, req: RestRequest) -> dict:
+        from elasticsearch_tpu.search.percolator import percolate
+        index = self.node.indices_service.resolve(
+            req.path_params["index"])[0]
+        meta = self.node.cluster_service.state().indices[index]
+        body = req.body or {}
+        doc = body.get("doc")
+        if doc is None:
+            from elasticsearch_tpu.common.errors import IllegalArgumentError
+            raise IllegalArgumentError("percolate requires a [doc]")
+        size = body.get("size")
+        return percolate(meta, doc, size=size)
+
+    def percolate(self, req: RestRequest):
+        out = self._percolate(req)
+        return 200, {"total": out["total"], "matches": out["matches"],
+                     "_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def percolate_count(self, req: RestRequest):
+        out = self._percolate(req)
+        return 200, {"total": out["total"],
+                     "_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def suggest(self, req: RestRequest):
+        """POST /{index}/_suggest — standalone suggest (RestSuggestAction):
+        the body IS the suggest section; runs as a size-0 search."""
+        index = req.path_params.get("index", "_all")
+        resp = self.node.search(index, {"size": 0,
+                                        "suggest": req.body or {}})
+        out = {"_shards": resp["_shards"]}
+        out.update(resp.get("suggest", {}))
+        return 200, out
+
     def scroll(self, req: RestRequest):
         body = req.body or {}
         scroll_id = body.get("scroll_id", req.param("scroll_id"))
@@ -499,6 +609,45 @@ class Handlers:
         return 200, {"tokens": tokens}
 
     # ---- cluster / stats ---------------------------------------------------
+
+    # ---- snapshot/restore -------------------------------------------------
+
+    def put_repository(self, req: RestRequest):
+        self.node.snapshots_service.put_repository(
+            req.path_params["repo"], req.body or {})
+        return 200, {"acknowledged": True}
+
+    def get_repositories(self, req: RestRequest):
+        return 200, self.node.snapshots_service.get_repositories(
+            req.path_params.get("repo"))
+
+    def delete_repository(self, req: RestRequest):
+        self.node.snapshots_service.delete_repository(
+            req.path_params["repo"])
+        return 200, {"acknowledged": True}
+
+    def create_snapshot(self, req: RestRequest):
+        out = self.node.snapshots_service.create_snapshot(
+            req.path_params["repo"], req.path_params["snapshot"],
+            req.body or {})
+        return 200, out
+
+    def get_snapshots(self, req: RestRequest):
+        return 200, self.node.snapshots_service.get_snapshots(
+            req.path_params["repo"], req.path_params["snapshot"])
+
+    def delete_snapshot(self, req: RestRequest):
+        self.node.snapshots_service.delete_snapshot(
+            req.path_params["repo"], req.path_params["snapshot"])
+        return 200, {"acknowledged": True}
+
+    def restore_snapshot(self, req: RestRequest):
+        return 200, self.node.snapshots_service.restore_snapshot(
+            req.path_params["repo"], req.path_params["snapshot"],
+            req.body or {})
+
+    def snapshot_status(self, req: RestRequest):
+        return 200, self.node.snapshots_service.snapshot_status()
 
     def cluster_health(self, req: RestRequest):
         want = req.params.get("wait_for_status")

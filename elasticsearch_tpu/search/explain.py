@@ -1,0 +1,87 @@
+"""Score explanations — the _explain API and `explain: true` hits.
+
+Reference: core/action/explain/TransportExplainAction.java (a single-shard
+read that runs the query against one doc and returns Lucene's
+`Explanation` tree) and the fetch-phase explain sub-phase
+(core/search/fetch/explain/). Lucene builds the tree inside its scorers;
+here the query tree is re-evaluated per clause against the (already
+computed) segment score arrays, reading each clause's value at the target
+doc — same numbers the batch kernel produced, organized as a tree.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from elasticsearch_tpu.search import query_dsl as q
+
+
+def _eval(searcher, query: q.Query, gdoc: int) -> tuple[float, bool]:
+    """(score at gdoc, matched) for an arbitrary sub-query."""
+    per_seg = searcher._execute_query(query)
+    scores = np.concatenate([np.asarray(s) for s, _ in per_seg])
+    mask = np.concatenate([np.asarray(m) for _, m in per_seg])
+    return float(scores[gdoc]), bool(mask[gdoc])
+
+
+def _describe(query: q.Query) -> str:
+    name = type(query).__name__.replace("Query", "").lower()
+    field = getattr(query, "field", None)
+    if name == "match":
+        return f"match [{query.field}:{query.text}]"
+    if name == "term":
+        return f"term [{query.field}:{query.value}]"
+    if name == "matchphrase":
+        return f"phrase [{query.field}:\"{query.text}\"]"
+    if field is not None:
+        return f"{name} [{field}]"
+    return name
+
+
+def explain_query(searcher, query: q.Query, gdoc: int) -> dict:
+    """Explanation tree for one global doc id on one shard searcher."""
+    value, matched = _eval(searcher, query, gdoc)
+    node = {"value": round(value, 6), "matched": matched,
+            "description": _describe(query), "details": []}
+    if isinstance(query, q.BoolQuery):
+        for label, clauses in (("must", query.must),
+                               ("should", query.should),
+                               ("filter", query.filter)):
+            for c in clauses:
+                d = explain_query(searcher, c, gdoc)
+                d["description"] = f"{label}: {d['description']}"
+                node["details"].append(d)
+        for c in query.must_not:
+            sub_v, sub_m = _eval(searcher, c, gdoc)
+            node["details"].append({
+                "value": 0.0, "matched": not sub_m,
+                "description": f"must_not: {_describe(c)}", "details": []})
+    elif isinstance(query, (q.MultiMatchQuery,)):
+        for f in query.fields:
+            sub = q.MatchQuery(field=f.split("^")[0], text=query.text)
+            node["details"].append(explain_query(searcher, sub, gdoc))
+    elif isinstance(query, q.FunctionScoreQuery):
+        node["details"].append(explain_query(searcher, query.query, gdoc))
+    elif isinstance(query, q.ConstantScoreQuery):
+        node["details"].append(
+            explain_query(searcher, query.filter_query, gdoc))
+    elif isinstance(query, (q.MatchQuery, q.MatchPhraseQuery)):
+        # per-term BM25 contributions
+        mapper = searcher.mapper_service.document_mapper().mappers.get(
+            query.field)
+        analyzer = getattr(mapper, "search_analyzer", None) or \
+            getattr(mapper, "analyzer", None)
+        terms = [t.term for t in analyzer.analyze(str(query.text))] \
+            if analyzer else str(query.text).lower().split()
+        if len(terms) > 1 and isinstance(query, q.MatchQuery):
+            for t in terms:
+                sub = q.TermQuery(field=query.field, value=t)
+                node["details"].append(explain_query(searcher, sub, gdoc))
+    return node
+
+
+def strip_matched(node: dict) -> dict:
+    """ES Explanation wire shape has no `matched` inside details."""
+    out = {"value": node["value"], "description": node["description"],
+           "details": [strip_matched(d) for d in node["details"]]}
+    return out

@@ -1,0 +1,56 @@
+"""Percolator — reverse search: match a document against stored queries.
+
+Reference: core/percolator/PercolatorService.java:107 — the doc is parsed
+into a one-document in-memory index (Lucene MemoryIndex) and every
+registered query runs against it; registrations live in
+core/index/percolator/PercolatorQueriesRegistry.java as hidden
+`.percolator`-type docs. Here registrations ride IndexMetadata (replicated
+and persisted with the cluster state), and percolation executes on the
+coordinating node against a scratch single-doc segment — no shard fan-out
+needed since the registry is global, not per-shard.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.device_reader import DeviceReader
+from elasticsearch_tpu.index.engine import SearcherView
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.search.phase import ShardSearcher
+from elasticsearch_tpu.search.query_dsl import parse_query
+
+
+def percolate(meta, doc: dict, queries: dict | None = None,
+              size: int | None = None) -> dict:
+    """Match `doc` against `meta.percolators` (or an explicit query map).
+    → {"total": N, "matches": [{"_index", "_id"}...]}"""
+    queries = meta.percolators if queries is None else queries
+    if not queries:
+        return {"total": 0, "matches": []}
+    # scratch mapper: percolation must not mutate the live mapper registry
+    # with dynamically inferred fields from probe docs
+    scratch = MapperService(AnalysisRegistry(Settings(meta.settings)))
+    for t, m in (meta.mappings or {}).items():
+        scratch.merge(t, m)
+    parsed = scratch.document_mapper().parse("_percolate_doc", doc)
+    builder = SegmentBuilder(seg_id=0)
+    builder.add(parsed)
+    seg = builder.build()
+    mask = np.zeros(seg.padded_docs, dtype=bool)
+    mask[:seg.num_docs] = True
+    reader = DeviceReader(SearcherView([seg], [mask], 1))
+    searcher = ShardSearcher(0, reader, scratch, index_name=meta.name)
+    matches = []
+    for qid, body in queries.items():
+        q = parse_query(body.get("query"))
+        per_seg = searcher._execute_query(q)
+        if any(bool(np.asarray(m).any()) for _, m in per_seg):
+            matches.append({"_index": meta.name, "_id": qid})
+    total = len(matches)
+    if size is not None:
+        matches = matches[:size]
+    return {"total": total, "matches": matches}

@@ -1,0 +1,113 @@
+"""Percolator, _explain, _termvectors, _field_stats (SURVEY.md §2.3
+'Other data APIs' + §2.6 percolator)."""
+
+import pytest
+
+from elasticsearch_tpu.testing import InternalTestCluster
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    with InternalTestCluster(
+            2, base_path=tmp_path_factory.mktemp("dapi")) as c:
+        c.wait_for_nodes(2)
+        m = c.master()
+        m.indices_service.create_index(
+            "posts", {"settings": {"number_of_shards": 2,
+                                   "number_of_replicas": 0}})
+        c.wait_for_health("green")
+        ops = [("index", {"_index": "posts", "_id": str(i)},
+                {"body": f"jax compiles to xla {'fast' * (i % 3)}",
+                 "votes": i * 10}) for i in range(10)]
+        m.document_actions.bulk(ops, refresh=True)
+        yield c
+
+
+# ---- percolator ------------------------------------------------------------
+
+def test_percolate_matches_registered_queries(cluster):
+    from elasticsearch_tpu.search.percolator import percolate
+    m = cluster.master()
+    m.indices_service.put_percolator(
+        "posts", "q-jax", {"query": {"match": {"body": "jax"}}})
+    m.indices_service.put_percolator(
+        "posts", "q-torch", {"query": {"match": {"body": "torch"}}})
+    m.indices_service.put_percolator(
+        "posts", "q-votes", {"query": {"range": {"votes": {"gte": 50}}}})
+    meta = m.cluster_service.state().indices["posts"]
+    out = percolate(meta, {"body": "jax on tpu", "votes": 99})
+    ids = {mm["_id"] for mm in out["matches"]}
+    assert ids == {"q-jax", "q-votes"}
+    # registrations replicate through the cluster state
+    other = cluster.non_masters()[0]
+    meta2 = other.cluster_service.state().indices["posts"]
+    assert set(meta2.percolators) == {"q-jax", "q-torch", "q-votes"}
+    out2 = percolate(meta2, {"body": "torch only"})
+    assert {mm["_id"] for mm in out2["matches"]} == {"q-torch"}
+
+
+def test_percolator_delete(cluster):
+    m = cluster.master()
+    m.indices_service.put_percolator(
+        "posts", "q-tmp", {"query": {"match_all": {}}})
+    m.indices_service.delete_percolator("posts", "q-tmp")
+    assert "q-tmp" not in m.cluster_service.state().indices[
+        "posts"].percolators
+
+
+# ---- explain ---------------------------------------------------------------
+
+def test_explain_matching_doc(cluster):
+    m = cluster.non_masters()[0]                # routes over the wire
+    out = m.document_actions.explain_doc(
+        "posts", "3", {"query": {"match": {"body": "jax"}}})
+    assert out["matched"] is True
+    assert out["explanation"]["value"] > 0
+    assert "match" in out["explanation"]["description"]
+
+
+def test_explain_non_matching_doc(cluster):
+    out = cluster.master().document_actions.explain_doc(
+        "posts", "3", {"query": {"match": {"body": "pytorch"}}})
+    assert out["matched"] is False
+
+
+def test_explain_bool_breakdown(cluster):
+    out = cluster.master().document_actions.explain_doc(
+        "posts", "6", {"query": {"bool": {
+            "must": [{"match": {"body": "jax"}}],
+            "filter": [{"range": {"votes": {"gte": 50}}}]}}})
+    assert out["matched"] is True
+    details = out["explanation"]["details"]
+    assert any(d["description"].startswith("must:") for d in details)
+    assert any(d["description"].startswith("filter:") for d in details)
+
+
+# ---- termvectors -----------------------------------------------------------
+
+def test_termvectors(cluster):
+    out = cluster.non_masters()[0].document_actions.termvectors("posts", "4")
+    assert out["found"] is True
+    tv = out["term_vectors"]["body"]
+    assert "jax" in tv["terms"]
+    assert tv["terms"]["jax"]["term_freq"] == 1
+    assert tv["terms"]["jax"]["doc_freq"] >= 1
+    assert tv["field_statistics"]["doc_count"] >= 1
+
+
+def test_termvectors_missing_doc(cluster):
+    out = cluster.master().document_actions.termvectors("posts", "nope")
+    assert out["found"] is False
+
+
+# ---- field stats -----------------------------------------------------------
+
+def test_field_stats_numeric_and_text(cluster):
+    out = cluster.master().search_actions.field_stats(
+        "posts", ["votes", "body"])
+    fields = out["indices"]["_all"]["fields"]
+    assert fields["votes"]["doc_count"] == 10
+    assert fields["votes"]["min_value"] == 0.0
+    assert fields["votes"]["max_value"] == 90.0
+    assert fields["body"]["doc_count"] == 10
+    assert out["_shards"]["failed"] == 0
