@@ -502,21 +502,32 @@ class DocumentActions:
                 continue
             uterms = np.asarray(col.uterms[local])
             utf = np.asarray(col.utf[local])
-            df = np.asarray(col.df)
             terms = {}
             for tid, tf in zip(uterms, utf):
                 if tid < 0:
                     continue
                 term = col.terms[int(tid)]
+                # shard-wide doc freq, not just this doc's segment —
+                # otherwise the same request returns different numbers
+                # across refreshes/merges
                 terms[term] = {"term_freq": int(tf),
-                               "doc_freq": int(df[int(tid)])}
-            if terms:
-                out_fields[fname] = {
-                    "field_statistics": {
-                        "sum_doc_freq": int(df.sum()),
-                        "doc_count": int(seg.seg.num_docs),
-                        "sum_ttf": int(col.total_tokens)},
-                    "terms": dict(sorted(terms.items()))}
+                               "doc_freq": int(reader.df(fname, term))}
+            if not terms:
+                continue
+            sum_df = doc_count = sum_ttf = 0
+            for s2 in reader.segments:
+                c2 = s2.seg.text_fields.get(fname)
+                if c2 is None:
+                    continue
+                sum_df += int(np.asarray(c2.df).sum())
+                doc_count += int(s2.seg.num_docs)
+                sum_ttf += int(c2.total_tokens)
+            out_fields[fname] = {
+                "field_statistics": {
+                    "sum_doc_freq": sum_df,
+                    "doc_count": doc_count,
+                    "sum_ttf": sum_ttf},
+                "terms": dict(sorted(terms.items()))}
         return {**base, "found": True, "took": 0,
                 "term_vectors": out_fields}
 

@@ -115,25 +115,41 @@ class SearchActions:
 
     def _execute_shard(self, name: str, shard: int, body: dict,
                        doc_slot: int | None = None) -> dict:
+        t0 = time.perf_counter()
         svc = self.node.indices_service.index(name)
         engine = svc.engine(shard)
         reader = device_reader_for(engine)
-        searcher = ShardSearcher(shard, reader, svc.mapper_service,
-                                 index_name=name, doc_slot=doc_slot)
-        req = parse_search_request(body)
-        result = searcher.query_phase(req)
-        k = min(len(result.doc_ids), req.from_ + req.size)
-        hits = searcher.fetch_phase(req, result, name, list(range(k)))
-        out = {"total": result.total,
-               "max_score": (float(result.max_score)
-                             if result.max_score is not None else None),
-               "hits": hits,
-               "aggs": wire_safe(result.agg_partials)}
-        if req.suggest:
-            from elasticsearch_tpu.search.suggest import ShardSuggester
-            sg = ShardSuggester(reader, svc.mapper_service)
-            out["suggest"] = {spec.name: sg.collect(spec)
-                              for spec in req.suggest}
+        # per-request scratch accounting (request breaker): score + mask
+        # arrays over every doc of the shard
+        breaker = None
+        if svc.breaker_service is not None:
+            breaker = svc.breaker_service.breaker("request")
+            est = max(reader.num_docs, 1) * 16
+            breaker.add_estimate(est, f"search [{name}][{shard}]")
+        try:
+            searcher = ShardSearcher(shard, reader, svc.mapper_service,
+                                     index_name=name, doc_slot=doc_slot)
+            req = parse_search_request(body)
+            result = searcher.query_phase(req)
+            k = min(len(result.doc_ids), req.from_ + req.size)
+            hits = searcher.fetch_phase(req, result, name, list(range(k)))
+            out = {"total": result.total,
+                   "max_score": (float(result.max_score)
+                                 if result.max_score is not None else None),
+                   "hits": hits,
+                   "aggs": wire_safe(result.agg_partials)}
+            if req.suggest:
+                from elasticsearch_tpu.search.suggest import ShardSuggester
+                sg = ShardSuggester(reader, svc.mapper_service)
+                out["suggest"] = {spec.name: sg.collect(spec)
+                                  for spec in req.suggest}
+        finally:
+            if breaker is not None:
+                breaker.release(est)
+        if svc.search_slow_log.thresholds:       # skip json.dumps when off
+            svc.search_slow_log.maybe_log(
+                time.perf_counter() - t0,
+                f"shard[{shard}], source[{json.dumps(body)[:512]}]")
         return out
 
     # ---- coordinator -------------------------------------------------------

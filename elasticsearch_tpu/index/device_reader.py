@@ -18,6 +18,8 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import threading
+
 import numpy as np
 
 from elasticsearch_tpu.index.engine import SearcherView
@@ -204,8 +206,46 @@ def device_reader_for(engine, view: SearcherView | None = None,
     global registry to leak HBM across index delete/create churn)."""
     if view is None:
         view = engine.acquire_searcher()
-    cached = getattr(engine, "_device_reader_cache", None)
-    if cached is None or cached.generation != view.generation:
+    # serialize cache swap + breaker accounting (concurrent searches after
+    # a refresh must not double-pack or double-account); a dedicated lock,
+    # not engine._lock, so packing never blocks writes
+    lock = getattr(engine, "_device_reader_lock", None)
+    if lock is None:
+        lock = engine.__dict__.setdefault("_device_reader_lock",
+                                          threading.Lock())
+    with lock:
+        cached = getattr(engine, "_device_reader_cache", None)
+        if cached is not None and cached.generation == view.generation:
+            return cached
+        # account device-resident column memory against the fielddata
+        # breaker (HBM is the scarce resource the reference's fielddata
+        # breaker models). Reserve only the DELTA vs the generation being
+        # replaced: reserving the full new size while the old is still
+        # held would spuriously trip once an index passes half the limit.
+        bs = getattr(engine, "breaker_service", None)
+        new_bytes = sum(seg.memory_bytes() for seg in view.segments)
+        old_bytes = getattr(cached, "_accounted_bytes", 0) if cached else 0
+        if bs is not None:
+            fd = bs.breaker("fielddata")
+            if new_bytes > old_bytes:
+                fd.add_estimate(new_bytes - old_bytes,
+                                f"segments gen {view.generation}")
+            else:
+                fd.release(old_bytes - new_bytes)
         cached = DeviceReader(view, device=device)
+        cached._accounted_bytes = new_bytes if bs is not None else 0
         engine._device_reader_cache = cached
-    return cached
+        return cached
+
+
+def release_device_reader(engine) -> None:
+    """Drop the engine's cached reader and return its breaker reservation
+    (called from Engine.close so budget doesn't leak across index
+    delete/create churn)."""
+    cached = getattr(engine, "_device_reader_cache", None)
+    bs = getattr(engine, "breaker_service", None)
+    if cached is not None and bs is not None:
+        bs.breaker("fielddata").release(
+            getattr(cached, "_accounted_bytes", 0))
+    if cached is not None:
+        engine._device_reader_cache = None

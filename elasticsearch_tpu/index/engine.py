@@ -114,6 +114,10 @@ class Engine:
         # commit out (the reference holds an IndexCommit ref / blocks
         # flush on RECOVERING shards for the same windows).
         self._commit_pins = 0
+        # wired by IndexService: threshold slow log (IndexingSlowLog.java)
+        # and the node's breaker service for memory accounting
+        self.indexing_slow_log = None
+        self.breaker_service = None
 
         durability = settings.get("index.translog.durability", DURABILITY_REQUEST)
         self.translog = Translog(self.path / "translog", durability=durability)
@@ -170,7 +174,11 @@ class Engine:
                 self.translog.add(TranslogOp(OP_INDEX, doc_id, new_version,
                                              source=source, routing=routing))
             self.stats.index_total += 1
-            self.stats.index_time_ms += (time.perf_counter() - t0) * 1e3
+            took = time.perf_counter() - t0
+            self.stats.index_time_ms += took * 1e3
+            if self.indexing_slow_log is not None:
+                self.indexing_slow_log.maybe_log(
+                    took, f"id[{doc_id}], version[{new_version}]")
             return new_version, current == NOT_FOUND
 
     def index_replica(self, doc_id: str, source: dict, version: int,
@@ -525,5 +533,9 @@ class Engine:
     def close(self) -> None:
         with self._lock:
             if not self._closed:
+                # return the cached device reader's breaker reservation
+                from elasticsearch_tpu.index.device_reader import (
+                    release_device_reader)
+                release_device_reader(self)
                 self.translog.close()
                 self._closed = True

@@ -1,0 +1,66 @@
+"""Threshold-based slow logs for search and indexing.
+
+Reference: core/index/search/stats/SearchSlowLog.java and
+core/index/indexing/IndexingSlowLog.java — per-index warn/info/debug/trace
+time thresholds (`index.search.slowlog.threshold.query.warn`,
+`index.indexing.slowlog.threshold.index.warn`, …) gating log lines on the
+standard logging hierarchy, updated dynamically with index settings.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from elasticsearch_tpu.common.settings import Settings, parse_time_value
+
+_LEVELS = (("warn", logging.WARNING), ("info", logging.INFO),
+           ("debug", logging.DEBUG), ("trace", 5))
+
+
+class SlowLog:
+    _prefix: str = ""
+
+    def __init__(self, index_name: str, settings: Settings,
+                 logger_name: str):
+        self.index_name = index_name
+        self.logger = logging.getLogger(logger_name)
+        self.thresholds: list[tuple[float, int, str]] = []
+        self.update_settings(settings)
+
+    def update_settings(self, settings: Settings) -> None:
+        self.thresholds = []
+        for name, level in _LEVELS:
+            raw = settings.get(f"{self._prefix}.{name}")
+            if raw in (None, "", "-1"):
+                continue
+            try:
+                self.thresholds.append(
+                    (parse_time_value(str(raw), name), level, name))
+            except (ValueError, TypeError):
+                continue
+        self.thresholds.sort(reverse=True)       # strictest (longest) first
+
+    def maybe_log(self, took_s: float, message: str) -> str | None:
+        """Log at the highest level whose threshold `took_s` exceeds;
+        → the level name logged at (for tests), or None."""
+        for threshold, level, name in self.thresholds:
+            if took_s >= threshold:
+                self.logger.log(
+                    level, "[%s] took[%.1fms], %s",
+                    self.index_name, took_s * 1000.0, message)
+                return name
+        return None
+
+
+class SearchSlowLog(SlowLog):
+    _prefix = "index.search.slowlog.threshold.query"
+
+    def __init__(self, index_name: str, settings: Settings):
+        super().__init__(index_name, settings, "index.search.slowlog")
+
+
+class IndexingSlowLog(SlowLog):
+    _prefix = "index.indexing.slowlog.threshold.index"
+
+    def __init__(self, index_name: str, settings: Settings):
+        super().__init__(index_name, settings, "index.indexing.slowlog")

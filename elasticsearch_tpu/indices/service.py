@@ -58,7 +58,8 @@ class IndexService:
     """Per-index container: mapper service + one engine per LOCAL shard."""
 
     def __init__(self, meta: IndexMetadata, path: Path,
-                 local_shards: list[int] | None = None):
+                 local_shards: list[int] | None = None,
+                 breaker_service=None):
         self.name = meta.name
         self.meta = meta
         self.path = path
@@ -68,6 +69,11 @@ class IndexService:
         self.mapper_service = MapperService(self.analysis)
         for type_name, mapping in (meta.mappings or {}).items():
             self.mapper_service.merge(type_name, mapping)
+        from elasticsearch_tpu.index.slowlog import (
+            IndexingSlowLog, SearchSlowLog)
+        self.search_slow_log = SearchSlowLog(meta.name, index_settings)
+        self.indexing_slow_log = IndexingSlowLog(meta.name, index_settings)
+        self.breaker_service = breaker_service
         self.engines: dict[int, Engine] = {}
         if local_shards is None:
             local_shards = list(range(meta.number_of_shards))
@@ -78,10 +84,19 @@ class IndexService:
 
     def add_local_shard(self, sid: int) -> Engine:
         if sid not in self.engines:
-            self.engines[sid] = Engine(self.path / str(sid),
-                                       self.mapper_service,
-                                       self.index_settings)
+            engine = Engine(self.path / str(sid), self.mapper_service,
+                            self.index_settings)
+            engine.indexing_slow_log = self.indexing_slow_log
+            engine.breaker_service = self.breaker_service
+            self.engines[sid] = engine
         return self.engines[sid]
+
+    def apply_settings(self, meta: IndexMetadata) -> None:
+        """Dynamic settings landed in new metadata (IndexSettingsService
+        analog): refresh the pieces that read them."""
+        self.index_settings = Settings(meta.settings)
+        self.search_slow_log.update_settings(self.index_settings)
+        self.indexing_slow_log.update_settings(self.index_settings)
 
     def remove_local_shard(self, sid: int, delete_files: bool = False) -> None:
         engine = self.engines.pop(sid, None)
@@ -166,6 +181,9 @@ class IndicesService:
         self.node_id = node_id
         self.allocation = allocation or AllocationService()
         self.indices: dict[str, IndexService] = {}
+        # hierarchical memory accounting (HierarchyCircuitBreakerService);
+        # wired by the Node before any index exists
+        self.breaker_service = None
         # Master forwarding seam (TransportMasterNodeAction.java:50): when
         # set by the Node, metadata mutations on a non-master route to the
         # elected master; signature (action, request, local_fn) → result.
@@ -209,11 +227,14 @@ class IndicesService:
                     continue                     # nothing of it lives here
                 self.indices[name] = IndexService(
                     meta, self.data_path / "indices" / name,
-                    local_shards=[s.shard for s in local])
+                    local_shards=[s.shard for s in local],
+                    breaker_service=self.breaker_service)
             svc = self.indices[name]
             if meta.mappings != svc.meta.mappings:
                 for t, m in (meta.mappings or {}).items():
                     svc.mapper_service.merge(t, m)
+            if meta.settings != svc.meta.settings:
+                svc.apply_settings(meta)
             svc.meta = meta
             # create newly assigned shards / drop moved-away ones
             want = {s.shard for s in local}

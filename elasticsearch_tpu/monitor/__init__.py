@@ -1,0 +1,11 @@
+"""Node monitoring — hot-threads sampling and OS/process probes.
+
+Reference: core/monitor/ — HotThreads stack sampler
+(core/monitor/jvm/HotThreads.java), OS/process/JVM probes feeding node
+stats, GC overhead watcher (JvmMonitorService.java).
+"""
+
+from elasticsearch_tpu.monitor.hot_threads import hot_threads
+from elasticsearch_tpu.monitor.probes import process_stats, os_stats
+
+__all__ = ["hot_threads", "process_stats", "os_stats"]

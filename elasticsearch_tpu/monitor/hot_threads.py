@@ -1,0 +1,53 @@
+"""Hot threads — what is this node busy doing right now.
+
+Reference: core/monitor/jvm/HotThreads.java — sample every thread's stack
+N times over an interval, rank threads by how often they were caught on
+CPU, and print the dominant stacks. Drives `GET /_nodes/hot_threads`.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+
+
+def hot_threads(snapshots: int = 10, interval: float = 0.05,
+                threads: int = 3) -> str:
+    """Sample all live threads `snapshots` times; → ES-shaped text report
+    ranking threads by busiest dominant frame."""
+    samples: dict[int, Counter] = {}
+    names: dict[int, str] = {}
+    stacks: dict[tuple[int, str], list[str]] = {}
+    me = threading.get_ident()
+    for _ in range(snapshots):
+        frames = sys._current_frames()
+        live = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in frames.items():
+            if tid == me or tid not in live:
+                continue
+            stack = traceback.extract_stack(frame)
+            if not stack:
+                continue
+            top = stack[-1]
+            key = f"{top.name} ({top.filename.rsplit('/', 1)[-1]}:{top.lineno})"
+            samples.setdefault(tid, Counter())[key] += 1
+            names[tid] = live[tid]
+            stacks[(tid, key)] = [
+                f"{f.name} ({f.filename.rsplit('/', 1)[-1]}:{f.lineno})"
+                for f in reversed(stack[-12:])]
+        time.sleep(interval)
+    ranked = sorted(samples.items(),
+                    key=lambda kv: -kv[1].most_common(1)[0][1])
+    lines = [f"::: hot threads: {snapshots} samples, "
+             f"{interval * 1000:.0f}ms interval"]
+    for tid, counter in ranked[:threads]:
+        key, hits = counter.most_common(1)[0]
+        pct = 100.0 * hits / snapshots
+        lines.append(f"\n   {pct:.1f}% ({hits}/{snapshots} snapshots) "
+                     f"'{names.get(tid, tid)}'")
+        for frame_line in stacks.get((tid, key), []):
+            lines.append(f"     {frame_line}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,53 @@
+"""OS / process probes for node stats.
+
+Reference: core/monitor/os/OsProbe.java, process/ProcessProbe.java — the
+numbers behind `GET /_nodes/stats` os/process sections.
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+import time
+
+_START = time.time()
+
+
+def process_stats() -> dict:
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return {
+        "timestamp": int(time.time() * 1000),
+        "open_file_descriptors": _open_fds(),
+        "cpu": {"total_in_millis": int((ru.ru_utime + ru.ru_stime) * 1000)},
+        "mem": {"resident_in_bytes": ru.ru_maxrss * 1024},
+        "uptime_in_millis": int((time.time() - _START) * 1000),
+    }
+
+
+def _open_fds() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return -1
+
+
+def os_stats() -> dict:
+    out = {"timestamp": int(time.time() * 1000)}
+    try:
+        load1, load5, load15 = os.getloadavg()
+        out["cpu"] = {"load_average": {"1m": round(load1, 2),
+                                       "5m": round(load5, 2),
+                                       "15m": round(load15, 2)}}
+    except OSError:
+        pass
+    try:
+        page = os.sysconf("SC_PAGE_SIZE")
+        total = os.sysconf("SC_PHYS_PAGES") * page
+        avail = os.sysconf("SC_AVPHYS_PAGES") * page
+        out["mem"] = {"total_in_bytes": total, "free_in_bytes": avail,
+                      "used_in_bytes": total - avail,
+                      "free_percent": int(100 * avail / total),
+                      "used_percent": int(100 * (total - avail) / total)}
+    except (OSError, ValueError):
+        pass
+    return out

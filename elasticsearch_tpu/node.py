@@ -57,10 +57,14 @@ class Node:
             ClusterState(cluster_name=cluster_name), self.node_id)
         self.cluster_service.add_listener(self._persist_state)
         from elasticsearch_tpu.indices.service import IndicesService
+        from elasticsearch_tpu.common.breaker import (
+            HierarchyCircuitBreakerService)
+        self.breaker_service = HierarchyCircuitBreakerService(self.settings)
         self.indices_service = IndicesService(self.data_path,
                                               self.cluster_service,
                                               self.node_id,
                                               self.allocation)
+        self.indices_service.breaker_service = self.breaker_service
         self.indices_service.on_shard_started = self._on_shard_started
         self.indices_service.on_shard_failed = self._on_shard_failed
         # ShardStateAction RPC endpoints (master side)
@@ -88,6 +92,13 @@ class Node:
         # snapshot/restore (core/snapshots/)
         from elasticsearch_tpu.snapshots import SnapshotsService
         self.snapshots_service = SnapshotsService(self)
+        # node-level monitoring fan-out (core/action/admin/cluster/node/)
+        self.transport_service.register_request_handler(
+            self.NODE_STATS_ACTION, self._handle_node_stats,
+            executor="management", sync=True)
+        self.transport_service.register_request_handler(
+            self.HOT_THREADS_ACTION, self._handle_hot_threads,
+            executor="management", sync=True)
         self._delayed_reroute_timer = None
         self.cluster_service.add_listener(self._schedule_delayed_reroute)
         from elasticsearch_tpu.discovery import ZenDiscovery
@@ -328,6 +339,88 @@ class Node:
                 st, [(shard, details)]),
             priority=URGENT).result(10.0)
         return {}
+
+    # ---- node-level monitoring (nodes stats / hot threads fan-out) ---------
+
+    NODE_STATS_ACTION = "cluster:monitor/nodes/stats[n]"
+    HOT_THREADS_ACTION = "cluster:monitor/nodes/hot_threads[n]"
+
+    def local_node_stats(self) -> dict:
+        """This node's stats document (core/action/admin/cluster/node/stats
+        — indices rollup, breakers, thread pools, process/os probes)."""
+        from elasticsearch_tpu.monitor import os_stats, process_stats
+        indices_total = {"docs": {"count": 0},
+                         "segments": {"count": 0, "memory_in_bytes": 0},
+                         "indexing": {"index_total": 0,
+                                      "index_time_in_millis": 0}}
+        for svc in list(self.indices_service.indices.values()):
+            s = svc.stats()
+            indices_total["docs"]["count"] += s["docs"]["count"]
+            indices_total["segments"]["count"] += s["segments"]["count"]
+            indices_total["segments"]["memory_in_bytes"] += \
+                s["segments"]["memory_in_bytes"]
+            indices_total["indexing"]["index_total"] += \
+                s["indexing"]["index_total"]
+            indices_total["indexing"]["index_time_in_millis"] += \
+                s["indexing"]["index_time_in_millis"]
+        pools = {}
+        ts = self.transport_service
+        with ts._pools_lock:
+            for name, pool in ts._pools.items():
+                pools[name] = {
+                    "threads": len(getattr(pool, "_threads", ())),
+                    "queue": pool._work_queue.qsize(),
+                }
+        recovery = getattr(self, "recovery_service", None)
+        return {
+            "name": self.node_name,
+            "timestamp": int(time.time() * 1000),
+            "indices": indices_total,
+            "breakers": self.breaker_service.stats(),
+            "thread_pool": pools,
+            "process": process_stats(),
+            "os": os_stats(),
+            "recovery": dict(recovery.stats) if recovery else {},
+        }
+
+    def _handle_node_stats(self, request: dict, source) -> dict:
+        return self.local_node_stats()
+
+    def _handle_hot_threads(self, request: dict, source) -> dict:
+        from elasticsearch_tpu.monitor import hot_threads
+        return {"text": hot_threads(
+            snapshots=int(request.get("snapshots", 10)),
+            interval=float(request.get("interval", 0.05)),
+            threads=int(request.get("threads", 3)))}
+
+    def _fan_out_nodes(self, action: str, request: dict) -> dict:
+        """Collect one payload per cluster node (TransportNodesAction)."""
+        state = self.cluster_service.state()
+        out = {}
+        futures = []
+        for nid, n in state.nodes.items():
+            if nid == self.node_id:
+                continue
+            futures.append((nid, self.transport_service.send_request(
+                n, action, request, timeout=15.0)))
+        handler = {self.NODE_STATS_ACTION: self._handle_node_stats,
+                   self.HOT_THREADS_ACTION: self._handle_hot_threads}[action]
+        out[self.node_id] = handler(request, None)
+        for nid, fut in futures:
+            try:
+                out[nid] = fut.result(20.0)
+            except Exception:                    # noqa: BLE001 — node gone
+                continue
+        return out
+
+    def collect_nodes_stats(self) -> dict:
+        return {"cluster_name": self.cluster_service.state().cluster_name,
+                "nodes": self._fan_out_nodes(self.NODE_STATS_ACTION, {})}
+
+    def collect_hot_threads(self, **params) -> str:
+        per_node = self._fan_out_nodes(self.HOT_THREADS_ACTION, params)
+        return "\n".join(f"::: node [{nid[:8]}]\n{p['text']}"
+                         for nid, p in per_node.items())
 
     @property
     def is_master(self) -> bool:

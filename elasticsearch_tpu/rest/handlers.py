@@ -143,6 +143,16 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/_cat/nodes", h.cat_nodes)
     r("GET", "/_cat/master", h.cat_master)
     r("GET", "/_cat/aliases", h.cat_aliases)
+    r("GET", "/_cat/allocation", h.cat_allocation)
+    r("GET", "/_cat/recovery", h.cat_recovery)
+    r("GET", "/_cat/segments", h.cat_segments)
+    r("GET", "/_cat/thread_pool", h.cat_thread_pool)
+    r("GET", "/_cat/snapshots/{repo}", h.cat_snapshots)
+    r("GET", "/_cat/templates", h.cat_templates)
+    r("GET", "/_cat/pending_tasks", h.cat_pending_tasks)
+    r("GET", "/_cat/nodeattrs", h.cat_nodeattrs)
+    r("GET", "/_nodes/hot_threads", h.nodes_hot_threads)
+    r("GET", "/_nodes/{node}/hot_threads", h.nodes_hot_threads)
 
 
 class Handlers:
@@ -711,15 +721,9 @@ class Handlers:
                                 "roles": ["master", "data", "ingest"]}}}
 
     def nodes_stats(self, req: RestRequest):
-        indices_stats = {}
-        total_docs = 0
-        for name, svc in self.node.indices_service.indices.items():
-            s = svc.stats()
-            total_docs += s["docs"]["count"]
-        return 200, {"nodes": {self.node.node_id: {
-            "name": self.node.node_name,
-            "indices": {"docs": {"count": total_docs}},
-        }}}
+        """GET /_nodes/stats — every node's stats document, collected over
+        the transport (TransportNodesStatsAction fan-out)."""
+        return 200, self.node.collect_nodes_stats()
 
     def all_stats(self, req: RestRequest):
         indices = {n: svc.stats()
@@ -753,7 +757,10 @@ class Handlers:
     def cat_help(self, req: RestRequest):
         paths = ["/_cat/indices", "/_cat/health", "/_cat/count",
                  "/_cat/shards", "/_cat/nodes", "/_cat/master",
-                 "/_cat/aliases"]
+                 "/_cat/aliases", "/_cat/allocation", "/_cat/recovery",
+                 "/_cat/segments", "/_cat/thread_pool",
+                 "/_cat/snapshots/{repo}", "/_cat/templates",
+                 "/_cat/pending_tasks", "/_cat/nodeattrs"]
         return 200, "=^.^=\n" + "\n".join(paths) + "\n"
 
     def cat_indices(self, req: RestRequest):
@@ -800,8 +807,106 @@ class Handlers:
                                      "node"], rows)
 
     def cat_nodes(self, req: RestRequest):
-        return self._cat_table(req, ["name", "node.role", "master"],
-                               [[self.node.node_name, "dim", "*"]])
+        state = self.node.cluster_service.state()
+        rows = []
+        for nid, n in sorted(state.nodes.items(), key=lambda kv: kv[1].name):
+            role = ("m" if n.master_eligible else "-") + \
+                ("d" if n.data_node else "-")
+            rows.append([n.address.host, role,
+                         "*" if nid == state.master_node_id else "-",
+                         n.name])
+        return self._cat_table(req, ["host", "node.role", "master", "name"],
+                               rows)
+
+    def cat_allocation(self, req: RestRequest):
+        state = self.node.cluster_service.state()
+        per_node = {nid: 0 for nid in state.nodes}
+        for s in state.routing_table.shards:
+            if s.node_id in per_node:
+                per_node[s.node_id] += 1
+        rows = [[count, state.nodes[nid].address.host,
+                 state.nodes[nid].name]
+                for nid, count in sorted(per_node.items(),
+                                         key=lambda kv: state.nodes[kv[0]].name)]
+        unassigned = sum(1 for s in state.routing_table.shards
+                         if not s.assigned)
+        if unassigned:
+            rows.append([unassigned, "-", "UNASSIGNED"])
+        return self._cat_table(req, ["shards", "host", "node"], rows)
+
+    def cat_recovery(self, req: RestRequest):
+        stats = self.node.recovery_service.stats
+        rows = [[stats["recoveries"], stats["files_sent"],
+                 stats["files_skipped"], stats["bytes_sent"],
+                 stats["ops_replayed"]]]
+        return self._cat_table(req, ["recoveries", "files_sent",
+                                     "files_skipped", "bytes_sent",
+                                     "ops_replayed"], rows)
+
+    def cat_segments(self, req: RestRequest):
+        rows = []
+        for name, svc in sorted(self.node.indices_service.indices.items()):
+            for sid in sorted(svc.engines):
+                for seg in svc.engines[sid].segment_stats():
+                    rows.append([name, sid, f"seg_{seg['seg_id']}",
+                                 seg["num_docs"], seg["live_docs"],
+                                 seg["memory_bytes"]])
+        return self._cat_table(req, ["index", "shard", "segment",
+                                     "docs.count", "docs.live",
+                                     "memory.bytes"], rows)
+
+    def cat_thread_pool(self, req: RestRequest):
+        ts = self.node.transport_service
+        rows = []
+        with ts._pools_lock:
+            for name, pool in sorted(ts._pools.items()):
+                rows.append([self.node.node_name, name,
+                             len(getattr(pool, "_threads", ())),
+                             pool._work_queue.qsize()])
+        return self._cat_table(req, ["node_name", "name", "threads",
+                                     "queue"], rows)
+
+    def cat_snapshots(self, req: RestRequest):
+        repo = req.path_params["repo"]
+        out = self.node.snapshots_service.get_snapshots(repo, "_all")
+        rows = [[s["snapshot"], s["state"],
+                 s.get("start_time_in_millis", 0),
+                 s.get("end_time_in_millis", 0),
+                 len(s.get("indices", {})),
+                 s.get("shards", {}).get("successful", 0),
+                 s.get("shards", {}).get("failed", 0)]
+                for s in out["snapshots"]]
+        return self._cat_table(req, ["id", "status", "start_epoch",
+                                     "end_epoch", "indices", "successful",
+                                     "failed"], rows)
+
+    def cat_templates(self, req: RestRequest):
+        state = self.node.cluster_service.state()
+        rows = [[name, str(t.get("template", t.get("index_patterns", "-"))),
+                 t.get("order", 0)]
+                for name, t in sorted(state.templates.items())]
+        return self._cat_table(req, ["name", "template", "order"], rows)
+
+    def cat_pending_tasks(self, req: RestRequest):
+        rows = [[t["insert_order"], t["priority"], t["source"]]
+                for t in self.node.cluster_service.pending_tasks()]
+        return self._cat_table(req, ["insertOrder", "priority", "source"],
+                               rows)
+
+    def cat_nodeattrs(self, req: RestRequest):
+        state = self.node.cluster_service.state()
+        rows = []
+        for nid, n in sorted(state.nodes.items(), key=lambda kv: kv[1].name):
+            for attr, value in n.attributes:
+                rows.append([n.name, n.address.host, attr, value])
+        return self._cat_table(req, ["node", "host", "attr", "value"], rows)
+
+    def nodes_hot_threads(self, req: RestRequest):
+        params = {}
+        for k in ("snapshots", "interval", "threads"):
+            if req.param(k) is not None:
+                params[k] = req.param(k)
+        return 200, self.node.collect_hot_threads(**params)
 
     def cat_master(self, req: RestRequest):
         return self._cat_table(

@@ -1,0 +1,158 @@
+"""Observability tier (SURVEY.md §2.8/§5): circuit breakers, slow logs,
+hot threads, nodes stats fan-out, _cat APIs."""
+
+import json
+import logging
+import subprocess
+
+import pytest
+
+from elasticsearch_tpu.common.breaker import HierarchyCircuitBreakerService
+from elasticsearch_tpu.common.errors import CircuitBreakingError
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.slowlog import IndexingSlowLog, SearchSlowLog
+from elasticsearch_tpu.monitor import hot_threads
+from elasticsearch_tpu.testing import InternalTestCluster
+
+
+# ---- breakers (unit) --------------------------------------------------------
+
+def test_breaker_trips_and_releases():
+    svc = HierarchyCircuitBreakerService(Settings(
+        {"indices.breaker.total.limit": "1000b",
+         "indices.breaker.request.limit": "600b",
+         "indices.breaker.fielddata.limit": "600b"}))
+    req = svc.breaker("request")
+    req.add_estimate(500, "a")
+    with pytest.raises(CircuitBreakingError):
+        req.add_estimate(200, "b")               # child limit 600
+    assert req.stats()["tripped"] == 1
+    # parent: request 500 + fielddata 600 > 1000 total
+    fd = svc.breaker("fielddata")
+    with pytest.raises(CircuitBreakingError):
+        fd.add_estimate(600, "c")
+    assert fd.used == 0                          # rolled back
+    req.release(500)
+    fd.add_estimate(600, "c")                    # fits now
+    assert svc.stats()["parent"]["estimated_size_in_bytes"] == 600
+
+
+def test_breaker_percentage_limits():
+    svc = HierarchyCircuitBreakerService(Settings(
+        {"indices.breaker.total.limit": "1000b",
+         "indices.breaker.fielddata.limit": "50%"}))
+    assert svc.breaker("fielddata").limit == 500
+
+
+# ---- slow logs (unit) -------------------------------------------------------
+
+def test_search_slow_log_threshold(caplog):
+    slog = SearchSlowLog("idx", Settings(
+        {"index.search.slowlog.threshold.query.warn": "100ms",
+         "index.search.slowlog.threshold.query.info": "10ms"}))
+    with caplog.at_level(logging.INFO, logger="index.search.slowlog"):
+        assert slog.maybe_log(0.05, "q1") == "info"
+        assert slog.maybe_log(0.5, "q2") == "warn"
+        assert slog.maybe_log(0.001, "q3") is None
+    assert len(caplog.records) == 2
+    assert "[idx]" in caplog.records[0].getMessage()
+
+
+def test_indexing_slow_log_disabled_by_default(caplog):
+    slog = IndexingSlowLog("idx", Settings({}))
+    assert slog.maybe_log(99.0, "op") is None
+
+
+# ---- hot threads (unit) -----------------------------------------------------
+
+def test_hot_threads_reports_busy_thread():
+    import threading, time
+    stop = threading.Event()
+
+    def spin():
+        while not stop.is_set():
+            sum(range(1000))
+
+    t = threading.Thread(target=spin, name="busy-spinner", daemon=True)
+    t.start()
+    try:
+        out = hot_threads(snapshots=6, interval=0.02)
+    finally:
+        stop.set()
+    assert "hot threads" in out
+    assert "busy-spinner" in out
+
+
+# ---- cluster-level ----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    with InternalTestCluster(
+            2, base_path=tmp_path_factory.mktemp("obs")) as c:
+        c.wait_for_nodes(2)
+        m = c.master()
+        m.indices_service.create_index(
+            "obs", {"settings": {"number_of_shards": 2,
+                                 "number_of_replicas": 0}})
+        c.wait_for_health("green")
+        ops = [("index", {"_index": "obs", "_id": str(i)},
+                {"msg": f"log line {i}"}) for i in range(20)]
+        m.document_actions.bulk(ops, refresh=True)
+        yield c
+
+
+def test_nodes_stats_covers_all_nodes(cluster):
+    out = cluster.master().collect_nodes_stats()
+    assert len(out["nodes"]) == 2
+    for stats in out["nodes"].values():
+        assert "breakers" in stats and "parent" in stats["breakers"]
+        assert "thread_pool" in stats
+        assert stats["process"]["cpu"]["total_in_millis"] >= 0
+
+
+def test_fielddata_breaker_accounts_segments(cluster):
+    m = cluster.master()
+    # a search forces device reader packing → fielddata accounting
+    m.search_actions.search("obs", {"query": {"match": {"msg": "log"}}})
+    used = sum(n.breaker_service.breaker("fielddata").used
+               for n in cluster.nodes)
+    assert used > 0
+
+
+def test_search_slowlog_fires_on_live_search(cluster, caplog):
+    m = cluster.master()
+    m.indices_service.update_settings(
+        "obs", {"index.search.slowlog.threshold.query.info": "0ms"})
+    import time
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        svc = m.indices_service.indices.get("obs")
+        if svc is not None and svc.search_slow_log.thresholds:
+            break
+        time.sleep(0.05)
+    with caplog.at_level(logging.INFO, logger="index.search.slowlog"):
+        m.search_actions.search("obs", {"query": {"match_all": {}}})
+    assert any("[obs]" in r.getMessage() for r in caplog.records)
+
+
+def test_cat_and_hot_threads_rest(cluster):
+    from elasticsearch_tpu.rest.server import RestServer
+    srv = RestServer(cluster.master(), port=19331).start()
+    base = "http://127.0.0.1:19331"
+    try:
+        for path in ("/_cat/allocation?v=true", "/_cat/segments",
+                     "/_cat/thread_pool", "/_cat/recovery",
+                     "/_cat/pending_tasks", "/_cat/templates",
+                     "/_cat/nodes?v=true", "/_cat/nodeattrs"):
+            out = subprocess.run(["curl", "-s", base + path],
+                                 capture_output=True, text=True).stdout
+            assert out is not None
+        out = subprocess.run(["curl", "-s", base + "/_nodes/hot_threads"],
+                             capture_output=True, text=True).stdout
+        assert "hot threads" in out
+        out = subprocess.run(["curl", "-s", base + "/_nodes/stats"],
+                             capture_output=True, text=True).stdout
+        stats = json.loads(out)
+        assert len(stats["nodes"]) == 2
+    finally:
+        srv.stop()
