@@ -1,0 +1,107 @@
+"""Plugin SPI — extend the node without forking it.
+
+Reference: core/plugins/Plugin.java:41-80 (`nodeModules()/nodeServices()/
+indexModules()/onModule(...)` hooks) + PluginsService (dir scan,
+classloader isolation, wired at core/node/Node.java:145,165-168,196).
+The reference's 21 in-tree plugins extend exactly these seams: analysis
+providers, script engines, discovery ping providers, repositories,
+mappers, REST endpoints.
+
+Python-native loading replaces the jar scan: `plugins` in node settings
+lists `module.path:ClassName` entries (or Plugin instances in embedded
+use); each class is imported and instantiated once per node. Hooks:
+
+* ``node_settings()``    — defaults merged UNDER user settings
+* ``on_node_start(node)`` — service wiring after the node is up
+* ``rest_routes(controller, node)`` — extra REST endpoints
+* ``analysis(registry)`` — register analyzers/tokenizers/filters
+* ``script_functions()`` — extra vectorized script functions
+* ``query_parsers()``    — {name: fn(body)->Query} extra query DSL types
+* ``on_node_stop(node)`` — teardown
+"""
+
+from __future__ import annotations
+
+import importlib
+
+
+class Plugin:
+    name = "plugin"
+
+    def node_settings(self) -> dict:
+        return {}
+
+    def on_node_start(self, node) -> None:
+        pass
+
+    def rest_routes(self, controller, node) -> None:
+        pass
+
+    def analysis(self, registry) -> None:
+        pass
+
+    def script_functions(self) -> dict:
+        return {}
+
+    def query_parsers(self) -> dict:
+        return {}
+
+    def on_node_stop(self, node) -> None:
+        pass
+
+
+class PluginsService:
+    def __init__(self, specs) -> None:
+        """`specs`: iterable of Plugin instances, Plugin subclasses, or
+        "module.path:ClassName" strings (the settings form)."""
+        self.plugins: list[Plugin] = []
+        for spec in specs or []:
+            self.plugins.append(self._load(spec))
+
+    @staticmethod
+    def _load(spec) -> Plugin:
+        if isinstance(spec, Plugin):
+            return spec
+        if isinstance(spec, type) and issubclass(spec, Plugin):
+            return spec()
+        if isinstance(spec, str):
+            mod_name, _, cls_name = spec.partition(":")
+            if not cls_name:
+                raise ValueError(
+                    f"plugin spec [{spec}] must be module:ClassName")
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+            return cls()
+        raise ValueError(f"cannot load plugin from {spec!r}")
+
+    def info(self) -> list[dict]:
+        return [{"name": p.name, "classname": type(p).__qualname__}
+                for p in self.plugins]
+
+    # ---- hook fan-out ------------------------------------------------------
+
+    def merged_default_settings(self) -> dict:
+        out: dict = {}
+        for p in self.plugins:
+            out.update(p.node_settings())
+        return out
+
+    def apply_node_start(self, node) -> None:
+        from elasticsearch_tpu.search import scripts as script_mod
+        for p in self.plugins:
+            for fname, fn in p.script_functions().items():
+                script_mod._FUNCS[fname] = fn
+            from elasticsearch_tpu.search import query_dsl
+            for qname, parser in p.query_parsers().items():
+                query_dsl.EXTRA_PARSERS[qname] = parser
+            p.on_node_start(node)
+
+    def apply_rest(self, controller, node) -> None:
+        for p in self.plugins:
+            p.rest_routes(controller, node)
+
+    def apply_node_stop(self, node) -> None:
+        for p in self.plugins:
+            try:
+                p.on_node_stop(node)
+            except Exception:                    # noqa: BLE001 — teardown
+                pass
