@@ -349,19 +349,30 @@ class SearchActions:
                     continue
                 tcol = s.seg.text_fields.get(f)
                 if tcol is not None:
-                    has = (np.asarray(tcol.uterms) >= 0).any(axis=1)
-                    doc_count += int((has[:live.shape[0]] & live).sum())
-                    if tcol.terms:
-                        lo, hi = tcol.terms[0], tcol.terms[-1]
+                    uterms = np.asarray(tcol.uterms)[:live.shape[0]]
+                    has = (uterms >= 0).any(axis=1)
+                    doc_count += int((has & live).sum())
+                    # min/max over terms with >=1 LIVE posting only —
+                    # terms surviving solely in deleted docs must not
+                    # skew the bounds
+                    live_tids = np.unique(uterms[live])
+                    live_tids = live_tids[live_tids >= 0]
+                    if live_tids.size:
+                        lo = tcol.terms[int(live_tids[0])]
+                        hi = tcol.terms[int(live_tids[-1])]
                         min_v = lo if min_v is None else min(min_v, lo)
                         max_v = hi if max_v is None else max(max_v, hi)
                     continue
                 kcol = s.seg.keyword_fields.get(f)
                 if kcol is not None:
-                    has = (np.asarray(kcol.ords) >= 0).any(axis=1)
-                    doc_count += int((has[:live.shape[0]] & live).sum())
-                    if kcol.vocab:
-                        lo, hi = kcol.vocab[0], kcol.vocab[-1]
+                    ords = np.asarray(kcol.ords)[:live.shape[0]]
+                    has = (ords >= 0).any(axis=1)
+                    doc_count += int((has & live).sum())
+                    live_ords = np.unique(ords[live])
+                    live_ords = live_ords[live_ords >= 0]
+                    if live_ords.size:
+                        lo = kcol.vocab[int(live_ords[0])]
+                        hi = kcol.vocab[int(live_ords[-1])]
                         min_v = lo if min_v is None else min(min_v, lo)
                         max_v = hi if max_v is None else max(max_v, hi)
             if doc_count:

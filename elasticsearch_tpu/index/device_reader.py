@@ -241,11 +241,16 @@ def device_reader_for(engine, view: SearcherView | None = None,
 def release_device_reader(engine) -> None:
     """Drop the engine's cached reader and return its breaker reservation
     (called from Engine.close so budget doesn't leak across index
-    delete/create churn)."""
-    cached = getattr(engine, "_device_reader_cache", None)
-    bs = getattr(engine, "breaker_service", None)
-    if cached is not None and bs is not None:
-        bs.breaker("fielddata").release(
-            getattr(cached, "_accounted_bytes", 0))
-    if cached is not None:
-        engine._device_reader_cache = None
+    delete/create churn). Takes the same lock as device_reader_for so a
+    concurrent packer can't install a new reader+reservation between our
+    read and clear (which would leak or double-release breaker bytes)."""
+    lock = engine.__dict__.setdefault("_device_reader_lock",
+                                      threading.Lock())
+    with lock:
+        cached = getattr(engine, "_device_reader_cache", None)
+        bs = getattr(engine, "breaker_service", None)
+        if cached is not None and bs is not None:
+            bs.breaker("fielddata").release(
+                getattr(cached, "_accounted_bytes", 0))
+        if cached is not None:
+            engine._device_reader_cache = None

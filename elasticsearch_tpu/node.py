@@ -30,6 +30,18 @@ class Node:
                  transport_hub: LocalTransportHub | None = None):
         if not isinstance(settings, Settings):
             settings = Settings(settings or {})
+        # plugin scan + default-settings merge happen before anything reads
+        # settings (reference order: PluginsService at core/node/Node.java:145
+        # precedes module assembly; plugin additionalSettings merge UNDER
+        # user settings)
+        from elasticsearch_tpu.plugins import PluginsService
+        specs = settings.get("plugins") or []
+        if isinstance(specs, str):
+            specs = [s.strip() for s in specs.split(",") if s.strip()]
+        self.plugins_service = PluginsService(specs)
+        defaults = self.plugins_service.merged_default_settings()
+        if defaults:
+            settings = Settings(defaults).merge(settings)
         self.settings = settings
         self.node_id = uuid.uuid4().hex[:20]
         self.node_name = settings.get("node.name", f"node-{self.node_id[:7]}")
@@ -118,6 +130,9 @@ class Node:
         self._started = True
         self.discovery.start(self.settings.get_as_float(
             "discovery.initial_state_timeout", 30.0))
+        # plugin service wiring once the node is fully up (the analog of
+        # nodeServices()/onModule hooks firing at injector-creation time)
+        self.plugins_service.apply_node_start(self)
         return self
 
     def _gateway_recover(self, state: ClusterState) -> ClusterState:
@@ -483,6 +498,7 @@ class Node:
         """Graceful shutdown: leave the cluster, then stop services."""
         if self._started:
             self._started = False
+            self.plugins_service.apply_node_stop(self)
             if self._delayed_reroute_timer is not None:
                 self._delayed_reroute_timer.cancel()
             self.search_actions.close()

@@ -86,13 +86,22 @@ class PluginsService:
         return out
 
     def apply_node_start(self, node) -> None:
+        from elasticsearch_tpu.analysis.analyzers import BUILTIN_ANALYZERS
+        from elasticsearch_tpu.search import query_dsl
         from elasticsearch_tpu.search import scripts as script_mod
+        self._registered_funcs: list[str] = []
+        self._registered_parsers: list[str] = []
         for p in self.plugins:
             for fname, fn in p.script_functions().items():
                 script_mod._FUNCS[fname] = fn
-            from elasticsearch_tpu.search import query_dsl
+                self._registered_funcs.append(fname)
             for qname, parser in p.query_parsers().items():
                 query_dsl.EXTRA_PARSERS[qname] = parser
+                self._registered_parsers.append(qname)
+            # analyzer providers land in the builtin registry, which every
+            # per-index AnalysisRegistry copies at creation (the
+            # onModule(AnalysisModule) seam)
+            p.analysis(BUILTIN_ANALYZERS)
             p.on_node_start(node)
 
     def apply_rest(self, controller, node) -> None:
@@ -100,6 +109,16 @@ class PluginsService:
             p.rest_routes(controller, node)
 
     def apply_node_stop(self, node) -> None:
+        # unregister what apply_node_start put into the process-global
+        # registries so plugin behavior doesn't outlive its node (in
+        # embedded multi-node use the registries are still process-wide
+        # while running, like any in-JVM singleton)
+        from elasticsearch_tpu.search import query_dsl
+        from elasticsearch_tpu.search import scripts as script_mod
+        for fname in getattr(self, "_registered_funcs", ()):
+            script_mod._FUNCS.pop(fname, None)
+        for qname in getattr(self, "_registered_parsers", ()):
+            query_dsl.EXTRA_PARSERS.pop(qname, None)
         for p in self.plugins:
             try:
                 p.on_node_stop(node)

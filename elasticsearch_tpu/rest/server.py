@@ -22,6 +22,9 @@ class RestServer:
         self.node = node
         self.controller = RestController()
         register_all(self.controller, node)
+        plugins = getattr(node, "plugins_service", None)
+        if plugins is not None:
+            plugins.apply_rest(self.controller, node)
         controller = self.controller
 
         class Handler(BaseHTTPRequestHandler):

@@ -213,6 +213,13 @@ def _parse_msm(v) -> int | str | None:
     return v
 
 
+# Plugin-registered query parsers ({name: fn(body) -> Query}) — the SPI seam
+# the reference exposes via IndicesQueriesModule/onModule(IndicesQueriesModule)
+# (query parsers registered by plugins). PluginsService.apply_node_start fills
+# this; parse_query falls back to it after the built-in arms.
+EXTRA_PARSERS: dict[str, Any] = {}
+
+
 def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query type
     if body is None or body == {}:
         return MatchAllQuery()
@@ -406,5 +413,9 @@ def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query
     if qtype in ("query_string", "simple_query_string"):
         from elasticsearch_tpu.search.query_string import parse_query_string
         return parse_query_string(qbody)
+
+    extra = EXTRA_PARSERS.get(qtype)
+    if extra is not None:
+        return extra(qbody)
 
     raise QueryParsingError(f"unknown query type [{qtype}]")

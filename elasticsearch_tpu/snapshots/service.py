@@ -178,9 +178,35 @@ class SnapshotsService:
                 if customs.get("snapshots_in_progress"):
                     raise RuntimeError(
                         "a snapshot is already running")
+                if customs.get("snapshot_deletions_in_progress"):
+                    raise RuntimeError(
+                        "a snapshot deletion is in progress")
                 customs["snapshots_in_progress"] = entry
             return st.with_(customs=customs)
         self.node.cluster_service.submit_and_wait("update-snapshot-state",
+                                                  update)
+
+    def _set_deletion_in_progress(self, entry: dict | None) -> None:
+        """Mutual-exclusion gate between deletes and running creates — the
+        reference's SnapshotsService likewise rejects deletes while a
+        snapshot is STARTED (SnapshotsInProgress check in deleteSnapshot).
+        Both markers flow through the master's single-writer queue, so
+        create/delete (and their index.json read-modify-writes, which only
+        happen while the corresponding marker is held) are serialized."""
+        def update(st):
+            customs = dict(st.customs)
+            if entry is None:
+                customs.pop("snapshot_deletions_in_progress", None)
+            else:
+                if customs.get("snapshots_in_progress"):
+                    raise RuntimeError(
+                        "cannot delete snapshot while a snapshot is running")
+                if customs.get("snapshot_deletions_in_progress"):
+                    raise RuntimeError(
+                        "a snapshot deletion is already in progress")
+                customs["snapshot_deletions_in_progress"] = entry
+            return st.with_(customs=customs)
+        self.node.cluster_service.submit_and_wait("update-snapshot-deletion",
                                                   update)
 
     # ---- read / delete -----------------------------------------------------
@@ -200,7 +226,12 @@ class SnapshotsService:
 
     def delete_snapshot(self, repo: str, snapshot: str) -> None:
         def local():
-            self.repository(repo).delete_snapshot(snapshot)
+            self._set_deletion_in_progress(
+                {"repository": repo, "snapshot": snapshot})
+            try:
+                self.repository(repo).delete_snapshot(snapshot)
+            finally:
+                self._set_deletion_in_progress(None)
         self.node.indices_service._master_op(
             "delete-snapshot", {"repo": repo, "snapshot": snapshot}, local)
 

@@ -1,0 +1,81 @@
+"""Plugin SPI wiring tests (core/plugins/Plugin.java:41-80 seams): node
+settings merge, query-parser registration reachable from parse_query,
+REST route registration, start/stop hooks."""
+
+import json
+import urllib.request
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.plugins import Plugin
+from elasticsearch_tpu.rest.server import RestServer
+from elasticsearch_tpu.search import query_dsl
+
+
+class _ProbePlugin(Plugin):
+    name = "probe"
+
+    def __init__(self):
+        self.started_on = None
+        self.stopped_on = None
+
+    def node_settings(self):
+        return {"probe.default": "from-plugin", "cluster.name": "ignored"}
+
+    def on_node_start(self, node):
+        self.started_on = node
+
+    def on_node_stop(self, node):
+        self.stopped_on = node
+
+    def query_parsers(self):
+        # a trivial extra query type: {"always": {}} -> match_all
+        return {"always": lambda body: query_dsl.MatchAllQuery()}
+
+    def rest_routes(self, controller, node):
+        controller.register(
+            "GET", "/_probe", lambda req: (200, {"probe": True}))
+
+
+def test_plugin_wiring_end_to_end(tmp_path):
+    plugin = _ProbePlugin()
+    node = Node({"plugins": [plugin],
+                 "cluster.name": "explicit"},
+                data_path=tmp_path / "n1").start()
+    try:
+        # defaults merge UNDER user settings
+        assert node.settings.get("probe.default") == "from-plugin"
+        assert node.settings.get("cluster.name") == "explicit"
+        assert plugin.started_on is node
+        # plugin query parser is consulted by parse_query
+        q = query_dsl.parse_query({"always": {}})
+        assert isinstance(q, query_dsl.MatchAllQuery)
+        # ... and usable in a real search
+        node.indices_service.create_index(
+            "idx", {"settings": {"number_of_shards": 1,
+                                 "number_of_replicas": 0}})
+        node.index_doc("idx", "1", {"t": "hello"}, refresh=True)
+        res = node.search("idx", {"query": {"always": {}}})
+        assert res["hits"]["total"]["value"] == 1
+        # plugin REST route served by the HTTP server
+        server = RestServer(node, port=0).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/_probe") as r:
+                assert json.loads(r.read())["probe"] is True
+        finally:
+            server.stop()
+    finally:
+        node.close()
+        query_dsl.EXTRA_PARSERS.pop("always", None)
+    assert plugin.stopped_on is node
+
+
+def test_plugin_spec_string_load(tmp_path):
+    # settings string form "module:ClassName"
+    node = Node({"plugins": ["tests.test_plugins:_ProbePlugin"]},
+                data_path=tmp_path / "n2").start()
+    try:
+        assert node.plugins_service.info()[0]["name"] == "probe"
+    finally:
+        node.close()
+        query_dsl.EXTRA_PARSERS.pop("always", None)
