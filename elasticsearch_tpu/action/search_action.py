@@ -347,6 +347,7 @@ class SearchActions:
                         min_v = lo if min_v is None else min(min_v, lo)
                         max_v = hi if max_v is None else max(max_v, hi)
                     continue
+                all_live = bool(live.all())
                 tcol = s.seg.text_fields.get(f)
                 if tcol is not None:
                     uterms = np.asarray(tcol.uterms)[:live.shape[0]]
@@ -354,27 +355,42 @@ class SearchActions:
                     doc_count += int((has & live).sum())
                     # min/max over terms with >=1 LIVE posting only —
                     # terms surviving solely in deleted docs must not
-                    # skew the bounds
-                    live_tids = np.unique(uterms[live])
-                    live_tids = live_tids[live_tids >= 0]
-                    if live_tids.size:
-                        lo = tcol.terms[int(live_tids[0])]
-                        hi = tcol.terms[int(live_tids[-1])]
-                        min_v = lo if min_v is None else min(min_v, lo)
-                        max_v = hi if max_v is None else max(max_v, hi)
+                    # skew the bounds. No-deletes fast path: the sorted
+                    # dictionary endpoints are already exact.
+                    if all_live:
+                        bounds = (tcol.terms[0], tcol.terms[-1]) \
+                            if tcol.terms else None
+                    else:
+                        live_tids = np.unique(uterms[live])
+                        live_tids = live_tids[live_tids >= 0]
+                        bounds = (tcol.terms[int(live_tids[0])],
+                                  tcol.terms[int(live_tids[-1])]) \
+                            if live_tids.size else None
+                    if bounds:
+                        min_v = bounds[0] if min_v is None \
+                            else min(min_v, bounds[0])
+                        max_v = bounds[1] if max_v is None \
+                            else max(max_v, bounds[1])
                     continue
                 kcol = s.seg.keyword_fields.get(f)
                 if kcol is not None:
                     ords = np.asarray(kcol.ords)[:live.shape[0]]
                     has = (ords >= 0).any(axis=1)
                     doc_count += int((has & live).sum())
-                    live_ords = np.unique(ords[live])
-                    live_ords = live_ords[live_ords >= 0]
-                    if live_ords.size:
-                        lo = kcol.vocab[int(live_ords[0])]
-                        hi = kcol.vocab[int(live_ords[-1])]
-                        min_v = lo if min_v is None else min(min_v, lo)
-                        max_v = hi if max_v is None else max(max_v, hi)
+                    if all_live:
+                        bounds = (kcol.vocab[0], kcol.vocab[-1]) \
+                            if kcol.vocab else None
+                    else:
+                        live_ords = np.unique(ords[live])
+                        live_ords = live_ords[live_ords >= 0]
+                        bounds = (kcol.vocab[int(live_ords[0])],
+                                  kcol.vocab[int(live_ords[-1])]) \
+                            if live_ords.size else None
+                    if bounds:
+                        min_v = bounds[0] if min_v is None \
+                            else min(min_v, bounds[0])
+                        max_v = bounds[1] if max_v is None \
+                            else max(max_v, bounds[1])
             if doc_count:
                 out[f] = {"max_doc": max_doc, "doc_count": doc_count,
                           "min_value": min_v, "max_value": max_v}

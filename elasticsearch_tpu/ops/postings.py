@@ -1,0 +1,250 @@
+"""Postings-block BM25 scoring — the inverted alternative to the forward scan.
+
+Two batched BM25 top-k kernels living behind the same contract as
+``models/bm25.bm25_topk_batch`` (the Lucene TermScorer replacement,
+ref: core/search/query/QueryPhase.java:314), each with a different
+work/hardware trade-off. ``ROOFLINE.md`` at the repo root derives the
+arithmetic; the bench (bench.py, BENCH_KERNEL=forward|slots|csr) measures
+all three on the chip and the engine keeps whichever wins.
+
+1. **slots** (`bm25_topk_batch_slots`): forward-layout scan, restructured so
+   the per-doc work is shared across the whole query batch. The batch's
+   unique terms become S "slots"; one pass over the [N, U] forward index
+   builds a per-doc slot-impact matrix A[N, S] (VPU compare+accumulate),
+   then every query's scores come from one MXU matmul W[Q, S] @ A[N, S]^T.
+   Work: N·U·S VPU ops + N·S·Q MXU MACs per batch — independent of how
+   many queries share terms, and the doc axis is processed in fixed-size
+   blocks with a running top-k, so HBM stays O(block·S + Q·k) at any N.
+
+2. **csr** (`bm25_topk_batch_csr`): true postings (impact-block) layout —
+   a term-partitioned CSR built once per segment; scoring gathers only the
+   postings of the batch's terms (E = Σ df entries) and scatter-adds
+   weighted impacts into dense [Q, N] score rows. Work: O(E) gathers +
+   Q·E scatter-adds — asymptotically the CPU/Lucene work profile, but
+   scatter throughput on TPU is the open question the bench answers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = jnp.float32(-jnp.inf)
+
+
+# ---------------------------------------------------------------------------
+# Host-side batch planning (shared by both kernels)
+# ---------------------------------------------------------------------------
+
+def plan_batch(qtids: np.ndarray, qidf: np.ndarray, vocab_size: int,
+               qweight: np.ndarray | None = None,
+               slot_pad: int = 32, s_total: int | None = None):
+    """Map a [Q, T] query batch onto batch-unique term slots.
+
+    Returns (table [V+1] int32: term id -> slot, or S for absent;
+             W [Q, S] f32: per-query per-slot weight = idf·boost summed over
+             duplicate query terms — Lucene sums duplicate TermQuery clauses).
+    S is padded to a multiple of ``slot_pad`` (or to the fixed ``s_total``)
+    to bound compiled shapes: steady-state serving should pass a fixed
+    ``s_total`` (e.g. Q·T rounded up) so every batch hits one compiled
+    program.
+    """
+    q, t = qtids.shape
+    uniq = np.unique(qtids[qtids >= 0])
+    s_real = uniq.shape[0]
+    if s_total is not None:
+        if s_real > s_total:
+            raise ValueError(f"batch has {s_real} unique terms > "
+                             f"s_total={s_total}")
+        s = s_total
+    else:
+        s = max(((s_real + slot_pad - 1) // slot_pad) * slot_pad, slot_pad)
+    table = np.full(vocab_size + 1, s, np.int32)
+    table[uniq] = np.arange(s_real, dtype=np.int32)
+    w = np.zeros((q, s), np.float32)
+    if qweight is None:
+        qweight = np.ones_like(qidf)
+    rows = np.repeat(np.arange(q), t)
+    valid = (qtids >= 0).reshape(-1)
+    slots = table[np.clip(qtids.reshape(-1), 0, vocab_size)]
+    np.add.at(w, (rows[valid], slots[valid]),
+              (qidf * qweight).reshape(-1)[valid])
+    return table, w
+
+
+# ---------------------------------------------------------------------------
+# Kernel 1: slot-shared forward scan (VPU build + MXU weighting)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("k", "k1", "b", "block"))
+def bm25_topk_batch_slots(uterms, utf, doc_len, live, table, w, avgdl,
+                          k: int, k1: float = 1.2, b: float = 0.75,
+                          block: int = 16384):
+    """Batched BM25 top-k via batch-shared slot impacts.
+
+    uterms/utf: [N, U] forward impact index; doc_len/live: [N];
+    table: [V+1] int32 term→slot; w: [Q, S] f32 per-query slot weights.
+    Returns (top_scores [Q, k], top_docs [Q, k] int32 global doc ids).
+    """
+    n, u = uterms.shape
+    q, s = w.shape
+    blk = min(block, n)
+    if n % blk:
+        # callers normally supply bucketized (power-of-2) row counts so a
+        # power-of-2 block divides evenly; pad defensively otherwise
+        pad = blk - n % blk
+        uterms = jnp.pad(uterms, ((0, pad), (0, 0)), constant_values=-1)
+        utf = jnp.pad(utf, ((0, pad), (0, 0)))
+        doc_len = jnp.pad(doc_len, (0, pad), constant_values=1)
+        live = jnp.pad(live, (0, pad))
+        n += pad
+    n_blocks = n // blk
+    kk = min(k, n)
+
+    norm = k1 * (1.0 - b + b * doc_len.astype(jnp.float32) / avgdl)
+    has_term = w > 0.0                # [Q, S] one indicator per query term
+    slot_ids = jnp.arange(s)
+
+    def body(carry, i):
+        top_s, top_d = carry
+        ut = jax.lax.dynamic_slice(uterms, (i * blk, 0), (blk, u))
+        tf = jax.lax.dynamic_slice(utf, (i * blk, 0), (blk, u))
+        nm = jax.lax.dynamic_slice(norm, (i * blk,), (blk,))
+        lv = jax.lax.dynamic_slice(live, (i * blk,), (blk,))
+        tfn = tf * (k1 + 1.0) / (tf + nm[:, None])            # [B, U]
+        slot = table[jnp.clip(ut, 0, table.shape[0] - 2)]
+        slot = jnp.where(ut >= 0, slot, s)                    # pad → S
+
+        # accumulate slot impacts one unique-term column at a time so the
+        # transient stays [B, S] (never [B, U, S]): VPU compare+FMA chain
+        def acc(j, carry_a):
+            a_acc, pres = carry_a
+            hit = slot[:, j][:, None] == slot_ids[None, :]    # [B, S]
+            a_acc = a_acc + jnp.where(hit, tfn[:, j][:, None], 0.0)
+            return a_acc, pres | hit
+
+        a, present = jax.lax.fori_loop(
+            0, u, acc, (jnp.zeros((blk, s), jnp.float32),
+                        jnp.zeros((blk, s), bool)))
+        scores = jax.lax.dot_general(
+            w, a, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [Q, B]
+        matched = jax.lax.dot_general(
+            has_term.astype(jnp.float32), present.astype(jnp.float32),
+            (((1,), (1,)), ((), ())))                         # [Q, B]
+        ok = lv[None, :] & (matched > 0.0)
+        masked = jnp.where(ok, scores, NEG_INF)
+        bs, bi = jax.lax.top_k(masked, min(kk, blk))          # [Q, kb]
+        bd = jnp.where(bs > NEG_INF,
+                       (bi + i * blk).astype(jnp.int32), -1)
+        # merge with running top-k (stable: earlier blocks first keeps
+        # doc-id-ascending tie-break, matching TopDocs.merge)
+        cat_s = jnp.concatenate([top_s, bs], axis=1)
+        cat_d = jnp.concatenate([top_d, bd], axis=1)
+        ms, mi = jax.lax.top_k(cat_s, kk)
+        md = jnp.take_along_axis(cat_d, mi, axis=1)
+        return (ms, md), None
+
+    init = (jnp.full((q, kk), NEG_INF), jnp.full((q, kk), -1, jnp.int32))
+    (top_s, top_d), _ = jax.lax.scan(body, init, jnp.arange(n_blocks))
+    if kk < k:
+        top_s = jnp.pad(top_s, ((0, 0), (0, k - kk)),
+                        constant_values=NEG_INF)
+        top_d = jnp.pad(top_d, ((0, 0), (0, k - kk)), constant_values=-1)
+    return top_s, top_d
+
+
+# ---------------------------------------------------------------------------
+# Kernel 2: CSR postings gather + scatter-add
+# ---------------------------------------------------------------------------
+
+class PostingsIndex:
+    """Term-partitioned CSR over a segment's forward index (host build).
+
+    The inverted view of the [N, U] forward columns: per term, the doc ids
+    containing it and their term frequencies, concatenated in term order —
+    Lucene's postings lists as three dense arrays (SURVEY.md §7 step 2's
+    "postings as padded dense blocks").
+    """
+
+    def __init__(self, indptr: np.ndarray, docs: np.ndarray,
+                 tfs: np.ndarray):
+        self.indptr = indptr          # [V+1] int64
+        self.docs = docs              # [NNZ] int32, doc-sorted per term
+        self.tfs = tfs                # [NNZ] float32
+
+    @staticmethod
+    def from_forward(uterms: np.ndarray, utf: np.ndarray,
+                     vocab_size: int) -> "PostingsIndex":
+        valid = uterms >= 0
+        terms = uterms[valid].astype(np.int64)
+        rows = np.broadcast_to(
+            np.arange(uterms.shape[0], dtype=np.int32)[:, None],
+            uterms.shape)[valid]
+        tfs = utf[valid]
+        order = np.argsort(terms, kind="stable")  # doc order preserved per term
+        terms, rows, tfs = terms[order], rows[order], tfs[order]
+        counts = np.bincount(terms, minlength=vocab_size)
+        indptr = np.zeros(vocab_size + 1, np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        return PostingsIndex(indptr, rows.astype(np.int32),
+                             tfs.astype(np.float32))
+
+    def gather_batch(self, table: np.ndarray, s: int,
+                     pad_to: int = 4096):
+        """Concatenate the postings of the batch's S slot terms.
+
+        Returns (entry_slot [E] int32, entry_doc [E] int32,
+        entry_tf [E] f32) with E padded to ``pad_to`` granularity
+        (pad entries have slot == s and doc == 0, weight 0 via W).
+        """
+        tids = np.nonzero(table[:-1] < s)[0]
+        spans = [(int(self.indptr[t]), int(self.indptr[t + 1]),
+                  int(table[t])) for t in tids]
+        e = sum(hi - lo for lo, hi, _ in spans)
+        ep = max(((e + pad_to - 1) // pad_to) * pad_to, pad_to)
+        entry_slot = np.full(ep, s, np.int32)
+        entry_doc = np.zeros(ep, np.int32)
+        entry_tf = np.zeros(ep, np.float32)
+        at = 0
+        for lo, hi, slot in spans:
+            w = hi - lo
+            entry_slot[at:at + w] = slot
+            entry_doc[at:at + w] = self.docs[lo:hi]
+            entry_tf[at:at + w] = self.tfs[lo:hi]
+            at += w
+        return entry_slot, entry_doc, entry_tf
+
+
+@partial(jax.jit, static_argnames=("k", "k1", "b", "n_docs"))
+def bm25_topk_batch_csr(entry_slot, entry_doc, entry_tf, doc_len, live,
+                        w, avgdl, n_docs: int, k: int,
+                        k1: float = 1.2, b: float = 0.75):
+    """Scatter-add postings scoring: O(E) work like the CPU baseline.
+
+    entry_*: [E] flattened batch postings (slot, doc, tf); w: [Q, S+1]
+    weights with a zero pad column at S. Returns (scores [Q, k], docs).
+    """
+    q = w.shape[0]
+    norm = k1 * (1.0 - b + b * doc_len.astype(jnp.float32) / avgdl)
+    contrib = entry_tf * (k1 + 1.0) / (entry_tf + norm[entry_doc])  # [E]
+
+    def one(w_q):
+        vals = w_q[entry_slot] * contrib
+        scores = jnp.zeros(n_docs, jnp.float32).at[entry_doc].add(
+            vals, mode="drop")
+        return scores
+
+    scores = jax.vmap(one)(w)                                    # [Q, N]
+    masked = jnp.where(live[None, :] & (scores > 0.0), scores, NEG_INF)
+    kk = min(k, n_docs)
+    top_s, top_i = jax.lax.top_k(masked, kk)
+    top_d = jnp.where(top_s > NEG_INF, top_i.astype(jnp.int32), -1)
+    if kk < k:
+        top_s = jnp.pad(top_s, ((0, 0), (0, k - kk)),
+                        constant_values=NEG_INF)
+        top_d = jnp.pad(top_d, ((0, 0), (0, k - kk)), constant_values=-1)
+    return top_s, top_d

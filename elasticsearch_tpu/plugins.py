@@ -23,6 +23,44 @@ use); each class is imported and instantiated once per node. Hooks:
 from __future__ import annotations
 
 import importlib
+import threading
+
+# process-wide refcounts for plugin registrations into module-global
+# registries: {(id(registry), key): [count, displaced_value]}
+_MISSING = object()
+_REG_LOCK = threading.Lock()
+_REG_REFS: dict[tuple[int, str], list] = {}
+
+
+def _global_register(registry: dict, key: str, value, undo: list) -> None:
+    with _REG_LOCK:
+        ref = _REG_REFS.setdefault((id(registry), key),
+                                   [0, registry.get(key, _MISSING)])
+        ref[0] += 1
+        registry[key] = value
+        undo.append((registry, key))
+
+
+def _note_registration(registry: dict, key: str, displaced, undo: list) -> None:
+    """Record a registration a plugin performed directly (analysis hook)."""
+    with _REG_LOCK:
+        ref = _REG_REFS.setdefault((id(registry), key), [0, displaced])
+        ref[0] += 1
+        undo.append((registry, key))
+
+
+def _global_unregister(registry: dict, key: str) -> None:
+    with _REG_LOCK:
+        ref = _REG_REFS.get((id(registry), key))
+        if ref is None:
+            return
+        ref[0] -= 1
+        if ref[0] <= 0:
+            del _REG_REFS[(id(registry), key)]
+            if ref[1] is _MISSING:
+                registry.pop(key, None)
+            else:
+                registry[key] = ref[1]
 
 
 class Plugin:
@@ -89,19 +127,23 @@ class PluginsService:
         from elasticsearch_tpu.analysis.analyzers import BUILTIN_ANALYZERS
         from elasticsearch_tpu.search import query_dsl
         from elasticsearch_tpu.search import scripts as script_mod
-        self._registered_funcs: list[str] = []
-        self._registered_parsers: list[str] = []
+        self._undo: list = []
         for p in self.plugins:
             for fname, fn in p.script_functions().items():
-                script_mod._FUNCS[fname] = fn
-                self._registered_funcs.append(fname)
+                _global_register(script_mod._FUNCS, fname, fn, self._undo)
             for qname, parser in p.query_parsers().items():
-                query_dsl.EXTRA_PARSERS[qname] = parser
-                self._registered_parsers.append(qname)
+                _global_register(query_dsl.EXTRA_PARSERS, qname, parser,
+                                 self._undo)
             # analyzer providers land in the builtin registry, which every
             # per-index AnalysisRegistry copies at creation (the
-            # onModule(AnalysisModule) seam)
+            # onModule(AnalysisModule) seam); snapshot-diff the dict so
+            # stop can restore displaced builtins
+            before = dict(BUILTIN_ANALYZERS)
             p.analysis(BUILTIN_ANALYZERS)
+            for name in set(BUILTIN_ANALYZERS) | set(before):
+                if BUILTIN_ANALYZERS.get(name) is not before.get(name):
+                    _note_registration(BUILTIN_ANALYZERS, name,
+                                       before.get(name, _MISSING), self._undo)
             p.on_node_start(node)
 
     def apply_rest(self, controller, node) -> None:
@@ -110,15 +152,16 @@ class PluginsService:
 
     def apply_node_stop(self, node) -> None:
         # unregister what apply_node_start put into the process-global
-        # registries so plugin behavior doesn't outlive its node (in
-        # embedded multi-node use the registries are still process-wide
-        # while running, like any in-JVM singleton)
-        from elasticsearch_tpu.search import query_dsl
-        from elasticsearch_tpu.search import scripts as script_mod
-        for fname in getattr(self, "_registered_funcs", ()):
-            script_mod._FUNCS.pop(fname, None)
-        for qname in getattr(self, "_registered_parsers", ()):
-            query_dsl.EXTRA_PARSERS.pop(qname, None)
+        # registries so plugin behavior doesn't outlive its node. Entries
+        # are REFCOUNTED across PluginsService instances: in embedded
+        # multi-node use, every node normally loads the same plugins, and
+        # one node's close must not disable the others (the registries
+        # stay process-wide while any registrant lives, like an in-JVM
+        # singleton); displaced pre-existing values are restored by the
+        # final unregister.
+        for registry, key in getattr(self, "_undo", ()):
+            _global_unregister(registry, key)
+        self._undo = []
         for p in self.plugins:
             try:
                 p.on_node_stop(node)

@@ -1,0 +1,124 @@
+"""Parity tests: the slot-shared and CSR postings kernels must reproduce
+the forward-scan kernel's exact BM25 top-k (scores and tie-broken doc
+order) — all three implement Lucene TermScorer/BM25Similarity semantics
+(ref: core/search/query/QueryPhase.java:314)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from elasticsearch_tpu.models.bm25 import bm25_topk_batch
+from elasticsearch_tpu.ops import postings as P
+
+
+@pytest.fixture(scope="module")
+def corpus(rng=None):
+    rng = np.random.default_rng(42)
+    n, u, vocab = 512, 12, 300
+    uterms = np.full((n, u), -1, np.int32)
+    utf = np.zeros((n, u), np.float32)
+    lens = np.zeros(n, np.int32)
+    for i in range(n):
+        cnt = rng.integers(3, u)
+        tids = np.sort(rng.choice(vocab, size=cnt, replace=False))
+        tfs = rng.integers(1, 5, size=cnt)
+        uterms[i, :cnt] = tids
+        utf[i, :cnt] = tfs
+        lens[i] = tfs.sum()
+    live = np.ones(n, bool)
+    live[5] = live[100] = False      # deleted docs must never surface
+    return uterms, utf, lens, live, vocab
+
+
+@pytest.fixture(scope="module")
+def queries(corpus):
+    rng = np.random.default_rng(7)
+    uterms, *_ , vocab = corpus
+    q, t = 8, 3
+    qtids = rng.choice(vocab, size=(q, t)).astype(np.int32)
+    qtids[0, 1] = qtids[0, 0]        # duplicate term in one query
+    qtids[1, 2] = -1                 # padded (absent) term
+    df = np.zeros(vocab, np.int64)
+    np.add.at(df, uterms[uterms >= 0], 1)
+    n = uterms.shape[0]
+    idf = np.where(df > 0, np.log1p((n - df + 0.5) / (df + 0.5)), 0.0)
+    qidf = np.where(qtids >= 0, idf[np.clip(qtids, 0, vocab - 1)], 0.0) \
+        .astype(np.float32)
+    return qtids, qidf
+
+
+AVGDL = None
+
+
+def _forward(corpus, queries, k):
+    uterms, utf, lens, live, vocab = corpus
+    qtids, qidf = queries
+    avgdl = np.float32(lens.sum() / len(lens))
+    return bm25_topk_batch(jnp.asarray(uterms), jnp.asarray(utf),
+                           jnp.asarray(lens), jnp.asarray(live),
+                           jnp.asarray(qtids), jnp.asarray(qidf),
+                           avgdl, k)
+
+
+def _assert_same(a, b, k):
+    sa, da = np.asarray(a[0]), np.asarray(a[1])
+    sb, db = np.asarray(b[0]), np.asarray(b[1])
+    np.testing.assert_allclose(
+        np.where(np.isfinite(sa), sa, -1), np.where(np.isfinite(sb), sb, -1),
+        rtol=1e-4, atol=1e-5)
+    # doc ids must match except where equal scores permute within ties
+    for qi in range(da.shape[0]):
+        mismatch = da[qi] != db[qi]
+        if mismatch.any():
+            # every mismatch must be a score tie
+            assert np.allclose(sa[qi][mismatch], sb[qi][mismatch],
+                               rtol=1e-4), (qi, da[qi], db[qi])
+
+
+def test_slots_kernel_matches_forward(corpus, queries):
+    uterms, utf, lens, live, vocab = corpus
+    qtids, qidf = queries
+    k = 20
+    table, w = P.plan_batch(qtids, qidf, vocab)
+    avgdl = np.float32(lens.sum() / len(lens))
+    got = P.bm25_topk_batch_slots(
+        jnp.asarray(uterms), jnp.asarray(utf), jnp.asarray(lens),
+        jnp.asarray(live), jnp.asarray(table), jnp.asarray(w), avgdl, k,
+        block=128)                    # force multi-block merge path
+    _assert_same(_forward(corpus, queries, k), got, k)
+
+
+def test_slots_kernel_single_block(corpus, queries):
+    uterms, utf, lens, live, vocab = corpus
+    qtids, qidf = queries
+    k = 600                           # k > n exercises padding
+    table, w = P.plan_batch(qtids, qidf, vocab)
+    avgdl = np.float32(lens.sum() / len(lens))
+    got = P.bm25_topk_batch_slots(
+        jnp.asarray(uterms), jnp.asarray(utf), jnp.asarray(lens),
+        jnp.asarray(live), jnp.asarray(table), jnp.asarray(w), avgdl, k)
+    _assert_same(_forward(corpus, queries, k), got, k)
+
+
+def test_csr_kernel_matches_forward(corpus, queries):
+    uterms, utf, lens, live, vocab = corpus
+    qtids, qidf = queries
+    k = 20
+    table, w = P.plan_batch(qtids, qidf, vocab)
+    idx = P.PostingsIndex.from_forward(uterms, utf, vocab)
+    es, ed, etf = idx.gather_batch(table, w.shape[1], pad_to=64)
+    wp = np.pad(w, ((0, 0), (0, 1)))
+    avgdl = np.float32(lens.sum() / len(lens))
+    got = P.bm25_topk_batch_csr(
+        jnp.asarray(es), jnp.asarray(ed), jnp.asarray(etf),
+        jnp.asarray(lens), jnp.asarray(live), jnp.asarray(wp), avgdl,
+        uterms.shape[0], k)
+    _assert_same(_forward(corpus, queries, k), got, k)
+
+
+def test_plan_batch_sums_duplicate_terms(queries):
+    qtids, qidf = queries
+    table, w = P.plan_batch(qtids, qidf, 300)
+    s0 = table[qtids[0, 0]]
+    # query 0 repeats its first term: slot weight must be 2x idf
+    assert np.isclose(w[0, s0], 2 * qidf[0, 0])
