@@ -40,8 +40,9 @@ def combine_bool(n: int,
         for s, m in should:
             scores = scores + jnp.where(m, s, 0.0)
             should_count = should_count + m.astype(jnp.int32)
-        if minimum_should_match > 0:
-            mask = mask & (should_count >= minimum_should_match)
+        # applied unconditionally so the threshold can be a traced value
+        # (msm == 0 makes the predicate vacuously true)
+        mask = mask & (should_count >= minimum_should_match)
     return scores, mask
 
 

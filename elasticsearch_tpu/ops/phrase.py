@@ -72,3 +72,50 @@ def sloppy_phrase_mask(tokens, qtids: list, deltas: list[int], slop: int):
             hit_any = h if hit_any is None else (hit_any | h)
         window = hit_any if window is None else (window & hit_any)
     return window.any(axis=1)
+
+
+_INF_SLOP = jnp.float32(1e9)
+
+
+def sloppy_phrase_freq(tokens, qtids: list, deltas: list[int], slop: int):
+    """Proximity-weighted sloppy phrase frequency — Lucene
+    SloppyPhraseScorer semantics for in-order matches: each match at total
+    displacement d (sum of per-term forward shifts from the exact-phrase
+    positions) contributes ``1 / (d + 1)`` to the phrase frequency
+    (SloppyPhraseScorer.sloppyFreq: 1/(1+matchLength)).
+
+    Matches are ANCHORED at the first term's actual position (its shift is
+    pinned to 0) so each occurrence is counted exactly once; every later
+    term takes its NEAREST admissible position (min shift in [0, slop]),
+    and the match is valid when the summed displacement ≤ slop. Deviations
+    from Lucene, documented: out-of-order matches (terms moving backwards)
+    are not found, and a phrase repeating one term can map two query terms
+    onto one token position.
+
+    Returns freq[N] f32.
+    """
+    total = None
+    for i, (tid, d) in enumerate(zip(qtids, deltas)):
+        shifts = (0,) if i == 0 else range(slop + 1)
+        best = None
+        for s in shifts:
+            h = (_shift_left(tokens, d + s) == tid) & (tid >= 0)
+            cand = jnp.where(h, jnp.float32(s), _INF_SLOP)
+            best = cand if best is None else jnp.minimum(best, cand)
+        total = best if total is None else total + best
+    valid = total <= slop
+    return jnp.where(valid, 1.0 / (1.0 + total), 0.0).sum(axis=1)
+
+
+def sloppy_phrase_score(tokens, doc_len, qtids: list, deltas: list[int],
+                        slop: int, idfs, k1, b, avgdl):
+    """BM25 over the proximity-weighted sloppy frequency (tf = sloppyFreq,
+    idf = Σ idf of the phrase terms, like PhraseWeight's combined stats).
+
+    Returns (scores[N] f32, mask[N] bool)."""
+    freq = sloppy_phrase_freq(tokens, qtids, deltas, slop)
+    norm = k1 * (1.0 - b + b * doc_len.astype(jnp.float32) / avgdl)
+    tf_norm = freq * (k1 + 1.0) / (freq + norm)
+    mask = freq > 0
+    sum_idf = jnp.asarray(idfs).sum()
+    return jnp.where(mask, sum_idf * tf_norm, 0.0), mask

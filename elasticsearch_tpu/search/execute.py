@@ -36,11 +36,62 @@ from elasticsearch_tpu.search import query_dsl as q
 from elasticsearch_tpu.search.scripts import ScriptContext, compile_script
 
 
+class ConstFeed:
+    """Separates a query's *structure* from its *constants* so the executor
+    walk can be traced once per (structure, segment layout) and replayed as
+    one compiled XLA program with fresh constants (term ids, idf, bounds) as
+    inputs — the compile-cache seam promised by this module's docstring.
+
+    plan mode: record every dynamic constant (value + shape/dtype into the
+    signature) and every static token; replay mode: hand back the traced
+    arrays of the jitted function in the same (deterministic) walk order.
+    """
+
+    __slots__ = ("mode", "values", "sig", "_replay", "_pos")
+
+    def __init__(self, mode: str = "plan", replay=None):
+        self.mode = mode
+        self.values: list[np.ndarray] = []
+        self.sig: list = []
+        self._replay = replay
+        self._pos = 0
+
+    def feed(self, v, dtype=None):
+        """A dynamic constant: value may differ between queries that share
+        one compiled program."""
+        if self.mode == "plan":
+            arr = np.asarray(v, dtype=dtype)
+            self.values.append(arr)
+            self.sig.append(("c", arr.shape, str(arr.dtype)))
+            return jnp.asarray(arr)
+        t = self._replay[self._pos]
+        self._pos += 1
+        return t
+
+    def static(self, *tokens) -> None:
+        """A static token: anything that changes the traced structure
+        (field names, clause counts, modifiers, slop windows...)."""
+        if self.mode == "plan":
+            self.sig.append(tokens)
+
+    def signature(self) -> tuple:
+        return tuple(self.sig)
+
+
+def _eager_const(v, dtype=None):
+    return np.asarray(v, dtype=dtype)
+
+
+def _noop_static(*tokens) -> None:
+    return None
+
+
 @dataclass
 class ExecutionContext:
     reader: DeviceReader
     mapper_service: Any
     bm25: BM25Params = BM25Params()
+    cf: ConstFeed | None = None
 
 
 def _edit_distance_le(a: str, b: str, k: int) -> bool:
@@ -72,6 +123,10 @@ class SegmentExecutor:
         self.seg = seg
         self.ctx = ctx
         self.n = seg.padded_docs
+        # dynamic-constant / static-token seams (plan-replay tracing); the
+        # eager path feeds plain numpy values straight into the jnp ops
+        self.c = ctx.cf.feed if ctx.cf is not None else _eager_const
+        self.sig = ctx.cf.static if ctx.cf is not None else _noop_static
 
     # ------------------------------------------------------------------ util
 
@@ -85,10 +140,12 @@ class SegmentExecutor:
         return ms.analysis.get("standard")
 
     def _zeros(self):
+        self.sig("zeros")
         return jnp.zeros(self.n, jnp.float32), jnp.zeros(self.n, bool)
 
     def _all(self, boost: float):
-        return (jnp.full(self.n, np.float32(boost)), jnp.ones(self.n, bool))
+        return (jnp.full(self.n, 1.0, jnp.float32)
+                * self.c(boost, np.float32), jnp.ones(self.n, bool))
 
     def _numeric_value(self, field: str, value):
         fm = self.ctx.mapper_service.field_mapper(field)
@@ -107,6 +164,7 @@ class SegmentExecutor:
         if method is None:
             raise QueryParsingError(
                 f"no executor for query type [{type(query).__name__}]")
+        self.sig(type(query).__name__, getattr(query, "field", None))
         return method(query)
 
     def match_mask(self, query: q.Query):
@@ -137,7 +195,9 @@ class SegmentExecutor:
     def _exec_MatchQuery(self, query: q.MatchQuery):
         if query.field in ("*", "_all"):
             # all-fields match (ES _all / query_string default): OR over every
-            # text field present in the segment
+            # text field present in the segment — iteration order is part of
+            # the plan signature (const feed order follows it)
+            self.sig("all-fields", tuple(self.seg.text))
             subs = [q.MatchQuery(field=f, text=query.text,
                                  operator=query.operator, boost=query.boost)
                     for f in self.seg.text]
@@ -146,7 +206,7 @@ class SegmentExecutor:
             scores = None
             mask = None
             for sub in subs:
-                s, m = self._exec_MatchQuery(sub)
+                s, m = self.execute(sub)
                 scores = s if scores is None else jnp.maximum(scores, s)
                 mask = m if mask is None else (mask | m)
             return scores, mask
@@ -154,7 +214,7 @@ class SegmentExecutor:
                 query.field in self.seg.keyword
                 or query.field in self.seg.numeric):
             # match on keyword/numeric doc values == exact term (ES behavior)
-            return self._exec_TermQuery(q.TermQuery(
+            return self.execute(q.TermQuery(
                 field=query.field, value=query.text, boost=query.boost))
         analyzer = self._analyzer_for(query.field, query.analyzer)
         terms = [t.term for t in analyzer.analyze(query.text)]
@@ -167,17 +227,19 @@ class SegmentExecutor:
         p = self.ctx.bm25
         scores, nmatch = lexical.bm25_match(
             col.uterms, col.utf, col.doc_len,
-            jnp.asarray(tids, jnp.int32), jnp.asarray(idfs, jnp.float32),
+            jnp.asarray(self.c(tids, np.int32)),
+            jnp.asarray(self.c(idfs, np.float32)),
             jnp.ones(len(tids), jnp.float32), p.k1, p.b,
-            np.float32(max(st.avgdl, 1e-9)))
+            self.c(max(st.avgdl, 1e-9), np.float32))
         if query.operator == "and":
             required = len(terms)
         elif query.minimum_should_match is not None:
             required = _resolve_msm(query.minimum_should_match, len(terms))
         else:
             required = 1
-        mask = nmatch >= required
-        return jnp.where(mask, scores * np.float32(query.boost), 0.0), mask
+        mask = nmatch >= self.c(required, np.int32)
+        return jnp.where(mask, scores * self.c(query.boost, np.float32),
+                         0.0), mask
 
     def _exec_MatchPhraseQuery(self, query: q.MatchPhraseQuery):
         analyzer = self._analyzer_for(query.field, query.analyzer)
@@ -185,7 +247,7 @@ class SegmentExecutor:
         if not toks:
             return self._zeros()
         if len(toks) == 1:
-            return self._exec_MatchQuery(q.MatchQuery(
+            return self.execute(q.MatchQuery(
                 field=query.field, text=query.text, analyzer=query.analyzer,
                 boost=query.boost))
         resolved = self._match_terms(query.field, [t.term for t in toks])
@@ -193,23 +255,24 @@ class SegmentExecutor:
             return self._zeros()
         col, st, tids, idfs = resolved
         deltas = [t.position - toks[0].position for t in toks]
+        self.sig("phrase", tuple(deltas), query.slop)
         p = self.ctx.bm25
+        tid_scalars = [jnp.int32(self.c(t, np.int32)) for t in tids]
         if query.slop > 0:
-            mask = phrase_ops.sloppy_phrase_mask(
-                col.tokens, [jnp.int32(t) for t in tids], deltas, query.slop)
-            # sloppy scoring approximated by OR-scored masked BM25
-            scores, _ = lexical.bm25_match(
-                col.uterms, col.utf, col.doc_len,
-                jnp.asarray(tids, jnp.int32), jnp.asarray(idfs, jnp.float32),
-                jnp.ones(len(tids), jnp.float32), p.k1, p.b,
-                np.float32(max(st.avgdl, 1e-9)))
-            return jnp.where(mask, scores * np.float32(query.boost), 0.0), mask
+            scores, mask = phrase_ops.sloppy_phrase_score(
+                col.tokens, col.doc_len, tid_scalars, deltas, query.slop,
+                jnp.asarray(self.c(idfs, np.float32)), p.k1, p.b,
+                self.c(max(st.avgdl, 1e-9), np.float32))
+            return scores * self.c(query.boost, np.float32), mask
         scores, mask = phrase_ops.phrase_score(
-            col.tokens, col.doc_len, [jnp.int32(t) for t in tids], deltas,
-            np.float32(sum(idfs)), p.k1, p.b, np.float32(max(st.avgdl, 1e-9)))
-        return scores * np.float32(query.boost), mask
+            col.tokens, col.doc_len, tid_scalars, deltas,
+            self.c(sum(idfs), np.float32), p.k1, p.b,
+            self.c(max(st.avgdl, 1e-9), np.float32))
+        return scores * self.c(query.boost, np.float32), mask
 
     def _exec_MultiMatchQuery(self, query: q.MultiMatchQuery):
+        self.sig("multi_match", query.type, query.tie_breaker > 0,
+                 len(query.fields))
         subs = []
         for fspec in query.fields:
             fname, _, fboost = fspec.partition("^")
@@ -234,29 +297,36 @@ class SegmentExecutor:
             else:  # best_fields: max + tie_breaker * others
                 mx = jnp.maximum(scores, s)
                 if query.tie_breaker > 0:
-                    scores = mx + np.float32(query.tie_breaker) * \
+                    scores = mx + self.c(query.tie_breaker, np.float32) * \
                         (scores + s - mx)
                 else:
                     scores = mx
-        return jnp.where(mask, scores * np.float32(query.boost), 0.0), mask
+        return jnp.where(mask, scores * self.c(query.boost, np.float32),
+                         0.0), mask
 
     def _keyword_or_text_term_mask(self, field: str, value):
         fm = self.ctx.mapper_service.field_mapper(field)
         kcol = self.seg.keyword.get(field)
         if kcol is not None:
+            self.sig("term-kw", field)
             return filter_ops.keyword_term(
-                kcol.ords, jnp.int32(kcol.column.ord(str(value))))
+                kcol.ords, self.c(kcol.column.ord(str(value)), np.int32))
         ncol = self.seg.numeric.get(field)
         if ncol is not None or (fm is not None and fm.kind == KIND_NUMERIC):
             if ncol is None:
+                self.sig("term-none", field)
                 return jnp.zeros(self.n, bool)
+            self.sig("term-num", field)
             hi, lo = dd_split(self._numeric_value(field, value))
             return filter_ops.numeric_term(ncol.hi, ncol.lo, ncol.exists,
-                                           jnp.float32(hi), jnp.float32(lo))
+                                           self.c(hi, np.float32),
+                                           self.c(lo, np.float32))
         tcol = self.seg.text.get(field)
         if tcol is not None:
-            return lexical.term_filter(tcol.uterms,
-                                       jnp.int32(tcol.column.tid(str(value))))
+            self.sig("term-text", field)
+            return lexical.term_filter(
+                tcol.uterms, self.c(tcol.column.tid(str(value)), np.int32))
+        self.sig("term-none", field)
         return jnp.zeros(self.n, bool)
 
     def _exec_TermQuery(self, query: q.TermQuery):
@@ -264,23 +334,26 @@ class SegmentExecutor:
         # TermQuery); on keyword/numeric doc values it is constant-score.
         tcol = self.seg.text.get(query.field)
         if tcol is not None and self.seg.keyword.get(query.field) is None:
-            return self._exec_MatchQuery(q.MatchQuery(
+            return self.execute(q.MatchQuery(
                 field=query.field, text=str(query.value), analyzer="keyword",
                 boost=query.boost))
         mask = self._keyword_or_text_term_mask(query.field, query.value)
-        return bool_ops.constant_score(mask, query.boost)
+        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
 
     def _exec_TermsQuery(self, query: q.TermsQuery):
         kcol = self.seg.keyword.get(query.field)
         if kcol is not None:
+            self.sig("terms-kw", query.field)
             qords = [kcol.column.ord(str(v)) for v in query.values]
             mask = filter_ops.keyword_terms(
-                kcol.ords, jnp.asarray(qords or [-1], jnp.int32))
-            return bool_ops.constant_score(mask, query.boost)
+                kcol.ords, jnp.asarray(self.c(qords or [-1], np.int32)))
+            return bool_ops.constant_score(mask,
+                                           self.c(query.boost, np.float32))
+        self.sig("terms-any", query.field, len(query.values))
         mask = jnp.zeros(self.n, bool)
         for v in query.values:
             mask = mask | self._keyword_or_text_term_mask(query.field, v)
-        return bool_ops.constant_score(mask, query.boost)
+        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
 
     def _exec_RangeQuery(self, query: q.RangeQuery):
         ncol = self.seg.numeric.get(query.field)
@@ -299,15 +372,18 @@ class SegmentExecutor:
             if query.lt is not None:
                 hi_v = min(hi_v, np.nextafter(np.float64(
                     self._numeric_value(query.field, query.lt)), -np.inf))
+            self.sig("range-num", query.field)
             ghi, glo = dd_split(lo_v)
             lhi, llo = dd_split(hi_v)
             mask = filter_ops.numeric_range(
                 ncol.hi, ncol.lo, ncol.exists,
-                jnp.float32(ghi), jnp.float32(glo),
-                jnp.float32(lhi), jnp.float32(llo))
-            return bool_ops.constant_score(mask, query.boost)
+                self.c(ghi, np.float32), self.c(glo, np.float32),
+                self.c(lhi, np.float32), self.c(llo, np.float32))
+            return bool_ops.constant_score(mask,
+                                           self.c(query.boost, np.float32))
         kcol = self.seg.keyword.get(query.field)
         if kcol is not None:
+            self.sig("range-kw", query.field)
             vocab = kcol.column.vocab
             lo_ord = 0
             hi_ord = len(vocab)
@@ -319,70 +395,87 @@ class SegmentExecutor:
                 hi_ord = _bisect_right(vocab, str(query.lte))
             if query.lt is not None:
                 hi_ord = _bisect_left(vocab, str(query.lt))
-            mask = filter_ops.keyword_ord_range(kcol.ords, lo_ord, hi_ord)
-            return bool_ops.constant_score(mask, query.boost)
+            mask = filter_ops.keyword_ord_range(
+                kcol.ords, self.c(lo_ord, np.int32),
+                self.c(hi_ord, np.int32))
+            return bool_ops.constant_score(mask,
+                                           self.c(query.boost, np.float32))
         return self._zeros()
 
     def _exec_ExistsQuery(self, query: q.ExistsQuery):
         f = query.field
         if f in self.seg.numeric:
-            mask = self.seg.numeric[f].exists
+            kind, mask = "num", self.seg.numeric[f].exists
         elif f in self.seg.keyword:
-            mask = (self.seg.keyword[f].ords >= 0).any(axis=1)
+            kind, mask = "kw", (self.seg.keyword[f].ords >= 0).any(axis=1)
         elif f in self.seg.text:
-            mask = self.seg.text[f].doc_len > 0
+            kind, mask = "text", self.seg.text[f].doc_len > 0
         elif f in self.seg.vector:
-            mask = self.seg.vector[f].exists
+            kind, mask = "vec", self.seg.vector[f].exists
         elif f in self.seg.geo:
-            mask = self.seg.geo[f].exists
+            kind, mask = "geo", self.seg.geo[f].exists
         else:
-            mask = jnp.zeros(self.n, bool)
-        return bool_ops.constant_score(mask, query.boost)
+            kind, mask = "none", jnp.zeros(self.n, bool)
+        self.sig("exists", kind, f)
+        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
 
     # --- vocab-scan leaf family (prefix/wildcard/regexp/fuzzy) -------------
 
     def _vocab_scan_mask(self, field: str, pred):
         """Expand a term predicate against per-segment vocabularies —
-        Lucene's MultiTermQuery rewrite (TermsEnum scan) stays host-side."""
+        Lucene's MultiTermQuery rewrite (TermsEnum scan) stays host-side.
+        Matching term-id lists are padded to power-of-2 buckets so queries
+        with different expansion counts share compiled programs."""
         kcol = self.seg.keyword.get(field)
         if kcol is not None:
+            self.sig("scan-kw", field)
             qords = [i for i, v in enumerate(kcol.column.vocab) if pred(v)]
             if not qords:
+                self.sig("scan-empty")
                 return jnp.zeros(self.n, bool)
-            return filter_ops.keyword_terms(kcol.ords,
-                                            jnp.asarray(qords, jnp.int32))
+            qords = _pad_pow2(qords, -1)
+            return filter_ops.keyword_terms(
+                kcol.ords, jnp.asarray(self.c(qords, np.int32)))
         tcol = self.seg.text.get(field)
         if tcol is not None:
+            self.sig("scan-text", field)
             tids = [i for i, t in enumerate(tcol.column.terms) if pred(t)]
             if not tids:
+                self.sig("scan-empty")
                 return jnp.zeros(self.n, bool)
-            hit = (tcol.uterms[:, :, None] ==
-                   jnp.asarray(tids, jnp.int32)[None, None, :])
+            tids = _pad_pow2(tids, -1)
+            qt = jnp.asarray(self.c(tids, np.int32))
+            hit = (tcol.uterms[:, :, None] == qt[None, None, :]) & \
+                (qt[None, None, :] >= 0)
             return hit.any(axis=(1, 2))
+        self.sig("scan-none", field)
         return jnp.zeros(self.n, bool)
 
     def _exec_PrefixQuery(self, query: q.PrefixQuery):
         kcol = self.seg.keyword.get(query.field)
         if kcol is not None:   # sorted vocab → ordinal interval, no scan
+            self.sig("prefix-kw", query.field)
             vocab = kcol.column.vocab
             lo = _bisect_left(vocab, query.value)
             hi = _bisect_left(vocab, query.value + "￿")
-            mask = filter_ops.keyword_ord_range(kcol.ords, lo, hi)
-            return bool_ops.constant_score(mask, query.boost)
+            mask = filter_ops.keyword_ord_range(
+                kcol.ords, self.c(lo, np.int32), self.c(hi, np.int32))
+            return bool_ops.constant_score(mask,
+                                           self.c(query.boost, np.float32))
         mask = self._vocab_scan_mask(query.field,
                                      lambda t: t.startswith(query.value))
-        return bool_ops.constant_score(mask, query.boost)
+        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
 
     def _exec_WildcardQuery(self, query: q.WildcardQuery):
         rx = re.compile(fnmatch.translate(query.pattern))
         mask = self._vocab_scan_mask(query.field, lambda t: rx.match(t) is not None)
-        return bool_ops.constant_score(mask, query.boost)
+        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
 
     def _exec_RegexpQuery(self, query: q.RegexpQuery):
         rx = re.compile(query.pattern)
         mask = self._vocab_scan_mask(query.field,
                                      lambda t: rx.fullmatch(t) is not None)
-        return bool_ops.constant_score(mask, query.boost)
+        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
 
     def _exec_FuzzyQuery(self, query: q.FuzzyQuery):
         v = query.value
@@ -392,7 +485,7 @@ class SegmentExecutor:
             k = int(query.fuzziness)
         mask = self._vocab_scan_mask(query.field,
                                      lambda t: _edit_distance_le(t, v, k))
-        return bool_ops.constant_score(mask, query.boost)
+        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
 
     def _exec_IdsQuery(self, query: q.IdsQuery):
         wanted = set(query.values)
@@ -400,11 +493,14 @@ class SegmentExecutor:
         for local, did in enumerate(self.seg.seg.ids):
             if did in wanted:
                 hits[local] = True
-        return bool_ops.constant_score(jnp.asarray(hits), query.boost)
+        return bool_ops.constant_score(jnp.asarray(self.c(hits)),
+                                       self.c(query.boost, np.float32))
 
     # ------------------------------------------------------------- compound
 
     def _exec_BoolQuery(self, query: q.BoolQuery):
+        self.sig("bool", len(query.must), len(query.should),
+                 len(query.must_not), len(query.filter))
         must = [self.execute(sub) for sub in query.must]
         should = [self.execute(sub) for sub in query.should]
         must_not = [self.match_mask(sub) for sub in query.must_not]
@@ -415,21 +511,29 @@ class SegmentExecutor:
             msm = 1 if (query.should and not query.must and not query.filter) \
                 else 0
         scores, mask = bool_ops.combine_bool(
-            self.n, must, should, must_not, filters, msm)
-        return scores * np.float32(query.boost), mask
+            self.n, must, should, must_not, filters,
+            self.c(msm, np.int32) if should else 0)
+        return scores * self.c(query.boost, np.float32), mask
 
     def _exec_ConstantScoreQuery(self, query: q.ConstantScoreQuery):
         mask = self.match_mask(query.filter_query)
-        return bool_ops.constant_score(mask, query.boost)
+        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
 
     def _exec_FunctionScoreQuery(self, query: q.FunctionScoreQuery):
+        self.sig("function_score", query.score_mode, query.boost_mode,
+                 query.max_boost is not None, query.min_score is not None,
+                 tuple((fn.kind, fn.weight is not None,
+                        fn.filter_query is not None)
+                       for fn in query.functions))
         base_scores, base_mask = self.execute(query.query or q.MatchAllQuery())
         factors, masks = [], []
         for fn in query.functions:
             factor = self._function_factor(fn, base_scores)
             if fn.weight is not None:
-                factor = factor * np.float32(fn.weight) if fn.kind != "weight" \
-                    else fs_ops.weight_factor(self.n, fn.weight)
+                factor = factor * self.c(fn.weight, np.float32) \
+                    if fn.kind != "weight" \
+                    else fs_ops.weight_factor(self.n,
+                                              self.c(fn.weight, np.float32))
             fmask = self.match_mask(fn.filter_query) if fn.filter_query \
                 else jnp.ones(self.n, bool)
             factors.append(factor)
@@ -438,30 +542,41 @@ class SegmentExecutor:
         if combined is None:
             scores = base_scores
         else:
+            max_boost = None if query.max_boost is None \
+                else self.c(query.max_boost, np.float32)
             scores = fs_ops.apply_boost_mode(base_scores, combined,
-                                             query.boost_mode, query.max_boost)
+                                             query.boost_mode, max_boost)
         mask = base_mask
         if query.min_score is not None:
-            mask = mask & (scores >= np.float32(query.min_score))
-        return scores * np.float32(query.boost), mask
+            mask = mask & (scores >= self.c(query.min_score, np.float32))
+        return scores * self.c(query.boost, np.float32), mask
 
     def _function_factor(self, fn: q.ScoreFunction, base_scores):
         params = fn.params
         if fn.kind == "weight":
-            return fs_ops.weight_factor(self.n, fn.weight or 1.0)
+            return fs_ops.weight_factor(self.n,
+                                        self.c(fn.weight or 1.0, np.float32))
         if fn.kind == "random_score":
+            self.sig("random", int(params.get("seed", 0)))
             return fs_ops.random_score(self.n, int(params.get("seed", 0)),
-                                       self.seg.doc_base)
+                                       self.c(self.seg.doc_base, np.uint32))
         if fn.kind == "field_value_factor":
             fname = params["field"]
             ncol = self.seg.numeric.get(fname)
             if ncol is None:
+                self.sig("fvf-missing", fname)
                 missing = params.get("missing", 1.0)
-                return jnp.full(self.n, np.float32(missing))
+                return jnp.full(self.n, 1.0, jnp.float32) * \
+                    self.c(missing, np.float32)
+            self.sig("fvf", fname, params.get("modifier", "none"),
+                     params.get("missing") is None)
+            missing = params.get("missing")
             return fs_ops.field_value_factor(
-                ncol.hi, ncol.exists, factor=float(params.get("factor", 1.0)),
+                ncol.hi, ncol.exists,
+                factor=self.c(float(params.get("factor", 1.0)), np.float32),
                 modifier=params.get("modifier", "none"),
-                missing=params.get("missing"))
+                missing=None if missing is None
+                else self.c(float(missing), np.float32))
         if fn.kind in ("gauss", "exp", "linear"):
             fname, spec = next(iter(params.items()))
             ncol = self.seg.numeric.get(fname)
@@ -469,27 +584,35 @@ class SegmentExecutor:
             fm = self.ctx.mapper_service.field_mapper(fname)
             geo_col = self.seg.geo.get(fname)
             if geo_col is not None:
+                self.sig("decay-geo", fname, fn.kind)
                 # geo decay: distance to origin in meters
                 if isinstance(origin, dict):
                     olat, olon = float(origin["lat"]), float(origin["lon"])
                 else:
                     olat, olon = (float(x) for x in str(origin).split(","))
-                from elasticsearch_tpu.ops.filters import geo_distance
-                # reuse haversine by computing distances then linear decay
+                olat = self.c(olat, np.float32)
+                olon = self.c(olon, np.float32)
+                # reuse haversine by computing distances then decay
                 r = 6371008.8
                 p1 = jnp.radians(geo_col.lat)
-                p2 = np.radians(olat)
+                p2 = jnp.radians(olat)
                 dphi = jnp.radians(geo_col.lat - olat)
                 dlmb = jnp.radians(geo_col.lon - olon)
-                a = jnp.sin(dphi / 2) ** 2 + jnp.cos(p1) * np.cos(p2) * \
+                a = jnp.sin(dphi / 2) ** 2 + jnp.cos(p1) * jnp.cos(p2) * \
                     jnp.sin(dlmb / 2) ** 2
                 dist = 2 * r * jnp.arcsin(jnp.sqrt(a))
                 scale = q.parse_distance(spec["scale"])
                 offset = q.parse_distance(spec.get("offset", 0))
-                return fs_ops.decay(dist, geo_col.exists, 0.0, scale, offset,
-                                    float(spec.get("decay", 0.5)), fn.kind)
+                return fs_ops.decay(dist, geo_col.exists,
+                                    self.c(0.0, np.float32),
+                                    self.c(scale, np.float32),
+                                    self.c(offset, np.float32),
+                                    self.c(float(spec.get("decay", 0.5)),
+                                           np.float32), fn.kind)
             if ncol is None:
+                self.sig("decay-missing", fname)
                 return jnp.ones(self.n, jnp.float32)
+            self.sig("decay", fname, fn.kind)
             if fm is not None and fm.type == "date":
                 origin_v = parse_date(origin) if origin is not None else 0.0
                 from elasticsearch_tpu.common.settings import parse_time_value
@@ -499,8 +622,12 @@ class SegmentExecutor:
                 origin_v = float(origin if origin is not None else 0.0)
                 scale = float(spec["scale"])
                 offset = float(spec.get("offset", 0))
-            return fs_ops.decay(ncol.hi, ncol.exists, origin_v, scale, offset,
-                                float(spec.get("decay", 0.5)), fn.kind)
+            return fs_ops.decay(ncol.hi, ncol.exists,
+                                self.c(origin_v, np.float32),
+                                self.c(scale, np.float32),
+                                self.c(offset, np.float32),
+                                self.c(float(spec.get("decay", 0.5)),
+                                       np.float32), fn.kind)
         if fn.kind == "script_score":
             script = params.get("script", params)
             if isinstance(script, dict):
@@ -511,7 +638,29 @@ class SegmentExecutor:
             return self._eval_script(src, sparams, base_scores)
         raise QueryParsingError(f"unknown score function [{fn.kind}]")
 
+    def _feed_script_params(self, params: dict) -> dict:
+        """Numeric script params become dynamic constants (vector params as
+        f32 arrays); anything else is structural."""
+        out = {}
+        for key in sorted(params):
+            v = params[key]
+            if isinstance(v, bool) or isinstance(v, str):
+                self.sig("sparam", key, v)
+                out[key] = v
+            elif isinstance(v, (int, float)):
+                self.sig("sparam", key, "num")
+                out[key] = self.c(float(v), np.float32)
+            elif isinstance(v, (list, tuple)):
+                self.sig("sparam", key, "vec", len(v))
+                out[key] = self.c(np.asarray(v, np.float32))
+            else:
+                self.sig("sparam", key, repr(v))
+                out[key] = v
+        return out
+
     def _eval_script(self, source: str, params: dict, scores):
+        self.sig("script", source)
+        params = self._feed_script_params(params)
         def get_numeric(field):
             ncol = self.seg.numeric.get(field)
             if ncol is None:
@@ -531,16 +680,17 @@ class SegmentExecutor:
     def _exec_ScriptScoreQuery(self, query: q.ScriptScoreQuery):
         base_scores, base_mask = self.execute(query.query or q.MatchAllQuery())
         scores = self._eval_script(query.script, query.params, base_scores)
-        return jnp.where(base_mask, scores * np.float32(query.boost), 0.0), \
+        return jnp.where(base_mask,
+                         scores * self.c(query.boost, np.float32), 0.0), \
             base_mask
 
     def _exec_KnnQuery(self, query: q.KnnQuery):
         vcol = self.seg.vector.get(query.field)
         if vcol is None:
             return self._zeros()
-        qv = jnp.asarray(query.query_vector, jnp.float32)
+        qv = jnp.asarray(self.c(query.query_vector, np.float32))
         scores = vector_ops.cosine_scores(vcol.vecs, vcol.exists, qv)
-        return (scores + 1.0) * np.float32(query.boost) * \
+        return (scores + 1.0) * self.c(query.boost, np.float32) * \
             vcol.exists.astype(jnp.float32), vcol.exists
 
     def _exec_GeoDistanceQuery(self, query: q.GeoDistanceQuery):
@@ -548,8 +698,10 @@ class SegmentExecutor:
         if gcol is None:
             return self._zeros()
         mask = filter_ops.geo_distance(gcol.lat, gcol.lon, gcol.exists,
-                                       query.lat, query.lon, query.distance_m)
-        return bool_ops.constant_score(mask, query.boost)
+                                       self.c(query.lat, np.float32),
+                                       self.c(query.lon, np.float32),
+                                       self.c(query.distance_m, np.float32))
+        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
 
     def _exec_GeoBoundingBoxQuery(self, query: q.GeoBoundingBoxQuery):
         gcol = self.seg.geo.get(query.field)
@@ -557,8 +709,10 @@ class SegmentExecutor:
             return self._zeros()
         mask = filter_ops.geo_bounding_box(
             gcol.lat, gcol.lon, gcol.exists,
-            query.top, query.left, query.bottom, query.right)
-        return bool_ops.constant_score(mask, query.boost)
+            self.c(query.top, np.float32), self.c(query.left, np.float32),
+            self.c(query.bottom, np.float32),
+            self.c(query.right, np.float32))
+        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
 
 
 def _resolve_msm(msm, num_clauses: int) -> int:
@@ -572,6 +726,14 @@ def _resolve_msm(msm, num_clauses: int) -> int:
             else num_clauses - int(num_clauses * -pct / 100.0)
         return max(val, 0)
     return int(s)
+
+
+def _pad_pow2(ids: list[int], fill: int) -> list[int]:
+    """Pad an id list to the next power-of-2 length so vocab-expansion
+    queries (wildcard/fuzzy/regexp) share compiled programs per bucket."""
+    n = max(len(ids), 1)
+    target = 1 << (n - 1).bit_length()
+    return ids + [fill] * (target - len(ids))
 
 
 def _bisect_left(vocab: list[str], v: str) -> int:

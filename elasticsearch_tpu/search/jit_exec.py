@@ -1,0 +1,223 @@
+"""Compiled query execution: one fused XLA program per (plan, layout).
+
+This delivers the promise in execute.py's docstring — the production query
+path analog of QueryPhase's single collector pass (ref:
+core/search/query/QueryPhase.java:99-314, `searcher.search(query,
+collector)` :314): instead of eagerly dispatching one device op per AST
+node, the whole per-segment walk — scoring, boolean algebra,
+function_score, min_score, post_filter, search-after continuation, hit
+counting and top-k — traces into ONE jitted program.
+
+Mechanics (see execute.ConstFeed):
+
+1. **plan pass** — `jax.eval_shape` walks the executor abstractly (zero
+   device work), recording every dynamic constant (term ids, idf, bounds)
+   and a structural signature (query shape, static tokens, const shapes).
+2. **cache** — key = (signature, segment layout, BM25 params, output
+   wants). Hit → the compiled program runs with this query's constants as
+   inputs. Queries differing only in terms/values/boosts share a program;
+   segments sharing a shape bucket share it too (the bounded-recompilation
+   contract of segment.doc_count_bucket).
+3. **replay** — the jitted function rebuilds a segment view from traced
+   arrays and re-walks the same executor code, with `ConstFeed` handing
+   back traced constants in recorded order.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import replace as dc_replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.index.device_reader import DeviceSegment
+from elasticsearch_tpu.ops import topk as topk_ops
+from elasticsearch_tpu.search.execute import (
+    ConstFeed, ExecutionContext, SegmentExecutor)
+
+_CACHE_CAP = 512
+_cache: OrderedDict[tuple, "jax.stages.Wrapped"] = OrderedDict()
+_cache_lock = threading.Lock()
+_stats = {"hits": 0, "misses": 0, "fallbacks": 0}
+
+
+def cache_stats() -> dict:
+    return dict(_stats)
+
+
+def note_fallback() -> None:
+    _stats["fallbacks"] += 1
+
+
+def clear_cache() -> None:
+    with _cache_lock:
+        _cache.clear()
+        _stats.update(hits=0, misses=0, fallbacks=0)
+
+
+# ---------------------------------------------------------------------------
+# Segment flatten/rebuild (the traced-input pytree)
+# ---------------------------------------------------------------------------
+
+_KINDS = ("text", "keyword", "numeric", "vector", "geo")
+_ARRAYS = {
+    "text": ("tokens", "uterms", "utf", "doc_len"),
+    "keyword": ("ords",),
+    "numeric": ("hi", "lo", "exists"),
+    "vector": ("vecs", "exists"),
+    "geo": ("lat", "lon", "exists"),
+}
+
+
+def seg_flatten(seg: DeviceSegment) -> list:
+    """Device arrays of a segment in deterministic order (live first)."""
+    flat = [seg.live]
+    for kind in _KINDS:
+        fields = getattr(seg, kind)
+        for name in sorted(fields):
+            col = fields[name]
+            for attr in _ARRAYS[kind]:
+                flat.append(getattr(col, attr))
+    return flat
+
+
+def seg_rebuild(seg: DeviceSegment, flat: list) -> DeviceSegment:
+    """Shallow-copy `seg` with arrays swapped for (traced) `flat`."""
+    it = iter(flat)
+    live = next(it)
+    kinds = {}
+    for kind in _KINDS:
+        fields = getattr(seg, kind)
+        # arrays were flattened in sorted-name order, but the rebuilt dicts
+        # must preserve the ORIGINAL iteration order — executor walks (e.g.
+        # the all-fields match loop) iterate these dicts, and plan/replay
+        # const order depends on it
+        rebuilt = {
+            name: dc_replace(fields[name],
+                             **{attr: next(it) for attr in _ARRAYS[kind]})
+            for name in sorted(fields)}
+        kinds[kind] = {name: rebuilt[name] for name in fields}
+    return dc_replace(seg, live=live, **kinds)
+
+
+def layout_key(seg: DeviceSegment) -> tuple:
+    out = [seg.padded_docs]
+    for kind in _KINDS:
+        fields = getattr(seg, kind)
+        for name in sorted(fields):
+            col = fields[name]
+            out.append((kind, name) + tuple(
+                (tuple(getattr(col, attr).shape),
+                 str(getattr(col, attr).dtype))
+                for attr in _ARRAYS[kind]))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# The fused per-segment program
+# ---------------------------------------------------------------------------
+
+def _build(seg_view, ctx, query, post_filter, flags, k):
+    """The traced body: executor walk + phase post-processing + top-k."""
+    cf = ctx.cf
+    ex = SegmentExecutor(seg_view, ctx)
+    scores, mask = ex.execute(query)
+    mask = mask & seg_view.live
+    if flags["min_score"]:
+        mask = mask & (scores >= cf.feed(flags["_min_score"], np.float32))
+    if post_filter is not None:
+        pf_mask = SegmentExecutor(seg_view, ctx).match_mask(post_filter)
+        mask_post = mask & pf_mask
+    else:
+        mask_post = mask
+    if flags["search_after"]:
+        last_score = cf.feed(flags["_sa_score"], np.float32)
+        last_doc = cf.feed(flags["_sa_doc"], np.int32)
+        ids = jnp.arange(seg_view.padded_docs, dtype=jnp.int32) + \
+            cf.feed(flags["_doc_base"], np.int32)
+        cont = (scores < last_score) | ((scores == last_score) &
+                                        (ids > last_doc))
+        mask_post = mask_post & cont
+    count = mask_post.sum(dtype=jnp.int32)
+    outs = {"count": count}
+    if flags["want_topk"]:
+        ts, td = topk_ops.top_k(scores, mask_post,
+                                min(k, seg_view.padded_docs),
+                                0)
+        outs["top_scores"], outs["top_docs"] = ts, td
+    if flags["want_arrays"]:
+        outs["scores"] = scores
+        outs["mask"] = mask_post
+        # pre-post_filter mask for aggregations (ES computes aggs on the
+        # main query result, ignoring post_filter)
+        outs["agg_mask"] = mask
+    return outs
+
+
+def run_segment(seg: DeviceSegment, ctx: ExecutionContext, query,
+                *, post_filter=None, min_score=None, search_after=None,
+                k: int | None = None, want_arrays: bool = False) -> dict:
+    """Execute a query against one device segment as one compiled program.
+
+    Returns {"count": i32 [, "top_scores", "top_docs"] [, "scores",
+    "mask", "agg_mask"]} as device arrays; top_docs are segment-local
+    (caller adds seg.doc_base).
+    """
+    flags = {
+        "min_score": min_score is not None,
+        "_min_score": 0.0 if min_score is None else float(min_score),
+        "search_after": search_after is not None,
+        "_sa_score": 0.0 if search_after is None
+        else float(search_after[0]),
+        "_sa_doc": -1 if (search_after is None or len(search_after) < 2)
+        else int(search_after[1]),
+        "_doc_base": seg.doc_base,
+        "want_topk": k is not None,
+        "want_arrays": want_arrays,
+    }
+    k_static = 0 if k is None else int(k)
+
+    # ---- plan pass: collect consts + signature, no device work ----------
+    pcf = ConstFeed("plan")
+    pctx = dc_replace(ctx, cf=pcf)
+    jax.eval_shape(
+        lambda: _build(seg, pctx, query, post_filter, flags, k_static))
+    consts = tuple(jnp.asarray(v) for v in pcf.values)
+
+    key = (pcf.signature(), layout_key(seg),
+           float(ctx.bm25.k1), float(ctx.bm25.b),
+           flags["min_score"], flags["search_after"], k_static, want_arrays,
+           post_filter is not None)
+
+    flat = seg_flatten(seg)
+    with _cache_lock:
+        fn = _cache.get(key)
+        if fn is not None:
+            _cache.move_to_end(key)
+            _stats["hits"] += 1
+    if fn is None:
+        _stats["misses"] += 1
+
+        def run(flat_in, consts_in):
+            rcf = ConstFeed("replay", replay=consts_in)
+            rctx = dc_replace(ctx, cf=rcf)
+            view = seg_rebuild(seg, flat_in)
+            return _build(view, rctx, query, post_filter, flags, k_static)
+
+        # AOT lower+compile and cache ONLY the executable: a cached
+        # jax.jit closure would pin the whole DeviceSegment/DeviceReader
+        # (every column's device arrays) for the life of the cache entry —
+        # an accumulating device-memory leak across index churn
+        shapes = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            (flat, consts))
+        fn = jax.jit(run).lower(*shapes).compile()
+        with _cache_lock:
+            _cache[key] = fn
+            while len(_cache) > _CACHE_CAP:
+                _cache.popitem(last=False)
+
+    return fn(flat, consts)

@@ -140,6 +140,81 @@ class ShardSearcher:
     # -- query phase ---------------------------------------------------------
 
     def query_phase(self, req: ParsedSearchRequest) -> ShardQueryResult:
+        """One fused device program per segment (compile-cached across
+        queries and same-shaped segments); falls back to the eager
+        per-op walk if the plan/trace fails for an exotic query. Only the
+        plan/trace seam is guarded — errors in parsing/aggs/sort raise
+        normally without double execution."""
+        from elasticsearch_tpu.search import jit_exec
+        k = max(req.from_ + req.size, 1)
+        score_order = not req.sort or \
+            (len(req.sort) == 1 and "_score" in req.sort[0])
+        need_arrays = bool(req.aggs) or not score_order
+        sa = req.search_after if (req.search_after is not None
+                                  and not req.sort) else None
+        try:
+            outs = [(seg, jit_exec.run_segment(
+                seg, self.ctx, req.query,
+                post_filter=req.post_filter, min_score=req.min_score,
+                search_after=sa, k=(k if score_order else None),
+                want_arrays=need_arrays))
+                for seg in self.reader.segments]
+        except QueryParsingError:
+            raise
+        except Exception:                     # noqa: BLE001 — fallback seam
+            jit_exec.note_fallback()
+            return self._query_phase_eager(req)
+
+        total = int(sum(int(np.asarray(o["count"])) for _, o in outs))
+        agg_partials = {}
+        if req.aggs:
+            agg_partials = self._collect_aggs(
+                req, [np.asarray(o["agg_mask"]) for _, o in outs],
+                [np.asarray(o["scores"]) for _, o in outs])
+
+        if not score_order:
+            per_seg = [(o["scores"], o["mask"]) for _, o in outs]
+            return self._sorted_query(req, per_seg, total, agg_partials)
+
+        seg_scores = [o["top_scores"] for _, o in outs]
+        seg_docs = [jnp.where(o["top_docs"] >= 0,
+                              o["top_docs"] + seg.doc_base, -1)
+                    for seg, o in outs]
+        return self._finish_score_order(k, total, seg_scores, seg_docs,
+                                        agg_partials)
+
+    def _collect_aggs(self, req: ParsedSearchRequest,
+                      masks: list, scores: list) -> dict:
+        """Run top-level agg collectors over the (pre-post_filter) mask —
+        shared by the jit and eager query paths."""
+        if not req.aggs:
+            return {}
+        agg_mask = np.concatenate(masks) if masks else np.zeros(0, bool)
+        agg_scores = np.concatenate(scores) if scores \
+            else np.zeros(0, np.float32)
+        agg_ctx = ShardAggContext(self.reader, self.mapper_service,
+                                  self._filter_masks_np, scores=agg_scores)
+        from elasticsearch_tpu.search.aggregations import PIPELINE_AGGS
+        return {node.name: collect(node, agg_mask, agg_ctx)
+                for node in req.aggs if node.type not in PIPELINE_AGGS}
+
+    def _finish_score_order(self, k: int, total: int, seg_scores: list,
+                            seg_docs: list, agg_partials: dict
+                            ) -> ShardQueryResult:
+        """Device merge of per-segment top-k → shard result (shared by the
+        jit and eager query paths)."""
+        if seg_scores:
+            ms, md = topk_ops.merge_top_k(seg_scores, seg_docs, k)
+            ms, md = np.asarray(ms), np.asarray(md)
+            valid = md >= 0
+            ms, md = ms[valid], md[valid]
+        else:
+            ms, md = np.zeros(0, np.float32), np.zeros(0, np.int32)
+        max_sc = float(ms[0]) if ms.size else None
+        return ShardQueryResult(self.shard_id, total, max_sc, md, ms, None,
+                                agg_partials, self.reader)
+
+    def _query_phase_eager(self, req: ParsedSearchRequest) -> ShardQueryResult:
         k = max(req.from_ + req.size, 1)
         per_seg = self._execute_query(req.query)
 
@@ -148,19 +223,9 @@ class ShardSearcher:
                        for s, m in per_seg]
 
         # aggregations run on the pre-post_filter mask (ES semantics)
-        agg_partials = {}
-        if req.aggs:
-            agg_mask = np.concatenate([np.asarray(m) for _, m in per_seg]) \
-                if per_seg else np.zeros(0, bool)
-            agg_scores = np.concatenate([np.asarray(s) for s, _ in per_seg]) \
-                if per_seg else np.zeros(0, np.float32)
-            agg_ctx = ShardAggContext(self.reader, self.mapper_service,
-                                      self._filter_masks_np, scores=agg_scores)
-            from elasticsearch_tpu.search.aggregations import PIPELINE_AGGS
-            for node in req.aggs:
-                if node.type in PIPELINE_AGGS:
-                    continue  # sibling pipelines are reduce-phase only
-                agg_partials[node.name] = collect(node, agg_mask, agg_ctx)
+        agg_partials = self._collect_aggs(
+            req, [np.asarray(m) for _, m in per_seg],
+            [np.asarray(s) for s, _ in per_seg])
 
         if req.post_filter is not None:
             post = [SegmentExecutor(seg, self.ctx).match_mask(req.post_filter)
@@ -190,16 +255,8 @@ class ShardSearcher:
             ts, td = topk_ops.top_k(s, m, min(k, seg.padded_docs), seg.doc_base)
             seg_scores.append(ts)
             seg_docs.append(td)
-        if seg_scores:
-            ms, md = topk_ops.merge_top_k(seg_scores, seg_docs, k)
-            ms, md = np.asarray(ms), np.asarray(md)
-            valid = md >= 0
-            ms, md = ms[valid], md[valid]
-        else:
-            ms, md = np.zeros(0, np.float32), np.zeros(0, np.int32)
-        max_sc = float(ms[0]) if ms.size else None
-        return ShardQueryResult(self.shard_id, total, max_sc, md, ms, None,
-                                agg_partials, self.reader)
+        return self._finish_score_order(k, total, seg_scores, seg_docs,
+                                        agg_partials)
 
     def _sorted_query(self, req, per_seg, total, agg_partials):
         """Sort-by-field path: host numpy argsort over doc-values columns

@@ -1,0 +1,137 @@
+"""Compiled query-path tests: the production search path must execute as
+one fused program per segment with compile-cache reuse across queries
+(different constants) and across same-shape-bucket segments — the
+collector-stack-in-one-pass design (ref:
+core/search/query/QueryPhase.java:99-314)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search import jit_exec
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node({}, data_path=tmp_path / "n").start()
+    yield n
+    n.close()
+
+
+def _mk(node, name, docs, shards=1):
+    node.indices_service.create_index(
+        name, {"settings": {"number_of_shards": shards,
+                            "number_of_replicas": 0}})
+    for i in range(docs):
+        node.index_doc(name, str(i),
+                       {"t": f"alpha beta word{i % 5}", "n": i,
+                        "tag": f"g{i % 3}"})
+    node.broadcast_actions.refresh(name)
+
+
+def test_cache_reuse_across_queries(node):
+    _mk(node, "idx", 40)
+    jit_exec.clear_cache()
+    node.search("idx", {"query": {"match": {"t": "word1"}}})
+    base = jit_exec.cache_stats()
+    # same plan shape, different term/boost values → no recompile
+    for term, boost in (("word2", 1.0), ("word3", 2.5), ("alpha", 0.3)):
+        node.search("idx", {"query": {"match": {
+            "t": {"query": term, "boost": boost}}}})
+    st = jit_exec.cache_stats()
+    assert st["misses"] == base["misses"]
+    assert st["hits"] >= base["hits"] + 3
+    assert st["fallbacks"] == 0
+
+
+def test_cache_reuse_across_same_bucket_segments(node):
+    # two indexes with the same doc-count bucket & field layout share
+    # compiled programs (doc_count_bucket gives both the 128-row bucket)
+    _mk(node, "a", 30)
+    _mk(node, "b", 60)
+    jit_exec.clear_cache()
+    node.search("a", {"query": {"match": {"t": "alpha"}}})
+    st1 = jit_exec.cache_stats()
+    node.search("b", {"query": {"match": {"t": "beta"}}})
+    st2 = jit_exec.cache_stats()
+    assert st2["misses"] == st1["misses"], \
+        "same-bucket segment must reuse the compiled program"
+    assert st2["fallbacks"] == 0
+
+
+def test_jit_matches_eager_results(node):
+    _mk(node, "idx", 80)
+    body = {
+        "query": {"bool": {
+            "must": [{"match": {"t": "alpha"}}],
+            "should": [{"term": {"tag": "g1"}},
+                       {"range": {"n": {"gte": 20, "lt": 60}}}],
+            "must_not": [{"term": {"n": 13}}],
+        }},
+        "size": 30,
+        "min_score": 0.01,
+    }
+    got = node.search("idx", body)
+    # force the eager path and compare exactly
+    from elasticsearch_tpu.search import phase as phase_mod
+    orig = phase_mod.ShardSearcher.query_phase
+    phase_mod.ShardSearcher.query_phase = \
+        phase_mod.ShardSearcher._query_phase_eager
+    try:
+        want = node.search("idx", body)
+    finally:
+        phase_mod.ShardSearcher.query_phase = orig
+    assert [h["_id"] for h in got["hits"]["hits"]] == \
+        [h["_id"] for h in want["hits"]["hits"]]
+    np.testing.assert_allclose(
+        [h["_score"] for h in got["hits"]["hits"]],
+        [h["_score"] for h in want["hits"]["hits"]], rtol=1e-5)
+    assert got["hits"]["total"] == want["hits"]["total"]
+
+
+def test_no_fallbacks_for_core_query_types(node):
+    _mk(node, "idx", 50)
+    jit_exec.clear_cache()
+    bodies = [
+        {"query": {"match_all": {}}},
+        {"query": {"match": {"t": "alpha beta"}}},
+        {"query": {"match_phrase": {"t": "alpha beta"}}},
+        {"query": {"term": {"tag": "g2"}}},
+        {"query": {"terms": {"tag": ["g0", "g1"]}}},
+        {"query": {"range": {"n": {"gte": 5, "lte": 25}}}},
+        {"query": {"exists": {"field": "n"}}},
+        {"query": {"prefix": {"tag": "g"}}},
+        {"query": {"wildcard": {"t": "word*"}}},
+        {"query": {"fuzzy": {"t": "alpah"}}},
+        {"query": {"constant_score": {"filter": {"term": {"tag": "g0"}},
+                                      "boost": 3.0}}},
+        {"query": {"function_score": {
+            "query": {"match": {"t": "alpha"}},
+            "functions": [{"field_value_factor": {
+                "field": "n", "modifier": "log1p", "factor": 0.5}}],
+            "boost_mode": "multiply"}}},
+        {"query": {"match": {"t": "alpha"}}, "post_filter":
+            {"term": {"tag": "g1"}}},
+        {"query": {"match": {"t": "alpha"}}, "min_score": 0.1},
+    ]
+    for body in bodies:
+        node.search("idx", body)
+    assert jit_exec.cache_stats()["fallbacks"] == 0
+
+
+def test_search_after_continuation_jitted(node):
+    _mk(node, "idx", 40)
+    jit_exec.clear_cache()
+    p1 = node.search("idx", {"query": {"match": {"t": "alpha"}}, "size": 5})
+    hits = p1["hits"]["hits"]
+    last = hits[-1]
+    # score-ordered search_after cursor is (score, internal doc id); with
+    # one segment the internal id equals insertion order == _id here
+    p2 = node.search("idx", {"query": {"match": {"t": "alpha"}},
+                             "size": 5,
+                             "search_after": [last["_score"],
+                                              int(last["_id"])]})
+    assert jit_exec.cache_stats()["fallbacks"] == 0
+    ids1 = {h["_id"] for h in hits}
+    ids2 = {h["_id"] for h in p2["hits"]["hits"]}
+    assert not (ids1 & ids2)
