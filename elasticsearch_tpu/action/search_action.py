@@ -137,7 +137,9 @@ class SearchActions:
                    "max_score": (float(result.max_score)
                                  if result.max_score is not None else None),
                    "hits": hits,
-                   "aggs": wire_safe(result.agg_partials)}
+                   "aggs": wire_safe(result.agg_partials),
+                   "terminated_early": result.terminated_early,
+                   "timed_out": result.timed_out}
             if req.suggest:
                 from elasticsearch_tpu.search.suggest import ShardSuggester
                 sg = ShardSuggester(reader, svc.mapper_service)

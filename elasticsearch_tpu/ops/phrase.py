@@ -58,22 +58,6 @@ def phrase_score(tokens, doc_len, qtids: list, deltas: list[int],
     return jnp.where(mask, sum_idf * tf_norm, 0.0), mask
 
 
-def sloppy_phrase_mask(tokens, qtids: list, deltas: list[int], slop: int):
-    """Sloppy phrase (slop > 0): every term within a window of
-    [delta_k, delta_k + slop] of the start. This is a superset-approximation
-    of Lucene's edit-distance slop for in-order matches.
-
-    Returns mask[N] bool."""
-    window = None
-    for tid, d in zip(qtids, deltas):
-        hit_any = None
-        for s in range(slop + 1):
-            h = (_shift_left(tokens, d + s) == tid) & (tid >= 0)
-            hit_any = h if hit_any is None else (hit_any | h)
-        window = hit_any if window is None else (window & hit_any)
-    return window.any(axis=1)
-
-
 _INF_SLOP = jnp.float32(1e9)
 
 

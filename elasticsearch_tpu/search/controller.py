@@ -107,7 +107,7 @@ def merge_shard_payloads(req: ParsedSearchRequest, payloads: list[dict],
         shards["failures"] = failures
     response = {
         "took": int(took_ms),
-        "timed_out": False,
+        "timed_out": any(p.get("timed_out") for p in payloads),
         "_shards": shards,
         "hits": {
             "total": {"value": total, "relation": "eq"},
@@ -115,6 +115,8 @@ def merge_shard_payloads(req: ParsedSearchRequest, payloads: list[dict],
             "hits": [e[4] for e in page],
         },
     }
+    if any(p.get("terminated_early") for p in payloads):
+        response["terminated_early"] = True
     if req.aggs:
         response["aggregations"] = reduce_aggs(
             req.aggs, [p["aggs"] for p in payloads])
@@ -147,7 +149,7 @@ def merge_responses(index_name: str, req: ParsedSearchRequest,
 
     response = {
         "took": int(took_ms),
-        "timed_out": False,
+        "timed_out": any(r.timed_out for r in results),
         "_shards": {"total": len(results), "successful": len(results),
                     "skipped": 0, "failed": 0},
         "hits": {
@@ -156,6 +158,8 @@ def merge_responses(index_name: str, req: ParsedSearchRequest,
             "hits": hits_out,
         },
     }
+    if any(r.terminated_early for r in results):
+        response["terminated_early"] = True
     if agg_nodes:
         response["aggregations"] = reduce_aggs(
             agg_nodes, [r.agg_partials for r in results])

@@ -49,7 +49,8 @@ def cache_stats() -> dict:
 
 
 def note_fallback() -> None:
-    _stats["fallbacks"] += 1
+    with _cache_lock:
+        _stats["fallbacks"] += 1
 
 
 def clear_cache() -> None:
@@ -199,7 +200,8 @@ def run_segment(seg: DeviceSegment, ctx: ExecutionContext, query,
             _cache.move_to_end(key)
             _stats["hits"] += 1
     if fn is None:
-        _stats["misses"] += 1
+        with _cache_lock:
+            _stats["misses"] += 1
 
         def run(flat_in, consts_in):
             rcf = ConstFeed("replay", replay=consts_in)

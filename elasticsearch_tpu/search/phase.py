@@ -17,6 +17,7 @@ and runs sub-phases (source filtering, highlight, script fields analog).
 from __future__ import annotations
 
 import fnmatch
+import time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -51,6 +52,8 @@ class ParsedSearchRequest:
     script_fields: dict = field(default_factory=dict)
     suggest: list = field(default_factory=list)    # [SuggestSpec]
     stored_fields: list = field(default_factory=list)
+    terminate_after: int | None = None             # per-shard collected cap
+    timeout_ms: float | None = None                # per-shard time budget
 
 
 def parse_search_request(body: dict | None) -> ParsedSearchRequest:
@@ -78,6 +81,11 @@ def parse_search_request(body: dict | None) -> ParsedSearchRequest:
     req.explain = bool(body.get("explain", False))
     req.script_fields = body.get("script_fields", {})
     req.stored_fields = body.get("stored_fields", body.get("fields", []))
+    if body.get("terminate_after"):
+        req.terminate_after = int(body["terminate_after"])
+    if body.get("timeout") is not None:
+        from elasticsearch_tpu.common.settings import parse_time_value
+        req.timeout_ms = parse_time_value(body["timeout"], "timeout") * 1000.0
     from elasticsearch_tpu.search.suggest import parse_suggest
     req.suggest = parse_suggest(body.get("suggest"))
     return req
@@ -94,6 +102,8 @@ class ShardQueryResult:
     sort_values: list[list] | None  # per hit, when sort-by-field
     agg_partials: dict
     reader: DeviceReader
+    terminated_early: bool = False  # terminate_after tripped on this shard
+    timed_out: bool = False         # timeout budget tripped on this shard
 
 
 class ShardSearcher:
@@ -152,13 +162,33 @@ class ShardSearcher:
         need_arrays = bool(req.aggs) or not score_order
         sa = req.search_after if (req.search_after is not None
                                   and not req.sort) else None
+        terminated_early = timed_out = False
+        deadline = None if req.timeout_ms is None \
+            else time.monotonic() + req.timeout_ms / 1000.0
         try:
-            outs = [(seg, jit_exec.run_segment(
-                seg, self.ctx, req.query,
-                post_filter=req.post_filter, min_score=req.min_score,
-                search_after=sa, k=(k if score_order else None),
-                want_arrays=need_arrays))
-                for seg in self.reader.segments]
+            outs = []
+            running = 0
+            for seg in self.reader.segments:
+                if deadline is not None and time.monotonic() > deadline:
+                    timed_out = True           # partial results, remaining
+                    break                      # segments skipped
+                o = jit_exec.run_segment(
+                    seg, self.ctx, req.query,
+                    post_filter=req.post_filter, min_score=req.min_score,
+                    search_after=sa, k=(k if score_order else None),
+                    want_arrays=need_arrays)
+                outs.append((seg, o))
+                if req.terminate_after is not None or deadline is not None:
+                    # early-termination modes need the running count /
+                    # actual device completion → block per segment
+                    # (QueryPhase.java:240-310 terminate-after + time-limit
+                    # collector wrappers); without blocking, async dispatch
+                    # would let device time escape the budget entirely
+                    running += int(np.asarray(o["count"]))
+                    if req.terminate_after is not None and \
+                            running >= req.terminate_after:
+                        terminated_early = True
+                        break
         except QueryParsingError:
             raise
         except Exception:                     # noqa: BLE001 — fallback seam
@@ -166,22 +196,40 @@ class ShardSearcher:
             return self._query_phase_eager(req)
 
         total = int(sum(int(np.asarray(o["count"])) for _, o in outs))
+        if req.terminate_after is not None:
+            # the reference reports the number of docs actually collected
+            total = min(total, req.terminate_after)
         agg_partials = {}
         if req.aggs:
-            agg_partials = self._collect_aggs(
-                req, [np.asarray(o["agg_mask"]) for _, o in outs],
-                [np.asarray(o["scores"]) for _, o in outs])
+            masks = [np.asarray(o["agg_mask"]) for _, o in outs]
+            scores = [np.asarray(o["scores"]) for _, o in outs]
+            # early termination: unprocessed segments contribute empty
+            # masks so agg columns stay reader-aligned
+            for seg in self.reader.segments[len(outs):]:
+                masks.append(np.zeros(seg.padded_docs, bool))
+                scores.append(np.zeros(seg.padded_docs, np.float32))
+            agg_partials = self._collect_aggs(req, masks, scores)
 
-        if not score_order:
+        if not outs:
+            res = ShardQueryResult(self.shard_id, 0, None,
+                                   np.zeros(0, np.int32),
+                                   np.zeros(0, np.float32),
+                                   [] if not score_order else None,
+                                   agg_partials, self.reader)
+        elif not score_order:
             per_seg = [(o["scores"], o["mask"]) for _, o in outs]
-            return self._sorted_query(req, per_seg, total, agg_partials)
-
-        seg_scores = [o["top_scores"] for _, o in outs]
-        seg_docs = [jnp.where(o["top_docs"] >= 0,
-                              o["top_docs"] + seg.doc_base, -1)
-                    for seg, o in outs]
-        return self._finish_score_order(k, total, seg_scores, seg_docs,
-                                        agg_partials)
+            res = self._sorted_query(req, per_seg, total, agg_partials,
+                                     segments=[seg for seg, _ in outs])
+        else:
+            seg_scores = [o["top_scores"] for _, o in outs]
+            seg_docs = [jnp.where(o["top_docs"] >= 0,
+                                  o["top_docs"] + seg.doc_base, -1)
+                        for seg, o in outs]
+            res = self._finish_score_order(k, total, seg_scores, seg_docs,
+                                           agg_partials)
+        res.terminated_early = terminated_early
+        res.timed_out = timed_out
+        return res
 
     def _collect_aggs(self, req: ParsedSearchRequest,
                       masks: list, scores: list) -> dict:
@@ -215,21 +263,49 @@ class ShardSearcher:
                                 agg_partials, self.reader)
 
     def _query_phase_eager(self, req: ParsedSearchRequest) -> ShardQueryResult:
+        """Eager per-op fallback, same partial-results semantics as the jit
+        path: terminate_after / timeout stop between segments (counts here
+        are pre-min_score/post_filter — a coarser budget than the jit
+        path's, acceptable for the fallback seam)."""
         k = max(req.from_ + req.size, 1)
-        per_seg = self._execute_query(req.query)
+        terminated_early = timed_out = False
+        deadline = None if req.timeout_ms is None \
+            else time.monotonic() + req.timeout_ms / 1000.0
+        per_seg = []
+        segments = []
+        running = 0
+        for seg in self.reader.segments:
+            if deadline is not None and time.monotonic() > deadline:
+                timed_out = True
+                break
+            ex = SegmentExecutor(seg, self.ctx)
+            scores, mask = ex.execute(req.query)
+            mask = mask & seg.live
+            per_seg.append((scores, mask))
+            segments.append(seg)
+            if req.terminate_after is not None or deadline is not None:
+                running += int(np.asarray(topk_ops.count_matches(mask)))
+                if req.terminate_after is not None and \
+                        running >= req.terminate_after:
+                    terminated_early = True
+                    break
 
         if req.min_score is not None:
             per_seg = [(s, m & (s >= np.float32(req.min_score)))
                        for s, m in per_seg]
 
-        # aggregations run on the pre-post_filter mask (ES semantics)
-        agg_partials = self._collect_aggs(
-            req, [np.asarray(m) for _, m in per_seg],
-            [np.asarray(s) for s, _ in per_seg])
+        # aggregations run on the pre-post_filter mask (ES semantics);
+        # unprocessed segments contribute empty masks
+        masks = [np.asarray(m) for _, m in per_seg]
+        scores_l = [np.asarray(s) for s, _ in per_seg]
+        for seg in self.reader.segments[len(per_seg):]:
+            masks.append(np.zeros(seg.padded_docs, bool))
+            scores_l.append(np.zeros(seg.padded_docs, np.float32))
+        agg_partials = self._collect_aggs(req, masks, scores_l)
 
         if req.post_filter is not None:
             post = [SegmentExecutor(seg, self.ctx).match_mask(req.post_filter)
-                    for seg in self.reader.segments]
+                    for seg in segments]
             per_seg = [(s, m & pm) for (s, m), pm in zip(per_seg, post)]
 
         if req.search_after is not None and not req.sort:
@@ -237,7 +313,7 @@ class ShardSearcher:
             last_score = np.float32(float(req.search_after[0]))
             last_doc = int(req.search_after[1]) if len(req.search_after) > 1 else -1
             new = []
-            for seg, (s, m) in zip(self.reader.segments, per_seg):
+            for seg, (s, m) in zip(segments, per_seg):
                 ids = jnp.arange(seg.padded_docs, dtype=jnp.int32) + seg.doc_base
                 cont = (s < last_score) | ((s == last_score) & (ids > last_doc))
                 new.append((s, m & cont))
@@ -245,22 +321,39 @@ class ShardSearcher:
 
         total = int(sum(int(np.asarray(topk_ops.count_matches(m)))
                         for _, m in per_seg)) if per_seg else 0
+        if req.terminate_after is not None:
+            total = min(total, req.terminate_after)
 
         if req.sort and not (len(req.sort) == 1 and "_score" in req.sort[0]):
-            return self._sorted_query(req, per_seg, total, agg_partials)
+            if per_seg:
+                res = self._sorted_query(req, per_seg, total, agg_partials,
+                                         segments=segments)
+            else:
+                res = ShardQueryResult(self.shard_id, 0, None,
+                                       np.zeros(0, np.int32),
+                                       np.zeros(0, np.float32), [],
+                                       agg_partials, self.reader)
+        else:
+            # score ordering: device top-k per segment, device merge
+            seg_scores, seg_docs = [], []
+            for seg, (s, m) in zip(segments, per_seg):
+                ts, td = topk_ops.top_k(s, m, min(k, seg.padded_docs),
+                                        seg.doc_base)
+                seg_scores.append(ts)
+                seg_docs.append(td)
+            res = self._finish_score_order(k, total, seg_scores, seg_docs,
+                                           agg_partials)
+        res.terminated_early = terminated_early
+        res.timed_out = timed_out
+        return res
 
-        # score ordering: device top-k per segment, device merge
-        seg_scores, seg_docs = [], []
-        for seg, (s, m) in zip(self.reader.segments, per_seg):
-            ts, td = topk_ops.top_k(s, m, min(k, seg.padded_docs), seg.doc_base)
-            seg_scores.append(ts)
-            seg_docs.append(td)
-        return self._finish_score_order(k, total, seg_scores, seg_docs,
-                                        agg_partials)
-
-    def _sorted_query(self, req, per_seg, total, agg_partials):
+    def _sorted_query(self, req, per_seg, total, agg_partials,
+                      segments=None):
         """Sort-by-field path: host numpy argsort over doc-values columns
-        (exact f64; matches Lucene FieldComparator semantics incl. missing)."""
+        (exact f64; matches Lucene FieldComparator semantics incl. missing).
+        `segments` restricts to a processed PREFIX of the reader's segments
+        (early termination) — concat order keeps global ids aligned."""
+        segments = self.reader.segments if segments is None else segments
         mask = np.concatenate([np.asarray(m) for _, m in per_seg])
         scores = np.concatenate([np.asarray(s) for s, _ in per_seg])
         n = mask.shape[0]
@@ -282,7 +375,8 @@ class ShardSearcher:
                 vals = (doc_ids + (self._doc_slot << 42)).astype(np.float64)
                 out = vals
             else:
-                vals, out = self._sort_column(fname, n, missing, order)
+                vals, out = self._sort_column(fname, n, missing, order,
+                                              segments)
             per_hit_out.append(out)
             keys.append(-vals if order == "desc" else vals)
         # np.lexsort: LAST key is primary → (docid tie-break, ..., spec1)
@@ -299,21 +393,23 @@ class ShardSearcher:
                                 top.astype(np.int32), scores[top],
                                 sort_values, agg_partials, self.reader)
 
-    def _sort_column(self, fname: str, n: int, missing, order: str):
+    def _sort_column(self, fname: str, n: int, missing, order: str,
+                     segments=None):
         """→ (numeric sort key [n] f64, per-hit output values [n] object)."""
+        segments = self.reader.segments if segments is None else segments
         cols = []
         outs = []
         # union vocabulary across segments so keyword ordinals are comparable
         union: dict[str, int] | None = None
-        if any(fname in seg.seg.keyword_fields for seg in self.reader.segments):
+        if any(fname in seg.seg.keyword_fields for seg in segments):
             values: set[str] = set()
-            for seg in self.reader.segments:
+            for seg in segments:
                 kcol = seg.seg.keyword_fields.get(fname)
                 if kcol is not None:
                     values.update(kcol.vocab)
             union_vocab = sorted(values)
             union = {v: i for i, v in enumerate(union_vocab)}
-        for seg in self.reader.segments:
+        for seg in segments:
             col = seg.seg.numeric_fields.get(fname)
             if col is not None:
                 vals = col.values.astype(np.float64).copy()

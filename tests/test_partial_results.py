@@ -1,0 +1,71 @@
+"""terminate_after / timeout partial results (ref:
+core/search/query/QueryPhase.java:240-310 — terminate-after collector
+wrapper and time-limiting collector)."""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node({}, data_path=tmp_path / "n").start()
+    n.indices_service.create_index(
+        "t", {"settings": {"number_of_shards": 1, "number_of_replicas": 0}})
+    for i in range(50):
+        n.index_doc("t", str(i), {"v": "common token", "n": i})
+        if i % 10 == 9:
+            n.indices_service.index("t").refresh()   # several segments
+    n.broadcast_actions.refresh("t")
+    yield n
+    n.close()
+
+
+def test_terminate_after_caps_and_flags(node):
+    r = node.search("t", {"query": {"match": {"v": "common"}},
+                          "terminate_after": 15})
+    assert r["terminated_early"] is True
+    assert r["hits"]["total"]["value"] <= 15
+    assert r["hits"]["hits"]          # partial results still returned
+
+
+def test_terminate_after_not_reached(node):
+    r = node.search("t", {"query": {"match": {"v": "common"}},
+                          "terminate_after": 10_000})
+    assert "terminated_early" not in r
+    assert r["hits"]["total"]["value"] == 50
+
+
+def test_timeout_flag_with_zero_budget(node):
+    # a zero budget trips before the first segment: partial (empty) results
+    # with timed_out set, not an error
+    r = node.search("t", {"query": {"match": {"v": "common"}},
+                          "timeout": "0ms"})
+    assert r["timed_out"] is True
+    assert r["hits"]["total"]["value"] == 0
+
+
+def test_no_timeout_with_generous_budget(node):
+    r = node.search("t", {"query": {"match": {"v": "common"}},
+                          "timeout": "30s"})
+    assert r["timed_out"] is False
+    assert r["hits"]["total"]["value"] == 50
+
+
+def test_timeout_with_field_sort_returns_partial(node):
+    r = node.search("t", {"query": {"match": {"v": "common"}},
+                          "sort": [{"n": "asc"}], "timeout": "0ms"})
+    assert r["timed_out"] is True
+    assert r["hits"]["hits"] == []
+
+
+def test_terminate_after_on_eager_fallback(node, monkeypatch):
+    from elasticsearch_tpu.search import jit_exec
+
+    def boom(*a, **k):
+        raise RuntimeError("forced fallback")
+    monkeypatch.setattr(jit_exec, "run_segment", boom)
+    r = node.search("t", {"query": {"match": {"v": "common"}},
+                          "terminate_after": 15})
+    assert r["terminated_early"] is True
+    assert r["hits"]["total"]["value"] <= 15
