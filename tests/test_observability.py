@@ -76,7 +76,10 @@ def test_hot_threads_reports_busy_thread():
     t = threading.Thread(target=spin, name="busy-spinner", daemon=True)
     t.start()
     try:
-        out = hot_threads(snapshots=6, interval=0.02)
+        # threads=50: report every sampled thread — under a loaded suite
+        # leftover pool/reaper threads can crowd a top-3 cut and the
+        # spinner, though always on-CPU, would drop out of the report.
+        out = hot_threads(snapshots=10, interval=0.02, threads=50)
     finally:
         stop.set()
     assert "hot threads" in out

@@ -128,10 +128,13 @@ class TestPhrase:
         assert np.asarray(freq)[0] == 1.0
 
     def test_sloppy(self):
+        # doc0: "0 9 1" — term 1 is displaced by 1 from the exact-phrase
+        # position → sloppyFreq 1/(1+1) = 0.5 at slop 1.
+        # doc1: "0 9 9 1" — displacement 2 > slop 1 → no match.
         tokens = np.array([[0, 9, 1, -1], [0, 9, 9, 1]], np.int32)
-        m0 = phrase.sloppy_phrase_mask(jnp.array(tokens),
-                                       [jnp.int32(0), jnp.int32(1)], [0, 1], 1)
-        np.testing.assert_array_equal(np.asarray(m0), [True, False])
+        freq = phrase.sloppy_phrase_freq(jnp.array(tokens),
+                                         [jnp.int32(0), jnp.int32(1)], [0, 1], 1)
+        np.testing.assert_allclose(np.asarray(freq), [0.5, 0.0])
 
 
 class TestBoolean:
