@@ -308,12 +308,45 @@ class Engine:
                             or e.local_doc != local:
                         mask[local] = False
                 self._pending_seg_deletes = {}
-            self._reader_gen += 1
             self.stats.refresh_total += 1
-            self._reader = SearcherView(list(self._segments),
-                                        [m.copy() for m in self._live_masks],
-                                        self._reader_gen)
-            return self._reader
+            return self._swap_reader()
+
+    def _swap_reader(self) -> SearcherView:
+        """Bump the generation and publish a fresh point-in-time view
+        (callers hold self._lock)."""
+        self._reader_gen += 1
+        self._reader = SearcherView(list(self._segments),
+                                    [m.copy() for m in self._live_masks],
+                                    self._reader_gen)
+        return self._reader
+
+    def install_segment(self, segment: Segment,
+                        track_versions: bool = True) -> None:
+        """Bulk-ingest: install a pre-built immutable segment into the live
+        segment set and swap the reader — the engine-level analog of
+        Lucene's ``IndexWriter.addIndexes`` (used for bulk loads that
+        build columnar segments directly, e.g. Segment.from_packed_text).
+
+        Documents are taken as NEW: no version-conflict checks run. With
+        ``track_versions=False`` the version map skips them (append-only
+        corpora: realtime get / update / delete-by-id won't resolve these
+        docs). The segment is NOT in the translog — call :meth:`flush` to
+        make the install durable (addIndexes has the same contract: files
+        are only safe after commit)."""
+        with self._lock:
+            self._ensure_open()
+            segment.seg_id = self._next_seg_id
+            self._next_seg_id += 1
+            mask = np.zeros(segment.padded_docs, dtype=bool)
+            mask[:segment.num_docs] = True
+            if track_versions:
+                for local in range(segment.num_docs):
+                    self._versions[segment.ids[local]] = VersionEntry(
+                        1, False, segment.seg_id, local)
+            self._segments.append(segment)
+            self._live_masks.append(mask)
+            self.stats.index_total += segment.num_docs
+            self._swap_reader()
 
     def acquire_searcher(self) -> SearcherView:
         with self._lock:
@@ -360,8 +393,19 @@ class Engine:
             self.refresh()
             if len(self._segments) <= max_num_segments:
                 return
-            builder = merge_segments(self._next_seg_id, self._segments,
-                                     self._live_masks,
+            # bulk-ingested segments without stored _source cannot be
+            # re-analyzed — keep them as-is and merge only the rest
+            # (Segment.source_complete)
+            mergeable = [(s, m) for s, m in
+                         zip(self._segments, self._live_masks)
+                         if s.source_complete]
+            kept = [(s, m) for s, m in zip(self._segments, self._live_masks)
+                    if not s.source_complete]
+            if len(mergeable) <= 1:
+                return
+            builder = merge_segments(self._next_seg_id,
+                                     [s for s, _ in mergeable],
+                                     [m for _, m in mergeable],
                                      self.mapper_service.document_mapper(),
                                      max_tokens=self._buffer.max_tokens)
             merged = builder.build()
@@ -372,16 +416,14 @@ class Engine:
                 if e is not None and not e.deleted:
                     self._versions[did] = VersionEntry(e.version, False,
                                                        merged.seg_id, local)
-            old = self._segments
+            old = [s for s, _ in mergeable]
             was_committed = any((self.path / f"seg_{s.seg_id}" / "meta.json").exists()
                                 for s in old)
-            self._segments = [merged]
-            self._live_masks = [mask]
+            self._segments = [s for s, _ in kept] + [merged]
+            self._live_masks = [m for _, m in kept] + [mask]
             self._next_seg_id += 1
-            self._reader_gen += 1
             self.stats.merge_total += 1
-            self._reader = SearcherView(list(self._segments), [mask.copy()],
-                                        self._reader_gen)
+            self._swap_reader()
             if was_committed:
                 # Persist the merged segment and a new commit point FIRST;
                 # only then is it safe to delete the merged-away segment

@@ -136,6 +136,12 @@ class Segment:
     vector_fields: dict[str, VectorFieldColumn]
     geo_fields: dict[str, GeoFieldColumn]
     version_id: int = CURRENT_VERSION.id
+    # False for bulk-ingested segments built without stored _source: their
+    # docs cannot be re-analyzed, so background/force merges must keep the
+    # segment as-is instead of re-parsing it (engine.force_merge honors
+    # this; Lucene's addIndexes'd segments merge at the codec level and
+    # have no such constraint — columnar re-analysis here does).
+    source_complete: bool = True
 
     def memory_bytes(self) -> int:
         total = 0
@@ -153,6 +159,55 @@ class Segment:
             total += col.lat.nbytes + col.lon.nbytes
         return total
 
+    # ---- bulk columnar ingest ---------------------------------------------
+
+    @staticmethod
+    def from_packed_text(seg_id: int, field: str, *, terms: list[str],
+                         tokens: np.ndarray, uterms: np.ndarray,
+                         utf: np.ndarray, doc_len: np.ndarray,
+                         df: np.ndarray, num_docs: int,
+                         total_tokens: int | None = None,
+                         ids: list[str] | None = None,
+                         sources: list[dict] | None = None) -> "Segment":
+        """Construct an immutable single-text-field segment directly from
+        pre-tokenized packed columns — the high-throughput bulk-load path,
+        the analog of Lucene's ``IndexWriter.addIndexes(CodecReader...)``
+        (segment-level ingest without re-analysis). Bulk loaders and the
+        benchmark corpus builder use this; the per-document path is
+        :class:`SegmentBuilder`.
+
+        Invariants (the SegmentBuilder contract): ``terms`` is SORTED and
+        term ids are ranks in it; ``tokens`` is position-indexed with -1
+        holes; rows at and beyond ``num_docs`` are padding (-1 / 0).
+        """
+        np_docs = int(uterms.shape[0])
+        if not (tokens.shape[0] == np_docs == doc_len.shape[0]
+                == utf.shape[0]):
+            raise ValueError("packed columns disagree on row count")
+        if num_docs > np_docs:
+            raise ValueError(f"num_docs {num_docs} > padded rows {np_docs}")
+        if total_tokens is None:
+            total_tokens = int(np.asarray(doc_len[:num_docs]).sum())
+        col = TextFieldColumn(
+            terms=list(terms),
+            tokens=np.ascontiguousarray(tokens, dtype=np.int32),
+            uterms=np.ascontiguousarray(uterms, dtype=np.int32),
+            utf=np.ascontiguousarray(utf, dtype=np.float32),
+            doc_len=np.ascontiguousarray(doc_len, dtype=np.int32),
+            df=np.ascontiguousarray(df, dtype=np.int32),
+            total_tokens=total_tokens)
+        if ids is None:
+            ids = [str(i) for i in range(num_docs)] + \
+                [""] * (np_docs - num_docs)
+        source_complete = sources is not None
+        if sources is None:
+            sources = [{}] * np_docs       # shared empty dict: read-only
+        return Segment(seg_id=seg_id, num_docs=num_docs, padded_docs=np_docs,
+                       ids=ids, sources=sources, text_fields={field: col},
+                       keyword_fields={}, numeric_fields={},
+                       vector_fields={}, geo_fields={},
+                       source_complete=source_complete)
+
     # ---- persistence ------------------------------------------------------
 
     def write(self, path: Path) -> None:
@@ -163,6 +218,7 @@ class Segment:
         meta: dict[str, Any] = {
             "seg_id": self.seg_id, "num_docs": self.num_docs,
             "padded_docs": self.padded_docs, "version_id": self.version_id,
+            "source_complete": self.source_complete,
             "text_fields": {}, "keyword_fields": {}, "numeric_fields": [],
             "vector_fields": {}, "geo_fields": [],
         }
@@ -242,7 +298,8 @@ class Segment:
                        padded_docs=meta["padded_docs"], ids=ids, sources=sources,
                        text_fields=text_fields, keyword_fields=keyword_fields,
                        numeric_fields=numeric_fields, vector_fields=vector_fields,
-                       geo_fields=geo_fields, version_id=meta["version_id"])
+                       geo_fields=geo_fields, version_id=meta["version_id"],
+                       source_complete=meta.get("source_complete", True))
 
 
 class SegmentBuilder:

@@ -16,6 +16,8 @@ TopDocs.merge's (shard index, position) tie-break.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -57,6 +59,66 @@ def merge_top_k(scores_list, docs_list, k: int):
     valid = top_scores > NEG_INF
     return (jnp.where(valid, top_scores, NEG_INF),
             jnp.where(valid, docs[idx], -1))
+
+
+def merge_top_k_batch(scores_list, docs_list, k: int, bases):
+    """Batched cross-segment merge: per-segment ``([B, k_s], [B, k_s])``
+    rankings (segment-LOCAL doc ids) → global ``([B, k], [B, k])``.
+
+    The batch-axis companion of :func:`merge_top_k` for the vmapped query
+    path (jit_exec.run_segment_batch): `bases` maps each segment's local
+    ids to reader-global ids inside the program, and concatenation in
+    segment order + stable top_k keeps the reference's merge tie-break
+    (TopDocs.merge, core/search/controller/SearchPhaseController.java:165).
+    """
+    return _merge_top_k_batch(tuple(scores_list), tuple(docs_list), k,
+                              tuple(int(b) for b in bases))
+
+
+@partial(jax.jit, static_argnames=("k", "bases"))
+def _merge_top_k_batch(scores_list, docs_list, k: int, bases):
+    docs = jnp.concatenate(
+        [jnp.where(d >= 0, d + b, -1) for d, b in zip(docs_list, bases)],
+        axis=1)
+    scores = jnp.concatenate(scores_list, axis=1)
+    masked = jnp.where(docs >= 0, scores, NEG_INF)
+    kk = min(k, masked.shape[1])
+    top_scores, idx = jax.lax.top_k(masked, kk)
+    valid = top_scores > NEG_INF
+    top_docs = jnp.where(valid, jnp.take_along_axis(docs, idx, axis=1), -1)
+    top_scores = jnp.where(valid, top_scores, NEG_INF)
+    if kk < k:
+        top_scores = jnp.pad(top_scores, ((0, 0), (0, k - kk)),
+                             constant_values=NEG_INF)
+        top_docs = jnp.pad(top_docs, ((0, 0), (0, k - kk)),
+                           constant_values=-1)
+    return top_scores, top_docs
+
+
+def pack_batch_result(top_scores, top_docs, counts):
+    """Pack a batched merge result into ONE f32 array ``[B, 2k+1]``
+    (scores ‖ doc-ids ‖ count) so the host needs a single device→host
+    fetch per batch — round-trip latency, not bandwidth, dominates fetch
+    cost on a tunneled interconnect. Doc ids and counts are exact in f32
+    below 2**24; callers must use the unpacked path beyond that."""
+    return _pack_batch_result(top_scores, top_docs, counts)
+
+
+@jax.jit
+def _pack_batch_result(top_scores, top_docs, counts):
+    return jnp.concatenate(
+        [top_scores, top_docs.astype(jnp.float32),
+         counts.astype(jnp.float32)[:, None]], axis=1)
+
+
+def unpack_batch_result(packed: "np.ndarray", k: int):
+    """Host-side inverse of :func:`pack_batch_result` →
+    (scores [B,k] f32, docs [B,k] i32, counts [B] i64)."""
+    import numpy as np
+    scores = packed[:, :k]
+    docs = packed[:, k:2 * k].astype(np.int32)
+    counts = packed[:, 2 * k].astype(np.int64)
+    return scores, docs, counts
 
 
 def count_matches(mask):

@@ -1,17 +1,30 @@
 """Query execution: AST → (scores, mask) per device segment.
 
-The analog of Lucene's Query.createWeight/scorer tree as driven by
+The analog of Lucene's Query.createWeight/scorer split as driven by
 QueryPhase.execute (core/search/query/QueryPhase.java:99-314), re-designed
-for XLA: the executor walks the AST **host-side** resolving per-segment
-constants (term ids, idf from reader-aggregated df, keyword ordinal bounds,
-double-double range bounds), then emits pure jnp ops over the segment's
-columns. The whole walk happens inside one traced function per
-(segment shape × query plan) — see :class:`SegmentExecutor.jitted` — so XLA
-fuses scoring, boolean algebra, function_score and top-k into one program.
+for XLA in two phases:
 
-Term-to-ordinal resolution happens OUTSIDE the traced function (host dict
-lookups), which is exactly the part of Lucene's per-segment TermsEnum.seek
-that has no business running on an accelerator.
+* **resolve** (:class:`SegmentResolver`) — host-side "createWeight": walk
+  the AST resolving per-segment constants (term ids from the segment term
+  dictionary, idf from reader-aggregated df, keyword ordinal bounds,
+  double-double range bounds) into a :class:`ConstTable`, and return an
+  *emit closure*. Resolution is dictionary lookups only — microseconds per
+  query — so planning scales to batched/high-QPS dispatch.
+* **emit** — the "scorer": pure jnp ops over the segment's columns, read
+  through :class:`EmitCtx` so the SAME closure runs eagerly (numpy
+  constants, real columns) or inside jit (traced constants, traced column
+  views) — one implementation, no parity drift between the compiled path
+  and its fallback oracle.
+
+The ConstTable separates a query's *structure* (static signature tokens +
+constant shapes) from its *constants* (values): queries sharing a signature
+share one compiled XLA program, with constants as inputs — and a batch of
+same-signature queries runs under ``jax.vmap`` with constants stacked on a
+leading axis (jit_exec.run_segment_batch).
+
+Term-to-ordinal resolution happens host-side, which is exactly the part of
+Lucene's per-segment TermsEnum.seek that has no business running on an
+accelerator.
 """
 
 from __future__ import annotations
@@ -19,7 +32,7 @@ from __future__ import annotations
 import fnmatch
 import re
 from dataclasses import dataclass
-from typing import Any
+from typing import Any, Callable
 
 import jax.numpy as jnp
 import numpy as np
@@ -36,54 +49,59 @@ from elasticsearch_tpu.search import query_dsl as q
 from elasticsearch_tpu.search.scripts import ScriptContext, compile_script
 
 
-class ConstFeed:
-    """Separates a query's *structure* from its *constants* so the executor
-    walk can be traced once per (structure, segment layout) and replayed as
-    one compiled XLA program with fresh constants (term ids, idf, bounds) as
-    inputs — the compile-cache seam promised by this module's docstring.
+class ConstTable:
+    """A query plan's dynamic constants + structural signature.
 
-    plan mode: record every dynamic constant (value + shape/dtype into the
-    signature) and every static token; replay mode: hand back the traced
-    arrays of the jitted function in the same (deterministic) walk order.
+    ``add`` registers a constant and returns its index (a *const ref*);
+    emit closures fetch it back through ``EmitCtx.get`` — by index, so the
+    scheme is insensitive to evaluation order. ``static`` records anything
+    that changes the traced structure (field names, clause counts,
+    modifiers, slop windows...) into the signature.
     """
 
-    __slots__ = ("mode", "values", "sig", "_replay", "_pos")
+    __slots__ = ("values", "sig")
 
-    def __init__(self, mode: str = "plan", replay=None):
-        self.mode = mode
+    def __init__(self):
         self.values: list[np.ndarray] = []
         self.sig: list = []
-        self._replay = replay
-        self._pos = 0
 
-    def feed(self, v, dtype=None):
-        """A dynamic constant: value may differ between queries that share
-        one compiled program."""
-        if self.mode == "plan":
-            arr = np.asarray(v, dtype=dtype)
-            self.values.append(arr)
-            self.sig.append(("c", arr.shape, str(arr.dtype)))
-            return jnp.asarray(arr)
-        t = self._replay[self._pos]
-        self._pos += 1
-        return t
+    def add(self, v, dtype=None) -> int:
+        arr = np.asarray(v, dtype=dtype)
+        self.values.append(arr)
+        self.sig.append(("c", arr.shape, str(arr.dtype)))
+        return len(self.values) - 1
 
     def static(self, *tokens) -> None:
-        """A static token: anything that changes the traced structure
-        (field names, clause counts, modifiers, slop windows...)."""
-        if self.mode == "plan":
-            self.sig.append(tokens)
+        self.sig.append(tokens)
 
     def signature(self) -> tuple:
         return tuple(self.sig)
 
 
-def _eager_const(v, dtype=None):
-    return np.asarray(v, dtype=dtype)
+class EmitCtx:
+    """Hands emit closures their segment view and resolved constants.
+
+    ``seg`` is either the real :class:`DeviceSegment` (eager) or the
+    traced rebuild of it inside jit (jit_exec.seg_rebuild); ``consts`` are
+    numpy arrays (eager) or traced arrays (jit). Emit closures MUST read
+    every array through this object — never through the resolver's
+    segment — or the compiled program would bake device buffers in as
+    constants instead of taking them as inputs.
+    """
+
+    __slots__ = ("seg", "consts", "n")
+
+    def __init__(self, seg: DeviceSegment, consts):
+        self.seg = seg
+        self.consts = consts
+        self.n = seg.padded_docs
+
+    def get(self, ref: int):
+        return self.consts[ref]
 
 
-def _noop_static(*tokens) -> None:
-    return None
+# emit closure: EmitCtx → (scores [N] f32, mask [N] bool)
+Emit = Callable[[EmitCtx], tuple]
 
 
 @dataclass
@@ -91,7 +109,12 @@ class ExecutionContext:
     reader: DeviceReader
     mapper_service: Any
     bm25: BM25Params = BM25Params()
-    cf: ConstFeed | None = None
+    # Optional global term statistics (DFS_QUERY_THEN_FETCH,
+    # core/search/dfs/DfsPhase.java:45): {"doc_count": int,
+    # "df": {(field, term): int}, "avgdl": {field: float}}. When set, idf
+    # and avgdl come from here instead of the shard-local reader, so every
+    # shard scores with identical statistics.
+    dfs_stats: dict | None = None
 
 
 def _edit_distance_le(a: str, b: str, k: int) -> bool:
@@ -116,17 +139,18 @@ def _edit_distance_le(a: str, b: str, k: int) -> bool:
     return prev[len(b)] <= k
 
 
-class SegmentExecutor:
-    """Executes query ASTs against one device segment."""
+class SegmentResolver:
+    """Host-side "createWeight": resolves query ASTs against one segment's
+    dictionaries into emit closures + a ConstTable."""
 
-    def __init__(self, seg: DeviceSegment, ctx: ExecutionContext):
+    def __init__(self, seg: DeviceSegment, ctx: ExecutionContext,
+                 ct: ConstTable | None = None):
         self.seg = seg
         self.ctx = ctx
+        self.ct = ct if ct is not None else ConstTable()
         self.n = seg.padded_docs
-        # dynamic-constant / static-token seams (plan-replay tracing); the
-        # eager path feeds plain numpy values straight into the jnp ops
-        self.c = ctx.cf.feed if ctx.cf is not None else _eager_const
-        self.sig = ctx.cf.static if ctx.cf is not None else _noop_static
+        self.c = self.ct.add
+        self.sig = self.ct.static
 
     # ------------------------------------------------------------------ util
 
@@ -139,13 +163,15 @@ class SegmentExecutor:
             return fm.search_analyzer
         return ms.analysis.get("standard")
 
-    def _zeros(self):
+    def _zeros(self) -> Emit:
         self.sig("zeros")
-        return jnp.zeros(self.n, jnp.float32), jnp.zeros(self.n, bool)
+        return lambda em: (jnp.zeros(em.n, jnp.float32),
+                           jnp.zeros(em.n, bool))
 
-    def _all(self, boost: float):
-        return (jnp.full(self.n, 1.0, jnp.float32)
-                * self.c(boost, np.float32), jnp.ones(self.n, bool))
+    def _all(self, boost: float) -> Emit:
+        r_boost = self.c(boost, np.float32)
+        return lambda em: (jnp.full(em.n, 1.0, jnp.float32) * em.get(r_boost),
+                           jnp.ones(em.n, bool))
 
     def _numeric_value(self, field: str, value):
         fm = self.ctx.mapper_service.field_mapper(field)
@@ -156,121 +182,177 @@ class SegmentExecutor:
             return 1.0 if value else 0.0
         return float(value)
 
+    def _term_stats(self, field: str, term: str) -> tuple[int, int]:
+        """→ (df, doc_count), from global DFS statistics when present
+        (aggregateDfs, core/search/controller/SearchPhaseController.java:105)
+        else from the shard-local reader."""
+        dfs = self.ctx.dfs_stats
+        if dfs is not None:
+            return (int(dfs["df"].get((field, term), 0)),
+                    int(dfs["doc_count"]))
+        st = self.ctx.reader.text_stats(field)
+        return self.ctx.reader.df(field, term), max(st.doc_count, 1)
+
+    def _avgdl(self, field: str) -> float:
+        dfs = self.ctx.dfs_stats
+        if dfs is not None and field in dfs.get("avgdl", {}):
+            return max(float(dfs["avgdl"][field]), 1e-9)
+        return max(self.ctx.reader.text_stats(field).avgdl, 1e-9)
+
     # ------------------------------------------------------------- dispatch
 
-    def execute(self, query: q.Query):
-        """→ (scores [N] f32, mask [N] bool); live-mask applied by caller."""
-        method = getattr(self, f"_exec_{type(query).__name__}", None)
+    def resolve(self, query: q.Query) -> Emit:
+        """→ emit closure producing (scores [N] f32, mask [N] bool);
+        live-mask applied by the caller."""
+        method = getattr(self, f"_res_{type(query).__name__}", None)
         if method is None:
             raise QueryParsingError(
                 f"no executor for query type [{type(query).__name__}]")
         self.sig(type(query).__name__, getattr(query, "field", None))
         return method(query)
 
-    def match_mask(self, query: q.Query):
-        return self.execute(query)[1]
+    def resolve_mask(self, query: q.Query) -> Callable[[EmitCtx], Any]:
+        emit = self.resolve(query)
+        return lambda em: emit(em)[1]
 
     # ----------------------------------------------------------------- leafs
 
-    def _exec_MatchAllQuery(self, query: q.MatchAllQuery):
+    def _res_MatchAllQuery(self, query: q.MatchAllQuery) -> Emit:
         return self._all(query.boost)
 
-    def _exec_MatchNoneQuery(self, query: q.MatchNoneQuery):
+    def _res_MatchNoneQuery(self, query: q.MatchNoneQuery) -> Emit:
         return self._zeros()
 
     def _match_terms(self, field: str, terms: list[str]):
-        """Resolve analyzed terms to per-segment ids + idf (reader stats)."""
+        """Resolve analyzed terms to per-segment ids + idf (reader or DFS
+        stats)."""
         col = self.seg.text.get(field)
         if col is None:
             return None
-        st = self.ctx.reader.text_stats(field)
         tids, idfs = [], []
         for t in terms:
             tid = col.column.tid(t)
-            df = self.ctx.reader.df(field, t)
+            df, doc_count = self._term_stats(field, t)
             tids.append(tid)
-            idfs.append(bm25_idf(df, max(st.doc_count, 1)) if df > 0 else 0.0)
-        return col, st, tids, idfs
+            idfs.append(bm25_idf(df, doc_count) if df > 0 else 0.0)
+        return tids, idfs
 
-    def _exec_MatchQuery(self, query: q.MatchQuery):
-        if query.field in ("*", "_all"):
+    def _res_MatchQuery(self, query: q.MatchQuery) -> Emit:
+        field = query.field
+        if field in ("*", "_all"):
             # all-fields match (ES _all / query_string default): OR over every
             # text field present in the segment — iteration order is part of
-            # the plan signature (const feed order follows it)
+            # the plan signature
             self.sig("all-fields", tuple(self.seg.text))
-            subs = [q.MatchQuery(field=f, text=query.text,
-                                 operator=query.operator, boost=query.boost)
-                    for f in self.seg.text]
+            subs = [self.resolve(q.MatchQuery(
+                field=f, text=query.text, operator=query.operator,
+                boost=query.boost)) for f in self.seg.text]
             if not subs:
                 return self._zeros()
-            scores = None
-            mask = None
-            for sub in subs:
-                s, m = self.execute(sub)
-                scores = s if scores is None else jnp.maximum(scores, s)
-                mask = m if mask is None else (mask | m)
-            return scores, mask
-        if self.seg.text.get(query.field) is None and (
-                query.field in self.seg.keyword
-                or query.field in self.seg.numeric):
+
+            def emit_all(em):
+                scores = mask = None
+                for sub in subs:
+                    s, m = sub(em)
+                    scores = s if scores is None else jnp.maximum(scores, s)
+                    mask = m if mask is None else (mask | m)
+                return scores, mask
+            return emit_all
+        if self.seg.text.get(field) is None and (
+                field in self.seg.keyword or field in self.seg.numeric):
             # match on keyword/numeric doc values == exact term (ES behavior)
-            return self.execute(q.TermQuery(
-                field=query.field, value=query.text, boost=query.boost))
-        analyzer = self._analyzer_for(query.field, query.analyzer)
+            return self.resolve(q.TermQuery(
+                field=field, value=query.text, boost=query.boost))
+        analyzer = self._analyzer_for(field, query.analyzer)
         terms = [t.term for t in analyzer.analyze(query.text)]
         if not terms:
             return self._zeros()
-        resolved = self._match_terms(query.field, terms)
+        resolved = self._match_terms(field, terms)
         if resolved is None:
             return self._zeros()
-        col, st, tids, idfs = resolved
-        p = self.ctx.bm25
-        scores, nmatch = lexical.bm25_match(
-            col.uterms, col.utf, col.doc_len,
-            jnp.asarray(self.c(tids, np.int32)),
-            jnp.asarray(self.c(idfs, np.float32)),
-            jnp.ones(len(tids), jnp.float32), p.k1, p.b,
-            self.c(max(st.avgdl, 1e-9), np.float32))
+        tids, idfs = resolved
         if query.operator == "and":
             required = len(terms)
         elif query.minimum_should_match is not None:
             required = _resolve_msm(query.minimum_should_match, len(terms))
         else:
             required = 1
-        mask = nmatch >= self.c(required, np.int32)
-        return jnp.where(mask, scores * self.c(query.boost, np.float32),
-                         0.0), mask
+        n_terms = len(tids)
+        r_tids = self.c(tids, np.int32)
+        r_idfs = self.c(idfs, np.float32)
+        r_avgdl = self.c(self._avgdl(field), np.float32)
+        # required == 1 (the default OR semantics): a doc matches iff any
+        # query term hits, and every present term has idf > 0, so
+        # mask ≡ scores > 0 — the nmatch accumulation becomes dead code XLA
+        # eliminates (T fewer [N, U] compare/reduce passes, the same
+        # shortcut the standalone kernel gets for free)
+        # guard for DFS-provided stats: a term present in this segment but
+        # with global df 0 would have idf 0 — its matches score 0 and the
+        # scores>0 shortcut would drop them, diverging from nmatch
+        # semantics; fall back to nmatch counting in that (odd) case
+        all_idf_pos = all(idf > 0 or tid < 0
+                          for tid, idf in zip(tids, idfs))
+        msm1 = required == 1 and all_idf_pos
+        self.sig("msm1" if msm1 else "msm")
+        r_req = None if msm1 else self.c(required, np.int32)
+        r_boost = self.c(query.boost, np.float32)
+        p = self.ctx.bm25
 
-    def _exec_MatchPhraseQuery(self, query: q.MatchPhraseQuery):
-        analyzer = self._analyzer_for(query.field, query.analyzer)
+        def emit(em):
+            col = em.seg.text[field]
+            scores, nmatch = lexical.bm25_match(
+                col.uterms, col.utf, col.doc_len,
+                jnp.asarray(em.get(r_tids)), jnp.asarray(em.get(r_idfs)),
+                jnp.ones(n_terms, jnp.float32), p.k1, p.b, em.get(r_avgdl))
+            if msm1:
+                # OR semantics: the bm25 sum is already 0 on non-matching
+                # docs, so the mask is just scores > 0 and no re-zeroing
+                # where-pass is needed (boost scales 0 to 0)
+                mask = scores > 0
+                return scores * em.get(r_boost), mask
+            mask = nmatch >= em.get(r_req)
+            return jnp.where(mask, scores * em.get(r_boost), 0.0), mask
+        return emit
+
+    def _res_MatchPhraseQuery(self, query: q.MatchPhraseQuery) -> Emit:
+        field = query.field
+        analyzer = self._analyzer_for(field, query.analyzer)
         toks = analyzer.analyze(query.text)
         if not toks:
             return self._zeros()
         if len(toks) == 1:
-            return self.execute(q.MatchQuery(
-                field=query.field, text=query.text, analyzer=query.analyzer,
+            return self.resolve(q.MatchQuery(
+                field=field, text=query.text, analyzer=query.analyzer,
                 boost=query.boost))
-        resolved = self._match_terms(query.field, [t.term for t in toks])
+        resolved = self._match_terms(field, [t.term for t in toks])
         if resolved is None:
             return self._zeros()
-        col, st, tids, idfs = resolved
+        tids, idfs = resolved
         deltas = [t.position - toks[0].position for t in toks]
-        self.sig("phrase", tuple(deltas), query.slop)
+        slop = query.slop
+        self.sig("phrase", tuple(deltas), slop)
         p = self.ctx.bm25
-        tid_scalars = [jnp.int32(self.c(t, np.int32)) for t in tids]
-        if query.slop > 0:
-            scores, mask = phrase_ops.sloppy_phrase_score(
-                col.tokens, col.doc_len, tid_scalars, deltas, query.slop,
-                jnp.asarray(self.c(idfs, np.float32)), p.k1, p.b,
-                self.c(max(st.avgdl, 1e-9), np.float32))
-            return scores * self.c(query.boost, np.float32), mask
-        scores, mask = phrase_ops.phrase_score(
-            col.tokens, col.doc_len, tid_scalars, deltas,
-            self.c(sum(idfs), np.float32), p.k1, p.b,
-            self.c(max(st.avgdl, 1e-9), np.float32))
-        return scores * self.c(query.boost, np.float32), mask
+        r_tids = [self.c(t, np.int32) for t in tids]
+        r_idfs = self.c(idfs, np.float32)
+        r_sum_idf = self.c(sum(idfs), np.float32)
+        r_avgdl = self.c(self._avgdl(field), np.float32)
+        r_boost = self.c(query.boost, np.float32)
 
-    def _exec_MultiMatchQuery(self, query: q.MultiMatchQuery):
+        def emit(em):
+            col = em.seg.text[field]
+            tid_scalars = [em.get(r) for r in r_tids]
+            if slop > 0:
+                scores, mask = phrase_ops.sloppy_phrase_score(
+                    col.tokens, col.doc_len, tid_scalars, deltas, slop,
+                    jnp.asarray(em.get(r_idfs)), p.k1, p.b, em.get(r_avgdl))
+            else:
+                scores, mask = phrase_ops.phrase_score(
+                    col.tokens, col.doc_len, tid_scalars, deltas,
+                    em.get(r_sum_idf), p.k1, p.b, em.get(r_avgdl))
+            return scores * em.get(r_boost), mask
+        return emit
+
+    def _res_MultiMatchQuery(self, query: q.MultiMatchQuery) -> Emit:
         self.sig("multi_match", query.type, query.tie_breaker > 0,
                  len(query.fields))
         subs = []
@@ -278,112 +360,147 @@ class SegmentExecutor:
             fname, _, fboost = fspec.partition("^")
             boost = float(fboost) if fboost else 1.0
             if query.type == "phrase":
-                sub = q.MatchPhraseQuery(field=fname, text=query.text, boost=boost)
+                sub = q.MatchPhraseQuery(field=fname, text=query.text,
+                                         boost=boost)
             else:
                 sub = q.MatchQuery(field=fname, text=query.text,
                                    operator=query.operator, boost=boost)
-            subs.append(self.execute(sub))
+            subs.append(self.resolve(sub))
         if not subs:
             return self._zeros()
-        scores = None
-        mask = None
-        for s, m in subs:
-            if scores is None:
-                scores, mask = s, m
-                continue
-            mask = mask | m
-            if query.type == "most_fields":
-                scores = scores + s
-            else:  # best_fields: max + tie_breaker * others
-                mx = jnp.maximum(scores, s)
-                if query.tie_breaker > 0:
-                    scores = mx + self.c(query.tie_breaker, np.float32) * \
-                        (scores + s - mx)
-                else:
-                    scores = mx
-        return jnp.where(mask, scores * self.c(query.boost, np.float32),
-                         0.0), mask
+        mm_type = query.type
+        tie = query.tie_breaker
+        r_tie = self.c(tie, np.float32) if tie > 0 else None
+        r_boost = self.c(query.boost, np.float32)
+
+        def emit(em):
+            scores = mask = None
+            for sub in subs:
+                s, m = sub(em)
+                if scores is None:
+                    scores, mask = s, m
+                    continue
+                mask = mask | m
+                if mm_type == "most_fields":
+                    scores = scores + s
+                else:  # best_fields: max + tie_breaker * others
+                    mx = jnp.maximum(scores, s)
+                    if r_tie is not None:
+                        scores = mx + em.get(r_tie) * (scores + s - mx)
+                    else:
+                        scores = mx
+            return jnp.where(mask, scores * em.get(r_boost), 0.0), mask
+        return emit
 
     def _keyword_or_text_term_mask(self, field: str, value):
+        """→ mask emit for an exact term on keyword/numeric/text columns."""
         fm = self.ctx.mapper_service.field_mapper(field)
         kcol = self.seg.keyword.get(field)
         if kcol is not None:
             self.sig("term-kw", field)
-            return filter_ops.keyword_term(
-                kcol.ords, self.c(kcol.column.ord(str(value)), np.int32))
+            r_ord = self.c(kcol.column.ord(str(value)), np.int32)
+            return lambda em: filter_ops.keyword_term(
+                em.seg.keyword[field].ords, em.get(r_ord))
         ncol = self.seg.numeric.get(field)
         if ncol is not None or (fm is not None and fm.kind == KIND_NUMERIC):
             if ncol is None:
                 self.sig("term-none", field)
-                return jnp.zeros(self.n, bool)
+                return lambda em: jnp.zeros(em.n, bool)
             self.sig("term-num", field)
             hi, lo = dd_split(self._numeric_value(field, value))
-            return filter_ops.numeric_term(ncol.hi, ncol.lo, ncol.exists,
-                                           self.c(hi, np.float32),
-                                           self.c(lo, np.float32))
+            r_hi = self.c(hi, np.float32)
+            r_lo = self.c(lo, np.float32)
+
+            def emit(em):
+                col = em.seg.numeric[field]
+                return filter_ops.numeric_term(col.hi, col.lo, col.exists,
+                                               em.get(r_hi), em.get(r_lo))
+            return emit
         tcol = self.seg.text.get(field)
         if tcol is not None:
             self.sig("term-text", field)
-            return lexical.term_filter(
-                tcol.uterms, self.c(tcol.column.tid(str(value)), np.int32))
+            r_tid = self.c(tcol.column.tid(str(value)), np.int32)
+            return lambda em: lexical.term_filter(
+                em.seg.text[field].uterms, em.get(r_tid))
         self.sig("term-none", field)
-        return jnp.zeros(self.n, bool)
+        return lambda em: jnp.zeros(em.n, bool)
 
-    def _exec_TermQuery(self, query: q.TermQuery):
+    def _res_TermQuery(self, query: q.TermQuery) -> Emit:
         # term on text fields scores BM25 like a single-term match (Lucene
         # TermQuery); on keyword/numeric doc values it is constant-score.
         tcol = self.seg.text.get(query.field)
         if tcol is not None and self.seg.keyword.get(query.field) is None:
-            return self.execute(q.MatchQuery(
+            return self.resolve(q.MatchQuery(
                 field=query.field, text=str(query.value), analyzer="keyword",
                 boost=query.boost))
-        mask = self._keyword_or_text_term_mask(query.field, query.value)
-        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
+        mask_emit = self._keyword_or_text_term_mask(query.field, query.value)
+        r_boost = self.c(query.boost, np.float32)
+        return lambda em: bool_ops.constant_score(mask_emit(em),
+                                                  em.get(r_boost))
 
-    def _exec_TermsQuery(self, query: q.TermsQuery):
-        kcol = self.seg.keyword.get(query.field)
+    def _res_TermsQuery(self, query: q.TermsQuery) -> Emit:
+        field = query.field
+        kcol = self.seg.keyword.get(field)
+        r_boost = self.c(query.boost, np.float32)
         if kcol is not None:
-            self.sig("terms-kw", query.field)
+            self.sig("terms-kw", field)
             qords = [kcol.column.ord(str(v)) for v in query.values]
-            mask = filter_ops.keyword_terms(
-                kcol.ords, jnp.asarray(self.c(qords or [-1], np.int32)))
-            return bool_ops.constant_score(mask,
-                                           self.c(query.boost, np.float32))
-        self.sig("terms-any", query.field, len(query.values))
-        mask = jnp.zeros(self.n, bool)
-        for v in query.values:
-            mask = mask | self._keyword_or_text_term_mask(query.field, v)
-        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
+            r_ords = self.c(qords or [-1], np.int32)
 
-    def _exec_RangeQuery(self, query: q.RangeQuery):
-        ncol = self.seg.numeric.get(query.field)
+            def emit(em):
+                mask = filter_ops.keyword_terms(
+                    em.seg.keyword[field].ords, jnp.asarray(em.get(r_ords)))
+                return bool_ops.constant_score(mask, em.get(r_boost))
+            return emit
+        self.sig("terms-any", field, len(query.values))
+        mask_emits = [self._keyword_or_text_term_mask(field, v)
+                      for v in query.values]
+
+        def emit(em):
+            mask = jnp.zeros(em.n, bool)
+            for me in mask_emits:
+                mask = mask | me(em)
+            return bool_ops.constant_score(mask, em.get(r_boost))
+        return emit
+
+    def _res_RangeQuery(self, query: q.RangeQuery) -> Emit:
+        field = query.field
+        r_boost = self.c(query.boost, np.float32)
+        ncol = self.seg.numeric.get(field)
         if ncol is not None:
             # gte/gt (and lte/lt) apply independently; effective bound is the
             # tightest (ES RangeQueryParser applies each given bound).
             lo_v = -np.inf
             if query.gte is not None:
-                lo_v = self._numeric_value(query.field, query.gte)
+                lo_v = self._numeric_value(field, query.gte)
             if query.gt is not None:
                 lo_v = max(lo_v, np.nextafter(np.float64(
-                    self._numeric_value(query.field, query.gt)), np.inf))
+                    self._numeric_value(field, query.gt)), np.inf))
             hi_v = np.inf
             if query.lte is not None:
-                hi_v = self._numeric_value(query.field, query.lte)
+                hi_v = self._numeric_value(field, query.lte)
             if query.lt is not None:
                 hi_v = min(hi_v, np.nextafter(np.float64(
-                    self._numeric_value(query.field, query.lt)), -np.inf))
-            self.sig("range-num", query.field)
+                    self._numeric_value(field, query.lt)), -np.inf))
+            self.sig("range-num", field)
             ghi, glo = dd_split(lo_v)
             lhi, llo = dd_split(hi_v)
-            mask = filter_ops.numeric_range(
-                ncol.hi, ncol.lo, ncol.exists,
-                self.c(ghi, np.float32), self.c(glo, np.float32),
-                self.c(lhi, np.float32), self.c(llo, np.float32))
-            return bool_ops.constant_score(mask,
-                                           self.c(query.boost, np.float32))
-        kcol = self.seg.keyword.get(query.field)
+            r_ghi = self.c(ghi, np.float32)
+            r_glo = self.c(glo, np.float32)
+            r_lhi = self.c(lhi, np.float32)
+            r_llo = self.c(llo, np.float32)
+
+            def emit(em):
+                col = em.seg.numeric[field]
+                mask = filter_ops.numeric_range(
+                    col.hi, col.lo, col.exists,
+                    em.get(r_ghi), em.get(r_glo),
+                    em.get(r_lhi), em.get(r_llo))
+                return bool_ops.constant_score(mask, em.get(r_boost))
+            return emit
+        kcol = self.seg.keyword.get(field)
         if kcol is not None:
-            self.sig("range-kw", query.field)
+            self.sig("range-kw", field)
             vocab = kcol.column.vocab
             lo_ord = 0
             hi_ord = len(vocab)
@@ -395,29 +512,40 @@ class SegmentExecutor:
                 hi_ord = _bisect_right(vocab, str(query.lte))
             if query.lt is not None:
                 hi_ord = _bisect_left(vocab, str(query.lt))
-            mask = filter_ops.keyword_ord_range(
-                kcol.ords, self.c(lo_ord, np.int32),
-                self.c(hi_ord, np.int32))
-            return bool_ops.constant_score(mask,
-                                           self.c(query.boost, np.float32))
+            r_lo = self.c(lo_ord, np.int32)
+            r_hi = self.c(hi_ord, np.int32)
+
+            def emit(em):
+                mask = filter_ops.keyword_ord_range(
+                    em.seg.keyword[field].ords, em.get(r_lo), em.get(r_hi))
+                return bool_ops.constant_score(mask, em.get(r_boost))
+            return emit
         return self._zeros()
 
-    def _exec_ExistsQuery(self, query: q.ExistsQuery):
+    def _res_ExistsQuery(self, query: q.ExistsQuery) -> Emit:
         f = query.field
+        r_boost = self.c(query.boost, np.float32)
         if f in self.seg.numeric:
-            kind, mask = "num", self.seg.numeric[f].exists
+            self.sig("exists", "num", f)
+            mask_emit = lambda em: em.seg.numeric[f].exists   # noqa: E731
         elif f in self.seg.keyword:
-            kind, mask = "kw", (self.seg.keyword[f].ords >= 0).any(axis=1)
+            self.sig("exists", "kw", f)
+            mask_emit = lambda em: (                          # noqa: E731
+                em.seg.keyword[f].ords >= 0).any(axis=1)
         elif f in self.seg.text:
-            kind, mask = "text", self.seg.text[f].doc_len > 0
+            self.sig("exists", "text", f)
+            mask_emit = lambda em: em.seg.text[f].doc_len > 0  # noqa: E731
         elif f in self.seg.vector:
-            kind, mask = "vec", self.seg.vector[f].exists
+            self.sig("exists", "vec", f)
+            mask_emit = lambda em: em.seg.vector[f].exists    # noqa: E731
         elif f in self.seg.geo:
-            kind, mask = "geo", self.seg.geo[f].exists
+            self.sig("exists", "geo", f)
+            mask_emit = lambda em: em.seg.geo[f].exists       # noqa: E731
         else:
-            kind, mask = "none", jnp.zeros(self.n, bool)
-        self.sig("exists", kind, f)
-        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
+            self.sig("exists", "none", f)
+            mask_emit = lambda em: jnp.zeros(em.n, bool)      # noqa: E731
+        return lambda em: bool_ops.constant_score(mask_emit(em),
+                                                  em.get(r_boost))
 
     # --- vocab-scan leaf family (prefix/wildcard/regexp/fuzzy) -------------
 
@@ -432,202 +560,199 @@ class SegmentExecutor:
             qords = [i for i, v in enumerate(kcol.column.vocab) if pred(v)]
             if not qords:
                 self.sig("scan-empty")
-                return jnp.zeros(self.n, bool)
-            qords = _pad_pow2(qords, -1)
-            return filter_ops.keyword_terms(
-                kcol.ords, jnp.asarray(self.c(qords, np.int32)))
+                return lambda em: jnp.zeros(em.n, bool)
+            r_ords = self.c(_pad_pow2(qords, -1), np.int32)
+            return lambda em: filter_ops.keyword_terms(
+                em.seg.keyword[field].ords, jnp.asarray(em.get(r_ords)))
         tcol = self.seg.text.get(field)
         if tcol is not None:
             self.sig("scan-text", field)
             tids = [i for i, t in enumerate(tcol.column.terms) if pred(t)]
             if not tids:
                 self.sig("scan-empty")
-                return jnp.zeros(self.n, bool)
-            tids = _pad_pow2(tids, -1)
-            qt = jnp.asarray(self.c(tids, np.int32))
-            hit = (tcol.uterms[:, :, None] == qt[None, None, :]) & \
-                (qt[None, None, :] >= 0)
-            return hit.any(axis=(1, 2))
-        self.sig("scan-none", field)
-        return jnp.zeros(self.n, bool)
+                return lambda em: jnp.zeros(em.n, bool)
+            r_tids = self.c(_pad_pow2(tids, -1), np.int32)
 
-    def _exec_PrefixQuery(self, query: q.PrefixQuery):
+            def emit(em):
+                qt = jnp.asarray(em.get(r_tids))
+                uterms = em.seg.text[field].uterms
+                hit = (uterms[:, :, None] == qt[None, None, :]) & \
+                    (qt[None, None, :] >= 0)
+                return hit.any(axis=(1, 2))
+            return emit
+        self.sig("scan-none", field)
+        return lambda em: jnp.zeros(em.n, bool)
+
+    def _constant_mask_emit(self, mask_emit, boost: float) -> Emit:
+        r_boost = self.c(boost, np.float32)
+        return lambda em: bool_ops.constant_score(mask_emit(em),
+                                                  em.get(r_boost))
+
+    def _res_PrefixQuery(self, query: q.PrefixQuery) -> Emit:
         kcol = self.seg.keyword.get(query.field)
         if kcol is not None:   # sorted vocab → ordinal interval, no scan
             self.sig("prefix-kw", query.field)
+            field = query.field
             vocab = kcol.column.vocab
-            lo = _bisect_left(vocab, query.value)
-            hi = _bisect_left(vocab, query.value + "￿")
-            mask = filter_ops.keyword_ord_range(
-                kcol.ords, self.c(lo, np.int32), self.c(hi, np.int32))
-            return bool_ops.constant_score(mask,
-                                           self.c(query.boost, np.float32))
-        mask = self._vocab_scan_mask(query.field,
-                                     lambda t: t.startswith(query.value))
-        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
+            r_lo = self.c(_bisect_left(vocab, query.value), np.int32)
+            r_hi = self.c(_bisect_left(vocab, query.value + "￿"),
+                          np.int32)
+            return self._constant_mask_emit(
+                lambda em: filter_ops.keyword_ord_range(
+                    em.seg.keyword[field].ords, em.get(r_lo), em.get(r_hi)),
+                query.boost)
+        value = query.value
+        return self._constant_mask_emit(
+            self._vocab_scan_mask(query.field,
+                                  lambda t: t.startswith(value)),
+            query.boost)
 
-    def _exec_WildcardQuery(self, query: q.WildcardQuery):
+    def _res_WildcardQuery(self, query: q.WildcardQuery) -> Emit:
         rx = re.compile(fnmatch.translate(query.pattern))
-        mask = self._vocab_scan_mask(query.field, lambda t: rx.match(t) is not None)
-        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
+        return self._constant_mask_emit(
+            self._vocab_scan_mask(query.field,
+                                  lambda t: rx.match(t) is not None),
+            query.boost)
 
-    def _exec_RegexpQuery(self, query: q.RegexpQuery):
+    def _res_RegexpQuery(self, query: q.RegexpQuery) -> Emit:
         rx = re.compile(query.pattern)
-        mask = self._vocab_scan_mask(query.field,
-                                     lambda t: rx.fullmatch(t) is not None)
-        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
+        return self._constant_mask_emit(
+            self._vocab_scan_mask(query.field,
+                                  lambda t: rx.fullmatch(t) is not None),
+            query.boost)
 
-    def _exec_FuzzyQuery(self, query: q.FuzzyQuery):
+    def _res_FuzzyQuery(self, query: q.FuzzyQuery) -> Emit:
         v = query.value
         if query.fuzziness == "AUTO":
             k = 0 if len(v) < 3 else (1 if len(v) < 6 else 2)
         else:
             k = int(query.fuzziness)
-        mask = self._vocab_scan_mask(query.field,
-                                     lambda t: _edit_distance_le(t, v, k))
-        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
+        return self._constant_mask_emit(
+            self._vocab_scan_mask(query.field,
+                                  lambda t: _edit_distance_le(t, v, k)),
+            query.boost)
 
-    def _exec_IdsQuery(self, query: q.IdsQuery):
+    def _res_IdsQuery(self, query: q.IdsQuery) -> Emit:
         wanted = set(query.values)
         hits = np.zeros(self.n, bool)
         for local, did in enumerate(self.seg.seg.ids):
             if did in wanted:
                 hits[local] = True
-        return bool_ops.constant_score(jnp.asarray(self.c(hits)),
-                                       self.c(query.boost, np.float32))
+        r_hits = self.c(hits)
+        r_boost = self.c(query.boost, np.float32)
+        return lambda em: bool_ops.constant_score(
+            jnp.asarray(em.get(r_hits)), em.get(r_boost))
 
     # ------------------------------------------------------------- compound
 
-    def _exec_BoolQuery(self, query: q.BoolQuery):
+    def _res_BoolQuery(self, query: q.BoolQuery) -> Emit:
         self.sig("bool", len(query.must), len(query.should),
                  len(query.must_not), len(query.filter))
-        must = [self.execute(sub) for sub in query.must]
-        should = [self.execute(sub) for sub in query.should]
-        must_not = [self.match_mask(sub) for sub in query.must_not]
-        filters = [self.match_mask(sub) for sub in query.filter]
+        must = [self.resolve(sub) for sub in query.must]
+        should = [self.resolve(sub) for sub in query.should]
+        must_not = [self.resolve_mask(sub) for sub in query.must_not]
+        filters = [self.resolve_mask(sub) for sub in query.filter]
         if query.minimum_should_match is not None:
             msm = _resolve_msm(query.minimum_should_match, len(query.should))
         else:
             msm = 1 if (query.should and not query.must and not query.filter) \
                 else 0
-        scores, mask = bool_ops.combine_bool(
-            self.n, must, should, must_not, filters,
-            self.c(msm, np.int32) if should else 0)
-        return scores * self.c(query.boost, np.float32), mask
+        r_msm = self.c(msm, np.int32) if should else None
+        r_boost = self.c(query.boost, np.float32)
 
-    def _exec_ConstantScoreQuery(self, query: q.ConstantScoreQuery):
-        mask = self.match_mask(query.filter_query)
-        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
+        def emit(em):
+            scores, mask = bool_ops.combine_bool(
+                em.n,
+                [e(em) for e in must], [e(em) for e in should],
+                [e(em) for e in must_not], [e(em) for e in filters],
+                em.get(r_msm) if r_msm is not None else 0)
+            return scores * em.get(r_boost), mask
+        return emit
 
-    def _exec_FunctionScoreQuery(self, query: q.FunctionScoreQuery):
+    def _res_ConstantScoreQuery(self, query: q.ConstantScoreQuery) -> Emit:
+        mask_emit = self.resolve_mask(query.filter_query)
+        return self._constant_mask_emit(mask_emit, query.boost)
+
+    def _res_FunctionScoreQuery(self, query: q.FunctionScoreQuery) -> Emit:
         self.sig("function_score", query.score_mode, query.boost_mode,
                  query.max_boost is not None, query.min_score is not None,
                  tuple((fn.kind, fn.weight is not None,
                         fn.filter_query is not None)
                        for fn in query.functions))
-        base_scores, base_mask = self.execute(query.query or q.MatchAllQuery())
-        factors, masks = [], []
+        base_emit = self.resolve(query.query or q.MatchAllQuery())
+        fn_emits = []
         for fn in query.functions:
-            factor = self._function_factor(fn, base_scores)
-            if fn.weight is not None:
-                factor = factor * self.c(fn.weight, np.float32) \
-                    if fn.kind != "weight" \
-                    else fs_ops.weight_factor(self.n,
-                                              self.c(fn.weight, np.float32))
-            fmask = self.match_mask(fn.filter_query) if fn.filter_query \
-                else jnp.ones(self.n, bool)
-            factors.append(factor)
-            masks.append(fmask)
-        combined = fs_ops.combine_functions(factors, masks, query.score_mode)
-        if combined is None:
-            scores = base_scores
-        else:
-            max_boost = None if query.max_boost is None \
-                else self.c(query.max_boost, np.float32)
-            scores = fs_ops.apply_boost_mode(base_scores, combined,
-                                             query.boost_mode, max_boost)
-        mask = base_mask
-        if query.min_score is not None:
-            mask = mask & (scores >= self.c(query.min_score, np.float32))
-        return scores * self.c(query.boost, np.float32), mask
+            factor_emit = self._function_factor(fn)
+            if fn.weight is not None and fn.kind != "weight":
+                r_w = self.c(fn.weight, np.float32)
+                factor_emit = (lambda fe, rw: lambda em, s:
+                               fe(em, s) * em.get(rw))(factor_emit, r_w)
+            fmask_emit = self.resolve_mask(fn.filter_query) \
+                if fn.filter_query else None
+            fn_emits.append((factor_emit, fmask_emit))
+        score_mode, boost_mode = query.score_mode, query.boost_mode
+        r_max_boost = None if query.max_boost is None \
+            else self.c(query.max_boost, np.float32)
+        r_min_score = None if query.min_score is None \
+            else self.c(query.min_score, np.float32)
+        r_boost = self.c(query.boost, np.float32)
 
-    def _function_factor(self, fn: q.ScoreFunction, base_scores):
+        def emit(em):
+            base_scores, base_mask = base_emit(em)
+            factors, masks = [], []
+            for factor_emit, fmask_emit in fn_emits:
+                factors.append(factor_emit(em, base_scores))
+                masks.append(fmask_emit(em) if fmask_emit is not None
+                             else jnp.ones(em.n, bool))
+            combined = fs_ops.combine_functions(factors, masks, score_mode)
+            if combined is None:
+                scores = base_scores
+            else:
+                mb = None if r_max_boost is None else em.get(r_max_boost)
+                scores = fs_ops.apply_boost_mode(base_scores, combined,
+                                                 boost_mode, mb)
+            mask = base_mask
+            if r_min_score is not None:
+                mask = mask & (scores >= em.get(r_min_score))
+            return scores * em.get(r_boost), mask
+        return emit
+
+    def _function_factor(self, fn: q.ScoreFunction):
+        """→ factor emit: (em, base_scores) → [N] f32."""
         params = fn.params
         if fn.kind == "weight":
-            return fs_ops.weight_factor(self.n,
-                                        self.c(fn.weight or 1.0, np.float32))
+            r_w = self.c(fn.weight or 1.0, np.float32)
+            return lambda em, s: fs_ops.weight_factor(em.n, em.get(r_w))
         if fn.kind == "random_score":
-            self.sig("random", int(params.get("seed", 0)))
-            return fs_ops.random_score(self.n, int(params.get("seed", 0)),
-                                       self.c(self.seg.doc_base, np.uint32))
+            seed = int(params.get("seed", 0))
+            self.sig("random", seed)
+            r_base = self.c(self.seg.doc_base, np.uint32)
+            return lambda em, s: fs_ops.random_score(em.n, seed,
+                                                     em.get(r_base))
         if fn.kind == "field_value_factor":
             fname = params["field"]
             ncol = self.seg.numeric.get(fname)
             if ncol is None:
                 self.sig("fvf-missing", fname)
-                missing = params.get("missing", 1.0)
-                return jnp.full(self.n, 1.0, jnp.float32) * \
-                    self.c(missing, np.float32)
-            self.sig("fvf", fname, params.get("modifier", "none"),
-                     params.get("missing") is None)
+                r_missing = self.c(params.get("missing", 1.0), np.float32)
+                return lambda em, s: (jnp.full(em.n, 1.0, jnp.float32)
+                                      * em.get(r_missing))
+            modifier = params.get("modifier", "none")
             missing = params.get("missing")
-            return fs_ops.field_value_factor(
-                ncol.hi, ncol.exists,
-                factor=self.c(float(params.get("factor", 1.0)), np.float32),
-                modifier=params.get("modifier", "none"),
-                missing=None if missing is None
-                else self.c(float(missing), np.float32))
+            self.sig("fvf", fname, modifier, missing is None)
+            r_factor = self.c(float(params.get("factor", 1.0)), np.float32)
+            r_missing = None if missing is None \
+                else self.c(float(missing), np.float32)
+
+            def factor_emit(em, s):
+                col = em.seg.numeric[fname]
+                return fs_ops.field_value_factor(
+                    col.hi, col.exists, factor=em.get(r_factor),
+                    modifier=modifier,
+                    missing=None if r_missing is None else em.get(r_missing))
+            return factor_emit
         if fn.kind in ("gauss", "exp", "linear"):
-            fname, spec = next(iter(params.items()))
-            ncol = self.seg.numeric.get(fname)
-            origin = spec.get("origin")
-            fm = self.ctx.mapper_service.field_mapper(fname)
-            geo_col = self.seg.geo.get(fname)
-            if geo_col is not None:
-                self.sig("decay-geo", fname, fn.kind)
-                # geo decay: distance to origin in meters
-                if isinstance(origin, dict):
-                    olat, olon = float(origin["lat"]), float(origin["lon"])
-                else:
-                    olat, olon = (float(x) for x in str(origin).split(","))
-                olat = self.c(olat, np.float32)
-                olon = self.c(olon, np.float32)
-                # reuse haversine by computing distances then decay
-                r = 6371008.8
-                p1 = jnp.radians(geo_col.lat)
-                p2 = jnp.radians(olat)
-                dphi = jnp.radians(geo_col.lat - olat)
-                dlmb = jnp.radians(geo_col.lon - olon)
-                a = jnp.sin(dphi / 2) ** 2 + jnp.cos(p1) * jnp.cos(p2) * \
-                    jnp.sin(dlmb / 2) ** 2
-                dist = 2 * r * jnp.arcsin(jnp.sqrt(a))
-                scale = q.parse_distance(spec["scale"])
-                offset = q.parse_distance(spec.get("offset", 0))
-                return fs_ops.decay(dist, geo_col.exists,
-                                    self.c(0.0, np.float32),
-                                    self.c(scale, np.float32),
-                                    self.c(offset, np.float32),
-                                    self.c(float(spec.get("decay", 0.5)),
-                                           np.float32), fn.kind)
-            if ncol is None:
-                self.sig("decay-missing", fname)
-                return jnp.ones(self.n, jnp.float32)
-            self.sig("decay", fname, fn.kind)
-            if fm is not None and fm.type == "date":
-                origin_v = parse_date(origin) if origin is not None else 0.0
-                from elasticsearch_tpu.common.settings import parse_time_value
-                scale = parse_time_value(spec["scale"]) * 1000.0
-                offset = parse_time_value(spec.get("offset", 0)) * 1000.0
-            else:
-                origin_v = float(origin if origin is not None else 0.0)
-                scale = float(spec["scale"])
-                offset = float(spec.get("offset", 0))
-            return fs_ops.decay(ncol.hi, ncol.exists,
-                                self.c(origin_v, np.float32),
-                                self.c(scale, np.float32),
-                                self.c(offset, np.float32),
-                                self.c(float(spec.get("decay", 0.5)),
-                                       np.float32), fn.kind)
+            return self._decay_factor(fn, params)
         if fn.kind == "script_score":
             script = params.get("script", params)
             if isinstance(script, dict):
@@ -635,84 +760,197 @@ class SegmentExecutor:
                 sparams = script.get("params", {})
             else:
                 src, sparams = str(script), {}
-            return self._eval_script(src, sparams, base_scores)
+            return self._script_factor(src, sparams)
         raise QueryParsingError(f"unknown score function [{fn.kind}]")
+
+    def _decay_factor(self, fn: q.ScoreFunction, params: dict):
+        fname, spec = next(iter(params.items()))
+        kind = fn.kind
+        origin = spec.get("origin")
+        fm = self.ctx.mapper_service.field_mapper(fname)
+        geo_col = self.seg.geo.get(fname)
+        if geo_col is not None:
+            self.sig("decay-geo", fname, kind)
+            # geo decay: distance to origin in meters
+            if isinstance(origin, dict):
+                olat, olon = float(origin["lat"]), float(origin["lon"])
+            else:
+                olat, olon = (float(x) for x in str(origin).split(","))
+            r_olat = self.c(olat, np.float32)
+            r_olon = self.c(olon, np.float32)
+            r_scale = self.c(q.parse_distance(spec["scale"]), np.float32)
+            r_offset = self.c(q.parse_distance(spec.get("offset", 0)),
+                              np.float32)
+            r_decay = self.c(float(spec.get("decay", 0.5)), np.float32)
+            r_zero = self.c(0.0, np.float32)
+
+            def factor_emit(em, s):
+                col = em.seg.geo[fname]
+                olat_t, olon_t = em.get(r_olat), em.get(r_olon)
+                r = 6371008.8
+                p1 = jnp.radians(col.lat)
+                p2 = jnp.radians(olat_t)
+                dphi = jnp.radians(col.lat - olat_t)
+                dlmb = jnp.radians(col.lon - olon_t)
+                a = jnp.sin(dphi / 2) ** 2 + jnp.cos(p1) * jnp.cos(p2) * \
+                    jnp.sin(dlmb / 2) ** 2
+                dist = 2 * r * jnp.arcsin(jnp.sqrt(a))
+                return fs_ops.decay(dist, col.exists, em.get(r_zero),
+                                    em.get(r_scale), em.get(r_offset),
+                                    em.get(r_decay), kind)
+            return factor_emit
+        ncol = self.seg.numeric.get(fname)
+        if ncol is None:
+            self.sig("decay-missing", fname)
+            return lambda em, s: jnp.ones(em.n, jnp.float32)
+        self.sig("decay", fname, kind)
+        if fm is not None and fm.type == "date":
+            origin_v = parse_date(origin) if origin is not None else 0.0
+            from elasticsearch_tpu.common.settings import parse_time_value
+            scale = parse_time_value(spec["scale"]) * 1000.0
+            offset = parse_time_value(spec.get("offset", 0)) * 1000.0
+        else:
+            origin_v = float(origin if origin is not None else 0.0)
+            scale = float(spec["scale"])
+            offset = float(spec.get("offset", 0))
+        r_origin = self.c(origin_v, np.float32)
+        r_scale = self.c(scale, np.float32)
+        r_offset = self.c(offset, np.float32)
+        r_decay = self.c(float(spec.get("decay", 0.5)), np.float32)
+
+        def factor_emit(em, s):
+            col = em.seg.numeric[fname]
+            return fs_ops.decay(col.hi, col.exists, em.get(r_origin),
+                                em.get(r_scale), em.get(r_offset),
+                                em.get(r_decay), kind)
+        return factor_emit
 
     def _feed_script_params(self, params: dict) -> dict:
         """Numeric script params become dynamic constants (vector params as
-        f32 arrays); anything else is structural."""
+        f32 arrays); anything else is structural. Returns {key: value-or-
+        const-ref marker} where refs are wrapped for emit-time lookup."""
         out = {}
         for key in sorted(params):
             v = params[key]
             if isinstance(v, bool) or isinstance(v, str):
                 self.sig("sparam", key, v)
-                out[key] = v
+                out[key] = ("static", v)
             elif isinstance(v, (int, float)):
                 self.sig("sparam", key, "num")
-                out[key] = self.c(float(v), np.float32)
+                out[key] = ("ref", self.c(float(v), np.float32))
             elif isinstance(v, (list, tuple)):
                 self.sig("sparam", key, "vec", len(v))
-                out[key] = self.c(np.asarray(v, np.float32))
+                out[key] = ("ref", self.c(np.asarray(v, np.float32)))
             else:
                 self.sig("sparam", key, repr(v))
-                out[key] = v
+                out[key] = ("static", v)
         return out
 
-    def _eval_script(self, source: str, params: dict, scores):
+    def _script_factor(self, source: str, params: dict):
+        """→ (em, base_scores) → [N] f32 evaluating the sandboxed script."""
         self.sig("script", source)
-        params = self._feed_script_params(params)
-        def get_numeric(field):
-            ncol = self.seg.numeric.get(field)
-            if ncol is None:
-                return jnp.zeros(self.n, jnp.float32), jnp.zeros(self.n, bool)
-            return ncol.hi, ncol.exists
+        param_spec = self._feed_script_params(params)
+        compiled = compile_script(source)
 
-        def get_vector(field):
-            vcol = self.seg.vector.get(field)
-            if vcol is None:
-                raise QueryParsingError(f"no vector field [{field}]")
-            return vcol.vecs, vcol.exists
+        def factor_emit(em, scores):
+            sparams = {k: (em.get(v) if tag == "ref" else v)
+                       for k, (tag, v) in param_spec.items()}
 
-        ctx = ScriptContext(get_numeric, get_vector, scores, params)
-        out = compile_script(source).evaluate(ctx)
-        return jnp.broadcast_to(jnp.asarray(out, jnp.float32), (self.n,))
+            def get_numeric(field):
+                ncol = em.seg.numeric.get(field)
+                if ncol is None:
+                    return (jnp.zeros(em.n, jnp.float32),
+                            jnp.zeros(em.n, bool))
+                return ncol.hi, ncol.exists
 
-    def _exec_ScriptScoreQuery(self, query: q.ScriptScoreQuery):
-        base_scores, base_mask = self.execute(query.query or q.MatchAllQuery())
-        scores = self._eval_script(query.script, query.params, base_scores)
-        return jnp.where(base_mask,
-                         scores * self.c(query.boost, np.float32), 0.0), \
-            base_mask
+            def get_vector(field):
+                vcol = em.seg.vector.get(field)
+                if vcol is None:
+                    raise QueryParsingError(f"no vector field [{field}]")
+                return vcol.vecs, vcol.exists
 
-    def _exec_KnnQuery(self, query: q.KnnQuery):
-        vcol = self.seg.vector.get(query.field)
-        if vcol is None:
+            ctx = ScriptContext(get_numeric, get_vector, scores, sparams)
+            out = compiled.evaluate(ctx)
+            return jnp.broadcast_to(jnp.asarray(out, jnp.float32), (em.n,))
+        return factor_emit
+
+    def _res_ScriptScoreQuery(self, query: q.ScriptScoreQuery) -> Emit:
+        base_emit = self.resolve(query.query or q.MatchAllQuery())
+        factor_emit = self._script_factor(query.script, query.params)
+        r_boost = self.c(query.boost, np.float32)
+
+        def emit(em):
+            base_scores, base_mask = base_emit(em)
+            scores = factor_emit(em, base_scores)
+            return jnp.where(base_mask, scores * em.get(r_boost), 0.0), \
+                base_mask
+        return emit
+
+    def _res_KnnQuery(self, query: q.KnnQuery) -> Emit:
+        field = query.field
+        if self.seg.vector.get(field) is None:
             return self._zeros()
-        qv = jnp.asarray(self.c(query.query_vector, np.float32))
-        scores = vector_ops.cosine_scores(vcol.vecs, vcol.exists, qv)
-        return (scores + 1.0) * self.c(query.boost, np.float32) * \
-            vcol.exists.astype(jnp.float32), vcol.exists
+        r_qv = self.c(query.query_vector, np.float32)
+        r_boost = self.c(query.boost, np.float32)
 
-    def _exec_GeoDistanceQuery(self, query: q.GeoDistanceQuery):
-        gcol = self.seg.geo.get(query.field)
-        if gcol is None:
-            return self._zeros()
-        mask = filter_ops.geo_distance(gcol.lat, gcol.lon, gcol.exists,
-                                       self.c(query.lat, np.float32),
-                                       self.c(query.lon, np.float32),
-                                       self.c(query.distance_m, np.float32))
-        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
+        def emit(em):
+            col = em.seg.vector[field]
+            qv = jnp.asarray(em.get(r_qv))
+            scores = vector_ops.cosine_scores(col.vecs, col.exists, qv)
+            return (scores + 1.0) * em.get(r_boost) * \
+                col.exists.astype(jnp.float32), col.exists
+        return emit
 
-    def _exec_GeoBoundingBoxQuery(self, query: q.GeoBoundingBoxQuery):
-        gcol = self.seg.geo.get(query.field)
-        if gcol is None:
+    def _res_GeoDistanceQuery(self, query: q.GeoDistanceQuery) -> Emit:
+        field = query.field
+        if self.seg.geo.get(field) is None:
             return self._zeros()
-        mask = filter_ops.geo_bounding_box(
-            gcol.lat, gcol.lon, gcol.exists,
-            self.c(query.top, np.float32), self.c(query.left, np.float32),
-            self.c(query.bottom, np.float32),
-            self.c(query.right, np.float32))
-        return bool_ops.constant_score(mask, self.c(query.boost, np.float32))
+        r_lat = self.c(query.lat, np.float32)
+        r_lon = self.c(query.lon, np.float32)
+        r_dist = self.c(query.distance_m, np.float32)
+        return self._constant_mask_emit(
+            lambda em: filter_ops.geo_distance(
+                em.seg.geo[field].lat, em.seg.geo[field].lon,
+                em.seg.geo[field].exists,
+                em.get(r_lat), em.get(r_lon), em.get(r_dist)),
+            query.boost)
+
+    def _res_GeoBoundingBoxQuery(self, query: q.GeoBoundingBoxQuery) -> Emit:
+        field = query.field
+        if self.seg.geo.get(field) is None:
+            return self._zeros()
+        r_top = self.c(query.top, np.float32)
+        r_left = self.c(query.left, np.float32)
+        r_bottom = self.c(query.bottom, np.float32)
+        r_right = self.c(query.right, np.float32)
+        return self._constant_mask_emit(
+            lambda em: filter_ops.geo_bounding_box(
+                em.seg.geo[field].lat, em.seg.geo[field].lon,
+                em.seg.geo[field].exists,
+                em.get(r_top), em.get(r_left),
+                em.get(r_bottom), em.get(r_right)),
+            query.boost)
+
+
+class SegmentExecutor:
+    """Eager facade: resolve + emit immediately against the real segment.
+
+    The per-op fallback path and the parity oracle for the compiled path —
+    both run the SAME emit closures, so they cannot drift."""
+
+    def __init__(self, seg: DeviceSegment, ctx: ExecutionContext):
+        self.seg = seg
+        self.ctx = ctx
+        self.n = seg.padded_docs
+
+    def execute(self, query: q.Query):
+        """→ (scores [N] f32, mask [N] bool); live-mask applied by caller."""
+        ct = ConstTable()
+        emit = SegmentResolver(self.seg, self.ctx, ct).resolve(query)
+        return emit(EmitCtx(self.seg, [jnp.asarray(v) for v in ct.values]))
+
+    def match_mask(self, query: q.Query):
+        return self.execute(query)[1]
 
 
 def _resolve_msm(msm, num_clauses: int) -> int:

@@ -3,24 +3,26 @@
 This delivers the promise in execute.py's docstring — the production query
 path analog of QueryPhase's single collector pass (ref:
 core/search/query/QueryPhase.java:99-314, `searcher.search(query,
-collector)` :314): instead of eagerly dispatching one device op per AST
-node, the whole per-segment walk — scoring, boolean algebra,
+collector)` :314): the whole per-segment walk — scoring, boolean algebra,
 function_score, min_score, post_filter, search-after continuation, hit
-counting and top-k — traces into ONE jitted program.
+counting and top-k — runs as ONE jitted program.
 
-Mechanics (see execute.ConstFeed):
+Mechanics (see execute.SegmentResolver):
 
-1. **plan pass** — `jax.eval_shape` walks the executor abstractly (zero
-   device work), recording every dynamic constant (term ids, idf, bounds)
-   and a structural signature (query shape, static tokens, const shapes).
+1. **resolve** — host-side "createWeight": dictionary lookups collect every
+   dynamic constant (term ids, idf, bounds) into a ConstTable plus a
+   structural signature, and produce emit closures of pure jnp ops.
+   Microseconds per query — no tracing, no device work.
 2. **cache** — key = (signature, segment layout, BM25 params, output
    wants). Hit → the compiled program runs with this query's constants as
    inputs. Queries differing only in terms/values/boosts share a program;
    segments sharing a shape bucket share it too (the bounded-recompilation
    contract of segment.doc_count_bucket).
-3. **replay** — the jitted function rebuilds a segment view from traced
-   arrays and re-walks the same executor code, with `ConstFeed` handing
-   back traced constants in recorded order.
+3. **emit under jit** — the jitted function rebuilds a segment view from
+   traced arrays and calls the emit closures with traced constants.
+4. **batch** — B same-signature queries stack their constants on a leading
+   axis and run under ``jax.vmap`` as one program (run_segment_batch): the
+   TPU-native answer to request-at-a-time dispatch.
 """
 
 from __future__ import annotations
@@ -36,7 +38,7 @@ import numpy as np
 from elasticsearch_tpu.index.device_reader import DeviceSegment
 from elasticsearch_tpu.ops import topk as topk_ops
 from elasticsearch_tpu.search.execute import (
-    ConstFeed, ExecutionContext, SegmentExecutor)
+    ConstTable, EmitCtx, ExecutionContext, SegmentResolver)
 
 _CACHE_CAP = 512
 _cache: OrderedDict[tuple, "jax.stages.Wrapped"] = OrderedDict()
@@ -93,9 +95,9 @@ def seg_rebuild(seg: DeviceSegment, flat: list) -> DeviceSegment:
     for kind in _KINDS:
         fields = getattr(seg, kind)
         # arrays were flattened in sorted-name order, but the rebuilt dicts
-        # must preserve the ORIGINAL iteration order — executor walks (e.g.
-        # the all-fields match loop) iterate these dicts, and plan/replay
-        # const order depends on it
+        # must preserve the ORIGINAL iteration order — resolver walks (e.g.
+        # the all-fields match loop) iterate these dicts, and the emitted
+        # structure depends on it
         rebuilt = {
             name: dc_replace(fields[name],
                              **{attr: next(it) for attr in _ARRAYS[kind]})
@@ -121,24 +123,40 @@ def layout_key(seg: DeviceSegment) -> tuple:
 # The fused per-segment program
 # ---------------------------------------------------------------------------
 
-def _build(seg_view, ctx, query, post_filter, flags, k):
-    """The traced body: executor walk + phase post-processing + top-k."""
-    cf = ctx.cf
-    ex = SegmentExecutor(seg_view, ctx)
-    scores, mask = ex.execute(query)
-    mask = mask & seg_view.live
+def _plan(seg: DeviceSegment, ctx: ExecutionContext, query, post_filter,
+          flags):
+    """Host resolve → (ConstTable, emit_q, emit_pf mask-emit, flag refs)."""
+    ct = ConstTable()
+    resolver = SegmentResolver(seg, ctx, ct)
+    emit_q = resolver.resolve(query)
+    emit_pf = resolver.resolve_mask(post_filter) \
+        if post_filter is not None else None
+    refs = {}
     if flags["min_score"]:
-        mask = mask & (scores >= cf.feed(flags["_min_score"], np.float32))
-    if post_filter is not None:
-        pf_mask = SegmentExecutor(seg_view, ctx).match_mask(post_filter)
-        mask_post = mask & pf_mask
+        refs["min_score"] = ct.add(flags["_min_score"], np.float32)
+    if flags["search_after"]:
+        refs["sa_score"] = ct.add(flags["_sa_score"], np.float32)
+        refs["sa_doc"] = ct.add(flags["_sa_doc"], np.int32)
+        refs["doc_base"] = ct.add(flags["_doc_base"], np.int32)
+    return ct, emit_q, emit_pf, refs
+
+
+def _build(view, consts, emit_q, emit_pf, refs, flags, k: int):
+    """The program body: emit + phase post-processing + top-k."""
+    em = EmitCtx(view, consts)
+    scores, mask = emit_q(em)
+    mask = mask & view.live
+    if "min_score" in refs:
+        mask = mask & (scores >= em.get(refs["min_score"]))
+    if emit_pf is not None:
+        mask_post = mask & emit_pf(em)
     else:
         mask_post = mask
-    if flags["search_after"]:
-        last_score = cf.feed(flags["_sa_score"], np.float32)
-        last_doc = cf.feed(flags["_sa_doc"], np.int32)
-        ids = jnp.arange(seg_view.padded_docs, dtype=jnp.int32) + \
-            cf.feed(flags["_doc_base"], np.int32)
+    if "sa_score" in refs:
+        last_score = em.get(refs["sa_score"])
+        last_doc = em.get(refs["sa_doc"])
+        ids = jnp.arange(view.padded_docs, dtype=jnp.int32) + \
+            em.get(refs["doc_base"])
         cont = (scores < last_score) | ((scores == last_score) &
                                         (ids > last_doc))
         mask_post = mask_post & cont
@@ -146,8 +164,7 @@ def _build(seg_view, ctx, query, post_filter, flags, k):
     outs = {"count": count}
     if flags["want_topk"]:
         ts, td = topk_ops.top_k(scores, mask_post,
-                                min(k, seg_view.padded_docs),
-                                0)
+                                min(k, view.padded_docs), 0)
         outs["top_scores"], outs["top_docs"] = ts, td
     if flags["want_arrays"]:
         outs["scores"] = scores
@@ -156,6 +173,25 @@ def _build(seg_view, ctx, query, post_filter, flags, k):
         # main query result, ignoring post_filter)
         outs["agg_mask"] = mask
     return outs
+
+
+def _get_compiled(key, build_fn):
+    with _cache_lock:
+        fn = _cache.get(key)
+        if fn is not None:
+            _cache.move_to_end(key)
+            _stats["hits"] += 1
+            return fn
+    # compile OUTSIDE the lock (slow); a racing duplicate compile is
+    # harmless — last one wins the cache slot
+    with _cache_lock:
+        _stats["misses"] += 1
+    fn = build_fn()
+    with _cache_lock:
+        _cache[key] = fn
+        while len(_cache) > _CACHE_CAP:
+            _cache.popitem(last=False)
+    return fn
 
 
 def run_segment(seg: DeviceSegment, ctx: ExecutionContext, query,
@@ -181,34 +217,20 @@ def run_segment(seg: DeviceSegment, ctx: ExecutionContext, query,
     }
     k_static = 0 if k is None else int(k)
 
-    # ---- plan pass: collect consts + signature, no device work ----------
-    pcf = ConstFeed("plan")
-    pctx = dc_replace(ctx, cf=pcf)
-    jax.eval_shape(
-        lambda: _build(seg, pctx, query, post_filter, flags, k_static))
-    consts = tuple(jnp.asarray(v) for v in pcf.values)
+    ct, emit_q, emit_pf, refs = _plan(seg, ctx, query, post_filter, flags)
+    consts = [jnp.asarray(v) for v in ct.values]
 
-    key = (pcf.signature(), layout_key(seg),
+    key = (ct.signature(), layout_key(seg),
            float(ctx.bm25.k1), float(ctx.bm25.b),
            flags["min_score"], flags["search_after"], k_static, want_arrays,
            post_filter is not None)
-
     flat = seg_flatten(seg)
-    with _cache_lock:
-        fn = _cache.get(key)
-        if fn is not None:
-            _cache.move_to_end(key)
-            _stats["hits"] += 1
-    if fn is None:
-        with _cache_lock:
-            _stats["misses"] += 1
 
+    def compile_fn():
         def run(flat_in, consts_in):
-            rcf = ConstFeed("replay", replay=consts_in)
-            rctx = dc_replace(ctx, cf=rcf)
             view = seg_rebuild(seg, flat_in)
-            return _build(view, rctx, query, post_filter, flags, k_static)
-
+            return _build(view, consts_in, emit_q, emit_pf, refs, flags,
+                          k_static)
         # AOT lower+compile and cache ONLY the executable: a cached
         # jax.jit closure would pin the whole DeviceSegment/DeviceReader
         # (every column's device arrays) for the life of the cache entry —
@@ -216,10 +238,110 @@ def run_segment(seg: DeviceSegment, ctx: ExecutionContext, query,
         shapes = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             (flat, consts))
-        fn = jax.jit(run).lower(*shapes).compile()
-        with _cache_lock:
-            _cache[key] = fn
-            while len(_cache) > _CACHE_CAP:
-                _cache.popitem(last=False)
+        return jax.jit(run).lower(*shapes).compile()
 
+    fn = _get_compiled(key, compile_fn)
     return fn(flat, consts)
+
+
+def run_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,
+                      queries: list, *, k: int) -> dict | None:
+    """Execute a BATCH of queries against one device segment as ONE vmapped
+    compiled program.
+
+    This is the TPU-native answer to the reference's request-at-a-time
+    search dispatch (SearchService.executeQueryPhase,
+    core/search/SearchService.java:293, driven per request by
+    TransportSearchTypeAction): an accelerator wants batches, so queries
+    sharing a plan signature share one program with their constants stacked
+    on a leading batch axis — scoring, masking and per-query top-k all run
+    under jax.vmap with no host round-trips between queries.
+
+    Only the score-ordered top-k shape is supported (no post_filter /
+    min_score / search_after / aggregation arrays — callers route such
+    requests down the per-query path). Returns ``{"count": [B] i32,
+    "top_scores": [B, k] f32, "top_docs": [B, k] i32}`` (segment-local doc
+    ids) as device arrays, or ``None`` when the queries do not all share
+    one plan signature — or the shared plan has no dynamic constants —
+    (the caller falls back to per-query execution).
+
+    The batch axis is padded to the next power of two (repeating the last
+    query's constants) so varying batch sizes share compiled programs.
+    """
+    if not queries:
+        return None
+    flags = {
+        "min_score": False, "_min_score": 0.0,
+        "search_after": False, "_sa_score": 0.0, "_sa_doc": -1,
+        "_doc_base": seg.doc_base,
+        "want_topk": True, "want_arrays": False,
+    }
+    k_static = int(k)
+    sig0 = None
+    emit0 = refs0 = None
+    consts_rows: list[list[np.ndarray]] = []
+    for query in queries:
+        ct, emit_q, _, refs = _plan(seg, ctx, query, None, flags)
+        if sig0 is None:
+            sig0, emit0, refs0 = ct.signature(), emit_q, refs
+        elif ct.signature() != sig0:
+            return None
+        consts_rows.append(ct.values)
+
+    b = len(queries)
+    b_pad = 1 if b == 1 else 1 << (b - 1).bit_length()
+    if b_pad != b:
+        consts_rows = consts_rows + [consts_rows[-1]] * (b_pad - b)
+    n_consts = len(consts_rows[0])
+    if n_consts == 0:
+        # const-free plans (match_none / absent-field zeros): nothing to
+        # vmap over — the per-query path handles these (rare) shapes
+        return None
+    # pack constants per dtype into ONE [B, total] buffer each: every
+    # host→device transfer pays dispatch/tunnel latency, so 2 packed
+    # buffers beat N small ones; the program unpacks by static slicing
+    # (free under XLA). The spec layout is a pure function of the plan
+    # signature, so cached programs agree on it.
+    specs = []                       # per const: (dtype, offset, shape, size)
+    totals: dict[str, int] = {}
+    for v in consts_rows[0]:
+        dt = str(v.dtype)
+        off = totals.get(dt, 0)
+        size = int(v.size)
+        specs.append((dt, off, v.shape, size))
+        totals[dt] = off + size
+    packed = {}
+    for dt, total in totals.items():
+        packed[dt] = np.empty((b_pad, total), dtype=dt)
+    for bi, row in enumerate(consts_rows):
+        for v, (dt, off, _shape, size) in zip(row, specs):
+            packed[dt][bi, off:off + size] = v.reshape(-1)
+    packed = {dt: jnp.asarray(buf) for dt, buf in packed.items()}
+
+    key = ("batch", sig0, layout_key(seg),
+           float(ctx.bm25.k1), float(ctx.bm25.b), k_static, b_pad)
+    flat = seg_flatten(seg)
+
+    def compile_fn():
+        def run(flat_in, packed_in):
+            view = seg_rebuild(seg, flat_in)
+
+            def one(packed_one):
+                consts_one = [
+                    packed_one[dt][off:off + size].reshape(shape)
+                    for dt, off, shape, size in specs]
+                return _build(view, consts_one, emit0, None, refs0,
+                              flags, k_static)
+
+            return jax.vmap(one)(packed_in)
+
+        shapes = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            (flat, packed))
+        return jax.jit(run).lower(*shapes).compile()
+
+    fn = _get_compiled(key, compile_fn)
+    outs = fn(flat, packed)
+    if b_pad != b:
+        outs = {name: v[:b] for name, v in outs.items()}
+    return outs

@@ -91,6 +91,17 @@ def parse_search_request(body: dict | None) -> ParsedSearchRequest:
     return req
 
 
+def _is_score_order(sort: list) -> bool:
+    """True iff results follow the default (_score desc) order: no sort, or
+    exactly [{"_score": {"order": "desc"}}]. An ASCENDING _score sort must
+    take the field-sort path or its direction would be silently dropped."""
+    if not sort:
+        return True
+    if len(sort) != 1 or "_score" not in sort[0]:
+        return False
+    return sort[0]["_score"].get("order", "desc") == "desc"
+
+
 @dataclass
 class ShardQueryResult:
     shard_id: int
@@ -157,8 +168,7 @@ class ShardSearcher:
         normally without double execution."""
         from elasticsearch_tpu.search import jit_exec
         k = max(req.from_ + req.size, 1)
-        score_order = not req.sort or \
-            (len(req.sort) == 1 and "_score" in req.sort[0])
+        score_order = _is_score_order(req.sort)
         need_arrays = bool(req.aggs) or not score_order
         sa = req.search_after if (req.search_after is not None
                                   and not req.sort) else None
@@ -230,6 +240,77 @@ class ShardSearcher:
         res.terminated_early = terminated_early
         res.timed_out = timed_out
         return res
+
+    def query_phase_batch(self, reqs: list[ParsedSearchRequest]
+                          ) -> list[ShardQueryResult] | None:
+        """Batched query phase: execute B score-ordered requests as ONE
+        vmapped program per segment plus one batched cross-segment merge —
+        the whole multi-query round trip is S+1 device dispatches instead
+        of B×(S+1).
+
+        The reference's _msearch fans requests out one at a time
+        (core/action/search/TransportMultiSearchAction.java); on an
+        accelerator the batch IS the unit of work, so this is the engine's
+        primary high-throughput entry. Returns None when the batch is
+        ineligible (aggs / sort-by-field / post_filter / min_score /
+        search_after / suggest / partial-results modes) or the queries
+        don't share one compiled plan — the caller then falls back to
+        per-request :meth:`query_phase`.
+        """
+        from elasticsearch_tpu.search import jit_exec
+        if not reqs:
+            return []
+        for req in reqs:
+            if (req.aggs or not _is_score_order(req.sort)
+                    or req.post_filter is not None
+                    or req.min_score is not None
+                    or req.search_after is not None or req.suggest
+                    or req.terminate_after is not None
+                    or req.timeout_ms is not None):
+                return None
+        k = max(max(req.from_ + req.size, 1) for req in reqs)
+        queries = [req.query for req in reqs]
+        try:
+            seg_outs = []
+            for seg in self.reader.segments:
+                outs = jit_exec.run_segment_batch(seg, self.ctx, queries, k=k)
+                if outs is None:       # mixed plan signatures
+                    return None
+                seg_outs.append(outs)
+        except QueryParsingError:
+            raise
+        except Exception:                 # noqa: BLE001 — fallback seam
+            jit_exec.note_fallback()
+            return None
+        if not seg_outs:
+            return [ShardQueryResult(self.shard_id, 0, None,
+                                     np.zeros(0, np.int32),
+                                     np.zeros(0, np.float32), None, {},
+                                     self.reader) for _ in reqs]
+        bases = [seg.doc_base for seg in self.reader.segments]
+        ms, md = topk_ops.merge_top_k_batch(
+            [o["top_scores"] for o in seg_outs],
+            [o["top_docs"] for o in seg_outs], k, bases)
+        counts = sum(o["count"] for o in seg_outs)
+        if self.reader.max_doc < (1 << 24):
+            # single-fetch fast path: one device→host round trip per batch
+            # (RTT dominates fetch cost); doc ids exact in f32 below 2^24
+            packed = np.asarray(topk_ops.pack_batch_result(ms, md, counts))
+            ms, md, totals = topk_ops.unpack_batch_result(packed, k)
+        else:
+            ms, md = np.asarray(ms), np.asarray(md)
+            totals = np.asarray(counts)
+        results = []
+        for bi, req in enumerate(reqs):
+            kq = max(req.from_ + req.size, 1)
+            valid = md[bi] >= 0
+            s_, d_ = ms[bi][valid][:kq], md[bi][valid][:kq]
+            results.append(ShardQueryResult(
+                self.shard_id, int(totals[bi]),
+                float(s_[0]) if s_.size else None,
+                d_.astype(np.int32), s_.astype(np.float32), None, {},
+                self.reader))
+        return results
 
     def _collect_aggs(self, req: ParsedSearchRequest,
                       masks: list, scores: list) -> dict:
@@ -324,7 +405,7 @@ class ShardSearcher:
         if req.terminate_after is not None:
             total = min(total, req.terminate_after)
 
-        if req.sort and not (len(req.sort) == 1 and "_score" in req.sort[0]):
+        if not _is_score_order(req.sort):
             if per_seg:
                 res = self._sorted_query(req, per_seg, total, agg_partials,
                                          segments=segments)
