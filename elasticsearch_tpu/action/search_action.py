@@ -55,9 +55,11 @@ def wire_safe(obj):
 
 
 class _ScrollContext:
-    def __init__(self, index_expr: str, body: dict, keep_alive_s: float):
+    def __init__(self, index_expr: str, body: dict, keep_alive_s: float,
+                 search_type: str | None = None):
         self.index_expr = index_expr
         self.body = dict(body)
+        self.search_type = search_type
         self.keep_alive_s = keep_alive_s
         self.expires_at = time.monotonic() + keep_alive_s
         self.last_sort_key: list | None = None
@@ -71,6 +73,7 @@ class _ScrollContext:
 
 class SearchActions:
     QUERY_FETCH = "indices:data/read/search[phase/query+fetch]"
+    DFS = "indices:data/read/search[phase/dfs]"
     FIELD_STATS = "indices:data/read/field_stats[s]"
 
     def __init__(self, node):
@@ -84,6 +87,8 @@ class SearchActions:
         node.transport_service.register_request_handler(
             self.QUERY_FETCH, self._handle_shard_query, executor="search",
             sync=True)
+        node.transport_service.register_request_handler(
+            self.DFS, self._handle_shard_dfs, executor="search", sync=True)
         node.transport_service.register_request_handler(
             self.FIELD_STATS, self._handle_field_stats, executor="search",
             sync=True)
@@ -111,10 +116,23 @@ class SearchActions:
     def _handle_shard_query(self, request: dict, source) -> dict:
         return self._execute_shard(request["index"], request["shard"],
                                    request["body"],
-                                   doc_slot=request.get("doc_slot"))
+                                   doc_slot=request.get("doc_slot"),
+                                   dfs=request.get("dfs"))
+
+    def _handle_shard_dfs(self, request: dict, source) -> dict:
+        """DFS phase (DfsPhase.execute analog): term/collection statistics
+        of this shard for the query's terms."""
+        from elasticsearch_tpu.search.dfs import shard_dfs
+        from elasticsearch_tpu.search.query_dsl import parse_query
+        name, shard = request["index"], request["shard"]
+        svc = self.node.indices_service.index(name)
+        reader = device_reader_for(svc.engine(shard))
+        query = parse_query((request.get("body") or {}).get("query"))
+        return shard_dfs(reader, svc.mapper_service, query)
 
     def _execute_shard(self, name: str, shard: int, body: dict,
-                       doc_slot: int | None = None) -> dict:
+                       doc_slot: int | None = None,
+                       dfs: dict | None = None) -> dict:
         t0 = time.perf_counter()
         svc = self.node.indices_service.index(name)
         engine = svc.engine(shard)
@@ -127,8 +145,10 @@ class SearchActions:
             est = max(reader.num_docs, 1) * 16
             breaker.add_estimate(est, f"search [{name}][{shard}]")
         try:
+            from elasticsearch_tpu.search.dfs import to_execution_stats
             searcher = ShardSearcher(shard, reader, svc.mapper_service,
-                                     index_name=name, doc_slot=doc_slot)
+                                     index_name=name, doc_slot=doc_slot,
+                                     dfs_stats=to_execution_stats(dfs))
             req = parse_search_request(body)
             result = searcher.query_phase(req)
             k = min(len(result.doc_ids), req.from_ + req.size)
@@ -179,7 +199,8 @@ class SearchActions:
         return groups
 
     def _try_shard(self, state, name: str, sid: int, copies: list,
-                   body: dict, doc_slot: int | None = None):
+                   body: dict, doc_slot: int | None = None,
+                   dfs: dict | None = None):
         """→ ("ok", payload) or ("fail", reason-dict). Walks the copy list
         (shard-failover retry, TransportSearchTypeAction.java:205-247)."""
         from elasticsearch_tpu.action.replication import unwrap_remote
@@ -190,14 +211,15 @@ class SearchActions:
             try:
                 if c.node_id == self.node.node_id:
                     return "ok", self._execute_shard(name, sid, body,
-                                                     doc_slot=doc_slot)
+                                                     doc_slot=doc_slot,
+                                                     dfs=dfs)
                 target = state.node(c.node_id)
                 if target is None:
                     continue
                 return "ok", self.node.transport_service.send_request(
                     target, self.QUERY_FETCH,
                     {"index": name, "shard": sid, "body": body,
-                     "doc_slot": doc_slot},
+                     "doc_slot": doc_slot, "dfs": dfs},
                     timeout=30.0).result(35.0)
             except Exception as e:               # noqa: BLE001 — classify
                 e = unwrap_remote(e)
@@ -218,29 +240,67 @@ class SearchActions:
             fail["status"] = last.status
         return "fail", fail
 
+    # accepted search types (ref: SearchType.fromString,
+    # core/action/search/SearchType.java:29 — scan/count are deprecated
+    # aliases there; query_and_fetch IS this implementation's execution
+    # model, see module docstring)
+    SEARCH_TYPES = (None, "query_then_fetch", "query_and_fetch",
+                    "dfs_query_then_fetch", "dfs_query_and_fetch")
+
     def search(self, index_expr: str, body: dict | None = None,
-               scroll: str | None = None) -> dict:
+               scroll: str | None = None,
+               search_type: str | None = None) -> dict:
+        from elasticsearch_tpu.common.errors import IllegalArgumentError
+        if search_type not in self.SEARCH_TYPES:
+            raise IllegalArgumentError(
+                f"No search type for [{search_type}]")
+        if search_type in ("dfs_query_and_fetch",):
+            search_type = "dfs_query_then_fetch"
         t0 = time.perf_counter()
         body = dict(body or {})
         if scroll is not None:
             body["sort"] = self._scroll_sort(body.get("sort"))
-        resp = self._search_once(index_expr, body, t0)
+        resp = self._search_once(index_expr, body, t0,
+                                 search_type=search_type)
         if scroll is not None:
             resp["_scroll_id"] = self._open_scroll(index_expr, body, scroll,
-                                                   resp)
+                                                   resp,
+                                                   search_type=search_type)
         return resp
 
-    def _search_once(self, index_expr: str, body: dict, t0: float) -> dict:
+    def _dfs_phase(self, state, groups, body: dict) -> dict:
+        """The DFS round preceding the query round
+        (executeDfsPhase, core/search/SearchService.java:264 +
+        aggregateDfs SearchPhaseController.java:105): gather each shard's
+        term/collection statistics, reduce to global stats."""
+        from elasticsearch_tpu.search.dfs import aggregate_dfs
+        futures = [self._pool.submit(
+            self._try_shard_action, state, n, s, copies, self.DFS,
+            self._handle_shard_dfs, body) for n, s, copies in groups]
+        results = []
+        for fut in futures:
+            status, payload = fut.result()
+            if status == "ok":
+                results.append(payload)
+            # a failed shard contributes no stats — its query round will
+            # fail over / report the shard failure itself
+        return aggregate_dfs(results)
+
+    def _search_once(self, index_expr: str, body: dict, t0: float,
+                     search_type: str | None = None) -> dict:
         names = self.node.indices_service.resolve(index_expr)
         state = self.node.cluster_service.state()
         req = parse_search_request(body)
         groups = self._shard_groups(state, names)
+        dfs = None
+        if search_type == "dfs_query_then_fetch":
+            dfs = self._dfs_phase(state, groups, body)
         # dense, deterministic _doc slots per (index, shard): sorted so a
         # scroll's later pages (same index set) assign identical slots
         slot_of = {(n, s): i for i, (n, s) in
                    enumerate(sorted((n, s) for n, s, _ in groups))}
         futures = [self._pool.submit(self._try_shard, state, n, s, copies,
-                                     body, slot_of[(n, s)])
+                                     body, slot_of[(n, s)], dfs)
                    for n, s, copies in groups]
         payloads, failures = [], []
         for fut in futures:
@@ -416,9 +476,9 @@ class SearchActions:
         return sort
 
     def _open_scroll(self, index_expr: str, body: dict, scroll: str,
-                     first_page: dict) -> str:
+                     first_page: dict, search_type: str | None = None) -> str:
         keep = parse_time_value(scroll, "scroll")
-        ctx = _ScrollContext(index_expr, body, keep)
+        ctx = _ScrollContext(index_expr, body, keep, search_type=search_type)
         self._note_page(ctx, first_page)
         with self._lock:
             cid = f"ctx{next(self._ctx_ids)}"
@@ -459,7 +519,8 @@ class SearchActions:
         body["from"] = 0
         if ctx.last_sort_key is not None:
             body["search_after"] = ctx.last_sort_key
-        resp = self._search_once(ctx.index_expr, body, time.perf_counter())
+        resp = self._search_once(ctx.index_expr, body, time.perf_counter(),
+                                 search_type=ctx.search_type)
         self._note_page(ctx, resp)
         resp["_scroll_id"] = scroll_id
         return resp

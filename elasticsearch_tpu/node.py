@@ -564,8 +564,10 @@ class Node:
     # ---- search entry ------------------------------------------------------
 
     def search(self, index: str, body: dict | None = None,
-               scroll: str | None = None) -> dict:
-        return self.search_actions.search(index, body, scroll=scroll)
+               scroll: str | None = None,
+               search_type: str | None = None) -> dict:
+        return self.search_actions.search(index, body, scroll=scroll,
+                                          search_type=search_type)
 
     def count(self, index: str, body: dict | None = None) -> dict:
         return self.search_actions.count(index, body)

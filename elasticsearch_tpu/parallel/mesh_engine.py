@@ -1,0 +1,454 @@
+"""The ENGINE's distributed query plane — shard_map over ("dp", "shard").
+
+Where the host path fans a query out over per-shard RPCs
+(action/search_action.py, ref: TransportSearchTypeAction.java:137) and
+merges at the coordinator (SearchPhaseController.sortDocs:165), this module
+runs the SAME engine artifacts — the segments real Engines built from
+indexed documents, their live/delete bitmaps, the query-DSL resolve/emit
+closures of search/execute.py — as ONE SPMD program over a device mesh:
+
+* every engine shard's segments are padded to common shape buckets,
+  stacked on a leading axis and sharded over the ``shard`` mesh axis
+  (doc-partition = the reference's hash-routed shard);
+* the query batch is sharded over ``dp`` (concurrent-searches axis);
+* term statistics are aggregated globally host-side (search/dfs.py — the
+  DFS round; term *ids* stay per-shard constants since segment
+  dictionaries differ) so every shard scores with identical idf/avgdl;
+* in-program: per-slot emit under ``jax.vmap`` → per-shard top-k →
+  ``all_gather`` over ICI + re-top-k, hit counts via ``psum`` — the whole
+  scatter-gather-reduce with no host round trips (SURVEY §2.2/§2.10).
+
+Results are bit-identical to the RPC path under dfs_query_then_fetch (the
+host merge concatenates shard payloads in the same shard order the
+all_gather does, and lax.top_k is stable) — asserted by
+tests/test_mesh_engine.py and the driver's dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elasticsearch_tpu.common.errors import QueryParsingError
+from elasticsearch_tpu.index.device_reader import (
+    DeviceKeywordField, DeviceNumericField, DeviceSegment, DeviceTextField,
+    dd_split)
+from elasticsearch_tpu.index.segment import (
+    KeywordFieldColumn, Segment, TextFieldColumn)
+from elasticsearch_tpu.search import dfs as dfs_mod
+from elasticsearch_tpu.search.execute import ExecutionContext
+from elasticsearch_tpu.search.jit_exec import (
+    _build, _plan, seg_flatten, seg_rebuild, layout_key)
+from elasticsearch_tpu.search.phase import parse_search_request
+
+_FLAGS = {
+    "min_score": False, "_min_score": 0.0,
+    "search_after": False, "_sa_score": 0.0, "_sa_doc": -1,
+    "_doc_base": 0, "want_topk": True, "want_arrays": False,
+}
+
+
+def _pad2(a: np.ndarray, rows: int, cols: int, fill) -> np.ndarray:
+    out = np.full((rows, cols), fill, a.dtype)
+    out[:a.shape[0], :a.shape[1]] = a
+    return out
+
+
+def _pad1(a: np.ndarray, rows: int, fill) -> np.ndarray:
+    out = np.full(rows, fill, a.dtype)
+    out[:a.shape[0]] = a
+    return out
+
+
+@dataclass
+class _SlotLayout:
+    """Common padded layout of one segment slot across every shard."""
+    np_docs: int
+    text: dict[str, tuple[int, int]]       # field → (L, U)
+    keyword: dict[str, int]                # field → K
+    numeric: list[str]
+
+
+class MeshEngineSearcher:
+    """Executes query-DSL searches over all shards of an index as one
+    shard_map program on a ``("dp", "shard")`` mesh.
+
+    Built from the engines' current searcher views (point-in-time segment
+    sets + live masks — deletes respected); rebuild after refresh, like
+    acquiring a new searcher.
+    """
+
+    def __init__(self, mesh: Mesh, engines: list, mapper_service,
+                 k1: float = 1.2, b: float = 0.75):
+        from elasticsearch_tpu.ops.similarity import BM25Params
+        self.mesh = mesh
+        self.mapper_service = mapper_service
+        self.k1, self.b = k1, b
+        self._bm25 = BM25Params(k1=k1, b=b)
+        s = mesh.shape["shard"]
+        if len(engines) != s:
+            raise ValueError(f"{len(engines)} engine shards != mesh shard "
+                             f"axis {s}")
+        self.n_shards = s
+        views = [e.acquire_searcher() for e in engines]
+        self._views = views
+        self.n_slots = max((len(v.segments) for v in views), default=0)
+        if self.n_slots == 0:
+            raise ValueError("no segments — refresh the engines first")
+        self._layouts = [self._slot_layout(j) for j in range(self.n_slots)]
+        self.slot_bases = np.cumsum(
+            [0] + [lay.np_docs for lay in self._layouts])[:-1].tolist()
+        self.shard_stride = int(sum(lay.np_docs for lay in self._layouts))
+        # templates[s][j]: host-side DeviceSegment (numpy arrays, real host
+        # column dicts) used for resolution; shard 0's templates also give
+        # the traced structure in the program body
+        self._templates = [
+            [self._template(si, j) for j in range(self.n_slots)]
+            for si in range(s)]
+        # stacked + mesh-sharded device arrays per slot, seg_flatten order
+        shard_sharding = NamedSharding(mesh, P("shard"))
+        self._flats = []
+        for j in range(self.n_slots):
+            per_shard = [seg_flatten(self._templates[si][j])
+                         for si in range(s)]
+            self._flats.append([
+                jax.device_put(np.stack([per_shard[si][i]
+                                         for si in range(s)]),
+                               shard_sharding)
+                for i in range(len(per_shard[0]))])
+        self._programs: dict[tuple, callable] = {}
+
+    # ---- packing ----------------------------------------------------------
+
+    def _slot_layout(self, j: int) -> _SlotLayout:
+        np_docs = 0
+        text: dict[str, tuple[int, int]] = {}
+        keyword: dict[str, int] = {}
+        numeric: set[str] = set()
+        for v in self._views:
+            if j >= len(v.segments):
+                continue
+            seg = v.segments[j]
+            np_docs = max(np_docs, seg.padded_docs)
+            for name, c in seg.text_fields.items():
+                pl, pu = text.get(name, (0, 0))
+                text[name] = (max(pl, c.tokens.shape[1]),
+                              max(pu, c.uterms.shape[1]))
+            for name, c in seg.keyword_fields.items():
+                keyword[name] = max(keyword.get(name, 0), c.ords.shape[1])
+            numeric.update(seg.numeric_fields)
+            if seg.vector_fields or seg.geo_fields:
+                raise QueryParsingError(
+                    "mesh engine plane does not pack vector/geo fields yet"
+                    " — use the RPC fan-out path")
+        return _SlotLayout(np_docs=max(np_docs, 8), text=text,
+                           keyword=keyword, numeric=sorted(numeric))
+
+    def _template(self, si: int, j: int) -> DeviceSegment:
+        """Shard ``si`` slot ``j`` padded to the slot layout — numpy arrays
+        + REAL host dictionaries (term/ordinal resolution)."""
+        lay = self._layouts[j]
+        view = self._views[si]
+        seg = view.segments[j] if j < len(view.segments) else None
+        live = view.live_masks[j] if seg is not None else None
+        n = lay.np_docs
+        text = {}
+        for name, (L, U) in lay.text.items():
+            c = seg.text_fields.get(name) if seg is not None else None
+            if c is None:
+                c = TextFieldColumn(
+                    terms=[], tokens=np.full((n, L), -1, np.int32),
+                    uterms=np.full((n, U), -1, np.int32),
+                    utf=np.zeros((n, U), np.float32),
+                    doc_len=np.zeros(n, np.int32),
+                    df=np.zeros(1, np.int32), total_tokens=0)
+                text[name] = DeviceTextField(
+                    tokens=c.tokens, uterms=c.uterms, utf=c.utf,
+                    doc_len=c.doc_len, column=c)
+            else:
+                text[name] = DeviceTextField(
+                    tokens=_pad2(c.tokens, n, L, -1),
+                    uterms=_pad2(c.uterms, n, U, -1),
+                    utf=_pad2(c.utf, n, U, 0.0),
+                    doc_len=_pad1(c.doc_len, n, 0), column=c)
+        keyword = {}
+        for name, kdim in lay.keyword.items():
+            c = seg.keyword_fields.get(name) if seg is not None else None
+            if c is None:
+                c = KeywordFieldColumn(vocab=[],
+                                       ords=np.full((n, kdim), -1, np.int32))
+            keyword[name] = DeviceKeywordField(
+                ords=_pad2(c.ords, n, kdim, -1), column=c)
+        numeric = {}
+        for name in lay.numeric:
+            c = seg.numeric_fields.get(name) if seg is not None else None
+            if c is None:
+                hi = np.zeros(n, np.float32)
+                lo = np.zeros(n, np.float32)
+                exists = np.zeros(n, bool)
+            else:
+                hi, lo = dd_split(c.values)
+                hi, lo = _pad1(hi, n, 0.0), _pad1(lo, n, 0.0)
+                exists = _pad1(c.exists, n, False)
+            numeric[name] = DeviceNumericField(hi=hi, lo=lo, exists=exists,
+                                               column=c)
+        live_p = _pad1(live, n, False) if live is not None \
+            else np.zeros(n, bool)
+        host_seg = seg if seg is not None else Segment(
+            seg_id=-1, num_docs=0, padded_docs=n, ids=[], sources=[],
+            text_fields={}, keyword_fields={}, numeric_fields={},
+            vector_fields={}, geo_fields={})
+        return DeviceSegment(seg=host_seg, live=live_p,
+                             doc_base=self.slot_bases[j], text=text,
+                             keyword=keyword, numeric=numeric, vector={},
+                             geo={})
+
+    # ---- statistics (the DFS round, host-side) ----------------------------
+
+    def _global_dfs(self, queries: list) -> dict:
+        shard_results = []
+        for si in range(self.n_shards):
+            from elasticsearch_tpu.search.query_dsl import BoolQuery
+            reader = _TemplateReader(self._templates[si], self._views[si])
+            shard_results.append(dfs_mod.shard_dfs(
+                reader, self.mapper_service, BoolQuery(must=list(queries))))
+        return dfs_mod.to_execution_stats(
+            dfs_mod.aggregate_dfs(shard_results))
+
+    # ---- the program ------------------------------------------------------
+
+    def _program(self, sigs, layouts, k: int, b_pad: int, specs_per_slot,
+                 emits, refss, templates0):
+        key = (tuple(sigs), tuple(layouts), k, b_pad)
+        fn = self._programs.get(key)
+        if fn is not None:
+            return fn
+        n_slots = self.n_slots
+        slot_bases = self.slot_bases
+        stride = self.shard_stride
+
+        def step_local(flats, consts):
+            # flats[j]: arrays [1, Np_j, ...]; consts[j]: [1, B_local, ...]
+            shard_idx = jax.lax.axis_index("shard").astype(jnp.int32)
+            seg_scores, seg_docs, counts = [], [], None
+            for j in range(n_slots):
+                view = seg_rebuild(templates0[j],
+                                   [a[0] for a in flats[j]])
+
+                def one(cs, j=j, view=view):
+                    return _build(view, list(cs), emits[j], None, refss[j],
+                                  _FLAGS, k)
+
+                outs = jax.vmap(one)(
+                    jax.tree.map(lambda a: a[0], consts[j]))
+                docs = jnp.where(outs["top_docs"] >= 0,
+                                 outs["top_docs"] + slot_bases[j], -1)
+                seg_scores.append(outs["top_scores"])
+                seg_docs.append(docs)
+                counts = outs["count"] if counts is None \
+                    else counts + outs["count"]
+            scores = jnp.concatenate(seg_scores, axis=1)    # [B, slots*k]
+            docs = jnp.concatenate(seg_docs, axis=1)
+            kk = min(k, scores.shape[1])
+            top_s, idx = jax.lax.top_k(
+                jnp.where(docs >= 0, scores, -jnp.inf), kk)
+            top_d = jnp.take_along_axis(docs, idx, axis=1)
+            top_d = jnp.where(top_s > -jnp.inf,
+                              top_d + shard_idx * stride, -1)
+            if kk < k:
+                top_s = jnp.pad(top_s, ((0, 0), (0, k - kk)),
+                                constant_values=-jnp.inf)
+                top_d = jnp.pad(top_d, ((0, 0), (0, k - kk)),
+                                constant_values=-1)
+            # ---- reduce over ICI: counts psum + all_gather re-top-k -----
+            totals = jax.lax.psum(counts, "shard")          # [B_local]
+            all_s = jax.lax.all_gather(top_s, "shard")      # [S, B, k]
+            all_d = jax.lax.all_gather(top_d, "shard")
+            s_ax = all_s.shape[0]
+            flat_s = jnp.moveaxis(all_s, 0, 1).reshape(-1, s_ax * k)
+            flat_d = jnp.moveaxis(all_d, 0, 1).reshape(-1, s_ax * k)
+            g_s, pos = jax.lax.top_k(
+                jnp.where(flat_d >= 0, flat_s, -jnp.inf), k)
+            g_d = jnp.take_along_axis(flat_d, pos, axis=1)
+            g_d = jnp.where(g_s > -jnp.inf, g_d, -1)
+            g_s = jnp.where(g_s > -jnp.inf, g_s, -jnp.inf)
+            return g_s, g_d, totals
+
+        flat_specs = [[P("shard")] * len(self._flats[j])
+                      for j in range(n_slots)]
+        const_specs = [jax.tree.map(lambda _: P("shard", "dp"),
+                                    specs_per_slot[j])
+                       for j in range(n_slots)]
+        mapped = shard_map(
+            step_local, mesh=self.mesh,
+            in_specs=(flat_specs, const_specs),
+            out_specs=(P("dp"), P("dp"), P("dp")),
+            check_vma=False)
+        fn = jax.jit(mapped)
+        self._programs[key] = fn
+        return fn
+
+    def search_batch(self, bodies: list[dict], ):
+        """Execute B query-DSL request bodies (score-ordered top-k shapes)
+        as one mesh program → list of {"total", "scores", "doc_ids"} with
+        GLOBAL doc ids (resolve via :meth:`resolve`)."""
+        if not bodies:
+            return []
+        reqs = [parse_search_request(b) for b in bodies]
+        for req in reqs:
+            if (req.aggs or req.sort or req.post_filter is not None
+                    or req.min_score is not None
+                    or req.search_after is not None or req.suggest
+                    or req.terminate_after is not None
+                    or req.timeout_ms is not None):
+                raise QueryParsingError(
+                    "mesh engine plane supports score-ordered top-k "
+                    "requests — route others to the RPC path")
+        k = max(max(r.from_ + r.size, 1) for r in reqs)
+        queries = [r.query for r in reqs]
+        dfs_stats = self._global_dfs(queries)
+        dp = self.mesh.shape["dp"]
+        b_real = len(queries)
+        b_pad = -(-b_real // dp) * dp
+        queries_p = queries + [queries[-1]] * (b_pad - b_real)
+
+        # resolve every (shard, slot, query): consts [S, B, ...]; signature
+        # must agree across shards AND queries per slot (uniform field
+        # layout makes shard structure uniform; mixed query structures are
+        # rejected like run_segment_batch's None)
+        sigs, layouts, emits, refss, specs_per_slot = [], [], [], [], []
+        consts_dev = []
+        q_sharding = NamedSharding(self.mesh, P("shard", "dp"))
+        for j in range(self.n_slots):
+            sig_j = emit_j = refs_j = None
+            rows = []                      # [S][B] → list of const arrays
+            for si in range(self.n_shards):
+                ctx = ExecutionContext(
+                    reader=_TemplateReader(self._templates[si],
+                                           self._views[si]),
+                    mapper_service=self.mapper_service,
+                    bm25=self._bm25,
+                    dfs_stats=dfs_stats)
+                row = []
+                for query in queries_p:
+                    ct, emit_q, _, refs = _plan(
+                        self._templates[si][j], ctx, query, None, _FLAGS)
+                    if sig_j is None:
+                        sig_j, emit_j, refs_j = ct.signature(), emit_q, refs
+                    elif ct.signature() != sig_j:
+                        raise QueryParsingError(
+                            "mesh engine plane requires one plan signature "
+                            "per batch (mixed query structures)")
+                    row.append(ct.values)
+                rows.append(row)
+            n_c = len(rows[0][0])
+            stacked = tuple(
+                jax.device_put(
+                    np.stack([np.stack([rows[si][bi][i]
+                                        for bi in range(b_pad)])
+                              for si in range(self.n_shards)]),
+                    q_sharding)
+                for i in range(n_c))
+            sigs.append(sig_j)
+            layouts.append(layout_key(self._templates[0][j]))
+            emits.append(emit_j)
+            refss.append(refs_j)
+            specs_per_slot.append(stacked)
+            consts_dev.append(stacked)
+
+        fn = self._program(sigs, layouts, k, b_pad, specs_per_slot,
+                           emits, refss,
+                           [self._templates[0][j]
+                            for j in range(self.n_slots)])
+        g_s, g_d, totals = fn(self._flats, consts_dev)
+        g_s, g_d = np.asarray(g_s), np.asarray(g_d)
+        totals = np.asarray(totals)
+        out = []
+        for bi, req in enumerate(reqs):
+            kq = max(req.from_ + req.size, 1)
+            valid = g_d[bi] >= 0
+            out.append({"total": int(totals[bi]),
+                        "scores": g_s[bi][valid][:kq],
+                        "doc_ids": g_d[bi][valid][:kq]})
+        return out
+
+    # ---- doc id resolution ------------------------------------------------
+
+    def resolve(self, global_doc: int) -> tuple[int, int, int]:
+        """global doc id → (shard, slot, local row)."""
+        si, local = divmod(int(global_doc), self.shard_stride)
+        for j in reversed(range(self.n_slots)):
+            if local >= self.slot_bases[j]:
+                return si, j, local - self.slot_bases[j]
+        raise IndexError(global_doc)
+
+    def doc_id(self, global_doc: int) -> str:
+        si, j, row = self.resolve(global_doc)
+        return self._views[si].segments[j].ids[row]
+
+
+def rpc_oracle(mapper_service, engines: list, body: dict,
+               k: int) -> tuple[int, list]:
+    """The host-path reference the mesh program must match bit-exactly:
+    per-shard ShardSearcher with globally aggregated DFS statistics, then
+    a coordinator-ordered merge ((-score, shard) like TopDocs.merge).
+    → (total_hits, [(score, shard, doc_id), ...][:k]). Used by
+    tests/test_mesh_engine.py and __graft_entry__.dryrun_multichip."""
+    from elasticsearch_tpu.index.device_reader import DeviceReader
+    from elasticsearch_tpu.search.phase import ShardSearcher
+    from elasticsearch_tpu.search.query_dsl import parse_query
+    readers = [DeviceReader(e.acquire_searcher()) for e in engines]
+    query = parse_query(body.get("query"))
+    stats = dfs_mod.to_execution_stats(dfs_mod.aggregate_dfs(
+        [dfs_mod.shard_dfs(r, mapper_service, query) for r in readers]))
+    req = parse_search_request(body)
+    rows: list[tuple[float, int, str]] = []
+    total = 0
+    for si, r in enumerate(readers):
+        res = ShardSearcher(si, r, mapper_service,
+                            dfs_stats=stats).query_phase(req)
+        total += res.total
+        for pos in range(len(res.doc_ids)):
+            seg, local = r.resolve(int(res.doc_ids[pos]))
+            rows.append((float(res.scores[pos]), si, seg.seg.ids[local]))
+    rows.sort(key=lambda x: (-x[0], x[1]))
+    return total, rows[:k]
+
+
+class _TemplateReader:
+    """Reader facade over one shard's padded templates — df/text stats for
+    resolution and the DFS round."""
+
+    def __init__(self, templates, view):
+        self.segments = templates          # DeviceSegment-shaped
+        self._view = view
+
+    @property
+    def num_docs(self) -> int:
+        return self._view.num_docs
+
+    def text_stats(self, field: str):
+        from elasticsearch_tpu.index.device_reader import TextFieldStats
+        doc_count = docs_with = total = 0
+        for seg in self._view.segments:
+            c = seg.text_fields.get(field)
+            if c is not None:
+                doc_count += seg.num_docs
+                docs_with += int((c.doc_len[:seg.num_docs] > 0).sum())
+                total += c.total_tokens
+        return TextFieldStats(doc_count, docs_with, total)
+
+    def df(self, field: str, term: str) -> int:
+        out = 0
+        for seg in self._view.segments:
+            c = seg.text_fields.get(field)
+            if c is not None:
+                tid = c.tid(term)
+                if tid >= 0:
+                    out += int(c.df[tid])
+        return out

@@ -458,7 +458,8 @@ class Handlers:
     def search(self, req: RestRequest):
         resp = self.node.search(req.path_params["index"],
                                 self._search_body(req),
-                                scroll=req.param("scroll"))
+                                scroll=req.param("scroll"),
+                                search_type=req.param("search_type"))
         return 200, resp
 
     def search_all(self, req: RestRequest):
@@ -468,7 +469,8 @@ class Handlers:
                          "hits": {"total": {"value": 0, "relation": "eq"},
                                   "max_score": None, "hits": []}}
         resp = self.node.search("_all", self._search_body(req),
-                                scroll=req.param("scroll"))
+                                scroll=req.param("scroll"),
+                                search_type=req.param("search_type"))
         return 200, resp
 
     def count(self, req: RestRequest):

@@ -1,0 +1,136 @@
+"""DFS phase — global term statistics for dfs_query_then_fetch.
+
+Reference: core/search/dfs/DfsPhase.java:45 collects each shard's term and
+collection statistics for the query's terms; the coordinator aggregates
+them (aggregateDfs, core/search/controller/SearchPhaseController.java:
+105-154) and the query phase then scores every shard with the SAME global
+idf/avgdl — so multi-shard results are bit-identical to a single-shard
+index over the same corpus.
+
+Here the shard side walks the query AST host-side (the same analysis the
+resolver performs), returns df per (field, term) plus per-field collection
+stats, and the merged statistics flow into resolution through
+``ExecutionContext.dfs_stats`` (execute.SegmentResolver._term_stats).
+On-mesh (shard_map) execution gets the identical effect from a psum over
+the df vectors (parallel/distributed.py); this host-side round serves the
+RPC fan-out path.
+"""
+
+from __future__ import annotations
+
+from elasticsearch_tpu.search import query_dsl as q
+
+# wire key separator: (field, term) → "field\x00term" (JSON-safe)
+_SEP = "\x00"
+
+
+def _analyzer_for(mapper_service, field: str, override: str | None):
+    if override:
+        return mapper_service.analysis.get(override)
+    fm = mapper_service.field_mapper(field)
+    if fm is not None and getattr(fm, "kind", None) == "text":
+        return fm.search_analyzer
+    return mapper_service.analysis.get("standard")
+
+
+def collect_terms(query: q.Query, text_fields: set[str],
+                  mapper_service) -> set[tuple[str, str]]:
+    """→ {(field, term)} — every analyzed term whose idf affects scoring.
+
+    Mirrors the resolver's analysis exactly (same analyzers, same
+    all-fields expansion) so the DFS round covers precisely the statistics
+    the query phase will look up.
+    """
+    out: set[tuple[str, str]] = set()
+
+    def fields_of(f: str) -> list[str]:
+        return sorted(text_fields) if f in ("*", "_all") else [f]
+
+    def walk(node: q.Query | None):
+        if node is None:
+            return
+        t = type(node).__name__
+        if t == "MatchQuery":
+            for f in fields_of(node.field):
+                an = _analyzer_for(mapper_service, f, node.analyzer)
+                out.update((f, tok.term) for tok in an.analyze(node.text))
+        elif t == "MatchPhraseQuery":
+            for f in fields_of(node.field):
+                an = _analyzer_for(mapper_service, f, node.analyzer)
+                out.update((f, tok.term) for tok in an.analyze(node.text))
+        elif t == "MultiMatchQuery":
+            for fspec in node.fields:
+                fname = fspec.partition("^")[0]
+                for f in fields_of(fname):
+                    an = _analyzer_for(mapper_service, f, None)
+                    out.update((f, tok.term)
+                               for tok in an.analyze(node.text))
+        elif t == "TermQuery":
+            if node.field in text_fields:
+                # resolver scores text terms via a keyword-analyzed match
+                out.add((node.field, str(node.value)))
+        elif t == "BoolQuery":
+            for sub in (*node.must, *node.should, *node.must_not,
+                        *node.filter):
+                walk(sub)
+        elif t == "ConstantScoreQuery":
+            walk(node.filter_query)
+        elif t == "FunctionScoreQuery":
+            walk(node.query)
+            for fn in node.functions:
+                walk(fn.filter_query)
+        elif t == "ScriptScoreQuery":
+            walk(node.query)
+        # other leaf types (range/terms/prefix/...) are constant-score:
+        # no idf in their scores
+    walk(query)
+    return out
+
+
+def shard_dfs(reader, mapper_service, query: q.Query) -> dict:
+    """Shard-side DFS collection (DfsPhase.execute analog) → wire-safe
+    {"df": {"field\\x00term": n}, "fields": {field: [doc_count,
+    docs_with_field, total_tokens]}}."""
+    text_fields = set()
+    for seg in reader.segments:
+        text_fields.update(seg.text)
+    terms = collect_terms(query, text_fields, mapper_service)
+    df = {f"{f}{_SEP}{t}": reader.df(f, t) for f, t in terms}
+    fields = {}
+    for f in {f for f, _ in terms}:
+        st = reader.text_stats(f)
+        fields[f] = [st.doc_count, st.docs_with_field, st.total_tokens]
+    return {"df": df, "fields": fields}
+
+
+def aggregate_dfs(shard_results: list[dict]) -> dict:
+    """Coordinator reduce (aggregateDfs analog) → the wire form passed to
+    every shard's query phase."""
+    df: dict[str, int] = {}
+    fields: dict[str, list[int]] = {}
+    for r in shard_results:
+        for key, n in r.get("df", {}).items():
+            df[key] = df.get(key, 0) + int(n)
+        for f, (dc, dwf, tt) in r.get("fields", {}).items():
+            cur = fields.setdefault(f, [0, 0, 0])
+            cur[0] += int(dc)
+            cur[1] += int(dwf)
+            cur[2] += int(tt)
+    return {"df": df, "fields": fields}
+
+
+def to_execution_stats(wire: dict | None) -> dict | None:
+    """Wire form → ExecutionContext.dfs_stats ({(field, term): df},
+    per-field doc_count and avgdl)."""
+    if not wire:
+        return None
+    df = {}
+    for key, n in wire.get("df", {}).items():
+        f, _, t = key.partition(_SEP)
+        df[(f, t)] = int(n)
+    doc_count = {}
+    avgdl = {}
+    for f, (dc, dwf, tt) in wire.get("fields", {}).items():
+        doc_count[f] = int(dc)
+        avgdl[f] = tt / max(dwf, 1)
+    return {"df": df, "doc_count": doc_count, "avgdl": avgdl}

@@ -110,10 +110,11 @@ class ExecutionContext:
     mapper_service: Any
     bm25: BM25Params = BM25Params()
     # Optional global term statistics (DFS_QUERY_THEN_FETCH,
-    # core/search/dfs/DfsPhase.java:45): {"doc_count": int,
-    # "df": {(field, term): int}, "avgdl": {field: float}}. When set, idf
-    # and avgdl come from here instead of the shard-local reader, so every
-    # shard scores with identical statistics.
+    # core/search/dfs/DfsPhase.java:45), produced by search/dfs.py:
+    # {"df": {(field, term): int}, "doc_count": {field: int},
+    # "avgdl": {field: float}}. When set, idf and avgdl come from here
+    # instead of the shard-local reader, so every shard scores with
+    # identical statistics.
     dfs_stats: dict | None = None
 
 
@@ -185,11 +186,16 @@ class SegmentResolver:
     def _term_stats(self, field: str, term: str) -> tuple[int, int]:
         """→ (df, doc_count), from global DFS statistics when present
         (aggregateDfs, core/search/controller/SearchPhaseController.java:105)
-        else from the shard-local reader."""
+        else from the shard-local reader. A term the DFS round did not
+        cover falls back to local stats (graceful, like a stale
+        AggregatedDfs entry)."""
         dfs = self.ctx.dfs_stats
-        if dfs is not None:
-            return (int(dfs["df"].get((field, term), 0)),
-                    int(dfs["doc_count"]))
+        if dfs is not None and (field, term) in dfs["df"]:
+            doc_count = dfs["doc_count"].get(field)
+            if doc_count is None:
+                doc_count = max(self.ctx.reader.text_stats(field).doc_count,
+                                1)
+            return int(dfs["df"][(field, term)]), max(int(doc_count), 1)
         st = self.ctx.reader.text_stats(field)
         return self.ctx.reader.df(field, term), max(st.doc_count, 1)
 

@@ -121,7 +121,8 @@ class ShardSearcher:
     """Per-shard query execution over a DeviceReader."""
 
     def __init__(self, shard_id: int, reader: DeviceReader, mapper_service,
-                 index_name: str = "", doc_slot: int | None = None):
+                 index_name: str = "", doc_slot: int | None = None,
+                 dfs_stats: dict | None = None):
         self.shard_id = shard_id
         self.reader = reader
         self.mapper_service = mapper_service
@@ -137,7 +138,9 @@ class ShardSearcher:
             doc_slot = ((zlib.crc32(index_name.encode()) * 31 + shard_id)
                         & 0x7FF)
         self._doc_slot = doc_slot & 0x7FF
-        self.ctx = ExecutionContext(reader=reader, mapper_service=mapper_service)
+        self.ctx = ExecutionContext(reader=reader,
+                                    mapper_service=mapper_service,
+                                    dfs_stats=dfs_stats)
 
     # -- mask/scores over every segment --------------------------------------
 
