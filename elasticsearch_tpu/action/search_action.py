@@ -60,6 +60,7 @@ class _ScrollContext:
         self.index_expr = index_expr
         self.body = dict(body)
         self.search_type = search_type
+        self.dfs_cache: dict = {}
         self.keep_alive_s = keep_alive_s
         self.expires_at = time.monotonic() + keep_alive_s
         self.last_sort_key: list | None = None
@@ -69,6 +70,79 @@ class _ScrollContext:
         if keep_alive_s is not None:
             self.keep_alive_s = keep_alive_s
         self.expires_at = time.monotonic() + self.keep_alive_s
+
+
+def rewrite_mlt_likes(node, body: dict, default_index: str = "_all") -> dict:
+    """Coordinator-side more_like_this rewrite: liked DOCUMENTS are fetched
+    here (routing-aware GET, any shard/node) and turned into like-texts +
+    `_exclude_ids`, so every shard scores them — a shard-local source scan
+    would silently match nothing on shards not hosting the liked doc.
+    The reference does the same (liked docs are fetched before query
+    construction, core/index/query/MoreLikeThisQueryParser.java). Missing
+    docs are skipped, as are dicts without _id. Returns a rewritten copy
+    (the input body is not mutated); bodies without doc-likes pass through
+    unchanged."""
+    def walk(obj):
+        if isinstance(obj, list):
+            return [walk(v) for v in obj]
+        if not isinstance(obj, dict):
+            return obj
+        out = {}
+        for key, val in obj.items():
+            if key in ("more_like_this", "mlt") and isinstance(val, dict) \
+                    and _mlt_has_docs(val):
+                out[key] = _fetch_mlt_likes(node, val, default_index)
+            else:
+                out[key] = walk(val)
+        return out
+    return walk(body)
+
+
+def _mlt_has_docs(spec: dict) -> bool:
+    raw = spec.get("like", spec.get("like_text"))
+    likes = raw if isinstance(raw, list) else [raw] if raw is not None else []
+    return any(isinstance(x, dict) for x in likes) or \
+        bool(spec.get("ids") or spec.get("docs"))
+
+
+def _fetch_mlt_likes(node, spec: dict, default_index: str) -> dict:
+    spec = dict(spec)
+    raw_like = spec.pop("like", None)
+    raw_like_text = spec.pop("like_text", None)
+    raw = raw_like if raw_like is not None else raw_like_text
+    likes = raw if isinstance(raw, list) else [raw] if raw is not None else []
+    raw_ids = spec.pop("ids", None) or []
+    raw_docs = spec.pop("docs", None) or []
+    for did in list(raw_ids) + list(raw_docs):
+        likes.append(did if isinstance(did, dict) else {"_id": did})
+    texts: list = []
+    exclude = list(spec.get("_exclude_ids", []))
+    fields = spec.get("fields") or []
+    for item in likes:
+        if not isinstance(item, dict):
+            texts.append(item)
+            continue
+        did = item.get("_id")
+        if did is None:
+            continue
+        index = item.get("_index", default_index)
+        try:
+            got = node.document_actions.get_doc(index, str(did))
+        except Exception:                  # noqa: BLE001 — missing doc/index
+            continue
+        if not got.get("found"):
+            continue
+        src = got.get("_source") or {}
+        for f in (fields or [k for k, v in src.items()
+                             if isinstance(v, str)]):
+            v = src.get(f)
+            if isinstance(v, str):
+                texts.append(v)
+        exclude.append(str(did))
+    spec["like"] = texts
+    if exclude:
+        spec["_exclude_ids"] = exclude
+    return spec
 
 
 class SearchActions:
@@ -258,14 +332,17 @@ class SearchActions:
             search_type = "dfs_query_then_fetch"
         t0 = time.perf_counter()
         body = dict(body or {})
+        dfs_cache: dict | None = {} if scroll is not None else None
         if scroll is not None:
             body["sort"] = self._scroll_sort(body.get("sort"))
         resp = self._search_once(index_expr, body, t0,
-                                 search_type=search_type)
+                                 search_type=search_type,
+                                 dfs_cache=dfs_cache)
         if scroll is not None:
             resp["_scroll_id"] = self._open_scroll(index_expr, body, scroll,
                                                    resp,
-                                                   search_type=search_type)
+                                                   search_type=search_type,
+                                                   dfs_cache=dfs_cache)
         return resp
 
     def _dfs_phase(self, state, groups, body: dict) -> dict:
@@ -287,14 +364,26 @@ class SearchActions:
         return aggregate_dfs(results)
 
     def _search_once(self, index_expr: str, body: dict, t0: float,
-                     search_type: str | None = None) -> dict:
+                     search_type: str | None = None,
+                     dfs_cache: dict | None = None) -> dict:
         names = self.node.indices_service.resolve(index_expr)
+        body = rewrite_mlt_likes(self.node, body,
+                                 names[0] if names else "_all")
         state = self.node.cluster_service.state()
         req = parse_search_request(body)
         groups = self._shard_groups(state, names)
         dfs = None
         if search_type == "dfs_query_then_fetch":
-            dfs = self._dfs_phase(state, groups, body)
+            # scroll contexts reuse the stats gathered for page one: the
+            # reference keeps AggregatedDfs in the search context — fresh
+            # stats per page would cost S extra RPCs per page and could
+            # shift scores across the search_after boundary mid-scroll
+            if dfs_cache is not None and "wire" in dfs_cache:
+                dfs = dfs_cache["wire"]
+            else:
+                dfs = self._dfs_phase(state, groups, body)
+                if dfs_cache is not None:
+                    dfs_cache["wire"] = dfs
         # dense, deterministic _doc slots per (index, shard): sorted so a
         # scroll's later pages (same index set) assign identical slots
         slot_of = {(n, s): i for i, (n, s) in
@@ -476,9 +565,11 @@ class SearchActions:
         return sort
 
     def _open_scroll(self, index_expr: str, body: dict, scroll: str,
-                     first_page: dict, search_type: str | None = None) -> str:
+                     first_page: dict, search_type: str | None = None,
+                     dfs_cache: dict | None = None) -> str:
         keep = parse_time_value(scroll, "scroll")
         ctx = _ScrollContext(index_expr, body, keep, search_type=search_type)
+        ctx.dfs_cache = dfs_cache if dfs_cache is not None else {}
         self._note_page(ctx, first_page)
         with self._lock:
             cid = f"ctx{next(self._ctx_ids)}"
@@ -520,7 +611,8 @@ class SearchActions:
         if ctx.last_sort_key is not None:
             body["search_after"] = ctx.last_sort_key
         resp = self._search_once(ctx.index_expr, body, time.perf_counter(),
-                                 search_type=ctx.search_type)
+                                 search_type=ctx.search_type,
+                                 dfs_cache=ctx.dfs_cache)
         self._note_page(ctx, resp)
         resp["_scroll_id"] = scroll_id
         return resp

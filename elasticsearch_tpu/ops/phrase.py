@@ -45,6 +45,17 @@ def phrase_freq(tokens, qtids: list, deltas: list[int]):
     return window.sum(axis=1).astype(jnp.float32)
 
 
+def freq_score(freq, doc_len, sum_idf, k1, b, avgdl):
+    """BM25 over a positional frequency (tf = freq, idf = Σ idf of the
+    participating terms — Lucene PhraseWeight/SpanWeight combined stats).
+
+    Returns (scores[N] f32, mask[N] bool)."""
+    norm = k1 * (1.0 - b + b * doc_len.astype(jnp.float32) / avgdl)
+    tf_norm = freq * (k1 + 1.0) / (freq + norm)
+    mask = freq > 0
+    return jnp.where(mask, sum_idf * tf_norm, 0.0), mask
+
+
 def phrase_score(tokens, doc_len, qtids: list, deltas: list[int],
                  sum_idf, k1, b, avgdl):
     """BM25 phrase scoring: tf = phrase frequency, idf = Σ idf(term)
@@ -52,32 +63,21 @@ def phrase_score(tokens, doc_len, qtids: list, deltas: list[int],
 
     Returns (scores[N] f32, mask[N] bool)."""
     freq = phrase_freq(tokens, qtids, deltas)
-    norm = k1 * (1.0 - b + b * doc_len.astype(jnp.float32) / avgdl)
-    tf_norm = freq * (k1 + 1.0) / (freq + norm)
-    mask = freq > 0
-    return jnp.where(mask, sum_idf * tf_norm, 0.0), mask
+    return freq_score(freq, doc_len, sum_idf, k1, b, avgdl)
 
 
 _INF_SLOP = jnp.float32(1e9)
 
 
-def sloppy_phrase_freq(tokens, qtids: list, deltas: list[int], slop: int):
-    """Proximity-weighted sloppy phrase frequency — Lucene
-    SloppyPhraseScorer semantics for in-order matches: each match at total
-    displacement d (sum of per-term forward shifts from the exact-phrase
-    positions) contributes ``1 / (d + 1)`` to the phrase frequency
-    (SloppyPhraseScorer.sloppyFreq: 1/(1+matchLength)).
-
-    Matches are ANCHORED at the first term's actual position (its shift is
-    pinned to 0) so each occurrence is counted exactly once; every later
-    term takes its NEAREST admissible position (min shift in [0, slop]),
-    and the match is valid when the summed displacement ≤ slop. Deviations
-    from Lucene, documented: out-of-order matches (terms moving backwards)
-    are not found, and a phrase repeating one term can map two query terms
-    onto one token position.
-
-    Returns freq[N] f32.
-    """
+def _sloppy_displacement(tokens, qtids: list, deltas: list[int], slop: int):
+    """→ [N, L] total displacement of the best in-order match anchored at
+    each start position (> slop ⇒ no match there). Matches are ANCHORED at
+    the first term's actual position (its shift pinned to 0) so each
+    occurrence is counted exactly once; every later term takes its NEAREST
+    admissible position (min shift in [0, slop]). Deviations from Lucene,
+    documented: out-of-order matches (terms moving backwards) are not
+    found, and a phrase repeating one term can map two query terms onto
+    one token position."""
     total = None
     for i, (tid, d) in enumerate(zip(qtids, deltas)):
         shifts = (0,) if i == 0 else range(slop + 1)
@@ -87,8 +87,34 @@ def sloppy_phrase_freq(tokens, qtids: list, deltas: list[int], slop: int):
             cand = jnp.where(h, jnp.float32(s), _INF_SLOP)
             best = cand if best is None else jnp.minimum(best, cand)
         total = best if total is None else total + best
+    return total
+
+
+def sloppy_phrase_freq(tokens, qtids: list, deltas: list[int], slop: int):
+    """Proximity-weighted sloppy phrase frequency — Lucene
+    SloppyPhraseScorer semantics for in-order matches: each match at total
+    displacement d contributes ``1 / (d + 1)`` to the phrase frequency
+    (SloppyPhraseScorer.sloppyFreq: 1/(1+matchLength)).
+
+    Returns freq[N] f32. See :func:`_sloppy_displacement` for anchoring
+    semantics and documented deviations.
+    """
+    total = _sloppy_displacement(tokens, qtids, deltas, slop)
     valid = total <= slop
     return jnp.where(valid, 1.0 / (1.0 + total), 0.0).sum(axis=1)
+
+
+def sloppy_phrase_count(tokens, qtids: list, deltas: list[int], slop: int):
+    """Number of in-order matches within the slop budget (each anchored
+    occurrence counts 1, NOT the 1/(1+d) sloppyFreq weight) — span_near's
+    frequency semantics (NearSpansOrdered enumerates spans; SpanScorer
+    then weighs each by sloppyFreq, which this implementation simplifies
+    to plain counting, documented in the span_near resolver).
+
+    Returns freq[N] f32.
+    """
+    total = _sloppy_displacement(tokens, qtids, deltas, slop)
+    return (total <= slop).sum(axis=1).astype(jnp.float32)
 
 
 def sloppy_phrase_score(tokens, doc_len, qtids: list, deltas: list[int],
@@ -98,8 +124,27 @@ def sloppy_phrase_score(tokens, doc_len, qtids: list, deltas: list[int],
 
     Returns (scores[N] f32, mask[N] bool)."""
     freq = sloppy_phrase_freq(tokens, qtids, deltas, slop)
-    norm = k1 * (1.0 - b + b * doc_len.astype(jnp.float32) / avgdl)
-    tf_norm = freq * (k1 + 1.0) / (freq + norm)
-    mask = freq > 0
-    sum_idf = jnp.asarray(idfs).sum()
-    return jnp.where(mask, sum_idf * tf_norm, 0.0), mask
+    return freq_score(freq, doc_len, jnp.asarray(idfs).sum(), k1, b, avgdl)
+
+
+def span_near_freq_unordered(tokens, qtids: list, slop: int):
+    """Unordered span-near frequency (Lucene NearSpansUnordered analog): a
+    span starts at position ``p`` when EVERY clause term occurs somewhere
+    in the window ``tokens[p : p+T+slop]``; runs of overlapping starts
+    collapse to their first position so each distinct region counts once.
+    Deviations from Lucene, documented: per-span width does not feed a
+    sloppyFreq weighting (plain freq scoring), and two clause terms may
+    map onto one token occurrence when the phrase repeats a term.
+
+    Returns freq[N] f32.
+    """
+    window = len(qtids) + slop
+    match = None
+    for tid in qtids:
+        present = None
+        for d in range(window):
+            h = (_shift_left(tokens, d) == tid) & (tid >= 0)
+            present = h if present is None else (present | h)
+        match = present if match is None else (match & present)
+    prev = jnp.pad(match[:, :-1], ((0, 0), (1, 0)), constant_values=False)
+    return (match & ~prev).sum(axis=1).astype(jnp.float32)

@@ -222,7 +222,7 @@ class MeshEngineSearcher:
 
     # ---- the program ------------------------------------------------------
 
-    def _program(self, sigs, layouts, k: int, b_pad: int, specs_per_slot,
+    def _program(self, sigs, layouts, k: int, b_pad: int, consts_tree,
                  emits, refss, templates0):
         key = (tuple(sigs), tuple(layouts), k, b_pad)
         fn = self._programs.get(key)
@@ -282,7 +282,7 @@ class MeshEngineSearcher:
         flat_specs = [[P("shard")] * len(self._flats[j])
                       for j in range(n_slots)]
         const_specs = [jax.tree.map(lambda _: P("shard", "dp"),
-                                    specs_per_slot[j])
+                                    consts_tree[j])
                        for j in range(n_slots)]
         mapped = shard_map(
             step_local, mesh=self.mesh,
@@ -321,7 +321,7 @@ class MeshEngineSearcher:
         # must agree across shards AND queries per slot (uniform field
         # layout makes shard structure uniform; mixed query structures are
         # rejected like run_segment_batch's None)
-        sigs, layouts, emits, refss, specs_per_slot = [], [], [], [], []
+        sigs, layouts, emits, refss = [], [], [], []
         consts_dev = []
         q_sharding = NamedSharding(self.mesh, P("shard", "dp"))
         for j in range(self.n_slots):
@@ -358,10 +358,9 @@ class MeshEngineSearcher:
             layouts.append(layout_key(self._templates[0][j]))
             emits.append(emit_j)
             refss.append(refs_j)
-            specs_per_slot.append(stacked)
             consts_dev.append(stacked)
 
-        fn = self._program(sigs, layouts, k, b_pad, specs_per_slot,
+        fn = self._program(sigs, layouts, k, b_pad, consts_dev,
                            emits, refss,
                            [self._templates[0][j]
                             for j in range(self.n_slots)])

@@ -34,41 +34,69 @@ def _analyzer_for(mapper_service, field: str, override: str | None):
 
 
 def collect_terms(query: q.Query, text_fields: set[str],
-                  mapper_service) -> set[tuple[str, str]]:
+                  mapper_service, reader=None) -> set[tuple[str, str]]:
     """→ {(field, term)} — every analyzed term whose idf affects scoring.
 
     Mirrors the resolver's analysis exactly (same analyzers, same
     all-fields expansion) so the DFS round covers precisely the statistics
-    the query phase will look up.
+    the query phase will look up. ``reader`` (optional) resolves
+    more_like_this liked-document sources.
     """
     out: set[tuple[str, str]] = set()
 
     def fields_of(f: str) -> list[str]:
         return sorted(text_fields) if f in ("*", "_all") else [f]
 
+    def analyze_into(f: str, text: str, analyzer_override=None):
+        an = _analyzer_for(mapper_service, f, analyzer_override)
+        out.update((f, tok.term) for tok in an.analyze(text))
+
     def walk(node: q.Query | None):
         if node is None:
             return
         t = type(node).__name__
-        if t == "MatchQuery":
+        if t in ("MatchQuery", "MatchPhraseQuery"):
             for f in fields_of(node.field):
-                an = _analyzer_for(mapper_service, f, node.analyzer)
-                out.update((f, tok.term) for tok in an.analyze(node.text))
-        elif t == "MatchPhraseQuery":
-            for f in fields_of(node.field):
-                an = _analyzer_for(mapper_service, f, node.analyzer)
-                out.update((f, tok.term) for tok in an.analyze(node.text))
+                analyze_into(f, node.text, node.analyzer)
         elif t == "MultiMatchQuery":
             for fspec in node.fields:
-                fname = fspec.partition("^")[0]
-                for f in fields_of(fname):
-                    an = _analyzer_for(mapper_service, f, None)
-                    out.update((f, tok.term)
-                               for tok in an.analyze(node.text))
-        elif t == "TermQuery":
+                for f in fields_of(fspec.partition("^")[0]):
+                    analyze_into(f, node.text)
+        elif t == "CommonTermsQuery":
+            for f in fields_of(node.field):
+                analyze_into(f, node.text, node.analyzer)
+        elif t in ("TermQuery", "SpanTermQuery"):
             if node.field in text_fields:
                 # resolver scores text terms via a keyword-analyzed match
-                out.add((node.field, str(node.value)))
+                out.add((node.field, str(getattr(node, "value"))))
+        elif t == "SpanNearQuery":
+            for c in node.clauses:
+                walk(c)
+        elif t == "MoreLikeThisQuery":
+            fields = node.fields or sorted(text_fields)
+            texts_by_field = {f: list(node.like_texts) for f in fields}
+            if reader is not None and node.like_docs:
+                wanted = {str(s.get("_id", "")) for s in node.like_docs}
+                for seg in reader.segments:
+                    host = getattr(seg, "seg", seg)
+                    for local, hid in enumerate(
+                            host.ids[:host.num_docs]):
+                        if hid in wanted:
+                            src = host.sources[local]
+                            for f in fields:
+                                if isinstance(src.get(f), str):
+                                    texts_by_field[f].append(src[f])
+            # all candidate terms — the resolver's df-based selection then
+            # reads GLOBAL stats, so coverage must precede selection
+            for f, texts in texts_by_field.items():
+                for text in texts:
+                    analyze_into(f, text)
+        elif t == "DisMaxQuery":
+            for sub in node.queries:
+                walk(sub)
+        elif t == "BoostingQuery":
+            walk(node.positive)
+            walk(node.negative)
         elif t == "BoolQuery":
             for sub in (*node.must, *node.should, *node.must_not,
                         *node.filter):
@@ -94,7 +122,7 @@ def shard_dfs(reader, mapper_service, query: q.Query) -> dict:
     text_fields = set()
     for seg in reader.segments:
         text_fields.update(seg.text)
-    terms = collect_terms(query, text_fields, mapper_service)
+    terms = collect_terms(query, text_fields, mapper_service, reader=reader)
     df = {f"{f}{_SEP}{t}": reader.df(f, t) for f, t in terms}
     fields = {}
     for f in {f for f, _ in terms}:

@@ -679,6 +679,250 @@ class SegmentResolver:
         mask_emit = self.resolve_mask(query.filter_query)
         return self._constant_mask_emit(mask_emit, query.boost)
 
+    def _res_DisMaxQuery(self, query: q.DisMaxQuery) -> Emit:
+        self.sig("dis_max", len(query.queries), query.tie_breaker > 0)
+        subs = [self.resolve(sub) for sub in query.queries]
+        if not subs:
+            return self._zeros()
+        r_tie = self.c(query.tie_breaker, np.float32) \
+            if query.tie_breaker > 0 else None
+        r_boost = self.c(query.boost, np.float32)
+
+        def emit(em):
+            best = total = mask = None
+            for sub in subs:
+                s, m = sub(em)
+                s = jnp.where(m, s, 0.0)
+                if best is None:
+                    best, total, mask = s, s, m
+                    continue
+                best = jnp.maximum(best, s)
+                total = total + s
+                mask = mask | m
+            scores = best if r_tie is None else \
+                best + em.get(r_tie) * (total - best)
+            return jnp.where(mask, scores * em.get(r_boost), 0.0), mask
+        return emit
+
+    def _res_BoostingQuery(self, query: q.BoostingQuery) -> Emit:
+        pos = self.resolve(query.positive or q.MatchAllQuery())
+        neg = self.resolve_mask(query.negative or q.MatchNoneQuery())
+        r_neg = self.c(query.negative_boost, np.float32)
+        r_boost = self.c(query.boost, np.float32)
+
+        def emit(em):
+            scores, mask = pos(em)
+            demote = jnp.where(neg(em), em.get(r_neg),
+                               jnp.float32(1.0))
+            return scores * demote * em.get(r_boost), mask
+        return emit
+
+    def _res_CommonTermsQuery(self, query: q.CommonTermsQuery) -> Emit:
+        field = query.field
+        analyzer = self._analyzer_for(field, query.analyzer)
+        terms = [t.term for t in analyzer.analyze(query.text)]
+        if not terms or self.seg.text.get(field) is None:
+            return self._zeros()
+        # split by document frequency (ExtendedCommonTermsQuery: ≥1 means
+        # an absolute df cutoff, <1 a fraction of docCount)
+        low, high = [], []
+        for t in terms:
+            df, doc_count = self._term_stats(field, t)
+            cutoff = query.cutoff_frequency if query.cutoff_frequency >= 1 \
+                else query.cutoff_frequency * doc_count
+            idf = bm25_idf(df, doc_count) if df > 0 else 0.0
+            tid = self.seg.text[field].column.tid(t)
+            (high if df > cutoff else low).append((tid, idf))
+        self.sig("common", len(low), len(high))
+        msm_low = len(low) if query.low_freq_operator == "and" else \
+            _resolve_msm(query.minimum_should_match_low, len(low)) \
+            if query.minimum_should_match_low is not None else 1
+        msm_high = len(high) if query.high_freq_operator == "and" else \
+            _resolve_msm(query.minimum_should_match_high, len(high)) \
+            if query.minimum_should_match_high is not None else 1
+        r_avgdl = self.c(self._avgdl(field), np.float32)
+        r_boost = self.c(query.boost, np.float32)
+        p = self.ctx.bm25
+
+        def group(pairs):
+            if not pairs:
+                return None
+            return (self.c([t for t, _ in pairs], np.int32),
+                    self.c([i for _, i in pairs], np.float32), len(pairs))
+        g_low, g_high = group(low), group(high)
+        r_msm_low = self.c(msm_low, np.int32) if g_low else None
+        r_msm_high = self.c(msm_high, np.int32) if g_high else None
+
+        def emit(em):
+            col = em.seg.text[field]
+
+            def score_group(g):
+                r_tids, r_idfs, n = g
+                return lexical.bm25_match(
+                    col.uterms, col.utf, col.doc_len,
+                    jnp.asarray(em.get(r_tids)), jnp.asarray(em.get(r_idfs)),
+                    jnp.ones(n, jnp.float32), p.k1, p.b, em.get(r_avgdl))
+            if g_low is not None:
+                low_s, low_n = score_group(g_low)
+                mask = low_n >= em.get(r_msm_low)
+                scores = low_s
+                if g_high is not None:
+                    high_s, _ = score_group(g_high)
+                    scores = scores + high_s
+            else:
+                high_s, high_n = score_group(g_high)
+                mask = high_n >= em.get(r_msm_high)
+                scores = high_s
+            return jnp.where(mask, scores * em.get(r_boost), 0.0), mask
+        return emit
+
+    def _res_SpanTermQuery(self, query: q.SpanTermQuery) -> Emit:
+        # a lone span_term scores like a single-term match (SpanWeight's
+        # sloppyFreq over unit-width spans == term frequency)
+        return self.resolve(q.MatchQuery(field=query.field,
+                                         text=query.value,
+                                         analyzer="keyword",
+                                         boost=query.boost))
+
+    def _res_SpanNearQuery(self, query: q.SpanNearQuery) -> Emit:
+        field = query.clauses[0].field
+        col = self.seg.text.get(field)
+        if col is None:
+            return self._zeros()
+        terms = [c.value for c in query.clauses]
+        resolved = self._match_terms(field, terms)
+        if resolved is None:
+            return self._zeros()
+        tids, idfs = resolved
+        slop = query.slop
+        self.sig("span_near", len(tids), slop, query.in_order, field)
+        r_tids = [self.c(t, np.int32) for t in tids]
+        r_sum_idf = self.c(sum(idfs), np.float32)
+        r_avgdl = self.c(self._avgdl(field), np.float32)
+        r_boost = self.c(query.boost, np.float32)
+        in_order = query.in_order
+        n_clauses = len(tids)
+        p = self.ctx.bm25
+
+        def emit(em):
+            tcol = em.seg.text[field]
+            tid_scalars = [em.get(r) for r in r_tids]
+            if in_order:
+                # ordered spans ≡ sloppy phrase with consecutive expected
+                # positions; freq counts anchored matches (the 1/(1+d)
+                # sloppyFreq weight is a documented simplification away)
+                freq = phrase_ops.sloppy_phrase_count(
+                    tcol.tokens, tid_scalars, list(range(n_clauses)), slop)
+            else:
+                freq = phrase_ops.span_near_freq_unordered(
+                    tcol.tokens, tid_scalars, slop)
+            scores, mask = phrase_ops.freq_score(
+                freq, tcol.doc_len, em.get(r_sum_idf), p.k1, p.b,
+                em.get(r_avgdl))
+            return scores * em.get(r_boost), mask
+        return emit
+
+    def _res_MoreLikeThisQuery(self, query: q.MoreLikeThisQuery) -> Emit:
+        fields = query.fields or sorted(self.seg.text)
+        self.sig("mlt", tuple(fields), query.include)
+        # gather like text per field: raw texts apply to every field;
+        # liked docs contribute their own field values
+        texts_by_field: dict[str, list[str]] = {f: list(query.like_texts)
+                                                for f in fields}
+        like_rows: list[tuple[int, int]] = []     # (segment idx, local row)
+        for spec in query.like_docs:
+            did = str(spec.get("_id", ""))
+            for si, seg in enumerate(self.ctx.reader.segments):
+                host = seg.seg
+                for local, hid in enumerate(host.ids[:host.num_docs]):
+                    if hid != did:
+                        continue
+                    like_rows.append((si, local))
+                    src = host.sources[local]
+                    for f in fields:
+                        v = src.get(f)
+                        if isinstance(v, str):
+                            texts_by_field[f].append(v)
+        # significant-term selection: tf in the like text ≥ min_term_freq,
+        # df ≥ min_doc_freq, ranked by idf (MoreLikeThis.createQueue)
+        candidates: list[tuple[float, str, str, float]] = []
+        for f in fields:
+            analyzer = self._analyzer_for(f, None)
+            tf: dict[str, int] = {}
+            for text in texts_by_field[f]:
+                for tok in analyzer.analyze(text):
+                    tf[tok.term] = tf.get(tok.term, 0) + 1
+            for term, n in tf.items():
+                if n < query.min_term_freq:
+                    continue
+                df, doc_count = self._term_stats(f, term)
+                if df < query.min_doc_freq or df <= 0:
+                    continue
+                idf = bm25_idf(df, doc_count)
+                candidates.append((idf * n, f, term, idf))
+        candidates.sort(key=lambda x: (-x[0], x[1], x[2]))
+        picked = candidates[:query.max_query_terms]
+        if not picked:
+            return self._zeros()
+        # one scoring group per field (terms resolve per segment dict)
+        by_field: dict[str, list[tuple[int, float]]] = {}
+        for _, f, term, idf in picked:
+            col = self.seg.text.get(f)
+            tid = col.column.tid(term) if col is not None else -1
+            by_field.setdefault(f, []).append((tid, idf))
+        msm = _resolve_msm(query.minimum_should_match, len(picked)) \
+            if query.minimum_should_match is not None else 1
+        self.sig("mlt-groups",
+                 tuple((f, len(v)) for f, v in sorted(by_field.items())))
+        groups = []
+        for f in sorted(by_field):
+            pairs = by_field[f]
+            groups.append((f,
+                           self.c([t for t, _ in pairs], np.int32),
+                           self.c([i for _, i in pairs], np.float32),
+                           len(pairs)))
+        r_msm = self.c(msm, np.int32)
+        r_boost = self.c(query.boost, np.float32)
+        exclude = None
+        if (like_rows or query.exclude_ids) and not query.include:
+            my_idx = next((i for i, s in
+                           enumerate(self.ctx.reader.segments)
+                           if s is self.seg), None)
+            hits = np.zeros(self.n, bool)
+            for sj, local in like_rows:
+                if sj == my_idx:
+                    hits[local] = True
+            if query.exclude_ids:
+                wanted = set(query.exclude_ids)
+                host = self.seg.seg
+                for local, hid in enumerate(host.ids[:host.num_docs]):
+                    if hid in wanted:
+                        hits[local] = True
+            if hits.any():
+                exclude = self.c(hits)
+        self.sig("mlt-excl", exclude is not None)
+        r_avgdl = {f: self.c(self._avgdl(f), np.float32)
+                   for f, *_ in groups}
+        p = self.ctx.bm25
+
+        def emit(em):
+            scores = jnp.zeros(em.n, jnp.float32)
+            nmatch = jnp.zeros(em.n, jnp.int32)
+            for f, r_tids, r_idfs, n in groups:
+                col = em.seg.text[f]
+                s, nm = lexical.bm25_match(
+                    col.uterms, col.utf, col.doc_len,
+                    jnp.asarray(em.get(r_tids)), jnp.asarray(em.get(r_idfs)),
+                    jnp.ones(n, jnp.float32), p.k1, p.b,
+                    em.get(r_avgdl[f]))
+                scores = scores + s
+                nmatch = nmatch + nm
+            mask = nmatch >= em.get(r_msm)
+            if exclude is not None:
+                mask = mask & ~jnp.asarray(em.get(exclude))
+            return jnp.where(mask, scores * em.get(r_boost), 0.0), mask
+        return emit
+
     def _res_FunctionScoreQuery(self, query: q.FunctionScoreQuery) -> Emit:
         self.sig("function_score", query.score_mode, query.boost_mode,
                  query.max_boost is not None, query.min_score is not None,

@@ -136,6 +136,73 @@ class ConstantScoreQuery(Query):
 
 
 @dataclass
+class DisMaxQuery(Query):
+    """ref: core/index/query/DisMaxQueryParser.java — score = best
+    sub-query + tie_breaker × the rest."""
+    queries: list[Query] = dc_field(default_factory=list)
+    tie_breaker: float = 0.0
+
+
+@dataclass
+class BoostingQuery(Query):
+    """ref: core/index/query/BoostingQueryParser.java — positive matches,
+    demoted (× negative_boost) when the negative query also matches."""
+    positive: Query | None = None
+    negative: Query | None = None
+    negative_boost: float = 0.5
+
+
+@dataclass
+class CommonTermsQuery(Query):
+    """ref: core/index/query/CommonTermsQueryParser.java — terms split by
+    document frequency: low-freq terms gate the match, high-freq terms
+    only contribute score."""
+    field: str = ""
+    text: str = ""
+    cutoff_frequency: float = 0.01     # ≥1 → absolute df threshold
+    low_freq_operator: str = "or"
+    high_freq_operator: str = "or"
+    minimum_should_match_low: int | str | None = None
+    minimum_should_match_high: int | str | None = None
+    analyzer: str | None = None
+
+
+@dataclass
+class SpanTermQuery(Query):
+    """ref: core/index/query/SpanTermQueryParser.java."""
+    field: str = ""
+    value: str = ""
+
+
+@dataclass
+class SpanNearQuery(Query):
+    """ref: core/index/query/SpanNearQueryParser.java — clauses must
+    target one field; matches spans of width ≤ clauses+slop."""
+    clauses: list[Query] = dc_field(default_factory=list)
+    slop: int = 0
+    in_order: bool = True
+
+
+@dataclass
+class MoreLikeThisQuery(Query):
+    """ref: core/index/query/MoreLikeThisQueryParser.java — select the
+    like-input's most significant terms (tf·idf) and match on them."""
+    fields: list[str] = dc_field(default_factory=list)
+    like_texts: list[str] = dc_field(default_factory=list)
+    like_docs: list[dict] = dc_field(default_factory=list)  # {"_id": ...}
+    max_query_terms: int = 25
+    min_term_freq: int = 2
+    min_doc_freq: int = 5
+    minimum_should_match: int | str | None = "30%"
+    include: bool = False              # include the liked docs themselves
+    # ids to exclude from results even when their text arrived pre-fetched
+    # (the coordinator rewrites like-docs into like-texts + _exclude_ids —
+    # search_action.rewrite_mlt_likes; the reference fetches liked docs at
+    # the coordinator too, MoreLikeThisQueryParser + TransportMltAction)
+    exclude_ids: list[str] = dc_field(default_factory=list)
+
+
+@dataclass
 class ScoreFunction:
     kind: str                          # field_value_factor | weight | random_score
     #                                  # | script_score | gauss | exp | linear
@@ -336,6 +403,99 @@ def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query
     if qtype == "constant_score":
         return ConstantScoreQuery(
             filter_query=parse_query(qbody.get("filter", qbody.get("query"))),
+            boost=float(qbody.get("boost", 1.0)))
+
+    if qtype == "dis_max":
+        return DisMaxQuery(
+            queries=[parse_query(sub) for sub in qbody.get("queries", [])],
+            tie_breaker=float(qbody.get("tie_breaker", 0.0)),
+            boost=float(qbody.get("boost", 1.0)))
+
+    if qtype == "boosting":
+        if "positive" not in qbody or "negative" not in qbody:
+            raise QueryParsingError(
+                "[boosting] query requires 'positive' and 'negative'")
+        return BoostingQuery(
+            positive=parse_query(qbody["positive"]),
+            negative=parse_query(qbody["negative"]),
+            negative_boost=float(qbody.get("negative_boost", 0.5)),
+            boost=float(qbody.get("boost", 1.0)))
+
+    if qtype == "common":
+        fname, spec = _field_body(qbody, "common")
+        if not isinstance(spec, dict):
+            spec = {"query": spec}
+        msm = spec.get("minimum_should_match")
+        msm_low = msm_high = None
+        if isinstance(msm, dict):
+            msm_low = _parse_msm(msm.get("low_freq"))
+            msm_high = _parse_msm(msm.get("high_freq"))
+        else:
+            msm_low = _parse_msm(msm)
+        return CommonTermsQuery(
+            field=fname, text=str(spec.get("query", "")),
+            cutoff_frequency=float(spec.get("cutoff_frequency", 0.01)),
+            low_freq_operator=str(spec.get("low_freq_operator",
+                                           "or")).lower(),
+            high_freq_operator=str(spec.get("high_freq_operator",
+                                            "or")).lower(),
+            minimum_should_match_low=msm_low,
+            minimum_should_match_high=msm_high,
+            analyzer=spec.get("analyzer"),
+            boost=float(spec.get("boost", 1.0)))
+
+    if qtype == "span_term":
+        fname, spec = _field_body(qbody, "span_term")
+        if isinstance(spec, dict):
+            return SpanTermQuery(field=fname,
+                                 value=str(spec.get("value",
+                                                    spec.get("term", ""))),
+                                 boost=float(spec.get("boost", 1.0)))
+        return SpanTermQuery(field=fname, value=str(spec))
+
+    if qtype == "span_near":
+        clauses = [parse_query(c) for c in qbody.get("clauses", [])]
+        if not clauses:
+            raise QueryParsingError("[span_near] requires clauses")
+        for c in clauses:
+            if not isinstance(c, SpanTermQuery):
+                raise QueryParsingError(
+                    "[span_near] clauses must be span_term queries")
+        fields = {c.field for c in clauses}
+        if len(fields) != 1:
+            raise QueryParsingError(
+                "[span_near] clauses must target one field")
+        return SpanNearQuery(clauses=clauses,
+                             slop=int(qbody.get("slop", 0)),
+                             in_order=bool(qbody.get("in_order", True)),
+                             boost=float(qbody.get("boost", 1.0)))
+
+    if qtype in ("more_like_this", "mlt"):
+        like_texts: list[str] = []
+        like_docs: list[dict] = []
+        raw_like = qbody.get("like", qbody.get("like_text"))
+        for item in (raw_like if isinstance(raw_like, list)
+                     else [raw_like] if raw_like is not None else []):
+            if isinstance(item, dict):
+                like_docs.append(item)
+            else:
+                like_texts.append(str(item))
+        for did in qbody.get("ids", qbody.get("docs", [])) or []:
+            like_docs.append(did if isinstance(did, dict) else {"_id": did})
+        if not like_texts and not like_docs:
+            raise QueryParsingError(
+                "[more_like_this] requires 'like' text or docs")
+        fields = qbody.get("fields", [])
+        return MoreLikeThisQuery(
+            fields=list(fields),
+            like_texts=like_texts, like_docs=like_docs,
+            exclude_ids=[str(x) for x in qbody.get("_exclude_ids", [])],
+            max_query_terms=int(qbody.get("max_query_terms", 25)),
+            min_term_freq=int(qbody.get("min_term_freq", 2)),
+            min_doc_freq=int(qbody.get("min_doc_freq", 5)),
+            minimum_should_match=_parse_msm(
+                qbody.get("minimum_should_match", "30%")),
+            include=bool(qbody.get("include", False)),
             boost=float(qbody.get("boost", 1.0)))
 
     if qtype == "function_score":

@@ -127,6 +127,29 @@ class TestPhrase:
                                   [jnp.int32(5), jnp.int32(7)], [0, 2])
         assert np.asarray(freq)[0] == 1.0
 
+    def test_sloppy_count_counts_each_match(self):
+        # "a x b ... a x b": two in-order matches at displacement 1 each —
+        # sloppyFreq sums 0.5+0.5=1.0 but the span COUNT must be 2
+        tokens = np.array([[0, 9, 1, 7, 0, 9, 1, -1]], np.int32)
+        freq = phrase.sloppy_phrase_freq(jnp.array(tokens),
+                                         [jnp.int32(0), jnp.int32(1)],
+                                         [0, 1], 1)
+        np.testing.assert_allclose(np.asarray(freq), [1.0])
+        count = phrase.sloppy_phrase_count(jnp.array(tokens),
+                                           [jnp.int32(0), jnp.int32(1)],
+                                           [0, 1], 1)
+        np.testing.assert_allclose(np.asarray(count), [2.0])
+
+    def test_span_near_unordered_freq(self):
+        # terms 0,1 within window 2+1: doc0 "1 0" reversed adjacent →
+        # match; doc1 far apart → none; doc2 two separate regions → 2
+        tokens = np.array([[1, 0, -1, -1, -1, -1, -1, -1],
+                           [0, 9, 9, 9, 9, 9, 9, 1],
+                           [0, 1, 9, 9, 9, 1, 0, -1]], np.int32)
+        freq = phrase.span_near_freq_unordered(
+            jnp.array(tokens), [jnp.int32(0), jnp.int32(1)], 1)
+        np.testing.assert_allclose(np.asarray(freq), [1.0, 0.0, 2.0])
+
     def test_sloppy(self):
         # doc0: "0 9 1" — term 1 is displaced by 1 from the exact-phrase
         # position → sloppyFreq 1/(1+1) = 0.5 at slop 1.

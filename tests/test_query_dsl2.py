@@ -1,0 +1,240 @@
+"""Query DSL tranche 2: dis_max, boosting, common, span_term/span_near,
+more_like_this — parser + executor + compiled-path (no-fallback) tests.
+Reference parsers: core/index/query/{DisMaxQueryParser, BoostingQueryParser,
+CommonTermsQueryParser, SpanTermQueryParser, SpanNearQueryParser,
+MoreLikeThisQueryParser}.java."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import QueryParsingError
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search import jit_exec
+from elasticsearch_tpu.search.query_dsl import (
+    BoostingQuery, CommonTermsQuery, DisMaxQuery, MoreLikeThisQuery,
+    SpanNearQuery, SpanTermQuery, parse_query)
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node({}, data_path=tmp_path_factory.mktemp("dsl2") / "n").start()
+    n.indices_service.create_index(
+        "idx", {"settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0},
+                "mappings": {"_doc": {"properties": {
+                    "t": {"type": "text", "analyzer": "whitespace"},
+                    "n": {"type": "long"}}}}})
+    docs = [
+        "the quick brown fox",          # 0
+        "the quick red fox jumps",      # 1
+        "the lazy brown dog",           # 2
+        "quick brown quick fox",        # 3
+        "red dog plays",                # 4
+        "the the the common words",     # 5
+        "fox jumps over brown fence",   # 6
+        "quick fox",                    # 7
+    ]
+    for i, t in enumerate(docs):
+        n.index_doc("idx", str(i), {"t": t, "n": i})
+    n.broadcast_actions.refresh("idx")
+    yield n
+    n.close()
+
+
+def _ids(resp):
+    return {h["_id"] for h in resp["hits"]["hits"]}
+
+
+def _search(node, query, size=20):
+    jit_exec.clear_cache()
+    out = node.search("idx", {"query": query, "size": size})
+    assert jit_exec.cache_stats()["fallbacks"] == 0, \
+        f"compiled path fell back for {query}"
+    return out
+
+
+class TestDisMax:
+    def test_parse(self):
+        q = parse_query({"dis_max": {"queries": [{"term": {"t": "fox"}}],
+                                     "tie_breaker": 0.3}})
+        assert isinstance(q, DisMaxQuery) and q.tie_breaker == 0.3
+
+    def test_best_field_wins(self, node):
+        out = _search(node, {"dis_max": {"queries": [
+            {"match": {"t": "fox"}}, {"match": {"t": "dog"}}]}})
+        assert _ids(out) == {"0", "1", "2", "3", "4", "6", "7"}
+        # pure max (no tie_breaker): score equals the best sub-score
+        fox = node.search("idx", {"query": {"match": {"t": "fox"}}})
+        best_fox = {h["_id"]: h["_score"] for h in fox["hits"]["hits"]}
+        for h in out["hits"]["hits"]:
+            if h["_id"] in best_fox and h["_id"] not in ("2", "4"):
+                assert abs(h["_score"] - best_fox[h["_id"]]) < 1e-5
+
+    def test_tie_breaker_adds(self, node):
+        plain = _search(node, {"dis_max": {"queries": [
+            {"match": {"t": "quick"}}, {"match": {"t": "fox"}}]}})
+        tied = _search(node, {"dis_max": {"queries": [
+            {"match": {"t": "quick"}}, {"match": {"t": "fox"}}],
+            "tie_breaker": 0.5}})
+        p = {h["_id"]: h["_score"] for h in plain["hits"]["hits"]}
+        t = {h["_id"]: h["_score"] for h in tied["hits"]["hits"]}
+        # doc 7 matches both → tie_breaker strictly raises its score
+        assert t["7"] > p["7"]
+        # doc 2 matches neither quick nor fox? (matches nothing) — absent
+        assert set(p) == set(t)
+
+
+class TestBoosting:
+    def test_parse_requires_both(self):
+        with pytest.raises(QueryParsingError):
+            parse_query({"boosting": {"positive": {"match_all": {}}}})
+
+    def test_negative_demotes(self, node):
+        out = _search(node, {"boosting": {
+            "positive": {"match": {"t": "fox"}},
+            "negative": {"match": {"t": "red"}},
+            "negative_boost": 0.2}})
+        plain = node.search("idx", {"query": {"match": {"t": "fox"}}})
+        p = {h["_id"]: h["_score"] for h in plain["hits"]["hits"]}
+        got = {h["_id"]: h["_score"] for h in out["hits"]["hits"]}
+        assert set(got) == set(p)              # same matches
+        assert abs(got["1"] - 0.2 * p["1"]) < 1e-5   # red fox demoted
+        assert abs(got["0"] - p["0"]) < 1e-5         # brown fox untouched
+
+
+class TestCommonTerms:
+    def test_parse(self):
+        q = parse_query({"common": {"t": {
+            "query": "the quick fox", "cutoff_frequency": 0.5,
+            "minimum_should_match": {"low_freq": 2, "high_freq": 3}}}})
+        assert isinstance(q, CommonTermsQuery)
+        assert q.minimum_should_match_low == 2
+        assert q.minimum_should_match_high == 3
+
+    def test_high_freq_terms_dont_gate(self, node):
+        # "the" appears in 4/8 docs → high-freq at cutoff 0.4 (threshold
+        # 3.2 < 4); "plays" is low-freq. Docs matching only "the" must NOT
+        # match.
+        out = _search(node, {"common": {"t": {
+            "query": "the plays", "cutoff_frequency": 0.4}}})
+        assert _ids(out) == {"4"}
+        # a plain match would return every "the" doc too
+        plain = node.search("idx", {"query": {"match": {"t": "the plays"}}})
+        assert len(_ids(plain)) > 1
+
+    def test_all_high_freq_falls_through(self, node):
+        out = _search(node, {"common": {"t": {
+            "query": "the", "cutoff_frequency": 0.4}}})
+        assert _ids(out) == {"0", "1", "2", "5"}
+
+
+class TestSpan:
+    def test_span_term_scores_like_term(self, node):
+        out = _search(node, {"span_term": {"t": "fox"}})
+        plain = node.search("idx", {"query": {"term": {"t": "fox"}}})
+        assert _ids(out) == _ids(plain)
+
+    def test_span_near_in_order(self, node):
+        q = {"span_near": {"clauses": [{"span_term": {"t": "quick"}},
+                                       {"span_term": {"t": "fox"}}],
+                           "slop": 1, "in_order": True}}
+        out = _search(node, q)
+        # quick→fox within displacement 1: "quick brown fox" (1),
+        # "quick red fox" (1), "quick brown quick fox", "quick fox"
+        assert _ids(out) == {"0", "1", "3", "7"}
+
+    def test_span_near_exact_adjacent(self, node):
+        q = {"span_near": {"clauses": [{"span_term": {"t": "quick"}},
+                                       {"span_term": {"t": "fox"}}],
+                           "slop": 0, "in_order": True}}
+        assert _ids(_search(node, q)) == {"3", "7"}
+
+    def test_span_near_unordered(self, node):
+        q = {"span_near": {"clauses": [{"span_term": {"t": "fox"}},
+                                       {"span_term": {"t": "quick"}}],
+                           "slop": 1, "in_order": False}}
+        # unordered window of width 3: quick/fox within 3 positions in
+        # either order
+        assert _ids(_search(node, q)) == {"0", "1", "3", "7"}
+
+    def test_span_near_rejects_mixed_fields(self):
+        with pytest.raises(QueryParsingError):
+            parse_query({"span_near": {"clauses": [
+                {"span_term": {"a": "x"}}, {"span_term": {"b": "y"}}]}})
+
+
+class TestMoreLikeThis:
+    def test_parse(self):
+        q = parse_query({"more_like_this": {
+            "fields": ["t"], "like": "quick fox", "min_term_freq": 1}})
+        assert isinstance(q, MoreLikeThisQuery)
+        with pytest.raises(QueryParsingError):
+            parse_query({"more_like_this": {"fields": ["t"]}})
+
+    def test_like_text_finds_similar(self, node):
+        out = _search(node, {"more_like_this": {
+            "fields": ["t"], "like": "quick brown fox",
+            "min_term_freq": 1, "min_doc_freq": 1,
+            "minimum_should_match": 1}})
+        assert {"0", "3", "7"} <= _ids(out)
+        assert "4" not in _ids(out)      # red dog plays: no overlap
+
+    def test_like_doc_excludes_itself(self, node):
+        out = _search(node, {"more_like_this": {
+            "fields": ["t"], "like": [{"_id": "0"}],
+            "min_term_freq": 1, "min_doc_freq": 1,
+            "minimum_should_match": 1}})
+        ids = _ids(out)
+        assert "0" not in ids            # include=false default
+        assert {"3", "7"} <= ids
+        inc = _search(node, {"more_like_this": {
+            "fields": ["t"], "like": [{"_id": "0"}], "include": True,
+            "min_term_freq": 1, "min_doc_freq": 1,
+            "minimum_should_match": 1}})
+        assert "0" in _ids(inc)
+
+
+class TestMltCrossShard:
+    def test_like_doc_on_another_shard(self, tmp_path):
+        # the liked doc lives on ONE shard; similar docs on others must
+        # still match (coordinator fetches the doc, rewrite_mlt_likes)
+        n = Node({}, data_path=tmp_path / "x").start()
+        try:
+            n.indices_service.create_index(
+                "ms", {"settings": {"number_of_shards": 4,
+                                    "number_of_replicas": 0}})
+            texts = {"a1": "solar panel energy grid",
+                     "a2": "solar energy panel output",
+                     "a3": "solar panel installation",
+                     "b1": "cooking pasta tonight",
+                     "b2": "rainy weather forecast"}
+            for did, t in texts.items():
+                n.index_doc("ms", did, {"t": t})
+            n.broadcast_actions.refresh("ms")
+            out = n.search("ms", {"query": {"more_like_this": {
+                "fields": ["t"], "like": [{"_id": "a1"}],
+                "min_term_freq": 1, "min_doc_freq": 1,
+                "minimum_should_match": 1}}, "size": 10})
+            ids = {h["_id"] for h in out["hits"]["hits"]}
+            assert {"a2", "a3"} <= ids
+            assert "a1" not in ids        # excluded across shards too
+            assert "b1" not in ids
+        finally:
+            n.close()
+
+
+class TestDfsCoverage:
+    def test_new_types_reach_dfs(self, node):
+        from elasticsearch_tpu.search import dfs as dfs_mod
+        svc = node.indices_service.indices["idx"]
+        q = parse_query({"dis_max": {"queries": [
+            {"common": {"t": {"query": "quick fox"}}},
+            {"span_near": {"clauses": [{"span_term": {"t": "brown"}},
+                                       {"span_term": {"t": "dog"}}],
+                           "slop": 2}},
+            {"boosting": {"positive": {"match": {"t": "red"}},
+                          "negative": {"match": {"t": "lazy"}},
+                          "negative_boost": 0.1}}]}})
+        terms = dfs_mod.collect_terms(q, {"t"}, svc.mapper_service)
+        for w in ("quick", "fox", "brown", "dog", "red", "lazy"):
+            assert ("t", w) in terms
