@@ -110,7 +110,10 @@ def _fetch_mlt_likes(node, spec: dict, default_index: str) -> dict:
     raw_like = spec.pop("like", None)
     raw_like_text = spec.pop("like_text", None)
     raw = raw_like if raw_like is not None else raw_like_text
-    likes = raw if isinstance(raw, list) else [raw] if raw is not None else []
+    # copy: appending ids/docs below must not mutate the caller's list (a
+    # scroll context re-rewrites its stored body every page)
+    likes = list(raw) if isinstance(raw, list) \
+        else [raw] if raw is not None else []
     raw_ids = spec.pop("ids", None) or []
     raw_docs = spec.pop("docs", None) or []
     for did in list(raw_ids) + list(raw_docs):
@@ -126,8 +129,10 @@ def _fetch_mlt_likes(node, spec: dict, default_index: str) -> dict:
         if did is None:
             continue
         index = item.get("_index", default_index)
+        routing = item.get("_routing", item.get("routing"))
         try:
-            got = node.document_actions.get_doc(index, str(did))
+            got = node.document_actions.get_doc(index, str(did),
+                                                routing=routing)
         except Exception:                  # noqa: BLE001 — missing doc/index
             continue
         if not got.get("found"):

@@ -13,7 +13,7 @@ CollectionStatistics/TermStatistics for query-time IDF.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dc_field
 from typing import Any
 
 import jax
@@ -81,6 +81,15 @@ class DeviceGeoField:
 
 
 @dataclass
+class DeviceNestedBlock:
+    """A nested path's child segment + child→parent join, device-resident.
+    Child ``live`` already folds the PARENT's live mask in (children of
+    deleted parents can never match)."""
+    child: "DeviceSegment"
+    parent: Any                     # [child Np] i32, -1 pad
+
+
+@dataclass
 class DeviceSegment:
     seg: Segment
     live: Any                       # [Np] bool (padding & deletes False)
@@ -90,6 +99,7 @@ class DeviceSegment:
     numeric: dict[str, DeviceNumericField]
     vector: dict[str, DeviceVectorField]
     geo: dict[str, DeviceGeoField]
+    nested: dict[str, "DeviceNestedBlock"] = dc_field(default_factory=dict)
 
     @property
     def padded_docs(self) -> int:
@@ -147,18 +157,36 @@ class DeviceReader:
                                     lon=put(c.lon.astype(np.float32)),
                                     exists=put(c.exists), column=c)
                for name, c in seg.geo_fields.items()}
+        nested = {}
+        for path, blk in seg.nested_blocks.items():
+            # child live folds the parent's live mask in: children of
+            # deleted parents never match (Lucene deletes the hidden
+            # nested docs together with the parent)
+            valid = blk.parent >= 0
+            child_live = np.zeros(blk.segment.padded_docs, bool)
+            child_live[valid] = live[blk.parent[valid]]
+            nested[path] = DeviceNestedBlock(
+                child=self._pack_segment(blk.segment, child_live, 0, put),
+                parent=put(blk.parent))
         return DeviceSegment(seg=seg, live=put(live), doc_base=doc_base,
                              text=text, keyword=keyword, numeric=numeric,
-                             vector=vector, geo=geo)
+                             vector=vector, geo=geo, nested=nested)
 
     def _collect_stats(self, view: SearcherView) -> None:
         for seg in view.segments:
-            for name, c in seg.text_fields.items():
-                st = self._text_stats.setdefault(
-                    name, TextFieldStats(0, 0, 0))
-                st.doc_count += seg.num_docs
-                st.docs_with_field += int((c.doc_len[:seg.num_docs] > 0).sum())
-                st.total_tokens += c.total_tokens
+            self._collect_seg_stats(seg)
+
+    def _collect_seg_stats(self, seg: Segment) -> None:
+        for name, c in seg.text_fields.items():
+            st = self._text_stats.setdefault(name, TextFieldStats(0, 0, 0))
+            st.doc_count += seg.num_docs
+            st.docs_with_field += int((c.doc_len[:seg.num_docs] > 0).sum())
+            st.total_tokens += c.total_tokens
+        for blk in seg.nested_blocks.values():
+            # nested child fields get their own stats over CHILD rows (the
+            # reference's nested docs likewise contribute their own
+            # field statistics)
+            self._collect_seg_stats(blk.segment)
 
     # ---- stats (CollectionStatistics / TermStatistics analog) -------------
 
@@ -170,15 +198,20 @@ class DeviceReader:
         return self._text_stats.get(field, TextFieldStats(self.num_docs, 0, 0))
 
     def df(self, field: str, term: str) -> int:
-        """Doc frequency aggregated across this reader's segments."""
-        total = 0
-        for s in self.segments:
-            col = s.seg.text_fields.get(field)
+        """Doc frequency aggregated across this reader's segments
+        (including nested child blocks — their fields are path-prefixed,
+        so names never collide with parent fields)."""
+        def seg_df(seg: Segment) -> int:
+            out = 0
+            col = seg.text_fields.get(field)
             if col is not None:
                 tid = col.tid(term)
                 if tid >= 0:
-                    total += int(col.df[tid])
-        return total
+                    out += int(col.df[tid])
+            for blk in seg.nested_blocks.values():
+                out += seg_df(blk.segment)
+            return out
+        return sum(seg_df(s.seg) for s in self.segments)
 
     # ---- doc id resolution -------------------------------------------------
 

@@ -432,9 +432,8 @@ class Engine:
             for seg in old:  # remove persisted files of merged-away segments
                 seg_dir = self.path / f"seg_{seg.seg_id}"
                 if seg_dir.exists():
-                    for f in seg_dir.iterdir():
-                        f.unlink()
-                    seg_dir.rmdir()
+                    import shutil
+                    shutil.rmtree(seg_dir)   # incl. nested child subdirs
 
     # -------------------------------------------------------------- recovery
 
@@ -524,7 +523,9 @@ class Engine:
             commit = self.path / "commit.json"
             files = [commit] if commit.exists() else []
             for seg_dir in sorted(self.path.glob("seg_*")):
-                files.extend(sorted(seg_dir.iterdir()))
+                # recursive: nested child blocks live in subdirectories
+                files.extend(sorted(p for p in seg_dir.rglob("*")
+                                    if p.is_file()))
             for f in files:
                 data = f.read_bytes()
                 out[str(f.relative_to(self.path))] = \

@@ -124,6 +124,16 @@ class GeoFieldColumn:
 
 
 @dataclass
+class NestedBlock:
+    """One nested path's child rows for a segment: a full child segment
+    (nested objects are docs of their own — ref: ObjectMapper Nested,
+    nested objects index as adjacent hidden Lucene docs) plus the
+    child-row → parent-row join column."""
+    segment: "Segment"
+    parent: np.ndarray               # [child padded] int32, -1 pad
+
+
+@dataclass
 class Segment:
     seg_id: int
     num_docs: int                    # true doc count (rows beyond are pad)
@@ -142,6 +152,8 @@ class Segment:
     # this; Lucene's addIndexes'd segments merge at the codec level and
     # have no such constraint — columnar re-analysis here does).
     source_complete: bool = True
+    # nested path → child block (mapping "type": "nested")
+    nested_blocks: dict[str, NestedBlock] = dc_field(default_factory=dict)
 
     def memory_bytes(self) -> int:
         total = 0
@@ -157,6 +169,8 @@ class Segment:
             total += col.vecs.nbytes
         for col in self.geo_fields.values():
             total += col.lat.nbytes + col.lon.nbytes
+        for blk in self.nested_blocks.values():
+            total += blk.segment.memory_bytes() + blk.parent.nbytes
         return total
 
     # ---- bulk columnar ingest ---------------------------------------------
@@ -244,6 +258,11 @@ class Segment:
             arrays[f"g.{name}.lon"] = c.lon
             arrays[f"g.{name}.exists"] = c.exists
 
+        meta["nested"] = sorted(self.nested_blocks)
+        for p, blk in self.nested_blocks.items():
+            blk.segment.write(path / f"nested_{p}")
+            arrays[f"x.{p}.parent"] = blk.parent
+
         tmp_npz, tmp_meta, tmp_src = (path / "arrays.npz.tmp", path / "meta.json.tmp",
                                       path / "source.jsonl.tmp")
         with open(tmp_npz, "wb") as f:
@@ -294,12 +313,17 @@ class Segment:
                                  lon=arrays[f"g.{name}.lon"],
                                  exists=arrays[f"g.{name}.exists"])
             for name in meta["geo_fields"]}
+        nested_blocks = {
+            p: NestedBlock(segment=Segment.read(path / f"nested_{p}"),
+                           parent=arrays[f"x.{p}.parent"])
+            for p in meta.get("nested", [])}
         return Segment(seg_id=meta["seg_id"], num_docs=meta["num_docs"],
                        padded_docs=meta["padded_docs"], ids=ids, sources=sources,
                        text_fields=text_fields, keyword_fields=keyword_fields,
                        numeric_fields=numeric_fields, vector_fields=vector_fields,
                        geo_fields=geo_fields, version_id=meta["version_id"],
-                       source_complete=meta.get("source_complete", True))
+                       source_complete=meta.get("source_complete", True),
+                       nested_blocks=nested_blocks)
 
 
 class SegmentBuilder:
@@ -359,7 +383,29 @@ class SegmentBuilder:
             sources=[d.source for d in self.docs],
             text_fields=text_fields, keyword_fields=keyword_fields,
             numeric_fields=numeric_fields, vector_fields=vector_fields,
-            geo_fields=geo_fields)
+            geo_fields=geo_fields,
+            nested_blocks=self._build_nested())
+
+    def _build_nested(self) -> dict[str, NestedBlock]:
+        """Each nested path's objects become rows of a CHILD segment built
+        through the ordinary per-kind builders, plus a parent join column."""
+        paths: set[str] = set()
+        for d in self.docs:
+            paths.update(d.nested)
+        blocks: dict[str, NestedBlock] = {}
+        for path in sorted(paths):
+            child = SegmentBuilder(seg_id=0, max_tokens=self.max_tokens)
+            parents: list[int] = []
+            for i, d in enumerate(self.docs):
+                for row in d.nested.get(path, []):
+                    child.docs.append(ParsedDocument(
+                        doc_id="", source={}, fields=row))
+                    parents.append(i)
+            child_seg = child.build()
+            parent = np.full(child_seg.padded_docs, -1, np.int32)
+            parent[:len(parents)] = parents
+            blocks[path] = NestedBlock(segment=child_seg, parent=parent)
+        return blocks
 
     # ---- per-kind builders ------------------------------------------------
 

@@ -236,10 +236,14 @@ class PeerRecoveryService:
         keep = set(request["keep"])
         # remove files of stale segments the source's commit doesn't know
         for seg_dir in engine.path.glob("seg_*"):
-            for f in list(seg_dir.iterdir()):
-                rel = str(f.relative_to(engine.path))
-                if rel not in keep:
-                    f.unlink(missing_ok=True)
+            # recursive: nested child blocks live in subdirectories
+            for f in sorted(seg_dir.rglob("*"), reverse=True):
+                if f.is_file():
+                    rel = str(f.relative_to(engine.path))
+                    if rel not in keep:
+                        f.unlink(missing_ok=True)
+                elif f.is_dir() and not any(f.iterdir()):
+                    f.rmdir()
             if not any(seg_dir.iterdir()):
                 seg_dir.rmdir()
         engine.install_recovered_commit()

@@ -84,6 +84,12 @@ class ParsedDocument:
     source: dict
     fields: dict[str, ParsedField]
     routing: str | None = None
+    # nested path → one field-dict per nested object (each becomes a row
+    # of the segment's child block; ref: ObjectMapper Nested,
+    # core/index/mapper/object/ObjectMapper.java — nested objects are
+    # separate hidden docs adjacent to their parent)
+    nested: dict[str, list[dict[str, ParsedField]]] = field(
+        default_factory=dict)
 
 
 class FieldMapper:
@@ -230,13 +236,29 @@ class DocumentMapper:
         self.dynamic = {"true": True, "false": False, "strict": "strict"}.get(
             str(mapping_def.get("dynamic", dynamic)).lower(), True)
         self.mappers: dict[str, FieldMapper] = {}
+        # paths mapped {"type": "nested"} — their objects index as child
+        # rows (segment nested blocks), not flattened parent fields
+        self.nested_paths: set[str] = set()
         self._build(mapping_def.get("properties", {}), prefix="")
 
-    def _build(self, properties: Mapping[str, Any], prefix: str) -> None:
+    def _build(self, properties: Mapping[str, Any], prefix: str,
+               in_nested: bool = False) -> None:
         for name, fdef in properties.items():
             full = f"{prefix}{name}"
+            if fdef.get("type") == "nested":
+                if in_nested:
+                    # reject up front: a silently-dropped inner block would
+                    # make data unsearchable with no error
+                    raise MapperParsingError(
+                        f"nested field [{full}] inside a nested field is "
+                        f"not supported")
+                self.nested_paths.add(full)
+                self._build(fdef.get("properties", {}), prefix=f"{full}.",
+                            in_nested=True)
+                continue
             if "properties" in fdef and "type" not in fdef:   # object field
-                self._build(fdef["properties"], prefix=f"{full}.")
+                self._build(fdef["properties"], prefix=f"{full}.",
+                            in_nested=in_nested)
                 continue
             self.add_mapper(FieldMapper(full, fdef.get("type", "text"), fdef,
                                         self.analysis))
@@ -282,20 +304,36 @@ class DocumentMapper:
     def parse(self, doc_id: str, source: Mapping[str, Any],
               routing: str | None = None) -> ParsedDocument:
         fields: dict[str, ParsedField] = {}
+        nested: dict[str, list[dict[str, ParsedField]]] = {}
         new_mappers: list[FieldMapper] = []
-        self._parse_object(source, "", fields, new_mappers)
+        self._parse_object(source, "", fields, new_mappers, nested)
         for m in new_mappers:        # dynamic mapping update
             self.add_mapper(m)
         return ParsedDocument(doc_id=doc_id, source=dict(source), fields=fields,
-                              routing=routing)
+                              routing=routing, nested=nested)
 
     def _parse_object(self, obj: Mapping[str, Any], prefix: str,
                       out: dict[str, ParsedField],
-                      new_mappers: list[FieldMapper]) -> None:
+                      new_mappers: list[FieldMapper],
+                      nested: dict[str, list[dict[str, ParsedField]]]
+                      | None = None) -> None:
         for key, value in obj.items():
             full = f"{prefix}{key}"
+            if nested is not None and full in self.nested_paths:
+                objs = value if isinstance(value, list) else [value]
+                rows = nested.setdefault(full, [])
+                for sub in objs:
+                    if not isinstance(sub, Mapping):
+                        raise MapperParsingError(
+                            f"nested field [{full}] expects objects")
+                    row: dict[str, ParsedField] = {}
+                    self._parse_object(sub, f"{full}.", row, new_mappers,
+                                       nested=None)
+                    rows.append(row)
+                continue
             if isinstance(value, Mapping) and full not in self.mappers:
-                self._parse_object(value, f"{full}.", out, new_mappers)
+                self._parse_object(value, f"{full}.", out, new_mappers,
+                                   nested)
                 continue
             mapper = self.mappers.get(full)
             if mapper is None:
@@ -315,6 +353,12 @@ class DocumentMapper:
 
     def mapping_dict(self) -> dict:
         props: dict[str, Any] = {}
+        for path in sorted(self.nested_paths):
+            node = props
+            parts = path.split(".")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {}).setdefault("properties", {})
+            node[parts[-1]] = {"type": "nested"}
         for name, m in self.mappers.items():
             if "." in name and name.rsplit(".", 1)[0] in self.mappers:
                 continue  # sub-field, rendered inside parent
@@ -354,6 +398,16 @@ class MapperService:
                           properties: Mapping[str, Any], prefix: str) -> None:
         for name, fdef in properties.items():
             full = f"{prefix}{name}"
+            if fdef.get("type") == "nested":
+                if any(full.startswith(f"{p}.") for p in
+                       existing.nested_paths):
+                    raise MapperParsingError(
+                        f"nested field [{full}] inside a nested field is "
+                        f"not supported")
+                existing.nested_paths.add(full)
+                self._merge_properties(existing, fdef.get("properties", {}),
+                                       f"{full}.")
+                continue
             if "properties" in fdef and "type" not in fdef:   # object field
                 self._merge_properties(existing, fdef["properties"], f"{full}.")
                 continue

@@ -91,6 +91,8 @@ def collect_terms(query: q.Query, text_fields: set[str],
             for f, texts in texts_by_field.items():
                 for text in texts:
                     analyze_into(f, text)
+        elif t == "NestedQuery":
+            walk(node.query)
         elif t == "DisMaxQuery":
             for sub in node.queries:
                 walk(sub)

@@ -776,6 +776,52 @@ class SegmentResolver:
             return jnp.where(mask, scores * em.get(r_boost), 0.0), mask
         return emit
 
+    def _res_NestedQuery(self, query: q.NestedQuery) -> Emit:
+        """Nested query: resolve the inner query against the path's CHILD
+        segment; the emit scatter-reduces child matches onto parent rows
+        ((.at[].max/add — a segment-reduce, XLA-native). Children of
+        deleted parents are already dead in the child live mask
+        (device_reader packing)."""
+        path = query.path
+        block = self.seg.nested.get(path)
+        if block is None:
+            return self._zeros()
+        score_mode = query.score_mode
+        self.sig("nested", path, score_mode)
+        inner = SegmentResolver(block.child, self.ctx, self.ct).resolve(
+            query.query or q.MatchAllQuery())
+        r_boost = self.c(query.boost, np.float32)
+
+        def emit(em):
+            blk = em.seg.nested[path]
+            child_em = EmitCtx(blk.child, em.consts)
+            c_scores, c_mask = inner(child_em)
+            ok = c_mask & blk.child.live & (blk.parent >= 0)
+            idx = jnp.where(blk.parent >= 0, blk.parent, 0)
+            matched = jnp.zeros(em.n, bool).at[idx].max(ok, mode="drop")
+            if score_mode == "none":
+                scores = matched.astype(jnp.float32)
+            elif score_mode in ("max", "min"):
+                fill = -jnp.inf if score_mode == "max" else jnp.inf
+                red = jnp.full(em.n, fill, jnp.float32)
+                contrib = jnp.where(ok, c_scores, fill)
+                red = red.at[idx].max(contrib, mode="drop") \
+                    if score_mode == "max" \
+                    else red.at[idx].min(contrib, mode="drop")
+                scores = jnp.where(matched, red, 0.0)
+            else:
+                ssum = jnp.zeros(em.n, jnp.float32).at[idx].add(
+                    jnp.where(ok, c_scores, 0.0), mode="drop")
+                if score_mode == "avg":
+                    cnt = jnp.zeros(em.n, jnp.float32).at[idx].add(
+                        ok.astype(jnp.float32), mode="drop")
+                    scores = ssum / jnp.maximum(cnt, 1.0)
+                else:                    # sum
+                    scores = ssum
+            return jnp.where(matched, scores * em.get(r_boost), 0.0), \
+                matched
+        return emit
+
     def _res_SpanTermQuery(self, query: q.SpanTermQuery) -> Emit:
         # a lone span_term scores like a single-term match (SpanWeight's
         # sloppyFreq over unit-width spans == term frequency)
@@ -864,12 +910,18 @@ class SegmentResolver:
         picked = candidates[:query.max_query_terms]
         if not picked:
             return self._zeros()
-        # one scoring group per field (terms resolve per segment dict)
+        # one scoring group per field PRESENT in this segment (a field's
+        # terms can't match where its column doesn't exist — same zeros
+        # semantics as _match_terms; minimum_should_match still counts all
+        # picked terms, so docs in such segments need the remaining fields)
         by_field: dict[str, list[tuple[int, float]]] = {}
         for _, f, term, idf in picked:
             col = self.seg.text.get(f)
-            tid = col.column.tid(term) if col is not None else -1
-            by_field.setdefault(f, []).append((tid, idf))
+            if col is None:
+                continue
+            by_field.setdefault(f, []).append((col.column.tid(term), idf))
+        if not by_field:
+            return self._zeros()
         msm = _resolve_msm(query.minimum_should_match, len(picked)) \
             if query.minimum_should_match is not None else 1
         self.sig("mlt-groups",

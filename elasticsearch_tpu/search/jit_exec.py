@@ -76,7 +76,8 @@ _ARRAYS = {
 
 
 def seg_flatten(seg: DeviceSegment) -> list:
-    """Device arrays of a segment in deterministic order (live first)."""
+    """Device arrays of a segment in deterministic order (live first;
+    nested child blocks recurse after the flat kinds)."""
     flat = [seg.live]
     for kind in _KINDS:
         fields = getattr(seg, kind)
@@ -84,26 +85,42 @@ def seg_flatten(seg: DeviceSegment) -> list:
             col = fields[name]
             for attr in _ARRAYS[kind]:
                 flat.append(getattr(col, attr))
+    for path in sorted(seg.nested):
+        blk = seg.nested[path]
+        flat.append(blk.parent)
+        flat.extend(seg_flatten(blk.child))
     return flat
 
 
 def seg_rebuild(seg: DeviceSegment, flat: list) -> DeviceSegment:
     """Shallow-copy `seg` with arrays swapped for (traced) `flat`."""
     it = iter(flat)
-    live = next(it)
-    kinds = {}
-    for kind in _KINDS:
-        fields = getattr(seg, kind)
-        # arrays were flattened in sorted-name order, but the rebuilt dicts
-        # must preserve the ORIGINAL iteration order — resolver walks (e.g.
-        # the all-fields match loop) iterate these dicts, and the emitted
-        # structure depends on it
-        rebuilt = {
-            name: dc_replace(fields[name],
-                             **{attr: next(it) for attr in _ARRAYS[kind]})
-            for name in sorted(fields)}
-        kinds[kind] = {name: rebuilt[name] for name in fields}
-    return dc_replace(seg, live=live, **kinds)
+
+    def rebuild(s: DeviceSegment) -> DeviceSegment:
+        live = next(it)
+        kinds = {}
+        for kind in _KINDS:
+            fields = getattr(s, kind)
+            # arrays were flattened in sorted-name order, but the rebuilt
+            # dicts must preserve the ORIGINAL iteration order — resolver
+            # walks (e.g. the all-fields match loop) iterate these dicts,
+            # and the emitted structure depends on it
+            rebuilt = {
+                name: dc_replace(fields[name],
+                                 **{attr: next(it)
+                                    for attr in _ARRAYS[kind]})
+                for name in sorted(fields)}
+            kinds[kind] = {name: rebuilt[name] for name in fields}
+        nested = {}
+        for path in sorted(s.nested):
+            blk = s.nested[path]
+            parent = next(it)
+            nested[path] = dc_replace(blk, parent=parent,
+                                      child=rebuild(blk.child))
+        nested = {path: nested[path] for path in s.nested}
+        return dc_replace(s, live=live, nested=nested, **kinds)
+
+    return rebuild(seg)
 
 
 def layout_key(seg: DeviceSegment) -> tuple:
@@ -116,6 +133,10 @@ def layout_key(seg: DeviceSegment) -> tuple:
                 (tuple(getattr(col, attr).shape),
                  str(getattr(col, attr).dtype))
                 for attr in _ARRAYS[kind]))
+    for path in sorted(seg.nested):
+        blk = seg.nested[path]
+        out.append(("nested", path, tuple(blk.parent.shape),
+                    layout_key(blk.child)))
     return tuple(out)
 
 

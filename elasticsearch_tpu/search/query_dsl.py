@@ -184,6 +184,16 @@ class SpanNearQuery(Query):
 
 
 @dataclass
+class NestedQuery(Query):
+    """ref: core/index/query/NestedQueryParser.java — the inner query runs
+    over a path's nested objects; a parent matches when any of its objects
+    does, scored per score_mode."""
+    path: str = ""
+    query: Query | None = None
+    score_mode: str = "avg"            # avg | sum | max | min | none
+
+
+@dataclass
 class MoreLikeThisQuery(Query):
     """ref: core/index/query/MoreLikeThisQueryParser.java — select the
     like-input's most significant terms (tf·idf) and match on them."""
@@ -469,6 +479,20 @@ def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query
                              slop=int(qbody.get("slop", 0)),
                              in_order=bool(qbody.get("in_order", True)),
                              boost=float(qbody.get("boost", 1.0)))
+
+    if qtype == "nested":
+        if "path" not in qbody or "query" not in qbody:
+            raise QueryParsingError("[nested] requires 'path' and 'query'")
+        score_mode = str(qbody.get("score_mode", "avg")).lower()
+        if score_mode == "total":          # 2.x alias
+            score_mode = "sum"
+        if score_mode not in ("avg", "sum", "max", "min", "none"):
+            raise QueryParsingError(
+                f"illegal score_mode for nested query [{score_mode}]")
+        return NestedQuery(path=str(qbody["path"]),
+                           query=parse_query(qbody["query"]),
+                           score_mode=score_mode,
+                           boost=float(qbody.get("boost", 1.0)))
 
     if qtype in ("more_like_this", "mlt"):
         like_texts: list[str] = []

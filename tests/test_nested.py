@@ -1,0 +1,178 @@
+"""Nested mapping + nested query tests (ref: ObjectMapper Nested,
+core/index/query/NestedQueryParser.java): nested objects index as child
+rows invisible to flat queries, inner queries match WITHIN one object (no
+cross-object leakage), parents score per score_mode, and the child blocks
+survive flush/reopen and deletes."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search import jit_exec
+
+
+MAPPING = {"_doc": {"properties": {
+    "title": {"type": "text", "analyzer": "whitespace"},
+    "comments": {"type": "nested", "properties": {
+        "author": {"type": "keyword"},
+        "text": {"type": "text", "analyzer": "whitespace"},
+        "stars": {"type": "long"}}}}}}
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node({}, data_path=tmp_path / "n").start()
+    n.indices_service.create_index(
+        "idx", {"settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0},
+                "mappings": MAPPING})
+    n.index_doc("idx", "1", {
+        "title": "great hotel",
+        "comments": [{"author": "alice", "text": "loved the pool",
+                      "stars": 5},
+                     {"author": "bob", "text": "noisy room", "stars": 2}]})
+    n.index_doc("idx", "2", {
+        "title": "quiet inn",
+        "comments": [{"author": "alice", "text": "noisy street",
+                      "stars": 2}]})
+    n.index_doc("idx", "3", {"title": "no comments here"})
+    n.broadcast_actions.refresh("idx")
+    yield n
+    n.close()
+
+
+def _ids(resp):
+    return {h["_id"] for h in resp["hits"]["hits"]}
+
+
+def _search(node, body):
+    jit_exec.clear_cache()
+    out = node.search("idx", body)
+    assert jit_exec.cache_stats()["fallbacks"] == 0, "compiled path fell back"
+    return out
+
+
+class TestNestedSemantics:
+    def test_no_cross_object_leakage(self, node):
+        # alice + stars=2 in ONE object: only doc 2 (doc 1 has alice/5 and
+        # bob/2 — a flattened mapping would wrongly match it)
+        out = _search(node, {"query": {"nested": {
+            "path": "comments",
+            "query": {"bool": {
+                "must": [{"term": {"comments.author": "alice"}},
+                         {"term": {"comments.stars": 2}}]}}}}})
+        assert _ids(out) == {"2"}
+
+    def test_any_object_matches(self, node):
+        out = _search(node, {"query": {"nested": {
+            "path": "comments",
+            "query": {"match": {"comments.text": "noisy"}}}}})
+        assert _ids(out) == {"1", "2"}
+
+    def test_flat_query_cannot_see_nested_fields(self, node):
+        out = node.search("idx", {"query": {
+            "term": {"comments.author": "alice"}}})
+        assert _ids(out) == set()
+
+    def test_parent_without_objects_never_matches(self, node):
+        out = _search(node, {"query": {"nested": {
+            "path": "comments", "query": {"match_all": {}}}}})
+        assert _ids(out) == {"1", "2"}
+
+    def test_score_modes(self, node):
+        def score(mode):
+            out = _search(node, {"query": {"nested": {
+                "path": "comments", "score_mode": mode,
+                "query": {"match": {"comments.text": "noisy"}}}}})
+            return {h["_id"]: h["_score"] for h in out["hits"]["hits"]}
+        s_sum, s_max, s_avg = score("sum"), score("max"), score("avg")
+        s_none = score("none")
+        for did in ("1", "2"):
+            assert s_sum[did] >= s_max[did] >= s_avg[did] - 1e-6
+            assert s_none[did] == 1.0
+        # "total" 2.x alias == sum
+        assert score("total") == s_sum
+
+    def test_min_score_mode(self, node):
+        out = _search(node, {"query": {"nested": {
+            "path": "comments", "score_mode": "min",
+            "query": {"range": {"comments.stars": {"gte": 0}}}}}})
+        assert _ids(out) == {"1", "2"}
+
+    def test_bool_combination_with_flat(self, node):
+        out = _search(node, {"query": {"bool": {
+            "must": [{"match": {"title": "hotel"}},
+                     {"nested": {"path": "comments",
+                                 "query": {"term": {"comments.stars": 5}}}}]
+        }}})
+        assert _ids(out) == {"1"}
+
+
+class TestNestedLifecycle:
+    def test_delete_parent_removes_children(self, node):
+        node.document_actions.delete_doc("idx", "1")
+        node.broadcast_actions.refresh("idx")
+        out = _search(node, {"query": {"nested": {
+            "path": "comments",
+            "query": {"match": {"comments.text": "noisy"}}}}})
+        assert _ids(out) == {"2"}
+
+    def test_flush_reopen_keeps_nested(self, node, tmp_path):
+        node.broadcast_actions.flush("idx")
+        svc = node.indices_service.indices["idx"]
+        eng = svc.engine(0)
+        manifest = eng.file_manifest()
+        assert any("nested_comments" in f for f in manifest), \
+            "nested child files missing from the recovery manifest"
+        from elasticsearch_tpu.index.engine import Engine
+        from elasticsearch_tpu.index.segment import Segment
+        # reopen the committed segment files directly
+        seg_dirs = sorted(eng.path.glob("seg_*"))
+        assert seg_dirs
+        seg = Segment.read(seg_dirs[0])
+        assert "comments" in seg.nested_blocks
+        blk = seg.nested_blocks["comments"]
+        assert blk.segment.num_docs == 3          # three comment objects
+        assert (blk.parent[:3] >= 0).all()
+
+    def test_update_replaces_nested_rows(self, node):
+        node.index_doc("idx", "2", {"title": "quiet inn",
+                                    "comments": [{"author": "carol",
+                                                  "text": "peaceful stay",
+                                                  "stars": 4}]})
+        node.broadcast_actions.refresh("idx")
+        out = _search(node, {"query": {"nested": {
+            "path": "comments",
+            "query": {"term": {"comments.author": "alice"}}}}})
+        assert _ids(out) == {"1"}
+        out = _search(node, {"query": {"nested": {
+            "path": "comments",
+            "query": {"match": {"comments.text": "peaceful"}}}}})
+        assert _ids(out) == {"2"}
+
+
+class TestNestedParsing:
+    def test_mapping_roundtrip(self, node):
+        svc = node.indices_service.indices["idx"]
+        md = svc.mapper_service.mapping_dict()["_doc"]
+        assert md["properties"]["comments"]["type"] == "nested"
+        assert "author" in md["properties"]["comments"]["properties"]
+
+    def test_nested_in_nested_rejected(self, tmp_path):
+        from elasticsearch_tpu.common.errors import MapperParsingError
+        from elasticsearch_tpu.mapping import MapperService
+        ms = MapperService()
+        with pytest.raises(MapperParsingError):
+            ms.merge("_doc", {"properties": {"a": {
+                "type": "nested", "properties": {"b": {
+                    "type": "nested", "properties": {
+                        "x": {"type": "text"}}}}}}})
+
+    def test_invalid_score_mode(self):
+        from elasticsearch_tpu.common.errors import QueryParsingError
+        from elasticsearch_tpu.search.query_dsl import parse_query
+        with pytest.raises(QueryParsingError):
+            parse_query({"nested": {"path": "c", "query": {"match_all": {}},
+                                    "score_mode": "weird"}})
+        with pytest.raises(QueryParsingError):
+            parse_query({"nested": {"path": "c"}})
