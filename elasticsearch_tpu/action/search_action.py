@@ -150,8 +150,60 @@ def _fetch_mlt_likes(node, spec: dict, default_index: str) -> dict:
     return spec
 
 
+class ShardRequestCache:
+    """Shard request cache (ref:
+    core/indices/cache/request/IndicesRequestCache.java:78): caches whole
+    per-shard query+fetch payloads for hits-free requests (size 0 — the
+    count/agg shapes the reference caches), keyed by (index, shard, reader
+    generation, canonical request bytes). A refresh bumps the generation,
+    so stale entries simply stop being hit and age out of the LRU."""
+
+    def __init__(self, cap: int = 256):
+        from collections import OrderedDict
+        self.cap = cap
+        self._lru: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.stats = {"hits": 0, "misses": 0, "evictions": 0}
+
+    def key(self, engine_uuid: str, generation: int, body: dict,
+            dfs: dict | None):
+        # engine_uuid (an incarnation id) rather than (index, shard):
+        # delete+recreate of the same index restarts generations, and a
+        # name-keyed entry could otherwise serve the OLD index's results
+        return (engine_uuid, generation,
+                json.dumps(body, sort_keys=True),
+                json.dumps(dfs, sort_keys=True) if dfs else None)
+
+    def get(self, key) -> dict | None:
+        with self._lock:
+            out = self._lru.get(key)
+            if out is not None:
+                self._lru.move_to_end(key)
+                self.stats["hits"] += 1
+            else:
+                self.stats["misses"] += 1
+            return out
+
+    def put(self, key, payload: dict) -> None:
+        with self._lock:
+            self._lru[key] = payload
+            self._lru.move_to_end(key)
+            while len(self._lru) > self.cap:
+                self._lru.popitem(last=False)
+                self.stats["evictions"] += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._lru.clear()
+
+    def stats_dict(self) -> dict:
+        with self._lock:
+            return {**self.stats, "entries": len(self._lru)}
+
+
 class SearchActions:
     QUERY_FETCH = "indices:data/read/search[phase/query+fetch]"
+    MSEARCH_SHARD = "indices:data/read/msearch[shard]"
     DFS = "indices:data/read/search[phase/dfs]"
     FIELD_STATS = "indices:data/read/field_stats[s]"
 
@@ -167,7 +219,17 @@ class SearchActions:
             self.QUERY_FETCH, self._handle_shard_query, executor="search",
             sync=True)
         node.transport_service.register_request_handler(
+            self.MSEARCH_SHARD, self._handle_shard_msearch,
+            executor="search", sync=True)
+        node.transport_service.register_request_handler(
             self.DFS, self._handle_shard_dfs, executor="search", sync=True)
+        self.request_cache = ShardRequestCache(
+            cap=int(node.settings.get("indices.requests.cache.entries", 256))
+            if hasattr(node, "settings") else 256)
+        # dedicated pool for _msearch item fan-out: sharing _pool with the
+        # per-shard futures it spawns could deadlock at saturation
+        self._msearch_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="msearch")
         node.transport_service.register_request_handler(
             self.FIELD_STATS, self._handle_field_stats, executor="search",
             sync=True)
@@ -189,6 +251,7 @@ class SearchActions:
     def close(self):
         self._closed = True
         self._pool.shutdown(wait=False, cancel_futures=True)
+        self._msearch_pool.shutdown(wait=False, cancel_futures=True)
 
     # ---- data-node side ----------------------------------------------------
 
@@ -197,6 +260,69 @@ class SearchActions:
                                    request["body"],
                                    doc_slot=request.get("doc_slot"),
                                    dfs=request.get("dfs"))
+
+    def _handle_shard_msearch(self, request: dict, source) -> dict:
+        """Shard-side _msearch: B request bodies against one shard in ONE
+        batched device program when they share a plan
+        (ShardSearcher.query_phase_batch — the TPU-native multi-search),
+        per-request execution otherwise. → {"payloads": [per body]}."""
+        name, shard = request["index"], request["shard"]
+        bodies = request["bodies"]
+        svc = self.node.indices_service.index(name)
+        reader = device_reader_for(svc.engine(shard))
+        searcher = ShardSearcher(shard, reader, svc.mapper_service,
+                                 index_name=name,
+                                 doc_slot=request.get("doc_slot"))
+        reqs, errors = [], {}
+        for i, body in enumerate(bodies):
+            try:
+                reqs.append(parse_search_request(body))
+            except Exception as e:           # noqa: BLE001 — per-item error
+                reqs.append(None)
+                errors[i] = str(e)
+        valid = [(i, r) for i, r in enumerate(reqs) if r is not None]
+        results: dict[int, object] = {}
+        try:
+            batch = searcher.query_phase_batch([r for _, r in valid]) \
+                if valid else []
+        except Exception:                    # noqa: BLE001 — isolate items
+            batch = None
+        if batch is not None:
+            for (i, _), res in zip(valid, batch):
+                results[i] = res
+        else:
+            for i, r in valid:
+                try:
+                    results[i] = searcher.query_phase(r)
+                except Exception as e:       # noqa: BLE001 — per-item error
+                    errors[i] = str(e)       # others must still succeed
+        payloads = []
+        for i, body in enumerate(bodies):
+            if i in errors:
+                payloads.append({"error": errors[i]})
+                continue
+            req, result = reqs[i], results[i]
+            try:
+                k = min(len(result.doc_ids), req.from_ + req.size)
+                hits = searcher.fetch_phase(req, result, name,
+                                            list(range(k)))
+                out = {
+                    "total": result.total,
+                    "max_score": (float(result.max_score)
+                                  if result.max_score is not None else None),
+                    "hits": hits, "aggs": wire_safe(result.agg_partials),
+                    "terminated_early": result.terminated_early,
+                    "timed_out": result.timed_out}
+                if req.suggest:
+                    from elasticsearch_tpu.search.suggest import \
+                        ShardSuggester
+                    sg = ShardSuggester(reader, svc.mapper_service)
+                    out["suggest"] = {spec.name: sg.collect(spec)
+                                      for spec in req.suggest}
+                payloads.append(out)
+            except Exception as e:           # noqa: BLE001 — per-item error
+                payloads.append({"error": str(e)})
+        return {"payloads": payloads}
 
     def _handle_shard_dfs(self, request: dict, source) -> dict:
         """DFS phase (DfsPhase.execute analog): term/collection statistics
@@ -216,6 +342,17 @@ class SearchActions:
         svc = self.node.indices_service.index(name)
         engine = svc.engine(shard)
         reader = device_reader_for(engine)
+        # shard request cache: hits-free (size 0) requests keyed by reader
+        # generation + request bytes (IndicesRequestCache.java:78); gated
+        # by index.requests.cache.enable
+        cache_key = None
+        if body.get("size") == 0 and str(svc.index_settings.get(
+                "index.requests.cache.enable", "true")).lower() != "false":
+            cache_key = self.request_cache.key(engine.engine_uuid,
+                                               reader.generation, body, dfs)
+            cached = self.request_cache.get(cache_key)
+            if cached is not None:
+                return cached
         # per-request scratch accounting (request breaker): score + mask
         # arrays over every doc of the shard
         breaker = None
@@ -251,6 +388,11 @@ class SearchActions:
             svc.search_slow_log.maybe_log(
                 time.perf_counter() - t0,
                 f"shard[{shard}], source[{json.dumps(body)[:512]}]")
+        if cache_key is not None and not out.get("timed_out") \
+                and not out.get("terminated_early"):
+            # partial results must not pin themselves until the next
+            # refresh (the reference cache refuses timed-out entries too)
+            self.request_cache.put(cache_key, out)
         return out
 
     # ---- coordinator -------------------------------------------------------
@@ -412,6 +554,86 @@ class SearchActions:
         return {"count": resp["hits"]["total"]["value"],
                 "_shards": resp["_shards"]}
 
+    # ---- _msearch (ref: core/action/search/TransportMultiSearchAction) ----
+
+    def multi_search(self, items: list[tuple[str, dict]]) -> dict:
+        """Execute B (index_expr, body) search items → {"responses": [...]}.
+
+        Consecutive items on the SAME index expression batch into one
+        shard fan-out carrying every body — each data node then runs the
+        whole batch as one vmapped program when the plans align (the
+        reference fans request-at-a-time; an accelerator wants the batch).
+        Per-item failures return an {"error": ...} entry (the _msearch
+        contract), never failing the whole request.
+        """
+        responses: list[dict | None] = [None] * len(items)
+        groups: list[tuple[str, list[int]]] = []
+        for i, (index_expr, _body) in enumerate(items):
+            if groups and groups[-1][0] == index_expr:
+                groups[-1][1].append(i)
+            else:
+                groups.append((index_expr, [i]))
+        futures = [self._msearch_pool.submit(self._msearch_group,
+                                             expr, [items[i][1] for i in idxs])
+                   for expr, idxs in groups]
+        for (expr, idxs), fut in zip(groups, futures):
+            try:
+                outs = fut.result()
+            except Exception as e:           # noqa: BLE001 — per-group error
+                outs = [{"error": {"type": "search_phase_execution_exception",
+                                   "reason": str(e)}}] * len(idxs)
+            for i, out in zip(idxs, outs):
+                responses[i] = out
+        return {"responses": responses}
+
+    def _msearch_group(self, index_expr: str, bodies: list[dict]) -> list[dict]:
+        """One shard fan-out for a group of bodies on one index expr."""
+        t0 = time.perf_counter()
+        names = self.node.indices_service.resolve(index_expr)
+        bodies = [rewrite_mlt_likes(self.node, b,
+                                    names[0] if names else "_all")
+                  for b in bodies]
+        state = self.node.cluster_service.state()
+        groups = self._shard_groups(state, names)
+        slot_of = {(n, s): i for i, (n, s) in
+                   enumerate(sorted((n, s) for n, s, _ in groups))}
+        futures = [self._pool.submit(
+            self._try_shard_action, state, n, s, copies, self.MSEARCH_SHARD,
+            self._handle_shard_msearch, None,
+            extra={"bodies": bodies, "doc_slot": slot_of[(n, s)]})
+            for n, s, copies in groups]
+        per_shard, failures = [], []
+        for fut in futures:
+            status, payload = fut.result()
+            if status == "ok":
+                per_shard.append(payload["payloads"])
+            else:
+                failures.append(payload)
+        took = (time.perf_counter() - t0) * 1e3
+        outs = []
+        for bi, body in enumerate(bodies):
+            item_payloads, item_error = [], None
+            for shard_payloads in per_shard:
+                p = shard_payloads[bi]
+                if "error" in p:
+                    item_error = p["error"]
+                else:
+                    item_payloads.append(p)
+            if item_error is not None and not item_payloads:
+                outs.append({"error": {"type": "parsing_exception",
+                                       "reason": item_error}})
+                continue
+            try:
+                req = parse_search_request(body)
+            except Exception as e:           # noqa: BLE001 — per-item error
+                outs.append({"error": {"type": "parsing_exception",
+                                       "reason": str(e)}})
+                continue
+            outs.append(merge_shard_payloads(
+                req, item_payloads, took, total_shards=len(groups),
+                failures=failures))
+        return outs
+
     # ---- field stats (core/action/fieldstats/TransportFieldStatsAction) ----
 
     def field_stats(self, index_expr: str, fields: list[str]) -> dict:
@@ -461,13 +683,14 @@ class SearchActions:
                 "indices": {"_all": {"fields": merged}}}
 
     def _try_shard_action(self, state, name, sid, copies, action,
-                          local_handler, body):
+                          local_handler, body, extra: dict | None = None):
         """Copy-failover for non-search per-shard actions."""
         from elasticsearch_tpu.action.replication import unwrap_remote
         last = None
         for c in copies:
             try:
-                request = {"index": name, "shard": sid, "body": body}
+                request = {"index": name, "shard": sid, "body": body,
+                           **(extra or {})}
                 if c.node_id == self.node.node_id:
                     return "ok", local_handler(request, None)
                 target = state.node(c.node_id)

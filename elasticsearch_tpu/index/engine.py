@@ -101,6 +101,11 @@ class Engine:
                  settings: Settings = Settings.EMPTY):
         self.path = Path(shard_path)
         self.path.mkdir(parents=True, exist_ok=True)
+        # engine incarnation id: distinguishes delete+recreate of the same
+        # index/shard in caches keyed by reader generation (a recreated
+        # engine restarts generations from 0)
+        import uuid as _uuid
+        self.engine_uuid = _uuid.uuid4().hex
         self.mapper_service = mapper_service
         self.settings = settings
         self.stats = EngineStats()

@@ -387,6 +387,8 @@ class Node:
                     "queue": pool._work_queue.qsize(),
                 }
         recovery = getattr(self, "recovery_service", None)
+        indices_total["request_cache"] = \
+            self.search_actions.request_cache.stats_dict()
         return {
             "name": self.node_name,
             "timestamp": int(time.time() * 1000),

@@ -85,6 +85,10 @@ def register_all(rc: RestController, node) -> None:
     # search family
     r("GET", "/_search", h.search_all)
     r("POST", "/_search", h.search_all)
+    r("GET", "/_msearch", h.msearch)
+    r("POST", "/_msearch", h.msearch)
+    r("GET", "/{index}/_msearch", h.msearch)
+    r("POST", "/{index}/_msearch", h.msearch)
     r("GET", "/{index}/_search", h.search)
     r("POST", "/{index}/_search", h.search)
     r("GET", "/{index}/_count", h.count)
@@ -454,6 +458,30 @@ class Handlers:
         if req.param("_source") in ("false", "true"):
             body["_source"] = req.param("_source") == "true"
         return body
+
+    def msearch(self, req: RestRequest):
+        """NDJSON multi-search (ref: RestMultiSearchAction): alternating
+        header/body lines; header may name the index (else the URL's)."""
+        from elasticsearch_tpu.common.errors import IllegalArgumentError
+        default_index = req.path_params.get("index", "_all")
+        lines = [ln for ln in req.raw_body.decode("utf-8").splitlines()
+                 if ln.strip()]
+        if len(lines) % 2 != 0:
+            raise IllegalArgumentError(
+                "msearch body must be header/body line pairs")
+        items = []
+        for i in range(0, len(lines), 2):
+            try:
+                header = json.loads(lines[i])
+                body = json.loads(lines[i + 1])
+            except json.JSONDecodeError as e:
+                raise IllegalArgumentError(
+                    f"malformed msearch body at line {i + 1}: {e}") from None
+            index = header.get("index", default_index) or default_index
+            if isinstance(index, list):
+                index = ",".join(index)
+            items.append((index, body))
+        return 200, self.node.search_actions.multi_search(items)
 
     def search(self, req: RestRequest):
         resp = self.node.search(req.path_params["index"],

@@ -36,6 +36,18 @@ from elasticsearch_tpu.search.query_dsl import parse_query
 
 
 @dataclass
+class RescoreSpec:
+    """One rescore pass (ref: core/search/rescore/QueryRescorer.java +
+    RescoreParseElement): re-rank the top window_size hits of each shard
+    by combining the primary score with a rescore-query score."""
+    query: q.Query
+    window_size: int = 10
+    query_weight: float = 1.0
+    rescore_query_weight: float = 1.0
+    score_mode: str = "total"          # total | multiply | avg | max | min
+
+
+@dataclass
 class ParsedSearchRequest:
     query: q.Query
     from_: int = 0
@@ -54,6 +66,7 @@ class ParsedSearchRequest:
     stored_fields: list = field(default_factory=list)
     terminate_after: int | None = None             # per-shard collected cap
     timeout_ms: float | None = None                # per-shard time budget
+    rescore: list[RescoreSpec] = field(default_factory=list)
 
 
 def parse_search_request(body: dict | None) -> ParsedSearchRequest:
@@ -88,6 +101,29 @@ def parse_search_request(body: dict | None) -> ParsedSearchRequest:
         req.timeout_ms = parse_time_value(body["timeout"], "timeout") * 1000.0
     from elasticsearch_tpu.search.suggest import parse_suggest
     req.suggest = parse_suggest(body.get("suggest"))
+    raw_rescore = body.get("rescore")
+    if raw_rescore:
+        if isinstance(raw_rescore, dict):
+            raw_rescore = [raw_rescore]
+        for spec in raw_rescore:
+            inner = spec.get("query", {})
+            if "rescore_query" not in inner:
+                raise QueryParsingError("rescore requires [rescore_query]")
+            mode = str(inner.get("score_mode", "total")).lower()
+            if mode not in ("total", "multiply", "avg", "max", "min"):
+                raise QueryParsingError(
+                    f"illegal rescore score_mode [{mode}]")
+            req.rescore.append(RescoreSpec(
+                query=parse_query(inner["rescore_query"]),
+                window_size=int(spec.get("window_size", 10)),
+                query_weight=float(inner.get("query_weight", 1.0)),
+                rescore_query_weight=float(
+                    inner.get("rescore_query_weight", 1.0)),
+                score_mode=mode))
+        if req.sort:
+            raise QueryParsingError(
+                "rescore cannot be combined with sort (QueryRescorer "
+                "re-ranks by score)")
     return req
 
 
@@ -171,6 +207,10 @@ class ShardSearcher:
         normally without double execution."""
         from elasticsearch_tpu.search import jit_exec
         k = max(req.from_ + req.size, 1)
+        if req.rescore:
+            # the shard must collect at least the largest rescore window
+            # (QueryRescorer re-ranks the top window of EACH shard)
+            k = max(k, max(s.window_size for s in req.rescore))
         score_order = _is_score_order(req.sort)
         need_arrays = bool(req.aggs) or not score_order
         sa = req.search_after if (req.search_after is not None
@@ -242,6 +282,8 @@ class ShardSearcher:
                                            agg_partials)
         res.terminated_early = terminated_early
         res.timed_out = timed_out
+        if req.rescore and res.sort_values is None:
+            self._apply_rescore(req, res)
         return res
 
     def query_phase_batch(self, reqs: list[ParsedSearchRequest]
@@ -269,7 +311,7 @@ class ShardSearcher:
                     or req.min_score is not None
                     or req.search_after is not None or req.suggest
                     or req.terminate_after is not None
-                    or req.timeout_ms is not None):
+                    or req.timeout_ms is not None or req.rescore):
                 return None
         k = max(max(req.from_ + req.size, 1) for req in reqs)
         queries = [req.query for req in reqs]
@@ -315,6 +357,45 @@ class ShardSearcher:
                 self.reader))
         return results
 
+    def _apply_rescore(self, req: ParsedSearchRequest,
+                       res: ShardQueryResult) -> None:
+        """Re-rank the top window of this shard's hits per rescore pass
+        (QueryRescorer.rescore: docs matching the rescore query combine
+        primary×query_weight with secondary×rescore_query_weight; docs not
+        matching keep primary×query_weight; only the window re-sorts)."""
+        if not len(res.doc_ids):
+            return
+        scores = res.scores.astype(np.float32).copy()
+        docs = res.doc_ids.copy()
+        for spec in req.rescore:
+            window = min(spec.window_size, len(docs))
+            if window <= 0:
+                continue
+            per_seg = self._execute_query(spec.query)
+            sec_scores = np.concatenate(
+                [np.asarray(s) for s, _ in per_seg])
+            sec_mask = np.concatenate([np.asarray(m) for _, m in per_seg])
+            d = docs[:window]
+            prim = scores[:window] * np.float32(spec.query_weight)
+            sec = sec_scores[d] * np.float32(spec.rescore_query_weight)
+            if spec.score_mode == "total":
+                comb = prim + sec
+            elif spec.score_mode == "multiply":
+                comb = prim * sec
+            elif spec.score_mode == "avg":
+                comb = (prim + sec) / 2.0
+            elif spec.score_mode == "max":
+                comb = np.maximum(prim, sec)
+            else:                          # min
+                comb = np.minimum(prim, sec)
+            comb = np.where(sec_mask[d], comb, prim).astype(np.float32)
+            order = np.lexsort((d, -comb))  # score desc, doc-id tie-break
+            docs[:window] = d[order]
+            scores[:window] = comb[order]
+        res.doc_ids = docs
+        res.scores = scores
+        res.max_score = float(scores[0]) if len(scores) else None
+
     def _collect_aggs(self, req: ParsedSearchRequest,
                       masks: list, scores: list) -> dict:
         """Run top-level agg collectors over the (pre-post_filter) mask —
@@ -352,6 +433,8 @@ class ShardSearcher:
         are pre-min_score/post_filter — a coarser budget than the jit
         path's, acceptable for the fallback seam)."""
         k = max(req.from_ + req.size, 1)
+        if req.rescore:
+            k = max(k, max(s.window_size for s in req.rescore))
         terminated_early = timed_out = False
         deadline = None if req.timeout_ms is None \
             else time.monotonic() + req.timeout_ms / 1000.0
@@ -429,6 +512,8 @@ class ShardSearcher:
                                            agg_partials)
         res.terminated_early = terminated_early
         res.timed_out = timed_out
+        if req.rescore and res.sort_values is None:
+            self._apply_rescore(req, res)
         return res
 
     def _sorted_query(self, req, per_seg, total, agg_partials,

@@ -1,0 +1,170 @@
+"""Rescore window (QueryRescorer), _msearch (TransportMultiSearchAction /
+RestMultiSearchAction) and the shard request cache
+(IndicesRequestCache.java:78)."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.errors import QueryParsingError
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search.phase import parse_search_request
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node({}, data_path=tmp_path / "n").start()
+    n.indices_service.create_index(
+        "idx", {"settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0},
+                "mappings": {"_doc": {"properties": {
+                    "t": {"type": "text", "analyzer": "whitespace"},
+                    "n": {"type": "long"}}}}})
+    docs = ["quick fox", "quick brown fox jumps high", "quick quick fox",
+            "brown dog", "fox brown quick"]
+    for i, t in enumerate(docs):
+        n.index_doc("idx", str(i), {"t": t, "n": i})
+    n.broadcast_actions.refresh("idx")
+    yield n
+    n.close()
+
+
+class TestRescore:
+    def test_parse_validation(self):
+        with pytest.raises(QueryParsingError):
+            parse_search_request({"query": {"match_all": {}},
+                                  "rescore": {"query": {}}})
+        with pytest.raises(QueryParsingError):
+            parse_search_request({
+                "query": {"match_all": {}}, "sort": [{"n": "asc"}],
+                "rescore": {"query": {"rescore_query": {"match_all": {}}}}})
+
+    def test_total_mode_promotes_matches(self, node):
+        base = node.search("idx", {"query": {"match": {"t": "quick"}},
+                                   "size": 10})
+        base_scores = {h["_id"]: h["_score"] for h in base["hits"]["hits"]}
+        out = node.search("idx", {
+            "query": {"match": {"t": "quick"}}, "size": 10,
+            "rescore": {"window_size": 10, "query": {
+                "rescore_query": {"match": {"t": "brown"}},
+                "rescore_query_weight": 10.0}}})
+        got = {h["_id"]: h["_score"] for h in out["hits"]["hits"]}
+        assert set(got) == set(base_scores)       # same matches, new order
+        # brown-matching docs gained; non-matching kept primary score
+        assert got["0"] == pytest.approx(base_scores["0"], rel=1e-5)
+        assert got["1"] > base_scores["1"]
+        top = out["hits"]["hits"][0]["_id"]
+        assert top in ("1", "4")                   # quick + brown docs
+        # response ordered by the combined score
+        scores = [h["_score"] for h in out["hits"]["hits"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_window_limits_rescoring(self, node):
+        out = node.search("idx", {
+            "query": {"match": {"t": "quick"}}, "size": 10,
+            "rescore": {"window_size": 1, "query": {
+                "rescore_query": {"match": {"t": "brown"}},
+                "rescore_query_weight": 100.0}}})
+        # only the single top hit could be re-ranked; hits beyond the
+        # window keep their primary order/scores
+        assert len(out["hits"]["hits"]) == 4
+
+    def test_multiply_mode(self, node):
+        out = node.search("idx", {
+            "query": {"match": {"t": "quick"}}, "size": 10,
+            "rescore": {"window_size": 10, "query": {
+                "rescore_query": {"constant_score": {
+                    "filter": {"term": {"t": "brown"}}, "boost": 3.0}},
+                "score_mode": "multiply"}}})
+        base = node.search("idx", {"query": {"match": {"t": "quick"}},
+                                   "size": 10})
+        b = {h["_id"]: h["_score"] for h in base["hits"]["hits"]}
+        g = {h["_id"]: h["_score"] for h in out["hits"]["hits"]}
+        assert g["1"] == pytest.approx(3.0 * b["1"], rel=1e-5)
+        assert g["0"] == pytest.approx(b["0"], rel=1e-5)
+
+
+class TestMsearch:
+    def test_multi_search_batches(self, node):
+        items = [("idx", {"query": {"match": {"t": f"{w}"}}, "size": 10})
+                 for w in ("quick", "fox", "brown")]
+        out = node.search_actions.multi_search(items)
+        assert len(out["responses"]) == 3
+        for resp, w in zip(out["responses"], ("quick", "fox", "brown")):
+            single = node.search("idx", {"query": {"match": {"t": w}},
+                                         "size": 10})
+            assert resp["hits"]["total"] == single["hits"]["total"]
+            assert [h["_id"] for h in resp["hits"]["hits"]] == \
+                [h["_id"] for h in single["hits"]["hits"]]
+
+    def test_per_item_errors(self, node):
+        items = [("idx", {"query": {"match": {"t": "quick"}}}),
+                 ("idx", {"query": {"definitely_not_a_query": {}}})]
+        out = node.search_actions.multi_search(items)
+        assert "hits" in out["responses"][0]
+        assert "error" in out["responses"][1]
+
+    def test_rest_ndjson(self, node, tmp_path):
+        # drive through a REST controller wired to the node
+        from elasticsearch_tpu.rest.controller import RestController
+        from elasticsearch_tpu.rest.handlers import register_all
+        controller = RestController()
+        register_all(controller, node)
+        body = (json.dumps({}) + "\n" +
+                json.dumps({"query": {"match": {"t": "quick"}}}) + "\n" +
+                json.dumps({"index": "idx"}) + "\n" +
+                json.dumps({"query": {"match": {"t": "dog"}}}) + "\n")
+        status, resp = controller.dispatch(
+            "POST", "/idx/_msearch", body.encode())
+        assert status == 200
+        assert len(resp["responses"]) == 2
+        assert resp["responses"][0]["hits"]["total"]["value"] == 4
+        assert resp["responses"][1]["hits"]["total"]["value"] == 1
+
+
+class TestRequestCache:
+    def test_size0_cached_and_invalidated_by_refresh(self, node):
+        cache = node.search_actions.request_cache
+        cache.clear()
+        body = {"query": {"match": {"t": "quick"}}, "size": 0}
+        before = cache.stats_dict()
+        r1 = node.search("idx", body)
+        mid = cache.stats_dict()
+        assert mid["misses"] == before["misses"] + 1
+        r2 = node.search("idx", body)
+        after = cache.stats_dict()
+        assert after["hits"] == mid["hits"] + 1
+        assert r1["hits"]["total"] == r2["hits"]["total"]
+        # indexing + refresh bumps the reader generation → fresh entry
+        node.index_doc("idx", "99", {"t": "quick quick"})
+        node.broadcast_actions.refresh("idx")
+        r3 = node.search("idx", body)
+        assert r3["hits"]["total"]["value"] == \
+            r1["hits"]["total"]["value"] + 1
+        final = cache.stats_dict()
+        assert final["misses"] == after["misses"] + 1
+
+    def test_sized_requests_not_cached(self, node):
+        cache = node.search_actions.request_cache
+        cache.clear()
+        body = {"query": {"match": {"t": "quick"}}, "size": 5}
+        node.search("idx", body)
+        node.search("idx", body)
+        st = cache.stats_dict()
+        assert st["hits"] == 0 and st["misses"] == 0
+
+    def test_cache_disabled_by_setting(self, node):
+        node.indices_service.update_settings(
+            "idx", {"index.requests.cache.enable": "false"})
+        cache = node.search_actions.request_cache
+        cache.clear()
+        body = {"query": {"match": {"t": "quick"}}, "size": 0}
+        node.search("idx", body)
+        node.search("idx", body)
+        st = cache.stats_dict()
+        assert st["hits"] == 0 and st["misses"] == 0
+
+    def test_stats_in_nodes_stats(self, node):
+        out = node.collect_nodes_stats()
+        for stats in out["nodes"].values():
+            assert "request_cache" in stats["indices"]
