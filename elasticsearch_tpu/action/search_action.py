@@ -431,9 +431,19 @@ class SearchActions:
         for c in copies:
             try:
                 if c.node_id == self.node.node_id:
-                    return "ok", self._execute_shard(name, sid, body,
-                                                     doc_slot=doc_slot,
-                                                     dfs=dfs)
+                    # local copies still execute ON the bounded search pool
+                    # (the reference dispatches local shard ops to the
+                    # SEARCH threadpool too) so saturation rejects instead
+                    # of queueing unboundedly; a rejection fails over to
+                    # the next copy like any shard failure
+                    fut = self.node.thread_pool.submit(
+                        "search", self._execute_shard, name, sid, body,
+                        doc_slot=doc_slot, dfs=dfs)
+                    try:
+                        return "ok", fut.result(35.0)
+                    except Exception:
+                        fut.cancel()     # don't leave abandoned work queued
+                        raise
                 target = state.node(c.node_id)
                 if target is None:
                     continue
@@ -587,12 +597,27 @@ class SearchActions:
         return {"responses": responses}
 
     def _msearch_group(self, index_expr: str, bodies: list[dict]) -> list[dict]:
-        """One shard fan-out for a group of bodies on one index expr."""
+        """One shard fan-out for a group of bodies on one index expr.
+        Bodies are parsed ONCE here — invalid items answer immediately and
+        never ship; per-item SHARD errors surface as that item's shard
+        failures (partial results stay visible as partial)."""
         t0 = time.perf_counter()
         names = self.node.indices_service.resolve(index_expr)
         bodies = [rewrite_mlt_likes(self.node, b,
                                     names[0] if names else "_all")
                   for b in bodies]
+        outs: list[dict | None] = [None] * len(bodies)
+        parsed: dict[int, object] = {}
+        for i, body in enumerate(bodies):
+            try:
+                parsed[i] = parse_search_request(body)
+            except Exception as e:           # noqa: BLE001 — per-item error
+                outs[i] = {"error": {"type": "parsing_exception",
+                                     "reason": str(e)}}
+        valid = sorted(parsed)
+        if not valid:
+            return [o for o in outs]
+        send_bodies = [bodies[i] for i in valid]
         state = self.node.cluster_service.state()
         groups = self._shard_groups(state, names)
         slot_of = {(n, s): i for i, (n, s) in
@@ -600,39 +625,31 @@ class SearchActions:
         futures = [self._pool.submit(
             self._try_shard_action, state, n, s, copies, self.MSEARCH_SHARD,
             self._handle_shard_msearch, None,
-            extra={"bodies": bodies, "doc_slot": slot_of[(n, s)]})
+            extra={"bodies": send_bodies, "doc_slot": slot_of[(n, s)]})
             for n, s, copies in groups]
-        per_shard, failures = [], []
+        per_shard, group_failures = [], []
         for fut in futures:
             status, payload = fut.result()
             if status == "ok":
                 per_shard.append(payload["payloads"])
             else:
-                failures.append(payload)
+                group_failures.append(payload)
         took = (time.perf_counter() - t0) * 1e3
-        outs = []
-        for bi, body in enumerate(bodies):
-            item_payloads, item_error = [], None
+        for pos, i in enumerate(valid):
+            item_payloads = []
+            item_failures = list(group_failures)
             for shard_payloads in per_shard:
-                p = shard_payloads[bi]
+                p = shard_payloads[pos]
                 if "error" in p:
-                    item_error = p["error"]
+                    item_failures.append({"reason": {
+                        "type": "shard_search_failure",
+                        "reason": p["error"]}})
                 else:
                     item_payloads.append(p)
-            if item_error is not None and not item_payloads:
-                outs.append({"error": {"type": "parsing_exception",
-                                       "reason": item_error}})
-                continue
-            try:
-                req = parse_search_request(body)
-            except Exception as e:           # noqa: BLE001 — per-item error
-                outs.append({"error": {"type": "parsing_exception",
-                                       "reason": str(e)}})
-                continue
-            outs.append(merge_shard_payloads(
-                req, item_payloads, took, total_shards=len(groups),
-                failures=failures))
-        return outs
+            outs[i] = merge_shard_payloads(
+                parsed[i], item_payloads, took, total_shards=len(groups),
+                failures=item_failures)
+        return [o for o in outs]
 
     # ---- field stats (core/action/fieldstats/TransportFieldStatsAction) ----
 
@@ -692,7 +709,16 @@ class SearchActions:
                 request = {"index": name, "shard": sid, "body": body,
                            **(extra or {})}
                 if c.node_id == self.node.node_id:
-                    return "ok", local_handler(request, None)
+                    # same bounded-search-pool dispatch as _try_shard:
+                    # local msearch/DFS/field_stats work must not bypass
+                    # the backpressure the remote path gets
+                    fut = self.node.thread_pool.submit(
+                        "search", local_handler, request, None)
+                    try:
+                        return "ok", fut.result(35.0)
+                    except Exception:
+                        fut.cancel()
+                        raise
                 target = state.node(c.node_id)
                 if target is None:
                     continue

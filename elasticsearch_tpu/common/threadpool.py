@@ -1,0 +1,196 @@
+"""ThreadPool — named, sized, bounded-queue executors per workload class.
+
+The reference's concurrency model (core/threadpool/ThreadPool.java:70-129):
+every workload class gets its own fixed-size pool with a BOUNDED queue, and
+submissions beyond queue capacity are REJECTED (EsRejectedExecutionException
+→ HTTP 429) instead of silently piling up — that rejection IS the
+backpressure signal: a search storm saturates the search pool and starts
+bouncing requests while the index/bulk pools keep writing.
+
+Sizing follows the reference defaults (ThreadPool.java:122-129): search =
+3·cores/2+1 with queue 1000, index = cores with queue 200, bulk = cores
+with queue 50, get = cores with queue 1000; management/refresh/flush/
+snapshot are small scaling pools with unbounded queues (rejections there
+would lose housekeeping work, not shed load).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from concurrent.futures import Future
+
+from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+
+
+class EsRejectedExecutionError(ElasticsearchTpuError):
+    """core/util/concurrent/EsRejectedExecutionException — mapped to 429."""
+
+    status = 429
+    error_type = "es_rejected_execution_exception"
+
+
+_POISON = object()
+
+
+class FixedThreadPool:
+    """Fixed worker count + bounded queue + rejection — the reference's
+    EsThreadPoolExecutor with an EsAbortPolicy."""
+
+    def __init__(self, name: str, size: int, queue_size: int):
+        self.name = name
+        self.size = size
+        self.queue_size = queue_size           # <= 0: unbounded
+        self._q: queue.Queue = queue.Queue(
+            maxsize=queue_size if queue_size > 0 else 0)
+        self._lock = threading.Lock()
+        self.active = 0
+        self.completed = 0
+        self.rejected = 0
+        self._closed = False
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"[{name}][{i}]")
+            for i in range(size)]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, fn, *args, **kwargs) -> Future:
+        """→ Future; raises EsRejectedExecutionError when the queue is at
+        capacity (never blocks the submitter)."""
+        if self._closed:
+            raise EsRejectedExecutionError(
+                f"rejected execution on [{self.name}] (pool closed)")
+        fut: Future = Future()
+        try:
+            self._q.put_nowait((fut, fn, args, kwargs))
+        except queue.Full:
+            with self._lock:
+                self.rejected += 1
+            raise EsRejectedExecutionError(
+                f"rejected execution of [{getattr(fn, '__name__', fn)}] on "
+                f"[{self.name}]: queue capacity {self.queue_size} reached"
+            ) from None
+        return fut
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _POISON:
+                return
+            fut, fn, args, kwargs = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            with self._lock:
+                self.active += 1
+            try:
+                fut.set_result(fn(*args, **kwargs))
+            except BaseException as e:         # noqa: BLE001 — to the future
+                fut.set_exception(e)
+            finally:
+                with self._lock:
+                    self.active -= 1
+                    self.completed += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"threads": self.size,
+                    "queue": self._q.qsize(),
+                    "queue_size": self.queue_size,
+                    "active": self.active,
+                    "rejected": self.rejected,
+                    "completed": self.completed}
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        # drain queued work first (cancel futures so waiters unblock) —
+        # otherwise a full queue would swallow the poison pills and leave
+        # workers running forever
+        try:
+            while True:
+                item = self._q.get_nowait()
+                if item is not _POISON:
+                    item[0].cancel()
+        except queue.Empty:
+            pass
+        for _ in self._threads:
+            self._q.put(_POISON)   # workers consume; queue was just drained
+
+
+def _cores() -> int:
+    return os.cpu_count() or 4
+
+
+# name → (size, queue_size); callables defer to runtime core count
+_DEFAULTS = {
+    "generic": (lambda c: max(4, c // 2), -1),
+    "search": (lambda c: 3 * c // 2 + 1, 1000),
+    "index": (lambda c: c, 200),
+    "bulk": (lambda c: c, 50),
+    # replica ops run on their own UNBOUNDED pool: a primary blocks on its
+    # replicas' acks, so sharing (or bounding) this pool could deadlock or
+    # fail writes the primary already applied locally (the transport-layer
+    # comment in transport/service.py documents the deadlock shape)
+    "replica": (lambda c: c, -1),
+    "get": (lambda c: c, 1000),
+    "management": (lambda c: 5, -1),
+    "refresh": (lambda c: max(1, c // 10), -1),
+    "flush": (lambda c: max(1, c // 2), -1),
+    "snapshot": (lambda c: max(1, c // 2), -1),
+    "warmer": (lambda c: max(1, c // 2), -1),
+    "suggest": (lambda c: c, 1000),
+    "percolate": (lambda c: c, 1000),
+}
+
+
+class ThreadPool:
+    """The node's pool registry. Sizes/queues override via settings:
+    ``threadpool.<name>.size`` / ``threadpool.<name>.queue_size``
+    (the reference's static threadpool settings)."""
+
+    def __init__(self, settings=None):
+        get = settings.get if settings is not None else lambda *a: None
+        cores = _cores()
+        self._pools: dict[str, FixedThreadPool] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self._overrides = {}
+        for name, (size_fn, qsize) in _DEFAULTS.items():
+            size = int(get(f"threadpool.{name}.size") or size_fn(cores))
+            q = int(get(f"threadpool.{name}.queue_size") or qsize)
+            self._overrides[name] = (size, q)
+
+    def executor(self, name: str) -> FixedThreadPool:
+        with self._lock:
+            if self._closed:
+                # never resurrect pools after node close — a late transport
+                # dispatch would otherwise leak a full thread complement
+                raise EsRejectedExecutionError(
+                    f"rejected execution on [{name}] (thread pool closed)")
+            pool = self._pools.get(name)
+            if pool is None:
+                size, qsize = self._overrides.get(
+                    name, (max(4, _cores() // 2), -1))
+                pool = FixedThreadPool(name, size, qsize)
+                self._pools[name] = pool
+            return pool
+
+    def submit(self, name: str, fn, *args, **kwargs) -> Future:
+        return self.executor(name).submit(fn, *args, **kwargs)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {name: pool.stats()
+                    for name, pool in sorted(self._pools.items())}
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+            pools = list(self._pools.values())
+            self._pools.clear()
+        for pool in pools:
+            pool.shutdown()

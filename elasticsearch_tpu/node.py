@@ -59,10 +59,13 @@ class Node:
         hub = self._hub or LocalTransportHub()
         attrs = (("data", self.settings.get("node.data", "true")),
                  ("master", self.settings.get("node.master", "true")))
+        from elasticsearch_tpu.common.threadpool import ThreadPool
+        self.thread_pool = ThreadPool(self.settings)
         self.transport_service = TransportService(
             LocalTransport(hub),
             lambda addr: DiscoveryNode(self.node_id, self.node_name, addr,
-                                       attributes=attrs))
+                                       attributes=attrs),
+            thread_pool=self.thread_pool)
         self.allocation = AllocationService()
         cluster_name = self.settings.get("cluster.name", "elasticsearch-tpu")
         self.cluster_service = ClusterService(
@@ -378,14 +381,7 @@ class Node:
                 s["indexing"]["index_total"]
             indices_total["indexing"]["index_time_in_millis"] += \
                 s["indexing"]["index_time_in_millis"]
-        pools = {}
-        ts = self.transport_service
-        with ts._pools_lock:
-            for name, pool in ts._pools.items():
-                pools[name] = {
-                    "threads": len(getattr(pool, "_threads", ())),
-                    "queue": pool._work_queue.qsize(),
-                }
+        pools = self.thread_pool.stats()
         recovery = getattr(self, "recovery_service", None)
         indices_total["request_cache"] = \
             self.search_actions.request_cache.stats_dict()
@@ -508,6 +504,7 @@ class Node:
             self.indices_service.close()
             self.cluster_service.close()
             self.transport_service.close()
+            self.thread_pool.shutdown()
 
     def kill(self) -> None:
         """Abrupt death — no leave notification, no flush ordering; the
@@ -523,6 +520,7 @@ class Node:
             self.discovery._running = False
             self.cluster_service.close()
             self.indices_service.close()
+            self.thread_pool.shutdown()
 
     def __enter__(self):
         return self.start()

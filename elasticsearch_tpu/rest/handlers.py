@@ -886,15 +886,14 @@ class Handlers:
                                      "memory.bytes"], rows)
 
     def cat_thread_pool(self, req: RestRequest):
-        ts = self.node.transport_service
         rows = []
-        with ts._pools_lock:
-            for name, pool in sorted(ts._pools.items()):
-                rows.append([self.node.node_name, name,
-                             len(getattr(pool, "_threads", ())),
-                             pool._work_queue.qsize()])
+        for name, st in self.node.thread_pool.stats().items():
+            rows.append([self.node.node_name, name, st["threads"],
+                         st["queue"], st["active"], st["rejected"],
+                         st["completed"]])
         return self._cat_table(req, ["node_name", "name", "threads",
-                                     "queue"], rows)
+                                     "queue", "active", "rejected",
+                                     "completed"], rows)
 
     def cat_snapshots(self, req: RestRequest):
         repo = req.path_params["repo"]

@@ -143,9 +143,14 @@ class TransportService:
     """One per node. Owns the handler registry and in-flight request table;
     delegates byte movement to a Transport (local.py / tcp.py)."""
 
-    def __init__(self, transport, local_node_factory, executor=None):
+    def __init__(self, transport, local_node_factory, executor=None,
+                 thread_pool=None):
         """`local_node_factory(bound_address) -> DiscoveryNode` — the node
-        identity depends on the port the transport binds."""
+        identity depends on the port the transport binds. When the node's
+        :class:`~elasticsearch_tpu.common.threadpool.ThreadPool` is given,
+        named-executor dispatch runs on its bounded pools (rejections
+        propagate to the caller as transport failures — backpressure);
+        otherwise ad-hoc unbounded pools serve tests/standalone use."""
         self.transport = transport
         self._handlers: dict[str, _RequestHandler] = {}
         self._responses: dict[int, _ResponseContext] = {}
@@ -154,6 +159,7 @@ class TransportService:
         self._executor = executor or ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="transport")
         self._owns_executor = executor is None
+        self.thread_pool = thread_pool
         # Named per-workload pools (ThreadPool.java:70-129: index/bulk/
         # search/management...). Handlers that BLOCK on further RPCs (e.g.
         # a primary waiting for replica acks) must not share a pool with
@@ -264,7 +270,13 @@ class TransportService:
         elif reg.executor == "generic":
             self._executor.submit(run)
         else:
-            self._pool_for(reg.executor).submit(run)
+            try:
+                self._pool_for(reg.executor).submit(run)
+            except Exception as e:              # noqa: BLE001 — rejection
+                # bounded-pool rejection (EsRejectedExecutionError): the
+                # caller gets the 429-class failure instead of unbounded
+                # queueing — this IS the backpressure signal
+                channel.send_failure(e)
 
     def on_response(self, request_id: int, payload: bytes | None,
                     error: tuple[str, str] | None,
@@ -305,7 +317,9 @@ class TransportService:
             self.transport.send_response(to_node, request_id, out.bytes(),
                                          None)
 
-    def _pool_for(self, name: str) -> ThreadPoolExecutor:
+    def _pool_for(self, name: str):
+        if self.thread_pool is not None:
+            return self.thread_pool.executor(name)
         with self._pools_lock:
             pool = self._pools.get(name)
             if pool is None:

@@ -1,0 +1,135 @@
+"""ThreadPool tests — named pools with bounded queues whose rejection is
+the backpressure signal (ref: core/threadpool/ThreadPool.java:70-129 +
+EsRejectedExecutionException): a saturated search pool bounces searches
+with 429 while the index pool keeps writing."""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.threadpool import (
+    EsRejectedExecutionError, FixedThreadPool, ThreadPool)
+from elasticsearch_tpu.node import Node
+
+
+def _wait_active(pool, timeout=5.0):
+    """Wait until the worker has DEQUEUED the running job (active ≥ 1 and
+    queue empty) so the next submit deterministically lands in the queue."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = pool.stats()
+        if st["active"] >= 1 and st["queue"] == 0:
+            return
+        time.sleep(0.005)
+    raise AssertionError("worker never picked the job up")
+
+
+class TestFixedThreadPool:
+    def test_executes_and_counts(self):
+        p = FixedThreadPool("t", size=2, queue_size=8)
+        futs = [p.submit(lambda x=i: x * 2) for i in range(6)]
+        assert sorted(f.result(5) for f in futs) == [0, 2, 4, 6, 8, 10]
+        st = p.stats()
+        assert st["completed"] == 6 and st["rejected"] == 0
+        p.shutdown()
+
+    def test_rejects_beyond_queue_capacity(self):
+        p = FixedThreadPool("t", size=1, queue_size=1)
+        gate = time.sleep
+        p.submit(gate, 0.5)              # occupies the worker
+        _wait_active(p)                  # ...once the worker picked it up
+        p.submit(gate, 0.5)              # fills the queue
+        with pytest.raises(EsRejectedExecutionError) as ei:
+            p.submit(gate, 0.0)
+        assert ei.value.status == 429
+        assert p.stats()["rejected"] == 1
+        p.shutdown()
+
+    def test_exceptions_reach_future(self):
+        p = FixedThreadPool("t", size=1, queue_size=4)
+        fut = p.submit(lambda: 1 / 0)
+        with pytest.raises(ZeroDivisionError):
+            fut.result(5)
+        p.shutdown()
+
+    def test_submit_after_shutdown_rejects(self):
+        p = FixedThreadPool("t", size=1, queue_size=4)
+        p.shutdown()
+        with pytest.raises(EsRejectedExecutionError):
+            p.submit(lambda: 1)
+
+
+class TestThreadPoolRegistry:
+    def test_defaults_and_overrides(self):
+        class S(dict):
+            def get(self, k, d=None):
+                return super().get(k, d)
+        tp = ThreadPool(S({"threadpool.search.size": "3",
+                           "threadpool.search.queue_size": "7"}))
+        search = tp.executor("search")
+        assert search.size == 3 and search.queue_size == 7
+        bulk = tp.executor("bulk")
+        assert bulk.queue_size == 50
+        assert tp.executor("replica").queue_size <= 0  # unbounded
+        st = tp.stats()
+        assert {"search", "bulk", "replica"} <= set(st)
+        tp.shutdown()
+
+
+class TestNodeBackpressure:
+    def test_saturated_search_rejects_while_indexing_proceeds(self, tmp_path):
+        n = Node({"threadpool.search.size": "1",
+                  "threadpool.search.queue_size": "1"},
+                 data_path=tmp_path / "n").start()
+        try:
+            n.indices_service.create_index(
+                "idx", {"settings": {"number_of_shards": 1,
+                                     "number_of_replicas": 0}})
+            for i in range(10):
+                n.index_doc("idx", str(i), {"t": f"alpha word{i}"})
+            n.broadcast_actions.refresh("idx")
+            body = {"query": {"match": {"t": "alpha"}}}
+            assert n.search("idx", body)["hits"]["total"]["value"] == 10
+
+            # saturate: one job occupies the single worker, one fills the
+            # bounded queue — the next search must be REJECTED, not queued
+            n.thread_pool.submit("search", time.sleep, 1.5)
+            _wait_active(n.thread_pool.executor("search"))
+            n.thread_pool.submit("search", time.sleep, 1.5)
+            out = n.search("idx", body)
+            assert out["_shards"]["failed"] == 1
+            failure = out["_shards"]["failures"][0]
+            assert failure["reason"]["type"] == \
+                "es_rejected_execution_exception"
+            assert failure.get("status") == 429
+
+            # the index pool is independent: writes proceed under the storm
+            n.index_doc("idx", "during-storm", {"t": "alpha extra"})
+            assert n.document_actions.get_doc("idx", "during-storm")["found"]
+
+            # the pool drains and search recovers
+            time.sleep(1.8)
+            out = n.search("idx", body)
+            assert out["_shards"]["failed"] == 0
+            assert out["hits"]["total"]["value"] == 10  # pre-refresh count
+            st = n.thread_pool.stats()["search"]
+            assert st["rejected"] >= 1
+        finally:
+            n.close()
+
+    def test_thread_pool_in_nodes_stats_and_cat(self, tmp_path):
+        n = Node({}, data_path=tmp_path / "m").start()
+        try:
+            n.indices_service.create_index(
+                "x", {"settings": {"number_of_shards": 1,
+                                   "number_of_replicas": 0}})
+            n.index_doc("x", "1", {"t": "hello"})
+            n.broadcast_actions.refresh("x")
+            n.search("x", {"query": {"match_all": {}}})
+            stats = n.collect_nodes_stats()
+            pools = next(iter(stats["nodes"].values()))["thread_pool"]
+            assert "search" in pools
+            assert pools["search"]["completed"] >= 1
+            assert "rejected" in pools["search"]
+        finally:
+            n.close()
