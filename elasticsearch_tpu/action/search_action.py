@@ -628,24 +628,34 @@ class SearchActions:
             extra={"bodies": send_bodies, "doc_slot": slot_of[(n, s)]})
             for n, s, copies in groups]
         per_shard, group_failures = [], []
-        for fut in futures:
+        for (n, s, _copies), fut in zip(groups, futures):
             status, payload = fut.result()
             if status == "ok":
-                per_shard.append(payload["payloads"])
+                per_shard.append((n, s, payload["payloads"]))
             else:
                 group_failures.append(payload)
         took = (time.perf_counter() - t0) * 1e3
         for pos, i in enumerate(valid):
             item_payloads = []
             item_failures = list(group_failures)
-            for shard_payloads in per_shard:
+            for n, s, shard_payloads in per_shard:
                 p = shard_payloads[pos]
                 if "error" in p:
-                    item_failures.append({"reason": {
-                        "type": "shard_search_failure",
-                        "reason": p["error"]}})
+                    # same shape as group-level shard failures
+                    item_failures.append({"shard": s, "index": n,
+                                          "reason": {
+                                              "type": "shard_search_failure",
+                                              "reason": p["error"]}})
                 else:
                     item_payloads.append(p)
+            if not item_payloads and item_failures:
+                # every shard failed for this item: an error entry, not a
+                # legitimate-looking empty result (the _msearch contract)
+                outs[i] = {"error": {
+                    "type": "search_phase_execution_exception",
+                    "reason": "all shards failed",
+                    "failed_shards": item_failures}}
+                continue
             outs[i] = merge_shard_payloads(
                 parsed[i], item_payloads, took, total_shards=len(groups),
                 failures=item_failures)

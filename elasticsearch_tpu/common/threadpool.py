@@ -58,20 +58,22 @@ class FixedThreadPool:
 
     def submit(self, fn, *args, **kwargs) -> Future:
         """→ Future; raises EsRejectedExecutionError when the queue is at
-        capacity (never blocks the submitter)."""
-        if self._closed:
-            raise EsRejectedExecutionError(
-                f"rejected execution on [{self.name}] (pool closed)")
+        capacity (never blocks the submitter). The closed-check and
+        enqueue share the lock with shutdown's drain, so no item can slip
+        in behind the poison pills and hang its caller forever."""
         fut: Future = Future()
-        try:
-            self._q.put_nowait((fut, fn, args, kwargs))
-        except queue.Full:
-            with self._lock:
+        with self._lock:
+            if self._closed:
+                raise EsRejectedExecutionError(
+                    f"rejected execution on [{self.name}] (pool closed)")
+            try:
+                self._q.put_nowait((fut, fn, args, kwargs))
+            except queue.Full:
                 self.rejected += 1
-            raise EsRejectedExecutionError(
-                f"rejected execution of [{getattr(fn, '__name__', fn)}] on "
-                f"[{self.name}]: queue capacity {self.queue_size} reached"
-            ) from None
+                raise EsRejectedExecutionError(
+                    f"rejected execution of [{getattr(fn, '__name__', fn)}]"
+                    f" on [{self.name}]: queue capacity {self.queue_size} "
+                    f"reached") from None
         return fut
 
     def _worker(self) -> None:
@@ -107,16 +109,17 @@ class FixedThreadPool:
             if self._closed:
                 return
             self._closed = True
-        # drain queued work first (cancel futures so waiters unblock) —
-        # otherwise a full queue would swallow the poison pills and leave
-        # workers running forever
-        try:
-            while True:
-                item = self._q.get_nowait()
-                if item is not _POISON:
-                    item[0].cancel()
-        except queue.Empty:
-            pass
+            # drain queued work (cancel futures so waiters unblock) —
+            # a full queue would otherwise swallow the poison pills and
+            # leave workers running forever; under the lock, no racing
+            # submit can enqueue behind the drain
+            try:
+                while True:
+                    item = self._q.get_nowait()
+                    if item is not _POISON:
+                        item[0].cancel()
+            except queue.Empty:
+                pass
         for _ in self._threads:
             self._q.put(_POISON)   # workers consume; queue was just drained
 

@@ -50,12 +50,45 @@ def histogram_counts(values, exists, mask, base: float, interval: float,
     return counts[:num_buckets]
 
 
+def histogram_counts_dd(hi, lo, exists, mask, base_hi: float, base_lo: float,
+                        interval: float, num_buckets: int):
+    """Histogram over double-double values (epoch-millis dates, large
+    longs): f32 alone quantizes 1.5e12 to ~1e5 steps, so bucketize the
+    RELATIVE value (hi - base_hi) + (lo - base_lo) — exact to f32 epsilon
+    of the data RANGE, not of the absolute magnitude (Sterbenz: same-scale
+    f32 subtraction is exact). base must sit at/below the minimum value,
+    on a bucket boundary, split host-side via dd_split."""
+    in_ctx = exists & mask
+    rel = (hi - jnp.float32(base_hi)) + (lo - jnp.float32(base_lo))
+    idx = jnp.floor(rel / jnp.float32(interval)).astype(jnp.int32)
+    idx = jnp.where(in_ctx & (idx >= 0) & (idx < num_buckets), idx,
+                    num_buckets)
+    ones = jnp.where(idx < num_buckets, 1, 0)
+    counts = jax.ops.segment_sum(ones, idx, num_segments=num_buckets + 1)
+    return counts[:num_buckets]
+
+
 def range_counts(values, exists, mask, lows, highs):
     """range agg: lows/highs [R] f64 device arrays (±inf open ends).
     → counts [R] int32 (ranges may overlap, matching ES semantics)."""
     in_ctx = (exists & mask)[:, None]
     hit = in_ctx & (values[:, None] >= lows[None, :]) & (values[:, None] < highs[None, :])
     return hit.sum(axis=0).astype(jnp.int32)
+
+
+def dd_min_max(hi, lo, exists, mask):
+    """Exact extrema of a double-double column by lexicographic (hi, lo)
+    order — a bare f32 hi min/max is off by up to half an ulp of the
+    magnitude (~65 s at epoch-millis scale). → (count, min_hi, min_lo,
+    max_hi, max_lo) device scalars; host reconstructs exact f64 as
+    hi + lo."""
+    m = exists & mask
+    cnt = m.sum(dtype=jnp.int32)
+    mn_hi = jnp.min(jnp.where(m, hi, jnp.inf))
+    mn_lo = jnp.min(jnp.where(m & (hi == mn_hi), lo, jnp.inf))
+    mx_hi = jnp.max(jnp.where(m, hi, -jnp.inf))
+    mx_lo = jnp.max(jnp.where(m & (hi == mx_hi), lo, -jnp.inf))
+    return cnt, mn_hi, mn_lo, mx_hi, mx_lo
 
 
 def stats_metrics(values, exists, mask):

@@ -72,6 +72,298 @@ def parse_aggs(body: dict | None) -> list[AggNode]:
 
 
 # ---------------------------------------------------------------------------
+# device collect fast path (ops/aggs_ops kernels)
+# ---------------------------------------------------------------------------
+
+# observability for tests/ops: how collection executed
+DEVICE_AGG_STATS = {"device_collects": 0, "host_fallbacks": 0}
+
+
+class DeviceAggState:
+    """Per-segment DEVICE query masks (+ scores) for aggregation collection.
+
+    The device fast path (collect_device) reduces on the accelerator and
+    fetches only bucket-/scalar-sized results; nodes it can't serve fall
+    back to the numpy collectors, which need the full masks on host —
+    ``np_mask()`` materializes them lazily and counts doing so, so tests
+    can assert the device path never transfers full columns."""
+
+    def __init__(self, reader, masks_dev: list, scores_dev: list):
+        self.reader = reader
+        self.masks = masks_dev            # per segment [Np] bool (device)
+        self.scores_dev = scores_dev      # per segment [Np] f32 (device)
+        self.host_materializations = 0
+        self._np_mask = None
+        self._np_scores = None
+
+    def np_mask(self) -> np.ndarray:
+        if self._np_mask is None:
+            self.host_materializations += 1
+            self._np_mask = np.concatenate(
+                [np.asarray(m) for m in self.masks]) if self.masks \
+                else np.zeros(0, bool)
+        return self._np_mask
+
+    def np_scores(self) -> np.ndarray:
+        if self._np_scores is None:
+            self._np_scores = np.concatenate(
+                [np.asarray(s) for s in self.scores_dev]) if self.scores_dev \
+                else np.zeros(0, np.float32)
+        return self._np_scores
+
+
+_DEVICE_METRICS = {"min", "max", "sum", "avg", "stats", "extended_stats"}
+_MAX_DEVICE_HISTO_BUCKETS = 10_000
+
+
+def collect_device(node: AggNode, state: DeviceAggState) -> dict | None:
+    """Device collection for the hot agg shapes: a segment-reduce on the
+    accelerator with only bucket/scalar results crossing to host (SURVEY §7
+    step 9; ref collector tree: AggregationPhase.java:44). Returns None for
+    shapes it doesn't serve — script/missing params, sub-aggregations,
+    calendar intervals, text-backed terms — and the numpy collectors (the
+    parity oracle) take over.
+
+    Precision note: device sums accumulate in f32 over the (hi, lo)
+    double-double split — tests hold the numpy path to rtol 1e-5."""
+    if node.subs or node.pipelines:
+        return None
+    params = node.params
+    if "script" in params or "missing" in params or "order" in params:
+        return None
+    fname = params.get("field")
+    if fname is None:
+        return None
+    try:
+        if node.type in _DEVICE_METRICS:
+            out = _d_metric(fname, state)
+        elif node.type == "value_count":
+            out = _d_value_count(fname, state)
+        elif node.type == "terms":
+            out = _d_terms(fname, state)
+        elif node.type == "histogram":
+            out = _d_histogram(node, fname, state)
+        elif node.type == "date_histogram":
+            interval = params.get("interval") or \
+                params.get("calendar_interval") or params.get("fixed_interval")
+            if _CALENDAR.get(str(interval)) is not None:
+                return None               # calendar buckets stay host-side
+            out = _d_date_histogram(node, fname, state)
+        elif node.type in ("range", "date_range"):
+            out = _d_range(node, fname, state,
+                           is_date=node.type == "date_range")
+        else:
+            return None
+    except _DeviceAggFallback:
+        return None
+    if out is not None:
+        DEVICE_AGG_STATS["device_collects"] += 1
+    return out
+
+
+class _DeviceAggFallback(Exception):
+    pass
+
+
+def _d_numeric_cols(fname: str, state: DeviceAggState):
+    cols = [seg.numeric.get(fname) for seg in state.reader.segments]
+    if not any(c is not None for c in cols):
+        raise _DeviceAggFallback
+    return cols
+
+
+def _d_count_minmax(fname: str, state: DeviceAggState):
+    """→ (rows [segments, 5] = (count, min_hi, min_lo, max_hi, max_lo)
+    fetched in ONE transfer, cols) — dd-exact extrema (aggs_ops.dd_min_max)."""
+    import jax.numpy as jnp
+    from elasticsearch_tpu.ops import aggs_ops
+    cols = _d_numeric_cols(fname, state)
+    rows = []
+    for seg, col, mask in zip(state.reader.segments, cols, state.masks):
+        if col is None:
+            continue
+        cnt, mn_hi, mn_lo, mx_hi, mx_lo = aggs_ops.dd_min_max(
+            col.hi, col.lo, col.exists, mask)
+        rows.append(jnp.stack([cnt.astype(jnp.float32),
+                               mn_hi, mn_lo, mx_hi, mx_lo]))
+    return np.asarray(jnp.stack(rows)), cols
+
+
+def _dd_extrema(rows: np.ndarray) -> tuple[float, float]:
+    """Host reduce of per-segment dd extrema → exact f64 (min, max)."""
+    live = rows[:, 0] > 0
+    mins = rows[live, 1].astype(np.float64) + rows[live, 2]
+    maxs = rows[live, 3].astype(np.float64) + rows[live, 4]
+    return float(mins.min()), float(maxs.max())
+
+
+def _d_metric(fname: str, state: DeviceAggState) -> dict:
+    import jax.numpy as jnp
+    from elasticsearch_tpu.ops import aggs_ops
+    mm_rows, cols = _d_count_minmax(fname, state)
+    sums = []
+    for seg, col, mask in zip(state.reader.segments, cols, state.masks):
+        if col is None:
+            continue
+        s_hi = jnp.where(col.exists & mask, col.hi, 0.0).sum()
+        s_lo = jnp.where(col.exists & mask, col.lo, 0.0).sum()
+        ssq = aggs_ops.sum_of_squares(col.hi, col.exists, mask)
+        sums.append(jnp.stack([s_hi, s_lo, ssq]))
+    s_rows = np.asarray(jnp.stack(sums))
+    count = int(mm_rows[:, 0].sum())
+    out = {"count": count}
+    if count:
+        mn, mx = _dd_extrema(mm_rows)
+        out.update(sum=float(s_rows[:, 0].sum() + s_rows[:, 1].sum()),
+                   min=mn, max=mx, sum_sq=float(s_rows[:, 2].sum()))
+    else:
+        out.update(sum=0.0, min=None, max=None, sum_sq=0.0)
+    return out
+
+
+def _d_value_count(fname: str, state: DeviceAggState) -> dict:
+    import jax.numpy as jnp
+    from elasticsearch_tpu.ops import aggs_ops
+    counts = []
+    served = False
+    for seg, mask in zip(state.reader.segments, state.masks):
+        ncol = seg.numeric.get(fname)
+        if ncol is not None:
+            counts.append(aggs_ops.value_count(ncol.exists, mask))
+            served = True
+            continue
+        kcol = seg.keyword.get(fname)
+        if kcol is not None:
+            counts.append(aggs_ops.value_count(
+                (kcol.ords >= 0).any(axis=1), mask))
+            served = True
+    if not served:
+        raise _DeviceAggFallback
+    return {"count": int(np.asarray(jnp.stack(counts)).sum())}
+
+
+def _d_terms(fname: str, state: DeviceAggState) -> dict:
+    """Keyword terms agg: per-segment ordinal counts on device (vocab-sized
+    fetches), union-merged host-side by term string."""
+    from elasticsearch_tpu.ops import aggs_ops
+    segs = state.reader.segments
+    for candidate in (fname, f"{fname}.keyword"):
+        cols = [seg.keyword.get(candidate) for seg in segs]
+        if not any(c is not None for c in cols):
+            continue
+        merged: dict[str, int] = {}
+        for seg, col, mask in zip(segs, cols, state.masks):
+            if col is None:
+                continue
+            vocab = col.column.vocab
+            if not vocab:
+                continue
+            counts = np.asarray(aggs_ops.ord_value_counts(
+                col.ords, mask, len(vocab)))
+            for oid in np.nonzero(counts)[0]:
+                key = vocab[int(oid)]
+                merged[key] = merged.get(key, 0) + int(counts[oid])
+        buckets = {k: {"doc_count": n} for k, n in merged.items()}
+        return {"buckets": _as_pairs(buckets),
+                "doc_count_error_upper_bound": 0}
+    raise _DeviceAggFallback        # numeric/text terms stay host-side
+
+
+def _d_histogram_common(node: AggNode, fname: str, state: DeviceAggState,
+                        interval: float, offset: float):
+    import jax.numpy as jnp
+    from elasticsearch_tpu.index.device_reader import dd_split
+    from elasticsearch_tpu.ops import aggs_ops
+    rows, cols = _d_count_minmax(fname, state)
+    if not int(rows[:, 0].sum()):
+        return []
+    # dd-exact extrema → the base bucket is exact; no edge docs can land
+    # below index 0 or beyond the last bucket
+    lo, hi = _dd_extrema(rows)
+    first = math.floor((lo - offset) / interval)
+    last = math.floor((hi - offset) / interval)
+    n_buckets = int(last - first + 1)
+    if n_buckets > _MAX_DEVICE_HISTO_BUCKETS:
+        raise _DeviceAggFallback
+    base = first * interval + offset
+    base_hi, base_lo = dd_split(np.float64(base))
+    per_seg = []
+    for seg, col, mask in zip(state.reader.segments, cols, state.masks):
+        if col is None:
+            continue
+        per_seg.append(aggs_ops.histogram_counts_dd(
+            col.hi, col.lo, col.exists, mask, float(base_hi),
+            float(base_lo), interval, n_buckets))
+    counts = np.asarray(jnp.stack(per_seg)).sum(axis=0)
+    return [(base + i * interval, int(c))
+            for i, c in enumerate(counts) if c > 0]
+
+
+def _d_histogram(node: AggNode, fname: str, state: DeviceAggState) -> dict:
+    interval = float(node.params["interval"])
+    offset = float(node.params.get("offset", 0.0))
+    pairs = _d_histogram_common(node, fname, state, interval, offset)
+    buckets = {float(k): {"doc_count": c} for k, c in pairs}
+    return {"buckets": _as_pairs(buckets), "interval": interval,
+            "min_doc_count": int(node.params.get("min_doc_count", 0))}
+
+
+def _d_date_histogram(node: AggNode, fname: str,
+                      state: DeviceAggState) -> dict:
+    interval = node.params.get("interval") or \
+        node.params.get("calendar_interval") or \
+        node.params.get("fixed_interval")
+    try:
+        # calendar names the host path knows ('1d', 'day'...) may not be
+        # fixed-parseable — fall back rather than error
+        ms = parse_time_value(interval) * 1000.0
+    except Exception:                       # noqa: BLE001 — fallback seam
+        raise _DeviceAggFallback from None
+    pairs = _d_histogram_common(node, fname, state, ms, 0.0)
+    buckets = {int(k): {"doc_count": c} for k, c in pairs}
+    return {"buckets": _as_pairs(buckets), "date": True}
+
+
+def _d_range(node: AggNode, fname: str, state: DeviceAggState,
+             is_date: bool) -> dict:
+    import jax.numpy as jnp
+    from elasticsearch_tpu.index.device_reader import dd_split
+    from elasticsearch_tpu.ops import filters as filter_ops
+    bounds = _range_bounds(node, is_date)
+    if not bounds:
+        return {"buckets": [], "keyed_order": []}
+    cols = _d_numeric_cols(fname, state)
+    per_seg = []
+    for seg, col, mask in zip(state.reader.segments, cols, state.masks):
+        if col is None:
+            continue
+        row = []
+        for _key, lo, hi in bounds:
+            # double-double comparison: exact for dates/large longs where
+            # a single f32 bound would blur the boundary. Range semantics
+            # are [from, to): numeric_range is [lo, hi] inclusive, so the
+            # upper bound steps one ulp below `to`.
+            ghi, glo = dd_split(np.float64(lo))
+            upper = np.nextafter(np.float64(hi), -np.inf) \
+                if hi != np.inf else np.float64(np.inf)
+            lhi, llo = dd_split(upper)
+            m = filter_ops.numeric_range(
+                col.hi, col.lo, col.exists,
+                jnp.float32(ghi), jnp.float32(glo),
+                jnp.float32(lhi), jnp.float32(llo))
+            row.append((m & mask).sum(dtype=jnp.int32))
+        per_seg.append(jnp.stack(row))
+    counts = np.asarray(jnp.stack(per_seg)).sum(axis=0)
+    buckets = {}
+    for (key, lo, hi), c in zip(bounds, counts):
+        buckets[key] = {"doc_count": int(c),
+                        "from": None if lo == -np.inf else lo,
+                        "to": None if hi == np.inf else hi}
+    return {"buckets": _as_pairs(buckets),
+            "keyed_order": [b[0] for b in bounds]}
+
+
+# ---------------------------------------------------------------------------
 # collect phase (per shard)
 # ---------------------------------------------------------------------------
 

@@ -254,13 +254,15 @@ class ShardSearcher:
             total = min(total, req.terminate_after)
         agg_partials = {}
         if req.aggs:
-            masks = [np.asarray(o["agg_mask"]) for _, o in outs]
-            scores = [np.asarray(o["scores"]) for _, o in outs]
-            # early termination: unprocessed segments contribute empty
-            # masks so agg columns stay reader-aligned
+            # keep masks/scores ON DEVICE: the device agg fast path reduces
+            # there and only bucket results cross to host; the numpy
+            # fallback materializes lazily (early-terminated segments
+            # contribute empty masks so columns stay reader-aligned)
+            masks = [o["agg_mask"] for _, o in outs]
+            scores = [o["scores"] for _, o in outs]
             for seg in self.reader.segments[len(outs):]:
-                masks.append(np.zeros(seg.padded_docs, bool))
-                scores.append(np.zeros(seg.padded_docs, np.float32))
+                masks.append(jnp.zeros(seg.padded_docs, bool))
+                scores.append(jnp.zeros(seg.padded_docs, jnp.float32))
             agg_partials = self._collect_aggs(req, masks, scores)
 
         if not outs:
@@ -399,17 +401,31 @@ class ShardSearcher:
     def _collect_aggs(self, req: ParsedSearchRequest,
                       masks: list, scores: list) -> dict:
         """Run top-level agg collectors over the (pre-post_filter) mask —
-        shared by the jit and eager query paths."""
+        shared by the jit and eager query paths. ``masks``/``scores`` are
+        per-segment DEVICE arrays: the device fast path (collect_device)
+        segment-reduces on the accelerator with only bucket/scalar results
+        crossing to host; ineligible nodes fall back to the numpy
+        collectors, which materialize the host mask once, lazily."""
         if not req.aggs:
             return {}
-        agg_mask = np.concatenate(masks) if masks else np.zeros(0, bool)
-        agg_scores = np.concatenate(scores) if scores \
-            else np.zeros(0, np.float32)
-        agg_ctx = ShardAggContext(self.reader, self.mapper_service,
-                                  self._filter_masks_np, scores=agg_scores)
-        from elasticsearch_tpu.search.aggregations import PIPELINE_AGGS
-        return {node.name: collect(node, agg_mask, agg_ctx)
-                for node in req.aggs if node.type not in PIPELINE_AGGS}
+        from elasticsearch_tpu.search.aggregations import (
+            DEVICE_AGG_STATS, DeviceAggState, PIPELINE_AGGS, collect_device)
+        state = DeviceAggState(self.reader, masks, scores)
+        out = {}
+        np_ctx = None
+        for node in req.aggs:
+            if node.type in PIPELINE_AGGS:
+                continue
+            partial = collect_device(node, state)
+            if partial is None:
+                DEVICE_AGG_STATS["host_fallbacks"] += 1
+                if np_ctx is None:
+                    np_ctx = ShardAggContext(
+                        self.reader, self.mapper_service,
+                        self._filter_masks_np, scores=state.np_scores())
+                partial = collect(node, state.np_mask(), np_ctx)
+            out[node.name] = partial
+        return out
 
     def _finish_score_order(self, k: int, total: int, seg_scores: list,
                             seg_docs: list, agg_partials: dict
@@ -462,12 +478,13 @@ class ShardSearcher:
                        for s, m in per_seg]
 
         # aggregations run on the pre-post_filter mask (ES semantics);
-        # unprocessed segments contribute empty masks
-        masks = [np.asarray(m) for _, m in per_seg]
-        scores_l = [np.asarray(s) for s, _ in per_seg]
+        # unprocessed segments contribute empty masks; arrays stay on
+        # device for the agg fast path
+        masks = [m for _, m in per_seg]
+        scores_l = [s for s, _ in per_seg]
         for seg in self.reader.segments[len(per_seg):]:
-            masks.append(np.zeros(seg.padded_docs, bool))
-            scores_l.append(np.zeros(seg.padded_docs, np.float32))
+            masks.append(jnp.zeros(seg.padded_docs, bool))
+            scores_l.append(jnp.zeros(seg.padded_docs, jnp.float32))
         agg_partials = self._collect_aggs(req, masks, scores_l)
 
         if req.post_filter is not None:

@@ -1,0 +1,143 @@
+"""Device aggregation fast path: the hot agg shapes must collect ON the
+accelerator (segment-reduce, only bucket/scalar results fetched — SURVEY §7
+step 9) with results matching the numpy collectors (the parity oracle),
+and must NOT materialize full per-doc masks on host."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search import aggregations as aggs_mod
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node({}, data_path=tmp_path_factory.mktemp("devaggs") / "n").start()
+    n.indices_service.create_index(
+        "idx", {"settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0},
+                "mappings": {"_doc": {"properties": {
+                    "t": {"type": "text", "analyzer": "whitespace"},
+                    "tag": {"type": "keyword"},
+                    "price": {"type": "long"},
+                    "when": {"type": "date"}}}}})
+    rng = np.random.default_rng(5)
+    for i in range(300):
+        n.index_doc("idx", str(i), {
+            "t": f"alpha word{i % 7}",
+            "tag": f"g{int(rng.integers(0, 6))}",
+            "price": int(rng.integers(0, 500)),
+            "when": 1_500_000_000_000 + int(rng.integers(0, 10_000_000))})
+    n.broadcast_actions.refresh("idx")
+    yield n
+    n.close()
+
+
+ELIGIBLE_AGGS = {
+    "mx": {"max": {"field": "price"}},
+    "mn": {"min": {"field": "price"}},
+    "sm": {"sum": {"field": "price"}},
+    "av": {"avg": {"field": "price"}},
+    "st": {"stats": {"field": "price"}},
+    "xs": {"extended_stats": {"field": "price"}},
+    "vc": {"value_count": {"field": "tag"}},
+    "tg": {"terms": {"field": "tag", "size": 10}},
+    "hi": {"histogram": {"field": "price", "interval": 100}},
+    "rg": {"range": {"field": "price",
+                     "ranges": [{"to": 100}, {"from": 100, "to": 300},
+                                {"from": 300}]}},
+    "dh": {"date_histogram": {"field": "when", "interval": "1h"}},
+}
+
+
+def _strip_took(resp):
+    return resp["aggregations"]
+
+
+def test_device_path_matches_numpy_oracle(node):
+    body = {"query": {"match": {"t": "alpha"}}, "size": 0,
+            "aggs": ELIGIBLE_AGGS}
+    node.search_actions.request_cache.clear()
+    got = _strip_took(node.search("idx", body))
+    # force the numpy oracle by disabling the device path
+    orig = aggs_mod.collect_device
+    aggs_mod.collect_device = lambda node_, state: None
+    try:
+        node.search_actions.request_cache.clear()
+        want = _strip_took(node.search("idx", body))
+    finally:
+        aggs_mod.collect_device = orig
+
+    def compare(a, b, path=""):
+        assert type(a) is type(b), (path, a, b)
+        if isinstance(a, dict):
+            assert set(a) == set(b), (path, a, b)
+            for k in a:
+                compare(a[k], b[k], f"{path}.{k}")
+        elif isinstance(a, list):
+            assert len(a) == len(b), (path, a, b)
+            for i, (x, y) in enumerate(zip(a, b)):
+                compare(x, y, f"{path}[{i}]")
+        elif isinstance(a, float):
+            assert b == pytest.approx(a, rel=1e-5, abs=1e-6), (path, a, b)
+        else:
+            assert a == b, (path, a, b)
+    compare(got, want)
+
+
+def test_fine_grained_date_histogram_exact(node):
+    # 1s buckets at epoch-millis magnitude: a bare-f32 bucketize would be
+    # ~65s off (half an ulp of 1.5e12); the dd kernel must stay exact and
+    # LOSE NO DOCS at the range edges
+    body = {"query": {"match_all": {}}, "size": 0,
+            "aggs": {"s": {"date_histogram": {"field": "when",
+                                              "interval": "1s"}},
+                     "mm": {"stats": {"field": "when"}}}}
+    node.search_actions.request_cache.clear()
+    got = node.search("idx", body)["aggregations"]
+    assert sum(b["doc_count"] for b in got["s"]["buckets"]) == 300
+    orig = aggs_mod.collect_device
+    aggs_mod.collect_device = lambda node_, state: None
+    try:
+        node.search_actions.request_cache.clear()
+        want = node.search("idx", body)["aggregations"]
+    finally:
+        aggs_mod.collect_device = orig
+    assert got["s"]["buckets"] == want["s"]["buckets"]
+    # dd-exact min/max: equal to the f64 host values to the millisecond
+    assert got["mm"]["min"] == want["mm"]["min"]
+    assert got["mm"]["max"] == want["mm"]["max"]
+
+
+def test_no_full_column_transfer_for_eligible_aggs(node):
+    node.search_actions.request_cache.clear()
+    before = dict(aggs_mod.DEVICE_AGG_STATS)
+    node.search("idx", {"query": {"match": {"t": "alpha"}}, "size": 0,
+                        "aggs": ELIGIBLE_AGGS})
+    after = dict(aggs_mod.DEVICE_AGG_STATS)
+    assert after["device_collects"] - before["device_collects"] == \
+        len(ELIGIBLE_AGGS)
+    assert after["host_fallbacks"] == before["host_fallbacks"]
+
+
+def test_ineligible_aggs_fall_back(node):
+    node.search_actions.request_cache.clear()
+    before = dict(aggs_mod.DEVICE_AGG_STATS)
+    # sub-aggregation → host path
+    node.search("idx", {"query": {"match_all": {}}, "size": 0,
+                        "aggs": {"tg": {"terms": {"field": "tag"},
+                                        "aggs": {"p": {"avg": {
+                                            "field": "price"}}}}}})
+    after = dict(aggs_mod.DEVICE_AGG_STATS)
+    assert after["host_fallbacks"] > before["host_fallbacks"]
+
+
+def test_device_and_host_mix(node):
+    # one eligible + one ineligible in the same request: both answered
+    node.search_actions.request_cache.clear()
+    out = node.search("idx", {
+        "query": {"match_all": {}}, "size": 0,
+        "aggs": {"mx": {"max": {"field": "price"}},
+                 "card": {"cardinality": {"field": "tag"}}}})
+    assert out["aggregations"]["mx"]["value"] is not None
+    assert out["aggregations"]["card"]["value"] == 6
