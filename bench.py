@@ -74,36 +74,46 @@ def pick_platform() -> str:
     return "cpu"
 
 
-def make_corpus(rng, n_docs: int, vocab: int, mean_len: int, max_unique: int):
-    """Vectorized Zipf corpus directly in packed column form."""
+def make_corpus(rng, n_docs: int, vocab: int, mean_len: int, max_unique: int,
+                chunk: int = 1_000_000):
+    """Vectorized Zipf corpus directly in packed column form (chunked: the
+    f64 sampling scratch for 8.8M docs would need ~8 GB at once)."""
     lens = np.clip(rng.poisson(mean_len, n_docs), 8, 112).astype(np.int32)
     L = int(lens.max())
-    # zipf-ish: sample from a power-law over the vocab
-    ranks = (rng.pareto(1.1, size=(n_docs, L)) + 1).astype(np.float64)
-    toks = np.minimum((ranks * 3).astype(np.int64), vocab - 1).astype(np.int32)
-    mask = np.arange(L)[None, :] < lens[:, None]
-    toks = np.where(mask, toks, -1)
-
-    # unique terms + counts per row (vectorized)
-    order = np.argsort(toks, axis=1, kind="stable")
-    st = np.take_along_axis(toks, order, axis=1)
-    new = np.ones_like(st, dtype=bool)
-    new[:, 1:] = st[:, 1:] != st[:, :-1]
-    new &= st >= 0
-    uidx = np.cumsum(new, axis=1) - 1              # unique slot per token
-    U = int(new.sum(axis=1).max())
-    U = min(U, max_unique)
+    U = max_unique
+    toks = np.full((n_docs, L), -1, np.int32)
     uterms = np.full((n_docs, U), -1, np.int32)
     utf = np.zeros((n_docs, U), np.float32)
-    rows = np.repeat(np.arange(n_docs), L).reshape(n_docs, L)
-    valid = (st >= 0) & (uidx < U)
-    np.add.at(utf, (rows[valid], uidx[valid]), 1.0)
-    first = new & valid
-    uterms[rows[first], uidx[first]] = st[first]
-
     df = np.zeros(vocab, np.int64)
-    np.add.at(df, uterms[uterms >= 0], 1)
-    return uterms, utf, lens, df, toks
+    for lo in range(0, n_docs, chunk):
+        hi = min(lo + chunk, n_docs)
+        n = hi - lo
+        # zipf-ish: sample from a power-law over the vocab
+        ranks = (rng.pareto(1.1, size=(n, L)) + 1)
+        tk = np.minimum((ranks * 3).astype(np.int64),
+                        vocab - 1).astype(np.int32)
+        del ranks
+        mask = np.arange(L)[None, :] < lens[lo:hi, None]
+        tk = np.where(mask, tk, -1)
+        toks[lo:hi] = tk
+
+        # unique terms + counts per row (vectorized)
+        order = np.argsort(tk, axis=1, kind="stable")
+        st = np.take_along_axis(tk, order, axis=1)
+        new = np.ones_like(st, dtype=bool)
+        new[:, 1:] = st[:, 1:] != st[:, :-1]
+        new &= st >= 0
+        uidx = np.cumsum(new, axis=1) - 1          # unique slot per token
+        rows = np.broadcast_to(np.arange(lo, hi)[:, None], (n, L))
+        valid = (st >= 0) & (uidx < U)
+        np.add.at(utf, (rows[valid], uidx[valid]), 1.0)
+        first = new & valid
+        uterms[rows[first], uidx[first]] = st[first]
+        np.add.at(df, uterms[lo:hi][uterms[lo:hi] >= 0], 1)
+    # trim the unique-term axis to what the corpus actually used
+    used = int(np.argmax((uterms >= 0).any(axis=0)[::-1]))
+    u_eff = U - used if (uterms >= 0).any() else 1
+    return uterms[:, :u_eff], utf[:, :u_eff], lens, df, toks
 
 
 def make_queries(rng, n_queries: int, vocab: int, terms: int, df):
@@ -116,7 +126,7 @@ def make_queries(rng, n_queries: int, vocab: int, terms: int, df):
 
 
 def main() -> int:
-    n_docs = int(os.environ.get("BENCH_DOCS", 200_000))
+    n_docs = int(os.environ.get("BENCH_DOCS", 1_000_000))
     vocab = int(os.environ.get("BENCH_VOCAB", 30_000))
     n_queries = int(os.environ.get("BENCH_QUERIES", 512))
     batch = int(os.environ.get("BENCH_BATCH", 64))
@@ -178,9 +188,14 @@ def main() -> int:
         f"({cpu_time*1000/cpu_queries:.2f} ms/query)")
 
     # ---- device run --------------------------------------------------------
-    # pad rows to a power-of-2 bucket (engine segments are bucketized the
-    # same way; the slots kernel wants block-divisible row counts)
-    n_pad = 1 << (n_docs - 1).bit_length()
+    kernels = os.environ.get("BENCH_KERNEL", "forward").split(",")
+    # the slots kernel needs power-of-2 block-divisible rows; the forward
+    # kernel (the winner — see ROOFLINE.md) only needs lane alignment, so
+    # pad to 8192 and save up to 2x HBM + compute at large corpora
+    if set(kernels) - {"forward"}:
+        n_pad = 1 << (n_docs - 1).bit_length()
+    else:
+        n_pad = ((n_docs + 8191) // 8192) * 8192
     if n_pad != n_docs:
         pad = n_pad - n_docs
         uterms = np.pad(uterms, ((0, pad), (0, 0)), constant_values=-1)
@@ -198,7 +213,6 @@ def main() -> int:
 
     from elasticsearch_tpu.ops import postings as postings_ops
 
-    kernels = os.environ.get("BENCH_KERNEL", "slots,forward,csr").split(",")
     n_batches = max(n_queries // batch, 1)
     csr_index = None
     if "csr" in kernels:
@@ -349,23 +363,65 @@ def main() -> int:
         from elasticsearch_tpu.search.phase import (ShardSearcher,
                                                     parse_search_request)
 
+        # release the standalone kernel's device arrays first: at MS-MARCO
+        # scale the engine's reader needs the HBM they occupy
+        import gc
+        del d_uterms, d_utf, d_len, d_live, run_batch
+        gc.collect()
+
         w = len(str(vocab - 1))
         term_names = [f"t{i:0{w}d}" for i in range(vocab)]
-        toks_p = np.pad(toks, ((0, n_pad - n_docs), (0, 0)),
-                        constant_values=-1) if n_pad != n_docs else toks
         t0 = time.perf_counter()
-        seg = Segment.from_packed_text(
-            0, "body", terms=term_names, tokens=toks_p, uterms=uterms,
-            utf=utf, doc_len=lens_p, df=df, num_docs=n_docs)
         ms_map = MapperService()
         ms_map.merge("_doc", {"properties": {"body": {
             "type": "text", "analyzer": "whitespace"}}})
         eng = Engine(Path(tempfile.mkdtemp(prefix="bench_engine_")), ms_map)
-        eng.install_segment(seg, track_versions=False)
+        # install as power-of-2-bucketed segments of <=2^20 rows — the
+        # engine's own segment discipline (doc_count_bucket): per-segment
+        # program intermediates stay ~[B, 1M] instead of [B, corpus], and
+        # the cross-segment device merge stitches the shard top-k
+        seg_rows = int(os.environ.get("BENCH_SEG_ROWS", 1 << 20))
+        # positions cost ~40% of HBM and BM25 doesn't read them; keep them
+        # at small scale (phrase parity elsewhere), drop them when the
+        # corpus wouldn't fit (index_options: freqs analog)
+        with_positions = os.environ.get(
+            "BENCH_POSITIONS",
+            "1" if n_docs <= 2_000_000 else "0") == "1"
+        from elasticsearch_tpu.index.segment import doc_count_bucket
+        n_segs = -(-n_docs // seg_rows)
+        for lo in range(0, n_docs, seg_rows):
+            hi = min(lo + seg_rows, n_docs)
+            rows = hi - lo
+            np_rows = doc_count_bucket(rows)
+            def padrows(a, fill):
+                out_shape = (np_rows,) + a.shape[1:]
+                out = np.full(out_shape, fill, a.dtype)
+                out[:rows] = a[lo:hi]
+                return out
+            seg_df = np.zeros(vocab, np.int64)
+            seg_ut = uterms[lo:hi]
+            np.add.at(seg_df, seg_ut[seg_ut >= 0], 1)
+            seg = Segment.from_packed_text(
+                0, "body", terms=term_names,
+                tokens=padrows(toks, -1) if with_positions else None,
+                uterms=padrows(uterms, -1), utf=padrows(utf, 0.0),
+                doc_len=padrows(lens, 0), df=seg_df, num_docs=rows,
+                ids=[str(lo + i) for i in range(rows)] +
+                    [""] * (np_rows - rows))
+            eng.install_segment(seg, track_versions=False)
         searcher = ShardSearcher(0, device_reader_for(eng, device=dev),
                                  ms_map)
-        log(f"[bench] engine: segment installed + device-packed in "
-            f"{time.perf_counter() - t0:.1f}s")
+        log(f"[bench] engine: {n_segs} segment(s) installed + "
+            f"device-packed in {time.perf_counter() - t0:.1f}s "
+            f"(positions={'yes' if with_positions else 'no'})")
+        # reader-global doc id → corpus row (padding rows map to -1)
+        gid_to_orig = np.full(searcher.reader.max_doc, -1, np.int64)
+        for dseg in searcher.reader.segments:
+            n_real = dseg.seg.num_docs
+            base = dseg.doc_base
+            first_id = int(dseg.seg.ids[0])
+            gid_to_orig[base:base + n_real] = np.arange(
+                first_id, first_id + n_real)
 
         texts = [" ".join(term_names[t] for t in row) for row in qtids_all]
         reqs = [parse_search_request({"query": {"match": {"body": tx}},
@@ -376,7 +432,12 @@ def main() -> int:
         res0 = searcher.query_phase_batch(bs[0])
         compile_s = time.perf_counter() - t0
         assert res0 is not None, "engine batch path fell back"
-        engine_ok = parity([(r.doc_ids, r.scores) for r in res0], "engine")
+        engine_rows = []
+        for r in res0:
+            orig = gid_to_orig[np.asarray(r.doc_ids, np.int64)]
+            assert (orig >= 0).all(), "engine returned a padding row"
+            engine_rows.append((orig, np.asarray(r.scores)))
+        engine_ok = parity(engine_rows, "engine")
         log(f"[bench] engine recall parity ({batch} queries, doc-id level): "
             f"{engine_ok}")
 

@@ -241,13 +241,15 @@ class DocumentActions:
 
     def index_doc(self, index: str, doc_id: str | None, source: dict,
                   routing: str | None = None, version: int | None = None,
-                  op_type: str = "index", refresh: bool = False) -> dict:
+                  op_type: str = "index", refresh: bool = False,
+                  version_type: str = "internal") -> dict:
         name = self._resolve_write_index(index)
         doc_id = doc_id or uuid.uuid4().hex[:20]
         shard = self._shard_id(name, doc_id, routing)
         request = {"index": name, "shard": shard, "id": doc_id,
                    "source": source, "routing": routing,
                    "version": version, "op_type": op_type,
+                   "version_type": version_type,
                    "refresh": refresh}
         return self._on_primary(name, shard, request, self.INDEX_P,
                                 self._handle_index_p_local)
@@ -263,7 +265,8 @@ class DocumentActions:
             request["id"], request["source"],
             version=MATCH_ANY if version is None else version,
             routing=request.get("routing"),
-            op_type=request.get("op_type", "index"))
+            op_type=request.get("op_type", "index"),
+            version_type=request.get("version_type", "internal"))
         if request.get("refresh"):
             engine.refresh()
         total, ok, failures = self._replicate(
@@ -290,11 +293,13 @@ class DocumentActions:
 
     def delete_doc(self, index: str, doc_id: str,
                    routing: str | None = None, version: int | None = None,
-                   refresh: bool = False) -> dict:
+                   refresh: bool = False,
+                   version_type: str = "internal") -> dict:
         name = self._resolve_single(index)
         shard = self._shard_id(name, doc_id, routing)
         request = {"index": name, "shard": shard, "id": doc_id,
-                   "version": version, "refresh": refresh}
+                   "version": version, "version_type": version_type,
+                   "refresh": refresh}
         return self._on_primary(name, shard, request, self.DELETE_P,
                                 self._handle_delete_p_local)
 
@@ -306,7 +311,9 @@ class DocumentActions:
         engine = self._engine(name, shard)
         version = request.get("version")
         v = engine.delete(request["id"],
-                          version=MATCH_ANY if version is None else version)
+                          version=MATCH_ANY if version is None else version,
+                          version_type=request.get("version_type",
+                                                   "internal"))
         if request.get("refresh"):
             engine.refresh()
         total, ok, failures = self._replicate(
@@ -328,11 +335,23 @@ class DocumentActions:
     # core/action/update/TransportUpdateAction.java) -------------------------
 
     def update_doc(self, index: str, doc_id: str, body: dict,
-                   routing: str | None = None, refresh: bool = False) -> dict:
-        name = self._resolve_single(index)
+                   routing: str | None = None, refresh: bool = False,
+                   version: int | None = None) -> dict:
+        if version is not None and ("upsert" in body
+                                    or body.get("doc_as_upsert")):
+            # the reference rejects this combination up front: a versioned
+            # update must never CREATE the doc
+            raise IllegalArgumentError(
+                "Validation Failed: can't provide version in upsert request")
+        # upserts auto-create the index like an index op (TransportUpdateAction
+        # routes through the same auto-create path)
+        name = self._resolve_write_index(index) \
+            if ("upsert" in body or body.get("doc_as_upsert")) \
+            else self._resolve_single(index)
         shard = self._shard_id(name, doc_id, routing)
         request = {"index": name, "shard": shard, "id": doc_id, "body": body,
-                   "routing": routing, "refresh": refresh}
+                   "routing": routing, "refresh": refresh,
+                   "req_version": version}
         return self._on_primary(name, shard, request, self.UPDATE_P,
                                 self._handle_update_local)
 
@@ -346,14 +365,22 @@ class DocumentActions:
         engine = self._engine(name, shard)
         current = engine.get(request["id"])
         if not current.found:
-            if "upsert" in body:
+            if "upsert" in body or body.get("doc_as_upsert"):
+                # doc_as_upsert: the partial doc IS the upsert document
+                # (UpdateHelper.prepare, TransportUpdateAction)
                 return self._handle_index_p_local(
                     {"index": name, "shard": shard, "id": request["id"],
-                     "source": body["upsert"],
+                     "source": body["upsert"] if "upsert" in body
+                     else body.get("doc", {}),
                      "routing": request.get("routing"), "version": None,
                      "op_type": "index",
                      "refresh": bool(request.get("refresh"))})
             raise DocumentMissingError(name, request["id"])
+        if request.get("req_version") is not None and \
+                current.version != request["req_version"]:
+            from elasticsearch_tpu.common.errors import VersionConflictError
+            raise VersionConflictError(name, request["id"], current.version,
+                                       request["req_version"])
         if "doc" in body:
             merged = _deep_merge(dict(current.source), body["doc"])
         elif "script" in body:
@@ -413,12 +440,14 @@ class DocumentActions:
             index=name, shard=shard)
 
     def get_doc(self, index: str, doc_id: str,
-                routing: str | None = None) -> dict:
+                routing: str | None = None, realtime: bool = True,
+                refresh: bool = False) -> dict:
         name = self._resolve_single(index)
         shard = self._shard_id(name, doc_id, routing)
         return self._single_shard_read(
             name, shard, self.GET_S,
-            {"index": name, "shard": shard, "id": doc_id},
+            {"index": name, "shard": shard, "id": doc_id,
+             "realtime": realtime, "refresh": refresh},
             self._handle_get)
 
     # ---- explain (core/action/explain/TransportExplainAction.java) ---------
@@ -534,7 +563,10 @@ class DocumentActions:
     def _handle_get(self, request: dict, source) -> dict:
         name = request["index"]
         engine = self._engine(name, request["shard"])
-        r = engine.get(request["id"])
+        if request.get("refresh"):
+            engine.refresh()
+        r = engine.get(request["id"],
+                       realtime=request.get("realtime", True))
         out = {"_index": name, "_type": "_doc", "_id": request["id"],
                "found": r.found}
         if r.found:
@@ -546,11 +578,13 @@ class DocumentActions:
         docs = []
         for spec in body.get("docs", []):
             idx = spec.get("_index", default_index)
+            did = str(spec["_id"])
             try:
-                docs.append(self.get_doc(idx, spec["_id"],
-                                         routing=spec.get("routing")))
+                docs.append(self.get_doc(idx, did,
+                                         routing=spec.get("routing",
+                                                          spec.get("_routing"))))
             except ElasticsearchTpuError as e:
-                docs.append({"_index": idx, "_id": spec["_id"],
+                docs.append({"_index": idx, "_id": did, "found": False,
                              "error": e.to_xcontent()})
         if "ids" in body and default_index:
             for did in body["ids"]:
@@ -558,6 +592,7 @@ class DocumentActions:
                     docs.append(self.get_doc(default_index, str(did)))
                 except ElasticsearchTpuError as e:
                     docs.append({"_index": default_index, "_id": str(did),
+                                 "found": False,
                                  "error": e.to_xcontent()})
         return {"docs": docs}
 

@@ -46,6 +46,25 @@ MATCH_ANY = -3  # Versions.MATCH_ANY
 NOT_FOUND = -1
 
 
+_VERSION_TYPES = ("internal", "external", "external_gt", "external_gte",
+                  "force")
+
+
+def _check_external_args(doc_id: str, version: int,
+                         version_type: str) -> None:
+    """VersionType validation (400-class): unknown types are rejected and
+    non-internal types REQUIRE an explicit version (the reference's
+    action_request_validation, not a 409)."""
+    from elasticsearch_tpu.common.errors import IllegalArgumentError
+    if version_type not in _VERSION_TYPES:
+        raise IllegalArgumentError(
+            f"version type [{version_type}] is not supported")
+    if version == MATCH_ANY:
+        raise IllegalArgumentError(
+            f"[{doc_id}] version must be set when version_type is "
+            f"[{version_type}]")
+
+
 @dataclass
 class VersionEntry:
     version: int
@@ -150,19 +169,36 @@ class Engine:
 
     def index(self, doc_id: str, source: dict, version: int = MATCH_ANY,
               routing: str | None = None, op_type: str = "index",
+              version_type: str = "internal",
               from_translog: bool = False) -> tuple[int, bool]:
         """→ (new_version, created). Version semantics follow
-        InternalEngine.innerIndex (version check → write → versionMap put)."""
+        InternalEngine.innerIndex (version check → write → versionMap put);
+        version_type external/external_gte/force per VersionType.java —
+        external compares against the LAST KNOWN version (tombstones
+        included) and the doc takes the caller's version."""
         t0 = time.perf_counter()
         with self._lock:
             self._ensure_open()
             entry = self._versions.get(doc_id)
             current = NOT_FOUND if entry is None or entry.deleted else entry.version
-            if op_type == "create" and current != NOT_FOUND:
-                raise VersionConflictError("", doc_id, current, 0)
-            if version != MATCH_ANY and version != current:
-                raise VersionConflictError("", doc_id, current, version)
-            new_version = 1 if current == NOT_FOUND else current + 1
+            if version_type != "internal":
+                _check_external_args(doc_id, version, version_type)
+                known = NOT_FOUND if entry is None else entry.version
+                ok = (version_type == "force"
+                      or known == NOT_FOUND
+                      or (version_type == "external_gte"
+                          and version >= known)
+                      or (version_type in ("external", "external_gt")
+                          and version > known))
+                if not ok:
+                    raise VersionConflictError("", doc_id, known, version)
+                new_version = version
+            else:
+                if op_type == "create" and current != NOT_FOUND:
+                    raise VersionConflictError("", doc_id, current, 0)
+                if version != MATCH_ANY and version != current:
+                    raise VersionConflictError("", doc_id, current, version)
+                new_version = 1 if current == NOT_FOUND else current + 1
 
             parsed = self.mapper_service.document_mapper().parse(
                 doc_id, source, routing=routing)
@@ -233,16 +269,31 @@ class Engine:
             return version
 
     def delete(self, doc_id: str, version: int = MATCH_ANY,
+               version_type: str = "internal",
                from_translog: bool = False) -> int:
         with self._lock:
             self._ensure_open()
             entry = self._versions.get(doc_id)
             current = NOT_FOUND if entry is None or entry.deleted else entry.version
-            if version != MATCH_ANY and version != current:
-                raise VersionConflictError("", doc_id, current, version)
-            if current == NOT_FOUND:
-                raise DocumentMissingError("", doc_id)
-            new_version = current + 1
+            if version_type != "internal":
+                _check_external_args(doc_id, version, version_type)
+                known = NOT_FOUND if entry is None else entry.version
+                ok = (version_type == "force" or known == NOT_FOUND
+                      or (version_type == "external_gte"
+                          and version >= known)
+                      or (version_type in ("external", "external_gt")
+                          and version > known))
+                if not ok:
+                    raise VersionConflictError("", doc_id, known, version)
+                if current == NOT_FOUND:
+                    raise DocumentMissingError("", doc_id)
+                new_version = version
+            else:
+                if version != MATCH_ANY and version != current:
+                    raise VersionConflictError("", doc_id, current, version)
+                if current == NOT_FOUND:
+                    raise DocumentMissingError("", doc_id)
+                new_version = current + 1
             if entry.seg_id == -1:
                 self._buffer.docs[entry.local_doc] = None
                 self._buffer_docs.pop(doc_id, None)
@@ -254,12 +305,17 @@ class Engine:
             self.stats.delete_total += 1
             return new_version
 
-    def get(self, doc_id: str) -> GetResult:
+    def get(self, doc_id: str, realtime: bool = True) -> GetResult:
         """Realtime get (reference: ShardGetService.java:68 — reads from the
-        version map / translog without waiting for refresh)."""
+        version map / translog without waiting for refresh). With
+        ``realtime=False``, the LAST REFRESHED view answers, like the
+        reference's searcher-backed get: buffered writes and buffered
+        deletes are invisible until refresh."""
         with self._lock:
             self._ensure_open()
             entry = self._versions.get(doc_id)
+            if not realtime:
+                return self._get_from_reader(doc_id, entry)
             if entry is None or entry.deleted:
                 return GetResult(found=False, doc_id=doc_id)
             if entry.seg_id == -1:
@@ -270,6 +326,24 @@ class Engine:
                     return GetResult(True, doc_id, entry.version,
                                      seg.sources[entry.local_doc])
             return GetResult(found=False, doc_id=doc_id)
+
+    def _get_from_reader(self, doc_id: str,
+                         entry: "VersionEntry | None") -> GetResult:
+        """Non-realtime get: resolve through the current point-in-time
+        view's segments + live masks (callers hold self._lock). The
+        reported version is the latest KNOWN version — segments don't
+        store per-row versions (a documented approximation)."""
+        view = self._reader
+        for seg, live in zip(view.segments, view.live_masks):
+            index = getattr(seg, "_id_index", None)
+            if index is None:
+                index = {d: i for i, d in enumerate(seg.ids[:seg.num_docs])}
+                seg._id_index = index
+            local = index.get(doc_id)
+            if local is not None and bool(live[local]):
+                version = entry.version if entry is not None else 1
+                return GetResult(True, doc_id, version, seg.sources[local])
+        return GetResult(found=False, doc_id=doc_id)
 
     # --------------------------------------------------------------- refresh
 

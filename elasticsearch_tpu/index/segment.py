@@ -78,6 +78,11 @@ class TextFieldColumn:
     doc_len: np.ndarray              # [Np] int32 (token count incl. truncation)
     df: np.ndarray                   # [V] int32 docs-containing-term
     total_tokens: int                # Σ doc_len over real docs (for avgdl)
+    # False when positions were not indexed (the reference's
+    # index_options: freqs): tokens is a -1 stub and positional queries
+    # (match_phrase, span_near) refuse the field instead of silently
+    # matching nothing
+    has_positions: bool = True
     term_index: dict[str, int] = dc_field(default_factory=dict)
 
     def __post_init__(self):
@@ -177,7 +182,7 @@ class Segment:
 
     @staticmethod
     def from_packed_text(seg_id: int, field: str, *, terms: list[str],
-                         tokens: np.ndarray, uterms: np.ndarray,
+                         tokens: np.ndarray | None, uterms: np.ndarray,
                          utf: np.ndarray, doc_len: np.ndarray,
                          df: np.ndarray, num_docs: int,
                          total_tokens: int | None = None,
@@ -192,9 +197,14 @@ class Segment:
 
         Invariants (the SegmentBuilder contract): ``terms`` is SORTED and
         term ids are ranks in it; ``tokens`` is position-indexed with -1
-        holes; rows at and beyond ``num_docs`` are padding (-1 / 0).
+        holes — or ``None`` to skip position indexing entirely (the
+        reference's ``index_options: freqs``: ~40% less memory, positional
+        queries rejected); rows at and beyond ``num_docs`` are padding.
         """
         np_docs = int(uterms.shape[0])
+        has_positions = tokens is not None
+        if tokens is None:
+            tokens = np.full((np_docs, 8), -1, np.int32)
         if not (tokens.shape[0] == np_docs == doc_len.shape[0]
                 == utf.shape[0]):
             raise ValueError("packed columns disagree on row count")
@@ -209,7 +219,7 @@ class Segment:
             utf=np.ascontiguousarray(utf, dtype=np.float32),
             doc_len=np.ascontiguousarray(doc_len, dtype=np.int32),
             df=np.ascontiguousarray(df, dtype=np.int32),
-            total_tokens=total_tokens)
+            total_tokens=total_tokens, has_positions=has_positions)
         if ids is None:
             ids = [str(i) for i in range(num_docs)] + \
                 [""] * (np_docs - num_docs)
@@ -238,7 +248,8 @@ class Segment:
         }
         for name, c in self.text_fields.items():
             meta["text_fields"][name] = {"terms": c.terms,
-                                         "total_tokens": c.total_tokens}
+                                         "total_tokens": c.total_tokens,
+                                         "has_positions": c.has_positions}
             for a in ("tokens", "uterms", "utf", "doc_len", "df"):
                 arrays[f"t.{name}.{a}"] = getattr(c, a)
         for name, c in self.keyword_fields.items():
@@ -291,6 +302,7 @@ class Segment:
         text_fields = {
             name: TextFieldColumn(
                 terms=info["terms"], total_tokens=info["total_tokens"],
+                has_positions=info.get("has_positions", True),
                 tokens=arrays[f"t.{name}.tokens"],
                 uterms=arrays[f"t.{name}.uterms"], utf=arrays[f"t.{name}.utf"],
                 doc_len=arrays[f"t.{name}.doc_len"], df=arrays[f"t.{name}.df"])

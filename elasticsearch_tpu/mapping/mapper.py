@@ -132,8 +132,11 @@ class FieldMapper:
                 f"{name}.{sub_name}", sub_def.get("type", "keyword"), sub_def, analysis)
 
     def to_dict(self) -> dict:
-        out = {"type": self.type, **{k: v for k, v in self.params.items()
-                                     if k not in ("type", "fields")}}
+        # render the type the mapping was PUT with (2.x "string" stays
+        # "string" even though it resolved to text/keyword internally)
+        out = {"type": self.params.get("type", self.type),
+               **{k: v for k, v in self.params.items()
+                  if k not in ("type", "fields")}}
         if self.sub_fields:
             out["fields"] = {n.split(".")[-1]: m.to_dict()
                              for n, m in self.sub_fields.items()}
@@ -367,7 +370,8 @@ class DocumentMapper:
             for p in parts[:-1]:
                 node = node.setdefault(p, {}).setdefault("properties", {})
             node[parts[-1]] = m.to_dict()
-        return {"properties": props}
+        # an empty mapping renders as {} (the reference omits `properties`)
+        return {"properties": props} if props else {}
 
 
 class MapperService:

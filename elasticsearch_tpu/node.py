@@ -532,25 +532,33 @@ class Node:
 
     def index_doc(self, index: str, doc_id: str | None, source: dict,
                   routing: str | None = None, version: int | None = None,
-                  op_type: str = "index", refresh: bool = False) -> dict:
+                  op_type: str = "index", refresh: bool = False,
+                  version_type: str = "internal") -> dict:
         return self.document_actions.index_doc(
             index, doc_id, source, routing=routing, version=version,
-            op_type=op_type, refresh=refresh)
+            op_type=op_type, refresh=refresh, version_type=version_type)
 
     def get_doc(self, index: str, doc_id: str,
-                routing: str | None = None) -> dict:
-        return self.document_actions.get_doc(index, doc_id, routing=routing)
+                routing: str | None = None, realtime: bool = True,
+                refresh: bool = False) -> dict:
+        return self.document_actions.get_doc(index, doc_id, routing=routing,
+                                             realtime=realtime,
+                                             refresh=refresh)
 
     def delete_doc(self, index: str, doc_id: str,
                    routing: str | None = None, version: int | None = None,
-                   refresh: bool = False) -> dict:
+                   refresh: bool = False,
+                   version_type: str = "internal") -> dict:
         return self.document_actions.delete_doc(
-            index, doc_id, routing=routing, version=version, refresh=refresh)
+            index, doc_id, routing=routing, version=version, refresh=refresh,
+            version_type=version_type)
 
     def update_doc(self, index: str, doc_id: str, body: dict,
-                   routing: str | None = None, refresh: bool = False) -> dict:
+                   routing: str | None = None, refresh: bool = False,
+                   version: int | None = None) -> dict:
         return self.document_actions.update_doc(
-            index, doc_id, body, routing=routing, refresh=refresh)
+            index, doc_id, body, routing=routing, refresh=refresh,
+            version=version)
 
     def mget(self, body: dict, default_index: str | None = None) -> dict:
         return self.document_actions.mget(body, default_index)

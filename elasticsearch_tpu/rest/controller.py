@@ -111,7 +111,11 @@ class RestController:
             except (json.JSONDecodeError, UnicodeDecodeError):
                 req.body = None  # NDJSON handlers read raw_body
         try:
-            return handler(req)
+            status, payload = handler(req)
+            fp = qs.get("filter_path")
+            if fp and isinstance(payload, (dict, list)):
+                payload = filter_response(payload, fp.split(","))
+            return status, payload
         except ElasticsearchTpuError as e:
             return e.status, {"error": {"root_cause": [e.to_xcontent()],
                                         **e.to_xcontent()},
@@ -119,3 +123,62 @@ class RestController:
         except Exception as e:  # noqa: BLE001 — REST boundary
             return 500, {"error": {"type": "exception", "reason": str(e)},
                          "status": 500}
+
+
+def filter_response(payload, patterns: list[str]):
+    """`filter_path` response filtering (ref: the 2.x response-filtering
+    support, XContentMapValues-style path globs): keep only sub-trees whose
+    dotted path matches a pattern; `*` matches one segment, `**` any number.
+    Array elements inherit their container's path (indices don't count as
+    segments, like the reference)."""
+    import fnmatch as _fn
+    pats = [p.split(".") for p in patterns if p]
+
+    def walk(obj, active):
+        if isinstance(obj, list):
+            out = []
+            for el in obj:
+                kept = walk(el, active)
+                if kept is not _OMIT:
+                    out.append(kept)
+            return out if out else _OMIT
+        if not isinstance(obj, dict):
+            # a leaf survives only when some pattern is fully consumed or
+            # sits on a trailing '**'
+            return obj if any(p == [] or p == ["**"] for p in active) \
+                else _OMIT
+        out = {}
+        for key, val in obj.items():
+            nxt = []
+            full = False
+            for pat in active:
+                if pat == [] or pat == ["**"]:
+                    full = True
+                    continue
+                head, rest = pat[0], pat[1:]
+                if head == "**":
+                    nxt.append(pat)          # '**' keeps absorbing segments
+                    if rest and _fn.fnmatch(key, rest[0]):
+                        if len(rest) == 1:
+                            full = True
+                        else:
+                            nxt.append(rest[1:])
+                elif _fn.fnmatch(key, head):
+                    if not rest:
+                        full = True
+                    else:
+                        nxt.append(rest)
+            if full:
+                out[key] = val
+                continue
+            if nxt:
+                kept = walk(val, nxt)
+                if kept is not _OMIT:
+                    out[key] = kept
+        return out if out else _OMIT
+
+    kept = walk(payload, pats)
+    return {} if kept is _OMIT else kept
+
+
+_OMIT = object()

@@ -23,12 +23,14 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/", h.root)
     # index CRUD
     r("PUT", "/{index}", h.create_index)
+    r("POST", "/{index}", h.create_index)    # 2.x allows POST create
     r("DELETE", "/{index}", h.delete_index)
     r("GET", "/{index}", h.get_index)
     r("HEAD", "/{index}", h.head_index)
     r("POST", "/{index}/_refresh", h.refresh)
     r("GET", "/{index}/_refresh", h.refresh)
     r("POST", "/_refresh", h.refresh_all)
+    r("GET", "/_refresh", h.refresh_all)
     r("POST", "/{index}/_flush", h.flush)
     r("POST", "/_flush", h.flush_all)
     r("POST", "/{index}/_forcemerge", h.force_merge)
@@ -37,10 +39,18 @@ def register_all(rc: RestController, node) -> None:
     r("POST", "/{index}/_close", h.close_index)
     # mappings & settings
     r("PUT", "/{index}/_mapping", h.put_mapping)
+    r("POST", "/{index}/_mapping", h.put_mapping)
     r("PUT", "/{index}/_mappings", h.put_mapping)
     r("PUT", "/{index}/_mapping/{type}", h.put_mapping)
+    r("POST", "/{index}/_mapping/{type}", h.put_mapping)
+    r("PUT", "/{index}/{type}/_mapping", h.put_mapping)
+    r("POST", "/{index}/{type}/_mapping", h.put_mapping)
+    r("PUT", "/_mapping/{type}", h.put_mapping_all)
+    r("POST", "/_mapping/{type}", h.put_mapping_all)
     r("GET", "/{index}/_mapping", h.get_mapping)
+    r("GET", "/{index}/_mapping/{type}", h.get_mapping)
     r("GET", "/_mapping", h.get_all_mappings)
+    r("GET", "/_mapping/{type}", h.get_all_mappings)
     r("GET", "/{index}/_settings", h.get_settings)
     r("PUT", "/{index}/_settings", h.put_settings)
     # aliases
@@ -82,9 +92,17 @@ def register_all(rc: RestController, node) -> None:
     r("POST", "/_mget", h.mget)
     r("GET", "/_mget", h.mget)
     r("POST", "/{index}/_mget", h.mget)
-    # search family
+    r("GET", "/{index}/{type}/_mget", h.mget)
+    r("POST", "/{index}/{type}/_mget", h.mget)
+    # search family (incl. the 2.x typed routes /{index}/{type}/_search;
+    # types are a namespacing fiction here — single-type semantics)
     r("GET", "/_search", h.search_all)
     r("POST", "/_search", h.search_all)
+    r("GET", "/{index}/{type}/_search", h.search)
+    r("POST", "/{index}/{type}/_search", h.search)
+    r("GET", "/{index}/{type}/_count", h.count)
+    r("HEAD", "/{index}/{type}", h.type_exists)
+    r("POST", "/{index}/{type}/_count", h.count)
     r("GET", "/_msearch", h.msearch)
     r("POST", "/_msearch", h.msearch)
     r("GET", "/{index}/_msearch", h.msearch)
@@ -94,6 +112,12 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/{index}/_count", h.count)
     r("POST", "/{index}/_count", h.count)
     r("GET", "/_count", h.count_all)
+    r("GET", "/_search/template", h.search_template)
+    r("POST", "/_search/template", h.search_template)
+    r("GET", "/{index}/_search/template", h.search_template)
+    r("POST", "/{index}/_search/template", h.search_template)
+    r("GET", "/{index}/{type}/_search/template", h.search_template)
+    r("POST", "/{index}/{type}/_search/template", h.search_template)
     r("POST", "/_search/scroll", h.scroll)
     r("GET", "/_search/scroll", h.scroll)
     r("DELETE", "/_search/scroll", h.clear_scroll)
@@ -159,9 +183,21 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/_nodes/{node}/hot_threads", h.nodes_hot_threads)
 
 
+def _filter_doc_source(src, spec):
+    from elasticsearch_tpu.search.phase import _filter_source
+    if src is None:
+        return None
+    return _filter_source(src, spec)
+
+
 class Handlers:
     def __init__(self, node):
         self.node = node
+        # 2.x type bookkeeping: typed routes remember each doc's type so
+        # `GET /{index}/_all/{id}` can echo the type it was indexed with —
+        # types are a REST-surface fiction over the typeless engine (the
+        # map is in-memory; after restart _all-gets answer `_doc`)
+        self._doc_types: dict[tuple[str, str], str] = {}
 
     @staticmethod
     def _check_type(req: RestRequest) -> None:
@@ -170,6 +206,8 @@ class Handlers:
         type names may not start with '_' (reference: MapperService type
         validation)."""
         t = req.path_params.get("type")
+        if t == "_all":          # ES accepts _all as a type wildcard
+            return
         if t is not None and t.startswith("_"):
             from elasticsearch_tpu.common.errors import IllegalArgumentError
             raise IllegalArgumentError(
@@ -246,11 +284,29 @@ class Handlers:
             self.node.indices_service.put_mapping(n, tname, body)
         return 200, {"acknowledged": True}
 
+    def put_mapping_all(self, req: RestRequest):
+        req.path_params = {**req.path_params, "index": "_all"}
+        return self.put_mapping(req)
+
     def get_mapping(self, req: RestRequest):
+        want_type = req.path_params.get("type")
         out = {}
         for n in self.node.indices_service.resolve(req.path_params["index"]):
             svc = self.node.indices_service.index(n)
-            out[n] = {"mappings": svc.mapper_service.mapping_dict()}
+            md = svc.mapper_service.mapping_dict()
+            if want_type and want_type != "_all":
+                md = {t: m for t, m in md.items() if t == want_type}
+                if not md:
+                    continue
+            out[n] = {"mappings": md}
+        if want_type and want_type != "_all" and not out:
+            from elasticsearch_tpu.common.errors import \
+                ElasticsearchTpuError
+
+            class _TypeMissing(ElasticsearchTpuError):
+                status = 404
+                error_type = "type_missing_exception"
+            raise _TypeMissing(f"type [{want_type}] missing")
         return 200, out
 
     def get_all_mappings(self, req: RestRequest):
@@ -338,6 +394,28 @@ class Handlers:
 
     # ---- documents --------------------------------------------------------
 
+    def _echo_type(self, req: RestRequest, resp):
+        """2.x typed routes echo the {type} path segment in responses,
+        and routed requests echo _routing (the reference returns the
+        routing the doc was addressed with)."""
+        t = req.path_params.get("type")
+        index = req.path_params.get("index")
+        doc_id = req.path_params.get("id")
+        if t and t != "_all" and isinstance(resp, dict) and "_type" in resp:
+            resp = {**resp, "_type": t}
+            if index and doc_id and req.method in ("PUT", "POST") \
+                    and len(self._doc_types) < 100_000:
+                self._doc_types[(index, doc_id)] = t
+        elif t == "_all" and isinstance(resp, dict) and "_type" in resp \
+                and index and doc_id:
+            known = self._doc_types.get((index, doc_id))
+            if known:
+                resp = {**resp, "_type": known}
+        routing = req.param("routing")
+        if routing and isinstance(resp, dict) and "_id" in resp:
+            resp = {**resp, "_routing": routing}
+        return resp
+
     def index_doc(self, req: RestRequest):
         self._check_type(req)
         version = req.param("version")
@@ -346,8 +424,9 @@ class Handlers:
             routing=req.param("routing"),
             version=int(version) if version else None,
             op_type="create" if req.param("op_type") == "create" else "index",
+            version_type=req.param("version_type") or "internal",
             refresh=req.param_as_bool("refresh"))
-        return (201 if resp["created"] else 200), resp
+        return (201 if resp["created"] else 200), self._echo_type(req, resp)
 
     def index_doc_auto_id(self, req: RestRequest):
         self._check_type(req)
@@ -355,7 +434,7 @@ class Handlers:
             req.path_params["index"], None, req.body or {},
             routing=req.param("routing"),
             refresh=req.param_as_bool("refresh"))
-        return 201, resp
+        return 201, self._echo_type(req, resp)
 
     def create_doc(self, req: RestRequest):
         resp = self.node.index_doc(
@@ -364,12 +443,89 @@ class Handlers:
             refresh=req.param_as_bool("refresh"))
         return 201, resp
 
+    def type_exists(self, req: RestRequest):
+        """HEAD /{index}/{type} (RestTypesExistsAction): the type exists
+        when the index has a mapping registered under that name."""
+        name = req.path_params["index"]
+        svc = self.node.indices_service.indices.get(name)
+        if svc is None:
+            try:
+                names = self.node.indices_service.resolve(name)
+            except Exception:               # noqa: BLE001 — missing index
+                return 404, ""
+            svc = self.node.indices_service.indices.get(
+                names[0]) if names else None
+            if svc is None:
+                return 404, ""
+        t = req.path_params["type"]
+        known = set(svc.mapper_service.mappers) | {"_all", "_doc"}
+        return (200 if t in known else 404), ""
+
     def get_doc(self, req: RestRequest):
         self._check_type(req)
-        resp = self.node.get_doc(req.path_params["index"],
-                                 req.path_params["id"],
-                                 routing=req.param("routing"))
-        return (200 if resp["found"] else 404), resp
+        resp = self.node.get_doc(
+            req.path_params["index"], req.path_params["id"],
+            routing=req.param("routing"),
+            realtime=req.param_as_bool("realtime", True),
+            refresh=req.param_as_bool("refresh"))
+        t = req.path_params.get("type")
+        if resp["found"] and t and t != "_all":
+            stored = self._doc_types.get((req.path_params["index"],
+                                          req.path_params["id"]))
+            if stored and t != stored:    # wrong type = miss (2.x)
+                resp = {"_index": req.path_params["index"], "_type": t,
+                        "_id": req.path_params["id"], "found": False}
+        if resp["found"]:
+            raw_src = resp.get("_source") or {}
+            src_spec = self._get_source_spec(req)
+            if src_spec is not True:
+                filtered = _filter_doc_source(resp.get("_source"), src_spec)
+                resp = dict(resp)
+                if filtered is None:
+                    resp.pop("_source", None)
+                else:
+                    resp["_source"] = filtered
+            want_version = req.param("version")
+            if want_version and req.param("version_type") != "force" \
+                    and int(want_version) != resp.get("_version"):
+                from elasticsearch_tpu.common.errors import \
+                    VersionConflictError
+                raise VersionConflictError(
+                    req.path_params["index"], req.path_params["id"],
+                    resp.get("_version"), int(want_version))
+            fields = req.param("fields")
+            if fields:
+                # extracted from the UNFILTERED source: fields are
+                # independent of whether _source is echoed (2.x)
+                src = raw_src
+                out = {}
+                for f in fields.split(","):
+                    v = src.get(f)
+                    if v is not None:
+                        out[f] = v if isinstance(v, list) else [v]
+                resp = {**resp, "fields": out}
+                if req.param("_source") in (None, "false"):
+                    resp.pop("_source", None)
+        return (200 if resp["found"] else 404), self._echo_type(req, resp)
+
+    @staticmethod
+    def _get_source_spec(req: RestRequest):
+        """GET-api _source filtering params → a _filter_source spec."""
+        raw = req.param("_source")
+        inc = req.param("_source_include", req.param("_source_includes"))
+        exc = req.param("_source_exclude", req.param("_source_excludes"))
+        if raw is None and not inc and not exc:
+            return True
+        if raw == "false":
+            return False
+        spec: dict = {}
+        if raw not in (None, "true", "false", ""):
+            spec["includes"] = raw.split(",")
+        if inc:
+            spec["includes"] = inc.split(",")
+        if exc:
+            spec["excludes"] = exc.split(",")
+        return spec if spec else True
 
     def get_source(self, req: RestRequest):
         self._check_type(req)
@@ -382,23 +538,66 @@ class Handlers:
 
     def delete_doc(self, req: RestRequest):
         self._check_type(req)
+        version = req.param("version")
         resp = self.node.delete_doc(req.path_params["index"],
                                     req.path_params["id"],
                                     routing=req.param("routing"),
+                                    version=int(version) if version
+                                    else None,
+                                    version_type=req.param("version_type")
+                                    or "internal",
                                     refresh=req.param_as_bool("refresh"))
-        return 200, resp
+        return 200, self._echo_type(req, resp)
 
     def update_doc(self, req: RestRequest):
         self._check_type(req)
+        vt = req.param("version_type")
+        if vt and vt != "internal":
+            from elasticsearch_tpu.common.errors import IllegalArgumentError
+            raise IllegalArgumentError(
+                f"Validation Failed: version type [{vt}] is not supported "
+                f"by the update API")
+        version = req.param("version")
         resp = self.node.update_doc(req.path_params["index"],
                                     req.path_params["id"], req.body or {},
                                     routing=req.param("routing"),
+                                    version=int(version) if version
+                                    else None,
                                     refresh=req.param_as_bool("refresh"))
-        return 200, resp
+        return 200, self._echo_type(req, resp)
 
     def mget(self, req: RestRequest):
-        return 200, self.node.mget(req.body or {},
-                                   req.path_params.get("index"))
+        from elasticsearch_tpu.common.errors import IllegalArgumentError
+        body = req.body or {}
+        default_index = req.path_params.get("index")
+        problems = []
+        for i, spec in enumerate(body.get("docs", [])):
+            if "_id" not in spec:
+                problems.append(f"id is missing for doc {i}")
+            if "_index" not in spec and not default_index:
+                problems.append(f"index is missing for doc {i}")
+        if problems:
+            raise IllegalArgumentError(
+                "action_request_validation_exception: "
+                + "; ".join(problems))
+        out = self.node.mget(body, req.path_params.get("index"))
+        # echo each doc spec's _type; a WRONG type is a miss (2.x type
+        # fiction, cf. _echo_type — types namespace docs at the surface)
+        specs = list(body.get("docs", []))
+        default_t = req.path_params.get("type")
+        for i, doc in enumerate(out.get("docs", [])):
+            spec = specs[i] if i < len(specs) else {}
+            t = spec.get("_type") or default_t
+            if not t or t == "_all":
+                continue
+            doc["_type"] = t
+            stored = self._doc_types.get((doc.get("_index"),
+                                          doc.get("_id")))
+            if doc.get("found") and stored and t != stored:
+                out["docs"][i] = {"_index": doc.get("_index"),
+                                  "_type": t, "_id": doc.get("_id"),
+                                  "found": False}
+        return 200, out
 
     # ---- bulk -------------------------------------------------------------
 
@@ -457,6 +656,16 @@ class Handlers:
                 for s in req.param("sort").split(",")]
         if req.param("_source") in ("false", "true"):
             body["_source"] = req.param("_source") == "true"
+        inc = req.param("_source_include", req.param("_source_includes"))
+        exc = req.param("_source_exclude", req.param("_source_excludes"))
+        if inc or exc:
+            spec = body.get("_source")
+            spec = spec if isinstance(spec, dict) else {}
+            if inc:
+                spec["includes"] = inc.split(",")
+            if exc:
+                spec["excludes"] = exc.split(",")
+            body["_source"] = spec
         return body
 
     def msearch(self, req: RestRequest):
@@ -483,11 +692,36 @@ class Handlers:
             items.append((index, body))
         return 200, self.node.search_actions.multi_search(items)
 
+    @staticmethod
+    def _rest_search_type(req: RestRequest) -> str | None:
+        st = req.param("search_type")
+        if st in ("query_and_fetch", "dfs_query_and_fetch"):
+            # internal-only since 2.x (issue 9606): the REST layer rejects
+            # them even though the action layer understands the aliases
+            from elasticsearch_tpu.common.errors import IllegalArgumentError
+            raise IllegalArgumentError(
+                f"search_type [{st}] is not supported from the REST layer")
+        return st
+
+    def search_template(self, req: RestRequest):
+        """/_search/template: render the mustache template into a search
+        body, then search (RestSearchTemplateAction /
+        SearchService.parseTemplate)."""
+        from elasticsearch_tpu.search.templates import render_search_template
+        body = render_search_template(req.body or {}, lambda _i: None)
+        resp = self.node.search(req.path_params.get("index", "_all"), body,
+                                search_type=self._rest_search_type(req))
+        return 200, resp
+
     def search(self, req: RestRequest):
         resp = self.node.search(req.path_params["index"],
                                 self._search_body(req),
                                 scroll=req.param("scroll"),
-                                search_type=req.param("search_type"))
+                                search_type=self._rest_search_type(req))
+        t = req.path_params.get("type")
+        if t and t != "_all":
+            for hit in resp.get("hits", {}).get("hits", []):
+                hit["_type"] = t
         return 200, resp
 
     def search_all(self, req: RestRequest):
@@ -498,7 +732,7 @@ class Handlers:
                                   "max_score": None, "hits": []}}
         resp = self.node.search("_all", self._search_body(req),
                                 scroll=req.param("scroll"),
-                                search_type=req.param("search_type"))
+                                search_type=self._rest_search_type(req))
         return 200, resp
 
     def count(self, req: RestRequest):
@@ -518,7 +752,7 @@ class Handlers:
         out = self.node.document_actions.explain_doc(
             req.path_params["index"], req.path_params["id"], body,
             routing=req.param("routing"))
-        return 200, out
+        return 200, self._echo_type(req, out)
 
     def termvectors(self, req: RestRequest):
         self._check_type(req)
@@ -696,10 +930,17 @@ class Handlers:
             from elasticsearch_tpu.common.settings import parse_time_millis
             timeout = parse_time_millis(
                 req.params.get("timeout", "30s")) / 1000.0
-            return 200, self.node.wait_for_health(
+            out = self.node.wait_for_health(
                 want, timeout, wait_for_nodes=wait_nodes)
-        return 200, self.node.cluster_service.state().health(
-            len(self.node.cluster_service.pending_tasks()))
+        else:
+            out = self.node.cluster_service.state().health(
+                len(self.node.cluster_service.pending_tasks()))
+        if req.params.get("level") in ("indices", "shards"):
+            state = self.node.cluster_service.state()
+            out = dict(out)
+            out["indices"] = {name: {"status": out["status"]}
+                              for name in state.indices}
+        return 200, out
 
     def cluster_state(self, req: RestRequest):
         state = self.node.cluster_service.state()

@@ -31,7 +31,8 @@ from elasticsearch_tpu.common.settings import parse_time_value
 from elasticsearch_tpu.mapping.mapper import parse_date
 
 BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "date_range",
-               "filter", "filters", "global", "missing"}
+               "filter", "filters", "global", "missing",
+               "significant_terms"}
 METRIC_AGGS = {"min", "max", "sum", "avg", "stats", "extended_stats",
                "value_count", "cardinality", "percentiles", "top_hits"}
 PIPELINE_AGGS = {"avg_bucket", "max_bucket", "min_bucket", "sum_bucket",
@@ -244,10 +245,15 @@ def _d_value_count(fname: str, state: DeviceAggState) -> dict:
 
 def _d_terms(fname: str, state: DeviceAggState) -> dict:
     """Keyword terms agg: per-segment ordinal counts on device (vocab-sized
-    fetches), union-merged host-side by term string."""
+    fetches), union-merged host-side by term string. Resolution mirrors
+    ShardAggContext.keyword_values: an analyzed text field wins over its
+    .keyword multi-field (2.x fielddata tokens) and stays host-side."""
     from elasticsearch_tpu.ops import aggs_ops
     segs = state.reader.segments
-    for candidate in (fname, f"{fname}.keyword"):
+    candidates = [fname]
+    if not any(seg.text.get(fname) is not None for seg in segs):
+        candidates.append(f"{fname}.keyword")
+    for candidate in candidates:
         cols = [seg.keyword.get(candidate) for seg in segs]
         if not any(c is not None for c in cols):
             continue
@@ -376,6 +382,13 @@ class ShardAggContext:
         self.execute_filter = execute_filter  # (Query) → list[np mask per seg]
         self.scores = scores                  # [N] query scores (top_hits)
 
+    def live_mask(self) -> np.ndarray:
+        """Concatenated live mask over the reader (significant_terms'
+        background set)."""
+        return np.concatenate([np.asarray(s.live)
+                               for s in self.reader.segments]) \
+            if self.reader.segments else np.zeros(0, bool)
+
     def numeric_values(self, fname: str):
         """→ (values f64 concat over segments, exists concat)."""
         vals, exists = [], []
@@ -392,23 +405,27 @@ class ShardAggContext:
     def keyword_values(self, fname: str):
         """→ (ords [N,K] concat (ord remapped to per-shard union), vocab).
 
-        Resolution order: exact keyword column → `{field}.keyword`
-        multi-field (the dynamic-string mapping) → uninverted text tokens
-        (the reference loads fielddata for an analyzed string and its
-        terms agg yields the analyzed tokens — IndexFieldDataService on a
-        string field, SURVEY §2.5 fielddata)."""
+        Resolution order: exact keyword column → uninverted text tokens
+        (the reference loads fielddata for an analyzed string, so a
+        terms/significant_terms agg on it yields the ANALYZED tokens —
+        IndexFieldDataService on a string field, SURVEY §2.5 fielddata) →
+        `{field}.keyword` multi-field as a last resort."""
         segs = self.reader.segments
-        for candidate in (fname, f"{fname}.keyword"):
-            cols = [s.seg.keyword_fields.get(candidate) for s in segs]
-            if any(c is not None for c in cols):
-                return self._union_ords(
-                    [(c.vocab, c.ords) if c is not None else None
-                     for c in cols])
+        cols = [s.seg.keyword_fields.get(fname) for s in segs]
+        if any(c is not None for c in cols):
+            return self._union_ords(
+                [(c.vocab, c.ords) if c is not None else None
+                 for c in cols])
         tcols = [s.seg.text_fields.get(fname) for s in segs]
         if any(c is not None for c in tcols):
             return self._union_ords(
                 [(c.terms, c.uterms) if c is not None else None
                  for c in tcols])
+        cols = [s.seg.keyword_fields.get(f"{fname}.keyword") for s in segs]
+        if any(c is not None for c in cols):
+            return self._union_ords(
+                [(c.vocab, c.ords) if c is not None else None
+                 for c in cols])
         return self._union_ords([None] * len(segs))
 
     def _union_ords(self, per_seg):
@@ -693,6 +710,34 @@ def _c_missing(node, mask, ctx):
     return out
 
 
+def _c_significant_terms(node, mask, ctx):
+    """significant_terms (ref: core/search/aggregations/bucket/significant/
+    SignificantTermsAggregator + JLHScore): per-term foreground (query
+    mask) and background (whole index) counts; the coordinator scores the
+    merged counts."""
+    fname = node.params.get("field")
+    ords, vocab = ctx.keyword_values(fname)
+    live = ctx.live_mask()
+    if not vocab:
+        return {"buckets": [], "fg_total": int((mask & live).sum()),
+                "bg_total": int(live.sum())}
+    fg_sel = ords[mask & live]
+    bg_sel = ords[live]
+    fg = np.bincount(fg_sel[fg_sel >= 0], minlength=len(vocab))
+    bg = np.bincount(bg_sel[bg_sel >= 0], minlength=len(vocab))
+    buckets = {}
+    for oid in np.nonzero(fg)[0]:
+        key = vocab[int(oid)]
+        b = {"doc_count": int(fg[oid]), "bg_count": int(bg[oid])}
+        if node.subs:
+            bmask = mask & live & (ords == oid).any(axis=1)
+            b["subs"] = _collect_subs(node, bmask, ctx)
+        buckets[key] = b
+    return {"buckets": _as_pairs(buckets),
+            "fg_total": int((mask & live).sum()),
+            "bg_total": int(live.sum())}
+
+
 _COLLECTORS = {
     "min": _c_metric, "max": _c_metric, "sum": _c_metric, "avg": _c_metric,
     "stats": _c_metric, "extended_stats": _c_metric,
@@ -703,6 +748,7 @@ _COLLECTORS = {
     "range": _c_range, "date_range": lambda n, m, c: _c_range(n, m, c, True),
     "filter": _c_filter, "filters": _c_filters,
     "global": _c_global, "missing": _c_missing,
+    "significant_terms": _c_significant_terms,
 }
 
 
@@ -916,6 +962,41 @@ def _reduce_node(node: AggNode, parts: list[dict]) -> dict:
         buckets = [{"key": k, **_final_bucket(merged[k])} for k in order
                    if k in merged]
         return {"buckets": buckets}
+    if t == "significant_terms":
+        fg_total = sum(p.get("fg_total", 0) for p in parts)
+        bg_total = sum(p.get("bg_total", 0) for p in parts)
+        counts: dict = {}
+        sub_parts: dict = {}
+        for p in parts:
+            for key, b in _bucket_dict(p).items():
+                cur = counts.setdefault(key, {"doc_count": 0, "bg_count": 0})
+                cur["doc_count"] += b["doc_count"]
+                cur["bg_count"] += b.get("bg_count", 0)
+                if "subs" in b:
+                    sub_parts.setdefault(key, []).append(b["subs"])
+        min_dc = int(node.params.get("min_doc_count", 3))
+        size = int(node.params.get("size", 10) or 0) or len(counts)
+        scored = []
+        for key, b in counts.items():
+            if b["doc_count"] < min_dc:
+                continue
+            fg_pct = b["doc_count"] / max(fg_total, 1)
+            bg_pct = b["bg_count"] / max(bg_total, 1)
+            # JLH (SignificanceHeuristic default): 0 unless the term is
+            # MORE frequent in the foreground than in the background
+            score = 0.0 if fg_pct <= bg_pct or bg_pct == 0 else \
+                (fg_pct - bg_pct) * (fg_pct / bg_pct)
+            if score > 0:
+                scored.append((score, key, b))
+        scored.sort(key=lambda x: (-x[0], str(x[1])))
+        buckets = []
+        for s, k, b in scored[:size]:
+            bucket = {"key": k, "doc_count": b["doc_count"],
+                      "score": s, "bg_count": b["bg_count"]}
+            if node.subs and k in sub_parts:
+                bucket.update(reduce_aggs(node.subs, sub_parts[k]))
+            buckets.append(bucket)
+        return {"doc_count": fg_total, "buckets": buckets}
     raise QueryParsingError(f"cannot reduce aggregation type [{node.type}]")
 
 

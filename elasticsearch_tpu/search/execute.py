@@ -330,6 +330,11 @@ class SegmentResolver:
             return self.resolve(q.MatchQuery(
                 field=field, text=query.text, analyzer=query.analyzer,
                 boost=query.boost))
+        col = self.seg.text.get(field)
+        if col is not None and not col.column.has_positions:
+            raise QueryParsingError(
+                f"field [{field}] was not indexed with positions — "
+                f"phrase queries need index_options [positions]")
         resolved = self._match_terms(field, [t.term for t in toks])
         if resolved is None:
             return self._zeros()
@@ -835,6 +840,10 @@ class SegmentResolver:
         col = self.seg.text.get(field)
         if col is None:
             return self._zeros()
+        if not col.column.has_positions:
+            raise QueryParsingError(
+                f"field [{field}] was not indexed with positions — "
+                f"span queries need index_options [positions]")
         terms = [c.value for c in query.clauses]
         resolved = self._match_terms(field, terms)
         if resolved is None:

@@ -697,8 +697,16 @@ class ShardSearcher:
             elif req.stored_fields:
                 fields = {}
                 for f in req.stored_fields:
-                    if f in src:
-                        v = src[f]
+                    v = src.get(f)
+                    if v is None and "." in f:   # dotted path into objects
+                        node = src
+                        for part in f.split("."):
+                            node = node.get(part) \
+                                if isinstance(node, dict) else None
+                            if node is None:
+                                break
+                        v = node
+                    if v is not None and not isinstance(v, dict):
                         fields[f] = v if isinstance(v, list) else [v]
                 if fields:
                     hit["fields"] = fields
@@ -736,6 +744,10 @@ class ShardSearcher:
 
 
 def _filter_source(src: dict, spec) -> dict | None:
+    """_source filtering with DOTTED-PATH globs (ref:
+    FetchSourceContext/XContentMapValues.filter): an include pattern
+    matching an object path keeps the whole subtree; patterns reach into
+    nested objects ("obj.inner.field", "obj.*")."""
     if spec is True:
         return src
     if spec is False:
@@ -751,14 +763,65 @@ def _filter_source(src: dict, spec) -> dict | None:
             includes = [includes]
         if isinstance(excludes, str):
             excludes = [excludes]
-    out = {}
-    for k, v in src.items():
-        if includes and not any(fnmatch.fnmatch(k, p) for p in includes):
-            continue
-        if excludes and any(fnmatch.fnmatch(k, p) for p in excludes):
-            continue
-        out[k] = v
-    return out
+    if not includes and not excludes:
+        return src
+
+    def prefixes(path: str) -> list[str]:
+        parts = path.split(".")
+        return [".".join(parts[:i + 1]) for i in range(len(parts))]
+
+    def included(path: str) -> bool:
+        if not includes:
+            return True
+        return any(fnmatch.fnmatch(p, pat)
+                   for pat in includes for p in prefixes(path))
+
+    def deeper_include(path: str) -> bool:
+        """An include pattern may target something BELOW this object."""
+        return any(pat.startswith(path + ".") or
+                   fnmatch.fnmatch(path, ".".join(
+                       pat.split(".")[:len(path.split("."))]))
+                   for pat in includes)
+
+    def excluded(path: str) -> bool:
+        return any(fnmatch.fnmatch(p, pat)
+                   for pat in excludes for p in prefixes(path))
+
+    def filter_value(v, path: str):
+        """→ (keep, filtered value) for one field value at `path` —
+        arrays of objects filter element-wise (XContentMapValues reaches
+        inside arrays; element indices don't count as path segments)."""
+        if isinstance(v, dict):
+            if included(path):
+                return True, (walk(v, path) if excludes else v)
+            if includes and deeper_include(path):
+                sub = walk(v, path)
+                return bool(sub), sub
+            return False, None
+        if isinstance(v, list) and any(isinstance(el, dict) for el in v):
+            out = []
+            for el in v:
+                if isinstance(el, dict):
+                    keep, sub = filter_value(el, path)
+                    if keep:
+                        out.append(sub)
+                elif included(path):
+                    out.append(el)
+            return bool(out), out
+        return included(path), v
+
+    def walk(obj: dict, prefix: str) -> dict:
+        out = {}
+        for k, v in obj.items():
+            path = f"{prefix}.{k}" if prefix else k
+            if excluded(path):
+                continue
+            keep, sub = filter_value(v, path)
+            if keep:
+                out[k] = sub
+        return out
+
+    return walk(src, "")
 
 
 def _sort_value_out(v):

@@ -338,6 +338,16 @@ def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query
             tie_breaker=float(qbody.get("tie_breaker", 0.0)),
             boost=float(qbody.get("boost", 1.0)))
 
+    if qtype in ("term", "terms") and isinstance(qbody, dict) \
+            and len(qbody) == 1 and next(iter(qbody)) in ("_id", "_uid"):
+        # the _id/_uid metadata field resolves through the ids query
+        # (ref: core/index/mapper/internal/IdFieldMapper termQuery)
+        _f, spec = next(iter(qbody.items()))
+        vals = spec.get("value", spec.get("values")) \
+            if isinstance(spec, dict) else spec
+        vals = vals if isinstance(vals, list) else [vals]
+        return IdsQuery(values=[str(v) for v in vals])
+
     if qtype == "term":
         fname, spec = _field_body(qbody, "term")
         if isinstance(spec, dict):
@@ -479,6 +489,16 @@ def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query
                              slop=int(qbody.get("slop", 0)),
                              in_order=bool(qbody.get("in_order", True)),
                              boost=float(qbody.get("boost", 1.0)))
+
+    if qtype == "template":
+        # template QUERY (ref: core/index/query/TemplateQueryParser.java):
+        # render the mustache body to a query dict, then parse it
+        from elasticsearch_tpu.search.templates import render_search_template
+        spec = dict(qbody)
+        if "query" in spec and "inline" not in spec and "source" not in spec:
+            spec["template"] = spec.pop("query")
+        rendered = render_search_template(spec, lambda _i: None)
+        return parse_query(rendered)
 
     if qtype == "nested":
         if "path" not in qbody or "query" not in qbody:

@@ -46,6 +46,14 @@ class SnapshotsService:
         return repository_for(name, spec)
 
     def put_repository(self, name: str, body: dict) -> None:
+        # relative fs locations resolve under the node's data path (the
+        # reference requires them inside path.repo; resolving against the
+        # process CWD would litter it with repository directories)
+        settings = dict(body.get("settings") or {})
+        loc = settings.get("location")
+        if loc is not None and not str(loc).startswith("/"):
+            settings["location"] = str(self.node.data_path / "repos" / loc)
+            body = {**body, "settings": settings}
         repository_for(name, body).verify()      # fail fast on bad config
 
         def local():

@@ -1,0 +1,439 @@
+"""Runner for the reference's REST YAML conformance suites.
+
+The reference ships an implementation-independent acceptance suite
+(rest-api-spec/src/main/resources/rest-api-spec/test, 84 dirs) executed by
+ESRestTestCase (test/test/rest/): every test is a sequence of `do` steps
+(API calls, resolved through the machine-readable api specs in
+rest-api-spec/api/*.json) and assertions (match/length/is_true/...). This
+runner executes those YAML files against OUR RestController in-process —
+the cheapest possible cross-implementation contract check.
+
+Deliberate compatibility shims, applied on the COMPARISON side only (the
+server keeps its modern response shapes):
+* ``hits.total`` — this framework answers the modern ``{"value", "relation"}``
+  object; 2.x suites expect the bare count, so a {"value": N} object
+  compares equal to N.
+* stringified YAML bodies (``body: "{ _source: true }"``) parse as YAML,
+  exactly like the reference runner.
+
+Tests demanding unsupported harness features (`skip: features:`) or
+versions outside ours are reported as skipped, like ESRestTestCase.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import yaml
+
+
+@dataclass
+class StepFailure(Exception):
+    step: str
+    reason: str
+
+    def __str__(self):
+        return f"[{self.step}] {self.reason}"
+
+
+@dataclass
+class TestResult:
+    suite: str
+    name: str
+    status: str                 # passed | failed | skipped
+    reason: str = ""
+
+
+@dataclass
+class ApiSpec:
+    name: str
+    methods: list
+    paths: list
+    parts: set
+    params: set
+    body: bool
+
+
+# our fictional 2.x-line version for `skip: version:` ranges
+RUNNER_VERSION = (2, 1, 0)
+SUPPORTED_FEATURES: set[str] = set()
+
+
+def _parse_version(s: str):
+    nums = [int(x) for x in re.findall(r"\d+", s)[:3]]
+    while len(nums) < 3:
+        nums.append(0)
+    return tuple(nums)
+
+
+def _version_skipped(spec: str) -> bool:
+    spec = str(spec).strip()
+    if spec == "all":
+        return True
+    m = re.match(r"^(.*?)\s*-\s*(.*)$", spec)
+    if not m:
+        return False
+    lo = _parse_version(m.group(1)) if m.group(1).strip() else (0, 0, 0)
+    hi = _parse_version(m.group(2)) if m.group(2).strip() else (99, 0, 0)
+    return lo <= RUNNER_VERSION <= hi
+
+
+class YamlRestRunner:
+    def __init__(self, spec_dir: Path):
+        """spec_dir: .../rest-api-spec (containing api/ and test/)."""
+        self.spec_dir = Path(spec_dir)
+        self.apis: dict[str, ApiSpec] = {}
+        for f in (self.spec_dir / "api").glob("*.json"):
+            doc = json.loads(f.read_text())
+            ((name, spec),) = doc.items()
+            url = spec.get("url", {})
+            self.apis[name] = ApiSpec(
+                name=name,
+                methods=spec.get("methods", ["GET"]),
+                paths=url.get("paths", [url.get("path", "/")]),
+                parts=set(url.get("parts", {})),
+                params=set(url.get("params", {})),
+                body=spec.get("body") is not None)
+
+    # ------------------------------------------------------------------ node
+
+    def _fresh_controller(self, node):
+        from elasticsearch_tpu.rest.controller import RestController
+        from elasticsearch_tpu.rest.handlers import register_all
+        controller = RestController()
+        register_all(controller, node)
+        return controller
+
+    def _wipe(self, node) -> None:
+        """Between-tests cleanup (ESRestTestCase wipes indices/templates)."""
+        for name in list(node.indices_service.indices):
+            try:
+                node.indices_service.delete_index(name)
+            except Exception:               # noqa: BLE001 — best effort
+                pass
+        st = node.cluster_service.state()
+        for tpl in list(getattr(st, "templates", {}) or {}):
+            try:
+                node.indices_service.delete_template(tpl)
+            except Exception:               # noqa: BLE001 — best effort
+                pass
+
+    # ----------------------------------------------------------------- suite
+
+    def run_suite(self, suite_path: Path, node) -> list[TestResult]:
+        rel = str(suite_path.relative_to(self.spec_dir / "test"))
+        try:
+            docs = list(yaml.safe_load_all(suite_path.read_text()))
+        except yaml.YAMLError as e:
+            return [TestResult(rel, "<parse>", "failed", f"yaml: {e}")]
+        setup_steps: list = []
+        tests: list[tuple[str, list]] = []
+        for doc in docs:
+            if not isinstance(doc, dict):
+                continue
+            for name, steps in doc.items():
+                if name == "setup":
+                    setup_steps = steps or []
+                else:
+                    tests.append((name, steps or []))
+        results = []
+        controller = self._fresh_controller(node)
+        for name, steps in tests:
+            self._wipe(node)
+            try:
+                ctx = _Ctx(controller=controller, runner=self)
+                for si, step in enumerate(setup_steps):
+                    try:
+                        ctx.run_step(step)
+                    except StepFailure as e:
+                        raise StepFailure(f"setup[{si}]:{e.step}", e.reason)
+                for si, step in enumerate(steps):
+                    try:
+                        ctx.run_step(step)
+                    except StepFailure as e:
+                        raise StepFailure(f"step[{si}]:{e.step}", e.reason)
+                results.append(TestResult(rel, name, "passed"))
+            except _Skipped as e:
+                results.append(TestResult(rel, name, "skipped", str(e)))
+            except StepFailure as e:
+                results.append(TestResult(rel, name, "failed", str(e)))
+            except Exception as e:          # noqa: BLE001 — suite robustness
+                results.append(TestResult(rel, name, "failed",
+                                          f"{type(e).__name__}: {e}"))
+        return results
+
+    # ------------------------------------------------------------------- api
+
+    def call(self, controller, api: str, args: dict):
+        args = dict(args or {})
+        if api == "create" and "create" not in self.apis:
+            # the 2.x spec has no create.json; the reference runner maps it
+            # onto index with op_type=create
+            api = "index"
+            args["op_type"] = "create"
+        spec = self.apis.get(api)
+        if spec is None:
+            raise StepFailure("do", f"unknown api [{api}]")
+        body = args.pop("body", None)
+        parts = {k: v for k, v in args.items() if k in spec.parts}
+        query = {k: v for k, v in args.items() if k not in spec.parts}
+        # choose the most specific path whose parts are all provided
+        best = None
+        for path in spec.paths:
+            needed = set(re.findall(r"{(\w+)}", path))
+            if needed <= set(parts):
+                if best is None or len(needed) > len(best[1]):
+                    best = (path, needed)
+        if best is None:
+            raise StepFailure("do", f"[{api}] missing url parts for "
+                                    f"{spec.paths}: have {sorted(parts)}")
+        path, needed = best
+        for k in needed:
+            v = parts[k]
+            if isinstance(v, list):
+                v = ",".join(str(x) for x in v)
+            path = path.replace(f"{{{k}}}", str(v))
+        if query:
+            from urllib.parse import urlencode
+            path += "?" + urlencode({k: _qval(v) for k, v in query.items()})
+        if body is None:
+            raw = b""
+        elif isinstance(body, (dict,)):
+            raw = json.dumps(body).encode()
+        elif isinstance(body, list):        # bulk-style NDJSON
+            raw = ("\n".join(
+                x if isinstance(x, str) else json.dumps(x)
+                for x in body) + "\n").encode()
+        else:                               # stringified YAML body
+            text = str(body)
+            try:
+                parsed = yaml.safe_load(text)
+            except yaml.YAMLError:
+                # a raw NDJSON blob (multiple JSON docs) — pass through
+                parsed = None
+            if parsed is None:
+                raw = text.encode() if text.endswith("\n") \
+                    else (text + "\n").encode()
+            elif isinstance(parsed, list):
+                raw = ("\n".join(json.dumps(x) for x in parsed)
+                       + "\n").encode()
+            else:
+                raw = json.dumps(parsed).encode()
+        method = "POST" if (raw and "POST" in spec.methods) \
+            else spec.methods[0]
+        status, resp = controller.dispatch(method, path, raw)
+        if spec.methods == ["HEAD"]:
+            # exists-style APIs answer a boolean (the reference runner
+            # translates HEAD 200/404 to true/false, never an error)
+            return 200, status == 200
+        return status, resp
+
+
+def _qval(v):
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, list):
+        return ",".join(str(x) for x in v)
+    return v
+
+
+class _Skipped(Exception):
+    pass
+
+
+_CATCH_STATUS = {"missing": (404,), "conflict": (409,),
+                 "bad_request": (400,), "param": (400,),
+                 "forbidden": (403,), "unavailable": (503,)}
+
+
+@dataclass
+class _Ctx:
+    controller: object
+    runner: YamlRestRunner
+    stash: dict = field(default_factory=dict)
+    response: object = None
+
+    # -------------------------------------------------------------- steps
+
+    def run_step(self, step: dict) -> None:
+        ((kind, payload),) = step.items()
+        fn = getattr(self, f"_s_{kind}", None)
+        if fn is None:
+            raise StepFailure(kind, "unsupported step type")
+        fn(payload)
+
+    def _s_skip(self, spec: dict) -> None:
+        feats = spec.get("features") or []
+        if isinstance(feats, str):
+            feats = [feats]
+        missing = [f for f in feats if f not in SUPPORTED_FEATURES]
+        if missing:
+            raise _Skipped(f"features {missing}")
+        if "version" in spec and _version_skipped(spec["version"]):
+            raise _Skipped(f"version {spec['version']}: "
+                           f"{spec.get('reason', '')}")
+
+    def _s_do(self, spec: dict) -> None:
+        spec = dict(spec)
+        catch = spec.pop("catch", None)
+        spec.pop("warnings", None)
+        spec.pop("headers", None)
+        ((api, args),) = spec.items()
+        args = dict(self._sub(args) or {})
+        ignore = args.pop("ignore", None)
+        ignored = set()
+        if ignore is not None:
+            ignored = {int(x) for x in
+                       (ignore if isinstance(ignore, list) else [ignore])}
+        status, resp = self.runner.call(self.controller, api, args)
+        self.response = resp
+        if catch is not None:
+            if status < 400:
+                raise StepFailure("do", f"[{api}] expected error [{catch}], "
+                                        f"got {status}")
+            expected = _CATCH_STATUS.get(catch)
+            if expected is not None and status not in expected:
+                raise StepFailure("do", f"[{api}] expected {catch} "
+                                        f"{expected}, got {status}: {resp}")
+            return
+        if status >= 400 and status not in ignored:
+            raise StepFailure("do", f"[{api}] failed {status}: "
+                                    f"{json.dumps(resp)[:300]}")
+
+    def _s_set(self, spec: dict) -> None:
+        for path, var in spec.items():
+            self.stash[var] = self._lookup(path)
+
+    def _s_match(self, spec: dict) -> None:
+        for path, want in spec.items():
+            got = self._lookup(path)
+            want = self._sub(want)
+            if isinstance(want, str) and len(want) > 1 and \
+                    want.startswith("/") and want.rstrip().endswith("/"):
+                pattern = want.strip().strip("/")
+                if re.search(pattern, str(got), re.VERBOSE) is None:
+                    raise StepFailure(
+                        "match", f"{path}: /{pattern}/ !~ {got!r}")
+                continue
+            if not _eq(got, want):
+                raise StepFailure("match", f"{path}: got {got!r}, "
+                                           f"want {want!r}")
+
+    def _s_length(self, spec: dict) -> None:
+        for path, want in spec.items():
+            got = self._lookup(path)
+            n = len(got) if got is not None else 0
+            if n != int(self._sub(want)):
+                raise StepFailure("length", f"{path}: len {n} != {want}")
+
+    def _s_is_true(self, path) -> None:
+        got = self._lookup(path)
+        if got in (None, False, "", 0, [], {}):
+            raise StepFailure("is_true", f"{path}: {got!r}")
+
+    def _s_is_false(self, path) -> None:
+        got = self._lookup(path)
+        if got not in (None, False, "", 0, [], {}):
+            raise StepFailure("is_false", f"{path}: {got!r}")
+
+    def _cmp(self, spec, op, name):
+        for path, want in spec.items():
+            got = _total_value(self._lookup(path))
+            want = _total_value(self._sub(want))
+            if not op(float(got), float(want)):
+                raise StepFailure(name, f"{path}: {got!r} vs {want!r}")
+
+    def _s_gt(self, spec):
+        self._cmp(spec, lambda a, b: a > b, "gt")
+
+    def _s_gte(self, spec):
+        self._cmp(spec, lambda a, b: a >= b, "gte")
+
+    def _s_lt(self, spec):
+        self._cmp(spec, lambda a, b: a < b, "lt")
+
+    def _s_lte(self, spec):
+        self._cmp(spec, lambda a, b: a <= b, "lte")
+
+    # -------------------------------------------------------------- lookup
+
+    def _lookup(self, path):
+        if path in ("$body", ""):
+            return self.response
+        node = self.response
+        for part in _split_path(str(path)):
+            part = self.stash.get(part[1:], part) if part.startswith("$") \
+                else part
+            if isinstance(node, dict):
+                if part in node:
+                    node = node[part]
+                    continue
+                return None
+            if isinstance(node, list):
+                try:
+                    node = node[int(part)]
+                    continue
+                except (ValueError, IndexError):
+                    return None
+            return None
+        return _total_value(node)
+
+    def _sub(self, obj):
+        """$stash substitution through params/bodies/expectations."""
+        if isinstance(obj, str):
+            if obj.startswith("$"):
+                return self.stash.get(obj[1:], obj)
+            return obj
+        if isinstance(obj, dict):
+            return {self._sub(k) if isinstance(k, str) else k: self._sub(v)
+                    for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [self._sub(v) for v in obj]
+        return obj
+
+
+def _split_path(path: str) -> list[str]:
+    out, cur, esc = [], "", False
+    for ch in path:
+        if esc:
+            cur += ch
+            esc = False
+        elif ch == "\\":
+            esc = True
+        elif ch == ".":
+            out.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    out.append(cur)
+    return [p for p in out if p != ""]
+
+
+def _total_value(v):
+    """Modern {"value": N, "relation": ...} totals compare as bare counts
+    (the 2.x suites predate the object form)."""
+    if isinstance(v, dict) and "value" in v and \
+            set(v) <= {"value", "relation"}:
+        return v["value"]
+    return v
+
+
+def _eq(got, want) -> bool:
+    got, want = _total_value(got), _total_value(want)
+    if isinstance(want, float) or isinstance(got, float):
+        try:
+            return abs(float(got) - float(want)) <= 1e-6 * max(
+                1.0, abs(float(want)))
+        except (TypeError, ValueError):
+            return False
+    if isinstance(want, bool) or isinstance(got, bool):
+        return bool(got) == bool(want)
+    if isinstance(want, int) and isinstance(got, int):
+        return got == want
+    if isinstance(want, dict) and isinstance(got, dict):
+        return all(k in got and _eq(got[k], v) for k, v in want.items()) \
+            and set(got) == set(want)
+    return got == want

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Run the reference's REST YAML suites against this framework and write
+the CONFORMANCE.md scoreboard (runner: elasticsearch_tpu/testing_yaml.py).
+
+Usage: JAX_PLATFORMS=cpu python scripts/yaml_conformance.py [spec_dir]
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+import tempfile
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from elasticsearch_tpu.node import Node                    # noqa: E402
+from elasticsearch_tpu.testing_yaml import YamlRestRunner  # noqa: E402
+
+DEFAULT_SPEC = ("/root/reference/rest-api-spec/src/main/resources/"
+                "rest-api-spec")
+
+# The tracked subset (grown each round; the pytest floor guards it).
+CHOSEN = ["search", "index", "indices.create", "get", "get_source", "count",
+          "create", "delete", "exists", "bulk", "update", "mget", "explain",
+          "indices.exists", "indices.exists_type",
+          "indices.put_mapping", "indices.get_mapping", "indices.refresh",
+          "cluster.health", "info", "ping", "mlt", "indices.optimize",
+          "suggest", "termvectors"]
+
+
+def main() -> int:
+    spec = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else DEFAULT_SPEC)
+    runner = YamlRestRunner(spec)
+    node = Node({}, data_path=pathlib.Path(tempfile.mkdtemp())).start()
+    rows = []
+    tp = tf = ts = 0
+    try:
+        for d in sorted(p.name for p in (spec / "test").iterdir()
+                        if p.is_dir()):
+            c = {"passed": 0, "failed": 0, "skipped": 0}
+            for f in sorted((spec / "test" / d).glob("*.yaml")):
+                for r in runner.run_suite(f, node):
+                    c[r.status] += 1
+            rows.append((d, c))
+            tp += c["passed"]
+            tf += c["failed"]
+            ts += c["skipped"]
+    finally:
+        node.close()
+
+    chosen_p = sum(c["passed"] for d, c in rows if d in CHOSEN)
+    chosen_f = sum(c["failed"] for d, c in rows if d in CHOSEN)
+    lines = [
+        "# REST YAML conformance scoreboard",
+        "",
+        "The reference's implementation-independent acceptance suite "
+        "(rest-api-spec/.../test, run in-process by "
+        "`elasticsearch_tpu/testing_yaml.py`; regenerate with "
+        "`python scripts/yaml_conformance.py`).",
+        "",
+        f"**Tracked subset** ({len(CHOSEN)} dirs): "
+        f"{chosen_p}/{chosen_p + chosen_f} passed "
+        f"(**{chosen_p / max(chosen_p + chosen_f, 1) * 100:.0f}%**) — "
+        "floor guarded by tests/test_yaml_conformance.py.",
+        f"**All suites**: {tp}/{tp + tf} passed "
+        f"({tp / max(tp + tf, 1) * 100:.0f}%), {ts} skipped.",
+        "",
+        "| suite dir | passed | failed | skipped | tracked |",
+        "|---|---|---|---|---|",
+    ]
+    for d, c in rows:
+        lines.append(f"| {d} | {c['passed']} | {c['failed']} | "
+                     f"{c['skipped']} | {'yes' if d in CHOSEN else ''} |")
+    out = pathlib.Path(__file__).resolve().parent.parent / "CONFORMANCE.md"
+    out.write_text("\n".join(lines) + "\n")
+    print(f"wrote {out}: tracked "
+          f"{chosen_p}/{chosen_p + chosen_f}, all {tp}/{tp + tf}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,45 @@
+"""REST YAML conformance floor — runs a core slice of the reference's
+acceptance suites (rest-api-spec/.../test) through testing_yaml.YamlRestRunner
+and asserts the pass rate doesn't regress. The full scoreboard lives in
+CONFORMANCE.md (scripts/yaml_conformance.py)."""
+
+import pathlib
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.testing_yaml import YamlRestRunner
+
+SPEC = pathlib.Path("/root/reference/rest-api-spec/src/main/resources/"
+                    "rest-api-spec")
+
+# fast core dirs (~1 min); the broader tracked subset runs via the script
+CORE_DIRS = ["search", "index", "get", "create", "delete", "exists",
+             "count", "bulk", "mget", "indices.exists_type",
+             "indices.put_mapping", "info", "ping"]
+
+FLOOR = 0.55
+
+
+@pytest.mark.skipif(not SPEC.exists(), reason="reference spec not present")
+def test_core_yaml_suites_pass_floor(tmp_path):
+    runner = YamlRestRunner(SPEC)
+    node = Node({}, data_path=tmp_path / "n").start()
+    passed = failed = 0
+    failures = []
+    try:
+        for d in CORE_DIRS:
+            for f in sorted((SPEC / "test" / d).glob("*.yaml")):
+                for r in runner.run_suite(f, node):
+                    if r.status == "passed":
+                        passed += 1
+                    elif r.status == "failed":
+                        failed += 1
+                        failures.append(f"{r.suite}::{r.name}")
+    finally:
+        node.close()
+    rate = passed / max(passed + failed, 1)
+    assert rate >= FLOOR, (
+        f"YAML conformance regressed: {passed}/{passed + failed} "
+        f"({rate:.0%}) < floor {FLOOR:.0%}; failures: {failures[:20]}")
