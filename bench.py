@@ -372,9 +372,12 @@ def main() -> int:
         w = len(str(vocab - 1))
         term_names = [f"t{i:0{w}d}" for i in range(vocab)]
         t0 = time.perf_counter()
+        vec_dims = int(os.environ.get("BENCH_VECTOR_DIMS", 768))
         ms_map = MapperService()
-        ms_map.merge("_doc", {"properties": {"body": {
-            "type": "text", "analyzer": "whitespace"}}})
+        ms_map.merge("_doc", {"properties": {
+            "body": {"type": "text", "analyzer": "whitespace"},
+            "rank": {"type": "double"},
+            "vec": {"type": "dense_vector", "dims": max(vec_dims, 1)}}})
         eng = Engine(Path(tempfile.mkdtemp(prefix="bench_engine_")), ms_map)
         # install as power-of-2-bucketed segments of <=2^20 rows — the
         # engine's own segment discipline (doc_count_bucket): per-segment
@@ -387,7 +390,14 @@ def main() -> int:
         with_positions = os.environ.get(
             "BENCH_POSITIONS",
             "1" if n_docs <= 2_000_000 else "0") == "1"
-        from elasticsearch_tpu.index.segment import doc_count_bucket
+        from elasticsearch_tpu.index.segment import (
+            NumericFieldColumn, VectorFieldColumn, doc_count_bucket)
+        # BASELINE configs 3/4 need doc-values + vector columns: a numeric
+        # "rank" everywhere; unit vectors only while they fit HBM
+        with_vectors = os.environ.get(
+            "BENCH_VECTORS",
+            "1" if n_docs <= 1_200_000 else "0") == "1" and vec_dims > 0
+        rank_all = rng.random(n_docs).astype(np.float64) * 100.0
         n_segs = -(-n_docs // seg_rows)
         for lo in range(0, n_docs, seg_rows):
             hi = min(lo + seg_rows, n_docs)
@@ -408,6 +418,17 @@ def main() -> int:
                 doc_len=padrows(lens, 0), df=seg_df, num_docs=rows,
                 ids=[str(lo + i) for i in range(rows)] +
                     [""] * (np_rows - rows))
+            exists = np.zeros(np_rows, bool)
+            exists[:rows] = True
+            seg.numeric_fields["rank"] = NumericFieldColumn(
+                values=padrows(rank_all, 0.0), exists=exists.copy())
+            if with_vectors:
+                vecs = np.zeros((np_rows, vec_dims), np.float32)
+                raw = rng.standard_normal((rows, vec_dims)).astype(np.float32)
+                vecs[:rows] = raw / np.linalg.norm(raw, axis=1,
+                                                   keepdims=True)
+                seg.vector_fields["vec"] = VectorFieldColumn(
+                    vecs=vecs, exists=exists.copy(), dims=vec_dims)
             eng.install_segment(seg, track_versions=False)
         searcher = ShardSearcher(0, device_reader_for(eng, device=dev),
                                  ms_map)
@@ -464,6 +485,69 @@ def main() -> int:
             f"{engine_qps:.1f} QPS ({dt / todo * 1000:.1f} ms/batch, "
             f"compile {compile_s:.1f}s)")
 
+        # ---- BASELINE configs 2-4 on the engine path --------------------
+        # (2: bool multi-term + phrase; 3: function_score
+        # field_value_factor; 4: brute-force cosine kNN). Config 1 is the
+        # headline above; config 5's scatter-gather+merge is exercised by
+        # the per-segment fan-out + device merge here and by the
+        # multi-shard tests/mesh dryrun (no standalone number yet).
+        configs = {}
+        if os.environ.get("BENCH_CONFIGS", "1") != "0":
+            def measure(name, bodies):
+                breqs = [parse_search_request(b) for b in bodies]
+                cbs = [breqs[i:i + batch]
+                       for i in range(0, len(breqs), batch)] or [[]]
+                r0 = searcher.query_phase_batch(cbs[0])
+                assert r0 is not None, f"config {name} fell back"
+                t0 = time.perf_counter()
+                searcher.query_phase_batch(cbs[0])
+                per = time.perf_counter() - t0
+                todo = len(cbs) if per < 2.0 else 1
+                t0 = time.perf_counter()
+                with ThreadPoolExecutor(n_threads) as pool:
+                    list(pool.map(searcher.query_phase_batch, cbs[:todo]))
+                dt = time.perf_counter() - t0
+                done = sum(len(c) for c in cbs[:todo])
+                configs[name] = {"qps": round(done / dt, 2),
+                                 "ms_per_batch": round(dt / todo * 1e3, 2)}
+                log(f"[bench] config {name}: {configs[name]['qps']} QPS")
+
+            ncq = min(n_queries, batch * 4)
+            # config 2: 2-term must + 2-term phrase (real adjacent pairs)
+            if with_positions:
+                bodies = []
+                for qi in range(ncq):
+                    t1, t2 = qtids_all[qi][0], qtids_all[qi][1]
+                    d = int(rng.integers(0, n_docs))
+                    p = int(rng.integers(0, max(int(lens[d]) - 1, 1)))
+                    a, b_ = int(toks[d, p]), int(toks[d, p + 1])
+                    if a < 0 or b_ < 0:
+                        a, b_ = int(toks[d, 0]), int(toks[d, 1])
+                    bodies.append({"query": {"bool": {
+                        "must": [{"match": {
+                            "body": f"{term_names[t1]} {term_names[t2]}"}}],
+                        "should": [{"match_phrase": {
+                            "body": f"{term_names[a]} {term_names[b_]}"}}],
+                    }}, "size": k})
+                measure("bool_phrase", bodies)
+            # config 3: function_score field_value_factor over the match
+            bodies = [{"query": {"function_score": {
+                "query": {"match": {"body": texts[qi]}},
+                "functions": [{"field_value_factor": {
+                    "field": "rank", "modifier": "log1p", "factor": 1.0}}],
+                "boost_mode": "multiply"}}, "size": k}
+                for qi in range(ncq)]
+            measure("function_score", bodies)
+            # config 4: brute-force cosine kNN over unit vectors
+            if with_vectors:
+                qvecs = rng.standard_normal(
+                    (ncq, vec_dims)).astype(np.float32)
+                qvecs /= np.linalg.norm(qvecs, axis=1, keepdims=True)
+                bodies = [{"query": {"knn": {
+                    "field": "vec", "query_vector": qvecs[qi].tolist()}},
+                    "size": min(k, 100)} for qi in range(ncq)]
+                measure("dense_cosine", bodies)
+
         # request-at-a-time path (the reference's dispatch model)
         nq_serial = min(batch, 32)
         searcher.query_phase(reqs[0])
@@ -476,7 +560,8 @@ def main() -> int:
                   "serial_qps": round(serial_qps, 2),
                   "ms_per_batch": round(dt / todo * 1000, 2),
                   "threads": n_threads,
-                  "compile_s": round(compile_s, 1)}
+                  "compile_s": round(compile_s, 1),
+                  "configs": configs}
         eng.close()
 
     recall_ok = bool(kernel_ok and engine_ok)

@@ -142,6 +142,9 @@ _DEFAULTS = {
     "get": (lambda c: c, 1000),
     "management": (lambda c: 5, -1),
     "refresh": (lambda c: max(1, c // 10), -1),
+    # background segment merges (ElasticsearchConcurrentMergeScheduler):
+    # unbounded queue — dropping a merge just re-queues at next refresh
+    "merge": (lambda c: max(1, c // 2), -1),
     "flush": (lambda c: max(1, c // 2), -1),
     "snapshot": (lambda c: max(1, c // 2), -1),
     "warmer": (lambda c: max(1, c // 2), -1),

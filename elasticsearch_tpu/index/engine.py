@@ -142,12 +142,25 @@ class Engine:
         # and the node's breaker service for memory accounting
         self.indexing_slow_log = None
         self.breaker_service = None
+        # background merging (ElasticsearchConcurrentMergeScheduler +
+        # MergePolicyConfig): refresh() checks the policy and submits a
+        # merge to this executor (callable(fn); the node wires its "merge"
+        # thread pool here — None runs the merge inline, which unit tests
+        # and standalone engines want for determinism)
+        self.merge_executor = None
+        self._merge_running = False
+        self._merge_failures = 0
+        self._booted = False
 
         durability = settings.get("index.translog.durability", DURABILITY_REQUEST)
         self.translog = Translog(self.path / "translog", durability=durability)
 
         self._segments: list[Segment] = []
         self._live_masks: list[np.ndarray] = []
+        # segments installed with track_versions=False: the background
+        # merge's per-row version-map re-check would silently drop their
+        # (untracked) docs, so they never background-merge
+        self._untracked_seg_ids: set[int] = set()
         self._buffer = SegmentBuilder(seg_id=0)
         self._buffer_docs: dict[str, int] = {}      # _id → buffer local doc
         self._versions: dict[str, VersionEntry] = {}
@@ -164,6 +177,10 @@ class Engine:
         # the first searcher.
         self._reader = SearcherView([], [], 0)
         self.refresh()
+        # merges stay off during construction: merge_executor is wired by
+        # IndexService only after the engine exists, and recovery must not
+        # block on an inline merge of a large commit
+        self._booted = True
 
     # ------------------------------------------------------------------ CRUD
 
@@ -388,7 +405,9 @@ class Engine:
                         mask[local] = False
                 self._pending_seg_deletes = {}
             self.stats.refresh_total += 1
-            return self._swap_reader()
+            out = self._swap_reader()
+        self._maybe_merge()
+        return out
 
     def _swap_reader(self) -> SearcherView:
         """Bump the generation and publish a fresh point-in-time view
@@ -422,6 +441,8 @@ class Engine:
                 for local in range(segment.num_docs):
                     self._versions[segment.ids[local]] = VersionEntry(
                         1, False, segment.seg_id, local)
+            else:
+                self._untracked_seg_ids.add(segment.seg_id)
             self._segments.append(segment)
             self._live_masks.append(mask)
             self.stats.index_total += segment.num_docs
@@ -462,6 +483,140 @@ class Engine:
             self.translog.roll(committed=True)
             self.stats.flush_total += 1
 
+    # ------------------------------------------------- background merging
+
+    def _merge_candidates(self) -> list[tuple[Segment, "np.ndarray"]]:
+        """Merge policy (MergePolicyConfig, tiered-lite): once the segment
+        count exceeds segments_per_tier, merge up to max_merge_at_once of
+        the SMALLEST re-analyzable segments into one. Two tiered-style
+        guards keep total merge work O(n log n) instead of O(n²): segments
+        above max_merged_segment_docs never merge again, and a run of
+        small segments won't drag in a segment >4× their combined size
+        (so the accumulated big segment isn't rewritten every cycle).
+        Callers hold _lock."""
+        per_tier = int(self.settings.get(
+            "index.merge.policy.segments_per_tier", 10))
+        max_at_once = int(self.settings.get(
+            "index.merge.policy.max_merge_at_once", 10))
+        max_merged = int(self.settings.get(
+            "index.merge.policy.max_merged_segment_docs", 5_000_000))
+        if len(self._segments) <= per_tier:
+            return []
+        cands = [(s, m) for s, m in zip(self._segments, self._live_masks)
+                 if s.source_complete
+                 and s.seg_id not in self._untracked_seg_ids
+                 and s.num_docs < max_merged]
+        if len(cands) < 2:
+            return []
+        cands.sort(key=lambda sm: sm[0].num_docs)
+        picked: list = []
+        total = 0
+        for s, m in cands:
+            if picked and s.num_docs > 4 * max(total, 64):
+                break                      # size skew: stop before the jump
+            picked.append((s, m))
+            total += s.num_docs
+            if len(picked) == max_at_once:
+                break
+        return picked if len(picked) >= 2 else []
+
+    def _maybe_merge(self) -> None:
+        """Refresh-time merge trigger (the scheduler seam the reference
+        hangs off IndexWriter; ours hangs off refresh because that is when
+        new segments appear)."""
+        with self._lock:
+            if (not self._booted or self._closed or self._commit_pins
+                    or self._merge_running or self._merge_failures >= 3
+                    or not self._merge_candidates()):
+                return
+            self._merge_running = True
+        if self.merge_executor is not None:
+            try:
+                self.merge_executor(self._background_merge)
+            except Exception:                # noqa: BLE001 — pool closed
+                self._merge_running = False
+        else:
+            self._background_merge()
+
+    def _background_merge(self) -> None:
+        """One background merge: snapshot the candidate segments under the
+        lock, re-analyze them into one OUTSIDE the lock (writes continue),
+        then commit the swap — docs deleted or updated during the merge
+        stay dead because the version map is re-checked per row at commit
+        (Lucene carries deletes forward into merged segments the same
+        way). Failures log and count toward a circuit breaker (3 strikes
+        stops retriggering; a successful force_merge resets it) so a
+        persistently unmergeable segment can't wedge refresh or spin the
+        merge pool."""
+        try:
+            with self._lock:
+                if self._closed or self._commit_pins:
+                    return
+                cands = self._merge_candidates()
+                if not cands:
+                    return
+                srcs = [(s, m.copy()) for s, m in cands]
+            builder = merge_segments(
+                0, [s for s, _ in srcs], [m for _, m in srcs],
+                self.mapper_service.document_mapper(),
+                max_tokens=self._buffer.max_tokens)
+            merged = builder.build()
+            # row → source location, in merge_segments' iteration order
+            locs = [(s.seg_id, local) for s, m in srcs
+                    for local in range(s.num_docs) if m[local]]
+            with self._lock:
+                if self._closed or self._commit_pins:
+                    return
+                present = {s.seg_id for s in self._segments}
+                if not all(s.seg_id in present for s, _ in srcs):
+                    return               # raced with a force_merge
+                merged.seg_id = self._next_seg_id
+                self._next_seg_id += 1
+                mask = np.zeros(merged.padded_docs, dtype=bool)
+                for local, (ssid, slocal) in enumerate(locs):
+                    e = self._versions.get(merged.ids[local])
+                    if e is not None and not e.deleted \
+                            and e.seg_id == ssid and e.local_doc == slocal:
+                        mask[local] = True
+                        self._versions[merged.ids[local]] = VersionEntry(
+                            e.version, False, merged.seg_id, local)
+                drop = {s.seg_id for s, _ in srcs}
+                keep = [i for i, s in enumerate(self._segments)
+                        if s.seg_id not in drop]
+                self._segments = [self._segments[i] for i in keep] + [merged]
+                self._live_masks = [self._live_masks[i]
+                                    for i in keep] + [mask]
+                self._pending_seg_deletes = {
+                    k: v for k, v in self._pending_seg_deletes.items()
+                    if k[0] not in drop}
+                self.stats.merge_total += 1
+                self._swap_reader()
+                self._drop_segment_files(drop)
+            self._merge_failures = 0
+        except Exception:                    # noqa: BLE001 — see docstring
+            import logging
+            self._merge_failures += 1
+            logging.getLogger(__name__).exception(
+                "background merge failed (%d/3) on %s",
+                self._merge_failures, self.path)
+        finally:
+            self._merge_running = False
+
+    def _drop_segment_files(self, drop_ids) -> None:
+        """Persist the post-merge commit FIRST (when any dropped segment
+        was committed), then delete the merged-away directories — a crash
+        in between must never lose committed docs. Callers hold _lock."""
+        was_committed = any(
+            (self.path / f"seg_{sid}" / "meta.json").exists()
+            for sid in drop_ids)
+        if was_committed:
+            self.flush()
+        import shutil
+        for sid in drop_ids:
+            seg_dir = self.path / f"seg_{sid}"
+            if seg_dir.exists():
+                shutil.rmtree(seg_dir)
+
     def force_merge(self, max_num_segments: int = 1) -> None:
         """_optimize / force-merge: rewrite segments into one, dropping
         deleted docs (ElasticsearchConcurrentMergeScheduler's job)."""
@@ -496,23 +651,13 @@ class Engine:
                     self._versions[did] = VersionEntry(e.version, False,
                                                        merged.seg_id, local)
             old = [s for s, _ in mergeable]
-            was_committed = any((self.path / f"seg_{s.seg_id}" / "meta.json").exists()
-                                for s in old)
             self._segments = [s for s, _ in kept] + [merged]
             self._live_masks = [m for _, m in kept] + [mask]
             self._next_seg_id += 1
             self.stats.merge_total += 1
+            self._merge_failures = 0
             self._swap_reader()
-            if was_committed:
-                # Persist the merged segment and a new commit point FIRST;
-                # only then is it safe to delete the merged-away segment
-                # files (otherwise a crash here loses committed docs).
-                self.flush()
-            for seg in old:  # remove persisted files of merged-away segments
-                seg_dir = self.path / f"seg_{seg.seg_id}"
-                if seg_dir.exists():
-                    import shutil
-                    shutil.rmtree(seg_dir)   # incl. nested child subdirs
+            self._drop_segment_files([seg.seg_id for seg in old])
 
     # -------------------------------------------------------------- recovery
 

@@ -59,7 +59,8 @@ class IndexService:
 
     def __init__(self, meta: IndexMetadata, path: Path,
                  local_shards: list[int] | None = None,
-                 breaker_service=None):
+                 breaker_service=None, merge_submit=None):
+        self.merge_submit = merge_submit
         self.name = meta.name
         self.meta = meta
         self.path = path
@@ -88,6 +89,7 @@ class IndexService:
                             self.index_settings)
             engine.indexing_slow_log = self.indexing_slow_log
             engine.breaker_service = self.breaker_service
+            engine.merge_executor = self.merge_submit
             self.engines[sid] = engine
         return self.engines[sid]
 
@@ -184,6 +186,9 @@ class IndicesService:
         # hierarchical memory accounting (HierarchyCircuitBreakerService);
         # wired by the Node before any index exists
         self.breaker_service = None
+        # background merges: the Node wires this to its "merge" thread
+        # pool; None runs merges inline at refresh (deterministic tests)
+        self.merge_submit = None
         # Master forwarding seam (TransportMasterNodeAction.java:50): when
         # set by the Node, metadata mutations on a non-master route to the
         # elected master; signature (action, request, local_fn) → result.
@@ -228,7 +233,8 @@ class IndicesService:
                 self.indices[name] = IndexService(
                     meta, self.data_path / "indices" / name,
                     local_shards=[s.shard for s in local],
-                    breaker_service=self.breaker_service)
+                    breaker_service=self.breaker_service,
+                    merge_submit=self.merge_submit)
             svc = self.indices[name]
             if meta.mappings != svc.meta.mappings:
                 for t, m in (meta.mappings or {}).items():

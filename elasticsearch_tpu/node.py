@@ -80,6 +80,8 @@ class Node:
                                               self.node_id,
                                               self.allocation)
         self.indices_service.breaker_service = self.breaker_service
+        self.indices_service.merge_submit = \
+            lambda fn: self.thread_pool.submit("merge", fn)
         self.indices_service.on_shard_started = self._on_shard_started
         self.indices_service.on_shard_failed = self._on_shard_failed
         # ShardStateAction RPC endpoints (master side)

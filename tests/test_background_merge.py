@@ -1,0 +1,131 @@
+"""Background merge scheduler + policy tests (ref:
+ElasticsearchConcurrentMergeScheduler + MergePolicyConfig): segment counts
+stay bounded under sustained indexing, deletes/updates racing a merge stay
+dead, and sourceless bulk segments are never merged away."""
+
+import pathlib
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.mapping import MapperService
+
+
+def _mapper():
+    ms = MapperService()
+    ms.merge("_doc", {"properties": {"t": {"type": "text",
+                                           "analyzer": "whitespace"}}})
+    return ms
+
+
+def _fill(e, lo, hi):
+    for i in range(lo, hi):
+        e.index(str(i), {"t": f"alpha word{i % 5}"})
+    e.refresh()
+
+
+class TestMergePolicy:
+    def test_segment_count_stays_bounded(self, tmp_path):
+        e = Engine(tmp_path / "a", _mapper(),
+                   settings_from({"index.merge.policy.segments_per_tier": 4,
+                                  "index.merge.policy.max_merge_at_once": 4}))
+        for r in range(12):                  # 12 refreshes = 12 segments
+            _fill(e, r * 5, r * 5 + 5)
+        # inline merges (no executor) run at refresh → bounded
+        assert len(e._segments) <= 7, len(e._segments)
+        assert e.stats.merge_total >= 1
+        # every doc still searchable exactly once
+        view = e.acquire_searcher()
+        ids = [seg.ids[i] for seg, m in zip(view.segments, view.live_masks)
+               for i in range(seg.num_docs) if m[i]]
+        assert sorted(ids, key=int) == [str(i) for i in range(60)]
+        e.close()
+
+    def test_no_merge_below_tier(self, tmp_path):
+        e = Engine(tmp_path / "b", _mapper(),
+                   settings_from({"index.merge.policy.segments_per_tier": 10}))
+        for r in range(5):
+            _fill(e, r * 3, r * 3 + 3)
+        assert e.stats.merge_total == 0
+        assert len(e._segments) == 5
+        e.close()
+
+    def test_deletes_survive_merge(self, tmp_path):
+        e = Engine(tmp_path / "c", _mapper(),
+                   settings_from({"index.merge.policy.segments_per_tier": 3,
+                                  "index.merge.policy.max_merge_at_once": 8}))
+        for r in range(6):
+            _fill(e, r * 4, r * 4 + 4)
+        e.delete("1")
+        e.delete("13")
+        e.refresh()                          # merge may run here
+        assert not e.get("1").found
+        assert not e.get("13").found
+        view = e.acquire_searcher()
+        live = {seg.ids[i] for seg, m in zip(view.segments, view.live_masks)
+                for i in range(seg.num_docs) if m[i]}
+        assert "1" not in live and "13" not in live
+        assert len(live) == 22
+        e.close()
+
+    def test_merged_segments_persist(self, tmp_path):
+        e = Engine(tmp_path / "d", _mapper(),
+                   settings_from({"index.merge.policy.segments_per_tier": 3}))
+        for r in range(6):
+            _fill(e, r * 2, r * 2 + 2)
+        e.flush()
+        for r in range(6, 10):               # more segments post-commit
+            _fill(e, r * 2, r * 2 + 2)
+        e.flush()
+        e.close()
+        e2 = Engine(tmp_path / "d", _mapper())
+        for i in range(20):
+            assert e2.get(str(i)).found, i
+        e2.close()
+
+    def test_background_executor_used(self, tmp_path):
+        ran = []
+
+        def executor(fn):
+            ran.append(fn)
+            fn()                             # run inline but observe
+        e = Engine(tmp_path / "e", _mapper(),
+                   settings_from({"index.merge.policy.segments_per_tier": 2}))
+        e.merge_executor = executor
+        for r in range(5):
+            _fill(e, r * 2, r * 2 + 2)
+        assert ran, "merge never submitted to the executor"
+        assert e.stats.merge_total >= 1
+        e.close()
+
+
+def settings_from(d):
+    from elasticsearch_tpu.common.settings import Settings
+    return Settings({str(k): str(v) for k, v in d.items()})
+
+
+def test_node_wires_merge_pool(tmp_path):
+    from elasticsearch_tpu.node import Node
+    n = Node({"index.merge.policy.segments_per_tier": "3"},
+             data_path=tmp_path / "n").start()
+    try:
+        n.indices_service.create_index(
+            "m", {"settings": {"number_of_shards": 1,
+                               "number_of_replicas": 0,
+                               "index.merge.policy.segments_per_tier": 3}})
+        for r in range(8):
+            for i in range(r * 3, r * 3 + 3):
+                n.index_doc("m", str(i), {"t": f"alpha word{i % 3}"})
+            n.broadcast_actions.refresh("m")
+        eng = n.indices_service.indices["m"].engine(0)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(eng._segments) > 5:
+            time.sleep(0.1)
+        assert len(eng._segments) <= 5, len(eng._segments)
+        out = n.search("m", {"query": {"match": {"t": "alpha"}}, "size": 50})
+        assert out["hits"]["total"]["value"] == 24
+        assert "merge" in n.thread_pool.stats()
+    finally:
+        n.close()
