@@ -386,6 +386,133 @@ class AllocationService:
 
     # ---- public entry points ----------------------------------------------
 
+    def execute_commands(self, state: ClusterState,
+                         commands: list[dict]) -> ClusterState:
+        """`POST /_cluster/reroute` commands (ref: core/cluster/routing/
+        allocation/command/ — MoveAllocationCommand, CancelAllocation
+        Command, AllocateAllocationCommand), with this framework's
+        recovery semantics:
+
+        * cancel  — unassign the named copy; the allocator re-places it
+          and peer recovery rebuilds it.
+        * allocate / allocate_replica — pin an UNASSIGNED copy onto a
+          node.
+        * move — unassign on from_node and pin-initialize on to_node.
+          Streaming relocation (RELOCATING handoff) is not implemented,
+          so moving a primary requires an active replica (which promotes;
+          the moved copy then peer-recovers) — a sole primary refuses to
+          move rather than lose data.
+        """
+        from elasticsearch_tpu.common.errors import IllegalArgumentError
+        routing = state.routing_table
+
+        def find(index, shard, node_id=None, want_state=None):
+            for c in routing.shard_copies(index, shard):
+                if node_id is not None and c.node_id != node_id:
+                    continue
+                if want_state is not None and c.state != want_state:
+                    continue
+                return c
+            return None
+
+        for command in commands:
+            if len(command) != 1:
+                raise IllegalArgumentError(
+                    "each reroute command is a single-key object")
+            (kind, args), = command.items()
+            index = args.get("index")
+            shard = int(args.get("shard", 0))
+            if index not in state.indices:
+                raise IllegalArgumentError(f"no such index [{index}]")
+            if kind == "cancel":
+                node_id = args.get("node")
+                if node_id is None:
+                    raise IllegalArgumentError(
+                        "[cancel] requires [node] — which copy to cancel "
+                        "must be explicit")
+                c = find(index, shard, node_id)
+                if c is None or not c.assigned:
+                    raise IllegalArgumentError(
+                        f"[cancel] no copy of [{index}][{shard}] on "
+                        f"[{node_id}]")
+                if c.primary and not args.get("allow_primary", False):
+                    raise IllegalArgumentError(
+                        "[cancel] primary needs allow_primary")
+                routing = routing.replace_shard(
+                    c, c.failed(UnassignedReason.REROUTE_CANCELLED,
+                                "reroute cancel"))
+            elif kind in ("allocate", "allocate_replica"):
+                node_id = args.get("node")
+                if state.node(node_id) is None:
+                    raise IllegalArgumentError(f"no such node [{node_id}]")
+                # prefer an unassigned REPLICA; pinning an unassigned
+                # PRIMARY onto a node means an empty-store recovery (data
+                # loss) and needs the explicit allow_primary escape hatch
+                unassigned = [o for o in routing.shard_copies(index, shard)
+                              if o.state == ShardRoutingState.UNASSIGNED]
+                c = next((o for o in unassigned if not o.primary), None)
+                if c is None:
+                    c = next(iter(unassigned), None)
+                    if c is not None and c.primary and \
+                            not args.get("allow_primary", False):
+                        raise IllegalArgumentError(
+                            f"[{kind}] trying to allocate a PRIMARY of "
+                            f"[{index}][{shard}] — an empty-store primary "
+                            f"loses data; pass allow_primary to force")
+                if c is None:
+                    raise IllegalArgumentError(
+                        f"[{kind}] no unassigned copy of "
+                        f"[{index}][{shard}]")
+                if any(o.node_id == node_id and o.assigned
+                       for o in routing.shard_copies(index, shard)):
+                    raise IllegalArgumentError(
+                        f"[{kind}] a copy of [{index}][{shard}] is "
+                        f"already on [{node_id}]")
+                routing = routing.replace_shard(c, c.initialize(node_id))
+            elif kind == "move":
+                from_node = args.get("from_node")
+                to_node = args.get("to_node")
+                if state.node(to_node) is None:
+                    raise IllegalArgumentError(f"no such node [{to_node}]")
+                c = find(index, shard, from_node,
+                         ShardRoutingState.STARTED)
+                if c is None:
+                    raise IllegalArgumentError(
+                        f"[move] no STARTED copy of [{index}][{shard}] "
+                        f"on [{from_node}]")
+                if any(o.node_id == to_node and o.assigned
+                       for o in routing.shard_copies(index, shard)):
+                    raise IllegalArgumentError(
+                        f"[move] a copy of [{index}][{shard}] is already "
+                        f"on [{to_node}]")
+                if c.primary:
+                    repl = next(
+                        (o for o in routing.shard_copies(index, shard)
+                         if o.active and not o.primary), None)
+                    if repl is None:
+                        raise IllegalArgumentError(
+                            "[move] cannot move a primary with no active "
+                            "replica (streaming relocation not "
+                            "implemented)")
+                    # swap roles first: the replica promotes in place; the
+                    # moving copy becomes a replica that peer-recovers on
+                    # the target from the new primary
+                    from dataclasses import replace as dc_replace
+                    routing = routing.replace_shard(
+                        repl, dc_replace(repl, primary=True))
+                    demoted = dc_replace(c, primary=False)
+                    routing = routing.replace_shard(c, demoted)
+                    c = demoted
+                moved = c.failed(UnassignedReason.REROUTE_CANCELLED,
+                                 f"reroute move to {to_node}")
+                routing = routing.replace_shard(c, moved.initialize(
+                    to_node))
+            else:
+                raise IllegalArgumentError(
+                    f"unknown reroute command [{kind}]")
+        state = state.with_(routing_table=routing)
+        return self.reroute(state, "reroute_commands")
+
     def reroute(self, state: ClusterState, reason: str = "") -> ClusterState:
         routing = self._fail_shards_on_missing_nodes(state,
                                                      state.routing_table)

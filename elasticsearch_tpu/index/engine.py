@@ -617,6 +617,28 @@ class Engine:
             if seg_dir.exists():
                 shutil.rmtree(seg_dir)
 
+    def synced_flush(self) -> str | None:
+        """Flush + stamp a sync_id in the commit (SyncedFlushService.java:
+        60 — copies sharing a sync_id skip phase-1 file comparison; our
+        recovery already diffs by checksum, so the id is a cheap marker,
+        not a correctness requirement)."""
+        import uuid as _uuid
+        with self._lock:
+            self._ensure_open()
+            if self._commit_pins:
+                return None
+            self.flush()
+            commit_file = self.path / "commit.json"
+            if not commit_file.exists():
+                return None
+            commit = json.loads(commit_file.read_text())
+            sync_id = _uuid.uuid4().hex
+            commit["sync_id"] = sync_id
+            tmp = self.path / "commit.json.tmp"
+            tmp.write_text(json.dumps(commit))
+            os.replace(tmp, commit_file)
+            return sync_id
+
     def force_merge(self, max_num_segments: int = 1) -> None:
         """_optimize / force-merge: rewrite segments into one, dropping
         deleted docs (ElasticsearchConcurrentMergeScheduler's job)."""
@@ -628,11 +650,12 @@ class Engine:
             if len(self._segments) <= max_num_segments:
                 return
             # bulk-ingested segments without stored _source cannot be
-            # re-analyzed — keep them as-is and merge only the rest
-            # (Segment.source_complete)
+            # re-analyzed, and untracked ones would lose every doc to the
+            # version-map re-check — keep both as-is, merge only the rest
             mergeable = [(s, m) for s, m in
                          zip(self._segments, self._live_masks)
-                         if s.source_complete]
+                         if s.source_complete
+                         and s.seg_id not in self._untracked_seg_ids]
             kept = [(s, m) for s, m in zip(self._segments, self._live_masks)
                     if not s.source_complete]
             if len(mergeable) <= 1:

@@ -204,6 +204,74 @@ class Node:
                     continue
                 raise unwrap_remote(e) from None
 
+    def put_stored_script(self, lang: str, sid: str, source) -> None:
+        """Indexed/stored scripts live in cluster state (the reference's
+        hidden .scripts index; metadata storage gives the same durability
+        — cf. search/templates.py's reasoning for stored templates)."""
+        self.indices_service._master_op(
+            "put-script", {"lang": lang, "id": sid, "source": source},
+            lambda: self._put_script_on_master(lang, sid, source))
+
+    def delete_stored_script(self, lang: str, sid: str) -> None:
+        self.indices_service._master_op(
+            "delete-script", {"lang": lang, "id": sid},
+            lambda: self._delete_script_on_master(lang, sid))
+
+    def _put_script_on_master(self, lang: str, sid: str, source) -> None:
+        def update(state):
+            scripts = {**state.customs.get("stored_scripts", {}),
+                       f"{lang}\x00{sid}": source}
+            return state.with_(customs={**state.customs,
+                                        "stored_scripts": scripts})
+        self.cluster_service.submit_and_wait(f"put-script [{sid}]", update)
+
+    def _delete_script_on_master(self, lang: str, sid: str) -> None:
+        def update(state):
+            scripts = {k: v for k, v in
+                       state.customs.get("stored_scripts", {}).items()
+                       if k != f"{lang}\x00{sid}"}
+            return state.with_(customs={**state.customs,
+                                        "stored_scripts": scripts})
+        self.cluster_service.submit_and_wait(f"delete-script [{sid}]",
+                                             update)
+
+    def stored_script(self, sid: str, lang: str = "mustache"):
+        return self.cluster_service.state().customs.get(
+            "stored_scripts", {}).get(f"{lang}\x00{sid}")
+
+    def cluster_reroute(self, commands: list[dict],
+                        dry_run: bool = False) -> dict:
+        """POST /_cluster/reroute (ref: TransportClusterRerouteAction +
+        allocation commands): explicit shard placement commands applied
+        through the master's single-writer queue; dry_run validates and
+        computes without publishing."""
+        if dry_run:
+            state = self.cluster_service.state()
+            new = self.allocation.execute_commands(state, commands)
+            return {"acknowledged": True,
+                    "state": {"routing_table": new.routing_table.to_dict()
+                              if hasattr(new.routing_table, "to_dict")
+                              else {}}}
+        self.indices_service._master_op(
+            "cluster-reroute", {"commands": commands},
+            lambda: self._reroute_on_master(commands))
+        return {"acknowledged": True}
+
+    def _reroute_on_master(self, commands: list[dict]) -> None:
+        from elasticsearch_tpu.cluster.service import URGENT
+        errors: list[Exception] = []
+
+        def update(state):
+            try:
+                return self.allocation.execute_commands(state, commands)
+            except Exception as e:           # noqa: BLE001 — surface below
+                errors.append(e)
+                return state
+        self.cluster_service.submit_and_wait("cluster-reroute", update,
+                                             priority=URGENT)
+        if errors:
+            raise errors[0]
+
     def _handle_master_forward(self, request: dict, source) -> dict:
         isvc = self.indices_service
         action, req = request["action"], request["request"]
@@ -241,6 +309,12 @@ class Node:
             "restore-snapshot": lambda:
                 self.snapshots_service._restore_on_master(
                     req["repo"], req["snapshot"], req["body"]),
+            "cluster-reroute": lambda: self._reroute_on_master(
+                req.get("commands") or []),
+            "put-script": lambda: self._put_script_on_master(
+                req["lang"], req["id"], req["source"]),
+            "delete-script": lambda: self._delete_script_on_master(
+                req["lang"], req["id"]),
         }
         fn = dispatch.get(action)
         if fn is None:

@@ -133,6 +133,27 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/_cluster/stats", h.cluster_stats)
     r("GET", "/_cluster/settings", h.cluster_settings)
     r("PUT", "/_cluster/settings", h.put_cluster_settings)
+    r("POST", "/_cluster/reroute", h.cluster_reroute)
+    # caches / synced flush / exists
+    r("POST", "/{index}/_cache/clear", h.cache_clear)
+    r("GET", "/{index}/_cache/clear", h.cache_clear)
+    r("POST", "/_cache/clear", h.cache_clear)
+    r("POST", "/{index}/_search/exists", h.search_exists)
+    r("GET", "/{index}/_search/exists", h.search_exists)
+    r("POST", "/_search/exists", h.search_exists)
+    r("POST", "/{index}/_flush/synced", h.synced_flush)
+    r("GET", "/{index}/_flush/synced", h.synced_flush)
+    r("POST", "/_flush/synced", h.synced_flush)
+    # indexed (stored) scripts & templates
+    # (ref: core/action/indexedscripts/ + RestPutIndexedScriptAction)
+    r("PUT", "/_scripts/{lang}/{id}", h.put_script)
+    r("POST", "/_scripts/{lang}/{id}", h.put_script)
+    r("GET", "/_scripts/{lang}/{id}", h.get_script)
+    r("DELETE", "/_scripts/{lang}/{id}", h.delete_script)
+    r("PUT", "/_search/template/{id}", h.put_search_template)
+    r("POST", "/_search/template/{id}", h.put_search_template)
+    r("GET", "/_search/template/{id}", h.get_search_template)
+    r("DELETE", "/_search/template/{id}", h.delete_search_template)
     # percolator (RestPercolateAction; registrations via .percolator paths)
     r("PUT", "/{index}/.percolator/{id}", h.put_percolator)
     r("POST", "/{index}/.percolator/{id}", h.put_percolator)
@@ -708,7 +729,8 @@ class Handlers:
         body, then search (RestSearchTemplateAction /
         SearchService.parseTemplate)."""
         from elasticsearch_tpu.search.templates import render_search_template
-        body = render_search_template(req.body or {}, lambda _i: None)
+        body = render_search_template(req.body or {},
+                                      self.node.stored_script)
         resp = self.node.search(req.path_params.get("index", "_all"), body,
                                 search_type=self._rest_search_type(req))
         return 200, resp
@@ -941,6 +963,103 @@ class Handlers:
             out["indices"] = {name: {"status": out["status"]}
                               for name in state.indices}
         return 200, out
+
+    def cluster_reroute(self, req: RestRequest):
+        body = req.body or {}
+        out = self.node.cluster_reroute(
+            body.get("commands") or [],
+            dry_run=req.param_as_bool("dry_run"))
+        return 200, out
+
+    def cache_clear(self, req: RestRequest):
+        """/{index}/_cache/clear (RestClearIndicesCacheAction): drops the
+        shard request cache (the only node-level query cache here — device
+        readers are not a cache, they ARE the index)."""
+        names = self.node.indices_service.resolve(
+            req.path_params.get("index", "_all"))
+        self.node.search_actions.request_cache.clear()
+        total = sum(self.node.indices_service.indices[n].meta.number_of_shards
+                    for n in names if n in self.node.indices_service.indices)
+        return 200, {"_shards": {"total": total, "successful": total,
+                                 "failed": 0}}
+
+    def search_exists(self, req: RestRequest):
+        """/_search/exists (core/action/exists/TransportExistsAction):
+        count with terminate_after=1 — 404 {"exists": false} on no match."""
+        body = dict(self._search_body(req))
+        body["size"] = 0
+        body["terminate_after"] = 1
+        out = self.node.search(req.path_params.get("index", "_all"), body)
+        exists = out["hits"]["total"]["value"] > 0
+        return (200 if exists else 404), {"exists": exists}
+
+    def synced_flush(self, req: RestRequest):
+        """/{index}/_flush/synced (SyncedFlushService.java:60): flush and
+        stamp a sync_id so idle copies prove file-identity cheaply (peer
+        recovery here already skips identical files via checksums; the
+        sync_id keeps the API surface + commit marker)."""
+        names = self.node.indices_service.resolve(
+            req.path_params.get("index", "_all"))
+        out = {"_shards": {"total": 0, "successful": 0, "failed": 0}}
+        for n in names:
+            svc = self.node.indices_service.indices.get(n)
+            if svc is None:
+                continue
+            ok = failed = 0
+            for e in svc.shard_engines:
+                if e.synced_flush() is not None:
+                    ok += 1
+                else:                # commit pinned (snapshot/recovery)
+                    failed += 1
+            out[n] = {"total": ok + failed, "successful": ok,
+                      "failed": failed}
+            out["_shards"]["total"] += ok + failed
+            out["_shards"]["successful"] += ok
+            out["_shards"]["failed"] += failed
+        return 200, out
+
+    # ---- stored scripts & templates (core/action/indexedscripts/) --------
+
+    def _stored_scripts(self) -> dict:
+        return self.node.cluster_service.state().customs.get(
+            "stored_scripts", {})
+
+    def put_script(self, req: RestRequest):
+        lang, sid = req.path_params["lang"], req.path_params["id"]
+        body = req.body or {}
+        source = body.get("script", body.get("template", body))
+        created = f"{lang}\x00{sid}" not in self._stored_scripts()
+        self.node.put_stored_script(lang, sid, source)
+        return (201 if created else 200), {
+            "_id": sid, "acknowledged": True, "created": created}
+
+    def get_script(self, req: RestRequest):
+        lang, sid = req.path_params["lang"], req.path_params["id"]
+        src = self._stored_scripts().get(f"{lang}\x00{sid}")
+        if src is None:
+            return 404, {"_id": sid, "lang": lang, "found": False}
+        return 200, {"_id": sid, "lang": lang, "found": True,
+                     "script" if lang != "mustache" else "template": src}
+
+    def delete_script(self, req: RestRequest):
+        lang, sid = req.path_params["lang"], req.path_params["id"]
+        found = f"{lang}\x00{sid}" in self._stored_scripts()
+        if not found:
+            return 404, {"_id": sid, "found": False}
+        self.node.delete_stored_script(lang, sid)
+        return 200, {"_id": sid, "found": True, "acknowledged": True}
+
+    def put_search_template(self, req: RestRequest):
+        req.path_params = {**req.path_params, "lang": "mustache"}
+        return self.put_script(req)
+
+    def get_search_template(self, req: RestRequest):
+        req.path_params = {**req.path_params, "lang": "mustache"}
+        return self.get_script(req)
+
+    def delete_search_template(self, req: RestRequest):
+        req.path_params = {**req.path_params, "lang": "mustache"}
+        return self.delete_script(req)
 
     def cluster_state(self, req: RestRequest):
         state = self.node.cluster_service.state()

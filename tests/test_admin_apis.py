@@ -1,0 +1,173 @@
+"""Admin API tranche: _cluster/reroute commands, _cache/clear,
+_search/exists, synced flush, stored scripts/templates (refs:
+core/cluster/routing/allocation/command/, RestClearIndicesCacheAction,
+TransportExistsAction, SyncedFlushService, core/action/indexedscripts/)."""
+
+import json
+import time
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.controller import RestController
+from elasticsearch_tpu.rest.handlers import register_all
+from elasticsearch_tpu.testing import InternalTestCluster
+
+
+@pytest.fixture
+def rc(tmp_path):
+    n = Node({}, data_path=tmp_path / "n").start()
+    c = RestController()
+    register_all(c, n)
+    yield n, c
+    n.close()
+
+
+def _seed(n, name="idx", shards=1):
+    n.indices_service.create_index(
+        name, {"settings": {"number_of_shards": shards,
+                            "number_of_replicas": 0}})
+    for i in range(10):
+        n.index_doc(name, str(i), {"t": f"alpha word{i % 3}"})
+    n.broadcast_actions.refresh(name)
+
+
+class TestSearchExists:
+    def test_exists_and_404(self, rc):
+        n, c = rc
+        _seed(n)
+        st, out = c.dispatch("POST", "/idx/_search/exists",
+                             json.dumps({"query": {"match": {
+                                 "t": "word1"}}}).encode())
+        assert st == 200 and out["exists"] is True
+        st, out = c.dispatch("POST", "/idx/_search/exists",
+                             json.dumps({"query": {"match": {
+                                 "t": "zzz"}}}).encode())
+        assert st == 404 and out["exists"] is False
+
+
+class TestCacheClear:
+    def test_clears_request_cache(self, rc):
+        n, c = rc
+        _seed(n)
+        body = {"query": {"match": {"t": "alpha"}}, "size": 0}
+        n.search("idx", body)
+        n.search("idx", body)
+        assert n.search_actions.request_cache.stats_dict()["entries"] >= 1
+        st, out = c.dispatch("POST", "/idx/_cache/clear", b"")
+        assert st == 200 and out["_shards"]["failed"] == 0
+        assert n.search_actions.request_cache.stats_dict()["entries"] == 0
+
+
+class TestSyncedFlush:
+    def test_stamps_sync_id(self, rc):
+        n, c = rc
+        _seed(n)
+        st, out = c.dispatch("POST", "/idx/_flush/synced", b"")
+        assert st == 200
+        assert out["idx"]["successful"] == 1
+        eng = n.indices_service.indices["idx"].engine(0)
+        commit = json.loads((eng.path / "commit.json").read_text())
+        assert commit.get("sync_id")
+
+
+class TestStoredScripts:
+    def test_crud_and_template_execution(self, rc):
+        n, c = rc
+        _seed(n)
+        st, out = c.dispatch(
+            "PUT", "/_search/template/my_tpl",
+            json.dumps({"template": {"query": {"match": {
+                "t": "{{word}}"}}}}).encode())
+        assert st == 201
+        st, out = c.dispatch("GET", "/_search/template/my_tpl", b"")
+        assert st == 200 and out["found"]
+        # execute by id
+        st, out = c.dispatch(
+            "POST", "/idx/_search/template",
+            json.dumps({"id": "my_tpl",
+                        "params": {"word": "word1"}}).encode())
+        assert st == 200
+        assert out["hits"]["total"]["value"] > 0
+        st, _ = c.dispatch("DELETE", "/_search/template/my_tpl", b"")
+        assert st == 200
+        st, out = c.dispatch("GET", "/_search/template/my_tpl", b"")
+        assert st == 404
+        # generic script CRUD under a lang
+        st, _ = c.dispatch("PUT", "/_scripts/expression/rankit",
+                           json.dumps({"script": "doc_rank * 2"}).encode())
+        assert st == 201
+        st, out = c.dispatch("GET", "/_scripts/expression/rankit", b"")
+        assert st == 200 and out["found"] and out["script"] == "doc_rank * 2"
+
+
+class TestClusterReroute:
+    def test_cancel_replica_recovers(self, tmp_path):
+        with InternalTestCluster(2, base_path=tmp_path) as cluster:
+            cluster.wait_for_nodes(2)
+            m = cluster.master()
+            m.indices_service.create_index(
+                "r", {"settings": {"number_of_shards": 1,
+                                   "number_of_replicas": 1}})
+            cluster.wait_for_health("green")
+            for i in range(5):
+                m.index_doc("r", str(i), {"t": "alpha"})
+            m.broadcast_actions.refresh("r")
+            state = m.cluster_service.state()
+            replica = next(cp for cp in
+                           state.routing_table.shard_copies("r", 0)
+                           if not cp.primary)
+            out = m.cluster_reroute([{"cancel": {
+                "index": "r", "shard": 0, "node": replica.node_id}}])
+            assert out["acknowledged"]
+            cluster.wait_for_health("green")     # re-allocated + recovered
+            out = m.search("r", {"query": {"match": {"t": "alpha"}}})
+            assert out["hits"]["total"]["value"] == 5
+
+    def test_move_replica(self, tmp_path):
+        with InternalTestCluster(3, base_path=tmp_path) as cluster:
+            cluster.wait_for_nodes(3)
+            m = cluster.master()
+            m.indices_service.create_index(
+                "mv", {"settings": {"number_of_shards": 1,
+                                    "number_of_replicas": 1}})
+            cluster.wait_for_health("green")
+            for i in range(5):
+                m.index_doc("mv", str(i), {"t": "beta"})
+            state = m.cluster_service.state()
+            copies = state.routing_table.shard_copies("mv", 0)
+            replica = next(cp for cp in copies if not cp.primary)
+            used = {cp.node_id for cp in copies}
+            free = next(nid for nid in state.nodes if nid not in used)
+            m.cluster_reroute([{"move": {
+                "index": "mv", "shard": 0,
+                "from_node": replica.node_id, "to_node": free}}])
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                st = m.cluster_service.state()
+                cps = st.routing_table.shard_copies("mv", 0)
+                if any(c.node_id == free and c.active for c in cps) and \
+                        all(c.node_id != replica.node_id for c in cps):
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError("replica never moved")
+            m.broadcast_actions.refresh("mv")
+            out = m.search("mv", {"query": {"match": {"t": "beta"}}})
+            assert out["hits"]["total"]["value"] == 5
+
+    def test_invalid_commands_rejected(self, rc):
+        n, c = rc
+        _seed(n)
+        from elasticsearch_tpu.common.errors import IllegalArgumentError
+        with pytest.raises(IllegalArgumentError):
+            n.cluster_reroute([{"move": {"index": "nope", "shard": 0,
+                                         "from_node": "a",
+                                         "to_node": "b"}}])
+        # primary with no replica refuses to move
+        state = n.cluster_service.state()
+        pr = state.routing_table.primary("idx", 0)
+        with pytest.raises(IllegalArgumentError):
+            n.cluster_reroute([{"move": {
+                "index": "idx", "shard": 0,
+                "from_node": pr.node_id, "to_node": "nowhere"}}])
