@@ -781,6 +781,11 @@ class BroadcastActions:
                 svc.flush()
             elif request["op"] == "force_merge":
                 svc.force_merge(request.get("max_num_segments", 1))
+            elif request["op"] == "synced_flush":
+                # ALL copies stamp the COORDINATOR's sync_id — a shared id
+                # is the whole point (SyncedFlushService.java:60)
+                for e in svc.shard_engines:
+                    e.synced_flush(sync_id=request["sync_id"])
         return {}
 
     def refresh(self, index_expr: str) -> dict:
@@ -788,6 +793,11 @@ class BroadcastActions:
 
     def flush(self, index_expr: str) -> dict:
         return self._fan_out(index_expr, "flush")
+
+    def synced_flush(self, index_expr: str) -> dict:
+        import uuid as _uuid
+        return self._fan_out(index_expr, "synced_flush",
+                             sync_id=_uuid.uuid4().hex)
 
     def force_merge(self, index_expr: str,
                     max_num_segments: int = 1) -> dict:

@@ -73,15 +73,21 @@ class _ScrollContext:
 
 
 def rewrite_mlt_likes(node, body: dict, default_index: str = "_all") -> dict:
-    """Coordinator-side more_like_this rewrite: liked DOCUMENTS are fetched
-    here (routing-aware GET, any shard/node) and turned into like-texts +
-    `_exclude_ids`, so every shard scores them — a shard-local source scan
-    would silently match nothing on shards not hosting the liked doc.
-    The reference does the same (liked docs are fetched before query
-    construction, core/index/query/MoreLikeThisQueryParser.java). Missing
-    docs are skipped, as are dicts without _id. Returns a rewritten copy
-    (the input body is not mutated); bodies without doc-likes pass through
-    unchanged."""
+    """Coordinator-side request rewrites that need cluster access before
+    the per-shard fan-out:
+
+    * more_like_this liked DOCUMENTS are fetched here (routing-aware GET,
+      any shard/node) and turned into like-texts + `_exclude_ids`, so
+      every shard scores them — a shard-local source scan would silently
+      match nothing on shards not hosting the liked doc (the reference
+      fetches liked docs before query construction too).
+    * stored-script references ({"script": {"id": ...}} in script_score /
+      function_score, {"id": ...} template queries) resolve against the
+      cluster-state script registry (core/script/ScriptService indexed
+      scripts) into inline sources shards can execute.
+
+    Returns a rewritten copy (the input body is not mutated); bodies
+    without such references pass through unchanged."""
     def walk(obj):
         if isinstance(obj, list):
             return [walk(v) for v in obj]
@@ -92,10 +98,41 @@ def rewrite_mlt_likes(node, body: dict, default_index: str = "_all") -> dict:
             if key in ("more_like_this", "mlt") and isinstance(val, dict) \
                     and _mlt_has_docs(val):
                 out[key] = _fetch_mlt_likes(node, val, default_index)
+            elif key == "script" and isinstance(val, dict) \
+                    and "id" in val and "source" not in val \
+                    and "inline" not in val:
+                src = _stored_script_any(node, str(val["id"]),
+                                         val.get("lang"))
+                if src is None:
+                    out[key] = walk(val)
+                else:
+                    out[key] = {**{k: walk(v) for k, v in val.items()
+                                   if k != "id"}, "inline": src}
+            elif key == "template" and isinstance(val, dict) \
+                    and "id" in val and not any(
+                        k in val for k in ("query", "inline", "source")):
+                src = _stored_script_any(node, str(val["id"]), "mustache")
+                if src is None:
+                    out[key] = walk(val)
+                else:
+                    out[key] = {**{k: walk(v) for k, v in val.items()
+                                   if k != "id"}, "inline": src}
             else:
                 out[key] = walk(val)
         return out
     return walk(body)
+
+
+def _stored_script_any(node, sid: str, lang: str | None):
+    """Stored-script lookup; without a lang, any registered lang matches
+    (the 2.x indexed-script API keys by (lang, id))."""
+    if lang:
+        return node.stored_script(sid, lang)
+    scripts = node.cluster_service.state().customs.get("stored_scripts", {})
+    for key, src in scripts.items():
+        if key.split("\x00", 1)[1] == sid:
+            return src
+    return None
 
 
 def _mlt_has_docs(spec: dict) -> bool:
@@ -192,9 +229,16 @@ class ShardRequestCache:
                 self._lru.popitem(last=False)
                 self.stats["evictions"] += 1
 
-    def clear(self) -> None:
+    def clear(self, engine_uuids: set | None = None) -> None:
+        """Drop everything, or only entries belonging to the given engine
+        incarnations (index-scoped /_cache/clear)."""
         with self._lock:
-            self._lru.clear()
+            if engine_uuids is None:
+                self._lru.clear()
+            else:
+                for key in [k for k in self._lru
+                            if k[0] in engine_uuids]:
+                    del self._lru[key]
 
     def stats_dict(self) -> dict:
         with self._lock:

@@ -472,6 +472,10 @@ class AllocationService:
             elif kind == "move":
                 from_node = args.get("from_node")
                 to_node = args.get("to_node")
+                if from_node is None:
+                    raise IllegalArgumentError(
+                        "[move] requires [from_node] — which copy moves "
+                        "must be explicit")
                 if state.node(to_node) is None:
                     raise IllegalArgumentError(f"no such node [{to_node}]")
                 c = find(index, shard, from_node,

@@ -617,11 +617,12 @@ class Engine:
             if seg_dir.exists():
                 shutil.rmtree(seg_dir)
 
-    def synced_flush(self) -> str | None:
+    def synced_flush(self, sync_id: str | None = None) -> str | None:
         """Flush + stamp a sync_id in the commit (SyncedFlushService.java:
-        60 — copies sharing a sync_id skip phase-1 file comparison; our
-        recovery already diffs by checksum, so the id is a cheap marker,
-        not a correctness requirement)."""
+        60). Every COPY of a shard must receive the SAME id (the broadcast
+        coordinator generates one) — matching ids are the cheap proof of
+        file identity; our recovery also diffs by checksum, so the id is a
+        marker, not a correctness requirement."""
         import uuid as _uuid
         with self._lock:
             self._ensure_open()
@@ -632,7 +633,7 @@ class Engine:
             if not commit_file.exists():
                 return None
             commit = json.loads(commit_file.read_text())
-            sync_id = _uuid.uuid4().hex
+            sync_id = sync_id or _uuid.uuid4().hex
             commit["sync_id"] = sync_id
             tmp = self.path / "commit.json.tmp"
             tmp.write_text(json.dumps(commit))
@@ -652,12 +653,16 @@ class Engine:
             # bulk-ingested segments without stored _source cannot be
             # re-analyzed, and untracked ones would lose every doc to the
             # version-map re-check — keep both as-is, merge only the rest
+            # (kept MUST be the exact complement of mergeable: a segment
+            # in neither list would silently vanish from the index)
+            def can_merge(s: Segment) -> bool:
+                return s.source_complete and \
+                    s.seg_id not in self._untracked_seg_ids
             mergeable = [(s, m) for s, m in
                          zip(self._segments, self._live_masks)
-                         if s.source_complete
-                         and s.seg_id not in self._untracked_seg_ids]
+                         if can_merge(s)]
             kept = [(s, m) for s, m in zip(self._segments, self._live_masks)
-                    if not s.source_complete]
+                    if not can_merge(s)]
             if len(mergeable) <= 1:
                 return
             builder = merge_segments(self._next_seg_id,

@@ -204,26 +204,35 @@ class Node:
                     continue
                 raise unwrap_remote(e) from None
 
-    def put_stored_script(self, lang: str, sid: str, source) -> None:
+    def put_stored_script(self, lang: str, sid: str, source) -> bool:
         """Indexed/stored scripts live in cluster state (the reference's
         hidden .scripts index; metadata storage gives the same durability
-        — cf. search/templates.py's reasoning for stored templates)."""
-        self.indices_service._master_op(
+        — cf. search/templates.py's reasoning for stored templates).
+        → created (False = overwrote), decided inside the MASTER's
+        single-writer update so concurrent puts and applied-state lag on
+        the coordinating node can't misreport it."""
+        out = self.indices_service._master_op(
             "put-script", {"lang": lang, "id": sid, "source": source},
             lambda: self._put_script_on_master(lang, sid, source))
+        return bool(out.get("created", True)) if isinstance(out, dict) \
+            else True
 
     def delete_stored_script(self, lang: str, sid: str) -> None:
         self.indices_service._master_op(
             "delete-script", {"lang": lang, "id": sid},
             lambda: self._delete_script_on_master(lang, sid))
 
-    def _put_script_on_master(self, lang: str, sid: str, source) -> None:
+    def _put_script_on_master(self, lang: str, sid: str, source) -> dict:
+        created = [True]
+
         def update(state):
-            scripts = {**state.customs.get("stored_scripts", {}),
-                       f"{lang}\x00{sid}": source}
+            existing = state.customs.get("stored_scripts", {})
+            created[0] = f"{lang}\x00{sid}" not in existing
+            scripts = {**existing, f"{lang}\x00{sid}": source}
             return state.with_(customs={**state.customs,
                                         "stored_scripts": scripts})
         self.cluster_service.submit_and_wait(f"put-script [{sid}]", update)
+        return {"created": created[0]}
 
     def _delete_script_on_master(self, lang: str, sid: str) -> None:
         def update(state):
@@ -319,7 +328,9 @@ class Node:
         fn = dispatch.get(action)
         if fn is None:
             raise ValueError(f"unknown master action [{action}]")
-        fn()
+        out = fn()
+        if isinstance(out, dict):        # e.g. put-script's created flag
+            return {"acknowledged": True, **out}
         return {"acknowledged": True}
 
     # ---- cluster-level metadata (master ops) -------------------------------

@@ -973,11 +973,21 @@ class Handlers:
 
     def cache_clear(self, req: RestRequest):
         """/{index}/_cache/clear (RestClearIndicesCacheAction): drops the
-        shard request cache (the only node-level query cache here — device
-        readers are not a cache, they ARE the index)."""
-        names = self.node.indices_service.resolve(
-            req.path_params.get("index", "_all"))
-        self.node.search_actions.request_cache.clear()
+        shard request cache entries of the NAMED indices only (the only
+        node-level query cache here — device readers are not a cache,
+        they ARE the index). Coordinator-local; remote nodes' entries age
+        out by generation."""
+        index = req.path_params.get("index", "_all")
+        names = self.node.indices_service.resolve(index)
+        if index in ("_all", "*"):
+            self.node.search_actions.request_cache.clear()
+        else:
+            uuids = {e.engine_uuid
+                     for n in names
+                     if n in self.node.indices_service.indices
+                     for e in
+                     self.node.indices_service.indices[n].shard_engines}
+            self.node.search_actions.request_cache.clear(uuids)
         total = sum(self.node.indices_service.indices[n].meta.number_of_shards
                     for n in names if n in self.node.indices_service.indices)
         return 200, {"_shards": {"total": total, "successful": total,
@@ -994,28 +1004,17 @@ class Handlers:
         return (200 if exists else 404), {"exists": exists}
 
     def synced_flush(self, req: RestRequest):
-        """/{index}/_flush/synced (SyncedFlushService.java:60): flush and
-        stamp a sync_id so idle copies prove file-identity cheaply (peer
-        recovery here already skips identical files via checksums; the
-        sync_id keeps the API surface + commit marker)."""
-        names = self.node.indices_service.resolve(
-            req.path_params.get("index", "_all"))
-        out = {"_shards": {"total": 0, "successful": 0, "failed": 0}}
+        """/{index}/_flush/synced (SyncedFlushService.java:60): broadcast
+        a synced flush so EVERY copy cluster-wide stamps the coordinator's
+        shared sync_id (matching ids are the point; peer recovery here
+        also skips identical files via checksums)."""
+        index = req.path_params.get("index", "_all")
+        out = self.node.broadcast_actions.synced_flush(index)
+        names = self.node.indices_service.resolve(index)
         for n in names:
-            svc = self.node.indices_service.indices.get(n)
-            if svc is None:
-                continue
-            ok = failed = 0
-            for e in svc.shard_engines:
-                if e.synced_flush() is not None:
-                    ok += 1
-                else:                # commit pinned (snapshot/recovery)
-                    failed += 1
-            out[n] = {"total": ok + failed, "successful": ok,
-                      "failed": failed}
-            out["_shards"]["total"] += ok + failed
-            out["_shards"]["successful"] += ok
-            out["_shards"]["failed"] += failed
+            out[n] = {"total": out["_shards"]["total"],
+                      "successful": out["_shards"]["successful"],
+                      "failed": out["_shards"]["failed"]}
         return 200, out
 
     # ---- stored scripts & templates (core/action/indexedscripts/) --------
@@ -1028,8 +1027,7 @@ class Handlers:
         lang, sid = req.path_params["lang"], req.path_params["id"]
         body = req.body or {}
         source = body.get("script", body.get("template", body))
-        created = f"{lang}\x00{sid}" not in self._stored_scripts()
-        self.node.put_stored_script(lang, sid, source)
+        created = self.node.put_stored_script(lang, sid, source)
         return (201 if created else 200), {
             "_id": sid, "acknowledged": True, "created": created}
 

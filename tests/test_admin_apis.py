@@ -100,6 +100,30 @@ class TestStoredScripts:
         st, out = c.dispatch("GET", "/_scripts/expression/rankit", b"")
         assert st == 200 and out["found"] and out["script"] == "doc_rank * 2"
 
+    def test_stored_script_executes_in_script_score(self, rc):
+        n, c = rc
+        n.indices_service.create_index(
+            "sc", {"settings": {"number_of_shards": 1,
+                                "number_of_replicas": 0},
+                   "mappings": {"_doc": {"properties": {
+                       "t": {"type": "text"},
+                       "rank": {"type": "long"}}}}})
+        for i in range(6):
+            n.index_doc("sc", str(i), {"t": "alpha", "rank": i})
+        n.broadcast_actions.refresh("sc")
+        c.dispatch("PUT", "/_scripts/expression/by_rank",
+                   json.dumps({"script": "doc['rank'].value"}).encode())
+        st, out = c.dispatch("POST", "/sc/_search", json.dumps({
+            "query": {"function_score": {
+                "query": {"match": {"t": "alpha"}},
+                "functions": [{"script_score": {
+                    "script": {"id": "by_rank"}}}],
+                "boost_mode": "replace"}},
+            "size": 6}).encode())
+        assert st == 200, out
+        ids = [h["_id"] for h in out["hits"]["hits"]]
+        assert ids == ["5", "4", "3", "2", "1", "0"]
+
 
 class TestClusterReroute:
     def test_cancel_replica_recovers(self, tmp_path):
