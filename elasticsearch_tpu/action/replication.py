@@ -749,8 +749,10 @@ class BroadcastActions:
             request = {"indices": names, "op": op, **kw}
             if nid == self.node.node_id:
                 try:
-                    self._handle(request, None)
-                    ok += nshards
+                    resp = self._handle(request, None) or {}
+                    f = int(resp.get("failed", 0))
+                    ok += nshards - min(f, nshards)
+                    failed += min(f, nshards)
                 except Exception:                # noqa: BLE001 — count it
                     failed += nshards
                 continue
@@ -762,8 +764,10 @@ class BroadcastActions:
                 target, self.ACTION, request, timeout=30.0)))
         for nshards, fut in futures:
             try:
-                fut.result(35.0)
-                ok += nshards
+                resp = fut.result(35.0) or {}
+                f = int(resp.get("failed", 0))
+                ok += nshards - min(f, nshards)
+                failed += min(f, nshards)
             except Exception:                    # noqa: BLE001 — count it
                 failed += nshards
         return {"_shards": {"total": total_shards, "successful": ok,
@@ -771,6 +775,7 @@ class BroadcastActions:
 
     def _handle(self, request: dict, source) -> dict:
         isvc = self.node.indices_service
+        pinned = 0
         for name in request["indices"]:
             svc = isvc.indices.get(name)
             if svc is None:
@@ -783,10 +788,13 @@ class BroadcastActions:
                 svc.force_merge(request.get("max_num_segments", 1))
             elif request["op"] == "synced_flush":
                 # ALL copies stamp the COORDINATOR's sync_id — a shared id
-                # is the whole point (SyncedFlushService.java:60)
+                # is the whole point (SyncedFlushService.java:60); a
+                # pinned commit (snapshot/recovery in flight) cannot be
+                # stamped and counts as failed, not silently successful
                 for e in svc.shard_engines:
-                    e.synced_flush(sync_id=request["sync_id"])
-        return {}
+                    if e.synced_flush(sync_id=request["sync_id"]) is None:
+                        pinned += 1
+        return {"failed": pinned}
 
     def refresh(self, index_expr: str) -> dict:
         return self._fan_out(index_expr, "refresh")

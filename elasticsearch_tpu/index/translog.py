@@ -152,6 +152,18 @@ class Translog:
             self.sync()
         return op.seq_no
 
+    def stats(self) -> dict:
+        """Uncommitted operation count + on-disk bytes of live generations
+        (the _stats translog section)."""
+        ops = len(self.uncommitted_ops())
+        size = 0
+        for p in self.path.glob("translog-*.tlog"):
+            try:
+                size += p.stat().st_size
+            except OSError:
+                pass
+        return {"operations": ops, "size_in_bytes": size}
+
     def sync(self) -> None:
         self._file.flush()
         os.fsync(self._file.fileno())

@@ -426,6 +426,11 @@ class MapperService:
     def document_mapper(self, type_name: str | None = None) -> DocumentMapper:
         tname = type_name or self.DEFAULT_TYPE
         if tname not in self.mappers:
+            if type_name is None and len(self.mappers) == 1:
+                # untyped op against an index mapped with ONE custom type:
+                # that type IS the document mapping (single-type
+                # semantics — the 2.x type name is a surface label here)
+                return next(iter(self.mappers.values()))
             self.mappers[tname] = DocumentMapper(tname, {}, self.analysis)
         return self.mappers[tname]
 

@@ -181,7 +181,9 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/_nodes", h.nodes_info)
     r("GET", "/_nodes/stats", h.nodes_stats)
     r("GET", "/_stats", h.all_stats)
+    r("GET", "/_stats/{metric}", h.all_stats)
     r("GET", "/{index}/_stats", h.index_stats)
+    r("GET", "/{index}/_stats/{metric}", h.index_stats)
     # _cat
     r("GET", "/_cat", h.cat_help)
     r("GET", "/_cat/indices", h.cat_indices)
@@ -1009,12 +1011,14 @@ class Handlers:
         shared sync_id (matching ids are the point; peer recovery here
         also skips identical files via checksums)."""
         index = req.path_params.get("index", "_all")
-        out = self.node.broadcast_actions.synced_flush(index)
         names = self.node.indices_service.resolve(index)
-        for n in names:
-            out[n] = {"total": out["_shards"]["total"],
-                      "successful": out["_shards"]["successful"],
-                      "failed": out["_shards"]["failed"]}
+        out = {"_shards": {"total": 0, "successful": 0, "failed": 0}}
+        for n in names:                  # per-index fan-out → honest
+            r = self.node.broadcast_actions.synced_flush(n)["_shards"]
+            out[n] = {"total": r["total"], "successful": r["successful"],
+                      "failed": r["failed"]}
+            for k in ("total", "successful", "failed"):
+                out["_shards"][k] += r[k]
         return 200, out
 
     # ---- stored scripts & templates (core/action/indexedscripts/) --------
@@ -1113,18 +1117,120 @@ class Handlers:
         the transport (TransportNodesStatsAction fan-out)."""
         return 200, self.node.collect_nodes_stats()
 
+    _STATS_METRICS = {
+        "docs": ("docs",), "store": ("store",),
+        "indexing": ("indexing",), "get": ("get",), "search": ("search",),
+        "merge": ("merges",), "refresh": ("refresh",), "flush": ("flush",),
+        "warmer": ("warmer",), "query_cache": ("query_cache",),
+        "filter_cache": ("filter_cache",), "fielddata": ("fielddata",),
+        "completion": ("completion",), "segments": ("segments",),
+        "translog": ("translog",), "suggest": ("suggest",),
+        "percolate": ("percolate",), "request_cache": ("request_cache",),
+        "recovery": ("recovery",),
+    }
+
+    @staticmethod
+    def _field_memory(svc, field: str) -> int:
+        """Host-side column bytes of one field across committed segments —
+        the fielddata-breakdown figure (?fielddata_fields=...)."""
+        total = 0
+        for e in svc.shard_engines:
+            for seg in e.acquire_searcher().segments:
+                c = seg.text_fields.get(field)
+                if c is not None:
+                    total += c.uterms.nbytes + c.utf.nbytes
+                k = seg.keyword_fields.get(field)
+                if k is not None:
+                    total += k.ords.nbytes
+                n = seg.numeric_fields.get(field)
+                if n is not None:
+                    total += n.values.nbytes
+        return total
+
+    def _stats_response(self, names: list[str],
+                        metric: str | None, req: RestRequest) -> dict:
+        """The 2.x _stats shape (RestIndicesStatsAction): `_all` +
+        per-index, each split primaries/total, sections filtered by the
+        metric path. Single-process note: totals cover the shards THIS
+        node hosts (primaries == total until replicas live elsewhere)."""
+        keep = None
+        if metric and metric not in ("_all", "*"):
+            keep = set()
+            for m in metric.split(","):
+                keep.update(self._STATS_METRICS.get(m, ()))
+
+        def trim(sections: dict) -> dict:
+            if keep is None:
+                return sections
+            return {k: v for k, v in sections.items() if k in keep}
+
+        level = req.param("level", "indices")
+        fd_fields = req.param("fielddata_fields", req.param("fields"))
+        cp_fields = req.param("completion_fields", req.param("fields"))
+        indices = {}
+        all_sections: dict = {}
+        shards = ok = 0
+        state = self.node.cluster_service.state()
+        for n in names:
+            svc = self.node.indices_service.indices.get(n)
+            if svc is None:
+                continue
+            sections = trim(svc.stats())
+            # per-field breakdowns (?fielddata_fields= / completion_fields=
+            # / fields=) — sizes from the columnar field memory
+            for section, wanted, kinds in (
+                    ("fielddata", fd_fields, None),
+                    ("completion", cp_fields, "completion")):
+                if wanted and section in sections:
+                    fields = {}
+                    for f in wanted.split(","):
+                        fm = svc.mapper_service.field_mapper(f)
+                        if kinds == "completion" and (
+                                fm is None or fm.type != "completion"):
+                            continue
+                        size = self._field_memory(svc, f)
+                        if size or fm is not None:
+                            fields[f] = {"memory_size_in_bytes": size} \
+                                if section == "fielddata" \
+                                else {"size_in_bytes": size}
+                    # `fields` is a BREAKDOWN; the section total stays
+                    # index-wide (the reference never narrows it)
+                    sections = {**sections,
+                                section: {**sections[section],
+                                          "fields": fields}}
+            entry = {"primaries": sections, "total": sections}
+            if level == "shards":
+                entry["shards"] = {
+                    str(sid): [{"docs": {
+                        "count": e.acquire_searcher().num_docs}}]
+                    for sid, e in svc.engines.items()}
+            indices[n] = entry
+            copies = list(state.routing_table.index_shards(n))
+            shards += len(copies)       # every copy the index SHOULD have
+            ok += sum(1 for s in copies if s.active)
+            for key, val in sections.items():
+                cur = all_sections.setdefault(key, {})
+                for stat, v in val.items():
+                    if isinstance(v, (int, float)) and \
+                            not isinstance(v, bool):
+                        cur[stat] = cur.get(stat, 0) + v
+                    else:
+                        cur.setdefault(stat, v)
+        out = {"_shards": {"total": shards, "successful": ok, "failed": 0},
+               "_all": {"primaries": all_sections, "total": all_sections}}
+        if level != "cluster":       # level=cluster omits per-index stats
+            out["indices"] = indices
+        return out
+
     def all_stats(self, req: RestRequest):
-        indices = {n: svc.stats()
-                   for n, svc in self.node.indices_service.indices.items()}
-        total_docs = sum(s["docs"]["count"] for s in indices.values())
-        return 200, {"_all": {"primaries": {"docs": {"count": total_docs}}},
-                     "indices": indices}
+        names = list(self.node.indices_service.indices)
+        return 200, self._stats_response(names,
+                                         req.path_params.get("metric"), req)
 
     def index_stats(self, req: RestRequest):
-        out = {}
-        for n in self.node.indices_service.resolve(req.path_params["index"]):
-            out[n] = {"primaries": self.node.indices_service.index(n).stats()}
-        return 200, {"indices": out}
+        names = self.node.indices_service.resolve(req.path_params["index"])
+        return 200, self._stats_response(names,
+                                         req.path_params.get("metric"), req)
 
     # ---- _cat --------------------------------------------------------------
 
