@@ -166,3 +166,10 @@ def reconstruct_error(py_class_name: str, reason: str) -> ElasticsearchTpuError:
     err.index = None
     err.shard = None
     return err
+
+
+class TypeMissingError(ElasticsearchTpuError):
+    """Requested mapping type absent (reference: TypeMissingException)."""
+
+    status = 404
+    error_type = "type_missing_exception"

@@ -51,6 +51,11 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/{index}/_mapping/{type}", h.get_mapping)
     r("GET", "/_mapping", h.get_all_mappings)
     r("GET", "/_mapping/{type}", h.get_all_mappings)
+    r("GET", "/_mapping/field/{fields}", h.get_field_mapping)
+    r("GET", "/{index}/_mapping/field/{fields}", h.get_field_mapping)
+    r("GET", "/_mapping/{type}/field/{fields}", h.get_field_mapping)
+    r("GET", "/{index}/_mapping/{type}/field/{fields}",
+      h.get_field_mapping)
     r("GET", "/{index}/_settings", h.get_settings)
     r("PUT", "/{index}/_settings", h.put_settings)
     # aliases
@@ -206,6 +211,31 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/_nodes/{node}/hot_threads", h.nodes_hot_threads)
 
 
+def _wildcard_match(value: str, pattern: str) -> bool:
+    """ES wildcard matching: only `*` is a metacharacter, case-sensitive
+    (fnmatch would interpret ?/[...] and case-fold on some platforms)."""
+    import re as _re
+    if "*" not in pattern:
+        return value == pattern
+    rx = ".*".join(_re.escape(p) for p in pattern.split("*"))
+    return _re.fullmatch(rx, value) is not None
+
+
+def _source_from_path(src, path: str):
+    """Dotted-path value extraction from a source dict (stored fields)."""
+    if not isinstance(src, dict):
+        return None
+    v = src.get(path)
+    if v is None and "." in path:
+        node = src
+        for part in path.split("."):
+            node = node.get(part) if isinstance(node, dict) else None
+            if node is None:
+                return None
+        v = node
+    return v
+
+
 def _filter_doc_source(src, spec):
     from elasticsearch_tpu.search.phase import _filter_source
     if src is None:
@@ -330,6 +360,44 @@ class Handlers:
                 status = 404
                 error_type = "type_missing_exception"
             raise _TypeMissing(f"type [{want_type}] missing")
+        return 200, out
+
+    def get_field_mapping(self, req: RestRequest):
+        """GET /{index}/_mapping[/{type}]/field/{fields}
+        (RestGetFieldMappingAction): per-field mapping entries, wildcard
+        field patterns supported; a missing type is 404, a missing field
+        an empty object."""
+        fields = req.path_params["fields"].split(",")
+        want_type = req.path_params.get("type")
+        names = self.node.indices_service.resolve(
+            req.path_params.get("index", "_all"))
+        out = {}
+        type_seen = False
+        for n in names:
+            svc = self.node.indices_service.indices.get(n)
+            if svc is None:
+                continue
+            mappings = {}
+            for tname, dm in svc.mapper_service.mappers.items():
+                if want_type and want_type not in ("_all", "*") \
+                        and not _wildcard_match(tname, want_type):
+                    continue
+                type_seen = True
+                fmap = {}
+                for pat in fields:
+                    for fname, fm in dm.mappers.items():
+                        if _wildcard_match(fname, pat):
+                            leaf = fname.split(".")[-1]
+                            fmap[fname] = {"full_name": fname,
+                                           "mapping": {leaf: fm.to_dict()}}
+                mappings[tname] = fmap
+            # an index where no requested type/field matched renders as
+            # ABSENT (the reference returns {} for a fully-missing field)
+            if any(mappings.values()):
+                out[n] = {"mappings": mappings}
+        if want_type and want_type not in ("_all", "*") and not type_seen:
+            from elasticsearch_tpu.common.errors import TypeMissingError
+            raise TypeMissingError(f"type [{want_type}] missing")
         return 200, out
 
     def get_all_mappings(self, req: RestRequest):
@@ -594,7 +662,13 @@ class Handlers:
         body = req.body or {}
         default_index = req.path_params.get("index")
         problems = []
-        for i, spec in enumerate(body.get("docs", [])):
+        docs = body.get("docs", [])
+        ids = body.get("ids", [])
+        if not docs and not ids:
+            problems.append("no documents to get")
+        if ids and not default_index:
+            problems.append("index is missing")
+        for i, spec in enumerate(docs):
             if "_id" not in spec:
                 problems.append(f"id is missing for doc {i}")
             if "_index" not in spec and not default_index:
@@ -612,14 +686,37 @@ class Handlers:
             spec = specs[i] if i < len(specs) else {}
             t = spec.get("_type") or default_t
             if not t or t == "_all":
-                continue
-            doc["_type"] = t
-            stored = self._doc_types.get((doc.get("_index"),
-                                          doc.get("_id")))
-            if doc.get("found") and stored and t != stored:
-                out["docs"][i] = {"_index": doc.get("_index"),
-                                  "_type": t, "_id": doc.get("_id"),
-                                  "found": False}
+                stored = self._doc_types.get((doc.get("_index"),
+                                              doc.get("_id")))
+                if stored:
+                    doc["_type"] = stored
+            else:
+                doc["_type"] = t
+                stored = self._doc_types.get((doc.get("_index"),
+                                              doc.get("_id")))
+                if doc.get("found") and stored and t != stored:
+                    doc = out["docs"][i] = {
+                        "_index": doc.get("_index"), "_type": t,
+                        "_id": doc.get("_id"), "found": False}
+            wanted = spec.get("fields", body.get("fields",
+                                                 req.param("fields")))
+            if wanted and doc.get("found"):
+                if isinstance(wanted, str):
+                    wanted = wanted.split(",")
+                src = doc.get("_source") or {}
+                fields = {}
+                for f in wanted:
+                    v = _source_from_path(src, f)
+                    if v is not None:
+                        fields[f] = v if isinstance(v, list) else [v]
+                doc["fields"] = fields
+                # _source suppressed by fields UNLESS explicitly requested
+                # (spec/body value or ?_source=); explicit false drops it
+                src_req = spec.get("_source",
+                                   body.get("_source",
+                                            req.param("_source")))
+                if src_req in (None, False, "false"):
+                    doc.pop("_source", None)
         return 200, out
 
     # ---- bulk -------------------------------------------------------------
