@@ -142,6 +142,9 @@ class IndexMetadata:
     # ride the replicated+persisted metadata instead, which keeps them out
     # of the document space and recovers them for free.
     percolators: dict = field(default_factory=dict)
+    # search warmers {name → {"types": [...], "source": body}} (ref:
+    # IndexWarmersMetaData cluster-state custom, core/search/warmer/)
+    warmers: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {
@@ -150,8 +153,14 @@ class IndexMetadata:
                 "number_of_replicas": str(self.number_of_replicas),
                 "uuid": self.uuid,
                 "creation_date": str(self.creation_date),
-                **{k: v for k, v in self.settings.items()
-                   if not k.startswith("index.")},
+                # stored keys are index.-prefixed; display strips the
+                # prefix under the "index" object (IndexMetaData xcontent)
+                **{(k[6:] if k.startswith("index.") else k):
+                   ("true" if v is True else "false" if v is False
+                    else str(v) if isinstance(v, (int, float)) else v)
+                   for k, v in self.settings.items()
+                   if k not in ("index.number_of_shards",
+                                "index.number_of_replicas")},
             }},
             "mappings": self.mappings,
             "aliases": self.aliases,
@@ -166,6 +175,8 @@ class IndexMetadata:
                "version": self.version}
         if self.percolators:
             out["percolators"] = self.percolators
+        if self.warmers:
+            out["warmers"] = self.warmers
         return out
 
     @staticmethod
@@ -177,7 +188,8 @@ class IndexMetadata:
             aliases=m.get("aliases", {}), state=m.get("state", "open"),
             creation_date=m.get("creation_date", 0), uuid=m.get("uuid", ""),
             version=m.get("version", 1),
-            percolators=m.get("percolators", {}))
+            percolators=m.get("percolators", {}),
+            warmers=m.get("warmers", {}))
 
 
 @dataclass(frozen=True)

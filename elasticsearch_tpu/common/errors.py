@@ -88,6 +88,13 @@ class QueryParsingError(ElasticsearchTpuError):
     error_type = "query_parsing_exception"
 
 
+class IndexClosedError(ElasticsearchTpuError):
+    """Operation explicitly targeting a closed index (ref:
+    indices/IndexClosedException.java → RestStatus.FORBIDDEN)."""
+    status = 403
+    error_type = "index_closed_exception"
+
+
 class ShardNotFoundError(ElasticsearchTpuError):
     status = 404
     error_type = "shard_not_found_exception"

@@ -44,6 +44,24 @@ def _normalize_index_settings(raw: dict) -> dict:
             for k, v in flat.items()}
 
 
+def normalize_alias(spec: dict | None) -> dict:
+    """Alias body → stored AliasMetaData shape; `routing` expands to both
+    index_routing and search_routing (ref: AliasMetaData.Builder)."""
+    spec = spec or {}
+    meta = {}
+    if spec.get("filter") is not None:
+        meta["filter"] = spec["filter"]
+    ir = spec.get("index_routing", spec.get("indexRouting",
+                                            spec.get("routing")))
+    sr = spec.get("search_routing", spec.get("searchRouting",
+                                             spec.get("routing")))
+    if ir is not None:
+        meta["index_routing"] = str(ir)
+    if sr is not None:
+        meta["search_routing"] = str(sr)
+    return meta
+
+
 class ShardNotLocalError(Exception):
     """The target shard copy lives on another node — the action layer must
     route the operation over the transport."""
@@ -254,6 +272,9 @@ class IndicesService:
         # application).
         self.prepare_shard = None
         self._recovering: set[str] = set()
+        # completed per-shard recovery records (ref: the indices recovery
+        # API, core/action/admin/indices/recovery/ + RestRecoveryAction)
+        self.recovery_records: list[dict] = []
         self._recovery_executor = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix=f"recovery[{node_id[:8]}]")
         cluster_service.add_listener(self._cluster_changed)
@@ -325,6 +346,7 @@ class IndicesService:
         """Recovery-executor body: run the peer-recovery hook, then report
         started (or failed) to the master via the Node's callbacks."""
         from elasticsearch_tpu.indices.recovery import DelayRecoveryError
+        t0 = time.time()
         try:
             if self.prepare_shard is not None:
                 self.prepare_shard(s, engine)
@@ -344,7 +366,42 @@ class IndicesService:
             return
         self._reported_started.add(s.allocation_id)
         self._recovering.discard(s.allocation_id)
+        self._record_recovery(s, engine, t0)
         self.on_shard_started(s)
+
+    def _record_recovery(self, s: ShardRouting, engine, t0: float) -> None:
+        """Append a completed-recovery record (the `_recovery` / cat.recovery
+        data source; ref: RecoveryState in core/indices/recovery/)."""
+        state = self.cluster_service.state()
+        source = self.node_id
+        if not s.primary:
+            primary = next((p for p in
+                            state.routing_table.index_shards(s.index)
+                            if p.shard == s.shard and p.primary
+                            and p.node_id), None)
+            if primary is not None:
+                source = primary.node_id
+        def node_name(nid):
+            n = state.nodes.get(nid)
+            return n.name if n is not None else nid[:8]
+        files = nbytes = 0
+        try:
+            for p in engine.path.rglob("*"):
+                if p.is_file():
+                    files += 1
+                    nbytes += p.stat().st_size
+        except OSError:
+            pass
+        self.recovery_records.append({
+            "index": s.index, "shard": s.shard,
+            "time_ms": max(int((time.time() - t0) * 1000), 1),
+            "type": "store" if s.primary else "replica",
+            "stage": "done",
+            "source_host": node_name(source),
+            "target_host": node_name(self.node_id),
+            "repository": "n/a", "snapshot": "n/a",
+            "files": files, "bytes": nbytes, "translog": 0,
+        })
 
     def _retry_reconcile(self) -> None:
         try:
@@ -414,8 +471,9 @@ class IndicesService:
                 number_of_replicas=sett.get_as_int(
                     "index.number_of_replicas", 0),
                 settings=settings, mappings=mappings,
-                aliases={a: (v or {})
+                aliases={a: normalize_alias(v)
                          for a, v in body.get("aliases", {}).items()},
+                warmers=dict(body.get("warmers", {})),
                 creation_date=int(time.time() * 1000),
                 uuid=uuid.uuid4().hex[:22])
             new = state.with_(
@@ -540,6 +598,43 @@ class IndicesService:
         self.cluster_service.submit_and_wait(
             f"delete-percolator [{index}/{qid}]", update)
 
+    def put_warmer(self, index: str, name: str, warmer: dict) -> None:
+        """Register a search warmer (ref: IndexWarmersMetaData +
+        TransportPutWarmerAction — the warmer source runs against every
+        fresh searcher; here registration is the metadata contract, and
+        warming happens when a refresh swaps in a new device reader)."""
+        self._master_op(
+            "put-warmer", {"index": index, "name": name, "body": warmer},
+            lambda: self._put_warmer_local(index, name, warmer))
+
+    def _put_warmer_local(self, index: str, name: str, warmer: dict) -> None:
+        def update(state: ClusterState) -> ClusterState:
+            if index not in state.indices:
+                raise IndexNotFoundError(index)
+            meta = state.indices[index]
+            new_meta = replace(meta, warmers={**meta.warmers, name: warmer},
+                               version=meta.version + 1)
+            return state.with_(indices={**state.indices, index: new_meta})
+        self.cluster_service.submit_and_wait(
+            f"put-warmer [{index}/{name}]", update)
+
+    def delete_warmers(self, index: str, names: set[str]) -> None:
+        self._master_op(
+            "delete-warmer", {"index": index, "names": sorted(names)},
+            lambda: self._delete_warmers_local(index, names))
+
+    def _delete_warmers_local(self, index: str, names) -> None:
+        names = set(names)
+        def update(state: ClusterState) -> ClusterState:
+            if index not in state.indices:
+                raise IndexNotFoundError(index)
+            meta = state.indices[index]
+            keep = {k: v for k, v in meta.warmers.items() if k not in names}
+            new_meta = replace(meta, warmers=keep, version=meta.version + 1)
+            return state.with_(indices={**state.indices, index: new_meta})
+        self.cluster_service.submit_and_wait(
+            f"delete-warmer [{index}]", update)
+
     def put_alias(self, index: str, alias: str, body: dict | None = None):
         self._master_op(
             "put-alias", {"index": index, "alias": alias, "body": body},
@@ -572,6 +667,27 @@ class IndicesService:
             return state.with_(indices={**state.indices, index: new_meta})
         self.cluster_service.submit_and_wait(f"delete-alias [{alias}]",
                                              update)
+
+    def set_index_state(self, index: str, new_state: str):
+        """open/close an index (ref: MetaDataIndexStateService — state
+        flips in IndexMetaData; closed indices keep their files but serve
+        no reads/writes)."""
+        self._master_op(
+            "index-state", {"index": index, "state": new_state},
+            lambda: self._set_index_state_local(index, new_state))
+
+    def _set_index_state_local(self, index: str, new_state: str):
+        def update(state: ClusterState) -> ClusterState:
+            if index not in state.indices:
+                raise IndexNotFoundError(index)
+            meta = state.indices[index]
+            if meta.state == new_state:
+                return state
+            new_meta = IndexMetadata(**{**meta.__dict__,
+                                        "state": new_state})
+            return state.with_(indices={**state.indices, index: new_meta})
+        self.cluster_service.submit_and_wait(
+            f"{new_state}-index [{index}]", update)
 
     # ---- resolution -------------------------------------------------------
 

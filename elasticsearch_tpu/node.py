@@ -59,6 +59,17 @@ class Node:
         hub = self._hub or LocalTransportHub()
         attrs = (("data", self.settings.get("node.data", "true")),
                  ("master", self.settings.get("node.master", "true")))
+        # every other `node.<key>` setting becomes a custom node attribute
+        # (ref: DiscoveryNode attributes from `node.` settings,
+        # core/cluster/node/DiscoveryNodeService.java)
+        reserved = {"data", "master", "name", "local", "mode", "client",
+                    "max_local_storage_nodes", "portsfile"}
+        extra = tuple(
+            (k[len("node."):], str(v))
+            for k, v in sorted(self.settings.as_dict().items())
+            if k.startswith("node.") and k[len("node."):] not in reserved
+            and "." not in k[len("node."):])
+        attrs = attrs + extra
         from elasticsearch_tpu.common.threadpool import ThreadPool
         self.thread_pool = ThreadPool(self.settings)
         self.transport_service = TransportService(
@@ -296,6 +307,12 @@ class Node:
                                                 req.get("body")),
             "delete-alias": lambda: isvc.delete_alias(req["index"],
                                                       req["alias"]),
+            "index-state": lambda: isvc.set_index_state(req["index"],
+                                                        req["state"]),
+            "put-warmer": lambda: isvc.put_warmer(req["index"], req["name"],
+                                                  req["body"]),
+            "delete-warmer": lambda: isvc.delete_warmers(
+                req["index"], set(req["names"])),
             "put-template": lambda: self.put_template(req["name"],
                                                       req["body"]),
             "delete-template": lambda: self.delete_template(req["name"]),

@@ -97,7 +97,8 @@ class RestController:
         parsed = urlparse(uri)
         qs = {k: v[-1] for k, v in parse_qs(parsed.query,
                                             keep_blank_values=True).items()}
-        handler, path_params = self.resolve(method, parsed.path)
+        from urllib.parse import unquote
+        handler, path_params = self.resolve(method, unquote(parsed.path))
         if handler is None and method == "HEAD":
             handler, path_params = self.resolve("GET", parsed.path)
         if handler is None:

@@ -8,12 +8,17 @@ response shape. The `_cat` family renders text tables
 
 from __future__ import annotations
 
+import fnmatch
 import json
+import os
 import time
 
 from elasticsearch_tpu import __version__
-from elasticsearch_tpu.common.errors import IndexNotFoundError
+from elasticsearch_tpu.common.errors import (IllegalArgumentError,
+                                             IndexNotFoundError)
 from elasticsearch_tpu.rest.controller import RestController, RestRequest
+from elasticsearch_tpu.rest.table import (CatTable, Col, fmt_bytes,
+                                          fmt_epoch_iso)
 
 
 def register_all(rc: RestController, node) -> None:
@@ -56,14 +61,42 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/_mapping/{type}/field/{fields}", h.get_field_mapping)
     r("GET", "/{index}/_mapping/{type}/field/{fields}",
       h.get_field_mapping)
+    r("GET", "/_settings", h.get_settings)
+    r("GET", "/_settings/{name}", h.get_settings)
     r("GET", "/{index}/_settings", h.get_settings)
+    r("GET", "/{index}/_settings/{name}", h.get_settings)
     r("PUT", "/{index}/_settings", h.put_settings)
+    r("PUT", "/_settings", h.put_settings)
     # aliases
     r("POST", "/_aliases", h.update_aliases)
-    r("PUT", "/{index}/_alias/{name}", h.put_alias)
-    r("DELETE", "/{index}/_alias/{name}", h.delete_alias)
+    for alias_seg in ("_alias", "_aliases"):
+        r("PUT", f"/{{index}}/{alias_seg}/{{name}}", h.put_alias)
+        r("POST", f"/{{index}}/{alias_seg}/{{name}}", h.put_alias)
+        r("DELETE", f"/{{index}}/{alias_seg}/{{name}}", h.delete_alias)
     r("GET", "/_alias", h.get_aliases)
+    r("GET", "/_aliases", h.get_aliases)
+    r("GET", "/_alias/{name}", h.get_aliases)
+    r("GET", "/_aliases/{name}", h.get_aliases)
     r("GET", "/{index}/_alias", h.get_aliases)
+    r("GET", "/{index}/_aliases", h.get_aliases)
+    r("GET", "/{index}/_alias/{name}", h.get_aliases)
+    r("GET", "/{index}/_aliases/{name}", h.get_aliases)
+    r("HEAD", "/_alias/{name}", h.head_alias)
+    r("HEAD", "/{index}/_alias/{name}", h.head_alias)
+    # warmers
+    for wseg in ("_warmer", "_warmers"):
+        for m in ("PUT", "POST"):
+            r(m, f"/{wseg}/{{name}}", h.put_warmer)
+            r(m, f"/{{index}}/{wseg}/{{name}}", h.put_warmer)
+            r(m, f"/{{index}}/{{type}}/{wseg}/{{name}}", h.put_warmer)
+        r("DELETE", f"/{{index}}/{wseg}/{{name}}", h.delete_warmer)
+    r("GET", "/_warmer", h.get_warmer)
+    r("GET", "/_warmer/{name}", h.get_warmer)
+    r("GET", "/{index}/_warmer", h.get_warmer)
+    r("GET", "/{index}/_warmer/{name}", h.get_warmer)
+    r("GET", "/{index}/{type}/_warmer/{name}", h.get_warmer)
+    # indices.get feature paths (GET /{index}/_settings,_mappings…)
+    r("GET", "/{index}/{features}", h.get_index_features)
     # templates
     r("PUT", "/_template/{name}", h.put_template)
     r("GET", "/_template/{name}", h.get_template)
@@ -192,17 +225,26 @@ def register_all(rc: RestController, node) -> None:
     # _cat
     r("GET", "/_cat", h.cat_help)
     r("GET", "/_cat/indices", h.cat_indices)
+    r("GET", "/_cat/indices/{index}", h.cat_indices)
     r("GET", "/_cat/health", h.cat_health)
     r("GET", "/_cat/count", h.cat_count)
     r("GET", "/_cat/count/{index}", h.cat_count)
     r("GET", "/_cat/shards", h.cat_shards)
+    r("GET", "/_cat/shards/{index}", h.cat_shards)
     r("GET", "/_cat/nodes", h.cat_nodes)
     r("GET", "/_cat/master", h.cat_master)
     r("GET", "/_cat/aliases", h.cat_aliases)
+    r("GET", "/_cat/aliases/{name}", h.cat_aliases)
     r("GET", "/_cat/allocation", h.cat_allocation)
+    r("GET", "/_cat/allocation/{node_id}", h.cat_allocation)
     r("GET", "/_cat/recovery", h.cat_recovery)
+    r("GET", "/_cat/recovery/{index}", h.cat_recovery)
     r("GET", "/_cat/segments", h.cat_segments)
+    r("GET", "/_cat/segments/{index}", h.cat_segments)
     r("GET", "/_cat/thread_pool", h.cat_thread_pool)
+    r("GET", "/_cat/fielddata", h.cat_fielddata)
+    r("GET", "/_cat/fielddata/{fields}", h.cat_fielddata)
+    r("GET", "/_cat/plugins", h.cat_plugins)
     r("GET", "/_cat/snapshots/{repo}", h.cat_snapshots)
     r("GET", "/_cat/templates", h.cat_templates)
     r("GET", "/_cat/pending_tasks", h.cat_pending_tasks)
@@ -292,9 +334,19 @@ class Handlers:
         return 200, {"acknowledged": True}
 
     def get_index(self, req: RestRequest):
-        names = self.node.indices_service.resolve(req.path_params["index"])
+        names = self._resolve_expanded(req, req.path_params["index"])
         state = self.node.cluster_service.state()
-        return 200, {n: state.indices[n].to_dict() for n in names}
+        human = req.param_as_bool("human")
+        out = {}
+        for n in names:
+            meta = state.indices[n]
+            entry = meta.to_dict()
+            entry["warmers"] = meta.warmers
+            if human:
+                entry["settings"]["index"]["creation_date_string"] = \
+                    fmt_epoch_iso(meta.creation_date)
+            out[n] = entry
+        return 200, out
 
     def head_index(self, req: RestRequest):
         if self.node.indices_service.has_index(req.path_params["index"]):
@@ -321,9 +373,13 @@ class Handlers:
             req.path_params["index"], max_seg)
 
     def open_index(self, req: RestRequest):
+        for n in self.node.indices_service.resolve(req.path_params["index"]):
+            self.node.indices_service.set_index_state(n, "open")
         return 200, {"acknowledged": True}
 
     def close_index(self, req: RestRequest):
+        for n in self.node.indices_service.resolve(req.path_params["index"]):
+            self.node.indices_service.set_index_state(n, "close")
         return 200, {"acknowledged": True}
 
     # ---- mappings / settings ----------------------------------------------
@@ -341,25 +397,80 @@ class Handlers:
         req.path_params = {**req.path_params, "index": "_all"}
         return self.put_mapping(req)
 
+    def _resolve_expanded(self, req: RestRequest, expr: str) -> list[str]:
+        """Index resolution honouring the IndicesOptions params
+        `expand_wildcards` (default open), `ignore_unavailable`, and
+        `allow_no_indices` (ref: IndicesOptions.fromRequest +
+        IndexNameExpressionResolver). Wildcard expansion filters by index
+        state; explicitly named indices always resolve (or 404 unless
+        ignore_unavailable)."""
+        state = self.node.cluster_service.state()
+        states = set()
+        for p in req.param("expand_wildcards", "open").split(","):
+            if p == "all":
+                states |= {"open", "close"}
+            elif p == "open":
+                states.add("open")
+            elif p == "closed":
+                states.add("close")
+        ignore_unavailable = req.param_as_bool("ignore_unavailable")
+        allow_no = req.param_as_bool("allow_no_indices", True)
+        out: list[str] = []
+        for part in (p.strip() for p in expr.split(",")):
+            if part in ("_all", "*", "") or "*" in part or "?" in part:
+                matched = [
+                    n for n, m in state.indices.items()
+                    if m.state in states
+                    and (part in ("_all", "*", "")
+                         or fnmatch.fnmatch(n, part))]
+                if not matched and not allow_no:
+                    raise IndexNotFoundError(part or "_all")
+                out.extend(sorted(matched))
+                continue
+            if part in state.indices:
+                out.append(part)
+                continue
+            via_alias = [n for n, m in state.indices.items()
+                         if part in m.aliases]
+            if via_alias:
+                out.extend(via_alias)
+            elif not ignore_unavailable:
+                raise IndexNotFoundError(part)
+        seen: set[str] = set()
+        return [n for n in out if not (n in seen or seen.add(n))]
+
+    def _index_mappings(self, name: str) -> dict:
+        """Live mappings when a local service exists (captures dynamic
+        updates), cluster-state metadata otherwise (closed indices)."""
+        svc = self.node.indices_service.indices.get(name)
+        if svc is not None:
+            return svc.mapper_service.mapping_dict()
+        meta = self.node.cluster_service.state().indices.get(name)
+        return dict(meta.mappings) if meta else {}
+
     def get_mapping(self, req: RestRequest):
         want_type = req.path_params.get("type")
+        had_index = "index" in req.path_params
+        names = self._resolve_expanded(req,
+                                       req.path_params.get("index", "_all"))
+        pats = None
+        if want_type and want_type != "_all":
+            pats = [p for p in want_type.split(",") if p]
         out = {}
-        for n in self.node.indices_service.resolve(req.path_params["index"]):
-            svc = self.node.indices_service.index(n)
-            md = svc.mapper_service.mapping_dict()
-            if want_type and want_type != "_all":
-                md = {t: m for t, m in md.items() if t == want_type}
+        for n in names:
+            md = self._index_mappings(n)
+            if pats is not None:
+                md = {t: m for t, m in md.items()
+                      if any(fnmatch.fnmatch(t, p) for p in pats)}
                 if not md:
                     continue
             out[n] = {"mappings": md}
-        if want_type and want_type != "_all" and not out:
-            from elasticsearch_tpu.common.errors import \
-                ElasticsearchTpuError
-
-            class _TypeMissing(ElasticsearchTpuError):
-                status = 404
-                error_type = "type_missing_exception"
-            raise _TypeMissing(f"type [{want_type}] missing")
+        if not out:
+            # ref RestGetMappingAction empty-result dispatch: explicit
+            # index+type → 200 {}, bare type → 404 type_missing
+            if pats is not None and not had_index:
+                from elasticsearch_tpu.common.errors import TypeMissingError
+                raise TypeMissingError(f"type [{want_type}] missing")
         return 200, out
 
     def get_field_mapping(self, req: RestRequest):
@@ -401,16 +512,36 @@ class Handlers:
         return 200, out
 
     def get_all_mappings(self, req: RestRequest):
-        out = {}
-        for n, svc in self.node.indices_service.indices.items():
-            out[n] = {"mappings": svc.mapper_service.mapping_dict()}
-        return 200, out
+        return self.get_mapping(req)
 
     def get_settings(self, req: RestRequest):
         state = self.node.cluster_service.state()
+        human = req.param_as_bool("human")
+        name_expr = req.path_params.get("name")
+        pats = None
+        if name_expr and name_expr not in ("_all", "*"):
+            pats = [p for p in name_expr.split(",") if p]
         out = {}
-        for n in self.node.indices_service.resolve(req.path_params["index"]):
-            out[n] = {"settings": state.indices[n].to_dict()["settings"]}
+        expr = req.path_params.get("index", "_all")
+        for n in self._resolve_expanded(req, expr):
+            meta = state.indices[n]
+            settings = meta.to_dict()["settings"]
+            settings["index"].setdefault("version", {"created": "2040099"})
+            if human:
+                settings["index"]["creation_date_string"] = \
+                    fmt_epoch_iso(meta.creation_date)
+                settings["index"]["version"]["created_string"] = __version__
+            if pats is not None:
+                # filter by flattened setting name (RestGetSettingsAction
+                # `name` patterns, e.g. index.number_of_shards or index.*)
+                idx = {
+                    k: v for k, v in settings["index"].items()
+                    if not isinstance(v, dict)
+                    and any(fnmatch.fnmatch(f"index.{k}", p) for p in pats)}
+                settings = {"index": idx}
+                if not idx:
+                    continue
+            out[n] = {"settings": settings}
         return 200, out
 
     def put_settings(self, req: RestRequest):
@@ -425,38 +556,195 @@ class Handlers:
 
     # ---- aliases ----------------------------------------------------------
 
+    @staticmethod
+    def _alias_meta(spec: dict | None) -> dict:
+        from elasticsearch_tpu.indices.service import normalize_alias
+        return normalize_alias(spec)
+
     def update_aliases(self, req: RestRequest):
-        for action in (req.body or {}).get("actions", []):
+        actions = (req.body or {}).get("actions", [])
+        if not actions:
+            raise IllegalArgumentError("No action specified")
+        for action in actions:
             (verb, spec), = action.items()
             indices = spec.get("indices", [spec.get("index")])
+            if isinstance(indices, str):
+                indices = [indices]
             aliases = spec.get("aliases", [spec.get("alias")])
             if isinstance(aliases, str):
                 aliases = [aliases]
-            for idx in indices:
-                for alias in aliases:
-                    if verb == "add":
-                        self.node.indices_service.put_alias(
-                            idx, alias, {k: v for k, v in spec.items()
-                                         if k in ("filter", "routing")})
-                    elif verb == "remove":
-                        self.node.indices_service.delete_alias(idx, alias)
+            for idx_expr in indices:
+                if idx_expr is None:
+                    raise IllegalArgumentError(
+                        f"[{verb}] requires an [index]")
+                for idx in self.node.indices_service.resolve(idx_expr):
+                    for alias in aliases:
+                        if verb == "add":
+                            self.node.indices_service.put_alias(
+                                idx, alias, self._alias_meta(spec))
+                        elif verb == "remove":
+                            self.node.indices_service.delete_alias(idx, alias)
         return 200, {"acknowledged": True}
 
     def put_alias(self, req: RestRequest):
-        self.node.indices_service.put_alias(
-            req.path_params["index"], req.path_params["name"], req.body)
+        expr = req.path_params.get("index") or req.param("index") or "_all"
+        names = self.node.indices_service.resolve(expr)
+        if not names:
+            raise IndexNotFoundError(expr)
+        for idx in names:
+            self.node.indices_service.put_alias(
+                idx, req.path_params["name"], self._alias_meta(req.body))
         return 200, {"acknowledged": True}
 
     def delete_alias(self, req: RestRequest):
-        self.node.indices_service.delete_alias(
-            req.path_params["index"], req.path_params["name"])
+        state = self.node.cluster_service.state()
+        expr = req.path_params.get("index") or "_all"
+        names = self.node.indices_service.resolve(expr)
+        if not names:
+            raise IndexNotFoundError(expr)
+        pats = [p for p in req.path_params["name"].split(",") if p]
+        removed = False
+        for idx in names:
+            have = state.indices[idx].aliases
+            for alias in list(have):
+                if any(p in ("_all", "*") or fnmatch.fnmatch(alias, p)
+                       for p in pats):
+                    self.node.indices_service.delete_alias(idx, alias)
+                    removed = True
+        if not removed:
+            return 404, {"error": f"aliases [{req.path_params['name']}] "
+                                  f"missing", "status": 404}
         return 200, {"acknowledged": True}
 
-    def get_aliases(self, req: RestRequest):
+    def _find_aliases(self, req: RestRequest):
+        """→ (had_index_param, name_param, {index: {alias: meta}})
+        matching MetaData.findAliases: with a name filter only indices
+        holding a match appear; without one every resolved index appears."""
         state = self.node.cluster_service.state()
-        names = self.node.indices_service.resolve(
-            req.path_params.get("index", "_all"))
-        return 200, {n: {"aliases": state.indices[n].aliases} for n in names}
+        index_expr = req.path_params.get("index") or req.param("index")
+        name_expr = req.path_params.get("name") or req.param("name")
+        names = self.node.indices_service.resolve(index_expr or "_all")
+        pats = None
+        if name_expr and name_expr not in ("_all", "*"):
+            pats = [p for p in name_expr.split(",") if p]
+        out = {}
+        for n in names:
+            have = state.indices[n].aliases
+            if pats is None:
+                out[n] = dict(have)
+            else:
+                hit = {a: v for a, v in have.items()
+                       if any(fnmatch.fnmatch(a, p) for p in pats)}
+                if hit:
+                    out[n] = hit
+        return index_expr is not None, name_expr, out
+
+    def get_aliases(self, req: RestRequest):
+        had_index, name_expr, found = self._find_aliases(req)
+        if "/_aliases" in req.path:
+            # the deprecated /_aliases API always lists every resolved
+            # index, empty alias maps included, and never 404s (ref:
+            # RestGetIndicesAliasesAction)
+            names = self.node.indices_service.resolve(
+                req.path_params.get("index") or req.param("index") or "_all")
+            return 200, {n: {"aliases": found.get(n, {})} for n in names}
+        if not any(found.values()) and name_expr and \
+                name_expr not in ("_all", "*"):
+            # ref RestGetAliasesAction: empty body if indices were
+            # specified; 404 "alias missing" otherwise
+            if had_index:
+                return 200, {}
+            return 404, {"error": f"alias [{name_expr}] missing",
+                         "status": 404}
+        return 200, {n: {"aliases": v} for n, v in found.items()}
+
+    def head_alias(self, req: RestRequest):
+        _, _, found = self._find_aliases(req)
+        return (200, "") if any(found.values()) else (404, "")
+
+    # ---- warmers (ref: core/search/warmer/IndexWarmersMetaData +
+    # rest/action/admin/indices/warmer/) --------------------------------------
+
+    def put_warmer(self, req: RestRequest):
+        name = req.path_params["name"]
+        if not name:
+            raise IllegalArgumentError("missing warmer name")
+        expr = req.path_params.get("index") or req.param("index") or "_all"
+        names = self.node.indices_service.resolve(expr)
+        types = [t for t in
+                 (req.path_params.get("type") or "").split(",") if t]
+        warmer = {"types": types, "source": req.body or {}}
+        for idx in names:
+            self.node.indices_service.put_warmer(idx, name, warmer)
+        return 200, {"acknowledged": True}
+
+    def delete_warmer(self, req: RestRequest):
+        state = self.node.cluster_service.state()
+        expr = req.path_params.get("index")
+        if not expr:
+            raise IllegalArgumentError(
+                "index is missing for delete warmer")
+        names = self.node.indices_service.resolve(expr)
+        pats = [p for p in req.path_params["name"].split(",") if p]
+        removed = False
+        for idx in names:
+            have = state.indices[idx].warmers
+            hit = {w for w in have
+                   if any(p in ("_all", "*") or fnmatch.fnmatch(w, p)
+                          for p in pats)}
+            if hit:
+                self.node.indices_service.delete_warmers(idx, hit)
+                removed = True
+        if not removed:
+            return 404, {"error": f"warmers [{req.path_params['name']}] "
+                                  f"missing", "status": 404}
+        return 200, {"acknowledged": True}
+
+    def get_warmer(self, req: RestRequest):
+        state = self.node.cluster_service.state()
+        expr = req.path_params.get("index") or req.param("index") or "_all"
+        names = self.node.indices_service.resolve(expr)
+        name_expr = req.path_params.get("name") or req.param("name")
+        pats = None
+        if name_expr and name_expr not in ("_all", "*"):
+            pats = [p for p in name_expr.split(",") if p]
+        out = {}
+        for n in names:
+            have = state.indices[n].warmers
+            if pats is None:
+                # no name filter → every resolved index appears, empty
+                # warmer maps included
+                out[n] = {"warmers": dict(have)}
+                continue
+            have = {w: v for w, v in have.items()
+                    if any(fnmatch.fnmatch(w, p) for p in pats)}
+            if have:
+                out[n] = {"warmers": have}
+        return 200, out
+
+    def get_index_features(self, req: RestRequest):
+        """GET /{index}/{features} — the indices.get API with a feature
+        list (_settings,_mappings,_warmers,_aliases; ref:
+        RestGetIndicesAction)."""
+        feats = (req.path_params.get("features")
+                 or req.path_params.get("feature")
+                 or req.path_params.get("type") or "").split(",")
+        if not all(f.startswith("_") for f in feats):
+            return 400, {"error": f"no handler found for uri [{req.path}] "
+                                  f"and method [GET]"}
+        keymap = {"_settings": "settings", "_mappings": "mappings",
+                  "_mapping": "mappings", "_warmers": "warmers",
+                  "_warmer": "warmers", "_aliases": "aliases",
+                  "_alias": "aliases"}
+        keys = [keymap[f] for f in feats if f in keymap]
+        if not keys:
+            return 400, {"error": f"no handler found for uri [{req.path}] "
+                                  f"and method [GET]"}
+        status, full = self.get_index(req)
+        if status != 200:
+            return status, full
+        return 200, {n: {k: v for k, v in entry.items() if k in keys}
+                     for n, entry in full.items()}
 
     # ---- templates --------------------------------------------------------
 
@@ -1330,166 +1618,797 @@ class Handlers:
                                          req.path_params.get("metric"), req)
 
     # ---- _cat --------------------------------------------------------------
+    #
+    # Reference: core/rest/action/cat/Rest*CatAction.java — each action
+    # declares its Table columns (getTableWithHeader) and RestTable renders
+    # help / h= / v= / alignment. Column sets below mirror the 2.x actions.
 
-    def _cat_table(self, req: RestRequest, headers: list[str],
-                   rows: list[list]) -> tuple[int, str]:
-        verbose = req.param_as_bool("v")
-        widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
-                  if rows else len(str(h)) for i, h in enumerate(headers)]
-        lines = []
-        if verbose:
-            lines.append(" ".join(str(h).ljust(w)
-                                  for h, w in zip(headers, widths)).rstrip())
-        for r in rows:
-            lines.append(" ".join(str(c).ljust(w)
-                                  for c, w in zip(r, widths)).rstrip())
-        return 200, "\n".join(lines) + "\n"
+    def _node_ip(self, host: str | None = None) -> str:
+        host = host or "127.0.0.1"
+        import re as _re
+        return host if _re.fullmatch(r"(\d{1,3}\.){3}\d{1,3}", host) \
+            else "127.0.0.1"
+
+    def _node_host(self, n=None) -> str:
+        host = n.address.host if n is not None else "local"
+        return host if host != "local" else "127.0.0.1"
+
+    def _index_health(self, state, name: str) -> str:
+        copies = list(state.routing_table.index_shards(name))
+        if all(s.active for s in copies):
+            return "green"
+        primaries = [s for s in copies if s.primary]
+        return "yellow" if all(s.active for s in primaries) else "red"
+
+    def _store_bytes(self, engine) -> int:
+        try:
+            return sum(p.stat().st_size for p in engine.path.rglob("*")
+                       if p.is_file())
+        except OSError:
+            return 0
+
+    @staticmethod
+    def _bytes_fmt(req: RestRequest):
+        """`bytes=` cat param: raw numeric rendering in the given unit
+        (ref: RestTable.renderValue ByteSizeValue handling)."""
+        unit = req.param("bytes")
+        divisors = {"b": 1, "k": 1 << 10, "kb": 1 << 10, "m": 1 << 20,
+                    "mb": 1 << 20, "g": 1 << 30, "gb": 1 << 30}
+        if unit in divisors:
+            d = divisors[unit]
+            return lambda n: str(int(n) // d)
+        return fmt_bytes
+
+    def _node_matches(self, state, nid: str, n, expr: str) -> bool:
+        """Node-id expression resolution (ref: DiscoveryNodes.resolveNodes —
+        _local/_master/_all, ids, names, wildcards, comma lists)."""
+        for part in expr.split(","):
+            part = part.strip()
+            if part in ("_all", "*"):
+                return True
+            if part == "_local" and nid == self.node.node_id:
+                return True
+            if part == "_master" and nid == state.master_node_id:
+                return True
+            if part in (nid, n.name):
+                return True
+            if ("*" in part or "?" in part) and (
+                    fnmatch.fnmatch(nid, part) or
+                    fnmatch.fnmatch(n.name, part)):
+                return True
+        return False
+
+    def _closed_check(self, expr: str | None):
+        """Explicitly targeting a closed index is FORBIDDEN (ref:
+        indices/IndexClosedException.java, RestStatus.FORBIDDEN)."""
+        from elasticsearch_tpu.common.errors import IndexClosedError
+        if not expr or expr in ("_all", "*"):
+            return
+        state = self.node.cluster_service.state()
+        for part in expr.split(","):
+            meta = state.indices.get(part)
+            if meta is not None and meta.state == "close":
+                raise IndexClosedError(part)
 
     def cat_help(self, req: RestRequest):
-        paths = ["/_cat/indices", "/_cat/health", "/_cat/count",
-                 "/_cat/shards", "/_cat/nodes", "/_cat/master",
-                 "/_cat/aliases", "/_cat/allocation", "/_cat/recovery",
-                 "/_cat/segments", "/_cat/thread_pool",
+        paths = ["/_cat/aliases", "/_cat/allocation", "/_cat/count",
+                 "/_cat/fielddata", "/_cat/health", "/_cat/indices",
+                 "/_cat/master", "/_cat/nodeattrs", "/_cat/nodes",
+                 "/_cat/pending_tasks", "/_cat/plugins", "/_cat/recovery",
+                 "/_cat/segments", "/_cat/shards",
                  "/_cat/snapshots/{repo}", "/_cat/templates",
-                 "/_cat/pending_tasks", "/_cat/nodeattrs"]
+                 "/_cat/thread_pool"]
         return 200, "=^.^=\n" + "\n".join(paths) + "\n"
 
-    def cat_indices(self, req: RestRequest):
+    def cat_aliases(self, req: RestRequest):
         state = self.node.cluster_service.state()
-        rows = []
-        for n, svc in sorted(self.node.indices_service.indices.items()):
-            meta = state.indices[n]
-            health = "green" if meta.number_of_replicas == 0 else "yellow"
-            rows.append([health, "open", n, meta.uuid,
-                         meta.number_of_shards, meta.number_of_replicas,
-                         svc.num_docs(), 0, "0b", "0b"])
-        return self._cat_table(req, ["health", "status", "index", "uuid",
-                                     "pri", "rep", "docs.count", "docs.deleted",
-                                     "store.size", "pri.store.size"], rows)
+        t = CatTable([
+            Col("alias", ("a",), "alias name"),
+            Col("index", ("i", "idx"), "index the alias points to"),
+            Col("filter", ("f", "fi"), "filter"),
+            Col("routing.index", ("ri", "routingIndex"), "index routing"),
+            Col("routing.search", ("rs", "routingSearch"), "search routing"),
+        ])
+        name = req.path_params.get("name")
+        pats = [p for p in name.split(",")] if name else None
+        for n, meta in sorted(state.indices.items()):
+            for alias, spec in sorted(meta.aliases.items()):
+                if pats and not any(fnmatch.fnmatch(alias, p) for p in pats):
+                    continue
+                spec = spec or {}
+                t.add(**{"alias": alias, "index": n,
+                         "filter": "*" if spec.get("filter") else "-",
+                         "routing.index": spec.get("index_routing", "-"),
+                         "routing.search": spec.get("search_routing", "-")})
+        return t.render(req)
 
-    def cat_health(self, req: RestRequest):
-        h = self.node.cluster_service.state().health()
-        ts = int(time.time())
-        rows = [[ts, time.strftime("%H:%M:%S", time.gmtime(ts)),
-                 h["cluster_name"], h["status"], h["number_of_nodes"],
-                 h["number_of_data_nodes"], h["active_shards"],
-                 h["active_primary_shards"], h["relocating_shards"],
-                 h["initializing_shards"], h["unassigned_shards"]]]
-        return self._cat_table(req, ["epoch", "timestamp", "cluster", "status",
-                                     "node.total", "node.data", "shards", "pri",
-                                     "relo", "init", "unassign"], rows)
+    def cat_allocation(self, req: RestRequest):
+        import shutil as _sh
+        state = self.node.cluster_service.state()
+        target = req.path_params.get("node_id")
+        per_node: dict[str, int] = {nid: 0 for nid in state.nodes}
+        for s in state.routing_table.shards:
+            if s.node_id in per_node:
+                per_node[s.node_id] += 1
+        try:
+            du = _sh.disk_usage(str(self.node.data_path))
+        except OSError:
+            du = None
+        t = CatTable([
+            Col("shards", desc="number of shards on node", right=True),
+            Col("disk.indices", ("di",), "disk used by ES indices",
+                right=True),
+            Col("disk.used", ("du",), "disk used (total)", right=True),
+            Col("disk.avail", ("da",), "disk available", right=True),
+            Col("disk.total", ("dt",), "total capacity", right=True),
+            Col("disk.percent", ("dp",), "percent disk used", right=True),
+            Col("host", ("h",), "host of node"),
+            Col("ip", desc="ip of node"),
+            Col("node", ("n",), "name of node"),
+        ])
+        fmt = self._bytes_fmt(req)
+        indices_bytes = sum(
+            self._store_bytes(e)
+            for svc in self.node.indices_service.indices.values()
+            for e in svc.engines.values())
+        for nid, n in sorted(state.nodes.items(), key=lambda kv: kv[1].name):
+            if target and not self._node_matches(state, nid, n, target):
+                continue
+            t.add(**{"shards": per_node[nid],
+                     "disk.indices": fmt(indices_bytes),
+                     "disk.used": fmt(du.used) if du else "",
+                     "disk.avail": fmt(du.free) if du else "",
+                     "disk.total": fmt(du.total) if du else "",
+                     "disk.percent":
+                         int(100 * du.used / du.total) if du else "",
+                     "host": self._node_host(n),
+                     "ip": self._node_ip(),
+                     "node": n.name})
+        unassigned = sum(1 for s in state.routing_table.shards
+                         if not s.assigned)
+        if unassigned and not target:
+            t.add(shards=unassigned, node="UNASSIGNED")
+        return t.render(req)
 
     def cat_count(self, req: RestRequest):
         expr = req.path_params.get("index", "_all")
         count = self.node.count(expr, None)["count"] if \
             self.node.indices_service.indices else 0
         ts = int(time.time())
-        return self._cat_table(req, ["epoch", "timestamp", "count"],
-                               [[ts, time.strftime("%H:%M:%S", time.gmtime(ts)),
-                                 count]])
+        # no text-align attrs in RestCountAction — all columns left-aligned
+        t = CatTable([
+            Col("epoch", ("t", "time"), "seconds since 1970-01-01 00:00:00"),
+            Col("timestamp", ("ts", "hms"), "time in HH:MM:SS"),
+            Col("count", ("dc", "docs.count", "docsCount"),
+                "the document count"),
+        ])
+        t.add(epoch=ts, timestamp=time.strftime("%H:%M:%S", time.gmtime(ts)),
+              count=count)
+        return t.render(req)
 
-    def cat_shards(self, req: RestRequest):
+    def cat_fielddata(self, req: RestRequest):
+        per_field: dict[str, int] = {}
+        for svc in self.node.indices_service.indices.values():
+            for engine in svc.engines.values():
+                reader = getattr(engine, "_device_reader_cache", None)
+                if reader is None:
+                    continue
+                for seg in reader.segments:
+                    for group in (seg.text, seg.keyword, seg.numeric,
+                                  seg.vector, seg.geo):
+                        for fname, df in group.items():
+                            col = getattr(df, "column", None)
+                            nb = 0
+                            for arr_name in ("tokens", "ords", "hi", "vecs",
+                                             "lat"):
+                                arr = getattr(df, arr_name, None)
+                                if arr is not None:
+                                    nb += getattr(arr, "nbytes", 0)
+                            _ = col
+                            per_field[fname] = per_field.get(fname, 0) + nb
+        wanted = req.path_params.get("fields") or req.param("fields")
+        if wanted:
+            pats = wanted.split(",")
+            per_field = {f: b for f, b in per_field.items()
+                         if any(fnmatch.fnmatch(f, p) for p in pats)}
+        cols = [
+            Col("id", desc="node id", default=False),
+            Col("host", ("h",), "node host"),
+            Col("ip", desc="node ip"),
+            Col("node", ("n",), "node name"),
+            Col("total", desc="total fielddata memory", right=True),
+        ]
+        cols.extend(Col(f, desc=f"{f} fielddata memory", right=True,
+                        default=False) for f in sorted(per_field))
+        t = CatTable(cols)
+        row = {"id": self.node.node_id[:4], "host": self._node_host(),
+               "ip": self._node_ip(), "node": self.node.node_name,
+               "total": fmt_bytes(sum(per_field.values()))}
+        row.update({f: fmt_bytes(b) for f, b in per_field.items()})
+        t.add(**row)
+        return t.render(req)
+
+    def cat_health(self, req: RestRequest):
+        h = self.node.cluster_service.state().health()
+        ts = int(time.time())
+        pending = len(self.node.cluster_service.pending_tasks())
+        total = h["active_shards"] + h["relocating_shards"] + \
+            h["initializing_shards"] + h["unassigned_shards"]
+        pct = 100.0 * h["active_shards"] / total if total else 100.0
+        with_ts = req.param_as_bool("ts", True)
+        cols = ([Col("epoch", ("t", "time"), "seconds since epoch",
+                     right=True),
+                 Col("timestamp", ("ts", "hms", "hhmmss"), "time in "
+                     "HH:MM:SS")] if with_ts else [])
+        cols += [
+            Col("cluster", ("cl",), "cluster name"),
+            Col("status", ("st",), "health status"),
+            Col("node.total", ("nt", "nodeTotal"), "total number of nodes",
+                right=True),
+            Col("node.data", ("nd", "nodeData"), "number of data nodes",
+                right=True),
+            Col("shards", ("t", "sh", "shards.total", "shardsTotal"),
+                "total number of shards", right=True),
+            Col("pri", ("p", "shards.primary", "shardsPrimary"),
+                "number of primary shards", right=True),
+            Col("relo", ("r", "shards.relocating", "shardsRelocating"),
+                "number of relocating nodes", right=True),
+            Col("init", ("i", "shards.initializing", "shardsInitializing"),
+                "number of initializing nodes", right=True),
+            Col("unassign", ("u", "shards.unassigned", "shardsUnassigned"),
+                "number of unassigned shards", right=True),
+            Col("pending_tasks", ("pt", "pendingTasks"),
+                "number of pending tasks", right=True),
+            Col("max_task_wait_time", ("mtwt", "maxTaskWaitTime"),
+                "wait time of longest task pending", right=True),
+            Col("active_shards_percent", ("asp", "activeShardsPercent"),
+                "active number of shards in percent", right=True),
+        ]
+        t = CatTable(cols)
+        row = {"cluster": h["cluster_name"], "status": h["status"],
+               "node.total": h["number_of_nodes"],
+               "node.data": h["number_of_data_nodes"],
+               "shards": h["active_shards"],
+               "pri": h["active_primary_shards"],
+               "relo": h["relocating_shards"],
+               "init": h["initializing_shards"],
+               "unassign": h["unassigned_shards"],
+               "pending_tasks": pending,
+               "max_task_wait_time": "-",
+               "active_shards_percent": f"{pct:.1f}%"}
+        if with_ts:
+            row["epoch"] = ts
+            row["timestamp"] = time.strftime("%H:%M:%S", time.gmtime(ts))
+        t.add(**row)
+        return t.render(req)
+
+    def cat_indices(self, req: RestRequest):
         state = self.node.cluster_service.state()
-        rows = []
-        for s in state.routing_table.shards:
-            rows.append([s.index, s.shard, "p" if s.primary else "r",
-                         s.state.value, s.node_id or "-"])
-        return self._cat_table(req, ["index", "shard", "prirep", "state",
-                                     "node"], rows)
+        expr = req.path_params.get("index")
+        names = self.node.indices_service.resolve(expr) if expr \
+            else sorted(state.indices)
+        t = CatTable([
+            Col("health", ("h",), "current health status"),
+            Col("status", ("s",), "open/close status"),
+            Col("index", ("i", "idx"), "index name"),
+            Col("uuid", ("id",), "index uuid", default=False),
+            Col("pri", ("p", "shards.primary", "shardsPrimary"),
+                "number of primary shards", right=True),
+            Col("rep", ("r", "shards.replica", "shardsReplica"),
+                "number of replica shards", right=True),
+            Col("docs.count", ("dc", "docsCount"), "available docs",
+                right=True),
+            Col("docs.deleted", ("dd", "docsDeleted"), "deleted docs",
+                right=True),
+            Col("store.size", ("ss", "storeSize"), "store size of primaries "
+                "& replicas", right=True),
+            Col("pri.store.size", desc="store size of primaries",
+                right=True),
+            Col("creation.date", ("cd",), "index creation date (millis)",
+                right=True, default=False),
+            Col("creation.date.string", ("cds",), "index creation date "
+                "(ISO8601)", right=True, default=False),
+        ])
+        for n in names:
+            meta = state.indices.get(n)
+            if meta is None:
+                continue
+            svc = self.node.indices_service.indices.get(n)
+            docs = svc.num_docs() if svc else 0
+            deleted = 0
+            store = 0
+            if svc:
+                for e in svc.engines.values():
+                    store += self._store_bytes(e)
+                    for seg in e.segment_stats():
+                        deleted += seg["num_docs"] - seg["live_docs"]
+            t.add(**{"health": self._index_health(state, n),
+                     "status": meta.state if meta.state == "close"
+                     else "open",
+                     "index": n, "uuid": meta.uuid or "-",
+                     "pri": meta.number_of_shards,
+                     "rep": meta.number_of_replicas,
+                     "docs.count": docs, "docs.deleted": deleted,
+                     "store.size": fmt_bytes(store),
+                     "pri.store.size": fmt_bytes(store),
+                     "creation.date": meta.creation_date,
+                     "creation.date.string":
+                         fmt_epoch_iso(meta.creation_date)})
+        return t.render(req)
+
+    def cat_master(self, req: RestRequest):
+        state = self.node.cluster_service.state()
+        mid = state.master_node_id or self.node.node_id
+        n = state.nodes.get(mid)
+        t = CatTable([
+            Col("id", desc="node id"),
+            Col("host", ("h",), "host name"),
+            Col("ip", desc="ip address"),
+            Col("node", ("n",), "node name"),
+        ])
+        t.add(id=mid, host=self._node_host(n), ip=self._node_ip(),
+              node=n.name if n else self.node.node_name)
+        return t.render(req)
+
+    def cat_nodeattrs(self, req: RestRequest):
+        state = self.node.cluster_service.state()
+        t = CatTable([
+            Col("node", desc="node name"),
+            Col("id", ("nodeId",), "unique node id", default=False),
+            Col("pid", ("p",), "process id", default=False),
+            Col("host", ("h",), "host name"),
+            Col("ip", ("i",), "ip address"),
+            Col("port", ("po",), "bound transport port", default=False),
+            Col("attr", desc="attribute name"),
+            Col("value", desc="attribute value"),
+        ])
+        for nid, n in sorted(state.nodes.items(), key=lambda kv: kv[1].name):
+            for attr, value in n.attributes:
+                t.add(node=n.name, id=nid[:4], pid=os.getpid(),
+                      host=self._node_host(n), ip=self._node_ip(),
+                      port=n.address.port, attr=attr, value=value)
+        return t.render(req)
 
     def cat_nodes(self, req: RestRequest):
+        from elasticsearch_tpu.monitor.probes import os_stats, process_stats
         state = self.node.cluster_service.state()
-        rows = []
+        ps, osx = process_stats(), os_stats()
+        rss = ps["mem"]["resident_in_bytes"]
+        total_mem = osx.get("mem", {}).get("total_in_bytes", rss or 1)
+        load1 = osx.get("cpu", {}).get("load_average", {}).get("1m", 0.0)
+        fd = ps["open_file_descriptors"]
+        try:
+            import resource as _res
+            fd_max = _res.getrlimit(_res.RLIMIT_NOFILE)[0]
+        except (ImportError, OSError, ValueError):
+            fd_max = -1
+        full_id = req.param_as_bool("full_id")
+        t = CatTable([
+            Col("id", ("nodeId",), "unique node id", default=False),
+            Col("pid", ("p",), "process id", right=True, default=False),
+            Col("host", ("h",), "host name"),
+            Col("ip", ("i",), "ip address"),
+            Col("port", ("po",), "bound transport port", right=True,
+                default=False),
+            Col("version", ("v",), "es version", default=False),
+            Col("heap.current", ("hc", "heapCurrent"), "used heap",
+                right=True, default=False),
+            Col("heap.percent", ("hp", "heapPercent"), "used heap ratio",
+                right=True),
+            Col("heap.max", ("hm", "heapMax"), "max configured heap",
+                right=True, default=False),
+            Col("ram.current", ("rc", "ramCurrent"), "used machine memory",
+                right=True, default=False),
+            Col("ram.percent", ("rp", "ramPercent"), "used machine memory "
+                "ratio", right=True),
+            Col("ram.max", ("rm", "ramMax"), "total machine memory",
+                right=True, default=False),
+            Col("file_desc.current", ("fdc", "fileDescriptorCurrent"),
+                "used file descriptors", right=True, default=False),
+            Col("file_desc.percent", ("fdp", "fileDescriptorPercent"),
+                "used file descriptor ratio", right=True, default=False),
+            Col("file_desc.max", ("fdm", "fileDescriptorMax"),
+                "max file descriptors", right=True, default=False),
+            Col("load", ("l",), "most recent load avg", right=True),
+            Col("uptime", ("u",), "node uptime", right=True, default=False),
+            Col("node.role", ("r", "role", "dc", "nodeRole"),
+                "d:data node, c:client node"),
+            Col("master", ("m",), "m:master-eligible, *:current master"),
+            Col("name", ("n",), "node name"),
+        ])
         for nid, n in sorted(state.nodes.items(), key=lambda kv: kv[1].name):
-            role = ("m" if n.master_eligible else "-") + \
-                ("d" if n.data_node else "-")
-            rows.append([n.address.host, role,
-                         "*" if nid == state.master_node_id else "-",
-                         n.name])
-        return self._cat_table(req, ["host", "node.role", "master", "name"],
-                               rows)
+            fd_pct = int(100 * fd / fd_max) if fd_max and fd_max > 0 else 0
+            t.add(**{"id": nid if full_id else nid[:4], "pid": os.getpid(),
+                     "host": self._node_host(n), "ip": self._node_ip(),
+                     "port": n.address.port, "version": __version__,
+                     "heap.current": fmt_bytes(rss),
+                     "heap.percent": int(100 * rss / max(total_mem, 1)),
+                     "heap.max": fmt_bytes(total_mem),
+                     "ram.current": fmt_bytes(
+                         osx.get("mem", {}).get("used_in_bytes", 0)),
+                     "ram.percent":
+                         osx.get("mem", {}).get("used_percent", 0),
+                     "ram.max": fmt_bytes(total_mem),
+                     "file_desc.current": fd,
+                     "file_desc.percent": fd_pct,
+                     "file_desc.max": fd_max,
+                     "load": f"{load1:.2f}",
+                     "uptime": f"{ps['uptime_in_millis'] // 1000}s",
+                     "node.role": "d" if n.data_node else "c",
+                     "master": "*" if nid == state.master_node_id
+                     else ("m" if n.master_eligible else "-"),
+                     "name": n.name})
+        return t.render(req)
 
-    def cat_allocation(self, req: RestRequest):
-        state = self.node.cluster_service.state()
-        per_node = {nid: 0 for nid in state.nodes}
-        for s in state.routing_table.shards:
-            if s.node_id in per_node:
-                per_node[s.node_id] += 1
-        rows = [[count, state.nodes[nid].address.host,
-                 state.nodes[nid].name]
-                for nid, count in sorted(per_node.items(),
-                                         key=lambda kv: state.nodes[kv[0]].name)]
-        unassigned = sum(1 for s in state.routing_table.shards
-                         if not s.assigned)
-        if unassigned:
-            rows.append([unassigned, "-", "UNASSIGNED"])
-        return self._cat_table(req, ["shards", "host", "node"], rows)
+    def cat_pending_tasks(self, req: RestRequest):
+        t = CatTable([
+            Col("insertOrder", ("o",), "task insertion order", right=True),
+            Col("timeInQueue", ("t",), "how long task has been in queue",
+                right=True),
+            Col("priority", ("p",), "task priority"),
+            Col("source", ("s",), "task source"),
+        ])
+        for task in self.node.cluster_service.pending_tasks():
+            t.add(insertOrder=task["insert_order"],
+                  timeInQueue=f"{task.get('time_in_queue_millis', 0)}ms",
+                  priority=task["priority"], source=task["source"])
+        return t.render(req)
+
+    def cat_plugins(self, req: RestRequest):
+        t = CatTable([
+            Col("id", desc="unique node id", default=False),
+            Col("name", desc="node name"),
+            Col("component", ("c",), "component name"),
+            Col("version", ("v",), "component version"),
+            Col("type", ("t",), "plugin type (j for jvm, s for site)"),
+            Col("url", ("u",), "url for site plugins"),
+            Col("description", ("d",), "plugin details"),
+        ])
+        plugins = getattr(self.node, "plugins_service", None)
+        for p in (plugins.plugins if plugins else []):
+            t.add(id=self.node.node_id[:4], name=self.node.node_name,
+                  component=getattr(p, "name", type(p).__name__),
+                  version=__version__, type="j", url="-",
+                  description=getattr(p, "description", "-"))
+        return t.render(req)
 
     def cat_recovery(self, req: RestRequest):
-        stats = self.node.recovery_service.stats
-        rows = [[stats["recoveries"], stats["files_sent"],
-                 stats["files_skipped"], stats["bytes_sent"],
-                 stats["ops_replayed"]]]
-        return self._cat_table(req, ["recoveries", "files_sent",
-                                     "files_skipped", "bytes_sent",
-                                     "ops_replayed"], rows)
+        expr = req.path_params.get("index")
+        names = set(self.node.indices_service.resolve(expr)) if expr \
+            else None
+        t = CatTable([
+            Col("index", ("i", "idx"), "index name"),
+            Col("shard", ("s", "sh"), "shard name", right=True),
+            Col("time", ("t", "ti"), "recovery time in ms", right=True),
+            Col("type", ("ty",), "recovery type"),
+            Col("stage", ("st",), "recovery stage"),
+            Col("source_host", ("shost",), "source host"),
+            Col("target_host", ("thost",), "target host"),
+            Col("repository", ("rep",), "repository"),
+            Col("snapshot", ("snap",), "snapshot"),
+            Col("files", ("f",), "number of files to recover", right=True),
+            Col("files_percent", ("fp",), "percent of files recovered",
+                right=True),
+            Col("bytes", ("b",), "size to recover in bytes", right=True),
+            Col("bytes_percent", ("bp",), "percent of bytes recovered",
+                right=True),
+            Col("total_files", ("tf",), "total number of files",
+                right=True),
+            Col("total_bytes", ("tb",), "total number of bytes",
+                right=True),
+            Col("translog", ("tr",), "translog operations recovered",
+                right=True),
+            Col("translog_percent", ("trp",), "percent of translog "
+                "recovery", right=True),
+            Col("total_translog", ("trt",), "current translog operations",
+                right=True),
+        ])
+        state = self.node.cluster_service.state()
+        # one row per live shard copy: latest record only, and only for
+        # indices that still exist (RecoveryState lives on the shard)
+        latest: dict[tuple, dict] = {}
+        for rec in self.node.indices_service.recovery_records:
+            if rec["index"] in state.indices:
+                latest[(rec["index"], rec["shard"], rec["type"])] = rec
+        for rec in latest.values():
+            if names is not None and rec["index"] not in names:
+                continue
+            t.add(index=rec["index"], shard=rec["shard"],
+                  time=rec["time_ms"], type=rec["type"], stage=rec["stage"],
+                  source_host=rec["source_host"],
+                  target_host=rec["target_host"],
+                  repository=rec.get("repository", "n/a"),
+                  snapshot=rec.get("snapshot", "n/a"),
+                  files=rec["files"], files_percent="100.0%",
+                  bytes=rec["bytes"], bytes_percent="100.0%",
+                  total_files=rec["files"], total_bytes=rec["bytes"],
+                  translog=rec.get("translog", 0),
+                  translog_percent="100.0%",
+                  total_translog=rec.get("translog", 0))
+        return t.render(req)
 
     def cat_segments(self, req: RestRequest):
-        rows = []
-        for name, svc in sorted(self.node.indices_service.indices.items()):
+        expr = req.path_params.get("index")
+        self._closed_check(expr)
+        names = self.node.indices_service.resolve(expr) if expr \
+            else sorted(self.node.indices_service.indices)
+        state = self.node.cluster_service.state()
+        t = CatTable([
+            Col("index", ("i", "idx"), "index name"),
+            Col("shard", ("s", "sh"), "shard name", right=True),
+            Col("prirep", ("p", "pr", "primaryOrReplica"),
+                "primary or replica"),
+            Col("ip", desc="ip of node where it lives"),
+            Col("id", desc="unique id of node where it lives",
+                default=False),
+            Col("segment", desc="segment name"),
+            Col("generation", ("g", "gen"), "segment generation",
+                right=True),
+            Col("docs.count", ("dc", "docsCount"), "number of docs in "
+                "segment", right=True),
+            Col("docs.deleted", ("dd", "docsDeleted"), "number of deleted "
+                "docs in segment", right=True),
+            Col("size", ("si",), "segment size in bytes", right=True),
+            Col("size.memory", ("sm", "sizeMemory"), "segment memory in "
+                "bytes", right=True),
+            Col("committed", ("ic", "isCommitted"), "is segment committed"),
+            Col("searchable", ("is", "isSearchable"),
+                "is segment searched"),
+            Col("version", ("v",), "version"),
+            Col("compound", ("ico", "isCompound"),
+                "is segment compound"),
+        ])
+        for name in names:
+            svc = self.node.indices_service.indices.get(name)
+            if svc is None:
+                continue
+            primaries = {s.shard for s in
+                         state.routing_table.index_shards(name)
+                         if s.primary and s.node_id == self.node.node_id}
             for sid in sorted(svc.engines):
-                for seg in svc.engines[sid].segment_stats():
-                    rows.append([name, sid, f"seg_{seg['seg_id']}",
-                                 seg["num_docs"], seg["live_docs"],
-                                 seg["memory_bytes"]])
-        return self._cat_table(req, ["index", "shard", "segment",
-                                     "docs.count", "docs.live",
-                                     "memory.bytes"], rows)
+                engine = svc.engines[sid]
+                seg_bytes = self._store_bytes(engine)
+                stats = engine.segment_stats()
+                per_seg = seg_bytes // max(len(stats), 1)
+                for seg in stats:
+                    t.add(**{"index": name, "shard": sid,
+                             "prirep": "p" if sid in primaries else "r",
+                             "ip": self._node_ip(),
+                             "id": self.node.node_id[:4],
+                             "segment": f"_{seg['seg_id']}",
+                             "generation": seg["seg_id"],
+                             "docs.count": seg["live_docs"],
+                             "docs.deleted":
+                                 seg["num_docs"] - seg["live_docs"],
+                             "size": fmt_bytes(per_seg),
+                             "size.memory": seg["memory_bytes"],
+                             "committed": True, "searchable": True,
+                             "version": "5.4.0", "compound": False})
+        return t.render(req)
+
+    def cat_shards(self, req: RestRequest):
+        expr = req.path_params.get("index")
+        names = set(self.node.indices_service.resolve(expr)) if expr \
+            else None
+        state = self.node.cluster_service.state()
+        stats_cols = [
+            ("completion.size", "size of completion"),
+            ("fielddata.memory_size", "used fielddata cache"),
+            ("fielddata.evictions", "fielddata evictions"),
+            ("query_cache.memory_size", "used query cache"),
+            ("query_cache.evictions", "query cache evictions"),
+            ("flush.total", "number of flushes"),
+            ("flush.total_time", "time spent in flush"),
+            ("get.current", "number of current get ops"),
+            ("get.time", "time spent in get"),
+            ("get.total", "number of get ops"),
+            ("get.exists_time", "time spent in successful gets"),
+            ("get.exists_total", "number of successful gets"),
+            ("get.missing_time", "time spent in failed gets"),
+            ("get.missing_total", "number of failed gets"),
+            ("indexing.delete_current", "number of current deletions"),
+            ("indexing.delete_time", "time spent in deletions"),
+            ("indexing.delete_total", "number of delete ops"),
+            ("indexing.index_current", "number of current indexing ops"),
+            ("indexing.index_time", "time spent in indexing"),
+            ("indexing.index_total", "number of indexing ops"),
+            ("indexing.index_failed", "number of failed indexing ops"),
+            ("merges.current", "number of current merges"),
+            ("merges.current_docs", "number of current merging docs"),
+            ("merges.current_size", "size of current merges"),
+            ("merges.total", "number of completed merge ops"),
+            ("merges.total_docs", "docs merged"),
+            ("merges.total_size", "size merged"),
+            ("merges.total_time", "time spent in merges"),
+            ("percolate.current", "number of current percolations"),
+            ("percolate.memory_size", "memory used by percolator"),
+            ("percolate.queries", "number of registered percolation "
+             "queries"),
+            ("percolate.time", "time spent percolating"),
+            ("percolate.total", "total percolations"),
+            ("refresh.total", "total refreshes"),
+            ("refresh.time", "time spent in refreshes"),
+            ("search.fetch_current", "current fetch phase ops"),
+            ("search.fetch_time", "time spent in fetch phase"),
+            ("search.fetch_total", "total fetch ops"),
+            ("search.open_contexts", "open search contexts"),
+            ("search.query_current", "current query phase ops"),
+            ("search.query_time", "time spent in query phase"),
+            ("search.query_total", "total query phase ops"),
+            ("search.scroll_current", "open scroll contexts"),
+            ("search.scroll_time", "time scroll contexts held open"),
+            ("search.scroll_total", "completed scroll contexts"),
+            ("segments.count", "number of segments"),
+            ("segments.memory", "memory used by segments"),
+            ("segments.index_writer_memory",
+             "memory used by index writer"),
+            ("segments.index_writer_max_memory",
+             "maximum memory index writer may use"),
+            ("segments.version_map_memory",
+             "memory used by version map"),
+            ("segments.fixed_bitset_memory",
+             "memory used by fixed bit sets"),
+            ("warmer.current", "current warmer ops"),
+            ("warmer.total", "total warmer ops"),
+            ("warmer.total_time", "time spent in warmers"),
+        ]
+        cols = [
+            Col("index", ("i", "idx"), "index name"),
+            Col("shard", ("s", "sh"), "shard name", right=True),
+            Col("prirep", ("p", "pr", "primaryOrReplica"),
+                "primary or replica"),
+            Col("state", ("st",), "shard state"),
+            Col("docs", ("d", "dc"), "number of docs in shard",
+                right=True),
+            Col("store", ("sto",), "store size of shard", right=True),
+            Col("ip", desc="ip of node where it lives"),
+            Col("id", desc="unique id of node where it lives",
+                default=False),
+            Col("node", ("n",), "name of node where it lives"),
+            Col("unassigned.reason", ("ur",), "reason shard is unassigned",
+                default=False),
+            Col("unassigned.at", ("ua",), "time shard became unassigned",
+                default=False),
+            Col("unassigned.for", ("uf",), "time has been unassigned",
+                default=False),
+            Col("unassigned.details", ("ud",), "additional details as to "
+                "why the shard became unassigned", default=False),
+        ]
+        cols.extend(Col(name, desc=desc, right=True, default=False)
+                    for name, desc in stats_cols)
+        t = CatTable(cols)
+        for s in state.routing_table.shards:
+            if names is not None and s.index not in names:
+                continue
+            meta = state.indices.get(s.index)
+            shadow = meta is not None and str(
+                meta.settings.get("index.shadow_replicas",
+                                  meta.settings.get("shadow_replicas",
+                                                    ""))).lower() == "true"
+            row = {"index": s.index, "shard": s.shard,
+                   "prirep": "p" if s.primary else ("s" if shadow else "r"),
+                   "state": s.state.value}
+            if s.assigned:
+                n = state.nodes.get(s.node_id)
+                svc = self.node.indices_service.indices.get(s.index)
+                engine = svc.engines.get(s.shard) if svc else None
+                if engine is not None:
+                    row["docs"] = engine.num_docs
+                    row["store"] = fmt_bytes(self._store_bytes(engine))
+                row["ip"] = self._node_ip()
+                row["id"] = s.node_id[:4]
+                row["node"] = n.name if n else s.node_id
+            else:
+                row.update({"docs": "", "store": "", "ip": "", "node": "",
+                            "state": "UNASSIGNED"})
+                if s.unassigned_info is not None:
+                    row["unassigned.reason"] = getattr(
+                        s.unassigned_info, "reason", "")
+            t.add(**row)
+        return t.render(req)
+
+    # the 2.x pool catalogue (ThreadPool.java:70-87 — no merge pool;
+    # Lucene owns merges there, our internal merge pool likewise stays
+    # out of the cat surface)
+    _TP_POOLS = ("bulk", "fetch_shard_started", "fetch_shard_store",
+                 "flush", "generic", "get", "index", "listener",
+                 "management", "optimize", "percolate", "refresh",
+                 "search", "snapshot", "suggest", "warmer")
+    _TP_ALIAS = {"bulk": "b", "fetch_shard_started": "fss",
+                 "fetch_shard_store": "fsst", "flush": "f", "generic": "ge",
+                 "get": "g", "index": "i", "listener": "l",
+                 "management": "ma", "optimize": "o",
+                 "percolate": "p", "refresh": "r", "search": "s",
+                 "snapshot": "sn", "suggest": "su", "warmer": "w"}
+    _TP_FIELDS = (("type", "t"), ("active", "a"), ("size", "s"),
+                  ("queue", "q"), ("queueSize", "qs"), ("rejected", "r"),
+                  ("largest", "l"), ("completed", "c"), ("min", "mi"),
+                  ("max", "ma"), ("keepAlive", "ka"))
 
     def cat_thread_pool(self, req: RestRequest):
-        rows = []
-        for name, st in self.node.thread_pool.stats().items():
-            rows.append([self.node.node_name, name, st["threads"],
-                         st["queue"], st["active"], st["rejected"],
-                         st["completed"]])
-        return self._cat_table(req, ["node_name", "name", "threads",
-                                     "queue", "active", "rejected",
-                                     "completed"], rows)
+        full_id = req.param_as_bool("full_id")
+        cols = [
+            Col("id", ("nodeId",), "unique node id", default=False),
+            Col("pid", ("p",), "process id", right=True, default=False),
+            Col("host", ("h",), "host name"),
+            Col("ip", ("i",), "ip address"),
+            Col("port", ("po",), "bound transport port", right=True,
+                default=False),
+        ]
+        default_on = {("bulk", "active"), ("bulk", "queue"),
+                      ("bulk", "rejected"), ("index", "active"),
+                      ("index", "queue"), ("index", "rejected"),
+                      ("search", "active"), ("search", "queue"),
+                      ("search", "rejected")}
+        for pool in self._TP_POOLS:
+            pa = self._TP_ALIAS[pool]
+            for fname, fa in self._TP_FIELDS:
+                cols.append(Col(
+                    f"{pool}.{fname}", (f"{pa}{fa}",),
+                    f"{fname} for {pool} pool",
+                    right=fname != "type",
+                    default=(pool, fname) in default_on))
+        t = CatTable(cols)
+        live = self.node.thread_pool.stats()
+        row = {"id": self.node.node_id if full_id
+               else self.node.node_id[:4],
+               "pid": os.getpid(), "host": self._node_host(),
+               "ip": self._node_ip(), "port": "-"}
+        for pool in self._TP_POOLS:
+            st = live.get(pool, {})
+            row[f"{pool}.type"] = "fixed"
+            row[f"{pool}.active"] = st.get("active", 0)
+            row[f"{pool}.size"] = st.get("threads", 0)
+            row[f"{pool}.queue"] = st.get("queue", 0)
+            qs = st.get("queue_size", -1)
+            row[f"{pool}.queueSize"] = qs if qs and qs > 0 else ""
+            row[f"{pool}.rejected"] = st.get("rejected", 0)
+            row[f"{pool}.largest"] = st.get("threads", 0)
+            row[f"{pool}.completed"] = st.get("completed", 0)
+            row[f"{pool}.min"] = ""
+            row[f"{pool}.max"] = ""
+            row[f"{pool}.keepAlive"] = ""
+        t.add(**row)
+        return t.render(req)
 
     def cat_snapshots(self, req: RestRequest):
         repo = req.path_params["repo"]
         out = self.node.snapshots_service.get_snapshots(repo, "_all")
-        rows = [[s["snapshot"], s["state"],
-                 s.get("start_time_in_millis", 0),
-                 s.get("end_time_in_millis", 0),
-                 len(s.get("indices", {})),
-                 s.get("shards", {}).get("successful", 0),
-                 s.get("shards", {}).get("failed", 0)]
-                for s in out["snapshots"]]
-        return self._cat_table(req, ["id", "status", "start_epoch",
-                                     "end_epoch", "indices", "successful",
-                                     "failed"], rows)
+        t = CatTable([
+            Col("id", ("snapshot",), "unique snapshot id"),
+            Col("status", ("s",), "snapshot state"),
+            Col("start_epoch", ("ste", "startEpoch"),
+                "start time in seconds since epoch", right=True),
+            Col("end_epoch", ("ete", "endEpoch"),
+                "end time in seconds since epoch", right=True),
+            Col("indices", ("i",), "number of indices", right=True),
+            Col("successful_shards", ("ss",), "number of successful "
+                "shards", right=True),
+            Col("failed_shards", ("fs",), "number of failed shards",
+                right=True),
+        ])
+        for s in out["snapshots"]:
+            t.add(id=s["snapshot"], status=s["state"],
+                  start_epoch=s.get("start_time_in_millis", 0) // 1000,
+                  end_epoch=s.get("end_time_in_millis", 0) // 1000,
+                  indices=len(s.get("indices", {})),
+                  successful_shards=s.get("shards", {}).get("successful", 0),
+                  failed_shards=s.get("shards", {}).get("failed", 0))
+        return t.render(req)
 
     def cat_templates(self, req: RestRequest):
         state = self.node.cluster_service.state()
-        rows = [[name, str(t.get("template", t.get("index_patterns", "-"))),
-                 t.get("order", 0)]
-                for name, t in sorted(state.templates.items())]
-        return self._cat_table(req, ["name", "template", "order"], rows)
-
-    def cat_pending_tasks(self, req: RestRequest):
-        rows = [[t["insert_order"], t["priority"], t["source"]]
-                for t in self.node.cluster_service.pending_tasks()]
-        return self._cat_table(req, ["insertOrder", "priority", "source"],
-                               rows)
-
-    def cat_nodeattrs(self, req: RestRequest):
-        state = self.node.cluster_service.state()
-        rows = []
-        for nid, n in sorted(state.nodes.items(), key=lambda kv: kv[1].name):
-            for attr, value in n.attributes:
-                rows.append([n.name, n.address.host, attr, value])
-        return self._cat_table(req, ["node", "host", "attr", "value"], rows)
+        t = CatTable([
+            Col("name", ("n",), "template name"),
+            Col("template", ("t",), "template pattern string"),
+            Col("order", ("o",), "template application order", right=True),
+        ])
+        for name, tpl in sorted(state.templates.items()):
+            t.add(name=name,
+                  template=str(tpl.get("template",
+                                       tpl.get("index_patterns", "-"))),
+                  order=tpl.get("order", 0))
+        return t.render(req)
 
     def nodes_hot_threads(self, req: RestRequest):
         params = {}
@@ -1497,17 +2416,3 @@ class Handlers:
             if req.param(k) is not None:
                 params[k] = req.param(k)
         return 200, self.node.collect_hot_threads(**params)
-
-    def cat_master(self, req: RestRequest):
-        return self._cat_table(
-            req, ["id", "node"],
-            [[self.node.node_id, self.node.node_name]])
-
-    def cat_aliases(self, req: RestRequest):
-        state = self.node.cluster_service.state()
-        rows = []
-        for n, meta in state.indices.items():
-            for alias in meta.aliases:
-                rows.append([alias, n, "-", "-"])
-        return self._cat_table(req, ["alias", "index", "filter", "routing"],
-                               rows)

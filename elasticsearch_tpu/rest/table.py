@@ -1,0 +1,131 @@
+"""RestTable — the `_cat` text-table engine.
+
+Reference: core/rest/action/support/RestTable.java + common/Table.java —
+each cat action declares its columns (name, alias list, description,
+text-align, default visibility); the renderer then honours `help`
+(column catalogue), `h` (column selection, aliases + wildcards, in the
+order given), `v` (header row), and pads cells to column width with
+right-alignment for numeric columns (headers align with their cells).
+Trailing pad spaces are kept, exactly like the reference — the YAML
+conformance regexes depend on them.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass, field as dc_field
+
+
+@dataclass
+class Col:
+    name: str
+    alias: tuple = ()
+    desc: str = ""
+    right: bool = False          # text-align:right (numeric columns)
+    default: bool = True         # shown when no `h=` given
+
+
+@dataclass
+class CatTable:
+    cols: list[Col]
+    rows: list[dict] = dc_field(default_factory=list)
+
+    def add(self, **cells) -> None:
+        self.rows.append(cells)
+
+    # ---- rendering --------------------------------------------------------
+
+    def render(self, req) -> tuple[int, str]:
+        if req.param_as_bool("help"):
+            return 200, self._render_help()
+        cols = self._select(req.param("h"))
+        verbose = req.param_as_bool("v")
+        return 200, self._render_rows(cols, verbose)
+
+    def _render_help(self) -> str:
+        width = max((len(c.name) for c in self.cols), default=0)
+        lines = []
+        for c in self.cols:
+            alias = ",".join(c.alias) if c.alias else "-"
+            lines.append(f"{c.name.ljust(width)} | {alias} | "
+                         f"{c.desc or c.name}")
+        return "\n".join(lines) + "\n"
+
+    def _select(self, h: str | None) -> list[tuple[Col, str]]:
+        """→ [(col, display_header)] — name matches display the name, alias
+        matches display the alias AS TYPED, wildcards expand to names, and
+        unknown tokens are dropped (RestTable.buildDisplayHeaders)."""
+        if not h:
+            return [(c, c.name) for c in self.cols if c.default]
+        by_name = {c.name: c for c in self.cols}
+        by_alias = {}
+        for c in self.cols:
+            for a in c.alias:
+                by_alias.setdefault(a, c)
+        out: list[tuple[Col, str]] = []
+        for tok in h.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if tok in by_name:
+                out.append((by_name[tok], tok))
+            elif tok in by_alias:
+                out.append((by_alias[tok], tok))
+            elif "*" in tok or "?" in tok:
+                out.extend((c, c.name) for c in self.cols
+                           if fnmatch.fnmatch(c.name, tok))
+        return out
+
+    def _render_rows(self, sel: list[tuple[Col, str]],
+                     verbose: bool) -> str:
+        cols = [c for c, _ in sel]
+        grid = [[_str(row.get(c.name, "")) for c in cols]
+                for row in self.rows]
+        # header names count toward column width only when the header row
+        # is shown (RestTable.buildWidths), and every cell (the last
+        # included) carries a trailing separator space — the YAML
+        # conformance regexes rely on both behaviours
+        widths = [len(d) if verbose else 0 for _, d in sel]
+        for r in grid:
+            for i, cell in enumerate(r):
+                widths[i] = max(widths[i], len(cell))
+        lines = []
+        if verbose:
+            lines.append("".join(
+                (d.rjust(w) if c.right else d.ljust(w)) + " "
+                for (c, d), w in zip(sel, widths)))
+        for r in grid:
+            lines.append("".join(
+                (cell.rjust(w) if c.right else cell.ljust(w)) + " "
+                for cell, w, c in zip(r, widths, cols)))
+        return "".join(line + "\n" for line in lines)
+
+
+def _str(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        return f"{v:.1f}"
+    return str(v)
+
+
+def fmt_bytes(n) -> str:
+    """ES ByteSizeValue.toString: largest unit, one decimal when inexact
+    (1536 → '1.5kb', 1024 → '1kb', 17 → '17b')."""
+    n = int(n)
+    for unit, suffix in ((1 << 40, "tb"), (1 << 30, "gb"),
+                         (1 << 20, "mb"), (1 << 10, "kb")):
+        if n >= unit:
+            v = n / unit
+            return f"{int(v)}{suffix}" if v == int(v) else f"{v:.1f}{suffix}"
+    return f"{n}b"
+
+
+def fmt_epoch_iso(ms: int) -> str:
+    """IndexMetaData creation.date.string — ISO8601 millis Z."""
+    import datetime
+    dt = datetime.datetime.fromtimestamp(ms / 1000.0,
+                                         tz=datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"

@@ -27,13 +27,25 @@ CHOSEN = ["search", "index", "indices.create", "get", "get_source", "count",
           "indices.exists", "indices.exists_type",
           "indices.put_mapping", "indices.get_mapping", "indices.refresh",
           "cluster.health", "info", "ping", "mlt", "indices.optimize",
-          "suggest", "termvectors"]
+          "suggest", "termvectors",
+          # round 3 tranche: cat family, aliases, warmers, settings
+          "cat.aliases", "cat.allocation", "cat.count", "cat.fielddata",
+          "cat.health", "cat.indices", "cat.nodeattrs", "cat.nodes",
+          "cat.plugins", "cat.recovery", "cat.segments", "cat.shards",
+          "cat.thread_pool", "indices.get_alias", "indices.get_aliases",
+          "indices.put_alias", "indices.delete_alias",
+          "indices.exists_alias", "indices.update_aliases",
+          "indices.get_warmer", "indices.put_warmer",
+          "indices.delete_warmer", "indices.get_settings", "indices.get"]
 
 
 def main() -> int:
     spec = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else DEFAULT_SPEC)
     runner = YamlRestRunner(spec)
-    node = Node({}, data_path=pathlib.Path(tempfile.mkdtemp())).start()
+    # node.testattr mirrors the reference CI cluster config the cat.nodeattrs
+    # suite expects (a planted custom attribute)
+    node = Node({"node.testattr": "test"},
+                data_path=pathlib.Path(tempfile.mkdtemp())).start()
     rows = []
     tp = tf = ts = 0
     try:
