@@ -524,7 +524,9 @@ class DocumentActions:
             return {**base, "found": False}
         reader, gdoc = loc
         seg, local = reader.resolve(gdoc)
-        want = (request.get("body") or {}).get("fields")
+        body = request.get("body") or {}
+        want = body.get("fields")
+        term_stats = bool(body.get("term_statistics"))
         out_fields: dict = {}
         for fname, col in seg.seg.text_fields.items():
             if want and fname not in want:
@@ -541,6 +543,17 @@ class DocumentActions:
                 # across refreshes/merges
                 terms[term] = {"term_freq": int(tf),
                                "doc_freq": int(reader.df(fname, term))}
+                if term_stats:
+                    ttf = 0
+                    for s2 in reader.segments:
+                        c2 = s2.seg.text_fields.get(fname)
+                        if c2 is None:
+                            continue
+                        t2 = c2.tid(term)
+                        if t2 >= 0:
+                            ttf += int(np.asarray(
+                                c2.utf * (c2.uterms == t2)).sum())
+                    terms[term]["ttf"] = ttf
             if not terms:
                 continue
             sum_df = doc_count = sum_ttf = 0

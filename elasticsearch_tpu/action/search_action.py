@@ -567,7 +567,7 @@ class SearchActions:
     def _search_once(self, index_expr: str, body: dict, t0: float,
                      search_type: str | None = None,
                      dfs_cache: dict | None = None) -> dict:
-        names = self.node.indices_service.resolve(index_expr)
+        names = self.node.indices_service.resolve_open(index_expr)
         body = rewrite_mlt_likes(self.node, body,
                                  names[0] if names else "_all")
         state = self.node.cluster_service.state()
@@ -634,8 +634,15 @@ class SearchActions:
             try:
                 outs = fut.result()
             except Exception as e:           # noqa: BLE001 — per-group error
-                outs = [{"error": {"type": "search_phase_execution_exception",
-                                   "reason": str(e)}}] * len(idxs)
+                from elasticsearch_tpu.common.errors import (
+                    ElasticsearchTpuError)
+                if isinstance(e, ElasticsearchTpuError):
+                    cause = e.to_xcontent()
+                else:
+                    cause = {"type": "search_phase_execution_exception",
+                             "reason": str(e)}
+                outs = [{"error": {"root_cause": [cause], **cause}}] \
+                    * len(idxs)
             for i, out in zip(idxs, outs):
                 responses[i] = out
         return {"responses": responses}
@@ -646,7 +653,7 @@ class SearchActions:
         never ship; per-item SHARD errors surface as that item's shard
         failures (partial results stay visible as partial)."""
         t0 = time.perf_counter()
-        names = self.node.indices_service.resolve(index_expr)
+        names = self.node.indices_service.resolve_open(index_expr)
         bodies = [rewrite_mlt_likes(self.node, b,
                                     names[0] if names else "_all")
                   for b in bodies]
@@ -710,7 +717,7 @@ class SearchActions:
     def field_stats(self, index_expr: str, fields: list[str]) -> dict:
         """Per-field min/max/doc-count over one copy of every shard,
         reduced cluster-wide (the 2.x _field_stats API, level=cluster)."""
-        names = self.node.indices_service.resolve(index_expr)
+        names = self.node.indices_service.resolve_open(index_expr)
         state = self.node.cluster_service.state()
         groups = self._shard_groups(state, names)
         body = {"fields": fields}

@@ -36,7 +36,10 @@ class Token:
 
 # Word characters: letters and digits of any script (approximates Lucene's
 # StandardTokenizer UAX#29 word-break rules closely enough for parity tests).
-_STANDARD_RE = re.compile(r"[^\W_]+(?:['’][^\W_]+)*", re.UNICODE)
+# \w includes '_': UAX#29 (Lucene StandardTokenizer) classes underscore as
+# ExtendNumLet, which JOINS words — "value1_foo" is ONE token. All-
+# underscore matches are dropped below (no word chars → no token).
+_STANDARD_RE = re.compile(r"\w+(?:['’]\w+)*", re.UNICODE)
 _WHITESPACE_RE = re.compile(r"\S+")
 _LETTER_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
 
@@ -49,7 +52,11 @@ def _regex_tokenize(text: str, pattern: re.Pattern) -> list[Token]:
 
 
 def standard_tokenizer(text: str) -> list[Token]:
-    return _regex_tokenize(text, _STANDARD_RE)
+    toks = _regex_tokenize(text, _STANDARD_RE)
+    kept = [t for t in toks if t.term.strip("_")]
+    # re-number positions after dropping underscore-only matches
+    return [Token(t.term, pos, t.start_offset, t.end_offset)
+            for pos, t in enumerate(kept)]
 
 
 def whitespace_tokenizer(text: str) -> list[Token]:
@@ -340,6 +347,12 @@ BUILTIN_ANALYZERS: dict[str, Analyzer] = {
                      [lowercase_filter, stop_filter_factory()]),
     "english": Analyzer("english", standard_tokenizer,
                         [lowercase_filter, stop_filter_factory(), porter_stem_filter]),
+    # SnowballAnalyzer (deprecated in Lucene 5 but still registered in ES
+    # 2.x): standard tokenizer, lowercase, stop, snowball stemmer — the
+    # Porter stemmer is the English snowball variant here
+    "snowball": Analyzer("snowball", standard_tokenizer,
+                         [lowercase_filter, stop_filter_factory(),
+                          porter_stem_filter]),
 }
 
 

@@ -640,6 +640,21 @@ class Engine:
             os.replace(tmp, commit_file)
             return sync_id
 
+    def commit_user_data(self) -> dict:
+        """The last commit's user data (ref: SegmentInfos userData — where
+        the reference stamps translog ids and the synced-flush sync_id)."""
+        commit_file = self.path / "commit.json"
+        if not commit_file.exists():
+            return {}
+        try:
+            commit = json.loads(commit_file.read_text())
+        except (OSError, json.JSONDecodeError):
+            return {}
+        out = {"translog_generation": str(commit.get("translog_gen", 0))}
+        if commit.get("sync_id"):
+            out["sync_id"] = commit["sync_id"]
+        return out
+
     def force_merge(self, max_num_segments: int = 1) -> None:
         """_optimize / force-merge: rewrite segments into one, dropping
         deleted docs (ElasticsearchConcurrentMergeScheduler's job)."""

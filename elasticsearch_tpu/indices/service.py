@@ -339,6 +339,13 @@ class IndicesService:
                 shutil.rmtree(self.data_path / "indices" / name,
                               ignore_errors=True)
                 del self.indices[name]
+        gone = [r["index"] for r in self.recovery_records
+                if r["index"] not in new.indices]
+        if gone:
+            # RecoveryState dies with its shard — purge records of
+            # deleted indices so a recreated index starts clean
+            self.recovery_records = [r for r in self.recovery_records
+                                     if r["index"] in new.indices]
 
     on_shard_failed = None
 
@@ -387,19 +394,29 @@ class IndicesService:
         files = nbytes = 0
         try:
             for p in engine.path.rglob("*"):
-                if p.is_file():
+                # the recovered file set = the committed store (commit +
+                # segment files); the translog is replayed, not copied
+                if p.is_file() and "translog" not in p.parts:
                     files += 1
                     nbytes += p.stat().st_size
         except OSError:
             pass
+        rtype = "store" if s.primary else "replica"
+        repository = snapshot = "n/a"
+        meta = state.indices.get(s.index)
+        if s.primary and meta is not None and \
+                meta.settings.get("index.restore.repository"):
+            rtype = "snapshot"
+            repository = meta.settings["index.restore.repository"]
+            snapshot = meta.settings.get("index.restore.snapshot", "n/a")
         self.recovery_records.append({
             "index": s.index, "shard": s.shard,
             "time_ms": max(int((time.time() - t0) * 1000), 1),
-            "type": "store" if s.primary else "replica",
+            "type": rtype,
             "stage": "done",
             "source_host": node_name(source),
             "target_host": node_name(self.node_id),
-            "repository": "n/a", "snapshot": "n/a",
+            "repository": repository, "snapshot": snapshot,
             "files": files, "bytes": nbytes, "translog": 0,
         })
 
@@ -720,6 +737,33 @@ class IndicesService:
             if n not in seen:
                 seen.add(n)
                 out.append(n)
+        return out
+
+    def resolve_open(self, expr: str) -> list[str]:
+        """Search/read resolution: wildcard expansion skips closed
+        indices, explicitly naming one raises IndexClosedError (403) —
+        ref: IndexNameExpressionResolver + IndexClosedException."""
+        from elasticsearch_tpu.common.errors import IndexClosedError
+        state = self.cluster_service.state()
+        # expand each explicit (non-wildcard) part to the concrete index
+        # names it denotes — an alias to a closed index is as explicit as
+        # naming the index itself
+        explicit: set[str] = set()
+        for p in (expr or "_all").split(","):
+            p = p.strip()
+            if not p or "*" in p or p == "_all":
+                continue
+            try:
+                explicit.update(self._resolve(state, p))
+            except IndexNotFoundError:
+                pass
+        out = []
+        for n in self._resolve(state, expr or "_all"):
+            if state.indices[n].state == "close":
+                if n in explicit:
+                    raise IndexClosedError(f"closed index [{n}]")
+                continue
+            out.append(n)
         return out
 
     def resolve(self, expr: str) -> list[str]:

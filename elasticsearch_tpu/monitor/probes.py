@@ -17,6 +17,7 @@ def process_stats() -> dict:
     ru = resource.getrusage(resource.RUSAGE_SELF)
     return {
         "timestamp": int(time.time() * 1000),
+        "id": os.getpid(),
         "open_file_descriptors": _open_fds(),
         "cpu": {"total_in_millis": int((ru.ru_utime + ru.ru_stime) * 1000)},
         "mem": {"resident_in_bytes": ru.ru_maxrss * 1024},

@@ -11,6 +11,7 @@ TransportAction instances.
 
 from __future__ import annotations
 
+import threading as _threading
 import time
 import uuid
 from pathlib import Path
@@ -235,29 +236,45 @@ class Node:
 
     def _put_script_on_master(self, lang: str, sid: str, source) -> dict:
         created = [True]
+        version = [1]
 
         def update(state):
+            key = f"{lang}\x00{sid}"
             existing = state.customs.get("stored_scripts", {})
-            created[0] = f"{lang}\x00{sid}" not in existing
-            scripts = {**existing, f"{lang}\x00{sid}": source}
-            return state.with_(customs={**state.customs,
-                                        "stored_scripts": scripts})
+            created[0] = key not in existing
+            versions = dict(state.customs.get("stored_script_versions", {}))
+            version[0] = versions.get(key, 0) + 1
+            versions[key] = version[0]
+            scripts = {**existing, key: source}
+            return state.with_(customs={
+                **state.customs, "stored_scripts": scripts,
+                "stored_script_versions": versions})
         self.cluster_service.submit_and_wait(f"put-script [{sid}]", update)
-        return {"created": created[0]}
+        return {"created": created[0], "version": version[0]}
 
     def _delete_script_on_master(self, lang: str, sid: str) -> None:
         def update(state):
+            key = f"{lang}\x00{sid}"
             scripts = {k: v for k, v in
                        state.customs.get("stored_scripts", {}).items()
-                       if k != f"{lang}\x00{sid}"}
-            return state.with_(customs={**state.customs,
-                                        "stored_scripts": scripts})
+                       if k != key}
+            # deletion bumps the version like a document delete would
+            # (the reference's .scripts index semantics)
+            versions = dict(state.customs.get("stored_script_versions", {}))
+            versions[key] = versions.get(key, 0) + 1
+            return state.with_(customs={
+                **state.customs, "stored_scripts": scripts,
+                "stored_script_versions": versions})
         self.cluster_service.submit_and_wait(f"delete-script [{sid}]",
                                              update)
 
     def stored_script(self, sid: str, lang: str = "mustache"):
         return self.cluster_service.state().customs.get(
             "stored_scripts", {}).get(f"{lang}\x00{sid}")
+
+    def stored_script_version(self, sid: str, lang: str) -> int:
+        return self.cluster_service.state().customs.get(
+            "stored_script_versions", {}).get(f"{lang}\x00{sid}", 0)
 
     def cluster_reroute(self, commands: list[dict],
                         dry_run: bool = False) -> dict:
@@ -472,12 +489,16 @@ class Node:
         — indices rollup, breakers, thread pools, process/os probes)."""
         from elasticsearch_tpu.monitor import os_stats, process_stats
         indices_total = {"docs": {"count": 0},
+                         "store": {"size_in_bytes": 0,
+                                   "throttle_time_in_millis": 0},
                          "segments": {"count": 0, "memory_in_bytes": 0},
                          "indexing": {"index_total": 0,
                                       "index_time_in_millis": 0}}
         for svc in list(self.indices_service.indices.values()):
             s = svc.stats()
             indices_total["docs"]["count"] += s["docs"]["count"]
+            indices_total["store"]["size_in_bytes"] += \
+                s.get("store", {}).get("size_in_bytes", 0)
             indices_total["segments"]["count"] += s["segments"]["count"]
             indices_total["segments"]["memory_in_bytes"] += \
                 s["segments"]["memory_in_bytes"]
@@ -489,16 +510,57 @@ class Node:
         recovery = getattr(self, "recovery_service", None)
         indices_total["request_cache"] = \
             self.search_actions.request_cache.stats_dict()
+        ps = process_stats()
+        osx = os_stats()
+        heap = ps["mem"]["resident_in_bytes"]
+        total_mem = osx.get("mem", {}).get("total_in_bytes", heap or 1)
         return {
             "name": self.node_name,
             "timestamp": int(time.time() * 1000),
             "indices": indices_total,
             "breakers": self.breaker_service.stats(),
             "thread_pool": pools,
-            "process": process_stats(),
-            "os": os_stats(),
+            "process": ps,
+            "os": osx,
+            # process-level memory reported under the reference's jvm
+            # section name (there is no JVM; RSS plays the heap role)
+            "jvm": {"timestamp": ps["timestamp"],
+                    "uptime_in_millis": ps["uptime_in_millis"],
+                    "mem": {"heap_used_in_bytes": heap,
+                            "heap_used_percent":
+                                int(100 * heap / max(total_mem, 1)),
+                            "heap_max_in_bytes": total_mem},
+                    "threads": {"count": _threading.active_count(),
+                                "peak_count": _threading.active_count()},
+                    "gc": {"collectors": {}},
+                    "buffer_pools": {
+                        "direct": {"count": 0, "used_in_bytes": 0,
+                                   "total_capacity_in_bytes": 0},
+                        "mapped": {"count": 0, "used_in_bytes": 0,
+                                   "total_capacity_in_bytes": 0}}},
+            "transport": {"server_open": 0, "rx_count": 0,
+                          "rx_size_in_bytes": 0, "tx_count": 0,
+                          "tx_size_in_bytes": 0},
+            "fs": self._fs_stats(ps["timestamp"]),
+            "http": {"current_open": 0, "total_opened": 0},
             "recovery": dict(recovery.stats) if recovery else {},
         }
+
+    def _fs_stats(self, ts: int) -> dict:
+        import shutil as _sh
+        try:
+            du = _sh.disk_usage(str(self.data_path))
+            entry = {"path": str(self.data_path), "type": "local",
+                     "total_in_bytes": du.total,
+                     "free_in_bytes": du.free,
+                     "available_in_bytes": du.free}
+        except OSError:
+            entry = {"path": str(self.data_path), "type": "local",
+                     "total_in_bytes": 0,
+                     "free_in_bytes": 0, "available_in_bytes": 0}
+        total = {k: v for k, v in entry.items()
+                 if k not in ("path", "type")}
+        return {"timestamp": ts, "total": total, "data": [entry]}
 
     def _handle_node_stats(self, request: dict, source) -> dict:
         return self.local_node_stats()

@@ -43,15 +43,60 @@ class SnapshotAlreadyExistsError(RepositoryError):
 
 def repository_for(name: str, spec: dict) -> "FsRepository":
     """Instantiate a repository from its cluster-state registration
-    ({"type": ..., "settings": {...}}). Only "fs" ships in-core, like the
-    reference (s3/azure arrive as plugins via the same contract)."""
+    ({"type": ..., "settings": {...}}). "fs" and read-only "url" ship
+    in-core, like the reference (core/repositories/{fs,uri}/; s3/azure
+    arrive as plugins via the same contract)."""
     rtype = spec.get("type", "fs")
+    settings = spec.get("settings") or {}
+    if rtype == "url":
+        url = settings.get("url")
+        if not url:
+            raise RepositoryError(f"repository [{name}] requires "
+                                  f"settings.url")
+        return UrlRepository(name, str(url))
     if rtype != "fs":
         raise RepositoryError(f"unknown repository type [{rtype}]")
-    location = (spec.get("settings") or {}).get("location")
+    location = settings.get("location")
     if not location:
         raise RepositoryError(f"repository [{name}] requires settings.location")
     return FsRepository(name, location)
+
+
+class UrlRepository:
+    """Read-only URL repository (ref: core/repositories/uri/URLRepository
+    — snapshots can only be listed/restored, never written). file:// URLs
+    delegate to the fs layout; remote schemes are registered but answer
+    empty listings here (zero-egress environment)."""
+
+    def __init__(self, name: str, url: str):
+        self.name = name
+        self.url = url
+        self._fs = None
+        if url.startswith("file:"):
+            from urllib.parse import urlparse
+            self._fs = FsRepository(name, urlparse(url).path)
+
+    def verify(self) -> None:
+        return None                      # read-only: nothing to write
+
+    def snapshot_names(self) -> list[str]:
+        return self._fs.snapshot_names() if self._fs else []
+
+    def read_snapshot(self, snapshot: str) -> dict:
+        if self._fs:
+            return self._fs.read_snapshot(snapshot)
+        raise SnapshotMissingError(f"[{self.name}:{snapshot}] is missing")
+
+    def _read_only(self, *_a, **_k):
+        raise RepositoryError(
+            f"[{self.name}] cannot modify a read-only url repository")
+
+    begin_snapshot = finalize_snapshot = delete_snapshot = _read_only
+
+    def __getattr__(self, item):
+        if self._fs is not None:
+            return getattr(self._fs, item)
+        raise AttributeError(item)
 
 
 class FsRepository:

@@ -71,7 +71,10 @@ class RestController:
         root = self._tries.get(method.upper())
         if root is None:
             return None, {}
-        segs = [s for s in path.split("/") if s]
+        # decode per segment AFTER splitting — a %2F inside a document id
+        # must not become a path separator (RestUtils.decodeComponent)
+        from urllib.parse import unquote
+        segs = [unquote(s) for s in path.split("/") if s]
 
         def walk(node: _TrieNode, i: int, params: dict):
             if i == len(segs):
@@ -97,8 +100,7 @@ class RestController:
         parsed = urlparse(uri)
         qs = {k: v[-1] for k, v in parse_qs(parsed.query,
                                             keep_blank_values=True).items()}
-        from urllib.parse import unquote
-        handler, path_params = self.resolve(method, unquote(parsed.path))
+        handler, path_params = self.resolve(method, parsed.path)
         if handler is None and method == "HEAD":
             handler, path_params = self.resolve("GET", parsed.path)
         if handler is None:

@@ -99,9 +99,25 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/{index}/{features}", h.get_index_features)
     # templates
     r("PUT", "/_template/{name}", h.put_template)
+    r("POST", "/_template/{name}", h.put_template)
     r("GET", "/_template/{name}", h.get_template)
+    r("HEAD", "/_template/{name}", h.get_template)
     r("GET", "/_template", h.get_templates)
     r("DELETE", "/_template/{name}", h.delete_template)
+    r("GET", "/_render/template", h.render_template)
+    r("POST", "/_render/template", h.render_template)
+    r("GET", "/_render/template/{id}", h.render_template)
+    r("POST", "/_render/template/{id}", h.render_template)
+    r("GET", "/_segments", h.indices_segments)
+    r("GET", "/{index}/_segments", h.indices_segments)
+    r("GET", "/_recovery", h.indices_recovery)
+    r("GET", "/{index}/_recovery", h.indices_recovery)
+    r("POST", "/_upgrade", h.indices_upgrade)
+    r("POST", "/{index}/_upgrade", h.indices_upgrade)
+    r("GET", "/_upgrade", h.upgrade_status)
+    r("GET", "/{index}/_upgrade", h.upgrade_status)
+    r("GET", "/_shard_stores", h.indices_shard_stores)
+    r("GET", "/{index}/_shard_stores", h.indices_shard_stores)
     # documents (modern _doc + ES 2.x /{index}/{type}/{id})
     for doc_seg in ("_doc", "{type}"):
         r("PUT", f"/{{index}}/{doc_seg}/{{id}}", h.index_doc)
@@ -158,7 +174,10 @@ def register_all(rc: RestController, node) -> None:
     r("POST", "/{index}/{type}/_search/template", h.search_template)
     r("POST", "/_search/scroll", h.scroll)
     r("GET", "/_search/scroll", h.scroll)
+    r("POST", "/_search/scroll/{scroll_id}", h.scroll)
+    r("GET", "/_search/scroll/{scroll_id}", h.scroll)
     r("DELETE", "/_search/scroll", h.clear_scroll)
+    r("DELETE", "/_search/scroll/{scroll_id}", h.clear_scroll)
     r("POST", "/{index}/_validate/query", h.validate_query)
     r("GET", "/{index}/_validate/query", h.validate_query)
     r("POST", "/{index}/_analyze", h.analyze)
@@ -179,6 +198,7 @@ def register_all(rc: RestController, node) -> None:
     r("POST", "/{index}/_search/exists", h.search_exists)
     r("GET", "/{index}/_search/exists", h.search_exists)
     r("POST", "/_search/exists", h.search_exists)
+    r("GET", "/_search/exists", h.search_exists)
     r("POST", "/{index}/_flush/synced", h.synced_flush)
     r("GET", "/{index}/_flush/synced", h.synced_flush)
     r("POST", "/_flush/synced", h.synced_flush)
@@ -200,6 +220,28 @@ def register_all(rc: RestController, node) -> None:
     r("POST", "/{index}/_percolate", h.percolate)
     r("GET", "/{index}/_percolate/count", h.percolate_count)
     r("POST", "/{index}/_percolate/count", h.percolate_count)
+    r("GET", "/{index}/{type}/_percolate", h.percolate)
+    r("POST", "/{index}/{type}/_percolate", h.percolate)
+    r("GET", "/{index}/{type}/_percolate/count", h.percolate_count)
+    r("POST", "/{index}/{type}/_percolate/count", h.percolate_count)
+    r("GET", "/{index}/{type}/{id}/_percolate", h.percolate_existing)
+    r("POST", "/{index}/{type}/{id}/_percolate", h.percolate_existing)
+    r("GET", "/{index}/{type}/{id}/_percolate/count",
+      h.percolate_existing_count)
+    r("POST", "/{index}/{type}/{id}/_percolate/count",
+      h.percolate_existing_count)
+    for pfx in ("", "/{index}", "/{index}/{type}"):
+        r("GET", f"{pfx}/_mpercolate", h.mpercolate)
+        r("POST", f"{pfx}/_mpercolate", h.mpercolate)
+        r("GET", f"{pfx}/_mtermvectors", h.mtermvectors)
+        r("POST", f"{pfx}/_mtermvectors", h.mtermvectors)
+    r("GET", "/_search_shards", h.search_shards)
+    r("POST", "/_search_shards", h.search_shards)
+    r("GET", "/{index}/_search_shards", h.search_shards)
+    r("POST", "/{index}/_search_shards", h.search_shards)
+    r("GET", "/{index}/{type}/_search/exists", h.search_exists)
+    r("POST", "/{index}/{type}/_search/exists", h.search_exists)
+    r("GET", "/_cluster/pending_tasks", h.cluster_pending_tasks)
     # suggest (RestSuggestAction)
     r("POST", "/_suggest", h.suggest)
     r("GET", "/_suggest", h.suggest)
@@ -212,12 +254,20 @@ def register_all(rc: RestController, node) -> None:
     r("POST", "/_snapshot/{repo}", h.put_repository)
     r("GET", "/_snapshot/{repo}", h.get_repositories)
     r("DELETE", "/_snapshot/{repo}", h.delete_repository)
+    r("POST", "/_snapshot/{repo}/_verify", h.verify_repository)
     r("PUT", "/_snapshot/{repo}/{snapshot}", h.create_snapshot)
     r("GET", "/_snapshot/{repo}/{snapshot}", h.get_snapshots)
     r("DELETE", "/_snapshot/{repo}/{snapshot}", h.delete_snapshot)
     r("POST", "/_snapshot/{repo}/{snapshot}/_restore", h.restore_snapshot)
     r("GET", "/_nodes", h.nodes_info)
     r("GET", "/_nodes/stats", h.nodes_stats)
+    r("GET", "/_nodes/stats/{metric}", h.nodes_stats)
+    r("GET", "/_nodes/stats/{metric}/{index_metric}", h.nodes_stats)
+    r("GET", "/_nodes/{node}/stats", h.nodes_stats)
+    r("GET", "/_nodes/{node}/stats/{metric}", h.nodes_stats)
+    r("GET", "/_nodes/{node}/stats/{metric}/{index_metric}", h.nodes_stats)
+    r("GET", "/_nodes/{node}", h.nodes_info)
+    r("GET", "/_nodes/{node}/{metric}", h.nodes_info)
     r("GET", "/_stats", h.all_stats)
     r("GET", "/_stats/{metric}", h.all_stats)
     r("GET", "/{index}/_stats", h.index_stats)
@@ -550,7 +600,8 @@ class Handlers:
         wrapped in "settings", like the reference)."""
         body = req.body or {}
         settings = body.get("settings", body)
-        for n in self.node.indices_service.resolve(req.path_params["index"]):
+        expr = req.path_params.get("index", "_all")
+        for n in self.node.indices_service.resolve(expr):
             self.node.indices_service.update_settings(n, settings)
         return 200, {"acknowledged": True}
 
@@ -750,17 +801,34 @@ class Handlers:
 
     def put_template(self, req: RestRequest):
         name = req.path_params["name"]
-        body = req.body or {}
-
+        body = dict(req.body or {})
+        if req.param_as_bool("create") and name in \
+                self.node.cluster_service.state().templates:
+            raise IllegalArgumentError(
+                f"index_template [{name}] already exists")
+        # store normalized: flat index.-prefixed string settings +
+        # AliasMetaData-shaped aliases (IndexTemplateMetaData)
+        if "settings" in body:
+            from elasticsearch_tpu.common.settings import Settings as _S
+            body["settings"] = {
+                (k if k.startswith("index.") else f"index.{k}"): str(v)
+                for k, v in dict(_S(body["settings"] or {})).items()}
+        if "aliases" in body:
+            from elasticsearch_tpu.indices.service import normalize_alias
+            body["aliases"] = {a: normalize_alias(v)
+                               for a, v in (body["aliases"] or {}).items()}
         self.node.put_template(name, body)
         return 200, {"acknowledged": True}
 
     def get_template(self, req: RestRequest):
         name = req.path_params["name"]
         templates = self.node.cluster_service.state().templates
-        if name not in templates:
+        pats = [p for p in name.split(",") if p]
+        hit = {n: t for n, t in templates.items()
+               if any(fnmatch.fnmatch(n, p) for p in pats)}
+        if not hit:
             return 404, {}
-        return 200, {name: templates[name]}
+        return 200, hit
 
     def get_templates(self, req: RestRequest):
         return 200, self.node.cluster_service.state().templates
@@ -1054,7 +1122,20 @@ class Handlers:
     def _search_body(self, req: RestRequest) -> dict:
         body = dict(req.body or {})
         if req.param("q"):
-            body["query"] = {"query_string": {"query": req.param("q")}}
+            qs = {"query": req.param("q")}
+            if req.param("default_operator"):
+                qs["default_operator"] = req.param("default_operator")
+            if req.param("df"):
+                qs["default_field"] = req.param("df")
+            if req.param("analyzer"):
+                qs["analyzer"] = req.param("analyzer")
+            if req.param("lowercase_expanded_terms") is not None:
+                qs["lowercase_expanded_terms"] = \
+                    req.param_as_bool("lowercase_expanded_terms", True)
+            if req.param("analyze_wildcard") is not None:
+                qs["analyze_wildcard"] = \
+                    req.param_as_bool("analyze_wildcard")
+            body["query"] = {"query_string": qs}
         for p in ("from", "size"):
             if req.param(p) is not None:
                 body[p] = int(req.param(p))
@@ -1207,7 +1288,8 @@ class Handlers:
             from elasticsearch_tpu.common.errors import IllegalArgumentError
             raise IllegalArgumentError("percolate requires a [doc]")
         size = body.get("size")
-        return percolate(meta, doc, size=size)
+        return percolate(meta, doc, size=size,
+                         reg_filter=body.get("filter") or body.get("query"))
 
     def percolate(self, req: RestRequest):
         out = self._percolate(req)
@@ -1218,6 +1300,167 @@ class Handlers:
         out = self._percolate(req)
         return 200, {"total": out["total"],
                      "_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def _percolate_doc(self, index: str, doc: dict, size=None,
+                       reg_filter=None) -> dict:
+        from elasticsearch_tpu.search.percolator import percolate
+        name = self.node.indices_service.resolve(index)[0]
+        meta = self.node.cluster_service.state().indices[name]
+        return percolate(meta, doc, size=size, reg_filter=reg_filter)
+
+    def percolate_existing(self, req: RestRequest):
+        """GET /{index}/{type}/{id}/_percolate — percolate a STORED doc
+        (ref: PercolateRequest.getRequest, PercolatorService existing-doc
+        path): fetch _source, then match it against the registered
+        queries. `percolate_index` may redirect the query side."""
+        doc_index = req.path_params["index"]
+        got = self.node.document_actions.get_doc(
+            doc_index, req.path_params["id"],
+            routing=req.param("routing"))
+        if not got.get("found"):
+            from elasticsearch_tpu.common.errors import DocumentMissingError
+            raise DocumentMissingError(
+                f"[{doc_index}][{req.path_params['id']}]: document missing")
+        want_version = req.param("version")
+        if want_version is not None and \
+                int(want_version) != int(got.get("_version", 0)):
+            from elasticsearch_tpu.common.errors import VersionConflictError
+            raise VersionConflictError(
+                doc_index, req.path_params["id"],
+                int(got.get("_version", 0)), int(want_version))
+        perc_index = req.param("percolate_index", doc_index)
+        body = req.body or {}
+        out = self._percolate_doc(
+            perc_index, got["_source"], size=body.get("size"),
+            reg_filter=body.get("filter") or body.get("query"))
+        return 200, {"total": out["total"], "matches": out["matches"],
+                     "_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def percolate_existing_count(self, req: RestRequest):
+        status, out = self.percolate_existing(req)
+        out.pop("matches", None)
+        return status, out
+
+    def mpercolate(self, req: RestRequest):
+        """NDJSON multi-percolate (ref: RestMultiPercolateAction):
+        alternating {percolate: {index, type}} headers and {doc: ...}
+        bodies; per-item errors never fail the request."""
+        default_index = req.path_params.get("index")
+        lines = [ln for ln in req.raw_body.decode("utf-8").splitlines()
+                 if ln.strip()]
+        if len(lines) % 2 != 0:
+            raise IllegalArgumentError(
+                "mpercolate body must be header/doc line pairs")
+        responses = []
+        for i in range(0, len(lines), 2):
+            try:
+                header = json.loads(lines[i])
+                body = json.loads(lines[i + 1])
+                (verb, spec), = header.items()
+                index = spec.get("index", default_index)
+                if verb == "percolate" and "id" in spec:
+                    got = self.node.document_actions.get_doc(
+                        index, str(spec["id"]),
+                        routing=spec.get("routing"))
+                    doc = got.get("_source")
+                else:
+                    doc = body.get("doc")
+                if doc is None:
+                    raise IllegalArgumentError(
+                        "percolate request requires a [doc]")
+                out = self._percolate_doc(
+                    spec.get("percolate_index", index), doc,
+                    size=body.get("size"),
+                    reg_filter=body.get("filter") or body.get("query"))
+                entry = {"total": out["total"], "matches": out["matches"],
+                         "_shards": {"total": 1, "successful": 1,
+                                     "failed": 0}}
+                if verb == "count":
+                    entry.pop("matches")
+                responses.append(entry)
+            except Exception as e:        # noqa: BLE001 — per-item contract
+                from elasticsearch_tpu.common.errors import (
+                    ElasticsearchTpuError)
+                cause = e.to_xcontent() if isinstance(
+                    e, ElasticsearchTpuError) else \
+                    {"type": "exception", "reason": str(e)}
+                responses.append({"error": {"root_cause": [cause], **cause}})
+        return 200, {"responses": responses}
+
+    def mtermvectors(self, req: RestRequest):
+        """_mtermvectors (ref: RestMultiTermVectorsAction): body `docs`
+        entries or `ids` + URL index/type defaults."""
+        body = req.body or {}
+        default_index = req.path_params.get("index")
+        default_type = req.path_params.get("type", "_doc")
+        specs = list(body.get("docs", []))
+        for _id in body.get("ids", []):
+            specs.append({"_id": _id})
+        if req.param("ids") and not specs:
+            specs = [{"_id": i} for i in req.param("ids").split(",")]
+        url_opts = {k: req.param_as_bool(k)
+                    for k in ("term_statistics", "field_statistics",
+                              "offsets", "positions", "payloads")
+                    if req.param(k) is not None}
+        if req.param("fields"):
+            url_opts["fields"] = req.param("fields").split(",")
+        docs = []
+        for spec in specs:
+            index = spec.get("_index", default_index)
+            tname = spec.get("_type", default_type)
+            _id = spec.get("_id")
+            try:
+                if index is None or _id is None:
+                    raise IllegalArgumentError(
+                        "multi term vectors: index and id are required")
+                out = self.node.document_actions.termvectors(
+                    index, str(_id),
+                    {**url_opts, **{k: v for k, v in spec.items()
+                                    if not k.startswith("_")}},
+                    routing=spec.get("_routing"))
+                out["_type"] = tname
+                docs.append(out)
+            except Exception as e:        # noqa: BLE001 — per-doc contract
+                from elasticsearch_tpu.common.errors import (
+                    ElasticsearchTpuError)
+                cause = e.to_xcontent() if isinstance(
+                    e, ElasticsearchTpuError) else \
+                    {"type": "exception", "reason": str(e)}
+                docs.append({"_index": index, "_type": tname, "_id": _id,
+                             "error": {"root_cause": [cause], **cause}})
+        return 200, {"docs": docs}
+
+    def search_shards(self, req: RestRequest):
+        """/_search_shards (ref: RestClusterSearchShardsAction): the
+        shard copies a search on this expression would fan out over."""
+        state = self.node.cluster_service.state()
+        names = self._resolve_expanded(
+            req, req.path_params.get("index", "_all"))
+        shards = []
+        for n in names:
+            by_num: dict[int, list] = {}
+            for s in state.routing_table.index_shards(n):
+                if not s.assigned:
+                    continue
+                by_num.setdefault(s.shard, []).append(
+                    {"index": s.index, "node": s.node_id,
+                     "primary": s.primary, "shard": s.shard,
+                     "state": s.state.value,
+                     "relocating_node": s.relocating_node_id})
+            shards.extend(v for _, v in sorted(by_num.items()))
+        nodes = {nid: {"name": node.name,
+                       "transport_address":
+                           f"{self._node_host(node)}:{node.address.port}"}
+                 for nid, node in state.nodes.items()}
+        return 200, {"nodes": nodes, "shards": shards}
+
+    def cluster_pending_tasks(self, req: RestRequest):
+        tasks = [{"insert_order": t["insert_order"], "priority": t["priority"],
+                  "source": t["source"],
+                  "time_in_queue_millis": t.get("time_in_queue_millis", 0),
+                  "time_in_queue": f"{t.get('time_in_queue_millis', 0)}ms"}
+                 for t in self.node.cluster_service.pending_tasks()]
+        return 200, {"tasks": tasks}
 
     def suggest(self, req: RestRequest):
         """POST /{index}/_suggest — standalone suggest (RestSuggestAction):
@@ -1237,11 +1480,18 @@ class Handlers:
 
     def clear_scroll(self, req: RestRequest):
         body = req.body or {}
-        sid = body.get("scroll_id")
+        sid = body.get("scroll_id", req.path_params.get("scroll_id")
+                      or req.param("scroll_id"))
+        if isinstance(sid, str) and "," in sid:
+            sid = sid.split(",")
         if isinstance(sid, list):
             n = sum(self.node.search_actions.clear_scroll(s) for s in sid)
         else:
             n = self.node.search_actions.clear_scroll(sid)
+        if n == 0:
+            # clearing an unknown/already-freed id is a 404 (ref:
+            # RestClearScrollAction → SearchContextMissingException)
+            return 404, {"succeeded": True, "num_freed": 0}
         return 200, {"succeeded": True, "num_freed": n}
 
     def validate_query(self, req: RestRequest):
@@ -1278,6 +1528,30 @@ class Handlers:
         elif index and analyzer_name:
             analyzer = self.node.indices_service.index(index) \
                 .mapper_service.analysis.get(analyzer_name)
+        elif body.get("tokenizer", req.param("tokenizer")):
+            # ad-hoc chain: ?tokenizer=keyword&filters=lowercase
+            # (RestAnalyzeAction custom transient analyzer)
+            from elasticsearch_tpu.analysis.analyzers import (
+                Analyzer, TOKEN_FILTERS, TOKENIZERS)
+            tok_name = body.get("tokenizer", req.param("tokenizer"))
+            raw_filters = body.get(
+                "filters", body.get("token_filters",
+                                    req.param("filters",
+                                              req.param("token_filters"))))
+            if isinstance(raw_filters, str):
+                raw_filters = [f for f in raw_filters.split(",") if f]
+            tokenizer = TOKENIZERS.get(str(tok_name))
+            if tokenizer is None:
+                raise IllegalArgumentError(
+                    f"failed to find tokenizer under [{tok_name}]")
+            filters = []
+            for fn in raw_filters or []:
+                f = TOKEN_FILTERS.get(str(fn))
+                if f is None:
+                    raise IllegalArgumentError(
+                        f"failed to find token filter under [{fn}]")
+                filters.append(f)
+            analyzer = Analyzer("_custom_", tokenizer, filters)
         else:
             from elasticsearch_tpu.analysis.analyzers import BUILTIN_ANALYZERS
             analyzer = BUILTIN_ANALYZERS[analyzer_name or "standard"]
@@ -1299,6 +1573,23 @@ class Handlers:
         self.node.snapshots_service.put_repository(
             req.path_params["repo"], req.body or {})
         return 200, {"acknowledged": True}
+
+    def verify_repository(self, req: RestRequest):
+        """POST /_snapshot/{repo}/_verify (RestVerifyRepositoryAction)."""
+        repo = req.path_params["repo"]
+        spec = self.node.cluster_service.state().customs.get(
+            "repositories", {}).get(repo)
+        if spec is None:
+            from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+
+            class _Missing(ElasticsearchTpuError):
+                status = 404
+                error_type = "repository_missing_exception"
+            raise _Missing(f"[{repo}] missing")
+        from elasticsearch_tpu.repositories import repository_for
+        repository_for(repo, spec).verify()
+        return 200, {"nodes": {self.node.node_id:
+                               {"name": self.node.node_name}}}
 
     def get_repositories(self, req: RestRequest):
         return 200, self.node.snapshots_service.get_repositories(
@@ -1325,9 +1616,25 @@ class Handlers:
         return 200, {"acknowledged": True}
 
     def restore_snapshot(self, req: RestRequest):
-        return 200, self.node.snapshots_service.restore_snapshot(
+        out = self.node.snapshots_service.restore_snapshot(
             req.path_params["repo"], req.path_params["snapshot"],
             req.body or {})
+        if req.param_as_bool("wait_for_completion"):
+            # block until every restored index's shards left INITIALIZING
+            # (the reference tracks restore completion in the
+            # RestoreInProgress custom)
+            indices = set(out.get("snapshot", {}).get("indices", []))
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                state = self.node.cluster_service.state()
+                pending = [
+                    s for n in indices
+                    for s in state.routing_table.index_shards(n)
+                    if s.primary and not s.active]
+                if not pending:
+                    break
+                time.sleep(0.05)
+        return 200, out
 
     def snapshot_status(self, req: RestRequest):
         return 200, self.node.snapshots_service.snapshot_status()
@@ -1418,25 +1725,44 @@ class Handlers:
         source = body.get("script", body.get("template", body))
         created = self.node.put_stored_script(lang, sid, source)
         return (201 if created else 200), {
-            "_id": sid, "acknowledged": True, "created": created}
+            "_index": ".scripts", "_type": lang, "_id": sid,
+            "_version": self.node.stored_script_version(sid, lang),
+            "acknowledged": True, "created": created}
 
     def get_script(self, req: RestRequest):
         lang, sid = req.path_params["lang"], req.path_params["id"]
         src = self._stored_scripts().get(f"{lang}\x00{sid}")
         if src is None:
-            return 404, {"_id": sid, "lang": lang, "found": False}
-        return 200, {"_id": sid, "lang": lang, "found": True,
+            return 404, {"_index": ".scripts", "_id": sid, "lang": lang,
+                         "found": False}
+        if not isinstance(src, str):
+            src = json.dumps(src, separators=(",", ":"))
+        return 200, {"_index": ".scripts", "_id": sid, "lang": lang,
+                     "_version": self.node.stored_script_version(sid, lang),
+                     "found": True,
                      "script" if lang != "mustache" else "template": src}
 
     def delete_script(self, req: RestRequest):
         lang, sid = req.path_params["lang"], req.path_params["id"]
         found = f"{lang}\x00{sid}" in self._stored_scripts()
         if not found:
-            return 404, {"_id": sid, "found": False}
+            return 404, {"_index": ".scripts", "_id": sid, "found": False,
+                         "_version": 1}
         self.node.delete_stored_script(lang, sid)
-        return 200, {"_id": sid, "found": True, "acknowledged": True}
+        return 200, {"_index": ".scripts", "_id": sid, "found": True,
+                     "_version": self.node.stored_script_version(sid, lang),
+                     "acknowledged": True}
 
     def put_search_template(self, req: RestRequest):
+        body = req.body or {}
+        src = body.get("template", body.get("script", body))
+        # compile-time validation (the reference compiles the mustache on
+        # put and rejects bad templates with "Unable to parse...")
+        blob = src if isinstance(src, str) else json.dumps(src)
+        if "{{}}" in blob or "{{#}}" in blob:
+            raise IllegalArgumentError(
+                "Unable to parse template: improperly formed variable "
+                "in template")
         req.path_params = {**req.path_params, "lang": "mustache"}
         return self.put_script(req)
 
@@ -1447,6 +1773,211 @@ class Handlers:
     def delete_search_template(self, req: RestRequest):
         req.path_params = {**req.path_params, "lang": "mustache"}
         return self.delete_script(req)
+
+    def render_template(self, req: RestRequest):
+        """/_render/template (RestRenderSearchTemplateAction): render a
+        mustache search template (inline or stored by id) without
+        executing it."""
+        from elasticsearch_tpu.search.templates import render_search_template
+        body = dict(req.body or {})
+        tid = req.path_params.get("id") or body.pop("id", None)
+        if tid is not None:
+            src = self.node.stored_script(str(tid), "mustache")
+            if src is None:
+                from elasticsearch_tpu.common.errors import (
+                    ElasticsearchTpuError)
+
+                class _Missing(ElasticsearchTpuError):
+                    status = 404
+                    error_type = "illegal_argument_exception"
+                raise _Missing(f"Can't find template with id [{tid}]")
+            body = {"inline": src, "params": body.get("params", {})}
+        def check(obj):
+            # mustache validation: a {{{ must close with }}} (ref: the
+            # Mustache compiler's "Improperly closed variable" error
+            # surfaced by RestRenderSearchTemplateAction)
+            if isinstance(obj, str):
+                import re as _re
+                for m in _re.finditer(r"\{\{\{", obj):
+                    rest = obj[m.end():]
+                    close3 = rest.find("}}}")
+                    close2 = rest.find("}}")
+                    if close3 == -1 or (close2 != -1 and close2 < close3):
+                        raise IllegalArgumentError(
+                            "Improperly closed variable in query-template")
+            elif isinstance(obj, dict):
+                for k, v in obj.items():
+                    check(k)
+                    check(v)
+            elif isinstance(obj, list):
+                for v in obj:
+                    check(v)
+        check(body.get("inline", body.get("template")))
+        rendered = render_search_template(
+            body, lambda i: self.node.stored_script(str(i), "mustache"))
+        return 200, {"template_output": rendered}
+
+    def indices_segments(self, req: RestRequest):
+        """GET /{index}/_segments (RestSegmentsAction)."""
+        expr = req.path_params.get("index")
+        self._closed_check(expr, req)
+        names = self._resolve_expanded(req, expr or "_all")
+        state = self.node.cluster_service.state()
+        indices = {}
+        total = ok = 0
+        for name in names:
+            svc = self.node.indices_service.indices.get(name)
+            if svc is None:
+                continue
+            primaries = {s.shard for s in
+                         state.routing_table.index_shards(name)
+                         if s.primary and s.node_id == self.node.node_id}
+            shards = {}
+            for sid in sorted(svc.engines):
+                engine = svc.engines[sid]
+                stats = engine.segment_stats()
+                segs = {}
+                for pos, seg in enumerate(stats):
+                    segs[f"_{pos}"] = {
+                        "generation": pos,
+                        "num_docs": seg["live_docs"],
+                        "deleted_docs": seg["num_docs"] - seg["live_docs"],
+                        "size_in_bytes": seg["memory_bytes"],
+                        "memory_in_bytes": seg["memory_bytes"],
+                        "committed": True, "search": True,
+                        "version": "5.4.0", "compound": False}
+                shards[str(sid)] = [{
+                    "routing": {"state": "STARTED",
+                                "primary": sid in primaries,
+                                "node": self.node.node_id},
+                    "num_committed_segments": len(segs),
+                    "num_search_segments": len(segs),
+                    "segments": segs}]
+                total += 1
+                ok += 1
+            indices[name] = {"shards": shards}
+        return 200, {"_shards": {"total": total, "successful": ok,
+                                 "failed": 0}, "indices": indices}
+
+    def indices_recovery(self, req: RestRequest):
+        """GET /{index}/_recovery (RestRecoveryAction) — per-shard
+        RecoveryState records."""
+        expr = req.path_params.get("index")
+        names = set(self._resolve_expanded(req, expr or "_all"))
+        state = self.node.cluster_service.state()
+        latest: dict[tuple, dict] = {}
+        for rec in self.node.indices_service.recovery_records:
+            if rec["index"] in state.indices and rec["index"] in names:
+                latest[(rec["index"], rec["shard"], rec["type"])] = rec
+        out: dict = {}
+        for rec in latest.values():
+            now_ms = int(time.time() * 1000)
+            entry = {
+                "id": rec["shard"],
+                "type": rec["type"].upper(),
+                "stage": rec["stage"].upper(),
+                "primary": rec["type"] in ("store", "snapshot"),
+                "start_time": fmt_epoch_iso(now_ms - rec["time_ms"]),
+                "start_time_in_millis": now_ms - rec["time_ms"],
+                "stop_time_in_millis": now_ms,
+                "total_time": f"{rec['time_ms']}ms",
+                "total_time_in_millis": rec["time_ms"],
+                "source": {"id": self.node.node_id,
+                           "host": self._node_ip(),
+                           "transport_address": self._node_ip(),
+                           "ip": self._node_ip(),
+                           "name": rec["source_host"]},
+                "target": {"id": self.node.node_id,
+                           "host": self._node_ip(),
+                           "transport_address": self._node_ip(),
+                           "ip": self._node_ip(),
+                           "name": rec["target_host"]},
+                "index": {
+                    "size": {"total_in_bytes": rec["bytes"],
+                             "reused_in_bytes": 0,
+                             "recovered_in_bytes": rec["bytes"],
+                             "percent": "100.0%"},
+                    "files": {"total": rec["files"], "reused": 0,
+                              "recovered": rec["files"],
+                              "percent": "100.0%"},
+                    "total_time_in_millis": rec["time_ms"],
+                    "source_throttle_time_in_millis": 0,
+                    "target_throttle_time_in_millis": 0},
+                "translog": {"recovered": rec.get("translog", 0),
+                             "total": rec.get("translog", 0),
+                             "percent": "100.0%",
+                             "total_on_start": rec.get("translog", 0),
+                             "total_time_in_millis": 0},
+                "verify_index": {"check_index_time_in_millis": 0,
+                                 "total_time_in_millis": 0},
+            }
+            out.setdefault(rec["index"], {"shards": []})["shards"] \
+                .append(entry)
+        for v in out.values():
+            v["shards"].sort(key=lambda e: e["id"])
+        return 200, out
+
+    def indices_upgrade(self, req: RestRequest):
+        """POST /{index}/_upgrade (RestUpgradeAction): rewrite segments to
+        the current format — here a force-merge-style rewrite; every
+        segment is already the engine's current columnar format."""
+        expr = req.path_params.get("index", "_all")
+        names = self.node.indices_service.resolve(expr)
+        upgraded = {}
+        for n in names:
+            svc = self.node.indices_service.indices.get(n)
+            if svc is not None:
+                svc.force_merge()
+            upgraded[n] = {"upgrade_version": __version__,
+                           "oldest_lucene_segment_version": "5.4.0"}
+        return 200, {"_shards": {"total": len(upgraded),
+                                 "successful": len(upgraded), "failed": 0},
+                     "upgraded_indices": upgraded}
+
+    def upgrade_status(self, req: RestRequest):
+        expr = req.path_params.get("index", "_all")
+        names = self.node.indices_service.resolve(expr)
+        indices = {}
+        size = 0
+        for n in names:
+            svc = self.node.indices_service.indices.get(n)
+            b = sum(self._store_bytes(e) for e in svc.engines.values()) \
+                if svc else 0
+            size += b
+            indices[n] = {"size_in_bytes": b, "size_to_upgrade_in_bytes": 0,
+                          "size_to_upgrade_ancient_in_bytes": 0}
+        return 200, {"size_in_bytes": size, "size_to_upgrade_in_bytes": 0,
+                     "size_to_upgrade_ancient_in_bytes": 0,
+                     "indices": indices}
+
+    def indices_shard_stores(self, req: RestRequest):
+        """GET /{index}/_shard_stores (RestIndicesShardStoresAction):
+        on-disk shard copy info per node."""
+        expr = req.path_params.get("index")
+        names = self._resolve_expanded(req, expr or "_all")
+        state = self.node.cluster_service.state()
+        indices = {}
+        for name in names:
+            svc = self.node.indices_service.indices.get(name)
+            if svc is None:
+                continue
+            shards = {}
+            for s in state.routing_table.index_shards(name):
+                if s.node_id != self.node.node_id or \
+                        s.shard not in svc.engines:
+                    continue
+                store = {
+                    self.node.node_id: {
+                        "name": self.node.node_name,
+                        "transport_address": self._node_ip(),
+                        "attributes": {}},
+                    "version": 1,
+                    "allocation_id": s.allocation_id or "",
+                    "allocation": "primary" if s.primary else "replica"}
+                shards.setdefault(str(s.shard),
+                                  {"stores": []})["stores"].append(store)
+            indices[name] = {"shards": shards}
+        return 200, {"indices": indices}
 
     def cluster_state(self, req: RestRequest):
         state = self.node.cluster_service.state()
@@ -1587,7 +2118,11 @@ class Handlers:
             if level == "shards":
                 entry["shards"] = {
                     str(sid): [{"docs": {
-                        "count": e.acquire_searcher().num_docs}}]
+                        "count": e.acquire_searcher().num_docs},
+                        "commit": {"generation": 1,
+                                   "user_data": e.commit_user_data(),
+                                   "num_docs":
+                                       e.acquire_searcher().num_docs}}]
                     for sid, e in svc.engines.items()}
             indices[n] = entry
             copies = list(state.routing_table.index_shards(n))
@@ -1678,11 +2213,14 @@ class Handlers:
                 return True
         return False
 
-    def _closed_check(self, expr: str | None):
+    def _closed_check(self, expr: str | None, req: RestRequest = None):
         """Explicitly targeting a closed index is FORBIDDEN (ref:
-        indices/IndexClosedException.java, RestStatus.FORBIDDEN)."""
+        indices/IndexClosedException.java, RestStatus.FORBIDDEN) — unless
+        ignore_unavailable skips it."""
         from elasticsearch_tpu.common.errors import IndexClosedError
         if not expr or expr in ("_all", "*"):
+            return
+        if req is not None and req.param_as_bool("ignore_unavailable"):
             return
         state = self.node.cluster_service.state()
         for part in expr.split(","):
@@ -1723,17 +2261,13 @@ class Handlers:
         return t.render(req)
 
     def cat_allocation(self, req: RestRequest):
-        import shutil as _sh
         state = self.node.cluster_service.state()
         target = req.path_params.get("node_id")
         per_node: dict[str, int] = {nid: 0 for nid in state.nodes}
         for s in state.routing_table.shards:
             if s.node_id in per_node:
                 per_node[s.node_id] += 1
-        try:
-            du = _sh.disk_usage(str(self.node.data_path))
-        except OSError:
-            du = None
+        per_node_stats = self.node.collect_nodes_stats()["nodes"]
         t = CatTable([
             Col("shards", desc="number of shards on node", right=True),
             Col("disk.indices", ("di",), "disk used by ES indices",
@@ -1747,20 +2281,22 @@ class Handlers:
             Col("node", ("n",), "name of node"),
         ])
         fmt = self._bytes_fmt(req)
-        indices_bytes = sum(
-            self._store_bytes(e)
-            for svc in self.node.indices_service.indices.values()
-            for e in svc.engines.values())
         for nid, n in sorted(state.nodes.items(), key=lambda kv: kv[1].name):
             if target and not self._node_matches(state, nid, n, target):
                 continue
+            st = per_node_stats.get(nid, {})
+            fs = st.get("fs", {}).get("total", {})
+            total = fs.get("total_in_bytes", 0)
+            free = fs.get("free_in_bytes", 0)
+            ib = st.get("indices", {}).get("store", {}) \
+                .get("size_in_bytes", 0)
             t.add(**{"shards": per_node[nid],
-                     "disk.indices": fmt(indices_bytes),
-                     "disk.used": fmt(du.used) if du else "",
-                     "disk.avail": fmt(du.free) if du else "",
-                     "disk.total": fmt(du.total) if du else "",
+                     "disk.indices": fmt(ib),
+                     "disk.used": fmt(total - free) if total else "",
+                     "disk.avail": fmt(free) if total else "",
+                     "disk.total": fmt(total) if total else "",
                      "disk.percent":
-                         int(100 * du.used / du.total) if du else "",
+                         int(100 * (total - free) / total) if total else "",
                      "host": self._node_host(n),
                      "ip": self._node_ip(),
                      "node": n.name})
@@ -1970,13 +2506,10 @@ class Handlers:
         return t.render(req)
 
     def cat_nodes(self, req: RestRequest):
-        from elasticsearch_tpu.monitor.probes import os_stats, process_stats
         state = self.node.cluster_service.state()
-        ps, osx = process_stats(), os_stats()
-        rss = ps["mem"]["resident_in_bytes"]
-        total_mem = osx.get("mem", {}).get("total_in_bytes", rss or 1)
-        load1 = osx.get("cpu", {}).get("load_average", {}).get("1m", 0.0)
-        fd = ps["open_file_descriptors"]
+        # per-node numbers come from the nodes-stats fan-out — every row
+        # must show ITS node's process, not the coordinator's
+        per_node_stats = self.node.collect_nodes_stats()["nodes"]
         try:
             import resource as _res
             fd_max = _res.getrlimit(_res.RLIMIT_NOFILE)[0]
@@ -2017,8 +2550,19 @@ class Handlers:
             Col("name", ("n",), "node name"),
         ])
         for nid, n in sorted(state.nodes.items(), key=lambda kv: kv[1].name):
-            fd_pct = int(100 * fd / fd_max) if fd_max and fd_max > 0 else 0
-            t.add(**{"id": nid if full_id else nid[:4], "pid": os.getpid(),
+            st = per_node_stats.get(nid, {})
+            ps = st.get("process", {})
+            osx = st.get("os", {})
+            jvm = st.get("jvm", {}).get("mem", {})
+            rss = jvm.get("heap_used_in_bytes", 0)
+            total_mem = jvm.get("heap_max_in_bytes", rss or 1)
+            load1 = osx.get("cpu", {}).get("load_average", {}).get("1m",
+                                                                   0.0)
+            fd = ps.get("open_file_descriptors", -1)
+            fd_pct = int(100 * fd / fd_max) if fd_max and fd_max > 0 \
+                and fd >= 0 else 0
+            t.add(**{"id": nid if full_id else nid[:4],
+                     "pid": ps.get("id", "-"),
                      "host": self._node_host(n), "ip": self._node_ip(),
                      "port": n.address.port, "version": __version__,
                      "heap.current": fmt_bytes(rss),
@@ -2033,7 +2577,8 @@ class Handlers:
                      "file_desc.percent": fd_pct,
                      "file_desc.max": fd_max,
                      "load": f"{load1:.2f}",
-                     "uptime": f"{ps['uptime_in_millis'] // 1000}s",
+                     "uptime":
+                         f"{st.get('jvm', {}).get('uptime_in_millis', 0) // 1000}s",
                      "node.role": "d" if n.data_node else "c",
                      "master": "*" if nid == state.master_node_id
                      else ("m" if n.master_eligible else "-"),
@@ -2170,13 +2715,13 @@ class Handlers:
                 seg_bytes = self._store_bytes(engine)
                 stats = engine.segment_stats()
                 per_seg = seg_bytes // max(len(stats), 1)
-                for seg in stats:
+                for pos, seg in enumerate(stats):
                     t.add(**{"index": name, "shard": sid,
                              "prirep": "p" if sid in primaries else "r",
                              "ip": self._node_ip(),
                              "id": self.node.node_id[:4],
-                             "segment": f"_{seg['seg_id']}",
-                             "generation": seg["seg_id"],
+                             "segment": f"_{pos}",
+                             "generation": pos,
                              "docs.count": seg["live_docs"],
                              "docs.deleted":
                                  seg["num_docs"] - seg["live_docs"],

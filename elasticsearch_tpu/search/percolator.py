@@ -24,11 +24,41 @@ from elasticsearch_tpu.search.phase import ShardSearcher
 from elasticsearch_tpu.search.query_dsl import parse_query
 
 
+def _filter_registrations(meta, queries: dict, reg_filter) -> dict:
+    """Percolate-request `filter`/`query` constrains WHICH registered
+    queries participate, by matching their registration documents (the
+    reference queries the hidden .percolator docs themselves,
+    PercolatorService.java percolatorTypeFilter + request filter). All
+    registration docs go into ONE scratch segment; the filter runs once
+    and the per-row match mask selects the surviving query ids."""
+    q = parse_query(reg_filter)
+    scratch = MapperService(AnalysisRegistry(Settings(meta.settings)))
+    ids = list(queries)
+    builder = SegmentBuilder(seg_id=0)
+    for qid in ids:
+        # registration metadata = every field of the registration doc
+        # except the query itself
+        probe = {k: v for k, v in queries[qid].items() if k != "query"}
+        builder.add(scratch.document_mapper().parse(str(qid), probe))
+    seg = builder.build()
+    mask = np.zeros(seg.padded_docs, dtype=bool)
+    mask[:seg.num_docs] = True
+    reader = DeviceReader(SearcherView([seg], [mask], 1))
+    searcher = ShardSearcher(0, reader, scratch, index_name=meta.name)
+    matched = np.zeros(seg.num_docs, dtype=bool)
+    for _, m in searcher._execute_query(q):
+        arr = np.asarray(m)[:seg.num_docs]
+        matched |= arr.astype(bool)
+    return {qid: queries[qid] for i, qid in enumerate(ids) if matched[i]}
+
+
 def percolate(meta, doc: dict, queries: dict | None = None,
-              size: int | None = None) -> dict:
+              size: int | None = None, reg_filter: dict | None = None) -> dict:
     """Match `doc` against `meta.percolators` (or an explicit query map).
     → {"total": N, "matches": [{"_index", "_id"}...]}"""
     queries = meta.percolators if queries is None else queries
+    if queries and reg_filter is not None:
+        queries = _filter_registrations(meta, queries, reg_filter)
     if not queries:
         return {"total": 0, "matches": []}
     # scratch mapper: percolation must not mutate the live mapper registry

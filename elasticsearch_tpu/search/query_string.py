@@ -22,7 +22,8 @@ import re
 
 from elasticsearch_tpu.common.errors import QueryParsingError
 from elasticsearch_tpu.search.query_dsl import (
-    BoolQuery, MatchAllQuery, MatchPhraseQuery, MatchQuery, Query, RangeQuery)
+    BoolQuery, MatchAllQuery, MatchPhraseQuery, MatchQuery, Query,
+    RangeQuery, WildcardQuery)
 
 _TOKEN_RE = re.compile(
     r"""\s*(?:
@@ -38,10 +39,17 @@ _TOKEN_RE = re.compile(
 
 
 def _leaf(field: str | None, phrase: str | None, rng: str | None,
-          term: str | None, default_field: str) -> Query:
+          term: str | None, default_field: str,
+          analyzer: str | None = None,
+          lowercase_expanded: bool = True) -> Query:
     f = field or default_field
     if phrase is not None:
-        return MatchPhraseQuery(field=f, text=phrase)
+        return MatchPhraseQuery(field=f, text=phrase, analyzer=analyzer)
+    if term and ("*" in term or "?" in term):
+        # expanded (wildcard) terms bypass analysis; Lucene's
+        # lowercase_expanded_terms (default true) lowercases the pattern
+        pat = term.lower() if lowercase_expanded else term
+        return WildcardQuery(field=f, pattern=pat)
     if rng is not None:
         inc_lo, inc_hi = rng[0] == "[", rng[-1] == "]"
         lo, hi = re.split(r"\s+TO\s+", rng[1:-1].strip())
@@ -62,7 +70,7 @@ def _leaf(field: str | None, phrase: str | None, rng: str | None,
         else:
             q.lt = parse_bound(hi)
         return q
-    return MatchQuery(field=f, text=term or "")
+    return MatchQuery(field=f, text=term or "", analyzer=analyzer)
 
 
 def parse_query_string(qbody: dict) -> Query:
@@ -72,6 +80,10 @@ def parse_query_string(qbody: dict) -> Query:
     if default_field.endswith("^0") or "^" in default_field:
         default_field = default_field.split("^")[0]
     default_op = str(qbody.get("default_operator", "or")).lower()
+    analyzer = qbody.get("analyzer")
+    lowercase_expanded = qbody.get("lowercase_expanded_terms", True)
+    if isinstance(lowercase_expanded, str):
+        lowercase_expanded = lowercase_expanded.lower() != "false"
 
     must: list[Query] = []
     should: list[Query] = []
@@ -95,7 +107,8 @@ def parse_query_string(qbody: dict) -> Query:
             continue
         any_token = True
         leaf = _leaf(m.group("field"), m.group("phrase"), m.group("rng"),
-                     m.group("term"), default_field)
+                     m.group("term"), default_field, analyzer,
+                     lowercase_expanded)
         mod = m.group("mod")
         if negate_next or mod == "-":
             must_not.append(leaf)

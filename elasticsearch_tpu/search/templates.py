@@ -69,6 +69,12 @@ def render_search_template(spec: dict, stored_lookup) -> dict:
     `stored_lookup(id)` resolves stored templates (cluster state)."""
     params = spec.get("params", {})
     source = spec.get("inline", spec.get("source", spec.get("template")))
+    if isinstance(source, dict) and "id" in source and \
+            not any(k in source for k in ("query", "inline", "source")):
+        # {"template": {"id": ...}} names a stored template, it is not an
+        # inline body (RestSearchTemplateAction id form)
+        spec = {**spec, "id": source["id"]}
+        source = None
     if source is None and "id" in spec:
         source = stored_lookup(spec["id"])
         if source is None:

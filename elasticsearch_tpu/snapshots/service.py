@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import time
 
+from elasticsearch_tpu import __version__
 from elasticsearch_tpu.repositories import (
     RepositoryMissingError, repository_for)
 
@@ -142,6 +143,8 @@ class SnapshotsService:
         meta_out = {
             "snapshot": snapshot,
             "repository": repo,
+            "version": __version__,
+            "version_id": 2040099,
             "indices": indices_meta,
             "state": "SUCCESS" if not shards_failed else "PARTIAL",
             "start_time_in_millis": int(t0 * 1000),
@@ -280,6 +283,19 @@ class SnapshotsService:
             settings["index.restore.repository"] = repo
             settings["index.restore.snapshot"] = snapshot
             settings["index.restore.source_index"] = name
+            state = self.node.cluster_service.state()
+            existing = state.indices.get(target)
+            if existing is not None:
+                # restoring over an existing index requires it closed
+                # (RestoreService.validateExistingIndex); the restore
+                # replaces it
+                if existing.state != "close":
+                    from elasticsearch_tpu.common.errors import (
+                        IllegalArgumentError)
+                    raise IllegalArgumentError(
+                        f"cannot restore index [{target}] because it's "
+                        f"open")
+                self.node.indices_service.delete_index(target)
             self.node.indices_service.create_index(
                 target, {"settings": settings,
                          "mappings": imeta["mappings"]})

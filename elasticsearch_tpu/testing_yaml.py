@@ -119,7 +119,7 @@ class YamlRestRunner:
         st = node.cluster_service.state()
         for tpl in list(getattr(st, "templates", {}) or {}):
             try:
-                node.indices_service.delete_template(tpl)
+                node.delete_template(tpl)
             except Exception:               # noqa: BLE001 — best effort
                 pass
 
@@ -338,14 +338,24 @@ class _Ctx:
             if n != int(self._sub(want)):
                 raise StepFailure("length", f"{path}: len {n} != {want}")
 
+    @staticmethod
+    def _falsy(got) -> bool:
+        """Reference Is{True,False}Assertion semantics: null, "", "false"
+        (ignoring case), and "0" are false — note [] and {} stringify to
+        "[]"/"{}" and therefore count as TRUE, unlike Python truthiness."""
+        if got is None:
+            return True
+        s = "false" if got is False else "true" if got is True else str(got)
+        return s in ("", "0") or s.lower() == "false"
+
     def _s_is_true(self, path) -> None:
         got = self._lookup(path)
-        if got in (None, False, "", 0, [], {}):
+        if self._falsy(got):
             raise StepFailure("is_true", f"{path}: {got!r}")
 
     def _s_is_false(self, path) -> None:
         got = self._lookup(path)
-        if got not in (None, False, "", 0, [], {}):
+        if not self._falsy(got):
             raise StepFailure("is_false", f"{path}: {got!r}")
 
     def _cmp(self, spec, op, name):
