@@ -25,6 +25,28 @@ from elasticsearch_tpu.transport.service import (
     RemoteTransportError, TransportException)
 
 
+def update_get_section(source: dict | None, version,
+                       wanted) -> dict:
+    """The update API's `fields` → "get" section, built from the source
+    the update just APPLIED (UpdateHelper.extractGetResult — no re-get,
+    so a concurrent write can't leak into the response)."""
+    from elasticsearch_tpu.common.settings import source_from_path as _sfp
+    if isinstance(wanted, str):
+        wanted = wanted.split(",")
+    section: dict = {"found": True, "_version": version}
+    fvals = {}
+    for f in wanted or []:
+        if f == "_source":
+            section["_source"] = source
+            continue
+        v = _sfp(source or {}, f)
+        if v is not None:
+            fvals[f] = v if isinstance(v, list) else [v]
+    if fvals:
+        section["fields"] = fvals
+    return section
+
+
 def unwrap_remote(e: Exception) -> Exception:
     """RemoteTransportException.unwrapCause analog."""
     if isinstance(e, RemoteTransportError):
@@ -242,15 +264,23 @@ class DocumentActions:
     def index_doc(self, index: str, doc_id: str | None, source: dict,
                   routing: str | None = None, version: int | None = None,
                   op_type: str = "index", refresh: bool = False,
-                  version_type: str = "internal") -> dict:
+                  version_type: str = "internal",
+                  meta: dict | None = None) -> dict:
         name = self._resolve_write_index(index)
         doc_id = doc_id or uuid.uuid4().hex[:20]
+        # a child doc routes by its parent id so the family shares a shard
+        # (ref: TransportIndexAction resolveRequest — routing defaults to
+        # parent)
+        if routing is None and meta and meta.get("_parent") is not None:
+            routing = str(meta["_parent"])
+        if routing is not None:
+            meta = {**(meta or {}), "_routing": routing}
         shard = self._shard_id(name, doc_id, routing)
         request = {"index": name, "shard": shard, "id": doc_id,
                    "source": source, "routing": routing,
                    "version": version, "op_type": op_type,
                    "version_type": version_type,
-                   "refresh": refresh}
+                   "refresh": refresh, "meta": meta}
         return self._on_primary(name, shard, request, self.INDEX_P,
                                 self._handle_index_p_local)
 
@@ -266,14 +296,16 @@ class DocumentActions:
             version=MATCH_ANY if version is None else version,
             routing=request.get("routing"),
             op_type=request.get("op_type", "index"),
-            version_type=request.get("version_type", "internal"))
+            version_type=request.get("version_type", "internal"),
+            meta=request.get("meta"))
         if request.get("refresh"):
             engine.refresh()
         total, ok, failures = self._replicate(
             name, shard, self.INDEX_R,
             {"index": name, "shard": shard, "id": request["id"],
              "source": request["source"], "routing": request.get("routing"),
-             "version": v, "refresh": bool(request.get("refresh"))})
+             "version": v, "refresh": bool(request.get("refresh")),
+             "meta": request.get("meta")})
         return {"_index": name, "_type": "_doc", "_id": request["id"],
                 "_version": v,
                 "result": "created" if created else "updated",
@@ -284,7 +316,8 @@ class DocumentActions:
         engine = self._engine(request["index"], request["shard"])
         engine.index_replica(request["id"], request["source"],
                              request["version"],
-                             routing=request.get("routing"))
+                             routing=request.get("routing"),
+                             meta=request.get("meta"))
         if request.get("refresh"):
             engine.refresh()
         return {}
@@ -336,7 +369,8 @@ class DocumentActions:
 
     def update_doc(self, index: str, doc_id: str, body: dict,
                    routing: str | None = None, refresh: bool = False,
-                   version: int | None = None) -> dict:
+                   version: int | None = None,
+                   meta: dict | None = None) -> dict:
         if version is not None and ("upsert" in body
                                     or body.get("doc_as_upsert")):
             # the reference rejects this combination up front: a versioned
@@ -348,10 +382,14 @@ class DocumentActions:
         name = self._resolve_write_index(index) \
             if ("upsert" in body or body.get("doc_as_upsert")) \
             else self._resolve_single(index)
+        if routing is None and meta and meta.get("_parent") is not None:
+            routing = str(meta["_parent"])
+        if routing is not None:
+            meta = {**(meta or {}), "_routing": routing}
         shard = self._shard_id(name, doc_id, routing)
         request = {"index": name, "shard": shard, "id": doc_id, "body": body,
                    "routing": routing, "refresh": refresh,
-                   "req_version": version}
+                   "req_version": version, "meta": meta}
         return self._on_primary(name, shard, request, self.UPDATE_P,
                                 self._handle_update_local)
 
@@ -368,13 +406,17 @@ class DocumentActions:
             if "upsert" in body or body.get("doc_as_upsert"):
                 # doc_as_upsert: the partial doc IS the upsert document
                 # (UpdateHelper.prepare, TransportUpdateAction)
-                return self._handle_index_p_local(
+                upsert_src = body["upsert"] if "upsert" in body \
+                    else body.get("doc", {})
+                out = self._handle_index_p_local(
                     {"index": name, "shard": shard, "id": request["id"],
-                     "source": body["upsert"] if "upsert" in body
-                     else body.get("doc", {}),
+                     "source": upsert_src,
                      "routing": request.get("routing"), "version": None,
                      "op_type": "index",
-                     "refresh": bool(request.get("refresh"))})
+                     "refresh": bool(request.get("refresh")),
+                     "meta": request.get("meta")})
+                out["_update_source"] = upsert_src
+                return out
             raise DocumentMissingError(name, request["id"])
         if request.get("req_version") is not None and \
                 current.version != request["req_version"]:
@@ -388,12 +430,20 @@ class DocumentActions:
                                           body["script"])
         else:
             merged = dict(current.source)
+        # carry existing metadata forward, overridden by the request's
+        # (a fresh ttl/timestamp restamps; parent/type persist)
+        new_meta = dict(current.meta or {})
+        new_meta.update(request.get("meta") or {})
         out = self._handle_index_p_local(
             {"index": name, "shard": shard, "id": request["id"],
              "source": merged, "routing": request.get("routing"),
              "version": current.version, "op_type": "index",
-             "refresh": bool(request.get("refresh"))})
+             "refresh": bool(request.get("refresh")),
+             "meta": new_meta or None})
         out["result"] = "updated"
+        # the applied source rides along so callers can answer `fields`
+        # without a racy re-get (UpdateHelper.extractGetResult)
+        out["_update_source"] = merged
         return out
 
     # ---- get (TransportSingleShardAction: one copy, failover) --------------
@@ -585,24 +635,40 @@ class DocumentActions:
         if r.found:
             out["_version"] = r.version
             out["_source"] = r.source
+            for key, value in (r.meta or {}).items():
+                if key == "_type":
+                    out["_type"] = value
+                elif key == "_ttl":
+                    # _ttl reads back as REMAINING millis (TTLFieldMapper)
+                    out["_ttl"] = int(value) - int(time.time() * 1000)
+                else:
+                    out[key] = value
         return out
 
-    def mget(self, body: dict, default_index: str | None = None) -> dict:
+    def mget(self, body: dict, default_index: str | None = None,
+             realtime: bool = True, refresh: bool = False) -> dict:
         docs = []
         for spec in body.get("docs", []):
             idx = spec.get("_index", default_index)
             did = str(spec["_id"])
+            routing = spec.get("routing",
+                               spec.get("_routing",
+                                        spec.get("parent",
+                                                 spec.get("_parent"))))
             try:
-                docs.append(self.get_doc(idx, did,
-                                         routing=spec.get("routing",
-                                                          spec.get("_routing"))))
+                docs.append(self.get_doc(
+                    idx, did,
+                    routing=None if routing is None else str(routing),
+                    realtime=realtime, refresh=refresh))
             except ElasticsearchTpuError as e:
                 docs.append({"_index": idx, "_id": did, "found": False,
                              "error": e.to_xcontent()})
         if "ids" in body and default_index:
             for did in body["ids"]:
                 try:
-                    docs.append(self.get_doc(default_index, str(did)))
+                    docs.append(self.get_doc(default_index, str(did),
+                                             realtime=realtime,
+                                             refresh=refresh))
                 except ElasticsearchTpuError as e:
                     docs.append({"_index": default_index, "_id": str(did),
                                  "found": False,
@@ -628,9 +694,16 @@ class DocumentActions:
                 name = resolved[index]
                 doc_id = meta.get("_id") or uuid.uuid4().hex[:20]
                 routing = meta.get("routing", meta.get("_routing"))
+                doc_meta = meta.get("_meta_fields")
+                if routing is None and doc_meta and \
+                        doc_meta.get("_parent") is not None:
+                    routing = str(doc_meta["_parent"])
+                if routing is not None:
+                    doc_meta = {**(doc_meta or {}),
+                                "_routing": str(routing)}
                 shard = self._shard_id(name, doc_id, routing)
                 by_shard.setdefault((name, shard), []).append(
-                    (pos, (action, doc_id, routing, source)))
+                    (pos, (action, doc_id, routing, source, doc_meta)))
             except Exception as e:               # noqa: BLE001 — per item
                 errors = True
                 items[pos] = self._bulk_error_item(action, index,
@@ -638,8 +711,9 @@ class DocumentActions:
         for (name, shard), group in by_shard.items():
             request = {"index": name, "shard": shard, "refresh": refresh,
                        "items": [
-                           {"action": a, "id": d, "routing": r, "source": s}
-                           for _, (a, d, r, s) in group]}
+                           {"action": a, "id": d, "routing": r, "source": s,
+                            "meta": m}
+                           for _, (a, d, r, s, m) in group]}
             try:
                 resp = self._on_primary(name, shard, request, self.BULK_P,
                                         self._handle_bulk_p_local)
@@ -650,7 +724,7 @@ class DocumentActions:
                         errors = True
             except Exception as e:               # noqa: BLE001 — whole shard
                 errors = True
-                for pos, (action, doc_id, _r, _s) in group:
+                for pos, (action, doc_id, _r, _s, _m) in group:
                     items[pos] = self._bulk_error_item(action, name, doc_id, e)
         return {"took": int((time.perf_counter() - t0) * 1e3),
                 "errors": errors, "items": items}
@@ -681,11 +755,13 @@ class DocumentActions:
                     v, created = engine.index(
                         item["id"], item["source"],
                         routing=item.get("routing"),
-                        op_type="create" if action == "create" else "index")
+                        op_type="create" if action == "create" else "index",
+                        meta=item.get("meta"))
                     replica_ops.append({"op": "index", "id": item["id"],
                                         "source": item["source"],
                                         "routing": item.get("routing"),
-                                        "version": v})
+                                        "version": v,
+                                        "meta": item.get("meta")})
                     r = {"_index": name, "_type": "_doc", "_id": item["id"],
                          "_version": v,
                          "result": "created" if created else "updated",
@@ -699,13 +775,20 @@ class DocumentActions:
                          "_version": v, "result": "deleted", "found": True,
                          "status": 200}
                 elif action == "update":
+                    ubody = item.get("source") or {}
                     r = {**self._handle_update_local(
                         {"index": name, "shard": shard, "id": item["id"],
-                         "body": item.get("source") or {},
+                         "body": ubody,
                          "routing": item.get("routing"),
-                         "refresh": bool(request.get("refresh"))}),
+                         "refresh": bool(request.get("refresh")),
+                         "meta": item.get("meta")}),
                         "status": 200}
                     # update replicates itself via _handle_index_p_local
+                    src = r.pop("_update_source", None)
+                    wanted = ubody.get("fields")
+                    if wanted:
+                        r["get"] = update_get_section(
+                            src, r.get("_version"), wanted)
                 else:
                     raise ValueError(f"unknown bulk action [{action}]")
                 items_out.append({action: r})
@@ -726,7 +809,8 @@ class DocumentActions:
         for op in request["ops"]:
             if op["op"] == "index":
                 engine.index_replica(op["id"], op["source"], op["version"],
-                                     routing=op.get("routing"))
+                                     routing=op.get("routing"),
+                                     meta=op.get("meta"))
             else:
                 engine.delete_replica(op["id"], op["version"])
         if request.get("refresh"):

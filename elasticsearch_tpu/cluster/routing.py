@@ -18,7 +18,10 @@ class OperationRouting:
     @staticmethod
     def shard_id(doc_id: str, num_shards: int, routing: str | None = None) -> int:
         key = routing if routing is not None else doc_id
-        h = murmur3_hash32(key)
+        # the reference hashes the routing's UTF-16 code units, little-
+        # endian (Murmur3HashFunction.hash: char → 2 bytes), then floorMod
+        # — matching byte-for-byte keeps our doc→shard placement identical
+        h = murmur3_hash32(str(key).encode("utf-16-le"))
         return h % num_shards if h >= 0 else (h % num_shards + num_shards) % num_shards
 
     @staticmethod

@@ -88,6 +88,20 @@ class QueryParsingError(ElasticsearchTpuError):
     error_type = "query_parsing_exception"
 
 
+class RoutingMissingError(ElasticsearchTpuError):
+    """A _parent-mapped type requires routing/parent on every doc op
+    (reference: RoutingMissingException, 400)."""
+    status = 400
+    error_type = "routing_missing_exception"
+
+
+class AlreadyExpiredError(ElasticsearchTpuError):
+    """Doc's ttl (counted from its _timestamp) elapsed before indexing
+    (reference: AlreadyExpiredException)."""
+    status = 400
+    error_type = "already_expired_exception"
+
+
 class IndexClosedError(ElasticsearchTpuError):
     """Operation explicitly targeting a closed index (ref:
     indices/IndexClosedException.java → RestStatus.FORBIDDEN)."""

@@ -216,3 +216,18 @@ def parse_time_millis(v) -> int:
         if s.endswith(suffix):
             return int(float(s[:-len(suffix)]) * mult)
     return int(float(s))
+
+
+def source_from_path(src, path: str):
+    """Dotted-path value extraction from a source dict (stored fields)."""
+    if not isinstance(src, dict):
+        return None
+    v = src.get(path)
+    if v is None and "." in path:
+        node = src
+        for part in path.split("."):
+            node = node.get(part) if isinstance(node, dict) else None
+            if node is None:
+                return None
+        v = node
+    return v

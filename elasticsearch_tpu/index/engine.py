@@ -79,6 +79,9 @@ class GetResult:
     doc_id: str
     version: int = 0
     source: dict | None = None
+    # metadata-field values (_type/_parent/_timestamp/_ttl) read back from
+    # the doc's parsed fields or segment columns
+    meta: dict | None = None
 
 
 @dataclass
@@ -113,6 +116,37 @@ class SearcherView:
     @property
     def max_doc(self) -> int:
         return sum(s.num_docs for s in self.segments)
+
+
+def _parsed_meta(doc) -> dict | None:
+    """Metadata-field values out of a buffered ParsedDocument."""
+    out = {}
+    for key in ("_type", "_parent", "_routing"):
+        f = doc.fields.get(key)
+        if f is not None and f.keywords:
+            out[key] = f.keywords[0]
+    for key in ("_timestamp", "_ttl"):
+        f = doc.fields.get(key)
+        if f is not None and f.numerics:
+            out[key] = int(f.numerics[0])
+    return out or None
+
+
+def _segment_meta(seg, local: int) -> dict | None:
+    """Metadata-field values out of a committed segment's columns."""
+    out = {}
+    for key in ("_type", "_parent", "_routing"):
+        col = seg.keyword_fields.get(key)
+        if col is not None and local < col.ords.shape[0]:
+            o = int(col.ords[local, 0])
+            if o >= 0:
+                out[key] = col.vocab[o]
+    for key in ("_timestamp", "_ttl"):
+        col = seg.numeric_fields.get(key)
+        if col is not None and local < col.values.shape[0] \
+                and bool(col.exists[local]):
+            out[key] = int(col.values[local])
+    return out or None
 
 
 class Engine:
@@ -187,7 +221,8 @@ class Engine:
     def index(self, doc_id: str, source: dict, version: int = MATCH_ANY,
               routing: str | None = None, op_type: str = "index",
               version_type: str = "internal",
-              from_translog: bool = False) -> tuple[int, bool]:
+              from_translog: bool = False,
+              meta: dict | None = None) -> tuple[int, bool]:
         """→ (new_version, created). Version semantics follow
         InternalEngine.innerIndex (version check → write → versionMap put);
         version_type external/external_gte/force per VersionType.java —
@@ -218,7 +253,7 @@ class Engine:
                 new_version = 1 if current == NOT_FOUND else current + 1
 
             parsed = self.mapper_service.document_mapper().parse(
-                doc_id, source, routing=routing)
+                doc_id, source, routing=routing, meta=meta)
             # supersede any buffered copy of the same doc
             old_buf = self._buffer_docs.get(doc_id)
             if old_buf is not None:
@@ -230,7 +265,8 @@ class Engine:
             self._versions[doc_id] = VersionEntry(new_version, False, -1, local)
             if not from_translog:
                 self.translog.add(TranslogOp(OP_INDEX, doc_id, new_version,
-                                             source=source, routing=routing))
+                                             source=source, routing=routing,
+                                             meta=meta))
             self.stats.index_total += 1
             took = time.perf_counter() - t0
             self.stats.index_time_ms += took * 1e3
@@ -240,7 +276,8 @@ class Engine:
             return new_version, current == NOT_FOUND
 
     def index_replica(self, doc_id: str, source: dict, version: int,
-                      routing: str | None = None) -> int:
+                      routing: str | None = None,
+                      meta: dict | None = None) -> int:
         """Apply a replicated index op with the version the primary
         resolved (TransportShardBulkAction replica path: no version
         conflict re-check, core/action/bulk/TransportShardBulkAction.java:448).
@@ -252,7 +289,7 @@ class Engine:
             if entry is not None and entry.version >= version:
                 return entry.version
             parsed = self.mapper_service.document_mapper().parse(
-                doc_id, source, routing=routing)
+                doc_id, source, routing=routing, meta=meta)
             old_buf = self._buffer_docs.get(doc_id)
             if old_buf is not None:
                 self._buffer.docs[old_buf] = None
@@ -263,7 +300,8 @@ class Engine:
             self._buffer_docs[doc_id] = local
             self._versions[doc_id] = VersionEntry(version, False, -1, local)
             self.translog.add(TranslogOp(OP_INDEX, doc_id, version,
-                                         source=source, routing=routing))
+                                         source=source, routing=routing,
+                                         meta=meta))
             self.stats.index_total += 1
             return version
 
@@ -337,11 +375,14 @@ class Engine:
                 return GetResult(found=False, doc_id=doc_id)
             if entry.seg_id == -1:
                 doc = self._buffer.docs[entry.local_doc]
-                return GetResult(True, doc_id, entry.version, doc.source)
+                return GetResult(True, doc_id, entry.version, doc.source,
+                                 meta=_parsed_meta(doc))
             for seg in self._segments:
                 if seg.seg_id == entry.seg_id:
                     return GetResult(True, doc_id, entry.version,
-                                     seg.sources[entry.local_doc])
+                                     seg.sources[entry.local_doc],
+                                     meta=_segment_meta(seg,
+                                                        entry.local_doc))
             return GetResult(found=False, doc_id=doc_id)
 
     def _get_from_reader(self, doc_id: str,
@@ -359,7 +400,8 @@ class Engine:
             local = index.get(doc_id)
             if local is not None and bool(live[local]):
                 version = entry.version if entry is not None else 1
-                return GetResult(True, doc_id, version, seg.sources[local])
+                return GetResult(True, doc_id, version, seg.sources[local],
+                                 meta=_segment_meta(seg, local))
         return GetResult(found=False, doc_id=doc_id)
 
     # --------------------------------------------------------------- refresh
@@ -640,6 +682,37 @@ class Engine:
             os.replace(tmp, commit_file)
             return sync_id
 
+    def expired_docs(self, now_ms: int) -> list[str]:
+        """Doc ids whose _ttl expiry passed (the IndicesTTLService sweep
+        source, core/indices/ttl/IndicesTTLService.java — there a range
+        query over _ttl; here a direct scan of the numeric column +
+        write buffer)."""
+        out: list[str] = []
+        with self._lock:
+            for seg, live in zip(self._segments, self._live_masks):
+                col = seg.numeric_fields.get("_ttl")
+                if col is None:
+                    continue
+                vals = np.asarray(col.values[:seg.num_docs])
+                ex = np.asarray(col.exists[:seg.num_docs])
+                mask = ex & (vals <= now_ms) & live[:seg.num_docs]
+                for local in np.nonzero(mask)[0]:
+                    did = seg.ids[int(local)]
+                    entry = self._versions.get(did)
+                    if entry is not None and not entry.deleted and \
+                            entry.seg_id == seg.seg_id and \
+                            entry.local_doc == int(local):
+                        out.append(did)
+            for did, local in self._buffer_docs.items():
+                doc = self._buffer.docs[local]
+                if doc is None:
+                    continue
+                f = doc.fields.get("_ttl")
+                if f is not None and f.numerics and \
+                        f.numerics[0] <= now_ms:
+                    out.append(did)
+        return out
+
     def commit_user_data(self) -> dict:
         """The last commit's user data (ref: SegmentInfos userData — where
         the reference stamps translog ids and the synced-flush sync_id)."""
@@ -747,7 +820,7 @@ class Engine:
 
     def _apply_replayed_index(self, op: TranslogOp) -> None:
         parsed = self.mapper_service.document_mapper().parse(
-            op.doc_id, op.source, routing=op.routing)
+            op.doc_id, op.source, routing=op.routing, meta=op.meta)
         old_buf = self._buffer_docs.get(op.doc_id)
         if old_buf is not None:
             self._buffer.docs[old_buf] = None

@@ -46,6 +46,9 @@ class TranslogOp:
     source: dict | None = None
     routing: str | None = None
     seq_no: int = -1
+    # metadata fields (_type/_parent/_timestamp/_ttl) — replayed so a
+    # restart preserves parent joins and TTL expiries
+    meta: dict | None = None
 
     def encode(self) -> bytes:
         rec: dict[str, Any] = {"op": self.op, "id": self.doc_id,
@@ -54,6 +57,8 @@ class TranslogOp:
             rec["src"] = self.source
         if self.routing is not None:
             rec["r"] = self.routing
+        if self.meta:
+            rec["m"] = self.meta
         return json.dumps(rec, separators=(",", ":")).encode("utf-8")
 
     @staticmethod
@@ -61,7 +66,7 @@ class TranslogOp:
         rec = json.loads(data)
         return TranslogOp(op=rec["op"], doc_id=rec["id"], version=rec["v"],
                           source=rec.get("src"), routing=rec.get("r"),
-                          seq_no=rec.get("seq", -1))
+                          seq_no=rec.get("seq", -1), meta=rec.get("m"))
 
 
 class Translog:

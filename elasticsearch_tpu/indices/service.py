@@ -484,7 +484,11 @@ class IndicesService:
             sett = Settings(settings)
             meta = IndexMetadata(
                 name=name,
-                number_of_shards=sett.get_as_int("index.number_of_shards", 1),
+                # ES 2.x default shard count (IndexMetaData
+                # SETTING_NUMBER_OF_SHARDS default 5) — parent/routing
+                # semantics depend on docs actually spreading over shards
+                number_of_shards=sett.get_as_int("index.number_of_shards",
+                                                 5),
                 number_of_replicas=sett.get_as_int(
                     "index.number_of_replicas", 0),
                 settings=settings, mappings=mappings,

@@ -242,6 +242,19 @@ class DocumentMapper:
         # paths mapped {"type": "nested"} — their objects index as child
         # rows (segment nested blocks), not flattened parent fields
         self.nested_paths: set[str] = set()
+        # metadata-field configs (ref: core/index/mapper/internal/
+        # {Parent,Timestamp,TTL}FieldMapper): _parent joins this type to a
+        # parent type; _timestamp/_ttl stamp per-doc numeric columns
+        p = mapping_def.get("_parent") or {}
+        self.parent_type: str | None = p.get("type")
+        def _on(v):
+            return str(v).lower() in ("true", "1", "yes", "on")
+        ts = mapping_def.get("_timestamp") or {}
+        self.timestamp_enabled = _on(ts.get("enabled", "false"))
+        self.timestamp_default: str | None = ts.get("default")
+        ttl = mapping_def.get("_ttl") or {}
+        self.ttl_enabled = _on(ttl.get("enabled", "false"))
+        self.ttl_default: str | None = ttl.get("default")
         self._build(mapping_def.get("properties", {}), prefix="")
 
     def _build(self, properties: Mapping[str, Any], prefix: str,
@@ -305,13 +318,30 @@ class DocumentMapper:
     # ---- parse ------------------------------------------------------------
 
     def parse(self, doc_id: str, source: Mapping[str, Any],
-              routing: str | None = None) -> ParsedDocument:
+              routing: str | None = None,
+              meta: Mapping[str, Any] | None = None) -> ParsedDocument:
         fields: dict[str, ParsedField] = {}
         nested: dict[str, list[dict[str, ParsedField]]] = {}
         new_mappers: list[FieldMapper] = []
         self._parse_object(source, "", fields, new_mappers, nested)
         for m in new_mappers:        # dynamic mapping update
             self.add_mapper(m)
+        if meta:
+            # metadata fields index as ordinary columns under their
+            # reserved names — _type/_parent keyword, _timestamp/_ttl
+            # numeric — so type filters, parent joins, and TTL sweeps are
+            # plain device queries (the reference's internal field mappers
+            # do the same with Lucene fields)
+            for key in ("_type", "_parent", "_routing"):
+                v = meta.get(key)
+                if v is not None:
+                    fields[key] = ParsedField(name=key, kind="keyword",
+                                              keywords=[str(v)])
+            for key in ("_timestamp", "_ttl"):
+                v = meta.get(key)
+                if v is not None:
+                    fields[key] = ParsedField(name=key, kind="numeric",
+                                              numerics=[float(v)])
         return ParsedDocument(doc_id=doc_id, source=dict(source), fields=fields,
                               routing=routing, nested=nested)
 

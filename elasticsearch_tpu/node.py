@@ -130,6 +130,13 @@ class Node:
             executor="management", sync=True)
         self._delayed_reroute_timer = None
         self.cluster_service.add_listener(self._schedule_delayed_reroute)
+        # TTL purger (IndicesTTLService): periodic sweep deleting expired
+        # _ttl docs through the normal replicated delete path
+        from elasticsearch_tpu.common.settings import parse_time_value
+        self._ttl_interval = parse_time_value(
+            self.settings.get("indices.ttl.interval", "60s"), "ttl.interval")
+        self._ttl_timer = None
+        self._schedule_ttl_sweep()
         from elasticsearch_tpu.discovery import ZenDiscovery
         self.discovery = ZenDiscovery(
             self.transport_service, self.cluster_service, self.allocation,
@@ -562,6 +569,47 @@ class Node:
                  if k not in ("path", "type")}
         return {"timestamp": ts, "total": total, "data": [entry]}
 
+    def _schedule_ttl_sweep(self) -> None:
+        t = _threading.Timer(self._ttl_interval, self._ttl_tick)
+        t.daemon = True
+        self._ttl_timer = t
+        t.start()
+
+    def _ttl_tick(self) -> None:
+        try:
+            self.ttl_sweep_once()
+        except Exception:                # noqa: BLE001 — keep sweeping
+            pass
+        if self._started:
+            self._schedule_ttl_sweep()
+
+    def ttl_sweep_once(self) -> int:
+        """One TTL purge pass (IndicesTTLService.PurgerThread): find
+        expired docs per local shard, delete them through the replicated
+        path (routing-aware via the doc's stored _routing)."""
+        now_ms = int(time.time() * 1000)
+        purged = 0
+        state = self.cluster_service.state()
+        for name, svc in list(self.indices_service.indices.items()):
+            # only primaries sweep (IndicesTTLService purges on primary
+            # shards; replicas receive the replicated deletes)
+            primaries = {s.shard for s in
+                         state.routing_table.index_shards(name)
+                         if s.primary and s.node_id == self.node_id}
+            for sid, engine in list(svc.engines.items()):
+                if sid not in primaries:
+                    continue
+                for did in engine.expired_docs(now_ms):
+                    try:
+                        got = engine.get(did)
+                        routing = (got.meta or {}).get("_routing")
+                        self.document_actions.delete_doc(name, did,
+                                                         routing=routing)
+                        purged += 1
+                    except Exception:    # noqa: BLE001 — racing writes
+                        continue
+        return purged
+
     def _handle_node_stats(self, request: dict, source) -> dict:
         return self.local_node_stats()
 
@@ -665,6 +713,8 @@ class Node:
             self.plugins_service.apply_node_stop(self)
             if self._delayed_reroute_timer is not None:
                 self._delayed_reroute_timer.cancel()
+            if self._ttl_timer is not None:
+                self._ttl_timer.cancel()
             self.search_actions.close()
             self.discovery.stop()
             self.indices_service.close()
@@ -699,10 +749,12 @@ class Node:
     def index_doc(self, index: str, doc_id: str | None, source: dict,
                   routing: str | None = None, version: int | None = None,
                   op_type: str = "index", refresh: bool = False,
-                  version_type: str = "internal") -> dict:
+                  version_type: str = "internal",
+                  meta: dict | None = None) -> dict:
         return self.document_actions.index_doc(
             index, doc_id, source, routing=routing, version=version,
-            op_type=op_type, refresh=refresh, version_type=version_type)
+            op_type=op_type, refresh=refresh, version_type=version_type,
+            meta=meta)
 
     def get_doc(self, index: str, doc_id: str,
                 routing: str | None = None, realtime: bool = True,
@@ -721,13 +773,17 @@ class Node:
 
     def update_doc(self, index: str, doc_id: str, body: dict,
                    routing: str | None = None, refresh: bool = False,
-                   version: int | None = None) -> dict:
+                   version: int | None = None,
+                   meta: dict | None = None) -> dict:
         return self.document_actions.update_doc(
             index, doc_id, body, routing=routing, refresh=refresh,
-            version=version)
+            version=version, meta=meta)
 
-    def mget(self, body: dict, default_index: str | None = None) -> dict:
-        return self.document_actions.mget(body, default_index)
+    def mget(self, body: dict, default_index: str | None = None,
+             realtime: bool = True, refresh: bool = False) -> dict:
+        return self.document_actions.mget(body, default_index,
+                                          realtime=realtime,
+                                          refresh=refresh)
 
     def bulk(self, operations: list[tuple[str, dict, dict | None]],
              refresh: bool = False) -> dict:

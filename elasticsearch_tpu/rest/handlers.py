@@ -143,6 +143,9 @@ def register_all(rc: RestController, node) -> None:
     r("POST", "/_bulk", h.bulk)
     r("PUT", "/_bulk", h.bulk)
     r("POST", "/{index}/_bulk", h.bulk)
+    r("PUT", "/{index}/_bulk", h.bulk)
+    r("POST", "/{index}/{type}/_bulk", h.bulk)
+    r("PUT", "/{index}/{type}/_bulk", h.bulk)
     r("POST", "/_mget", h.mget)
     r("GET", "/_mget", h.mget)
     r("POST", "/{index}/_mget", h.mget)
@@ -313,19 +316,31 @@ def _wildcard_match(value: str, pattern: str) -> bool:
     return _re.fullmatch(rx, value) is not None
 
 
-def _source_from_path(src, path: str):
-    """Dotted-path value extraction from a source dict (stored fields)."""
-    if not isinstance(src, dict):
-        return None
-    v = src.get(path)
-    if v is None and "." in path:
-        node = src
-        for part in path.split("."):
-            node = node.get(part) if isinstance(node, dict) else None
-            if node is None:
-                return None
-        v = node
-    return v
+from elasticsearch_tpu.common.settings import (
+    source_from_path as _source_from_path)
+
+
+def _mget_source_spec(raw):
+    """Per-item _source value → _filter_source spec (FetchSourceContext
+    shapes: bool / "false" / pattern / [patterns] / {include, exclude})."""
+    if raw in (False, "false"):
+        return False
+    if raw in (True, "true", None, ""):
+        return True
+    if isinstance(raw, str):
+        return {"includes": raw.split(",")}
+    if isinstance(raw, list):
+        return {"includes": [str(x) for x in raw]}
+    if isinstance(raw, dict):
+        spec = {}
+        inc = raw.get("include", raw.get("includes"))
+        exc = raw.get("exclude", raw.get("excludes"))
+        if inc:
+            spec["includes"] = inc if isinstance(inc, list) else [inc]
+        if exc:
+            spec["excludes"] = exc if isinstance(exc, list) else [exc]
+        return spec or True
+    return True
 
 
 def _filter_doc_source(src, spec):
@@ -863,6 +878,87 @@ class Handlers:
             resp = {**resp, "_routing": routing}
         return resp
 
+    def _type_mapper(self, index_expr: str, tname: str | None):
+        """DocumentMapper for (index, type) when both resolve — metadata-
+        field requirements (_parent/_timestamp/_ttl) live there."""
+        try:
+            names = self.node.indices_service.resolve(index_expr)
+        except IndexNotFoundError:
+            return None
+        for n in names:
+            svc = self.node.indices_service.indices.get(n)
+            if svc is None:
+                continue
+            ms = svc.mapper_service
+            if tname and tname in ms.mappers:
+                return ms.mappers[tname]
+            if not tname and len(ms.mappers) == 1:
+                return next(iter(ms.mappers.values()))
+        return None
+
+    def _write_meta(self, req: RestRequest, index: str,
+                    body: dict | None = None) -> dict | None:
+        """Metadata fields for a doc write: _type, _parent (+ the
+        routing_missing_exception requirement), _timestamp, _ttl.
+        Ref: core/index/mapper/internal/{Parent,Timestamp,TTL}FieldMapper
+        + TransportIndexAction request resolution."""
+        from elasticsearch_tpu.common.errors import RoutingMissingError
+        body = body or {}
+        tname = req.path_params.get("type")
+        parent = req.param("parent", body.get("parent"))
+        meta: dict = {}
+        if tname and not tname.startswith("_"):
+            meta["_type"] = tname
+        dm = self._type_mapper(index, tname)
+        if dm is not None and dm.parent_type and parent is None and \
+                req.param("routing", body.get("routing")) is None:
+            # resolved routing (explicit or parent-derived) must exist
+            # (TransportIndexAction.resolveRequest)
+            raise RoutingMissingError(
+                f"routing is required for [{index}]/[{tname}]")
+        if parent is not None:
+            meta["_parent"] = str(parent)
+        now = int(time.time() * 1000)
+        ts = req.param("timestamp", body.get("timestamp"))
+        if ts is not None:
+            if str(ts).lstrip("-").isdigit():
+                meta["_timestamp"] = int(ts)      # epoch millis
+            else:
+                from elasticsearch_tpu.mapping.mapper import parse_date
+                meta["_timestamp"] = int(parse_date(ts))
+        elif dm is not None and dm.timestamp_enabled:
+            meta["_timestamp"] = now
+        ttl = req.param("ttl", body.get("ttl"))
+        if ttl is None and dm is not None and dm.ttl_enabled:
+            ttl = dm.ttl_default
+        if ttl is not None:
+            from elasticsearch_tpu.common.settings import parse_time_value
+            ttl_ms = int(parse_time_value(ttl, "ttl") * 1000)
+            # expiry counts from the doc's _timestamp (TTLFieldMapper:
+            # timestamp + ttl), so a past timestamp can be dead on arrival
+            expiry = meta.get("_timestamp", now) + ttl_ms
+            if expiry <= now:
+                from elasticsearch_tpu.common.errors import (
+                    AlreadyExpiredError)
+                raise AlreadyExpiredError(f"already expired ttl [{ttl}]")
+            meta["_ttl"] = expiry
+        return meta or None
+
+    def _read_routing(self, req: RestRequest, index: str) -> str | None:
+        """Routing for a single-doc read/delete: explicit routing, else
+        parent; a _parent-mapped type REQUIRES one (RoutingMissing, 400)."""
+        from elasticsearch_tpu.common.errors import RoutingMissingError
+        routing = req.param("routing")
+        if routing is None:
+            routing = req.param("parent")
+        if routing is None:
+            dm = self._type_mapper(index, req.path_params.get("type"))
+            if dm is not None and dm.parent_type:
+                raise RoutingMissingError(
+                    f"routing is required for [{index}]/"
+                    f"[{req.path_params.get('type')}]")
+        return routing
+
     def index_doc(self, req: RestRequest):
         self._check_type(req)
         version = req.param("version")
@@ -872,7 +968,8 @@ class Handlers:
             version=int(version) if version else None,
             op_type="create" if req.param("op_type") == "create" else "index",
             version_type=req.param("version_type") or "internal",
-            refresh=req.param_as_bool("refresh"))
+            refresh=req.param_as_bool("refresh"),
+            meta=self._write_meta(req, req.path_params["index"]))
         return (201 if resp["created"] else 200), self._echo_type(req, resp)
 
     def index_doc_auto_id(self, req: RestRequest):
@@ -880,14 +977,16 @@ class Handlers:
         resp = self.node.index_doc(
             req.path_params["index"], None, req.body or {},
             routing=req.param("routing"),
-            refresh=req.param_as_bool("refresh"))
+            refresh=req.param_as_bool("refresh"),
+            meta=self._write_meta(req, req.path_params["index"]))
         return 201, self._echo_type(req, resp)
 
     def create_doc(self, req: RestRequest):
         resp = self.node.index_doc(
             req.path_params["index"], req.path_params["id"], req.body or {},
             routing=req.param("routing"), op_type="create",
-            refresh=req.param_as_bool("refresh"))
+            refresh=req.param_as_bool("refresh"),
+            meta=self._write_meta(req, req.path_params["index"]))
         return 201, resp
 
     def type_exists(self, req: RestRequest):
@@ -912,7 +1011,7 @@ class Handlers:
         self._check_type(req)
         resp = self.node.get_doc(
             req.path_params["index"], req.path_params["id"],
-            routing=req.param("routing"),
+            routing=self._read_routing(req, req.path_params["index"]),
             realtime=req.param_as_bool("realtime", True),
             refresh=req.param_as_bool("refresh"))
         t = req.path_params.get("type")
@@ -946,12 +1045,18 @@ class Handlers:
                 # independent of whether _source is echoed (2.x)
                 src = raw_src
                 out = {}
-                for f in fields.split(","):
+                flist = fields.split(",")
+                for f in flist:
+                    if f.startswith("_"):
+                        continue          # metadata fields render top-level
                     v = src.get(f)
                     if v is not None:
                         out[f] = v if isinstance(v, list) else [v]
                 resp = {**resp, "fields": out}
-                if req.param("_source") in (None, "false"):
+                if not out:
+                    resp.pop("fields")
+                if "_source" not in flist and \
+                        req.param("_source") in (None, "false"):
                     resp.pop("_source", None)
         return (200 if resp["found"] else 404), self._echo_type(req, resp)
 
@@ -976,19 +1081,28 @@ class Handlers:
 
     def get_source(self, req: RestRequest):
         self._check_type(req)
-        resp = self.node.get_doc(req.path_params["index"],
-                                 req.path_params["id"],
-                                 routing=req.param("routing"))
+        resp = self.node.get_doc(
+            req.path_params["index"], req.path_params["id"],
+            routing=self._read_routing(req, req.path_params["index"]),
+            realtime=req.param_as_bool("realtime", True),
+            refresh=req.param_as_bool("refresh"))
         if not resp["found"]:
             return 404, {}
-        return 200, resp["_source"]
+        spec = self._get_source_spec(req)
+        src = resp["_source"]
+        if spec is False:
+            return 200, {}
+        if spec is not True:
+            src = _filter_doc_source(src, spec) or {}
+        return 200, src
 
     def delete_doc(self, req: RestRequest):
         self._check_type(req)
         version = req.param("version")
         resp = self.node.delete_doc(req.path_params["index"],
                                     req.path_params["id"],
-                                    routing=req.param("routing"),
+                                    routing=self._read_routing(
+                                        req, req.path_params["index"]),
                                     version=int(version) if version
                                     else None,
                                     version_type=req.param("version_type")
@@ -1005,12 +1119,24 @@ class Handlers:
                 f"Validation Failed: version type [{vt}] is not supported "
                 f"by the update API")
         version = req.param("version")
+        body = req.body or {}
         resp = self.node.update_doc(req.path_params["index"],
-                                    req.path_params["id"], req.body or {},
+                                    req.path_params["id"], body,
                                     routing=req.param("routing"),
+                                    meta=self._write_meta(
+                                        req, req.path_params["index"]),
                                     version=int(version) if version
                                     else None,
                                     refresh=req.param_as_bool("refresh"))
+        applied = resp.pop("_update_source", None)
+        wanted = req.param("fields", body.get("fields"))
+        if wanted:
+            # `fields` on update answers a "get" section built from the
+            # APPLIED source (UpdateHelper.extractGetResult)
+            from elasticsearch_tpu.action.replication import (
+                update_get_section)
+            resp = {**resp, "get": update_get_section(
+                applied, resp.get("_version"), wanted)}
         return 200, self._echo_type(req, resp)
 
     def mget(self, req: RestRequest):
@@ -1033,7 +1159,9 @@ class Handlers:
             raise IllegalArgumentError(
                 "action_request_validation_exception: "
                 + "; ".join(problems))
-        out = self.node.mget(body, req.path_params.get("index"))
+        out = self.node.mget(body, req.path_params.get("index"),
+                             realtime=req.param_as_bool("realtime", True),
+                             refresh=req.param_as_bool("refresh"))
         # echo each doc spec's _type; a WRONG type is a miss (2.x type
         # fiction, cf. _echo_type — types namespace docs at the surface)
         specs = list(body.get("docs", []))
@@ -1054,6 +1182,10 @@ class Handlers:
                     doc = out["docs"][i] = {
                         "_index": doc.get("_index"), "_type": t,
                         "_id": doc.get("_id"), "found": False}
+            # per-spec _source filtering: true/false/patterns/
+            # {include,exclude} (ref: FetchSourceContext per MGET item)
+            src_req = spec.get("_source",
+                               body.get("_source", req.param("_source")))
             wanted = spec.get("fields", body.get("fields",
                                                  req.param("fields")))
             if wanted and doc.get("found"):
@@ -1061,18 +1193,30 @@ class Handlers:
                     wanted = wanted.split(",")
                 src = doc.get("_source") or {}
                 fields = {}
+                keep_source = False
                 for f in wanted:
+                    if f == "_source":
+                        keep_source = True
+                        continue
                     v = _source_from_path(src, f)
                     if v is not None:
                         fields[f] = v if isinstance(v, list) else [v]
                 doc["fields"] = fields
                 # _source suppressed by fields UNLESS explicitly requested
-                # (spec/body value or ?_source=); explicit false drops it
-                src_req = spec.get("_source",
-                                   body.get("_source",
-                                            req.param("_source")))
-                if src_req in (None, False, "false"):
+                if not keep_source and src_req in (None, False, "false"):
                     doc.pop("_source", None)
+                    src_req = None
+            if doc.get("found") and "_source" in doc:
+                fspec = _mget_source_spec(src_req) if src_req is not None \
+                    else self._get_source_spec(req)
+                if fspec is False:
+                    doc.pop("_source", None)
+                elif fspec is not True:
+                    filtered = _filter_doc_source(doc["_source"], fspec)
+                    if filtered is None:
+                        doc.pop("_source", None)
+                    else:
+                        doc["_source"] = filtered
         return 200, out
 
     # ---- bulk -------------------------------------------------------------
@@ -1102,6 +1246,31 @@ class Handlers:
                         "must be an object")
                 meta = dict(meta or {})
                 meta.setdefault("_index", default_index)
+                meta.setdefault("_type", req.path_params.get("type"))
+                if action in ("index", "create", "update"):
+                    mf = {}
+                    t = meta.get("_type")
+                    if t and not str(t).startswith("_"):
+                        mf["_type"] = str(t)
+                    parent = meta.get("parent", meta.get("_parent"))
+                    if parent is not None:
+                        mf["_parent"] = str(parent)
+                    ts = meta.get("timestamp", meta.get("_timestamp"))
+                    if ts is not None:
+                        if str(ts).lstrip("-").isdigit():
+                            mf["_timestamp"] = int(ts)
+                        else:
+                            from elasticsearch_tpu.mapping.mapper import (
+                                parse_date)
+                            mf["_timestamp"] = int(parse_date(ts))
+                    ttl = meta.get("ttl", meta.get("_ttl"))
+                    if ttl is not None:
+                        from elasticsearch_tpu.common.settings import (
+                            parse_time_value)
+                        mf["_ttl"] = int(time.time() * 1000) + \
+                            int(parse_time_value(ttl, "ttl") * 1000)
+                    if mf:
+                        meta["_meta_fields"] = mf
                 source = None
                 if action in ("index", "create", "update"):
                     if i >= len(lines):
@@ -1110,6 +1279,14 @@ class Handlers:
                             f"without a source line")
                     source = json.loads(lines[i])
                     i += 1
+                if action == "update":
+                    # `fields` may ride the header line or the URL — fold
+                    # it into the update body (UpdateRequest.fields)
+                    wanted = meta.get("fields", req.param("fields"))
+                    if wanted and "fields" not in (source or {}):
+                        if isinstance(wanted, str):
+                            wanted = wanted.split(",")
+                        source = {**(source or {}), "fields": wanted}
                 ops.append((action, meta, source))
         except (json.JSONDecodeError, ValueError) as e:
             raise IllegalArgumentError(

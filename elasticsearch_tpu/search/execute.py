@@ -643,6 +643,39 @@ class SegmentResolver:
                                   lambda t: _edit_distance_le(t, v, k)),
             query.boost)
 
+    def _res_ParentIdsQuery(self, query: q.ParentIdsQuery) -> Emit:
+        """Join-result lookup: doc matches when its `field` value (_id or
+        the _parent keyword column) keys `id_scores`; score = mapped value
+        (host-computed by ShardSearcher._rewrite_joins)."""
+        vals = np.zeros(self.n, np.float32)
+        hits = np.zeros(self.n, bool)
+        seg = self.seg.seg
+        if query.field == "_id":
+            for local, did in enumerate(seg.ids):
+                s = query.id_scores.get(did)
+                if s is not None:
+                    vals[local] = s
+                    hits[local] = True
+        else:
+            col = seg.keyword_fields.get(query.field)
+            if col is not None:
+                per_ord = np.array(
+                    [query.id_scores.get(v, np.nan) for v in col.vocab],
+                    np.float64)
+                first = np.asarray(col.ords[:seg.num_docs, 0])
+                ok = first >= 0
+                looked = np.where(ok, per_ord[np.maximum(first, 0)],
+                                  np.nan)
+                hit = ~np.isnan(looked)
+                hits[:seg.num_docs] = hit
+                vals[:seg.num_docs] = np.where(hit, looked, 0.0)
+        r_vals = self.c(vals)
+        r_hits = self.c(hits)
+        r_boost = self.c(query.boost, np.float32)
+        return lambda em: (jnp.asarray(em.get(r_vals))
+                           * em.get(r_boost),
+                           jnp.asarray(em.get(r_hits)))
+
     def _res_IdsQuery(self, query: q.IdsQuery) -> Emit:
         wanted = set(query.values)
         hits = np.zeros(self.n, bool)

@@ -182,6 +182,7 @@ class ShardSearcher:
 
     def _execute_query(self, query: q.Query):
         """→ list of (scores, mask) device pairs, live-masked, per segment."""
+        query = self._rewrite_joins(query)
         out = []
         for seg in self.reader.segments:
             ex = SegmentExecutor(seg, self.ctx)
@@ -190,7 +191,91 @@ class ShardSearcher:
             out.append((scores, mask))
         return out
 
+    # ---- parent/child joins ------------------------------------------------
+
+    def _rewrite_joins(self, query: q.Query) -> q.Query:
+        """Shard-local parent/child join rewrite: children colocate with
+        their parent (routing = parent id), so has_child/has_parent reduce
+        to (1) run the inner query over the typed docs, (2) lift the
+        per-doc scores through the _parent column host-side, (3) replace
+        the node with a ParentIdsQuery the device resolves like ids.
+        The reference's two-pass join (ChildrenQuery/ParentQuery,
+        core/index/search/child/) does the same dance over Lucene
+        ordinals; here the join state is a small id→score map."""
+        if isinstance(query, q.HasChildQuery):
+            inner = q.BoolQuery(
+                must=[self._rewrite_joins(query.query)],
+                filter=[q.TermQuery(field="_type", value=query.type)])
+            scores: dict[str, list] = {}
+            for seg, (sc, mask) in zip(self.reader.segments,
+                                       self._execute_query(inner)):
+                m = np.asarray(mask)
+                s = np.asarray(sc)
+                col = seg.seg.keyword_fields.get("_parent")
+                if col is None:
+                    continue
+                for local in np.nonzero(m[:seg.seg.num_docs])[0]:
+                    o = int(col.ords[int(local), 0])
+                    if o >= 0:
+                        scores.setdefault(col.vocab[o],
+                                          []).append(float(s[int(local)]))
+            mode = query.score_mode
+            id_scores = {}
+            for pid, vals in scores.items():
+                n = len(vals)
+                if n < max(query.min_children, 1) or \
+                        (query.max_children and n > query.max_children):
+                    continue
+                if mode == "sum":
+                    v = sum(vals)
+                elif mode == "max":
+                    v = max(vals)
+                elif mode == "min":
+                    v = min(vals)
+                elif mode == "avg":
+                    v = sum(vals) / n
+                else:
+                    v = 1.0
+                id_scores[pid] = v
+            return q.ParentIdsQuery(field="_id", id_scores=id_scores,
+                                    boost=query.boost)
+        if isinstance(query, q.HasParentQuery):
+            inner = q.BoolQuery(
+                must=[self._rewrite_joins(query.query)],
+                filter=[q.TermQuery(field="_type",
+                                    value=query.parent_type)])
+            id_scores = {}
+            for seg, (sc, mask) in zip(self.reader.segments,
+                                       self._execute_query(inner)):
+                m = np.asarray(mask)
+                s = np.asarray(sc)
+                for local in np.nonzero(m[:seg.seg.num_docs])[0]:
+                    pid = seg.seg.ids[int(local)]
+                    v = float(s[int(local)]) \
+                        if query.score_mode == "score" else 1.0
+                    id_scores[pid] = max(id_scores.get(pid, 0.0), v)
+            return q.ParentIdsQuery(field="_parent", id_scores=id_scores,
+                                    boost=query.boost)
+        # recurse into compounds
+        if isinstance(query, q.BoolQuery):
+            return q.BoolQuery(
+                must=[self._rewrite_joins(s) for s in query.must],
+                should=[self._rewrite_joins(s) for s in query.should],
+                must_not=[self._rewrite_joins(s) for s in query.must_not],
+                filter=[self._rewrite_joins(s) for s in query.filter],
+                minimum_should_match=query.minimum_should_match,
+                boost=query.boost)
+        for attr in ("query", "positive", "negative"):
+            sub = getattr(query, attr, None)
+            if isinstance(sub, q.Query):
+                new = self._rewrite_joins(sub)
+                if new is not sub:
+                    import dataclasses as _dc
+                    query = _dc.replace(query, **{attr: new})
+        return query
+
     def _filter_masks_np(self, query: q.Query) -> np.ndarray:
+        query = self._rewrite_joins(query)   # agg filter contexts too
         masks = []
         for seg in self.reader.segments:
             ex = SegmentExecutor(seg, self.ctx)
@@ -206,6 +291,14 @@ class ShardSearcher:
         plan/trace seam is guarded — errors in parsing/aggs/sort raise
         normally without double execution."""
         from elasticsearch_tpu.search import jit_exec
+        rewritten = self._rewrite_joins(req.query)
+        if rewritten is not req.query or (
+                req.post_filter is not None):
+            import dataclasses as _dc
+            req = _dc.replace(
+                req, query=rewritten,
+                post_filter=None if req.post_filter is None
+                else self._rewrite_joins(req.post_filter))
         k = max(req.from_ + req.size, 1)
         if req.rescore:
             # the shard must collect at least the largest rescore window

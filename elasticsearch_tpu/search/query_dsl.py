@@ -184,6 +184,37 @@ class SpanNearQuery(Query):
 
 
 @dataclass
+class HasChildQuery(Query):
+    """ref: core/index/query/HasChildQueryParser.java — parents whose
+    children (docs of `type`, joined via the _parent metadata column)
+    match the inner query."""
+    type: str = ""
+    query: Query | None = None
+    score_mode: str = "none"       # none|min|max|sum|avg
+    min_children: int = 0
+    max_children: int = 0          # 0 = unbounded
+
+
+@dataclass
+class HasParentQuery(Query):
+    """ref: core/index/query/HasParentQueryParser.java — children whose
+    parent doc (of `parent_type`) matches the inner query."""
+    parent_type: str = ""
+    query: Query | None = None
+    score_mode: str = "none"       # none|score
+
+
+@dataclass
+class ParentIdsQuery(Query):
+    """INTERNAL: the shard-local rewrite target of has_child/has_parent —
+    match docs whose `field` value (_id or _parent) is a key of
+    `id_scores`, scoring each doc with its mapped value (the host-side
+    join result; cf. the reference's ParentIdsQuery)."""
+    field: str = "_id"
+    id_scores: dict = dc_field(default_factory=dict)
+
+
+@dataclass
 class NestedQuery(Query):
     """ref: core/index/query/NestedQueryParser.java — the inner query runs
     over a path's nested objects; a parent matches when any of its objects
@@ -499,6 +530,36 @@ def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query
             spec["template"] = spec.pop("query")
         rendered = render_search_template(spec, lambda _i: None)
         return parse_query(rendered)
+
+    if qtype == "has_child":
+        if "type" not in qbody or "query" not in qbody:
+            raise QueryParsingError("[has_child] requires 'type' and "
+                                    "'query'")
+        sm = str(qbody.get("score_mode", "none")).lower()
+        if sm == "total":                  # 2.x alias
+            sm = "sum"
+        return HasChildQuery(type=str(qbody["type"]),
+                             query=parse_query(qbody["query"]),
+                             score_mode=sm,
+                             min_children=int(qbody.get("min_children", 0)),
+                             max_children=int(qbody.get("max_children", 0)),
+                             boost=float(qbody.get("boost", 1.0)))
+
+    if qtype == "has_parent":
+        ptype = qbody.get("parent_type", qbody.get("type"))
+        if ptype is None or "query" not in qbody:
+            raise QueryParsingError("[has_parent] requires 'parent_type' "
+                                    "and 'query'")
+        sm = str(qbody.get("score_mode", "none")).lower()
+        return HasParentQuery(parent_type=str(ptype),
+                              query=parse_query(qbody["query"]),
+                              score_mode=sm,
+                              boost=float(qbody.get("boost", 1.0)))
+
+    if qtype == "type":
+        # {"type": {"value": t}} filters by the _type metadata column
+        # (ref: TypeQueryParser)
+        return TermQuery(field="_type", value=str(qbody.get("value", "")))
 
     if qtype == "nested":
         if "path" not in qbody or "query" not in qbody:

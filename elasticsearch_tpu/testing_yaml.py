@@ -229,7 +229,10 @@ class YamlRestRunner:
         status, resp = controller.dispatch(method, path, raw)
         if spec.methods == ["HEAD"]:
             # exists-style APIs answer a boolean (the reference runner
-            # translates HEAD 200/404 to true/false, never an error)
+            # translates HEAD 200/404 to true/false); other statuses are
+            # real errors and must stay visible to catch: steps
+            if status not in (200, 404):
+                return status, resp
             return 200, status == 200
         return status, resp
 

@@ -1,0 +1,164 @@
+"""Parent/child joins + metadata fields (_parent/_type/_timestamp/_ttl).
+
+Reference behaviours covered: _parent mapping requires routing on writes
+(RoutingMissingException, core/index/mapper/internal/ParentFieldMapper),
+children route to the parent's shard, has_child/has_parent queries join
+through the _parent column (core/index/query/HasChildQueryParser.java,
+HasParentQueryParser.java), _timestamp/_ttl stamp per-doc values
+(TimestampFieldMapper/TTLFieldMapper), and the TTL purger deletes expired
+docs (core/indices/ttl/IndicesTTLService.java).
+"""
+
+import json
+import time
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.controller import RestController
+from elasticsearch_tpu.rest.handlers import register_all
+
+
+@pytest.fixture()
+def rig(tmp_path):
+    node = Node({}, data_path=tmp_path / "n").start()
+    rc = RestController()
+    register_all(rc, node)
+    try:
+        yield node, rc
+    finally:
+        node.close()
+
+
+def call(rc, method, path, body=None):
+    raw = b"" if body is None else json.dumps(body).encode()
+    return rc.dispatch(method, path, raw)
+
+
+def _shop(rc):
+    call(rc, "PUT", "/shop", {
+        "settings": {"number_of_shards": 3, "number_of_replicas": 0},
+        "mappings": {"item": {},
+                     "review": {"_parent": {"type": "item"}}}})
+    call(rc, "PUT", "/shop/item/i1?refresh=true", {"name": "widget"})
+    call(rc, "PUT", "/shop/item/i2?refresh=true", {"name": "gadget"})
+    call(rc, "PUT", "/shop/review/r1?parent=i1&refresh=true",
+         {"stars": 5, "text": "great"})
+    call(rc, "PUT", "/shop/review/r2?parent=i1&refresh=true",
+         {"stars": 1, "text": "bad"})
+    call(rc, "PUT", "/shop/review/r3?parent=i2&refresh=true",
+         {"stars": 3, "text": "ok"})
+
+
+class TestParentField:
+    def test_index_without_parent_is_routing_missing(self, rig):
+        node, rc = rig
+        call(rc, "PUT", "/shop", {
+            "mappings": {"review": {"_parent": {"type": "item"}}}})
+        st, out = call(rc, "PUT", "/shop/review/r1", {"stars": 5})
+        assert st == 400
+        assert out["error"]["type"] == "routing_missing_exception"
+
+    def test_parent_roundtrip_and_routing(self, rig):
+        node, rc = rig
+        _shop(rc)
+        st, out = call(rc, "GET", "/shop/review/r1?parent=i1")
+        assert st == 200
+        assert out["_parent"] == "i1"
+        assert out["_routing"] == "i1"
+        # omitted parent on a parented type is an error, not a miss
+        st, out = call(rc, "GET", "/shop/review/r1")
+        assert st == 400
+        assert out["error"]["type"] == "routing_missing_exception"
+
+    def test_parent_survives_restart(self, rig, tmp_path):
+        node, rc = rig
+        _shop(rc)
+        node.close()
+        node2 = Node({}, data_path=tmp_path / "n").start()
+        rc2 = RestController()
+        register_all(rc2, node2)
+        try:
+            st, out = call(rc2, "GET", "/shop/review/r2?parent=i1")
+            assert st == 200 and out["_parent"] == "i1"
+        finally:
+            node2.close()
+
+
+class TestJoins:
+    def test_has_child(self, rig):
+        node, rc = rig
+        _shop(rc)
+        st, out = call(rc, "POST", "/shop/_search", {
+            "query": {"has_child": {"type": "review",
+                                    "query": {"match": {"text": "great"}}}}})
+        assert st == 200
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["i1"]
+
+    def test_has_child_score_modes_and_min_children(self, rig):
+        node, rc = rig
+        _shop(rc)
+        st, out = call(rc, "POST", "/shop/_search", {
+            "query": {"has_child": {
+                "type": "review", "score_mode": "sum",
+                "query": {"range": {"stars": {"gte": 1}}}}}})
+        scores = {h["_id"]: h["_score"] for h in out["hits"]["hits"]}
+        assert scores["i1"] == pytest.approx(2.0)
+        assert scores["i2"] == pytest.approx(1.0)
+        st, out = call(rc, "POST", "/shop/_search", {
+            "query": {"has_child": {
+                "type": "review", "min_children": 2,
+                "query": {"match_all": {}}}}})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["i1"]
+
+    def test_has_parent(self, rig):
+        node, rc = rig
+        _shop(rc)
+        st, out = call(rc, "POST", "/shop/_search", {
+            "query": {"has_parent": {
+                "parent_type": "item",
+                "query": {"match": {"name": "widget"}}}}})
+        assert sorted(h["_id"] for h in out["hits"]["hits"]) == ["r1", "r2"]
+
+    def test_type_query(self, rig):
+        node, rc = rig
+        _shop(rc)
+        st, out = call(rc, "POST", "/shop/_search",
+                       {"query": {"type": {"value": "item"}}, "size": 10})
+        assert sorted(h["_id"] for h in out["hits"]["hits"]) == ["i1", "i2"]
+
+
+class TestTimestampTtl:
+    def test_timestamp_stamped_when_enabled(self, rig):
+        node, rc = rig
+        call(rc, "PUT", "/logs", {
+            "mappings": {"event": {"_timestamp": {"enabled": True}}}})
+        before = int(time.time() * 1000)
+        call(rc, "PUT", "/logs/event/1?refresh=true", {"msg": "x"})
+        st, out = call(rc, "GET", "/logs/event/1")
+        assert st == 200
+        assert before <= out["_timestamp"] <= int(time.time() * 1000)
+
+    def test_ttl_remaining_and_purge(self, rig):
+        node, rc = rig
+        call(rc, "PUT", "/logs", {
+            "mappings": {"event": {"_ttl": {"enabled": True,
+                                            "default": "10s"}}}})
+        call(rc, "PUT", "/logs/event/1?refresh=true", {"msg": "x"})
+        st, out = call(rc, "GET", "/logs/event/1")
+        assert 0 < out["_ttl"] <= 10_000
+        # an explicit short ttl expires; the sweep deletes it
+        call(rc, "PUT", "/logs/event/2?ttl=1ms", {"msg": "y"})
+        time.sleep(0.05)
+        assert node.ttl_sweep_once() >= 1
+        st, _ = call(rc, "GET", "/logs/event/2")
+        assert st == 404
+
+    def test_expired_on_arrival_rejected(self, rig):
+        node, rc = rig
+        call(rc, "PUT", "/logs", {})
+        st, out = call(
+            rc, "PUT", "/logs/event/1?ttl=20s&timestamp=1372011280000",
+            {"msg": "x"})
+        assert st == 400
+        assert out["error"]["type"] == "already_expired_exception"
