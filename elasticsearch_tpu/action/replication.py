@@ -290,6 +290,11 @@ class DocumentActions:
     def _handle_index_p_local(self, request: dict) -> dict:
         name, shard = request["index"], request["shard"]
         engine = self._engine(name, shard)
+        t = (request.get("meta") or {}).get("_type")
+        if t:
+            svc = self.node.indices_service.indices.get(name)
+            if svc is not None:
+                svc.indexing_types[t] = svc.indexing_types.get(t, 0) + 1
         version = request.get("version")
         v, created = engine.index(
             request["id"], request["source"],
@@ -511,14 +516,18 @@ class DocumentActions:
             {"index": name, "shard": shard, "id": doc_id, "body": body},
             self._handle_explain)
 
-    def _doc_location(self, engine, doc_id: str):
+    def _doc_location(self, engine, doc_id: str, realtime: bool = True):
         """→ (reader, global doc id) of a committed doc, refreshing if the
-        doc still sits in the write buffer; None when absent/deleted."""
+        doc still sits in the write buffer; None when absent/deleted.
+        With realtime=False only already-refreshed docs resolve (the
+        searcher-visible set, like the reference's non-realtime path)."""
         from elasticsearch_tpu.index.device_reader import device_reader_for
         entry = engine._versions.get(doc_id)
         if entry is None or entry.deleted:
             return None
         if entry.seg_id == -1:
+            if not realtime:
+                return None
             engine.refresh()                     # buffered → make visible
             entry = engine._versions.get(doc_id)
             if entry is None or entry.deleted or entry.seg_id < 0:
@@ -569,14 +578,18 @@ class DocumentActions:
         name = request["index"]
         base = {"_index": name, "_type": "_doc", "_id": request["id"]}
         engine = self._engine(name, request["shard"])
-        loc = self._doc_location(engine, request["id"])
+        body = request.get("body") or {}
+        loc = self._doc_location(engine, request["id"],
+                                 realtime=body.get("realtime", True)
+                                 not in (False, "false"))
         if loc is None:
             return {**base, "found": False}
         reader, gdoc = loc
         seg, local = reader.resolve(gdoc)
-        body = request.get("body") or {}
         want = body.get("fields")
         term_stats = bool(body.get("term_statistics"))
+        src = seg.seg.sources[local] if local < len(seg.seg.sources) \
+            else {}
         out_fields: dict = {}
         for fname, col in seg.seg.text_fields.items():
             if want and fname not in want:
@@ -606,6 +619,26 @@ class DocumentActions:
                     terms[term]["ttf"] = ttf
             if not terms:
                 continue
+            # per-occurrence tokens (position + char offsets) come from
+            # re-analyzing the stored _source with the field's analyzer —
+            # the reference does the same when term vectors aren't stored
+            # (TermVectorsService.generateTermVectors)
+            raw = src.get(fname) if isinstance(src, dict) else None
+            if raw is not None:
+                svc2 = self.node.indices_service.indices.get(name)
+                fm = svc2.mapper_service.field_mapper(fname) \
+                    if svc2 else None
+                analyzer = getattr(fm, "analyzer", None)
+                if analyzer is not None:
+                    values = raw if isinstance(raw, list) else [raw]
+                    for v in values:
+                        for tok in analyzer.analyze(str(v)):
+                            t = terms.get(tok.term)
+                            if t is not None:
+                                t.setdefault("tokens", []).append(
+                                    {"position": tok.position,
+                                     "start_offset": tok.start_offset,
+                                     "end_offset": tok.end_offset})
             sum_df = doc_count = sum_ttf = 0
             for s2 in reader.segments:
                 c2 = s2.seg.text_fields.get(fname)
@@ -688,6 +721,14 @@ class DocumentActions:
         by_shard: dict[tuple[str, int], list[tuple[int, tuple]]] = {}
         for pos, (action, meta, source) in enumerate(operations):
             index = meta.get("_index")
+            err = meta.get("_meta_error")
+            if err is not None:
+                errors = True
+                items[pos] = {action: {"_index": index,
+                                       "_id": meta.get("_id"),
+                                       "error": err["error"],
+                                       "status": err["status"]}}
+                continue
             try:
                 if index not in resolved:
                     resolved[index] = self._resolve_write_index(index)
@@ -752,6 +793,12 @@ class DocumentActions:
             action = item["action"]
             try:
                 if action in ("index", "create"):
+                    ti = (item.get("meta") or {}).get("_type")
+                    if ti:
+                        svc2 = self.node.indices_service.indices.get(name)
+                        if svc2 is not None:
+                            svc2.indexing_types[ti] = \
+                                svc2.indexing_types.get(ti, 0) + 1
                     v, created = engine.index(
                         item["id"], item["source"],
                         routing=item.get("routing"),

@@ -13,10 +13,12 @@ QUERY_THEN_FETCH buys nothing); the coordinator reduce then keeps the
 global [from, from+size) slice, which is identical to what
 query_then_fetch returns.
 
-Scroll is a coordinator-side cursor (search_after continuation re-running
-the scatter) instead of server-side per-shard contexts — the TPU-friendly
-redesign of ScrollContext (no pinned per-shard readers; see
-search/service.py for the single-node variant and rationale).
+Scroll pairs a coordinator-side cursor (search_after continuation
+re-running the scatter) with data-node reader PINS: the first page pins
+each shard's point-in-time SearcherView under the scroll's ctx_uid
+(ScrollContext semantics, SearchService.java:533-558), so later pages
+never see writes that landed mid-scroll; pins expire with the keep-alive
+and die on clear_scroll.
 """
 
 from __future__ import annotations
@@ -56,7 +58,13 @@ def wire_safe(obj):
 
 class _ScrollContext:
     def __init__(self, index_expr: str, body: dict, keep_alive_s: float,
-                 search_type: str | None = None):
+                 search_type: str | None = None,
+                 ctx_uid: str | None = None):
+        import uuid as _uuid
+        # stable id carried in every page's shard requests: data nodes pin
+        # their point-in-time reader views under it (SearchService
+        # activeContexts analog — scroll pages must NOT see later writes)
+        self.ctx_uid = ctx_uid or _uuid.uuid4().hex
         self.index_expr = index_expr
         self.body = dict(body)
         self.search_type = search_type
@@ -155,12 +163,43 @@ def _fetch_mlt_likes(node, spec: dict, default_index: str) -> dict:
     raw_docs = spec.pop("docs", None) or []
     for did in list(raw_ids) + list(raw_docs):
         likes.append(did if isinstance(did, dict) else {"_id": did})
+    raw_unlike = spec.get("unlike")
+    unlikes = list(raw_unlike) if isinstance(raw_unlike, list) \
+        else [raw_unlike] if raw_unlike is not None else []
     texts: list = []
     exclude = list(spec.get("_exclude_ids", []))
     fields = spec.get("fields") or []
+    unlike_out: list = []
+    for item in unlikes:
+        if not isinstance(item, dict):
+            unlike_out.append(str(item))
+            continue
+        if "doc" in item:
+            unlike_out.extend(str(v) for v in item["doc"].values()
+                              if isinstance(v, str))
+            continue
+        did = item.get("_id")
+        if did is None:
+            continue
+        try:
+            got = node.document_actions.get_doc(
+                item.get("_index", default_index), str(did),
+                routing=item.get("_routing", item.get("routing")))
+        except Exception:                  # noqa: BLE001 — missing doc
+            continue
+        if got.get("found"):
+            unlike_out.extend(v for v in (got.get("_source") or
+                                          {}).values()
+                              if isinstance(v, str))
+    if unlike_out:
+        spec["unlike"] = unlike_out
     for item in likes:
         if not isinstance(item, dict):
             texts.append(item)
+            continue
+        if "doc" in item:
+            texts.extend(str(v) for v in item["doc"].values()
+                         if isinstance(v, str))
             continue
         did = item.get("_id")
         if did is None:
@@ -258,6 +297,9 @@ class SearchActions:
         self._rotation = itertools.count()
         self._contexts: dict[str, _ScrollContext] = {}
         self._ctx_ids = itertools.count(1)
+        # data-node side scroll pins: (ctx_uid, index, shard) →
+        # (SearcherView, DeviceReader, expires_at_monotonic)
+        self._pinned: dict[tuple, tuple] = {}
         self._lock = threading.Lock()
         node.transport_service.register_request_handler(
             self.QUERY_FETCH, self._handle_shard_query, executor="search",
@@ -303,7 +345,8 @@ class SearchActions:
         return self._execute_shard(request["index"], request["shard"],
                                    request["body"],
                                    doc_slot=request.get("doc_slot"),
-                                   dfs=request.get("dfs"))
+                                   dfs=request.get("dfs"),
+                                   scroll_pin=request.get("scroll_pin"))
 
     def _handle_shard_msearch(self, request: dict, source) -> dict:
         """Shard-side _msearch: B request bodies against one shard in ONE
@@ -381,16 +424,21 @@ class SearchActions:
 
     def _execute_shard(self, name: str, shard: int, body: dict,
                        doc_slot: int | None = None,
-                       dfs: dict | None = None) -> dict:
+                       dfs: dict | None = None,
+                       scroll_pin: dict | None = None) -> dict:
         t0 = time.perf_counter()
         svc = self.node.indices_service.index(name)
         engine = svc.engine(shard)
-        reader = device_reader_for(engine)
+        if scroll_pin is not None:
+            reader = self._pinned_reader(scroll_pin, name, shard, engine)
+        else:
+            reader = device_reader_for(engine)
         # shard request cache: hits-free (size 0) requests keyed by reader
         # generation + request bytes (IndicesRequestCache.java:78); gated
         # by index.requests.cache.enable
         cache_key = None
-        if body.get("size") == 0 and str(svc.index_settings.get(
+        if scroll_pin is None and body.get("size") == 0 and \
+                str(svc.index_settings.get(
                 "index.requests.cache.enable", "true")).lower() != "false":
             cache_key = self.request_cache.key(engine.engine_uuid,
                                                reader.generation, body, dfs)
@@ -411,8 +459,11 @@ class SearchActions:
                                      dfs_stats=to_execution_stats(dfs))
             req = parse_search_request(body)
             result = searcher.query_phase(req)
+            q_ms = (time.perf_counter() - t0) * 1000.0
             k = min(len(result.doc_ids), req.from_ + req.size)
             hits = searcher.fetch_phase(req, result, name, list(range(k)))
+            svc.note_search(body.get("stats"), q_ms,
+                            (time.perf_counter() - t0) * 1000.0 - q_ms)
             out = {"total": result.total,
                    "max_score": (float(result.max_score)
                                  if result.max_score is not None else None),
@@ -465,7 +516,8 @@ class SearchActions:
 
     def _try_shard(self, state, name: str, sid: int, copies: list,
                    body: dict, doc_slot: int | None = None,
-                   dfs: dict | None = None):
+                   dfs: dict | None = None,
+                   scroll_pin: dict | None = None):
         """→ ("ok", payload) or ("fail", reason-dict). Walks the copy list
         (shard-failover retry, TransportSearchTypeAction.java:205-247)."""
         from elasticsearch_tpu.action.replication import unwrap_remote
@@ -482,7 +534,7 @@ class SearchActions:
                     # the next copy like any shard failure
                     fut = self.node.thread_pool.submit(
                         "search", self._execute_shard, name, sid, body,
-                        doc_slot=doc_slot, dfs=dfs)
+                        doc_slot=doc_slot, dfs=dfs, scroll_pin=scroll_pin)
                     try:
                         return "ok", fut.result(35.0)
                     except Exception:
@@ -494,7 +546,8 @@ class SearchActions:
                 return "ok", self.node.transport_service.send_request(
                     target, self.QUERY_FETCH,
                     {"index": name, "shard": sid, "body": body,
-                     "doc_slot": doc_slot, "dfs": dfs},
+                     "doc_slot": doc_slot, "dfs": dfs,
+                     "scroll_pin": scroll_pin},
                     timeout=30.0).result(35.0)
             except Exception as e:               # noqa: BLE001 — classify
                 e = unwrap_remote(e)
@@ -534,16 +587,22 @@ class SearchActions:
         t0 = time.perf_counter()
         body = dict(body or {})
         dfs_cache: dict | None = {} if scroll is not None else None
+        scroll_pin = None
         if scroll is not None:
             body["sort"] = self._scroll_sort(body.get("sort"))
+            import uuid as _uuid
+            keep = parse_time_value(scroll, "scroll")
+            scroll_pin = {"uid": _uuid.uuid4().hex, "keep_s": keep}
         resp = self._search_once(index_expr, body, t0,
                                  search_type=search_type,
-                                 dfs_cache=dfs_cache)
+                                 dfs_cache=dfs_cache,
+                                 scroll_pin=scroll_pin)
         if scroll is not None:
             resp["_scroll_id"] = self._open_scroll(index_expr, body, scroll,
                                                    resp,
                                                    search_type=search_type,
-                                                   dfs_cache=dfs_cache)
+                                                   dfs_cache=dfs_cache,
+                                                   ctx_uid=scroll_pin["uid"])
         return resp
 
     def _dfs_phase(self, state, groups, body: dict) -> dict:
@@ -566,7 +625,8 @@ class SearchActions:
 
     def _search_once(self, index_expr: str, body: dict, t0: float,
                      search_type: str | None = None,
-                     dfs_cache: dict | None = None) -> dict:
+                     dfs_cache: dict | None = None,
+                     scroll_pin: dict | None = None) -> dict:
         names = self.node.indices_service.resolve_open(index_expr)
         body = rewrite_mlt_likes(self.node, body,
                                  names[0] if names else "_all")
@@ -590,7 +650,7 @@ class SearchActions:
         slot_of = {(n, s): i for i, (n, s) in
                    enumerate(sorted((n, s) for n, s, _ in groups))}
         futures = [self._pool.submit(self._try_shard, state, n, s, copies,
-                                     body, slot_of[(n, s)], dfs)
+                                     body, slot_of[(n, s)], dfs, scroll_pin)
                    for n, s, copies in groups]
         payloads, failures = [], []
         for fut in futures:
@@ -714,24 +774,27 @@ class SearchActions:
 
     # ---- field stats (core/action/fieldstats/TransportFieldStatsAction) ----
 
-    def field_stats(self, index_expr: str, fields: list[str]) -> dict:
+    def field_stats(self, index_expr: str, fields: list[str],
+                    level: str = "cluster",
+                    index_constraints: dict | None = None) -> dict:
         """Per-field min/max/doc-count over one copy of every shard,
-        reduced cluster-wide (the 2.x _field_stats API, level=cluster)."""
+        reduced cluster-wide or per index (the 2.x _field_stats API
+        `level` param)."""
         names = self.node.indices_service.resolve_open(index_expr)
         state = self.node.cluster_service.state()
         groups = self._shard_groups(state, names)
-        body = {"fields": fields}
+        fetch = list(fields)
+        for f in (index_constraints or {}):
+            if f not in fetch:
+                fetch.append(f)
+        body = {"fields": fetch}
         futures = [self._pool.submit(
             self._try_shard_action, state, n, s, copies, self.FIELD_STATS,
             self._handle_field_stats, body) for n, s, copies in groups]
-        merged: dict[str, dict] = {}
+        buckets: dict[str, dict[str, dict]] = {}
         ok = failed = 0
-        for fut in futures:
-            status, payload = fut.result()
-            if status != "ok":
-                failed += 1
-                continue
-            ok += 1
+
+        def fold(merged: dict, payload: dict) -> None:
             for f, st in payload["fields"].items():
                 cur = merged.get(f)
                 if cur is None:
@@ -753,12 +816,62 @@ class SearchActions:
                         cur["type_conflict"] = True
                     else:
                         cur[k] = pick(cur[k], st[k])
-        for st in merged.values():
-            st["density"] = int(100 * st["doc_count"] /
-                                max(st["max_doc"], 1))
+        for (n, _s, _c), fut in zip(groups, futures):
+            status, payload = fut.result()
+            if status != "ok":
+                failed += 1
+                continue
+            ok += 1
+            key = n if level == "indices" else "_all"
+            fold(buckets.setdefault(key, {}), payload)
+        for merged in buckets.values():
+            for st in merged.values():
+                st["density"] = int(100 * st["doc_count"] /
+                                    max(st["max_doc"], 1))
+        if index_constraints:
+            # drop indices whose constrained field stats miss the bounds
+            # (FieldStatsRequest indexConstraints)
+            def meets(merged: dict) -> bool:
+                for f, spec in index_constraints.items():
+                    st = merged.get(f)
+                    if st is None:
+                        return False
+                    for prop, bounds in spec.items():
+                        val = st.get(prop)
+                        if val is None:
+                            return False
+                        for op, want in bounds.items():
+                            try:
+                                if isinstance(val, str):
+                                    w = str(want)
+                                else:
+                                    try:
+                                        w = type(val)(want)
+                                    except (TypeError, ValueError):
+                                        # date-string constraint against a
+                                        # millis-valued field
+                                        from elasticsearch_tpu.mapping \
+                                            .mapper import parse_date
+                                        w = type(val)(parse_date(want))
+                            except Exception:  # noqa: BLE001 — no compare
+                                return False
+                            if op == "gte" and not val >= w:
+                                return False
+                            if op == "gt" and not val > w:
+                                return False
+                            if op == "lte" and not val <= w:
+                                return False
+                            if op == "lt" and not val < w:
+                                return False
+                return True
+            buckets = {k: v for k, v in buckets.items() if meets(v)}
+            want_fields = set(fields)
+            buckets = {k: {f: st for f, st in v.items()
+                           if f in want_fields}
+                       for k, v in buckets.items()}
         return {"_shards": {"total": len(groups), "successful": ok,
                             "failed": failed},
-                "indices": {"_all": {"fields": merged}}}
+                "indices": {k: {"fields": v} for k, v in buckets.items()}}
 
     def _try_shard_action(self, state, name, sid, copies, action,
                           local_handler, body, extra: dict | None = None):
@@ -862,6 +975,41 @@ class SearchActions:
                           "min_value": min_v, "max_value": max_v}
         return {"fields": out}
 
+    def _pinned_reader(self, scroll_pin: dict, name: str, shard: int,
+                       engine):
+        """Point-in-time reader for a scroll context: the FIRST page pins
+        the shard's current SearcherView (segments are immutable, the view
+        object keeps them alive); later pages reuse it regardless of
+        refreshes — ScrollContext semantics (SearchService.java:533-558).
+        Views expire with the scroll keep-alive."""
+        from elasticsearch_tpu.index.device_reader import DeviceReader
+        key = (scroll_pin["uid"], name, shard)
+        now = time.monotonic()
+        with self._lock:
+            # lazy sweep of expired pins
+            dead = [k for k, (_, _, exp) in self._pinned.items()
+                    if exp < now]
+            for k in dead:
+                del self._pinned[k]
+            hit = self._pinned.get(key)
+            if hit is not None:
+                view, reader, _ = hit
+                self._pinned[key] = (view, reader,
+                                     now + scroll_pin["keep_s"])
+                return reader
+        view = engine.acquire_searcher()
+        reader = device_reader_for(engine, view)
+        if reader.generation != view.generation:
+            reader = DeviceReader(view)
+        with self._lock:
+            self._pinned[key] = (view, reader, now + scroll_pin["keep_s"])
+        return reader
+
+    def _drop_pins(self, uid: str) -> None:
+        with self._lock:
+            for k in [k for k in self._pinned if k[0] == uid]:
+                del self._pinned[k]
+
     # ---- scroll ------------------------------------------------------------
 
     @staticmethod
@@ -881,9 +1029,11 @@ class SearchActions:
 
     def _open_scroll(self, index_expr: str, body: dict, scroll: str,
                      first_page: dict, search_type: str | None = None,
-                     dfs_cache: dict | None = None) -> str:
+                     dfs_cache: dict | None = None,
+                     ctx_uid: str | None = None) -> str:
         keep = parse_time_value(scroll, "scroll")
-        ctx = _ScrollContext(index_expr, body, keep, search_type=search_type)
+        ctx = _ScrollContext(index_expr, body, keep, search_type=search_type,
+                             ctx_uid=ctx_uid)
         ctx.dfs_cache = dfs_cache if dfs_cache is not None else {}
         self._note_page(ctx, first_page)
         with self._lock:
@@ -927,7 +1077,9 @@ class SearchActions:
             body["search_after"] = ctx.last_sort_key
         resp = self._search_once(ctx.index_expr, body, time.perf_counter(),
                                  search_type=ctx.search_type,
-                                 dfs_cache=ctx.dfs_cache)
+                                 dfs_cache=ctx.dfs_cache,
+                                 scroll_pin={"uid": ctx.ctx_uid,
+                                             "keep_s": ctx.keep_alive_s})
         self._note_page(ctx, resp)
         resp["_scroll_id"] = scroll_id
         return resp
@@ -942,7 +1094,13 @@ class SearchActions:
                 cid = json.loads(base64.b64decode(scroll_id))["id"]
             except Exception:                    # noqa: BLE001 — bad id
                 return 0
-            return 1 if self._contexts.pop(cid, None) is not None else 0
+            ctx = self._contexts.pop(cid, None)
+        if ctx is not None:
+            # local pins die now; REMOTE nodes' pins age out with the
+            # keep-alive (a clear RPC would tighten this cluster-wide)
+            self._drop_pins(ctx.ctx_uid)
+            return 1
+        return 0
 
     def reap_expired(self) -> int:
         now = time.monotonic()
@@ -951,6 +1109,12 @@ class SearchActions:
                     if c.expires_at < now]
             for k in dead:
                 del self._contexts[k]
+            # expired reader pins release their device-resident views here
+            # too — lazy sweeping inside _pinned_reader alone would leak
+            # them on nodes that never serve another pinned search
+            for k in [k for k, (_, _, exp) in self._pinned.items()
+                      if exp < now]:
+                del self._pinned[k]
         return len(dead)
 
     def active_contexts(self) -> int:

@@ -354,6 +354,9 @@ BUILTIN_ANALYZERS: dict[str, Analyzer] = {
                          [lowercase_filter, stop_filter_factory(),
                           porter_stem_filter]),
 }
+# "default" names the index's default analyzer — standard unless the index
+# overrides it (AnalysisRegistry resolves overrides; this is the fallback)
+BUILTIN_ANALYZERS["default"] = BUILTIN_ANALYZERS["standard"]
 
 
 class AnalysisRegistry:

@@ -93,6 +93,14 @@ class IndexService:
         self.search_slow_log = SearchSlowLog(meta.name, index_settings)
         self.indexing_slow_log = IndexingSlowLog(meta.name, index_settings)
         self.breaker_service = breaker_service
+        # per-index search stats incl. request groups (ref:
+        # core/index/search/stats/ShardSearchStats.java:36 — _all bucket
+        # plus one bucket per `stats` group named by the request)
+        self.search_stats = {"query_total": 0, "query_time_ms": 0.0,
+                             "fetch_total": 0, "fetch_time_ms": 0.0,
+                             "groups": {}}
+        # per-type indexing counters (ShardIndexingService typeStats)
+        self.indexing_types: dict[str, int] = {}
         self.engines: dict[int, Engine] = {}
         if local_shards is None:
             local_shards = list(range(meta.number_of_shards))
@@ -162,6 +170,20 @@ class IndexService:
     def num_docs(self) -> int:
         return sum(e.num_docs for e in self.shard_engines)
 
+    def note_search(self, groups, query_ms: float,
+                    fetch_ms: float = 0.0) -> None:
+        """One completed shard search (ShardSearchStats.onQueryPhase)."""
+        buckets = [self.search_stats]
+        for g in groups or []:
+            buckets.append(self.search_stats["groups"].setdefault(
+                str(g), {"query_total": 0, "query_time_ms": 0.0,
+                         "fetch_total": 0, "fetch_time_ms": 0.0}))
+        for b in buckets:
+            b["query_total"] += 1
+            b["query_time_ms"] += query_ms
+            b["fetch_total"] += 1
+            b["fetch_time_ms"] += fetch_ms
+
     def stats(self) -> dict:
         agg = {"index_total": 0, "delete_total": 0, "refresh_total": 0,
                "flush_total": 0, "merge_total": 0, "index_time_ms": 0.0}
@@ -209,11 +231,34 @@ class IndexService:
                          "index_time_in_millis": int(agg["index_time_ms"]),
                          "delete_total": agg["delete_total"],
                          "is_throttled": False,
-                         "throttle_time_in_millis": 0},
+                         "throttle_time_in_millis": 0,
+                         "types": {
+                             t: {"index_total": n,
+                                 "index_time_in_millis": 0,
+                                 "index_current": 0,
+                                 "delete_total": 0,
+                                 "delete_time_in_millis": 0,
+                                 "delete_current": 0}
+                             for t, n in self.indexing_types.items()}},
             "get": {"total": 0, "time_in_millis": 0},
-            "search": {"open_contexts": 0, "query_total": 0,
-                       "query_time_in_millis": 0, "fetch_total": 0,
-                       "fetch_time_in_millis": 0},
+            "search": {
+                "open_contexts": 0,
+                "query_total": self.search_stats["query_total"],
+                "query_time_in_millis":
+                    int(self.search_stats["query_time_ms"]),
+                "query_current": 0,
+                "fetch_total": self.search_stats["fetch_total"],
+                "fetch_time_in_millis":
+                    int(self.search_stats["fetch_time_ms"]),
+                "fetch_current": 0,
+                "groups": {
+                    g: {"query_total": b["query_total"],
+                        "query_time_in_millis": int(b["query_time_ms"]),
+                        "query_current": 0,
+                        "fetch_total": b["fetch_total"],
+                        "fetch_time_in_millis": int(b["fetch_time_ms"]),
+                        "fetch_current": 0}
+                    for g, b in self.search_stats["groups"].items()}},
             "merges": {"total": agg["merge_total"],
                        "total_time_in_millis": 0, "current": 0},
             "refresh": {"total": agg["refresh_total"],

@@ -42,6 +42,48 @@ NUMERIC_TYPES = {"long", "integer", "short", "byte", "double", "float",
 POSITION_INCREMENT_GAP = 16
 
 
+def completion_context_value(cfg: dict, raw) -> str:
+    """One context dimension's value → its index key component."""
+    if cfg.get("type") == "geo":
+        from elasticsearch_tpu.utils.geohash import (
+            geohash_encode, precision_to_length)
+        length = precision_to_length(cfg.get("precision", "1km"))
+        if isinstance(raw, dict):
+            lat, lon = float(raw.get("lat")), float(raw.get("lon"))
+        elif isinstance(raw, (list, tuple)):
+            lon, lat = float(raw[0]), float(raw[1])
+        else:
+            return str(raw)[:length]          # already a geohash
+        return geohash_encode(lat, lon, length)
+    return str(raw)
+
+
+def completion_context_keys(cfg: dict, provided: dict,
+                            path_values: dict | None = None) -> list[str]:
+    """Context config + per-value context → the key prefixes an input is
+    indexed under (one per combination; ref: ContextMapping.parseContext).
+    A `path` dimension with no resolved value yet yields a placeholder the
+    DocumentMapper post-pass replaces from the doc source."""
+    dims: list[list[str]] = []
+    for name in sorted(cfg):
+        c = cfg[name] or {}
+        raw = provided.get(name)
+        if raw is None and path_values and name in path_values:
+            raw = path_values[name]
+        if raw is None and c.get("path"):
+            dims.append([f"\x00PATH:{name}"])
+            continue
+        if raw is None:
+            raw = c.get("default", "")
+        vals = raw if isinstance(raw, list) else [raw]
+        dims.append([completion_context_value(c, v) for v in vals])
+    keys = [""]
+    for vals in dims:
+        keys = [f"{k}\x1d{v}" if k else str(v)
+                for k in keys for v in vals]
+    return keys
+
+
 def parse_date(value: Any) -> float:
     """→ epoch millis (float). Accepts epoch millis, ISO-8601, yyyy-MM-dd."""
     if isinstance(value, bool):
@@ -104,6 +146,12 @@ class FieldMapper:
         # (reference: core/index/mapper/core/StringFieldMapper.java).
         if ftype == "string":
             self.type = "keyword" if params.get("index") == "not_analyzed" else "text"
+        elif ftype == "multi_field":
+            # pre-1.0 multi_field syntax (still accepted in 2.x): the
+            # sub-field named like the field is the main mapping
+            main = (params.get("fields") or {}).get(name.split(".")[-1], {})
+            self.type = "keyword" if main.get("index") == "not_analyzed" \
+                else "text"
         if self.type == "text":
             self.kind = KIND_TEXT
             self.analyzer = analysis.get(params.get("analyzer", "standard"))
@@ -114,6 +162,11 @@ class FieldMapper:
             # suggester prefix-scans the sorted vocab, standing in for the
             # reference's FST-backed CompletionFieldMapper
             self.kind = KIND_KEYWORD
+            # context suggester config (ContextMappings, 2.x "context" on
+            # completion fields): {name: {type: category|geo, default?,
+            # path?, precision?}}
+            self.context_config = params.get("context") \
+                if self.type == "completion" else None
         elif self.type in NUMERIC_TYPES:
             self.kind = KIND_NUMERIC
         elif self.type == "dense_vector":
@@ -133,8 +186,12 @@ class FieldMapper:
 
     def to_dict(self) -> dict:
         # render the type the mapping was PUT with (2.x "string" stays
-        # "string" even though it resolved to text/keyword internally)
-        out = {"type": self.params.get("type", self.type),
+        # "string" even though it resolved to text/keyword internally;
+        # legacy multi_field renders as string like the reference upgrade)
+        rendered = self.params.get("type", self.type)
+        if rendered == "multi_field":
+            rendered = "string"
+        out = {"type": rendered,
                **{k: v for k, v in self.params.items()
                   if k not in ("type", "fields")}}
         if self.sub_fields:
@@ -178,12 +235,28 @@ class FieldMapper:
                 # parse shapes); weights degrade to doc frequency here
                 flat: list[str] = []
                 for v in values:
+                    inputs: list[str]
+                    provided_ctx: dict = {}
                     if isinstance(v, dict):
                         inp = v.get("input", [])
-                        flat.extend([inp] if isinstance(inp, str) else
-                                    [str(x) for x in inp])
+                        inputs = [inp] if isinstance(inp, str) else \
+                            [str(x) for x in inp]
+                        provided_ctx = v.get("context") or {}
                     elif v is not None:
-                        flat.append(str(v))
+                        inputs = [str(v)]
+                    else:
+                        continue
+                    cfg = getattr(self, "context_config", None)
+                    # match keys are lowercased (CompletionFieldMapper's
+                    # default "simple" index analyzer); the original text
+                    # rides after \x1e for display
+                    encoded = [f"{i.lower()}\x1e{i}" for i in inputs]
+                    if cfg:
+                        keys = completion_context_keys(cfg, provided_ctx)
+                        flat.extend(f"{key}\x1f{e}" for key in keys
+                                    for e in encoded)
+                    else:
+                        flat.extend(encoded)
                 pf.keywords = flat
             else:
                 pf.keywords = [str(v) for v in values if v is not None]
@@ -326,6 +399,25 @@ class DocumentMapper:
         self._parse_object(source, "", fields, new_mappers, nested)
         for m in new_mappers:        # dynamic mapping update
             self.add_mapper(m)
+        # resolve completion-context `path` placeholders from the doc
+        # source (ContextMapping path references another field's value)
+        for fname, pf in fields.items():
+            if not pf.keywords or "\x00PATH:" not in "".join(pf.keywords):
+                continue
+            fm = self.mappers.get(fname)
+            cfg = getattr(fm, "context_config", None) or {}
+            resolved = []
+            for key in pf.keywords:
+                for name, c in cfg.items():
+                    ph = f"\x00PATH:{name}"
+                    if ph in key:
+                        raw = source.get(c.get("path", ""))
+                        if raw is None:
+                            raw = c.get("default", "")
+                        key = key.replace(
+                            ph, completion_context_value(c, raw))
+                resolved.append(key)
+            pf.keywords = resolved
         if meta:
             # metadata fields index as ordinary columns under their
             # reserved names — _type/_parent keyword, _timestamp/_ttl

@@ -183,6 +183,10 @@ def register_all(rc: RestController, node) -> None:
     r("DELETE", "/_search/scroll/{scroll_id}", h.clear_scroll)
     r("POST", "/{index}/_validate/query", h.validate_query)
     r("GET", "/{index}/_validate/query", h.validate_query)
+    r("POST", "/_validate/query", h.validate_query)
+    r("GET", "/_validate/query", h.validate_query)
+    r("POST", "/{index}/{type}/_validate/query", h.validate_query)
+    r("GET", "/{index}/{type}/_validate/query", h.validate_query)
     r("POST", "/{index}/_analyze", h.analyze)
     r("GET", "/{index}/_analyze", h.analyze)
     r("POST", "/_analyze", h.analyze)
@@ -190,6 +194,8 @@ def register_all(rc: RestController, node) -> None:
     # cluster & stats
     r("GET", "/_cluster/health", h.cluster_health)
     r("GET", "/_cluster/state", h.cluster_state)
+    r("GET", "/_cluster/state/{metric}", h.cluster_state)
+    r("GET", "/_cluster/state/{metric}/{index}", h.cluster_state)
     r("GET", "/_cluster/stats", h.cluster_stats)
     r("GET", "/_cluster/settings", h.cluster_settings)
     r("PUT", "/_cluster/settings", h.put_cluster_settings)
@@ -554,9 +560,13 @@ class Handlers:
             if svc is None:
                 continue
             mappings = {}
+            type_pats = None
+            if want_type and want_type not in ("_all", "*"):
+                type_pats = [t for t in want_type.split(",") if t]
+            include_defaults = req.param_as_bool("include_defaults")
             for tname, dm in svc.mapper_service.mappers.items():
-                if want_type and want_type not in ("_all", "*") \
-                        and not _wildcard_match(tname, want_type):
+                if type_pats and not any(_wildcard_match(tname, p)
+                                         for p in type_pats):
                     continue
                 type_seen = True
                 fmap = {}
@@ -564,8 +574,13 @@ class Handlers:
                     for fname, fm in dm.mappers.items():
                         if _wildcard_match(fname, pat):
                             leaf = fname.split(".")[-1]
+                            fdict = fm.to_dict()
+                            if include_defaults and \
+                                    getattr(fm, "kind", None) == "text":
+                                fdict.setdefault("analyzer", "default")
+                                fdict.setdefault("index", "analyzed")
                             fmap[fname] = {"full_name": fname,
-                                           "mapping": {leaf: fm.to_dict()}}
+                                           "mapping": {leaf: fdict}}
                 mappings[tname] = fmap
             # an index where no requested type/field matched renders as
             # ABSENT (the reference returns {} for a fully-missing field)
@@ -606,6 +621,17 @@ class Handlers:
                 settings = {"index": idx}
                 if not idx:
                     continue
+            if req.param_as_bool("flat_settings"):
+                flat = {}
+                def walk(prefix, node):
+                    for k, v in node.items():
+                        key = f"{prefix}.{k}" if prefix else k
+                        if isinstance(v, dict):
+                            walk(key, v)
+                        else:
+                            flat[key] = v
+                walk("", settings)
+                settings = flat
             out[n] = {"settings": settings}
         return 200, out
 
@@ -616,7 +642,7 @@ class Handlers:
         body = req.body or {}
         settings = body.get("settings", body)
         expr = req.path_params.get("index", "_all")
-        for n in self.node.indices_service.resolve(expr):
+        for n in self._resolve_expanded(req, expr):
             self.node.indices_service.update_settings(n, settings)
         return 200, {"acknowledged": True}
 
@@ -771,17 +797,17 @@ class Handlers:
         expr = req.path_params.get("index") or req.param("index") or "_all"
         names = self.node.indices_service.resolve(expr)
         name_expr = req.path_params.get("name") or req.param("name")
-        pats = None
-        if name_expr and name_expr not in ("_all", "*"):
-            pats = [p for p in name_expr.split(",") if p]
         out = {}
         for n in names:
             have = state.indices[n].warmers
-            if pats is None:
-                # no name filter → every resolved index appears, empty
+            if name_expr is None:
+                # bare GET /_warmer → every resolved index appears, empty
                 # warmer maps included
                 out[n] = {"warmers": dict(have)}
                 continue
+            # with a name expression (wildcards included) only indices
+            # holding a match appear
+            pats = ["*"] if name_expr in ("_all", "*")                 else [p for p in name_expr.split(",") if p]
             have = {w: v for w, v in have.items()
                     if any(fnmatch.fnmatch(w, p) for p in pats)}
             if have:
@@ -835,6 +861,17 @@ class Handlers:
         self.node.put_template(name, body)
         return 200, {"acknowledged": True}
 
+    @staticmethod
+    def _nest_settings(flat: dict) -> dict:
+        out: dict = {}
+        for k, v in flat.items():
+            node = out
+            parts = k.split(".")
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = v
+        return out
+
     def get_template(self, req: RestRequest):
         name = req.path_params["name"]
         templates = self.node.cluster_service.state().templates
@@ -843,6 +880,11 @@ class Handlers:
                if any(fnmatch.fnmatch(n, p) for p in pats)}
         if not hit:
             return 404, {}
+        if not req.param_as_bool("flat_settings"):
+            hit = {n: ({**t, "settings":
+                        self._nest_settings(t["settings"])}
+                       if isinstance(t.get("settings"), dict) else t)
+                   for n, t in hit.items()}
         return 200, hit
 
     def get_templates(self, req: RestRequest):
@@ -898,20 +940,29 @@ class Handlers:
 
     def _write_meta(self, req: RestRequest, index: str,
                     body: dict | None = None) -> dict | None:
+        body = body or {}
+        return self._doc_meta_fields(
+            index, req.path_params.get("type"),
+            parent=req.param("parent", body.get("parent")),
+            routing=req.param("routing", body.get("routing")),
+            timestamp=req.param("timestamp", body.get("timestamp")),
+            ttl=req.param("ttl", body.get("ttl")))
+
+    def _doc_meta_fields(self, index: str, tname: str | None, *,
+                         parent=None, routing=None, timestamp=None,
+                         ttl=None) -> dict | None:
         """Metadata fields for a doc write: _type, _parent (+ the
-        routing_missing_exception requirement), _timestamp, _ttl.
+        routing_missing_exception requirement), _timestamp, _ttl — ONE
+        rule set shared by the single-doc and bulk paths.
         Ref: core/index/mapper/internal/{Parent,Timestamp,TTL}FieldMapper
         + TransportIndexAction request resolution."""
         from elasticsearch_tpu.common.errors import RoutingMissingError
-        body = body or {}
-        tname = req.path_params.get("type")
-        parent = req.param("parent", body.get("parent"))
         meta: dict = {}
-        if tname and not tname.startswith("_"):
-            meta["_type"] = tname
+        if tname and not str(tname).startswith("_"):
+            meta["_type"] = str(tname)
         dm = self._type_mapper(index, tname)
         if dm is not None and dm.parent_type and parent is None and \
-                req.param("routing", body.get("routing")) is None:
+                routing is None:
             # resolved routing (explicit or parent-derived) must exist
             # (TransportIndexAction.resolveRequest)
             raise RoutingMissingError(
@@ -919,16 +970,14 @@ class Handlers:
         if parent is not None:
             meta["_parent"] = str(parent)
         now = int(time.time() * 1000)
-        ts = req.param("timestamp", body.get("timestamp"))
-        if ts is not None:
-            if str(ts).lstrip("-").isdigit():
-                meta["_timestamp"] = int(ts)      # epoch millis
+        if timestamp is not None:
+            if str(timestamp).lstrip("-").isdigit():
+                meta["_timestamp"] = int(timestamp)   # epoch millis
             else:
                 from elasticsearch_tpu.mapping.mapper import parse_date
-                meta["_timestamp"] = int(parse_date(ts))
+                meta["_timestamp"] = int(parse_date(timestamp))
         elif dm is not None and dm.timestamp_enabled:
             meta["_timestamp"] = now
-        ttl = req.param("ttl", body.get("ttl"))
         if ttl is None and dm is not None and dm.ttl_enabled:
             ttl = dm.ttl_default
         if ttl is not None:
@@ -1049,7 +1098,7 @@ class Handlers:
                 for f in flist:
                     if f.startswith("_"):
                         continue          # metadata fields render top-level
-                    v = src.get(f)
+                    v = _source_from_path(src, f)
                     if v is not None:
                         out[f] = v if isinstance(v, list) else [v]
                 resp = {**resp, "fields": out}
@@ -1248,29 +1297,23 @@ class Handlers:
                 meta.setdefault("_index", default_index)
                 meta.setdefault("_type", req.path_params.get("type"))
                 if action in ("index", "create", "update"):
-                    mf = {}
-                    t = meta.get("_type")
-                    if t and not str(t).startswith("_"):
-                        mf["_type"] = str(t)
-                    parent = meta.get("parent", meta.get("_parent"))
-                    if parent is not None:
-                        mf["_parent"] = str(parent)
-                    ts = meta.get("timestamp", meta.get("_timestamp"))
-                    if ts is not None:
-                        if str(ts).lstrip("-").isdigit():
-                            mf["_timestamp"] = int(ts)
-                        else:
-                            from elasticsearch_tpu.mapping.mapper import (
-                                parse_date)
-                            mf["_timestamp"] = int(parse_date(ts))
-                    ttl = meta.get("ttl", meta.get("_ttl"))
-                    if ttl is not None:
-                        from elasticsearch_tpu.common.settings import (
-                            parse_time_value)
-                        mf["_ttl"] = int(time.time() * 1000) + \
-                            int(parse_time_value(ttl, "ttl") * 1000)
-                    if mf:
-                        meta["_meta_fields"] = mf
+                    try:
+                        mf = self._doc_meta_fields(
+                            meta.get("_index"), meta.get("_type"),
+                            parent=meta.get("parent", meta.get("_parent")),
+                            routing=meta.get("routing",
+                                             meta.get("_routing")),
+                            timestamp=meta.get("timestamp",
+                                               meta.get("_timestamp")),
+                            ttl=meta.get("ttl", meta.get("_ttl")))
+                        if mf:
+                            meta["_meta_fields"] = mf
+                    except ElasticsearchTpuError as e:
+                        # per-item failure — the bulk response carries it,
+                        # the request succeeds (TransportShardBulkAction
+                        # item error contract)
+                        meta["_meta_error"] = {"status": e.status,
+                                               "error": e.to_xcontent()}
                 source = None
                 if action in ("index", "create", "update"):
                     if i >= len(lines):
@@ -1322,6 +1365,9 @@ class Handlers:
                 for s in req.param("sort").split(",")]
         if req.param("_source") in ("false", "true"):
             body["_source"] = req.param("_source") == "true"
+        for fp in ("fielddata_fields", "docvalue_fields"):
+            if req.param(fp) and fp not in body:
+                body[fp] = req.param(fp).split(",")
         inc = req.param("_source_include", req.param("_source_includes"))
         exc = req.param("_source_exclude", req.param("_source_excludes"))
         if inc or exc:
@@ -1415,18 +1461,46 @@ class Handlers:
         self._check_type(req)
         body = req.body or {}
         if "query" not in body and req.param("q"):
-            body = {"query": {"query_string": {"query": req.param("q")}}}
+            # reuse the full q-param surface (default_operator, analyzer,
+            # lowercase_expanded_terms...) the search endpoint supports
+            body = {"query": self._search_body(req)["query"]}
         out = self.node.document_actions.explain_doc(
             req.path_params["index"], req.path_params["id"], body,
-            routing=req.param("routing"))
+            routing=self._read_routing(req, req.path_params["index"]))
+        spec = self._get_source_spec(req)
+        if spec is not False and (req.param("_source") is not None
+                                  or req.param("_source_include")
+                                  or req.param("_source_includes")
+                                  or req.param("_source_exclude")
+                                  or req.param("_source_excludes")):
+            got = self.node.get_doc(
+                req.path_params["index"], req.path_params["id"],
+                routing=self._read_routing(req, req.path_params["index"]))
+            if got.get("found"):
+                src = got.get("_source")
+                if spec is not True:
+                    src = _filter_doc_source(src, spec)
+                out = {**out, "get": {"found": True, "_source": src}}
         return 200, self._echo_type(req, out)
 
     def termvectors(self, req: RestRequest):
         self._check_type(req)
+        body = dict(req.body or {})
+        for k in ("term_statistics", "field_statistics", "offsets",
+                  "positions", "payloads", "realtime"):
+            if req.param(k) is not None and k not in body:
+                body[k] = req.param_as_bool(
+                    k, k not in ("term_statistics",))
+        if req.param("fields") and "fields" not in body:
+            body["fields"] = req.param("fields").split(",")
         out = self.node.document_actions.termvectors(
             req.path_params["index"], req.path_params["id"],
-            req.body or {}, routing=req.param("routing"))
-        return (200 if out.get("found") else 404), out
+            body, routing=req.param("routing"))
+        t = req.path_params.get("type")
+        if t and t != "_all":
+            out = {**out, "_type": t}
+        # found:false is a 200 (TermVectorsResponse renders OK either way)
+        return 200, out
 
     def field_stats(self, req: RestRequest):
         fields = req.param("fields")
@@ -1434,7 +1508,9 @@ class Handlers:
         flist = body.get("fields") or \
             ([f.strip() for f in fields.split(",")] if fields else [])
         index = req.path_params.get("index", "_all")
-        return 200, self.node.search_actions.field_stats(index, flist)
+        return 200, self.node.search_actions.field_stats(
+            index, flist, level=req.param("level", "cluster"),
+            index_constraints=body.get("index_constraints"))
 
     # ---- percolator -------------------------------------------------------
 
@@ -1684,9 +1760,16 @@ class Handlers:
             error = e.message
         out = {"valid": valid,
                "_shards": {"total": 1, "successful": 1, "failed": 0}}
-        if error and req.param_as_bool("explain"):
-            out["explanations"] = [{"index": req.path_params.get("index"),
-                                    "valid": False, "error": error}]
+        if req.param_as_bool("explain"):
+            names = self.node.indices_service.resolve(
+                req.path_params.get("index", "_all"))
+            out["explanations"] = [
+                {"index": n, "valid": valid,
+                 **({"error": error} if error
+                    else {"explanation": "*:*" if not body.get("query")
+                          else json.dumps(body.get("query"),
+                                          separators=(",", ":"))})}
+                for n in names]
         return 200, out
 
     def analyze(self, req: RestRequest):
@@ -1828,18 +1911,123 @@ class Handlers:
         else:
             out = self.node.cluster_service.state().health(
                 len(self.node.cluster_service.pending_tasks()))
-        if req.params.get("level") in ("indices", "shards"):
+        level = req.params.get("level")
+        if level in ("indices", "shards"):
             state = self.node.cluster_service.state()
             out = dict(out)
-            out["indices"] = {name: {"status": out["status"]}
-                              for name in state.indices}
-        return 200, out
+            indices = {}
+            for name, meta in state.indices.items():
+                copies = list(state.routing_table.index_shards(name))
+                active = [s for s in copies if s.active]
+                prim_active = [s for s in active if s.primary]
+                if len(active) == len(copies):
+                    istat = "green"
+                elif len(prim_active) == meta.number_of_shards:
+                    istat = "yellow"
+                else:
+                    istat = "red"
+                entry = {
+                    "status": istat,
+                    "number_of_shards": meta.number_of_shards,
+                    "number_of_replicas": meta.number_of_replicas,
+                    "active_primary_shards": len(prim_active),
+                    "active_shards": len(active),
+                    "relocating_shards": 0,
+                    "initializing_shards": sum(
+                        1 for s in copies
+                        if s.state.value == "INITIALIZING"),
+                    "unassigned_shards": sum(
+                        1 for s in copies if not s.assigned)}
+                if level == "shards":
+                    shards = {}
+                    for s in copies:
+                        sh = shards.setdefault(str(s.shard), {
+                            "status": "green", "primary_active": False,
+                            "active_shards": 0, "relocating_shards": 0,
+                            "initializing_shards": 0,
+                            "unassigned_shards": 0})
+                        if s.primary and s.active:
+                            sh["primary_active"] = True
+                        if s.active:
+                            sh["active_shards"] += 1
+                        elif not s.assigned:
+                            sh["unassigned_shards"] += 1
+                            sh["status"] = "yellow"
+                        else:
+                            sh["initializing_shards"] += 1
+                            sh["status"] = "yellow"
+                    entry["shards"] = shards
+                indices[name] = entry
+            out["indices"] = indices
+        # unmet wait condition → 408 (RestClusterHealthAction renders the
+        # timed-out health body with REQUEST_TIMEOUT)
+        return (408 if out.get("timed_out") else 200), out
 
     def cluster_reroute(self, req: RestRequest):
         body = req.body or {}
-        out = self.node.cluster_reroute(
-            body.get("commands") or [],
-            dry_run=req.param_as_bool("dry_run"))
+        explain = req.param_as_bool("explain")
+        explanations = None
+        if explain:
+            # decisions evaluate against the state the commands APPLY to
+            # (RoutingExplanations are computed during execution, before
+            # publication)
+            pre_state = self.node.cluster_service.state()
+            explanations = []
+            for c in (body.get("commands") or []):
+                verb = next(iter(c))
+                params = dict(c[verb])
+                if verb in ("cancel", "allocate"):
+                    params.setdefault("allow_primary", False)
+                decision = {"decider": f"{verb}_allocation_command",
+                            "decision": "YES", "explanation": "ok"}
+                try:
+                    self.node.allocation.execute_commands(pre_state, [c])
+                except Exception as e:   # noqa: BLE001 — explain, don't fail
+                    decision = {"decider": f"{verb}_allocation_command",
+                                "decision": "NO", "explanation": str(e)}
+                explanations.append(
+                    {"command": verb, "parameters": params,
+                     "decisions": [decision]})
+        try:
+            out = dict(self.node.cluster_reroute(
+                body.get("commands") or [],
+                dry_run=req.param_as_bool("dry_run")))
+        except IllegalArgumentError:
+            if not explain:
+                raise
+            out = {"acknowledged": True, "state": {}}
+        if explanations is not None:
+            out["explanations"] = explanations
+        # response `state` renders per ?metric= (default: everything BUT
+        # metadata — RestClusterRerouteAction.DEFAULT_METRICS)
+        metric = req.param("metric", "_all_minus_metadata")
+        state = self.node.cluster_service.state()
+        st = out.setdefault("state", {})
+        chosen = metric.split(",") if metric != "_all_minus_metadata"             else ["blocks", "nodes", "routing_table", "master_node",
+                  "version"]
+        if "metadata" in chosen or metric == "_all":
+            st["metadata"] = {
+                "indices": {n: {**m.to_dict(), "state": m.state}
+                            for n, m in state.indices.items()},
+                "templates": state.templates}
+        if "nodes" in chosen or metric == "_all":
+            st["nodes"] = {nid: {"name": n.name}
+                           for nid, n in state.nodes.items()}
+        if "master_node" in chosen or metric == "_all":
+            st["master_node"] = state.master_node_id
+        if "version" in chosen or metric == "_all":
+            st["version"] = state.version
+        if ("blocks" in chosen or metric == "_all") and "blocks" not in st:
+            st["blocks"] = {}
+        if ("routing_table" in chosen or metric == "_all") and \
+                "routing_table" not in st:
+            st["routing_table"] = {"indices": {
+                n: {"shards": {str(sh.shard): [{
+                    "state": sh.state.value, "primary": sh.primary,
+                    "node": sh.node_id, "shard": sh.shard,
+                    "index": sh.index}]
+                    for sh in state.routing_table.index_shards(n)}}
+                for n in state.indices}}
         return 200, out
 
     def cache_clear(self, req: RestRequest):
@@ -2157,25 +2345,82 @@ class Handlers:
         return 200, {"indices": indices}
 
     def cluster_state(self, req: RestRequest):
+        """GET /_cluster/state[/{metric}[/{index}]]
+        (RestClusterStateAction): metric list filters the rendered
+        sections; the index filter narrows metadata/routing_table."""
         state = self.node.cluster_service.state()
-        return 200, {
-            "cluster_name": state.cluster_name,
-            "version": state.version,
-            "master_node": state.master_node_id,
-            "nodes": {nid: {"name": n.name,
-                            "transport_address": str(n.address),
-                            "attributes": dict(n.attributes)}
-                      for nid, n in state.nodes.items()},
-            "metadata": {"indices": {n: m.to_dict()
-                                     for n, m in state.indices.items()},
-                         "templates": state.templates},
-            "routing_table": {"indices": {
+        metric = req.path_params.get("metric")
+        wanted = None
+        if metric and metric not in ("_all",):
+            wanted = {m for m in metric.split(",") if m}
+            if "_all" in wanted:
+                wanted = None
+        index_expr = req.path_params.get("index")
+        names = self._resolve_expanded(req, index_expr) if index_expr             else sorted(state.indices)
+
+        def on(m):
+            return wanted is None or m in wanted
+        out: dict = {"cluster_name": state.cluster_name}
+        if on("version"):
+            out["version"] = state.version
+        if on("master_node"):
+            out["master_node"] = state.master_node_id
+        if on("nodes"):
+            out["nodes"] = {
+                nid: {"name": n.name,
+                      "transport_address": str(n.address),
+                      "attributes": dict(n.attributes)}
+                for nid, n in state.nodes.items()}
+        if on("blocks"):
+            blocks: dict = {}
+            for n in names:
+                meta = state.indices[n]
+                entry = {}
+                for key, bid, desc in (
+                        ("index.blocks.read_only", "5",
+                         "index read-only (api)"),
+                        ("index.blocks.read", "7", "index read (api)"),
+                        ("index.blocks.write", "8", "index write (api)"),
+                        ("index.blocks.metadata", "9",
+                         "index metadata (api)")):
+                    if str(meta.settings.get(key, "")).lower() == "true":
+                        entry[bid] = {"description": desc,
+                                      "retryable": False,
+                                      "levels": ["write",
+                                                 "metadata_write"]}
+                if entry:
+                    blocks.setdefault("indices", {})[n] = entry
+            out["blocks"] = blocks
+        if on("metadata"):
+            out["metadata"] = {
+                "cluster_uuid": "_na_",
+                "indices": {n: {**state.indices[n].to_dict(),
+                                "state": state.indices[n].state}
+                            for n in names},
+                "templates": state.templates}
+        if on("routing_table"):
+            out["routing_table"] = {"indices": {
                 n: {"shards": {str(s.shard): [{
                     "state": s.state.value, "primary": s.primary,
                     "node": s.node_id, "shard": s.shard, "index": s.index}]
                     for s in state.routing_table.index_shards(n)}}
-                for n in state.indices}},
-        }
+                for n in names}}
+        if on("routing_nodes"):
+            per_node: dict = {nid: [] for nid in state.nodes}
+            unassigned = []
+            for s in state.routing_table.shards:
+                if s.index not in names:
+                    continue
+                entry = {"state": s.state.value, "primary": s.primary,
+                         "node": s.node_id, "shard": s.shard,
+                         "index": s.index}
+                if s.assigned:
+                    per_node.setdefault(s.node_id, []).append(entry)
+                else:
+                    unassigned.append(entry)
+            out["routing_nodes"] = {"unassigned": unassigned,
+                                    "nodes": per_node}
+        return 200, out
 
     def cluster_stats(self, req: RestRequest):
         total_docs = sum(svc.num_docs()
@@ -2260,6 +2505,41 @@ class Handlers:
         level = req.param("level", "indices")
         fd_fields = req.param("fielddata_fields", req.param("fields"))
         cp_fields = req.param("completion_fields", req.param("fields"))
+        groups = req.param("groups")
+        types_param = req.param("types")
+
+        def trim_groups(sections: dict) -> dict:
+            """search.groups renders only when ?groups= asks (ES 2.x
+            RestIndicesStatsAction), filtered to the requested names."""
+            indexing = sections.get("indexing")
+            if indexing is not None and "types" in indexing:
+                if not types_param:
+                    indexing = {k: v for k, v in indexing.items()
+                                if k != "types"}
+                elif types_param not in ("_all", "*"):
+                    tp = types_param.split(",")
+                    indexing = {**indexing,
+                                "types": {t: v for t, v in
+                                          indexing["types"].items()
+                                          if any(fnmatch.fnmatch(t, p)
+                                                 for p in tp)}}
+                else:
+                    indexing = dict(indexing)
+                sections = {**sections, "indexing": indexing}
+            search = sections.get("search")
+            if search is None or "groups" not in search:
+                return sections
+            if not groups:
+                search = {k: v for k, v in search.items() if k != "groups"}
+            elif groups not in ("_all", "*"):
+                pats = groups.split(",")
+                search = {**search,
+                          "groups": {g: v
+                                     for g, v in search["groups"].items()
+                                     if any(fnmatch.fnmatch(g, p)
+                                            for p in pats)}}
+            return {**sections, "search": search}
+
         indices = {}
         all_sections: dict = {}
         shards = ok = 0
@@ -2268,24 +2548,33 @@ class Handlers:
             svc = self.node.indices_service.indices.get(n)
             if svc is None:
                 continue
-            sections = trim(svc.stats())
+            sections = trim_groups(trim(svc.stats()))
             # per-field breakdowns (?fielddata_fields= / completion_fields=
-            # / fields=) — sizes from the columnar field memory
-            for section, wanted, kinds in (
-                    ("fielddata", fd_fields, None),
-                    ("completion", cp_fields, "completion")):
+            # / fields=) — wildcard patterns expand over the mapped field
+            # names; sizes from the columnar field memory
+            all_fields = {
+                name: fm
+                for dm in svc.mapper_service.mappers.values()
+                for name, fm in dm.mappers.items()}
+            for section, wanted, completion_only in (
+                    ("fielddata", fd_fields, False),
+                    ("completion", cp_fields, True)):
                 if wanted and section in sections:
+                    pats = [w for w in wanted.split(",") if w]
                     fields = {}
-                    for f in wanted.split(","):
-                        fm = svc.mapper_service.field_mapper(f)
-                        if kinds == "completion" and (
-                                fm is None or fm.type != "completion"):
+                    for fname, fm in sorted(all_fields.items()):
+                        is_completion = getattr(fm, "type",
+                                                None) == "completion"
+                        if completion_only != is_completion:
                             continue
-                        size = self._field_memory(svc, f)
-                        if size or fm is not None:
-                            fields[f] = {"memory_size_in_bytes": size} \
-                                if section == "fielddata" \
-                                else {"size_in_bytes": size}
+                        if not any(fnmatch.fnmatch(fname, p)
+                                   for p in pats):
+                            continue
+                        size = self._field_memory(svc, fname)
+                        fields[fname] = \
+                            {"memory_size_in_bytes": size} \
+                            if section == "fielddata" \
+                            else {"size_in_bytes": size}
                     # `fields` is a BREAKDOWN; the section total stays
                     # index-wide (the reference never narrows it)
                     sections = {**sections,
@@ -2296,7 +2585,8 @@ class Handlers:
                 entry["shards"] = {
                     str(sid): [{"docs": {
                         "count": e.acquire_searcher().num_docs},
-                        "commit": {"generation": 1,
+                        "commit": {"id": e.engine_uuid[:22],
+                                   "generation": 1,
                                    "user_data": e.commit_user_data(),
                                    "num_docs":
                                        e.acquire_searcher().num_docs}}]
@@ -2305,14 +2595,17 @@ class Handlers:
             copies = list(state.routing_table.index_shards(n))
             shards += len(copies)       # every copy the index SHOULD have
             ok += sum(1 for s in copies if s.active)
-            for key, val in sections.items():
-                cur = all_sections.setdefault(key, {})
-                for stat, v in val.items():
+            def roll(dst: dict, src: dict) -> None:
+                for stat, v in src.items():
                     if isinstance(v, (int, float)) and \
                             not isinstance(v, bool):
-                        cur[stat] = cur.get(stat, 0) + v
+                        dst[stat] = dst.get(stat, 0) + v
+                    elif isinstance(v, dict):
+                        roll(dst.setdefault(stat, {}), v)
                     else:
-                        cur.setdefault(stat, v)
+                        dst.setdefault(stat, v)
+            for key, val in sections.items():
+                roll(all_sections.setdefault(key, {}), val)
         out = {"_shards": {"total": shards, "successful": ok, "failed": 0},
                "_all": {"primaries": all_sections, "total": all_sections}}
         if level != "cluster":       # level=cluster omits per-index stats

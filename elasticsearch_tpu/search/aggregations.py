@@ -42,6 +42,20 @@ _CALENDAR = {"year": "Y", "1y": "Y", "quarter": "Q", "1q": "Q",
              "month": "M", "1M": "M", "week": "W", "1w": "W"}
 
 
+def _java_decimal_format(value, pattern: str) -> str:
+    """Minimal Java DecimalFormat rendering for histogram `format`
+    (ref: ValueFormatter.Number.Pattern): literal prefix/suffix around a
+    #/0 digit pattern; the count of '0's after '.' fixes the decimals."""
+    import re as _re
+    m = _re.search(r"[#0][#0,]*(?:\.([0#]+))?", pattern)
+    if m is None:
+        return str(value)
+    decimals = len(m.group(1)) if m.group(1) else 0
+    num = f"{float(value):.{decimals}f}" if decimals else \
+        str(int(round(float(value))))
+    return pattern[:m.start()] + num + pattern[m.end():]
+
+
 @dataclass
 class AggNode:
     name: str
@@ -954,6 +968,10 @@ def _reduce_node(node: AggNode, parts: list[dict]) -> dict:
         keys = sorted(merged)
         buckets = [{"key": k, **_final_bucket(merged[k])} for k in keys
                    if merged[k]["doc_count"] >= max(min_dc, 1) or min_dc == 0]
+        fmt = node.params.get("format")
+        if fmt and t == "histogram":
+            for b in buckets:
+                b["key_as_string"] = _java_decimal_format(b["key"], fmt)
         _render_pipeline(node, buckets)
         return {"buckets": buckets}
     if t in ("range", "date_range"):

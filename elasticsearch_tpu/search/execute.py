@@ -912,7 +912,8 @@ class SegmentResolver:
 
     def _res_MoreLikeThisQuery(self, query: q.MoreLikeThisQuery) -> Emit:
         fields = query.fields or sorted(self.seg.text)
-        self.sig("mlt", tuple(fields), query.include)
+        self.sig("mlt", tuple(fields), query.include,
+                 tuple(query.unlike_texts), len(query.unlike_docs))
         # gather like text per field: raw texts apply to every field;
         # liked docs contribute their own field values
         texts_by_field: dict[str, list[str]] = {f: list(query.like_texts)
@@ -931,16 +932,36 @@ class SegmentResolver:
                         v = src.get(f)
                         if isinstance(v, str):
                             texts_by_field[f].append(v)
+        # `unlike` terms are struck from the candidate set
+        # (MoreLikeThisQuery setUnlikeText)
+        unlike_terms: dict[str, set] = {}
+        unlike_texts = list(query.unlike_texts)
+        for spec in query.unlike_docs:
+            did = str(spec.get("_id", ""))
+            for seg in self.ctx.reader.segments:
+                host = seg.seg
+                for local, hid in enumerate(host.ids[:host.num_docs]):
+                    if hid == did:
+                        src = host.sources[local]
+                        unlike_texts.extend(
+                            v for v in src.values()
+                            if isinstance(v, str))
         # significant-term selection: tf in the like text ≥ min_term_freq,
         # df ≥ min_doc_freq, ranked by idf (MoreLikeThis.createQueue)
         candidates: list[tuple[float, str, str, float]] = []
         for f in fields:
             analyzer = self._analyzer_for(f, None)
+            if unlike_texts and f not in unlike_terms:
+                unlike_terms[f] = {
+                    tok.term for text in unlike_texts
+                    for tok in analyzer.analyze(text)}
             tf: dict[str, int] = {}
             for text in texts_by_field[f]:
                 for tok in analyzer.analyze(text):
                     tf[tok.term] = tf.get(tok.term, 0) + 1
             for term, n in tf.items():
+                if term in unlike_terms.get(f, ()):
+                    continue
                 if n < query.min_term_freq:
                     continue
                 df, doc_count = self._term_stats(f, term)

@@ -64,6 +64,7 @@ class ParsedSearchRequest:
     script_fields: dict = field(default_factory=dict)
     suggest: list = field(default_factory=list)    # [SuggestSpec]
     stored_fields: list = field(default_factory=list)
+    docvalue_fields: list = field(default_factory=list)
     terminate_after: int | None = None             # per-shard collected cap
     timeout_ms: float | None = None                # per-shard time budget
     rescore: list[RescoreSpec] = field(default_factory=list)
@@ -93,7 +94,20 @@ def parse_search_request(body: dict | None) -> ParsedSearchRequest:
     req.search_after = body.get("search_after")
     req.explain = bool(body.get("explain", False))
     req.script_fields = body.get("script_fields", {})
+    raw_dvf = body.get("fielddata_fields", body.get("docvalue_fields", []))
+    req.docvalue_fields = [raw_dvf] if isinstance(raw_dvf, str) \
+        else list(raw_dvf)
     req.stored_fields = body.get("stored_fields", body.get("fields", []))
+    if isinstance(req.stored_fields, str):
+        req.stored_fields = [req.stored_fields]
+    if req.stored_fields and "_source" not in body:
+        # `fields` without an explicit _source suppresses the source
+        # (FetchSourceContext.DO_NOT_FETCH_SOURCE unless "_source" listed)
+        if "_source" in req.stored_fields:
+            req.stored_fields = [f for f in req.stored_fields
+                                 if f != "_source"]
+        else:
+            req.source_filter = False
     if body.get("terminate_after"):
         req.terminate_after = int(body["terminate_after"])
     if body.get("timeout") is not None:
@@ -787,9 +801,10 @@ class ShardSearcher:
                     hit["highlight"] = hl
             if req.script_fields:
                 hit["fields"] = self._script_fields(req.script_fields, seg, local)
-            elif req.stored_fields:
+            elif req.stored_fields or req.docvalue_fields:
                 fields = {}
-                for f in req.stored_fields:
+                for f in list(req.stored_fields) + list(
+                        req.docvalue_fields):
                     v = src.get(f)
                     if v is None and "." in f:   # dotted path into objects
                         node = src

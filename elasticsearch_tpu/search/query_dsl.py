@@ -231,6 +231,9 @@ class MoreLikeThisQuery(Query):
     fields: list[str] = dc_field(default_factory=list)
     like_texts: list[str] = dc_field(default_factory=list)
     like_docs: list[dict] = dc_field(default_factory=list)  # {"_id": ...}
+    # `unlike` inputs: their terms are REMOVED from the selected set
+    unlike_texts: list[str] = dc_field(default_factory=list)
+    unlike_docs: list[dict] = dc_field(default_factory=list)
     max_query_terms: int = 25
     min_term_freq: int = 2
     min_doc_freq: int = 5
@@ -585,8 +588,28 @@ def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query
                 like_docs.append(item)
             else:
                 like_texts.append(str(item))
-        for did in qbody.get("ids", qbody.get("docs", [])) or []:
+        for did in qbody.get("ids", []) or []:
             like_docs.append(did if isinstance(did, dict) else {"_id": did})
+        for item in qbody.get("docs", []) or []:
+            if isinstance(item, dict) and "doc" in item:
+                # artificial document: its string values are like-texts
+                like_texts.extend(str(v) for v in item["doc"].values()
+                                  if isinstance(v, str))
+            else:
+                like_docs.append(item if isinstance(item, dict)
+                                 else {"_id": item})
+        unlike_texts: list[str] = []
+        unlike_docs: list[dict] = []
+        raw_unlike = qbody.get("unlike")
+        for item in (raw_unlike if isinstance(raw_unlike, list)
+                     else [raw_unlike] if raw_unlike is not None else []):
+            if isinstance(item, dict) and "doc" in item:
+                unlike_texts.extend(str(v) for v in item["doc"].values()
+                                    if isinstance(v, str))
+            elif isinstance(item, dict):
+                unlike_docs.append(item)
+            else:
+                unlike_texts.append(str(item))
         if not like_texts and not like_docs:
             raise QueryParsingError(
                 "[more_like_this] requires 'like' text or docs")
@@ -594,6 +617,7 @@ def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query
         return MoreLikeThisQuery(
             fields=list(fields),
             like_texts=like_texts, like_docs=like_docs,
+            unlike_texts=unlike_texts, unlike_docs=unlike_docs,
             exclude_ids=[str(x) for x in qbody.get("_exclude_ids", [])],
             max_query_terms=int(qbody.get("max_query_terms", 25)),
             min_term_freq=int(qbody.get("min_term_freq", 2)),

@@ -237,31 +237,52 @@ class ShardSuggester:
     # ---- completion ---------------------------------------------------------
 
     def _collect_completion(self, spec: SuggestSpec) -> dict:
-        prefix = spec.text
+        base = spec.text.lower()
+        fm = self.mapper_service.field_mapper(spec.field)
+        cfg = getattr(fm, "context_config", None)
+        prefixes: list[tuple[str, int]] = [(base, 0)]
+        if cfg:
+            # context-filtered completion: the index keys are
+            # "{ctx}\x1f{input}" (ContextMappings) — every requested
+            # context value scans its own key range, options strip the key
+            from elasticsearch_tpu.mapping.mapper import (
+                completion_context_keys)
+            keys = completion_context_keys(cfg,
+                                           spec.params.get("context") or {})
+            prefixes = [(f"{k}\x1f{base}", len(k) + 1) for k in keys] \
+                or prefixes
         counts: dict[str, int] = {}
+        strip_of: dict[str, int] = {}
         for s in self.reader.segments:
             col = s.seg.keyword_fields.get(spec.field)
             if col is None:
                 continue
             vocab = col.vocab                    # sorted → prefix range scan
             import bisect
-            lo = bisect.bisect_left(vocab, prefix)
-            hi = bisect.bisect_left(vocab, prefix + "￿")
-            if hi <= lo:
-                continue
-            ords = np.asarray(col.ords)
-            live = np.asarray(s.live)[:ords.shape[0]]
-            for o in range(lo, hi):
-                n = int((((ords == o).any(axis=1)) & live).sum())
-                if n:
-                    counts[vocab[o]] = counts.get(vocab[o], 0) + n
-        options = [{"text": t, "score": float(n)}
+            ords = live = None
+            for prefix, strip in prefixes:
+                lo = bisect.bisect_left(vocab, prefix)
+                hi = bisect.bisect_left(vocab, prefix + "￿")
+                if hi <= lo:
+                    continue
+                if ords is None:
+                    ords = np.asarray(col.ords)
+                    live = np.asarray(s.live)[:ords.shape[0]]
+                for o in range(lo, hi):
+                    n = int((((ords == o).any(axis=1)) & live).sum())
+                    if n:
+                        counts[vocab[o]] = counts.get(vocab[o], 0) + n
+                        strip_of[vocab[o]] = strip
+        def display(t: str) -> str:
+            t = t[strip_of.get(t, 0):]
+            return t.split("\x1e", 1)[1] if "\x1e" in t else t
+        options = [{"text": display(t), "score": float(n)}
                    for t, n in sorted(counts.items(),
                                       key=lambda kv: (-kv[1], kv[0]))]
         size = int(spec.params.get("size", 5))
         return {"kind": "completion",
-                "entries": [{"text": prefix, "offset": 0,
-                             "length": len(prefix),
+                "entries": [{"text": spec.text, "offset": 0,
+                             "length": len(spec.text),
                              "options": options[:size]}]}
 
 

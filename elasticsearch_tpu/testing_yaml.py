@@ -180,7 +180,8 @@ class YamlRestRunner:
         if spec is None:
             raise StepFailure("do", f"unknown api [{api}]")
         body = args.pop("body", None)
-        parts = {k: v for k, v in args.items() if k in spec.parts}
+        parts = {k: v for k, v in args.items()
+                 if k in spec.parts and v not in ("", [], None)}
         query = {k: v for k, v in args.items() if k not in spec.parts}
         # choose the most specific path whose parts are all provided
         best = None
@@ -251,7 +252,8 @@ class _Skipped(Exception):
 
 _CATCH_STATUS = {"missing": (404,), "conflict": (409,),
                  "bad_request": (400,), "param": (400,),
-                 "forbidden": (403,), "unavailable": (503,)}
+                 "forbidden": (403,), "unavailable": (503,),
+                 "request_timeout": (408,)}
 
 
 @dataclass

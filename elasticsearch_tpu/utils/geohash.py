@@ -1,0 +1,67 @@
+"""Geohash encoding (ref: core/common/geo/GeoHashUtils.java — base-32
+interleaved lat/lon bits; the context suggester's geo contexts and the
+geohash_grid aggregation key on these)."""
+
+from __future__ import annotations
+
+_BASE32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+
+#: geohash length → approximate cell size in meters (ES's
+#: GeoUtils.geoHashLevelsForPrecision table, coarsest edge)
+_CELL_METERS = [None, 5_009_400, 1_252_300, 156_500, 39_100, 4_900,
+                1_200, 152.9, 38.2, 4.78, 1.19, 0.149, 0.037]
+
+
+def geohash_encode(lat: float, lon: float, length: int = 12) -> str:
+    lat_lo, lat_hi = -90.0, 90.0
+    lon_lo, lon_hi = -180.0, 180.0
+    out = []
+    bit = 0
+    ch = 0
+    even = True
+    while len(out) < length:
+        if even:
+            mid = (lon_lo + lon_hi) / 2
+            if lon >= mid:
+                ch = (ch << 1) | 1
+                lon_lo = mid
+            else:
+                ch <<= 1
+                lon_hi = mid
+        else:
+            mid = (lat_lo + lat_hi) / 2
+            if lat >= mid:
+                ch = (ch << 1) | 1
+                lat_lo = mid
+            else:
+                ch <<= 1
+                lat_hi = mid
+        even = not even
+        bit += 1
+        if bit == 5:
+            out.append(_BASE32[ch])
+            bit = 0
+            ch = 0
+    return "".join(out)
+
+
+def precision_to_length(precision) -> int:
+    """'5km' / '100m' / meters → the geohash length whose cells are at
+    least that fine (GeoUtils.geoHashLevelsForPrecision)."""
+    meters = None
+    if isinstance(precision, (int, float)):
+        if precision <= 12:              # bare number = geohash length
+            return max(1, int(precision))
+        meters = float(precision)
+    else:
+        s = str(precision).strip().lower()
+        for suffix, mult in (("km", 1000.0), ("m", 1.0)):
+            if s.endswith(suffix):
+                meters = float(s[: -len(suffix)]) * mult
+                break
+        if meters is None:
+            return max(1, min(int(float(s)), 12))  # bare geohash length
+    for length in range(1, 13):
+        if _CELL_METERS[length] <= meters:
+            return length
+    return 12

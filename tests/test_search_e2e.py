@@ -293,3 +293,33 @@ class TestMultiIndex:
         indices = {h["_index"] for h in r["hits"]["hits"]}
         assert indices == {"articles", "articles2"}
         node.indices_service.delete_index("articles2")
+
+
+class TestScrollPointInTime:
+    """Scroll pages read a pinned point-in-time view (ScrollContext,
+    SearchService.java:533-558): writes landing mid-scroll stay invisible."""
+
+    def test_scroll_ignores_later_writes(self, node):
+        node.indices_service.create_index(
+            "pit", {"settings": {"number_of_shards": 1}})
+        node.index_doc("pit", "1", {"n": 1})
+        node.index_doc("pit", "2", {"n": 2})
+        node.indices_service.index("pit").refresh()
+        page = node.search_actions.search("pit",
+                                          {"query": {"match_all": {}},
+                                           "size": 1}, scroll="1m")
+        sid = page["_scroll_id"]
+        assert page["hits"]["total"]["value"] == 2
+        node.index_doc("pit", "3", {"n": 3})
+        node.indices_service.index("pit").refresh()
+        page2 = node.search_actions.scroll(sid, "1m")
+        # the new doc must NOT appear in the pinned view
+        assert page2["hits"]["total"]["value"] == 2
+        seen = {h["_id"] for h in page["hits"]["hits"]} | \
+            {h["_id"] for h in page2["hits"]["hits"]}
+        assert seen == {"1", "2"}
+        # a FRESH search sees all three
+        fresh = node.search_actions.search(
+            "pit", {"query": {"match_all": {}}})
+        assert fresh["hits"]["total"]["value"] == 3
+        node.search_actions.clear_scroll(sid)

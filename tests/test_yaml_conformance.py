@@ -19,7 +19,7 @@ CORE_DIRS = ["search", "index", "get", "create", "delete", "exists",
              "count", "bulk", "mget", "indices.exists_type",
              "indices.put_mapping", "info", "ping"]
 
-FLOOR = 0.55
+FLOOR = 0.95
 
 
 @pytest.mark.skipif(not SPEC.exists(), reason="reference spec not present")
