@@ -623,8 +623,12 @@ class DocumentActions:
             # re-analyzing the stored _source with the field's analyzer —
             # the reference does the same when term vectors aren't stored
             # (TermVectorsService.generateTermVectors)
+            want_positions = body.get("positions", True) \
+                not in (False, "false")
+            want_offsets = body.get("offsets", True) \
+                not in (False, "false")
             raw = src.get(fname) if isinstance(src, dict) else None
-            if raw is not None:
+            if raw is not None and (want_positions or want_offsets):
                 svc2 = self.node.indices_service.indices.get(name)
                 fm = svc2.mapper_service.field_mapper(fname) \
                     if svc2 else None
@@ -634,11 +638,15 @@ class DocumentActions:
                     for v in values:
                         for tok in analyzer.analyze(str(v)):
                             t = terms.get(tok.term)
-                            if t is not None:
-                                t.setdefault("tokens", []).append(
-                                    {"position": tok.position,
-                                     "start_offset": tok.start_offset,
-                                     "end_offset": tok.end_offset})
+                            if t is None:
+                                continue
+                            entry = {}
+                            if want_positions:
+                                entry["position"] = tok.position
+                            if want_offsets:
+                                entry["start_offset"] = tok.start_offset
+                                entry["end_offset"] = tok.end_offset
+                            t.setdefault("tokens", []).append(entry)
             sum_df = doc_count = sum_ttf = 0
             for s2 in reader.segments:
                 c2 = s2.seg.text_fields.get(fname)

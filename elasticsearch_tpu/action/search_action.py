@@ -444,6 +444,10 @@ class SearchActions:
                                                reader.generation, body, dfs)
             cached = self.request_cache.get(cache_key)
             if cached is not None:
+                # a cache hit is still a served query (ShardSearchStats
+                # increments outside the request cache)
+                svc.note_search(body.get("stats"),
+                                (time.perf_counter() - t0) * 1000.0)
                 return cached
         # per-request scratch accounting (request breaker): score + mask
         # arrays over every doc of the shard
@@ -1089,6 +1093,7 @@ class SearchActions:
             if scroll_id is None:
                 n = len(self._contexts)
                 self._contexts.clear()
+                self._pinned.clear()     # free pinned readers with them
                 return n
             try:
                 cid = json.loads(base64.b64decode(scroll_id))["id"]

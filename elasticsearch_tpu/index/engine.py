@@ -252,7 +252,8 @@ class Engine:
                     raise VersionConflictError("", doc_id, current, version)
                 new_version = 1 if current == NOT_FOUND else current + 1
 
-            parsed = self.mapper_service.document_mapper().parse(
+            parsed = self.mapper_service.document_mapper(
+                (meta or {}).get("_type")).parse(
                 doc_id, source, routing=routing, meta=meta)
             # supersede any buffered copy of the same doc
             old_buf = self._buffer_docs.get(doc_id)
@@ -288,7 +289,8 @@ class Engine:
             entry = self._versions.get(doc_id)
             if entry is not None and entry.version >= version:
                 return entry.version
-            parsed = self.mapper_service.document_mapper().parse(
+            parsed = self.mapper_service.document_mapper(
+                (meta or {}).get("_type")).parse(
                 doc_id, source, routing=routing, meta=meta)
             old_buf = self._buffer_docs.get(doc_id)
             if old_buf is not None:
@@ -819,7 +821,8 @@ class Engine:
                 self._versions[op.doc_id] = VersionEntry(op.version, True, -2, -1)
 
     def _apply_replayed_index(self, op: TranslogOp) -> None:
-        parsed = self.mapper_service.document_mapper().parse(
+        parsed = self.mapper_service.document_mapper(
+            (op.meta or {}).get("_type")).parse(
             op.doc_id, op.source, routing=op.routing, meta=op.meta)
         old_buf = self._buffer_docs.get(op.doc_id)
         if old_buf is not None:

@@ -960,7 +960,9 @@ class Handlers:
         meta: dict = {}
         if tname and not str(tname).startswith("_"):
             meta["_type"] = str(tname)
-        dm = self._type_mapper(index, tname)
+        # a bulk item may omit _index (invalid — replication reports it
+        # as a per-item error); mapper-driven rules need a real index
+        dm = self._type_mapper(index, tname) if index else None
         if dm is not None and dm.parent_type and parent is None and \
                 routing is None:
             # resolved routing (explicit or parent-derived) must exist
@@ -1971,7 +1973,7 @@ class Handlers:
             # decisions evaluate against the state the commands APPLY to
             # (RoutingExplanations are computed during execution, before
             # publication)
-            pre_state = self.node.cluster_service.state()
+            sim_state = self.node.cluster_service.state()
             explanations = []
             for c in (body.get("commands") or []):
                 verb = next(iter(c))
@@ -1981,7 +1983,11 @@ class Handlers:
                 decision = {"decider": f"{verb}_allocation_command",
                             "decision": "YES", "explanation": "ok"}
                 try:
-                    self.node.allocation.execute_commands(pre_state, [c])
+                    # sequential simulation: each command sees the effect
+                    # of the previous ones (the real execution is one
+                    # ordered batch)
+                    sim_state = self.node.allocation.execute_commands(
+                        sim_state, [c])
                 except Exception as e:   # noqa: BLE001 — explain, don't fail
                     decision = {"decider": f"{verb}_allocation_command",
                                 "decision": "NO", "explanation": str(e)}

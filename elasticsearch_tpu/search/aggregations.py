@@ -11,11 +11,14 @@ tree the coordinator applies across shards (segment→shard→global, SURVEY.md
 §2.10 "aggregation tree reduce"). The dense-kernel equivalents live in
 ops/aggs_ops.py and take over on-device for the hot aggs as a perf pass.
 
-Supported: terms, histogram, date_histogram (fixed + calendar intervals),
-range, date_range, filter, filters, global, missing (bucket);
-min/max/sum/avg/stats/extended_stats/value_count/cardinality/percentiles/
-top_hits (metrics); avg_bucket/max_bucket/min_bucket/sum_bucket/
-cumulative_sum/derivative (pipeline).
+Supported: terms, significant_terms, histogram, date_histogram (fixed +
+calendar intervals), range, date_range, filter, filters, global, missing,
+sampler, nested, reverse_nested, children, geohash_grid, geo_distance
+(bucket); min/max/sum/avg/stats/extended_stats/value_count/cardinality/
+percentiles/percentile_ranks/top_hits/geo_bounds/geo_centroid/
+scripted_metric (metrics); avg_bucket/max_bucket/min_bucket/sum_bucket/
+cumulative_sum/derivative/moving_avg/serial_diff/bucket_script/
+bucket_selector (pipeline).
 """
 
 from __future__ import annotations
@@ -32,11 +35,15 @@ from elasticsearch_tpu.mapping.mapper import parse_date
 
 BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "date_range",
                "filter", "filters", "global", "missing",
-               "significant_terms"}
+               "significant_terms", "sampler", "nested", "reverse_nested",
+               "children", "geohash_grid", "geo_distance"}
 METRIC_AGGS = {"min", "max", "sum", "avg", "stats", "extended_stats",
-               "value_count", "cardinality", "percentiles", "top_hits"}
+               "value_count", "cardinality", "percentiles",
+               "percentile_ranks", "top_hits", "geo_bounds",
+               "geo_centroid", "scripted_metric"}
 PIPELINE_AGGS = {"avg_bucket", "max_bucket", "min_bucket", "sum_bucket",
-                 "cumulative_sum", "derivative"}
+                 "cumulative_sum", "derivative", "moving_avg",
+                 "serial_diff", "bucket_script", "bucket_selector"}
 
 _CALENDAR = {"year": "Y", "1y": "Y", "quarter": "Q", "1q": "Q",
              "month": "M", "1M": "M", "week": "W", "1w": "W"}
@@ -442,6 +449,22 @@ class ShardAggContext:
                  for c in cols])
         return self._union_ords([None] * len(segs))
 
+    def geo_values(self, fname: str):
+        """→ (lat f64, lon f64, exists) concatenated over segments."""
+        lats, lons, exists = [], [], []
+        for s in self.reader.segments:
+            col = s.seg.geo_fields.get(fname)
+            if col is None:
+                lats.append(np.zeros(s.padded_docs))
+                lons.append(np.zeros(s.padded_docs))
+                exists.append(np.zeros(s.padded_docs, bool))
+            else:
+                lats.append(np.asarray(col.lat, np.float64))
+                lons.append(np.asarray(col.lon, np.float64))
+                exists.append(np.asarray(col.exists, bool))
+        return (np.concatenate(lats), np.concatenate(lons),
+                np.concatenate(exists))
+
     def _union_ords(self, per_seg):
         """[(vocab, ords[Np,K]) | None per segment] → shard-union view."""
         union: dict[str, int] = {}
@@ -752,9 +775,299 @@ def _c_significant_terms(node, mask, ctx):
             "bg_total": int(live.sum())}
 
 
+def _c_sampler(node, mask, ctx):
+    """sampler (ref: bucket/sampler/SamplerAggregator): restrict sub-aggs
+    to the shard's top `shard_size` docs by query score."""
+    shard_size = int(node.params.get("shard_size", 100))
+    bmask = mask
+    if ctx.scores is not None and mask.sum() > shard_size:
+        scores = np.where(mask, np.asarray(ctx.scores), -np.inf)
+        top = np.argpartition(-scores, shard_size)[:shard_size]
+        bmask = np.zeros_like(mask)
+        bmask[top] = True
+        bmask &= mask
+    out = {"doc_count": int(bmask.sum())}
+    if node.subs:
+        out["subs"] = _collect_subs(node, bmask, ctx)
+    return out
+
+
+class _NestedCtx(ShardAggContext):
+    """Child-row view for nested aggs: segments are the nested blocks'
+    child DeviceSegments; `parent_ctx`/`parent_of` link back for
+    reverse_nested."""
+
+    def __init__(self, parent_ctx: ShardAggContext, path: str):
+        self.parent_ctx = parent_ctx
+        self.path = path
+        self.mapper_service = parent_ctx.mapper_service
+        self.execute_filter = parent_ctx.execute_filter
+        self.scores = None
+        import types
+        segs = []
+        self.parent_of: list[np.ndarray] = []
+        self.parent_base: list[int] = []
+        base = 0
+        for s in parent_ctx.reader.segments:
+            blk = s.nested.get(path)
+            if blk is not None:
+                segs.append(blk.child)
+                self.parent_of.append(np.asarray(blk.parent))
+            else:
+                segs.append(None)
+                self.parent_of.append(np.zeros(0, np.int64))
+            self.parent_base.append(base)
+            base += s.padded_docs
+        self.reader = types.SimpleNamespace(
+            segments=[x for x in segs if x is not None])
+        self._all_segs = segs
+
+    def child_mask(self, parent_mask: np.ndarray) -> np.ndarray:
+        """Parent-space mask → concatenated child-row mask."""
+        outs = []
+        for seg, parents, base in zip(self._all_segs, self.parent_of,
+                                      self.parent_base):
+            if seg is None:
+                continue
+            valid = parents >= 0
+            m = np.zeros(seg.padded_docs, bool)
+            live = np.asarray(seg.live)
+            idx = np.nonzero(valid)[0]
+            m[idx] = parent_mask[base + parents[idx]]
+            outs.append(m & live[:len(m)])
+        return np.concatenate(outs) if outs else np.zeros(0, bool)
+
+    def parent_mask(self, child_mask: np.ndarray) -> np.ndarray:
+        """Child-row mask → parent-space mask (reverse_nested)."""
+        total = sum(s.padded_docs
+                    for s in self.parent_ctx.reader.segments)
+        out = np.zeros(total, bool)
+        off = 0
+        for seg, parents, base in zip(self._all_segs, self.parent_of,
+                                      self.parent_base):
+            if seg is None:
+                continue
+            n = seg.padded_docs
+            cm = child_mask[off:off + n]
+            idx = np.nonzero(cm & (parents[:n] >= 0))[0]                 if len(parents) >= n else np.nonzero(cm)[0][:0]
+            out[base + parents[idx]] = True
+            off += n
+        return out
+
+
+def _c_nested(node, mask, ctx):
+    """nested agg (ref: bucket/nested/NestedAggregator): sub-aggs run over
+    the path's CHILD rows of the matching parents."""
+    path = node.params.get("path")
+    nctx = _NestedCtx(ctx, path)
+    cmask = nctx.child_mask(mask)
+    out = {"doc_count": int(cmask.sum())}
+    if node.subs:
+        out["subs"] = {}
+        for sub in node.subs:
+            if sub.type == "reverse_nested":
+                pmask = nctx.parent_mask(cmask)
+                r = {"doc_count": int((pmask & mask).sum())}
+                if sub.subs:
+                    r["subs"] = _collect_subs(sub, pmask & mask, ctx)
+                out["subs"][sub.name] = r
+            else:
+                out["subs"][sub.name] = collect(sub, cmask, nctx)
+    return out
+
+
+def _c_reverse_nested(node, mask, ctx):
+    # only meaningful under a nested agg (handled in _c_nested); standalone
+    # it is the identity bucket
+    out = {"doc_count": int(mask.sum())}
+    if node.subs:
+        out["subs"] = _collect_subs(node, mask, ctx)
+    return out
+
+
+def _c_children(node, mask, ctx):
+    """children agg (ref: bucket/children/ParentToChildrenAggregator):
+    bucket = docs of child `type` whose _parent is a doc in the current
+    bucket (parent/child colocate per shard, so the join is local)."""
+    child_type = node.params.get("type")
+    # matching parents' _ids per segment
+    parent_ids: set[str] = set()
+    off = 0
+    for s in ctx.reader.segments:
+        n = s.padded_docs
+        seg_mask = mask[off:off + n]
+        for local in np.nonzero(seg_mask[:s.seg.num_docs])[0]:
+            parent_ids.add(s.seg.ids[int(local)])
+        off += n
+    # child mask: _type == child_type and _parent ∈ parent_ids
+    outs = []
+    for s in ctx.reader.segments:
+        m = np.zeros(s.padded_docs, bool)
+        tcol = s.seg.keyword_fields.get("_type")
+        pcol = s.seg.keyword_fields.get("_parent")
+        if tcol is not None and pcol is not None and parent_ids:
+            t_ok = np.zeros(s.padded_docs, bool)
+            if child_type in tcol.index:
+                tid = tcol.index[child_type]
+                t_ok[:tcol.ords.shape[0]] = (tcol.ords == tid).any(axis=1)
+            p_ok = np.zeros(s.padded_docs, bool)
+            wanted = np.array([v in parent_ids for v in pcol.vocab], bool)
+            first = np.asarray(pcol.ords[:, 0])
+            ok = first >= 0
+            p_ok[:len(first)] = ok & wanted[np.maximum(first, 0)]
+            m = t_ok & p_ok & np.asarray(s.live)[:s.padded_docs]
+        outs.append(m)
+    cmask = np.concatenate(outs) if outs else np.zeros(0, bool)
+    out = {"doc_count": int(cmask.sum())}
+    if node.subs:
+        out["subs"] = _collect_subs(node, cmask, ctx)
+    return out
+
+
+def _c_geohash_grid(node, mask, ctx):
+    from elasticsearch_tpu.utils.geohash import (
+        geohash_encode, precision_to_length)
+    fname = node.params.get("field")
+    length = precision_to_length(node.params.get("precision", 5))
+    lat, lon, exists = ctx.geo_values(fname)
+    m = mask & exists
+    buckets: dict = {}
+    for i in np.nonzero(m)[0]:
+        key = geohash_encode(float(lat[i]), float(lon[i]), length)
+        b = buckets.setdefault(key, {"doc_count": 0, "_rows": []})
+        b["doc_count"] += 1
+        b["_rows"].append(int(i))
+    out_buckets = {}
+    for key, b in buckets.items():
+        entry = {"doc_count": b["doc_count"]}
+        if node.subs:
+            bmask = np.zeros_like(mask)
+            bmask[b["_rows"]] = True
+            entry["subs"] = _collect_subs(node, bmask, ctx)
+        out_buckets[key] = entry
+    return {"buckets": _as_pairs(out_buckets)}
+
+
+def _haversine_km(lat1, lon1, lat2, lon2):
+    r = 6371.0087714
+    p1, p2 = np.radians(lat1), np.radians(lat2)
+    dphi = np.radians(lat2 - lat1)
+    dl = np.radians(lon2 - lon1)
+    a = np.sin(dphi / 2) ** 2 + np.cos(p1) * np.cos(p2) * np.sin(dl / 2) ** 2
+    return 2 * r * np.arcsin(np.sqrt(a))
+
+
+def _c_geo_distance(node, mask, ctx):
+    """geo_distance ranges from an origin (ref: bucket/range/geodistance/)."""
+    fname = node.params.get("field")
+    origin = node.params.get("origin")
+    if isinstance(origin, str):
+        olat, olon = (float(x) for x in origin.split(","))
+    elif isinstance(origin, (list, tuple)):
+        olon, olat = float(origin[0]), float(origin[1])
+    else:
+        olat, olon = float(origin["lat"]), float(origin["lon"])
+    unit = str(node.params.get("unit", "m"))
+    per_km = {"m": 1000.0, "km": 1.0, "mi": 0.621371, "yd": 1093.61}.get(
+        unit, 1000.0)
+    lat, lon, exists = ctx.geo_values(fname)
+    dist = _haversine_km(olat, olon, lat, lon) * per_km
+    m = mask & exists
+    buckets = {}
+    order = []
+    for r in node.params.get("ranges", []):
+        frm = float(r["from"]) if r.get("from") is not None else -np.inf
+        to = float(r["to"]) if r.get("to") is not None else np.inf
+        key = r.get("key") or (
+            f"{'*' if frm == -np.inf else r.get('from')}-"
+            f"{'*' if to == np.inf else r.get('to')}")
+        bmask = m & (dist >= frm) & (dist < to)
+        b = {"doc_count": int(bmask.sum()),
+             "from": None if frm == -np.inf else frm,
+             "to": None if to == np.inf else to}
+        if node.subs:
+            b["subs"] = _collect_subs(node, bmask, ctx)
+        buckets[key] = b
+        order.append(key)
+    return {"buckets": _as_pairs(buckets), "keyed_order": order}
+
+
+def _c_geo_bounds(node, mask, ctx):
+    lat, lon, exists = ctx.geo_values(node.params.get("field"))
+    m = mask & exists
+    if not m.any():
+        return {"count": 0}
+    return {"count": int(m.sum()),
+            "top": float(lat[m].max()), "bottom": float(lat[m].min()),
+            "left": float(lon[m].min()), "right": float(lon[m].max())}
+
+
+def _c_geo_centroid(node, mask, ctx):
+    lat, lon, exists = ctx.geo_values(node.params.get("field"))
+    m = mask & exists
+    n = int(m.sum())
+    if not n:
+        return {"count": 0, "lat_sum": 0.0, "lon_sum": 0.0}
+    return {"count": n, "lat_sum": float(lat[m].sum()),
+            "lon_sum": float(lon[m].sum())}
+
+
+def _c_percentile_ranks(node, mask, ctx):
+    vals, exists = _field_numeric(node, ctx)
+    m = mask & exists
+    return {"values": vals[m].tolist(),
+            "wanted": [float(v) for v in node.params.get("values", [])]}
+
+
+def _c_scripted_metric(node, mask, ctx):
+    """scripted_metric (ref: metrics/scripted/): the map script runs as a
+    sandboxed EXPRESSION over each doc's fields (our lang-expression
+    analog; no Groovy); the shard partial is the list of map values, and
+    combine/reduce scripts see them as `_values`."""
+    from elasticsearch_tpu.search.scripts import (
+        ScriptContext, compile_script)
+    map_src = node.params.get("map_script")
+    if map_src is None:
+        raise QueryParsingError(
+            "[scripted_metric] requires a map_script")
+    script = compile_script(str(map_src))
+    values = []
+    off = 0
+    for s in ctx.reader.segments:
+        n = s.padded_docs
+        seg_mask = mask[off:off + n]
+        rows = np.nonzero(seg_mask[:s.seg.num_docs])[0]
+        if len(rows):
+            def get_numeric(field, _s=s):
+                col = _s.seg.numeric_fields.get(field)
+                if col is None:
+                    z = np.zeros(_s.padded_docs)
+                    return z, np.zeros(_s.padded_docs, bool)
+                return (np.asarray(col.values, np.float64),
+                        np.asarray(col.exists, bool))
+            sctx = ScriptContext(
+                get_numeric_column=get_numeric,
+                get_vector_column=lambda f: (None, None),
+                scores=np.zeros(n, np.float32),
+                params=node.params.get("params", {}))
+            arr = np.asarray(script.evaluate(sctx))
+            if arr.ndim == 0:
+                values.extend([float(arr)] * len(rows))
+            else:
+                values.extend(float(arr[int(r)]) for r in rows)
+        off += n
+    return {"values": values}
+
+
 _COLLECTORS = {
     "min": _c_metric, "max": _c_metric, "sum": _c_metric, "avg": _c_metric,
     "stats": _c_metric, "extended_stats": _c_metric,
+    "sampler": _c_sampler, "nested": _c_nested,
+    "reverse_nested": _c_reverse_nested, "children": _c_children,
+    "geohash_grid": _c_geohash_grid, "geo_distance": _c_geo_distance,
+    "geo_bounds": _c_geo_bounds, "geo_centroid": _c_geo_centroid,
+    "percentile_ranks": _c_percentile_ranks,
+    "scripted_metric": _c_scripted_metric,
     "value_count": _c_value_count, "cardinality": _c_cardinality,
     "percentiles": _c_percentiles, "top_hits": _c_top_hits,
     "terms": _c_terms, "histogram": _c_histogram,
@@ -860,11 +1173,158 @@ def _bucket_path_value(bucket: dict, path: str):
     return node
 
 
+def _moving_avg(values: list, params: dict) -> list:
+    """moving_avg models (ref: pipeline/movavg/models/): simple, linear,
+    ewma, holt, holt_winters (additive, no seasonality shortcut)."""
+    window = int(params.get("window", 5))
+    model = str(params.get("model", "simple"))
+    settings = params.get("settings", {}) or {}
+    out: list = []
+    for i in range(len(values)):
+        win = [v for v in values[max(0, i - window + 1): i + 1]
+               if v is not None]
+        if not win:
+            out.append(None)
+            continue
+        if model == "linear":
+            ws = list(range(1, len(win) + 1))
+            out.append(sum(w * v for w, v in zip(ws, win)) / sum(ws))
+        elif model == "ewma":
+            alpha = float(settings.get("alpha", 0.3))
+            acc = win[0]
+            for v in win[1:]:
+                acc = alpha * v + (1 - alpha) * acc
+            out.append(acc)
+        elif model in ("holt", "holt_winters"):
+            alpha = float(settings.get("alpha", 0.3))
+            beta = float(settings.get("beta", 0.1))
+            level, trend = win[0], 0.0
+            for v in win[1:]:
+                last = level
+                level = alpha * v + (1 - alpha) * (level + trend)
+                trend = beta * (level - last) + (1 - beta) * trend
+            out.append(level + trend)
+        else:
+            out.append(sum(win) / len(win))
+    return out
+
+
+def _pipe_expr(src: str, variables: dict):
+    """bucket_script/bucket_selector expression over buckets_path values,
+    evaluated by the SAME restricted-AST walker as lang-expression scripts
+    (search/scripts.py) — never by eval(): remote request bodies must not
+    reach the Python object graph."""
+    import ast as _ast
+    import math as _math
+    allowed = {"abs": abs, "min": min, "max": max, "sqrt": _math.sqrt,
+               "log": _math.log, "log10": _math.log10, "pow": pow}
+    try:
+        tree = _ast.parse(src, mode="eval")
+    except SyntaxError as e:
+        raise QueryParsingError(f"bucket script parse error: {e}") from None
+
+    def ev(node):
+        if isinstance(node, _ast.Expression):
+            return ev(node.body)
+        if isinstance(node, _ast.Constant) and isinstance(
+                node.value, (int, float, bool)):
+            return node.value
+        if isinstance(node, _ast.Name):
+            if node.id in variables:
+                return variables[node.id]
+            raise QueryParsingError(
+                f"unknown variable [{node.id}] in bucket script")
+        if isinstance(node, _ast.BinOp):
+            ops = {_ast.Add: lambda a, b: a + b,
+                   _ast.Sub: lambda a, b: a - b,
+                   _ast.Mult: lambda a, b: a * b,
+                   _ast.Div: lambda a, b: a / b,
+                   _ast.Mod: lambda a, b: a % b,
+                   _ast.Pow: lambda a, b: a ** b}
+            fn = ops.get(type(node.op))
+            if fn is None:
+                raise QueryParsingError("operator not allowed")
+            return fn(ev(node.left), ev(node.right))
+        if isinstance(node, _ast.UnaryOp):
+            if isinstance(node.op, _ast.USub):
+                return -ev(node.operand)
+            if isinstance(node.op, _ast.Not):
+                return not ev(node.operand)
+            raise QueryParsingError("unary operator not allowed")
+        if isinstance(node, _ast.BoolOp):
+            vals = [ev(v) for v in node.values]
+            return all(vals) if isinstance(node.op, _ast.And)                 else any(vals)
+        if isinstance(node, _ast.Compare):
+            ops = {_ast.Gt: lambda a, b: a > b,
+                   _ast.GtE: lambda a, b: a >= b,
+                   _ast.Lt: lambda a, b: a < b,
+                   _ast.LtE: lambda a, b: a <= b,
+                   _ast.Eq: lambda a, b: a == b,
+                   _ast.NotEq: lambda a, b: a != b}
+            left = ev(node.left)
+            for op, comp in zip(node.ops, node.comparators):
+                fn = ops.get(type(op))
+                if fn is None:
+                    raise QueryParsingError("comparison not allowed")
+                right = ev(comp)
+                if not fn(left, right):
+                    return False
+                left = right
+            return True
+        if isinstance(node, _ast.IfExp):
+            return ev(node.body) if ev(node.test) else ev(node.orelse)
+        if isinstance(node, _ast.Call) and isinstance(node.func, _ast.Name) \
+                and node.func.id in allowed and not node.keywords:
+            return allowed[node.func.id](*[ev(a) for a in node.args])
+        raise QueryParsingError(
+            "expression not allowed in bucket script")
+    return ev(tree)
+
+
 def _render_pipeline(node: AggNode, buckets: list[dict]) -> None:
-    """Parent pipelines (cumulative_sum, derivative) rendered into each
-    bucket of the enclosing multi-bucket agg."""
+    """Parent pipelines rendered into (or filtering) the buckets of the
+    enclosing multi-bucket agg (ref: pipeline/*)."""
     for pipe in node.pipelines:
-        if pipe.type not in ("cumulative_sum", "derivative"):
+        if pipe.type == "bucket_selector":
+            paths = pipe.params.get("buckets_path", {})
+            script = pipe.params.get("script", "")
+            if isinstance(script, dict):
+                script = script.get("inline", script.get("source", ""))
+            keep = []
+            for b in buckets:
+                variables = {k: _bucket_path_value(b, p)
+                             for k, p in paths.items()}
+                if any(v is None for v in variables.values()):
+                    continue
+                try:
+                    if _pipe_expr(str(script), variables):
+                        keep.append(b)
+                except QueryParsingError:
+                    raise
+                except Exception:        # noqa: BLE001 — bucket dropped
+                    continue
+            buckets[:] = keep
+            continue
+        if pipe.type not in ("cumulative_sum", "derivative", "moving_avg",
+                             "serial_diff", "bucket_script"):
+            continue
+        if pipe.type == "bucket_script":
+            paths = pipe.params.get("buckets_path", {})
+            script = pipe.params.get("script", "")
+            if isinstance(script, dict):
+                script = script.get("inline", script.get("source", ""))
+            for b in buckets:
+                variables = {k: _bucket_path_value(b, p)
+                             for k, p in paths.items()}
+                if any(v is None for v in variables.values()):
+                    continue
+                try:
+                    b[pipe.name] = {"value": float(
+                        _pipe_expr(str(script), variables))}
+                except QueryParsingError:
+                    raise
+                except Exception:        # noqa: BLE001 — skip bucket
+                    continue
             continue
         path = pipe.params.get("buckets_path", "_count")
         values = [_bucket_path_value(b, path) for b in buckets]
@@ -879,6 +1339,16 @@ def _render_pipeline(node: AggNode, buckets: list[dict]) -> None:
                 if prev is not None and v is not None:
                     b[pipe.name] = {"value": v - prev}
                 prev = v
+        elif pipe.type == "moving_avg":
+            for b, v in zip(buckets, _moving_avg(values, pipe.params)):
+                if v is not None:
+                    b[pipe.name] = {"value": v}
+        elif pipe.type == "serial_diff":
+            lag = int(pipe.params.get("lag", 1))
+            for i, b in enumerate(buckets):
+                if i >= lag and values[i] is not None \
+                        and values[i - lag] is not None:
+                    b[pipe.name] = {"value": values[i] - values[i - lag]}
 
 
 def _reduce_node(node: AggNode, parts: list[dict]) -> dict:
@@ -980,6 +1450,64 @@ def _reduce_node(node: AggNode, parts: list[dict]) -> dict:
         buckets = [{"key": k, **_final_bucket(merged[k])} for k in order
                    if k in merged]
         return {"buckets": buckets}
+    if t in ("sampler", "nested", "reverse_nested", "children"):
+        total = sum(p.get("doc_count", 0) for p in parts)
+        out = {"doc_count": total}
+        if node.subs:
+            # reverse_nested subs were collected inline by _c_nested
+            sub_parts = [p["subs"] for p in parts if "subs" in p]
+            if sub_parts:
+                out.update(reduce_aggs(node.subs, sub_parts))
+        return out
+    if t in ("geohash_grid", "geo_distance"):
+        merged = _merge_buckets(node, parts)
+        if t == "geo_distance":
+            order = parts[0].get("keyed_order", list(merged)) \
+                if parts else []
+            buckets = [{"key": k, **_final_bucket(merged[k])}
+                       for k in order if k in merged]
+        else:
+            size = int(node.params.get("size", 10000) or 0) or len(merged)
+            items = sorted(merged.items(),
+                           key=lambda kv: (-kv[1]["doc_count"], kv[0]))
+            buckets = [{"key": k, **_final_bucket(b)}
+                       for k, b in items[:size]]
+        _render_pipeline(node, buckets)
+        return {"buckets": buckets}
+    if t == "geo_bounds":
+        alive = [p for p in parts if p.get("count")]
+        if not alive:
+            return {"bounds": None}
+        return {"bounds": {
+            "top_left": {"lat": max(p["top"] for p in alive),
+                         "lon": min(p["left"] for p in alive)},
+            "bottom_right": {"lat": min(p["bottom"] for p in alive),
+                             "lon": max(p["right"] for p in alive)}}}
+    if t == "geo_centroid":
+        n = sum(p.get("count", 0) for p in parts)
+        if not n:
+            return {"count": 0}
+        return {"count": n,
+                "location": {
+                    "lat": sum(p.get("lat_sum", 0.0) for p in parts) / n,
+                    "lon": sum(p.get("lon_sum", 0.0) for p in parts) / n}}
+    if t == "percentile_ranks":
+        allv = np.concatenate([np.asarray(p["values"], np.float64)
+                               for p in parts]) if parts else \
+            np.zeros(0)
+        wanted = parts[0].get("wanted", []) if parts else []
+        vals = {}
+        for w in wanted:
+            vals[f"{float(w)}"] = (
+                float(100.0 * (allv <= w).sum() / allv.size)
+                if allv.size else None)
+        return {"values": vals}
+    if t == "scripted_metric":
+        allv = [v for p in parts for v in p.get("values", [])]
+        # combine/reduce as expressions over `_values` would need a host
+        # list context; the practical default (the reference's examples
+        # sum) reduces to the sum — documented subset
+        return {"value": float(np.sum(allv)) if allv else 0.0}
     if t == "significant_terms":
         fg_total = sum(p.get("fg_total", 0) for p in parts)
         bg_total = sum(p.get("bg_total", 0) for p in parts)

@@ -1,0 +1,159 @@
+"""Aggregation breadth: sampler, nested/reverse_nested, children, geo aggs,
+percentile_ranks, scripted_metric, moving_avg/bucket_script/bucket_selector/
+serial_diff pipelines.
+
+Reference: core/search/aggregations/bucket/{sampler,nested,children,
+geogrid,range/geodistance}, metrics/{geobounds,geocentroid,percentiles,
+scripted}, pipeline/{movavg,bucketscript,...}.
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node(data_path=tmp_path_factory.mktemp("aggs")).start()
+    n.indices_service.create_index("shop", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {
+            "item": {"properties": {
+                "name": {"type": "string"},
+                "price": {"type": "double"},
+                "place": {"type": "geo_point"},
+                "tags": {"type": "nested", "properties": {
+                    "label": {"type": "string",
+                              "index": "not_analyzed"},
+                    "weight": {"type": "long"}}}}},
+            "review": {"_parent": {"type": "item"},
+                       "properties": {"stars": {"type": "long"}}}}})
+    docs = [
+        ("i1", {"name": "alpha widget", "price": 10.0,
+                "place": {"lat": 52.52, "lon": 13.40},   # Berlin
+                "tags": [{"label": "red", "weight": 1},
+                         {"label": "blue", "weight": 2}]}),
+        ("i2", {"name": "beta widget", "price": 20.0,
+                "place": {"lat": 48.85, "lon": 2.35},    # Paris
+                "tags": [{"label": "red", "weight": 3}]}),
+        ("i3", {"name": "gamma gadget", "price": 30.0,
+                "place": {"lat": 52.50, "lon": 13.45},   # Berlin-ish
+                "tags": [{"label": "green", "weight": 5}]}),
+    ]
+    for did, src in docs:
+        n.index_doc("shop", did, src,
+                    meta={"_type": "item"})
+    for rid, parent, stars in (("r1", "i1", 5), ("r2", "i1", 1),
+                               ("r3", "i2", 3)):
+        n.index_doc("shop", rid, {"stars": stars},
+                    meta={"_type": "review", "_parent": parent})
+    n.indices_service.index("shop").refresh()
+    yield n
+    n.close()
+
+
+def agg(node, body):
+    return node.search("shop", {"size": 0, "query": {"type": {
+        "value": "item"}}, "aggs": body})["aggregations"]
+
+
+class TestBucketBreadth:
+    def test_sampler(self, node):
+        out = agg(node, {"s": {"sampler": {"shard_size": 2},
+                               "aggs": {"p": {"avg": {"field": "price"}}}}})
+        assert out["s"]["doc_count"] == 2
+        assert out["s"]["p"]["value"] is not None
+
+    def test_nested_and_reverse(self, node):
+        out = agg(node, {"t": {"nested": {"path": "tags"}, "aggs": {
+            "labels": {"terms": {"field": "tags.label"}},
+            "back": {"reverse_nested": {}}}}})
+        assert out["t"]["doc_count"] == 4          # 4 nested tag rows
+        keys = {b["key"]: b["doc_count"]
+                for b in out["t"]["labels"]["buckets"]}
+        assert keys == {"red": 2, "blue": 1, "green": 1}
+        assert out["t"]["back"]["doc_count"] == 3  # back to parents
+
+    def test_children(self, node):
+        out = agg(node, {"kids": {"children": {"type": "review"},
+                                  "aggs": {"s": {"avg": {
+                                      "field": "stars"}}}}})
+        assert out["kids"]["doc_count"] == 3
+        assert out["kids"]["s"]["value"] == pytest.approx(3.0)
+
+    def test_geohash_grid(self, node):
+        out = agg(node, {"g": {"geohash_grid": {"field": "place",
+                                                "precision": 3}}})
+        counts = {b["key"]: b["doc_count"] for b in out["g"]["buckets"]}
+        assert sum(counts.values()) == 3
+        assert max(counts.values()) == 2           # the two Berlin docs
+
+    def test_geo_distance(self, node):
+        out = agg(node, {"d": {"geo_distance": {
+            "field": "place", "origin": {"lat": 52.52, "lon": 13.40},
+            "unit": "km",
+            "ranges": [{"to": 50}, {"from": 50}]}}})
+        b = out["d"]["buckets"]
+        assert b[0]["doc_count"] == 2              # Berlin pair
+        assert b[1]["doc_count"] == 1              # Paris
+
+
+class TestMetricBreadth:
+    def test_geo_bounds(self, node):
+        out = agg(node, {"b": {"geo_bounds": {"field": "place"}}})
+        bounds = out["b"]["bounds"]
+        assert bounds["top_left"]["lat"] == pytest.approx(52.52)
+        assert bounds["top_left"]["lon"] == pytest.approx(2.35)
+        assert bounds["bottom_right"]["lat"] == pytest.approx(48.85)
+
+    def test_geo_centroid(self, node):
+        out = agg(node, {"c": {"geo_centroid": {"field": "place"}}})
+        assert out["c"]["count"] == 3
+        assert 48 < out["c"]["location"]["lat"] < 53
+
+    def test_percentile_ranks(self, node):
+        out = agg(node, {"pr": {"percentile_ranks": {
+            "field": "price", "values": [15, 30]}}})
+        assert out["pr"]["values"]["15.0"] == pytest.approx(100 / 3)
+        assert out["pr"]["values"]["30.0"] == pytest.approx(100.0)
+
+    def test_scripted_metric(self, node):
+        out = agg(node, {"sm": {"scripted_metric": {
+            "map_script": "doc['price'].value * 2"}}})
+        assert out["sm"]["value"] == pytest.approx(120.0)
+
+
+class TestPipelineBreadth:
+    def body(self):
+        return {"h": {"histogram": {"field": "price", "interval": 10},
+                      "aggs": {"p": {"sum": {"field": "price"}}}}}
+
+    def test_moving_avg(self, node):
+        b = self.body()
+        b["h"]["aggs"]["ma"] = {"moving_avg": {
+            "buckets_path": "p", "window": 2}}
+        out = agg(node, b)
+        vals = [bk.get("ma", {}).get("value")
+                for bk in out["h"]["buckets"]]
+        assert vals[1] == pytest.approx((10 + 20) / 2)
+
+    def test_serial_diff(self, node):
+        b = self.body()
+        b["h"]["aggs"]["sd"] = {"serial_diff": {"buckets_path": "p",
+                                                "lag": 1}}
+        out = agg(node, b)
+        assert out["h"]["buckets"][1]["sd"]["value"] == pytest.approx(10.0)
+
+    def test_bucket_script_and_selector(self, node):
+        b = self.body()
+        b["h"]["aggs"]["double"] = {"bucket_script": {
+            "buckets_path": {"v": "p"}, "script": "v * 2"}}
+        out = agg(node, b)
+        assert out["h"]["buckets"][0]["double"]["value"] == \
+            pytest.approx(20.0)
+        b2 = self.body()
+        b2["h"]["aggs"]["keep"] = {"bucket_selector": {
+            "buckets_path": {"v": "p"}, "script": "v > 15"}}
+        out2 = agg(node, b2)
+        assert [bk["p"]["value"] for bk in out2["h"]["buckets"]] == \
+            [20.0, 30.0]

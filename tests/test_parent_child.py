@@ -79,6 +79,7 @@ class TestParentField:
         rc2 = RestController()
         register_all(rc2, node2)
         try:
+            node2.wait_for_health("yellow", 15.0)
             st, out = call(rc2, "GET", "/shop/review/r2?parent=i1")
             assert st == 200 and out["_parent"] == "i1"
         finally:
