@@ -397,11 +397,15 @@ def _d_range(node: AggNode, fname: str, state: DeviceAggState,
 class ShardAggContext:
     """Host views of one shard's reader for aggregation collection."""
 
-    def __init__(self, reader, mapper_service, execute_filter, scores=None):
+    def __init__(self, reader, mapper_service, execute_filter, scores=None,
+                 exec_ctx=None):
         self.reader = reader
         self.mapper_service = mapper_service
         self.execute_filter = execute_filter  # (Query) → list[np mask per seg]
         self.scores = scores                  # [N] query scores (top_hits)
+        # the query ExecutionContext, when the caller has one — nested agg
+        # sub-filters re-execute over CHILD segments through it
+        self.exec_ctx = exec_ctx
 
     def live_mask(self) -> np.ndarray:
         """Concatenated live mask over the reader (significant_terms'
@@ -801,7 +805,10 @@ class _NestedCtx(ShardAggContext):
         self.parent_ctx = parent_ctx
         self.path = path
         self.mapper_service = parent_ctx.mapper_service
-        self.execute_filter = parent_ctx.execute_filter
+        self.exec_ctx = parent_ctx.exec_ctx
+        # filters under a nested agg evaluate in CHILD-row space — the
+        # parent's execute_filter would mask the wrong doc space
+        self.execute_filter = self._child_filter
         self.scores = None
         import types
         segs = []
@@ -821,6 +828,19 @@ class _NestedCtx(ShardAggContext):
         self.reader = types.SimpleNamespace(
             segments=[x for x in segs if x is not None])
         self._all_segs = segs
+
+    def _child_filter(self, query) -> np.ndarray:
+        from elasticsearch_tpu.search.execute import SegmentExecutor
+        if self.exec_ctx is None:
+            raise QueryParsingError(
+                "filter aggregations under [nested] need the query "
+                "execution context")
+        masks = []
+        for seg in self.reader.segments:
+            ex = SegmentExecutor(seg, self.exec_ctx)
+            masks.append(np.asarray(ex.match_mask(query))
+                         & np.asarray(seg.live)[:seg.padded_docs])
+        return np.concatenate(masks) if masks else np.zeros(0, bool)
 
     def child_mask(self, parent_mask: np.ndarray) -> np.ndarray:
         """Parent-space mask → concatenated child-row mask."""
@@ -1030,6 +1050,14 @@ def _c_scripted_metric(node, mask, ctx):
     if map_src is None:
         raise QueryParsingError(
             "[scripted_metric] requires a map_script")
+    for phase in ("init_script", "combine_script", "reduce_script"):
+        if node.params.get(phase):
+            # this engine's scripted_metric reduces by summing map values;
+            # silently ignoring a custom phase would return plausible but
+            # wrong numbers
+            raise QueryParsingError(
+                f"[scripted_metric] {phase} is not supported (the map "
+                f"values reduce by sum)")
     script = compile_script(str(map_src))
     values = []
     off = 0
@@ -1211,74 +1239,15 @@ def _moving_avg(values: list, params: dict) -> list:
 
 def _pipe_expr(src: str, variables: dict):
     """bucket_script/bucket_selector expression over buckets_path values,
-    evaluated by the SAME restricted-AST walker as lang-expression scripts
-    (search/scripts.py) — never by eval(): remote request bodies must not
-    reach the Python object graph."""
-    import ast as _ast
-    import math as _math
-    allowed = {"abs": abs, "min": min, "max": max, "sqrt": _math.sqrt,
-               "log": _math.log, "log10": _math.log10, "pow": pow}
-    try:
-        tree = _ast.parse(src, mode="eval")
-    except SyntaxError as e:
-        raise QueryParsingError(f"bucket script parse error: {e}") from None
-
-    def ev(node):
-        if isinstance(node, _ast.Expression):
-            return ev(node.body)
-        if isinstance(node, _ast.Constant) and isinstance(
-                node.value, (int, float, bool)):
-            return node.value
-        if isinstance(node, _ast.Name):
-            if node.id in variables:
-                return variables[node.id]
-            raise QueryParsingError(
-                f"unknown variable [{node.id}] in bucket script")
-        if isinstance(node, _ast.BinOp):
-            ops = {_ast.Add: lambda a, b: a + b,
-                   _ast.Sub: lambda a, b: a - b,
-                   _ast.Mult: lambda a, b: a * b,
-                   _ast.Div: lambda a, b: a / b,
-                   _ast.Mod: lambda a, b: a % b,
-                   _ast.Pow: lambda a, b: a ** b}
-            fn = ops.get(type(node.op))
-            if fn is None:
-                raise QueryParsingError("operator not allowed")
-            return fn(ev(node.left), ev(node.right))
-        if isinstance(node, _ast.UnaryOp):
-            if isinstance(node.op, _ast.USub):
-                return -ev(node.operand)
-            if isinstance(node.op, _ast.Not):
-                return not ev(node.operand)
-            raise QueryParsingError("unary operator not allowed")
-        if isinstance(node, _ast.BoolOp):
-            vals = [ev(v) for v in node.values]
-            return all(vals) if isinstance(node.op, _ast.And)                 else any(vals)
-        if isinstance(node, _ast.Compare):
-            ops = {_ast.Gt: lambda a, b: a > b,
-                   _ast.GtE: lambda a, b: a >= b,
-                   _ast.Lt: lambda a, b: a < b,
-                   _ast.LtE: lambda a, b: a <= b,
-                   _ast.Eq: lambda a, b: a == b,
-                   _ast.NotEq: lambda a, b: a != b}
-            left = ev(node.left)
-            for op, comp in zip(node.ops, node.comparators):
-                fn = ops.get(type(op))
-                if fn is None:
-                    raise QueryParsingError("comparison not allowed")
-                right = ev(comp)
-                if not fn(left, right):
-                    return False
-                left = right
-            return True
-        if isinstance(node, _ast.IfExp):
-            return ev(node.body) if ev(node.test) else ev(node.orelse)
-        if isinstance(node, _ast.Call) and isinstance(node.func, _ast.Name) \
-                and node.func.id in allowed and not node.keywords:
-            return allowed[node.func.id](*[ev(a) for a in node.args])
-        raise QueryParsingError(
-            "expression not allowed in bucket script")
-    return ev(tree)
+    evaluated by the lang-expression walker (search/scripts.py) with the
+    bucket values bound as bare names — ONE sandbox to audit, never
+    eval()."""
+    from elasticsearch_tpu.search.scripts import (
+        ScriptContext, compile_script)
+    ctx = ScriptContext(get_numeric_column=None, get_vector_column=None,
+                        scores=None, params={}, variables=variables)
+    out = compile_script(str(src)).evaluate(ctx)
+    return out
 
 
 def _render_pipeline(node: AggNode, buckets: list[dict]) -> None:
@@ -1504,9 +1473,8 @@ def _reduce_node(node: AggNode, parts: list[dict]) -> dict:
         return {"values": vals}
     if t == "scripted_metric":
         allv = [v for p in parts for v in p.get("values", [])]
-        # combine/reduce as expressions over `_values` would need a host
-        # list context; the practical default (the reference's examples
-        # sum) reduces to the sum — documented subset
+        # custom combine/reduce phases are rejected at collect time; the
+        # supported contract is sum-of-map-values
         return {"value": float(np.sum(allv)) if allv else 0.0}
     if t == "significant_terms":
         fg_total = sum(p.get("fg_total", 0) for p in parts)

@@ -295,9 +295,16 @@ class SegmentResolver:
         # guard for DFS-provided stats: a term present in this segment but
         # with global df 0 would have idf 0 — its matches score 0 and the
         # scores>0 shortcut would drop them, diverging from nmatch
-        # semantics; fall back to nmatch counting in that (odd) case
-        all_idf_pos = all(idf > 0 or tid < 0
-                          for tid, idf in zip(tids, idfs))
+        # semantics; fall back to nmatch counting in that (odd) case.
+        # The test is the term's LOCAL df: local df 0 means no posting can
+        # match here, so idf 0 is harmless — keeping msm1 makes the plan
+        # signature independent of which query terms this shard happens to
+        # hold (shards of one index must batch together, and the compile
+        # cache keys on the signature)
+        col_df = np.asarray(self.seg.text[field].column.df)
+        all_idf_pos = all(
+            idf > 0 or tid < 0 or col_df[tid] == 0
+            for tid, idf in zip(tids, idfs))
         msm1 = required == 1 and all_idf_pos
         self.sig("msm1" if msm1 else "msm")
         r_req = None if msm1 else self.c(required, np.int32)

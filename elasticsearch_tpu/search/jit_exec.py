@@ -50,9 +50,24 @@ def cache_stats() -> dict:
     return dict(_stats)
 
 
-def note_fallback() -> None:
+_logged_fallbacks: set = set()
+
+
+def note_fallback(exc: BaseException | None = None) -> None:
     with _cache_lock:
         _stats["fallbacks"] += 1
+    if exc is not None:
+        # log each distinct failure once — silent fallbacks hide real
+        # kernel bugs (round-2 verdict weak #9)
+        key = (type(exc).__name__, str(exc)[:120])
+        if key not in _logged_fallbacks:
+            _logged_fallbacks.add(key)
+            import logging
+            import traceback
+            logging.getLogger("elasticsearch_tpu.jit").warning(
+                "jit path fell back to eager: %s",
+                "".join(traceback.format_exception(
+                    type(exc), exc, exc.__traceback__)[-3:]).strip())
 
 
 def clear_cache() -> None:

@@ -351,8 +351,8 @@ class ShardSearcher:
                         break
         except QueryParsingError:
             raise
-        except Exception:                     # noqa: BLE001 — fallback seam
-            jit_exec.note_fallback()
+        except Exception as e:                # noqa: BLE001 — fallback seam
+            jit_exec.note_fallback(e)
             return self._query_phase_eager(req)
 
         total = int(sum(int(np.asarray(o["count"])) for _, o in outs))
@@ -433,8 +433,8 @@ class ShardSearcher:
                 seg_outs.append(outs)
         except QueryParsingError:
             raise
-        except Exception:                 # noqa: BLE001 — fallback seam
-            jit_exec.note_fallback()
+        except Exception as e:            # noqa: BLE001 — fallback seam
+            jit_exec.note_fallback(e)
             return None
         if not seg_outs:
             return [ShardQueryResult(self.shard_id, 0, None,
@@ -529,7 +529,8 @@ class ShardSearcher:
                 if np_ctx is None:
                     np_ctx = ShardAggContext(
                         self.reader, self.mapper_service,
-                        self._filter_masks_np, scores=state.np_scores())
+                        self._filter_masks_np, scores=state.np_scores(),
+                        exec_ctx=self.ctx)
                 partial = collect(node, state.np_mask(), np_ctx)
             out[node.name] = partial
         return out

@@ -53,11 +53,15 @@ _FUNCS: dict[str, Callable] = {
 class ScriptContext:
     """Per-segment evaluation context handed to compiled scripts."""
 
-    def __init__(self, get_numeric_column, get_vector_column, scores, params: dict):
+    def __init__(self, get_numeric_column, get_vector_column, scores,
+                 params: dict, variables: dict | None = None):
         self.get_numeric_column = get_numeric_column   # field → ([N] f32, exists)
         self.get_vector_column = get_vector_column     # field → ([N, D] f32, exists)
         self.scores = scores                           # [N] f32
         self.params = params
+        # bare-name bindings (bucket_script/bucket_selector buckets_path
+        # values) — resolved before the _score special name
+        self.variables = variables or {}
 
 
 class CompiledScript:
@@ -79,6 +83,8 @@ def _eval(node: _pyast.AST, ctx: ScriptContext) -> Any:  # noqa: C901
             return node.value
         raise QueryParsingError(f"script constant not allowed: {node.value!r}")
     if isinstance(node, _pyast.Name):
+        if node.id in ctx.variables:
+            return ctx.variables[node.id]
         if node.id == "_score":
             return ctx.scores
         raise QueryParsingError(f"unknown script variable [{node.id}]")
