@@ -1,0 +1,188 @@
+"""XContent — pluggable content formats for request/response bodies.
+
+Reference: core/common/xcontent/XContentFactory.java + XContentType — the
+same API body can arrive as JSON, YAML, CBOR, or SMILE, sniffed from the
+Content-Type header or the payload's magic bytes; responses render in the
+requested format. JSON and YAML use the standard codecs; CBOR is a
+self-contained RFC 7049 subset codec (maps/arrays/strings/ints/floats/
+bool/null — the shapes JSON can express, which is exactly what the
+reference emits); SMILE is detected and reported as unsupported rather
+than misparsed as JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+JSON = "application/json"
+YAML = "application/yaml"
+CBOR = "application/cbor"
+SMILE = "application/smile"
+
+
+def sniff_type(content_type: str | None, body: bytes) -> str:
+    """XContentFactory.xContentType: the header wins; otherwise the
+    payload's magic bytes."""
+    if content_type:
+        ct = content_type.split(";")[0].strip().lower()
+        for t in (JSON, YAML, CBOR, SMILE):
+            if ct == t or ct.endswith("+" + t.rsplit("/", 1)[1]):
+                return t
+        if "yaml" in ct:
+            return YAML
+        if "cbor" in ct:
+            return CBOR
+        if "smile" in ct:
+            return SMILE
+    if body[:3] == b":)\n":
+        return SMILE
+    if body[:3] == b"---":
+        return YAML
+    if body[:1] and (body[0] >> 5) in (4, 5) and body[:1] != b"[" \
+            and body[:1] != b"{":
+        # CBOR major type 4 (array) / 5 (map) leading byte; printable
+        # JSON never starts with those ranges
+        return CBOR
+    return JSON
+
+
+def decode(body: bytes, content_type: str | None = None) -> Any:
+    t = sniff_type(content_type, body)
+    if t == JSON:
+        return json.loads(body)
+    if t == YAML:
+        import yaml
+        return yaml.safe_load(body.decode("utf-8"))
+    if t == CBOR:
+        value, offset = _cbor_decode(body, 0)
+        return value
+    raise IllegalArgumentError(
+        "SMILE content is not supported by this build; send JSON, YAML "
+        "or CBOR")
+
+
+def encode(obj: Any, accept: str | None = None,
+           pretty: bool = False) -> tuple[bytes, str]:
+    """→ (payload, content_type) per the `format=`/Accept choice."""
+    t = sniff_type(accept, b"") if accept else JSON
+    if accept in ("yaml",):
+        t = YAML
+    elif accept in ("cbor",):
+        t = CBOR
+    elif accept in ("json", None):
+        t = JSON
+    if t == YAML:
+        import yaml
+        return (yaml.safe_dump(obj, default_flow_style=False,
+                               sort_keys=False).encode(), YAML)
+    if t == CBOR:
+        return _cbor_encode(obj), CBOR
+    if pretty:
+        return (json.dumps(obj, indent=2) + "\n").encode(), JSON
+    return json.dumps(obj).encode(), JSON
+
+
+# ---------------------------------------------------------------------------
+# CBOR (RFC 7049 subset: the JSON-expressible shapes)
+# ---------------------------------------------------------------------------
+
+def _cbor_head(major: int, value: int) -> bytes:
+    if value < 24:
+        return bytes([(major << 5) | value])
+    if value < 0x100:
+        return bytes([(major << 5) | 24, value])
+    if value < 0x10000:
+        return bytes([(major << 5) | 25]) + value.to_bytes(2, "big")
+    if value < 0x100000000:
+        return bytes([(major << 5) | 26]) + value.to_bytes(4, "big")
+    return bytes([(major << 5) | 27]) + value.to_bytes(8, "big")
+
+
+def _cbor_encode(obj: Any) -> bytes:
+    if obj is None:
+        return b"\xf6"
+    if obj is True:
+        return b"\xf5"
+    if obj is False:
+        return b"\xf4"
+    if isinstance(obj, int):
+        return _cbor_head(0, obj) if obj >= 0 else _cbor_head(1, -1 - obj)
+    if isinstance(obj, float):
+        return b"\xfb" + struct.pack(">d", obj)
+    if isinstance(obj, bytes):
+        return _cbor_head(2, len(obj)) + obj
+    if isinstance(obj, str):
+        raw = obj.encode("utf-8")
+        return _cbor_head(3, len(raw)) + raw
+    if isinstance(obj, (list, tuple)):
+        return _cbor_head(4, len(obj)) + b"".join(
+            _cbor_encode(v) for v in obj)
+    if isinstance(obj, dict):
+        out = _cbor_head(5, len(obj))
+        for k, v in obj.items():
+            out += _cbor_encode(str(k)) + _cbor_encode(v)
+        return out
+    raise IllegalArgumentError(
+        f"cannot encode [{type(obj).__name__}] as CBOR")
+
+
+def _cbor_uint(data: bytes, offset: int, info: int) -> tuple[int, int]:
+    if info < 24:
+        return info, offset
+    size = {24: 1, 25: 2, 26: 4, 27: 8}.get(info)
+    if size is None:
+        raise IllegalArgumentError("unsupported CBOR length encoding")
+    return int.from_bytes(data[offset:offset + size], "big"), offset + size
+
+
+def _cbor_decode(data: bytes, offset: int) -> tuple[Any, int]:
+    if offset >= len(data):
+        raise IllegalArgumentError("truncated CBOR payload")
+    byte = data[offset]
+    major, info = byte >> 5, byte & 0x1F
+    offset += 1
+    if major == 0:
+        return _cbor_uint(data, offset, info)
+    if major == 1:
+        v, offset = _cbor_uint(data, offset, info)
+        return -1 - v, offset
+    if major == 2:
+        n, offset = _cbor_uint(data, offset, info)
+        return data[offset:offset + n], offset + n
+    if major == 3:
+        n, offset = _cbor_uint(data, offset, info)
+        return data[offset:offset + n].decode("utf-8"), offset + n
+    if major == 4:
+        n, offset = _cbor_uint(data, offset, info)
+        out = []
+        for _ in range(n):
+            v, offset = _cbor_decode(data, offset)
+            out.append(v)
+        return out, offset
+    if major == 5:
+        n, offset = _cbor_uint(data, offset, info)
+        d: dict = {}
+        for _ in range(n):
+            k, offset = _cbor_decode(data, offset)
+            v, offset = _cbor_decode(data, offset)
+            d[k] = v
+        return d, offset
+    if major == 7:
+        if info == 20:
+            return False, offset
+        if info == 21:
+            return True, offset
+        if info == 22:
+            return None, offset
+        if info == 26:
+            return struct.unpack(">f", data[offset:offset + 4])[0], \
+                offset + 4
+        if info == 27:
+            return struct.unpack(">d", data[offset:offset + 8])[0], \
+                offset + 8
+    raise IllegalArgumentError(
+        f"unsupported CBOR item (major {major}, info {info})")

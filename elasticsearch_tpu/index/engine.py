@@ -186,8 +186,15 @@ class Engine:
         self._merge_failures = 0
         self._booted = False
 
-        durability = settings.get("index.translog.durability", DURABILITY_REQUEST)
-        self.translog = Translog(self.path / "translog", durability=durability)
+        if getattr(type(self), "_SHADOW", False):
+            # read-only replica: no write handle on the primary's WAL,
+            # no uncommitted-op replay (commits-only visibility)
+            self.translog = _NullTranslog()
+        else:
+            durability = settings.get("index.translog.durability",
+                                      DURABILITY_REQUEST)
+            self.translog = Translog(self.path / "translog",
+                                     durability=durability)
 
         self._segments: list[Segment] = []
         self._live_masks: list[np.ndarray] = []
@@ -925,3 +932,75 @@ class Engine:
                 release_device_reader(self)
                 self.translog.close()
                 self._closed = True
+
+
+class _NullTranslog:
+    """The shadow's translog stand-in: a read-only replica must neither
+    hold a write handle on the primary's WAL nor replay uncommitted ops
+    (ShadowEngine reads COMMITS only)."""
+
+    generation = 0
+    committed_generation = 0
+
+    def add(self, *a, **kw):
+        raise EngineClosedError("shadow engine has no translog")
+
+    def uncommitted_ops(self):
+        return []
+
+    def roll(self, *a, **kw):
+        return None
+
+    def stats(self):
+        return {"operations": 0, "size_in_bytes": 0}
+
+    def close(self):
+        return None
+
+
+class ShadowEngine(Engine):
+    """Read-only engine over a shared-filesystem shard directory (ref:
+    core/index/engine/ShadowEngine.java — with index.shadow_replicas,
+    replicas never apply ops; they re-open the commits the primary wrote
+    to shared storage). Document ops, flush, and merges are refused — the
+    PRIMARY owns the directory's commit and translog; the shadow only
+    ever reads committed state. ``refresh_from_disk`` picks up the
+    primary's latest commit."""
+
+    _SHADOW = True
+
+    def index(self, *a, **kw):
+        raise EngineClosedError(
+            "shadow engine does not support document operations")
+
+    index_replica = index
+    delete = index
+    delete_replica = index
+
+    def flush(self, *a, **kw):
+        # committing from the shadow would overwrite the primary's commit
+        # and (worse) roll its translog — ShadowEngine.flush is a no-op
+        # reader re-open in the reference too
+        return None
+
+    def force_merge(self, *a, **kw):
+        raise EngineClosedError("shadow engine does not merge")
+
+    def synced_flush(self, *a, **kw):
+        return None
+
+    def refresh_from_disk(self) -> int:
+        """Re-open the newest on-disk commit (the primary's flush) and
+        swap the reader. → the commit generation now serving reads."""
+        with self._lock:
+            self._ensure_open()
+            self._segments = []
+            self._live_masks = []
+            self._buffer = SegmentBuilder(
+                seg_id=0, max_tokens=self._buffer.max_tokens)
+            self._buffer_docs = {}
+            self._versions = {}
+            self._pending_seg_deletes = {}
+            self._commit_gen = self._load_commit()
+            self.refresh()
+            return self._commit_gen

@@ -137,6 +137,16 @@ class Node:
             self.settings.get("indices.ttl.interval", "60s"), "ttl.interval")
         self._ttl_timer = None
         self._schedule_ttl_sweep()
+        # file scripts hot-reload (ResourceWatcherService + the
+        # ScriptService file-script listener)
+        from elasticsearch_tpu.watcher import ResourceWatcherService
+        scripts_dir = Path(self.settings.get(
+            "path.scripts", self.data_path / "config" / "scripts"))
+        self.resource_watcher = ResourceWatcherService(
+            scripts_dir,
+            interval_s=parse_time_value(
+                self.settings.get("resource.reload.interval", "5s"),
+                "resource.reload.interval")).start()
         from elasticsearch_tpu.discovery import ZenDiscovery
         self.discovery = ZenDiscovery(
             self.transport_service, self.cluster_service, self.allocation,
@@ -276,8 +286,13 @@ class Node:
                                              update)
 
     def stored_script(self, sid: str, lang: str = "mustache"):
-        return self.cluster_service.state().customs.get(
+        src = self.cluster_service.state().customs.get(
             "stored_scripts", {}).get(f"{lang}\x00{sid}")
+        if src is None and getattr(self, "resource_watcher", None):
+            # file scripts resolve after indexed ones (ScriptService
+            # lookup order: inline > indexed > file)
+            src = self.resource_watcher.get(sid, lang)
+        return src
 
     def stored_script_version(self, sid: str, lang: str) -> int:
         return self.cluster_service.state().customs.get(
@@ -715,6 +730,8 @@ class Node:
                 self._delayed_reroute_timer.cancel()
             if self._ttl_timer is not None:
                 self._ttl_timer.cancel()
+            if getattr(self, "resource_watcher", None):
+                self.resource_watcher.stop()
             self.search_actions.close()
             self.discovery.stop()
             self.indices_service.close()

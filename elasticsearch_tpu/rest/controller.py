@@ -95,7 +95,8 @@ class RestController:
         found = walk(root, 0, {})
         return found if found else (None, {})
 
-    def dispatch(self, method: str, uri: str, body: bytes) -> tuple[int, Any]:
+    def dispatch(self, method: str, uri: str, body: bytes,
+                 content_type: str | None = None) -> tuple[int, Any]:
         """→ (status, response_body_object)."""
         parsed = urlparse(uri)
         qs = {k: v[-1] for k, v in parse_qs(parsed.query,
@@ -110,9 +111,12 @@ class RestController:
                           path_params=path_params, raw_body=body)
         if body:
             try:
-                req.body = json.loads(body)
-            except (json.JSONDecodeError, UnicodeDecodeError):
-                req.body = None  # NDJSON handlers read raw_body
+                from elasticsearch_tpu.common.xcontent import decode
+                req.body = decode(body, content_type)
+            except ElasticsearchTpuError as e:
+                return e.status, _error_body(e)
+            except Exception:   # noqa: BLE001 — NDJSON reads raw_body
+                req.body = None
         try:
             status, payload = handler(req)
             fp = qs.get("filter_path")
@@ -120,12 +124,16 @@ class RestController:
                 payload = filter_response(payload, fp.split(","))
             return status, payload
         except ElasticsearchTpuError as e:
-            return e.status, {"error": {"root_cause": [e.to_xcontent()],
-                                        **e.to_xcontent()},
-                              "status": e.status}
+            return e.status, _error_body(e)
         except Exception as e:  # noqa: BLE001 — REST boundary
             return 500, {"error": {"type": "exception", "reason": str(e)},
                          "status": 500}
+
+
+def _error_body(e: ElasticsearchTpuError) -> dict:
+    """The ES error envelope (root_cause + flattened cause + status)."""
+    return {"error": {"root_cause": [e.to_xcontent()], **e.to_xcontent()},
+            "status": e.status}
 
 
 def filter_response(payload, patterns: list[str]):

@@ -34,13 +34,27 @@ class RestServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 status, payload = controller.dispatch(
-                    self.command, self.path, body)
+                    self.command, self.path, body,
+                    content_type=self.headers.get("Content-Type"))
                 if isinstance(payload, str):
                     data = payload.encode("utf-8")
                     ctype = "text/plain; charset=UTF-8"
                 else:
-                    data = json.dumps(payload).encode("utf-8")
-                    ctype = "application/json; charset=UTF-8"
+                    # response format: ?format= wins, else the Accept
+                    # header (XContentType.fromMediaTypeOrFormat)
+                    from urllib.parse import parse_qs, urlparse
+                    from elasticsearch_tpu.common.xcontent import encode
+                    qs = parse_qs(urlparse(self.path).query,
+                                  keep_blank_values=True)
+                    fmt = (qs.get("format") or [None])[0]
+                    accept = fmt or self.headers.get("Accept")
+                    if accept in ("*/*", "", None):
+                        accept = "json"
+                    # bare `?pretty` means true (param_as_bool semantics)
+                    pretty = (qs.get("pretty") or ["false"])[0] \
+                        in ("", "true", "1")
+                    data, ctype = encode(payload, accept, pretty=pretty)
+                    ctype += "; charset=UTF-8"
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))

@@ -41,10 +41,20 @@ _ALLOWED_CMPOPS = {
     _pyast.Eq: lambda a, b: a == b, _pyast.NotEq: lambda a, b: a != b,
 }
 
+def _fold(fn, args):
+    from functools import reduce
+    if len(args) == 1:
+        return args[0]
+    return reduce(fn, args)
+
+
 _FUNCS: dict[str, Callable] = {
     "log": jnp.log, "ln": jnp.log, "log10": jnp.log10, "sqrt": jnp.sqrt,
     "abs": jnp.abs, "exp": jnp.exp, "floor": jnp.floor, "ceil": jnp.ceil,
-    "min": jnp.minimum, "max": jnp.maximum, "pow": jnp.power,
+    # variadic like the builtins, elementwise like jnp (folded pairwise)
+    "min": lambda *a: _fold(jnp.minimum, a),
+    "max": lambda *a: _fold(jnp.maximum, a),
+    "pow": jnp.power,
     "sigmoid": lambda x, k=1.0, a=1.0: x ** a / (x ** a + k ** a),
     "saturation": lambda x, k: x / (x + k),
 }
@@ -96,14 +106,29 @@ def _eval(node: _pyast.AST, ctx: ScriptContext) -> Any:  # noqa: C901
     if isinstance(node, _pyast.UnaryOp):
         if isinstance(node.op, _pyast.USub):
             return -_eval(node.operand, ctx)
+        if isinstance(node.op, _pyast.Not):
+            return jnp.logical_not(_eval(node.operand, ctx))
         raise QueryParsingError("unary operator not allowed in script")
     if isinstance(node, _pyast.Compare):
-        if len(node.ops) != 1:
-            raise QueryParsingError("chained comparisons not allowed")
-        op = _ALLOWED_CMPOPS.get(type(node.ops[0]))
-        if op is None:
-            raise QueryParsingError("comparison not allowed in script")
-        return op(_eval(node.left, ctx), _eval(node.comparators[0], ctx))
+        left = _eval(node.left, ctx)
+        result = None
+        for cmp_op, comp in zip(node.ops, node.comparators):
+            op = _ALLOWED_CMPOPS.get(type(cmp_op))
+            if op is None:
+                raise QueryParsingError("comparison not allowed in script")
+            right = _eval(comp, ctx)
+            piece = op(left, right)
+            result = piece if result is None else \
+                jnp.logical_and(result, piece)
+            left = right
+        return result
+    if isinstance(node, _pyast.BoolOp):
+        fold = jnp.logical_and if isinstance(node.op, _pyast.And) \
+            else jnp.logical_or
+        out = _eval(node.values[0], ctx)
+        for v in node.values[1:]:
+            out = fold(out, _eval(v, ctx))
+        return out
     if isinstance(node, _pyast.IfExp):
         cond = _eval(node.test, ctx)
         return jnp.where(cond, _eval(node.body, ctx), _eval(node.orelse, ctx))

@@ -1,0 +1,134 @@
+"""Tribe node — a federated read view over multiple clusters.
+
+Reference: core/tribe/TribeService.java — the tribe node runs one inner
+client node per configured tribe, merges every member cluster's state
+into its own (indices tagged with their tribe name), and serves reads by
+routing each index to the cluster that owns it; writes to tribe-managed
+indices are rejected (the tribe's master is a local no-op).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuError, IndexNotFoundError)
+
+
+class TribeWriteError(ElasticsearchTpuError):
+    status = 400
+    error_type = "illegal_argument_exception"
+
+
+class TribeService:
+    def __init__(self, node, members: dict):
+        """`members`: {tribe_name: hub | (hub, cluster_name)} — the
+        member cluster's transport hub and its cluster.name (the
+        reference's tribe.<name>.cluster.name setting). One inner CLIENT
+        node (no data, no master) joins each member cluster; on conflicts
+        the FIRST tribe to publish an index name wins (the reference's
+        `tribe.on_conflict: any` default)."""
+        from elasticsearch_tpu.node import Node
+        self.node = node
+        self.members: dict[str, Node] = {}
+        self._index_owner: dict[str, str] = {}
+        self._lock = threading.Lock()
+        for name, spec in members.items():
+            hub, cluster_name = spec if isinstance(spec, tuple) \
+                else (spec, "elasticsearch-tpu")
+            inner = Node({"node.data": "false", "node.master": "false",
+                          "cluster.name": cluster_name,
+                          "node.name": f"{node.node_name}/{name}"},
+                         data_path=node.data_path / "tribe" / name,
+                         transport_hub=hub)
+            inner.start()
+            self.members[name] = inner
+            inner.cluster_service.add_listener(
+                lambda old, new, _t=name: self._member_changed(_t, new))
+            self._member_changed(name, inner.cluster_service.state())
+
+    # ---- merged view -------------------------------------------------------
+
+    def _member_changed(self, tribe: str, state) -> None:
+        with self._lock:
+            for idx in state.indices:
+                self._index_owner.setdefault(idx, tribe)
+            # drop indices the owning tribe no longer has
+            for idx in [i for i, t in self._index_owner.items()
+                        if t == tribe and i not in state.indices]:
+                del self._index_owner[idx]
+
+    def merged_indices(self) -> dict:
+        """{index: {tribe, metadata}} across members."""
+        out = {}
+        with self._lock:
+            owners = dict(self._index_owner)
+        for idx, tribe in owners.items():
+            meta = self.members[tribe].cluster_service.state() \
+                .indices.get(idx)
+            if meta is not None:
+                out[idx] = {"tribe": tribe, "meta": meta}
+        return out
+
+    def owner_of(self, index: str):
+        with self._lock:
+            tribe = self._index_owner.get(index)
+        if tribe is None:
+            raise IndexNotFoundError(index)
+        return self.members[tribe]
+
+    # ---- federated reads ---------------------------------------------------
+
+    def search(self, index_expr: str, body: dict | None = None) -> dict:
+        """Scatter the search to every owning member cluster and merge
+        hits by score (the tribe coordinator reduce)."""
+        merged = self.merged_indices()
+        import fnmatch
+        targets: dict[str, list[str]] = {}
+        parts = (index_expr or "_all").split(",")
+        for idx, info in merged.items():
+            if any(p in ("_all", "*") or fnmatch.fnmatch(idx, p)
+                   or p == idx for p in parts):
+                targets.setdefault(info["tribe"], []).append(idx)
+        if not targets:
+            raise IndexNotFoundError(index_expr)
+        if len(targets) == 1:
+            ((t, idxs),) = targets.items()
+            return self.members[t].search(",".join(idxs), dict(body or {}))
+        # cross-cluster pagination: every member returns its global-window
+        # candidates (from=0, size=from+size); the offset applies AFTER
+        # the merged sort (the same window discipline as the shard-level
+        # SearchPhaseController.sortDocs)
+        from_ = int((body or {}).get("from", 0))
+        size = int((body or {}).get("size", 10))
+        member_body = {**(body or {}), "from": 0, "size": from_ + size}
+        responses = [
+            self.members[t].search(",".join(idxs), member_body)
+            for t, idxs in sorted(targets.items())]
+        hits = [h for r in responses for h in r["hits"]["hits"]]
+        hits.sort(key=lambda h: -(h.get("_score") or 0.0))
+        hits = hits[from_:from_ + size]
+        total = sum(r["hits"]["total"]["value"] for r in responses)
+        return {
+            "took": max(r.get("took", 0) for r in responses),
+            "timed_out": any(r.get("timed_out") for r in responses),
+            "_shards": {
+                "total": sum(r["_shards"]["total"] for r in responses),
+                "successful": sum(r["_shards"]["successful"]
+                                  for r in responses),
+                "failed": sum(r["_shards"].get("failed", 0)
+                              for r in responses)},
+            "hits": {"total": {"value": total, "relation": "eq"},
+                     "max_score": hits[0]["_score"] if hits else None,
+                     "hits": hits}}
+
+    def get_doc(self, index: str, doc_id: str, **kw) -> dict:
+        return self.owner_of(index).get_doc(index, doc_id, **kw)
+
+    def write_blocked(self, index: str) -> None:
+        raise TribeWriteError(
+            f"tribe node cannot write to tribe-managed index [{index}]")
+
+    def close(self) -> None:
+        for inner in self.members.values():
+            inner.close()

@@ -1,0 +1,78 @@
+"""ResourceWatcherService — config/scripts directory hot-reload.
+
+Reference: core/watcher/ResourceWatcherService.java + the ScriptService
+file-script watcher (core/script/ScriptService.java ScriptChangesListener):
+files under the scripts path register as file scripts named by filename,
+with the language taken from the extension; edits and deletions apply at
+the next poll tick.
+"""
+
+from __future__ import annotations
+
+import threading
+from pathlib import Path
+
+#: extension → script lang (the reference maps per ScriptEngineService
+#: registered extensions)
+EXT_LANGS = {".mustache": "mustache", ".expression": "expression",
+             ".expr": "expression", ".painless": "expression"}
+
+
+class ResourceWatcherService:
+    def __init__(self, scripts_path: Path, interval_s: float = 5.0):
+        self.scripts_path = Path(scripts_path)
+        self.interval_s = interval_s
+        # (lang, name) → source
+        self.file_scripts: dict[tuple[str, str], str] = {}
+        self._lock = threading.Lock()
+        self._timer: threading.Timer | None = None
+        self._stopped = False
+        self.poll_once()
+
+    def start(self) -> "ResourceWatcherService":
+        self._schedule()
+        return self
+
+    def _schedule(self) -> None:
+        if self._stopped:
+            return
+        t = threading.Timer(self.interval_s, self._tick)
+        t.daemon = True
+        self._timer = t
+        t.start()
+
+    def _tick(self) -> None:
+        try:
+            self.poll_once()
+        except Exception:                # noqa: BLE001 — keep polling
+            pass
+        self._schedule()
+
+    def poll_once(self) -> None:
+        """One scan: register new/changed files, drop removed ones."""
+        scripts: dict[tuple[str, str], str] = {}
+        if self.scripts_path.is_dir():
+            for f in sorted(self.scripts_path.iterdir()):
+                lang = EXT_LANGS.get(f.suffix)
+                if lang is None:
+                    continue
+                try:
+                    scripts[(lang, f.stem)] = f.read_text()
+                except OSError:
+                    continue                     # raced a delete
+        with self._lock:
+            self.file_scripts = scripts
+
+    def get(self, name: str, lang: str | None = None) -> str | None:
+        with self._lock:
+            if lang is not None:
+                return self.file_scripts.get((lang, name))
+            for (_lang, n), src in self.file_scripts.items():
+                if n == name:
+                    return src
+        return None
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._timer is not None:
+            self._timer.cancel()

@@ -157,3 +157,14 @@ class TestPipelineBreadth:
         out2 = agg(node, b2)
         assert [bk["p"]["value"] for bk in out2["h"]["buckets"]] == \
             [20.0, 30.0]
+
+
+def test_bucket_selector_boolean_script(node):
+    out = agg(node, {"h": {"histogram": {"field": "price", "interval": 10},
+                           "aggs": {
+        "p": {"sum": {"field": "price"}},
+        "keep": {"bucket_selector": {
+            "buckets_path": {"v": "p", "c": "_count"},
+            "script": "v > 5 and c >= 1"}}}}})
+    assert [b["p"]["value"] for b in out["h"]["buckets"]] == \
+        [10.0, 20.0, 30.0]

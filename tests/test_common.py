@@ -90,3 +90,42 @@ class TestMurmur3:
         assert murmur3_hash32("doc-1") % 5 == murmur3_hash32("doc-1") % 5
         shards = {murmur3_hash32(f"doc-{i}") % 8 for i in range(100)}
         assert len(shards) == 8  # spreads across shards
+
+
+class TestXContent:
+    def test_cbor_roundtrip(self):
+        from elasticsearch_tpu.common.xcontent import (_cbor_encode,
+                                                       decode)
+        doc = {"a": 1, "b": [1.5, "x", None, True],
+               "nested": {"k": -42, "big": 1 << 40}}
+        assert decode(_cbor_encode(doc), "application/cbor") == doc
+
+    def test_yaml_sniff_and_decode(self):
+        from elasticsearch_tpu.common.xcontent import decode, sniff_type
+        body = b"---\nquery:\n  match_all: {}\n"
+        assert sniff_type(None, body) == "application/yaml"
+        assert decode(body) == {"query": {"match_all": {}}}
+
+    def test_smile_rejected(self):
+        import pytest
+        from elasticsearch_tpu.common.errors import IllegalArgumentError
+        from elasticsearch_tpu.common.xcontent import decode
+        with pytest.raises(IllegalArgumentError):
+            decode(b":)\n\x01payload", None)
+
+
+class TestResourceWatcher:
+    def test_file_scripts_reload(self, tmp_path):
+        from elasticsearch_tpu.watcher import ResourceWatcherService
+        d = tmp_path / "scripts"
+        d.mkdir()
+        (d / "greet.mustache").write_text('{"query": {"match": '
+                                          '{"f": "{{v}}"}}}')
+        w = ResourceWatcherService(d, interval_s=60)
+        assert w.get("greet", "mustache").startswith('{"query"')
+        (d / "rank.expression").write_text("doc['r'].value * 2")
+        (d / "greet.mustache").unlink()
+        w.poll_once()
+        assert w.get("greet", "mustache") is None
+        assert w.get("rank", "expression") == "doc['r'].value * 2"
+        w.stop()

@@ -147,3 +147,49 @@ class TestMerge:
         assert view.num_docs == 3
         assert view.segments[0].num_docs == 3  # deletes physically gone
         assert engine.get("2").found
+
+
+class TestShadowEngine:
+    """ShadowEngine (ref: core/index/engine/ShadowEngine.java): read-only
+    over a shared filesystem; refresh_from_disk re-opens the primary's
+    commits."""
+
+    def test_shadow_reads_primary_commits(self, tmp_path):
+        from elasticsearch_tpu.index.engine import Engine, ShadowEngine
+        from elasticsearch_tpu.common.errors import EngineClosedError
+        from elasticsearch_tpu.mapping import MapperService
+        import pytest
+        ms = MapperService()
+        primary = Engine(tmp_path / "shard", ms)
+        primary.index("1", {"msg": "hello shadow"})
+        primary.flush()
+        shadow = ShadowEngine(tmp_path / "shard", MapperService())
+        r = shadow.get("1")
+        assert r.found and r.source["msg"] == "hello shadow"
+        with pytest.raises(EngineClosedError):
+            shadow.index("2", {"msg": "nope"})
+        # primary writes + flushes; the shadow sees it after re-open
+        primary.index("2", {"msg": "second"})
+        primary.flush()
+        shadow.refresh_from_disk()
+        assert shadow.get("2").found
+        shadow.close()
+        primary.close()
+
+    def test_shadow_commits_only_and_flush_safe(self, tmp_path):
+        """The shadow must not see uncommitted ops, must not hold/roll the
+        primary's translog, and flush must be a no-op (data-loss guard)."""
+        from elasticsearch_tpu.index.engine import Engine, ShadowEngine
+        from elasticsearch_tpu.mapping import MapperService
+        p = Engine(tmp_path / "s", MapperService())
+        p.index("1", {"a": 1})
+        p.flush()
+        p.index("2", {"a": 2})               # uncommitted (translog only)
+        shadow = ShadowEngine(tmp_path / "s", MapperService())
+        assert not shadow.get("2").found     # commits-only visibility
+        assert shadow.flush() is None        # must not touch the commit
+        shadow.close()
+        p.close()
+        reopened = Engine(tmp_path / "s", MapperService())
+        assert reopened.get("2").found       # primary's WAL intact
+        reopened.close()

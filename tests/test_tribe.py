@@ -1,0 +1,59 @@
+"""Tribe node federation (ref: core/tribe/TribeService.java): one inner
+client node per member cluster, merged index view, federated reads,
+write rejection."""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.transport.local import LocalTransportHub
+from elasticsearch_tpu.tribe import TribeService, TribeWriteError
+
+
+@pytest.fixture()
+def clusters(tmp_path):
+    hub1, hub2 = LocalTransportHub(), LocalTransportHub()
+    n1 = Node({"cluster.name": "c1"}, data_path=tmp_path / "c1",
+              transport_hub=hub1).start()
+    n2 = Node({"cluster.name": "c2"}, data_path=tmp_path / "c2",
+              transport_hub=hub2).start()
+    n1.indices_service.create_index("logs", {"settings":
+                                             {"number_of_shards": 1}})
+    n2.indices_service.create_index("metrics", {"settings":
+                                                {"number_of_shards": 1}})
+    n1.index_doc("logs", "1", {"msg": "quick brown fox"})
+    n2.index_doc("metrics", "1", {"msg": "lazy brown dog"})
+    n1.indices_service.index("logs").refresh()
+    n2.indices_service.index("metrics").refresh()
+    tribe_node = Node({"node.name": "tribe"},
+                      data_path=tmp_path / "tribe").start()
+    tribe = TribeService(tribe_node, {"t1": (hub1, "c1"),
+                                  "t2": (hub2, "c2")})
+    try:
+        yield tribe
+    finally:
+        tribe.close()
+        tribe_node.close()
+        n1.close()
+        n2.close()
+
+
+def test_merged_view_and_federated_search(clusters):
+    tribe = clusters
+    merged = tribe.merged_indices()
+    assert set(merged) == {"logs", "metrics"}
+    assert merged["logs"]["tribe"] == "t1"
+    out = tribe.search("_all", {"query": {"match": {"msg": "brown"}}})
+    assert out["hits"]["total"]["value"] == 2
+    assert {h["_index"] for h in out["hits"]["hits"]} == \
+        {"logs", "metrics"}
+    # single-cluster expression routes to the owner only
+    out = tribe.search("logs", {"query": {"match_all": {}}})
+    assert out["hits"]["total"]["value"] == 1
+
+
+def test_reads_and_write_block(clusters):
+    tribe = clusters
+    got = tribe.get_doc("metrics", "1")
+    assert got["found"] and got["_source"]["msg"] == "lazy brown dog"
+    with pytest.raises(TribeWriteError):
+        tribe.write_blocked("logs")
