@@ -612,11 +612,15 @@ def main() -> int:
                 for tx in texts[:batch * 4]]
             bs5 = [reqs5[i:i + batch] for i in range(0, len(reqs5), batch)]
 
+            shard_pool = ThreadPoolExecutor(n_shards)
+
             def run_batch5(breqs):
-                # scatter: one fused program per shard; device→host of
-                # the per-shard top-k only (k5 ids+scores per query)
-                per_shard_res = [s5.query_phase_batch(breqs)
-                                 for s5 in searchers5]
+                # scatter: one fused program per shard, dispatched
+                # CONCURRENTLY — the device serializes the programs but
+                # the per-shard top-k fetch round trips overlap (the node
+                # fans shard requests out in parallel the same way)
+                per_shard_res = list(shard_pool.map(
+                    lambda s5: s5.query_phase_batch(breqs), searchers5))
                 # gather + reduce: cross-shard merged top-k, then the
                 # from/size page slice (sortDocs + pagination)
                 out_pages = []

@@ -42,10 +42,9 @@ def sniff_type(content_type: str | None, body: bytes) -> str:
         return SMILE
     if body[:3] == b"---":
         return YAML
-    if body[:1] and (body[0] >> 5) in (4, 5) and body[:1] != b"[" \
-            and body[:1] != b"{":
-        # CBOR major type 4 (array) / 5 (map) leading byte; printable
-        # JSON never starts with those ranges
+    if body[:1] and (body[0] >> 5) in (4, 5):
+        # CBOR major type 4 (array) / 5 (map) leading byte (0x80-0xBF) —
+        # outside printable ASCII, so JSON never starts there
         return CBOR
     return JSON
 
@@ -55,7 +54,12 @@ def decode(body: bytes, content_type: str | None = None) -> Any:
     if t == JSON:
         return json.loads(body)
     if t == YAML:
-        import yaml
+        try:
+            import yaml
+        except ImportError:
+            raise IllegalArgumentError(
+                "YAML content requires PyYAML, which is not installed"
+            ) from None
         return yaml.safe_load(body.decode("utf-8"))
     if t == CBOR:
         value, offset = _cbor_decode(body, 0)

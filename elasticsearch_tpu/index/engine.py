@@ -986,6 +986,12 @@ class ShadowEngine(Engine):
     def force_merge(self, *a, **kw):
         raise EngineClosedError("shadow engine does not merge")
 
+    def _maybe_merge(self, *a, **kw):
+        # a shadow merging would rewrite — and then DELETE — segment
+        # directories the PRIMARY's commit still references on the shared
+        # filesystem; merging is the primary's job alone
+        return None
+
     def synced_flush(self, *a, **kw):
         return None
 

@@ -53,7 +53,14 @@ class RestServer:
                     # bare `?pretty` means true (param_as_bool semantics)
                     pretty = (qs.get("pretty") or ["false"])[0] \
                         in ("", "true", "1")
-                    data, ctype = encode(payload, accept, pretty=pretty)
+                    try:
+                        data, ctype = encode(payload, accept,
+                                             pretty=pretty)
+                    except Exception:   # noqa: BLE001 — never drop the
+                        # connection over a response-format failure; JSON
+                        # always renders
+                        data, ctype = (json.dumps(payload).encode(),
+                                       "application/json")
                     ctype += "; charset=UTF-8"
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)

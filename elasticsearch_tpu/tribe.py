@@ -106,7 +106,31 @@ class TribeService:
             self.members[t].search(",".join(idxs), member_body)
             for t, idxs in sorted(targets.items())]
         hits = [h for r in responses for h in r["hits"]["hits"]]
-        hits.sort(key=lambda h: -(h.get("_score") or 0.0))
+        sort_spec = (body or {}).get("sort")
+        if sort_spec and any(h.get("sort") is not None for h in hits):
+            # field sort: merge by the members' sort keys, honouring each
+            # field's order (the coordinator reduce over sort values)
+            specs = sort_spec if isinstance(sort_spec, list) else [sort_spec]
+            descs = []
+            for sp in specs:
+                if isinstance(sp, dict):
+                    (fname, opts), = sp.items()
+                    order = opts.get("order", "asc") \
+                        if isinstance(opts, dict) else opts
+                else:
+                    fname, order = sp, ("desc" if sp == "_score" else "asc")
+                descs.append(str(order) == "desc")
+
+            def key(h):
+                vals = h.get("sort") or []
+                return tuple((-v if d and isinstance(v, (int, float))
+                              else v)
+                             for v, d in zip(vals, descs))
+            hits.sort(key=key)
+        else:
+            hits.sort(key=lambda h: -(h.get("_score") or 0.0))
+        max_score = max((h.get("_score") or 0.0 for h in hits),
+                        default=None) if hits else None
         hits = hits[from_:from_ + size]
         total = sum(r["hits"]["total"]["value"] for r in responses)
         return {
@@ -119,7 +143,7 @@ class TribeService:
                 "failed": sum(r["_shards"].get("failed", 0)
                               for r in responses)},
             "hits": {"total": {"value": total, "relation": "eq"},
-                     "max_score": hits[0]["_score"] if hits else None,
+                     "max_score": max_score,
                      "hits": hits}}
 
     def get_doc(self, index: str, doc_id: str, **kw) -> dict:
