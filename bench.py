@@ -655,6 +655,7 @@ def main() -> int:
                 "shards": n_shards, "from": from5}
             log(f"[bench] config 8shard_qtf_top1000: "
                 f"{configs['8shard_qtf_top1000']['qps']} QPS")
+            shard_pool.shutdown(wait=False)
             for e5 in engines5:
                 e5.close()
 

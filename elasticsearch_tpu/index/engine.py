@@ -691,6 +691,23 @@ class Engine:
             os.replace(tmp, commit_file)
             return sync_id
 
+    def buffer_memory_bytes(self) -> int:
+        """Rough RAM footprint of the uncommitted write buffer — the
+        figure the IndexingMemoryController budget governs (the analog of
+        Lucene's DocumentsWriter RAM accounting)."""
+        with self._lock:
+            total = 0
+            for doc in self._buffer.docs:
+                if doc is None:
+                    continue
+                total += 256                      # per-doc fixed overhead
+                for pf in doc.fields.values():
+                    total += 16 * len(pf.tokens) + 24 * len(pf.keywords) \
+                        + 8 * len(pf.numerics)
+                    if pf.vector is not None:
+                        total += pf.vector.nbytes
+            return total
+
     def expired_docs(self, now_ms: int) -> list[str]:
         """Doc ids whose _ttl expiry passed (the IndicesTTLService sweep
         source, core/indices/ttl/IndicesTTLService.java — there a range

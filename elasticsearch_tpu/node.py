@@ -137,6 +137,15 @@ class Node:
             self.settings.get("indices.ttl.interval", "60s"), "ttl.interval")
         self._ttl_timer = None
         self._schedule_ttl_sweep()
+        # IndexingMemoryController (core/indices/memory/
+        # IndexingMemoryController.java:48): a node-wide budget for
+        # uncommitted write buffers; when the sum exceeds
+        # indices.memory.index_buffer_size, the largest buffers refresh
+        # (turning them into searchable segments frees the RAM)
+        self._index_buffer_budget = self._parse_buffer_size(
+            self.settings.get("indices.memory.index_buffer_size", "10%"))
+        self._imc_timer = None
+        self._schedule_imc()
         # file scripts hot-reload (ResourceWatcherService + the
         # ScriptService file-script listener)
         from elasticsearch_tpu.watcher import ResourceWatcherService
@@ -584,6 +593,65 @@ class Node:
                  if k not in ("path", "type")}
         return {"timestamp": ts, "total": total, "data": [entry]}
 
+    @staticmethod
+    def _parse_buffer_size(raw) -> int:
+        """'10%' of total memory, or an absolute byte size ('512mb')."""
+        s = str(raw).strip().lower()
+        if s.endswith("%"):
+            try:
+                import os as _os
+                total = _os.sysconf("SC_PHYS_PAGES") * \
+                    _os.sysconf("SC_PAGE_SIZE")
+            except (OSError, ValueError):
+                total = 1 << 32
+            return int(total * float(s[:-1]) / 100.0)
+        units = {"kb": 1 << 10, "mb": 1 << 20, "gb": 1 << 30, "b": 1}
+        for suffix, mult in units.items():
+            if s.endswith(suffix):
+                return int(float(s[: -len(suffix)]) * mult)
+        return int(float(s))
+
+    def _schedule_imc(self) -> None:
+        t = _threading.Timer(
+            self.settings.get_as_float(
+                "indices.memory.interval_s", 5.0), self._imc_tick)
+        t.daemon = True
+        self._imc_timer = t
+        t.start()
+
+    def _imc_tick(self) -> None:
+        try:
+            self.indexing_memory_check()
+        except Exception:                # noqa: BLE001 — keep governing
+            pass
+        if self._started:
+            self._schedule_imc()
+
+    def indexing_memory_check(self) -> int:
+        """One governor pass: refresh the largest write buffers until the
+        node-wide total fits the budget. → buffers refreshed."""
+        sized = []
+        for name, svc in list(self.indices_service.indices.items()):
+            for sid, engine in list(svc.engines.items()):
+                try:
+                    sized.append((engine.buffer_memory_bytes(), engine))
+                except Exception:        # noqa: BLE001 — engine closing
+                    continue
+        total = sum(b for b, _ in sized)
+        refreshed = 0
+        if total <= self._index_buffer_budget:
+            return 0
+        for nbytes, engine in sorted(sized, key=lambda x: -x[0]):
+            if total <= self._index_buffer_budget or nbytes == 0:
+                break
+            try:
+                engine.refresh()
+                refreshed += 1
+                total -= nbytes
+            except Exception:            # noqa: BLE001 — engine closing
+                continue
+        return refreshed
+
     def _schedule_ttl_sweep(self) -> None:
         t = _threading.Timer(self._ttl_interval, self._ttl_tick)
         t.daemon = True
@@ -730,6 +798,8 @@ class Node:
                 self._delayed_reroute_timer.cancel()
             if self._ttl_timer is not None:
                 self._ttl_timer.cancel()
+            if getattr(self, "_imc_timer", None) is not None:
+                self._imc_timer.cancel()
             if getattr(self, "resource_watcher", None):
                 self.resource_watcher.stop()
             self.search_actions.close()

@@ -57,9 +57,9 @@ class RestServer:
                         data, ctype = encode(payload, accept,
                                              pretty=pretty)
                     except Exception:   # noqa: BLE001 — never drop the
-                        # connection over a response-format failure; JSON
-                        # always renders
-                        data, ctype = (json.dumps(payload).encode(),
+                        # connection over a response-format failure
+                        data, ctype = (json.dumps(payload,
+                                                  default=str).encode(),
                                        "application/json")
                     ctype += "; charset=UTF-8"
                 self.send_response(status)

@@ -121,16 +121,29 @@ class TribeService:
                     fname, order = sp, ("desc" if sp == "_score" else "asc")
                 descs.append(str(order) == "desc")
 
-            def key(h):
-                vals = h.get("sort") or []
-                return tuple((-v if d and isinstance(v, (int, float))
-                              else v)
-                             for v, d in zip(vals, descs))
-            hits.sort(key=key)
+            import functools
+
+            def cmp(a, b):
+                va, vb = a.get("sort") or [], b.get("sort") or []
+                for i, desc in enumerate(descs):
+                    x = va[i] if i < len(va) else None
+                    y = vb[i] if i < len(vb) else None
+                    if x == y:
+                        continue
+                    if x is None:        # missing sorts last (ES default)
+                        return 1
+                    if y is None:
+                        return -1
+                    less = x < y
+                    return (1 if less else -1) if desc \
+                        else (-1 if less else 1)
+                return 0
+            hits.sort(key=functools.cmp_to_key(cmp))
         else:
             hits.sort(key=lambda h: -(h.get("_score") or 0.0))
-        max_score = max((h.get("_score") or 0.0 for h in hits),
-                        default=None) if hits else None
+        scores = [h["_score"] for h in hits
+                  if h.get("_score") is not None]
+        max_score = max(scores) if scores else None
         hits = hits[from_:from_ + size]
         total = sum(r["hits"]["total"]["value"] for r in responses)
         return {

@@ -193,3 +193,28 @@ class TestShadowEngine:
         reopened = Engine(tmp_path / "s", MapperService())
         assert reopened.get("2").found       # primary's WAL intact
         reopened.close()
+
+
+class TestIndexingMemoryController:
+    """Node-wide write-buffer budget (ref: core/indices/memory/
+    IndexingMemoryController.java:48): over-budget buffers refresh."""
+
+    def test_over_budget_buffers_refresh(self, tmp_path):
+        from elasticsearch_tpu.node import Node
+        n = Node({"indices.memory.index_buffer_size": "1kb"},
+                 data_path=tmp_path / "imc").start()
+        try:
+            n.indices_service.create_index(
+                "buf", {"settings": {"number_of_shards": 1}})
+            for i in range(50):
+                n.index_doc("buf", str(i), {"body": f"token{i} " * 30})
+            svc = n.indices_service.index("buf")
+            engine = svc.engines[0]
+            assert engine.buffer_memory_bytes() > 1024
+            assert n.indexing_memory_check() >= 1
+            assert engine.buffer_memory_bytes() == 0   # buffer flushed
+            # docs remain searchable after the governor refresh
+            out = n.search("buf", {"query": {"match": {"body": "token3"}}})
+            assert out["hits"]["total"]["value"] == 1
+        finally:
+            n.close()
