@@ -86,6 +86,10 @@ class IndexService:
         self.index_settings = index_settings
         self.analysis = AnalysisRegistry(index_settings)
         self.mapper_service = MapperService(self.analysis)
+        # index-default similarity (SimilarityModule: the `default` named
+        # similarity applies to fields without an explicit one)
+        self.mapper_service.default_similarity = index_settings.get(
+            "index.similarity.default.type")
         for type_name, mapping in (meta.mappings or {}).items():
             self.mapper_service.merge(type_name, mapping)
         from elasticsearch_tpu.index.slowlog import (
@@ -158,6 +162,36 @@ class IndexService:
     def refresh(self):
         for e in self.shard_engines:
             e.refresh()
+        self.run_warmers()
+
+    def run_warmers(self) -> int:
+        """Execute registered warmers against the fresh readers (ref:
+        core/index/warmer/ + IndicesWarmer — warmers run whenever a new
+        searcher opens). Here a warmer run packs the new device reader
+        and compiles/caches the warmer query's program, so the first real
+        search after a refresh hits warm caches. → warmers executed."""
+        warmers = getattr(self.meta, "warmers", None)
+        if not warmers:
+            return 0
+        from elasticsearch_tpu.index.device_reader import device_reader_for
+        from elasticsearch_tpu.search.phase import (
+            ShardSearcher, parse_search_request)
+        ran = 0
+        for sid, engine in list(self.engines.items()):
+            try:
+                searcher = ShardSearcher(sid, device_reader_for(engine),
+                                         self.mapper_service,
+                                         index_name=self.name)
+            except Exception:            # noqa: BLE001 — engine closing
+                continue
+            for spec in warmers.values():
+                try:                     # one bad warmer must not stop
+                    source = spec.get("source", spec) or {}
+                    searcher.query_phase(parse_search_request(source))
+                    ran += 1
+                except Exception:        # noqa: BLE001 — warmers must
+                    continue             # never fail a refresh
+        return ran
 
     def flush(self):
         for e in self.shard_engines:

@@ -53,3 +53,49 @@ def term_filter(uterms, qtid):
     uterms: [N, U] int32; qtid: scalar int32 (-1 = absent → all False).
     """
     return ((uterms == qtid) & (qtid >= 0)).any(axis=1)
+
+
+def classic_match(uterms, utf, doc_len, qtids, qidf, qweight):
+    """Classic TF-IDF scoring (ref: Lucene TFIDFSimilarity / the 2.x
+    "default" similarity): score_t = sqrt(tf) * idf^2 * (1/sqrt(dl)).
+    `qidf` carries the CLASSIC idf (1 + ln(N/(df+1))); same interface as
+    bm25_match."""
+    n = uterms.shape[0]
+    inv_norm = jnp.where(doc_len > 0,
+                         1.0 / jnp.sqrt(doc_len.astype(jnp.float32)), 0.0)
+    scores = jnp.zeros(n, dtype=jnp.float32)
+    nmatch = jnp.zeros(n, dtype=jnp.int32)
+    for t in range(qtids.shape[0]):
+        tid = qtids[t]
+        hit = (uterms == tid) & (tid >= 0)
+        any_hit = hit.any(axis=1)
+        tf = (utf * hit).sum(axis=1)
+        scores = scores + qweight[t] * (qidf[t] * qidf[t]) * jnp.where(
+            any_hit, jnp.sqrt(tf) * inv_norm, 0.0)
+        nmatch = nmatch + any_hit.astype(jnp.int32)
+    return scores, nmatch
+
+
+def lm_dirichlet_match(uterms, utf, doc_len, qtids, qctf_frac, qweight,
+                       mu):
+    """LM Dirichlet smoothing (ref: Lucene LMDirichletSimilarity, the
+    reference's lm_dirichlet similarity module): per matched term
+    score_t = log(1 + tf/(mu * P(t|C))) + log(mu / (dl + mu)), floored at
+    0 like Lucene. `qctf_frac` = collection term frequency / collection
+    token count per query term."""
+    n = uterms.shape[0]
+    dl = doc_len.astype(jnp.float32)
+    norm = jnp.log(mu / (dl + mu))                                    # [N]
+    scores = jnp.zeros(n, dtype=jnp.float32)
+    nmatch = jnp.zeros(n, dtype=jnp.int32)
+    for t in range(qtids.shape[0]):
+        tid = qtids[t]
+        hit = (uterms == tid) & (tid >= 0)
+        any_hit = hit.any(axis=1)
+        tf = (utf * hit).sum(axis=1)
+        term_score = jnp.log1p(tf / (mu * jnp.maximum(qctf_frac[t],
+                                                      1e-12))) + norm
+        scores = scores + qweight[t] * jnp.where(
+            any_hit, jnp.maximum(term_score, 0.0), 0.0)
+        nmatch = nmatch + any_hit.astype(jnp.int32)
+    return scores, nmatch

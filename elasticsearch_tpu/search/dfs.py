@@ -126,27 +126,45 @@ def shard_dfs(reader, mapper_service, query: q.Query) -> dict:
         text_fields.update(seg.text)
     terms = collect_terms(query, text_fields, mapper_service, reader=reader)
     df = {f"{f}{_SEP}{t}": reader.df(f, t) for f, t in terms}
+    # collection term frequencies ride along for LM-family similarities
+    # (P(t|C) must be GLOBAL under dfs_query_then_fetch, like idf)
+    import numpy as _np
+    ctf = {}
+    for f, t in terms:
+        total = 0
+        for seg in reader.segments:
+            col = seg.seg.text_fields.get(f)
+            if col is None:
+                continue
+            tid = col.tid(t)
+            if tid >= 0:
+                total += float(_np.asarray(
+                    col.utf * (col.uterms == tid)).sum())
+        ctf[f"{f}{_SEP}{t}"] = total
     fields = {}
     for f in {f for f, _ in terms}:
         st = reader.text_stats(f)
         fields[f] = [st.doc_count, st.docs_with_field, st.total_tokens]
-    return {"df": df, "fields": fields}
+    return {"df": df, "ctf": ctf, "fields": fields}
 
 
 def aggregate_dfs(shard_results: list[dict]) -> dict:
     """Coordinator reduce (aggregateDfs analog) → the wire form passed to
     every shard's query phase."""
     df: dict[str, int] = {}
+    ctf: dict[str, float] = {}
     fields: dict[str, list[int]] = {}
     for r in shard_results:
         for key, n in r.get("df", {}).items():
             df[key] = df.get(key, 0) + int(n)
+        for key, n in r.get("ctf", {}).items():
+            ctf[key] = ctf.get(key, 0.0) + float(n)
         for f, (dc, dwf, tt) in r.get("fields", {}).items():
             cur = fields.setdefault(f, [0, 0, 0])
             cur[0] += int(dc)
             cur[1] += int(dwf)
             cur[2] += int(tt)
-    return {"df": df, "fields": fields}
+    return {"df": df, "ctf": ctf, "fields": fields}
 
 
 def to_execution_stats(wire: dict | None) -> dict | None:
@@ -158,9 +176,16 @@ def to_execution_stats(wire: dict | None) -> dict | None:
     for key, n in wire.get("df", {}).items():
         f, _, t = key.partition(_SEP)
         df[(f, t)] = int(n)
+    ctf = {}
+    for key, n in wire.get("ctf", {}).items():
+        f, _, t = key.partition(_SEP)
+        ctf[(f, t)] = float(n)
     doc_count = {}
     avgdl = {}
+    total_tokens = {}
     for f, (dc, dwf, tt) in wire.get("fields", {}).items():
         doc_count[f] = int(dc)
         avgdl[f] = tt / max(dwf, 1)
-    return {"df": df, "doc_count": doc_count, "avgdl": avgdl}
+        total_tokens[f] = int(tt)
+    return {"df": df, "ctf": ctf, "doc_count": doc_count, "avgdl": avgdl,
+            "total_tokens": total_tokens}

@@ -164,6 +164,57 @@ class SegmentResolver:
             return fm.search_analyzer
         return ms.analysis.get("standard")
 
+    def _similarity_for(self, field: str) -> str:
+        """Per-field similarity module (ref: SimilarityModule — BM25 /
+        classic (the 2.x "default" TF-IDF) / lm_dirichlet), from the
+        field mapping's `similarity` or the index default."""
+        fm = self.ctx.mapper_service.field_mapper(field)
+        sim = None
+        if fm is not None:
+            sim = fm.params.get("similarity")
+        if sim is None:
+            sim = getattr(self.ctx.mapper_service, "default_similarity",
+                          None)
+        # NOTE: phrase/common/span queries score BM25 regardless — like
+        # idf, the alt similarities apply to term-frequency scoring paths
+        # (match, term-on-text, multi_match via its match subs)
+        sim = str(sim or "BM25").lower()
+        if sim in ("default", "classic", "tfidf", "tf/idf"):
+            return "classic"
+        if sim in ("lmdirichlet", "lm_dirichlet"):
+            return "lm_dirichlet"
+        return "bm25"
+
+    def _ctf_frac(self, field: str, term: str) -> float:
+        """Collection term frequency / collection tokens (LM Dirichlet's
+        P(t|C)) — from global DFS statistics when present (like idf),
+        else summed over this reader's segments and cached per reader."""
+        dfs = self.ctx.dfs_stats
+        if dfs is not None and (field, term) in dfs.get("ctf", {}):
+            total = dfs.get("total_tokens", {}).get(field, 0)
+            if total:
+                return dfs["ctf"][(field, term)] / total
+        cache = getattr(self.ctx.reader, "_ctf_cache", None)
+        if cache is None:
+            cache = self.ctx.reader.__dict__.setdefault("_ctf_cache", {})
+        key = (field, term)
+        if key in cache:
+            return cache[key]
+        ctf = 0
+        total = 0
+        for s in self.ctx.reader.segments:
+            col = s.seg.text_fields.get(field)
+            if col is None:
+                continue
+            total += int(col.total_tokens)
+            t2 = col.tid(term)
+            if t2 >= 0:
+                ctf += float(np.asarray(
+                    col.utf * (col.uterms == t2)).sum())
+        frac = ctf / total if total else 0.0
+        cache[key] = frac
+        return frac
+
     def _zeros(self) -> Emit:
         self.sig("zeros")
         return lambda em: (jnp.zeros(em.n, jnp.float32),
@@ -284,6 +335,13 @@ class SegmentResolver:
         else:
             required = 1
         n_terms = len(tids)
+        similarity = self._similarity_for(field)
+        if similarity != "bm25":
+            # reuse the (df, doc_count) per term already gathered by
+            # _match_terms — no second stats pass on the planning path
+            stats = [self._term_stats(field, t) for t in terms]
+            return self._match_alt_similarity(query, field, terms, tids,
+                                              similarity, required, stats)
         r_tids = self.c(tids, np.int32)
         r_idfs = self.c(idfs, np.float32)
         r_avgdl = self.c(self._avgdl(field), np.float32)
@@ -323,6 +381,54 @@ class SegmentResolver:
                 # where-pass is needed (boost scales 0 to 0)
                 mask = scores > 0
                 return scores * em.get(r_boost), mask
+            mask = nmatch >= em.get(r_req)
+            return jnp.where(mask, scores * em.get(r_boost), 0.0), mask
+        return emit
+
+    def _match_alt_similarity(self, query, field: str, terms: list[str],
+                              tids: list[int], similarity: str,
+                              required: int,
+                              stats: list[tuple[int, int]]) -> Emit:
+        """Non-BM25 similarity scoring for match queries (classic TF-IDF
+        and LM Dirichlet); the plan signature carries the module name so
+        differently-scored fields never share a program."""
+        self.sig("match-sim", similarity)
+        n_terms = len(tids)
+        r_tids = self.c(tids, np.int32)
+        r_req = self.c(required, np.int32)
+        r_boost = self.c(query.boost, np.float32)
+        if similarity == "classic":
+            idfs = []
+            for df, doc_count in stats:
+                idfs.append(1.0 + np.log(max(doc_count, 1)
+                                         / (df + 1.0)) if df > 0 else 0.0)
+            r_w = self.c(idfs, np.float32)
+
+            def emit(em):
+                col = em.seg.text[field]
+                scores, nmatch = lexical.classic_match(
+                    col.uterms, col.utf, col.doc_len,
+                    jnp.asarray(em.get(r_tids)),
+                    jnp.asarray(em.get(r_w)),
+                    jnp.ones(n_terms, jnp.float32))
+                mask = nmatch >= em.get(r_req)
+                return jnp.where(mask, scores * em.get(r_boost), 0.0), mask
+            return emit
+        # lm_dirichlet
+        fm = self.ctx.mapper_service.field_mapper(field)
+        mu = float((fm.params.get("similarity_mu", 2000.0))
+                   if fm is not None else 2000.0)
+        fracs = [self._ctf_frac(field, t) for t in terms]
+        r_frac = self.c(fracs, np.float32)
+        r_mu = self.c(mu, np.float32)
+
+        def emit(em):
+            col = em.seg.text[field]
+            scores, nmatch = lexical.lm_dirichlet_match(
+                col.uterms, col.utf, col.doc_len,
+                jnp.asarray(em.get(r_tids)),
+                jnp.asarray(em.get(r_frac)),
+                jnp.ones(n_terms, jnp.float32), em.get(r_mu))
             mask = nmatch >= em.get(r_req)
             return jnp.where(mask, scores * em.get(r_boost), 0.0), mask
         return emit

@@ -164,3 +164,32 @@ class TestCollectTerms:
         assert stats["doc_count"]["t"] == 15
         assert abs(stats["avgdl"]["t"] - 125 / 14) < 1e-9
         assert dfs_mod.to_execution_stats(None) is None
+
+
+def test_lm_dirichlet_dfs_cross_shard_parity(tmp_path):
+    """LM Dirichlet P(t|C) must be GLOBAL under dfs_query_then_fetch, like
+    idf — 4-shard scores equal 1-shard scores."""
+    from elasticsearch_tpu.node import Node
+    docs = ["quick brown fox", "quick quick", "lazy dog",
+            "quick fox jumps", "brown bear", "the fox"]
+    mapping = {"d": {"properties": {
+        "body": {"type": "string", "similarity": "lm_dirichlet"}}}}
+    scores = []
+    for shards, sub in ((4, "a"), (1, "b")):
+        n = Node(data_path=tmp_path / sub).start()
+        try:
+            n.indices_service.create_index(
+                "lm", {"settings": {"number_of_shards": shards},
+                       "mappings": mapping})
+            for i, b in enumerate(docs):
+                n.index_doc("lm", str(i), {"body": b},
+                            meta={"_type": "d"})
+            n.indices_service.index("lm").refresh()
+            out = n.search("lm", {"query": {"match": {
+                "body": "quick fox"}}},
+                search_type="dfs_query_then_fetch")
+            scores.append({h["_id"]: round(h["_score"], 6)
+                           for h in out["hits"]["hits"]})
+        finally:
+            n.close()
+    assert scores[0] == scores[1]

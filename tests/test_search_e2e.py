@@ -323,3 +323,44 @@ class TestScrollPointInTime:
             "pit", {"query": {"match_all": {}}})
         assert fresh["hits"]["total"]["value"] == 3
         node.search_actions.clear_scroll(sid)
+
+
+class TestSimilarityModules:
+    """Per-field similarity selection (ref: SimilarityModule — BM25 /
+    classic TF-IDF / LM Dirichlet)."""
+
+    def _index(self, node, name, similarity):
+        node.indices_service.create_index(name, {
+            "settings": {"number_of_shards": 1},
+            "mappings": {"d": {"properties": {
+                "body": {"type": "string",
+                         "similarity": similarity}}}}})
+        docs = ["the quick brown fox", "quick quick brown",
+                "lazy dog sleeps", "quick"]
+        for i, b in enumerate(docs):
+            node.index_doc(name, str(i), {"body": b}, meta={"_type": "d"})
+        node.indices_service.index(name).refresh()
+
+    def test_classic_and_lm_rank_and_score(self, node):
+        import math
+        self._index(node, "sim_classic", "classic")
+        out = node.search("sim_classic",
+                          {"query": {"match": {"body": "quick"}}})
+        hits = out["hits"]["hits"]
+        assert [h["_id"] for h in hits][:1] == ["3"]   # shortest doc wins
+        # classic: sqrt(tf) * idf^2 / sqrt(dl)
+        idf = 1.0 + math.log(4 / (3 + 1.0))
+        expect = math.sqrt(1.0) * idf * idf / math.sqrt(1.0)
+        assert hits[0]["_score"] == pytest.approx(expect, rel=1e-5)
+
+        self._index(node, "sim_lm", "lm_dirichlet")
+        out = node.search("sim_lm",
+                          {"query": {"match": {"body": "quick"}}})
+        assert out["hits"]["total"]["value"] == 3
+        assert all(h["_score"] >= 0 for h in out["hits"]["hits"])
+
+    def test_bm25_default_unchanged(self, node):
+        self._index(node, "sim_bm25", "BM25")
+        out = node.search("sim_bm25",
+                          {"query": {"match": {"body": "quick"}}})
+        assert out["hits"]["total"]["value"] == 3
