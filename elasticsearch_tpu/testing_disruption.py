@@ -1,0 +1,202 @@
+"""Reusable fault-injection schemes for in-process clusters.
+
+Reference: test/test/disruption/ — ServiceDisruptionScheme implementations
+(NetworkPartition, NetworkDisconnectPartition, NetworkUnresponsivePartition,
+NetworkDelaysPartition, BlockClusterStateProcessing,
+SlowClusterStateProcessing) applied to the InternalTestCluster. Here the
+schemes install outbound rules on each node's LocalTransport (the same
+seam MockTransportService uses in the reference), so any multi-node test
+can compose partitions/delays declaratively:
+
+    with NetworkPartition([n1], [n2, n3]).applied():
+        ...cluster behavior under partition...
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import time
+
+from elasticsearch_tpu.transport.local import DROP
+
+
+def _addr_of(node):
+    return node.transport_service.local_node.address
+
+
+class ServiceDisruptionScheme:
+    """Base: install/remove outbound rules on the affected nodes."""
+
+    def __init__(self):
+        self._saved: list[tuple] = []
+
+    def _nodes(self) -> list:
+        raise NotImplementedError
+
+    def _rule_for(self, node):
+        """→ callable(addr, action) -> DROP | delay-seconds | None, or
+        None when this node needs no rule."""
+        raise NotImplementedError
+
+    def apply(self) -> None:
+        for node in self._nodes():
+            transport = node.transport_service.transport
+            self._saved.append((transport, transport.outbound_rule))
+            prev = transport.outbound_rule
+            mine = self._rule_for(node)
+
+            def combined(addr, action, _prev=prev, _mine=mine):
+                # DROP from ANY stacked scheme wins; otherwise the
+                # longest delay applies (a partition stacked over a delay
+                # must still cut traffic)
+                verdicts = []
+                for rule in (_prev, _mine):
+                    if rule is None:
+                        continue
+                    v = rule(addr, action)
+                    if v == DROP:
+                        return DROP
+                    if v is not None:
+                        verdicts.append(v)
+                delays = [v for v in verdicts
+                          if isinstance(v, (int, float))]
+                return max(delays) if delays else None
+            transport.outbound_rule = combined
+
+    def remove(self) -> None:
+        # LIFO: overlapping schemes must unwind in reverse application
+        # order or a stale snapshot clobbers a newer one
+        for transport, prev in reversed(self._saved):
+            transport.outbound_rule = prev
+        self._saved.clear()
+
+    # the reference's ServiceDisruptionScheme verb pair
+    start_disrupting = apply
+    stop_disrupting = remove
+
+    @contextlib.contextmanager
+    def applied(self):
+        self.apply()
+        try:
+            yield self
+        finally:
+            self.remove()
+
+
+class NetworkPartition(ServiceDisruptionScheme):
+    """Two-sided partition: traffic between side A and side B is cut in
+    BOTH directions (NetworkDisconnectPartition semantics — requests fail
+    as dropped; our transport surfaces that as a timeout/connect error,
+    covering the Unresponsive variant too)."""
+
+    def __init__(self, side_a: list, side_b: list):
+        super().__init__()
+        self.side_a = list(side_a)
+        self.side_b = list(side_b)
+
+    def _nodes(self) -> list:
+        return self.side_a + self.side_b
+
+    def _rule_for(self, node):
+        other = self.side_b if node in self.side_a else self.side_a
+        cut = {_addr_of(n) for n in other}
+
+        def rule(addr, action):
+            return DROP if addr in cut else None
+        return rule
+
+
+# the reference ships disconnect and unresponsive as separate schemes;
+# over LocalTransport both manifest as dropped frames
+NetworkDisconnectPartition = NetworkPartition
+NetworkUnresponsivePartition = NetworkPartition
+
+
+class NetworkDelaysPartition(ServiceDisruptionScheme):
+    """Cross-side traffic is DELAYED by a random interval in
+    [min_delay, max_delay] seconds (NetworkDelaysPartition)."""
+
+    def __init__(self, side_a: list, side_b: list,
+                 min_delay: float = 0.1, max_delay: float = 0.5,
+                 seed: int | None = None):
+        super().__init__()
+        self.side_a = list(side_a)
+        self.side_b = list(side_b)
+        self.min_delay = min_delay
+        self.max_delay = max_delay
+        self._rng = random.Random(seed)
+
+    def _nodes(self) -> list:
+        return self.side_a + self.side_b
+
+    def _rule_for(self, node):
+        other = self.side_b if node in self.side_a else self.side_a
+        slow = {_addr_of(n) for n in other}
+
+        def rule(addr, action):
+            if addr in slow:
+                return self._rng.uniform(self.min_delay, self.max_delay)
+            return None
+        return rule
+
+
+class IsolateNode(NetworkPartition):
+    """Cut one node off from the rest (the reference's common
+    one-node-vs-majority construction)."""
+
+    def __init__(self, node, rest: list):
+        super().__init__([node], list(rest))
+
+
+class BlockClusterStateProcessing(ServiceDisruptionScheme):
+    """Drop cluster-state publish traffic TO one node — it keeps serving
+    with a stale view (BlockClusterStateProcessing)."""
+
+    PUBLISH_PREFIX = "internal:discovery/zen/publish"
+
+    def __init__(self, blocked_node, publishers: list):
+        super().__init__()
+        self.blocked = blocked_node
+        self.publishers = list(publishers)
+
+    def _nodes(self) -> list:
+        return self.publishers
+
+    def _rule_for(self, node):
+        target = _addr_of(self.blocked)
+
+        def rule(addr, action):
+            if addr == target and action.startswith(self.PUBLISH_PREFIX):
+                return DROP
+            return None
+        return rule
+
+
+class SlowClusterStateProcessing(BlockClusterStateProcessing):
+    """Delay (not drop) state publishes to one node
+    (SlowClusterStateProcessing)."""
+
+    def __init__(self, slow_node, publishers: list, delay_s: float = 0.5):
+        super().__init__(slow_node, publishers)
+        self.delay_s = delay_s
+
+    def _rule_for(self, node):
+        target = _addr_of(self.blocked)
+
+        def rule(addr, action):
+            if addr == target and action.startswith(self.PUBLISH_PREFIX):
+                return self.delay_s
+            return None
+        return rule
+
+
+def wait_until(predicate, timeout: float = 10.0,
+               interval: float = 0.05) -> bool:
+    """Poll helper for disruption tests (assertBusy analog)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()

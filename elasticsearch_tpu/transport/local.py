@@ -84,32 +84,35 @@ class LocalTransport:
 
     def send_request(self, node: DiscoveryNode, request_id: int, action: str,
                      payload: bytes) -> None:
-        target = self._ruled_lookup(node.address, action)
+        target, delay = self._ruled_lookup(node.address, action)
         if target is None:
             return                              # dropped by disruption rule
         version = min(self._service.local_node.version, node.version)
         source = self._service.local_node
         target._deliver(
             lambda: target._service.on_request(source, request_id, action,
-                                               payload, version))
+                                               payload, version),
+            delay=delay)
 
     def send_response(self, node: DiscoveryNode, request_id: int,
                       payload: bytes | None, error) -> None:
         # Responses ride the same disruption rules (a partition cuts both
         # directions; NetworkPartition.java severs request and response).
-        target = self._ruled_lookup(node.address, "<response>",
-                                    raise_on_missing=False)
+        target, delay = self._ruled_lookup(node.address, "<response>",
+                                           raise_on_missing=False)
         if target is None:
             return
         version = min(self._service.local_node.version, node.version)
         target._deliver(
             lambda: target._service.on_response(request_id, payload, error,
-                                                version))
+                                                version),
+            delay=delay)
 
     # ---- internals ---------------------------------------------------------
 
     def _ruled_lookup(self, addr: TransportAddress, action: str,
                       raise_on_missing: bool = True):
+        """→ (target transport | None, delay seconds | None)."""
         if self._closed:
             raise ConnectTransportError("transport closed")
         rule = self.outbound_rule
@@ -117,29 +120,27 @@ class LocalTransport:
         if rule is not None:
             verdict = rule(addr, action)
             if verdict == DROP:
-                return None
+                return None, None
             if isinstance(verdict, (int, float)) and verdict > 0:
                 delay = float(verdict)
         target = self.hub.lookup(addr)
         if target is None or target._closed:
             if raise_on_missing:
                 raise ConnectTransportError(f"no node at {addr}")
-            return None
-        if delay:
-            timer = threading.Timer(delay, lambda: None)
-            # Delayed delivery: re-dispatch after the timer fires.
-            real_target = target
+            return None, None
+        return target, delay
 
-            class _Delayed:
-                def _deliver(self, fn):
-                    t = threading.Timer(delay, real_target._deliver, (fn,))
-                    t.daemon = True
-                    t.start()
-            return _Delayed()
-        return target
-
-    def _deliver(self, fn) -> None:
+    def _deliver(self, fn, delay: float | None = None) -> None:
+        """Run `fn` on this node's worker pool; with `delay`, schedule the
+        dispatch after the timer fires (NetworkDelays disruption). The
+        delay lives HERE so no wrapper object has to mirror transport
+        attributes for deferred sends."""
         if self._closed:
+            return
+        if delay:
+            t = threading.Timer(delay, self._deliver, (fn,))
+            t.daemon = True
+            t.start()
             return
         try:
             self._pool.submit(fn)
