@@ -265,6 +265,17 @@ def device_reader_for(engine, view: SearcherView | None = None,
                                 f"segments gen {view.generation}")
             else:
                 fd.release(old_bytes - new_bytes)
+        if cached is not None:
+            # the retiring generation's filter-cache counters fold into a
+            # cumulative per-engine tally — ES cache stats survive reader
+            # swaps (IndicesQueryCache counts per shard, not per reader)
+            old_stats = getattr(cached, "_filter_cache_stats", None)
+            if old_stats:
+                carry = engine.__dict__.setdefault(
+                    "_filter_cache_carry",
+                    {"hit_count": 0, "miss_count": 0, "evictions": 0})
+                for k in carry:
+                    carry[k] += old_stats.get(k, 0)
         cached = DeviceReader(view, device=device)
         cached._accounted_bytes = new_bytes if bs is not None else 0
         engine._device_reader_cache = cached

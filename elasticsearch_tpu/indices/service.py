@@ -204,6 +204,31 @@ class IndexService:
     def num_docs(self) -> int:
         return sum(e.num_docs for e in self.shard_engines)
 
+    def _query_cache_stats(self) -> dict:
+        """Filter-cache counters: the live reader's counts plus the
+        CUMULATIVE tally engines carry across refreshes (ES cache stats
+        never reset on a reader swap)."""
+        out = {"memory_size_in_bytes": 0, "evictions": 0,
+               "hit_count": 0, "miss_count": 0}
+        for e in self.shard_engines:
+            baseline = getattr(e, "_filter_cache_carry", None)
+            if baseline:
+                for k in ("hit_count", "miss_count", "evictions"):
+                    out[k] += baseline.get(k, 0)
+            reader = getattr(e, "_device_reader_cache", None)
+            if reader is None:
+                continue
+            st = getattr(reader, "_filter_cache_stats", None)
+            if st:
+                out["hit_count"] += st["hit_count"]
+                out["miss_count"] += st["miss_count"]
+                out["evictions"] += st["evictions"]
+            cache = getattr(reader, "_filter_mask_cache", None)
+            if cache:
+                out["memory_size_in_bytes"] += sum(
+                    m.nbytes for m in cache.values())
+        return out
+
     def note_search(self, groups, query_ms: float,
                     fetch_ms: float = 0.0) -> None:
         """One completed shard search (ShardSearchStats.onQueryPhase)."""
@@ -300,8 +325,7 @@ class IndexService:
             "flush": {"total": agg["flush_total"],
                       "total_time_in_millis": 0},
             "warmer": {"current": 0, "total": 0, "total_time_in_millis": 0},
-            "query_cache": {"memory_size_in_bytes": 0, "evictions": 0,
-                            "hit_count": 0, "miss_count": 0},
+            "query_cache": self._query_cache_stats(),
             "filter_cache": {"memory_size_in_bytes": 0, "evictions": 0},
             "fielddata": {"memory_size_in_bytes": mem, "evictions": 0},
             "completion": {"size_in_bytes": completion_bytes},

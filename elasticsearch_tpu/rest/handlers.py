@@ -2038,12 +2038,25 @@ class Handlers:
 
     def cache_clear(self, req: RestRequest):
         """/{index}/_cache/clear (RestClearIndicesCacheAction): drops the
-        shard request cache entries of the NAMED indices only (the only
-        node-level query cache here — device readers are not a cache,
-        they ARE the index). Coordinator-local; remote nodes' entries age
-        out by generation."""
+        shard request cache entries AND the readers' filter/query caches
+        of the NAMED indices. Coordinator-local; remote nodes' entries
+        age out by generation."""
         index = req.path_params.get("index", "_all")
         names = self.node.indices_service.resolve(index)
+        for n in names:
+            svc = self.node.indices_service.indices.get(n)
+            if svc is None:
+                continue
+            for e in svc.engines.values():
+                reader = getattr(e, "_device_reader_cache", None)
+                if reader is not None:
+                    lock = reader.__dict__.get("_filter_cache_lock")
+                    if lock is not None:
+                        with lock:
+                            reader.__dict__.pop("_filter_mask_cache",
+                                                None)
+                    else:
+                        reader.__dict__.pop("_filter_mask_cache", None)
         if index in ("_all", "*"):
             self.node.search_actions.request_cache.clear()
         else:

@@ -17,6 +17,7 @@ and runs sub-phases (source filtering, highlight, script fields analog).
 from __future__ import annotations
 
 import fnmatch
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -289,12 +290,39 @@ class ShardSearcher:
         return query
 
     def _filter_masks_np(self, query: q.Query) -> np.ndarray:
+        """Filter-context mask over the reader, memoized per (reader
+        generation, filter shape) — the Lucene filter/query cache analog
+        (ref: core/indices/cache/query/IndicesQueryCache.java:48): the
+        same filter repeated across agg requests reuses its bitset until
+        a refresh swaps the reader."""
+        rd = self.reader.__dict__
+        lock = rd.setdefault("_filter_cache_lock", threading.Lock())
+        with lock:
+            cache = rd.setdefault("_filter_mask_cache", {})
+            stats = rd.setdefault(
+                "_filter_cache_stats", {"hit_count": 0, "miss_count": 0,
+                                        "evictions": 0})
+            # key on the PRE-rewrite query: the join rewrite is the
+            # expensive part and is deterministic within a reader
+            # generation, so a hit must skip it too
+            key = repr(query)
+            hit = cache.get(key)
+            if hit is not None:
+                stats["hit_count"] += 1
+                return hit
+            stats["miss_count"] += 1
         query = self._rewrite_joins(query)   # agg filter contexts too
         masks = []
         for seg in self.reader.segments:
             ex = SegmentExecutor(seg, self.ctx)
             masks.append(np.asarray(ex.match_mask(query) & seg.live))
-        return np.concatenate(masks) if masks else np.zeros(0, bool)
+        out = np.concatenate(masks) if masks else np.zeros(0, bool)
+        with lock:
+            if len(cache) >= 256:           # bounded like the reference's
+                cache.pop(next(iter(cache)))  # LRU-ish eviction
+                stats["evictions"] += 1
+            cache[key] = out
+        return out
 
     # -- query phase ---------------------------------------------------------
 

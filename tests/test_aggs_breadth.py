@@ -168,3 +168,28 @@ def test_bucket_selector_boolean_script(node):
             "script": "v > 5 and c >= 1"}}}}})
     assert [b["p"]["value"] for b in out["h"]["buckets"]] == \
         [10.0, 20.0, 30.0]
+
+
+def test_filter_mask_cache_reuses_bitsets(node):
+    """The filter/query cache (IndicesQueryCache analog): a repeated agg
+    filter reuses its bitset within a reader generation."""
+    from elasticsearch_tpu.index.device_reader import device_reader_for
+    svc = node.indices_service.index("shop")
+    engine = svc.engines[sorted(svc.engines)[0]]
+    body = {"f": {"filter": {"term": {"name": "widget"}},
+                  "aggs": {"p": {"avg": {"field": "price"}}}}}
+    # size=1 keeps the SHARD REQUEST cache out of the way (it would
+    # answer the repeat before the filter cache is consulted)
+    search = {"size": 1, "query": {"type": {"value": "item"}},
+              "aggs": body}
+    node.search("shop", search)
+    reader = device_reader_for(engine)
+    before = dict(getattr(reader, "_filter_cache_stats",
+                          {"hit_count": 0}))
+    node.search("shop", search)
+    after = getattr(reader, "_filter_cache_stats", None)
+    assert after is not None
+    assert after["hit_count"] > before.get("hit_count", 0)
+    stats = svc.stats()["query_cache"]
+    assert stats["hit_count"] >= 1
+    assert stats["memory_size_in_bytes"] > 0
