@@ -669,7 +669,7 @@ class SearchActions:
 
     def count(self, index_expr: str, body: dict | None = None) -> dict:
         resp = self.search(index_expr, {**(body or {}), "size": 0})
-        return {"count": resp["hits"]["total"]["value"],
+        return {"count": resp["hits"]["total"],
                 "_shards": resp["_shards"]}
 
     # ---- _msearch (ref: core/action/search/TransportMultiSearchAction) ----
@@ -1071,7 +1071,7 @@ class SearchActions:
         if ctx.finished:
             resp = {"took": 0, "timed_out": False,
                     "_shards": {"total": 0, "successful": 0, "failed": 0},
-                    "hits": {"total": {"value": 0, "relation": "eq"},
+                    "hits": {"total": 0,
                              "max_score": None, "hits": []}}
             resp["_scroll_id"] = scroll_id
             return resp

@@ -100,6 +100,14 @@ class DeviceSegment:
     vector: dict[str, DeviceVectorField]
     geo: dict[str, DeviceGeoField]
     nested: dict[str, "DeviceNestedBlock"] = dc_field(default_factory=dict)
+    # device_put for LAZY columns (tokens / vecs): those stay host-side
+    # numpy until a plan declares it needs them (jit_exec.seg_flatten
+    # materializes + caches on first use). Position matrices and dense
+    # vectors dominate column bytes (~450 MB and ~3 GB at 1M docs) and a
+    # BM25 query reads neither — eager transfer would serialize the first
+    # search behind gigabytes of host→HBM traffic. None (mesh-engine
+    # templates) means "arrays are host-side by design, don't touch".
+    lazy_put: Any = None
 
     @property
     def padded_docs(self) -> int:
@@ -138,7 +146,8 @@ class DeviceReader:
         text = {}
         for name, c in seg.text_fields.items():
             text[name] = DeviceTextField(
-                tokens=put(c.tokens), uterms=put(c.uterms),
+                tokens=np.ascontiguousarray(c.tokens),    # lazy (see above)
+                uterms=put(c.uterms),
                 utf=put(c.utf), doc_len=put(c.doc_len), column=c)
         keyword = {name: DeviceKeywordField(ords=put(c.ords), column=c)
                    for name, c in seg.keyword_fields.items()}
@@ -151,8 +160,9 @@ class DeviceReader:
         for name, c in seg.vector_fields.items():
             norms = np.linalg.norm(c.vecs, axis=1, keepdims=True)
             normed = c.vecs / np.maximum(norms, 1e-12)
-            vector[name] = DeviceVectorField(vecs=put(normed.astype(np.float32)),
-                                             exists=put(c.exists), column=c)
+            vector[name] = DeviceVectorField(
+                vecs=np.ascontiguousarray(normed.astype(np.float32)),  # lazy
+                exists=put(c.exists), column=c)
         geo = {name: DeviceGeoField(lat=put(c.lat.astype(np.float32)),
                                     lon=put(c.lon.astype(np.float32)),
                                     exists=put(c.exists), column=c)
@@ -170,7 +180,8 @@ class DeviceReader:
                 parent=put(blk.parent))
         return DeviceSegment(seg=seg, live=put(live), doc_base=doc_base,
                              text=text, keyword=keyword, numeric=numeric,
-                             vector=vector, geo=geo, nested=nested)
+                             vector=vector, geo=geo, nested=nested,
+                             lazy_put=put)
 
     def _collect_stats(self, view: SearcherView) -> None:
         for seg in view.segments:

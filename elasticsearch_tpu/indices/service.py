@@ -224,9 +224,14 @@ class IndexService:
                 out["miss_count"] += st["miss_count"]
                 out["evictions"] += st["evictions"]
             cache = getattr(reader, "_filter_mask_cache", None)
-            if cache:
-                out["memory_size_in_bytes"] += sum(
-                    m.nbytes for m in cache.values())
+            lock = getattr(reader, "_filter_cache_lock", None)
+            if cache and lock is not None:
+                # snapshot under the cache's own lock — a concurrent
+                # search may insert/evict mid-iteration (_filter_masks_np
+                # always creates the lock before the cache)
+                with lock:
+                    masks = list(cache.values())
+                out["memory_size_in_bytes"] += sum(m.nbytes for m in masks)
         return out
 
     def note_search(self, groups, query_ms: float,

@@ -118,8 +118,12 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/{index}/_upgrade", h.upgrade_status)
     r("GET", "/_shard_stores", h.indices_shard_stores)
     r("GET", "/{index}/_shard_stores", h.indices_shard_stores)
-    # documents (modern _doc + ES 2.x /{index}/{type}/{id})
-    for doc_seg in ("_doc", "{type}"):
+    # documents: ES 2.x /{index}/{type}/{id} routes. "_doc" is just a type
+    # name resolved by the {type} param (RestIndexAction registers only the
+    # param form) — a literal "_doc" trie branch would shadow
+    # /{index}/{type}/_bulk and friends for type "_doc" (the literal child
+    # wins the walk before backtracking can try the param branch)
+    for doc_seg in ("{type}",):
         r("PUT", f"/{{index}}/{doc_seg}/{{id}}", h.index_doc)
         r("POST", f"/{{index}}/{doc_seg}/{{id}}", h.index_doc)
         r("POST", f"/{{index}}/{doc_seg}", h.index_doc_auto_id)
@@ -372,8 +376,10 @@ class Handlers:
         type names may not start with '_' (reference: MapperService type
         validation)."""
         t = req.path_params.get("type")
-        if t == "_all":          # ES accepts _all as a type wildcard
-            return
+        if t in ("_all", "_doc"):  # _all = type wildcard; _doc = the
+            return                 # default type (reaches here via the
+                                   # {type} route — no literal _doc branch,
+                                   # it would shadow /{index}/{type}/_bulk)
         if t is not None and t.startswith("_"):
             from elasticsearch_tpu.common.errors import IllegalArgumentError
             raise IllegalArgumentError(
@@ -1443,7 +1449,7 @@ class Handlers:
         if not self.node.indices_service.indices:
             return 200, {"took": 0, "timed_out": False,
                          "_shards": {"total": 0, "successful": 0, "failed": 0},
-                         "hits": {"total": {"value": 0, "relation": "eq"},
+                         "hits": {"total": 0,
                                   "max_score": None, "hits": []}}
         resp = self.node.search("_all", self._search_body(req),
                                 scroll=req.param("scroll"),
@@ -2078,7 +2084,7 @@ class Handlers:
         body["size"] = 0
         body["terminate_after"] = 1
         out = self.node.search(req.path_params.get("index", "_all"), body)
-        exists = out["hits"]["total"]["value"] > 0
+        exists = out["hits"]["total"] > 0
         return (200 if exists else 404), {"exists": exists}
 
     def synced_flush(self, req: RestRequest):

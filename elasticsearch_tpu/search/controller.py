@@ -110,7 +110,7 @@ def merge_shard_payloads(req: ParsedSearchRequest, payloads: list[dict],
         "timed_out": any(p.get("timed_out") for p in payloads),
         "_shards": shards,
         "hits": {
-            "total": {"value": total, "relation": "eq"},
+            "total": total,
             "max_score": max_score,
             "hits": [e[4] for e in page],
         },
@@ -153,7 +153,7 @@ def merge_responses(index_name: str, req: ParsedSearchRequest,
         "_shards": {"total": len(results), "successful": len(results),
                     "skipped": 0, "failed": 0},
         "hits": {
-            "total": {"value": total, "relation": "eq"},
+            "total": total,
             "max_score": max_score,
             "hits": hits_out,
         },

@@ -59,11 +59,21 @@ class ConstTable:
     modifiers, slop windows...) into the signature.
     """
 
-    __slots__ = ("values", "sig")
+    __slots__ = ("values", "sig", "positions_needed", "vectors_needed")
 
     def __init__(self):
         self.values: list[np.ndarray] = []
         self.sig: list = []
+        # text fields whose POSITION matrix ([N, L] tokens) the plan
+        # reads (phrase/span scoring). Everything else runs on the
+        # forward-impact columns, and jit_exec excludes untouched token
+        # matrices from the traced inputs — at 1M docs the tokens array
+        # alone made XLA compile ~14x slower for plans that never read it
+        self.positions_needed: set = set()
+        # vector fields whose [N, D] vecs the plan reads — same
+        # tree-shaking contract as positions_needed (the [N] bool exists
+        # arrays are always traced; only vecs are lazy/shaken)
+        self.vectors_needed: set = set()
 
     def add(self, v, dtype=None) -> int:
         arr = np.asarray(v, dtype=dtype)
@@ -455,6 +465,7 @@ class SegmentResolver:
         deltas = [t.position - toks[0].position for t in toks]
         slop = query.slop
         self.sig("phrase", tuple(deltas), slop)
+        self.ct.positions_needed.add(field)
         p = self.ctx.bm25
         r_tids = [self.c(t, np.int32) for t in tids]
         r_idfs = self.c(idfs, np.float32)
@@ -660,7 +671,7 @@ class SegmentResolver:
             self.sig("exists", "text", f)
             mask_emit = lambda em: em.seg.text[f].doc_len > 0  # noqa: E731
         elif f in self.seg.vector:
-            self.sig("exists", "vec", f)
+            self.sig("exists", "vec", f)   # reads only the [N] exists mask
             mask_emit = lambda em: em.seg.vector[f].exists    # noqa: E731
         elif f in self.seg.geo:
             self.sig("exists", "geo", f)
@@ -990,6 +1001,7 @@ class SegmentResolver:
             raise QueryParsingError(
                 f"field [{field}] was not indexed with positions — "
                 f"span queries need index_options [positions]")
+        self.ct.positions_needed.add(field)
         terms = [c.value for c in query.clauses]
         resolved = self._match_terms(field, terms)
         if resolved is None:
@@ -1329,6 +1341,11 @@ class SegmentResolver:
         self.sig("script", source)
         param_spec = self._feed_script_params(params)
         compiled = compile_script(source)
+        vf = compiled.vector_fields()
+        # ScriptContext.get_vector pulls vector columns at emit time; a
+        # non-literal field argument means "could be any of them"
+        self.ct.vectors_needed.update(
+            self.seg.vector if vf is None else vf)
 
         def factor_emit(em, scores):
             sparams = {k: (em.get(v) if tag == "ref" else v)
@@ -1368,6 +1385,7 @@ class SegmentResolver:
         field = query.field
         if self.seg.vector.get(field) is None:
             return self._zeros()
+        self.ct.vectors_needed.add(field)
         r_qv = self.c(query.query_vector, np.float32)
         r_boost = self.c(query.boost, np.float32)
 
@@ -1425,6 +1443,23 @@ class SegmentExecutor:
         """→ (scores [N] f32, mask [N] bool); live-mask applied by caller."""
         ct = ConstTable()
         emit = SegmentResolver(self.seg, self.ctx, ct).resolve(query)
+        # materialize any LAZY columns the plan touches (tokens / vecs stay
+        # host-side numpy until first use — device_reader.DeviceSegment
+        # .lazy_put) so the eager path doesn't re-transfer them per query
+        from elasticsearch_tpu.search import jit_exec
+
+        def materialize(seg):
+            for f in ct.positions_needed:
+                col = seg.text.get(f)
+                if col is not None:       # nested-child fields live in the
+                    jit_exec._fetch(seg, col, "tokens")   # child segment
+            for f in ct.vectors_needed:
+                col = seg.vector.get(f)
+                if col is not None:
+                    jit_exec._fetch(seg, col, "vecs")
+            for blk in seg.nested.values():
+                materialize(blk.child)
+        materialize(self.seg)
         return emit(EmitCtx(self.seg, [jnp.asarray(v) for v in ct.values]))
 
     def match_mask(self, query: q.Query):

@@ -27,6 +27,7 @@ Mechanics (see execute.SegmentResolver):
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 from dataclasses import replace as dc_replace
@@ -90,25 +91,71 @@ _ARRAYS = {
 }
 
 
-def seg_flatten(seg: DeviceSegment) -> list:
+_materialize_lock = threading.Lock()
+
+
+def _fetch(seg: DeviceSegment, col, attr: str):
+    """Read a column array, materializing LAZY host-side columns (tokens /
+    vecs) onto the reader's device on first use. The result is cached back
+    on the column object so the transfer happens once per reader
+    generation; the lock stops concurrent first-phrase-queries from
+    shipping the same hundreds of MB twice."""
+    a = getattr(col, attr)
+    if seg.lazy_put is None or not isinstance(a, np.ndarray):
+        return a
+    with _materialize_lock:
+        a = getattr(col, attr)
+        if isinstance(a, np.ndarray):
+            a = seg.lazy_put(a)
+            setattr(col, attr, a)
+    return a
+
+
+def _keep(kind: str, attr: str, name: str, positions_for, vectors_for
+          ) -> bool:
+    """Tree-shaking rule for the traced-input pytree: text position
+    matrices and vector columns are kept per-FIELD, everything else
+    always. `None` for either filter means "keep everything" (the mesh
+    engine pre-stacks segments once, before any plan exists)."""
+    if kind == "text" and attr == "tokens":
+        return positions_for is None or name in positions_for
+    if kind == "vector" and attr == "vecs":
+        return vectors_for is None or name in vectors_for
+    return True
+
+
+def seg_flatten(seg: DeviceSegment, positions_for: frozenset | None = None,
+                vectors_for: frozenset | None = None) -> list:
     """Device arrays of a segment in deterministic order (live first;
-    nested child blocks recurse after the flat kinds)."""
+    nested child blocks recurse after the flat kinds). Text position
+    matrices flatten ONLY for fields in `positions_for`, and vector/geo
+    columns only when the plan declared that kind — tracing the [N, L]
+    tokens array (or a [N, 768] vector column) no op reads multiplies
+    XLA compile time for nothing (measured ~14x at 1M docs)."""
     flat = [seg.live]
     for kind in _KINDS:
         fields = getattr(seg, kind)
         for name in sorted(fields):
             col = fields[name]
             for attr in _ARRAYS[kind]:
-                flat.append(getattr(col, attr))
+                if not _keep(kind, attr, name, positions_for, vectors_for):
+                    continue
+                flat.append(_fetch(seg, col, attr))
     for path in sorted(seg.nested):
         blk = seg.nested[path]
         flat.append(blk.parent)
-        flat.extend(seg_flatten(blk.child))
+        flat.extend(seg_flatten(blk.child, positions_for, vectors_for))
     return flat
 
 
-def seg_rebuild(seg: DeviceSegment, flat: list) -> DeviceSegment:
-    """Shallow-copy `seg` with arrays swapped for (traced) `flat`."""
+def seg_rebuild(seg: DeviceSegment, flat: list,
+                positions_for: frozenset | None = None,
+                vectors_for: frozenset | None = None) -> DeviceSegment:
+    """Shallow-copy `seg` with arrays swapped for (traced) `flat`. Arrays
+    excluded from the flatten become None — a plan reading data it never
+    declared fails loudly at trace time (and falls back to eager) instead
+    of silently baking a device buffer into the compiled program as a
+    constant."""
     it = iter(flat)
 
     def rebuild(s: DeviceSegment) -> DeviceSegment:
@@ -122,7 +169,11 @@ def seg_rebuild(seg: DeviceSegment, flat: list) -> DeviceSegment:
             # and the emitted structure depends on it
             rebuilt = {
                 name: dc_replace(fields[name],
-                                 **{attr: next(it)
+                                 **{attr: (next(it)
+                                           if _keep(kind, attr, name,
+                                                    positions_for,
+                                                    vectors_for)
+                                           else None)
                                     for attr in _ARRAYS[kind]})
                 for name in sorted(fields)}
             kinds[kind] = {name: rebuilt[name] for name in fields}
@@ -256,15 +307,17 @@ def run_segment(seg: DeviceSegment, ctx: ExecutionContext, query,
     ct, emit_q, emit_pf, refs = _plan(seg, ctx, query, post_filter, flags)
     consts = [jnp.asarray(v) for v in ct.values]
 
-    key = (ct.signature(), layout_key(seg),
+    pos_for = frozenset(ct.positions_needed)
+    vecs = frozenset(ct.vectors_needed)
+    key = (ct.signature(), layout_key(seg), pos_for, vecs,
            float(ctx.bm25.k1), float(ctx.bm25.b),
            flags["min_score"], flags["search_after"], k_static, want_arrays,
            post_filter is not None)
-    flat = seg_flatten(seg)
+    flat = seg_flatten(seg, pos_for, vecs)
 
     def compile_fn():
         def run(flat_in, consts_in):
-            view = seg_rebuild(seg, flat_in)
+            view = seg_rebuild(seg, flat_in, pos_for, vecs)
             return _build(view, consts_in, emit_q, emit_pf, refs, flags,
                           k_static)
         # AOT lower+compile and cache ONLY the executable: a cached
@@ -315,11 +368,15 @@ def run_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,
     k_static = int(k)
     sig0 = None
     emit0 = refs0 = None
+    pos_for: frozenset = frozenset()
+    vecs: frozenset = frozenset()
     consts_rows: list[list[np.ndarray]] = []
     for query in queries:
         ct, emit_q, _, refs = _plan(seg, ctx, query, None, flags)
         if sig0 is None:
             sig0, emit0, refs0 = ct.signature(), emit_q, refs
+            pos_for = frozenset(ct.positions_needed)
+            vecs = frozenset(ct.vectors_needed)
         elif ct.signature() != sig0:
             return None
         consts_rows.append(ct.values)
@@ -354,13 +411,18 @@ def run_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,
             packed[dt][bi, off:off + size] = v.reshape(-1)
     packed = {dt: jnp.asarray(buf) for dt, buf in packed.items()}
 
-    key = ("batch", sig0, layout_key(seg),
+    key = ("batch", sig0, layout_key(seg), pos_for, vecs,
            float(ctx.bm25.k1), float(ctx.bm25.b), k_static, b_pad)
-    flat = seg_flatten(seg)
+    flat = seg_flatten(seg, pos_for, vecs)
+    if os.environ.get("JIT_DEBUG"):
+        total = sum(int(a.size) * a.dtype.itemsize for a in flat)
+        print(f"[jit-debug] batch flat: {len(flat)} arrays, "
+              f"{total/1e6:.1f} MB traced; pos_for={sorted(pos_for)} "
+              f"vecs={sorted(vecs)}", flush=True)
 
     def compile_fn():
         def run(flat_in, packed_in):
-            view = seg_rebuild(seg, flat_in)
+            view = seg_rebuild(seg, flat_in, pos_for, vecs)
 
             def one(packed_one):
                 consts_one = [

@@ -852,6 +852,7 @@ class ShardSearcher:
 
     def _script_fields(self, script_fields: dict, seg, local: int) -> dict:
         from elasticsearch_tpu.search.scripts import compile_script, ScriptContext
+        from elasticsearch_tpu.search import jit_exec
         out = {}
         for name, spec in script_fields.items():
             script = spec.get("script", spec)
@@ -870,7 +871,9 @@ class ShardSearcher:
                 col = seg.vector.get(fld)
                 if col is None:
                     raise QueryParsingError(f"no vector field [{fld}]")
-                return col.vecs, col.exists
+                # vecs are LAZY (host numpy until first use) — _fetch
+                # materializes + caches the device copy once per reader
+                return jit_exec._fetch(seg, col, "vecs"), col.exists
             ctx = ScriptContext(get_numeric, get_vector,
                                 jnp.zeros(seg.padded_docs, jnp.float32), params)
             vals = compile_script(src).evaluate(ctx)

@@ -86,6 +86,30 @@ class CompiledScript:
     def evaluate(self, ctx: ScriptContext):
         return _eval(self._tree.body, ctx)
 
+    def vector_fields(self) -> set | None:
+        """Plan-time scan: the vector fields this script's accessors
+        (cosineSimilarity / dotProduct) read. Drives traced-input
+        tree-shaking — a numeric-only script must not force multi-GB
+        vector columns into the compiled program. Returns the (possibly
+        empty) set of constant field names, or None when a field argument
+        is not a literal (caller must assume all vector columns)."""
+        out: set = set()
+        for node in _pyast.walk(self._tree):
+            if isinstance(node, _pyast.Call) and \
+                    isinstance(node.func, _pyast.Name) and \
+                    node.func.id in ("cosineSimilarity", "dotProduct"):
+                if len(node.args) == 2 and \
+                        isinstance(node.args[1], _pyast.Constant) and \
+                        isinstance(node.args[1].value, str):
+                    out.add(node.args[1].value)
+                else:
+                    return None
+        return out
+
+    def uses_vectors(self) -> bool:
+        fields = self.vector_fields()
+        return fields is None or bool(fields)
+
 
 def _eval(node: _pyast.AST, ctx: ScriptContext) -> Any:  # noqa: C901
     if isinstance(node, _pyast.Constant):

@@ -94,7 +94,7 @@ class SearchService:
         body = dict(body or {})
         body["size"] = 0
         resp = self.search(index, body)
-        return {"count": resp["hits"]["total"]["value"],
+        return {"count": resp["hits"]["total"],
                 "_shards": resp["_shards"]}
 
     # ------------------------------------------------------------- scroll

@@ -15,6 +15,11 @@ from elasticsearch_tpu.common.errors import (
     ElasticsearchTpuError, IndexNotFoundError)
 
 
+def _total(t):
+    """hits.total is a bare count (2.x REST shape); accept the object
+    form too for inner clients that may be version-skewed."""
+    return t["value"] if isinstance(t, dict) else int(t)
+
 class TribeWriteError(ElasticsearchTpuError):
     status = 400
     error_type = "illegal_argument_exception"
@@ -145,7 +150,7 @@ class TribeService:
                   if h.get("_score") is not None]
         max_score = max(scores) if scores else None
         hits = hits[from_:from_ + size]
-        total = sum(r["hits"]["total"]["value"] for r in responses)
+        total = sum(_total(r["hits"]["total"]) for r in responses)
         return {
             "took": max(r.get("took", 0) for r in responses),
             "timed_out": any(r.get("timed_out") for r in responses),
@@ -155,7 +160,7 @@ class TribeService:
                                   for r in responses),
                 "failed": sum(r["_shards"].get("failed", 0)
                               for r in responses)},
-            "hits": {"total": {"value": total, "relation": "eq"},
+            "hits": {"total": total,
                      "max_score": max_score,
                      "hits": hits}}
 

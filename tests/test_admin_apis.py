@@ -88,7 +88,7 @@ class TestStoredScripts:
             json.dumps({"id": "my_tpl",
                         "params": {"word": "word1"}}).encode())
         assert st == 200
-        assert out["hits"]["total"]["value"] > 0
+        assert out["hits"]["total"] > 0
         st, _ = c.dispatch("DELETE", "/_search/template/my_tpl", b"")
         assert st == 200
         st, out = c.dispatch("GET", "/_search/template/my_tpl", b"")
@@ -146,7 +146,7 @@ class TestClusterReroute:
             assert out["acknowledged"]
             cluster.wait_for_health("green")     # re-allocated + recovered
             out = m.search("r", {"query": {"match": {"t": "alpha"}}})
-            assert out["hits"]["total"]["value"] == 5
+            assert out["hits"]["total"] == 5
 
     def test_move_replica(self, tmp_path):
         with InternalTestCluster(3, base_path=tmp_path) as cluster:
@@ -178,7 +178,7 @@ class TestClusterReroute:
                 raise AssertionError("replica never moved")
             m.broadcast_actions.refresh("mv")
             out = m.search("mv", {"query": {"match": {"t": "beta"}}})
-            assert out["hits"]["total"]["value"] == 5
+            assert out["hits"]["total"] == 5
 
     def test_invalid_commands_rejected(self, rc):
         n, c = rc

@@ -164,7 +164,7 @@ def test_single_node_cluster_still_works(tmp_path):
         n.indices_service.create_index("solo", {})
         n.index_doc("solo", "1", {"a": 1}, refresh=True)
         assert n.search("solo", {"query": {"match_all": {}}}
-                        )["hits"]["total"]["value"] == 1
+                        )["hits"]["total"] == 1
 
 
 def test_shard_state_travels_reconciler_to_master(cluster3):

@@ -44,7 +44,7 @@ def test_write_from_any_node_routes_to_primary(cluster3):
     # every node sees every doc via distributed search
     for n in c.nodes:
         resp = n.search("docs", {"query": {"match_all": {}}, "size": 50})
-        assert resp["hits"]["total"]["value"] == 20
+        assert resp["hits"]["total"] == 20
         assert resp["_shards"]["failed"] == 0
         assert resp["_shards"]["total"] == 4
 
@@ -78,11 +78,11 @@ def test_replicas_receive_ops_and_serve_after_primary_loss(cluster3):
     while time.monotonic() < deadline:
         resp = survivor.search("docs", {"query": {"match_all": {}},
                                         "size": 50})
-        if resp["hits"]["total"]["value"] == 30 and \
+        if resp["hits"]["total"] == 30 and \
                 resp["_shards"]["failed"] == 0:
             break
         time.sleep(0.2)
-    assert resp["hits"]["total"]["value"] == 30
+    assert resp["hits"]["total"] == 30
     for i in range(30):
         assert survivor.get_doc("docs", str(i))["found"]
 

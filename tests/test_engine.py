@@ -215,6 +215,6 @@ class TestIndexingMemoryController:
             assert engine.buffer_memory_bytes() == 0   # buffer flushed
             # docs remain searchable after the governor refresh
             out = n.search("buf", {"query": {"match": {"body": "token3"}}})
-            assert out["hits"]["total"]["value"] == 1
+            assert out["hits"]["total"] == 1
         finally:
             n.close()

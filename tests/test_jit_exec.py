@@ -135,3 +135,44 @@ def test_search_after_continuation_jitted(node):
     ids1 = {h["_id"] for h in hits}
     ids2 = {h["_id"] for h in p2["hits"]["hits"]}
     assert not (ids1 & ids2)
+
+
+class TestTracedInputShaking:
+    """Position matrices and vector columns stay host-side (lazy) until a
+    plan declares it reads them — tracing a [N, L] tokens array or a
+    [N, D] vector column a BM25 query never touches multiplies XLA
+    compile time and serializes the first search behind the transfer."""
+
+    def _dseg(self, node, name):
+        from elasticsearch_tpu.index.device_reader import device_reader_for
+        svc = node.indices_service.indices[name]
+        return device_reader_for(svc.engine(0)).segments[0]
+
+    def test_tokens_lazy_until_phrase(self, node):
+        _mk(node, "lz", 30)
+        svc = node.indices_service.indices["lz"]
+        from elasticsearch_tpu.index.device_reader import device_reader_for
+        from elasticsearch_tpu.search.phase import (ShardSearcher,
+                                                    parse_search_request)
+        s = ShardSearcher(0, device_reader_for(svc.engine(0)),
+                          svc.mapper_service)
+        dseg = s.reader.segments[0]
+        assert isinstance(dseg.text["t"].tokens, np.ndarray)
+        # BM25 match does not materialize positions
+        r = s.query_phase(parse_search_request(
+            {"query": {"match": {"t": "alpha"}}, "size": 5}))
+        assert r.total == 30
+        assert isinstance(dseg.text["t"].tokens, np.ndarray)
+        # a phrase query does — once, cached on the column
+        r = s.query_phase(parse_search_request(
+            {"query": {"match_phrase": {"t": "alpha beta"}}, "size": 5}))
+        assert r.total == 30
+        assert not isinstance(dseg.text["t"].tokens, np.ndarray)
+
+    def test_numeric_script_does_not_declare_vectors(self):
+        from elasticsearch_tpu.search.scripts import compile_script
+        assert not compile_script("doc['n'].value * 2").uses_vectors()
+        assert compile_script(
+            "cosineSimilarity(params.qv, 'v') + 1").uses_vectors()
+        assert compile_script(
+            "dotProduct(params.qv, 'v')").uses_vectors()

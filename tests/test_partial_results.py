@@ -25,7 +25,7 @@ def test_terminate_after_caps_and_flags(node):
     r = node.search("t", {"query": {"match": {"v": "common"}},
                           "terminate_after": 15})
     assert r["terminated_early"] is True
-    assert r["hits"]["total"]["value"] <= 15
+    assert r["hits"]["total"] <= 15
     assert r["hits"]["hits"]          # partial results still returned
 
 
@@ -33,7 +33,7 @@ def test_terminate_after_not_reached(node):
     r = node.search("t", {"query": {"match": {"v": "common"}},
                           "terminate_after": 10_000})
     assert "terminated_early" not in r
-    assert r["hits"]["total"]["value"] == 50
+    assert r["hits"]["total"] == 50
 
 
 def test_timeout_flag_with_zero_budget(node):
@@ -42,14 +42,14 @@ def test_timeout_flag_with_zero_budget(node):
     r = node.search("t", {"query": {"match": {"v": "common"}},
                           "timeout": "0ms"})
     assert r["timed_out"] is True
-    assert r["hits"]["total"]["value"] == 0
+    assert r["hits"]["total"] == 0
 
 
 def test_no_timeout_with_generous_budget(node):
     r = node.search("t", {"query": {"match": {"v": "common"}},
                           "timeout": "30s"})
     assert r["timed_out"] is False
-    assert r["hits"]["total"]["value"] == 50
+    assert r["hits"]["total"] == 50
 
 
 def test_timeout_with_field_sort_returns_partial(node):
@@ -68,4 +68,4 @@ def test_terminate_after_on_eager_fallback(node, monkeypatch):
     r = node.search("t", {"query": {"match": {"v": "common"}},
                           "terminate_after": 15})
     assert r["terminated_early"] is True
-    assert r["hits"]["total"]["value"] <= 15
+    assert r["hits"]["total"] <= 15

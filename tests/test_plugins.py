@@ -55,7 +55,7 @@ def test_plugin_wiring_end_to_end(tmp_path):
                                  "number_of_replicas": 0}})
         node.index_doc("idx", "1", {"t": "hello"}, refresh=True)
         res = node.search("idx", {"query": {"always": {}}})
-        assert res["hits"]["total"]["value"] == 1
+        assert res["hits"]["total"] == 1
         # plugin REST route served by the HTTP server
         server = RestServer(node, port=0).start()
         try:

@@ -147,7 +147,7 @@ def test_node_loss_reallocates_with_data(tmp_path):
         # the re-recovered copy serves reads: search via any node
         resp = c.master().search_actions.search(
             "d", {"query": {"match_all": {}}, "size": 0})
-        assert resp["hits"]["total"]["value"] == 40
+        assert resp["hits"]["total"] == 40
 
 
 def test_deletes_replayed_to_recovering_replica(cluster2):

@@ -118,8 +118,8 @@ class TestMsearch:
             "POST", "/idx/_msearch", body.encode())
         assert status == 200
         assert len(resp["responses"]) == 2
-        assert resp["responses"][0]["hits"]["total"]["value"] == 4
-        assert resp["responses"][1]["hits"]["total"]["value"] == 1
+        assert resp["responses"][0]["hits"]["total"] == 4
+        assert resp["responses"][1]["hits"]["total"] == 1
 
 
 class TestRequestCache:
@@ -139,8 +139,8 @@ class TestRequestCache:
         node.index_doc("idx", "99", {"t": "quick quick"})
         node.broadcast_actions.refresh("idx")
         r3 = node.search("idx", body)
-        assert r3["hits"]["total"]["value"] == \
-            r1["hits"]["total"]["value"] + 1
+        assert r3["hits"]["total"] == \
+            r1["hits"]["total"] + 1
         final = cache.stats_dict()
         assert final["misses"] == after["misses"] + 1
 

@@ -107,11 +107,11 @@ class TestBulk:
 class TestSearch:
     def test_match(self, client):
         r = client.search("books", {"query": {"match": {"title": "war"}}})
-        assert r["hits"]["total"]["value"] == 2
+        assert r["hits"]["total"] == 2
 
     def test_uri_q(self, client):
         srv_resp = client._request("GET", "/books/_search?q=title:peace")
-        assert srv_resp["hits"]["total"]["value"] == 2
+        assert srv_resp["hits"]["total"] == 2
 
     def test_aggs(self, client):
         r = client.search("books", {"size": 0, "aggs": {
@@ -168,7 +168,7 @@ class TestIndicesApi:
         client._request("POST", "/_aliases", {"actions": [
             {"add": {"index": "books", "alias": "library"}}]})
         r = client.search("library", {"query": {"match_all": {}}})
-        assert r["hits"]["total"]["value"] == 3
+        assert r["hits"]["total"] == 3
 
     def test_template(self, client):
         client.indices.put_template("logs_tmpl", {

@@ -52,7 +52,7 @@ class TestMatch:
     def test_match_basic(self, node):
         r = node.search("articles", {"query": {"match": {"body": "quick"}}})
         assert set(ids(r)) == {"0", "2", "3"}
-        assert r["hits"]["total"]["value"] == 3
+        assert r["hits"]["total"] == 3
         assert r["hits"]["hits"][0]["_score"] > 0
         assert r["hits"]["hits"][0]["_source"]["title"]
 
@@ -69,9 +69,9 @@ class TestMatch:
 
     def test_match_all_and_none(self, node):
         assert node.search("articles", {"query": {"match_all": {}}}
-                           )["hits"]["total"]["value"] == 4
+                           )["hits"]["total"] == 4
         assert node.search("articles", {"query": {"match_none": {}}}
-                           )["hits"]["total"]["value"] == 0
+                           )["hits"]["total"] == 0
 
     def test_match_phrase(self, node):
         r = node.search("articles",
@@ -112,7 +112,7 @@ class TestStructured:
 
     def test_exists(self, node):
         r = node.search("articles", {"query": {"exists": {"field": "views"}}})
-        assert r["hits"]["total"]["value"] == 4
+        assert r["hits"]["total"] == 4
 
     def test_prefix_wildcard_fuzzy(self, node):
         r = node.search("articles", {"query": {"prefix": {"tags": "ani"}}})
@@ -265,7 +265,7 @@ class TestPostFilter:
             "post_filter": {"term": {"tags": "food"}}})
         # post_filter applies to hits and total; aggs (none here) see the
         # pre-filter set (ES semantics)
-        assert r["hits"]["total"]["value"] == 1
+        assert r["hits"]["total"] == 1
         assert ids(r) == ["3"]
 
 
@@ -309,19 +309,19 @@ class TestScrollPointInTime:
                                           {"query": {"match_all": {}},
                                            "size": 1}, scroll="1m")
         sid = page["_scroll_id"]
-        assert page["hits"]["total"]["value"] == 2
+        assert page["hits"]["total"] == 2
         node.index_doc("pit", "3", {"n": 3})
         node.indices_service.index("pit").refresh()
         page2 = node.search_actions.scroll(sid, "1m")
         # the new doc must NOT appear in the pinned view
-        assert page2["hits"]["total"]["value"] == 2
+        assert page2["hits"]["total"] == 2
         seen = {h["_id"] for h in page["hits"]["hits"]} | \
             {h["_id"] for h in page2["hits"]["hits"]}
         assert seen == {"1", "2"}
         # a FRESH search sees all three
         fresh = node.search_actions.search(
             "pit", {"query": {"match_all": {}}})
-        assert fresh["hits"]["total"]["value"] == 3
+        assert fresh["hits"]["total"] == 3
         node.search_actions.clear_scroll(sid)
 
 
@@ -356,11 +356,11 @@ class TestSimilarityModules:
         self._index(node, "sim_lm", "lm_dirichlet")
         out = node.search("sim_lm",
                           {"query": {"match": {"body": "quick"}}})
-        assert out["hits"]["total"]["value"] == 3
+        assert out["hits"]["total"] == 3
         assert all(h["_score"] >= 0 for h in out["hits"]["hits"])
 
     def test_bm25_default_unchanged(self, node):
         self._index(node, "sim_bm25", "BM25")
         out = node.search("sim_bm25",
                           {"query": {"match": {"body": "quick"}}})
-        assert out["hits"]["total"]["value"] == 3
+        assert out["hits"]["total"] == 3

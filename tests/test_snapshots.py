@@ -31,7 +31,7 @@ def _mk_index(c, name, docs, shards=2, replicas=0):
 def _count(node, index):
     return node.search_actions.search(
         index, {"query": {"match_all": {}}, "size": 0}
-    )["hits"]["total"]["value"]
+    )["hits"]["total"]
 
 
 def test_snapshot_and_restore_roundtrip(cluster, tmp_path):

@@ -89,7 +89,7 @@ class TestNodeBackpressure:
                 n.index_doc("idx", str(i), {"t": f"alpha word{i}"})
             n.broadcast_actions.refresh("idx")
             body = {"query": {"match": {"t": "alpha"}}}
-            assert n.search("idx", body)["hits"]["total"]["value"] == 10
+            assert n.search("idx", body)["hits"]["total"] == 10
 
             # saturate: one job occupies the single worker, one fills the
             # bounded queue — the next search must be REJECTED, not queued
@@ -111,7 +111,7 @@ class TestNodeBackpressure:
             time.sleep(1.8)
             out = n.search("idx", body)
             assert out["_shards"]["failed"] == 0
-            assert out["hits"]["total"]["value"] == 10  # pre-refresh count
+            assert out["hits"]["total"] == 10  # pre-refresh count
             st = n.thread_pool.stats()["search"]
             assert st["rejected"] >= 1
         finally:

@@ -43,12 +43,12 @@ def test_merged_view_and_federated_search(clusters):
     assert set(merged) == {"logs", "metrics"}
     assert merged["logs"]["tribe"] == "t1"
     out = tribe.search("_all", {"query": {"match": {"msg": "brown"}}})
-    assert out["hits"]["total"]["value"] == 2
+    assert out["hits"]["total"] == 2
     assert {h["_index"] for h in out["hits"]["hits"]} == \
         {"logs", "metrics"}
     # single-cluster expression routes to the owner only
     out = tribe.search("logs", {"query": {"match_all": {}}})
-    assert out["hits"]["total"]["value"] == 1
+    assert out["hits"]["total"] == 1
 
 
 def test_reads_and_write_block(clusters):
