@@ -75,8 +75,10 @@ def merge_top_k_batch(scores_list, docs_list, k: int, bases):
                               tuple(int(b) for b in bases))
 
 
-@partial(jax.jit, static_argnames=("k", "bases"))
-def _merge_top_k_batch(scores_list, docs_list, k: int, bases):
+def merge_top_k_batch_body(scores_list, docs_list, k: int, bases):
+    """Traceable body shared by the standalone jitted entry below and the
+    fused reader program (jit_exec.run_reader_batch) — ONE copy of the
+    tie-break / -inf-pad contract."""
     docs = jnp.concatenate(
         [jnp.where(d >= 0, d + b, -1) for d, b in zip(docs_list, bases)],
         axis=1)
@@ -95,6 +97,10 @@ def _merge_top_k_batch(scores_list, docs_list, k: int, bases):
     return top_scores, top_docs
 
 
+_merge_top_k_batch = partial(jax.jit, static_argnames=("k", "bases"))(
+    merge_top_k_batch_body)
+
+
 def pack_batch_result(top_scores, top_docs, counts):
     """Pack a batched merge result into ONE f32 array ``[B, 2k+1]``
     (scores ‖ doc-ids ‖ count) so the host needs a single device→host
@@ -104,11 +110,14 @@ def pack_batch_result(top_scores, top_docs, counts):
     return _pack_batch_result(top_scores, top_docs, counts)
 
 
-@jax.jit
-def _pack_batch_result(top_scores, top_docs, counts):
+def pack_batch_result_body(top_scores, top_docs, counts):
+    """Traceable body (shared with the fused reader program)."""
     return jnp.concatenate(
         [top_scores, top_docs.astype(jnp.float32),
          counts.astype(jnp.float32)[:, None]], axis=1)
+
+
+_pack_batch_result = jax.jit(pack_batch_result_body)
 
 
 def unpack_batch_result(packed: "np.ndarray", k: int):

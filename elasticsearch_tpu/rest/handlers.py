@@ -911,7 +911,11 @@ class Handlers:
         t = req.path_params.get("type")
         index = req.path_params.get("index")
         doc_id = req.path_params.get("id")
-        if t and t != "_all" and isinstance(resp, dict) and "_type" in resp:
+        if t and t not in ("_all", "_doc") and isinstance(resp, dict) \
+                and "_type" in resp:
+            # "_doc" is the default type, not a user type: responses
+            # already carry _type:_doc and recording it would make later
+            # typed reads of the same id miss
             resp = {**resp, "_type": t}
             if index and doc_id and req.method in ("PUT", "POST") \
                     and len(self._doc_types) < 100_000:
@@ -1072,7 +1076,9 @@ class Handlers:
             realtime=req.param_as_bool("realtime", True),
             refresh=req.param_as_bool("refresh"))
         t = req.path_params.get("type")
-        if resp["found"] and t and t != "_all":
+        if resp["found"] and t and t not in ("_all", "_doc"):
+            # _all = wildcard; _doc = the default type (same reach as the
+            # typeless modern surface — never a strict type filter)
             stored = self._doc_types.get((req.path_params["index"],
                                           req.path_params["id"]))
             if stored and t != stored:    # wrong type = miss (2.x)
@@ -1226,7 +1232,7 @@ class Handlers:
         for i, doc in enumerate(out.get("docs", [])):
             spec = specs[i] if i < len(specs) else {}
             t = spec.get("_type") or default_t
-            if not t or t == "_all":
+            if not t or t in ("_all", "_doc"):
                 stored = self._doc_types.get((doc.get("_index"),
                                               doc.get("_id")))
                 if stored:

@@ -333,6 +333,160 @@ def run_segment(seg: DeviceSegment, ctx: ExecutionContext, query,
     return fn(flat, consts)
 
 
+def _plan_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,
+                        queries: list, k_static: int) -> dict | None:
+    """Plan a batch of same-signature queries against one segment and pack
+    their dynamic constants per dtype into ONE [B, total] buffer each:
+    every host→device transfer pays dispatch/tunnel latency, so 2 packed
+    buffers beat N small ones; the program unpacks by static slicing
+    (free under XLA). The spec layout is a pure function of the plan
+    signature, so cached programs agree on it. Returns None when the
+    queries do not share one plan signature or the shared plan has no
+    dynamic constants (callers fall back to per-query execution)."""
+    if not queries:
+        return None
+    flags = {
+        "min_score": False, "_min_score": 0.0,
+        "search_after": False, "_sa_score": 0.0, "_sa_doc": -1,
+        "_doc_base": seg.doc_base,
+        "want_topk": True, "want_arrays": False,
+    }
+    sig0 = None
+    emit0 = refs0 = None
+    pos_for: frozenset = frozenset()
+    vecs: frozenset = frozenset()
+    consts_rows: list[list[np.ndarray]] = []
+    for query in queries:
+        ct, emit_q, _, refs = _plan(seg, ctx, query, None, flags)
+        if sig0 is None:
+            sig0, emit0, refs0 = ct.signature(), emit_q, refs
+            pos_for = frozenset(ct.positions_needed)
+            vecs = frozenset(ct.vectors_needed)
+        elif ct.signature() != sig0:
+            return None
+        consts_rows.append(ct.values)
+
+    b = len(queries)
+    # pad the batch axis to the next power of two (repeating the last
+    # query's constants) so varying batch sizes share compiled programs
+    b_pad = 1 if b == 1 else 1 << (b - 1).bit_length()
+    if b_pad != b:
+        consts_rows = consts_rows + [consts_rows[-1]] * (b_pad - b)
+    if not consts_rows[0]:
+        # const-free plans (match_none / absent-field zeros): nothing to
+        # vmap over — the per-query path handles these (rare) shapes
+        return None
+    specs = []                       # per const: (dtype, offset, shape, size)
+    totals: dict[str, int] = {}
+    for v in consts_rows[0]:
+        dt = str(v.dtype)
+        off = totals.get(dt, 0)
+        size = int(v.size)
+        specs.append((dt, off, v.shape, size))
+        totals[dt] = off + size
+    packed = {}
+    for dt, total in totals.items():
+        packed[dt] = np.empty((b_pad, total), dtype=dt)
+    for bi, row in enumerate(consts_rows):
+        for v, (dt, off, _shape, size) in zip(row, specs):
+            packed[dt][bi, off:off + size] = v.reshape(-1)
+    return {
+        "seg": seg, "sig": sig0, "emit": emit0, "refs": refs0,
+        "pos": pos_for, "vecs": vecs, "flags": flags,
+        "specs": tuple(specs), "packed": packed, "b_pad": b_pad,
+        "flat": seg_flatten(seg, pos_for, vecs),
+        "key": (sig0, layout_key(seg), pos_for, vecs,
+                float(ctx.bm25.k1), float(ctx.bm25.b), k_static, b_pad,
+                tuple(specs)),
+        "k": k_static,
+    }
+
+
+def _lane_fn(plan: dict, view: DeviceSegment):
+    """One vmap lane: unpack this query's constants by static slicing and
+    run the shared program body."""
+    def one(packed_one):
+        consts_one = [
+            packed_one[dt][off:off + size].reshape(shape)
+            for dt, off, shape, size in plan["specs"]]
+        return _build(view, consts_one, plan["emit"], None, plan["refs"],
+                      plan["flags"], plan["k"])
+    return one
+
+
+def run_reader_batch(segments: list, ctx: ExecutionContext, queries: list,
+                     *, k: int, pack: bool):
+    """The whole reader's batched query phase as ONE compiled program:
+    per-segment vmapped scoring + top-k, cross-segment merge to
+    reader-global doc ids (TopDocs.merge tie-break — concat in segment
+    order + stable top_k, core/search/controller/SearchPhaseController
+    .java:165), hit-count sum, and (with ``pack``) the [B, 2k+1] packed
+    fetch layout — a single device dispatch + a single device→host fetch
+    per batch instead of S+2 dispatches, which matters when every
+    dispatch pays tunneled-interconnect round-trip latency.
+
+    Returns a packed [B, 2k+1] f32 array (``pack=True``; exact only while
+    doc ids and counts stay below 2**24 — the caller checks max_doc), or
+    ``{"top_scores", "top_docs", "count"}`` device arrays. None when any
+    segment's queries do not share one plan signature (caller falls back
+    to per-query execution).
+    """
+    if not queries or not segments:
+        return None
+    k_static = int(k)
+    plans = []
+    for seg in segments:
+        plan = _plan_segment_batch(seg, ctx, queries, k_static)
+        if plan is None:
+            return None
+        plans.append(plan)
+    b = len(queries)
+    b_pad = plans[0]["b_pad"]
+    bases = tuple(int(seg.doc_base) for seg in segments)
+    key = ("reader", bases, bool(pack)) + tuple(p["key"] for p in plans)
+    flats = [p["flat"] for p in plans]
+    packeds = [{dt: jnp.asarray(buf) for dt, buf in p["packed"].items()}
+               for p in plans]
+    if os.environ.get("JIT_DEBUG"):
+        total = sum(int(a.size) * a.dtype.itemsize
+                    for flat in flats for a in flat)
+        print(f"[jit-debug] reader batch: {len(plans)} segment(s), "
+              f"{sum(len(f) for f in flats)} arrays, {total/1e6:.1f} MB "
+              f"traced; pos_for={sorted(plans[0]['pos'])} "
+              f"vecs={sorted(plans[0]['vecs'])}", flush=True)
+
+    def compile_fn():
+        def run(flats_in, packeds_in):
+            ts_list, td_list = [], []
+            counts = None
+            for plan, flat_in, packed_in in zip(plans, flats_in,
+                                                packeds_in):
+                view = seg_rebuild(plan["seg"], flat_in,
+                                   plan["pos"], plan["vecs"])
+                outs = jax.vmap(_lane_fn(plan, view))(packed_in)
+                ts_list.append(outs["top_scores"])
+                td_list.append(outs["top_docs"])
+                counts = outs["count"] if counts is None \
+                    else counts + outs["count"]
+            top_s, top_d = topk_ops.merge_top_k_batch_body(
+                ts_list, td_list, k_static, bases)
+            if pack:
+                return topk_ops.pack_batch_result_body(top_s, top_d,
+                                                       counts)
+            return {"top_scores": top_s, "top_docs": top_d, "count": counts}
+
+        shapes = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            (flats, packeds))
+        return jax.jit(run).lower(*shapes).compile()
+
+    fn = _get_compiled(key, compile_fn)
+    out = fn(flats, packeds)
+    if b_pad != b:
+        out = out[:b] if pack else {name: v[:b] for name, v in out.items()}
+    return out
+
+
 def run_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,
                       queries: list, *, k: int) -> dict | None:
     """Execute a BATCH of queries against one device segment as ONE vmapped
@@ -357,81 +511,23 @@ def run_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,
     The batch axis is padded to the next power of two (repeating the last
     query's constants) so varying batch sizes share compiled programs.
     """
-    if not queries:
+    plan = _plan_segment_batch(seg, ctx, queries, int(k))
+    if plan is None:
         return None
-    flags = {
-        "min_score": False, "_min_score": 0.0,
-        "search_after": False, "_sa_score": 0.0, "_sa_doc": -1,
-        "_doc_base": seg.doc_base,
-        "want_topk": True, "want_arrays": False,
-    }
-    k_static = int(k)
-    sig0 = None
-    emit0 = refs0 = None
-    pos_for: frozenset = frozenset()
-    vecs: frozenset = frozenset()
-    consts_rows: list[list[np.ndarray]] = []
-    for query in queries:
-        ct, emit_q, _, refs = _plan(seg, ctx, query, None, flags)
-        if sig0 is None:
-            sig0, emit0, refs0 = ct.signature(), emit_q, refs
-            pos_for = frozenset(ct.positions_needed)
-            vecs = frozenset(ct.vectors_needed)
-        elif ct.signature() != sig0:
-            return None
-        consts_rows.append(ct.values)
-
     b = len(queries)
-    b_pad = 1 if b == 1 else 1 << (b - 1).bit_length()
-    if b_pad != b:
-        consts_rows = consts_rows + [consts_rows[-1]] * (b_pad - b)
-    n_consts = len(consts_rows[0])
-    if n_consts == 0:
-        # const-free plans (match_none / absent-field zeros): nothing to
-        # vmap over — the per-query path handles these (rare) shapes
-        return None
-    # pack constants per dtype into ONE [B, total] buffer each: every
-    # host→device transfer pays dispatch/tunnel latency, so 2 packed
-    # buffers beat N small ones; the program unpacks by static slicing
-    # (free under XLA). The spec layout is a pure function of the plan
-    # signature, so cached programs agree on it.
-    specs = []                       # per const: (dtype, offset, shape, size)
-    totals: dict[str, int] = {}
-    for v in consts_rows[0]:
-        dt = str(v.dtype)
-        off = totals.get(dt, 0)
-        size = int(v.size)
-        specs.append((dt, off, v.shape, size))
-        totals[dt] = off + size
-    packed = {}
-    for dt, total in totals.items():
-        packed[dt] = np.empty((b_pad, total), dtype=dt)
-    for bi, row in enumerate(consts_rows):
-        for v, (dt, off, _shape, size) in zip(row, specs):
-            packed[dt][bi, off:off + size] = v.reshape(-1)
-    packed = {dt: jnp.asarray(buf) for dt, buf in packed.items()}
-
-    key = ("batch", sig0, layout_key(seg), pos_for, vecs,
-           float(ctx.bm25.k1), float(ctx.bm25.b), k_static, b_pad)
-    flat = seg_flatten(seg, pos_for, vecs)
+    key = ("batch",) + plan["key"]
+    flat = plan["flat"]
+    packed = {dt: jnp.asarray(buf) for dt, buf in plan["packed"].items()}
     if os.environ.get("JIT_DEBUG"):
         total = sum(int(a.size) * a.dtype.itemsize for a in flat)
         print(f"[jit-debug] batch flat: {len(flat)} arrays, "
-              f"{total/1e6:.1f} MB traced; pos_for={sorted(pos_for)} "
-              f"vecs={sorted(vecs)}", flush=True)
+              f"{total/1e6:.1f} MB traced; pos_for={sorted(plan['pos'])} "
+              f"vecs={sorted(plan['vecs'])}", flush=True)
 
     def compile_fn():
         def run(flat_in, packed_in):
-            view = seg_rebuild(seg, flat_in, pos_for, vecs)
-
-            def one(packed_one):
-                consts_one = [
-                    packed_one[dt][off:off + size].reshape(shape)
-                    for dt, off, shape, size in specs]
-                return _build(view, consts_one, emit0, None, refs0,
-                              flags, k_static)
-
-            return jax.vmap(one)(packed_in)
+            view = seg_rebuild(seg, flat_in, plan["pos"], plan["vecs"])
+            return jax.vmap(_lane_fn(plan, view))(packed_in)
 
         shapes = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
@@ -440,6 +536,6 @@ def run_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,
 
     fn = _get_compiled(key, compile_fn)
     outs = fn(flat, packed)
-    if b_pad != b:
+    if plan["b_pad"] != b:
         outs = {name: v[:b] for name, v in outs.items()}
     return outs

@@ -452,36 +452,33 @@ class ShardSearcher:
                 return None
         k = max(max(req.from_ + req.size, 1) for req in reqs)
         queries = [req.query for req in reqs]
+        if not self.reader.segments:
+            return [ShardQueryResult(self.shard_id, 0, None,
+                                     np.zeros(0, np.int32),
+                                     np.zeros(0, np.float32), None, {},
+                                     self.reader) for _ in reqs]
+        # doc ids and counts survive the packed f32 fetch layout exactly
+        # only below 2^24
+        pack = self.reader.max_doc < (1 << 24)
         try:
-            seg_outs = []
-            for seg in self.reader.segments:
-                outs = jit_exec.run_segment_batch(seg, self.ctx, queries, k=k)
-                if outs is None:       # mixed plan signatures
-                    return None
-                seg_outs.append(outs)
+            out = jit_exec.run_reader_batch(self.reader.segments, self.ctx,
+                                            queries, k=k, pack=pack)
         except QueryParsingError:
             raise
         except Exception as e:            # noqa: BLE001 — fallback seam
             jit_exec.note_fallback(e)
             return None
-        if not seg_outs:
-            return [ShardQueryResult(self.shard_id, 0, None,
-                                     np.zeros(0, np.int32),
-                                     np.zeros(0, np.float32), None, {},
-                                     self.reader) for _ in reqs]
-        bases = [seg.doc_base for seg in self.reader.segments]
-        ms, md = topk_ops.merge_top_k_batch(
-            [o["top_scores"] for o in seg_outs],
-            [o["top_docs"] for o in seg_outs], k, bases)
-        counts = sum(o["count"] for o in seg_outs)
-        if self.reader.max_doc < (1 << 24):
-            # single-fetch fast path: one device→host round trip per batch
-            # (RTT dominates fetch cost); doc ids exact in f32 below 2^24
-            packed = np.asarray(topk_ops.pack_batch_result(ms, md, counts))
-            ms, md, totals = topk_ops.unpack_batch_result(packed, k)
+        if out is None:                   # mixed plan signatures
+            return None
+        if pack:
+            # single-fetch fast path: scoring, merge AND result packing
+            # ran as one program — one dispatch + one device→host round
+            # trip per batch (RTT dominates on a tunneled interconnect)
+            ms, md, totals = topk_ops.unpack_batch_result(np.asarray(out), k)
         else:
-            ms, md = np.asarray(ms), np.asarray(md)
-            totals = np.asarray(counts)
+            ms = np.asarray(out["top_scores"])
+            md = np.asarray(out["top_docs"])
+            totals = np.asarray(out["count"])
         results = []
         for bi, req in enumerate(reqs):
             kq = max(req.from_ + req.size, 1)
