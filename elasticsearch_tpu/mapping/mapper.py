@@ -25,6 +25,8 @@ from typing import Any, Mapping
 
 import numpy as np
 
+from json import dumps as _json_dumps
+
 from elasticsearch_tpu.analysis import AnalysisRegistry, Token
 from elasticsearch_tpu.common.errors import MapperParsingError, IllegalArgumentError
 from elasticsearch_tpu.common.settings import parse_bool
@@ -37,7 +39,7 @@ KIND_VECTOR = "vector"
 KIND_GEO = "geo"
 
 NUMERIC_TYPES = {"long", "integer", "short", "byte", "double", "float",
-                 "half_float", "date", "boolean"}
+                 "half_float", "date", "boolean", "murmur3"}
 
 POSITION_INCREMENT_GAP = 16
 
@@ -273,6 +275,15 @@ class FieldMapper:
                         raise MapperParsingError(
                             f"failed to parse [{self.name}] value [{v}] as boolean"
                         ) from None
+                elif self.type == "murmur3":
+                    # mapper-murmur3 plugin: index hash128(value).h1 as a
+                    # long doc-value (Murmur3FieldMapper.java:137) — feeds
+                    # cardinality aggs on pre-hashed values. f64 storage
+                    # keeps 53 of the 64 bits; collisions stay negligible
+                    # for distinct-count purposes
+                    from elasticsearch_tpu.utils.murmur3 import hash128_x64_h1
+                    pf.numerics.append(
+                        float(hash128_x64_h1(str(v).encode("utf-8"))))
                 else:
                     try:
                         pf.numerics.append(float(v))
@@ -328,6 +339,11 @@ class DocumentMapper:
         ttl = mapping_def.get("_ttl") or {}
         self.ttl_enabled = _on(ttl.get("enabled", "false"))
         self.ttl_default: str | None = ttl.get("default")
+        # mapper-size plugin: {"_size": {"enabled": true}} indexes the
+        # source byte length as a long doc-value under _size
+        # (plugins/mapper-size/.../SizeFieldMapper.java)
+        self.size_enabled = _on((mapping_def.get("_size") or {})
+                                .get("enabled", "false"))
         self._build(mapping_def.get("properties", {}), prefix="")
 
     def _build(self, properties: Mapping[str, Any], prefix: str,
@@ -434,6 +450,15 @@ class DocumentMapper:
                 if v is not None:
                     fields[key] = ParsedField(name=key, kind="numeric",
                                               numerics=[float(v)])
+        if self.size_enabled:
+            # UTF-8 byte length of the (compact re-serialized) source —
+            # ensure_ascii would count escape sequences, inflating every
+            # non-ASCII char ~3x vs the bytes ES measures
+            fields["_size"] = ParsedField(
+                name="_size", kind="numeric",
+                numerics=[float(len(_json_dumps(
+                    source, separators=(",", ":"),
+                    ensure_ascii=False).encode("utf-8")))])
         return ParsedDocument(doc_id=doc_id, source=dict(source), fields=fields,
                               routing=routing, nested=nested)
 

@@ -14,7 +14,8 @@ import os
 import time
 
 from elasticsearch_tpu import __version__
-from elasticsearch_tpu.common.errors import (IllegalArgumentError,
+from elasticsearch_tpu.common.errors import (DocumentMissingError,
+                                             IllegalArgumentError,
                                              IndexNotFoundError)
 from elasticsearch_tpu.rest.controller import RestController, RestRequest
 from elasticsearch_tpu.rest.table import (CatTable, Col, fmt_bytes,
@@ -136,6 +137,8 @@ def register_all(rc: RestController, node) -> None:
         r("POST", f"/{{index}}/{doc_seg}/{{id}}/_explain", h.explain)
         r("GET", f"/{{index}}/{doc_seg}/{{id}}/_termvectors", h.termvectors)
         r("POST", f"/{{index}}/{doc_seg}/{{id}}/_termvectors", h.termvectors)
+    r("DELETE", "/{index}/_query", h.delete_by_query)
+    r("DELETE", "/{index}/{type}/_query", h.delete_by_query)
     r("GET", "/{index}/_field_stats", h.field_stats)
     r("POST", "/{index}/_field_stats", h.field_stats)
     r("GET", "/_field_stats", h.field_stats)
@@ -1738,6 +1741,89 @@ class Handlers:
         out = {"_shards": resp["_shards"]}
         out.update(resp.get("suggest", {}))
         return 200, out
+
+    def delete_by_query(self, req: RestRequest):
+        """DELETE /{index}/_query — the delete-by-query plugin action
+        (plugins/delete-by-query/.../TransportDeleteByQueryAction.java:76):
+        a scan-scroll over matching docs feeding per-doc deletes, counted
+        per index as found/deleted/missing/failed and rolled up under
+        "_indices" with an "_all" summary
+        (DeleteByQueryResponse.toXContent:179-201)."""
+        t0 = time.perf_counter()
+        index = req.path_params["index"]
+        body = req.body or {}
+        query = body.get("query")
+        if query is None:
+            src = req.param("source")
+            if src:                     # ?source= carries a JSON body
+                try:
+                    query = json.loads(src).get("query")
+                except (ValueError, AttributeError):
+                    query = None
+            elif req.param("q"):        # ?q= is strictly a query_string
+                query = {"query_string": {"query": req.param("q")}}
+        if query is None:
+            from elasticsearch_tpu.common.errors import IllegalArgumentError
+            raise IllegalArgumentError(
+                "delete-by-query requires a query (body, source or q)")
+        t = req.path_params.get("type") or req.param("type")
+        if t and t != "_all":
+            if t == "_doc":
+                # the default type: match docs stored under _doc OR with
+                # no stored _type at all (untyped modern-surface docs)
+                tf = {"bool": {"should": [
+                    {"term": {"_type": t}},
+                    {"bool": {"must_not": [{"exists": {"field": "_type"}}]}},
+                ]}}
+            else:
+                tf = {"term": {"_type": t}}
+            query = {"bool": {"must": query, "filter": tf}}
+        counts: dict[str, list[int]] = {}     # index → [found, deleted,
+        #                                        missing, failed]
+        # the plugin's scroll TTL defaults to 10m (DeleteByQueryRequest
+        # DEFAULT_SCROLL_TIMEOUT) and honors ?scroll — a 1m default can
+        # expire mid-page while replicated deletes drain
+        keep = req.param("scroll") or "10m"
+        search_body = {"query": query, "size": 500, "version": True,
+                       "fields": ["_routing", "_parent"],
+                       "_source": False}
+        resp = self.node.search(index, search_body, scroll=keep)
+        sid = resp.get("_scroll_id")
+        try:
+            while True:
+                hits = resp["hits"]["hits"]
+                if not hits:
+                    break
+                for h in hits:
+                    c = counts.setdefault(h["_index"], [0, 0, 0, 0])
+                    c[0] += 1
+                    routing = h.get("_routing") or h.get("_parent")
+                    try:
+                        self.node.delete_doc(h["_index"], h["_id"],
+                                             routing=routing)
+                        c[1] += 1
+                    except DocumentMissingError:
+                        # deleted concurrently between scroll and delete —
+                        # the reference counts isFound()==false as missing
+                        c[2] += 1
+                    except Exception:              # noqa: BLE001
+                        c[3] += 1
+                if sid is None:
+                    break
+                resp = self.node.search_actions.scroll(sid, keep)
+        finally:
+            if sid is not None:
+                self.node.search_actions.clear_scroll(sid)
+        totals = [sum(c[i] for c in counts.values()) for i in range(4)]
+        indices = {"_all": {"found": totals[0], "deleted": totals[1],
+                            "missing": totals[2], "failed": totals[3]}}
+        for name in sorted(counts):
+            c = counts[name]
+            indices[name] = {"found": c[0], "deleted": c[1],
+                             "missing": c[2], "failed": c[3]}
+        return 200, {"took": int((time.perf_counter() - t0) * 1000),
+                     "timed_out": False, "_indices": indices,
+                     "failures": []}
 
     def scroll(self, req: RestRequest):
         body = req.body or {}

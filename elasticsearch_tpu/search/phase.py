@@ -802,19 +802,30 @@ class ShardSearcher:
 
     def fetch_phase(self, req: ParsedSearchRequest, result: ShardQueryResult,
                     index_name: str, positions: list[int]) -> list[dict]:
+        from elasticsearch_tpu.index.engine import _segment_meta
+        meta_wanted = [f for f in req.stored_fields
+                       if f in ("_routing", "_parent", "_timestamp", "_ttl")]
         hits = []
         for pos in positions:
             gid = int(result.doc_ids[pos])
             seg, local = self.reader.resolve(gid)
             src = seg.seg.sources[local]
+            meta = _segment_meta(seg.seg, local) or {}
             emit_score = result.sort_values is None or any(
                 "_score" in spec for spec in req.sort)
             hit = {
                 "_index": index_name,
-                "_type": "_doc",
+                "_type": meta.get("_type", "_doc"),
                 "_id": seg.seg.ids[local],
                 "_score": (float(result.scores[pos]) if emit_score else None),
             }
+            # requested metadata fields render at the TOP level of the hit
+            # (InternalSearchHit.toXContent puts metadata fields beside
+            # _id, not under "fields" — the 2.x shape delete-by-query's
+            # scroll relies on for _routing/_parent)
+            for f in meta_wanted:
+                if meta.get(f) is not None:
+                    hit[f] = meta[f]
             if result.sort_values is not None:
                 hit["sort"] = result.sort_values[pos]
             filtered = _filter_source(src, req.source_filter)

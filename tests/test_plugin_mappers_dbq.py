@@ -1,0 +1,156 @@
+"""Plugin-parity features: delete-by-query (plugins/delete-by-query),
+mapper-murmur3, mapper-size — the 2.x plugin surface SURVEY.md §2.9 lists,
+driven through the REST controller and the mapping/search stack."""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.controller import RestController
+from elasticsearch_tpu.rest.handlers import register_all
+from elasticsearch_tpu.utils.murmur3 import hash128_x64_h1
+
+
+@pytest.fixture
+def rest(tmp_path):
+    n = Node({}, data_path=tmp_path / "n").start()
+    c = RestController()
+    register_all(c, n)
+    yield n, c
+    n.close()
+
+
+class TestMurmur3Hash:
+    def test_reference_vectors(self):
+        # x64_128 h1, seed 0 (matches mmh3.hash64 / the reference's
+        # common/hash/MurmurHash3.java used by Murmur3FieldMapper)
+        assert hash128_x64_h1(b"") == 0
+        assert hash128_x64_h1(b"hello") == -3758069500696749310
+        # >16-byte input exercises the block loop
+        assert hash128_x64_h1(b"hello" * 7) != hash128_x64_h1(b"hello" * 6)
+
+    def test_murmur3_field_cardinality(self, rest):
+        n, _ = rest
+        n.indices_service.create_index("mm", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"_doc": {"properties": {
+                "h": {"type": "murmur3"}}}}})
+        for i, v in enumerate(["x", "y", "x", "z", "y", "x"]):
+            n.index_doc("mm", str(i), {"h": v})
+        n.broadcast_actions.refresh("mm")
+        r = n.search("mm", {"size": 0, "aggs": {
+            "card": {"cardinality": {"field": "h"}}}})
+        assert r["aggregations"]["card"]["value"] == 3
+
+
+class TestSizeField:
+    def test_size_enabled_indexes_source_length(self, rest):
+        n, _ = rest
+        n.indices_service.create_index("sz", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"_doc": {"_size": {"enabled": True},
+                                  "properties": {"t": {"type": "keyword"}}}}})
+        n.index_doc("sz", "1", {"t": "a"})
+        n.index_doc("sz", "2", {"t": "a" * 100})
+        n.broadcast_actions.refresh("sz")
+        r = n.search("sz", {"query": {"range": {"_size": {"gt": 50}}}})
+        assert r["hits"]["total"] == 1
+        assert r["hits"]["hits"][0]["_id"] == "2"
+
+    def test_size_disabled_by_default(self, rest):
+        n, _ = rest
+        n.indices_service.create_index("nsz", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0}})
+        n.index_doc("nsz", "1", {"t": "a"})
+        n.broadcast_actions.refresh("nsz")
+        r = n.search("nsz", {"query": {"exists": {"field": "_size"}}})
+        assert r["hits"]["total"] == 0
+
+
+class TestDeleteByQuery:
+    def _fill(self, c, idx="dq", n_docs=30):
+        c.dispatch("PUT", f"/{idx}", b'{"settings":{"number_of_shards":2}}')
+        for i in range(n_docs):
+            body = ('{"t": "keep"}' if i % 3 else '{"t": "drop"}').encode()
+            c.dispatch("PUT", f"/{idx}/tweet/{i}?refresh=true", body)
+
+    def test_basic_delete(self, rest):
+        n, c = rest
+        self._fill(c)
+        st, body = c.dispatch("DELETE", "/dq/_query",
+                              b'{"query": {"match": {"t": "drop"}}}')
+        assert st == 200
+        assert body["_indices"]["_all"] == {
+            "found": 10, "deleted": 10, "missing": 0, "failed": 0}
+        assert body["_indices"]["dq"]["deleted"] == 10
+        assert body["failures"] == []
+        c.dispatch("POST", "/dq/_refresh", b"")
+        _, out = c.dispatch("GET", "/dq/_count", b"")
+        assert out["count"] == 20
+
+    def test_typed_route_filters(self, rest):
+        n, c = rest
+        self._fill(c)
+        st, body = c.dispatch("DELETE", "/dq/other/_query",
+                              b'{"query": {"match_all": {}}}')
+        assert body["_indices"]["_all"]["found"] == 0
+
+    def test_q_param(self, rest):
+        n, c = rest
+        self._fill(c)
+        st, body = c.dispatch("DELETE", "/dq/_query?q=t:drop", b"")
+        assert body["_indices"]["_all"]["deleted"] == 10
+
+    def test_missing_query_400(self, rest):
+        n, c = rest
+        self._fill(c)
+        st, body = c.dispatch("DELETE", "/dq/_query", b"")
+        assert st == 400
+
+    def test_routed_docs_deleted(self, rest):
+        n, c = rest
+        c.dispatch("PUT", "/rt", b'{"settings":{"number_of_shards":3}}')
+        for i in range(12):
+            c.dispatch("PUT", f"/rt/tweet/{i}?routing=r{i % 2}&refresh=true",
+                       b'{"t": "drop"}')
+        st, body = c.dispatch("DELETE", "/rt/_query",
+                              b'{"query": {"match": {"t": "drop"}}}')
+        assert body["_indices"]["_all"] == {
+            "found": 12, "deleted": 12, "missing": 0, "failed": 0}
+
+
+class TestMetaFieldsInHits:
+    def test_routing_field_top_level(self, rest):
+        n, _ = rest
+        n.indices_service.create_index("mf", {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 0}})
+        n.index_doc("mf", "1", {"t": "a"}, routing="r7")
+        n.broadcast_actions.refresh("mf")
+        r = n.search("mf", {"query": {"match_all": {}},
+                            "fields": ["_routing"]})
+        hit = r["hits"]["hits"][0]
+        # 2.x renders requested metadata fields at hit top level
+        # (InternalSearchHit.toXContent)
+        assert hit["_routing"] == "r7"
+
+    def test_doc_typed_route_spares_named_types(self, rest):
+        n, c = rest
+        c.dispatch("PUT", "/mx", b'{"settings":{"number_of_shards":1}}')
+        c.dispatch("PUT", "/mx/blog/1?refresh=true", b'{"t": "x"}')
+        c.dispatch("PUT", "/mx/_doc/2?refresh=true", b'{"t": "x"}')
+        st, body = c.dispatch("DELETE", "/mx/_doc/_query",
+                              b'{"query": {"match_all": {}}}')
+        # _doc reaches untyped/default-type docs but NOT named types
+        assert body["_indices"]["_all"]["deleted"] == 1, body
+        c.dispatch("POST", "/mx/_refresh", b"")
+        _, out = c.dispatch("GET", "/mx/blog/1", b"")
+        assert out["found"]
+
+    def test_q_param_is_query_string_not_json(self, rest):
+        n, c = rest
+        c.dispatch("PUT", "/qs", b'{"settings":{"number_of_shards":1}}')
+        c.dispatch("PUT", '/qs/tweet/1?refresh=true', b'{"t": "hello"}')
+        # a q value that happens to parse as JSON must still be treated
+        # as a query_string query, not a body
+        st, body = c.dispatch("DELETE", '/qs/_query?q=%7B%22t%22%3A1%7D', b"")
+        assert st == 200, body
+        assert body["_indices"]["_all"]["found"] == 0
