@@ -356,10 +356,12 @@ class SearchActions:
         name, shard = request["index"], request["shard"]
         bodies = request["bodies"]
         svc = self.node.indices_service.index(name)
-        reader = device_reader_for(svc.engine(shard))
+        engine = svc.engine(shard)
+        reader = device_reader_for(engine)
         searcher = ShardSearcher(shard, reader, svc.mapper_service,
                                  index_name=name,
-                                 doc_slot=request.get("doc_slot"))
+                                 doc_slot=request.get("doc_slot"),
+                                 version_fn=engine.doc_version)
         reqs, errors = [], {}
         for i, body in enumerate(bodies):
             try:
@@ -460,7 +462,9 @@ class SearchActions:
             from elasticsearch_tpu.search.dfs import to_execution_stats
             searcher = ShardSearcher(shard, reader, svc.mapper_service,
                                      index_name=name, doc_slot=doc_slot,
-                                     dfs_stats=to_execution_stats(dfs))
+                                     dfs_stats=to_execution_stats(dfs),
+                                     version_fn=svc.engine(shard)
+                                     .doc_version)
             req = parse_search_request(body)
             result = searcher.query_phase(req)
             q_ms = (time.perf_counter() - t0) * 1000.0

@@ -368,6 +368,11 @@ class AnalysisRegistry:
         self.tokenizers: dict[str, Tokenizer] = dict(TOKENIZERS)
         self.tokenizers["ngram"] = ngram_tokenizer_factory()
         self.filters: dict[str, TokenFilter] = dict(TOKEN_FILTERS)
+        # stored index settings carry the "index." prefix (IndexMetaData
+        # normalization); analysis components must resolve either form
+        index_settings = Settings(
+            {(k[len("index."):] if k.startswith("index.") else k): v
+             for k, v in dict(index_settings).items()})
         self._build_components(index_settings)
         self._build_custom(index_settings)
 

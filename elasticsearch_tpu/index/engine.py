@@ -369,6 +369,16 @@ class Engine:
             self.stats.delete_total += 1
             return new_version
 
+    def doc_version(self, doc_id: str) -> int | None:
+        """Current version of a live doc (None if absent/deleted) — feeds
+        search hits' _version (version:true) and delete-by-query's
+        optimistic per-doc deletes."""
+        with self._lock:
+            entry = self._versions.get(doc_id)
+            if entry is None or entry.deleted:
+                return None
+            return entry.version
+
     def get(self, doc_id: str, realtime: bool = True) -> GetResult:
         """Realtime get (reference: ShardGetService.java:68 — reads from the
         version map / translog without waiting for refresh). With

@@ -27,6 +27,8 @@ import numpy as np
 
 from json import dumps as _json_dumps
 
+from elasticsearch_tpu.utils.murmur3 import hash128_x64_h1
+
 from elasticsearch_tpu.analysis import AnalysisRegistry, Token
 from elasticsearch_tpu.common.errors import MapperParsingError, IllegalArgumentError
 from elasticsearch_tpu.common.settings import parse_bool
@@ -281,7 +283,6 @@ class FieldMapper:
                     # cardinality aggs on pre-hashed values. f64 storage
                     # keeps 53 of the 64 bits; collisions stay negligible
                     # for distinct-count purposes
-                    from elasticsearch_tpu.utils.murmur3 import hash128_x64_h1
                     pf.numerics.append(
                         float(hash128_x64_h1(str(v).encode("utf-8"))))
                 else:
@@ -451,14 +452,17 @@ class DocumentMapper:
                     fields[key] = ParsedField(name=key, kind="numeric",
                                               numerics=[float(v)])
         if self.size_enabled:
-            # UTF-8 byte length of the (compact re-serialized) source —
-            # ensure_ascii would count escape sequences, inflating every
-            # non-ASCII char ~3x vs the bytes ES measures
+            # the REST layer threads the on-the-wire source length in as
+            # meta._source_bytes (what SizeFieldMapper measures); embedded
+            # callers without raw bytes fall back to a compact UTF-8
+            # re-serialization (ensure_ascii would inflate non-ASCII ~3x)
+            raw_len = (meta or {}).get("_source_bytes")
             fields["_size"] = ParsedField(
                 name="_size", kind="numeric",
-                numerics=[float(len(_json_dumps(
-                    source, separators=(",", ":"),
-                    ensure_ascii=False).encode("utf-8")))])
+                numerics=[float(raw_len if raw_len is not None else
+                                len(_json_dumps(
+                                    source, separators=(",", ":"),
+                                    ensure_ascii=False).encode("utf-8")))])
         return ParsedDocument(doc_id=doc_id, source=dict(source), fields=fields,
                               routing=routing, nested=nested)
 

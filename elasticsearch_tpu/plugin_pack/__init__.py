@@ -1,0 +1,19 @@
+"""In-tree plugin pack — stand-ins for the reference's bundled plugins.
+
+The reference ships 21 in-tree plugins (SURVEY.md §2.9; plugins/ in the
+source tree) extending the same SPI seams elasticsearch_tpu.plugins
+exposes. This package provides working equivalents for the feasible ones
+in a zero-egress, pure-Python environment:
+
+* analysis_extra — analysis-icu / analysis-phonetic / analysis-kuromoji /
+  analysis-smartcn / analysis-stempel analyzer + filter providers
+* cloud — repository-s3 / repository-azure blobstore types (local-root
+  emulation behind the same repository contract) and the
+  discovery-ec2/gce/azure settings surfaces
+
+Script-language plugins (lang-groovy/javascript/python/expression) need no
+separate providers here: every script surface routes through the one
+restricted-AST expression engine (search/scripts.py), which accepts the
+`doc['f'].value`-style subset those languages share; `lang` tags are
+carried verbatim by the stored-scripts APIs.
+"""

@@ -1,0 +1,230 @@
+"""Analysis plugin stand-ins: icu, phonetic, kuromoji, smartcn, stempel.
+
+Reference plugins (SURVEY.md §2.9): plugins/analysis-icu (ICU normalizer /
+folding), analysis-phonetic (soundex/metaphone token filters),
+analysis-kuromoji (Japanese), analysis-smartcn (Chinese), analysis-stempel
+(Polish). Each registers providers through ``onModule(AnalysisModule)``;
+here the same names register through ``Plugin.analysis(registry)``.
+
+The CJK analyzers use the bigram strategy of Lucene's CJKAnalyzer (the
+pre-morphological default the reference also falls back to): Han/Kana
+runs emit overlapping bigrams, Latin runs emit lowercased words. It is
+not a lattice morphological analyzer, but it gives the same
+recall-oriented behavior for mixed CJK text with zero native deps.
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+
+from elasticsearch_tpu.analysis.analyzers import (
+    Analyzer, Token, lowercase_filter, standard_tokenizer)
+from elasticsearch_tpu.plugins import Plugin
+
+# ---------------------------------------------------------------------------
+# ICU: normalization + diacritic folding (ICUFoldingFilter analog)
+# ---------------------------------------------------------------------------
+
+
+def icu_fold(text: str) -> str:
+    """NFKC-normalize, casefold, strip combining marks — the practical
+    core of ICUFoldingFilter (analysis-icu)."""
+    text = unicodedata.normalize("NFKC", text).casefold()
+    decomposed = unicodedata.normalize("NFD", text)
+    return "".join(c for c in decomposed if not unicodedata.combining(c))
+
+
+def icu_folding_filter(tokens: list[Token]) -> list[Token]:
+    return [Token(icu_fold(t.term), t.position, t.start_offset,
+                  t.end_offset) for t in tokens]
+
+
+def icu_normalizer_filter(tokens: list[Token]) -> list[Token]:
+    return [Token(unicodedata.normalize("NFKC", t.term).casefold(),
+                  t.position, t.start_offset, t.end_offset) for t in tokens]
+
+
+# ---------------------------------------------------------------------------
+# Phonetic encoders (analysis-phonetic: PhoneticTokenFilterFactory)
+# ---------------------------------------------------------------------------
+
+_SOUNDEX_CODES = {
+    **dict.fromkeys("bfpv", "1"), **dict.fromkeys("cgjkqsxz", "2"),
+    **dict.fromkeys("dt", "3"), "l": "4", **dict.fromkeys("mn", "5"),
+    "r": "6"}
+
+
+def soundex(word: str) -> str:
+    """American Soundex (the plugin's "soundex" encoder)."""
+    word = "".join(c for c in word.lower() if c.isalpha())
+    if not word:
+        return ""
+    first = word[0].upper()
+    # h/w are transparent between same-coded consonants; vowels break runs
+    out, prev = [], _SOUNDEX_CODES.get(word[0], "")
+    for c in word[1:]:
+        code = _SOUNDEX_CODES.get(c, "")
+        if code and code != prev:
+            out.append(code)
+        if c not in "hw":
+            prev = code
+    return (first + "".join(out) + "000")[:4]
+
+
+_METAPHONE_DROP = re.compile(r"[^a-z]")
+
+
+def metaphone(word: str) -> str:
+    """A compact metaphone variant (the plugin's "metaphone" encoder):
+    collapses the classic consonant classes; close-enough phonetic
+    bucketing for match parity tests."""
+    w = _METAPHONE_DROP.sub("", word.lower())
+    if not w:
+        return ""
+    subs = [("ph", "f"), ("gh", "h"), ("ck", "k"), ("sch", "sk"),
+            ("th", "0"), ("sh", "x"), ("ch", "x"), ("dg", "j"),
+            ("wh", "w")]
+    for a, b in subs:
+        w = w.replace(a, b)
+    out = [w[0]]
+    for c in w[1:]:
+        c = {"b": "b", "c": "k", "d": "t", "g": "k", "p": "b", "q": "k",
+             "s": "s", "z": "s", "v": "f", "y": "", "a": "", "e": "",
+             "i": "", "o": "", "u": ""}.get(c, c)
+        if c and c != out[-1]:
+            out.append(c)
+    return "".join(out).upper()
+
+
+def phonetic_filter_factory(params: dict):
+    encoder = {"soundex": soundex, "metaphone": metaphone,
+               "double_metaphone": metaphone}.get(
+        str(params.get("encoder", "metaphone")).lower(), metaphone)
+    replace = str(params.get("replace", "true")).lower() in ("true", "1")
+
+    def phonetic(tokens: list[Token]) -> list[Token]:
+        out = []
+        for t in tokens:
+            code = encoder(t.term)
+            if not code:
+                out.append(t)
+                continue
+            out.append(Token(code, t.position, t.start_offset, t.end_offset))
+            if not replace:
+                out.append(t)           # emit original at the same position
+        return out
+    return phonetic
+
+
+# ---------------------------------------------------------------------------
+# CJK bigrams (kuromoji / smartcn stand-in; Lucene CJKAnalyzer strategy)
+# ---------------------------------------------------------------------------
+
+_CJK_RUN = re.compile(
+    r"[぀-ヿ㐀-䶿一-鿿豈-﫿]+")
+_LATIN_RUN = re.compile(r"\w+", re.UNICODE)
+
+
+def cjk_bigram_tokenizer(text: str) -> list[Token]:
+    out: list[Token] = []
+    pos = 0
+    i = 0
+    while i < len(text):
+        m = _CJK_RUN.match(text, i)
+        if m:
+            run = m.group(0)
+            if len(run) == 1:
+                out.append(Token(run, pos, m.start(), m.end()))
+                pos += 1
+            else:
+                for j in range(len(run) - 1):
+                    out.append(Token(run[j:j + 2], pos,
+                                     m.start() + j, m.start() + j + 2))
+                    pos += 1
+            i = m.end()
+            continue
+        m = _LATIN_RUN.match(text, i)
+        if m and not _CJK_RUN.match(m.group(0)):
+            out.append(Token(m.group(0).lower(), pos, m.start(), m.end()))
+            pos += 1
+            i = m.end()
+            continue
+        i += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Polish light stemmer (stempel stand-in)
+# ---------------------------------------------------------------------------
+
+_POLISH_SUFFIXES = ("owała", "owali", "owało", "ałaś", "ałem", "iłem",
+                    "iłam", "ach", "ami", "ach", "owi", "ach", "iem",
+                    "em", "om", "ów", "ą", "ę", "a", "i", "y", "e", "u",
+                    "o")
+
+
+def polish_stem_filter(tokens: list[Token]) -> list[Token]:
+    out = []
+    for t in tokens:
+        term = t.term
+        for suf in _POLISH_SUFFIXES:
+            if len(term) - len(suf) >= 3 and term.endswith(suf):
+                term = term[:-len(suf)]
+                break
+        out.append(Token(term, t.position, t.start_offset, t.end_offset))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Plugin classes
+# ---------------------------------------------------------------------------
+
+
+class IcuAnalysisPlugin(Plugin):
+    """analysis-icu: icu_analyzer + icu_folding / icu_normalizer filters."""
+    name = "analysis-icu"
+
+    def analysis(self, registry) -> None:
+        registry.analyzers["icu_analyzer"] = Analyzer(
+            "icu_analyzer", standard_tokenizer, [icu_folding_filter])
+        registry.filter_factories["icu_folding"] = \
+            lambda params: icu_folding_filter
+        registry.filter_factories["icu_normalizer"] = \
+            lambda params: icu_normalizer_filter
+
+
+class PhoneticAnalysisPlugin(Plugin):
+    """analysis-phonetic: the "phonetic" token filter type."""
+    name = "analysis-phonetic"
+
+    def analysis(self, registry) -> None:
+        registry.filter_factories["phonetic"] = phonetic_filter_factory
+
+
+class KuromojiAnalysisPlugin(Plugin):
+    """analysis-kuromoji: "kuromoji" analyzer (CJK bigram strategy)."""
+    name = "analysis-kuromoji"
+
+    def analysis(self, registry) -> None:
+        registry.analyzers["kuromoji"] = Analyzer(
+            "kuromoji", cjk_bigram_tokenizer)
+
+
+class SmartcnAnalysisPlugin(Plugin):
+    """analysis-smartcn: "smartcn" analyzer (CJK bigram strategy)."""
+    name = "analysis-smartcn"
+
+    def analysis(self, registry) -> None:
+        registry.analyzers["smartcn"] = Analyzer(
+            "smartcn", cjk_bigram_tokenizer)
+
+
+class StempelAnalysisPlugin(Plugin):
+    """analysis-stempel: "polish" analyzer (light suffix stemmer)."""
+    name = "analysis-stempel"
+
+    def analysis(self, registry) -> None:
+        registry.analyzers["polish"] = Analyzer(
+            "polish", standard_tokenizer,
+            [lowercase_filter, polish_stem_filter])

@@ -1,0 +1,103 @@
+"""Cloud plugin stand-ins: repository-s3 / repository-azure and the
+discovery-ec2 / discovery-gce / discovery-azure settings surfaces.
+
+Reference plugins (SURVEY.md §2.9): plugins/repository-s3 and
+repository-azure register blob-store repository types through the same
+repository contract core fs/url use (BlobStoreRepository,
+core/repositories/blobstore/BlobStoreRepository.java:118); the discovery
+plugins contribute unicast ping providers resolved from cloud APIs.
+
+This environment has zero network egress, so the object-store repository
+types are backed by the SAME blobstore layout rooted at a local directory:
+``settings.bucket``/``settings.container`` + ``base_path`` select a
+subtree under ``repositories.<type>.root`` (node setting) or
+``settings.local_root``. Snapshot bytes, incremental dedupe and restore
+flow through the identical repository interface — swapping the directory
+client for a real S3/Azure client is deployment plumbing, not framework
+structure. The discovery plugins validate their settings surface and
+resolve ``discovery.<cloud>.hosts`` (explicitly configured endpoints);
+live cloud-API enumeration is likewise gated on egress.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from elasticsearch_tpu.plugins import Plugin
+from elasticsearch_tpu.repositories.repository import (
+    REPOSITORY_TYPES, FsRepository, RepositoryError)
+
+
+def _object_store_factory(rtype: str, container_key: str):
+    def factory(name: str, settings: dict) -> FsRepository:
+        container = settings.get(container_key)
+        if not container:
+            raise RepositoryError(
+                f"repository [{name}] of type [{rtype}] requires "
+                f"settings.{container_key}")
+        root = settings.get("local_root")
+        if not root:
+            raise RepositoryError(
+                f"repository [{name}]: [{rtype}] has no network egress "
+                f"here — set settings.local_root to the directory standing "
+                f"in for the object store")
+        base = settings.get("base_path", "").strip("/")
+        location = Path(root) / str(container)
+        if base:
+            location = location / base
+        return FsRepository(name, str(location))
+    return factory
+
+
+class S3RepositoryPlugin(Plugin):
+    """repository-s3: "s3" repository type (bucket/base_path layout)."""
+    name = "repository-s3"
+
+    def on_node_start(self, node) -> None:
+        REPOSITORY_TYPES["s3"] = _object_store_factory("s3", "bucket")
+
+    def on_node_stop(self, node) -> None:
+        REPOSITORY_TYPES.pop("s3", None)
+
+
+class AzureRepositoryPlugin(Plugin):
+    """repository-azure: "azure" repository type (container layout)."""
+    name = "repository-azure"
+
+    def on_node_start(self, node) -> None:
+        REPOSITORY_TYPES["azure"] = _object_store_factory("azure",
+                                                          "container")
+
+    def on_node_stop(self, node) -> None:
+        REPOSITORY_TYPES.pop("azure", None)
+
+
+class _CloudDiscoveryPlugin(Plugin):
+    """Shared shape of the discovery-{ec2,gce,azure} stand-ins: hosts come
+    from ``discovery.<cloud>.hosts`` settings instead of a cloud API."""
+
+    cloud = ""
+
+    def node_settings(self) -> dict:
+        return {f"discovery.{self.cloud}.enabled": "false"}
+
+    def hosts(self, node) -> list[str]:
+        raw = node.settings.get(f"discovery.{self.cloud}.hosts", "")
+        if isinstance(raw, (list, tuple)):
+            return [str(h) for h in raw]
+        return [h.strip() for h in str(raw).split(",") if h.strip()]
+
+
+class Ec2DiscoveryPlugin(_CloudDiscoveryPlugin):
+    name = "discovery-ec2"
+    cloud = "ec2"
+
+
+class GceDiscoveryPlugin(_CloudDiscoveryPlugin):
+    name = "discovery-gce"
+    cloud = "gce"
+
+
+class AzureDiscoveryPlugin(_CloudDiscoveryPlugin):
+    name = "discovery-azure"
+    cloud = "azure"

@@ -14,7 +14,8 @@ use); each class is imported and instantiated once per node. Hooks:
 * ``node_settings()``    — defaults merged UNDER user settings
 * ``on_node_start(node)`` — service wiring after the node is up
 * ``rest_routes(controller, node)`` — extra REST endpoints
-* ``analysis(registry)`` — register analyzers/tokenizers/filters
+* ``analysis(module)``   — register analyzers/tokenizers/filter factories
+  (module.analyzers / .tokenizers / .filter_factories dicts)
 * ``script_functions()`` — extra vectorized script functions
 * ``query_parsers()``    — {name: fn(body)->Query} extra query DSL types
 * ``on_node_stop(node)`` — teardown
@@ -61,6 +62,20 @@ def _global_unregister(registry: dict, key: str) -> None:
                 registry.pop(key, None)
             else:
                 registry[key] = ref[1]
+
+
+class _AnalysisModule:
+    """What ``Plugin.analysis`` receives — the onModule(AnalysisModule)
+    seam: process-wide provider registries every per-index
+    AnalysisRegistry copies at creation."""
+
+    __slots__ = ("analyzers", "tokenizers", "filter_factories")
+
+    def __init__(self, analyzers: dict, tokenizers: dict,
+                 filter_factories: dict):
+        self.analyzers = analyzers
+        self.tokenizers = tokenizers
+        self.filter_factories = filter_factories
 
 
 class Plugin:
@@ -124,26 +139,33 @@ class PluginsService:
         return out
 
     def apply_node_start(self, node) -> None:
-        from elasticsearch_tpu.analysis.analyzers import BUILTIN_ANALYZERS
+        from elasticsearch_tpu.analysis import analyzers as analysis_mod
         from elasticsearch_tpu.search import query_dsl
         from elasticsearch_tpu.search import scripts as script_mod
         self._undo: list = []
+        module = _AnalysisModule(
+            analysis_mod.BUILTIN_ANALYZERS, analysis_mod.TOKENIZERS,
+            analysis_mod.TOKEN_FILTER_FACTORIES)
         for p in self.plugins:
             for fname, fn in p.script_functions().items():
                 _global_register(script_mod._FUNCS, fname, fn, self._undo)
             for qname, parser in p.query_parsers().items():
                 _global_register(query_dsl.EXTRA_PARSERS, qname, parser,
                                  self._undo)
-            # analyzer providers land in the builtin registry, which every
-            # per-index AnalysisRegistry copies at creation (the
-            # onModule(AnalysisModule) seam); snapshot-diff the dict so
-            # stop can restore displaced builtins
-            before = dict(BUILTIN_ANALYZERS)
-            p.analysis(BUILTIN_ANALYZERS)
-            for name in set(BUILTIN_ANALYZERS) | set(before):
-                if BUILTIN_ANALYZERS.get(name) is not before.get(name):
-                    _note_registration(BUILTIN_ANALYZERS, name,
-                                       before.get(name, _MISSING), self._undo)
+            # analyzer/tokenizer/filter providers land in the builtin
+            # registries, which every per-index AnalysisRegistry copies at
+            # creation (the onModule(AnalysisModule) seam); snapshot-diff
+            # each dict so stop can restore displaced builtins
+            befores = [(d, dict(d)) for d in
+                       (module.analyzers, module.tokenizers,
+                        module.filter_factories)]
+            p.analysis(module)
+            for registry, before in befores:
+                for name in set(registry) | set(before):
+                    if registry.get(name) is not before.get(name):
+                        _note_registration(registry, name,
+                                           before.get(name, _MISSING),
+                                           self._undo)
             p.on_node_start(node)
 
     def apply_rest(self, controller, node) -> None:

@@ -41,13 +41,25 @@ class SnapshotAlreadyExistsError(RepositoryError):
     pass
 
 
+# plugin-registrable repository types: {type: factory(name, settings)}
+# (the reference's RepositoriesModule.registerRepository seam — s3/azure
+# plugins add their types here)
+REPOSITORY_TYPES: dict = {}
+
+
 def repository_for(name: str, spec: dict) -> "FsRepository":
     """Instantiate a repository from its cluster-state registration
     ({"type": ..., "settings": {...}}). "fs" and read-only "url" ship
     in-core, like the reference (core/repositories/{fs,uri}/; s3/azure
-    arrive as plugins via the same contract)."""
+    arrive as plugins via the same contract — REPOSITORY_TYPES)."""
     rtype = spec.get("type", "fs")
     settings = spec.get("settings") or {}
+    # plugin registrations take precedence over the in-core types so a
+    # plugin can uniformly override ANY name (incl. url/fs) — one rule,
+    # no special cases
+    factory = REPOSITORY_TYPES.get(rtype)
+    if factory is not None:
+        return factory(name, settings)
     if rtype == "url":
         url = settings.get("url")
         if not url:

@@ -954,12 +954,18 @@ class Handlers:
     def _write_meta(self, req: RestRequest, index: str,
                     body: dict | None = None) -> dict | None:
         body = body or {}
-        return self._doc_meta_fields(
+        meta = self._doc_meta_fields(
             index, req.path_params.get("type"),
             parent=req.param("parent", body.get("parent")),
             routing=req.param("routing", body.get("routing")),
             timestamp=req.param("timestamp", body.get("timestamp")),
             ttl=req.param("ttl", body.get("ttl")))
+        if req.raw_body:
+            # on-the-wire source length — what mapper-size's _size records
+            # (whitespace and escapes as the client sent them)
+            meta = dict(meta or {})
+            meta["_source_bytes"] = len(req.raw_body)
+        return meta
 
     def _doc_meta_fields(self, index: str, tname: str | None, *,
                          parent=None, routing=None, timestamp=None,
@@ -1338,6 +1344,8 @@ class Handlers:
                             f"malformed bulk body: action [{action}] "
                             f"without a source line")
                     source = json.loads(lines[i])
+                    mf = meta.setdefault("_meta_fields", {})
+                    mf["_source_bytes"] = len(lines[i].encode("utf-8"))
                     i += 1
                 if action == "update":
                     # `fields` may ride the header line or the URL — fold
@@ -1787,6 +1795,7 @@ class Handlers:
         search_body = {"query": query, "size": 500, "version": True,
                        "fields": ["_routing", "_parent"],
                        "_source": False}
+        failures: list[dict] = []
         resp = self.node.search(index, search_body, scroll=keep)
         sid = resp.get("_scroll_id")
         try:
@@ -1799,15 +1808,25 @@ class Handlers:
                     c[0] += 1
                     routing = h.get("_routing") or h.get("_parent")
                     try:
+                        # optimistic delete pinned to the SCANNED version:
+                        # a doc updated between scan and delete survives
+                        # as a version conflict (the reference sets the
+                        # scroll hit's version on each DeleteRequest)
                         self.node.delete_doc(h["_index"], h["_id"],
-                                             routing=routing)
+                                             routing=routing,
+                                             version=h.get("_version"))
                         c[1] += 1
                     except DocumentMissingError:
                         # deleted concurrently between scroll and delete —
                         # the reference counts isFound()==false as missing
                         c[2] += 1
-                    except Exception:              # noqa: BLE001
+                    except Exception as e:         # noqa: BLE001
                         c[3] += 1
+                        if len(failures) < 100:    # bounded detail
+                            failures.append({
+                                "index": h["_index"], "id": h["_id"],
+                                "status": getattr(e, "status", 500),
+                                "reason": str(e)})
                 if sid is None:
                     break
                 resp = self.node.search_actions.scroll(sid, keep)
@@ -1823,7 +1842,7 @@ class Handlers:
                              "missing": c[2], "failed": c[3]}
         return 200, {"took": int((time.perf_counter() - t0) * 1000),
                      "timed_out": False, "_indices": indices,
-                     "failures": []}
+                     "failures": failures}
 
     def scroll(self, req: RestRequest):
         body = req.body or {}

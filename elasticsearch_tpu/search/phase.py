@@ -66,6 +66,7 @@ class ParsedSearchRequest:
     suggest: list = field(default_factory=list)    # [SuggestSpec]
     stored_fields: list = field(default_factory=list)
     docvalue_fields: list = field(default_factory=list)
+    version: bool = False                          # render _version per hit
     terminate_after: int | None = None             # per-shard collected cap
     timeout_ms: float | None = None                # per-shard time budget
     rescore: list[RescoreSpec] = field(default_factory=list)
@@ -94,6 +95,7 @@ def parse_search_request(body: dict | None) -> ParsedSearchRequest:
     req.highlight = body.get("highlight")
     req.search_after = body.get("search_after")
     req.explain = bool(body.get("explain", False))
+    req.version = bool(body.get("version", False))
     req.script_fields = body.get("script_fields", {})
     raw_dvf = body.get("fielddata_fields", body.get("docvalue_fields", []))
     req.docvalue_fields = [raw_dvf] if isinstance(raw_dvf, str) \
@@ -173,10 +175,12 @@ class ShardSearcher:
 
     def __init__(self, shard_id: int, reader: DeviceReader, mapper_service,
                  index_name: str = "", doc_slot: int | None = None,
-                 dfs_stats: dict | None = None):
+                 dfs_stats: dict | None = None, version_fn=None):
         self.shard_id = shard_id
         self.reader = reader
         self.mapper_service = mapper_service
+        # doc_id → live version (engine.doc_version) for version:true hits
+        self.version_fn = version_fn
         # 11-bit (index, shard) slot for the _doc tie-break: doc ids use
         # bits 0-41, the slot bits 42-52 — all within float64's 53-bit
         # mantissa so cross-shard search_after cursors stay exact. The
@@ -819,6 +823,10 @@ class ShardSearcher:
                 "_id": seg.seg.ids[local],
                 "_score": (float(result.scores[pos]) if emit_score else None),
             }
+            if req.version and self.version_fn is not None:
+                v = self.version_fn(hit["_id"])
+                if v is not None:
+                    hit["_version"] = v
             # requested metadata fields render at the TOP level of the hit
             # (InternalSearchHit.toXContent puts metadata fields beside
             # _id, not under "fields" — the 2.x shape delete-by-query's

@@ -1,0 +1,184 @@
+"""In-tree plugin pack (SURVEY.md §2.9 stand-ins): analysis-icu/phonetic/
+kuromoji/smartcn/stempel analyzer providers, repository-s3/azure object-
+store repository types, discovery-* settings surfaces — all loaded
+through the same Plugin SPI the reference's onModule seams express."""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.plugin_pack.analysis_extra import (
+    IcuAnalysisPlugin, KuromojiAnalysisPlugin, PhoneticAnalysisPlugin,
+    StempelAnalysisPlugin, icu_fold, metaphone, soundex)
+from elasticsearch_tpu.plugin_pack.cloud import (Ec2DiscoveryPlugin,
+                                                 S3RepositoryPlugin)
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node({"plugins": [IcuAnalysisPlugin(), PhoneticAnalysisPlugin(),
+                          KuromojiAnalysisPlugin(), StempelAnalysisPlugin(),
+                          S3RepositoryPlugin(), Ec2DiscoveryPlugin()]},
+             data_path=tmp_path / "n").start()
+    yield n
+    n.close()
+
+
+class TestEncoders:
+    def test_soundex_classic_vectors(self):
+        # published American-Soundex vectors
+        assert soundex("Robert") == "R163"
+        assert soundex("Rupert") == "R163"
+        assert soundex("Tymczak") == "T522"
+        assert soundex("Pfister") == "P236"
+        assert soundex("Honeyman") == "H555"
+
+    def test_metaphone_buckets_homophones(self):
+        assert metaphone("smith") == metaphone("smyth")
+        assert metaphone("phone") == metaphone("fone")
+
+    def test_icu_fold(self):
+        assert icu_fold("Café") == "cafe"
+        assert icu_fold("ﬁn") == "fin"          # NFKC ligature expansion
+
+
+class TestAnalysisPluginsEndToEnd:
+    def test_icu_analyzer_folds_diacritics(self, node):
+        node.indices_service.create_index("icu", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"_doc": {"properties": {
+                "t": {"type": "text", "analyzer": "icu_analyzer"}}}}})
+        node.index_doc("icu", "1", {"t": "Café au lait"}, refresh=True)
+        r = node.search("icu", {"query": {"match": {"t": "cafe"}}})
+        assert r["hits"]["total"] == 1
+
+    def test_phonetic_filter_matches_misspelling(self, node):
+        node.indices_service.create_index("ph", {
+            "settings": {
+                "number_of_shards": 1, "number_of_replicas": 0,
+                "analysis": {
+                    "filter": {"snd": {"type": "phonetic",
+                                       "encoder": "soundex"}},
+                    "analyzer": {"names": {
+                        "type": "custom", "tokenizer": "standard",
+                        "filter": ["lowercase", "snd"]}}}},
+            "mappings": {"_doc": {"properties": {
+                "name": {"type": "text", "analyzer": "names"}}}}})
+        node.index_doc("ph", "1", {"name": "Smith"}, refresh=True)
+        r = node.search("ph", {"query": {"match": {"name": "Smyth"}}})
+        assert r["hits"]["total"] == 1
+
+    def test_kuromoji_bigrams_match_cjk(self, node):
+        node.indices_service.create_index("jp", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"_doc": {"properties": {
+                "t": {"type": "text", "analyzer": "kuromoji"}}}}})
+        node.index_doc("jp", "1", {"t": "東京都に住む"}, refresh=True)
+        r = node.search("jp", {"query": {"match": {"t": "東京"}}})
+        assert r["hits"]["total"] == 1
+
+    def test_polish_stemmer_conflates_inflections(self, node):
+        node.indices_service.create_index("pl", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"_doc": {"properties": {
+                "t": {"type": "text", "analyzer": "polish"}}}}})
+        node.index_doc("pl", "1", {"t": "domami"}, refresh=True)
+        r = node.search("pl", {"query": {"match": {"t": "domem"}}})
+        assert r["hits"]["total"] == 1
+
+
+class TestObjectStoreRepositories:
+    def test_s3_repo_snapshot_restore_roundtrip(self, node, tmp_path):
+        node.indices_service.create_index("src", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0}})
+        node.index_doc("src", "1", {"t": "hello"}, refresh=True)
+        snaps = node.snapshots_service
+        snaps.put_repository("repo", {
+            "type": "s3",
+            "settings": {"bucket": "my-bucket", "base_path": "snaps",
+                         "local_root": str(tmp_path / "s3root")}})
+        snaps.create_snapshot("repo", "snap1",
+                              {"indices": "src",
+                               "wait_for_completion": True})
+        node.indices_service.delete_index("src")
+        snaps.restore_snapshot("repo", "snap1", {})
+        node.wait_for_health("yellow", 10.0)
+        r = node.search("src", {"query": {"match_all": {}}})
+        assert r["hits"]["total"] == 1
+        # the blobstore landed under bucket/base_path, fs layout
+        assert (tmp_path / "s3root" / "my-bucket" / "snaps").exists()
+
+    def test_s3_repo_requires_bucket_and_root(self, node):
+        from elasticsearch_tpu.repositories.repository import (
+            RepositoryError, repository_for)
+        with pytest.raises(RepositoryError):
+            repository_for("r", {"type": "s3", "settings": {}})
+        with pytest.raises(RepositoryError):
+            repository_for("r", {"type": "s3",
+                                 "settings": {"bucket": "b"}})
+
+
+class TestCloudDiscoverySettings:
+    def test_hosts_from_settings(self, tmp_path):
+        plug = Ec2DiscoveryPlugin()
+        n = Node({"plugins": [plug],
+                  "discovery.ec2.hosts": "10.0.0.1:9300, 10.0.0.2:9300"},
+                 data_path=tmp_path / "d").start()
+        try:
+            assert plug.hosts(n) == ["10.0.0.1:9300", "10.0.0.2:9300"]
+        finally:
+            n.close()
+
+
+class TestVersionedDeleteByQuery:
+    def test_version_rendered_in_hits(self, node):
+        node.indices_service.create_index("vv", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0}})
+        node.index_doc("vv", "1", {"t": "x"})
+        node.index_doc("vv", "1", {"t": "y"}, )   # bump to v2
+        node.broadcast_actions.refresh("vv")
+        r = node.search("vv", {"query": {"match_all": {}},
+                               "version": True})
+        assert r["hits"]["hits"][0]["_version"] == 2
+
+    def test_concurrent_update_survives_dbq(self, node, monkeypatch):
+        from elasticsearch_tpu.rest.controller import RestController
+        from elasticsearch_tpu.rest.handlers import register_all
+        c = RestController()
+        register_all(c, node)
+        c.dispatch("PUT", "/cv", b'{"settings":{"number_of_shards":1}}')
+        c.dispatch("PUT", "/cv/t/1?refresh=true", b'{"x": "drop"}')
+        # simulate an update racing between scan and delete: bump the
+        # version after the scroll page is taken
+        real_delete = node.delete_doc
+        def racing_delete(index, doc_id, **kw):
+            node.index_doc(index, doc_id, {"x": "keep"})    # v2
+            return real_delete(index, doc_id, **kw)
+        monkeypatch.setattr(node, "delete_doc", racing_delete)
+        st, body = c.dispatch("DELETE", "/cv/_query",
+                              b'{"query": {"match": {"x": "drop"}}}')
+        # versioned delete conflicts -> failed (not silently deleted)
+        assert body["_indices"]["_all"]["failed"] == 1, body
+        assert body["failures"] and body["failures"][0]["id"] == "1"
+        monkeypatch.undo()
+        c.dispatch("POST", "/cv/_refresh", b"")
+        _, out = c.dispatch("GET", "/cv/t/1", b"")
+        assert out["found"] and out["_source"]["x"] == "keep"
+
+
+class TestSizeUsesWireBytes:
+    def test_size_counts_raw_body_bytes(self, node):
+        from elasticsearch_tpu.rest.controller import RestController
+        from elasticsearch_tpu.rest.handlers import register_all
+        c = RestController()
+        register_all(c, node)
+        c.dispatch("PUT", "/szb", b'{"settings":{"number_of_shards":1},'
+                   b'"mappings":{"t":{"_size":{"enabled":true}}}}')
+        raw = b'{  "t" :  "caf\xc3\xa9"  }'     # whitespace + UTF-8
+        c.dispatch("PUT", "/szb/t/1?refresh=true", raw)
+        r = node.search("szb", {"query": {"match_all": {}},
+                                "fields": ["_size"],
+                                "docvalue_fields": []})
+        # exact on-the-wire length, not a re-serialization
+        got = node.search("szb", {"query": {"range": {"_size": {
+            "gte": len(raw), "lte": len(raw)}}}})
+        assert got["hits"]["total"] == 1
