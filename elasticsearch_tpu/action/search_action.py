@@ -463,8 +463,7 @@ class SearchActions:
             searcher = ShardSearcher(shard, reader, svc.mapper_service,
                                      index_name=name, doc_slot=doc_slot,
                                      dfs_stats=to_execution_stats(dfs),
-                                     version_fn=svc.engine(shard)
-                                     .doc_version)
+                                     version_fn=engine.doc_version)
             req = parse_search_request(body)
             result = searcher.query_phase(req)
             q_ms = (time.perf_counter() - t0) * 1000.0
@@ -581,7 +580,8 @@ class SearchActions:
     # aliases there; query_and_fetch IS this implementation's execution
     # model, see module docstring)
     SEARCH_TYPES = (None, "query_then_fetch", "query_and_fetch",
-                    "dfs_query_then_fetch", "dfs_query_and_fetch")
+                    "dfs_query_then_fetch", "dfs_query_and_fetch",
+                    "scan", "count")
 
     def search(self, index_expr: str, body: dict | None = None,
                scroll: str | None = None,
@@ -594,6 +594,23 @@ class SearchActions:
             search_type = "dfs_query_then_fetch"
         t0 = time.perf_counter()
         body = dict(body or {})
+        if search_type == "count":
+            # deprecated alias for size=0 (SearchType.COUNT): hit counting
+            # + aggregations, no fetch phase
+            body["size"] = 0
+            search_type = None
+        scan = search_type == "scan"
+        if scan:
+            # SearchType.SCAN (2.x, deprecated in 2.1): unscored index-
+            # order sweep behind a scroll cursor. First response carries
+            # the total and a scroll id but NO hits; each scroll pulls
+            # size docs per shard in _doc order (QueryPhase.java:161-186
+            # MinDocQuery continuation)
+            if scroll is None:
+                raise IllegalArgumentError(
+                    "scan search type requires a [scroll] parameter")
+            body["sort"] = ["_doc"]
+            search_type = None
         dfs_cache: dict | None = {} if scroll is not None else None
         scroll_pin = None
         if scroll is not None:
@@ -601,6 +618,21 @@ class SearchActions:
             import uuid as _uuid
             keep = parse_time_value(scroll, "scroll")
             scroll_pin = {"uid": _uuid.uuid4().hex, "keep_s": keep}
+        if scan:
+            # per-shard page size, like the reference's scan contexts
+            names = self.node.indices_service.resolve_open(index_expr)
+            n_shards = len(self._shard_groups(
+                self.node.cluster_service.state(), names)) or 1
+            body["size"] = int(body.get("size", 10)) * n_shards
+            probe = dict(body, size=0)
+            resp = self._search_once(index_expr, probe, t0,
+                                     dfs_cache=dfs_cache,
+                                     scroll_pin=scroll_pin)
+            # cursor not advanced: the first scroll() call reads page one
+            resp["_scroll_id"] = self._open_scroll(
+                index_expr, body, scroll, {"hits": {"hits": [{}]}},
+                dfs_cache=dfs_cache, ctx_uid=scroll_pin["uid"])
+            return resp
         resp = self._search_once(index_expr, body, t0,
                                  search_type=search_type,
                                  dfs_cache=dfs_cache,

@@ -141,7 +141,7 @@ def _segment_meta(seg, local: int) -> dict | None:
             o = int(col.ords[local, 0])
             if o >= 0:
                 out[key] = col.vocab[o]
-    for key in ("_timestamp", "_ttl"):
+    for key in ("_timestamp", "_ttl", "_version"):
         col = seg.numeric_fields.get(key)
         if col is not None and local < col.values.shape[0] \
                 and bool(col.exists[local]):
@@ -259,8 +259,13 @@ class Engine:
                     raise VersionConflictError("", doc_id, current, version)
                 new_version = 1 if current == NOT_FOUND else current + 1
 
+            # stamp the resolved version into the doc's columns (the
+            # VersionFieldMapper doc-value): fetched hits read the
+            # point-in-time version from the SEGMENT, not the live map
+            meta = dict(meta or {})
+            meta["_version"] = new_version
             parsed = self.mapper_service.document_mapper(
-                (meta or {}).get("_type")).parse(
+                meta.get("_type")).parse(
                 doc_id, source, routing=routing, meta=meta)
             # supersede any buffered copy of the same doc
             old_buf = self._buffer_docs.get(doc_id)
@@ -296,8 +301,10 @@ class Engine:
             entry = self._versions.get(doc_id)
             if entry is not None and entry.version >= version:
                 return entry.version
+            meta = dict(meta or {})
+            meta["_version"] = version
             parsed = self.mapper_service.document_mapper(
-                (meta or {}).get("_type")).parse(
+                meta.get("_type")).parse(
                 doc_id, source, routing=routing, meta=meta)
             old_buf = self._buffer_docs.get(doc_id)
             if old_buf is not None:
@@ -855,9 +862,11 @@ class Engine:
                 self._versions[op.doc_id] = VersionEntry(op.version, True, -2, -1)
 
     def _apply_replayed_index(self, op: TranslogOp) -> None:
+        meta = dict(op.meta or {})
+        meta["_version"] = op.version
         parsed = self.mapper_service.document_mapper(
-            (op.meta or {}).get("_type")).parse(
-            op.doc_id, op.source, routing=op.routing, meta=op.meta)
+            meta.get("_type")).parse(
+            op.doc_id, op.source, routing=op.routing, meta=meta)
         old_buf = self._buffer_docs.get(op.doc_id)
         if old_buf is not None:
             self._buffer.docs[old_buf] = None

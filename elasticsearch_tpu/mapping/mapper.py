@@ -446,7 +446,7 @@ class DocumentMapper:
                 if v is not None:
                     fields[key] = ParsedField(name=key, kind="keyword",
                                               keywords=[str(v)])
-            for key in ("_timestamp", "_ttl"):
+            for key in ("_timestamp", "_ttl", "_version"):
                 v = meta.get(key)
                 if v is not None:
                     fields[key] = ParsedField(name=key, kind="numeric",

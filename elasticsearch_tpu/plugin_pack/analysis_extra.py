@@ -123,7 +123,10 @@ def phonetic_filter_factory(params: dict):
 
 _CJK_RUN = re.compile(
     r"[぀-ヿ㐀-䶿一-鿿豈-﫿]+")
-_LATIN_RUN = re.compile(r"\w+", re.UNICODE)
+# word chars EXCLUDING the CJK ranges above — \w would swallow a CJK run
+# that follows a Latin char into one giant token (no bigrams emitted)
+_WORD_RUN = re.compile(
+    r"[0-9_A-Za-z\u00C0-\u024F\u0370-\u03FF\u0400-\u04FF\uAC00-\uD7AF]+")
 
 
 def cjk_bigram_tokenizer(text: str) -> list[Token]:
@@ -144,8 +147,8 @@ def cjk_bigram_tokenizer(text: str) -> list[Token]:
                     pos += 1
             i = m.end()
             continue
-        m = _LATIN_RUN.match(text, i)
-        if m and not _CJK_RUN.match(m.group(0)):
+        m = _WORD_RUN.match(text, i)
+        if m:
             out.append(Token(m.group(0).lower(), pos, m.start(), m.end()))
             pos += 1
             i = m.end()

@@ -21,6 +21,7 @@ live cloud-API enumeration is likewise gated on egress.
 
 from __future__ import annotations
 
+import threading
 from pathlib import Path
 
 from elasticsearch_tpu.plugins import Plugin
@@ -49,15 +50,38 @@ def _object_store_factory(rtype: str, container_key: str):
     return factory
 
 
+# REPOSITORY_TYPES is process-global; embedded multi-node tests load the
+# same plugin on every node, and one node's close must not disable the
+# others — refcount registrations like plugins._global_register does
+_reg_lock = threading.Lock()
+_reg_counts: dict[str, int] = {}
+
+
+def _register_repo_type(rtype: str, factory) -> None:
+    with _reg_lock:
+        _reg_counts[rtype] = _reg_counts.get(rtype, 0) + 1
+        REPOSITORY_TYPES[rtype] = factory
+
+
+def _unregister_repo_type(rtype: str) -> None:
+    with _reg_lock:
+        n = _reg_counts.get(rtype, 0) - 1
+        if n <= 0:
+            _reg_counts.pop(rtype, None)
+            REPOSITORY_TYPES.pop(rtype, None)
+        else:
+            _reg_counts[rtype] = n
+
+
 class S3RepositoryPlugin(Plugin):
     """repository-s3: "s3" repository type (bucket/base_path layout)."""
     name = "repository-s3"
 
     def on_node_start(self, node) -> None:
-        REPOSITORY_TYPES["s3"] = _object_store_factory("s3", "bucket")
+        _register_repo_type("s3", _object_store_factory("s3", "bucket"))
 
     def on_node_stop(self, node) -> None:
-        REPOSITORY_TYPES.pop("s3", None)
+        _unregister_repo_type("s3")
 
 
 class AzureRepositoryPlugin(Plugin):
@@ -65,11 +89,11 @@ class AzureRepositoryPlugin(Plugin):
     name = "repository-azure"
 
     def on_node_start(self, node) -> None:
-        REPOSITORY_TYPES["azure"] = _object_store_factory("azure",
-                                                          "container")
+        _register_repo_type("azure",
+                            _object_store_factory("azure", "container"))
 
     def on_node_stop(self, node) -> None:
-        REPOSITORY_TYPES.pop("azure", None)
+        _unregister_repo_type("azure")
 
 
 class _CloudDiscoveryPlugin(Plugin):

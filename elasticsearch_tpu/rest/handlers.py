@@ -952,7 +952,8 @@ class Handlers:
         return None
 
     def _write_meta(self, req: RestRequest, index: str,
-                    body: dict | None = None) -> dict | None:
+                    body: dict | None = None, *,
+                    is_source: bool = True) -> dict | None:
         body = body or {}
         meta = self._doc_meta_fields(
             index, req.path_params.get("type"),
@@ -960,9 +961,11 @@ class Handlers:
             routing=req.param("routing", body.get("routing")),
             timestamp=req.param("timestamp", body.get("timestamp")),
             ttl=req.param("ttl", body.get("ttl")))
-        if req.raw_body:
+        if req.raw_body and is_source:
             # on-the-wire source length — what mapper-size's _size records
-            # (whitespace and escapes as the client sent them)
+            # (whitespace and escapes as the client sent them). NOT set
+            # for updates: their body is a {"doc"/"script"} wrapper, not
+            # the document; the mapper then measures the merged source
             meta = dict(meta or {})
             meta["_source_bytes"] = len(req.raw_body)
         return meta
@@ -1196,7 +1199,8 @@ class Handlers:
                                     req.path_params["id"], body,
                                     routing=req.param("routing"),
                                     meta=self._write_meta(
-                                        req, req.path_params["index"]),
+                                        req, req.path_params["index"],
+                                        is_source=False),
                                     version=int(version) if version
                                     else None,
                                     refresh=req.param_as_bool("refresh"))
@@ -1344,8 +1348,11 @@ class Handlers:
                             f"malformed bulk body: action [{action}] "
                             f"without a source line")
                     source = json.loads(lines[i])
-                    mf = meta.setdefault("_meta_fields", {})
-                    mf["_source_bytes"] = len(lines[i].encode("utf-8"))
+                    if action != "update":
+                        # update lines are {"doc"/"script"} wrappers, not
+                        # the document source
+                        mf = meta.setdefault("_meta_fields", {})
+                        mf["_source_bytes"] = len(lines[i].encode("utf-8"))
                     i += 1
                 if action == "update":
                     # `fields` may ride the header line or the URL — fold

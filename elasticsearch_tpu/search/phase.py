@@ -823,8 +823,15 @@ class ShardSearcher:
                 "_id": seg.seg.ids[local],
                 "_score": (float(result.scores[pos]) if emit_score else None),
             }
-            if req.version and self.version_fn is not None:
-                v = self.version_fn(hit["_id"])
+            if req.version:
+                # point-in-time version from the segment's _version
+                # column (VersionFieldMapper doc-value) — the live map is
+                # only a fallback for rows indexed before the column
+                # existed; a live read could pair a newer version with
+                # this snapshot's _source and defeat optimistic deletes
+                v = meta.get("_version")
+                if v is None and self.version_fn is not None:
+                    v = self.version_fn(hit["_id"])
                 if v is not None:
                     hit["_version"] = v
             # requested metadata fields render at the TOP level of the hit

@@ -182,3 +182,44 @@ class TestSizeUsesWireBytes:
         got = node.search("szb", {"query": {"range": {"_size": {
             "gte": len(raw), "lte": len(raw)}}}})
         assert got["hits"]["total"] == 1
+
+    def test_size_remeasured_after_update(self, node):
+        n = node
+        from elasticsearch_tpu.rest.controller import RestController
+        from elasticsearch_tpu.rest.handlers import register_all
+        c = RestController()
+        register_all(c, n)
+        c.dispatch("PUT", "/su", b'{"settings":{"number_of_shards":1},'
+                   b'"mappings":{"t":{"_size":{"enabled":true}}}}')
+        big = b'{"a": "' + b"x" * 200 + b'"}'
+        c.dispatch("PUT", "/su/t/1?refresh=true", big)
+        # update with a tiny wrapper body must NOT shrink _size to the
+        # wrapper's length
+        c.dispatch("POST", "/su/t/1/_update?refresh=true",
+                   b'{"doc": {"b": 1}}')
+        got = n.search("su", {"query": {"range": {"_size": {"gte": 100}}}})
+        assert got["hits"]["total"] == 1, got["hits"]
+
+
+class TestCjkMixedText:
+    def test_latin_prefix_does_not_swallow_cjk(self):
+        from elasticsearch_tpu.plugin_pack.analysis_extra import (
+            cjk_bigram_tokenizer)
+        toks = [t.term for t in cjk_bigram_tokenizer("abc東京に住む")]
+        assert toks[0] == "abc"
+        assert "東京" in toks
+
+
+class TestRepoTypeRefcount:
+    def test_second_node_close_keeps_type_registered(self, tmp_path):
+        from elasticsearch_tpu.plugin_pack.cloud import S3RepositoryPlugin
+        from elasticsearch_tpu.repositories.repository import (
+            REPOSITORY_TYPES)
+        n1 = Node({"plugins": [S3RepositoryPlugin()]},
+                  data_path=tmp_path / "a").start()
+        n2 = Node({"plugins": [S3RepositoryPlugin()]},
+                  data_path=tmp_path / "b").start()
+        n2.close()
+        assert "s3" in REPOSITORY_TYPES        # n1 still registered
+        n1.close()
+        assert "s3" not in REPOSITORY_TYPES

@@ -1,0 +1,69 @@
+"""search_type=scan / count (2.x SearchType.SCAN/COUNT semantics —
+core/action/search/SearchType.java; scan is the unscored index-order
+sweep behind a scroll cursor, count the size=0 alias)."""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node({}, data_path=tmp_path / "n").start()
+    yield n
+    n.close()
+
+
+def _fill(node, docs=25, shards=2):
+    node.indices_service.create_index(
+        "sc", {"settings": {"number_of_shards": shards,
+                            "number_of_replicas": 0}})
+    for i in range(docs):
+        node.index_doc("sc", str(i), {"t": "x", "n": i})
+    node.broadcast_actions.refresh("sc")
+
+
+def test_scan_first_page_empty_then_full_sweep(node):
+    _fill(node)
+    r = node.search("sc", {"query": {"match_all": {}}, "size": 5},
+                    scroll="1m", search_type="scan")
+    assert r["hits"]["total"] == 25
+    assert r["hits"]["hits"] == []
+    sid = r["_scroll_id"]
+    seen = set()
+    while True:
+        page = node.search_actions.scroll(sid, "1m")
+        if not page["hits"]["hits"]:
+            break
+        # size is PER SHARD for scan (5 x 2 shards)
+        assert len(page["hits"]["hits"]) <= 10
+        seen |= {h["_id"] for h in page["hits"]["hits"]}
+    assert len(seen) == 25
+
+
+def test_scan_requires_scroll(node):
+    _fill(node, docs=3, shards=1)
+    from elasticsearch_tpu.common.errors import IllegalArgumentError
+    with pytest.raises(IllegalArgumentError):
+        node.search("sc", {"query": {"match_all": {}}},
+                    search_type="scan")
+
+
+def test_scan_filters_by_query(node):
+    _fill(node)
+    r = node.search("sc", {"query": {"range": {"n": {"lt": 7}}},
+                           "size": 100}, scroll="1m", search_type="scan")
+    assert r["hits"]["total"] == 7
+    page = node.search_actions.scroll(r["_scroll_id"], "1m")
+    assert {h["_id"] for h in page["hits"]["hits"]} == \
+        {str(i) for i in range(7)}
+
+
+def test_count_type_is_size_zero(node):
+    _fill(node)
+    r = node.search("sc", {"query": {"match_all": {}},
+                           "aggs": {"mx": {"max": {"field": "n"}}}},
+                    search_type="count")
+    assert r["hits"]["total"] == 25
+    assert r["hits"]["hits"] == []
+    assert r["aggregations"]["mx"]["value"] == 24.0
