@@ -47,12 +47,19 @@ def register_all(rc: RestController, node) -> None:
     r("PUT", "/{index}/_mapping", h.put_mapping)
     r("POST", "/{index}/_mapping", h.put_mapping)
     r("PUT", "/{index}/_mappings", h.put_mapping)
+    r("POST", "/{index}/_mappings", h.put_mapping)
+    r("PUT", "/{index}/_mappings/{type}", h.put_mapping)
+    r("POST", "/{index}/_mappings/{type}", h.put_mapping)
+    r("PUT", "/{index}/{type}/_mappings", h.put_mapping)
+    r("POST", "/{index}/{type}/_mappings", h.put_mapping)
     r("PUT", "/{index}/_mapping/{type}", h.put_mapping)
     r("POST", "/{index}/_mapping/{type}", h.put_mapping)
     r("PUT", "/{index}/{type}/_mapping", h.put_mapping)
     r("POST", "/{index}/{type}/_mapping", h.put_mapping)
     r("PUT", "/_mapping/{type}", h.put_mapping_all)
     r("POST", "/_mapping/{type}", h.put_mapping_all)
+    r("PUT", "/_mappings/{type}", h.put_mapping_all)
+    r("POST", "/_mappings/{type}", h.put_mapping_all)
     r("GET", "/{index}/_mapping", h.get_mapping)
     r("GET", "/{index}/_mapping/{type}", h.get_mapping)
     r("GET", "/_mapping", h.get_all_mappings)
@@ -137,6 +144,8 @@ def register_all(rc: RestController, node) -> None:
         r("POST", f"/{{index}}/{doc_seg}/{{id}}/_explain", h.explain)
         r("GET", f"/{{index}}/{doc_seg}/{{id}}/_termvectors", h.termvectors)
         r("POST", f"/{{index}}/{doc_seg}/{{id}}/_termvectors", h.termvectors)
+        r("GET", f"/{{index}}/{doc_seg}/_termvectors", h.termvectors)
+        r("POST", f"/{{index}}/{doc_seg}/_termvectors", h.termvectors)
     r("DELETE", "/{index}/_query", h.delete_by_query)
     r("DELETE", "/{index}/{type}/_query", h.delete_by_query)
     r("GET", "/{index}/_field_stats", h.field_stats)
@@ -171,6 +180,8 @@ def register_all(rc: RestController, node) -> None:
     r("POST", "/_msearch", h.msearch)
     r("GET", "/{index}/_msearch", h.msearch)
     r("POST", "/{index}/_msearch", h.msearch)
+    r("GET", "/{index}/{type}/_msearch", h.msearch)
+    r("POST", "/{index}/{type}/_msearch", h.msearch)
     r("GET", "/{index}/_search", h.search)
     r("POST", "/{index}/_search", h.search)
     r("GET", "/{index}/_count", h.count)
@@ -200,10 +211,12 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/_analyze", h.analyze)
     # cluster & stats
     r("GET", "/_cluster/health", h.cluster_health)
+    r("GET", "/_cluster/health/{index}", h.cluster_health)
     r("GET", "/_cluster/state", h.cluster_state)
     r("GET", "/_cluster/state/{metric}", h.cluster_state)
     r("GET", "/_cluster/state/{metric}/{index}", h.cluster_state)
     r("GET", "/_cluster/stats", h.cluster_stats)
+    r("GET", "/_cluster/stats/nodes/{node}", h.cluster_stats)
     r("GET", "/_cluster/settings", h.cluster_settings)
     r("PUT", "/_cluster/settings", h.put_cluster_settings)
     r("POST", "/_cluster/reroute", h.cluster_reroute)
@@ -255,6 +268,8 @@ def register_all(rc: RestController, node) -> None:
     r("POST", "/_search_shards", h.search_shards)
     r("GET", "/{index}/_search_shards", h.search_shards)
     r("POST", "/{index}/_search_shards", h.search_shards)
+    r("GET", "/{index}/{type}/_search_shards", h.search_shards)
+    r("POST", "/{index}/{type}/_search_shards", h.search_shards)
     r("GET", "/{index}/{type}/_search/exists", h.search_exists)
     r("POST", "/{index}/{type}/_search/exists", h.search_exists)
     r("GET", "/_cluster/pending_tasks", h.cluster_pending_tasks)
@@ -266,12 +281,15 @@ def register_all(rc: RestController, node) -> None:
     # snapshot/restore (RestPutRepositoryAction … RestRestoreSnapshotAction)
     r("GET", "/_snapshot", h.get_repositories)
     r("GET", "/_snapshot/_status", h.snapshot_status)
+    r("GET", "/_snapshot/{repo}/_status", h.snapshot_status)
+    r("GET", "/_snapshot/{repo}/{snapshot}/_status", h.snapshot_status)
     r("PUT", "/_snapshot/{repo}", h.put_repository)
     r("POST", "/_snapshot/{repo}", h.put_repository)
     r("GET", "/_snapshot/{repo}", h.get_repositories)
     r("DELETE", "/_snapshot/{repo}", h.delete_repository)
     r("POST", "/_snapshot/{repo}/_verify", h.verify_repository)
     r("PUT", "/_snapshot/{repo}/{snapshot}", h.create_snapshot)
+    r("POST", "/_snapshot/{repo}/{snapshot}", h.create_snapshot)
     r("GET", "/_snapshot/{repo}/{snapshot}", h.get_snapshots)
     r("DELETE", "/_snapshot/{repo}/{snapshot}", h.delete_snapshot)
     r("POST", "/_snapshot/{repo}/{snapshot}/_restore", h.restore_snapshot)
@@ -315,8 +333,12 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/_cat/templates", h.cat_templates)
     r("GET", "/_cat/pending_tasks", h.cat_pending_tasks)
     r("GET", "/_cat/nodeattrs", h.cat_nodeattrs)
-    r("GET", "/_nodes/hot_threads", h.nodes_hot_threads)
-    r("GET", "/_nodes/{node}/hot_threads", h.nodes_hot_threads)
+    # all 8 spec path variants (nodes.hot_threads.json): _nodes and the
+    # legacy _cluster/nodes prefix, hot_threads and hotthreads spellings
+    for prefix in ("/_nodes", "/_cluster/nodes"):
+        for spelling in ("hot_threads", "hotthreads"):
+            r("GET", f"{prefix}/{spelling}", h.nodes_hot_threads)
+            r("GET", f"{prefix}/{{node}}/{spelling}", h.nodes_hot_threads)
 
 
 def _wildcard_match(value: str, pattern: str) -> bool:
@@ -1525,14 +1547,66 @@ class Handlers:
                     k, k not in ("term_statistics",))
         if req.param("fields") and "fields" not in body:
             body["fields"] = req.param("fields").split(",")
+        doc_id = req.path_params.get("id") or body.get("id")
+        if doc_id is None:
+            # id-less route: TermVectorsRequest.doc — an ARTIFICIAL
+            # document analyzed with the index's mappings
+            # (RestTermVectorsAction /{index}/{type}/_termvectors)
+            if not isinstance(body.get("doc"), dict):
+                raise IllegalArgumentError(
+                    "termvectors requires an [id] or a [doc] to analyze")
+            return 200, self._artificial_termvectors(
+                req.path_params["index"], body,
+                req.path_params.get("type") or "_doc")
         out = self.node.document_actions.termvectors(
-            req.path_params["index"], req.path_params["id"],
+            req.path_params["index"], doc_id,
             body, routing=req.param("routing"))
         t = req.path_params.get("type")
         if t and t != "_all":
             out = {**out, "_type": t}
         # found:false is a 200 (TermVectorsResponse renders OK either way)
         return 200, out
+
+    def _artificial_termvectors(self, index: str, body: dict,
+                                tname: str) -> dict:
+        """Term vectors of a body-provided doc: analyze each requested
+        text field with the index's analyzer; positions/offsets honor the
+        request flags (the reference builds a one-doc memory index)."""
+        names = self.node.indices_service.resolve_open(index)
+        svc = self.node.indices_service.index(names[0] if names else index)
+        doc = body["doc"]
+        want = body.get("fields")
+        positions = body.get("positions", True) not in (False, "false")
+        offsets = body.get("offsets", True) not in (False, "false")
+        tv: dict = {}
+        for fname, value in doc.items():
+            if want and fname not in want:
+                continue
+            if not isinstance(value, str):
+                continue
+            fm = svc.mapper_service.field_mapper(fname)
+            if fm is not None and fm.kind != "text":
+                continue
+            analyzer = fm.analyzer if fm is not None else                 svc.mapper_service.analysis.get("standard")
+            terms: dict = {}
+            for tok in analyzer.analyze(value):
+                e = terms.setdefault(tok.term, {"term_freq": 0,
+                                                "tokens": []})
+                e["term_freq"] += 1
+                tok_out = {}
+                if positions:
+                    tok_out["position"] = tok.position
+                if offsets:
+                    tok_out["start_offset"] = tok.start_offset
+                    tok_out["end_offset"] = tok.end_offset
+                if tok_out:
+                    e["tokens"].append(tok_out)
+            if not positions and not offsets:
+                for e in terms.values():
+                    e.pop("tokens", None)
+            tv[fname] = {"terms": dict(sorted(terms.items()))}
+        return {"_index": index, "_type": tname, "_version": 0,
+                "found": True, "term_vectors": tv}
 
     def field_stats(self, req: RestRequest):
         fields = req.param("fields")
@@ -2023,7 +2097,40 @@ class Handlers:
         return 200, out
 
     def snapshot_status(self, req: RestRequest):
-        return 200, self.node.snapshots_service.snapshot_status()
+        """GET /_snapshot[/{repo}[/{snap}]]/_status — in-progress entries
+        plus, for a NAMED snapshot, the completed state read from the
+        repository (TransportSnapshotsStatusAction falls back to repo
+        data for finished snapshots)."""
+        out = self.node.snapshots_service.snapshot_status()
+        repo = req.path_params.get("repo")
+        snaps = [x for x in
+                 str(req.path_params.get("snapshot") or "").split(",") if x]
+        if repo:
+            # unknown repository → RepositoryMissingException (404), like
+            # TransportSnapshotsStatusAction
+            self.node.snapshots_service.repository(repo)
+            out["snapshots"] = [
+                e for e in out["snapshots"]
+                if e.get("repository", repo) == repo
+                and (not snaps or e.get("snapshot") in snaps)]
+            in_progress = {e.get("snapshot") for e in out["snapshots"]}
+            for name in snaps:
+                if name in in_progress:
+                    continue           # running entry already listed
+                info = self.node.snapshots_service.get_snapshots(
+                    repo, name)["snapshots"]
+                out["snapshots"].extend({
+                    "snapshot": i.get("snapshot", name),
+                    "repository": repo,
+                    "state": i.get("state", "SUCCESS"),
+                    "shards_stats": {
+                        "done": i.get("shards", {}).get("successful", 0),
+                        "failed": i.get("shards", {}).get("failed", 0),
+                        "total": i.get("shards", {}).get("total", 0),
+                        "initializing": 0, "started": 0, "finalizing": 0},
+                    "indices": {nm: {} for nm in i.get("indices", [])},
+                } for i in info)
+        return 200, out
 
     def cluster_health(self, req: RestRequest):
         want = req.params.get("wait_for_status")
@@ -2566,13 +2673,27 @@ class Handlers:
         return 200, out
 
     def cluster_stats(self, req: RestRequest):
+        """GET /_cluster/stats[/nodes/{node}] — the {node} filter limits
+        which nodes contribute (RestClusterStatsAction {nodeId}); node
+        ids/names resolve like the _nodes APIs (_local/_all/id/name)."""
+        state = self.node.cluster_service.state()
+        node_filter = req.path_params.get("node")
+        contributing = 1
+        if node_filter and node_filter not in ("_all",):
+            wanted = set(node_filter.split(","))
+            me = {self.node.node_id, self.node.node_name, "_local"}
+            contributing = 1 if wanted & me else 0
         total_docs = sum(svc.num_docs()
-                         for svc in self.node.indices_service.indices.values())
+                         for svc in self.node.indices_service.indices.values()) \
+            if contributing else 0
         return 200, {
-            "cluster_name": self.node.cluster_service.state().cluster_name,
-            "indices": {"count": len(self.node.indices_service.indices),
+            "cluster_name": state.cluster_name,
+            "indices": {"count": (len(self.node.indices_service.indices)
+                                  if contributing else 0),
                         "docs": {"count": total_docs}},
-            "nodes": {"count": {"total": 1, "data": 1, "master": 1}},
+            "nodes": {"count": {"total": contributing,
+                                "data": contributing,
+                                "master": contributing}},
         }
 
     def cluster_settings(self, req: RestRequest):
