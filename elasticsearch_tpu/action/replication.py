@@ -811,7 +811,7 @@ class DocumentActions:
                         item["id"], item["source"],
                         routing=item.get("routing"),
                         op_type="create" if action == "create" else "index",
-                        meta=item.get("meta"))
+                        meta=item.get("meta"), sync=False)
                     replica_ops.append({"op": "index", "id": item["id"],
                                         "source": item["source"],
                                         "routing": item.get("routing"),
@@ -823,7 +823,7 @@ class DocumentActions:
                          "created": created,
                          "status": 201 if created else 200}
                 elif action == "delete":
-                    v = engine.delete(item["id"])
+                    v = engine.delete(item["id"], sync=False)
                     replica_ops.append({"op": "delete", "id": item["id"],
                                         "version": v})
                     r = {"_index": name, "_type": "_doc", "_id": item["id"],
@@ -850,6 +850,10 @@ class DocumentActions:
             except Exception as e:               # noqa: BLE001 — per item
                 items_out.append(self._bulk_error_item(action, name,
                                                        item["id"], e))
+        # per-REQUEST durability: ONE translog fsync per shard bulk, after
+        # the item loop and before acking (IndexShard.sync in
+        # TransportShardBulkAction) — not one per op
+        engine.translog.sync()
         if request.get("refresh"):
             engine.refresh()
         if replica_ops:
@@ -865,10 +869,11 @@ class DocumentActions:
             if op["op"] == "index":
                 engine.index_replica(op["id"], op["source"], op["version"],
                                      routing=op.get("routing"),
-                                     meta=op.get("meta"))
+                                     meta=op.get("meta"), sync=False)
             else:
-                engine.delete_replica(op["id"], op["version"])
-        if request.get("refresh"):
+                engine.delete_replica(op["id"], op["version"], sync=False)
+        engine.translog.sync()          # per-request durability (see
+        if request.get("refresh"):      # the primary loop above)
             engine.refresh()
         return {}
 

@@ -229,7 +229,8 @@ class Engine:
               routing: str | None = None, op_type: str = "index",
               version_type: str = "internal",
               from_translog: bool = False,
-              meta: dict | None = None) -> tuple[int, bool]:
+              meta: dict | None = None,
+              sync: bool = True) -> tuple[int, bool]:
         """→ (new_version, created). Version semantics follow
         InternalEngine.innerIndex (version check → write → versionMap put);
         version_type external/external_gte/force per VersionType.java —
@@ -279,7 +280,7 @@ class Engine:
             if not from_translog:
                 self.translog.add(TranslogOp(OP_INDEX, doc_id, new_version,
                                              source=source, routing=routing,
-                                             meta=meta))
+                                             meta=meta), sync=sync)
             self.stats.index_total += 1
             took = time.perf_counter() - t0
             self.stats.index_time_ms += took * 1e3
@@ -290,7 +291,7 @@ class Engine:
 
     def index_replica(self, doc_id: str, source: dict, version: int,
                       routing: str | None = None,
-                      meta: dict | None = None) -> int:
+                      meta: dict | None = None, sync: bool = True) -> int:
         """Apply a replicated index op with the version the primary
         resolved (TransportShardBulkAction replica path: no version
         conflict re-check, core/action/bulk/TransportShardBulkAction.java:448).
@@ -317,11 +318,12 @@ class Engine:
             self._versions[doc_id] = VersionEntry(version, False, -1, local)
             self.translog.add(TranslogOp(OP_INDEX, doc_id, version,
                                          source=source, routing=routing,
-                                         meta=meta))
+                                         meta=meta), sync=sync)
             self.stats.index_total += 1
             return version
 
-    def delete_replica(self, doc_id: str, version: int) -> int:
+    def delete_replica(self, doc_id: str, version: int,
+                       sync: bool = True) -> int:
         """Apply a replicated delete with the primary-resolved version."""
         with self._lock:
             self._ensure_open()
@@ -335,13 +337,14 @@ class Engine:
                 self._pending_seg_deletes[(entry.seg_id, entry.local_doc)] \
                     = doc_id
             self._versions[doc_id] = VersionEntry(version, True, -2, -1)
-            self.translog.add(TranslogOp(OP_DELETE, doc_id, version))
+            self.translog.add(TranslogOp(OP_DELETE, doc_id, version),
+                              sync=sync)
             self.stats.delete_total += 1
             return version
 
     def delete(self, doc_id: str, version: int = MATCH_ANY,
                version_type: str = "internal",
-               from_translog: bool = False) -> int:
+               from_translog: bool = False, sync: bool = True) -> int:
         with self._lock:
             self._ensure_open()
             entry = self._versions.get(doc_id)
@@ -372,7 +375,8 @@ class Engine:
                 self._pending_seg_deletes[(entry.seg_id, entry.local_doc)] = doc_id
             self._versions[doc_id] = VersionEntry(new_version, True, -2, -1)
             if not from_translog:
-                self.translog.add(TranslogOp(OP_DELETE, doc_id, new_version))
+                self.translog.add(TranslogOp(OP_DELETE, doc_id, new_version),
+                                  sync=sync)
             self.stats.delete_total += 1
             return new_version
 

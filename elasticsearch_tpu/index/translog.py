@@ -145,15 +145,20 @@ class Translog:
 
     # ---- write path -------------------------------------------------------
 
-    def add(self, op: TranslogOp) -> int:
-        """Append one op; returns its seq_no. Fsync policy per durability."""
+    def add(self, op: TranslogOp, sync: bool = True) -> int:
+        """Append one op; returns its seq_no. With ``sync`` (the default)
+        REQUEST durability fsyncs immediately; bulk callers pass
+        sync=False per op and call :meth:`sync` ONCE before acking — the
+        reference's per-REQUEST (not per-op) durability
+        (TransportShardBulkAction syncs the translog once per shard bulk,
+        IndexShard.sync). One fsync per 4k-doc bulk instead of 4k."""
         op.seq_no = self.next_seq_no
         payload = op.encode()
         frame = _HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
         self._file.write(frame)
         self.next_seq_no += 1
         self._ops_in_gen += 1
-        if self.durability == DURABILITY_REQUEST:
+        if sync and self.durability == DURABILITY_REQUEST:
             self.sync()
         return op.seq_no
 

@@ -1,0 +1,47 @@
+"""Native (C) runtime components, compiled on demand with the in-image
+toolchain and loaded via the CPython extension loader. Every native path
+has a pure-Python fallback with identical semantics — the parity is
+pinned by tests (tests/test_native_tokenizer.py)."""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.machinery
+import importlib.util
+import subprocess
+import sysconfig
+from pathlib import Path
+
+_HERE = Path(__file__).parent
+
+
+def load_tokenizer():
+    """Compile (once, content-hashed) + import the tokenizer extension.
+    Returns the module or None when no working toolchain is available."""
+    src = _HERE / "tokenizer.c"
+    try:
+        digest = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    except OSError:
+        return None
+    build = _HERE / "_build"
+    so = build / f"estpu_tokenizer-{digest}.so"
+    if not so.exists():
+        build.mkdir(exist_ok=True)
+        inc = sysconfig.get_path("include")
+        cmd = ["cc", "-O2", "-shared", "-fPIC", f"-I{inc}",
+               "-o", str(so) + ".tmp", str(src)]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+            (build / (so.name + ".tmp")).rename(so)
+        except (OSError, subprocess.SubprocessError):
+            return None
+    try:
+        loader = importlib.machinery.ExtensionFileLoader(
+            "estpu_tokenizer", str(so))
+        spec = importlib.util.spec_from_loader("estpu_tokenizer", loader)
+        mod = importlib.util.module_from_spec(spec)
+        loader.exec_module(mod)
+        return mod
+    except ImportError:
+        return None

@@ -1,0 +1,77 @@
+"""Native C tokenizer parity: byte-for-byte identical Tokens vs the
+Python reference implementations across unicode, apostrophes,
+underscores, CJK, and empty/degenerate inputs."""
+
+import random
+import string
+
+import pytest
+
+from elasticsearch_tpu.analysis import analyzers as A
+
+native = pytest.importorskip(
+    "elasticsearch_tpu.native", reason="native pkg missing")
+MOD = __import__("elasticsearch_tpu.native",
+                 fromlist=["load_tokenizer"]).load_tokenizer()
+pytestmark = pytest.mark.skipif(MOD is None, reason="no C toolchain")
+
+CASES = [
+    "",
+    "hello world",
+    "The quick_brown fox's 2nd ___ run",
+    "l'été à Zürich — naïve café",
+    "don’t stop o'clock 'leading trailing'",
+    "a_b __x__ _ 1_2",
+    "  spaces\t\tand\nnewlines  ",
+    "日本語のテキスト mixed with latin",
+    "punct!@#$%^&*()[]{};:,.<>?/|\\~`",
+    "ALL CAPS MiXeD iii İstanbul ẞharp",
+    "числа 123 и кириллица",
+    "x" * 300,
+]
+
+
+def _rand_text(rng):
+    alphabet = string.ascii_letters + string.digits + " _'’-—.,!?" + \
+        "éüñßÆ日本語中文한글"
+    return "".join(rng.choice(alphabet) for _ in range(rng.randrange(80)))
+
+
+def _toks(fn, text):
+    return [(t.term, t.position, t.start_offset, t.end_offset)
+            for t in fn(text)]
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_standard_parity(case):
+    assert _toks(A.standard_tokenizer, case) == \
+        _toks(A.py_standard_tokenizer, case)
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_whitespace_parity(case):
+    assert _toks(A.whitespace_tokenizer, case) == \
+        _toks(A.py_whitespace_tokenizer, case)
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_letter_parity(case):
+    assert _toks(A.letter_tokenizer, case) == \
+        _toks(A.py_letter_tokenizer, case)
+
+
+def test_fuzz_parity():
+    rng = random.Random(7)
+    for _ in range(300):
+        text = _rand_text(rng)
+        for fast, ref in ((A.standard_tokenizer, A.py_standard_tokenizer),
+                          (A.whitespace_tokenizer,
+                           A.py_whitespace_tokenizer),
+                          (A.letter_tokenizer, A.py_letter_tokenizer)):
+            assert _toks(fast, text) == _toks(ref, text), repr(text)
+
+
+def test_analyzer_chain_uses_native():
+    # the standard analyzer (tokenizer + lowercase) end to end
+    terms = A.BUILTIN_ANALYZERS["standard"].terms("The QUICK Fox's")
+    assert terms == ["the", "quick", "fox's"]
