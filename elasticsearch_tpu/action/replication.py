@@ -16,10 +16,11 @@ import time
 import uuid
 
 from elasticsearch_tpu.cluster.routing import OperationRouting
-from elasticsearch_tpu.cluster.state import ShardRouting
+from elasticsearch_tpu.cluster.state import NO_MASTER_BLOCK, ShardRouting
 from elasticsearch_tpu.common.errors import (
-    DocumentMissingError, ElasticsearchTpuError, IllegalArgumentError,
-    IndexAlreadyExistsError, UnavailableShardsError, reconstruct_error)
+    ClusterBlockError, DocumentMissingError, ElasticsearchTpuError,
+    IllegalArgumentError, IndexAlreadyExistsError, UnavailableShardsError,
+    reconstruct_error)
 from elasticsearch_tpu.index.engine import MATCH_ANY
 from elasticsearch_tpu.transport.service import (
     RemoteTransportError, TransportException)
@@ -259,6 +260,15 @@ class DocumentActions:
                     raise
                 time.sleep(0.05)
 
+    def _check_write_block(self) -> None:
+        """Reject writes while the no-master block is in force (reference:
+        `discovery.zen.no_master_block` defaults to `write` — a node on the
+        minority side of a partition must not accept writes it can never
+        durably replicate; reads stay allowed)."""
+        if NO_MASTER_BLOCK in self._state().blocks:
+            raise ClusterBlockError(
+                "blocked by: [SERVICE_UNAVAILABLE/2/no master];")
+
     # ---- index -------------------------------------------------------------
 
     def index_doc(self, index: str, doc_id: str | None, source: dict,
@@ -266,6 +276,7 @@ class DocumentActions:
                   op_type: str = "index", refresh: bool = False,
                   version_type: str = "internal",
                   meta: dict | None = None) -> dict:
+        self._check_write_block()
         name = self._resolve_write_index(index)
         doc_id = doc_id or uuid.uuid4().hex[:20]
         # a child doc routes by its parent id so the family shares a shard
@@ -333,6 +344,7 @@ class DocumentActions:
                    routing: str | None = None, version: int | None = None,
                    refresh: bool = False,
                    version_type: str = "internal") -> dict:
+        self._check_write_block()
         name = self._resolve_single(index)
         shard = self._shard_id(name, doc_id, routing)
         request = {"index": name, "shard": shard, "id": doc_id,
@@ -376,6 +388,7 @@ class DocumentActions:
                    routing: str | None = None, refresh: bool = False,
                    version: int | None = None,
                    meta: dict | None = None) -> dict:
+        self._check_write_block()
         if version is not None and ("upsert" in body
                                     or body.get("doc_as_upsert")):
             # the reference rejects this combination up front: a versioned
@@ -720,6 +733,7 @@ class DocumentActions:
 
     def bulk(self, operations: list[tuple[str, dict, dict | None]],
              refresh: bool = False) -> dict:
+        self._check_write_block()
         t0 = time.perf_counter()
         # auto-create every target index up front (TransportBulkAction does
         # a create round-trip per missing index before splitting)

@@ -29,6 +29,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="force JAX CPU platform (no TPU)")
     parser.add_argument("-E", action="append", default=[], metavar="K=V",
                         help="setting override (repeatable)")
+    parser.add_argument("--portsfile", default=None,
+                        help="write 'http=<port>\\ntransport=<port>' here "
+                             "once bound (test orchestration; ref: the "
+                             "--portsfile node flag)")
     args = parser.parse_args(argv)
 
     if args.cpu:
@@ -45,8 +49,14 @@ def main(argv: list[str] | None = None) -> int:
 
     node = Node(settings, data_path=args.data).start()
     server = RestServer(node, host=args.host, port=args.port).start()
+    taddr = node.transport_service.transport.bound_address()
     print(f"[estpu] node [{node.node_name}] started, "
-          f"http on {server.host}:{server.port}", flush=True)
+          f"http on {server.host}:{server.port}, transport on {taddr}",
+          flush=True)
+    if args.portsfile:
+        from pathlib import Path
+        Path(args.portsfile).write_text(
+            f"http={server.port}\ntransport={taddr.port}\n")
 
     stop = threading.Event()
 

@@ -324,7 +324,11 @@ class ClusterState:
         unassigned = counts[ShardRoutingState.UNASSIGNED]
         primaries_ok = all(
             s.active for s in self.routing_table.shards if s.primary)
-        if not primaries_ok or STATE_NOT_RECOVERED_BLOCK in self.blocks:
+        if not primaries_ok or STATE_NOT_RECOVERED_BLOCK in self.blocks \
+                or NO_MASTER_BLOCK in self.blocks:
+            # no elected master: the routing table is stale by definition
+            # (the reference surfaces this as a ClusterBlockException /
+            # red health rather than reporting pre-partition shard counts)
             status = "red"
         elif unassigned > 0 or counts[ShardRoutingState.INITIALIZING] > 0:
             status = "yellow"

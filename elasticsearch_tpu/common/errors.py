@@ -166,6 +166,16 @@ class MasterNotDiscoveredError(ElasticsearchTpuError):
     error_type = "master_not_discovered_exception"
 
 
+class ClusterBlockError(ElasticsearchTpuError):
+    """Operation refused by a cluster-level block (reference:
+    ClusterBlockException, core/cluster/block/ClusterBlocks.java — e.g. the
+    discovery no-master block rejects writes on a node that lost its
+    quorum, `discovery.zen.no_master_block`)."""
+
+    status = 503
+    error_type = "cluster_block_exception"
+
+
 def _all_subclasses(cls) -> list:
     out = []
     for sub in cls.__subclasses__():

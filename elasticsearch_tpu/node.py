@@ -23,6 +23,7 @@ from elasticsearch_tpu.cluster.state import (
 from elasticsearch_tpu.common.settings import Settings
 from elasticsearch_tpu.transport import (
     DiscoveryNode, LocalTransport, LocalTransportHub, TransportService)
+from elasticsearch_tpu.transport.service import TransportAddress
 
 
 class Node:
@@ -57,7 +58,26 @@ class Node:
     SHARD_FAILED_ACTION = "internal:cluster/shard/failure"
 
     def start(self) -> "Node":
-        hub = self._hub or LocalTransportHub()
+        # transport selection (ref: `transport.type` setting resolved by
+        # NetworkModule — NettyTransport by default, LocalTransport for
+        # embedded/test use; core/node/Node.java:230-275 wiring order).
+        # "tcp" boots a real socket server so multi-process / multi-host
+        # clusters form over the network; "local" keeps the in-process hub.
+        transport_type = self.settings.get("transport.type", "local")
+        if transport_type in ("tcp", "netty"):
+            from elasticsearch_tpu.transport.tcp import TcpTransport
+            hub = None
+            transport = TcpTransport(
+                self.settings.get("transport.host", "127.0.0.1"),
+                self.settings.get_as_int("transport.tcp.port", 0),
+                publish_host=self.settings.get("transport.publish_host"))
+            seed_provider = self._unicast_seeds
+        elif transport_type == "local":
+            hub = self._hub or LocalTransportHub()
+            transport = LocalTransport(hub)
+            seed_provider = hub.addresses
+        else:
+            raise ValueError(f"unknown transport.type [{transport_type}]")
         attrs = (("data", self.settings.get("node.data", "true")),
                  ("master", self.settings.get("node.master", "true")))
         # every other `node.<key>` setting becomes a custom node attribute
@@ -74,7 +94,7 @@ class Node:
         from elasticsearch_tpu.common.threadpool import ThreadPool
         self.thread_pool = ThreadPool(self.settings)
         self.transport_service = TransportService(
-            LocalTransport(hub),
+            transport,
             lambda addr: DiscoveryNode(self.node_id, self.node_name, addr,
                                        attributes=attrs),
             thread_pool=self.thread_pool)
@@ -159,7 +179,7 @@ class Node:
         from elasticsearch_tpu.discovery import ZenDiscovery
         self.discovery = ZenDiscovery(
             self.transport_service, self.cluster_service, self.allocation,
-            seed_provider=hub.addresses, cluster_name=cluster_name,
+            seed_provider=seed_provider, cluster_name=cluster_name,
             min_master_nodes=self.settings.get_as_int(
                 "discovery.zen.minimum_master_nodes", 1),
             gateway_fn=self._gateway_recover,
@@ -177,6 +197,24 @@ class Node:
         # nodeServices()/onModule hooks firing at injector-creation time)
         self.plugins_service.apply_node_start(self)
         return self
+
+    def _unicast_seeds(self) -> list[TransportAddress]:
+        """Unicast discovery seeds for TCP clusters (ref: UnicastZenPing,
+        `discovery.zen.ping.unicast.hosts` — a list or comma string of
+        host:port pairs). The local bound address is implicit; zen skips it
+        when pinging."""
+        raw = self.settings.get("discovery.zen.ping.unicast.hosts") or []
+        if isinstance(raw, str):
+            raw = [h.strip() for h in raw.split(",") if h.strip()]
+        seeds = []
+        for entry in raw:
+            host, sep, port = str(entry).rpartition(":")
+            if not sep or not port:
+                # bare host: default to the standard transport port (the
+                # reference appends :9300 to host-only unicast entries)
+                host, port = str(entry).rstrip(":"), "9300"
+            seeds.append(TransportAddress(host or "127.0.0.1", int(port)))
+        return seeds
 
     def _gateway_recover(self, state: ClusterState) -> ClusterState:
         """Gateway recovery (GatewayMetaState): merge persisted metadata

@@ -32,16 +32,31 @@ _REQ, _RESP, _RESP_ERR = 0, 1, 2
 
 
 class TcpTransport:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 publish_host: str | None = None):
         self._host, self._want_port = host, port
+        # the address peers should dial (ref: `transport.publish_host` /
+        # NetworkService publish resolution): binding to a wildcard must
+        # not advertise the wildcard, which dials back to the PEER's own
+        # loopback
+        self._publish_host = publish_host
         self._service = None
         self._address: TransportAddress | None = None
         self._server: socket.socket | None = None
         self._closed = False
         self._lock = threading.Lock()
         self._outbound: dict[TransportAddress, socket.socket] = {}
-        self._inbound_channels: dict[int, socket.socket] = {}
+        # reply channels keyed by (requester node_id, its request_id):
+        # request ids are per-requester counters, so two clients' ids collide
+        self._inbound_channels: dict[tuple[str, int], socket.socket] = {}
+        # one writer lock per live socket — sendall releases the GIL between
+        # chunks, so unserialized concurrent writers interleave frames
+        self._write_locks: dict[int, threading.Lock] = {}
         self._threads: list[threading.Thread] = []
+        # Disruption hook: rule(to_address, action) -> None | "drop" | float
+        # (seconds of delay) — same seam LocalTransport exposes, so the
+        # disruption schemes (testing_disruption.py) run over real sockets.
+        self.outbound_rule = None
 
     # ---- Transport interface ----------------------------------------------
 
@@ -52,7 +67,10 @@ class TcpTransport:
         srv.bind((self._host, self._want_port))
         srv.listen(64)
         self._server = srv
-        self._address = TransportAddress(self._host, srv.getsockname()[1])
+        publish = self._publish_host or self._host
+        if publish in ("0.0.0.0", "::", ""):
+            publish = self._default_publish_host()
+        self._address = TransportAddress(publish, srv.getsockname()[1])
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name=f"tcp_accept[{self._address}]")
         t.start()
@@ -60,6 +78,25 @@ class TcpTransport:
 
     def bound_address(self) -> TransportAddress:
         return self._address
+
+    @staticmethod
+    def _default_publish_host() -> str:
+        """Best routable local address when bound to a wildcard: the source
+        address of an (unsent) UDP connect to a public IP, falling back to
+        the hostname's resolution, then loopback."""
+        try:
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                probe.connect(("8.8.8.8", 9))
+                return probe.getsockname()[0]
+            finally:
+                probe.close()
+        except OSError:
+            pass
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
 
     def close(self) -> None:
         self._closed = True
@@ -76,8 +113,44 @@ class TcpTransport:
             except OSError:
                 pass
 
+    def _ruled(self, addr: TransportAddress, action: str,
+               send) -> bool:
+        """Apply the disruption rule; → True when the send was handled
+        (dropped or deferred), False when the caller should send now."""
+        rule = self.outbound_rule
+        if rule is None:
+            return False
+        verdict = rule(addr, action)
+        if verdict == "drop":
+            return True
+        if isinstance(verdict, (int, float)) and verdict > 0:
+            def fire():
+                # a node killed while the delay was pending must stay
+                # silent (LocalTransport._deliver's _closed guard): a
+                # resurrected stale send is exactly the ghost-message
+                # class the disruption tests exist to rule out
+                if self._closed:
+                    return
+                try:
+                    send()
+                except (OSError, ConnectTransportError):
+                    pass                         # target gone meanwhile
+            t = threading.Timer(float(verdict), fire)
+            t.daemon = True
+            t.start()
+            return True
+        return False
+
     def send_request(self, node: DiscoveryNode, request_id: int, action: str,
                      payload: bytes) -> None:
+        if self._ruled(node.address, action,
+                       lambda: self._do_send_request(node, request_id,
+                                                     action, payload)):
+            return
+        self._do_send_request(node, request_id, action, payload)
+
+    def _do_send_request(self, node: DiscoveryNode, request_id: int,
+                         action: str, payload: bytes) -> None:
         out = StreamOutput()
         out.write_byte(_REQ)
         out.write_long(request_id)
@@ -89,6 +162,20 @@ class TcpTransport:
 
     def send_response(self, node: DiscoveryNode, request_id: int,
                       payload: bytes | None, error) -> None:
+        # pop the reply channel BEFORE the disruption rule: a dropped
+        # response must not leak the (node_id, request_id) → socket entry
+        with self._lock:
+            chan = self._inbound_channels.pop((node.node_id, request_id),
+                                              None)
+        if self._ruled(node.address, "<response>",
+                       lambda: self._do_send_response(node, request_id,
+                                                      payload, error, chan)):
+            return
+        self._do_send_response(node, request_id, payload, error, chan)
+
+    def _do_send_response(self, node: DiscoveryNode, request_id: int,
+                          payload: bytes | None, error,
+                          chan: socket.socket | None = None) -> None:
         out = StreamOutput()
         if error is None:
             out.write_byte(_RESP)
@@ -105,8 +192,6 @@ class TcpTransport:
             out.write_string(error[1])
         # Prefer the inbound channel the request arrived on (the reference
         # replies on the request's channel); fall back to an outbound conn.
-        with self._lock:
-            chan = self._inbound_channels.pop(request_id, None)
         if chan is not None:
             try:
                 self._write_framed(chan, out.bytes())
@@ -129,9 +214,11 @@ class TcpTransport:
                 self._outbound.pop(addr, None)
             raise ConnectTransportError(f"send to {addr} failed: {e}") from e
 
-    @staticmethod
-    def _write_framed(sock: socket.socket, body: bytes) -> None:
-        sock.sendall(_MARKER + struct.pack(">i", len(body)) + body)
+    def _write_framed(self, sock: socket.socket, body: bytes) -> None:
+        with self._lock:
+            wl = self._write_locks.setdefault(id(sock), threading.Lock())
+        with wl:
+            sock.sendall(_MARKER + struct.pack(">i", len(body)) + body)
 
     def _connect(self, addr: TransportAddress) -> socket.socket:
         with self._lock:
@@ -184,6 +271,8 @@ class TcpTransport:
         except OSError:
             return
         finally:
+            with self._lock:
+                self._write_locks.pop(id(sock), None)
             try:
                 sock.close()
             except OSError:
@@ -209,7 +298,7 @@ class TcpTransport:
             action = inp.read_string()
             payload = inp.read_bytes()
             with self._lock:
-                self._inbound_channels[request_id] = sock
+                self._inbound_channels[(source.node_id, request_id)] = sock
             self._service.on_request(source, request_id, action, payload,
                                      version)
         elif msg_type == _RESP:

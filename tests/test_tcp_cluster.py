@@ -1,0 +1,322 @@
+"""Clusters over real TCP sockets — in-process and multi-process.
+
+Reference: the default NettyTransport boot path
+(core/transport/netty/NettyTransport.java:142, wired by
+core/node/Node.java:230-275 + the `transport.type` setting) and the
+full-cluster-restart / node-kill integration tests
+(test/test/InternalTestCluster.java restartNode(KILL)). Everything the
+LocalTransport suite proves in one process must also hold when zen
+discovery, publish, replication and recovery ride length-framed sockets —
+including across OS process boundaries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _tcp_settings(ports: list[int], my_port: int, name: str,
+                  min_masters: int) -> dict:
+    return {
+        "transport.type": "tcp",
+        "transport.tcp.port": my_port,
+        "discovery.zen.ping.unicast.hosts":
+            ",".join(f"127.0.0.1:{p}" for p in ports),
+        "discovery.zen.minimum_master_nodes": min_masters,
+        "discovery.zen.ping_timeout": 0.3,
+        "discovery.zen.publish_timeout": 3.0,
+        "fd.ping_interval": 0.1,
+        "fd.ping_timeout": 0.4,
+        "fd.ping_retries": 2,
+        "node.name": name,
+        "cluster.name": "tcp-test",
+    }
+
+
+def _start_all(nodes: list[Node]) -> None:
+    threads = [threading.Thread(target=n.start, daemon=True) for n in nodes]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+
+
+@pytest.fixture()
+def tcp_pair(tmp_path):
+    ports = _free_ports(2)
+    nodes = [Node(_tcp_settings(ports, p, f"tcp-{i}", 2),
+                  data_path=tmp_path / f"n{i}")
+             for i, p in enumerate(ports)]
+    _start_all(nodes)
+    yield nodes
+    for n in nodes:
+        try:
+            n.close()
+        except Exception:            # noqa: BLE001 — already killed by test
+            pass
+
+
+def test_two_nodes_form_cluster_over_tcp(tcp_pair):
+    a, b = tcp_pair
+    sa, sb = a.cluster_service.state(), b.cluster_service.state()
+    assert sa.master_node_id == sb.master_node_id is not None
+    assert set(sa.nodes) == set(sb.nodes) and len(sa.nodes) == 2
+
+
+def test_replication_and_search_over_tcp(tcp_pair):
+    a, b = tcp_pair
+    a.indices_service.create_index("t", {"settings": {
+        "number_of_shards": 2, "number_of_replicas": 1}})
+    h = a.wait_for_health("green", timeout=20)
+    assert h["status"] == "green", h
+    for i in range(20):
+        a.index_doc("t", str(i), {"body": f"word{i} common"})
+    a.broadcast_actions.refresh("t")
+    # read and search through the OTHER node: routing, replication and the
+    # scatter-gather fan-out all crossed the socket
+    assert b.get_doc("t", "7")["_source"]["body"] == "word7 common"
+    res = b.search("t", {"query": {"match": {"body": "common"}},
+                         "size": 30})
+    assert res["hits"]["total"] == 20
+
+
+def test_node_kill_failover_over_tcp(tmp_path):
+    """Kill one of three TCP nodes; the survivors re-elect (if needed),
+    promote replicas and go green again — all over sockets."""
+    ports = _free_ports(3)
+    nodes = [Node(_tcp_settings(ports, p, f"tcp-{i}", 2),
+                  data_path=tmp_path / f"n{i}")
+             for i, p in enumerate(ports)]
+    _start_all(nodes)
+    try:
+        a = nodes[0]
+        a.indices_service.create_index("t", {"settings": {
+            "number_of_shards": 2, "number_of_replicas": 1}})
+        assert a.wait_for_health("green", timeout=20)["status"] == "green"
+        for i in range(10):
+            a.index_doc("t", str(i), {"n": i})
+        nodes[2].kill()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            h = a.wait_for_health(None, timeout=1.0)
+            if h["number_of_nodes"] == 2 and h["status"] == "green":
+                break
+            time.sleep(0.2)
+        h = a.wait_for_health("green", timeout=5)
+        assert h["status"] == "green" and h["number_of_nodes"] == 2, h
+        a.broadcast_actions.refresh("t")
+        res = a.search("t", {"size": 20})
+        assert res["hits"]["total"] == 10
+    finally:
+        for n in nodes:
+            try:
+                n.close()
+            except Exception:        # noqa: BLE001
+                pass
+
+
+def test_partition_disruption_over_tcp(tmp_path):
+    """NetworkPartition works on TcpTransport via the same outbound-rule
+    seam as LocalTransport: isolating the master forces a step-down and a
+    re-election among the majority side."""
+    from elasticsearch_tpu.testing_disruption import NetworkPartition
+    ports = _free_ports(3)
+    nodes = [Node(_tcp_settings(ports, p, f"tcp-{i}", 2),
+                  data_path=tmp_path / f"n{i}")
+             for i, p in enumerate(ports)]
+    _start_all(nodes)
+    try:
+        master_id = nodes[0].cluster_service.state().master_node_id
+        master = next(n for n in nodes if n.node_id == master_id)
+        rest = [n for n in nodes if n.node_id != master_id]
+        with NetworkPartition([master], rest).applied():
+            deadline = time.monotonic() + 20
+            new_master = None
+            while time.monotonic() < deadline:
+                ids = {n.cluster_service.state().master_node_id
+                       for n in rest}
+                if ids and None not in ids and master_id not in ids and \
+                        len(ids) == 1:
+                    new_master = ids.pop()
+                    break
+                time.sleep(0.1)
+            assert new_master is not None, "majority never re-elected"
+        # after healing, the old master rejoins the new master's cluster
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            st = master.cluster_service.state()
+            if st.master_node_id == new_master and len(st.nodes) == 3:
+                break
+            time.sleep(0.1)
+        assert master.cluster_service.state().master_node_id == new_master
+    finally:
+        for n in nodes:
+            try:
+                n.close()
+            except Exception:        # noqa: BLE001
+                pass
+
+
+def test_quorum_loss_blocks_writes_allows_reads(tcp_pair):
+    """When its peer dies, a 2-node/min_master=2 survivor steps down: the
+    no-master block rejects writes (discovery.zen.no_master_block=write),
+    reads keep working, health goes red (ClusterBlocks semantics)."""
+    from elasticsearch_tpu.common.errors import ClusterBlockError
+    a, b = tcp_pair
+    a.indices_service.create_index("t", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 1}})
+    a.wait_for_health("green", timeout=20)
+    a.index_doc("t", "1", {"f": "x"}, refresh=True)
+    b.kill()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if a.cluster_service.state().master_node_id is None:
+            break
+        time.sleep(0.1)
+    st = a.cluster_service.state()
+    assert st.master_node_id is None, "survivor should have stepped down"
+    assert st.health(0)["status"] == "red"
+    with pytest.raises(ClusterBlockError):
+        a.index_doc("t", "2", {"f": "y"})
+    assert a.search("t", {"query": {"match_all": {}}})["hits"]["total"] == 1
+
+
+# ---- multi-process: one node per OS process over localhost TCP ------------
+
+
+def _http(method: str, port: int, path: str, body=None, timeout=10.0):
+    data = None
+    headers = {}
+    if body is not None:
+        data = body if isinstance(body, bytes) else \
+            json.dumps(body).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method,
+        headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read() or b"{}")
+
+
+def _wait_http(port: int, timeout: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            _http("GET", port, "/", timeout=2.0)
+            return
+        except (urllib.error.URLError, OSError):
+            time.sleep(0.3)
+    raise TimeoutError(f"http on {port} never came up")
+
+
+@pytest.mark.slow
+def test_three_os_processes_form_cluster_and_survive_kill():
+    """The flagship system test: three `estpu` OS processes cluster over
+    TCP, take replicated writes over HTTP, and survive a SIGKILL'd node
+    with reallocation + peer recovery crossing real sockets."""
+    tports = _free_ports(3)
+    hports = _free_ports(3)
+    seeds = ",".join(f"127.0.0.1:{p}" for p in tports)
+    base = Path(tempfile.mkdtemp(prefix="estpu-proc-"))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = []
+    try:
+        for i in range(3):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "elasticsearch_tpu.bootstrap",
+                 "--cpu", "--data", str(base / f"n{i}"),
+                 "--port", str(hports[i]),
+                 "-E", "transport.type=tcp",
+                 "-E", f"transport.tcp.port={tports[i]}",
+                 "-E", f"discovery.zen.ping.unicast.hosts={seeds}",
+                 "-E", "discovery.zen.minimum_master_nodes=2",
+                 "-E", "fd.ping_interval=0.2", "-E", "fd.ping_timeout=0.5",
+                 "-E", "fd.ping_retries=2",
+                 "-E", "discovery.zen.ping_timeout=0.5",
+                 "-E", f"node.name=proc-{i}",
+                 "-E", "cluster.name=proc-test"],
+                cwd=str(REPO), env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        for p in hports:
+            _wait_http(p, timeout=90.0)
+        h = _http("GET", hports[0],
+                  "/_cluster/health?wait_for_nodes=3&timeout=30s",
+                  timeout=40.0)
+        assert h["number_of_nodes"] == 3, h
+
+        _http("PUT", hports[0], "/docs", {"settings": {
+            "number_of_shards": 2, "number_of_replicas": 1}})
+        h = _http("GET", hports[0],
+                  "/_cluster/health?wait_for_status=green&timeout=30s",
+                  timeout=40.0)
+        assert h["status"] == "green", h
+        bulk = "".join(
+            json.dumps({"index": {"_index": "docs", "_type": "d",
+                                  "_id": str(i)}}) + "\n" +
+            json.dumps({"body": f"token{i} shared"}) + "\n"
+            for i in range(50))
+        out = _http("POST", hports[0], "/_bulk?refresh=true",
+                    bulk.encode())
+        assert not out.get("errors"), out
+        # read through a DIFFERENT process
+        res = _http("POST", hports[1], "/docs/_search",
+                    {"query": {"match": {"body": "shared"}}, "size": 0})
+        assert res["hits"]["total"] == 50, res
+
+        procs[2].send_signal(signal.SIGKILL)
+        procs[2].wait(timeout=10)
+        deadline = time.monotonic() + 60
+        ok = False
+        while time.monotonic() < deadline:
+            try:
+                h = _http("GET", hports[0], "/_cluster/health",
+                          timeout=5.0)
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.5)
+                continue
+            if h["number_of_nodes"] == 2 and h["status"] == "green":
+                ok = True
+                break
+            time.sleep(0.5)
+        assert ok, f"cluster never healed after kill: {h}"
+        res = _http("POST", hports[0], "/docs/_search",
+                    {"query": {"match_all": {}}, "size": 0})
+        assert res["hits"]["total"] == 50, res
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
