@@ -1,0 +1,135 @@
+"""Admission-queue micro-batching for request-at-a-time traffic.
+
+The reference executes each search on its own thread the moment it
+arrives (core/search/query/QueryPhase.java:314's per-request model over
+the `search` thread pool). On an accelerator the economics invert: one
+fused batched program amortizes the dispatch + device→host round trip
+over every query in the batch (`ShardSearcher.query_phase_batch`), so the
+winning server shape for concurrent low-rate clients is an admission
+queue that coalesces whatever requests arrive within a tiny deadline into
+one device batch — the same latency/throughput trade TPU serving stacks
+make for model inference.
+
+Semantics: each caller blocks until its own result is ready; a request
+never waits longer than `max_wait_s` for peers, and a full batch
+dispatches immediately. Ineligible requests (aggs, sort-by-field, …)
+fall through to the caller's serial path untouched, so this is purely an
+optimization layer — results are produced by the same
+`query_phase_batch` program the msearch path uses.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+
+
+class AdaptiveBatcher:
+    """Deadline-bounded micro-batch admission queue in front of a
+    `query_phase_batch`-shaped callable.
+
+    `run_batch(reqs) -> list[results] | None` — None means the batch was
+    ineligible; every waiter then receives None and the caller runs its
+    serial fallback."""
+
+    def __init__(self, run_batch, max_batch: int = 64,
+                 max_wait_s: float = 0.002, pad_to_bucket: bool = True):
+        self._run_batch = run_batch
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        # Pad formed batches up to the next power of two (cycling the
+        # queued requests) so a jitted run_batch compiles O(log B) programs
+        # instead of one per distinct arrival count — jagged batch sizes
+        # are the norm under a deadline trigger. Requires run_batch to be
+        # a pure function of the request list (query_phase_batch is).
+        self.pad_to_bucket = pad_to_bucket
+        self._lock = threading.Lock()
+        self._queue: list[tuple[object, Future]] = []
+        self._timer: threading.Timer | None = None
+        self._closed = False
+        # stats (exposed through shard search stats)
+        self.batches = 0
+        self.requests = 0
+
+    def submit(self, req) -> Future:
+        """Enqueue one request; the Future resolves to its result (or None
+        when the formed batch turned out ineligible)."""
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                fut.set_result(None)
+                return fut
+            self._queue.append((req, fut))
+            full = len(self._queue) >= self.max_batch
+            if full:
+                batch = self._drain_locked()
+            elif self._timer is None:
+                t = threading.Timer(self.max_wait_s, self._deadline_fire)
+                t.daemon = True
+                t.start()
+                self._timer = t
+                batch = None
+            else:
+                batch = None
+        if full:
+            self._dispatch(batch)
+        return fut
+
+    def execute(self, req):
+        """Blocking convenience: submit and wait. → result | None."""
+        return self.submit(req).result()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            batch = self._drain_locked()
+        for _, fut in batch:
+            fut.set_result(None)
+
+    # ---- internals ---------------------------------------------------------
+
+    def _drain_locked(self) -> list:
+        batch, self._queue = self._queue, []
+        if self._timer is not None:
+            # a full-batch drain must defuse the pending deadline timer, or
+            # it fires into the NEXT forming batch and fragments it
+            self._timer.cancel()
+            self._timer = None
+        return batch
+
+    def _deadline_fire(self) -> None:
+        with self._lock:
+            batch = self._drain_locked()
+        if batch:
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: list) -> None:
+        reqs = [r for r, _ in batch]
+        if self.pad_to_bucket and len(reqs) < self.max_batch:
+            # bucket sizes that can reach run_batch: powers of two below
+            # max_batch, plus max_batch itself (full batches form at
+            # exactly max_batch anyway) — O(log B) distinct compiles even
+            # for a non-power-of-two max_batch
+            bucket = 1
+            while bucket < len(reqs):
+                bucket <<= 1
+            if bucket > self.max_batch:
+                bucket = self.max_batch
+            reqs = reqs + [reqs[i % len(reqs)]
+                           for i in range(bucket - len(reqs))]
+        try:
+            results = self._run_batch(reqs)
+        except Exception as e:               # noqa: BLE001 — fan the error out
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        if results is None:
+            for _, fut in batch:
+                fut.set_result(None)
+            return
+        for (_, fut), res in zip(batch, results):
+            if not fut.done():
+                fut.set_result(res)
+        self.batches += 1
+        self.requests += len(batch)

@@ -345,6 +345,15 @@ class ShardSearcher:
                 req, query=rewritten,
                 post_filter=None if req.post_filter is None
                 else self._rewrite_joins(req.post_filter))
+        # Single-request fast path: delegate eligible requests to the
+        # batched program with B=1. The batch program fuses scoring, merge
+        # and packing into ONE dispatch + ONE device→host fetch; the
+        # general path below pays one fetch per segment for counts plus
+        # two for the merged top-k, and on a tunneled interconnect each
+        # fetch is a full RTT (the request-at-a-time latency story).
+        fast = self.query_phase_batch([req])
+        if fast is not None:
+            return fast[0]
         k = max(req.from_ + req.size, 1)
         if req.rescore:
             # the shard must collect at least the largest rescore window

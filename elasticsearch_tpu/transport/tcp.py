@@ -49,6 +49,9 @@ class TcpTransport:
         # reply channels keyed by (requester node_id, its request_id):
         # request ids are per-requester counters, so two clients' ids collide
         self._inbound_channels: dict[tuple[str, int], socket.socket] = {}
+        # every accepted connection, so close() can sever them — a killed
+        # node must not process frames already in flight on inbound socks
+        self._inbound_socks: set[socket.socket] = set()
         # one writer lock per live socket — sendall releases the GIL between
         # chunks, so unserialized concurrent writers interleave frames
         self._write_locks: dict[int, threading.Lock] = {}
@@ -105,9 +108,18 @@ class TcpTransport:
         except OSError:
             pass
         with self._lock:
-            socks = list(self._outbound.values())
+            socks = list(self._outbound.values()) + \
+                list(self._inbound_socks)
             self._outbound.clear()
+            self._inbound_socks.clear()
+            self._write_locks.clear()
         for s in socks:
+            try:
+                # shutdown unblocks reader threads parked in recv() so no
+                # already-inflight frame gets dispatched after the kill
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 s.close()
             except OSError:
@@ -212,6 +224,7 @@ class TcpTransport:
         except OSError as e:
             with self._lock:
                 self._outbound.pop(addr, None)
+                self._write_locks.pop(id(sock), None)
             raise ConnectTransportError(f"send to {addr} failed: {e}") from e
 
     def _write_framed(self, sock: socket.socket, body: bytes) -> None:
@@ -250,6 +263,11 @@ class TcpTransport:
                 conn, _ = self._server.accept()
             except OSError:
                 return
+            with self._lock:
+                if self._closed:
+                    conn.close()
+                    continue
+                self._inbound_socks.add(conn)
             t = threading.Thread(target=self._read_loop, args=(conn,),
                                  daemon=True, name="tcp_read[inbound]")
             t.start()
@@ -273,6 +291,7 @@ class TcpTransport:
         finally:
             with self._lock:
                 self._write_locks.pop(id(sock), None)
+                self._inbound_socks.discard(sock)
             try:
                 sock.close()
             except OSError:
