@@ -610,13 +610,7 @@ def main() -> int:
 
         # warm every power-of-two bucket the padded batcher can form, so
         # the timed region never pays a compile (one program per bucket)
-        warm_sizes = []
-        b_ = 1
-        while b_ < n_clients:
-            warm_sizes.append(b_)
-            b_ <<= 1
-        warm_sizes.append(n_clients)    # full batches form at max_batch
-        for b_ in warm_sizes:
+        for b_ in batcher.bucket_sizes():
             searcher.query_phase_batch([reqs[i % len(reqs)]
                                         for i in range(b_)])
         t0 = time.perf_counter()

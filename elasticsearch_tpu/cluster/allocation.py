@@ -435,12 +435,20 @@ class AllocationService:
                     raise IllegalArgumentError(
                         f"[cancel] no copy of [{index}][{shard}] on "
                         f"[{node_id}]")
-                if c.primary and not args.get("allow_primary", False):
-                    raise IllegalArgumentError(
-                        "[cancel] primary needs allow_primary")
-                routing = routing.replace_shard(
-                    c, c.failed(UnassignedReason.REROUTE_CANCELLED,
-                                "reroute cancel"))
+                if c.relocation_target:
+                    # cancelling the landing half reverts the relocation;
+                    # the still-serving source resumes STARTED
+                    routing = self._revert_relocation(routing, target=c)
+                elif c.state == ShardRoutingState.RELOCATING:
+                    # cancelling the source side reverts the same way
+                    routing = self._revert_relocation(routing, source=c)
+                else:
+                    if c.primary and not args.get("allow_primary", False):
+                        raise IllegalArgumentError(
+                            "[cancel] primary needs allow_primary")
+                    routing = routing.replace_shard(
+                        c, c.failed(UnassignedReason.REROUTE_CANCELLED,
+                                    "reroute cancel"))
             elif kind in ("allocate", "allocate_replica"):
                 node_id = args.get("node")
                 if state.node(node_id) is None:
@@ -489,28 +497,18 @@ class AllocationService:
                     raise IllegalArgumentError(
                         f"[move] a copy of [{index}][{shard}] is already "
                         f"on [{to_node}]")
-                if c.primary:
-                    repl = next(
-                        (o for o in routing.shard_copies(index, shard)
-                         if o.active and not o.primary), None)
-                    if repl is None:
-                        raise IllegalArgumentError(
-                            "[move] cannot move a primary with no active "
-                            "replica (streaming relocation not "
-                            "implemented)")
-                    # swap roles first: the replica promotes in place; the
-                    # moving copy becomes a replica that peer-recovers on
-                    # the target from the new primary
-                    from dataclasses import replace as dc_replace
-                    routing = routing.replace_shard(
-                        repl, dc_replace(repl, primary=True))
-                    demoted = dc_replace(c, primary=False)
-                    routing = routing.replace_shard(c, demoted)
-                    c = demoted
-                moved = c.failed(UnassignedReason.REROUTE_CANCELLED,
-                                 f"reroute move to {to_node}")
-                routing = routing.replace_shard(c, moved.initialize(
-                    to_node))
+                # streaming relocation (RecoverySourceHandler.java:125-152
+                # recovery-with-handoff): the source copy keeps serving —
+                # and, for a primary, keeps COORDINATING writes — while
+                # the target peer-recovers; ops replicate to the target
+                # throughout (it is an assigned copy, so the replication
+                # fan-out includes it); apply_started_shards flips
+                # ownership when the target reports in. A sole primary
+                # moves safely: at no point does the shard lose its only
+                # serving copy.
+                src, tgt = c.relocate(to_node)
+                routing = routing.replace_shard(c, src)
+                routing = RoutingTable(routing.shards + (tgt,))
             else:
                 raise IllegalArgumentError(
                     f"unknown reroute command [{kind}]")
@@ -528,12 +526,32 @@ class AllocationService:
 
     def apply_started_shards(self, state: ClusterState,
                              started: list[ShardRouting]) -> ClusterState:
+        from dataclasses import replace as dc_replace
         routing = state.routing_table
         for s in started:
             current = self._find(routing, s)
-            if current is not None and \
-                    current.state == ShardRoutingState.INITIALIZING:
-                routing = routing.replace_shard(current, current.started())
+            if current is None or \
+                    current.state != ShardRoutingState.INITIALIZING:
+                continue
+            if current.relocation_target:
+                # relocation handoff: the target takes over the source's
+                # role (incl. the primary flag) in the same atomic routing
+                # update that retires the source — IndexShard's RELOCATED
+                # hand-off moment (ShardRoutingState.java:27-44)
+                source = next(
+                    (o for o in routing.shard_copies(s.index, s.shard)
+                     if o.state == ShardRoutingState.RELOCATING
+                     and o.relocating_node_id == current.node_id), None)
+                landed = dc_replace(current.started(),
+                                    primary=source.primary
+                                    if source is not None
+                                    else current.primary)
+                routing = routing.replace_shard(current, landed)
+                if source is not None:
+                    routing = RoutingTable(tuple(
+                        o for o in routing.shards if o.key != source.key))
+                continue
+            routing = routing.replace_shard(current, current.started())
         if routing is state.routing_table:
             return state
         state = state.with_(routing_table=routing)
@@ -569,13 +587,25 @@ class AllocationService:
         routing = state.routing_table
         for s, details in failed:
             current = self._find(routing, s)
-            if current is not None and current.assigned:
-                prev_failures = (current.unassigned_info.failed_allocations
-                                 if current.unassigned_info else 0)
-                routing = routing.replace_shard(
-                    current,
-                    current.failed(UnassignedReason.ALLOCATION_FAILED,
-                                   details, prev_failures + 1))
+            if current is None or not current.assigned:
+                continue
+            if current.relocation_target:
+                # failed landing: drop the surplus target and let the
+                # still-serving source resume STARTED (cancelRelocation)
+                routing = self._revert_relocation(routing, target=current)
+                continue
+            if current.state == ShardRoutingState.RELOCATING:
+                # the source died mid-handoff: its half-recovered target
+                # cannot finish (recovery source gone) — drop it, then
+                # fail the source copy normally
+                routing = self._drop_relocation_target(routing, current)
+                current = self._find(routing, s) or current
+            prev_failures = (current.unassigned_info.failed_allocations
+                             if current.unassigned_info else 0)
+            routing = routing.replace_shard(
+                current,
+                current.failed(UnassignedReason.ALLOCATION_FAILED,
+                               details, prev_failures + 1))
         if routing is state.routing_table:
             return state
         return self.reroute(state.with_(routing_table=routing),
@@ -612,6 +642,47 @@ class AllocationService:
         return alloc.explanations
 
     # ---- internals ---------------------------------------------------------
+
+    @staticmethod
+    def _revert_relocation(routing: RoutingTable,
+                           target: ShardRouting | None = None,
+                           source: ShardRouting | None = None
+                           ) -> RoutingTable:
+        """Cancel a relocation named by either of its halves: remove the
+        surplus target copy; the source resumes STARTED."""
+        from dataclasses import replace as dc_replace
+        if source is None:
+            source = next(
+                (o for o in routing.shard_copies(target.index,
+                                                 target.shard)
+                 if o.state == ShardRoutingState.RELOCATING
+                 and o.relocating_node_id == target.node_id), None)
+        if target is None:
+            target = next(
+                (o for o in routing.shard_copies(source.index,
+                                                 source.shard)
+                 if o.relocation_target
+                 and o.relocating_node_id == source.node_id), None)
+        if target is not None:
+            routing = RoutingTable(tuple(
+                o for o in routing.shards if o.key != target.key))
+        if source is not None:
+            routing = routing.replace_shard(
+                source, dc_replace(source, state=ShardRoutingState.STARTED,
+                                   relocating_node_id=None))
+        return routing
+
+    @staticmethod
+    def _drop_relocation_target(routing: RoutingTable,
+                                source: ShardRouting) -> RoutingTable:
+        target = next(
+            (o for o in routing.shard_copies(source.index, source.shard)
+             if o.relocation_target
+             and o.relocating_node_id == source.node_id), None)
+        if target is None:
+            return routing
+        return RoutingTable(tuple(
+            o for o in routing.shards if o.key != target.key))
 
     @staticmethod
     def _find(routing: RoutingTable, target: ShardRouting):
@@ -655,6 +726,15 @@ class AllocationService:
                                       routing: RoutingTable) -> RoutingTable:
         for s in list(routing.shards):
             if s.assigned and s.node_id not in state.nodes:
+                if s.relocation_target:
+                    # the landing node left: revert the relocation; the
+                    # source is still serving every required copy
+                    routing = self._revert_relocation(routing, target=s)
+                    continue
+                if s.state == ShardRoutingState.RELOCATING:
+                    # the source left mid-handoff: its target cannot
+                    # finish recovering from it — drop both and reallocate
+                    routing = self._drop_relocation_target(routing, s)
                 routing = routing.replace_shard(
                     s, s.failed(UnassignedReason.NODE_LEFT,
                                 f"node [{s.node_id}] left"))

@@ -80,6 +80,34 @@ class ShardRouting:
         return replace(self, state=ShardRoutingState.STARTED,
                        relocating_node_id=None, unassigned_info=None)
 
+    def relocate(self, to_node: str) -> tuple["ShardRouting",
+                                              "ShardRouting"]:
+        """Begin streaming relocation (ref: ShardRoutingState.java:27-44
+        RELOCATING + ShardRouting.buildTargetRelocatingShard). → (source,
+        target): the source keeps serving in RELOCATING; the target
+        INITIALIZES on `to_node` and peer-recovers while writes keep
+        replicating to it. Deviation from the reference: the target
+        carries primary=False during recovery even for a primary move —
+        primary() lookups and the replication fan-out then need no
+        relocation special-casing; completion transfers the primary flag
+        atomically in apply_started_shards."""
+        assert self.state == ShardRoutingState.STARTED
+        source = replace(self, state=ShardRoutingState.RELOCATING,
+                         relocating_node_id=to_node)
+        target = ShardRouting(
+            self.index, self.shard, to_node, False,
+            ShardRoutingState.INITIALIZING,
+            allocation_id=uuid.uuid4().hex[:20],
+            relocating_node_id=self.node_id)
+        return source, target
+
+    @property
+    def relocation_target(self) -> bool:
+        """An INITIALIZING copy that exists only as the landing half of a
+        relocation (its relocating_node_id points back at the source)."""
+        return self.state == ShardRoutingState.INITIALIZING and \
+            self.relocating_node_id is not None
+
     def failed(self, reason: UnassignedReason, details: str = "",
                failed_allocations: int = 0) -> "ShardRouting":
         return replace(
@@ -330,7 +358,13 @@ class ClusterState:
             # (the reference surfaces this as a ClusterBlockException /
             # red health rather than reporting pre-partition shard counts)
             status = "red"
-        elif unassigned > 0 or counts[ShardRoutingState.INITIALIZING] > 0:
+        elif unassigned > 0 or any(
+                s.state == ShardRoutingState.INITIALIZING
+                and not s.relocation_target
+                for s in self.routing_table.shards):
+            # a relocation target is a SURPLUS copy — every required copy
+            # is still active on the source side, so relocation alone
+            # keeps the cluster green (reference health semantics)
             status = "yellow"
         else:
             status = "green"

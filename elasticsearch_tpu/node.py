@@ -208,11 +208,20 @@ class Node:
             raw = [h.strip() for h in raw.split(",") if h.strip()]
         seeds = []
         for entry in raw:
-            host, sep, port = str(entry).rpartition(":")
-            if not sep or not port:
-                # bare host: default to the standard transport port (the
-                # reference appends :9300 to host-only unicast entries)
-                host, port = str(entry).rstrip(":"), "9300"
+            entry = str(entry)
+            if entry.startswith("["):
+                # bracketed IPv6: [::1] or [::1]:9300
+                host, _, rest = entry[1:].partition("]")
+                port = rest.lstrip(":") or "9300"
+            elif entry.count(":") > 1:
+                # raw IPv6 literal, no port syntax possible
+                host, port = entry, "9300"
+            else:
+                host, sep, port = entry.rpartition(":")
+                if not sep or not port:
+                    # bare host: default to the standard transport port
+                    # (the reference appends :9300 to host-only entries)
+                    host, port = entry.rstrip(":"), "9300"
             seeds.append(TransportAddress(host or "127.0.0.1", int(port)))
         return seeds
 

@@ -47,9 +47,24 @@ class AdaptiveBatcher:
         self._queue: list[tuple[object, Future]] = []
         self._timer: threading.Timer | None = None
         self._closed = False
-        # stats (exposed through shard search stats)
+        # dispatch counters (read by callers for telemetry; written under
+        # _lock — full-batch and deadline dispatches run on different
+        # threads)
         self.batches = 0
         self.requests = 0
+
+    def bucket_sizes(self) -> list[int]:
+        """Every batch size _dispatch can hand to run_batch: powers of two
+        below max_batch plus max_batch itself. Callers that pre-compile
+        (warm) programs iterate exactly this set."""
+        if not self.pad_to_bucket:
+            return list(range(1, self.max_batch + 1))
+        sizes, b = [], 1
+        while b < self.max_batch:
+            sizes.append(b)
+            b <<= 1
+        sizes.append(self.max_batch)
+        return sizes
 
     def submit(self, req) -> Future:
         """Enqueue one request; the Future resolves to its result (or None
@@ -131,5 +146,6 @@ class AdaptiveBatcher:
         for (_, fut), res in zip(batch, results):
             if not fut.done():
                 fut.set_result(res)
-        self.batches += 1
-        self.requests += len(batch)
+        with self._lock:
+            self.batches += 1
+            self.requests += len(batch)

@@ -1,0 +1,227 @@
+"""Streaming shard relocation (RELOCATING handoff).
+
+Reference: core/cluster/routing/ShardRoutingState.java:27-44 (RELOCATING
+state + target shard), core/indices/recovery/RecoverySourceHandler.java:
+125-152 (recovery-with-handoff: source serves while the target recovers;
+ops keep flowing; a final sync flips ownership). The round-3 gap this
+closes: a sole primary can now move between nodes without ever losing
+its only serving copy.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.allocation import AllocationService
+from elasticsearch_tpu.cluster.state import (
+    ClusterState, IndexMetadata, RoutingTable, ShardRouting,
+    ShardRoutingState)
+from elasticsearch_tpu.transport.service import (
+    DiscoveryNode, TransportAddress)
+
+
+# ---- state-machine unit tests ---------------------------------------------
+
+
+def _two_node_state(replicas: int = 0) -> ClusterState:
+    nodes = {f"n{i}": DiscoveryNode(f"n{i}", f"n{i}",
+                                    TransportAddress("local", 9300 + i))
+             for i in range(2)}
+    meta = IndexMetadata("idx", 1, replicas)
+    state = ClusterState(nodes=nodes, master_node_id="n0",
+                         indices={"idx": meta},
+                         routing_table=RoutingTable().add_index(meta))
+    alloc = AllocationService()
+    state = alloc.reroute(state, "test")
+    # start every INITIALIZING copy
+    started = [s for s in state.routing_table.shards
+               if s.state == ShardRoutingState.INITIALIZING]
+    return alloc.apply_started_shards(state, started), alloc
+
+
+def _copies(state):
+    return state.routing_table.shard_copies("idx", 0)
+
+
+def test_move_sole_primary_enters_relocating():
+    state, alloc = _two_node_state()
+    (src,) = _copies(state)
+    assert src.primary and src.state == ShardRoutingState.STARTED
+    other = "n1" if src.node_id == "n0" else "n0"
+    state = alloc.execute_commands(state, [
+        {"move": {"index": "idx", "shard": 0,
+                  "from_node": src.node_id, "to_node": other}}])
+    copies = _copies(state)
+    assert len(copies) == 2
+    source = next(c for c in copies
+                  if c.state == ShardRoutingState.RELOCATING)
+    target = next(c for c in copies if c.relocation_target)
+    # the source KEEPS the primary flag and keeps serving (active)
+    assert source.primary and source.active
+    assert source.relocating_node_id == other
+    assert target.node_id == other and not target.primary
+    # relocation is green: every required copy is still active
+    assert state.health(0)["status"] == "green"
+    assert state.health(0)["relocating_shards"] == 1
+
+
+def test_handoff_flips_primary_and_retires_source():
+    state, alloc = _two_node_state()
+    (src,) = _copies(state)
+    other = "n1" if src.node_id == "n0" else "n0"
+    state = alloc.execute_commands(state, [
+        {"move": {"index": "idx", "shard": 0,
+                  "from_node": src.node_id, "to_node": other}}])
+    target = next(c for c in _copies(state) if c.relocation_target)
+    state = alloc.apply_started_shards(state, [target])
+    copies = _copies(state)
+    assert len(copies) == 1
+    landed = copies[0]
+    assert landed.node_id == other and landed.primary
+    assert landed.state == ShardRoutingState.STARTED
+    assert landed.relocating_node_id is None
+    assert state.health(0)["status"] == "green"
+
+
+def test_cancel_on_target_reverts_relocation():
+    state, alloc = _two_node_state()
+    (src,) = _copies(state)
+    other = "n1" if src.node_id == "n0" else "n0"
+    state = alloc.execute_commands(state, [
+        {"move": {"index": "idx", "shard": 0,
+                  "from_node": src.node_id, "to_node": other}}])
+    state = alloc.execute_commands(state, [
+        {"cancel": {"index": "idx", "shard": 0, "node": other}}])
+    copies = _copies(state)
+    assert len(copies) == 1
+    assert copies[0].node_id == src.node_id
+    assert copies[0].state == ShardRoutingState.STARTED
+    assert copies[0].primary
+
+
+def test_target_node_left_reverts_relocation():
+    state, alloc = _two_node_state()
+    (src,) = _copies(state)
+    other = "n1" if src.node_id == "n0" else "n0"
+    state = alloc.execute_commands(state, [
+        {"move": {"index": "idx", "shard": 0,
+                  "from_node": src.node_id, "to_node": other}}])
+    survivors = {nid: n for nid, n in state.nodes.items() if nid != other}
+    state = alloc.reroute(state.with_(nodes=survivors), "node left")
+    copies = _copies(state)
+    assert len(copies) == 1
+    assert copies[0].node_id == src.node_id
+    assert copies[0].state == ShardRoutingState.STARTED
+
+
+def test_source_node_left_drops_target_and_unassigns():
+    state, alloc = _two_node_state()
+    (src,) = _copies(state)
+    other = "n1" if src.node_id == "n0" else "n0"
+    state = alloc.execute_commands(state, [
+        {"move": {"index": "idx", "shard": 0,
+                  "from_node": src.node_id, "to_node": other}}])
+    survivors = {nid: n for nid, n in state.nodes.items()
+                 if nid != src.node_id}
+    state = alloc.reroute(state.with_(nodes=survivors), "node left")
+    copies = _copies(state)
+    # the half-recovered target is dropped with its source; the primary
+    # slot re-allocates (possibly back onto the surviving node)
+    assert all(not c.relocation_target for c in copies)
+    assert sum(1 for c in copies if c.primary) == 1
+
+
+def test_failed_target_report_reverts_relocation():
+    state, alloc = _two_node_state()
+    (src,) = _copies(state)
+    other = "n1" if src.node_id == "n0" else "n0"
+    state = alloc.execute_commands(state, [
+        {"move": {"index": "idx", "shard": 0,
+                  "from_node": src.node_id, "to_node": other}}])
+    target = next(c for c in _copies(state) if c.relocation_target)
+    state = alloc.apply_failed_shards(state, [(target, "disk died")])
+    copies = _copies(state)
+    assert len(copies) == 1
+    assert copies[0].node_id == src.node_id
+    assert copies[0].state == ShardRoutingState.STARTED
+
+
+# ---- integration: live cluster, concurrent writes -------------------------
+
+
+@pytest.fixture()
+def cluster():
+    from elasticsearch_tpu.testing import InternalTestCluster
+    c = InternalTestCluster(num_nodes=2)
+    yield c
+    c.close()
+
+
+def test_move_sole_primary_with_concurrent_writes(cluster):
+    """The VERDICT acceptance test: a sole primary moves between live
+    nodes while a writer hammers it; every acknowledged write survives
+    the handoff and the source engine is gone afterwards."""
+    a = cluster.nodes[0]
+    a.indices_service.create_index("m", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0}})
+    a.wait_for_health("green", timeout=10)
+    for i in range(50):
+        a.index_doc("m", f"pre{i}", {"n": i})
+
+    state = a.cluster_service.state()
+    src = state.routing_table.primary("m", 0)
+    target_node = next(n for n in cluster.nodes
+                       if n.node_id != src.node_id)
+
+    acked = []
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            try:
+                a.index_doc("m", f"live{i}", {"n": i})
+                acked.append(i)
+            except Exception:        # noqa: BLE001 — unacked writes may fail
+                pass
+            i += 1
+            time.sleep(0.002)
+
+    w = threading.Thread(target=writer)
+    w.start()
+    try:
+        a.cluster_reroute([{"move": {
+            "index": "m", "shard": 0,
+            "from_node": src.node_id, "to_node": target_node.node_id}}])
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            pr = a.cluster_service.state().routing_table.primary("m", 0)
+            if pr is not None and pr.node_id == target_node.node_id and \
+                    pr.state == ShardRoutingState.STARTED:
+                break
+            time.sleep(0.05)
+        pr = a.cluster_service.state().routing_table.primary("m", 0)
+        assert pr.node_id == target_node.node_id and \
+            pr.state == ShardRoutingState.STARTED, pr
+        # writes continue to land on the new primary
+        a.index_doc("m", "post", {"n": -1})
+    finally:
+        stop.set()
+        w.join(timeout=10)
+
+    a.broadcast_actions.refresh("m")
+    res = a.search("m", {"size": 0})
+    expected = 50 + len(acked) + 1
+    assert res["hits"]["total"] == expected, \
+        (res["hits"]["total"], expected)
+    # spot-check acked live writes round-trip by id
+    for i in acked[:5] + acked[-5:]:
+        assert a.get_doc("m", f"live{i}")["_source"]["n"] == i
+    # the source node no longer hosts the shard engine
+    src_node = next(n for n in cluster.nodes if n.node_id == src.node_id)
+    svc = src_node.indices_service.indices.get("m")
+    assert svc is None or 0 not in svc.engines
+    assert a.wait_for_health("green", timeout=5)["status"] == "green"
