@@ -286,9 +286,17 @@ class ShardRequestCache:
 
 class SearchActions:
     QUERY_FETCH = "indices:data/read/search[phase/query+fetch]"
+    QUERY_ID = "indices:data/read/search[phase/query]"
+    FETCH_ID = "indices:data/read/search[phase/fetch/id]"
+    FREE_CONTEXT = "indices:data/read/search[free_context]"
     MSEARCH_SHARD = "indices:data/read/msearch[shard]"
     DFS = "indices:data/read/search[phase/dfs]"
     FIELD_STATS = "indices:data/read/field_stats[s]"
+
+    # fetch amplification break-even: below this window the extra fetch
+    # round trip of query_then_fetch costs more than the surplus _source
+    # bytes query_and_fetch ships (see `search` docstring)
+    QTF_WINDOW_THRESHOLD = 100
 
     def __init__(self, node):
         self.node = node
@@ -309,6 +317,15 @@ class SearchActions:
             executor="search", sync=True)
         node.transport_service.register_request_handler(
             self.DFS, self._handle_shard_dfs, executor="search", sync=True)
+        node.transport_service.register_request_handler(
+            self.QUERY_ID, self._handle_shard_query_only,
+            executor="search", sync=True)
+        node.transport_service.register_request_handler(
+            self.FETCH_ID, self._handle_shard_fetch,
+            executor="search", sync=True)
+        node.transport_service.register_request_handler(
+            self.FREE_CONTEXT, self._handle_free_context,
+            executor="same", sync=True)
         self.request_cache = ShardRequestCache(
             cap=int(node.settings.get("indices.requests.cache.entries", 256))
             if hasattr(node, "settings") else 256)
@@ -347,6 +364,113 @@ class SearchActions:
                                    doc_slot=request.get("doc_slot"),
                                    dfs=request.get("dfs"),
                                    scroll_pin=request.get("scroll_pin"))
+
+    def _handle_shard_query_only(self, request: dict, source) -> dict:
+        return self._execute_shard_query(
+            request["index"], request["shard"], request["body"],
+            doc_slot=request.get("doc_slot"), dfs=request.get("dfs"),
+            pin=request["pin"])
+
+    def _execute_shard_query(self, name: str, shard: int, body: dict,
+                             doc_slot: int | None, dfs: dict | None,
+                             pin: dict) -> dict:
+        """Query phase only (QueryPhase.execute without fetch): rank this
+        shard's top from+size and return compact hit DESCRIPTORS — ids,
+        scores, sort keys — never `_source`. The reader pins under the
+        request's context uid so the fetch round sees the same
+        point-in-time (the reference holds the docs in the shard's search
+        context between phases; ids crossing the wire + a pinned reader
+        give the same contract)."""
+        t0 = time.perf_counter()
+        svc = self.node.indices_service.index(name)
+        engine = svc.engine(shard)
+        reader = self._pinned_reader(pin, name, shard, engine)
+        breaker = None
+        if svc.breaker_service is not None:
+            breaker = svc.breaker_service.breaker("request")
+            est = max(reader.num_docs, 1) * 16
+            breaker.add_estimate(est, f"search [{name}][{shard}]")
+        try:
+            from elasticsearch_tpu.search.dfs import to_execution_stats
+            searcher = ShardSearcher(shard, reader, svc.mapper_service,
+                                     index_name=name, doc_slot=doc_slot,
+                                     dfs_stats=to_execution_stats(dfs),
+                                     version_fn=engine.doc_version)
+            req = parse_search_request(body)
+            result = searcher.query_phase(req)
+            q_ms = (time.perf_counter() - t0) * 1000.0
+            svc.note_search(body.get("stats"), q_ms)
+            k = min(len(result.doc_ids), req.from_ + req.size)
+            out = {"total": result.total,
+                   "max_score": (float(result.max_score)
+                                 if result.max_score is not None else None),
+                   "docs": [int(d) for d in result.doc_ids[:k]],
+                   "scores": [float(s) for s in result.scores[:k]],
+                   "sort": wire_safe(result.sort_values[:k])
+                   if result.sort_values is not None else None,
+                   "aggs": wire_safe(result.agg_partials),
+                   "terminated_early": result.terminated_early,
+                   "timed_out": result.timed_out}
+            if req.suggest:
+                from elasticsearch_tpu.search.suggest import ShardSuggester
+                sg = ShardSuggester(reader, svc.mapper_service)
+                out["suggest"] = {spec.name: sg.collect(spec)
+                                  for spec in req.suggest}
+        finally:
+            if breaker is not None:
+                breaker.release(est)
+        if svc.search_slow_log.thresholds:
+            svc.search_slow_log.maybe_log(
+                time.perf_counter() - t0,
+                f"shard[{shard}], source[{json.dumps(body)[:512]}]")
+        return out
+
+    def _handle_shard_fetch(self, request: dict, source) -> dict:
+        """Fetch phase for coordinator-chosen winners (fillDocIdsToLoad →
+        the second fan-out, TransportSearchQueryThenFetchAction.java:
+        89-150): build full hits for exactly the doc ids that made the
+        global page, against the reader pinned by the query round."""
+        from elasticsearch_tpu.search.phase import ShardQueryResult
+        name, shard = request["index"], request["shard"]
+        svc = self.node.indices_service.index(name)
+        engine = svc.engine(shard)
+        reader = self._pinned_reader({**request["pin"], "require": True},
+                                     name, shard, engine)
+        req = parse_search_request(request["body"])
+        docs = np.asarray(request["docs"], np.int32)
+        result = ShardQueryResult(
+            shard, 0, None, docs,
+            np.asarray(request["scores"], np.float32),
+            request.get("sort"), {}, reader)
+        searcher = ShardSearcher(shard, reader, svc.mapper_service,
+                                 index_name=name,
+                                 doc_slot=request.get("doc_slot"),
+                                 version_fn=engine.doc_version)
+        return {"hits": searcher.fetch_phase(req, result, name,
+                                             list(range(len(docs))))}
+
+    def _handle_free_context(self, request: dict, source) -> dict:
+        """Release reader pins for a finished context (the reference's
+        free-context round after query_then_fetch / on clear_scroll)."""
+        self._drop_pins(request["uid"])
+        return {}
+
+    def _free_context(self, uid: str, node_ids) -> None:
+        """Fire-and-forget pin release on exactly the nodes that served
+        the context (the reference's free-context round)."""
+        self._drop_pins(uid)
+        state = self.node.cluster_service.state()
+        for nid in set(node_ids):
+            if nid == self.node.node_id:
+                continue
+            target = state.node(nid)
+            if target is None:
+                continue
+            try:
+                self.node.transport_service.send_request(
+                    target, self.FREE_CONTEXT, {"uid": uid}, timeout=5.0)
+            except Exception:        # noqa: BLE001 — pins age out anyway
+                pass
 
     def _handle_shard_msearch(self, request: dict, source) -> dict:
         """Shard-side _msearch: B request bodies against one shard in ONE
@@ -524,9 +648,14 @@ class SearchActions:
     def _try_shard(self, state, name: str, sid: int, copies: list,
                    body: dict, doc_slot: int | None = None,
                    dfs: dict | None = None,
-                   scroll_pin: dict | None = None):
-        """→ ("ok", payload) or ("fail", reason-dict). Walks the copy list
-        (shard-failover retry, TransportSearchTypeAction.java:205-247)."""
+                   scroll_pin: dict | None = None,
+                   qtf_pin: dict | None = None):
+        """→ ("ok", payload, node_id) or ("fail", reason-dict, None).
+        Walks the copy list (shard-failover retry,
+        TransportSearchTypeAction.java:205-247). With `qtf_pin`, runs the
+        query-ONLY phase (descriptors, reader pinned) instead of
+        query+fetch; the returned node_id tells the coordinator where the
+        pin — and thus the fetch round — lives."""
         from elasticsearch_tpu.action.replication import unwrap_remote
         from elasticsearch_tpu.common.errors import (
             IllegalArgumentError, MapperParsingError, QueryParsingError)
@@ -539,23 +668,36 @@ class SearchActions:
                     # SEARCH threadpool too) so saturation rejects instead
                     # of queueing unboundedly; a rejection fails over to
                     # the next copy like any shard failure
-                    fut = self.node.thread_pool.submit(
-                        "search", self._execute_shard, name, sid, body,
-                        doc_slot=doc_slot, dfs=dfs, scroll_pin=scroll_pin)
+                    if qtf_pin is not None:
+                        fut = self.node.thread_pool.submit(
+                            "search", self._execute_shard_query, name, sid,
+                            body, doc_slot, dfs, qtf_pin)
+                    else:
+                        fut = self.node.thread_pool.submit(
+                            "search", self._execute_shard, name, sid, body,
+                            doc_slot=doc_slot, dfs=dfs,
+                            scroll_pin=scroll_pin)
                     try:
-                        return "ok", fut.result(35.0)
+                        return "ok", fut.result(35.0), c.node_id
                     except Exception:
                         fut.cancel()     # don't leave abandoned work queued
                         raise
                 target = state.node(c.node_id)
                 if target is None:
                     continue
+                if qtf_pin is not None:
+                    action = self.QUERY_ID
+                    request = {"index": name, "shard": sid, "body": body,
+                               "doc_slot": doc_slot, "dfs": dfs,
+                               "pin": qtf_pin}
+                else:
+                    action = self.QUERY_FETCH
+                    request = {"index": name, "shard": sid, "body": body,
+                               "doc_slot": doc_slot, "dfs": dfs,
+                               "scroll_pin": scroll_pin}
                 return "ok", self.node.transport_service.send_request(
-                    target, self.QUERY_FETCH,
-                    {"index": name, "shard": sid, "body": body,
-                     "doc_slot": doc_slot, "dfs": dfs,
-                     "scroll_pin": scroll_pin},
-                    timeout=30.0).result(35.0)
+                    target, action, request,
+                    timeout=30.0).result(35.0), c.node_id
             except Exception as e:               # noqa: BLE001 — classify
                 e = unwrap_remote(e)
                 # Deterministic request errors fail the same way on every
@@ -573,7 +715,7 @@ class SearchActions:
         if isinstance(last, ElasticsearchTpuError):
             fail["reason"] = last.to_xcontent()
             fail["status"] = last.status
-        return "fail", fail
+        return "fail", fail, None
 
     # accepted search types (ref: SearchType.fromString,
     # core/action/search/SearchType.java:29 — scan/count are deprecated
@@ -689,12 +831,27 @@ class SearchActions:
         # scroll's later pages (same index set) assign identical slots
         slot_of = {(n, s): i for i, (n, s) in
                    enumerate(sorted((n, s) for n, s, _ in groups))}
+        # True QUERY_THEN_FETCH (fillDocIdsToLoad + second fan-out,
+        # SearchPhaseController.java:289, TransportSearchQueryThenFetch
+        # Action.java:89-150) when the window is deep enough that shipping
+        # every shard's full from+size `_source` payloads would dominate:
+        # the query round moves only ids/scores, the fetch round touches
+        # only the shards owning the global page. Shallow windows keep the
+        # single-round QUERY_AND_FETCH model (module docstring) — the
+        # extra round trip costs more than the surplus hit bytes.
+        use_qtf = scroll_pin is None and len(groups) > 1 and (
+            search_type in ("query_then_fetch", "dfs_query_then_fetch")
+            or (search_type is None
+                and req.from_ + req.size >= self.QTF_WINDOW_THRESHOLD))
+        if use_qtf:
+            return self._query_then_fetch(state, groups, body, req, t0,
+                                          slot_of, dfs)
         futures = [self._pool.submit(self._try_shard, state, n, s, copies,
                                      body, slot_of[(n, s)], dfs, scroll_pin)
                    for n, s, copies in groups]
         payloads, failures = [], []
         for fut in futures:
-            status, payload = fut.result()
+            status, payload, _node = fut.result()
             if status == "ok":
                 payloads.append(payload)
             else:
@@ -702,6 +859,92 @@ class SearchActions:
         return merge_shard_payloads(
             req, payloads, (time.perf_counter() - t0) * 1e3,
             total_shards=len(groups), failures=failures)
+
+    def _query_then_fetch(self, state, groups, body: dict, req, t0: float,
+                          slot_of: dict, dfs: dict | None) -> dict:
+        """Two-round distributed search: query (descriptors only) →
+        coordinator merge → winner-only fetch → assemble."""
+        import uuid as _uuid
+        from elasticsearch_tpu.search.controller import _hit_comparator
+        pin = {"uid": _uuid.uuid4().hex, "keep_s": 30.0}
+        futures = [self._pool.submit(self._try_shard, state, n, s, copies,
+                                     body, slot_of[(n, s)], dfs,
+                                     None, pin)
+                   for n, s, copies in groups]
+        qpayloads, failures = [], []   # (payload, node_id, name, sid, slot)
+        for (n, s, _), fut in zip(groups, futures):
+            status, payload, node_id = fut.result()
+            if status == "ok":
+                qpayloads.append((payload, node_id, n, s, slot_of[(n, s)]))
+            else:
+                failures.append(payload)
+        try:
+            # sortDocs over descriptors → the global [from, from+size)
+            entries = []
+            for si, (p, _, _, _, _) in enumerate(qpayloads):
+                sort_vals = p.get("sort")
+                for pos in range(len(p["docs"])):
+                    entries.append((
+                        sort_vals[pos] if sort_vals is not None else None,
+                        p["scores"][pos], si, pos))
+            keyfn = _hit_comparator(req)
+            entries.sort(key=keyfn)
+            page = entries[req.from_: req.from_ + req.size]
+            # fillDocIdsToLoad → fetch ONLY from shards owning winners,
+            # targeting the exact node whose reader is pinned
+            by_shard: dict[int, list[int]] = {}
+            for e in page:
+                by_shard.setdefault(e[2], []).append(e[3])
+            fetch_futs = {}
+            for si, positions in by_shard.items():
+                p, node_id, name, sid, slot = qpayloads[si]
+                request = {
+                    "index": name, "shard": sid, "body": body, "pin": pin,
+                    "doc_slot": slot,
+                    "docs": [p["docs"][pos] for pos in positions],
+                    "scores": [p["scores"][pos] for pos in positions],
+                    "sort": ([p["sort"][pos] for pos in positions]
+                             if p.get("sort") is not None else None)}
+                if node_id == self.node.node_id:
+                    fetch_futs[si] = self.node.thread_pool.submit(
+                        "search", self._handle_shard_fetch, request, None)
+                else:
+                    target = state.node(node_id)
+                    if target is None:
+                        fetch_futs[si] = None
+                        continue
+                    fetch_futs[si] = self.node.transport_service.\
+                        send_request(target, self.FETCH_ID, request,
+                                     timeout=30.0)
+            fetched: dict[tuple[int, int], dict] = {}
+            fetch_failed: set[int] = set()
+            for si, positions in by_shard.items():
+                fut = fetch_futs.get(si)
+                try:
+                    if fut is None:
+                        raise ElasticsearchTpuError(
+                            "fetch target node left the cluster")
+                    hits = fut.result(35.0)["hits"]
+                    for pos, hit in zip(positions, hits):
+                        fetched[(si, pos)] = hit
+                except Exception as e:   # noqa: BLE001 — per-shard failure
+                    fetch_failed.add(si)
+                    _, _, name, sid, _ = qpayloads[si]
+                    failures.append({
+                        "shard": sid, "index": name,
+                        "reason": {"type": "fetch_phase_failure",
+                                   "reason": str(e)}})
+            hits_out = [fetched[(e[2], e[3])] for e in page
+                        if (e[2], e[3]) in fetched]
+        finally:
+            self._free_context(pin["uid"],
+                               [nid for _, nid, *_ in qpayloads])
+        from elasticsearch_tpu.search.controller import assemble_response
+        payloads = [p for p, *_ in qpayloads]
+        return assemble_response(
+            req, payloads, hits_out, (time.perf_counter() - t0) * 1e3,
+            total_shards=len(groups), failures=failures,
+            successful=len(qpayloads) - len(fetch_failed))
 
     def count(self, index_expr: str, body: dict | None = None) -> dict:
         resp = self.search(index_expr, {**(body or {}), "size": 0})
@@ -1037,6 +1280,14 @@ class SearchActions:
                 self._pinned[key] = (view, reader,
                                      now + scroll_pin["keep_s"])
                 return reader
+        if scroll_pin.get("require"):
+            # a fetch round arriving after its query-round pin expired
+            # MUST fail: re-pinning the current view would resolve the
+            # shipped reader-local doc ids against a different point in
+            # time and silently return the wrong documents
+            raise SearchContextMissingError(
+                f"no pinned context [{scroll_pin['uid']}] for "
+                f"[{name}][{shard}]")
         view = engine.acquire_searcher()
         reader = device_reader_for(engine, view)
         if reader.generation != view.generation:

@@ -80,6 +80,47 @@ def _hit_comparator(req: ParsedSearchRequest):
     return functools.cmp_to_key(cmp_entries)
 
 
+def assemble_response(req: ParsedSearchRequest, payloads: list[dict],
+                      hits_out: list[dict], took_ms: float,
+                      total_shards: int, failures: list[dict],
+                      successful: int | None = None) -> dict:
+    """Final response assembly shared by both distributed execution
+    models (SearchPhaseController.merge :300-431): totals, max_score
+    gating, shard accounting, agg/suggest reduction — over pre-merged
+    page hits."""
+    total = sum(p["total"] for p in payloads)
+    max_scores = [p["max_score"] for p in payloads
+                  if p.get("max_score") is not None]
+    max_score = max(max_scores) if max_scores and req.size > 0 \
+        and not req.sort else None
+    shards = {"total": total_shards,
+              "successful": len(payloads) if successful is None
+              else successful,
+              "skipped": 0, "failed": len(failures)}
+    if failures:
+        shards["failures"] = failures
+    response = {
+        "took": int(took_ms),
+        "timed_out": any(p.get("timed_out") for p in payloads),
+        "_shards": shards,
+        "hits": {
+            "total": total,
+            "max_score": max_score,
+            "hits": hits_out,
+        },
+    }
+    if any(p.get("terminated_early") for p in payloads):
+        response["terminated_early"] = True
+    if req.aggs:
+        response["aggregations"] = reduce_aggs(
+            req.aggs, [p["aggs"] for p in payloads])
+    if req.suggest:
+        from elasticsearch_tpu.search.suggest import reduce_suggest
+        response["suggest"] = reduce_suggest(
+            req.suggest, [p.get("suggest", {}) for p in payloads])
+    return response
+
+
 def merge_shard_payloads(req: ParsedSearchRequest, payloads: list[dict],
                          took_ms: float, total_shards: int,
                          failures: list[dict]) -> dict:
@@ -95,36 +136,8 @@ def merge_shard_payloads(req: ParsedSearchRequest, payloads: list[dict],
     keyfn = _hit_comparator(req)
     entries.sort(key=lambda e: keyfn((e[0], e[1], e[2], e[3])))
     page = entries[req.from_: req.from_ + req.size]
-
-    total = sum(p["total"] for p in payloads)
-    max_scores = [p["max_score"] for p in payloads
-                  if p.get("max_score") is not None]
-    max_score = max(max_scores) if max_scores and req.size > 0 \
-        and not req.sort else None
-    shards = {"total": total_shards, "successful": len(payloads),
-              "skipped": 0, "failed": len(failures)}
-    if failures:
-        shards["failures"] = failures
-    response = {
-        "took": int(took_ms),
-        "timed_out": any(p.get("timed_out") for p in payloads),
-        "_shards": shards,
-        "hits": {
-            "total": total,
-            "max_score": max_score,
-            "hits": [e[4] for e in page],
-        },
-    }
-    if any(p.get("terminated_early") for p in payloads):
-        response["terminated_early"] = True
-    if req.aggs:
-        response["aggregations"] = reduce_aggs(
-            req.aggs, [p["aggs"] for p in payloads])
-    if req.suggest:
-        from elasticsearch_tpu.search.suggest import reduce_suggest
-        response["suggest"] = reduce_suggest(
-            req.suggest, [p.get("suggest", {}) for p in payloads])
-    return response
+    return assemble_response(req, payloads, [e[4] for e in page], took_ms,
+                             total_shards, failures)
 
 
 def merge_responses(index_name: str, req: ParsedSearchRequest,

@@ -214,8 +214,10 @@ def test_move_sole_primary_with_concurrent_writes(cluster):
 
     a.broadcast_actions.refresh("m")
     res = a.search("m", {"size": 0})
+    # every ACKED write must survive; >= because a write applied on the
+    # engine whose ack then raced the handoff lands unacked-but-present
     expected = 50 + len(acked) + 1
-    assert res["hits"]["total"] == expected, \
+    assert res["hits"]["total"] >= expected, \
         (res["hits"]["total"], expected)
     # spot-check acked live writes round-trip by id
     for i in acked[:5] + acked[-5:]:
