@@ -441,17 +441,50 @@ class DocumentActions:
             from elasticsearch_tpu.common.errors import VersionConflictError
             raise VersionConflictError(name, request["id"], current.version,
                                        request["req_version"])
+        script_meta_updates: dict = {}
         if "doc" in body:
             merged = _deep_merge(dict(current.source), body["doc"])
         elif "script" in body:
-            merged = _apply_update_script(dict(current.source),
-                                          body["script"])
+            now_ms = int(time.time() * 1000)
+            script_meta = {k: v for k, v in (current.meta or {}).items()
+                           if k in ("_ttl", "_timestamp", "_routing",
+                                    "_parent")}
+            if "_ttl" in script_meta:
+                # scripts see/set ttl as REMAINING millis (TTLFieldMapper
+                # ctx._ttl semantics); storage keeps the absolute expiry
+                script_meta["_ttl"] = int(script_meta["_ttl"]) - now_ms
+            merged, op, script_meta_updates = _apply_update_script(
+                dict(current.source), body["script"],
+                meta={"_id": request["id"], **script_meta})
+            if "_ttl" in script_meta_updates:
+                script_meta_updates["_ttl"] = \
+                    now_ms + int(script_meta_updates["_ttl"])
+            if op == "none":
+                # noop result (UpdateHelper: ctx.op = "none")
+                return {"_index": name, "_type": "_doc",
+                        "_id": request["id"],
+                        "_version": current.version, "result": "noop",
+                        "_shards": {"total": 0, "successful": 0,
+                                    "failed": 0},
+                        "_update_source": dict(current.source)}
+            if op == "delete":
+                # keep the optimistic check: a write landing between the
+                # get and this delete must conflict, not vanish
+                out = self._handle_delete_p_local(
+                    {"index": name, "shard": shard, "id": request["id"],
+                     "version": current.version,
+                     "refresh": bool(request.get("refresh"))})
+                out["result"] = "deleted"
+                out["_update_source"] = dict(current.source)
+                return out
         else:
             merged = dict(current.source)
         # carry existing metadata forward, overridden by the request's
-        # (a fresh ttl/timestamp restamps; parent/type persist)
+        # (a fresh ttl/timestamp restamps; parent/type persist), then by
+        # anything the update script set on ctx (_ttl/_timestamp)
         new_meta = dict(current.meta or {})
         new_meta.update(request.get("meta") or {})
+        new_meta.update(script_meta_updates)
         out = self._handle_index_p_local(
             {"index": name, "shard": shard, "id": request["id"],
              "source": merged, "routing": request.get("routing"),

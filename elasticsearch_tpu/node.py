@@ -957,37 +957,28 @@ def _deep_merge(base: dict, patch: dict) -> dict:
     return base
 
 
-def _apply_update_script(source: dict, script) -> dict:
-    """Update scripts: support the common `ctx._source.field = ...` and
-    `ctx._source.field += n` idioms via a restricted evaluator."""
-    import re as _re
+def _apply_update_script(source: dict, script,
+                         meta: dict | None = None) -> tuple[dict, str]:
+    """Run an update script against the document (UpdateHelper.prepare):
+    the script sees `ctx` with a mutable `_source` plus `op`/`_ttl`/
+    `_timestamp`/`_id` and `params`; → (new source, op) where op is
+    "index" (reindex), "none" (noop) or "delete" (remove the doc).
+    Interpreted by GroovyLite (scriptlang.py), the lang-groovy analog —
+    conditionals, loops and collection mutation all work."""
+    from elasticsearch_tpu.search.scriptlang import compile_groovylite
     if isinstance(script, dict):
         src = script.get("source", script.get("inline", ""))
         params = script.get("params", {})
     else:
         src, params = str(script), {}
-    for stmt in src.split(";"):
-        stmt = stmt.strip()
-        if not stmt:
-            continue
-        m = _re.fullmatch(
-            r"ctx\._source\.(\w+)\s*(=|\+=|-=)\s*(.+)", stmt)
-        if not m:
-            raise ValueError(f"unsupported update script [{stmt}]")
-        fname, op, expr = m.groups()
-        expr = expr.strip()
-        pm = _re.fullmatch(r"params\.(\w+)", expr)
-        if pm:
-            value = params[pm.group(1)]
-        else:
-            try:
-                value = float(expr) if "." in expr else int(expr)
-            except ValueError:
-                value = expr.strip("'\"")
-        if op == "=":
-            source[fname] = value
-        elif op == "+=":
-            source[fname] = source.get(fname, 0) + value
-        elif op == "-=":
-            source[fname] = source.get(fname, 0) - value
-    return source
+    ctx = {"_source": source, "op": "index", **(meta or {})}
+    before = {k: ctx.get(k) for k in ("_ttl", "_timestamp")}
+    compile_groovylite(src).run({"ctx": ctx, "params": params})
+    op = ctx.get("op", "index")
+    if op not in ("index", "none", "noop", "delete"):
+        raise ValueError(f"invalid ctx.op [{op}]")
+    # scripts may restamp ttl/timestamp (UpdateHelper reads ctx._ttl /
+    # ctx._timestamp after the script runs)
+    meta_updates = {k: ctx[k] for k in ("_ttl", "_timestamp")
+                    if ctx.get(k) is not None and ctx.get(k) != before[k]}
+    return ctx["_source"], "none" if op == "noop" else op, meta_updates

@@ -1039,26 +1039,116 @@ def _c_percentile_ranks(node, mask, ctx):
             "wanted": [float(v) for v in node.params.get("values", [])]}
 
 
+class _AggDocValues:
+    """`doc` binding for interpreted scripted_metric: doc['f'].value over
+    one segment's numeric/keyword columns, one doc at a time."""
+
+    def __init__(self, seg):
+        self.seg = seg
+        self.doc = 0
+
+    def __scriptlang_getitem__(self, field):
+        return _AggFieldValue(self, field)
+
+
+class _AggFieldValue:
+    def __init__(self, owner: _AggDocValues, field: str):
+        self.owner = owner
+        self.field = field
+
+    def _keyword_col(self):
+        seg = self.owner.seg
+        # analyzed strings expose doc values through their .keyword
+        # subfield (the columnar analog of fielddata on text)
+        return seg.keyword_fields.get(self.field) or \
+            seg.keyword_fields.get(self.field + ".keyword")
+
+    def _values(self) -> list:
+        seg, i = self.owner.seg, self.owner.doc
+        num = seg.numeric_fields.get(self.field)
+        if num is not None:
+            return [float(num.values[i])] if num.exists[i] else []
+        kw = self._keyword_col()
+        if kw is not None:
+            return [kw.vocab[o] for o in kw.ords[i] if o >= 0]
+        return []
+
+    def __scriptlang_getattr__(self, name: str):
+        vals = self._values()
+        if name == "value":
+            return vals[0] if vals else (
+                "" if self._keyword_col() is not None else 0.0)
+        if name == "values":
+            return vals
+        if name == "empty":
+            return not vals
+        from elasticsearch_tpu.search.scriptlang import ScriptException
+        raise ScriptException(f"no doc-value property [{name}]")
+
+    def __scriptlang_method__(self, name: str, args):
+        if name == "size":
+            return len(self._values())
+        if name == "isEmpty":
+            return not self._values()
+        if name == "getValue":
+            return self.__scriptlang_getattr__("value")
+        from elasticsearch_tpu.search.scriptlang import ScriptException
+        raise ScriptException(f"no doc-value method [{name}]")
+
+
+def _c_scripted_metric_interpreted(node, mask, ctx):
+    """Full scripted_metric contract (ref: metrics/scripted/
+    ScriptedMetricAggregator): init_script seeds `_agg`, map_script runs
+    per matching doc with `doc` values, combine_script folds the shard
+    state, reduce_script (reduce side) folds `_aggs`. Interpreted by
+    GroovyLite — loops and collection state work as in lang-groovy."""
+    from elasticsearch_tpu.search.scriptlang import compile_groovylite
+    params = dict(node.params.get("params", {}))
+    agg: dict = {}
+    bindings = {"_agg": agg, "params": params}
+    init = node.params.get("init_script")
+    if init:
+        compile_groovylite(str(init)).run(dict(bindings))
+    map_script = compile_groovylite(str(node.params["map_script"]))
+    off = 0
+    for s in ctx.reader.segments:
+        n = s.padded_docs
+        rows = np.nonzero(mask[off:off + n][:s.seg.num_docs])[0]
+        if len(rows):
+            dv = _AggDocValues(s.seg)
+            b = {**bindings, "doc": dv}
+            for r in rows:
+                dv.doc = int(r)
+                map_script.run(dict(b))
+        off += n
+    combine = node.params.get("combine_script")
+    if combine:
+        partial = compile_groovylite(str(combine)).run(dict(bindings))
+    else:
+        partial = agg
+    from elasticsearch_tpu.action.search_action import wire_safe
+    return {"partial": wire_safe(partial), "interpreted": True}
+
+
 def _c_scripted_metric(node, mask, ctx):
-    """scripted_metric (ref: metrics/scripted/): the map script runs as a
-    sandboxed EXPRESSION over each doc's fields (our lang-expression
-    analog; no Groovy); the shard partial is the list of map values, and
-    combine/reduce scripts see them as `_values`."""
+    """scripted_metric (ref: metrics/scripted/): simple arithmetic map
+    scripts run VECTORIZED as expressions (lang-expression speed); any
+    init/combine/reduce phase — or a map script the expression grammar
+    cannot compile — switches to the interpreted GroovyLite path with the
+    full reference contract."""
     from elasticsearch_tpu.search.scripts import (
         ScriptContext, compile_script)
     map_src = node.params.get("map_script")
     if map_src is None:
         raise QueryParsingError(
             "[scripted_metric] requires a map_script")
-    for phase in ("init_script", "combine_script", "reduce_script"):
-        if node.params.get(phase):
-            # this engine's scripted_metric reduces by summing map values;
-            # silently ignoring a custom phase would return plausible but
-            # wrong numbers
-            raise QueryParsingError(
-                f"[scripted_metric] {phase} is not supported (the map "
-                f"values reduce by sum)")
-    script = compile_script(str(map_src))
+    if any(node.params.get(p) for p in
+           ("init_script", "combine_script", "reduce_script")):
+        return _c_scripted_metric_interpreted(node, mask, ctx)
+    try:
+        script = compile_script(str(map_src))
+    except QueryParsingError:                # not an expression: interpret
+        return _c_scripted_metric_interpreted(node, mask, ctx)
     values = []
     off = 0
     for s in ctx.reader.segments:
@@ -1472,9 +1562,23 @@ def _reduce_node(node: AggNode, parts: list[dict]) -> dict:
                 if allv.size else None)
         return {"values": vals}
     if t == "scripted_metric":
+        if any(p.get("interpreted") for p in parts):
+            # full contract: reduce_script folds the per-shard partials
+            # (`_aggs`); without one the partials list IS the value
+            # (ScriptedMetricAggregator doReduce)
+            from elasticsearch_tpu.search.scriptlang import (
+                compile_groovylite)
+            aggs_list = [p.get("partial") for p in parts]
+            reduce_src = node.params.get("reduce_script")
+            if reduce_src:
+                value = compile_groovylite(str(reduce_src)).run(
+                    {"_aggs": aggs_list,
+                     "params": dict(node.params.get("params", {}))})
+            else:
+                value = aggs_list
+            return {"value": value}
         allv = [v for p in parts for v in p.get("values", [])]
-        # custom combine/reduce phases are rejected at collect time; the
-        # supported contract is sum-of-map-values
+        # expression path reduces by summing map values
         return {"value": float(np.sum(allv)) if allv else 0.0}
     if t == "significant_terms":
         fg_total = sum(p.get("fg_total", 0) for p in parts)

@@ -906,9 +906,25 @@ class ShardSearcher:
                 # vecs are LAZY (host numpy until first use) — _fetch
                 # materializes + caches the device copy once per reader
                 return jit_exec._fetch(seg, col, "vecs"), col.exists
+            try:
+                compiled = compile_script(src)
+            except QueryParsingError:
+                # not an expression: run the general-purpose language per
+                # hit (lang-groovy analog — loops/conditionals/collections)
+                from elasticsearch_tpu.search.aggregations import (
+                    _AggDocValues)
+                from elasticsearch_tpu.search.scriptlang import (
+                    compile_groovylite)
+                dv = _AggDocValues(seg.seg)
+                dv.doc = int(local)
+                val = compile_groovylite(src).run(
+                    {"doc": dv, "params": params})
+                out[name] = val if isinstance(val, list) else [val]
+                continue
             ctx = ScriptContext(get_numeric, get_vector,
-                                jnp.zeros(seg.padded_docs, jnp.float32), params)
-            vals = compile_script(src).evaluate(ctx)
+                                jnp.zeros(seg.padded_docs, jnp.float32),
+                                params)
+            vals = compiled.evaluate(ctx)
             arr = np.asarray(jnp.broadcast_to(jnp.asarray(vals),
                                               (seg.padded_docs,)))
             out[name] = [float(arr[local])]

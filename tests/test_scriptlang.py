@@ -1,0 +1,205 @@
+"""GroovyLite — the general-purpose script language (lang-groovy analog).
+
+Reference: core/script/ScriptService.java:227 (Groovy as the default
+engine) and plugins/lang-groovy. Covers: language semantics (locals,
+loops, conditionals, collections, methods, operators), sandboxing (op
+budget, no dunder access, closed method tables), and the engine
+integrations — update-by-script with ctx.op, full scripted_metric
+init/map/combine/reduce, script fields beyond arithmetic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.search.scriptlang import (
+    ScriptException, compile_groovylite)
+
+
+def run(src, **bindings):
+    return compile_groovylite(src).run(bindings)
+
+
+# ---- language semantics ----------------------------------------------------
+
+
+@pytest.mark.parametrize("src,want", [
+    ("1 + 2 * 3", 7),
+    ("(1 + 2) * 3", 9),
+    ("2 ** 3 ** 2", 512),                      # right-assoc power
+    ("10 - 3 - 2", 5),                         # left-assoc minus
+    ("7 % 3", 1),
+    ("'a' + 1 + 'b'", "a1b"),                  # Groovy string concat
+    ("1 < 2 && 3 >= 3", True),
+    ("!(1 == 2) || false", True),
+    ("def x = 5; x > 3 ? 'big' : 'small'", "big"),
+    ("null ?: 'dflt'", "dflt"),                # elvis
+    ("'x' ?: 'dflt'", "x"),
+    ("0 ?: 5", 5),                             # Groovy truth: 0 is false
+    ("[] ?: 'empty'", "empty"),
+    ("def s = 0; for (x in [1,2,3,4]) { s += x }; s", 10),
+    ("def s = 0; def i = 0; while (i < 5) { s += i; i++ }; s", 10),
+    ("def t = 0; for (int i = 0; i < 10; i++) "
+     "{ if (i % 2 == 0) { t += i } }; t", 20),
+    ("def m = [a: 1, b: 2]; m.a + m['b']", 3),
+    ("def L = new ArrayList(); L.add(3); L.add(1); L.sort(); L", [1, 3]),
+    ("def m = [x: 1, y: 2]; def s = 0; "
+     "for (k in m.keySet()) { s += m[k] }; s", 3),
+    ("'Hello'.toLowerCase().contains('ell')", True),
+    ("'a,b,c'.split(',').size()", 3),
+    ("[1,2,3].contains(2)", True),
+    ("2 in [1,2,3]", True),
+    ("Math.max(3, Math.sqrt(16))", 4.0),
+    ("def s=0; for (x in [1,2,3,4,5]) { if (x == 4) { break }; s += x };"
+     " s", 6),
+    ("def s=0; for (x in [1,2,3]) { if (x == 2) { continue }; s += x };"
+     " s", 4),
+    ("def f = 1; for (int i = 1; i <= 5; i++) { f *= i }; return f", 120),
+    ("[1,2,3].sum()", 6),
+    ("def m = [:]; m.isEmpty()", True),
+])
+def test_language(src, want):
+    assert run(src) == want
+
+
+def test_op_budget_stops_runaway_loops():
+    with pytest.raises(ScriptException, match="budget"):
+        run("while (true) { }")
+
+
+@pytest.mark.parametrize("bad", [
+    "x.__class__", "import os", "System.exit(1)",
+    "}", "x.getClass()",
+])
+def test_sandbox_rejects(bad):
+    with pytest.raises(ScriptException):
+        run(bad, x={})
+
+
+def test_each_closure_rejected():
+    with pytest.raises(ScriptException):
+        run("[1,2].each { }")          # closures are unsupported
+    with pytest.raises(ScriptException, match="for loop"):
+        run("[1,2].each(1)")           # method form names the alternative
+
+
+# ---- update-by-script ------------------------------------------------------
+
+
+@pytest.fixture()
+def node(tmp_path):
+    from elasticsearch_tpu.node import Node
+    with Node({"node.name": "s1"}, data_path=tmp_path) as n:
+        yield n
+
+
+def test_update_with_loops_and_state(node):
+    node.index_doc("u", "1", {"values": [3, -1, 4, -5], "total": 0})
+    node.update_doc("u", "1", {"script": {
+        "inline": "def t = 0; for (v in ctx._source.values) "
+                  "{ if (v > 0) { t += v } } ctx._source.total = t"}})
+    assert node.get_doc("u", "1")["_source"]["total"] == 7
+
+
+def test_update_ctx_op_none_is_noop(node):
+    node.index_doc("u2", "1", {"counter": 1})
+    r = node.update_doc("u2", "1", {"script": {
+        "inline": "if (ctx._source.counter < 10) { ctx.op = 'none' }"}})
+    assert r["result"] == "noop"
+    assert node.get_doc("u2", "1")["_version"] == 1    # no reindex
+
+
+def test_update_ctx_op_delete(node):
+    node.index_doc("u3", "1", {"kill": True})
+    r = node.update_doc("u3", "1", {"script": {
+        "inline": "if (ctx._source.kill) { ctx.op = 'delete' }"}})
+    assert r["result"] == "deleted"
+    assert node.get_doc("u3", "1")["found"] is False
+
+
+def test_update_increments_missing_field_from_zero(node):
+    # the counter idiom must seed absent fields (old-evaluator parity)
+    node.index_doc("u5", "1", {"other": 1})
+    node.update_doc("u5", "1", {"script": "ctx._source.views += 1"})
+    node.update_doc("u5", "1", {"script": "ctx._source.views += 1"})
+    assert node.get_doc("u5", "1")["_source"]["views"] == 2
+
+
+def test_update_script_restamps_ttl(node):
+    import time as _t
+    node.indices_service.create_index("u6", {"mappings": {"d": {
+        "_ttl": {"enabled": True}}}})
+    expiry = int(_t.time() * 1000) + 60_000     # stored _ttl is absolute
+    node.index_doc("u6", "1", {"v": 1}, meta={"_ttl": expiry})
+    node.update_doc("u6", "1", {"script": "ctx._ttl = 3600000"})
+    got = node.get_doc("u6", "1")               # reads back as REMAINING
+    assert 3_500_000 < got["_ttl"] <= 3_600_000, got
+
+
+def test_update_list_append_params(node):
+    node.index_doc("u4", "1", {"tags": ["a"]})
+    node.update_doc("u4", "1", {"script": {
+        "inline": "if (!ctx._source.tags.contains(params.t)) "
+                  "{ ctx._source.tags.add(params.t) }",
+        "params": {"t": "b"}}})
+    node.update_doc("u4", "1", {"script": {
+        "inline": "if (!ctx._source.tags.contains(params.t)) "
+                  "{ ctx._source.tags.add(params.t) }",
+        "params": {"t": "b"}}})
+    assert node.get_doc("u4", "1")["_source"]["tags"] == ["a", "b"]
+
+
+# ---- scripted_metric: the reference's canonical profit example -------------
+
+
+def test_scripted_metric_full_contract(node):
+    for i, (t, amount) in enumerate([("sale", 80), ("cost", 10),
+                                     ("cost", 30), ("sale", 130)]):
+        node.index_doc("tx", str(i), {"type": t, "amount": amount})
+    node.broadcast_actions.refresh("tx")
+    res = node.search("tx", {"size": 0, "aggs": {"profit": {
+        "scripted_metric": {
+            "init_script": "_agg.transactions = []",
+            "map_script":
+                "_agg.transactions.add(doc['type'].value == 'sale' ? "
+                "doc['amount'].value : -1 * doc['amount'].value)",
+            "combine_script":
+                "def profit = 0; for (t in _agg.transactions) "
+                "{ profit += t }; return profit",
+            "reduce_script":
+                "def profit = 0; for (a in _aggs) { profit += a }; "
+                "return profit"}}}})
+    assert res["aggregations"]["profit"]["value"] == 170.0
+
+
+def test_scripted_metric_no_reduce_returns_partials(node):
+    node.index_doc("tx2", "1", {"v": 5}, refresh=True)
+    res = node.search("tx2", {"size": 0, "aggs": {"m": {
+        "scripted_metric": {
+            "init_script": "_agg.c = 0",
+            "map_script": "_agg.c += doc['v'].value"}}}})
+    # no reduce_script: the per-shard partials list is the value
+    parts = res["aggregations"]["m"]["value"]
+    assert sum(p["c"] for p in parts if p) == 5.0
+
+
+def test_scripted_metric_expression_fast_path_still_works(node):
+    node.index_doc("tx3", "1", {"v": 2})
+    node.index_doc("tx3", "2", {"v": 3})
+    node.broadcast_actions.refresh("tx3")
+    res = node.search("tx3", {"size": 0, "aggs": {"m": {
+        "scripted_metric": {"map_script": "doc['v'].value * 2"}}}})
+    assert res["aggregations"]["m"]["value"] == 10.0
+
+
+# ---- script fields beyond arithmetic ---------------------------------------
+
+
+def test_script_field_groovylite_fallback(node):
+    node.index_doc("sf", "1", {"a": 3, "b": 4}, refresh=True)
+    res = node.search("sf", {"query": {"match_all": {}}, "script_fields": {
+        "verdict": {"script": {
+            "inline": "def x = doc['a'].value + doc['b'].value; "
+                      "x > 5 ? 'big' : 'small'"}}}})
+    assert res["hits"]["hits"][0]["fields"]["verdict"] == ["big"]
