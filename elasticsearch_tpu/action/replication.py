@@ -453,8 +453,13 @@ class DocumentActions:
                 # scripts see/set ttl as REMAINING millis (TTLFieldMapper
                 # ctx._ttl semantics); storage keeps the absolute expiry
                 script_meta["_ttl"] = int(script_meta["_ttl"]) - now_ms
+            import copy as _copy
+            # DEEP copy: GroovyLite mutates nested lists/maps in place,
+            # and engine.get returns the live stored source — a script
+            # that touches nested state then aborts (ctx.op = none)
+            # must not leave unversioned edits behind
             merged, op, script_meta_updates = _apply_update_script(
-                dict(current.source), body["script"],
+                _copy.deepcopy(current.source), body["script"],
                 meta={"_id": request["id"], **script_meta})
             if "_ttl" in script_meta_updates:
                 script_meta_updates["_ttl"] = \

@@ -332,6 +332,128 @@ class DelayedAllocationDecider(AllocationDecider):
 
 
 
+class ShardsLimitAllocationDecider(AllocationDecider):
+    """Cap shards per node, per index and cluster-wide
+    (decider/ShardsLimitAllocationDecider.java:
+    index.routing.allocation.total_shards_per_node +
+    cluster.routing.allocation.total_shards_per_node)."""
+    name = "shards_limit"
+
+    def can_allocate(self, shard, node_id, alloc):
+        meta = alloc.state.indices.get(shard.index)
+        node_shards = alloc.node_shards(node_id)
+        idx_limit = int((meta.settings if meta else {}).get(
+            "index.routing.allocation.total_shards_per_node", -1))
+        if idx_limit > 0:
+            on_node = sum(1 for s in node_shards
+                          if s.index == shard.index)
+            if on_node >= idx_limit:
+                return alloc.explain(
+                    self.name, shard, node_id, NO,
+                    f"index limit [{idx_limit}] shards per node reached")
+        settings = {**alloc.state.persistent_settings,
+                    **alloc.state.transient_settings}
+        cl_limit = int(settings.get(
+            "cluster.routing.allocation.total_shards_per_node", -1))
+        if cl_limit > 0 and len(node_shards) >= cl_limit:
+            return alloc.explain(
+                self.name, shard, node_id, NO,
+                f"cluster limit [{cl_limit}] shards per node reached")
+        return YES
+
+
+class SnapshotInProgressAllocationDecider(AllocationDecider):
+    """A shard being snapshotted must not move — the snapshot streams
+    from its current node (decider/SnapshotInProgressAllocationDecider
+    .java, gated by
+    cluster.routing.allocation.snapshot.relocation_enabled)."""
+    name = "snapshot_in_progress"
+
+    def can_rebalance(self, shard, alloc):
+        settings = {**alloc.state.persistent_settings,
+                    **alloc.state.transient_settings}
+        if str(settings.get(
+                "cluster.routing.allocation.snapshot.relocation_enabled",
+                "false")).lower() == "true":
+            return YES
+        # the custom is ONE in-progress entry ({repository, snapshot,
+        # state, indices}) — snapshots/service.py:119 — not the
+        # reference's multi-entry list; every shard of a named index is
+        # streaming while the state is non-terminal
+        snap = alloc.state.customs.get("snapshots_in_progress")
+        if snap and snap.get("state") not in ("SUCCESS", "FAILED",
+                                              "ABORTED", None) and \
+                shard.index in (snap.get("indices") or []):
+            return alloc.explain(
+                self.name, shard, shard.node_id or "?", NO,
+                "shard is being snapshotted")
+        return YES
+
+
+class RebalanceOnlyWhenActiveDecider(AllocationDecider):
+    """Only STARTED shards rebalance
+    (decider/RebalanceOnlyWhenActiveAllocationDecider.java)."""
+    name = "rebalance_only_when_active"
+
+    def can_rebalance(self, shard, alloc):
+        if shard.state != ShardRoutingState.STARTED:
+            return alloc.explain(self.name, shard, shard.node_id or "?",
+                                 NO, "shard is not started")
+        return YES
+
+
+class ClusterRebalanceAllocationDecider(AllocationDecider):
+    """Gate rebalancing on cluster recovery progress
+    (decider/ClusterRebalanceAllocationDecider.java:
+    cluster.routing.allocation.allow_rebalance =
+    always | indices_primaries_active | indices_all_active)."""
+    name = "cluster_rebalance"
+
+    def can_rebalance(self, shard, alloc):
+        settings = {**alloc.state.persistent_settings,
+                    **alloc.state.transient_settings}
+        mode = str(settings.get(
+            "cluster.routing.allocation.allow_rebalance",
+            "indices_all_active")).lower()
+        if mode == "always":
+            return YES
+        relevant = [s for s in alloc.routing.shards
+                    if not s.relocation_target]
+        if mode == "indices_primaries_active":
+            if all(s.active for s in relevant if s.primary):
+                return YES
+            return alloc.explain(self.name, shard, shard.node_id or "?",
+                                 NO, "not all primaries are active")
+        if all(s.active for s in relevant):
+            return YES
+        return alloc.explain(self.name, shard, shard.node_id or "?",
+                             NO, "not all shards are active")
+
+
+class ConcurrentRebalanceAllocationDecider(AllocationDecider):
+    """Cap concurrent relocations cluster-wide
+    (decider/ConcurrentRebalanceAllocationDecider.java:
+    cluster.routing.allocation.cluster_concurrent_rebalance, default 2;
+    -1 = unlimited)."""
+    name = "concurrent_rebalance"
+
+    def can_rebalance(self, shard, alloc):
+        settings = {**alloc.state.persistent_settings,
+                    **alloc.state.transient_settings}
+        limit = int(settings.get(
+            "cluster.routing.allocation.cluster_concurrent_rebalance", 2))
+        if limit < 0:
+            return YES
+        relocating = sum(1 for s in alloc.routing.shards
+                         if s.state == ShardRoutingState.RELOCATING)
+        if relocating >= limit:
+            return alloc.explain(
+                self.name, shard, shard.node_id or "?", NO,
+                f"[{relocating}] relocations already in flight "
+                f"(limit [{limit}])")
+        return YES
+
+
 DEFAULT_DECIDERS = (
     MaxRetryAllocationDecider(),
     SameShardAllocationDecider(),
@@ -343,6 +465,11 @@ DEFAULT_DECIDERS = (
     DelayedAllocationDecider(),
     ThrottlingAllocationDecider(),
     DiskThresholdDecider(),
+    ShardsLimitAllocationDecider(),
+    SnapshotInProgressAllocationDecider(),
+    RebalanceOnlyWhenActiveDecider(),
+    ClusterRebalanceAllocationDecider(),
+    ConcurrentRebalanceAllocationDecider(),
 )
 
 
@@ -520,9 +647,51 @@ class AllocationService:
                                                      state.routing_table)
         routing = self._promote_replicas(routing)
         routing = self._allocate_unassigned(state, routing)
+        routing = self._rebalance(state, routing)
         if routing is state.routing_table:
             return state
         return state.with_(routing_table=routing)
+
+    def _rebalance(self, state: ClusterState,
+                   routing: RoutingTable) -> RoutingTable:
+        """Automatic rebalancing (BalancedShardsAllocator.balance): while
+        the heaviest and lightest data nodes differ by more than the
+        weight threshold, start a streaming relocation of one STARTED
+        shard from heavy to light — gated by the rebalance deciders
+        (cluster_rebalance / concurrent_rebalance / snapshot / active)
+        and the target's allocation deciders. One relocation per pass
+        keeps publishes small; follow-up reroutes (shard started events)
+        continue the balance."""
+        data_nodes = sorted(state.data_nodes())
+        if len(data_nodes) < 2:
+            return routing
+        alloc = RoutingAllocation(state, routing, dict(self.disk_usage))
+        settings = {**state.persistent_settings, **state.transient_settings}
+        if str(settings.get("cluster.routing.rebalance.enable",
+                            "all")).lower() == "none":
+            return routing
+
+        def node_weight(nid: str) -> float:
+            return float(len(alloc.node_shards(nid)))
+
+        heavy = max(data_nodes, key=node_weight)
+        light = min(data_nodes, key=node_weight)
+        if node_weight(heavy) - node_weight(light) <= \
+                self.allocator.threshold:
+            return routing
+        for shard in alloc.node_shards(heavy):
+            if shard.state != ShardRoutingState.STARTED:
+                continue
+            if any(d.can_rebalance(shard, alloc) == NO
+                   for d in self.deciders):
+                continue
+            if any(d.can_allocate(shard, light, alloc) == NO
+                   for d in self.deciders):
+                continue
+            src, tgt = shard.relocate(light)
+            routing = routing.replace_shard(shard, src)
+            return RoutingTable(routing.shards + (tgt,))
+        return routing
 
     def apply_started_shards(self, state: ClusterState,
                              started: list[ShardRouting]) -> ClusterState:

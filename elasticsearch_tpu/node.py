@@ -141,6 +141,15 @@ class Node:
         # snapshot/restore (core/snapshots/)
         from elasticsearch_tpu.snapshots import SnapshotsService
         self.snapshots_service = SnapshotsService(self)
+        # live disk-usage sampling feeding the DiskThresholdDecider
+        # (InternalClusterInfoService)
+        from elasticsearch_tpu.cluster.info import ClusterInfoService
+        from elasticsearch_tpu.common.settings import parse_time_value \
+            as _ptv
+        self.cluster_info_service = ClusterInfoService(
+            self, interval_s=_ptv(
+                self.settings.get("cluster.info.update.interval", "30s"),
+                "cluster.info.update.interval")).start()
         # node-level monitoring fan-out (core/action/admin/cluster/node/)
         self.transport_service.register_request_handler(
             self.NODE_STATS_ACTION, self._handle_node_stats,
@@ -849,6 +858,8 @@ class Node:
                 self._imc_timer.cancel()
             if getattr(self, "resource_watcher", None):
                 self.resource_watcher.stop()
+            if getattr(self, "cluster_info_service", None):
+                self.cluster_info_service.stop()
             self.search_actions.close()
             self.discovery.stop()
             self.indices_service.close()
@@ -864,6 +875,8 @@ class Node:
             self._started = False
             if self._delayed_reroute_timer is not None:
                 self._delayed_reroute_timer.cancel()
+            if getattr(self, "cluster_info_service", None):
+                self.cluster_info_service.stop()
             self.transport_service.close()
             self.discovery.master_fd.stop()
             self.discovery.nodes_fd.stop()
@@ -958,7 +971,8 @@ def _deep_merge(base: dict, patch: dict) -> dict:
 
 
 def _apply_update_script(source: dict, script,
-                         meta: dict | None = None) -> tuple[dict, str]:
+                         meta: dict | None = None
+                         ) -> tuple[dict, str, dict]:
     """Run an update script against the document (UpdateHelper.prepare):
     the script sees `ctx` with a mutable `_source` plus `op`/`_ttl`/
     `_timestamp`/`_id` and `params`; → (new source, op) where op is

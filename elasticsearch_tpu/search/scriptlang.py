@@ -498,7 +498,9 @@ class _Interp:
         self._tick()
         kind = node[0]
         if kind == "block":
-            return self.exec_block(node, dict(scope) if False else scope)
+            # blocks do NOT open a new scope (Groovy locals declared in a
+            # loop body stay visible after it; tests rely on this)
+            return self.exec_block(node, scope)
         if kind == "declare":
             scope[node[1]] = self.eval(node[2], scope)
             return None
@@ -785,55 +787,8 @@ def _to_str(v) -> str:
     return str(v)
 
 
-# ---- doc-values bindings ---------------------------------------------------
-
-class DocValues:
-    """The `doc` binding: doc['field'] → per-field accessor for ONE doc
-    at a time (set_doc advances). Columns come from the same columnar
-    doc-values the vectorized engine reads."""
-
-    def __init__(self, get_column):
-        self._get_column = get_column            # field → (np column, exists)
-        self._cache: dict[str, tuple] = {}
-        self._doc = 0
-
-    def set_doc(self, i: int) -> None:
-        self._doc = i
-
-    def __scriptlang_getitem__(self, field):
-        col = self._cache.get(field)
-        if col is None:
-            col = self._get_column(field)
-            self._cache[field] = col
-        return _FieldValue(col, self)
-
-
-class _FieldValue:
-    def __init__(self, col, owner: DocValues):
-        self._col = col
-        self._owner = owner
-
-    def __scriptlang_getattr__(self, name: str):
-        values, exists = self._col
-        i = self._owner._doc
-        if name == "value":
-            return float(values[i]) if exists is None or exists[i] else 0.0
-        if name == "values":
-            return [float(values[i])] \
-                if exists is None or exists[i] else []
-        if name == "empty":
-            return not (exists is None or bool(exists[i]))
-        raise ScriptException(f"no doc-value property [{name}]")
-
-    def __scriptlang_method__(self, name: str, args):
-        if name == "size":
-            return 0 if self.__scriptlang_getattr__("empty") else 1
-        if name == "getValue":
-            return self.__scriptlang_getattr__("value")
-        if name == "isEmpty":
-            return self.__scriptlang_getattr__("empty")
-        raise ScriptException(f"no doc-value method [{name}]")
-
+# The `doc` binding lives in aggregations.py (_AggDocValues): it reads
+# the columnar segments directly and handles .keyword subfield fallback.
 
 _COMPILE_CACHE: dict[str, CompiledGroovyLite] = {}
 
