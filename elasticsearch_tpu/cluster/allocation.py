@@ -679,13 +679,22 @@ class AllocationService:
         if node_weight(heavy) - node_weight(light) <= \
                 self.allocator.threshold:
             return routing
+        rebalance_mode = str(settings.get(
+            "cluster.routing.rebalance.enable", "all")).lower()
         for shard in alloc.node_shards(heavy):
             if shard.state != ShardRoutingState.STARTED:
                 continue
-            if any(d.can_rebalance(shard, alloc) == NO
+            if rebalance_mode == "primaries" and not shard.primary:
+                continue
+            if rebalance_mode == "replicas" and shard.primary:
+                continue
+            if any(d.can_rebalance(shard, alloc) != YES
                    for d in self.deciders):
                 continue
-            if any(d.can_allocate(shard, light, alloc) == NO
+            # anything short of YES (NO or THROTTLE) defers the move —
+            # rebalancing must respect the recovery throttle the
+            # unassigned-allocation path respects
+            if any(d.can_allocate(shard, light, alloc) != YES
                    for d in self.deciders):
                 continue
             src, tgt = shard.relocate(light)

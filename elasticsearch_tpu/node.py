@@ -142,14 +142,15 @@ class Node:
         from elasticsearch_tpu.snapshots import SnapshotsService
         self.snapshots_service = SnapshotsService(self)
         # live disk-usage sampling feeding the DiskThresholdDecider
-        # (InternalClusterInfoService)
+        # (InternalClusterInfoService) — constructed here, started at the
+        # end of start() so a failed boot never leaks the timer
         from elasticsearch_tpu.cluster.info import ClusterInfoService
         from elasticsearch_tpu.common.settings import parse_time_value \
             as _ptv
         self.cluster_info_service = ClusterInfoService(
             self, interval_s=_ptv(
                 self.settings.get("cluster.info.update.interval", "30s"),
-                "cluster.info.update.interval")).start()
+                "cluster.info.update.interval"))
         # node-level monitoring fan-out (core/action/admin/cluster/node/)
         self.transport_service.register_request_handler(
             self.NODE_STATS_ACTION, self._handle_node_stats,
@@ -202,6 +203,7 @@ class Node:
         self._started = True
         self.discovery.start(self.settings.get_as_float(
             "discovery.initial_state_timeout", 30.0))
+        self.cluster_info_service.start()
         # plugin service wiring once the node is fully up (the analog of
         # nodeServices()/onModule hooks firing at injector-creation time)
         self.plugins_service.apply_node_start(self)
@@ -975,8 +977,9 @@ def _apply_update_script(source: dict, script,
                          ) -> tuple[dict, str, dict]:
     """Run an update script against the document (UpdateHelper.prepare):
     the script sees `ctx` with a mutable `_source` plus `op`/`_ttl`/
-    `_timestamp`/`_id` and `params`; → (new source, op) where op is
-    "index" (reindex), "none" (noop) or "delete" (remove the doc).
+    `_timestamp`/`_id` and `params`; → (new source, op, meta_updates)
+    where op is "index" (reindex), "none" (noop) or "delete" (remove the
+    doc) and meta_updates carries any _ttl/_timestamp the script set.
     Interpreted by GroovyLite (scriptlang.py), the lang-groovy analog —
     conditionals, loops and collection mutation all work."""
     from elasticsearch_tpu.search.scriptlang import compile_groovylite

@@ -1,0 +1,90 @@
+"""Engine BM25 vs the independent Lucene-formula oracle.
+
+Reference: core/.../index/similarity/SimilarityModule.java + Lucene 5.x
+BM25Similarity. The oracle (scripts/bm25_oracle.py) is written straight
+from the published formula and shares no code with the engine's ops or
+segments — agreement here is external evidence of BM25 semantics (idf
+shape, length normalization, tie behavior), not self-consistency.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+from bm25_oracle import (                       # noqa: E402
+    BM25Oracle, recall_with_tie_tolerance)
+
+
+@pytest.fixture(scope="module")
+def corpus_engine():
+    from elasticsearch_tpu.node import Node
+    import tempfile
+    rng = np.random.default_rng(7)
+    n_docs, vocab, L = 5000, 800, 30
+    lens = np.clip(rng.poisson(18, n_docs), 4, L).astype(np.int32)
+    ranks = (rng.pareto(1.1, size=(n_docs, L)) + 1)
+    toks = np.minimum((ranks * 2).astype(np.int64), vocab - 1)
+    toks = np.where(np.arange(L)[None, :] < lens[:, None], toks, -1)
+    toks = toks.astype(np.int32)
+    node = Node({"node.name": "oracle"},
+                data_path=tempfile.mkdtemp()).start()
+    node.indices_service.create_index("o", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0}})
+    for i in range(n_docs):
+        body = " ".join(f"t{t}" for t in toks[i] if t >= 0)
+        node.index_doc("o", str(i), {"body": body})
+    node.broadcast_actions.refresh("o")
+    yield node, toks
+    node.close()
+
+
+def test_engine_topk_matches_lucene_formula_oracle(corpus_engine):
+    node, toks = corpus_engine
+    oracle = BM25Oracle(toks)
+    rng = np.random.default_rng(11)
+    k = 100
+    recalls, score_diffs = [], []
+    for _ in range(12):
+        qterms = rng.choice(np.arange(1, 400), size=3, replace=False)
+        sc = oracle.score_query(qterms)
+        ids, oscores = oracle.topk(qterms, k, scores=sc)
+        res = node.search("o", {"query": {"match": {
+            "body": " ".join(f"t{t}" for t in qterms)}}, "size": k})
+        engine_ids = [int(h["_id"]) for h in res["hits"]["hits"]]
+        engine_scores = [h["_score"] for h in res["hits"]["hits"]]
+        recalls.append(recall_with_tie_tolerance(ids, sc, engine_ids, k))
+        # absolute score agreement on the top hits (float32 engine vs
+        # float64 oracle): relative error stays tiny
+        for eid, esc in zip(engine_ids[:10], engine_scores[:10]):
+            score_diffs.append(abs(esc - sc[eid]) / max(abs(sc[eid]),
+                                                        1e-9))
+    assert float(np.mean(recalls)) >= 0.999, recalls
+    assert max(score_diffs) < 5e-3, max(score_diffs)
+
+
+def test_oracle_formula_spot_values():
+    """Hand-checked BM25 values: one term, known df/tf/dl."""
+    # 4 docs; term 0 in docs 0 (tf 2, dl 4) and 1 (tf 1, dl 2)
+    toks = np.array([[0, 0, 1, 2],
+                     [0, 3, -1, -1],
+                     [4, 5, 6, -1],
+                     [7, 8, -1, -1]], np.int32)
+    o = BM25Oracle(toks)
+    n, df = 4, 2
+    idf = np.log1p((n - df + 0.5) / (df + 0.5))
+    avgdl = (4 + 2 + 3 + 2) / 4
+    tf, dl = 2.0, 4.0
+    expect0 = idf * tf * 2.2 / (tf + 1.2 * (1 - 0.75 + 0.75 * dl / avgdl))
+    sc = o.score_query([0])
+    assert sc[0] == pytest.approx(expect0, rel=1e-12)
+    tf, dl = 1.0, 2.0
+    expect1 = idf * tf * 2.2 / (tf + 1.2 * (1 - 0.75 + 0.75 * dl / avgdl))
+    assert sc[1] == pytest.approx(expect1, rel=1e-12)
+    assert sc[2] == 0.0 and sc[3] == 0.0
+    ids, scores = o.topk([0], 2)
+    assert list(ids) == [0, 1] and scores[0] > scores[1]

@@ -39,7 +39,10 @@ def test_state_publish_reaches_all_nodes(cluster3):
     for n in c.nodes:
         st = n.cluster_service.state()
         assert "events" in st.indices
-        assert len(st.routing_table.shards) == 6
+        # relocation targets are transient surplus copies — the automatic
+        # rebalancer may have one in flight while shards settle
+        assert sum(1 for s in st.routing_table.shards
+                   if not s.relocation_target) == 6
     # shards are spread across nodes (balanced allocator)
     placements = {s.node_id
                   for s in master.cluster_service.state().routing_table.shards}
