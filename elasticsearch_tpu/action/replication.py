@@ -169,12 +169,22 @@ class DocumentActions:
         """Route a primary-phase op: execute locally if the primary shard
         lives here, otherwise forward; retry once per routing change when
         the target turns out stale."""
+        from elasticsearch_tpu.indices.service import ShardNotLocalError
         deadline = time.monotonic() + self.PRIMARY_TIMEOUT
         last: Exception | None = None
         while time.monotonic() < deadline:
             pr = self._await_primary(name, shard)
             if pr.node_id == self.node.node_id:
-                return local_fn(request)
+                try:
+                    return local_fn(request)
+                except ShardNotLocalError as e:
+                    # ownership moved DURING the local execution (the
+                    # post-op recheck tripped — e.g. a relocation handoff
+                    # landed mid-op): re-resolve and retry on the new
+                    # primary, same as the remote retry path
+                    last = e
+                    time.sleep(0.05)
+                    continue
             target = self._state().node(pr.node_id)
             try:
                 return self.node.transport_service.send_request(
@@ -207,12 +217,15 @@ class DocumentActions:
                 if c.assigned and not c.primary]
 
     def _replicate(self, name: str, shard: int, action: str,
-                   payload: dict) -> tuple[int, int, list[dict]]:
-        """→ (total_copies, successful, failures). Failed replicas are
-        reported shard-failed to the master (onReplicaFailure :864-900)."""
+                   payload: dict) -> tuple[int, int, list[dict], set]:
+        """→ (total_copies, successful, failures, delivered_node_ids).
+        Failed replicas are reported shard-failed to the master
+        (onReplicaFailure :864-900); the delivered set feeds the post-op
+        ownership recheck."""
         copies = self._replicas_of(name, shard)
         futures = []
         state = self._state()
+        delivered: set[str] = set()
         ok, failures = 1, []                     # primary already succeeded
         for c in copies:
             target = state.node(c.node_id)
@@ -233,13 +246,14 @@ class DocumentActions:
             try:
                 fut.result(self.REPLICA_TIMEOUT + 5)
                 ok += 1
+                delivered.add(c.node_id)
             except Exception as e:               # noqa: BLE001 — report it
                 failures.append({"shard": shard, "index": name,
                                  "node": c.node_id, "status": "INTERNAL",
                                  "reason": str(unwrap_remote(e))})
                 self.node._on_shard_failed(
                     c, f"replication op failed: {unwrap_remote(e)}")
-        return 1 + len(copies), ok, failures
+        return 1 + len(copies), ok, failures, delivered
 
     def _shards_header(self, total: int, ok: int,
                        failures: list[dict]) -> dict:
@@ -295,7 +309,40 @@ class DocumentActions:
         return self._on_primary(name, shard, request, self.INDEX_P,
                                 self._handle_index_p_local)
 
+    def _assert_primary_here(self, name: str, shard: int) -> None:
+        """IndexShard's RELOCATED guard: a primary-phase op forwarded on
+        STALE routing must not execute on a node that no longer owns the
+        primary — its replication fan-out (computed from the new state)
+        would reach nobody, acking a write that dies with the retired
+        engine. Raising the retryable ShardNotLocalError sends the
+        coordinator back through _on_primary's routing re-resolution."""
+        from elasticsearch_tpu.indices.service import ShardNotLocalError
+        pr = self._state().routing_table.primary(name, shard)
+        if pr is None or pr.node_id != self.node.node_id:
+            raise ShardNotLocalError(
+                f"[{name}][{shard}] primary no longer on this node "
+                f"(relocated or failed over)")
+
+    def _recheck_primary_after_op(self, name: str, shard: int,
+                                  delivered: set) -> None:
+        """Post-op half of the lost-write guard: after apply+fan-out, if
+        ownership moved, the ack stands ONLY when the op provably reached
+        the node now holding the primary (the relocation target was in
+        the pre-handoff fan-out); otherwise raise retryable so the op
+        re-executes where the data actually lives. Re-execution cannot
+        double-apply: it happens only when the new primary never received
+        the op."""
+        from elasticsearch_tpu.indices.service import ShardNotLocalError
+        pr = self._state().routing_table.primary(name, shard)
+        if pr is not None and (pr.node_id == self.node.node_id
+                               or pr.node_id in delivered):
+            return
+        raise ShardNotLocalError(
+            f"[{name}][{shard}] primary moved during the op and the new "
+            f"primary did not receive it")
+
     def _handle_index_p(self, request: dict, source) -> dict:
+        self._assert_primary_here(request["index"], request["shard"])
         return self._handle_index_p_local(request)
 
     def _handle_index_p_local(self, request: dict) -> dict:
@@ -316,12 +363,22 @@ class DocumentActions:
             meta=request.get("meta"))
         if request.get("refresh"):
             engine.refresh()
-        total, ok, failures = self._replicate(
+        total, ok, failures, delivered = self._replicate(
             name, shard, self.INDEX_R,
             {"index": name, "shard": shard, "id": request["id"],
              "source": request["source"], "routing": request.get("routing"),
              "version": v, "refresh": bool(request.get("refresh")),
              "meta": request.get("meta")})
+        # post-op ownership recheck (the relocation-handoff lost-write
+        # guard): state application is monotonic per node, so if the
+        # fan-out above computed its copies from a POST-handoff state
+        # (reaching nobody) this check also sees that state and turns
+        # the ack into a retry against the new primary; if the fan-out
+        # saw the PRE-handoff state it DELIVERED to the relocation
+        # target, the ack stands, and no spurious retry can double-apply
+        # the op. Reference: IndexShard RELOCATED verification before
+        # the response turnaround.
+        self._recheck_primary_after_op(name, shard, delivered)
         return {"_index": name, "_type": "_doc", "_id": request["id"],
                 "_version": v,
                 "result": "created" if created else "updated",
@@ -354,6 +411,7 @@ class DocumentActions:
                                 self._handle_delete_p_local)
 
     def _handle_delete_p(self, request: dict, source) -> dict:
+        self._assert_primary_here(request["index"], request["shard"])
         return self._handle_delete_p_local(request)
 
     def _handle_delete_p_local(self, request: dict) -> dict:
@@ -366,10 +424,12 @@ class DocumentActions:
                                                    "internal"))
         if request.get("refresh"):
             engine.refresh()
-        total, ok, failures = self._replicate(
+        total, ok, failures, delivered = self._replicate(
             name, shard, self.DELETE_R,
             {"index": name, "shard": shard, "id": request["id"],
              "version": v, "refresh": bool(request.get("refresh"))})
+        # post-op ownership recheck (see _handle_index_p_local)
+        self._recheck_primary_after_op(name, shard, delivered)
         return {"_index": name, "_type": "_doc", "_id": request["id"],
                 "_version": v, "result": "deleted", "found": True,
                 "_shards": self._shards_header(total, ok, failures)}
@@ -412,6 +472,7 @@ class DocumentActions:
                                 self._handle_update_local)
 
     def _handle_update(self, request: dict, source) -> dict:
+        self._assert_primary_here(request["index"], request["shard"])
         return self._handle_update_local(request)
 
     def _handle_update_local(self, request: dict) -> dict:
@@ -839,6 +900,7 @@ class DocumentActions:
                          "status": status}}
 
     def _handle_bulk_p(self, request: dict, source) -> dict:
+        self._assert_primary_here(request["index"], request["shard"])
         return self._handle_bulk_p_local(request)
 
     def _handle_bulk_p_local(self, request: dict) -> dict:
@@ -908,11 +970,14 @@ class DocumentActions:
         engine.translog.sync()
         if request.get("refresh"):
             engine.refresh()
+        delivered: set = set()
         if replica_ops:
-            self._replicate(name, shard, self.BULK_R,
-                            {"index": name, "shard": shard,
-                             "ops": replica_ops,
-                             "refresh": bool(request.get("refresh"))})
+            _, _, _, delivered = self._replicate(
+                name, shard, self.BULK_R,
+                {"index": name, "shard": shard, "ops": replica_ops,
+                 "refresh": bool(request.get("refresh"))})
+        # post-op ownership recheck (see _handle_index_p_local)
+        self._recheck_primary_after_op(name, shard, delivered)
         return {"items": items_out}
 
     def _handle_bulk_r(self, request: dict, source) -> dict:

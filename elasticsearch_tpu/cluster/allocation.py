@@ -667,8 +667,9 @@ class AllocationService:
             return routing
         alloc = RoutingAllocation(state, routing, dict(self.disk_usage))
         settings = {**state.persistent_settings, **state.transient_settings}
-        if str(settings.get("cluster.routing.rebalance.enable",
-                            "all")).lower() == "none":
+        rebalance_mode = str(settings.get(
+            "cluster.routing.rebalance.enable", "all")).lower()
+        if rebalance_mode == "none":
             return routing
 
         def node_weight(nid: str) -> float:
@@ -679,8 +680,6 @@ class AllocationService:
         if node_weight(heavy) - node_weight(light) <= \
                 self.allocator.threshold:
             return routing
-        rebalance_mode = str(settings.get(
-            "cluster.routing.rebalance.enable", "all")).lower()
         for shard in alloc.node_shards(heavy):
             if shard.state != ShardRoutingState.STARTED:
                 continue

@@ -121,12 +121,20 @@ class ZenDiscovery:
     # ---- ping / election ---------------------------------------------------
 
     def _ping_all(self) -> list[dict]:
+        from elasticsearch_tpu.transport.stream import (
+            MINIMUM_COMPATIBLE_VERSION)
         local = self.transport.local_node
         responses = []
         for addr in self.seed_provider():
             if addr == local.address:
                 continue
-            probe = DiscoveryNode("?", "?", addr)
+            # first contact: the peer's wire version is unknown, so ping
+            # at the minimum compatible generation (UnicastZenPing sends
+            # pings at the minimum compatible version for the same
+            # reason) — gated fields stay off the wire until the
+            # handshake learns the real version
+            probe = DiscoveryNode("?", "?", addr,
+                                  version=MINIMUM_COMPATIBLE_VERSION)
             try:
                 r = self.transport.submit_request(
                     probe, PING_ACTION, {"cluster_name": self.cluster_name},

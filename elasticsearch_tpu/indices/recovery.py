@@ -107,7 +107,8 @@ class PeerRecoveryService:
                  "target_node": {"node_id": local.node_id,
                                  "name": local.name,
                                  "host": local.address.host,
-                                 "port": local.address.port},
+                                 "port": local.address.port,
+                                 "version": local.version},
                  "manifest": engine.file_manifest()},
                 timeout=120.0)
         except RemoteTransportError as e:
@@ -134,9 +135,18 @@ class PeerRecoveryService:
         engine = svc.engines.get(shard) if svc is not None else None
         if engine is None:
             raise DelayRecoveryError(f"[{index}][{shard}] engine not open")
+        from elasticsearch_tpu.transport.stream import (
+            MINIMUM_COMPATIBLE_VERSION)
         tn = request["target_node"]
-        target = DiscoveryNode(tn["node_id"], tn["name"],
-                               TransportAddress(tn["host"], tn["port"]))
+        # carry the target's wire version so streamed chunks/ops
+        # serialize at the negotiated generation; a request WITHOUT the
+        # key comes from an older-generation node, so the conservative
+        # fallback is the minimum compatible version (defaulting to
+        # CURRENT would write gated fields the old peer cannot parse)
+        target = DiscoveryNode(
+            tn["node_id"], tn["name"],
+            TransportAddress(tn["host"], tn["port"]),
+            version=tn.get("version", MINIMUM_COMPATIBLE_VERSION))
         t0 = time.perf_counter()
         # phase1 prologue: pin the translog FIRST (so no flush anywhere can
         # trim ops we must replay), then flush AND pin the commit so a

@@ -93,10 +93,11 @@ class Node:
         attrs = attrs + extra
         from elasticsearch_tpu.common.threadpool import ThreadPool
         self.thread_pool = ThreadPool(self.settings)
+        from elasticsearch_tpu import __version__ as _build
         self.transport_service = TransportService(
             transport,
             lambda addr: DiscoveryNode(self.node_id, self.node_name, addr,
-                                       attributes=attrs),
+                                       attributes=attrs, build=_build),
             thread_pool=self.thread_pool)
         self.allocation = AllocationService()
         cluster_name = self.settings.get("cluster.name", "elasticsearch-tpu")

@@ -66,12 +66,14 @@ class TransportAddress:
 @dataclass(frozen=True)
 class DiscoveryNode:
     """Reference: core/cluster/node/DiscoveryNode.java — id, name, address,
-    attributes (data/master roles), wire version."""
+    attributes (data/master roles), wire version, build hash (1_000_100+,
+    the Build.java analog surfaced in nodes info)."""
     node_id: str
     name: str
     address: TransportAddress
     attributes: tuple = ()
     version: int = CURRENT_VERSION
+    build: str = ""
 
     @property
     def master_eligible(self) -> bool:
@@ -88,14 +90,23 @@ class DiscoveryNode:
         out.write_int(self.address.port)
         out.write_value(dict(self.attributes))
         out.write_vint(self.version)
+        # gated field (StreamInput.java:58 pattern): both sides agreed on
+        # min(local, remote) for this stream, so a 1_000_099 peer neither
+        # writes nor expects the build hash
+        if out.version >= 1_000_100:
+            out.write_string(self.build)
 
     @staticmethod
     def from_wire(inp: StreamInput) -> "DiscoveryNode":
-        return DiscoveryNode(
-            node_id=inp.read_string(), name=inp.read_string(),
-            address=TransportAddress(inp.read_string(), inp.read_int()),
-            attributes=tuple(sorted(inp.read_value().items())),
-            version=inp.read_vint())
+        node_id = inp.read_string()
+        name = inp.read_string()
+        address = TransportAddress(inp.read_string(), inp.read_int())
+        attributes = tuple(sorted(inp.read_value().items()))
+        version = inp.read_vint()
+        build = inp.read_string() if inp.version >= 1_000_100 else ""
+        return DiscoveryNode(node_id=node_id, name=name, address=address,
+                             attributes=attributes, version=version,
+                             build=build)
 
 
 class TransportChannel:

@@ -18,7 +18,12 @@ import struct
 # Mirrors org.elasticsearch.Version ids (Version.java) in spirit: an int that
 # both sides exchange during the handshake, min(local, remote) governs the
 # stream (NettyTransport sets the stream version from the channel handshake).
-CURRENT_VERSION = 1_000_099
+# History:
+#   1_000_099 — base codec generation (rounds 1-3)
+#   1_000_100 — DiscoveryNode carries a `build` hash (gated: StreamInput
+#               .java:58-style read guarded on the stream version)
+V_1_0_99 = 1_000_099
+CURRENT_VERSION = 1_000_100
 MINIMUM_COMPATIBLE_VERSION = 1_000_000
 
 _NULL = 0

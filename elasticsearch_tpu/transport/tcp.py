@@ -163,10 +163,13 @@ class TcpTransport:
 
     def _do_send_request(self, node: DiscoveryNode, request_id: int,
                          action: str, payload: bytes) -> None:
-        out = StreamOutput()
+        # the ENVELOPE serializes at the negotiated version too — gated
+        # fields inside DiscoveryNode.to_wire key off out.version
+        wire_version = min(self._service.local_node.version, node.version)
+        out = StreamOutput(wire_version)
         out.write_byte(_REQ)
         out.write_long(request_id)
-        out.write_vint(min(self._service.local_node.version, node.version))
+        out.write_vint(wire_version)
         self._service.local_node.to_wire(out)
         out.write_string(action)
         out.write_bytes(payload)
@@ -188,18 +191,19 @@ class TcpTransport:
     def _do_send_response(self, node: DiscoveryNode, request_id: int,
                           payload: bytes | None, error,
                           chan: socket.socket | None = None) -> None:
-        out = StreamOutput()
+        # response envelope serializes at the negotiated version, same
+        # as the request path
+        wire_version = min(self._service.local_node.version, node.version)
+        out = StreamOutput(wire_version)
         if error is None:
             out.write_byte(_RESP)
             out.write_long(request_id)
-            out.write_vint(min(self._service.local_node.version,
-                               node.version))
+            out.write_vint(wire_version)
             out.write_bytes(payload)
         else:
             out.write_byte(_RESP_ERR)
             out.write_long(request_id)
-            out.write_vint(min(self._service.local_node.version,
-                               node.version))
+            out.write_vint(wire_version)
             out.write_string(error[0])
             out.write_string(error[1])
         # Prefer the inbound channel the request arrived on (the reference
@@ -312,6 +316,9 @@ class TcpTransport:
         msg_type = inp.read_byte()
         request_id = inp.read_long()
         version = inp.read_vint()
+        # everything after the version vint — including the envelope's
+        # DiscoveryNode — parses at the declared stream version
+        inp.version = version
         if msg_type == _REQ:
             source = DiscoveryNode.from_wire(inp)
             action = inp.read_string()

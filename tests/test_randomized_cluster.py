@@ -1,0 +1,109 @@
+"""Randomized cluster tests — shapes drawn from the session seed.
+
+Reference: TESTING.asciidoc:1-60 + ESTestCase's randomized runner:
+node counts, shard counts, replica counts, doc volumes and op orders
+vary per run (reproducible via the printed ESTPU_TEST_SEED), because
+fixed shapes systematically miss allocation/ordering bugs. Keep sizes
+bounded so a run stays in seconds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+
+@pytest.fixture()
+def random_cluster(test_random):
+    from elasticsearch_tpu.testing import InternalTestCluster
+    n_nodes = test_random.randint(2, 4)
+    c = InternalTestCluster(num_nodes=n_nodes)
+    yield c, test_random
+    c.close()
+
+
+def test_randomized_index_replicate_search(random_cluster):
+    c, rnd = random_cluster
+    a = c.nodes[0]
+    shards = rnd.randint(1, 5)
+    replicas = rnd.randint(0, min(2, len(c.nodes) - 1))
+    n_docs = rnd.randint(20, 120)
+    a.indices_service.create_index("r", {"settings": {
+        "number_of_shards": shards, "number_of_replicas": replicas}})
+    h = a.wait_for_health("green", timeout=20)
+    assert h["status"] == "green", (h, shards, replicas, len(c.nodes))
+    ids = list(range(n_docs))
+    rnd.shuffle(ids)
+    for i in ids:
+        a.index_doc("r", str(i), {"n": i, "body": f"tok{i % 7} common"})
+    a.broadcast_actions.refresh("r")
+    # query through a RANDOM node — routing must not care
+    q = c.nodes[rnd.randrange(len(c.nodes))]
+    res = q.search("r", {"query": {"match": {"body": "common"}},
+                         "size": 0})
+    assert res["hits"]["total"] == n_docs
+    tok = rnd.randrange(7)
+    expect = sum(1 for i in range(n_docs) if i % 7 == tok)
+    res = q.search("r", {"query": {"match": {"body": f"tok{tok}"}},
+                         "size": 0})
+    assert res["hits"]["total"] == expect
+
+
+def test_randomized_node_kill_with_replicas(random_cluster):
+    c, rnd = random_cluster
+    if len(c.nodes) < 3:
+        pytest.skip("kill test needs a quorum-surviving cluster")
+    a = c.nodes[0]
+    shards = rnd.randint(1, 4)
+    a.indices_service.create_index("k", {"settings": {
+        "number_of_shards": shards, "number_of_replicas": 1}})
+    a.wait_for_health("green", timeout=20)
+    n_docs = rnd.randint(10, 60)
+    for i in range(n_docs):
+        a.index_doc("k", str(i), {"n": i})
+    victim = c.nodes[rnd.randrange(1, len(c.nodes))]
+    victim.kill()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        h = a.wait_for_health(None, timeout=1.0)
+        if h["number_of_nodes"] == len(c.nodes) - 1 and \
+                h["status"] == "green":
+            break
+        time.sleep(0.2)
+    h = a.wait_for_health("green", timeout=5)
+    assert h["status"] == "green", h
+    a.broadcast_actions.refresh("k")
+    assert a.search("k", {"size": 0})["hits"]["total"] == n_docs
+
+
+def test_randomized_concurrent_writers(random_cluster):
+    c, rnd = random_cluster
+    a = c.nodes[0]
+    a.indices_service.create_index("w", {"settings": {
+        "number_of_shards": rnd.randint(1, 3),
+        "number_of_replicas": min(1, len(c.nodes) - 1)}})
+    a.wait_for_health("green", timeout=20)
+    n_writers = rnd.randint(2, 4)
+    per = rnd.randint(10, 40)
+    errors: list = []
+
+    def writer(wi: int, node) -> None:
+        for i in range(per):
+            try:
+                node.index_doc("w", f"{wi}-{i}", {"w": wi, "i": i})
+            except Exception as e:   # noqa: BLE001 — collected
+                errors.append(e)
+
+    threads = [threading.Thread(
+        target=writer, args=(wi, c.nodes[rnd.randrange(len(c.nodes))]))
+        for wi in range(n_writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors, errors[:3]
+    a.broadcast_actions.refresh("w")
+    assert a.search("w", {"size": 0})["hits"]["total"] == \
+        n_writers * per

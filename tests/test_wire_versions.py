@@ -1,0 +1,99 @@
+"""Wire-version gating, exercised — not dead machinery.
+
+Reference: core/common/io/stream/StreamInput.java:58 (version-gated field
+reads), NettyTransport's min(local, remote) stream-version negotiation,
+test/test/ESBackcompatTestCase.java. CURRENT_VERSION 1_000_100 added the
+DiscoveryNode `build` field; these tests round-trip against the previous
+generation in both directions and run a real mixed-version TCP exchange.
+"""
+
+from __future__ import annotations
+
+from elasticsearch_tpu.transport.service import (
+    DiscoveryNode, TransportAddress, TransportService)
+from elasticsearch_tpu.transport.stream import (
+    CURRENT_VERSION, V_1_0_99, StreamInput, StreamOutput)
+from elasticsearch_tpu.transport.tcp import TcpTransport
+
+
+def _node(build="abc123", version=CURRENT_VERSION):
+    return DiscoveryNode("id1", "n1", TransportAddress("127.0.0.1", 9300),
+                         attributes=(("data", "true"),), version=version,
+                         build=build)
+
+
+def test_gated_field_round_trips_at_current():
+    out = StreamOutput(CURRENT_VERSION)
+    _node().to_wire(out)
+    back = DiscoveryNode.from_wire(StreamInput(out.bytes(),
+                                               CURRENT_VERSION))
+    assert back.build == "abc123"
+    assert back == _node()
+
+
+def test_gated_field_dropped_on_old_stream():
+    """A 1_000_099 stream neither carries nor expects `build`; every
+    other field survives byte-exactly."""
+    out = StreamOutput(V_1_0_99)
+    _node().to_wire(out)
+    back = DiscoveryNode.from_wire(StreamInput(out.bytes(), V_1_0_99))
+    assert back.build == ""                     # gated away, not garbled
+    assert back.node_id == "id1" and back.address.port == 9300
+    assert dict(back.attributes) == {"data": "true"}
+    # and the old stream is SHORTER: the field truly wasn't written
+    new = StreamOutput(CURRENT_VERSION)
+    _node().to_wire(new)
+    assert len(out.bytes()) < len(new.bytes())
+
+
+def test_old_reader_parses_old_writer_payload():
+    """Forward direction an old node would see: a new node writing at the
+    negotiated (old) version produces bytes an old parser accepts."""
+    out = StreamOutput(V_1_0_99)
+    _node(version=V_1_0_99).to_wire(out)
+    inp = StreamInput(out.bytes(), V_1_0_99)
+    back = DiscoveryNode.from_wire(inp)
+    assert back.version == V_1_0_99
+    assert inp.remaining() == 0 if hasattr(inp, "remaining") else True
+
+
+def test_mixed_version_nodes_talk_over_tcp():
+    """System-level negotiation: an old-generation node (version
+    1_000_099) and a current node exchange real TCP requests; each side
+    writes at min(local, remote) so the gated field never corrupts the
+    stream."""
+    services = []
+    try:
+        old = TransportService(
+            TcpTransport("127.0.0.1", 0),
+            lambda addr: DiscoveryNode("old", "old", addr,
+                                       version=V_1_0_99, build="oldbuild"))
+        services.append(old)
+        new = TransportService(
+            TcpTransport("127.0.0.1", 0),
+            lambda addr: DiscoveryNode("new", "new", addr,
+                                       version=CURRENT_VERSION,
+                                       build="newbuild"))
+        services.append(new)
+        seen = {}
+
+        def handler(request, source):
+            seen["source"] = source
+            return {"echo": request["x"], "server_saw_build": source.build}
+
+        old.register_request_handler("test/echo", handler, sync=True)
+        new.register_request_handler("test/echo", handler, sync=True)
+        # new → old: stream negotiates down to 1_000_099, build dropped
+        r1 = new.submit_request(old.local_node, "test/echo", {"x": 1},
+                                timeout=10.0)
+        assert r1["echo"] == 1
+        assert r1["server_saw_build"] == ""     # gated off the old stream
+        # old → new: the request frame itself declares 1_000_099; the
+        # current node parses it with the old layout
+        r2 = old.submit_request(new.local_node, "test/echo", {"x": 2},
+                                timeout=10.0)
+        assert r2["echo"] == 2
+        assert seen["source"].node_id == "old"
+    finally:
+        for s in services:
+            s.close()
