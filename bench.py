@@ -802,9 +802,178 @@ def main() -> int:
                 "shards": n_shards, "from": from5}
             log(f"[bench] config 8shard_qtf_top1000: "
                 f"{configs['8shard_qtf_top1000']['qps']} QPS")
+
+            # ---- mesh collective plane, same 8 shards, ONE program -----
+            # (parallel/mesh_engine.py): the 8 shard engines folded onto a
+            # 1-device ("dp","shard") mesh (spd=8) — per-shard emit, local
+            # shard-block merge, all_gather re-top-k and psum counts all
+            # IN-PROGRAM, vs the RPC path's per-shard dispatch + host
+            # merge above. On a v5e-8 the same program spreads the shard
+            # axis over ICI; this measures it on the hardware we have.
+            if os.environ.get("BENCH_MESH", "1") == "1":
+                from elasticsearch_tpu.parallel import make_mesh
+                from elasticsearch_tpu.parallel.mesh_engine import (
+                    MeshEngineSearcher)
+                from elasticsearch_tpu.search import dfs as dfs_mod
+                from elasticsearch_tpu.search.query_dsl import parse_query
+                t0 = time.perf_counter()
+                mesh1 = make_mesh(dp=1, shard=1, devices=[dev])
+                msearch = MeshEngineSearcher(mesh1, engines5, ms_map)
+                pack_s = time.perf_counter() - t0
+                bodies5 = [{"query": {"match": {"body": tx}}, "size": k5}
+                           for tx in texts[:batch * 4]]
+                mb = [bodies5[i:i + batch]
+                      for i in range(0, len(bodies5), batch)]
+                t0 = time.perf_counter()
+                out0 = msearch.search_batch(mb[0])
+                mesh_compile = time.perf_counter() - t0
+
+                # parity vs the dfs RPC oracle (reader device arrays are
+                # already resident in searchers5)
+                readers5 = [s.reader for s in searchers5]
+
+                def oracle_one(body):
+                    query = parse_query(body["query"])
+                    stats = dfs_mod.to_execution_stats(dfs_mod.aggregate_dfs(
+                        [dfs_mod.shard_dfs(r, ms_map, query)
+                         for r in readers5]))
+                    req = parse_search_request(body)
+                    rows, total = [], 0
+                    for si, r in enumerate(readers5):
+                        res = ShardSearcher(
+                            si, r, ms_map, dfs_stats=stats).query_phase(req)
+                        total += res.total
+                        for pos in range(len(res.doc_ids)):
+                            seg, local = r.resolve(int(res.doc_ids[pos]))
+                            rows.append((float(res.scores[pos]), si,
+                                         seg.seg.ids[local]))
+                    rows.sort(key=lambda x: (-x[0], x[1]))
+                    return total, rows[:k5]
+
+                mesh_ok = True
+                for qi in range(int(os.environ.get("BENCH_MESH_PARITY",
+                                                   "3"))):
+                    total, rows = oracle_one(bodies5[qi])
+                    got = [msearch.doc_id(d) for d in out0[qi]["doc_ids"]]
+                    want = [did for _, _, did in rows]
+                    if out0[qi]["total"] != total or got != want:
+                        overlap = len(set(got) & set(want)) / \
+                            max(len(want), 1)
+                        if overlap < 0.999 or out0[qi]["total"] != total:
+                            log(f"[bench] mesh parity FAIL q{qi}: "
+                                f"total {out0[qi]['total']} vs {total}, "
+                                f"overlap {overlap:.4f}")
+                            mesh_ok = False
+                        else:
+                            log(f"[bench] mesh parity q{qi}: id-order "
+                                f"differs, set overlap {overlap:.4f}")
+                t0 = time.perf_counter()
+                msearch.search_batch(mb[0])
+                per = time.perf_counter() - t0
+                todo_m = len(mb) if per < 2.0 else 1
+                t0 = time.perf_counter()
+                with ThreadPoolExecutor(n_threads) as pool:
+                    list(pool.map(msearch.search_batch, mb[:todo_m]))
+                dt_m = time.perf_counter() - t0
+                done_m = sum(len(b) for b in mb[:todo_m])
+                configs["mesh_8shard_top1000"] = {
+                    "qps": round(done_m / dt_m, 2),
+                    "ms_per_batch": round(dt_m / todo_m * 1e3, 2),
+                    "parity_ok": mesh_ok, "pack_s": round(pack_s, 1),
+                    "compile_s": round(mesh_compile, 1), "spd": 8}
+                log(f"[bench] config mesh_8shard_top1000: "
+                    f"{configs['mesh_8shard_top1000']['qps']} QPS "
+                    f"(parity_ok={mesh_ok}, pack {pack_s:.1f}s, "
+                    f"compile {mesh_compile:.1f}s)")
             shard_pool.shutdown(wait=False)
             for e5 in engines5:
                 e5.close()
+
+        # ---- HBM over-capacity streaming (SURVEY §7 residency) ---------
+        # One engine, 8 segments, and a reader budgeted to HALF of them:
+        # emulates a corpus at 2x HBM capacity — the overflow half
+        # streams host→HBM per batch, double-buffered
+        # (jit_exec.run_segments_streamed), vs the fully-resident reader.
+        if os.environ.get("BENCH_STREAM", "1") == "1":
+            from elasticsearch_tpu.index.device_reader import DeviceReader
+            eng_s = Engine(Path(tempfile.mkdtemp(prefix="bench_stream_")),
+                           ms_map)
+            per_seg = -(-n_docs // 8)
+            t0 = time.perf_counter()
+            for si in range(8):
+                lo = si * per_seg
+                hi = min(lo + per_seg, n_docs)
+                rows = hi - lo
+                np_rows = doc_count_bucket(rows)
+
+                def tpad(a, fill):
+                    out = np.full((np_rows,) + a.shape[1:], fill, a.dtype)
+                    out[:rows] = a[lo:hi]
+                    return out
+                seg_df = np.zeros(vocab, np.int64)
+                sut = uterms[lo:hi]
+                np.add.at(seg_df, sut[sut >= 0], 1)
+                eng_s.install_segment(Segment.from_packed_text(
+                    si, "body", terms=term_names, tokens=None,
+                    uterms=tpad(uterms, -1), utf=tpad(utf, 0.0),
+                    doc_len=tpad(lens, 0), df=seg_df, num_docs=rows,
+                    ids=[str(lo + i) for i in range(rows)] +
+                        [""] * (np_rows - rows)), track_versions=False)
+            view_s = eng_s.acquire_searcher()
+            half = sum(s.memory_bytes() for s in view_s.segments[:4])
+            log(f"[bench] stream: 8-segment engine built in "
+                f"{time.perf_counter() - t0:.1f}s; budget {half/1e6:.0f} MB "
+                f"(4 of 8 segments resident)")
+            reqs_s = [parse_search_request(
+                {"query": {"match": {"body": tx}}, "size": k})
+                for tx in texts[:batch * 4]]
+            bss = [reqs_s[i:i + batch]
+                   for i in range(0, len(reqs_s), batch)]
+
+            def measure_reader(reader, label):
+                s_ = ShardSearcher(0, reader, ms_map)
+                r0 = s_.query_phase_batch(bss[0])
+                assert r0 is not None, f"{label} fell back"
+                # keep only doc ids: each result holds a `reader` ref and
+                # would pin the resident reader's HBM through the
+                # streamed measurement
+                ids0 = [r.doc_ids for r in r0]
+                del r0
+                t0 = time.perf_counter()
+                s_.query_phase_batch(bss[0])
+                per = time.perf_counter() - t0
+                todo = len(bss) if per < 2.0 else 1
+                t0 = time.perf_counter()
+                for b_ in bss[:todo]:
+                    s_.query_phase_batch(b_)
+                dt = time.perf_counter() - t0
+                return ids0, dt / todo * 1e3, sum(
+                    len(b_) for b_ in bss[:todo]) / dt
+
+            import gc as _gc
+            r_full = DeviceReader(view_s, device=dev)
+            res_f, ms_f, qps_f = measure_reader(r_full, "resident")
+            del r_full
+            _gc.collect()
+            r_half = DeviceReader(view_s, device=dev,
+                                  hbm_budget_bytes=half)
+            assert sum(s.resident for s in r_half.segments) == 4
+            res_h, ms_h, qps_h = measure_reader(r_half, "streamed")
+            stream_ok = all(np.array_equal(a, b)
+                            for a, b in zip(res_f, res_h))
+            ratio = ms_h / ms_f if ms_f else float("inf")
+            engine["stream_2x_capacity"] = {
+                "resident_qps": round(qps_f, 2),
+                "streamed_qps": round(qps_h, 2),
+                "ms_per_batch_resident": round(ms_f, 2),
+                "ms_per_batch_streamed": round(ms_h, 2),
+                "overhead_x": round(ratio, 2), "parity_ok": stream_ok}
+            log(f"[bench] stream 2x-capacity: resident {qps_f:.1f} QPS "
+                f"vs streamed {qps_h:.1f} QPS (overhead {ratio:.2f}x, "
+                f"parity_ok={stream_ok})")
+            del r_half
+            _gc.collect()
+            eng_s.close()
 
     oracle_recall = engine.get("oracle_recall_at_k")
     recall_ok = bool(kernel_ok and engine_ok and

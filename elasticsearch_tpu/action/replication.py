@@ -319,9 +319,7 @@ class DocumentActions:
         from elasticsearch_tpu.indices.service import ShardNotLocalError
         pr = self._state().routing_table.primary(name, shard)
         if pr is None or pr.node_id != self.node.node_id:
-            raise ShardNotLocalError(
-                f"[{name}][{shard}] primary no longer on this node "
-                f"(relocated or failed over)")
+            raise ShardNotLocalError(name, shard)
 
     def _recheck_primary_after_op(self, name: str, shard: int,
                                   delivered: set) -> None:
@@ -337,9 +335,7 @@ class DocumentActions:
         if pr is not None and (pr.node_id == self.node.node_id
                                or pr.node_id in delivered):
             return
-        raise ShardNotLocalError(
-            f"[{name}][{shard}] primary moved during the op and the new "
-            f"primary did not receive it")
+        raise ShardNotLocalError(name, shard)
 
     def _handle_index_p(self, request: dict, source) -> dict:
         self._assert_primary_here(request["index"], request["shard"])

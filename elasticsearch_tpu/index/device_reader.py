@@ -108,6 +108,12 @@ class DeviceSegment:
     # search behind gigabytes of host→HBM traffic. None (mesh-engine
     # templates) means "arrays are host-side by design, don't touch".
     lazy_put: Any = None
+    # False → columns live in a pinned HOST pool, not HBM: the segment is
+    # beyond the reader's HBM budget and is streamed host→device per query
+    # batch, double-buffered (jit_exec.run_segments_streamed) — the
+    # over-capacity analog of the reference's FS-cache paging
+    # (core/index/store/FsDirectoryService.java mmap).
+    resident: bool = True
 
     @property
     def padded_docs(self) -> int:
@@ -126,15 +132,30 @@ class TextFieldStats:
 
 
 class DeviceReader:
-    def __init__(self, view: SearcherView, device=None):
+    def __init__(self, view: SearcherView, device=None,
+                 hbm_budget_bytes: int | None = None):
+        """``hbm_budget_bytes`` caps the column bytes uploaded to HBM: a
+        PREFIX of segments (in order) is packed device-resident until the
+        budget is spent; every later segment stays in a host pool and is
+        streamed per query batch. Prefix-order (not best-fit) keeps the
+        cross-segment merge's tie-break identical to the fully-resident
+        reader: resident candidates always precede streamed ones in
+        segment order."""
         self.generation = view.generation
         self.segments: list[DeviceSegment] = []
         self._text_stats: dict[str, TextFieldStats] = {}
         doc_base = 0
         put = (lambda x: jax.device_put(x, device)) if device is not None \
             else jax.device_put
+        self.device = device
+        used = 0
+        streaming = False
         for seg, live in zip(view.segments, view.live_masks):
-            self.segments.append(self._pack_segment(seg, live, doc_base, put))
+            if hbm_budget_bytes is not None and not streaming:
+                used += seg.memory_bytes()
+                streaming = used > hbm_budget_bytes
+            self.segments.append(self._pack_segment(
+                seg, live, doc_base, put, resident=not streaming))
             doc_base += seg.padded_docs
         self.max_doc = doc_base
         self._collect_stats(view)
@@ -142,7 +163,11 @@ class DeviceReader:
     # ---- packing ----------------------------------------------------------
 
     def _pack_segment(self, seg: Segment, live: np.ndarray, doc_base: int,
-                      put) -> DeviceSegment:
+                      put, resident: bool = True) -> DeviceSegment:
+        if not resident:
+            # host pool: contiguous numpy (one memcpy per DMA later), no
+            # device transfer now, no lazy materialization caching
+            put = np.ascontiguousarray
         text = {}
         for name, c in seg.text_fields.items():
             text[name] = DeviceTextField(
@@ -176,12 +201,14 @@ class DeviceReader:
             child_live = np.zeros(blk.segment.padded_docs, bool)
             child_live[valid] = live[blk.parent[valid]]
             nested[path] = DeviceNestedBlock(
-                child=self._pack_segment(blk.segment, child_live, 0, put),
+                child=self._pack_segment(blk.segment, child_live, 0, put,
+                                         resident=resident),
                 parent=put(blk.parent))
         return DeviceSegment(seg=seg, live=put(live), doc_base=doc_base,
                              text=text, keyword=keyword, numeric=numeric,
                              vector=vector, geo=geo, nested=nested,
-                             lazy_put=put)
+                             lazy_put=put if resident else None,
+                             resident=resident)
 
     def _collect_stats(self, view: SearcherView) -> None:
         for seg in view.segments:
@@ -287,7 +314,13 @@ def device_reader_for(engine, view: SearcherView | None = None,
                     {"hit_count": 0, "miss_count": 0, "evictions": 0})
                 for k in carry:
                     carry[k] += old_stats.get(k, 0)
-        cached = DeviceReader(view, device=device)
+        budget = None
+        st = getattr(engine, "settings", None)
+        if st is not None:
+            raw = st.get("index.hbm_budget_bytes", None)
+            if raw is not None:
+                budget = int(raw)
+        cached = DeviceReader(view, device=device, hbm_budget_bytes=budget)
         cached._accounted_bytes = new_bytes if bs is not None else 0
         engine._device_reader_cache = cached
         return cached

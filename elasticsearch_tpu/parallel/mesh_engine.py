@@ -9,7 +9,10 @@ closures of search/execute.py — as ONE SPMD program over a device mesh:
 
 * every engine shard's segments are padded to common shape buckets,
   stacked on a leading axis and sharded over the ``shard`` mesh axis
-  (doc-partition = the reference's hash-routed shard);
+  (doc-partition = the reference's hash-routed shard); when the index has
+  more shards than devices (incl. the 1-chip case) each device holds a
+  block of ``spd = n_shards // mesh_shard`` stacked shards and merges
+  them locally before the collective;
 * the query batch is sharded over ``dp`` (concurrent-searches axis);
 * term statistics are aggregated globally host-side (search/dfs.py — the
   DFS round; term *ids* stay per-shard constants since segment
@@ -90,10 +93,17 @@ class MeshEngineSearcher:
         self.mapper_service = mapper_service
         self.k1, self.b = k1, b
         self._bm25 = BM25Params(k1=k1, b=b)
-        s = mesh.shape["shard"]
-        if len(engines) != s:
-            raise ValueError(f"{len(engines)} engine shards != mesh shard "
-                             f"axis {s}")
+        s_mesh = mesh.shape["shard"]
+        if len(engines) % s_mesh != 0:
+            raise ValueError(f"{len(engines)} engine shards not divisible "
+                             f"by mesh shard axis {s_mesh}")
+        s = len(engines)
+        # shards-per-device blocking: when the index has more shards than
+        # the mesh's shard axis (incl. the 1-chip case), each device holds
+        # a contiguous block of spd shards on the stacked leading axis and
+        # merges them locally before the cross-device all_gather — the
+        # same program distributes unchanged from 1 chip to a full slice.
+        self.spd = s // s_mesh
         self.n_shards = s
         views = [e.acquire_searcher() for e in engines]
         self._views = views
@@ -231,40 +241,59 @@ class MeshEngineSearcher:
         n_slots = self.n_slots
         slot_bases = self.slot_bases
         stride = self.shard_stride
+        spd = self.spd
 
         def step_local(flats, consts):
-            # flats[j]: arrays [1, Np_j, ...]; consts[j]: [1, B_local, ...]
-            shard_idx = jax.lax.axis_index("shard").astype(jnp.int32)
-            seg_scores, seg_docs, counts = [], [], None
-            for j in range(n_slots):
-                view = seg_rebuild(templates0[j],
-                                   [a[0] for a in flats[j]])
+            # flats[j]: arrays [spd, Np_j, ...]; consts[j]: [spd, B_local, ...]
+            dev_idx = jax.lax.axis_index("shard").astype(jnp.int32)
+            cand_s, cand_d, counts = [], [], None
+            for li in range(spd):
+                seg_scores, seg_docs = [], []
+                for j in range(n_slots):
+                    view = seg_rebuild(templates0[j],
+                                       [a[li] for a in flats[j]])
 
-                def one(cs, j=j, view=view):
-                    return _build(view, list(cs), emits[j], None, refss[j],
-                                  _FLAGS, k)
+                    def one(cs, j=j, view=view):
+                        return _build(view, list(cs), emits[j], None,
+                                      refss[j], _FLAGS, k)
 
-                outs = jax.vmap(one)(
-                    jax.tree.map(lambda a: a[0], consts[j]))
-                docs = jnp.where(outs["top_docs"] >= 0,
-                                 outs["top_docs"] + slot_bases[j], -1)
-                seg_scores.append(outs["top_scores"])
-                seg_docs.append(docs)
-                counts = outs["count"] if counts is None \
-                    else counts + outs["count"]
-            scores = jnp.concatenate(seg_scores, axis=1)    # [B, slots*k]
-            docs = jnp.concatenate(seg_docs, axis=1)
-            kk = min(k, scores.shape[1])
-            top_s, idx = jax.lax.top_k(
-                jnp.where(docs >= 0, scores, -jnp.inf), kk)
-            top_d = jnp.take_along_axis(docs, idx, axis=1)
-            top_d = jnp.where(top_s > -jnp.inf,
-                              top_d + shard_idx * stride, -1)
-            if kk < k:
-                top_s = jnp.pad(top_s, ((0, 0), (0, k - kk)),
-                                constant_values=-jnp.inf)
-                top_d = jnp.pad(top_d, ((0, 0), (0, k - kk)),
-                                constant_values=-1)
+                    outs = jax.vmap(one)(
+                        jax.tree.map(lambda a, li=li: a[li], consts[j]))
+                    docs = jnp.where(outs["top_docs"] >= 0,
+                                     outs["top_docs"] + slot_bases[j], -1)
+                    seg_scores.append(outs["top_scores"])
+                    seg_docs.append(docs)
+                    counts = outs["count"] if counts is None \
+                        else counts + outs["count"]
+                scores = jnp.concatenate(seg_scores, axis=1)  # [B, slots*k]
+                docs = jnp.concatenate(seg_docs, axis=1)
+                kk = min(k, scores.shape[1])
+                top_s, idx = jax.lax.top_k(
+                    jnp.where(docs >= 0, scores, -jnp.inf), kk)
+                top_d = jnp.take_along_axis(docs, idx, axis=1)
+                top_d = jnp.where(top_s > -jnp.inf,
+                                  top_d + (dev_idx * spd + li) * stride, -1)
+                if kk < k:
+                    top_s = jnp.pad(top_s, ((0, 0), (0, k - kk)),
+                                    constant_values=-jnp.inf)
+                    top_d = jnp.pad(top_d, ((0, 0), (0, k - kk)),
+                                    constant_values=-1)
+                cand_s.append(top_s)
+                cand_d.append(top_d)
+            if spd > 1:
+                # local merge over this device's shard block: keeping k of
+                # the spd*k candidates is exact (each dropped candidate
+                # loses to >=k same-device candidates that also outrank it
+                # globally; stable top_k keeps the lower shard on ties —
+                # the (-score, shard) order of SearchPhaseController)
+                loc_s = jnp.concatenate(cand_s, axis=1)       # [B, spd*k]
+                loc_d = jnp.concatenate(cand_d, axis=1)
+                top_s, pos = jax.lax.top_k(
+                    jnp.where(loc_d >= 0, loc_s, -jnp.inf), k)
+                top_d = jnp.take_along_axis(loc_d, pos, axis=1)
+                top_d = jnp.where(top_s > -jnp.inf, top_d, -1)
+            else:
+                top_s, top_d = cand_s[0], cand_d[0]
             # ---- reduce over ICI: counts psum + all_gather re-top-k -----
             totals = jax.lax.psum(counts, "shard")          # [B_local]
             all_s = jax.lax.all_gather(top_s, "shard")      # [S, B, k]
@@ -309,9 +338,14 @@ class MeshEngineSearcher:
                 raise QueryParsingError(
                     "mesh engine plane supports score-ordered top-k "
                     "requests — route others to the RPC path")
+        import os
+        import time
+        debug = os.environ.get("MESH_DEBUG")
+        t0 = time.perf_counter()
         k = max(max(r.from_ + r.size, 1) for r in reqs)
         queries = [r.query for r in reqs]
         dfs_stats = self._global_dfs(queries)
+        t_dfs = time.perf_counter() - t0
         dp = self.mesh.shape["dp"]
         b_real = len(queries)
         b_pad = -(-b_real // dp) * dp
@@ -360,13 +394,21 @@ class MeshEngineSearcher:
             refss.append(refs_j)
             consts_dev.append(stacked)
 
+        t1 = time.perf_counter()
         fn = self._program(sigs, layouts, k, b_pad, consts_dev,
                            emits, refss,
                            [self._templates[0][j]
                             for j in range(self.n_slots)])
         g_s, g_d, totals = fn(self._flats, consts_dev)
+        t2 = time.perf_counter()
         g_s, g_d = np.asarray(g_s), np.asarray(g_d)
         totals = np.asarray(totals)
+        if debug:
+            print(f"[mesh-debug] dfs {t_dfs*1e3:.0f}ms "
+                  f"plan+stack {(t1-t0-t_dfs)*1e3:.0f}ms "
+                  f"dispatch {(t2-t1)*1e3:.0f}ms "
+                  f"fetch {(time.perf_counter()-t2)*1e3:.0f}ms",
+                  flush=True)
         out = []
         for bi, req in enumerate(reqs):
             kq = max(req.from_ + req.size, 1)

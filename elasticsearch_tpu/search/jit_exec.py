@@ -487,6 +487,63 @@ def run_reader_batch(segments: list, ctx: ExecutionContext, queries: list,
     return out
 
 
+def run_segments_streamed(segments: list, ctx: ExecutionContext,
+                          queries: list, *, k: int,
+                          device=None) -> list | None:
+    """Batched query phase over HOST-POOL (non-resident) segments: each
+    segment's columns are DMA'd host→HBM per batch, double-buffered so
+    segment i+1's transfer overlaps segment i's compute, and the device
+    buffers are dropped as soon as the program consumes them — corpora
+    beyond HBM capacity execute at a bounded footprint of ~two segments'
+    columns (SURVEY §7 "HBM budget & residency"; the over-capacity analog
+    of the reference's FS-cache paging,
+    core/index/store/FsDirectoryService.java mmap).
+
+    Returns one ``{"count", "top_scores", "top_docs"}`` dict per segment
+    (batch axis padded like :func:`run_segment_batch` — callers slice),
+    or None when any segment's plan is ineligible for batching.
+    """
+    if not segments:
+        return []
+    k_static = int(k)
+    plans = []
+    for seg in segments:
+        plan = _plan_segment_batch(seg, ctx, queries, k_static)
+        if plan is None:
+            return None
+        plans.append(plan)
+    put = (lambda a: jax.device_put(a, device)) if device is not None \
+        else jax.device_put
+
+    def get_fn(seg, plan):
+        def compile_fn():
+            def run(flat_in, packed_in):
+                view = seg_rebuild(seg, flat_in, plan["pos"], plan["vecs"])
+                return jax.vmap(_lane_fn(plan, view))(packed_in)
+            shapes = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (plan["flat"], plan["packed"]))
+            return jax.jit(run).lower(*shapes).compile()
+        # same key space as run_segment_batch: bucketized segments with a
+        # common layout share ONE compiled program across the whole sweep
+        return _get_compiled(("batch",) + plan["key"], compile_fn)
+
+    outs_all = []
+    nxt = [put(a) for a in plans[0]["flat"]]
+    for i, (seg, plan) in enumerate(zip(segments, plans)):
+        cur, nxt = nxt, None
+        fn = get_fn(seg, plan)
+        packed = {dt: jnp.asarray(buf) for dt, buf in plan["packed"].items()}
+        outs = fn(cur, packed)              # async dispatch
+        if i + 1 < len(plans):
+            # enqueue the next segment's host→HBM transfer now: DMA
+            # overlaps the in-flight program's compute
+            nxt = [put(a) for a in plans[i + 1]["flat"]]
+        outs_all.append(outs)
+        del cur                             # free as soon as compute drains
+    return outs_all
+
+
 def run_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,
                       queries: list, *, k: int) -> dict | None:
     """Execute a BATCH of queries against one device segment as ONE vmapped

@@ -473,25 +473,36 @@ class ShardSearcher:
         # doc ids and counts survive the packed f32 fetch layout exactly
         # only below 2^24
         pack = self.reader.max_doc < (1 << 24)
-        try:
-            out = jit_exec.run_reader_batch(self.reader.segments, self.ctx,
-                                            queries, k=k, pack=pack)
-        except QueryParsingError:
-            raise
-        except Exception as e:            # noqa: BLE001 — fallback seam
-            jit_exec.note_fallback(e)
-            return None
-        if out is None:                   # mixed plan signatures
-            return None
-        if pack:
-            # single-fetch fast path: scoring, merge AND result packing
-            # ran as one program — one dispatch + one device→host round
-            # trip per batch (RTT dominates on a tunneled interconnect)
-            ms, md, totals = topk_ops.unpack_batch_result(np.asarray(out), k)
+        streamed = [s for s in self.reader.segments
+                    if not getattr(s, "resident", True)]
+        if streamed:
+            res_sm = self._query_phase_batch_streamed(queries, k, streamed)
+            if res_sm is None:
+                return None
+            ms, md, totals = res_sm
         else:
-            ms = np.asarray(out["top_scores"])
-            md = np.asarray(out["top_docs"])
-            totals = np.asarray(out["count"])
+            try:
+                out = jit_exec.run_reader_batch(self.reader.segments,
+                                                self.ctx, queries, k=k,
+                                                pack=pack)
+            except QueryParsingError:
+                raise
+            except Exception as e:        # noqa: BLE001 — fallback seam
+                jit_exec.note_fallback(e)
+                return None
+            if out is None:               # mixed plan signatures
+                return None
+            if pack:
+                # single-fetch fast path: scoring, merge AND result
+                # packing ran as one program — one dispatch + one
+                # device→host round trip per batch (RTT dominates on a
+                # tunneled interconnect)
+                ms, md, totals = topk_ops.unpack_batch_result(
+                    np.asarray(out), k)
+            else:
+                ms = np.asarray(out["top_scores"])
+                md = np.asarray(out["top_docs"])
+                totals = np.asarray(out["count"])
         results = []
         for bi, req in enumerate(reqs):
             kq = max(req.from_ + req.size, 1)
@@ -503,6 +514,56 @@ class ShardSearcher:
                 d_.astype(np.int32), s_.astype(np.float32), None, {},
                 self.reader))
         return results
+
+    def _query_phase_batch_streamed(self, queries: list, k: int,
+                                    streamed: list):
+        """Batched query phase when the reader exceeds its HBM budget: the
+        resident prefix runs as the usual one-program merge; streamed
+        segments run double-buffered through jit_exec.run_segments_streamed;
+        the final cross-part merge happens host-side in segment order (the
+        stable (-score, segment) tie-break of the fully-resident path).
+        → (ms, md, totals) numpy arrays or None (ineligible plans)."""
+        from elasticsearch_tpu.search import jit_exec
+        b = len(queries)
+        resident = [s for s in self.reader.segments
+                    if getattr(s, "resident", True)]
+        try:
+            out_r = None
+            if resident:
+                out_r = jit_exec.run_reader_batch(resident, self.ctx,
+                                                  queries, k=k, pack=False)
+                if out_r is None:
+                    return None
+            outs_s = jit_exec.run_segments_streamed(
+                streamed, self.ctx, queries, k=k,
+                device=getattr(self.reader, "device", None))
+        except QueryParsingError:
+            raise
+        except Exception as e:            # noqa: BLE001 — fallback seam
+            jit_exec.note_fallback(e)
+            return None
+        if outs_s is None:
+            return None
+        ms_parts, md_parts = [], []
+        totals = np.zeros(b, np.int64)
+        if out_r is not None:
+            ms_parts.append(np.asarray(out_r["top_scores"]))
+            md_parts.append(np.asarray(out_r["top_docs"]))
+            totals = totals + np.asarray(out_r["count"])
+        for seg, o in zip(streamed, outs_s):
+            s_ = np.asarray(o["top_scores"])[:b]
+            d_ = np.asarray(o["top_docs"])[:b]
+            ms_parts.append(s_)
+            md_parts.append(np.where(d_ >= 0, d_ + seg.doc_base, -1))
+            totals = totals + np.asarray(o["count"])[:b]
+        S = np.concatenate(ms_parts, axis=1)
+        D = np.concatenate(md_parts, axis=1)
+        S = np.where(D >= 0, S, -np.inf).astype(np.float32)
+        order = np.argsort(-S, axis=1, kind="stable")[:, :k]
+        ms = np.take_along_axis(S, order, axis=1)
+        md = np.take_along_axis(D, order, axis=1)
+        md = np.where(np.isfinite(ms), md, -1)
+        return ms, md, totals
 
     def _apply_rescore(self, req: ParsedSearchRequest,
                        res: ShardQueryResult) -> None:

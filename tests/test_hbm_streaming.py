@@ -1,0 +1,99 @@
+"""HBM over-capacity streaming (SURVEY §7 "HBM budget & residency"): a
+reader whose segments exceed the HBM budget keeps a resident prefix and
+streams the rest host→device per batch, double-buffered
+(jit_exec.run_segments_streamed) — results must be identical to the
+fully-resident reader, and the single-request / aggs / sort fallback paths
+must keep working over streamed segments."""
+
+import numpy as np
+
+from elasticsearch_tpu.index.device_reader import DeviceReader
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.search.phase import ShardSearcher, parse_search_request
+
+
+def _mapper():
+    ms = MapperService()
+    ms.merge("_doc", {"properties": {
+        "t": {"type": "text", "analyzer": "whitespace"},
+        "n": {"type": "long"}}})
+    return ms
+
+
+def _engine(tmp_path, rng, n_segs=4, docs_per=60):
+    ms = _mapper()
+    eng = Engine(tmp_path / "s", ms)
+    i = 0
+    for _ in range(n_segs):
+        for _ in range(docs_per):
+            words = [f"w{int(x)}" for x in rng.zipf(1.6, size=8) if x < 40]
+            eng.index(str(i), {"t": " ".join(words) or "w1", "n": i})
+            i += 1
+        eng.refresh()                     # one segment per round
+    eng.delete(str(5))
+    if i > 130:
+        eng.delete(str(130))
+    eng.refresh()
+    return ms, eng
+
+
+def _budget_for_prefix(view, n_resident):
+    """A budget that fits exactly the first n segments."""
+    return sum(s.memory_bytes() for s in view.segments[:n_resident])
+
+
+def test_streamed_matches_resident(tmp_path, rng):
+    ms, eng = _engine(tmp_path, rng)
+    view = eng.acquire_searcher()
+    full = ShardSearcher(0, DeviceReader(view), ms)
+    reqs = [parse_search_request({"query": {"match": {"t": f"w1 w{j} w7"}},
+                                  "size": 30}) for j in range(2, 10)]
+    want = full.query_phase_batch(reqs)
+    assert want is not None
+    for n_res in (0, 1, 3):
+        budget = _budget_for_prefix(view, n_res)
+        rd = DeviceReader(view, hbm_budget_bytes=budget)
+        assert [s.resident for s in rd.segments] == \
+            [i < n_res for i in range(len(rd.segments))]
+        got = ShardSearcher(0, rd, ms).query_phase_batch(reqs)
+        assert got is not None, f"streamed path fell back (n_res={n_res})"
+        for g, w in zip(got, want):
+            assert g.total == w.total
+            np.testing.assert_array_equal(g.doc_ids, w.doc_ids)
+            np.testing.assert_allclose(g.scores, w.scores, rtol=1e-6)
+    eng.close()
+
+
+def test_streamed_single_request_and_aggs(tmp_path, rng):
+    """Non-batchable shapes (aggs) fall back to per-query eager execution,
+    which must still work over host-pool segments (implicit transfer)."""
+    ms, eng = _engine(tmp_path, rng, n_segs=3, docs_per=40)
+    view = eng.acquire_searcher()
+    full = ShardSearcher(0, DeviceReader(view), ms)
+    stream = ShardSearcher(
+        0, DeviceReader(view, hbm_budget_bytes=_budget_for_prefix(view, 1)),
+        ms)
+    body = {"query": {"match": {"t": "w1"}}, "size": 10,
+            "aggs": {"mx": {"max": {"field": "n"}}}}
+    req = parse_search_request(body)
+    w = full.query_phase(req)
+    g = stream.query_phase(req)
+    assert g.total == w.total
+    np.testing.assert_array_equal(g.doc_ids, w.doc_ids)
+    assert g.agg_partials.keys() == w.agg_partials.keys()
+    eng.close()
+
+
+def test_streamed_respects_deletes(tmp_path, rng):
+    ms, eng = _engine(tmp_path, rng)
+    view = eng.acquire_searcher()
+    rd = DeviceReader(view, hbm_budget_bytes=0)
+    assert not any(s.resident for s in rd.segments)
+    got = ShardSearcher(0, rd, ms).query_phase_batch(
+        [parse_search_request({"query": {"match": {"t": "w1"}},
+                               "size": 250})])
+    assert got is not None
+    ids = {rd.doc_id(int(d)) for d in got[0].doc_ids}
+    assert "5" not in ids and "130" not in ids
+    eng.close()

@@ -112,3 +112,93 @@ def test_mesh_total_counts(mesh, engines):
             want += int((hits & live).sum())
     for res in out:
         assert res["total"] == want
+
+
+# ---- shards-per-device blocking (spd > 1) ---------------------------------
+# More engine shards than mesh devices — the 1-chip config-5 shape and the
+# general "many shards per device" deployment. Results must stay identical
+# to the RPC oracle regardless of how the shard axis is folded.
+
+@pytest.mark.parametrize("mesh_shard,dp", [(2, 2), (1, 1)])
+def test_mesh_spd_matches_rpc_path(engines, mesh_shard, dp):
+    ms, engs = engines                               # 4 engine shards
+    m = make_mesh(dp=dp, shard=mesh_shard,
+                  devices=jax.devices()[:dp * mesh_shard])
+    searcher = MeshEngineSearcher(m, engs, ms)
+    assert searcher.spd == N_SHARDS // mesh_shard
+    for q in QUERIES:
+        body = {"query": q, "size": 25}
+        out = searcher.search_batch([body] * dp)
+        ref_total, ref_rows = _rpc_reference(ms, engs, body, 25)
+        want = [(round(s, 4), did) for s, _, did in ref_rows]
+        for res in out:
+            assert res["total"] == ref_total, q
+            got = [(round(float(s), 4), searcher.doc_id(d))
+                   for s, d in zip(res["scores"], res["doc_ids"])]
+            assert got == want, q
+
+
+def test_mesh_large_shard_parity(tmp_path):
+    """Past toy shapes: ~100k docs per shard (packed columnar ingest, the
+    bench's corpus discipline), 2 shards on a 2-device shard axis, top-1000
+    parity against the RPC oracle."""
+    from elasticsearch_tpu.index.segment import Segment, doc_count_bucket
+
+    ms = _mapper()
+    rng = np.random.default_rng(7)
+    n_per, vocab, L = 100_000, 5_000, 24
+    w = len(str(vocab - 1))
+    names = [f"w{i:0{w}d}" for i in range(vocab)]
+    engs = []
+    for si in range(2):
+        lens = np.clip(rng.poisson(12, n_per), 4, L).astype(np.int32)
+        toks = (rng.pareto(1.1, size=(n_per, L)) * 3).astype(np.int64)
+        toks = np.minimum(toks, vocab - 1).astype(np.int32)
+        toks[np.arange(L)[None, :] >= lens[:, None]] = -1
+        order = np.argsort(toks, axis=1, kind="stable")
+        st = np.take_along_axis(toks, order, axis=1)
+        new = np.ones_like(st, dtype=bool)
+        new[:, 1:] = st[:, 1:] != st[:, :-1]
+        new &= st >= 0
+        uidx = np.cumsum(new, axis=1) - 1
+        U = int(uidx.max()) + 1
+        uterms = np.full((n_per, U), -1, np.int32)
+        utf = np.zeros((n_per, U), np.float32)
+        rows = np.broadcast_to(np.arange(n_per)[:, None], (n_per, L))
+        valid = st >= 0
+        np.add.at(utf, (rows[valid], uidx[valid]), 1.0)
+        first = new & valid
+        uterms[rows[first], uidx[first]] = st[first]
+        df = np.zeros(vocab, np.int64)
+        np.add.at(df, uterms[uterms >= 0], 1)
+        np_rows = doc_count_bucket(n_per)
+
+        def pad(a, fill):
+            out = np.full((np_rows,) + a.shape[1:], fill, a.dtype)
+            out[:n_per] = a
+            return out
+
+        seg = Segment.from_packed_text(
+            0, "t", terms=names, tokens=None,
+            uterms=pad(uterms, -1), utf=pad(utf, 0.0),
+            doc_len=pad(lens, 0), df=df, num_docs=n_per,
+            ids=[f"{si}-{i}" for i in range(n_per)] +
+                [""] * (np_rows - n_per))
+        e = Engine(tmp_path / f"big{si}", ms)
+        e.install_segment(seg, track_versions=False)
+        engs.append(e)
+    try:
+        m = make_mesh(dp=1, shard=2, devices=jax.devices()[:2])
+        searcher = MeshEngineSearcher(m, engs, ms)
+        body = {"query": {"match": {
+            "t": f"{names[1]} {names[5]} {names[40]}"}}, "size": 1000}
+        out = searcher.search_batch([body])
+        total, rows = _rpc_reference(ms, engs, body, 1000)
+        assert out[0]["total"] == total and total > 1000
+        got = [(round(float(s), 3), searcher.doc_id(d))
+               for s, d in zip(out[0]["scores"], out[0]["doc_ids"])]
+        want = [(round(s, 3), did) for s, _, did in rows]
+        assert got == want
+    finally:
+        for e in engs:
+            e.close()
