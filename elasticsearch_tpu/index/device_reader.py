@@ -81,6 +81,15 @@ class DeviceGeoField:
 
 
 @dataclass
+class DeviceShapeField:
+    lats: Any        # [Np, V] f32 closed rings
+    lons: Any        # [Np, V] f32
+    nv: Any          # [Np] i32 edge count
+    exists: Any
+    column: Any
+
+
+@dataclass
 class DeviceNestedBlock:
     """A nested path's child segment + child→parent join, device-resident.
     Child ``live`` already folds the PARENT's live mask in (children of
@@ -100,6 +109,7 @@ class DeviceSegment:
     vector: dict[str, DeviceVectorField]
     geo: dict[str, DeviceGeoField]
     nested: dict[str, "DeviceNestedBlock"] = dc_field(default_factory=dict)
+    shape: dict[str, DeviceShapeField] = dc_field(default_factory=dict)
     # device_put for LAZY columns (tokens / vecs): those stay host-side
     # numpy until a plan declares it needs them (jit_exec.seg_flatten
     # materializes + caches on first use). Position matrices and dense
@@ -129,6 +139,24 @@ class TextFieldStats:
     @property
     def avgdl(self) -> float:
         return self.total_tokens / max(self.docs_with_field, 1)
+
+
+def resident_prefix_bytes(view: SearcherView,
+                          hbm_budget_bytes: int | None) -> int:
+    """Column bytes of the segment prefix that stays HBM-resident under a
+    budget (mirrors DeviceReader's cutoff: the first segment whose
+    cumulative size exceeds the budget — and everything after it —
+    streams)."""
+    total = 0
+    used = 0
+    for seg in view.segments:
+        b = seg.memory_bytes()
+        if hbm_budget_bytes is not None:
+            used += b
+            if used > hbm_budget_bytes:
+                break
+        total += b
+    return total
 
 
 class DeviceReader:
@@ -192,6 +220,10 @@ class DeviceReader:
                                     lon=put(c.lon.astype(np.float32)),
                                     exists=put(c.exists), column=c)
                for name, c in seg.geo_fields.items()}
+        shape = {name: DeviceShapeField(lats=put(c.lats), lons=put(c.lons),
+                                        nv=put(c.nv), exists=put(c.exists),
+                                        column=c)
+                 for name, c in seg.shape_fields.items()}
         nested = {}
         for path, blk in seg.nested_blocks.items():
             # child live folds the parent's live mask in: children of
@@ -207,6 +239,7 @@ class DeviceReader:
         return DeviceSegment(seg=seg, live=put(live), doc_base=doc_base,
                              text=text, keyword=keyword, numeric=numeric,
                              vector=vector, geo=geo, nested=nested,
+                             shape=shape,
                              lazy_put=put if resident else None,
                              resident=resident)
 
@@ -294,7 +327,17 @@ def device_reader_for(engine, view: SearcherView | None = None,
         # replaced: reserving the full new size while the old is still
         # held would spuriously trip once an index passes half the limit.
         bs = getattr(engine, "breaker_service", None)
-        new_bytes = sum(seg.memory_bytes() for seg in view.segments)
+        budget = None
+        st = getattr(engine, "settings", None)
+        if st is not None:
+            raw = st.get("index.hbm_budget_bytes", None)
+            if raw is not None:
+                budget = int(raw)
+        # under an HBM budget only the resident prefix occupies HBM —
+        # streamed segments live in the host pool plus ~2 transient
+        # DMA buffers, so accounting the full corpus would trip the
+        # breaker on exactly the over-capacity case streaming exists for
+        new_bytes = resident_prefix_bytes(view, budget)
         old_bytes = getattr(cached, "_accounted_bytes", 0) if cached else 0
         if bs is not None:
             fd = bs.breaker("fielddata")
@@ -314,12 +357,6 @@ def device_reader_for(engine, view: SearcherView | None = None,
                     {"hit_count": 0, "miss_count": 0, "evictions": 0})
                 for k in carry:
                     carry[k] += old_stats.get(k, 0)
-        budget = None
-        st = getattr(engine, "settings", None)
-        if st is not None:
-            raw = st.get("index.hbm_budget_bytes", None)
-            if raw is not None:
-                budget = int(raw)
         cached = DeviceReader(view, device=device, hbm_budget_bytes=budget)
         cached._accounted_bytes = new_bytes if bs is not None else 0
         engine._device_reader_cache = cached

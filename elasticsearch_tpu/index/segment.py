@@ -45,7 +45,8 @@ import numpy as np
 
 from elasticsearch_tpu.common.versioning import CURRENT_VERSION
 from elasticsearch_tpu.mapping.mapper import (
-    ParsedDocument, KIND_TEXT, KIND_KEYWORD, KIND_NUMERIC, KIND_VECTOR, KIND_GEO)
+    ParsedDocument, KIND_TEXT, KIND_KEYWORD, KIND_NUMERIC, KIND_VECTOR,
+    KIND_GEO, KIND_SHAPE)
 
 # Position-slot cap per text field (docs longer than this are truncated at
 # index time; reference analog: index.mapping.depth/field limits). Padded to
@@ -93,6 +94,19 @@ class TextFieldColumn:
         """Query-time term lookup; -1 = term absent from this segment."""
         return self.term_index.get(term, -1)
 
+    def ctf(self, tid: int) -> float:
+        """Collection term frequency (Σ tf over docs) for one term id.
+        The per-term vector is built in ONE pass over the column on first
+        use and cached — per-term full-matrix reductions at DFS time cost
+        ~3 s/batch at 1M docs before this cache."""
+        vec = getattr(self, "_ctf_vec", None)
+        if vec is None:
+            vec = np.zeros(self.df.shape[0], np.float64)
+            valid = self.uterms >= 0
+            np.add.at(vec, self.uterms[valid], self.utf[valid])
+            object.__setattr__(self, "_ctf_vec", vec)
+        return float(vec[tid]) if 0 <= tid < vec.shape[0] else 0.0
+
 
 @dataclass
 class KeywordFieldColumn:
@@ -129,6 +143,20 @@ class GeoFieldColumn:
 
 
 @dataclass
+class ShapeFieldColumn:
+    """geo_shape doc values: each doc's shape as a CLOSED vertex ring
+    (point → 1 edge, envelope → 4, polygon → its outer ring; built by
+    utils/geoshape.parse_shape), padded to the column-wide max. Relations
+    run as dense polygon tests on device (ops/geoshape.py) — the
+    TPU-native replacement for the reference's geohash prefix-tree index
+    (core/index/mapper/geo/GeoShapeFieldMapper.java)."""
+    lats: np.ndarray                 # [Np, V] float32, ring closed
+    lons: np.ndarray                 # [Np, V] float32
+    nv: np.ndarray                   # [Np] int32 edge count (0 = none)
+    exists: np.ndarray               # [Np] bool
+
+
+@dataclass
 class NestedBlock:
     """One nested path's child rows for a segment: a full child segment
     (nested objects are docs of their own — ref: ObjectMapper Nested,
@@ -159,6 +187,9 @@ class Segment:
     source_complete: bool = True
     # nested path → child block (mapping "type": "nested")
     nested_blocks: dict[str, NestedBlock] = dc_field(default_factory=dict)
+    # geo_shape columns (vertex rings, ShapeFieldColumn)
+    shape_fields: dict[str, ShapeFieldColumn] = dc_field(
+        default_factory=dict)
 
     def memory_bytes(self) -> int:
         total = 0
@@ -174,6 +205,8 @@ class Segment:
             total += col.vecs.nbytes
         for col in self.geo_fields.values():
             total += col.lat.nbytes + col.lon.nbytes
+        for col in self.shape_fields.values():
+            total += col.lats.nbytes + col.lons.nbytes + col.nv.nbytes
         for blk in self.nested_blocks.values():
             total += blk.segment.memory_bytes() + blk.parent.nbytes
         return total
@@ -268,6 +301,12 @@ class Segment:
             arrays[f"g.{name}.lat"] = c.lat
             arrays[f"g.{name}.lon"] = c.lon
             arrays[f"g.{name}.exists"] = c.exists
+        meta["shape_fields"] = sorted(self.shape_fields)
+        for name, c in self.shape_fields.items():
+            arrays[f"s.{name}.lats"] = c.lats
+            arrays[f"s.{name}.lons"] = c.lons
+            arrays[f"s.{name}.nv"] = c.nv
+            arrays[f"s.{name}.exists"] = c.exists
 
         meta["nested"] = sorted(self.nested_blocks)
         for p, blk in self.nested_blocks.items():
@@ -325,6 +364,12 @@ class Segment:
                                  lon=arrays[f"g.{name}.lon"],
                                  exists=arrays[f"g.{name}.exists"])
             for name in meta["geo_fields"]}
+        shape_fields = {
+            name: ShapeFieldColumn(lats=arrays[f"s.{name}.lats"],
+                                   lons=arrays[f"s.{name}.lons"],
+                                   nv=arrays[f"s.{name}.nv"],
+                                   exists=arrays[f"s.{name}.exists"])
+            for name in meta.get("shape_fields", [])}
         nested_blocks = {
             p: NestedBlock(segment=Segment.read(path / f"nested_{p}"),
                            parent=arrays[f"x.{p}.parent"])
@@ -335,7 +380,8 @@ class Segment:
                        numeric_fields=numeric_fields, vector_fields=vector_fields,
                        geo_fields=geo_fields, version_id=meta["version_id"],
                        source_complete=meta.get("source_complete", True),
-                       nested_blocks=nested_blocks)
+                       nested_blocks=nested_blocks,
+                       shape_fields=shape_fields)
 
 
 class SegmentBuilder:
@@ -376,6 +422,7 @@ class SegmentBuilder:
         numeric_fields: dict[str, NumericFieldColumn] = {}
         vector_fields: dict[str, VectorFieldColumn] = {}
         geo_fields: dict[str, GeoFieldColumn] = {}
+        shape_fields: dict[str, ShapeFieldColumn] = {}
 
         for fname, kind in field_kinds.items():
             if kind == KIND_TEXT:
@@ -388,6 +435,8 @@ class SegmentBuilder:
                 vector_fields[fname] = self._build_vector(fname, n, np_docs)
             elif kind == KIND_GEO:
                 geo_fields[fname] = self._build_geo(fname, n, np_docs)
+            elif kind == KIND_SHAPE:
+                shape_fields[fname] = self._build_shape(fname, n, np_docs)
 
         return Segment(
             seg_id=self.seg_id, num_docs=n, padded_docs=np_docs,
@@ -395,7 +444,7 @@ class SegmentBuilder:
             sources=[d.source for d in self.docs],
             text_fields=text_fields, keyword_fields=keyword_fields,
             numeric_fields=numeric_fields, vector_fields=vector_fields,
-            geo_fields=geo_fields,
+            geo_fields=geo_fields, shape_fields=shape_fields,
             nested_blocks=self._build_nested())
 
     def _build_nested(self) -> dict[str, NestedBlock]:
@@ -535,6 +584,30 @@ class SegmentBuilder:
                 lat[i], lon[i] = pf.geo
                 exists[i] = True
         return GeoFieldColumn(lat=lat, lon=lon, exists=exists)
+
+    def _build_shape(self, fname: str, n: int,
+                     np_docs: int) -> ShapeFieldColumn:
+        rings = []
+        vmax = 2
+        for d in self.docs:
+            pf = self._field(d, fname)
+            ring = pf.shape if pf is not None else None
+            rings.append(ring)
+            if ring is not None:
+                vmax = max(vmax, len(ring[0]))
+        lats = np.zeros((np_docs, vmax), np.float32)
+        lons = np.zeros((np_docs, vmax), np.float32)
+        nv = np.zeros(np_docs, np.int32)
+        exists = np.zeros(np_docs, bool)
+        for i, ring in enumerate(rings):
+            if ring is None:
+                continue
+            rl, ro = ring
+            lats[i, :len(rl)] = rl
+            lons[i, :len(ro)] = ro
+            nv[i] = len(rl) - 1
+            exists[i] = True
+        return ShapeFieldColumn(lats=lats, lons=lons, nv=nv, exists=exists)
 
 
 def merge_segments(seg_id: int, segments: Iterable[Segment],

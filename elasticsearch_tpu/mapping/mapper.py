@@ -39,6 +39,7 @@ KIND_KEYWORD = "keyword"
 KIND_NUMERIC = "numeric"   # long/integer/short/byte/double/float/date/boolean
 KIND_VECTOR = "vector"
 KIND_GEO = "geo"
+KIND_SHAPE = "shape"
 
 NUMERIC_TYPES = {"long", "integer", "short", "byte", "double", "float",
                  "half_float", "date", "boolean", "murmur3"}
@@ -122,6 +123,8 @@ class ParsedField:
     numerics: list[float] = field(default_factory=list)     # KIND_NUMERIC
     vector: np.ndarray | None = None                        # KIND_VECTOR
     geo: tuple[float, float] | None = None                  # KIND_GEO (lat, lon)
+    # KIND_SHAPE: (lats, lons) closed vertex ring (utils/geoshape)
+    shape: tuple[list[float], list[float]] | None = None
 
 
 @dataclass
@@ -180,6 +183,8 @@ class FieldMapper:
                 raise MapperParsingError(f"dense_vector field [{name}] requires dims")
         elif self.type == "geo_point":
             self.kind = KIND_GEO
+        elif self.type == "geo_shape":
+            self.kind = KIND_SHAPE
         else:
             raise MapperParsingError(f"no handler for type [{ftype}] on field [{name}]")
         # Multi-fields: {"fields": {"raw": {"type": "keyword"}}}
@@ -299,6 +304,18 @@ class FieldMapper:
                     f"dense_vector [{self.name}] expects dims [{self.dims}], "
                     f"got shape {arr.shape}")
             pf.vector = arr
+        elif self.kind == KIND_SHAPE:
+            from elasticsearch_tpu.utils.geoshape import parse_shape
+            v = value if isinstance(value, dict) else values[0]
+            if not isinstance(v, dict):
+                raise MapperParsingError(
+                    f"cannot parse geo_shape [{value!r}]")
+            try:
+                pf.shape = parse_shape(v)
+            except Exception as e:
+                raise MapperParsingError(
+                    f"failed to parse geo_shape [{self.name}]: {e}") \
+                    from None
         elif self.kind == KIND_GEO:
             v = values[0]
             if isinstance(v, dict):

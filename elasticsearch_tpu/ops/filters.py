@@ -73,3 +73,33 @@ def geo_bounding_box(lat, lon, exists, top, left, bottom, right):
     in_lon = jnp.where(left <= right, (lon >= left) & (lon <= right),
                        (lon >= left) | (lon <= right))  # dateline crossing
     return exists & in_lat & in_lon
+
+
+def geo_distance_range(lat, lon, exists, qlat, qlon,
+                       gte_m, gt_m, lte_m, lt_m):
+    """Annulus filter (reference: GeoDistanceRangeQueryParser): bound
+    values < 0 mean "unbounded on this side" (host encodes None so)."""
+    r = 6371008.8
+    p1, p2 = jnp.radians(lat), jnp.radians(qlat)
+    dphi = jnp.radians(lat - qlat)
+    dlmb = jnp.radians(lon - qlon)
+    a = jnp.sin(dphi / 2) ** 2 + \
+        jnp.cos(p1) * jnp.cos(p2) * jnp.sin(dlmb / 2) ** 2
+    d = 2 * r * jnp.arcsin(jnp.sqrt(a))
+    ok = exists
+    ok &= (gte_m < 0) | (d >= gte_m)
+    ok &= (gt_m < 0) | (d > gt_m)
+    ok &= (lte_m < 0) | (d <= lte_m)
+    ok &= (lt_m < 0) | (d < lt_m)
+    return ok
+
+
+def geo_polygon(lat, lon, exists, vlats, vlons):
+    """Even-odd ray-casting point-in-polygon (reference:
+    GeoPolygonQueryParser → GeoPolygonQuery). vlats/vlons: [V] f32 vertex
+    ring (closed implicitly; the shared kernel wants an explicit closing
+    vertex)."""
+    from elasticsearch_tpu.ops.geoshape import _points_in_query_ring
+    qlats = jnp.concatenate([vlats, vlats[:1]])
+    qlons = jnp.concatenate([vlons, vlons[:1]])
+    return exists & _points_in_query_ring(lat, lon, qlats, qlons)

@@ -1,0 +1,105 @@
+"""geo_shape relation kernels (ref: core/index/query/GeoShapeQueryParser
+.java; the reference indexes shapes into a geohash prefix tree and runs
+Lucene spatial queries — here shapes are doc-value vertex rings and the
+four relations are exact dense polygon tests, looped over query edges so
+intermediates stay [N, V]).
+
+Doc shapes: ``lats``/``lons`` [N, V] f32 closed rings (vertex nv == vertex
+0), ``nv`` [N] i32 edge counts, ``exists`` [N] bool. Query shape: closed
+ring constants [E+1]. All tests treat boundary contact as intersection
+(inclusive orientation ≤ 0), matching the reference's default
+``intersects`` looseness at cell resolution.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _orient(ax, ay, bx, by, cx, cy):
+    return (bx - ax) * (cy - ay) - (by - ay) * (cx - ax)
+
+
+def _doc_edges(dlats, dlons, dnv):
+    a_lat, a_lon = dlats[:, :-1], dlons[:, :-1]
+    b_lat, b_lon = dlats[:, 1:], dlons[:, 1:]
+    valid = jnp.arange(dlats.shape[1] - 1)[None, :] < dnv[:, None]
+    return a_lat, a_lon, b_lat, b_lon, valid
+
+
+def _edge_cross_any(dlats, dlons, dnv, qlats, qlons):
+    """[N] — any doc edge intersects any query edge (segment–segment
+    orientation test, inclusive of collinear touch)."""
+    a_lat, a_lon, b_lat, b_lon, valid = _doc_edges(dlats, dlons, dnv)
+    e = qlats.shape[0] - 1
+
+    def body(i, acc):
+        c_lat, c_lon = qlats[i], qlons[i]
+        d_lat, d_lon = qlats[i + 1], qlons[i + 1]
+        o1 = _orient(a_lon, a_lat, b_lon, b_lat, c_lon, c_lat)
+        o2 = _orient(a_lon, a_lat, b_lon, b_lat, d_lon, d_lat)
+        o3 = _orient(c_lon, c_lat, d_lon, d_lat, a_lon, a_lat)
+        o4 = _orient(c_lon, c_lat, d_lon, d_lat, b_lon, b_lat)
+        hit = (o1 * o2 <= 0) & (o3 * o4 <= 0) & valid
+        return acc | hit.any(axis=1)
+
+    return jax.lax.fori_loop(0, e, body,
+                             jnp.zeros(dlats.shape[0], bool))
+
+
+def _points_in_query_ring(plats, plons, qlats, qlons):
+    """Even-odd ray cast of arbitrary-shape point arrays against the
+    query ring → bool array of plats' shape."""
+    e = qlats.shape[0] - 1
+
+    def body(i, parity):
+        yi, xi = qlats[i], qlons[i]
+        yj, xj = qlats[i + 1], qlons[i + 1]
+        crosses = (yi > plats) != (yj > plats)
+        xcross = (xj - xi) * (plats - yi) / jnp.where(
+            yj - yi == 0, 1e-30, yj - yi) + xi
+        return parity ^ (crosses & (plons < xcross))
+
+    return jax.lax.fori_loop(0, e, body, jnp.zeros(plats.shape, bool))
+
+
+def _query_point_in_doc_rings(qlat, qlon, dlats, dlons, dnv):
+    """[N] — the query ring's first vertex inside each doc's ring."""
+    a_lat, a_lon, b_lat, b_lon, valid = _doc_edges(dlats, dlons, dnv)
+    crosses = ((a_lat > qlat) != (b_lat > qlat)) & valid
+    xcross = (b_lon - a_lon) * (qlat - a_lat) / jnp.where(
+        b_lat - a_lat == 0, 1e-30, b_lat - a_lat) + a_lon
+    return (crosses & (qlon < xcross)).sum(axis=1) % 2 == 1
+
+
+def shape_relation(dlats, dlons, dnv, exists, qlats, qlons,
+                   relation: str):
+    """→ [N] bool mask for intersects / disjoint / within / contains."""
+    cross = _edge_cross_any(dlats, dlons, dnv, qlats, qlons)
+    doc0_in_q = _points_in_query_ring(dlats[:, 0], dlons[:, 0],
+                                      qlats, qlons)
+    q0_in_doc = _query_point_in_doc_rings(qlats[0], qlons[0],
+                                          dlats, dlons, dnv)
+    inter = cross | doc0_in_q | q0_in_doc
+    if relation == "intersects":
+        return exists & inter
+    if relation == "disjoint":
+        return exists & ~inter
+    if relation == "within":
+        # every doc vertex inside the query ring, no boundary crossing
+        vparity = _points_in_query_ring(dlats, dlons, qlats, qlons)
+        vvalid = jnp.arange(dlats.shape[1])[None, :] <= dnv[:, None]
+        all_in = jnp.where(vvalid, vparity, True).all(axis=1)
+        return exists & all_in & ~cross
+    if relation == "contains":
+        # every query vertex inside the doc ring, no boundary crossing
+        e = qlats.shape[0] - 1
+
+        def body(i, acc):
+            return acc & _query_point_in_doc_rings(
+                qlats[i], qlons[i], dlats, dlons, dnv)
+        all_in = jax.lax.fori_loop(0, e, body,
+                                   jnp.ones(dlats.shape[0], bool))
+        return exists & all_in & ~cross
+    raise ValueError(f"unknown geo_shape relation [{relation}]")

@@ -1,0 +1,166 @@
+"""Span algebra over the position-indexed token matrix.
+
+Lucene's span queries (core/index/query/Span*QueryParser.java → Lucene
+``spans`` package) enumerate (start, end) position intervals per doc and
+combine them (or / not / first / near / containing / within). The
+TPU-native representation here is the **min-end map**: for every start
+position ``p`` of a doc, ``ends[doc, p]`` holds the SMALLEST end of a span
+starting at ``p`` (``INF`` when no span starts there). All combinators are
+dense [N, L] array ops — no per-doc iteration.
+
+Exactness: unit-width leaves (span_term, span_multi expansions and
+span_or over them) make every combinator exact. Clauses that produce
+multi-width span sets (a sloppy span_near nested inside another
+combinator) are represented by their minimal span per start — a
+documented approximation (the non-minimal alternatives are dropped, like
+keeping only the first span per start position).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+INF = jnp.int32(1 << 30)
+
+
+def term_ends(tokens, tid):
+    """[N, L] token matrix + scalar term id → min-end map (unit spans)."""
+    pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+    return jnp.where((tokens == tid) & (tid >= 0), pos + 1, INF)
+
+
+def term_set_ends(tokens, tids):
+    """Unit spans at positions whose token is in ``tids`` ([T], -1 pad) —
+    the span_multi rewrite (SpanMultiTermQueryWrapper)."""
+    hit = (tokens[:, :, None] == tids[None, None, :]) & \
+        (tids[None, None, :] >= 0)
+    pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+    return jnp.where(hit.any(axis=2), pos + 1, INF)
+
+
+def pad_ends(ends, L: int):
+    """Pad the position axis to a common L (no spans start in padding)."""
+    if ends.shape[1] == L:
+        return ends
+    return jnp.pad(ends, ((0, 0), (0, L - ends.shape[1])),
+                   constant_values=INF)
+
+
+def or_ends(ends_list):
+    """Union of span sets — min of min-ends per start (SpanOrQuery)."""
+    return functools.reduce(jnp.minimum, ends_list)
+
+
+def first_ends(ends, end: int):
+    """Spans ending at position ≤ ``end`` (SpanFirstQuery)."""
+    return jnp.where(ends <= jnp.int32(end), ends, INF)
+
+
+def _first_start_from(ends):
+    """F[q] = earliest start ≥ q with a span (else INF) — suffix min of
+    start positions."""
+    pos = jnp.arange(ends.shape[1], dtype=jnp.int32)[None, :]
+    idx = jnp.where(ends < INF, pos, INF)
+    return jax.lax.cummin(idx, axis=1, reverse=True)
+
+
+def near_ordered_ends(ends_list, slop: int):
+    """Ordered near over span clauses: chains each clause's EARLIEST
+    start ≥ the previous clause's end (greedy — exact for unit-width
+    clauses), total inter-span gap ≤ slop (SpanNearQuery in_order)."""
+    L = ends_list[0].shape[1]
+    cur_end = ends_list[0]
+    valid = cur_end < INF
+    total_gap = jnp.zeros_like(cur_end)
+    for ek in ends_list[1:]:
+        fk = _first_start_from(ek)
+        in_range = valid & (cur_end < L)
+        q = jnp.clip(cur_end, 0, L - 1)
+        start_k = jnp.where(in_range, jnp.take_along_axis(fk, q, axis=1),
+                            INF)
+        end_k = jnp.take_along_axis(
+            ek, jnp.clip(start_k, 0, L - 1), axis=1)
+        valid = in_range & (start_k < INF)
+        total_gap = total_gap + jnp.where(valid, start_k - cur_end, 0)
+        cur_end = jnp.where(valid, end_k, INF)
+    return jnp.where(valid & (total_gap <= jnp.int32(slop)), cur_end, INF)
+
+
+def coverage(ends):
+    """[N, L] bool — positions covered by ANY span of the set (interval
+    scatter: +1 at starts, −1 at ends, prefix sum > 0)."""
+    n, L = ends.shape
+    has = (ends < INF).astype(jnp.int32)
+    delta = jnp.zeros((n, L + 1), jnp.int32).at[:, :L].add(has)
+    end_idx = jnp.clip(jnp.where(ends < INF, ends, 0), 0, L)
+    rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+    delta = delta.at[rows, end_idx].add(-has)
+    return jnp.cumsum(delta, axis=1)[:, :L] > 0
+
+
+def not_ends(inc, exc, pre: int, post: int):
+    """Include spans whose window [start−pre, end+post) does not touch any
+    exclude span (SpanNotQuery)."""
+    n, L = inc.shape
+    cov = coverage(exc).astype(jnp.int32)
+    prefix = jnp.concatenate(
+        [jnp.zeros((n, 1), jnp.int32), jnp.cumsum(cov, axis=1)], axis=1)
+    pos = jnp.arange(L, dtype=jnp.int32)
+    w0 = jnp.clip(pos - pre, 0, L)                       # [L]
+    w1 = jnp.clip(jnp.where(inc < INF, inc, 0) + post, 0, L)   # [N, L]
+    covered = (jnp.take_along_axis(prefix, w1, axis=1)
+               - jnp.take(prefix, w0, axis=1)) > 0
+    return jnp.where((inc < INF) & ~covered, inc, INF)
+
+
+def _shift_left_dyn(a, d, fill):
+    """a[:, p] → a[:, p+d] for traced d (out-of-range = fill)."""
+    L = a.shape[1]
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    return jnp.where(pos < L - d, jnp.roll(a, -d, axis=1), fill)
+
+
+def _shift_right_dyn(a, d, fill):
+    """a[:, p] → a[:, p−d] for traced d (out-of-range = fill)."""
+    pos = jnp.arange(a.shape[1], dtype=jnp.int32)[None, :]
+    return jnp.where(pos >= d, jnp.roll(a, d, axis=1), fill)
+
+
+def containing_ends(big, little):
+    """Spans of ``big`` containing at least one ``little`` span
+    (SpanContainingQuery): big [p, e) contains little [p+d, e') when
+    p+d < e and e' ≤ e."""
+    L = big.shape[1]
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+
+    def body(d, acc):
+        lsh = _shift_left_dyn(little, d, INF)
+        return acc | ((pos + d < big) & (lsh <= big) & (lsh < INF))
+
+    acc = jax.lax.fori_loop(0, L, body,
+                            jnp.zeros(big.shape, bool))
+    return jnp.where(acc & (big < INF), big, INF)
+
+
+def within_ends(little, big):
+    """Spans of ``little`` lying inside some ``big`` span
+    (SpanWithinQuery): little at q with end l is inside big [q−d, e) when
+    e ≥ l (start q−d ≤ q holds by construction)."""
+    L = little.shape[1]
+
+    def body(d, acc):
+        bsh = _shift_right_dyn(big, d, INF)
+        return acc | ((bsh < INF) & (bsh >= little) & (little < INF))
+
+    acc = jax.lax.fori_loop(0, L, body,
+                            jnp.zeros(little.shape, bool))
+    return jnp.where(acc, little, INF)
+
+
+def span_freq(ends):
+    """Span frequency per doc = number of starts with a span (each start
+    contributes its minimal span once)."""
+    return (ends < INF).sum(axis=1).astype(jnp.float32)

@@ -152,10 +152,11 @@ class MeshEngineSearcher:
             for name, c in seg.keyword_fields.items():
                 keyword[name] = max(keyword.get(name, 0), c.ords.shape[1])
             numeric.update(seg.numeric_fields)
-            if seg.vector_fields or seg.geo_fields or seg.nested_blocks:
+            if seg.vector_fields or seg.geo_fields or seg.nested_blocks \
+                    or seg.shape_fields:
                 raise QueryParsingError(
-                    "mesh engine plane does not pack vector/geo/nested "
-                    "fields yet — use the RPC fan-out path")
+                    "mesh engine plane does not pack vector/geo/shape/"
+                    "nested fields yet — use the RPC fan-out path")
         return _SlotLayout(np_docs=max(np_docs, 8), text=text,
                            keyword=keyword, numeric=sorted(numeric))
 

@@ -128,18 +128,16 @@ def shard_dfs(reader, mapper_service, query: q.Query) -> dict:
     df = {f"{f}{_SEP}{t}": reader.df(f, t) for f, t in terms}
     # collection term frequencies ride along for LM-family similarities
     # (P(t|C) must be GLOBAL under dfs_query_then_fetch, like idf)
-    import numpy as _np
     ctf = {}
     for f, t in terms:
-        total = 0
+        total = 0.0
         for seg in reader.segments:
             col = seg.seg.text_fields.get(f)
             if col is None:
                 continue
             tid = col.tid(t)
             if tid >= 0:
-                total += float(_np.asarray(
-                    col.utf * (col.uterms == tid)).sum())
+                total += col.ctf(tid)
         ctf[f"{f}{_SEP}{t}"] = total
     fields = {}
     for f in {f for f, _ in terms}:

@@ -126,6 +126,10 @@ class ExecutionContext:
     # instead of the shard-local reader, so every shard scores with
     # identical statistics.
     dfs_stats: dict | None = None
+    # The shard's index name — resolves the `indices` query per shard
+    # (IndicesQueryParser picks query vs no_match_query by index). None →
+    # standalone searchers match the listed branch (single-index tests).
+    index_name: str | None = None
 
 
 def _edit_distance_le(a: str, b: str, k: int) -> bool:
@@ -219,8 +223,7 @@ class SegmentResolver:
             total += int(col.total_tokens)
             t2 = col.tid(term)
             if t2 >= 0:
-                ctf += float(np.asarray(
-                    col.utf * (col.uterms == t2)).sum())
+                ctf += col.ctf(t2)
         frac = ctf / total if total else 0.0
         cache[key] = frac
         return frac
@@ -993,6 +996,11 @@ class SegmentResolver:
                                          boost=query.boost))
 
     def _res_SpanNearQuery(self, query: q.SpanNearQuery) -> Emit:
+        if not all(type(c).__name__ == "SpanTermQuery"
+                   for c in query.clauses):
+            # composite clauses (or/not/multi/masking/nested near) run
+            # through the span-algebra min-end framework (ordered only)
+            return self._span_score_emit(query, query.boost)
         field = query.clauses[0].field
         col = self.seg.text.get(field)
         if col is None:
@@ -1034,6 +1042,228 @@ class SegmentResolver:
                 em.get(r_avgdl))
             return scores * em.get(r_boost), mask
         return emit
+
+    # ---- span algebra (ops/spans.py min-end maps) -----------------------
+
+    def _span_ends(self, query):
+        """Resolve a span query to its min-end map.
+
+        → (emit_ends(em) → [N, L] i32, sum_idf, field) or None when a
+        required field/term is absent from the segment (no spans). The
+        reported ``field`` supplies doc_len/avgdl for scoring (the masked
+        field for field_masking_span, per FieldMaskingSpanQuery docs).
+        """
+        from elasticsearch_tpu.ops import spans as span_ops
+        t = type(query).__name__
+        self.sig("span", t)
+
+        def leaf(field, tids, idfs, multi: bool):
+            col = self.seg.text.get(field)
+            if col is None or not tids:
+                return None
+            if not col.column.has_positions:
+                raise QueryParsingError(
+                    f"field [{field}] was not indexed with positions — "
+                    f"span queries need index_options [positions]")
+            self.ct.positions_needed.add(field)
+            # span_multi expansions weight like ONE term (mean idf of the
+            # rewritten set); explicit clauses sum like SpanWeight stats
+            sum_idf = (sum(idfs) / len(idfs)) if multi else sum(idfs)
+            if len(tids) == 1:
+                r_tid = self.c(tids[0], np.int32)
+                self.sig("span-term", field)
+                return (lambda em: span_ops.term_ends(
+                    em.seg.text[field].tokens, em.get(r_tid)),
+                    sum_idf, field)
+            r_tids = self.c(_pad_pow2(tids, -1), np.int32)
+            self.sig("span-terms", field, len(_pad_pow2(tids, -1)))
+            return (lambda em: span_ops.term_set_ends(
+                em.seg.text[field].tokens, jnp.asarray(em.get(r_tids))),
+                sum_idf, field)
+
+        if t == "SpanTermQuery":
+            resolved = self._match_terms(query.field, [query.value])
+            if resolved is None:
+                return None
+            tids, idfs = resolved
+            return leaf(query.field, tids, idfs, multi=False)
+
+        if t == "SpanMultiQuery":
+            inner = query.match
+            it = type(inner).__name__
+            field = getattr(inner, "field", "")
+            col = self.seg.text.get(field)
+            if col is None:
+                return None
+            if it == "PrefixQuery":
+                val = inner.value
+                pred = lambda term: term.startswith(val)   # noqa: E731
+            elif it == "WildcardQuery":
+                rx = re.compile(fnmatch.translate(inner.pattern))
+                pred = lambda term: rx.match(term) is not None  # noqa: E731
+            elif it == "RegexpQuery":
+                rx = re.compile(inner.pattern)
+                pred = \
+                    lambda term: rx.fullmatch(term) is not None  # noqa: E731
+            elif it == "FuzzyQuery":
+                v = inner.value
+                fz = inner.fuzziness
+                kmax = (0 if len(v) < 3 else 1 if len(v) < 6 else 2) \
+                    if fz == "AUTO" else int(fz)
+                pred = \
+                    lambda term: _edit_distance_le(term, v, kmax)  # noqa: E731
+            else:
+                raise QueryParsingError(
+                    f"[span_multi] does not support inner query [{it}]")
+            tids = [i for i, term in enumerate(col.column.terms)
+                    if pred(term)]
+            if not tids:
+                return None
+            idfs = []
+            for tid in tids:
+                df, doc_count = self._term_stats(
+                    field, col.column.terms[tid])
+                idfs.append(bm25_idf(max(df, 1), doc_count))
+            return leaf(field, tids, idfs, multi=True)
+
+        if t == "FieldMaskingSpanQuery":
+            plan = self._span_ends(query.query)
+            if plan is None:
+                return None
+            if self.seg.text.get(query.field) is None:
+                return None
+            emit_e, sum_idf, _inner_field = plan
+            self.sig("span-mask", query.field)
+            return emit_e, sum_idf, query.field
+
+        if t == "SpanOrQuery":
+            plans = [self._span_ends(c) for c in query.clauses]
+            plans = [p for p in plans if p is not None]
+            if not plans:
+                return None
+            sum_idf = sum(p[1] for p in plans)
+            field = plans[0][2]
+            emits = [p[0] for p in plans]
+
+            def emit(em):
+                L = max(em.seg.text[p[2]].tokens.shape[1] for p in plans)
+                return span_ops.or_ends([
+                    span_ops.pad_ends(e(em), L) for e in emits])
+            return emit, sum_idf, field
+
+        if t == "SpanNearQuery":
+            if not query.in_order:
+                raise QueryParsingError(
+                    "unordered span_near cannot nest inside other span "
+                    "queries (its span set is not single-interval)")
+            plans = [self._span_ends(c) for c in query.clauses]
+            if any(p is None for p in plans) or not plans:
+                return None
+            sum_idf = sum(p[1] for p in plans)
+            field = plans[0][2]
+            slop = int(query.slop)
+            self.sig("span-near-ends", len(plans), slop)
+            emits = [p[0] for p in plans]
+
+            def emit(em):
+                L = max(em.seg.text[p[2]].tokens.shape[1] for p in plans)
+                return span_ops.near_ordered_ends(
+                    [span_ops.pad_ends(e(em), L) for e in emits], slop)
+            return emit, sum_idf, field
+
+        if t == "SpanNotQuery":
+            inc = self._span_ends(query.include)
+            if inc is None:
+                return None
+            exc = self._span_ends(query.exclude)
+            if exc is None:
+                return inc
+            pre, post = int(query.pre), int(query.post)
+            self.sig("span-not", pre, post)
+            inc_e, sum_idf, field = inc
+            exc_e = exc[0]
+            exc_field = exc[2]
+
+            def emit(em):
+                L = max(em.seg.text[field].tokens.shape[1],
+                        em.seg.text[exc_field].tokens.shape[1])
+                return span_ops.not_ends(
+                    span_ops.pad_ends(inc_e(em), L),
+                    span_ops.pad_ends(exc_e(em), L), pre, post)
+            return emit, sum_idf, field
+
+        if t == "SpanFirstQuery":
+            plan = self._span_ends(query.match)
+            if plan is None:
+                return None
+            end = int(query.end)
+            self.sig("span-first", end)
+            inner_e, sum_idf, field = plan
+            return (lambda em: span_ops.first_ends(inner_e(em), end),
+                    sum_idf, field)
+
+        if t in ("SpanContainingQuery", "SpanWithinQuery"):
+            big = self._span_ends(query.big)
+            little = self._span_ends(query.little)
+            if big is None or little is None:
+                return None
+            big_e, big_idf, big_f = big
+            lit_e, lit_idf, lit_f = little
+            containing = t == "SpanContainingQuery"
+
+            def emit(em):
+                L = max(em.seg.text[big_f].tokens.shape[1],
+                        em.seg.text[lit_f].tokens.shape[1])
+                b = span_ops.pad_ends(big_e(em), L)
+                li = span_ops.pad_ends(lit_e(em), L)
+                return span_ops.containing_ends(b, li) if containing \
+                    else span_ops.within_ends(li, b)
+            return ((emit, big_idf, big_f) if containing
+                    else (emit, lit_idf, lit_f))
+
+        raise QueryParsingError(f"[{t}] is not a span query")
+
+    def _span_score_emit(self, query, boost: float) -> Emit:
+        """Top-level span query → scored emit: freq = spans per doc,
+        BM25 over (freq, Σ idf) like the span_near scorer."""
+        from elasticsearch_tpu.ops import spans as span_ops
+        plan = self._span_ends(query)
+        if plan is None:
+            return self._zeros()
+        emit_e, sum_idf, field = plan
+        r_sum_idf = self.c(sum_idf, np.float32)
+        r_avgdl = self.c(self._avgdl(field), np.float32)
+        r_boost = self.c(boost, np.float32)
+        p = self.ctx.bm25
+
+        def emit(em):
+            freq = span_ops.span_freq(emit_e(em))
+            scores, mask = phrase_ops.freq_score(
+                freq, em.seg.text[field].doc_len, em.get(r_sum_idf),
+                p.k1, p.b, em.get(r_avgdl))
+            return scores * em.get(r_boost), mask
+        return emit
+
+    def _res_SpanOrQuery(self, query: q.SpanOrQuery) -> Emit:
+        return self._span_score_emit(query, query.boost)
+
+    def _res_SpanNotQuery(self, query: q.SpanNotQuery) -> Emit:
+        return self._span_score_emit(query, query.boost)
+
+    def _res_SpanFirstQuery(self, query: q.SpanFirstQuery) -> Emit:
+        return self._span_score_emit(query, query.boost)
+
+    def _res_SpanContainingQuery(self, query) -> Emit:
+        return self._span_score_emit(query, query.boost)
+
+    def _res_SpanWithinQuery(self, query) -> Emit:
+        return self._span_score_emit(query, query.boost)
+
+    def _res_SpanMultiQuery(self, query: q.SpanMultiQuery) -> Emit:
+        return self._span_score_emit(query, query.boost)
+
+    def _res_FieldMaskingSpanQuery(self, query) -> Emit:
+        return self._span_score_emit(query, query.boost)
 
     def _res_MoreLikeThisQuery(self, query: q.MoreLikeThisQuery) -> Emit:
         fields = query.fields or sorted(self.seg.text)
@@ -1426,6 +1656,98 @@ class SegmentResolver:
                 em.get(r_top), em.get(r_left),
                 em.get(r_bottom), em.get(r_right)),
             query.boost)
+
+    def _res_GeoPolygonQuery(self, query: q.GeoPolygonQuery) -> Emit:
+        field = query.field
+        if self.seg.geo.get(field) is None:
+            return self._zeros()
+        self.sig("geo-poly", len(query.lats))
+        r_lats = self.c(np.asarray(query.lats, np.float32), np.float32)
+        r_lons = self.c(np.asarray(query.lons, np.float32), np.float32)
+        return self._constant_mask_emit(
+            lambda em: filter_ops.geo_polygon(
+                em.seg.geo[field].lat, em.seg.geo[field].lon,
+                em.seg.geo[field].exists,
+                jnp.asarray(em.get(r_lats)), jnp.asarray(em.get(r_lons))),
+            query.boost)
+
+    def _res_GeoDistanceRangeQuery(self,
+                                   query: q.GeoDistanceRangeQuery) -> Emit:
+        field = query.field
+        if self.seg.geo.get(field) is None:
+            return self._zeros()
+        # None bounds encode as -1 (the op treats negatives as unbounded)
+        enc = [(-1.0 if v is None else float(v))
+               for v in (query.gte_m, query.gt_m, query.lte_m, query.lt_m)]
+        refs = [self.c(v, np.float32) for v in enc]
+        r_lat = self.c(query.lat, np.float32)
+        r_lon = self.c(query.lon, np.float32)
+        return self._constant_mask_emit(
+            lambda em: filter_ops.geo_distance_range(
+                em.seg.geo[field].lat, em.seg.geo[field].lon,
+                em.seg.geo[field].exists, em.get(r_lat), em.get(r_lon),
+                *(em.get(r) for r in refs)),
+            query.boost)
+
+    def _res_GeohashCellQuery(self, query: q.GeohashCellQuery) -> Emit:
+        from elasticsearch_tpu.utils.geohash import (
+            geohash_decode_bbox, geohash_neighbors)
+        field = query.field
+        if self.seg.geo.get(field) is None:
+            return self._zeros()
+        cells = [query.geohash]
+        if query.neighbors:
+            cells += geohash_neighbors(query.geohash)
+        self.sig("geohash-cell", len(cells))
+        boxes = []
+        for gh in cells:
+            lat_lo, lat_hi, lon_lo, lon_hi = geohash_decode_bbox(gh)
+            boxes.append(tuple(self.c(v, np.float32)
+                               for v in (lat_hi, lon_lo, lat_lo, lon_hi)))
+
+        def mask_emit(em):
+            g = em.seg.geo[field]
+            out = None
+            for top, left, bottom, right in boxes:
+                m = filter_ops.geo_bounding_box(
+                    g.lat, g.lon, g.exists, em.get(top), em.get(left),
+                    em.get(bottom), em.get(right))
+                out = m if out is None else out | m
+            return out
+        return self._constant_mask_emit(mask_emit, query.boost)
+
+    def _res_GeoShapeQuery(self, query: q.GeoShapeQuery) -> Emit:
+        from elasticsearch_tpu.ops import geoshape as shape_ops
+        from elasticsearch_tpu.utils.geoshape import parse_shape
+        field = query.field
+        if self.seg.shape.get(field) is None:
+            return self._zeros()
+        qlats, qlons = parse_shape(query.shape)
+        relation = query.relation
+        if relation not in ("intersects", "disjoint", "within", "contains"):
+            raise QueryParsingError(
+                f"unknown geo_shape relation [{relation}]")
+        self.sig("geo-shape", relation, len(qlats))
+        r_lats = self.c(np.asarray(qlats, np.float32), np.float32)
+        r_lons = self.c(np.asarray(qlons, np.float32), np.float32)
+        return self._constant_mask_emit(
+            lambda em: shape_ops.shape_relation(
+                em.seg.shape[field].lats, em.seg.shape[field].lons,
+                em.seg.shape[field].nv, em.seg.shape[field].exists,
+                jnp.asarray(em.get(r_lats)), jnp.asarray(em.get(r_lons)),
+                relation),
+            query.boost)
+
+    def _res_IndicesQuery(self, query: q.IndicesQuery) -> Emit:
+        name = self.ctx.index_name
+        # per-shard branch pick (IndicesQueryParser): a standalone
+        # searcher with no index name takes the match branch
+        if name is None or name in query.indices:
+            picked = query.query or q.MatchAllQuery()
+        else:
+            picked = query.no_match_query or q.MatchAllQuery()
+        self.sig("indices", name in query.indices if name else True)
+        return self.resolve(picked)
 
 
 class SegmentExecutor:

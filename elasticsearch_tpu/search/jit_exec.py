@@ -81,13 +81,14 @@ def clear_cache() -> None:
 # Segment flatten/rebuild (the traced-input pytree)
 # ---------------------------------------------------------------------------
 
-_KINDS = ("text", "keyword", "numeric", "vector", "geo")
+_KINDS = ("text", "keyword", "numeric", "vector", "geo", "shape")
 _ARRAYS = {
     "text": ("tokens", "uterms", "utf", "doc_len"),
     "keyword": ("ords",),
     "numeric": ("hi", "lo", "exists"),
     "vector": ("vecs", "exists"),
     "geo": ("lat", "lon", "exists"),
+    "shape": ("lats", "lons", "nv", "exists"),
 }
 
 

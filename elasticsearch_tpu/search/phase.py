@@ -195,7 +195,8 @@ class ShardSearcher:
         self._doc_slot = doc_slot & 0x7FF
         self.ctx = ExecutionContext(reader=reader,
                                     mapper_service=mapper_service,
-                                    dfs_stats=dfs_stats)
+                                    dfs_stats=dfs_stats,
+                                    index_name=index_name or None)
 
     # -- mask/scores over every segment --------------------------------------
 

@@ -12,7 +12,13 @@ wildcard, regexp, fuzzy, ids, bool (BoolQueryParser), constant_score,
 function_score (FunctionScoreQueryParser: field_value_factor, weight,
 random_score, script_score, gauss/exp/linear decay), script_score, knn
 (no 2015 equivalent — dense-vector path, BASELINE config 4), geo_distance,
-geo_bounding_box, simple_query_string/query_string (reduced grammar).
+geo_bounding_box, simple_query_string/query_string (reduced grammar),
+dis_max, boosting, common, template, has_child/has_parent, nested, type,
+more_like_this, missing, the full span algebra (span_term/near/or/not/
+first/containing/within/multi + field_masking_span — min-end interval
+maps, ops/spans.py), geo_polygon, geo_distance_range, geohash_cell,
+geo_shape (vertex-ring relations, ops/geoshape.py), indices, and the 2.x
+compat wrappers (not, and, or, filtered, limit, wrapper).
 """
 
 from __future__ import annotations
@@ -184,6 +190,64 @@ class SpanNearQuery(Query):
 
 
 @dataclass
+class SpanOrQuery(Query):
+    """ref: core/index/query/SpanOrQueryParser.java — union of clause
+    span sets."""
+    clauses: list[Query] = dc_field(default_factory=list)
+
+
+@dataclass
+class SpanNotQuery(Query):
+    """ref: core/index/query/SpanNotQueryParser.java — include spans not
+    overlapping any exclude span (pre/post widen the kill window)."""
+    include: Query | None = None
+    exclude: Query | None = None
+    pre: int = 0
+    post: int = 0
+
+
+@dataclass
+class SpanFirstQuery(Query):
+    """ref: core/index/query/SpanFirstQueryParser.java — match spans
+    ending at position ≤ ``end``."""
+    match: Query | None = None
+    end: int = 0
+
+
+@dataclass
+class SpanContainingQuery(Query):
+    """ref: core/index/query/SpanContainingQueryParser.java — spans of
+    ``big`` that contain a ``little`` span."""
+    big: Query | None = None
+    little: Query | None = None
+
+
+@dataclass
+class SpanWithinQuery(Query):
+    """ref: core/index/query/SpanWithinQueryParser.java — spans of
+    ``little`` that lie inside a ``big`` span."""
+    big: Query | None = None
+    little: Query | None = None
+
+
+@dataclass
+class SpanMultiQuery(Query):
+    """ref: core/index/query/SpanMultiTermQueryParser.java — a multi-term
+    query (prefix/wildcard/regexp/fuzzy) as a span: expands against the
+    segment term dictionary into a position-set leaf."""
+    match: Query | None = None
+
+
+@dataclass
+class FieldMaskingSpanQuery(Query):
+    """ref: core/index/query/FieldMaskingSpanQueryParser.java — report the
+    inner span under another field name so cross-field span composition
+    is allowed (positions evaluated on the INNER field's token matrix)."""
+    query: Query | None = None
+    field: str = ""
+
+
+@dataclass
 class HasChildQuery(Query):
     """ref: core/index/query/HasChildQueryParser.java — parents whose
     children (docs of `type`, joined via the _parent metadata column)
@@ -296,6 +360,55 @@ class GeoBoundingBoxQuery(Query):
     right: float = 0.0
 
 
+@dataclass
+class GeoPolygonQuery(Query):
+    """ref: core/index/query/GeoPolygonQueryParser.java — point-in-polygon
+    via even-odd ray casting over the vertex ring."""
+    field: str = ""
+    lats: list[float] = dc_field(default_factory=list)
+    lons: list[float] = dc_field(default_factory=list)
+
+
+@dataclass
+class GeoDistanceRangeQuery(Query):
+    """ref: core/index/query/GeoDistanceRangeQueryParser.java — annulus:
+    from ≤ distance(point, origin) ≤ to."""
+    field: str = ""
+    lat: float = 0.0
+    lon: float = 0.0
+    gte_m: float | None = None
+    gt_m: float | None = None
+    lte_m: float | None = None
+    lt_m: float | None = None
+
+
+@dataclass
+class GeohashCellQuery(Query):
+    """ref: core/index/query/GeohashCellQuery.java — docs whose point
+    falls in a geohash cell (plus the 8 neighbors when asked)."""
+    field: str = ""
+    geohash: str = ""
+    neighbors: bool = False
+
+
+@dataclass
+class GeoShapeQuery(Query):
+    """ref: core/index/query/GeoShapeQueryParser.java — spatial relation
+    between each doc's indexed shape and the query shape."""
+    field: str = ""
+    shape: dict = dc_field(default_factory=dict)   # GeoJSON-ish body
+    relation: str = "intersects"   # intersects | disjoint | within | contains
+
+
+@dataclass
+class IndicesQuery(Query):
+    """ref: core/index/query/IndicesQueryParser.java — per-shard: run
+    ``query`` when the shard's index is listed, else ``no_match_query``."""
+    indices: list[str] = dc_field(default_factory=list)
+    query: Query | None = None
+    no_match_query: Query | None = None   # None = match_all (the default)
+
+
 # ---------------------------------------------------------------------------
 # parsing
 # ---------------------------------------------------------------------------
@@ -322,6 +435,37 @@ def _field_body(body: dict, qtype: str) -> tuple[str, Any]:
 
 def _parse_msm(v) -> int | str | None:
     return v
+
+
+def span_effective_fields(node: Query | None) -> set[str]:
+    """The field(s) a span query's positions come from, AFTER masking:
+    field_masking_span reports its mask field (that is its purpose —
+    FieldMaskingSpanQueryParser), so validation that all clauses agree on
+    one field treats masked clauses as the masked name."""
+    if node is None:
+        return set()
+    t = type(node).__name__
+    if t == "SpanTermQuery":
+        return {node.field}
+    if t == "FieldMaskingSpanQuery":
+        return {node.field}
+    if t == "SpanMultiQuery":
+        f = getattr(node.match, "field", None)
+        return {f} if f else set()
+    if t in ("SpanOrQuery", "SpanNearQuery"):
+        out: set[str] = set()
+        for c in node.clauses:
+            out |= span_effective_fields(c)
+        return out
+    if t == "SpanNotQuery":
+        return span_effective_fields(node.include) | \
+            span_effective_fields(node.exclude)
+    if t == "SpanFirstQuery":
+        return span_effective_fields(node.match)
+    if t in ("SpanContainingQuery", "SpanWithinQuery"):
+        return span_effective_fields(node.big) | \
+            span_effective_fields(node.little)
+    return set()
 
 
 # Plugin-registered query parsers ({name: fn(body) -> Query}) — the SPI seam
@@ -511,14 +655,21 @@ def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query
         clauses = [parse_query(c) for c in qbody.get("clauses", [])]
         if not clauses:
             raise QueryParsingError("[span_near] requires clauses")
+        span_types = (SpanTermQuery, SpanNearQuery, SpanOrQuery,
+                      SpanNotQuery, SpanFirstQuery, SpanContainingQuery,
+                      SpanWithinQuery, SpanMultiQuery,
+                      FieldMaskingSpanQuery)
         for c in clauses:
-            if not isinstance(c, SpanTermQuery):
+            if not isinstance(c, span_types):
                 raise QueryParsingError(
-                    "[span_near] clauses must be span_term queries")
-        fields = {c.field for c in clauses}
-        if len(fields) != 1:
+                    "[span_near] clauses must be span queries")
+        fields = set()
+        for c in clauses:
+            fields |= span_effective_fields(c)
+        if len(fields) > 1:
             raise QueryParsingError(
-                "[span_near] clauses must target one field")
+                "[span_near] clauses must target one field "
+                "(use field_masking_span to combine fields)")
         return SpanNearQuery(clauses=clauses,
                              slop=int(qbody.get("slop", 0)),
                              in_order=bool(qbody.get("in_order", True)),
@@ -702,6 +853,192 @@ def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query
     if qtype in ("query_string", "simple_query_string"):
         from elasticsearch_tpu.search.query_string import parse_query_string
         return parse_query_string(qbody)
+
+    # ---- span algebra (SpanOr/Not/First/Containing/Within/MultiTerm,
+    # FieldMaskingSpan parsers under core/index/query/) -------------------
+    if qtype == "span_or":
+        clauses = [parse_query(c) for c in qbody.get("clauses", [])]
+        if not clauses:
+            raise QueryParsingError("[span_or] requires 'clauses'")
+        fields = set()
+        for c in clauses:
+            fields |= span_effective_fields(c)
+        if len(fields) > 1:
+            raise QueryParsingError(
+                "[span_or] clauses must target one field "
+                "(use field_masking_span to combine fields)")
+        return SpanOrQuery(clauses=clauses,
+                           boost=float(qbody.get("boost", 1.0)))
+    if qtype == "span_not":
+        if "include" not in qbody or "exclude" not in qbody:
+            raise QueryParsingError(
+                "[span_not] requires 'include' and 'exclude'")
+        dist = int(qbody.get("dist", 0))
+        return SpanNotQuery(include=parse_query(qbody["include"]),
+                            exclude=parse_query(qbody["exclude"]),
+                            pre=int(qbody.get("pre", dist)),
+                            post=int(qbody.get("post", dist)),
+                            boost=float(qbody.get("boost", 1.0)))
+    if qtype == "span_first":
+        if "match" not in qbody:
+            raise QueryParsingError("[span_first] requires 'match'")
+        return SpanFirstQuery(match=parse_query(qbody["match"]),
+                              end=int(qbody.get("end", 0)),
+                              boost=float(qbody.get("boost", 1.0)))
+    if qtype in ("span_containing", "span_within"):
+        if "big" not in qbody or "little" not in qbody:
+            raise QueryParsingError(
+                f"[{qtype}] requires 'big' and 'little'")
+        cls = SpanContainingQuery if qtype == "span_containing" \
+            else SpanWithinQuery
+        return cls(big=parse_query(qbody["big"]),
+                   little=parse_query(qbody["little"]),
+                   boost=float(qbody.get("boost", 1.0)))
+    if qtype == "span_multi":
+        if "match" not in qbody:
+            raise QueryParsingError("[span_multi] requires 'match'")
+        return SpanMultiQuery(match=parse_query(qbody["match"]),
+                              boost=float(qbody.get("boost", 1.0)))
+    if qtype == "field_masking_span":
+        if "query" not in qbody or "field" not in qbody:
+            raise QueryParsingError(
+                "[field_masking_span] requires 'query' and 'field'")
+        return FieldMaskingSpanQuery(query=parse_query(qbody["query"]),
+                                     field=str(qbody["field"]),
+                                     boost=float(qbody.get("boost", 1.0)))
+
+    # ---- geo long tail --------------------------------------------------
+    if qtype == "geo_polygon":
+        fname, spec = _field_body(qbody, "geo_polygon")
+        lats, lons = [], []
+        for p in spec.get("points", []):
+            if isinstance(p, dict):
+                lats.append(float(p["lat"]))
+                lons.append(float(p["lon"]))
+            elif isinstance(p, (list, tuple)):
+                lons.append(float(p[0]))
+                lats.append(float(p[1]))
+            else:
+                la, lo = (float(x) for x in str(p).split(","))
+                lats.append(la)
+                lons.append(lo)
+        if len(lats) < 3:
+            raise QueryParsingError(
+                "[geo_polygon] requires at least 3 points")
+        return GeoPolygonQuery(field=fname, lats=lats, lons=lons)
+    if qtype == "geo_distance_range":
+        keys = {"from", "to", "gte", "gt", "lte", "lt", "include_lower",
+                "include_upper", "unit", "distance_type", "boost",
+                "_name", "validation_method", "optimize_bbox"}
+        point_items = {k: v for k, v in qbody.items() if k not in keys}
+        fname, point = next(iter(point_items.items()))
+        if isinstance(point, dict):
+            lat, lon = float(point["lat"]), float(point["lon"])
+        elif isinstance(point, (list, tuple)):
+            lon, lat = float(point[0]), float(point[1])
+        else:
+            lat, lon = (float(x) for x in str(point).split(","))
+        inc_lo = bool(qbody.get("include_lower", True))
+        inc_hi = bool(qbody.get("include_upper", True))
+        lo = qbody.get("gte", qbody.get("from"))
+        lo_x = qbody.get("gt")
+        hi = qbody.get("lte", qbody.get("to"))
+        hi_x = qbody.get("lt")
+        if lo is not None and not inc_lo:
+            lo, lo_x = None, lo
+        if hi is not None and not inc_hi:
+            hi, hi_x = None, hi
+        return GeoDistanceRangeQuery(
+            field=fname, lat=lat, lon=lon,
+            gte_m=None if lo is None else parse_distance(lo),
+            gt_m=None if lo_x is None else parse_distance(lo_x),
+            lte_m=None if hi is None else parse_distance(hi),
+            lt_m=None if hi_x is None else parse_distance(hi_x))
+    if qtype in ("geohash_cell", "geohash_filter"):
+        from elasticsearch_tpu.utils.geohash import (
+            geohash_encode, precision_to_length)
+        fname, spec = next(iter(
+            (k, v) for k, v in qbody.items()
+            if k not in ("precision", "neighbors", "boost", "_name")))
+        length = precision_to_length(qbody["precision"]) \
+            if "precision" in qbody else 12
+        if isinstance(spec, dict) and "geohash" in spec:
+            gh = str(spec["geohash"])[:length]
+        elif isinstance(spec, dict) and "lat" in spec and "lon" in spec:
+            gh = geohash_encode(float(spec["lat"]), float(spec["lon"]),
+                                length)
+        elif isinstance(spec, (list, tuple)):       # GeoJSON [lon, lat]
+            gh = geohash_encode(float(spec[1]), float(spec[0]), length)
+        elif isinstance(spec, dict):
+            raise QueryParsingError(
+                f"[geohash_cell] cannot parse point [{spec!r}]")
+        else:
+            gh = str(spec)[:length]
+        return GeohashCellQuery(field=fname, geohash=gh,
+                                neighbors=bool(qbody.get("neighbors",
+                                                         False)))
+    if qtype == "geo_shape":
+        fname, spec = _field_body(qbody, "geo_shape")
+        shape = spec.get("shape")
+        if shape is None:
+            raise QueryParsingError(
+                "[geo_shape] requires an inline 'shape' "
+                "(indexed-shape lookup is resolved by the caller)")
+        return GeoShapeQuery(field=fname, shape=dict(shape),
+                             relation=str(spec.get("relation",
+                                                   "intersects")).lower())
+
+    # ---- compatibility / wrapper types ----------------------------------
+    if qtype == "indices":
+        idx = qbody.get("indices", qbody.get("index"))
+        if idx is None or "query" not in qbody:
+            raise QueryParsingError(
+                "[indices] requires 'indices' and 'query'")
+        nmq = qbody.get("no_match_query", "all")
+        if nmq == "all":
+            no_match = None
+        elif nmq == "none":
+            no_match = MatchNoneQuery()
+        else:
+            no_match = parse_query(nmq)
+        return IndicesQuery(
+            indices=[idx] if isinstance(idx, str) else [str(i) for i in idx],
+            query=parse_query(qbody["query"]), no_match_query=no_match)
+    if qtype == "not":
+        # ref: NotQueryParser — matches docs NOT matching the inner query
+        # (accepts the bare, {"query": ...} and 1.x {"filter": ...} forms)
+        inner = qbody
+        if isinstance(qbody, dict):
+            inner = qbody.get("query", qbody.get("filter", qbody))
+        return BoolQuery(must=[MatchAllQuery()],
+                         must_not=[parse_query(inner)])
+    if qtype == "and":
+        clauses = qbody.get("filters", qbody) if isinstance(qbody, dict) \
+            else qbody
+        return BoolQuery(filter=[parse_query(c) for c in clauses])
+    if qtype == "or":
+        clauses = qbody.get("filters", qbody) if isinstance(qbody, dict) \
+            else qbody
+        return BoolQuery(should=[parse_query(c) for c in clauses],
+                         minimum_should_match=1)
+    if qtype == "filtered":
+        # 2.x compat (FilteredQueryParser): query scored, filter as mask
+        out = BoolQuery(must=[parse_query(qbody.get("query"))])
+        if qbody.get("filter") is not None:
+            out.filter = [parse_query(qbody["filter"])]
+        return out
+    if qtype == "limit":
+        # deprecated in 2.x: parses and matches everything (LimitQueryParser)
+        return MatchAllQuery()
+    if qtype == "wrapper":
+        import base64
+        import json as _json
+        raw = qbody.get("query") if isinstance(qbody, dict) else qbody
+        try:
+            decoded = _json.loads(base64.b64decode(raw))
+        except Exception as e:
+            raise QueryParsingError(f"[wrapper] bad base64 query: {e}")
+        return parse_query(decoded)
 
     extra = EXTRA_PARSERS.get(qtype)
     if extra is not None:

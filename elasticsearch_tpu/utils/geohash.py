@@ -65,3 +65,52 @@ def precision_to_length(precision) -> int:
         if _CELL_METERS[length] <= meters:
             return length
     return 12
+
+
+def geohash_decode_bbox(gh: str) -> tuple[float, float, float, float]:
+    """geohash → (lat_lo, lat_hi, lon_lo, lon_hi) cell bounds."""
+    lat_lo, lat_hi = -90.0, 90.0
+    lon_lo, lon_hi = -180.0, 180.0
+    even = True
+    for c in gh:
+        ch = _BASE32.index(c)
+        for bit in (16, 8, 4, 2, 1):
+            if even:
+                mid = (lon_lo + lon_hi) / 2
+                if ch & bit:
+                    lon_lo = mid
+                else:
+                    lon_hi = mid
+            else:
+                mid = (lat_lo + lat_hi) / 2
+                if ch & bit:
+                    lat_lo = mid
+                else:
+                    lat_hi = mid
+            even = not even
+    return lat_lo, lat_hi, lon_lo, lon_hi
+
+
+def geohash_neighbors(gh: str) -> list[str]:
+    """The 8 neighboring cells at the same precision (re-encoding the
+    centers offset by one cell — robust at edges/poles; duplicates and
+    the cell itself are dropped)."""
+    lat_lo, lat_hi, lon_lo, lon_hi = geohash_decode_bbox(gh)
+    dlat = lat_hi - lat_lo
+    dlon = lon_hi - lon_lo
+    clat = (lat_lo + lat_hi) / 2
+    clon = (lon_lo + lon_hi) / 2
+    out = []
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            if dx == 0 and dy == 0:
+                continue
+            nlat = clat + dy * dlat
+            nlon = clon + dx * dlon
+            if not -90.0 <= nlat <= 90.0:
+                continue
+            nlon = ((nlon + 180.0) % 360.0) - 180.0
+            n = geohash_encode(nlat, nlon, len(gh))
+            if n != gh and n not in out:
+                out.append(n)
+    return out

@@ -1,0 +1,65 @@
+"""GeoJSON-ish shape parsing shared by the geo_shape field mapper and the
+geo_shape query (ref: core/common/geo/builders/ShapeBuilder.java).
+
+Shapes reduce to a single CLOSED vertex ring (lat/lon lists where the last
+vertex repeats the first): point → 1 vertex, envelope → 4, polygon → its
+outer ring, circle → a 32-gon. Holes, multi-geometries and linestrings are
+not supported (documented simplification — the reference triangulates into
+a prefix-tree index; here relations run as exact dense polygon tests on
+device, ops/geoshape.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+from elasticsearch_tpu.common.errors import QueryParsingError
+
+CIRCLE_SEGMENTS = 32
+
+
+def parse_shape(shape: dict) -> tuple[list[float], list[float]]:
+    """→ (lats, lons) closed ring (last vertex == first; len ≥ 2)."""
+    if not isinstance(shape, dict) or "type" not in shape:
+        raise QueryParsingError(f"cannot parse shape [{shape!r}]")
+    stype = str(shape["type"]).lower()
+    coords = shape.get("coordinates")
+    if stype == "point":
+        lon, lat = float(coords[0]), float(coords[1])
+        return [lat, lat], [lon, lon]
+    if stype == "envelope":
+        # ES order: [[west, north], [east, south]]
+        (w, n), (e, s) = coords
+        lats = [float(n), float(n), float(s), float(s), float(n)]
+        lons = [float(w), float(e), float(e), float(w), float(w)]
+        return lats, lons
+    if stype == "polygon":
+        ring = coords[0]
+        if len(coords) > 1:
+            raise QueryParsingError(
+                "geo_shape polygons with holes are not supported")
+        lats = [float(p[1]) for p in ring]
+        lons = [float(p[0]) for p in ring]
+        if lats[0] != lats[-1] or lons[0] != lons[-1]:
+            lats.append(lats[0])
+            lons.append(lons[0])
+        if len(lats) < 4:
+            raise QueryParsingError("polygon needs at least 3 vertices")
+        return lats, lons
+    if stype == "circle":
+        lon, lat = float(coords[0]), float(coords[1])
+        from elasticsearch_tpu.search.query_dsl import parse_distance
+        radius_m = parse_distance(shape.get("radius", "0m"))
+        # meters → degrees (local tangent approximation)
+        dlat = radius_m / 111_320.0
+        dlon = radius_m / (111_320.0 * max(math.cos(math.radians(lat)),
+                                           1e-6))
+        lats, lons = [], []
+        for i in range(CIRCLE_SEGMENTS + 1):
+            a = 2.0 * math.pi * i / CIRCLE_SEGMENTS
+            lats.append(lat + dlat * math.sin(a))
+            lons.append(lon + dlon * math.cos(a))
+        return lats, lons
+    raise QueryParsingError(
+        f"geo_shape type [{stype}] is not supported "
+        f"(point/envelope/polygon/circle)")

@@ -1,0 +1,299 @@
+"""Query DSL tranche 3 — the long tail closing the ~50-parser surface
+(core/index/query/): span algebra (span_or/not/first/containing/within/
+multi, field_masking_span), geo long tail (geo_polygon,
+geo_distance_range, geohash_cell, geo_shape), and the compatibility
+wrappers (indices, not, and, or, filtered, limit, wrapper)."""
+
+import base64
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.errors import QueryParsingError
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search import jit_exec
+from elasticsearch_tpu.search.query_dsl import (
+    BoolQuery, GeoPolygonQuery, GeoShapeQuery, IndicesQuery, MatchAllQuery,
+    SpanNotQuery, SpanOrQuery, parse_query)
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node({}, data_path=tmp_path_factory.mktemp("dsl3") / "n").start()
+    n.indices_service.create_index(
+        "idx", {"settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0},
+                "mappings": {"_doc": {"properties": {
+                    "t": {"type": "text", "analyzer": "whitespace"},
+                    "pt": {"type": "geo_point"},
+                    "shp": {"type": "geo_shape"},
+                    "n": {"type": "long"}}}}})
+    texts = [
+        "alpha beta gamma delta",            # 0
+        "beta alpha gamma",                  # 1
+        "alpha gamma beta epsilon",          # 2
+        "delta epsilon zeta",                # 3
+        "alpha beta alpha beta",             # 4
+        "gamma delta alpha",                 # 5
+    ]
+    # geo points on a grid around (10, 10)
+    points = [(10.0, 10.0), (10.5, 10.5), (11.0, 11.0),
+              (20.0, 20.0), (10.2, 9.8), (-5.0, 40.0)]
+    shapes = [
+        {"type": "point", "coordinates": [10.0, 10.0]},                # 0
+        {"type": "envelope", "coordinates": [[9.0, 12.0], [11.0, 9.0]]},  # 1
+        {"type": "polygon", "coordinates":                             # 2
+         [[[0.0, 0.0], [4.0, 0.0], [4.0, 4.0], [0.0, 4.0],
+           [0.0, 0.0]]]},
+        {"type": "point", "coordinates": [50.0, 50.0]},                # 3
+        {"type": "polygon", "coordinates":                             # 4
+         [[[9.5, 9.5], [10.5, 9.5], [10.5, 10.5], [9.5, 10.5],
+           [9.5, 9.5]]]},
+        {"type": "point", "coordinates": [2.0, 2.0]},                  # 5
+    ]
+    for i, t in enumerate(texts):
+        n.index_doc("idx", str(i), {
+            "t": t, "n": i,
+            "pt": {"lat": points[i][0], "lon": points[i][1]},
+            "shp": shapes[i]})
+    n.broadcast_actions.refresh("idx")
+    yield n
+    n.close()
+
+
+def _ids(resp):
+    return {h["_id"] for h in resp["hits"]["hits"]}
+
+
+def _search(node, query, size=20):
+    jit_exec.clear_cache()
+    out = node.search("idx", {"query": query, "size": size})
+    assert jit_exec.cache_stats()["fallbacks"] == 0, \
+        f"compiled path fell back for {query}"
+    return out
+
+
+class TestSpanAlgebra:
+    def test_span_or(self, node):
+        r = _search(node, {"span_or": {"clauses": [
+            {"span_term": {"t": "zeta"}},
+            {"span_term": {"t": "epsilon"}}]}})
+        assert _ids(r) == {"2", "3"}
+
+    def test_span_or_parse(self):
+        q = parse_query({"span_or": {"clauses": [
+            {"span_term": {"t": "x"}}]}})
+        assert isinstance(q, SpanOrQuery) and len(q.clauses) == 1
+
+    def test_span_first(self, node):
+        # "alpha" within the first 1 positions → docs starting with alpha
+        r = _search(node, {"span_first": {
+            "match": {"span_term": {"t": "alpha"}}, "end": 1}})
+        assert _ids(r) == {"0", "2", "4"}
+
+    def test_span_not(self, node):
+        # "beta" not immediately followed by "gamma": doc1 has
+        # "beta alpha", doc4 "beta alpha"/"beta"-final, doc0 has
+        # "beta gamma" (killed), doc2 "beta epsilon" (kept)
+        r = _search(node, {"span_not": {
+            "include": {"span_term": {"t": "beta"}},
+            "exclude": {"span_near": {
+                "clauses": [{"span_term": {"t": "beta"}},
+                            {"span_term": {"t": "gamma"}}],
+                "slop": 0, "in_order": True}}}})
+        assert _ids(r) == {"1", "2", "4"}
+
+    def test_span_not_parse(self):
+        q = parse_query({"span_not": {
+            "include": {"span_term": {"t": "a"}},
+            "exclude": {"span_term": {"t": "b"}}, "pre": 1, "post": 2}})
+        assert isinstance(q, SpanNotQuery) and q.pre == 1 and q.post == 2
+
+    def test_span_containing(self, node):
+        # spans "alpha ... gamma" (slop 1) containing a "beta" span:
+        # doc0 alpha beta gamma ✓; doc2 alpha gamma (no beta inside);
+        # doc1 has no alpha-then-gamma within slop... (beta alpha gamma:
+        # alpha@1 gamma@2, no beta inside)
+        r = _search(node, {"span_containing": {
+            "big": {"span_near": {"clauses": [
+                {"span_term": {"t": "alpha"}},
+                {"span_term": {"t": "gamma"}}], "slop": 1,
+                "in_order": True}},
+            "little": {"span_term": {"t": "beta"}}}})
+        assert _ids(r) == {"0"}
+
+    def test_span_within(self, node):
+        r = _search(node, {"span_within": {
+            "big": {"span_near": {"clauses": [
+                {"span_term": {"t": "alpha"}},
+                {"span_term": {"t": "gamma"}}], "slop": 1,
+                "in_order": True}},
+            "little": {"span_term": {"t": "beta"}}}})
+        assert _ids(r) == {"0"}
+
+    def test_span_multi(self, node):
+        # prefix "ep*" → epsilon
+        r = _search(node, {"span_multi": {
+            "match": {"prefix": {"t": {"value": "ep"}}}}})
+        assert _ids(r) == {"2", "3"}
+
+    def test_field_masking_span(self, node):
+        r = _search(node, {"span_near": {
+            "clauses": [
+                {"span_term": {"t": "alpha"}},
+                {"field_masking_span": {
+                    "query": {"span_term": {"t": "beta"}},
+                    "field": "t"}}],
+            "slop": 0, "in_order": True}})
+        assert _ids(r) == {"0", "4"}
+
+    def test_span_scores_match_phrase_shape(self, node):
+        # span freq feeds BM25 — a doc with two occurrences outranks one
+        r = _search(node, {"span_or": {"clauses": [
+            {"span_term": {"t": "alpha"}}]}})
+        hits = r["hits"]["hits"]
+        assert hits[0]["_id"] == "4"      # "alpha beta alpha beta"
+
+
+class TestGeoLongTail:
+    def test_geo_polygon(self, node):
+        r = _search(node, {"geo_polygon": {"pt": {"points": [
+            {"lat": 9.0, "lon": 9.0}, {"lat": 12.0, "lon": 9.0},
+            {"lat": 12.0, "lon": 12.0}, {"lat": 9.0, "lon": 12.0}]}}})
+        assert _ids(r) == {"0", "1", "2", "4"}
+
+    def test_geo_polygon_parse_rejects_short(self):
+        with pytest.raises(QueryParsingError):
+            parse_query({"geo_polygon": {"pt": {"points": [
+                {"lat": 0, "lon": 0}, {"lat": 1, "lon": 1}]}}})
+
+    def test_geo_distance_range(self, node):
+        # annulus around (10,10): excludes the center point itself
+        r = _search(node, {"geo_distance_range": {
+            "gt": "10km", "lte": "200km",
+            "pt": {"lat": 10.0, "lon": 10.0}}})
+        assert _ids(r) == {"1", "2", "4"}
+
+    def test_geohash_cell(self, node):
+        from elasticsearch_tpu.utils.geohash import geohash_encode
+        gh = geohash_encode(10.0, 10.0, 4)
+        r = _search(node, {"geohash_cell": {
+            "pt": {"geohash": gh}, "neighbors": True}})
+        assert "0" in _ids(r)
+        assert "3" not in _ids(r)
+
+    def test_geohash_roundtrip(self):
+        from elasticsearch_tpu.utils.geohash import (
+            geohash_decode_bbox, geohash_encode, geohash_neighbors)
+        gh = geohash_encode(48.8566, 2.3522, 6)
+        lat_lo, lat_hi, lon_lo, lon_hi = geohash_decode_bbox(gh)
+        assert lat_lo <= 48.8566 <= lat_hi
+        assert lon_lo <= 2.3522 <= lon_hi
+        assert len(geohash_neighbors(gh)) == 8
+
+
+class TestGeoShape:
+    def test_intersects_envelope(self, node):
+        r = _search(node, {"geo_shape": {"shp": {
+            "shape": {"type": "envelope",
+                      "coordinates": [[9.5, 11.0], [10.5, 9.5]]}}}})
+        # point(10,10)=0 ✓, envelope 9-11=1 ✓, small poly=4 ✓
+        assert _ids(r) == {"0", "1", "4"}
+
+    def test_disjoint(self, node):
+        r = _search(node, {"geo_shape": {"shp": {
+            "shape": {"type": "envelope",
+                      "coordinates": [[9.5, 11.0], [10.5, 9.5]]},
+            "relation": "disjoint"}}})
+        assert _ids(r) == {"2", "3", "5"}
+
+    def test_within(self, node):
+        # everything within a huge envelope except the far point
+        r = _search(node, {"geo_shape": {"shp": {
+            "shape": {"type": "envelope",
+                      "coordinates": [[-1.0, 30.0], [30.0, -1.0]]},
+            "relation": "within"}}})
+        assert _ids(r) == {"0", "1", "2", "4", "5"}
+
+    def test_contains(self, node):
+        # docs whose shape contains the point (2, 2): the 0-4 polygon
+        r = _search(node, {"geo_shape": {"shp": {
+            "shape": {"type": "point", "coordinates": [2.0, 2.0]},
+            "relation": "contains"}}})
+        assert "2" in _ids(r)
+        assert "3" not in _ids(r)
+
+    def test_circle_query(self, node):
+        r = _search(node, {"geo_shape": {"shp": {
+            "shape": {"type": "circle", "coordinates": [10.0, 10.0],
+                      "radius": "100km"}}}})
+        assert "0" in _ids(r) and "3" not in _ids(r)
+
+    def test_parse(self):
+        q = parse_query({"geo_shape": {"f": {
+            "shape": {"type": "point", "coordinates": [1, 2]},
+            "relation": "within"}}})
+        assert isinstance(q, GeoShapeQuery) and q.relation == "within"
+
+    def test_polygon_holes_rejected(self, node):
+        with pytest.raises(Exception):
+            node.search("idx", {"query": {"geo_shape": {"shp": {
+                "shape": {"type": "polygon",
+                          "coordinates": [[[0, 0], [1, 0], [1, 1],
+                                           [0, 0]],
+                                          [[0.2, 0.2], [0.8, 0.2],
+                                           [0.8, 0.8], [0.2, 0.2]]]}}}}})
+
+
+class TestCompatWrappers:
+    def test_indices_parse(self):
+        q = parse_query({"indices": {"indices": ["a", "b"],
+                                     "query": {"match_all": {}},
+                                     "no_match_query": "none"}})
+        assert isinstance(q, IndicesQuery) and q.indices == ["a", "b"]
+
+    def test_indices_match_branch(self, node):
+        r = _search(node, {"indices": {
+            "indices": ["idx"],
+            "query": {"term": {"t": "zeta"}},
+            "no_match_query": "none"}})
+        assert _ids(r) == {"3"}
+
+    def test_indices_no_match_branch(self, node):
+        r = _search(node, {"indices": {
+            "indices": ["other"],
+            "query": {"term": {"t": "zeta"}},
+            "no_match_query": {"term": {"t": "epsilon"}}}})
+        assert _ids(r) == {"2", "3"}
+
+    def test_not_query(self, node):
+        r = _search(node, {"not": {"query": {"term": {"t": "alpha"}}}})
+        assert _ids(r) == {"3"}
+
+    def test_and_or(self, node):
+        r = _search(node, {"and": [{"term": {"t": "alpha"}},
+                                   {"term": {"t": "delta"}}]})
+        assert _ids(r) == {"0", "5"}
+        r = _search(node, {"or": [{"term": {"t": "zeta"}},
+                                  {"term": {"t": "epsilon"}}]})
+        assert _ids(r) == {"2", "3"}
+
+    def test_filtered(self, node):
+        q = parse_query({"filtered": {
+            "query": {"match": {"t": "alpha"}},
+            "filter": {"range": {"n": {"gte": 2}}}}})
+        assert isinstance(q, BoolQuery) and len(q.filter) == 1
+        r = _search(node, {"filtered": {
+            "query": {"match": {"t": "alpha"}},
+            "filter": {"range": {"n": {"gte": 2}}}}})
+        assert _ids(r) == {"2", "4", "5"}
+
+    def test_limit_is_match_all(self):
+        assert isinstance(parse_query({"limit": {"value": 100}}),
+                          MatchAllQuery)
+
+    def test_wrapper(self, node):
+        inner = json.dumps({"term": {"t": "zeta"}})
+        b64 = base64.b64encode(inner.encode()).decode()
+        r = _search(node, {"wrapper": {"query": b64}})
+        assert _ids(r) == {"3"}
