@@ -4,10 +4,12 @@ Reference: core/common/xcontent/XContentFactory.java + XContentType — the
 same API body can arrive as JSON, YAML, CBOR, or SMILE, sniffed from the
 Content-Type header or the payload's magic bytes; responses render in the
 requested format. JSON and YAML use the standard codecs; CBOR is a
-self-contained RFC 7049 subset codec (maps/arrays/strings/ints/floats/
-bool/null — the shapes JSON can express, which is exactly what the
-reference emits); SMILE is detected and reported as unsupported rather
-than misparsed as JSON.
+self-contained RFC 7049 subset codec and SMILE a self-contained codec of
+the published Smile format (":)\\n" header, token-class bytes, zigzag
+vints, 7-bit float chunks; the decoder additionally honors shared
+property-name / string-value back-references so Jackson-default payloads
+parse) — both cover the JSON-expressible shapes, which is exactly what
+the reference emits.
 """
 
 from __future__ import annotations
@@ -64,9 +66,7 @@ def decode(body: bytes, content_type: str | None = None) -> Any:
     if t == CBOR:
         value, offset = _cbor_decode(body, 0)
         return value
-    raise IllegalArgumentError(
-        "SMILE content is not supported by this build; send JSON, YAML "
-        "or CBOR")
+    return smile_decode(body)
 
 
 def encode(obj: Any, accept: str | None = None,
@@ -77,6 +77,8 @@ def encode(obj: Any, accept: str | None = None,
         t = YAML
     elif accept in ("cbor",):
         t = CBOR
+    elif accept in ("smile",):
+        t = SMILE
     elif accept in ("json", None):
         t = JSON
     if t == YAML:
@@ -85,6 +87,8 @@ def encode(obj: Any, accept: str | None = None,
                                sort_keys=False).encode(), YAML)
     if t == CBOR:
         return _cbor_encode(obj), CBOR
+    if t == SMILE:
+        return smile_encode(obj), SMILE
     if pretty:
         return (json.dumps(obj, indent=2) + "\n").encode(), JSON
     return json.dumps(obj).encode(), JSON
@@ -190,3 +194,291 @@ def _cbor_decode(data: bytes, offset: int) -> tuple[Any, int]:
                 offset + 8
     raise IllegalArgumentError(
         f"unsupported CBOR item (major {major}, info {info})")
+
+
+# ---------------------------------------------------------------------------
+# SMILE (the Jackson binary JSON format; ref XContentType.SMILE —
+# core/common/xcontent/smile/SmileXContent.java wraps Jackson's
+# SmileFactory; this is a from-the-published-format codec)
+# ---------------------------------------------------------------------------
+
+_SMILE_HEADER = b":)\n"
+
+
+def _zigzag(v: int) -> int:
+    return (v << 1) ^ (v >> 63) if v < 0 else v << 1
+
+
+def _unzigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _smile_vint(v: int) -> bytes:
+    """Smile's MSB-first vint: final byte carries 6 data bits + the 0x80
+    end marker; preceding bytes carry 7 bits with the high bit clear."""
+    out = [0x80 | (v & 0x3F)]
+    v >>= 6
+    while v:
+        out.append(v & 0x7F)
+        v >>= 7
+    return bytes(reversed(out))
+
+
+def _smile_read_vint(data: bytes, off: int) -> tuple[int, int]:
+    v = 0
+    while True:
+        if off >= len(data):
+            raise IllegalArgumentError("truncated SMILE vint")
+        b = data[off]
+        off += 1
+        if b & 0x80:
+            return (v << 6) | (b & 0x3F), off
+        v = (v << 7) | b
+
+
+def _smile_7bit(raw: bytes) -> bytes:
+    """Big-endian 7-bit chunking (floats/doubles ride this way)."""
+    n = int.from_bytes(raw, "big")
+    nbytes = (len(raw) * 8 + 6) // 7
+    out = bytearray(nbytes)
+    for i in range(nbytes - 1, -1, -1):
+        out[i] = n & 0x7F
+        n >>= 7
+    return bytes(out)
+
+
+def _smile_un7bit(data: bytes, off: int, nbits: int) -> tuple[bytes, int]:
+    nbytes = (nbits + 6) // 7
+    if off + nbytes > len(data):
+        raise IllegalArgumentError("truncated SMILE float")
+    n = 0
+    for i in range(nbytes):
+        n = (n << 7) | (data[off + i] & 0x7F)
+    n &= (1 << nbits) - 1
+    return n.to_bytes(nbits // 8, "big"), off + nbytes
+
+
+def smile_encode(obj: Any) -> bytes:
+    """Encode without shared-reference tables (header flag byte 0x00) —
+    every decoder must accept that, per the format spec."""
+    out = bytearray(_SMILE_HEADER + b"\x00")
+    _smile_enc_value(obj, out)
+    return bytes(out)
+
+
+def _smile_enc_value(obj: Any, out: bytearray) -> None:
+    if obj is None:
+        out.append(0x21)
+    elif obj is True:
+        out.append(0x23)
+    elif obj is False:
+        out.append(0x22)
+    elif isinstance(obj, int):
+        if not -(1 << 63) <= obj < (1 << 63):
+            # BigInteger token: vint byte length + 7-bit-chunked
+            # big-endian two's-complement payload
+            raw = obj.to_bytes((obj.bit_length() + 8) // 8, "big",
+                               signed=True)
+            out.append(0x26)
+            out += _smile_vint(len(raw))
+            out += _smile_7bit(raw)
+            return
+        z = _zigzag(obj)
+        if z < 32:                               # small int, 1 byte
+            out.append(0xC0 + z)
+        elif -(1 << 31) <= obj < (1 << 31):
+            out.append(0x24)
+            out += _smile_vint(z)
+        else:
+            out.append(0x25)
+            out += _smile_vint(z)
+    elif isinstance(obj, float):
+        out.append(0x29)
+        out += _smile_7bit(struct.pack(">d", obj))
+    elif isinstance(obj, str):
+        raw = obj.encode("utf-8")
+        is_ascii = len(raw) == len(obj)
+        if not obj:
+            out.append(0x20)
+        elif is_ascii and len(raw) <= 32:
+            out.append(0x40 + len(raw) - 1)
+            out += raw
+        elif is_ascii and len(raw) <= 64:
+            out.append(0x60 + len(raw) - 33)
+            out += raw
+        elif not is_ascii and 2 <= len(raw) <= 33:
+            out.append(0x80 + len(raw) - 2)
+            out += raw
+        elif not is_ascii and len(raw) <= 65:
+            out.append(0xA0 + len(raw) - 34)
+            out += raw
+        else:
+            out.append(0xE0 if is_ascii else 0xE4)
+            out += raw
+            out.append(0xFC)
+    elif isinstance(obj, (list, tuple)):
+        out.append(0xF8)
+        for v in obj:
+            _smile_enc_value(v, out)
+        out.append(0xF9)
+    elif isinstance(obj, dict):
+        out.append(0xFA)
+        for k, v in obj.items():
+            _smile_enc_key(str(k), out)
+            _smile_enc_value(v, out)
+        out.append(0xFB)
+    else:
+        raise IllegalArgumentError(
+            f"cannot encode [{type(obj).__name__}] as SMILE")
+
+
+def _smile_enc_key(key: str, out: bytearray) -> None:
+    raw = key.encode("utf-8")
+    is_ascii = len(raw) == len(key)
+    if not key:
+        out.append(0x20)
+    elif is_ascii and len(raw) <= 64:
+        out.append(0x80 + len(raw) - 1)
+        out += raw
+    elif not is_ascii and 2 <= len(raw) <= 57:
+        out.append(0xC0 + len(raw) - 2)
+        out += raw
+    else:
+        out.append(0x34)
+        out += raw
+        out.append(0xFC)
+
+
+class _SmileDecoder:
+    def __init__(self, data: bytes):
+        if data[:3] != _SMILE_HEADER:
+            raise IllegalArgumentError("not a SMILE payload (no ':)' "
+                                       "header)")
+        if len(data) < 4:
+            raise IllegalArgumentError("truncated SMILE header")
+        self.data = data
+        self.off = 4
+        # header flags announce whether back-references may appear; the
+        # tables are maintained regardless (cheap) so flag quirks in
+        # writers don't break us
+        self.shared_names: list[str] = []
+        self.shared_values: list[str] = []
+
+    def decode(self) -> Any:
+        v = self.read_value()
+        return v
+
+    def _take(self, n: int) -> bytes:
+        if self.off + n > len(self.data):
+            raise IllegalArgumentError("truncated SMILE payload")
+        out = self.data[self.off:self.off + n]
+        self.off += n
+        return out
+
+    def _until_fc(self) -> bytes:
+        end = self.data.find(b"\xfc", self.off)
+        if end < 0:
+            raise IllegalArgumentError("unterminated SMILE long string")
+        out = self.data[self.off:end]
+        self.off = end + 1
+        return out
+
+    def _note_value(self, s: str, raw_len: int) -> str:
+        if 0 < raw_len <= 64:
+            if len(self.shared_values) >= 1024:
+                # spec/Jackson behavior: a full table is cleared and
+                # indices restart from 0
+                self.shared_values.clear()
+            self.shared_values.append(s)
+        return s
+
+    def read_value(self) -> Any:
+        b = self._take(1)[0]
+        if 0x01 <= b <= 0x1F:                       # short shared value ref
+            return self.shared_values[b - 1]
+        if b == 0x20:
+            return ""
+        if b == 0x21:
+            return None
+        if b == 0x22:
+            return False
+        if b == 0x23:
+            return True
+        if b in (0x24, 0x25):                       # 32/64-bit vint
+            z, self.off = _smile_read_vint(self.data, self.off)
+            return _unzigzag(z)
+        if b == 0x26:                               # BigInteger
+            n, self.off = _smile_read_vint(self.data, self.off)
+            raw, self.off = _smile_un7bit(self.data, self.off, n * 8)
+            return int.from_bytes(raw, "big", signed=True)
+        if b == 0x28:                               # float32
+            raw, self.off = _smile_un7bit(self.data, self.off, 32)
+            return struct.unpack(">f", raw)[0]
+        if b == 0x29:                               # float64
+            raw, self.off = _smile_un7bit(self.data, self.off, 64)
+            return struct.unpack(">d", raw)[0]
+        if 0x40 <= b <= 0x7F:                       # short ASCII value
+            n = (b & 0x1F) + 1 + (32 if b >= 0x60 else 0)
+            raw = self._take(n)
+            return self._note_value(raw.decode("utf-8"), n)
+        if 0x80 <= b <= 0xBF:                       # short Unicode value
+            n = (b & 0x1F) + 2 + (32 if b >= 0xA0 else 0)
+            raw = self._take(n)
+            return self._note_value(raw.decode("utf-8"), n)
+        if 0xC0 <= b <= 0xDF:                       # small int
+            return _unzigzag(b & 0x1F)
+        if b in (0xE0, 0xE4):                       # long text
+            return self._until_fc().decode("utf-8")
+        if 0xEC <= b <= 0xEF:                       # long shared value ref
+            idx = ((b & 0x03) << 8) | self._take(1)[0]
+            return self.shared_values[idx]
+        if b == 0xF8:
+            out = []
+            while self.data[self.off] != 0xF9:
+                out.append(self.read_value())
+            self.off += 1
+            return out
+        if b == 0xFA:
+            d: dict = {}
+            while self.data[self.off] != 0xFB:
+                k = self.read_key()
+                d[k] = self.read_value()
+            self.off += 1
+            return d
+        raise IllegalArgumentError(
+            f"unsupported SMILE value token 0x{b:02X}")
+
+    def read_key(self) -> str:
+        b = self._take(1)[0]
+        if b == 0x20:
+            return ""
+        if 0x30 <= b <= 0x33:                       # long shared name ref
+            idx = ((b & 0x03) << 8) | self._take(1)[0]
+            return self.shared_names[idx]
+        if b == 0x34:                               # long Unicode name
+            return self._until_fc().decode("utf-8")
+        if 0x40 <= b <= 0x7F:                       # short shared name ref
+            return self.shared_names[b - 0x40]
+        if 0x80 <= b <= 0xBF:                       # short ASCII name
+            raw = self._take((b & 0x3F) + 1)
+            key = raw.decode("utf-8")
+        elif 0xC0 <= b <= 0xF7:                     # short Unicode name
+            raw = self._take((b - 0xC0) + 2)
+            key = raw.decode("utf-8")
+        else:
+            raise IllegalArgumentError(
+                f"unsupported SMILE key token 0x{b:02X}")
+        if len(raw) <= 64:
+            if len(self.shared_names) >= 1024:
+                self.shared_names.clear()      # spec: full table resets
+            self.shared_names.append(key)
+        return key
+
+
+def smile_decode(data: bytes) -> Any:
+    try:
+        return _SmileDecoder(data).decode()
+    except (IndexError, ValueError, UnicodeDecodeError) as e:
+        # malformed client payloads must surface as 400s, not 500s
+        raise IllegalArgumentError(f"malformed SMILE payload: {e}") \
+            from None

@@ -1,4 +1,4 @@
-"""Analysis plugin stand-ins: icu, phonetic, kuromoji, smartcn, stempel.
+"""Analysis plugins: icu, phonetic, kuromoji, smartcn, stempel, cjk.
 
 Reference plugins (SURVEY.md §2.9): plugins/analysis-icu (ICU normalizer /
 folding), analysis-phonetic (soundex/metaphone token filters),
@@ -6,11 +6,13 @@ analysis-kuromoji (Japanese), analysis-smartcn (Chinese), analysis-stempel
 (Polish). Each registers providers through ``onModule(AnalysisModule)``;
 here the same names register through ``Plugin.analysis(registry)``.
 
-The CJK analyzers use the bigram strategy of Lucene's CJKAnalyzer (the
-pre-morphological default the reference also falls back to): Han/Kana
-runs emit overlapping bigrams, Latin runs emit lowercased words. It is
-not a lattice morphological analyzer, but it gives the same
-recall-oriented behavior for mixed CJK text with zero native deps.
+kuromoji and smartcn are real segmenters: a dictionary-lattice Viterbi
+for Japanese (plugin_pack/morph_ja.py) and bidirectional maximum
+matching for Chinese (plugin_pack/morph_zh.py), each over a compact
+embedded lexicon (the machinery of the reference plugins without their
+multi-MB model files; OOV text degrades to character-class chunks). The
+bigram strategy of Lucene's CJKAnalyzer stays available as the "cjk"
+analyzer, like the reference core.
 """
 
 from __future__ import annotations
@@ -206,21 +208,37 @@ class PhoneticAnalysisPlugin(Plugin):
 
 
 class KuromojiAnalysisPlugin(Plugin):
-    """analysis-kuromoji: "kuromoji" analyzer (CJK bigram strategy)."""
+    """analysis-kuromoji: lattice-Viterbi Japanese segmentation plus the
+    kuromoji_stemmer / ja_stop filters (JapaneseAnalyzer composition)."""
     name = "analysis-kuromoji"
 
     def analysis(self, registry) -> None:
+        from elasticsearch_tpu.plugin_pack import morph_ja
         registry.analyzers["kuromoji"] = Analyzer(
-            "kuromoji", cjk_bigram_tokenizer)
+            "kuromoji", morph_ja.kuromoji_tokenizer,
+            [morph_ja.kuromoji_stemmer_filter, morph_ja.ja_stop_filter])
+        registry.analyzers["kuromoji_search"] = Analyzer(
+            "kuromoji_search", morph_ja.kuromoji_tokenizer,
+            [morph_ja.kuromoji_stemmer_filter, morph_ja.ja_stop_filter])
+        registry.filter_factories["kuromoji_stemmer"] = \
+            lambda params: morph_ja.kuromoji_stemmer_filter
+        registry.filter_factories["ja_stop"] = \
+            lambda params: morph_ja.ja_stop_filter
+        registry.analyzers.setdefault(
+            "cjk", Analyzer("cjk", cjk_bigram_tokenizer))
 
 
 class SmartcnAnalysisPlugin(Plugin):
-    """analysis-smartcn: "smartcn" analyzer (CJK bigram strategy)."""
+    """analysis-smartcn: bidirectional-max-matching Chinese
+    segmentation (SmartChineseAnalyzer analog)."""
     name = "analysis-smartcn"
 
     def analysis(self, registry) -> None:
+        from elasticsearch_tpu.plugin_pack import morph_zh
         registry.analyzers["smartcn"] = Analyzer(
-            "smartcn", cjk_bigram_tokenizer)
+            "smartcn", morph_zh.smartcn_tokenizer)
+        registry.analyzers.setdefault(
+            "cjk", Analyzer("cjk", cjk_bigram_tokenizer))
 
 
 class StempelAnalysisPlugin(Plugin):

@@ -1,0 +1,185 @@
+"""Japanese morphological segmentation — the kuromoji analog.
+
+The reference plugin (plugins/analysis-kuromoji) wraps Lucene's kuromoji:
+a word lattice over a dictionary + per-edge costs, solved by Viterbi.
+This module implements the SAME machinery — dictionary lattice, unknown-
+word generation by character class, Viterbi min-cost path — with a
+compact embedded lexicon (function words, auxiliaries, common content
+words incl. frequent conjugations) instead of the 12 MB IPADIC binary.
+Unknown text degrades to character-class chunks (katakana/Latin/digit
+runs stay whole; kanji runs split 1-2 chars), which is also what kuromoji
+does for out-of-vocabulary words via its character definitions.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+
+from elasticsearch_tpu.analysis.analyzers import Token
+
+# ---------------------------------------------------------------------------
+# Embedded lexicon: term → (cost, pos). Lower cost wins. POS tags: p =
+# particle, aux = auxiliary/copula, n = noun, v = verb (incl. common
+# conjugations), adj = adjective, adv = adverb, pron = pronoun.
+# ---------------------------------------------------------------------------
+
+_LEX: dict[str, tuple[int, str]] = {}
+
+
+def _add(pos: str, cost: int, words: str) -> None:
+    for w in words.split():
+        _LEX[w] = (cost, pos)
+
+
+_add("p", 100, "は が を に で と も の へ や から まで より ので のに ね よ か な って")
+_add("aux", 120, "です ます でした ました ません でしょう だ だった である います いました "
+     "いる いた ある あった ない なかった たい たかった れる られる せる させる")
+_add("pron", 200, "私 僕 俺 君 彼 彼女 これ それ あれ どれ ここ そこ あそこ どこ 誰 何")
+_add("n", 250, "日本 東京 大阪 京都 学校 学生 先生 会社 会社員 電車 時間 今日 明日 昨日 "
+     "天気 映画 音楽 料理 寿司 犬 猫 人 車 本 水 山 川 空 海 朝 昼 夜 年 月 日 週 "
+     "言葉 日本語 英語 名前 仕事 家 店 駅 道 町 国 世界 問題 検索 情報 技術 開発")
+# administrative suffixes: cheap enough that 東京+都 beats 東+京都
+_add("n", 380, "都 県 市 区 村 駅前 大学")
+_add("v", 300, "行く 行き 行きます 行った 行って 来る 来ます 来た 来て 見る 見ます 見た 見て "
+     "食べる 食べます 食べた 食べて 飲む 飲みます 飲んだ 買う 買います 買った 買いました "
+     "読む 読みます 読んだ 書く 書きます 書いた 話す 話します 話した 聞く 聞きます 聞いた "
+     "する します した して 思う 思います 思った 分かる 分かります 分かった 使う 使います "
+     "住む 住みます 住んだ 住んで 働く 働きます 働いた")
+_add("adj", 300, "高い 安い 大きい 小さい 新しい 古い 良い 悪い 早い 遅い 美しい おいしい "
+     "楽しい 難しい 易しい 暑い 寒い")
+_add("adv", 300, "とても すこし 少し たくさん もう まだ よく いつも")
+
+_MAX_WORD = max(len(w) for w in _LEX)
+
+# particles + auxiliaries double as the ja_stop word list (the reference
+# plugin's JapaneseStopTokenFilter defaults)
+JA_STOPWORDS = frozenset(w for w, (_, pos) in _LEX.items()
+                         if pos in ("p", "aux"))
+
+
+def _char_class(c: str) -> str:
+    o = ord(c)
+    if 0x3040 <= o <= 0x309F:
+        return "hira"
+    if 0x30A0 <= o <= 0x30FF or o == 0xFF70:
+        return "kata"
+    if 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF:
+        return "kanji"
+    if c.isdigit():
+        return "digit"
+    if c.isalpha():
+        return "latin"
+    if c.isspace():
+        return "space"
+    return "other"
+
+
+_UNK_COST = {"kata": 400, "latin": 350, "digit": 350, "hira": 800,
+             "kanji": 600, "other": 1000}
+
+
+def _unknown_candidates(text: str, i: int) -> list[tuple[int, int]]:
+    """→ [(end, cost)] unknown-word edges starting at i (kuromoji's
+    CharacterDefinition GROUP/INVOKE behavior by class)."""
+    cls = _char_class(text[i])
+    if cls == "space":
+        return []
+    j = i + 1
+    while j < len(text) and _char_class(text[j]) == cls:
+        j += 1
+    run_len = j - i
+    out = []
+    if cls in ("kata", "latin", "digit"):
+        # grouping classes: the whole run is one unknown word
+        out.append((j, _UNK_COST[cls] + 10 * run_len))
+    elif cls == "kanji":
+        # kanji: 1-2 char candidates (compounds resolve via the lattice)
+        out.append((i + 1, _UNK_COST[cls]))
+        if run_len >= 2:
+            out.append((i + 2, int(_UNK_COST[cls] * 1.7)))
+    else:
+        out.append((i + 1, _UNK_COST[cls]))
+    return out
+
+
+def segment(text: str) -> list[tuple[str, int, int]]:
+    """Viterbi min-cost segmentation → [(term, start, end)]."""
+    n = len(text)
+    INF = 1 << 30
+    best = [INF] * (n + 1)
+    back: list[tuple[int, bool] | None] = [None] * (n + 1)
+    best[0] = 0
+    for i in range(n):
+        if best[i] >= INF:
+            continue
+        if _char_class(text[i]) == "space":
+            if best[i] < best[i + 1]:
+                best[i + 1] = best[i]
+                back[i + 1] = (i, False)       # skip edge, emits nothing
+            continue
+        # dictionary edges
+        for ln in range(1, min(_MAX_WORD, n - i) + 1):
+            w = text[i:i + ln]
+            hit = _LEX.get(w)
+            if hit is None:
+                continue
+            cost = best[i] + hit[0]
+            if cost < best[i + ln]:
+                best[i + ln] = cost
+                back[i + ln] = (i, True)
+        # unknown-word edges
+        for end, ucost in _unknown_candidates(text, i):
+            cost = best[i] + ucost
+            if cost < best[end]:
+                best[end] = cost
+                back[end] = (i, True)
+    # walk back
+    out: list[tuple[str, int, int]] = []
+    j = n
+    while j > 0:
+        prev = back[j]
+        if prev is None:                        # unreachable: force 1-char
+            j -= 1
+            continue
+        i, emits = prev
+        if emits:
+            out.append((text[i:j], i, j))
+        j = i
+    out.reverse()
+    return out
+
+
+def kuromoji_tokenizer(text: str) -> list[Token]:
+    out = []
+    pos = 0
+    for term, start, end in segment(text):
+        cls = _char_class(term[0])
+        if cls in ("latin", "digit"):
+            term = term.lower()
+        out.append(Token(term, pos, start, end))
+        pos += 1
+    return out
+
+
+def kuromoji_stemmer_filter(tokens: list[Token]) -> list[Token]:
+    """JapaneseKatakanaStemFilter analog: strip a trailing prolonged
+    sound mark from katakana terms of length ≥ 4 (コンピューター →
+    コンピューター without the final ー)."""
+    out = []
+    for t in tokens:
+        term = t.term
+        if len(term) >= 4 and term.endswith("ー") and \
+                _char_class(term[0]) == "kata":
+            term = term[:-1]
+        out.append(Token(term, t.position, t.start_offset, t.end_offset))
+    return out
+
+
+def ja_stop_filter(tokens: list[Token]) -> list[Token]:
+    return [t for t in tokens if t.term not in JA_STOPWORDS]
+
+
+def normalize_nfkc(text: str) -> str:
+    """kuromoji_iteration_mark/ICU-style pre-normalization (full-width
+    Latin → ASCII etc.)."""
+    return unicodedata.normalize("NFKC", text)

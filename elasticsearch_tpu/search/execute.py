@@ -132,6 +132,35 @@ class ExecutionContext:
     index_name: str | None = None
 
 
+def fuzzy_kmax(value: str, fuzziness) -> int:
+    """The AUTO edit-distance ladder (FuzzyQuery defaults): 0 below 3
+    chars, 1 below 6, else 2."""
+    if fuzziness == "AUTO":
+        return 0 if len(value) < 3 else (1 if len(value) < 6 else 2)
+    return int(fuzziness)
+
+
+def multi_term_pred(inner):
+    """term-predicate for a multi-term query node (prefix / wildcard /
+    regexp / fuzzy) — the single rewrite seam shared by the _res_* arms
+    and the span_multi expansion (Lucene's MultiTermQuery TermsEnum)."""
+    it = type(inner).__name__
+    if it == "PrefixQuery":
+        val = inner.value
+        return lambda term: term.startswith(val)
+    if it == "WildcardQuery":
+        rx = re.compile(fnmatch.translate(inner.pattern))
+        return lambda term: rx.match(term) is not None
+    if it == "RegexpQuery":
+        rx = re.compile(inner.pattern)
+        return lambda term: rx.fullmatch(term) is not None
+    if it == "FuzzyQuery":
+        v = inner.value
+        kmax = fuzzy_kmax(v, inner.fuzziness)
+        return lambda term: _edit_distance_le(term, v, kmax)
+    return None
+
+
 def _edit_distance_le(a: str, b: str, k: int) -> bool:
     """Banded Levenshtein ≤ k (fuzzy query vocab scan)."""
     if abs(len(a) - len(b)) > k:
@@ -739,35 +768,23 @@ class SegmentResolver:
                 lambda em: filter_ops.keyword_ord_range(
                     em.seg.keyword[field].ords, em.get(r_lo), em.get(r_hi)),
                 query.boost)
-        value = query.value
         return self._constant_mask_emit(
-            self._vocab_scan_mask(query.field,
-                                  lambda t: t.startswith(value)),
+            self._vocab_scan_mask(query.field, multi_term_pred(query)),
             query.boost)
 
     def _res_WildcardQuery(self, query: q.WildcardQuery) -> Emit:
-        rx = re.compile(fnmatch.translate(query.pattern))
         return self._constant_mask_emit(
-            self._vocab_scan_mask(query.field,
-                                  lambda t: rx.match(t) is not None),
+            self._vocab_scan_mask(query.field, multi_term_pred(query)),
             query.boost)
 
     def _res_RegexpQuery(self, query: q.RegexpQuery) -> Emit:
-        rx = re.compile(query.pattern)
         return self._constant_mask_emit(
-            self._vocab_scan_mask(query.field,
-                                  lambda t: rx.fullmatch(t) is not None),
+            self._vocab_scan_mask(query.field, multi_term_pred(query)),
             query.boost)
 
     def _res_FuzzyQuery(self, query: q.FuzzyQuery) -> Emit:
-        v = query.value
-        if query.fuzziness == "AUTO":
-            k = 0 if len(v) < 3 else (1 if len(v) < 6 else 2)
-        else:
-            k = int(query.fuzziness)
         return self._constant_mask_emit(
-            self._vocab_scan_mask(query.field,
-                                  lambda t: _edit_distance_le(t, v, k)),
+            self._vocab_scan_mask(query.field, multi_term_pred(query)),
             query.boost)
 
     def _res_ParentIdsQuery(self, query: q.ParentIdsQuery) -> Emit:
@@ -1090,31 +1107,15 @@ class SegmentResolver:
 
         if t == "SpanMultiQuery":
             inner = query.match
-            it = type(inner).__name__
             field = getattr(inner, "field", "")
             col = self.seg.text.get(field)
             if col is None:
                 return None
-            if it == "PrefixQuery":
-                val = inner.value
-                pred = lambda term: term.startswith(val)   # noqa: E731
-            elif it == "WildcardQuery":
-                rx = re.compile(fnmatch.translate(inner.pattern))
-                pred = lambda term: rx.match(term) is not None  # noqa: E731
-            elif it == "RegexpQuery":
-                rx = re.compile(inner.pattern)
-                pred = \
-                    lambda term: rx.fullmatch(term) is not None  # noqa: E731
-            elif it == "FuzzyQuery":
-                v = inner.value
-                fz = inner.fuzziness
-                kmax = (0 if len(v) < 3 else 1 if len(v) < 6 else 2) \
-                    if fz == "AUTO" else int(fz)
-                pred = \
-                    lambda term: _edit_distance_le(term, v, kmax)  # noqa: E731
-            else:
+            pred = multi_term_pred(inner)
+            if pred is None:
                 raise QueryParsingError(
-                    f"[span_multi] does not support inner query [{it}]")
+                    f"[span_multi] does not support inner query "
+                    f"[{type(inner).__name__}]")
             tids = [i for i, term in enumerate(col.column.terms)
                     if pred(term)]
             if not tids:
@@ -1146,9 +1147,12 @@ class SegmentResolver:
             emits = [p[0] for p in plans]
 
             def emit(em):
-                L = max(em.seg.text[p[2]].tokens.shape[1] for p in plans)
-                return span_ops.or_ends([
-                    span_ops.pad_ends(e(em), L) for e in emits])
+                # pad to the widest CHILD map (children may span several
+                # underlying token matrices via field_masking_span)
+                maps = [e(em) for e in emits]
+                L = max(m.shape[1] for m in maps)
+                return span_ops.or_ends(
+                    [span_ops.pad_ends(m, L) for m in maps])
             return emit, sum_idf, field
 
         if t == "SpanNearQuery":
@@ -1166,9 +1170,10 @@ class SegmentResolver:
             emits = [p[0] for p in plans]
 
             def emit(em):
-                L = max(em.seg.text[p[2]].tokens.shape[1] for p in plans)
+                maps = [e(em) for e in emits]
+                L = max(m.shape[1] for m in maps)
                 return span_ops.near_ordered_ends(
-                    [span_ops.pad_ends(e(em), L) for e in emits], slop)
+                    [span_ops.pad_ends(m, L) for m in maps], slop)
             return emit, sum_idf, field
 
         if t == "SpanNotQuery":
@@ -1182,14 +1187,13 @@ class SegmentResolver:
             self.sig("span-not", pre, post)
             inc_e, sum_idf, field = inc
             exc_e = exc[0]
-            exc_field = exc[2]
 
             def emit(em):
-                L = max(em.seg.text[field].tokens.shape[1],
-                        em.seg.text[exc_field].tokens.shape[1])
+                inc_m, exc_m = inc_e(em), exc_e(em)
+                L = max(inc_m.shape[1], exc_m.shape[1])
                 return span_ops.not_ends(
-                    span_ops.pad_ends(inc_e(em), L),
-                    span_ops.pad_ends(exc_e(em), L), pre, post)
+                    span_ops.pad_ends(inc_m, L),
+                    span_ops.pad_ends(exc_m, L), pre, post)
             return emit, sum_idf, field
 
         if t == "SpanFirstQuery":
@@ -1212,10 +1216,10 @@ class SegmentResolver:
             containing = t == "SpanContainingQuery"
 
             def emit(em):
-                L = max(em.seg.text[big_f].tokens.shape[1],
-                        em.seg.text[lit_f].tokens.shape[1])
-                b = span_ops.pad_ends(big_e(em), L)
-                li = span_ops.pad_ends(lit_e(em), L)
+                b, li = big_e(em), lit_e(em)
+                L = max(b.shape[1], li.shape[1])
+                b = span_ops.pad_ends(b, L)
+                li = span_ops.pad_ends(li, L)
                 return span_ops.containing_ends(b, li) if containing \
                     else span_ops.within_ends(li, b)
             return ((emit, big_idf, big_f) if containing

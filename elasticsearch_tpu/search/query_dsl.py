@@ -930,7 +930,11 @@ def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query
         keys = {"from", "to", "gte", "gt", "lte", "lt", "include_lower",
                 "include_upper", "unit", "distance_type", "boost",
                 "_name", "validation_method", "optimize_bbox"}
-        point_items = {k: v for k, v in qbody.items() if k not in keys}
+        point_items = {k: v for k, v in qbody.items()
+                       if k not in keys and not k.startswith("_")}
+        if not point_items:
+            raise QueryParsingError(
+                "[geo_distance_range] requires a geo_point field")
         fname, point = next(iter(point_items.items()))
         if isinstance(point, dict):
             lat, lon = float(point["lat"]), float(point["lon"])
@@ -957,9 +961,13 @@ def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query
     if qtype in ("geohash_cell", "geohash_filter"):
         from elasticsearch_tpu.utils.geohash import (
             geohash_encode, precision_to_length)
-        fname, spec = next(iter(
-            (k, v) for k, v in qbody.items()
-            if k not in ("precision", "neighbors", "boost", "_name")))
+        cell_items = [(k, v) for k, v in qbody.items()
+                      if k not in ("precision", "neighbors", "boost")
+                      and not k.startswith("_")]
+        if not cell_items:
+            raise QueryParsingError(
+                "[geohash_cell] requires a geo_point field")
+        fname, spec = cell_items[0]
         length = precision_to_length(qbody["precision"]) \
             if "precision" in qbody else 12
         if isinstance(spec, dict) and "geohash" in spec:

@@ -106,12 +106,44 @@ class TestXContent:
         assert sniff_type(None, body) == "application/yaml"
         assert decode(body) == {"query": {"match_all": {}}}
 
-    def test_smile_rejected(self):
-        import pytest
-        from elasticsearch_tpu.common.errors import IllegalArgumentError
-        from elasticsearch_tpu.common.xcontent import decode
-        with pytest.raises(IllegalArgumentError):
-            decode(b":)\n\x01payload", None)
+    def test_smile_roundtrip(self):
+        from elasticsearch_tpu.common.xcontent import (decode, encode,
+                                                       smile_decode,
+                                                       smile_encode)
+        doc = {"a": 1, "b": [1.5, "x", None, True, False],
+               "nested": {"k": -42, "big": 1 << 40, "neg": -(1 << 40)},
+               "uni": "héllo wörld ünïcode",
+               "long": "z" * 200, "long_uni": "é" * 100,
+               "empty": "", "small_neg": -7,
+               "edge32": (1 << 31) - 1, "edge33": 1 << 31,
+               "key_" + "k" * 80: "long key", "": "empty key"}
+        payload = smile_encode(doc)
+        assert payload[:3] == b":)\n"
+        assert smile_decode(payload) == doc
+        # through the content-negotiation front door
+        body, ct = encode(doc, accept="smile")
+        assert ct == "application/smile"
+        assert decode(body, None) == doc          # magic-byte sniffing
+        assert decode(body, "application/smile") == doc
+
+    def test_smile_shared_name_refs(self):
+        # hand-built payload using shared property-name back-references
+        # (Jackson's default writer emits these): {"ab": 1, ...}, then a
+        # second object in an array reuses the name via 0x40
+        from elasticsearch_tpu.common.xcontent import smile_decode
+        payload = (b":)\n\x01" b"\xf8"
+                   b"\xfa" b"\x81ab" b"\xc2" b"\xfb"     # {"ab": 1}
+                   b"\xfa" b"\x40" b"\xc4" b"\xfb"       # {"ab": 2} via ref
+                   b"\xf9")
+        assert smile_decode(payload) == [{"ab": 1}, {"ab": 2}]
+
+    def test_smile_shared_value_refs(self):
+        from elasticsearch_tpu.common.xcontent import smile_decode
+        payload = (b":)\n\x02" b"\xf8"
+                   b"\x41hi"                              # "hi" (noted)
+                   b"\x01"                                # ref -> "hi"
+                   b"\xf9")
+        assert smile_decode(payload) == ["hi", "hi"]
 
 
 class TestResourceWatcher:
@@ -129,3 +161,31 @@ class TestResourceWatcher:
         assert w.get("greet", "mustache") is None
         assert w.get("rank", "expression") == "doc['r'].value * 2"
         w.stop()
+
+
+class TestSmileEdgeCases:
+    def test_big_integers(self):
+        from elasticsearch_tpu.common.xcontent import (smile_decode,
+                                                       smile_encode)
+        doc = {"a": -(1 << 70), "b": 1 << 100, "c": -(1 << 63),
+               "d": (1 << 63) - 1}
+        assert smile_decode(smile_encode(doc)) == doc
+
+    def test_malformed_is_illegal_argument(self):
+        import pytest
+        from elasticsearch_tpu.common.errors import IllegalArgumentError
+        from elasticsearch_tpu.common.xcontent import smile_decode
+        for payload in (b":)\n\x00\xf8",       # truncated array
+                        b":)\n\x00\x05",       # ref into empty table
+                        b":)\n\x00\x41\xff"):  # bad utf-8
+            with pytest.raises(IllegalArgumentError):
+                smile_decode(payload)
+
+    def test_shared_table_reset_at_1024(self):
+        from elasticsearch_tpu.common.xcontent import (smile_decode,
+                                                       smile_encode)
+        # >1024 distinct keys through the roundtrip still decode (the
+        # encoder emits no refs; the decoder's table reset must not
+        # corrupt anything)
+        doc = {f"key{i:04d}": i for i in range(1100)}
+        assert smile_decode(smile_encode(doc)) == doc

@@ -297,3 +297,47 @@ class TestCompatWrappers:
         b64 = base64.b64encode(inner.encode()).decode()
         r = _search(node, {"wrapper": {"query": b64}})
         assert _ids(r) == {"3"}
+
+
+class TestReviewRegressions:
+    def test_field_masking_span_cross_field(self, tmp_path):
+        """A masked span over a DIFFERENT underlying field must actually
+        match (review r4: the min-end map was padded/measured against the
+        mask field's token matrix and silently matched nothing)."""
+        n = Node({}, data_path=tmp_path / "fm").start()
+        n.indices_service.create_index("fm", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"_doc": {"properties": {
+                "title": {"type": "text", "analyzer": "whitespace"},
+                "body": {"type": "text", "analyzer": "whitespace"}}}}})
+        n.index_doc("fm", "1", {
+            "title": "alpha beta",
+            "body": "one two three four five six seven alpha nine"})
+        n.index_doc("fm", "2", {"title": "beta", "body": "one two"})
+        n.broadcast_actions.refresh("fm")
+        r = n.search("fm", {"query": {"span_or": {"clauses": [
+            {"field_masking_span": {
+                "query": {"span_term": {"body": "alpha"}},
+                "field": "title"}}]}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"1"}
+        # combined with a title clause (different matrix widths)
+        r = n.search("fm", {"query": {"span_near": {"clauses": [
+            {"span_term": {"title": "alpha"}},
+            {"field_masking_span": {
+                "query": {"span_term": {"body": "two"}},
+                "field": "title"}}],
+            "slop": 0, "in_order": True}}})
+        assert r["hits"]["total"] == 1
+        n.close()
+
+    def test_geo_range_missing_field_is_parse_error(self):
+        with pytest.raises(QueryParsingError):
+            parse_query({"geo_distance_range": {"from": "1km",
+                                                "to": "2km"}})
+        with pytest.raises(QueryParsingError):
+            parse_query({"geohash_cell": {"precision": 3}})
+        # 1.x _cache noise must not be mistaken for the field
+        q = parse_query({"geo_distance_range": {
+            "_cache": True, "from": "1km", "to": "2km",
+            "pin": {"lat": 1.0, "lon": 2.0}}})
+        assert q.field == "pin"
