@@ -384,7 +384,8 @@ class _SmileDecoder:
         return out
 
     def _note_value(self, s: str, raw_len: int) -> str:
-        if 0 < raw_len <= 64:
+        # Jackson's MAX_SHARED_STRING_LENGTH_BYTES is 65
+        if 0 < raw_len <= 65:
             if len(self.shared_values) >= 1024:
                 # spec/Jackson behavior: a full table is cleared and
                 # indices restart from 0
@@ -456,7 +457,14 @@ class _SmileDecoder:
             idx = ((b & 0x03) << 8) | self._take(1)[0]
             return self.shared_names[idx]
         if b == 0x34:                               # long Unicode name
-            return self._until_fc().decode("utf-8")
+            raw = self._until_fc()
+            key = raw.decode("utf-8")
+            # Jackson still table-shares long-token names up to 64 bytes
+            if len(raw) <= 64:
+                if len(self.shared_names) >= 1024:
+                    self.shared_names.clear()
+                self.shared_names.append(key)
+            return key
         if 0x40 <= b <= 0x7F:                       # short shared name ref
             return self.shared_names[b - 0x40]
         if 0x80 <= b <= 0xBF:                       # short ASCII name

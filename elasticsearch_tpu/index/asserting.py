@@ -1,0 +1,92 @@
+"""AssertingEngine — the MockEngineSupport / AssertingSearcher analog.
+
+The reference's test framework wraps every engine and searcher in
+asserting shims (test/test/engine/MockEngineSupport.java,
+AssertingSearcher: searcher-leak checks, invariant assertions on every
+read) injected through the normal engine-factory seam. Here the same
+seam is the ``index.engine.type: asserting`` setting
+(IndicesService.add_local_shard): tests get an Engine that checks
+invariants on every operation and accounts searcher acquisitions, and
+the in-process test cluster (testing.InternalTestCluster) runs leak
+checks at teardown.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from elasticsearch_tpu.index.engine import Engine
+
+
+class AssertingEngine(Engine):
+    """Engine wrapper asserting cross-operation invariants:
+
+    * version monotonicity — a successful index op must leave the doc at
+      a strictly higher version than before;
+    * live accounting — after every refresh, each searcher view's live
+      rows must sum to exactly ``doc_count`` and live masks must match
+      their segments' padded row counts;
+    * searcher accounting — acquisitions are counted per generation
+      (the AssertingSearcher ledger; read via ``searcher_acquisitions``).
+    """
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._assert_lock = threading.Lock()
+        self.searcher_acquisitions: dict[int, int] = {}
+
+    # ---- invariant helpers ------------------------------------------------
+
+    def _assert_live_consistency(self) -> None:
+        before = self.num_docs
+        view = super().acquire_searcher()
+        live_total = 0
+        for seg, mask in zip(view.segments, view.live_masks):
+            assert mask.shape[0] == seg.padded_docs, \
+                f"live mask rows {mask.shape[0]} != padded " \
+                f"{seg.padded_docs} (seg {seg.seg_id})"
+            assert not mask[seg.num_docs:].any(), \
+                f"padding rows alive in seg {seg.seg_id}"
+            live_total += int(mask.sum())
+        if self.num_docs != before:
+            return        # concurrent writers moved the goalposts: skip
+        assert live_total == before, \
+            f"live rows {live_total} != doc_count {before}"
+
+    # ---- wrapped operations ----------------------------------------------
+
+    def index(self, doc_id, source, **kw):
+        before = self.doc_version(doc_id)
+        out = super().index(doc_id, source, **kw)
+        # judge by the version THE OP returned, not a re-read — a
+        # concurrent delete after the op would make a re-read None and
+        # flake a correct run (per-doc versions only grow, so the
+        # returned version still exceeds any earlier observation)
+        new_version = out[0] if isinstance(out, tuple) else out
+        assert new_version is not None and \
+            (before is None or new_version > before), \
+            f"version did not advance for [{doc_id}]: " \
+            f"{before} -> {new_version}"
+        return out
+
+    def refresh(self):
+        out = super().refresh()
+        self._assert_live_consistency()
+        return out
+
+    def acquire_searcher(self):
+        view = super().acquire_searcher()
+        with self._assert_lock:
+            self.searcher_acquisitions[view.generation] = \
+                self.searcher_acquisitions.get(view.generation, 0) + 1
+        return view
+
+
+def engine_class_for(settings) -> type[Engine]:
+    """The engine-factory seam (IndexModule.engineFactoryImpl,
+    core/index/IndexModule.java:37): ``index.engine.type: asserting``
+    swaps in the asserting wrapper, anything else gets the real engine."""
+    if settings is not None and \
+            settings.get("index.engine.type", "") == "asserting":
+        return AssertingEngine
+    return Engine

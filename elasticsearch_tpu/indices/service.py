@@ -115,8 +115,13 @@ class IndexService:
 
     def add_local_shard(self, sid: int) -> Engine:
         if sid not in self.engines:
-            engine = Engine(self.path / str(sid), self.mapper_service,
-                            self.index_settings)
+            # engine-factory seam (IndexModule.engineFactoryImpl,
+            # core/index/IndexModule.java:37): index.engine.type selects
+            # the asserting test wrapper (MockEngineFactory analog)
+            from elasticsearch_tpu.index.asserting import engine_class_for
+            engine_cls = engine_class_for(self.index_settings)
+            engine = engine_cls(self.path / str(sid), self.mapper_service,
+                                self.index_settings)
             engine.indexing_slow_log = self.indexing_slow_log
             engine.breaker_service = self.breaker_service
             engine.merge_executor = self.merge_submit

@@ -70,7 +70,9 @@ class Node:
             transport = TcpTransport(
                 self.settings.get("transport.host", "127.0.0.1"),
                 self.settings.get_as_int("transport.tcp.port", 0),
-                publish_host=self.settings.get("transport.publish_host"))
+                publish_host=self.settings.get("transport.publish_host"),
+                compress=self.settings.get_as_bool(
+                    "transport.tcp.compress", False))
             seed_provider = self._unicast_seeds
         elif transport_type == "local":
             hub = self._hub or LocalTransportHub()

@@ -107,13 +107,37 @@ class InternalTestCluster:
             node.kill()
         self.nodes.remove(node)
 
-    def close(self) -> None:
+    def close(self, check_leaks: bool = True) -> None:
+        leaks: list[str] = []
+        if check_leaks:
+            # the reference's test framework asserts resource balance at
+            # cluster teardown (MockFSDirectoryService unclosed-handle
+            # checks, AssertingSearcher leak ledger): after engines
+            # close, every breaker reservation must have been returned
+            for n in list(self.nodes):
+                try:
+                    for idx in getattr(n.indices_service, "indices",
+                                       {}).values():
+                        for engine in idx.engines.values():
+                            engine.close()
+                    bs = getattr(n, "breaker_service", None)
+                    if bs is not None:
+                        for bname in ("fielddata", "request"):
+                            used = bs.breaker(bname).used
+                            if used:
+                                leaks.append(
+                                    f"node [{n.settings.get('node.name')}]"
+                                    f" breaker [{bname}] leaked {used} "
+                                    f"bytes after engine close")
+                except Exception:                # noqa: BLE001 — teardown
+                    pass
         for n in list(self.nodes):
             try:
                 n.close()
             except Exception:                    # noqa: BLE001 — teardown
                 pass
         self.nodes.clear()
+        assert not leaks, "; ".join(leaks)
 
     def __enter__(self):
         return self

@@ -8,7 +8,17 @@ on each frame. Threading: an accept loop + one reader thread per inbound
 connection replaces the Netty event loop; handler dispatch happens on the
 TransportService executor, matching the reference's worker offload.
 
-Frame layout (after the 2-byte marker b"ET" and 4-byte big-endian length):
+Two NettyTransport disciplines carried over:
+* optional frame compression (`transport.tcp.compress`, the LZF-optional
+  bit of the reference's status byte — zlib here) — a flags byte after
+  the marker, so each frame states whether its body is compressed;
+* per-traffic-class outbound channels (connectToNode :871 opens
+  recovery/bulk/reg/state/ping channel groups): the outbound socket is
+  keyed by (address, class-of-action), so a bulk or recovery stream
+  can't head-of-line-block pings or cluster-state publishes.
+
+Frame layout: b"ET", 1 flags byte (bit0 = deflate), 4-byte big-endian
+length, then the (possibly deflated) body:
   StreamOutput[ byte msg_type (0=req, 1=resp, 2=resp_error),
                 long request_id, vint wire_version, then per type:
     req:        DiscoveryNode source, string action, bytes payload
@@ -21,6 +31,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import zlib
 
 from elasticsearch_tpu.transport.service import (
     ConnectTransportError, DiscoveryNode, TransportAddress)
@@ -29,12 +40,35 @@ from elasticsearch_tpu.transport.stream import (
 
 _MARKER = b"ET"
 _REQ, _RESP, _RESP_ERR = 0, 1, 2
+_FLAG_COMPRESSED = 0x01
+# compressing tiny frames (pings, acks) costs more than it saves
+_COMPRESS_MIN_BYTES = 128
+
+# action name → channel class, the reference's ChannelType routing
+# (NettyTransport.connectToNode: recovery/bulk/reg/state/ping groups)
+_CHANNEL_CLASSES = (
+    ("internal:index/shard/recovery", "recovery"),
+    ("indices:data/write", "bulk"),
+    ("internal:discovery/zen/publish", "state"),
+    ("cluster:monitor/state", "state"),
+    ("internal:discovery/zen/fd", "ping"),
+    ("internal:discovery/zen/unicast", "ping"),
+)
+
+
+def channel_class(action: str) -> str:
+    for prefix, cls in _CHANNEL_CLASSES:
+        if action.startswith(prefix):
+            return cls
+    return "reg"
 
 
 class TcpTransport:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 publish_host: str | None = None):
+                 publish_host: str | None = None,
+                 compress: bool = False):
         self._host, self._want_port = host, port
+        self.compress = compress
         # the address peers should dial (ref: `transport.publish_host` /
         # NetworkService publish resolution): binding to a wildcard must
         # not advertise the wildcard, which dials back to the PEER's own
@@ -45,7 +79,9 @@ class TcpTransport:
         self._server: socket.socket | None = None
         self._closed = False
         self._lock = threading.Lock()
-        self._outbound: dict[TransportAddress, socket.socket] = {}
+        # outbound sockets keyed by (address, channel class)
+        self._outbound: dict[tuple[TransportAddress, str],
+                             socket.socket] = {}
         # reply channels keyed by (requester node_id, its request_id):
         # request ids are per-requester counters, so two clients' ids collide
         self._inbound_channels: dict[tuple[str, int], socket.socket] = {}
@@ -173,7 +209,7 @@ class TcpTransport:
         self._service.local_node.to_wire(out)
         out.write_string(action)
         out.write_bytes(payload)
-        self._send_frame(node.address, out.bytes())
+        self._send_frame(node.address, out.bytes(), channel_class(action))
 
     def send_response(self, node: DiscoveryNode, request_id: int,
                       payload: bytes | None, error) -> None:
@@ -215,31 +251,39 @@ class TcpTransport:
             except OSError:
                 pass
         try:
-            self._send_frame(node.address, out.bytes())
+            self._send_frame(node.address, out.bytes(), "reg")
         except ConnectTransportError:
             pass                                 # requester is gone
 
     # ---- socket plumbing ---------------------------------------------------
 
-    def _send_frame(self, addr: TransportAddress, body: bytes) -> None:
-        sock = self._connect(addr)
+    def _send_frame(self, addr: TransportAddress, body: bytes,
+                    cls: str = "reg") -> None:
+        sock = self._connect(addr, cls)
         try:
             self._write_framed(sock, body)
         except OSError as e:
             with self._lock:
-                self._outbound.pop(addr, None)
+                self._outbound.pop((addr, cls), None)
                 self._write_locks.pop(id(sock), None)
             raise ConnectTransportError(f"send to {addr} failed: {e}") from e
 
     def _write_framed(self, sock: socket.socket, body: bytes) -> None:
+        flags = 0
+        if self.compress and len(body) >= _COMPRESS_MIN_BYTES:
+            body = zlib.compress(body, 6)
+            flags |= _FLAG_COMPRESSED
         with self._lock:
             wl = self._write_locks.setdefault(id(sock), threading.Lock())
         with wl:
-            sock.sendall(_MARKER + struct.pack(">i", len(body)) + body)
+            sock.sendall(_MARKER + bytes([flags])
+                         + struct.pack(">i", len(body)) + body)
 
-    def _connect(self, addr: TransportAddress) -> socket.socket:
+    def _connect(self, addr: TransportAddress,
+                 cls: str = "reg") -> socket.socket:
+        key = (addr, cls)
         with self._lock:
-            sock = self._outbound.get(addr)
+            sock = self._outbound.get(key)
         if sock is not None:
             return sock
         try:
@@ -250,13 +294,13 @@ class TcpTransport:
                 from e
         sock.settimeout(None)
         with self._lock:
-            existing = self._outbound.get(addr)
+            existing = self._outbound.get(key)
             if existing is not None:
                 sock.close()
                 return existing
-            self._outbound[addr] = sock
+            self._outbound[key] = sock
         t = threading.Thread(target=self._read_loop, args=(sock,),
-                             daemon=True, name=f"tcp_read[{addr}]")
+                             daemon=True, name=f"tcp_read[{addr}/{cls}]")
         t.start()
         self._threads.append(t)
         return sock
@@ -280,15 +324,21 @@ class TcpTransport:
     def _read_loop(self, sock: socket.socket) -> None:
         try:
             while not self._closed:
-                header = self._read_exact(sock, 6)
+                header = self._read_exact(sock, 7)
                 if header is None:
                     return
                 if header[:2] != _MARKER:
                     return                       # corrupt stream: drop conn
-                size = struct.unpack(">i", header[2:])[0]
+                flags = header[2]
+                size = struct.unpack(">i", header[3:])[0]
                 body = self._read_exact(sock, size)
                 if body is None:
                     return
+                if flags & _FLAG_COMPRESSED:
+                    try:
+                        body = zlib.decompress(body)
+                    except zlib.error:
+                        return                   # corrupt stream: drop conn
                 self._handle_frame(sock, body)
         except OSError:
             return

@@ -320,3 +320,30 @@ def test_three_os_processes_form_cluster_and_survive_kill():
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 pass
+
+
+def test_cluster_with_frame_compression(tmp_path):
+    """The whole distributed stack — zen join, publish, replication,
+    search — over COMPRESSED tcp frames (transport.tcp.compress)."""
+    ports = _free_ports(2)
+    nodes = [Node({**_tcp_settings(ports, p, f"tcpc-{i}", 2),
+                   "transport.tcp.compress": True},
+                  data_path=tmp_path / f"c{i}")
+             for i, p in enumerate(ports)]
+    _start_all(nodes)
+    try:
+        a, b = nodes
+        a.indices_service.create_index("t", {"settings": {
+            "number_of_shards": 1, "number_of_replicas": 1}})
+        assert a.wait_for_health("green", timeout=20)["status"] == "green"
+        a.index_doc("t", "1", {"body": "hello " * 500})
+        a.broadcast_actions.refresh("t")
+        assert b.get_doc("t", "1")["_source"]["body"].startswith("hello")
+        res = b.search("t", {"query": {"match": {"body": "hello"}}})
+        assert res["hits"]["total"] == 1
+    finally:
+        for n in nodes:
+            try:
+                n.close()
+            except Exception:       # noqa: BLE001
+                pass

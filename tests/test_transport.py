@@ -232,3 +232,66 @@ def test_tcp_connect_failure():
             a.submit_request(ghost, "x", {}, timeout=2.0)
     finally:
         a.close()
+
+
+def test_tcp_compression_roundtrip():
+    """transport.tcp.compress: large frames deflate on the wire (the
+    reference's optional LZF bit, NettyTransport `transport.tcp.compress`)
+    and a non-compressing peer still interoperates (per-frame flag)."""
+    a = TransportService(
+        TcpTransport(compress=True),
+        lambda addr: DiscoveryNode(random_node_id(), "tcp_a", addr))
+    b = TransportService(
+        TcpTransport(),                 # replies uncompressed
+        lambda addr: DiscoveryNode(random_node_id(), "tcp_b", addr))
+    try:
+        big = {"blob": "x" * 50_000, "n": 1}
+        b.register_request_handler(
+            "test:echo", lambda req, src: {"len": len(req["blob"])},
+            sync=True)
+        resp = a.submit_request(b.local_node, "test:echo", big,
+                                timeout=10.0)
+        assert resp == {"len": 50_000}
+        # tiny frames skip compression (threshold)
+        resp = a.submit_request(b.local_node, "test:echo",
+                                {"blob": "y", "n": 2}, timeout=10.0)
+        assert resp == {"len": 1}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_tcp_channel_classes():
+    """Outbound sockets are per traffic class (NettyTransport
+    connectToNode channel groups): a recovery send and a ping send to the
+    same peer use DIFFERENT sockets."""
+    from elasticsearch_tpu.transport.tcp import channel_class
+    assert channel_class("internal:index/shard/recovery[file_chunk]") == \
+        "recovery"
+    assert channel_class("indices:data/write/bulk[s]") == "bulk"
+    assert channel_class("internal:discovery/zen/fd/master_ping") == "ping"
+    assert channel_class("internal:discovery/zen/publish/send") == "state"
+    assert channel_class("indices:data/read/search[phase/query]") == "reg"
+
+    a = TransportService(
+        TcpTransport(),
+        lambda addr: DiscoveryNode(random_node_id(), "tcp_a", addr))
+    b = TransportService(
+        TcpTransport(),
+        lambda addr: DiscoveryNode(random_node_id(), "tcp_b", addr))
+    try:
+        b.register_request_handler("internal:discovery/zen/fd/ping",
+                                   lambda r, s: {"ok": 1}, sync=True)
+        b.register_request_handler("indices:data/write/bulk",
+                                   lambda r, s: {"ok": 2}, sync=True)
+        assert a.submit_request(b.local_node,
+                                "internal:discovery/zen/fd/ping", {},
+                                timeout=10.0) == {"ok": 1}
+        assert a.submit_request(b.local_node, "indices:data/write/bulk",
+                                {}, timeout=10.0) == {"ok": 2}
+        tcp = a._transport if hasattr(a, "_transport") else a.transport
+        keys = {cls for (_addr, cls) in tcp._outbound}
+        assert {"ping", "bulk"} <= keys
+    finally:
+        a.close()
+        b.close()
