@@ -42,7 +42,44 @@ KIND_GEO = "geo"
 KIND_SHAPE = "shape"
 
 NUMERIC_TYPES = {"long", "integer", "short", "byte", "double", "float",
-                 "half_float", "date", "boolean", "murmur3"}
+                 "half_float", "date", "boolean", "murmur3", "ip",
+                 "token_count"}
+KIND_BINARY = "binary"
+
+
+def ip_to_long(v) -> int:
+    """Dotted-quad IPv4 → long, the reference's IpFieldMapper.ipToLong
+    (core/index/mapper/ip/IpFieldMapper.java) — indexed as a numeric
+    doc value so ranges and CIDR terms are ordinary numeric intervals."""
+    parts = str(v).split(".")
+    if len(parts) != 4:
+        raise MapperParsingError(f"failed to parse ip [{v}]")
+    out = 0
+    for p in parts:
+        try:
+            b = int(p)
+        except ValueError:
+            raise MapperParsingError(f"failed to parse ip [{v}]") \
+                from None
+        if not 0 <= b <= 255:
+            raise MapperParsingError(f"failed to parse ip [{v}]")
+        out = (out << 8) | b
+    return out
+
+
+def cidr_range(v: str) -> tuple[int, int]:
+    """'a.b.c.d/n' → (network, broadcast) longs."""
+    addr, _, bits = str(v).partition("/")
+    try:
+        n = int(bits)
+    except ValueError:
+        raise MapperParsingError(f"invalid CIDR mask [{v}]") from None
+    if not 0 <= n <= 32:
+        raise MapperParsingError(f"invalid CIDR mask [{v}]")
+    base = ip_to_long(addr)
+    mask = ((1 << 32) - 1) ^ ((1 << (32 - n)) - 1)
+    lo = base & mask
+    return lo, lo | ((1 << (32 - n)) - 1)
 
 POSITION_INCREMENT_GAP = 16
 
@@ -176,6 +213,15 @@ class FieldMapper:
                 if self.type == "completion" else None
         elif self.type in NUMERIC_TYPES:
             self.kind = KIND_NUMERIC
+            if self.type == "token_count":
+                # TokenCountFieldMapper: analyze the string, index the
+                # token count as a numeric doc value
+                self.analyzer = analysis.get(
+                    params.get("analyzer", "standard"))
+        elif self.type == "binary":
+            # BinaryFieldMapper: stored in _source only (not indexed, no
+            # doc values by default — matches the reference's defaults)
+            self.kind = KIND_BINARY
         elif self.type == "dense_vector":
             self.kind = KIND_VECTOR
             self.dims = int(params.get("dims", 0))
@@ -282,6 +328,14 @@ class FieldMapper:
                         raise MapperParsingError(
                             f"failed to parse [{self.name}] value [{v}] as boolean"
                         ) from None
+                elif self.type == "ip":
+                    if isinstance(v, (int, float)):
+                        pf.numerics.append(float(v))
+                    else:
+                        pf.numerics.append(float(ip_to_long(v)))
+                elif self.type == "token_count":
+                    pf.numerics.append(
+                        float(len(self.analyzer.analyze(str(v)))))
                 elif self.type == "murmur3":
                     # mapper-murmur3 plugin: index hash128(value).h1 as a
                     # long doc-value (Murmur3FieldMapper.java:137) — feeds

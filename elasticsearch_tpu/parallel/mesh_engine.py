@@ -55,6 +55,42 @@ _FLAGS = {
     "_doc_base": 0, "want_topk": True, "want_arrays": False,
 }
 
+#: metric aggregations the collective plane reduces IN-PROGRAM: per-shard
+#: partials from the query mask and numeric columns, then psum/pmin/pmax
+#: over the shard mesh axis (SURVEY §2.10 "aggregation tree reduce" on
+#: ICI instead of the host coordinator)
+_MESH_METRICS = ("min", "max", "sum", "avg", "value_count", "stats")
+
+
+def _mesh_agg_spec(reqs) -> tuple | None:
+    """Validate + extract a batch-uniform metric-agg spec.
+
+    → tuple of (name, kind, field), or None when there are no aggs.
+    Raises QueryParsingError for aggs the plane can't reduce (bucket
+    aggs, sub-aggs, scripts) or non-uniform specs — callers route those
+    to the RPC path.
+    """
+    specs = []
+    for req in reqs:
+        cur = []
+        for node in req.aggs:
+            # 'missing'/'script' change per-doc values — the RPC device
+            # path (aggregations.collect_device) rejects them the same way
+            if node.subs or node.pipelines or \
+                    node.type not in _MESH_METRICS or \
+                    "field" not in node.params or \
+                    set(node.params) - {"field", "format"}:
+                raise QueryParsingError(
+                    f"mesh engine plane cannot reduce agg "
+                    f"[{node.name}:{node.type}] in-program — use the "
+                    f"RPC fan-out path")
+            cur.append((node.name, node.type, str(node.params["field"])))
+        specs.append(tuple(cur))
+    if any(s != specs[0] for s in specs):
+        raise QueryParsingError(
+            "mesh engine plane requires one agg spec per batch")
+    return specs[0] or None
+
 
 def _pad2(a: np.ndarray, rows: int, cols: int, fill) -> np.ndarray:
     out = np.full((rows, cols), fill, a.dtype)
@@ -234,8 +270,13 @@ class MeshEngineSearcher:
     # ---- the program ------------------------------------------------------
 
     def _program(self, sigs, layouts, k: int, b_pad: int, consts_tree,
-                 emits, refss, templates0):
-        key = (tuple(sigs), tuple(layouts), k, b_pad)
+                 emits, refss, templates0, agg_spec=None):
+        # the compiled program depends only on WHICH fields get partials
+        # (names/kinds are host-side rendering) — key accordingly so
+        # renamed aggs share the executable
+        agg_fields = sorted({f for _, _, f in agg_spec}) if agg_spec \
+            else []
+        key = (tuple(sigs), tuple(layouts), k, b_pad, tuple(agg_fields))
         fn = self._programs.get(key)
         if fn is not None:
             return fn
@@ -243,11 +284,14 @@ class MeshEngineSearcher:
         slot_bases = self.slot_bases
         stride = self.shard_stride
         spd = self.spd
+        flags = dict(_FLAGS, want_arrays=bool(agg_fields))
 
         def step_local(flats, consts):
             # flats[j]: arrays [spd, Np_j, ...]; consts[j]: [spd, B_local, ...]
             dev_idx = jax.lax.axis_index("shard").astype(jnp.int32)
             cand_s, cand_d, counts = [], [], None
+            b_local = None
+            acc = {f: None for f in agg_fields}
             for li in range(spd):
                 seg_scores, seg_docs = [], []
                 for j in range(n_slots):
@@ -256,10 +300,55 @@ class MeshEngineSearcher:
 
                     def one(cs, j=j, view=view):
                         return _build(view, list(cs), emits[j], None,
-                                      refss[j], _FLAGS, k)
+                                      refss[j], flags, k)
 
                     outs = jax.vmap(one)(
                         jax.tree.map(lambda a, li=li: a[li], consts[j]))
+                    if agg_fields:
+                        # per-shard metric partials from the query mask,
+                        # reduced over ICI after the loop. Values are the
+                        # DOUBLE-DOUBLE (hi, lo) split — summing/extrema
+                        # on hi alone would drop the f64 residual the
+                        # device agg path preserves (aggregations.py
+                        # _d_metric / _dd_extrema)
+                        amask = outs["agg_mask"]          # [B, N]
+                        b_local = amask.shape[0]
+                        for f in agg_fields:
+                            ncol = view.numeric.get(f)
+                            if ncol is None:
+                                continue
+                            m = amask & ncol.exists[None, :]
+                            hi = ncol.hi[None, :]
+                            lo = ncol.lo[None, :]
+                            p = [
+                                jnp.where(m, hi, 0.0).sum(axis=1),
+                                jnp.where(m, lo, 0.0).sum(axis=1),
+                                m.sum(axis=1).astype(jnp.int32),
+                            ]
+                            mn_hi = jnp.where(m, hi, jnp.inf).min(axis=1)
+                            mn_lo = jnp.where(
+                                m & (hi == mn_hi[:, None]), lo,
+                                jnp.inf).min(axis=1)
+                            mx_hi = jnp.where(m, hi, -jnp.inf).max(axis=1)
+                            mx_lo = jnp.where(
+                                m & (hi == mx_hi[:, None]), lo,
+                                -jnp.inf).max(axis=1)
+                            p += [mn_hi, mn_lo, mx_hi, mx_lo]
+                            if acc[f] is None:
+                                acc[f] = p
+                            else:
+                                a0 = acc[f]
+                                pick_mn = (p[3] < a0[3]) | \
+                                    ((p[3] == a0[3]) & (p[4] < a0[4]))
+                                pick_mx = (p[5] > a0[5]) | \
+                                    ((p[5] == a0[5]) & (p[6] > a0[6]))
+                                acc[f] = [
+                                    a0[0] + p[0], a0[1] + p[1],
+                                    a0[2] + p[2],
+                                    jnp.where(pick_mn, p[3], a0[3]),
+                                    jnp.where(pick_mn, p[4], a0[4]),
+                                    jnp.where(pick_mx, p[5], a0[5]),
+                                    jnp.where(pick_mx, p[6], a0[6])]
                     docs = jnp.where(outs["top_docs"] >= 0,
                                      outs["top_docs"] + slot_bases[j], -1)
                     seg_scores.append(outs["top_scores"])
@@ -307,17 +396,60 @@ class MeshEngineSearcher:
             g_d = jnp.take_along_axis(flat_d, pos, axis=1)
             g_d = jnp.where(g_s > -jnp.inf, g_d, -1)
             g_s = jnp.where(g_s > -jnp.inf, g_s, -jnp.inf)
-            return g_s, g_d, totals
+            if not agg_fields:
+                return g_s, g_d, totals
+
+            # metric partials reduce over the shard axis in-program:
+            # psum for sums/count; (hi, lo) extrema pairs reduce
+            # lexicographically over an all_gather (pmin on hi alone
+            # would detach the lo residual from its hi)
+            def pair_reduce(hi_v, lo_v, is_min: bool):
+                ah = jax.lax.all_gather(hi_v, "shard")     # [S, B]
+                al = jax.lax.all_gather(lo_v, "shard")
+                rh, rl = ah[0], al[0]
+                for s in range(1, ah.shape[0]):
+                    bh, bl = ah[s], al[s]
+                    if is_min:
+                        pick = (bh < rh) | ((bh == rh) & (bl < rl))
+                    else:
+                        pick = (bh > rh) | ((bh == rh) & (bl > rl))
+                    rh = jnp.where(pick, bh, rh)
+                    rl = jnp.where(pick, bl, rl)
+                return rh, rl
+
+            agg_out = []
+            for f in agg_fields:
+                a0 = acc[f]
+                if a0 is None:                   # field absent everywhere
+                    a0 = [jnp.zeros(b_local, jnp.float32),
+                          jnp.zeros(b_local, jnp.float32),
+                          jnp.zeros(b_local, jnp.int32),
+                          jnp.full(b_local, jnp.inf, jnp.float32),
+                          jnp.full(b_local, jnp.inf, jnp.float32),
+                          jnp.full(b_local, -jnp.inf, jnp.float32),
+                          jnp.full(b_local, -jnp.inf, jnp.float32)]
+                mn_hi, mn_lo = pair_reduce(a0[3], a0[4], True)
+                mx_hi, mx_lo = pair_reduce(a0[5], a0[6], False)
+                agg_out.append((
+                    jax.lax.psum(a0[0], "shard"),
+                    jax.lax.psum(a0[1], "shard"),
+                    jax.lax.psum(a0[2], "shard"),
+                    mn_hi, mn_lo, mx_hi, mx_lo))
+            return g_s, g_d, totals, tuple(agg_out)
 
         flat_specs = [[P("shard")] * len(self._flats[j])
                       for j in range(n_slots)]
         const_specs = [jax.tree.map(lambda _: P("shard", "dp"),
                                     consts_tree[j])
                        for j in range(n_slots)]
+        out_specs = (P("dp"), P("dp"), P("dp"))
+        if agg_fields:
+            out_specs = out_specs + (
+                tuple((P("dp"),) * 7 for _ in agg_fields),)
         mapped = shard_map(
             step_local, mesh=self.mesh,
             in_specs=(flat_specs, const_specs),
-            out_specs=(P("dp"), P("dp"), P("dp")),
+            out_specs=out_specs,
             check_vma=False)
         fn = jax.jit(mapped)
         self._programs[key] = fn
@@ -331,7 +463,7 @@ class MeshEngineSearcher:
             return []
         reqs = [parse_search_request(b) for b in bodies]
         for req in reqs:
-            if (req.aggs or req.sort or req.post_filter is not None
+            if (req.sort or req.post_filter is not None
                     or req.min_score is not None
                     or req.search_after is not None or req.suggest
                     or req.terminate_after is not None
@@ -339,6 +471,7 @@ class MeshEngineSearcher:
                 raise QueryParsingError(
                     "mesh engine plane supports score-ordered top-k "
                     "requests — route others to the RPC path")
+        agg_spec = _mesh_agg_spec(reqs)
         import os
         import time
         debug = os.environ.get("MESH_DEBUG")
@@ -399,8 +532,11 @@ class MeshEngineSearcher:
         fn = self._program(sigs, layouts, k, b_pad, consts_dev,
                            emits, refss,
                            [self._templates[0][j]
-                            for j in range(self.n_slots)])
-        g_s, g_d, totals = fn(self._flats, consts_dev)
+                            for j in range(self.n_slots)],
+                           agg_spec=agg_spec)
+        outs = fn(self._flats, consts_dev)
+        g_s, g_d, totals = outs[0], outs[1], outs[2]
+        agg_arrays = outs[3] if agg_spec else None
         t2 = time.perf_counter()
         g_s, g_d = np.asarray(g_s), np.asarray(g_d)
         totals = np.asarray(totals)
@@ -410,13 +546,46 @@ class MeshEngineSearcher:
                   f"dispatch {(t2-t1)*1e3:.0f}ms "
                   f"fetch {(time.perf_counter()-t2)*1e3:.0f}ms",
                   flush=True)
+        agg_np = None
+        if agg_spec:
+            fields = sorted({f for _, _, f in agg_spec})
+            agg_np = {f: [np.asarray(a) for a in agg_arrays[i]]
+                      for i, f in enumerate(fields)}
         out = []
         for bi, req in enumerate(reqs):
             kq = max(req.from_ + req.size, 1)
             valid = g_d[bi] >= 0
-            out.append({"total": int(totals[bi]),
-                        "scores": g_s[bi][valid][:kq],
-                        "doc_ids": g_d[bi][valid][:kq]})
+            res = {"total": int(totals[bi]),
+                   "scores": g_s[bi][valid][:kq],
+                   "doc_ids": g_d[bi][valid][:kq]}
+            if agg_spec:
+                res["aggregations"] = self._render_aggs(agg_spec, agg_np,
+                                                        bi)
+            out.append(res)
+        return out
+
+    @staticmethod
+    def _render_aggs(agg_spec, agg_np, bi: int) -> dict:
+        """Partials → the reference's metric agg response shapes (hi+lo
+        recombined in f64, like aggregations.py's device reductions)."""
+        out: dict = {}
+        for name, kind, f in agg_spec:
+            s_hi, s_lo, c_, mn_hi, mn_lo, mx_hi, mx_lo = \
+                (arr[bi] for arr in agg_np[f])
+            c_ = int(c_)
+            s_ = float(np.float64(s_hi) + np.float64(s_lo))
+            mn = float(np.float64(mn_hi) + np.float64(mn_lo)) if c_ \
+                else None
+            mx = float(np.float64(mx_hi) + np.float64(mx_lo)) if c_ \
+                else None
+            avg = (s_ / c_) if c_ else None
+            out[name] = {
+                "min": {"value": mn}, "max": {"value": mx},
+                "sum": {"value": s_}, "value_count": {"value": c_},
+                "avg": {"value": avg},
+                "stats": {"count": c_, "min": mn, "max": mx,
+                          "sum": s_, "avg": avg},
+            }[kind]
         return out
 
     # ---- doc id resolution ------------------------------------------------

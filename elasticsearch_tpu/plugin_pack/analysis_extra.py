@@ -214,12 +214,14 @@ class KuromojiAnalysisPlugin(Plugin):
 
     def analysis(self, registry) -> None:
         from elasticsearch_tpu.plugin_pack import morph_ja
+        chain = [morph_ja.kuromoji_baseform_filter,
+                 morph_ja.kuromoji_stemmer_filter, morph_ja.ja_stop_filter]
         registry.analyzers["kuromoji"] = Analyzer(
-            "kuromoji", morph_ja.kuromoji_tokenizer,
-            [morph_ja.kuromoji_stemmer_filter, morph_ja.ja_stop_filter])
+            "kuromoji", morph_ja.kuromoji_tokenizer, list(chain))
         registry.analyzers["kuromoji_search"] = Analyzer(
-            "kuromoji_search", morph_ja.kuromoji_tokenizer,
-            [morph_ja.kuromoji_stemmer_filter, morph_ja.ja_stop_filter])
+            "kuromoji_search", morph_ja.kuromoji_tokenizer, list(chain))
+        registry.filter_factories["kuromoji_baseform"] = \
+            lambda params: morph_ja.kuromoji_baseform_filter
         registry.filter_factories["kuromoji_stemmer"] = \
             lambda params: morph_ja.kuromoji_stemmer_filter
         registry.filter_factories["ja_stop"] = \

@@ -40,11 +40,31 @@ _add("n", 250, "æ—¥æœ¬ æ±äº¬ å¤§é˜ª äº¬éƒ½ å­¦æ ¡ å­¦ç”Ÿ å…ˆç”Ÿ ä¼šç¤¾ ä¼šç¤¾å“
      "è¨€è‘‰ æ—¥æœ¬èª è‹±èª åå‰ ä»•äº‹ å®¶ åº— é§… é“ ç”º å›½ ä¸–ç•Œ å•é¡Œ æ¤œç´¢ æƒ…å ± æŠ€è¡“ é–‹ç™º")
 # administrative suffixes: cheap enough that æ±äº¬+éƒ½ beats æ±+äº¬éƒ½
 _add("n", 380, "éƒ½ çœŒ å¸‚ åŒº æ‘ é§…å‰ å¤§å­¦")
-_add("v", 300, "è¡Œã è¡Œã è¡Œãã¾ã™ è¡Œã£ãŸ è¡Œã£ã¦ æ¥ã‚‹ æ¥ã¾ã™ æ¥ãŸ æ¥ã¦ è¦‹ã‚‹ è¦‹ã¾ã™ è¦‹ãŸ è¦‹ã¦ "
-     "é£Ÿã¹ã‚‹ é£Ÿã¹ã¾ã™ é£Ÿã¹ãŸ é£Ÿã¹ã¦ é£²ã‚€ é£²ã¿ã¾ã™ é£²ã‚“ã  è²·ã† è²·ã„ã¾ã™ è²·ã£ãŸ è²·ã„ã¾ã—ãŸ "
-     "èª­ã‚€ èª­ã¿ã¾ã™ èª­ã‚“ã  æ›¸ã æ›¸ãã¾ã™ æ›¸ã„ãŸ è©±ã™ è©±ã—ã¾ã™ è©±ã—ãŸ èã èãã¾ã™ èã„ãŸ "
-     "ã™ã‚‹ ã—ã¾ã™ ã—ãŸ ã—ã¦ æ€ã† æ€ã„ã¾ã™ æ€ã£ãŸ åˆ†ã‹ã‚‹ åˆ†ã‹ã‚Šã¾ã™ åˆ†ã‹ã£ãŸ ä½¿ã† ä½¿ã„ã¾ã™ "
-     "ä½ã‚€ ä½ã¿ã¾ã™ ä½ã‚“ã  ä½ã‚“ã§ åƒã åƒãã¾ã™ åƒã„ãŸ")
+# verb base form â†’ its common conjugations; both directions feed the
+# lexicon, and the mapping backs the kuromoji_baseform token filter
+_VERB_GROUPS = {
+    "è¡Œã": "è¡Œã è¡Œãã¾ã™ è¡Œã£ãŸ è¡Œã£ã¦",
+    "æ¥ã‚‹": "æ¥ã¾ã™ æ¥ãŸ æ¥ã¦",
+    "è¦‹ã‚‹": "è¦‹ã¾ã™ è¦‹ãŸ è¦‹ã¦",
+    "é£Ÿã¹ã‚‹": "é£Ÿã¹ã¾ã™ é£Ÿã¹ãŸ é£Ÿã¹ã¦",
+    "é£²ã‚€": "é£²ã¿ã¾ã™ é£²ã‚“ã ",
+    "è²·ã†": "è²·ã„ã¾ã™ è²·ã£ãŸ è²·ã„ã¾ã—ãŸ",
+    "èª­ã‚€": "èª­ã¿ã¾ã™ èª­ã‚“ã ",
+    "æ›¸ã": "æ›¸ãã¾ã™ æ›¸ã„ãŸ",
+    "è©±ã™": "è©±ã—ã¾ã™ è©±ã—ãŸ",
+    "èã": "èãã¾ã™ èã„ãŸ",
+    "ã™ã‚‹": "ã—ã¾ã™ ã—ãŸ ã—ã¦",
+    "æ€ã†": "æ€ã„ã¾ã™ æ€ã£ãŸ",
+    "åˆ†ã‹ã‚‹": "åˆ†ã‹ã‚Šã¾ã™ åˆ†ã‹ã£ãŸ",
+    "ä½¿ã†": "ä½¿ã„ã¾ã™",
+    "ä½ã‚€": "ä½ã¿ã¾ã™ ä½ã‚“ã  ä½ã‚“ã§",
+    "åƒã": "åƒãã¾ã™ åƒã„ãŸ",
+}
+BASEFORMS: dict[str, str] = {
+    conj: base for base, conjs in _VERB_GROUPS.items()
+    for conj in conjs.split()}
+for _base, _conjs in _VERB_GROUPS.items():
+    _add("v", 300, _base + " " + _conjs)
 _add("adj", 300, "é«˜ã„ å®‰ã„ å¤§ãã„ å°ã•ã„ æ–°ã—ã„ å¤ã„ è‰¯ã„ æ‚ªã„ æ—©ã„ é…ã„ ç¾ã—ã„ ãŠã„ã—ã„ "
      "æ¥½ã—ã„ é›£ã—ã„ æ˜“ã—ã„ æš‘ã„ å¯’ã„")
 _add("adv", 300, "ã¨ã¦ã‚‚ ã™ã“ã— å°‘ã— ãŸãã•ã‚“ ã‚‚ã† ã¾ã  ã‚ˆã ã„ã¤ã‚‚")
@@ -177,6 +197,13 @@ def kuromoji_stemmer_filter(tokens: list[Token]) -> list[Token]:
 
 def ja_stop_filter(tokens: list[Token]) -> list[Token]:
     return [t for t in tokens if t.term not in JA_STOPWORDS]
+
+
+def kuromoji_baseform_filter(tokens: list[Token]) -> list[Token]:
+    """JapaneseBaseFormFilter analog: conjugated verbs conflate to their
+    dictionary (base) form, so è¡Œãã¾ã™ / è¡Œã£ãŸ / è¡Œã all match."""
+    return [Token(BASEFORMS.get(t.term, t.term), t.position,
+                  t.start_offset, t.end_offset) for t in tokens]
 
 
 def normalize_nfkc(text: str) -> str:

@@ -272,6 +272,9 @@ class SegmentResolver:
         if fm is not None and fm.type == "date" and not isinstance(
                 value, (int, float)):
             return parse_date(value)
+        if fm is not None and fm.type == "ip" and isinstance(value, str):
+            from elasticsearch_tpu.mapping.mapper import ip_to_long
+            return float(ip_to_long(value))
         if isinstance(value, bool):
             return 1.0 if value else 0.0
         return float(value)
@@ -595,6 +598,14 @@ class SegmentResolver:
     def _res_TermQuery(self, query: q.TermQuery) -> Emit:
         # term on text fields scores BM25 like a single-term match (Lucene
         # TermQuery); on keyword/numeric doc values it is constant-score.
+        fm = self.ctx.mapper_service.field_mapper(query.field)
+        if fm is not None and fm.type == "ip" and \
+                isinstance(query.value, str) and "/" in query.value:
+            # CIDR term → numeric interval (IpFieldMapper termQuery)
+            from elasticsearch_tpu.mapping.mapper import cidr_range
+            lo, hi = cidr_range(query.value)
+            return self.resolve(q.RangeQuery(field=query.field, gte=lo,
+                                             lte=hi, boost=query.boost))
         tcol = self.seg.text.get(query.field)
         if tcol is not None and self.seg.keyword.get(query.field) is None:
             return self.resolve(q.MatchQuery(

