@@ -120,6 +120,7 @@ TOKENIZERS: dict[str, Tokenizer] = {
     "whitespace": whitespace_tokenizer,
     "letter": letter_tokenizer,
     "keyword": keyword_tokenizer,
+    "classic": standard_tokenizer,
 }
 
 
@@ -314,6 +315,253 @@ def porter_stem_filter(tokens: Iterable[Token]) -> list[Token]:
 
 TokenFilter = Callable[[Iterable[Token]], list[Token]]
 
+def trim_filter(tokens: Iterable[Token]) -> list[Token]:
+    return [Token(t.term.strip(), t.position, t.start_offset,
+                  t.end_offset) for t in tokens]
+
+
+def reverse_filter(tokens: Iterable[Token]) -> list[Token]:
+    return [Token(t.term[::-1], t.position, t.start_offset, t.end_offset)
+            for t in tokens]
+
+
+def truncate_filter_factory(length: int = 10) -> "TokenFilter":
+    def f(tokens: Iterable[Token]) -> list[Token]:
+        return [Token(t.term[:length], t.position, t.start_offset,
+                      t.end_offset) for t in tokens]
+    return f
+
+
+def limit_filter_factory(max_token_count: int = 1) -> "TokenFilter":
+    def f(tokens: Iterable[Token]) -> list[Token]:
+        return list(tokens)[:max_token_count]
+    return f
+
+
+def decimal_digit_filter(tokens: Iterable[Token]) -> list[Token]:
+    """Unicode decimal digits → ASCII 0-9 (DecimalDigitFilter)."""
+    import unicodedata
+
+    def fold(s: str) -> str:
+        return "".join(str(unicodedata.decimal(c)) if
+                       unicodedata.category(c) == "Nd" else c for c in s)
+    return [Token(fold(t.term), t.position, t.start_offset, t.end_offset)
+            for t in tokens]
+
+
+def cjk_width_filter(tokens: Iterable[Token]) -> list[Token]:
+    """Full-width ASCII / half-width katakana normalization
+    (CJKWidthFilter ≈ NFKC on those ranges)."""
+    import unicodedata
+    return [Token(unicodedata.normalize("NFKC", t.term), t.position,
+                  t.start_offset, t.end_offset) for t in tokens]
+
+
+_ELISION_ARTICLES = frozenset(
+    "l m t qu n s j d c jusqu quoiqu lorsqu puisqu".split())
+
+
+def elision_filter_factory(articles=None) -> "TokenFilter":
+    arts = frozenset(a.lower() for a in articles) if articles \
+        else _ELISION_ARTICLES
+
+    def f(tokens: Iterable[Token]) -> list[Token]:
+        out = []
+        for t in tokens:
+            term = t.term
+            for sep in ("'", "’"):
+                head, s, tail = term.partition(sep)
+                if s and head.lower() in arts:
+                    term = tail
+                    break
+            out.append(Token(term, t.position, t.start_offset,
+                             t.end_offset))
+        return out
+    return f
+
+
+def apostrophe_filter(tokens: Iterable[Token]) -> list[Token]:
+    """Strip everything after an apostrophe (ApostropheFilter)."""
+    return [Token(t.term.partition("'")[0] or t.term, t.position,
+                  t.start_offset, t.end_offset) for t in tokens]
+
+
+def keep_filter_factory(keep_words) -> "TokenFilter":
+    kept = frozenset(keep_words)
+
+    def f(tokens: Iterable[Token]) -> list[Token]:
+        return [t for t in tokens if t.term in kept]
+    return f
+
+
+def edge_ngram_filter_factory(min_gram: int = 1,
+                              max_gram: int = 2) -> "TokenFilter":
+    def f(tokens: Iterable[Token]) -> list[Token]:
+        out = []
+        for t in tokens:
+            for n in range(min_gram, min(max_gram, len(t.term)) + 1):
+                out.append(Token(t.term[:n], t.position, t.start_offset,
+                                 t.end_offset))
+        return out
+    return f
+
+
+def ngram_filter_factory(min_gram: int = 1,
+                         max_gram: int = 2) -> "TokenFilter":
+    def f(tokens: Iterable[Token]) -> list[Token]:
+        out = []
+        for t in tokens:
+            for n in range(min_gram, max_gram + 1):
+                for i in range(0, len(t.term) - n + 1):
+                    out.append(Token(t.term[i:i + n], t.position,
+                                     t.start_offset, t.end_offset))
+        return out
+    return f
+
+
+def pattern_replace_filter_factory(pattern: str,
+                                   replacement: str = "") -> "TokenFilter":
+    rx = re.compile(pattern)
+
+    def f(tokens: Iterable[Token]) -> list[Token]:
+        return [Token(rx.sub(replacement, t.term), t.position,
+                      t.start_offset, t.end_offset) for t in tokens]
+    return f
+
+
+def synonym_filter_factory(synonyms: list) -> "TokenFilter":
+    """Inline synonym list (SynonymTokenFilterFactory), Solr format:
+    'a, b => c' maps a and b to c; 'a, b, c' makes the group equivalent
+    (every member expands to all members, same position)."""
+    expand: dict[str, list[str]] = {}
+    for rule in synonyms or []:
+        if "=>" in rule:
+            lhs, rhs = rule.split("=>", 1)
+            targets = [w.strip() for w in rhs.split(",") if w.strip()]
+            for src in (w.strip() for w in lhs.split(",")):
+                if src:
+                    expand[src] = targets
+        else:
+            group = [w.strip() for w in rule.split(",") if w.strip()]
+            for src in group:
+                expand[src] = group
+
+    def f(tokens: Iterable[Token]) -> list[Token]:
+        # multi-word targets expand to consecutive positions and shift
+        # everything after them (a flattened SynonymGraph: "ny => new
+        # york" keeps "new york" phrase-matchable)
+        out = []
+        shift = 0
+        for t in tokens:
+            base = t.position + shift
+            terms = expand.get(t.term)
+            if terms is None:
+                out.append(Token(t.term, base, t.start_offset,
+                                 t.end_offset))
+                continue
+            width = 1
+            seen = set()
+            for term in terms:
+                if term in seen:
+                    continue
+                seen.add(term)
+                words = term.split()
+                for wi, w in enumerate(words):
+                    out.append(Token(w, base + wi, t.start_offset,
+                                     t.end_offset))
+                width = max(width, len(words))
+            shift += width - 1
+        return out
+    return f
+
+
+_WORD_DELIM_SPLIT = re.compile(
+    r"[A-Z]?[a-z]+|[A-Z]+(?![a-z])|\d+")
+
+
+def word_delimiter_filter_factory(params: dict) -> "TokenFilter":
+    """WordDelimiterTokenFilterFactory core behavior: split on case
+    transitions / letter-digit boundaries / intra-word punctuation;
+    optionally keep the original token."""
+    preserve = str(params.get("preserve_original",
+                              "false")).lower() in ("true", "1")
+
+    def f(tokens: Iterable[Token]) -> list[Token]:
+        out = []
+        for t in tokens:
+            parts = _WORD_DELIM_SPLIT.findall(t.term)
+            if len(parts) <= 1:
+                # no split: one token, whether or not preserving (Lucene
+                # emits the original exactly once here)
+                out.append(Token(parts[0] if parts else t.term,
+                                 t.position, t.start_offset,
+                                 t.end_offset))
+                continue
+            if preserve:
+                out.append(t)
+            for p in parts:
+                out.append(Token(p, t.position, t.start_offset,
+                                 t.end_offset))
+        return out
+    return f
+
+
+def edge_ngram_tokenizer_factory(min_gram: int = 1,
+                                 max_gram: int = 2) -> "Tokenizer":
+    def tok(text: str) -> list[Token]:
+        out = []
+        for n in range(min_gram, min(max_gram, len(text)) + 1):
+            out.append(Token(text[:n], 0, 0, n))
+        return out
+    return tok
+
+
+def pattern_tokenizer_factory(pattern: str = r"\W+",
+                              group: int = -1) -> "Tokenizer":
+    rx = re.compile(pattern)
+
+    def tok(text: str) -> list[Token]:
+        out = []
+        if group >= 0:
+            for pos, m in enumerate(rx.finditer(text)):
+                out.append(Token(m.group(group), pos, m.start(), m.end()))
+            return out
+        pos = 0
+        idx = 0
+        for part in rx.split(text):
+            if part:
+                start = text.index(part, idx)
+                out.append(Token(part, pos, start, start + len(part)))
+                pos += 1
+                idx = start + len(part)
+        return out
+    return tok
+
+
+def path_hierarchy_tokenizer_factory(delimiter: str = "/") -> "Tokenizer":
+    def tok(text: str) -> list[Token]:
+        out = []
+        parts = text.split(delimiter)
+        acc = ""
+        for i, part in enumerate(parts):
+            acc = part if i == 0 else acc + delimiter + part
+            if acc:
+                out.append(Token(acc, 0, 0, len(acc)))
+        return out
+    return tok
+
+
+_URL_EMAIL = re.compile(
+    r"https?://[^\s]+|[\w.+-]+@[\w-]+\.[\w.-]+|\w+")
+
+
+def uax_url_email_tokenizer(text: str) -> list[Token]:
+    # no case folding here — that is the lowercase filter's job, like
+    # Lucene's UAX29URLEmailTokenizer
+    return [Token(m.group(0), pos, m.start(), m.end())
+            for pos, m in enumerate(_URL_EMAIL.finditer(text))]
+
+
 TOKEN_FILTERS: dict[str, TokenFilter] = {
     "lowercase": lowercase_filter,
     "uppercase": uppercase_filter,
@@ -321,16 +569,42 @@ TOKEN_FILTERS: dict[str, TokenFilter] = {
     "stop": stop_filter_factory(),
     "porter_stem": porter_stem_filter,
     "stemmer": porter_stem_filter,
+    "kstem": porter_stem_filter,
+    "snowball": porter_stem_filter,
     "unique": unique_filter,
     "shingle": shingle_filter_factory(),
     "length": length_filter_factory(),
+    "trim": trim_filter,
+    "reverse": reverse_filter,
+    "truncate": truncate_filter_factory(),
+    "decimal_digit": decimal_digit_filter,
+    "cjk_width": cjk_width_filter,
+    "elision": elision_filter_factory(),
+    "apostrophe": apostrophe_filter,
+    "edge_ngram": edge_ngram_filter_factory(),
+    "edgeNGram": edge_ngram_filter_factory(),
+    "ngram": ngram_filter_factory(),
+    "nGram": ngram_filter_factory(),
+    "word_delimiter": word_delimiter_filter_factory({}),
 }
+
+# tokenizers defined below the static table register here
+TOKENIZERS["uax_url_email"] = uax_url_email_tokenizer
+TOKENIZERS["edge_ngram"] = edge_ngram_tokenizer_factory()
+TOKENIZERS["path_hierarchy"] = path_hierarchy_tokenizer_factory()
+TOKENIZERS["pattern"] = pattern_tokenizer_factory()
 
 # Parameterized component factories, used for custom definitions in index
 # settings (``analysis.tokenizer.<name>.type`` / ``analysis.filter.<name>.type``).
 TOKENIZER_FACTORIES: dict[str, Callable[..., Tokenizer]] = {
     "ngram": lambda params: ngram_tokenizer_factory(
         int(params.get("min_gram", 1)), int(params.get("max_gram", 2))),
+    "edge_ngram": lambda params: edge_ngram_tokenizer_factory(
+        int(params.get("min_gram", 1)), int(params.get("max_gram", 2))),
+    "pattern": lambda params: pattern_tokenizer_factory(
+        str(params.get("pattern", r"\W+")), int(params.get("group", -1))),
+    "path_hierarchy": lambda params: path_hierarchy_tokenizer_factory(
+        str(params.get("delimiter", "/"))),
 }
 
 TOKEN_FILTER_FACTORIES: dict[str, Callable[..., TokenFilter]] = {
@@ -343,6 +617,24 @@ TOKEN_FILTER_FACTORIES: dict[str, Callable[..., TokenFilter]] = {
         int(params.get("min_shingle_size", 2)),
         int(params.get("max_shingle_size", 2)),
         params.get("token_separator", " ")),
+    "truncate": lambda params: truncate_filter_factory(
+        int(params.get("length", 10))),
+    "limit": lambda params: limit_filter_factory(
+        int(params.get("max_token_count", 1))),
+    "elision": lambda params: elision_filter_factory(
+        params.get("articles")),
+    "keep": lambda params: keep_filter_factory(
+        params.get("keep_words", [])),
+    "edge_ngram": lambda params: edge_ngram_filter_factory(
+        int(params.get("min_gram", 1)), int(params.get("max_gram", 2))),
+    "ngram": lambda params: ngram_filter_factory(
+        int(params.get("min_gram", 1)), int(params.get("max_gram", 2))),
+    "pattern_replace": lambda params: pattern_replace_filter_factory(
+        str(params.get("pattern", "")),
+        str(params.get("replacement", ""))),
+    "synonym": lambda params: synonym_filter_factory(
+        params.get("synonyms", [])),
+    "word_delimiter": word_delimiter_filter_factory,
 }
 
 

@@ -52,7 +52,8 @@ class AssertingEngine(Engine):
         # view and the versions map legitimately disagree
         with self._lock:
             stable = len(self._buffer) == 0 and \
-                self._reader.generation == view.generation
+                self._reader.generation == view.generation and \
+                not getattr(self, "_pending_seg_deletes", None)
             dc = sum(1 for e in self._versions.values() if not e.deleted)
         if stable:
             assert live_total == dc, \

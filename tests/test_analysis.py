@@ -91,3 +91,140 @@ class TestPorterStemmer:
     ])
     def test_vocabulary(self, word, stem):
         assert porter_stem(word) == stem
+
+
+class TestProviderBreadth:
+    """Round-4 provider tranche (AnalysisModule's ~150 providers: the
+    commonly-used subset)."""
+
+    def _an(self, settings=None):
+        from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+        from elasticsearch_tpu.common.settings import Settings
+        return AnalysisRegistry(Settings(settings or {}))
+
+    def test_filters(self):
+        from elasticsearch_tpu.analysis.analyzers import (
+            Token, TOKEN_FILTERS)
+        t = [Token("  FooBar42  ".strip(), 0, 0, 10)]
+        assert TOKEN_FILTERS["reverse"](
+            [Token("abc", 0, 0, 3)])[0].term == "cba"
+        assert TOKEN_FILTERS["truncate"](
+            [Token("abcdefghijklmno", 0, 0, 15)])[0].term == "abcdefghij"
+        assert TOKEN_FILTERS["trim"](
+            [Token(" x ", 0, 0, 3)])[0].term == "x"
+        assert TOKEN_FILTERS["decimal_digit"](
+            [Token("١٢٣", 0, 0, 3)])[0].term == "123"
+        assert TOKEN_FILTERS["cjk_width"](
+            [Token("ＡＢＣ", 0, 0, 3)])[0].term == "ABC"
+        assert TOKEN_FILTERS["elision"](
+            [Token("l'avion", 0, 0, 7)])[0].term == "avion"
+        assert TOKEN_FILTERS["apostrophe"](
+            [Token("Türkiye'den", 0, 0, 11)])[0].term == "Türkiye"
+        wd = [x.term for x in TOKEN_FILTERS["word_delimiter"](t)]
+        assert wd == ["Foo", "Bar", "42"]
+        eg = [x.term for x in TOKEN_FILTERS["edge_ngram"](
+            [Token("abc", 0, 0, 3)])]
+        assert eg == ["a", "ab"]
+
+    def test_synonym_filter_through_index(self, tmp_path):
+        from elasticsearch_tpu.node import Node
+        n = Node({}, data_path=tmp_path / "syn").start()
+        n.indices_service.create_index("s", {
+            "settings": {
+                "number_of_shards": 1, "number_of_replicas": 0,
+                "analysis": {
+                    "filter": {"syn": {
+                        "type": "synonym",
+                        "synonyms": ["car, automobile",
+                                     "tv => television"]}},
+                    "analyzer": {"a": {
+                        "type": "custom", "tokenizer": "standard",
+                        "filter": ["lowercase", "syn"]}}}},
+            "mappings": {"_doc": {"properties": {
+                "t": {"type": "text", "analyzer": "a"}}}}})
+        n.index_doc("s", "1", {"t": "my car is fast"}, refresh=True)
+        n.index_doc("s", "2", {"t": "watching tv"}, refresh=True)
+        r = n.search("s", {"query": {"match": {"t": "automobile"}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"1"}
+        r = n.search("s", {"query": {"match": {"t": "television"}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"2"}
+        n.close()
+
+    def test_edge_ngram_search_as_you_type(self, tmp_path):
+        from elasticsearch_tpu.node import Node
+        n = Node({}, data_path=tmp_path / "eg").start()
+        n.indices_service.create_index("e", {
+            "settings": {
+                "number_of_shards": 1, "number_of_replicas": 0,
+                "analysis": {
+                    "filter": {"autocomplete": {
+                        "type": "edge_ngram", "min_gram": 2,
+                        "max_gram": 8}},
+                    "analyzer": {
+                        "index_a": {"type": "custom",
+                                    "tokenizer": "standard",
+                                    "filter": ["lowercase",
+                                               "autocomplete"]},
+                        "search_a": {"type": "custom",
+                                     "tokenizer": "standard",
+                                     "filter": ["lowercase"]}}}},
+            "mappings": {"_doc": {"properties": {
+                "t": {"type": "text", "analyzer": "index_a",
+                      "search_analyzer": "search_a"}}}}})
+        n.index_doc("e", "1", {"t": "elasticsearch"}, refresh=True)
+        r = n.search("e", {"query": {"match": {"t": "elast"}}})
+        assert r["hits"]["total"] == 1
+        n.close()
+
+    def test_tokenizers(self):
+        from elasticsearch_tpu.analysis.analyzers import TOKENIZERS
+        assert [t.term for t in TOKENIZERS["path_hierarchy"](
+            "/usr/local/bin")] == ["/usr", "/usr/local", "/usr/local/bin"]
+        terms = [t.term for t in TOKENIZERS["uax_url_email"](
+            "mail me@example.com or see https://x.io/a?b=1 now")]
+        assert "me@example.com" in terms
+        assert "https://x.io/a?b=1" in terms
+        reg = None
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+        reg = AnalysisRegistry(Settings({
+            "analysis.tokenizer.pt.type": "pattern",
+            "analysis.tokenizer.pt.pattern": ",",
+            "analysis.analyzer.csv.type": "custom",
+            "analysis.analyzer.csv.tokenizer": "pt"}))
+        assert reg.get("csv").terms("a,b,c") == ["a", "b", "c"]
+
+    def test_multiword_synonym_phrase(self, tmp_path):
+        """'ny => new york' must keep the expansion phrase-matchable
+        (review r4: a single 'new york' token was unmatchable)."""
+        from elasticsearch_tpu.node import Node
+        n = Node({}, data_path=tmp_path / "mw").start()
+        n.indices_service.create_index("m", {
+            "settings": {
+                "number_of_shards": 1, "number_of_replicas": 0,
+                "analysis": {
+                    "filter": {"syn": {"type": "synonym",
+                                       "synonyms": ["ny => new york"]}},
+                    "analyzer": {"a": {"type": "custom",
+                                       "tokenizer": "standard",
+                                       "filter": ["lowercase", "syn"]}}}},
+            "mappings": {"_doc": {"properties": {
+                "t": {"type": "text", "analyzer": "a"}}}}})
+        n.index_doc("m", "1", {"t": "I love NY city"}, refresh=True)
+        r = n.search("m", {"query": {"match_phrase": {"t": "new york"}}})
+        assert r["hits"]["total"] == 1
+        r = n.search("m", {"query": {"match": {"t": "york"}}})
+        assert r["hits"]["total"] == 1
+        # the token AFTER the expansion keeps phrase adjacency too
+        r = n.search("m", {"query": {"match_phrase": {"t": "york city"}}})
+        assert r["hits"]["total"] == 1
+        n.close()
+
+    def test_word_delimiter_preserve_no_dup(self):
+        from elasticsearch_tpu.analysis.analyzers import (
+            Token, word_delimiter_filter_factory)
+        wd = word_delimiter_filter_factory({"preserve_original": True})
+        out = wd([Token("foo", 0, 0, 3)])
+        assert [t.term for t in out] == ["foo"]      # exactly once
+        out = wd([Token("FooBar", 0, 0, 6)])
+        assert [t.term for t in out] == ["FooBar", "Foo", "Bar"]
