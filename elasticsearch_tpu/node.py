@@ -985,15 +985,18 @@ def _apply_update_script(source: dict, script,
     doc) and meta_updates carries any _ttl/_timestamp the script set.
     Interpreted by GroovyLite (scriptlang.py), the lang-groovy analog —
     conditionals, loops and collection mutation all work."""
-    from elasticsearch_tpu.search.scriptlang import compile_groovylite
+    from elasticsearch_tpu.search.script_engines import resolve_engine
+    lang = None
     if isinstance(script, dict):
         src = script.get("source", script.get("inline", ""))
         params = script.get("params", {})
+        lang = script.get("lang")
     else:
         src, params = str(script), {}
+    compile_fn = resolve_engine(lang)
     ctx = {"_source": source, "op": "index", **(meta or {})}
     before = {k: ctx.get(k) for k in ("_ttl", "_timestamp")}
-    compile_groovylite(src).run({"ctx": ctx, "params": params})
+    compile_fn(src).run({"ctx": ctx, "params": params})
     op = ctx.get("op", "index")
     if op not in ("index", "none", "noop", "delete"):
         raise ValueError(f"invalid ctx.op [{op}]")

@@ -1,0 +1,133 @@
+"""lang-python — a sandboxed Python script engine.
+
+The reference ships plugins/lang-python (Jython behind
+ScriptEngineService). Here the host language IS Python, so the engine
+compiles real Python — gated by an AST whitelist (the sandboxing
+discipline of the reference's sandboxed langs and this repo's expression
+engine): statements/expressions only, no imports, no attribute access to
+underscored names, no calls outside an allowlist of pure builtins. The
+script's last expression (or an explicit ``return``... via assignment to
+``result``) is the value; bindings arrive as plain names (``doc``,
+``params``, ``ctx``, ``_score``, ``state``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticsearch_tpu.plugins import Plugin
+
+_ALLOWED_NODES = (
+    ast.Module, ast.Expr, ast.Assign, ast.AugAssign, ast.AnnAssign,
+    ast.If, ast.For, ast.While, ast.Break, ast.Continue, ast.Pass,
+    ast.Name, ast.Load, ast.Store, ast.Constant, ast.Tuple, ast.List,
+    ast.Dict, ast.Set, ast.Subscript, ast.Slice, ast.Index,
+    ast.BinOp, ast.UnaryOp, ast.BoolOp, ast.Compare, ast.IfExp,
+    ast.Call, ast.keyword, ast.Attribute, ast.ListComp, ast.SetComp,
+    ast.DictComp, ast.GeneratorExp, ast.comprehension, ast.Starred,
+    ast.FormattedValue, ast.JoinedStr,
+    # operators
+    ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod,
+    ast.Pow, ast.USub, ast.UAdd, ast.Not, ast.And, ast.Or,
+    ast.Eq, ast.NotEq, ast.Lt, ast.LtE, ast.Gt, ast.GtE, ast.In,
+    ast.NotIn, ast.Is, ast.IsNot, ast.BitAnd, ast.BitOr, ast.BitXor,
+    ast.LShift, ast.RShift, ast.Invert,
+)
+
+_SAFE_BUILTINS = {
+    "abs": abs, "min": min, "max": max, "sum": sum, "len": len,
+    "round": round, "int": int, "float": float, "str": str,
+    "bool": bool, "list": list, "dict": dict, "set": set,
+    "tuple": tuple, "sorted": sorted, "reversed": reversed,
+    "range": range, "enumerate": enumerate, "zip": zip, "any": any,
+    "all": all,
+}
+
+# methods reachable via attribute access on plain values
+_SAFE_METHODS = frozenset({
+    "append", "extend", "insert", "pop", "remove", "sort", "index",
+    "count", "get", "keys", "values", "items", "setdefault", "update",
+    "add", "discard", "split", "join", "strip", "lower", "upper",
+    "startswith", "endswith", "replace", "find", "format",
+})
+# value-access properties of the doc-values bindings
+_SAFE_PROPS = frozenset({"value", "values", "empty"})
+
+
+class PythonScriptError(Exception):
+    pass
+
+
+def _check(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, _ALLOWED_NODES):
+            raise PythonScriptError(
+                f"[lang-python] {type(node).__name__} is not allowed "
+                f"in sandboxed scripts")
+        if isinstance(node, ast.Attribute):
+            # CLOSED attribute set, loads included: open attribute
+            # traversal would walk from bound objects (doc → segment →
+            # columns) into live engine internals
+            if node.attr not in _SAFE_METHODS | _SAFE_PROPS:
+                raise PythonScriptError(
+                    f"[lang-python] attribute [{node.attr}] is not "
+                    f"allowed")
+        if isinstance(node, ast.Call):
+            fn = node.func
+            ok = isinstance(fn, ast.Name) or (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in _SAFE_METHODS)
+            if not ok:
+                raise PythonScriptError(
+                    "[lang-python] only allowlisted builtins and safe "
+                    "methods are callable")
+        if isinstance(node, ast.Name) and node.id.startswith("__"):
+            raise PythonScriptError(
+                "[lang-python] dunder names are not allowed")
+
+
+class CompiledPython:
+    def __init__(self, source: str):
+        self.source = source
+        try:
+            tree = ast.parse(source, mode="exec")
+        except SyntaxError as e:
+            raise PythonScriptError(f"[lang-python] {e}") from None
+        _check(tree)
+        # the value of a trailing bare expression becomes the script's
+        # result (Jython's eval-last-expression convention)
+        if tree.body and isinstance(tree.body[-1], ast.Expr):
+            tree.body[-1] = ast.copy_location(
+                ast.Assign(targets=[ast.Name(id="result",
+                                             ctx=ast.Store())],
+                           value=tree.body[-1].value), tree.body[-1])
+            ast.fix_missing_locations(tree)
+        self._code = compile(tree, "<lang-python>", "exec")
+
+    def run(self, bindings: dict):
+        scope = {"__builtins__": dict(_SAFE_BUILTINS)}
+        scope.update(bindings)
+        exec(self._code, scope)       # noqa: S102 — AST-whitelisted
+        return scope.get("result")
+
+
+_CACHE: dict[str, CompiledPython] = {}
+
+
+def compile_python(source: str) -> CompiledPython:
+    cs = _CACHE.get(source)
+    if cs is None:
+        cs = CompiledPython(source)
+        if len(_CACHE) > 512:
+            _CACHE.clear()
+        _CACHE[source] = cs
+    return cs
+
+
+class PythonLangPlugin(Plugin):
+    """lang-python: registers the sandboxed engine under lang
+    'python' (the reference plugin's name)."""
+    name = "lang-python"
+
+    def script_engines(self) -> dict:
+        return {"python": compile_python}

@@ -96,6 +96,11 @@ class Plugin:
     def script_functions(self) -> dict:
         return {}
 
+    def script_engines(self) -> dict:
+        """{lang: compile_fn} — extra ScriptEngineServices
+        (ScriptModule.addScriptEngine seam)."""
+        return {}
+
     def query_parsers(self) -> dict:
         return {}
 
@@ -146,9 +151,13 @@ class PluginsService:
         module = _AnalysisModule(
             analysis_mod.BUILTIN_ANALYZERS, analysis_mod.TOKENIZERS,
             analysis_mod.TOKEN_FILTER_FACTORIES)
+        from elasticsearch_tpu.search import script_engines
         for p in self.plugins:
             for fname, fn in p.script_functions().items():
                 _global_register(script_mod._FUNCS, fname, fn, self._undo)
+            for lang, compile_fn in p.script_engines().items():
+                _global_register(script_engines.ENGINES, lang, compile_fn,
+                                 self._undo)
             for qname, parser in p.query_parsers().items():
                 _global_register(query_dsl.EXTRA_PARSERS, qname, parser,
                                  self._undo)

@@ -1050,6 +1050,10 @@ class _AggDocValues:
     def __scriptlang_getitem__(self, field):
         return _AggFieldValue(self, field)
 
+    # plain-Python subscripting for the lang-python engine (the
+    # scriptlang interpreter goes through __scriptlang_getitem__)
+    __getitem__ = __scriptlang_getitem__
+
 
 class _AggFieldValue:
     def __init__(self, owner: _AggDocValues, field: str):
@@ -1095,6 +1099,20 @@ class _AggFieldValue:
         from elasticsearch_tpu.search.scriptlang import ScriptException
         raise ScriptException(f"no doc-value method [{name}]")
 
+    # plain-Python attribute access for the lang-python engine
+    # (.value / .values / .empty mirror the scriptlang protocol)
+    @property
+    def value(self):
+        return self.__scriptlang_getattr__("value")
+
+    @property
+    def values(self):
+        return self.__scriptlang_getattr__("values")
+
+    @property
+    def empty(self):
+        return self.__scriptlang_getattr__("empty")
+
 
 def _c_scripted_metric_interpreted(node, mask, ctx):
     """Full scripted_metric contract (ref: metrics/scripted/
@@ -1102,14 +1120,15 @@ def _c_scripted_metric_interpreted(node, mask, ctx):
     per matching doc with `doc` values, combine_script folds the shard
     state, reduce_script (reduce side) folds `_aggs`. Interpreted by
     GroovyLite — loops and collection state work as in lang-groovy."""
-    from elasticsearch_tpu.search.scriptlang import compile_groovylite
+    from elasticsearch_tpu.search.script_engines import resolve_engine
+    compile_fn = resolve_engine(node.params.get("lang"))
     params = dict(node.params.get("params", {}))
     agg: dict = {}
     bindings = {"_agg": agg, "params": params}
     init = node.params.get("init_script")
     if init:
-        compile_groovylite(str(init)).run(dict(bindings))
-    map_script = compile_groovylite(str(node.params["map_script"]))
+        compile_fn(str(init)).run(dict(bindings))
+    map_script = compile_fn(str(node.params["map_script"]))
     off = 0
     for s in ctx.reader.segments:
         n = s.padded_docs
@@ -1123,7 +1142,7 @@ def _c_scripted_metric_interpreted(node, mask, ctx):
         off += n
     combine = node.params.get("combine_script")
     if combine:
-        partial = compile_groovylite(str(combine)).run(dict(bindings))
+        partial = compile_fn(str(combine)).run(dict(bindings))
     else:
         partial = agg
     from elasticsearch_tpu.action.search_action import wire_safe
@@ -1566,12 +1585,13 @@ def _reduce_node(node: AggNode, parts: list[dict]) -> dict:
             # full contract: reduce_script folds the per-shard partials
             # (`_aggs`); without one the partials list IS the value
             # (ScriptedMetricAggregator doReduce)
-            from elasticsearch_tpu.search.scriptlang import (
-                compile_groovylite)
+            from elasticsearch_tpu.search.script_engines import (
+                resolve_engine)
+            compile_fn = resolve_engine(node.params.get("lang"))
             aggs_list = [p.get("partial") for p in parts]
             reduce_src = node.params.get("reduce_script")
             if reduce_src:
-                value = compile_groovylite(str(reduce_src)).run(
+                value = compile_fn(str(reduce_src)).run(
                     {"_aggs": aggs_list,
                      "params": dict(node.params.get("params", {}))})
             else:

@@ -950,11 +950,30 @@ class ShardSearcher:
         out = {}
         for name, spec in script_fields.items():
             script = spec.get("script", spec)
+            lang = None
             if isinstance(script, dict):
                 src = script.get("source", script.get("inline", ""))
                 params = script.get("params", {})
+                lang = script.get("lang")
             else:
                 src, params = str(script), {}
+            def run_interpreted(compile_fn):
+                """Per-hit engine run (shared by the explicit-lang path
+                and the expression-compile fallback)."""
+                from elasticsearch_tpu.search.aggregations import (
+                    _AggDocValues)
+                dv = _AggDocValues(seg.seg)
+                dv.doc = int(local)
+                val = compile_fn(src).run({"doc": dv, "params": params})
+                out[name] = val if isinstance(val, list) else [val]
+
+            if lang not in (None, "expression"):
+                # explicit lang → its registered engine, per hit
+                # (ScriptService.compile dispatches by lang the same way)
+                from elasticsearch_tpu.search.script_engines import (
+                    resolve_engine)
+                run_interpreted(resolve_engine(lang))
+                continue
             def get_numeric(fld):
                 col = seg.numeric.get(fld)
                 if col is None:
@@ -973,15 +992,9 @@ class ShardSearcher:
             except QueryParsingError:
                 # not an expression: run the general-purpose language per
                 # hit (lang-groovy analog — loops/conditionals/collections)
-                from elasticsearch_tpu.search.aggregations import (
-                    _AggDocValues)
                 from elasticsearch_tpu.search.scriptlang import (
                     compile_groovylite)
-                dv = _AggDocValues(seg.seg)
-                dv.doc = int(local)
-                val = compile_groovylite(src).run(
-                    {"doc": dv, "params": params})
-                out[name] = val if isinstance(val, list) else [val]
+                run_interpreted(compile_groovylite)
                 continue
             ctx = ScriptContext(get_numeric, get_vector,
                                 jnp.zeros(seg.padded_docs, jnp.float32),

@@ -1,0 +1,134 @@
+"""lang-python plugin: a sandboxed Python ScriptEngineService (the
+reference's plugins/lang-python, Jython) registered through the plugin
+SPI's script_engines seam, driving script fields and update-by-script."""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.plugin_pack.lang_python import (
+    CompiledPython, PythonLangPlugin, PythonScriptError, compile_python)
+
+
+class TestSandbox:
+    def test_basic_eval(self):
+        assert compile_python("1 + 2 * 3").run({}) == 7
+        assert compile_python(
+            "xs = [1, 2, 3]\nsum(x * x for x in xs)").run({}) == 14
+        assert compile_python(
+            "total = 0\nfor i in range(5):\n"
+            "    if i % 2 == 0:\n        total += i\ntotal").run({}) == 6
+
+    def test_bindings(self):
+        assert compile_python("params['a'] + 1").run(
+            {"params": {"a": 41}}) == 42
+
+    def test_import_rejected(self):
+        with pytest.raises(PythonScriptError):
+            CompiledPython("import os")
+
+    def test_dunder_rejected(self):
+        with pytest.raises(PythonScriptError):
+            CompiledPython("().__class__")
+        with pytest.raises(PythonScriptError):
+            CompiledPython("__builtins__")
+
+    def test_def_lambda_rejected(self):
+        with pytest.raises(PythonScriptError):
+            CompiledPython("def f():\n    pass")
+        with pytest.raises(PythonScriptError):
+            CompiledPython("f = lambda: 1")
+
+    def test_open_not_available(self):
+        with pytest.raises(Exception):
+            compile_python("open('/etc/passwd')").run({})
+
+    def test_safe_methods(self):
+        assert compile_python(
+            "xs = []\nxs.append(3)\nxs.append(1)\nxs.sort()\nxs").run(
+            {}) == [1, 3]
+
+
+class TestThroughTheNode:
+    @pytest.fixture()
+    def node(self, tmp_path):
+        n = Node({"plugins": [PythonLangPlugin()]},
+                 data_path=tmp_path / "n").start()
+        n.indices_service.create_index("p", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0}})
+        yield n
+        n.close()
+
+    def test_script_field(self, node):
+        node.index_doc("p", "1", {"price": 10, "qty": 3}, refresh=True)
+        r = node.search("p", {
+            "query": {"match_all": {}},
+            "script_fields": {"total": {"script": {
+                "lang": "python",
+                "source": "doc['price'].value * doc['qty'].value"}}}})
+        assert r["hits"]["hits"][0]["fields"]["total"] == [30.0]
+
+    def test_update_by_script(self, node):
+        node.index_doc("p", "1", {"counter": 1}, refresh=True)
+        node.update_doc("p", "1", {"script": {
+            "lang": "python",
+            "source": "ctx['_source']['counter'] = "
+                      "ctx['_source']['counter'] + params['by']",
+            "params": {"by": 4}}})
+        assert node.get_doc("p", "1")["_source"]["counter"] == 5
+
+    def test_scripted_metric(self, node):
+        for i in range(5):
+            node.index_doc("p", str(i), {"v": i})
+        node.broadcast_actions.refresh("p")
+        r = node.search("p", {
+            "size": 0, "query": {"match_all": {}},
+            "aggs": {"m": {"scripted_metric": {
+                "lang": "python",
+                "init_script": "_agg['vals'] = []",
+                "map_script": "_agg['vals'].append(doc['v'].value)",
+                "combine_script": "sum(_agg['vals'])",
+                "reduce_script": "sum(_aggs)"}}}})
+        assert r["aggregations"]["m"]["value"] == 10.0
+
+    def test_unknown_lang_rejected(self, node):
+        node.index_doc("p", "1", {"x": 1}, refresh=True)
+        with pytest.raises(Exception):
+            node.search("p", {
+                "query": {"match_all": {}},
+                "script_fields": {"y": {"script": {
+                    "lang": "javascript", "source": "1"}}}})
+
+
+class TestSandboxHardening:
+    """Review r4: attribute traversal and open calls must be closed."""
+
+    def test_internal_traversal_rejected(self):
+        with pytest.raises(PythonScriptError):
+            CompiledPython("doc.seg")
+        with pytest.raises(PythonScriptError):
+            CompiledPython("doc['f'].owner")
+
+    def test_unsafe_method_call_rejected(self):
+        with pytest.raises(PythonScriptError):
+            CompiledPython("params.clear()")
+        # calls must be Name or safe-method attribute
+        with pytest.raises(PythonScriptError):
+            CompiledPython("x = [1]\nx.copy().clear()")
+
+    def test_safe_value_props_still_work(self):
+        # .value/.values/.empty stay usable (doc-value protocol)
+        CompiledPython("doc['f'].value + 1")
+        CompiledPython("len(doc['f'].values)")
+
+    def test_unknown_lang_raises_in_update(self, tmp_path):
+        from elasticsearch_tpu.common.errors import QueryParsingError
+        n = Node({}, data_path=tmp_path / "u").start()
+        n.indices_service.create_index("u", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 0}})
+        n.index_doc("u", "1", {"x": 1}, refresh=True)
+        with pytest.raises(Exception) as ei:
+            n.update_doc("u", "1", {"script": {
+                "lang": "javascript", "source": "ctx.op = 'none'"}})
+        assert "not installed" in str(ei.value)
+        n.close()
