@@ -33,7 +33,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from elasticsearch_tpu.common.errors import (
-    ElasticsearchTpuError, SearchContextMissingError)
+    ElasticsearchTpuError, QueryParsingError, SearchContextMissingError)
 from elasticsearch_tpu.common.settings import parse_time_value
 from elasticsearch_tpu.index.device_reader import device_reader_for
 from elasticsearch_tpu.search.controller import merge_shard_payloads
@@ -787,6 +787,96 @@ class SearchActions:
                                                    ctx_uid=scroll_pin["uid"])
         return resp
 
+    def _try_collective_plane(self, names, groups, body: dict, req,
+                              t0: float) -> dict | None:
+        """→ a full search response served by the mesh program, or None
+        (not opted in / shards not all local / ineligible shape — the
+        caller proceeds with the ordinary fan-out). The merged global
+        top-k splits back by owning shard so the standard winner-only
+        fetch phase assembles hits."""
+        if len(names) != 1 or req.sort or req.post_filter is not None \
+                or req.min_score is not None \
+                or req.search_after is not None or req.suggest \
+                or req.terminate_after is not None \
+                or req.timeout_ms is not None or req.rescore:
+            return None
+        index = self.node.indices_service.indices.get(names[0])
+        if index is None or len(groups) < 2:
+            return None
+        if str(index.index_settings.get(
+                "index.search.collective_plane", "false")).lower() \
+                not in ("true", "1"):
+            return None
+        nshards = index.meta.number_of_shards
+        if set(index.engines) != set(range(nshards)):
+            return None                   # not every shard lives here
+        from elasticsearch_tpu.search.controller import merge_responses
+        from elasticsearch_tpu.search.phase import (ShardQueryResult,
+                                                    ShardSearcher)
+        try:
+            msearch = self._mesh_searcher_for(index)
+            out = msearch.search_batch([body])[0]
+        except QueryParsingError:
+            return None                   # e.g. bucket aggs, geo fields
+        except Exception:                 # noqa: BLE001 — fallback seam
+            from elasticsearch_tpu.search import jit_exec
+            jit_exec.note_fallback()
+            return None
+        searchers = [ShardSearcher(sid, device_reader_for(index.engines[sid]),
+                                   index.mapper_service,
+                                   index_name=index.name)
+                     for sid in range(nshards)]
+        # doc ids map (slot, row) through BOTH point-in-time snapshots:
+        # a refresh between the mesh search and the fetch readers would
+        # make segment layouts disagree — both snapshots are immutable,
+        # so a generation comparison decides validity once, here
+        for si, s in enumerate(searchers):
+            if s.reader.generation != msearch._views[si].generation:
+                return None               # raced a refresh: fan-out path
+        per_shard: dict[int, list[tuple[int, float]]] = {}
+        for g, sc in zip(out["doc_ids"], out["scores"]):
+            si, j, row = msearch.resolve(int(g))
+            rdoc = searchers[si].reader.segments[j].doc_base + row
+            per_shard.setdefault(si, []).append((rdoc, float(sc)))
+        results = []
+        for si, s in enumerate(searchers):
+            rows = per_shard.get(si, [])
+            results.append(ShardQueryResult(
+                si,
+                # only the GLOBAL total exists (in-program psum); carried
+                # on shard 0 so the coordinator's sum stays exact
+                int(out["total"]) if si == 0 else 0,
+                max((sc for _, sc in rows), default=None),
+                np.asarray([d for d, _ in rows], np.int32),
+                np.asarray([sc for _, sc in rows], np.float32),
+                None, {}, s.reader))
+        resp = merge_responses(index.name, req, results, searchers,
+                               (time.perf_counter() - t0) * 1e3, None)
+        mesh_aggs = out.get("aggregations")
+        if req.aggs and mesh_aggs is not None:
+            resp["aggregations"] = mesh_aggs
+        return resp
+
+    def _mesh_searcher_for(self, index):
+        """Cache per segment-generation tuple (a refresh on any shard
+        rebuilds — reader reacquisition semantics). The mesh packs its
+        own stacked copy of the shard columns: the opt-in trades HBM for
+        dispatch count."""
+        import jax
+        from elasticsearch_tpu.parallel import make_mesh
+        from elasticsearch_tpu.parallel.mesh_engine import (
+            MeshEngineSearcher)
+        gens = tuple(e.acquire_searcher().generation
+                     for e in index.shard_engines)
+        cached = index.__dict__.get("_mesh_cache")
+        if cached is not None and cached[0] == gens:
+            return cached[1]
+        mesh = make_mesh(dp=1, shard=1, devices=[jax.devices()[0]])
+        msearch = MeshEngineSearcher(mesh, list(index.shard_engines),
+                                     index.mapper_service)
+        index.__dict__["_mesh_cache"] = (gens, msearch)
+        return msearch
+
     def _dfs_phase(self, state, groups, body: dict) -> dict:
         """The DFS round preceding the query round
         (executeDfsPhase, core/search/SearchService.java:264 +
@@ -816,6 +906,17 @@ class SearchActions:
         req = parse_search_request(body)
         groups = self._shard_groups(state, names)
         dfs = None
+        if search_type == "dfs_query_then_fetch" and dfs_cache is None:
+            # collective plane (opt-in): when this node holds EVERY shard
+            # of a single opted-in index, an eligible dfs search runs as
+            # ONE shard_map program — per-shard emit, all_gather top-k
+            # merge, psum counts and metric aggs, global DFS statistics —
+            # instead of the dfs round + per-shard fan-out + host merge
+            # (SURVEY §2.2: scatter/gather + reduce onto ICI collectives)
+            mesh_resp = self._try_collective_plane(names, groups, body,
+                                                   req, t0)
+            if mesh_resp is not None:
+                return mesh_resp
         if search_type == "dfs_query_then_fetch":
             # scroll contexts reuse the stats gathered for page one: the
             # reference keeps AggregatedDfs in the search context — fresh

@@ -43,12 +43,14 @@ _SAFE_BUILTINS = {
     "all": all,
 }
 
-# methods reachable via attribute access on plain values
+# methods reachable via attribute access on plain values. NO str.format:
+# format strings perform their own attribute traversal at runtime
+# ('{0.seg}'.format(doc)), punching through the AST whitelist.
 _SAFE_METHODS = frozenset({
     "append", "extend", "insert", "pop", "remove", "sort", "index",
     "count", "get", "keys", "values", "items", "setdefault", "update",
     "add", "discard", "split", "join", "strip", "lower", "upper",
-    "startswith", "endswith", "replace", "find", "format",
+    "startswith", "endswith", "replace", "find",
 })
 # value-access properties of the doc-values bindings
 _SAFE_PROPS = frozenset({"value", "values", "empty"})
@@ -81,9 +83,51 @@ def _check(tree: ast.AST) -> None:
                 raise PythonScriptError(
                     "[lang-python] only allowlisted builtins and safe "
                     "methods are callable")
-        if isinstance(node, ast.Name) and node.id.startswith("__"):
-            raise PythonScriptError(
-                "[lang-python] dunder names are not allowed")
+        if isinstance(node, ast.Name):
+            if node.id.startswith("__"):
+                raise PythonScriptError(
+                    "[lang-python] dunder names are not allowed")
+            if node.id.startswith("_") and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                # underscored names are runtime-provided bindings (_agg,
+                # _score, the _tick budget hook) — rebinding them could
+                # disable enforcement
+                raise PythonScriptError(
+                    "[lang-python] cannot assign underscored names")
+
+
+_OP_BUDGET = 200_000
+_MAX_RANGE = 10_000_000
+
+
+def _bounded_range(*args):
+    r = range(*args)
+    if len(r) > _MAX_RANGE:
+        raise PythonScriptError(
+            f"[lang-python] range of {len(r)} exceeds the sandbox limit")
+    return r
+
+
+class _TickInjector(ast.NodeTransformer):
+    """Prepend a `_tick()` call to every loop body — the GroovyLite op
+    budget discipline (scriptlang.py: runaway loops raise instead of
+    hanging a shard thread)."""
+
+    def _tick_stmt(self, ref):
+        return ast.copy_location(
+            ast.Expr(value=ast.Call(
+                func=ast.Name(id="_tick", ctx=ast.Load()),
+                args=[], keywords=[])), ref)
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        node.body = [self._tick_stmt(node)] + node.body
+        return node
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        node.body = [self._tick_stmt(node)] + node.body
+        return node
 
 
 class CompiledPython:
@@ -94,6 +138,7 @@ class CompiledPython:
         except SyntaxError as e:
             raise PythonScriptError(f"[lang-python] {e}") from None
         _check(tree)
+        tree = _TickInjector().visit(tree)
         # the value of a trailing bare expression becomes the script's
         # result (Jython's eval-last-expression convention)
         if tree.body and isinstance(tree.body[-1], ast.Expr):
@@ -101,11 +146,21 @@ class CompiledPython:
                 ast.Assign(targets=[ast.Name(id="result",
                                              ctx=ast.Store())],
                            value=tree.body[-1].value), tree.body[-1])
-            ast.fix_missing_locations(tree)
+        ast.fix_missing_locations(tree)
         self._code = compile(tree, "<lang-python>", "exec")
 
     def run(self, bindings: dict):
-        scope = {"__builtins__": dict(_SAFE_BUILTINS)}
+        budget = [_OP_BUDGET]
+
+        def _tick():
+            budget[0] -= 1
+            if budget[0] < 0:
+                raise PythonScriptError(
+                    "[lang-python] op budget exceeded (runaway loop)")
+
+        builtins = dict(_SAFE_BUILTINS)
+        builtins["range"] = _bounded_range
+        scope = {"__builtins__": builtins, "_tick": _tick}
         scope.update(bindings)
         exec(self._code, scope)       # noqa: S102 — AST-whitelisted
         return scope.get("result")

@@ -21,14 +21,6 @@ def register_engine(lang: str, compile_fn) -> None:
     ENGINES[lang] = compile_fn
 
 
-def engine_for(lang: str | None):
-    """→ compile fn for an explicit lang, or None (caller falls back to
-    the expression-then-groovy default chain)."""
-    if lang is None:
-        return None
-    return ENGINES.get(str(lang))
-
-
 def resolve_engine(lang: str | None):
     """Explicit lang → its engine, RAISING when not installed (a silent
     GroovyLite fallback would interpret the script under the wrong

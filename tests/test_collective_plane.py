@@ -1,0 +1,114 @@
+"""The collective plane as a production path: with
+`index.search.collective_plane: true`, an eligible dfs_query_then_fetch
+on a node holding every shard runs as ONE shard_map program
+(parallel/mesh_engine) instead of dfs round + per-shard fan-out — the
+response must be indistinguishable from the RPC path (SURVEY §2.2's
+"scatter/gather + reduce moves onto ICI collectives"; dfs semantics are
+the mesh's native semantics, its statistics round IS global)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+DFS = "dfs_query_then_fetch"
+
+
+@pytest.fixture(scope="module")
+def nodes(tmp_path_factory):
+    base = tmp_path_factory.mktemp("cp")
+    n = Node({}, data_path=base / "n").start()
+    rng = np.random.default_rng(5)
+    for name, plane in (("on", True), ("off", False)):
+        n.indices_service.create_index(name, {
+            "settings": {"number_of_shards": 4, "number_of_replicas": 0,
+                         "index.search.collective_plane": plane},
+            "mappings": {"_doc": {"properties": {
+                "t": {"type": "text", "analyzer": "whitespace"},
+                "v": {"type": "long"}}}}})
+    for i in range(300):
+        words = " ".join(f"w{int(x)}" for x in rng.zipf(1.5, 6) if x < 40)
+        doc = {"t": words or "w1", "v": i}
+        n.index_doc("on", str(i), doc)
+        n.index_doc("off", str(i), doc)
+    n.broadcast_actions.refresh("on")
+    n.broadcast_actions.refresh("off")
+    yield n
+    n.close()
+
+
+BODIES = [
+    {"query": {"match": {"t": "w1 w3"}}, "size": 25},
+    {"query": {"bool": {"must": [{"match": {"t": "w2"}}],
+                        "filter": [{"range": {"v": {"gte": 100}}}]}},
+     "size": 10},
+    {"query": {"match": {"t": "w1"}}, "from": 5, "size": 10},
+]
+
+
+def test_mesh_path_matches_fanout(nodes):
+    n = nodes
+    for body in BODIES:
+        a = n.search("on", dict(body), search_type=DFS)
+        b = n.search("off", dict(body), search_type=DFS)
+        assert a["hits"]["total"] == b["hits"]["total"], body
+        ia = [(h["_id"], round(h["_score"], 4)) for h in a["hits"]["hits"]]
+        ib = [(h["_id"], round(h["_score"], 4)) for h in b["hits"]["hits"]]
+        assert ia == ib, body
+        assert a["hits"]["hits"][0]["_source"]    # fetch phase ran
+    # the plane actually engaged (cache built on the opted-in index)
+    assert "_mesh_cache" in n.indices_service.indices["on"].__dict__
+    assert "_mesh_cache" not in n.indices_service.indices["off"].__dict__
+
+
+def test_mesh_path_metric_aggs(nodes):
+    n = nodes
+    body = {"query": {"match": {"t": "w2"}}, "size": 0,
+            "aggs": {"st": {"stats": {"field": "v"}},
+                     "mx": {"max": {"field": "v"}}}}
+    a = n.search("on", dict(body), search_type=DFS)
+    b = n.search("off", dict(body), search_type=DFS)
+    assert a["aggregations"]["mx"]["value"] == \
+        b["aggregations"]["mx"]["value"]
+    for k in ("count", "min", "max", "sum", "avg"):
+        av = a["aggregations"]["st"][k]
+        bv = b["aggregations"]["st"][k]
+        assert av == pytest.approx(bv, rel=1e-6), (k, av, bv)
+
+
+def test_ineligible_falls_back(nodes):
+    n = nodes
+    # sort-by-field is not a mesh shape: must fall back and still work
+    body = {"query": {"match": {"t": "w1"}}, "size": 5,
+            "sort": [{"v": {"order": "desc"}}]}
+    a = n.search("on", dict(body), search_type=DFS)
+    b = n.search("off", dict(body), search_type=DFS)
+    assert [h["_id"] for h in a["hits"]["hits"]] == \
+        [h["_id"] for h in b["hits"]["hits"]]
+    # bucket aggs fall back too
+    body = {"query": {"match_all": {}}, "size": 0,
+            "aggs": {"t": {"terms": {"field": "v"}}}}
+    a = n.search("on", dict(body), search_type=DFS)
+    b = n.search("off", dict(body), search_type=DFS)
+    assert a["aggregations"]["t"]["buckets"] == \
+        b["aggregations"]["t"]["buckets"]
+    # plain query_then_fetch keeps per-shard statistics (different
+    # semantics) — the plane must not hijack it
+    a = n.search("on", {"query": {"match": {"t": "w1"}}, "size": 5})
+    b = n.search("off", {"query": {"match": {"t": "w1"}}, "size": 5})
+    assert [h["_id"] for h in a["hits"]["hits"]] == \
+        [h["_id"] for h in b["hits"]["hits"]]
+
+
+def test_refresh_invalidates_mesh_cache(nodes):
+    n = nodes
+    idx = n.indices_service.indices["on"]
+    n.search("on", {"query": {"match": {"t": "w1"}}}, search_type=DFS)
+    gens0, ms0 = idx.__dict__["_mesh_cache"]
+    n.index_doc("on", "fresh-1", {"t": "w1 freshterm", "v": 999})
+    n.broadcast_actions.refresh("on")
+    r = n.search("on", {"query": {"match": {"t": "freshterm"}}},
+                 search_type=DFS)
+    assert r["hits"]["total"] == 1
+    gens1, ms1 = idx.__dict__["_mesh_cache"]
+    assert gens1 != gens0 and ms1 is not ms0

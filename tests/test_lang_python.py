@@ -132,3 +132,21 @@ class TestSandboxHardening:
                 "lang": "javascript", "source": "ctx.op = 'none'"}})
         assert "not installed" in str(ei.value)
         n.close()
+
+    def test_format_escape_closed(self):
+        with pytest.raises(PythonScriptError):
+            CompiledPython("'{0.seg}'.format(doc)")
+
+    def test_op_budget_stops_runaway(self):
+        with pytest.raises(PythonScriptError) as ei:
+            compile_python("x = 0\nwhile True:\n    x += 1").run({})
+        assert "budget" in str(ei.value)
+        with pytest.raises(PythonScriptError):
+            compile_python("range(10**9)").run({})
+
+    def test_underscore_rebinding_rejected(self):
+        with pytest.raises(PythonScriptError):
+            CompiledPython("_tick = 1")
+        # reading runtime bindings stays fine
+        assert compile_python("_agg['x']").run(
+            {"_agg": {"x": 5}}) == 5
