@@ -787,37 +787,42 @@ class SearchActions:
                                                    ctx_uid=scroll_pin["uid"])
         return resp
 
-    def _try_collective_plane(self, names, groups, body: dict, req,
-                              t0: float) -> dict | None:
-        """→ a full search response served by the mesh program, or None
-        (not opted in / shards not all local / ineligible shape — the
-        caller proceeds with the ordinary fan-out). The merged global
-        top-k splits back by owning shard so the standard winner-only
-        fetch phase assembles hits."""
-        if len(names) != 1 or req.sort or req.post_filter is not None \
-                or req.min_score is not None \
-                or req.search_after is not None or req.suggest \
-                or req.terminate_after is not None \
-                or req.timeout_ms is not None or req.rescore:
+    def _try_collective_plane(self, names, bodies: list, reqs: list,
+                              t0: float) -> list[dict] | None:
+        """→ full search responses for a BATCH of bodies served by ONE
+        mesh program, or None (not opted in / shards not all local /
+        ineligible shape — the caller proceeds with the ordinary
+        fan-out). The merged global top-k of each item splits back by
+        owning shard so the standard winner-only fetch assembles hits;
+        _msearch groups ride the same call with B > 1 (the batch IS the
+        accelerator's unit of work)."""
+        if len(names) != 1:
             return None
+        for req in reqs:
+            if req.sort or req.post_filter is not None \
+                    or req.min_score is not None \
+                    or req.search_after is not None or req.suggest \
+                    or req.terminate_after is not None \
+                    or req.timeout_ms is not None or req.rescore:
+                return None
         index = self.node.indices_service.indices.get(names[0])
-        if index is None or len(groups) < 2:
+        if index is None:
             return None
         if str(index.index_settings.get(
                 "index.search.collective_plane", "false")).lower() \
                 not in ("true", "1"):
             return None
         nshards = index.meta.number_of_shards
-        if set(index.engines) != set(range(nshards)):
+        if nshards < 2 or set(index.engines) != set(range(nshards)):
             return None                   # not every shard lives here
         from elasticsearch_tpu.search.controller import merge_responses
         from elasticsearch_tpu.search.phase import (ShardQueryResult,
                                                     ShardSearcher)
         try:
             msearch = self._mesh_searcher_for(index)
-            out = msearch.search_batch([body])[0]
+            outs = msearch.search_batch(list(bodies))
         except QueryParsingError:
-            return None                   # e.g. bucket aggs, geo fields
+            return None    # e.g. bucket aggs, geo fields, mixed plans
         except Exception:                 # noqa: BLE001 — fallback seam
             from elasticsearch_tpu.search import jit_exec
             jit_exec.note_fallback()
@@ -833,49 +838,88 @@ class SearchActions:
         for si, s in enumerate(searchers):
             if s.reader.generation != msearch._views[si].generation:
                 return None               # raced a refresh: fan-out path
-        per_shard: dict[int, list[tuple[int, float]]] = {}
-        for g, sc in zip(out["doc_ids"], out["scores"]):
-            si, j, row = msearch.resolve(int(g))
-            rdoc = searchers[si].reader.segments[j].doc_base + row
-            per_shard.setdefault(si, []).append((rdoc, float(sc)))
-        results = []
-        for si, s in enumerate(searchers):
-            rows = per_shard.get(si, [])
-            results.append(ShardQueryResult(
-                si,
-                # only the GLOBAL total exists (in-program psum); carried
-                # on shard 0 so the coordinator's sum stays exact
-                int(out["total"]) if si == 0 else 0,
-                max((sc for _, sc in rows), default=None),
-                np.asarray([d for d, _ in rows], np.int32),
-                np.asarray([sc for _, sc in rows], np.float32),
-                None, {}, s.reader))
-        resp = merge_responses(index.name, req, results, searchers,
-                               (time.perf_counter() - t0) * 1e3, None)
-        mesh_aggs = out.get("aggregations")
-        if req.aggs and mesh_aggs is not None:
-            resp["aggregations"] = mesh_aggs
-        return resp
+        responses = []
+        q_ms = (time.perf_counter() - t0) * 1e3
+        for body, req, out in zip(bodies, reqs, outs):
+            per_shard: dict[int, list[tuple[int, float]]] = {}
+            for g, sc in zip(out["doc_ids"], out["scores"]):
+                si, j, row = msearch.resolve(int(g))
+                rdoc = searchers[si].reader.segments[j].doc_base + row
+                per_shard.setdefault(si, []).append((rdoc, float(sc)))
+            results = []
+            for si, s in enumerate(searchers):
+                rows = per_shard.get(si, [])
+                results.append(ShardQueryResult(
+                    si,
+                    # only the GLOBAL total exists (in-program psum);
+                    # carried on shard 0 so the coordinator sum is exact
+                    int(out["total"]) if si == 0 else 0,
+                    max((sc for _, sc in rows), default=None),
+                    np.asarray([d for d, _ in rows], np.int32),
+                    np.asarray([sc for _, sc in rows], np.float32),
+                    None, {}, s.reader))
+            resp = merge_responses(index.name, req, results, searchers,
+                                   (time.perf_counter() - t0) * 1e3, None)
+            mesh_aggs = out.get("aggregations")
+            if req.aggs and mesh_aggs is not None:
+                resp["aggregations"] = mesh_aggs
+            responses.append(resp)
+            # operators watch _stats/slow logs — the plane must feed
+            # them like the fan-out does (one note per request; per-shard
+            # granularity does not exist in a one-program execution)
+            index.note_search(body.get("stats"), q_ms / len(bodies))
+            if index.search_slow_log.thresholds:
+                index.search_slow_log.maybe_log(
+                    q_ms / 1e3 / len(bodies),
+                    f"collective-plane, source"
+                    f"[{json.dumps(body)[:512]}]")
+        return responses
 
     def _mesh_searcher_for(self, index):
         """Cache per segment-generation tuple (a refresh on any shard
         rebuilds — reader reacquisition semantics). The mesh packs its
         own stacked copy of the shard columns: the opt-in trades HBM for
-        dispatch count."""
+        dispatch count — accounted against the fielddata breaker like
+        every other HBM residency (device_reader_for does the same), and
+        built under a per-index lock so concurrent dfs searches cannot
+        double-pack."""
+        import threading
         import jax
         from elasticsearch_tpu.parallel import make_mesh
         from elasticsearch_tpu.parallel.mesh_engine import (
             MeshEngineSearcher)
-        gens = tuple(e.acquire_searcher().generation
-                     for e in index.shard_engines)
-        cached = index.__dict__.get("_mesh_cache")
-        if cached is not None and cached[0] == gens:
-            return cached[1]
-        mesh = make_mesh(dp=1, shard=1, devices=[jax.devices()[0]])
-        msearch = MeshEngineSearcher(mesh, list(index.shard_engines),
-                                     index.mapper_service)
-        index.__dict__["_mesh_cache"] = (gens, msearch)
-        return msearch
+        lock = index.__dict__.setdefault("_mesh_lock", threading.Lock())
+        with lock:
+            gens = tuple(e.acquire_searcher().generation
+                         for e in index.shard_engines)
+            cached = index.__dict__.get("_mesh_cache")
+            if cached is not None and cached[0] == gens:
+                return cached[1]
+            bs = getattr(self.node, "breaker_service", None)
+            new_bytes = sum(seg.memory_bytes()
+                            for e in index.shard_engines
+                            for seg in e.acquire_searcher().segments)
+            old_bytes = cached[2] if cached is not None else 0
+            if bs is not None:
+                fd = bs.breaker("fielddata")
+                if new_bytes > old_bytes:
+                    fd.add_estimate(new_bytes - old_bytes,
+                                    f"mesh plane [{index.name}]")
+                else:
+                    fd.release(old_bytes - new_bytes)
+            try:
+                mesh = make_mesh(dp=1, shard=1,
+                                 devices=[jax.devices()[0]])
+                msearch = MeshEngineSearcher(
+                    mesh, list(index.shard_engines),
+                    index.mapper_service)
+            except BaseException:
+                if bs is not None and new_bytes > old_bytes:
+                    bs.breaker("fielddata").release(new_bytes - old_bytes)
+                raise
+            index.__dict__["_mesh_cache"] = (
+                gens, msearch, new_bytes if bs is not None else 0)
+            return msearch
 
     def _dfs_phase(self, state, groups, body: dict) -> dict:
         """The DFS round preceding the query round
@@ -913,10 +957,10 @@ class SearchActions:
             # merge, psum counts and metric aggs, global DFS statistics —
             # instead of the dfs round + per-shard fan-out + host merge
             # (SURVEY §2.2: scatter/gather + reduce onto ICI collectives)
-            mesh_resp = self._try_collective_plane(names, groups, body,
-                                                   req, t0)
+            mesh_resp = self._try_collective_plane(names, [body], [req],
+                                                   t0)
             if mesh_resp is not None:
-                return mesh_resp
+                return mesh_resp[0]
         if search_type == "dfs_query_then_fetch":
             # scroll contexts reuse the stats gathered for page one: the
             # reference keeps AggregatedDfs in the search context — fresh
@@ -1054,27 +1098,33 @@ class SearchActions:
 
     # ---- _msearch (ref: core/action/search/TransportMultiSearchAction) ----
 
-    def multi_search(self, items: list[tuple[str, dict]]) -> dict:
-        """Execute B (index_expr, body) search items → {"responses": [...]}.
+    def multi_search(self, items: list) -> dict:
+        """Execute B (index_expr, body[, search_type]) search items →
+        {"responses": [...]}.
 
-        Consecutive items on the SAME index expression batch into one
-        shard fan-out carrying every body — each data node then runs the
-        whole batch as one vmapped program when the plans align (the
-        reference fans request-at-a-time; an accelerator wants the batch).
-        Per-item failures return an {"error": ...} entry (the _msearch
-        contract), never failing the whole request.
+        Consecutive items on the SAME (index expression, search_type)
+        batch into one shard fan-out carrying every body — each data node
+        then runs the whole batch as one vmapped program when the plans
+        align (the reference fans request-at-a-time; an accelerator wants
+        the batch); dfs batches on an opted-in local index ride the
+        collective plane as ONE mesh program. Per-item failures return an
+        {"error": ...} entry (the _msearch contract), never failing the
+        whole request.
         """
+        items = [(it[0], it[1], it[2] if len(it) > 2 else None)
+                 for it in items]
         responses: list[dict | None] = [None] * len(items)
-        groups: list[tuple[str, list[int]]] = []
-        for i, (index_expr, _body) in enumerate(items):
-            if groups and groups[-1][0] == index_expr:
-                groups[-1][1].append(i)
+        groups: list[tuple[str, str | None, list[int]]] = []
+        for i, (index_expr, _body, stype) in enumerate(items):
+            if groups and groups[-1][0] == index_expr \
+                    and groups[-1][1] == stype:
+                groups[-1][2].append(i)
             else:
-                groups.append((index_expr, [i]))
-        futures = [self._msearch_pool.submit(self._msearch_group,
-                                             expr, [items[i][1] for i in idxs])
-                   for expr, idxs in groups]
-        for (expr, idxs), fut in zip(groups, futures):
+                groups.append((index_expr, stype, [i]))
+        futures = [self._msearch_pool.submit(
+            self._msearch_group, expr, [items[i][1] for i in idxs],
+            stype) for expr, stype, idxs in groups]
+        for (expr, stype, idxs), fut in zip(groups, futures):
             try:
                 outs = fut.result()
             except Exception as e:           # noqa: BLE001 — per-group error
@@ -1091,7 +1141,8 @@ class SearchActions:
                 responses[i] = out
         return {"responses": responses}
 
-    def _msearch_group(self, index_expr: str, bodies: list[dict]) -> list[dict]:
+    def _msearch_group(self, index_expr: str, bodies: list[dict],
+                       search_type: str | None = None) -> list[dict]:
         """One shard fan-out for a group of bodies on one index expr.
         Bodies are parsed ONCE here — invalid items answer immediately and
         never ship; per-item SHARD errors surface as that item's shard
@@ -1113,6 +1164,29 @@ class SearchActions:
         if not valid:
             return [o for o in outs]
         send_bodies = [bodies[i] for i in valid]
+        if search_type in ("dfs_query_then_fetch", "dfs_query_and_fetch"):
+            # a dfs msearch group is the collective plane's natural
+            # batch: ONE mesh program scores every item with global
+            # statistics; fallback runs the items individually
+            mesh_outs = self._try_collective_plane(
+                names, send_bodies, [parsed[i] for i in valid], t0)
+            if mesh_outs is not None:
+                for i, r in zip(valid, mesh_outs):
+                    outs[i] = r
+                return [o for o in outs]
+            # per-item dfs fallback, concurrently. A transient pool (not
+            # _pool/_msearch_pool) because this frame already RUNS on
+            # _msearch_pool and _search_once fans shards onto _pool —
+            # same-pool nesting deadlocks under saturation
+            from concurrent.futures import ThreadPoolExecutor as _TPE
+            with _TPE(max_workers=min(len(valid), 4)) as pool:
+                futs = {i: pool.submit(self._search_once, index_expr,
+                                       bodies[i], t0,
+                                       "dfs_query_then_fetch")
+                        for i in valid}
+                for i in valid:
+                    outs[i] = futs[i].result()
+            return [o for o in outs]
         state = self.node.cluster_service.state()
         groups = self._shard_groups(state, names)
         slot_of = {(n, s): i for i, (n, s) in

@@ -352,6 +352,13 @@ class IndexService:
     def close(self):
         for e in self.shard_engines:
             e.close()
+        # return the collective-plane cache's breaker reservation (set by
+        # SearchActions._mesh_searcher_for) — dropping the index must not
+        # strand fielddata budget
+        cached = self.__dict__.pop("_mesh_cache", None)
+        if cached is not None and len(cached) > 2 and cached[2] and \
+                self.breaker_service is not None:
+            self.breaker_service.breaker("fielddata").release(cached[2])
 
 
 class IndicesService:

@@ -467,7 +467,7 @@ class MeshEngineSearcher:
                     or req.min_score is not None
                     or req.search_after is not None or req.suggest
                     or req.terminate_after is not None
-                    or req.timeout_ms is not None):
+                    or req.timeout_ms is not None or req.rescore):
                 raise QueryParsingError(
                     "mesh engine plane supports score-ordered top-k "
                     "requests — route others to the RPC path")

@@ -87,13 +87,13 @@ def _check(tree: ast.AST) -> None:
             if node.id.startswith("__"):
                 raise PythonScriptError(
                     "[lang-python] dunder names are not allowed")
-            if node.id.startswith("_") and \
+            if node.id in ("_tick", "_tick_iter") and \
                     isinstance(node.ctx, (ast.Store, ast.Del)):
-                # underscored names are runtime-provided bindings (_agg,
-                # _score, the _tick budget hook) — rebinding them could
-                # disable enforcement
+                # rebinding the injected budget hooks would disable
+                # enforcement (plain `_` and user underscore names stay
+                # legal — only the enforcement names are reserved)
                 raise PythonScriptError(
-                    "[lang-python] cannot assign underscored names")
+                    "[lang-python] cannot assign reserved names")
 
 
 _OP_BUDGET = 200_000
@@ -109,9 +109,12 @@ def _bounded_range(*args):
 
 
 class _TickInjector(ast.NodeTransformer):
-    """Prepend a `_tick()` call to every loop body — the GroovyLite op
-    budget discipline (scriptlang.py: runaway loops raise instead of
-    hanging a shard thread)."""
+    """Meter every iteration construct with the op budget — the
+    GroovyLite discipline (scriptlang.py: runaway loops raise instead of
+    hanging a shard thread). Statement loops get a `_tick()` prepended to
+    the body; comprehensions/generator expressions get their iterables
+    wrapped in `_tick_iter(...)` (they iterate without a statement body
+    to hook)."""
 
     def _tick_stmt(self, ref):
         return ast.copy_location(
@@ -127,6 +130,13 @@ class _TickInjector(ast.NodeTransformer):
     def visit_For(self, node):
         self.generic_visit(node)
         node.body = [self._tick_stmt(node)] + node.body
+        return node
+
+    def visit_comprehension(self, node):
+        self.generic_visit(node)
+        node.iter = ast.copy_location(
+            ast.Call(func=ast.Name(id="_tick_iter", ctx=ast.Load()),
+                     args=[node.iter], keywords=[]), node.iter)
         return node
 
 
@@ -158,9 +168,15 @@ class CompiledPython:
                 raise PythonScriptError(
                     "[lang-python] op budget exceeded (runaway loop)")
 
+        def _tick_iter(it):
+            for x in it:
+                _tick()
+                yield x
+
         builtins = dict(_SAFE_BUILTINS)
         builtins["range"] = _bounded_range
-        scope = {"__builtins__": builtins, "_tick": _tick}
+        scope = {"__builtins__": builtins, "_tick": _tick,
+                 "_tick_iter": _tick_iter}
         scope.update(bindings)
         exec(self._code, scope)       # noqa: S102 — AST-whitelisted
         return scope.get("result")

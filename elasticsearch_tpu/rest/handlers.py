@@ -1455,7 +1455,9 @@ class Handlers:
             index = header.get("index", default_index) or default_index
             if isinstance(index, list):
                 index = ",".join(index)
-            items.append((index, body))
+            items.append((index, body,
+                          header.get("search_type",
+                                     req.param("search_type"))))
         return 200, self.node.search_actions.multi_search(items)
 
     @staticmethod

@@ -104,11 +104,61 @@ def test_refresh_invalidates_mesh_cache(nodes):
     n = nodes
     idx = n.indices_service.indices["on"]
     n.search("on", {"query": {"match": {"t": "w1"}}}, search_type=DFS)
-    gens0, ms0 = idx.__dict__["_mesh_cache"]
+    gens0, ms0 = idx.__dict__["_mesh_cache"][:2]
     n.index_doc("on", "fresh-1", {"t": "w1 freshterm", "v": 999})
+    # keep the comparison index identical (later tests diff on/off)
+    n.index_doc("off", "fresh-1", {"t": "w1 freshterm", "v": 999})
     n.broadcast_actions.refresh("on")
+    n.broadcast_actions.refresh("off")
     r = n.search("on", {"query": {"match": {"t": "freshterm"}}},
                  search_type=DFS)
     assert r["hits"]["total"] == 1
-    gens1, ms1 = idx.__dict__["_mesh_cache"]
+    gens1, ms1 = idx.__dict__["_mesh_cache"][:2]
     assert gens1 != gens0 and ms1 is not ms0
+
+
+def test_msearch_dfs_batch_through_mesh(nodes):
+    """A dfs _msearch group on an opted-in index runs as ONE mesh
+    program; answers must equal per-item dfs searches on the fan-out
+    index (and per-item search_type headers are honored at all)."""
+    n = nodes
+    items_on = [("on", dict(b), DFS) for b in BODIES[:2]]
+    items_off = [("off", dict(b), DFS) for b in BODIES[:2]]
+    ra = n.search_actions.multi_search(items_on)["responses"]
+    rb = n.search_actions.multi_search(items_off)["responses"]
+    for a, b in zip(ra, rb):
+        assert "error" not in a and "error" not in b
+        assert a["hits"]["total"] == b["hits"]["total"]
+        assert [(h["_id"], round(h["_score"], 4))
+                for h in a["hits"]["hits"]] == \
+            [(h["_id"], round(h["_score"], 4)) for h in b["hits"]["hits"]]
+
+
+def test_msearch_mixed_shapes_fall_back(nodes):
+    n = nodes
+    items = [("on", {"query": {"match": {"t": "w1"}}, "size": 3}, DFS),
+             ("on", {"query": {"match": {"t": "w2"}}, "size": 3,
+                     "sort": [{"v": "desc"}]}, DFS)]
+    rs = n.search_actions.multi_search(items)["responses"]
+    assert all("error" not in r for r in rs)
+    assert rs[1]["hits"]["hits"][0]["_source"]["v"] >= \
+        rs[1]["hits"]["hits"][-1]["_source"]["v"]
+
+
+def test_mesh_cache_breaker_accounted(nodes):
+    """The stacked mesh copy reserves fielddata budget and returns it
+    when the index closes (review r4)."""
+    n = nodes
+    n.search("on", {"query": {"match": {"t": "w1"}}}, search_type=DFS)
+    cached = n.indices_service.indices["on"].__dict__["_mesh_cache"]
+    assert len(cached) == 3 and cached[2] > 0
+    fd = n.breaker_service.breaker("fielddata")
+    assert fd.used >= cached[2]
+
+
+def test_mesh_feeds_search_stats(nodes):
+    n = nodes
+    idx = n.indices_service.indices["on"]
+    before = idx.search_stats["query_total"]
+    n.search("on", {"query": {"match": {"t": "w1"}}}, search_type=DFS)
+    assert idx.search_stats["query_total"] == before + 1

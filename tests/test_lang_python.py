@@ -150,3 +150,14 @@ class TestSandboxHardening:
         # reading runtime bindings stays fine
         assert compile_python("_agg['x']").run(
             {"_agg": {"x": 5}}) == 5
+
+    def test_comprehension_budget(self):
+        with pytest.raises(PythonScriptError) as ei:
+            compile_python(
+                "sum(1 for i in range(100000) for j in range(100000))"
+            ).run({})
+        assert "budget" in str(ei.value)
+        # small comprehensions still work, and plain `_` is legal again
+        assert compile_python("[i * 2 for _ in range(2) "
+                              "for i in range(3)]").run(
+            {}) == [0, 2, 4, 0, 2, 4]
