@@ -799,10 +799,12 @@ class SearchActions:
         if len(names) != 1:
             return None
         for req in reqs:
-            if req.sort or req.post_filter is not None \
-                    or req.min_score is not None \
-                    or req.search_after is not None or req.suggest \
-                    or req.terminate_after is not None \
+            # sort / post_filter / min_score / search_after-with-sort /
+            # metric + terms/histogram aggs now run IN-PROGRAM — the
+            # mesh searcher itself raises QueryParsingError for the
+            # residual ineligible shapes (scripts, geo, keyword sorts,
+            # sub-aggs) and the fan-out handles them
+            if req.suggest or req.terminate_after is not None \
                     or req.timeout_ms is not None or req.rescore:
                 return None
         index = self.node.indices_service.indices.get(names[0])
@@ -815,6 +817,12 @@ class SearchActions:
         nshards = index.meta.number_of_shards
         if nshards < 2 or set(index.engines) != set(range(nshards)):
             return None                   # not every shard lives here
+        if not self._plane_precheck(index, reqs):
+            # always-ineligible shape (keyword/_doc sort, sub-aggs,
+            # score-order search_after, …): bail BEFORE the mesh build —
+            # _mesh_searcher_for stacks every shard column into HBM, a
+            # cost the RPC fallback should not pay per refresh generation
+            return None
         from elasticsearch_tpu.search.controller import merge_responses
         from elasticsearch_tpu.search.phase import (ShardQueryResult,
                                                     ShardSearcher)
@@ -841,23 +849,29 @@ class SearchActions:
         responses = []
         q_ms = (time.perf_counter() - t0) * 1e3
         for body, req, out in zip(bodies, reqs, outs):
-            per_shard: dict[int, list[tuple[int, float]]] = {}
-            for g, sc in zip(out["doc_ids"], out["scores"]):
+            sort_vals = out.get("sort_values")
+            per_shard: dict[int, list[tuple[int, float, list]]] = {}
+            for pos, (g, sc) in enumerate(zip(out["doc_ids"],
+                                              out["scores"])):
                 si, j, row = msearch.resolve(int(g))
                 rdoc = searchers[si].reader.segments[j].doc_base + row
-                per_shard.setdefault(si, []).append((rdoc, float(sc)))
+                per_shard.setdefault(si, []).append(
+                    (rdoc, float(sc),
+                     sort_vals[pos] if sort_vals is not None else None))
             results = []
             for si, s in enumerate(searchers):
                 rows = per_shard.get(si, [])
                 results.append(ShardQueryResult(
                     si,
-                    # only the GLOBAL total exists (in-program psum);
-                    # carried on shard 0 so the coordinator sum is exact
-                    int(out["total"]) if si == 0 else 0,
-                    max((sc for _, sc in rows), default=None),
-                    np.asarray([d for d, _ in rows], np.int32),
-                    np.asarray([sc for _, sc in rows], np.float32),
-                    None, {}, s.reader))
+                    # real per-shard totals from the program's
+                    # all_gather count lane
+                    int(out["shard_totals"][si]),
+                    max((sc for _, sc, _ in rows), default=None),
+                    np.asarray([d for d, _, _ in rows], np.int32),
+                    np.asarray([sc for _, sc, _ in rows], np.float32),
+                    [sv for _, _, sv in rows]
+                    if sort_vals is not None else None,
+                    {}, s.reader))
             resp = merge_responses(index.name, req, results, searchers,
                                    (time.perf_counter() - t0) * 1e3, None)
             mesh_aggs = out.get("aggregations")
@@ -874,6 +888,42 @@ class SearchActions:
                     f"collective-plane, source"
                     f"[{json.dumps(body)[:512]}]")
         return responses
+
+    @staticmethod
+    def _plane_precheck(index, reqs: list) -> bool:
+        """Mapping-only eligibility screen, run before committing to the
+        mesh pack. Conservative: anything it cannot rule out passes
+        through to the searcher's precise layout-based validation (which
+        raises QueryParsingError → RPC fallback)."""
+        from elasticsearch_tpu.parallel.mesh_engine import _MESH_METRICS
+        from elasticsearch_tpu.search.phase import _is_score_order
+        string_types = ("keyword", "string", "text")
+        for req in reqs:
+            if _is_score_order(req.sort):
+                if req.search_after is not None:
+                    return False          # score-order cursors are
+            else:                         # doc-id-relative (plane-local)
+                for spec in req.sort:
+                    (fname, _), = spec.items()
+                    if fname == "_doc":
+                        return False
+                    if fname == "_score":
+                        continue
+                    fm = index.mapper_service.field_mapper(fname)
+                    if fm is not None and fm.type in string_types:
+                        return False      # keyword sorts stay host-side
+            for node in req.aggs:
+                if node.subs or node.pipelines:
+                    return False
+                if node.type not in _MESH_METRICS + ("terms",
+                                                     "histogram"):
+                    return False
+                if node.type == "terms":
+                    fname = str(node.params.get("field", ""))
+                    fm = index.mapper_service.field_mapper(fname)
+                    if fm is not None and fm.type == "text":
+                        return False      # analyzed-text terms
+        return True
 
     def _mesh_searcher_for(self, index):
         """Cache per segment-generation tuple (a refresh on any shard

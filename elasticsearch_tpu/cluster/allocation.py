@@ -524,11 +524,12 @@ class AllocationService:
           and peer recovery rebuilds it.
         * allocate / allocate_replica — pin an UNASSIGNED copy onto a
           node.
-        * move — unassign on from_node and pin-initialize on to_node.
-          Streaming relocation (RELOCATING handoff) is not implemented,
-          so moving a primary requires an active replica (which promotes;
-          the moved copy then peer-recovers) — a sole primary refuses to
-          move rather than lose data.
+        * move — streaming relocation with handoff (RELOCATING state):
+          the source keeps serving and coordinating writes while the
+          target recovers, rides the replication fan-out, and
+          apply_started_shards flips ownership — a sole primary moves
+          under live writes with no data loss (see the move branch
+          below and tests/test_relocation.py).
         """
         from elasticsearch_tpu.common.errors import IllegalArgumentError
         routing = state.routing_table

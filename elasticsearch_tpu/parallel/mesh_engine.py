@@ -18,17 +18,41 @@ closures of search/execute.py — as ONE SPMD program over a device mesh:
   DFS round; term *ids* stay per-shard constants since segment
   dictionaries differ) so every shard scores with identical idf/avgdl;
 * in-program: per-slot emit under ``jax.vmap`` → per-shard top-k →
-  ``all_gather`` over ICI + re-top-k, hit counts via ``psum`` — the whole
-  scatter-gather-reduce with no host round trips (SURVEY §2.2/§2.10).
+  ``all_gather`` over ICI + re-top-k, per-shard hit counts via an
+  all_gather lane — the whole scatter-gather-reduce with no host round
+  trips (SURVEY §2.2/§2.10).
 
-Results are bit-identical to the RPC path under dfs_query_then_fetch (the
-host merge concatenates shard payloads in the same shard order the
-all_gather does, and lax.top_k is stable) — asserted by
-tests/test_mesh_engine.py and the driver's dryrun_multichip.
+Eligible request shapes (everything else raises QueryParsingError and the
+caller falls back to the RPC fan-out):
+
+* score-ordered top-k (the original plane);
+* **sort-by-field** — numeric doc-values sort keys ride the merge as
+  double-double (hi, lo) pairs; per-shard selection is a multi-key stable
+  argsort (value asc/desc, tie by doc id) and the cross-shard merge
+  re-sorts the gathered candidate keys with shard-major tie-break, the
+  (sort values, shard, position) order of SearchPhaseController.sortDocs;
+* **post_filter** — a second mask emit ANDed into hits but not into the
+  aggregation mask (SearchContext.postFilter semantics);
+* **min_score** — per-query score threshold const;
+* **search_after with a field sort** — the cursor becomes an in-program
+  lexicographic strictly-greater mask over the transformed sort keys;
+* **metric aggs** (min/max/sum/avg/value_count/stats) psum'd in-program;
+* **terms / histogram bucket aggs** — fixed-width in-program reductions:
+  per-(shard, slot) ordinal counts (exact, vocab-sized) and
+  double-double histogram scatter-adds against a statically-based bucket
+  window, all_gathered and rendered through the same
+  ``reduce_aggs`` pipeline the RPC coordinator uses
+  (InternalAggregations.reduce analog).
+
+Results are bit-identical to the RPC path (the host merge concatenates
+shard payloads in the same shard order the all_gather does, and the
+selection orders are stable) — asserted by tests/test_mesh_engine.py and
+the driver's dryrun_multichip.
 """
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 
 import jax
@@ -61,35 +85,185 @@ _FLAGS = {
 #: ICI instead of the host coordinator)
 _MESH_METRICS = ("min", "max", "sum", "avg", "value_count", "stats")
 
+#: histogram bucket-window cap — the whole field range must bucketize
+#: into this many slots for the static-base scatter-add (matches the RPC
+#: device path's _MAX_DEVICE_HISTO_BUCKETS discipline)
+_MAX_HISTO_BUCKETS = 4096
 
-def _mesh_agg_spec(reqs) -> tuple | None:
-    """Validate + extract a batch-uniform metric-agg spec.
+#: terms agg budget: padded_vocab × batch × shards cells gathered per agg
+_MAX_TERMS_CELLS = 1 << 26
 
-    → tuple of (name, kind, field), or None when there are no aggs.
-    Raises QueryParsingError for aggs the plane can't reduce (bucket
-    aggs, sub-aggs, scripts) or non-uniform specs — callers route those
-    to the RPC path.
-    """
+
+def _stable_order(keys: list, kk: int):
+    """Lexicographic ascending order over column-stacked keys [B, M]
+    (most-significant first), ties broken by original index — composed
+    stable argsorts from least- to most-significant key. → idx [B, kk]."""
+    b, m = keys[0].shape
+    order = jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32), (b, m))
+    for key in keys[::-1]:
+        cur = jnp.take_along_axis(key, order, axis=1)
+        o2 = jnp.argsort(cur, axis=1, stable=True)
+        order = jnp.take_along_axis(order, o2, axis=1)
+    return order[:, :kk]
+
+
+def _gather_payload(payload: dict, idx):
+    return {name: jnp.take_along_axis(arr, idx, axis=1)
+            for name, arr in payload.items()}
+
+
+def _dd_fill(v: float) -> tuple[float, float]:
+    """dd_split for fill/cursor scalars → plain floats (dd_split itself
+    already zeroes the residual for non-finite inputs)."""
+    hi, lo = dd_split(np.float64(v))
+    return float(hi), float(lo)
+
+
+@dataclass(frozen=True)
+class _SortSpec:
+    """One static sort key: a numeric doc-values field or _score."""
+    field: str                 # "" for _score
+    order: str                 # "asc" | "desc"
+    fill: float                # raw missing fill (±inf or numeric missing)
+
+    @property
+    def is_score(self) -> bool:
+        return self.field == ""
+
+
+def _mesh_sort_spec(reqs, layouts) -> tuple:
+    """Validate + extract a batch-uniform field-sort spec.
+
+    → tuple[_SortSpec]. Raises QueryParsingError for sorts the plane
+    can't run in-program (keyword/script sorts, _doc, per-request
+    divergent specs) — callers route those to the RPC path."""
+    raw0 = reqs[0].sort
+    if any(req.sort != raw0 for req in reqs):
+        raise QueryParsingError(
+            "mesh engine plane requires one sort spec per batch")
     specs = []
+    for spec in raw0:
+        (fname, opts), = spec.items()
+        order = opts.get("order", "asc")
+        missing = opts.get("missing", "_last")
+        if fname == "_doc":
+            raise QueryParsingError(
+                "mesh engine plane cannot sort by _doc (doc-id numbering "
+                "is plane-local) — use the RPC fan-out path")
+        if fname == "_score":
+            specs.append(_SortSpec("", order, 0.0))
+            continue
+        if any(fname in lay.keyword or fname in lay.text
+               for lay in layouts):
+            raise QueryParsingError(
+                f"mesh engine plane sorts numeric doc-values only — "
+                f"[{fname}] needs the host vocab-union path")
+        if missing in ("_last", "_first"):
+            fill = math.inf if (missing == "_last") == (order == "asc") \
+                else -math.inf
+        else:
+            fill = float(missing)
+        specs.append(_SortSpec(fname, order, fill))
+    return tuple(specs)
+
+
+def _mesh_agg_plan(reqs, layouts, field_extrema) -> tuple:
+    """Validate + extract batch-uniform agg lanes.
+
+    → (metric_spec, bucket_specs): metric_spec is the (name, kind, field)
+    tuple of the psum lane; bucket_specs is a tuple of
+    ("terms", name, resolved_field) / ("histogram", name, field,
+    interval, base, n_buckets) entries. Raises QueryParsingError for aggs
+    the plane can't reduce (sub-aggs, scripts, other bucket kinds,
+    non-uniform specs) — callers route those to the RPC path."""
+    metric_sig, bucket_sig = [], []
     for req in reqs:
-        cur = []
+        met, buck = [], []
         for node in req.aggs:
-            # 'missing'/'script' change per-doc values — the RPC device
-            # path (aggregations.collect_device) rejects them the same way
-            if node.subs or node.pipelines or \
-                    node.type not in _MESH_METRICS or \
-                    "field" not in node.params or \
-                    set(node.params) - {"field", "format"}:
+            if node.subs or node.pipelines:
+                raise QueryParsingError(
+                    f"mesh engine plane cannot reduce sub/pipeline aggs "
+                    f"under [{node.name}] in-program — use the RPC "
+                    f"fan-out path")
+            if node.type in _MESH_METRICS:
+                # 'missing'/'script' change per-doc values — the RPC
+                # device path (aggregations.collect_device) rejects them
+                # the same way
+                if "field" not in node.params or \
+                        set(node.params) - {"field", "format"}:
+                    raise QueryParsingError(
+                        f"mesh engine plane cannot reduce agg "
+                        f"[{node.name}:{node.type}] in-program — use the "
+                        f"RPC fan-out path")
+                met.append((node.name, node.type,
+                            str(node.params["field"])))
+            elif node.type == "terms":
+                if "field" not in node.params or \
+                        set(node.params) - {"field", "size", "shard_size",
+                                            "order", "min_doc_count",
+                                            "format"}:
+                    raise QueryParsingError(
+                        f"mesh engine plane terms agg [{node.name}] has "
+                        f"unsupported params — use the RPC fan-out path")
+                fname = str(node.params["field"])
+                if any(fname in lay.text for lay in layouts):
+                    raise QueryParsingError(
+                        f"terms over analyzed text [{fname}] stays "
+                        f"host-side — use the RPC fan-out path")
+                if any(fname in lay.keyword for lay in layouts):
+                    resolved = fname
+                elif any(f"{fname}.keyword" in lay.keyword
+                         for lay in layouts):
+                    resolved = f"{fname}.keyword"
+                else:
+                    raise QueryParsingError(
+                        f"terms agg field [{fname}] is not a keyword "
+                        f"column — use the RPC fan-out path")
+                buck.append(("terms", node.name, resolved))
+            elif node.type == "histogram":
+                if "field" not in node.params or "interval" not in \
+                        node.params or \
+                        set(node.params) - {"field", "interval", "offset",
+                                            "min_doc_count", "format",
+                                            "order"}:
+                    raise QueryParsingError(
+                        f"mesh engine plane histogram [{node.name}] has "
+                        f"unsupported params — use the RPC fan-out path")
+                fname = str(node.params["field"])
+                interval = float(node.params["interval"])
+                offset = float(node.params.get("offset", 0.0))
+                if interval <= 0:
+                    raise QueryParsingError("histogram interval must be "
+                                            "positive")
+                ext = field_extrema.get(fname)
+                if ext is None:
+                    buck.append(("histogram", node.name, fname,
+                                 interval, 0.0, 0))
+                    continue
+                fmin, fmax = ext
+                first = math.floor((fmin - offset) / interval)
+                last = math.floor((fmax - offset) / interval)
+                n_buckets = int(last - first + 1)
+                if n_buckets > _MAX_HISTO_BUCKETS:
+                    raise QueryParsingError(
+                        f"histogram [{node.name}] needs {n_buckets} "
+                        f"buckets > {_MAX_HISTO_BUCKETS} — use the RPC "
+                        f"fan-out path")
+                base = first * interval + offset
+                buck.append(("histogram", node.name, fname, interval,
+                             base, n_buckets))
+            else:
                 raise QueryParsingError(
                     f"mesh engine plane cannot reduce agg "
-                    f"[{node.name}:{node.type}] in-program — use the "
-                    f"RPC fan-out path")
-            cur.append((node.name, node.type, str(node.params["field"])))
-        specs.append(tuple(cur))
-    if any(s != specs[0] for s in specs):
+                    f"[{node.name}:{node.type}] in-program — use the RPC "
+                    f"fan-out path")
+        metric_sig.append(tuple(met))
+        bucket_sig.append(tuple(buck))
+    if any(s != metric_sig[0] for s in metric_sig) or \
+            any(s != bucket_sig[0] for s in bucket_sig):
         raise QueryParsingError(
             "mesh engine plane requires one agg spec per batch")
-    return specs[0] or None
+    return metric_sig[0] or None, bucket_sig[0] or None
 
 
 def _pad2(a: np.ndarray, rows: int, cols: int, fill) -> np.ndarray:
@@ -109,7 +283,8 @@ class _SlotLayout:
     """Common padded layout of one segment slot across every shard."""
     np_docs: int
     text: dict[str, tuple[int, int]]       # field → (L, U)
-    keyword: dict[str, int]                # field → K
+    keyword: dict[str, int]                # field → K (ords width)
+    kw_vocab: dict[str, int]               # field → padded vocab size
     numeric: list[str]
 
 
@@ -150,6 +325,26 @@ class MeshEngineSearcher:
         self.slot_bases = np.cumsum(
             [0] + [lay.np_docs for lay in self._layouts])[:-1].tolist()
         self.shard_stride = int(sum(lay.np_docs for lay in self._layouts))
+        # exact f64 extrema per numeric field across every shard's live
+        # columns — gives histogram lanes a STATIC dd base (the whole
+        # field range maps to one bucket window, so per-query scatter-adds
+        # need no data-dependent base collective)
+        self._field_extrema: dict[str, tuple[float, float]] = {}
+        for v in views:
+            for seg in v.segments:
+                for name, col in seg.numeric_fields.items():
+                    vals = col.values[col.exists[:len(col.values)]] \
+                        if col.exists is not None else col.values
+                    if vals.size == 0:
+                        continue
+                    lo = float(np.min(vals))
+                    hi = float(np.max(vals))
+                    cur = self._field_extrema.get(name)
+                    if cur is None:
+                        self._field_extrema[name] = (lo, hi)
+                    else:
+                        self._field_extrema[name] = (min(cur[0], lo),
+                                                     max(cur[1], hi))
         # templates[s][j]: host-side DeviceSegment (numpy arrays, real host
         # column dicts) used for resolution; shard 0's templates also give
         # the traced structure in the program body
@@ -175,6 +370,7 @@ class MeshEngineSearcher:
         np_docs = 0
         text: dict[str, tuple[int, int]] = {}
         keyword: dict[str, int] = {}
+        kw_vocab: dict[str, int] = {}
         numeric: set[str] = set()
         for v in self._views:
             if j >= len(v.segments):
@@ -187,6 +383,7 @@ class MeshEngineSearcher:
                               max(pu, c.uterms.shape[1]))
             for name, c in seg.keyword_fields.items():
                 keyword[name] = max(keyword.get(name, 0), c.ords.shape[1])
+                kw_vocab[name] = max(kw_vocab.get(name, 1), len(c.vocab))
             numeric.update(seg.numeric_fields)
             if seg.vector_fields or seg.geo_fields or seg.nested_blocks \
                     or seg.shape_fields:
@@ -194,7 +391,8 @@ class MeshEngineSearcher:
                     "mesh engine plane does not pack vector/geo/shape/"
                     "nested fields yet — use the RPC fan-out path")
         return _SlotLayout(np_docs=max(np_docs, 8), text=text,
-                           keyword=keyword, numeric=sorted(numeric))
+                           keyword=keyword, kw_vocab=kw_vocab,
+                           numeric=sorted(numeric))
 
     def _template(self, si: int, j: int) -> DeviceSegment:
         """Shard ``si`` slot ``j`` padded to the slot layout — numpy arrays
@@ -270,13 +468,22 @@ class MeshEngineSearcher:
     # ---- the program ------------------------------------------------------
 
     def _program(self, sigs, layouts, k: int, b_pad: int, consts_tree,
-                 emits, refss, templates0, agg_spec=None):
+                 emits, pfs, refss, templates0, agg_spec=None,
+                 bucket_specs=None, sort_specs=None, has_cursor=False):
         # the compiled program depends only on WHICH fields get partials
         # (names/kinds are host-side rendering) — key accordingly so
         # renamed aggs share the executable
         agg_fields = sorted({f for _, _, f in agg_spec}) if agg_spec \
             else []
-        key = (tuple(sigs), tuple(layouts), k, b_pad, tuple(agg_fields))
+        bucket_key = tuple(
+            (b[0], b[2]) + ((b[3], b[4], b[5]) if b[0] == "histogram"
+                            else ())
+            for b in bucket_specs) if bucket_specs else ()
+        sort_key = tuple((s.field, s.order, s.fill)
+                         for s in sort_specs) if sort_specs else None
+        key = (tuple(sigs), tuple(layouts), k, b_pad, tuple(agg_fields),
+               bucket_key, sort_key, has_cursor,
+               tuple(pf is not None for pf in pfs))
         fn = self._programs.get(key)
         if fn is not None:
             return fn
@@ -284,26 +491,46 @@ class MeshEngineSearcher:
         slot_bases = self.slot_bases
         stride = self.shard_stride
         spd = self.spd
-        flags = dict(_FLAGS, want_arrays=bool(agg_fields))
+        sort_mode = sort_specs is not None
+        want_arrays = bool(agg_fields or bucket_specs) or sort_mode
+        flags = dict(_FLAGS, want_topk=not sort_mode,
+                     want_arrays=want_arrays,
+                     min_score=bool(refss[0] and "min_score" in refss[0]))
+        # per-bucket static plans
+        terms_lanes = [b for b in (bucket_specs or ())
+                       if b[0] == "terms"]
+        histo_lanes = [b for b in (bucket_specs or ())
+                       if b[0] == "histogram"]
+        kw_vocab = [lay_obj.kw_vocab for lay_obj in self._layouts]
 
-        def step_local(flats, consts):
+        def step_local(flats, consts, cursors):
             # flats[j]: arrays [spd, Np_j, ...]; consts[j]: [spd, B_local, ...]
+            from elasticsearch_tpu.ops import aggs_ops
             dev_idx = jax.lax.axis_index("shard").astype(jnp.int32)
-            cand_s, cand_d, counts = [], [], None
+            cand = []                    # per-block payload dicts [B, k]
+            counts_blocks = []           # per-block [B] hit counts
             b_local = None
             acc = {f: None for f in agg_fields}
+            terms_acc = {(b[1], j): [] for b in terms_lanes
+                         for j in range(n_slots)}
+            histo_acc = {b[1]: None for b in histo_lanes}
             for li in range(spd):
                 seg_scores, seg_docs = [], []
+                arr_scores, arr_masks = [], []
+                counts = None
+                views = []
                 for j in range(n_slots):
                     view = seg_rebuild(templates0[j],
                                        [a[li] for a in flats[j]])
+                    views.append(view)
 
                     def one(cs, j=j, view=view):
-                        return _build(view, list(cs), emits[j], None,
+                        return _build(view, list(cs), emits[j], pfs[j],
                                       refss[j], flags, k)
 
                     outs = jax.vmap(one)(
                         jax.tree.map(lambda a, li=li: a[li], consts[j]))
+                    b_local = outs["count"].shape[0]
                     if agg_fields:
                         # per-shard metric partials from the query mask,
                         # reduced over ICI after the loop. Values are the
@@ -312,7 +539,6 @@ class MeshEngineSearcher:
                         # device agg path preserves (aggregations.py
                         # _d_metric / _dd_extrema)
                         amask = outs["agg_mask"]          # [B, N]
-                        b_local = amask.shape[0]
                         for f in agg_fields:
                             ncol = view.numeric.get(f)
                             if ncol is None:
@@ -349,129 +575,345 @@ class MeshEngineSearcher:
                                     jnp.where(pick_mn, p[4], a0[4]),
                                     jnp.where(pick_mx, p[5], a0[5]),
                                     jnp.where(pick_mx, p[6], a0[6])]
-                    docs = jnp.where(outs["top_docs"] >= 0,
-                                     outs["top_docs"] + slot_bases[j], -1)
-                    seg_scores.append(outs["top_scores"])
-                    seg_docs.append(docs)
+                    if bucket_specs:
+                        amask = outs["agg_mask"]          # [B, N]
+                        for lane in terms_lanes:
+                            _, name, f = lane
+                            kcol = view.keyword.get(f)
+                            v_j = kw_vocab[j].get(f, 1)
+                            if kcol is None:
+                                terms_acc[(name, j)].append(
+                                    jnp.zeros((b_local, v_j), jnp.int32))
+                            else:
+                                terms_acc[(name, j)].append(jax.vmap(
+                                    lambda m, kcol=kcol, v_j=v_j:
+                                    aggs_ops.ord_value_counts(
+                                        kcol.ords, m, v_j))(amask))
+                        for lane in histo_lanes:
+                            _, name, f, interval, base, nb = lane
+                            if nb == 0:
+                                continue
+                            ncol = view.numeric.get(f)
+                            if ncol is None:
+                                continue
+                            bh, bl = dd_split(np.float64(base))
+                            h = jax.vmap(
+                                lambda m, ncol=ncol, bh=bh, bl=bl,
+                                interval=interval, nb=nb:
+                                aggs_ops.histogram_counts_dd(
+                                    ncol.hi, ncol.lo, ncol.exists, m,
+                                    float(bh), float(bl), interval,
+                                    nb))(amask)
+                            histo_acc[name] = h if histo_acc[name] is None \
+                                else histo_acc[name] + h
+                    if sort_mode:
+                        arr_scores.append(outs["scores"])
+                        arr_masks.append(outs["mask"])
+                    else:
+                        docs = jnp.where(outs["top_docs"] >= 0,
+                                         outs["top_docs"] + slot_bases[j],
+                                         -1)
+                        seg_scores.append(outs["top_scores"])
+                        seg_docs.append(docs)
                     counts = outs["count"] if counts is None \
                         else counts + outs["count"]
-                scores = jnp.concatenate(seg_scores, axis=1)  # [B, slots*k]
-                docs = jnp.concatenate(seg_docs, axis=1)
-                kk = min(k, scores.shape[1])
-                top_s, idx = jax.lax.top_k(
-                    jnp.where(docs >= 0, scores, -jnp.inf), kk)
-                top_d = jnp.take_along_axis(docs, idx, axis=1)
-                top_d = jnp.where(top_s > -jnp.inf,
-                                  top_d + (dev_idx * spd + li) * stride, -1)
-                if kk < k:
-                    top_s = jnp.pad(top_s, ((0, 0), (0, k - kk)),
-                                    constant_values=-jnp.inf)
-                    top_d = jnp.pad(top_d, ((0, 0), (0, k - kk)),
-                                    constant_values=-1)
-                cand_s.append(top_s)
-                cand_d.append(top_d)
-            if spd > 1:
-                # local merge over this device's shard block: keeping k of
-                # the spd*k candidates is exact (each dropped candidate
-                # loses to >=k same-device candidates that also outrank it
-                # globally; stable top_k keeps the lower shard on ties —
-                # the (-score, shard) order of SearchPhaseController)
-                loc_s = jnp.concatenate(cand_s, axis=1)       # [B, spd*k]
-                loc_d = jnp.concatenate(cand_d, axis=1)
-                top_s, pos = jax.lax.top_k(
-                    jnp.where(loc_d >= 0, loc_s, -jnp.inf), k)
-                top_d = jnp.take_along_axis(loc_d, pos, axis=1)
-                top_d = jnp.where(top_s > -jnp.inf, top_d, -1)
+                counts_blocks.append(counts)
+                shard_off = (dev_idx * spd + li) * stride
+                if sort_mode:
+                    scores = jnp.concatenate(arr_scores, axis=1)  # [B, str]
+                    mask = jnp.concatenate(arr_masks, axis=1)
+                    inval = jnp.where(mask, 0.0, 1.0).astype(jnp.float32)
+                    thi_list, tlo_list = [], []
+                    for sp in sort_specs:
+                        if sp.is_score:
+                            raw_hi, raw_lo = scores, \
+                                jnp.zeros_like(scores)
+                        else:
+                            cols_hi, cols_lo = [], []
+                            f_hi, f_lo = _dd_fill(sp.fill)
+                            for view in views:
+                                ncol = view.numeric.get(sp.field)
+                                n_j = view.live.shape[0]
+                                if ncol is None:
+                                    # host absent-column semantics: flat
+                                    # +inf raw key (phase._sort_column)
+                                    cols_hi.append(jnp.full(
+                                        n_j, jnp.inf, jnp.float32))
+                                    cols_lo.append(jnp.zeros(
+                                        n_j, jnp.float32))
+                                else:
+                                    cols_hi.append(jnp.where(
+                                        ncol.exists, ncol.hi,
+                                        jnp.float32(f_hi)))
+                                    cols_lo.append(jnp.where(
+                                        ncol.exists, ncol.lo,
+                                        jnp.float32(f_lo)))
+                            raw_hi = jnp.broadcast_to(
+                                jnp.concatenate(cols_hi)[None, :],
+                                scores.shape)
+                            raw_lo = jnp.broadcast_to(
+                                jnp.concatenate(cols_lo)[None, :],
+                                scores.shape)
+                        if sp.order == "desc":
+                            raw_hi, raw_lo = -raw_hi, -raw_lo
+                        thi_list.append(raw_hi)
+                        tlo_list.append(raw_lo)
+                    if has_cursor:
+                        # strictly-after mask in transformed key space:
+                        # lexicographic (k1,k2,...) > (c1,c2,...)
+                        cur = cursors[li]                  # [B, 2*nspec]
+                        gt = jnp.zeros_like(mask)
+                        eq = jnp.ones_like(mask)
+                        for i in range(len(sort_specs)):
+                            for comp, arr in ((0, thi_list[i]),
+                                              (1, tlo_list[i])):
+                                c = cur[:, 2 * i + comp][:, None]
+                                gt = gt | (eq & (arr > c))
+                                eq = eq & (arr == c)
+                        mask = mask & gt
+                        inval = jnp.where(mask, 0.0, 1.0).astype(
+                            jnp.float32)
+                    keys = [inval]
+                    for hi_a, lo_a in zip(thi_list, tlo_list):
+                        keys.append(jnp.where(inval > 0, jnp.inf, hi_a))
+                        keys.append(jnp.where(inval > 0, jnp.inf, lo_a))
+                    kk = min(k, stride)
+                    idx = _stable_order(keys, kk)
+                    payload = {"docs": jnp.broadcast_to(
+                        jnp.arange(stride, dtype=jnp.int32),
+                        mask.shape), "scores": scores, "inval": inval}
+                    for i, (hi_a, lo_a) in enumerate(
+                            zip(thi_list, tlo_list)):
+                        payload[f"khi{i}"] = hi_a
+                        payload[f"klo{i}"] = lo_a
+                    top = _gather_payload(payload, idx)
+                    top["docs"] = jnp.where(
+                        top["inval"] > 0, -1, top["docs"] + shard_off)
+                    if kk < k:
+                        pads = {"docs": -1, "scores": -jnp.inf,
+                                "inval": 1.0}
+                        top = {name: jnp.pad(
+                            arr, ((0, 0), (0, k - kk)),
+                            constant_values=pads.get(name, jnp.inf))
+                            for name, arr in top.items()}
+                    cand.append(top)
+                else:
+                    scores = jnp.concatenate(seg_scores, axis=1)
+                    docs = jnp.concatenate(seg_docs, axis=1)
+                    kk = min(k, scores.shape[1])
+                    top_s, idx = jax.lax.top_k(
+                        jnp.where(docs >= 0, scores, -jnp.inf), kk)
+                    top_d = jnp.take_along_axis(docs, idx, axis=1)
+                    top_d = jnp.where(top_s > -jnp.inf,
+                                      top_d + shard_off, -1)
+                    if kk < k:
+                        top_s = jnp.pad(top_s, ((0, 0), (0, k - kk)),
+                                        constant_values=-jnp.inf)
+                        top_d = jnp.pad(top_d, ((0, 0), (0, k - kk)),
+                                        constant_values=-1)
+                    cand.append({"docs": top_d, "scores": top_s})
+
+            def merge(blocks: list, force: bool = False) -> dict:
+                """Exact candidate merge: keeping k of the len(blocks)*k
+                candidates loses only entries outranked by >=k better
+                same-gather candidates; stable order keeps the earlier
+                block on ties — blocks arrive shard-major, so this is the
+                (sort key, shard, position) order of
+                SearchPhaseController.sortDocs."""
+                if len(blocks) == 1 and not force:
+                    return blocks[0]
+                allp = {name: jnp.concatenate(
+                    [blk[name] for blk in blocks], axis=1)
+                    for name in blocks[0]}
+                if sort_mode:
+                    keys = [allp["inval"]]
+                    for i in range(len(sort_specs)):
+                        keys.append(jnp.where(allp["inval"] > 0, jnp.inf,
+                                              allp[f"khi{i}"]))
+                        keys.append(jnp.where(allp["inval"] > 0, jnp.inf,
+                                              allp[f"klo{i}"]))
+                    idx = _stable_order(keys, k)
+                else:
+                    _, idx = jax.lax.top_k(
+                        jnp.where(allp["docs"] >= 0, allp["scores"],
+                                  -jnp.inf), k)
+                return _gather_payload(allp, idx)
+
+            local = merge(cand)
+            # ---- reduce over ICI: per-shard count lane + gathered merge
+            counts_stack = jnp.stack(counts_blocks)        # [spd, B]
+            shard_counts = jax.lax.all_gather(
+                counts_stack, "shard")                     # [s_mesh, spd, B]
+            gathered = {name: jax.lax.all_gather(arr, "shard")
+                        for name, arr in local.items()}    # [S, B, k]
+            s_ax = next(iter(gathered.values())).shape[0]
+            flat = {name: jnp.moveaxis(arr, 0, 1).reshape(
+                -1, s_ax * k) for name, arr in gathered.items()}
+            g = merge([flat], force=True)
+            if sort_mode:
+                g["docs"] = jnp.where(g["inval"] > 0, -1, g["docs"])
+                g["scores"] = jnp.where(g["inval"] > 0, -jnp.inf,
+                                        g["scores"])
             else:
-                top_s, top_d = cand_s[0], cand_d[0]
-            # ---- reduce over ICI: counts psum + all_gather re-top-k -----
-            totals = jax.lax.psum(counts, "shard")          # [B_local]
-            all_s = jax.lax.all_gather(top_s, "shard")      # [S, B, k]
-            all_d = jax.lax.all_gather(top_d, "shard")
-            s_ax = all_s.shape[0]
-            flat_s = jnp.moveaxis(all_s, 0, 1).reshape(-1, s_ax * k)
-            flat_d = jnp.moveaxis(all_d, 0, 1).reshape(-1, s_ax * k)
-            g_s, pos = jax.lax.top_k(
-                jnp.where(flat_d >= 0, flat_s, -jnp.inf), k)
-            g_d = jnp.take_along_axis(flat_d, pos, axis=1)
-            g_d = jnp.where(g_s > -jnp.inf, g_d, -1)
-            g_s = jnp.where(g_s > -jnp.inf, g_s, -jnp.inf)
-            if not agg_fields:
-                return g_s, g_d, totals
+                g["scores"] = jnp.where(g["docs"] >= 0, g["scores"],
+                                        -jnp.inf)
+            out = {"docs": g["docs"], "scores": g["scores"],
+                   "shard_counts": shard_counts,
+                   "totals": shard_counts.sum(axis=(0, 1))}
+            if sort_mode:
+                out["skeys"] = tuple(
+                    (g[f"khi{i}"], g[f"klo{i}"])
+                    for i in range(len(sort_specs)))
 
-            # metric partials reduce over the shard axis in-program:
-            # psum for sums/count; (hi, lo) extrema pairs reduce
-            # lexicographically over an all_gather (pmin on hi alone
-            # would detach the lo residual from its hi)
-            def pair_reduce(hi_v, lo_v, is_min: bool):
-                ah = jax.lax.all_gather(hi_v, "shard")     # [S, B]
-                al = jax.lax.all_gather(lo_v, "shard")
-                rh, rl = ah[0], al[0]
-                for s in range(1, ah.shape[0]):
-                    bh, bl = ah[s], al[s]
-                    if is_min:
-                        pick = (bh < rh) | ((bh == rh) & (bl < rl))
-                    else:
-                        pick = (bh > rh) | ((bh == rh) & (bl > rl))
-                    rh = jnp.where(pick, bh, rh)
-                    rl = jnp.where(pick, bl, rl)
-                return rh, rl
+            if agg_fields:
+                # metric partials reduce over the shard axis in-program:
+                # psum for sums/count; (hi, lo) extrema pairs reduce
+                # lexicographically over an all_gather (pmin on hi alone
+                # would detach the lo residual from its hi)
+                def pair_reduce(hi_v, lo_v, is_min: bool):
+                    ah = jax.lax.all_gather(hi_v, "shard")     # [S, B]
+                    al = jax.lax.all_gather(lo_v, "shard")
+                    rh, rl = ah[0], al[0]
+                    for s in range(1, ah.shape[0]):
+                        bh, bl = ah[s], al[s]
+                        if is_min:
+                            pick = (bh < rh) | ((bh == rh) & (bl < rl))
+                        else:
+                            pick = (bh > rh) | ((bh == rh) & (bl > rl))
+                        rh = jnp.where(pick, bh, rh)
+                        rl = jnp.where(pick, bl, rl)
+                    return rh, rl
 
-            agg_out = []
-            for f in agg_fields:
-                a0 = acc[f]
-                if a0 is None:                   # field absent everywhere
-                    a0 = [jnp.zeros(b_local, jnp.float32),
-                          jnp.zeros(b_local, jnp.float32),
-                          jnp.zeros(b_local, jnp.int32),
-                          jnp.full(b_local, jnp.inf, jnp.float32),
-                          jnp.full(b_local, jnp.inf, jnp.float32),
-                          jnp.full(b_local, -jnp.inf, jnp.float32),
-                          jnp.full(b_local, -jnp.inf, jnp.float32)]
-                mn_hi, mn_lo = pair_reduce(a0[3], a0[4], True)
-                mx_hi, mx_lo = pair_reduce(a0[5], a0[6], False)
-                agg_out.append((
-                    jax.lax.psum(a0[0], "shard"),
-                    jax.lax.psum(a0[1], "shard"),
-                    jax.lax.psum(a0[2], "shard"),
-                    mn_hi, mn_lo, mx_hi, mx_lo))
-            return g_s, g_d, totals, tuple(agg_out)
+                agg_out = []
+                for f in agg_fields:
+                    a0 = acc[f]
+                    if a0 is None:                   # field absent
+                        a0 = [jnp.zeros(b_local, jnp.float32),
+                              jnp.zeros(b_local, jnp.float32),
+                              jnp.zeros(b_local, jnp.int32),
+                              jnp.full(b_local, jnp.inf, jnp.float32),
+                              jnp.full(b_local, jnp.inf, jnp.float32),
+                              jnp.full(b_local, -jnp.inf, jnp.float32),
+                              jnp.full(b_local, -jnp.inf, jnp.float32)]
+                    mn_hi, mn_lo = pair_reduce(a0[3], a0[4], True)
+                    mx_hi, mx_lo = pair_reduce(a0[5], a0[6], False)
+                    agg_out.append((
+                        jax.lax.psum(a0[0], "shard"),
+                        jax.lax.psum(a0[1], "shard"),
+                        jax.lax.psum(a0[2], "shard"),
+                        mn_hi, mn_lo, mx_hi, mx_lo))
+                out["metrics"] = tuple(agg_out)
+            if bucket_specs:
+                terms_out = {}
+                for lane in terms_lanes:
+                    _, name, f = lane
+                    terms_out[name] = tuple(
+                        jax.lax.all_gather(
+                            jnp.stack(terms_acc[(name, j)]), "shard")
+                        for j in range(n_slots))  # [s_mesh, spd, B, V_j]
+                histo_out = {}
+                for lane in histo_lanes:
+                    _, name, f, interval, base, nb = lane
+                    h = histo_acc[name]
+                    if h is None:
+                        h = jnp.zeros((b_local, max(nb, 1)), jnp.int32)
+                    histo_out[name] = jax.lax.psum(h, "shard")
+                if terms_out:
+                    out["terms"] = terms_out
+                if histo_out:
+                    out["histo"] = histo_out
+            return out
 
         flat_specs = [[P("shard")] * len(self._flats[j])
                       for j in range(n_slots)]
         const_specs = [jax.tree.map(lambda _: P("shard", "dp"),
                                     consts_tree[j])
                        for j in range(n_slots)]
-        out_specs = (P("dp"), P("dp"), P("dp"))
+        cursor_spec = P("shard", "dp")
+        # out specs mirror step_local's output pytree
+        out_specs = {"docs": P("dp"), "scores": P("dp"),
+                     "shard_counts": P(None, None, "dp"),
+                     "totals": P("dp")}
+        if sort_specs is not None:
+            out_specs["skeys"] = tuple((P("dp"), P("dp"))
+                                       for _ in sort_specs)
         if agg_fields:
-            out_specs = out_specs + (
-                tuple((P("dp"),) * 7 for _ in agg_fields),)
+            out_specs["metrics"] = tuple(
+                (P("dp"),) * 7 for _ in agg_fields)
+        if bucket_specs:
+            t_named = {b[1]: tuple(P(None, None, "dp", None)
+                                   for _ in range(n_slots))
+                       for b in terms_lanes}
+            h_named = {b[1]: P("dp", None) for b in histo_lanes}
+            if t_named:
+                out_specs["terms"] = t_named
+            if h_named:
+                out_specs["histo"] = h_named
         mapped = shard_map(
             step_local, mesh=self.mesh,
-            in_specs=(flat_specs, const_specs),
+            in_specs=(flat_specs, const_specs, cursor_spec),
             out_specs=out_specs,
             check_vma=False)
         fn = jax.jit(mapped)
         self._programs[key] = fn
         return fn
 
-    def search_batch(self, bodies: list[dict], ):
-        """Execute B query-DSL request bodies (score-ordered top-k shapes)
-        as one mesh program → list of {"total", "scores", "doc_ids"} with
-        GLOBAL doc ids (resolve via :meth:`resolve`)."""
+    def search_batch(self, bodies: list[dict]):
+        """Execute B query-DSL request bodies as one mesh program →
+        list of {"total", "shard_totals", "scores", "doc_ids"
+        [, "sort_values"] [, "aggregations"]} with GLOBAL doc ids
+        (resolve via :meth:`resolve`)."""
         if not bodies:
             return []
         reqs = [parse_search_request(b) for b in bodies]
         for req in reqs:
-            if (req.sort or req.post_filter is not None
-                    or req.min_score is not None
-                    or req.search_after is not None or req.suggest
-                    or req.terminate_after is not None
+            if (req.suggest or req.terminate_after is not None
                     or req.timeout_ms is not None or req.rescore):
                 raise QueryParsingError(
-                    "mesh engine plane supports score-ordered top-k "
-                    "requests — route others to the RPC path")
-        agg_spec = _mesh_agg_spec(reqs)
+                    "mesh engine plane does not run suggest/"
+                    "terminate_after/timeout/rescore — route to the RPC "
+                    "path")
+        from elasticsearch_tpu.search.phase import _is_score_order
+        score_order = [_is_score_order(req.sort) for req in reqs]
+        if any(s != score_order[0] for s in score_order):
+            raise QueryParsingError(
+                "mesh engine plane requires one sort mode per batch")
+        sort_specs = None
+        if not score_order[0]:
+            sort_specs = _mesh_sort_spec(reqs, self._layouts)
+        has_ms = [req.min_score is not None for req in reqs]
+        if any(m != has_ms[0] for m in has_ms):
+            raise QueryParsingError(
+                "mesh engine plane requires uniform min_score presence")
+        has_sa = [req.search_after is not None for req in reqs]
+        if any(s != has_sa[0] for s in has_sa):
+            raise QueryParsingError(
+                "mesh engine plane requires uniform search_after presence")
+        has_cursor = has_sa[0]
+        if has_cursor:
+            if sort_specs is None:
+                raise QueryParsingError(
+                    "score-ordered search_after cursors are doc-id-"
+                    "relative — use the RPC fan-out path")
+            for req in reqs:
+                sa = req.search_after
+                if len(sa) != len(sort_specs) or \
+                        any(v is None or isinstance(v, str) for v in sa):
+                    raise QueryParsingError(
+                        "mesh engine plane needs a full numeric "
+                        "search_after cursor — use the RPC fan-out path")
+        agg_spec, bucket_specs = _mesh_agg_plan(reqs, self._layouts,
+                                                self._field_extrema)
+        if bucket_specs:
+            for b in bucket_specs:
+                if b[0] == "terms":
+                    cells = sum(lay.kw_vocab.get(b[2], 1)
+                                for lay in self._layouts) * \
+                        len(reqs) * self.n_shards
+                    if cells > _MAX_TERMS_CELLS:
+                        raise QueryParsingError(
+                            "terms agg vocab too large for the mesh "
+                            "gather budget — use the RPC fan-out path")
         import os
         import time
         debug = os.environ.get("MESH_DEBUG")
@@ -483,17 +925,22 @@ class MeshEngineSearcher:
         dp = self.mesh.shape["dp"]
         b_real = len(queries)
         b_pad = -(-b_real // dp) * dp
-        queries_p = queries + [queries[-1]] * (b_pad - b_real)
+        reqs_p = reqs + [reqs[-1]] * (b_pad - b_real)
+
+        want_arrays = bool(agg_spec or bucket_specs) or \
+            sort_specs is not None
+        base_flags = dict(_FLAGS, want_topk=sort_specs is None,
+                          want_arrays=want_arrays, min_score=has_ms[0])
 
         # resolve every (shard, slot, query): consts [S, B, ...]; signature
         # must agree across shards AND queries per slot (uniform field
         # layout makes shard structure uniform; mixed query structures are
         # rejected like run_segment_batch's None)
-        sigs, layouts, emits, refss = [], [], [], []
+        sigs, layouts, emits, pfs, refss = [], [], [], [], []
         consts_dev = []
         q_sharding = NamedSharding(self.mesh, P("shard", "dp"))
         for j in range(self.n_slots):
-            sig_j = emit_j = refs_j = None
+            sig_j = emit_j = pf_j = refs_j = None
             rows = []                      # [S][B] → list of const arrays
             for si in range(self.n_shards):
                 ctx = ExecutionContext(
@@ -503,11 +950,16 @@ class MeshEngineSearcher:
                     bm25=self._bm25,
                     dfs_stats=dfs_stats)
                 row = []
-                for query in queries_p:
-                    ct, emit_q, _, refs = _plan(
-                        self._templates[si][j], ctx, query, None, _FLAGS)
+                for req in reqs_p:
+                    flags_q = dict(base_flags,
+                                   _min_score=float(req.min_score)
+                                   if req.min_score is not None else 0.0)
+                    ct, emit_q, emit_pf, refs = _plan(
+                        self._templates[si][j], ctx, req.query,
+                        req.post_filter, flags_q)
                     if sig_j is None:
-                        sig_j, emit_j, refs_j = ct.signature(), emit_q, refs
+                        sig_j, emit_j, pf_j, refs_j = \
+                            ct.signature(), emit_q, emit_pf, refs
                     elif ct.signature() != sig_j:
                         raise QueryParsingError(
                             "mesh engine plane requires one plan signature "
@@ -525,21 +977,41 @@ class MeshEngineSearcher:
             sigs.append(sig_j)
             layouts.append(layout_key(self._templates[0][j]))
             emits.append(emit_j)
+            pfs.append(pf_j)
             refss.append(refs_j)
             consts_dev.append(stacked)
 
+        # search_after cursor operand: transformed (hi, lo) per spec —
+        # the same key space the program sorts in
+        n_spec = len(sort_specs) if sort_specs else 0
+        cur_np = np.zeros((self.n_shards, b_pad, max(2 * n_spec, 1)),
+                          np.float32)
+        if has_cursor:
+            for bi, req in enumerate(reqs_p):
+                for i, sp in enumerate(sort_specs):
+                    chi, clo = _dd_fill(float(req.search_after[i]))
+                    if sp.order == "desc":
+                        chi, clo = -chi, -clo
+                    cur_np[:, bi, 2 * i] = float(chi)
+                    cur_np[:, bi, 2 * i + 1] = float(clo)
+        cursors = jax.device_put(cur_np, q_sharding)
+
         t1 = time.perf_counter()
         fn = self._program(sigs, layouts, k, b_pad, consts_dev,
-                           emits, refss,
+                           emits, pfs, refss,
                            [self._templates[0][j]
                             for j in range(self.n_slots)],
-                           agg_spec=agg_spec)
-        outs = fn(self._flats, consts_dev)
-        g_s, g_d, totals = outs[0], outs[1], outs[2]
-        agg_arrays = outs[3] if agg_spec else None
+                           agg_spec=agg_spec, bucket_specs=bucket_specs,
+                           sort_specs=sort_specs, has_cursor=has_cursor)
+        outs = fn(self._flats, consts_dev, cursors)
         t2 = time.perf_counter()
-        g_s, g_d = np.asarray(g_s), np.asarray(g_d)
-        totals = np.asarray(totals)
+        g_s = np.asarray(outs["scores"])
+        g_d = np.asarray(outs["docs"])
+        totals = np.asarray(outs["totals"])
+        shard_counts = np.asarray(outs["shard_counts"]).reshape(
+            self.n_shards, b_pad)
+        skeys = [(np.asarray(h), np.asarray(l))
+                 for h, l in outs["skeys"]] if sort_specs else None
         if debug:
             print(f"[mesh-debug] dfs {t_dfs*1e3:.0f}ms "
                   f"plan+stack {(t1-t0-t_dfs)*1e3:.0f}ms "
@@ -549,19 +1021,102 @@ class MeshEngineSearcher:
         agg_np = None
         if agg_spec:
             fields = sorted({f for _, _, f in agg_spec})
-            agg_np = {f: [np.asarray(a) for a in agg_arrays[i]]
+            agg_np = {f: [np.asarray(a) for a in outs["metrics"][i]]
                       for i, f in enumerate(fields)}
+        terms_np = {name: [np.asarray(a).reshape(
+            (self.n_shards, b_pad) + a.shape[3:])
+            for a in arrs]
+            for name, arrs in outs.get("terms", {}).items()} \
+            if bucket_specs else {}
+        histo_np = {name: np.asarray(a)
+                    for name, a in outs.get("histo", {}).items()} \
+            if bucket_specs else {}
         out = []
         for bi, req in enumerate(reqs):
             kq = max(req.from_ + req.size, 1)
             valid = g_d[bi] >= 0
             res = {"total": int(totals[bi]),
+                   "shard_totals": shard_counts[:, bi].astype(np.int64),
                    "scores": g_s[bi][valid][:kq],
                    "doc_ids": g_d[bi][valid][:kq]}
+            if sort_specs:
+                res["sort_values"] = self._render_sort_values(
+                    sort_specs, skeys, bi, int(valid.sum()), kq)
+            aggs: dict = {}
             if agg_spec:
-                res["aggregations"] = self._render_aggs(agg_spec, agg_np,
-                                                        bi)
+                aggs.update(self._render_aggs(agg_spec, agg_np, bi))
+            if bucket_specs:
+                aggs.update(self._render_buckets(
+                    req, bucket_specs, terms_np, histo_np, bi))
+            if aggs:
+                res["aggregations"] = aggs
             out.append(res)
+        return out
+
+    @staticmethod
+    def _render_sort_values(sort_specs, skeys, bi: int, n_valid: int,
+                            kq: int) -> list:
+        """Transformed (hi, lo) keys → per-hit hit["sort"] values: f64
+        recombine, un-negate desc (FP negation is exact), inf → None
+        (phase._sort_value_out semantics)."""
+        from elasticsearch_tpu.search.phase import _sort_value_out
+        rows = []
+        for pos in range(min(n_valid, kq)):
+            vals = []
+            for i, sp in enumerate(sort_specs):
+                hi_a, lo_a = skeys[i]
+                raw = np.float64(hi_a[bi][pos]) + np.float64(lo_a[bi][pos])
+                if sp.order == "desc":
+                    raw = -raw
+                vals.append(_sort_value_out(raw))
+            rows.append(vals)
+        return rows
+
+    def _render_buckets(self, req, bucket_specs, terms_np, histo_np,
+                        bi: int) -> dict:
+        """Gathered bucket lanes → final agg responses through the SAME
+        coordinator reduce the RPC path uses (reduce_aggs), fed per-shard
+        partial dicts in the device-collect wire shapes."""
+        from elasticsearch_tpu.search.aggregations import reduce_aggs
+        nodes = {n.name: n for n in req.aggs}
+        out: dict = {}
+        for lane in bucket_specs:
+            if lane[0] == "terms":
+                _, name, f = lane
+                arrs = terms_np[name]      # per slot: [S, B, V_j]
+                parts = []
+                for si in range(self.n_shards):
+                    merged: dict[str, int] = {}
+                    for j in range(self.n_slots):
+                        counts = arrs[j][si, bi]
+                        segs = self._views[si].segments
+                        col = segs[j].keyword_fields.get(f) \
+                            if j < len(segs) else None
+                        if col is None:
+                            continue
+                        vocab = col.vocab
+                        for oid in np.nonzero(counts)[0]:
+                            if int(oid) >= len(vocab):
+                                continue
+                            key_t = vocab[int(oid)]
+                            merged[key_t] = merged.get(key_t, 0) + \
+                                int(counts[oid])
+                    parts.append({name: {
+                        "buckets": [[k_, {"doc_count": n_}]
+                                    for k_, n_ in merged.items()],
+                        "doc_count_error_upper_bound": 0}})
+                out.update(reduce_aggs([nodes[name]], parts))
+            else:
+                _, name, f, interval, base, nb = lane
+                counts = histo_np[name][bi] if nb else np.zeros(0)
+                pairs = [[float(base + i * interval),
+                          {"doc_count": int(c)}]
+                         for i, c in enumerate(counts[:nb]) if c > 0]
+                node = nodes[name]
+                partial = {"buckets": pairs, "interval": interval,
+                           "min_doc_count": int(node.params.get(
+                               "min_doc_count", 0))}
+                out.update(reduce_aggs([node], [{name: partial}]))
         return out
 
     @staticmethod

@@ -11,9 +11,13 @@ in a zero-egress, pure-Python environment:
   emulation behind the same repository contract) and the
   discovery-ec2/gce/azure settings surfaces
 
-Script-language plugins (lang-groovy/javascript/python/expression) need no
-separate providers here: every script surface routes through the one
-restricted-AST expression engine (search/scripts.py), which accepts the
-`doc['f'].value`-style subset those languages share; `lang` tags are
-carried verbatim by the stored-scripts APIs.
+* lang_python — sandboxed Python ScriptEngineService (lang-python/Jython
+  analog, AST-whitelisted)
+* lang_javascript — sandboxed JavaScript-subset ScriptEngineService
+  (lang-javascript/Rhino analog, GroovyLite-style budgeted interpreter)
+* morph_ja / morph_zh — morphological CJK analysis (kuromoji/smartcn)
+
+lang-groovy and the vectorized expression engine are built in
+(search/scriptlang.py, search/scripts.py); `lang` tags route through the
+script_engines registry.
 """

@@ -1,0 +1,1034 @@
+"""lang-javascript — a sandboxed JavaScript-subset script engine.
+
+The reference ships plugins/lang-javascript (Rhino behind
+``JavaScriptScriptEngineService``). This is its analog in the GroovyLite
+mold (search/scriptlang.py): tokenizer → AST → budgeted tree-walking
+interpreter, sandboxed by construction — the parser only builds nodes the
+interpreter knows, names resolve against script scopes and caller
+bindings only, property/method access dispatches through closed per-type
+tables, and every interpreter step debits an op budget so runaway loops
+raise instead of hanging a shard thread.
+
+Surface syntax (the ES-docs/test-suite JavaScript subset):
+
+    var total = 0;
+    for (var i = 0; i < doc['vals'].values.length; i++) {
+        total += doc['vals'].values[i];
+    }
+    if (total > params.limit) { total = params.limit; }
+    total;
+
+Supported: var/let/const (all function-scoped here), function
+declarations with closures, if/else, for(;;), for..in (object keys /
+array indices), for..of, while, do..while, break/continue/return,
+ternary, && || !, == != === !==, typeof, delete obj.prop, arithmetic
+(+ - * / % with JS true division), string concat, arrays, object
+literals, Math.*, JSON.stringify/parse, and the closed Array/String
+method tables below. The script's value is an explicit ``return`` or the
+last expression statement (Rhino's eval convention).
+
+Documented deviations from full ECMAScript (same spirit as GroovyLite's
+Groovy subset): no prototypes / `this` / arrow functions / regex /
+try-catch; integer-valued arithmetic stays integral (1+2 is 3, 1/2 is
+0.5 — only division always follows JS); `==` equals `===` except that
+int/float compare numerically; `undefined` and `null` both map to the
+host null.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import math
+import re
+
+from elasticsearch_tpu.plugins import Plugin
+from elasticsearch_tpu.search.scriptlang import ScriptException
+
+DEFAULT_OP_BUDGET = 500_000
+
+# ---- tokenizer -------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+|//[^\n]*|/\*.*?\*/)
+  | (?P<num>\d+\.\d+(?:[eE][+-]?\d+)?|\.\d+|\d+(?:[eE][+-]?\d+)?)
+  | (?P<str>'(?:[^'\\]|\\.)*'|"(?:[^"\\]|\\.)*")
+  | (?P<name>[A-Za-z_$][A-Za-z0-9_$]*)
+  | (?P<op>===|!==|==|!=|<=|>=|&&|\|\||\+\+|--|\+=|-=|\*=|/=|%=
+        |[-+*/%<>=!?:.,;(){}\[\]])
+""", re.VERBOSE | re.DOTALL)
+
+_KEYWORDS = {"var", "let", "const", "function", "if", "else", "for",
+             "while", "do", "in", "of", "return", "break", "continue",
+             "true", "false", "null", "undefined", "typeof", "delete",
+             "new"}
+
+
+def _tokenize(src: str) -> list[tuple[str, str]]:
+    out, pos = [], 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            raise ScriptException(
+                f"[lang-javascript] unexpected character {src[pos]!r} "
+                f"at {pos}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        text = m.group()
+        if kind == "name" and text in _KEYWORDS:
+            kind = text
+        out.append((kind, text))
+    out.append(("eof", ""))
+    return out
+
+
+def _unquote(s: str) -> str:
+    body = s[1:-1]
+    if "\\" not in body:
+        return body
+    # backslashreplace keeps non-Latin-1 text intact through the
+    # unicode_escape round trip (a bare .encode() would mojibake any
+    # literal mixing non-ASCII characters with an escape sequence)
+    return body.encode("latin-1", "backslashreplace") \
+        .decode("unicode_escape")
+
+
+# ---- parser ----------------------------------------------------------------
+
+_BIN_PREC = {
+    "||": 1, "&&": 2,
+    "==": 3, "!=": 3, "===": 3, "!==": 3,
+    "<": 4, "<=": 4, ">": 4, ">=": 4, "in": 4,
+    "+": 5, "-": 5,
+    "*": 6, "/": 6, "%": 6,
+}
+
+_ASSIGN_OPS = {"=", "+=", "-=", "*=", "/=", "%="}
+
+
+class _Parser:
+    def __init__(self, tokens):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self, k: int = 0):
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self):
+        t = self.toks[self.i]
+        if t[0] != "eof":
+            self.i += 1
+        return t
+
+    def accept(self, text: str) -> bool:
+        k, v = self.peek()
+        if v == text and (k == "op" or k == text):
+            self.next()
+            return True
+        return False
+
+    def expect(self, text: str):
+        if not self.accept(text):
+            k, v = self.peek()
+            raise ScriptException(
+                f"[lang-javascript] expected {text!r}, got {v!r}")
+
+    def program(self):
+        stmts = []
+        while self.peek()[0] != "eof":
+            stmts.append(self.statement())
+        return ("block", stmts)
+
+    def block(self):
+        self.expect("{")
+        stmts = []
+        while not self.accept("}"):
+            if self.peek()[0] == "eof":
+                raise ScriptException("[lang-javascript] unclosed block")
+            stmts.append(self.statement())
+        return ("block", stmts)
+
+    def statement(self):   # noqa: C901 — one dispatch table, flat cases
+        k, v = self.peek()
+        if v == "{" and k == "op":
+            return self.block()
+        if k in ("var", "let", "const"):
+            self.next()
+            decls = []
+            while True:
+                name = self._name()
+                init = ("undef",)
+                if self.accept("="):
+                    init = self.assign_expr()
+                decls.append((name, init))
+                if not self.accept(","):
+                    break
+            self.accept(";")
+            return ("declare", decls)
+        if k == "function":
+            self.next()
+            name = self._name()
+            params = self._params()
+            body = self.block()
+            return ("funcdecl", name, params, body)
+        if k == "if":
+            self.next()
+            self.expect("(")
+            cond = self.expr()
+            self.expect(")")
+            then = self.statement()
+            other = self.statement() if self.accept("else") else None
+            return ("if", cond, then, other)
+        if k == "while":
+            self.next()
+            self.expect("(")
+            cond = self.expr()
+            self.expect(")")
+            return ("while", cond, self.statement())
+        if k == "do":
+            self.next()
+            body = self.statement()
+            self.expect("while")
+            self.expect("(")
+            cond = self.expr()
+            self.expect(")")
+            self.accept(";")
+            return ("dowhile", cond, body)
+        if k == "for":
+            return self._for()
+        if k == "return":
+            self.next()
+            if self.peek()[1] in (";", "}") or self.peek()[0] == "eof":
+                val = ("undef",)
+            else:
+                val = self.expr()
+            self.accept(";")
+            return ("return", val)
+        if k == "break":
+            self.next()
+            self.accept(";")
+            return ("break",)
+        if k == "continue":
+            self.next()
+            self.accept(";")
+            return ("continue",)
+        if self.accept(";"):
+            return ("block", [])
+        node = self.expr()
+        self.accept(";")
+        return ("exprstmt", node)
+
+    def _for(self):
+        self.next()
+        self.expect("(")
+        # for (var x in e) | for (x of e) | for (init; cond; step)
+        save = self.i
+        decl_kw = self.peek()[0] in ("var", "let", "const")
+        if decl_kw:
+            self.next()
+        if self.peek()[0] == "name" and self.peek(1)[0] in ("in", "of"):
+            name = self._name()
+            mode = self.next()[0]                 # "in" | "of"
+            seq = self.expr()
+            self.expect(")")
+            return ("forin" if mode == "in" else "forof",
+                    name, seq, self.statement())
+        self.i = save
+        init = None
+        if not self.accept(";"):
+            init = self.statement()               # consumes the ';'
+        cond = ("true",) if self.peek()[1] == ";" else self.expr()
+        self.expect(";")
+        step = None
+        if self.peek()[1] != ")":
+            step = ("exprstmt", self.expr())
+        self.expect(")")
+        return ("cfor", init, cond, step, self.statement())
+
+    def _name(self) -> str:
+        k, v = self.next()
+        if k != "name":
+            raise ScriptException(
+                f"[lang-javascript] expected a name, got {v!r}")
+        if v.startswith("__"):
+            # "__parent__" threads the closure chain through scope dicts;
+            # dunder names are reserved wholesale (the GroovyLite rule)
+            raise ScriptException(
+                f"[lang-javascript] reserved name [{v}]")
+        return v
+
+    def _params(self) -> list:
+        self.expect("(")
+        out = []
+        while not self.accept(")"):
+            if out:
+                self.expect(",")
+            out.append(self._name())
+        return out
+
+    # -- expressions -----------------------------------------------------
+
+    def expr(self):
+        return self.assign_expr()
+
+    def assign_expr(self):
+        left = self.ternary()
+        k, v = self.peek()
+        if k == "op" and v in _ASSIGN_OPS:
+            if left[0] not in ("name", "getattr", "getitem"):
+                raise ScriptException(
+                    "[lang-javascript] invalid assignment target")
+            self.next()
+            return ("assign", v, left, self.assign_expr())
+        return left
+
+    def ternary(self):
+        cond = self.binary(0)
+        if self.accept("?"):
+            a = self.assign_expr()
+            self.expect(":")
+            b = self.assign_expr()
+            return ("ternary", cond, a, b)
+        return cond
+
+    def binary(self, min_prec: int):
+        left = self.unary()
+        while True:
+            k, v = self.peek()
+            op = v if (k == "op" or k == "in") else None
+            prec = _BIN_PREC.get(op)
+            if prec is None or prec < min_prec:
+                return left
+            self.next()
+            right = self.binary(prec + 1)
+            left = ("binop", op, left, right)
+
+    def unary(self):
+        k, v = self.peek()
+        if v == "!" and k == "op":
+            self.next()
+            return ("not", self.unary())
+        if v == "-" and k == "op":
+            self.next()
+            return ("neg", self.unary())
+        if v == "+" and k == "op":
+            self.next()
+            return ("pos", self.unary())
+        if k == "typeof":
+            self.next()
+            return ("typeof", self.unary())
+        if k == "delete":
+            self.next()
+            target = self.unary()
+            if target[0] not in ("getattr", "getitem"):
+                raise ScriptException(
+                    "[lang-javascript] can only delete properties")
+            return ("delete", target)
+        if v == "++" or v == "--":
+            self.next()
+            target = self.unary()
+            return ("preincr", v, target)
+        return self.postfix()
+
+    def postfix(self):
+        node = self.atom()
+        while True:
+            k, v = self.peek()
+            if v == "." and k == "op":
+                self.next()
+                name = self._name()
+                if self.peek()[1] == "(":
+                    node = ("method", node, name, self._args())
+                else:
+                    node = ("getattr", node, name)
+            elif v == "[" and k == "op":
+                self.next()
+                key = self.expr()
+                self.expect("]")
+                node = ("getitem", node, key)
+            elif v == "(" and k == "op" and node[0] == "name":
+                node = ("call", node[1], self._args())
+            elif v in ("++", "--"):
+                self.next()
+                node = ("postincr", v, node)
+            else:
+                return node
+
+    def _args(self) -> list:
+        self.expect("(")
+        out = []
+        while not self.accept(")"):
+            if out:
+                self.expect(",")
+            out.append(self.assign_expr())
+        return out
+
+    def atom(self):   # noqa: C901 — flat literal dispatch
+        k, v = self.next()
+        if k == "num":
+            return ("num", float(v) if ("." in v or "e" in v or "E" in v)
+                    else int(v))
+        if k == "str":
+            return ("str", _unquote(v))
+        if k in ("true", "false", "null"):
+            return (k,)
+        if k == "undefined":
+            return ("undef",)
+        if k == "name":
+            return ("name", v)
+        if k == "new":
+            # new Array() / new Object() — Rhino-era idioms
+            name = self._name()
+            args = self._args() if self.peek()[1] == "(" else []
+            return ("new", name, args)
+        if v == "(":
+            node = self.expr()
+            self.expect(")")
+            return node
+        if v == "[":
+            items = []
+            while not self.accept("]"):
+                if items:
+                    self.expect(",")
+                items.append(self.assign_expr())
+            return ("array", items)
+        if v == "{":
+            pairs = []
+            while not self.accept("}"):
+                if pairs:
+                    self.expect(",")
+                kk, kv = self.next()
+                if kk not in ("name", "str", "num") and \
+                        kk not in _KEYWORDS:
+                    raise ScriptException(
+                        f"[lang-javascript] bad object key {kv!r}")
+                key = _unquote(kv) if kk == "str" else kv
+                self.expect(":")
+                pairs.append((key, self.assign_expr()))
+            return ("object", pairs)
+        raise ScriptException(f"[lang-javascript] unexpected {v!r}")
+
+
+# ---- interpreter -----------------------------------------------------------
+
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
+    pass
+
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+class _Function:
+    __slots__ = ("params", "body", "closure")
+
+    def __init__(self, params, body, closure):
+        self.params = params
+        self.body = body
+        self.closure = closure
+
+
+def _js_slice(xs, *args):
+    start = int(args[0]) if args else 0
+    end = int(args[1]) if len(args) > 1 else len(xs)
+    return xs[start:end]
+
+
+def _js_splice(xs, start, count=None, *items):
+    start = int(start)
+    count = len(xs) - start if count is None else int(count)
+    removed = xs[start:start + count]
+    xs[start:start + count] = list(items)
+    return removed
+
+
+_ARRAY_METHODS = {
+    "push": lambda xs, *a: (xs.extend(a), len(xs))[1],
+    "pop": lambda xs: xs.pop() if xs else None,
+    "shift": lambda xs: xs.pop(0) if xs else None,
+    "unshift": lambda xs, *a: (xs.__setitem__(slice(0, 0), list(a)),
+                               len(xs))[1],
+    "indexOf": lambda xs, v: xs.index(v) if v in xs else -1,
+    "includes": lambda xs, v: v in xs,
+    "join": lambda xs, sep=",": sep.join(_to_str(x) for x in xs),
+    "slice": _js_slice,
+    "splice": _js_splice,
+    "concat": lambda xs, *a: xs + [y for b in a for y in
+                                   (b if isinstance(b, list) else [b])],
+    "reverse": lambda xs: (xs.reverse(), xs)[1],
+    "sort": lambda xs: (xs.sort(key=_sort_key), xs)[1],
+}
+
+_STRING_METHODS = {
+    "indexOf": lambda s, v: s.find(_to_str(v)),
+    "includes": lambda s, v: _to_str(v) in s,
+    "charAt": lambda s, i: s[int(i)] if 0 <= int(i) < len(s) else "",
+    "substring": lambda s, a, b=None: s[int(a):
+                                        (int(b) if b is not None
+                                         else len(s))],
+    "slice": _js_slice,
+    "split": lambda s, sep=None: s.split(sep) if sep else list(s),
+    "toLowerCase": lambda s: s.lower(),
+    "toUpperCase": lambda s: s.upper(),
+    "trim": lambda s: s.strip(),
+    "replace": lambda s, a, b: s.replace(_to_str(a), _to_str(b), 1),
+    "startsWith": lambda s, p: s.startswith(_to_str(p)),
+    "endsWith": lambda s, p: s.endswith(_to_str(p)),
+    "concat": lambda s, *a: s + "".join(_to_str(x) for x in a),
+}
+
+def _js_round(x):
+    # JS Math.round rounds half toward +Infinity (Math.round(0.5) is 1,
+    # Math.round(-2.5) is -2) — not Python's banker's rounding
+    return math.floor(x + 0.5)
+
+
+_MATH = {
+    "abs": abs, "max": max, "min": min, "sqrt": math.sqrt,
+    "floor": math.floor, "ceil": math.ceil, "round": _js_round,
+    "log": math.log, "exp": math.exp, "pow": pow,
+    "PI": math.pi, "E": math.e,
+}
+
+_JSON = {
+    "stringify": lambda v: _json.dumps(v),
+    "parse": lambda s: _json.loads(s),
+}
+
+_NEWABLE = {"Array": list, "Object": dict}
+
+
+def _sort_key(v):
+    # JS default sort is lexicographic over string forms
+    return _to_str(v)
+
+
+def _to_str(v) -> str:
+    if v is None:
+        return "null"
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    if isinstance(v, list):
+        return ",".join(_to_str(x) for x in v)
+    return str(v)
+
+
+def _truthy(v) -> bool:
+    # JS truth: null/undefined/false/0/NaN/"" are falsy; [] and {} are
+    # TRUTHY (unlike Groovy)
+    if v is None or v is False:
+        return False
+    if isinstance(v, str):
+        return len(v) > 0
+    if isinstance(v, (int, float)):
+        return v != 0 and v == v
+    return True
+
+
+def _js_eq(a, b) -> bool:
+    if isinstance(a, bool) != isinstance(b, bool) and \
+            (isinstance(a, bool) or isinstance(b, bool)):
+        return False
+    return a == b
+
+
+def _binop(op: str, a, b):   # noqa: C901 — operator table
+    if op == "+":
+        if isinstance(a, str) or isinstance(b, str):
+            return _to_str(a) + _to_str(b)
+        if isinstance(a, list) or isinstance(b, list):
+            return _to_str(a) + _to_str(b)
+        return a + b
+    if op == "-":
+        return a - b
+    if op == "*":
+        return a * b
+    if op == "/":
+        return a / b                       # JS true division
+    if op == "%":
+        return math.fmod(a, b) if isinstance(a, float) or \
+            isinstance(b, float) else _int_rem(a, b)
+    if op in ("==", "==="):
+        return _js_eq(a, b)
+    if op in ("!=", "!=="):
+        return not _js_eq(a, b)
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    if op == ">=":
+        return a >= b
+    if op == "in":
+        if isinstance(b, dict):
+            return _to_str(a) in b or a in b
+        if isinstance(b, list):
+            return 0 <= int(a) < len(b)
+        raise ScriptException("[lang-javascript] 'in' needs an object")
+    raise ScriptException(f"[lang-javascript] unknown operator {op}")
+
+
+def _int_rem(a, b):
+    # JS % truncates toward zero (Python's % floors)
+    return int(math.fmod(a, b))
+
+
+class CompiledJavaScript:
+    def __init__(self, source: str):
+        self.source = source
+        try:
+            self.tree = _Parser(_tokenize(source)).program()
+        except ScriptException:
+            raise
+        except Exception as e:     # noqa: BLE001 — uniform compile error
+            raise ScriptException(
+                f"[lang-javascript] compile error: {e}") from e
+
+    def run(self, bindings: dict, op_budget: int = DEFAULT_OP_BUDGET):
+        interp = _Interp(bindings, op_budget)
+        try:
+            return interp.exec_block(self.tree, {})
+        except _Return as r:
+            return r.value
+        except ScriptException:
+            raise
+        except (_Break, _Continue):
+            raise ScriptException(
+                "[lang-javascript] break/continue outside loop") from None
+        except ZeroDivisionError:
+            # JS yields Infinity; a search hit carrying Infinity breaks
+            # JSON rendering the same way — surface it as a script error
+            raise ScriptException(
+                "[lang-javascript] division by zero") from None
+        except (TypeError, ValueError, KeyError, IndexError,
+                AttributeError) as e:
+            raise ScriptException(
+                f"[lang-javascript] runtime error: {e}") from e
+
+
+_MAX_CALL_DEPTH = 100
+
+
+class _Interp:
+    def __init__(self, bindings: dict, op_budget: int):
+        self.bindings = bindings
+        self.budget = op_budget
+        self.depth = 0
+
+    def _tick(self):
+        self.budget -= 1
+        if self.budget <= 0:
+            raise ScriptException(
+                "[lang-javascript] script exceeded its operation budget")
+
+    # -- statements ------------------------------------------------------
+
+    def exec_block(self, node, scope):
+        last = None
+        for stmt in node[1]:
+            last = self.exec_stmt(stmt, scope)
+        return last
+
+    def exec_stmt(self, node, scope):   # noqa: C901 — flat dispatch
+        self._tick()
+        kind = node[0]
+        if kind == "block":
+            # var is function-scoped in JS: blocks share the scope
+            return self.exec_block(node, scope)
+        if kind == "declare":
+            for name, init in node[1]:
+                scope[name] = self.eval(init, scope)
+            return None
+        if kind == "funcdecl":
+            scope[node[1]] = _Function(node[2], node[3], scope)
+            return None
+        if kind == "exprstmt":
+            return self.eval(node[1], scope)
+        if kind == "if":
+            if _truthy(self.eval(node[1], scope)):
+                return self.exec_stmt(node[2], scope)
+            if node[3] is not None:
+                return self.exec_stmt(node[3], scope)
+            return None
+        if kind == "while":
+            while _truthy(self.eval(node[1], scope)):
+                self._tick()
+                try:
+                    self.exec_stmt(node[2], scope)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+            return None
+        if kind == "dowhile":
+            while True:
+                self._tick()
+                try:
+                    self.exec_stmt(node[2], scope)
+                except _Break:
+                    break
+                except _Continue:
+                    pass
+                if not _truthy(self.eval(node[1], scope)):
+                    break
+            return None
+        if kind in ("forin", "forof"):
+            seq = self.eval(node[2], scope)
+            if isinstance(seq, dict):
+                items = list(seq.keys()) if kind == "forin" \
+                    else list(seq.values())
+            elif isinstance(seq, list):
+                items = list(range(len(seq))) if kind == "forin" \
+                    else list(seq)
+            elif isinstance(seq, str):
+                items = list(range(len(seq))) if kind == "forin" \
+                    else list(seq)
+            elif seq is None:
+                items = []
+            else:
+                raise ScriptException(
+                    "[lang-javascript] for..in/of needs an object, "
+                    "array or string")
+            for item in items:
+                self._tick()
+                scope[node[1]] = item
+                try:
+                    self.exec_stmt(node[3], scope)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+            return None
+        if kind == "cfor":
+            if node[1] is not None:
+                self.exec_stmt(node[1], scope)
+            while _truthy(self.eval(node[2], scope)):
+                self._tick()
+                try:
+                    self.exec_stmt(node[4], scope)
+                except _Break:
+                    break
+                except _Continue:
+                    pass
+                if node[3] is not None:
+                    self.exec_stmt(node[3], scope)
+            return None
+        if kind == "return":
+            raise _Return(self.eval(node[1], scope))
+        if kind == "break":
+            raise _Break()
+        if kind == "continue":
+            raise _Continue()
+        raise ScriptException(f"[lang-javascript] unknown stmt {kind}")
+
+    # -- expressions -----------------------------------------------------
+
+    def eval(self, node, scope):   # noqa: C901 — flat dispatch
+        self._tick()
+        kind = node[0]
+        if kind in ("num", "str"):
+            return node[1]
+        if kind == "true":
+            return True
+        if kind == "false":
+            return False
+        if kind in ("null", "undef"):
+            return None
+        if kind == "name":
+            return self._lookup(node[1], scope)
+        if kind == "binop":
+            op = node[1]
+            if op == "&&":
+                a = self.eval(node[2], scope)
+                return self.eval(node[3], scope) if _truthy(a) else a
+            if op == "||":
+                a = self.eval(node[2], scope)
+                return a if _truthy(a) else self.eval(node[3], scope)
+            return _binop(op, self.eval(node[2], scope),
+                          self.eval(node[3], scope))
+        if kind == "not":
+            return not _truthy(self.eval(node[1], scope))
+        if kind == "neg":
+            return -self.eval(node[1], scope)
+        if kind == "pos":
+            v = self.eval(node[1], scope)
+            return float(v) if isinstance(v, str) else v
+        if kind == "typeof":
+            try:
+                v = self.eval(node[1], scope)
+            except ScriptException:
+                return "undefined"
+            if v is None:
+                return "undefined"
+            if isinstance(v, bool):
+                return "boolean"
+            if isinstance(v, (int, float)):
+                return "number"
+            if isinstance(v, str):
+                return "string"
+            if isinstance(v, _Function):
+                return "function"
+            return "object"
+        if kind == "delete":
+            t = node[1]
+            obj = self.eval(t[1], scope)
+            key = t[2] if t[0] == "getattr" else self.eval(t[2], scope)
+            if isinstance(obj, dict):
+                obj.pop(key, None)
+                return True
+            if isinstance(obj, list) and t[0] == "getitem":
+                i = int(key)
+                if 0 <= i < len(obj):
+                    obj[i] = None
+                return True
+            return False
+        if kind == "ternary":
+            return self.eval(node[2], scope) \
+                if _truthy(self.eval(node[1], scope)) \
+                else self.eval(node[3], scope)
+        if kind == "assign":
+            return self._assign(node, scope)
+        if kind in ("preincr", "postincr"):
+            op, target = node[1], node[2]
+            cur = self.eval(target, scope)
+            cur = 0 if cur is None else cur
+            new = cur + (1 if op == "++" else -1)
+            self._store(target, new, scope)
+            return new if kind == "preincr" else cur
+        if kind == "array":
+            return [self.eval(e, scope) for e in node[1]]
+        if kind == "object":
+            return {k: self.eval(v, scope) for k, v in node[1]}
+        if kind == "getattr":
+            return self._getattr(self.eval(node[1], scope), node[2])
+        if kind == "getitem":
+            obj = self.eval(node[1], scope)
+            key = self.eval(node[2], scope)
+            if isinstance(obj, list):
+                i = int(key)
+                return obj[i] if 0 <= i < len(obj) else None
+            if isinstance(obj, dict):
+                if key in obj:
+                    return obj[key]
+                return obj.get(_to_str(key))
+            if isinstance(obj, str):
+                i = int(key)
+                return obj[i] if 0 <= i < len(obj) else None
+            if hasattr(obj, "__scriptlang_getitem__"):
+                return obj.__scriptlang_getitem__(key)
+            raise ScriptException(
+                f"[lang-javascript] cannot index "
+                f"{type(obj).__name__}")
+        if kind == "method":
+            return self._method(node, scope)
+        if kind == "call":
+            fn = self._lookup(node[1], scope)
+            if not isinstance(fn, _Function):
+                raise ScriptException(
+                    f"[lang-javascript] [{node[1]}] is not a function")
+            args = [self.eval(a, scope) for a in node[2]]
+            return self._invoke(fn, args)
+        if kind == "new":
+            ctor = _NEWABLE.get(node[1])
+            if ctor is None:
+                raise ScriptException(
+                    f"[lang-javascript] cannot instantiate [{node[1]}]")
+            args = [self.eval(a, scope) for a in node[2]]
+            if ctor is list and len(args) == 1 and \
+                    isinstance(args[0], int):
+                return [None] * args[0]
+            return ctor(args) if (ctor is list and args) else ctor()
+        raise ScriptException(f"[lang-javascript] unknown expr {kind}")
+
+    def _invoke(self, fn: _Function, args: list):
+        self._tick()
+        if self.depth >= _MAX_CALL_DEPTH:
+            raise ScriptException(
+                "[lang-javascript] call depth exceeded "
+                f"({_MAX_CALL_DEPTH}) — runaway recursion")
+        call_scope = {"__parent__": fn.closure}
+        for i, p in enumerate(fn.params):
+            call_scope[p] = args[i] if i < len(args) else None
+        self.depth += 1
+        try:
+            self.exec_block(fn.body, call_scope)
+        except _Return as r:
+            return r.value
+        except (_Break, _Continue):
+            # must not escape into the CALLER's loop — that would
+            # silently terminate it instead of reporting the bad script
+            raise ScriptException(
+                "[lang-javascript] break/continue outside loop") from None
+        finally:
+            self.depth -= 1
+        return None
+
+    def _assign(self, node, scope):
+        _, op, target, value_node = node
+        value = self.eval(value_node, scope)
+        if op != "=":
+            current = self.eval(target, scope)
+            if current is None:
+                current = "" if isinstance(value, str) else 0
+            value = _binop(op[0], current, value)
+        self._store(target, value, scope)
+        return value
+
+    def _store(self, target, value, scope):
+        tk = target[0]
+        if tk == "name":
+            name = target[1]
+            s = scope
+            while s is not None:
+                if name in s:
+                    s[name] = value
+                    return
+                s = s.get("__parent__")
+            if name in self.bindings and not isinstance(
+                    self.bindings[name], (dict, list)):
+                self.bindings[name] = value
+            else:
+                scope[name] = value
+        elif tk == "getattr":
+            obj = self.eval(target[1], scope)
+            if not isinstance(obj, dict):
+                raise ScriptException(
+                    f"[lang-javascript] cannot set property on "
+                    f"{type(obj).__name__}")
+            obj[target[2]] = value
+        elif tk == "getitem":
+            obj = self.eval(target[1], scope)
+            key = self.eval(target[2], scope)
+            if isinstance(obj, list):
+                i = int(key)
+                if i == len(obj):
+                    obj.append(value)
+                elif 0 <= i < len(obj):
+                    obj[i] = value
+                else:
+                    raise ScriptException(
+                        "[lang-javascript] sparse array writes are not "
+                        "supported")
+            elif isinstance(obj, dict):
+                obj[key] = value
+            else:
+                raise ScriptException(
+                    f"[lang-javascript] cannot index-assign "
+                    f"{type(obj).__name__}")
+
+    def _lookup(self, name: str, scope):
+        s = scope
+        while s is not None:
+            if name in s:
+                return s[name]
+            s = s.get("__parent__")
+        if name in self.bindings:
+            return self.bindings[name]
+        if name == "Math":
+            return _MATH
+        if name == "JSON":
+            return _JSON
+        raise ScriptException(
+            f"[lang-javascript] unknown variable [{name}]")
+
+    def _getattr(self, obj, name: str):
+        if name.startswith("__"):
+            raise ScriptException(
+                f"[lang-javascript] forbidden property [{name}]")
+        if obj is _MATH:
+            v = _MATH.get(name)
+            if v is None or callable(v):
+                raise ScriptException(
+                    f"[lang-javascript] unknown Math constant [{name}]")
+            return v
+        if isinstance(obj, dict):
+            return obj.get(name)
+        if isinstance(obj, (str, list)) and name == "length":
+            return len(obj)
+        if hasattr(obj, "__scriptlang_getattr__"):
+            return obj.__scriptlang_getattr__(name)
+        raise ScriptException(
+            f"[lang-javascript] no property [{name}] on "
+            f"{type(obj).__name__}")
+
+    def _method(self, node, scope):
+        obj = self.eval(node[1], scope)
+        name = node[2]
+        args = [self.eval(a, scope) for a in node[3]]
+        if name.startswith("__"):
+            raise ScriptException(
+                f"[lang-javascript] forbidden method [{name}]")
+        if obj is _MATH:
+            fn = _MATH.get(name)
+            if not callable(fn):
+                raise ScriptException(
+                    f"[lang-javascript] unknown Math method [{name}]")
+            return fn(*args)
+        if obj is _JSON:
+            fn = _JSON.get(name)
+            if fn is None:
+                raise ScriptException(
+                    f"[lang-javascript] unknown JSON method [{name}]")
+            return fn(*args)
+        if isinstance(obj, dict):
+            # object-literal "methods" are just stored functions
+            fn = obj.get(name)
+            if isinstance(fn, _Function):
+                return self._invoke(fn, args)
+            if name == "hasOwnProperty":
+                return args[0] in obj if args else False
+            raise ScriptException(
+                f"[lang-javascript] no method [{name}] on object")
+        table = None
+        if isinstance(obj, list):
+            table = _ARRAY_METHODS
+        elif isinstance(obj, str):
+            table = _STRING_METHODS
+        elif isinstance(obj, (int, float)):
+            if name == "toFixed":
+                nd = int(args[0]) if args else 0
+                return f"{float(obj):.{nd}f}"
+            if name == "toString":
+                return _to_str(obj)
+        elif hasattr(obj, "__scriptlang_method__"):
+            return obj.__scriptlang_method__(name, args)
+        if table is None or name not in table:
+            raise ScriptException(
+                f"[lang-javascript] no method [{name}] on "
+                f"{type(obj).__name__}")
+        return table[name](obj, *args)
+
+
+_COMPILE_CACHE: dict[str, CompiledJavaScript] = {}
+
+
+def compile_javascript(source: str) -> CompiledJavaScript:
+    c = _COMPILE_CACHE.get(source)
+    if c is None:
+        if len(_COMPILE_CACHE) > 512:
+            _COMPILE_CACHE.clear()
+        c = CompiledJavaScript(source)
+        _COMPILE_CACHE[source] = c
+    return c
+
+
+class JavaScriptLangPlugin(Plugin):
+    """lang-javascript: registers the sandboxed engine under lang
+    'javascript' and the 'js' alias (the reference plugin's names —
+    plugins/lang-javascript JavaScriptScriptEngineService.TYPES)."""
+    name = "lang-javascript"
+
+    def script_engines(self) -> dict:
+        return {"javascript": compile_javascript,
+                "js": compile_javascript}

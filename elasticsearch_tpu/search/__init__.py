@@ -1,4 +1,3 @@
 from elasticsearch_tpu.search.query_dsl import parse_query
-from elasticsearch_tpu.search.service import SearchService
 
-__all__ = ["parse_query", "SearchService"]
+__all__ = ["parse_query"]

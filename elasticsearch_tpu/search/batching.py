@@ -21,7 +21,7 @@ optimization layer — results are produced by the same
 from __future__ import annotations
 
 import threading
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
 
 
 class AdaptiveBatcher:
@@ -30,11 +30,31 @@ class AdaptiveBatcher:
 
     `run_batch(reqs) -> list[results] | None` — None means the batch was
     ineligible; every waiter then receives None and the caller runs its
-    serial fallback."""
+    serial fallback.
+
+    Pipelined mode: pass `drain_batch` and `run_batch` becomes the
+    LAUNCH phase (`query_phase_batch_launch`-shaped: async device
+    dispatch, returns an opaque handle or None-for-ineligible) while
+    `drain_batch(handle) -> list[results]` blocks for the device→host
+    transfer on a worker thread. Launching batch N+1 no longer waits for
+    batch N's results to cross the interconnect — on a high-RTT link
+    that drain otherwise idles the device for its full round trip. Up to
+    `max_in_flight` batches may be launched-but-undrained at once (a
+    semaphore backpressures the admission queue beyond that)."""
 
     def __init__(self, run_batch, max_batch: int = 64,
-                 max_wait_s: float = 0.002, pad_to_bucket: bool = True):
+                 max_wait_s: float = 0.002, pad_to_bucket: bool = True,
+                 drain_batch=None, max_in_flight: int = 4):
         self._run_batch = run_batch
+        self._drain_batch = drain_batch
+        if drain_batch is not None:
+            self._inflight = threading.BoundedSemaphore(max_in_flight)
+            self._drain_pool = ThreadPoolExecutor(
+                max_workers=max_in_flight,
+                thread_name_prefix="batch-drain")
+        else:
+            self._inflight = None
+            self._drain_pool = None
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         # Pad formed batches up to the next power of two (cycling the
@@ -100,6 +120,9 @@ class AdaptiveBatcher:
             batch = self._drain_locked()
         for _, fut in batch:
             fut.set_result(None)
+        if self._drain_pool is not None:
+            # let in-flight drains finish so no waiter hangs forever
+            self._drain_pool.shutdown(wait=True)
 
     # ---- internals ---------------------------------------------------------
 
@@ -132,6 +155,45 @@ class AdaptiveBatcher:
                 bucket = self.max_batch
             reqs = reqs + [reqs[i % len(reqs)]
                            for i in range(bucket - len(reqs))]
+        if self._drain_batch is not None:
+            # pipelined: launch here (async device dispatch, fast), drain
+            # on a worker — the next batch forms and launches while this
+            # one's results ride the link
+            self._inflight.acquire()
+            with self._lock:
+                closed = self._closed
+            if closed:
+                # close() raced us while we blocked on the in-flight
+                # semaphore: the pool may already be shut down — resolve
+                # the waiters (None = serial fallback) instead of leaving
+                # them hung on futures nobody will complete
+                self._inflight.release()
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_result(None)
+                return
+            try:
+                handle = self._run_batch(reqs)
+            except Exception as e:           # noqa: BLE001 — fan the error out
+                self._inflight.release()
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+                return
+            if handle is None:
+                self._inflight.release()
+                for _, fut in batch:
+                    fut.set_result(None)
+                return
+            try:
+                self._drain_pool.submit(self._drain_and_deliver, handle,
+                                        batch)
+            except RuntimeError:
+                # pool shut down between the closed check and submit —
+                # drain inline so the launched handle and its waiters
+                # still complete
+                self._drain_and_deliver(handle, batch)
+            return
         try:
             results = self._run_batch(reqs)
         except Exception as e:               # noqa: BLE001 — fan the error out
@@ -139,6 +201,21 @@ class AdaptiveBatcher:
                 if not fut.done():
                     fut.set_exception(e)
             return
+        self._deliver(batch, results)
+
+    def _drain_and_deliver(self, handle, batch: list) -> None:
+        try:
+            results = self._drain_batch(handle)
+        except Exception as e:               # noqa: BLE001 — fan the error out
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        finally:
+            self._inflight.release()
+        self._deliver(batch, results)
+
+    def _deliver(self, batch: list, results) -> None:
         if results is None:
             for _, fut in batch:
                 fut.set_result(None)

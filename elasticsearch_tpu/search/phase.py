@@ -452,10 +452,29 @@ class ShardSearcher:
         search_after / suggest / partial-results modes) or the queries
         don't share one compiled plan — the caller then falls back to
         per-request :meth:`query_phase`.
+
+        Implemented as launch + drain so a pipelined caller (the
+        AdaptiveBatcher) can overlap batch N's device→host drain with
+        batch N+1's device work — on a tunneled interconnect the drain
+        round trip otherwise idles the chip for its full RTT.
         """
+        handle = self.query_phase_batch_launch(reqs)
+        if handle is None:
+            return None
+        return self.query_phase_batch_drain(handle)
+
+    def query_phase_batch_launch(self, reqs: list[ParsedSearchRequest]):
+        """Phase 1 of the batched query phase: eligibility screen, ONE
+        async device dispatch, and an async device→host copy kick-off.
+        Returns an opaque handle for :meth:`query_phase_batch_drain`, or
+        None when the batch is ineligible (caller falls back to serial
+        :meth:`query_phase`). Never blocks on device results — JAX's
+        async dispatch returns immediately and ``copy_to_host_async``
+        starts the transfer in the background, so consecutive launches
+        pipeline on the device while earlier drains ride the link."""
         from elasticsearch_tpu.search import jit_exec
         if not reqs:
-            return []
+            return ("empty", [])
         for req in reqs:
             if (req.aggs or not _is_score_order(req.sort)
                     or req.post_filter is not None
@@ -467,32 +486,53 @@ class ShardSearcher:
         k = max(max(req.from_ + req.size, 1) for req in reqs)
         queries = [req.query for req in reqs]
         if not self.reader.segments:
-            return [ShardQueryResult(self.shard_id, 0, None,
-                                     np.zeros(0, np.int32),
-                                     np.zeros(0, np.float32), None, {},
-                                     self.reader) for _ in reqs]
+            return ("empty", reqs)
         # doc ids and counts survive the packed f32 fetch layout exactly
         # only below 2^24
         pack = self.reader.max_doc < (1 << 24)
         streamed = [s for s in self.reader.segments
                     if not getattr(s, "resident", True)]
         if streamed:
+            # the streamed path is inherently synchronous (H2D double
+            # buffering drives its own loop) — drain gets finished arrays
             res_sm = self._query_phase_batch_streamed(queries, k, streamed)
             if res_sm is None:
                 return None
-            ms, md, totals = res_sm
-        else:
+            return ("host", reqs, k, res_sm)
+        try:
+            out = jit_exec.run_reader_batch(self.reader.segments,
+                                            self.ctx, queries, k=k,
+                                            pack=pack)
+        except QueryParsingError:
+            raise
+        except Exception as e:            # noqa: BLE001 — fallback seam
+            jit_exec.note_fallback(e)
+            return None
+        if out is None:                   # mixed plan signatures
+            return None
+        for arr in ([out] if pack else
+                    [out["top_scores"], out["top_docs"], out["count"]]):
             try:
-                out = jit_exec.run_reader_batch(self.reader.segments,
-                                                self.ctx, queries, k=k,
-                                                pack=pack)
-            except QueryParsingError:
-                raise
-            except Exception as e:        # noqa: BLE001 — fallback seam
-                jit_exec.note_fallback(e)
-                return None
-            if out is None:               # mixed plan signatures
-                return None
+                arr.copy_to_host_async()
+            except Exception:             # noqa: BLE001 — optional fast path
+                pass                      # drain's np.asarray still works
+        return ("device", reqs, k, pack, out)
+
+    def query_phase_batch_drain(self, handle
+                                ) -> list[ShardQueryResult]:
+        """Phase 2: block until the launched batch's results are on host
+        (one RTT, overlappable across batches — concurrent drains share
+        the link's latency) and build per-request ShardQueryResults."""
+        tag, reqs = handle[0], handle[1]
+        if tag == "empty":
+            return [ShardQueryResult(self.shard_id, 0, None,
+                                     np.zeros(0, np.int32),
+                                     np.zeros(0, np.float32), None, {},
+                                     self.reader) for _ in reqs]
+        if tag == "host":
+            _, _, k, (ms, md, totals) = handle
+        else:
+            _, _, k, pack, out = handle
             if pack:
                 # single-fetch fast path: scoring, merge AND result
                 # packing ran as one program — one dispatch + one

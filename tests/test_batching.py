@@ -95,3 +95,90 @@ def test_close_drains_queue_with_none():
     b.close()
     assert f.result(1.0) is None
     assert b.submit(8).result(1.0) is None     # post-close submit
+
+# ---- pipelined (launch/drain) mode --------------------------------------
+
+def test_pipelined_overlaps_drains():
+    """With drain_batch set, batch N+1 launches while batch N drains:
+    4 batches whose drains each sleep 50 ms must complete in ~1 drain
+    window, not 4 serialized ones."""
+    launched, lock = [], threading.Lock()
+
+    def launch(reqs):
+        with lock:
+            launched.append(list(reqs))
+        return list(reqs)                    # the handle is just the reqs
+
+    def drain(handle):
+        time.sleep(0.05)                     # simulated link RTT
+        return [r * 2 for r in handle]
+
+    b = AdaptiveBatcher(launch, drain_batch=drain, max_batch=2,
+                        max_wait_s=0.005, pad_to_bucket=False,
+                        max_in_flight=8)
+    futs = []
+    t0 = time.perf_counter()
+    for i in range(8):                       # forms 4 full batches of 2
+        futs.append(b.submit(i))
+    out = [f.result(2.0) for f in futs]
+    dt = time.perf_counter() - t0
+    assert out == [i * 2 for i in range(8)]
+    assert len(launched) == 4
+    # serialized drains would be >= 0.2 s; overlapped is ~0.05-0.1 s
+    assert dt < 0.15, f"drains serialized: {dt:.3f}s"
+    b.close()
+
+
+def test_pipelined_ineligible_and_error_paths():
+    def launch(reqs):
+        if any(r == "bad" for r in reqs):
+            return None                      # ineligible
+        if any(r == "boom" for r in reqs):
+            raise RuntimeError("launch failed")
+        return list(reqs)
+
+    def drain(handle):
+        if any(r == "drainboom" for r in handle):
+            raise RuntimeError("drain failed")
+        return list(handle)
+
+    b = AdaptiveBatcher(launch, drain_batch=drain, max_batch=1,
+                        max_wait_s=0.005, pad_to_bucket=False)
+    assert b.execute("bad") is None
+    try:
+        b.execute("boom")
+        raise AssertionError("expected launch error")
+    except RuntimeError as e:
+        assert "launch failed" in str(e)
+    try:
+        b.execute("drainboom")
+        raise AssertionError("expected drain error")
+    except RuntimeError as e:
+        assert "drain failed" in str(e)
+    assert b.execute("ok") == "ok"
+    b.close()
+
+
+def test_pipelined_in_flight_backpressure():
+    """max_in_flight bounds launched-but-undrained batches."""
+    peak, cur, lock = [0], [0], threading.Lock()
+
+    def launch(reqs):
+        with lock:
+            cur[0] += 1
+            peak[0] = max(peak[0], cur[0])
+        return list(reqs)
+
+    def drain(handle):
+        time.sleep(0.02)
+        with lock:
+            cur[0] -= 1
+        return list(handle)
+
+    b = AdaptiveBatcher(launch, drain_batch=drain, max_batch=1,
+                        max_wait_s=0.001, pad_to_bucket=False,
+                        max_in_flight=2)
+    futs = [b.submit(i) for i in range(10)]
+    assert [f.result(5.0) for f in futs] == list(range(10))
+    assert peak[0] <= 2, f"in-flight exceeded bound: {peak[0]}"
+    b.close()

@@ -76,16 +76,44 @@ def test_mesh_path_metric_aggs(nodes):
         assert av == pytest.approx(bv, rel=1e-6), (k, av, bv)
 
 
-def test_ineligible_falls_back(nodes):
+def test_sorted_query_rides_the_plane(nodes):
+    """Round 5: sort-by-numeric-field IS a mesh shape — in-program
+    double-double sort keys through the all_gather merge. Response must
+    be indistinguishable from the fan-out, incl. hit['sort'] values."""
     n = nodes
-    # sort-by-field is not a mesh shape: must fall back and still work
-    body = {"query": {"match": {"t": "w1"}}, "size": 5,
+    for body in (
+            {"query": {"match": {"t": "w1"}}, "size": 5,
+             "sort": [{"v": {"order": "desc"}}]},
+            {"query": {"match": {"t": "w1"}}, "size": 5,
+             "sort": [{"v": {"order": "asc"}}]},
+            {"query": {"match": {"t": "w1 w3"}}, "size": 8,
+             "sort": [{"v": "desc"}],
+             "post_filter": {"range": {"v": {"gte": 50}}}}):
+        a = n.search("on", dict(body), search_type=DFS)
+        b = n.search("off", dict(body), search_type=DFS)
+        assert a["hits"]["total"] == b["hits"]["total"], body
+        assert [(h["_id"], h["sort"]) for h in a["hits"]["hits"]] == \
+            [(h["_id"], h["sort"]) for h in b["hits"]["hits"]], body
+
+
+def test_sorted_search_after_rides_the_plane(nodes):
+    n = nodes
+    base = {"query": {"match": {"t": "w1"}}, "size": 5,
             "sort": [{"v": {"order": "desc"}}]}
-    a = n.search("on", dict(body), search_type=DFS)
-    b = n.search("off", dict(body), search_type=DFS)
+    p1 = n.search("on", dict(base), search_type=DFS)
+    cursor = p1["hits"]["hits"][-1]["sort"]
+    page2 = dict(base, search_after=cursor)
+    a = n.search("on", dict(page2), search_type=DFS)
+    b = n.search("off", dict(page2), search_type=DFS)
     assert [h["_id"] for h in a["hits"]["hits"]] == \
         [h["_id"] for h in b["hits"]["hits"]]
-    # bucket aggs fall back too
+    assert not ({h["_id"] for h in a["hits"]["hits"]} &
+                {h["_id"] for h in p1["hits"]["hits"]})
+
+
+def test_ineligible_falls_back(nodes):
+    n = nodes
+    # numeric terms aggs stay host-side: must fall back and still work
     body = {"query": {"match_all": {}}, "size": 0,
             "aggs": {"t": {"terms": {"field": "v"}}}}
     a = n.search("on", dict(body), search_type=DFS)
@@ -162,3 +190,45 @@ def test_mesh_feeds_search_stats(nodes):
     before = idx.search_stats["query_total"]
     n.search("on", {"query": {"match": {"t": "w1"}}}, search_type=DFS)
     assert idx.search_stats["query_total"] == before + 1
+
+
+def test_bucket_aggs_ride_the_plane(nodes):
+    """Keyword terms + histogram bucket aggs reduce in-program (fixed-
+    width ordinal counts / dd histogram scatter-adds) — responses equal
+    the fan-out path's coordinator reduce."""
+    n = nodes
+    rng = np.random.default_rng(17)
+    langs = ["en", "de", "fr", "ja"]
+    for name, plane in (("kon", True), ("koff", False)):
+        n.indices_service.create_index(name, {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 0,
+                         "index.search.collective_plane": plane},
+            "mappings": {"_doc": {"properties": {
+                "t": {"type": "text", "analyzer": "whitespace"},
+                "k": {"type": "keyword"},
+                "v": {"type": "long"}}}}})
+    for i in range(150):
+        doc = {"t": "w1" if i % 2 else "w1 w2",
+               "k": langs[int(rng.integers(0, 4))],
+               "v": int(rng.integers(0, 500))}
+        n.index_doc("kon", str(i), doc)
+        n.index_doc("koff", str(i), doc)
+    n.broadcast_actions.refresh("kon")
+    n.broadcast_actions.refresh("koff")
+    body = {"query": {"match": {"t": "w1"}}, "size": 5,
+            "sort": [{"v": "desc"}],
+            "aggs": {"by_k": {"terms": {"field": "k", "size": 3}},
+                     "h": {"histogram": {"field": "v", "interval": 100}},
+                     "mx": {"max": {"field": "v"}}}}
+    a = n.search("kon", dict(body), search_type=DFS)
+    b = n.search("koff", dict(body), search_type=DFS)
+    # the plane actually engaged on the opted-in index
+    assert "_mesh_cache" in n.indices_service.indices["kon"].__dict__
+    assert a["hits"]["total"] == b["hits"]["total"]
+    assert [(h["_id"], h["sort"]) for h in a["hits"]["hits"]] == \
+        [(h["_id"], h["sort"]) for h in b["hits"]["hits"]]
+    assert a["aggregations"]["by_k"] == b["aggregations"]["by_k"]
+    assert a["aggregations"]["h"]["buckets"] == \
+        b["aggregations"]["h"]["buckets"]
+    assert a["aggregations"]["mx"]["value"] == \
+        b["aggregations"]["mx"]["value"]

@@ -1,0 +1,154 @@
+"""lang-javascript plugin: a sandboxed JS-subset ScriptEngineService
+(the reference's plugins/lang-javascript, Rhino —
+JavaScriptScriptEngineService) registered through the plugin SPI's
+script_engines seam, interpreted in the GroovyLite mold."""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.plugin_pack.lang_javascript import (
+    CompiledJavaScript, JavaScriptLangPlugin, compile_javascript)
+from elasticsearch_tpu.search.scriptlang import ScriptException
+
+
+class TestInterpreter:
+    def run(self, src, **bindings):
+        return compile_javascript(src).run(bindings)
+
+    def test_arithmetic_and_last_expression_value(self):
+        assert self.run("1 + 2 * 3") == 7
+        assert self.run("1 / 2") == 0.5          # JS true division
+        assert self.run("7 % 3") == 1
+        assert self.run("-7 % 3") == -1          # truncating, not floored
+        assert self.run("'a' + 1 + 2") == "a12"  # left-assoc string concat
+
+    def test_var_for_loop_and_return(self):
+        src = """
+        var total = 0;
+        for (var i = 0; i < 10; i++) { total += i; }
+        return total;
+        """
+        assert self.run(src) == 45
+
+    def test_for_in_and_for_of(self):
+        assert self.run(
+            "var ks = []; var o = {a: 1, b: 2};"
+            "for (var k in o) { ks.push(k); } ks.join('-')") == "a-b"
+        assert self.run(
+            "var s = 0; for (var v of [10, 20, 12]) { s += v; } s") == 42
+        # for..in over an array yields indices
+        assert self.run(
+            "var s = 0; for (var i in [5, 6, 7]) { s += i; } s") == 3
+
+    def test_functions_and_closures(self):
+        src = """
+        function mul(a, b) { return a * b; }
+        function adder(n) {
+            function add(x) { return x + n; }
+            return add;
+        }
+        var f = adder(10);
+        mul(2, 3) + f(4);
+        """
+        assert self.run(src) == 20
+
+    def test_strict_and_loose_equality(self):
+        assert self.run("1 === 1.0") is True
+        assert self.run("true === 1") is False
+        assert self.run("'a' !== 'b'") is True
+
+    def test_typeof_and_undefined(self):
+        assert self.run("typeof 3") == "number"
+        assert self.run("typeof 'x'") == "string"
+        assert self.run("typeof missingVar") == "undefined"
+        assert self.run("undefined == null") is True
+
+    def test_objects_arrays_and_methods(self):
+        assert self.run(
+            "var xs = [3, 1, 2]; xs.sort(); xs.join(',')") == "1,2,3"
+        assert self.run("[1, 2, 3].indexOf(2)") == 1
+        assert self.run("[1, 2].concat([3], 4).length") == 4
+        assert self.run("'Hello World'.toLowerCase().split(' ')[1]") == \
+            "world"
+        assert self.run("'abcdef'.substring(1, 3)") == "bc"
+        assert self.run("var o = {x: 1}; o.y = 2; delete o.x;"
+                        "JSON.stringify(o)") == '{"y": 2}'
+        assert self.run("Math.max(1, Math.floor(2.9))") == 2
+
+    def test_truthiness_is_javascript_not_groovy(self):
+        # [] and {} are truthy in JS (Groovy treats them as false)
+        assert self.run("[] ? 1 : 2") == 1
+        assert self.run("({}) ? 1 : 2") == 1
+        assert self.run("'' ? 1 : 2") == 2
+        assert self.run("0 ? 1 : 2") == 2
+
+    def test_op_budget_stops_runaway_loop(self):
+        with pytest.raises(ScriptException, match="budget"):
+            self.run("while (true) { var x = 1; }")
+
+    def test_recursion_depth_capped(self):
+        with pytest.raises(ScriptException, match="depth|budget"):
+            self.run("function f(n) { return f(n + 1); } f(0)")
+
+    def test_sandbox_rejects_dunder(self):
+        with pytest.raises(ScriptException):
+            CompiledJavaScript("var __proto__ = 1;")
+        with pytest.raises(ScriptException):
+            self.run("({}).__class__")
+
+    def test_bindings(self):
+        assert self.run("params.a + params['b']",
+                        params={"a": 40, "b": 2}) == 42
+
+
+class TestThroughTheNode:
+    @pytest.fixture()
+    def node(self, tmp_path):
+        n = Node({"plugins": [JavaScriptLangPlugin()]},
+                 data_path=tmp_path / "n").start()
+        n.indices_service.create_index("j", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0}})
+        yield n
+        n.close()
+
+    def test_script_field(self, node):
+        node.index_doc("j", "1", {"price": 10, "qty": 3}, refresh=True)
+        r = node.search("j", {
+            "query": {"match_all": {}},
+            "script_fields": {"total": {"script": {
+                "lang": "javascript",
+                "source": "doc['price'].value * doc['qty'].value"}}}})
+        assert r["hits"]["hits"][0]["fields"]["total"] == [30.0]
+
+    def test_update_by_script(self, node):
+        node.index_doc("j", "1", {"counter": 1}, refresh=True)
+        node.update_doc("j", "1", {"script": {
+            "lang": "js",
+            "source": "ctx._source.counter += params.by",
+            "params": {"by": 4}}})
+        assert node.get_doc("j", "1")["_source"]["counter"] == 5
+
+    def test_scripted_metric(self, node):
+        for i in range(5):
+            node.index_doc("j", str(i), {"v": i + 1})
+        node.broadcast_actions.refresh("j")
+        r = node.search("j", {"size": 0, "aggs": {"s": {
+            "scripted_metric": {
+                "lang": "javascript",
+                "init_script": "_agg.acc = [];",
+                "map_script": "_agg.acc.push(doc['v'].value);",
+                "combine_script":
+                    "var t = 0;"
+                    "for (var x of _agg.acc) { t += x; } return t;",
+                "reduce_script":
+                    "var t = 0;"
+                    "for (var s of _aggs) { t += s; } return t;"}}}})
+        assert r["aggregations"]["s"]["value"] == 15.0
+
+    def test_unknown_lang_still_raises(self, node):
+        node.index_doc("j", "1", {"v": 1}, refresh=True)
+        with pytest.raises(Exception):
+            node.search("j", {
+                "query": {"match_all": {}},
+                "script_fields": {"x": {"script": {
+                    "lang": "rhino2", "source": "1"}}}})

@@ -294,3 +294,258 @@ def test_mesh_rejects_missing_param(mesh, engines):
         MeshEngineSearcher(mesh, engs, ms).search_batch([{
             "query": {"match_all": {}},
             "aggs": {"a": {"sum": {"field": "n", "missing": 0}}}}] * 2)
+
+
+# ---- generalized plane: sort / post_filter / min_score / search_after /
+# per-shard totals / bucket aggs (round-5 eligibility expansion) ----------
+
+def _sorted_oracle(ms, engs, body):
+    """Host-path reference for field-sorted requests: per-shard
+    ShardSearcher with global DFS stats, merged by controller.sort_docs
+    — the (sort values, shard, position) order of
+    SearchPhaseController.sortDocs."""
+    from elasticsearch_tpu.index.device_reader import DeviceReader
+    from elasticsearch_tpu.search import dfs as dfs_mod
+    from elasticsearch_tpu.search.controller import sort_docs
+    from elasticsearch_tpu.search.phase import (ShardSearcher,
+                                                parse_search_request)
+    from elasticsearch_tpu.search.query_dsl import parse_query
+    readers = [DeviceReader(e.acquire_searcher()) for e in engs]
+    query = parse_query(body.get("query"))
+    stats = dfs_mod.to_execution_stats(dfs_mod.aggregate_dfs(
+        [dfs_mod.shard_dfs(r, ms, query) for r in readers]))
+    req = parse_search_request(body)
+    results = [ShardSearcher(si, r, ms, dfs_stats=stats).query_phase(req)
+               for si, r in enumerate(readers)]
+    page = sort_docs(results, req)
+    rows = []
+    for ref in page:
+        r = readers[ref.shard_idx]
+        seg, local = r.resolve(int(
+            results[ref.shard_idx].doc_ids[ref.position]))
+        rows.append((seg.seg.ids[local], ref.sort_values))
+    return [res.total for res in results], rows
+
+
+@pytest.mark.parametrize("order", ["asc", "desc"])
+def test_mesh_sort_by_field_parity(mesh, engines, order):
+    ms, engs = engines
+    searcher = MeshEngineSearcher(mesh, engs, ms)
+    body = {"query": {"match": {"t": "w1 w2"}}, "size": 30,
+            "sort": [{"n": {"order": order}}]}
+    out = searcher.search_batch([body] * 2)
+    shard_totals, want = _sorted_oracle(ms, engs, body)
+    for res in out:
+        assert res["total"] == sum(shard_totals)
+        assert list(res["shard_totals"]) == shard_totals
+        got = [(searcher.doc_id(d), sv)
+               for d, sv in zip(res["doc_ids"], res["sort_values"])]
+        assert got == want
+
+
+def test_mesh_sort_missing_values(tmp_path):
+    """Sparse numeric sort field: missing docs honor _last/_first and a
+    numeric `missing`, identical to the host vocab path."""
+    ms = _mapper()
+    engs = [Engine(tmp_path / f"sp{i}", ms) for i in range(2)]
+    for i in range(40):
+        doc = {"t": "w1"}
+        if i % 3 != 0:                       # every 3rd doc lacks "n"
+            doc["n"] = (i * 37) % 100
+        engs[i % 2].index(str(i), doc)
+    for e in engs:
+        e.refresh()
+    try:
+        m = make_mesh(dp=1, shard=2, devices=jax.devices()[:2])
+        searcher = MeshEngineSearcher(m, engs, ms)
+        for sort in ([{"n": {"order": "asc"}}],
+                     [{"n": {"order": "desc", "missing": "_first"}}],
+                     [{"n": {"order": "asc", "missing": 42}}]):
+            body = {"query": {"match": {"t": "w1"}}, "size": 40,
+                    "sort": sort}
+            out = searcher.search_batch([body])
+            _, want = _sorted_oracle(ms, engs, body)
+            got = [(searcher.doc_id(d), sv)
+                   for d, sv in zip(out[0]["doc_ids"],
+                                    out[0]["sort_values"])]
+            assert got == want, sort
+    finally:
+        for e in engs:
+            e.close()
+
+
+def test_mesh_post_filter_min_score(mesh, engines):
+    ms, engs = engines
+    searcher = MeshEngineSearcher(mesh, engs, ms)
+    for body in (
+            {"query": {"match": {"t": "w1 w2"}}, "size": 25,
+             "post_filter": {"range": {"n": {"gte": 50, "lt": 150}}}},
+            {"query": {"match": {"t": "w1 w2"}}, "size": 25,
+             "min_score": 0.4}):
+        out = searcher.search_batch([body] * 2)
+        ref_total, ref_rows = _rpc_reference(ms, engs, body, 25)
+        for res in out:
+            assert res["total"] == ref_total, body
+            got = [(round(float(s), 4), searcher.doc_id(d))
+                   for s, d in zip(res["scores"], res["doc_ids"])]
+            want = [(round(s, 4), did) for s, _, did in ref_rows]
+            assert got == want, body
+
+
+def test_mesh_search_after_field_sort(mesh, engines):
+    """Field-sorted pagination: page 2 via search_after must equal the
+    host path's continuation (the cursor is an in-program mask)."""
+    ms, engs = engines
+    searcher = MeshEngineSearcher(mesh, engs, ms)
+    base = {"query": {"match": {"t": "w1 w2"}}, "size": 10,
+            "sort": [{"n": {"order": "desc"}}]}
+    p1 = searcher.search_batch([base] * 2)[0]
+    cursor = p1["sort_values"][-1]
+    page2 = dict(base, search_after=cursor)
+    out = searcher.search_batch([page2] * 2)
+    _, want = _sorted_oracle(ms, engs, page2)
+    for res in out:
+        got = [(searcher.doc_id(d), sv)
+               for d, sv in zip(res["doc_ids"], res["sort_values"])]
+        assert got == want
+        # no overlap with page 1
+        assert not ({searcher.doc_id(d) for d in res["doc_ids"]} &
+                    {searcher.doc_id(d) for d in p1["doc_ids"]})
+
+
+def test_mesh_per_shard_totals(mesh, engines):
+    ms, engs = engines
+    searcher = MeshEngineSearcher(mesh, engs, ms)
+    body = {"query": {"match": {"t": "w1"}}, "size": 5}
+    out = searcher.search_batch([body] * 2)
+    shard_totals, _ = _sorted_oracle(ms, engs, dict(body, sort=[
+        {"n": {"order": "asc"}}]))
+    for res in out:
+        assert list(res["shard_totals"]) == shard_totals
+        assert res["total"] == sum(shard_totals)
+
+
+def _keyword_engines(tmp_path, n_shards=2):
+    ms = MapperService()
+    ms.merge("_doc", {"properties": {
+        "t": {"type": "text", "analyzer": "whitespace"},
+        "k": {"type": "keyword"},
+        "n": {"type": "long"}}})
+    engs = [Engine(tmp_path / f"kw{i}", ms) for i in range(n_shards)]
+    rng = np.random.default_rng(3)
+    langs = ["en", "de", "fr", "ja", "zh", "pt"]
+    for i in range(120):
+        doc = {"t": "w1" if i % 2 else "w1 w2",
+               "k": langs[int(rng.integers(0, len(langs)))],
+               "n": int(rng.integers(0, 200))}
+        engs[i % n_shards].index(str(i), doc)
+    for e in engs:
+        e.refresh()
+    return ms, engs
+
+
+def test_mesh_terms_agg_parity(tmp_path):
+    """Keyword terms agg reduced in-program (per-shard ordinal counts →
+    all_gather → coordinator reduce) must equal brute-force counts with
+    ES ordering (count desc, term asc) and exact sum_other."""
+    ms, engs = _keyword_engines(tmp_path)
+    try:
+        m = make_mesh(dp=2, shard=2, devices=jax.devices()[:4])
+        searcher = MeshEngineSearcher(m, engs, ms)
+        body = {"query": {"match": {"t": "w1"}}, "size": 0,
+                "aggs": {"by_k": {"terms": {"field": "k", "size": 3}}}}
+        out = searcher.search_batch([body] * 2)
+        # brute-force oracle
+        from collections import Counter
+        cnt = Counter()
+        for e in engs:
+            view = e.acquire_searcher()
+            for seg, live in zip(view.segments, view.live_masks):
+                col = seg.text_fields["t"]
+                tid = col.tid("w1")
+                hit = (col.uterms == tid).any(axis=1) & live
+                kcol = seg.keyword_fields["k"]
+                for r in np.nonzero(hit)[0]:
+                    for o in kcol.ords[r]:
+                        if o >= 0:
+                            cnt[kcol.vocab[int(o)]] += 1
+        items = sorted(cnt.items(), key=lambda kv: (-kv[1], kv[0]))
+        want = [{"key": k, "doc_count": c} for k, c in items[:3]]
+        other = sum(c for _, c in items[3:])
+        for res in out:
+            a = res["aggregations"]["by_k"]
+            assert a["buckets"] == want
+            assert a["sum_other_doc_count"] == other
+            assert a["doc_count_error_upper_bound"] == 0
+    finally:
+        for e in engs:
+            e.close()
+
+
+def test_mesh_histogram_agg_parity(tmp_path):
+    ms, engs = _keyword_engines(tmp_path)
+    try:
+        m = make_mesh(dp=1, shard=2, devices=jax.devices()[:2])
+        searcher = MeshEngineSearcher(m, engs, ms)
+        body = {"query": {"match": {"t": "w1"}}, "size": 0,
+                "aggs": {"h": {"histogram": {"field": "n",
+                                             "interval": 25}}}}
+        out = searcher.search_batch([body])
+        from collections import Counter
+        cnt = Counter()
+        for e in engs:
+            view = e.acquire_searcher()
+            for seg, live in zip(view.segments, view.live_masks):
+                col = seg.text_fields["t"]
+                tid = col.tid("w1")
+                hit = (col.uterms == tid).any(axis=1) & live
+                ncol = seg.numeric_fields["n"]
+                for r in np.nonzero(hit)[0]:
+                    if ncol.exists[r]:
+                        cnt[float(ncol.values[r] // 25 * 25)] += 1
+        want = [{"key": k, "doc_count": cnt[k]} for k in sorted(cnt)]
+        assert out[0]["aggregations"]["h"]["buckets"] == want
+    finally:
+        for e in engs:
+            e.close()
+
+
+def test_mesh_sort_with_terms_agg_combined(tmp_path):
+    """The round-5 'Done' shape: a sorted request WITH a terms agg runs
+    on the plane in one program."""
+    ms, engs = _keyword_engines(tmp_path)
+    try:
+        m = make_mesh(dp=1, shard=2, devices=jax.devices()[:2])
+        searcher = MeshEngineSearcher(m, engs, ms)
+        body = {"query": {"match": {"t": "w1"}}, "size": 10,
+                "sort": [{"n": {"order": "desc"}}],
+                "aggs": {"by_k": {"terms": {"field": "k"}},
+                         "mx": {"max": {"field": "n"}}}}
+        out = searcher.search_batch([body])
+        _, want = _sorted_oracle(ms, engs, body)
+        got = [(searcher.doc_id(d), sv)
+               for d, sv in zip(out[0]["doc_ids"],
+                                out[0]["sort_values"])]
+        assert got == want
+        assert out[0]["aggregations"]["by_k"]["buckets"]
+        assert out[0]["aggregations"]["mx"]["value"] is not None
+    finally:
+        for e in engs:
+            e.close()
+
+
+def test_mesh_rejects_residual_shapes(mesh, engines):
+    """The eligibility frontier after round 5: keyword sorts, _doc sorts,
+    sub-aggs, score-order search_after still route to RPC."""
+    from elasticsearch_tpu.common.errors import QueryParsingError
+    ms, engs = engines
+    searcher = MeshEngineSearcher(mesh, engs, ms)
+    for body in (
+            {"query": {"match_all": {}}, "sort": [{"_doc": {}}]},
+            {"query": {"match_all": {}}, "sort": [{"t": {}}]},
+            {"query": {"match_all": {}}, "search_after": [1.5]},
+            {"query": {"match_all": {}},
+             "aggs": {"a": {"terms": {"field": "n"},
+                            "aggs": {"m": {"max": {"field": "n"}}}}}}):
+        with pytest.raises(QueryParsingError):
+            searcher.search_batch([body] * 2)
