@@ -1001,6 +1001,32 @@ def main() -> int:
                 s.seg.memory_bytes() for s in r_half.segments
                 if not s.resident)
             predicted_ms = streamed_bytes / (h2d_mbps * 1e6) * 1e3
+            # ---- overlap quantification (round-5): how much of the
+            # smaller leg (compute here, transfer on a local attach) the
+            # threaded prefetch pipeline hides. W >= max(Tc, Tt) always;
+            # overlap = (Tc + Tt - W) / min(Tc, Tt), 1.0 = fully hidden.
+            # Tc = the same segments' compute with everything resident
+            # (ms_f); Tt = measured-bandwidth transfer floor. The bw
+            # probe is a single 64 MB blocking put, so Tt carries its
+            # error — clamp and report the raw legs alongside.
+            from elasticsearch_tpu.search import jit_exec as _jx
+            st = getattr(_jx.run_segments_streamed, "last_stats", None)
+            put_wait_ms = round(st["put_wait_s"] * 1e3, 1) if st else None
+            t_c, t_t, w_ = ms_f, predicted_ms, ms_h
+            overlap = (t_c + t_t - w_) / min(t_c, t_t) if min(t_c, t_t) \
+                else 0.0
+            overlap = max(0.0, min(1.0, overlap))
+            # compute-bound model for a LOCAL host attach (PCIe-class
+            # H2D, env-overridable): streamed wall ~ max(Tc, Tt_local)
+            # + one segment's fill; overhead vs resident follows
+            local_gbps = float(os.environ.get("BENCH_LOCAL_H2D_GBPS",
+                                              "10"))
+            tt_local = streamed_bytes / (local_gbps * 1e9) * 1e3
+            n_str = sum(1 for s in r_half.segments if not s.resident)
+            w_local = max(t_c, tt_local) + tt_local / max(n_str, 1)
+            local_overhead = w_local / ms_f if ms_f else float("inf")
+            w_tunnel_model = max(t_c, t_t) + t_t / max(n_str, 1)
+            model_err = abs(w_tunnel_model - ms_h) / ms_h if ms_h else 1.0
             engine["stream_2x_capacity"] = {
                 "resident_qps": round(qps_f, 2),
                 "streamed_qps": round(qps_h, 2),
@@ -1009,12 +1035,25 @@ def main() -> int:
                 "overhead_x": round(ratio, 2), "parity_ok": stream_ok,
                 "h2d_mbps": round(h2d_mbps, 1),
                 "streamed_mb_per_batch": round(streamed_bytes / 1e6, 1),
-                "predicted_transfer_ms": round(predicted_ms, 1)}
+                "predicted_transfer_ms": round(predicted_ms, 1),
+                "overlap_hidden_frac": round(overlap, 3),
+                "put_wait_ms_per_batch": put_wait_ms,
+                "compute_leg_ms": round(t_c, 1),
+                "tunnel_model_ms": round(w_tunnel_model, 1),
+                "tunnel_model_err": round(model_err, 3),
+                "local_h2d_gbps_assumed": local_gbps,
+                "predicted_local_overhead_x": round(local_overhead, 2)}
             log(f"[bench] stream 2x-capacity: resident {qps_f:.1f} QPS "
                 f"vs streamed {qps_h:.1f} QPS (overhead {ratio:.2f}x, "
                 f"parity_ok={stream_ok}; H2D {h2d_mbps:.0f} MB/s, "
                 f"{streamed_bytes/1e6:.0f} MB/batch → predicted "
                 f"transfer {predicted_ms:.0f} ms)")
+            log(f"[bench] stream overlap: {overlap*100:.0f}% of the "
+                f"smaller leg hidden (compute {t_c:.0f} ms inside "
+                f"transfer {t_t:.0f} ms; wall {w_:.0f} ms, model "
+                f"{w_tunnel_model:.0f} ms, err {model_err*100:.0f}%); "
+                f"local-attach model ({local_gbps:.0f} GB/s H2D): "
+                f"overhead {local_overhead:.2f}x vs resident")
             del r_half
             _gc.collect()
             eng_s.close()
@@ -1023,14 +1062,14 @@ def main() -> int:
     recall_ok = bool(kernel_ok and engine_ok and
                      (oracle_recall is None or oracle_recall >= 0.999))
     qps = engine.get("qps", kernel_qps)
-    print(json.dumps({
+    record = {
         "metric": "bm25_top1000_qps_per_chip",
         "value": round(qps, 2),
         "unit": "qps",
         "vs_baseline": round(qps / cpu_qps, 3),
         "recall_ok": recall_ok,
         "oracle_recall_at_k": oracle_recall,
-        "corpus_mode": os.environ.get("BENCH_CORPUS", "zipf"),
+        "corpus_mode": corpus_mode,
         "device": f"{dev.platform} ({dev})",
         "n_docs": n_docs,
         "cpu_baseline_qps": round(cpu_qps, 2),
@@ -1038,10 +1077,76 @@ def main() -> int:
         "kernel": best,
         "kernel_qps": kernel_qps,
         "kernels": results,
-    }))
+    }
+
+    # ---- MS-MARCO-scale headline (BASELINE.json's stated metric) -------
+    # The recorded headline must be the corpus the README advertises:
+    # re-exec engine-only at 8.8M docs / msmarco statistics as a child
+    # run (oracle gating stays on the ≤2M runs — this one is parity-
+    # checked engine-vs-kernel on identical top-k) and promote its
+    # number to the top-level metric; the full-config run above is kept
+    # in its entirety under "corpora".
+    want_8m8 = os.environ.get("BENCH_HEADLINE_8M8")
+    if want_8m8 is None:
+        want_8m8 = "1" if (dev.platform not in ("cpu",)
+                           and corpus_mode == "zipf"
+                           and os.environ.get("BENCH_DOCS") is None) \
+            else "0"
+    if want_8m8 == "1":
+        import subprocess
+        docs_8m8 = os.environ.get("BENCH_8M8_DOCS", "8800000")
+        child_env = dict(os.environ,
+                         BENCH_DOCS=docs_8m8, BENCH_CORPUS="msmarco",
+                         BENCH_CONFIGS="0", BENCH_CONFIG5="0",
+                         BENCH_MESH="0", BENCH_STREAM="0",
+                         BENCH_ORACLE="0", BENCH_HEADLINE_8M8="0",
+                         BENCH_CPU_QUERIES="32")
+        log(f"[bench] headline corpus: {docs_8m8} docs msmarco "
+            f"statistics (engine-only child run)")
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=child_env, capture_output=True, text=True,
+                timeout=3600)
+            for ln in out.stdout.splitlines():
+                if ln.startswith("[bench]"):
+                    log(ln)
+            child = json.loads(out.stdout.strip().splitlines()[-1])
+        except Exception as e:             # noqa: BLE001 — keep 1M record
+            log(f"[bench] 8.8M child run failed ({e}); keeping the "
+                f"{n_docs}-doc headline")
+            child = None
+        if child is not None and child.get("recall_ok"):
+            record = {
+                "metric": "bm25_top1000_qps_per_chip",
+                "value": child["value"],
+                "unit": "qps",
+                "vs_baseline": child["vs_baseline"],
+                "recall_ok": bool(recall_ok and child["recall_ok"]),
+                # oracle recall gate rode the ≤2M run; the 8.8M run is
+                # engine-vs-kernel parity-checked
+                "oracle_recall_at_k": oracle_recall,
+                "corpus_mode": "msmarco",
+                "device": child["device"],
+                "n_docs": child["n_docs"],
+                "cpu_baseline_qps": child["cpu_baseline_qps"],
+                "engine": child["engine"],
+                "kernel": child["kernel"],
+                "kernel_qps": child["kernel_qps"],
+                "corpora": {
+                    f"zipf_{n_docs // 1_000_000}m": {
+                        k_: v_ for k_, v_ in record.items()
+                        if k_ != "metric"},
+                    "msmarco_8m8": {
+                        k_: v_ for k_, v_ in child.items()
+                        if k_ != "metric"},
+                },
+            }
+
+    print(json.dumps(record))
     # the parity check gates the metric: a fast-but-wrong result must not
     # be recorded as a pass
-    return 0 if recall_ok else 1
+    return 0 if record["recall_ok"] else 1
 
 
 if __name__ == "__main__":

@@ -739,7 +739,16 @@ class AnalysisRegistry:
                 raw_filters = [f.strip() for f in raw_filters.split(",") if f.strip()]
             for fname in raw_filters:
                 if fname not in self.filters:
-                    raise IllegalArgumentError(f"unknown filter [{fname}] for analyzer [{name}]")
+                    # bare factory names act as pre-configured filters
+                    # with default params (how the reference exposes
+                    # plugin filters like kuromoji_baseform directly)
+                    if fname in TOKEN_FILTER_FACTORIES:
+                        self.filters[fname] = \
+                            TOKEN_FILTER_FACTORIES[fname]({})
+                    else:
+                        raise IllegalArgumentError(
+                            f"unknown filter [{fname}] for analyzer "
+                            f"[{name}]")
                 filters.append(self.filters[fname])
             self.analyzers[name] = Analyzer(name, self.tokenizers[tok_name], filters)
 

@@ -82,10 +82,12 @@ class DeviceGeoField:
 
 @dataclass
 class DeviceShapeField:
-    lats: Any        # [Np, V] f32 closed rings
+    lats: Any        # [Np, V] f32 concatenated rings
     lons: Any        # [Np, V] f32
-    nv: Any          # [Np] i32 edge count
+    nv: Any          # [Np] i32 edge slots
     exists: Any
+    rid: Any         # [Np, V] i32 ring id (-1 pad)
+    area: Any        # [Np, V] bool — ring encloses area
     column: Any
 
 
@@ -222,6 +224,7 @@ class DeviceReader:
                for name, c in seg.geo_fields.items()}
         shape = {name: DeviceShapeField(lats=put(c.lats), lons=put(c.lons),
                                         nv=put(c.nv), exists=put(c.exists),
+                                        rid=put(c.rid), area=put(c.area),
                                         column=c)
                  for name, c in seg.shape_fields.items()}
         nested = {}

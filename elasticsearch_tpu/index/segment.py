@@ -144,16 +144,31 @@ class GeoFieldColumn:
 
 @dataclass
 class ShapeFieldColumn:
-    """geo_shape doc values: each doc's shape as a CLOSED vertex ring
-    (point → 1 edge, envelope → 4, polygon → its outer ring; built by
-    utils/geoshape.parse_shape), padded to the column-wide max. Relations
-    run as dense polygon tests on device (ops/geoshape.py) — the
-    TPU-native replacement for the reference's geohash prefix-tree index
+    """geo_shape doc values: each doc's shape as concatenated vertex
+    RINGS (built by utils/geoshape.parse_shape_rings — polygon outer +
+    hole rings, multipolygon members, line runs, degenerate point
+    rings), padded to the column-wide max. ``rid`` gates edges to
+    same-ring neighbours and ``area`` marks rings that enclose area
+    (even-odd parity ignores line runs). Relations run as dense
+    multi-ring tests on device (ops/geoshape.py) — the TPU-native
+    replacement for the reference's geohash prefix-tree index
     (core/index/mapper/geo/GeoShapeFieldMapper.java)."""
-    lats: np.ndarray                 # [Np, V] float32, ring closed
+    lats: np.ndarray                 # [Np, V] float32
     lons: np.ndarray                 # [Np, V] float32
-    nv: np.ndarray                   # [Np] int32 edge count (0 = none)
+    nv: np.ndarray                   # [Np] int32 edge slots (verts - 1)
     exists: np.ndarray               # [Np] bool
+    rid: np.ndarray | None = None    # [Np, V] int32 ring id (-1 pad)
+    area: np.ndarray | None = None   # [Np, V] bool
+
+    def __post_init__(self):
+        if self.rid is None:
+            # legacy single-ring columns: one ring over the nv window
+            self.rid = np.where(
+                np.arange(self.lats.shape[1])[None, :] <=
+                self.nv[:, None], 0, -1).astype(np.int32)
+            self.rid[~self.exists] = -1
+        if self.area is None:
+            self.area = self.rid >= 0
 
 
 @dataclass
@@ -206,7 +221,8 @@ class Segment:
         for col in self.geo_fields.values():
             total += col.lat.nbytes + col.lon.nbytes
         for col in self.shape_fields.values():
-            total += col.lats.nbytes + col.lons.nbytes + col.nv.nbytes
+            total += col.lats.nbytes + col.lons.nbytes + col.nv.nbytes \
+                + col.rid.nbytes + col.area.nbytes
         for blk in self.nested_blocks.values():
             total += blk.segment.memory_bytes() + blk.parent.nbytes
         return total
@@ -307,6 +323,8 @@ class Segment:
             arrays[f"s.{name}.lons"] = c.lons
             arrays[f"s.{name}.nv"] = c.nv
             arrays[f"s.{name}.exists"] = c.exists
+            arrays[f"s.{name}.rid"] = c.rid
+            arrays[f"s.{name}.area"] = c.area
 
         meta["nested"] = sorted(self.nested_blocks)
         for p, blk in self.nested_blocks.items():
@@ -365,10 +383,15 @@ class Segment:
                                  exists=arrays[f"g.{name}.exists"])
             for name in meta["geo_fields"]}
         shape_fields = {
-            name: ShapeFieldColumn(lats=arrays[f"s.{name}.lats"],
-                                   lons=arrays[f"s.{name}.lons"],
-                                   nv=arrays[f"s.{name}.nv"],
-                                   exists=arrays[f"s.{name}.exists"])
+            name: ShapeFieldColumn(
+                lats=arrays[f"s.{name}.lats"],
+                lons=arrays[f"s.{name}.lons"],
+                nv=arrays[f"s.{name}.nv"],
+                exists=arrays[f"s.{name}.exists"],
+                # pre-round-5 stores lack ring ids; __post_init__
+                # derives the legacy single-ring layout
+                rid=arrays.get(f"s.{name}.rid"),
+                area=arrays.get(f"s.{name}.area"))
             for name in meta.get("shape_fields", [])}
         nested_blocks = {
             p: NestedBlock(segment=Segment.read(path / f"nested_{p}"),
@@ -597,17 +620,22 @@ class SegmentBuilder:
                 vmax = max(vmax, len(ring[0]))
         lats = np.zeros((np_docs, vmax), np.float32)
         lons = np.zeros((np_docs, vmax), np.float32)
+        rid = np.full((np_docs, vmax), -1, np.int32)
+        area = np.zeros((np_docs, vmax), bool)
         nv = np.zeros(np_docs, np.int32)
         exists = np.zeros(np_docs, bool)
         for i, ring in enumerate(rings):
             if ring is None:
                 continue
-            rl, ro = ring
+            rl, ro, rr, ra = ring
             lats[i, :len(rl)] = rl
             lons[i, :len(ro)] = ro
+            rid[i, :len(rr)] = rr
+            area[i, :len(ra)] = ra
             nv[i] = len(rl) - 1
             exists[i] = True
-        return ShapeFieldColumn(lats=lats, lons=lons, nv=nv, exists=exists)
+        return ShapeFieldColumn(lats=lats, lons=lons, nv=nv,
+                                exists=exists, rid=rid, area=area)
 
 
 def merge_segments(seg_id: int, segments: Iterable[Segment],

@@ -359,13 +359,13 @@ class FieldMapper:
                     f"got shape {arr.shape}")
             pf.vector = arr
         elif self.kind == KIND_SHAPE:
-            from elasticsearch_tpu.utils.geoshape import parse_shape
+            from elasticsearch_tpu.utils.geoshape import parse_shape_rings
             v = value if isinstance(value, dict) else values[0]
             if not isinstance(v, dict):
                 raise MapperParsingError(
                     f"cannot parse geo_shape [{value!r}]")
             try:
-                pf.shape = parse_shape(v)
+                pf.shape = parse_shape_rings(v)
             except Exception as e:
                 raise MapperParsingError(
                     f"failed to parse geo_shape [{self.name}]: {e}") \

@@ -99,7 +99,10 @@ def geo_polygon(lat, lon, exists, vlats, vlons):
     GeoPolygonQueryParser → GeoPolygonQuery). vlats/vlons: [V] f32 vertex
     ring (closed implicitly; the shared kernel wants an explicit closing
     vertex)."""
-    from elasticsearch_tpu.ops.geoshape import _points_in_query_ring
+    from elasticsearch_tpu.ops.geoshape import _points_in_query_shape
     qlats = jnp.concatenate([vlats, vlats[:1]])
     qlons = jnp.concatenate([vlons, vlons[:1]])
-    return exists & _points_in_query_ring(lat, lon, qlats, qlons)
+    qrid = jnp.zeros(qlats.shape[0], jnp.int32)
+    qarea = jnp.ones(qlats.shape[0], bool)
+    return exists & _points_in_query_shape(lat, lon, qlats, qlons,
+                                           qrid, qarea)

@@ -1,14 +1,21 @@
 """geo_shape relation kernels (ref: core/index/query/GeoShapeQueryParser
 .java; the reference indexes shapes into a geohash prefix tree and runs
-Lucene spatial queries — here shapes are doc-value vertex rings and the
-four relations are exact dense polygon tests, looped over query edges so
-intermediates stay [N, V]).
+Lucene spatial queries — here shapes are doc-value MULTI-RING vertex
+soups and the four relations are exact dense tests, looped over query
+edges so intermediates stay [N, V]).
 
-Doc shapes: ``lats``/``lons`` [N, V] f32 closed rings (vertex nv == vertex
-0), ``nv`` [N] i32 edge counts, ``exists`` [N] bool. Query shape: closed
-ring constants [E+1]. All tests treat boundary contact as intersection
-(inclusive orientation ≤ 0), matching the reference's default
-``intersects`` looseness at cell resolution.
+Doc shapes: ``lats``/``lons`` [N, V] f32 concatenated rings, ``rid``
+[N, V] i32 ring ids (edges exist only between same-rid neighbours; -1 =
+pad), ``area`` [N, V] bool (ring encloses area — line runs do not),
+``nv`` [N] i32 edge slots, ``exists`` [N] bool. Query shape: constant
+arrays of the same layout from utils/geoshape.parse_shape_rings.
+
+Inside-ness is GLOBAL EVEN-ODD parity over area-ring edges: polygon
+holes flip parity back out, multipolygon members flip it in — so
+polygon-with-holes and multi-geometries need no decomposition
+(PolygonBuilder/MultiPolygonBuilder semantics). All edge tests treat
+boundary contact as intersection (inclusive orientation ≤ 0), matching
+the reference's default ``intersects`` looseness at cell resolution.
 """
 
 from __future__ import annotations
@@ -21,17 +28,23 @@ def _orient(ax, ay, bx, by, cx, cy):
     return (bx - ax) * (cy - ay) - (by - ay) * (cx - ax)
 
 
-def _doc_edges(dlats, dlons, dnv):
+def _doc_edges(dlats, dlons, dnv, drid):
     a_lat, a_lon = dlats[:, :-1], dlons[:, :-1]
     b_lat, b_lon = dlats[:, 1:], dlons[:, 1:]
-    valid = jnp.arange(dlats.shape[1] - 1)[None, :] < dnv[:, None]
+    valid = (jnp.arange(dlats.shape[1] - 1)[None, :] < dnv[:, None]) & \
+        (drid[:, :-1] == drid[:, 1:]) & (drid[:, :-1] >= 0)
     return a_lat, a_lon, b_lat, b_lon, valid
 
 
-def _edge_cross_any(dlats, dlons, dnv, qlats, qlons):
+def _qedge_valid(qrid, i):
+    return qrid[i] == qrid[i + 1]
+
+
+def _edge_cross_any(dlats, dlons, dnv, drid, qlats, qlons, qrid):
     """[N] — any doc edge intersects any query edge (segment–segment
     orientation test, inclusive of collinear touch)."""
-    a_lat, a_lon, b_lat, b_lon, valid = _doc_edges(dlats, dlons, dnv)
+    a_lat, a_lon, b_lat, b_lon, valid = _doc_edges(dlats, dlons, dnv,
+                                                   drid)
     e = qlats.shape[0] - 1
 
     def body(i, acc):
@@ -41,16 +54,31 @@ def _edge_cross_any(dlats, dlons, dnv, qlats, qlons):
         o2 = _orient(a_lon, a_lat, b_lon, b_lat, d_lon, d_lat)
         o3 = _orient(c_lon, c_lat, d_lon, d_lat, a_lon, a_lat)
         o4 = _orient(c_lon, c_lat, d_lon, d_lat, b_lon, b_lat)
-        hit = (o1 * o2 <= 0) & (o3 * o4 <= 0) & valid
+        hit = (o1 * o2 <= 0) & (o3 * o4 <= 0)
+        # all four orientations zero = collinear (incl. any degenerate
+        # point edge on the other edge's LINE): the orientation test is
+        # vacuous there — require 1-D bounding-interval overlap on both
+        # axes or distant collinear segments false-positive
+        collinear = (o1 == 0) & (o2 == 0) & (o3 == 0) & (o4 == 0)
+        q_lat_lo, q_lat_hi = jnp.minimum(c_lat, d_lat), \
+            jnp.maximum(c_lat, d_lat)
+        q_lon_lo, q_lon_hi = jnp.minimum(c_lon, d_lon), \
+            jnp.maximum(c_lon, d_lon)
+        bbox = (jnp.minimum(a_lat, b_lat) <= q_lat_hi) & \
+            (jnp.maximum(a_lat, b_lat) >= q_lat_lo) & \
+            (jnp.minimum(a_lon, b_lon) <= q_lon_hi) & \
+            (jnp.maximum(a_lon, b_lon) >= q_lon_lo)
+        hit = hit & jnp.where(collinear, bbox, True) & valid & \
+            _qedge_valid(qrid, i)
         return acc | hit.any(axis=1)
 
     return jax.lax.fori_loop(0, e, body,
                              jnp.zeros(dlats.shape[0], bool))
 
 
-def _points_in_query_ring(plats, plons, qlats, qlons):
-    """Even-odd ray cast of arbitrary-shape point arrays against the
-    query ring → bool array of plats' shape."""
+def _points_in_query_shape(plats, plons, qlats, qlons, qrid, qarea):
+    """Global even-odd ray cast of point arrays against the query's
+    AREA rings → bool array of plats' shape."""
     e = qlats.shape[0] - 1
 
     def body(i, parity):
@@ -59,46 +87,74 @@ def _points_in_query_ring(plats, plons, qlats, qlons):
         crosses = (yi > plats) != (yj > plats)
         xcross = (xj - xi) * (plats - yi) / jnp.where(
             yj - yi == 0, 1e-30, yj - yi) + xi
-        return parity ^ (crosses & (plons < xcross))
+        gate = _qedge_valid(qrid, i) & qarea[i]
+        return parity ^ (crosses & (plons < xcross) & gate)
 
     return jax.lax.fori_loop(0, e, body, jnp.zeros(plats.shape, bool))
 
 
-def _query_point_in_doc_rings(qlat, qlon, dlats, dlons, dnv):
-    """[N] — the query ring's first vertex inside each doc's ring."""
-    a_lat, a_lon, b_lat, b_lon, valid = _doc_edges(dlats, dlons, dnv)
+def _query_point_in_doc_shapes(qlat, qlon, dlats, dlons, dnv, drid,
+                               darea):
+    """[N] — one query vertex inside each doc's area rings (even-odd)."""
+    a_lat, a_lon, b_lat, b_lon, valid = _doc_edges(dlats, dlons, dnv,
+                                                   drid)
+    valid = valid & darea[:, :-1]
     crosses = ((a_lat > qlat) != (b_lat > qlat)) & valid
     xcross = (b_lon - a_lon) * (qlat - a_lat) / jnp.where(
         b_lat - a_lat == 0, 1e-30, b_lat - a_lat) + a_lon
     return (crosses & (qlon < xcross)).sum(axis=1) % 2 == 1
 
 
-def shape_relation(dlats, dlons, dnv, exists, qlats, qlons,
-                   relation: str):
-    """→ [N] bool mask for intersects / disjoint / within / contains."""
-    cross = _edge_cross_any(dlats, dlons, dnv, qlats, qlons)
-    doc0_in_q = _points_in_query_ring(dlats[:, 0], dlons[:, 0],
-                                      qlats, qlons)
-    q0_in_doc = _query_point_in_doc_rings(qlats[0], qlons[0],
-                                          dlats, dlons, dnv)
-    inter = cross | doc0_in_q | q0_in_doc
+def _ring_starts_np(qrid):
+    """Host-side: index of each ring's first vertex (qrid is a host
+    numpy constant at trace time)."""
+    import numpy as np
+    qrid = np.asarray(qrid)
+    return [int(i) for i in range(len(qrid))
+            if i == 0 or qrid[i] != qrid[i - 1]]
+
+
+def shape_relation(dlats, dlons, dnv, exists, drid, darea,
+                   qlats, qlons, qrid_np, qarea_np, relation: str):
+    """→ [N] bool mask for intersects / disjoint / within / contains.
+
+    ``qrid_np``/``qarea_np`` are HOST numpy constants (ring structure is
+    static per query); the vertex coordinates ride the const table."""
+    qrid = jnp.asarray(qrid_np)
+    qarea = jnp.asarray(qarea_np)
+    cross = _edge_cross_any(dlats, dlons, dnv, drid, qlats, qlons, qrid)
+    # one representative vertex PER doc ring inside the query (a doc
+    # member ring wholly inside the query intersects it even when the
+    # doc's first ring does not)
+    vparity_all = _points_in_query_shape(dlats, dlons, qlats, qlons,
+                                         qrid, qarea)
+    ring_start = (drid >= 0) & jnp.concatenate(
+        [jnp.ones((dlats.shape[0], 1), bool),
+         drid[:, 1:] != drid[:, :-1]], axis=1)
+    doc0_in_q = (vparity_all & ring_start).any(axis=1)
+    # one representative vertex PER query ring inside the doc (a
+    # multipolygon member or hole wholly inside the doc intersects it
+    # even when the first ring does not)
+    q_in_doc = jnp.zeros(dlats.shape[0], bool)
+    for start in _ring_starts_np(qrid_np):
+        q_in_doc = q_in_doc | _query_point_in_doc_shapes(
+            qlats[start], qlons[start], dlats, dlons, dnv, drid, darea)
+    inter = cross | doc0_in_q | q_in_doc
     if relation == "intersects":
         return exists & inter
     if relation == "disjoint":
         return exists & ~inter
     if relation == "within":
-        # every doc vertex inside the query ring, no boundary crossing
-        vparity = _points_in_query_ring(dlats, dlons, qlats, qlons)
-        vvalid = jnp.arange(dlats.shape[1])[None, :] <= dnv[:, None]
-        all_in = jnp.where(vvalid, vparity, True).all(axis=1)
+        # every doc vertex inside the query shape, no boundary crossing
+        all_in = jnp.where(drid >= 0, vparity_all, True).all(axis=1)
         return exists & all_in & ~cross
     if relation == "contains":
-        # every query vertex inside the doc ring, no boundary crossing
+        # every query vertex inside the doc shape, no boundary crossing
         e = qlats.shape[0] - 1
 
         def body(i, acc):
-            return acc & _query_point_in_doc_rings(
-                qlats[i], qlons[i], dlats, dlons, dnv)
+            return acc & _query_point_in_doc_shapes(
+                qlats[i], qlons[i], dlats, dlons, dnv, drid, darea)
         all_in = jax.lax.fori_loop(0, e, body,
                                    jnp.ones(dlats.shape[0], bool))
         return exists & all_in & ~cross

@@ -9,11 +9,14 @@ starting at ``p`` (``INF`` when no span starts there). All combinators are
 dense [N, L] array ops — no per-doc iteration.
 
 Exactness: unit-width leaves (span_term, span_multi expansions and
-span_or over them) make every combinator exact. Clauses that produce
-multi-width span sets (a sloppy span_near nested inside another
-combinator) are represented by their minimal span per start — a
-documented approximation (the non-minimal alternatives are dropped, like
-keeping only the first span per start position).
+span_or over them) make every combinator exact — including unordered
+span_near, which composes arbitrarily (NearSpansUnordered semantics:
+window width minus total span length ≤ slop, anchored at clause
+starts). Clauses that produce multi-width span sets (a sloppy
+span_near nested inside another combinator) are represented by their
+minimal span per start — a documented approximation (the non-minimal
+alternatives are dropped, like keeping only the first span per start
+position).
 """
 
 from __future__ import annotations
@@ -65,6 +68,37 @@ def _first_start_from(ends):
     pos = jnp.arange(ends.shape[1], dtype=jnp.int32)[None, :]
     idx = jnp.where(ends < INF, pos, INF)
     return jax.lax.cummin(idx, axis=1, reverse=True)
+
+
+def near_unordered_ends(ends_list, slop: int):
+    """Unordered near over span clauses → min-end map (SpanNearQuery
+    in_order=false, NearSpansUnordered): a window starts at ``p`` when
+    some clause's span starts exactly at p and EVERY clause has a span
+    inside the window; the Lucene slop criterion is
+    (window_end − window_start) − Σ span widths ≤ slop. Each clause
+    greedily takes its earliest span starting ≥ p (exact for unit-width
+    clauses, minimal-span approximation for nested multi-width ones —
+    the same representation discipline as the ordered combinator)."""
+    L = ends_list[0].shape[1]
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    window_end = None
+    total_len = jnp.zeros_like(ends_list[0])
+    anchored = jnp.zeros(ends_list[0].shape, bool)
+    valid = jnp.ones(ends_list[0].shape, bool)
+    for ek in ends_list:
+        fk = _first_start_from(ek)           # earliest start ≥ p
+        sk = fk
+        e_at = jnp.where(
+            sk < INF,
+            jnp.take_along_axis(ek, jnp.clip(sk, 0, L - 1), axis=1), INF)
+        valid = valid & (sk < INF)
+        anchored = anchored | (ek < INF)     # a span starts AT p
+        window_end = e_at if window_end is None \
+            else jnp.maximum(window_end, e_at)
+        total_len = total_len + jnp.where(sk < INF, e_at - sk, 0)
+    ok = valid & anchored & \
+        (window_end - pos - total_len <= jnp.int32(slop))
+    return jnp.where(ok, window_end, INF)
 
 
 def near_ordered_ends(ends_list, slop: int):

@@ -220,6 +220,11 @@ class KuromojiAnalysisPlugin(Plugin):
             "kuromoji", morph_ja.kuromoji_tokenizer, list(chain))
         registry.analyzers["kuromoji_search"] = Analyzer(
             "kuromoji_search", morph_ja.kuromoji_tokenizer, list(chain))
+        # the tokenizer itself is a registered component so CUSTOM
+        # analyzers can compose it (KuromojiAnalysisBinderProcessor
+        # registers "kuromoji_tokenizer" the same way)
+        registry.tokenizers["kuromoji_tokenizer"] = \
+            morph_ja.kuromoji_tokenizer
         registry.filter_factories["kuromoji_baseform"] = \
             lambda params: morph_ja.kuromoji_baseform_filter
         registry.filter_factories["kuromoji_stemmer"] = \
@@ -239,6 +244,8 @@ class SmartcnAnalysisPlugin(Plugin):
         from elasticsearch_tpu.plugin_pack import morph_zh
         registry.analyzers["smartcn"] = Analyzer(
             "smartcn", morph_zh.smartcn_tokenizer)
+        registry.tokenizers["smartcn_tokenizer"] = \
+            morph_zh.smartcn_tokenizer
         registry.analyzers.setdefault(
             "cjk", Analyzer("cjk", cjk_bigram_tokenizer))
 

@@ -3,12 +3,14 @@
 The reference plugin (plugins/analysis-kuromoji) wraps Lucene's kuromoji:
 a word lattice over a dictionary + per-edge costs, solved by Viterbi.
 This module implements the SAME machinery — dictionary lattice, unknown-
-word generation by character class, Viterbi min-cost path — with a
-compact embedded lexicon (function words, auxiliaries, common content
-words incl. frequent conjugations) instead of the 12 MB IPADIC binary.
-Unknown text degrades to character-class chunks (katakana/Latin/digit
-runs stay whole; kanji runs split 1-2 chars), which is also what kuromoji
-does for out-of-vocabulary words via its character definitions.
+word generation by character class, Viterbi min-cost path — over a
+GENERATED dictionary-scale lexicon (plugin_pack/ja_lexicon.py: ~2.3k
+hand-authored lemmas expanded by exact rule conjugation to >16k surface
+forms with per-class costs) instead of the 12 MB IPADIC binary, which a
+zero-egress build cannot vendor. Unknown text degrades to
+character-class chunks (katakana/Latin/digit runs stay whole; kanji runs
+split 1-2 chars), which is also what kuromoji does for out-of-vocabulary
+words via its character definitions.
 """
 
 from __future__ import annotations
@@ -16,58 +18,14 @@ from __future__ import annotations
 import unicodedata
 
 from elasticsearch_tpu.analysis.analyzers import Token
+from elasticsearch_tpu.plugin_pack import ja_lexicon
 
-# ---------------------------------------------------------------------------
-# Embedded lexicon: term → (cost, pos). Lower cost wins. POS tags: p =
-# particle, aux = auxiliary/copula, n = noun, v = verb (incl. common
+# Lexicon: term → (cost, pos). Lower cost wins. POS tags: p = particle,
+# aux = auxiliary/copula, n = noun, v = verb (incl. generated
 # conjugations), adj = adjective, adv = adverb, pron = pronoun.
-# ---------------------------------------------------------------------------
-
-_LEX: dict[str, tuple[int, str]] = {}
-
-
-def _add(pos: str, cost: int, words: str) -> None:
-    for w in words.split():
-        _LEX[w] = (cost, pos)
-
-
-_add("p", 100, "は が を に で と も の へ や から まで より ので のに ね よ か な って")
-_add("aux", 120, "です ます でした ました ません でしょう だ だった である います いました "
-     "いる いた ある あった ない なかった たい たかった れる られる せる させる")
-_add("pron", 200, "私 僕 俺 君 彼 彼女 これ それ あれ どれ ここ そこ あそこ どこ 誰 何")
-_add("n", 250, "日本 東京 大阪 京都 学校 学生 先生 会社 会社員 電車 時間 今日 明日 昨日 "
-     "天気 映画 音楽 料理 寿司 犬 猫 人 車 本 水 山 川 空 海 朝 昼 夜 年 月 日 週 "
-     "言葉 日本語 英語 名前 仕事 家 店 駅 道 町 国 世界 問題 検索 情報 技術 開発")
-# administrative suffixes: cheap enough that 東京+都 beats 東+京都
-_add("n", 380, "都 県 市 区 村 駅前 大学")
-# verb base form → its common conjugations; both directions feed the
-# lexicon, and the mapping backs the kuromoji_baseform token filter
-_VERB_GROUPS = {
-    "行く": "行き 行きます 行った 行って",
-    "来る": "来ます 来た 来て",
-    "見る": "見ます 見た 見て",
-    "食べる": "食べます 食べた 食べて",
-    "飲む": "飲みます 飲んだ",
-    "買う": "買います 買った 買いました",
-    "読む": "読みます 読んだ",
-    "書く": "書きます 書いた",
-    "話す": "話します 話した",
-    "聞く": "聞きます 聞いた",
-    "する": "します した して",
-    "思う": "思います 思った",
-    "分かる": "分かります 分かった",
-    "使う": "使います",
-    "住む": "住みます 住んだ 住んで",
-    "働く": "働きます 働いた",
-}
-BASEFORMS: dict[str, str] = {
-    conj: base for base, conjs in _VERB_GROUPS.items()
-    for conj in conjs.split()}
-for _base, _conjs in _VERB_GROUPS.items():
-    _add("v", 300, _base + " " + _conjs)
-_add("adj", 300, "高い 安い 大きい 小さい 新しい 古い 良い 悪い 早い 遅い 美しい おいしい "
-     "楽しい 難しい 易しい 暑い 寒い")
-_add("adv", 300, "とても すこし 少し たくさん もう まだ よく いつも")
+# BASEFORMS maps every generated conjugated form back to its dictionary
+# form — it backs the kuromoji_baseform token filter.
+_LEX, BASEFORMS = ja_lexicon.build()
 
 _MAX_WORD = max(len(w) for w in _LEX)
 

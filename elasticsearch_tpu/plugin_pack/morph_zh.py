@@ -3,11 +3,17 @@
 The reference plugin (plugins/analysis-smartcn) wraps Lucene's
 SmartChineseAnalyzer (hidden-Markov segmentation over a bigram
 dictionary). This module implements **bidirectional maximum matching**
-over an embedded lexicon — forward and backward greedy passes with the
-classic disambiguation rule (fewer words, then fewer single-character
-words, then prefer the backward pass) — a real dictionary segmenter with
-the standard BMM accuracy profile, no 2 MB model file. Out-of-vocabulary
-characters emit as singletons; Latin/digit runs stay whole.
+over a dictionary-scale lexicon — forward and backward greedy passes
+with the classic disambiguation rule (fewer words, then fewer
+single-character words, then prefer the backward pass) — a real
+dictionary segmenter with the standard BMM accuracy profile.
+
+Lexicon: the embedded ~150-word seed is augmented at first use with the
+MIT-licensed word list shipped by the locally-installed ``jieba``
+package (~46k multi-character Han words at frequency ≥ 50, length 2-6),
+loaded lazily so package import stays instant and degrading gracefully
+to the seed when jieba is absent. Out-of-vocabulary characters emit as
+singletons; Latin/digit runs stay whole.
 """
 
 from __future__ import annotations
@@ -26,8 +32,12 @@ _WORDS = """
 饭店 餐厅 咖啡 米饭 面条 水果 苹果 香蕉 牛奶 鸡蛋 早上 上午 中午 下午 晚上 星期
 """
 
-_LEX: frozenset[str] = frozenset(w for w in _WORDS.split())
-_MAX_WORD = max(len(w) for w in _LEX)
+_SEED: frozenset[str] = frozenset(w for w in _WORDS.split())
+
+_MIN_FREQ = 50
+_MAX_LEN = 6
+
+_lex_cache: tuple[frozenset, int] | None = None
 
 
 def _is_han(c: str) -> bool:
@@ -35,13 +45,46 @@ def _is_han(c: str) -> bool:
     return 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF
 
 
+def _lexicon() -> tuple[frozenset, int]:
+    """Lazy (seed ∪ jieba dict.txt) lexicon + its max word length."""
+    global _lex_cache
+    if _lex_cache is not None:
+        return _lex_cache
+    words = set(_SEED)
+    try:
+        import os
+
+        import jieba
+        path = os.path.join(os.path.dirname(jieba.__file__), "dict.txt")
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) < 2:
+                    continue
+                w = parts[0]
+                if not (2 <= len(w) <= _MAX_LEN) or \
+                        not all(_is_han(c) for c in w):
+                    continue
+                try:
+                    freq = int(parts[1])
+                except ValueError:
+                    continue
+                if freq >= _MIN_FREQ:
+                    words.add(w)
+    except Exception:                 # noqa: BLE001 — seed-only fallback
+        pass
+    _lex_cache = (frozenset(words), max(len(w) for w in words))
+    return _lex_cache
+
+
 def _fmm(text: str) -> list[str]:
+    lex, max_word = _lexicon()
     out = []
     i = 0
     n = len(text)
     while i < n:
-        for ln in range(min(_MAX_WORD, n - i), 0, -1):
-            if ln == 1 or text[i:i + ln] in _LEX:
+        for ln in range(min(max_word, n - i), 0, -1):
+            if ln == 1 or text[i:i + ln] in lex:
                 out.append(text[i:i + ln])
                 i += ln
                 break
@@ -49,11 +92,12 @@ def _fmm(text: str) -> list[str]:
 
 
 def _bmm(text: str) -> list[str]:
+    lex, max_word = _lexicon()
     out = []
     j = len(text)
     while j > 0:
-        for ln in range(min(_MAX_WORD, j), 0, -1):
-            if ln == 1 or text[j - ln:j] in _LEX:
+        for ln in range(min(max_word, j), 0, -1):
+            if ln == 1 or text[j - ln:j] in lex:
                 out.append(text[j - ln:j])
                 j -= ln
                 break

@@ -111,7 +111,12 @@ class Plugin:
 class PluginsService:
     def __init__(self, specs) -> None:
         """`specs`: iterable of Plugin instances, Plugin subclasses, or
-        "module.path:ClassName" strings (the settings form)."""
+        "module.path:ClassName" strings (the settings form). A plain
+        comma-separated string is accepted too — the shape a standalone
+        ``estpu -E plugins=mod:Cls,mod:Cls2`` process produces (the
+        reference's config-file plugin list, bin/plugin install)."""
+        if isinstance(specs, str):
+            specs = [s.strip() for s in specs.split(",") if s.strip()]
         self.plugins: list[Plugin] = []
         for spec in specs or []:
             self.plugins.append(self._load(spec))

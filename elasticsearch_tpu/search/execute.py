@@ -1167,24 +1167,23 @@ class SegmentResolver:
             return emit, sum_idf, field
 
         if t == "SpanNearQuery":
-            if not query.in_order:
-                raise QueryParsingError(
-                    "unordered span_near cannot nest inside other span "
-                    "queries (its span set is not single-interval)")
             plans = [self._span_ends(c) for c in query.clauses]
             if any(p is None for p in plans) or not plans:
                 return None
             sum_idf = sum(p[1] for p in plans)
             field = plans[0][2]
             slop = int(query.slop)
-            self.sig("span-near-ends", len(plans), slop)
+            in_order = bool(query.in_order)
+            self.sig("span-near-ends", len(plans), slop, in_order)
             emits = [p[0] for p in plans]
+            near = span_ops.near_ordered_ends if in_order \
+                else span_ops.near_unordered_ends
 
             def emit(em):
                 maps = [e(em) for e in emits]
                 L = max(m.shape[1] for m in maps)
-                return span_ops.near_ordered_ends(
-                    [span_ops.pad_ends(m, L) for m in maps], slop)
+                return near([span_ops.pad_ends(m, L) for m in maps],
+                            slop)
             return emit, sum_idf, field
 
         if t == "SpanNotQuery":
@@ -1733,24 +1732,30 @@ class SegmentResolver:
 
     def _res_GeoShapeQuery(self, query: q.GeoShapeQuery) -> Emit:
         from elasticsearch_tpu.ops import geoshape as shape_ops
-        from elasticsearch_tpu.utils.geoshape import parse_shape
+        from elasticsearch_tpu.utils.geoshape import parse_shape_rings
         field = query.field
         if self.seg.shape.get(field) is None:
             return self._zeros()
-        qlats, qlons = parse_shape(query.shape)
+        qlats, qlons, qrid, qarea = parse_shape_rings(query.shape)
         relation = query.relation
         if relation not in ("intersects", "disjoint", "within", "contains"):
             raise QueryParsingError(
                 f"unknown geo_shape relation [{relation}]")
-        self.sig("geo-shape", relation, len(qlats))
+        # ring structure is static (part of the traced program); only
+        # the vertex coordinates ride the const table
+        qrid_np = np.asarray(qrid, np.int32)
+        qarea_np = np.asarray(qarea, bool)
+        self.sig("geo-shape", relation, len(qlats),
+                 tuple(qrid), tuple(qarea))
         r_lats = self.c(np.asarray(qlats, np.float32), np.float32)
         r_lons = self.c(np.asarray(qlons, np.float32), np.float32)
         return self._constant_mask_emit(
             lambda em: shape_ops.shape_relation(
                 em.seg.shape[field].lats, em.seg.shape[field].lons,
                 em.seg.shape[field].nv, em.seg.shape[field].exists,
+                em.seg.shape[field].rid, em.seg.shape[field].area,
                 jnp.asarray(em.get(r_lats)), jnp.asarray(em.get(r_lons)),
-                relation),
+                qrid_np, qarea_np, relation),
             query.boost)
 
     def _res_IndicesQuery(self, query: q.IndicesQuery) -> Emit:

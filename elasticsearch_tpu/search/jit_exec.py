@@ -28,7 +28,9 @@ Mechanics (see execute.SegmentResolver):
 from __future__ import annotations
 
 import os
+import queue
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import replace as dc_replace
 
@@ -88,7 +90,7 @@ _ARRAYS = {
     "numeric": ("hi", "lo", "exists"),
     "vector": ("vecs", "exists"),
     "geo": ("lat", "lon", "exists"),
-    "shape": ("lats", "lons", "nv", "exists"),
+    "shape": ("lats", "lons", "nv", "exists", "rid", "area"),
 }
 
 
@@ -529,19 +531,64 @@ def run_segments_streamed(segments: list, ctx: ExecutionContext,
         # common layout share ONE compiled program across the whole sweep
         return _get_compiled(("batch",) + plan["key"], compile_fn)
 
+    # transfers run on a DEDICATED feeder thread, one segment ahead:
+    # host→HBM DMA overlaps the in-flight program's compute even when
+    # device_put itself blocks the calling thread on this interconnect —
+    # the same reason batching.py drains on worker threads. A
+    # 2-permit semaphore bounds MATERIALIZED segments to two (the
+    # over-capacity contract this path exists for); the consumer blocks
+    # on segment i−1's completion before granting the next permit, so
+    # async dispatch cannot run ahead of the device and pin every
+    # segment's buffers at once.
+    prefetch: queue.Queue = queue.Queue()
+    feed_err: list = []
+    slots = threading.Semaphore(2)
+    stop = threading.Event()
+
+    def _feeder():
+        try:
+            for plan in plans:
+                while not slots.acquire(timeout=0.25):
+                    if stop.is_set():
+                        return
+                if stop.is_set():
+                    return
+                prefetch.put([put(a) for a in plan["flat"]])
+        except Exception as e:               # noqa: BLE001 — surfaced below
+            feed_err.append(e)
+            prefetch.put(None)
+
+    feeder = threading.Thread(target=_feeder, daemon=True,
+                              name="hbm-stream-feeder")
+    feeder.start()
     outs_all = []
-    nxt = [put(a) for a in plans[0]["flat"]]
-    for i, (seg, plan) in enumerate(zip(segments, plans)):
-        cur, nxt = nxt, None
-        fn = get_fn(seg, plan)
-        packed = {dt: jnp.asarray(buf) for dt, buf in plan["packed"].items()}
-        outs = fn(cur, packed)              # async dispatch
-        if i + 1 < len(plans):
-            # enqueue the next segment's host→HBM transfer now: DMA
-            # overlaps the in-flight program's compute
-            nxt = [put(a) for a in plans[i + 1]["flat"]]
-        outs_all.append(outs)
-        del cur                             # free as soon as compute drains
+    stats = {"put_wait_s": 0.0, "dispatch_s": 0.0}
+    try:
+        for i, (seg, plan) in enumerate(zip(segments, plans)):
+            t0 = time.perf_counter()
+            cur = prefetch.get()
+            if cur is None:
+                raise feed_err[0]
+            stats["put_wait_s"] += time.perf_counter() - t0
+            fn = get_fn(seg, plan)
+            packed = {dt: jnp.asarray(buf)
+                      for dt, buf in plan["packed"].items()}
+            t1 = time.perf_counter()
+            outs = fn(cur, packed)          # async dispatch
+            stats["dispatch_s"] += time.perf_counter() - t1
+            outs_all.append(outs)
+            del cur                         # free as soon as compute drains
+            if i >= 1:
+                # segment i−1's program has fully drained → its column
+                # buffers are free; only then does a permit return so
+                # the feeder may stage segment i+1 (keeps exactly two
+                # segments materialized: i computing, i+1 staging)
+                jax.block_until_ready(outs_all[i - 1]["count"])
+                slots.release()
+    finally:
+        stop.set()                          # unblocks a waiting feeder on
+        feeder.join()                       # any consumer-side error
+    run_segments_streamed.last_stats = stats
     return outs_all
 
 

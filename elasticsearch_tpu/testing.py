@@ -32,8 +32,15 @@ class InternalTestCluster:
 
     def __init__(self, num_nodes: int = 3, base_path: str | Path | None = None,
                  settings: dict | None = None,
-                 cluster_name: str = "test-cluster"):
-        self.hub = LocalTransportHub()
+                 cluster_name: str = "test-cluster",
+                 transport: str = "local"):
+        """``transport``: "local" (in-process hub, the default) or "tcp"
+        (real sockets on free loopback ports + unicast discovery) — the
+        randomized matrix draws this so every suite exercises both wire
+        paths (InternalTestCluster.java randomizes its transport the
+        same way)."""
+        self.transport = transport
+        self.hub = LocalTransportHub() if transport == "local" else None
         self.base = Path(base_path or tempfile.mkdtemp(prefix="estpu-"))
         self.cluster_name = cluster_name
         self.settings = {**self.DEFAULT_SETTINGS, **(settings or {})}
@@ -43,6 +50,23 @@ class InternalTestCluster:
         # for exactly this reason, elect/ElectMasterService.java)
         self.settings.setdefault("discovery.zen.minimum_master_nodes",
                                  num_nodes // 2 + 1)
+        if transport == "tcp":
+            import socket as _socket
+            socks, ports = [], []
+            for _ in range(num_nodes):
+                s = _socket.socket()
+                s.bind(("127.0.0.1", 0))
+                socks.append(s)
+                ports.append(s.getsockname()[1])
+            for s in socks:
+                s.close()
+            self._tcp_ports = ports
+            self.settings.update({
+                "transport.type": "tcp",
+                "discovery.zen.ping.unicast.hosts":
+                    ",".join(f"127.0.0.1:{p}" for p in ports),
+            })
+            self.settings.setdefault("discovery.zen.publish_timeout", 3.0)
         self.nodes: list[Node] = []
         self._counter = 0
         # initial nodes start concurrently: with minimum_master_nodes > 1
@@ -60,9 +84,21 @@ class InternalTestCluster:
     def _make_node(self, **extra_settings) -> Node:
         self._counter += 1
         name = f"node-{self._counter}"
-        return Node({**self.settings, **extra_settings,
-                     "cluster.name": self.cluster_name, "node.name": name},
-                    data_path=self.base / name, transport_hub=self.hub)
+        settings = {**self.settings, **extra_settings,
+                    "cluster.name": self.cluster_name, "node.name": name}
+        if self.transport == "tcp":
+            if self._counter <= len(self._tcp_ports):
+                port = self._tcp_ports[self._counter - 1]
+            else:                        # added node: grab a fresh port
+                import socket as _socket
+                s = _socket.socket()
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+                s.close()
+                self._tcp_ports.append(port)
+            settings["transport.tcp.port"] = port
+        return Node(settings, data_path=self.base / name,
+                    transport_hub=self.hub)
 
     # ---- membership --------------------------------------------------------
 

@@ -1,0 +1,150 @@
+# -*- coding: utf-8 -*-
+"""Phrase-accurate highlighting + the postings-class passage highlighter
+(round 5; ref core/search/highlight/ — plain/PostingsHighlighter/FVH are
+all phrase-accurate; postings scores sentence passages and returns the
+best N in document order, no_match_size returns the leading passage)."""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node({}, data_path=tmp_path_factory.mktemp("hl") / "n").start()
+    n.indices_service.create_index("h", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"_doc": {"properties": {
+            "t": {"type": "text", "analyzer": "standard"}}}}})
+    long_doc = (
+        "The quick brown fox jumps over the lazy dog. "
+        "A quick meal was served after the hunt. "
+        "Foxes are clever animals that hunt at night. "
+        + "Nothing interesting happens in this sentence at all. " * 40
+        + "Finally the quick fox returned to its den near the river. "
+        "The den was warm and dry.")
+    n.index_doc("h", "1", {"t": long_doc}, refresh=True)
+    yield n
+    n.close()
+
+
+def _frags(n, body):
+    r = n.search("h", body)
+    hit = r["hits"]["hits"][0]
+    return hit.get("highlight", {}).get("t", [])
+
+
+def test_phrase_highlights_only_adjacent_occurrences(node):
+    """'quick fox' as a phrase: 'quick meal' and standalone 'Foxes'
+    sentences must NOT highlight — only the real phrase occurrence."""
+    frags = _frags(node, {
+        "query": {"match_phrase": {"t": "quick fox"}},
+        "highlight": {"fields": {"t": {}}, "number_of_fragments": 10}})
+    assert frags, "phrase must highlight its occurrence"
+    joined = " ".join(frags)
+    assert "<em>quick</em> <em>fox</em>" in joined
+    # the stray 'quick' (meal) and 'fox' (jumps) occurrences stay bare
+    assert "<em>quick</em> meal" not in joined
+    assert "brown <em>fox</em>" not in joined
+
+
+def test_plain_term_highlighting_still_matches_everywhere(node):
+    frags = _frags(node, {
+        "query": {"match": {"t": "quick"}},
+        "highlight": {"fields": {"t": {}}, "number_of_fragments": 10}})
+    assert sum(f.count("<em>quick</em>") for f in frags) >= 3
+
+
+def test_postings_passages_score_and_document_order(node):
+    """type: postings → sentence passages; the phrase sentence outranks
+    the filler; selected passages come back in document order."""
+    frags = _frags(node, {
+        "query": {"bool": {"must": [
+            {"match_phrase": {"t": "quick fox"}},
+            {"match": {"t": "den"}}]}},
+        "highlight": {"fields": {"t": {"type": "postings"}},
+                      "number_of_fragments": 2}})
+    assert len(frags) == 2
+    # document order: the phrase passage precedes the den passage
+    assert "<em>quick</em> <em>fox</em>" in frags[0]
+    assert "<em>den</em>" in frags[1]
+    # passages are sentences, not arbitrary char windows
+    assert frags[0].endswith(".")
+
+
+def test_postings_no_match_size(node):
+    frags = _frags(node, {
+        "query": {"match_all": {}},
+        "highlight": {"fields": {"t": {"type": "postings",
+                                       "no_match_size": 30}}}})
+    assert len(frags) == 1 and frags[0].startswith("The quick brown")
+    assert len(frags[0]) <= 30
+
+
+def test_span_near_highlights_within_slop(node):
+    """span_near [quick, den] slop 2 in order: only 'quick fox returned
+    to its den' region matches ('quick meal' does not)."""
+    frags = _frags(node, {
+        "query": {"span_near": {"clauses": [
+            {"span_term": {"t": "quick"}},
+            {"span_term": {"t": "den"}}], "slop": 4,
+            "in_order": True}},
+        "highlight": {"fields": {"t": {}}, "number_of_fragments": 10}})
+    joined = " ".join(frags)
+    assert "<em>quick</em>" in joined and "<em>den</em>" in joined
+    assert "<em>quick</em> meal" not in joined
+
+
+def test_fvh_type_accepted(node):
+    frags = _frags(node, {
+        "query": {"match": {"t": "fox"}},
+        "highlight": {"fields": {"t": {"type": "fvh"}},
+                      "number_of_fragments": 1}})
+    assert frags and "<em>fox" in frags[0]
+
+
+@pytest.fixture(scope="module")
+def ws_node(tmp_path_factory):
+    """Whitespace-analyzed field: tokens may CONTAIN sentence
+    punctuation ("3.5"), and span_near order-freedom matters."""
+    n = Node({}, data_path=tmp_path_factory.mktemp("hlw") / "n").start()
+    n.indices_service.create_index("w", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"_doc": {"properties": {
+            "t": {"type": "text", "analyzer": "whitespace"}}}}})
+    n.index_doc("w", "1", {"t": "version 3.5 rocks the house"},
+                refresh=True)
+    n.index_doc("w", "2", {"t": "quick fox"}, refresh=True)
+    yield n
+    n.close()
+
+
+def _wfrags(n, body, _id="1"):
+    r = n.search("w", body)
+    hits = {h["_id"]: h for h in r["hits"]["hits"]}
+    return hits[_id].get("highlight", {}).get("t", [])
+
+
+def test_passage_break_inside_token_still_highlights(ws_node):
+    """The '.' inside whitespace token '3.5' makes a sentence break
+    mid-token; the passage boundary must snap past the match span, not
+    silently drop the field from the highlight response."""
+    for typ in ("unified", "postings", "fvh"):
+        frags = _wfrags(ws_node, {
+            "query": {"match": {"t": "3.5"}},
+            "highlight": {"fields": {"t": {"type": typ}}}})
+        assert any("<em>3.5</em>" in f for f in frags), (typ, frags)
+
+
+def test_unordered_span_near_highlights_reversed_order(ws_node):
+    """span_near [fox, quick] in_order=false slop=0 matches doc
+    'quick fox' (near_unordered_ends); the highlighter must mark the
+    reversed-order occurrence, not return empty."""
+    body = {
+        "query": {"span_near": {
+            "clauses": [{"span_term": {"t": "fox"}},
+                        {"span_term": {"t": "quick"}}],
+            "slop": 0, "in_order": False}},
+        "highlight": {"fields": {"t": {}}}}
+    frags = _wfrags(ws_node, body, _id="2")
+    assert any("<em>quick</em> <em>fox</em>" in f for f in frags), frags

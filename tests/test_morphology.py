@@ -1,0 +1,203 @@
+# -*- coding: utf-8 -*-
+"""Dictionary-scale CJK morphology (round 5): the kuromoji/smartcn
+analogs run the same lattice/BMM machinery as before, but over
+dictionary-scale lexicons — morph_ja's generated ~13k surface forms
+(plugin_pack/ja_lexicon.py: lemma base x exact rule conjugation) and
+morph_zh's ~46k-word lexicon (embedded seed + the locally installed
+jieba package's MIT word list).
+
+The held-out suites below are natural sentences with DOCUMENTED expected
+segmentations (linguistically correct splits, not whatever the code
+emitted); the gate is >=90% exact sentence-level agreement, so the
+lexicons must actually cover running text, not just their own entries.
+"""
+
+from elasticsearch_tpu.plugin_pack import ja_lexicon
+from elasticsearch_tpu.plugin_pack.morph_ja import (
+    BASEFORMS, _LEX, kuromoji_baseform_filter, kuromoji_tokenizer, segment)
+from elasticsearch_tpu.plugin_pack.morph_zh import _lexicon, smartcn_tokenizer
+
+
+# ---- lexicon scale --------------------------------------------------------
+
+def test_ja_lexicon_is_dictionary_scale():
+    assert len(_LEX) >= 10_000, len(_LEX)
+    assert len(BASEFORMS) >= 8_000, len(BASEFORMS)
+    # every conjugated form maps back to a base that is itself in the
+    # lexicon (the kuromoji_baseform contract)
+    missing = [b for b in set(BASEFORMS.values()) if b not in _LEX]
+    assert not missing, missing[:10]
+
+
+def test_zh_lexicon_is_dictionary_scale():
+    lex, max_word = _lexicon()
+    assert len(lex) >= 20_000, len(lex)
+    assert 2 <= max_word <= 8
+
+
+def test_ja_conjugator_exact_forms():
+    assert "行った" in ja_lexicon.conjugate_godan("行く")
+    assert "行って" in ja_lexicon.conjugate_godan("行く")
+    assert "泳いだ" in ja_lexicon.conjugate_godan("泳ぐ")
+    assert "読んだ" in ja_lexicon.conjugate_godan("読む")
+    assert "話した" in ja_lexicon.conjugate_godan("話す")
+    assert "食べられる" in ja_lexicon.conjugate_ichidan("食べる")
+    assert "勉強しました" in ja_lexicon.conjugate_suru("勉強")
+    assert "高かった" in ja_lexicon.conjugate_i_adj("高い")
+
+
+def test_ja_baseform_filter_conflates_generated_conjugations():
+    for conj, base in (("行きました", "行く"), ("食べています"[:4] + "た", "食べる"),
+                       ("します", "する"), ("買った", "買う"),
+                       ("働いた", "働く"), ("遊んで", "遊ぶ")):
+        toks = kuromoji_tokenizer(conj)
+        out = kuromoji_baseform_filter(toks)
+        assert any(t.term == base for t in out), (conj, base,
+                                                  [t.term for t in out])
+
+
+# ---- held-out real-sentence suites ---------------------------------------
+
+JA_HELD_OUT = [
+    ("新しい技術を使って問題を解決します",
+     ["新しい", "技術", "を", "使って", "問題", "を", "解決", "します"]),
+    ("毎朝七時に起きて会社へ行きます",
+     ["毎朝", "七時", "に", "起きて", "会社", "へ", "行きます"]),
+    ("週末に友達と映画を見に行きました",
+     ["週末", "に", "友達", "と", "映画", "を", "見", "に", "行きました"]),
+    ("日本の文化に興味があります",
+     ["日本", "の", "文化", "に", "興味", "が", "あります"]),
+    ("この料理は母が作りました",
+     ["この", "料理", "は", "母", "が", "作りました"]),
+    ("電車で学校に通っています",
+     ["電車", "で", "学校", "に", "通って", "います"]),
+    ("来年の春に大学を卒業します",
+     ["来年", "の", "春", "に", "大学", "を", "卒業", "します"]),
+    ("写真を撮るのが好きです",
+     ["写真", "を", "撮る", "の", "が", "好き", "です"]),
+    ("雨が降っているので傘を持って行きます",
+     ["雨", "が", "降っている", "ので", "傘", "を", "持って", "行きます"]),
+    ("インターネットで情報を検索しました",
+     ["インターネット", "で", "情報", "を", "検索", "しました"]),
+    ("経済の状況が少しずつ変化しています",
+     ["経済", "の", "状況", "が", "少し", "ずつ", "変化", "して", "います"]),
+    ("彼女は英語と中国語を話します",
+     ["彼女", "は", "英語", "と", "中国語", "を", "話します"]),
+    ("健康のために毎日運動しています",
+     ["健康", "の", "ために", "毎日", "運動", "して", "います"]),
+    ("会議は午後三時から始まります",
+     ["会議", "は", "午後", "三時", "から", "始まります"]),
+    ("データを分析して結果を報告しました",
+     ["データ", "を", "分析", "して", "結果", "を", "報告", "しました"]),
+    ("子供たちは公園で遊んでいます",
+     ["子供", "たち", "は", "公園", "で", "遊んで", "います"]),
+    ("この本は難しくて分かりませんでした",
+     ["この", "本", "は", "難しくて", "分かりません", "でした"]),
+    ("夏休みに北海道を旅行する予定です",
+     ["夏休み", "に", "北海道", "を", "旅行", "する", "予定", "です"]),
+    ("音楽を聞きながら勉強します",
+     ["音楽", "を", "聞きながら", "勉強", "します"]),
+    ("駅の近くに新しい店ができました",
+     ["駅", "の", "近く", "に", "新しい", "店", "が", "できました"]),
+]
+
+ZH_HELD_OUT = [
+    ("我昨天买了一本新书", ["我", "昨天", "买", "了", "一本", "新书"]),
+    ("这个问题很难解决", ["这个", "问题", "很", "难", "解决"]),
+    ("上海是中国最大的城市",
+     ["上海", "是", "中国", "最大", "的", "城市"]),
+    ("他们正在开发新的搜索引擎",
+     ["他们", "正在", "开发", "新", "的", "搜索引擎"]),
+    ("学生们在图书馆看书", ["学生", "们", "在", "图书馆", "看书"]),
+    ("明天上午九点开会", ["明天", "上午", "九点", "开会"]),
+    ("互联网改变了人们的生活",
+     ["互联网", "改变", "了", "人们", "的", "生活"]),
+    ("她会说英语和法语", ["她", "会", "说", "英语", "和", "法语"]),
+    ("这家餐厅的菜很好吃", ["这家", "餐厅", "的", "菜", "很", "好吃"]),
+    ("科学技术是第一生产力",
+     ["科学技术", "是", "第一", "生产力"]),
+    ("我们需要更多的时间和资源",
+     ["我们", "需要", "更", "多", "的", "时间", "和", "资源"]),
+    ("北京的冬天很冷", ["北京", "的", "冬天", "很", "冷"]),
+    ("公司的业务发展得很快",
+     ["公司", "的", "业务", "发展", "得", "很快"]),
+    ("请把这份文件发给我",
+     ["请", "把", "这份", "文件", "发给", "我"]),
+    ("人工智能正在改变世界",
+     ["人工智能", "正在", "改变", "世界"]),
+]
+
+
+def test_ja_held_out_sentences():
+    hits, misses = 0, []
+    for sent, want in JA_HELD_OUT:
+        got = [t for t, _, _ in segment(sent)]
+        if got == want:
+            hits += 1
+        else:
+            misses.append((sent, got, want))
+    frac = hits / len(JA_HELD_OUT)
+    assert frac >= 0.9, (frac, misses[:3])
+
+
+def test_zh_held_out_sentences():
+    hits, misses = 0, []
+    for sent, want in ZH_HELD_OUT:
+        got = [t.term for t in smartcn_tokenizer(sent)]
+        if got == want:
+            hits += 1
+        else:
+            misses.append((sent, got, want))
+    frac = hits / len(ZH_HELD_OUT)
+    assert frac >= 0.9, (frac, misses[:3])
+
+
+def test_zh_seed_only_fallback_still_segments():
+    """Without jieba the seed lexicon still drives BMM (graceful
+    degradation, not a crash)."""
+    from elasticsearch_tpu.plugin_pack import morph_zh
+    saved = morph_zh._lex_cache
+    try:
+        morph_zh._lex_cache = (morph_zh._SEED,
+                               max(len(w) for w in morph_zh._SEED))
+        toks = [t.term for t in smartcn_tokenizer("我们在北京学习中文")]
+        assert "北京" in toks and "中文" in toks
+    finally:
+        morph_zh._lex_cache = saved
+
+
+def test_custom_analyzer_composes_plugin_tokenizer_and_bare_filter():
+    """A CUSTOM analyzer names the plugin's tokenizer + a bare
+    pre-configured filter factory — the composition a standalone
+    `estpu -E plugins=...` node accepts over REST (what the reference's
+    kuromoji plugin registers via its AnalysisBinderProcessor)."""
+    from elasticsearch_tpu.analysis.analyzers import (
+        AnalysisRegistry, TOKEN_FILTER_FACTORIES, TOKENIZERS)
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.plugin_pack.analysis_extra import (
+        KuromojiAnalysisPlugin)
+
+    class _Mod:
+        analyzers: dict = {}
+        tokenizers = TOKENIZERS
+        filter_factories = TOKEN_FILTER_FACTORIES
+
+    added_tok, added_filt = [], []
+    try:
+        before_t, before_f = set(TOKENIZERS), set(TOKEN_FILTER_FACTORIES)
+        KuromojiAnalysisPlugin().analysis(_Mod)
+        added_tok = [k for k in TOKENIZERS if k not in before_t]
+        added_filt = [k for k in TOKEN_FILTER_FACTORIES
+                      if k not in before_f]
+        reg = AnalysisRegistry(Settings({
+            "analysis.analyzer.ja.type": "custom",
+            "analysis.analyzer.ja.tokenizer": "kuromoji_tokenizer",
+            "analysis.analyzer.ja.filter": ["kuromoji_baseform"]}))
+        terms = reg.get("ja").terms("寿司を食べました")
+        assert "食べる" in terms          # baseform filter applied
+        assert "寿司" in terms            # lattice segmentation
+    finally:
+        for k in added_tok:
+            TOKENIZERS.pop(k, None)
+        for k in added_filt:
+            TOKEN_FILTER_FACTORIES.pop(k, None)

@@ -79,3 +79,15 @@ def test_plugin_spec_string_load(tmp_path):
     finally:
         node.close()
         query_dsl.EXTRA_PARSERS.pop("always", None)
+
+
+def test_plugin_spec_comma_string_load(tmp_path):
+    # the standalone-CLI form: `estpu -E plugins=a:X,b:Y` reaches
+    # PluginsService as ONE comma-separated string
+    node = Node({"plugins": "tests.test_plugins:_ProbePlugin"},
+                data_path=tmp_path / "n3").start()
+    try:
+        assert node.plugins_service.info()[0]["name"] == "probe"
+    finally:
+        node.close()
+        query_dsl.EXTRA_PARSERS.pop("always", None)

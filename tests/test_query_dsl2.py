@@ -162,6 +162,44 @@ class TestSpan:
             parse_query({"span_near": {"clauses": [
                 {"span_term": {"a": "x"}}, {"span_term": {"b": "y"}}]}})
 
+    def test_unordered_span_near_nests_in_span_or(self, node):
+        """Round 5 (Lucene NearSpansUnordered composes arbitrarily): an
+        unordered near inside a span_or."""
+        q = {"span_or": {"clauses": [
+            {"span_near": {"clauses": [{"span_term": {"t": "fox"}},
+                                       {"span_term": {"t": "quick"}}],
+                           "slop": 0, "in_order": False}},
+            {"span_term": {"t": "fence"}}]}}
+        # adjacent quick/fox either order: 3 ("quick fox"), 7; plus 6
+        # via the fence arm; 0/1 need slop ≥ 1 → excluded
+        assert _ids(_search(node, q)) == {"3", "6", "7"}
+
+    def test_unordered_span_near_nests_in_outer_near(self, node):
+        """Unordered inner near chained by an ordered outer near: the
+        {quick,fox} window then 'jumps' right after."""
+        q = {"span_near": {"clauses": [
+            {"span_near": {"clauses": [{"span_term": {"t": "quick"}},
+                                       {"span_term": {"t": "fox"}}],
+                           "slop": 1, "in_order": False}},
+            {"span_term": {"t": "jumps"}}], "slop": 0,
+            "in_order": True}}
+        # doc 1 "the quick red fox jumps": window [quick..fox] then
+        # jumps adjacent ✓; doc 0 has no jumps; doc 6's fox window has
+        # no quick
+        assert _ids(_search(node, q)) == {"1"}
+
+    def test_unordered_span_near_nests_in_containing(self, node):
+        q = {"span_containing": {
+            "big": {"span_near": {"clauses": [
+                {"span_term": {"t": "the"}},
+                {"span_term": {"t": "fox"}}],
+                "slop": 3, "in_order": False}},
+            "little": {"span_term": {"t": "brown"}}}}
+        # doc 0 "the quick brown fox": the..fox window contains brown ✓
+        # doc 1's window ("the quick red fox") lacks brown
+        out = _ids(_search(node, q))
+        assert "0" in out and "1" not in out
+
 
 class TestMoreLikeThis:
     def test_parse(self):

@@ -235,14 +235,68 @@ class TestGeoShape:
             "relation": "within"}}})
         assert isinstance(q, GeoShapeQuery) and q.relation == "within"
 
-    def test_polygon_holes_rejected(self, node):
-        with pytest.raises(Exception):
-            node.search("idx", {"query": {"geo_shape": {"shp": {
-                "shape": {"type": "polygon",
-                          "coordinates": [[[0, 0], [1, 0], [1, 1],
-                                           [0, 0]],
-                                          [[0.2, 0.2], [0.8, 0.2],
-                                           [0.8, 0.8], [0.2, 0.2]]]}}}}})
+    def test_polygon_with_hole_excludes_hole_interior(self, node):
+        """Round 5 (ref PolygonBuilder holes): a query polygon covering
+        8..13 with a hole over 9.5..10.5 must NOT intersect the point
+        doc at (10, 10) — it sits inside the hole — but still catches
+        the 9-11 envelope doc (which straddles the hole boundary)."""
+        holed = {"type": "polygon", "coordinates": [
+            [[8.0, 8.0], [13.0, 8.0], [13.0, 13.0], [8.0, 13.0],
+             [8.0, 8.0]],
+            [[9.5, 9.5], [10.5, 9.5], [10.5, 10.5], [9.5, 10.5],
+             [9.5, 9.5]]]}
+        r = _search(node, {"geo_shape": {"shp": {"shape": holed}}})
+        assert "0" not in _ids(r)          # point(10,10) inside the hole
+        assert "1" in _ids(r)              # envelope 9-11 crosses hole
+        # without the hole the point matches again
+        solid = {"type": "polygon",
+                 "coordinates": [holed["coordinates"][0]]}
+        r = _search(node, {"geo_shape": {"shp": {"shape": solid}}})
+        assert "0" in _ids(r)
+
+    def test_multipolygon_is_a_disjunction(self, node):
+        """Round 5 (ref MultiPolygonBuilder): two disjoint members, one
+        over the (10,10) point, one over the (2,2) region."""
+        mp = {"type": "multipolygon", "coordinates": [
+            [[[9.5, 9.5], [10.5, 9.5], [10.5, 10.5], [9.5, 10.5],
+              [9.5, 9.5]]],
+            [[[1.5, 1.5], [2.5, 1.5], [2.5, 2.5], [1.5, 2.5],
+              [1.5, 1.5]]]]}
+        r = _search(node, {"geo_shape": {"shp": {"shape": mp}}})
+        assert "0" in _ids(r)              # first member
+        assert "2" in _ids(r)              # second member (0-4 polygon)
+        assert "3" not in _ids(r)          # far away from both
+
+    def test_linestring_intersects_but_contains_nothing(self, node):
+        line = {"type": "linestring",
+                "coordinates": [[9.0, 10.0], [11.0, 10.0]]}
+        r = _search(node, {"geo_shape": {"shp": {"shape": line}}})
+        assert "1" in _ids(r)              # line crosses the envelope
+        # a line has no interior: nothing is 'within' it
+        r = _search(node, {"geo_shape": {"shp": {
+            "shape": line, "relation": "within"}}})
+        assert _ids(r) == set()
+
+    def test_multi_ring_doc_shape_round_trips(self, node):
+        """A DOC indexed as a polygon-with-hole: a query point inside
+        the doc's hole must not match intersects."""
+        node.index_doc("idx", "hole-doc", {"shp": {
+            "type": "polygon", "coordinates": [
+                [[40.0, 40.0], [50.0, 40.0], [50.0, 50.0], [40.0, 50.0],
+                 [40.0, 40.0]],
+                [[44.0, 44.0], [46.0, 44.0], [46.0, 46.0], [44.0, 46.0],
+                 [44.0, 44.0]]]}}, refresh=True)
+        try:
+            inside_hole = {"type": "point", "coordinates": [45.0, 45.0]}
+            r = _search(node, {"geo_shape": {"shp": {
+                "shape": inside_hole}}})
+            assert "hole-doc" not in _ids(r)
+            in_solid = {"type": "point", "coordinates": [41.0, 41.0]}
+            r = _search(node, {"geo_shape": {"shp": {
+                "shape": in_solid}}})
+            assert "hole-doc" in _ids(r)
+        finally:
+            node.delete_doc("idx", "hole-doc", refresh=True)
 
 
 class TestCompatWrappers:
@@ -341,3 +395,28 @@ class TestReviewRegressions:
             "_cache": True, "from": "1km", "to": "2km",
             "pin": {"lat": 1.0, "lon": 2.0}}})
         assert q.field == "pin"
+
+
+class TestGeoShapeCollinear:
+    def test_collinear_disjoint_segments_do_not_intersect(self, node):
+        """Review r5: a point doc sharing a latitude line with a distant
+        axis-aligned query edge must stay disjoint (the orientation test
+        is vacuous for collinear cases — bounds must decide)."""
+        node.index_doc("idx", "col-pt", {"shp": {
+            "type": "point", "coordinates": [100.0, 10.0]}}, refresh=True)
+        try:
+            # envelope with an edge along lat=10, lon 0..1 — far away
+            env = {"type": "envelope",
+                   "coordinates": [[0.0, 10.0], [1.0, 9.0]]}
+            r = _search(node, {"geo_shape": {"shp": {"shape": env}}})
+            assert "col-pt" not in _ids(r)
+            r = _search(node, {"geo_shape": {"shp": {
+                "shape": env, "relation": "disjoint"}}})
+            assert "col-pt" in _ids(r)
+            # the point ON the edge segment still intersects
+            on_edge = {"type": "envelope",
+                       "coordinates": [[99.0, 10.0], [101.0, 9.0]]}
+            r = _search(node, {"geo_shape": {"shp": {"shape": on_edge}}})
+            assert "col-pt" in _ids(r)
+        finally:
+            node.delete_doc("idx", "col-pt", refresh=True)

