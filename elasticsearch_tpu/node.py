@@ -189,20 +189,54 @@ class Node:
             interval_s=parse_time_value(
                 self.settings.get("resource.reload.interval", "5s"),
                 "resource.reload.interval")).start()
-        from elasticsearch_tpu.discovery import ZenDiscovery
-        self.discovery = ZenDiscovery(
-            self.transport_service, self.cluster_service, self.allocation,
-            seed_provider=seed_provider, cluster_name=cluster_name,
-            min_master_nodes=self.settings.get_as_int(
-                "discovery.zen.minimum_master_nodes", 1),
-            gateway_fn=self._gateway_recover,
-            ping_timeout=self.settings.get_as_float(
-                "discovery.zen.ping_timeout", 1.0),
-            fd_interval=self.settings.get_as_float("fd.ping_interval", 0.5),
-            fd_timeout=self.settings.get_as_float("fd.ping_timeout", 1.0),
-            fd_retries=self.settings.get_as_int("fd.ping_retries", 3),
-            publish_timeout=self.settings.get_as_float(
-                "discovery.zen.publish_timeout", 10.0))
+        # plugin ZenPing providers compose with the transport's own seed
+        # source (DiscoveryModule.addZenPing — how discovery-multicast
+        # rides beside unicast); collected BEFORE ZenDiscovery starts so
+        # plugin seeds feed the initial election round
+        try:
+            extra_pings = self.plugins_service.collect_zen_pings(self)
+            if extra_pings:
+                base_seeds = seed_provider
+
+                def seed_provider():
+                    seeds = list(base_seeds())
+                    seen = set(seeds)
+                    for fn in extra_pings:
+                        # plugin seeds are best-effort ADDITIONS: one
+                        # failing probe must not cost the round its
+                        # unicast seeds
+                        try:
+                            extra = fn()
+                        except Exception:    # noqa: BLE001 — next round
+                            continue
+                        for a in extra:
+                            if a not in seen:
+                                seen.add(a)
+                                seeds.append(a)
+                    return seeds
+            from elasticsearch_tpu.discovery import ZenDiscovery
+            self.discovery = ZenDiscovery(
+                self.transport_service, self.cluster_service,
+                self.allocation,
+                seed_provider=seed_provider, cluster_name=cluster_name,
+                min_master_nodes=self.settings.get_as_int(
+                    "discovery.zen.minimum_master_nodes", 1),
+                gateway_fn=self._gateway_recover,
+                ping_timeout=self.settings.get_as_float(
+                    "discovery.zen.ping_timeout", 1.0),
+                fd_interval=self.settings.get_as_float(
+                    "fd.ping_interval", 0.5),
+                fd_timeout=self.settings.get_as_float(
+                    "fd.ping_timeout", 1.0),
+                fd_retries=self.settings.get_as_int("fd.ping_retries", 3),
+                publish_timeout=self.settings.get_as_float(
+                    "discovery.zen.publish_timeout", 10.0))
+        except Exception:
+            # a failed boot must not leak plugin ping responders (same
+            # invariant cluster_info_service keeps: constructed here,
+            # started only once start() cannot fail before _started)
+            self.plugins_service.abort_zen_pings(self)
+            raise
         self._started = True
         self.discovery.start(self.settings.get_as_float(
             "discovery.initial_state_timeout", 30.0))

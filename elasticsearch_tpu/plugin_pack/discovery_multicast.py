@@ -1,0 +1,204 @@
+"""discovery-multicast — UDP multicast zen ping provider.
+
+Reference: plugins/discovery-multicast (MulticastZenPing.java — removed
+from core in 2.0 and reshipped as a plugin): nodes join group
+224.2.2.4:54328, ping datagrams carry the cluster name, and responses
+carry the responder's transport address, which zen then pings over the
+real transport. This module implements the same protocol over the OS
+multicast stack: a responder thread answers group pings for this node's
+cluster with its published TCP transport address, and the probe joins
+zen's seed sources through the ``zen_ping_providers`` plugin seam
+(collected before the initial election round) — unicast hosts keep
+working alongside, the MulticastZenPing + UnicastZenPing composition of
+the reference's ZenPingService.
+
+Settings (`discovery.zen.ping.multicast.*`, reference names):
+  group (224.2.2.4), port (54328), ttl (3), enabled (true),
+  ping_timeout (0.5 s collect window).
+
+The multicast interface prefers loopback first so same-host clusters
+(including zero-egress containers) discover each other; group join is
+attempted on loopback AND INADDR_ANY, covering cross-host LANs when an
+egress-capable interface exists.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+
+from elasticsearch_tpu.plugins import Plugin
+from elasticsearch_tpu.transport.service import TransportAddress
+
+_PROTO = "estpu-mcast-1"
+
+
+def _join_group(sock: socket.socket, group: str) -> None:
+    joined = 0
+    for iface in ("127.0.0.1", None):
+        try:
+            if iface is None:
+                mreq = struct.pack("4sl", socket.inet_aton(group),
+                                   socket.INADDR_ANY)
+            else:
+                mreq = struct.pack("4s4s", socket.inet_aton(group),
+                                   socket.inet_aton(iface))
+            sock.setsockopt(socket.IPPROTO_IP, socket.IP_ADD_MEMBERSHIP,
+                            mreq)
+            joined += 1
+        except OSError:
+            continue
+    if not joined:
+        # a deaf responder = silently broken discovery; fail the boot
+        # loudly so the operator knows multicast is non-functional here
+        raise OSError(
+            f"discovery-multicast: cannot join group {group} on any "
+            f"interface (no multicast route?)")
+
+
+def _mcast_send_socket(ttl: int) -> socket.socket:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_TTL, ttl)
+    s.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_LOOP, 1)
+    try:
+        # prefer loopback so same-host discovery works with zero egress
+        s.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_IF,
+                     socket.inet_aton("127.0.0.1"))
+    except OSError:
+        pass
+    return s
+
+
+class MulticastDiscoveryPlugin(Plugin):
+    """Registers the multicast responder + seed provider on node start."""
+
+    name = "discovery-multicast"
+
+    def __init__(self):
+        self._sock: socket.socket | None = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # -- responder -----------------------------------------------------
+
+    def zen_ping_providers(self, node) -> list:
+        """Start the responder and hand zen the multicast probe — called
+        after the transport is bound, before the initial election, so a
+        cluster can form from multicast alone (no unicast hosts)."""
+        s = node.settings
+        if not s.get_as_bool("discovery.zen.ping.multicast.enabled", True):
+            return []
+        addr = node.transport_service.transport.bound_address()
+        if getattr(addr, "port", 0) in (None, 0) or \
+                str(addr.host) == "local":
+            # LocalTransport (publishes host exactly "local") isn't
+            # dialable from a datagram — multicast only makes sense over
+            # a socket transport. "localhost" is a real TCP host.
+            return []
+        if self._thread is not None and self._thread.is_alive():
+            raise ValueError(
+                "discovery-multicast: one MulticastDiscoveryPlugin "
+                "instance per node (responder already running) — give "
+                "each embedded node its own instance")
+        group = s.get("discovery.zen.ping.multicast.group", "224.2.2.4")
+        port = s.get_as_int("discovery.zen.ping.multicast.port", 54328)
+        ttl = s.get_as_int("discovery.zen.ping.multicast.ttl", 3)
+        self._timeout = s.get_as_float(
+            "discovery.zen.ping.multicast.ping_timeout", 0.5)
+        self._group, self._port, self._ttl = group, port, ttl
+        self._cluster = node.cluster_service.state().cluster_name
+        self._reply = {"proto": _PROTO, "t": "pong",
+                       "cluster": self._cluster,
+                       "host": addr.host, "port": addr.port,
+                       "node": node.node_name}
+
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        except (OSError, AttributeError):
+            pass
+        sock.bind(("", port))
+        _join_group(sock, group)
+        sock.settimeout(0.25)
+        self._sock = sock
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._respond_loop, daemon=True,
+            name=f"mcast-disco[{node.node_name}]")
+        self._thread.start()
+        return [self.probe]
+
+    def _respond_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data, src = self._sock.recvfrom(2048)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                msg = json.loads(data.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if msg.get("proto") != _PROTO or msg.get("t") != "ping" or \
+                    msg.get("cluster") != self._cluster:
+                continue        # another cluster's ping rides the group
+            try:
+                self._sock.sendto(
+                    json.dumps(self._reply).encode("utf-8"), src)
+            except OSError:
+                continue
+
+    # -- probe (the seed-provider leg) ---------------------------------
+
+    def probe(self) -> list[TransportAddress]:
+        """One multicast ping round → responders' transport addresses."""
+        out: list[TransportAddress] = []
+        try:
+            c = _mcast_send_socket(self._ttl)
+        except OSError:
+            return out
+        try:
+            c.settimeout(self._timeout)
+            ping = json.dumps({"proto": _PROTO, "t": "ping",
+                               "cluster": self._cluster}).encode("utf-8")
+            c.sendto(ping, (self._group, self._port))
+            seen = set()
+            while True:
+                try:
+                    data, _ = c.recvfrom(2048)
+                except (socket.timeout, OSError):
+                    break
+                try:
+                    msg = json.loads(data.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    continue
+                if msg.get("proto") != _PROTO or msg.get("t") != "pong" \
+                        or msg.get("cluster") != self._cluster:
+                    continue
+                try:
+                    key = (str(msg["host"]), int(msg["port"]))
+                except (KeyError, TypeError, ValueError):
+                    continue        # malformed pong on the shared group
+                if key in seen or not key[0] or not key[1]:
+                    continue
+                seen.add(key)
+                out.append(TransportAddress(key[0], key[1]))
+        finally:
+            c.close()
+        return out
+
+    def on_node_stop(self, node) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

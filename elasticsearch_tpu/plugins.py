@@ -104,6 +104,14 @@ class Plugin:
     def query_parsers(self) -> dict:
         return {}
 
+    def zen_ping_providers(self, node) -> list:
+        """Extra discovery seed sources (the DiscoveryModule.addZenPing
+        seam — how discovery-multicast adds MulticastZenPing beside
+        UnicastZenPing). Called after the transport is bound but BEFORE
+        ZenDiscovery starts, so seeds feed the initial election round.
+        Each returned callable yields a list of TransportAddress."""
+        return []
+
     def on_node_stop(self, node) -> None:
         pass
 
@@ -181,6 +189,28 @@ class PluginsService:
                                            before.get(name, _MISSING),
                                            self._undo)
             p.on_node_start(node)
+
+    def collect_zen_pings(self, node) -> list:
+        """All plugins' extra discovery seed callables (addZenPing)."""
+        fns = []
+        self._ping_plugins = []
+        for p in self.plugins:
+            provided = p.zen_ping_providers(node)
+            if provided:
+                self._ping_plugins.append(p)
+            fns.extend(provided)
+        return fns
+
+    def abort_zen_pings(self, node) -> None:
+        """Tear down ping providers after a boot failure: only plugins
+        that actually provided one get their on_node_stop (best-effort —
+        apply_node_start never ran for them)."""
+        for p in getattr(self, "_ping_plugins", ()):
+            try:
+                p.on_node_stop(node)
+            except Exception:            # noqa: BLE001 — already failing
+                pass
+        self._ping_plugins = []
 
     def apply_rest(self, controller, node) -> None:
         for p in self.plugins:

@@ -1,0 +1,105 @@
+"""discovery-multicast: clusters form over UDP multicast with NO
+unicast hosts (ref plugins/discovery-multicast — MulticastZenPing joins
+224.2.2.4:54328, answers per-cluster pings with its transport address;
+here over a random high group port so parallel test sessions don't
+cross-talk)."""
+
+import socket
+import threading
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.plugin_pack.discovery_multicast import (
+    MulticastDiscoveryPlugin)
+
+
+def _mcast_ok() -> bool:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_IF,
+                     socket.inet_aton("127.0.0.1"))
+        s.close()
+        return True
+    except OSError:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _mcast_ok(), reason="no multicast-capable loopback")
+
+
+def _settings(name: str, mcast_port: int, min_masters: int) -> dict:
+    return {
+        "transport.type": "tcp",
+        "transport.tcp.port": 0,
+        # NO discovery.zen.ping.unicast.hosts — multicast only
+        "plugins": [MulticastDiscoveryPlugin()],
+        "discovery.zen.ping.multicast.port": mcast_port,
+        "discovery.zen.ping.multicast.ping_timeout": 0.3,
+        "discovery.zen.minimum_master_nodes": min_masters,
+        "discovery.zen.ping_timeout": 0.3,
+        "discovery.zen.publish_timeout": 3.0,
+        "fd.ping_interval": 0.1,
+        "fd.ping_timeout": 0.4,
+        "fd.ping_retries": 2,
+        "node.name": name,
+        "cluster.name": "mcast-test",
+    }
+
+
+def _free_udp_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_nodes_form_cluster_via_multicast_only(tmp_path):
+    mport = _free_udp_port()
+    nodes = [Node(_settings(f"mc-{i}", mport, 2),
+                  data_path=tmp_path / f"n{i}") for i in range(2)]
+    threads = [threading.Thread(target=n.start, daemon=True)
+               for n in nodes]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    try:
+        sa = nodes[0].cluster_service.state()
+        sb = nodes[1].cluster_service.state()
+        assert len(sa.nodes) == 2 and len(sb.nodes) == 2
+        assert sa.master_node_id == sb.master_node_id
+        assert sa.master_node_id is not None
+    finally:
+        for n in nodes:
+            try:
+                n.close()
+            except Exception:      # noqa: BLE001 — teardown
+                pass
+
+
+def test_multicast_ignores_other_clusters(tmp_path):
+    """Two clusters share the group: pings carry the cluster name, so
+    each cluster only discovers its own members."""
+    mport = _free_udp_port()
+    sa = _settings("ca-0", mport, 1)
+    sb = dict(_settings("cb-0", mport, 1), **{"cluster.name": "other"})
+    na = Node(sa, data_path=tmp_path / "a")
+    nb = Node(sb, data_path=tmp_path / "b")
+    threads = [threading.Thread(target=n.start, daemon=True)
+               for n in (na, nb)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    try:
+        assert len(na.cluster_service.state().nodes) == 1
+        assert len(nb.cluster_service.state().nodes) == 1
+    finally:
+        for n in (na, nb):
+            try:
+                n.close()
+            except Exception:      # noqa: BLE001 — teardown
+                pass
