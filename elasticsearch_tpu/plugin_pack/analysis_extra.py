@@ -48,6 +48,212 @@ def icu_normalizer_filter(tokens: list[Token]) -> list[Token]:
 
 
 # ---------------------------------------------------------------------------
+# ICU tokenizer — UAX#29 word breaks with DICTIONARY-BASED CJK runs
+# (ICUTokenizer uses ICU's BreakIterator, which segments Han/kana runs
+# through its CJ dictionary; here those runs delegate to the same
+# dictionary segmenters the kuromoji/smartcn analogs use)
+# ---------------------------------------------------------------------------
+
+_CJK_MIX_RUN = re.compile(r"[぀-ゟ゠-ヿ㐀-䶿一-鿿豈-﫿]+")
+_KANA_CHAR = re.compile(r"[぀-ゟ゠-ヿ]")
+_NUM_WORD = re.compile(r"\d+(?:[.,]\d+)*|[^\W\d_]+", re.UNICODE)
+
+
+def icu_tokenizer(text: str) -> list[Token]:
+    """Word-boundary tokens; Han runs segment by dictionary BMM
+    (morph_zh), kana-anchored runs by the lattice Viterbi (morph_ja) —
+    the ICUTokenizer discipline (dictionary-based CJ break data),
+    sharing this pack's CJK dictionaries."""
+    from elasticsearch_tpu.plugin_pack import morph_ja, morph_zh
+    out: list[Token] = []
+    pos = 0
+    i = 0
+    n = len(text)
+    while i < n:
+        m = _CJK_MIX_RUN.match(text, i)
+        if m:
+            run = m.group(0)
+            if _KANA_CHAR.search(run):
+                # any kana in the run: Japanese — lattice-segment the
+                # whole Han+kana stretch (寿司を… starts with kanji)
+                for t in morph_ja.kuromoji_tokenizer(run):
+                    out.append(Token(t.term, pos,
+                                     m.start() + t.start_offset,
+                                     m.start() + t.end_offset))
+                    pos += 1
+            else:
+                off = m.start()
+                for w in morph_zh.segment_han(run):
+                    out.append(Token(w, pos, off, off + len(w)))
+                    pos += 1
+                    off += len(w)
+            i = m.end()
+            continue
+        m = _NUM_WORD.match(text, i)
+        if m:
+            out.append(Token(m.group(0), pos, m.start(), m.end()))
+            pos += 1
+            i = m.end()
+            continue
+        i += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ICU transforms (ICUTransformFilter analog): compound transform ids are
+# ";"-chained steps. Supported steps: Any-Latin (Greek/Cyrillic
+# romanization, BGN-style tables), Latin-ASCII, Lower, Upper, NFC/NFD/
+# NFKC/NFKD, "[:Nonspacing Mark:] Remove". Unknown steps raise — a typo
+# must not silently index untransformed text.
+# ---------------------------------------------------------------------------
+
+_GREEK_LATIN = {
+    "α": "a", "β": "v", "γ": "g", "δ": "d", "ε": "e", "ζ": "z",
+    "η": "i", "θ": "th", "ι": "i", "κ": "k", "λ": "l", "μ": "m",
+    "ν": "n", "ξ": "x", "ο": "o", "π": "p", "ρ": "r", "σ": "s",
+    "ς": "s", "τ": "t", "υ": "y", "φ": "f", "χ": "ch", "ψ": "ps",
+    "ω": "o"}
+_CYRILLIC_LATIN = {
+    "а": "a", "б": "b", "в": "v", "г": "g", "д": "d", "е": "e",
+    "ё": "e", "ж": "zh", "з": "z", "и": "i", "й": "j", "к": "k",
+    "л": "l", "м": "m", "н": "n", "о": "o", "п": "p", "р": "r",
+    "с": "s", "т": "t", "у": "u", "ф": "f", "х": "h", "ц": "c",
+    "ч": "ch", "ш": "sh", "щ": "shch", "ъ": "", "ы": "y", "ь": "",
+    "э": "e", "ю": "yu", "я": "ya"}
+
+
+def _translit_any_latin(text: str) -> str:
+    # decompose first so accented letters (ή = η + ́) map through the
+    # base-letter tables; combining marks pass through (a chained
+    # Latin-ASCII step strips them, as in ICU transform pipelines)
+    out = []
+    for c in unicodedata.normalize("NFD", text):
+        low = c.lower()
+        rep = _GREEK_LATIN.get(low)
+        if rep is None:
+            rep = _CYRILLIC_LATIN.get(low)
+        if rep is None:
+            out.append(c)
+        elif c != low:                      # preserve leading-case shape
+            out.append(rep[:1].upper() + rep[1:])
+        else:
+            out.append(rep)
+    return unicodedata.normalize("NFC", "".join(out))
+
+
+# letters with no canonical decomposition that ICU's Latin-ASCII still
+# maps (its table is rule-based, not normalization-based)
+_LATIN_ASCII_EXTRA = {
+    "ß": "ss", "ẞ": "SS", "ø": "o", "Ø": "O", "æ": "ae", "Æ": "AE",
+    "œ": "oe", "Œ": "OE", "đ": "d", "Đ": "D", "ð": "d", "Ð": "D",
+    "þ": "th", "Þ": "TH", "ł": "l", "Ł": "L", "ı": "i", "ħ": "h",
+    "Ħ": "H", "ŋ": "n", "Ŋ": "N", "ĸ": "k"}
+
+
+def _strip_marks(text: str) -> str:
+    return "".join(c for c in unicodedata.normalize("NFD", text)
+                   if not unicodedata.combining(c))
+
+
+def _latin_ascii(text: str) -> str:
+    return "".join(_LATIN_ASCII_EXTRA.get(c, c)
+                   for c in _strip_marks(text))
+
+
+_TRANSFORM_STEPS = {
+    "any-latin": _translit_any_latin,
+    "latin-ascii": _latin_ascii,
+    "lower": str.lower,
+    "upper": str.upper,
+    "nfc": lambda t: unicodedata.normalize("NFC", t),
+    "nfd": lambda t: unicodedata.normalize("NFD", t),
+    "nfkc": lambda t: unicodedata.normalize("NFKC", t),
+    "nfkd": lambda t: unicodedata.normalize("NFKD", t),
+    "[:nonspacing mark:] remove": _strip_marks,
+}
+
+
+def icu_transform_filter_factory(params: dict):
+    from elasticsearch_tpu.common.errors import IllegalArgumentError
+    tid = str(params.get("id", "Null"))
+    steps = []
+    for raw in tid.split(";"):
+        raw = raw.strip()
+        if not raw or raw.lower() == "null":
+            continue
+        fn = _TRANSFORM_STEPS.get(raw.lower())
+        if fn is None:
+            raise IllegalArgumentError(
+                f"icu_transform: unsupported transform step [{raw}] "
+                f"(supported: {sorted(_TRANSFORM_STEPS)})")
+        steps.append(fn)
+
+    def icu_transform(tokens: list[Token]) -> list[Token]:
+        out = []
+        for t in tokens:
+            term = t.term
+            for fn in steps:
+                term = fn(term)
+            out.append(Token(term, t.position, t.start_offset,
+                             t.end_offset))
+        return out
+    return icu_transform
+
+
+# ---------------------------------------------------------------------------
+# ICU collation keys (ICUCollationKeyFilter analog): terms become sort
+# keys so keyword ordering follows the locale's collation instead of
+# code points. UCA-approximating key = (primary: case/mark-folded,
+# secondary: marks, tertiary: case), with per-locale tailoring for the
+# Scandinavian after-z letters and German umlaut expansion.
+# ---------------------------------------------------------------------------
+
+_COLLATE_TAILOR = {
+    # da/no/sv: å ä æ ö ø sort AFTER z (primary difference)
+    "da": {"å": "z{", "æ": "z|", "ø": "z}", "ä": "z|", "ö": "z}"},
+    "no": {"å": "z{", "æ": "z|", "ø": "z}", "ä": "z|", "ö": "z}"},
+    "sv": {"å": "z{", "ä": "z|", "ö": "z}"},
+    # de phonebook: umlauts expand to vowel+e
+    "de__phonebook": {"ä": "ae", "ö": "oe", "ü": "ue", "ß": "ss"},
+}
+
+
+def icu_collation_key(term: str, locale: str = "",
+                      strength: str = "tertiary") -> str:
+    # canonically-equivalent inputs must key identically (NFD 'åka'
+    # ships from external pipelines); compose BEFORE the per-char
+    # tailor lookup or 'å' arrives as 'a'+mark and skips tailoring
+    term = unicodedata.normalize("NFC", term)
+    tailor = _COLLATE_TAILOR.get(locale.lower().replace("-", "_"), {})
+    folded = []
+    for c in term.casefold():
+        folded.append(tailor.get(c, c))
+    primary = _strip_marks("".join(folded))
+    if strength == "primary":
+        return primary
+    secondary = "".join(c for c in unicodedata.normalize("NFD", term)
+                        if unicodedata.combining(c))
+    if strength == "secondary":
+        return primary + "\x01" + secondary
+    case_bits = "".join("1" if c.isupper() else "0" for c in term)
+    return primary + "\x01" + secondary + "\x01" + case_bits
+
+
+def icu_collation_filter_factory(params: dict):
+    locale = str(params.get("language", params.get("locale", "")))
+    variant = str(params.get("variant", ""))
+    if variant:
+        locale = f"{locale}__{variant.strip('@').replace('collation=', '')}"
+    strength = str(params.get("strength", "tertiary")).lower()
+
+    def icu_collation(tokens: list[Token]) -> list[Token]:
+        return [Token(icu_collation_key(t.term, locale, strength),
+                      t.position, t.start_offset, t.end_offset)
+                for t in tokens]
+    return icu_collation
+
+
+# ---------------------------------------------------------------------------
 # Phonetic encoders (analysis-phonetic: PhoneticTokenFilterFactory)
 # ---------------------------------------------------------------------------
 
@@ -163,10 +369,18 @@ def cjk_bigram_tokenizer(text: str) -> list[Token]:
 # Polish light stemmer (stempel stand-in)
 # ---------------------------------------------------------------------------
 
-_POLISH_SUFFIXES = ("owała", "owali", "owało", "ałaś", "ałem", "iłem",
-                    "iłam", "ach", "ami", "ach", "owi", "ach", "iem",
-                    "em", "om", "ów", "ą", "ę", "a", "i", "y", "e", "u",
-                    "o")
+# longest-first so the most specific inflection strips before its
+# substring (owaniem before em; stempel's trained tables encode the
+# same longest-suffix discipline)
+_POLISH_SUFFIXES = tuple(sorted(
+    {"owaniem", "owania", "owanie", "owałam", "owałem", "owała",
+     "owali", "owało", "owany", "owana", "owane", "ościach", "ościami",
+     "ością", "ości", "ować", "ałaś", "ałam", "ałem", "iłem", "iłam",
+     "iłeś", "iłaś", "acji", "acja", "acją", "acje", "ście", "stwo",
+     "stwa", "stwie", "ach", "ami", "owi", "iem", "ego", "emu", "ymi",
+     "imi", "ych", "ich", "iej", "ej", "em", "om", "ów", "ie", "ię",
+     "ą", "ę", "a", "i", "y", "e", "u", "o"},
+    key=len, reverse=True))
 
 
 def polish_stem_filter(tokens: list[Token]) -> list[Token]:
@@ -192,11 +406,16 @@ class IcuAnalysisPlugin(Plugin):
 
     def analysis(self, registry) -> None:
         registry.analyzers["icu_analyzer"] = Analyzer(
-            "icu_analyzer", standard_tokenizer, [icu_folding_filter])
+            "icu_analyzer", icu_tokenizer, [icu_folding_filter])
+        registry.tokenizers["icu_tokenizer"] = icu_tokenizer
         registry.filter_factories["icu_folding"] = \
             lambda params: icu_folding_filter
         registry.filter_factories["icu_normalizer"] = \
             lambda params: icu_normalizer_filter
+        registry.filter_factories["icu_transform"] = \
+            icu_transform_filter_factory
+        registry.filter_factories["icu_collation"] = \
+            icu_collation_filter_factory
 
 
 class PhoneticAnalysisPlugin(Plugin):

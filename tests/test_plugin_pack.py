@@ -310,3 +310,78 @@ def test_kuromoji_baseform_conflates_conjugations(tmp_path):
     r = n.search("bf", {"query": {"match": {"t": "行って"}}})
     assert "2" in {h["_id"] for h in r["hits"]["hits"]}
     n.close()
+
+
+class TestIcuRound5:
+    """icu_tokenizer / icu_transform / icu_collation (the remaining
+    ICUAnalysisBinderProcessor registrations)."""
+
+    def test_icu_tokenizer_dictionary_cjk(self):
+        from elasticsearch_tpu.plugin_pack.analysis_extra import (
+            icu_tokenizer)
+        # Han run: dictionary BMM, not bigrams
+        terms = [t.term for t in icu_tokenizer("我们在北京大学学习")]
+        assert "北京大学" in terms and "学习" in terms
+        # kana-anchored run: lattice Viterbi segmentation
+        terms = [t.term for t in icu_tokenizer("寿司を食べました")]
+        assert "寿司" in terms and "を" in terms
+        # mixed-script text keeps word tokens with offsets
+        toks = icu_tokenizer("ICU 4.8 und Käse")
+        assert [t.term for t in toks] == ["ICU", "4.8", "und", "Käse"]
+        assert toks[1].start_offset == 4 and toks[1].end_offset == 7
+
+    def test_icu_transform_any_latin(self):
+        from elasticsearch_tpu.analysis.analyzers import Token
+        from elasticsearch_tpu.plugin_pack.analysis_extra import (
+            icu_transform_filter_factory)
+        f = icu_transform_filter_factory(
+            {"id": "Any-Latin; Latin-ASCII; Lower"})
+        toks = [Token("Αθήνα", 0, 0, 5), Token("Москва", 1, 6, 12)]
+        assert [t.term for t in f(toks)] == ["athina", "moskva"]
+
+    def test_icu_transform_unknown_step_raises(self):
+        import pytest as _pytest
+        from elasticsearch_tpu.common.errors import IllegalArgumentError
+        from elasticsearch_tpu.plugin_pack.analysis_extra import (
+            icu_transform_filter_factory)
+        with _pytest.raises(IllegalArgumentError):
+            icu_transform_filter_factory({"id": "Han-Latin"})
+
+    def test_icu_collation_swedish_after_z(self):
+        from elasticsearch_tpu.plugin_pack.analysis_extra import (
+            icu_collation_key)
+        # Swedish: å/ä/ö sort AFTER z; code-point order would put them
+        # after 'a' folding — the tailored keys restore locale order
+        keys = sorted(["zebra", "åka", "äpple", "öga", "apa"],
+                      key=lambda w: icu_collation_key(w, "sv"))
+        assert keys == ["apa", "zebra", "åka", "äpple", "öga"]
+        # default locale: accent-insensitive primary, accent-sensitive
+        # secondary (café > cafe only at secondary strength)
+        assert icu_collation_key("café", strength="primary") == \
+            icu_collation_key("cafe", strength="primary")
+        assert icu_collation_key("café", strength="secondary") != \
+            icu_collation_key("cafe", strength="secondary")
+
+    def test_icu_collation_german_phonebook(self):
+        from elasticsearch_tpu.plugin_pack.analysis_extra import (
+            icu_collation_key)
+        # de phonebook: ä expands to ae → "Bär" sorts with "Baer"
+        assert icu_collation_key("Bär", "de__phonebook",
+                                 "primary") == \
+            icu_collation_key("Baer", "de__phonebook", "primary")
+
+    def test_icu_collation_nfd_input_keys_identically(self):
+        import unicodedata
+        from elasticsearch_tpu.plugin_pack.analysis_extra import (
+            icu_collation_key)
+        nfc, nfd = "åka", unicodedata.normalize("NFD", "åka")
+        assert nfc != nfd
+        assert icu_collation_key(nfc, "sv") == icu_collation_key(nfd, "sv")
+
+    def test_icu_transform_latin_ascii_nondecomposable(self):
+        from elasticsearch_tpu.analysis.analyzers import Token
+        from elasticsearch_tpu.plugin_pack.analysis_extra import (
+            icu_transform_filter_factory)
+        f = icu_transform_filter_factory({"id": "Latin-Ascii; Lower"})
+        toks = [Token("Straße", 0, 0, 6), Token("Øresund", 1, 7, 14)]
+        assert [t.term for t in f(toks)] == ["strasse", "oresund"]

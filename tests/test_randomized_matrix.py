@@ -41,7 +41,8 @@ if _shape_rnd.random() < 0.5:
     SETTINGS["transport.tcp.compress"] = _shape_rnd.choice([True, False])
 
 SCENARIOS = ["crud_search", "kill_replica_holder", "move_primary",
-             "partition_minority", "rolling_settings"]
+             "partition_minority", "rolling_settings",
+             "snapshot_restore", "scroll_under_writes"]
 if os.environ.get("ESTPU_MATRIX_ALL") == "1":
     SAMPLED = list(SCENARIOS)
 else:
@@ -208,6 +209,84 @@ def _scenario_partition_minority(c, rnd):
     m = c.master()
     m.broadcast_actions.refresh("m_part")
     assert m.search("m_part", {"size": 0})["hits"]["total"] == 21
+
+
+def _scenario_snapshot_restore(c, rnd):
+    """Snapshot through a random node, wipe, restore, verify counts —
+    under whatever shape/transport the session drew."""
+    import shutil
+    import tempfile
+    a = c.master()
+    shards = rnd.randint(1, 3)
+    a.indices_service.create_index("m_snap", {"settings": {
+        "number_of_shards": shards,
+        "number_of_replicas": min(1, len(c.nodes) - 1)}})
+    _green(a)
+    n_docs = rnd.randint(25, 90)
+    for i in range(n_docs):
+        a.index_doc("m_snap", str(i), {"n": i})
+    a.broadcast_actions.refresh("m_snap")
+    loc = tempfile.mkdtemp(prefix="m-snap-repo-")
+    try:
+        a.snapshots_service.put_repository(
+            "m_backup", {"type": "fs", "settings": {"location": loc}})
+        out = a.snapshots_service.create_snapshot(
+            "m_backup", "s1", {"indices": ["m_snap"]})
+        assert out["snapshot"]["state"] == "SUCCESS", out
+        a.indices_service.delete_index("m_snap")
+        a.snapshots_service.restore_snapshot("m_backup", "s1")
+        deadline = time.monotonic() + 30
+        q = c.nodes[rnd.randrange(len(c.nodes))]
+        while time.monotonic() < deadline:
+            try:
+                if q.search("m_snap", {"size": 0})["hits"]["total"] \
+                        == n_docs:
+                    break
+            except Exception:    # noqa: BLE001 — restore in flight
+                pass
+            time.sleep(0.2)
+        assert q.search("m_snap", {"size": 0})["hits"]["total"] \
+            == n_docs
+    finally:
+        shutil.rmtree(loc, ignore_errors=True)
+
+
+def _scenario_scroll_under_writes(c, rnd):
+    """Scroll pages pin point-in-time readers: writes landing mid-scroll
+    never leak into later pages, on either transport."""
+    a = c.master()
+    a.indices_service.create_index("m_scr", {"settings": {
+        "number_of_shards": rnd.randint(1, 3),
+        "number_of_replicas": 0}})
+    _green(a)
+    n_docs = rnd.randint(40, 100)
+    for i in range(n_docs):
+        a.index_doc("m_scr", str(i), {"n": i})
+    a.broadcast_actions.refresh("m_scr")
+    page = rnd.randint(7, 19)
+    r = a.search("m_scr", {"query": {"match_all": {}}, "size": page,
+                           "sort": [{"n": {"order": "asc"}}]},
+                 scroll="1m")
+    seen = [h["_id"] for h in r["hits"]["hits"]]
+    sid = r["_scroll_id"]
+    # concurrent writes through random nodes while the scroll walks
+    for i in range(rnd.randint(10, 30)):
+        c.nodes[rnd.randrange(len(c.nodes))].index_doc(
+            "m_scr", f"mid-{i}", {"n": n_docs + i})
+    a.broadcast_actions.refresh("m_scr")
+    while True:
+        r = a.search_actions.scroll(sid, scroll="1m")
+        hits = r["hits"]["hits"]
+        if not hits:
+            break
+        seen.extend(h["_id"] for h in hits)
+        sid = r["_scroll_id"]
+        # a looping scroll id must FAIL reproducibly, not hang CI
+        assert len(seen) <= n_docs + page, \
+            f"scroll re-served pages: {len(seen)} > {n_docs}"
+    assert len(seen) == n_docs, (len(seen), n_docs)
+    assert not any(i.startswith("mid-") for i in seen)
+    assert len(set(seen)) == n_docs         # no dup across pages
 
 
 def _scenario_rolling_settings(c, rnd):
