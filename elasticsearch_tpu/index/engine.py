@@ -535,10 +535,11 @@ class Engine:
             if self._commit_pins:
                 return                           # commit pinned — no flush
             self.refresh()
+            store_type = str(self.settings.get("index.store.type", "fs"))
             for seg, mask in zip(self._segments, self._live_masks):
                 seg_dir = self.path / f"seg_{seg.seg_id}"
                 if not (seg_dir / "meta.json").exists():
-                    seg.write(seg_dir)
+                    seg.write(seg_dir, store_type=store_type)
                 np.save(seg_dir / "live.tmp.npy", mask)
                 os.replace(seg_dir / "live.tmp.npy", seg_dir / "live.npy")
             self._commit_gen += 1

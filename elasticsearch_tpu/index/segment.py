@@ -54,6 +54,58 @@ from elasticsearch_tpu.mapping.mapper import (
 DEFAULT_MAX_TOKENS = 512
 _ROW_PAD = 8
 
+# index.store.type → on-disk layout (IndexStoreModule registry; plugins
+# extend it — store-smb adds the smb_* names). Layouts: "compressed"
+# (npz deflate), "uncompressed" (plain npz, faster open), "npy_dir"
+# (one .npy per column, OS-mmap'd on read so cold columns page lazily).
+STORE_TYPES: dict[str, str] = {
+    "fs": "compressed", "default": "compressed",
+    "niofs": "uncompressed", "simple_fs": "uncompressed",
+    "simplefs": "uncompressed",
+    "mmapfs": "npy_dir", "mmap_fs": "npy_dir",
+}
+
+
+def validate_store_type(store_type: str) -> str:
+    """→ layout name, raising the create-index-time error for unknown
+    types (IndexStoreModule resolution; indices/service validates at
+    creation so a typo can't produce an index that fails every flush)."""
+    layout = STORE_TYPES.get(str(store_type))
+    if layout is None:
+        from elasticsearch_tpu.common.errors import IllegalArgumentError
+        raise IllegalArgumentError(
+            f"unknown index.store.type [{store_type}] "
+            f"(registered: {sorted(STORE_TYPES)})")
+    return layout
+
+
+def _column_file(arrays_dir: Path, key: str) -> Path:
+    """One encoding for column-key → filename (shared by write + mmap
+    read; field names may contain characters unfit for filenames)."""
+    from urllib.parse import quote
+    return arrays_dir / (quote(key, safe=".") + ".npy")
+
+
+class _MmapArrays:
+    """Mapping view over a per-column .npy directory, each array opened
+    with ``mmap_mode="r"`` — reads page in on demand (the mmapfs
+    DirectoryService strategy)."""
+
+    def __init__(self, path: Path):
+        self._path = path
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        f = _column_file(self._path, key)
+        if not f.exists():
+            raise KeyError(key)
+        return np.load(f, mmap_mode="r")
+
+    def get(self, key: str, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
 
 def pad_to(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
@@ -283,9 +335,18 @@ class Segment:
 
     # ---- persistence ------------------------------------------------------
 
-    def write(self, path: Path) -> None:
+    def write(self, path: Path, store_type: str = "fs") -> None:
         """Persist as npz + json (write-tmp-then-rename like the reference's
-        MetaDataStateFormat, core/gateway/MetaDataStateFormat.java)."""
+        MetaDataStateFormat, core/gateway/MetaDataStateFormat.java).
+
+        ``store_type`` is the `index.store.type` seam (core/index/store/
+        IndexStoreModule — fs/niofs/mmapfs/default; plugins add more,
+        store-smb): "fs"/"default" = compressed npz; "niofs"/"simple_fs"
+        = uncompressed npz (faster open, eager read); "mmapfs"/
+        "mmap_fs" = one .npy per column, opened with OS mmap so cold
+        columns page in on demand (the FsDirectoryService mmap
+        strategy). Unknown types raise."""
+        layout = validate_store_type(store_type)
         path.mkdir(parents=True, exist_ok=True)
         arrays: dict[str, np.ndarray] = {}
         meta: dict[str, Any] = {
@@ -328,13 +389,39 @@ class Segment:
 
         meta["nested"] = sorted(self.nested_blocks)
         for p, blk in self.nested_blocks.items():
-            blk.segment.write(path / f"nested_{p}")
+            blk.segment.write(path / f"nested_{p}", store_type=store_type)
             arrays[f"x.{p}.parent"] = blk.parent
+        meta["store"] = layout
 
-        tmp_npz, tmp_meta, tmp_src = (path / "arrays.npz.tmp", path / "meta.json.tmp",
-                                      path / "source.jsonl.tmp")
-        with open(tmp_npz, "wb") as f:
-            np.savez_compressed(f, **arrays)
+        import shutil
+        tmp_meta, tmp_src = (path / "meta.json.tmp",
+                             path / "source.jsonl.tmp")
+        if layout == "npy_dir":
+            tmp_dir = path / "arrays.tmp"
+            if tmp_dir.exists():
+                shutil.rmtree(tmp_dir)
+            tmp_dir.mkdir()
+            for key, arr in arrays.items():
+                np.save(_column_file(tmp_dir, key),
+                        np.ascontiguousarray(arr))
+            final_dir = path / "arrays"
+            if final_dir.exists():
+                shutil.rmtree(final_dir)
+            tmp_dir.rename(final_dir)
+            # a crash-interrupted earlier write under another store type
+            # may have left the other layout's artifact — remove it, or
+            # file_manifest() ships the dead file to replicas/snapshots
+            (path / "arrays.npz").unlink(missing_ok=True)
+        else:
+            tmp_npz = path / "arrays.npz.tmp"
+            with open(tmp_npz, "wb") as f:
+                if layout == "uncompressed":
+                    np.savez(f, **arrays)
+                else:
+                    np.savez_compressed(f, **arrays)
+            tmp_npz.rename(path / "arrays.npz")
+            if (path / "arrays").exists():
+                shutil.rmtree(path / "arrays")
         tmp_meta.write_text(json.dumps(meta))
         with open(tmp_src, "w") as f:
             for doc_id, src in zip(self.ids, self.sources):
@@ -342,14 +429,16 @@ class Segment:
         # meta.json is the "segment fully persisted" sentinel (Engine.flush
         # checks it) — rename it LAST so a crash between renames can never
         # produce a sentinel-present-but-incomplete segment.
-        tmp_npz.rename(path / "arrays.npz")
         tmp_src.rename(path / "source.jsonl")
         tmp_meta.rename(path / "meta.json")
 
     @staticmethod
     def read(path: Path) -> "Segment":
         meta = json.loads((path / "meta.json").read_text())
-        arrays = np.load(path / "arrays.npz")
+        if meta.get("store") == "npy_dir":
+            arrays = _MmapArrays(path / "arrays")
+        else:
+            arrays = np.load(path / "arrays.npz")
         ids, sources = [], []
         with open(path / "source.jsonl") as f:
             for line in f:

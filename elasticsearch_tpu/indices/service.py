@@ -578,6 +578,13 @@ class IndicesService:
             if name in state.indices:
                 raise IndexAlreadyExistsError(name)
             settings = _normalize_index_settings(body.get("settings", {}))
+            # a typo'd store type must fail HERE, not on every later
+            # flush (incl. the swallowed background-merge flush) —
+            # IndexStoreModule resolves at creation in the reference too
+            if "index.store.type" in settings:
+                from elasticsearch_tpu.index.segment import (
+                    validate_store_type)
+                validate_store_type(settings["index.store.type"])
             mappings = dict(body.get("mappings", {}))
             if mappings and "properties" in mappings:
                 mappings = {"_doc": mappings}   # typeless API compat

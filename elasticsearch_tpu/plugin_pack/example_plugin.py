@@ -1,0 +1,57 @@
+"""example-plugin — the SPI demonstration plugin.
+
+Reference: plugins/jvm-example (JvmExamplePlugin + ExampleRestAction —
+the template every third-party plugin starts from) and
+plugins/site-example (static content served under /_plugin/<name>/).
+This module exercises every extension seam the SPI offers in one small
+plugin, and doubles as living documentation for plugin authors:
+
+* ``node_settings``      — a default merged under user settings
+* ``rest_routes``        — GET /_example (ExampleRestAction analog) and
+  the site at GET /_plugin/example-plugin/ (site-example analog)
+* ``analysis``           — an "example_shout" filter factory
+* ``script_functions``   — `example_double(x)` for vectorized scripts
+* ``query_parsers``      — an `example_all` query type
+* ``zen_ping_providers`` — declared empty (how discovery plugins hook)
+"""
+
+from __future__ import annotations
+
+from elasticsearch_tpu.plugins import Plugin
+
+
+def _shout_filter(tokens):
+    from elasticsearch_tpu.analysis.analyzers import Token
+    return [Token(t.term.upper() + "!", t.position, t.start_offset,
+                  t.end_offset) for t in tokens]
+
+
+class ExamplePlugin(Plugin):
+    name = "example-plugin"
+
+    def node_settings(self) -> dict:
+        return {"example.greeting": "hello from example-plugin"}
+
+    def rest_routes(self, controller, node) -> None:
+        def example(request):
+            return 200, {"greeting": node.settings.get(
+                "example.greeting"), "node": node.node_name}
+
+        def site(request):
+            return 200, {"_site": "<html><body>example site</body></html>"}
+        controller.register("GET", "/_example", example)
+        controller.register("GET", "/_plugin/example-plugin/", site)
+
+    def analysis(self, registry) -> None:
+        registry.filter_factories["example_shout"] = \
+            lambda params: _shout_filter
+
+    def script_functions(self) -> dict:
+        return {"example_double": lambda x: x * 2.0}
+
+    def query_parsers(self) -> dict:
+        from elasticsearch_tpu.search import query_dsl as q
+
+        def parse_example_all(body):
+            return q.MatchAllQuery(boost=float(body.get("boost", 1.0)))
+        return {"example_all": parse_example_all}

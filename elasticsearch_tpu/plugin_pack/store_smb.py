@@ -1,0 +1,41 @@
+"""store-smb — SMB-safe store types.
+
+Reference: plugins/store-smb (SmbMmapFsIndexStore / SmbSimpleFsIndexStore):
+on SMB/CIFS mounts, Windows mmap handles break on in-place file
+replacement, so the plugin ships store types that either force simple
+(non-mmap) IO or an SMB-tolerant mmap. Here the same two names register
+into the `index.store.type` registry (`index/segment.py:STORE_TYPES`):
+
+* ``smb_simple_fs`` → eager uncompressed reads (no mmap handles held
+  over the share — the SimpleFSDirectory discipline);
+* ``smb_mmap_fs``  → the per-column mmap layout (the share is declared
+  mmap-safe by the operator, SmbMmapFsDirectoryService).
+
+Registration is refcounted through the PluginsService undo log, so a
+node stopping does not unregister types another embedded node uses.
+"""
+
+from __future__ import annotations
+
+from elasticsearch_tpu.plugins import Plugin
+
+
+class SmbStorePlugin(Plugin):
+    name = "store-smb"
+
+    def __init__(self):
+        self._undo: list = []
+
+    def on_node_start(self, node) -> None:
+        from elasticsearch_tpu.index.segment import STORE_TYPES
+        from elasticsearch_tpu.plugins import (
+            _global_register, _global_unregister)
+        self._unregister = _global_unregister
+        for name, layout in (("smb_simple_fs", "uncompressed"),
+                             ("smb_mmap_fs", "npy_dir")):
+            _global_register(STORE_TYPES, name, layout, self._undo)
+
+    def on_node_stop(self, node) -> None:
+        for registry, key in self._undo:
+            self._unregister(registry, key)
+        self._undo = []

@@ -1,0 +1,139 @@
+"""index.store.type seam + store-smb + example plugin (SURVEY §2.9:
+store-smb, jvm-example/site-example — the last plugin-pack rows).
+
+The store types change the on-disk segment layout (compressed npz /
+uncompressed npz / per-column mmap'd .npy) but NOT semantics: a flushed
+engine reopens identically under every type.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.index.segment import STORE_TYPES, Segment
+from elasticsearch_tpu.node import Node
+
+
+def _engine(tmp_path, store_type=None):
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.index.engine import Engine
+    from elasticsearch_tpu.mapping.mapper import MapperService
+    svc = MapperService()
+    svc.merge("_doc", {"properties": {
+        "body": {"type": "text"}, "n": {"type": "long"}}})
+    settings = {}
+    if store_type is not None:
+        settings["index.store.type"] = store_type
+    return Engine(tmp_path, svc, settings=Settings(settings)), svc
+
+
+@pytest.mark.parametrize("store_type",
+                         ["fs", "niofs", "mmapfs", "simple_fs"])
+def test_flush_reopen_roundtrip_per_store_type(tmp_path, store_type):
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.index.engine import Engine
+    eng, svc = _engine(tmp_path / store_type, store_type)
+    for i in range(7):
+        eng.index(str(i), {"body": f"tok{i} shared", "n": i})
+    eng.flush()
+    eng.close()
+    eng2 = Engine(tmp_path / store_type, svc,
+                  settings=Settings({"index.store.type": store_type}))
+    try:
+        segs = eng2.acquire_searcher().segments
+        assert sum(s.num_docs for s in segs) == 7
+        got = sorted(
+            int(v) for s in segs
+            for v, e in zip(np.asarray(s.numeric_fields["n"].values),
+                            np.asarray(s.numeric_fields["n"].exists))
+            if e)
+        assert len(got) == 7
+    finally:
+        eng2.close()
+
+
+def test_mmapfs_layout_is_per_column_mmap(tmp_path):
+    eng, svc = _engine(tmp_path, "mmapfs")
+    eng.index("1", {"body": "hello world", "n": 1})
+    eng.flush()
+    eng.close()
+    seg_dirs = list(tmp_path.glob("seg_*"))
+    assert seg_dirs and (seg_dirs[0] / "arrays").is_dir()
+    assert not (seg_dirs[0] / "arrays.npz").exists()
+    seg = Segment.read(seg_dirs[0])
+    col = seg.numeric_fields["n"].values
+    assert isinstance(col, np.memmap)       # OS-paged, not eager
+
+
+def test_unknown_store_type_raises(tmp_path):
+    eng, _ = _engine(tmp_path, "smb_mmap_fs")   # plugin NOT loaded
+    eng.index("1", {"body": "x", "n": 1})
+    with pytest.raises(IllegalArgumentError):
+        eng.flush()
+    eng.close()
+
+
+def test_smb_store_plugin_registers_types(tmp_path):
+    from elasticsearch_tpu.plugin_pack.store_smb import SmbStorePlugin
+    assert "smb_mmap_fs" not in STORE_TYPES
+    node = Node({"plugins": [SmbStorePlugin()]},
+                data_path=tmp_path / "n").start()
+    try:
+        assert STORE_TYPES["smb_mmap_fs"] == "npy_dir"
+        assert STORE_TYPES["smb_simple_fs"] == "uncompressed"
+        node.indices_service.create_index("smb", {"settings": {
+            "number_of_shards": 1, "number_of_replicas": 0,
+            "index.store.type": "smb_simple_fs"}})
+        node.index_doc("smb", "1", {"f": "v"}, refresh=True)
+        node.broadcast_actions.flush("smb")
+        assert node.search("smb", {"size": 0})["hits"]["total"] == 1
+    finally:
+        node.close()
+    assert "smb_mmap_fs" not in STORE_TYPES     # refcounted unregister
+
+
+def test_example_plugin_exercises_every_seam(tmp_path):
+    from elasticsearch_tpu.plugin_pack.example_plugin import ExamplePlugin
+    from elasticsearch_tpu.rest.controller import RestController
+    node = Node({"plugins": [ExamplePlugin()]},
+                data_path=tmp_path / "n").start()
+    try:
+        # node_settings merged under user settings
+        assert node.settings.get("example.greeting") == \
+            "hello from example-plugin"
+        # rest routes (ExampleRestAction + site-example analogs)
+        controller = RestController()
+        node.plugins_service.apply_rest(controller, node)
+        status, body = controller.dispatch("GET", "/_example", None, None)
+        assert status == 200 and "greeting" in body
+        status, body = controller.dispatch(
+            "GET", "/_plugin/example-plugin/", None, None)
+        assert status == 200 and "_site" in body
+        # analysis filter factory
+        node.indices_service.create_index("ex", {"settings": {
+            "number_of_shards": 1, "number_of_replicas": 0,
+            "analysis": {"analyzer": {"loud": {
+                "type": "custom", "tokenizer": "standard",
+                "filter": ["example_shout"]}}}},
+            "mappings": {"doc": {"properties": {
+                "t": {"type": "text", "analyzer": "loud"}}}}})
+        node.index_doc("ex", "1", {"t": "hello"}, refresh=True)
+        assert node.search("ex", {"query": {"match": {"t": "hello"}}}
+                           )["hits"]["total"] == 1
+        # query parser seam
+        assert node.search("ex", {"query": {"example_all": {}}}
+                           )["hits"]["total"] == 1
+    finally:
+        node.close()
+
+
+def test_unknown_store_type_rejected_at_create(tmp_path):
+    node = Node({}, data_path=tmp_path / "n").start()
+    try:
+        with pytest.raises(IllegalArgumentError):
+            node.indices_service.create_index("bad", {"settings": {
+                "number_of_shards": 1,
+                "index.store.type": "no_such_store"}})
+        assert not node.indices_service.has_index("bad")
+    finally:
+        node.close()
