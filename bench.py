@@ -415,6 +415,7 @@ def main() -> int:
         ms_map.merge("_doc", {"properties": {
             "body": {"type": "text", "analyzer": "whitespace"},
             "rank": {"type": "double"},
+            "cat": {"type": "keyword"},
             "vec": {"type": "dense_vector", "dims": max(vec_dims, 1)}}})
         eng = Engine(Path(tempfile.mkdtemp(prefix="bench_engine_")), ms_map)
         # install as power-of-2-bucketed segments of <=2^20 rows — the
@@ -429,13 +430,22 @@ def main() -> int:
             "BENCH_POSITIONS",
             "1" if n_docs <= 2_000_000 else "0") == "1"
         from elasticsearch_tpu.index.segment import (
-            NumericFieldColumn, VectorFieldColumn, doc_count_bucket)
+            KeywordFieldColumn, NumericFieldColumn, VectorFieldColumn,
+            doc_count_bucket)
         # BASELINE configs 3/4 need doc-values + vector columns: a numeric
         # "rank" everywhere; unit vectors only while they fit HBM
         with_vectors = os.environ.get(
             "BENCH_VECTORS",
             "1" if n_docs <= 1_200_000 else "0") == "1" and vec_dims > 0
         rank_all = rng.random(n_docs).astype(np.float64) * 100.0
+        # keyword category column — the generalized-plane bench sorts by
+        # rank and reduces a terms agg over this in-program. Drawn from a
+        # CHILD generator: inserting a draw into the shared stream would
+        # silently change every later seeded draw (vectors, queries)
+        # and break cross-commit comparability of recorded numbers.
+        cat_names = [f"cat{i:02d}" for i in range(16)]
+        cat_all = np.random.default_rng(4242).integers(
+            0, 16, n_docs).astype(np.int32)
         n_segs = -(-n_docs // seg_rows)
         for lo in range(0, n_docs, seg_rows):
             hi = min(lo + seg_rows, n_docs)
@@ -770,6 +780,18 @@ def main() -> int:
                     doc_len=spad(lens, 0), df=seg_df, num_docs=rows,
                     ids=[str(lo + i) for i in range(rows)] +
                         [""] * (np_rows - rows))
+                if os.environ.get("BENCH_MESH", "1") == "1":
+                    # only the generalized-plane config reads these;
+                    # readers eagerly upload every column, so attaching
+                    # them unconditionally would carry ~12 B/doc of
+                    # never-read HBM through the RPC-only configs
+                    s5_exists = np.zeros(np_rows, bool)
+                    s5_exists[:rows] = True
+                    seg.numeric_fields["rank"] = NumericFieldColumn(
+                        values=spad(rank_all, 0.0), exists=s5_exists)
+                    seg.keyword_fields["cat"] = KeywordFieldColumn(
+                        vocab=list(cat_names),
+                        ords=spad(cat_all[:, None], -1))
                 e5 = Engine(Path(tempfile.mkdtemp(prefix="bench_s5_")),
                             ms_map)
                 e5.install_segment(seg, track_versions=False)
@@ -909,6 +931,78 @@ def main() -> int:
                     f"{configs['mesh_8shard_top1000']['qps']} QPS "
                     f"(parity_ok={mesh_ok}, pack {pack_s:.1f}s, "
                     f"compile {mesh_compile:.1f}s)")
+
+                # ---- generalized plane: the SAME config-5 corpus with a
+                # numeric sort + terms agg, all in-program (round-5
+                # eligibility expansion — sort keys ride the all_gather
+                # merge, bucket counts reduce over the shard axis)
+                gbodies = [{"query": {"match": {"body": tx}}, "size": k5,
+                            "sort": [{"rank": {"order": "desc"}}],
+                            "aggs": {"by_cat": {"terms": {
+                                "field": "cat", "size": 8}}}}
+                           for tx in texts[:batch * 4]]
+                t0 = time.perf_counter()
+                out_g = msearch.search_batch(gbodies[:batch])
+                gen_compile = time.perf_counter() - t0
+                # parity q0: totals, rank-descending order, bucket counts
+                # vs a brute-force numpy oracle over the packed corpus
+                qt = np.array(
+                    [term_names.index(w) for w in texts[0].split()
+                     if w in term_names], np.int64)
+                # uterms may carry kernel-section pad rows past n_docs
+                hit = np.isin(uterms[:n_docs], qt).any(axis=1)
+                gen_ok = out_g[0]["total"] == int(hit.sum())
+                hit_idx = np.nonzero(hit)[0]
+                want_ids = [str(hit_idx[j]) for j in
+                            np.argsort(-rank_all[hit_idx],
+                                       kind="stable")[:k5]]
+                got_ids = [msearch.doc_id(d)
+                           for d in out_g[0]["doc_ids"]]
+                if got_ids != want_ids:
+                    # dd (f32 hi, lo) sort keys carry ~49-bit mantissas
+                    # vs the oracle's f64: colliding ranks may reorder
+                    # at the boundary — same tolerance as the mesh
+                    # parity block above
+                    g_overlap = len(set(got_ids) & set(want_ids)) / \
+                        max(len(want_ids), 1)
+                    if g_overlap < 0.999:
+                        log(f"[bench] generalized-plane sort parity "
+                            f"FAIL: overlap {g_overlap:.4f}")
+                        gen_ok = False
+                    else:
+                        log(f"[bench] generalized-plane sort parity: "
+                            f"id-order differs, set overlap "
+                            f"{g_overlap:.4f}")
+                from collections import Counter as _Counter
+                cnt = _Counter(int(c) for c in cat_all[hit])
+                want_buckets = sorted(
+                    ((cat_names[t], n) for t, n in cnt.items()),
+                    key=lambda kv: (-kv[1], kv[0]))[:8]
+                got_buckets = [
+                    (b["key"], b["doc_count"]) for b in
+                    out_g[0]["aggregations"]["by_cat"]["buckets"]]
+                gen_ok = gen_ok and got_buckets == want_buckets
+                gmb = [gbodies[i:i + batch]
+                       for i in range(0, len(gbodies), batch)]
+                t0 = time.perf_counter()
+                msearch.search_batch(gmb[0])
+                per_g = time.perf_counter() - t0
+                todo_g = len(gmb) if per_g < 2.0 else 1
+                t0 = time.perf_counter()
+                with ThreadPoolExecutor(n_threads) as pool:
+                    list(pool.map(msearch.search_batch, gmb[:todo_g]))
+                dt_g = time.perf_counter() - t0
+                done_g = sum(len(b) for b in gmb[:todo_g])
+                configs["mesh_8shard_sorted_terms_agg"] = {
+                    "qps": round(done_g / dt_g, 2),
+                    "ms_per_batch": round(dt_g / todo_g * 1e3, 2),
+                    "parity_ok": gen_ok,
+                    "compile_s": round(gen_compile, 1), "spd": 8}
+                log(f"[bench] config mesh_8shard_sorted_terms_agg "
+                    f"(rank sort + terms agg in-program): "
+                    f"{configs['mesh_8shard_sorted_terms_agg']['qps']} "
+                    f"QPS (parity_ok={gen_ok}, "
+                    f"compile {gen_compile:.1f}s)")
             shard_pool.shutdown(wait=False)
             for e5 in engines5:
                 e5.close()
