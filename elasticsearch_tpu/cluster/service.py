@@ -130,7 +130,20 @@ class ClusterService:
 
         def run():
             try:
-                if new.version > self._state.version:
+                # same-master states apply in version order; a state from
+                # a DIFFERENT master (or arriving while we have none)
+                # supersedes regardless of version — a node whose local
+                # version ran ahead during a partition (fault-detection
+                # removals bump it) must still adopt the newly elected
+                # master's state after rejoining, or it silently drops
+                # every publish until the master's version catches up
+                # (ZenDiscovery.processNextPendingClusterState: the
+                # version gate applies only when the state is from the
+                # current master). Stale-master states never get here:
+                # the publish receive path rejects senders that differ
+                # from the master we already follow.
+                if new.version > self._state.version or \
+                        new.master_node_id != self._state.master_node_id:
                     self.apply_new_state(new)
                 fut.set_result(self._state)
             except Exception as e:              # noqa: BLE001 → future

@@ -17,6 +17,15 @@ from elasticsearch_tpu.transport.service import DiscoveryNode, TransportService
 MASTER_PING_ACTION = "internal:discovery/zen/fd/master_ping"
 NODE_PING_ACTION = "internal:discovery/zen/fd/ping"
 
+# remote error types that mean "the peer answered and said NO" — identity
+# facts, not liveness flakes; both fault detectors skip the retry budget
+# for them (the reference fails fast on these too instead of re-pinging)
+_REJECTION_TYPES = ("NotTheMasterError", "NodeNotPartOfClusterError")
+
+
+def _is_rejection(e: Exception) -> bool:
+    return getattr(e, "error_type", None) in _REJECTION_TYPES
+
 
 class _Pinger(threading.Thread):
     def __init__(self, name: str, interval: float, fn):
@@ -81,8 +90,14 @@ class MasterFaultDetection:
                  "source_id": self.transport.local_node.node_id},
                 timeout=self.timeout)
             self._failures = 0
-        except Exception:                        # noqa: BLE001 — count it
-            self._failures += 1
+        except Exception as e:                   # noqa: BLE001 — count it
+            # an explicit "I am not the master" answer is a fact, not a
+            # flake: rejoin NOW instead of burning the retry budget (the
+            # reference's MasterFaultDetection retries only on timeouts)
+            if _is_rejection(e):
+                self._failures = self.retries
+            else:
+                self._failures += 1
             if self._failures >= self.retries:
                 self.stop()
                 if self.on_master_failure is not None:
@@ -155,10 +170,17 @@ class NodesFaultDetection:
                     timeout=self.timeout)
                 with self._lock:
                     self._failures[node.node_id] = 0
-            except Exception:                    # noqa: BLE001 — count it
+            except Exception as e:               # noqa: BLE001 — count it
                 with self._lock:
-                    self._failures[node.node_id] = \
-                        self._failures.get(node.node_id, 0) + 1
+                    # a rejection ("I follow another master" / "wrong
+                    # node id") trips immediately — this is how a stale
+                    # master that healed back from a partition learns the
+                    # cluster moved on within ONE ping interval, instead
+                    # of serving a second state lineage for retries x
+                    # timeout more seconds
+                    self._failures[node.node_id] = self.retries \
+                        if _is_rejection(e) \
+                        else self._failures.get(node.node_id, 0) + 1
                     tripped = self._failures[node.node_id] >= self.retries
                     if tripped:
                         self._nodes.pop(node.node_id, None)

@@ -28,12 +28,24 @@ COMMIT_ACTION = "internal:discovery/zen/publish/commit"
 MAX_PENDING_STATES = 25
 
 
+class FailedToCommitClusterStateError(Exception):
+    """Raised when fewer than minimum_master_nodes master-eligible nodes
+    acked a published state: the master must NOT apply it (the reference's
+    Discovery.FailedToCommitClusterStateException discipline) — committing
+    without a quorum is how a partitioned minority master builds a second
+    state lineage that acks writes the healed cluster never saw."""
+
+
 class PublishClusterStateAction:
     def __init__(self, transport: TransportService, cluster_service,
                  publish_timeout: float = 10.0):
         self.transport = transport
         self.cluster_service = cluster_service
         self.publish_timeout = publish_timeout
+        # how many master-eligible acks (local node included) a state
+        # needs before commit; discovery points this at its
+        # minimum_master_nodes setting
+        self.required_acks_fn = lambda: 1
         self._lock = threading.Lock()
         self._pending: OrderedDict[str, ClusterState] = OrderedDict()
         # last state each peer acked — governs diff vs full (the reference
@@ -88,6 +100,17 @@ class PublishClusterStateAction:
         for node in acked:
             self._peer_state[node.node_id] = (new.version, new.state_uuid)
 
+        # quorum gate: commit only with minimum_master_nodes
+        # master-eligible acks (ourselves included) — otherwise the whole
+        # update fails and nothing applies anywhere
+        eligible_acks = sum(1 for n in acked if n.master_eligible) + \
+            (1 if self.transport.local_node.master_eligible else 0)
+        required = self.required_acks_fn()
+        if eligible_acks < required:
+            raise FailedToCommitClusterStateError(
+                f"state v{new.version}: only {eligible_acks} of "
+                f"{required} required master-eligible acks")
+
         # phase 2: commit — apply locally first (master applies what it
         # publishes even if some peers missed it; FD will handle them)
         self.cluster_service.apply_new_state(new)
@@ -109,6 +132,19 @@ class PublishClusterStateAction:
             state = ClusterState.apply_diff(base, diff)   # raises → resend
         else:
             state = ClusterState.from_wire_dict(request["full"])
+        # a node already following a master accepts publishes only from
+        # that master (ZenDiscovery's from-current-master validation): a
+        # stale master that healed back from a partition must get a nack
+        # — not buffer a state that could later commit over the real
+        # master's — and the nack is what tells it to step down & rejoin
+        local = self.cluster_service.state()
+        if local.master_node_id is not None and \
+                state.master_node_id is not None and \
+                state.master_node_id != local.master_node_id:
+            raise ValueError(
+                f"rejecting cluster state v{state.version} from "
+                f"[{state.master_node_id}]: already following "
+                f"[{local.master_node_id}]")
         with self._lock:
             self._pending[state.state_uuid] = state
             while len(self._pending) > MAX_PENDING_STATES:
@@ -121,5 +157,18 @@ class PublishClusterStateAction:
         if state is None:
             raise IncompatibleClusterStateVersionError(
                 f"no pending state {request['uuid']}")
+        # re-validate against the CURRENT master: the state may have been
+        # buffered before this node switched masters (fd dropped the old
+        # one mid-publish), and a deposed master's late commit must not
+        # flip us back onto its dead lineage — same rule as the publish
+        # receive path, re-checked because _pending outlives the switch
+        local = self.cluster_service.state()
+        if local.master_node_id is not None and \
+                state.master_node_id is not None and \
+                state.master_node_id != local.master_node_id:
+            raise ValueError(
+                f"rejecting commit of v{state.version} from "
+                f"[{state.master_node_id}]: already following "
+                f"[{local.master_node_id}]")
         self.cluster_service.apply_published_state(state).result(30.0)
         return {}

@@ -49,6 +49,7 @@ class ZenDiscovery:
         self.ping_timeout = ping_timeout
         self.publisher = PublishClusterStateAction(transport, cluster_service,
                                                    publish_timeout)
+        self.publisher.required_acks_fn = lambda: self.min_master_nodes
         self.master_fd = MasterFaultDetection(transport, fd_interval,
                                               fd_timeout, fd_retries)
         self.nodes_fd = NodesFaultDetection(transport, fd_interval,
@@ -116,7 +117,25 @@ class ZenDiscovery:
     # ---- publish (master → everyone) --------------------------------------
 
     def publish(self, new: ClusterState, old: ClusterState) -> None:
-        self.publisher.publish(new, old)
+        from elasticsearch_tpu.discovery.publish import (
+            FailedToCommitClusterStateError)
+        try:
+            self.publisher.publish(new, old)
+        except FailedToCommitClusterStateError:
+            # we could not assemble a master-eligible quorum for this
+            # state: we are (at best) a minority master. Step down NOW
+            # and rejoin (ZenDiscovery rejoins on failed publish) — the
+            # failed update's caller sees the exception, nothing applied.
+            # This runs on the cluster-service executor, so mutating
+            # directly is the serialized path.
+            current = self.cluster_service.state()
+            if current.master_node_id == self.transport.local_node.node_id:
+                self.cluster_service.apply_new_state(current.with_(
+                    master_node_id=None,
+                    blocks=current.blocks | {NO_MASTER_BLOCK},
+                    version=current.version))
+                self._ensure_join_thread()
+            raise
 
     # ---- ping / election ---------------------------------------------------
 
@@ -124,8 +143,23 @@ class ZenDiscovery:
         from elasticsearch_tpu.transport.stream import (
             MINIMUM_COMPATIBLE_VERSION)
         local = self.transport.local_node
+        # ping the configured seeds PLUS every node of the last cluster
+        # state (UnicastZenPing builds its target set the same way via
+        # its ClusterState provider): a node that joined after boot —
+        # e.g. a replacement for a dead seed — must still be countable
+        # toward the election quorum after the master is lost, even
+        # though no static unicast entry names it
+        targets = list(self.seed_provider())
+        seen = set(targets)
+        try:
+            for n in self.cluster_service.state().nodes.values():
+                if n.address not in seen:
+                    seen.add(n.address)
+                    targets.append(n.address)
+        except Exception:                        # noqa: BLE001 — pre-state
+            pass
         responses = []
-        for addr in self.seed_provider():
+        for addr in targets:
             if addr == local.address:
                 continue
             # first contact: the peer's wire version is unknown, so ping

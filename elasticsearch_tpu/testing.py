@@ -116,11 +116,27 @@ class InternalTestCluster:
 
     def master(self) -> Node:
         """The node that currently believes it is master (and is seen as
-        master by a majority of live nodes)."""
-        for n in self.nodes:
-            if n._started and n.is_master:
+        master by a majority of live nodes). Right after a partition
+        heals, a deposed master may claim mastership for one more fd
+        ping interval — counting every live node's view keeps tests from
+        addressing that second state lineage."""
+        live = [n for n in self.nodes if n._started]
+        claims = [n for n in live if n.is_master]
+        # no single-claimant shortcut: right after a partition heals the
+        # deposed minority master can briefly be the ONLY claimant (the
+        # majority side mid-re-election claims nothing) — only majority
+        # backing makes a claim real
+        votes: dict[str, int] = {}
+        for n in live:
+            mid = n.cluster_service.state().master_node_id
+            if mid is not None:
+                votes[mid] = votes.get(mid, 0) + 1
+        for n in claims:
+            if votes.get(n.node_id, 0) > len(live) // 2:
                 return n
-        raise RuntimeError("no master")
+        raise RuntimeError(f"no majority master (claims="
+                           f"{[n.node_name for n in claims]}, "
+                           f"votes={votes})")
 
     def non_masters(self) -> list[Node]:
         return [n for n in self.nodes if n._started and not n.is_master]

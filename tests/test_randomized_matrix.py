@@ -116,6 +116,23 @@ def _scenario_kill_replica_holder(c, rnd):
         a.index_doc("m_kill", str(i), {"n": i})
     victim = c.nodes[rnd.randrange(1, len(c.nodes))]
     c.stop_node(victim, graceful=False)
+    # first the SURVIVORS must absorb the lost replica and reach green —
+    # adding the replacement before this wait would let the fresh node
+    # take the replica and mask a broken re-allocation path
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        h = c.nodes[0].wait_for_health(None, timeout=1.0)
+        if h["number_of_nodes"] == len(c.nodes) and \
+                h["status"] == "green":
+            break
+        time.sleep(0.2)
+    _green(c.nodes[0], timeout=10)
+    # then replace the killed node so later scenarios see the drawn
+    # cluster shape — the quorum (minimum_master_nodes) was fixed at
+    # creation time from that shape, and a permanently shrunk cluster
+    # can no longer afford losing a minority (InternalTestCluster
+    # restarts nodes rather than shrinking, InternalTestCluster.java)
+    c.add_node()
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
         h = c.nodes[0].wait_for_health(None, timeout=1.0)
@@ -180,7 +197,14 @@ def _scenario_partition_minority(c, rnd):
     _green(a)
     for i in range(20):
         a.index_doc("m_part", str(i), {"n": i})
-    n_minority = rnd.randint(1, (len(c.nodes) - 1) // 2)
+    # the isolated majority must still hold an election quorum — being a
+    # majority of the CURRENT node list is not enough if the cluster ever
+    # shrank below its creation-time minimum_master_nodes
+    quorum = int(c.settings.get("discovery.zen.minimum_master_nodes", 1))
+    max_minority = min((len(c.nodes) - 1) // 2, len(c.nodes) - quorum)
+    if max_minority < 1:
+        pytest.skip("no minority can be isolated without losing quorum")
+    n_minority = rnd.randint(1, max_minority)
     minority = rnd.sample(c.nodes, n_minority)
     majority = [n for n in c.nodes if n not in minority]
     with NetworkPartition(minority, majority).applied():
