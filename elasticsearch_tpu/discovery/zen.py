@@ -22,7 +22,8 @@ from elasticsearch_tpu.cluster.state import (
 from elasticsearch_tpu.cluster.service import URGENT, ClusterService
 from elasticsearch_tpu.discovery.fd import (
     MasterFaultDetection, NodesFaultDetection, NotTheMasterError)
-from elasticsearch_tpu.discovery.publish import PublishClusterStateAction
+from elasticsearch_tpu.discovery.publish import (
+    FailedToCommitClusterStateError, PublishClusterStateAction)
 from elasticsearch_tpu.transport.service import (
     DiscoveryNode, TransportAddress, TransportService)
 
@@ -117,8 +118,6 @@ class ZenDiscovery:
     # ---- publish (master → everyone) --------------------------------------
 
     def publish(self, new: ClusterState, old: ClusterState) -> None:
-        from elasticsearch_tpu.discovery.publish import (
-            FailedToCommitClusterStateError)
         try:
             self.publisher.publish(new, old)
         except FailedToCommitClusterStateError:

@@ -71,6 +71,20 @@ def _green(node, timeout=30):
     return h
 
 
+def _wait_nodes_green(c, timeout=30):
+    """Poll until some node sees the full membership AND green, then
+    assert green — the one wait discipline for every scenario that
+    changes membership."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        h = c.nodes[0].wait_for_health(None, timeout=1.0)
+        if h["number_of_nodes"] == len(c.nodes) and \
+                h["status"] == "green":
+            break
+        time.sleep(0.2)
+    _green(c.nodes[0], timeout=10)
+
+
 @pytest.mark.parametrize("scenario", SAMPLED)
 def test_matrix_scenario(cluster, scenario):
     globals()[f"_scenario_{scenario}"](cluster, _rnd(scenario))
@@ -119,28 +133,14 @@ def _scenario_kill_replica_holder(c, rnd):
     # first the SURVIVORS must absorb the lost replica and reach green —
     # adding the replacement before this wait would let the fresh node
     # take the replica and mask a broken re-allocation path
-    deadline = time.monotonic() + 30
-    while time.monotonic() < deadline:
-        h = c.nodes[0].wait_for_health(None, timeout=1.0)
-        if h["number_of_nodes"] == len(c.nodes) and \
-                h["status"] == "green":
-            break
-        time.sleep(0.2)
-    _green(c.nodes[0], timeout=10)
+    _wait_nodes_green(c)
     # then replace the killed node so later scenarios see the drawn
     # cluster shape — the quorum (minimum_master_nodes) was fixed at
     # creation time from that shape, and a permanently shrunk cluster
     # can no longer afford losing a minority (InternalTestCluster
     # restarts nodes rather than shrinking, InternalTestCluster.java)
     c.add_node()
-    deadline = time.monotonic() + 30
-    while time.monotonic() < deadline:
-        h = c.nodes[0].wait_for_health(None, timeout=1.0)
-        if h["number_of_nodes"] == len(c.nodes) and \
-                h["status"] == "green":
-            break
-        time.sleep(0.2)
-    _green(c.nodes[0], timeout=10)
+    _wait_nodes_green(c)
     c.nodes[0].broadcast_actions.refresh("m_kill")
     assert c.nodes[0].search("m_kill", {"size": 0})["hits"]["total"] \
         == n_docs
@@ -223,13 +223,7 @@ def _scenario_partition_minority(c, rnd):
             time.sleep(0.2)
         assert surviving is not None, "majority never converged"
         surviving.index_doc("m_part", "during", {"n": 99})
-    deadline = time.monotonic() + 30
-    while time.monotonic() < deadline:
-        h = c.nodes[0].wait_for_health(None, timeout=1.0)
-        if h["number_of_nodes"] == len(c.nodes) and \
-                h["status"] == "green":
-            break
-        time.sleep(0.2)
+    _wait_nodes_green(c)
     m = c.master()
     m.broadcast_actions.refresh("m_part")
     assert m.search("m_part", {"size": 0})["hits"]["total"] == 21
