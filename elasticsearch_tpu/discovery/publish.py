@@ -46,6 +46,11 @@ class PublishClusterStateAction:
         # needs before commit; discovery points this at its
         # minimum_master_nodes setting
         self.required_acks_fn = lambda: 1
+        # the master this node is currently joining/voting for while it
+        # has none (zen's election winner) — a masterless node must not
+        # ack a publish from anyone else, or its ack props up a stale
+        # master's commit quorum it never agreed to join
+        self.expected_master_fn = lambda: None
         self._lock = threading.Lock()
         self._pending: OrderedDict[str, ClusterState] = OrderedDict()
         # last state each peer acked — governs diff vs full (the reference
@@ -125,26 +130,39 @@ class PublishClusterStateAction:
 
     # ---- receiving side ----------------------------------------------------
 
+    def _validate_publisher(self, sender_id: str) -> None:
+        """A node accepts publishes/commits ONLY from (a) the master it
+        follows, or (b) while masterless, the master it is currently
+        joining (zen's election winner) — ZenDiscovery's from-current-
+        master validation plus the join fence. Without (b), a node whose
+        master-fd false-tripped would ack a healed stale master's
+        publish, and that ack counts toward the stale commit quorum —
+        two overlapping "quorums" and a second state lineage. The nack
+        is also what tells the stale master to step down & rejoin."""
+        local = self.cluster_service.state()
+        if local.master_node_id is not None:
+            if sender_id != local.master_node_id:
+                raise ValueError(
+                    f"rejecting publish from [{sender_id}]: already "
+                    f"following [{local.master_node_id}]")
+            return
+        expected = self.expected_master_fn()
+        if expected is not None and sender_id != expected:
+            raise ValueError(
+                f"rejecting publish from [{sender_id}]: masterless "
+                f"but joining [{expected}]")
+
     def _handle_publish(self, request: dict, source) -> dict:
+        # validate the SENDER before touching the payload: a stale
+        # master's diff would otherwise fail diff application first and
+        # buy a wasted full-state resend round trip before the real nack
+        self._validate_publisher(source.node_id)
         if "diff" in request:
             diff = request["diff"]
             base = self.cluster_service.state()
             state = ClusterState.apply_diff(base, diff)   # raises → resend
         else:
             state = ClusterState.from_wire_dict(request["full"])
-        # a node already following a master accepts publishes only from
-        # that master (ZenDiscovery's from-current-master validation): a
-        # stale master that healed back from a partition must get a nack
-        # — not buffer a state that could later commit over the real
-        # master's — and the nack is what tells it to step down & rejoin
-        local = self.cluster_service.state()
-        if local.master_node_id is not None and \
-                state.master_node_id is not None and \
-                state.master_node_id != local.master_node_id:
-            raise ValueError(
-                f"rejecting cluster state v{state.version} from "
-                f"[{state.master_node_id}]: already following "
-                f"[{local.master_node_id}]")
         with self._lock:
             self._pending[state.state_uuid] = state
             while len(self._pending) > MAX_PENDING_STATES:
@@ -157,18 +175,10 @@ class PublishClusterStateAction:
         if state is None:
             raise IncompatibleClusterStateVersionError(
                 f"no pending state {request['uuid']}")
-        # re-validate against the CURRENT master: the state may have been
-        # buffered before this node switched masters (fd dropped the old
-        # one mid-publish), and a deposed master's late commit must not
-        # flip us back onto its dead lineage — same rule as the publish
-        # receive path, re-checked because _pending outlives the switch
-        local = self.cluster_service.state()
-        if local.master_node_id is not None and \
-                state.master_node_id is not None and \
-                state.master_node_id != local.master_node_id:
-            raise ValueError(
-                f"rejecting commit of v{state.version} from "
-                f"[{state.master_node_id}]: already following "
-                f"[{local.master_node_id}]")
+        # re-validate at commit time: the state may have been buffered
+        # before this node switched masters (fd dropped the old one
+        # mid-publish), and a deposed master's late commit must not flip
+        # us back onto its dead lineage — _pending outlives the switch
+        self._validate_publisher(source.node_id)
         self.cluster_service.apply_published_state(state).result(30.0)
         return {}

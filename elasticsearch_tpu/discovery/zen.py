@@ -51,6 +51,7 @@ class ZenDiscovery:
         self.publisher = PublishClusterStateAction(transport, cluster_service,
                                                    publish_timeout)
         self.publisher.required_acks_fn = lambda: self.min_master_nodes
+        self.publisher.expected_master_fn = lambda: self._election_winner
         self.master_fd = MasterFaultDetection(transport, fd_interval,
                                               fd_timeout, fd_retries)
         self.nodes_fd = NodesFaultDetection(transport, fd_interval,

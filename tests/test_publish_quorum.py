@@ -95,28 +95,32 @@ def test_healed_stale_master_rejoins_and_metadata_survives(cluster):
         lambda: len({_master_of(n) for n in c.nodes}) == 1, timeout=10.0)
 
 
-def test_new_master_state_supersedes_regardless_of_version(cluster):
+def test_new_master_state_supersedes_regardless_of_version():
     """ClusterService applies a committed state from a DIFFERENT master
     even when the local version ran ahead; same-master states still apply
-    strictly in version order."""
-    n = cluster.nodes[0]
-    svc = n.cluster_service
-    current = svc.state()
-    ahead = current.with_(version=current.version + 50)
-    svc.apply_new_state(ahead)
-    assert svc.state().version == current.version + 50
+    strictly in version order. Standalone service — mutating a live
+    cluster node's state from the test thread would race its executor."""
+    from elasticsearch_tpu.cluster.service import ClusterService
+    from elasticsearch_tpu.cluster.state import ClusterState
+    base = ClusterState(master_node_id="old-master", version=10)
+    svc = ClusterService(base, node_id="n1")
+    try:
+        ahead = base.with_(version=60)
+        svc.apply_new_state(ahead)
+        assert svc.state().version == 60
 
-    other_master = current.with_(
-        version=current.version + 1,
-        master_node_id="somebody-new")
-    svc.apply_published_state(other_master).result(10.0)
-    assert svc.state().master_node_id == "somebody-new"
-    assert svc.state().version == current.version + 1
+        other_master = base.with_(version=11,
+                                  master_node_id="somebody-new")
+        svc.apply_published_state(other_master).result(10.0)
+        assert svc.state().master_node_id == "somebody-new"
+        assert svc.state().version == 11
 
-    # same master, stale version → ignored
-    stale_same = svc.state().with_(version=1)
-    svc.apply_published_state(stale_same).result(10.0)
-    assert svc.state().version == current.version + 1
+        # same master, stale version → ignored
+        stale_same = svc.state().with_(version=1)
+        svc.apply_published_state(stale_same).result(10.0)
+        assert svc.state().version == 11
+    finally:
+        svc.close()
 
 
 class _RejectingTransport:
