@@ -147,10 +147,15 @@ class PublishClusterStateAction:
                     f"following [{local.master_node_id}]")
             return
         expected = self.expected_master_fn()
-        if expected is not None and sender_id != expected:
+        if expected is None or sender_id != expected:
+            # no join target at all also rejects: right after dropping a
+            # master (winner cleared), that master's LATE commit must not
+            # slip through the gap before the next ping round picks a
+            # target. A legitimate new master's eager publish is nacked
+            # once and accepted after this node joins it.
             raise ValueError(
-                f"rejecting publish from [{sender_id}]: masterless "
-                f"but joining [{expected}]")
+                f"rejecting publish from [{sender_id}]: masterless, "
+                f"joining [{expected}]")
 
     def _handle_publish(self, request: dict, source) -> dict:
         # validate the SENDER before touching the payload: a stale

@@ -130,6 +130,7 @@ class ZenDiscovery:
             # directly is the serialized path.
             current = self.cluster_service.state()
             if current.master_node_id == self.transport.local_node.node_id:
+                self._election_winner = None     # re-decide from pings
                 self.cluster_service.apply_new_state(current.with_(
                     master_node_id=None,
                     blocks=current.blocks | {NO_MASTER_BLOCK},
@@ -410,6 +411,10 @@ class ZenDiscovery:
             current = self.cluster_service.state()
             if current.master_node_id != master.node_id:
                 return
+            # the dropped master must not pass the masterless publish
+            # fence via a stale join target — its late commits are the
+            # thing the fence rejects; the next ping round re-decides
+            self._election_winner = None
             nodes = {nid: n for nid, n in current.nodes.items()
                      if nid != master.node_id}
             # local-only mutation: this node's view drops the master; the
@@ -434,6 +439,11 @@ class ZenDiscovery:
         local_id = self.transport.local_node.node_id
         master_id = new.master_node_id
         if master_id is not None:
+            # the winner tracks the master lineage we actually follow —
+            # kept in sync here so the masterless publish fence compares
+            # against the LAST followed master, however we came to
+            # follow it (join, vote batch, or applied publish)
+            self._election_winner = master_id
             with self._votes_lock:
                 self._pending_joins = {}         # election settled
         if master_id == local_id:

@@ -42,7 +42,7 @@ if _shape_rnd.random() < 0.5:
 
 SCENARIOS = ["crud_search", "kill_replica_holder", "move_primary",
              "partition_minority", "rolling_settings",
-             "snapshot_restore", "scroll_under_writes"]
+             "snapshot_restore", "scroll_under_writes", "node_churn"]
 if os.environ.get("ESTPU_MATRIX_ALL") == "1":
     SAMPLED = list(SCENARIOS)
 else:
@@ -305,6 +305,33 @@ def _scenario_scroll_under_writes(c, rnd):
     assert len(seen) == n_docs, (len(seen), n_docs)
     assert not any(i.startswith("mid-") for i in seen)
     assert len(set(seen)) == n_docs         # no dup across pages
+
+
+def _scenario_node_churn(c, rnd):
+    """Grow the cluster by one node (auto-rebalancing may move shards
+    onto it), then gracefully retire a non-master member — counts stay
+    exact through both membership changes."""
+    a = c.master()
+    shards = rnd.randint(2, 4)
+    a.indices_service.create_index("m_churn", {"settings": {
+        "number_of_shards": shards,
+        "number_of_replicas": min(1, len(c.nodes) - 1)}})
+    _green(a)
+    n_docs = rnd.randint(30, 90)
+    for i in range(n_docs):
+        a.index_doc("m_churn", str(i), {"n": i})
+    a.broadcast_actions.refresh("m_churn")
+    c.add_node()
+    _wait_nodes_green(c)
+    assert c.master().search("m_churn", {"size": 0})["hits"]["total"] \
+        == n_docs
+    # graceful leave: shards drain off the retiree before/after close
+    victims = [n for n in c.nodes if not n.is_master]
+    c.stop_node(victims[rnd.randrange(len(victims))], graceful=True)
+    _wait_nodes_green(c)
+    m = c.master()
+    m.broadcast_actions.refresh("m_churn")
+    assert m.search("m_churn", {"size": 0})["hits"]["total"] == n_docs
 
 
 def _scenario_rolling_settings(c, rnd):
