@@ -1,0 +1,193 @@
+"""Randomized query-DSL fuzzer — compiled-path match sets vs a
+pure-Python oracle.
+
+The reference leans on RandomizedTesting to cross-check query semantics
+(SURVEY §4; e.g. core's SearchQueryIT random bool trees). Here a seeded
+generator builds random bool/constant_score trees over term / match
+(or+and) / terms / prefix / range / match_all leaves, executes them on
+the PRODUCT path (node.search → jit_exec compiled programs, fallback
+asserted zero), and compares the returned doc-id set and total against
+an independent set-algebra oracle evaluated on the raw docs. Scores are
+deliberately out of scope (bm25_oracle covers scoring); this pins the
+boolean/minimum_should_match/filter semantics across the whole
+generator space. Reproduce any failure with the printed ESTPU_TEST_SEED.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from conftest import derive_seed
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search import jit_exec
+
+VOCAB = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta",
+         "theta", "iota", "kappa", "lam", "mu"]
+N_DOCS = 160
+N_QUERIES = 48
+MAX_DEPTH = 3
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rnd = random.Random(derive_seed("dsl-fuzz-corpus"))
+    docs = {}
+    for i in range(N_DOCS):
+        toks = [rnd.choice(VOCAB)
+                for _ in range(rnd.randint(3, 9))]
+        docs[str(i)] = {"t": " ".join(toks), "n": i,
+                        "_toks": set(toks), "_list": toks}
+    return docs
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory, corpus):
+    n = Node({}, data_path=tmp_path_factory.mktemp("fuzz") / "n").start()
+    n.indices_service.create_index(
+        "fz", {"settings": {"number_of_shards": 2,
+                            "number_of_replicas": 0},
+               "mappings": {"_doc": {"properties": {
+                   "t": {"type": "text", "analyzer": "whitespace"},
+                   "n": {"type": "long"}}}}})
+    for i, d in corpus.items():
+        n.index_doc("fz", i, {"t": d["t"], "n": d["n"]})
+    n.broadcast_actions.refresh("fz")
+    yield n
+    n.close()
+
+
+# ---- random query generator ------------------------------------------------
+
+def gen_query(rnd: random.Random, depth: int = 0) -> dict:
+    leaves = ["term", "match_or", "match_and", "terms", "prefix",
+              "range", "match_all", "phrase", "wildcard"]
+    kinds = leaves if depth >= MAX_DEPTH else \
+        leaves + ["bool", "bool", "constant_score"]
+    kind = rnd.choice(kinds)
+    if kind == "term":
+        return {"term": {"t": rnd.choice(VOCAB)}}
+    if kind == "match_or":
+        words = rnd.sample(VOCAB, rnd.randint(1, 3))
+        return {"match": {"t": " ".join(words)}}
+    if kind == "match_and":
+        words = rnd.sample(VOCAB, rnd.randint(1, 2))
+        return {"match": {"t": {"query": " ".join(words),
+                                "operator": "and"}}}
+    if kind == "terms":
+        return {"terms": {"t": rnd.sample(VOCAB, rnd.randint(1, 4))}}
+    if kind == "prefix":
+        w = rnd.choice(VOCAB)
+        return {"prefix": {"t": w[:rnd.randint(1, 3)]}}
+    if kind == "range":
+        lo = rnd.randint(0, N_DOCS)
+        hi = rnd.randint(0, N_DOCS)
+        lo, hi = min(lo, hi), max(lo, hi)
+        body = {}
+        if rnd.random() < 0.8:
+            body["gte" if rnd.random() < 0.5 else "gt"] = lo
+        if rnd.random() < 0.8 or not body:
+            body["lte" if rnd.random() < 0.5 else "lt"] = hi
+        return {"range": {"n": body}}
+    if kind == "match_all":
+        return {"match_all": {}}
+    if kind == "phrase":
+        words = [rnd.choice(VOCAB) for _ in range(rnd.randint(2, 3))]
+        return {"match_phrase": {"t": " ".join(words)}}
+    if kind == "wildcard":
+        w = rnd.choice(VOCAB)
+        pat = w[:rnd.randint(1, 2)] + "*" + (w[-1] if rnd.random() < 0.5
+                                             else "")
+        return {"wildcard": {"t": pat}}
+    if kind == "constant_score":
+        return {"constant_score": {"filter": gen_query(rnd, depth + 1)}}
+    # bool
+    b: dict = {}
+    for clause, p in (("must", 0.6), ("filter", 0.4),
+                      ("should", 0.6), ("must_not", 0.35)):
+        if rnd.random() < p:
+            b[clause] = [gen_query(rnd, depth + 1)
+                         for _ in range(rnd.randint(1, 2))]
+    if not b:
+        b["must"] = [gen_query(rnd, depth + 1)]
+    if "should" in b and rnd.random() < 0.4:
+        b["minimum_should_match"] = rnd.randint(1, len(b["should"]))
+    return {"bool": b}
+
+
+# ---- oracle ----------------------------------------------------------------
+
+def matches(q: dict, doc: dict) -> bool:
+    kind, body = next(iter(q.items()))
+    if kind == "match_all":
+        return True
+    if kind == "term":
+        return body["t"] in doc["_toks"]
+    if kind == "terms":
+        return any(w in doc["_toks"] for w in body["t"])
+    if kind == "prefix":
+        return any(t.startswith(body["t"]) for t in doc["_toks"])
+    if kind == "match":
+        spec = body["t"]
+        if isinstance(spec, dict):
+            words = spec["query"].split()
+            if spec.get("operator") == "and":
+                return all(w in doc["_toks"] for w in words)
+        else:
+            words = spec.split()
+        return any(w in doc["_toks"] for w in words)
+    if kind == "range":
+        n = doc["n"]
+        r = body["n"]
+        return all((
+            n >= r["gte"] if "gte" in r else True,
+            n > r["gt"] if "gt" in r else True,
+            n <= r["lte"] if "lte" in r else True,
+            n < r["lt"] if "lt" in r else True))
+    if kind == "match_phrase":
+        words = body["t"].split()
+        lst = doc["_list"]
+        return any(lst[i:i + len(words)] == words
+                   for i in range(len(lst) - len(words) + 1))
+    if kind == "wildcard":
+        import fnmatch
+        return any(fnmatch.fnmatchcase(t, body["t"])
+                   for t in doc["_toks"])
+    if kind == "constant_score":
+        return matches(body["filter"], doc)
+    if kind == "bool":
+        must = body.get("must", [])
+        filt = body.get("filter", [])
+        should = body.get("should", [])
+        must_not = body.get("must_not", [])
+        if any(matches(m, doc) for m in must_not):
+            return False
+        if not all(matches(m, doc) for m in must + filt):
+            return False
+        if should:
+            msm = body.get("minimum_should_match")
+            if msm is None:
+                # pure-should bool: at least one must match; with
+                # must/filter present, should is optional (scoring only)
+                msm = 0 if (must or filt) else 1
+            if sum(1 for s in should if matches(s, doc)) < int(msm):
+                return False
+        return True
+    raise AssertionError(f"oracle hole: {kind}")
+
+
+def test_random_trees_match_oracle(node, corpus):
+    rnd = random.Random(derive_seed("dsl-fuzz-queries"))
+    for qi in range(N_QUERIES):
+        q = gen_query(rnd)
+        jit_exec.clear_cache()
+        out = node.search("fz", {"query": q, "size": N_DOCS + 10})
+        assert jit_exec.cache_stats()["fallbacks"] == 0, \
+            f"compiled path fell back for {q}"
+        got = {h["_id"] for h in out["hits"]["hits"]}
+        want = {i for i, d in corpus.items() if matches(q, d)}
+        assert got == want, (
+            f"query #{qi} {q}: engine={sorted(got - want)[:5]} extra, "
+            f"{sorted(want - got)[:5]} missing of {len(want)}")
+        assert out["hits"]["total"] == len(want), (qi, q)

@@ -123,33 +123,33 @@ def test_new_master_state_supersedes_regardless_of_version():
         svc.close()
 
 
-def test_masterless_fence_requires_join_target(cluster):
+def test_masterless_fence_requires_join_target():
     """While masterless, a node acks ONLY the master it is joining: a
     deposed master's late commit must not slip through the gap after the
-    winner is cleared and before the next ping round."""
-    n = cluster.nodes[0]
-    pub = n.discovery.publisher
-    real_master = n.cluster_service.state().master_node_id
-    # following: only the followed master passes
-    pub._validate_publisher(real_master)
+    winner is cleared and before the next ping round. Standalone
+    publisher object — mutating a live node's publisher would race its
+    real transport handlers."""
+    from elasticsearch_tpu.cluster.state import ClusterState
+    from elasticsearch_tpu.discovery.publish import (
+        PublishClusterStateAction)
+    pub = PublishClusterStateAction.__new__(PublishClusterStateAction)
+    holder = {"s": ClusterState(master_node_id="m1", version=3)}
+    pub.cluster_service = type(
+        "S", (), {"state": lambda self: holder["s"]})()
+    pub.expected_master_fn = lambda: None
+    # following m1: only m1 passes
+    pub._validate_publisher("m1")
     with pytest.raises(ValueError):
         pub._validate_publisher("someone-else")
     # masterless: only the current join target passes; no target → nack
-    orig_state_fn = pub.cluster_service.state
-    masterless = orig_state_fn().with_(master_node_id=None)
-    pub.cluster_service = type("S", (), {"state": lambda s: masterless,
-                                         "apply_published_state": None})()
-    try:
-        n.discovery._election_winner = "joining-b"
-        pub._validate_publisher("joining-b")
-        with pytest.raises(ValueError):
-            pub._validate_publisher("deposed-a")
-        n.discovery._election_winner = None
-        with pytest.raises(ValueError):
-            pub._validate_publisher("deposed-a")
-    finally:
-        pub.cluster_service = n.cluster_service
-        n.discovery._election_winner = real_master
+    holder["s"] = ClusterState(master_node_id=None, version=3)
+    pub.expected_master_fn = lambda: "joining-b"
+    pub._validate_publisher("joining-b")
+    with pytest.raises(ValueError):
+        pub._validate_publisher("deposed-a")
+    pub.expected_master_fn = lambda: None
+    with pytest.raises(ValueError):
+        pub._validate_publisher("deposed-a")
 
 
 class _RejectingTransport:

@@ -326,7 +326,7 @@ def _scenario_node_churn(c, rnd):
     assert c.master().search("m_churn", {"size": 0})["hits"]["total"] \
         == n_docs
     # graceful leave: shards drain off the retiree before/after close
-    victims = [n for n in c.nodes if not n.is_master]
+    victims = c.non_masters()
     c.stop_node(victims[rnd.randrange(len(victims))], graceful=True)
     _wait_nodes_green(c)
     m = c.master()
