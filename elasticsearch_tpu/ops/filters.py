@@ -39,10 +39,29 @@ def _dd_le(hi, lo, qhi, qlo):
     return (hi < qhi) | ((hi == qhi) & (lo <= qlo))
 
 
-def numeric_range(hi, lo, exists, gte_hi, gte_lo, lte_hi, lte_lo):
+def _dd_gt(hi, lo, qhi, qlo):
+    return (hi > qhi) | ((hi == qhi) & (lo > qlo))
+
+
+def _dd_lt(hi, lo, qhi, qlo):
+    return (hi < qhi) | ((hi == qhi) & (lo < qlo))
+
+
+def numeric_range(hi, lo, exists, gte_hi, gte_lo, lte_hi, lte_lo,
+                  lo_strict=None, hi_strict=None):
     """Exact numeric/date range over the double-double column. Open ends use
-    ∓inf for (gte_hi, lte_hi) with 0 lo parts."""
-    return exists & _dd_ge(hi, lo, gte_hi, gte_lo) & _dd_le(hi, lo, lte_hi, lte_lo)
+    ∓inf for (gte_hi, lte_hi) with 0 lo parts. Exclusive bounds pass
+    lo_strict/hi_strict as traced 0/1 scalars — strictness must ride the
+    comparison itself, NOT a nextafter-bumped bound: the f64 neighbor of a
+    small value (e.g. nextafter(0) = 5e-324) underflows the f32 dd split
+    back to the original value, silently turning gt/lt into gte/lte."""
+    ge = _dd_ge(hi, lo, gte_hi, gte_lo)
+    if lo_strict is not None:
+        ge = jnp.where(lo_strict > 0, _dd_gt(hi, lo, gte_hi, gte_lo), ge)
+    le = _dd_le(hi, lo, lte_hi, lte_lo)
+    if hi_strict is not None:
+        le = jnp.where(hi_strict > 0, _dd_lt(hi, lo, lte_hi, lte_lo), le)
+    return exists & ge & le
 
 
 def numeric_term(hi, lo, exists, qhi, qlo):

@@ -368,16 +368,17 @@ def _d_range(node: AggNode, fname: str, state: DeviceAggState,
         for _key, lo, hi in bounds:
             # double-double comparison: exact for dates/large longs where
             # a single f32 bound would blur the boundary. Range semantics
-            # are [from, to): numeric_range is [lo, hi] inclusive, so the
-            # upper bound steps one ulp below `to`.
+            # are [from, to): the upper bound compares STRICTLY (a
+            # nextafter-bumped bound would underflow the dd split for
+            # small `to` values, e.g. to:0, and turn exclusive into
+            # inclusive).
             ghi, glo = dd_split(np.float64(lo))
-            upper = np.nextafter(np.float64(hi), -np.inf) \
-                if hi != np.inf else np.float64(np.inf)
-            lhi, llo = dd_split(upper)
+            lhi, llo = dd_split(np.float64(hi))
             m = filter_ops.numeric_range(
                 col.hi, col.lo, col.exists,
                 jnp.float32(ghi), jnp.float32(glo),
-                jnp.float32(lhi), jnp.float32(llo))
+                jnp.float32(lhi), jnp.float32(llo),
+                hi_strict=jnp.float32(0.0 if hi == np.inf else 1.0))
             row.append((m & mask).sum(dtype=jnp.int32))
         per_seg.append(jnp.stack(row))
     counts = np.asarray(jnp.stack(per_seg)).sum(axis=0)

@@ -646,20 +646,25 @@ class SegmentResolver:
         r_boost = self.c(query.boost, np.float32)
         ncol = self.seg.numeric.get(field)
         if ncol is not None:
-            # gte/gt (and lte/lt) apply independently; effective bound is the
-            # tightest (ES RangeQueryParser applies each given bound).
-            lo_v = -np.inf
+            # gte/gt (and lte/lt) apply independently; effective bound is
+            # the tightest (ES RangeQueryParser applies each given bound).
+            # Exclusivity is a comparison-strictness flag, not a
+            # nextafter-bumped value — the f64 neighbor of a small bound
+            # underflows the f32 dd split (gt:0 would become gte:0).
+            lo_v, lo_strict = -np.inf, False
             if query.gte is not None:
-                lo_v = self._numeric_value(field, query.gte)
+                lo_v = np.float64(self._numeric_value(field, query.gte))
             if query.gt is not None:
-                lo_v = max(lo_v, np.nextafter(np.float64(
-                    self._numeric_value(field, query.gt)), np.inf))
-            hi_v = np.inf
+                g = np.float64(self._numeric_value(field, query.gt))
+                if g >= lo_v:
+                    lo_v, lo_strict = g, True
+            hi_v, hi_strict = np.inf, False
             if query.lte is not None:
-                hi_v = self._numeric_value(field, query.lte)
+                hi_v = np.float64(self._numeric_value(field, query.lte))
             if query.lt is not None:
-                hi_v = min(hi_v, np.nextafter(np.float64(
-                    self._numeric_value(field, query.lt)), -np.inf))
+                l_ = np.float64(self._numeric_value(field, query.lt))
+                if l_ <= hi_v:
+                    hi_v, hi_strict = l_, True
             self.sig("range-num", field)
             ghi, glo = dd_split(lo_v)
             lhi, llo = dd_split(hi_v)
@@ -667,13 +672,16 @@ class SegmentResolver:
             r_glo = self.c(glo, np.float32)
             r_lhi = self.c(lhi, np.float32)
             r_llo = self.c(llo, np.float32)
+            r_gx = self.c(np.float32(1.0 if lo_strict else 0.0))
+            r_lx = self.c(np.float32(1.0 if hi_strict else 0.0))
 
             def emit(em):
                 col = em.seg.numeric[field]
                 mask = filter_ops.numeric_range(
                     col.hi, col.lo, col.exists,
                     em.get(r_ghi), em.get(r_glo),
-                    em.get(r_lhi), em.get(r_llo))
+                    em.get(r_lhi), em.get(r_llo),
+                    lo_strict=em.get(r_gx), hi_strict=em.get(r_lx))
                 return bool_ops.constant_score(mask, em.get(r_boost))
             return emit
         kcol = self.seg.keyword.get(field)
@@ -682,14 +690,17 @@ class SegmentResolver:
             vocab = kcol.column.vocab
             lo_ord = 0
             hi_ord = len(vocab)
+            # tightest-bound combination, same discipline as the numeric
+            # branch (each given bound applies; ordinal intervals make
+            # gt/lt exact without strictness flags)
             if query.gte is not None:
-                lo_ord = _bisect_left(vocab, str(query.gte))
+                lo_ord = max(lo_ord, _bisect_left(vocab, str(query.gte)))
             if query.gt is not None:
-                lo_ord = _bisect_right(vocab, str(query.gt))
+                lo_ord = max(lo_ord, _bisect_right(vocab, str(query.gt)))
             if query.lte is not None:
-                hi_ord = _bisect_right(vocab, str(query.lte))
+                hi_ord = min(hi_ord, _bisect_right(vocab, str(query.lte)))
             if query.lt is not None:
-                hi_ord = _bisect_left(vocab, str(query.lt))
+                hi_ord = min(hi_ord, _bisect_left(vocab, str(query.lt)))
             r_lo = self.c(lo_ord, np.int32)
             r_hi = self.c(hi_ord, np.int32)
 

@@ -1,0 +1,246 @@
+"""Randomized aggregation fuzzer — engine results vs a numpy oracle.
+
+Companion to test_dsl_fuzz.py (the reference's RandomizedTesting
+discipline over core/search/aggregations/): seeded random agg trees —
+terms / histogram / range / filter buckets with one level of random
+metric sub-aggs (min/max/avg/sum/stats/value_count/cardinality) — run
+under a random filter query on the product path, and every bucket key,
+doc_count and metric value must match an independent pure-Python/numpy
+oracle over the same docs. Reproduce failures with ESTPU_TEST_SEED.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+import pytest
+
+from conftest import derive_seed
+from elasticsearch_tpu.node import Node
+
+CATS = [f"c{i}" for i in range(6)]
+VOCAB = ["red", "green", "blue", "amber"]
+N_DOCS = 150
+N_QUERIES = 30
+METRICS = ["min", "max", "avg", "sum", "value_count", "stats",
+           "cardinality"]
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rnd = random.Random(derive_seed("aggs-fuzz-corpus"))
+    docs = []
+    for i in range(N_DOCS):
+        docs.append({"id": str(i),
+                     "k": rnd.choice(CATS),
+                     "n": rnd.randint(0, 99),
+                     "f": round(rnd.uniform(-50, 50), 3),
+                     "t": " ".join(rnd.choice(VOCAB)
+                                   for _ in range(3))})
+    return docs
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory, corpus):
+    n = Node({}, data_path=tmp_path_factory.mktemp("aggfz") / "n").start()
+    n.indices_service.create_index(
+        "az", {"settings": {"number_of_shards": 2,
+                            "number_of_replicas": 0},
+               "mappings": {"_doc": {"properties": {
+                   "k": {"type": "keyword"},
+                   "n": {"type": "long"},
+                   "f": {"type": "double"},
+                   "t": {"type": "text",
+                         "analyzer": "whitespace"}}}}})
+    for d in corpus:
+        n.index_doc("az", d["id"],
+                    {k: v for k, v in d.items() if k != "id"})
+    n.broadcast_actions.refresh("az")
+    yield n
+    n.close()
+
+
+# ---- generators ------------------------------------------------------------
+
+def gen_filter_query(rnd):
+    kind = rnd.choice(["match_all", "term_t", "range_n", "term_k"])
+    if kind == "match_all":
+        return {"match_all": {}}
+    if kind == "term_t":
+        return {"term": {"t": rnd.choice(VOCAB)}}
+    if kind == "term_k":
+        return {"term": {"k": rnd.choice(CATS)}}
+    lo = rnd.randint(0, 80)
+    return {"range": {"n": {"gte": lo, "lte": lo + rnd.randint(5, 60)}}}
+
+
+def gen_metric(rnd):
+    m = rnd.choice(METRICS)
+    field = "k" if m == "cardinality" else rnd.choice(["n", "f"])
+    return m, field, {m: {"field": field}}
+
+
+def gen_agg(rnd):
+    kind = rnd.choice(["terms", "histogram", "range", "filter",
+                       "metric"])
+    if kind == "metric":
+        m, field, spec = gen_metric(rnd)
+        return {"kind": "metric", "m": m, "field": field, "spec": spec}
+    subs = {}
+    sub_specs = {}
+    for i in range(rnd.randint(0, 2)):
+        m, field, spec = gen_metric(rnd)
+        sub_specs[f"s{i}_{m}"] = spec
+        subs[f"s{i}_{m}"] = (m, field)
+    if kind == "terms":
+        spec = {"terms": {"field": "k", "size": 20}}
+    elif kind == "histogram":
+        spec = {"histogram": {"field": "n",
+                              "interval": rnd.choice([5, 10, 25]),
+                              "min_doc_count": 1}}
+    elif kind == "range":
+        edges = sorted(rnd.sample(range(0, 100), 2))
+        spec = {"range": {"field": "n", "ranges": [
+            {"to": edges[0]},
+            {"from": edges[0], "to": edges[1]},
+            {"from": edges[1]}]}}
+    else:
+        spec = {"filter": gen_filter_query(rnd)}
+    if sub_specs:
+        spec = dict(spec)
+        spec["aggs"] = sub_specs
+    return {"kind": kind, "spec": spec, "subs": subs}
+
+
+# ---- oracle ----------------------------------------------------------------
+
+def query_matches(q, d):
+    kind, body = next(iter(q.items()))
+    if kind == "match_all":
+        return True
+    if kind == "term":
+        f, v = next(iter(body.items()))
+        return v in d["t"].split() if f == "t" else d[f] == v
+    r = body["n"]
+    return (d["n"] >= r.get("gte", -10**9)) and \
+        (d["n"] <= r.get("lte", 10**9))
+
+
+def oracle_metric(m, field, docs):
+    vals = [d[field] for d in docs]
+    if m == "value_count":
+        return len(vals)
+    if m == "cardinality":
+        return len(set(vals))
+    if not vals:
+        # reference semantics over an empty bucket: sum is 0.0 (the
+        # empty sum), min/max/avg are null, stats reports count 0
+        if m == "sum":
+            return 0.0
+        return {"count": 0} if m == "stats" else None
+    if m == "min":
+        return min(vals)
+    if m == "max":
+        return max(vals)
+    if m == "sum":
+        return sum(vals)
+    if m == "avg":
+        return sum(vals) / len(vals)
+    return {"count": len(vals), "min": min(vals), "max": max(vals),
+            "sum": sum(vals), "avg": sum(vals) / len(vals)}
+
+
+def close(a, b):
+    if a is None or b is None:
+        return a is None and b is None
+    return math.isclose(float(a), float(b), rel_tol=1e-4, abs_tol=1e-4)
+
+
+def check_metric(m, field, got, docs, ctx):
+    want = oracle_metric(m, field, docs)
+    if m == "stats":
+        assert got["count"] == want["count"], (ctx, got, want)
+        if want["count"]:
+            for key in ("min", "max", "sum", "avg"):
+                assert close(got[key], want[key]), (ctx, key, got, want)
+    elif m in ("value_count", "cardinality"):
+        assert got["value"] == want, (ctx, got, want)
+    else:
+        assert close(got.get("value"), want), (ctx, m, got, want)
+
+
+def check_bucket_subs(subs, bucket, docs, ctx):
+    for name, (m, field) in subs.items():
+        check_metric(m, field, bucket[name], docs, (ctx, name))
+
+
+def test_keyword_range_tightest_bounds(node, corpus):
+    """gte and gt both apply on keyword ranges (tightest wins), matching
+    the numeric branch — gt must not simply overwrite gte."""
+    out = node.search("az", {"query": {"range": {"k": {
+        "gte": "c3", "gt": "c0"}}}, "size": N_DOCS + 10})
+    got = {h["_id"] for h in out["hits"]["hits"]}
+    want = {d["id"] for d in corpus if d["k"] >= "c3"}
+    assert got == want
+
+
+def test_range_agg_exclusive_to_zero(node, corpus):
+    """Regression: range-agg buckets are [from, to) with to compared
+    STRICTLY in the dd kernel — to:0 must not swallow n=0 docs."""
+    out = node.search("az", {"size": 0, "aggs": {"r": {"range": {
+        "field": "n", "ranges": [{"to": 0}, {"from": 0}]}}}})
+    b = out["aggregations"]["r"]["buckets"]
+    assert b[0]["doc_count"] == 0                 # n >= 0 everywhere
+    assert b[1]["doc_count"] == len(corpus)
+
+
+def test_random_agg_trees_match_oracle(node, corpus):
+    rnd = random.Random(derive_seed("aggs-fuzz-queries"))
+    for qi in range(N_QUERIES):
+        q = gen_filter_query(rnd)
+        agg = gen_agg(rnd)
+        out = node.search("az", {"size": 0, "query": q,
+                                 "aggs": {"a": agg["spec"]}})
+        matched = [d for d in corpus if query_matches(q, d)]
+        got = out["aggregations"]["a"]
+        ctx = (qi, q, agg["spec"])
+        assert out["hits"]["total"] == len(matched), ctx
+
+        if agg["kind"] == "metric":
+            check_metric(agg["m"], agg["field"], got, matched, ctx)
+            continue
+        if agg["kind"] == "terms":
+            want = {}
+            for d in matched:
+                want.setdefault(d["k"], []).append(d)
+            order = sorted(want, key=lambda k2: (-len(want[k2]), k2))
+            assert [b["key"] for b in got["buckets"]] == order, ctx
+            for b in got["buckets"]:
+                assert b["doc_count"] == len(want[b["key"]]), ctx
+                check_bucket_subs(agg["subs"], b, want[b["key"]], ctx)
+        elif agg["kind"] == "histogram":
+            interval = agg["spec"]["histogram"]["interval"]
+            want = {}
+            for d in matched:
+                want.setdefault((d["n"] // interval) * interval,
+                                []).append(d)
+            assert [b["key"] for b in got["buckets"]] == \
+                sorted(want), ctx
+            for b in got["buckets"]:
+                docs_b = want[int(b["key"])]
+                assert b["doc_count"] == len(docs_b), ctx
+                check_bucket_subs(agg["subs"], b, docs_b, ctx)
+        elif agg["kind"] == "range":
+            ranges = agg["spec"]["range"]["ranges"]
+            for b, r in zip(got["buckets"], ranges):
+                docs_b = [d for d in matched
+                          if d["n"] >= r.get("from", -10**9)
+                          and d["n"] < r.get("to", 10**9)]
+                assert b["doc_count"] == len(docs_b), (ctx, r)
+                check_bucket_subs(agg["subs"], b, docs_b, (ctx, r))
+        else:                                    # filter agg
+            docs_b = [d for d in matched
+                      if query_matches(agg["spec"]["filter"], d)]
+            assert got["doc_count"] == len(docs_b), ctx
+            check_bucket_subs(agg["subs"], got, docs_b, ctx)

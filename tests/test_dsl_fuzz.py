@@ -177,6 +177,19 @@ def matches(q: dict, doc: dict) -> bool:
     raise AssertionError(f"oracle hole: {kind}")
 
 
+def test_exclusive_bounds_at_zero(node):
+    """Regression (found by this fuzzer, seed 42): gt/lt strictness must
+    ride the dd comparison — a nextafter-bumped bound underflows the f32
+    double-double split at small values, so gt:0 matched n=0."""
+    out = node.search("fz", {"query": {"range": {"n": {"gt": 0,
+                                                       "lt": 78}}},
+                             "size": N_DOCS + 10})
+    ids = {h["_id"] for h in out["hits"]["hits"]}
+    assert "0" not in ids and "78" not in ids
+    assert "1" in ids and "77" in ids
+    assert out["hits"]["total"] == 77
+
+
 def test_random_trees_match_oracle(node, corpus):
     rnd = random.Random(derive_seed("dsl-fuzz-queries"))
     for qi in range(N_QUERIES):
