@@ -843,14 +843,32 @@ class ShardSearcher:
                     values.update(kcol.vocab)
             union_vocab = sorted(values)
             union = {v: i for i, v in enumerate(union_vocab)}
+        # one missing-fill per (field, order, missing) spec, shared by
+        # missing DOCS and column-less SEGMENTS alike — a segment that
+        # happens to hold no values for the field must rank its docs
+        # exactly like a missing doc in a segment that has the column.
+        # _last/_first place at the end/start of the list regardless of
+        # direction; a custom value/TERM substitutes for comparison
+        # (terms absent from the union vocab rank between neighbors).
+        if missing in ("_last", "_first"):
+            fill = np.inf if (missing == "_last") == (order == "asc") \
+                else -np.inf
+            out_fill = None if union is not None else fill
+        elif union is not None:
+            ms = str(missing)
+            if ms in union:
+                fill = float(union[ms])
+            else:
+                import bisect
+                fill = bisect.bisect_left(union_vocab, ms) - 0.5
+            out_fill = ms
+        else:
+            fill = float(missing)
+            out_fill = fill
         for seg in segments:
             col = seg.seg.numeric_fields.get(fname)
             if col is not None:
                 vals = col.values.astype(np.float64).copy()
-                fill = np.inf if (missing == "_last") == (order == "asc") \
-                    else -np.inf
-                if missing not in ("_last", "_first"):
-                    fill = float(missing)
                 vals[~col.exists] = fill
                 cols.append(vals)
                 outs.append(vals)
@@ -861,18 +879,15 @@ class ShardSearcher:
                                  np.int64)
                 first = kcol.ords[:, 0]
                 have = first >= 0
-                # same missing semantics as the numeric branch: _last default
-                fill = np.inf if (missing == "_last") == (order == "asc") \
-                    else -np.inf
                 ranks = np.full(first.shape, fill, np.float64)
                 ranks[have] = remap[first[have]]
                 cols.append(ranks)
-                out = np.full(first.shape, None, dtype=object)
+                out = np.full(first.shape, out_fill, dtype=object)
                 out[have] = [union_vocab[int(r)] for r in ranks[have]]
                 outs.append(out)
                 continue
-            cols.append(np.full(seg.padded_docs, np.inf))
-            outs.append(np.full(seg.padded_docs, None, dtype=object))
+            cols.append(np.full(seg.padded_docs, np.float64(fill)))
+            outs.append(np.full(seg.padded_docs, out_fill, dtype=object))
         if not cols:
             return np.full(n, np.inf), np.full(n, None, dtype=object)
         return np.concatenate(cols), np.concatenate(outs)

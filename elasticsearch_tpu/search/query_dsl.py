@@ -543,9 +543,33 @@ def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query
         fname, spec = _field_body(qbody, "range")
         if not isinstance(spec, dict):
             raise QueryParsingError("[range] expects an object of bounds")
-        return RangeQuery(field=fname, gte=spec.get("gte", spec.get("from")),
-                          gt=spec.get("gt"), lte=spec.get("lte", spec.get("to")),
-                          lt=spec.get("lt"), boost=float(spec.get("boost", 1.0)))
+        # gt/gte (and lt/lte) share ONE bound slot, last key in body
+        # order wins — the reference's RangeQueryParser assigns from/
+        # includeLower per parsed key, so a later gt overwrites an
+        # earlier gte entirely (include_lower/include_upper are the 2.x
+        # flag spellings applied to from/to)
+        lo = hi = None
+        lo_incl = bool(spec.get("include_lower", True))
+        hi_incl = bool(spec.get("include_upper", True))
+        for kk, vv in spec.items():
+            if kk in ("gte", "from"):
+                lo = vv
+                if kk == "gte":
+                    lo_incl = True
+            elif kk == "gt":
+                lo, lo_incl = vv, False
+            elif kk in ("lte", "to"):
+                hi = vv
+                if kk == "lte":
+                    hi_incl = True
+            elif kk == "lt":
+                hi, hi_incl = vv, False
+        return RangeQuery(field=fname,
+                          gte=lo if lo_incl else None,
+                          gt=None if lo_incl else lo,
+                          lte=hi if hi_incl else None,
+                          lt=None if hi_incl else hi,
+                          boost=float(spec.get("boost", 1.0)))
 
     if qtype == "exists":
         return ExistsQuery(field=qbody["field"])

@@ -175,13 +175,20 @@ def check_bucket_subs(subs, bucket, docs, ctx):
         check_metric(m, field, bucket[name], docs, (ctx, name))
 
 
-def test_keyword_range_tightest_bounds(node, corpus):
-    """gte and gt both apply on keyword ranges (tightest wins), matching
-    the numeric branch — gt must not simply overwrite gte."""
+def test_range_bound_slots_last_key_wins(node, corpus):
+    """gt/gte share ONE bound slot and the last body key wins — the
+    reference's RangeQueryParser assigns from/includeLower per parsed
+    key, so a later gt overwrites an earlier gte (same for lt/lte), on
+    keyword and numeric fields alike."""
     out = node.search("az", {"query": {"range": {"k": {
         "gte": "c3", "gt": "c0"}}}, "size": N_DOCS + 10})
     got = {h["_id"] for h in out["hits"]["hits"]}
-    want = {d["id"] for d in corpus if d["k"] >= "c3"}
+    want = {d["id"] for d in corpus if d["k"] > "c0"}
+    assert got == want
+    out = node.search("az", {"query": {"range": {"n": {
+        "gt": 50, "gte": 30}}}, "size": N_DOCS + 10})
+    got = {h["_id"] for h in out["hits"]["hits"]}
+    want = {d["id"] for d in corpus if d["n"] >= 30}
     assert got == want
 
 

@@ -1,0 +1,171 @@
+"""Randomized sort + pagination fuzzer — engine order vs a comparator
+oracle.
+
+Third of the randomized parity suites (with test_dsl_fuzz /
+test_aggs_fuzz): seeded random sort specs — numeric/keyword keys,
+asc/desc, missing "_first"/"_last"/custom substitutes, 1-2 keys plus a
+unique tiebreak so the total order is deterministic — combined with
+random from/size windows and filter queries, executed on the product
+path and compared id-for-id against a cmp_to_key oracle implementing
+the reference's FieldComparator semantics (missing placement is
+end/start of the LIST regardless of direction; custom missing values
+substitute before comparison). Reproduce with ESTPU_TEST_SEED.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+
+import pytest
+
+from conftest import derive_seed
+from elasticsearch_tpu.node import Node
+
+N_DOCS = 120
+N_QUERIES = 35
+VOCAB = ["ant", "bee", "cat", "dog", "elk"]
+KEYS = ["ka", "kb", "kc", "kd"]
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rnd = random.Random(derive_seed("sort-fuzz-corpus"))
+    uniq = list(range(N_DOCS))
+    rnd.shuffle(uniq)
+    docs = []
+    for i in range(N_DOCS):
+        d = {"id": str(i), "u": uniq[i],
+             "t": " ".join(rnd.choice(VOCAB) for _ in range(3))}
+        if rnd.random() > 0.15:
+            d["f"] = rnd.choice([-2.5, 0.0, 1.25, 3.5, 7.0, 11.5])
+        if rnd.random() > 0.15:
+            d["k"] = rnd.choice(KEYS)
+        docs.append(d)
+    return docs
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory, corpus):
+    n = Node({}, data_path=tmp_path_factory.mktemp("sortfz") / "n").start()
+    n.indices_service.create_index(
+        "sz", {"settings": {"number_of_shards": 2,
+                            "number_of_replicas": 0},
+               "mappings": {"_doc": {"properties": {
+                   "u": {"type": "long"},
+                   "f": {"type": "double"},
+                   "k": {"type": "keyword"},
+                   "t": {"type": "text",
+                         "analyzer": "whitespace"}}}}})
+    for d in corpus:
+        n.index_doc("sz", d["id"],
+                    {k: v for k, v in d.items() if k != "id"})
+    n.broadcast_actions.refresh("sz")
+    yield n
+    n.close()
+
+
+def gen_sort(rnd):
+    """1-2 random keys + a unique tiebreak → deterministic total order."""
+    specs = []
+    for _ in range(rnd.randint(1, 2)):
+        field = rnd.choice(["f", "k"])
+        order = rnd.choice(["asc", "desc"])
+        missing = "_last"
+        if rnd.random() < 0.5:
+            missing = rnd.choice(
+                ["_first", "_last",
+                 5.0 if field == "f" else "car"])
+        specs.append((field, order, missing))
+    specs.append(("u", rnd.choice(["asc", "desc"]), "_last"))
+    body = [{f: {"order": o, "missing": m}} for f, o, m in specs]
+    return specs, body
+
+
+def gen_query(rnd):
+    kind = rnd.choice(["match_all", "term", "range"])
+    if kind == "match_all":
+        return {"match_all": {}}
+    if kind == "term":
+        return {"term": {"t": rnd.choice(VOCAB)}}
+    lo = rnd.randint(0, 80)
+    return {"range": {"u": {"gte": lo, "lte": lo + rnd.randint(10, 60)}}}
+
+
+def query_matches(q, d):
+    kind, body = next(iter(q.items()))
+    if kind == "match_all":
+        return True
+    if kind == "term":
+        return body["t"] in d["t"].split()
+    r = body["u"]
+    return r["gte"] <= d["u"] <= r["lte"]
+
+
+def oracle_order(docs, specs):
+    def cmp(a, b):
+        for field, order, missing in specs:
+            va, vb = a.get(field), b.get(field)
+            if missing not in ("_first", "_last"):
+                va = missing if va is None else va
+                vb = missing if vb is None else vb
+            ra = 0 if va is not None else \
+                (-1 if missing == "_first" else 1)
+            rb = 0 if vb is not None else \
+                (-1 if missing == "_first" else 1)
+            if ra != rb:
+                # missing placement is start/end of the LIST, not of the
+                # key direction (FieldComparator missing semantics)
+                return ra - rb
+            if va is None:
+                continue
+            if va != vb:
+                c = -1 if va < vb else 1
+                return c if order == "asc" else -c
+        return 0
+    return sorted(docs, key=functools.cmp_to_key(cmp))
+
+
+def test_columnless_segment_honors_missing_spec(tmp_path):
+    """A segment holding NO values for the sort field must rank its docs
+    exactly like missing docs in a segment that has the column — the
+    fallback fill honors missing:_first and custom substitutes too."""
+    n = Node({}, data_path=tmp_path / "n").start()
+    n.indices_service.create_index(
+        "cl", {"settings": {"number_of_shards": 1,
+                            "number_of_replicas": 0},
+               "mappings": {"_doc": {"properties": {
+                   "k": {"type": "keyword"}}}}})
+    n.index_doc("cl", "a", {"k": "bee"})
+    n.index_doc("cl", "b", {"k": "dog"})
+    n.broadcast_actions.refresh("cl")         # segment 1: has k column
+    n.index_doc("cl", "c", {})
+    n.broadcast_actions.refresh("cl")         # segment 2: NO k column
+    r = n.search("cl", {"sort": [{"k": {"order": "asc",
+                                        "missing": "_first"}}],
+                        "size": 10})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["c", "a", "b"]
+    r = n.search("cl", {"sort": [{"k": {"order": "asc",
+                                        "missing": "cat"}}],
+                        "size": 10})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["a", "c", "b"]
+    n.close()
+
+
+def test_random_sorts_match_oracle(node, corpus):
+    rnd = random.Random(derive_seed("sort-fuzz-queries"))
+    for qi in range(N_QUERIES):
+        q = gen_query(rnd)
+        specs, sort_body = gen_sort(rnd)
+        frm = rnd.randint(0, 40)
+        size = rnd.randint(1, 50)
+        out = node.search("sz", {"query": q, "sort": sort_body,
+                                 "from": frm, "size": size})
+        matched = [d for d in corpus if query_matches(q, d)]
+        want = [d["id"] for d in
+                oracle_order(matched, specs)][frm:frm + size]
+        got = [h["_id"] for h in out["hits"]["hits"]]
+        assert got == want, (
+            f"#{qi} q={q} sort={sort_body} from={frm} size={size}: "
+            f"got {got[:8]} want {want[:8]}")
+        assert out["hits"]["total"] == len(matched), (qi, q)
