@@ -36,7 +36,8 @@ import numpy as np
 from elasticsearch_tpu.common.errors import (
     DocumentMissingError, EngineClosedError, VersionConflictError)
 from elasticsearch_tpu.common.settings import Settings
-from elasticsearch_tpu.index.segment import Segment, SegmentBuilder, merge_segments
+from elasticsearch_tpu.index.segment import (
+    Segment, SegmentBuilder, merge_segments, row_meta)
 from elasticsearch_tpu.index.translog import (
     Translog, TranslogOp, OP_INDEX, OP_DELETE, DURABILITY_REQUEST)
 from elasticsearch_tpu.mapping import MapperService
@@ -134,19 +135,7 @@ def _parsed_meta(doc) -> dict | None:
 
 def _segment_meta(seg, local: int) -> dict | None:
     """Metadata-field values out of a committed segment's columns."""
-    out = {}
-    for key in ("_type", "_parent", "_routing"):
-        col = seg.keyword_fields.get(key)
-        if col is not None and local < col.ords.shape[0]:
-            o = int(col.ords[local, 0])
-            if o >= 0:
-                out[key] = col.vocab[o]
-    for key in ("_timestamp", "_ttl", "_version"):
-        col = seg.numeric_fields.get(key)
-        if col is not None and local < col.values.shape[0] \
-                and bool(col.exists[local]):
-            out[key] = int(col.values[local])
-    return out or None
+    return row_meta(seg, local) or None
 
 
 class Engine:
@@ -256,9 +245,17 @@ class Engine:
             else:
                 if op_type == "create" and current != NOT_FOUND:
                     raise VersionConflictError("", doc_id, current, 0)
-                if version != MATCH_ANY and version != current:
-                    raise VersionConflictError("", doc_id, current, version)
-                new_version = 1 if current == NOT_FOUND else current + 1
+                # internal versioning CONTINUES through tombstones
+                # (InternalEngine.innerIndex loads deletes from the
+                # version map: delete v11 → next index v12, and an
+                # explicit expected version matches the tombstone's).
+                # Restarting at 1 would break per-doc version
+                # monotonicity — the property every replica/replay
+                # "skip strictly-older ops" guard is built on.
+                known = NOT_FOUND if entry is None else entry.version
+                if version != MATCH_ANY and version != known:
+                    raise VersionConflictError("", doc_id, known, version)
+                new_version = 1 if known == NOT_FOUND else known + 1
 
             # stamp the resolved version into the doc's columns (the
             # VersionFieldMapper doc-value): fetched hits read the
@@ -295,12 +292,16 @@ class Engine:
         """Apply a replicated index op with the version the primary
         resolved (TransportShardBulkAction replica path: no version
         conflict re-check, core/action/bulk/TransportShardBulkAction.java:448).
-        Idempotent: ops at or below the locally known version are skipped,
-        which also dedupes recovery-replay vs. live-replication overlap."""
+        Ops STRICTLY below the locally known version are skipped, which
+        dedupes recovery-replay vs. live-replication overlap; an op AT
+        the known version re-applies — that's idempotent for a double
+        delivery of the same op, and required for external_gte, where two
+        successive legitimate writes can carry the SAME version and the
+        later one must win."""
         with self._lock:
             self._ensure_open()
             entry = self._versions.get(doc_id)
-            if entry is not None and entry.version >= version:
+            if entry is not None and entry.version > version:
                 return entry.version
             meta = dict(meta or {})
             meta["_version"] = version
@@ -324,11 +325,13 @@ class Engine:
 
     def delete_replica(self, doc_id: str, version: int,
                        sync: bool = True) -> int:
-        """Apply a replicated delete with the primary-resolved version."""
+        """Apply a replicated delete with the primary-resolved version
+        (same strictly-below skip rule as index_replica: an equal-version
+        delete — external_gte can issue one — must still apply)."""
         with self._lock:
             self._ensure_open()
             entry = self._versions.get(doc_id)
-            if entry is not None and entry.version >= version:
+            if entry is not None and entry.version > version:
                 return entry.version
             if entry is not None and entry.seg_id == -1:
                 self._buffer.docs[entry.local_doc] = None
@@ -419,8 +422,11 @@ class Engine:
                          entry: "VersionEntry | None") -> GetResult:
         """Non-realtime get: resolve through the current point-in-time
         view's segments + live masks (callers hold self._lock). The
-        reported version is the latest KNOWN version — segments don't
-        store per-row versions (a documented approximation)."""
+        version reported is the segment row's own _version doc-value
+        (the VersionFieldMapper column) — the point-in-time value, NOT
+        the live map's, which may already be ahead of the refreshed
+        view; rows without the column (legacy segments) fall back to the
+        latest known version."""
         view = self._reader
         for seg, live in zip(view.segments, view.live_masks):
             index = getattr(seg, "_id_index", None)
@@ -429,9 +435,13 @@ class Engine:
                 seg._id_index = index
             local = index.get(doc_id)
             if local is not None and bool(live[local]):
-                version = entry.version if entry is not None else 1
+                meta = _segment_meta(seg, local)
+                if meta is not None and "_version" in meta:
+                    version = int(meta["_version"])
+                else:
+                    version = entry.version if entry is not None else 1
                 return GetResult(True, doc_id, version, seg.sources[local],
-                                 meta=_segment_meta(seg, local))
+                                 meta=meta)
         return GetResult(found=False, doc_id=doc_id)
 
     # --------------------------------------------------------------- refresh
@@ -850,13 +860,16 @@ class Engine:
         for op in self.translog.uncommitted_ops():
             if op.op == OP_INDEX:
                 entry = self._versions.get(op.doc_id)
-                if entry is not None and entry.version >= op.version \
-                        and not entry.deleted:
+                # skip only ops STRICTLY below the known version: the
+                # translog is ordered, so an op AT the known version is a
+                # later same-version write (external_gte allows those) or
+                # an idempotent re-apply — either way the op must land
+                if entry is not None and entry.version > op.version:
                     continue  # already applied in a newer state
                 self._apply_replayed_index(op)
             elif op.op == OP_DELETE:
                 entry = self._versions.get(op.doc_id)
-                if entry is not None and entry.version >= op.version and entry.deleted:
+                if entry is not None and entry.version > op.version:
                     continue
                 if entry is not None and entry.seg_id == -1:
                     self._buffer.docs[entry.local_doc] = None

@@ -727,19 +727,46 @@ class SegmentBuilder:
                                 exists=exists, rid=rid, area=area)
 
 
+def row_meta(seg: "Segment", local: int) -> dict:
+    """Metadata-field values of one row out of a segment's reserved
+    columns (_type/_parent/_routing keyword, _timestamp/_ttl/_version
+    numeric) — what the internal field mappers materialized at index
+    time."""
+    out: dict = {}
+    for key in ("_type", "_parent", "_routing"):
+        col = seg.keyword_fields.get(key)
+        if col is not None and local < col.ords.shape[0]:
+            o = int(col.ords[local, 0])
+            if o >= 0:
+                out[key] = col.vocab[o]
+    for key in ("_timestamp", "_ttl", "_version"):
+        col = seg.numeric_fields.get(key)
+        if col is not None and local < col.values.shape[0] \
+                and bool(col.exists[local]):
+            out[key] = int(col.values[local])
+    return out
+
+
 def merge_segments(seg_id: int, segments: Iterable[Segment],
                    live_masks: Iterable[np.ndarray] | None = None,
                    mapper=None,
                    max_tokens: int = DEFAULT_MAX_TOKENS) -> "SegmentBuilder":
     """Background-merge equivalent (ElasticsearchConcurrentMergeScheduler):
     re-parse surviving docs into a fresh builder. Requires the mapper to
-    re-analyze; engine calls this with its DocumentMapper."""
+    re-analyze; engine calls this with its DocumentMapper. Each row's
+    metadata columns ride through the merge (Lucene merges carry every
+    stored field) — dropping them would silently break _type filters,
+    parent/child joins, routed fetches, TTL sweeps and point-in-time
+    _version reads for merged docs."""
     builder = SegmentBuilder(seg_id, max_tokens=max_tokens)
     masks = list(live_masks) if live_masks is not None else None
     for si, seg in enumerate(segments):
         for local in range(seg.num_docs):
             if masks is not None and not masks[si][local]:
                 continue
-            doc = mapper.parse(seg.ids[local], seg.sources[local])
+            meta = row_meta(seg, local)
+            doc = mapper.parse(seg.ids[local], seg.sources[local],
+                               routing=meta.get("_routing"),
+                               meta=meta or None)
             builder.add(doc)
     return builder

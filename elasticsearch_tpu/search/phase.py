@@ -863,8 +863,15 @@ class ShardSearcher:
                 fill = bisect.bisect_left(union_vocab, ms) - 0.5
             out_fill = ms
         else:
-            fill = float(missing)
-            out_fill = fill
+            try:
+                fill = float(missing)
+                out_fill = fill
+            except (TypeError, ValueError):
+                # a string substitute on a field with no keyword column
+                # anywhere in the shard: every doc is missing, so all
+                # rank equal at the substitute
+                fill = 0.0
+                out_fill = str(missing)
         for seg in segments:
             col = seg.seg.numeric_fields.get(fname)
             if col is not None:

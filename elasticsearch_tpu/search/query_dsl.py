@@ -545,25 +545,29 @@ def parse_query(body: dict | None) -> Query:  # noqa: C901 — one arm per query
             raise QueryParsingError("[range] expects an object of bounds")
         # gt/gte (and lt/lte) share ONE bound slot, last key in body
         # order wins — the reference's RangeQueryParser assigns from/
-        # includeLower per parsed key, so a later gt overwrites an
-        # earlier gte entirely (include_lower/include_upper are the 2.x
-        # flag spellings applied to from/to)
+        # includeLower per parsed key IN BODY ORDER, so a later gt
+        # overwrites an earlier gte entirely and include_lower/
+        # include_upper (the 2.x flag spellings) also apply at their
+        # position ("from" leaves the inclusivity flag untouched)
         lo = hi = None
-        lo_incl = bool(spec.get("include_lower", True))
-        hi_incl = bool(spec.get("include_upper", True))
+        lo_incl = hi_incl = True
         for kk, vv in spec.items():
-            if kk in ("gte", "from"):
+            if kk == "from":
                 lo = vv
-                if kk == "gte":
-                    lo_incl = True
+            elif kk == "gte":
+                lo, lo_incl = vv, True
             elif kk == "gt":
                 lo, lo_incl = vv, False
-            elif kk in ("lte", "to"):
+            elif kk == "include_lower":
+                lo_incl = bool(vv)
+            elif kk == "to":
                 hi = vv
-                if kk == "lte":
-                    hi_incl = True
+            elif kk == "lte":
+                hi, hi_incl = vv, True
             elif kk == "lt":
                 hi, hi_incl = vv, False
+            elif kk == "include_upper":
+                hi_incl = bool(vv)
         return RangeQuery(field=fname,
                           gte=lo if lo_incl else None,
                           gt=None if lo_incl else lo,

@@ -1,0 +1,207 @@
+"""Randomized engine CRUD/versioning fuzzer vs an exact oracle.
+
+Fourth randomized parity suite: a seeded stream of index (create /
+internal-versioned / external) and delete ops, interleaved with
+refresh / flush / full close-and-reopen (translog replay), runs against
+one Engine while a pure-Python oracle tracks, per doc: live version,
+source, and the LAST KNOWN version (tombstones included — the value
+external versioning compares against, InternalEngine.innerIndex /
+VersionType.java). Every op's outcome (new version, created flag,
+VersionConflictError, DocumentMissingError) and every realtime /
+non-realtime get must match the oracle exactly. Tombstone loss on
+flush+reopen (segments persist no tombstones; only translog replay
+restores them — the reference GCs tombstones the same way via
+index.gc_deletes) is part of the model. Reproduce via ESTPU_TEST_SEED.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from conftest import derive_seed
+from elasticsearch_tpu.common.errors import (DocumentMissingError,
+                                             VersionConflictError)
+from elasticsearch_tpu.index.engine import MATCH_ANY, Engine
+from elasticsearch_tpu.mapping import MapperService
+
+IDS = [f"d{i}" for i in range(15)]
+N_OPS = 300
+REOPEN_AT = {100, 220}
+
+
+class Oracle:
+    def __init__(self):
+        self.live: dict[str, tuple[int, dict]] = {}   # id → (version, src)
+        self.known: dict[str, int] = {}               # id → last version
+        self.refreshed: dict[str, tuple[int, dict]] = {}
+
+
+    def current(self, doc_id):
+        return self.live.get(doc_id, (None, None))[0]
+
+    def index(self, doc_id, src, version, op_type, vtype):
+        cur = self.current(doc_id)
+        if vtype != "internal":
+            known = self.known.get(doc_id)
+            ok = (vtype == "force" or known is None
+                  or (vtype == "external_gte" and version >= known)
+                  or (vtype in ("external", "external_gt")
+                      and version > known))
+            if not ok:
+                return "conflict", None, None
+            new = version
+        else:
+            if op_type == "create" and cur is not None:
+                return "conflict", None, None
+            # internal versioning continues through tombstones (the
+            # reference's in-gc-window semantics): explicit versions
+            # compare against the LAST KNOWN version, and the next
+            # version is known+1 even after a delete
+            known = self.known.get(doc_id)
+            if version != MATCH_ANY and version != known:
+                return "conflict", None, None
+            new = 1 if known is None else known + 1
+        created = cur is None
+        self.live[doc_id] = (new, src)
+        self.known[doc_id] = new
+
+        return "ok", new, created
+
+    def delete(self, doc_id, version, vtype):
+        cur = self.current(doc_id)
+        if vtype != "internal":
+            known = self.known.get(doc_id)
+            ok = (vtype == "force" or known is None
+                  or (vtype == "external_gte" and version >= known)
+                  or (vtype in ("external", "external_gt")
+                      and version > known))
+            if not ok:
+                return "conflict", None
+            if cur is None:
+                return "missing", None
+            new = version
+        else:
+            if version != MATCH_ANY and version != cur:
+                return "conflict", None
+            if cur is None:
+                return "missing", None
+            new = cur + 1
+        self.live.pop(doc_id, None)
+        self.known[doc_id] = new
+
+        return "ok", new
+
+    def refresh(self):
+        self.refreshed = dict(self.live)
+
+    def flush(self):
+        # this engine's flush refreshes first (the write buffer must
+        # become a segment to persist — InternalEngine commits make the
+        # segment durable, and here visibility rides the same step)
+        self.refresh()
+
+    def reopen(self):
+        # commit.json persists the FULL versions map (tombstones
+        # included) and translog replay restores post-commit ops, so a
+        # reopen forgets nothing — external versioning keeps comparing
+        # against pre-restart tombstones
+        pass
+
+
+def test_random_crud_stream_matches_oracle(tmp_path):
+    rnd = random.Random(derive_seed("crud-fuzz"))
+    ms = MapperService()
+    eng = Engine(tmp_path / "e", ms)
+    o = Oracle()
+
+    def check_gets():
+        for doc_id in IDS:
+            got = eng.get(doc_id, realtime=True)
+            want = o.live.get(doc_id)
+            assert got.found == (want is not None), (doc_id, got)
+            if want is not None:
+                assert got.version == want[0], (doc_id, got, want)
+                assert got.source == want[1], (doc_id,)
+            assert eng.doc_version(doc_id) == \
+                (want[0] if want else None), doc_id
+            nr = eng.get(doc_id, realtime=False)
+            rwant = o.refreshed.get(doc_id)
+            assert nr.found == (rwant is not None), \
+                (doc_id, "non-realtime", nr, rwant)
+            if rwant is not None:
+                assert nr.version == rwant[0], (doc_id, nr, rwant)
+
+    for step in range(N_OPS):
+        if step in REOPEN_AT:
+            eng.close()
+            eng = Engine(tmp_path / "e", ms)
+            o.reopen()
+            eng.refresh()
+            o.refresh()
+            check_gets()
+            continue
+        doc_id = rnd.choice(IDS)
+        r = rnd.random()
+        if r < 0.50:                              # index
+            src = {"v": step, "body": f"tok{step % 7}"}
+            vtype = rnd.choice(["internal"] * 4 + ["external",
+                                                   "external_gte"])
+            op_type = "index"
+            if vtype == "internal":
+                version = MATCH_ANY
+                if rnd.random() < 0.3:
+                    # half the time the CORRECT current version, half a
+                    # wrong one → both conflict arms exercised
+                    cur = o.current(doc_id)
+                    version = cur if (cur and rnd.random() < 0.5) \
+                        else rnd.randint(1, 8)
+                elif rnd.random() < 0.15:
+                    op_type = "create"
+            else:
+                version = rnd.randint(1, 10)
+            exp, exp_ver, exp_created = o.index(
+                doc_id, src, version, op_type, vtype)
+            try:
+                got_ver, got_created = eng.index(
+                    doc_id, src, version=version, op_type=op_type,
+                    version_type=vtype)
+                assert exp == "ok", (step, doc_id, vtype, version,
+                                     "engine accepted, oracle refused")
+                assert (got_ver, got_created) == (exp_ver, exp_created), \
+                    (step, doc_id, got_ver, exp_ver)
+            except VersionConflictError:
+                assert exp == "conflict", (step, doc_id, vtype, version,
+                                           "engine refused, oracle ok")
+        elif r < 0.75:                            # delete
+            vtype = rnd.choice(["internal"] * 3 + ["external"])
+            if vtype == "internal":
+                version = MATCH_ANY
+                if rnd.random() < 0.3:
+                    cur = o.current(doc_id)
+                    version = cur if (cur and rnd.random() < 0.5) \
+                        else rnd.randint(1, 8)
+            else:
+                version = rnd.randint(1, 10)
+            exp, exp_ver = o.delete(doc_id, version, vtype)
+            try:
+                got_ver = eng.delete(doc_id, version=version,
+                                     version_type=vtype)
+                assert exp == "ok", (step, doc_id, vtype, version)
+                assert got_ver == exp_ver, (step, doc_id, got_ver,
+                                            exp_ver)
+            except VersionConflictError:
+                assert exp == "conflict", (step, doc_id, vtype, version)
+            except DocumentMissingError:
+                assert exp == "missing", (step, doc_id, vtype, version)
+        elif r < 0.85:
+            eng.refresh()
+            o.refresh()
+        elif r < 0.90:
+            eng.flush()
+            o.flush()
+        if step % 25 == 0:
+            check_gets()
+    check_gets()
+    eng.close()

@@ -190,6 +190,17 @@ def test_exclusive_bounds_at_zero(node):
     assert out["hits"]["total"] == 77
 
 
+def test_range_include_flags_apply_in_body_order(node):
+    """include_lower/include_upper apply at their position in the body,
+    like every other range key in the reference's parser — an
+    include_lower:false AFTER gte demotes it to exclusive."""
+    out = node.search("fz", {"query": {"range": {"n": {
+        "gte": 0, "include_lower": False, "lte": 5}}},
+        "size": N_DOCS + 10})
+    ids = {h["_id"] for h in out["hits"]["hits"]}
+    assert ids == {"1", "2", "3", "4", "5"}
+
+
 def test_random_trees_match_oracle(node, corpus):
     rnd = random.Random(derive_seed("dsl-fuzz-queries"))
     for qi in range(N_QUERIES):

@@ -149,6 +149,17 @@ def test_columnless_segment_honors_missing_spec(tmp_path):
                                         "missing": "cat"}}],
                         "size": 10})
     assert [h["_id"] for h in r["hits"]["hits"]] == ["a", "c", "b"]
+    # a mapped-but-unpopulated keyword field with a string substitute
+    # must not crash: every doc is missing → all rank equal
+    n.indices_service.create_index("cl2", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"_doc": {"properties": {
+            "k": {"type": "keyword"}}}}})
+    n.index_doc("cl2", "x", {})
+    n.broadcast_actions.refresh("cl2")
+    r = n.search("cl2", {"sort": [{"k": {"missing": "cat"}}],
+                         "size": 10})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["x"]
     n.close()
 
 
