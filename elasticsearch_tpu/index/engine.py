@@ -366,8 +366,12 @@ class Engine:
                     raise DocumentMissingError("", doc_id)
                 new_version = version
             else:
-                if version != MATCH_ANY and version != current:
-                    raise VersionConflictError("", doc_id, current, version)
+                # same continuation rule as the index arm: explicit
+                # internal versions compare against the LAST KNOWN
+                # version, tombstones included
+                known = NOT_FOUND if entry is None else entry.version
+                if version != MATCH_ANY and version != known:
+                    raise VersionConflictError("", doc_id, known, version)
                 if current == NOT_FOUND:
                     raise DocumentMissingError("", doc_id)
                 new_version = current + 1
@@ -859,18 +863,16 @@ class Engine:
     def _replay_translog(self) -> None:
         for op in self.translog.uncommitted_ops():
             if op.op == OP_INDEX:
-                entry = self._versions.get(op.doc_id)
-                # skip only ops STRICTLY below the known version: the
-                # translog is ordered, so an op AT the known version is a
-                # later same-version write (external_gte allows those) or
-                # an idempotent re-apply — either way the op must land
-                if entry is not None and entry.version > op.version:
-                    continue  # already applied in a newer state
+                # apply UNCONDITIONALLY: the translog is the total order
+                # of this shard's ops, and the committed state reflects a
+                # prefix of it, so replaying every op in sequence
+                # converges to the exact pre-crash state — version-based
+                # skips can't express "later in the log" once force
+                # writes (which may LOWER a version) or external_gte
+                # equal-version successors are in play
                 self._apply_replayed_index(op)
             elif op.op == OP_DELETE:
                 entry = self._versions.get(op.doc_id)
-                if entry is not None and entry.version > op.version:
-                    continue
                 if entry is not None and entry.seg_id == -1:
                     self._buffer.docs[entry.local_doc] = None
                     self._buffer_docs.pop(op.doc_id, None)

@@ -862,14 +862,19 @@ class ShardSearcher:
                 import bisect
                 fill = bisect.bisect_left(union_vocab, ms) - 0.5
             out_fill = ms
+        elif any(fname in seg.seg.numeric_fields for seg in segments):
+            # numeric field: a non-numeric substitute is a caller error —
+            # surface it (float raises), don't silently rank at 0
+            fill = float(missing)
+            out_fill = fill
         else:
             try:
                 fill = float(missing)
                 out_fill = fill
             except (TypeError, ValueError):
-                # a string substitute on a field with no keyword column
-                # anywhere in the shard: every doc is missing, so all
-                # rank equal at the substitute
+                # a string substitute on a field with NO column of either
+                # kind anywhere in the shard: every doc is missing, so
+                # all rank equal at the substitute
                 fill = 0.0
                 out_fill = str(missing)
         for seg in segments:

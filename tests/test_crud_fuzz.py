@@ -8,10 +8,12 @@ source, and the LAST KNOWN version (tombstones included — the value
 external versioning compares against, InternalEngine.innerIndex /
 VersionType.java). Every op's outcome (new version, created flag,
 VersionConflictError, DocumentMissingError) and every realtime /
-non-realtime get must match the oracle exactly. Tombstone loss on
-flush+reopen (segments persist no tombstones; only translog replay
-restores them — the reference GCs tombstones the same way via
-index.gc_deletes) is part of the model. Reproduce via ESTPU_TEST_SEED.
+non-realtime get must match the oracle exactly. Tombstones SURVIVE
+flush+reopen (commit.json persists the full versions map and translog
+replay restores post-commit ops), so external and internal versioning
+keep comparing against pre-restart tombstones — the reference only
+forgets them after index.gc_deletes, which this engine never does
+in-session. Reproduce via ESTPU_TEST_SEED.
 """
 
 from __future__ import annotations
@@ -83,7 +85,11 @@ class Oracle:
                 return "missing", None
             new = version
         else:
-            if version != MATCH_ANY and version != cur:
+            # internal deletes also compare explicit versions against
+            # the LAST KNOWN version (tombstones included), then report
+            # missing — same continuation rule as the index arm
+            known = self.known.get(doc_id)
+            if version != MATCH_ANY and version != known:
                 return "conflict", None
             if cur is None:
                 return "missing", None

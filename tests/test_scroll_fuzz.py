@@ -1,0 +1,112 @@
+"""Randomized scroll fuzzer — full enumeration, PIT isolation, ordering.
+
+Fifth randomized parity suite: seeded scroll sessions over a 3-shard
+index draw page size, sort (indexed field asc/desc, _doc, or scored
+match), and a concurrent write/delete/refresh schedule applied MID
+SCROLL. Every session must enumerate exactly the point-in-time snapshot
+from when the scroll opened — no duplicates, no losses, no leakage of
+mid-scroll writes — and sorted scrolls must page in global sort order
+(reference: ScrollContext + the pinned-reader discipline of
+SearchService scroll contexts). Reproduce with ESTPU_TEST_SEED.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from conftest import derive_seed
+from elasticsearch_tpu.node import Node
+
+VOCAB = ["oak", "elm", "fir", "ash"]
+N_SESSIONS = 12
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node({}, data_path=tmp_path / "n").start()
+    n.indices_service.create_index(
+        "sc", {"settings": {"number_of_shards": 3,
+                            "number_of_replicas": 0},
+               "mappings": {"_doc": {"properties": {
+                   "n": {"type": "long"},
+                   "t": {"type": "text",
+                         "analyzer": "whitespace"}}}}})
+    yield n
+    n.close()
+
+
+def test_random_scroll_sessions(node):
+    rnd = random.Random(derive_seed("scroll-fuzz"))
+    alive: dict[str, int] = {}
+    next_id = 0
+
+    def write_some(k):
+        nonlocal next_id
+        for _ in range(k):
+            action = rnd.random()
+            if action < 0.75 or not alive:
+                doc_id = f"d{next_id}"
+                next_id += 1
+                alive[doc_id] = next_id
+                node.index_doc("sc", doc_id, {
+                    "n": alive[doc_id],
+                    "t": " ".join(rnd.choice(VOCAB) for _ in range(3))})
+            else:
+                victim = rnd.choice(list(alive))
+                node.delete_doc("sc", victim)
+                del alive[victim]
+
+    write_some(60)
+    node.broadcast_actions.refresh("sc")
+
+    for si in range(N_SESSIONS):
+        node.broadcast_actions.refresh("sc")
+        snapshot = set(alive)
+        size = rnd.randint(1, 17)
+        mode = rnd.choice(["sort_asc", "sort_desc", "score", "plain"])
+        body = {"size": size}
+        if mode == "sort_asc":
+            body["sort"] = [{"n": {"order": "asc"}}]
+        elif mode == "sort_desc":
+            body["sort"] = [{"n": {"order": "desc"}}]
+        elif mode == "score":
+            body["query"] = {"match": {"t": "oak elm"}}
+            snapshot = {i for i in snapshot}  # totals re-checked below
+        r = node.search("sc", body, scroll="1m")
+        if mode == "score":
+            # the snapshot for a scored scroll is whatever matched at
+            # open time; recompute from a non-scroll search on the same
+            # refreshed view before any mid-scroll writes land
+            match = node.search("sc", {"query": body["query"],
+                                       "size": len(alive) + 50})
+            snapshot = {h["_id"] for h in match["hits"]["hits"]}
+        seen: list[str] = []
+        keys: list[int] = []
+        sid = r["_scroll_id"]
+        pages = 0
+        hits = r["hits"]["hits"]
+        while hits:
+            seen.extend(h["_id"] for h in hits)
+            if mode in ("sort_asc", "sort_desc"):
+                keys.extend(h["sort"][0] for h in hits)
+            pages += 1
+            # concurrent writes + refresh while the cursor walks
+            if pages % 2 == 1:
+                write_some(rnd.randint(1, 6))
+                node.broadcast_actions.refresh("sc")
+            r = node.search_actions.scroll(sid, scroll="1m")
+            sid = r["_scroll_id"]
+            hits = r["hits"]["hits"]
+            assert len(seen) <= len(snapshot), (
+                f"session {si} ({mode}): scroll re-served pages")
+        node.search_actions.clear_scroll(sid)
+        assert set(seen) == snapshot, (
+            f"session {si} ({mode}, size={size}): "
+            f"missing {sorted(snapshot - set(seen))[:5]}, "
+            f"extra {sorted(set(seen) - snapshot)[:5]}")
+        assert len(seen) == len(set(seen)), f"session {si}: dup ids"
+        if mode in ("sort_asc", "sort_desc"):
+            ordered = sorted(keys, reverse=(mode == "sort_desc"))
+            assert keys == ordered, f"session {si}: out of order"
