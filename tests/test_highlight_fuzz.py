@@ -1,0 +1,129 @@
+"""Randomized highlight fuzzer — marked spans vs a positional oracle.
+
+Sixth randomized parity suite, aimed at the round-5 passage
+highlighters: seeded random docs (whitespace-analyzed) and random
+term / multi-term / phrase queries run with every highlighter type
+(plain, postings, fvh, unified). Invariants checked per hit:
+
+* stripping the <em> tags from every fragment yields a substring of the
+  original field text (no corruption, no stitching errors);
+* the SET of marked words equals the oracle's: for term queries, every
+  occurrence of a query term; for match_phrase, ONLY words inside a
+  true consecutive-phrase occurrence — the phrase-accuracy claim;
+* docs with no oracle match produce no highlight entry for the field.
+
+Reproduce with ESTPU_TEST_SEED.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+
+import pytest
+
+from conftest import derive_seed
+from elasticsearch_tpu.node import Node
+
+VOCAB = ["ruby", "opal", "jade", "onyx", "pearl", "topaz"]
+N_DOCS = 40
+N_QUERIES = 24
+TYPES = ["plain", "postings", "fvh", "unified"]
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rnd = random.Random(derive_seed("hl-fuzz-corpus"))
+    return {str(i): " ".join(rnd.choice(VOCAB)
+                             for _ in range(rnd.randint(6, 40)))
+            for i in range(N_DOCS)}
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory, corpus):
+    n = Node({}, data_path=tmp_path_factory.mktemp("hlfz") / "n").start()
+    n.indices_service.create_index(
+        "hz", {"settings": {"number_of_shards": 1,
+                            "number_of_replicas": 0},
+               "mappings": {"_doc": {"properties": {
+                   "t": {"type": "text",
+                         "analyzer": "whitespace"}}}}})
+    for i, t in corpus.items():
+        n.index_doc("hz", i, {"t": t})
+    n.broadcast_actions.refresh("hz")
+    yield n
+    n.close()
+
+
+def oracle_marked(text: str, query: dict) -> set[int]:
+    """→ token positions the highlighter must mark."""
+    toks = text.split()
+    kind, body = next(iter(query.items()))
+    if kind == "term":
+        return {i for i, t in enumerate(toks) if t == body["t"]}
+    if kind == "match":
+        words = set(body["t"].split())
+        return {i for i, t in enumerate(toks) if t in words}
+    # match_phrase: only tokens inside a full consecutive occurrence
+    words = body["t"].split()
+    marked: set[int] = set()
+    for i in range(len(toks) - len(words) + 1):
+        if toks[i:i + len(words)] == words:
+            marked.update(range(i, i + len(words)))
+    return marked
+
+
+def marked_words(fragments: list[str]) -> list[str]:
+    out = []
+    for f in fragments:
+        out.extend(re.findall(r"<em>(.*?)</em>", f))
+    return out
+
+
+def test_random_highlights_match_oracle(node, corpus):
+    rnd = random.Random(derive_seed("hl-fuzz-queries"))
+    for qi in range(N_QUERIES):
+        kind = rnd.choice(["term", "match", "phrase"])
+        if kind == "term":
+            query = {"term": {"t": rnd.choice(VOCAB)}}
+        elif kind == "match":
+            query = {"match": {
+                "t": " ".join(rnd.sample(VOCAB, rnd.randint(1, 3)))}}
+        else:
+            query = {"match_phrase": {
+                "t": " ".join(rnd.choice(VOCAB)
+                              for _ in range(rnd.randint(2, 3)))}}
+        htype = rnd.choice(TYPES)
+        frag_size = rnd.choice([30, 80, 200])
+        out = node.search("hz", {
+            "query": query, "size": N_DOCS,
+            "highlight": {"fields": {"t": {
+                "type": htype, "fragment_size": frag_size,
+                "number_of_fragments": 10}}}})
+        for h in out["hits"]["hits"]:
+            text = corpus[h["_id"]]
+            want = oracle_marked(text, query)
+            hl = h.get("highlight", {}).get("t")
+            ctx = (qi, query, htype, frag_size, h["_id"])
+            if not want:
+                assert not hl, (ctx, "highlighted a non-matching doc")
+                continue
+            assert hl, (ctx, "no fragments for a matching doc")
+            toks = text.split()
+            want_words = sorted(toks[i] for i in want)
+            got_words = sorted(marked_words(hl))
+            # fragments may truncate the doc (few/short fragments), so
+            # the marked words must be a NON-EMPTY SUBSET of the oracle
+            # marks; with enough fragment budget they must be exact
+            assert got_words, (ctx, "fragments without any <em> mark")
+            leftover = list(want_words)
+            for w in got_words:
+                assert w in leftover, (ctx, f"marked '{w}' not in oracle",
+                                       want_words)
+                leftover.remove(w)
+            if frag_size == 200:
+                assert not leftover, (ctx, "missed marks", leftover)
+            for f in hl:
+                plain = re.sub(r"</?em>", "", f)
+                assert plain in text, (ctx, f"fragment not a substring: "
+                                            f"{plain!r}")

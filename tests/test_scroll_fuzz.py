@@ -73,7 +73,6 @@ def test_random_scroll_sessions(node):
             body["sort"] = [{"n": {"order": "desc"}}]
         elif mode == "score":
             body["query"] = {"match": {"t": "oak elm"}}
-            snapshot = {i for i in snapshot}  # totals re-checked below
         r = node.search("sc", body, scroll="1m")
         if mode == "score":
             # the snapshot for a scored scroll is whatever matched at

@@ -1,0 +1,145 @@
+"""Randomized term-suggester fuzzer vs an independent edit-distance
+oracle.
+
+Seeded random suggest requests — misspelled and in-vocabulary tokens,
+max_edits 1/2, prefix_length 0-2, suggest_mode missing/popular/always,
+size draws — run through the product path while an oracle recomputes,
+from the raw corpus: document frequencies, optimal-string-alignment
+Damerau distances, the score formula 1 - d/max(len), candidate
+filtering (prefix, identity, min_word_length, mode) and the
+(-score, -freq, text) ordering. Option lists must match exactly.
+Reference: the DirectSpellChecker-style candidate generation behind
+TermSuggester. Reproduce with ESTPU_TEST_SEED.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from conftest import derive_seed
+from elasticsearch_tpu.node import Node
+
+WORDS = ["apple", "apply", "maple", "ample", "angle", "ankle",
+         "battle", "bottle", "cattle", "rattle", "kettle",
+         "grape", "grade", "grace", "trace", "track"]
+N_DOCS = 50
+N_QUERIES = 40
+
+
+def osa(a: str, b: str, cap: int) -> int:
+    """Optimal string alignment (Damerau with non-overlapping
+    transpositions) — independent of the product's implementation."""
+    la, lb = len(a), len(b)
+    if abs(la - lb) > cap:
+        return cap + 1
+    prev2: list[int] = []
+    prev = list(range(lb + 1))
+    for i in range(1, la + 1):
+        cur = [i] + [0] * lb
+        for j in range(1, lb + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            if i > 1 and j > 1 and a[i - 1] == b[j - 2] \
+                    and a[i - 2] == b[j - 1]:
+                cur[j] = min(cur[j], prev2[j - 2] + 1)
+        prev2, prev = prev, cur
+    return prev[lb]
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rnd = random.Random(derive_seed("suggest-fuzz-corpus"))
+    return {str(i): " ".join(rnd.sample(WORDS, rnd.randint(2, 5)))
+            for i in range(N_DOCS)}
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory, corpus):
+    n = Node({}, data_path=tmp_path_factory.mktemp("sgfz") / "n").start()
+    n.indices_service.create_index(
+        "sg", {"settings": {"number_of_shards": 1,
+                            "number_of_replicas": 0},
+               "mappings": {"_doc": {"properties": {
+                   "t": {"type": "text",
+                         "analyzer": "whitespace"}}}}})
+    for i, t in corpus.items():
+        n.index_doc("sg", i, {"t": t})
+    n.broadcast_actions.refresh("sg")
+    yield n
+    n.close()
+
+
+def oracle_df(corpus) -> dict[str, int]:
+    df: dict[str, int] = {}
+    for t in corpus.values():
+        for w in set(t.split()):
+            df[w] = df.get(w, 0) + 1
+    return df
+
+
+def oracle_options(token, df, max_edits, prefix_len, mode, size,
+                   min_len=4):
+    tok_df = df.get(token, 0)
+    if mode == "missing" and tok_df > 0:
+        return []
+    prefix = token[:prefix_len]
+    out = []
+    for term, freq in df.items():
+        if term == token or not term.startswith(prefix):
+            continue
+        if len(term) < min_len and len(token) >= min_len:
+            continue
+        if mode == "popular" and freq <= tok_df:
+            continue
+        d = osa(token, term, max_edits)
+        if d > max_edits:
+            continue
+        score = round(1.0 - d / max(len(token), len(term)), 6)
+        out.append({"text": term, "freq": freq, "score": score})
+    out.sort(key=lambda c: (-c["score"], -c["freq"], c["text"]))
+    return out[:size]
+
+
+def mutate(rnd, w):
+    i = rnd.randrange(len(w))
+    kind = rnd.random()
+    ab = "abcdefghijklmnopqrstuvwxyz"
+    if kind < 0.4:                                   # substitute
+        return w[:i] + rnd.choice(ab) + w[i + 1:]
+    if kind < 0.6:                                   # delete
+        return w[:i] + w[i + 1:]
+    if kind < 0.8:                                   # insert
+        return w[:i] + rnd.choice(ab) + w[i:]
+    if len(w) > 1:                                   # transpose
+        i = min(i, len(w) - 2)
+        return w[:i] + w[i + 1] + w[i] + w[i + 2:]
+    return w
+
+
+def test_random_term_suggest_matches_oracle(node, corpus):
+    rnd = random.Random(derive_seed("suggest-fuzz-queries"))
+    df = oracle_df(corpus)
+    for qi in range(N_QUERIES):
+        base = rnd.choice(WORDS)
+        token = base if rnd.random() < 0.25 else mutate(rnd, base)
+        if rnd.random() < 0.3:
+            token = mutate(rnd, token)               # 2-edit misspell
+        params = {"field": "t",
+                  "max_edits": rnd.choice([1, 2]),
+                  "prefix_length": rnd.choice([0, 1, 2]),
+                  "suggest_mode": rnd.choice(["missing", "popular",
+                                              "always"]),
+                  "size": rnd.choice([3, 5, 10])}
+        out = node.search("sg", {"size": 0, "suggest": {
+            "fix": {"text": token, "term": dict(params)}}})
+        entry = out["suggest"]["fix"][0]
+        got = [(o["text"], o["freq"], round(o["score"], 6))
+               for o in entry["options"]]
+        want = [(o["text"], o["freq"], o["score"])
+                for o in oracle_options(
+                    token.lower(), df, params["max_edits"],
+                    params["prefix_length"], params["suggest_mode"],
+                    params["size"])]
+        assert got == want, (qi, token, params, got[:4], want[:4])
