@@ -68,6 +68,8 @@ class _ScrollContext:
         self.index_expr = index_expr
         self.body = dict(body)
         self.search_type = search_type
+        # a routed scroll stays routed on EVERY page, not just page one
+        self.routing: str | None = None
         self.dfs_cache: dict = {}
         self.keep_alive_s = keep_alive_s
         self.expires_at = time.monotonic() + keep_alive_s
@@ -623,15 +625,21 @@ class SearchActions:
 
     # ---- coordinator -------------------------------------------------------
 
-    def _shard_groups(self, state, names: list[str]):
+    def _shard_groups(self, state, names: list[str],
+                      routing: str | None = None):
         """→ [(index, shard, [copies in try-order])] — active copies only,
         local first, then rotated (preference/rotation,
-        performFirstPhase :156)."""
+        performFirstPhase :156). With `routing` (comma-separated keys)
+        the fan-out restricts to the shards those keys hash to
+        (OperationRouting.searchShards with a routing set)."""
+        from elasticsearch_tpu.cluster.routing import OperationRouting
         rot = next(self._rotation)
         groups = []
         for name in names:
             meta = state.indices[name]
-            for sid in range(meta.number_of_shards):
+            sids = OperationRouting.search_shards(
+                meta.number_of_shards, routing=routing)
+            for sid in sids:
                 copies = [c for c in
                           state.routing_table.shard_copies(name, sid)
                           if c.active]
@@ -727,7 +735,8 @@ class SearchActions:
 
     def search(self, index_expr: str, body: dict | None = None,
                scroll: str | None = None,
-               search_type: str | None = None) -> dict:
+               search_type: str | None = None,
+               routing: str | None = None) -> dict:
         from elasticsearch_tpu.common.errors import IllegalArgumentError
         if search_type not in self.SEARCH_TYPES:
             raise IllegalArgumentError(
@@ -761,30 +770,36 @@ class SearchActions:
             keep = parse_time_value(scroll, "scroll")
             scroll_pin = {"uid": _uuid.uuid4().hex, "keep_s": keep}
         if scan:
-            # per-shard page size, like the reference's scan contexts
+            # per-shard page size, like the reference's scan contexts —
+            # counting only the ROUTED shards when routing narrows them
             names = self.node.indices_service.resolve_open(index_expr)
             n_shards = len(self._shard_groups(
-                self.node.cluster_service.state(), names)) or 1
+                self.node.cluster_service.state(), names,
+                routing=routing)) or 1
             body["size"] = int(body.get("size", 10)) * n_shards
             probe = dict(body, size=0)
             resp = self._search_once(index_expr, probe, t0,
                                      dfs_cache=dfs_cache,
-                                     scroll_pin=scroll_pin)
+                                     scroll_pin=scroll_pin,
+                                     routing=routing)
             # cursor not advanced: the first scroll() call reads page one
             resp["_scroll_id"] = self._open_scroll(
                 index_expr, body, scroll, {"hits": {"hits": [{}]}},
-                dfs_cache=dfs_cache, ctx_uid=scroll_pin["uid"])
+                dfs_cache=dfs_cache, ctx_uid=scroll_pin["uid"],
+                routing=routing)
             return resp
         resp = self._search_once(index_expr, body, t0,
                                  search_type=search_type,
                                  dfs_cache=dfs_cache,
-                                 scroll_pin=scroll_pin)
+                                 scroll_pin=scroll_pin,
+                                 routing=routing)
         if scroll is not None:
             resp["_scroll_id"] = self._open_scroll(index_expr, body, scroll,
                                                    resp,
                                                    search_type=search_type,
                                                    dfs_cache=dfs_cache,
-                                                   ctx_uid=scroll_pin["uid"])
+                                                   ctx_uid=scroll_pin["uid"],
+                                                   routing=routing)
         return resp
 
     def _try_collective_plane(self, names, bodies: list, reqs: list,
@@ -992,15 +1007,20 @@ class SearchActions:
     def _search_once(self, index_expr: str, body: dict, t0: float,
                      search_type: str | None = None,
                      dfs_cache: dict | None = None,
-                     scroll_pin: dict | None = None) -> dict:
+                     scroll_pin: dict | None = None,
+                     routing: str | None = None) -> dict:
         names = self.node.indices_service.resolve_open(index_expr)
         body = rewrite_mlt_likes(self.node, body,
                                  names[0] if names else "_all")
         state = self.node.cluster_service.state()
         req = parse_search_request(body)
-        groups = self._shard_groups(state, names)
+        groups = self._shard_groups(state, names, routing=routing)
         dfs = None
-        if search_type == "dfs_query_then_fetch" and dfs_cache is None:
+        if search_type == "dfs_query_then_fetch" and dfs_cache is None \
+                and routing is None:
+            # (routed searches skip the plane: its one-program fan-out
+            # always covers EVERY shard, and restricting the mesh to a
+            # routed subset would cost a recompile per routing set)
             # collective plane (opt-in): when this node holds EVERY shard
             # of a single opted-in index, an eligible dfs search runs as
             # ONE shard_map program — per-shard emit, all_gather top-k
@@ -1141,8 +1161,10 @@ class SearchActions:
             total_shards=len(groups), failures=failures,
             successful=len(qpayloads) - len(fetch_failed))
 
-    def count(self, index_expr: str, body: dict | None = None) -> dict:
-        resp = self.search(index_expr, {**(body or {}), "size": 0})
+    def count(self, index_expr: str, body: dict | None = None,
+              routing: str | None = None) -> dict:
+        resp = self.search(index_expr, {**(body or {}), "size": 0},
+                           routing=routing)
         return {"count": resp["hits"]["total"],
                 "_shards": resp["_shards"]}
 
@@ -1546,10 +1568,12 @@ class SearchActions:
     def _open_scroll(self, index_expr: str, body: dict, scroll: str,
                      first_page: dict, search_type: str | None = None,
                      dfs_cache: dict | None = None,
-                     ctx_uid: str | None = None) -> str:
+                     ctx_uid: str | None = None,
+                     routing: str | None = None) -> str:
         keep = parse_time_value(scroll, "scroll")
         ctx = _ScrollContext(index_expr, body, keep, search_type=search_type,
                              ctx_uid=ctx_uid)
+        ctx.routing = routing
         ctx.dfs_cache = dfs_cache if dfs_cache is not None else {}
         self._note_page(ctx, first_page)
         with self._lock:
@@ -1595,7 +1619,8 @@ class SearchActions:
                                  search_type=ctx.search_type,
                                  dfs_cache=ctx.dfs_cache,
                                  scroll_pin={"uid": ctx.ctx_uid,
-                                             "keep_s": ctx.keep_alive_s})
+                                             "keep_s": ctx.keep_alive_s},
+                                 routing=ctx.routing)
         self._note_page(ctx, resp)
         resp["_scroll_id"] = scroll_id
         return resp

@@ -28,7 +28,12 @@ class OperationRouting:
     def search_shards(num_shards: int, preference: str | None = None,
                       routing: str | None = None) -> list[int]:
         """Which shards a search fans out to (one copy of every shard;
-        routing narrows to the owning shard — reference :67-71)."""
+        routing — a single key or a comma-separated set — narrows to the
+        shards those keys hash to, reference :67-71)."""
         if routing is not None:
-            return [OperationRouting.shard_id(routing, num_shards)]
+            keys = [r.strip() for r in str(routing).split(",")
+                    if r.strip()]
+            if keys:
+                return sorted({OperationRouting.shard_id(k, num_shards)
+                               for k in keys})
         return list(range(num_shards))

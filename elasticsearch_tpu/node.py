@@ -981,12 +981,15 @@ class Node:
 
     def search(self, index: str, body: dict | None = None,
                scroll: str | None = None,
-               search_type: str | None = None) -> dict:
+               search_type: str | None = None,
+               routing: str | None = None) -> dict:
         return self.search_actions.search(index, body, scroll=scroll,
-                                          search_type=search_type)
+                                          search_type=search_type,
+                                          routing=routing)
 
-    def count(self, index: str, body: dict | None = None) -> dict:
-        return self.search_actions.count(index, body)
+    def count(self, index: str, body: dict | None = None,
+              routing: str | None = None) -> dict:
+        return self.search_actions.count(index, body, routing=routing)
 
 
 def _nodes_predicate(expr, actual: int) -> bool:

@@ -1486,7 +1486,8 @@ class Handlers:
         resp = self.node.search(req.path_params["index"],
                                 self._search_body(req),
                                 scroll=req.param("scroll"),
-                                search_type=self._rest_search_type(req))
+                                search_type=self._rest_search_type(req),
+                                routing=req.param("routing"))
         t = req.path_params.get("type")
         if t and t != "_all":
             for hit in resp.get("hits", {}).get("hits", []):
@@ -1501,15 +1502,18 @@ class Handlers:
                                   "max_score": None, "hits": []}}
         resp = self.node.search("_all", self._search_body(req),
                                 scroll=req.param("scroll"),
-                                search_type=self._rest_search_type(req))
+                                search_type=self._rest_search_type(req),
+                                routing=req.param("routing"))
         return 200, resp
 
     def count(self, req: RestRequest):
         return 200, self.node.count(req.path_params["index"],
-                                    self._search_body(req))
+                                    self._search_body(req),
+                                    routing=req.param("routing"))
 
     def count_all(self, req: RestRequest):
-        return 200, self.node.count("_all", self._search_body(req))
+        return 200, self.node.count("_all", self._search_body(req),
+                                    routing=req.param("routing"))
 
     # ---- explain / termvectors / field_stats ------------------------------
 

@@ -1,0 +1,61 @@
+"""Randomized percolator fuzzer — reverse search vs the DSL oracle.
+
+Registers seeded random query trees (the test_dsl_fuzz generator) as
+percolators, then percolates seeded random docs: the set of matching
+query ids must equal evaluating each registered tree against the doc
+with the same pure-Python oracle the forward-search fuzzer uses —
+percolation is exactly reverse search, so the two suites share one
+semantic model (reference: PercolatorService's single-doc memory index).
+Reproduce with ESTPU_TEST_SEED.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from conftest import derive_seed
+from test_dsl_fuzz import VOCAB, gen_query, matches
+from elasticsearch_tpu.node import Node
+
+N_QUERIES = 30
+N_DOCS = 40
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node({}, data_path=tmp_path_factory.mktemp("pcfz") / "n").start()
+    n.indices_service.create_index(
+        "pz", {"settings": {"number_of_shards": 1,
+                            "number_of_replicas": 0},
+               "mappings": {"_doc": {"properties": {
+                   "t": {"type": "text",
+                         "analyzer": "whitespace"},
+                   "n": {"type": "long"}}}}})
+    yield n
+    n.close()
+
+
+def test_random_percolators_match_oracle(node):
+    from elasticsearch_tpu.search.percolator import percolate
+    rnd = random.Random(derive_seed("percolator-fuzz"))
+    queries = {}
+    for i in range(N_QUERIES):
+        q = gen_query(rnd)
+        queries[f"q{i}"] = q
+        node.indices_service.put_percolator("pz", f"q{i}", {"query": q})
+    meta = node.cluster_service.state().indices["pz"]
+    assert set(meta.percolators) == set(queries)
+    for di in range(N_DOCS):
+        toks = [rnd.choice(VOCAB) for _ in range(rnd.randint(2, 8))]
+        doc = {"t": " ".join(toks), "n": rnd.randint(0, 170)}
+        oracle_doc = {"_toks": set(toks), "_list": toks, "n": doc["n"]}
+        out = percolate(meta, doc)
+        got = {m["_id"] for m in out["matches"]}
+        want = {qid for qid, q in queries.items()
+                if matches(q, oracle_doc)}
+        assert got == want, (
+            f"doc {di} {doc}: extra {sorted(got - want)[:4]}, "
+            f"missing {sorted(want - got)[:4]}")
+        assert out["total"] == len(want)

@@ -1,0 +1,97 @@
+"""Randomized routing fuzzer — custom routing placement + CRUD
+consistency over a multi-shard index.
+
+Seeded docs carry random routing keys (some share keys, some omit
+routing). Invariants (reference: OperationRouting's hash(routing) %
+shards discipline): a doc indexed with routing R is always findable by
+get/delete WITH routing R; docs sharing a routing key land on ONE shard
+(verified through the search _shards accounting of routed searches);
+search without routing fans out and sees everything; routed search with
+routing R sees exactly the docs of R's shard. Reproduce with
+ESTPU_TEST_SEED.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from conftest import derive_seed
+from elasticsearch_tpu.node import Node
+
+N_SHARDS = 4
+N_DOCS = 80
+KEYS = ["r1", "r2", "r3", "r4", "r5", "r6"]
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node({}, data_path=tmp_path / "n").start()
+    n.indices_service.create_index(
+        "rt", {"settings": {"number_of_shards": N_SHARDS,
+                            "number_of_replicas": 0},
+               "mappings": {"_doc": {"properties": {
+                   "n": {"type": "long"}}}}})
+    yield n
+    n.close()
+
+
+def test_random_routing_consistency(node):
+    rnd = random.Random(derive_seed("routing-fuzz"))
+    routing: dict[str, str | None] = {}
+    for i in range(N_DOCS):
+        doc_id = f"d{i}"
+        r = rnd.choice(KEYS) if rnd.random() < 0.7 else None
+        routing[doc_id] = r
+        node.index_doc("rt", doc_id, {"n": i}, routing=r)
+    node.broadcast_actions.refresh("rt")
+
+    # every doc findable via its own routing (or none)
+    for doc_id, r in routing.items():
+        got = node.get_doc("rt", doc_id, routing=r)
+        assert got["found"], (doc_id, r)
+
+    # full search sees everything
+    out = node.search("rt", {"size": N_DOCS + 10})
+    assert out["hits"]["total"] == N_DOCS
+    assert out["_shards"]["total"] == N_SHARDS
+
+    # a routed search hits exactly ONE shard, and the docs it returns
+    # are precisely those whose routing key hashes to that shard — in
+    # particular every doc sharing the routing key is present
+    for key in KEYS:
+        routed = node.search("rt", {"size": N_DOCS + 10}, routing=key)
+        assert routed["_shards"]["total"] == 1, key
+        ids = {h["_id"] for h in routed["hits"]["hits"]}
+        same_key = {d for d, r in routing.items() if r == key}
+        assert same_key <= ids, (key, sorted(same_key - ids)[:5])
+
+    # a routed SCROLL stays routed on every page: the union of pages
+    # equals the routed one-shot search, never the full index
+    key = rnd.choice(KEYS)
+    routed_all = {h["_id"] for h in node.search(
+        "rt", {"size": N_DOCS + 10}, routing=key)["hits"]["hits"]}
+    r = node.search("rt", {"size": 7, "sort": [{"n": {"order": "asc"}}]},
+                    scroll="1m", routing=key)
+    seen = set()
+    sid = r["_scroll_id"]
+    hits = r["hits"]["hits"]
+    while hits:
+        seen.update(h["_id"] for h in hits)
+        r = node.search_actions.scroll(sid, scroll="1m")
+        sid = r["_scroll_id"]
+        hits = r["hits"]["hits"]
+    node.search_actions.clear_scroll(sid)
+    assert seen == routed_all, (key, len(seen), len(routed_all))
+
+    # routed deletes remove through the same placement
+    victims = rnd.sample(list(routing), 20)
+    for doc_id in victims:
+        node.delete_doc("rt", doc_id, routing=routing[doc_id])
+    node.broadcast_actions.refresh("rt")
+    out = node.search("rt", {"size": N_DOCS + 10})
+    assert out["hits"]["total"] == N_DOCS - len(victims)
+    for doc_id in victims:
+        got = node.get_doc("rt", doc_id, routing=routing[doc_id])
+        assert not got["found"], doc_id
