@@ -70,6 +70,7 @@ class _ScrollContext:
         self.search_type = search_type
         # a routed scroll stays routed on EVERY page, not just page one
         self.routing: str | None = None
+        self.preference: str | None = None
         self.dfs_cache: dict = {}
         self.keep_alive_s = keep_alive_s
         self.expires_at = time.monotonic() + keep_alive_s
@@ -626,32 +627,84 @@ class SearchActions:
     # ---- coordinator -------------------------------------------------------
 
     def _shard_groups(self, state, names: list[str],
-                      routing: str | None = None):
+                      routing: str | None = None,
+                      preference: str | None = None):
         """→ [(index, shard, [copies in try-order])] — active copies only,
         local first, then rotated (preference/rotation,
-        performFirstPhase :156). With `routing` (comma-separated keys)
-        the fan-out restricts to the shards those keys hash to
-        (OperationRouting.searchShards with a routing set)."""
+        performFirstPhase :156). `routing` (comma-separated keys)
+        restricts the fan-out to the shards those keys hash to
+        (OperationRouting.searchShards with a routing set); `preference`
+        selects/orders the copies per the reference's preference grammar
+        (_primary/_primary_first/_local/_only_node/_prefer_node/_shards
+        and custom sticky strings)."""
         from elasticsearch_tpu.cluster.routing import OperationRouting
         rot = next(self._rotation)
+        pref = preference
+        shard_filter: set[int] | None = None
+        if pref and pref.startswith("_shards:"):
+            # 2.x syntax: _shards:0,2[;<nested-preference>]
+            spec, _, nested = pref[len("_shards:"):].partition(";")
+            try:
+                shard_filter = {int(s) for s in spec.split(",")
+                                if s.strip()}
+            except ValueError:
+                from elasticsearch_tpu.common.errors import (
+                    IllegalArgumentError)
+                raise IllegalArgumentError(
+                    f"invalid _shards preference [{preference}]") from None
+            pref = nested or None
         groups = []
         for name in names:
             meta = state.indices[name]
             sids = OperationRouting.search_shards(
                 meta.number_of_shards, routing=routing)
             for sid in sids:
+                if shard_filter is not None and sid not in shard_filter:
+                    continue
                 copies = [c for c in
                           state.routing_table.shard_copies(name, sid)
                           if c.active]
-                local = [c for c in copies
-                         if c.node_id == self.node.node_id]
-                rest = [c for c in copies
-                        if c.node_id != self.node.node_id]
-                if rest:
-                    k = rot % len(rest)
-                    rest = rest[k:] + rest[:k]
-                groups.append((name, sid, local + rest))
+                # a preference that excludes every copy still keeps the
+                # group: the fan-out records a shard FAILURE for it (the
+                # reference raises rather than silently shrinking the
+                # result set)
+                groups.append((name, sid,
+                               self._order_copies(copies, pref, rot)))
         return groups
+
+    def _order_copies(self, copies: list, pref: str | None, rot: int):
+        """Copy try-order under a preference (OperationRouting's
+        preference-aware selection, reference :67-71)."""
+        local_id = self.node.node_id
+        if pref is None or pref == "_local":
+            # default: local copy first, then rotate the rest
+            local = [c for c in copies if c.node_id == local_id]
+            rest = [c for c in copies if c.node_id != local_id]
+            if rest:
+                k = rot % len(rest)
+                rest = rest[k:] + rest[:k]
+            return local + rest
+        if pref == "_primary":
+            return [c for c in copies if c.primary]
+        if pref == "_primary_first":
+            return [c for c in copies if c.primary] + \
+                [c for c in copies if not c.primary]
+        if pref.startswith("_only_node:"):
+            node_id = pref.split(":", 1)[1]
+            return [c for c in copies if c.node_id == node_id]
+        if pref.startswith("_prefer_node:"):
+            node_id = pref.split(":", 1)[1]
+            return [c for c in copies if c.node_id == node_id] + \
+                [c for c in copies if c.node_id != node_id]
+        # custom string: deterministic sticky rotation — the same
+        # preference value always lands on the same copy, on every
+        # coordinating node (murmur, NOT Python's per-process hash;
+        # Python's % is already non-negative for a positive modulus)
+        if copies:
+            from elasticsearch_tpu.utils.hashing import murmur3_hash32
+            k = murmur3_hash32(str(pref).encode("utf-8")) % len(copies)
+            return copies[k:] + copies[:k]
+        return []
 
     def _try_shard(self, state, name: str, sid: int, copies: list,
                    body: dict, doc_slot: int | None = None,
@@ -736,7 +789,8 @@ class SearchActions:
     def search(self, index_expr: str, body: dict | None = None,
                scroll: str | None = None,
                search_type: str | None = None,
-               routing: str | None = None) -> dict:
+               routing: str | None = None,
+               preference: str | None = None) -> dict:
         from elasticsearch_tpu.common.errors import IllegalArgumentError
         if search_type not in self.SEARCH_TYPES:
             raise IllegalArgumentError(
@@ -781,25 +835,28 @@ class SearchActions:
             resp = self._search_once(index_expr, probe, t0,
                                      dfs_cache=dfs_cache,
                                      scroll_pin=scroll_pin,
-                                     routing=routing)
+                                     routing=routing,
+                                     preference=preference)
             # cursor not advanced: the first scroll() call reads page one
             resp["_scroll_id"] = self._open_scroll(
                 index_expr, body, scroll, {"hits": {"hits": [{}]}},
                 dfs_cache=dfs_cache, ctx_uid=scroll_pin["uid"],
-                routing=routing)
+                routing=routing, preference=preference)
             return resp
         resp = self._search_once(index_expr, body, t0,
                                  search_type=search_type,
                                  dfs_cache=dfs_cache,
                                  scroll_pin=scroll_pin,
-                                 routing=routing)
+                                 routing=routing,
+                                 preference=preference)
         if scroll is not None:
             resp["_scroll_id"] = self._open_scroll(index_expr, body, scroll,
                                                    resp,
                                                    search_type=search_type,
                                                    dfs_cache=dfs_cache,
                                                    ctx_uid=scroll_pin["uid"],
-                                                   routing=routing)
+                                                   routing=routing,
+                                                   preference=preference)
         return resp
 
     def _try_collective_plane(self, names, bodies: list, reqs: list,
@@ -1008,19 +1065,21 @@ class SearchActions:
                      search_type: str | None = None,
                      dfs_cache: dict | None = None,
                      scroll_pin: dict | None = None,
-                     routing: str | None = None) -> dict:
+                     routing: str | None = None,
+                     preference: str | None = None) -> dict:
         names = self.node.indices_service.resolve_open(index_expr)
         body = rewrite_mlt_likes(self.node, body,
                                  names[0] if names else "_all")
         state = self.node.cluster_service.state()
         req = parse_search_request(body)
-        groups = self._shard_groups(state, names, routing=routing)
+        groups = self._shard_groups(state, names, routing=routing,
+                                    preference=preference)
         dfs = None
         if search_type == "dfs_query_then_fetch" and dfs_cache is None \
-                and routing is None:
-            # (routed searches skip the plane: its one-program fan-out
-            # always covers EVERY shard, and restricting the mesh to a
-            # routed subset would cost a recompile per routing set)
+                and routing is None and preference is None:
+            # (routed/preference-restricted searches skip the plane: its
+            # one-program fan-out always covers EVERY shard, and
+            # restricting the mesh would cost a recompile per subset)
             # collective plane (opt-in): when this node holds EVERY shard
             # of a single opted-in index, an eligible dfs search runs as
             # ONE shard_map program — per-shard emit, all_gather top-k
@@ -1162,9 +1221,10 @@ class SearchActions:
             successful=len(qpayloads) - len(fetch_failed))
 
     def count(self, index_expr: str, body: dict | None = None,
-              routing: str | None = None) -> dict:
+              routing: str | None = None,
+              preference: str | None = None) -> dict:
         resp = self.search(index_expr, {**(body or {}), "size": 0},
-                           routing=routing)
+                           routing=routing, preference=preference)
         return {"count": resp["hits"]["total"],
                 "_shards": resp["_shards"]}
 
@@ -1569,11 +1629,13 @@ class SearchActions:
                      first_page: dict, search_type: str | None = None,
                      dfs_cache: dict | None = None,
                      ctx_uid: str | None = None,
-                     routing: str | None = None) -> str:
+                     routing: str | None = None,
+                     preference: str | None = None) -> str:
         keep = parse_time_value(scroll, "scroll")
         ctx = _ScrollContext(index_expr, body, keep, search_type=search_type,
                              ctx_uid=ctx_uid)
         ctx.routing = routing
+        ctx.preference = preference
         ctx.dfs_cache = dfs_cache if dfs_cache is not None else {}
         self._note_page(ctx, first_page)
         with self._lock:
@@ -1620,7 +1682,8 @@ class SearchActions:
                                  dfs_cache=ctx.dfs_cache,
                                  scroll_pin={"uid": ctx.ctx_uid,
                                              "keep_s": ctx.keep_alive_s},
-                                 routing=ctx.routing)
+                                 routing=ctx.routing,
+                                 preference=ctx.preference)
         self._note_page(ctx, resp)
         resp["_scroll_id"] = scroll_id
         return resp

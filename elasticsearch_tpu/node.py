@@ -982,14 +982,18 @@ class Node:
     def search(self, index: str, body: dict | None = None,
                scroll: str | None = None,
                search_type: str | None = None,
-               routing: str | None = None) -> dict:
+               routing: str | None = None,
+               preference: str | None = None) -> dict:
         return self.search_actions.search(index, body, scroll=scroll,
                                           search_type=search_type,
-                                          routing=routing)
+                                          routing=routing,
+                                          preference=preference)
 
     def count(self, index: str, body: dict | None = None,
-              routing: str | None = None) -> dict:
-        return self.search_actions.count(index, body, routing=routing)
+              routing: str | None = None,
+              preference: str | None = None) -> dict:
+        return self.search_actions.count(index, body, routing=routing,
+                                         preference=preference)
 
 
 def _nodes_predicate(expr, actual: int) -> bool:

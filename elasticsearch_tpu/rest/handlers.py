@@ -1487,7 +1487,8 @@ class Handlers:
                                 self._search_body(req),
                                 scroll=req.param("scroll"),
                                 search_type=self._rest_search_type(req),
-                                routing=req.param("routing"))
+                                routing=req.param("routing"),
+                                preference=req.param("preference"))
         t = req.path_params.get("type")
         if t and t != "_all":
             for hit in resp.get("hits", {}).get("hits", []):
@@ -1503,17 +1504,20 @@ class Handlers:
         resp = self.node.search("_all", self._search_body(req),
                                 scroll=req.param("scroll"),
                                 search_type=self._rest_search_type(req),
-                                routing=req.param("routing"))
+                                routing=req.param("routing"),
+                                preference=req.param("preference"))
         return 200, resp
 
     def count(self, req: RestRequest):
         return 200, self.node.count(req.path_params["index"],
                                     self._search_body(req),
-                                    routing=req.param("routing"))
+                                    routing=req.param("routing"),
+                                    preference=req.param("preference"))
 
     def count_all(self, req: RestRequest):
         return 200, self.node.count("_all", self._search_body(req),
-                                    routing=req.param("routing"))
+                                    routing=req.param("routing"),
+                                    preference=req.param("preference"))
 
     # ---- explain / termvectors / field_stats ------------------------------
 

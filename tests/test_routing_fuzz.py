@@ -37,6 +37,44 @@ def node(tmp_path):
     n.close()
 
 
+def test_preference_variants(tmp_path):
+    """The preference grammar selects/orders shard copies: _shards
+    restricts the shard set, _only_node restricts copies to one node,
+    _primary works cluster-wide, and a custom string is sticky."""
+    from elasticsearch_tpu.testing import InternalTestCluster
+    with InternalTestCluster(num_nodes=2, base_path=tmp_path) as c:
+        c.wait_for_nodes(2)
+        a = c.master()
+        a.indices_service.create_index("pf", {"settings": {
+            "number_of_shards": 2, "number_of_replicas": 1}})
+        c.wait_for_health("green")
+        for i in range(30):
+            a.index_doc("pf", str(i), {"n": i})
+        a.broadcast_actions.refresh("pf")
+        out = a.search("pf", {"size": 40}, preference="_primary")
+        assert out["hits"]["total"] == 30
+        assert out["_shards"]["total"] == 2
+        out = a.search("pf", {"size": 40}, preference="_shards:0")
+        assert out["_shards"]["total"] == 1
+        sub = {h["_id"] for h in out["hits"]["hits"]}
+        out1 = a.search("pf", {"size": 40}, preference="_shards:1")
+        sub1 = {h["_id"] for h in out1["hits"]["hits"]}
+        assert sub | sub1 == {str(i) for i in range(30)}
+        assert not (sub & sub1)
+        # every copy lives on one of the two nodes; _only_node on each
+        # node still sees the whole corpus only if that node holds a
+        # copy of every shard (1 replica on 2 nodes → it does)
+        for n in c.nodes:
+            out = a.search("pf", {"size": 40},
+                           preference=f"_only_node:{n.node_id}")
+            assert out["hits"]["total"] == 30, n.node_name
+        # custom preference: sticky — same string, same result set
+        r1 = a.search("pf", {"size": 40}, preference="session-42")
+        r2 = a.search("pf", {"size": 40}, preference="session-42")
+        assert [h["_id"] for h in r1["hits"]["hits"]] == \
+            [h["_id"] for h in r2["hits"]["hits"]]
+
+
 def test_random_routing_consistency(node):
     rnd = random.Random(derive_seed("routing-fuzz"))
     routing: dict[str, str | None] = {}
