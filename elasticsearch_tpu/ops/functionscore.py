@@ -83,42 +83,55 @@ def weight_factor(n: int, weight: float):
     return jnp.full(n, weight, dtype=jnp.float32)
 
 
-def combine_functions(factors: list, masks: list, score_mode: str):
-    """score_mode over per-function factors (function filters pre-applied as
-    masks: non-matching docs contribute the identity)."""
+def combine_functions(factors: list, masks: list, score_mode: str,
+                      weights: list | None = None):
+    """score_mode over per-function factors (function filters pre-applied
+    as masks). A doc matched by NO function keeps the combined factor at
+    1.0 in EVERY mode — FiltersFunctionScoreQuery.innerScore initializes
+    factor = 1.0 and its per-mode guards (±inf for max/min, weightSum ==
+    0 for sum/avg) leave it untouched when nothing matched. `weights`
+    (per-function scalars, default 1) feed avg's weighted denominator
+    (reference: weightSum accumulates WeightFactorFunction weights)."""
     if not factors:
         return None
-    if score_mode in ("multiply", "first"):
+    if score_mode == "first":
+        # first MATCHING function wins (not the first listed one)
+        out = jnp.ones_like(factors[0])
+        chosen = jnp.zeros(factors[0].shape, bool)
+        for f, m in zip(factors, masks):
+            take = m & ~chosen
+            out = jnp.where(take, f, out)
+            chosen = chosen | m
+        return out
+    if score_mode == "multiply":
         out = None
         for f, m in zip(factors, masks):
             f = jnp.where(m, f, 1.0)
-            if score_mode == "first":
-                out = f if out is None else out  # first listed function wins
-            else:
-                out = f if out is None else out * f
+            out = f if out is None else out * f
         return out
-    if score_mode == "sum":
-        out = None
-        for f, m in zip(factors, masks):
+    if score_mode in ("sum", "avg"):
+        tot, wsum = None, None
+        ws = weights if weights is not None else [1.0] * len(factors)
+        for f, m, w in zip(factors, masks, ws):
             f = jnp.where(m, f, 0.0)
-            out = f if out is None else out + f
-        return out
-    if score_mode == "avg":
-        tot, cnt = None, None
-        for f, m in zip(factors, masks):
-            f = jnp.where(m, f, 0.0)
-            c = m.astype(jnp.float32)
+            c = jnp.where(m, w, 0.0).astype(jnp.float32)
             tot = f if tot is None else tot + f
-            cnt = c if cnt is None else cnt + c
-        return tot / jnp.maximum(cnt, 1.0)
+            wsum = c if wsum is None else wsum + c
+        out = tot if score_mode == "sum" else tot / jnp.maximum(wsum, 1e-9)
+        return jnp.where(wsum > 0, out, 1.0)
     if score_mode in ("max", "min"):
         red = jnp.maximum if score_mode == "max" else jnp.minimum
-        out = None
+        out, any_m = None, None
         for f, m in zip(factors, masks):
             fill = -jnp.inf if score_mode == "max" else jnp.inf
             f = jnp.where(m, f, fill)
             out = f if out is None else red(out, f)
-        return jnp.where(jnp.isfinite(out), out, 1.0)
+            any_m = m if any_m is None else (any_m | m)
+        # fall back to 1.0 only where NO function matched — a matched
+        # function legitimately producing ±inf must keep it (the
+        # reference's guard compares against the sentinel it seeded,
+        # not against infiniteness of the result)
+        return jnp.where(any_m, out, 1.0)
     raise ValueError(f"unknown score_mode [{score_mode}]")
 
 

@@ -1434,7 +1434,9 @@ class SegmentResolver:
                                fe(em, s) * em.get(rw))(factor_emit, r_w)
             fmask_emit = self.resolve_mask(fn.filter_query) \
                 if fn.filter_query else None
-            fn_emits.append((factor_emit, fmask_emit))
+            r_wsum = self.c(fn.weight if fn.weight is not None else 1.0,
+                            np.float32)
+            fn_emits.append((factor_emit, fmask_emit, r_wsum))
         score_mode, boost_mode = query.score_mode, query.boost_mode
         r_max_boost = None if query.max_boost is None \
             else self.c(query.max_boost, np.float32)
@@ -1444,12 +1446,14 @@ class SegmentResolver:
 
         def emit(em):
             base_scores, base_mask = base_emit(em)
-            factors, masks = [], []
-            for factor_emit, fmask_emit in fn_emits:
+            factors, masks, weights = [], [], []
+            for factor_emit, fmask_emit, r_wsum in fn_emits:
                 factors.append(factor_emit(em, base_scores))
                 masks.append(fmask_emit(em) if fmask_emit is not None
                              else jnp.ones(em.n, bool))
-            combined = fs_ops.combine_functions(factors, masks, score_mode)
+                weights.append(em.get(r_wsum))
+            combined = fs_ops.combine_functions(factors, masks, score_mode,
+                                                weights=weights)
             if combined is None:
                 scores = base_scores
             else:

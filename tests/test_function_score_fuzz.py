@@ -1,0 +1,199 @@
+"""Randomized function_score fuzzer — exact scoring algebra vs the
+independent BM25 oracle.
+
+Base relevance comes from `scripts/bm25_oracle.py` (written from the
+published BM25 formula, shares no code with the engine); the fuzzer
+layers random function_score shapes on top — weight / field_value_factor
+(modifiers none/log1p/sqrt/square, factors, per-function weights),
+optional per-function filters, score_mode multiply/sum/avg/first/max/
+min, boost_mode multiply/sum/max/min/replace, occasional max_boost —
+and recomputes the
+full algebra in float64 (FunctionScoreQuery / FiltersFunctionScoreQuery
+semantics). Every returned hit's score must match the oracle at f32
+tolerance and the returned page must be a true top-k. Reproduce with
+ESTPU_TEST_SEED.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import random
+import sys
+
+import numpy as np
+import pytest
+
+from conftest import derive_seed
+from elasticsearch_tpu.node import Node
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "scripts"))
+from bm25_oracle import BM25Oracle  # noqa: E402
+
+VOCAB = [f"w{i}" for i in range(40)]
+N_DOCS = 400
+N_QUERIES = 25
+K = 10
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rnd = random.Random(derive_seed("fs-fuzz-corpus"))
+    docs = []
+    for i in range(N_DOCS):
+        toks = [rnd.choice(VOCAB) for _ in range(rnd.randint(4, 20))]
+        docs.append({"id": str(i), "toks": toks,
+                     "fv": round(rnd.uniform(0.5, 40.0), 3)})
+    return docs
+
+
+@pytest.fixture(scope="module")
+def oracle(corpus):
+    tid = {w: i for i, w in enumerate(VOCAB)}
+    L = max(len(d["toks"]) for d in corpus)
+    mat = np.full((len(corpus), L), -1, np.int64)
+    for i, d in enumerate(corpus):
+        mat[i, :len(d["toks"])] = [tid[w] for w in d["toks"]]
+    return BM25Oracle(mat), tid
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory, corpus):
+    n = Node({}, data_path=tmp_path_factory.mktemp("fsfz") / "n").start()
+    n.indices_service.create_index(
+        "fs", {"settings": {"number_of_shards": 1,
+                            "number_of_replicas": 0},
+               "mappings": {"_doc": {"properties": {
+                   "t": {"type": "text", "analyzer": "whitespace"},
+                   "fv": {"type": "double"}}}}})
+    for d in corpus:
+        n.index_doc("fs", d["id"], {"t": " ".join(d["toks"]),
+                                    "fv": d["fv"]})
+    n.broadcast_actions.refresh("fs")
+    yield n
+    n.close()
+
+
+MODIFIERS = {"none": lambda x: x,
+             "log1p": lambda x: math.log10(1.0 + x),
+             "sqrt": math.sqrt,
+             "square": lambda x: x * x}
+
+
+def gen_function(rnd):
+    fn: dict = {}
+    kind = rnd.random()
+    if kind < 0.35:
+        fn["weight"] = round(rnd.uniform(0.2, 4.0), 2)
+    else:
+        fvf = {"field": "fv",
+               "factor": round(rnd.uniform(0.5, 2.0), 2),
+               "modifier": rnd.choice(list(MODIFIERS))}
+        fn["field_value_factor"] = fvf
+        if rnd.random() < 0.4:
+            fn["weight"] = round(rnd.uniform(0.2, 3.0), 2)
+    if rnd.random() < 0.4:
+        lo = round(rnd.uniform(0, 25), 2)
+        fn["filter"] = {"range": {"fv": {"gte": lo}}}
+    return fn
+
+
+def oracle_function_value(fn, doc):
+    """→ (value, weight) for a matching function, None otherwise."""
+    if "filter" in fn:
+        if not doc["fv"] >= fn["filter"]["range"]["fv"]["gte"]:
+            return None
+    w = fn.get("weight", 1.0) if "field_value_factor" in fn \
+        else fn["weight"]
+    if "field_value_factor" in fn:
+        fvf = fn["field_value_factor"]
+        v = MODIFIERS[fvf["modifier"]](fvf["factor"] * doc["fv"])
+        if fn.get("weight") is not None:
+            v *= fn["weight"]
+        return v, w
+    return fn["weight"], w
+
+
+def combine(pairs, mode):
+    """FiltersFunctionScoreQuery.innerScore: factor starts at 1.0 and a
+    doc matched by NO function keeps it — the per-mode guards (±inf,
+    weightSum == 0) leave the initial 1.0 untouched. `avg` divides by
+    the weight sum; `first` takes the first MATCHING function."""
+    if not pairs:
+        return 1.0
+    values = [v for v, _ in pairs]
+    if mode == "multiply":
+        out = 1.0
+        for v in values:
+            out *= v
+        return out
+    if mode == "sum":
+        return sum(values)
+    if mode == "avg":
+        wsum = sum(w for _, w in pairs)
+        return sum(values) / wsum if wsum else 1.0
+    if mode == "first":
+        return values[0]
+    if mode == "max":
+        return max(values)
+    return min(values)
+
+
+def boost_combine(base, fnval, mode, max_boost):
+    if max_boost is not None:
+        fnval = min(fnval, max_boost)
+    return {"multiply": base * fnval, "sum": base + fnval,
+            "max": max(base, fnval), "min": min(base, fnval),
+            "replace": fnval}[mode]
+
+
+def test_random_function_score_matches_oracle(node, corpus, oracle):
+    bm25, tid = oracle
+    rnd = random.Random(derive_seed("fs-fuzz-queries"))
+    for qi in range(N_QUERIES):
+        terms = rnd.sample(VOCAB, rnd.randint(1, 3))
+        functions = [gen_function(rnd)
+                     for _ in range(rnd.randint(1, 3))]
+        score_mode = rnd.choice(["multiply", "sum", "max", "min",
+                                 "avg", "first"])
+        boost_mode = rnd.choice(["multiply", "sum", "max", "min",
+                                 "replace"])
+        max_boost = round(rnd.uniform(1.0, 8.0), 2) \
+            if rnd.random() < 0.3 else None
+        body = {"query": {"function_score": {
+            "query": {"match": {"t": " ".join(terms)}},
+            "functions": functions,
+            "score_mode": score_mode, "boost_mode": boost_mode}},
+            "size": K}
+        if max_boost is not None:
+            body["query"]["function_score"]["max_boost"] = max_boost
+        out = node.search("fs", body)
+
+        qids = np.array([tid[w] for w in terms], np.int64)
+        base = bm25.score_query(qids)
+        want = {}
+        for i, d in enumerate(corpus):
+            if base[i] <= 0.0:
+                continue
+            pairs = [p for p in (oracle_function_value(f, d)
+                                 for f in functions) if p is not None]
+            want[d["id"]] = boost_combine(
+                float(base[i]), combine(pairs, score_mode), boost_mode,
+                max_boost)
+        ctx = (qi, terms, functions, score_mode, boost_mode, max_boost)
+        assert out["hits"]["total"] == len(want), ctx
+        hits = out["hits"]["hits"]
+        for h in hits:
+            w = want[h["_id"]]
+            assert math.isclose(h["_score"], w,
+                                rel_tol=3e-4, abs_tol=1e-4), \
+                (ctx, h["_id"], h["_score"], w)
+        # true top-k: the k-th returned score matches the oracle's k-th
+        kk = min(K, len(want))
+        top = sorted(want.values(), reverse=True)[:kk]
+        got = [h["_score"] for h in hits]
+        assert len(got) == kk, ctx
+        for g, w in zip(got, top):
+            assert math.isclose(g, w, rel_tol=3e-4, abs_tol=1e-4), \
+                (ctx, got[:5], top[:5])
