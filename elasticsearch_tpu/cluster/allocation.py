@@ -643,6 +643,34 @@ class AllocationService:
         state = state.with_(routing_table=routing)
         return self.reroute(state, "reroute_commands")
 
+    def reset_failed_counters(self, state: ClusterState) -> ClusterState:
+        """Fresh retry budget for shards that exhausted
+        index.allocation.max_retries. The reference requires a manual
+        `_cluster/reroute?retry_failed`; here a node JOIN is the natural
+        automatic trigger — partition-time recovery failures burn
+        through the budget in seconds and must not wedge a replica
+        forever once the cluster heals."""
+        import dataclasses
+        routing = state.routing_table
+        changed = False
+        # one-pass rebuild, NOT replace_shard: failed replicas of the
+        # same shard share an identical key (node/allocation ids are
+        # None), so key-based replacement would reset one slot twice and
+        # leave its sibling wedged
+        out = []
+        for s in routing.shards:
+            ui = s.unassigned_info
+            if not s.assigned and ui is not None and ui.failed_allocations:
+                out.append(dataclasses.replace(
+                    s, unassigned_info=dataclasses.replace(
+                        ui, failed_allocations=0)))
+                changed = True
+            else:
+                out.append(s)
+        if not changed:
+            return state
+        return state.with_(routing_table=type(routing)(tuple(out)))
+
     def reroute(self, state: ClusterState, reason: str = "") -> ClusterState:
         routing = self._fail_shards_on_missing_nodes(state,
                                                      state.routing_table)

@@ -331,7 +331,8 @@ class ZenDiscovery:
                     # existing-node joins for the same reason).
                     return st.with_(nodes=nodes)
                 return self.allocation.reroute(
-                    st.with_(nodes=nodes),
+                    self.allocation.reset_failed_counters(
+                        st.with_(nodes=nodes)),
                     f"node joined [{joiner.name}]")
             fut = self.cluster_service.submit_state_update(
                 f"zen-disco-join [{joiner.name}]", update, priority=URGENT)

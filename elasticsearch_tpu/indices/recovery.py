@@ -65,6 +65,11 @@ class PeerRecoveryService:
                                     executor="recovery", sync=True)
         self.stats = {"recoveries": 0, "files_sent": 0, "files_skipped": 0,
                       "bytes_sent": 0, "ops_replayed": 0}
+        # (index, shard) → source node_id of the recovery THIS target is
+        # currently running: inbound chunk/cleanup/ops RPCs from any
+        # other node are stale (a source we abandoned after it left the
+        # state) and must not interleave with the live stream
+        self._active_sources: dict[tuple[str, int], str] = {}
 
     # ---- target side -------------------------------------------------------
 
@@ -100,8 +105,13 @@ class PeerRecoveryService:
             raise DelayRecoveryError("primary node not in cluster state")
         local = self.node.transport_service.local_node
         engine.pin_commit(flush_first=False)     # block local flush/merge
+        skey = (shard_routing.index, shard_routing.shard)
+        self._active_sources[skey] = source_node.node_id
         try:                                     # while files stream in
-            self.node.transport_service.submit_request(
+            # timeout rides the POLL below (which can also cancel on
+            # source-left); a transport-level timer would complete the
+            # future with ReceiveTimeoutError and skip the retry path
+            fut = self.node.transport_service.send_request(
                 source_node, START_RECOVERY,
                 {"index": shard_routing.index, "shard": shard_routing.shard,
                  "target_node": {"node_id": local.node_id,
@@ -110,7 +120,30 @@ class PeerRecoveryService:
                                  "port": local.address.port,
                                  "version": local.version},
                  "manifest": engine.file_manifest()},
-                timeout=120.0)
+                timeout=None)
+            # poll instead of a blind 120 s block: a partition can swallow
+            # the source mid-recovery, and the reference CANCELS in-flight
+            # recoveries when the source node leaves the cluster state
+            # (RecoveriesCollection.cancelRecoveriesForShard) rather than
+            # waiting out the RPC timeout — retry then targets whatever
+            # primary the healed cluster elects
+            import concurrent.futures as _cf
+            deadline = time.monotonic() + 125.0
+            while True:
+                try:
+                    fut.result(timeout=1.0)
+                    break
+                except _cf.TimeoutError:
+                    if time.monotonic() > deadline:
+                        raise DelayRecoveryError(
+                            "recovery start timed out") from None
+                    now = self.node.cluster_service.state()
+                    cur = now.routing_table.primary(
+                        shard_routing.index, shard_routing.shard)
+                    if cur is None or cur.node_id != source_node.node_id \
+                            or source_node.node_id not in now.nodes:
+                        raise DelayRecoveryError(
+                            "recovery source left the cluster") from None
         except RemoteTransportError as e:
             # a source-side delay crosses the wire as RemoteTransportError;
             # surface it as the retryable kind, not a shard failure
@@ -118,6 +151,7 @@ class PeerRecoveryService:
                 raise DelayRecoveryError(e.reason) from None
             raise
         finally:
+            self._active_sources.pop(skey, None)
             engine.unpin_commit()
 
     # ---- source side -------------------------------------------------------
@@ -223,7 +257,22 @@ class PeerRecoveryService:
                 "not open")
         return engine
 
+    def _check_source(self, request: dict, source) -> None:
+        """Inbound recovery traffic must come from the source THIS
+        target's current recovery targets — after a cancel-on-source-left
+        retry, the abandoned source may still be streaming, and two
+        sources interleaving writes into the same files corrupts the
+        shard (RecoveriesCollection's per-recovery session discipline)."""
+        want = self._active_sources.get((request["index"],
+                                         request["shard"]))
+        if want is None or source.node_id != want:
+            raise RecoveryFailedError(
+                f"[{request['index']}][{request['shard']}] recovery "
+                f"traffic from stale source [{source.node_id}]"
+                f" (current: [{want}])")
+
     def _handle_file_chunk(self, request: dict, source) -> dict:
+        self._check_source(request, source)
         engine = self._target_engine(request)
         rel = request["path"]
         if ".." in rel or rel.startswith("/"):
@@ -242,6 +291,7 @@ class PeerRecoveryService:
         return {}
 
     def _handle_clean_files(self, request: dict, source) -> dict:
+        self._check_source(request, source)
         engine = self._target_engine(request)
         keep = set(request["keep"])
         # remove files of stale segments the source's commit doesn't know
@@ -261,6 +311,7 @@ class PeerRecoveryService:
 
     def _handle_translog_ops(self, request: dict, source) -> dict:
         from elasticsearch_tpu.index.translog import OP_INDEX
+        self._check_source(request, source)
         engine = self._target_engine(request)
         for op in request["ops"]:
             if op["op"] == OP_INDEX:

@@ -381,6 +381,12 @@ class IndicesService:
         self.master_executor = None
         # allocation ids this node has already reported as started
         self._reported_started: set[str] = set()
+        # allocation_id → ("started", None) | ("failed", reason): what we
+        # last told the master, so a report LOST to a partition can be
+        # re-sent when a later state still shows the shard INITIALIZING
+        # (the reference re-sends shardStarted on every clusterChanged
+        # where the master's view lags, IndicesClusterStateService)
+        self._report_outcome: dict[str, tuple[str, str | None]] = {}
         # Node wires this to the ShardStateAction path:
         # on_shard_started(shard_routing) → master applies started
         self.on_shard_started = None
@@ -442,16 +448,35 @@ class IndicesService:
             # during the constructor reconcile it is not yet, and the
             # Node's follow-up recheck must pick these shards up.
             for s in local:
-                if s.state == ShardRoutingState.INITIALIZING and \
-                        s.allocation_id not in self._reported_started and \
-                        s.allocation_id not in self._recovering and \
-                        self.on_shard_started is not None:
-                    self._recovering.add(s.allocation_id)
+                if s.state != ShardRoutingState.INITIALIZING or \
+                        s.allocation_id in self._recovering or \
+                        self.on_shard_started is None:
+                    continue
+                if s.allocation_id in self._reported_started:
+                    # the master STILL sees this copy INITIALIZING after
+                    # we reported — the report was lost (partition mid-
+                    # RPC). Re-send the recorded outcome; without this a
+                    # lost report wedges the shard INITIALIZING forever
+                    outcome, reason = self._report_outcome.get(
+                        s.allocation_id, ("started", None))
                     try:
-                        self._recovery_executor.submit(
-                            self._do_recovery, s, svc.engines[s.shard])
-                    except RuntimeError:         # node closing
-                        self._recovering.discard(s.allocation_id)
+                        if outcome == "failed":
+                            # never promote a failed copy just because
+                            # the failure callback is unwired
+                            if self.on_shard_failed is not None:
+                                self.on_shard_failed(
+                                    s, reason or "recovery failed")
+                        else:
+                            self.on_shard_started(s)
+                    except Exception:    # noqa: BLE001 — retry next state
+                        pass
+                    continue
+                self._recovering.add(s.allocation_id)
+                try:
+                    self._recovery_executor.submit(
+                        self._do_recovery, s, svc.engines[s.shard])
+                except RuntimeError:             # node closing
+                    self._recovering.discard(s.allocation_id)
 
         for name in list(self.indices):
             if name not in new.indices:
@@ -487,10 +512,16 @@ class IndicesService:
             return
         except Exception as e:                   # noqa: BLE001 — report fail
             self._recovering.discard(s.allocation_id)
+            # outcome FIRST: a concurrent reconcile that sees the id in
+            # _reported_started must never default to "started" for a
+            # copy whose recovery failed
+            self._report_outcome[s.allocation_id] = \
+                ("failed", f"recovery failed: {e}")
             self._reported_started.add(s.allocation_id)
             if self.on_shard_failed is not None:
                 self.on_shard_failed(s, f"recovery failed: {e}")
             return
+        self._report_outcome[s.allocation_id] = ("started", None)
         self._reported_started.add(s.allocation_id)
         self._recovering.discard(s.allocation_id)
         self._record_recovery(s, engine, t0)
@@ -554,6 +585,7 @@ class IndicesService:
         next reconcile re-sends it (the reference resends shardStarted for
         shards still INITIALIZING in a new state)."""
         self._reported_started.discard(allocation_id)
+        self._report_outcome.pop(allocation_id, None)
 
     # ---- metadata CRUD (MetaDataCreateIndexService analog) ----------------
 

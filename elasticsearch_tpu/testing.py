@@ -28,6 +28,9 @@ class InternalTestCluster:
         "fd.ping_retries": 2,
         "discovery.zen.ping_timeout": 0.3,
         "discovery.zen.publish_timeout": 2.0,
+        # a node joining a busy post-disruption cluster can need several
+        # ping rounds under CI load; the default 30 s occasionally flakes
+        "discovery.initial_state_timeout": 60.0,
     }
 
     def __init__(self, num_nodes: int = 3, base_path: str | Path | None = None,

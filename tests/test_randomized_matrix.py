@@ -130,10 +130,28 @@ def _scenario_kill_replica_holder(c, rnd):
         a.index_doc("m_kill", str(i), {"n": i})
     victim = c.nodes[rnd.randrange(1, len(c.nodes))]
     c.stop_node(victim, graceful=False)
-    # first the SURVIVORS must absorb the lost replica and reach green —
-    # adding the replacement before this wait would let the fresh node
-    # take the replica and mask a broken re-allocation path
-    _wait_nodes_green(c)
+    # first the SURVIVORS must absorb the loss — converged membership
+    # and every primary of THIS index active (replica promotion) —
+    # before the replacement joins; full-cluster green may be impossible
+    # here when an earlier scenario's index wants more replicas than the
+    # shrunken cluster can host
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            m = c.master()          # transiently no-majority mid-election
+        except RuntimeError:
+            time.sleep(0.2)
+            continue
+        st = m.cluster_service.state()
+        n_sh = st.indices["m_kill"].number_of_shards
+        prim_ok = all(
+            (pr := st.routing_table.primary("m_kill", s)) is not None
+            and pr.state == "STARTED" for s in range(n_sh))
+        if len(st.nodes) == len(c.nodes) and prim_ok:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("survivors never recovered m_kill primaries")
     # then replace the killed node so later scenarios see the drawn
     # cluster shape — the quorum (minimum_master_nodes) was fixed at
     # creation time from that shape, and a permanently shrunk cluster
