@@ -23,7 +23,7 @@ from elasticsearch_tpu.common.errors import (
     reconstruct_error)
 from elasticsearch_tpu.index.engine import MATCH_ANY
 from elasticsearch_tpu.transport.service import (
-    RemoteTransportError, TransportException)
+    NodeDisconnectedError, RemoteTransportError, TransportException)
 
 
 def update_get_section(source: dict | None, version,
@@ -84,9 +84,15 @@ class DocumentActions:
     TERMVECTORS_S = "indices:data/read/tv[s]"
 
     #: how long the reroute phase waits for an active primary (the
-    #: reference's default index timeout is 1m; tests want seconds)
+    #: reference's default index timeout is 1m; tests want seconds).
+    #: REPLICA_TIMEOUT bounds how long a primary waits on one replica
+    #: ack: in-process replica applies are ms-scale, so 8 s is already
+    #: 3+ orders of magnitude of slack — and under injected message
+    #: drops it is the difference between "replica failed, reallocate"
+    #: in seconds and a half-minute write stall per lost frame
     PRIMARY_TIMEOUT = 15.0
-    REPLICA_TIMEOUT = 30.0
+    REPLICA_TIMEOUT = 8.0
+    BLOCK_RETRY_TIMEOUT = 5.0
 
     def __init__(self, node):
         self.node = node
@@ -186,11 +192,16 @@ class DocumentActions:
                     time.sleep(0.05)
                     continue
             target = self._state().node(pr.node_id)
+            # per-ATTEMPT timeout well below the overall deadline: a
+            # single dropped frame must cost one retry round, not the
+            # whole budget (a chaos-matrix lesson — with attempt ==
+            # deadline, one lost RPC turned into UnavailableShards)
+            attempt_timeout = min(
+                5.0, max(deadline - time.monotonic(), 0.5))
             try:
                 return self.node.transport_service.send_request(
                     target, action, request,
-                    timeout=self.PRIMARY_TIMEOUT).result(
-                        self.PRIMARY_TIMEOUT + 5)
+                    timeout=attempt_timeout).result(attempt_timeout + 5)
             except RemoteTransportError as e:
                 if _is_retryable(e):             # stale routing at the
                     last = e                     # target (primary moved) →
@@ -248,6 +259,15 @@ class DocumentActions:
                 ok += 1
                 delivered.add(c.node_id)
             except Exception as e:               # noqa: BLE001 — report it
+                if self.node.transport_service._closed:
+                    # the "replica failure" is an artifact of THIS node
+                    # dying (its close failed the in-flight fan-out). A
+                    # dying primary must not ack-with-failed-replica:
+                    # the ack could still escape while the failure
+                    # report dies with the node, and the promoted
+                    # replica would silently miss an acked write
+                    raise NodeDisconnectedError(
+                        "node is shutting down mid-replication") from e
                 failures.append({"shard": shard, "index": name,
                                  "node": c.node_id, "status": "INTERNAL",
                                  "reason": str(unwrap_remote(e))})
@@ -278,10 +298,22 @@ class DocumentActions:
         """Reject writes while the no-master block is in force (reference:
         `discovery.zen.no_master_block` defaults to `write` — a node on the
         minority side of a partition must not accept writes it can never
-        durably replicate; reads stay allowed)."""
-        if NO_MASTER_BLOCK in self._state().blocks:
-            raise ClusterBlockError(
-                "blocked by: [SERVICE_UNAVAILABLE/2/no master];")
+        durably replicate; reads stay allowed). The block is RETRYABLE
+        (TransportReplicationAction.ReroutePhase waits on retryable
+        cluster blocks): a re-election lasts well under a second, and
+        failing writes instantly through it turns every transient master
+        blip into caller-visible errors."""
+        if NO_MASTER_BLOCK not in self._state().blocks:
+            return
+        # a few seconds covers any re-election; a real quorum loss still
+        # surfaces as the block error, just not instantly
+        deadline = time.monotonic() + self.BLOCK_RETRY_TIMEOUT
+        while time.monotonic() < deadline:
+            if NO_MASTER_BLOCK not in self._state().blocks:
+                return
+            time.sleep(0.05)
+        raise ClusterBlockError(
+            "blocked by: [SERVICE_UNAVAILABLE/2/no master];")
 
     # ---- index -------------------------------------------------------------
 
@@ -962,8 +994,9 @@ class DocumentActions:
                                                        item["id"], e))
         # per-REQUEST durability: ONE translog fsync per shard bulk, after
         # the item loop and before acking (IndexShard.sync in
-        # TransportShardBulkAction) — not one per op
-        engine.translog.sync()
+        # TransportShardBulkAction) — not one per op; an IO error here
+        # self-fails the engine (retryable upstream) instead of acking
+        engine.translog_sync()
         if request.get("refresh"):
             engine.refresh()
         delivered: set = set()
@@ -985,7 +1018,7 @@ class DocumentActions:
                                      meta=op.get("meta"), sync=False)
             else:
                 engine.delete_replica(op["id"], op["version"], sync=False)
-        engine.translog.sync()          # per-request durability (see
+        engine.translog_sync()          # per-request durability (see
         if request.get("refresh"):      # the primary loop above)
             engine.refresh()
         return {}

@@ -287,9 +287,17 @@ class NodeVersionAllocationDecider(AllocationDecider):
 
 class MaxRetryAllocationDecider(AllocationDecider):
     """Give up after N failed allocation attempts
-    (decider/MaxRetryAllocationDecider.java)."""
+    (decider/MaxRetryAllocationDecider.java) — but only for a cooldown,
+    not forever: the reference requires a manual
+    `_cluster/reroute?retry_failed`, while this repo favors
+    self-healing (see reset_failed_counters). A fault window (disk
+    faults, message drops) can burn the whole budget in seconds; once
+    the fault heals there is no cluster EVENT to reset on, so without
+    the cooldown the copy would stay wedged unassigned on a perfectly
+    healthy cluster — a chaos-matrix find."""
     name = "max_retry"
     DEFAULT_MAX = 5
+    RETRY_COOLDOWN_MS = 5_000
 
     def can_allocate(self, shard, node_id, alloc):
         if shard.unassigned_info is None:
@@ -297,11 +305,15 @@ class MaxRetryAllocationDecider(AllocationDecider):
         meta = alloc.state.indices.get(shard.index)
         limit = int((meta.settings if meta else {}).get(
             MAX_RETRIES_SETTING, self.DEFAULT_MAX))
-        if shard.unassigned_info.failed_allocations >= limit:
-            return alloc.explain(
-                self.name, shard, node_id, NO,
-                f"{shard.unassigned_info.failed_allocations} failed "
-                f"allocation attempts >= limit {limit}")
+        info = shard.unassigned_info
+        if info.failed_allocations >= limit:
+            elapsed = int(time.time() * 1000) - info.at_millis
+            if elapsed < self.RETRY_COOLDOWN_MS:
+                return alloc.explain(
+                    self.name, shard, node_id, NO,
+                    f"{info.failed_allocations} failed allocation "
+                    f"attempts >= limit {limit}; retrying in "
+                    f"{self.RETRY_COOLDOWN_MS - elapsed}ms")
         return YES
 
 
@@ -454,8 +466,33 @@ class ConcurrentRebalanceAllocationDecider(AllocationDecider):
         return YES
 
 
+class PrimaryStoreAllocationDecider(AllocationDecider):
+    """A primary whose holder LEFT (NODE_LEFT) may only re-allocate to
+    that same node: the data lives on its disk, and assigning a fresh
+    empty primary elsewhere while the holder is merely partitioned away
+    silently discards every document — the shard must instead stay
+    unassigned (red) until the holder returns or a replica is promoted
+    (PrimaryShardAllocator requires an on-disk copy; discovered by the
+    chaos matrix isolating both copies of a shard)."""
+    name = "primary_store"
+
+    def can_allocate(self, shard, node_id, alloc):
+        info = shard.unassigned_info
+        if not shard.primary or info is None or \
+                info.reason != UnassignedReason.NODE_LEFT or \
+                info.last_node_id is None:
+            return YES
+        if node_id == info.last_node_id:
+            return YES
+        return alloc.explain(
+            self.name, shard, node_id, NO,
+            f"primary data lives on departed node "
+            f"[{info.last_node_id}]; a fresh allocation would be empty")
+
+
 DEFAULT_DECIDERS = (
     MaxRetryAllocationDecider(),
+    PrimaryStoreAllocationDecider(),
     SameShardAllocationDecider(),
     ReplicaAfterPrimaryActiveDecider(),
     EnableAllocationDecider(),
@@ -824,11 +861,24 @@ class AllocationService:
         now = int(time.time() * 1000)
         best = None
         for s in state.routing_table.unassigned():
-            if s.primary or s.unassigned_info is None:
-                continue
-            if s.unassigned_info.reason != UnassignedReason.NODE_LEFT:
+            if s.unassigned_info is None:
                 continue
             meta = state.indices.get(s.index)
+            # max-retry cooldown expiry: the decider will allow a fresh
+            # attempt then, but only a reroute actually retries — and
+            # after an in-place heal there is no cluster event to drive
+            # one, so the caller must schedule it
+            limit = int((meta.settings if meta else {}).get(
+                MAX_RETRIES_SETTING, MaxRetryAllocationDecider.DEFAULT_MAX))
+            if s.unassigned_info.failed_allocations >= limit:
+                remaining = max(
+                    s.unassigned_info.at_millis
+                    + MaxRetryAllocationDecider.RETRY_COOLDOWN_MS - now, 1)
+                if best is None or remaining < best:
+                    best = remaining
+            if s.primary or \
+                    s.unassigned_info.reason != UnassignedReason.NODE_LEFT:
+                continue
             delay = _parse_millis((meta.settings if meta else {}).get(
                 DELAYED_ALLOCATION_SETTING, "0ms"))
             if delay <= 0:
@@ -943,7 +993,8 @@ class AllocationService:
                     routing = self._drop_relocation_target(routing, s)
                 routing = routing.replace_shard(
                     s, s.failed(UnassignedReason.NODE_LEFT,
-                                f"node [{s.node_id}] left"))
+                                f"node [{s.node_id}] left",
+                                last_node_id=s.node_id))
         return routing
 
     def _decide(self, shard: ShardRouting, node_id: str,

@@ -45,6 +45,13 @@ class UnassignedInfo:
     at_millis: int = 0
     details: str = ""
     failed_allocations: int = 0
+    # the node that held this copy when it went unassigned (NODE_LEFT):
+    # the primary-store allocation guard pins re-allocation of a primary
+    # to the holder of its on-disk data — assigning a FRESH primary
+    # elsewhere while the holder is merely partitioned away silently
+    # discards every document (the reference's PrimaryShardAllocator
+    # requires a store copy for exactly this reason)
+    last_node_id: str | None = None
 
 
 @dataclass(frozen=True)
@@ -109,13 +116,14 @@ class ShardRouting:
             self.relocating_node_id is not None
 
     def failed(self, reason: UnassignedReason, details: str = "",
-               failed_allocations: int = 0) -> "ShardRouting":
+               failed_allocations: int = 0,
+               last_node_id: str | None = None) -> "ShardRouting":
         return replace(
             self, node_id=None, state=ShardRoutingState.UNASSIGNED,
             allocation_id=None, relocating_node_id=None,
             unassigned_info=UnassignedInfo(
                 reason, int(time.time() * 1000), details,
-                failed_allocations))
+                failed_allocations, last_node_id))
 
     @property
     def key(self) -> tuple:
@@ -134,7 +142,8 @@ class ShardRouting:
                 "at": self.unassigned_info.at_millis,
                 "details": self.unassigned_info.details,
                 "failed_allocations":
-                    self.unassigned_info.failed_allocations}
+                    self.unassigned_info.failed_allocations,
+                "last_node": self.unassigned_info.last_node_id}
         return d
 
     @staticmethod
@@ -144,7 +153,8 @@ class ShardRouting:
             u = d["unassigned_info"]
             ui = UnassignedInfo(UnassignedReason(u["reason"]), u["at"],
                                 u.get("details", ""),
-                                u.get("failed_allocations", 0))
+                                u.get("failed_allocations", 0),
+                                u.get("last_node"))
         return ShardRouting(
             index=d["index"], shard=d["shard"], node_id=d.get("node"),
             primary=d["primary"], state=ShardRoutingState(d["state"]),
@@ -476,6 +486,9 @@ class ClusterState:
                         for n, m in self.indices.items()},
             "templates": self.templates,
             "persistent_settings": self.persistent_settings,
+            # delete tombstones survive restarts so a full-cluster
+            # bounce can't resurrect a deleted index via dangling import
+            "tombstones": self.customs.get("index_tombstones", []),
         }
         path.mkdir(parents=True, exist_ok=True)
         tmp = path / "global-state.json.tmp"

@@ -165,6 +165,16 @@ class Engine:
         # and the node's breaker service for memory accounting
         self.indexing_slow_log = None
         self.breaker_service = None
+        # Engine self-fail (Engine.failEngine, core/index/engine/
+        # Engine.java maybeFailEngine): an IO error on the WAL or the
+        # committed store closes the engine and reports the shard failed
+        # so the master reallocates the copy — the fault must surface as
+        # a shard failure, never a wedged shard. on_failure(reason) is
+        # wired by IndexService; disk_fault is the store-write injection
+        # hook (hook(op, None), op in {"store.write", "store.commit"}).
+        self.on_failure = None
+        self.failure_reason: str | None = None
+        self.disk_fault = None
         # background merging (ElasticsearchConcurrentMergeScheduler +
         # MergePolicyConfig): refresh() checks the policy and submits a
         # merge to this executor (callable(fn); the node wires its "merge"
@@ -211,6 +221,59 @@ class Engine:
         # IndexService only after the engine exists, and recovery must not
         # block on an inline merge of a large commit
         self._booted = True
+
+    # ------------------------------------------------------- engine self-fail
+
+    def fail_engine(self, reason: str) -> None:
+        """Close the engine and report the failure upward (failEngine):
+        the IndexService callback turns this into a shard-failed report
+        to the master, which reallocates the copy. Idempotent; the
+        report runs OFF the failing op's thread because it walks cluster
+        state and may submit a master update."""
+        with self._lock:
+            if self._closed or self.failure_reason is not None:
+                return
+            self.failure_reason = str(reason)
+        cb = self.on_failure
+        if cb is not None:
+            t = threading.Thread(target=cb, args=(self.failure_reason,),
+                                 name="engine-failure", daemon=True)
+            t.start()
+        try:
+            self.close()
+        except Exception:                        # noqa: BLE001 — dying disk
+            pass
+
+    def _fail_io(self, what: str, e: Exception) -> None:
+        """An IO error on a durability-critical write: self-fail, then
+        surface the retryable EngineClosedError so coordinators re-route
+        to the copy the master promotes."""
+        self.fail_engine(f"{what} failed: {e}")
+        raise EngineClosedError(
+            f"engine failed [{what} failed: {e}]") from e
+
+    def _translog_add(self, op: TranslogOp, sync: bool) -> None:
+        try:
+            self.translog.add(op, sync=sync)
+        except OSError as e:
+            self._fail_io("translog append", e)
+
+    def translog_sync(self) -> None:
+        """Fsync the WAL per the durability policy; an IO error fails the
+        engine (bulk callers ack only after this returns). On an engine
+        that already failed mid-bulk this raises the retryable
+        EngineClosedError so the coordinator re-routes the whole bulk to
+        the promoted primary instead of surfacing a closed-file error."""
+        self._ensure_open()
+        try:
+            self.translog.sync()
+        except OSError as e:
+            self._fail_io("translog sync", e)
+
+    def _io_fault(self, op: str) -> None:
+        fault = self.disk_fault
+        if fault is not None:
+            fault(op, None)                      # may raise OSError
 
     # ------------------------------------------------------------------ CRUD
 
@@ -275,9 +338,9 @@ class Engine:
             self._buffer_docs[doc_id] = local
             self._versions[doc_id] = VersionEntry(new_version, False, -1, local)
             if not from_translog:
-                self.translog.add(TranslogOp(OP_INDEX, doc_id, new_version,
-                                             source=source, routing=routing,
-                                             meta=meta), sync=sync)
+                self._translog_add(TranslogOp(OP_INDEX, doc_id, new_version,
+                                              source=source, routing=routing,
+                                              meta=meta), sync)
             self.stats.index_total += 1
             took = time.perf_counter() - t0
             self.stats.index_time_ms += took * 1e3
@@ -317,9 +380,9 @@ class Engine:
             local = self._buffer.add(parsed)
             self._buffer_docs[doc_id] = local
             self._versions[doc_id] = VersionEntry(version, False, -1, local)
-            self.translog.add(TranslogOp(OP_INDEX, doc_id, version,
-                                         source=source, routing=routing,
-                                         meta=meta), sync=sync)
+            self._translog_add(TranslogOp(OP_INDEX, doc_id, version,
+                                          source=source, routing=routing,
+                                          meta=meta), sync)
             self.stats.index_total += 1
             return version
 
@@ -340,8 +403,7 @@ class Engine:
                 self._pending_seg_deletes[(entry.seg_id, entry.local_doc)] \
                     = doc_id
             self._versions[doc_id] = VersionEntry(version, True, -2, -1)
-            self.translog.add(TranslogOp(OP_DELETE, doc_id, version),
-                              sync=sync)
+            self._translog_add(TranslogOp(OP_DELETE, doc_id, version), sync)
             self.stats.delete_total += 1
             return version
 
@@ -382,8 +444,8 @@ class Engine:
                 self._pending_seg_deletes[(entry.seg_id, entry.local_doc)] = doc_id
             self._versions[doc_id] = VersionEntry(new_version, True, -2, -1)
             if not from_translog:
-                self.translog.add(TranslogOp(OP_DELETE, doc_id, new_version),
-                                  sync=sync)
+                self._translog_add(TranslogOp(OP_DELETE, doc_id,
+                                              new_version), sync)
             self.stats.delete_total += 1
             return new_version
 
@@ -550,24 +612,34 @@ class Engine:
                 return                           # commit pinned — no flush
             self.refresh()
             store_type = str(self.settings.get("index.store.type", "fs"))
-            for seg, mask in zip(self._segments, self._live_masks):
-                seg_dir = self.path / f"seg_{seg.seg_id}"
-                if not (seg_dir / "meta.json").exists():
-                    seg.write(seg_dir, store_type=store_type)
-                np.save(seg_dir / "live.tmp.npy", mask)
-                os.replace(seg_dir / "live.tmp.npy", seg_dir / "live.npy")
-            self._commit_gen += 1
-            commit = {
-                "generation": self._commit_gen,
-                "segments": [s.seg_id for s in self._segments],
-                "next_seg_id": self._next_seg_id,
-                "versions": {did: [e.version, e.deleted, e.seg_id, e.local_doc]
-                             for did, e in self._versions.items()},
-            }
-            tmp = self.path / "commit.json.tmp"
-            tmp.write_text(json.dumps(commit))
-            os.replace(tmp, self.path / "commit.json")
-            self.translog.roll(committed=True)
+            try:
+                for seg, mask in zip(self._segments, self._live_masks):
+                    self._io_fault("store.write")
+                    seg_dir = self.path / f"seg_{seg.seg_id}"
+                    if not (seg_dir / "meta.json").exists():
+                        seg.write(seg_dir, store_type=store_type)
+                    np.save(seg_dir / "live.tmp.npy", mask)
+                    os.replace(seg_dir / "live.tmp.npy",
+                               seg_dir / "live.npy")
+                self._commit_gen += 1
+                commit = {
+                    "generation": self._commit_gen,
+                    "segments": [s.seg_id for s in self._segments],
+                    "next_seg_id": self._next_seg_id,
+                    "versions": {did: [e.version, e.deleted, e.seg_id,
+                                       e.local_doc]
+                                 for did, e in self._versions.items()},
+                }
+                self._io_fault("store.commit")
+                tmp = self.path / "commit.json.tmp"
+                tmp.write_text(json.dumps(commit))
+                os.replace(tmp, self.path / "commit.json")
+                self.translog.roll(committed=True)
+            except OSError as e:
+                # a failed commit leaves the previous commit.json intact
+                # (tmp + atomic replace), but the engine's durability
+                # contract is broken — self-fail and reallocate
+                self._fail_io("store commit", e)
             self.stats.flush_total += 1
 
     # ------------------------------------------------- background merging
@@ -1005,6 +1077,9 @@ class _NullTranslog:
         return []
 
     def roll(self, *a, **kw):
+        return None
+
+    def sync(self):
         return None
 
     def stats(self):

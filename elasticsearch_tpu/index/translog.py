@@ -74,6 +74,13 @@ class Translog:
         self.path = Path(path)
         self.path.mkdir(parents=True, exist_ok=True)
         self.durability = durability
+        # Disk-fault injection seam (the MockDirectoryWrapper analog for
+        # the WAL): hook(op, data) called before every append ("add",
+        # frame bytes) and fsync ("sync", None). It may raise OSError to
+        # inject an IO error, or — for "add" — return a truncated frame
+        # to simulate a short (torn) write: the truncated bytes land in
+        # the file and the append still fails. None in production.
+        self.fault_hook = None
         gen, committed_gen, seq_no = self._read_checkpoint()
         self.generation = gen
         self.committed_generation = committed_gen
@@ -155,6 +162,17 @@ class Translog:
         op.seq_no = self.next_seq_no
         payload = op.encode()
         frame = _HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+        fault = self.fault_hook
+        if fault is not None:
+            torn = fault("add", frame)           # may raise OSError
+            if torn is not None:
+                # short write: the torn prefix reaches the file, then the
+                # append fails — replay must stop at the frame boundary
+                self._file.write(torn)
+                self._file.flush()
+                raise OSError(
+                    f"simulated short write ({len(torn)}/{len(frame)} "
+                    f"bytes)")
         self._file.write(frame)
         self.next_seq_no += 1
         self._ops_in_gen += 1
@@ -175,6 +193,13 @@ class Translog:
         return {"operations": ops, "size_in_bytes": size}
 
     def sync(self) -> None:
+        if self._file.closed:
+            # closed by a concurrent engine self-fail: surface the IO
+            # class the callers handle, not ValueError from flush()
+            raise OSError("translog closed")
+        fault = self.fault_hook
+        if fault is not None:
+            fault("sync", None)                  # may raise OSError
         self._file.flush()
         os.fsync(self._file.fileno())
         self._write_checkpoint()
@@ -269,5 +294,10 @@ class Translog:
 
     def close(self) -> None:
         if not self._file.closed:
-            self.sync()
+            try:
+                self.sync()
+            except OSError:
+                # a failing disk must not wedge close — the engine is
+                # self-failing; acked ops were already synced per policy
+                pass
             self._file.close()

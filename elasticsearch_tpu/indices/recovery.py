@@ -70,6 +70,12 @@ class PeerRecoveryService:
         # other node are stale (a source we abandoned after it left the
         # state) and must not interleave with the live stream
         self._active_sources: dict[tuple[str, int], str] = {}
+        # (index, shard) → monotonic time of the last inbound recovery
+        # RPC: the liveness signal that lets the target distinguish "a
+        # big phase1 is streaming" from "the start request (or the whole
+        # stream) was swallowed by a partition" — the latter must retry
+        # in seconds, not wait out the full recovery deadline
+        self._last_activity: dict[tuple[str, int], float] = {}
 
     # ---- target side -------------------------------------------------------
 
@@ -107,6 +113,7 @@ class PeerRecoveryService:
         engine.pin_commit(flush_first=False)     # block local flush/merge
         skey = (shard_routing.index, shard_routing.shard)
         self._active_sources[skey] = source_node.node_id
+        self._last_activity[skey] = time.monotonic()
         try:                                     # while files stream in
             # timeout rides the POLL below (which can also cancel on
             # source-left); a transport-level timer would complete the
@@ -137,6 +144,15 @@ class PeerRecoveryService:
                     if time.monotonic() > deadline:
                         raise DelayRecoveryError(
                             "recovery start timed out") from None
+                    # liveness: no inbound recovery RPC for this long
+                    # means the start request or the stream itself was
+                    # lost (dropped frames) — retry instead of waiting
+                    # out the whole deadline with a wedged shard
+                    if time.monotonic() - \
+                            self._last_activity.get(skey, 0.0) > 15.0:
+                        raise DelayRecoveryError(
+                            "recovery stalled: no traffic from source "
+                            "for 15s") from None
                     now = self.node.cluster_service.state()
                     cur = now.routing_table.primary(
                         shard_routing.index, shard_routing.shard)
@@ -152,6 +168,7 @@ class PeerRecoveryService:
             raise
         finally:
             self._active_sources.pop(skey, None)
+            self._last_activity.pop(skey, None)
             engine.unpin_commit()
 
     # ---- source side -------------------------------------------------------
@@ -222,17 +239,21 @@ class PeerRecoveryService:
             offsets = range(0, total, CHUNK_SIZE) if total else [0]
             for off in offsets:
                 chunk = data[off:off + CHUNK_SIZE]
+                # 15 s per chunk: plenty for a 512 KiB in-process hop,
+                # and under injected drops the failure surfaces as a
+                # clean retryable recovery failure in seconds instead
+                # of a minute-long wedge per lost frame
                 self.node.transport_service.submit_request(
                     target, FILE_CHUNK,
                     {"index": index, "shard": shard, "path": rel,
                      "offset": off, "data": chunk, "total": total},
-                    timeout=60.0)
+                    timeout=15.0)
                 bytes_sent += len(chunk)
         # install: drop stale files, open the commit
         self.node.transport_service.submit_request(
             target, CLEAN_FILES,
             {"index": index, "shard": shard,
-             "keep": sorted(source_manifest)}, timeout=60.0)
+             "keep": sorted(source_manifest)}, timeout=15.0)
         return len(to_send), bytes_sent, skipped
 
     def _phase2(self, engine, target, index: str, shard: int,
@@ -244,7 +265,7 @@ class PeerRecoveryService:
             self.node.transport_service.submit_request(
                 target, TRANSLOG_OPS,
                 {"index": index, "shard": shard, "ops": chunk},
-                timeout=60.0)
+                timeout=15.0)
 
     # ---- target-side handlers (driven by the source) -----------------------
 
@@ -263,13 +284,14 @@ class PeerRecoveryService:
         retry, the abandoned source may still be streaming, and two
         sources interleaving writes into the same files corrupts the
         shard (RecoveriesCollection's per-recovery session discipline)."""
-        want = self._active_sources.get((request["index"],
-                                         request["shard"]))
+        skey = (request["index"], request["shard"])
+        want = self._active_sources.get(skey)
         if want is None or source.node_id != want:
             raise RecoveryFailedError(
                 f"[{request['index']}][{request['shard']}] recovery "
                 f"traffic from stale source [{source.node_id}]"
                 f" (current: [{want}])")
+        self._last_activity[skey] = time.monotonic()
 
     def _handle_file_chunk(self, request: dict, source) -> dict:
         self._check_source(request, source)

@@ -15,6 +15,7 @@ with an AllocationService.reroute so new shards get assigned.
 
 from __future__ import annotations
 
+import json
 import shutil
 import threading
 import time
@@ -77,8 +78,16 @@ class IndexService:
 
     def __init__(self, meta: IndexMetadata, path: Path,
                  local_shards: list[int] | None = None,
-                 breaker_service=None, merge_submit=None):
+                 breaker_service=None, merge_submit=None,
+                 on_engine_failure=None, disk_fault_lookup=None):
         self.merge_submit = merge_submit
+        # engine self-fail report: on_engine_failure(index, shard, reason)
+        # — IndicesService turns it into a shard-failed to the master
+        self.on_engine_failure = on_engine_failure
+        # node-level disk-fault injection (testing_disruption.
+        # DiskFaultScheme): newly created engines pick up the hook so a
+        # "bad disk" survives engine recreation until the scheme heals it
+        self.disk_fault_lookup = disk_fault_lookup
         self.name = meta.name
         self.meta = meta
         self.path = path
@@ -125,6 +134,15 @@ class IndexService:
             engine.indexing_slow_log = self.indexing_slow_log
             engine.breaker_service = self.breaker_service
             engine.merge_executor = self.merge_submit
+            if self.on_engine_failure is not None:
+                engine.on_failure = (
+                    lambda reason, _n=self.name, _s=sid:
+                    self.on_engine_failure(_n, _s, reason))
+            fault = (self.disk_fault_lookup()
+                     if self.disk_fault_lookup is not None else None)
+            if fault is not None:
+                engine.disk_fault = fault
+                engine.translog.fault_hook = fault
             self.engines[sid] = engine
         return self.engines[sid]
 
@@ -372,6 +390,9 @@ class IndicesService:
         # hierarchical memory accounting (HierarchyCircuitBreakerService);
         # wired by the Node before any index exists
         self.breaker_service = None
+        # node-level disk-fault injection hook (testing_disruption.
+        # DiskFaultScheme); newly created engines inherit it
+        self.disk_fault = None
         # background merges: the Node wires this to its "merge" thread
         # pool; None runs merges inline at refresh (deterministic tests)
         self.merge_submit = None
@@ -398,6 +419,14 @@ class IndicesService:
         # application).
         self.prepare_shard = None
         self._recovering: set[str] = set()
+        # dangling-indices import (core/gateway/DanglingIndicesState.java):
+        # on-disk index dirs unknown to the applied cluster state are
+        # offered to the master (Node wires dangling_import), unless a
+        # delete tombstone says the index was removed — then the local
+        # copy is destroyed so deleted indices stay dead
+        self.dangling_import = None
+        self._dangling_offered: set[str] = set()
+        self._meta_written: dict[str, tuple] = {}
         # completed per-shard recovery records (ref: the indices recovery
         # API, core/action/admin/indices/recovery/ + RestRecoveryAction)
         self.recovery_records: list[dict] = []
@@ -429,7 +458,9 @@ class IndicesService:
                     meta, self.data_path / "indices" / name,
                     local_shards=[s.shard for s in local],
                     breaker_service=self.breaker_service,
-                    merge_submit=self.merge_submit)
+                    merge_submit=self.merge_submit,
+                    on_engine_failure=self._engine_failed,
+                    disk_fault_lookup=lambda: self.disk_fault)
             svc = self.indices[name]
             if meta.mappings != svc.meta.mappings:
                 for t, m in (meta.mappings or {}).items():
@@ -437,6 +468,7 @@ class IndicesService:
             if meta.settings != svc.meta.settings:
                 svc.apply_settings(meta)
             svc.meta = meta
+            self._write_index_meta(name, meta)
             # create newly assigned shards / drop moved-away ones
             want = {s.shard for s in local}
             for sid in want - set(svc.engines):
@@ -484,6 +516,8 @@ class IndicesService:
                 shutil.rmtree(self.data_path / "indices" / name,
                               ignore_errors=True)
                 del self.indices[name]
+                self._meta_written.pop(name, None)
+                self._dangling_offered.discard(name)
         gone = [r["index"] for r in self.recovery_records
                 if r["index"] not in new.indices]
         if gone:
@@ -491,8 +525,102 @@ class IndicesService:
             # deleted indices so a recreated index starts clean
             self.recovery_records = [r for r in self.recovery_records
                                      if r["index"] in new.indices]
+        self._scan_dangling(new)
+
+    # ---- dangling indices (DanglingIndicesState analog) --------------------
+
+    def _write_index_meta(self, name: str, meta) -> None:
+        """Stamp the index's metadata into its data directory so a copy
+        orphaned by cluster-metadata loss can be re-imported (the
+        reference persists IndexMetaData in the index folder)."""
+        key = (meta.uuid, meta.version)
+        if self._meta_written.get(name) == key:
+            return
+        d = self.data_path / "indices" / name
+        try:
+            d.mkdir(parents=True, exist_ok=True)
+            tmp = d / "_meta.json.tmp"
+            tmp.write_text(json.dumps(meta.to_state_dict()))
+            tmp.replace(d / "_meta.json")
+            self._meta_written[name] = key
+        except OSError:
+            pass                                 # retried on a later state
+
+    def _scan_dangling(self, new: ClusterState) -> None:
+        """Compare on-disk index dirs against the applied state: offer
+        unknown ones to the master for metadata re-import + allocation;
+        destroy tombstoned ones (a delete that happened while this node
+        was offline must win — removed indices stay dead)."""
+        if new.master_node_id is None:
+            return                               # no one to offer to
+        root = self.data_path / "indices"
+        if not root.is_dir():
+            return
+        tomb_names: set[str] = set()
+        tomb_uuids: set[str] = set()
+        for t in new.customs.get("index_tombstones", []):
+            tomb_names.add(t.get("index"))
+            if t.get("uuid"):
+                tomb_uuids.add(t["uuid"])
+        for d in sorted(root.iterdir()):
+            name = d.name
+            if not d.is_dir() or name in new.indices \
+                    or name in self.indices:
+                continue
+            raw = None
+            try:
+                raw = json.loads((d / "_meta.json").read_text())
+            except (OSError, json.JSONDecodeError):
+                raw = None
+            disk_uuid = (raw or {}).get("uuid", "")
+            if name in tomb_names or (disk_uuid and
+                                      disk_uuid in tomb_uuids):
+                shutil.rmtree(d, ignore_errors=True)
+                self._dangling_offered.discard(name)
+                self._meta_written.pop(name, None)
+                continue
+            if raw is None or self.dangling_import is None \
+                    or name in self._dangling_offered:
+                continue
+            self._dangling_offered.add(name)
+            # the offer RPC can block on master forwarding — never on
+            # the state-applier thread
+            t = threading.Thread(target=self._offer_dangling,
+                                 args=(name, raw),
+                                 name=f"dangling[{name}]", daemon=True)
+            t.start()
+
+    def _offer_dangling(self, name: str, meta_dict: dict) -> None:
+        try:
+            self.dangling_import(name, meta_dict)
+        except Exception:                        # noqa: BLE001 — retry later
+            self._dangling_offered.discard(name)
 
     on_shard_failed = None
+
+    def _engine_failed(self, index: str, sid: int, reason: str) -> None:
+        """An engine self-failed (translog/store IO error): drop the dead
+        engine locally and report the copy failed so the master
+        reallocates it (IndexShard.failShard → ShardStateAction). Runs on
+        the engine's failure thread, never the failing op's."""
+        routing = next(
+            (s for s in self.cluster_service.state().routing_table
+             .on_node(self.node_id)
+             if s.index == index and s.shard == sid), None)
+        svc = self.indices.get(index)
+        if svc is not None:
+            try:
+                svc.remove_local_shard(sid)
+            except Exception:                    # noqa: BLE001 — dying disk
+                pass
+        if routing is not None:
+            # a re-allocation of this copy gets a fresh allocation id; the
+            # old report bookkeeping must not leak onto it
+            self._reported_started.discard(routing.allocation_id)
+            self._report_outcome.pop(routing.allocation_id, None)
+            self._recovering.discard(routing.allocation_id)
+            if self.on_shard_failed is not None:
+                self.on_shard_failed(routing, f"engine failure: {reason}")
 
     def _do_recovery(self, s: ShardRouting, engine) -> None:
         """Recovery-executor body: run the peer-recovery hook, then report
@@ -668,15 +796,26 @@ class IndicesService:
         self._master_op("delete-index", {"name": name},
                         lambda: self._delete_index_local(name))
 
+    #: delete tombstones kept in cluster state (IndexGraveyard analog):
+    #: a node offline during the delete must find the tombstone on
+    #: rejoin and destroy its on-disk copy instead of offering it back
+    #: as a dangling index
+    TOMBSTONE_CAP = 100
+
     def _delete_index_local(self, name: str) -> None:
         def update(state: ClusterState) -> ClusterState:
             names = self._resolve(state, name)
             indices = dict(state.indices)
             routing = state.routing_table
+            tombs = list(state.customs.get("index_tombstones", []))
             for n in names:
+                tombs.append({"index": n, "uuid": indices[n].uuid})
                 del indices[n]
                 routing = routing.remove_index(n)
-            return state.with_(indices=indices, routing_table=routing)
+            tombs = tombs[-self.TOMBSTONE_CAP:]
+            return state.with_(indices=indices, routing_table=routing,
+                               customs={**state.customs,
+                                        "index_tombstones": tombs})
         self.cluster_service.submit_and_wait(f"delete-index [{name}]", update)
 
     def put_mapping(self, name: str, type_name: str, mapping: dict) -> None:

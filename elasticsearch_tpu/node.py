@@ -126,6 +126,9 @@ class Node:
             self.SHARD_FAILED_ACTION, self._handle_shard_failed, sync=True)
         # master-forwarding seam (TransportMasterNodeAction analog)
         self.indices_service.master_executor = self._execute_master_action
+        # dangling-indices offer path (DanglingIndicesState → master
+        # metadata re-import + allocation)
+        self.indices_service.dangling_import = self._import_dangling
         self.transport_service.register_request_handler(
             self.MASTER_FORWARD_ACTION, self._handle_master_forward,
             executor="management", sync=True)
@@ -287,12 +290,22 @@ class Node:
             meta = IndexMetadata.from_state_dict(name, m)
             indices[name] = meta
             routing = routing.add_index(meta)
+        from elasticsearch_tpu.indices.service import IndicesService
+        tombs = list(raw.get("tombstones", []))
+        for t in state.customs.get("index_tombstones", []):
+            if t not in tombs:
+                tombs.append(t)
+        customs = dict(state.customs)
+        if tombs:
+            customs["index_tombstones"] = \
+                tombs[-IndicesService.TOMBSTONE_CAP:]
         return state.with_(
             version=max(state.version, raw.get("version", 0)),
             indices=indices, routing_table=routing,
             templates={**raw.get("templates", {}), **state.templates},
             persistent_settings={**raw.get("persistent_settings", {}),
-                                 **state.persistent_settings})
+                                 **state.persistent_settings},
+            customs=customs)
 
     # ---- master forwarding (TransportMasterNodeAction.java:50) -------------
 
@@ -484,6 +497,8 @@ class Node:
                 req["lang"], req["id"], req["source"]),
             "delete-script": lambda: self._delete_script_on_master(
                 req["lang"], req["id"]),
+            "import-dangling": lambda: self._import_dangling_on_master(
+                req["name"], req["meta"]),
         }
         fn = dispatch.get(action)
         if fn is None:
@@ -492,6 +507,36 @@ class Node:
         if isinstance(out, dict):        # e.g. put-script's created flag
             return {"acknowledged": True, **out}
         return {"acknowledged": True}
+
+    # ---- dangling-indices import (core/gateway/DanglingIndicesState.java) --
+
+    def _import_dangling(self, name: str, meta_dict: dict) -> None:
+        """Offer an orphaned on-disk index to the elected master (local
+        when we are it); the master re-imports the metadata and allocates
+        — unless a tombstone or a racing re-create made the offer stale."""
+        self.indices_service._master_op(
+            "import-dangling", {"name": name, "meta": meta_dict},
+            lambda: self._import_dangling_on_master(name, meta_dict))
+
+    def _import_dangling_on_master(self, name: str,
+                                   meta_dict: dict) -> None:
+        def update(state: ClusterState) -> ClusterState:
+            if name in state.indices:
+                return state                     # re-created meanwhile
+            tombs = state.customs.get("index_tombstones", [])
+            uuid_ = meta_dict.get("uuid", "")
+            for t in tombs:
+                if t.get("index") == name or \
+                        (uuid_ and t.get("uuid") == uuid_):
+                    return state                 # deleted: stays dead
+            meta = IndexMetadata.from_state_dict(name, meta_dict)
+            return self.allocation.reroute(
+                state.with_(
+                    indices={**state.indices, name: meta},
+                    routing_table=state.routing_table.add_index(meta)),
+                f"dangling index imported [{name}]")
+        self.cluster_service.submit_and_wait(
+            f"import-dangling [{name}]", update)
 
     # ---- cluster-level metadata (master ops) -------------------------------
 
@@ -580,10 +625,38 @@ class Node:
                 priority=URGENT)
             return
         master = state.master_node
-        if master is not None:
-            self.transport_service.send_request(
-                master, self.SHARD_FAILED_ACTION,
-                {"shard": shard.to_dict(), "details": details}, timeout=10.0)
+        if master is None:
+            self._retry_shard_failed(shard, details)
+            return
+        fut = self.transport_service.send_request(
+            master, self.SHARD_FAILED_ACTION,
+            {"shard": shard.to_dict(), "details": details}, timeout=10.0)
+        fut.add_done_callback(
+            lambda f: self._retry_shard_failed(shard, details)
+            if f.exception() is not None else None)
+
+    def _retry_shard_failed(self, shard, details: str) -> None:
+        """A failed-shard report lost to a dying/absent master MUST be
+        re-sent: until some master applies it, the cluster state keeps
+        advertising a copy that missed writes as active — reads served
+        from it silently lose acked documents (a chaos-matrix find:
+        replica fan-out failure racing a master kill)."""
+        import threading
+        t = threading.Timer(1.0, self._resend_shard_failed,
+                            (shard, details))
+        t.daemon = True
+        t.start()
+
+    def _resend_shard_failed(self, shard, details: str) -> None:
+        if not self._started:
+            return
+        st = self.cluster_service.state()
+        cur = [s for s in st.routing_table.shard_copies(shard.index,
+                                                        shard.shard)
+               if s.allocation_id == shard.allocation_id]
+        if not cur or not cur[0].assigned:
+            return                               # already applied
+        self._on_shard_failed(shard, details)
 
     def _handle_shard_started(self, request: dict, source) -> dict:
         from elasticsearch_tpu.cluster.state import ShardRouting

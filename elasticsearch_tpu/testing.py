@@ -84,9 +84,11 @@ class InternalTestCluster:
             t.join(timeout=60.0)
         self.nodes.extend(pending)
 
-    def _make_node(self, **extra_settings) -> Node:
+    def _make_node(self, name: str | None = None, **extra_settings) -> Node:
         self._counter += 1
-        name = f"node-{self._counter}"
+        # an explicit name re-uses that node's data path — the
+        # killed-node-rejoins construction (dangling-indices tests)
+        name = name or f"node-{self._counter}"
         settings = {**self.settings, **extra_settings,
                     "cluster.name": self.cluster_name, "node.name": name}
         if self.transport == "tcp":
@@ -105,8 +107,8 @@ class InternalTestCluster:
 
     # ---- membership --------------------------------------------------------
 
-    def add_node(self, **extra_settings) -> Node:
-        node = self._make_node(**extra_settings)
+    def add_node(self, name: str | None = None, **extra_settings) -> Node:
+        node = self._make_node(name=name, **extra_settings)
         node.start()
         self.nodes.append(node)
         return node

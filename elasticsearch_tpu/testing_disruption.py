@@ -3,10 +3,21 @@
 Reference: test/test/disruption/ — ServiceDisruptionScheme implementations
 (NetworkPartition, NetworkDisconnectPartition, NetworkUnresponsivePartition,
 NetworkDelaysPartition, BlockClusterStateProcessing,
-SlowClusterStateProcessing) applied to the InternalTestCluster. Here the
-schemes install outbound rules on each node's LocalTransport (the same
-seam MockTransportService uses in the reference), so any multi-node test
-can compose partitions/delays declaratively:
+SlowClusterStateProcessing) applied to the InternalTestCluster, plus the
+MockTransportService message-granular capabilities and the
+MockDirectoryWrapper disk-fault wrapper. Two injection seams:
+
+* address-level schemes install outbound rules on each node's transport
+  (LocalTransport/TcpTransport ``outbound_rule`` — drop/delay only);
+* message-granular schemes install on the node's **TransportService**
+  (``transport/service.py`` seam), which runs above the byte mover and
+  therefore applies uniformly to both transports and supports the full
+  verdict vocabulary: drop, delay, duplicate, reorder — per RPC action
+  class, drawn from a seeded rng so any failure replays from its seed.
+
+Disk faults (:class:`DiskFaultScheme`) inject IO errors and short writes
+into translog appends/fsyncs and store/commit writes; the engine reacts
+by self-failing → shard-failed → reallocation (never a wedged shard).
 
     with NetworkPartition([n1], [n2, n3]).applied():
         ...cluster behavior under partition...
@@ -18,7 +29,7 @@ import contextlib
 import random
 import time
 
-from elasticsearch_tpu.transport.local import DROP
+from elasticsearch_tpu.transport.service import DROP, DUPLICATE, REORDER
 
 
 def _addr_of(node):
@@ -28,6 +39,10 @@ def _addr_of(node):
 class ServiceDisruptionScheme:
     """Base: install/remove outbound rules on the affected nodes."""
 
+    #: where the rule lives: "transport" (address-level seam on the byte
+    #: mover) or "service" (message-granular seam on TransportService)
+    RULE_HOST = "transport"
+
     def __init__(self):
         self._saved: list[tuple] = []
 
@@ -35,21 +50,27 @@ class ServiceDisruptionScheme:
         raise NotImplementedError
 
     def _rule_for(self, node):
-        """→ callable(addr, action) -> DROP | delay-seconds | None, or
-        None when this node needs no rule."""
+        """→ callable(addr, action) -> DROP | delay-seconds |
+        ("duplicate", n) | ("reorder", jitter) | None, or None when this
+        node needs no rule (tuple verdicts only on the service seam)."""
         raise NotImplementedError
+
+    def _host(self, node):
+        ts = node.transport_service
+        return ts if self.RULE_HOST == "service" else ts.transport
 
     def apply(self) -> None:
         for node in self._nodes():
-            transport = node.transport_service.transport
-            self._saved.append((transport, transport.outbound_rule))
-            prev = transport.outbound_rule
+            host = self._host(node)
+            self._saved.append((host, host.outbound_rule))
+            prev = host.outbound_rule
             mine = self._rule_for(node)
 
             def combined(addr, action, _prev=prev, _mine=mine):
-                # DROP from ANY stacked scheme wins; otherwise the
-                # longest delay applies (a partition stacked over a delay
-                # must still cut traffic)
+                # DROP from ANY stacked scheme wins; a duplicate/reorder
+                # verdict wins next (they are rare per-message draws);
+                # otherwise the longest delay applies (a partition
+                # stacked over a delay must still cut traffic)
                 verdicts = []
                 for rule in (_prev, _mine):
                     if rule is None:
@@ -59,16 +80,19 @@ class ServiceDisruptionScheme:
                         return DROP
                     if v is not None:
                         verdicts.append(v)
+                for v in verdicts:
+                    if isinstance(v, tuple):
+                        return v
                 delays = [v for v in verdicts
                           if isinstance(v, (int, float))]
                 return max(delays) if delays else None
-            transport.outbound_rule = combined
+            host.outbound_rule = combined
 
     def remove(self) -> None:
         # LIFO: overlapping schemes must unwind in reverse application
         # order or a stale snapshot clobbers a newer one
-        for transport, prev in reversed(self._saved):
-            transport.outbound_rule = prev
+        for host, prev in reversed(self._saved):
+            host.outbound_rule = prev
         self._saved.clear()
 
     # the reference's ServiceDisruptionScheme verb pair
@@ -200,3 +224,230 @@ def wait_until(predicate, timeout: float = 10.0,
             return True
         time.sleep(interval)
     return predicate()
+
+
+# ---- message-granular fault schemes (service-seam, v2) ----------------------
+
+#: cluster-critical traffic the flaky schemes leave alone by default —
+#: randomly dropping fd pings / elections makes every scenario devolve
+#: into "node removed", which the partition schemes already cover; the
+#: flaky schemes exist to stress the RETRY paths of data/recovery RPCs
+DATA_ACTION_PREFIXES = (
+    "indices:data/",
+    "internal:index/shard/recovery",
+    "internal:snapshot/",
+    "indices:admin/broadcast",
+)
+
+
+class FaultyTransport(ServiceDisruptionScheme):
+    """Per-message seeded faults on the TransportService seam: each
+    outbound message matching ``action_prefixes`` draws one verdict —
+    drop / delay / duplicate / reorder — with the given probabilities
+    (the remainder passes clean). Runs identically over LocalTransport
+    and TcpTransport because the seam sits above the byte mover."""
+
+    RULE_HOST = "service"
+
+    def __init__(self, nodes: list, seed: int = 0,
+                 drop: float = 0.0, delay: float = 0.0,
+                 duplicate: float = 0.0, reorder: float = 0.0,
+                 delay_range: tuple = (0.01, 0.15),
+                 reorder_window: float = 0.05,
+                 action_prefixes: tuple = DATA_ACTION_PREFIXES):
+        super().__init__()
+        self.nodes = list(nodes)
+        self.seed = seed
+        self.p_drop = drop
+        self.p_delay = delay
+        self.p_duplicate = duplicate
+        self.p_reorder = reorder
+        self.delay_range = delay_range
+        self.reorder_window = reorder_window
+        self.prefixes = tuple(action_prefixes or ())
+
+    def _nodes(self) -> list:
+        return self.nodes
+
+    def _rule_for(self, node):
+        # per-node rng derived from (seed, node name): deterministic
+        # given the scheme seed, uncorrelated across nodes
+        import zlib
+        name = node.transport_service.local_node.name
+        rng = random.Random(self.seed ^ zlib.crc32(name.encode()))
+
+        def rule(addr, action):
+            if self.prefixes and \
+                    not any(action.startswith(p) for p in self.prefixes):
+                return None
+            r = rng.random()
+            if r < self.p_drop:
+                return DROP
+            r -= self.p_drop
+            if r < self.p_delay:
+                return rng.uniform(*self.delay_range)
+            r -= self.p_delay
+            if r < self.p_duplicate:
+                return (DUPLICATE, 1)
+            r -= self.p_duplicate
+            if r < self.p_reorder:
+                return (REORDER, rng.uniform(0.0, self.reorder_window))
+            return None
+        return rule
+
+
+class ActionDelay(ServiceDisruptionScheme):
+    """Delay every message of the given action classes from the given
+    nodes (service seam, so it works over TCP too) — the surgical tool
+    for forcing a recovery/replication RPC to lose a race."""
+
+    RULE_HOST = "service"
+
+    def __init__(self, nodes: list, delay_s: float,
+                 action_prefixes: tuple):
+        super().__init__()
+        self.nodes = list(nodes)
+        self.delay_s = delay_s
+        self.prefixes = tuple(action_prefixes)
+
+    def _nodes(self) -> list:
+        return self.nodes
+
+    def _rule_for(self, node):
+        def rule(addr, action):
+            if any(action.startswith(p) for p in self.prefixes):
+                return self.delay_s
+            return None
+        return rule
+
+
+# ---- disk-fault scheme ------------------------------------------------------
+
+class DiskFaultScheme:
+    """Inject disk faults into a node's engines: translog appends/fsyncs
+    raise OSError (or tear the frame mid-write with ``short_writes``) and
+    store/commit writes raise OSError. Installed node-wide
+    (IndicesService.disk_fault) so engines created while the fault is
+    active inherit it — a bad disk doesn't heal because a shard was
+    reallocated back. The engine must respond by self-failing the shard
+    (Engine.fail_engine → shard-failed → master reallocates), never by
+    wedging.
+
+    ``ops`` filters which operations fault: any of "add", "sync"
+    (translog) / "store.write", "store.commit" (engine flush)."""
+
+    def __init__(self, node, ops: tuple = ("add", "sync", "store.write",
+                                           "store.commit"),
+                 index: str | None = None,
+                 short_writes: bool = False, seed: int = 0):
+        self.node = node
+        self.ops = set(ops)
+        self.index = index
+        self.short_writes = short_writes
+        self._rng = random.Random(seed)
+
+    def _hook(self, op: str, data):
+        if op not in self.ops:
+            return None
+        if op == "add" and self.short_writes and data:
+            # torn frame: a strict prefix lands, then the append fails
+            return data[:self._rng.randrange(1, len(data))]
+        raise OSError(f"simulated disk fault [{op}]")
+
+    def _engines(self):
+        isvc = self.node.indices_service
+        for name, svc in list(isvc.indices.items()):
+            if self.index is not None and name != self.index:
+                continue
+            yield from list(svc.engines.values())
+
+    def start_disrupting(self) -> None:
+        if self.index is None:
+            self.node.indices_service.disk_fault = self._hook
+        for e in self._engines():
+            e.disk_fault = self._hook
+            e.translog.fault_hook = self._hook
+
+    def stop_disrupting(self) -> None:
+        if self.node.indices_service.disk_fault is self._hook:
+            self.node.indices_service.disk_fault = None
+        for e in self._engines():
+            if e.disk_fault is self._hook:
+                e.disk_fault = None
+            if getattr(e.translog, "fault_hook", None) is self._hook:
+                e.translog.fault_hook = None
+
+    @contextlib.contextmanager
+    def applied(self):
+        self.start_disrupting()
+        try:
+            yield self
+        finally:
+            self.stop_disrupting()
+
+
+# ---- seeded scheme registry (the matrix draws from this) --------------------
+
+#: names the randomized matrix can draw; each factory takes
+#: (cluster_nodes, rnd) and returns a started-able scheme or None
+SCHEME_NAMES = (
+    "none",
+    "partition_minority",
+    "isolate_one",
+    "delays",
+    "flaky_drop",
+    "flaky_delay",
+    "duplicate",
+    "reorder",
+    "block_state_one",
+    "slow_state_one",
+)
+
+
+def build_scheme(name: str, nodes: list, rnd: random.Random):
+    """Construct a disruption scheme by registry name over ``nodes``
+    with all shape parameters drawn from ``rnd`` — the seeded entry
+    point the randomized matrix (tests/test_randomized_matrix.py) and
+    replay tooling share. → scheme or None ("none")."""
+    seed = rnd.randrange(2 ** 31)
+    if name == "none" or len(nodes) < 2:
+        return None
+    if name == "partition_minority":
+        n_min = rnd.randint(1, max((len(nodes) - 1) // 2, 1))
+        minority = rnd.sample(nodes, n_min)
+        majority = [n for n in nodes if n not in minority]
+        return NetworkPartition(minority, majority)
+    if name == "isolate_one":
+        victim = nodes[rnd.randrange(len(nodes))]
+        return IsolateNode(victim, [n for n in nodes if n is not victim])
+    if name == "delays":
+        # max_delay stays under half the test clusters' fd.ping_timeout
+        # (0.3 s): the scheme should add latency, not fail fault
+        # detection — spurious evictions belong to the partition/kill
+        # schemes, which assert accordingly
+        half = rnd.sample(nodes, max(len(nodes) // 2, 1))
+        rest = [n for n in nodes if n not in half]
+        return NetworkDelaysPartition(half, rest, min_delay=0.02,
+                                      max_delay=0.1, seed=seed)
+    if name == "flaky_drop":
+        return FaultyTransport(nodes, seed=seed,
+                               drop=rnd.uniform(0.02, 0.12))
+    if name == "flaky_delay":
+        return FaultyTransport(nodes, seed=seed,
+                               delay=rnd.uniform(0.1, 0.4))
+    if name == "duplicate":
+        return FaultyTransport(nodes, seed=seed,
+                               duplicate=rnd.uniform(0.05, 0.25))
+    if name == "reorder":
+        return FaultyTransport(nodes, seed=seed,
+                               reorder=rnd.uniform(0.05, 0.3))
+    if name == "block_state_one":
+        blocked = nodes[rnd.randrange(len(nodes))]
+        return BlockClusterStateProcessing(
+            blocked, [n for n in nodes if n is not blocked])
+    if name == "slow_state_one":
+        slow = nodes[rnd.randrange(len(nodes))]
+        return SlowClusterStateProcessing(
+            slow, [n for n in nodes if n is not slow],
+            delay_s=rnd.uniform(0.1, 0.4))
+    raise ValueError(f"unknown scheme [{name}]")

@@ -17,9 +17,9 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
 from elasticsearch_tpu.transport.service import (
-    ConnectTransportError, DiscoveryNode, TransportAddress)
+    DROP, ConnectTransportError, DiscoveryNode, TransportAddress)
 
-DROP = "drop"
+__all__ = ["DROP", "LocalTransport", "LocalTransportHub"]
 
 
 class LocalTransportHub:

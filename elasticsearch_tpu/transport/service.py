@@ -21,6 +21,17 @@ from typing import Callable
 from elasticsearch_tpu.transport.stream import (
     CURRENT_VERSION, StreamInput, StreamOutput)
 
+#: fault-injection verdict: swallow the message entirely. Shared with the
+#: transport-level seams (local.py re-exports it; tcp.py compares to the
+#: same literal) so one scheme vocabulary covers both layers.
+DROP = "drop"
+#: fault-injection verdict constructors — a rule may also return
+#: ("duplicate", n) to deliver 1+n copies, or ("reorder", jitter_s) to
+#: hold the message and release it after the jitter (later messages pass
+#: it, which is what reordering IS on an ordered transport).
+DUPLICATE = "duplicate"
+REORDER = "reorder"
+
 
 class TransportException(Exception):
     pass
@@ -179,6 +190,15 @@ class TransportService:
         self._pools: dict[str, ThreadPoolExecutor] = {}
         self._pools_lock = threading.Lock()
         self.tracers: list[Callable[[str, str, str], None]] = []
+        # Service-level fault-injection seam (the MockTransportService
+        # analog, one layer ABOVE the byte mover so it applies uniformly
+        # to LocalTransport and TcpTransport): rule(addr, action) →
+        # None | DROP | delay-seconds | ("duplicate", n) |
+        # ("reorder", jitter-seconds). Evaluated on every outbound
+        # request and response ("<response>" action, matching the
+        # transport-level seams). Installed by testing_disruption
+        # schemes; None in production.
+        self.outbound_rule: Callable | None = None
         self._closed = False
         transport.bind(self)
         self.local_node: DiscoveryNode = local_node_factory(
@@ -246,8 +266,12 @@ class TransportService:
             ctx.timer.start()
         out = StreamOutput(min(self.local_node.version, node.version))
         out.write_value(request)
+        payload = out.bytes()
         try:
-            self.transport.send_request(node, rid, action, out.bytes())
+            self._ruled_send(
+                node.address, action,
+                lambda: self.transport.send_request(node, rid, action,
+                                                    payload))
         except Exception as e:                  # noqa: BLE001 — connect errors
             self._complete(rid, None, e if isinstance(e, TransportException)
                            else ConnectTransportError(str(e)))
@@ -321,12 +345,61 @@ class TransportService:
         self._trace("send_response", str(request_id), to_node.node_id)
         if error is not None:
             wire_err = (type(error).__name__, str(error))
-            self.transport.send_response(to_node, request_id, None, wire_err)
+            self._ruled_send(
+                to_node.address, "<response>",
+                lambda: self.transport.send_response(to_node, request_id,
+                                                     None, wire_err))
         else:
             out = StreamOutput(min(self.local_node.version, to_node.version))
             out.write_value(response)
-            self.transport.send_response(to_node, request_id, out.bytes(),
-                                         None)
+            payload = out.bytes()
+            self._ruled_send(
+                to_node.address, "<response>",
+                lambda: self.transport.send_response(to_node, request_id,
+                                                     payload, None))
+
+    def _ruled_send(self, addr: "TransportAddress", action: str,
+                    send: Callable[[], None]) -> None:
+        """Apply the service-level fault rule, then move the bytes.
+        Deferred sends (delay/reorder) fire on a timer and stay silent
+        when the node died meanwhile — a resurrected stale send is the
+        ghost-message class the disruption tests exist to rule out."""
+        rule = self.outbound_rule
+        verdict = rule(addr, action) if rule is not None else None
+        if verdict is None:
+            send()
+            return
+        if verdict == DROP:
+            return
+        if isinstance(verdict, (int, float)):
+            if verdict <= 0:
+                send()
+                return
+            self._deferred_send(float(verdict), send)
+            return
+        if isinstance(verdict, tuple) and len(verdict) == 2:
+            kind, arg = verdict
+            if kind == DUPLICATE:
+                send()
+                for _ in range(max(int(arg), 0)):
+                    send()
+                return
+            if kind == REORDER:
+                self._deferred_send(max(float(arg), 0.0), send)
+                return
+        raise ValueError(f"unknown fault verdict {verdict!r}")
+
+    def _deferred_send(self, delay: float, send: Callable[[], None]) -> None:
+        def fire():
+            if self._closed:
+                return
+            try:
+                send()
+            except (OSError, TransportException):
+                pass                             # target gone meanwhile
+        t = threading.Timer(delay, fire)
+        t.daemon = True
+        t.start()
 
     def _pool_for(self, name: str):
         if self.thread_pool is not None:

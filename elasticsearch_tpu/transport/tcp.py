@@ -281,6 +281,13 @@ class TcpTransport:
 
     def _connect(self, addr: TransportAddress,
                  cls: str = "reg") -> socket.socket:
+        if self._closed:
+            # a killed node must not dial fresh connections: a handler
+            # thread that outlived close() could otherwise ACK a write
+            # whose replica fan-out was failed by that very close — the
+            # promoted replica then misses an acked doc (chaos-matrix
+            # find: master kill racing a bulk)
+            raise ConnectTransportError("transport closed")
         key = (addr, cls)
         with self._lock:
             sock = self._outbound.get(key)

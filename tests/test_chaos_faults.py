@@ -1,0 +1,297 @@
+"""Chaos fault injection: message-granular transport faults + disk faults.
+
+The v2 fault layer (transport/service.py seam + testing_disruption
+schemes) under focused assertions:
+
+* duplicate/reorder faults are invisible to correctness (idempotent
+  replica apply, request-id correlation) — exact counts hold;
+* drop faults cost retries, never acked data — every acked write
+  survives the fault window;
+* translog/store IO errors trip engine self-fail → shard-failed →
+  reallocation (replica promotion), and the cluster returns to green
+  after the fault heals — never a wedged shard;
+* isolating EVERY copy of a shard makes it red (unassigned primary
+  pinned to its data), NOT a fresh empty primary — the data-loss class
+  the seeded matrix flushed out.
+
+Every random draw derives from the session seed via the test_random
+fixture, so failures replay from the printed ESTPU_TEST_SEED.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.testing import InternalTestCluster
+from elasticsearch_tpu.testing_disruption import (
+    DiskFaultScheme, FaultyTransport, IsolateNode, wait_until)
+
+
+@pytest.fixture(params=["local", "tcp"])
+def cluster3(request):
+    c = InternalTestCluster(num_nodes=3, transport=request.param)
+    yield c
+    c.close(check_leaks=False)
+
+
+@pytest.fixture
+def cluster3_local():
+    c = InternalTestCluster(num_nodes=3)
+    yield c
+    c.close(check_leaks=False)
+
+
+def _green(node, timeout=30):
+    h = node.wait_for_health("green", timeout=timeout)
+    assert h["status"] == "green", h
+    return h
+
+
+# ---- message-granular faults (both transports — the uniform seam) ----------
+
+def test_duplicate_and_reorder_keep_counts_exact(cluster3, test_random):
+    """Duplicated and reordered data RPCs are correctness-invisible:
+    replica apply is version-deduped, responses correlate by request id,
+    so exact doc counts hold with the faults active the whole time."""
+    c = cluster3
+    a = c.nodes[0]
+    a.indices_service.create_index("chaos_dup", {"settings": {
+        "number_of_shards": 2, "number_of_replicas": 1}})
+    _green(a)
+    scheme = FaultyTransport(c.nodes, seed=test_random.randrange(2 ** 31),
+                             duplicate=0.3, reorder=0.3)
+    n_docs = 40
+    with scheme.applied():
+        for i in range(n_docs):
+            c.nodes[i % 3].index_doc("chaos_dup", str(i), {"n": i})
+        for i in range(0, n_docs, 10):
+            c.nodes[(i + 1) % 3].delete_doc("chaos_dup", str(i))
+    a.broadcast_actions.refresh("chaos_dup")
+    total = a.search("chaos_dup", {"size": 0})["hits"]["total"]
+    assert total == n_docs - n_docs // 10, total
+    _green(a)
+
+
+def test_flaky_drop_acked_writes_survive(cluster3_local, test_random):
+    """Random drops on data RPCs: writes may fail (and are retried by
+    the caller), but every ACKED write must be durable and the healed
+    cluster must converge green."""
+    c = cluster3_local
+    a = c.nodes[0]
+    a.indices_service.create_index("chaos_drop", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 1}})
+    _green(a)
+    scheme = FaultyTransport(c.nodes, seed=test_random.randrange(2 ** 31),
+                             drop=0.12)
+    acked = set()
+    with scheme.applied():
+        for i in range(15):
+            try:
+                r = c.nodes[i % 3].index_doc("chaos_drop", f"d{i}",
+                                             {"n": i})
+                if r["_version"] >= 1:
+                    acked.add(f"d{i}")
+            except Exception:   # noqa: BLE001 — dropped frames cost acks
+                pass
+    # heal, then every acked doc must be readable and the cluster green
+    assert acked, "every single write failed under 12% drop"
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            h = c.master().wait_for_health(None, timeout=1.0)
+            if h["status"] == "green" and \
+                    h["number_of_nodes"] == len(c.nodes):
+                break
+        except RuntimeError:
+            pass
+        time.sleep(0.2)
+    m = c.master()
+    _green(m)
+    m.broadcast_actions.refresh("chaos_drop")
+    for did in sorted(acked):
+        assert m.get_doc("chaos_drop", did)["found"], \
+            f"acked doc [{did}] lost to a dropped frame"
+
+
+def test_isolating_all_copies_goes_red_not_empty(cluster3_local):
+    """Regression for the matrix-found data-loss bug: when the ONLY
+    holder of a shard is partitioned away, the master must leave the
+    primary unassigned (red) — never allocate a fresh EMPTY primary —
+    and the healed cluster must serve the original documents."""
+    c = cluster3_local
+    a = c.master()
+    a.indices_service.create_index("solo", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0}})
+    _green(a)
+    for i in range(12):
+        a.index_doc("solo", str(i), {"n": i})
+    holder = c.primary_node("solo", 0)
+    rest = [n for n in c.nodes if n is not holder]
+    with IsolateNode(holder, rest).applied():
+        # majority master ejects the holder; the shard must go red and
+        # STAY unassigned (pinned to the departed node's data)
+        def red_and_unassigned():
+            try:
+                m = next(n for n in rest if n._started and n.is_master)
+            except StopIteration:
+                return False
+            st = m.cluster_service.state()
+            if holder.node_id in st.nodes:
+                return False
+            pr = st.routing_table.primary("solo", 0)
+            return pr is not None and not pr.assigned
+        assert wait_until(red_and_unassigned, timeout=15), \
+            "primary was reallocated instead of pinned to its data"
+        m = next(n for n in rest if n.is_master)
+        assert m.cluster_service.state().health()["status"] == "red"
+        # a write against the dataless shard must FAIL, not fabricate an
+        # empty primary
+        with pytest.raises(Exception):
+            m.document_actions.PRIMARY_TIMEOUT = 2.0
+            try:
+                m.index_doc("solo", "ghost", {"n": -1})
+            finally:
+                m.document_actions.PRIMARY_TIMEOUT = 15.0
+    # heal: the holder rejoins, the primary lands back on ITS disk
+    def healed():
+        try:
+            m2 = c.master()
+        except RuntimeError:
+            return False
+        st = m2.cluster_service.state()
+        pr = st.routing_table.primary("solo", 0)
+        return len(st.nodes) == 3 and pr is not None and \
+            pr.node_id == holder.node_id and pr.state == "STARTED"
+    assert wait_until(healed, timeout=30), "holder never re-took primary"
+    m2 = c.master()
+    _green(m2)
+    m2.broadcast_actions.refresh("solo")
+    assert m2.search("solo", {"size": 0})["hits"]["total"] == 12
+
+
+# ---- disk faults → engine self-fail → reallocate → green after heal --------
+
+def test_translog_io_error_fails_shard_over(cluster3_local, test_random):
+    """An IO error on the primary's translog self-fails the engine; the
+    shard is reported failed, the replica is promoted, the in-flight
+    write is retried onto it, and after the fault heals the cluster is
+    green with every doc intact (satellite: engine self-fail path)."""
+    c = cluster3_local
+    a = c.master()
+    a.indices_service.create_index("disk_t", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 1}})
+    _green(a)
+    for i in range(10):
+        a.index_doc("disk_t", str(i), {"n": i})
+    victim = c.primary_node("disk_t", 0)
+    coordinator = next(n for n in c.nodes if n is not victim)
+    scheme = DiskFaultScheme(victim, index="disk_t", ops=("add", "sync"),
+                             seed=test_random.randrange(2 ** 31))
+    with scheme.applied():
+        # the engine on the victim fails on this write; the coordinator
+        # retries and the promoted replica serves it
+        out = coordinator.index_doc("disk_t", "x", {"n": 99})
+        assert out["_version"] >= 1
+        assert wait_until(
+            lambda: (pr := c.master().cluster_service.state()
+                     .routing_table.primary("disk_t", 0)) is not None
+            and pr.node_id != victim.node_id and pr.state == "STARTED",
+            timeout=20), "shard never failed over off the faulty disk"
+    # heal: the failed copy reallocates (peer-recovers) and green returns
+    def green_full():
+        try:
+            h = c.master().wait_for_health(None, timeout=1.0)
+        except RuntimeError:
+            return False
+        return h["status"] == "green" and h["number_of_nodes"] == 3
+    assert wait_until(green_full, timeout=45), \
+        "cluster never returned to green after the disk fault healed"
+    m = c.master()
+    m.broadcast_actions.refresh("disk_t")
+    assert m.search("disk_t", {"size": 0})["hits"]["total"] == 11
+    assert m.get_doc("disk_t", "x")["found"]
+
+
+def test_short_write_truncates_not_corrupts(tmp_path, test_random):
+    """A torn (short) translog append fails the op, and a reopened
+    engine replays exactly the complete frames — the torn tail is
+    truncated, never surfaced as corruption."""
+    from elasticsearch_tpu.analysis import AnalysisRegistry
+    from elasticsearch_tpu.common.errors import EngineClosedError
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.index.engine import Engine
+    from elasticsearch_tpu.mapping import MapperService
+    ms = MapperService(AnalysisRegistry(Settings.EMPTY))
+    e = Engine(tmp_path / "s0", ms)
+    for i in range(7):
+        e.index(str(i), {"n": i})
+
+    def tear(op, data):
+        if op == "add" and data:
+            return data[:max(1, len(data) // 2)]
+        return None
+    e.translog.fault_hook = tear
+    with pytest.raises(EngineClosedError):
+        e.index("torn", {"n": -1})
+    assert e.failure_reason is not None
+    # reopen over the same path: the 7 complete frames replay, the torn
+    # tail is silently truncated at the frame boundary
+    e2 = Engine(tmp_path / "s0", ms)
+    assert e2.num_docs == 7
+    assert e2.get("torn").found is False
+    # and the reopened engine appends cleanly after the truncation
+    e2.index("after", {"n": 100})
+    assert e2.num_docs == 8
+    e2.close()
+
+
+def test_store_commit_io_error_fails_engine(tmp_path):
+    """An IO error while writing the commit point (manifest) self-fails
+    the engine instead of acking a flush that was never durable."""
+    from elasticsearch_tpu.analysis import AnalysisRegistry
+    from elasticsearch_tpu.common.errors import EngineClosedError
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.index.engine import Engine
+    from elasticsearch_tpu.mapping import MapperService
+    ms = MapperService(AnalysisRegistry(Settings.EMPTY))
+    e = Engine(tmp_path / "s0", ms)
+    for i in range(5):
+        e.index(str(i), {"n": i})
+
+    def fail_commit(op, data):
+        if op == "store.commit":
+            raise OSError("simulated manifest write failure")
+    e.disk_fault = fail_commit
+    with pytest.raises(EngineClosedError):
+        e.flush()
+    assert e.failure_reason is not None
+    # the engine reopens from the last good commit + translog replay
+    e2 = Engine(tmp_path / "s0", ms)
+    assert e2.num_docs == 5
+    e2.close()
+
+
+def test_fault_seam_uniform_over_both_transports(test_random):
+    """The same scheme object (service-level seam) disrupts a TCP
+    cluster exactly like a local one — drop a data action class and the
+    write times out + retries rather than hanging."""
+    c = InternalTestCluster(num_nodes=2, transport="tcp")
+    try:
+        a = c.nodes[0]
+        a.indices_service.create_index("seam", {"settings": {
+            "number_of_shards": 1, "number_of_replicas": 1}})
+        _green(a)
+        a.index_doc("seam", "pre", {"n": 0})
+        scheme = FaultyTransport(
+            c.nodes, seed=test_random.randrange(2 ** 31), duplicate=1.0)
+        with scheme.applied():
+            # 100% duplication on every data RPC, over real sockets:
+            # double-delivery must stay invisible
+            for i in range(10):
+                a.index_doc("seam", f"d{i}", {"n": i})
+        a.broadcast_actions.refresh("seam")
+        assert a.search("seam", {"size": 0})["hits"]["total"] == 11
+    finally:
+        c.close(check_leaks=False)

@@ -1,0 +1,144 @@
+"""Dangling-indices import (core/gateway/DanglingIndicesState.java).
+
+Positive case: create an index, full-cluster-stop, wipe every node's
+persisted cluster metadata, restart over the same data paths — the
+on-disk index dirs (stamped with ``_meta.json``) are offered to the new
+master, re-imported, allocated, and the documents come back.
+
+Negative case (delete tombstone): a node that was DOWN while the
+cluster deleted an index finds the tombstone on rejoin and destroys its
+on-disk copy — removed indices stay dead, they do not resurrect as
+dangling imports.
+"""
+
+from __future__ import annotations
+
+import shutil
+import time
+
+from elasticsearch_tpu.testing import InternalTestCluster
+from elasticsearch_tpu.testing_disruption import wait_until
+
+
+def test_dangling_import_restores_index_after_metadata_wipe(tmp_path):
+    base = tmp_path / "cluster"
+    c = InternalTestCluster(num_nodes=2, base_path=base)
+    try:
+        a = c.nodes[0]
+        a.indices_service.create_index("dang", {"settings": {
+            "number_of_shards": 2, "number_of_replicas": 1}})
+        a.wait_for_health("green", timeout=30)
+        for i in range(25):
+            a.index_doc("dang", str(i), {"n": i, "body": f"tok{i % 3}"})
+        a.broadcast_actions.flush("dang")
+    finally:
+        c.close(check_leaks=False)
+    # wipe the persisted cluster metadata on every node — the gateway
+    # now knows nothing; only the index dirs (+ _meta.json) survive
+    for state_dir in base.glob("node-*/_state"):
+        shutil.rmtree(state_dir)
+
+    c2 = InternalTestCluster(num_nodes=2, base_path=base)
+    try:
+        m = c2.master()
+
+        def imported():
+            st = c2.master().cluster_service.state()
+            return "dang" in st.indices and \
+                st.health()["status"] == "green"
+        assert wait_until(imported, timeout=30), \
+            "dangling index never re-imported"
+        m = c2.master()
+        meta = m.cluster_service.state().indices["dang"]
+        assert meta.number_of_shards == 2
+        m.broadcast_actions.refresh("dang")
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if m.search("dang", {"size": 0})["hits"]["total"] == 25:
+                break
+            time.sleep(0.2)
+        assert m.search("dang", {"size": 0})["hits"]["total"] == 25
+    finally:
+        c2.close(check_leaks=False)
+
+
+def test_tombstone_keeps_deleted_index_dead(tmp_path):
+    base = tmp_path / "cluster"
+    c = InternalTestCluster(num_nodes=3, base_path=base)
+    try:
+        a = c.nodes[0]
+        a.indices_service.create_index("doomed", {"settings": {
+            "number_of_shards": 1, "number_of_replicas": 2}})
+        a.wait_for_health("green", timeout=30)
+        for i in range(10):
+            a.index_doc("doomed", str(i), {"n": i})
+        a.broadcast_actions.flush("doomed")
+        # take a NON-master member offline so the delete below doesn't
+        # race a re-election
+        offline = c.non_masters()[0]
+        offline_name = offline.node_name
+        offline_dir = base / offline_name / "indices" / "doomed"
+        assert offline_dir.is_dir()
+        c.stop_node(offline, graceful=False)     # files stay on disk
+
+        def converged(n_nodes):
+            def check():
+                try:
+                    return len(c.master().cluster_service.state()
+                               .nodes) == n_nodes
+                except RuntimeError:             # mid-election
+                    return False
+            return check
+        assert wait_until(converged(2), timeout=20)
+        m = c.master()
+        m.indices_service.delete_index("doomed")
+        tombs = m.cluster_service.state().customs.get(
+            "index_tombstones", [])
+        assert any(t["index"] == "doomed" for t in tombs)
+        # the node rejoins over its old data path: the tombstone must
+        # win — local copy destroyed, index NOT offered back
+        c.add_node(name=offline_name)
+        assert wait_until(converged(3), timeout=30)
+        assert wait_until(lambda: not offline_dir.exists(), timeout=20), \
+            "tombstoned index dir was not destroyed on rejoin"
+        time.sleep(0.5)                          # any in-flight offer
+        assert "doomed" not in \
+            c.master().cluster_service.state().indices, \
+            "deleted index resurrected via dangling import"
+    finally:
+        c.close(check_leaks=False)
+
+
+def test_tombstones_survive_full_cluster_restart(tmp_path):
+    """Persisted tombstones: delete, full stop, restart over the same
+    paths — a straggler dir from a partially-applied delete must stay
+    dead even though the delete happened a cluster-lifetime ago."""
+    base = tmp_path / "cluster"
+    c = InternalTestCluster(num_nodes=2, base_path=base)
+    try:
+        a = c.nodes[0]
+        a.indices_service.create_index("zombie", {"settings": {
+            "number_of_shards": 1, "number_of_replicas": 1}})
+        a.wait_for_health("green", timeout=30)
+        a.index_doc("zombie", "1", {"n": 1})
+        a.broadcast_actions.flush("zombie")
+        # simulate a node that never applied the delete: stash its copy
+        stash = tmp_path / "stash"
+        shutil.copytree(base / "node-2" / "indices" / "zombie", stash)
+        a.indices_service.delete_index("zombie")
+        time.sleep(0.3)                          # let deletes apply
+    finally:
+        c.close(check_leaks=False)
+    # resurrect the stale dir, then restart the cluster
+    target = base / "node-2" / "indices" / "zombie"
+    if not target.exists():
+        shutil.copytree(stash, target)
+    c2 = InternalTestCluster(num_nodes=2, base_path=base)
+    try:
+        assert wait_until(
+            lambda: not target.exists(), timeout=30), \
+            "stale dir of a deleted index survived restart"
+        assert "zombie" not in \
+            c2.master().cluster_service.state().indices
+    finally:
+        c2.close(check_leaks=False)

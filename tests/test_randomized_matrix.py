@@ -1,69 +1,211 @@
-"""Randomized integration MATRIX — the ESIntegTestCase discipline.
+"""Seeded chaos matrix v2 — the ESIntegTestCase discipline, per case.
 
 Reference: test/test/InternalTestCluster.java:146 randomizes node
 counts, settings and transport implementations across every integration
-suite. Here one session draws, from the printed ESTPU_TEST_SEED:
+suite; test/test/disruption/ supplies the scheme library. Here EVERY
+case draws its own cluster shape from its own seed:
 
-* the cluster shape — node count 2-5,
-* the transport — local in-process hub or real TCP sockets,
-* a settings subset — translog durability, refresh interval, frame
-  compression,
+* transport — local in-process hub or real TCP sockets,
+* node count 3-7, replica count, a settings subset,
+* a disruption scheme from the seeded registry
+  (elasticsearch_tpu.testing_disruption.build_scheme),
 
-and a SCENARIO SAMPLER picks a bounded number of disruption/recovery/
-relocation exercises to run under that shape (all of them under
-ESTPU_MATRIX_ALL=1). Any failure reproduces from the seed alone: shape,
-settings, doc counts and op orders all derive from it.
+and runs one scenario under that shape. Any failure replays exactly:
+each case prints a ``ESTPU_MATRIX_CASE=<scenario>:<seed>`` line, and
+running the module with that env var re-runs the identical draw
+(transport, nodes, replicas, scheme, op counts — everything derives
+from the seed).
+
+Tier-1 runs the deterministic SMOKE subset; the full ≥25-case matrix is
+marked ``slow`` (run it with ``-m slow`` / ESTPU_MATRIX_ALL=1).
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
 import random
+import threading
 import time
+from dataclasses import dataclass
 
 import pytest
 
-from conftest import SESSION_SEED, derive_seed
+from conftest import derive_seed
 
 # ---------------------------------------------------------------------------
-# session-level shape draw (collection-time: parametrization must be
-# deterministic per seed, so it cannot use the per-test fixture)
+# spec draw — THE seeded entry point (replay = same scenario + seed)
 # ---------------------------------------------------------------------------
 
-_shape_rnd = random.Random(derive_seed("randomized-matrix-shape"))
-N_NODES = _shape_rnd.randint(2, 5)
-TRANSPORT = _shape_rnd.choice(["local", "tcp"])
-SETTINGS = {}
-if _shape_rnd.random() < 0.5:
-    SETTINGS["index.translog.durability"] = _shape_rnd.choice(
-        ["request", "async"])
-if _shape_rnd.random() < 0.5:
-    SETTINGS["transport.tcp.compress"] = _shape_rnd.choice([True, False])
+SCENARIOS = [
+    "crud_search",
+    "kill_replica_holder",
+    "move_primary",
+    "partition_minority",
+    "snapshot_restore",
+    "scroll_under_writes",
+    "node_churn",
+    "rolling_settings",
+    # v2 combination scenarios
+    "recovery_during_relocation",
+    "snapshot_during_churn",
+    "master_failover_during_bulk",
+    "disk_fault_failover",
+]
 
-SCENARIOS = ["crud_search", "kill_replica_holder", "move_primary",
-             "partition_minority", "rolling_settings",
-             "snapshot_restore", "scroll_under_writes", "node_churn"]
-if os.environ.get("ESTPU_MATRIX_ALL") == "1":
-    SAMPLED = list(SCENARIOS)
-else:
-    SAMPLED = _shape_rnd.sample(SCENARIOS, 2)
+#: scenarios that stage their own disruption — layering a random scheme
+#: over them would double-fault the window they carefully construct
+SELF_DISRUPTING = {
+    "kill_replica_holder", "partition_minority", "node_churn",
+    "recovery_during_relocation", "snapshot_during_churn",
+    "master_failover_during_bulk", "disk_fault_failover",
+}
+
+#: schemes a write-exercising scenario can carry while still asserting
+#: EXACT counts: nothing here drops messages, so every ack happens —
+#: possibly late, duplicated, or reordered. Drop-based schemes run in
+#: the self-disrupting scenarios and tests/test_chaos_faults.py, where
+#: assertions use acked-sets instead of exact totals.
+SOFT_SCHEMES = ("none", "delays", "flaky_delay", "duplicate", "reorder",
+                "slow_state_one")
+
+#: deterministic tier-1 smoke subset (the full matrix is `slow`)
+SMOKE = ["crud_search", "partition_minority", "recovery_during_relocation",
+         "master_failover_during_bulk", "disk_fault_failover"]
+
+VARIANTS = int(os.environ.get("ESTPU_MATRIX_VARIANTS", "3"))
 
 
-@pytest.fixture(scope="module")
-def cluster():
+@dataclass(frozen=True)
+class MatrixSpec:
+    scenario: str
+    seed: int
+    transport: str
+    num_nodes: int
+    replicas: int
+    scheme: str
+    settings: tuple
+
+
+def draw_spec(scenario: str, seed: int) -> MatrixSpec:
+    """Deterministic draw of the whole case shape from (scenario, seed).
+    The draw order is fixed — replaying a printed seed reproduces the
+    identical transport/nodes/replicas/scheme tuple."""
+    rnd = random.Random(seed)
+    transport = rnd.choice(["local", "tcp"])
+    num_nodes = rnd.randint(3, 7)
+    replicas = rnd.randint(0, min(2, num_nodes - 1))
+    settings = {}
+    if rnd.random() < 0.5:
+        settings["index.translog.durability"] = rnd.choice(
+            ["request", "async"])
+    if transport == "tcp" and rnd.random() < 0.5:
+        settings["transport.tcp.compress"] = rnd.choice([True, False])
+    scheme = "none" if scenario in SELF_DISRUPTING \
+        else rnd.choice(SOFT_SCHEMES)
+    return MatrixSpec(scenario=scenario, seed=seed, transport=transport,
+                      num_nodes=num_nodes, replicas=replicas,
+                      scheme=scheme,
+                      settings=tuple(sorted(settings.items())))
+
+
+_FAIL_RECORDED: list[MatrixSpec] = []
+
+
+def run_case(scenario: str, seed: int) -> MatrixSpec:
+    """The matrix entrypoint: draw the spec, print the replay line,
+    build the cluster, run the scenario, tear down. → the spec run."""
+    spec = draw_spec(scenario, seed)
+    print(f"[matrix] scenario={scenario} seed={seed} "
+          f"transport={spec.transport} nodes={spec.num_nodes} "
+          f"replicas={spec.replicas} scheme={spec.scheme} "
+          f"settings={dict(spec.settings)}", flush=True)
+    print(f"[matrix] replay with: ESTPU_MATRIX_CASE={scenario}:{seed} "
+          f"python -m pytest tests/test_randomized_matrix.py -q",
+          flush=True)
+    if scenario == "_always_fail":
+        # replay-harness check: fail BEFORE any cluster spins up
+        _FAIL_RECORDED.append(spec)
+        raise AssertionError("deliberate matrix failure (replay check)")
     from elasticsearch_tpu.testing import InternalTestCluster
-    c = InternalTestCluster(num_nodes=N_NODES, transport=TRANSPORT,
-                            settings=dict(SETTINGS))
-    print(f"[matrix] seed={SESSION_SEED} nodes={N_NODES} "
-          f"transport={TRANSPORT} settings={SETTINGS} "
-          f"scenarios={SAMPLED}", flush=True)
-    yield c
-    c.close(check_leaks=False)
+    fn = globals()[f"_scenario_{scenario}"]
+    rnd = random.Random(seed ^ 0x5EED5EED)
+    c = InternalTestCluster(num_nodes=spec.num_nodes,
+                            transport=spec.transport,
+                            settings=dict(spec.settings))
+    try:
+        fn(c, rnd, spec)
+    finally:
+        c.close(check_leaks=False)
+    return spec
 
 
-def _rnd(name: str) -> random.Random:
-    return random.Random(derive_seed(f"matrix-{name}"))
+# ---------------------------------------------------------------------------
+# parametrization: smoke (tier-1) + full matrix (slow) + replay override
+# ---------------------------------------------------------------------------
 
+_REPLAY = os.environ.get("ESTPU_MATRIX_CASE")
+if _REPLAY:
+    _scen, _, _seed = _REPLAY.partition(":")
+    SMOKE_CASES = [(_scen, int(_seed))]
+    FULL_CASES: list[tuple[str, int]] = []
+else:
+    SMOKE_CASES = [(s, derive_seed(f"matrix2-smoke-{s}")) for s in SMOKE]
+    FULL_CASES = [(s, derive_seed(f"matrix2-{s}-v{v}"))
+                  for v in range(VARIANTS) for s in SCENARIOS]
+
+
+@pytest.mark.parametrize(
+    "scenario,seed", SMOKE_CASES,
+    ids=[f"{s}-{seed}" for s, seed in SMOKE_CASES])
+def test_matrix_smoke(scenario, seed):
+    run_case(scenario, seed)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "scenario,seed", FULL_CASES,
+    ids=[f"{s}-v{i // len(SCENARIOS)}-{seed}"
+         for i, (s, seed) in enumerate(FULL_CASES)])
+def test_matrix_full(scenario, seed):
+    run_case(scenario, seed)
+
+
+# ---------------------------------------------------------------------------
+# seed-replay guarantees (satellite): the printed seed IS the scenario
+# ---------------------------------------------------------------------------
+
+def test_seed_replay_reproduces_draw():
+    """Feeding a seed back to the draw reproduces the identical
+    transport/nodes/replicas/scheme tuple, for every scenario."""
+    for scenario in SCENARIOS:
+        seed = derive_seed(f"replay-check-{scenario}")
+        assert draw_spec(scenario, seed) == draw_spec(scenario, seed)
+        # a different seed must be able to change the draw (sanity that
+        # the spec actually derives from the seed, not from globals)
+        others = {draw_spec(scenario, seed + k) for k in range(8)}
+        assert len(others) > 1
+
+
+def test_printed_seed_replays_failing_scenario(capsys):
+    """A deliberately-failing case prints a replay line; feeding that
+    line's scenario:seed back to the entrypoint reproduces the exact
+    draw the failing run used."""
+    _FAIL_RECORDED.clear()
+    seed = derive_seed("matrix2-deliberate-failure")
+    with pytest.raises(AssertionError, match="deliberate"):
+        run_case("_always_fail", seed)
+    out = capsys.readouterr().out
+    line = [ln for ln in out.splitlines()
+            if "ESTPU_MATRIX_CASE=" in ln][-1]
+    token = line.split("ESTPU_MATRIX_CASE=")[1].split()[0]
+    scen, _, printed_seed = token.partition(":")
+    assert draw_spec(scen, int(printed_seed)) == _FAIL_RECORDED[0]
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
 
 def _green(node, timeout=30):
     h = node.wait_for_health("green", timeout=timeout)
@@ -71,70 +213,86 @@ def _green(node, timeout=30):
     return h
 
 
-def _wait_nodes_green(c, timeout=30):
+def _wait_nodes_green(c, timeout=45):
     """Poll until some node sees the full membership AND green, then
     assert green — the one wait discipline for every scenario that
     changes membership."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
-        h = c.nodes[0].wait_for_health(None, timeout=1.0)
+        try:
+            h = c.nodes[0].wait_for_health(None, timeout=1.0)
+        except Exception:   # noqa: BLE001 — node mid-start
+            time.sleep(0.2)
+            continue
         if h["number_of_nodes"] == len(c.nodes) and \
                 h["status"] == "green":
             break
         time.sleep(0.2)
-    _green(c.nodes[0], timeout=10)
+    _green(c.nodes[0], timeout=15)
 
 
-@pytest.mark.parametrize("scenario", SAMPLED)
-def test_matrix_scenario(cluster, scenario):
-    globals()[f"_scenario_{scenario}"](cluster, _rnd(scenario))
+@contextlib.contextmanager
+def _scheme_window(c, spec: MatrixSpec, rnd: random.Random):
+    """Apply the case's drawn disruption scheme for the duration of the
+    block (and ALWAYS heal it, even on failure)."""
+    from elasticsearch_tpu.testing_disruption import build_scheme
+    nodes = [n for n in c.nodes if n._started]
+    scheme = build_scheme(spec.scheme, nodes, rnd)
+    if scheme is None:
+        yield
+        return
+    scheme.start_disrupting()
+    try:
+        yield
+    finally:
+        scheme.stop_disrupting()
+
+
+def _any_node(c, rnd):
+    live = [n for n in c.nodes if n._started]
+    return live[rnd.randrange(len(live))]
 
 
 # ---------------------------------------------------------------------------
-# scenarios — each bounded to seconds, all shapes drawn from the seed
+# scenarios — each bounded to seconds; shapes all come from the seed
 # ---------------------------------------------------------------------------
 
-def _scenario_crud_search(c, rnd):
+def _scenario_crud_search(c, rnd, spec):
     a = c.nodes[0]
     shards = rnd.randint(1, 4)
-    replicas = rnd.randint(0, min(2, len(c.nodes) - 1))
     a.indices_service.create_index("m_crud", {"settings": {
-        "number_of_shards": shards, "number_of_replicas": replicas}})
+        "number_of_shards": shards,
+        "number_of_replicas": spec.replicas}})
     _green(a)
-    n_docs = rnd.randint(30, 120)
+    n_docs = rnd.randint(30, 90)
     ids = list(range(n_docs))
     rnd.shuffle(ids)
-    for i in ids:
-        a.index_doc("m_crud", str(i),
-                    {"n": i, "body": f"tok{i % 5} shared"})
-    # delete a random subset through a random node
-    dels = rnd.sample(range(n_docs), k=n_docs // 10)
-    for i in dels:
-        c.nodes[rnd.randrange(len(c.nodes))].delete_doc("m_crud", str(i))
+    with _scheme_window(c, spec, rnd):
+        for i in ids:
+            a.index_doc("m_crud", str(i),
+                        {"n": i, "body": f"tok{i % 5} shared"})
+        dels = rnd.sample(range(n_docs), k=n_docs // 10)
+        for i in dels:
+            _any_node(c, rnd).delete_doc("m_crud", str(i))
     a.broadcast_actions.refresh("m_crud")
-    q = c.nodes[rnd.randrange(len(c.nodes))]
-    total = q.search("m_crud", {"size": 0})["hits"]["total"]
+    total = _any_node(c, rnd).search("m_crud", {"size": 0})["hits"]["total"]
     assert total == n_docs - len(dels), (total, n_docs, len(dels))
 
 
-def _scenario_kill_replica_holder(c, rnd):
-    if len(c.nodes) < 3:
-        pytest.skip("needs a quorum-surviving cluster")
+def _scenario_kill_replica_holder(c, rnd, spec):
     a = c.nodes[0]
     a.indices_service.create_index("m_kill", {"settings": {
         "number_of_shards": rnd.randint(1, 3),
         "number_of_replicas": 1}})
     _green(a)
-    n_docs = rnd.randint(20, 80)
+    n_docs = rnd.randint(20, 60)
     for i in range(n_docs):
         a.index_doc("m_kill", str(i), {"n": i})
     victim = c.nodes[rnd.randrange(1, len(c.nodes))]
     c.stop_node(victim, graceful=False)
     # first the SURVIVORS must absorb the loss — converged membership
     # and every primary of THIS index active (replica promotion) —
-    # before the replacement joins; full-cluster green may be impossible
-    # here when an earlier scenario's index wants more replicas than the
-    # shrunken cluster can host
+    # before the replacement joins
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
         try:
@@ -152,11 +310,8 @@ def _scenario_kill_replica_holder(c, rnd):
         time.sleep(0.2)
     else:
         raise AssertionError("survivors never recovered m_kill primaries")
-    # then replace the killed node so later scenarios see the drawn
-    # cluster shape — the quorum (minimum_master_nodes) was fixed at
-    # creation time from that shape, and a permanently shrunk cluster
-    # can no longer afford losing a minority (InternalTestCluster
-    # restarts nodes rather than shrinking, InternalTestCluster.java)
+    # replace the killed node so the quorum (minimum_master_nodes fixed
+    # at creation from the drawn shape) keeps its safety margin
     c.add_node()
     _wait_nodes_green(c)
     c.nodes[0].broadcast_actions.refresh("m_kill")
@@ -164,28 +319,26 @@ def _scenario_kill_replica_holder(c, rnd):
         == n_docs
 
 
-def _scenario_move_primary(c, rnd):
-    """Streaming relocation under the randomized shape: move a primary
-    to a random other node while writes continue."""
+def _scenario_move_primary(c, rnd, spec):
+    """Streaming relocation under the drawn shape: move a primary to a
+    random other node while writes continue (under the drawn scheme)."""
     a = c.master()
     a.indices_service.create_index("m_move", {"settings": {
         "number_of_shards": 1, "number_of_replicas": 0}})
     _green(a)
-    for i in range(rnd.randint(20, 60)):
+    n_pre = rnd.randint(20, 50)
+    for i in range(n_pre):
         a.index_doc("m_move", f"pre-{i}", {"n": i})
     src = c.primary_node("m_move", 0)
     others = [n for n in c.nodes if n is not src and n._started]
-    if not others:
-        pytest.skip("single-node shape: nothing to move to")
     dst = others[rnd.randrange(len(others))]
-    a.cluster_reroute([{"move": {
-        "index": "m_move", "shard": 0,
-        "from_node": src.node_id, "to_node": dst.node_id}}])
-    # writes keep landing during the handoff
     extra = rnd.randint(5, 20)
-    for i in range(extra):
-        c.nodes[rnd.randrange(len(c.nodes))].index_doc(
-            "m_move", f"live-{i}", {"n": i})
+    with _scheme_window(c, spec, rnd):
+        a.cluster_reroute([{"move": {
+            "index": "m_move", "shard": 0,
+            "from_node": src.node_id, "to_node": dst.node_id}}])
+        for i in range(extra):          # writes land during the handoff
+            _any_node(c, rnd).index_doc("m_move", f"live-{i}", {"n": i})
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
         st = c.master().cluster_service.state()
@@ -198,15 +351,13 @@ def _scenario_move_primary(c, rnd):
         raise AssertionError("relocation did not complete")
     c.master().broadcast_actions.refresh("m_move")
     total = c.master().search("m_move", {"size": 0})["hits"]["total"]
-    assert total == 20 + extra or total >= extra, total
+    assert total == n_pre + extra, (total, n_pre, extra)
 
 
-def _scenario_partition_minority(c, rnd):
+def _scenario_partition_minority(c, rnd, spec):
     """Partition a random minority away; the majority keeps serving and
-    the healed cluster converges (works on BOTH transports — the
-    disruption seam is the outbound rule table)."""
-    if len(c.nodes) < 3:
-        pytest.skip("partition needs n >= 3")
+    the healed cluster converges (both transports — the disruption seam
+    is the outbound rule table)."""
     from elasticsearch_tpu.testing_disruption import NetworkPartition
     a = c.master()
     a.indices_service.create_index("m_part", {"settings": {
@@ -215,18 +366,22 @@ def _scenario_partition_minority(c, rnd):
     _green(a)
     for i in range(20):
         a.index_doc("m_part", str(i), {"n": i})
-    # the isolated majority must still hold an election quorum — being a
-    # majority of the CURRENT node list is not enough if the cluster ever
-    # shrank below its creation-time minimum_master_nodes
     quorum = int(c.settings.get("discovery.zen.minimum_master_nodes", 1))
     max_minority = min((len(c.nodes) - 1) // 2, len(c.nodes) - quorum)
-    if max_minority < 1:
-        pytest.skip("no minority can be isolated without losing quorum")
-    n_minority = rnd.randint(1, max_minority)
-    minority = rnd.sample(c.nodes, n_minority)
+    assert max_minority >= 1, "drawn shape cannot lose a minority"
+    # isolate non-holders of m_part: "the majority keeps serving" is
+    # only a fair assertion while a data copy remains reachable —
+    # isolating EVERY copy must make the shard red instead (covered by
+    # test_chaos_faults.py::test_isolating_all_copies_goes_red_not_empty)
+    st = c.master().cluster_service.state()
+    holders = {s.node_id for s in
+               st.routing_table.shard_copies("m_part", 0) if s.assigned}
+    pool = [n for n in c.nodes if n.node_id not in holders]
+    n_minority = max(min(rnd.randint(1, max_minority), len(pool)), 1)
+    minority = rnd.sample(pool, n_minority)
     majority = [n for n in c.nodes if n not in minority]
     with NetworkPartition(minority, majority).applied():
-        deadline = time.monotonic() + 20
+        deadline = time.monotonic() + 25
         surviving = None
         while time.monotonic() < deadline:
             try:
@@ -247,32 +402,33 @@ def _scenario_partition_minority(c, rnd):
     assert m.search("m_part", {"size": 0})["hits"]["total"] == 21
 
 
-def _scenario_snapshot_restore(c, rnd):
+def _scenario_snapshot_restore(c, rnd, spec):
     """Snapshot through a random node, wipe, restore, verify counts —
-    under whatever shape/transport the session drew."""
+    under whatever shape/transport/scheme the case drew."""
     import shutil
     import tempfile
     a = c.master()
     shards = rnd.randint(1, 3)
     a.indices_service.create_index("m_snap", {"settings": {
         "number_of_shards": shards,
-        "number_of_replicas": min(1, len(c.nodes) - 1)}})
+        "number_of_replicas": min(spec.replicas, 1)}})
     _green(a)
-    n_docs = rnd.randint(25, 90)
+    n_docs = rnd.randint(25, 70)
     for i in range(n_docs):
         a.index_doc("m_snap", str(i), {"n": i})
     a.broadcast_actions.refresh("m_snap")
     loc = tempfile.mkdtemp(prefix="m-snap-repo-")
     try:
-        a.snapshots_service.put_repository(
-            "m_backup", {"type": "fs", "settings": {"location": loc}})
-        out = a.snapshots_service.create_snapshot(
-            "m_backup", "s1", {"indices": ["m_snap"]})
-        assert out["snapshot"]["state"] == "SUCCESS", out
-        a.indices_service.delete_index("m_snap")
-        a.snapshots_service.restore_snapshot("m_backup", "s1")
+        with _scheme_window(c, spec, rnd):
+            a.snapshots_service.put_repository(
+                "m_backup", {"type": "fs", "settings": {"location": loc}})
+            out = a.snapshots_service.create_snapshot(
+                "m_backup", "s1", {"indices": ["m_snap"]})
+            assert out["snapshot"]["state"] == "SUCCESS", out
+            a.indices_service.delete_index("m_snap")
+            a.snapshots_service.restore_snapshot("m_backup", "s1")
         deadline = time.monotonic() + 30
-        q = c.nodes[rnd.randrange(len(c.nodes))]
+        q = _any_node(c, rnd)
         while time.monotonic() < deadline:
             try:
                 if q.search("m_snap", {"size": 0})["hits"]["total"] \
@@ -287,7 +443,7 @@ def _scenario_snapshot_restore(c, rnd):
         shutil.rmtree(loc, ignore_errors=True)
 
 
-def _scenario_scroll_under_writes(c, rnd):
+def _scenario_scroll_under_writes(c, rnd, spec):
     """Scroll pages pin point-in-time readers: writes landing mid-scroll
     never leak into later pages, on either transport."""
     a = c.master()
@@ -295,47 +451,46 @@ def _scenario_scroll_under_writes(c, rnd):
         "number_of_shards": rnd.randint(1, 3),
         "number_of_replicas": 0}})
     _green(a)
-    n_docs = rnd.randint(40, 100)
+    n_docs = rnd.randint(40, 90)
     for i in range(n_docs):
         a.index_doc("m_scr", str(i), {"n": i})
     a.broadcast_actions.refresh("m_scr")
     page = rnd.randint(7, 19)
-    r = a.search("m_scr", {"query": {"match_all": {}}, "size": page,
-                           "sort": [{"n": {"order": "asc"}}]},
-                 scroll="1m")
-    seen = [h["_id"] for h in r["hits"]["hits"]]
-    sid = r["_scroll_id"]
-    # concurrent writes through random nodes while the scroll walks
-    for i in range(rnd.randint(10, 30)):
-        c.nodes[rnd.randrange(len(c.nodes))].index_doc(
-            "m_scr", f"mid-{i}", {"n": n_docs + i})
-    a.broadcast_actions.refresh("m_scr")
-    while True:
-        r = a.search_actions.scroll(sid, scroll="1m")
-        hits = r["hits"]["hits"]
-        if not hits:
-            break
-        seen.extend(h["_id"] for h in hits)
+    with _scheme_window(c, spec, rnd):
+        r = a.search("m_scr", {"query": {"match_all": {}}, "size": page,
+                               "sort": [{"n": {"order": "asc"}}]},
+                     scroll="1m")
+        seen = [h["_id"] for h in r["hits"]["hits"]]
         sid = r["_scroll_id"]
-        # a looping scroll id must FAIL reproducibly, not hang CI
-        assert len(seen) <= n_docs + page, \
-            f"scroll re-served pages: {len(seen)} > {n_docs}"
+        for i in range(rnd.randint(10, 30)):
+            _any_node(c, rnd).index_doc("m_scr", f"mid-{i}",
+                                        {"n": n_docs + i})
+        a.broadcast_actions.refresh("m_scr")
+        while True:
+            r = a.search_actions.scroll(sid, scroll="1m")
+            hits = r["hits"]["hits"]
+            if not hits:
+                break
+            seen.extend(h["_id"] for h in hits)
+            sid = r["_scroll_id"]
+            # a looping scroll id must FAIL reproducibly, not hang CI
+            assert len(seen) <= n_docs + page, \
+                f"scroll re-served pages: {len(seen)} > {n_docs}"
     assert len(seen) == n_docs, (len(seen), n_docs)
     assert not any(i.startswith("mid-") for i in seen)
     assert len(set(seen)) == n_docs         # no dup across pages
 
 
-def _scenario_node_churn(c, rnd):
-    """Grow the cluster by one node (auto-rebalancing may move shards
-    onto it), then gracefully retire a non-master member — counts stay
-    exact through both membership changes."""
+def _scenario_node_churn(c, rnd, spec):
+    """Grow by one node (auto-rebalance may move shards onto it), then
+    gracefully retire a non-master member — counts stay exact through
+    both membership changes."""
     a = c.master()
-    shards = rnd.randint(2, 4)
     a.indices_service.create_index("m_churn", {"settings": {
-        "number_of_shards": shards,
+        "number_of_shards": rnd.randint(2, 4),
         "number_of_replicas": min(1, len(c.nodes) - 1)}})
     _green(a)
-    n_docs = rnd.randint(30, 90)
+    n_docs = rnd.randint(30, 70)
     for i in range(n_docs):
         a.index_doc("m_churn", str(i), {"n": i})
     a.broadcast_actions.refresh("m_churn")
@@ -343,7 +498,6 @@ def _scenario_node_churn(c, rnd):
     _wait_nodes_green(c)
     assert c.master().search("m_churn", {"size": 0})["hits"]["total"] \
         == n_docs
-    # graceful leave: shards drain off the retiree before/after close
     victims = c.non_masters()
     c.stop_node(victims[rnd.randrange(len(victims))], graceful=True)
     _wait_nodes_green(c)
@@ -352,19 +506,264 @@ def _scenario_node_churn(c, rnd):
     assert m.search("m_churn", {"size": 0})["hits"]["total"] == n_docs
 
 
-def _scenario_rolling_settings(c, rnd):
-    """Dynamic settings land cluster-wide through a random node."""
+def _scenario_rolling_settings(c, rnd, spec):
+    """Dynamic settings land cluster-wide through a random node, even
+    with the drawn scheme jittering the publish path."""
     a = c.nodes[0]
     a.indices_service.create_index("m_set", {"settings": {
         "number_of_shards": 1,
         "number_of_replicas": min(1, len(c.nodes) - 1)}})
     _green(a)
-    n = c.nodes[rnd.randrange(len(c.nodes))]
-    n.indices_service.update_settings("m_set", {
-        "index.refresh_interval": "30s"})
-    for node in c.nodes:
-        if not node._started:
-            continue
-        st = node.cluster_service.state()
-        meta = st.indices["m_set"]
-        assert meta.settings.get("index.refresh_interval") == "30s"
+    with _scheme_window(c, spec, rnd):
+        n = _any_node(c, rnd)
+        n.indices_service.update_settings("m_set", {
+            "index.refresh_interval": "30s"})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        ok = all(
+            node.cluster_service.state().indices["m_set"].settings.get(
+                "index.refresh_interval") == "30s"
+            for node in c.nodes if node._started)
+        if ok:
+            return
+        time.sleep(0.1)
+    raise AssertionError("settings never converged on all nodes")
+
+
+def _scenario_recovery_during_relocation(c, rnd, spec):
+    """Combination: kill a replica holder (forcing a replica re-recovery
+    through the replacement) WHILE the primary of the same shard is
+    relocating — the replica's recovery source moves under it. Recovery
+    traffic is additionally delayed so the two recoveries overlap. The
+    healed cluster must converge green with exact counts."""
+    from elasticsearch_tpu.testing_disruption import ActionDelay
+    a = c.master()
+    a.indices_service.create_index("m_rdr", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 1}})
+    _green(a)
+    n_docs = rnd.randint(20, 50)
+    for i in range(n_docs):
+        a.index_doc("m_rdr", str(i), {"n": i})
+    src = c.primary_node("m_rdr", 0)
+    st = c.master().cluster_service.state()
+    replica = next(s for s in st.routing_table.shard_copies("m_rdr", 0)
+                   if not s.primary and s.assigned)
+    victim = next(n for n in c.nodes if n.node_id == replica.node_id)
+    others = [n for n in c.nodes
+              if n is not src and n is not victim and n._started]
+    dst = others[rnd.randrange(len(others))]
+    slow_recovery = ActionDelay(
+        [src], 0.05, ("internal:index/shard/recovery",))
+    slow_recovery.start_disrupting()
+    try:
+        c.stop_node(victim, graceful=False)
+        a2 = c.master()
+        a2.cluster_reroute([{"move": {
+            "index": "m_rdr", "shard": 0,
+            "from_node": src.node_id, "to_node": dst.node_id}}])
+        extra = rnd.randint(5, 15)
+        for i in range(extra):
+            _any_node(c, rnd).index_doc("m_rdr", f"live-{i}", {"n": i})
+        c.add_node()                    # replacement hosts the new replica
+    finally:
+        slow_recovery.stop_disrupting()
+    _wait_nodes_green(c, timeout=60)
+    m = c.master()
+    m.broadcast_actions.refresh("m_rdr")
+    assert m.search("m_rdr", {"size": 0})["hits"]["total"] \
+        == n_docs + extra
+
+
+def _scenario_snapshot_during_churn(c, rnd, spec):
+    """Combination: a snapshot runs WHILE the cluster churns (node joins,
+    a member retires). The snapshot must complete — SUCCESS or an honest
+    PARTIAL, never a wedge — and the cluster must converge green; a
+    SUCCESS snapshot must then restore with exact counts."""
+    import shutil
+    import tempfile
+    a = c.master()
+    a.indices_service.create_index("m_sdc", {"settings": {
+        "number_of_shards": rnd.randint(2, 3),
+        "number_of_replicas": min(1, len(c.nodes) - 1)}})
+    _green(a)
+    n_docs = rnd.randint(30, 60)
+    for i in range(n_docs):
+        a.index_doc("m_sdc", str(i), {"n": i})
+    a.broadcast_actions.refresh("m_sdc")
+    loc = tempfile.mkdtemp(prefix="m-sdc-repo-")
+    out: dict = {}
+    err: list = []
+
+    def snapshotter():
+        try:
+            out.update(a.snapshots_service.create_snapshot(
+                "m_churn_bk", "s1", {"indices": ["m_sdc"]}))
+        except Exception as e:           # noqa: BLE001 — surfaced below
+            err.append(e)
+
+    try:
+        a.snapshots_service.put_repository(
+            "m_churn_bk", {"type": "fs", "settings": {"location": loc}})
+        t = threading.Thread(target=snapshotter, daemon=True)
+        t.start()
+        c.add_node()
+        victims = [n for n in c.non_masters() if n is not a]
+        if victims:
+            c.stop_node(victims[rnd.randrange(len(victims))],
+                        graceful=True)
+        t.join(90)
+        assert not t.is_alive(), "snapshot wedged during churn"
+        assert not err, f"snapshot raised: {err}"
+        state = out["snapshot"]["state"]
+        assert state in ("SUCCESS", "PARTIAL"), out
+        _wait_nodes_green(c, timeout=60)
+        if state == "SUCCESS":
+            a2 = c.master()
+            a2.indices_service.delete_index("m_sdc")
+            a2.snapshots_service.restore_snapshot("m_churn_bk", "s1")
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    if a2.search("m_sdc", {"size": 0})["hits"]["total"] \
+                            == n_docs:
+                        break
+                except Exception:        # noqa: BLE001 — restore running
+                    pass
+                time.sleep(0.2)
+            assert a2.search("m_sdc", {"size": 0})["hits"]["total"] \
+                == n_docs
+    finally:
+        shutil.rmtree(loc, ignore_errors=True)
+
+
+def _scenario_master_failover_during_bulk(c, rnd, spec):
+    """Combination: kill the elected master (non-graceful) while bulk
+    writes stream in from every node. Survivors re-elect, writes keep
+    flowing, and EVERY acked document survives the failover."""
+    a = c.master()
+    a.indices_service.create_index("m_mfb", {"settings": {
+        "number_of_shards": rnd.randint(1, 3),
+        "number_of_replicas": 1}})
+    _green(a)
+    acked: set[str] = set()
+    acked_lock = threading.Lock()
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set() and i < 300:
+            live = [n for n in c.nodes if n._started]
+            node = live[i % len(live)]
+            did = f"d{i}"
+            try:
+                r = node.bulk([("index", {"_index": "m_mfb", "_id": did},
+                                {"n": i})])
+                if not r["errors"]:
+                    with acked_lock:
+                        acked.add(did)
+            except Exception:            # noqa: BLE001 — mid-election
+                pass
+            i += 1
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    time.sleep(0.4)                      # let writes flow pre-failover
+    master = c.master()
+    c.stop_node(master, graceful=False)
+    deadline = time.monotonic() + 30     # survivors re-elect
+    while time.monotonic() < deadline:
+        try:
+            m = c.master()
+            if len(m.cluster_service.state().nodes) == len(c.nodes):
+                break
+        except RuntimeError:
+            pass
+        time.sleep(0.2)
+    else:
+        raise AssertionError("no post-failover master emerged")
+    time.sleep(0.5)                      # writes continue under new master
+    stop.set()
+    t.join(30)
+    assert not t.is_alive(), "writer wedged across the failover"
+    c.add_node()                         # restore the drawn shape
+    _wait_nodes_green(c, timeout=60)
+    m = c.master()
+    assert acked, "no write was ever acked"
+    # a replica that missed an op while its failure report raced the
+    # master kill keeps serving until the re-sent report lands and it
+    # re-recovers — reads converge within seconds, so poll before
+    # declaring an acked doc lost
+    deadline = time.monotonic() + 20
+    missing: list[str] = []
+    while time.monotonic() < deadline:
+        m = c.master()
+        m.broadcast_actions.refresh("m_mfb")
+        missing = [d for d in sorted(acked)
+                   if not m.get_doc("m_mfb", d)["found"]]
+        if not missing:
+            break
+        time.sleep(0.5)
+    if missing:
+        # forensics: which node-local engines actually hold the doc vs
+        # what the routing table claims
+        st = c.master().cluster_service.state()
+        lines = [f"routing: {[s.to_dict() for s in st.routing_table.shards if s.index == 'm_mfb']}"]
+        for n in c.nodes:
+            if not n._started:
+                continue
+            svc = n.indices_service.indices.get("m_mfb")
+            held = {}
+            if svc is not None:
+                for sid, e in svc.engines.items():
+                    held[sid] = [d for d in missing
+                                 if e.get(d).found]
+            lines.append(f"{n.node_name}: engines hold {held}")
+        raise AssertionError(
+            f"acked docs lost across failover: {missing[:5]}\n"
+            + "\n".join(lines))
+
+
+def _scenario_disk_fault_failover(c, rnd, spec):
+    """Disk faults on the primary's node (translog/store IO errors): the
+    engine must self-fail → shard-failed → replica promoted; after the
+    fault heals the cluster converges back to green with every acked doc
+    intact."""
+    from elasticsearch_tpu.testing_disruption import DiskFaultScheme
+    a = c.master()
+    a.indices_service.create_index("m_dff", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 1}})
+    _green(a)
+    n_docs = rnd.randint(15, 40)
+    for i in range(n_docs):
+        a.index_doc("m_dff", str(i), {"n": i})
+    victim = c.primary_node("m_dff", 0)
+    coordinator = next(n for n in c.nodes
+                       if n is not victim and n._started)
+    scheme = DiskFaultScheme(victim, index="m_dff",
+                             short_writes=rnd.random() < 0.5,
+                             seed=rnd.randrange(2 ** 31))
+    scheme.start_disrupting()
+    try:
+        # the write routed to the faulty primary must succeed anyway:
+        # engine self-fails, the replica is promoted, the coordinator's
+        # retry lands on the new primary
+        out = coordinator.index_doc("m_dff", "during-fault", {"n": -1})
+        assert out["_version"] >= 1
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            st = c.master().cluster_service.state()
+            pr = st.routing_table.primary("m_dff", 0)
+            if pr is not None and pr.node_id != victim.node_id and \
+                    pr.state == "STARTED":
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("primary never failed over off the "
+                                 "faulty disk")
+    finally:
+        scheme.stop_disrupting()
+    _wait_nodes_green(c, timeout=60)
+    m = c.master()
+    m.broadcast_actions.refresh("m_dff")
+    assert m.search("m_dff", {"size": 0})["hits"]["total"] == n_docs + 1
+    assert m.get_doc("m_dff", "during-fault")["found"]
