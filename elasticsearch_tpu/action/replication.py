@@ -176,9 +176,15 @@ class DocumentActions:
         lives here, otherwise forward; retry once per routing change when
         the target turns out stale."""
         from elasticsearch_tpu.indices.service import ShardNotLocalError
+        from elasticsearch_tpu.tasks import raise_if_cancelled
         deadline = time.monotonic() + self.PRIMARY_TIMEOUT
         last: Exception | None = None
         while time.monotonic() < deadline:
+            # cooperative cancellation checkpoint BEFORE the primary
+            # applies: once the op lands on the primary it must also
+            # reach the replicas (cancelling between would silently
+            # diverge copies), so the shed point is the attempt boundary
+            raise_if_cancelled()
             pr = self._await_primary(name, shard)
             if pr.node_id == self.node.node_id:
                 try:

@@ -24,6 +24,7 @@ and die on clear_scroll.
 from __future__ import annotations
 
 import base64
+import contextlib
 import itertools
 import json
 import threading
@@ -33,11 +34,13 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from elasticsearch_tpu.common.errors import (
-    ElasticsearchTpuError, QueryParsingError, SearchContextMissingError)
+    ElasticsearchTpuError, QueryParsingError, SearchContextMissingError,
+    TaskCancelledError)
 from elasticsearch_tpu.common.settings import parse_time_value
 from elasticsearch_tpu.index.device_reader import device_reader_for
 from elasticsearch_tpu.search.controller import merge_shard_payloads
 from elasticsearch_tpu.search.phase import ShardSearcher, parse_search_request
+from elasticsearch_tpu.tasks import manager as tasks
 
 
 def wire_safe(obj):
@@ -305,6 +308,10 @@ class SearchActions:
         self.node = node
         self._pool = ThreadPoolExecutor(max_workers=16,
                                         thread_name_prefix="search")
+        # test seam: hold shard execution at a cancellation checkpoint
+        # for this many seconds (chaos tests keep a shard task RUNNING
+        # while they cancel it / kill its coordinator)
+        self.shard_query_delay: float | None = None
         self._rotation = itertools.count()
         self._contexts: dict[str, _ScrollContext] = {}
         self._ctx_ids = itertools.count(1)
@@ -347,6 +354,35 @@ class SearchActions:
                                         name="scroll-reaper")
         self._reaper.start()
 
+    def _submit(self, fn, *args):
+        """Fan-out submit that carries the coordinating task across the
+        pool boundary, so shard RPCs sent from pool threads stamp the
+        parent-task header (TaskManager wiring)."""
+        return self._pool.submit(tasks.bind_current(fn), *args)
+
+    def _task_manager(self):
+        return getattr(self.node, "task_manager", None)
+
+    @contextlib.contextmanager
+    def _coordinating_task(self, action: str, description: str,
+                           timeout_ms: float | None = None):
+        """Register the coordinator-side task for a client-entry search
+        action, make it current for the duration, and wire the request
+        `timeout` through the task's deadline. Yields the task (None
+        when the node has no TaskManager — standalone unit tests)."""
+        tm = self._task_manager()
+        if tm is None:
+            yield None
+            return
+        task = tm.register(action, description=description)
+        if timeout_ms is not None:
+            task.deadline = time.monotonic() + timeout_ms / 1000.0
+        try:
+            with tasks.use_task(task):
+                yield task
+        finally:
+            tm.unregister(task)
+
     def _reap_loop(self) -> None:
         while not self._closed:
             time.sleep(5.0)
@@ -361,22 +397,52 @@ class SearchActions:
 
     # ---- data-node side ----------------------------------------------------
 
+    @staticmethod
+    def _apply_budget(req, budget_ms) -> None:
+        """Shard-side deadline wiring: the coordinator ships the
+        REMAINING time budget (its `timeout` minus wall time already
+        spent queueing and fanning out), which tightens both the parsed
+        request's timeout and the executing task's deadline — so
+        per-shard ``timed_out`` reflects elapsed time on the whole
+        request, not a clock restarted per shard."""
+        if budget_ms is None:
+            return
+        budget_ms = max(float(budget_ms), 1.0)
+        if req.timeout_ms is None or budget_ms < req.timeout_ms:
+            req.timeout_ms = budget_ms
+        cur = tasks.current_task()
+        if cur is not None:
+            dl = time.monotonic() + budget_ms / 1000.0
+            cur.deadline = dl if cur.deadline is None \
+                else min(cur.deadline, dl)
+
+    def _hold_for_test(self) -> None:
+        """Cancellation-checkpointed hold (see ``shard_query_delay``)."""
+        delay = self.shard_query_delay
+        if not delay:
+            return
+        deadline = time.monotonic() + float(delay)
+        while time.monotonic() < deadline:
+            tasks.raise_if_cancelled()
+            time.sleep(0.005)
+
     def _handle_shard_query(self, request: dict, source) -> dict:
         return self._execute_shard(request["index"], request["shard"],
                                    request["body"],
                                    doc_slot=request.get("doc_slot"),
                                    dfs=request.get("dfs"),
-                                   scroll_pin=request.get("scroll_pin"))
+                                   scroll_pin=request.get("scroll_pin"),
+                                   budget_ms=request.get("budget_ms"))
 
     def _handle_shard_query_only(self, request: dict, source) -> dict:
         return self._execute_shard_query(
             request["index"], request["shard"], request["body"],
             doc_slot=request.get("doc_slot"), dfs=request.get("dfs"),
-            pin=request["pin"])
+            pin=request["pin"], budget_ms=request.get("budget_ms"))
 
     def _execute_shard_query(self, name: str, shard: int, body: dict,
                              doc_slot: int | None, dfs: dict | None,
-                             pin: dict) -> dict:
+                             pin: dict, budget_ms=None) -> dict:
         """Query phase only (QueryPhase.execute without fetch): rank this
         shard's top from+size and return compact hit DESCRIPTORS — ids,
         scores, sort keys — never `_source`. The reader pins under the
@@ -400,6 +466,8 @@ class SearchActions:
                                      dfs_stats=to_execution_stats(dfs),
                                      version_fn=engine.doc_version)
             req = parse_search_request(body)
+            self._apply_budget(req, budget_ms)
+            self._hold_for_test()
             result = searcher.query_phase(req)
             q_ms = (time.perf_counter() - t0) * 1000.0
             svc.note_search(body.get("stats"), q_ms)
@@ -554,7 +622,8 @@ class SearchActions:
     def _execute_shard(self, name: str, shard: int, body: dict,
                        doc_slot: int | None = None,
                        dfs: dict | None = None,
-                       scroll_pin: dict | None = None) -> dict:
+                       scroll_pin: dict | None = None,
+                       budget_ms=None) -> dict:
         t0 = time.perf_counter()
         svc = self.node.indices_service.index(name)
         engine = svc.engine(shard)
@@ -592,6 +661,8 @@ class SearchActions:
                                      dfs_stats=to_execution_stats(dfs),
                                      version_fn=engine.doc_version)
             req = parse_search_request(body)
+            self._apply_budget(req, budget_ms)
+            self._hold_for_test()
             result = searcher.query_phase(req)
             q_ms = (time.perf_counter() - t0) * 1000.0
             k = min(len(result.doc_ids), req.from_ + req.size)
@@ -710,16 +781,24 @@ class SearchActions:
                    body: dict, doc_slot: int | None = None,
                    dfs: dict | None = None,
                    scroll_pin: dict | None = None,
-                   qtf_pin: dict | None = None):
+                   qtf_pin: dict | None = None,
+                   budget_deadline: float | None = None):
         """→ ("ok", payload, node_id) or ("fail", reason-dict, None).
         Walks the copy list (shard-failover retry,
         TransportSearchTypeAction.java:205-247). With `qtf_pin`, runs the
         query-ONLY phase (descriptors, reader pinned) instead of
         query+fetch; the returned node_id tells the coordinator where the
-        pin — and thus the fetch round — lives."""
+        pin — and thus the fetch round — lives. ``budget_deadline`` is
+        the request's absolute perf_counter deadline: the shard receives
+        only the REMAINING milliseconds, so its ``timed_out`` reflects
+        total elapsed time."""
         from elasticsearch_tpu.action.replication import unwrap_remote
         from elasticsearch_tpu.common.errors import (
             IllegalArgumentError, MapperParsingError, QueryParsingError)
+        budget_ms = None
+        if budget_deadline is not None:
+            budget_ms = max(
+                (budget_deadline - time.perf_counter()) * 1000.0, 1.0)
         last: Exception | None = None
         for c in copies:
             try:
@@ -732,12 +811,12 @@ class SearchActions:
                     if qtf_pin is not None:
                         fut = self.node.thread_pool.submit(
                             "search", self._execute_shard_query, name, sid,
-                            body, doc_slot, dfs, qtf_pin)
+                            body, doc_slot, dfs, qtf_pin, budget_ms)
                     else:
                         fut = self.node.thread_pool.submit(
                             "search", self._execute_shard, name, sid, body,
                             doc_slot=doc_slot, dfs=dfs,
-                            scroll_pin=scroll_pin)
+                            scroll_pin=scroll_pin, budget_ms=budget_ms)
                     try:
                         return "ok", fut.result(35.0), c.node_id
                     except Exception:
@@ -750,17 +829,25 @@ class SearchActions:
                     action = self.QUERY_ID
                     request = {"index": name, "shard": sid, "body": body,
                                "doc_slot": doc_slot, "dfs": dfs,
-                               "pin": qtf_pin}
+                               "pin": qtf_pin, "budget_ms": budget_ms}
                 else:
                     action = self.QUERY_FETCH
                     request = {"index": name, "shard": sid, "body": body,
                                "doc_slot": doc_slot, "dfs": dfs,
-                               "scroll_pin": scroll_pin}
+                               "scroll_pin": scroll_pin,
+                               "budget_ms": budget_ms}
                 return "ok", self.node.transport_service.send_request(
                     target, action, request,
                     timeout=30.0).result(35.0), c.node_id
             except Exception as e:               # noqa: BLE001 — classify
                 e = unwrap_remote(e)
+                if isinstance(e, TaskCancelledError):
+                    # a cancelled shard task must NOT fail over — re-running
+                    # a shed query on the next copy defeats the cancel; the
+                    # shard reports task_cancelled and the response stays
+                    # partial
+                    last = e
+                    break
                 # Deterministic request errors fail the same way on every
                 # copy — abort the whole search with the real status.
                 # Anything else (engine closed mid-relocation, node gone,
@@ -791,6 +878,35 @@ class SearchActions:
                search_type: str | None = None,
                routing: str | None = None,
                preference: str | None = None) -> dict:
+        """Client entry: registers the COORDINATING task (the root of the
+        fan-out's task tree), wires the request `timeout` through its
+        deadline, and — when the task was cancelled mid-flight — reports
+        the partial response with an explicit ``cancelled`` flag."""
+        timeout_ms = None
+        raw_timeout = (body or {}).get("timeout")
+        if raw_timeout is not None:
+            try:
+                timeout_ms = parse_time_value(raw_timeout,
+                                              "timeout") * 1000.0
+            except (ValueError, TypeError):
+                pass                     # parse_search_request re-raises
+        with self._coordinating_task(
+                "indices:data/read/search",
+                f"indices[{index_expr}], search_type[{search_type or '-'}]"
+                f"{', scroll' if scroll else ''}",
+                timeout_ms=timeout_ms) as task:
+            resp = self._search(index_expr, body, scroll=scroll,
+                                search_type=search_type, routing=routing,
+                                preference=preference)
+            if task is not None and task.cancelled:
+                resp["cancelled"] = True
+            return resp
+
+    def _search(self, index_expr: str, body: dict | None = None,
+                scroll: str | None = None,
+                search_type: str | None = None,
+                routing: str | None = None,
+                preference: str | None = None) -> dict:
         from elasticsearch_tpu.common.errors import IllegalArgumentError
         if search_type not in self.SEARCH_TYPES:
             raise IllegalArgumentError(
@@ -1049,7 +1165,7 @@ class SearchActions:
         aggregateDfs SearchPhaseController.java:105): gather each shard's
         term/collection statistics, reduce to global stats."""
         from elasticsearch_tpu.search.dfs import aggregate_dfs
-        futures = [self._pool.submit(
+        futures = [self._submit(
             self._try_shard_action, state, n, s, copies, self.DFS,
             self._handle_shard_dfs, body) for n, s, copies in groups]
         results = []
@@ -1117,11 +1233,18 @@ class SearchActions:
             search_type in ("query_then_fetch", "dfs_query_then_fetch")
             or (search_type is None
                 and req.from_ + req.size >= self.QTF_WINDOW_THRESHOLD))
+        # the request's absolute deadline: shards get the REMAINING
+        # budget at dispatch, so queue/fan-out time counts against the
+        # timeout (wired through the task's deadline on the shard side)
+        deadline_at = None if req.timeout_ms is None \
+            else t0 + req.timeout_ms / 1000.0
         if use_qtf:
             return self._query_then_fetch(state, groups, body, req, t0,
-                                          slot_of, dfs)
-        futures = [self._pool.submit(self._try_shard, state, n, s, copies,
-                                     body, slot_of[(n, s)], dfs, scroll_pin)
+                                          slot_of, dfs, deadline_at)
+        q_t0 = time.perf_counter()
+        futures = [self._submit(self._try_shard, state, n, s, copies,
+                                body, slot_of[(n, s)], dfs, scroll_pin,
+                                None, deadline_at)
                    for n, s, copies in groups]
         payloads, failures = [], []
         for fut in futures:
@@ -1130,20 +1253,36 @@ class SearchActions:
                 payloads.append(payload)
             else:
                 failures.append(payload)
-        return merge_shard_payloads(
+        q_ms = (time.perf_counter() - q_t0) * 1e3
+        r_t0 = time.perf_counter()
+        resp = merge_shard_payloads(
             req, payloads, (time.perf_counter() - t0) * 1e3,
             total_shards=len(groups), failures=failures)
+        from elasticsearch_tpu.search.controller import attach_phase_took
+        attach_phase_took(
+            resp, {"query": q_ms,
+                   "reduce": (time.perf_counter() - r_t0) * 1e3},
+            tasks.current_task())
+        if deadline_at is not None and time.perf_counter() > deadline_at:
+            # elapsed-time truth at the coordinator too: a request that
+            # blew its budget in fan-out/queueing is timed out even if
+            # no shard individually noticed (controller.py:104 only
+            # aggregates per-shard flags)
+            resp["timed_out"] = True
+        return resp
 
     def _query_then_fetch(self, state, groups, body: dict, req, t0: float,
-                          slot_of: dict, dfs: dict | None) -> dict:
+                          slot_of: dict, dfs: dict | None,
+                          budget_deadline: float | None = None) -> dict:
         """Two-round distributed search: query (descriptors only) →
         coordinator merge → winner-only fetch → assemble."""
         import uuid as _uuid
         from elasticsearch_tpu.search.controller import _hit_comparator
         pin = {"uid": _uuid.uuid4().hex, "keep_s": 30.0}
-        futures = [self._pool.submit(self._try_shard, state, n, s, copies,
-                                     body, slot_of[(n, s)], dfs,
-                                     None, pin)
+        q_t0 = time.perf_counter()
+        futures = [self._submit(self._try_shard, state, n, s, copies,
+                                body, slot_of[(n, s)], dfs,
+                                None, pin, budget_deadline)
                    for n, s, copies in groups]
         qpayloads, failures = [], []   # (payload, node_id, name, sid, slot)
         for (n, s, _), fut in zip(groups, futures):
@@ -1152,6 +1291,8 @@ class SearchActions:
                 qpayloads.append((payload, node_id, n, s, slot_of[(n, s)]))
             else:
                 failures.append(payload)
+        q_ms = (time.perf_counter() - q_t0) * 1e3
+        fetch_ms = 0.0
         try:
             # sortDocs over descriptors → the global [from, from+size)
             entries = []
@@ -1169,6 +1310,7 @@ class SearchActions:
             by_shard: dict[int, list[int]] = {}
             for e in page:
                 by_shard.setdefault(e[2], []).append(e[3])
+            f_t0 = time.perf_counter()
             fetch_futs = {}
             for si, positions in by_shard.items():
                 p, node_id, name, sid, slot = qpayloads[si]
@@ -1208,17 +1350,28 @@ class SearchActions:
                         "shard": sid, "index": name,
                         "reason": {"type": "fetch_phase_failure",
                                    "reason": str(e)}})
+            fetch_ms = (time.perf_counter() - f_t0) * 1e3
             hits_out = [fetched[(e[2], e[3])] for e in page
                         if (e[2], e[3]) in fetched]
         finally:
             self._free_context(pin["uid"],
                                [nid for _, nid, *_ in qpayloads])
-        from elasticsearch_tpu.search.controller import assemble_response
+        from elasticsearch_tpu.search.controller import (
+            assemble_response, attach_phase_took)
+        r_t0 = time.perf_counter()
         payloads = [p for p, *_ in qpayloads]
-        return assemble_response(
+        resp = assemble_response(
             req, payloads, hits_out, (time.perf_counter() - t0) * 1e3,
             total_shards=len(groups), failures=failures,
             successful=len(qpayloads) - len(fetch_failed))
+        attach_phase_took(
+            resp, {"query": q_ms, "fetch": fetch_ms,
+                   "reduce": (time.perf_counter() - r_t0) * 1e3},
+            tasks.current_task())
+        if budget_deadline is not None and \
+                time.perf_counter() > budget_deadline:
+            resp["timed_out"] = True
+        return resp
 
     def count(self, index_expr: str, body: dict | None = None,
               routing: str | None = None,
@@ -1253,9 +1406,17 @@ class SearchActions:
                 groups[-1][2].append(i)
             else:
                 groups.append((index_expr, stype, [i]))
-        futures = [self._msearch_pool.submit(
-            self._msearch_group, expr, [items[i][1] for i in idxs],
-            stype) for expr, stype, idxs in groups]
+        with self._coordinating_task(
+                "indices:data/read/msearch",
+                f"requests[{len(items)}]"):
+            futures = [self._msearch_pool.submit(
+                tasks.bind_current(self._msearch_group), expr,
+                [items[i][1] for i in idxs],
+                stype) for expr, stype, idxs in groups]
+            return self._collect_msearch(groups, futures, responses)
+
+    @staticmethod
+    def _collect_msearch(groups, futures, responses) -> dict:
         for (expr, stype, idxs), fut in zip(groups, futures):
             try:
                 outs = fut.result()
@@ -1312,9 +1473,9 @@ class SearchActions:
             # same-pool nesting deadlocks under saturation
             from concurrent.futures import ThreadPoolExecutor as _TPE
             with _TPE(max_workers=min(len(valid), 4)) as pool:
-                futs = {i: pool.submit(self._search_once, index_expr,
-                                       bodies[i], t0,
-                                       "dfs_query_then_fetch")
+                futs = {i: pool.submit(
+                    tasks.bind_current(self._search_once), index_expr,
+                    bodies[i], t0, "dfs_query_then_fetch")
                         for i in valid}
                 for i in valid:
                     outs[i] = futs[i].result()
@@ -1323,10 +1484,10 @@ class SearchActions:
         groups = self._shard_groups(state, names)
         slot_of = {(n, s): i for i, (n, s) in
                    enumerate(sorted((n, s) for n, s, _ in groups))}
-        futures = [self._pool.submit(
+        futures = [self._submit(
             self._try_shard_action, state, n, s, copies, self.MSEARCH_SHARD,
             self._handle_shard_msearch, None,
-            extra={"bodies": send_bodies, "doc_slot": slot_of[(n, s)]})
+            {"bodies": send_bodies, "doc_slot": slot_of[(n, s)]})
             for n, s, copies in groups]
         per_shard, group_failures = [], []
         for (n, s, _copies), fut in zip(groups, futures):
@@ -1378,7 +1539,7 @@ class SearchActions:
             if f not in fetch:
                 fetch.append(f)
         body = {"fields": fetch}
-        futures = [self._pool.submit(
+        futures = [self._submit(
             self._try_shard_action, state, n, s, copies, self.FIELD_STATS,
             self._handle_field_stats, body) for n, s, copies in groups]
         buckets: dict[str, dict[str, dict]] = {}
@@ -1652,6 +1813,15 @@ class SearchActions:
         ctx.last_sort_key = hits[-1].get("sort")
 
     def scroll(self, scroll_id: str, scroll: str | None = None) -> dict:
+        with self._coordinating_task("indices:data/read/scroll",
+                                     "scroll page") as task:
+            resp = self._scroll_page(scroll_id, scroll)
+            if task is not None and task.cancelled:
+                resp["cancelled"] = True
+            return resp
+
+    def _scroll_page(self, scroll_id: str,
+                     scroll: str | None = None) -> dict:
         try:
             cid = json.loads(base64.b64decode(scroll_id))["id"]
         except Exception:                        # noqa: BLE001 — bad id

@@ -68,6 +68,11 @@ class CircuitBreaker:
                 self.used -= bytes_
                 self.trip_count += 1
             raise
+        # per-task accounting: attribute the reservation to whatever
+        # task this thread is serving (TaskManager wiring) — cumulative,
+        # so a runaway query's scratch demand is visible in /_tasks
+        from elasticsearch_tpu.tasks import note_breaker_bytes
+        note_breaker_bytes(bytes_)
 
     def release(self, bytes_: int) -> None:
         with self._lock:

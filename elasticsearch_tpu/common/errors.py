@@ -136,6 +136,16 @@ class SearchContextMissingError(ElasticsearchTpuError):
     error_type = "search_context_missing_exception"
 
 
+class TaskCancelledError(ElasticsearchTpuError):
+    """A cancellable task observed its cancellation flag at a checkpoint
+    (reference: TaskCancelledException, core/tasks/ — cooperative
+    cancellation; crosses the transport by class name so the coordinator
+    sees the child's cancellation as what it is, not a generic 500)."""
+
+    status = 400
+    error_type = "task_cancelled_exception"
+
+
 class CircuitBreakingError(ElasticsearchTpuError):
     """Memory circuit breaker tripped (reference:
     core/common/breaker/CircuitBreakingException.java)."""

@@ -19,9 +19,11 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from concurrent.futures import Future
 
 from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+from elasticsearch_tpu.tasks import manager as _tasks
 
 
 class EsRejectedExecutionError(ElasticsearchTpuError):
@@ -48,6 +50,7 @@ class FixedThreadPool:
         self.active = 0
         self.completed = 0
         self.rejected = 0
+        self.queue_wait_ns = 0                 # cumulative queue latency
         self._closed = False
         self._threads = [
             threading.Thread(target=self._worker, daemon=True,
@@ -62,12 +65,17 @@ class FixedThreadPool:
         enqueue share the lock with shutdown's drain, so no item can slip
         in behind the poison pills and hang its caller forever."""
         fut: Future = Future()
+        # carry the submitter's task across the thread boundary (the
+        # ThreadContext.preserveContext analog) and stamp the enqueue
+        # time so queue latency is attributable to that task
+        item = (fut, fn, args, kwargs, _tasks.current_task(),
+                time.monotonic_ns())
         with self._lock:
             if self._closed:
                 raise EsRejectedExecutionError(
                     f"rejected execution on [{self.name}] (pool closed)")
             try:
-                self._q.put_nowait((fut, fn, args, kwargs))
+                self._q.put_nowait(item)
             except queue.Full:
                 self.rejected += 1
                 raise EsRejectedExecutionError(
@@ -81,13 +89,18 @@ class FixedThreadPool:
             item = self._q.get()
             if item is _POISON:
                 return
-            fut, fn, args, kwargs = item
+            fut, fn, args, kwargs, task, enq_ns = item
             if not fut.set_running_or_notify_cancel():
                 continue
+            waited = time.monotonic_ns() - enq_ns
+            if task is not None:
+                task.queue_ns += waited
             with self._lock:
                 self.active += 1
+                self.queue_wait_ns += waited
             try:
-                fut.set_result(fn(*args, **kwargs))
+                with _tasks.use_task(task):
+                    fut.set_result(fn(*args, **kwargs))
             except BaseException as e:         # noqa: BLE001 — to the future
                 fut.set_exception(e)
             finally:
@@ -102,7 +115,8 @@ class FixedThreadPool:
                     "queue_size": self.queue_size,
                     "active": self.active,
                     "rejected": self.rejected,
-                    "completed": self.completed}
+                    "completed": self.completed,
+                    "queue_wait_in_millis": self.queue_wait_ns // 1_000_000}
 
     def shutdown(self) -> None:
         with self._lock:

@@ -42,9 +42,16 @@ class SlowLog:
 
     def maybe_log(self, took_s: float, message: str) -> str | None:
         """Log at the highest level whose threshold `took_s` exceeds;
-        → the level name logged at (for tests), or None."""
+        → the level name logged at (for tests), or None. Lines carry the
+        executing task id and its parent/trace id (TaskManager wiring)
+        so a slow shard query joins back to its coordinating request."""
         for threshold, level, name in self.thresholds:
             if took_s >= threshold:
+                from elasticsearch_tpu.tasks import current_task
+                task = current_task()
+                if task is not None:
+                    message = (f"{message}, task[{task.task_id}], "
+                               f"parent[{task.parent_task_id or '-'}]")
                 self.logger.log(
                     level, "[%s] took[%.1fms], %s",
                     self.index_name, took_s * 1000.0, message)

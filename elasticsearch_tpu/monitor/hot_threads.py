@@ -43,11 +43,17 @@ def hot_threads(snapshots: int = 10, interval: float = 0.05,
                     key=lambda kv: -kv[1].most_common(1)[0][1])
     lines = [f"::: hot threads: {snapshots} samples, "
              f"{interval * 1000:.0f}ms interval"]
+    from elasticsearch_tpu.tasks import task_of_thread
     for tid, counter in ranked[:threads]:
         key, hits = counter.most_common(1)[0]
         pct = 100.0 * hits / snapshots
+        # the task this thread is serving (TaskManager wiring): joins a
+        # hot stack back to the request that caused it
+        task = task_of_thread(tid)
+        task_note = f" task[{task.task_id}]{{{task.action}}}" \
+            if task is not None else ""
         lines.append(f"\n   {pct:.1f}% ({hits}/{snapshots} snapshots) "
-                     f"'{names.get(tid, tid)}'")
+                     f"'{names.get(tid, tid)}'{task_note}")
         for frame_line in stacks.get((tid, key), []):
             lines.append(f"     {frame_line}")
     return "\n".join(lines) + "\n"

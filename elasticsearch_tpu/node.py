@@ -101,11 +101,35 @@ class Node:
             lambda addr: DiscoveryNode(self.node_id, self.node_name, addr,
                                        attributes=attrs, build=_build),
             thread_pool=self.thread_pool)
+        # task registry (core/tasks/TaskManager.java): every inbound RPC
+        # and every locally-spawned action registers under a
+        # cluster-unique "node:seq" id; wired into the transport so the
+        # parent link propagates on every outgoing request
+        from elasticsearch_tpu.tasks import TaskManager
+        self.task_manager = TaskManager(self.node_id, self.node_name)
+        self.transport_service.task_manager = self.task_manager
+        self.task_manager.ban_broadcaster = self._broadcast_task_ban
+        self.transport_service.register_request_handler(
+            self.TASKS_LIST_ACTION, self._handle_tasks_list,
+            executor="management", sync=True)
+        self.transport_service.register_request_handler(
+            self.TASK_CANCEL_ACTION, self._handle_task_cancel,
+            executor="management", sync=True)
+        # bans apply inline on the delivery thread ("same"): a cancel
+        # must land even when the management pool is saturated by the
+        # very work being cancelled
+        self.transport_service.register_request_handler(
+            self.TASK_BAN_ACTION, self._handle_task_ban,
+            executor="same", sync=True)
         self.allocation = AllocationService()
         cluster_name = self.settings.get("cluster.name", "elasticsearch-tpu")
         self.cluster_service = ClusterService(
             ClusterState(cluster_name=cluster_name), self.node_id)
         self.cluster_service.add_listener(self._persist_state)
+        # orphan reaping: when a node leaves the cluster, every task
+        # parented on it is cancelled (its coordinator can neither
+        # collect nor cancel it anymore) and its bans are dropped
+        self.cluster_service.add_listener(self._reap_tasks_on_node_left)
         from elasticsearch_tpu.indices.service import IndicesService
         from elasticsearch_tpu.common.breaker import (
             HierarchyCircuitBreakerService)
@@ -678,6 +702,106 @@ class Node:
             priority=URGENT).result(10.0)
         return {}
 
+    # ---- task management (core/tasks/, TransportListTasksAction etc.) ------
+
+    TASKS_LIST_ACTION = "cluster:monitor/tasks/lists[n]"
+    TASK_CANCEL_ACTION = "cluster:admin/tasks/cancel"
+    TASK_BAN_ACTION = "internal:admin/tasks/ban"
+
+    def _handle_tasks_list(self, request: dict, source) -> dict:
+        request = request or {}
+        return {
+            "name": self.node_name,
+            "transport_address":
+                str(self.transport_service.local_node.address),
+            "tasks": self.task_manager.list_tasks(
+                actions=request.get("actions"),
+                parent_task_id=request.get("parent_task_id"),
+                detailed=request.get("detailed", True))}
+
+    def collect_tasks(self, actions: list[str] | None = None,
+                      parent_task_id: str | None = None,
+                      nodes: list[str] | None = None,
+                      detailed: bool = True) -> dict:
+        """GET /_tasks — every node's matching tasks, collected over the
+        transport (TransportListTasksAction fan-out)."""
+        per_node = self._fan_out_nodes(
+            self.TASKS_LIST_ACTION,
+            {"actions": actions, "parent_task_id": parent_task_id,
+             "detailed": detailed})
+        if nodes:
+            wanted = set(nodes)
+            per_node = {nid: doc for nid, doc in per_node.items()
+                        if nid in wanted or doc.get("name") in wanted}
+        return {"nodes": per_node}
+
+    def cancel_task(self, task_id: str,
+                    reason: str = "by user request") -> dict:
+        """POST /_tasks/{id}/_cancel — routed to the task's OWNER node
+        (the id's node part); the owner marks the task and its local
+        descendants cancelled and broadcasts a ban on the id so children
+        on every other node — current and future — cancel too."""
+        owner, _, _ = str(task_id).rpartition(":")
+        if owner == self.node_id or not owner:
+            return self._cancel_local_task(task_id, reason)
+        state = self.cluster_service.state()
+        target = state.node(owner)
+        if target is None:
+            return {"found": False, "task_id": task_id}
+        from elasticsearch_tpu.action.replication import unwrap_remote
+        try:
+            return self.transport_service.send_request(
+                target, self.TASK_CANCEL_ACTION,
+                {"task_id": task_id, "reason": reason},
+                timeout=10.0).result(15.0)
+        except Exception as e:               # noqa: BLE001 — unwrap
+            raise unwrap_remote(e) from None
+
+    def _cancel_local_task(self, task_id: str, reason: str) -> dict:
+        tm = self.task_manager
+        task = tm.get(task_id)
+        if task is None:
+            return {"found": False, "task_id": task_id}
+        tm.cancel(task, reason)
+        # ban the id cluster-wide; the flag makes unregister lift it
+        task.ban_sent = True
+        self._broadcast_task_ban(task.task_id, True, reason)
+        return {"found": True, "task_id": task_id,
+                "task": task.to_dict()}
+
+    def _broadcast_task_ban(self, parent_task_id: str, ban: bool,
+                            reason: str) -> None:
+        """Fire-and-forget ban (or ban removal) to every other node —
+        TaskManager.setBan propagation. Best-effort: a node that misses
+        the ban still reaps the children when the parent node leaves."""
+        state = self.cluster_service.state()
+        for nid, n in state.nodes.items():
+            if nid == self.node_id:
+                continue
+            try:
+                self.transport_service.send_request(
+                    n, self.TASK_BAN_ACTION,
+                    {"parent": parent_task_id, "ban": ban,
+                     "reason": reason}, timeout=5.0)
+            except Exception:                # noqa: BLE001 — best effort
+                continue
+
+    def _handle_task_cancel(self, request: dict, source) -> dict:
+        return self._cancel_local_task(
+            request["task_id"], request.get("reason", "by user request"))
+
+    def _handle_task_ban(self, request: dict, source) -> dict:
+        if request.get("ban", True):
+            n = self.task_manager.set_ban(
+                request["parent"], request.get("reason", "parent banned"))
+            return {"cancelled": n}
+        self.task_manager.remove_ban(request["parent"])
+        return {"cancelled": 0}
+
+    def _reap_tasks_on_node_left(self, old, new) -> None:
+        for nid in set(old.nodes) - set(new.nodes):
+            self.task_manager.reap_node_left(nid)
+
     # ---- node-level monitoring (nodes stats / hot threads fan-out) ---------
 
     NODE_STATS_ACTION = "cluster:monitor/nodes/stats[n]"
@@ -719,6 +843,7 @@ class Node:
             "indices": indices_total,
             "breakers": self.breaker_service.stats(),
             "thread_pool": pools,
+            "tasks": self.task_manager.stats(),
             "process": ps,
             "os": osx,
             # process-level memory reported under the reference's jvm
@@ -882,7 +1007,8 @@ class Node:
             futures.append((nid, self.transport_service.send_request(
                 n, action, request, timeout=15.0)))
         handler = {self.NODE_STATS_ACTION: self._handle_node_stats,
-                   self.HOT_THREADS_ACTION: self._handle_hot_threads}[action]
+                   self.HOT_THREADS_ACTION: self._handle_hot_threads,
+                   self.TASKS_LIST_ACTION: self._handle_tasks_list}[action]
         out[self.node_id] = handler(request, None)
         for nid, fut in futures:
             try:

@@ -293,6 +293,11 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/_snapshot/{repo}/{snapshot}", h.get_snapshots)
     r("DELETE", "/_snapshot/{repo}/{snapshot}", h.delete_snapshot)
     r("POST", "/_snapshot/{repo}/{snapshot}/_restore", h.restore_snapshot)
+    # task management (rest/action/admin/cluster/node/tasks)
+    r("GET", "/_tasks", h.list_tasks)
+    r("POST", "/_tasks/_cancel", h.cancel_tasks)
+    r("GET", "/_tasks/{task_id}", h.get_task)
+    r("POST", "/_tasks/{task_id}/_cancel", h.cancel_task)
     r("GET", "/_nodes", h.nodes_info)
     r("GET", "/_nodes/stats", h.nodes_stats)
     r("GET", "/_nodes/stats/{metric}", h.nodes_stats)
@@ -325,6 +330,7 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/_cat/recovery/{index}", h.cat_recovery)
     r("GET", "/_cat/segments", h.cat_segments)
     r("GET", "/_cat/segments/{index}", h.cat_segments)
+    r("GET", "/_cat/tasks", h.cat_tasks)
     r("GET", "/_cat/thread_pool", h.cat_thread_pool)
     r("GET", "/_cat/fielddata", h.cat_fielddata)
     r("GET", "/_cat/fielddata/{fields}", h.cat_fielddata)
@@ -2729,6 +2735,67 @@ class Handlers:
         the transport (TransportNodesStatsAction fan-out)."""
         return 200, self.node.collect_nodes_stats()
 
+    # ---- task management (rest/action/admin/cluster/node/tasks) ------------
+
+    @staticmethod
+    def _tasks_filters(req: RestRequest) -> dict:
+        actions = req.param("actions")
+        nodes = req.param("nodes") or req.param("node_id")
+        return {
+            "actions": actions.split(",") if actions else None,
+            "parent_task_id": req.param("parent_task_id"),
+            "nodes": nodes.split(",") if nodes else None,
+            "detailed": req.param_as_bool("detailed", True),
+        }
+
+    def list_tasks(self, req: RestRequest):
+        """GET /_tasks — the cluster's running tasks, filterable by
+        node/action/parent (TransportListTasksAction)."""
+        return 200, self.node.collect_tasks(**self._tasks_filters(req))
+
+    def get_task(self, req: RestRequest):
+        """GET /_tasks/{task_id} — one task, wherever it runs."""
+        task_id = req.path_params["task_id"]
+        listed = self.node.collect_tasks()
+        for nid, doc in listed["nodes"].items():
+            task = doc["tasks"].get(task_id)
+            if task is not None:
+                return 200, {"completed": False,
+                             "task": {**task, "node_name": doc["name"]}}
+        return 404, {"error": {"type": "resource_not_found_exception",
+                               "reason": f"task [{task_id}] isn't "
+                                         f"running"},
+                     "status": 404}
+
+    def cancel_task(self, req: RestRequest):
+        """POST /_tasks/{task_id}/_cancel — cancels the task on its owner
+        node; bans propagate to child tasks on every other node."""
+        out = self.node.cancel_task(req.path_params["task_id"],
+                                    reason="by user request")
+        if not out.get("found"):
+            return 404, {"error": {
+                "type": "resource_not_found_exception",
+                "reason": f"task [{req.path_params['task_id']}] isn't "
+                          f"running (already completed?)"},
+                "status": 404}
+        return 200, out
+
+    def cancel_tasks(self, req: RestRequest):
+        """POST /_tasks/_cancel?actions=... — cancel every matching
+        cancellable task cluster-wide (TransportCancelTasksAction)."""
+        filters = self._tasks_filters(req)
+        filters.pop("detailed", None)
+        listed = self.node.collect_tasks(**filters)
+        cancelled = []
+        for nid, doc in listed["nodes"].items():
+            for tid, td in doc["tasks"].items():
+                if not td.get("cancellable") or td.get("cancelled"):
+                    continue
+                out = self.node.cancel_task(tid, reason="by user request")
+                if out.get("found"):
+                    cancelled.append(tid)
+        return 200, {"cancelled": sorted(cancelled)}
+
     _STATS_METRICS = {
         "docs": ("docs",), "store": ("store",),
         "indexing": ("indexing",), "get": ("get",), "search": ("search",),
@@ -2978,8 +3045,8 @@ class Handlers:
                  "/_cat/master", "/_cat/nodeattrs", "/_cat/nodes",
                  "/_cat/pending_tasks", "/_cat/plugins", "/_cat/recovery",
                  "/_cat/segments", "/_cat/shards",
-                 "/_cat/snapshots/{repo}", "/_cat/templates",
-                 "/_cat/thread_pool"]
+                 "/_cat/snapshots/{repo}", "/_cat/tasks",
+                 "/_cat/templates", "/_cat/thread_pool"]
         return 200, "=^.^=\n" + "\n".join(paths) + "\n"
 
     def cat_aliases(self, req: RestRequest):
@@ -3638,26 +3705,68 @@ class Handlers:
                     right=fname != "type",
                     default=(pool, fname) in default_on))
         t = CatTable(cols)
-        live = self.node.thread_pool.stats()
-        row = {"id": self.node.node_id if full_id
-               else self.node.node_id[:4],
-               "pid": os.getpid(), "host": self._node_host(),
-               "ip": self._node_ip(), "port": "-"}
-        for pool in self._TP_POOLS:
-            st = live.get(pool, {})
-            row[f"{pool}.type"] = "fixed"
-            row[f"{pool}.active"] = st.get("active", 0)
-            row[f"{pool}.size"] = st.get("threads", 0)
-            row[f"{pool}.queue"] = st.get("queue", 0)
-            qs = st.get("queue_size", -1)
-            row[f"{pool}.queueSize"] = qs if qs and qs > 0 else ""
-            row[f"{pool}.rejected"] = st.get("rejected", 0)
-            row[f"{pool}.largest"] = st.get("threads", 0)
-            row[f"{pool}.completed"] = st.get("completed", 0)
-            row[f"{pool}.min"] = ""
-            row[f"{pool}.max"] = ""
-            row[f"{pool}.keepAlive"] = ""
-        t.add(**row)
+        # one row per CLUSTER node (the reference's nodes-stats fan-out):
+        # queue depths and rejection counts are the cluster-wide
+        # backpressure picture, not just the coordinating node's
+        state = self.node.cluster_service.state()
+        per_node_stats = self.node.collect_nodes_stats()["nodes"]
+        for nid in sorted(per_node_stats,
+                          key=lambda i: per_node_stats[i].get("name", "")):
+            stats = per_node_stats[nid]
+            live = stats.get("thread_pool", {})
+            dn = state.nodes.get(nid)
+            row = {"id": nid if full_id else nid[:4],
+                   "pid": os.getpid() if nid == self.node.node_id else "-",
+                   "host": dn.address.host if dn else self._node_host(),
+                   "ip": self._node_ip(dn.address.host if dn else None),
+                   "port": dn.address.port if dn else "-"}
+            for pool in self._TP_POOLS:
+                st = live.get(pool, {})
+                row[f"{pool}.type"] = "fixed"
+                row[f"{pool}.active"] = st.get("active", 0)
+                row[f"{pool}.size"] = st.get("threads", 0)
+                row[f"{pool}.queue"] = st.get("queue", 0)
+                qs = st.get("queue_size", -1)
+                row[f"{pool}.queueSize"] = qs if qs and qs > 0 else ""
+                row[f"{pool}.rejected"] = st.get("rejected", 0)
+                row[f"{pool}.largest"] = st.get("threads", 0)
+                row[f"{pool}.completed"] = st.get("completed", 0)
+                row[f"{pool}.min"] = ""
+                row[f"{pool}.max"] = ""
+                row[f"{pool}.keepAlive"] = ""
+            t.add(**row)
+        return t.render(req)
+
+    def cat_tasks(self, req: RestRequest):
+        """GET /_cat/tasks — the cluster's running tasks as a table
+        (RestTasksAction)."""
+        listed = self.node.collect_tasks(**self._tasks_filters(req))
+        t = CatTable([
+            Col("action", ("ac",), "task action"),
+            Col("task_id", ("ti",), "unique task id"),
+            Col("parent_task_id", ("pti",), "parent task id"),
+            Col("type", ("ty",), "task type"),
+            Col("start_time", ("start",), "start time in ms since epoch",
+                right=True),
+            Col("running_time", ("time",), "running time", right=True),
+            Col("node", ("n",), "node name"),
+            Col("cancelled", ("c",), "cancellation flag", default=False),
+            Col("description", ("desc",), "task action description",
+                default=False),
+        ])
+        for nid in sorted(listed["nodes"]):
+            doc = listed["nodes"][nid]
+            for tid in sorted(doc["tasks"]):
+                td = doc["tasks"][tid]
+                t.add(action=td["action"], task_id=tid,
+                      parent_task_id=td.get("parent_task_id", "-"),
+                      type=td["type"],
+                      start_time=td["start_time_in_millis"],
+                      running_time="%.1fms"
+                                   % (td["running_time_in_nanos"] / 1e6),
+                      node=doc.get("name", nid),
+                      cancelled=str(bool(td.get("cancelled"))).lower(),
+                      description=td.get("description", ""))
         return t.render(req)
 
     def cat_snapshots(self, req: RestRequest):

@@ -80,6 +80,18 @@ def _hit_comparator(req: ParsedSearchRequest):
     return functools.cmp_to_key(cmp_entries)
 
 
+def attach_phase_took(response: dict, phases: dict, task=None) -> dict:
+    """Surface the coordinator's phase trace ({"query": ms, "fetch": ms,
+    "reduce": ms}) as the response's ``took`` breakdown and record the
+    spans on the coordinating task (the per-request twin of the
+    nodes-stats phase rollup)."""
+    response["took_breakdown"] = {k: int(v) for k, v in phases.items()}
+    if task is not None:
+        for name, ms in phases.items():
+            task.add_span(name, ms)
+    return response
+
+
 def assemble_response(req: ParsedSearchRequest, payloads: list[dict],
                       hits_out: list[dict], took_ms: float,
                       total_shards: int, failures: list[dict],

@@ -306,6 +306,11 @@ class SegmentResolver:
     def resolve(self, query: q.Query) -> Emit:
         """→ emit closure producing (scores [N] f32, mask [N] bool);
         live-mask applied by the caller."""
+        # cooperative cancellation checkpoint: plan resolution walks the
+        # whole AST host-side, so a cancelled task aborts here before the
+        # next device dispatch is even built (TaskManager wiring)
+        from elasticsearch_tpu.tasks import raise_if_cancelled
+        raise_if_cancelled()
         method = getattr(self, f"_res_{type(query).__name__}", None)
         if method is None:
             raise QueryParsingError(

@@ -25,7 +25,8 @@ from typing import Any
 import jax.numpy as jnp
 import numpy as np
 
-from elasticsearch_tpu.common.errors import QueryParsingError
+from elasticsearch_tpu.common.errors import (QueryParsingError,
+                                             TaskCancelledError)
 from elasticsearch_tpu.index.device_reader import DeviceReader
 from elasticsearch_tpu.ops import topk as topk_ops
 from elasticsearch_tpu.search import query_dsl as q
@@ -70,6 +71,29 @@ class ParsedSearchRequest:
     terminate_after: int | None = None             # per-shard collected cap
     timeout_ms: float | None = None                # per-shard time budget
     rescore: list[RescoreSpec] = field(default_factory=list)
+
+
+def _task_budget(req: ParsedSearchRequest):
+    """→ (current task, effective monotonic deadline): the tighter of
+    the request's own timeout and the executing task's deadline (the
+    coordinator wires `timeout` through the task so a shard's budget
+    shrinks by the wall time already spent queueing and fanning out)."""
+    from elasticsearch_tpu.tasks import current_task
+    task = current_task()
+    deadline = None if req.timeout_ms is None \
+        else time.monotonic() + req.timeout_ms / 1000.0
+    if task is not None and task.deadline is not None:
+        deadline = task.deadline if deadline is None \
+            else min(deadline, task.deadline)
+    return task, deadline
+
+
+def _checkpoint(task) -> None:
+    """Cooperative cancellation checkpoint at a segment boundary."""
+    if task is not None and task.cancelled:
+        raise TaskCancelledError(
+            f"task [{task.task_id}] was cancelled "
+            f"[{task.cancel_reason or 'unknown'}]")
 
 
 def parse_search_request(body: dict | None) -> ParsedSearchRequest:
@@ -365,12 +389,12 @@ class ShardSearcher:
         sa = req.search_after if (req.search_after is not None
                                   and not req.sort) else None
         terminated_early = timed_out = False
-        deadline = None if req.timeout_ms is None \
-            else time.monotonic() + req.timeout_ms / 1000.0
+        task, deadline = _task_budget(req)
         try:
             outs = []
             running = 0
             for seg in self.reader.segments:
+                _checkpoint(task)
                 if deadline is not None and time.monotonic() > deadline:
                     timed_out = True           # partial results, remaining
                     break                      # segments skipped
@@ -391,7 +415,10 @@ class ShardSearcher:
                             running >= req.terminate_after:
                         terminated_early = True
                         break
-        except QueryParsingError:
+        except (QueryParsingError, TaskCancelledError):
+            # cancellation must ABORT, not fall back to the eager path —
+            # re-running a cancelled query eagerly is the opposite of
+            # shedding it
             raise
         except Exception as e:                # noqa: BLE001 — fallback seam
             jit_exec.note_fallback(e)
@@ -473,6 +500,8 @@ class ShardSearcher:
         starts the transfer in the background, so consecutive launches
         pipeline on the device while earlier drains ride the link."""
         from elasticsearch_tpu.search import jit_exec
+        from elasticsearch_tpu.tasks import current_task
+        _checkpoint(current_task())
         if not reqs:
             return ("empty", [])
         for req in reqs:
@@ -700,12 +729,12 @@ class ShardSearcher:
         if req.rescore:
             k = max(k, max(s.window_size for s in req.rescore))
         terminated_early = timed_out = False
-        deadline = None if req.timeout_ms is None \
-            else time.monotonic() + req.timeout_ms / 1000.0
+        task, deadline = _task_budget(req)
         per_seg = []
         segments = []
         running = 0
         for seg in self.reader.segments:
+            _checkpoint(task)
             if deadline is not None and time.monotonic() > deadline:
                 timed_out = True
                 break

@@ -1,0 +1,13 @@
+"""Task management (core/tasks/): cluster-wide task registry,
+cross-node cancellation bans, and per-request accounting/tracing."""
+
+from elasticsearch_tpu.tasks.manager import (
+    AUTO_PARENT, TASK_HEADER, Task, TaskManager, bind_current,
+    current_task, note_breaker_bytes, note_queue_ns, raise_if_cancelled,
+    task_of_thread, use_task)
+
+__all__ = [
+    "AUTO_PARENT", "TASK_HEADER", "Task", "TaskManager", "bind_current",
+    "current_task", "note_breaker_bytes", "note_queue_ns",
+    "raise_if_cancelled", "task_of_thread", "use_task",
+]

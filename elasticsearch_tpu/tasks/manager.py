@@ -1,0 +1,348 @@
+"""TaskManager — the node's registry of everything currently running.
+
+Reference: core/tasks/TaskManager.java — every inbound transport request
+and every locally-spawned action registers a :class:`Task` with a
+cluster-unique id (``node_id:seq``, TaskId.java) and a parent-task link
+that propagates on every outgoing RPC, so a search fanning out to N
+shards is visible as one coordinating task plus N children across the
+cluster. Cancellation is cooperative (CancellableTask.java): cancelling
+a task flips a flag the work checks at checkpoint boundaries, and a BAN
+on the parent id (TaskManager.setBan) propagates to other nodes so
+children registered *after* the cancel are born cancelled. Orphans —
+children whose coordinating node left the cluster — are reaped on
+node-left events.
+
+Accounting rides the registry: wall time, threadpool queue time
+(EsThreadPoolExecutor timing), circuit-breaker bytes attributed to the
+task, and phase-level trace spans (query/fetch/reduce) that feed the
+response ``took`` breakdown and nodes stats.
+
+The thread-local *current task* is the propagation seam: the transport
+layer sets it around handler dispatch, :class:`FixedThreadPool` carries
+it across submit boundaries, and ``send_request`` reads it to stamp the
+parent-task header on outbound RPCs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+
+from elasticsearch_tpu.common.errors import TaskCancelledError
+
+#: request-dict key carrying the parent task id across the wire — the
+#: TransportService strips it before the handler sees the request (the
+#: reference writes TaskId into the request envelope; our envelope is
+#:  the request dict itself)
+TASK_HEADER = "__parent_task_id__"
+
+#: sentinel: register() inherits the parent from the thread-local
+#: current task (explicit None means "root task, no parent")
+AUTO_PARENT = object()
+
+_tls = threading.local()
+#: thread ident → Task, for hot_threads' "what task is this thread
+#: running" report (sampled from another thread, hence not thread-local)
+_thread_tasks: dict[int, "Task"] = {}
+
+
+def current_task() -> "Task | None":
+    return getattr(_tls, "task", None)
+
+
+@contextlib.contextmanager
+def use_task(task: "Task | None"):
+    """Make ``task`` the thread's current task for the duration (no-op
+    context when task is None so call sites don't branch)."""
+    prev = getattr(_tls, "task", None)
+    _tls.task = task
+    ident = threading.get_ident()
+    if task is not None:
+        _thread_tasks[ident] = task
+    try:
+        yield task
+    finally:
+        _tls.task = prev
+        if prev is not None:
+            _thread_tasks[ident] = prev
+        else:
+            _thread_tasks.pop(ident, None)
+
+
+def bind_current(fn):
+    """Capture the caller's current task so ``fn`` runs under it on
+    another thread (the context-preserving submit the reference gets
+    from ThreadContext.preserveContext)."""
+    task = current_task()
+    if task is None:
+        return fn
+
+    def bound(*args, **kwargs):
+        with use_task(task):
+            return fn(*args, **kwargs)
+    return bound
+
+
+def task_of_thread(ident: int) -> "Task | None":
+    """The task a thread is currently running, if any (hot_threads)."""
+    return _thread_tasks.get(ident)
+
+
+def raise_if_cancelled() -> None:
+    """Cooperative cancellation checkpoint: raises
+    :class:`TaskCancelledError` when the thread's current task (or any
+    ancestor registered on this node) has been cancelled."""
+    task = current_task()
+    if task is not None and task.cancelled:
+        raise TaskCancelledError(
+            f"task [{task.task_id}] was cancelled "
+            f"[{task.cancel_reason or 'unknown'}]")
+
+
+def note_breaker_bytes(nbytes: int) -> None:
+    """Attribute a circuit-breaker reservation to the current task
+    (cumulative — the task's total scratch demand, not the live level;
+    leak detection stays with the breakers themselves)."""
+    task = current_task()
+    if task is not None:
+        task.breaker_bytes += int(nbytes)
+
+
+def note_queue_ns(ns: int) -> None:
+    """Attribute threadpool queue wait to the current task."""
+    task = current_task()
+    if task is not None:
+        task.queue_ns += int(ns)
+
+
+class Task:
+    """One unit of running work (Task.java / CancellableTask.java)."""
+
+    __slots__ = ("id", "task_id", "node_id", "action", "description",
+                 "parent_task_id", "type", "cancellable", "cancelled",
+                 "cancel_reason", "start_time_ms", "_start_ns",
+                 "queue_ns", "breaker_bytes", "spans", "deadline",
+                 "ban_sent")
+
+    def __init__(self, node_id: str, seq: int, action: str,
+                 description: str, parent_task_id: str | None,
+                 task_type: str, cancellable: bool):
+        self.id = seq
+        self.node_id = node_id
+        self.task_id = f"{node_id}:{seq}"
+        self.action = action
+        self.description = description
+        self.parent_task_id = parent_task_id
+        self.type = task_type                  # "transport" | "direct"
+        self.cancellable = cancellable
+        self.cancelled = False
+        self.cancel_reason: str | None = None
+        self.start_time_ms = int(time.time() * 1000)
+        self._start_ns = time.monotonic_ns()
+        self.queue_ns = 0
+        self.breaker_bytes = 0
+        #: [(name, took_ms)] — phase trace (query/fetch/reduce)
+        self.spans: list[tuple[str, float]] = []
+        #: absolute monotonic deadline (search timeout wired through the
+        #: task, so per-shard budgets shrink with elapsed wall time)
+        self.deadline: float | None = None
+        #: a cancel for this task was broadcast as a cluster-wide ban —
+        #: unregister must broadcast the ban removal
+        self.ban_sent = False
+
+    def running_time_ns(self) -> int:
+        return time.monotonic_ns() - self._start_ns
+
+    def add_span(self, name: str, took_ms: float) -> None:
+        self.spans.append((name, float(took_ms)))
+
+    def to_dict(self, detailed: bool = True) -> dict:
+        out = {
+            "node": self.node_id,
+            "id": self.id,
+            "type": self.type,
+            "action": self.action,
+            "start_time_in_millis": self.start_time_ms,
+            "running_time_in_nanos": self.running_time_ns(),
+            "cancellable": self.cancellable,
+        }
+        if self.cancelled:
+            out["cancelled"] = True
+        if self.parent_task_id is not None:
+            out["parent_task_id"] = self.parent_task_id
+        if detailed:
+            out["description"] = self.description
+            out["queue_time_in_nanos"] = self.queue_ns
+            out["breaker_bytes"] = self.breaker_bytes
+            if self.spans:
+                out["phases"] = [{"name": n, "took_ms": round(ms, 3)}
+                                 for n, ms in self.spans]
+        return out
+
+
+class TaskManager:
+    """Per-node task registry + ban table (TaskManager.java)."""
+
+    def __init__(self, node_id: str, node_name: str = ""):
+        self.node_id = node_id
+        self.node_name = node_name
+        self._seq = itertools.count(1)
+        self._tasks: dict[int, Task] = {}
+        #: banned parent task id → reason: children registering under a
+        #: banned parent are born cancelled (setBan semantics)
+        self._bans: dict[str, str] = {}
+        self._lock = threading.Lock()
+        self.total_registered = 0
+        self.total_cancelled = 0
+        #: phase name → {"count", "time_in_millis"} rollup of completed
+        #: tasks' spans (nodes stats)
+        self.phase_totals: dict[str, dict] = {}
+        #: set by the node: callable(parent_task_id, ban: bool, reason)
+        #: broadcasting a ban (or its removal) to the rest of the cluster
+        self.ban_broadcaster = None
+
+    # ---- registry ----------------------------------------------------------
+
+    def register(self, action: str, description: str = "",
+                 parent_task_id=AUTO_PARENT, task_type: str = "direct",
+                 cancellable: bool = True) -> Task:
+        if parent_task_id is AUTO_PARENT:
+            cur = current_task()
+            parent_task_id = cur.task_id if cur is not None else None
+        task = Task(self.node_id, next(self._seq), action, description,
+                    parent_task_id, task_type, cancellable)
+        with self._lock:
+            self._tasks[task.id] = task
+            self.total_registered += 1
+            if parent_task_id is not None and parent_task_id in self._bans:
+                # born under a ban: cancelled before it runs a step
+                task.cancelled = True
+                task.cancel_reason = self._bans[parent_task_id]
+                self.total_cancelled += 1
+        return task
+
+    def unregister(self, task: Task | None) -> None:
+        if task is None:
+            return
+        with self._lock:
+            self._tasks.pop(task.id, None)
+            for name, ms in task.spans:
+                tot = self.phase_totals.setdefault(
+                    name, {"count": 0, "time_in_millis": 0})
+                tot["count"] += 1
+                tot["time_in_millis"] += int(ms)
+        if task.ban_sent and self.ban_broadcaster is not None:
+            # the parent finished: lift the cluster-wide ban so the id
+            # space can't accumulate dead bans (TaskManager.removeBan)
+            try:
+                self.ban_broadcaster(task.task_id, False,
+                                     task.cancel_reason or "")
+            except Exception:       # noqa: BLE001 — best-effort cleanup
+                pass
+
+    def get(self, task_id: str) -> Task | None:
+        """Lookup by full "node:seq" id (local tasks only)."""
+        node, _, seq = str(task_id).rpartition(":")
+        if node != self.node_id:
+            return None
+        try:
+            return self._tasks.get(int(seq))
+        except ValueError:
+            return None
+
+    def list_tasks(self, actions: list[str] | None = None,
+                   parent_task_id: str | None = None,
+                   detailed: bool = True) -> dict:
+        """→ {task_id: task dict} for tasks matching the filters
+        (ListTasksRequest match semantics: action patterns support a
+        trailing ``*`` wildcard)."""
+        import fnmatch
+        with self._lock:
+            snapshot = list(self._tasks.values())
+        out = {}
+        for t in snapshot:
+            if parent_task_id is not None and \
+                    t.parent_task_id != parent_task_id:
+                continue
+            if actions and not any(fnmatch.fnmatch(t.action, pat)
+                                   for pat in actions):
+                continue
+            out[t.task_id] = t.to_dict(detailed)
+        return out
+
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._tasks)
+
+    # ---- cancellation ------------------------------------------------------
+
+    def cancel(self, task: Task, reason: str) -> None:
+        """Mark a task (and its LOCAL descendants) cancelled. Remote
+        descendants are handled by the ban broadcast (node layer)."""
+        with self._lock:
+            self._cancel_locked(task, reason)
+
+    def _cancel_locked(self, task: Task, reason: str) -> None:
+        if not task.cancelled:
+            task.cancelled = True
+            task.cancel_reason = reason
+            self.total_cancelled += 1
+        # local descendants: children registered on THIS node under the
+        # cancelled task, recursively
+        for child in [t for t in self._tasks.values()
+                      if t.parent_task_id == task.task_id]:
+            self._cancel_locked(child, reason)
+
+    def set_ban(self, parent_task_id: str, reason: str) -> int:
+        """Ban a parent id: cancel every current task under it and mark
+        the id so future registrations are born cancelled. → number of
+        tasks cancelled now."""
+        with self._lock:
+            self._bans[parent_task_id] = reason
+            victims = [t for t in self._tasks.values()
+                       if t.parent_task_id == parent_task_id]
+            for t in victims:
+                self._cancel_locked(t, reason)
+            return len(victims)
+
+    def remove_ban(self, parent_task_id: str) -> None:
+        with self._lock:
+            self._bans.pop(parent_task_id, None)
+
+    def bans(self) -> dict:
+        with self._lock:
+            return dict(self._bans)
+
+    def reap_node_left(self, node_id: str) -> int:
+        """A node left the cluster: cancel every task parented on it
+        (orphaned children — their coordinator can neither collect nor
+        cancel them) and drop bans it originated. Cooperative: the
+        running work aborts at its next checkpoint and unregisters
+        through the normal completion path, releasing breaker bytes.
+        → tasks cancelled."""
+        prefix = f"{node_id}:"
+        with self._lock:
+            victims = [t for t in self._tasks.values()
+                       if (t.parent_task_id or "").startswith(prefix)]
+            for t in victims:
+                self._cancel_locked(
+                    t, f"coordinating node [{node_id}] left the cluster")
+            for banned in [b for b in self._bans
+                           if b.startswith(prefix)]:
+                del self._bans[banned]
+            return len(victims)
+
+    # ---- stats -------------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "active_count": len(self._tasks),
+                "total_registered": self.total_registered,
+                "total_cancelled": self.total_cancelled,
+                "bans": len(self._bans),
+                "phases": {k: dict(v)
+                           for k, v in sorted(self.phase_totals.items())},
+            }

@@ -386,6 +386,85 @@ class DiskFaultScheme:
             self.stop_disrupting()
 
 
+# ---- coordinator-kill scenario (task-management chaos) ----------------------
+
+def run_coordinator_kill_case(seed: int, transport: str = "local") -> dict:
+    """Seed-replayable coordinator-kill scenario (the task-management
+    chaos scheme, v3): draw cluster/index/search shapes from ``seed``,
+    start a fanned-out search whose shard tasks are HELD at a
+    cancellation checkpoint on the data nodes, kill the coordinating
+    node mid-search, and assert the survivors reap the orphaned child
+    tasks — no task parented on the dead node remains, and request
+    circuit-breaker bytes return to zero. Any assertion carries the seed
+    so a failure replays exactly (the PR 1 matrix discipline).
+
+    → summary dict {seed, nodes, shards, children_before_kill}."""
+    import threading
+
+    from elasticsearch_tpu.testing import InternalTestCluster
+
+    rnd = random.Random(seed)
+    num_nodes = rnd.randint(3, 4)
+    shards = rnd.randint(2, 2 * (num_nodes - 1))
+    ndocs = rnd.randint(8, 32)
+    hold_s = rnd.uniform(4.0, 7.0)
+    tag = f"[coordinator_kill seed={seed} transport={transport}]"
+    cluster = InternalTestCluster(num_nodes=num_nodes, transport=transport)
+    try:
+        master = cluster.master()
+        master.indices_service.create_index(
+            "chaos_tasks", {"settings": {"number_of_shards": shards,
+                                         "number_of_replicas": 0}})
+        cluster.wait_for_health("green")
+        for i in range(ndocs):
+            master.index_doc("chaos_tasks", str(i),
+                             {"body": f"doc {i} {rnd.random()}"})
+        # a non-master coordinator: the master must survive the kill to
+        # publish the node-left state that triggers the reap
+        coordinator = rnd.choice(cluster.non_masters())
+        for n in cluster.nodes:
+            n.search_actions.shard_query_delay = hold_s
+
+        def fire():
+            try:
+                coordinator.search("chaos_tasks",
+                                   {"query": {"match_all": {}}})
+            except Exception:       # noqa: BLE001 — dies with the kill
+                pass
+        searcher = threading.Thread(target=fire, daemon=True)
+        searcher.start()
+        survivors = [n for n in cluster.nodes if n is not coordinator]
+        prefix = f"{coordinator.node_id}:"
+
+        def children_on_survivors() -> int:
+            return sum(
+                1 for n in survivors
+                for t in n.task_manager.list_tasks().values()
+                if str(t.get("parent_task_id", "")).startswith(prefix))
+        assert wait_until(lambda: children_on_survivors() > 0,
+                          timeout=10.0), \
+            f"{tag} no shard task ever reached a survivor node"
+        children_before = children_on_survivors()
+        kill_at = time.monotonic()
+        cluster.stop_node(coordinator, graceful=False)     # the kill
+
+        def reaped() -> bool:
+            return children_on_survivors() == 0 and all(
+                n.breaker_service.breaker("request").used == 0
+                for n in survivors)
+        assert wait_until(reaped, timeout=15.0), (
+            f"{tag} orphaned tasks survived the reap pass: "
+            f"{[(n.node_name, n.task_manager.list_tasks()) for n in survivors]}, "
+            f"breakers={[(n.node_name, n.breaker_service.breaker('request').used) for n in survivors]}")
+        return {"seed": seed, "nodes": num_nodes, "shards": shards,
+                "children_before_kill": children_before,
+                "reap_seconds": round(time.monotonic() - kill_at, 3)}
+    finally:
+        for n in list(cluster.nodes):
+            n.search_actions.shard_query_delay = None
+        cluster.close()
+
+
 # ---- seeded scheme registry (the matrix draws from this) --------------------
 
 #: names the randomized matrix can draw; each factory takes

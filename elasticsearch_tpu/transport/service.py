@@ -130,19 +130,30 @@ class TransportChannel:
         self.request_id = request_id
         self.action = action
         self._done = False
+        # the Task registered for this request (TaskManager wiring);
+        # unregistered when the reply goes out — the task's lifetime IS
+        # the request's lifetime
+        self.task = None
 
     def send_response(self, response: dict | None) -> None:
         if self._done:
             return
         self._done = True
-        self._service._reply(self.source_node, self.request_id,
-                             response or {}, None)
+        try:
+            self._service._reply(self.source_node, self.request_id,
+                                 response or {}, None)
+        finally:
+            self._service._finish_task(self)
 
     def send_failure(self, error: Exception) -> None:
         if self._done:
             return
         self._done = True
-        self._service._reply(self.source_node, self.request_id, None, error)
+        try:
+            self._service._reply(self.source_node, self.request_id, None,
+                                 error)
+        finally:
+            self._service._finish_task(self)
 
 
 @dataclass
@@ -199,6 +210,11 @@ class TransportService:
         # transport-level seams). Installed by testing_disruption
         # schemes; None in production.
         self.outbound_rule: Callable | None = None
+        # TaskManager (tasks/manager.py), set by the node: every inbound
+        # request registers a task, every outbound request carries the
+        # current task's id as the parent link. None → no accounting
+        # (standalone transports in unit tests).
+        self.task_manager = None
         self._closed = False
         transport.bind(self)
         self.local_node: DiscoveryNode = local_node_factory(
@@ -260,6 +276,14 @@ class TransportService:
             ctx = _ResponseContext(fut, node, action)
             self._responses[rid] = ctx
         self._trace("send_request", action, node.node_id)
+        if self.task_manager is not None:
+            # parent-task propagation (TaskId in the request envelope):
+            # the receiver links its task under ours, making the fan-out
+            # one visible tree — and cancellable as one
+            from elasticsearch_tpu.tasks import TASK_HEADER, current_task
+            cur = current_task()
+            if cur is not None:
+                request = {**request, TASK_HEADER: cur.task_id}
         if timeout is not None:
             ctx.timer = threading.Timer(timeout, self._on_timeout, (rid,))
             ctx.timer.daemon = True
@@ -293,10 +317,23 @@ class TransportService:
             channel.send_failure(ActionNotFoundError(action))
             return
         request = StreamInput(payload, wire_version).read_value()
+        parent_task = None
+        if isinstance(request, dict):
+            from elasticsearch_tpu.tasks import TASK_HEADER
+            parent_task = request.pop(TASK_HEADER, None)
+        if self.task_manager is not None:
+            # register BEFORE dispatch so queue time on a saturated pool
+            # is visible in the task list, and a ban that lands while the
+            # request waits still cancels it before it runs a step
+            channel.task = self.task_manager.register(
+                action, description=f"requests[{source.name}]",
+                parent_task_id=parent_task, task_type="transport")
 
         def run():
+            from elasticsearch_tpu.tasks import use_task
             try:
-                reg.handler(request, channel)
+                with use_task(channel.task):
+                    reg.handler(request, channel)
             except Exception as e:              # noqa: BLE001 — crosses RPC
                 channel.send_failure(e)
 
@@ -339,6 +376,14 @@ class TransportService:
                            NodeDisconnectedError(f"[{node.name}] disconnected"))
 
     # ---- internals ---------------------------------------------------------
+
+    def _finish_task(self, channel: "TransportChannel") -> None:
+        """Unregister the request's task once its reply went out (or was
+        dropped because the requester is gone) — the registry must never
+        outlive the work it describes."""
+        task, channel.task = channel.task, None
+        if task is not None and self.task_manager is not None:
+            self.task_manager.unregister(task)
 
     def _reply(self, to_node: DiscoveryNode, request_id: int,
                response: dict | None, error: Exception | None) -> None:

@@ -1,0 +1,360 @@
+"""Task management: registry, parent propagation, cross-node
+cancellation, accounting, and the coordinator-kill chaos scheme.
+
+Tier-1 ("not slow") covers register/list/cancel/propagate on the local
+transport plus the seeded coordinator-kill reap; the tcp variants ride
+real sockets and are marked slow.
+"""
+
+import logging
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.errors import TaskCancelledError
+from elasticsearch_tpu.common.threadpool import FixedThreadPool
+from elasticsearch_tpu.tasks import (TaskManager, current_task,
+                                     raise_if_cancelled, use_task)
+from elasticsearch_tpu.testing import InternalTestCluster
+from elasticsearch_tpu.testing_disruption import (run_coordinator_kill_case,
+                                                  wait_until)
+
+
+# ---- TaskManager unit surface ----------------------------------------------
+
+def test_register_list_unregister():
+    tm = TaskManager("n1", "node-1")
+    t = tm.register("indices:data/read/search", description="d",
+                    parent_task_id=None)
+    assert t.task_id == "n1:1"
+    listed = tm.list_tasks()
+    assert t.task_id in listed
+    assert listed[t.task_id]["action"] == "indices:data/read/search"
+    assert listed[t.task_id]["description"] == "d"
+    # action filter with trailing wildcard (ListTasksRequest semantics)
+    assert tm.list_tasks(actions=["indices:data/read/*"])
+    assert not tm.list_tasks(actions=["cluster:*"])
+    tm.unregister(t)
+    assert tm.list_tasks() == {}
+    assert tm.stats()["total_registered"] == 1
+
+
+def test_parent_auto_inherits_current_task():
+    tm = TaskManager("n1")
+    parent = tm.register("parent-action", parent_task_id=None)
+    with use_task(parent):
+        assert current_task() is parent
+        child = tm.register("child-action")
+    assert child.parent_task_id == parent.task_id
+    assert current_task() is None
+
+
+def test_cancel_cascades_to_local_descendants():
+    tm = TaskManager("n1")
+    root = tm.register("root", parent_task_id=None)
+    child = tm.register("child", parent_task_id=root.task_id)
+    grand = tm.register("grand", parent_task_id=child.task_id)
+    tm.cancel(root, "test")
+    assert root.cancelled and child.cancelled and grand.cancelled
+    with use_task(grand):
+        with pytest.raises(TaskCancelledError):
+            raise_if_cancelled()
+
+
+def test_ban_cancels_current_and_future_children():
+    tm = TaskManager("n2")
+    child = tm.register("child", parent_task_id="n1:7")
+    assert tm.set_ban("n1:7", "parent cancelled") == 1
+    assert child.cancelled
+    # a child registered AFTER the ban is born cancelled
+    late = tm.register("late-child", parent_task_id="n1:7")
+    assert late.cancelled and late.cancel_reason == "parent cancelled"
+    tm.remove_ban("n1:7")
+    fresh = tm.register("fresh-child", parent_task_id="n1:7")
+    assert not fresh.cancelled
+
+
+def test_reap_node_left_cancels_orphans_and_drops_bans():
+    tm = TaskManager("n2")
+    orphan = tm.register("child", parent_task_id="dead:3")
+    local = tm.register("local-root", parent_task_id=None)
+    tm.set_ban("dead:9", "old ban")
+    assert tm.reap_node_left("dead") == 1
+    assert orphan.cancelled and not local.cancelled
+    assert tm.bans() == {}
+
+
+def test_threadpool_propagates_task_and_attributes_queue_time():
+    tm = TaskManager("n1")
+    task = tm.register("submitting", parent_task_id=None)
+    pool = FixedThreadPool("test", size=1, queue_size=10)
+    try:
+        seen = {}
+        with use_task(task):
+            fut = pool.submit(lambda: seen.update(t=current_task()))
+        fut.result(5.0)
+        assert seen["t"] is task
+        assert task.queue_ns >= 0
+        assert "queue_wait_in_millis" in pool.stats()
+    finally:
+        pool.shutdown()
+
+
+def test_task_to_dict_accounting_fields():
+    tm = TaskManager("n1")
+    t = tm.register("a", description="desc", parent_task_id="n0:1")
+    t.breaker_bytes += 1024
+    t.add_span("query", 12.5)
+    d = t.to_dict(detailed=True)
+    assert d["parent_task_id"] == "n0:1"
+    assert d["breaker_bytes"] == 1024
+    assert d["phases"] == [{"name": "query", "took_ms": 12.5}]
+    assert d["running_time_in_nanos"] >= 0
+    tm.unregister(t)
+    assert tm.stats()["phases"]["query"]["count"] == 1
+
+
+# ---- cluster: propagate / list / cancel over the local transport -----------
+
+@pytest.fixture(scope="module")
+def cluster():
+    with InternalTestCluster(num_nodes=2) as c:
+        m = c.master()
+        m.indices_service.create_index(
+            "tasks_idx", {"settings": {"number_of_shards": 4,
+                                       "number_of_replicas": 0}})
+        c.wait_for_health("green")
+        for i in range(16):
+            m.index_doc("tasks_idx", str(i), {"body": f"hello world {i}"})
+        m.broadcast_actions.refresh("tasks_idx")
+        yield c
+
+
+def _hold_all(cluster, seconds):
+    for n in cluster.nodes:
+        n.search_actions.shard_query_delay = seconds
+
+
+def test_search_task_tree_spans_nodes(cluster):
+    m = cluster.master()
+    other = cluster.non_masters()[0]
+    _hold_all(cluster, 1.5)
+    try:
+        out = {}
+        th = threading.Thread(target=lambda: out.update(
+            r=m.search("tasks_idx", {"query": {"match_all": {}}})))
+        th.start()
+
+        def tree_visible():
+            coord = [t for t in m.task_manager.list_tasks().values()
+                     if t["action"] == "indices:data/read/search"
+                     and "parent_task_id" not in t]
+            if not coord:
+                return False
+            parent_id = f"{m.node_id}:{coord[0]['id']}"
+            children = other.task_manager.list_tasks(
+                parent_task_id=parent_id)
+            return len(children) > 0
+        assert wait_until(tree_visible, timeout=5.0)
+        th.join(10.0)
+        assert out["r"]["hits"]["total"] == 16
+        # the coordinator reports its phase trace in the took breakdown
+        assert "query" in out["r"]["took_breakdown"]
+    finally:
+        _hold_all(cluster, None)
+    # registries drain once the request completes
+    assert wait_until(
+        lambda: all(
+            not n.task_manager.list_tasks(
+                actions=["indices:data/read/*"])
+            for n in cluster.nodes), timeout=5.0)
+
+
+def test_cancel_coordinating_task_cancels_remote_children(cluster):
+    m = cluster.master()
+    _hold_all(cluster, 8.0)
+    try:
+        out = {}
+        th = threading.Thread(target=lambda: out.update(
+            r=m.search("tasks_idx", {"query": {"match_all": {}}})))
+        th.start()
+        coord = {}
+
+        def coord_visible():
+            for tid, t in m.task_manager.list_tasks().items():
+                if t["action"] == "indices:data/read/search" \
+                        and "parent_task_id" not in t:
+                    coord["id"] = tid
+                    return True
+            return False
+        assert wait_until(coord_visible, timeout=5.0)
+        res = m.cancel_task(coord["id"], reason="test cancel")
+        assert res["found"]
+        th.join(10.0)
+        r = out["r"]
+        # partial/cancelled reported cleanly: explicit flag + per-shard
+        # task_cancelled failures, never a hang until the hold expires
+        assert r.get("cancelled") is True
+        assert r["_shards"]["failed"] >= 1
+        assert all(f["reason"]["type"] == "task_cancelled_exception"
+                   for f in r["_shards"]["failures"])
+    finally:
+        _hold_all(cluster, None)
+    # afterward: task list empty, bans lifted, zero leaked breaker bytes
+    assert wait_until(
+        lambda: all(n.task_manager.active_count() == 0
+                    and n.task_manager.bans() == {}
+                    for n in cluster.nodes), timeout=5.0)
+    for n in cluster.nodes:
+        assert n.breaker_service.breaker("request").used == 0
+
+
+def test_timeout_budget_counts_elapsed_coordination_time(cluster):
+    m = cluster.master()
+    # the hold burns the request's whole 50ms budget BEFORE the query
+    # phase starts; only the task-deadline wiring (remaining budget
+    # shipped per shard) can notice — a per-shard clock restart would
+    # not time out
+    _hold_all(cluster, 0.4)
+    try:
+        r = m.search("tasks_idx", {"query": {"match_all": {}},
+                                   "timeout": "50ms"})
+        assert r["timed_out"] is True
+    finally:
+        _hold_all(cluster, None)
+
+
+def test_tasks_rest_endpoints(cluster):
+    from elasticsearch_tpu.rest.controller import RestController
+    from elasticsearch_tpu.rest.handlers import register_all
+    m = cluster.master()
+    rc = RestController()
+    register_all(rc, m)
+    _hold_all(cluster, 2.0)
+    try:
+        out = {}
+        th = threading.Thread(target=lambda: out.update(
+            r=m.search("tasks_idx", {"query": {"match_all": {}}})))
+        th.start()
+
+        def listed():
+            status, body = rc.dispatch(
+                "GET", "/_tasks?actions=indices:data/read/search*", b"")
+            assert status == 200
+            return sum(len(doc["tasks"])
+                       for doc in body["nodes"].values()) >= 2
+        assert wait_until(listed, timeout=5.0)
+        status, text = rc.dispatch("GET", "/_cat/tasks?v=true", b"")
+        assert status == 200
+        assert "indices:data/read/search" in text
+        # _cat/thread_pool spans every cluster node
+        status, text = rc.dispatch("GET", "/_cat/thread_pool", b"")
+        assert status == 200
+        assert len(text.strip().splitlines()) == len(cluster.nodes)
+        th.join(10.0)
+    finally:
+        _hold_all(cluster, None)
+    status, body = rc.dispatch("POST", "/_tasks/nope:42/_cancel", b"")
+    assert status == 404
+    # nodes stats carries the task registry rollup
+    status, body = rc.dispatch("GET", "/_nodes/stats", b"")
+    for doc in body["nodes"].values():
+        assert "active_count" in doc["tasks"]
+
+
+def test_slowlog_line_carries_task_and_parent_id(cluster, caplog):
+    m = cluster.master()
+    svc = m.indices_service.index("tasks_idx")
+    from elasticsearch_tpu.common.settings import Settings
+    svc.search_slow_log.update_settings(Settings(
+        {"index.search.slowlog.threshold.query.warn": "0ms"}))
+    try:
+        task = m.task_manager.register("indices:data/read/search",
+                                       parent_task_id="other:9")
+        with caplog.at_level(logging.WARNING,
+                             logger="index.search.slowlog"):
+            with use_task(task):
+                level = svc.search_slow_log.maybe_log(0.5, "shard[0]")
+        m.task_manager.unregister(task)
+        assert level == "warn"
+        line = caplog.records[-1].getMessage()
+        assert task.task_id in line and "parent[other:9]" in line
+    finally:
+        svc.search_slow_log.update_settings(Settings({}))
+
+
+def test_hot_threads_names_running_task(cluster):
+    m = cluster.master()
+    _hold_all(cluster, 1.5)
+    try:
+        out = {}
+        th = threading.Thread(target=lambda: out.update(
+            r=m.search("tasks_idx", {"query": {"match_all": {}}})))
+        th.start()
+        time.sleep(0.3)
+        from elasticsearch_tpu.monitor import hot_threads
+        report = hot_threads(snapshots=3, interval=0.02, threads=10)
+        th.join(10.0)
+        assert "task[" in report
+    finally:
+        _hold_all(cluster, None)
+
+
+# ---- coordinator-kill chaos scheme (seed-replayable) ------------------------
+
+def test_coordinator_kill_reaps_orphans(test_random):
+    seed = test_random.randrange(2 ** 31)
+    print(f"\n[coordinator_kill] replay with seed={seed}")
+    summary = run_coordinator_kill_case(seed)
+    assert summary["children_before_kill"] >= 1
+
+
+# ---- slow variants: real sockets -------------------------------------------
+
+@pytest.mark.slow
+def test_cancel_propagates_over_tcp(test_random):
+    with InternalTestCluster(num_nodes=3, transport="tcp") as c:
+        m = c.master()
+        m.indices_service.create_index(
+            "tcp_tasks", {"settings": {"number_of_shards": 4,
+                                       "number_of_replicas": 0}})
+        c.wait_for_health("green")
+        for i in range(12):
+            m.index_doc("tcp_tasks", str(i), {"body": f"doc {i}"})
+        for n in c.nodes:
+            n.search_actions.shard_query_delay = 8.0
+        try:
+            out = {}
+            th = threading.Thread(target=lambda: out.update(
+                r=m.search("tcp_tasks", {"query": {"match_all": {}}})))
+            th.start()
+            coord = {}
+
+            def coord_visible():
+                for tid, t in m.task_manager.list_tasks().items():
+                    if t["action"] == "indices:data/read/search" \
+                            and "parent_task_id" not in t:
+                        coord["id"] = tid
+                        return True
+                return False
+            assert wait_until(coord_visible, timeout=10.0)
+            assert m.cancel_task(coord["id"])["found"]
+            th.join(15.0)
+            assert out["r"].get("cancelled") is True
+        finally:
+            for n in c.nodes:
+                n.search_actions.shard_query_delay = None
+        assert wait_until(
+            lambda: all(n.task_manager.active_count() == 0
+                        for n in c.nodes), timeout=10.0)
+        for n in c.nodes:
+            assert n.breaker_service.breaker("request").used == 0
+
+
+@pytest.mark.slow
+def test_coordinator_kill_reaps_orphans_tcp(test_random):
+    seed = test_random.randrange(2 ** 31)
+    print(f"\n[coordinator_kill tcp] replay with seed={seed}")
+    summary = run_coordinator_kill_case(seed, transport="tcp")
+    assert summary["children_before_kill"] >= 1
