@@ -1149,6 +1149,25 @@ def main() -> int:
     recall_ok = bool(kernel_ok and engine_ok and
                      (oracle_recall is None or oracle_recall >= 0.999))
     qps = engine.get("qps", kernel_qps)
+    # collective-plane accounting for the artifact: how often the run's
+    # searches stayed on a compiled path (admission rate) and how many
+    # shard_map trace+compiles the shape-keyed program cache actually
+    # paid (mesh_program_misses) vs re-dispatched (hits)
+    from elasticsearch_tpu.search import jit_exec as _jx_stats
+    _js = _jx_stats.cache_stats()
+    _m_total = _js["mesh_program_hits"] + _js["mesh_program_misses"]
+    engine["collective_plane"] = {
+        "mesh_dispatches": _m_total,
+        "program_compiles": _js["mesh_program_misses"],
+        "program_cache_hits": _js["mesh_program_hits"],
+        "admission_rate": round(
+            _m_total / max(_m_total + _js["plane_fallbacks"], 1), 3),
+        "fallback_reasons": _js["fallback_reasons"],
+    }
+    log(f"[bench] collective plane: {_m_total} mesh dispatches, "
+        f"{_js['mesh_program_misses']} program compiles, "
+        f"admission rate "
+        f"{engine['collective_plane']['admission_rate']}")
     record = {
         "metric": "bm25_top1000_qps_per_chip",
         "value": round(qps, 2),

@@ -290,6 +290,25 @@ class ShardRequestCache:
             return {**self.stats, "entries": len(self._lru)}
 
 
+class _PackCharge:
+    """One-shot fielddata reservation for a collective-plane mesh pack:
+    released exactly once — by supersession (refresh rebuild), cache
+    eviction, index close, or any backing engine's close listener —
+    whichever comes first."""
+
+    __slots__ = ("breaker_service", "nbytes")
+
+    def __init__(self, breaker_service, nbytes: int):
+        self.breaker_service = breaker_service
+        self.nbytes = int(nbytes)
+
+    def release(self) -> None:
+        bs, n = self.breaker_service, self.nbytes
+        self.nbytes = 0
+        if bs is not None and n:
+            bs.breaker("fielddata").release(n)
+
+
 class SearchActions:
     QUERY_FETCH = "indices:data/read/search[phase/query+fetch]"
     QUERY_ID = "indices:data/read/search[phase/query]"
@@ -313,6 +332,12 @@ class SearchActions:
         # while they cancel it / kill its coordinator)
         self.shard_query_delay: float | None = None
         self._rotation = itertools.count()
+        # multi-index collective-plane packs: names-tuple → (gens,
+        # MeshEngineSearcher, breaker bytes, index identity); single-index
+        # packs cache on the index object itself (and die with it)
+        from collections import OrderedDict
+        self._mesh_multi: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._mesh_multi_lock = threading.Lock()
         self._contexts: dict[str, _ScrollContext] = {}
         self._ctx_ids = itertools.count(1)
         # data-node side scroll pins: (ctx_uid, index, shard) →
@@ -975,65 +1000,136 @@ class SearchActions:
                                                    preference=preference)
         return resp
 
+    #: search types the plane can serve: dfs types score with global
+    #: statistics (the mesh's native mode); the rest score each shard
+    #: with its OWN statistics, bit-matching the default fan-out
+    PLANE_SEARCH_TYPES = (None, "query_then_fetch", "query_and_fetch",
+                          "dfs_query_then_fetch", "dfs_query_and_fetch")
+
+    @staticmethod
+    def _note_plane_fallback(indices, reason: str) -> None:
+        """One plane admission attempt that fell back to the fan-out:
+        label the node-wide reason counter AND each target index's
+        admission stats (surfaced in _stats / _nodes/stats). Admission
+        declines are NOT compiled-path `fallbacks` — the request still
+        runs correctly on the RPC fan-out."""
+        from elasticsearch_tpu.search import jit_exec
+        jit_exec.note_plane_fallback(reason)
+        for index in indices:
+            index.note_plane_fallback(reason)
+
     def _try_collective_plane(self, names, bodies: list, reqs: list,
-                              t0: float) -> list[dict] | None:
+                              t0: float,
+                              search_type: str | None = None
+                              ) -> list[dict] | None:
         """→ full search responses for a BATCH of bodies served by ONE
-        mesh program, or None (not opted in / shards not all local /
+        mesh program, or None (opted out / shards not all local /
         ineligible shape — the caller proceeds with the ordinary
-        fan-out). The merged global top-k of each item splits back by
-        owning shard so the standard winner-only fetch assembles hits;
+        fan-out). DEFAULT-ON: eligible searches ride the plane unless
+        `index.search.collective_plane: false` opts the index out. The
+        merged global top-k of each item splits back by owning (index,
+        shard) so the standard winner-only fetch assembles hits;
         _msearch groups ride the same call with B > 1 (the batch IS the
-        accelerator's unit of work)."""
-        if len(names) != 1:
+        accelerator's unit of work), and a multi-index request packs
+        every index's shard columns into the SAME program — one mesh
+        dispatch for an msearch spanning indices."""
+        if not names or search_type not in self.PLANE_SEARCH_TYPES:
             return None
-        for req in reqs:
-            # sort / post_filter / min_score / search_after-with-sort /
-            # metric + terms/histogram aggs now run IN-PROGRAM — the
-            # mesh searcher itself raises QueryParsingError for the
-            # residual ineligible shapes (scripts, geo, keyword sorts,
-            # sub-aggs) and the fan-out handles them
-            if req.suggest or req.terminate_after is not None \
-                    or req.timeout_ms is not None or req.rescore:
+        svc = self.node.indices_service
+        indices = []
+        for nm in names:
+            index = svc.indices.get(nm)
+            if index is None:
+                return None               # an index without local shards
+            if str(index.index_settings.get(
+                    "index.search.collective_plane", "true")).lower() \
+                    in ("false", "0"):
+                return None               # explicit opt-out
+            indices.append(index)
+        owners = []                       # (index, local shard id)
+        for index in indices:
+            nshards = index.meta.number_of_shards
+            if set(index.engines) != set(range(nshards)):
+                self._note_plane_fallback(indices, "not-local")
+                return None               # not every shard lives here
+            owners.extend((index, sid) for sid in range(nshards))
+        if len(owners) < 2:
+            return None                   # single shard: nothing to merge
+        if not any(e.acquire_searcher().segments
+                   for index in indices for e in index.shard_engines):
+            return None                   # nothing indexed yet: the
+        for req in reqs:                  # fan-out's empty response
+            if req.suggest or req.rescore:
+                self._note_plane_fallback(indices, "ineligible-shape")
                 return None
-        index = self.node.indices_service.indices.get(names[0])
-        if index is None:
-            return None
-        if str(index.index_settings.get(
-                "index.search.collective_plane", "false")).lower() \
-                not in ("true", "1"):
-            return None
-        nshards = index.meta.number_of_shards
-        if nshards < 2 or set(index.engines) != set(range(nshards)):
-            return None                   # not every shard lives here
-        if not self._plane_precheck(index, reqs):
-            # always-ineligible shape (keyword/_doc sort, sub-aggs,
-            # score-order search_after, …): bail BEFORE the mesh build —
+        if not all(self._plane_precheck(index, reqs)
+                   for index in indices):
+            # always-ineligible shape (_doc sort, sub-aggs, doc-id score
+            # cursors, …): bail BEFORE the mesh build —
             # _mesh_searcher_for stacks every shard column into HBM, a
             # cost the RPC fallback should not pay per refresh generation
+            self._note_plane_fallback(indices, "ineligible-shape")
             return None
+        from elasticsearch_tpu.search import jit_exec
         from elasticsearch_tpu.search.controller import merge_responses
         from elasticsearch_tpu.search.phase import (ShardQueryResult,
                                                     ShardSearcher)
-        try:
-            msearch = self._mesh_searcher_for(index)
-            outs = msearch.search_batch(list(bodies))
-        except QueryParsingError:
-            return None    # e.g. bucket aggs, geo fields, mixed plans
-        except Exception:                 # noqa: BLE001 — fallback seam
-            from elasticsearch_tpu.search import jit_exec
-            jit_exec.note_fallback()
-            return None
-        searchers = [ShardSearcher(sid, device_reader_for(index.engines[sid]),
-                                   index.mapper_service,
-                                   index_name=index.name)
-                     for sid in range(nshards)]
-        # doc ids map (slot, row) through BOTH point-in-time snapshots:
-        # a refresh between the mesh search and the fetch readers would
-        # make segment layouts disagree — both snapshots are immutable,
-        # so a generation comparison decides validity once, here
-        for si, s in enumerate(searchers):
-            if s.reader.generation != msearch._views[si].generation:
-                return None               # raced a refresh: fan-out path
+        tasks.raise_if_cancelled()
+        global_stats = search_type in ("dfs_query_then_fetch",
+                                       "dfs_query_and_fetch")
+        # A refresh between the mesh pack and the fetch readers would
+        # make (slot, row) resolution disagree — both are immutable
+        # point-in-time snapshots, so a generation comparison decides
+        # validity once. On a race, retry ONCE against the fresh
+        # snapshot (the pack was already built and breaker-charged;
+        # throwing it away for the fan-out wastes that HBM), then yield.
+        msearch = outs = searchers = None
+        for attempt in (0, 1):
+            try:
+                msearch = self._mesh_searcher_for(indices)
+            except QueryParsingError:     # vector/geo/nested layouts
+                self._note_plane_fallback(indices, "ineligible-shape")
+                return None
+            except Exception as e:        # noqa: BLE001 — fallback seam
+                jit_exec.note_fallback(e)
+                self._note_plane_fallback(indices, "device-error")
+                return None
+            if any(r.terminate_after is not None for r in reqs) and \
+                    msearch.n_slots > 1:
+                # terminate_after over multi-segment shards diverges
+                # from the fan-out's segment-prefix semantics — stay
+                # exact, let the fan-out serve it
+                self._note_plane_fallback(indices, "ineligible-shape")
+                return None
+            try:
+                outs = msearch.search_batch(list(bodies),
+                                            global_stats=global_stats)
+            except QueryParsingError as e:
+                # the mesh's own bails name the RPC path; anything else
+                # is a body that failed the plane's re-parse
+                self._note_plane_fallback(
+                    indices, "ineligible-shape" if "RPC" in str(e)
+                    else "parse-error")
+                return None
+            except TaskCancelledError:
+                raise
+            except Exception as e:        # noqa: BLE001 — fallback seam
+                jit_exec.note_fallback(e)
+                self._note_plane_fallback(indices, "device-error")
+                return None
+            searchers = [
+                ShardSearcher(sid, device_reader_for(index.engines[sid]),
+                              index.mapper_service,
+                              index_name=index.name,
+                              version_fn=index.engines[sid].doc_version)
+                for index, sid in owners]
+            if all(s.reader.generation == msearch._views[si].generation
+                   for si, s in enumerate(searchers)):
+                break
+            if attempt == 1:              # raced twice: fan-out path
+                self._note_plane_fallback(indices, "refresh-race")
+                return None
+        index_names = [index.name for index, _ in owners]
         responses = []
         q_ms = (time.perf_counter() - t0) * 1e3
         for body, req, out in zip(bodies, reqs, outs):
@@ -1047,34 +1143,52 @@ class SearchActions:
                     (rdoc, float(sc),
                      sort_vals[pos] if sort_vals is not None else None))
             results = []
+            ta = req.terminate_after
             for si, s in enumerate(searchers):
                 rows = per_shard.get(si, [])
+                # real per-shard totals from the program's all_gather
+                # count lane; terminate_after caps them like the
+                # fan-out's per-shard collection cap
+                raw_total = int(out["shard_totals"][si])
                 results.append(ShardQueryResult(
                     si,
-                    # real per-shard totals from the program's
-                    # all_gather count lane
-                    int(out["shard_totals"][si]),
+                    raw_total if ta is None else min(raw_total, ta),
                     max((sc for _, sc, _ in rows), default=None),
                     np.asarray([d for d, _, _ in rows], np.int32),
                     np.asarray([sc for _, sc, _ in rows], np.float32),
                     [sv for _, _, sv in rows]
                     if sort_vals is not None else None,
                     {}, s.reader))
-            resp = merge_responses(index.name, req, results, searchers,
+                if ta is not None and raw_total >= ta:
+                    results[-1].terminated_early = True
+            resp = merge_responses(index_names, req, results, searchers,
                                    (time.perf_counter() - t0) * 1e3, None)
             mesh_aggs = out.get("aggregations")
             if req.aggs and mesh_aggs is not None:
                 resp["aggregations"] = mesh_aggs
+            # elapsed-time truth: the request `timeout` and the task
+            # deadline (PR-2 wiring) both bound the plane's one dispatch
+            if req.timeout_ms is not None and \
+                    (time.perf_counter() - t0) * 1e3 > req.timeout_ms:
+                resp["timed_out"] = True
+            cur = tasks.current_task()
+            if cur is not None and cur.deadline is not None and \
+                    time.monotonic() > cur.deadline:
+                resp["timed_out"] = True
             responses.append(resp)
             # operators watch _stats/slow logs — the plane must feed
-            # them like the fan-out does (one note per request; per-shard
-            # granularity does not exist in a one-program execution)
-            index.note_search(body.get("stats"), q_ms / len(bodies))
-            if index.search_slow_log.thresholds:
-                index.search_slow_log.maybe_log(
-                    q_ms / 1e3 / len(bodies),
-                    f"collective-plane, source"
-                    f"[{json.dumps(body)[:512]}]")
+            # them like the fan-out does (one note per request per
+            # index; per-shard granularity does not exist in a
+            # one-program execution)
+            for index in indices:
+                index.note_search(body.get("stats"), q_ms / len(bodies))
+                if index.search_slow_log.thresholds:
+                    index.search_slow_log.maybe_log(
+                        q_ms / 1e3 / len(bodies),
+                        f"collective-plane, source"
+                        f"[{json.dumps(body)[:512]}]")
+        for index in indices:
+            index.note_plane_served(len(bodies))
         return responses
 
     @staticmethod
@@ -1085,21 +1199,30 @@ class SearchActions:
         raises QueryParsingError → RPC fallback)."""
         from elasticsearch_tpu.parallel.mesh_engine import _MESH_METRICS
         from elasticsearch_tpu.search.phase import _is_score_order
-        string_types = ("keyword", "string", "text")
         for req in reqs:
             if _is_score_order(req.sort):
-                if req.search_after is not None:
-                    return False          # score-order cursors are
-            else:                         # doc-id-relative (plane-local)
+                if req.search_after is not None and (
+                        req.sort or len(req.search_after) != 1):
+                    # a doc-id cursor component is numbering-relative
+                    # (reader-local vs plane-local); an EXPLICIT _score
+                    # sort makes the fan-out ignore the cursor — both
+                    # stay host-side
+                    return False
+            else:
                 for spec in req.sort:
-                    (fname, _), = spec.items()
+                    (fname, opts), = spec.items()
                     if fname == "_doc":
                         return False
                     if fname == "_score":
                         continue
                     fm = index.mapper_service.field_mapper(fname)
-                    if fm is not None and fm.type in string_types:
-                        return False      # keyword sorts stay host-side
+                    if fm is not None and fm.type == "text":
+                        return False      # analyzed text never sorts
+                    if fm is not None and \
+                            fm.type in ("keyword", "string") and \
+                            opts.get("missing", "_last") not in \
+                            ("_last", "_first"):
+                        return False      # custom missing TERM: host
             for node in req.aggs:
                 if node.subs or node.pipelines:
                     return False
@@ -1113,51 +1236,110 @@ class SearchActions:
                         return False      # analyzed-text terms
         return True
 
-    def _mesh_searcher_for(self, index):
-        """Cache per segment-generation tuple (a refresh on any shard
-        rebuilds — reader reacquisition semantics). The mesh packs its
-        own stacked copy of the shard columns: the opt-in trades HBM for
-        dispatch count — accounted against the fielddata breaker like
-        every other HBM residency (device_reader_for does the same), and
-        built under a per-index lock so concurrent dfs searches cannot
-        double-pack."""
-        import threading
-        import jax
-        from elasticsearch_tpu.parallel import make_mesh
+    def _plane_mesh_get(self):
+        """One shared 1-device mesh for every plane pack on this node:
+        re-using the SAME Mesh object keeps NamedSharding identity stable
+        so shape-keyed programs re-dispatch without retracing."""
+        mesh = getattr(self, "_plane_mesh", None)
+        if mesh is None:
+            import jax
+            from elasticsearch_tpu.parallel import make_mesh
+            mesh = make_mesh(dp=1, shard=1, devices=[jax.devices()[0]])
+            self._plane_mesh = mesh      # benign race: equal meshes
+        return mesh
+
+    @staticmethod
+    def _release_pack(entry) -> None:
+        """Return a mesh pack's fielddata reservation (idempotent)."""
+        if entry is None:
+            return
+        charge = getattr(entry[1], "_pack_charge", None)
+        if charge is not None:
+            charge.release()
+
+    def _mesh_build(self, indices: list, cached):
+        """DATA layer build: stack every index's shard columns into one
+        MeshEngineSearcher → (gens, msearch, breaker bytes), reusing
+        `cached` when no engine's reader generation moved. The pack
+        trades HBM for dispatch count — accounted against the fielddata
+        breaker like every other HBM residency (device_reader_for does
+        the same) via a one-shot charge that ALSO releases when any
+        backing engine closes (shard relocation / teardown must not
+        strand breaker budget). Compiled programs live in mesh_engine's
+        module-level SHAPE-keyed cache, so a rebuild here re-dispatches
+        them instead of re-tracing."""
         from elasticsearch_tpu.parallel.mesh_engine import (
             MeshEngineSearcher)
-        lock = index.__dict__.setdefault("_mesh_lock", threading.Lock())
-        with lock:
-            gens = tuple(e.acquire_searcher().generation
-                         for e in index.shard_engines)
-            cached = index.__dict__.get("_mesh_cache")
-            if cached is not None and cached[0] == gens:
-                return cached[1]
-            bs = getattr(self.node, "breaker_service", None)
-            new_bytes = sum(seg.memory_bytes()
-                            for e in index.shard_engines
-                            for seg in e.acquire_searcher().segments)
-            old_bytes = cached[2] if cached is not None else 0
-            if bs is not None:
-                fd = bs.breaker("fielddata")
-                if new_bytes > old_bytes:
-                    fd.add_estimate(new_bytes - old_bytes,
-                                    f"mesh plane [{index.name}]")
-                else:
-                    fd.release(old_bytes - new_bytes)
-            try:
-                mesh = make_mesh(dp=1, shard=1,
-                                 devices=[jax.devices()[0]])
-                msearch = MeshEngineSearcher(
-                    mesh, list(index.shard_engines),
-                    index.mapper_service)
-            except BaseException:
-                if bs is not None and new_bytes > old_bytes:
-                    bs.breaker("fielddata").release(new_bytes - old_bytes)
-                raise
-            index.__dict__["_mesh_cache"] = (
-                gens, msearch, new_bytes if bs is not None else 0)
-            return msearch
+        engines, mappers = [], []
+        for index in indices:
+            for sid in sorted(index.engines):
+                engines.append(index.engines[sid])
+                mappers.append(index.mapper_service)
+        gens = tuple(e.acquire_searcher().generation for e in engines)
+        if cached is not None and cached[0] == gens:
+            return cached[:3]
+        self._release_pack(cached)       # superseded pack returns first
+        bs = getattr(self.node, "breaker_service", None)
+        new_bytes = sum(seg.memory_bytes() for e in engines
+                        for seg in e.acquire_searcher().segments)
+        charge = _PackCharge(bs, new_bytes if bs is not None else 0)
+        if bs is not None:
+            bs.breaker("fielddata").add_estimate(
+                new_bytes,
+                f"mesh plane "
+                f"[{','.join(index.name for index in indices)}]")
+        try:
+            msearch = MeshEngineSearcher(
+                self._plane_mesh_get(), engines,
+                indices[0].mapper_service, mapper_services=mappers)
+        except BaseException:
+            charge.release()
+            raise
+        msearch._pack_charge = charge
+        for e in engines:
+            lst = e.__dict__.setdefault("_close_listeners", [])
+            # superseded packs' one-shots are spent — prune them so
+            # long-lived engines don't accumulate dead callbacks
+            lst[:] = [cb for cb in lst
+                      if getattr(cb.__self__, "nbytes", 1)]
+            lst.append(charge.release)
+        return (gens, msearch, charge.nbytes)
+
+    def _mesh_searcher_for(self, indices: list):
+        """Per-generation DATA-layer cache (a refresh on any shard
+        rebuilds — reader reacquisition semantics), built under a lock
+        so concurrent searches cannot double-pack. Single-index packs
+        live on the index object (released by IndexService.close);
+        multi-index packs live in a small LRU here, validated against
+        live index identity (a deleted/recreated index must not serve a
+        stale pack) and breaker-released on eviction."""
+        import threading
+        if len(indices) == 1:
+            index = indices[0]
+            lock = index.__dict__.setdefault("_mesh_lock",
+                                             threading.Lock())
+            with lock:
+                entry = self._mesh_build(
+                    indices, index.__dict__.get("_mesh_cache"))
+                index.__dict__["_mesh_cache"] = entry
+                return entry[1]
+        key = tuple(index.name for index in indices)
+        ids = tuple(id(index) for index in indices)
+        with self._mesh_multi_lock:
+            cached = self._mesh_multi.get(key)
+            if cached is not None and cached[3] != ids:
+                # an index was deleted/recreated under the same name:
+                # the pack is stale, return its budget and rebuild
+                self._release_pack(cached)
+                del self._mesh_multi[key]
+                cached = None
+            entry = self._mesh_build(indices, cached)
+            self._mesh_multi[key] = entry + (ids,)
+            self._mesh_multi.move_to_end(key)
+            while len(self._mesh_multi) > 4:
+                _, old = self._mesh_multi.popitem(last=False)
+                self._release_pack(old)
+            return entry[1]
 
     def _dfs_phase(self, state, groups, body: dict) -> dict:
         """The DFS round preceding the query round
@@ -1191,19 +1373,24 @@ class SearchActions:
         groups = self._shard_groups(state, names, routing=routing,
                                     preference=preference)
         dfs = None
-        if search_type == "dfs_query_then_fetch" and dfs_cache is None \
-                and routing is None and preference is None:
-            # (routed/preference-restricted searches skip the plane: its
-            # one-program fan-out always covers EVERY shard, and
-            # restricting the mesh would cost a recompile per subset)
-            # collective plane (opt-in): when this node holds EVERY shard
-            # of a single opted-in index, an eligible dfs search runs as
+        if dfs_cache is None and scroll_pin is None and routing is None \
+                and preference is None:
+            # collective plane (DEFAULT-ON): when this node holds EVERY
+            # shard of the target indices, an eligible search runs as
             # ONE shard_map program — per-shard emit, all_gather top-k
-            # merge, psum counts and metric aggs, global DFS statistics —
-            # instead of the dfs round + per-shard fan-out + host merge
-            # (SURVEY §2.2: scatter/gather + reduce onto ICI collectives)
+            # merge, psum counts, metric/bucket aggs — instead of the
+            # per-shard fan-out + host merge (SURVEY §2.2: scatter/
+            # gather + reduce onto ICI collectives). dfs types score
+            # with global statistics (the plane's native mode); plain
+            # searches score each shard with its own statistics,
+            # bit-matching the fan-out. Routed/preference-restricted
+            # searches skip it (the one-program fan-out always covers
+            # EVERY shard; restricting the mesh would cost a recompile
+            # per subset) and scroll pages need pinned readers the pack
+            # does not provide.
             mesh_resp = self._try_collective_plane(names, [body], [req],
-                                                   t0)
+                                                   t0,
+                                                   search_type=search_type)
             if mesh_resp is not None:
                 return mesh_resp[0]
         if search_type == "dfs_query_then_fetch":
@@ -1457,16 +1644,21 @@ class SearchActions:
         if not valid:
             return [o for o in outs]
         send_bodies = [bodies[i] for i in valid]
-        if search_type in ("dfs_query_then_fetch", "dfs_query_and_fetch"):
-            # a dfs msearch group is the collective plane's natural
-            # batch: ONE mesh program scores every item with global
-            # statistics; fallback runs the items individually
+        if search_type in self.PLANE_SEARCH_TYPES:
+            # an msearch group is the collective plane's natural batch:
+            # ONE mesh program scores every item — global statistics for
+            # dfs groups, per-shard statistics otherwise — and a group
+            # whose expression spans several indices still packs into
+            # the same single dispatch; fallback runs the items through
+            # the ordinary paths
             mesh_outs = self._try_collective_plane(
-                names, send_bodies, [parsed[i] for i in valid], t0)
+                names, send_bodies, [parsed[i] for i in valid], t0,
+                search_type=search_type)
             if mesh_outs is not None:
                 for i, r in zip(valid, mesh_outs):
                     outs[i] = r
                 return [o for o in outs]
+        if search_type in ("dfs_query_then_fetch", "dfs_query_and_fetch"):
             # per-item dfs fallback, concurrently. A transient pool (not
             # _pool/_msearch_pool) because this frame already RUNS on
             # _msearch_pool and _search_once fans shards onto _pool —

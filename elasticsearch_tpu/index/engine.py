@@ -1058,6 +1058,15 @@ class Engine:
                 from elasticsearch_tpu.index.device_reader import (
                     release_device_reader)
                 release_device_reader(self)
+                # collective-plane packs (and anything else holding
+                # device memory against this engine's segments) release
+                # through close listeners — breaker balance must hold
+                # the moment the ENGINE dies, not only at index close
+                for cb in list(getattr(self, "_close_listeners", ())):
+                    try:
+                        cb()
+                    except Exception:    # noqa: BLE001 — teardown path
+                        pass
                 self.translog.close()
                 self._closed = True
 

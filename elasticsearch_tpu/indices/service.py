@@ -112,6 +112,10 @@ class IndexService:
         self.search_stats = {"query_total": 0, "query_time_ms": 0.0,
                              "fetch_total": 0, "fetch_time_ms": 0.0,
                              "groups": {}}
+        # collective-plane admission accounting: queries served by the
+        # one-program mesh path vs fallbacks to the RPC fan-out, by
+        # reason — the observability the default flip ships with
+        self.plane_stats: dict = {"served": 0, "fallback": {}}
         # per-type indexing counters (ShardIndexingService typeStats)
         self.indexing_types: dict[str, int] = {}
         self.engines: dict[int, Engine] = {}
@@ -257,6 +261,18 @@ class IndexService:
                 out["memory_size_in_bytes"] += sum(m.nbytes for m in masks)
         return out
 
+    def note_plane_served(self, queries: int = 1) -> None:
+        """`queries` searches answered by the collective plane (one mesh
+        dispatch may serve a whole msearch batch)."""
+        self.plane_stats["served"] += queries
+
+    def note_plane_fallback(self, reason: str) -> None:
+        """One plane admission attempt that fell back to the RPC fan-out
+        (reasons: ineligible_shape / parse_error / refresh_race /
+        device_error / not_local)."""
+        fb = self.plane_stats["fallback"]
+        fb[reason] = fb.get(reason, 0) + 1
+
     def note_search(self, groups, query_ms: float,
                     fetch_ms: float = 0.0) -> None:
         """One completed shard search (ShardSearchStats.onQueryPhase)."""
@@ -338,6 +354,11 @@ class IndexService:
                 "fetch_time_in_millis":
                     int(self.search_stats["fetch_time_ms"]),
                 "fetch_current": 0,
+                "collective_plane": {
+                    "served": self.plane_stats["served"],
+                    "fallback": dict(self.plane_stats["fallback"]),
+                    "fallback_total":
+                        sum(self.plane_stats["fallback"].values())},
                 "groups": {
                     g: {"query_total": b["query_total"],
                         "query_time_in_millis": int(b["query_time_ms"]),
@@ -370,13 +391,16 @@ class IndexService:
     def close(self):
         for e in self.shard_engines:
             e.close()
-        # return the collective-plane cache's breaker reservation (set by
-        # SearchActions._mesh_searcher_for) — dropping the index must not
-        # strand fielddata budget
+        # return the collective-plane pack's breaker reservation (set by
+        # SearchActions._mesh_searcher_for) — dropping the index must
+        # not strand fielddata budget. The charge is one-shot: the
+        # engine close listeners above normally fired it already; this
+        # covers packs whose engines were removed earlier.
         cached = self.__dict__.pop("_mesh_cache", None)
-        if cached is not None and len(cached) > 2 and cached[2] and \
-                self.breaker_service is not None:
-            self.breaker_service.breaker("fielddata").release(cached[2])
+        if cached is not None:
+            charge = getattr(cached[1], "_pack_charge", None)
+            if charge is not None:
+                charge.release()
 
 
 class IndicesService:

@@ -817,7 +817,15 @@ class Node:
                          "segments": {"count": 0, "memory_in_bytes": 0},
                          "indexing": {"index_total": 0,
                                       "index_time_in_millis": 0}}
+        # collective-plane admission rollup across this node's indices
+        # (per-index detail lives in _stats; the flip to default-on is
+        # observable here: served / fallback-by-reason)
+        plane_total: dict = {"served": 0, "fallback": {}}
         for svc in list(self.indices_service.indices.values()):
+            plane_total["served"] += svc.plane_stats["served"]
+            for reason, n in svc.plane_stats["fallback"].items():
+                plane_total["fallback"][reason] = \
+                    plane_total["fallback"].get(reason, 0) + n
             s = svc.stats()
             indices_total["docs"]["count"] += s["docs"]["count"]
             indices_total["store"]["size_in_bytes"] += \
@@ -833,6 +841,12 @@ class Node:
         recovery = getattr(self, "recovery_service", None)
         indices_total["request_cache"] = \
             self.search_actions.request_cache.stats_dict()
+        indices_total["collective_plane"] = plane_total
+        # compiled-path counters: per-segment program cache plus the
+        # plane's shape-keyed program layer (mesh_program_{hits,misses})
+        # and fallback reasons — the trace/compile budget, observable
+        from elasticsearch_tpu.search import jit_exec as _jit_exec
+        indices_total["jit"] = _jit_exec.cache_stats()
         ps = process_stats()
         osx = os_stats()
         heap = ps["mem"]["resident_in_bytes"]

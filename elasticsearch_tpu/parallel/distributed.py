@@ -30,7 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from elasticsearch_tpu.parallel.mesh import shard_map_compat
 
 from elasticsearch_tpu.ops import lexical, topk as topk_ops
 
@@ -94,12 +95,11 @@ def distributed_bm25_step(mesh: Mesh, k: int, k1: float = 1.2, b: float = 0.75):
         top_docs = jnp.take_along_axis(flat_docs, pos, axis=1)
         return top_scores, top_docs, total_hits
 
-    mapped = shard_map(
+    mapped = shard_map_compat(
         step_local, mesh=mesh,
         in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
                   P("shard", "dp"), P("shard", "dp"), P("shard"), P("shard")),
-        out_specs=(P("dp"), P("dp"), P("dp")),
-        check_vma=False)
+        out_specs=(P("dp"), P("dp"), P("dp")))
     return jax.jit(mapped)
 
 

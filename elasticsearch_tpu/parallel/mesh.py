@@ -15,6 +15,21 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """`jax.shard_map` across the versions this repo meets: new jax
+    exports it top-level with `check_vma`; 0.4.x ships it under
+    jax.experimental with `check_rep`. Replication checking stays off
+    either way (the programs return per-shard lanes on purpose)."""
+    try:
+        from jax import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+
 def make_mesh(dp: int | None = None, shard: int | None = None,
               devices=None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())

@@ -31,11 +31,18 @@ caller falls back to the RPC fan-out):
   argsort (value asc/desc, tie by doc id) and the cross-shard merge
   re-sorts the gathered candidate keys with shard-major tie-break, the
   (sort values, shard, position) order of SearchPhaseController.sortDocs;
+* **keyword sorts** — ordinal columns lift to ranks in a cross-shard
+  UNION vocabulary (the host path's vocab-union, precomputed per data
+  generation into an f32 operand lane; exact below 2^24 terms);
 * **post_filter** — a second mask emit ANDed into hits but not into the
   aggregation mask (SearchContext.postFilter semantics);
 * **min_score** — per-query score threshold const;
 * **search_after with a field sort** — the cursor becomes an in-program
-  lexicographic strictly-greater mask over the transformed sort keys;
+  lexicographic strictly-greater mask over the transformed sort keys
+  (keyword cursor terms map to union ranks, absent terms to the
+  bisect − ½ midpoint);
+* **score-order search_after** — the bare [score] cursor runs as the
+  same in-program (score, doc) continuation mask run_segment applies;
 * **metric aggs** (min/max/sum/avg/value_count/stats) psum'd in-program;
 * **terms / histogram bucket aggs** — fixed-width in-program reductions:
   per-(shard, slot) ordinal counts (exact, vocab-sized) and
@@ -43,6 +50,21 @@ caller falls back to the RPC fan-out):
   window, all_gathered and rendered through the same
   ``reduce_aggs`` pipeline the RPC coordinator uses
   (InternalAggregations.reduce analog).
+
+Two-layer caching: each MeshEngineSearcher instance is the DATA layer
+(stacked columns, rebuilt on refresh); compiled shard_map programs live
+in a module-level SHAPE-keyed cache (plan signature, slot layouts,
+k/batch buckets, sort/agg specs, mesh geometry) that survives data
+rebuilds — a repeated sorted/terms-agg query re-traces at most once per
+shape, counter-verified via jit_exec.mesh_program_{hits,misses}.
+
+Statistics modes: ``search_batch(global_stats=True)`` scores every shard
+with globally aggregated DFS statistics (dfs_query_then_fetch — the
+plane's native mode); ``global_stats=False`` scores each shard with its
+OWN statistics, bit-matching the default fan-out so plain searches ride
+the plane too. Multi-index batches pass one mapper per engine shard
+(``mapper_services``) and pack every index's shard columns into the same
+program.
 
 Results are bit-identical to the RPC path (the host merge concatenates
 shard payloads in the same shard order the all_gather does, and the
@@ -52,13 +74,15 @@ the driver's dryrun_multichip.
 
 from __future__ import annotations
 
+import bisect
 import math
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from elasticsearch_tpu.common.errors import QueryParsingError
@@ -93,6 +117,30 @@ _MAX_HISTO_BUCKETS = 4096
 #: terms agg budget: padded_vocab × batch × shards cells gathered per agg
 _MAX_TERMS_CELLS = 1 << 26
 
+#: keyword-sort union ranks ride the merge as f32 — exact only below 2^24
+_MAX_KW_SORT_VOCAB = 1 << 24
+
+# ---------------------------------------------------------------------------
+# The PROGRAM layer of the collective plane's two-layer cache.
+#
+# A MeshEngineSearcher instance is the DATA layer: stacked shard columns,
+# templates and extrema, rebuilt whenever a refresh bumps any shard's
+# generation. The compiled shard_map programs live here instead, keyed by
+# everything that shapes the traced computation (plan signatures, slot
+# layouts, k/batch buckets, sort/agg specs, mesh geometry) — so a repeated
+# sorted/terms-agg query re-traces at most once per SHAPE, not once per
+# refresh generation. jit_exec's mesh_program_{hits,misses} counters prove
+# the contract (tier-1 regression guard in tests/test_collective_plane.py).
+# ---------------------------------------------------------------------------
+_PROGRAM_CACHE_CAP = 64
+_program_cache: "OrderedDict[tuple, object]" = OrderedDict()
+_program_lock = threading.Lock()
+
+
+def clear_program_cache() -> None:
+    with _program_lock:
+        _program_cache.clear()
+
 
 def _stable_order(keys: list, kk: int):
     """Lexicographic ascending order over column-stacked keys [B, M]
@@ -121,22 +169,28 @@ def _dd_fill(v: float) -> tuple[float, float]:
 
 @dataclass(frozen=True)
 class _SortSpec:
-    """One static sort key: a numeric doc-values field or _score."""
+    """One static sort key: _score, a numeric doc-values field, or a
+    keyword ordinal column lifted to union ranks."""
     field: str                 # "" for _score
     order: str                 # "asc" | "desc"
-    fill: float                # raw missing fill (±inf or numeric missing)
+    fill: float                # missing fill (±inf, numeric missing, rank)
+    kind: str = "numeric"      # "score" | "numeric" | "keyword"
 
     @property
     def is_score(self) -> bool:
-        return self.field == ""
+        return self.kind == "score"
 
 
 def _mesh_sort_spec(reqs, layouts) -> tuple:
     """Validate + extract a batch-uniform field-sort spec.
 
-    → tuple[_SortSpec]. Raises QueryParsingError for sorts the plane
-    can't run in-program (keyword/script sorts, _doc, per-request
-    divergent specs) — callers route those to the RPC path."""
+    → tuple[_SortSpec]. Numeric doc-values sort in-program as
+    double-double keys; keyword fields sort via a per-generation
+    union-rank column (the host vocab-union, precomputed into an f32
+    operand lane). Raises QueryParsingError for sorts the plane can't
+    run in-program (analyzed-text/script sorts, _doc, custom keyword
+    missing, per-request divergent specs) — callers route those to the
+    RPC path."""
     raw0 = reqs[0].sort
     if any(req.sort != raw0 for req in reqs):
         raise QueryParsingError(
@@ -151,18 +205,41 @@ def _mesh_sort_spec(reqs, layouts) -> tuple:
                 "mesh engine plane cannot sort by _doc (doc-id numbering "
                 "is plane-local) — use the RPC fan-out path")
         if fname == "_score":
-            specs.append(_SortSpec("", order, 0.0))
+            specs.append(_SortSpec("", order, 0.0, "score"))
             continue
-        if any(fname in lay.keyword or fname in lay.text
-               for lay in layouts):
+        in_text = any(fname in lay.text for lay in layouts)
+        in_kw = any(fname in lay.keyword for lay in layouts)
+        in_num = any(fname in lay.numeric for lay in layouts)
+        if in_text:
             raise QueryParsingError(
-                f"mesh engine plane sorts numeric doc-values only — "
-                f"[{fname}] needs the host vocab-union path")
+                f"mesh engine plane cannot sort analyzed text "
+                f"[{fname}] — use the RPC fan-out path")
+        if in_kw and in_num:
+            # same name mapped to different column kinds across shards
+            # (multi-index batch with conflicting mappings): rank order
+            # is undefined in one key space — host merge handles it
+            raise QueryParsingError(
+                f"sort field [{fname}] maps to both numeric and keyword "
+                f"columns — use the RPC fan-out path")
+        if in_kw:
+            if missing not in ("_last", "_first"):
+                raise QueryParsingError(
+                    f"keyword sort [{fname}] with a custom missing term "
+                    f"stays host-side — use the RPC fan-out path")
+            fill = math.inf if (missing == "_last") == (order == "asc") \
+                else -math.inf
+            specs.append(_SortSpec(fname, order, fill, "keyword"))
+            continue
         if missing in ("_last", "_first"):
             fill = math.inf if (missing == "_last") == (order == "asc") \
                 else -math.inf
         else:
-            fill = float(missing)
+            try:
+                fill = float(missing)
+            except (TypeError, ValueError):
+                raise QueryParsingError(
+                    f"sort [{fname}] has a non-numeric missing "
+                    f"substitute — use the RPC fan-out path") from None
         specs.append(_SortSpec(fname, order, fill))
     return tuple(specs)
 
@@ -210,6 +287,11 @@ def _mesh_agg_plan(reqs, layouts, field_extrema) -> tuple:
                     raise QueryParsingError(
                         f"terms over analyzed text [{fname}] stays "
                         f"host-side — use the RPC fan-out path")
+                if any(fname in lay.keyword for lay in layouts) and \
+                        any(fname in lay.numeric for lay in layouts):
+                    raise QueryParsingError(
+                        f"terms field [{fname}] maps to both numeric and "
+                        f"keyword columns — use the RPC fan-out path")
                 if any(fname in lay.keyword for lay in layouts):
                     resolved = fname
                 elif any(f"{fname}.keyword" in lay.keyword
@@ -298,10 +380,18 @@ class MeshEngineSearcher:
     """
 
     def __init__(self, mesh: Mesh, engines: list, mapper_service,
-                 k1: float = 1.2, b: float = 0.75):
+                 k1: float = 1.2, b: float = 0.75,
+                 mapper_services: list | None = None):
         from elasticsearch_tpu.ops.similarity import BM25Params
         self.mesh = mesh
         self.mapper_service = mapper_service
+        # multi-index batches: one mapper per engine shard (aligned with
+        # `engines`) so each shard resolves queries against ITS index's
+        # mappings; single-index callers pass just mapper_service
+        self._mappers = list(mapper_services) if mapper_services \
+            else [mapper_service] * len(engines)
+        if len(self._mappers) != len(engines):
+            raise ValueError("mapper_services must align with engines")
         self.k1, self.b = k1, b
         self._bm25 = BM25Params(k1=k1, b=b)
         s_mesh = mesh.shape["shard"]
@@ -362,7 +452,12 @@ class MeshEngineSearcher:
                                          for si in range(s)]),
                                shard_sharding)
                 for i in range(len(per_shard[0]))])
-        self._programs: dict[tuple, callable] = {}
+        # keyword-sort data layer: per (field, fill) union-rank columns
+        # and their vocabularies, built lazily on first keyword sort and
+        # cached for this searcher's point-in-time views
+        self._kw_rank_cache: dict[tuple, tuple] = {}
+        self._kw_sort_vocab: dict[str, list] = {}
+        self._kw_operand_cache: dict[tuple, object] = {}
 
     # ---- packing ----------------------------------------------------------
 
@@ -461,30 +556,117 @@ class MeshEngineSearcher:
             from elasticsearch_tpu.search.query_dsl import BoolQuery
             reader = _TemplateReader(self._templates[si], self._views[si])
             shard_results.append(dfs_mod.shard_dfs(
-                reader, self.mapper_service, BoolQuery(must=list(queries))))
+                reader, self._mappers[si], BoolQuery(must=list(queries))))
         return dfs_mod.to_execution_stats(
             dfs_mod.aggregate_dfs(shard_results))
+
+    # ---- keyword-sort union ranks (data layer) ----------------------------
+
+    def _kw_sort_ranks(self, field: str, fill: float):
+        """→ (ranks [S, stride] f32, union_vocab): every doc's FIRST
+        keyword ordinal lifted to a rank in the cross-shard union
+        vocabulary (the host path's vocab-union, phase._sort_column),
+        missing docs and column-less slots at `fill`. Ranks are exact in
+        f32 below 2^24 terms; larger vocabularies stay host-side."""
+        key = (field, fill)
+        hit = self._kw_rank_cache.get(key)
+        if hit is not None:
+            return hit
+        values: set[str] = set()
+        for v in self._views:
+            for seg in v.segments:
+                c = seg.keyword_fields.get(field)
+                if c is not None:
+                    values.update(c.vocab)
+        if len(values) >= _MAX_KW_SORT_VOCAB:
+            raise QueryParsingError(
+                f"keyword sort [{field}] vocab exceeds the f32-exact "
+                f"rank budget — use the RPC fan-out path")
+        union_vocab = sorted(values)
+        rank_of = {t: i for i, t in enumerate(union_vocab)}
+        ranks = np.full((self.n_shards, self.shard_stride),
+                        np.float32(fill), np.float32)
+        for si, v in enumerate(self._views):
+            for j, lay in enumerate(self._layouts):
+                seg = v.segments[j] if j < len(v.segments) else None
+                if seg is None:
+                    continue
+                c = seg.keyword_fields.get(field)
+                if c is None:
+                    continue
+                first = c.ords[:, 0]
+                have = first >= 0
+                remap = np.array([rank_of[t] for t in c.vocab] or [0],
+                                 np.float32)
+                col = np.full(lay.np_docs, np.float32(fill), np.float32)
+                col[:first.shape[0]][have] = remap[first[have]]
+                base = self.slot_bases[j]
+                ranks[si, base:base + lay.np_docs] = col
+        self._kw_sort_vocab[field] = union_vocab
+        self._kw_rank_cache[key] = (ranks, union_vocab)
+        return ranks, union_vocab
+
+    def _kw_rank_operand(self, sort_specs):
+        """Stacked [S, n_kw, stride] f32 device operand carrying every
+        keyword spec's union-rank column (dummy [S, 1, 1] when the sort
+        has no keyword keys — program shapes stay deterministic per
+        key)."""
+        kw_specs = [sp for sp in (sort_specs or ())
+                    if sp.kind == "keyword"]
+        ckey = tuple((sp.field, sp.fill) for sp in kw_specs)
+        hit = self._kw_operand_cache.get(ckey)
+        if hit is not None:
+            return hit
+        if not kw_specs:
+            arr = np.zeros((self.n_shards, 1, 1), np.float32)
+        else:
+            arr = np.stack(
+                [self._kw_sort_ranks(sp.field, sp.fill)[0]
+                 for sp in kw_specs], axis=1)
+        dev = jax.device_put(arr, NamedSharding(self.mesh, P("shard")))
+        self._kw_operand_cache[ckey] = dev
+        return dev
 
     # ---- the program ------------------------------------------------------
 
     def _program(self, sigs, layouts, k: int, b_pad: int, consts_tree,
                  emits, pfs, refss, templates0, agg_spec=None,
                  bucket_specs=None, sort_specs=None, has_cursor=False):
-        # the compiled program depends only on WHICH fields get partials
-        # (names/kinds are host-side rendering) — key accordingly so
-        # renamed aggs share the executable
+        from elasticsearch_tpu.search import jit_exec
+        # metric lanes return a field-ordered TUPLE, so only WHICH
+        # fields get partials matters (renamed metric aggs share the
+        # executable); bucket lanes return dicts KEYED BY AGG NAME in
+        # the output pytree — names must key the program too
         agg_fields = sorted({f for _, _, f in agg_spec}) if agg_spec \
             else []
         bucket_key = tuple(
-            (b[0], b[2]) + ((b[3], b[4], b[5]) if b[0] == "histogram"
-                            else ())
+            (b[0], b[1], b[2]) + ((b[3], b[4], b[5])
+                                  if b[0] == "histogram" else ())
             for b in bucket_specs) if bucket_specs else ()
-        sort_key = tuple((s.field, s.order, s.fill)
+        sort_key = tuple((s.field, s.order, s.fill, s.kind)
                          for s in sort_specs) if sort_specs else None
+        # programs outlive this searcher (module-level cache): the key
+        # carries every static the closures bake in beyond the plan
+        # signatures and slot layouts — mesh geometry + device identity,
+        # shard blocking, slot bases/stride (doc numbering), per-slot
+        # padded vocab sizes (terms-lane widths), BM25 params, and which
+        # const refs exist (min_score / search_after lanes)
         key = (tuple(sigs), tuple(layouts), k, b_pad, tuple(agg_fields),
                bucket_key, sort_key, has_cursor,
-               tuple(pf is not None for pf in pfs))
-        fn = self._programs.get(key)
+               tuple(pf is not None for pf in pfs),
+               tuple(sorted(refss[0] or {})),
+               tuple(sorted(self.mesh.shape.items())),
+               tuple(int(d.id) for d in self.mesh.devices.flat),
+               self.n_shards, self.spd, self.n_slots,
+               tuple(self.slot_bases), self.shard_stride,
+               tuple(tuple(sorted(lay.kw_vocab.items()))
+                     for lay in self._layouts),
+               float(self.k1), float(self.b))
+        with _program_lock:
+            fn = _program_cache.get(key)
+            if fn is not None:
+                _program_cache.move_to_end(key)
+        jit_exec.note_mesh_program(fn is not None)
         if fn is not None:
             return fn
         n_slots = self.n_slots
@@ -503,8 +685,9 @@ class MeshEngineSearcher:
                        if b[0] == "histogram"]
         kw_vocab = [lay_obj.kw_vocab for lay_obj in self._layouts]
 
-        def step_local(flats, consts, cursors):
+        def step_local(flats, consts, cursors, kwsorts):
             # flats[j]: arrays [spd, Np_j, ...]; consts[j]: [spd, B_local, ...]
+            # kwsorts: [spd, n_kw, stride] keyword-sort union-rank lanes
             from elasticsearch_tpu.ops import aggs_ops
             dev_idx = jax.lax.axis_index("shard").astype(jnp.int32)
             cand = []                    # per-block payload dicts [B, k]
@@ -624,10 +807,18 @@ class MeshEngineSearcher:
                     mask = jnp.concatenate(arr_masks, axis=1)
                     inval = jnp.where(mask, 0.0, 1.0).astype(jnp.float32)
                     thi_list, tlo_list = [], []
+                    kw_i = 0
                     for sp in sort_specs:
                         if sp.is_score:
                             raw_hi, raw_lo = scores, \
                                 jnp.zeros_like(scores)
+                        elif sp.kind == "keyword":
+                            # union-rank lane: exact f32 integers (vocab
+                            # < 2^24), missing already at the fill rank
+                            raw_hi = jnp.broadcast_to(
+                                kwsorts[li][kw_i][None, :], scores.shape)
+                            raw_lo = jnp.zeros_like(scores)
+                            kw_i += 1
                         else:
                             cols_hi, cols_lo = [], []
                             f_hi, f_lo = _dd_fill(sp.fill)
@@ -830,6 +1021,7 @@ class MeshEngineSearcher:
                                     consts_tree[j])
                        for j in range(n_slots)]
         cursor_spec = P("shard", "dp")
+        kwsort_spec = P("shard")
         # out specs mirror step_local's output pytree
         out_specs = {"docs": P("dp"), "scores": P("dp"),
                      "shard_counts": P(None, None, "dp"),
@@ -849,30 +1041,43 @@ class MeshEngineSearcher:
                 out_specs["terms"] = t_named
             if h_named:
                 out_specs["histo"] = h_named
-        mapped = shard_map(
+        from elasticsearch_tpu.parallel.mesh import shard_map_compat
+        mapped = shard_map_compat(
             step_local, mesh=self.mesh,
-            in_specs=(flat_specs, const_specs, cursor_spec),
-            out_specs=out_specs,
-            check_vma=False)
+            in_specs=(flat_specs, const_specs, cursor_spec, kwsort_spec),
+            out_specs=out_specs)
         fn = jax.jit(mapped)
-        self._programs[key] = fn
+        # built OUTSIDE the lock (tracing is slow); a racing duplicate
+        # build is harmless — last one wins the slot, like _get_compiled
+        with _program_lock:
+            _program_cache[key] = fn
+            while len(_program_cache) > _PROGRAM_CACHE_CAP:
+                _program_cache.popitem(last=False)
         return fn
 
-    def search_batch(self, bodies: list[dict]):
+    def search_batch(self, bodies: list[dict], global_stats: bool = True):
         """Execute B query-DSL request bodies as one mesh program →
         list of {"total", "shard_totals", "scores", "doc_ids"
         [, "sort_values"] [, "aggregations"]} with GLOBAL doc ids
-        (resolve via :meth:`resolve`)."""
+        (resolve via :meth:`resolve`).
+
+        ``global_stats`` selects the scoring statistics: True runs the
+        DFS round over every shard (dfs_query_then_fetch semantics — the
+        plane's native mode); False scores each shard with its OWN
+        statistics, bit-matching the default fan-out's per-shard scoring
+        so plain searches can ride the plane too.
+
+        ``terminate_after``/``timeout`` do not bail here: the program's
+        count lane gives the caller exact per-shard totals to cap, and
+        the task deadline (search_action) owns the time budget."""
         if not bodies:
             return []
         reqs = [parse_search_request(b) for b in bodies]
         for req in reqs:
-            if (req.suggest or req.terminate_after is not None
-                    or req.timeout_ms is not None or req.rescore):
+            if req.suggest or req.rescore:
                 raise QueryParsingError(
-                    "mesh engine plane does not run suggest/"
-                    "terminate_after/timeout/rescore — route to the RPC "
-                    "path")
+                    "mesh engine plane does not run suggest/rescore — "
+                    "route to the RPC path")
         from elasticsearch_tpu.search.phase import _is_score_order
         score_order = [_is_score_order(req.sort) for req in reqs]
         if any(s != score_order[0] for s in score_order):
@@ -890,18 +1095,37 @@ class MeshEngineSearcher:
             raise QueryParsingError(
                 "mesh engine plane requires uniform search_after presence")
         has_cursor = has_sa[0]
-        if has_cursor:
-            if sort_specs is None:
-                raise QueryParsingError(
-                    "score-ordered search_after cursors are doc-id-"
-                    "relative — use the RPC fan-out path")
+        score_cursor = False
+        if has_cursor and sort_specs is None:
+            # score-order continuation: admissible for the bare [score]
+            # cursor — it becomes the same in-program (score, doc) mask
+            # run_segment applies, with no doc pivot. A cursor with a
+            # doc-id component is numbering-relative (reader-local in
+            # the fan-out, plane-local here) and stays on the RPC path;
+            # an EXPLICIT [{"_score": "desc"}] sort makes the fan-out
+            # ignore the cursor entirely — match it by bailing.
             for req in reqs:
                 sa = req.search_after
-                if len(sa) != len(sort_specs) or \
-                        any(v is None or isinstance(v, str) for v in sa):
+                if req.sort or len(sa) != 1 or sa[0] is None or \
+                        isinstance(sa[0], str):
                     raise QueryParsingError(
-                        "mesh engine plane needs a full numeric "
-                        "search_after cursor — use the RPC fan-out path")
+                        "score-order search_after cursors with a doc-id "
+                        "component are numbering-relative — use the RPC "
+                        "fan-out path")
+            score_cursor, has_cursor = True, False
+        elif has_cursor:
+            for req in reqs:
+                sa = req.search_after
+                if len(sa) != len(sort_specs):
+                    raise QueryParsingError(
+                        "mesh engine plane needs a full search_after "
+                        "cursor — use the RPC fan-out path")
+                for v, sp in zip(sa, sort_specs):
+                    if v is None or (sp.kind != "keyword"
+                                     and isinstance(v, str)):
+                        raise QueryParsingError(
+                            "mesh engine plane needs typed search_after "
+                            "cursor values — use the RPC fan-out path")
         agg_spec, bucket_specs = _mesh_agg_plan(reqs, self._layouts,
                                                 self._field_extrema)
         if bucket_specs:
@@ -916,15 +1140,20 @@ class MeshEngineSearcher:
                             "gather budget — use the RPC fan-out path")
         import os
         import time
+        from elasticsearch_tpu.search.batching import pow2_bucket
         debug = os.environ.get("MESH_DEBUG")
         t0 = time.perf_counter()
-        k = max(max(r.from_ + r.size, 1) for r in reqs)
+        # k and batch-size BUCKETS: a repeated query shape with a
+        # slightly different size/from or arrival count must re-dispatch
+        # a cached program, not re-trace one (per-request kq slices the
+        # surplus off host-side below)
+        k = pow2_bucket(max(max(r.from_ + r.size, 1) for r in reqs))
         queries = [r.query for r in reqs]
-        dfs_stats = self._global_dfs(queries)
+        dfs_stats = self._global_dfs(queries) if global_stats else None
         t_dfs = time.perf_counter() - t0
         dp = self.mesh.shape["dp"]
         b_real = len(queries)
-        b_pad = -(-b_real // dp) * dp
+        b_pad = pow2_bucket(-(-b_real // dp)) * dp
         reqs_p = reqs + [reqs[-1]] * (b_pad - b_real)
 
         want_arrays = bool(agg_spec or bucket_specs) or \
@@ -946,7 +1175,7 @@ class MeshEngineSearcher:
                 ctx = ExecutionContext(
                     reader=_TemplateReader(self._templates[si],
                                            self._views[si]),
-                    mapper_service=self.mapper_service,
+                    mapper_service=self._mappers[si],
                     bm25=self._bm25,
                     dfs_stats=dfs_stats)
                 row = []
@@ -954,6 +1183,13 @@ class MeshEngineSearcher:
                     flags_q = dict(base_flags,
                                    _min_score=float(req.min_score)
                                    if req.min_score is not None else 0.0)
+                    if score_cursor:
+                        # in-program (score, doc) continuation with no
+                        # doc pivot: ids > -1 is vacuous, so the mask
+                        # reduces to run_segment's score cursor exactly
+                        flags_q.update(search_after=True,
+                                       _sa_score=float(req.search_after[0]),
+                                       _sa_doc=-1)
                     ct, emit_q, emit_pf, refs = _plan(
                         self._templates[si][j], ctx, req.query,
                         req.post_filter, flags_q)
@@ -989,12 +1225,25 @@ class MeshEngineSearcher:
         if has_cursor:
             for bi, req in enumerate(reqs_p):
                 for i, sp in enumerate(sort_specs):
-                    chi, clo = _dd_fill(float(req.search_after[i]))
+                    if sp.kind == "keyword":
+                        # string cursor → union rank; a term absent from
+                        # the union sits between its lexicographic
+                        # neighbors (the host path's bisect − 0.5)
+                        _, union = self._kw_sort_ranks(sp.field, sp.fill)
+                        sval = str(req.search_after[i])
+                        pos = bisect.bisect_left(union, sval)
+                        if pos < len(union) and union[pos] == sval:
+                            chi, clo = float(pos), 0.0
+                        else:
+                            chi, clo = float(pos) - 0.5, 0.0
+                    else:
+                        chi, clo = _dd_fill(float(req.search_after[i]))
                     if sp.order == "desc":
                         chi, clo = -chi, -clo
                     cur_np[:, bi, 2 * i] = float(chi)
                     cur_np[:, bi, 2 * i + 1] = float(clo)
         cursors = jax.device_put(cur_np, q_sharding)
+        kwsorts = self._kw_rank_operand(sort_specs)
 
         t1 = time.perf_counter()
         fn = self._program(sigs, layouts, k, b_pad, consts_dev,
@@ -1003,7 +1252,7 @@ class MeshEngineSearcher:
                             for j in range(self.n_slots)],
                            agg_spec=agg_spec, bucket_specs=bucket_specs,
                            sort_specs=sort_specs, has_cursor=has_cursor)
-        outs = fn(self._flats, consts_dev, cursors)
+        outs = fn(self._flats, consts_dev, cursors, kwsorts)
         t2 = time.perf_counter()
         g_s = np.asarray(outs["scores"])
         g_d = np.asarray(outs["docs"])
@@ -1053,12 +1302,13 @@ class MeshEngineSearcher:
             out.append(res)
         return out
 
-    @staticmethod
-    def _render_sort_values(sort_specs, skeys, bi: int, n_valid: int,
+    def _render_sort_values(self, sort_specs, skeys, bi: int, n_valid: int,
                             kq: int) -> list:
         """Transformed (hi, lo) keys → per-hit hit["sort"] values: f64
         recombine, un-negate desc (FP negation is exact), inf → None
-        (phase._sort_value_out semantics)."""
+        (phase._sort_value_out semantics); keyword ranks map back through
+        the union vocabulary (missing fills land on ±inf → None, like
+        the host path's _last/_first out_fill)."""
         from elasticsearch_tpu.search.phase import _sort_value_out
         rows = []
         for pos in range(min(n_valid, kq)):
@@ -1068,7 +1318,14 @@ class MeshEngineSearcher:
                 raw = np.float64(hi_a[bi][pos]) + np.float64(lo_a[bi][pos])
                 if sp.order == "desc":
                     raw = -raw
-                vals.append(_sort_value_out(raw))
+                if sp.kind == "keyword":
+                    union = self._kw_sort_vocab.get(sp.field, [])
+                    vals.append(
+                        union[int(raw)]
+                        if np.isfinite(raw) and float(raw).is_integer()
+                        and 0 <= int(raw) < len(union) else None)
+                else:
+                    vals.append(_sort_value_out(raw))
             rows.append(vals)
         return rows
 

@@ -24,6 +24,19 @@ import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 
 
+def pow2_bucket(n: int, cap: int | None = None) -> int:
+    """Smallest power of two >= n (>= 1), clamped to `cap` when given.
+
+    The one bucketing rule every batched/jitted layer shares — admission
+    batches here, jit_exec's vmap batch axis, and the mesh plane's k and
+    batch buckets — so a jagged size distribution compiles O(log N)
+    programs instead of one per distinct count."""
+    b = 1 if n <= 1 else 1 << (n - 1).bit_length()
+    if cap is not None and b > cap:
+        return cap
+    return b
+
+
 class AdaptiveBatcher:
     """Deadline-bounded micro-batch admission queue in front of a
     `query_phase_batch`-shaped callable.
@@ -148,11 +161,7 @@ class AdaptiveBatcher:
             # max_batch, plus max_batch itself (full batches form at
             # exactly max_batch anyway) — O(log B) distinct compiles even
             # for a non-power-of-two max_batch
-            bucket = 1
-            while bucket < len(reqs):
-                bucket <<= 1
-            if bucket > self.max_batch:
-                bucket = self.max_batch
+            bucket = pow2_bucket(len(reqs), self.max_batch)
             reqs = reqs + [reqs[i % len(reqs)]
                            for i in range(bucket - len(reqs))]
         if self._drain_batch is not None:

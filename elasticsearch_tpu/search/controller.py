@@ -152,9 +152,14 @@ def merge_shard_payloads(req: ParsedSearchRequest, payloads: list[dict],
                              total_shards, failures)
 
 
-def merge_responses(index_name: str, req: ParsedSearchRequest,
+def merge_responses(index_name: str | list, req: ParsedSearchRequest,
                     results: list[ShardQueryResult], searchers,
                     took_ms: float, agg_nodes) -> dict:
+    """`index_name` is one name, or one name PER SEARCHER — the
+    collective plane's multi-index batches merge shards of several
+    indices in one result list and each hit must render its owner."""
+    names = list(index_name) if isinstance(index_name, (list, tuple)) \
+        else [index_name] * len(searchers)
     page = sort_docs(results, req)
     # fetch phase only on shards owning winning docs (fillDocIdsToLoad)
     by_shard: dict[int, list[int]] = {}
@@ -162,7 +167,8 @@ def merge_responses(index_name: str, req: ParsedSearchRequest,
         by_shard.setdefault(ref.shard_idx, []).append(ref.position)
     fetched: dict[tuple[int, int], dict] = {}
     for si, positions in by_shard.items():
-        hits = searchers[si].fetch_phase(req, results[si], index_name, positions)
+        hits = searchers[si].fetch_phase(req, results[si], names[si],
+                                         positions)
         for pos, hit in zip(positions, hits):
             fetched[(si, pos)] = hit
     hits_out = [fetched[(ref.shard_idx, ref.position)] for ref in page]

@@ -421,7 +421,7 @@ class ShardSearcher:
             # shedding it
             raise
         except Exception as e:                # noqa: BLE001 — fallback seam
-            jit_exec.note_fallback(e)
+            jit_exec.note_fallback(e, reason="device-error")
             return self._query_phase_eager(req)
 
         total = int(sum(int(np.asarray(o["count"])) for _, o in outs))
@@ -535,7 +535,7 @@ class ShardSearcher:
         except QueryParsingError:
             raise
         except Exception as e:            # noqa: BLE001 — fallback seam
-            jit_exec.note_fallback(e)
+            jit_exec.note_fallback(e, reason="device-error")
             return None
         if out is None:                   # mixed plan signatures
             return None
@@ -610,7 +610,7 @@ class ShardSearcher:
         except QueryParsingError:
             raise
         except Exception as e:            # noqa: BLE001 — fallback seam
-            jit_exec.note_fallback(e)
+            jit_exec.note_fallback(e, reason="device-error")
             return None
         if outs_s is None:
             return None

@@ -535,15 +535,19 @@ def test_mesh_sort_with_terms_agg_combined(tmp_path):
 
 
 def test_mesh_rejects_residual_shapes(mesh, engines):
-    """The eligibility frontier after round 5: keyword sorts, _doc sorts,
-    sub-aggs, score-order search_after still route to RPC."""
+    """The eligibility frontier after the default flip: analyzed-text
+    sorts, _doc sorts, sub-aggs, custom keyword missing, and score-order
+    search_after WITH a doc-id component still route to RPC (keyword
+    sorts and bare [score] cursors now ride the plane)."""
     from elasticsearch_tpu.common.errors import QueryParsingError
     ms, engs = engines
     searcher = MeshEngineSearcher(mesh, engs, ms)
     for body in (
             {"query": {"match_all": {}}, "sort": [{"_doc": {}}]},
             {"query": {"match_all": {}}, "sort": [{"t": {}}]},
-            {"query": {"match_all": {}}, "search_after": [1.5]},
+            {"query": {"match_all": {}}, "search_after": [1.5, 7]},
+            {"query": {"match_all": {}},
+             "sort": [{"k": {"missing": "zzz"}}]},
             {"query": {"match_all": {}},
              "aggs": {"a": {"terms": {"field": "n"},
                             "aggs": {"m": {"max": {"field": "n"}}}}}}):
