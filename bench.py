@@ -1145,6 +1145,106 @@ def main() -> int:
             _gc.collect()
             eng_s.close()
 
+    # ---- percolate leg: persistent registry + one-dispatch matching -------
+    # N standing queries × one probe doc: the serial number is the
+    # pre-registry per-query loop (percolate_serial, the in-repo oracle);
+    # the batched number is the fused registry path; the mpercolate number
+    # packs a multi-doc batch into one dispatch per plan shape. Registry
+    # program hits/misses ride the record so a cold-cache run is visible.
+    perc_record = None
+    if os.environ.get("BENCH_PERCOLATE", "1") == "1":
+        from elasticsearch_tpu.cluster.state import IndexMetadata
+        from elasticsearch_tpu.search import percolator as perc_mod
+        from elasticsearch_tpu.search import jit_exec as _jx_p
+        perc_record = {}
+        pvocab = [f"pw{i:03d}" for i in range(200)]
+        prng = np.random.default_rng(77)
+
+        def reg_body(i: int) -> dict:
+            w = pvocab[int(prng.integers(0, len(pvocab)))]
+            kind = i % 3
+            if kind == 0:
+                qq = {"match": {"body":
+                                f"{w} {pvocab[(i * 7) % len(pvocab)]}"}}
+            elif kind == 1:
+                qq = {"term": {"cat": w}}
+            else:
+                qq = {"range": {"rank": {"gte": int(prng.integers(0, 90))}}}
+            return {"query": qq, "group": f"g{i % 8}"}
+
+        pdocs = [{"body": " ".join(pvocab[int(j)] for j in
+                                   prng.integers(0, len(pvocab), 6)),
+                  "cat": pvocab[int(prng.integers(0, len(pvocab)))],
+                  "rank": float(prng.integers(0, 100))}
+                 for _ in range(12)]
+        reg_counts = [int(x) for x in os.environ.get(
+            "BENCH_PERCOLATE_REGS", "1000,10000").split(",")]
+        for n_regs in reg_counts:
+            percs = {f"q{i}": reg_body(i) for i in range(n_regs)}
+            pmeta = IndexMetadata(
+                name=f"bench_perc_{n_regs}", number_of_shards=1,
+                number_of_replicas=0,
+                mappings={"_doc": {"properties": {
+                    "body": {"type": "text", "analyzer": "whitespace"},
+                    "cat": {"type": "keyword"},
+                    "rank": {"type": "double"}}}},
+                percolators=percs, uuid=f"bench{n_regs}", version=1)
+            n_serial = 2 if n_regs <= 1000 else 1
+            t0 = time.perf_counter()
+            ser0 = None
+            for d in pdocs[:n_serial]:
+                ser0 = perc_mod.percolate_serial(pmeta, d)
+            serial_ms = (time.perf_counter() - t0) / n_serial * 1e3
+            b0 = perc_mod.percolate(pmeta, pdocs[0])     # warm (compiles)
+            if n_serial == 1:                # ser0 was the same probe doc
+                assert b0["total"] == ser0["total"], "percolate parity"
+            js0 = _jx_p.cache_stats()
+            n_probes = 24
+            t0 = time.perf_counter()
+            for pi in range(n_probes):
+                out_b = perc_mod.percolate(pmeta, pdocs[pi % len(pdocs)])
+            batched_ms = (time.perf_counter() - t0) / n_probes * 1e3
+            js_mid = _jx_p.cache_stats()
+            # parity on the last probe vs the serial oracle
+            ser_chk = perc_mod.percolate_serial(
+                pmeta, pdocs[(n_probes - 1) % len(pdocs)])
+            perc_ok = ([m["_id"] for m in out_b["matches"]] ==
+                       [m["_id"] for m in ser_chk["matches"]])
+            mitems = [{"doc": d} for d in pdocs]
+            perc_mod.percolate_many(pmeta, mitems)       # warm
+            t0 = time.perf_counter()
+            rounds = 4
+            for _ in range(rounds):
+                perc_mod.percolate_many(pmeta, mitems)
+            mperc_ms = (time.perf_counter() - t0) / (rounds *
+                                                     len(mitems)) * 1e3
+            js1 = _jx_p.cache_stats()
+            reg_st = perc_mod.registry_stats(pmeta.name) or {}
+            perc_record[str(n_regs)] = {
+                "serial_ms_per_probe": round(serial_ms, 2),
+                "batched_ms_per_probe": round(batched_ms, 2),
+                "mpercolate_ms_per_probe": round(mperc_ms, 2),
+                "speedup_x": round(serial_ms / max(batched_ms, 1e-9), 1),
+                "parity_ok": perc_ok,
+                # zero once warm: the registry's whole point
+                "steady_program_misses":
+                    js_mid["percolate_program_misses"]
+                    - js0["percolate_program_misses"],
+                # first multi-doc pack compiles its stacked shapes once
+                "mpercolate_program_misses":
+                    js1["percolate_program_misses"]
+                    - js_mid["percolate_program_misses"],
+                "program_hits": js1["percolate_program_hits"],
+                "program_misses": js1["percolate_program_misses"],
+                "registry": reg_st,
+            }
+            log(f"[bench] percolate {n_regs} regs: serial "
+                f"{serial_ms:.1f} ms/probe vs batched {batched_ms:.1f} "
+                f"ms/probe ({serial_ms / max(batched_ms, 1e-9):.1f}x), "
+                f"mpercolate {mperc_ms:.1f} ms/probe, parity_ok={perc_ok}, "
+                f"steady misses "
+                f"{perc_record[str(n_regs)]['steady_program_misses']}")
+
     oracle_recall = engine.get("oracle_recall_at_k")
     recall_ok = bool(kernel_ok and engine_ok and
                      (oracle_recall is None or oracle_recall >= 0.999))
@@ -1187,6 +1287,7 @@ def main() -> int:
         "kernel": best,
         "kernel_qps": kernel_qps,
         "kernels": results,
+        "percolate": perc_record,
     }
 
     # ---- MS-MARCO-scale headline (BASELINE.json's stated metric) -------
@@ -1210,7 +1311,7 @@ def main() -> int:
                          BENCH_CONFIGS="0", BENCH_CONFIG5="0",
                          BENCH_MESH="0", BENCH_STREAM="0",
                          BENCH_ORACLE="0", BENCH_HEADLINE_8M8="0",
-                         BENCH_CPU_QUERIES="32")
+                         BENCH_PERCOLATE="0", BENCH_CPU_QUERIES="32")
         log(f"[bench] headline corpus: {docs_8m8} docs msmarco "
             f"statistics (engine-only child run)")
         try:
@@ -1245,6 +1346,7 @@ def main() -> int:
                 "engine": child["engine"],
                 "kernel": child["kernel"],
                 "kernel_qps": child["kernel_qps"],
+                "percolate": perc_record,
                 "corpora": {
                     f"zipf_{n_docs // 1_000_000}m": {
                         k_: v_ for k_, v_ in record.items()

@@ -287,6 +287,33 @@ class IndexService:
             b["fetch_total"] += 1
             b["fetch_time_ms"] += fetch_ms
 
+    def _percolate_stats(self) -> dict:
+        """The 2.x percolate stats section plus the registry counters the
+        batched data plane ships with (same pattern as search.
+        collective_plane): ops/time, registered query count, and the
+        persistent-registry maintenance counters that prove repeated
+        percolates rebuild nothing."""
+        from elasticsearch_tpu.search.percolator import registry_stats
+        st = registry_stats(self.name)
+        base = {"total": 0, "time_in_millis": 0, "current": 0,
+                "queries": len(getattr(self.meta, "percolators", {}) or {}),
+                "memory_size_in_bytes": -1}
+        if st is None:
+            return base
+        base.update(total=st["count"], time_in_millis=int(st["time_ms"]),
+                    queries=st["registered"])
+        base["registry"] = {k: st[k] for k in (
+            "builds", "syncs", "adds", "removes", "bucket_invalidations",
+            "mapper_rebuilds", "shape_buckets", "fused_queries",
+            "fallback_queries")}
+        # compiled-lane cache counters (node-global — the program cache is
+        # shared across indices, like indices.jit in _nodes/stats)
+        from elasticsearch_tpu.search import jit_exec
+        js = jit_exec.cache_stats()
+        base["registry"]["program_hits"] = js["percolate_program_hits"]
+        base["registry"]["program_misses"] = js["percolate_program_misses"]
+        return base
+
     def stats(self) -> dict:
         agg = {"index_total": 0, "delete_total": 0, "refresh_total": 0,
                "flush_total": 0, "merge_total": 0, "index_time_ms": 0.0}
@@ -382,7 +409,7 @@ class IndexService:
             "translog": {"operations": translog_ops,
                          "size_in_bytes": translog_bytes},
             "suggest": {"total": 0, "time_in_millis": 0},
-            "percolate": {"total": 0, "time_in_millis": 0},
+            "percolate": self._percolate_stats(),
             "request_cache": {"memory_size_in_bytes": 0, "evictions": 0,
                               "hit_count": 0, "miss_count": 0},
             "recovery": {"current_as_source": 0, "current_as_target": 0},

@@ -821,11 +821,20 @@ class Node:
         # (per-index detail lives in _stats; the flip to default-on is
         # observable here: served / fallback-by-reason)
         plane_total: dict = {"served": 0, "fallback": {}}
+        # percolate rollup: ops/time/registered queries summed across this
+        # node's indices plus the registry program-cache counters (the
+        # compiled-percolation analog of the collective_plane rollup)
+        perc_total: dict = {"total": 0, "time_in_millis": 0, "current": 0,
+                            "queries": 0}
         for svc in list(self.indices_service.indices.values()):
             plane_total["served"] += svc.plane_stats["served"]
             for reason, n in svc.plane_stats["fallback"].items():
                 plane_total["fallback"][reason] = \
                     plane_total["fallback"].get(reason, 0) + n
+            ps_idx = svc._percolate_stats()
+            perc_total["total"] += ps_idx["total"]
+            perc_total["time_in_millis"] += ps_idx["time_in_millis"]
+            perc_total["queries"] += ps_idx["queries"]
             s = svc.stats()
             indices_total["docs"]["count"] += s["docs"]["count"]
             indices_total["store"]["size_in_bytes"] += \
@@ -842,6 +851,7 @@ class Node:
         indices_total["request_cache"] = \
             self.search_actions.request_cache.stats_dict()
         indices_total["collective_plane"] = plane_total
+        indices_total["percolate"] = perc_total
         # compiled-path counters: per-segment program cache plus the
         # plane's shape-keyed program layer (mesh_program_{hits,misses})
         # and fallback reasons — the trace/compile budget, observable

@@ -1652,24 +1652,49 @@ class Handlers:
         return 200, {"_index": index, "_type": ".percolator",
                      "_id": req.path_params["id"], "found": True}
 
+    @staticmethod
+    def _percolate_item(body: dict) -> dict:
+        """Percolate request body → percolate_many item dict (the fidelity
+        surface: size, score/track_scores, sort-by-score, highlight,
+        aggs, registration filter)."""
+        return {
+            "doc": body.get("doc"),
+            "size": body.get("size"),
+            "reg_filter": body.get("filter") or body.get("query"),
+            "score": bool(body.get("score") or body.get("track_scores")),
+            "sort": bool(body.get("sort")),
+            "highlight": body.get("highlight"),
+            "aggs": body.get("aggs") or body.get("aggregations"),
+        }
+
+    @staticmethod
+    def _percolate_render(out: dict, fmt: str | None) -> dict:
+        entry = {"total": out["total"],
+                 "matches": ([m["_id"] for m in out["matches"]]
+                             if fmt == "ids" else out["matches"]),
+                 "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if "aggregations" in out:
+            entry["aggregations"] = out["aggregations"]
+        return entry
+
     def _percolate(self, req: RestRequest) -> dict:
-        from elasticsearch_tpu.search.percolator import percolate
+        from elasticsearch_tpu.search.percolator import percolate_many
         index = self.node.indices_service.resolve(
             req.path_params["index"])[0]
         meta = self.node.cluster_service.state().indices[index]
         body = req.body or {}
-        doc = body.get("doc")
-        if doc is None:
+        if body.get("doc") is None:
             from elasticsearch_tpu.common.errors import IllegalArgumentError
             raise IllegalArgumentError("percolate requires a [doc]")
-        size = body.get("size")
-        return percolate(meta, doc, size=size,
-                         reg_filter=body.get("filter") or body.get("query"))
+        out = percolate_many(meta, [self._percolate_item(body)])[0]
+        if "_exception" in out:
+            raise out["_exception"]
+        return out
 
     def percolate(self, req: RestRequest):
         out = self._percolate(req)
-        return 200, {"total": out["total"], "matches": out["matches"],
-                     "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        return 200, self._percolate_render(out,
+                                           req.param("percolate_format"))
 
     def percolate_count(self, req: RestRequest):
         out = self._percolate(req)
@@ -1705,61 +1730,98 @@ class Handlers:
                 int(got.get("_version", 0)), int(want_version))
         perc_index = req.param("percolate_index", doc_index)
         body = req.body or {}
-        out = self._percolate_doc(
-            perc_index, got["_source"], size=body.get("size"),
-            reg_filter=body.get("filter") or body.get("query"))
-        return 200, {"total": out["total"], "matches": out["matches"],
-                     "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        from elasticsearch_tpu.search.percolator import percolate_many
+        name = self.node.indices_service.resolve(perc_index)[0]
+        meta = self.node.cluster_service.state().indices[name]
+        item = self._percolate_item({**body, "doc": got["_source"]})
+        out = percolate_many(meta, [item])[0]
+        if "_exception" in out:
+            raise out["_exception"]
+        return 200, self._percolate_render(out,
+                                           req.param("percolate_format"))
 
     def percolate_existing_count(self, req: RestRequest):
         status, out = self.percolate_existing(req)
         out.pop("matches", None)
         return status, out
 
+    @staticmethod
+    def _percolate_error_entry(e: Exception) -> dict:
+        from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+        cause = e.to_xcontent() if isinstance(e, ElasticsearchTpuError) \
+            else {"type": "exception", "reason": str(e)}
+        return {"error": {"root_cause": [cause], **cause}}
+
     def mpercolate(self, req: RestRequest):
         """NDJSON multi-percolate (ref: RestMultiPercolateAction):
         alternating {percolate: {index, type}} headers and {doc: ...}
-        bodies; per-item errors never fail the request."""
+        bodies. Per-item errors never fail the request — a malformed
+        header/doc pair (bad JSON, missing doc, unknown index, or a
+        trailing header with no doc line) yields an error entry in its
+        slot while every other item still evaluates. Items sharing an
+        index pack into ONE percolate_many batch, so a multi-doc request
+        rides one fused dispatch per plan shape instead of a per-item
+        loop (the multi-index msearch packing discipline)."""
         default_index = req.path_params.get("index")
         lines = [ln for ln in req.raw_body.decode("utf-8").splitlines()
                  if ln.strip()]
-        if len(lines) % 2 != 0:
-            raise IllegalArgumentError(
-                "mpercolate body must be header/doc line pairs")
-        responses = []
+        specs: list[dict] = []           # per item: parsed spec or _exc
         for i in range(0, len(lines), 2):
             try:
                 header = json.loads(lines[i])
+                if i + 1 >= len(lines):
+                    raise IllegalArgumentError(
+                        "mpercolate header without a following doc line")
                 body = json.loads(lines[i + 1])
                 (verb, spec), = header.items()
+                if verb not in ("percolate", "count"):
+                    raise IllegalArgumentError(
+                        f"unknown mpercolate action [{verb}]")
                 index = spec.get("index", default_index)
                 if verb == "percolate" and "id" in spec:
                     got = self.node.document_actions.get_doc(
                         index, str(spec["id"]),
                         routing=spec.get("routing"))
-                    doc = got.get("_source")
-                else:
-                    doc = body.get("doc")
-                if doc is None:
+                    body = {**body, "doc": got.get("_source")}
+                if body.get("doc") is None:
                     raise IllegalArgumentError(
                         "percolate request requires a [doc]")
-                out = self._percolate_doc(
-                    spec.get("percolate_index", index), doc,
-                    size=body.get("size"),
-                    reg_filter=body.get("filter") or body.get("query"))
-                entry = {"total": out["total"], "matches": out["matches"],
-                         "_shards": {"total": 1, "successful": 1,
-                                     "failed": 0}}
-                if verb == "count":
-                    entry.pop("matches")
-                responses.append(entry)
-            except Exception as e:        # noqa: BLE001 — per-item contract
-                from elasticsearch_tpu.common.errors import (
-                    ElasticsearchTpuError)
-                cause = e.to_xcontent() if isinstance(
-                    e, ElasticsearchTpuError) else \
-                    {"type": "exception", "reason": str(e)}
-                responses.append({"error": {"root_cause": [cause], **cause}})
+                name = self.node.indices_service.resolve(
+                    spec.get("percolate_index", index))[0]
+                specs.append({"verb": verb, "index": name,
+                              "item": self._percolate_item(body)})
+            except Exception as e:       # noqa: BLE001 — per-item contract
+                specs.append({"_exc": e})
+        # group well-formed items by target index: one batched dispatch
+        # per index, per-item errors stitched back by position
+        groups: dict[str, list[int]] = {}
+        for pos, s in enumerate(specs):
+            if "_exc" not in s:
+                groups.setdefault(s["index"], []).append(pos)
+        outs: dict[int, dict] = {}
+        from elasticsearch_tpu.search.percolator import percolate_many
+        for index, positions in groups.items():
+            try:
+                meta = self.node.cluster_service.state().indices[index]
+                batch = percolate_many(
+                    meta, [specs[p]["item"] for p in positions])
+            except Exception as e:       # noqa: BLE001 — per-item contract
+                batch = [{"_exception": e}] * len(positions)
+            for p, o in zip(positions, batch):
+                outs[p] = o
+        responses = []
+        for pos, s in enumerate(specs):
+            exc = s.get("_exc")
+            out = outs.get(pos, {})
+            if exc is None and "_exception" in out:
+                exc = out["_exception"]
+            if exc is not None:
+                responses.append(self._percolate_error_entry(exc))
+                continue
+            entry = self._percolate_render(out, None)
+            if s["verb"] == "count":
+                entry.pop("matches")
+            responses.append(entry)
         return 200, {"responses": responses}
 
     def mtermvectors(self, req: RestRequest):
@@ -3255,6 +3317,13 @@ class Handlers:
                 right=True, default=False),
             Col("creation.date.string", ("cds",), "index creation date "
                 "(ISO8601)", right=True, default=False),
+            Col("percolate.queries", ("pq", "percolateQueries"),
+                "number of registered percolation queries", right=True,
+                default=False),
+            Col("percolate.total", ("pto", "percolateTotal"),
+                "total percolations", right=True, default=False),
+            Col("percolate.time", ("pti", "percolateTime"),
+                "time spent percolating", right=True, default=False),
         ])
         for n in names:
             meta = state.indices.get(n)
@@ -3269,6 +3338,8 @@ class Handlers:
                     store += self._store_bytes(e)
                     for seg in e.segment_stats():
                         deleted += seg["num_docs"] - seg["live_docs"]
+            from elasticsearch_tpu.search.percolator import registry_stats
+            perc = registry_stats(n)
             t.add(**{"health": self._index_health(state, n),
                      "status": meta.state if meta.state == "close"
                      else "open",
@@ -3280,7 +3351,12 @@ class Handlers:
                      "pri.store.size": fmt_bytes(store),
                      "creation.date": meta.creation_date,
                      "creation.date.string":
-                         fmt_epoch_iso(meta.creation_date)})
+                         fmt_epoch_iso(meta.creation_date),
+                     "percolate.queries": (perc or {}).get(
+                         "registered", len(meta.percolators or {})),
+                     "percolate.total": (perc or {}).get("count", 0),
+                     "percolate.time":
+                         f"{(perc or {}).get('time_ms', 0) / 1000:.1f}s"})
         return t.render(req)
 
     def cat_master(self, req: RestRequest):

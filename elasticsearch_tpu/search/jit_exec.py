@@ -56,7 +56,13 @@ _cache_lock = threading.Lock()
 # executions degrading to eager and is held at zero by the jit suites.
 _stats = {"hits": 0, "misses": 0, "fallbacks": 0,
           "mesh_program_hits": 0, "mesh_program_misses": 0,
-          "plane_fallbacks": 0}
+          "plane_fallbacks": 0,
+          # percolate_program_* count the percolator's fused-lane program
+          # cache (run_percolate_lanes): a miss is a fresh trace+compile
+          # for a (probe layout × query-shape set) never seen before, a
+          # hit re-dispatches against new stacked constants — the counters
+          # behind the tier-1 "≤1 compile per plan shape" registry guard.
+          "percolate_program_hits": 0, "percolate_program_misses": 0}
 #: why searches left the compiled/collective path, by label
 #: (ineligible-shape / parse-error / refresh-race / device-error / …)
 _fallback_reasons: dict[str, int] = {}
@@ -108,7 +114,8 @@ def clear_cache() -> None:
         _cache.clear()
         _stats.update(hits=0, misses=0, fallbacks=0,
                       mesh_program_hits=0, mesh_program_misses=0,
-                      plane_fallbacks=0)
+                      plane_fallbacks=0,
+                      percolate_program_hits=0, percolate_program_misses=0)
         _fallback_reasons.clear()
 
 
@@ -624,6 +631,149 @@ def run_segments_streamed(segments: list, ctx: ExecutionContext,
         feeder.join()                       # any consumer-side error
     run_segments_streamed.last_stats = stats
     return outs_all
+
+
+# ---------------------------------------------------------------------------
+# Percolation lanes: many registered queries × one probe doc, one dispatch
+# ---------------------------------------------------------------------------
+
+def pack_query_consts(consts_rows: list) -> tuple | None:
+    """Stack B same-signature queries' ConstTable values into one [B_pad,
+    total] buffer per dtype (the run_segment_batch packing discipline: two
+    packed transfers beat N small ones, and the batch axis pads to the
+    next power of two so varying registration counts share programs).
+    → (specs, packed, b_pad) or None when the shared plan is const-free
+    (the caller runs the program once and broadcasts)."""
+    from elasticsearch_tpu.search.batching import pow2_bucket
+    b = len(consts_rows)
+    b_pad = pow2_bucket(b)
+    if b_pad != b:
+        consts_rows = consts_rows + [consts_rows[-1]] * (b_pad - b)
+    if not consts_rows[0]:
+        return None
+    specs = []                       # per const: (dtype, offset, shape, size)
+    totals: dict[str, int] = {}
+    for v in consts_rows[0]:
+        dt = str(v.dtype)
+        off = totals.get(dt, 0)
+        size = int(v.size)
+        specs.append((dt, off, v.shape, size))
+        totals[dt] = off + size
+    packed = {dt: np.empty((b_pad, total), dtype=dt)
+              for dt, total in totals.items()}
+    for bi, row in enumerate(consts_rows):
+        for v, (dt, off, _shape, size) in zip(row, specs):
+            packed[dt][bi, off:off + size] = v.reshape(-1)
+    return tuple(specs), packed, b_pad
+
+
+def make_percolate_lane(seg: DeviceSegment, emit, sig: tuple,
+                        pos_for: frozenset, vecs_for: frozenset,
+                        consts_rows: list, bm25) -> dict:
+    """One percolate lane = (one probe segment × one same-signature query
+    group): the emit closure of the group's first plan plus every member's
+    constants packed on a leading batch axis. `consts_rows` must all share
+    `sig` (the caller groups by actual plan signature)."""
+    packed_spec = pack_query_consts(consts_rows)
+    if packed_spec is None:
+        specs, packed, b_pad = (), {}, 1     # const-free: run once, broadcast
+    else:
+        specs, packed, b_pad = packed_spec
+    return {
+        "seg": seg, "emit": emit, "specs": specs, "packed": packed,
+        "pos": pos_for, "vecs": vecs_for, "b_pad": b_pad,
+        "b": len(consts_rows),
+        "flat": seg_flatten(seg, pos_for, vecs_for),
+        "key": (sig, layout_key(seg), pos_for, vecs_for,
+                float(bm25.k1), float(bm25.b), b_pad, specs),
+    }
+
+
+def run_percolate_lanes(lanes: list) -> list:
+    """Evaluate percolate lanes as ONE compiled dispatch per PLAN SHAPE:
+    lanes sharing a key (plan signature × probe layout × batch bucket) —
+    e.g. an _mpercolate's D same-shaped probe docs against the same query
+    bucket — stack their segment arrays AND their packed constants on a
+    leading axis and run as one doubly-vmapped program (docs × queries).
+    Inside each lane the probe segment view rebuilds from traced arrays,
+    the group's queries run with their constants unpacked by static
+    slicing, and the per-query (matched, score) pair reduces in-program
+    (ops/percolate.match_reduce_body) so a whole lane's result crosses
+    the link as one small [B, 2] pack.
+
+    Keying per lane (not per lane-SET) is what bounds compiles to ≤1 per
+    plan shape: a probe-dependent lane (wildcard expansion differing per
+    doc) recompiles alone instead of dragging every stable lane with it.
+
+    → one [b, 2] numpy array per lane (match flag, score), batch padding
+    dropped; const-free lanes come back as [1, 2] (callers broadcast)."""
+    from elasticsearch_tpu.ops import percolate as perc_ops
+    from elasticsearch_tpu.search.batching import pow2_bucket
+    if not lanes:
+        return []
+    groups: dict[tuple, list[int]] = {}
+    for i, lane in enumerate(lanes):
+        groups.setdefault(lane["key"], []).append(i)
+    results: list = [None] * len(lanes)
+    pending = []
+    for key, idxs in groups.items():
+        rep = lanes[idxs[0]]
+        n = len(idxs)
+        n_pad = pow2_bucket(n)          # stack axis bucketed like the
+        padded = idxs + [idxs[-1]] * (n_pad - n)   # query batch axis
+        flats = [jnp.stack([lanes[i]["flat"][j] for i in padded])
+                 for j in range(len(rep["flat"]))]
+        packed = {dt: jnp.stack([jnp.asarray(lanes[i]["packed"][dt])
+                                 for i in padded])
+                  for dt in rep["packed"]}
+
+        def compile_fn(rep=rep):
+            def run(flats_in, packed_in):
+                def one(flat_one, packed_one):
+                    view = seg_rebuild(rep["seg"], flat_one,
+                                       rep["pos"], rep["vecs"])
+                    if rep["specs"]:
+                        def one_q(pq):
+                            consts_one = [
+                                pq[dt][off:off + size].reshape(shape)
+                                for dt, off, shape, size in rep["specs"]]
+                            em = EmitCtx(view, consts_one)
+                            scores, mask = rep["emit"](em)
+                            return perc_ops.match_reduce_body(
+                                scores, mask & view.live)
+                        matched, best = jax.vmap(one_q)(packed_one)
+                    else:
+                        # const-free plan (match_all / match_none
+                        # shapes): every query in the group IS the same
+                        # program — run once; the host broadcasts
+                        em = EmitCtx(view, [])
+                        scores, mask = rep["emit"](em)
+                        matched, best = perc_ops.match_reduce_body(
+                            scores, mask & view.live)
+                        matched, best = matched[None], best[None]
+                    return perc_ops.pack_match_result_body(matched, best)
+                return jax.vmap(one)(flats_in, packed_in)
+
+            shapes = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (flats, packed))
+            return jax.jit(run).lower(*shapes).compile()
+
+        full_key = ("percolate", key, n_pad)
+        with _cache_lock:
+            hit = full_key in _cache
+            _stats["percolate_program_hits" if hit
+                   else "percolate_program_misses"] += 1
+        fn = _get_compiled(full_key, compile_fn)
+        out = fn(flats, packed)         # async dispatch: groups pipeline
+        pending.append((idxs, out))
+    for idxs, out in pending:
+        arr = np.asarray(out)           # [n_pad, b(_pad)|1, 2]
+        for row, i in enumerate(idxs):
+            lane = lanes[i]
+            results[i] = arr[row, :lane["b"]] if lane["specs"] \
+                else arr[row]
+    return results
 
 
 def run_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,

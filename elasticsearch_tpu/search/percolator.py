@@ -1,4 +1,4 @@
-"""Percolator — reverse search: match a document against stored queries.
+"""Percolator — reverse search as a batched device workload.
 
 Reference: core/percolator/PercolatorService.java:107 — the doc is parsed
 into a one-document in-memory index (Lucene MemoryIndex) and every
@@ -8,20 +8,715 @@ core/index/percolator/PercolatorQueriesRegistry.java as hidden
 and persisted with the cluster state), and percolation executes on the
 coordinating node against a scratch single-doc segment — no shard fan-out
 needed since the registry is global, not per-shard.
+
+The execution model inverts the reference's query-at-a-time loop
+(thousands of standing queries × one doc is the ideal many-sparse-queries
+batch — the BM25S/GPUSparse argument applied to reverse search):
+
+* **Registry (persistent, per index)** — every registration is parsed and
+  planned ONCE into a shape bucket: the PROGRAM side of a percolation is
+  keyed by plan signature (the PR-3 program/data split), so queries
+  differing only in terms/values share one compiled lane. The registry
+  syncs INCREMENTALLY against cluster-state metadata — register/unregister
+  touches exactly the affected shape bucket; a percolate call that finds
+  the metadata unchanged rebuilds nothing (counter-verified in tier-1).
+  The scratch MapperService (the part of the old per-call rebuild that
+  actually cost milliseconds) is cached alongside, with probe-doc dynamic
+  mappings restored after each call so inference stays per-probe fresh.
+* **One-dispatch evaluation** — per probe doc, each bucket's members
+  resolve against the one-doc segment (dictionary lookups, microseconds)
+  and group by actual plan signature; every (segment × group) lane packs
+  its stacked constants and ALL lanes run as one fused vmapped program
+  (jit_exec.run_percolate_lanes) returning per-query (matched, score)
+  pairs reduced in-program (ops/percolate.py). `percolate_many` packs
+  many docs × many queries into the same single dispatch — the
+  multi-index msearch packing discipline applied to _mpercolate.
+* **Fallback lane** — shapes the fused path can't express (scripts,
+  geo_shape, parent/child joins) run per-query through the eager
+  executor, exactly like the old loop, so behavior never regresses; any
+  device error on the fused path degrades to the same lane
+  (jit_exec.note_fallback, reason-labeled).
+
+Responses carry full fidelity on the same pass: per-match scores, size +
+sort-by-score, highlight via the standard highlighters on the probe doc,
+and aggregations over registration metadata (the hidden-doc fields the
+reference's percolate aggs run on).
 """
 
 from __future__ import annotations
 
+import threading
+import time
+
 import numpy as np
 
 from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.common.errors import QueryParsingError
 from elasticsearch_tpu.common.settings import Settings
 from elasticsearch_tpu.index.device_reader import DeviceReader
 from elasticsearch_tpu.index.engine import SearcherView
 from elasticsearch_tpu.index.segment import SegmentBuilder
 from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.search import query_dsl as q
+from elasticsearch_tpu.search.execute import (ConstTable, ExecutionContext,
+                                              SegmentResolver)
 from elasticsearch_tpu.search.phase import ShardSearcher
 from elasticsearch_tpu.search.query_dsl import parse_query
+
+
+# ---------------------------------------------------------------------------
+# eligibility: which shapes ride the fused vmapped lane
+# ---------------------------------------------------------------------------
+
+#: node types the fused lane does not express: scripts re-enter Python per
+#: doc, geo_shape reads lazy ring columns, and parent/child joins need the
+#: ShardSearcher rewrite pass — all run per-query on the eager executor.
+_FALLBACK_NODES = (q.HasChildQuery, q.HasParentQuery, q.ScriptScoreQuery,
+                   q.GeoShapeQuery)
+_FALLBACK_FUNCTIONS = ("script_score", "random_score")
+
+
+def _needs_fallback(ast) -> bool:
+    if isinstance(ast, _FALLBACK_NODES):
+        return True
+    if isinstance(ast, q.FunctionScoreQuery) and any(
+            f.kind in _FALLBACK_FUNCTIONS for f in ast.functions):
+        return True
+    import dataclasses
+    if not dataclasses.is_dataclass(ast):
+        return False
+    for f in dataclasses.fields(ast):
+        v = getattr(ast, f.name, None)
+        if isinstance(v, q.Query):
+            if _needs_fallback(v):
+                return True
+        elif isinstance(v, (list, tuple)):
+            for el in v:
+                if isinstance(el, q.Query) and _needs_fallback(el):
+                    return True
+                if isinstance(el, q.ScoreFunction) and \
+                        el.filter_query is not None and \
+                        _needs_fallback(el.filter_query):
+                    return True
+    return False
+
+
+def _synthetic_doc(mappings: dict | None) -> dict:
+    """A doc holding every mapped field with a placeholder value — the
+    canonical probe the registry plans registrations against to derive
+    their shape bucket (field columns must EXIST for the plan to take the
+    same structural branches a real probe doc takes)."""
+    def fill(props: dict, out: dict) -> None:
+        for name, spec in (props or {}).items():
+            typ = spec.get("type")
+            if "properties" in spec and typ in (None, "object"):
+                fill(spec["properties"], out.setdefault(name, {}))
+                continue
+            if typ == "nested":
+                sub: dict = {}
+                fill(spec.get("properties", {}), sub)
+                out[name] = [sub]
+            elif typ in ("long", "integer", "short", "byte", "double",
+                         "float", "half_float", "scaled_float", "date"):
+                out[name] = 0
+            elif typ == "boolean":
+                out[name] = True
+            elif typ == "geo_point":
+                out[name] = {"lat": 0.0, "lon": 0.0}
+            elif typ == "dense_vector":
+                out[name] = [0.0] * int(spec.get("dims", 1) or 1)
+            elif typ == "geo_shape":
+                continue                     # fallback lane anyway
+            else:                            # text / keyword / string / ip
+                out[name] = "a"
+    doc: dict = {}
+    for _t, m in (mappings or {}).items():
+        fill(m.get("properties", {}), doc)
+    return doc
+
+
+class _Entry:
+    """One registration: the AST parsed once plus its lane classification."""
+
+    __slots__ = ("ast", "shape", "fallback", "body")
+
+    def __init__(self, ast, shape, fallback: bool, body: dict):
+        self.ast = ast
+        self.shape = shape           # bucket key (None for fallback lane)
+        self.fallback = fallback
+        self.body = body
+
+
+class PercolatorRegistry:
+    """Per-index persistent compiled-query registry.
+
+    Thread-safe: sync/diff and bucket maintenance run under the registry
+    lock; evaluation works on snapshots taken under it."""
+
+    def __init__(self, meta):
+        self.name = meta.name
+        self.uuid = meta.uuid
+        self.stats = {
+            "builds": 1,                 # registry constructions from scratch
+            "syncs": 0,                  # syncs that applied a change
+            "adds": 0, "removes": 0,
+            "bucket_invalidations": 0,   # shape buckets touched by syncs
+            "mapper_rebuilds": 0,        # scratch MapperService rebuilds
+            "count": 0,                  # percolate ops (one per probe doc)
+            "time_ms": 0.0,
+            "fused_queries": 0,          # query evaluations on the fused lane
+            "fallback_queries": 0,       # ... on the per-query eager lane
+        }
+        self._lock = threading.RLock()
+        self._snap: dict | None = None   # meta.percolators as last synced
+        self._version = -1
+        self._map_fp: str | None = None
+        self._mapper: MapperService | None = None
+        self._canon = None               # (DeviceSegment, ExecutionContext)
+        self._entries: dict[str, _Entry] = {}
+        self._order: list[str] = []      # registration order (response order)
+        self._buckets: dict = {}         # shape → {qid: _Entry}
+        self._bucket_gen: dict = {}      # shape → invalidation generation
+        self._reg_gen = 0                # bumps on any registration change
+        self._reg_env = None             # (ids, searcher) over registration docs
+        self._settings = Settings(meta.settings)
+
+    # ---- sync (the cluster/index-metadata registration seam) --------------
+
+    def sync(self, meta) -> None:
+        with self._lock:
+            map_fp = repr(meta.mappings)
+            if self._map_fp != map_fp:
+                self._rebuild_mapper(meta, map_fp)
+                # shapes are planned against the mapping-derived canonical
+                # segment — a mapping change re-buckets everything
+                for qid in list(self._entries):
+                    self._remove(qid, count=False)
+                self._snap = None
+            new = meta.percolators
+            if self._version == meta.version and new is self._snap:
+                return
+            old = self._snap or {}
+            if new is not old:
+                added = [qid for qid in new
+                         if qid not in old or new[qid] != old[qid]]
+                removed = [qid for qid in old if qid not in new]
+                changed = [qid for qid in added if qid in old]
+                if added or removed:
+                    self.stats["syncs"] += 1
+                touched = set()
+                for qid in removed + changed:
+                    touched.add(self._remove(qid))
+                for qid in added:
+                    touched.add(self._add(qid, new[qid]))
+                touched.discard(None)
+                self.stats["bucket_invalidations"] += len(touched)
+                for shape in touched:
+                    self._bucket_gen[shape] = \
+                        self._bucket_gen.get(shape, 0) + 1
+                if added or removed:
+                    self._reg_gen += 1
+                    self._reg_env = None     # registration-doc segment stale
+            self._snap = new
+            self._version = meta.version
+
+    def _rebuild_mapper(self, meta, map_fp: str) -> None:
+        self.stats["mapper_rebuilds"] += 1
+        self._settings = Settings(meta.settings)
+        scratch = MapperService(AnalysisRegistry(self._settings))
+        for t, m in (meta.mappings or {}).items():
+            scratch.merge(t, m)
+        scratch.default_similarity = self._settings.get(
+            "index.similarity.default.type")
+        self._mapper = scratch
+        self._map_fp = map_fp
+        # canonical one-doc env for registration-time shape planning
+        try:
+            parsed = self._parse_probe(_synthetic_doc(meta.mappings))
+        except Exception:                # noqa: BLE001 — canonical is advisory
+            parsed = self._parse_probe({})
+        seg, reader = _probe_reader(parsed)
+        self._canon = (reader.segments[0],
+                       ExecutionContext(reader=reader,
+                                        mapper_service=scratch,
+                                        index_name=self.name))
+
+    def _add(self, qid: str, body: dict):
+        """Parse + plan one registration; → its shape bucket key (None for
+        the fallback lane)."""
+        ast = parse_query((body or {}).get("query"))
+        self.stats["adds"] += 1
+        if _needs_fallback(ast):
+            entry = _Entry(ast, None, True, body)
+        else:
+            shape = self._shape_of(ast)
+            entry = _Entry(ast, shape, shape is None, body)
+        self._entries[qid] = entry
+        if qid not in self._order:
+            self._order.append(qid)
+        if entry.shape is not None:
+            self._buckets.setdefault(entry.shape, {})[qid] = entry
+        return entry.shape
+
+    def _remove(self, qid: str, count: bool = True):
+        entry = self._entries.pop(qid, None)
+        if entry is None:
+            return None
+        if count:
+            self.stats["removes"] += 1
+        self._order.remove(qid)
+        if entry.shape is not None:
+            bucket = self._buckets.get(entry.shape)
+            if bucket is not None:
+                bucket.pop(qid, None)
+                if not bucket:
+                    del self._buckets[entry.shape]
+        return entry.shape
+
+    def _shape_of(self, ast):
+        """Plan the AST once against the canonical mapping-derived segment:
+        the resulting signature is the registration's shape bucket. Plans
+        the canonical env can't express land on the fallback lane (None) —
+        correctness never depends on the bucket, only dispatch shape."""
+        seg, ctx = self._canon
+        try:
+            ct = ConstTable()
+            SegmentResolver(seg, ctx, ct).resolve(ast)
+            return (ct.signature(), frozenset(ct.positions_needed),
+                    frozenset(ct.vectors_needed))
+        except Exception:                # noqa: BLE001 — fallback lane
+            return None
+
+    # ---- probe-doc environment -------------------------------------------
+
+    def _parse_probe(self, doc: dict):
+        """Parse with the CACHED scratch mapper, then restore any
+        dynamically inferred mappers — each probe doc must see the same
+        inference a fresh per-call mapper would (the old rebuild-per-call
+        semantics) without paying the rebuild."""
+        dm = self._mapper.document_mapper()
+        before = set(dm.mappers)
+        parsed = dm.parse("_percolate_doc", doc)
+        self._probe_dynamic = [k for k in dm.mappers if k not in before]
+        return parsed
+
+    def _restore_probe_mappers(self) -> None:
+        dm = self._mapper.document_mapper()
+        for k in getattr(self, "_probe_dynamic", ()):  # keep through eval,
+            dm.mappers.pop(k, None)                    # drop before next doc
+
+    # ---- registration-doc environment (filter + aggs) ---------------------
+
+    def _registration_env(self):
+        """Scratch segment over the registration METADATA docs (every field
+        of a registration except the query itself) — the percolate-request
+        `filter`/`query` constraint and the aggs surface both run against
+        it (the reference queries the hidden .percolator docs the same
+        way). Cached until registrations change: this is DATA-layer state
+        rebuilt only on register/unregister, never per call."""
+        with self._lock:
+            if self._reg_env is not None:
+                return self._reg_env
+            scratch = MapperService(AnalysisRegistry(self._settings))
+            ids = list(self._order)
+            builder = SegmentBuilder(seg_id=0)
+            dm = scratch.document_mapper()
+            for qid in ids:
+                probe = {k: v for k, v in
+                         (self._entries[qid].body or {}).items()
+                         if k != "query"}
+                builder.add(dm.parse(str(qid), probe))
+            seg = builder.build()
+            mask = np.zeros(seg.padded_docs, dtype=bool)
+            mask[:seg.num_docs] = True
+            reader = DeviceReader(SearcherView([seg], [mask], 1))
+            searcher = ShardSearcher(0, reader, scratch,
+                                     index_name=self.name)
+            self._reg_env = (ids, seg, searcher)
+            return self._reg_env
+
+    def _filter_qids(self, reg_filter) -> set:
+        """Which registered query ids a percolate-request filter keeps."""
+        ids, seg, searcher = self._registration_env()
+        if not ids:
+            return set()
+        ast = parse_query(reg_filter)
+        matched = np.zeros(seg.num_docs, dtype=bool)
+        for _, m in searcher._execute_query(ast):
+            matched |= np.asarray(m)[:seg.num_docs].astype(bool)
+        return {qid for i, qid in enumerate(ids) if matched[i]}
+
+    def _collect_aggs(self, aggs_body: dict, matched_qids) -> dict | None:
+        """Aggregations over the registration metadata of the MATCHED
+        queries (PercolatorService aggs phase: buckets over the hidden
+        .percolator docs that matched)."""
+        from elasticsearch_tpu.search.aggregations import (parse_aggs,
+                                                           reduce_aggs,
+                                                           ShardAggContext,
+                                                           collect)
+        nodes = parse_aggs(aggs_body)
+        if not nodes:
+            return None
+        ids, seg, searcher = self._registration_env()
+        mask = np.zeros(seg.padded_docs, dtype=bool)
+        for i, qid in enumerate(ids):
+            if qid in matched_qids:
+                mask[i] = True
+        ctx = ShardAggContext(searcher.reader, searcher.mapper_service,
+                              searcher._filter_masks_np,
+                              exec_ctx=searcher.ctx)
+        partials = {n.name: collect(n, mask, ctx) for n in nodes
+                    if n.type not in _pipeline_aggs()}
+        return reduce_aggs(nodes, [partials])
+
+    # ---- evaluation --------------------------------------------------------
+
+    def run(self, meta, items: list[dict]) -> list[dict]:
+        """Evaluate a batch of percolate requests (one per probe doc) with
+        every fused lane of every item packed into ONE device dispatch.
+        → per item: a result dict, or {"_exception": exc} for a per-item
+        failure (the _mpercolate contract; `percolate` re-raises)."""
+        from elasticsearch_tpu.search import jit_exec
+        t0 = time.perf_counter()
+        with self._lock:
+            order = list(self._order)
+            buckets = {shape: dict(members)
+                       for shape, members in self._buckets.items()}
+            fallback_entries = {qid: e for qid, e in self._entries.items()
+                                if e.fallback}
+            bm25 = ExecutionContext(
+                reader=None, mapper_service=self._mapper).bm25
+        lanes: list[dict] = []
+        lane_owner: list[tuple[int, list[str]]] = []   # lane → (item, qids)
+        per_item: list[dict] = []                      # item scratch state
+        for it_idx, item in enumerate(items):
+            state = {"err": None, "matched": {}, "participating": None,
+                     "fused_qids": []}
+            per_item.append(state)
+            try:
+                doc = item.get("doc")
+                if doc is None:
+                    from elasticsearch_tpu.common.errors import \
+                        IllegalArgumentError
+                    raise IllegalArgumentError(
+                        "percolate requires a [doc]")
+                participating = None
+                if item.get("reg_filter") is not None and order:
+                    participating = self._filter_qids(item["reg_filter"])
+                state["participating"] = participating
+                if not order or (participating is not None
+                                 and not participating):
+                    continue
+                with self._lock:
+                    parsed = self._parse_probe(doc)
+                    try:
+                        seg, reader = _probe_reader(parsed)
+                        ctx = ExecutionContext(reader=reader,
+                                               mapper_service=self._mapper,
+                                               index_name=self.name)
+                        dseg = reader.segments[0]
+                        # fused lanes: per bucket, resolve members against
+                        # the probe segment (microseconds — dictionary
+                        # lookups) and group by ACTUAL plan signature;
+                        # multi-term expansions may split a bucket per
+                        # probe, which only adds a lane, never wrongness
+                        for shape in buckets:
+                            groups: dict = {}
+                            for qid, entry in buckets[shape].items():
+                                if participating is not None and \
+                                        qid not in participating:
+                                    continue
+                                ct = ConstTable()
+                                emit = SegmentResolver(
+                                    dseg, ctx, ct).resolve(entry.ast)
+                                gkey = (ct.signature(),
+                                        frozenset(ct.positions_needed),
+                                        frozenset(ct.vectors_needed))
+                                groups.setdefault(gkey, []).append(
+                                    (qid, emit, ct.values))
+                            for (sig, pos, vecs), rows in groups.items():
+                                lanes.append(jit_exec.make_percolate_lane(
+                                    dseg, rows[0][1], sig, pos, vecs,
+                                    [r[2] for r in rows], bm25))
+                                lane_owner.append(
+                                    (it_idx, [r[0] for r in rows]))
+                                state["fused_qids"].extend(
+                                    r[0] for r in rows)
+                        # fallback lane: per-query eager execution, the
+                        # old loop's exact semantics (incl. join rewrite)
+                        fb = [(qid, e) for qid, e in
+                              fallback_entries.items()
+                              if participating is None
+                              or qid in participating]
+                        if fb:
+                            searcher = ShardSearcher(
+                                0, reader, self._mapper,
+                                index_name=self.name)
+                            for qid, entry in fb:
+                                hit, best = _eager_match(searcher,
+                                                         entry.ast)
+                                if hit:
+                                    state["matched"][qid] = best
+                            self.stats["fallback_queries"] += len(fb)
+                    finally:
+                        self._restore_probe_mappers()
+            except Exception as e:       # noqa: BLE001 — per-item contract
+                state["err"] = e
+        # ---- the one dispatch ------------------------------------------
+        if lanes:
+            try:
+                outs = jit_exec.run_percolate_lanes(lanes)
+                for (it_idx, qids), out in zip(lane_owner, outs):
+                    state = per_item[it_idx]
+                    if out.shape[0] == 1 and len(qids) > 1:
+                        out = np.broadcast_to(out, (len(qids), 2))
+                    for qi, qid in enumerate(qids):
+                        if out[qi, 0] > 0.5:
+                            state["matched"][qid] = float(out[qi, 1])
+                self.stats["fused_queries"] += sum(
+                    len(qids) for _, qids in lane_owner)
+            except QueryParsingError:
+                raise
+            except Exception as e:       # noqa: BLE001 — fallback seam
+                jit_exec.note_fallback(e, reason="device-error")
+                self._eager_rescue(items, per_item)
+        # ---- per-item rendering ------------------------------------------
+        results = []
+        for item, state in zip(items, per_item):
+            if state["err"] is not None:
+                results.append({"_exception": state["err"]})
+                continue
+            try:
+                results.append(self._render(meta, item, state, order))
+            except Exception as e:       # noqa: BLE001 — per-item contract
+                results.append({"_exception": e})
+        dt = (time.perf_counter() - t0) * 1000.0
+        with self._lock:
+            self.stats["count"] += len(items)
+            self.stats["time_ms"] += dt
+        return results
+
+    def _eager_rescue(self, items, per_item) -> None:
+        """Device-error fallback: re-evaluate every fused-lane query of
+        every item on the eager executor (same emit closures — the
+        compiled path's parity oracle), never failing the request."""
+        for item, state in zip(items, per_item):
+            if state["err"] is not None or not state["fused_qids"]:
+                continue
+            with self._lock:
+                parsed = self._parse_probe(item["doc"])
+                try:
+                    _seg, reader = _probe_reader(parsed)
+                    searcher = ShardSearcher(0, reader, self._mapper,
+                                             index_name=self.name)
+                    for qid in state["fused_qids"]:
+                        entry = self._entries.get(qid)
+                        if entry is None:
+                            continue
+                        hit, best = _eager_match(searcher, entry.ast)
+                        if hit:
+                            state["matched"][qid] = best
+                finally:
+                    self._restore_probe_mappers()
+
+    def _render(self, meta, item: dict, state: dict,
+                order: list[str]) -> dict:
+        matched = state["matched"]
+        want_score = bool(item.get("score") or item.get("sort")
+                          or item.get("track_scores"))
+        qids = [qid for qid in order if qid in matched]
+        if item.get("sort"):
+            qids.sort(key=lambda qid: -matched[qid])
+        total = len(qids)
+        size = item.get("size")
+        if size is not None:
+            qids = qids[:int(size)]
+        matches = []
+        for qid in qids:
+            m = {"_index": meta.name, "_id": qid}
+            if want_score:
+                m["_score"] = matched[qid]
+            if item.get("highlight"):
+                entry = self._entries.get(qid)
+                if entry is not None:
+                    from elasticsearch_tpu.search.highlight import \
+                        highlight_hit
+                    hl = highlight_hit(item["highlight"], item["doc"],
+                                       self._mapper, entry.ast)
+                    if hl:
+                        m["highlight"] = hl
+            matches.append(m)
+        out = {"total": total, "matches": matches}
+        if item.get("aggs"):
+            aggregations = self._collect_aggs(item["aggs"], set(matched))
+            if aggregations is not None:
+                out["aggregations"] = aggregations
+        return out
+
+    # ---- introspection -----------------------------------------------------
+
+    def bucket_generations(self) -> dict:
+        with self._lock:
+            return dict(self._bucket_gen)
+
+    def stats_dict(self) -> dict:
+        with self._lock:
+            return {**{k: (round(v, 3) if isinstance(v, float) else v)
+                       for k, v in self.stats.items()},
+                    "registered": len(self._entries),
+                    "shape_buckets": len(self._buckets)}
+
+
+def _pipeline_aggs():
+    from elasticsearch_tpu.search.aggregations import PIPELINE_AGGS
+    return PIPELINE_AGGS
+
+
+def _probe_reader(parsed):
+    """One-doc scratch segment + device reader for a probe document."""
+    builder = SegmentBuilder(seg_id=0)
+    builder.add(parsed)
+    seg = builder.build()
+    mask = np.zeros(seg.padded_docs, dtype=bool)
+    mask[:seg.num_docs] = True
+    return seg, DeviceReader(SearcherView([seg], [mask], 1))
+
+
+def _eager_match(searcher: ShardSearcher, ast) -> tuple[bool, float]:
+    """Per-query eager evaluation (the old loop's semantics): → (matched,
+    best matching score)."""
+    best = -np.inf
+    hit = False
+    for s, m in searcher._execute_query(ast):
+        mnp = np.asarray(m).astype(bool)
+        if mnp.any():
+            hit = True
+            best = max(best, float(np.asarray(s)[mnp].max()))
+    return hit, (best if np.isfinite(best) else 0.0)
+
+
+# ---------------------------------------------------------------------------
+# module registry cache (per index, shared by every node in-process — the
+# registry is a pure function of replicated IndexMetadata)
+# ---------------------------------------------------------------------------
+
+_REGISTRIES: dict[str, PercolatorRegistry] = {}
+_REG_LOCK = threading.Lock()
+_REG_CAP = 64
+
+
+def registry_for(meta) -> PercolatorRegistry:
+    with _REG_LOCK:
+        reg = _REGISTRIES.get(meta.name)
+        if reg is None or reg.uuid != meta.uuid:
+            reg = PercolatorRegistry(meta)
+            _REGISTRIES[meta.name] = reg
+            while len(_REGISTRIES) > _REG_CAP:
+                _REGISTRIES.pop(next(iter(_REGISTRIES)))
+    reg.sync(meta)
+    return reg
+
+
+def registry_stats(name: str) -> dict | None:
+    """Observability hook for index `_stats` / the node rollup; None when
+    the index has never percolated (or holds no registrations)."""
+    with _REG_LOCK:
+        reg = _REGISTRIES.get(name)
+    return reg.stats_dict() if reg is not None else None
+
+
+def clear_registries() -> None:
+    with _REG_LOCK:
+        _REGISTRIES.clear()
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def percolate(meta, doc: dict, queries: dict | None = None,
+              size: int | None = None, reg_filter: dict | None = None,
+              score: bool = False, sort: bool = False,
+              highlight: dict | None = None,
+              aggs: dict | None = None) -> dict:
+    """Match `doc` against `meta.percolators` (or an explicit query map).
+    → {"total": N, "matches": [{"_index", "_id"[, "_score", "highlight"]}
+    ...][, "aggregations"]}"""
+    if queries is not None:
+        # explicit query map: no registry to key on — the serial path is
+        # also the in-test oracle for the batched one
+        return percolate_serial(meta, doc, queries, size=size,
+                                reg_filter=reg_filter, score=score,
+                                sort=sort, highlight=highlight)
+    out = percolate_many(meta, [{
+        "doc": doc, "size": size, "reg_filter": reg_filter,
+        "score": score, "sort": sort, "highlight": highlight,
+        "aggs": aggs}])[0]
+    if "_exception" in out:
+        raise out["_exception"]
+    return out
+
+
+def percolate_many(meta, items: list[dict]) -> list[dict]:
+    """Batch percolation: every item's fused lanes pack into one device
+    dispatch (the _mpercolate data plane). Items: {"doc", "size",
+    "reg_filter", "score", "sort", "highlight", "aggs"}. Per-item errors
+    come back as {"_exception": exc} — callers render or re-raise."""
+    reg = registry_for(meta)
+    return reg.run(meta, items)
+
+
+def percolate_serial(meta, doc: dict, queries: dict | None = None,
+                     size: int | None = None,
+                     reg_filter: dict | None = None, score: bool = False,
+                     sort: bool = False,
+                     highlight: dict | None = None) -> dict:
+    """The pre-registry per-query loop — kept as the explicit-query-map
+    path AND as the oracle the fuzzer checks the batched registry against
+    (same emit closures, eager dispatch, fresh scratch mapper)."""
+    queries = meta.percolators if queries is None else queries
+    if queries and reg_filter is not None:
+        queries = _filter_registrations(meta, queries, reg_filter)
+    if not queries:
+        return {"total": 0, "matches": []}
+    # scratch mapper: percolation must not mutate the live mapper registry
+    # with dynamically inferred fields from probe docs
+    settings = Settings(meta.settings)
+    scratch = MapperService(AnalysisRegistry(settings))
+    for t, m in (meta.mappings or {}).items():
+        scratch.merge(t, m)
+    scratch.default_similarity = settings.get(
+        "index.similarity.default.type")
+    parsed = scratch.document_mapper().parse("_percolate_doc", doc)
+    _seg, reader = _probe_reader(parsed)
+    searcher = ShardSearcher(0, reader, scratch, index_name=meta.name)
+    matched: dict[str, float] = {}
+    asts = {}
+    for qid, body in queries.items():
+        ast = parse_query(body.get("query"))
+        asts[qid] = ast
+        hit, best = _eager_match(searcher, ast)
+        if hit:
+            matched[qid] = best
+    want_score = bool(score or sort)
+    qids = [qid for qid in queries if qid in matched]
+    if sort:
+        qids.sort(key=lambda qid: -matched[qid])
+    total = len(qids)
+    if size is not None:
+        qids = qids[:int(size)]
+    matches = []
+    for qid in qids:
+        m = {"_index": meta.name, "_id": qid}
+        if want_score:
+            m["_score"] = matched[qid]
+        if highlight:
+            from elasticsearch_tpu.search.highlight import highlight_hit
+            hl = highlight_hit(highlight, doc, scratch, asts[qid])
+            if hl:
+                m["highlight"] = hl
+        matches.append(m)
+    return {"total": total, "matches": matches}
 
 
 def _filter_registrations(meta, queries: dict, reg_filter) -> dict:
@@ -31,7 +726,7 @@ def _filter_registrations(meta, queries: dict, reg_filter) -> dict:
     PercolatorService.java percolatorTypeFilter + request filter). All
     registration docs go into ONE scratch segment; the filter runs once
     and the per-row match mask selects the surviving query ids."""
-    q = parse_query(reg_filter)
+    ast = parse_query(reg_filter)
     scratch = MapperService(AnalysisRegistry(Settings(meta.settings)))
     ids = list(queries)
     builder = SegmentBuilder(seg_id=0)
@@ -46,41 +741,7 @@ def _filter_registrations(meta, queries: dict, reg_filter) -> dict:
     reader = DeviceReader(SearcherView([seg], [mask], 1))
     searcher = ShardSearcher(0, reader, scratch, index_name=meta.name)
     matched = np.zeros(seg.num_docs, dtype=bool)
-    for _, m in searcher._execute_query(q):
+    for _, m in searcher._execute_query(ast):
         arr = np.asarray(m)[:seg.num_docs]
         matched |= arr.astype(bool)
     return {qid: queries[qid] for i, qid in enumerate(ids) if matched[i]}
-
-
-def percolate(meta, doc: dict, queries: dict | None = None,
-              size: int | None = None, reg_filter: dict | None = None) -> dict:
-    """Match `doc` against `meta.percolators` (or an explicit query map).
-    → {"total": N, "matches": [{"_index", "_id"}...]}"""
-    queries = meta.percolators if queries is None else queries
-    if queries and reg_filter is not None:
-        queries = _filter_registrations(meta, queries, reg_filter)
-    if not queries:
-        return {"total": 0, "matches": []}
-    # scratch mapper: percolation must not mutate the live mapper registry
-    # with dynamically inferred fields from probe docs
-    scratch = MapperService(AnalysisRegistry(Settings(meta.settings)))
-    for t, m in (meta.mappings or {}).items():
-        scratch.merge(t, m)
-    parsed = scratch.document_mapper().parse("_percolate_doc", doc)
-    builder = SegmentBuilder(seg_id=0)
-    builder.add(parsed)
-    seg = builder.build()
-    mask = np.zeros(seg.padded_docs, dtype=bool)
-    mask[:seg.num_docs] = True
-    reader = DeviceReader(SearcherView([seg], [mask], 1))
-    searcher = ShardSearcher(0, reader, scratch, index_name=meta.name)
-    matches = []
-    for qid, body in queries.items():
-        q = parse_query(body.get("query"))
-        per_seg = searcher._execute_query(q)
-        if any(bool(np.asarray(m).any()) for _, m in per_seg):
-            matches.append({"_index": meta.name, "_id": qid})
-    total = len(matches)
-    if size is not None:
-        matches = matches[:size]
-    return {"total": total, "matches": matches}

@@ -6,13 +6,20 @@ query ids must equal evaluating each registered tree against the doc
 with the same pure-Python oracle the forward-search fuzzer uses —
 percolation is exactly reverse search, so the two suites share one
 semantic model (reference: PercolatorService's single-doc memory index).
-Reproduce with ESTPU_TEST_SEED.
+
+The second suite fuzzes the BATCHED REGISTRY path against the per-query
+loop (percolate_serial — the pre-registry implementation, same emit
+closures, eager dispatch) as an in-test oracle: matches, scores and
+highlight fragments must be identical, across register/unregister churn
+mid-sequence — the shape of bug a stale shape bucket or a missed
+invalidation would produce. Reproduce with ESTPU_TEST_SEED.
 """
 
 from __future__ import annotations
 
 import random
 
+import numpy as np
 import pytest
 
 from conftest import derive_seed
@@ -21,6 +28,7 @@ from elasticsearch_tpu.node import Node
 
 N_QUERIES = 30
 N_DOCS = 40
+N_CHURN_ROUNDS = 6
 
 
 @pytest.fixture(scope="module")
@@ -59,3 +67,81 @@ def test_random_percolators_match_oracle(node):
             f"doc {di} {doc}: extra {sorted(got - want)[:4]}, "
             f"missing {sorted(want - got)[:4]}")
         assert out["total"] == len(want)
+
+
+def _assert_parity(got: dict, want: dict, ctx: str) -> None:
+    """Batched-registry output must equal the per-query-loop oracle's:
+    same ids in the same order, same totals, scores to f32 tolerance
+    (eager and jitted runs share emit closures; only op fusion differs),
+    identical highlight fragments."""
+    assert [m["_id"] for m in got["matches"]] == \
+        [m["_id"] for m in want["matches"]], ctx
+    assert got["total"] == want["total"], ctx
+    for gm, wm in zip(got["matches"], want["matches"]):
+        if "_score" in wm:
+            assert np.isclose(gm["_score"], wm["_score"],
+                              rtol=1e-5, atol=1e-6), \
+                f"{ctx}: {gm['_id']} score {gm['_score']} vs {wm['_score']}"
+        assert gm.get("highlight") == wm.get("highlight"), \
+            f"{ctx}: {gm['_id']} highlight"
+
+
+def test_batched_registry_matches_serial_oracle_under_churn(node):
+    """Seeded fuzz: the batched registry path vs the per-query loop, with
+    register/unregister churn between probe rounds to catch stale-registry
+    bugs (a removed query still matching, an added one missing, a bucket
+    serving a neighbour's constants)."""
+    from elasticsearch_tpu.search.percolator import (percolate,
+                                                     percolate_serial,
+                                                     registry_stats)
+    rnd = random.Random(derive_seed("percolator-churn"))
+    node.indices_service.create_index(
+        "pzc", {"settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0},
+                "mappings": {"_doc": {"properties": {
+                    "t": {"type": "text", "analyzer": "whitespace"},
+                    "n": {"type": "long"}}}}})
+    active: dict[str, dict] = {}
+    counter = [0]
+
+    def register(k: int) -> None:
+        for _ in range(k):
+            qid = f"c{counter[0]}"
+            counter[0] += 1
+            body = {"query": gen_query(rnd)}
+            active[qid] = body
+            node.indices_service.put_percolator("pzc", qid, body)
+
+    register(12)
+    hl_spec = {"fields": {"t": {}}}
+    for rd in range(N_CHURN_ROUNDS):
+        meta = node.cluster_service.state().indices["pzc"]
+        assert set(meta.percolators) == set(active)
+        for pi in range(3):
+            toks = [rnd.choice(VOCAB) for _ in range(rnd.randint(2, 8))]
+            doc = {"t": " ".join(toks), "n": rnd.randint(0, 170)}
+            kw = {"score": True}
+            if pi == 2:                      # one highlighted probe/round
+                kw["highlight"] = hl_spec
+            got = percolate(meta, doc, **kw)
+            want = percolate_serial(meta, doc, **kw)
+            _assert_parity(got, want, f"round {rd} probe {pi} doc {doc}")
+        # churn: drop up to two registrations, add one to three
+        for _ in range(rnd.randint(0, 2)):
+            if not active:
+                break
+            victim = rnd.choice(sorted(active))
+            del active[victim]
+            node.indices_service.delete_percolator("pzc", victim)
+        register(rnd.randint(1, 3))
+    # final probe syncs the last churn round before the counter audit
+    meta = node.cluster_service.state().indices["pzc"]
+    _assert_parity(percolate(meta, {"t": "alpha beta", "n": 3},
+                             score=True),
+                   percolate_serial(meta, {"t": "alpha beta", "n": 3},
+                                    score=True), "final probe")
+    st = registry_stats("pzc")
+    # churn flowed through the incremental sync, never a full rebuild
+    assert st["builds"] == 1
+    assert st["adds"] == counter[0]
+    assert st["removes"] == counter[0] - len(active)
