@@ -1245,6 +1245,146 @@ def main() -> int:
                 f"steady misses "
                 f"{perc_record[str(n_regs)]['steady_program_misses']}")
 
+    # ---- refresh_interleave leg: the incremental data plane under churn ---
+    # Alternating bulk-index / search at steady state (the north-star
+    # continuous-indexing + heavy-search workload): each round appends a
+    # doc batch to one shard, refreshes, and immediately searches through
+    # a fresh collective-plane pack. `incremental` composes the pack from
+    # the per-segment device-block cache (uploads O(new segment));
+    # `full_rebuild` is the pre-block-cache baseline (host restack +
+    # O(corpus) re-upload per refresh). Program shapes for every slot
+    # count are pre-warmed on a throwaway engine set so BOTH modes measure
+    # pure data-layer + dispatch cost, not trace/compile. Feeds the
+    # eventual real-TPU BENCH_r06 (ROADMAP #1) — on CPU the host→device
+    # copy is a memcpy, so the on-chip gap (PCIe/ICI transfer) is wider.
+    ri_record = None
+    if os.environ.get("BENCH_REFRESH_INTERLEAVE", "1") == "1":
+        import tempfile
+        from pathlib import Path
+        from elasticsearch_tpu.index.engine import Engine
+        from elasticsearch_tpu.index.segment import (
+            Segment, doc_count_bucket)
+        from elasticsearch_tpu.mapping import MapperService
+        from elasticsearch_tpu.parallel import make_mesh
+        from elasticsearch_tpu.parallel.mesh_engine import (
+            MeshEngineSearcher)
+        from elasticsearch_tpu.search import jit_exec as _jx_ri
+
+        ri_docs = int(os.environ.get("BENCH_RI_DOCS", 200_000))
+        ri_shards = 4
+        ri_rounds = int(os.environ.get("BENCH_RI_ROUNDS", 5))
+        ri_batch = int(os.environ.get("BENCH_RI_BATCH", 100))
+        ri_vocab = 5000
+        ri_rng = np.random.default_rng(97)
+        ri_terms = [f"r{i:04d}" for i in range(ri_vocab)]
+        u_ri, f_ri, l_ri, df_ri, _ = make_corpus(
+            ri_rng, ri_docs, ri_vocab, 48, 64)
+        ri_map = MapperService()
+        ri_map.merge("_doc", {"properties": {
+            "body": {"type": "text", "analyzer": "whitespace"}}})
+        per_ri = -(-ri_docs // ri_shards)
+        ri_mesh = make_mesh(dp=1, shard=1, devices=[dev])
+        ri_bodies = [{"query": {"match": {
+            "body": " ".join(ri_terms[int(t)] for t in
+                             make_queries(ri_rng, 1, ri_vocab, 3,
+                                          df_ri)[0])}},
+            "size": 10} for _ in range(ri_rounds + 1)]
+        # identical churn docs each pass → identical slot layouts →
+        # shared program shapes across warm/incremental/full passes
+        churn = [[{"body": " ".join(
+            ri_terms[int(t)] for t in ri_rng.integers(
+                0, ri_vocab, 8))} for _ in range(ri_batch)]
+            for _ in range(ri_rounds)]
+
+        def ri_engines():
+            engines = []
+            for si in range(ri_shards):
+                lo, hi = si * per_ri, min((si + 1) * per_ri, ri_docs)
+                rows = hi - lo
+                np_rows = doc_count_bucket(rows)
+
+                def rpad(a, fill):
+                    out = np.full((np_rows,) + a.shape[1:], fill, a.dtype)
+                    out[:rows] = a[lo:hi]
+                    return out
+                seg_df = np.zeros(ri_vocab, np.int64)
+                sut = u_ri[lo:hi]
+                np.add.at(seg_df, sut[sut >= 0], 1)
+                seg = Segment.from_packed_text(
+                    0, "body", terms=ri_terms, tokens=None,
+                    uterms=rpad(u_ri, -1), utf=rpad(f_ri, 0.0),
+                    doc_len=rpad(l_ri, 0), df=seg_df, num_docs=rows,
+                    ids=[f"d{lo + i}" for i in range(rows)] +
+                        [""] * (np_rows - rows))
+                e = Engine(Path(tempfile.mkdtemp(prefix="bench_ri_")),
+                           ri_map)
+                e.install_segment(seg, track_versions=False)
+                engines.append(e)
+            return engines
+
+        def ri_pass(reuse: bool, record_rounds: bool):
+            engines = ri_engines()
+            rounds = []
+            bytes_per_refresh = []
+            try:
+                ms = MeshEngineSearcher(ri_mesh, engines, ri_map,
+                                        reuse_blocks=reuse)
+                ms.search_batch([ri_bodies[0]])      # warm gen-0 shape
+                for r in range(ri_rounds):
+                    dl0 = _jx_ri.cache_stats()["data_layer"]
+                    t0 = time.perf_counter()
+                    for di, doc in enumerate(churn[r]):
+                        engines[0].index(f"c{r}-{di}", doc)
+                    engines[0].refresh()
+                    ms = MeshEngineSearcher(
+                        ri_mesh, engines, ri_map, prev=ms,
+                        reuse_blocks=reuse)
+                    out = ms.search_batch([ri_bodies[r + 1]])
+                    assert out[0]["total"] >= 0
+                    rounds.append((time.perf_counter() - t0) * 1e3)
+                    dl1 = _jx_ri.cache_stats()["data_layer"]
+                    bytes_per_refresh.append(
+                        dl1["bytes_uploaded"] - dl0["bytes_uploaded"])
+            finally:
+                for e in engines:
+                    e.close()
+            if not record_rounds:
+                return None
+            rs = sorted(rounds)
+            return {"refresh_to_first_search_ms_p50":
+                    round(rs[len(rs) // 2], 2),
+                    "refresh_to_first_search_ms_mean":
+                    round(sum(rounds) / len(rounds), 2),
+                    "bytes_uploaded_per_refresh":
+                    int(sum(bytes_per_refresh) / len(bytes_per_refresh)),
+                    "rounds_ms": [round(x, 2) for x in rounds]}
+
+        t0 = time.perf_counter()
+        ri_pass(True, False)            # program shapes for 1..R slots
+        warm_s = time.perf_counter() - t0
+        inc = ri_pass(True, True)
+        full = ri_pass(False, True)
+        ri_record = {
+            "n_docs": ri_docs, "shards": ri_shards,
+            "rounds": ri_rounds, "batch_docs": ri_batch,
+            "incremental": inc, "full_rebuild": full,
+            "speedup_x": round(
+                full["refresh_to_first_search_ms_mean"]
+                / max(inc["refresh_to_first_search_ms_mean"], 1e-9), 2),
+            "upload_ratio": round(
+                full["bytes_uploaded_per_refresh"]
+                / max(inc["bytes_uploaded_per_refresh"], 1), 1),
+            "warm_compile_s": round(warm_s, 1),
+        }
+        log(f"[bench] refresh_interleave: incremental "
+            f"{inc['refresh_to_first_search_ms_mean']:.1f} ms/refresh "
+            f"({inc['bytes_uploaded_per_refresh'] / 1e6:.2f} MB up) vs "
+            f"full rebuild "
+            f"{full['refresh_to_first_search_ms_mean']:.1f} ms "
+            f"({full['bytes_uploaded_per_refresh'] / 1e6:.2f} MB up) — "
+            f"{ri_record['speedup_x']}x faster, "
+            f"{ri_record['upload_ratio']}x fewer bytes/refresh")
+
     oracle_recall = engine.get("oracle_recall_at_k")
     recall_ok = bool(kernel_ok and engine_ok and
                      (oracle_recall is None or oracle_recall >= 0.999))
@@ -1288,6 +1428,7 @@ def main() -> int:
         "kernel_qps": kernel_qps,
         "kernels": results,
         "percolate": perc_record,
+        "refresh_interleave": ri_record,
     }
 
     # ---- MS-MARCO-scale headline (BASELINE.json's stated metric) -------
@@ -1347,6 +1488,7 @@ def main() -> int:
                 "kernel": child["kernel"],
                 "kernel_qps": child["kernel_qps"],
                 "percolate": perc_record,
+                "refresh_interleave": ri_record,
                 "corpora": {
                     f"zipf_{n_docs // 1_000_000}m": {
                         k_: v_ for k_, v_ in record.items()

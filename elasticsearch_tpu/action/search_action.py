@@ -246,6 +246,16 @@ class ShardRequestCache:
         self._lru: "OrderedDict[tuple, dict]" = OrderedDict()
         self._lock = threading.Lock()
         self.stats = {"hits": 0, "misses": 0, "evictions": 0}
+        # per-engine-incarnation counters (key[0] is the engine uuid):
+        # the per-index request_cache section of _stats reads these, so
+        # hits/misses/evictions attribute to the index that earned them
+        # instead of the node-wide rollup reporting for everyone
+        self._by_uuid: dict[str, dict] = {}
+        self._sizes: dict[tuple, int] = {}
+
+    def _uuid_stats(self, uuid: str) -> dict:
+        return self._by_uuid.setdefault(
+            uuid, {"hits": 0, "misses": 0, "evictions": 0})
 
     def key(self, engine_uuid: str, generation: int, body: dict,
             dfs: dict | None):
@@ -259,54 +269,80 @@ class ShardRequestCache:
     def get(self, key) -> dict | None:
         with self._lock:
             out = self._lru.get(key)
+            bucket = self._uuid_stats(key[0])
             if out is not None:
                 self._lru.move_to_end(key)
                 self.stats["hits"] += 1
+                bucket["hits"] += 1
             else:
                 self.stats["misses"] += 1
+                bucket["misses"] += 1
             return out
+
+    @staticmethod
+    def _approx_bytes(key, payload: dict) -> int:
+        """Best-effort resident size of one entry (the payloads are the
+        wire-safe size-0 shard responses, so json measures them)."""
+        try:
+            return len(key[2]) + len(json.dumps(payload, default=str))
+        except (TypeError, ValueError):
+            return 1024
 
     def put(self, key, payload: dict) -> None:
         with self._lock:
             self._lru[key] = payload
             self._lru.move_to_end(key)
+            self._sizes[key] = self._approx_bytes(key, payload)
             while len(self._lru) > self.cap:
-                self._lru.popitem(last=False)
+                old_key, _ = self._lru.popitem(last=False)
+                self._sizes.pop(old_key, None)
                 self.stats["evictions"] += 1
+                self._uuid_stats(old_key[0])["evictions"] += 1
 
     def clear(self, engine_uuids: set | None = None) -> None:
         """Drop everything, or only entries belonging to the given engine
-        incarnations (index-scoped /_cache/clear)."""
+        incarnations (index-scoped /_cache/clear). Cumulative counters
+        survive — reference cache stats never reset on a clear."""
         with self._lock:
             if engine_uuids is None:
                 self._lru.clear()
+                self._sizes.clear()
             else:
                 for key in [k for k in self._lru
                             if k[0] in engine_uuids]:
                     del self._lru[key]
+                    self._sizes.pop(key, None)
 
     def stats_dict(self) -> dict:
         with self._lock:
-            return {**self.stats, "entries": len(self._lru)}
+            return {**self.stats, "entries": len(self._lru),
+                    "memory_size_in_bytes": sum(self._sizes.values())}
+
+    def stats_for(self, engine_uuids) -> dict:
+        """Per-index request_cache section (reference shape): cumulative
+        hit/miss/eviction counts plus the resident bytes of the given
+        engine incarnations' live entries."""
+        uuids = set(engine_uuids)
+        with self._lock:
+            out = {"hit_count": 0, "miss_count": 0, "evictions": 0,
+                   "memory_size_in_bytes": 0}
+            for uuid in uuids:
+                b = self._by_uuid.get(uuid)
+                if b is not None:
+                    out["hit_count"] += b["hits"]
+                    out["miss_count"] += b["misses"]
+                    out["evictions"] += b["evictions"]
+            out["memory_size_in_bytes"] = sum(
+                n for k, n in self._sizes.items() if k[0] in uuids)
+            return out
 
 
-class _PackCharge:
-    """One-shot fielddata reservation for a collective-plane mesh pack:
-    released exactly once — by supersession (refresh rebuild), cache
-    eviction, index close, or any backing engine's close listener —
-    whichever comes first."""
-
-    __slots__ = ("breaker_service", "nbytes")
-
-    def __init__(self, breaker_service, nbytes: int):
-        self.breaker_service = breaker_service
-        self.nbytes = int(nbytes)
-
-    def release(self) -> None:
-        bs, n = self.breaker_service, self.nbytes
-        self.nbytes = 0
-        if bs is not None and n:
-            bs.breaker("fielddata").release(n)
+# One-shot fielddata reservation for a collective-plane mesh pack:
+# released exactly once — by supersession (refresh rebuild), cache
+# eviction, index close, or any backing engine's close listener —
+# whichever comes first. (The per-segment device BLOCKS beneath the pack
+# carry their own OneShotCharges inside mesh_engine's block cache.)
+from elasticsearch_tpu.common.breaker import OneShotCharge as _PackCharge
 
 
 class SearchActions:
@@ -338,6 +374,15 @@ class SearchActions:
         from collections import OrderedDict
         self._mesh_multi: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._mesh_multi_lock = threading.Lock()
+        # double-buffered plane refresh: engine reader swaps schedule the
+        # next-generation data-layer pack here (coalesced per index), so
+        # the incremental compose runs OFF the query hot path and the
+        # first search after a refresh finds the pack already swapped in
+        # (or waits only for the in-flight build, never starts it cold)
+        self._plane_warm_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="plane-warm")
+        self._plane_warm_pending: set[str] = set()
+        self._plane_warm_lock = threading.Lock()
         self._contexts: dict[str, _ScrollContext] = {}
         self._ctx_ids = itertools.count(1)
         # data-node side scroll pins: (ctx_uid, index, shard) →
@@ -419,6 +464,56 @@ class SearchActions:
         self._closed = True
         self._pool.shutdown(wait=False, cancel_futures=True)
         self._msearch_pool.shutdown(wait=False, cancel_futures=True)
+        self._plane_warm_pool.shutdown(wait=False, cancel_futures=True)
+
+    # ---- double-buffered plane refresh -------------------------------------
+
+    def schedule_plane_rebuild(self, index_name: str) -> None:
+        """Engine reader-swap hook: pipeline the next-generation
+        collective-plane pack for `index_name` in the background.
+        Coalesced (one queued build per index — a refresh storm folds
+        into the next build, which reads the freshest generations) and
+        lazy: only indices whose pack a search already created warm, so
+        pure-indexing workloads pay nothing. Searches arriving before
+        the build finishes wait on the per-index build lock instead of
+        starting the compose cold — the refresh-to-first-search latency
+        win the incremental data layer exists for."""
+        if self._closed:
+            return
+        index = self.node.indices_service.indices.get(index_name)
+        if index is None or "_mesh_cache" not in index.__dict__:
+            return
+        with self._plane_warm_lock:
+            if index_name in self._plane_warm_pending:
+                return
+            self._plane_warm_pending.add(index_name)
+        try:
+            self._plane_warm_pool.submit(self._plane_warm, index_name)
+        except RuntimeError:                 # pool shut down
+            with self._plane_warm_lock:
+                self._plane_warm_pending.discard(index_name)
+
+    def _plane_warm(self, index_name: str) -> None:
+        with self._plane_warm_lock:
+            self._plane_warm_pending.discard(index_name)
+        if self._closed:
+            return
+        index = self.node.indices_service.indices.get(index_name)
+        if index is None:
+            return
+        if str(index.index_settings.get(
+                "index.search.collective_plane", "true")).lower() \
+                in ("false", "0"):
+            return
+        nshards = index.meta.number_of_shards
+        if nshards < 2 or set(index.engines) != set(range(nshards)):
+            return
+        try:
+            if any(e.acquire_searcher().segments
+                   for e in index.shard_engines):
+                self._mesh_searcher_for([index])
+        except Exception:                    # noqa: BLE001 — warm-path
+            pass                             # best effort; search rebuilds
 
     # ---- data-node side ----------------------------------------------------
 
@@ -1258,40 +1353,53 @@ class SearchActions:
             charge.release()
 
     def _mesh_build(self, indices: list, cached):
-        """DATA layer build: stack every index's shard columns into one
+        """DATA layer build: compose every index's shard columns into one
         MeshEngineSearcher → (gens, msearch, breaker bytes), reusing
-        `cached` when no engine's reader generation moved. The pack
-        trades HBM for dispatch count — accounted against the fielddata
-        breaker like every other HBM residency (device_reader_for does
-        the same) via a one-shot charge that ALSO releases when any
-        backing engine closes (shard relocation / teardown must not
-        strand breaker budget). Compiled programs live in mesh_engine's
-        module-level SHAPE-keyed cache, so a rebuild here re-dispatches
-        them instead of re-tracing."""
+        `cached` when no engine's reader generation moved. The build is
+        INCREMENTAL: per-segment device blocks come from mesh_engine's
+        module-level block cache (keyed engine uuid × block uid × slot
+        layout), so a refresh re-uploads only new segments' columns and
+        changed live masks, and the superseded pack keeps serving until
+        this one swaps in (`prev` hands its unchanged stacked operands
+        over). The stacked pack trades HBM for dispatch count —
+        accounted against the fielddata breaker like every other HBM
+        residency (device_reader_for does the same) via a one-shot
+        charge that ALSO releases when any backing engine closes (shard
+        relocation / teardown must not strand breaker budget); the
+        blocks beneath it carry their own exact per-block charges.
+        Compiled programs live in mesh_engine's module-level SHAPE-keyed
+        cache, so a rebuild here re-dispatches them instead of
+        re-tracing."""
         from elasticsearch_tpu.parallel.mesh_engine import (
             MeshEngineSearcher)
-        engines, mappers = [], []
+        engines, mappers, sinks = [], [], []
         for index in indices:
+            sink = index.plane_stats.setdefault("data_layer", {})
             for sid in sorted(index.engines):
                 engines.append(index.engines[sid])
                 mappers.append(index.mapper_service)
+                sinks.append(sink)
         gens = tuple(e.acquire_searcher().generation for e in engines)
         if cached is not None and cached[0] == gens:
             return cached[:3]
+        prev = cached[1] if cached is not None else None
         self._release_pack(cached)       # superseded pack returns first
         bs = getattr(self.node, "breaker_service", None)
         new_bytes = sum(seg.memory_bytes() for e in engines
                         for seg in e.acquire_searcher().segments)
+        reuse = all(
+            str(index.index_settings.get(
+                "index.search.plane_incremental", "true")).lower()
+            not in ("false", "0") for index in indices)
         charge = _PackCharge(bs, new_bytes if bs is not None else 0)
-        if bs is not None:
-            bs.breaker("fielddata").add_estimate(
-                new_bytes,
-                f"mesh plane "
-                f"[{','.join(index.name for index in indices)}]")
+        charge.charge(f"mesh plane "
+                      f"[{','.join(index.name for index in indices)}]")
         try:
             msearch = MeshEngineSearcher(
                 self._plane_mesh_get(), engines,
-                indices[0].mapper_service, mapper_services=mappers)
+                indices[0].mapper_service, mapper_services=mappers,
+                breaker_service=bs, prev=prev, reuse_blocks=reuse,
+                stats_sinks=sinks)
         except BaseException:
             charge.release()
             raise

@@ -84,6 +84,36 @@ class CircuitBreaker:
                 "overhead": 1.0, "tripped": self.trip_count}
 
 
+class OneShotCharge:
+    """A reservation released exactly once, whichever of its release paths
+    fires first (supersession, cache eviction, engine/index close). Device-
+    resident artifacts with several owners — the collective plane's stacked
+    packs and per-segment blocks — hang their breaker accounting on this so
+    competing teardown paths cannot double-release or strand bytes."""
+
+    __slots__ = ("breaker_service", "breaker_name", "nbytes")
+
+    def __init__(self, breaker_service, nbytes: int,
+                 breaker_name: str = "fielddata"):
+        self.breaker_service = breaker_service
+        self.breaker_name = breaker_name
+        self.nbytes = int(nbytes)
+
+    def charge(self, label: str = "<unknown>") -> "OneShotCharge":
+        """Reserve the budget (raises CircuitBreakingError on overflow —
+        the caller must not keep the artifact). → self, for chaining."""
+        if self.breaker_service is not None and self.nbytes:
+            self.breaker_service.breaker(self.breaker_name).add_estimate(
+                self.nbytes, label)
+        return self
+
+    def release(self) -> None:
+        bs, n = self.breaker_service, self.nbytes
+        self.nbytes = 0
+        if bs is not None and n:
+            bs.breaker(self.breaker_name).release(n)
+
+
 class HierarchyCircuitBreakerService:
     def __init__(self, settings: Settings = Settings.EMPTY):
         total = _parse_limit(settings.get("indices.breaker.total.limit"),

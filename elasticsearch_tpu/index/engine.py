@@ -184,6 +184,13 @@ class Engine:
         self._merge_running = False
         self._merge_failures = 0
         self._booted = False
+        # reader-swap listeners (RefreshListeners analog): fired OUTSIDE
+        # the engine lock after any operation that published a fresh
+        # point-in-time view (refresh, background/force merge, segment
+        # install). The collective plane hangs its double-buffered
+        # data-layer rebuild here — the next generation's device pack
+        # starts composing AT refresh, not at the first search.
+        self.reader_swap_listeners: list = []
 
         if getattr(type(self), "_SHADOW", False):
             # read-only replica: no write handle on the primary's WAL,
@@ -555,7 +562,18 @@ class Engine:
             self.stats.refresh_total += 1
             out = self._swap_reader()
         self._maybe_merge()
+        self._notify_reader_swap()
         return out
+
+    def _notify_reader_swap(self) -> None:
+        """Fire reader-swap listeners outside the engine lock (a listener
+        scheduling a device pack rebuild may itself acquire searcher
+        views). Listener failures never fail the swap."""
+        for cb in list(self.reader_swap_listeners):
+            try:
+                cb()
+            except Exception:                # noqa: BLE001 — best-effort
+                pass
 
     def _swap_reader(self) -> SearcherView:
         """Bump the generation and publish a fresh point-in-time view
@@ -595,6 +613,7 @@ class Engine:
             self._live_masks.append(mask)
             self.stats.index_total += segment.num_docs
             self._swap_reader()
+        self._notify_reader_swap()
 
     def acquire_searcher(self) -> SearcherView:
         with self._lock:
@@ -752,6 +771,7 @@ class Engine:
                 self._swap_reader()
                 self._drop_segment_files(drop)
             self._merge_failures = 0
+            self._notify_reader_swap()
         except Exception:                    # noqa: BLE001 — see docstring
             import logging
             self._merge_failures += 1
@@ -908,6 +928,7 @@ class Engine:
             self._merge_failures = 0
             self._swap_reader()
             self._drop_segment_files([seg.seg_id for seg in old])
+        self._notify_reader_swap()
 
     # -------------------------------------------------------------- recovery
 

@@ -48,6 +48,16 @@ from elasticsearch_tpu.mapping.mapper import (
     ParsedDocument, KIND_TEXT, KIND_KEYWORD, KIND_NUMERIC, KIND_VECTOR,
     KIND_GEO, KIND_SHAPE)
 
+# Process-unique block identities (itertools.count.__next__ is atomic under
+# CPython): every Segment object gets one at construction. seg_id alone is
+# NOT a stable identity — a recovered commit installs a DIFFERENT source
+# engine's segments under potentially colliding seg_ids — so device-resident
+# caches (the collective plane's per-segment block cache) key on block_uid,
+# which changes exactly when the backing column arrays change.
+import itertools as _itertools
+
+_block_uids = _itertools.count(1)
+
 # Position-slot cap per text field (docs longer than this are truncated at
 # index time; reference analog: index.mapping.depth/field limits). Padded to
 # a multiple of _ROW_PAD for TPU lane tiling.
@@ -257,6 +267,11 @@ class Segment:
     # geo_shape columns (vertex rings, ShapeFieldColumn)
     shape_fields: dict[str, ShapeFieldColumn] = dc_field(
         default_factory=dict)
+    # stable block identity across reader swaps: a SearcherView snapshot
+    # holds the same Segment OBJECTS across refresh generations, so a
+    # device-block cache keyed on block_uid reuses resident columns while
+    # any newly built/merged/recovered segment (a new object) re-uploads
+    block_uid: int = dc_field(default_factory=lambda: next(_block_uids))
 
     def memory_bytes(self) -> int:
         total = 0

@@ -79,8 +79,18 @@ class IndexService:
     def __init__(self, meta: IndexMetadata, path: Path,
                  local_shards: list[int] | None = None,
                  breaker_service=None, merge_submit=None,
-                 on_engine_failure=None, disk_fault_lookup=None):
+                 on_engine_failure=None, disk_fault_lookup=None,
+                 reader_swap_lookup=None, request_cache_lookup=None):
         self.merge_submit = merge_submit
+        # reader_swap_lookup() → callable(index_name) | None: resolved at
+        # FIRE time (the node wires its hook after boot-time reconcile
+        # already created indices) — engine reader swaps notify it so the
+        # collective plane can pipeline its next-generation pack
+        self.reader_swap_lookup = reader_swap_lookup
+        # request_cache_lookup() → ShardRequestCache | None: the node's
+        # shard request cache, read by stats() for the per-index
+        # request_cache section
+        self.request_cache_lookup = request_cache_lookup
         # engine self-fail report: on_engine_failure(index, shard, reason)
         # — IndicesService turns it into a shard-failed to the master
         self.on_engine_failure = on_engine_failure
@@ -147,6 +157,14 @@ class IndexService:
             if fault is not None:
                 engine.disk_fault = fault
                 engine.translog.fault_hook = fault
+            if self.reader_swap_lookup is not None:
+                # late-bound: the node wires the actual hook (the plane's
+                # double-buffered rebuild scheduler) after boot reconcile
+                def _on_swap(_n=self.name, _lk=self.reader_swap_lookup):
+                    hook = _lk()
+                    if hook is not None:
+                        hook(_n)
+                engine.reader_swap_listeners.append(_on_swap)
             self.engines[sid] = engine
         return self.engines[sid]
 
@@ -260,6 +278,19 @@ class IndexService:
                     masks = list(cache.values())
                 out["memory_size_in_bytes"] += sum(m.nbytes for m in masks)
         return out
+
+    def _request_cache_stats(self) -> dict:
+        """Real per-index shard-request-cache counters: the node-level
+        ShardRequestCache keys entries by engine uuid, so this index's
+        section sums exactly its own engines' hits/misses/evictions and
+        live entry bytes (previously hardcoded zeros)."""
+        cache = (self.request_cache_lookup()
+                 if self.request_cache_lookup is not None else None)
+        if cache is None:
+            return {"memory_size_in_bytes": 0, "evictions": 0,
+                    "hit_count": 0, "miss_count": 0}
+        return cache.stats_for(
+            e.engine_uuid for e in self.shard_engines)
 
     def note_plane_served(self, queries: int = 1) -> None:
         """`queries` searches answered by the collective plane (one mesh
@@ -385,7 +416,13 @@ class IndexService:
                     "served": self.plane_stats["served"],
                     "fallback": dict(self.plane_stats["fallback"]),
                     "fallback_total":
-                        sum(self.plane_stats["fallback"].values())},
+                        sum(self.plane_stats["fallback"].values()),
+                    # incremental data-layer traffic attributed to THIS
+                    # index's pack builds (bytes uploaded vs reused,
+                    # refresh classification) — the per-index view of
+                    # jit_exec's node-wide data_layer counters
+                    "data_layer": dict(
+                        self.plane_stats.get("data_layer", {}))},
                 "groups": {
                     g: {"query_total": b["query_total"],
                         "query_time_in_millis": int(b["query_time_ms"]),
@@ -410,8 +447,7 @@ class IndexService:
                          "size_in_bytes": translog_bytes},
             "suggest": {"total": 0, "time_in_millis": 0},
             "percolate": self._percolate_stats(),
-            "request_cache": {"memory_size_in_bytes": 0, "evictions": 0,
-                              "hit_count": 0, "miss_count": 0},
+            "request_cache": self._request_cache_stats(),
             "recovery": {"current_as_source": 0, "current_as_target": 0},
         }
 
@@ -447,6 +483,14 @@ class IndicesService:
         # background merges: the Node wires this to its "merge" thread
         # pool; None runs merges inline at refresh (deterministic tests)
         self.merge_submit = None
+        # reader-swap hook (Node → SearchActions.schedule_plane_rebuild):
+        # engine refreshes/merges notify it with the index name so the
+        # collective plane pipelines its next-generation device pack off
+        # the query hot path; late-bound via lookup so indices created
+        # during boot reconcile (before the node wires it) still fire
+        self.reader_swap_hook = None
+        # the node's ShardRequestCache (per-index request_cache stats)
+        self.request_cache = None
         # Master forwarding seam (TransportMasterNodeAction.java:50): when
         # set by the Node, metadata mutations on a non-master route to the
         # elected master; signature (action, request, local_fn) → result.
@@ -511,7 +555,9 @@ class IndicesService:
                     breaker_service=self.breaker_service,
                     merge_submit=self.merge_submit,
                     on_engine_failure=self._engine_failed,
-                    disk_fault_lookup=lambda: self.disk_fault)
+                    disk_fault_lookup=lambda: self.disk_fault,
+                    reader_swap_lookup=lambda: self.reader_swap_hook,
+                    request_cache_lookup=lambda: self.request_cache)
             svc = self.indices[name]
             if meta.mappings != svc.meta.mappings:
                 for t, m in (meta.mappings or {}).items():

@@ -162,6 +162,14 @@ class Node:
         self.document_actions = DocumentActions(self)
         self.search_actions = SearchActions(self)
         self.broadcast_actions = BroadcastActions(self)
+        # collective-plane data-layer pipelining: engine reader swaps
+        # (refresh/merge) schedule the next-generation pack build off
+        # the query hot path; per-index request_cache stats read the
+        # node's shard request cache through the same late-bound seam
+        self.indices_service.reader_swap_hook = \
+            self.search_actions.schedule_plane_rebuild
+        self.indices_service.request_cache = \
+            self.search_actions.request_cache
         # peer recovery (core/indices/recovery/): replicas pull files + ops
         # from their active primary before reporting started
         from elasticsearch_tpu.indices.recovery import PeerRecoveryService
@@ -820,7 +828,8 @@ class Node:
         # collective-plane admission rollup across this node's indices
         # (per-index detail lives in _stats; the flip to default-on is
         # observable here: served / fallback-by-reason)
-        plane_total: dict = {"served": 0, "fallback": {}}
+        plane_total: dict = {"served": 0, "fallback": {},
+                             "data_layer": {}}
         # percolate rollup: ops/time/registered queries summed across this
         # node's indices plus the registry program-cache counters (the
         # compiled-percolation analog of the collective_plane rollup)
@@ -831,6 +840,9 @@ class Node:
             for reason, n in svc.plane_stats["fallback"].items():
                 plane_total["fallback"][reason] = \
                     plane_total["fallback"].get(reason, 0) + n
+            for k, v in svc.plane_stats.get("data_layer", {}).items():
+                plane_total["data_layer"][k] = \
+                    plane_total["data_layer"].get(k, 0) + v
             ps_idx = svc._percolate_stats()
             perc_total["total"] += ps_idx["total"]
             perc_total["time_in_millis"] += ps_idx["time_in_millis"]

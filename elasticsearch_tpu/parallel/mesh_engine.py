@@ -51,12 +51,19 @@ caller falls back to the RPC fan-out):
   ``reduce_aggs`` pipeline the RPC coordinator uses
   (InternalAggregations.reduce analog).
 
-Two-layer caching: each MeshEngineSearcher instance is the DATA layer
-(stacked columns, rebuilt on refresh); compiled shard_map programs live
-in a module-level SHAPE-keyed cache (plan signature, slot layouts,
-k/batch buckets, sort/agg specs, mesh geometry) that survives data
-rebuilds — a repeated sorted/terms-agg query re-traces at most once per
-shape, counter-verified via jit_exec.mesh_program_{hits,misses}.
+Three-layer caching: per-SEGMENT device blocks live in a module-level
+cache keyed by (engine uuid, block uid, slot-layout signature) — a
+refresh uploads only newly built segments' columns and changed live
+masks (delete-only refreshes ship ZERO column bytes), counter-verified
+via jit_exec's data_layer.{bytes_uploaded,bytes_reused,...}; each
+MeshEngineSearcher instance is the DATA layer (stacked per-slot
+operands COMPOSED device-side from resident blocks per refresh
+generation, unchanged slots reusing the previous generation's
+operands); compiled shard_map programs live in a module-level
+SHAPE-keyed cache (plan signature, slot layouts, k/batch buckets,
+sort/agg specs, mesh geometry) that survives data rebuilds — a repeated
+sorted/terms-agg query re-traces at most once per shape,
+counter-verified via jit_exec.mesh_program_{hits,misses}.
 
 Statistics modes: ``search_batch(global_stats=True)`` scores every shard
 with globally aggregated DFS statistics (dfs_query_then_fetch — the
@@ -78,7 +85,7 @@ import bisect
 import math
 import threading
 from collections import OrderedDict
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as dc_replace
 
 import jax
 import jax.numpy as jnp
@@ -140,6 +147,205 @@ _program_lock = threading.Lock()
 def clear_program_cache() -> None:
     with _program_lock:
         _program_cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# The BLOCK layer: per-segment device-resident columns.
+#
+# Between the per-generation DATA layer (stacked, mesh-sharded operands)
+# and the shape-keyed PROGRAM layer sits a module-level cache of
+# per-segment device blocks keyed by (engine uuid, block uid, slot-layout
+# signature). A refresh that adds one segment uploads ONLY that segment's
+# padded columns (plus same-shaped empty fillers for shards that don't
+# reach the new slot); every other block is already device-resident and
+# the next-generation stacked layer is COMPOSED from resident blocks with
+# a device-side stack — no host restack, no host→device re-upload. A
+# delete-only refresh re-uploads just the changed live masks (zero column
+# bytes). Blocks are fielddata-charged individually (OneShotCharge) and
+# released exactly once: on supersession (merge drops the source
+# segments from the reader → prune), LRU eviction, or engine close.
+# jit_exec's data_layer.* counters prove the contract (tier-1 guards in
+# tests/test_incremental_plane.py).
+# ---------------------------------------------------------------------------
+_BLOCK_CACHE_CAP = 512
+#: block_uid sentinel for the shared empty-filler block of a slot layout
+#: (shards whose view has fewer segments than n_slots)
+_EMPTY_UID = 0
+
+
+class _Block:
+    __slots__ = ("key", "template", "arrays", "live_np", "col_bytes",
+                 "extrema", "charge")
+
+    def __init__(self, key, template, arrays, live_np, col_bytes,
+                 extrema, charge):
+        self.key = key
+        self.template = template        # DeviceSegment (host numpy views)
+        self.arrays = arrays            # device arrays, seg_flatten order
+        self.live_np = live_np          # padded live mask (host copy)
+        self.col_bytes = col_bytes      # charged column bytes (excl. live)
+        self.extrema = extrema          # numeric field → (min, max)
+        self.charge = charge            # OneShotCharge | None
+
+
+class _DeviceBlockCache:
+    def __init__(self, cap: int = _BLOCK_CACHE_CAP):
+        self.cap = cap
+        self._lru: "OrderedDict[tuple, _Block]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def fetch(self, engine_uuid: str, lay_sig: tuple, lay: "_SlotLayout",
+              seg, live, doc_base: int, breaker_service, label: str):
+        """→ (template, device arrays, extrema, col_up, mask_up, reused):
+        the padded per-segment device block, built+uploaded on miss,
+        composed from residency on hit. A hit with a changed live mask
+        re-uploads ONLY the mask (the delete path's zero-column-byte
+        refresh). Byte counts are actual host→device transfer; `reused`
+        is the resident column bytes a rebuild did not re-ship."""
+        uid = seg.block_uid if seg is not None else _EMPTY_UID
+        key = (engine_uuid, uid, lay_sig)
+        live_np = _pad1(live, lay.np_docs, False) if live is not None \
+            else None
+        with self._lock:
+            blk = self._lru.get(key)
+            if blk is not None:
+                self._lru.move_to_end(key)
+                mask_up = 0
+                if live_np is not None and \
+                        not np.array_equal(blk.live_np, live_np):
+                    # mask-delta refresh: re-ship ONLY the live rows;
+                    # updated under the lock so a racing pack build
+                    # captures a consistent (template, arrays) pair
+                    # (newest mask wins — equivalent to a refresh landing
+                    # mid-build, which the plane already tolerates)
+                    blk.arrays = [jax.device_put(live_np)] + \
+                        blk.arrays[1:]
+                    blk.template = dc_replace(blk.template, live=live_np)
+                    blk.live_np = live_np
+                    mask_up = int(live_np.nbytes)
+                tpl = blk.template
+                if tpl.doc_base != doc_base:
+                    tpl = dc_replace(tpl, doc_base=doc_base)
+                return (tpl, blk.arrays, blk.extrema, 0, mask_up,
+                        blk.col_bytes)
+        template = _build_template(lay, seg, live, doc_base)
+        flat_np = seg_flatten(template)
+        arrays = [jax.device_put(a) for a in flat_np]
+        mask_bytes = int(flat_np[0].nbytes)
+        col_bytes = int(sum(a.nbytes for a in flat_np[1:]))
+        extrema = _segment_extrema(seg) if seg is not None else {}
+        charge = None
+        if breaker_service is not None:
+            from elasticsearch_tpu.common.breaker import OneShotCharge
+            charge = OneShotCharge(
+                breaker_service, col_bytes + mask_bytes).charge(label)
+        blk = _Block(key, template, arrays, template.live, col_bytes,
+                     extrema, charge)
+        evicted = []
+        with self._lock:
+            cur = self._lru.get(key)
+            if cur is not None:
+                # raced duplicate build: keep the incumbent, return our
+                # charge — counting OUR upload is still honest (the
+                # transfer happened)
+                self._lru.move_to_end(key)
+                if charge is not None:
+                    charge.release()
+                blk = cur
+            else:
+                self._lru[key] = blk
+                while len(self._lru) > self.cap:
+                    evicted.append(self._lru.popitem(last=False)[1])
+        for old in evicted:
+            if old.charge is not None:
+                old.charge.release()
+        return blk.template, blk.arrays, blk.extrema, col_bytes, \
+            mask_bytes, 0
+
+    def prune(self, engine_uuid: str, live_uids: set) -> int:
+        """Release blocks of this engine whose segment left the reader
+        view (merged away / superseded). Empty fillers and layout
+        variants of LIVE segments stay (bounded by the LRU cap) — a
+        competing pack with a different slot layout must not thrash.
+        → bytes released."""
+        freed = 0
+        with self._lock:
+            dead = [k for k in self._lru
+                    if k[0] == engine_uuid and k[1] != _EMPTY_UID
+                    and k[1] not in live_uids]
+            gone = [self._lru.pop(k) for k in dead]
+        for blk in gone:
+            freed += blk.col_bytes + int(blk.live_np.nbytes)
+            if blk.charge is not None:
+                blk.charge.release()
+        return freed
+
+    def release_engine(self, engine_uuid: str) -> None:
+        """Engine close: drop every block (incl. empty fillers) charged
+        against this engine incarnation."""
+        with self._lock:
+            dead = [k for k in self._lru if k[0] == engine_uuid]
+            gone = [self._lru.pop(k) for k in dead]
+        for blk in gone:
+            if blk.charge is not None:
+                blk.charge.release()
+
+    def clear(self) -> None:
+        with self._lock:
+            gone = list(self._lru.values())
+            self._lru.clear()
+        for blk in gone:
+            if blk.charge is not None:
+                blk.charge.release()
+
+    def stats(self) -> dict:
+        with self._lock:
+            blocks = list(self._lru.values())
+        return {"entries": len(blocks),
+                "resident_bytes": sum(b.col_bytes + int(b.live_np.nbytes)
+                                      for b in blocks),
+                "charged_bytes": sum(b.charge.nbytes for b in blocks
+                                     if b.charge is not None)}
+
+
+_block_cache = _DeviceBlockCache()
+
+
+def clear_block_cache() -> None:
+    _block_cache.clear()
+
+
+def block_cache_stats() -> dict:
+    return _block_cache.stats()
+
+
+class _EngineBlocksRelease:
+    """Engine close listener: returns every cached device block charged
+    against the engine incarnation (a bound method, so search_action's
+    spent-one-shot listener pruning leaves it in place)."""
+
+    __slots__ = ("engine_uuid",)
+
+    def __init__(self, engine_uuid: str):
+        self.engine_uuid = engine_uuid
+
+    def release(self) -> None:
+        _block_cache.release_engine(self.engine_uuid)
+
+
+def _segment_extrema(seg) -> dict:
+    """Exact per-segment f64 extrema per numeric field (exists-masked,
+    live-independent — deletes never widen a bucket window, matching the
+    previous whole-corpus scan) → cached with the block so a rebuild
+    merges per-segment results instead of re-reducing the corpus."""
+    out: dict[str, tuple[float, float]] = {}
+    for name, col in seg.numeric_fields.items():
+        vals = col.values[col.exists[:len(col.values)]] \
+            if col.exists is not None else col.values
+        if vals.size == 0:
+            continue
+        out[name] = (float(np.min(vals)), float(np.max(vals)))
+    return out
 
 
 def _stable_order(keys: list, kk: int):
@@ -369,6 +575,74 @@ class _SlotLayout:
     kw_vocab: dict[str, int]               # field → padded vocab size
     numeric: list[str]
 
+    def sig(self) -> tuple:
+        """Hashable signature of everything that shapes the padded
+        column ARRAYS (kw_vocab shapes the terms-agg lanes, not the
+        arrays — it stays out, so a vocab-only drift does not re-upload
+        a resident block)."""
+        return (self.np_docs, tuple(sorted(self.text.items())),
+                tuple(sorted(self.keyword.items())),
+                tuple(self.numeric))
+
+
+def _build_template(lay: _SlotLayout, seg, live, doc_base: int
+                    ) -> DeviceSegment:
+    """One shard/slot padded to the slot layout — numpy arrays + REAL
+    host dictionaries (term/ordinal resolution). ``seg=None`` builds the
+    empty filler for shards whose view has fewer segments than
+    n_slots."""
+    n = lay.np_docs
+    text = {}
+    for name, (L, U) in lay.text.items():
+        c = seg.text_fields.get(name) if seg is not None else None
+        if c is None:
+            c = TextFieldColumn(
+                terms=[], tokens=np.full((n, L), -1, np.int32),
+                uterms=np.full((n, U), -1, np.int32),
+                utf=np.zeros((n, U), np.float32),
+                doc_len=np.zeros(n, np.int32),
+                df=np.zeros(1, np.int32), total_tokens=0)
+            text[name] = DeviceTextField(
+                tokens=c.tokens, uterms=c.uterms, utf=c.utf,
+                doc_len=c.doc_len, column=c)
+        else:
+            text[name] = DeviceTextField(
+                tokens=_pad2(c.tokens, n, L, -1),
+                uterms=_pad2(c.uterms, n, U, -1),
+                utf=_pad2(c.utf, n, U, 0.0),
+                doc_len=_pad1(c.doc_len, n, 0), column=c)
+    keyword = {}
+    for name, kdim in lay.keyword.items():
+        c = seg.keyword_fields.get(name) if seg is not None else None
+        if c is None:
+            c = KeywordFieldColumn(vocab=[],
+                                   ords=np.full((n, kdim), -1, np.int32))
+        keyword[name] = DeviceKeywordField(
+            ords=_pad2(c.ords, n, kdim, -1), column=c)
+    numeric = {}
+    for name in lay.numeric:
+        c = seg.numeric_fields.get(name) if seg is not None else None
+        if c is None:
+            hi = np.zeros(n, np.float32)
+            lo = np.zeros(n, np.float32)
+            exists = np.zeros(n, bool)
+        else:
+            hi, lo = dd_split(c.values)
+            hi, lo = _pad1(hi, n, 0.0), _pad1(lo, n, 0.0)
+            exists = _pad1(c.exists, n, False)
+        numeric[name] = DeviceNumericField(hi=hi, lo=lo, exists=exists,
+                                           column=c)
+    live_p = _pad1(live, n, False) if live is not None \
+        else np.zeros(n, bool)
+    host_seg = seg if seg is not None else Segment(
+        seg_id=-1, num_docs=0, padded_docs=n, ids=[], sources=[],
+        text_fields={}, keyword_fields={}, numeric_fields={},
+        vector_fields={}, geo_fields={})
+    return DeviceSegment(seg=host_seg, live=live_p,
+                         doc_base=doc_base, text=text,
+                         keyword=keyword, numeric=numeric, vector={},
+                         geo={})
+
 
 class MeshEngineSearcher:
     """Executes query-DSL searches over all shards of an index as one
@@ -381,7 +655,10 @@ class MeshEngineSearcher:
 
     def __init__(self, mesh: Mesh, engines: list, mapper_service,
                  k1: float = 1.2, b: float = 0.75,
-                 mapper_services: list | None = None):
+                 mapper_services: list | None = None,
+                 breaker_service=None, prev: "MeshEngineSearcher" = None,
+                 reuse_blocks: bool = True,
+                 stats_sinks: list | None = None):
         from elasticsearch_tpu.ops.similarity import BM25Params
         self.mesh = mesh
         self.mapper_service = mapper_service
@@ -415,43 +692,130 @@ class MeshEngineSearcher:
         self.slot_bases = np.cumsum(
             [0] + [lay.np_docs for lay in self._layouts])[:-1].tolist()
         self.shard_stride = int(sum(lay.np_docs for lay in self._layouts))
-        # exact f64 extrema per numeric field across every shard's live
-        # columns — gives histogram lanes a STATIC dd base (the whole
-        # field range maps to one bucket window, so per-query scatter-adds
-        # need no data-dependent base collective)
+        lay_sigs = tuple(lay.sig() for lay in self._layouts)
+        self._lay_sigs = lay_sigs
+        if reuse_blocks:
+            # engine-close hook: the moment any backing engine dies, its
+            # cached device blocks return their fielddata budget (shard
+            # relocation / index teardown must not strand breaker bytes)
+            for e in engines:
+                if not getattr(e, "_block_cache_hooked", False):
+                    hook = _EngineBlocksRelease(e.engine_uuid)
+                    e.__dict__.setdefault("_close_listeners",
+                                          []).append(hook.release)
+                    e._block_cache_hooked = True
+        # ---- DATA layer build: per-segment device blocks ---------------
+        # templates[s][j]: host-side DeviceSegment (numpy arrays, real
+        # host column dicts) used for resolution; shard 0's templates also
+        # give the traced structure in the program body. Blocks come from
+        # the module-level device-block cache: a refresh uploads only new
+        # segments' columns and changed live masks; resident blocks are
+        # REUSED and the per-slot stacked operands compose device-side.
+        from elasticsearch_tpu.search import jit_exec
+        self._templates = [[None] * self.n_slots for _ in range(s)]
+        blocks = [[None] * self.n_slots for _ in range(s)]
+        col_up = mask_up = reused = 0
+        # exact f64 extrema per numeric field, merged from per-block
+        # caches — gives histogram lanes a STATIC dd base (the whole
+        # field range maps to one bucket window, so per-query scatter-
+        # adds need no data-dependent base collective)
         self._field_extrema: dict[str, tuple[float, float]] = {}
-        for v in views:
-            for seg in v.segments:
-                for name, col in seg.numeric_fields.items():
-                    vals = col.values[col.exists[:len(col.values)]] \
-                        if col.exists is not None else col.values
-                    if vals.size == 0:
-                        continue
-                    lo = float(np.min(vals))
-                    hi = float(np.max(vals))
+        for si in range(s):
+            e_uuid = engines[si].engine_uuid
+            view = views[si]
+            sink = stats_sinks[si] if stats_sinks else None
+            for j in range(self.n_slots):
+                seg = view.segments[j] if j < len(view.segments) else None
+                live = view.live_masks[j] if seg is not None else None
+                lay = self._layouts[j]
+                if reuse_blocks:
+                    tpl, arrs, extrema, c_up, m_up, c_re = \
+                        _block_cache.fetch(
+                            e_uuid, lay_sigs[j], lay, seg, live,
+                            self.slot_bases[j], breaker_service,
+                            f"mesh block [{e_uuid[:8]}]")
+                else:
+                    tpl = _build_template(lay, seg, live,
+                                          self.slot_bases[j])
+                    flat_np = seg_flatten(tpl)
+                    arrs = [jax.device_put(a) for a in flat_np]
+                    extrema = _segment_extrema(seg) if seg is not None \
+                        else {}
+                    m_up = int(flat_np[0].nbytes)
+                    c_up = int(sum(a.nbytes for a in flat_np[1:]))
+                    c_re = 0
+                self._templates[si][j] = tpl
+                blocks[si][j] = arrs
+                col_up += c_up
+                mask_up += m_up
+                reused += c_re
+                if sink is not None:
+                    sink["bytes_uploaded"] = sink.get(
+                        "bytes_uploaded", 0) + c_up + m_up
+                    sink["col_bytes_uploaded"] = sink.get(
+                        "col_bytes_uploaded", 0) + c_up
+                    sink["mask_bytes_uploaded"] = sink.get(
+                        "mask_bytes_uploaded", 0) + m_up
+                    sink["bytes_reused"] = sink.get(
+                        "bytes_reused", 0) + c_re
+                for name, (lo, hi) in extrema.items():
                     cur = self._field_extrema.get(name)
-                    if cur is None:
-                        self._field_extrema[name] = (lo, hi)
-                    else:
-                        self._field_extrema[name] = (min(cur[0], lo),
-                                                     max(cur[1], hi))
-        # templates[s][j]: host-side DeviceSegment (numpy arrays, real host
-        # column dicts) used for resolution; shard 0's templates also give
-        # the traced structure in the program body
-        self._templates = [
-            [self._template(si, j) for j in range(self.n_slots)]
-            for si in range(s)]
-        # stacked + mesh-sharded device arrays per slot, seg_flatten order
+                    self._field_extrema[name] = (lo, hi) if cur is None \
+                        else (min(cur[0], lo), max(cur[1], hi))
+        kind = "full" if (reused == 0 or not reuse_blocks) else \
+            ("mask_only" if col_up == 0 else "incremental")
+        self.data_layer = {"col_bytes_uploaded": col_up,
+                           "mask_bytes_uploaded": mask_up,
+                           "bytes_uploaded": col_up + mask_up,
+                           "bytes_reused": reused, "kind": kind}
+        jit_exec.note_data_blocks(col_bytes=col_up, mask_bytes=mask_up,
+                                  reused_bytes=reused)
+        jit_exec.note_data_refresh(kind)
+        if stats_sinks:
+            key = {"full": "full_rebuilds",
+                   "incremental": "incremental_refreshes",
+                   "mask_only": "mask_only_refreshes"}[kind]
+            for sink in {id(sk): sk for sk in stats_sinks
+                         if sk is not None}.values():
+                sink[key] = sink.get(key, 0) + 1
+        # ---- next-generation stacked layer, composed from blocks -------
+        # double-buffered: the PREVIOUS searcher keeps serving its own
+        # stacked arrays untouched while this one composes; a slot whose
+        # every contributing block (and live mask) is unchanged reuses
+        # the previous generation's stacked operand outright.
         shard_sharding = NamedSharding(mesh, P("shard"))
         self._flats = []
+        self._block_tokens = []
+        prev_ok = (prev is not None and prev.mesh is mesh
+                   and prev.n_shards == s
+                   and getattr(prev, "_lay_sigs", None) is not None)
         for j in range(self.n_slots):
-            per_shard = [seg_flatten(self._templates[si][j])
-                         for si in range(s)]
+            # strong refs, compared by IDENTITY (an `id()` token could
+            # alias a freed block's address after GC; holding the arrays
+            # both prevents that and costs only references)
+            token = tuple(a for si in range(s) for a in blocks[si][j])
+            self._block_tokens.append(token)
+            if prev_ok and j < len(prev._block_tokens) \
+                    and len(prev._block_tokens[j]) == len(token) \
+                    and all(a is b for a, b in zip(prev._block_tokens[j],
+                                                   token)) \
+                    and prev._lay_sigs[j] == lay_sigs[j]:
+                self._flats.append(prev._flats[j])
+                continue
+            n_arr = len(blocks[0][j])
             self._flats.append([
-                jax.device_put(np.stack([per_shard[si][i]
-                                         for si in range(s)]),
+                jax.device_put(jnp.stack([blocks[si][j][i]
+                                          for si in range(s)]),
                                shard_sharding)
-                for i in range(len(per_shard[0]))])
+                for i in range(n_arr)])
+        if reuse_blocks:
+            # supersession sweep: blocks whose segment left the reader
+            # (background merge, force_merge, recovered commit) return
+            # their fielddata budget NOW — exact release, no stranding
+            for si in range(s):
+                _block_cache.prune(
+                    engines[si].engine_uuid,
+                    {g.block_uid for g in views[si].segments})
         # keyword-sort data layer: per (field, fill) union-rank columns
         # and their vocabularies, built lazily on first keyword sort and
         # cached for this searcher's point-in-time views
@@ -490,63 +854,13 @@ class MeshEngineSearcher:
                            numeric=sorted(numeric))
 
     def _template(self, si: int, j: int) -> DeviceSegment:
-        """Shard ``si`` slot ``j`` padded to the slot layout — numpy arrays
-        + REAL host dictionaries (term/ordinal resolution)."""
-        lay = self._layouts[j]
+        """Shard ``si`` slot ``j`` padded to the slot layout (see
+        :func:`_build_template` — the cacheable module-level builder)."""
         view = self._views[si]
         seg = view.segments[j] if j < len(view.segments) else None
         live = view.live_masks[j] if seg is not None else None
-        n = lay.np_docs
-        text = {}
-        for name, (L, U) in lay.text.items():
-            c = seg.text_fields.get(name) if seg is not None else None
-            if c is None:
-                c = TextFieldColumn(
-                    terms=[], tokens=np.full((n, L), -1, np.int32),
-                    uterms=np.full((n, U), -1, np.int32),
-                    utf=np.zeros((n, U), np.float32),
-                    doc_len=np.zeros(n, np.int32),
-                    df=np.zeros(1, np.int32), total_tokens=0)
-                text[name] = DeviceTextField(
-                    tokens=c.tokens, uterms=c.uterms, utf=c.utf,
-                    doc_len=c.doc_len, column=c)
-            else:
-                text[name] = DeviceTextField(
-                    tokens=_pad2(c.tokens, n, L, -1),
-                    uterms=_pad2(c.uterms, n, U, -1),
-                    utf=_pad2(c.utf, n, U, 0.0),
-                    doc_len=_pad1(c.doc_len, n, 0), column=c)
-        keyword = {}
-        for name, kdim in lay.keyword.items():
-            c = seg.keyword_fields.get(name) if seg is not None else None
-            if c is None:
-                c = KeywordFieldColumn(vocab=[],
-                                       ords=np.full((n, kdim), -1, np.int32))
-            keyword[name] = DeviceKeywordField(
-                ords=_pad2(c.ords, n, kdim, -1), column=c)
-        numeric = {}
-        for name in lay.numeric:
-            c = seg.numeric_fields.get(name) if seg is not None else None
-            if c is None:
-                hi = np.zeros(n, np.float32)
-                lo = np.zeros(n, np.float32)
-                exists = np.zeros(n, bool)
-            else:
-                hi, lo = dd_split(c.values)
-                hi, lo = _pad1(hi, n, 0.0), _pad1(lo, n, 0.0)
-                exists = _pad1(c.exists, n, False)
-            numeric[name] = DeviceNumericField(hi=hi, lo=lo, exists=exists,
-                                               column=c)
-        live_p = _pad1(live, n, False) if live is not None \
-            else np.zeros(n, bool)
-        host_seg = seg if seg is not None else Segment(
-            seg_id=-1, num_docs=0, padded_docs=n, ids=[], sources=[],
-            text_fields={}, keyword_fields={}, numeric_fields={},
-            vector_fields={}, geo_fields={})
-        return DeviceSegment(seg=host_seg, live=live_p,
-                             doc_base=self.slot_bases[j], text=text,
-                             keyword=keyword, numeric=numeric, vector={},
-                             geo={})
+        return _build_template(self._layouts[j], seg, live,
+                               self.slot_bases[j])
 
     # ---- statistics (the DFS round, host-side) ----------------------------
 

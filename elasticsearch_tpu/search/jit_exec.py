@@ -67,10 +67,45 @@ _stats = {"hits": 0, "misses": 0, "fallbacks": 0,
 #: (ineligible-shape / parse-error / refresh-race / device-error / …)
 _fallback_reasons: dict[str, int] = {}
 
+# data_layer.* count the collective plane's INCREMENTAL data layer
+# (mesh_engine._DeviceBlockCache): bytes_uploaded is actual host→device
+# transfer (column + live-mask bytes, split out below), bytes_reused is
+# the column bytes of already-resident blocks a rebuild composed instead
+# of re-uploading. The refresh classifiers prove the contract the tier-1
+# guards pin down: a one-segment refresh is `incremental` (uploads O(new
+# segment)), a delete-only refresh is `mask_only` (ZERO column bytes),
+# and only a cold/changed-layout build is a `full_rebuild`.
+_data_layer = {"bytes_uploaded": 0, "bytes_reused": 0,
+               "col_bytes_uploaded": 0, "mask_bytes_uploaded": 0,
+               "incremental_refreshes": 0, "full_rebuilds": 0,
+               "mask_only_refreshes": 0}
+
 
 def cache_stats() -> dict:
     with _cache_lock:
-        return {**_stats, "fallback_reasons": dict(_fallback_reasons)}
+        return {**_stats, "fallback_reasons": dict(_fallback_reasons),
+                "data_layer": dict(_data_layer)}
+
+
+def note_data_blocks(col_bytes: int = 0, mask_bytes: int = 0,
+                     reused_bytes: int = 0) -> None:
+    """Block-cache traffic from one data-layer (re)build: host→device
+    uploads (columns / live masks) and resident-block reuse."""
+    with _cache_lock:
+        _data_layer["bytes_uploaded"] += col_bytes + mask_bytes
+        _data_layer["col_bytes_uploaded"] += col_bytes
+        _data_layer["mask_bytes_uploaded"] += mask_bytes
+        _data_layer["bytes_reused"] += reused_bytes
+
+
+def note_data_refresh(kind: str) -> None:
+    """One data-layer rebuild classified: 'full' (no resident block
+    reused), 'incremental' (new column bytes composed with resident
+    blocks), or 'mask_only' (zero column bytes uploaded)."""
+    key = {"full": "full_rebuilds", "incremental": "incremental_refreshes",
+           "mask_only": "mask_only_refreshes"}[kind]
+    with _cache_lock:
+        _data_layer[key] += 1
 
 
 def note_mesh_program(hit: bool) -> None:
@@ -117,6 +152,7 @@ def clear_cache() -> None:
                       plane_fallbacks=0,
                       percolate_program_hits=0, percolate_program_misses=0)
         _fallback_reasons.clear()
+        _data_layer.update({k: 0 for k in _data_layer})
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,288 @@
+"""The incremental data plane (tier-1 guards): per-segment device blocks
+are uploaded once and REUSED across refresh generations — a refresh that
+adds one segment uploads O(new segment) bytes, not O(corpus); a
+delete-only refresh ships zero column bytes (mask delta only); a
+background/force merge frees exactly the superseded source blocks'
+fielddata budget; and the shape-keyed PROGRAM cache is untouched by
+data-layer deltas. Counter-verified via jit_exec's data_layer.* and
+mesh_engine.block_cache_stats()."""
+
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.parallel import mesh_engine
+from elasticsearch_tpu.search import jit_exec
+
+DFS = "dfs_query_then_fetch"
+
+
+def _mk_docs(rng, n):
+    docs = []
+    for i in range(n):
+        words = " ".join(f"w{int(x)}" for x in rng.zipf(1.5, 6) if x < 40)
+        docs.append({"t": words or "w1", "v": i})
+    return docs
+
+
+def _fill(n, name, docs, plane=True):
+    n.indices_service.create_index(name, {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 0,
+                     "index.search.collective_plane": plane},
+        "mappings": {"_doc": {"properties": {
+            "t": {"type": "text", "analyzer": "whitespace"},
+            "v": {"type": "long"}}}}})
+    for i, doc in enumerate(docs):
+        n.index_doc(name, str(i), doc)
+    n.broadcast_actions.refresh(name)
+
+
+def _wait_pack_current(n, name, timeout=8.0) -> bool:
+    """Poll until the index's plane pack matches the engines' CURRENT
+    reader generations — i.e. the refresh-triggered background rebuild
+    (double-buffering) caught up without any search running."""
+    idx = n.indices_service.indices[name]
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        cached = idx.__dict__.get("_mesh_cache")
+        gens = tuple(e.acquire_searcher().generation
+                     for e in idx.shard_engines)
+        if cached is not None and cached[0] == gens:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _dl():
+    return jit_exec.cache_stats()["data_layer"]
+
+
+@pytest.fixture(scope="module")
+def nodes(tmp_path_factory):
+    base = tmp_path_factory.mktemp("incplane")
+    n = Node({}, data_path=base / "n").start()
+    rng = np.random.default_rng(11)
+    # big enough that the per-shard corpus slot (≥ 600 docs → 1024-row
+    # bucket) dwarfs the 128-row padding floor a 1-doc segment gets
+    docs = _mk_docs(rng, 1200)
+    _fill(n, "inc", docs)
+    _fill(n, "inc_off", docs, plane=False)
+    yield n
+    n.close()
+
+
+def test_single_doc_refresh_uploads_new_segment_only(nodes):
+    n = nodes
+    r = n.search("inc", {"query": {"match": {"t": "w1"}}}, search_type=DFS)
+    assert r["hits"]["total"] > 0
+    base = _dl()
+    first_cols = base["col_bytes_uploaded"]
+    assert first_cols > 0 and base["full_rebuilds"] >= 1
+    n.index_doc("inc", "fresh-1", {"t": "w1 incfresh", "v": 9999})
+    n.broadcast_actions.refresh("inc")
+    # double-buffering: the next-generation pack composes in the
+    # background, triggered AT refresh — no search needed
+    assert _wait_pack_current(n, "inc")
+    r = n.search("inc", {"query": {"match": {"t": "incfresh"}}},
+                 search_type=DFS)
+    assert r["hits"]["total"] == 1
+    cur = _dl()
+    col_delta = cur["col_bytes_uploaded"] - first_cols
+    # only the 128-row-padded new segment's blocks (plus same-shaped
+    # empty fillers) shipped — a fraction of the ≥1024-row corpus slot
+    assert 0 < col_delta < first_cols / 3, (col_delta, first_cols)
+    assert cur["bytes_reused"] > base["bytes_reused"]
+    assert cur["incremental_refreshes"] > base["incremental_refreshes"]
+
+
+def test_delete_only_refresh_ships_zero_column_bytes(nodes):
+    n = nodes
+    n.search("inc", {"query": {"match": {"t": "w1"}}}, search_type=DFS)
+    assert _wait_pack_current(n, "inc")
+    base = _dl()
+    n.document_actions.delete_doc("inc", "7")
+    n.broadcast_actions.refresh("inc")
+    assert _wait_pack_current(n, "inc")
+    r = n.search("inc", {"query": {"match": {"t": "w1"}}}, search_type=DFS)
+    assert r["hits"]["total"] > 0
+    cur = _dl()
+    assert cur["col_bytes_uploaded"] == base["col_bytes_uploaded"], \
+        "delete-only refresh must upload ZERO column bytes"
+    assert cur["mask_bytes_uploaded"] > base["mask_bytes_uploaded"]
+    assert cur["mask_only_refreshes"] > base["mask_only_refreshes"]
+
+
+def test_program_cache_untouched_by_data_deltas(nodes):
+    """mesh_program misses must NOT move across pure data-layer deltas
+    (mask-delta refreshes keep the slot structure): the block/data
+    layers churn per refresh, the shape-keyed program re-dispatches."""
+    n = nodes
+    body = {"query": {"match": {"t": "w2"}}, "size": 8}
+    n.search("inc", dict(body), search_type=DFS)
+    miss0 = jit_exec.cache_stats()["mesh_program_misses"]
+    dl0 = _dl()
+    for gen in range(3):
+        n.document_actions.delete_doc("inc", str(100 + gen))
+        n.broadcast_actions.refresh("inc")
+        assert _wait_pack_current(n, "inc")
+        r = n.search("inc", dict(body), search_type=DFS)
+        assert r["hits"]["total"] > 0
+    dl1 = _dl()
+    # the data layer DID move (mask deltas) ...
+    assert dl1["mask_only_refreshes"] > dl0["mask_only_refreshes"]
+    # ... while the program layer re-traced NOTHING
+    assert jit_exec.cache_stats()["mesh_program_misses"] == miss0
+
+
+def test_merge_frees_superseded_source_blocks(nodes):
+    n = nodes
+    idx = n.indices_service.indices["inc"]
+    uuids = {e.engine_uuid for e in idx.shard_engines}
+
+    def our_blocks():
+        with mesh_engine._block_cache._lock:
+            return {k: (b.col_bytes + int(b.live_np.nbytes),
+                        b.charge.nbytes if b.charge else 0)
+                    for k, b in mesh_engine._block_cache._lru.items()
+                    if k[0] in uuids}
+
+    n.search("inc", {"query": {"match": {"t": "w1"}}}, search_type=DFS)
+    assert _wait_pack_current(n, "inc")
+    before = our_blocks()
+    live_uids = {s.block_uid for e in idx.shard_engines
+                 for s in e.acquire_searcher().segments}
+    assert {k[1] for k in before} - {mesh_engine._EMPTY_UID} == live_uids
+    fd = n.breaker_service.breaker("fielddata")
+    fd_before = fd.used
+    idx.force_merge(1)
+    assert _wait_pack_current(n, "inc")
+    n.search("inc", {"query": {"match": {"t": "w1"}}}, search_type=DFS)
+    after = our_blocks()
+    merged_uids = {s.block_uid for e in idx.shard_engines
+                   for s in e.acquire_searcher().segments}
+    # every pre-merge segment block whose segment left the reader is GONE
+    # (exact release), the merged segments' blocks are present
+    stale = {k for k in after
+             if k[1] not in merged_uids and k[1] != mesh_engine._EMPTY_UID}
+    assert not stale, stale
+    assert {k[1] for k in after} - {mesh_engine._EMPTY_UID} == merged_uids
+    # no stranded and no double-charged bytes: each resident block is
+    # charged exactly its resident size
+    for k, (resident, charged) in after.items():
+        assert resident == charged, (k, resident, charged)
+    freed = sum(r for k, (r, _) in before.items()
+                if k[1] not in merged_uids
+                and k[1] != mesh_engine._EMPTY_UID)
+    assert freed > 0
+    assert fd.used <= fd_before
+
+
+def test_breaker_exact_release_on_engine_close(tmp_path):
+    """Satellite (fielddata fix): per-segment blocks charge incrementally
+    and EVERY byte returns on engine/index close — zero stranded, zero
+    double-charged, across refresh + delete + merge churn."""
+    n = Node({}, data_path=tmp_path / "bx").start()
+    try:
+        rng = np.random.default_rng(23)
+        _fill(n, "bal", _mk_docs(rng, 300))
+        n.search("bal", {"query": {"match": {"t": "w1"}}}, search_type=DFS)
+        idx = n.indices_service.indices["bal"]
+        uuids = {e.engine_uuid for e in idx.shard_engines}
+        for gen in range(2):
+            n.index_doc("bal", f"g-{gen}", {"t": "w1 churn", "v": gen})
+            n.document_actions.delete_doc("bal", str(gen))
+            n.broadcast_actions.refresh("bal")
+            assert _wait_pack_current(n, "bal")
+            n.search("bal", {"query": {"match": {"t": "w1"}}},
+                     search_type=DFS)
+        idx.force_merge(1)
+        n.search("bal", {"query": {"match": {"t": "w1"}}}, search_type=DFS)
+        fd = n.breaker_service.breaker("fielddata")
+        assert fd.used > 0
+    finally:
+        n.close()
+    # the engines' close listeners returned every block + pack byte
+    assert n.breaker_service.breaker("fielddata").used == 0
+    with mesh_engine._block_cache._lock:
+        leaked = [k for k in mesh_engine._block_cache._lru
+                  if k[0] in uuids]
+    assert not leaked, leaked
+
+
+def test_plane_fanout_equality_across_refresh_merge_churn(nodes):
+    """The incremental compose must stay bit-identical to the fan-out
+    under churn: adds, updates, deletes, and a merge between searches."""
+    n = nodes
+    rng = np.random.default_rng(31)
+    _fill(n, "chrn", _mk_docs(rng, 260))
+    _fill(n, "chrn_off", _mk_docs(np.random.default_rng(31), 260),
+          plane=False)
+    bodies = [
+        {"query": {"match": {"t": "w1 w3"}}, "size": 10},
+        {"query": {"bool": {"must": [{"match": {"t": "w2"}}],
+                            "filter": [{"range": {"v": {"gte": 100}}}]}},
+         "size": 8},
+        {"query": {"match": {"t": "w1"}}, "size": 6,
+         "sort": [{"v": {"order": "desc"}}]},
+    ]
+
+    def check(tag):
+        for body in bodies:
+            a = n.search("chrn", dict(body), search_type=DFS)
+            b = n.search("chrn_off", dict(body), search_type=DFS)
+            assert a["hits"]["total"] == b["hits"]["total"], (tag, body)
+            ia = [(h["_id"], round(h["_score"], 4) if h["_score"] else 0,
+                   h.get("sort")) for h in a["hits"]["hits"]]
+            ib = [(h["_id"], round(h["_score"], 4) if h["_score"] else 0,
+                   h.get("sort")) for h in b["hits"]["hits"]]
+            assert ia == ib, (tag, body)
+
+    check("warm")
+    for round_ in range(3):
+        did = str(int(rng.integers(0, 260)))
+        upd = {"t": f"w1 churn{round_}", "v": 5000 + round_}
+        for name in ("chrn", "chrn_off"):
+            n.index_doc(name, f"churn-{round_}", dict(upd))
+            n.index_doc(name, did, dict(upd))      # update in place
+            try:
+                n.document_actions.delete_doc(name, str(round_ * 11 + 20))
+            except Exception:                      # noqa: BLE001 — gone
+                pass
+            n.broadcast_actions.refresh(name)
+        check(f"round-{round_}")
+    for name in ("chrn", "chrn_off"):
+        n.indices_service.indices[name].force_merge(1)
+    check("post-merge")
+
+
+def test_data_layer_counters_surface_in_stats(nodes):
+    n = nodes
+    n.search("inc", {"query": {"match": {"t": "w1"}}}, search_type=DFS)
+    st = n.indices_service.indices["inc"].stats()
+    dl = st["search"]["collective_plane"]["data_layer"]
+    assert dl.get("bytes_uploaded", 0) > 0
+    assert "full_rebuilds" in dl
+    ns = n.local_node_stats()["indices"]
+    assert ns["collective_plane"]["data_layer"]["bytes_uploaded"] > 0
+    assert ns["jit"]["data_layer"]["bytes_uploaded"] > 0
+
+
+def test_request_cache_stats_per_index(nodes):
+    """Satellite: per-index request_cache stats are REAL — hits/misses
+    key to the engines that earned them, other indices stay zero."""
+    n = nodes
+    # the opted-out index takes the RPC fan-out where the shard request
+    # cache lives (the plane serves hits-free requests in-program)
+    body = {"query": {"match": {"t": "w1"}}, "size": 0}
+    n.search("inc_off", dict(body))
+    n.search("inc_off", dict(body))
+    rc = n.indices_service.indices["inc_off"].stats()["request_cache"]
+    assert rc["miss_count"] >= 2          # one per shard, first pass
+    assert rc["hit_count"] >= 2           # second pass served cached
+    assert rc["memory_size_in_bytes"] > 0
+    other = n.indices_service.indices["inc"].stats()["request_cache"]
+    assert other["hit_count"] == 0 and other["miss_count"] == 0
+    node_rc = n.local_node_stats()["indices"]["request_cache"]
+    assert node_rc["hits"] >= rc["hit_count"]
