@@ -1385,6 +1385,106 @@ def main() -> int:
             f"{ri_record['speedup_x']}x faster, "
             f"{ri_record['upload_ratio']}x fewer bytes/refresh")
 
+    # ---- fault_recovery leg: degraded-mode serving under device faults ----
+    # Steady-state QPS on the collective plane, QPS during an injected
+    # device-fault burst (breaker open, fan-out/eager serving — requests
+    # keep succeeding), and time-to-plane-reopen after the faults heal
+    # (half-open probe within the backoff bound). CPU now; the on-chip
+    # number rides the eventual real-TPU BENCH_r06 (ROADMAP #1).
+    fr_record = None
+    if os.environ.get("BENCH_FAULT_RECOVERY", "1") == "1":
+        import tempfile
+        from pathlib import Path as _FRPath
+        from elasticsearch_tpu.node import Node as _FRNode
+        from elasticsearch_tpu.search import jit_exec as _jx_fr
+        from elasticsearch_tpu.testing_disruption import DeviceFaultScheme
+
+        fr_docs = int(os.environ.get("BENCH_FR_DOCS", 5000))
+        fr_queries = int(os.environ.get("BENCH_FR_QUERIES", 120))
+        fr_rng = np.random.default_rng(99)
+        fr_node = _FRNode({}, data_path=_FRPath(
+            tempfile.mkdtemp(prefix="bench_fr_")) / "n").start()
+        try:
+            fr_node.indices_service.create_index("fr", {
+                "settings": {"number_of_shards": 4,
+                             "number_of_replicas": 0},
+                "mappings": {"_doc": {"properties": {
+                    "t": {"type": "text", "analyzer": "whitespace"},
+                    "v": {"type": "long"}}}}})
+            for i in range(fr_docs):
+                words = " ".join(f"w{int(x)}" for x in
+                                 fr_rng.zipf(1.5, 6) if x < 60)
+                fr_node.index_doc("fr", str(i),
+                                  {"t": words or "w1", "v": i})
+            fr_node.broadcast_actions.refresh("fr")
+            fr_body = {"query": {"match": {"t": "w1 w3"}}, "size": 10}
+            _jx_fr.plane_breaker.reset()
+            _jx_fr.plane_breaker.configure(threshold=3, backoff_s=0.25,
+                                           max_backoff_s=5.0)
+            fr_node.search("fr", dict(fr_body))      # warm (compiles)
+            time.sleep(0.3)                          # drain plane warm
+
+            def fr_qps(n):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    out = fr_node.search("fr", dict(fr_body))
+                    assert out["hits"]["total"] >= 0
+                return n / (time.perf_counter() - t0)
+
+            steady_qps = fr_qps(fr_queries)
+            scheme = DeviceFaultScheme(seed=42, p=1.0,
+                                       reset_breaker_on_stop=False)
+            scheme.start_disrupting()
+            try:
+                t_burst = time.perf_counter()
+                open_after = None
+                burst_t0 = time.perf_counter()
+                for qi in range(fr_queries):
+                    fr_node.search("fr", dict(fr_body))
+                    if open_after is None and \
+                            _jx_fr.plane_breaker.stats()["state"] \
+                            == "open":
+                        open_after = qi + 1
+                        t_open_ms = (time.perf_counter()
+                                     - t_burst) * 1e3
+                burst_qps = fr_queries / (time.perf_counter() - burst_t0)
+                st_open = _jx_fr.plane_breaker.stats()
+                scheme.heal()                    # faults gone, hook counts
+                t_heal = time.perf_counter()
+                reopen_ms = None
+                deadline = time.perf_counter() + 10.0
+                while time.perf_counter() < deadline:
+                    fr_node.search("fr", dict(fr_body))
+                    if _jx_fr.plane_breaker.stats()["state"] == "closed":
+                        reopen_ms = (time.perf_counter() - t_heal) * 1e3
+                        break
+                    time.sleep(0.02)
+            finally:
+                scheme.stop_disrupting()
+                _jx_fr.plane_breaker.reset()
+            fr_record = {
+                "n_docs": fr_docs, "queries": fr_queries,
+                "steady_qps": round(steady_qps, 1),
+                "fault_burst_qps": round(burst_qps, 1),
+                "degraded_qps_ratio": round(burst_qps
+                                            / max(steady_qps, 1e-9), 3),
+                "breaker_opened": st_open["state"] == "open",
+                "errors_to_open": open_after,
+                "time_to_open_ms": round(t_open_ms, 2)
+                if open_after is not None else None,
+                "time_to_plane_reopen_ms": round(reopen_ms, 2)
+                if reopen_ms is not None else None,
+                "injected_faults": scheme.total_injected,
+                "breaker": st_open,
+            }
+            log(f"[bench] fault_recovery: steady {steady_qps:.1f} QPS, "
+                f"burst {burst_qps:.1f} QPS (breaker "
+                f"{'opened after ' + str(open_after) + ' requests' if open_after else 'never opened'}), "
+                f"plane reopened in "
+                f"{fr_record['time_to_plane_reopen_ms']} ms after heal")
+        finally:
+            fr_node.close()
+
     oracle_recall = engine.get("oracle_recall_at_k")
     recall_ok = bool(kernel_ok and engine_ok and
                      (oracle_recall is None or oracle_recall >= 0.999))
@@ -1429,6 +1529,7 @@ def main() -> int:
         "kernels": results,
         "percolate": perc_record,
         "refresh_interleave": ri_record,
+        "fault_recovery": fr_record,
     }
 
     # ---- MS-MARCO-scale headline (BASELINE.json's stated metric) -------
@@ -1489,6 +1590,7 @@ def main() -> int:
                 "kernel_qps": child["kernel_qps"],
                 "percolate": perc_record,
                 "refresh_interleave": ri_record,
+                "fault_recovery": fr_record,
                 "corpora": {
                     f"zipf_{n_docs // 1_000_000}m": {
                         k_: v_ for k_, v_ in record.items()

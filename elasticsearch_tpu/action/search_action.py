@@ -409,6 +409,21 @@ class SearchActions:
         self.request_cache = ShardRequestCache(
             cap=int(node.settings.get("indices.requests.cache.entries", 256))
             if hasattr(node, "settings") else 256)
+        # plane-breaker knobs (per-node — one process, one device): an
+        # explicit setting reconfigures the jit_exec module breaker
+        if hasattr(node, "settings"):
+            from elasticsearch_tpu.search import jit_exec
+            jit_exec.plane_breaker.configure(
+                threshold=node.settings.get(
+                    "search.plane_breaker.threshold"),
+                backoff_s=node.settings.get(
+                    "search.plane_breaker.backoff_seconds"),
+                max_backoff_s=node.settings.get(
+                    "search.plane_breaker.max_backoff_seconds"))
+        # background pack-build (plane warm) failure tracking: per-index
+        # consecutive failures drive the retry backoff and, past
+        # PLANE_WARM_MAX_RETRIES, the plane-degraded marking
+        self._plane_warm_failures: dict[str, int] = {}
         # dedicated pool for _msearch item fan-out: sharing _pool with the
         # per-shard futures it spawns could deadlock at saturation
         self._msearch_pool = ThreadPoolExecutor(
@@ -493,6 +508,13 @@ class SearchActions:
             with self._plane_warm_lock:
                 self._plane_warm_pending.discard(index_name)
 
+    #: background pack-build hardening: failed warms retry with
+    #: exponential backoff; past the retry budget the index is marked
+    #: plane-degraded (searches keep serving the previous generation or
+    #: the fan-out — never an error) until a build succeeds again
+    PLANE_WARM_MAX_RETRIES = 3
+    PLANE_WARM_BACKOFF_S = 0.25
+
     def _plane_warm(self, index_name: str) -> None:
         with self._plane_warm_lock:
             self._plane_warm_pending.discard(index_name)
@@ -508,12 +530,39 @@ class SearchActions:
         nshards = index.meta.number_of_shards
         if nshards < 2 or set(index.engines) != set(range(nshards)):
             return
+        from elasticsearch_tpu.search import jit_exec
         try:
-            if any(e.acquire_searcher().segments
-                   for e in index.shard_engines):
-                self._mesh_searcher_for([index])
-        except Exception:                    # noqa: BLE001 — warm-path
-            pass                             # best effort; search rebuilds
+            if not any(e.acquire_searcher().segments
+                       for e in index.shard_engines):
+                return
+            if not jit_exec.plane_breaker.allow():
+                return          # unhealthy device: the breaker's probe,
+            self._mesh_searcher_for([index])   # not the warm path, decides
+        except Exception as e:               # noqa: BLE001 — warm-path
+            # the failed build already returned its pack charge
+            # (_mesh_build / _mesh_searcher_for release on the way out);
+            # record the device error, then retry with backoff so a
+            # transient fault doesn't silently kill the coalesced-
+            # rebuild path — and degrade (never error) past the budget
+            jit_exec.note_device_error(e)
+            with self._plane_warm_lock:
+                n = self._plane_warm_failures.get(index_name, 0) + 1
+                self._plane_warm_failures[index_name] = n
+            if n >= self.PLANE_WARM_MAX_RETRIES:
+                index.plane_stats["degraded"] = True
+                return
+            if self._closed:
+                return
+            timer = threading.Timer(
+                self.PLANE_WARM_BACKOFF_S * (2 ** (n - 1)),
+                self.schedule_plane_rebuild, args=(index_name,))
+            timer.daemon = True
+            timer.start()
+        else:
+            jit_exec.plane_breaker.record_success()
+            with self._plane_warm_lock:
+                self._plane_warm_failures.pop(index_name, None)
+            index.plane_stats.pop("degraded", None)
 
     # ---- data-node side ----------------------------------------------------
 
@@ -1153,7 +1202,16 @@ class SearchActions:
         if not any(e.acquire_searcher().segments
                    for index in indices for e in index.shard_engines):
             return None                   # nothing indexed yet: the
-        for req in reqs:                  # fan-out's empty response
+                                          # fan-out's empty response
+        from elasticsearch_tpu.search import jit_exec
+        # plane breaker: an unhealthy device costs fan-out latency, not
+        # a failed mesh dispatch per query; a half-open probe is admitted
+        # here and reports back through record_success/record_error below
+        if not jit_exec.plane_breaker.allow():
+            jit_exec.note_breaker_skip()
+            self._note_plane_fallback(indices, "breaker-open")
+            return None
+        for req in reqs:
             if req.suggest or req.rescore:
                 self._note_plane_fallback(indices, "ineligible-shape")
                 return None
@@ -1165,7 +1223,6 @@ class SearchActions:
             # cost the RPC fallback should not pay per refresh generation
             self._note_plane_fallback(indices, "ineligible-shape")
             return None
-        from elasticsearch_tpu.search import jit_exec
         from elasticsearch_tpu.search.controller import merge_responses
         from elasticsearch_tpu.search.phase import (ShardQueryResult,
                                                     ShardSearcher)
@@ -1187,6 +1244,7 @@ class SearchActions:
                 return None
             except Exception as e:        # noqa: BLE001 — fallback seam
                 jit_exec.note_fallback(e)
+                jit_exec.note_device_error(e)
                 self._note_plane_fallback(indices, "device-error")
                 return None
             if any(r.terminate_after is not None for r in reqs) and \
@@ -1210,6 +1268,7 @@ class SearchActions:
                 raise
             except Exception as e:        # noqa: BLE001 — fallback seam
                 jit_exec.note_fallback(e)
+                jit_exec.note_device_error(e)
                 self._note_plane_fallback(indices, "device-error")
                 return None
             searchers = [
@@ -1282,7 +1341,15 @@ class SearchActions:
                         q_ms / 1e3 / len(bodies),
                         f"collective-plane, source"
                         f"[{json.dumps(body)[:512]}]")
+        # a served plane batch is the breaker's success signal (closes a
+        # half-open probe) and clears any plane-degraded marking left by
+        # failed background builds
+        jit_exec.plane_breaker.record_success()
+        with self._plane_warm_lock:
+            for index in indices:
+                self._plane_warm_failures.pop(index.name, None)
         for index in indices:
+            index.plane_stats.pop("degraded", None)
             index.note_plane_served(len(bodies))
         return responses
 
@@ -1427,8 +1494,16 @@ class SearchActions:
             lock = index.__dict__.setdefault("_mesh_lock",
                                              threading.Lock())
             with lock:
-                entry = self._mesh_build(
-                    indices, index.__dict__.get("_mesh_cache"))
+                try:
+                    entry = self._mesh_build(
+                        indices, index.__dict__.get("_mesh_cache"))
+                except BaseException:
+                    # the superseded pack's charge was already released
+                    # on the way into the failed build — drop the stale
+                    # cache entry so a gens-matched retry can't serve a
+                    # zero-charged pack (breaker-byte accounting drift)
+                    index.__dict__["_mesh_cache"] = None
+                    raise
                 index.__dict__["_mesh_cache"] = entry
                 return entry[1]
         key = tuple(index.name for index in indices)
@@ -1441,7 +1516,11 @@ class SearchActions:
                 self._release_pack(cached)
                 del self._mesh_multi[key]
                 cached = None
-            entry = self._mesh_build(indices, cached)
+            try:
+                entry = self._mesh_build(indices, cached)
+            except BaseException:
+                self._mesh_multi.pop(key, None)   # same staleness rule
+                raise
             self._mesh_multi[key] = entry + (ids,)
             self._mesh_multi.move_to_end(key)
             while len(self._mesh_multi) > 4:

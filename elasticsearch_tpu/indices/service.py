@@ -63,6 +63,13 @@ def normalize_alias(spec: dict | None) -> dict:
     return meta
 
 
+def _plane_breaker_stats() -> dict:
+    """The node's plane-breaker document for _stats sections (lazy
+    import — jit_exec pulls jax)."""
+    from elasticsearch_tpu.search import jit_exec
+    return jit_exec.plane_breaker.stats()
+
+
 class ShardNotLocalError(Exception):
     """The target shard copy lives on another node — the action layer must
     route the operation over the transport."""
@@ -336,7 +343,7 @@ class IndexService:
         base["registry"] = {k: st[k] for k in (
             "builds", "syncs", "adds", "removes", "bucket_invalidations",
             "mapper_rebuilds", "shape_buckets", "fused_queries",
-            "fallback_queries")}
+            "fallback_queries", "breaker_skips")}
         # compiled-lane cache counters (node-global — the program cache is
         # shared across indices, like indices.jit in _nodes/stats)
         from elasticsearch_tpu.search import jit_exec
@@ -417,6 +424,15 @@ class IndexService:
                     "fallback": dict(self.plane_stats["fallback"]),
                     "fallback_total":
                         sum(self.plane_stats["fallback"].values()),
+                    # accelerator-fault tolerance: is this index's plane
+                    # marked degraded (background pack builds exhausted
+                    # their retries — searches serve the previous
+                    # generation / fan-out), plus the node's plane
+                    # breaker (state, trips, probes — shared across
+                    # indices like the device it guards)
+                    "degraded":
+                        bool(self.plane_stats.get("degraded", False)),
+                    "breaker": _plane_breaker_stats(),
                     # incremental data-layer traffic attributed to THIS
                     # index's pack builds (bytes uploaded vs reused,
                     # refresh classification) — the per-index view of

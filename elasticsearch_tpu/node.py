@@ -827,9 +827,18 @@ class Node:
                                       "index_time_in_millis": 0}}
         # collective-plane admission rollup across this node's indices
         # (per-index detail lives in _stats; the flip to default-on is
-        # observable here: served / fallback-by-reason)
+        # observable here: served / fallback-by-reason), plus the
+        # plane breaker (state, trip count, consecutive errors, last
+        # error, probes) and which indices are plane-degraded —
+        # the degraded-mode-serving dashboard
+        from elasticsearch_tpu.search import jit_exec as _jx_breaker
         plane_total: dict = {"served": 0, "fallback": {},
-                             "data_layer": {}}
+                             "data_layer": {},
+                             "breaker": _jx_breaker.plane_breaker.stats(),
+                             "degraded_indices": sorted(
+                                 name for name, svc in
+                                 self.indices_service.indices.items()
+                                 if svc.plane_stats.get("degraded"))}
         # percolate rollup: ops/time/registered queries summed across this
         # node's indices plus the registry program-cache counters (the
         # compiled-percolation analog of the collective_plane rollup)

@@ -230,6 +230,8 @@ class _DeviceBlockCache:
                         blk.col_bytes)
         template = _build_template(lay, seg, live, doc_base)
         flat_np = seg_flatten(template)
+        from elasticsearch_tpu.search import jit_exec
+        jit_exec.device_fault_point("upload")
         arrays = [jax.device_put(a) for a in flat_np]
         mask_bytes = int(flat_np[0].nbytes)
         col_bytes = int(sum(a.nbytes for a in flat_np[1:]))
@@ -298,6 +300,28 @@ class _DeviceBlockCache:
             if blk.charge is not None:
                 blk.charge.release()
 
+    def evict_cold(self, fraction: float = 0.5) -> int:
+        """HBM-OOM response: drop the least-recently-used `fraction` of
+        cached blocks, releasing their fielddata charges, so the next
+        pack (re)build retries against reclaimed headroom. Blocks still
+        referenced by a serving pack stay alive through the pack's own
+        references — only the cache residency (and its accounting) is
+        given up. → bytes released."""
+        with self._lock:
+            n = int(len(self._lru) * fraction) if self._lru else 0
+            n = max(n, 1) if self._lru else 0
+            gone = [self._lru.popitem(last=False)[1] for _ in range(n)]
+        freed = 0
+        for blk in gone:
+            freed += blk.col_bytes + int(blk.live_np.nbytes)
+            if blk.charge is not None:
+                blk.charge.release()
+        return freed
+
+    def keys(self) -> list:
+        with self._lock:
+            return list(self._lru)
+
     def stats(self) -> dict:
         with self._lock:
             blocks = list(self._lru.values())
@@ -317,6 +341,18 @@ def clear_block_cache() -> None:
 
 def block_cache_stats() -> dict:
     return _block_cache.stats()
+
+
+def block_cache_keys() -> list:
+    """(engine uuid, block uid, layout sig) of every resident block —
+    the chaos suites' no-stale-``block_uid`` consistency check."""
+    return _block_cache.keys()
+
+
+def evict_cold_blocks(fraction: float = 0.5) -> int:
+    """Module entry for the HBM-OOM response (jit_exec.note_device_error):
+    evict the coldest `fraction` of device blocks → bytes released."""
+    return _block_cache.evict_cold(fraction)
 
 
 class _EngineBlocksRelease:
@@ -738,6 +774,7 @@ class MeshEngineSearcher:
                     tpl = _build_template(lay, seg, live,
                                           self.slot_bases[j])
                     flat_np = seg_flatten(tpl)
+                    jit_exec.device_fault_point("upload")
                     arrs = [jax.device_put(a) for a in flat_np]
                     extrema = _segment_extrema(seg) if seg is not None \
                         else {}
@@ -803,6 +840,7 @@ class MeshEngineSearcher:
                 self._flats.append(prev._flats[j])
                 continue
             n_arr = len(blocks[0][j])
+            jit_exec.device_fault_point("compose")
             self._flats.append([
                 jax.device_put(jnp.stack([blocks[si][j][i]
                                           for si in range(s)]),
@@ -983,6 +1021,7 @@ class MeshEngineSearcher:
         jit_exec.note_mesh_program(fn is not None)
         if fn is not None:
             return fn
+        jit_exec.device_fault_point("compile")
         n_slots = self.n_slots
         slot_bases = self.slot_bases
         stride = self.shard_stride
@@ -1566,6 +1605,8 @@ class MeshEngineSearcher:
                             for j in range(self.n_slots)],
                            agg_spec=agg_spec, bucket_specs=bucket_specs,
                            sort_specs=sort_specs, has_cursor=has_cursor)
+        from elasticsearch_tpu.search.jit_exec import device_fault_point
+        device_fault_point("plane-dispatch")
         outs = fn(self._flats, consts_dev, cursors, kwsorts)
         t2 = time.perf_counter()
         g_s = np.asarray(outs["scores"])

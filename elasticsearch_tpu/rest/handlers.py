@@ -3324,7 +3324,14 @@ class Handlers:
                 "total percolations", right=True, default=False),
             Col("percolate.time", ("pti", "percolateTime"),
                 "time spent percolating", right=True, default=False),
+            Col("plane.health", ("ph", "planeHealth"),
+                "collective-plane serving health: ok / degraded "
+                "(background builds gave up) / breaker-open (device "
+                "unhealthy, fan-out serving) / off (opted out)",
+                default=False),
         ])
+        from elasticsearch_tpu.search import jit_exec as _jx
+        breaker_open = _jx.plane_breaker.stats()["state"] != "closed"
         for n in names:
             meta = state.indices.get(n)
             if meta is None:
@@ -3340,6 +3347,16 @@ class Handlers:
                         deleted += seg["num_docs"] - seg["live_docs"]
             from elasticsearch_tpu.search.percolator import registry_stats
             perc = registry_stats(n)
+            if svc is not None and str(svc.index_settings.get(
+                    "index.search.collective_plane", "true")).lower() \
+                    in ("false", "0"):
+                plane_health = "off"
+            elif svc is not None and svc.plane_stats.get("degraded"):
+                plane_health = "degraded"
+            elif breaker_open:
+                plane_health = "breaker-open"
+            else:
+                plane_health = "ok"
             t.add(**{"health": self._index_health(state, n),
                      "status": meta.state if meta.state == "close"
                      else "open",
@@ -3356,7 +3373,8 @@ class Handlers:
                          "registered", len(meta.percolators or {})),
                      "percolate.total": (perc or {}).get("count", 0),
                      "percolate.time":
-                         f"{(perc or {}).get('time_ms', 0) / 1000:.1f}s"})
+                         f"{(perc or {}).get('time_ms', 0) / 1000:.1f}s",
+                     "plane.health": plane_health})
         return t.render(req)
 
     def cat_master(self, req: RestRequest):

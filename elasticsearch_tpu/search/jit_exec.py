@@ -46,6 +46,221 @@ from elasticsearch_tpu.search.execute import (
 _CACHE_CAP = 512
 _cache: OrderedDict[tuple, "jax.stages.Wrapped"] = OrderedDict()
 _cache_lock = threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# Device-fault seam + plane circuit breaker (accelerator-fault tolerance)
+# ---------------------------------------------------------------------------
+
+class DeviceFaultError(RuntimeError):
+    """Simulated accelerator error (testing_disruption.DeviceFaultScheme)
+    — shaped like the dispatch/upload/compile failures a sick device
+    raises, so every fallback seam treats it exactly like the real
+    thing."""
+
+
+class DeviceOomError(DeviceFaultError):
+    """Simulated HBM out-of-memory (the XLA RESOURCE_EXHAUSTED shape):
+    the one device error with a recovery action cheaper than degrading —
+    evict cold device blocks and let the next build retry smaller."""
+
+
+#: chaos seam: a callable(site: str) that may raise at each device
+#: touchpoint — ``dispatch`` (compiled per-segment/reader programs),
+#: ``compile`` (program build), ``upload`` (host→device block/column
+#: transfer), ``compose`` (device-side pack stacking), ``plane-dispatch``
+#: (the collective-plane mesh program), ``percolate`` (fused percolate
+#: lanes). None in production — the check is a single attribute read.
+_device_fault_hook = None
+
+
+def set_device_fault_hook(hook):
+    """Install (or with None, remove) the device-fault hook → the
+    previous hook, so stacked schemes can chain and restore."""
+    global _device_fault_hook
+    prev = _device_fault_hook
+    _device_fault_hook = hook
+    return prev
+
+
+def device_fault_point(site: str) -> None:
+    """One device touchpoint: gives the installed chaos hook the chance
+    to raise an accelerator-style error here."""
+    hook = _device_fault_hook
+    if hook is not None:
+        hook(site)
+
+
+def is_device_oom(exc: BaseException) -> bool:
+    """Does this exception look like device memory exhaustion? Covers
+    the injected :class:`DeviceOomError` and the strings real XLA
+    runtime errors carry (RESOURCE_EXHAUSTED / out of memory)."""
+    if isinstance(exc, DeviceOomError):
+        return True
+    msg = str(exc)
+    return "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
+
+
+class PlaneBreaker:
+    """Per-node circuit breaker over the compiled device paths.
+
+    closed → open after ``threshold`` CONSECUTIVE device errors →
+    half-open probe after an exponentially backed-off wait. While open,
+    admission gates (collective-plane admission in search_action, the
+    percolator's fused lanes, ShardSearcher's compiled query phase)
+    route straight to the fan-out/eager path, so an unhealthy device
+    costs fallback latency — not a failed device dispatch per query.
+    In half-open exactly ONE request is admitted as the probe; its
+    success closes the breaker, its failure re-opens with a doubled
+    backoff (capped at ``max_backoff_s``).
+
+    All in-process nodes share one device, so the module singleton
+    ``plane_breaker`` IS the per-node breaker (one node = one process =
+    one device in deployment); ``search.plane_breaker.*`` node settings
+    configure it.
+    """
+
+    #: a claimed half-open probe that never reports back (thread died)
+    #: frees the probe slot after this long
+    PROBE_TIMEOUT_S = 30.0
+
+    def __init__(self, threshold: int = 3, backoff_s: float = 1.0,
+                 max_backoff_s: float = 30.0):
+        self._lock = threading.Lock()
+        self.threshold = int(threshold)
+        self.base_backoff_s = float(backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self._reset_locked()
+
+    def _reset_locked(self) -> None:
+        self.state = "closed"
+        self.consecutive_errors = 0
+        self.trips = 0
+        self.probes = 0
+        self.errors_total = 0
+        self.last_error: str | None = None
+        self._backoff_s = self.base_backoff_s
+        self._retry_at = 0.0
+        self._probe_deadline: float | None = None
+
+    def reset(self) -> None:
+        with self._lock:
+            self._reset_locked()
+
+    def configure(self, threshold=None, backoff_s=None,
+                  max_backoff_s=None) -> None:
+        """Apply node settings (None leaves a knob unchanged)."""
+        with self._lock:
+            if threshold is not None:
+                self.threshold = max(int(threshold), 1)
+            if backoff_s is not None:
+                self.base_backoff_s = float(backoff_s)
+                if self.state == "closed":
+                    self._backoff_s = self.base_backoff_s
+            if max_backoff_s is not None:
+                self.max_backoff_s = float(max_backoff_s)
+
+    def allow(self) -> bool:
+        """May a device dispatch proceed? Open → False (until the
+        backoff elapses); half-open → True for exactly one caller (the
+        probe), False for everyone else."""
+        now = time.monotonic()
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                if now < self._retry_at:
+                    return False
+                self.state = "half_open"
+                self.probes += 1
+                self._probe_deadline = now + self.PROBE_TIMEOUT_S
+                return True
+            # half_open: one probe in flight at a time
+            if self._probe_deadline is not None and \
+                    now < self._probe_deadline:
+                return False
+            self.probes += 1
+            self._probe_deadline = now + self.PROBE_TIMEOUT_S
+            return True
+
+    def record_success(self) -> None:
+        """A device dispatch completed: closes a half-open probe, resets
+        the consecutive-error count."""
+        with self._lock:
+            if self.state == "half_open":
+                self.state = "closed"
+                self._backoff_s = self.base_backoff_s
+            self.consecutive_errors = 0
+            self._probe_deadline = None
+
+    def record_error(self, exc: BaseException) -> None:
+        """A device dispatch failed: counts toward the trip threshold;
+        a failed half-open probe re-opens with doubled backoff."""
+        now = time.monotonic()
+        with self._lock:
+            self.errors_total += 1
+            self.last_error = f"{type(exc).__name__}: {str(exc)[:160]}"
+            self.consecutive_errors += 1
+            if self.state == "half_open":
+                self.state = "open"
+                self.trips += 1
+                self._backoff_s = min(self._backoff_s * 2,
+                                      self.max_backoff_s)
+                self._retry_at = now + self._backoff_s
+                self._probe_deadline = None
+            elif self.state == "closed" and \
+                    self.consecutive_errors >= self.threshold:
+                self.state = "open"
+                self.trips += 1
+                self._retry_at = now + self._backoff_s
+
+    def stats(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "state": self.state,
+                "threshold": self.threshold,
+                "consecutive_errors": self.consecutive_errors,
+                "trips": self.trips,
+                "probes": self.probes,
+                "errors_total": self.errors_total,
+                "last_error": self.last_error,
+                "backoff_seconds": round(self._backoff_s, 3),
+                "open_remaining_seconds":
+                    round(max(self._retry_at - now, 0.0), 3)
+                    if self.state == "open" else 0.0,
+            }
+
+
+#: THE per-node plane breaker (module singleton — see class docstring)
+plane_breaker = PlaneBreaker()
+
+
+def note_device_error(exc: BaseException) -> None:
+    """One device error observed at a compiled-path seam: feeds the
+    plane breaker, and for HBM-OOM shapes first evicts cold blocks from
+    the PR 5 device-block cache — reclaiming headroom is cheaper than
+    degrading, and the next (re)build retries against a smaller
+    footprint."""
+    if is_device_oom(exc):
+        try:
+            from elasticsearch_tpu.parallel import mesh_engine
+            freed = mesh_engine.evict_cold_blocks()
+        except Exception:                # noqa: BLE001 — best-effort
+            freed = 0
+        with _cache_lock:
+            _stats["oom_evictions"] += 1
+            _stats["oom_bytes_evicted"] += int(freed)
+    plane_breaker.record_error(exc)
+
+
+def note_breaker_skip() -> None:
+    """One request routed to the fan-out/eager path because the plane
+    breaker was open — the degraded-mode-serving counter. (Collective-
+    plane admission declines label ``fallback_reasons`` separately via
+    :func:`note_plane_fallback` with reason ``breaker-open``.)"""
+    with _cache_lock:
+        _stats["breaker_open_skips"] += 1
 # mesh_program_* count the collective plane's shape-keyed PROGRAM layer
 # (mesh_engine._program): a miss is a fresh shard_map trace+compile, a
 # hit re-dispatches a compiled program against a new data-layer pack —
@@ -62,7 +277,12 @@ _stats = {"hits": 0, "misses": 0, "fallbacks": 0,
           # for a (probe layout × query-shape set) never seen before, a
           # hit re-dispatches against new stacked constants — the counters
           # behind the tier-1 "≤1 compile per plan shape" registry guard.
-          "percolate_program_hits": 0, "percolate_program_misses": 0}
+          "percolate_program_hits": 0, "percolate_program_misses": 0,
+          # degraded-mode serving: requests the open plane breaker routed
+          # to the fan-out/eager path (zero device dispatches), and
+          # HBM-OOM responses (cold-block evictions before degrading)
+          "breaker_open_skips": 0, "oom_evictions": 0,
+          "oom_bytes_evicted": 0}
 #: why searches left the compiled/collective path, by label
 #: (ineligible-shape / parse-error / refresh-race / device-error / …)
 _fallback_reasons: dict[str, int] = {}
@@ -83,8 +303,10 @@ _data_layer = {"bytes_uploaded": 0, "bytes_reused": 0,
 
 def cache_stats() -> dict:
     with _cache_lock:
-        return {**_stats, "fallback_reasons": dict(_fallback_reasons),
-                "data_layer": dict(_data_layer)}
+        out = {**_stats, "fallback_reasons": dict(_fallback_reasons),
+               "data_layer": dict(_data_layer)}
+    out["plane_breaker"] = plane_breaker.stats()
+    return out
 
 
 def note_data_blocks(col_bytes: int = 0, mask_bytes: int = 0,
@@ -150,7 +372,9 @@ def clear_cache() -> None:
         _stats.update(hits=0, misses=0, fallbacks=0,
                       mesh_program_hits=0, mesh_program_misses=0,
                       plane_fallbacks=0,
-                      percolate_program_hits=0, percolate_program_misses=0)
+                      percolate_program_hits=0, percolate_program_misses=0,
+                      breaker_open_skips=0, oom_evictions=0,
+                      oom_bytes_evicted=0)
         _fallback_reasons.clear()
         _data_layer.update({k: 0 for k in _data_layer})
 
@@ -352,6 +576,7 @@ def _get_compiled(key, build_fn):
     # harmless — last one wins the cache slot
     with _cache_lock:
         _stats["misses"] += 1
+    device_fault_point("compile")
     fn = build_fn()
     with _cache_lock:
         _cache[key] = fn
@@ -409,6 +634,7 @@ def run_segment(seg: DeviceSegment, ctx: ExecutionContext, query,
         return jax.jit(run).lower(*shapes).compile()
 
     fn = _get_compiled(key, compile_fn)
+    device_fault_point("dispatch")
     return fn(flat, consts)
 
 
@@ -561,6 +787,7 @@ def run_reader_batch(segments: list, ctx: ExecutionContext, queries: list,
         return jax.jit(run).lower(*shapes).compile()
 
     fn = _get_compiled(key, compile_fn)
+    device_fault_point("dispatch")
     out = fn(flats, packeds)
     if b_pad != b:
         out = out[:b] if pack else {name: v[:b] for name, v in out.items()}
@@ -592,8 +819,10 @@ def run_segments_streamed(segments: list, ctx: ExecutionContext,
         if plan is None:
             return None
         plans.append(plan)
-    put = (lambda a: jax.device_put(a, device)) if device is not None \
-        else jax.device_put
+    def put(a, _dev=device):
+        device_fault_point("upload")
+        return jax.device_put(a, _dev) if _dev is not None \
+            else jax.device_put(a)
 
     def get_fn(seg, plan):
         def compile_fn():
@@ -651,6 +880,7 @@ def run_segments_streamed(segments: list, ctx: ExecutionContext,
             packed = {dt: jnp.asarray(buf)
                       for dt, buf in plan["packed"].items()}
             t1 = time.perf_counter()
+            device_fault_point("dispatch")
             outs = fn(cur, packed)          # async dispatch
             stats["dispatch_s"] += time.perf_counter() - t1
             outs_all.append(outs)
@@ -801,6 +1031,7 @@ def run_percolate_lanes(lanes: list) -> list:
             _stats["percolate_program_hits" if hit
                    else "percolate_program_misses"] += 1
         fn = _get_compiled(full_key, compile_fn)
+        device_fault_point("percolate")
         out = fn(flats, packed)         # async dispatch: groups pipeline
         pending.append((idxs, out))
     for idxs, out in pending:
@@ -860,6 +1091,7 @@ def run_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,
         return jax.jit(run).lower(*shapes).compile()
 
     fn = _get_compiled(key, compile_fn)
+    device_fault_point("dispatch")
     outs = fn(flat, packed)
     if plan["b_pad"] != b:
         outs = {name: v[:b] for name, v in outs.items()}

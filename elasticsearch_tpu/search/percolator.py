@@ -166,6 +166,8 @@ class PercolatorRegistry:
             "time_ms": 0.0,
             "fused_queries": 0,          # query evaluations on the fused lane
             "fallback_queries": 0,       # ... on the per-query eager lane
+            "breaker_skips": 0,          # fused dispatches the open plane
+                                         # breaker routed to the eager lane
         }
         self._lock = threading.RLock()
         self._snap: dict | None = None   # meta.percolators as last synced
@@ -463,7 +465,15 @@ class PercolatorRegistry:
             except Exception as e:       # noqa: BLE001 — per-item contract
                 state["err"] = e
         # ---- the one dispatch ------------------------------------------
-        if lanes:
+        if lanes and not jit_exec.plane_breaker.allow():
+            # open plane breaker: the device is known-unhealthy — serve
+            # every fused query on the eager lane instead of re-paying
+            # the failing dispatch per percolate call
+            jit_exec.note_breaker_skip()
+            with self._lock:
+                self.stats["breaker_skips"] += 1
+            self._eager_rescue(items, per_item)
+        elif lanes:
             try:
                 outs = jit_exec.run_percolate_lanes(lanes)
                 for (it_idx, qids), out in zip(lane_owner, outs):
@@ -475,10 +485,12 @@ class PercolatorRegistry:
                             state["matched"][qid] = float(out[qi, 1])
                 self.stats["fused_queries"] += sum(
                     len(qids) for _, qids in lane_owner)
+                jit_exec.plane_breaker.record_success()
             except QueryParsingError:
                 raise
             except Exception as e:       # noqa: BLE001 — fallback seam
                 jit_exec.note_fallback(e, reason="device-error")
+                jit_exec.note_device_error(e)
                 self._eager_rescue(items, per_item)
         # ---- per-item rendering ------------------------------------------
         results = []

@@ -370,6 +370,13 @@ class ShardSearcher:
                 req, query=rewritten,
                 post_filter=None if req.post_filter is None
                 else self._rewrite_joins(req.post_filter))
+        # plane breaker: with the device marked unhealthy, go straight to
+        # the eager executor instead of re-paying a failing dispatch per
+        # query — the open breaker already knows how this would end; a
+        # half-open probe is admitted below and reports back
+        if not jit_exec.plane_breaker.allow():
+            jit_exec.note_breaker_skip()
+            return self._query_phase_eager(req)
         # Single-request fast path: delegate eligible requests to the
         # batched program with B=1. The batch program fuses scoring, merge
         # and packing into ONE dispatch + ONE device→host fetch; the
@@ -422,7 +429,9 @@ class ShardSearcher:
             raise
         except Exception as e:                # noqa: BLE001 — fallback seam
             jit_exec.note_fallback(e, reason="device-error")
+            jit_exec.note_device_error(e)
             return self._query_phase_eager(req)
+        jit_exec.plane_breaker.record_success()
 
         total = int(sum(int(np.asarray(o["count"])) for _, o in outs))
         if req.terminate_after is not None:
@@ -504,6 +513,11 @@ class ShardSearcher:
         _checkpoint(current_task())
         if not reqs:
             return ("empty", [])
+        if not jit_exec.plane_breaker.allow():
+            # open breaker: decline the batched device path; the caller's
+            # per-request fallback lands on query_phase, which routes to
+            # the eager executor under the same gate
+            return None
         for req in reqs:
             if (req.aggs or not _is_score_order(req.sort)
                     or req.post_filter is not None
@@ -536,9 +550,11 @@ class ShardSearcher:
             raise
         except Exception as e:            # noqa: BLE001 — fallback seam
             jit_exec.note_fallback(e, reason="device-error")
+            jit_exec.note_device_error(e)
             return None
         if out is None:                   # mixed plan signatures
             return None
+        jit_exec.plane_breaker.record_success()
         for arr in ([out] if pack else
                     [out["top_scores"], out["top_docs"], out["count"]]):
             try:
@@ -611,9 +627,11 @@ class ShardSearcher:
             raise
         except Exception as e:            # noqa: BLE001 — fallback seam
             jit_exec.note_fallback(e, reason="device-error")
+            jit_exec.note_device_error(e)
             return None
         if outs_s is None:
             return None
+        jit_exec.plane_breaker.record_success()
         ms_parts, md_parts = [], []
         totals = np.zeros(b, np.int64)
         if out_r is not None:

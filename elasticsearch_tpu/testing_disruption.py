@@ -386,6 +386,109 @@ class DiskFaultScheme:
             self.stop_disrupting()
 
 
+# ---- device-fault scheme (accelerator chaos) --------------------------------
+
+#: every device touchpoint the fault seam covers (jit_exec.
+#: device_fault_point call sites): compiled per-segment/reader dispatch,
+#: program compiles, host→device block uploads, device-side pack
+#: composes, the collective-plane mesh dispatch, fused percolate lanes
+DEVICE_FAULT_SITES = ("dispatch", "compile", "upload", "compose",
+                      "plane-dispatch", "percolate")
+
+
+class DeviceFaultScheme:
+    """Seeded accelerator-fault injection on jit_exec's device-fault
+    seam: each device touchpoint draws from a replayable rng and, with
+    probability ``p`` (overridable per site via ``p_by_site``), raises
+    an accelerator-style error there — a plain
+    :class:`jit_exec.DeviceFaultError` (dispatch/upload/compile
+    failure), or with probability ``oom_fraction`` a
+    :class:`jit_exec.DeviceOomError` (the RESOURCE_EXHAUSTED HBM-OOM
+    shape, which triggers cold-block eviction before degrading).
+
+    The seam is module-global (all in-process nodes share one device,
+    exactly like deployment shares one device per process), so the
+    scheme needs no node list. ``injected`` counts raises by site —
+    the number the breaker/fallback counters must reconcile with.
+    ``stop_disrupting`` restores the previous hook and (by default)
+    resets the plane breaker so a tripped-open state cannot leak into
+    unrelated tests.
+    """
+
+    def __init__(self, seed: int = 0, p: float = 0.0,
+                 sites: tuple = DEVICE_FAULT_SITES,
+                 p_by_site: dict | None = None,
+                 oom_fraction: float = 0.0,
+                 reset_breaker_on_stop: bool = True):
+        self.seed = seed
+        self.p = float(p)
+        self.sites = tuple(sites)
+        self.p_by_site = dict(p_by_site or {})
+        self.oom_fraction = float(oom_fraction)
+        self.reset_breaker_on_stop = reset_breaker_on_stop
+        self._rng = random.Random(seed)
+        self._prev = None
+        self._active = False
+        #: injected raises by site; ``calls`` counts every touchpoint
+        #: reached (0 while the breaker gates device work entirely)
+        self.injected: dict[str, int] = {}
+        self.calls = 0
+
+    @property
+    def total_injected(self) -> int:
+        return sum(self.injected.values())
+
+    def heal(self) -> None:
+        """Stop injecting (the hook stays installed and keeps counting
+        touchpoints) — the 'faults heal' half of a recovery scenario."""
+        self.p = 0.0
+        self.p_by_site = {}
+
+    def _hook(self, site: str) -> None:
+        from elasticsearch_tpu.search import jit_exec
+        self.calls += 1
+        p = self.p_by_site.get(site, self.p if site in self.sites else 0.0)
+        if p <= 0.0 or self._rng.random() >= p:
+            return
+        self.injected[site] = self.injected.get(site, 0) + 1
+        if self.oom_fraction and self._rng.random() < self.oom_fraction:
+            raise jit_exec.DeviceOomError(
+                f"RESOURCE_EXHAUSTED: simulated HBM out of memory at "
+                f"[{site}] (seed={self.seed})")
+        raise jit_exec.DeviceFaultError(
+            f"simulated device fault [{site}] (seed={self.seed})")
+
+    def _chained(self, site: str) -> None:
+        if self._prev is not None:
+            self._prev(site)
+        self._hook(site)
+
+    def start_disrupting(self) -> None:
+        if self._active:
+            return
+        from elasticsearch_tpu.search import jit_exec
+        self._prev = jit_exec.set_device_fault_hook(self._chained)
+        self._active = True
+
+    def stop_disrupting(self) -> None:
+        if not self._active:
+            return
+        from elasticsearch_tpu.search import jit_exec
+        jit_exec.set_device_fault_hook(self._prev)
+        self._prev = None
+        self._active = False
+        if self.reset_breaker_on_stop:
+            jit_exec.plane_breaker.reset()
+
+    @contextlib.contextmanager
+    def applied(self):
+        self.start_disrupting()
+        try:
+            yield self
+        finally:
+            self.stop_disrupting()
+
+
 # ---- coordinator-kill scenario (task-management chaos) ----------------------
 
 def run_coordinator_kill_case(seed: int, transport: str = "local") -> dict:
@@ -480,6 +583,10 @@ SCHEME_NAMES = (
     "reorder",
     "block_state_one",
     "slow_state_one",
+    # accelerator faults (the device-fault seam; node list unused —
+    # every in-process node shares the one device)
+    "device_flaky",
+    "device_oom",
 )
 
 
@@ -489,6 +596,14 @@ def build_scheme(name: str, nodes: list, rnd: random.Random):
     point the randomized matrix (tests/test_randomized_matrix.py) and
     replay tooling share. → scheme or None ("none")."""
     seed = rnd.randrange(2 ** 31)
+    if name == "device_flaky":
+        # intermittent accelerator faults across every device touchpoint:
+        # everything must degrade (fan-out / eager / rescue), never error
+        return DeviceFaultScheme(seed=seed, p=rnd.uniform(0.05, 0.25))
+    if name == "device_oom":
+        # HBM-OOM shape: cold-block eviction then degrade
+        return DeviceFaultScheme(seed=seed, p=rnd.uniform(0.05, 0.2),
+                                 oom_fraction=1.0)
     if name == "none" or len(nodes) < 2:
         return None
     if name == "partition_minority":

@@ -1,0 +1,461 @@
+"""Accelerator-fault tolerance (tier-1).
+
+Seeded device-fault injection (testing_disruption.DeviceFaultScheme on
+jit_exec's device-fault seam), the plane circuit breaker (closed → open
+after N consecutive device errors → half-open probe with exponential
+backoff), degraded-mode serving (plane → fan-out → eager, responses
+bit-identical throughout), background pack-build hardening, and the
+HBM-OOM cold-block-eviction response. The acceptance contract:
+
+* with faults injected, the breaker opens after N consecutive device
+  errors and serves every request via the fan-out with ZERO further
+  device dispatches while open;
+* a half-open probe restores the plane within bounded backoff once
+  faults heal;
+* zero leaked breaker bytes and green plane-vs-fanout equality after
+  every seeded device-fault case.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search import jit_exec
+from elasticsearch_tpu.testing_disruption import (DEVICE_FAULT_SITES,
+                                                  DeviceFaultScheme,
+                                                  wait_until)
+
+DFS = "dfs_query_then_fetch"
+
+
+@pytest.fixture(autouse=True)
+def _pristine_breaker():
+    """Every test starts and leaves with default breaker knobs, no
+    residual trip state, and no fault hook installed."""
+    jit_exec.set_device_fault_hook(None)
+    jit_exec.plane_breaker.reset()
+    jit_exec.plane_breaker.configure(threshold=3, backoff_s=1.0,
+                                     max_backoff_s=30.0)
+    yield
+    jit_exec.set_device_fault_hook(None)
+    jit_exec.plane_breaker.reset()
+    jit_exec.plane_breaker.configure(threshold=3, backoff_s=1.0,
+                                     max_backoff_s=30.0)
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node({}, data_path=tmp_path_factory.mktemp("devf") / "n").start()
+    rng = np.random.default_rng(11)
+    for name, plane in (("on", True), ("off", False)):
+        n.indices_service.create_index(name, {
+            "settings": {"number_of_shards": 4, "number_of_replicas": 0,
+                         "index.search.collective_plane": plane},
+            "mappings": {"_doc": {"properties": {
+                "t": {"type": "text", "analyzer": "whitespace"},
+                "v": {"type": "long"}}}}})
+    for i in range(240):
+        words = " ".join(f"w{int(x)}" for x in rng.zipf(1.5, 6) if x < 40)
+        doc = {"t": words or "w1", "v": i}
+        n.index_doc("on", str(i), doc)
+        n.index_doc("off", str(i), doc)
+    n.broadcast_actions.refresh("on")
+    n.broadcast_actions.refresh("off")
+    # warm the plane pack + let the coalesced background build drain so
+    # tests that forbid background device work see a quiet node
+    n.search("on", {"query": {"match": {"t": "w1"}}, "size": 5})
+    time.sleep(0.3)
+    yield n
+    n.close()
+
+
+BODIES = [
+    {"query": {"match": {"t": "w1 w3"}}, "size": 25},
+    {"query": {"bool": {"must": [{"match": {"t": "w2"}}],
+                        "filter": [{"range": {"v": {"gte": 100}}}]}},
+     "size": 10},
+    {"query": {"match": {"t": "w1"}}, "from": 5, "size": 10},
+    {"query": {"match": {"t": "w4 w2"}}, "size": 15,
+     "sort": [{"v": {"order": "desc"}}]},
+]
+
+
+def _sig(resp):
+    return (resp["hits"]["total"],
+            [(h["_id"], None if h["_score"] is None
+              else round(h["_score"], 4), h.get("sort"))
+             for h in resp["hits"]["hits"]])
+
+
+# ---------------------------------------------------------------------------
+# the breaker state machine
+# ---------------------------------------------------------------------------
+
+def test_breaker_state_machine():
+    b = jit_exec.PlaneBreaker(threshold=3, backoff_s=0.1,
+                              max_backoff_s=0.4)
+    err = RuntimeError("boom")
+    assert b.allow() and b.state == "closed"
+    b.record_error(err)
+    b.record_error(err)
+    assert b.state == "closed" and b.allow()
+    b.record_success()                       # success resets the count
+    b.record_error(err)
+    b.record_error(err)
+    assert b.state == "closed"
+    b.record_error(err)                      # 3rd CONSECUTIVE error trips
+    assert b.state == "open" and b.trips == 1
+    assert not b.allow()                     # gated while open
+    time.sleep(0.12)
+    assert b.allow() and b.state == "half_open"   # backoff elapsed: probe
+    assert not b.allow()                     # ...exactly ONE probe
+    b.record_error(err)                      # failed probe: reopen, 2x
+    assert b.state == "open"
+    st = b.stats()
+    assert st["backoff_seconds"] == pytest.approx(0.2)
+    assert not b.allow()
+    time.sleep(0.22)
+    assert b.allow() and b.state == "half_open"
+    b.record_success()                       # healed probe closes
+    assert b.state == "closed"
+    assert b.stats()["backoff_seconds"] == pytest.approx(0.1)  # reset
+    assert b.probes == 2 and b.errors_total == 6
+
+
+def test_fault_scheme_replays_from_seed():
+    """The same seed draws the identical fault sequence — the PR 1
+    matrix replay discipline applied to device faults."""
+    def draw(seed):
+        scheme = DeviceFaultScheme(seed=seed, p=0.3, oom_fraction=0.3)
+        out = []
+        for i in range(300):
+            site = DEVICE_FAULT_SITES[i % len(DEVICE_FAULT_SITES)]
+            try:
+                scheme._hook(site)
+                out.append((site, None))
+            except jit_exec.DeviceOomError:
+                out.append((site, "oom"))
+            except jit_exec.DeviceFaultError:
+                out.append((site, "fault"))
+        return out, dict(scheme.injected)
+    s1, i1 = draw(42)
+    s2, i2 = draw(42)
+    assert s1 == s2 and i1 == i2
+    assert sum(i1.values()) > 0
+    other, _ = draw(43)
+    assert other != s1
+
+
+# ---------------------------------------------------------------------------
+# degraded-mode serving: equality fuzz + counter reconciliation
+# ---------------------------------------------------------------------------
+
+def test_equality_and_counters_under_intermittent_faults(node, test_random):
+    """Plane-vs-fanout equality fuzz under intermittent injected device
+    faults: responses stay bit-identical regardless of which path serves
+    each request (plane / fan-out / eager), and the device-error and
+    breaker counters reconcile exactly with the injected fault count."""
+    # huge threshold: every fault is recorded, the breaker never gates —
+    # this test pins the per-request fallback seams, not the breaker
+    jit_exec.plane_breaker.configure(threshold=10 ** 9)
+    expected = [_sig(node.search("off", dict(b), search_type=DFS))
+                for b in BODIES]
+    js0 = jit_exec.cache_stats()
+    dev0 = js0["fallback_reasons"].get("device-error", 0)
+    scheme = DeviceFaultScheme(seed=test_random.randrange(2 ** 31), p=0.35)
+    with scheme.applied():
+        for i in range(24):
+            bi = test_random.randrange(len(BODIES))
+            a = node.search("on", dict(BODIES[bi]), search_type=DFS)
+            b = node.search("off", dict(BODIES[bi]), search_type=DFS)
+            assert _sig(a) == expected[bi], \
+                (bi, scheme.injected, _sig(a), expected[bi])
+            assert _sig(b) == expected[bi], (bi, scheme.injected)
+        js1 = jit_exec.cache_stats()
+        injected = scheme.total_injected
+        assert injected > 0, "seeded fuzz drew zero faults — widen p"
+        # every injected raise surfaced as exactly one labeled
+        # device-error fallback AND one breaker-recorded error
+        assert js1["fallback_reasons"].get("device-error", 0) - dev0 \
+            == injected, (js1["fallback_reasons"], scheme.injected)
+        assert js1["plane_breaker"]["errors_total"] == injected
+        assert js1["plane_breaker"]["trips"] == 0
+
+
+def test_breaker_opens_serves_fanout_then_probe_restores(node):
+    """The acceptance path end to end: N consecutive device errors open
+    the breaker; while open EVERY request serves via fan-out/eager with
+    ZERO device touchpoints reached; after faults heal, a half-open
+    probe restores the plane within the backoff bound."""
+    jit_exec.plane_breaker.configure(threshold=3, backoff_s=2.0)
+    body = BODIES[0]
+    expected = _sig(node.search("off", dict(body), search_type=DFS))
+    svc = node.indices_service.indices["on"]
+    scheme = DeviceFaultScheme(seed=7, p=1.0)
+    with scheme.applied():
+        # every device path fails → consecutive errors trip the breaker
+        for _ in range(4):
+            out = node.search("on", dict(body), search_type=DFS)
+            assert _sig(out) == expected      # degraded, never wrong
+            if jit_exec.plane_breaker.stats()["state"] == "open":
+                break
+        st = jit_exec.plane_breaker.stats()
+        assert st["state"] == "open", st
+        assert st["trips"] == 1
+        # open: zero further device dispatches — the fault hook sits at
+        # every device touchpoint, so its call count must not move
+        calls_before = scheme.calls
+        served_before = svc.plane_stats["served"]
+        for _ in range(5):
+            out = node.search("on", dict(body), search_type=DFS)
+            assert _sig(out) == expected
+        assert scheme.calls == calls_before, \
+            "device touchpoint reached while the breaker was open"
+        assert svc.plane_stats["served"] == served_before
+        assert jit_exec.cache_stats()["breaker_open_skips"] > 0
+        fb = svc.plane_stats["fallback"]
+        assert fb.get("breaker-open", 0) >= 5
+        # faults heal (hook keeps counting); the breaker is still open
+        scheme.heal()
+        time.sleep(2.1)                      # past the backoff bound
+        out = node.search("on", dict(body), search_type=DFS)
+        assert _sig(out) == expected
+        st = jit_exec.plane_breaker.stats()
+        assert st["state"] == "closed", st   # the probe closed it
+        assert st["probes"] >= 1
+        assert svc.plane_stats["served"] > served_before, \
+            "plane did not resume serving after the probe"
+
+
+# ---------------------------------------------------------------------------
+# background pack-build hardening (_plane_warm)
+# ---------------------------------------------------------------------------
+
+def test_plane_warm_failure_degrades_then_recovers(node):
+    """An injected background-build failure cannot leak fielddata
+    breaker bytes or silently kill the coalesced-rebuild path: failed
+    warms retry, exhaust their budget, mark the index plane-degraded
+    (searches keep serving — never an error), and a later successful
+    build clears the marking; teardown drains the bytes to baseline."""
+    sa = node.search_actions
+    fd = node.breaker_service.breaker("fielddata")
+    baseline = fd.used
+    node.indices_service.create_index("warm", {
+        "settings": {"number_of_shards": 3, "number_of_replicas": 0},
+        "mappings": {"_doc": {"properties": {
+            "t": {"type": "text", "analyzer": "whitespace"}}}}})
+    for i in range(40):
+        node.index_doc("warm", str(i), {"t": f"w{i % 6} shared"})
+    node.broadcast_actions.refresh("warm")
+    body = {"query": {"match": {"t": "shared"}}, "size": 10}
+    expected = _sig(node.search("warm", dict(body), search_type=DFS))
+    svc = node.indices_service.indices["warm"]
+    assert "_mesh_cache" in svc.__dict__      # plane pack exists → warms
+    time.sleep(0.3)                           # drain the initial warm
+    sa.PLANE_WARM_MAX_RETRIES = 1             # first failure degrades
+    scheme = DeviceFaultScheme(seed=3, p=1.0,
+                               reset_breaker_on_stop=True)
+    try:
+        with scheme.applied():
+            # a refresh schedules the background build, which fails
+            node.index_doc("warm", "x1", {"t": "shared fresh"})
+            node.broadcast_actions.refresh("warm")
+            assert wait_until(
+                lambda: svc.plane_stats.get("degraded", False),
+                timeout=10.0), "failed warm never marked plane-degraded"
+            # degraded ≠ broken: searches still serve (fan-out/eager)
+            out = node.search("warm", dict(body), search_type=DFS)
+            assert out["hits"]["total"] == 41
+        # healed (+ breaker reset): the next served plane batch clears
+        # the degraded marking and the failure count
+        node.broadcast_actions.refresh("warm")
+        out = node.search("warm", dict(body), search_type=DFS)
+        assert out["hits"]["total"] == 41
+        assert wait_until(
+            lambda: not node.search("warm", dict(body),
+                                    search_type=DFS).get("error")
+            and not svc.plane_stats.get("degraded", False),
+            timeout=10.0), svc.plane_stats
+        assert sa._plane_warm_failures.get("warm") is None
+        # the coalesced-rebuild path survived: another refresh still
+        # triggers a background build that lands a fresh-generation pack
+        node.index_doc("warm", "x2", {"t": "shared again"})
+        node.broadcast_actions.refresh("warm")
+        gens = tuple(e.acquire_searcher().generation
+                     for _, e in sorted(svc.engines.items()))
+        assert wait_until(
+            lambda: (svc.__dict__.get("_mesh_cache") or (None,))[0]
+            == gens, timeout=10.0), "background rebuild never landed"
+    finally:
+        del sa.PLANE_WARM_MAX_RETRIES         # restore the class default
+        node.indices_service.delete_index("warm")
+    # zero leaked breaker bytes after the whole fault episode
+    assert wait_until(lambda: fd.used <= baseline, timeout=10.0), \
+        (fd.used, baseline)
+    expected_still = _sig(node.search("on", dict(BODIES[0]),
+                                      search_type=DFS))
+    assert expected_still == _sig(node.search("off", dict(BODIES[0]),
+                                              search_type=DFS))
+    assert expected is not None
+
+
+# ---------------------------------------------------------------------------
+# HBM-OOM → cold-block eviction
+# ---------------------------------------------------------------------------
+
+def test_oom_evicts_cold_blocks_then_rebuild_is_consistent(node):
+    """A RESOURCE_EXHAUSTED-shaped device error evicts cold blocks from
+    the PR 5 device-block cache (reclaiming fielddata-charged HBM)
+    before the request degrades; the post-heal rebuild re-uploads fresh
+    blocks with no stale block_uid reuse and unchanged results."""
+    from elasticsearch_tpu.parallel import mesh_engine
+    jit_exec.plane_breaker.configure(threshold=10 ** 9)
+    body = BODIES[0]
+    expected = _sig(node.search("off", dict(body), search_type=DFS))
+    # ensure resident blocks exist (the fixture's warm search built them)
+    assert node.search("on", dict(body), search_type=DFS)
+    before = mesh_engine.block_cache_stats()
+    assert before["entries"] > 0
+    js0 = jit_exec.cache_stats()
+    scheme = DeviceFaultScheme(seed=5, p_by_site={"plane-dispatch": 1.0},
+                               oom_fraction=1.0)
+    with scheme.applied():
+        out = node.search("on", dict(body), search_type=DFS)
+        assert _sig(out) == expected          # degraded to fan-out
+    after = mesh_engine.block_cache_stats()
+    js1 = jit_exec.cache_stats()
+    assert js1["oom_evictions"] == js0["oom_evictions"] + \
+        scheme.injected.get("plane-dispatch", 0)
+    assert after["entries"] < before["entries"]
+    # healed: the plane rebuilds (a refresh moves the generation so the
+    # pack re-composes, re-fetching blocks) and equality stays green
+    node.index_doc("on", "oomx", {"t": "w1 w3", "v": 999})
+    node.index_doc("off", "oomx", {"t": "w1 w3", "v": 999})
+    node.broadcast_actions.refresh("on")
+    node.broadcast_actions.refresh("off")
+    expected2 = _sig(node.search("off", dict(body), search_type=DFS))
+    assert _sig(node.search("on", dict(body), search_type=DFS)) \
+        == expected2
+    # no stale block_uid reuse across the fault-triggered rebuild
+    svc = node.indices_service.indices["on"]
+    live = {e.engine_uuid: {s.block_uid
+                            for s in e.acquire_searcher().segments}
+            for e in svc.engines.values()}
+    for uuid, uid, _sig_k in mesh_engine.block_cache_keys():
+        if uuid in live:
+            assert uid == 0 or uid in live[uuid], \
+                f"stale block_uid {uid} for engine {uuid[:8]}"
+
+
+# ---------------------------------------------------------------------------
+# percolator gating
+# ---------------------------------------------------------------------------
+
+def test_percolator_rides_breaker_and_rescues(node):
+    """The percolator registry is gated on the same plane breaker: with
+    the breaker open, fused lanes skip the device entirely (eager lane
+    serves, counted in breaker_skips); device errors on the fused
+    dispatch rescue eagerly and feed the breaker."""
+    from elasticsearch_tpu.search.percolator import (percolate,
+                                                     percolate_serial,
+                                                     registry_stats)
+    node.indices_service.create_index("perc", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"_doc": {"properties": {
+            "t": {"type": "text", "analyzer": "whitespace"},
+            "n": {"type": "long"}}}}})
+    try:
+        for i in range(12):
+            q = {"match": {"t": f"w{i % 4}"}} if i % 2 \
+                else {"range": {"n": {"gte": i}}}
+            node.indices_service.put_percolator("perc", f"q{i}",
+                                                {"query": q})
+        meta = node.cluster_service.state().indices["perc"]
+        doc = {"t": "w0 w1 w3", "n": 7}
+        oracle = percolate_serial(meta, doc)
+        out = percolate(meta, doc)            # warm, fused path
+        assert out["total"] == oracle["total"]
+        # device error on the fused dispatch → eager rescue, breaker fed
+        jit_exec.plane_breaker.configure(threshold=2, backoff_s=5.0)
+        scheme = DeviceFaultScheme(seed=9, p_by_site={"percolate": 1.0})
+        with scheme.applied():
+            for _ in range(2):                # trips at threshold=2
+                out = percolate(meta, doc)
+                assert out["total"] == oracle["total"], scheme.injected
+            assert jit_exec.plane_breaker.stats()["state"] == "open"
+            calls_before = scheme.calls
+            skips0 = registry_stats("perc")["breaker_skips"]
+            out = percolate(meta, doc)        # open: eager, no device
+            assert out["total"] == oracle["total"]
+            assert scheme.calls == calls_before
+            assert registry_stats("perc")["breaker_skips"] == skips0 + 1
+        # scheme stop reset the breaker: fused path resumes
+        fused0 = registry_stats("perc")["fused_queries"]
+        out = percolate(meta, doc)
+        assert out["total"] == oracle["total"]
+        assert registry_stats("perc")["fused_queries"] > fused0
+    finally:
+        node.indices_service.delete_index("perc")
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def rest(node):
+    from elasticsearch_tpu.rest.controller import RestController
+    from elasticsearch_tpu.rest.handlers import register_all
+    rc = RestController()
+    register_all(rc, node)
+
+    def call(method, uri, body=b""):
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body).encode()
+        return rc.dispatch(method, uri, body)
+    return call
+
+
+def test_breaker_surfaces_in_stats_and_cat(node, rest):
+    """_nodes/stats carries the plane breaker section (state, trips,
+    consecutive errors, last error, probes), per-index _stats carries
+    search.collective_plane.breaker + degraded, and _cat/indices grows
+    a plane-health column that tracks the breaker state."""
+    st, ns = rest("GET", "/_nodes/stats")
+    nid = next(iter(ns["nodes"]))
+    breaker = ns["nodes"][nid]["indices"]["collective_plane"]["breaker"]
+    for key in ("state", "trips", "consecutive_errors", "last_error",
+                "probes", "threshold"):
+        assert key in breaker, breaker
+    assert breaker["state"] == "closed"
+    assert ns["nodes"][nid]["indices"]["collective_plane"][
+        "degraded_indices"] == []
+    st, out = rest("GET", "/on/_stats")
+    plane = out["indices"]["on"]["total"]["search"]["collective_plane"]
+    assert plane["breaker"]["state"] == "closed"
+    assert plane["degraded"] is False
+    st, cat = rest("GET", "/_cat/indices?v&h=index,plane.health")
+    rows = {ln.split()[0]: ln.split()[1]
+            for ln in cat.splitlines()[1:] if ln.strip()}
+    assert rows["on"] == "ok"
+    assert rows["off"] == "off"               # explicit plane opt-out
+    # trip the breaker: every surface flips together
+    for _ in range(3):
+        jit_exec.plane_breaker.record_error(RuntimeError("synthetic"))
+    try:
+        st, ns = rest("GET", "/_nodes/stats")
+        nid = next(iter(ns["nodes"]))
+        b2 = ns["nodes"][nid]["indices"]["collective_plane"]["breaker"]
+        assert b2["state"] == "open" and b2["trips"] == 1
+        assert "synthetic" in b2["last_error"]
+        st, cat = rest("GET", "/_cat/indices?v&h=index,plane.health")
+        rows = {ln.split()[0]: ln.split()[1]
+                for ln in cat.splitlines()[1:] if ln.strip()}
+        assert rows["on"] == "breaker-open"
+    finally:
+        jit_exec.plane_breaker.reset()

@@ -51,6 +51,9 @@ SCENARIOS = [
     "snapshot_during_churn",
     "master_failover_during_bulk",
     "disk_fault_failover",
+    # v3 accelerator-fault combination scenarios
+    "device_fault_during_refresh_storm",
+    "device_fault_during_relocation",
 ]
 
 #: scenarios that stage their own disruption — layering a random scheme
@@ -59,6 +62,7 @@ SELF_DISRUPTING = {
     "kill_replica_holder", "partition_minority", "node_churn",
     "recovery_during_relocation", "snapshot_during_churn",
     "master_failover_during_bulk", "disk_fault_failover",
+    "device_fault_during_refresh_storm", "device_fault_during_relocation",
 }
 
 #: schemes a write-exercising scenario can carry while still asserting
@@ -66,12 +70,15 @@ SELF_DISRUPTING = {
 #: possibly late, duplicated, or reordered. Drop-based schemes run in
 #: the self-disrupting scenarios and tests/test_chaos_faults.py, where
 #: assertions use acked-sets instead of exact totals.
+#: device-fault schemes join the soft set: an accelerator fault degrades
+#: the serving path (plane → fan-out → eager), it never drops an ack
 SOFT_SCHEMES = ("none", "delays", "flaky_delay", "duplicate", "reorder",
-                "slow_state_one")
+                "slow_state_one", "device_flaky", "device_oom")
 
 #: deterministic tier-1 smoke subset (the full matrix is `slow`)
 SMOKE = ["crud_search", "partition_minority", "recovery_during_relocation",
-         "master_failover_during_bulk", "disk_fault_failover"]
+         "master_failover_during_bulk", "disk_fault_failover",
+         "device_fault_during_refresh_storm"]
 
 VARIANTS = int(os.environ.get("ESTPU_MATRIX_VARIANTS", "3"))
 
@@ -767,3 +774,121 @@ def _scenario_disk_fault_failover(c, rnd, spec):
     m.broadcast_actions.refresh("m_dff")
     assert m.search("m_dff", {"size": 0})["hits"]["total"] == n_docs + 1
     assert m.get_doc("m_dff", "during-fault")["found"]
+
+
+def _scenario_device_fault_during_refresh_storm(c, rnd, spec):
+    """Accelerator faults while refreshes churn the incremental data
+    plane (PR 5 block cache + background generation swap): every search
+    stays correct — served by the plane, the fan-out or the eager
+    executor, never an error — the block cache holds no stale
+    ``block_uid`` after the fault-triggered rebuilds, and deleting the
+    index drains every fielddata byte (no stranded breaker budget)."""
+    from elasticsearch_tpu.parallel import mesh_engine
+    from elasticsearch_tpu.testing_disruption import (DeviceFaultScheme,
+                                                      wait_until)
+    a = c.nodes[0]
+    # full replication: the coordinating node holds every shard, so the
+    # collective plane — the device path under test — engages
+    a.indices_service.create_index("m_devrs", {"settings": {
+        "number_of_shards": rnd.randint(2, 3),
+        "number_of_replicas": len(c.nodes) - 1}})
+    _green(a)
+    total = rnd.randint(20, 40)
+    for i in range(total):
+        a.index_doc("m_devrs", str(i),
+                    {"n": i, "body": f"tok{i % 5} shared"})
+    a.broadcast_actions.refresh("m_devrs")
+    assert a.search("m_devrs", {"size": 0})["hits"]["total"] == total
+    scheme = DeviceFaultScheme(seed=rnd.randrange(2 ** 31),
+                               p=rnd.uniform(0.2, 0.6),
+                               oom_fraction=0.2)
+    with scheme.applied():
+        for r in range(rnd.randint(3, 5)):       # the refresh storm
+            for i in range(rnd.randint(5, 10)):
+                a.index_doc("m_devrs", f"s{r}-{i}",
+                            {"n": i, "body": "shared storm"})
+                total += 1
+            a.broadcast_actions.refresh("m_devrs")
+            got = _any_node(c, rnd).search(
+                "m_devrs", {"size": 0})["hits"]["total"]
+            assert got == total, (got, total, scheme.injected)
+    # healed (scheme stop reset the breaker): serving continues, and the
+    # block cache must hold no block_uid that left its engine's reader
+    a.broadcast_actions.refresh("m_devrs")
+    assert a.search("m_devrs", {"size": 0})["hits"]["total"] == total
+    live: dict = {}
+    for n in c.nodes:
+        svc = n.indices_service.indices.get("m_devrs")
+        if svc is None:
+            continue
+        for e in svc.engines.values():
+            live[e.engine_uuid] = {s.block_uid
+                                   for s in e.acquire_searcher().segments}
+    for uuid, uid, _sig in mesh_engine.block_cache_keys():
+        if uuid in live:
+            assert uid == 0 or uid in live[uuid], \
+                f"stale block_uid {uid} cached for engine {uuid[:8]} " \
+                f"(injected={scheme.injected})"
+    # teardown drains the data plane's breaker bytes entirely
+    a.indices_service.delete_index("m_devrs")
+    assert wait_until(lambda: all(
+        n.breaker_service.breaker("fielddata").used == 0
+        for n in c.nodes if n._started), timeout=15.0), \
+        [(n.node_name, n.breaker_service.breaker("fielddata").used)
+         for n in c.nodes if n._started]
+
+
+def _scenario_device_fault_during_relocation(c, rnd, spec):
+    """Accelerator faults while a primary relocates: the copy machinery
+    must complete untouched (device faults degrade the SERVING paths,
+    never recovery), searches stay correct throughout, and teardown
+    releases the closed source engine's device blocks — fielddata
+    drains to zero on every node."""
+    from elasticsearch_tpu.testing_disruption import (DeviceFaultScheme,
+                                                      wait_until)
+    a = c.master()
+    a.indices_service.create_index("m_devrel", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0}})
+    _green(a)
+    n_pre = rnd.randint(20, 40)
+    for i in range(n_pre):
+        a.index_doc("m_devrel", f"pre-{i}", {"n": i, "body": f"tok{i % 5}"})
+    a.broadcast_actions.refresh("m_devrel")
+    assert a.search("m_devrel", {"size": 0})["hits"]["total"] == n_pre
+    src = c.primary_node("m_devrel", 0)
+    others = [n for n in c.nodes if n is not src and n._started]
+    dst = others[rnd.randrange(len(others))]
+    extra = rnd.randint(5, 15)
+    scheme = DeviceFaultScheme(seed=rnd.randrange(2 ** 31),
+                               p=rnd.uniform(0.2, 0.6))
+    with scheme.applied():
+        a.cluster_reroute([{"move": {
+            "index": "m_devrel", "shard": 0,
+            "from_node": src.node_id, "to_node": dst.node_id}}])
+        for i in range(extra):           # writes land during the handoff
+            _any_node(c, rnd).index_doc("m_devrel", f"live-{i}", {"n": i})
+        # searches during the relocation degrade, never error
+        got = _any_node(c, rnd).search(
+            "m_devrel", {"size": 0})["hits"]["total"]
+        assert n_pre <= got <= n_pre + extra, (got, n_pre, extra)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = c.master().cluster_service.state()
+            pr = st.routing_table.primary("m_devrel", 0)
+            if pr is not None and pr.node_id == dst.node_id and \
+                    pr.state == "STARTED":
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                f"relocation did not complete under device faults "
+                f"(injected={scheme.injected})")
+    c.master().broadcast_actions.refresh("m_devrel")
+    total = c.master().search("m_devrel", {"size": 0})["hits"]["total"]
+    assert total == n_pre + extra, (total, n_pre, extra)
+    a.indices_service.delete_index("m_devrel")
+    assert wait_until(lambda: all(
+        n.breaker_service.breaker("fielddata").used == 0
+        for n in c.nodes if n._started), timeout=15.0), \
+        [(n.node_name, n.breaker_service.breaker("fielddata").used)
+         for n in c.nodes if n._started]
