@@ -1,0 +1,8 @@
+"""``python -m elasticsearch_tpu.analysis`` → plane-lint (see
+elasticsearch_tpu/analysis/lint/)."""
+
+import sys
+
+from elasticsearch_tpu.analysis.lint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,160 @@
+"""plane-lint: AST-level invariant analysis for the accelerator plane.
+
+Five rule families over the ``elasticsearch_tpu`` tree — breaker
+discipline, device-seam coverage, recompile hazards, lock discipline,
+host-sync hazards — each with inline suppressions
+(``# estpu: allow[rule-id] <reason>``), machine-readable output, and a
+tier-1 tree-is-clean gate (tests/test_static_analysis.py).
+
+Run it::
+
+    python -m elasticsearch_tpu.analysis [paths] [--json]
+    estpu-lint elasticsearch_tpu/
+
+API::
+
+    result = lint_paths(["elasticsearch_tpu"])
+    result.unsuppressed        # findings the gate fails on
+    result.to_json()           # stamped with per-family rule counts
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from elasticsearch_tpu.analysis.lint.context import (
+    DEFAULT_CONFIG, Finding, LintConfig, ModuleContext, RULE_FAMILIES)
+from elasticsearch_tpu.analysis.lint import (
+    rule_breaker, rule_device, rule_hostsync, rule_locks, rule_recompile)
+
+__all__ = ["Finding", "LintConfig", "LintResult", "DEFAULT_CONFIG",
+           "RULE_FAMILIES", "lint_paths", "iter_py_files"]
+
+_PER_MODULE_RULES = (rule_breaker.check, rule_device.check,
+                     rule_recompile.check, rule_hostsync.check,
+                     rule_locks.check_state)
+
+
+@dataclass
+class LintResult:
+    findings: list = field(default_factory=list)
+    files: int = 0
+    errors: list = field(default_factory=list)   # unparseable files
+
+    @property
+    def unsuppressed(self) -> list:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> list:
+        return [f for f in self.findings if f.suppressed]
+
+    def counts(self) -> dict:
+        by_rule: dict = {}
+        by_family: dict = {}
+        for f in self.findings:
+            key = "suppressed" if f.suppressed else "open"
+            by_rule.setdefault(f.rule, {"open": 0, "suppressed": 0})
+            by_rule[f.rule][key] += 1
+            by_family.setdefault(f.family, {"open": 0, "suppressed": 0})
+            by_family[f.family][key] += 1
+        return {"rules": by_rule, "families": by_family}
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "tool": "plane-lint",
+            "version": 1,
+            "files": self.files,
+            "findings": [f.to_dict() for f in self.findings],
+            "counts": self.counts(),
+            "open": len(self.unsuppressed),
+            "suppressed": len(self.suppressed),
+            "parse_errors": self.errors,
+        }, indent=2, sort_keys=True)
+
+    def render(self) -> str:
+        lines = [f.render() for f in sorted(
+            self.findings, key=lambda f: (f.path, f.line, f.rule))]
+        counts = self.counts()["families"]
+        fam = ", ".join(f"{name}: {c['open']}+{c['suppressed']}a"
+                        for name, c in sorted(counts.items()))
+        lines.append(
+            f"plane-lint: {len(self.unsuppressed)} finding(s), "
+            f"{len(self.suppressed)} allowed, {self.files} file(s)"
+            + (f" [{fam}]" if fam else ""))
+        for path, err in self.errors:
+            lines.append(f"plane-lint: parse error in {path}: {err}")
+        return "\n".join(lines)
+
+
+def iter_py_files(paths) -> list:
+    out = []
+    for path in paths:
+        if os.path.isfile(path) and path.endswith(".py"):
+            out.append(path)
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__",))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+    return out
+
+
+def _relpath(path: str) -> str:
+    rel = os.path.relpath(path)
+    return rel.replace(os.sep, "/")
+
+
+def lint_paths(paths, config: LintConfig = DEFAULT_CONFIG) -> LintResult:
+    result = LintResult()
+    contexts = []
+    for path in iter_py_files(paths):
+        rel = _relpath(path)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            ctx = ModuleContext(rel, src)
+        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+            result.errors.append((rel, str(exc)))
+            continue
+        contexts.append(ctx)
+    result.files = len(contexts)
+
+    lock_infos = []
+    by_rel = {}
+    for ctx in contexts:
+        by_rel[ctx.relpath] = ctx
+        for rule in _PER_MODULE_RULES:
+            result.findings.extend(rule(ctx, config))
+        result.findings.extend(ctx.meta_findings())
+        lock_infos.append(rule_locks.collect(ctx, config))
+
+    # cross-module lock-order pass (suppressible at the acquisition line)
+    for f in rule_locks.finalize(lock_infos, config):
+        ctx = by_rel.get(f.path)
+        if ctx is not None:
+            for line in (f.line - 1, f.line):
+                for rid, reason in ctx.suppressions.get(line, ()):
+                    if rid == f.rule and reason:
+                        f.suppressed = True
+                        f.suppress_reason = reason
+        result.findings.append(f)
+    return result
+
+
+def lock_graph_for(paths, config: LintConfig = DEFAULT_CONFIG):
+    """(edges, ranks) of the static lock-acquisition graph — the runtime
+    watchdog (elasticsearch_tpu.analysis.watchdog) consumes this."""
+    infos = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                ctx = ModuleContext(_relpath(path), fh.read())
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue
+        infos.append(rule_locks.collect(ctx, config))
+    edges = rule_locks.lock_graph(infos, config)
+    return edges, rule_locks.lock_ranks(edges)

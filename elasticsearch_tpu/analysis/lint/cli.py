@@ -1,0 +1,57 @@
+"""plane-lint command line: ``estpu-lint [paths] [--json] [--rule ID]``.
+
+Exit status 0 when every finding is suppressed (with a reason), 1 when
+open findings remain, 2 on usage/parse errors — so the tier-1 gate and
+any CI step can ride the exit code directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from elasticsearch_tpu.analysis.lint import (
+    DEFAULT_CONFIG, RULE_FAMILIES, lint_paths)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="estpu-lint",
+        description="plane-lint: AST invariant analysis for the "
+                    "accelerator plane (breaker / device-seam / "
+                    "recompile / lock / host-sync discipline)")
+    parser.add_argument("paths", nargs="*", default=["elasticsearch_tpu"],
+                        help="files or directories (default: "
+                             "elasticsearch_tpu)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report with per-rule "
+                             "counts")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="ID",
+                        help="only report these rule ids (repeatable)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print rule ids and families, then exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid, family in sorted(RULE_FAMILIES.items()):
+            print(f"{rid:28s} {family}")
+        return 0
+
+    result = lint_paths(args.paths, DEFAULT_CONFIG)
+    if args.rule:
+        unknown = set(args.rule) - set(RULE_FAMILIES)
+        if unknown:
+            print(f"estpu-lint: unknown rule id(s): "
+                  f"{', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+        result.findings = [f for f in result.findings
+                           if f.rule in args.rule]
+    print(result.to_json() if args.json else result.render())
+    if result.errors:
+        return 2
+    return 1 if result.unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,297 @@
+"""Shared AST plumbing for plane-lint.
+
+One :class:`ModuleContext` per analyzed file: the parsed tree with parent
+links, a function index (qualnames, lexical nesting, owning class), the
+import-alias table (so ``jit_exec.device_fault_point`` resolves across
+modules), and the inline-suppression index for the
+``# estpu: allow[rule-id] <reason>`` syntax.
+
+Suppressions attach to the STATEMENT they share a line with (any line of
+a multi-line statement works) or to the line directly above it; a bare
+``allow`` with no reason string does not suppress — it surfaces as an
+``allow-missing-reason`` finding instead, so every surviving suppression
+documents why the invariant does not apply.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+from dataclasses import dataclass, field
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*estpu:\s*allow\[([A-Za-z0-9_-]+)\]\s*(.*?)\s*$")
+
+#: rule-id → family (the JSON report counts by family; the ids are what
+#: suppressions name)
+RULE_FAMILIES = {
+    "breaker-unreleased": "breaker-discipline",
+    "breaker-double-release": "breaker-discipline",
+    "device-raw-call": "device-seam",
+    "device-unguarded": "device-seam",
+    "device-unknown-site": "device-seam",
+    "recompile-request-path": "recompile-hazard",
+    "recompile-unbucketed-key": "recompile-hazard",
+    "lock-order": "lock-discipline",
+    "lock-unguarded-state": "lock-discipline",
+    "host-sync-hot-loop": "host-sync",
+    "allow-missing-reason": "meta",
+}
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: str | None = None
+
+    @property
+    def family(self) -> str:
+        return RULE_FAMILIES.get(self.rule, "unknown")
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "family": self.family,
+                "path": self.path, "line": self.line,
+                "message": self.message, "suppressed": self.suppressed,
+                "suppress_reason": self.suppress_reason}
+
+    def render(self) -> str:
+        tag = "allowed" if self.suppressed else "error"
+        out = (f"{self.path}:{self.line}: [{self.rule}] {tag}: "
+               f"{self.message}")
+        if self.suppressed and self.suppress_reason:
+            out += f" (reason: {self.suppress_reason})"
+        return out
+
+
+@dataclass
+class LintConfig:
+    """Everything repo-specific the rules key on — overridable so the
+    fixture suite can point the seam/hot-path scoping at synthetic
+    files."""
+
+    #: modules allowed to touch the device directly (fnmatch over the
+    #: posix relpath) — the seam allowlist from the device-seam rule
+    seam_modules: tuple = ("*/search/jit_exec.py",
+                           "*/parallel/mesh_engine.py",
+                           "*/parallel/mesh.py",
+                           "*/ops/*.py")
+    #: modules whose dispatch loops the host-sync rule polices
+    hot_modules: tuple = ("*/search/jit_exec.py",
+                          "*/parallel/mesh_engine.py",
+                          "*/search/percolator.py",
+                          "*/ops/percolate.py")
+    #: the site classes device_fault_point may name
+    #: (testing_disruption.DEVICE_FAULT_SITES + READER_UPLOAD_SITE)
+    known_sites: tuple = ("dispatch", "compile", "upload", "compose",
+                          "plane-dispatch", "percolate", "reader-upload")
+    #: site classes that mark a LOOP as a dispatch loop (host-sync rule)
+    dispatch_sites: tuple = ("dispatch", "plane-dispatch", "percolate")
+    #: the seam entry points (calls routed through these are guarded)
+    fault_point_names: tuple = ("device_fault_point",)
+    seam_wrappers: tuple = ("seam_device_put", "seam_jit")
+    #: closures passed (by name) to these functions are compiled behind
+    #: a guarded, cache-keyed trampoline
+    trampolines: tuple = ("_get_compiled",)
+    #: referencing any of these inside a function counts as consulting
+    #: the PROGRAM-layer cache (recompile rule)
+    cache_markers: tuple = ("_get_compiled", "_program_cache",
+                            "note_mesh_program")
+    #: calls that construct a compiled program (recompile rule tracks
+    #: raw jax.jit plus the repo's guarded wrapper)
+    jit_constructors: tuple = ("jax.jit", "seam_jit")
+    #: batch-size bucketing helpers (recompile key rule)
+    bucket_fns: tuple = ("pow2_bucket",)
+    #: charge constructors the breaker rule pairs with .release()
+    charge_classes: tuple = ("OneShotCharge",)
+    #: methods whose callers are asserted (by name) to hold the lock
+    locked_suffix: str = "_locked"
+    #: container methods that mutate in place (lock-discipline rule)
+    mutators: tuple = ("append", "add", "update", "clear", "pop",
+                       "popitem", "setdefault", "extend", "remove",
+                       "discard", "move_to_end", "insert")
+
+
+DEFAULT_CONFIG = LintConfig()
+
+
+def module_matches(relpath: str, patterns: tuple) -> bool:
+    rel = relpath.replace("\\", "/")
+    return any(fnmatch.fnmatch(rel, pat) or fnmatch.fnmatch("*/" + rel, pat)
+               for pat in patterns)
+
+
+@dataclass
+class FunctionInfo:
+    node: object                       # FunctionDef | AsyncFunctionDef | Lambda
+    name: str
+    qualname: str
+    parent: "FunctionInfo | None"
+    class_name: str | None
+
+
+@dataclass
+class ModuleContext:
+    relpath: str
+    source: str
+    tree: ast.Module = None
+    suppressions: dict = field(default_factory=dict)   # line → [(rule, reason)]
+    functions: list = field(default_factory=list)
+    _fn_of_node: dict = field(default_factory=dict)    # id(node) → FunctionInfo
+    import_aliases: dict = field(default_factory=dict)  # alias → module path
+
+    def __post_init__(self):
+        self.tree = ast.parse(self.source)
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._pl_parent = node
+        self._index_suppressions()
+        self._index_functions()
+        self._index_imports()
+
+    # ---- suppressions -----------------------------------------------------
+
+    def _index_suppressions(self) -> None:
+        # tokenize so only REAL comments count — a docstring describing
+        # the allow syntax must not suppress anything
+        import io
+        import tokenize
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    self.suppressions.setdefault(
+                        tok.start[0], []).append((m.group(1), m.group(2)))
+        except tokenize.TokenError:
+            pass
+
+    def suppression_for(self, rule: str, node) -> "tuple | None":
+        """→ (reason,) if an allow[rule] comment covers `node` (any line
+        of its statement, or the line directly above)."""
+        stmt = self.enclosing_stmt(node)
+        lo = getattr(stmt, "lineno", node.lineno)
+        hi = getattr(stmt, "end_lineno", lo)
+        for line in range(lo - 1, hi + 1):
+            for rid, reason in self.suppressions.get(line, ()):
+                if rid == rule:
+                    return (reason,)
+        return None
+
+    def meta_findings(self) -> list:
+        """A bare allow with no reason never suppresses — report it."""
+        out = []
+        for line, entries in sorted(self.suppressions.items()):
+            for rid, reason in entries:
+                if not reason:
+                    out.append(Finding(
+                        "allow-missing-reason", self.relpath, line,
+                        f"suppression allow[{rid}] carries no reason "
+                        f"string — every allow must say why"))
+                elif rid not in RULE_FAMILIES:
+                    out.append(Finding(
+                        "allow-missing-reason", self.relpath, line,
+                        f"suppression names unknown rule id [{rid}]"))
+        return out
+
+    # ---- structure --------------------------------------------------------
+
+    def _index_functions(self) -> None:
+        def visit(node, parent_fn, class_name, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    info = FunctionInfo(child, child.name, qual,
+                                        parent_fn, class_name)
+                    self.functions.append(info)
+                    self._fn_of_node[id(child)] = info
+                    visit(child, info, class_name, qual + ".")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, parent_fn, child.name,
+                          prefix + child.name + ".")
+                else:
+                    visit(child, parent_fn, class_name, prefix)
+        visit(self.tree, None, None, "")
+
+    def _index_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.import_aliases[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.import_aliases[alias.asname or
+                                        alias.name.split(".")[0]] = \
+                        alias.name
+
+    def parent(self, node):
+        return getattr(node, "_pl_parent", None)
+
+    def ancestors(self, node):
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_stmt(self, node):
+        cur = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = self.parent(cur)
+        return cur or node
+
+    def enclosing_function(self, node) -> "FunctionInfo | None":
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return self._fn_of_node[id(anc)]
+        return None
+
+    def function_info(self, fn_node) -> "FunctionInfo | None":
+        return self._fn_of_node.get(id(fn_node))
+
+    def enclosing_chain(self, node):
+        info = self.enclosing_function(node)
+        while info is not None:
+            yield info
+            info = info.parent
+
+
+def callee_dotted(call: ast.Call) -> str:
+    """Best-effort dotted name of a call's callee ('' when dynamic)."""
+    return dotted(call.func)
+
+
+def dotted(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def last_name(node) -> str:
+    d = dotted(node)
+    return d.rsplit(".", 1)[-1] if d else ""
+
+
+def apply_suppressions(ctx: ModuleContext, findings: list, nodes: list
+                       ) -> list:
+    """Pair rule findings with their AST nodes and mark the suppressed
+    ones (reason recorded)."""
+    for f, node in zip(findings, nodes):
+        hit = ctx.suppression_for(f.rule, node)
+        if hit is not None and hit[0]:
+            f.suppressed = True
+            f.suppress_reason = hit[0]
+    return findings

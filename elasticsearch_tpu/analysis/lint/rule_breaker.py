@@ -1,0 +1,222 @@
+"""breaker-discipline: every charge has a release reachable on all exits.
+
+Charge sites are calls to ``<breaker>.add_estimate(...)`` and
+constructions of :class:`OneShotCharge` (under any import alias). A site
+passes when the reservation provably has a release path:
+
+* the charge's enclosing class itself defines ``release`` (the pairing
+  primitive — OneShotCharge.charge lives next to its release);
+* the charge sits inside a ``try`` whose ``finally`` (or an ``except``
+  handler) calls or registers ``.release`` — the straight-line pairing;
+* the same receiver has a ``.release`` call elsewhere in the function
+  (the charge-before-try and delta-accounting shapes: ES charges OUTSIDE
+  the try so a failed reservation is never double-released);
+* the charge object ESCAPES the function — stored to an attribute /
+  subscript / collection, returned, or handed to another callable
+  (close/swap listeners, cache entries that release on eviction).
+
+``breaker-double-release``: two unconditional ``x.release()`` calls on
+one receiver in the same straight-line suite — the double-return shape
+that under-accounts a shared breaker.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticsearch_tpu.analysis.lint.context import (
+    Finding, apply_suppressions, dotted, last_name)
+
+
+def _charge_aliases(ctx, cfg) -> set:
+    out = set(cfg.charge_classes)
+    for alias, target in ctx.import_aliases.items():
+        if target.rsplit(".", 1)[-1] in cfg.charge_classes:
+            out.add(alias)
+    return out
+
+
+def _is_charge_call(node: ast.Call, aliases: set) -> str | None:
+    name = last_name(node.func)
+    if name == "add_estimate" and isinstance(node.func, ast.Attribute):
+        return "add_estimate"
+    if name in aliases and isinstance(node.func, ast.Name):
+        return "OneShotCharge"
+    return None
+
+
+def _release_in(suites) -> bool:
+    for sub in suites:
+        for n in ast.walk(sub):
+            if isinstance(n, ast.Attribute) and n.attr == "release":
+                return True
+    return False
+
+
+def _class_defines_release(ctx, fn) -> bool:
+    """Is the charge inside a class that defines release() itself (the
+    pairing primitive)?"""
+    if fn is None or fn.class_name is None:
+        return False
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef) and node.name == fn.class_name:
+            return any(isinstance(m, ast.FunctionDef) and
+                       m.name == "release" for m in node.body)
+    return False
+
+
+def _in_guarded_try(ctx, call, fn_node) -> bool:
+    for anc in ctx.ancestors(call):
+        if anc is fn_node:
+            break
+        if isinstance(anc, ast.Try):
+            if _release_in(anc.finalbody) or _release_in(anc.handlers):
+                return True
+    return False
+
+
+def _receiver_released_in_fn(call, fn_node) -> bool:
+    """`recv.add_estimate(...)` paired by any `recv.release(...)` in the
+    same function (covers charge-before-try/finally and branch deltas)."""
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    recv = dotted(call.func.value)
+    if not recv:
+        return False
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Attribute) and n.attr == "release" and \
+                dotted(n.value) == recv:
+            return True
+    return False
+
+
+def _in_receiver_chain(node, call: ast.Call) -> bool:
+    """Is `node` inside `call`'s callee expression (a chained method ON
+    the charge rather than the charge escaping into an argument)?"""
+    return any(sub is node for sub in ast.walk(call.func))
+
+
+def _escapes(ctx, call: ast.Call, fn_node) -> bool:
+    """Does the charge value leave the function (stored / returned /
+    registered), or get released through its bound name?"""
+    cur = call
+    for anc in ctx.ancestors(call):
+        if anc is fn_node:
+            break
+        if isinstance(anc, ast.Return):
+            return True
+        if isinstance(anc, ast.Call) and not _in_receiver_chain(cur, anc):
+            return True                 # handed to another callable
+        if isinstance(anc, (ast.Assign, ast.AnnAssign)):
+            targets = anc.targets if isinstance(anc, ast.Assign) \
+                else [anc.target]
+            if any(isinstance(t, (ast.Attribute, ast.Subscript,
+                                  ast.Tuple, ast.List)) for t in targets):
+                return True
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if names:
+                return _name_escapes(names, fn_node, call)
+        cur = anc
+    return False
+
+
+def _name_escapes(names: list, fn_node, origin) -> bool:
+    for n in ast.walk(fn_node):
+        if n is origin:
+            continue
+        if isinstance(n, ast.Attribute) and \
+                isinstance(n.value, ast.Name) and n.value.id in names:
+            if n.attr == "release":
+                return True             # released (or registered) by name
+            continue
+        if isinstance(n, ast.Call):
+            for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) and sub.id in names:
+                        return True
+        elif isinstance(n, ast.Assign):
+            if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                   for t in n.targets) and \
+                    any(isinstance(s, ast.Name) and s.id in names
+                        for s in ast.walk(n.value)):
+                return True
+        elif isinstance(n, ast.Return) and n.value is not None:
+            if any(isinstance(s, ast.Name) and s.id in names
+                   for s in ast.walk(n.value)):
+                return True
+    return False
+
+
+def check(ctx, cfg) -> list:
+    aliases = _charge_aliases(ctx, cfg)
+    findings, nodes = [], []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _is_charge_call(node, aliases)
+        if kind is None:
+            continue
+        fn = ctx.enclosing_function(node)
+        if fn is None:
+            continue                    # module scope: test scaffolding
+        if _class_defines_release(ctx, fn) or \
+                _in_guarded_try(ctx, node, fn.node) or \
+                _receiver_released_in_fn(node, fn.node) or \
+                _escapes(ctx, node, fn.node):
+            continue
+        findings.append(Finding(
+            "breaker-unreleased", ctx.relpath, node.lineno,
+            f"{kind} in {fn.qualname}() has no release pairing "
+            f"reachable on all exits (no try/finally release, no "
+            f"same-function release, and the charge never escapes to "
+            f"a listener/cache/owner)"))
+        nodes.append(node)
+
+    # double-release: two unconditional x.release() in one suite
+    for fn in ctx.functions:
+        for body in _suites(fn.node):
+            seen: dict = {}
+            for stmt in body:
+                if not isinstance(stmt, ast.Expr) or \
+                        not isinstance(stmt.value, ast.Call):
+                    continue
+                call = stmt.value
+                if last_name(call.func) != "release" or \
+                        not isinstance(call.func, ast.Attribute):
+                    continue
+                recv = dotted(call.func.value)
+                if not recv:
+                    continue
+                if recv in seen:
+                    findings.append(Finding(
+                        "breaker-double-release", ctx.relpath,
+                        call.lineno,
+                        f"{recv}.release() called twice in the same "
+                        f"suite of {fn.qualname}() (first at line "
+                        f"{seen[recv]}) — double-releasing "
+                        f"under-accounts the breaker"))
+                    nodes.append(call)
+                else:
+                    seen[recv] = call.lineno
+    return apply_suppressions(ctx, findings, nodes)
+
+
+def _suites(fn_node):
+    """Every statement suite of a function, NOT descending into nested
+    defs (their suites are visited when their own FunctionInfo is)."""
+    stack = [fn_node]
+    while stack:
+        n = stack.pop()
+        for attr in ("body", "orelse", "finalbody"):
+            body = getattr(n, attr, None)
+            if isinstance(body, list) and body:
+                if n is not fn_node and isinstance(
+                        n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                yield body
+                stack.extend(s for s in body if not isinstance(
+                    s, (ast.FunctionDef, ast.AsyncFunctionDef)))
+        for h in getattr(n, "handlers", ()) or ():
+            if h.body:
+                yield h.body
+                stack.extend(h.body)

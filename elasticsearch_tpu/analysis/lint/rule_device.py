@@ -1,0 +1,227 @@
+"""device-seam coverage: every device touchpoint rides the fault seam.
+
+``device-raw-call``: outside the allowlisted seam modules
+(``search/jit_exec.py``, ``parallel/mesh_engine.py``, ``ops/*``) any raw
+``jax.device_put`` / ``jax.block_until_ready`` / ``.block_until_ready()``
+reference is an error, as is a ``jax.jit`` call constructed inside a
+function body (module-level kernel definitions — the ``ops/*`` decorator
+pattern — compile once per static shape and are allowed). Non-seam code
+routes uploads/compiles through the jit_exec seam wrappers
+(``seam_device_put`` / ``seam_jit``) so chaos can inject there and the
+plane breaker sees the error.
+
+``device-unguarded``: inside seam modules, every ``jax.device_put`` and
+program-compile call (``jax.jit`` / ``.lower().compile()``) in a
+function body must be DOMINATED by a ``device_fault_point(<site>)`` call
+naming a known site class — lexically earlier in the same function, in
+an enclosing function, or the call lives in a closure handed to the
+``_get_compiled`` trampoline (which guards before invoking it).
+
+``device-unknown-site``: a ``device_fault_point`` call whose site is not
+a known class (or not a string literal) — the chaos scheme would never
+draw it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticsearch_tpu.analysis.lint.context import (
+    Finding, apply_suppressions, dotted, last_name, module_matches)
+
+_RAW_DEVICE = {"jax.device_put", "jax.block_until_ready"}
+
+
+def _device_ref_kind(node, ctx) -> str | None:
+    """Classify a raw device reference: 'device_put', 'block', 'jit'."""
+    d = dotted(node)
+    if d in ("jax.device_put",):
+        return "device_put"
+    if d == "jax.block_until_ready":
+        return "block"
+    if isinstance(node, ast.Attribute) and \
+            node.attr == "block_until_ready":
+        return "block"
+    if d == "jax.jit":
+        return "jit"
+    return None
+
+
+def _fault_sites_before(ctx, cfg, fn, lineno) -> list:
+    """Site literals of device_fault_point calls in `fn` (or enclosing
+    functions) at or before `lineno`."""
+    sites = []
+    info = fn
+    while info is not None:
+        for n in ast.walk(info.node):
+            if isinstance(n, ast.Call) and \
+                    last_name(n.func) in cfg.fault_point_names and \
+                    n.lineno <= lineno and n.args:
+                a = n.args[0]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    sites.append(a.value)
+        info = info.parent
+    return sites
+
+
+def _wrapper_forwards_guard(cfg, fn, lineno) -> bool:
+    """Inside a registered seam WRAPPER (seam_device_put / seam_jit) the
+    fault point forwards the caller's site parameter —
+    ``device_fault_point(site)`` with ``site`` a parameter Name. The
+    literal is validated at every wrapper call site instead, so a
+    forwarded guard at/above `lineno` dominates the wrapper body."""
+    if fn is None or fn.name not in cfg.seam_wrappers:
+        return False
+    params = {a.arg for a in fn.node.args.args + fn.node.args.kwonlyargs}
+    for n in ast.walk(fn.node):
+        if isinstance(n, ast.Call) and \
+                last_name(n.func) in cfg.fault_point_names and \
+                n.lineno <= lineno and n.args and \
+                isinstance(n.args[0], ast.Name) and \
+                n.args[0].id in params:
+            return True
+    return False
+
+
+def _in_trampoline_closure(ctx, cfg, fn) -> bool:
+    """Is `fn` (or an enclosing def) passed BY NAME to a guarded
+    trampoline like _get_compiled in its enclosing scope?"""
+    info = fn
+    while info is not None:
+        outer = info.parent
+        scope = outer.node if outer is not None else ctx.tree
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Call) and \
+                    last_name(n.func) in cfg.trampolines:
+                for arg in n.args:
+                    if isinstance(arg, ast.Name) and arg.id == info.name:
+                        return True
+        info = outer
+    return False
+
+
+def _effective_function(ctx, node):
+    """Enclosing function, treating a DECORATOR expression as belonging
+    to the scope the decorated function is defined in — a module-level
+    ``@partial(jax.jit, ...)`` kernel is a once-per-shape compile, not a
+    per-request construction."""
+    fn = ctx.enclosing_function(node)
+    if fn is not None and any(
+            any(sub is node for sub in ast.walk(dec))
+            for dec in fn.node.decorator_list):
+        return fn.parent
+    return fn
+
+
+def check(ctx, cfg) -> list:
+    in_seam = module_matches(ctx.relpath, cfg.seam_modules)
+    findings, nodes = [], []
+
+    for node in ast.walk(ctx.tree):
+        # --- device_fault_point site vocabulary ---------------------------
+        if isinstance(node, ast.Call) and \
+                last_name(node.func) in cfg.fault_point_names:
+            ok = (node.args and isinstance(node.args[0], ast.Constant)
+                  and node.args[0].value in cfg.known_sites)
+            fn0 = ctx.enclosing_function(node)
+            if not ok and fn0 is not None and \
+                    fn0.name in cfg.seam_wrappers and node.args and \
+                    isinstance(node.args[0], ast.Name):
+                ok = True               # wrapper forwards its caller's
+            if not ok:                  # literal (checked at call sites)
+                findings.append(Finding(
+                    "device-unknown-site", ctx.relpath, node.lineno,
+                    f"device_fault_point site must be a string literal "
+                    f"from {sorted(cfg.known_sites)} — the chaos scheme "
+                    f"never draws an unknown site"))
+                nodes.append(node)
+            continue
+        # seam-wrapper call sites: the forwarded site literal is checked
+        # here instead of inside the wrapper
+        if isinstance(node, ast.Call) and \
+                last_name(node.func) in cfg.seam_wrappers:
+            site_arg = None
+            for kw in node.keywords:
+                if kw.arg == "site":
+                    site_arg = kw.value
+            if len(node.args) >= 3:
+                site_arg = node.args[2]
+            if site_arg is not None and not (
+                    isinstance(site_arg, ast.Constant) and
+                    site_arg.value in cfg.known_sites):
+                findings.append(Finding(
+                    "device-unknown-site", ctx.relpath, node.lineno,
+                    f"{last_name(node.func)} site= must be a string "
+                    f"literal from {sorted(cfg.known_sites)}"))
+                nodes.append(node)
+            continue
+        kind = None
+        if isinstance(node, (ast.Attribute, ast.Name)) and \
+                isinstance(getattr(node, "ctx", None), ast.Load):
+            parent = ctx.parent(node)
+            if isinstance(parent, (ast.Attribute,)):
+                continue                # inner part of a longer dotted path
+            kind = _device_ref_kind(node, ctx)
+            if kind is None:
+                continue
+            if isinstance(parent, ast.Call) and parent.func is node:
+                node_for_line = parent
+            else:
+                node_for_line = node
+        else:
+            continue
+
+        fn = _effective_function(ctx, node)
+        if not in_seam:
+            if kind == "jit" and fn is None:
+                continue                # module-level kernel definition
+            findings.append(Finding(
+                "device-raw-call", ctx.relpath, node_for_line.lineno,
+                f"raw {dotted(node) or node.attr} outside the seam "
+                f"allowlist — route through the jit_exec seam "
+                f"(seam_device_put / seam_jit / device_fault_point) so "
+                f"faults inject and the plane breaker observes it"))
+            nodes.append(node_for_line)
+            continue
+
+        # --- inside a seam module: dominance by the fault seam ------------
+        if fn is None:
+            continue                    # module-level kernel definition
+        if kind == "block":
+            continue                    # sync discipline is host-sync's rule
+        want = ("upload", "compose") if kind == "device_put" \
+            else ("compile",)
+        sites = _fault_sites_before(ctx, cfg, fn, node_for_line.lineno)
+        if any(s in want for s in sites):
+            continue
+        if _wrapper_forwards_guard(cfg, fn, node_for_line.lineno) or \
+                _in_trampoline_closure(ctx, cfg, fn):
+            continue
+        findings.append(Finding(
+            "device-unguarded", ctx.relpath, node_for_line.lineno,
+            f"{dotted(node)} in {fn.qualname}() is not dominated by "
+            f"device_fault_point({'/'.join(want)}) — this device "
+            f"touchpoint is invisible to fault injection and the "
+            f"plane breaker"))
+        nodes.append(node_for_line)
+
+    # .lower(...).compile() chains in seam modules count as compiles
+    if in_seam:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "compile" and \
+                    "jit" in ast.dump(node.func.value)[:400]:
+                fn = ctx.enclosing_function(node)
+                if fn is None:
+                    continue
+                sites = _fault_sites_before(ctx, cfg, fn, node.lineno)
+                if "compile" in sites or \
+                        _in_trampoline_closure(ctx, cfg, fn):
+                    continue
+                findings.append(Finding(
+                    "device-unguarded", ctx.relpath, node.lineno,
+                    f"program compile in {fn.qualname}() is not "
+                    f"dominated by device_fault_point(compile)"))
+                nodes.append(node)
+    return apply_suppressions(ctx, findings, nodes)

@@ -1,0 +1,124 @@
+"""host-sync hazard: no device→host sync inside a dispatch loop.
+
+Scope: functions in the hot-path modules (jit_exec / mesh_engine /
+percolator / ops.percolate) whose body dispatches compiled programs —
+marked by a ``device_fault_point`` call with a dispatch-class site
+(``dispatch`` / ``plane-dispatch`` / ``percolate``) or a
+``_get_compiled`` call.
+
+Inside such a function, a ``for``/``while`` loop that CONTAINS a
+dispatch marker must not also host-sync per iteration: the async
+dispatch pipeline (groups/segments overlapping on device) serializes
+the moment the loop body forces a transfer. Flagged syncs:
+
+* ``np.asarray(...)`` / ``.item()`` on anything;
+* ``jax.block_until_ready`` / ``.block_until_ready()``;
+* ``float()`` / ``int()`` / ``bool()`` applied to a dispatch RESULT —
+  a name bound from calling a ``_get_compiled``-produced program.
+
+Syncs after the loop (drain-at-the-end) are the intended shape and pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticsearch_tpu.analysis.lint.context import (
+    Finding, apply_suppressions, dotted, last_name, module_matches)
+
+
+def _dispatch_markers(fn_node, cfg) -> list:
+    out = []
+    for n in ast.walk(fn_node):
+        if not isinstance(n, ast.Call):
+            continue
+        name = last_name(n.func)
+        if name in cfg.trampolines:
+            out.append(n)
+        elif name in cfg.fault_point_names and n.args and \
+                isinstance(n.args[0], ast.Constant) and \
+                n.args[0].value in cfg.dispatch_sites:
+            out.append(n)
+    return out
+
+
+def _dispatch_result_names(fn_node, cfg) -> set:
+    """Names bound from invoking a compiled program: `fn =
+    _get_compiled(...)` (or self._program(...)) then `out = fn(...)`."""
+    program_names: set = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            callee = last_name(n.value.func)
+            if callee in cfg.trampolines or callee == "_program":
+                program_names.update(
+                    t.id for t in n.targets if isinstance(t, ast.Name))
+    results: set = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                and isinstance(n.value.func, ast.Name) and \
+                n.value.func.id in program_names:
+            results.update(
+                t.id for t in n.targets if isinstance(t, ast.Name))
+    return results
+
+
+def _base_name(expr) -> str:
+    while isinstance(expr, (ast.Subscript, ast.Attribute)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else ""
+
+
+def _sync_calls(loop, results: set):
+    for n in ast.walk(loop):
+        if not isinstance(n, ast.Call):
+            continue
+        d = dotted(n.func)
+        name = last_name(n.func)
+        # dotted() gives '' when the receiver is a call/subscript chain
+        # (`program(h).item()`), so method matches use the raw attr
+        attr = n.func.attr if isinstance(n.func, ast.Attribute) else ""
+        if d == "np.asarray" or d == "numpy.asarray":
+            yield n, "np.asarray forces a device→host transfer"
+        elif attr == "item" and not n.args:
+            yield n, ".item() forces a device→host transfer"
+        elif name == "block_until_ready" or attr == "block_until_ready":
+            yield n, "block_until_ready stalls the dispatch pipeline"
+        elif name in ("float", "int", "bool") and n.args and \
+                _base_name(n.args[0]) in results:
+            yield (n, f"{name}() on a dispatch result synchronizes "
+                      f"the device")
+
+
+def check(ctx, cfg) -> list:
+    if not module_matches(ctx.relpath, cfg.hot_modules):
+        return []
+    findings, nodes = [], []
+    for fn in ctx.functions:
+        markers = _dispatch_markers(fn.node, cfg)
+        if not markers:
+            continue
+        results = _dispatch_result_names(fn.node, cfg)
+        seen: set = set()
+        for loop in ast.walk(fn.node):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            marker_lines = {m.lineno for m in markers
+                            if _contains(loop, m)}
+            if not marker_lines:
+                continue
+            for call, why in _sync_calls(loop, results):
+                if id(call) in seen:
+                    continue
+                seen.add(id(call))
+                findings.append(Finding(
+                    "host-sync-hot-loop", ctx.relpath, call.lineno,
+                    f"{why} inside the dispatch loop of "
+                    f"{fn.qualname}() (dispatch at line "
+                    f"{min(marker_lines)}) — sync after the loop so "
+                    f"dispatches pipeline"))
+                nodes.append(call)
+    return apply_suppressions(ctx, findings, nodes)
+
+
+def _contains(outer, inner) -> bool:
+    return any(n is inner for n in ast.walk(outer))

@@ -1,0 +1,491 @@
+"""lock-discipline: the acquisition graph and guarded-state writes.
+
+Lock identities resolve statically:
+
+* ``with self._lock:`` inside class C of module m → ``m.C._lock``;
+* ``with _cache_lock:`` on a module global → ``m._cache_lock``;
+* ``with singleton._lock:`` where ``singleton = ClassName(...)`` at
+  module level → ``m.ClassName._lock``.
+
+``lock-order``: edges A→B are collected from (a) a ``with B`` lexically
+nested under ``with A`` and (b) one-level interprocedural resolution —
+while holding A, a call to a module-local function / same-class method /
+imported-module function whose body directly acquires B. A pair with
+edges both ways is a potential deadlock; both acquisition sites are
+named. Locks constructed as ``RLock()`` are reentrant, so A→A self
+edges are reported only for plain ``Lock()``.
+
+``lock-unguarded-state``: a module-level mutable container (or an
+instance attribute bound to one in ``__init__``) that is mutated under a
+lock ANYWHERE is lock-owned; every other mutation of it must hold the
+same lock. Exemptions: ``__init__`` (construction), methods named
+``*_locked`` (the caller-holds-the-lock convention), module scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from elasticsearch_tpu.analysis.lint.context import (
+    Finding, apply_suppressions, dotted, last_name)
+
+_MUTABLE_CTORS = ("dict", "list", "set", "OrderedDict", "defaultdict",
+                  "deque")
+
+
+def _modkey(relpath: str) -> str:
+    return relpath.replace("\\", "/").rsplit(".py", 1)[0] \
+        .replace("/", ".")
+
+
+@dataclass
+class LockSite:
+    lock: str          # resolved identity
+    relpath: str
+    line: int
+
+
+@dataclass
+class ModuleLockInfo:
+    modkey: str
+    relpath: str
+    #: lock identity → [LockSite] (every acquisition)
+    acquisitions: dict = field(default_factory=dict)
+    #: fn qualname → [lock identities it DIRECTLY acquires]
+    fn_locks: dict = field(default_factory=dict)
+    #: edges: (outer, inner) → (site_outer, site_inner)
+    edges: dict = field(default_factory=dict)
+    #: calls made while holding a lock: (lock, callee_repr, LockSite)
+    held_calls: list = field(default_factory=list)
+    #: lock identity → is reentrant (RLock)
+    reentrant: dict = field(default_factory=dict)
+    #: import alias → module dotted path
+    import_aliases: dict = field(default_factory=dict)
+    #: module-level singleton name → class name
+    singletons: dict = field(default_factory=dict)
+    #: every name bound at module scope (lock-identity resolution)
+    module_names: set = field(default_factory=set)
+
+
+def _lockish(expr) -> bool:
+    name = last_name(expr)
+    return bool(name) and "lock" in name.lower()
+
+
+def _resolve_lock(ctx, info, expr, class_name) -> str | None:
+    """Static identity of a lock expression, or None when dynamic."""
+    if isinstance(expr, ast.Name):
+        if expr.id in info.module_names:
+            return f"{info.modkey}.{expr.id}"
+        fn = ctx.enclosing_function(expr)
+        scope = fn.qualname if fn is not None else "<module>"
+        return f"{info.modkey}.{scope}.{expr.id}"   # function-local lock
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and class_name:
+                return f"{info.modkey}.{class_name}.{expr.attr}"
+            cls = info.singletons.get(base.id)
+            if cls is not None:
+                return f"{info.modkey}.{cls}.{expr.attr}"
+            mod = info.import_aliases.get(base.id)
+            if mod is not None:
+                return f"{mod}.{expr.attr}"
+    return None
+
+
+def collect(ctx, cfg) -> ModuleLockInfo:
+    info = ModuleLockInfo(_modkey(ctx.relpath), ctx.relpath)
+    info.import_aliases = dict(ctx.import_aliases)
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign):
+            info.module_names.update(
+                t.id for t in node.targets if isinstance(t, ast.Name))
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            info.module_names.add(node.target.id)
+    # module-level singletons + lock reentrancy
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            ctor = last_name(node.value.func)
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if ctor and ctor[0].isupper() and ctor not in (
+                        "OrderedDict", "RLock", "Lock"):
+                    info.singletons[t.id] = ctor
+                if ctor in ("Lock", "RLock"):
+                    info.reentrant[f"{info.modkey}.{t.id}"] = \
+                        ctor == "RLock"
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call) and \
+                last_name(node.value.func) in ("Lock", "RLock"):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    fn = ctx.enclosing_function(node)
+                    if fn is not None and fn.class_name:
+                        ident = f"{info.modkey}.{fn.class_name}.{t.attr}"
+                        info.reentrant[ident] = \
+                            last_name(node.value.func) == "RLock"
+
+    # acquisitions, lexical nesting, held calls
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.With):
+            continue
+        fn = ctx.enclosing_function(node)
+        class_name = fn.class_name if fn else None
+        for item in node.items:
+            expr = item.context_expr
+            if not _lockish(expr):
+                continue
+            ident = _resolve_lock(ctx, info, expr, class_name)
+            if ident is None:
+                continue
+            site = LockSite(ident, ctx.relpath, node.lineno)
+            info.acquisitions.setdefault(ident, []).append(site)
+            if fn is not None:
+                info.fn_locks.setdefault(fn.qualname, []).append(ident)
+            # lexical nesting under an outer lock
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, ast.With):
+                    for o_item in anc.items:
+                        o_expr = o_item.context_expr
+                        if not _lockish(o_expr):
+                            continue
+                        o_fn = ctx.enclosing_function(anc)
+                        o_ident = _resolve_lock(
+                            ctx, info, o_expr,
+                            o_fn.class_name if o_fn else None)
+                        if o_ident is not None:
+                            o_site = LockSite(o_ident, ctx.relpath,
+                                              anc.lineno)
+                            info.edges.setdefault(
+                                (o_ident, ident), (o_site, site))
+            # calls made inside this with body
+            for n in ast.walk(node):
+                if isinstance(n, ast.Call):
+                    callee = _callee_repr(ctx, info, n, class_name)
+                    if callee is not None:
+                        info.held_calls.append(
+                            (ident, callee,
+                             LockSite(ident, ctx.relpath, n.lineno)))
+    return info
+
+
+def _callee_repr(ctx, info, call, class_name) -> "tuple | None":
+    """→ ('local', name) | ('method', class, name) | ('module', modpath,
+    name) for resolvable callees."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return ("local", f.id)
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        base = f.value.id
+        if base == "self" and class_name:
+            return ("method", class_name, f.attr)
+        cls = info.singletons.get(base)
+        if cls is not None:
+            return ("method", cls, f.attr)
+        mod = info.import_aliases.get(base)
+        if mod is not None:
+            return ("module", mod, f.attr)
+    return None
+
+
+def lock_graph(infos: list, cfg=None) -> dict:
+    """(outer, inner) → (LockSite outer, LockSite inner) over the whole
+    tree: every lexically-nested acquisition plus one-level
+    interprocedural resolution (module-local functions, same-class /
+    singleton methods, imported-module functions called while holding a
+    lock). finalize() reports on this graph; the runtime watchdog
+    (elasticsearch_tpu.analysis.watchdog) asserts it."""
+    local_fns: dict = {}      # (modkey, name) → [lock identities]
+    method_fns: dict = {}     # (class, name) → [[lock identities]]
+    for info in infos:
+        for qual, locks in info.fn_locks.items():
+            parts = qual.split(".")
+            name = parts[-1]
+            local_fns.setdefault((info.modkey, name), []).extend(locks)
+            if len(parts) >= 2:
+                method_fns.setdefault((parts[-2], name), []).append(locks)
+    modkey_of = {info.modkey.rsplit(".", 1)[-1]: info.modkey
+                 for info in infos}
+
+    edges: dict = {}
+    for info in infos:
+        edges.update(info.edges)
+        for held, callee, site in info.held_calls:
+            targets = []
+            if callee[0] == "local":
+                targets = local_fns.get((info.modkey, callee[1]), [])
+            elif callee[0] == "method":
+                for locks in method_fns.get((callee[1], callee[2]), ()):
+                    targets.extend(locks)
+            elif callee[0] == "module":
+                mod = callee[1]
+                key = modkey_of.get(mod.rsplit(".", 1)[-1])
+                if key is not None:
+                    targets = local_fns.get((key, callee[2]), [])
+            for inner in targets:
+                edges.setdefault((held, inner),
+                                 (site, LockSite(inner, site.relpath,
+                                                 site.line)))
+    return edges
+
+
+def finalize(infos: list, cfg) -> list:
+    """Cross-module pass: resolve held calls into edges, then report
+    inconsistent lock-order pairs (and non-reentrant self cycles)."""
+    edges = lock_graph(infos, cfg)
+
+    reentrant: dict = {}
+    for info in infos:
+        reentrant.update(info.reentrant)
+
+    findings, nodes = [], []
+    reported = set()
+    for (a, b), (site_a, site_b) in sorted(
+            edges.items(), key=lambda kv: (kv[1][0].relpath,
+                                           kv[1][0].line)):
+        if a == b:
+            if not reentrant.get(a, True):
+                key = (a, a)
+                if key not in reported:
+                    reported.add(key)
+                    findings.append(Finding(
+                        "lock-order", site_a.relpath, site_a.line,
+                        f"non-reentrant lock {a} re-acquired while "
+                        f"held (self-deadlock)"))
+            continue
+        if (b, a) in edges and (b, a) not in reported:
+            reported.add((a, b))
+            other = edges[(b, a)][0]
+            findings.append(Finding(
+                "lock-order", site_a.relpath, site_a.line,
+                f"inconsistent lock order: {a} → {b} here, but "
+                f"{b} → {a} at {other.relpath}:{other.line} — "
+                f"potential deadlock"))
+    return findings
+
+
+def lock_ranks(edges: dict) -> dict:
+    """Deterministic topological ranks over the acquisition DAG (cycle
+    back-edges — already reported by lock-order — are dropped)."""
+    nodes = sorted({n for e in edges for n in e})
+    out_edges: dict = {n: set() for n in nodes}
+    for (a, b) in edges:
+        if a != b and (b, a) not in edges:
+            out_edges[a].add(b)
+    ranks: dict = {}
+
+    def depth(n, seen):
+        if n in ranks:
+            return ranks[n]
+        if n in seen:
+            return 0
+        seen.add(n)
+        d = 0
+        for m in sorted(out_edges[n]):
+            d = max(d, depth(m, seen) + 1)
+        ranks[n] = d
+        return d
+    for n in nodes:
+        depth(n, set())
+    # outer locks (acquired first) get LOWER rank numbers
+    mx = max(ranks.values(), default=0)
+    return {n: mx - d for n, d in ranks.items()}
+
+
+# ---------------------------------------------------------------------------
+# lock-unguarded-state (per module)
+# ---------------------------------------------------------------------------
+
+def check_state(ctx, cfg) -> list:
+    info = collect(ctx, cfg)
+    candidates = _state_candidates(ctx)
+    if not candidates:
+        return []
+    mutations: dict = {}    # state ident → [(lock|None, node, fn)]
+    for node in ast.walk(ctx.tree):
+        target = _mutation_target(ctx, node, cfg)
+        if target is None:
+            continue
+        ident = _state_ident(ctx, info, target)
+        if ident is None or ident not in candidates:
+            continue
+        fn = ctx.enclosing_function(node)
+        lock = _held_lock(ctx, info, node)
+        mutations.setdefault(ident, []).append((lock, node, fn))
+
+    call_sites = _call_sites(ctx, info)
+    findings, nodes = [], []
+    for ident, muts in sorted(mutations.items()):
+        owners = sorted({lock for lock, _, _ in muts if lock is not None})
+        if not owners:
+            continue                    # never locked anywhere: not owned
+        owner = owners[0] if len(owners) == 1 else None
+        for lock, node, fn in muts:
+            if lock is not None:
+                continue
+            if fn is None:
+                continue                # module-scope init
+            if fn.name == "__init__" or \
+                    fn.name.endswith(cfg.locked_suffix):
+                continue
+            if owner is not None and \
+                    _lock_dominated(fn, owner, call_sites, set()):
+                continue                # every caller holds the lock
+            findings.append(Finding(
+                "lock-unguarded-state", ctx.relpath, node.lineno,
+                f"{ident.rsplit('.', 1)[-1]} is mutated under "
+                f"{owner or ' / '.join(owners)} elsewhere but written "
+                f"here in {fn.qualname}() without holding it"))
+            nodes.append(node)
+    return apply_suppressions(ctx, findings, nodes)
+
+
+def _call_sites(ctx, info) -> dict:
+    """fn qualname → [(caller FunctionInfo, held lock ident | None)] for
+    every module-resolvable call."""
+    by_key = {}
+    for fn in ctx.functions:
+        by_key.setdefault((fn.class_name, fn.name), []).append(fn)
+        by_key.setdefault((None, fn.name), []).append(fn)
+    sites: dict = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        caller = ctx.enclosing_function(node)
+        if caller is None:
+            continue
+        f = node.func
+        targets = []
+        if isinstance(f, ast.Name):
+            targets = [t for t in by_key.get((None, f.id), ())
+                       if t.class_name is None]
+        elif isinstance(f, ast.Attribute) and isinstance(f.value,
+                                                         ast.Name):
+            if f.value.id == "self" and caller.class_name:
+                targets = by_key.get((caller.class_name, f.attr), [])
+            else:
+                cls = info.singletons.get(f.value.id)
+                if cls is not None:
+                    targets = by_key.get((cls, f.attr), [])
+        held = _held_lock(ctx, info, node)
+        for t in targets:
+            sites.setdefault(t.qualname, []).append((caller, held))
+    return sites
+
+
+def _lock_dominated(fn, owner: str, call_sites: dict, visiting: set
+                    ) -> bool:
+    """Every module-local call site of `fn` holds `owner` — directly, by
+    being construction (`__init__` of the same class), or transitively
+    through another dominated caller."""
+    if fn.qualname in visiting:
+        return True                     # cycle: optimistic, callers decide
+    entries = call_sites.get(fn.qualname)
+    if not entries:
+        return False
+    visiting = visiting | {fn.qualname}
+    for caller, held in entries:
+        if held == owner:
+            continue
+        if caller.name == "__init__" and \
+                caller.class_name == fn.class_name:
+            continue
+        if _lock_dominated(caller, owner, call_sites, visiting):
+            continue
+        return False
+    return True
+
+
+def _state_candidates(ctx) -> set:
+    out = set()
+    modkey = _modkey(ctx.relpath)
+    for node in ctx.tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if _mutable_value(node.value):
+                out.update(f"{modkey}.{t.id}" for t in targets
+                           if isinstance(t, ast.Name))
+    for fn in ctx.functions:
+        if fn.name != "__init__" or fn.class_name is None:
+            continue
+        for node in ast.walk(fn.node):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                if _mutable_value(node.value):
+                    for t in targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            out.add(f"{modkey}.{fn.class_name}.{t.attr}")
+    return out
+
+
+def _mutable_value(value) -> bool:
+    if value is None:
+        return False
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call) and \
+            last_name(value.func) in _MUTABLE_CTORS:
+        return True
+    return False
+
+
+def _mutation_target(ctx, node, cfg):
+    """→ the expression naming the mutated container, or None."""
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                return t.value
+    elif isinstance(node, ast.AugAssign):
+        t = node.target
+        return t.value if isinstance(t, ast.Subscript) else t
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                return t.value
+    elif isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in cfg.mutators:
+        return node.func.value
+    return None
+
+
+def _state_ident(ctx, info, target) -> str | None:
+    if isinstance(target, ast.Name):
+        return f"{info.modkey}.{target.id}"
+    if isinstance(target, ast.Attribute) and \
+            isinstance(target.value, ast.Name):
+        if target.value.id == "self":
+            fn = ctx.enclosing_function(target)
+            if fn is not None and fn.class_name:
+                return f"{info.modkey}.{fn.class_name}.{target.attr}"
+        cls = info.singletons.get(target.value.id)
+        if cls is not None:
+            return f"{info.modkey}.{cls}.{target.attr}"
+    return None
+
+
+def _held_lock(ctx, info, node) -> str | None:
+    fn = ctx.enclosing_function(node)
+    class_name = fn.class_name if fn else None
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                if _lockish(item.context_expr):
+                    ident = _resolve_lock(ctx, info, item.context_expr,
+                                          class_name)
+                    if ident is not None:
+                        return ident
+    return None

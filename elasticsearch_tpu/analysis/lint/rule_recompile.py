@@ -1,0 +1,254 @@
+"""recompile-hazard: request-path code must not construct programs.
+
+``recompile-request-path``: a ``jax.jit`` / ``seam_jit`` / ``jax.vmap``
+call inside a function body re-traces per invocation unless it is:
+
+* inside a TRACED context — the enclosing function is (transitively)
+  staged by ``jax.jit`` / ``jax.vmap`` / ``shard_map`` (decorated,
+  passed by name, or called from a traced function: trace-time code
+  runs once per compile, not per request);
+* a closure handed to the ``_get_compiled`` trampoline, or in a
+  function that consults the PROGRAM-layer cache (``_get_compiled`` /
+  ``_program_cache`` / ``note_mesh_program`` references);
+* a BUILDER — a function that directly returns the constructed program
+  — whose call sites are memoized (``cache[k] = build(...)`` under a
+  ``k not in cache`` guard) or module-level.
+
+``recompile-unbucketed-key``: a program-cache key tuple (flowing into
+``_get_compiled`` or a ``*_cache`` subscript) carrying a raw ``len(...)``
+component — batch sizes must pass through ``pow2_bucket`` (or another
+``bucket_fns`` entry) so varying request counts share programs.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticsearch_tpu.analysis.lint.context import (
+    Finding, apply_suppressions, dotted, last_name)
+
+_STAGERS = ("jit", "vmap", "shard_map", "shard_map_compat", "pmap",
+            "seam_jit")
+
+
+def _is_stage_call(node: ast.Call) -> bool:
+    return last_name(node.func) in _STAGERS
+
+
+def _traced_functions(ctx) -> set:
+    """qualnames of functions that run at TRACE time: passed by name to
+    a stager, decorated by one, or (fixpoint) called from a traced
+    function in this module."""
+    by_name: dict = {}
+    for fn in ctx.functions:
+        by_name.setdefault(fn.name, []).append(fn)
+    traced: set = set()
+    # seed: decorator or passed-by-name-to-stager
+    for fn in ctx.functions:
+        for dec in fn.node.decorator_list:
+            d = ast.dump(dec)           # covers @jax.jit and
+            if any(f"'{s}'" in d for s in _STAGERS):   # @partial(jax.jit, ...)
+                traced.add(fn.qualname)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_stage_call(node):
+            for arg in node.args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        for fn in by_name.get(sub.id, ()):
+                            traced.add(fn.qualname)
+    # nested defs inside traced functions execute at trace time too
+    def _close_nested():
+        added = False
+        for fn in ctx.functions:
+            if fn.qualname in traced:
+                continue
+            if fn.parent is not None and fn.parent.qualname in traced:
+                traced.add(fn.qualname)
+                added = True
+        return added
+    # fixpoint: callees of traced functions are traced
+    changed = True
+    while changed:
+        changed = _close_nested()
+        for fn in ctx.functions:
+            if fn.qualname not in traced:
+                continue
+            for n in ast.walk(fn.node):
+                if isinstance(n, ast.Call):
+                    callee = last_name(n.func)
+                    for cand in by_name.get(callee, ()):
+                        if cand.qualname not in traced:
+                            traced.add(cand.qualname)
+                            changed = True
+    return traced
+
+
+def _consults_cache(ctx, cfg, fn) -> bool:
+    info = fn
+    while info is not None:
+        for n in ast.walk(info.node):
+            if isinstance(n, (ast.Name, ast.Attribute)) and \
+                    last_name(n) in cfg.cache_markers:
+                return True
+        info = info.parent
+    return False
+
+
+def _in_trampoline(ctx, cfg, fn) -> bool:
+    info = fn
+    while info is not None:
+        outer = info.parent
+        scope = outer.node if outer is not None else ctx.tree
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Call) and \
+                    last_name(n.func) in cfg.trampolines:
+                if any(isinstance(a, ast.Name) and a.id == info.name
+                       for a in n.args):
+                    return True
+        info = outer
+    return False
+
+
+def _builders(ctx, cfg) -> set:
+    """Functions whose return value IS a constructed program (directly
+    `return jax.jit(...)` / `return seam_jit(...)`), closed over
+    functions returning a builder's result."""
+    names: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for fn in ctx.functions:
+            if fn.name in names:
+                continue
+            for n in ast.walk(fn.node):
+                if isinstance(n, ast.Return) and \
+                        isinstance(n.value, ast.Call):
+                    callee = last_name(n.value.func)
+                    if dotted(n.value.func) in cfg.jit_constructors or \
+                            callee in {c.rsplit(".", 1)[-1]
+                                       for c in cfg.jit_constructors} or \
+                            callee in names:
+                        names.add(fn.name)
+                        changed = True
+    return names
+
+
+def _is_memo_site(ctx, call: ast.Call) -> bool:
+    """`cache[k] = build(...)` guarded by a `k not in cache` test (the
+    memo idiom), or any subscript-store into a *cache*-named container."""
+    stmt = ctx.enclosing_stmt(call)
+    if isinstance(stmt, ast.Assign) and any(
+            isinstance(t, ast.Subscript) for t in stmt.targets):
+        for anc in ctx.ancestors(stmt):
+            if isinstance(anc, ast.If):
+                t = anc.test
+                if isinstance(t, ast.Compare) and any(
+                        isinstance(op, (ast.NotIn, ast.Is, ast.Eq))
+                        for op in t.ops):
+                    return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+    return False
+
+
+def check(ctx, cfg) -> list:
+    findings, nodes = [], []
+    traced = _traced_functions(ctx)
+    builders = _builders(ctx, cfg)
+    ctor_lasts = {c.rsplit(".", 1)[-1] for c in cfg.jit_constructors}
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee_last = last_name(node.func)
+        is_ctor = (dotted(node.func) in cfg.jit_constructors or
+                   callee_last in ctor_lasts)
+        is_vmap = callee_last in ("vmap",)
+        is_builder_call = callee_last in builders
+        if not (is_ctor or is_vmap or is_builder_call):
+            continue
+        fn = ctx.enclosing_function(node)
+        if fn is None:
+            continue                    # module-level kernel definition
+        if fn.qualname in traced or \
+                any(i.qualname in traced for i in ctx.enclosing_chain(node)):
+            continue
+        if _in_trampoline(ctx, cfg, fn) or _consults_cache(ctx, cfg, fn):
+            continue
+        if fn.name in builders:
+            continue                    # construction is the builder's job
+        if _is_memo_site(ctx, node):
+            continue                    # memoized construction
+        what = "jax.vmap" if is_vmap else (dotted(node.func) or callee_last)
+        findings.append(Finding(
+            "recompile-request-path", ctx.relpath, node.lineno,
+            f"{what} constructed inside {fn.qualname}() re-traces per "
+            f"call — route through the PROGRAM-layer cache "
+            f"(_get_compiled / _program_cache) or memoize the builder"))
+        nodes.append(node)
+
+    # --- unbucketed key components ---------------------------------------
+    for fn in ctx.functions:
+        bucketed = _bucketed_names(fn.node, cfg)
+        for call in ast.walk(fn.node):
+            if not isinstance(call, ast.Call) or \
+                    last_name(call.func) not in cfg.trampolines:
+                continue
+            if not call.args:
+                continue
+            key = _resolve_key_expr(fn.node, call.args[0])
+            for el in _tuple_elements(key):
+                bad = _raw_len(el, bucketed)
+                if bad is not None:
+                    findings.append(Finding(
+                        "recompile-unbucketed-key", ctx.relpath,
+                        bad.lineno,
+                        f"program-cache key in {fn.qualname}() carries "
+                        f"a raw len(...) component — bucket it with "
+                        f"{'/'.join(cfg.bucket_fns)} so varying batch "
+                        f"sizes share compiled programs"))
+                    nodes.append(bad)
+    return apply_suppressions(ctx, findings, nodes)
+
+
+def _bucketed_names(fn_node, cfg) -> set:
+    out = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                and last_name(n.value.func) in cfg.bucket_fns:
+            out.update(t.id for t in n.targets
+                       if isinstance(t, ast.Name))
+    return out
+
+
+def _resolve_key_expr(fn_node, expr):
+    """Follow one level of `key = (...)` indirection."""
+    if isinstance(expr, ast.Name):
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == expr.id
+                    for t in n.targets):
+                return n.value
+    return expr
+
+
+def _tuple_elements(expr):
+    if isinstance(expr, ast.Tuple):
+        return list(expr.elts)
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        return _tuple_elements(expr.left) + _tuple_elements(expr.right)
+    return []
+
+
+def _raw_len(el, bucketed: set):
+    """A len(...) call (or int(len(...))) not routed through a bucket
+    fn, or a name bound from one."""
+    if isinstance(el, ast.Call) and last_name(el.func) == "int" and \
+            el.args:
+        el = el.args[0]
+    if isinstance(el, ast.Call) and last_name(el.func) == "len":
+        return el
+    if isinstance(el, ast.Name) and el.id.startswith("len_") and \
+            el.id not in bucketed:
+        return el
+    return None

@@ -1,0 +1,251 @@
+"""Runtime lock-order watchdog: the dynamic half of plane-lint's
+lock-discipline rule.
+
+The static rule computes the lock-acquisition graph from ``with <lock>``
+statements across the threaded modules (see
+:func:`elasticsearch_tpu.analysis.lint.lock_graph_for`). This module
+checks the SAME order at runtime: with ``ESTPU_LOCK_WATCHDOG=1``, every
+lock the ``elasticsearch_tpu`` package constructs is wrapped, each
+thread's acquisition stack is tracked, and acquiring lock B while
+holding lock A is a violation when the static graph orders B before A
+(edge B→A with no A→B counterpart). The chaos-matrix tier-1 smoke cases
+run under :func:`watching`, so an ordering the analyzer believes in but
+the cluster does not actually follow — or vice versa — fails the case
+instead of deadlocking a production node.
+
+Violations are RECORDED, not raised at the acquisition site (a raise
+inside a background replication thread would be swallowed or wedge the
+cluster mid-teardown); :func:`watching` re-raises them as
+:class:`LockOrderError` when the scenario finishes. Pass ``strict=True``
+to raise at the acquisition site instead (useful under a debugger).
+
+Lock identities resolve lazily at first acquisition, to the same dotted
+names the static graph uses: ``self._lock`` inside class C of module m →
+``m.C._lock``; a module-global ``_cache_lock`` → ``m._cache_lock``.
+Locks the resolver cannot name (locals, comprehension temporaries) are
+tracked for stack bookkeeping but never flagged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+
+ENV_FLAG = "ESTPU_LOCK_WATCHDOG"
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+#: the static graph is computed once per process (parsing ~130 files);
+#: (edges, ranks) after canonicalization
+_graph_cache = None
+
+
+class LockOrderError(AssertionError):
+    """Runtime lock acquisition contradicted the static lock graph."""
+
+
+def _canon(ident: str) -> str:
+    """Normalize a dotted identity so the static graph (relpath-derived)
+    and the runtime resolver (__module__-derived) agree regardless of
+    the working directory the analyzer ran from."""
+    idx = ident.find("elasticsearch_tpu")
+    return ident[idx:] if idx > 0 else ident
+
+
+def static_lock_graph():
+    """(edges, ranks) of the package's canonicalized static lock graph,
+    computed once per process."""
+    global _graph_cache
+    if _graph_cache is None:
+        from elasticsearch_tpu.analysis.lint import lock_graph_for
+        pkg_dir = os.path.dirname(os.path.dirname(__file__))
+        raw_edges, raw_ranks = lock_graph_for([pkg_dir])
+        edges = {(_canon(a), _canon(b)) for a, b in raw_edges}
+        ranks = {_canon(n): r for n, r in raw_ranks.items()}
+        _graph_cache = (edges, ranks)
+    return _graph_cache
+
+
+class _WatchedLock:
+    """A threading lock that reports its acquisitions to the watchdog.
+    Resolution of the dotted identity happens at acquisition time — the
+    creating frame knows the module, but only the acquiring frame can
+    say which attribute / global the lock was bound to."""
+
+    __slots__ = ("_real", "_wd", "_ident")
+
+    def __init__(self, real, wd):
+        self._real = real
+        self._wd = wd
+        self._ident = None
+
+    # -- identity ----------------------------------------------------------
+
+    def _resolve(self, frame) -> str | None:
+        if self._ident is not None:
+            return self._ident
+        if frame is None:
+            return None
+        self_obj = frame.f_locals.get("self")
+        if self_obj is not None:
+            try:
+                attrs = vars(self_obj)
+            except TypeError:
+                attrs = {}
+            for attr, value in attrs.items():
+                if value is self:
+                    cls = type(self_obj)
+                    self._ident = _canon(
+                        f"{cls.__module__}.{cls.__name__}.{attr}")
+                    return self._ident
+        g = frame.f_globals
+        for name, value in g.items():
+            if value is self:
+                self._ident = _canon(f"{g.get('__name__', '?')}.{name}")
+                return self._ident
+        return None
+
+    # -- lock protocol -----------------------------------------------------
+
+    def acquire(self, blocking=True, timeout=-1, _frame=None):
+        frame = _frame if _frame is not None else sys._getframe(1)
+        ident = self._resolve(frame)
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self._wd._note_acquire(self, ident, frame)
+        return got
+
+    def release(self):
+        self._wd._note_release(self)
+        self._real.release()
+
+    def __enter__(self):
+        self.acquire(_frame=sys._getframe(1))
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._real.locked() if hasattr(self._real, "locked") \
+            else None
+
+    def __repr__(self):
+        return f"<WatchedLock {self._ident or '?'} of {self._real!r}>"
+
+
+class Watchdog:
+    def __init__(self, edges, ranks=None, strict=False):
+        self.edges = set(edges)
+        self.ranks = dict(ranks or {})
+        self.strict = strict
+        self.violations: list[str] = []
+        self._tls = threading.local()
+        self._mu = _ORIG_LOCK()
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _note_acquire(self, lock, ident, frame) -> None:
+        stack = self._stack()
+        if ident is not None:
+            for held_lock, held_ident in stack:
+                if held_ident is None or held_ident == ident or \
+                        held_lock is lock:
+                    continue
+                if (ident, held_ident) in self.edges and \
+                        (held_ident, ident) not in self.edges:
+                    where = f"{frame.f_code.co_filename}:" \
+                            f"{frame.f_lineno}" if frame else "?"
+                    msg = (f"acquired {ident} while holding {held_ident} "
+                           f"at {where}, but the static lock graph "
+                           f"orders {ident} BEFORE {held_ident} — "
+                           f"potential deadlock against the analyzed "
+                           f"order")
+                    with self._mu:
+                        self.violations.append(msg)
+                    if self.strict:
+                        raise LockOrderError(msg)
+        stack.append((lock, ident))
+
+    def _note_release(self, lock) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is lock:
+                del stack[i]
+                return
+
+    def check(self) -> None:
+        """Raise LockOrderError if any violation was recorded."""
+        if self.violations:
+            raise LockOrderError(
+                f"{len(self.violations)} lock-order violation(s):\n" +
+                "\n".join(self.violations))
+
+
+_active: Watchdog | None = None
+
+
+def enable(edges=None, ranks=None, strict=False) -> Watchdog:
+    """Patch ``threading.Lock`` / ``threading.RLock`` so locks created
+    by ``elasticsearch_tpu`` modules from here on are order-checked
+    against `edges` (default: the static graph). Idempotent — a second
+    enable returns the active watchdog."""
+    global _active
+    if _active is not None:
+        return _active
+    if edges is None:
+        edges, ranks = static_lock_graph()
+    wd = Watchdog(edges, ranks, strict=strict)
+
+    def _factory(real_ctor):
+        def make():
+            real = real_ctor()
+            mod = sys._getframe(1).f_globals.get("__name__", "")
+            if not mod.startswith("elasticsearch_tpu"):
+                return real
+            return _WatchedLock(real, wd)
+        return make
+
+    threading.Lock = _factory(_ORIG_LOCK)
+    threading.RLock = _factory(_ORIG_RLOCK)
+    _active = wd
+    return wd
+
+
+def disable() -> Watchdog | None:
+    """Restore the real lock factories → the watchdog that was active
+    (its recorded violations survive), or None."""
+    global _active
+    threading.Lock = _ORIG_LOCK
+    threading.RLock = _ORIG_RLOCK
+    wd, _active = _active, None
+    return wd
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get(ENV_FLAG, "") not in ("", "0", "false")
+
+
+@contextlib.contextmanager
+def watching(strict=False, force=False):
+    """Run a block under the watchdog when ``ESTPU_LOCK_WATCHDOG=1``
+    (or ``force=True``); on exit, restore the factories and re-raise any
+    recorded violation as :class:`LockOrderError`. A no-op yielding None
+    when the flag is off — the chaos matrix wraps every case in this."""
+    if not (force or enabled_by_env()):
+        yield None
+        return
+    wd = enable(strict=strict)
+    try:
+        yield wd
+    finally:
+        disable()
+    wd.check()

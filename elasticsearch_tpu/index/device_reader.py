@@ -175,8 +175,13 @@ class DeviceReader:
         self.segments: list[DeviceSegment] = []
         self._text_stats: dict[str, TextFieldStats] = {}
         doc_base = 0
-        put = (lambda x: jax.device_put(x, device)) if device is not None \
-            else jax.device_put
+        # uploads ride the device-fault seam (lazy import: jit_exec
+        # imports this module at load time). Site class reader-upload:
+        # this is the RPC fan-out's serving floor — injectable only by
+        # explicit p_by_site opt-in, never by the default chaos draw
+        from elasticsearch_tpu.search.jit_exec import seam_device_put
+        put = lambda x: seam_device_put(            # noqa: E731
+            x, device, site="reader-upload")
         self.device = device
         used = 0
         streaming = False

@@ -100,8 +100,8 @@ class BM25Retriever:
         self.index = index
         self.analyzer = analyzer or BUILTIN_ANALYZERS["english"]
         self.params = params
-        put = (lambda x: jax.device_put(x, device)) if device is not None \
-            else jax.device_put
+        from elasticsearch_tpu.search.jit_exec import seam_device_put
+        put = lambda x: seam_device_put(x, device)    # noqa: E731
         self.d_uterms = put(index.uterms)
         self.d_utf = put(index.utf)
         self.d_doc_len = put(index.doc_len)

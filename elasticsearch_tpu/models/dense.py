@@ -40,8 +40,8 @@ class DenseRetriever:
         normed = (vectors / np.maximum(norms, 1e-12)).astype(np.float32)
         live = np.zeros(vectors.shape[0], bool)
         live[:n] = True
-        put = (lambda x: jax.device_put(x, device)) if device is not None \
-            else jax.device_put
+        from elasticsearch_tpu.search.jit_exec import seam_device_put
+        put = lambda x: seam_device_put(x, device)    # noqa: E731
         self.d_vecs = put(normed)
         self.d_live = put(live)
         self.use_bf16 = use_bf16

@@ -100,7 +100,10 @@ def distributed_bm25_step(mesh: Mesh, k: int, k1: float = 1.2, b: float = 0.75):
         in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
                   P("shard", "dp"), P("shard", "dp"), P("shard"), P("shard")),
         out_specs=(P("dp"), P("dp"), P("dp")))
-    return jax.jit(mapped)
+    # through the device-fault seam; DistributedBM25.step_for memoizes
+    # per (mesh, k) so this builder never runs on the request path
+    from elasticsearch_tpu.search.jit_exec import seam_jit
+    return seam_jit(mapped)
 
 
 class DistributedBM25:
@@ -136,18 +139,19 @@ class DistributedBM25:
         live = np.concatenate([pad(sh.live, np_docs, fill=False)
                                for sh in self.shards])
         self.np_docs = np_docs
+        from elasticsearch_tpu.search.jit_exec import seam_device_put
         shard_sharding = NamedSharding(mesh, P("shard"))
-        self.d_uterms = jax.device_put(uterms, shard_sharding)
-        self.d_utf = jax.device_put(utf, shard_sharding)
-        self.d_doc_len = jax.device_put(doc_len, shard_sharding)
-        self.d_live = jax.device_put(live, shard_sharding)
-        self.d_num_docs = jax.device_put(
+        self.d_uterms = seam_device_put(uterms, shard_sharding)
+        self.d_utf = seam_device_put(utf, shard_sharding)
+        self.d_doc_len = seam_device_put(doc_len, shard_sharding)
+        self.d_live = seam_device_put(live, shard_sharding)
+        self.d_num_docs = seam_device_put(
             np.asarray([sh.num_docs for sh in self.shards], np.int32),
             shard_sharding)
         # float32, not int32: shards beyond ~2.1B tokens would wrap an int32
         # psum and silently invert BM25 length normalization; float32's
         # ~1e-7 relative rounding is harmless in avgdl
-        self.d_total_tokens = jax.device_put(
+        self.d_total_tokens = seam_device_put(
             np.asarray([sh.total_tokens for sh in self.shards], np.float32),
             shard_sharding)
         self._steps: dict[int, callable] = {}
@@ -187,11 +191,15 @@ class DistributedBM25:
             qdf = np.concatenate(
                 [qdf, np.zeros((qdf.shape[0], padded_q - nq, qdf.shape[2]),
                                qdf.dtype)], axis=1)
+        from elasticsearch_tpu.search.jit_exec import (
+            device_fault_point, seam_device_put)
         q_sharding = NamedSharding(self.mesh, P("shard", "dp"))
-        scores, docs, totals = self.step_for(k)(
+        step = self.step_for(k)
+        device_fault_point("dispatch")
+        scores, docs, totals = step(
             self.d_uterms, self.d_utf, self.d_doc_len, self.d_live,
-            jax.device_put(qtids, q_sharding),
-            jax.device_put(qdf, q_sharding),
+            seam_device_put(qtids, q_sharding),
+            seam_device_put(qdf, q_sharding),
             self.d_num_docs, self.d_total_tokens)
         return (np.asarray(scores)[:nq], np.asarray(docs)[:nq],
                 np.asarray(totals)[:nq])

@@ -202,6 +202,7 @@ class _DeviceBlockCache:
         re-uploads ONLY the mask (the delete path's zero-column-byte
         refresh). Byte counts are actual host→device transfer; `reused`
         is the resident column bytes a rebuild did not re-ship."""
+        from elasticsearch_tpu.search import jit_exec
         uid = seg.block_uid if seg is not None else _EMPTY_UID
         key = (engine_uuid, uid, lay_sig)
         live_np = _pad1(live, lay.np_docs, False) if live is not None \
@@ -217,7 +218,11 @@ class _DeviceBlockCache:
                     # updated under the lock so a racing pack build
                     # captures a consistent (template, arrays) pair
                     # (newest mask wins — equivalent to a refresh landing
-                    # mid-build, which the plane already tolerates)
+                    # mid-build, which the plane already tolerates).
+                    # This is a real host→device transfer: it draws from
+                    # the fault seam like every other upload (a raise
+                    # here leaves the block consistent on the old mask)
+                    jit_exec.device_fault_point("upload")
                     blk.arrays = [jax.device_put(live_np)] + \
                         blk.arrays[1:]
                     blk.template = dc_replace(blk.template, live=live_np)
@@ -230,7 +235,6 @@ class _DeviceBlockCache:
                         blk.col_bytes)
         template = _build_template(lay, seg, live, doc_base)
         flat_np = seg_flatten(template)
-        from elasticsearch_tpu.search import jit_exec
         jit_exec.device_fault_point("upload")
         arrays = [jax.device_put(a) for a in flat_np]
         mask_bytes = int(flat_np[0].nbytes)
@@ -975,6 +979,8 @@ class MeshEngineSearcher:
             arr = np.stack(
                 [self._kw_sort_ranks(sp.field, sp.fill)[0]
                  for sp in kw_specs], axis=1)
+        from elasticsearch_tpu.search import jit_exec
+        jit_exec.device_fault_point("upload")
         dev = jax.device_put(arr, NamedSharding(self.mesh, P("shard")))
         self._kw_operand_cache[ckey] = dev
         return dev
@@ -1520,6 +1526,10 @@ class MeshEngineSearcher:
         # rejected like run_segment_batch's None)
         sigs, layouts, emits, pfs, refss = [], [], [], [], []
         consts_dev = []
+        from elasticsearch_tpu.search import jit_exec
+        # the per-slot stacked query constants below are host→device
+        # transfers: one seam draw covers the batch's upload phase
+        jit_exec.device_fault_point("upload")
         q_sharding = NamedSharding(self.mesh, P("shard", "dp"))
         for j in range(self.n_slots):
             sig_j = emit_j = pf_j = refs_j = None
@@ -1595,6 +1605,7 @@ class MeshEngineSearcher:
                         chi, clo = -chi, -clo
                     cur_np[:, bi, 2 * i] = float(chi)
                     cur_np[:, bi, 2 * i + 1] = float(clo)
+        jit_exec.device_fault_point("upload")
         cursors = jax.device_put(cur_np, q_sharding)
         kwsorts = self._kw_rank_operand(sort_specs)
 

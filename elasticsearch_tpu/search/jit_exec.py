@@ -91,6 +91,33 @@ def device_fault_point(site: str) -> None:
         hook(site)
 
 
+def seam_device_put(a, device=None, site: str = "upload"):
+    """Host→device transfer through the fault seam: modules outside the
+    seam allowlist (device readers, standalone models, the distributed
+    data plane) route uploads here instead of calling ``jax.device_put``
+    raw, so chaos injection reaches every transfer and the plane breaker
+    observes real upload failures (plane-lint rule device-raw-call).
+
+    ``site`` must be a literal site class at the call site (plane-lint
+    checks it): ``upload`` for plane/block transfers, ``reader-upload``
+    for the RPC fan-out's baseline reader — the serving FLOOR, which the
+    default chaos draw leaves alone (see testing_disruption.
+    DEVICE_FAULT_SITES) so degraded-mode serving always has a working
+    fallback; targeted tests opt in via ``p_by_site``."""
+    device_fault_point(site)
+    return jax.device_put(a) if device is None \
+        else jax.device_put(a, device)
+
+
+def seam_jit(fn, **kwargs):
+    """Program construction through the fault seam. Callers OWN the
+    caching — memoize the result per static shape (plane-lint rule
+    recompile-request-path checks call sites); the seam only makes the
+    compile injectable and breaker-visible."""
+    device_fault_point("compile")
+    return jax.jit(fn, **kwargs)
+
+
 def is_device_oom(exc: BaseException) -> bool:
     """Does this exception look like device memory exhaustion? Covers
     the injected :class:`DeviceOomError` and the strings real XLA
@@ -890,7 +917,8 @@ def run_segments_streamed(segments: list, ctx: ExecutionContext,
                 # buffers are free; only then does a permit return so
                 # the feeder may stage segment i+1 (keeps exactly two
                 # segments materialized: i computing, i+1 staging)
-                jax.block_until_ready(outs_all[i - 1]["count"])
+                jax.block_until_ready(  # estpu: allow[host-sync-hot-loop] two-segment residency backpressure — the sync IS the contract (feeder may stage i+1 only after i−1 drains)
+                    outs_all[i - 1]["count"])
                 slots.release()
     finally:
         stop.set()                          # unblocks a waiting feeder on

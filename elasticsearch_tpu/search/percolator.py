@@ -483,8 +483,13 @@ class PercolatorRegistry:
                     for qi, qid in enumerate(qids):
                         if out[qi, 0] > 0.5:
                             state["matched"][qid] = float(out[qi, 1])
-                self.stats["fused_queries"] += sum(
-                    len(qids) for _, qids in lane_owner)
+                # under the registry lock like every other stats bump —
+                # += on a shared dict value is read-modify-write, and
+                # concurrent percolates race it (flagged by plane-lint
+                # lock-unguarded-state)
+                with self._lock:
+                    self.stats["fused_queries"] += sum(
+                        len(qids) for _, qids in lane_owner)
                 jit_exec.plane_breaker.record_success()
             except QueryParsingError:
                 raise

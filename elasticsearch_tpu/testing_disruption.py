@@ -388,12 +388,17 @@ class DiskFaultScheme:
 
 # ---- device-fault scheme (accelerator chaos) --------------------------------
 
-#: every device touchpoint the fault seam covers (jit_exec.
+#: the device touchpoints the DEFAULT chaos draw covers (jit_exec.
 #: device_fault_point call sites): compiled per-segment/reader dispatch,
 #: program compiles, host→device block uploads, device-side pack
-#: composes, the collective-plane mesh dispatch, fused percolate lanes
+#: composes, the collective-plane mesh dispatch, fused percolate lanes.
+#: NOT here: ``reader-upload`` (the RPC fan-out's baseline reader
+#: transfer, READER_UPLOAD_SITE) — the serving FLOOR every degraded
+#: path falls back to; drawing it by default would leave chaos cases
+#: with no working fallback, so targeted tests opt in via p_by_site
 DEVICE_FAULT_SITES = ("dispatch", "compile", "upload", "compose",
                       "plane-dispatch", "percolate")
+READER_UPLOAD_SITE = "reader-upload"
 
 
 class DeviceFaultScheme:
@@ -430,9 +435,14 @@ class DeviceFaultScheme:
         self._prev = None
         self._active = False
         #: injected raises by site; ``calls`` counts every touchpoint
-        #: reached (0 while the breaker gates device work entirely)
+        #: reached (0 while the breaker gates device work entirely);
+        #: ``calls_by_site`` splits that count so tests can assert on
+        #: dispatch-class touchpoints alone (the open-breaker contract
+        #: is ZERO DISPATCHES — floor uploads for the eager path are
+        #: expected and harmless)
         self.injected: dict[str, int] = {}
         self.calls = 0
+        self.calls_by_site: dict[str, int] = {}
 
     @property
     def total_injected(self) -> int:
@@ -444,9 +454,17 @@ class DeviceFaultScheme:
         self.p = 0.0
         self.p_by_site = {}
 
+    def dispatch_calls(self) -> int:
+        """Touchpoints of the dispatch classes (dispatch /
+        plane-dispatch / percolate) — the count the open-breaker
+        zero-device-dispatch assertions reconcile against."""
+        return sum(self.calls_by_site.get(s, 0)
+                   for s in ("dispatch", "plane-dispatch", "percolate"))
+
     def _hook(self, site: str) -> None:
         from elasticsearch_tpu.search import jit_exec
         self.calls += 1
+        self.calls_by_site[site] = self.calls_by_site.get(site, 0) + 1
         p = self.p_by_site.get(site, self.p if site in self.sites else 0.0)
         if p <= 0.0 or self._rng.random() >= p:
             return

@@ -1,0 +1,65 @@
+"""Negative fixtures: correct charge/release pairings — zero findings.
+
+Each function is one of the pairing shapes rule_breaker accepts: the
+charge-outside-try/finally-release idiom (ES charges before the try so
+a failed reservation is never double-released), escape to an owning
+cache/listener (released on eviction/close), and the pairing primitive
+itself (a class that defines release next to its charge).
+"""
+
+from elasticsearch_tpu.common.breaker import OneShotCharge
+
+
+def charge_then_finally(breaker, nbytes, work):
+    breaker.add_estimate(nbytes, "fixture")
+    try:
+        return work()
+    finally:
+        breaker.release(nbytes)
+
+
+def charge_released_on_failure_branch(breaker, nbytes, ok):
+    breaker.add_estimate(nbytes, "fixture")
+    if not ok:
+        breaker.release(nbytes)
+        return None
+    return nbytes
+
+
+def stored_charge_escapes(breaker_service, cache, key, nbytes):
+    # the owner releases on eviction — the charge escaped to it
+    charge = OneShotCharge(breaker_service, nbytes).charge(key)
+    cache[key] = charge
+
+
+def registered_with_listener(engine, breaker_service, nbytes):
+    charge = OneShotCharge(breaker_service, nbytes).charge("blk")
+    engine.close_listeners.append(charge.release)
+
+
+def returned_charge(breaker_service, nbytes):
+    return OneShotCharge(breaker_service, nbytes).charge("pack")
+
+
+class PairedAccounting:
+    """The pairing primitive: charge lives next to its release."""
+
+    def __init__(self, breaker):
+        self.breaker = breaker
+        self.nbytes = 0
+
+    def charge(self, nbytes):
+        self.breaker.add_estimate(nbytes, "paired")
+        self.nbytes = nbytes
+
+    def release(self):
+        self.breaker.release(self.nbytes)
+        self.nbytes = 0
+
+
+def conditional_release_is_single(charge, failed):
+    # one release per path — NOT the double-release shape
+    if failed:
+        charge.release()
+    else:
+        charge.release()

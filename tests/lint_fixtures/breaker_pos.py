@@ -1,0 +1,22 @@
+"""Positive fixtures: every function here must trip breaker-discipline.
+
+Parsed (never imported) by tests/test_static_analysis.py.
+"""
+
+from elasticsearch_tpu.common.breaker import OneShotCharge
+
+
+def charge_without_release(breaker, nbytes):
+    # no try/finally, no same-receiver release, nothing escapes
+    breaker.add_estimate(nbytes, "fixture")
+    return nbytes
+
+
+def one_shot_dropped(breaker_service, nbytes):
+    # the charge object is discarded: nobody can ever release it
+    OneShotCharge(breaker_service, nbytes).charge("fixture")
+
+
+def double_release(charge):
+    charge.release()
+    charge.release()

@@ -1,0 +1,6 @@
+"""Suppressed fixture: a reasoned allow silences breaker-discipline."""
+
+
+def process_lifetime_charge(breaker, nbytes):
+    breaker.add_estimate(nbytes, "fixture")  # estpu: allow[breaker-unreleased] process-lifetime reservation, released by interpreter exit
+    return nbytes

@@ -1,0 +1,18 @@
+"""Negative fixtures: non-seam code going through the seam wrappers —
+zero device-seam findings."""
+
+from elasticsearch_tpu.search.jit_exec import seam_device_put, seam_jit
+
+
+def upload_via_seam(arr, device):
+    return seam_device_put(arr, device)
+
+
+def reader_upload_via_seam(arr, device):
+    return seam_device_put(arr, device, site="reader-upload")
+
+
+def jit_via_seam(emit, cache, key):
+    if key not in cache:
+        cache[key] = seam_jit(emit)
+    return cache[key]

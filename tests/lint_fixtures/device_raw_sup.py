@@ -1,0 +1,7 @@
+"""Suppressed fixture: a reasoned allow silences device-raw-call."""
+
+import jax
+
+
+def bootstrap_upload(arr):
+    return jax.device_put(arr)  # estpu: allow[device-raw-call] import-time bootstrap runs before jit_exec exists; no request ever reaches it

@@ -1,0 +1,15 @@
+"""Scope check: the SAME syncing loop as hot_mod_pos.py, but this
+filename does not match the hot-module patterns — host-sync must not
+fire outside the hot-path modules."""
+
+import numpy as np
+
+from elasticsearch_tpu.search.jit_exec import device_fault_point
+
+
+def asarray_per_iteration(segments, program):
+    outs = []
+    for seg in segments:
+        device_fault_point("dispatch")
+        outs.append(np.asarray(program(seg)))
+    return outs

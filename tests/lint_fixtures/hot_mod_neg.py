@@ -1,0 +1,20 @@
+"""Negative fixtures: the intended dispatch shapes — zero host-sync
+findings. Syncs drain AFTER the loop so dispatches pipeline; loops
+without a dispatch marker are host-only and out of scope."""
+
+import numpy as np
+
+from elasticsearch_tpu.search.jit_exec import device_fault_point
+
+
+def drain_after_loop(segments, program):
+    outs = []
+    for seg in segments:
+        device_fault_point("dispatch")
+        outs.append(program(seg))
+    return [np.asarray(o) for o in outs]
+
+
+def host_only_loop(rows):
+    device_fault_point("upload")
+    return [float(r) for r in rows]

@@ -1,0 +1,38 @@
+"""Positive fixtures: host-device syncs inside dispatch loops (the
+fixture LintConfig maps ``*/hot_mod_*.py`` to the hot-path modules).
+
+``streamed_backpressure_regression`` is the shape plane-lint flagged at
+search/jit_exec.py:920 (run_segments_streamed) on the real tree — there
+it carries a reasoned allow because the sync IS the two-segment
+residency contract; here, unannotated, it must fire.
+"""
+
+import numpy as np
+
+from elasticsearch_tpu.search.jit_exec import device_fault_point
+
+
+def asarray_per_iteration(segments, program):
+    outs = []
+    for seg in segments:
+        device_fault_point("dispatch")
+        out = program(seg)
+        outs.append(np.asarray(out))
+    return outs
+
+
+def item_per_iteration(hits, program):
+    total = 0
+    for h in hits:
+        device_fault_point("percolate")
+        total += program(h).item()
+    return total
+
+
+def streamed_backpressure_regression(segments, program, outs_all):
+    for i, seg in enumerate(segments):
+        device_fault_point("dispatch")
+        outs_all[i] = program(seg)
+        if i >= 1:
+            outs_all[i - 1]["count"].block_until_ready()
+    return outs_all

@@ -1,0 +1,13 @@
+"""Suppressed fixture: the jit_exec backpressure shape with its
+reasoned allow (mirrors the one surviving suppression on the tree)."""
+
+from elasticsearch_tpu.search.jit_exec import device_fault_point
+
+
+def two_segment_backpressure(segments, program, outs_all):
+    for i, seg in enumerate(segments):
+        device_fault_point("dispatch")
+        outs_all[i] = program(seg)
+        if i >= 1:
+            outs_all[i - 1].block_until_ready()  # estpu: allow[host-sync-hot-loop] two-segment residency backpressure — the sync IS the contract
+    return outs_all

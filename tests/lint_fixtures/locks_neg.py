@@ -1,0 +1,45 @@
+"""Negative fixtures: disciplined locking — zero lock-discipline
+findings. Consistent ordering, reentrant self-nesting, the *_locked
+caller-holds convention, and construction-time writes."""
+
+import threading
+
+_outer_lock = threading.Lock()
+_inner_lock = threading.Lock()
+_reentrant_lock = threading.RLock()
+
+
+def consistent_order_one():
+    with _outer_lock:
+        with _inner_lock:
+            pass
+
+
+def consistent_order_two():
+    with _outer_lock:
+        with _inner_lock:
+            pass
+
+
+def reentrant_self_nesting():
+    with _reentrant_lock:
+        _reenter()
+
+
+def _reenter():
+    with _reentrant_lock:
+        pass
+
+
+class Holder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {"seed": True}
+        self._items["boot"] = True
+
+    def put(self, k, v):
+        with self._lock:
+            self._put_locked(k, v)
+
+    def _put_locked(self, k, v):
+        self._items[k] = v

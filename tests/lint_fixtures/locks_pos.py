@@ -1,0 +1,58 @@
+"""Positive fixtures: inconsistent lock order, non-reentrant self
+cycles, and unguarded writes to lock-owned state.
+
+``Registry.run`` is distilled from the real violation fixed in this PR
+at search/percolator.py:486 — the fused-lane stats bump mutated the
+shared stats dict outside the registry lock.
+"""
+
+import threading
+
+_a_lock = threading.Lock()
+_b_lock = threading.Lock()
+_cache = {}
+_cache_lock = threading.Lock()
+
+
+def first_a_then_b():
+    with _a_lock:
+        with _b_lock:
+            pass
+
+
+def first_b_then_a():
+    with _b_lock:
+        with _a_lock:
+            pass
+
+
+def locked_write(key, value):
+    with _cache_lock:
+        _cache[key] = value
+
+
+def unlocked_evict(key):
+    _cache.pop(key, None)
+
+
+def self_deadlock():
+    with _a_lock:
+        _reacquires_a()
+
+
+def _reacquires_a():
+    with _a_lock:
+        pass
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.stats = {"fused_queries": 0, "registered": 0}
+
+    def register(self, qid):
+        with self._lock:
+            self.stats["registered"] += 1
+
+    def run(self, qids):
+        self.stats["fused_queries"] += len(qids)

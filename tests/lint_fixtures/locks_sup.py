@@ -1,0 +1,30 @@
+"""Suppressed fixtures: reasoned allows silence lock-discipline."""
+
+import threading
+
+_stats = {"ops": 0}
+_stats_lock = threading.Lock()
+_x_lock = threading.Lock()
+_y_lock = threading.Lock()
+
+
+def locked_bump():
+    with _stats_lock:
+        _stats["ops"] += 1
+
+
+def unlocked_reset():
+    _stats["ops"] = 0  # estpu: allow[lock-unguarded-state] test-only reset before threads start; a torn write is benign
+
+
+def init_time_order():
+    # estpu: allow[lock-order] init-time probe runs before any other thread exists
+    with _x_lock:
+        with _y_lock:
+            pass
+
+
+def serving_time_order():
+    with _y_lock:
+        with _x_lock:
+            pass

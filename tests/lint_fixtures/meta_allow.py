@@ -1,0 +1,21 @@
+"""Meta fixtures: a bare allow (no reason) must NOT suppress — it
+surfaces as allow-missing-reason and the original finding stays open;
+an allow naming an unknown rule id is reported too."""
+
+import threading
+
+_data = {}
+_data_lock = threading.Lock()
+
+
+def locked_write(k):
+    with _data_lock:
+        _data[k] = True
+
+
+def bare_allow_does_not_suppress(k):
+    _data.pop(k, None)  # estpu: allow[lock-unguarded-state]
+
+
+def unknown_rule_id(k):
+    del _data[k]  # estpu: allow[no-such-rule] naming a rule that does not exist helps nobody

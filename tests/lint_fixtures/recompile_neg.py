@@ -1,0 +1,41 @@
+"""Negative fixtures: legitimate program construction — zero
+recompile-hazard findings.
+
+The accepted shapes: a memoized BUILDER (construction is its job, call
+sites cache), direct memoized construction, trace-time code (nested
+defs and vmaps under a staged function run once per compile), cache
+consultation through the PROGRAM-layer markers, and pow2-bucketed key
+components.
+"""
+
+import jax
+
+_step_cache = {}
+
+
+def make_step(k):
+    return jax.jit(lambda x: x[:k])
+
+
+def step_for(k):
+    if k not in _step_cache:
+        _step_cache[k] = make_step(k)
+    return _step_cache[k]
+
+
+def memoized_direct(cache, key, emit):
+    if key not in cache:
+        cache[key] = jax.jit(emit)
+    return cache[key]
+
+
+def trace_time_construction(batch):
+    @jax.jit
+    def inner(x):
+        return jax.vmap(lambda v: v + 1)(x)
+    return inner(batch)
+
+
+def bucketed_key(_get_compiled, pow2_bucket, sig, queries, build):
+    b = pow2_bucket(len(queries))
+    return _get_compiled((sig, b), build)

@@ -1,0 +1,27 @@
+"""Positive fixtures: program construction on the request path, and
+unbucketed program-cache keys.
+
+``per_request_jit`` is the shape parallel/distributed.py:103 had before
+this PR routed it through a memoized builder; ``unbucketed_key`` is the
+hazard the PROGRAM layer's pow2 bucketing exists to prevent.
+"""
+
+import jax
+
+
+def per_request_jit(emit, consts):
+    fn = jax.jit(emit)
+    return fn(consts)
+
+
+def per_request_vmap(emit, batch):
+    return jax.vmap(emit)(batch)
+
+
+def unbucketed_key(_get_compiled, sig, queries, build):
+    return _get_compiled((sig, len(queries)), build)
+
+
+def unbucketed_key_indirect(_get_compiled, sig, queries, build):
+    key = (sig, len(queries))
+    return _get_compiled(key, build)

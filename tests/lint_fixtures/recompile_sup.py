@@ -1,0 +1,12 @@
+"""Suppressed fixture: a reasoned allow silences recompile-hazard."""
+
+import jax
+
+
+def oneshot_admin_program(emit, consts):
+    fn = jax.jit(emit)  # estpu: allow[recompile-request-path] admin-only reindex path, runs once per index lifetime
+    return fn(consts)
+
+
+def exact_key_by_design(_get_compiled, sig, queries, build):
+    return _get_compiled((sig, len(queries)), build)  # estpu: allow[recompile-unbucketed-key] count is clamped to one page upstream; the key is already bounded

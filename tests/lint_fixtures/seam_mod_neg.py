@@ -1,0 +1,48 @@
+"""Negative fixtures for INSIDE a seam module: guarded touchpoints,
+module-level kernels, the trampoline closure, and the forwarding
+wrapper — zero device-seam findings.
+
+``seam_device_put`` mirrors jit_exec's wrapper: the guard forwards the
+CALLER's site literal (validated at every call site), which dominates
+the wrapper body.
+"""
+
+from functools import partial
+
+import jax
+
+from elasticsearch_tpu.search.jit_exec import device_fault_point
+
+
+@partial(jax.jit, static_argnums=0)
+def kernel(n, x):
+    # module-level kernel definition: compiles once per static shape
+    return x * n
+
+
+def guarded_upload(arrs):
+    device_fault_point("upload")
+    return [jax.device_put(a) for a in arrs]
+
+
+def guarded_compose(mask):
+    device_fault_point("compose")
+    return jax.device_put(mask)
+
+
+def guarded_compile(emit):
+    device_fault_point("compile")
+    return jax.jit(emit)
+
+
+def seam_device_put(a, device=None, site="upload"):
+    device_fault_point(site)
+    return jax.device_put(a) if device is None \
+        else jax.device_put(a, device)
+
+
+def dispatch_via_trampoline(_get_compiled, key, emit, consts):
+    def build():
+        return jax.jit(emit)
+    program = _get_compiled(key, build)
+    return program(consts)

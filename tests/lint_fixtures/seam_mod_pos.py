@@ -1,0 +1,35 @@
+"""Positive fixtures for INSIDE a seam module (the fixture LintConfig
+maps ``*/seam_mod_*.py`` to the seam allowlist): device touchpoints not
+dominated by the fault seam, and unknown site classes.
+
+``mask_swap_regression`` is distilled from the real violation fixed in
+this PR at parallel/mesh_engine.py:221 — the delete-only mask refresh
+re-uploaded the live bitmap under the block lock without drawing from
+the fault seam, so chaos could never fault that transfer.
+"""
+
+import jax
+
+from elasticsearch_tpu.search.jit_exec import device_fault_point
+
+
+def unguarded_upload(arrs):
+    return [jax.device_put(a) for a in arrs]
+
+
+def wrong_site_class(arr):
+    device_fault_point("dispatch")          # dominates dispatches, not uploads
+    return jax.device_put(arr)
+
+
+def unguarded_compile(emit):
+    return jax.jit(emit)
+
+
+def unknown_site():
+    device_fault_point("teleport")
+
+
+def mask_swap_regression(blk, live_np):
+    blk.arrays = [jax.device_put(live_np)] + blk.arrays[1:]
+    return blk

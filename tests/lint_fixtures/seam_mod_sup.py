@@ -1,0 +1,7 @@
+"""Suppressed fixture: a reasoned allow silences device-unguarded."""
+
+import jax
+
+
+def debug_upload(arr):
+    return jax.device_put(arr)  # estpu: allow[device-unguarded] debug-only dump path, never reached while serving

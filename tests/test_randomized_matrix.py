@@ -32,6 +32,7 @@ from dataclasses import dataclass
 import pytest
 
 from conftest import derive_seed
+from elasticsearch_tpu.analysis import watchdog as lock_watchdog
 
 # ---------------------------------------------------------------------------
 # spec draw — THE seeded entry point (replay = same scenario + seed)
@@ -137,13 +138,18 @@ def run_case(scenario: str, seed: int) -> MatrixSpec:
     from elasticsearch_tpu.testing import InternalTestCluster
     fn = globals()[f"_scenario_{scenario}"]
     rnd = random.Random(seed ^ 0x5EED5EED)
-    c = InternalTestCluster(num_nodes=spec.num_nodes,
-                            transport=spec.transport,
-                            settings=dict(spec.settings))
-    try:
-        fn(c, rnd, spec)
-    finally:
-        c.close(check_leaks=False)
+    # ESTPU_LOCK_WATCHDOG=1: every lock the cluster creates is runtime-
+    # order-checked against plane-lint's static lock graph; a recorded
+    # inversion fails the case here (LockOrderError) with the replay
+    # line already printed above
+    with lock_watchdog.watching():
+        c = InternalTestCluster(num_nodes=spec.num_nodes,
+                                transport=spec.transport,
+                                settings=dict(spec.settings))
+        try:
+            fn(c, rnd, spec)
+        finally:
+            c.close(check_leaks=False)
     return spec
 
 

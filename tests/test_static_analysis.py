@@ -1,0 +1,442 @@
+"""plane-lint (tier-1): the five rule families against fixture snippets,
+the tree-is-clean gate over ``elasticsearch_tpu/``, suppression
+mechanics, CLI/JSON output, and the runtime lock-order watchdog that
+cross-checks the static lock graph.
+
+Fixtures live under tests/lint_fixtures/ — they are PARSED by the
+analyzer, never imported. Each rule family has at least one positive
+(findings fire), one negative (clean), and one suppressed (reasoned
+allow) fixture; the *_regression functions are distilled from the real
+violations this PR fixed on the tree (see their docstrings).
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+import threading
+from pathlib import Path
+
+import pytest
+
+from elasticsearch_tpu.analysis import watchdog
+from elasticsearch_tpu.analysis.lint import (
+    DEFAULT_CONFIG, LintConfig, RULE_FAMILIES, lint_paths)
+from elasticsearch_tpu.analysis.lint.cli import main as lint_main
+
+REPO = Path(__file__).resolve().parents[1]
+FIXDIR = Path(__file__).resolve().parent / "lint_fixtures"
+
+#: fixture scoping: seam/hot membership keys on the fixture filenames
+#: instead of the real module paths; everything else stays the
+#: repo-default config
+FIX_CFG = LintConfig(seam_modules=("*/seam_mod_*.py",),
+                     hot_modules=("*/hot_mod_*.py",))
+
+
+def lint_fixture(name: str):
+    return lint_paths([str(FIXDIR / name)], FIX_CFG)
+
+
+def open_rules(result, *rule_ids):
+    return [f for f in result.unsuppressed if f.rule in rule_ids]
+
+
+def open_family(result, family):
+    return [f for f in result.unsuppressed if f.family == family]
+
+
+# ---------------------------------------------------------------------------
+# THE gate: zero unsuppressed findings over the real tree
+# ---------------------------------------------------------------------------
+
+def test_tree_is_clean():
+    result = lint_paths([str(REPO / "elasticsearch_tpu")], DEFAULT_CONFIG)
+    assert result.errors == [], result.errors
+    assert result.files > 100            # the whole package was scanned
+    pretty = "\n".join(f.render() for f in result.unsuppressed)
+    assert not result.unsuppressed, f"plane-lint findings:\n{pretty}"
+    # every surviving suppression documents why
+    for f in result.suppressed:
+        assert f.suppress_reason, f.render()
+
+
+def test_tree_breaker_pairing_is_clean():
+    """The charge-pairing check over every OneShotCharge/add_estimate
+    call site (common/breaker.py and its consumers): no unpaired charge
+    and no suppression in the breaker family anywhere on the tree —
+    DeviceFaultScheme.stop()/engine-close teardown paths all pair."""
+    result = lint_paths([str(REPO / "elasticsearch_tpu")], DEFAULT_CONFIG)
+    fam = [f for f in result.findings
+           if f.family == "breaker-discipline"]
+    assert fam == [], "\n".join(f.render() for f in fam)
+
+
+# ---------------------------------------------------------------------------
+# breaker-discipline
+# ---------------------------------------------------------------------------
+
+def test_breaker_positive():
+    r = lint_fixture("breaker_pos.py")
+    unreleased = open_rules(r, "breaker-unreleased")
+    assert len(unreleased) == 2          # add_estimate + dropped OneShotCharge
+    messages = " ".join(f.message for f in unreleased)
+    assert "charge_without_release" in messages      # qualname is named
+    assert "one_shot_dropped" in messages
+    assert len(open_rules(r, "breaker-double-release")) == 1
+
+
+def test_breaker_negative():
+    r = lint_fixture("breaker_neg.py")
+    assert open_family(r, "breaker-discipline") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+
+
+def test_breaker_suppressed():
+    r = lint_fixture("breaker_sup.py")
+    assert open_family(r, "breaker-discipline") == []
+    sup = [f for f in r.suppressed if f.rule == "breaker-unreleased"]
+    assert len(sup) == 1 and "process-lifetime" in sup[0].suppress_reason
+
+
+# ---------------------------------------------------------------------------
+# device-seam
+# ---------------------------------------------------------------------------
+
+def test_device_raw_positive():
+    r = lint_fixture("device_raw_pos.py")
+    raw = open_rules(r, "device-raw-call")
+    # device_put call, .block_until_ready(), jax.jit in a function, and
+    # the conditional-lambda regression (call + bare reference)
+    assert len(raw) == 5, "\n".join(f.render() for f in raw)
+
+
+def test_device_raw_negative_via_wrappers():
+    r = lint_fixture("device_raw_neg.py")
+    assert open_family(r, "device-seam") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+    # the wrappers also satisfy the recompile family (memoized seam_jit)
+    assert open_family(r, "recompile-hazard") == []
+
+
+def test_device_raw_suppressed():
+    r = lint_fixture("device_raw_sup.py")
+    assert open_family(r, "device-seam") == []
+    assert any(f.rule == "device-raw-call" for f in r.suppressed)
+
+
+def test_device_seam_positive():
+    r = lint_fixture("seam_mod_pos.py")
+    unguarded = open_rules(r, "device-unguarded")
+    # unguarded upload, wrong site class, unguarded compile, and the
+    # mesh_engine mask-swap regression
+    assert len(unguarded) == 4, "\n".join(f.render() for f in unguarded)
+    assert len(open_rules(r, "device-unknown-site")) == 1
+    assert open_rules(r, "device-raw-call") == []   # seam module: no raw rule
+
+
+def test_device_seam_negative():
+    r = lint_fixture("seam_mod_neg.py")
+    assert r.unsuppressed == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+
+
+def test_device_seam_suppressed():
+    r = lint_fixture("seam_mod_sup.py")
+    assert open_family(r, "device-seam") == []
+    assert any(f.rule == "device-unguarded" for f in r.suppressed)
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+
+def test_recompile_positive():
+    r = lint_fixture("recompile_pos.py")
+    assert len(open_rules(r, "recompile-request-path")) == 2  # jit + vmap
+    assert len(open_rules(r, "recompile-unbucketed-key")) == 2
+
+
+def test_recompile_negative():
+    r = lint_fixture("recompile_neg.py")
+    assert open_family(r, "recompile-hazard") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+
+
+def test_recompile_suppressed():
+    r = lint_fixture("recompile_sup.py")
+    assert open_family(r, "recompile-hazard") == []
+    assert {f.rule for f in r.suppressed} >= {
+        "recompile-request-path", "recompile-unbucketed-key"}
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+def test_locks_positive():
+    r = lint_fixture("locks_pos.py")
+    order = open_rules(r, "lock-order")
+    assert len(order) == 2               # inverted pair + self-deadlock
+    assert any("potential deadlock" in f.message for f in order)
+    assert any("self-deadlock" in f.message for f in order)
+    state = open_rules(r, "lock-unguarded-state")
+    # the unlocked module-cache evict + the percolator stats regression
+    assert len(state) == 2, "\n".join(f.render() for f in state)
+    assert any("stats" in f.message for f in state)
+
+
+def test_locks_negative():
+    r = lint_fixture("locks_neg.py")
+    assert open_family(r, "lock-discipline") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+
+
+def test_locks_suppressed():
+    r = lint_fixture("locks_sup.py")
+    assert open_family(r, "lock-discipline") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+    assert {f.rule for f in r.suppressed} >= {
+        "lock-order", "lock-unguarded-state"}
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+
+def test_hostsync_positive():
+    r = lint_fixture("hot_mod_pos.py")
+    hot = open_rules(r, "host-sync-hot-loop")
+    # np.asarray, .item(), and the block_until_ready backpressure shape
+    assert len(hot) == 3, "\n".join(f.render() for f in hot)
+
+
+def test_hostsync_negative():
+    r = lint_fixture("hot_mod_neg.py")
+    assert open_family(r, "host-sync") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+
+
+def test_hostsync_suppressed():
+    r = lint_fixture("hot_mod_sup.py")
+    assert open_family(r, "host-sync") == []
+    sup = [f for f in r.suppressed if f.rule == "host-sync-hot-loop"]
+    assert len(sup) == 1 and "backpressure" in sup[0].suppress_reason
+
+
+def test_hostsync_scoped_to_hot_modules():
+    # identical loop, filename outside the hot-module patterns: silent
+    r = lint_fixture("hostsync_scope.py")
+    assert r.findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppression mechanics (meta)
+# ---------------------------------------------------------------------------
+
+def test_bare_allow_does_not_suppress():
+    r = lint_fixture("meta_allow.py")
+    # both writes stay OPEN: a reasonless allow and an unknown-rule
+    # allow suppress nothing
+    assert len(open_rules(r, "lock-unguarded-state")) == 2
+    meta = open_rules(r, "allow-missing-reason")
+    assert len(meta) == 2
+    assert any("no reason" in f.message for f in meta)
+    assert any("unknown rule id" in f.message for f in meta)
+
+
+# ---------------------------------------------------------------------------
+# output formats + CLI
+# ---------------------------------------------------------------------------
+
+def test_json_report_is_stamped_with_rule_counts():
+    r = lint_fixture("locks_pos.py")
+    doc = json.loads(r.to_json())
+    assert doc["tool"] == "plane-lint" and doc["files"] == 1
+    assert doc["open"] == len(r.unsuppressed) > 0
+    counts = doc["counts"]
+    assert counts["families"]["lock-discipline"]["open"] == doc["open"]
+    assert counts["rules"]["lock-order"]["open"] == 2
+    for f in doc["findings"]:
+        assert set(f) >= {"rule", "family", "path", "line", "message",
+                          "suppressed"}
+
+
+def test_cli_exit_codes_and_json(capsys, tmp_path):
+    # clean file → 0 (DEFAULT_CONFIG: fixture is not seam/hot-scoped,
+    # lock rules are unscoped and the file is disciplined)
+    assert lint_main([str(FIXDIR / "locks_neg.py")]) == 0
+    capsys.readouterr()                  # drain the human-format report
+    # findings → 1, and --json is machine-readable
+    assert lint_main([str(FIXDIR / "locks_pos.py"), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["open"] > 0
+    # --rule filters; unknown rule id → 2
+    assert lint_main([str(FIXDIR / "locks_pos.py"),
+                      "--rule", "lock-order"]) == 1
+    assert lint_main(["--rule", "no-such-rule",
+                      str(FIXDIR / "locks_pos.py")]) == 2
+    # unparseable file → 2
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    assert lint_main([str(bad)]) == 2
+    # --list-rules prints every id with its family
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RULE_FAMILIES:
+        assert rid in out
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order watchdog (ESTPU_LOCK_WATCHDOG=1)
+# ---------------------------------------------------------------------------
+
+_WD_MODULE = textwrap.dedent("""
+    import threading
+
+    _a_lock = threading.Lock()
+    _b_lock = threading.Lock()
+
+    def good():
+        with _a_lock:
+            with _b_lock:
+                pass
+
+    def bad():
+        with _b_lock:
+            with _a_lock:
+                pass
+""")
+
+_WD_EDGES = {("elasticsearch_tpu.wdfix._a_lock",
+              "elasticsearch_tpu.wdfix._b_lock")}
+
+
+def _load_wd_fixture():
+    """Exec the fixture module under a package-prefixed __name__ so the
+    patched lock factories wrap its locks."""
+    g = {"__name__": "elasticsearch_tpu.wdfix"}
+    exec(_WD_MODULE, g)
+    return g
+
+
+def test_watchdog_records_inverted_acquisition():
+    wd = watchdog.enable(edges=_WD_EDGES)
+    try:
+        g = _load_wd_fixture()
+        g["good"]()
+        assert wd.violations == []
+        wd.check()                       # no-op while clean
+        g["bad"]()
+    finally:
+        assert watchdog.disable() is wd
+    assert len(wd.violations) == 1
+    assert "_a_lock" in wd.violations[0] and "BEFORE" in wd.violations[0]
+    with pytest.raises(watchdog.LockOrderError):
+        wd.check()
+    # factories restored: a fresh lock is a real lock again
+    assert type(threading.Lock()).__name__ != "_WatchedLock"
+
+
+def test_watchdog_strict_raises_at_site():
+    watchdog.enable(edges=_WD_EDGES, strict=True)
+    try:
+        g = _load_wd_fixture()
+        with pytest.raises(watchdog.LockOrderError):
+            g["bad"]()
+    finally:
+        watchdog.disable()
+
+
+def test_watchdog_ignores_foreign_and_unnamed_locks():
+    wd = watchdog.enable(edges=_WD_EDGES)
+    try:
+        # a lock created from THIS module (tests.*) is not wrapped
+        mine = threading.Lock()
+        assert type(mine).__name__ != "_WatchedLock"
+        # an unnameable (function-local) package lock never flags
+        g = {"__name__": "elasticsearch_tpu.wdfix2"}
+        exec(textwrap.dedent("""
+            import threading
+
+            def local_locks():
+                a = threading.Lock()
+                with a:
+                    pass
+        """), g)
+        g["local_locks"]()
+    finally:
+        watchdog.disable()
+    assert wd.violations == []
+
+
+def test_watching_is_noop_without_flag(monkeypatch):
+    monkeypatch.delenv(watchdog.ENV_FLAG, raising=False)
+    with watchdog.watching() as wd:
+        assert wd is None
+        assert threading.Lock is watchdog._ORIG_LOCK
+
+
+def test_watching_env_flag_raises_recorded_violations(monkeypatch):
+    monkeypatch.setenv(watchdog.ENV_FLAG, "1")
+    with pytest.raises(watchdog.LockOrderError):
+        with watchdog.watching() as wd:
+            assert wd is not None
+            wd.edges = set(_WD_EDGES)    # pin the synthetic graph
+            g = _load_wd_fixture()
+            g["bad"]()
+    assert threading.Lock is watchdog._ORIG_LOCK
+
+
+def test_static_lock_graph_covers_the_tree():
+    """The watchdog's graph comes from the same analysis as the static
+    rule: it must see the package's real nested acquisitions."""
+    edges, ranks = watchdog.static_lock_graph()
+    assert edges, "no lock-acquisition edges found on the tree"
+    names = {n for e in edges for n in e}
+    assert all(n.startswith("elasticsearch_tpu") or "." in n
+               for n in names)
+    # ranks order outer (first-acquired) locks before inner ones
+    for a, b in edges:
+        if a != b and (b, a) not in edges and a in ranks and b in ranks:
+            assert ranks[a] <= ranks[b], (a, b)
+
+
+# ---------------------------------------------------------------------------
+# DeviceFaultScheme.stop() / engine-close: zero residual breaker bytes
+# ---------------------------------------------------------------------------
+
+def test_scheme_stop_and_close_leave_zero_residual_bytes(tmp_path):
+    """A seeded fault burst (uploads + dispatches failing mid-build)
+    followed by scheme stop and node close must drain every fielddata
+    byte: the charge-pairing discipline the breaker rule checks
+    statically, exercised end-to-end."""
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.search import jit_exec
+    from elasticsearch_tpu.testing_disruption import DeviceFaultScheme
+
+    n = Node({}, data_path=tmp_path / "n").start()
+    try:
+        n.indices_service.create_index("resid", {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+            "mappings": {"_doc": {"properties": {
+                "t": {"type": "text", "analyzer": "whitespace"}}}}})
+        for i in range(40):
+            n.index_doc("resid", str(i), {"t": f"w{i % 7} shared"})
+        n.broadcast_actions.refresh("resid")
+        body = {"query": {"match": {"t": "shared"}}, "size": 10}
+        n.search("resid", dict(body))            # warm the plane pack
+        scheme = DeviceFaultScheme(seed=9, p=0.5, oom_fraction=0.3)
+        with scheme.applied():
+            for i in range(6):
+                n.index_doc("resid", f"x{i}", {"t": "shared fresh"})
+                n.broadcast_actions.refresh("resid")
+                out = n.search("resid", dict(body))  # degrades, never errors
+                assert out["hits"]["total"] > 0
+        assert scheme.total_injected > 0, "seed drew no faults"
+        # stop reset the breaker so the state cannot leak across tests
+        assert jit_exec.plane_breaker.stats()["state"] == "closed"
+    finally:
+        n.close()
+    fd = n.breaker_service.breaker("fielddata")
+    assert fd.used == 0, f"residual fielddata bytes: {fd.used}"
