@@ -662,6 +662,10 @@ def main() -> int:
         #      show per-request p50 once micro-batching amortizes the RTT.
         nq_serial = min(batch, 32)
         searcher.query_phase(reqs[0])
+        # tracer-off overhead guard: the timed serial leg below must
+        # allocate ZERO span objects (observability/tracing.py contract)
+        from elasticsearch_tpu.observability import tracing as obs_trace
+        spans_alloc0 = obs_trace.spans_allocated()
         lat = []
         for r in reqs[:nq_serial]:
             t0 = time.perf_counter()
@@ -687,6 +691,54 @@ def main() -> int:
         log(f"[bench] engine (request-at-a-time): {serial_qps:.1f} QPS, "
             f"p50 {serial_p50:.1f} ms (device↔host RTT floor "
             f"{rtt_ms:.1f} ms)")
+
+        # ---- span-trace attribution leg -------------------------------
+        # A few PROFILED probes attribute the serial path from spans —
+        # device dispatch share, compile share, span-derived RTT floor —
+        # and stamp a Chrome-trace artifact + histogram summary for the
+        # leg; the off-path guard above asserts the timed leg allocated
+        # no spans (tracer-off throughput within noise of untraced).
+        spans_off_delta = obs_trace.spans_allocated() - spans_alloc0
+        from elasticsearch_tpu.observability import chrome as obs_chrome
+        from elasticsearch_tpu.observability import (
+            histograms as obs_hist, use_node)
+        with use_node("bench"), \
+                obs_trace.trace("bench-engine", "bench"), \
+                obs_trace.collect_spans() as leg_spans:
+            for r in reqs[:min(nq_serial, 8)]:
+                with obs_trace.span("probe"):
+                    searcher.query_phase(r)
+        disp_us = [s["duration_us"] for s in leg_spans
+                   if s["name"] in ("dispatch", "plane-dispatch")]
+        comp_us = [s["duration_us"] for s in leg_spans
+                   if s["name"] == "compile"]
+        probe_us = sum(s["duration_us"] for s in leg_spans
+                       if s["name"] == "probe") or 1
+        trace_art = {
+            "spans": len(leg_spans),
+            "rtt_floor_ms_spans":
+                round(float(np.percentile(
+                    np.array(disp_us) / 1e3, 50)), 3) if disp_us
+                else None,
+            "compile_share": round(sum(comp_us) / probe_us, 4),
+            "device_share": round(sum(disp_us) / probe_us, 4),
+            "tracer_off_spans_allocated": int(spans_off_delta),
+            "overhead_ok": spans_off_delta == 0,
+            "histograms": obs_hist.summaries("bench"),
+        }
+        trace_path = os.environ.get("BENCH_TRACE_OUT",
+                                    "TRACE_engine.json")
+        try:
+            with open(trace_path, "w") as fh:
+                json.dump(obs_chrome.chrome_trace(leg_spans), fh)
+            trace_art["chrome_trace"] = trace_path
+        except OSError:
+            trace_art["chrome_trace"] = None
+        log(f"[bench] trace leg: {trace_art['spans']} spans, "
+            f"rtt_floor(spans) {trace_art['rtt_floor_ms_spans']} ms, "
+            f"device share {trace_art['device_share']}, "
+            f"compile share {trace_art['compile_share']}, "
+            f"off-path allocations {spans_off_delta}")
         # concurrent closed-loop clients through the admission queue:
         # each client sends one query at a time and blocks for its answer.
         # The batcher runs PIPELINED (launch/drain split): batch N+1's
@@ -771,6 +823,7 @@ def main() -> int:
                   "ms_per_batch": round(ms_b, 2),
                   "threads": n_threads,
                   "compile_s": round(compile_s, 1),
+                  "trace": trace_art,
                   "configs": configs}
         eng.close()
 

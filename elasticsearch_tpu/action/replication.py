@@ -922,8 +922,10 @@ class DocumentActions:
                 errors = True
                 for pos, (action, doc_id, _r, _s, _m) in group:
                     items[pos] = self._bulk_error_item(action, name, doc_id, e)
-        return {"took": int((time.perf_counter() - t0) * 1e3),
-                "errors": errors, "items": items}
+        took_ms = (time.perf_counter() - t0) * 1e3
+        from elasticsearch_tpu.observability import histograms
+        histograms.observe_lane("bulk", took_ms)
+        return {"took": int(took_ms), "errors": errors, "items": items}
 
     def _bulk_error_item(self, action: str, index, doc_id, e) -> dict:
         e = unwrap_remote(e)

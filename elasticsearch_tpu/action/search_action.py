@@ -38,6 +38,9 @@ from elasticsearch_tpu.common.errors import (
     TaskCancelledError)
 from elasticsearch_tpu.common.settings import parse_time_value
 from elasticsearch_tpu.index.device_reader import device_reader_for
+from elasticsearch_tpu.observability import attribution
+from elasticsearch_tpu.observability import histograms as obs_hist
+from elasticsearch_tpu.observability import tracing as obs_trace
 from elasticsearch_tpu.search.controller import merge_shard_payloads
 from elasticsearch_tpu.search.phase import ShardSearcher, parse_search_request
 from elasticsearch_tpu.tasks import manager as tasks
@@ -516,6 +519,13 @@ class SearchActions:
     PLANE_WARM_BACKOFF_S = 0.25
 
     def _plane_warm(self, index_name: str) -> None:
+        # the warm pool has no task context — attribute its compiles and
+        # uploads to this node explicitly so per-node jit rollups hold
+        from elasticsearch_tpu.observability import use_node
+        with use_node(self.node.node_id):
+            self._plane_warm_inner(index_name)
+
+    def _plane_warm_inner(self, index_name: str) -> None:
         with self._plane_warm_lock:
             self._plane_warm_pending.discard(index_name)
         if self._closed:
@@ -609,9 +619,38 @@ class SearchActions:
             doc_slot=request.get("doc_slot"), dfs=request.get("dfs"),
             pin=request["pin"], budget_ms=request.get("budget_ms"))
 
+    def _shard_traced(self, phase: str, name: str, shard: int, fn):
+        """Run one shard-phase callable under a per-shard attribution
+        record (slow-log plane fields) and — when a trace is active — a
+        ``shard`` span whose finished subtree is attached to the payload
+        as ``_profile`` (the coordinator pops it into the response's
+        profile section). The payload is shallow-copied before the
+        attach so request-cache entries never carry spans."""
+        if not obs_trace.active():
+            with attribution.collect(admission="fanout"):
+                return fn()
+        with attribution.collect(admission="fanout"), \
+                obs_trace.collect_spans() as spans, \
+                obs_trace.span(phase, index=name, shard=shard):
+            out = fn()
+        out = dict(out)
+        out["_profile"] = {"index": name, "shard": shard,
+                           "node": self.node.node_id,
+                           "spans": obs_trace.build_tree(spans)}
+        return out
+
     def _execute_shard_query(self, name: str, shard: int, body: dict,
                              doc_slot: int | None, dfs: dict | None,
                              pin: dict, budget_ms=None) -> dict:
+        return self._shard_traced(
+            "shard-query", name, shard,
+            lambda: self._execute_shard_query_inner(
+                name, shard, body, doc_slot, dfs, pin, budget_ms))
+
+    def _execute_shard_query_inner(self, name: str, shard: int,
+                                   body: dict, doc_slot: int | None,
+                                   dfs: dict | None, pin: dict,
+                                   budget_ms=None) -> dict:
         """Query phase only (QueryPhase.execute without fetch): rank this
         shard's top from+size and return compact hit DESCRIPTORS — ids,
         scores, sort keys — never `_source`. The reader pins under the
@@ -666,6 +705,11 @@ class SearchActions:
         return out
 
     def _handle_shard_fetch(self, request: dict, source) -> dict:
+        return self._shard_traced(
+            "shard-fetch", request["index"], request["shard"],
+            lambda: self._handle_shard_fetch_inner(request))
+
+    def _handle_shard_fetch_inner(self, request: dict) -> dict:
         """Fetch phase for coordinator-chosen winners (fillDocIdsToLoad →
         the second fan-out, TransportSearchQueryThenFetchAction.java:
         89-150): build full hits for exactly the doc ids that made the
@@ -793,6 +837,17 @@ class SearchActions:
                        dfs: dict | None = None,
                        scroll_pin: dict | None = None,
                        budget_ms=None) -> dict:
+        return self._shard_traced(
+            "shard", name, shard,
+            lambda: self._execute_shard_inner(
+                name, shard, body, doc_slot=doc_slot, dfs=dfs,
+                scroll_pin=scroll_pin, budget_ms=budget_ms))
+
+    def _execute_shard_inner(self, name: str, shard: int, body: dict,
+                             doc_slot: int | None = None,
+                             dfs: dict | None = None,
+                             scroll_pin: dict | None = None,
+                             budget_ms=None) -> dict:
         t0 = time.perf_counter()
         svc = self.node.indices_service.index(name)
         engine = svc.engine(shard)
@@ -1042,6 +1097,18 @@ class SearchActions:
                     "dfs_query_then_fetch", "dfs_query_and_fetch",
                     "scan", "count")
 
+    def _tracing_on(self, profile: bool) -> bool:
+        """Tracer gate: per-request ``profile`` opt-in, or the node-wide
+        ``observability.tracer.enable`` setting (default off — the off
+        path allocates no span objects)."""
+        if profile:
+            return True
+        settings = getattr(self.node, "settings", None)
+        if settings is None:
+            return False
+        return str(settings.get("observability.tracer.enable",
+                                "false")).lower() in ("true", "1")
+
     def search(self, index_expr: str, body: dict | None = None,
                scroll: str | None = None,
                search_type: str | None = None,
@@ -1050,9 +1117,18 @@ class SearchActions:
         """Client entry: registers the COORDINATING task (the root of the
         fan-out's task tree), wires the request `timeout` through its
         deadline, and — when the task was cancelled mid-flight — reports
-        the partial response with an explicit ``cancelled`` flag."""
+        the partial response with an explicit ``cancelled`` flag.
+
+        ``"profile": true`` in the body turns the span tracer on for
+        this request and returns the resulting span trees (coordinator
+        phases + per-shard device seams) under ``response["profile"]``.
+        The flag is stripped BEFORE the fan-out, so shards execute the
+        byte-identical request — profiled hits are guaranteed
+        bit-identical to unprofiled ones."""
+        body = dict(body or {})
+        profile = bool(body.pop("profile", False))
         timeout_ms = None
-        raw_timeout = (body or {}).get("timeout")
+        raw_timeout = body.get("timeout")
         if raw_timeout is not None:
             try:
                 timeout_ms = parse_time_value(raw_timeout,
@@ -1064,9 +1140,30 @@ class SearchActions:
                 f"indices[{index_expr}], search_type[{search_type or '-'}]"
                 f"{', scroll' if scroll else ''}",
                 timeout_ms=timeout_ms) as task:
-            resp = self._search(index_expr, body, scroll=scroll,
-                                search_type=search_type, routing=routing,
-                                preference=preference)
+            if task is not None and self._tracing_on(profile):
+                # trace id IS the coordinating task id: the span tree
+                # and the task tree describe the same request, and
+                # GET /_tasks/{id}/trace joins them back up
+                with obs_trace.trace(task.task_id, self.node.node_id), \
+                        obs_trace.profile_sink() as shard_profiles, \
+                        obs_trace.collect_spans() as coord_spans, \
+                        obs_trace.span("search", index=index_expr):
+                    resp = self._search(index_expr, body, scroll=scroll,
+                                        search_type=search_type,
+                                        routing=routing,
+                                        preference=preference)
+                if profile:
+                    resp["profile"] = {
+                        "trace_id": task.task_id,
+                        "coordinator":
+                            obs_trace.build_tree(coord_spans),
+                        "shards": shard_profiles,
+                    }
+            else:
+                resp = self._search(index_expr, body, scroll=scroll,
+                                    search_type=search_type,
+                                    routing=routing,
+                                    preference=preference)
             if task is not None and task.cancelled:
                 resp["cancelled"] = True
             return resp
@@ -1286,6 +1383,8 @@ class SearchActions:
         index_names = [index.name for index, _ in owners]
         responses = []
         q_ms = (time.perf_counter() - t0) * 1e3
+        for _ in bodies:
+            obs_hist.observe_lane("plane", q_ms / len(bodies))
         for body, req, out in zip(bodies, reqs, outs):
             sort_vals = out.get("sort_values")
             per_shard: dict[int, list[tuple[int, float, list]]] = {}
@@ -1552,11 +1651,12 @@ class SearchActions:
                      scroll_pin: dict | None = None,
                      routing: str | None = None,
                      preference: str | None = None) -> dict:
-        names = self.node.indices_service.resolve_open(index_expr)
-        body = rewrite_mlt_likes(self.node, body,
-                                 names[0] if names else "_all")
-        state = self.node.cluster_service.state()
-        req = parse_search_request(body)
+        with obs_trace.span("parse"):
+            names = self.node.indices_service.resolve_open(index_expr)
+            body = rewrite_mlt_likes(self.node, body,
+                                     names[0] if names else "_all")
+            state = self.node.cluster_service.state()
+            req = parse_search_request(body)
         groups = self._shard_groups(state, names, routing=routing,
                                     preference=preference)
         dfs = None
@@ -1575,9 +1675,13 @@ class SearchActions:
             # EVERY shard; restricting the mesh would cost a recompile
             # per subset) and scroll pages need pinned readers the pack
             # does not provide.
-            mesh_resp = self._try_collective_plane(names, [body], [req],
-                                                   t0,
-                                                   search_type=search_type)
+            from elasticsearch_tpu.search import jit_exec
+            with attribution.collect(admission="plane"), \
+                    obs_trace.span("plane") as psp:
+                mesh_resp = self._try_collective_plane(
+                    names, [body], [req], t0, search_type=search_type)
+                psp.set(served=mesh_resp is not None,
+                        breaker=jit_exec.plane_breaker.state)
             if mesh_resp is not None:
                 return mesh_resp[0]
         if search_type == "dfs_query_then_fetch":
@@ -1616,27 +1720,32 @@ class SearchActions:
             return self._query_then_fetch(state, groups, body, req, t0,
                                           slot_of, dfs, deadline_at)
         q_t0 = time.perf_counter()
-        futures = [self._submit(self._try_shard, state, n, s, copies,
-                                body, slot_of[(n, s)], dfs, scroll_pin,
-                                None, deadline_at)
-                   for n, s, copies in groups]
         payloads, failures = [], []
-        for fut in futures:
-            status, payload, _node = fut.result()
-            if status == "ok":
-                payloads.append(payload)
-            else:
-                failures.append(payload)
+        with obs_trace.span("query", shards=len(groups)):
+            futures = [self._submit(self._try_shard, state, n, s, copies,
+                                    body, slot_of[(n, s)], dfs,
+                                    scroll_pin, None, deadline_at)
+                       for n, s, copies in groups]
+            for fut in futures:
+                status, payload, _node = fut.result()
+                if status == "ok":
+                    obs_trace.sink_shard_profile(
+                        payload.pop("_profile", None))
+                    payloads.append(payload)
+                else:
+                    failures.append(payload)
         q_ms = (time.perf_counter() - q_t0) * 1e3
         r_t0 = time.perf_counter()
-        resp = merge_shard_payloads(
-            req, payloads, (time.perf_counter() - t0) * 1e3,
-            total_shards=len(groups), failures=failures)
+        with obs_trace.span("reduce"):
+            resp = merge_shard_payloads(
+                req, payloads, (time.perf_counter() - t0) * 1e3,
+                total_shards=len(groups), failures=failures)
         from elasticsearch_tpu.search.controller import attach_phase_took
         attach_phase_took(
             resp, {"query": q_ms,
                    "reduce": (time.perf_counter() - r_t0) * 1e3},
             tasks.current_task())
+        obs_hist.observe_lane("fanout", (time.perf_counter() - t0) * 1e3)
         if deadline_at is not None and time.perf_counter() > deadline_at:
             # elapsed-time truth at the coordinator too: a request that
             # blew its budget in fan-out/queueing is timed out even if
@@ -1654,17 +1763,21 @@ class SearchActions:
         from elasticsearch_tpu.search.controller import _hit_comparator
         pin = {"uid": _uuid.uuid4().hex, "keep_s": 30.0}
         q_t0 = time.perf_counter()
-        futures = [self._submit(self._try_shard, state, n, s, copies,
-                                body, slot_of[(n, s)], dfs,
-                                None, pin, budget_deadline)
-                   for n, s, copies in groups]
         qpayloads, failures = [], []   # (payload, node_id, name, sid, slot)
-        for (n, s, _), fut in zip(groups, futures):
-            status, payload, node_id = fut.result()
-            if status == "ok":
-                qpayloads.append((payload, node_id, n, s, slot_of[(n, s)]))
-            else:
-                failures.append(payload)
+        with obs_trace.span("query", shards=len(groups)):
+            futures = [self._submit(self._try_shard, state, n, s, copies,
+                                    body, slot_of[(n, s)], dfs,
+                                    None, pin, budget_deadline)
+                       for n, s, copies in groups]
+            for (n, s, _), fut in zip(groups, futures):
+                status, payload, node_id = fut.result()
+                if status == "ok":
+                    obs_trace.sink_shard_profile(
+                        payload.pop("_profile", None))
+                    qpayloads.append((payload, node_id, n, s,
+                                      slot_of[(n, s)]))
+                else:
+                    failures.append(payload)
         q_ms = (time.perf_counter() - q_t0) * 1e3
         fetch_ms = 0.0
         try:
@@ -1685,45 +1798,51 @@ class SearchActions:
             for e in page:
                 by_shard.setdefault(e[2], []).append(e[3])
             f_t0 = time.perf_counter()
-            fetch_futs = {}
-            for si, positions in by_shard.items():
-                p, node_id, name, sid, slot = qpayloads[si]
-                request = {
-                    "index": name, "shard": sid, "body": body, "pin": pin,
-                    "doc_slot": slot,
-                    "docs": [p["docs"][pos] for pos in positions],
-                    "scores": [p["scores"][pos] for pos in positions],
-                    "sort": ([p["sort"][pos] for pos in positions]
-                             if p.get("sort") is not None else None)}
-                if node_id == self.node.node_id:
-                    fetch_futs[si] = self.node.thread_pool.submit(
-                        "search", self._handle_shard_fetch, request, None)
-                else:
-                    target = state.node(node_id)
-                    if target is None:
-                        fetch_futs[si] = None
-                        continue
-                    fetch_futs[si] = self.node.transport_service.\
-                        send_request(target, self.FETCH_ID, request,
-                                     timeout=30.0)
             fetched: dict[tuple[int, int], dict] = {}
             fetch_failed: set[int] = set()
-            for si, positions in by_shard.items():
-                fut = fetch_futs.get(si)
-                try:
-                    if fut is None:
-                        raise ElasticsearchTpuError(
-                            "fetch target node left the cluster")
-                    hits = fut.result(35.0)["hits"]
-                    for pos, hit in zip(positions, hits):
-                        fetched[(si, pos)] = hit
-                except Exception as e:   # noqa: BLE001 — per-shard failure
-                    fetch_failed.add(si)
-                    _, _, name, sid, _ = qpayloads[si]
-                    failures.append({
-                        "shard": sid, "index": name,
-                        "reason": {"type": "fetch_phase_failure",
-                                   "reason": str(e)}})
+            with obs_trace.span("fetch", shards=len(by_shard)):
+                fetch_futs = {}
+                for si, positions in by_shard.items():
+                    p, node_id, name, sid, slot = qpayloads[si]
+                    request = {
+                        "index": name, "shard": sid, "body": body,
+                        "pin": pin, "doc_slot": slot,
+                        "docs": [p["docs"][pos] for pos in positions],
+                        "scores": [p["scores"][pos]
+                                   for pos in positions],
+                        "sort": ([p["sort"][pos] for pos in positions]
+                                 if p.get("sort") is not None else None)}
+                    if node_id == self.node.node_id:
+                        fetch_futs[si] = self.node.thread_pool.submit(
+                            "search", self._handle_shard_fetch, request,
+                            None)
+                    else:
+                        target = state.node(node_id)
+                        if target is None:
+                            fetch_futs[si] = None
+                            continue
+                        fetch_futs[si] = self.node.transport_service.\
+                            send_request(target, self.FETCH_ID, request,
+                                         timeout=30.0)
+                for si, positions in by_shard.items():
+                    fut = fetch_futs.get(si)
+                    try:
+                        if fut is None:
+                            raise ElasticsearchTpuError(
+                                "fetch target node left the cluster")
+                        payload_f = fut.result(35.0)
+                        obs_trace.sink_shard_profile(
+                            payload_f.pop("_profile", None))
+                        hits = payload_f["hits"]
+                        for pos, hit in zip(positions, hits):
+                            fetched[(si, pos)] = hit
+                    except Exception as e:  # noqa: BLE001 — per-shard
+                        fetch_failed.add(si)
+                        _, _, name, sid, _ = qpayloads[si]
+                        failures.append({
+                            "shard": sid, "index": name,
+                            "reason": {"type": "fetch_phase_failure",
+                                       "reason": str(e)}})
             fetch_ms = (time.perf_counter() - f_t0) * 1e3
             hits_out = [fetched[(e[2], e[3])] for e in page
                         if (e[2], e[3]) in fetched]
@@ -1734,14 +1853,17 @@ class SearchActions:
             assemble_response, attach_phase_took)
         r_t0 = time.perf_counter()
         payloads = [p for p, *_ in qpayloads]
-        resp = assemble_response(
-            req, payloads, hits_out, (time.perf_counter() - t0) * 1e3,
-            total_shards=len(groups), failures=failures,
-            successful=len(qpayloads) - len(fetch_failed))
+        with obs_trace.span("reduce"):
+            resp = assemble_response(
+                req, payloads, hits_out,
+                (time.perf_counter() - t0) * 1e3,
+                total_shards=len(groups), failures=failures,
+                successful=len(qpayloads) - len(fetch_failed))
         attach_phase_took(
             resp, {"query": q_ms, "fetch": fetch_ms,
                    "reduce": (time.perf_counter() - r_t0) * 1e3},
             tasks.current_task())
+        obs_hist.observe_lane("fanout", (time.perf_counter() - t0) * 1e3)
         if budget_deadline is not None and \
                 time.perf_counter() > budget_deadline:
             resp["timed_out"] = True
@@ -1838,9 +1960,11 @@ class SearchActions:
             # whose expression spans several indices still packs into
             # the same single dispatch; fallback runs the items through
             # the ordinary paths
-            mesh_outs = self._try_collective_plane(
-                names, send_bodies, [parsed[i] for i in valid], t0,
-                search_type=search_type)
+            with attribution.collect(admission="plane"), \
+                    obs_trace.span("plane", batch=len(send_bodies)):
+                mesh_outs = self._try_collective_plane(
+                    names, send_bodies, [parsed[i] for i in valid], t0,
+                    search_type=search_type)
             if mesh_outs is not None:
                 for i, r in zip(valid, mesh_outs):
                     outs[i] = r

@@ -1,8 +1,8 @@
 """plane-lint: AST-level invariant analysis for the accelerator plane.
 
-Five rule families over the ``elasticsearch_tpu`` tree — breaker
+Six rule families over the ``elasticsearch_tpu`` tree — breaker
 discipline, device-seam coverage, recompile hazards, lock discipline,
-host-sync hazards — each with inline suppressions
+host-sync hazards, span discipline — each with inline suppressions
 (``# estpu: allow[rule-id] <reason>``), machine-readable output, and a
 tier-1 tree-is-clean gate (tests/test_static_analysis.py).
 
@@ -27,14 +27,15 @@ from dataclasses import dataclass, field
 from elasticsearch_tpu.analysis.lint.context import (
     DEFAULT_CONFIG, Finding, LintConfig, ModuleContext, RULE_FAMILIES)
 from elasticsearch_tpu.analysis.lint import (
-    rule_breaker, rule_device, rule_hostsync, rule_locks, rule_recompile)
+    rule_breaker, rule_device, rule_hostsync, rule_locks, rule_recompile,
+    rule_spans)
 
 __all__ = ["Finding", "LintConfig", "LintResult", "DEFAULT_CONFIG",
            "RULE_FAMILIES", "lint_paths", "iter_py_files"]
 
 _PER_MODULE_RULES = (rule_breaker.check, rule_device.check,
                      rule_recompile.check, rule_hostsync.check,
-                     rule_locks.check_state)
+                     rule_locks.check_state, rule_spans.check)
 
 
 @dataclass

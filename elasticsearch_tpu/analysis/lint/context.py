@@ -36,6 +36,8 @@ RULE_FAMILIES = {
     "lock-order": "lock-discipline",
     "lock-unguarded-state": "lock-discipline",
     "host-sync-hot-loop": "host-sync",
+    "span-unscoped-site": "span-discipline",
+    "span-unended": "span-discipline",
     "allow-missing-reason": "meta",
 }
 
@@ -94,6 +96,12 @@ class LintConfig:
     #: the seam entry points (calls routed through these are guarded)
     fault_point_names: tuple = ("device_fault_point",)
     seam_wrappers: tuple = ("seam_device_put", "seam_jit")
+    #: span constructors the span-discipline rule pairs with fault
+    #: points (and requires to be used as `with` contexts)
+    span_fns: tuple = ("device_span",)
+    #: modules exempt from span-discipline (the tracer's own home —
+    #: constructors are DEFINED there, not leaked)
+    span_exempt_modules: tuple = ("*/observability/*",)
     #: closures passed (by name) to these functions are compiled behind
     #: a guarded, cache-keyed trampoline
     trampolines: tuple = ("_get_compiled",)

@@ -1,0 +1,98 @@
+"""span-discipline: every device seam is visible to the tracer.
+
+``span-unscoped-site``: a ``device_fault_point(<site>)`` call must be
+enclosed by — or paired with, anywhere in the same function (or an
+enclosing function, mirroring the device rule's dominance walk) — a
+``with device_span(<site>)`` statement naming the SAME site. Literal
+sites match literal span names; inside a seam wrapper that forwards its
+``site`` parameter to the fault point, the span must forward the same
+parameter. An uncovered site is a device touchpoint the profile API
+cannot attribute — the roofline story loses exactly the microseconds it
+exists to account for.
+
+``span-unended``: a span constructor (``device_span``) used anywhere
+but as a ``with`` context expression. Spans must end on ALL exits —
+success, raise, cancellation — and only the ``with`` form guarantees
+it; a bare call or an assigned span leaks an open span when the region
+raises. The observability package itself (where the constructors live)
+is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticsearch_tpu.analysis.lint.context import (
+    Finding, apply_suppressions, last_name, module_matches)
+
+
+def _span_withs(cfg, fn_node) -> list:
+    """(first-arg AST node) of every ``with <span_fn>(...)`` statement
+    in a function body."""
+    out = []
+    for n in ast.walk(fn_node):
+        if not isinstance(n, ast.With):
+            continue
+        for item in n.items:
+            ce = item.context_expr
+            if isinstance(ce, ast.Call) and \
+                    last_name(ce.func) in cfg.span_fns and ce.args:
+                out.append(ce.args[0])
+    return out
+
+
+def _site_covered(ctx, cfg, fn, site_arg) -> bool:
+    """Is this fault point's site matched by a span with-statement in
+    the enclosing function chain?"""
+    if isinstance(site_arg, ast.Constant):
+        def matches(arg):
+            return isinstance(arg, ast.Constant) and \
+                arg.value == site_arg.value
+    elif isinstance(site_arg, ast.Name):
+        def matches(arg):
+            return isinstance(arg, ast.Name) and arg.id == site_arg.id
+    else:
+        return True                     # device-unknown-site's problem
+    info = fn
+    while info is not None:
+        if any(matches(arg) for arg in _span_withs(cfg, info.node)):
+            return True
+        info = info.parent
+    return False
+
+
+def check(ctx, cfg) -> list:
+    exempt = module_matches(ctx.relpath, cfg.span_exempt_modules)
+    findings, nodes = [], []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = last_name(node.func)
+        if name in cfg.span_fns and not exempt:
+            parent = ctx.parent(node)
+            if not isinstance(parent, ast.withitem):
+                findings.append(Finding(
+                    "span-unended", ctx.relpath, node.lineno,
+                    f"{name}(...) used outside a `with` statement — a "
+                    f"span must end on all exits (return, raise, "
+                    f"cancellation); only the `with` form guarantees "
+                    f"closure"))
+                nodes.append(node)
+            continue
+        if name in cfg.fault_point_names and node.args:
+            fn = ctx.enclosing_function(node)
+            if fn is None:
+                continue                # module scope: test scaffolding
+            if _site_covered(ctx, cfg, fn, node.args[0]):
+                continue
+            site = node.args[0].value \
+                if isinstance(node.args[0], ast.Constant) \
+                else getattr(node.args[0], "id", "?")
+            findings.append(Finding(
+                "span-unscoped-site", ctx.relpath, node.lineno,
+                f"device_fault_point({site!r}) in {fn.qualname}() has "
+                f"no matching `with device_span({site!r})` in scope — "
+                f"this device seam is invisible to the span tracer and "
+                f"the profile API cannot attribute its time"))
+            nodes.append(node)
+    return apply_suppressions(ctx, findings, nodes)

@@ -65,11 +65,13 @@ class FixedThreadPool:
         enqueue share the lock with shutdown's drain, so no item can slip
         in behind the poison pills and hang its caller forever."""
         fut: Future = Future()
-        # carry the submitter's task across the thread boundary (the
+        # carry the submitter's task AND observability context (trace
+        # spans, attribution) across the thread boundary (the
         # ThreadContext.preserveContext analog) and stamp the enqueue
         # time so queue latency is attributable to that task
-        item = (fut, fn, args, kwargs, _tasks.current_task(),
-                time.monotonic_ns())
+        from elasticsearch_tpu.observability.tracing import bind_context
+        item = (fut, bind_context(fn), args, kwargs,
+                _tasks.current_task(), time.monotonic_ns())
         with self._lock:
             if self._closed:
                 raise EsRejectedExecutionError(

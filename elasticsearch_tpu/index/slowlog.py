@@ -44,14 +44,22 @@ class SlowLog:
         """Log at the highest level whose threshold `took_s` exceeds;
         → the level name logged at (for tests), or None. Lines carry the
         executing task id and its parent/trace id (TaskManager wiring)
-        so a slow shard query joins back to its coordinating request."""
+        so a slow shard query joins back to its coordinating request,
+        plus the plane attribution of the request — admission path,
+        fallback reason, program-cache hits/misses, and the device
+        dispatch share of ``took`` — so a slow query is diagnosable
+        from the log line alone."""
         for threshold, level, name in self.thresholds:
             if took_s >= threshold:
+                from elasticsearch_tpu.observability import attribution
                 from elasticsearch_tpu.tasks import current_task
                 task = current_task()
                 if task is not None:
                     message = (f"{message}, task[{task.task_id}], "
                                f"parent[{task.parent_task_id or '-'}]")
+                extra = attribution.render_current(took_s)
+                if extra:
+                    message = f"{message}, {extra}"
                 self.logger.log(
                     level, "[%s] took[%.1fms], %s",
                     self.index_name, took_s * 1000.0, message)

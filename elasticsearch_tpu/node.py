@@ -196,6 +196,9 @@ class Node:
         self.transport_service.register_request_handler(
             self.HOT_THREADS_ACTION, self._handle_hot_threads,
             executor="management", sync=True)
+        self.transport_service.register_request_handler(
+            self.TRACE_COLLECT_ACTION, self._handle_trace_collect,
+            executor="management", sync=True)
         self._delayed_reroute_timer = None
         self.cluster_service.add_listener(self._schedule_delayed_reroute)
         # TTL purger (IndicesTTLService): periodic sweep deleting expired
@@ -814,6 +817,7 @@ class Node:
 
     NODE_STATS_ACTION = "cluster:monitor/nodes/stats[n]"
     HOT_THREADS_ACTION = "cluster:monitor/nodes/hot_threads[n]"
+    TRACE_COLLECT_ACTION = "cluster:monitor/nodes/trace[n]"
 
     def local_node_stats(self) -> dict:
         """This node's stats document (core/action/admin/cluster/node/stats
@@ -875,13 +879,21 @@ class Node:
         indices_total["percolate"] = perc_total
         # compiled-path counters: per-segment program cache plus the
         # plane's shape-keyed program layer (mesh_program_{hits,misses})
-        # and fallback reasons — the trace/compile budget, observable
+        # and fallback reasons — the trace/compile budget, observable.
+        # `node_local` is THIS node's attributed slice of the shared
+        # module-level rollup (in-process nodes share one device, so the
+        # top-level numbers are process-wide; the slice is what isolates
+        # one node's activity in multi-node stats)
         from elasticsearch_tpu.search import jit_exec as _jit_exec
-        indices_total["jit"] = _jit_exec.cache_stats()
+        indices_total["jit"] = {
+            **_jit_exec.cache_stats(),
+            "node_local": _jit_exec.cache_stats(self.node_id)}
         ps = process_stats()
         osx = os_stats()
         heap = ps["mem"]["resident_in_bytes"]
         total_mem = osx.get("mem", {}).get("total_in_bytes", heap or 1)
+        from elasticsearch_tpu.observability import histograms as _hist
+        from elasticsearch_tpu.observability import tracing as _tracing
         return {
             "name": self.node_name,
             "timestamp": int(time.time() * 1000),
@@ -889,6 +901,10 @@ class Node:
             "breakers": self.breaker_service.stats(),
             "thread_pool": pools,
             "tasks": self.task_manager.stats(),
+            # per-lane latency distributions (fixed-bucket histograms,
+            # always on) + this node's span-store accounting
+            "latency": _hist.summaries(self.node_id),
+            "tracing": _tracing.store_stats(self.node_id),
             "process": ps,
             "os": osx,
             # process-level memory reported under the reference's jvm
@@ -1053,7 +1069,9 @@ class Node:
                 n, action, request, timeout=15.0)))
         handler = {self.NODE_STATS_ACTION: self._handle_node_stats,
                    self.HOT_THREADS_ACTION: self._handle_hot_threads,
-                   self.TASKS_LIST_ACTION: self._handle_tasks_list}[action]
+                   self.TASKS_LIST_ACTION: self._handle_tasks_list,
+                   self.TRACE_COLLECT_ACTION:
+                       self._handle_trace_collect}[action]
         out[self.node_id] = handler(request, None)
         for nid, fut in futures:
             try:
@@ -1065,6 +1083,49 @@ class Node:
     def collect_nodes_stats(self) -> dict:
         return {"cluster_name": self.cluster_service.state().cluster_name,
                 "nodes": self._fan_out_nodes(self.NODE_STATS_ACTION, {})}
+
+    # ---- span tracing (observability/tracing.py) ---------------------------
+
+    def _handle_trace_collect(self, request: dict, source) -> dict:
+        """One node's span records — for one trace id, or everything in
+        the store (the Chrome-trace dump)."""
+        from elasticsearch_tpu.observability import tracing
+        request = request or {}
+        trace_id = request.get("trace_id")
+        spans = tracing.spans_for(self.node_id, trace_id) if trace_id \
+            else tracing.all_spans(self.node_id)
+        return {"name": self.node_name, "spans": spans,
+                "stats": tracing.store_stats(self.node_id)}
+
+    def collect_trace(self, trace_id: str) -> dict:
+        """GET /_tasks/{id}/trace — gather one trace's spans from every
+        node and reassemble the cross-node tree under the coordinating
+        task id (span parent links survive the wire, so remote shard
+        subtrees nest under the coordinator's fan-out spans)."""
+        from elasticsearch_tpu.observability import tracing
+        per_node = self._fan_out_nodes(self.TRACE_COLLECT_ACTION,
+                                       {"trace_id": trace_id})
+        spans = [s for doc in per_node.values() for s in doc["spans"]]
+        return {
+            "trace_id": trace_id,
+            "span_count": len(spans),
+            "nodes": sorted({s["node"] for s in spans}),
+            "open_spans": sum(doc["stats"]["open_spans"]
+                              for doc in per_node.values()),
+            "tree": tracing.build_tree(spans),
+        }
+
+    def collect_chrome_trace(self, trace_id: str | None = None) -> dict:
+        """GET /_nodes/trace — every node's stored spans (optionally one
+        trace) as a Chrome Trace Event Format document for offline
+        viewing in chrome://tracing / Perfetto."""
+        from elasticsearch_tpu.observability import chrome
+        per_node = self._fan_out_nodes(
+            self.TRACE_COLLECT_ACTION,
+            {"trace_id": trace_id} if trace_id else {})
+        spans = [s for doc in per_node.values() for s in doc["spans"]]
+        spans.sort(key=lambda s: s["start_us"])
+        return chrome.chrome_trace(spans)
 
     def collect_hot_threads(self, **params) -> str:
         per_node = self._fan_out_nodes(self.HOT_THREADS_ACTION, params)

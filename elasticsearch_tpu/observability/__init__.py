@@ -1,0 +1,25 @@
+"""Observability: span tracing, per-lane latency histograms, slow-log
+attribution, Chrome-trace export.
+
+Submodules (import what you feed, re-exported here for convenience):
+
+* :mod:`~elasticsearch_tpu.observability.tracing` — the span tracer:
+  per-request trees keyed by the coordinating task id, context carried
+  on the task parent-link seams, per-node stores, device-seam spans.
+* :mod:`~elasticsearch_tpu.observability.histograms` — always-on
+  fixed-bucket latency histograms per lane per node (``_nodes/stats``).
+* :mod:`~elasticsearch_tpu.observability.attribution` — per-request
+  plane attribution for slow-log lines.
+* :mod:`~elasticsearch_tpu.observability.chrome` — Trace Event Format
+  export for chrome://tracing / Perfetto.
+* :mod:`~elasticsearch_tpu.observability.context` — node attribution
+  (which node's books an event lands on).
+"""
+
+from elasticsearch_tpu.observability import (  # noqa: F401
+    attribution, chrome, histograms, tracing)
+from elasticsearch_tpu.observability.context import (  # noqa: F401
+    current_node_id, use_node)
+
+__all__ = ["attribution", "chrome", "histograms", "tracing",
+           "current_node_id", "use_node"]

@@ -1,0 +1,107 @@
+"""Per-request attribution — what the slow log needs to say WHY.
+
+A slow-query log line that only names task ids forces an operator to
+correlate three other data sources before they know whether the query
+was slow because it fell off the collective plane, because it paid a
+fresh program compile, or because the device round trip dominated. This
+module keeps ONE small dict per in-flight request (thread-local,
+carried across pool submits by ``tasks.bind_current``) that the
+compiled-path seams feed as they run:
+
+* labels — ``admission`` ("plane" | "fanout"), ``fallback`` reason;
+* counters — program-cache hits/misses (segment + mesh layers);
+* device time — summed per seam site by ``tracing.device_span``.
+
+Always on and allocation-light: one dict per request, integer adds at
+sites that already hold the jit stats lock. Rendering happens only when
+a slow-log threshold actually fires.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_tls = threading.local()
+
+#: counter keys worth mirroring from the jit stats into the request
+#: attribution (program-cache behavior — the "did this query compile?"
+#: question a slow log line must answer)
+MIRRORED_COUNTS = frozenset((
+    "hits", "misses", "mesh_program_hits", "mesh_program_misses",
+    "percolate_program_hits", "percolate_program_misses", "fallbacks"))
+
+
+def current() -> dict | None:
+    return getattr(_tls, "attr", None)
+
+
+def _install(data: dict | None):
+    prev = getattr(_tls, "attr", None)
+    _tls.attr = data
+    return prev
+
+
+@contextlib.contextmanager
+def collect(**labels):
+    """Install a fresh attribution record for the duration; initial
+    ``labels`` (e.g. ``admission="fanout"``) seed it."""
+    prev = _install({"labels": dict(labels), "counts": {},
+                     "device_ms": {}})
+    try:
+        yield _tls.attr
+    finally:
+        _tls.attr = prev
+
+
+def label(key: str, value) -> None:
+    a = getattr(_tls, "attr", None)
+    if a is not None:
+        a["labels"][key] = value
+
+
+def count(key: str, n: int = 1) -> None:
+    a = getattr(_tls, "attr", None)
+    if a is not None:
+        c = a["counts"]
+        c[key] = c.get(key, 0) + n
+
+
+def device_ms(site: str, ms: float) -> None:
+    a = getattr(_tls, "attr", None)
+    if a is not None:
+        d = a["device_ms"]
+        d[site] = d.get(site, 0.0) + ms
+
+
+def render_current(took_s: float | None = None) -> str | None:
+    """One log-line fragment from the current record, or None when no
+    record is installed / nothing was attributed. Shape:
+    ``admission[plane], fallback[breaker-open], programs[2h/1m],
+    device[12.3ms/45%]``."""
+    a = getattr(_tls, "attr", None)
+    if a is None:
+        return None
+    parts = []
+    labels = a["labels"]
+    if "admission" in labels:
+        parts.append(f"admission[{labels['admission']}]")
+    if "fallback" in labels:
+        parts.append(f"fallback[{labels['fallback']}]")
+    c = a["counts"]
+    hits = c.get("hits", 0) + c.get("mesh_program_hits", 0) + \
+        c.get("percolate_program_hits", 0)
+    misses = c.get("misses", 0) + c.get("mesh_program_misses", 0) + \
+        c.get("percolate_program_misses", 0)
+    if hits or misses:
+        parts.append(f"programs[{hits}h/{misses}m]")
+    if c.get("fallbacks"):
+        parts.append(f"eager_fallbacks[{c['fallbacks']}]")
+    dev_total = sum(a["device_ms"].values())
+    if dev_total > 0.0:
+        frag = f"device[{dev_total:.1f}ms"
+        if took_s is not None and took_s > 0:
+            share = min(dev_total / (took_s * 1000.0), 1.0)
+            frag += f"/{share * 100.0:.0f}%"
+        parts.append(frag + "]")
+    return ", ".join(parts) if parts else None

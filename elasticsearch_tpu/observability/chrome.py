@@ -1,0 +1,44 @@
+"""Chrome-trace-format export (the LLM-serving tracing playbook's
+offline viewer): span records → the Trace Event JSON that
+chrome://tracing and Perfetto load directly.
+
+Each span becomes one complete ("X") event; node ids map to pids and
+thread idents to tids, so a cross-node search renders as one timeline
+with per-node lanes. ``GET /_nodes/trace`` serves this document and
+``bench.py`` stamps one per leg.
+"""
+
+from __future__ import annotations
+
+
+def chrome_trace(spans: list, label: str = "elasticsearch-tpu") -> dict:
+    """Span records (tracing.py shape) → a Trace Event Format document:
+    ``{"traceEvents": [...], "displayTimeUnit": "ms"}``."""
+    events = []
+    pids: dict[str, int] = {}
+    for rec in spans:
+        node = rec.get("node", "")
+        pid = pids.get(node)
+        if pid is None:
+            pid = pids[node] = len(pids) + 1
+            events.append({
+                "ph": "M", "pid": pid, "name": "process_name",
+                "args": {"name": f"node[{node or '-'}]"},
+            })
+        args = {"trace_id": rec["trace_id"],
+                "span_id": rec["span_id"],
+                "status": rec.get("status", "ok")}
+        if rec.get("parent_id") is not None:
+            args["parent_id"] = rec["parent_id"]
+        args.update(rec.get("attrs", {}))
+        events.append({
+            "name": rec["name"],
+            "cat": label,
+            "ph": "X",
+            "ts": rec["start_us"],
+            "dur": max(int(rec["duration_us"]), 1),
+            "pid": pid,
+            "tid": rec.get("thread", 0),
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

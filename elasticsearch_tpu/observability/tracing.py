@@ -1,0 +1,447 @@
+"""End-to-end span tracing: one search → one cross-node span tree.
+
+A trace is keyed by the COORDINATING task id (tasks/manager.py mints
+it), so the span tree and the task tree describe the same request and
+``GET /_tasks/{id}/trace`` can reassemble one search's spans from every
+node's store. Context rides the same seams the task parent links do:
+
+* thread-local :class:`TraceContext` (trace id + innermost span id +
+  recording node);
+* :data:`TRACE_HEADER` on outbound RPCs — stamped by
+  ``TransportService.send_request`` next to the parent-task header,
+  re-installed (with the RECEIVING node's id) around handler dispatch;
+* ``tasks.bind_current`` carries the context across pool submits via
+  :func:`bind_context`.
+
+Disabled-path contract: no active context ⇒ :func:`span` returns a
+shared no-op singleton — NO :class:`Span` objects are allocated
+(counter-verified by :func:`spans_allocated`). :func:`device_span` is
+always-on only for its timing side channel (the ``device_rtt``
+histogram and slow-log attribution); it too allocates a Span only under
+an active context.
+
+Spans end on ALL exits — they are context managers, and an exception
+unwinding through one stamps ``status`` ("cancelled" for task
+cancellation, "error" otherwise) before recording, so cancelled and
+timed-out requests still yield complete, closed trees with zero open
+spans left behind.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from collections import OrderedDict
+
+from elasticsearch_tpu.common.errors import TaskCancelledError
+from elasticsearch_tpu.observability import attribution, histograms
+from elasticsearch_tpu.observability.context import (
+    _current_override, current_node_id, use_node)
+
+__all__ = [
+    "TRACE_HEADER", "TraceContext", "Span", "trace", "adopt", "span",
+    "device_span", "active", "wire_header", "bind_context",
+    "collect_spans", "profile_sink", "sink_shard_profile",
+    "spans_allocated", "spans_for", "all_spans", "store_stats",
+    "open_span_count", "build_tree", "reset", "current_node_id",
+    "use_node",
+]
+
+#: request-dict key carrying {"id": trace_id, "parent": span_id} across
+#: the wire (stripped by TransportService before the handler runs, like
+#: the parent-task header)
+TRACE_HEADER = "__trace_ctx__"
+
+#: device seam sites whose span duration is a device round trip — these
+#: feed the always-on ``device_rtt`` histogram lane
+RTT_SITES = frozenset(("dispatch", "plane-dispatch", "percolate"))
+
+_tls = threading.local()
+_span_seq = itertools.count(1)
+#: Span allocations since process start — the tracer-off guard reads
+#: this before/after a request and asserts zero delta. Plain int += 1
+#: under the GIL; consistency beyond "monotone, exact when quiescent"
+#: is not needed.
+_alloc = [0]
+
+
+class TraceContext:
+    """Immutable propagation record: children of the current moment
+    parent under ``parent_span_id`` inside ``trace_id``, recorded on
+    ``node_id``'s store."""
+
+    __slots__ = ("trace_id", "parent_span_id", "node_id")
+
+    def __init__(self, trace_id: str, parent_span_id: str | None,
+                 node_id: str):
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+        self.node_id = node_id
+
+
+def current_ctx() -> "TraceContext | None":
+    return getattr(_tls, "ctx", None)
+
+
+def active() -> bool:
+    return getattr(_tls, "ctx", None) is not None
+
+
+# ---------------------------------------------------------------------------
+# per-node stores
+# ---------------------------------------------------------------------------
+
+class TraceStore:
+    """One node's finished spans, grouped by trace id (bounded LRU of
+    traces), plus the open-span count the leak guards assert on."""
+
+    TRACE_CAP = 128
+
+    def __init__(self):
+        self._traces: "OrderedDict[str, list]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.open_spans = 0
+        self.spans_recorded = 0
+
+    def opened(self) -> None:
+        with self._lock:
+            self.open_spans += 1
+
+    def finished(self, rec: dict) -> None:
+        with self._lock:
+            self.open_spans -= 1
+            self.spans_recorded += 1
+            lst = self._traces.get(rec["trace_id"])
+            if lst is None:
+                lst = self._traces[rec["trace_id"]] = []
+                while len(self._traces) > self.TRACE_CAP:
+                    self._traces.popitem(last=False)
+            lst.append(rec)
+            self._traces.move_to_end(rec["trace_id"])
+
+    def spans(self, trace_id: str) -> list:
+        with self._lock:
+            return list(self._traces.get(trace_id, ()))
+
+    def all(self) -> list:
+        with self._lock:
+            return [rec for lst in self._traces.values() for rec in lst]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"open_spans": self.open_spans,
+                    "spans_recorded": self.spans_recorded,
+                    "traces": len(self._traces)}
+
+
+_stores: dict[str, TraceStore] = {}
+_stores_lock = threading.Lock()
+
+
+def _store(node_id: str) -> TraceStore:
+    s = _stores.get(node_id)
+    if s is None:
+        with _stores_lock:
+            s = _stores.setdefault(node_id, TraceStore())
+    return s
+
+
+def spans_for(node_id: str, trace_id: str) -> list:
+    return _store(node_id).spans(trace_id)
+
+
+def all_spans(node_id: str) -> list:
+    return _store(node_id).all()
+
+
+def store_stats(node_id: str) -> dict:
+    return _store(node_id).stats()
+
+
+def open_span_count(node_id: str | None = None) -> int:
+    """Open spans on one node's store, or across every store."""
+    if node_id is not None:
+        return _store(node_id).stats()["open_spans"]
+    with _stores_lock:
+        stores = list(_stores.values())
+    return sum(s.stats()["open_spans"] for s in stores)
+
+
+def spans_allocated() -> int:
+    return _alloc[0]
+
+
+def reset() -> None:
+    """Drop every store (tests)."""
+    with _stores_lock:
+        _stores.clear()
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    """One timed region of one trace. Context manager — the only way a
+    span ends is ``__exit__``, so every exit path (return, raise,
+    cancellation) closes and records it."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "node_id", "name",
+                 "attrs", "start_us", "_t0", "_prev_ctx", "_entered")
+
+    def __init__(self, ctx: TraceContext, name: str, attrs: dict):
+        _alloc[0] += 1
+        self.trace_id = ctx.trace_id
+        self.parent_id = ctx.parent_span_id
+        self.node_id = ctx.node_id
+        self.span_id = f"{ctx.node_id[:8]}-{next(_span_seq)}"
+        self.name = name
+        self.attrs = attrs
+        self._entered = False
+
+    def __enter__(self):
+        self._prev_ctx = getattr(_tls, "ctx", None)
+        _tls.ctx = TraceContext(self.trace_id, self.span_id, self.node_id)
+        self.start_us = time.time_ns() // 1000
+        self._t0 = time.perf_counter()
+        _store(self.node_id).opened()
+        self._entered = True
+        return self
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur_us = int((time.perf_counter() - self._t0) * 1e6)
+        _tls.ctx = self._prev_ctx
+        if exc_type is None:
+            status = "ok"
+        elif issubclass(exc_type, TaskCancelledError):
+            status = "cancelled"
+        else:
+            status = "error"
+        rec = {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "node": self.node_id,
+            "name": self.name,
+            "start_us": self.start_us,
+            "duration_us": dur_us,
+            "thread": threading.get_ident(),
+            "status": status,
+        }
+        if self.attrs:
+            rec["attrs"] = dict(self.attrs)
+        _store(self.node_id).finished(rec)
+        stack = getattr(_tls, "collectors", None)
+        if stack:
+            stack[-1].append(rec)
+        return False
+
+
+def span(name: str, **attrs):
+    """A traced region — or the shared no-op when no trace is active
+    (nothing allocated)."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        return _NOOP
+    return Span(ctx, name, attrs)
+
+
+class _DeviceSpan:
+    """Device-seam region: always times (feeding the ``device_rtt``
+    histogram for dispatch-class sites and the slow-log attribution),
+    allocates a real Span only when a trace is active."""
+
+    __slots__ = ("site", "_t0", "_span")
+
+    def __init__(self, site: str):
+        self.site = site
+        self._span = None
+
+    def __enter__(self):
+        ctx = getattr(_tls, "ctx", None)
+        if ctx is not None:
+            self._span = Span(ctx, self.site, {}).__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def set(self, **attrs) -> "_DeviceSpan":
+        if self._span is not None:
+            self._span.set(**attrs)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur_ms = (time.perf_counter() - self._t0) * 1e3
+        if self._span is not None:
+            self._span.__exit__(exc_type, exc, tb)
+            self._span = None
+        attribution.device_ms(self.site, dur_ms)
+        if self.site in RTT_SITES:
+            histograms.observe_lane("device_rtt", dur_ms)
+        return False
+
+
+def device_span(site: str) -> _DeviceSpan:
+    return _DeviceSpan(site)
+
+
+# ---------------------------------------------------------------------------
+# context management
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def trace(trace_id: str, node_id: str):
+    """Root a new trace on this thread (the coordinator's entry)."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = TraceContext(str(trace_id), None, str(node_id))
+    try:
+        yield _tls.ctx
+    finally:
+        _tls.ctx = prev
+
+
+@contextlib.contextmanager
+def adopt(header: dict | None, node_id: str):
+    """Re-install a wire-carried context around handler dispatch; spans
+    record on the RECEIVING node's store. No-op when the request carried
+    no trace header."""
+    if not isinstance(header, dict) or "id" not in header:
+        yield None
+        return
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = TraceContext(str(header["id"]), header.get("parent"),
+                            str(node_id))
+    try:
+        yield _tls.ctx
+    finally:
+        _tls.ctx = prev
+
+
+def wire_header() -> dict | None:
+    """The current context as an RPC header value, or None when off."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        return None
+    return {"id": ctx.trace_id, "parent": ctx.parent_span_id}
+
+
+def bind_context(fn):
+    """Capture this thread's observability context (trace ctx, span
+    collectors, profile sink, node override, attribution record) so
+    ``fn`` runs under it on another thread — composed into
+    ``tasks.bind_current`` so every existing submit seam carries it."""
+    ctx = getattr(_tls, "ctx", None)
+    colls = list(getattr(_tls, "collectors", ()) or ())
+    sink = getattr(_tls, "sink", None)
+    override = _current_override()
+    attr = attribution.current()
+    if ctx is None and not colls and sink is None and override is None \
+            and attr is None:
+        return fn
+
+    def bound(*args, **kwargs):
+        prev_ctx = getattr(_tls, "ctx", None)
+        prev_colls = getattr(_tls, "collectors", None)
+        prev_sink = getattr(_tls, "sink", None)
+        prev_attr = attribution._install(attr)
+        _tls.ctx = ctx
+        _tls.collectors = colls
+        _tls.sink = sink
+        try:
+            if override is not None:
+                with use_node(override):
+                    return fn(*args, **kwargs)
+            return fn(*args, **kwargs)
+        finally:
+            _tls.ctx = prev_ctx
+            _tls.collectors = prev_colls
+            _tls.sink = prev_sink
+            attribution._install(prev_attr)
+
+    return bound
+
+
+@contextlib.contextmanager
+def collect_spans():
+    """Collect the span records finished under this scope (innermost
+    collector wins — nested scopes don't duplicate into outer ones).
+    Yields the list, filled as spans close."""
+    out: list = []
+    stack = getattr(_tls, "collectors", None)
+    if stack is None:
+        stack = _tls.collectors = []
+    stack.append(out)
+    try:
+        yield out
+    finally:
+        if out in stack:
+            stack.remove(out)
+
+
+@contextlib.contextmanager
+def profile_sink():
+    """Per-request landing zone for shard profile payloads: the
+    coordinator pops ``_profile`` blocks off shard responses wherever
+    they surface (fan-out loop, fetch round) and sinks them here for the
+    response's ``profile.shards`` section."""
+    prev = getattr(_tls, "sink", None)
+    _tls.sink = out = []
+    try:
+        yield out
+    finally:
+        _tls.sink = prev
+
+
+def sink_shard_profile(entry: dict) -> None:
+    sink = getattr(_tls, "sink", None)
+    if sink is not None and entry is not None:
+        sink.append(entry)
+
+
+# ---------------------------------------------------------------------------
+# tree assembly
+# ---------------------------------------------------------------------------
+
+def build_tree(spans: list) -> list:
+    """Nest flat span records into trees by parent link: children sort
+    by start time under a ``children`` key; spans whose parent is not in
+    the set (the coordinator root, or an orphan fragment) become roots.
+    Input records are not mutated."""
+    by_id = {}
+    for rec in spans:
+        node = dict(rec)
+        node["children"] = []
+        by_id[node["span_id"]] = node
+    roots = []
+    for node in by_id.values():
+        parent = by_id.get(node["parent_id"]) \
+            if node["parent_id"] is not None else None
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    for node in by_id.values():
+        node["children"].sort(key=lambda n: n["start_us"])
+    roots.sort(key=lambda n: n["start_us"])
+    return roots

@@ -191,18 +191,21 @@ class DistributedBM25:
             qdf = np.concatenate(
                 [qdf, np.zeros((qdf.shape[0], padded_q - nq, qdf.shape[2]),
                                qdf.dtype)], axis=1)
+        from elasticsearch_tpu.observability.tracing import device_span
         from elasticsearch_tpu.search.jit_exec import (
             device_fault_point, seam_device_put)
         q_sharding = NamedSharding(self.mesh, P("shard", "dp"))
         step = self.step_for(k)
-        device_fault_point("dispatch")
-        scores, docs, totals = step(
-            self.d_uterms, self.d_utf, self.d_doc_len, self.d_live,
-            seam_device_put(qtids, q_sharding),
-            seam_device_put(qdf, q_sharding),
-            self.d_num_docs, self.d_total_tokens)
-        return (np.asarray(scores)[:nq], np.asarray(docs)[:nq],
-                np.asarray(totals)[:nq])
+        with device_span("dispatch"):
+            device_fault_point("dispatch")
+            scores, docs, totals = step(
+                self.d_uterms, self.d_utf, self.d_doc_len, self.d_live,
+                seam_device_put(qtids, q_sharding),
+                seam_device_put(qdf, q_sharding),
+                self.d_num_docs, self.d_total_tokens)
+            out = (np.asarray(scores)[:nq], np.asarray(docs)[:nq],
+                   np.asarray(totals)[:nq])
+        return out
 
     def resolve(self, global_doc: int) -> tuple[int, int]:
         """global doc id → (shard, local doc)."""

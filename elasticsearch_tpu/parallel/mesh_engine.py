@@ -98,6 +98,7 @@ from elasticsearch_tpu.index.device_reader import (
     dd_split)
 from elasticsearch_tpu.index.segment import (
     KeywordFieldColumn, Segment, TextFieldColumn)
+from elasticsearch_tpu.observability.tracing import device_span
 from elasticsearch_tpu.search import dfs as dfs_mod
 from elasticsearch_tpu.search.execute import ExecutionContext
 from elasticsearch_tpu.search.jit_exec import (
@@ -222,9 +223,12 @@ class _DeviceBlockCache:
                     # This is a real host→device transfer: it draws from
                     # the fault seam like every other upload (a raise
                     # here leaves the block consistent on the old mask)
-                    jit_exec.device_fault_point("upload")
-                    blk.arrays = [jax.device_put(live_np)] + \
-                        blk.arrays[1:]
+                    with device_span("upload") as dsp:
+                        jit_exec.device_fault_point("upload")
+                        blk.arrays = [jax.device_put(live_np)] + \
+                            blk.arrays[1:]
+                        dsp.set(bytes=int(live_np.nbytes),
+                                kind="mask-delta")
                     blk.template = dc_replace(blk.template, live=live_np)
                     blk.live_np = live_np
                     mask_up = int(live_np.nbytes)
@@ -235,8 +239,11 @@ class _DeviceBlockCache:
                         blk.col_bytes)
         template = _build_template(lay, seg, live, doc_base)
         flat_np = seg_flatten(template)
-        jit_exec.device_fault_point("upload")
-        arrays = [jax.device_put(a) for a in flat_np]
+        with device_span("upload") as dsp:
+            jit_exec.device_fault_point("upload")
+            arrays = [jax.device_put(a) for a in flat_np]
+            dsp.set(bytes=int(sum(a.nbytes for a in flat_np)),
+                    kind="block")
         mask_bytes = int(flat_np[0].nbytes)
         col_bytes = int(sum(a.nbytes for a in flat_np[1:]))
         extrema = _segment_extrema(seg) if seg is not None else {}
@@ -778,8 +785,11 @@ class MeshEngineSearcher:
                     tpl = _build_template(lay, seg, live,
                                           self.slot_bases[j])
                     flat_np = seg_flatten(tpl)
-                    jit_exec.device_fault_point("upload")
-                    arrs = [jax.device_put(a) for a in flat_np]
+                    with device_span("upload") as dsp:
+                        jit_exec.device_fault_point("upload")
+                        arrs = [jax.device_put(a) for a in flat_np]
+                        dsp.set(bytes=int(sum(a.nbytes
+                                              for a in flat_np)))
                     extrema = _segment_extrema(seg) if seg is not None \
                         else {}
                     m_up = int(flat_np[0].nbytes)
@@ -844,12 +854,13 @@ class MeshEngineSearcher:
                 self._flats.append(prev._flats[j])
                 continue
             n_arr = len(blocks[0][j])
-            jit_exec.device_fault_point("compose")
-            self._flats.append([
-                jax.device_put(jnp.stack([blocks[si][j][i]
-                                          for si in range(s)]),
-                               shard_sharding)
-                for i in range(n_arr)])
+            with device_span("compose"):
+                jit_exec.device_fault_point("compose")
+                self._flats.append([
+                    jax.device_put(jnp.stack([blocks[si][j][i]
+                                              for si in range(s)]),
+                                   shard_sharding)
+                    for i in range(n_arr)])
         if reuse_blocks:
             # supersession sweep: blocks whose segment left the reader
             # (background merge, force_merge, recovered commit) return
@@ -980,8 +991,10 @@ class MeshEngineSearcher:
                 [self._kw_sort_ranks(sp.field, sp.fill)[0]
                  for sp in kw_specs], axis=1)
         from elasticsearch_tpu.search import jit_exec
-        jit_exec.device_fault_point("upload")
-        dev = jax.device_put(arr, NamedSharding(self.mesh, P("shard")))
+        with device_span("upload") as dsp:
+            jit_exec.device_fault_point("upload")
+            dev = jax.device_put(arr, NamedSharding(self.mesh, P("shard")))
+            dsp.set(bytes=int(arr.nbytes), kind="kw-rank")
         self._kw_operand_cache[ckey] = dev
         return dev
 
@@ -1401,11 +1414,14 @@ class MeshEngineSearcher:
             if h_named:
                 out_specs["histo"] = h_named
         from elasticsearch_tpu.parallel.mesh import shard_map_compat
-        mapped = shard_map_compat(
-            step_local, mesh=self.mesh,
-            in_specs=(flat_specs, const_specs, cursor_spec, kwsort_spec),
-            out_specs=out_specs)
-        fn = jax.jit(mapped)
+        with device_span("compile") as dsp:
+            mapped = shard_map_compat(
+                step_local, mesh=self.mesh,
+                in_specs=(flat_specs, const_specs, cursor_spec,
+                          kwsort_spec),
+                out_specs=out_specs)
+            fn = jax.jit(mapped)
+            dsp.set(layer="mesh-program")
         # built OUTSIDE the lock (tracing is slow); a racing duplicate
         # build is harmless — last one wins the slot, like _get_compiled
         with _program_lock:
@@ -1605,8 +1621,10 @@ class MeshEngineSearcher:
                         chi, clo = -chi, -clo
                     cur_np[:, bi, 2 * i] = float(chi)
                     cur_np[:, bi, 2 * i + 1] = float(clo)
-        jit_exec.device_fault_point("upload")
-        cursors = jax.device_put(cur_np, q_sharding)
+        with device_span("upload") as dsp:
+            jit_exec.device_fault_point("upload")
+            cursors = jax.device_put(cur_np, q_sharding)
+            dsp.set(bytes=int(cur_np.nbytes), kind="cursors")
         kwsorts = self._kw_rank_operand(sort_specs)
 
         t1 = time.perf_counter()
@@ -1617,16 +1635,21 @@ class MeshEngineSearcher:
                            agg_spec=agg_spec, bucket_specs=bucket_specs,
                            sort_specs=sort_specs, has_cursor=has_cursor)
         from elasticsearch_tpu.search.jit_exec import device_fault_point
-        device_fault_point("plane-dispatch")
-        outs = fn(self._flats, consts_dev, cursors, kwsorts)
-        t2 = time.perf_counter()
-        g_s = np.asarray(outs["scores"])
-        g_d = np.asarray(outs["docs"])
-        totals = np.asarray(outs["totals"])
-        shard_counts = np.asarray(outs["shard_counts"]).reshape(
-            self.n_shards, b_pad)
-        skeys = [(np.asarray(h), np.asarray(l))
-                 for h, l in outs["skeys"]] if sort_specs else None
+        # the span covers dispatch AND the first host fetches — the
+        # np.asarray calls are where the host actually waits on the
+        # device, so this duration IS the plane's device round trip
+        with device_span("plane-dispatch") as dsp:
+            device_fault_point("plane-dispatch")
+            outs = fn(self._flats, consts_dev, cursors, kwsorts)
+            t2 = time.perf_counter()
+            g_s = np.asarray(outs["scores"])
+            g_d = np.asarray(outs["docs"])
+            totals = np.asarray(outs["totals"])
+            shard_counts = np.asarray(outs["shard_counts"]).reshape(
+                self.n_shards, b_pad)
+            skeys = [(np.asarray(h), np.asarray(l))
+                     for h, l in outs["skeys"]] if sort_specs else None
+            dsp.set(batch=b_pad, shards=self.n_shards)
         if debug:
             print(f"[mesh-debug] dfs {t_dfs*1e3:.0f}ms "
                   f"plan+stack {(t1-t0-t_dfs)*1e3:.0f}ms "

@@ -296,8 +296,10 @@ def register_all(rc: RestController, node) -> None:
     # task management (rest/action/admin/cluster/node/tasks)
     r("GET", "/_tasks", h.list_tasks)
     r("POST", "/_tasks/_cancel", h.cancel_tasks)
+    r("GET", "/_tasks/{task_id}/trace", h.task_trace)
     r("GET", "/_tasks/{task_id}", h.get_task)
     r("POST", "/_tasks/{task_id}/_cancel", h.cancel_task)
+    r("GET", "/_nodes/trace", h.nodes_trace)
     r("GET", "/_nodes", h.nodes_info)
     r("GET", "/_nodes/stats", h.nodes_stats)
     r("GET", "/_nodes/stats/{metric}", h.nodes_stats)
@@ -1489,12 +1491,21 @@ class Handlers:
         return 200, resp
 
     def search(self, req: RestRequest):
-        resp = self.node.search(req.path_params["index"],
-                                self._search_body(req),
+        # REST-layer attribution for the profile API: body parse +
+        # dispatch overhead before/after the traced coordinator section
+        # (the tracer itself starts with the coordinating task)
+        t0 = time.perf_counter()
+        body = self._search_body(req)
+        parse_us = int((time.perf_counter() - t0) * 1e6)
+        resp = self.node.search(req.path_params["index"], body,
                                 scroll=req.param("scroll"),
                                 search_type=self._rest_search_type(req),
                                 routing=req.param("routing"),
                                 preference=req.param("preference"))
+        if "profile" in resp:
+            resp["profile"]["rest"] = {
+                "parse_us": parse_us,
+                "total_us": int((time.perf_counter() - t0) * 1e6)}
         t = req.path_params.get("type")
         if t and t != "_all":
             for hit in resp.get("hits", {}).get("hits", []):
@@ -2828,6 +2839,27 @@ class Handlers:
                                "reason": f"task [{task_id}] isn't "
                                          f"running"},
                      "status": 404}
+
+    def task_trace(self, req: RestRequest):
+        """GET /_tasks/{task_id}/trace — one search's span tree,
+        reassembled from every node's trace store under the coordinating
+        task id (observability/tracing.py). 404 when no node holds spans
+        for the id (tracer off, or the trace aged out of the store)."""
+        task_id = req.path_params["task_id"]
+        out = self.node.collect_trace(task_id)
+        if not out["span_count"]:
+            return 404, {"error": {
+                "type": "resource_not_found_exception",
+                "reason": f"no trace recorded for task [{task_id}] "
+                          f"(was the search profiled / the tracer on?)"},
+                "status": 404}
+        return 200, out
+
+    def nodes_trace(self, req: RestRequest):
+        """GET /_nodes/trace[?trace_id=...] — every node's stored spans
+        as a Chrome-trace-format document (chrome://tracing /
+        Perfetto)."""
+        return 200, self.node.collect_chrome_trace(req.param("trace_id"))
 
     def cancel_task(self, req: RestRequest):
         """POST /_tasks/{task_id}/_cancel — cancels the task on its owner

@@ -39,6 +39,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from elasticsearch_tpu.index.device_reader import DeviceSegment
+from elasticsearch_tpu.observability import attribution as _attribution
+from elasticsearch_tpu.observability.context import current_node_id
+from elasticsearch_tpu.observability.tracing import device_span
 from elasticsearch_tpu.ops import topk as topk_ops
 from elasticsearch_tpu.search.execute import (
     ConstTable, EmitCtx, ExecutionContext, SegmentResolver)
@@ -104,9 +107,10 @@ def seam_device_put(a, device=None, site: str = "upload"):
     default chaos draw leaves alone (see testing_disruption.
     DEVICE_FAULT_SITES) so degraded-mode serving always has a working
     fallback; targeted tests opt in via ``p_by_site``."""
-    device_fault_point(site)
-    return jax.device_put(a) if device is None \
-        else jax.device_put(a, device)
+    with device_span(site):
+        device_fault_point(site)
+        return jax.device_put(a) if device is None \
+            else jax.device_put(a, device)
 
 
 def seam_jit(fn, **kwargs):
@@ -114,8 +118,9 @@ def seam_jit(fn, **kwargs):
     caching — memoize the result per static shape (plane-lint rule
     recompile-request-path checks call sites); the seam only makes the
     compile injectable and breaker-visible."""
-    device_fault_point("compile")
-    return jax.jit(fn, **kwargs)
+    with device_span("compile"):
+        device_fault_point("compile")
+        return jax.jit(fn, **kwargs)
 
 
 def is_device_oom(exc: BaseException) -> bool:
@@ -276,8 +281,8 @@ def note_device_error(exc: BaseException) -> None:
         except Exception:                # noqa: BLE001 — best-effort
             freed = 0
         with _cache_lock:
-            _stats["oom_evictions"] += 1
-            _stats["oom_bytes_evicted"] += int(freed)
+            _bump("oom_evictions")
+            _bump("oom_bytes_evicted", int(freed))
     plane_breaker.record_error(exc)
 
 
@@ -287,7 +292,7 @@ def note_breaker_skip() -> None:
     plane admission declines label ``fallback_reasons`` separately via
     :func:`note_plane_fallback` with reason ``breaker-open``.)"""
     with _cache_lock:
-        _stats["breaker_open_skips"] += 1
+        _bump("breaker_open_skips")
 # mesh_program_* count the collective plane's shape-keyed PROGRAM layer
 # (mesh_engine._program): a miss is a fresh shard_map trace+compile, a
 # hit re-dispatches a compiled program against a new data-layer pack —
@@ -314,6 +319,27 @@ _stats = {"hits": 0, "misses": 0, "fallbacks": 0,
 #: (ineligible-shape / parse-error / refresh-race / device-error / …)
 _fallback_reasons: dict[str, int] = {}
 
+# Per-NODE attribution of the rollups above: every in-process node
+# shares this module, so without node keying a two-node cluster test
+# reads one node's compiles in the other node's _nodes/stats. Counter
+# bumps attribute to observability.context.current_node_id() (the
+# executing task's node); cache_stats(node_id=...) reads one bucket.
+_node_stats: dict[str, dict] = {}
+_node_fallback_reasons: dict[str, dict] = {}
+
+
+def _bump(key: str, n: int = 1) -> None:
+    """Count one event on the process-global rollup, the current node's
+    bucket, and (for program-cache keys) the per-request slow-log
+    attribution. Callers hold ``_cache_lock``."""
+    _stats[key] += n
+    nid = current_node_id()
+    if nid is not None:
+        bucket = _node_stats.setdefault(nid, {})
+        bucket[key] = bucket.get(key, 0) + n
+    if key in _attribution.MIRRORED_COUNTS:
+        _attribution.count(key, n)
+
 # data_layer.* count the collective plane's INCREMENTAL data layer
 # (mesh_engine._DeviceBlockCache): bytes_uploaded is actual host→device
 # transfer (column + live-mask bytes, split out below), bytes_reused is
@@ -328,7 +354,17 @@ _data_layer = {"bytes_uploaded": 0, "bytes_reused": 0,
                "mask_only_refreshes": 0}
 
 
-def cache_stats() -> dict:
+def cache_stats(node_id: str | None = None) -> dict:
+    """The process-global rollup (default), or — with ``node_id`` — the
+    counters attributed to one node's tasks (the per-node view
+    ``_nodes/stats`` reports as ``jit.node_local``)."""
+    if node_id is not None:
+        with _cache_lock:
+            bucket = dict(_node_stats.get(node_id, {}))
+            reasons = dict(_node_fallback_reasons.get(node_id, {}))
+        out = {key: bucket.get(key, 0) for key in _stats}
+        out["fallback_reasons"] = reasons
+        return out
     with _cache_lock:
         out = {**_stats, "fallback_reasons": dict(_fallback_reasons),
                "data_layer": dict(_data_layer)}
@@ -360,14 +396,19 @@ def note_data_refresh(kind: str) -> None:
 def note_mesh_program(hit: bool) -> None:
     """One collective-plane program-cache lookup (mesh_engine._program)."""
     with _cache_lock:
-        _stats["mesh_program_hits" if hit else "mesh_program_misses"] += 1
+        _bump("mesh_program_hits" if hit else "mesh_program_misses")
 
 
 def note_plane_fallback(reason: str) -> None:
     """One collective-plane admission decline, reason-labeled."""
+    _attribution.label("fallback", reason)
     with _cache_lock:
-        _stats["plane_fallbacks"] += 1
+        _bump("plane_fallbacks")
         _fallback_reasons[reason] = _fallback_reasons.get(reason, 0) + 1
+        nid = current_node_id()
+        if nid is not None:
+            bucket = _node_fallback_reasons.setdefault(nid, {})
+            bucket[reason] = bucket.get(reason, 0) + 1
 
 
 _logged_fallbacks: set = set()
@@ -376,7 +417,7 @@ _logged_fallbacks: set = set()
 def note_fallback(exc: BaseException | None = None,
                   reason: str | None = None) -> None:
     with _cache_lock:
-        _stats["fallbacks"] += 1
+        _bump("fallbacks")
         if reason is not None:
             _fallback_reasons[reason] = _fallback_reasons.get(reason, 0) + 1
     if exc is not None:
@@ -404,6 +445,8 @@ def clear_cache() -> None:
                       oom_bytes_evicted=0)
         _fallback_reasons.clear()
         _data_layer.update({k: 0 for k in _data_layer})
+        _node_stats.clear()
+        _node_fallback_reasons.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -597,14 +640,15 @@ def _get_compiled(key, build_fn):
         fn = _cache.get(key)
         if fn is not None:
             _cache.move_to_end(key)
-            _stats["hits"] += 1
+            _bump("hits")
             return fn
     # compile OUTSIDE the lock (slow); a racing duplicate compile is
     # harmless — last one wins the cache slot
     with _cache_lock:
-        _stats["misses"] += 1
-    device_fault_point("compile")
-    fn = build_fn()
+        _bump("misses")
+    with device_span("compile"):
+        device_fault_point("compile")
+        fn = build_fn()
     with _cache_lock:
         _cache[key] = fn
         while len(_cache) > _CACHE_CAP:
@@ -661,8 +705,9 @@ def run_segment(seg: DeviceSegment, ctx: ExecutionContext, query,
         return jax.jit(run).lower(*shapes).compile()
 
     fn = _get_compiled(key, compile_fn)
-    device_fault_point("dispatch")
-    return fn(flat, consts)
+    with device_span("dispatch"):
+        device_fault_point("dispatch")
+        return fn(flat, consts)
 
 
 def _plan_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,
@@ -814,8 +859,9 @@ def run_reader_batch(segments: list, ctx: ExecutionContext, queries: list,
         return jax.jit(run).lower(*shapes).compile()
 
     fn = _get_compiled(key, compile_fn)
-    device_fault_point("dispatch")
-    out = fn(flats, packeds)
+    with device_span("dispatch"):
+        device_fault_point("dispatch")
+        out = fn(flats, packeds)
     if b_pad != b:
         out = out[:b] if pack else {name: v[:b] for name, v in out.items()}
     return out
@@ -847,9 +893,10 @@ def run_segments_streamed(segments: list, ctx: ExecutionContext,
             return None
         plans.append(plan)
     def put(a, _dev=device):
-        device_fault_point("upload")
-        return jax.device_put(a, _dev) if _dev is not None \
-            else jax.device_put(a)
+        with device_span("upload"):
+            device_fault_point("upload")
+            return jax.device_put(a, _dev) if _dev is not None \
+                else jax.device_put(a)
 
     def get_fn(seg, plan):
         def compile_fn():
@@ -907,8 +954,9 @@ def run_segments_streamed(segments: list, ctx: ExecutionContext,
             packed = {dt: jnp.asarray(buf)
                       for dt, buf in plan["packed"].items()}
             t1 = time.perf_counter()
-            device_fault_point("dispatch")
-            outs = fn(cur, packed)          # async dispatch
+            with device_span("dispatch"):
+                device_fault_point("dispatch")
+                outs = fn(cur, packed)      # async dispatch
             stats["dispatch_s"] += time.perf_counter() - t1
             outs_all.append(outs)
             del cur                         # free as soon as compute drains
@@ -1056,11 +1104,12 @@ def run_percolate_lanes(lanes: list) -> list:
         full_key = ("percolate", key, n_pad)
         with _cache_lock:
             hit = full_key in _cache
-            _stats["percolate_program_hits" if hit
-                   else "percolate_program_misses"] += 1
+            _bump("percolate_program_hits" if hit
+                  else "percolate_program_misses")
         fn = _get_compiled(full_key, compile_fn)
-        device_fault_point("percolate")
-        out = fn(flats, packed)         # async dispatch: groups pipeline
+        with device_span("percolate"):
+            device_fault_point("percolate")
+            out = fn(flats, packed)     # async dispatch: groups pipeline
         pending.append((idxs, out))
     for idxs, out in pending:
         arr = np.asarray(out)           # [n_pad, b(_pad)|1, 2]
@@ -1119,8 +1168,9 @@ def run_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,
         return jax.jit(run).lower(*shapes).compile()
 
     fn = _get_compiled(key, compile_fn)
-    device_fault_point("dispatch")
-    outs = fn(flat, packed)
+    with device_span("dispatch"):
+        device_fault_point("dispatch")
+        outs = fn(flat, packed)
     if plan["b_pad"] != b:
         outs = {name: v[:b] for name, v in outs.items()}
     return outs

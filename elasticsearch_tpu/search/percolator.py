@@ -511,6 +511,9 @@ class PercolatorRegistry:
         with self._lock:
             self.stats["count"] += len(items)
             self.stats["time_ms"] += dt
+        from elasticsearch_tpu.observability import histograms
+        for _ in items:
+            histograms.observe_lane("percolate", dt / max(len(items), 1))
         return results
 
     def _eager_rescue(self, items, per_item) -> None:

@@ -72,9 +72,12 @@ def use_task(task: "Task | None"):
 
 
 def bind_current(fn):
-    """Capture the caller's current task so ``fn`` runs under it on
-    another thread (the context-preserving submit the reference gets
+    """Capture the caller's current task AND observability context
+    (trace spans, attribution, node override) so ``fn`` runs under them
+    on another thread (the context-preserving submit the reference gets
     from ThreadContext.preserveContext)."""
+    from elasticsearch_tpu.observability.tracing import bind_context
+    fn = bind_context(fn)
     task = current_task()
     if task is None:
         return fn
@@ -111,10 +114,13 @@ def note_breaker_bytes(nbytes: int) -> None:
 
 
 def note_queue_ns(ns: int) -> None:
-    """Attribute threadpool queue wait to the current task."""
+    """Attribute threadpool queue wait to the current task, and feed
+    the per-node ``queue_wait`` latency histogram (_nodes/stats)."""
     task = current_task()
     if task is not None:
         task.queue_ns += int(ns)
+    from elasticsearch_tpu.observability import histograms
+    histograms.observe_lane("queue_wait", ns / 1e6)
 
 
 class Task:

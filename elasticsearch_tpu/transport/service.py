@@ -284,6 +284,14 @@ class TransportService:
             cur = current_task()
             if cur is not None:
                 request = {**request, TASK_HEADER: cur.task_id}
+        # trace-context propagation rides the same envelope: the
+        # receiver re-roots its spans under ours, so one search yields
+        # ONE cross-node span tree keyed by the coordinating task id
+        from elasticsearch_tpu.observability.tracing import (
+            TRACE_HEADER, wire_header)
+        trace_hdr = wire_header()
+        if trace_hdr is not None:
+            request = {**request, TRACE_HEADER: trace_hdr}
         if timeout is not None:
             ctx.timer = threading.Timer(timeout, self._on_timeout, (rid,))
             ctx.timer.daemon = True
@@ -318,9 +326,13 @@ class TransportService:
             return
         request = StreamInput(payload, wire_version).read_value()
         parent_task = None
+        trace_hdr = None
         if isinstance(request, dict):
+            from elasticsearch_tpu.observability.tracing import \
+                TRACE_HEADER
             from elasticsearch_tpu.tasks import TASK_HEADER
             parent_task = request.pop(TASK_HEADER, None)
+            trace_hdr = request.pop(TRACE_HEADER, None)
         if self.task_manager is not None:
             # register BEFORE dispatch so queue time on a saturated pool
             # is visible in the task list, and a ban that lands while the
@@ -330,9 +342,13 @@ class TransportService:
                 parent_task_id=parent_task, task_type="transport")
 
         def run():
+            from elasticsearch_tpu.observability.tracing import adopt
             from elasticsearch_tpu.tasks import use_task
             try:
-                with use_task(channel.task):
+                # spans record on the RECEIVING node's store, parented
+                # under the sender's current span
+                with use_task(channel.task), \
+                        adopt(trace_hdr, self.local_node.node_id):
                     reg.handler(request, channel)
             except Exception as e:              # noqa: BLE001 — crosses RPC
                 channel.send_failure(e)

@@ -4,12 +4,14 @@ fire outside the hot-path modules."""
 
 import numpy as np
 
+from elasticsearch_tpu.observability.tracing import device_span
 from elasticsearch_tpu.search.jit_exec import device_fault_point
 
 
 def asarray_per_iteration(segments, program):
     outs = []
     for seg in segments:
-        device_fault_point("dispatch")
-        outs.append(np.asarray(program(seg)))
+        with device_span("dispatch"):
+            device_fault_point("dispatch")
+            outs.append(np.asarray(program(seg)))
     return outs

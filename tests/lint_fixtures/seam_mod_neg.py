@@ -11,6 +11,7 @@ from functools import partial
 
 import jax
 
+from elasticsearch_tpu.observability.tracing import device_span
 from elasticsearch_tpu.search.jit_exec import device_fault_point
 
 
@@ -21,24 +22,28 @@ def kernel(n, x):
 
 
 def guarded_upload(arrs):
-    device_fault_point("upload")
-    return [jax.device_put(a) for a in arrs]
+    with device_span("upload"):
+        device_fault_point("upload")
+        return [jax.device_put(a) for a in arrs]
 
 
 def guarded_compose(mask):
-    device_fault_point("compose")
-    return jax.device_put(mask)
+    with device_span("compose"):
+        device_fault_point("compose")
+        return jax.device_put(mask)
 
 
 def guarded_compile(emit):
-    device_fault_point("compile")
-    return jax.jit(emit)
+    with device_span("compile"):
+        device_fault_point("compile")
+        return jax.jit(emit)
 
 
 def seam_device_put(a, device=None, site="upload"):
-    device_fault_point(site)
-    return jax.device_put(a) if device is None \
-        else jax.device_put(a, device)
+    with device_span(site):
+        device_fault_point(site)
+        return jax.device_put(a) if device is None \
+            else jax.device_put(a, device)
 
 
 def dispatch_via_trampoline(_get_compiled, key, emit, consts):

@@ -1,0 +1,43 @@
+"""Negative fixtures: every covered shape the span-discipline rule must
+accept — enclosure, same-function pairing, wrapper parameter
+forwarding, and a fault point in a closure covered by its enclosing
+function's span."""
+
+
+def device_fault_point(site):
+    pass
+
+
+def device_span(site):
+    pass
+
+
+def enclosed(fn, arr):
+    with device_span("dispatch"):
+        device_fault_point("dispatch")
+        return fn(arr)
+
+
+def paired_later(fn, arr):
+    # one seam draw covers the upload phase; the span wraps the actual
+    # transfer a few lines down — pairing, not enclosure
+    device_fault_point("upload")
+    staged = [a for a in arr]
+    with device_span("upload"):
+        return fn(staged)
+
+
+def seam_wrapper(a, site="upload"):
+    # parameter-forwarding form (seam_device_put): span and fault point
+    # forward the SAME parameter; literals are checked at call sites
+    with device_span(site):
+        device_fault_point(site)
+        return a
+
+
+def outer_covers_closure(fn, arr):
+    with device_span("compile"):
+        def build():
+            device_fault_point("compile")
+            return fn(arr)
+        return build()

@@ -1,0 +1,34 @@
+"""Positive fixtures: device seams the span tracer cannot see.
+
+``naked_fault_point`` is the pre-PR shape of every jit_exec/mesh_engine
+dispatch site — a fault point with no span, i.e. a device touchpoint the
+profile API cannot attribute. ``assigned_span`` shows the leak shape the
+with-form requirement exists for: a span bound to a name never closes
+when the region raises.
+"""
+
+
+def device_fault_point(site):
+    pass
+
+
+def device_span(site):
+    pass
+
+
+def naked_fault_point(fn, arr):
+    device_fault_point("dispatch")
+    return fn(arr)
+
+
+def assigned_span(fn, arr):
+    sp = device_span("upload")          # span-unended: not a `with`
+    device_fault_point("upload")        # and therefore still unscoped
+    out = fn(arr)
+    return out, sp
+
+
+def mismatched_site(fn, arr):
+    with device_span("compile"):
+        device_fault_point("dispatch")  # span names the WRONG site
+        return fn(arr)

@@ -1,0 +1,10 @@
+"""Suppressed fixture: a reasoned allow silences span-discipline."""
+
+
+def device_fault_point(site):
+    pass
+
+
+def untraced_probe(fn, arr):
+    device_fault_point("dispatch")  # estpu: allow[span-unscoped-site] breaker half-open probe — timing is attributed by the probe counter, not a span
+    return fn(arr)

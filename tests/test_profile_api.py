@@ -1,0 +1,231 @@
+"""Search Profile API: ``"profile": true`` returns per-shard span trees
+with hits BIT-IDENTICAL to the unprofiled response — fuzz-verified on
+both the collective-plane and RPC fan-out paths — plus the trace REST
+endpoints, the tracer-off no-allocation guard, and per-lane latency
+histograms in nodes stats."""
+
+import json
+import random
+
+import pytest
+
+from elasticsearch_tpu.client import HttpClient
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.observability import tracing
+from elasticsearch_tpu.rest.server import RestServer
+from elasticsearch_tpu.testing import InternalTestCluster
+
+WORDS = ("alpha", "beta", "gamma", "delta", "omega", "kappa", "sigma",
+         "tau", "zeta", "iota")
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    with InternalTestCluster(
+            1, base_path=tmp_path_factory.mktemp("prof")) as c:
+        m = c.master()
+        # plane-eligible index: every shard local, ≥2 shards
+        m.indices_service.create_index(
+            "plane_idx", {"settings": {"number_of_shards": 2,
+                                       "number_of_replicas": 0}})
+        # fan-out-forced twin: identical docs, plane opted out
+        m.indices_service.create_index(
+            "fanout_idx", {"settings": {
+                "number_of_shards": 2, "number_of_replicas": 0,
+                "index.search.collective_plane": "false"}})
+        c.wait_for_health("green")
+        rng = random.Random(61)
+        for i in range(60):
+            doc = {"body": " ".join(rng.choices(WORDS, k=6)),
+                   "n": rng.randint(0, 100),
+                   "tag": rng.choice(("red", "green", "blue"))}
+            m.index_doc("plane_idx", str(i), doc)
+            m.index_doc("fanout_idx", str(i), doc)
+        m.broadcast_actions.refresh("plane_idx")
+        m.broadcast_actions.refresh("fanout_idx")
+        yield c
+
+
+def _fuzz_bodies(n, seed):
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        body = {"size": rng.choice((0, 3, 10, 25))}
+        kind = rng.random()
+        if kind < 0.3:
+            body["query"] = {"match": {"body": rng.choice(WORDS)}}
+        elif kind < 0.5:
+            body["query"] = {"bool": {
+                "must": [{"match": {"body": rng.choice(WORDS)}}],
+                "filter": [{"term": {"tag": rng.choice(
+                    ("red", "green", "blue"))}}]}}
+        elif kind < 0.7:
+            body["query"] = {"range": {"n": {"gte": rng.randint(0, 60)}}}
+        else:
+            body["query"] = {"match_all": {}}
+        if rng.random() < 0.3:
+            body["sort"] = [{"n": {"order": rng.choice(("asc",
+                                                        "desc"))}}]
+        if rng.random() < 0.25:
+            body["aggs"] = {"tags": {"terms": {"field": "tag"}}}
+        out.append(body)
+    return out
+
+
+def _strip_timing(resp):
+    out = {k: v for k, v in resp.items()
+           if k not in ("took", "took_breakdown", "profile")}
+    return json.loads(json.dumps(out, sort_keys=True))
+
+
+@pytest.mark.parametrize("index", ["plane_idx", "fanout_idx"])
+def test_profiled_hits_bit_identical_fuzz(cluster, index):
+    m = cluster.master()
+    for body in _fuzz_bodies(25, seed=7 if index == "plane_idx" else 11):
+        plain = m.search_actions.search(index, dict(body))
+        prof = m.search_actions.search(index,
+                                       {**body, "profile": True})
+        assert "profile" in prof
+        assert _strip_timing(plain) == _strip_timing(prof), body
+
+
+def test_fanout_profile_covers_shards_and_device_seams(cluster):
+    m = cluster.master()
+    resp = m.search_actions.search(
+        "fanout_idx", {"query": {"match": {"body": "alpha"}},
+                       "profile": True})
+    prof = resp["profile"]
+    shards = {(s["index"], s["shard"]) for s in prof["shards"]}
+    assert shards == {("fanout_idx", 0), ("fanout_idx", 1)}
+    for entry in prof["shards"]:
+        assert entry["node"] == m.node_id
+        names: list = []
+
+        def walk(t):
+            names.append(t["name"])
+            for c in t["children"]:
+                walk(c)
+        for root in entry["spans"]:
+            walk(root)
+        assert names[0] == "shard"
+        # the compiled query phase dispatches on-device per request
+        assert "dispatch" in names
+    coord = [t["name"] for t in prof["coordinator"]]
+    assert coord == ["search"]
+
+
+def test_plane_profile_attributes_the_mesh_dispatch(cluster):
+    m = cluster.master()
+    resp = m.search_actions.search(
+        "plane_idx", {"query": {"match": {"body": "alpha"}},
+                      "profile": True})
+    names: list = []
+
+    def walk(t):
+        names.append(t["name"])
+        for c in t["children"]:
+            walk(c)
+    for root in resp["profile"]["coordinator"]:
+        walk(root)
+    assert "plane" in names
+    assert "plane-dispatch" in names    # the one mesh dispatch, timed
+    # plane admission stats confirm the profiled request rode the plane
+    assert m.indices_service.index("plane_idx").plane_stats["served"] > 0
+
+
+def test_tracer_off_path_allocates_no_spans(cluster):
+    m = cluster.master()
+    m.search_actions.search("plane_idx", {"query": {"match_all": {}}})
+    before = tracing.spans_allocated()
+    for body in _fuzz_bodies(6, seed=3):
+        m.search_actions.search("plane_idx", body)
+        m.search_actions.search("fanout_idx", body)
+    assert tracing.spans_allocated() == before
+
+
+def test_latency_histograms_in_nodes_stats(cluster):
+    m = cluster.master()
+    m.search_actions.search("plane_idx", {"query": {"match_all": {}}})
+    m.search_actions.search("fanout_idx", {"query": {"match_all": {}}})
+    stats = m.local_node_stats()
+    lanes = stats["latency"]
+    for lane in ("plane", "fanout", "percolate", "bulk", "queue_wait",
+                 "device_rtt"):
+        assert lane in lanes
+        assert set(lanes[lane]) >= {"count", "p50_ms", "p95_ms",
+                                    "p99_ms", "sum_ms", "max_ms"}
+    assert lanes["plane"]["count"] >= 1
+    assert lanes["fanout"]["count"] >= 1
+    assert lanes["device_rtt"]["count"] >= 1
+    assert stats["tracing"]["open_spans"] == 0
+    # the per-node jit slice is attributed, not the process-global dump
+    node_local = stats["indices"]["jit"]["node_local"]
+    assert node_local["hits"] + node_local["misses"] > 0
+
+
+def test_slowlog_live_search_is_diagnosable_from_the_line(cluster,
+                                                          caplog):
+    """A slow fan-out query's log line names its admission path,
+    program-cache behavior, and device-dispatch share — no other data
+    source needed (satellite: slowlog plane attribution)."""
+    import logging
+
+    from elasticsearch_tpu.common.settings import Settings
+    m = cluster.master()
+    svc = m.indices_service.index("fanout_idx")
+    svc.search_slow_log.update_settings(Settings(
+        {"index.search.slowlog.threshold.query.info": "0ms"}))
+    try:
+        with caplog.at_level(logging.INFO,
+                             logger="index.search.slowlog"):
+            m.search_actions.search(
+                "fanout_idx", {"query": {"match": {"body": "alpha"}}})
+        msgs = [r.getMessage() for r in caplog.records]
+        assert any("admission[fanout]" in s for s in msgs), msgs
+        assert any("programs[" in s or "device[" in s for s in msgs)
+        assert any("task[" in s for s in msgs)
+    finally:
+        svc.search_slow_log.update_settings(Settings({}))
+
+
+# ---- REST endpoints ---------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def rest(tmp_path_factory):
+    node = Node(data_path=tmp_path_factory.mktemp("prof-rest")).start()
+    srv = RestServer(node, port=0).start()
+    client = HttpClient(port=srv.port)
+    client.indices.create("r_idx", {
+        "settings": {"index": {"number_of_shards": 2}}})
+    for i in range(12):
+        client.index("r_idx", {"body": f"trace me {i}"}, id=str(i))
+    client.indices.refresh("r_idx")
+    yield client
+    srv.stop()
+    node.close()
+
+
+def test_rest_profile_and_trace_endpoints(rest):
+    resp = rest.search("r_idx", {"query": {"match": {"body": "trace"}},
+                                 "profile": True})
+    prof = resp["profile"]
+    assert prof["rest"]["total_us"] >= prof["rest"]["parse_us"] >= 0
+    trace_id = prof["trace_id"]
+    out = rest._request("GET", f"/_tasks/{trace_id}/trace")
+    assert out["trace_id"] == trace_id
+    assert out["span_count"] > 0 and out["open_spans"] == 0
+    assert [t["name"] for t in out["tree"]] == ["search"]
+    # Chrome-trace dump: loadable Trace Event Format
+    doc = rest._request("GET", f"/_nodes/trace?trace_id={trace_id}")
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert xs and all("ts" in e and "dur" in e for e in xs)
+    # unknown trace id → 404
+    with pytest.raises(Exception):
+        rest._request("GET", "/_tasks/nope:999/trace")
+
+
+def test_rest_nodes_stats_exposes_latency_section(rest):
+    out = rest._request("GET", "/_nodes/stats")
+    for doc in out["nodes"].values():
+        assert "latency" in doc and "fanout" in doc["latency"]
+        assert "tracing" in doc

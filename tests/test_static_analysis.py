@@ -231,6 +231,46 @@ def test_hostsync_scoped_to_hot_modules():
 
 
 # ---------------------------------------------------------------------------
+# span-discipline
+# ---------------------------------------------------------------------------
+
+def test_spans_positive():
+    r = lint_fixture("spans_pos.py")
+    unscoped = open_rules(r, "span-unscoped-site")
+    # naked fault point, assigned (non-with) span, wrong-site span
+    assert len(unscoped) == 3, "\n".join(f.render() for f in unscoped)
+    messages = " ".join(f.message for f in unscoped)
+    assert "naked_fault_point" in messages
+    assert "mismatched_site" in messages
+    unended = open_rules(r, "span-unended")
+    assert len(unended) == 1 and "assigned_span" not in unended[0].message
+    assert "with" in unended[0].message
+
+
+def test_spans_negative():
+    r = lint_fixture("spans_neg.py")
+    assert open_family(r, "span-discipline") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+
+
+def test_spans_suppressed():
+    r = lint_fixture("spans_sup.py")
+    assert open_family(r, "span-discipline") == []
+    sup = [f for f in r.suppressed if f.rule == "span-unscoped-site"]
+    assert len(sup) == 1 and "probe" in sup[0].suppress_reason
+
+
+def test_spans_tree_every_site_class_is_covered():
+    """The instrumentation contract behind the profile API: every
+    device_fault_point call on the real tree sits in scope of a
+    matching device_span — zero open OR suppressed span findings (a
+    suppression here would be a seam the tracer silently misses)."""
+    result = lint_paths([str(REPO / "elasticsearch_tpu")], DEFAULT_CONFIG)
+    fam = [f for f in result.findings if f.family == "span-discipline"]
+    assert fam == [], "\n".join(f.render() for f in fam)
+
+
+# ---------------------------------------------------------------------------
 # suppression mechanics (meta)
 # ---------------------------------------------------------------------------
 

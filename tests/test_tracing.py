@@ -1,0 +1,286 @@
+"""Span tracing (observability/tracing.py): unit mechanics, cross-node
+propagation over BOTH transports, trace reassembly under the
+coordinating task id, and the zero-leaked-open-spans contract on
+completion, cancellation, and timeout."""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.errors import TaskCancelledError
+from elasticsearch_tpu.observability import (attribution, chrome,
+                                             histograms, tracing,
+                                             use_node)
+from elasticsearch_tpu.testing import InternalTestCluster
+from elasticsearch_tpu.testing_disruption import wait_until
+
+
+# ---- unit: spans, context, stores ------------------------------------------
+
+def test_span_tree_nests_by_parent_and_sorts_by_start():
+    with tracing.trace("t-unit-1", "nA"):
+        with tracing.collect_spans() as got:
+            with tracing.span("root"):
+                with tracing.span("a"):
+                    pass
+                with tracing.span("b"):
+                    with tracing.span("b1"):
+                        pass
+    tree = tracing.build_tree(got)
+    assert [t["name"] for t in tree] == ["root"]
+    root = tree[0]
+    assert [c["name"] for c in root["children"]] == ["a", "b"]
+    assert [c["name"] for c in root["children"][1]["children"]] == ["b1"]
+    assert tracing.open_span_count("nA") == 0
+
+
+def test_tracer_off_allocates_no_span_objects():
+    before = tracing.spans_allocated()
+    with tracing.span("ignored", attr=1):
+        with tracing.device_span("dispatch"):
+            pass
+    assert tracing.spans_allocated() == before
+    # the no-op singleton supports the full surface
+    sp = tracing.span("x")
+    assert sp.set(k=1) is sp
+
+
+def test_span_status_on_error_and_cancellation():
+    with tracing.trace("t-unit-2", "nB"):
+        with tracing.collect_spans() as got:
+            with pytest.raises(ValueError):
+                with tracing.span("boom"):
+                    raise ValueError("x")
+            with pytest.raises(TaskCancelledError):
+                with tracing.span("shed"):
+                    raise TaskCancelledError("cancelled")
+    by_name = {r["name"]: r for r in got}
+    assert by_name["boom"]["status"] == "error"
+    assert by_name["shed"]["status"] == "cancelled"
+    # every span closed despite the raises
+    assert tracing.open_span_count("nB") == 0
+
+
+def test_collect_spans_innermost_collector_wins():
+    with tracing.trace("t-unit-3", "nC"):
+        with tracing.collect_spans() as outer:
+            with tracing.span("coordinator"):
+                with tracing.collect_spans() as inner:
+                    with tracing.span("shard"):
+                        pass
+    assert [r["name"] for r in inner] == ["shard"]
+    assert [r["name"] for r in outer] == ["coordinator"]
+
+
+def test_device_span_feeds_rtt_histogram_and_attribution():
+    histograms.reset()
+    with use_node("rtt-node"), attribution.collect(admission="fanout"):
+        with tracing.device_span("dispatch"):
+            time.sleep(0.002)
+        with tracing.device_span("upload"):   # not a dispatch site
+            pass
+        frag = attribution.render_current(took_s=0.01)
+    lanes = histograms.summaries("rtt-node")
+    assert lanes["device_rtt"]["count"] == 1
+    assert lanes["device_rtt"]["p50_ms"] > 0.5
+    assert "admission[fanout]" in frag and "device[" in frag
+
+
+def test_slowlog_line_carries_plane_attribution(caplog):
+    import logging
+
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.index.slowlog import SearchSlowLog
+    slog = SearchSlowLog("idx", Settings(
+        {"index.search.slowlog.threshold.query.warn": "1ms"}))
+    with attribution.collect(admission="fanout"):
+        attribution.count("hits", 3)
+        attribution.count("misses", 1)
+        attribution.device_ms("dispatch", 5.0)
+        with caplog.at_level(logging.WARNING,
+                             logger="index.search.slowlog"):
+            assert slog.maybe_log(0.02, "q") == "warn"
+    msg = caplog.records[-1].getMessage()
+    assert "admission[fanout]" in msg
+    assert "programs[3h/1m]" in msg
+    assert "device[5.0ms/25%]" in msg
+    # without an attribution record the line is unchanged
+    with caplog.at_level(logging.WARNING, logger="index.search.slowlog"):
+        slog.maybe_log(0.02, "q2")
+    assert "admission[" not in caplog.records[-1].getMessage()
+
+
+def test_wire_header_roundtrip_adopt():
+    with tracing.trace("t-wire", "sender"):
+        with tracing.span("outer"):
+            hdr = tracing.wire_header()
+            assert hdr["id"] == "t-wire" and hdr["parent"]
+            with tracing.adopt(hdr, "receiver"):
+                with tracing.span("remote"):
+                    pass
+    remote = [r for r in tracing.spans_for("receiver", "t-wire")
+              if r["name"] == "remote"]
+    assert remote and remote[0]["parent_id"] == hdr["parent"]
+    # adopt of a header-less request is a no-op context
+    with tracing.adopt(None, "receiver"):
+        assert not tracing.active()
+
+
+def test_histogram_percentiles_and_node_isolation():
+    histograms.reset()
+    for ms in (1.0, 2.0, 4.0, 8.0, 100.0):
+        histograms.observe_lane("fanout", ms, node_id="iso-a")
+    histograms.observe_lane("fanout", 1000.0, node_id="iso-b")
+    a = histograms.summaries("iso-a")["fanout"]
+    b = histograms.summaries("iso-b")["fanout"]
+    assert a["count"] == 5 and b["count"] == 1
+    assert a["p50_ms"] <= a["p95_ms"] <= a["p99_ms"] <= a["max_ms"]
+    assert a["max_ms"] == 100.0 and b["max_ms"] == 1000.0
+    # lanes report a stable shape even when empty
+    assert histograms.summaries("iso-a")["percolate"]["count"] == 0
+
+
+def test_chrome_trace_export_shape():
+    with tracing.trace("t-chrome", "nD"):
+        with tracing.collect_spans() as got:
+            with tracing.span("search"):
+                with tracing.span("query"):
+                    pass
+    doc = chrome.chrome_trace(got)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 2
+    for e in xs:
+        assert e["dur"] >= 1 and e["ts"] > 0
+        assert e["args"]["trace_id"] == "t-chrome"
+    assert any(e["ph"] == "M" for e in doc["traceEvents"])
+
+
+# ---- cluster: propagation + reassembly -------------------------------------
+
+@pytest.fixture(scope="module", params=["local", "tcp"])
+def cluster(request, tmp_path_factory):
+    n = 3 if request.param == "local" else 2
+    with InternalTestCluster(
+            n, base_path=tmp_path_factory.mktemp("trace"),
+            transport=request.param) as c:
+        c.wait_for_nodes(n)
+        m = c.master()
+        m.indices_service.create_index(
+            "traced", {"settings": {"number_of_shards": n,
+                                    "number_of_replicas": 0}})
+        c.wait_for_health("green")
+        for i in range(24):
+            m.index_doc("traced", str(i), {"body": f"hello world {i}"})
+        m.broadcast_actions.refresh("traced")
+        yield c
+
+
+def _zero_open_everywhere(cluster):
+    return all(
+        tracing.store_stats(n.node_id)["open_spans"] == 0
+        for n in cluster.nodes)
+
+
+def test_profile_search_reassembles_one_cross_node_tree(cluster):
+    m = cluster.master()
+    resp = m.search_actions.search(
+        "traced", {"query": {"match": {"body": "hello"}}, "size": 5,
+                   "profile": True})
+    trace_id = resp["profile"]["trace_id"]
+    # trace id IS the coordinating task id (node_id:seq shape)
+    assert trace_id.startswith(m.node_id + ":")
+    out = m.collect_trace(trace_id)
+    assert out["span_count"] > 0 and out["open_spans"] == 0
+    # ONE root — the coordinator's search span — even though spans were
+    # recorded on several nodes
+    assert [t["name"] for t in out["tree"]] == ["search"]
+    assert len(out["nodes"]) >= 2
+    phases = [c["name"] for c in out["tree"][0]["children"]]
+    assert "query" in phases and "reduce" in phases
+    # every shard subtree reassembled under the fan-out
+    def collect(t, acc):
+        acc.append(t["name"])
+        for c in t["children"]:
+            collect(c, acc)
+    names: list = []
+    collect(out["tree"][0], names)
+    assert names.count("shard") == 3 if cluster.transport == "local" \
+        else names.count("shard") == 2
+    assert _zero_open_everywhere(cluster)
+
+
+def test_cancelled_search_leaves_complete_closed_tree(cluster):
+    m = cluster.master()
+    for n in cluster.nodes:
+        n.search_actions.shard_query_delay = 8.0
+    try:
+        out: dict = {}
+        th = threading.Thread(target=lambda: out.update(r=m.search(
+            "traced", {"query": {"match_all": {}}, "profile": True})))
+        th.start()
+        coord: dict = {}
+
+        def coord_visible():
+            for tid, t in m.task_manager.list_tasks().items():
+                if t["action"] == "indices:data/read/search" \
+                        and "parent_task_id" not in t:
+                    coord["id"] = tid
+                    return True
+            return False
+        assert wait_until(coord_visible, timeout=5.0)
+        assert m.cancel_task(coord["id"], reason="test cancel")["found"]
+        th.join(15.0)
+        assert out["r"].get("cancelled") is True
+    finally:
+        for n in cluster.nodes:
+            n.search_actions.shard_query_delay = None
+    # the cancelled request still yielded a complete, ENDED span tree:
+    # zero open spans anywhere, and the recorded spans carry their
+    # cancellation status
+    assert wait_until(lambda: _zero_open_everywhere(cluster),
+                      timeout=10.0)
+    spans = [s for n in cluster.nodes
+             for s in tracing.spans_for(n.node_id, coord["id"])]
+    assert spans, "cancelled trace recorded no spans"
+    assert any(s["status"] == "cancelled" for s in spans)
+
+
+def test_timed_out_search_closes_every_span(cluster):
+    m = cluster.master()
+    for n in cluster.nodes:
+        n.search_actions.shard_query_delay = 0.3
+    try:
+        resp = m.search_actions.search(
+            "traced", {"query": {"match_all": {}}, "timeout": "30ms",
+                       "profile": True})
+        assert resp["timed_out"] is True
+        assert "profile" in resp
+    finally:
+        for n in cluster.nodes:
+            n.search_actions.shard_query_delay = None
+    assert wait_until(lambda: _zero_open_everywhere(cluster),
+                      timeout=10.0)
+
+
+def test_per_node_stats_isolation_under_fanout(cluster):
+    """A search coordinated on node A must land on A's histograms, not
+    on every node's (module-level state is per-node keyed)."""
+    m = cluster.master()
+    others = [n for n in cluster.nodes if n is not m]
+    before_m = m.local_node_stats()["latency"]["fanout"]["count"]
+    before_o = [n.local_node_stats()["latency"]["fanout"]["count"]
+                for n in others]
+    m.search_actions.search("traced",
+                            {"query": {"match": {"body": "hello"}}})
+    after_m = m.local_node_stats()["latency"]["fanout"]["count"]
+    after_o = [n.local_node_stats()["latency"]["fanout"]["count"]
+               for n in others]
+    assert after_m == before_m + 1
+    assert after_o == before_o          # no smear onto other nodes
+    # per-node jit slices stay within the process-global rollup
+    total = m.local_node_stats()["indices"]["jit"]
+    per_node = [n.local_node_stats()["indices"]["jit"]["node_local"]
+                for n in cluster.nodes]
+    for key in ("hits", "misses"):
+        assert sum(p[key] for p in per_node) <= total[key]
