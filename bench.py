@@ -1438,6 +1438,206 @@ def main() -> int:
             f"{ri_record['speedup_x']}x faster, "
             f"{ri_record['upload_ratio']}x fewer bytes/refresh")
 
+    # ---- impact_pruning leg: quantized eager impacts + block-max sweep ----
+    # Exact forward kernel vs impact-eager (precomputed quantized
+    # impacts, no per-doc BM25 float math) vs block-max pruned sweep on
+    # a skewed top-k workload (rare-leaning query terms — the needle
+    # queries WAND-style pruning exists for). Stamps blocks scored /
+    # skipped, the effective-work ratio, steady-state program-cache
+    # counters, and the parity verdicts. CPU artifacts keep
+    # `"fallback": true`; the on-chip capture rides BENCH_r06
+    # (ROADMAP #1).
+    imp_record = None
+    if os.environ.get("BENCH_IMPACT", "1") == "1":
+        from elasticsearch_tpu.index.segment import (TextFieldColumn,
+                                                     build_impact_column)
+        from elasticsearch_tpu.search import jit_exec as _jx_imp
+        imp_k = int(os.environ.get("BENCH_IMPACT_K", 10))
+        imp_t = int(os.environ.get("BENCH_IMPACT_TERMS", 3))
+        imp_batch = int(os.environ.get("BENCH_IMPACT_BATCH",
+                                       min(batch, 32)))
+        imp_nb = int(os.environ.get("BENCH_IMPACT_BATCHES", 4))
+        imp_rows = int(os.environ.get("BENCH_IMPACT_BLOCK_ROWS", 2048))
+        # uint16 on the bench: at 16-bit width the quantization bound is
+        # far below any top-10 score gap of the skewed workload, so the
+        # lane's hits are expected IDENTICAL to the exact scorer (uint8
+        # remains the index default — its wider step is what makes the
+        # df-drift requant threshold survivable under refresh churn)
+        imp_bits = int(os.environ.get("BENCH_IMPACT_BITS", 16))
+        # skewed workload: rare-leaning terms (df fraction 2e-5..2e-4)
+        lo_df = max(2, int(2e-5 * n_docs))
+        hi_df = max(lo_df + 2, int(2e-4 * n_docs))
+        cand = np.nonzero((df >= lo_df) & (df <= hi_df))[0]
+        if cand.size < imp_t:
+            cand = np.nonzero(df > 0)[0]
+        q_imp = rng.choice(cand, size=(imp_nb * imp_batch,
+                                       imp_t)).astype(np.int32)
+        t0 = time.perf_counter()
+        imp_col = TextFieldColumn(
+            terms=[str(i) for i in range(vocab)],
+            tokens=np.zeros((1, 1), np.int32),
+            uterms=uterms, utf=utf, doc_len=lens_p,
+            df=df.astype(np.int64), total_tokens=int(lens.sum()))
+        icol = build_impact_column(
+            imp_col, df=df, doc_count=n_docs, avgdl=avgdl,
+            k1=p.k1, b=p.b, bits=imp_bits, block_rows=imp_rows,
+            block_budget=1 << 28)
+        imp_build_s = time.perf_counter() - t0
+        log(f"[bench] impact columns built in {imp_build_s:.1f}s "
+            f"(scale={icol.scale:.5f}, "
+            f"blocks={icol.qimp.shape[0] // icol.block_rows}, "
+            f"block_max={0 if icol.block_max is None else icol.block_max.nbytes} B)")
+        imp_cfg = _jx_imp.ImpactPlaneConfig(bits=imp_bits,
+                                            block_rows=imp_rows)
+        pack = _jx_imp._ImpactPack("t", imp_cfg, p.k1, p.b)
+        # the engine section released the kernel arrays' HBM — the leg
+        # carries its own uploads
+        di_ut = jax.device_put(jnp.asarray(uterms), dev)
+        di_utf = jax.device_put(jnp.asarray(utf), dev)
+        di_len = jax.device_put(jnp.asarray(lens_p), dev)
+        di_live = jax.device_put(jnp.asarray(live_np), dev)
+        d_qimp = jax.device_put(jnp.asarray(icol.qimp), dev)
+        d_bmax = jax.device_put(jnp.asarray(icol.block_max), dev)
+        n_blocks = icol.qimp.shape[0] // icol.block_rows
+        pack.segs.append({
+            "uterms": di_ut, "live": di_live, "qimp": d_qimp,
+            "block_max": d_bmax, "scale": float(icol.scale),
+            "host": imp_col, "np_docs": n_pad, "u": uterms.shape[1],
+            "doc_base": 0, "n_blocks": n_blocks})
+        pack.bases.append(0)
+        pack.total_blocks = n_blocks
+        pack.bound_per_term = icol.bound_per_term
+        pack.scales = jnp.asarray([icol.scale], jnp.float32)
+        term_rows = [[str(int(t)) for t in row] for row in q_imp]
+        ones = [1.0] * imp_batch
+        nocur = [None] * imp_batch
+
+        def imp_exact(bi):
+            qt = q_imp[bi * imp_batch:(bi + 1) * imp_batch]
+            s, d_ = bm25_topk_batch(
+                di_ut, di_utf, di_len, di_live,
+                jax.device_put(jnp.asarray(qt), dev),
+                jax.device_put(jnp.asarray(idf_table[qt]), dev),
+                np.float32(avgdl), imp_k, p.k1, p.b)
+            return np.asarray(s), np.asarray(d_)
+
+        def imp_eager(bi):
+            out = _jx_imp.run_impact_batch(
+                pack, term_rows[bi * imp_batch:(bi + 1) * imp_batch],
+                ones, nocur, k=imp_k)
+            return np.asarray(out["top_scores"]), \
+                np.asarray(out["top_docs"])
+
+        def imp_pruned(bi):
+            out = _jx_imp.run_impact_pruned(
+                pack, term_rows[bi * imp_batch:(bi + 1) * imp_batch],
+                ones, nocur, k=imp_k)
+            return {name: np.asarray(v) for name, v in out.items()}
+
+        def imp_ms(run):
+            t0 = time.perf_counter()
+            for bi in range(imp_nb):
+                run(bi)
+            return (time.perf_counter() - t0) * 1e3 / imp_nb
+
+        imp_exact(0)                     # warm: one compile per lane,
+        imp_eager(0)                     # OUTSIDE the steady-state
+        imp_pruned(0)                    # compile-counter window
+        js0 = _jx_imp.cache_stats()
+        exact_ms = imp_ms(imp_exact)
+        eager_ms = imp_ms(imp_eager)
+        pruned_ms = imp_ms(imp_pruned)
+        js1 = _jx_imp.cache_stats()
+        steady_compiles = js1["misses"] - js0["misses"]
+        # parity: eager vs exact (rank/id with quantization-tie
+        # tolerance; scores within the documented bound), pruned vs
+        # eager EXACT (ids + bit-equal scores)
+        es, ed = imp_exact(0)
+        gs, gd = imp_eager(0)
+        pr = imp_pruned(0)
+        imp_parity = True
+        imp_rank_identical = True
+        tol = pack.bound_per_term * imp_t + 1e-4
+        for qi in range(imp_batch):
+            imp_rank_identical &= (
+                list(gd[qi]) == list(ed[qi]))
+            # exact-scorer reference for THIS query: every returned doc
+            # must score within the quantization bound of its exact
+            # score AND be a true top-k member up to bound-sized ties
+            qrow = q_imp[qi]
+            ref = np.zeros(n_docs, np.float32)
+            for t_ in qrow:
+                col_ = mat.getcol(int(t_))
+                ref[col_.indices] += idf_table[int(t_)] * col_.data
+            kth = float(np.partition(ref, -imp_k)[-imp_k]) \
+                if n_docs > imp_k else float(ref.min())
+            for d_, s_ in zip(gd[qi], gs[qi]):
+                if d_ < 0:
+                    continue
+                if d_ >= n_docs or abs(float(s_) - ref[d_]) > tol:
+                    log(f"[bench] impact q{qi}: doc {d_} score "
+                        f"{s_:.4f} vs exact {ref[min(d_, n_docs-1)]:.4f}"
+                        f" off by > bound {tol:.4f}")
+                    imp_parity = False
+                elif ref[d_] < kth - tol:
+                    log(f"[bench] impact q{qi}: doc {d_} is not a "
+                        f"top-{imp_k} member (exact {ref[d_]:.4f} < "
+                        f"kth {kth:.4f} - bound)")
+                    imp_parity = False
+        if not imp_rank_identical:
+            log("[bench] impact-eager rank order differs from exact "
+                "somewhere (quantization ties) — member/score parity "
+                f"{'held' if imp_parity else 'FAILED'}")
+        pruned_identical = bool(
+            np.array_equal(pr["top_docs"], gd)
+            and np.array_equal(pr["top_scores"], gs))
+        scored = skipped = 0
+        for bi in range(imp_nb):
+            out = imp_pruned(bi)
+            scored += int(out["blocks_scored"].sum())
+            skipped += int(out["blocks_skipped"].sum())
+        total_blk = scored + skipped
+        # expected-work model (ROOFLINE "block-max" section): a block
+        # with NO query term has bound 0 and always skips, so the
+        # occupied-block union is the model's ceiling on effective work;
+        # theta-pruning trims the low-bound tail below it
+        p_t = 1.0 - (1.0 - df[q_imp].astype(np.float64)
+                     / max(n_docs, 1)) ** imp_rows
+        pred_occ = float(np.mean(1.0 - np.prod(1.0 - p_t, axis=1)))
+        imp_record = {
+            "n_docs": n_docs, "k": imp_k, "terms": imp_t,
+            "batch": imp_batch, "block_rows": imp_rows,
+            "blocks_total": n_blocks,
+            "impact_build_s": round(imp_build_s, 2),
+            "impact_bytes": int(icol.qimp.nbytes),
+            "block_max_bytes": 0 if icol.block_max is None
+            else int(icol.block_max.nbytes),
+            "exact_ms_per_batch": round(exact_ms, 2),
+            "impact_eager_ms_per_batch": round(eager_ms, 2),
+            "blockmax_ms_per_batch": round(pruned_ms, 2),
+            "eager_vs_exact_speedup": round(exact_ms
+                                            / max(eager_ms, 1e-9), 3),
+            "blocks_scored": scored,
+            "blocks_skipped": skipped,
+            "skip_ratio": round(skipped / max(total_blk, 1), 4),
+            "effective_work_ratio": round(scored / max(total_blk, 1),
+                                          4),
+            "predicted_occupied_frac": round(pred_occ, 4),
+            "steady_state_compiles": steady_compiles,
+            "bits": imp_bits,
+            "parity_eager_vs_exact": imp_parity,
+            "rank_identical_to_exact": imp_rank_identical,
+            "pruned_identical_to_eager": pruned_identical,
+            "bound_per_term": round(float(pack.bound_per_term), 6),
+        }
+        log(f"[bench] impact_pruning: exact {exact_ms:.1f} ms/batch, "
+            f"eager {eager_ms:.1f} ms/batch "
+            f"({imp_record['eager_vs_exact_speedup']}x), blockmax "
+            f"{pruned_ms:.1f} ms/batch, skip_ratio "
+            f"{imp_record['skip_ratio']} "
+            f"({skipped}/{total_blk} blocks), parity "
+            f"eager={imp_parity} pruned_identical={pruned_identical}")
+
     # ---- fault_recovery leg: degraded-mode serving under device faults ----
     # Steady-state QPS on the collective plane, QPS during an injected
     # device-fault burst (breaker open, fan-out/eager serving — requests
@@ -1583,6 +1783,7 @@ def main() -> int:
         "percolate": perc_record,
         "refresh_interleave": ri_record,
         "fault_recovery": fr_record,
+        "impact_pruning": imp_record,
     }
 
     # ---- MS-MARCO-scale headline (BASELINE.json's stated metric) -------
@@ -1606,7 +1807,8 @@ def main() -> int:
                          BENCH_CONFIGS="0", BENCH_CONFIG5="0",
                          BENCH_MESH="0", BENCH_STREAM="0",
                          BENCH_ORACLE="0", BENCH_HEADLINE_8M8="0",
-                         BENCH_PERCOLATE="0", BENCH_CPU_QUERIES="32")
+                         BENCH_PERCOLATE="0", BENCH_IMPACT="0",
+                         BENCH_CPU_QUERIES="32")
         log(f"[bench] headline corpus: {docs_8m8} docs msmarco "
             f"statistics (engine-only child run)")
         try:
@@ -1644,6 +1846,7 @@ def main() -> int:
                 "percolate": perc_record,
                 "refresh_interleave": ri_record,
                 "fault_recovery": fr_record,
+                "impact_pruning": imp_record,
                 "corpora": {
                     f"zipf_{n_docs // 1_000_000}m": {
                         k_: v_ for k_, v_ in record.items()

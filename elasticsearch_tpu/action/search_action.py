@@ -1287,6 +1287,14 @@ class SearchActions:
                     in ("false", "0"):
                 return None               # explicit opt-out
             indices.append(index)
+        if self._impact_preferred(indices, reqs, search_type):
+            # every target index opted into the impact plane and every
+            # body is an impact-scorable shape: decline the mesh so the
+            # fan-out's ShardSearcher serves it from the quantized
+            # impact columns (sublinear block-max work beats one more
+            # dense mesh dispatch)
+            self._note_plane_fallback(indices, "impact-preferred")
+            return None
         owners = []                       # (index, local shard id)
         for index in indices:
             nshards = index.meta.number_of_shards
@@ -1451,6 +1459,42 @@ class SearchActions:
             index.plane_stats.pop("degraded", None)
             index.note_plane_served(len(bodies))
         return responses
+
+    @staticmethod
+    def _impact_preferred(indices, reqs: list, search_type) -> bool:
+        """Should this batch leave the mesh to the impact lane? Only
+        when every index opted in (`index.search.impact_plane`), the
+        search type is a plain (non-DFS) one — impacts bake shard-local
+        idf — and every body resolves to an impact-scorable shape
+        against every index's mappings (the same execute.impact_terms
+        screen the shard-side admission applies)."""
+        from elasticsearch_tpu.search import jit_exec
+        from elasticsearch_tpu.search.execute import impact_terms
+        from elasticsearch_tpu.search.phase import _is_score_order
+        if search_type in ("dfs_query_then_fetch", "dfs_query_and_fetch"):
+            return False
+        cfgs = [jit_exec.impact_plane_config(index.name)
+                for index in indices]
+        if not all(cfgs):
+            return False
+        for req in reqs:
+            if (req.aggs or not _is_score_order(req.sort)
+                    or req.post_filter is not None
+                    or req.min_score is not None or req.suggest
+                    or req.terminate_after is not None
+                    or req.timeout_ms is not None or req.rescore
+                    or req.explain):
+                return False
+            if req.search_after is not None and \
+                    len(req.search_after) not in (1, 2):
+                return False              # only score-order cursors —
+                                          # pagination must stay in the
+                                          # quantized score domain
+            for index, cfg in zip(indices, cfgs):
+                if impact_terms(req.query, index.mapper_service,
+                                max_terms=cfg.max_terms) is None:
+                    return False
+        return True
 
     @staticmethod
     def _plane_precheck(index, reqs: list) -> bool:

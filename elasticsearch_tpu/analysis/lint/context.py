@@ -88,11 +88,20 @@ class LintConfig:
                           "*/search/percolator.py",
                           "*/ops/percolate.py")
     #: the site classes device_fault_point may name
-    #: (testing_disruption.DEVICE_FAULT_SITES + READER_UPLOAD_SITE)
+    #: (testing_disruption.DEVICE_FAULT_SITES + READER_UPLOAD_SITE;
+    #: impact-upload / blockmax-compose / pruning-dispatch are the
+    #: impact-ordered lane's device touchpoints)
     known_sites: tuple = ("dispatch", "compile", "upload", "compose",
-                          "plane-dispatch", "percolate", "reader-upload")
+                          "plane-dispatch", "percolate", "reader-upload",
+                          "impact-upload", "blockmax-compose",
+                          "pruning-dispatch")
     #: site classes that mark a LOOP as a dispatch loop (host-sync rule)
-    dispatch_sites: tuple = ("dispatch", "plane-dispatch", "percolate")
+    dispatch_sites: tuple = ("dispatch", "plane-dispatch", "percolate",
+                             "pruning-dispatch")
+    #: site classes that dominate a raw ``jax.device_put`` inside a seam
+    #: module (the upload/compose family of device touchpoints)
+    upload_sites: tuple = ("upload", "compose", "reader-upload",
+                           "impact-upload", "blockmax-compose")
     #: the seam entry points (calls routed through these are guarded)
     fault_point_names: tuple = ("device_fault_point",)
     seam_wrappers: tuple = ("seam_device_put", "seam_jit")

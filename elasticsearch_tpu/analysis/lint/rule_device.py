@@ -189,7 +189,7 @@ def check(ctx, cfg) -> list:
             continue                    # module-level kernel definition
         if kind == "block":
             continue                    # sync discipline is host-sync's rule
-        want = ("upload", "compose") if kind == "device_put" \
+        want = cfg.upload_sites if kind == "device_put" \
             else ("compile",)
         sites = _fault_sites_before(ctx, cfg, fn, node_for_line.lineno)
         if any(s in want for s in sites):

@@ -367,6 +367,15 @@ def device_reader_for(engine, view: SearcherView | None = None,
                     carry[k] += old_stats.get(k, 0)
         cached = DeviceReader(view, device=device, hbm_budget_bytes=budget)
         cached._accounted_bytes = new_bytes if bs is not None else 0
+        # impact-lane plumbing: the pack builder keys its device blocks
+        # by engine uuid (the PR 5 block-cache discipline) and charges
+        # them against the fielddata breaker; the close listener returns
+        # every cached block when this engine incarnation dies
+        cached.engine_uuid = engine.engine_uuid
+        cached.breaker_service = bs
+        from elasticsearch_tpu.parallel.mesh_engine import (
+            hook_engine_block_release)
+        hook_engine_block_release(engine)
         engine._device_reader_cache = cached
         return cached
 

@@ -121,6 +121,143 @@ def pad_to(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
+# ---------------------------------------------------------------------------
+# Impact-ordered index: quantized eager impacts + per-block maxima
+# (BM25S-style impact precompute, PAPERS.md; GPUSparse's block-organized
+# dense layout keeps the block tables accelerator-friendly).
+# ---------------------------------------------------------------------------
+
+#: default quantization width. uint8 keeps the per-term score error at
+#: max_impact/510 (~0.2%) AND makes the df-drift requantization threshold
+#: (one quantization step) wide enough that steady-state refreshes on a
+#: large corpus do not requantize resident segments.
+IMPACT_BITS = 8
+#: rows per block-max block — MUST be a power of two so it divides the
+#: pow2 doc_count_bucket row padding exactly
+IMPACT_BLOCK_ROWS = 2048
+#: block_max is a dense [B, V] table (GPUSparse layout); segments whose
+#: table would exceed this many cells ship impacts without block maxima
+#: (the eager impact lane still runs; only pruning is declined)
+IMPACT_BLOCK_BUDGET = 1 << 26
+
+
+@dataclass
+class ImpactColumn:
+    """Quantized BM25 impacts for one text field of one segment.
+
+    ``qimp[Np, U]`` mirrors the ``uterms`` layout: slot ``(d, u)`` holds
+    ``round(impact / scale)`` where ``impact = idf·tf·(k1+1)/(tf+norm)``
+    — the full per-(term, doc) BM25 contribution precomputed at
+    build time (BM25S), so query-time scoring is a dense compare +
+    integer gather/sum with NO per-doc float math. ``block_max[B, V]``
+    carries, per fixed row block, the max quantized impact of every
+    term — the WAND/block-max upper-bound table. Quantization error is
+    ≤ ``scale/2`` per matched term (``bound_per_term``).
+
+    idf (and avgdl) are READER-global at build time; the snapshot
+    fields let later refreshes measure cross-segment df drift and
+    requantize only when the drift exceeds one quantization step
+    (``drift_bound`` vs ``step_rel``)."""
+    qimp: np.ndarray                 # [Np, U] uint8/uint16
+    block_max: np.ndarray | None     # [B, V] same dtype (None: over budget)
+    scale: float                     # dequant factor: score = Σq · scale
+    bits: int
+    block_rows: int
+    doc_count: int                   # idf snapshot: reader doc count
+    avgdl: float                     # idf snapshot: reader avgdl
+    k1: float
+    b: float
+    quant_gen: int = 0               # bumped on requantization
+
+    @property
+    def step_rel(self) -> float:
+        """One quantization step as a fraction of the max impact."""
+        return 1.0 / ((1 << self.bits) - 1)
+
+    @property
+    def bound_per_term(self) -> float:
+        """Score-units error bound per matched query term (quantization
+        half-step plus the tolerated idf drift of one full step)."""
+        return self.scale * 0.5 + \
+            self.scale * ((1 << self.bits) - 1) * self.step_rel
+
+    def drift_bound(self, doc_count: int, avgdl: float) -> float:
+        """Conservative SCORE-UNITS bound on the impact drift since the
+        snapshot: ``2·|ln(N/N₀)|`` bounds any term's idf movement (df
+        can drift by at most the added/removed docs), ``|ln(a/a₀)|``
+        the length-norm movement, and ``k1+1`` bounds tfNorm — the
+        product bounds how far a precomputed impact can sit from its
+        current-statistics value. Compared against one quantization
+        step (``scale``) by the requant policy: drift within a step is
+        inside the documented ``bound_per_term`` envelope."""
+        import math
+        n0 = max(self.doc_count, 1)
+        a0 = max(self.avgdl, 1e-9)
+        # |ln(N/N₀)| bounds idf movement at FIXED df (d idf/dN = 1/(N+1));
+        # a rare term whose df itself jumps inside the growth window can
+        # exceed this between requants — that residual is part of the
+        # documented bound_per_term envelope (see ROOFLINE.md), and the
+        # corpus-growth trigger caps how long it can accumulate.
+        rel = abs(math.log(max(doc_count, 1) / n0)) + \
+            abs(math.log(max(avgdl, 1e-9) / a0))
+        return (self.k1 + 1.0) * rel
+
+
+def build_impact_column(col: TextFieldColumn, *, df: np.ndarray,
+                        doc_count: int, avgdl: float,
+                        k1: float = 1.2, b: float = 0.75,
+                        bits: int = IMPACT_BITS,
+                        block_rows: int = IMPACT_BLOCK_ROWS,
+                        block_budget: int = IMPACT_BLOCK_BUDGET,
+                        quant_gen: int = 0) -> ImpactColumn:
+    """Precompute one segment's quantized impact column + block maxima.
+
+    ``df`` is the [V] READER-global doc frequency of this segment's
+    terms (positional by term id) — the idf snapshot baked into the
+    impacts; ``doc_count``/``avgdl`` are the matching reader-global
+    statistics. Pure numpy, O(N·U): cheap enough that the PR 5
+    incremental data plane pays it once per NEW segment per refresh."""
+    if bits not in (8, 16):
+        raise ValueError(f"impact bits must be 8 or 16, got {bits}")
+    if block_rows & (block_rows - 1):
+        raise ValueError("impact block_rows must be a power of two")
+    dtype = np.uint8 if bits == 8 else np.uint16
+    qmax = (1 << bits) - 1
+    np_docs, _u = col.uterms.shape
+    v = int(np.asarray(df).shape[0])
+    n0 = max(int(doc_count), 1)
+    dfv = np.asarray(df, np.float64)
+    idf = np.log1p((n0 - dfv + 0.5) / (dfv + 0.5))
+    idf = np.where(dfv > 0, np.maximum(idf, 0.0), 0.0)
+    norm = k1 * (1.0 - b + b * np.asarray(col.doc_len, np.float64)
+                 / max(float(avgdl), 1e-9))
+    utf = np.asarray(col.utf, np.float64)
+    valid = np.asarray(col.uterms) >= 0
+    tfn = np.divide(utf * (k1 + 1.0), utf + norm[:, None],
+                    out=np.zeros_like(utf), where=valid)
+    imp = np.where(valid, idf[np.maximum(col.uterms, 0)] * tfn, 0.0)
+    mx = float(imp.max()) if imp.size else 0.0
+    scale = (mx / qmax) if mx > 0 else 1.0
+    qimp = np.clip(np.rint(imp / scale), 0, qmax).astype(dtype)
+    r = min(block_rows, np_docs)
+    n_blocks = max(np_docs // max(r, 1), 1)
+    block_max: np.ndarray | None
+    if n_blocks * v > block_budget:
+        block_max = None
+    else:
+        block_max = np.zeros((n_blocks, max(v, 1)), dtype)
+        ut = np.asarray(col.uterms)
+        for bi in range(n_blocks):
+            sl = slice(bi * r, (bi + 1) * r)
+            rows_t = ut[sl][valid[sl]]
+            rows_q = qimp[sl][valid[sl]]
+            np.maximum.at(block_max[bi], rows_t, rows_q)
+    return ImpactColumn(qimp=qimp, block_max=block_max, scale=scale,
+                        bits=bits, block_rows=r, doc_count=n0,
+                        avgdl=float(avgdl), k1=float(k1), b=float(b),
+                        quant_gen=quant_gen)
+
+
 def doc_count_bucket(n: int) -> int:
     """Bucketized row padding: bounds the number of distinct compiled shapes
     as segments grow (SURVEY.md §7 'Incrementality'). Geometric buckets:

@@ -70,6 +70,12 @@ def _plane_breaker_stats() -> dict:
     return jit_exec.plane_breaker.stats()
 
 
+def _impact_lane_stats(index_name: str) -> dict:
+    """One index's impact-lane rollup for _stats (lazy import)."""
+    from elasticsearch_tpu.search import jit_exec
+    return jit_exec.impact_index_stats(index_name)
+
+
 class ShardNotLocalError(Exception):
     """The target shard copy lives on another node — the action layer must
     route the operation over the transport."""
@@ -133,6 +139,12 @@ class IndexService:
         # one-program mesh path vs fallbacks to the RPC fan-out, by
         # reason — the observability the default flip ships with
         self.plane_stats: dict = {"served": 0, "fallback": {}}
+        # impact-ordered lane opt-in (`index.search.impact_plane`):
+        # registers this index's quantized-impact config with the
+        # compiled execution layer; absent/false leaves the exact
+        # scorer as the only scorer
+        from elasticsearch_tpu.search import jit_exec as _jit_exec
+        _jit_exec.configure_impact_plane(self.name, self.index_settings)
         # per-type indexing counters (ShardIndexingService typeStats)
         self.indexing_types: dict[str, int] = {}
         self.engines: dict[int, Engine] = {}
@@ -439,6 +451,10 @@ class IndexService:
                     # jit_exec's node-wide data_layer counters
                     "data_layer": dict(
                         self.plane_stats.get("data_layer", {}))},
+                # impact-ordered lane: admissions and block-sweep work
+                # attributed to THIS index (skip_ratio ≫ 0 is the
+                # per-index sublinearity evidence without the profiler)
+                "impact": _impact_lane_stats(self.name),
                 "groups": {
                     g: {"query_total": b["query_total"],
                         "query_time_in_millis": int(b["query_time_ms"]),

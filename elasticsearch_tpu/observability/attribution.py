@@ -88,6 +88,12 @@ def render_current(took_s: float | None = None) -> str | None:
         parts.append(f"admission[{labels['admission']}]")
     if "fallback" in labels:
         parts.append(f"fallback[{labels['fallback']}]")
+    if "impact_fallback" in labels:
+        parts.append(f"impact_fallback[{labels['impact_fallback']}]")
+    if "pruned" in labels:
+        # the block-max lane's per-request efficacy — pruned[N/M blocks]
+        # makes a query's skip ratio visible from the slow log alone
+        parts.append(f"pruned[{labels['pruned']}]")
     c = a["counts"]
     hits = c.get("hits", 0) + c.get("mesh_program_hits", 0) + \
         c.get("percolate_program_hits", 0)

@@ -1,0 +1,167 @@
+"""Impact-ordered scoring and block-max pruning kernels.
+
+The forward kernel (ops/lexical.py) recomputes the full BM25 term
+contribution — idf · tf·(k1+1)/(tf+norm) — for every (doc, query term)
+pair on every query. The impact lane precomputes that product at
+segment-build time into a quantized column (index/segment.py
+``ImpactColumn``), so query-time scoring collapses to a dense compare +
+integer gather/sum (BM25S, PAPERS.md): no per-doc float math, and the
+int sums dequantize with ONE multiply per doc.
+
+``pruned_segment_topk`` adds the asymptotic win: rows are organized in
+fixed blocks with a per-(block, term) quantized maximum
+(GPUSparse-style dense block table), blocks are swept in descending
+upper-bound order under ``lax.scan``, and a block whose bound cannot
+beat the running k-th score skips its compute AND its HBM reads through
+``lax.cond`` — WAND/block-max, expressed with static shapes so XLA
+stays happy. Queries run through ``lax.map`` (not vmap) so the cond
+remains a real branch instead of degrading to a select.
+
+Correctness contract (tests/test_impact_index.py): both lanes produce
+BIT-IDENTICAL scores (integer sums × the same scale), and pruning is
+conservative — a block is skipped only when its bound is strictly below
+the current k-th score (ties kept), so the pruned top-k equals the
+unpruned top-k exactly, including the (score desc, doc asc) tie order
+of the exact scorer's merge.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-jnp.inf)
+#: doc-id sort key for empty slots: past any real doc id so -inf ties
+#: never displace real candidates
+_PAD_DOC = jnp.int32(1 << 30)
+
+
+def impact_scores(uterms, qimp, qtids):
+    """Quantized eager scoring of one query against impact columns.
+
+    uterms: [N, U] i32 (-1 pad); qimp: [N, U] uint8/16 quantized
+    impacts; qtids: [T] i32 per-segment term ids (-1 absent/pad).
+
+    → (qsum [N] i32 — Σ of matched quantized impacts, exact integer
+    arithmetic; anyhit [N] bool — OR-semantics match mask, identical to
+    the exact kernel's msm1 mask).
+
+    Score and match count share ONE reduction per term: each entry
+    packs ``(q << 8) | 1`` so the sum carries Σq in the high bits and
+    the match count in the low byte — halving the [N, U] reduction
+    passes vs separate sum + any. Exact because uterms slots are UNIQUE
+    per doc (≤ 1 hit per term per doc → count ≤ T ≤ 255) and
+    Σq ≤ T·(2¹⁶−1) keeps the shifted sum far inside int32."""
+    n = uterms.shape[0]
+    enc = (qimp.astype(jnp.int32) << 8) + 1
+    acc = jnp.zeros(n, jnp.int32)
+    for t in range(qtids.shape[0]):   # T static: unrolled/fused by XLA
+        tid = qtids[t]
+        hit = (uterms == tid) & (tid >= 0)
+        acc = acc + jnp.where(hit, enc, 0).sum(axis=1)
+    return acc >> 8, (acc & 0xFF) > 0
+
+
+def block_bounds(block_max, qtids):
+    """Per-block integer upper bounds: Σ_t block_max[:, t] over the
+    query terms. Exact ≥ every in-block quantized score (per-term max
+    is an upper bound of per-term contribution; sums preserve it)."""
+    nb = block_max.shape[0]
+    ub = jnp.zeros(nb, jnp.int32)
+    for t in range(qtids.shape[0]):
+        tid = qtids[t]
+        col = jnp.take(block_max, jnp.maximum(tid, 0), axis=1)
+        ub = ub + jnp.where(tid >= 0, col.astype(jnp.int32), 0)
+    return ub
+
+
+def merge_topk_by_doc(scores_a, docs_a, scores_b, docs_b, k: int):
+    """Top-k of the concatenation by (score desc, doc id asc) — the
+    exact scorer's merge tie order, made explicit because block-sweep
+    candidates arrive out of doc order. Empty slots: (-inf, -1)."""
+    s = jnp.concatenate([scores_a, scores_b])
+    d = jnp.concatenate([docs_a, docs_b])
+    key_d = jnp.where(d >= 0, d, _PAD_DOC)
+    by_doc = jnp.argsort(key_d)                       # doc asc
+    by_score = jnp.argsort(-s[by_doc])                # stable: doc ties
+    sel = by_doc[by_score][:k]
+    ts = s[sel]
+    return ts, jnp.where(ts > NEG_INF, d[sel], -1)
+
+
+def eager_segment_topk(uterms, qimp, live, qtids, scale_boost, k: int,
+                       doc_base: int, cursor_s, cursor_d):
+    """One query × one segment, full (unpruned) impact scoring.
+
+    → (top_scores [k] f32, top_docs [k] i32 segment-LOCAL, count i32).
+    ``scale_boost`` = segment dequant scale × query boost (traced);
+    ``cursor_s``/``cursor_d`` implement the score-order search_after
+    continuation (pass +inf / -1 for no cursor)."""
+    from elasticsearch_tpu.ops import topk as topk_ops
+    n = uterms.shape[0]
+    qsum, anyhit = impact_scores(uterms, qimp, qtids)
+    sf = qsum.astype(jnp.float32) * scale_boost
+    gids = jnp.arange(n, dtype=jnp.int32) + doc_base
+    valid = anyhit & live & \
+        ((sf < cursor_s) | ((sf == cursor_s) & (gids > cursor_d)))
+    count = valid.sum(dtype=jnp.int32)
+    ts, td = topk_ops.top_k(sf, valid, min(k, n), 0)
+    return ts, td, count
+
+
+def pruned_segment_topk(carry, uterms, qimp, live, block_max, qtids,
+                        scale_boost, k: int, doc_base: int,
+                        cursor_s, cursor_d):
+    """One query's block-max sweep over one segment, threading the
+    running top-k across segments.
+
+    carry = (top_scores [k] f32, top_docs [k] i32 GLOBAL, scored i32,
+    skipped i32, matched i32). Blocks are visited in descending
+    upper-bound order; a block runs only when its bound can still reach
+    the k-th score (``ub >= θ`` — non-strict, so boundary ties survive)
+    AND some query term occurs in it at all (``ub > 0``). The skipped
+    branch touches none of the block's rows (lax.cond): on real
+    hardware that is skipped compute AND skipped HBM reads."""
+    np_docs, u = uterms.shape
+    n_blocks = block_max.shape[0]
+    r = np_docs // n_blocks
+    ub_i = block_bounds(block_max, qtids)
+    ub_f = ub_i.astype(jnp.float32) * scale_boost
+    order = jnp.argsort(-ub_f)
+
+    def step(c, bi):
+        ts, td, n_scored, n_skipped, n_matched = c
+        theta = ts[k - 1]
+        run = (ub_i[bi] > 0) & (ub_f[bi] >= theta)
+
+        def hot(c):
+            ts, td, n_scored, n_skipped, n_matched = c
+            ru = jax.lax.dynamic_slice(uterms, (bi * r, 0), (r, u))
+            rq = jax.lax.dynamic_slice(qimp, (bi * r, 0), (r, u))
+            rl = jax.lax.dynamic_slice(live, (bi * r,), (r,))
+            qsum, anyhit = impact_scores(ru, rq, qtids)
+            sf = qsum.astype(jnp.float32) * scale_boost
+            docs = bi * r + jnp.arange(r, dtype=jnp.int32) + doc_base
+            valid = anyhit & rl & \
+                ((sf < cursor_s) | ((sf == cursor_s) & (docs > cursor_d)))
+            sf = jnp.where(valid, sf, NEG_INF)
+            docs = jnp.where(valid, docs, -1)
+            ts2, td2 = merge_topk_by_doc(ts, td, sf, docs, k)
+            return (ts2, td2, n_scored + 1, n_skipped,
+                    n_matched + valid.sum(dtype=jnp.int32))
+
+        def cold(c):
+            ts, td, n_scored, n_skipped, n_matched = c
+            return (ts, td, n_scored, n_skipped + 1, n_matched)
+
+        return jax.lax.cond(run, hot, cold, c), None
+
+    carry, _ = jax.lax.scan(step, carry, order)
+    return carry
+
+
+def pruned_carry_init(k: int):
+    """Fresh cross-segment carry for :func:`pruned_segment_topk`."""
+    return (jnp.full(k, NEG_INF, jnp.float32),
+            jnp.full(k, -1, jnp.int32),
+            jnp.int32(0), jnp.int32(0), jnp.int32(0))

@@ -275,6 +275,55 @@ class _DeviceBlockCache:
         return blk.template, blk.arrays, blk.extrema, col_bytes, \
             mask_bytes, 0
 
+    def fetch_aux(self, key: tuple, build_np, breaker_service, label: str):
+        """Auxiliary per-segment device arrays (the impact lane's
+        quantized columns + block maxima) in the SAME LRU as the column
+        blocks — same keying discipline (engine uuid, block uid, sig),
+        same OneShotCharge accounting, same prune/release/evict sweeps.
+        ``build_np`` is called only on miss and returns the host arrays.
+        → (device arrays, uploaded bytes, reused bytes). The device
+        transfer itself happens at the CALLER'S seam site (the caller
+        passes already-uploaded arrays via the build closure would hide
+        the seam — instead the closure returns host arrays and the
+        upload happens here under the impact-upload site)."""
+        from elasticsearch_tpu.search import jit_exec
+        with self._lock:
+            blk = self._lru.get(key)
+            if blk is not None:
+                self._lru.move_to_end(key)
+                return blk.arrays, 0, blk.col_bytes
+        flat_np = [np.ascontiguousarray(a) for a in build_np()
+                   if a is not None]
+        with device_span("impact-upload") as dsp:
+            jit_exec.device_fault_point("impact-upload")
+            arrays = [jax.device_put(a) for a in flat_np]
+            dsp.set(bytes=int(sum(a.nbytes for a in flat_np)),
+                    kind="impact-block")
+        col_bytes = int(sum(a.nbytes for a in flat_np))
+        charge = None
+        if breaker_service is not None:
+            from elasticsearch_tpu.common.breaker import OneShotCharge
+            charge = OneShotCharge(breaker_service, col_bytes).charge(
+                label)
+        blk = _Block(key, None, arrays, np.zeros(0, bool), col_bytes,
+                     {}, charge)
+        evicted = []
+        with self._lock:
+            cur = self._lru.get(key)
+            if cur is not None:
+                self._lru.move_to_end(key)
+                if charge is not None:
+                    charge.release()
+                blk = cur
+            else:
+                self._lru[key] = blk
+                while len(self._lru) > self.cap:
+                    evicted.append(self._lru.popitem(last=False)[1])
+        for old in evicted:
+            if old.charge is not None:
+                old.charge.release()
+        return blk.arrays, col_bytes, 0
+
     def prune(self, engine_uuid: str, live_uids: set) -> int:
         """Release blocks of this engine whose segment left the reader
         view (merged away / superseded). Empty fillers and layout
@@ -364,6 +413,38 @@ def evict_cold_blocks(fraction: float = 0.5) -> int:
     """Module entry for the HBM-OOM response (jit_exec.note_device_error):
     evict the coldest `fraction` of device blocks → bytes released."""
     return _block_cache.evict_cold(fraction)
+
+
+def fetch_impact_block(engine_uuid: str, block_uid: int, field: str,
+                       icol, breaker_service):
+    """One segment's impact arrays (quantized column + block maxima),
+    device-resident through the per-segment block cache — the PR 5
+    discipline: a refresh uploads impact bytes ONLY for segments whose
+    block_uid (or quantization generation, after a df-drift requant) is
+    new; resident blocks reuse outright. → (qimp device array,
+    block_max device array | None, uploaded bytes, reused bytes)."""
+    has_bm = icol.block_max is not None
+    key = (engine_uuid, block_uid,
+           ("impact", field, icol.bits, icol.block_rows, icol.quant_gen,
+            has_bm))
+    arrays, up, re = _block_cache.fetch_aux(
+        key, lambda: [icol.qimp, icol.block_max], breaker_service,
+        f"impact block [{engine_uuid[:8]}]")
+    if has_bm:
+        return arrays[0], arrays[1], up, re
+    return arrays[0], None, up, re
+
+
+def hook_engine_block_release(engine) -> None:
+    """Install the engine-close listener that returns every cached
+    device block (columns AND impact blocks) charged against this
+    engine incarnation — shared by the mesh searcher build and the
+    impact pack builder so neither path can strand fielddata bytes."""
+    if not getattr(engine, "_block_cache_hooked", False):
+        hook = _EngineBlocksRelease(engine.engine_uuid)
+        engine.__dict__.setdefault("_close_listeners",
+                                   []).append(hook.release)
+        engine._block_cache_hooked = True
 
 
 class _EngineBlocksRelease:
@@ -746,11 +827,7 @@ class MeshEngineSearcher:
             # cached device blocks return their fielddata budget (shard
             # relocation / index teardown must not strand breaker bytes)
             for e in engines:
-                if not getattr(e, "_block_cache_hooked", False):
-                    hook = _EngineBlocksRelease(e.engine_uuid)
-                    e.__dict__.setdefault("_close_listeners",
-                                          []).append(hook.release)
-                    e._block_cache_hooked = True
+                hook_engine_block_release(e)
         # ---- DATA layer build: per-segment device blocks ---------------
         # templates[s][j]: host-side DeviceSegment (numpy arrays, real
         # host column dicts) used for resolution; shard 0's templates also

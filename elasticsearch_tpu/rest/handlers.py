@@ -3361,6 +3361,12 @@ class Handlers:
                 "(background builds gave up) / breaker-open (device "
                 "unhealthy, fan-out serving) / off (opted out)",
                 default=False),
+            Col("impact.blocks", ("ib", "impactBlocks"),
+                "impact-lane blocks evaluated (scored+skipped)",
+                right=True, default=False),
+            Col("impact.skip_ratio", ("isr", "impactSkipRatio"),
+                "fraction of impact blocks the block-max sweep skipped",
+                right=True, default=False),
         ])
         from elasticsearch_tpu.search import jit_exec as _jx
         breaker_open = _jx.plane_breaker.stats()["state"] != "closed"
@@ -3379,6 +3385,7 @@ class Handlers:
                         deleted += seg["num_docs"] - seg["live_docs"]
             from elasticsearch_tpu.search.percolator import registry_stats
             perc = registry_stats(n)
+            imp = _jx.impact_index_stats(n)
             if svc is not None and str(svc.index_settings.get(
                     "index.search.collective_plane", "true")).lower() \
                     in ("false", "0"):
@@ -3406,7 +3413,10 @@ class Handlers:
                      "percolate.total": (perc or {}).get("count", 0),
                      "percolate.time":
                          f"{(perc or {}).get('time_ms', 0) / 1000:.1f}s",
-                     "plane.health": plane_health})
+                     "plane.health": plane_health,
+                     "impact.blocks": imp["blocks_scored"] +
+                     imp["blocks_skipped"],
+                     "impact.skip_ratio": f"{imp['skip_ratio']:.2f}"})
         return t.render(req)
 
     def cat_master(self, req: RestRequest):
@@ -3702,6 +3712,10 @@ class Handlers:
             ("merges.total_docs", "docs merged"),
             ("merges.total_size", "size merged"),
             ("merges.total_time", "time spent in merges"),
+            ("impact.blocks", "impact-lane blocks evaluated "
+             "(scored+skipped)"),
+            ("impact.skip_ratio", "fraction of impact blocks the "
+             "block-max sweep skipped"),
             ("percolate.current", "number of current percolations"),
             ("percolate.memory_size", "memory used by percolator"),
             ("percolate.queries", "number of registered percolation "

@@ -132,6 +132,58 @@ class ExecutionContext:
     index_name: str | None = None
 
 
+def impact_terms(query: "q.Query", mapper_service,
+                 max_terms: int = 16) -> tuple | None:
+    """Impact-lane eligibility: can this query be scored from the
+    quantized per-(term, doc) impact columns alone?
+
+    The precomputed impacts bake idf·tfNorm for default-BM25
+    OR-semantics term scoring — exactly the disjunctive match/term
+    shapes, nothing else. → (field, analyzed terms, boost) when
+    eligible, None otherwise (the exact scorer stays the default: any
+    shape the quantized path can't reproduce — operators, msm,
+    alternative similarities, compounds, functions — declines here).
+    Mapping-only (no segment needed) so the collective-plane admission
+    can consult the same screen."""
+    t = type(query).__name__
+    if t == "TermQuery":
+        fm = mapper_service.field_mapper(query.field)
+        if fm is None or getattr(fm, "kind", None) != "text":
+            return None
+        # term-on-text scores like a single-term match through the
+        # keyword analyzer (the _res_TermQuery rewrite)
+        query = q.MatchQuery(field=query.field, text=str(query.value),
+                             analyzer="keyword", boost=query.boost)
+        t = "MatchQuery"
+    if t != "MatchQuery":
+        return None
+    field = query.field
+    if field in ("*", "_all"):
+        return None
+    fm = mapper_service.field_mapper(field)
+    if fm is None or getattr(fm, "kind", None) != "text":
+        return None
+    sim = fm.params.get("similarity") or \
+        getattr(mapper_service, "default_similarity", None)
+    if str(sim or "BM25").lower() not in ("bm25",):
+        return None
+    if query.operator == "and" or \
+            query.minimum_should_match not in (None, 1):
+        return None
+    if not (query.boost >= 0):            # negative boost flips order —
+        return None                       # block bounds would invert
+    if query.analyzer:
+        analyzer = mapper_service.analysis.get(query.analyzer)
+    else:
+        analyzer = fm.search_analyzer
+    if analyzer is None:
+        return None
+    terms = [tok.term for tok in analyzer.analyze(query.text)]
+    if not terms or len(terms) > max_terms:
+        return None
+    return field, terms, float(query.boost)
+
+
 def fuzzy_kmax(value: str, fuzziness) -> int:
     """The AUTO edit-distance ladder (FuzzyQuery defaults): 0 below 3
     chars, 1 below 6, else 2."""

@@ -314,10 +314,25 @@ _stats = {"hits": 0, "misses": 0, "fallbacks": 0,
           # to the fan-out/eager path (zero device dispatches), and
           # HBM-OOM responses (cold-block evictions before degrading)
           "breaker_open_skips": 0, "oom_evictions": 0,
-          "oom_bytes_evicted": 0}
+          "oom_bytes_evicted": 0,
+          # impact-ordered lane: requests admitted to quantized-impact
+          # scoring, block-max sweep work accounting (scored vs skipped
+          # blocks — the effective-work/sublinearity evidence), and
+          # impact requantizations forced by cross-segment df drift
+          # (steady-state refreshes must NOT bump this)
+          "impact_admissions": 0, "impact_blocks_scored": 0,
+          "impact_blocks_skipped": 0, "impact_requant_refreshes": 0}
 #: why searches left the compiled/collective path, by label
 #: (ineligible-shape / parse-error / refresh-race / device-error / …)
 _fallback_reasons: dict[str, int] = {}
+#: why impact-lane admission declined, by label — only bumped for
+#: indices that OPTED IN to the impact plane (the exact scorer is the
+#: default; a disabled index never logs an impact fallback)
+_impact_fallback_reasons: dict[str, int] = {}
+#: per-INDEX impact-lane accounting (admissions, blocks scored/skipped)
+#: — feeds the per-index _stats "search.impact" section and the
+#: _cat/indices impact.{blocks,skip_ratio} columns
+_impact_index_stats: dict[str, dict] = {}
 
 # Per-NODE attribution of the rollups above: every in-process node
 # shares this module, so without node keying a two-node cluster test
@@ -351,7 +366,12 @@ def _bump(key: str, n: int = 1) -> None:
 _data_layer = {"bytes_uploaded": 0, "bytes_reused": 0,
                "col_bytes_uploaded": 0, "mask_bytes_uploaded": 0,
                "incremental_refreshes": 0, "full_rebuilds": 0,
-               "mask_only_refreshes": 0}
+               "mask_only_refreshes": 0,
+               # impact-column traffic rides the same per-segment block
+               # cache: a refresh uploads impact bytes ONLY for segments
+               # that are new (or requantized) — resident segments count
+               # under impact_bytes_reused (tier-1 guard)
+               "impact_bytes_uploaded": 0, "impact_bytes_reused": 0}
 
 
 def cache_stats(node_id: str | None = None) -> dict:
@@ -367,6 +387,7 @@ def cache_stats(node_id: str | None = None) -> dict:
         return out
     with _cache_lock:
         out = {**_stats, "fallback_reasons": dict(_fallback_reasons),
+               "impact_fallback_reasons": dict(_impact_fallback_reasons),
                "data_layer": dict(_data_layer)}
     out["plane_breaker"] = plane_breaker.stats()
     return out
@@ -437,13 +458,10 @@ def note_fallback(exc: BaseException | None = None,
 def clear_cache() -> None:
     with _cache_lock:
         _cache.clear()
-        _stats.update(hits=0, misses=0, fallbacks=0,
-                      mesh_program_hits=0, mesh_program_misses=0,
-                      plane_fallbacks=0,
-                      percolate_program_hits=0, percolate_program_misses=0,
-                      breaker_open_skips=0, oom_evictions=0,
-                      oom_bytes_evicted=0)
+        _stats.update({k: 0 for k in _stats})
         _fallback_reasons.clear()
+        _impact_fallback_reasons.clear()
+        _impact_index_stats.clear()
         _data_layer.update({k: 0 for k in _data_layer})
         _node_stats.clear()
         _node_fallback_reasons.clear()
@@ -1174,3 +1192,399 @@ def run_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,
     if plan["b_pad"] != b:
         outs = {name: v[:b] for name, v in outs.items()}
     return outs
+
+
+# ---------------------------------------------------------------------------
+# Impact-ordered lane: quantized eager impacts + block-max pruning
+#
+# The exact forward kernel recomputes idf·tfNorm per (doc, term) on every
+# query. The impact lane reads the quantized per-(term, doc) impacts
+# precomputed at segment-build time (index/segment.build_impact_column,
+# BM25S-style) — a dense compare + integer sum — and, with block maxima,
+# sweeps row blocks in descending upper-bound order skipping blocks that
+# cannot reach the running k-th score (ops/blockmax.py). Admission is
+# opt-in per index (`index.search.impact_plane`): quantized scores agree
+# with the exact scorer only within the documented quantization bound,
+# so the exact scorer stays the default.
+#
+# Device residency rides the PR 5 per-segment block cache
+# (mesh_engine._DeviceBlockCache.fetch_aux): a refresh uploads impact
+# bytes only for NEW (or drift-requantized) segments, counter-verified
+# via data_layer.impact_bytes_{uploaded,reused}.
+# ---------------------------------------------------------------------------
+
+from dataclasses import dataclass as _dataclass
+
+
+@_dataclass(frozen=True)
+class ImpactPlaneConfig:
+    """Per-index impact-lane knobs (index.search.impact.* settings)."""
+    bits: int = 8
+    block_rows: int = 2048
+    prune: bool = True          # block-max sweep when totals not tracked
+    max_terms: int = 16         # T cap (programs unroll per term)
+
+
+#: index name → config for indices that opted in (None = lane off)
+_impact_configs: dict[str, ImpactPlaneConfig] = {}
+
+
+def configure_impact_plane(index_name: str, settings=None) -> None:
+    """Register (or with the setting off, clear) an index's impact-lane
+    config from its settings. Called at IndexService construction; tests
+    call it directly with a dict."""
+    get = settings.get if settings is not None else (lambda *_: None)
+    raw = get("index.search.impact_plane", "false")
+    if str(raw).lower() not in ("true", "1"):
+        _impact_configs.pop(index_name, None)
+        return
+    from elasticsearch_tpu.index.segment import (IMPACT_BITS,
+                                                 IMPACT_BLOCK_ROWS)
+    _impact_configs[index_name] = ImpactPlaneConfig(
+        bits=int(get("index.search.impact.bits", IMPACT_BITS) or
+                 IMPACT_BITS),
+        block_rows=int(get("index.search.impact.block_rows",
+                           IMPACT_BLOCK_ROWS) or IMPACT_BLOCK_ROWS),
+        prune=str(get("index.search.impact.prune", "true")).lower()
+        in ("true", "1"))
+
+
+def impact_plane_config(index_name: str | None) -> ImpactPlaneConfig | None:
+    if index_name is None:
+        return None
+    return _impact_configs.get(index_name)
+
+
+def note_impact_fallback(reason: str) -> None:
+    """One impact-lane admission decline (the request proceeds on the
+    exact scorer), reason-labeled like note_plane_fallback."""
+    _attribution.label("impact_fallback", reason)
+    with _cache_lock:
+        _impact_fallback_reasons[reason] = \
+            _impact_fallback_reasons.get(reason, 0) + 1
+
+
+def note_impact_served(index_name: str | None, n_requests: int,
+                       blocks_scored: int, blocks_skipped: int) -> None:
+    """`n_requests` served by the impact lane plus its block-sweep work
+    accounting (eager-lane requests count every block as scored)."""
+    with _cache_lock:
+        _bump("impact_admissions", n_requests)
+        _bump("impact_blocks_scored", int(blocks_scored))
+        _bump("impact_blocks_skipped", int(blocks_skipped))
+        if index_name:
+            bucket = _impact_index_stats.setdefault(
+                index_name, {"admissions": 0, "blocks_scored": 0,
+                             "blocks_skipped": 0})
+            bucket["admissions"] += n_requests
+            bucket["blocks_scored"] += int(blocks_scored)
+            bucket["blocks_skipped"] += int(blocks_skipped)
+
+
+def impact_index_stats(index_name: str) -> dict:
+    """One index's impact-lane rollup (zeros when never admitted)."""
+    with _cache_lock:
+        bucket = dict(_impact_index_stats.get(index_name, {}))
+    out = {"admissions": bucket.get("admissions", 0),
+           "blocks_scored": bucket.get("blocks_scored", 0),
+           "blocks_skipped": bucket.get("blocks_skipped", 0)}
+    total = out["blocks_scored"] + out["blocks_skipped"]
+    out["skip_ratio"] = round(out["blocks_skipped"] / total, 4) \
+        if total else 0.0
+    return out
+
+
+class _ImpactPack:
+    """One reader generation's device-resident impact pack for a field:
+    per-segment (uterms, qimp, live[, block_max]) device arrays plus the
+    host ImpactColumns (term dictionaries + quantization metadata)."""
+
+    __slots__ = ("field", "cfg", "k1", "b", "segs", "bases", "can_prune",
+                 "total_blocks", "bound_per_term", "scales")
+
+    def __init__(self, field, cfg, k1, b):
+        self.field = field
+        self.cfg = cfg
+        self.k1, self.b = k1, b
+        self.segs = []          # dicts per segment (see impact_pack_for)
+        self.bases = []
+        self.can_prune = True
+        self.total_blocks = 0
+        self.bound_per_term = 0.0
+        self.scales = None      # [S] f32 device constant (compose step)
+
+    def sig(self) -> tuple:
+        out = [self.field, self.cfg.bits, float(self.k1), float(self.b)]
+        for s in self.segs:
+            bm = s["block_max"]
+            out.append((s["np_docs"], s["u"], str(s["qimp"].dtype),
+                        None if bm is None else tuple(bm.shape),
+                        s["doc_base"]))
+        return tuple(out)
+
+
+def _impact_global_df(reader, field: str, col) -> "np.ndarray":
+    """READER-global df for one segment's term dictionary: the segment's
+    own df plus every sibling segment's count for the same term string —
+    the cross-segment aggregation the exact scorer does per query term,
+    done once per impact build over the whole vocabulary."""
+    df = np.asarray(col.df, np.int64).copy()
+    for other in reader.segments:
+        ocol = other.seg.text_fields.get(field)
+        if ocol is None or ocol is col:
+            continue
+        odf = np.asarray(ocol.df)
+        for i, term in enumerate(col.terms):
+            tid = ocol.term_index.get(term, -1)
+            if tid >= 0:
+                df[i] += int(odf[tid])
+    return df
+
+
+def _host_impact_column(reader, dseg, field: str, cfg: ImpactPlaneConfig,
+                        k1: float, b: float, doc_count: int,
+                        avgdl: float):
+    """The host-side quantized column for one segment, cached ON the
+    immutable host Segment (it survives reader swaps, so unchanged
+    segments never requantize). A cached column is reused while the
+    reader's statistics have drifted less than one quantization step
+    from its snapshot; beyond that the segment requantizes against
+    fresh statistics (impact_requant_refreshes counts these — the
+    tier-1 guard proves steady-state refreshes stay at zero)."""
+    from elasticsearch_tpu.index.segment import build_impact_column
+    host = dseg.seg
+    col = host.text_fields.get(field)
+    if col is None:
+        return None
+    cache = host.__dict__.setdefault("_impact_cache", {})
+    ckey = (field, cfg.bits, cfg.block_rows, float(k1), float(b))
+    icol = cache.get(ckey)
+    if icol is not None:
+        # requantize only when the statistics drift could move an
+        # impact by more than ONE quantization step (score units) —
+        # within a step the error stays inside bound_per_term
+        if icol.drift_bound(doc_count, avgdl) <= icol.scale:
+            return icol
+        with _cache_lock:
+            _bump("impact_requant_refreshes")
+        quant_gen = icol.quant_gen + 1
+    else:
+        quant_gen = 0
+    icol = build_impact_column(
+        col, df=_impact_global_df(reader, field, col),
+        doc_count=doc_count, avgdl=avgdl, k1=k1, b=b, bits=cfg.bits,
+        block_rows=cfg.block_rows, quant_gen=quant_gen)
+    cache[ckey] = icol
+    return icol
+
+
+def impact_pack_for(reader, field: str, cfg: ImpactPlaneConfig,
+                    k1: float = 1.2, b: float = 0.75) -> _ImpactPack | None:
+    """Build (or fetch the cached) impact pack for one reader generation.
+
+    Device arrays come from the PR 5 per-segment block cache keyed by
+    (engine uuid, block_uid, impact signature): unchanged segments reuse
+    their resident impact blocks outright — a refresh that adds one
+    segment uploads impact bytes only for it (data_layer.impact_bytes_*
+    counters prove it). Returns None when no segment carries the field.
+    """
+    packs = reader.__dict__.setdefault("_impact_packs", {})
+    pkey = (field, cfg.bits, cfg.block_rows, float(k1), float(b))
+    pack = packs.get(pkey)
+    if pack is not None:
+        return pack
+    st = reader.text_stats(field)
+    if st.docs_with_field <= 0:
+        return None
+    from elasticsearch_tpu.parallel.mesh_engine import (
+        fetch_impact_block)
+    engine_uuid = getattr(reader, "engine_uuid", None) or \
+        f"reader:{id(reader)}"
+    breaker_service = getattr(reader, "breaker_service", None)
+    pack = _ImpactPack(field, cfg, k1, b)
+    uploaded = reused = 0
+    for dseg in reader.segments:
+        icol = _host_impact_column(reader, dseg, field, cfg, k1, b,
+                                   st.doc_count, st.avgdl)
+        if icol is None:
+            continue
+        dev_qimp, dev_bm, up, re = fetch_impact_block(
+            engine_uuid, dseg.seg.block_uid, field, icol,
+            breaker_service)
+        uploaded += up
+        reused += re
+        n_blocks = icol.qimp.shape[0] // icol.block_rows
+        pack.segs.append({
+            "uterms": _fetch(dseg, dseg.text[field], "uterms"),
+            "live": dseg.live,
+            "qimp": dev_qimp, "block_max": dev_bm,
+            "scale": float(icol.scale), "col": icol,
+            "host": dseg.seg.text_fields[field],
+            "np_docs": int(icol.qimp.shape[0]),
+            "u": int(icol.qimp.shape[1]),
+            "doc_base": int(dseg.doc_base),
+            "n_blocks": int(n_blocks),
+        })
+        pack.bases.append(int(dseg.doc_base))
+        pack.total_blocks += int(n_blocks)
+        pack.bound_per_term = max(pack.bound_per_term,
+                                  icol.bound_per_term)
+        if dev_bm is None:
+            pack.can_prune = False
+    if not pack.segs:
+        return None
+    note_data_blocks_impact(uploaded, reused)
+    # compose step: the pack-level device constants (per-segment dequant
+    # scales) the compiled lanes take as inputs — the one device
+    # placement the pack itself performs, seamed + span-scoped so the
+    # breaker/tracer see it like every other compose
+    with device_span("blockmax-compose"):
+        device_fault_point("blockmax-compose")
+        pack.scales = jnp.asarray([s["scale"] for s in pack.segs],
+                                  jnp.float32)
+    packs[pkey] = pack
+    return pack
+
+
+def note_data_blocks_impact(uploaded: int, reused: int) -> None:
+    """Impact-column block-cache traffic from one pack build."""
+    with _cache_lock:
+        _data_layer["impact_bytes_uploaded"] += int(uploaded)
+        _data_layer["impact_bytes_reused"] += int(reused)
+
+
+def _impact_query_inputs(pack: _ImpactPack, term_lists: list,
+                         boosts: list, cursors: list):
+    """Pack B queries' per-segment term ids / boosts / cursors into the
+    lanes' input arrays (batch axis padded to a power of two, term axis
+    padded to a shared pow2 bucket so varying term counts share
+    programs)."""
+    from elasticsearch_tpu.search.batching import pow2_bucket
+    b = len(term_lists)
+    b_pad = pow2_bucket(b)
+    t_pad = pow2_bucket(max(max(len(t) for t in term_lists), 1))
+    rows = term_lists + [term_lists[-1]] * (b_pad - b)
+    boosts_p = list(boosts) + [boosts[-1]] * (b_pad - b)
+    cursors_p = list(cursors) + [cursors[-1]] * (b_pad - b)
+    qtids = []
+    for s in pack.segs:
+        tidx = s["host"].term_index
+        arr = np.full((b_pad, t_pad), -1, np.int32)
+        for bi, terms in enumerate(rows):
+            for ti, term in enumerate(terms):
+                arr[bi, ti] = tidx.get(term, -1)
+        qtids.append(jnp.asarray(arr))
+    cs = jnp.asarray([np.float32(c[0]) if c is not None else
+                      np.float32(np.inf) for c in cursors_p])
+    cd = jnp.asarray([np.int32(c[1]) if c is not None else -1
+                      for c in cursors_p], jnp.int32)
+    return qtids, jnp.asarray(boosts_p, jnp.float32), cs, cd, b_pad, t_pad
+
+
+def run_impact_batch(pack: _ImpactPack, term_lists: list, boosts: list,
+                     cursors: list, *, k: int) -> dict:
+    """Eager quantized-impact scoring of B queries over the whole
+    reader as ONE compiled program: per-segment dense compare + integer
+    gather/sum over the precomputed impacts (no per-doc BM25 float
+    math), per-query per-segment top-k, cross-segment merge — the same
+    output contract as run_reader_batch's unpacked mode. Counts are
+    EXACT (the anyhit mask matches the forward kernel's msm1 mask)."""
+    from elasticsearch_tpu.ops import blockmax as bm_ops
+    from elasticsearch_tpu.ops import topk as topk_ops
+    b = len(term_lists)
+    k_static = int(k)
+    qtids, boosts_a, cs, cd, b_pad, t_pad = _impact_query_inputs(
+        pack, term_lists, boosts, cursors)
+    bases = tuple(pack.bases)
+    key = ("impact-eager", pack.sig(), k_static, b_pad, t_pad)
+    seg_arrs = [(s["uterms"], s["qimp"], s["live"]) for s in pack.segs]
+
+    def compile_fn():
+        def run(seg_arrs_in, qtids_in, scales_in, boosts_in, cs_in,
+                cd_in):
+            ts_list, td_list = [], []
+            counts = None
+            for i, (ut, qi, lv) in enumerate(seg_arrs_in):
+                base = bases[i]
+
+                def one(qt, bo, c1, c2, ut=ut, qi=qi, lv=lv, i=i,
+                        base=base):
+                    return bm_ops.eager_segment_topk(
+                        ut, qi, lv, qt, scales_in[i] * bo, k_static,
+                        base, c1, c2)
+                ts, td, cnt = jax.vmap(one)(qtids_in[i], boosts_in,
+                                            cs_in, cd_in)
+                ts_list.append(ts)
+                td_list.append(td)
+                counts = cnt if counts is None else counts + cnt
+            top_s, top_d = topk_ops.merge_top_k_batch_body(
+                ts_list, td_list, k_static, bases)
+            return {"top_scores": top_s, "top_docs": top_d,
+                    "count": counts}
+
+        shapes = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            (seg_arrs, qtids, pack.scales, boosts_a, cs, cd))
+        return jax.jit(run).lower(*shapes).compile()
+
+    fn = _get_compiled(key, compile_fn)
+    with device_span("dispatch"):
+        device_fault_point("dispatch")
+        out = fn(seg_arrs, qtids, pack.scales, boosts_a, cs, cd)
+    if b_pad != b:
+        out = {name: v[:b] for name, v in out.items()}
+    return out
+
+
+def run_impact_pruned(pack: _ImpactPack, term_lists: list, boosts: list,
+                      cursors: list, *, k: int) -> dict:
+    """Block-max pruned top-k of B queries: blocks sweep in descending
+    upper-bound order with the running k-th score as the skip threshold,
+    carried ACROSS segments so early segments' candidates prune later
+    ones (ops/blockmax.pruned_segment_topk). Queries run under lax.map
+    so the skip stays a real branch. Returns the eager lane's output
+    contract plus per-query ``blocks_scored``/``blocks_skipped``;
+    ``count`` is matched docs in SCORED blocks only (a lower bound —
+    admission requires track_total_hits=false)."""
+    from elasticsearch_tpu.ops import blockmax as bm_ops
+    if not pack.can_prune:
+        raise ValueError("pack has segments without block maxima")
+    b = len(term_lists)
+    k_static = int(k)
+    qtids, boosts_a, cs, cd, b_pad, t_pad = _impact_query_inputs(
+        pack, term_lists, boosts, cursors)
+    bases = tuple(pack.bases)
+    key = ("impact-pruned", pack.sig(), k_static, b_pad, t_pad)
+    seg_arrs = [(s["uterms"], s["qimp"], s["live"], s["block_max"])
+                for s in pack.segs]
+
+    def compile_fn():
+        def run(seg_arrs_in, qtids_in, scales_in, boosts_in, cs_in,
+                cd_in):
+            def per_query(args):
+                qts, bo, c1, c2 = args
+                carry = bm_ops.pruned_carry_init(k_static)
+                for i, (ut, qi, lv, bmx) in enumerate(seg_arrs_in):
+                    carry = bm_ops.pruned_segment_topk(
+                        carry, ut, qi, lv, bmx, qts[i],
+                        scales_in[i] * bo, k_static, bases[i], c1, c2)
+                ts, td, n_scored, n_skipped, n_matched = carry
+                return {"top_scores": ts, "top_docs": td,
+                        "count": n_matched, "blocks_scored": n_scored,
+                        "blocks_skipped": n_skipped}
+            return jax.lax.map(per_query,
+                               (tuple(qtids_in), boosts_in, cs_in,
+                                cd_in))
+
+        shapes = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            (seg_arrs, qtids, pack.scales, boosts_a, cs, cd))
+        return jax.jit(run).lower(*shapes).compile()
+
+    fn = _get_compiled(key, compile_fn)
+    with device_span("pruning-dispatch"):
+        device_fault_point("pruning-dispatch")
+        out = fn(seg_arrs, qtids, pack.scales, boosts_a, cs, cd)
+    if b_pad != b:
+        out = {name: v[:b] for name, v in out.items()}
+    return out

@@ -137,6 +137,12 @@ def parse_search_request(body: dict | None) -> ParsedSearchRequest:
             req.source_filter = False
     if body.get("terminate_after"):
         req.terminate_after = int(body["terminate_after"])
+    tth = body.get("track_total_hits")
+    if tth is not None and str(tth).lower() in ("false", "0"):
+        # totals not tracked: the block-max impact lane may skip blocks
+        # (a skipped block's matches are never counted); any other value
+        # keeps exact totals
+        req.track_total_hits = False
     if body.get("timeout") is not None:
         from elasticsearch_tpu.common.settings import parse_time_value
         req.timeout_ms = parse_time_value(body["timeout"], "timeout") * 1000.0
@@ -518,6 +524,14 @@ class ShardSearcher:
             # per-request fallback lands on query_phase, which routes to
             # the eager executor under the same gate
             return None
+        # impact-ordered lane first: an opted-in index serves eligible
+        # disjunctive BM25 shapes from the quantized impact columns
+        # (score-order search_after cursors included — the generic
+        # screen below rejects those); ineligible requests fall through
+        # to the exact batched program
+        imp = self._impact_batch_launch(reqs)
+        if imp is not None:
+            return imp
         for req in reqs:
             if (req.aggs or not _is_score_order(req.sort)
                     or req.post_filter is not None
@@ -563,6 +577,95 @@ class ShardSearcher:
                 pass                      # drain's np.asarray still works
         return ("device", reqs, k, pack, out)
 
+    def _impact_batch_launch(self, reqs: list):
+        """Impact-lane admission + dispatch: serve B eligible requests
+        from the quantized impact columns (jit_exec.run_impact_batch),
+        with the block-max pruned sweep when no request tracks totals
+        (jit_exec.run_impact_pruned). Opt-in per index
+        (`index.search.impact_plane`) because quantized scores match
+        the exact scorer only within the documented quantization bound
+        — the exact scorer stays the default. Returns a drain handle or
+        None (caller proceeds on the exact path); declines are
+        reason-labeled via note_impact_fallback, mirroring the
+        collective plane's admission accounting."""
+        from elasticsearch_tpu.search import jit_exec
+        from elasticsearch_tpu.search.execute import impact_terms
+        cfg = jit_exec.impact_plane_config(self.ctx.index_name)
+        if cfg is None or not reqs or not self.reader.segments:
+            return None
+        if self.ctx.dfs_stats is not None:
+            # impacts bake READER-local idf; DFS global statistics
+            # would score with different idf than the snapshot
+            jit_exec.note_impact_fallback("dfs-stats")
+            return None
+        if any(not getattr(s, "resident", True)
+               for s in self.reader.segments):
+            jit_exec.note_impact_fallback("streamed-reader")
+            return None
+        specs = []
+        for req in reqs:
+            if (req.aggs or not _is_score_order(req.sort)
+                    or req.post_filter is not None
+                    or req.min_score is not None or req.suggest
+                    or req.terminate_after is not None
+                    or req.timeout_ms is not None or req.rescore
+                    or req.explain):
+                jit_exec.note_impact_fallback("ineligible-shape")
+                return None
+            if req.search_after is not None and \
+                    len(req.search_after) not in (1, 2):
+                jit_exec.note_impact_fallback("ineligible-cursor")
+                return None
+            spec = impact_terms(req.query, self.mapper_service,
+                                max_terms=cfg.max_terms)
+            if spec is None:
+                jit_exec.note_impact_fallback("ineligible-query")
+                return None
+            specs.append(spec)
+        if len({f for f, _, _ in specs}) != 1:
+            jit_exec.note_impact_fallback("mixed-fields")
+            return None
+        field = specs[0][0]
+        k = max(max(req.from_ + req.size, 1) for req in reqs)
+        term_lists = [terms for _, terms, _ in specs]
+        boosts = [boost for _, _, boost in specs]
+        cursors = []
+        for req in reqs:
+            if req.search_after is None:
+                cursors.append(None)
+            else:
+                sa = req.search_after
+                cursors.append((float(sa[0]),
+                                int(sa[1]) if len(sa) > 1 else -1))
+        prune = cfg.prune and all(req.track_total_hits is False
+                                  for req in reqs)
+        try:
+            pack = jit_exec.impact_pack_for(
+                self.reader, field, cfg, k1=self.ctx.bm25.k1,
+                b=self.ctx.bm25.b)
+            if pack is None:
+                jit_exec.note_impact_fallback("no-impact-columns")
+                return None
+            if prune and not pack.can_prune:
+                prune = False               # block tables over budget
+            run = jit_exec.run_impact_pruned if prune \
+                else jit_exec.run_impact_batch
+            out = run(pack, term_lists, boosts, cursors, k=k)
+        except QueryParsingError:
+            raise
+        except Exception as e:            # noqa: BLE001 — fallback seam
+            jit_exec.note_fallback(e, reason="device-error")
+            jit_exec.note_device_error(e)
+            jit_exec.note_impact_fallback("device-error")
+            return None
+        jit_exec.plane_breaker.record_success()
+        for name in ("top_scores", "top_docs", "count"):
+            try:
+                out[name].copy_to_host_async()
+            except Exception:             # noqa: BLE001 — optional
+                pass
+        return ("impact", reqs, k, out, prune, pack.total_blocks)
+
     def query_phase_batch_drain(self, handle
                                 ) -> list[ShardQueryResult]:
         """Phase 2: block until the launched batch's results are on host
@@ -574,7 +677,25 @@ class ShardSearcher:
                                      np.zeros(0, np.int32),
                                      np.zeros(0, np.float32), None, {},
                                      self.reader) for _ in reqs]
-        if tag == "host":
+        if tag == "impact":
+            from elasticsearch_tpu.observability import attribution
+            from elasticsearch_tpu.search import jit_exec
+            _, _, k, out, pruned, total_blocks = handle
+            ms = np.asarray(out["top_scores"])
+            md = np.asarray(out["top_docs"])
+            totals = np.asarray(out["count"])
+            if pruned:
+                scored = int(np.asarray(out["blocks_scored"]).sum())
+                skipped = int(np.asarray(out["blocks_skipped"]).sum())
+                attribution.label(
+                    "pruned", f"{skipped}/{scored + skipped} blocks")
+            else:
+                # eager impact scoring touches every block — honest
+                # effective-work accounting for the skip-ratio surfaces
+                scored, skipped = total_blocks * len(reqs), 0
+            jit_exec.note_impact_served(self.ctx.index_name, len(reqs),
+                                        scored, skipped)
+        elif tag == "host":
             _, _, k, (ms, md, totals) = handle
         else:
             _, _, k, pack, out = handle

@@ -397,7 +397,12 @@ class DiskFaultScheme:
 #: path falls back to; drawing it by default would leave chaos cases
 #: with no working fallback, so targeted tests opt in via p_by_site
 DEVICE_FAULT_SITES = ("dispatch", "compile", "upload", "compose",
-                      "plane-dispatch", "percolate")
+                      "plane-dispatch", "percolate",
+                      # impact-ordered lane touchpoints: quantized
+                      # column/block-max upload, pack-level compose,
+                      # and the block-max sweep dispatch
+                      "impact-upload", "blockmax-compose",
+                      "pruning-dispatch")
 READER_UPLOAD_SITE = "reader-upload"
 
 

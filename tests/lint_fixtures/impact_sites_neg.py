@@ -1,0 +1,33 @@
+"""Negative fixtures: the impact lane's device seams done RIGHT —
+every new site class (impact-upload, blockmax-compose,
+pruning-dispatch) guarded, span-scoped, and of the correct family.
+Must lint clean under the seam-module config.
+"""
+
+import jax
+
+
+def device_fault_point(site):
+    pass
+
+
+def device_span(site):
+    pass
+
+
+def impact_block_upload(arr):
+    with device_span("impact-upload"):
+        device_fault_point("impact-upload")
+        return jax.device_put(arr)
+
+
+def pack_compose(scales):
+    with device_span("blockmax-compose"):
+        device_fault_point("blockmax-compose")
+        return jax.device_put(scales)
+
+
+def pruned_dispatch(fn, args):
+    with device_span("pruning-dispatch"):
+        device_fault_point("pruning-dispatch")
+        return fn(*args)

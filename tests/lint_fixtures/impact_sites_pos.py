@@ -1,0 +1,37 @@
+"""Positive fixtures: impact-lane device seams done WRONG.
+
+The impact lane added three site classes (impact-upload,
+blockmax-compose, pruning-dispatch). These shapes must each fire:
+an impact upload with no span pairing, a device_put "guarded" by a
+dispatch-class site (not an upload-class one), and a typo'd site the
+chaos scheme would never draw.
+"""
+
+import jax
+
+
+def device_fault_point(site):
+    pass
+
+
+def device_span(site):
+    pass
+
+
+def unspanned_impact_upload(arr):
+    device_fault_point("impact-upload")   # span-unscoped-site
+    return jax.device_put(arr)
+
+
+def dispatch_guarding_an_upload(arr):
+    with device_span("pruning-dispatch"):
+        device_fault_point("pruning-dispatch")
+        # device-unguarded: pruning-dispatch is not an upload-class
+        # site, so this transfer is invisible to upload fault draws
+        return jax.device_put(arr)
+
+
+def typoed_site(fn, arr):
+    with device_span("blockmax-compose"):
+        device_fault_point("blockmax-compse")   # device-unknown-site
+        return fn(arr)

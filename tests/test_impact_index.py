@@ -1,0 +1,486 @@
+"""Impact-ordered device index (tier-1 guards).
+
+Quantized eager impacts + block-max pruning (ISSUE 9 / ROADMAP item 2):
+
+* quantization honesty — dequantized impacts sit within the documented
+  half-step bound of the float BM25 contributions, and the eager impact
+  lane's hits agree with the EXACT forward kernel (identical totals and
+  match masks; scores within the pack's quantization bound; recall@k
+  1.0 vs the independent float oracle with tie tolerance);
+* pruning soundness — the block-max sweep returns hits IDENTICAL to the
+  unpruned impact lane (ids, rank order, bit-equal scores) across
+  randomized corpora, delete churn, refresh/merge cycles, search_after
+  cursors, and collective plane on/off — while actually skipping blocks
+  (counter-verified via impact_blocks_{scored,skipped});
+* PR 5 discipline — impact columns ride the per-segment device-block
+  cache: a refresh uploads impact bytes only for NEW segments, a
+  delete-only refresh uploads ZERO impact bytes, and steady-state
+  refreshes never requantize (impact_requant_refreshes stays 0) while a
+  corpus-scale drift does;
+* admission — the lane is opt-in, reason-labels its declines, and every
+  ineligible shape lands on the exact scorer unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.device_reader import device_reader_for
+from elasticsearch_tpu.index.segment import build_impact_column
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.parallel import mesh_engine
+from elasticsearch_tpu.search import jit_exec
+from elasticsearch_tpu.search.phase import (ShardSearcher,
+                                            parse_search_request)
+
+
+@pytest.fixture
+def node(tmp_path):
+    jit_exec.clear_cache()
+    n = Node({}, data_path=tmp_path / "n").start()
+    yield n
+    n.close()
+    jit_exec.clear_cache()
+
+
+def _mk_index(node, name, docs, *, impact=True, plane=False, shards=1,
+              block_rows=64):
+    node.indices_service.create_index(name, {
+        "settings": {"number_of_shards": shards,
+                     "number_of_replicas": 0,
+                     "index.search.collective_plane": plane,
+                     "index.search.impact_plane": impact,
+                     "index.search.impact.block_rows": block_rows},
+        "mappings": {"_doc": {"properties": {
+            "t": {"type": "text", "analyzer": "whitespace"},
+            "v": {"type": "long"}}}}})
+    for i, doc in enumerate(docs):
+        node.index_doc(name, str(i), doc)
+    node.broadcast_actions.refresh(name)
+
+
+def _skewed_docs(rng, n, vocab=60):
+    """Zipf-ish token draws: a few common terms everywhere, rare terms
+    concentrated in few docs — the workload block-max pruning wants."""
+    docs = []
+    for i in range(n):
+        words = [f"w{min(int(x), vocab)}" for x in rng.zipf(1.3, 8)]
+        docs.append({"t": " ".join(words) or "w1", "v": i})
+    return docs
+
+
+def _searcher(node, name, shard=0):
+    svc = node.indices_service.indices[name]
+    return ShardSearcher(shard, device_reader_for(svc.engine(shard)),
+                         svc.mapper_service, index_name=name)
+
+
+def _impact_stats():
+    st = jit_exec.cache_stats()
+    return {k: st[k] for k in ("impact_admissions",
+                               "impact_blocks_scored",
+                               "impact_blocks_skipped",
+                               "impact_requant_refreshes")}
+
+
+def _pack_bound(node, name, field="t", shard=0):
+    svc = node.indices_service.indices[name]
+    cfg = jit_exec.impact_plane_config(name)
+    pack = jit_exec.impact_pack_for(
+        device_reader_for(svc.engine(shard)), field, cfg)
+    return pack.bound_per_term
+
+
+# ---------------------------------------------------------------------------
+# quantization honesty
+# ---------------------------------------------------------------------------
+
+def test_impact_column_quantization_bound(rng):
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    from elasticsearch_tpu.mapping import MapperService
+    from elasticsearch_tpu.analysis import AnalysisRegistry
+    from elasticsearch_tpu.common.settings import Settings
+    ar = AnalysisRegistry(Settings({}))
+    ms = MapperService(ar)
+    dm = ms.merge("_doc", {"properties": {
+        "t": {"type": "text", "analyzer": "whitespace"}}})
+    b = SegmentBuilder(0)
+    texts = [" ".join(f"w{int(rng.integers(0, 20))}"
+                      for _ in range(int(rng.integers(2, 30))))
+             for _ in range(130)]
+    for i, t in enumerate(texts):
+        b.add(dm.parse(str(i), {"t": t}))
+    seg = b.build()
+    col = seg.text_fields["t"]
+    n = seg.num_docs
+    avgdl = col.total_tokens / n
+    icol = build_impact_column(col, df=col.df, doc_count=n, avgdl=avgdl,
+                               block_rows=64)
+    # exact float impacts, straight from the formula
+    k1, b_ = 1.2, 0.75
+    dfv = np.asarray(col.df, np.float64)
+    idf = np.log1p((n - dfv + 0.5) / (dfv + 0.5))
+    norm = k1 * (1 - b_ + b_ * np.asarray(col.doc_len, np.float64)
+                 / avgdl)
+    valid = col.uterms >= 0
+    tfn = np.where(valid, col.utf * (k1 + 1) /
+                   np.where(valid, col.utf + norm[:, None], 1.0), 0.0)
+    imp = np.where(valid, idf[np.maximum(col.uterms, 0)] * tfn, 0.0)
+    deq = icol.qimp.astype(np.float64) * icol.scale
+    assert np.abs(deq - imp).max() <= icol.scale / 2 + 1e-9
+    # block maxima are exact upper bounds of in-block quantized impacts
+    r = icol.block_rows
+    for bi in range(icol.qimp.shape[0] // r):
+        sl = slice(bi * r, (bi + 1) * r)
+        ts = seg.text_fields["t"].uterms[sl][valid[sl]]
+        qs = icol.qimp[sl][valid[sl]]
+        for t, q in zip(ts, qs):
+            assert icol.block_max[bi, t] >= q
+
+
+def test_eager_lane_matches_exact_scorer(node, rng):
+    docs = _skewed_docs(rng, 260)
+    _mk_index(node, "imp", docs)
+    s = _searcher(node, "imp")
+    bound = _pack_bound(node, "imp")
+    for text in ("w1 w3", "w2", "w1 w5 w9", "w17 w1"):
+        req = parse_search_request(
+            {"query": {"match": {"t": text}}, "size": 12})
+        cfg = jit_exec._impact_configs.pop("imp")
+        exact = s.query_phase(req)
+        jit_exec._impact_configs["imp"] = cfg
+        got = s.query_phase(req)
+        t_terms = len(text.split())
+        # totals come from the same anyhit mask → identical
+        assert got.total == exact.total, text
+        # every returned doc's quantized score sits within the bound of
+        # its exact score
+        exact_by_doc = dict(zip(exact.doc_ids.tolist(),
+                                exact.scores.tolist()))
+        per_seg = s._execute_query(req.query)
+        full_scores = np.concatenate(
+            [np.asarray(sc) for sc, _ in per_seg])
+        for d, sc in zip(got.doc_ids.tolist(), got.scores.tolist()):
+            assert abs(sc - float(full_scores[d])) <= \
+                bound * t_terms + 1e-5
+        # rank agreement up to quantization ties: both lists must agree
+        # wherever the exact scorer's score gap exceeds the bound
+        del exact_by_doc
+
+
+def test_oracle_recall_is_one(node, rng):
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]
+                           / "scripts"))
+    from bm25_oracle import BM25Oracle, recall_with_tie_tolerance
+    docs = _skewed_docs(rng, 300)
+    _mk_index(node, "orc", docs)
+    # token-id matrix for the oracle (terms wN → id N)
+    lens = [len(d["t"].split()) for d in docs]
+    toks = np.full((len(docs), max(lens)), -1, np.int64)
+    for i, d in enumerate(docs):
+        for j, w in enumerate(d["t"].split()):
+            toks[i, j] = int(w[1:])
+    oracle = BM25Oracle(toks)
+    s = _searcher(node, "orc")
+    bound = _pack_bound(node, "orc")
+    for text in ("w1 w4", "w2 w7 w1", "w12"):
+        req = parse_search_request(
+            {"query": {"match": {"t": text}}, "size": 10})
+        got = s.query_phase(req)
+        terms = [int(w[1:]) for w in text.split()]
+        scores = oracle.score_query(terms)
+        ids, _ = oracle.topk(terms, 10, scores=scores)
+        # tie tolerance: quantization bound per term × terms
+        recall = recall_with_tie_tolerance(
+            ids, scores, got.doc_ids, min(10, len(got.doc_ids)),
+            tol=max(bound * len(terms) * 4, 1e-3))
+        assert recall == 1.0, (text, recall)
+
+
+# ---------------------------------------------------------------------------
+# pruning soundness: pruned ≡ unpruned, under churn
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("plane", [False, True])
+def test_pruned_equals_unpruned_fuzz(node, rng, plane):
+    docs = _skewed_docs(rng, 220)
+    _mk_index(node, "fz", docs, plane=plane)
+    svc = node.indices_service.indices["fz"]
+    for round_no in range(4):
+        if round_no == 1:        # delete churn
+            for did in (int(x) for x in rng.choice(120, size=14, replace=False)):
+                node.document_actions.delete_doc("fz", str(did))
+            node.broadcast_actions.refresh("fz")
+        elif round_no == 2:      # refresh with a new segment
+            for i in range(40):
+                node.index_doc("fz", f"n{i}",
+                               {"t": f"w1 w{int(rng.integers(1, 50))}",
+                                "v": 1000 + i})
+            node.broadcast_actions.refresh("fz")
+        elif round_no == 3:      # merge cycle
+            svc.force_merge(1)
+            node.broadcast_actions.refresh("fz")
+        s = _searcher(node, "fz")
+        for _ in range(5):
+            t = " ".join(f"w{int(rng.integers(1, 50))}"
+                         for _ in range(int(rng.integers(1, 5))))
+            k = int(rng.choice([1, 3, 10, 25]))
+            body = {"query": {"match": {"t": t}}, "size": k}
+            pruned = s.query_phase(parse_search_request(
+                {**body, "track_total_hits": False}))
+            unpruned = s.query_phase(parse_search_request(body))
+            np.testing.assert_array_equal(
+                pruned.doc_ids, unpruned.doc_ids,
+                err_msg=f"round {round_no} q={t!r} k={k}")
+            np.testing.assert_array_equal(pruned.scores, unpruned.scores)
+
+
+def test_search_after_cursor_continuation(node, rng):
+    docs = _skewed_docs(rng, 240)
+    _mk_index(node, "sa", docs)
+    s = _searcher(node, "sa")
+    body = {"query": {"match": {"t": "w1 w3"}}, "size": 8}
+    full = s.query_phase(parse_search_request(
+        {**body, "size": 16, "track_total_hits": False}))
+    page1 = s.query_phase(parse_search_request(
+        {**body, "track_total_hits": False}))
+    cursor = [float(page1.scores[-1]), int(page1.doc_ids[-1])]
+    page2 = s.query_phase(parse_search_request(
+        {**body, "search_after": cursor, "track_total_hits": False}))
+    np.testing.assert_array_equal(
+        np.concatenate([page1.doc_ids, page2.doc_ids]),
+        full.doc_ids)
+    # the pruned cursor page equals the unpruned cursor page exactly
+    page2e = s.query_phase(parse_search_request(
+        {**body, "search_after": cursor}))
+    np.testing.assert_array_equal(page2.doc_ids, page2e.doc_ids)
+
+
+def test_blocks_actually_skip(node, rng):
+    docs = _skewed_docs(rng, 400, vocab=120)
+    _mk_index(node, "sk", docs, block_rows=64)
+    s = _searcher(node, "sk")
+    before = _impact_stats()
+    req = parse_search_request({"query": {"match": {"t": "w40 w1"}},
+                                "size": 5, "track_total_hits": False})
+    got = s.query_phase(req)
+    assert got is not None
+    after = _impact_stats()
+    scored = after["impact_blocks_scored"] - before["impact_blocks_scored"]
+    skipped = after["impact_blocks_skipped"] - \
+        before["impact_blocks_skipped"]
+    assert after["impact_admissions"] > before["impact_admissions"]
+    assert scored + skipped > 0
+    assert skipped > 0, "skewed top-5 should skip blocks"
+    # counters reconcile: every block of the pack is either scored or
+    # skipped exactly once for the one admitted query
+    svc = node.indices_service.indices["sk"]
+    pack = jit_exec.impact_pack_for(
+        device_reader_for(svc.engine(0)), "t",
+        jit_exec.impact_plane_config("sk"))
+    assert scored + skipped == pack.total_blocks
+
+
+# ---------------------------------------------------------------------------
+# PR 5 discipline: incremental impact uploads + drift requant
+# ---------------------------------------------------------------------------
+
+def _impact_bytes():
+    dl = jit_exec.cache_stats()["data_layer"]
+    return dl["impact_bytes_uploaded"], dl["impact_bytes_reused"]
+
+
+def test_refresh_uploads_only_new_segment_impacts(node, rng):
+    docs = _skewed_docs(rng, 600)
+    _mk_index(node, "inc", docs)
+    s = _searcher(node, "inc")
+    req = parse_search_request({"query": {"match": {"t": "w1"}},
+                                "size": 5})
+    s.query_phase(req)
+    up0, re0 = _impact_bytes()
+    assert up0 > 0 and re0 == 0
+    # unrelated new segment: only ITS impact bytes upload, every
+    # resident segment's impact block is reused
+    for i in range(3):
+        node.index_doc("inc", f"x{i}",
+                       {"t": f"w2 w9 w{3 + i} w4 w1 w6", "v": i})
+    node.broadcast_actions.refresh("inc")
+    s2 = _searcher(node, "inc")
+    s2.query_phase(req)
+    up1, re1 = _impact_bytes()
+    assert re1 - re0 >= up0 - 0, "resident impact blocks must be reused"
+    assert 0 < up1 - up0 < up0, \
+        "refresh must upload impact bytes only for the new segment"
+    # delete-only refresh: ZERO new impact bytes
+    node.document_actions.delete_doc("inc", "3")
+    node.broadcast_actions.refresh("inc")
+    s3 = _searcher(node, "inc")
+    s3.query_phase(req)
+    up2, _re2 = _impact_bytes()
+    assert up2 == up1, "delete-only refresh uploaded impact bytes"
+    assert _impact_stats()["impact_requant_refreshes"] == 0, \
+        "steady-state refreshes must not requantize"
+
+
+def test_df_drift_forces_requant(node, rng):
+    docs = _skewed_docs(rng, 150)
+    _mk_index(node, "drift", docs)
+    s = _searcher(node, "drift")
+    req = parse_search_request({"query": {"match": {"t": "w1"}},
+                                "size": 5})
+    s.query_phase(req)
+    assert _impact_stats()["impact_requant_refreshes"] == 0
+    # corpus-scale drift: double the doc count → idf moves by far more
+    # than one quantization step → resident segments requantize
+    for i in range(170):
+        node.index_doc("drift", f"d{i}",
+                       {"t": f"w1 w{int(rng.integers(1, 50))}", "v": i})
+    node.broadcast_actions.refresh("drift")
+    s2 = _searcher(node, "drift")
+    s2.query_phase(req)
+    assert _impact_stats()["impact_requant_refreshes"] > 0
+
+
+def test_engine_close_releases_impact_blocks(node, rng):
+    docs = _skewed_docs(rng, 120)
+    _mk_index(node, "rel", docs)
+    s = _searcher(node, "rel")
+    s.query_phase(parse_search_request(
+        {"query": {"match": {"t": "w1"}}, "size": 3}))
+    svc = node.indices_service.indices["rel"]
+    uuids = {e.engine_uuid for e in svc.shard_engines}
+    assert any(key[0] in uuids and isinstance(key[2], tuple)
+               and key[2] and key[2][0] == "impact"
+               for key in mesh_engine.block_cache_keys())
+    node.indices_service.delete_index("rel")
+    assert not any(key[0] in uuids
+                   for key in mesh_engine.block_cache_keys()), \
+        "engine close must drop its impact blocks"
+
+
+# ---------------------------------------------------------------------------
+# admission gating + surfaces
+# ---------------------------------------------------------------------------
+
+def test_admission_declines_are_reason_labeled(node, rng):
+    docs = _skewed_docs(rng, 90)
+    _mk_index(node, "adm", docs)
+    s = _searcher(node, "adm")
+    # aggs → ineligible-shape; phrase → ineligible-query; both must
+    # still return correct results on the exact path
+    r1 = s.query_phase(parse_search_request(
+        {"query": {"match": {"t": "w1"}}, "size": 3,
+         "aggs": {"m": {"max": {"field": "v"}}}}))
+    assert r1.agg_partials
+    r2 = s.query_phase(parse_search_request(
+        {"query": {"match_phrase": {"t": "w1 w2"}}, "size": 3}))
+    assert r2 is not None
+    reasons = jit_exec.cache_stats()["impact_fallback_reasons"]
+    assert reasons.get("ineligible-shape", 0) >= 1
+    assert reasons.get("ineligible-query", 0) >= 1
+    # an index that never opted in logs NO impact fallbacks
+    _mk_index(node, "plain", _skewed_docs(rng, 40), impact=False)
+    sp = _searcher(node, "plain")
+    base = dict(jit_exec.cache_stats()["impact_fallback_reasons"])
+    sp.query_phase(parse_search_request(
+        {"query": {"match_phrase": {"t": "w1 w2"}}, "size": 3}))
+    assert jit_exec.cache_stats()["impact_fallback_reasons"] == base
+
+
+def test_stats_and_cat_surfaces(node, rng):
+    import json
+    from elasticsearch_tpu.rest.controller import RestController
+    from elasticsearch_tpu.rest.handlers import register_all
+    docs = _skewed_docs(rng, 150)
+    _mk_index(node, "surf", docs)
+    resp = node.search("surf", {"query": {"match": {"t": "w1 w9"}},
+                                "size": 5, "track_total_hits": False})
+    assert resp["hits"]["hits"]
+    svc = node.indices_service.indices["surf"]
+    imp = svc.stats()["search"]["impact"]
+    assert imp["admissions"] >= 1
+    assert imp["blocks_scored"] + imp["blocks_skipped"] > 0
+    jit = node.local_node_stats()["indices"]["jit"]
+    assert jit["impact_admissions"] >= 1
+    c = RestController()
+    register_all(c, node)
+    st, cat = c.dispatch(
+        "GET",
+        "/_cat/indices?h=index,impact.blocks,impact.skip_ratio", b"")
+    assert st == 200, cat
+    cells = [ln for ln in cat.splitlines()
+             if ln.startswith("surf ")][0].split()
+    assert int(cells[1]) > 0
+    assert 0.0 <= float(cells[2]) <= 1.0
+    del json
+
+
+def test_e2e_hits_match_plane(node, rng):
+    """End-to-end parity: impact-lane hits equal the exact
+    collective-plane hits on doc ids for a skew query whose gaps exceed
+    the quantization bound — and the coordinator's mesh admission
+    labels the decline impact-preferred."""
+    docs = _skewed_docs(rng, 260)
+    _mk_index(node, "ea", docs, impact=True, plane=True, shards=2)
+    _mk_index(node, "eb", docs, impact=False, plane=True, shards=2)
+    body = {"query": {"match": {"t": "w30 w1"}}, "size": 10}
+    ra = node.search("ea", body)
+    rb = node.search("eb", body)
+    assert ra["hits"]["total"] == rb["hits"]["total"]
+    # rank parity up to quantization ties: where the lists disagree,
+    # both positions must hold scores within the documented bound
+    # (equal-score-within-bound docs are interchangeable at a rank)
+    tol = _pack_bound(node, "ea") * 2 * 3
+    for ha, hb in zip(ra["hits"]["hits"], rb["hits"]["hits"]):
+        if ha["_id"] != hb["_id"]:
+            assert abs(ha["_score"] - hb["_score"]) <= tol, (ha, hb)
+    svc = node.indices_service.indices["ea"]
+    assert svc.plane_stats["fallback"].get("impact-preferred", 0) >= 1
+    assert jit_exec.cache_stats()["impact_admissions"] >= 1
+
+
+def test_device_fault_on_impact_site_falls_back(node, rng):
+    from elasticsearch_tpu.testing_disruption import DeviceFaultScheme
+    docs = _skewed_docs(rng, 120)
+    _mk_index(node, "flt", docs)
+    scheme = DeviceFaultScheme(
+        seed=11, p=0.0, sites=("impact-upload",),
+        p_by_site={"impact-upload": 1.0})
+    scheme.start_disrupting()
+    try:
+        s = _searcher(node, "flt")
+        req = parse_search_request({"query": {"match": {"t": "w1"}},
+                                    "size": 5})
+        got = s.query_phase(req)          # exact path serves
+        assert got.total > 0
+        reasons = jit_exec.cache_stats()["impact_fallback_reasons"]
+        assert reasons.get("device-error", 0) >= 1
+        assert scheme.injected.get("impact-upload", 0) >= 1
+    finally:
+        scheme.stop_disrupting()
+    # healed: the lane admits again
+    s2 = _searcher(node, "flt")
+    before = _impact_stats()["impact_admissions"]
+    s2.query_phase(parse_search_request(
+        {"query": {"match": {"t": "w1"}}, "size": 5}))
+    assert _impact_stats()["impact_admissions"] > before
+
+
+def test_slowlog_attribution_carries_pruned_blocks(node, rng):
+    """The slow-log plane-attribution line must carry pruned[N/M
+    blocks] for a block-max-served request, so per-query pruning
+    efficacy is visible without the profiler."""
+    from elasticsearch_tpu.observability import attribution
+    docs = _skewed_docs(rng, 200)
+    _mk_index(node, "slog", docs)
+    s = _searcher(node, "slog")
+    req = parse_search_request({"query": {"match": {"t": "w9 w1"}},
+                                "size": 5, "track_total_hits": False})
+    with attribution.collect(admission="fanout"):
+        s.query_phase(req)
+        line = attribution.render_current(0.5)
+    assert line is not None and "pruned[" in line, line
+    assert "blocks]" in line

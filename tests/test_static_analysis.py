@@ -480,3 +480,50 @@ def test_scheme_stop_and_close_leave_zero_residual_bytes(tmp_path):
         n.close()
     fd = n.breaker_service.breaker("fielddata")
     assert fd.used == 0, f"residual fielddata bytes: {fd.used}"
+
+
+# ---------------------------------------------------------------------------
+# impact-lane site classes (impact-upload / blockmax-compose /
+# pruning-dispatch)
+# ---------------------------------------------------------------------------
+
+IMPACT_FIX_CFG = LintConfig(seam_modules=("*/impact_sites_*.py",),
+                            hot_modules=("*/hot_mod_*.py",))
+
+
+def impact_fixture(name: str):
+    return lint_paths([str(FIXDIR / name)], IMPACT_FIX_CFG)
+
+
+def test_impact_sites_registered():
+    """The three impact-lane site classes are first-class citizens of
+    every discipline: lint vocabulary, family membership (upload vs
+    dispatch), and the default chaos draw."""
+    from elasticsearch_tpu.testing_disruption import DEVICE_FAULT_SITES
+    for site in ("impact-upload", "blockmax-compose", "pruning-dispatch"):
+        assert site in DEFAULT_CONFIG.known_sites
+        assert site in DEVICE_FAULT_SITES
+    assert "impact-upload" in DEFAULT_CONFIG.upload_sites
+    assert "blockmax-compose" in DEFAULT_CONFIG.upload_sites
+    assert "pruning-dispatch" in DEFAULT_CONFIG.dispatch_sites
+    assert "pruning-dispatch" not in DEFAULT_CONFIG.upload_sites
+
+
+def test_impact_sites_positive():
+    r = impact_fixture("impact_sites_pos.py")
+    unguarded = open_rules(r, "device-unguarded")
+    assert len(unguarded) == 1, "\n".join(f.render() for f in unguarded)
+    assert "dispatch_guarding_an_upload" in unguarded[0].message
+    unknown = open_rules(r, "device-unknown-site")
+    assert len(unknown) == 1
+    unscoped = open_rules(r, "span-unscoped-site")
+    messages = " ".join(f.message for f in unscoped)
+    assert "unspanned_impact_upload" in messages
+
+
+def test_impact_sites_negative():
+    r = impact_fixture("impact_sites_neg.py")
+    assert open_family(r, "device-seam") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+    assert open_family(r, "span-discipline") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
