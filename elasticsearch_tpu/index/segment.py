@@ -151,8 +151,11 @@ class ImpactColumn:
     build time (BM25S), so query-time scoring is a dense compare +
     integer gather/sum with NO per-doc float math. ``block_max[B, V]``
     carries, per fixed row block, the max quantized impact of every
-    term — the WAND/block-max upper-bound table. Quantization error is
-    ≤ ``scale/2`` per matched term (``bound_per_term``).
+    term — the WAND/block-max upper-bound table — with an OCCUPANCY
+    floor: present-term cells store at least 1, so a zero cell means
+    the term does not occur in the block at all (the pruning lane keys
+    its skip on that). Quantization error is ≤ ``scale/2`` per matched
+    term (``bound_per_term``).
 
     idf (and avgdl) are READER-global at build time; the snapshot
     fields let later refreshes measure cross-segment df drift and
@@ -250,7 +253,13 @@ def build_impact_column(col: TextFieldColumn, *, df: np.ndarray,
         for bi in range(n_blocks):
             sl = slice(bi * r, (bi + 1) * r)
             rows_t = ut[sl][valid[sl]]
-            rows_q = qimp[sl][valid[sl]]
+            # occupancy floor: a PRESENT (block, term) cell stores
+            # max(q, 1) so zero means "term absent from block" — a
+            # low-idf term whose impacts all quantize to 0 must still
+            # keep its blocks sweepable (the eager lane counts such
+            # docs as hits at score 0; the pruned lane has to agree).
+            # Still a valid upper bound: 1 ≥ 0 and bounds only need ≥.
+            rows_q = np.maximum(qimp[sl][valid[sl]], 1)
             np.maximum.at(block_max[bi], rows_t, rows_q)
     return ImpactColumn(qimp=qimp, block_max=block_max, scale=scale,
                         bits=bits, block_rows=r, doc_count=n0,

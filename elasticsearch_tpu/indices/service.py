@@ -898,6 +898,12 @@ class IndicesService:
                             base.setdefault("properties", {}).setdefault(
                                 fname, fdef)
             sett = Settings(settings)
+            # impact-lane knobs validate at creation for the same
+            # reason as store.type: a bad value must fail the create
+            # request with a 400, not blow up the cluster-state
+            # applier (IndexService init) after the create was acked
+            from elasticsearch_tpu.search import jit_exec as _jit_exec
+            _jit_exec.validate_impact_settings(sett)
             meta = IndexMetadata(
                 name=name,
                 # ES 2.x default shard count (IndexMetaData

@@ -19,10 +19,12 @@ remains a real branch instead of degrading to a select.
 
 Correctness contract (tests/test_impact_index.py): both lanes produce
 BIT-IDENTICAL scores (integer sums × the same scale), and pruning is
-conservative — a block is skipped only when its bound is strictly below
-the current k-th score (ties kept), so the pruned top-k equals the
-unpruned top-k exactly, including the (score desc, doc asc) tie order
-of the exact scorer's merge.
+conservative — a block is skipped only when no query term occurs in it
+(block_max carries an occupancy floor of 1 on present cells, so even
+fully-zero-quantized terms keep their blocks sweepable) or when its
+bound is strictly below the current k-th score (ties kept), so the
+pruned top-k equals the unpruned top-k exactly, including the
+(score desc, doc asc) tie order of the exact scorer's merge.
 """
 
 from __future__ import annotations
@@ -64,8 +66,12 @@ def impact_scores(uterms, qimp, qtids):
 
 def block_bounds(block_max, qtids):
     """Per-block integer upper bounds: Σ_t block_max[:, t] over the
-    query terms. Exact ≥ every in-block quantized score (per-term max
-    is an upper bound of per-term contribution; sums preserve it)."""
+    query terms. ≥ every in-block quantized score (per-term max is an
+    upper bound of per-term contribution; sums preserve it — the
+    occupancy floor of 1 on present cells only loosens the bound by one
+    quantization unit per term). Because absent cells are exactly 0 and
+    present cells ≥ 1, ``ub > 0`` ⟺ some query term OCCURS in the
+    block — the presence test the pruning sweep keys its skip on."""
     nb = block_max.shape[0]
     ub = jnp.zeros(nb, jnp.int32)
     for t in range(qtids.shape[0]):
@@ -119,9 +125,13 @@ def pruned_segment_topk(carry, uterms, qimp, live, block_max, qtids,
     skipped i32, matched i32). Blocks are visited in descending
     upper-bound order; a block runs only when its bound can still reach
     the k-th score (``ub >= θ`` — non-strict, so boundary ties survive)
-    AND some query term occurs in it at all (``ub > 0``). The skipped
-    branch touches none of the block's rows (lax.cond): on real
-    hardware that is skipped compute AND skipped HBM reads."""
+    AND some query term occurs in it at all (``ub_i > 0`` — exact
+    PRESENCE, not a score test: block_max stores present cells with a
+    floor of 1, so a term whose impacts all quantize to 0 still runs
+    its blocks and its score-0 hits match the eager lane's anyhit
+    mask). The skipped branch touches none of the block's rows
+    (lax.cond): on real hardware that is skipped compute AND skipped
+    HBM reads."""
     np_docs, u = uterms.shape
     n_blocks = block_max.shape[0]
     r = np_docs // n_blocks

@@ -308,13 +308,21 @@ class _DeviceBlockCache:
         blk = _Block(key, None, arrays, np.zeros(0, bool), col_bytes,
                      {}, charge)
         evicted = []
+        lost_race = False
         with self._lock:
             cur = self._lru.get(key)
             if cur is not None:
+                # raced duplicate build: keep the incumbent and return
+                # our charge. Report the bytes as REUSED, not uploaded —
+                # the impact counters verify the incremental-refresh
+                # discipline (unchanged segments upload zero bytes), and
+                # the loser's discarded transfer would fail that proof
+                # spuriously.
                 self._lru.move_to_end(key)
                 if charge is not None:
                     charge.release()
                 blk = cur
+                lost_race = True
             else:
                 self._lru[key] = blk
                 while len(self._lru) > self.cap:
@@ -322,7 +330,31 @@ class _DeviceBlockCache:
         for old in evicted:
             if old.charge is not None:
                 old.charge.release()
+        if lost_race:
+            return blk.arrays, 0, blk.col_bytes
         return blk.arrays, col_bytes, 0
+
+    def drop_stale_aux(self, engine_uuid: str, block_uid: int,
+                       sig_prefix: tuple, quant_gen: int) -> int:
+        """Release prior-quantization auxiliary blocks of ONE live
+        segment: a df-drift requant bumps quant_gen into the cache key,
+        so without this sweep the old generation stays keyed to a
+        still-live block_uid and prune(live_uids) never evicts it —
+        stale device arrays and breaker bytes would persist until
+        LRU-cap pressure or engine close. → bytes released."""
+        freed = 0
+        with self._lock:
+            dead = [k for k in self._lru
+                    if k[0] == engine_uuid and k[1] == block_uid
+                    and isinstance(k[2], tuple)
+                    and k[2][:len(sig_prefix)] == sig_prefix
+                    and k[2][len(sig_prefix)] < quant_gen]
+            gone = [self._lru.pop(k) for k in dead]
+        for blk in gone:
+            freed += blk.col_bytes + int(blk.live_np.nbytes)
+            if blk.charge is not None:
+                blk.charge.release()
+        return freed
 
     def prune(self, engine_uuid: str, live_uids: set) -> int:
         """Release blocks of this engine whose segment left the reader
@@ -421,8 +453,11 @@ def fetch_impact_block(engine_uuid: str, block_uid: int, field: str,
     device-resident through the per-segment block cache — the PR 5
     discipline: a refresh uploads impact bytes ONLY for segments whose
     block_uid (or quantization generation, after a df-drift requant) is
-    new; resident blocks reuse outright. → (qimp device array,
-    block_max device array | None, uploaded bytes, reused bytes)."""
+    new; resident blocks reuse outright. A requant's fresh generation
+    evicts the prior one for the same segment (the old key points at a
+    still-live block_uid, so the prune(live_uids) sweep alone would
+    never reclaim it). → (qimp device array, block_max device array |
+    None, uploaded bytes, reused bytes)."""
     has_bm = icol.block_max is not None
     key = (engine_uuid, block_uid,
            ("impact", field, icol.bits, icol.block_rows, icol.quant_gen,
@@ -430,6 +465,11 @@ def fetch_impact_block(engine_uuid: str, block_uid: int, field: str,
     arrays, up, re = _block_cache.fetch_aux(
         key, lambda: [icol.qimp, icol.block_max], breaker_service,
         f"impact block [{engine_uuid[:8]}]")
+    if icol.quant_gen > 0:
+        _block_cache.drop_stale_aux(
+            engine_uuid, block_uid,
+            ("impact", field, icol.bits, icol.block_rows),
+            icol.quant_gen)
     if has_bm:
         return arrays[0], arrays[1], up, re
     return arrays[0], None, up, re

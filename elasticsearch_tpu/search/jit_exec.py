@@ -1229,22 +1229,58 @@ class ImpactPlaneConfig:
 _impact_configs: dict[str, ImpactPlaneConfig] = {}
 
 
+def validate_impact_settings(settings) -> tuple:
+    """Validate the ``index.search.impact.*`` knobs, raising the
+    create-index-time 400 on a bad value — mirroring the store.type
+    idiom: a typo must fail the CREATE REQUEST, never reach the
+    cluster-state applier, and never surface later as a misleading
+    'device-error' fallback when the column build rejects it inside the
+    dispatch seam. → (bits, block_rows, max_terms)."""
+    from elasticsearch_tpu.common.errors import IllegalArgumentError
+    from elasticsearch_tpu.index.segment import (IMPACT_BITS,
+                                                 IMPACT_BLOCK_ROWS)
+    get = settings.get if settings is not None else (lambda *_: None)
+
+    def setting(name, default):
+        raw = get(name, default)
+        try:
+            return int(default if raw is None or raw == "" else raw)
+        except (TypeError, ValueError):
+            raise IllegalArgumentError(
+                f"{name} must be an integer, got [{raw}]")
+
+    bits = setting("index.search.impact.bits", IMPACT_BITS)
+    if bits not in (8, 16):
+        raise IllegalArgumentError(
+            f"index.search.impact.bits must be 8 or 16, got {bits}")
+    block_rows = setting("index.search.impact.block_rows",
+                         IMPACT_BLOCK_ROWS)
+    if block_rows <= 0 or block_rows & (block_rows - 1):
+        raise IllegalArgumentError(
+            "index.search.impact.block_rows must be a power of two, "
+            f"got {block_rows}")
+    max_terms = setting("index.search.impact.max_terms", 16)
+    if max_terms < 1:
+        raise IllegalArgumentError(
+            f"index.search.impact.max_terms must be >= 1, got "
+            f"{max_terms}")
+    return bits, block_rows, max_terms
+
+
 def configure_impact_plane(index_name: str, settings=None) -> None:
     """Register (or with the setting off, clear) an index's impact-lane
     config from its settings. Called at IndexService construction; tests
-    call it directly with a dict."""
+    call it directly with a dict. Bad values raise here too
+    (validate_impact_settings), but the create-index path validates
+    BEFORE the cluster state commits, so the applier never sees them."""
     get = settings.get if settings is not None else (lambda *_: None)
     raw = get("index.search.impact_plane", "false")
     if str(raw).lower() not in ("true", "1"):
         _impact_configs.pop(index_name, None)
         return
-    from elasticsearch_tpu.index.segment import (IMPACT_BITS,
-                                                 IMPACT_BLOCK_ROWS)
+    bits, block_rows, max_terms = validate_impact_settings(settings)
     _impact_configs[index_name] = ImpactPlaneConfig(
-        bits=int(get("index.search.impact.bits", IMPACT_BITS) or
-                 IMPACT_BITS),
-        block_rows=int(get("index.search.impact.block_rows",
-                           IMPACT_BLOCK_ROWS) or IMPACT_BLOCK_ROWS),
+        bits=bits, block_rows=block_rows, max_terms=max_terms,
         prune=str(get("index.search.impact.prune", "true")).lower()
         in ("true", "1"))
 
@@ -1327,17 +1363,24 @@ def _impact_global_df(reader, field: str, col) -> "np.ndarray":
     """READER-global df for one segment's term dictionary: the segment's
     own df plus every sibling segment's count for the same term string —
     the cross-segment aggregation the exact scorer does per query term,
-    done once per impact build over the whole vocabulary."""
+    done once per impact build over the whole vocabulary. Vectorized as
+    a sorted-terms merge (segment term dictionaries are sorted, see
+    TextFieldColumn.terms): O(V log V') numpy per sibling instead of a
+    per-term dict-lookup loop, so large vocabularies don't stall the
+    refresh path host-side."""
     df = np.asarray(col.df, np.int64).copy()
+    if not col.terms:
+        return df
+    terms = np.asarray(col.terms)
     for other in reader.segments:
         ocol = other.seg.text_fields.get(field)
-        if ocol is None or ocol is col:
+        if ocol is None or ocol is col or not ocol.terms:
             continue
-        odf = np.asarray(ocol.df)
-        for i, term in enumerate(col.terms):
-            tid = ocol.term_index.get(term, -1)
-            if tid >= 0:
-                df[i] += int(odf[tid])
+        oterms = np.asarray(ocol.terms)
+        pos = np.minimum(np.searchsorted(oterms, terms),
+                         len(oterms) - 1)
+        hit = oterms[pos] == terms
+        df[hit] += np.asarray(ocol.df, np.int64)[pos[hit]]
     return df
 
 
@@ -1451,6 +1494,54 @@ def note_data_blocks_impact(uploaded: int, reused: int) -> None:
     with _cache_lock:
         _data_layer["impact_bytes_uploaded"] += int(uploaded)
         _data_layer["impact_bytes_reused"] += int(reused)
+
+
+def verify_impact_cursor(pack: _ImpactPack, terms: list, boost: float,
+                         search_after) -> tuple | None:
+    """Admit a score-order search_after cursor to the impact lane only
+    when it was produced by the SAME quantization.
+
+    The lane's in-program continuation compares QUANTIZED scores
+    against the cursor score; a cursor minted by the exact scorer
+    (page 1 fell back — ineligible batch-mate, breaker open, device
+    error) or by a pre-requant quantization differs by up to
+    bound_per_term per matched term, which can skip or duplicate hits
+    across pages. Provenance is verified by recomputation: the cursor
+    doc's quantized score, rebuilt host-side from the pack's resident
+    columns (the same integer sum and the same float32
+    ``qsum · scale · boost`` arithmetic the compiled lanes run), must
+    equal the cursor score bit-for-bit as float32 — true for any cursor
+    this lane emitted under the current quant generation, and
+    essentially never for an exact-scorer float. Score-only cursors
+    (no doc tiebreak) carry nothing to verify against and decline the
+    same way.
+
+    Returns the canonical ``(float score, doc id)`` pair to feed the
+    compiled continuation, or None → the caller declines admission
+    (reason ``cross-lane-cursor``) and the exact scorer serves the
+    page."""
+    if len(search_after) != 2:
+        return None
+    doc = int(search_after[1])
+    want = np.float32(float(search_after[0]))
+    for s in pack.segs:
+        base = s["doc_base"]
+        if not (base <= doc < base + s["np_docs"]):
+            continue
+        row = doc - base
+        ut = np.asarray(s["host"].uterms[row])
+        qi = s["col"].qimp[row].astype(np.int64)
+        tidx = s["host"].term_index
+        qsum = 0
+        for term in terms:
+            tid = tidx.get(term, -1)
+            if tid >= 0:
+                qsum += int(qi[ut == tid].sum())
+        scale_boost = np.float32(np.float32(s["scale"]) *
+                                 np.float32(boost))
+        got = np.float32(np.float32(qsum) * scale_boost)
+        return (float(want), doc) if got == want else None
+    return None
 
 
 def _impact_query_inputs(pack: _ImpactPack, term_lists: list,

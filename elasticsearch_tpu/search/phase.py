@@ -629,14 +629,6 @@ class ShardSearcher:
         k = max(max(req.from_ + req.size, 1) for req in reqs)
         term_lists = [terms for _, terms, _ in specs]
         boosts = [boost for _, _, boost in specs]
-        cursors = []
-        for req in reqs:
-            if req.search_after is None:
-                cursors.append(None)
-            else:
-                sa = req.search_after
-                cursors.append((float(sa[0]),
-                                int(sa[1]) if len(sa) > 1 else -1))
         prune = cfg.prune and all(req.track_total_hits is False
                                   for req in reqs)
         try:
@@ -646,6 +638,22 @@ class ShardSearcher:
             if pack is None:
                 jit_exec.note_impact_fallback("no-impact-columns")
                 return None
+            # cursor provenance: the in-program continuation compares
+            # QUANTIZED scores, so a cursor minted by the exact scorer
+            # (prior page fell back) or by a pre-requant quantization
+            # would skip/duplicate hits across pages — verify each
+            # cursor against the pack and decline the batch otherwise
+            cursors = []
+            for req, terms, boost in zip(reqs, term_lists, boosts):
+                if req.search_after is None:
+                    cursors.append(None)
+                    continue
+                cur = jit_exec.verify_impact_cursor(
+                    pack, terms, boost, req.search_after)
+                if cur is None:
+                    jit_exec.note_impact_fallback("cross-lane-cursor")
+                    return None
+                cursors.append(cur)
             if prune and not pack.can_prune:
                 prune = False               # block tables over budget
             run = jit_exec.run_impact_pruned if prune \

@@ -259,6 +259,86 @@ def test_search_after_cursor_continuation(node, rng):
     np.testing.assert_array_equal(page2.doc_ids, page2e.doc_ids)
 
 
+def test_zero_quantized_term_pruned_parity(node):
+    """Extreme idf skew: a term occurring in EVERY doc quantizes
+    entirely to 0 (its impacts sit below half a step of the rare-term
+    max that sets the segment-global scale). The eager lane still
+    counts its docs as hits at score 0 — anyhit is the MATCH mask, not
+    the score — so the pruned sweep must agree: the skip is gated on
+    term PRESENCE in the block (block_max occupancy floor), never on
+    the quantized bound alone."""
+    n = 300
+    docs = [{"t": "c " + " ".join(f"f{i}x{j}" for j in range(5)),
+             "v": i} for i in range(n)]
+    _mk_index(node, "zq", docs, block_rows=64)
+    # premise check: the common term's quantized impacts are ALL zero,
+    # yet its block_max cells are non-zero (occupancy floor)
+    svc = node.indices_service.indices["zq"]
+    pack = jit_exec.impact_pack_for(
+        device_reader_for(svc.engine(0)), "t",
+        jit_exec.impact_plane_config("zq"))
+    seen = 0
+    for sg in pack.segs:
+        tid = sg["host"].term_index.get("c", -1)
+        if tid < 0:
+            continue
+        mask = np.asarray(sg["host"].uterms) == tid
+        assert mask.any()
+        assert int(sg["col"].qimp[mask].max()) == 0, \
+            "corpus not skewed enough to zero-quantize the common term"
+        assert int(np.asarray(sg["col"].block_max)[:, tid].max()) > 0
+        seen += 1
+    assert seen > 0
+    s = _searcher(node, "zq")
+    for k in (1, 7, 40):
+        body = {"query": {"match": {"t": "c"}}, "size": k}
+        pruned = s.query_phase(parse_search_request(
+            {**body, "track_total_hits": False}))
+        unpruned = s.query_phase(parse_search_request(body))
+        assert len(pruned.doc_ids) == k, f"k={k}"
+        np.testing.assert_array_equal(pruned.doc_ids, unpruned.doc_ids,
+                                      err_msg=f"k={k}")
+        np.testing.assert_array_equal(pruned.scores, unpruned.scores)
+
+
+def test_cross_lane_cursor_declines(node, rng):
+    """search_after provenance: the impact lane compares QUANTIZED
+    scores against the cursor, so only cursors it minted itself (same
+    quantization) are admitted — verified by recomputing the cursor
+    doc's quantized score from the pack. Off-grid cursors (exact-scorer
+    page 1, requant between pages) and score-only cursors decline
+    reason-labeled and the exact scorer serves the page."""
+    docs = _skewed_docs(rng, 240)
+    _mk_index(node, "xl", docs)
+    s = _searcher(node, "xl")
+    body = {"query": {"match": {"t": "w1 w3"}}, "size": 8,
+            "track_total_hits": False}
+    page1 = s.query_phase(parse_search_request(body))
+    assert len(page1.doc_ids) == 8
+    cur = [float(page1.scores[-1]), int(page1.doc_ids[-1])]
+    adm0 = _impact_stats()["impact_admissions"]
+    s.query_phase(parse_search_request({**body, "search_after": cur}))
+    assert _impact_stats()["impact_admissions"] > adm0, \
+        "same-quantization cursor must stay on the impact lane"
+
+    def declines():
+        return jit_exec.cache_stats()["impact_fallback_reasons"] \
+            .get("cross-lane-cursor", 0)
+    # a score the current quantization cannot produce for that doc
+    off = [float(page1.scores[-1]) + 1e-4, int(page1.doc_ids[-1])]
+    base = declines()
+    adm1 = _impact_stats()["impact_admissions"]
+    got = s.query_phase(parse_search_request(
+        {**body, "search_after": off}))
+    assert got is not None and len(got.doc_ids) > 0
+    assert declines() == base + 1
+    assert _impact_stats()["impact_admissions"] == adm1
+    # score-only cursor: no doc tiebreak to verify against
+    s.query_phase(parse_search_request(
+        {**body, "search_after": [float(page1.scores[-1])]}))
+    assert declines() == base + 2
+
+
 def test_blocks_actually_skip(node, rng):
     docs = _skewed_docs(rng, 400, vocab=120)
     _mk_index(node, "sk", docs, block_rows=64)
@@ -342,6 +422,135 @@ def test_df_drift_forces_requant(node, rng):
     s2 = _searcher(node, "drift")
     s2.query_phase(req)
     assert _impact_stats()["impact_requant_refreshes"] > 0
+
+
+def test_requant_drops_stale_generation_blocks(node, rng):
+    """A df-drift requant bumps quant_gen into the block-cache key; the
+    fresh generation must EVICT the prior one for the same segment —
+    the old key points at a still-live block_uid, so the prune sweep
+    alone would keep its device arrays and breaker bytes resident until
+    LRU pressure or engine close."""
+    docs = _skewed_docs(rng, 150)
+    _mk_index(node, "gen", docs)
+    s = _searcher(node, "gen")
+    req = parse_search_request({"query": {"match": {"t": "w1"}},
+                                "size": 5})
+    s.query_phase(req)
+    for i in range(170):
+        node.index_doc("gen", f"d{i}",
+                       {"t": f"w1 w{int(rng.integers(1, 50))}", "v": i})
+    node.broadcast_actions.refresh("gen")
+    s2 = _searcher(node, "gen")
+    s2.query_phase(req)
+    assert _impact_stats()["impact_requant_refreshes"] > 0
+    gens: dict = {}
+    for key in mesh_engine.block_cache_keys():
+        sig = key[2]
+        if isinstance(sig, tuple) and sig and sig[0] == "impact":
+            gens.setdefault((key[0], key[1]) + sig[1:4],
+                            set()).add(sig[4])
+    assert gens, "expected resident impact blocks"
+    assert all(len(v) == 1 for v in gens.values()), \
+        f"stale quantization generations still resident: {gens}"
+
+
+def test_lost_upload_race_counts_as_reuse():
+    """Two threads racing the same impact-block upload: the loser's
+    transfer is discarded in favor of the incumbent, so its bytes must
+    report as REUSED, not uploaded — the impact counters prove the
+    incremental-refresh discipline and a phantom upload would fail that
+    proof spuriously."""
+    import threading
+    cache = mesh_engine._block_cache
+    key = ("race-engine", 987654, ("impact", "t", 8, 64, 0, False))
+    arr = np.arange(64, dtype=np.uint8).reshape(8, 8)
+    barrier = threading.Barrier(2)
+    results, errors = [], []
+
+    def build():
+        barrier.wait(timeout=10)        # both threads are mid-miss
+        return [arr]
+
+    def worker():
+        try:
+            results.append(cache.fetch_aux(key, build, None, "race"))
+        except Exception as e:          # noqa: BLE001 — surfaced below
+            errors.append(e)
+    try:
+        threads = [threading.Thread(target=worker) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert sorted(r[1] for r in results) == [0, arr.nbytes], \
+            "exactly one racer may account an upload"
+        assert sorted(r[2] for r in results) == [0, arr.nbytes], \
+            "the raced loser must report its bytes as reused"
+        # both racers hold the SAME resident block
+        assert results[0][0] is results[1][0]
+    finally:
+        cache.release_engine("race-engine")
+
+
+def test_global_df_merges_sibling_segments(node, rng):
+    """The vectorized sorted-terms df merge equals the brute-force
+    per-term dict aggregation across a multi-segment reader."""
+    docs = _skewed_docs(rng, 120)
+    _mk_index(node, "gdf", docs)
+    for i in range(40):
+        node.index_doc("gdf", f"g{i}",
+                       {"t": f"w1 w{int(rng.integers(1, 70))}", "v": i})
+    node.broadcast_actions.refresh("gdf")
+    svc = node.indices_service.indices["gdf"]
+    reader = device_reader_for(svc.engine(0))
+    cols = [d.seg.text_fields["t"] for d in reader.segments
+            if d.seg.text_fields.get("t") is not None]
+    assert len(cols) >= 2, "need sibling segments"
+    for col in cols:
+        got = jit_exec._impact_global_df(reader, "t", col)
+        want = np.asarray(col.df, np.int64).copy()
+        for ocol in cols:
+            if ocol is col:
+                continue
+            odf = np.asarray(ocol.df)
+            for i, term in enumerate(col.terms):
+                tid = ocol.term_index.get(term, -1)
+                if tid >= 0:
+                    want[i] += int(odf[tid])
+        np.testing.assert_array_equal(got, want)
+
+
+def test_impact_settings_validated_at_creation(node):
+    """Bad impact settings fail the CREATE REQUEST with a 400-typed
+    error — not the cluster-state applier after the create was acked,
+    and never a misleading 'device-error' fallback inside the dispatch
+    seam — and max_terms is wired through."""
+    from elasticsearch_tpu.common.errors import IllegalArgumentError
+    base = {"index.search.impact_plane": "true"}
+    with pytest.raises(IllegalArgumentError, match="bits"):
+        jit_exec.configure_impact_plane(
+            "badbits", {**base, "index.search.impact.bits": 12})
+    with pytest.raises(IllegalArgumentError, match="block_rows"):
+        jit_exec.configure_impact_plane(
+            "badrows", {**base, "index.search.impact.block_rows": 100})
+    with pytest.raises(IllegalArgumentError, match="max_terms"):
+        jit_exec.configure_impact_plane(
+            "badterms", {**base, "index.search.impact.max_terms": 0})
+    for name in ("badbits", "badrows", "badterms"):
+        assert jit_exec.impact_plane_config(name) is None
+    try:
+        jit_exec.configure_impact_plane(
+            "mt", {**base, "index.search.impact.max_terms": 4})
+        assert jit_exec.impact_plane_config("mt").max_terms == 4
+    finally:
+        jit_exec._impact_configs.pop("mt", None)
+    # end-to-end: the create request itself rejects, no index appears
+    with pytest.raises(IllegalArgumentError, match="power of two"):
+        node.indices_service.create_index("badidx", {
+            "settings": {"index.search.impact_plane": True,
+                         "index.search.impact.block_rows": 100}})
+    assert "badidx" not in node.indices_service.indices
 
 
 def test_engine_close_releases_impact_blocks(node, rng):
