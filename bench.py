@@ -641,15 +641,94 @@ def main() -> int:
                 "boost_mode": "multiply"}}, "size": k}
                 for qi in range(ncq)]
             measure("function_score", bodies)
-            # config 4: brute-force cosine kNN over unit vectors
+            # config 4: brute-force cosine kNN over unit vectors —
+            # served by the TOP-LEVEL `knn` section (the dedicated
+            # vector lane with candidate oversampling; the query-DSL
+            # `knn` leaf remains as a back-compat alias, parity-pinned
+            # in tests/test_knn_hybrid.py)
             if with_vectors:
                 qvecs = rng.standard_normal(
                     (ncq, vec_dims)).astype(np.float32)
                 qvecs /= np.linalg.norm(qvecs, axis=1, keepdims=True)
-                bodies = [{"query": {"knn": {
-                    "field": "vec", "query_vector": qvecs[qi].tolist()}},
-                    "size": min(k, 100)} for qi in range(ncq)]
+                kc = min(k, 100)
+                bodies = [{"knn": {
+                    "field": "vec", "query_vector": qvecs[qi].tolist(),
+                    "k": kc, "num_candidates": max(kc, 100)},
+                    "size": kc} for qi in range(ncq)]
                 measure("dense_cosine", bodies)
+
+        # ---- rag_hybrid leg: msearch-heavy hybrid (BM25+kNN RRF) ------
+        # retrieval under 16/32 concurrent clients — the RAG workload
+        # (PAPERS.md, Elasticsearch-RAG): every request carries BOTH a
+        # lexical clause and a knn section, fused IN-PROGRAM via RRF so
+        # each is one device dispatch. Stamps QPS, fusion-dispatch /
+        # admission counters (reconciled against the request count),
+        # and int8-vs-f32 recall@10 over the same resident corpus.
+        rag_hybrid = {}
+        if os.environ.get("BENCH_RAG", "1") != "0" and with_vectors:
+            from elasticsearch_tpu.search import jit_exec as _jx
+            nrq = min(n_queries, batch * 4)
+            rag_rng = np.random.default_rng(777)
+            rag_qv = rag_rng.standard_normal(
+                (nrq, vec_dims)).astype(np.float32)
+            rag_qv /= np.linalg.norm(rag_qv, axis=1, keepdims=True)
+            kc = min(k, 100)
+            hreqs = [parse_search_request({
+                "query": {"match": {"body": texts[qi % len(texts)]}},
+                "knn": {"field": "vec",
+                        "query_vector": rag_qv[qi].tolist(),
+                        "k": kc, "num_candidates": max(kc, 100)},
+                "size": kc}) for qi in range(nrq)]
+            hbs = [hreqs[i:i + batch]
+                   for i in range(0, len(hreqs), batch)] or [[]]
+            st0 = _jx.cache_stats()
+            t0 = time.perf_counter()
+            r0 = searcher.query_phase_batch(hbs[0])
+            rag_compile_s = time.perf_counter() - t0
+            assert r0 is not None, "rag_hybrid batch fell back"
+            rag_clients = {}
+            for nclients in (16, 32):
+                qps_h, ms_h = timed_throughput(
+                    searcher.query_phase_batch, hbs, nclients)
+                rag_clients[str(nclients)] = {
+                    "qps": round(qps_h, 2),
+                    "ms_per_batch": round(ms_h, 2)}
+                log(f"[bench] rag_hybrid x{nclients} clients: "
+                    f"{qps_h:.1f} QPS ({ms_h:.1f} ms/batch)")
+            st1 = _jx.cache_stats()
+            # int8-vs-f32 recall@10: the same reader scored through an
+            # int8-quantized pack (per-segment scale/offset snapshot)
+            # vs the exact f32 pack
+            _jx.configure_knn_plane("bench_rag_int8",
+                                    {"index.knn.quantization": "int8"})
+            s8 = ShardSearcher(0, searcher.reader, ms_map,
+                               index_name="bench_rag_int8")
+            overlap = total_top = 0
+            for qi in range(min(nrq, 32)):
+                kb = {"knn": {"field": "vec",
+                              "query_vector": rag_qv[qi].tolist(),
+                              "k": 10, "num_candidates": 100},
+                      "size": 10}
+                rf = searcher.query_phase(parse_search_request(kb))
+                r8 = s8.query_phase(parse_search_request(kb))
+                f_ids = set(np.asarray(rf.doc_ids).tolist())
+                overlap += len(
+                    f_ids & set(np.asarray(r8.doc_ids).tolist()))
+                total_top += len(f_ids)
+            rag_hybrid = {
+                "clients": rag_clients,
+                "compile_s": round(rag_compile_s, 1),
+                "fusion_dispatches":
+                    st1["fusion_dispatches"] - st0["fusion_dispatches"],
+                "knn_admissions":
+                    st1["knn_admissions"] - st0["knn_admissions"],
+                "knn_fallback_reasons":
+                    dict(st1.get("knn_fallback_reasons", {})),
+                "int8_recall_at_10":
+                    round(overlap / max(total_top, 1), 4),
+            }
+            log(f"[bench] rag_hybrid int8-vs-f32 recall@10: "
+                f"{rag_hybrid['int8_recall_at_10']}")
 
         # request-at-a-time path (the reference's dispatch model,
         # QueryPhase.java:314). Three measurements tell the whole story:
@@ -824,7 +903,8 @@ def main() -> int:
                   "threads": n_threads,
                   "compile_s": round(compile_s, 1),
                   "trace": trace_art,
-                  "configs": configs}
+                  "configs": configs,
+                  "rag_hybrid": rag_hybrid}
         eng.close()
 
         # ---- BASELINE config 5: 8-shard query_then_fetch top-1000 ------

@@ -1320,6 +1320,14 @@ class SearchActions:
             if req.suggest or req.rescore:
                 self._note_plane_fallback(indices, "ineligible-shape")
                 return None
+            if req.knn is not None:
+                # a top-level knn section is served by the dedicated
+                # vector lane (ShardSearcher._knn_batch_launch) on the
+                # fan-out path — the mesh program has no vector/fusion
+                # lanes, and silently dropping the section would return
+                # lexical-only hits
+                self._note_plane_fallback(indices, "knn-lane")
+                return None
         if not all(self._plane_precheck(index, reqs)
                    for index in indices):
             # always-ineligible shape (_doc sort, sub-aggs, doc-id score
@@ -1483,7 +1491,7 @@ class SearchActions:
                     or req.min_score is not None or req.suggest
                     or req.terminate_after is not None
                     or req.timeout_ms is not None or req.rescore
-                    or req.explain):
+                    or req.explain or req.knn is not None):
                 return False
             if req.search_after is not None and \
                     len(req.search_after) not in (1, 2):

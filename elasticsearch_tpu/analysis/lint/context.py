@@ -94,14 +94,21 @@ class LintConfig:
     known_sites: tuple = ("dispatch", "compile", "upload", "compose",
                           "plane-dispatch", "percolate", "reader-upload",
                           "impact-upload", "blockmax-compose",
-                          "pruning-dispatch")
+                          "pruning-dispatch",
+                          # dense/late-interaction lane: vector block
+                          # upload, fused MaxSim + hybrid-fusion
+                          # dispatches
+                          "vector-upload", "maxsim-dispatch",
+                          "fusion-dispatch")
     #: site classes that mark a LOOP as a dispatch loop (host-sync rule)
     dispatch_sites: tuple = ("dispatch", "plane-dispatch", "percolate",
-                             "pruning-dispatch")
+                             "pruning-dispatch", "maxsim-dispatch",
+                             "fusion-dispatch")
     #: site classes that dominate a raw ``jax.device_put`` inside a seam
     #: module (the upload/compose family of device touchpoints)
     upload_sites: tuple = ("upload", "compose", "reader-upload",
-                           "impact-upload", "blockmax-compose")
+                           "impact-upload", "blockmax-compose",
+                           "vector-upload")
     #: the seam entry points (calls routed through these are guarded)
     fault_point_names: tuple = ("device_fault_point",)
     seam_wrappers: tuple = ("seam_device_put", "seam_jit")

@@ -73,6 +73,20 @@ class DeviceVectorField:
 
 
 @dataclass
+class DeviceMultiVectorField:
+    """rank_vectors column: [Np, T, D] token matrices (each real token
+    row L2-normalized so per-token dot = cosine), late-interaction
+    scored by the fused MaxSim kernel. ``vecs`` is LAZY like the dense
+    vector columns; the knn lane reads its device copy through the
+    per-segment block cache (mesh_engine.fetch_vector_block), not this
+    field."""
+    vecs: Any        # [Np, T, D] f32
+    lens: Any        # [Np] i32
+    exists: Any
+    column: Any
+
+
+@dataclass
 class DeviceGeoField:
     lat: Any
     lon: Any
@@ -112,6 +126,8 @@ class DeviceSegment:
     geo: dict[str, DeviceGeoField]
     nested: dict[str, "DeviceNestedBlock"] = dc_field(default_factory=dict)
     shape: dict[str, DeviceShapeField] = dc_field(default_factory=dict)
+    mvector: dict[str, DeviceMultiVectorField] = dc_field(
+        default_factory=dict)
     # device_put for LAZY columns (tokens / vecs): those stay host-side
     # numpy until a plan declares it needs them (jit_exec.seg_flatten
     # materializes + caches on first use). Position matrices and dense
@@ -223,6 +239,15 @@ class DeviceReader:
             vector[name] = DeviceVectorField(
                 vecs=np.ascontiguousarray(normed.astype(np.float32)),  # lazy
                 exists=put(c.exists), column=c)
+        mvector = {}
+        for name, c in seg.mvector_fields.items():
+            # per-TOKEN normalization (padding rows stay zero): MaxSim's
+            # token dot is then the token cosine, matching the dense lane
+            norms = np.linalg.norm(c.vecs, axis=2, keepdims=True)
+            normed = c.vecs / np.maximum(norms, 1e-12)
+            mvector[name] = DeviceMultiVectorField(
+                vecs=np.ascontiguousarray(normed.astype(np.float32)),  # lazy
+                lens=put(c.lens), exists=put(c.exists), column=c)
         geo = {name: DeviceGeoField(lat=put(c.lat.astype(np.float32)),
                                     lon=put(c.lon.astype(np.float32)),
                                     exists=put(c.exists), column=c)
@@ -247,7 +272,7 @@ class DeviceReader:
         return DeviceSegment(seg=seg, live=put(live), doc_base=doc_base,
                              text=text, keyword=keyword, numeric=numeric,
                              vector=vector, geo=geo, nested=nested,
-                             shape=shape,
+                             shape=shape, mvector=mvector,
                              lazy_put=put if resident else None,
                              resident=resident)
 

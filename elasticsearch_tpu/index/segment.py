@@ -46,7 +46,7 @@ import numpy as np
 from elasticsearch_tpu.common.versioning import CURRENT_VERSION
 from elasticsearch_tpu.mapping.mapper import (
     ParsedDocument, KIND_TEXT, KIND_KEYWORD, KIND_NUMERIC, KIND_VECTOR,
-    KIND_GEO, KIND_SHAPE)
+    KIND_MVECTOR, KIND_GEO, KIND_SHAPE)
 
 # Process-unique block identities (itertools.count.__next__ is atomic under
 # CPython): every Segment object gets one at construction. seg_id alone is
@@ -344,6 +344,63 @@ class VectorFieldColumn:
 
 
 @dataclass
+class MultiVectorFieldColumn:
+    """``rank_vectors`` doc values: per-doc [T, D] token matrices padded
+    to the column-wide pow2 token bucket (like the uterms layout), for
+    late-interaction MaxSim scoring (ops/maxsim.py). ``lens`` marks the
+    real token rows; padding rows are zero."""
+    vecs: np.ndarray                 # [Np, T, D] float32
+    lens: np.ndarray                 # [Np] int32 real token rows
+    exists: np.ndarray               # [Np] bool
+    dims: int
+
+
+@dataclass
+class QuantizedVectorColumn:
+    """int8 scalar quantization of one segment's vector column
+    (`index.knn.quantization: int8`): ``v ≈ q·scale + offset`` per
+    component, with the scale/offset SNAPSHOT taken over the segment's
+    own value range at quantization time — segments are immutable, so
+    unlike the impact columns (reader-global idf snapshots) the
+    snapshot never drifts and never requantizes. Per-component error is
+    ≤ ``scale/2``; a query's score error is bounded by
+    ``scale/2 · Σ|q_i|`` (the stamped quantization bound the recall
+    tests assert against)."""
+    qvecs: np.ndarray                # [Np, D] or [Np, T, D] int8
+    scale: float
+    offset: float
+    dims: int
+
+    def score_bound(self, qn: np.ndarray) -> float:
+        """Score-units error bound for one (normalized) query vector:
+        per-component quantization error ≤ scale/2, accumulated over
+        the |q|-weighted sum — for MaxSim, per QUERY TOKEN (the max
+        over doc tokens moves by at most the per-token bound)."""
+        q = np.abs(np.asarray(qn, np.float64))
+        if q.ndim == 1:
+            return float(self.scale * 0.5 * q.sum())
+        return float(self.scale * 0.5 * q.sum(axis=-1).sum())
+
+
+def quantize_vectors(vecs: np.ndarray, dims: int) -> QuantizedVectorColumn:
+    """Asymmetric int8 scalar quantization over one segment's (already
+    L2-normalized) vector values: offset centers the range, scale maps
+    it onto [-127, 127]. Pure numpy; paid once per NEW segment (the
+    host column caches on the immutable Segment, PR 5 discipline)."""
+    v = np.asarray(vecs, np.float32)
+    if v.size:
+        mn, mx = float(v.min()), float(v.max())
+    else:
+        mn = mx = 0.0
+    offset = np.float32((mx + mn) / 2.0)
+    half = max(mx - float(offset), float(offset) - mn)
+    scale = np.float32(half / 127.0) if half > 0 else np.float32(1.0)
+    q = np.clip(np.rint((v - offset) / scale), -127, 127).astype(np.int8)
+    return QuantizedVectorColumn(qvecs=q, scale=float(scale),
+                                 offset=float(offset), dims=dims)
+
+
+@dataclass
 class GeoFieldColumn:
     lat: np.ndarray                  # [Np] float64
     lon: np.ndarray                  # [Np] float64
@@ -410,6 +467,9 @@ class Segment:
     source_complete: bool = True
     # nested path → child block (mapping "type": "nested")
     nested_blocks: dict[str, NestedBlock] = dc_field(default_factory=dict)
+    # rank_vectors columns (multi-vector late interaction)
+    mvector_fields: dict[str, MultiVectorFieldColumn] = dc_field(
+        default_factory=dict)
     # geo_shape columns (vertex rings, ShapeFieldColumn)
     shape_fields: dict[str, ShapeFieldColumn] = dc_field(
         default_factory=dict)
@@ -431,6 +491,8 @@ class Segment:
             total += col.values.nbytes + col.exists.nbytes
         for col in self.vector_fields.values():
             total += col.vecs.nbytes
+        for col in self.mvector_fields.values():
+            total += col.vecs.nbytes + col.lens.nbytes
         for col in self.geo_fields.values():
             total += col.lat.nbytes + col.lon.nbytes
         for col in self.shape_fields.values():
@@ -534,6 +596,12 @@ class Segment:
             meta["vector_fields"][name] = {"dims": c.dims}
             arrays[f"v.{name}.vecs"] = c.vecs
             arrays[f"v.{name}.exists"] = c.exists
+        meta["mvector_fields"] = {name: {"dims": c.dims}
+                                  for name, c in self.mvector_fields.items()}
+        for name, c in self.mvector_fields.items():
+            arrays[f"mv.{name}.vecs"] = c.vecs
+            arrays[f"mv.{name}.lens"] = c.lens
+            arrays[f"mv.{name}.exists"] = c.exists
         for name, c in self.geo_fields.items():
             meta["geo_fields"].append(name)
             arrays[f"g.{name}.lat"] = c.lat
@@ -627,6 +695,12 @@ class Segment:
                                     exists=arrays[f"v.{name}.exists"],
                                     dims=info["dims"])
             for name, info in meta["vector_fields"].items()}
+        mvector_fields = {
+            name: MultiVectorFieldColumn(
+                vecs=arrays[f"mv.{name}.vecs"],
+                lens=arrays[f"mv.{name}.lens"],
+                exists=arrays[f"mv.{name}.exists"], dims=info["dims"])
+            for name, info in meta.get("mvector_fields", {}).items()}
         geo_fields = {
             name: GeoFieldColumn(lat=arrays[f"g.{name}.lat"],
                                  lon=arrays[f"g.{name}.lon"],
@@ -654,7 +728,8 @@ class Segment:
                        geo_fields=geo_fields, version_id=meta["version_id"],
                        source_complete=meta.get("source_complete", True),
                        nested_blocks=nested_blocks,
-                       shape_fields=shape_fields)
+                       shape_fields=shape_fields,
+                       mvector_fields=mvector_fields)
 
 
 class SegmentBuilder:
@@ -694,6 +769,7 @@ class SegmentBuilder:
         keyword_fields: dict[str, KeywordFieldColumn] = {}
         numeric_fields: dict[str, NumericFieldColumn] = {}
         vector_fields: dict[str, VectorFieldColumn] = {}
+        mvector_fields: dict[str, MultiVectorFieldColumn] = {}
         geo_fields: dict[str, GeoFieldColumn] = {}
         shape_fields: dict[str, ShapeFieldColumn] = {}
 
@@ -706,6 +782,9 @@ class SegmentBuilder:
                 numeric_fields[fname] = self._build_numeric(fname, n, np_docs)
             elif kind == KIND_VECTOR:
                 vector_fields[fname] = self._build_vector(fname, n, np_docs)
+            elif kind == KIND_MVECTOR:
+                mvector_fields[fname] = self._build_mvector(fname, n,
+                                                            np_docs)
             elif kind == KIND_GEO:
                 geo_fields[fname] = self._build_geo(fname, n, np_docs)
             elif kind == KIND_SHAPE:
@@ -718,6 +797,7 @@ class SegmentBuilder:
             text_fields=text_fields, keyword_fields=keyword_fields,
             numeric_fields=numeric_fields, vector_fields=vector_fields,
             geo_fields=geo_fields, shape_fields=shape_fields,
+            mvector_fields=mvector_fields,
             nested_blocks=self._build_nested())
 
     def _build_nested(self) -> dict[str, NestedBlock]:
@@ -846,6 +926,33 @@ class SegmentBuilder:
                 vecs[i] = pf.vector
                 exists[i] = True
         return VectorFieldColumn(vecs=vecs, exists=exists, dims=dims)
+
+    def _build_mvector(self, fname: str, n: int,
+                       np_docs: int) -> MultiVectorFieldColumn:
+        dims = 0
+        tmax = 1
+        for d in self.docs:
+            pf = self._field(d, fname)
+            if pf is not None and pf.mvector is not None:
+                dims = int(pf.mvector.shape[1])
+                tmax = max(tmax, int(pf.mvector.shape[0]))
+        # pow2 token bucket (like uterms' _ROW_PAD padding) so segments
+        # with similar token counts share compiled MaxSim shapes
+        t_pad = 1
+        while t_pad < tmax:
+            t_pad *= 2
+        vecs = np.zeros((np_docs, t_pad, max(dims, 1)), np.float32)
+        lens = np.zeros(np_docs, np.int32)
+        exists = np.zeros(np_docs, bool)
+        for i, d in enumerate(self.docs):
+            pf = self._field(d, fname)
+            if pf is not None and pf.mvector is not None:
+                t = pf.mvector.shape[0]
+                vecs[i, :t] = pf.mvector
+                lens[i] = t
+                exists[i] = True
+        return MultiVectorFieldColumn(vecs=vecs, lens=lens, exists=exists,
+                                      dims=dims)
 
     def _build_geo(self, fname: str, n: int, np_docs: int) -> GeoFieldColumn:
         lat = np.zeros(np_docs, dtype=np.float64)

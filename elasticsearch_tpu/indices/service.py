@@ -76,6 +76,12 @@ def _impact_lane_stats(index_name: str) -> dict:
     return jit_exec.impact_index_stats(index_name)
 
 
+def _knn_lane_stats(index_name: str) -> dict:
+    """One index's knn-lane rollup for _stats (lazy import)."""
+    from elasticsearch_tpu.search import jit_exec
+    return jit_exec.knn_index_stats(index_name)
+
+
 class ShardNotLocalError(Exception):
     """The target shard copy lives on another node — the action layer must
     route the operation over the transport."""
@@ -145,6 +151,10 @@ class IndexService:
         # scorer as the only scorer
         from elasticsearch_tpu.search import jit_exec as _jit_exec
         _jit_exec.configure_impact_plane(self.name, self.index_settings)
+        # knn-lane config (`index.knn.quantization`, hybrid fusion
+        # knobs): always registered — the top-level `knn` search
+        # section is the lane's opt-in, the settings only tune it
+        _jit_exec.configure_knn_plane(self.name, self.index_settings)
         # per-type indexing counters (ShardIndexingService typeStats)
         self.indexing_types: dict[str, int] = {}
         self.engines: dict[int, Engine] = {}
@@ -455,6 +465,11 @@ class IndexService:
                 # attributed to THIS index (skip_ratio ≫ 0 is the
                 # per-index sublinearity evidence without the profiler)
                 "impact": _impact_lane_stats(self.name),
+                # dense/late-interaction lane: compiled-lane admissions,
+                # hybrid fusion dispatches (reconciles with the hybrid
+                # request count — one dispatch per request), MaxSim
+                # dispatches over rank_vectors, attributed to THIS index
+                "knn": _knn_lane_stats(self.name),
                 "groups": {
                     g: {"query_total": b["query_total"],
                         "query_time_in_millis": int(b["query_time_ms"]),
@@ -904,6 +919,10 @@ class IndicesService:
             # applier (IndexService init) after the create was acked
             from elasticsearch_tpu.search import jit_exec as _jit_exec
             _jit_exec.validate_impact_settings(sett)
+            _jit_exec.validate_knn_settings(sett)
+            from elasticsearch_tpu.mapping.mapper import (
+                validate_vector_mappings)
+            validate_vector_mappings(mappings)
             meta = IndexMetadata(
                 name=name,
                 # ES 2.x default shard count (IndexMetaData

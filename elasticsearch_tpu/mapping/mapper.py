@@ -38,8 +38,17 @@ KIND_TEXT = "text"
 KIND_KEYWORD = "keyword"
 KIND_NUMERIC = "numeric"   # long/integer/short/byte/double/float/date/boolean
 KIND_VECTOR = "vector"
+KIND_MVECTOR = "mvector"   # rank_vectors: per-doc [T, D] token matrices
 KIND_GEO = "geo"
 KIND_SHAPE = "shape"
+
+#: dense_vector / rank_vectors dims ceiling — bounds the per-doc row the
+#: MXU matmuls over (and the create-request 400 for absurd mappings)
+MAX_VECTOR_DIMS = 4096
+#: rank_vectors token cap ceiling (per-doc [T, D] matrices are padded to
+#: the mapping's max_tokens, so T is HBM — keep it bounded)
+MAX_RANK_VECTOR_TOKENS = 512
+DEFAULT_RANK_VECTOR_TOKENS = 32
 
 NUMERIC_TYPES = {"long", "integer", "short", "byte", "double", "float",
                  "half_float", "date", "boolean", "murmur3", "ip",
@@ -82,6 +91,27 @@ def cidr_range(v: str) -> tuple[int, int]:
     return lo, lo | ((1 << (32 - n)) - 1)
 
 POSITION_INCREMENT_GAP = 16
+
+
+def _vector_dims(name: str, ftype: str, params) -> int:
+    """Validate a vector mapping's ``dims`` at CREATE time with the
+    400-typed error idiom (store.type / impact settings): a bad value
+    must fail the create/mapping request, never surface later as a
+    score-time shape error."""
+    raw = params.get("dims", 0)
+    try:
+        dims = int(raw)
+    except (TypeError, ValueError):
+        raise IllegalArgumentError(
+            f"{ftype} field [{name}] dims must be an integer, "
+            f"got [{raw}]") from None
+    if dims <= 0:
+        raise MapperParsingError(f"{ftype} field [{name}] requires dims")
+    if dims > MAX_VECTOR_DIMS:
+        raise IllegalArgumentError(
+            f"{ftype} field [{name}] dims must be <= {MAX_VECTOR_DIMS}, "
+            f"got {dims}")
+    return dims
 
 
 def completion_context_value(cfg: dict, raw) -> str:
@@ -159,6 +189,7 @@ class ParsedField:
     keywords: list[str] = field(default_factory=list)       # KIND_KEYWORD
     numerics: list[float] = field(default_factory=list)     # KIND_NUMERIC
     vector: np.ndarray | None = None                        # KIND_VECTOR
+    mvector: np.ndarray | None = None                       # KIND_MVECTOR [T, D]
     geo: tuple[float, float] | None = None                  # KIND_GEO (lat, lon)
     # KIND_SHAPE: (lats, lons) closed vertex ring (utils/geoshape)
     shape: tuple[list[float], list[float]] | None = None
@@ -224,9 +255,25 @@ class FieldMapper:
             self.kind = KIND_BINARY
         elif self.type == "dense_vector":
             self.kind = KIND_VECTOR
-            self.dims = int(params.get("dims", 0))
-            if self.dims <= 0:
-                raise MapperParsingError(f"dense_vector field [{name}] requires dims")
+            self.dims = _vector_dims(name, "dense_vector", params)
+        elif self.type == "rank_vectors":
+            # multi-vector late-interaction mapping: each doc carries a
+            # [T, D] token matrix (ColBERT-style), padded/bucketed like
+            # the uterms columns; scored by the fused MaxSim kernel
+            # (ops/maxsim.py) through the top-level `knn` search section
+            self.kind = KIND_MVECTOR
+            self.dims = _vector_dims(name, "rank_vectors", params)
+            raw_mt = params.get("max_tokens", DEFAULT_RANK_VECTOR_TOKENS)
+            try:
+                self.max_tokens = int(raw_mt)
+            except (TypeError, ValueError):
+                raise IllegalArgumentError(
+                    f"rank_vectors field [{name}] max_tokens must be an "
+                    f"integer, got [{raw_mt}]") from None
+            if not 1 <= self.max_tokens <= MAX_RANK_VECTOR_TOKENS:
+                raise IllegalArgumentError(
+                    f"rank_vectors field [{name}] max_tokens must be in "
+                    f"[1, {MAX_RANK_VECTOR_TOKENS}], got {self.max_tokens}")
         elif self.type == "geo_point":
             self.kind = KIND_GEO
         elif self.type == "geo_shape":
@@ -258,7 +305,7 @@ class FieldMapper:
 
     def parse_value(self, value: Any) -> ParsedField:
         pf = ParsedField(self.name, self.kind)
-        if self.kind == KIND_VECTOR:
+        if self.kind in (KIND_VECTOR, KIND_MVECTOR):
             values = [value]
         elif self.kind == KIND_GEO and isinstance(value, (list, tuple)) \
                 and len(value) == 2 and all(isinstance(x, numbers.Number)
@@ -358,6 +405,23 @@ class FieldMapper:
                     f"dense_vector [{self.name}] expects dims [{self.dims}], "
                     f"got shape {arr.shape}")
             pf.vector = arr
+        elif self.kind == KIND_MVECTOR:
+            try:
+                arr = np.asarray(value, dtype=np.float32)
+            except (TypeError, ValueError):
+                raise MapperParsingError(
+                    f"rank_vectors [{self.name}] expects a list of "
+                    f"[{self.dims}]-dim vectors") from None
+            if arr.ndim == 1:              # one token: [D] → [1, D]
+                arr = arr[None, :]
+            if arr.ndim != 2 or arr.shape[1] != self.dims or \
+                    arr.shape[0] == 0:
+                raise MapperParsingError(
+                    f"rank_vectors [{self.name}] expects [T, {self.dims}] "
+                    f"token vectors, got shape {arr.shape}")
+            # token cap is a mapping contract like text max_tokens:
+            # overflow truncates (index-time), never errors
+            pf.mvector = arr[:self.max_tokens]
         elif self.kind == KIND_SHAPE:
             from elasticsearch_tpu.utils.geoshape import parse_shape_rings
             v = value if isinstance(value, dict) else values[0]
@@ -382,6 +446,38 @@ class FieldMapper:
             else:
                 raise MapperParsingError(f"cannot parse geo_point [{value}]")
         return pf
+
+
+def validate_vector_mappings(mappings: Mapping[str, Any]) -> None:
+    """Create-index-time validation of vector field mappings (the
+    store.type / impact-settings idiom): dims bounds and rank_vectors
+    token caps must fail the CREATE REQUEST with the 400-typed error —
+    the cluster-state applier swallows exceptions, so a bad mapping
+    validated only there would silently produce a broken index."""
+    def walk(props: Mapping[str, Any]) -> None:
+        for name, fdef in (props or {}).items():
+            if not isinstance(fdef, Mapping):
+                continue
+            ftype = fdef.get("type")
+            if ftype in ("dense_vector", "rank_vectors"):
+                # constructing the mapper runs the full validation
+                FieldMapper(name, ftype, fdef, _VALIDATION_ANALYSIS)
+            if "properties" in fdef:
+                walk(fdef["properties"])
+    for _type, m in (mappings or {}).items():
+        if isinstance(m, Mapping):
+            walk(m.get("properties", {}))
+
+
+class _LazyAnalysis:
+    """Deferred AnalysisRegistry for the validation probe (vector
+    mappings never touch analyzers, so none is ever built)."""
+
+    def get(self, name):
+        return AnalysisRegistry().get(name)
+
+
+_VALIDATION_ANALYSIS = _LazyAnalysis()
 
 
 class DocumentMapper:

@@ -1,0 +1,165 @@
+"""Fused MaxSim — late-interaction scoring over multi-vector columns.
+
+ColBERT-style late interaction scores a document by summing, per query
+token, the best similarity against any document token:
+``score(q, d) = Σ_i max_j  q_i · d_j``. The reference era has nothing
+like it; FLASH-MAXSIM (PAPERS.md) shows the accelerator-native shape:
+never materialize the full ``[N, Qt, T]`` interaction tensor — sweep
+the document-token axis in fixed blocks under ``lax.scan``, carrying
+only the running per-(doc, query-token) maximum, so intermediates stay
+``[N, Qt, blk]`` instead of ``[N, Qt, T]``.
+
+Inputs come from the ``rank_vectors`` mapping type (index/segment.py
+``MultiVectorFieldColumn``): per-doc ``[T, D]`` token matrices padded
+to the segment-wide token cap, with ``lens[N]`` marking real rows.
+Token vectors are L2-normalized at pack time (device layer), so the
+per-token dot IS the cosine similarity. Padded doc tokens are masked
+to -inf before the max; padded query tokens contribute zero to the
+sum; a doc with zero tokens scores 0 (its ``exists`` is False anyway).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-jnp.inf)
+
+#: doc-token block width for the scan accumulation. A power of two so
+#: the padded token axis (itself pow2-bucketed) divides exactly.
+MAXSIM_BLOCK_T = 16
+
+
+def maxsim_scores_body(toks, lens, q, qmask, block_t: int = MAXSIM_BLOCK_T):
+    """MaxSim of ONE query against every doc of a segment.
+
+    toks: [N, T, D] f32 (row-normalized token matrices, zero padding);
+    lens: [N] i32 real token counts; q: [Qt, D] f32 (normalized);
+    qmask: [Qt] bool (False = query padding).
+
+    → scores [N] f32. Traceable body — runs eagerly and under jit.
+    """
+    n, t, d = toks.shape
+    blk = min(block_t, t)
+    n_blocks = -(-t // blk)
+    t_pad = n_blocks * blk
+    if t_pad != t:
+        toks = jnp.pad(toks, ((0, 0), (0, t_pad - t), (0, 0)))
+    # [n_blocks, N, blk, D] so scan walks the leading axis
+    blocks = jnp.transpose(
+        toks.reshape(n, n_blocks, blk, d), (1, 0, 2, 3))
+    pos = jnp.arange(t_pad, dtype=jnp.int32).reshape(n_blocks, blk)
+
+    def step(carry, inp):
+        chunk, p = inp                      # [N, blk, D], [blk]
+        sim = jnp.einsum("nbd,qd->nqb", chunk, q)
+        valid = (p[None, :] < lens[:, None])[:, None, :]
+        sim = jnp.where(valid, sim, NEG_INF)
+        return jnp.maximum(carry, sim.max(axis=2)), None
+
+    init = jnp.full((n, qmask.shape[0]), NEG_INF, jnp.float32)
+    tokmax, _ = jax.lax.scan(step, init, (blocks, pos))
+    # docs with zero tokens never beat -inf: contribute 0, not -inf
+    tokmax = jnp.where(jnp.isfinite(tokmax), tokmax, 0.0)
+    return (tokmax * qmask[None, :].astype(jnp.float32)).sum(axis=1)
+
+
+def maxsim_scores_batch_body(toks, lens, qs, qmasks,
+                             block_t: int = MAXSIM_BLOCK_T):
+    """B queries × one segment → [B, N] f32. Natively batched (the
+    query axis rides the einsum, not a per-query retrace): the scan
+    carry is [B, N, Qt] and intermediates stay [B, N, Qt, blk]."""
+    n, t, d = toks.shape
+    b, qt, _ = qs.shape
+    blk = min(block_t, t)
+    n_blocks = -(-t // blk)
+    t_pad = n_blocks * blk
+    if t_pad != t:
+        toks = jnp.pad(toks, ((0, 0), (0, t_pad - t), (0, 0)))
+    blocks = jnp.transpose(
+        toks.reshape(n, n_blocks, blk, d), (1, 0, 2, 3))
+    pos = jnp.arange(t_pad, dtype=jnp.int32).reshape(n_blocks, blk)
+
+    def step(carry, inp):
+        chunk, p = inp                  # [N, blk, D], [blk]
+        sim = jnp.einsum("ncd,bqd->bnqc", chunk, qs)
+        valid = (p[None, :] < lens[:, None])[None, :, None, :]
+        sim = jnp.where(valid, sim, NEG_INF)
+        return jnp.maximum(carry, sim.max(axis=3)), None
+
+    init = jnp.full((b, n, qt), NEG_INF, jnp.float32)
+    tokmax, _ = jax.lax.scan(step, init, (blocks, pos))
+    tokmax = jnp.where(jnp.isfinite(tokmax), tokmax, 0.0)
+    return (tokmax * qmasks[:, None, :].astype(jnp.float32)).sum(axis=2)
+
+
+def maxsim_scores_int8_body(qtoks, scale, offset, lens, q, qmask,
+                            block_t: int = MAXSIM_BLOCK_T):
+    """MaxSim over an int8-quantized token column.
+
+    qtoks: [N, T, D] int8 with ``v ≈ q·scale + offset`` per component
+    (per-segment scale/offset snapshot, index/segment.py
+    ``quantize_vectors``). The dequantized dot expands to
+    ``scale·(qint·q) + offset·Σq`` — one integer-width matmul plus a
+    rank-1 correction, so the column stays int8 in HBM (~4× density).
+    """
+    # dequantized dot: scale·(qint·q) + offset·Σq. The affine correction
+    # offset·Σq_i is constant over the DOC-token axis, and scale ≥ 0, so
+    # max_j(scale·x_j + c_i) = scale·max_j(x_j) + c_i — the max can run
+    # on the integer-valued similarities and correct afterwards.
+    qsum = q.sum(axis=1)                    # [Qt]
+    n, t, d = qtoks.shape
+    blk = min(block_t, t)
+    n_blocks = -(-t // blk)
+    t_pad = n_blocks * blk
+    toks = qtoks.astype(jnp.float32)
+    if t_pad != t:
+        toks = jnp.pad(toks, ((0, 0), (0, t_pad - t), (0, 0)))
+    blocks = jnp.transpose(
+        toks.reshape(n, n_blocks, blk, d), (1, 0, 2, 3))
+    pos = jnp.arange(t_pad, dtype=jnp.int32).reshape(n_blocks, blk)
+
+    def step(carry, inp):
+        chunk, p = inp
+        sim = jnp.einsum("nbd,qd->nqb", chunk, q)
+        valid = (p[None, :] < lens[:, None])[:, None, :]
+        sim = jnp.where(valid, sim, NEG_INF)
+        return jnp.maximum(carry, sim.max(axis=2)), None
+
+    init = jnp.full((n, qmask.shape[0]), NEG_INF, jnp.float32)
+    intmax, _ = jax.lax.scan(step, init, (blocks, pos))
+    tokmax = intmax * scale + offset * qsum[None, :]
+    tokmax = jnp.where(jnp.isfinite(intmax), tokmax, 0.0)
+    return (tokmax * qmask[None, :].astype(jnp.float32)).sum(axis=1)
+
+
+def maxsim_scores_int8_batch_body(qtoks, scale, offset, lens, qs, qmasks,
+                                  block_t: int = MAXSIM_BLOCK_T):
+    """Natively batched int8 MaxSim: integer-valued similarities max
+    under the scan, the affine dequant correction (constant over the
+    doc-token axis, scale ≥ 0) applied to the per-token maxima."""
+    n, t, d = qtoks.shape
+    b, qt, _ = qs.shape
+    blk = min(block_t, t)
+    n_blocks = -(-t // blk)
+    t_pad = n_blocks * blk
+    toks = qtoks.astype(jnp.float32)
+    if t_pad != t:
+        toks = jnp.pad(toks, ((0, 0), (0, t_pad - t), (0, 0)))
+    blocks = jnp.transpose(
+        toks.reshape(n, n_blocks, blk, d), (1, 0, 2, 3))
+    pos = jnp.arange(t_pad, dtype=jnp.int32).reshape(n_blocks, blk)
+
+    def step(carry, inp):
+        chunk, p = inp
+        sim = jnp.einsum("ncd,bqd->bnqc", chunk, qs)
+        valid = (p[None, :] < lens[:, None])[None, :, None, :]
+        sim = jnp.where(valid, sim, NEG_INF)
+        return jnp.maximum(carry, sim.max(axis=3)), None
+
+    init = jnp.full((b, n, qt), NEG_INF, jnp.float32)
+    intmax, _ = jax.lax.scan(step, init, (blocks, pos))
+    qsums = qs.sum(axis=2)                       # [B, Qt]
+    tokmax = intmax * scale + offset * qsums[:, None, :]
+    tokmax = jnp.where(jnp.isfinite(intmax), tokmax, 0.0)
+    return (tokmax * qmasks[:, None, :].astype(jnp.float32)).sum(axis=2)

@@ -13,6 +13,7 @@ bf16 matmul — exactly what the 128×128 systolic array is built for.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -46,6 +47,45 @@ def cosine_scores_batch(vecs, exists, qs, use_bf16: bool = False):
 
 def dot_scores(vecs, exists, q):
     return jnp.where(exists, vecs @ q, 0.0)
+
+
+def cosine_scores_int8_batch(qvecs, scale, offset, exists, qs):
+    """Batched cosine over an int8-quantized column.
+
+    qvecs: [N, D] int8 with ``v ≈ q·scale + offset`` per component
+    (per-segment scale/offset snapshot); qs: [Q, D] f32 row-normalized.
+    The dequantized dot expands to ``scale·(qint·qn) + offset·Σqn`` —
+    one matmul on the dense integer column plus a rank-1 correction, so
+    the column stays int8-dense in HBM (~4× the f32 corpus capacity).
+    → scores [Q, N] f32; non-existent rows score 0.
+    """
+    qn = l2_normalize(qs, axis=-1)
+    s = (qn @ qvecs.astype(jnp.float32).T) * scale \
+        + offset * qn.sum(axis=-1, keepdims=True)
+    return jnp.where(exists[None, :], s, 0.0)
+
+
+def filtered_topk_batch(scores, masks, k: int, doc_base: int = 0):
+    """Batched filtered-kNN candidate selection: per-query top-k over
+    pre-computed score rows with per-query eligibility masks (exists ∧
+    live ∧ knn-filter) — the candidate-oversample step of the knn lane
+    (``num_candidates`` rows per segment survive to the merge).
+    ``lax.top_k`` batches over leading axes natively, so the whole
+    batch is one fused selection (stable: ties → lower doc id).
+
+    scores: [B, N] f32; masks: [B, N] bool → ([B, k] f32, [B, k] i32).
+    """
+    neg_inf = jnp.float32(-jnp.inf)
+    masked = jnp.where(masks, scores, neg_inf)
+    kk = min(k, masked.shape[-1])
+    ts, idx = jax.lax.top_k(masked, kk)
+    valid = ts > neg_inf
+    td = jnp.where(valid, idx.astype(jnp.int32) + doc_base, -1)
+    ts = jnp.where(valid, ts, neg_inf)
+    if kk < k:    # corpus smaller than k: pad to the static width
+        ts = jnp.pad(ts, ((0, 0), (0, k - kk)), constant_values=neg_inf)
+        td = jnp.pad(td, ((0, 0), (0, k - kk)), constant_values=-1)
+    return ts, td
 
 
 def script_cosine_scores(vecs, exists, q):

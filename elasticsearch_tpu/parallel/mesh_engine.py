@@ -334,6 +334,54 @@ class _DeviceBlockCache:
             return blk.arrays, 0, blk.col_bytes
         return blk.arrays, col_bytes, 0
 
+    def aux_lookup(self, key: tuple):
+        """LRU-touching lookup of an auxiliary block → (arrays,
+        col_bytes) or None. Split out from :meth:`fetch_aux` so lanes
+        with their OWN seam site (the knn lane's ``vector-upload``) can
+        run the upload under a literal site class at their call site —
+        the device-seam lint requires the site be a literal, so the
+        shared path cannot take it as a parameter."""
+        with self._lock:
+            blk = self._lru.get(key)
+            if blk is None:
+                return None
+            self._lru.move_to_end(key)
+            return blk.arrays, blk.col_bytes
+
+    def aux_install(self, key: tuple, arrays: list, col_bytes: int,
+                    breaker_service, label: str):
+        """Install an already-uploaded auxiliary block → (arrays,
+        uploaded, reused). A raced duplicate build keeps the incumbent
+        and reports OUR bytes as REUSED (the loser's transfer must not
+        fail the incremental-refresh counter proofs spuriously)."""
+        charge = None
+        if breaker_service is not None:
+            from elasticsearch_tpu.common.breaker import OneShotCharge
+            charge = OneShotCharge(breaker_service, col_bytes).charge(
+                label)
+        blk = _Block(key, None, arrays, np.zeros(0, bool), col_bytes,
+                     {}, charge)
+        evicted = []
+        lost_race = False
+        with self._lock:
+            cur = self._lru.get(key)
+            if cur is not None:
+                self._lru.move_to_end(key)
+                if charge is not None:
+                    charge.release()
+                blk = cur
+                lost_race = True
+            else:
+                self._lru[key] = blk
+                while len(self._lru) > self.cap:
+                    evicted.append(self._lru.popitem(last=False)[1])
+        for old in evicted:
+            if old.charge is not None:
+                old.charge.release()
+        if lost_race:
+            return blk.arrays, 0, blk.col_bytes
+        return blk.arrays, col_bytes, 0
+
     def drop_stale_aux(self, engine_uuid: str, block_uid: int,
                        sig_prefix: tuple, quant_gen: int) -> int:
         """Release prior-quantization auxiliary blocks of ONE live
@@ -473,6 +521,33 @@ def fetch_impact_block(engine_uuid: str, block_uid: int, field: str,
     if has_bm:
         return arrays[0], arrays[1], up, re
     return arrays[0], None, up, re
+
+
+def fetch_vector_block(engine_uuid: str, block_uid: int, field: str,
+                       sig: tuple, build_np, breaker_service):
+    """One segment's knn-lane vector arrays (normalized f32 or
+    int8-quantized columns + exists [+ token lens]), device-resident
+    through the per-segment block cache — the PR 5 discipline: a
+    refresh uploads vector bytes ONLY for new segments; resident blocks
+    reuse outright (counter-verified via data_layer.vector_bytes_*).
+    ``build_np`` is called only on miss and returns the host arrays.
+    → (device arrays, uploaded bytes, reused bytes)."""
+    from elasticsearch_tpu.search import jit_exec
+    key = (engine_uuid, block_uid, ("vector", field) + tuple(sig))
+    hit = _block_cache.aux_lookup(key)
+    if hit is not None:
+        return hit[0], 0, hit[1]
+    flat_np = [np.ascontiguousarray(a) for a in build_np()
+               if a is not None]
+    with device_span("vector-upload") as dsp:
+        jit_exec.device_fault_point("vector-upload")
+        arrays = [jax.device_put(a) for a in flat_np]
+        dsp.set(bytes=int(sum(a.nbytes for a in flat_np)),
+                kind="vector-block")
+    col_bytes = int(sum(a.nbytes for a in flat_np))
+    return _block_cache.aux_install(
+        key, arrays, col_bytes, breaker_service,
+        f"vector block [{engine_uuid[:8]}]")
 
 
 def hook_engine_block_release(engine) -> None:

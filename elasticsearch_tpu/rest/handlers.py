@@ -3367,6 +3367,12 @@ class Handlers:
             Col("impact.skip_ratio", ("isr", "impactSkipRatio"),
                 "fraction of impact blocks the block-max sweep skipped",
                 right=True, default=False),
+            Col("knn.admissions", ("ka", "knnAdmissions"),
+                "requests served by the compiled knn/vector lane",
+                right=True, default=False),
+            Col("knn.fusion", ("kf", "knnFusion"),
+                "hybrid BM25+knn fusion dispatches (one per hybrid "
+                "request)", right=True, default=False),
         ])
         from elasticsearch_tpu.search import jit_exec as _jx
         breaker_open = _jx.plane_breaker.stats()["state"] != "closed"
@@ -3386,6 +3392,7 @@ class Handlers:
             from elasticsearch_tpu.search.percolator import registry_stats
             perc = registry_stats(n)
             imp = _jx.impact_index_stats(n)
+            knn_st = _jx.knn_index_stats(n)
             if svc is not None and str(svc.index_settings.get(
                     "index.search.collective_plane", "true")).lower() \
                     in ("false", "0"):
@@ -3416,7 +3423,9 @@ class Handlers:
                      "plane.health": plane_health,
                      "impact.blocks": imp["blocks_scored"] +
                      imp["blocks_skipped"],
-                     "impact.skip_ratio": f"{imp['skip_ratio']:.2f}"})
+                     "impact.skip_ratio": f"{imp['skip_ratio']:.2f}",
+                     "knn.admissions": knn_st["admissions"],
+                     "knn.fusion": knn_st["fusion_dispatches"]})
         return t.render(req)
 
     def cat_master(self, req: RestRequest):
@@ -3716,6 +3725,9 @@ class Handlers:
              "(scored+skipped)"),
             ("impact.skip_ratio", "fraction of impact blocks the "
              "block-max sweep skipped"),
+            ("knn.admissions", "requests served by the compiled "
+             "knn/vector lane"),
+            ("knn.fusion", "hybrid BM25+knn fusion dispatches"),
             ("percolate.current", "number of current percolations"),
             ("percolate.memory_size", "memory used by percolator"),
             ("percolate.queries", "number of registered percolation "

@@ -42,6 +42,7 @@ from elasticsearch_tpu.index.device_reader import DeviceSegment
 from elasticsearch_tpu.observability import attribution as _attribution
 from elasticsearch_tpu.observability.context import current_node_id
 from elasticsearch_tpu.observability.tracing import device_span
+from elasticsearch_tpu.ops import blockmax as blockmax_ops
 from elasticsearch_tpu.ops import topk as topk_ops
 from elasticsearch_tpu.search.execute import (
     ConstTable, EmitCtx, ExecutionContext, SegmentResolver)
@@ -321,7 +322,13 @@ _stats = {"hits": 0, "misses": 0, "fallbacks": 0,
           # impact requantizations forced by cross-segment df drift
           # (steady-state refreshes must NOT bump this)
           "impact_admissions": 0, "impact_blocks_scored": 0,
-          "impact_blocks_skipped": 0, "impact_requant_refreshes": 0}
+          "impact_blocks_skipped": 0, "impact_requant_refreshes": 0,
+          # dense/late-interaction retrieval lane: requests served by
+          # the compiled knn path, hybrid fusion dispatches (must
+          # reconcile with the hybrid request count — the one-dispatch
+          # proof), and fused MaxSim dispatches over rank_vectors
+          "knn_admissions": 0, "fusion_dispatches": 0,
+          "maxsim_dispatches": 0}
 #: why searches left the compiled/collective path, by label
 #: (ineligible-shape / parse-error / refresh-race / device-error / …)
 _fallback_reasons: dict[str, int] = {}
@@ -329,6 +336,12 @@ _fallback_reasons: dict[str, int] = {}
 #: indices that OPTED IN to the impact plane (the exact scorer is the
 #: default; a disabled index never logs an impact fallback)
 _impact_fallback_reasons: dict[str, int] = {}
+#: why knn/hybrid requests left the compiled lane (the eager
+#: per-segment fallback served them), by label
+_knn_fallback_reasons: dict[str, int] = {}
+#: per-INDEX knn-lane accounting — feeds the per-index _stats
+#: "search.knn" section and the _cat/indices knn.* columns
+_knn_index_stats: dict[str, dict] = {}
 #: per-INDEX impact-lane accounting (admissions, blocks scored/skipped)
 #: — feeds the per-index _stats "search.impact" section and the
 #: _cat/indices impact.{blocks,skip_ratio} columns
@@ -371,7 +384,11 @@ _data_layer = {"bytes_uploaded": 0, "bytes_reused": 0,
                # cache: a refresh uploads impact bytes ONLY for segments
                # that are new (or requantized) — resident segments count
                # under impact_bytes_reused (tier-1 guard)
-               "impact_bytes_uploaded": 0, "impact_bytes_reused": 0}
+               "impact_bytes_uploaded": 0, "impact_bytes_reused": 0,
+               # knn-lane vector columns ride the same per-segment block
+               # cache: a refresh uploads vector bytes ONLY for new
+               # segments (tier-1 guard); delete-only refreshes zero
+               "vector_bytes_uploaded": 0, "vector_bytes_reused": 0}
 
 
 def cache_stats(node_id: str | None = None) -> dict:
@@ -388,6 +405,7 @@ def cache_stats(node_id: str | None = None) -> dict:
     with _cache_lock:
         out = {**_stats, "fallback_reasons": dict(_fallback_reasons),
                "impact_fallback_reasons": dict(_impact_fallback_reasons),
+               "knn_fallback_reasons": dict(_knn_fallback_reasons),
                "data_layer": dict(_data_layer)}
     out["plane_breaker"] = plane_breaker.stats()
     return out
@@ -462,6 +480,8 @@ def clear_cache() -> None:
         _fallback_reasons.clear()
         _impact_fallback_reasons.clear()
         _impact_index_stats.clear()
+        _knn_fallback_reasons.clear()
+        _knn_index_stats.clear()
         _data_layer.update({k: 0 for k in _data_layer})
         _node_stats.clear()
         _node_fallback_reasons.clear()
@@ -471,12 +491,14 @@ def clear_cache() -> None:
 # Segment flatten/rebuild (the traced-input pytree)
 # ---------------------------------------------------------------------------
 
-_KINDS = ("text", "keyword", "numeric", "vector", "geo", "shape")
+_KINDS = ("text", "keyword", "numeric", "vector", "mvector", "geo",
+          "shape")
 _ARRAYS = {
     "text": ("tokens", "uterms", "utf", "doc_len"),
     "keyword": ("ords",),
     "numeric": ("hi", "lo", "exists"),
     "vector": ("vecs", "exists"),
+    "mvector": ("vecs", "lens", "exists"),
     "geo": ("lat", "lon", "exists"),
     "shape": ("lats", "lons", "nv", "exists", "rid", "area"),
 }
@@ -510,7 +532,7 @@ def _keep(kind: str, attr: str, name: str, positions_for, vectors_for
     engine pre-stacks segments once, before any plan exists)."""
     if kind == "text" and attr == "tokens":
         return positions_for is None or name in positions_for
-    if kind == "vector" and attr == "vecs":
+    if kind in ("vector", "mvector") and attr == "vecs":
         return vectors_for is None or name in vectors_for
     return True
 
@@ -1676,6 +1698,624 @@ def run_impact_pruned(pack: _ImpactPack, term_lists: list, boosts: list,
     with device_span("pruning-dispatch"):
         device_fault_point("pruning-dispatch")
         out = fn(seg_arrs, qtids, pack.scales, boosts_a, cs, cd)
+    if b_pad != b:
+        out = {name: v[:b] for name, v in out.items()}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Dense + late-interaction retrieval lane (top-level `knn` search section)
+#
+# Brute-force exact kNN over HBM-resident vector columns (the sharded
+# matmul already beats BM25 QPS on every bench round — ROADMAP item 4),
+# fused MaxSim over rank_vectors token matrices (ops/maxsim.py,
+# FLASH-MAXSIM-style block accumulation), and IN-PROGRAM hybrid fusion:
+# when a request carries both `knn` and `query`, both lanes score in the
+# SAME compiled program and reduce on-device via RRF or weighted-sum, so
+# a hybrid query is still ONE device dispatch — no second fan-out, no
+# host-side merge.
+#
+# Device residency rides the PR 5 per-segment block cache
+# (mesh_engine.fetch_vector_block): a refresh uploads vector bytes only
+# for NEW segments, counter-verified via data_layer.vector_bytes_*.
+# `index.knn.quantization: int8` stores the columns int8-dense with a
+# per-segment scale/offset snapshot (~4x HBM capacity; scores within the
+# stamped quantization bound); f32 stays the exact default.
+# ---------------------------------------------------------------------------
+
+
+@_dataclass(frozen=True)
+class KnnPlaneConfig:
+    """Per-index knn-lane knobs (`index.knn.*` / `index.search.hybrid.*`
+    settings). Unlike the impact plane the lane needs no opt-in — the
+    `knn` search section itself is the opt-in."""
+    quantization: str = "f32"      # f32 | int8
+    fusion_mode: str = "rrf"       # rrf | weighted
+    rank_constant: int = 60        # RRF k
+    lexical_weight: float = 0.5    # weighted-sum lexical leg weight
+
+
+#: index name → config (indices without an entry use the defaults)
+_knn_configs: dict[str, KnnPlaneConfig] = {}
+
+
+def validate_knn_settings(settings) -> KnnPlaneConfig:
+    """Validate the `index.knn.*` / `index.search.hybrid.*` knobs,
+    raising the create-index-time 400 on a bad value (the store.type /
+    impact-settings idiom: a typo must fail the CREATE REQUEST, never
+    reach the cluster-state applier or surface later as a misleading
+    device-error fallback)."""
+    from elasticsearch_tpu.common.errors import IllegalArgumentError
+    get = settings.get if settings is not None else (lambda *_: None)
+    quant = str(get("index.knn.quantization", "f32") or "f32").lower()
+    if quant not in ("f32", "int8"):
+        raise IllegalArgumentError(
+            f"index.knn.quantization must be f32 or int8, got [{quant}]")
+    mode = str(get("index.search.hybrid.mode", "rrf") or "rrf").lower()
+    if mode not in ("rrf", "weighted"):
+        raise IllegalArgumentError(
+            f"index.search.hybrid.mode must be rrf or weighted, "
+            f"got [{mode}]")
+    raw_k0 = get("index.search.hybrid.rank_constant", 60)
+    try:
+        k0 = int(60 if raw_k0 is None or raw_k0 == "" else raw_k0)
+    except (TypeError, ValueError):
+        raise IllegalArgumentError(
+            f"index.search.hybrid.rank_constant must be an integer, "
+            f"got [{raw_k0}]") from None
+    if k0 < 1:
+        raise IllegalArgumentError(
+            f"index.search.hybrid.rank_constant must be >= 1, got {k0}")
+    raw_w = get("index.search.hybrid.lexical_weight", 0.5)
+    try:
+        w = float(0.5 if raw_w is None or raw_w == "" else raw_w)
+    except (TypeError, ValueError):
+        raise IllegalArgumentError(
+            f"index.search.hybrid.lexical_weight must be a number, "
+            f"got [{raw_w}]") from None
+    if not 0.0 <= w <= 1.0:
+        raise IllegalArgumentError(
+            f"index.search.hybrid.lexical_weight must be in [0, 1], "
+            f"got {w}")
+    return KnnPlaneConfig(quantization=quant, fusion_mode=mode,
+                          rank_constant=k0, lexical_weight=w)
+
+
+def configure_knn_plane(index_name: str, settings=None) -> None:
+    """Register an index's knn-lane config from its settings (called at
+    IndexService construction; tests call it directly with a dict)."""
+    _knn_configs[index_name] = validate_knn_settings(settings)
+
+
+def knn_plane_config(index_name: str | None) -> KnnPlaneConfig:
+    if index_name is None:
+        return KnnPlaneConfig()
+    return _knn_configs.get(index_name) or KnnPlaneConfig()
+
+
+def note_knn_fallback(reason: str) -> None:
+    """One knn/hybrid request served by the eager per-segment fallback
+    lane instead of the compiled program, reason-labeled."""
+    _attribution.label("knn_fallback", reason)
+    with _cache_lock:
+        _knn_fallback_reasons[reason] = \
+            _knn_fallback_reasons.get(reason, 0) + 1
+
+
+def note_knn_served(index_name: str | None, n_requests: int,
+                    fused: int = 0, maxsim: int = 0) -> None:
+    """`n_requests` served by the compiled knn lane; `fused` of them
+    were hybrid (one fusion dispatch each — the counter the one-dispatch
+    acceptance reconciles against request count), `maxsim` scored a
+    rank_vectors field."""
+    with _cache_lock:
+        _bump("knn_admissions", n_requests)
+        if fused:
+            _bump("fusion_dispatches", fused)
+        if maxsim:
+            _bump("maxsim_dispatches", maxsim)
+        if index_name:
+            bucket = _knn_index_stats.setdefault(
+                index_name, {"admissions": 0, "fusion_dispatches": 0,
+                             "maxsim_dispatches": 0})
+            bucket["admissions"] += n_requests
+            bucket["fusion_dispatches"] += fused
+            bucket["maxsim_dispatches"] += maxsim
+
+
+def knn_index_stats(index_name: str) -> dict:
+    """One index's knn-lane rollup (zeros when never admitted)."""
+    with _cache_lock:
+        bucket = dict(_knn_index_stats.get(index_name, {}))
+    return {"admissions": bucket.get("admissions", 0),
+            "fusion_dispatches": bucket.get("fusion_dispatches", 0),
+            "maxsim_dispatches": bucket.get("maxsim_dispatches", 0)}
+
+
+def note_data_blocks_vector(uploaded: int, reused: int) -> None:
+    """Vector-column block-cache traffic from one pack build."""
+    with _cache_lock:
+        _data_layer["vector_bytes_uploaded"] += int(uploaded)
+        _data_layer["vector_bytes_reused"] += int(reused)
+
+
+def _host_knn_column(host_seg, field: str, quant: str):
+    """The host-side knn column for one segment — L2-normalized f32, or
+    its int8 quantization — cached ON the immutable host Segment (the
+    impact-column discipline: survives reader swaps, so unchanged
+    segments never renormalize/requantize). Returns
+    (arrays dict, multi: bool, dims) or None when the segment lacks the
+    field. Shared by the compiled pack builder and the eager fallback
+    lane so both lanes score the same bits."""
+    import numpy as _np
+    from elasticsearch_tpu.index.segment import quantize_vectors
+    col = host_seg.vector_fields.get(field)
+    mcol = host_seg.mvector_fields.get(field)
+    if col is None and mcol is None:
+        return None
+    multi = col is None
+    cache = host_seg.__dict__.setdefault("_knn_col_cache", {})
+    ckey = (field, quant)
+    hit = cache.get(ckey)
+    if hit is not None:
+        return hit
+    if multi:
+        norms = _np.linalg.norm(mcol.vecs, axis=2, keepdims=True)
+        normed = (mcol.vecs / _np.maximum(norms, 1e-12)).astype(
+            _np.float32)
+        out = {"lens": _np.asarray(mcol.lens, _np.int32),
+               "exists": _np.asarray(mcol.exists, bool)}
+        dims = mcol.dims
+    else:
+        norms = _np.linalg.norm(col.vecs, axis=1, keepdims=True)
+        normed = (col.vecs / _np.maximum(norms, 1e-12)).astype(
+            _np.float32)
+        out = {"lens": None, "exists": _np.asarray(col.exists, bool)}
+        dims = col.dims
+    if quant == "int8":
+        qcol = quantize_vectors(normed, dims)
+        out.update(vecs=qcol.qvecs, qcol=qcol,
+                   scale=qcol.scale, offset=qcol.offset)
+    else:
+        out.update(vecs=_np.ascontiguousarray(normed), qcol=None,
+                   scale=1.0, offset=0.0)
+    entry = (out, multi, dims)
+    cache[ckey] = entry
+    return entry
+
+
+class _VectorPack:
+    """One reader generation's device-resident knn pack for a field:
+    per-segment vector arrays (f32 or int8 + scale/offset snapshot)
+    riding the per-segment block cache, aligned 1:1 with the reader's
+    segments (None entries for segments without the field)."""
+
+    __slots__ = ("field", "quant", "multi", "dims", "segs", "scales",
+                 "offsets")
+
+    def __init__(self, field, quant):
+        self.field = field
+        self.quant = quant
+        self.multi = False
+        self.dims = 0
+        self.segs = []          # per reader segment: dict | None
+        self.scales = None      # [S_present] f32 device (compose step)
+        self.offsets = None
+
+    def sig(self) -> tuple:
+        out = [self.field, self.quant, self.multi, self.dims]
+        for s in self.segs:
+            if s is None:
+                out.append(None)
+            else:
+                out.append((s["np_docs"], s.get("t", 0),
+                            str(s["vecs"].dtype), s["doc_base"]))
+        return tuple(out)
+
+    def score_bound(self, qn) -> float:
+        """Worst per-segment quantization score bound for one query
+        (0.0 under f32) — the stamped int8 recall envelope."""
+        bound = 0.0
+        for s in self.segs:
+            if s is not None and s.get("qcol") is not None:
+                bound = max(bound, s["qcol"].score_bound(qn))
+        return bound
+
+
+def vector_pack_for(reader, field: str,
+                    cfg: KnnPlaneConfig) -> _VectorPack | None:
+    """Build (or fetch the cached) knn vector pack for one reader
+    generation. Device arrays come from the PR 5 per-segment block
+    cache keyed (engine uuid, block_uid, vector sig): unchanged
+    segments reuse their resident vector blocks outright — a refresh
+    that adds one segment uploads vector bytes only for it
+    (data_layer.vector_bytes_* counters prove it). Returns None when no
+    segment carries the field."""
+    packs = reader.__dict__.setdefault("_vector_packs", {})
+    pkey = (field, cfg.quantization)
+    pack = packs.get(pkey)
+    if pack is not None:
+        return pack
+    from elasticsearch_tpu.parallel.mesh_engine import fetch_vector_block
+    engine_uuid = getattr(reader, "engine_uuid", None) or \
+        f"reader:{id(reader)}"
+    breaker_service = getattr(reader, "breaker_service", None)
+    pack = _VectorPack(field, cfg.quantization)
+    uploaded = reused = 0
+    any_field = False
+    for dseg in reader.segments:
+        entry = _host_knn_column(dseg.seg, field, cfg.quantization)
+        if entry is None:
+            pack.segs.append(None)
+            continue
+        host, multi, dims = entry
+        any_field = True
+        pack.multi = multi
+        pack.dims = dims
+        arrs, up, re = fetch_vector_block(
+            engine_uuid, dseg.seg.block_uid, field,
+            (cfg.quantization, multi), lambda h=host: [
+                h["vecs"], h["exists"].astype(np.bool_),
+                h["lens"]], breaker_service)
+        uploaded += up
+        reused += re
+        dev_vecs, dev_exists = arrs[0], arrs[1]
+        dev_lens = arrs[2] if multi else None
+        pack.segs.append({
+            "vecs": dev_vecs, "exists": dev_exists, "lens": dev_lens,
+            "live": dseg.live, "qcol": host["qcol"],
+            "scale": float(host["scale"]),
+            "offset": float(host["offset"]),
+            "np_docs": int(dseg.padded_docs),
+            "t": int(host["vecs"].shape[1]) if multi else 0,
+            "doc_base": int(dseg.doc_base),
+        })
+    if not any_field:
+        return None
+    note_data_blocks_vector(uploaded, reused)
+    # compose step: per-segment dequant scale/offset device constants
+    # the compiled lanes take as inputs (seamed + span-scoped like the
+    # impact pack's scales)
+    present = [s for s in pack.segs if s is not None]
+    with device_span("compose"):
+        device_fault_point("compose")
+        pack.scales = jnp.asarray([s["scale"] for s in present],
+                                  jnp.float32)
+        pack.offsets = jnp.asarray([s["offset"] for s in present],
+                                   jnp.float32)
+    packs[pkey] = pack
+    return pack
+
+
+def _rrf_fuse_body(ls, ld, ds, dd, boosts, k0: float, k: int):
+    """In-program reciprocal-rank fusion of two candidate rankings.
+
+    ls/ld: lexical (scores, GLOBAL doc ids) [B, C]; ds/dd: knn lane
+    [B, C]; boosts: [B] knn-lane contribution multiplier. Each doc's
+    fused score is the f32 sum of its per-lane ``1/(k0 + rank + 1)``
+    contributions — each lane's lists carry unique docs, so a doc gets
+    at most two contributions and the sum is order-exact in f32,
+    matching the host fusion oracle bit-for-bit. Final top-k orders by
+    (score desc, doc asc) — ops/blockmax.merge_topk_by_doc.
+
+    NOTE: blockmax is imported at MODULE level, deliberately — this
+    body runs under an active trace, and a first-import there would
+    execute blockmax's module-level jnp constants inside the trace,
+    caching foreign tracers into its globals (observed as 'compiled
+    for N+3 inputs' failures on concurrent multi-shard searches)."""
+    bm_ops = blockmax_ops
+    c = ld.shape[1]
+    rk = 1.0 / (jnp.float32(k0) + jnp.arange(c, dtype=jnp.float32) + 1.0)
+    valid_l = ld >= 0
+    valid_d = dd >= 0
+    r_l = jnp.where(valid_l, rk[None, :], 0.0)
+    r_d = jnp.where(valid_d, rk[None, :] * boosts[:, None], 0.0)
+    eq = (ld[:, :, None] == dd[:, None, :]) & valid_l[:, :, None] \
+        & valid_d[:, None, :]
+    f_l = r_l + (eq * r_d[:, None, :]).sum(axis=2)
+    f_d = r_d + (eq * r_l[:, :, None]).sum(axis=1)
+    dup_d = eq.any(axis=1)
+    s_l = jnp.where(valid_l, f_l, -jnp.inf)
+    s_d = jnp.where(valid_d & ~dup_d, f_d, -jnp.inf)
+    count = valid_l.sum(axis=1, dtype=jnp.int32) + \
+        (valid_d & ~dup_d).sum(axis=1, dtype=jnp.int32)
+
+    def one(sl, dl, sd, dd_):
+        return bm_ops.merge_topk_by_doc(sl, dl, sd, dd_, k)
+    ts, td = jax.vmap(one)(s_l, ld, s_d, dd)
+    return ts, td, count
+
+
+def _weighted_fuse_body(ls, ld, ds, dd, boosts, w_lex: float, k: int):
+    """In-program weighted-sum fusion: each leg min-max-normalizes over
+    its candidate list (the models/hybrid.py linear mode), then
+    ``w·lex + (1-w)·boost·knn`` sums per doc. (Module-level blockmax
+    import: see the note in :func:`_rrf_fuse_body`.)"""
+    bm_ops = blockmax_ops
+    valid_l = ld >= 0
+    valid_d = dd >= 0
+
+    def norm(s, valid):
+        lo = jnp.where(valid, s, jnp.inf).min(axis=1, keepdims=True)
+        hi = jnp.where(valid, s, -jnp.inf).max(axis=1, keepdims=True)
+        rng = hi - lo
+        rng = jnp.where((rng > 0) & jnp.isfinite(rng), rng, 1.0)
+        lo = jnp.where(jnp.isfinite(lo), lo, 0.0)
+        return jnp.where(valid, (s - lo) / rng, 0.0)
+    r_l = jnp.float32(w_lex) * norm(ls, valid_l)
+    r_d = (1.0 - jnp.float32(w_lex)) * boosts[:, None] * norm(ds, valid_d)
+    eq = (ld[:, :, None] == dd[:, None, :]) & valid_l[:, :, None] \
+        & valid_d[:, None, :]
+    f_l = r_l + (eq * r_d[:, None, :]).sum(axis=2)
+    f_d = r_d + (eq * r_l[:, :, None]).sum(axis=1)
+    dup_d = eq.any(axis=1)
+    s_l = jnp.where(valid_l, f_l, -jnp.inf)
+    s_d = jnp.where(valid_d & ~dup_d, f_d, -jnp.inf)
+    count = valid_l.sum(axis=1, dtype=jnp.int32) + \
+        (valid_d & ~dup_d).sum(axis=1, dtype=jnp.int32)
+
+    def one(sl, dl, sd, dd_):
+        return bm_ops.merge_topk_by_doc(sl, dl, sd, dd_, k)
+    ts, td = jax.vmap(one)(s_l, ld, s_d, dd)
+    return ts, td, count
+
+
+def _plan_knn_segment(dseg, ctx, reqs):
+    """Resolve one segment's per-request lexical query (hybrid) and knn
+    filter into emit closures + packed constants. → plan dict or None
+    when the requests do not share one plan signature."""
+    sig0 = None
+    emit_q0 = emit_f0 = None
+    pos_for: frozenset = frozenset()
+    vecs_for: frozenset = frozenset()
+    consts_rows = []
+    for req in reqs:
+        ct = ConstTable()
+        resolver = SegmentResolver(dseg, ctx, ct)
+        knn = req.knn
+        emit_q = resolver.resolve(req.query) if knn.hybrid else None
+        emit_f = resolver.resolve_mask(knn.filter) \
+            if knn.filter is not None else None
+        ct.static("knn-lane", knn.hybrid, knn.filter is not None)
+        sig = ct.signature()
+        if sig0 is None:
+            sig0, emit_q0, emit_f0 = sig, emit_q, emit_f
+            pos_for = frozenset(ct.positions_needed)
+            vecs_for = frozenset(ct.vectors_needed)
+        elif sig != sig0:
+            return None
+        consts_rows.append(ct.values)
+    packed_spec = pack_query_consts(consts_rows)
+    if packed_spec is None:
+        specs, packed, b_pad = (), {}, None    # const-free plans
+    else:
+        specs, packed, b_pad = packed_spec
+    return {
+        "seg": dseg, "sig": sig0, "emit_q": emit_q0, "emit_f": emit_f0,
+        "specs": specs, "packed": packed, "b_pad": b_pad,
+        "pos": pos_for, "vecs": vecs_for,
+        "flat": seg_flatten(dseg, pos_for, vecs_for),
+        "key": (sig0, layout_key(dseg), pos_for, vecs_for),
+    }
+
+
+def _knn_query_inputs(reqs, pack):
+    """Stack B requests' query vectors / boosts on a padded batch axis.
+    → (qv, qmask | None, boosts, b_pad). Dense: qv [B_pad, D] f32
+    row-normalized. Multi (rank_vectors): qv [B_pad, Qt_pad, D] with
+    per-token normalization and qmask [B_pad, Qt_pad]."""
+    from elasticsearch_tpu.search.batching import pow2_bucket
+    b = len(reqs)
+    b_pad = pow2_bucket(b)
+    rows = [req.knn for req in reqs]
+    rows = rows + [rows[-1]] * (b_pad - b)
+    boosts = np.asarray([kn.boost for kn in rows], np.float32)
+    if not pack.multi:
+        qv = np.zeros((b_pad, pack.dims), np.float32)
+        for i, kn in enumerate(rows):
+            v = np.asarray(kn.query_vector, np.float32)
+            qv[i] = v / max(float(np.linalg.norm(v)), 1e-12)
+        return jnp.asarray(qv), None, jnp.asarray(boosts), b_pad
+    qt_pad = pow2_bucket(max(
+        max(len(kn.query_vector) for kn in rows), 1))
+    qv = np.zeros((b_pad, qt_pad, pack.dims), np.float32)
+    qmask = np.zeros((b_pad, qt_pad), bool)
+    for i, kn in enumerate(rows):
+        m = np.asarray(kn.query_vector, np.float32)
+        norms = np.linalg.norm(m, axis=1, keepdims=True)
+        qv[i, :m.shape[0]] = m / np.maximum(norms, 1e-12)
+        qmask[i, :m.shape[0]] = True
+    return jnp.asarray(qv), jnp.asarray(qmask), jnp.asarray(boosts), b_pad
+
+
+def run_knn_hybrid_batch(reader, ctx, reqs, pack: _VectorPack,
+                         cfg: KnnPlaneConfig, *, k: int,
+                         num_candidates: int):
+    """B knn (or hybrid BM25+knn) requests over the whole reader as ONE
+    compiled program.
+
+    Per segment: the knn lane scores the vector column (dense cosine
+    matmul, int8-dequantized matmul, or fused MaxSim over rank_vectors)
+    masked by exists ∧ live ∧ the request's `filter`; a hybrid request's
+    lexical lane scores the SAME segment view through the standard emit
+    closures under the same vmap. Each lane keeps its global
+    top-`num_candidates` (per-segment top-C, cross-segment device
+    merge), and hybrid requests reduce the two rankings on-device via
+    RRF (`rank_constant`) or weighted-sum — the whole thing is one
+    dispatch and one device→host fetch.
+
+    Returns {"top_scores" [B, k], "top_docs" [B, k], "count" [B]} or
+    None when the batch is not homogeneous (mixed plan signatures —
+    callers retry per-request)."""
+    from elasticsearch_tpu.ops import maxsim as maxsim_ops
+    from elasticsearch_tpu.ops import vector as vector_ops
+    segments = reader.segments
+    if not segments or not reqs:
+        return None
+    hybrid = reqs[0].knn.hybrid
+    b = len(reqs)
+    k_static = int(k)
+    c_static = int(num_candidates)
+    need_seg = hybrid or any(r.knn.filter is not None for r in reqs)
+    plans = None
+    if need_seg:
+        plans = []
+        for dseg in segments:
+            plan = _plan_knn_segment(dseg, ctx, reqs)
+            if plan is None:
+                return None
+            plans.append(plan)
+    qv, qmask, boosts, b_pad = _knn_query_inputs(reqs, pack)
+    if need_seg:
+        # const rows pad to the SAME bucket as the query vectors
+        for plan in plans:
+            if plan["b_pad"] is not None and plan["b_pad"] != b_pad:
+                return None
+    bases = tuple(int(s.doc_base) for s in segments)
+    vec_bases = tuple(s["doc_base"] for s in pack.segs if s is not None)
+    fusion_key = (cfg.fusion_mode, int(cfg.rank_constant),
+                  float(cfg.lexical_weight)) if hybrid else None
+    key = ("knn", pack.sig(), hybrid, need_seg, bases, k_static,
+           c_static, b_pad,
+           None if qmask is None else tuple(qmask.shape), fusion_key,
+           tuple(p["key"] for p in plans) if need_seg else None,
+           tuple(tuple(p["specs"]) for p in plans) if need_seg else None)
+    flats = [p["flat"] for p in plans] if need_seg else []
+    packeds = [{dt: jnp.asarray(buf) for dt, buf in p["packed"].items()}
+               for p in plans] if need_seg else []
+    vec_arrs = [() if s is None else
+                ((s["vecs"], s["exists"], s["live"]) if not pack.multi
+                 else (s["vecs"], s["exists"], s["live"], s["lens"]))
+                for s in pack.segs]
+
+    def compile_fn():
+        def run(flats_in, packeds_in, vec_in, scales_in, offsets_in,
+                qv_in, qmask_in, boosts_in):
+            # ---- per-segment lexical scores / filter masks ----------
+            lex_ts, lex_td = [], []
+            fmasks = [None] * len(segments)
+            if need_seg:
+                for i, (plan, flat_in, packed_in) in enumerate(
+                        zip(plans, flats_in, packeds_in)):
+                    view = seg_rebuild(plan["seg"], flat_in,
+                                       plan["pos"], plan["vecs"])
+
+                    def lane(packed_one, plan=plan, view=view):
+                        consts_one = [
+                            packed_one[dt][off:off + size].reshape(shape)
+                            for dt, off, shape, size in plan["specs"]]
+                        em = EmitCtx(view, consts_one)
+                        out = {}
+                        if plan["emit_q"] is not None:
+                            scores, mask = plan["emit_q"](em)
+                            mask = mask & view.live
+                            ts, td = topk_ops.top_k(
+                                scores, mask,
+                                min(c_static, view.padded_docs), 0)
+                            out["ts"], out["td"] = ts, td
+                        if plan["emit_f"] is not None:
+                            out["fmask"] = plan["emit_f"](em)
+                        return out
+
+                    if plan["specs"]:
+                        outs = jax.vmap(lane)(packed_in)
+                    else:
+                        # const-free plans: every request is the same
+                        # program — run once, broadcast the batch axis
+                        one = lane({})
+                        outs = {kk: jnp.broadcast_to(
+                            v, (b_pad,) + v.shape)
+                            for kk, v in one.items()}
+                    if hybrid:
+                        lex_ts.append(outs["ts"])
+                        lex_td.append(outs["td"])
+                    if "fmask" in outs:
+                        fmasks[i] = outs["fmask"]
+            # ---- per-segment knn candidates -------------------------
+            knn_ts, knn_td = [], []
+            knn_counts = jnp.zeros(b_pad, jnp.int32)
+            vi = 0
+            for i, arrs in enumerate(vec_in):
+                if not arrs:
+                    continue
+                if pack.multi:
+                    vecs, exists, live, lens = arrs
+                else:
+                    vecs, exists, live = arrs
+                if pack.multi and pack.quant == "int8":
+                    scores = maxsim_ops.maxsim_scores_int8_batch_body(
+                        vecs, scales_in[vi], offsets_in[vi], lens,
+                        qv_in, qmask_in)
+                elif pack.multi:
+                    scores = maxsim_ops.maxsim_scores_batch_body(
+                        vecs, lens, qv_in, qmask_in)
+                elif pack.quant == "int8":
+                    scores = vector_ops.cosine_scores_int8_batch(
+                        vecs, scales_in[vi], offsets_in[vi], exists,
+                        qv_in)
+                else:
+                    scores = jnp.where(exists[None, :],
+                                       qv_in @ vecs.T, 0.0)
+                if not hybrid:
+                    # knn-only: the section boost scales the reported
+                    # scores (rank-preserving — boost > 0 validated)
+                    scores = scores * boosts_in[:, None]
+                elig = exists & live
+                masks = jnp.broadcast_to(elig[None, :],
+                                         (b_pad, elig.shape[0]))
+                if fmasks[i] is not None:
+                    masks = masks & fmasks[i]
+                ts, td = vector_ops.filtered_topk_batch(
+                    scores, masks, min(c_static, elig.shape[0]), 0)
+                knn_ts.append(ts)
+                knn_td.append(td)
+                knn_counts = knn_counts + masks.sum(axis=1,
+                                                    dtype=jnp.int32)
+                vi += 1
+            ds, dd = topk_ops.merge_top_k_batch_body(
+                knn_ts, knn_td, c_static, vec_bases)
+            if not hybrid:
+                ts, td = ds[:, :k_static], dd[:, :k_static]
+                return {"top_scores": ts, "top_docs": td,
+                        "count": knn_counts}
+            ls, ld = topk_ops.merge_top_k_batch_body(
+                lex_ts, lex_td, c_static, bases)
+            if cfg.fusion_mode == "weighted":
+                ts, td, count = _weighted_fuse_body(
+                    ls, ld, ds, dd, boosts_in,
+                    float(cfg.lexical_weight), k_static)
+            else:
+                ts, td, count = _rrf_fuse_body(
+                    ls, ld, ds, dd, boosts_in,
+                    float(cfg.rank_constant), k_static)
+            return {"top_scores": ts, "top_docs": td, "count": count}
+
+        args = (flats, packeds, vec_arrs, pack.scales, pack.offsets,
+                qv, qmask if qmask is not None else jnp.zeros(0, bool),
+                boosts)
+        shapes = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args)
+        def run_outer(*a):
+            return run(a[0], a[1], a[2], a[3], a[4], a[5],
+                       a[6] if qmask is not None else None, a[7])
+        return jax.jit(run_outer).lower(*shapes).compile()
+
+    fn = _get_compiled(key, compile_fn)
+    args = (flats, packeds, vec_arrs, pack.scales, pack.offsets,
+            qv, qmask if qmask is not None else jnp.zeros(0, bool),
+            boosts)
+    if hybrid:
+        with device_span("fusion-dispatch"):
+            device_fault_point("fusion-dispatch")
+            out = fn(*args)
+    elif pack.multi:
+        with device_span("maxsim-dispatch"):
+            device_fault_point("maxsim-dispatch")
+            out = fn(*args)
+    else:
+        with device_span("dispatch"):
+            device_fault_point("dispatch")
+            out = fn(*args)
     if b_pad != b:
         out = {name: v[:b] for name, v in out.items()}
     return out

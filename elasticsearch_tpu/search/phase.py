@@ -71,6 +71,9 @@ class ParsedSearchRequest:
     terminate_after: int | None = None             # per-shard collected cap
     timeout_ms: float | None = None                # per-shard time budget
     rescore: list[RescoreSpec] = field(default_factory=list)
+    # top-level "knn" search section (dense / late-interaction lane;
+    # combined with `query` → in-program hybrid fusion)
+    knn: q.KnnSection | None = None
 
 
 def _task_budget(req: ParsedSearchRequest):
@@ -171,6 +174,27 @@ def parse_search_request(body: dict | None) -> ParsedSearchRequest:
             raise QueryParsingError(
                 "rescore cannot be combined with sort (QueryRescorer "
                 "re-ranks by score)")
+    if body.get("knn") is not None:
+        req.knn = q.parse_knn_section(body["knn"])
+        req.knn.hybrid = "knn" in body and "query" in body
+        # v1 lane surface: the knn section composes with from/size,
+        # _source/fields/highlight and its own `filter`; request
+        # features that would need rank-fused score arrays over the
+        # whole corpus are rejected up front with a clear 400
+        bad = [label for cond, label in (
+            (bool(req.sort) and not _is_score_order(req.sort), "sort"),
+            (bool(req.aggs), "aggs"),
+            (req.post_filter is not None, "post_filter"),
+            (req.min_score is not None, "min_score"),
+            (req.search_after is not None, "search_after"),
+            (bool(req.rescore), "rescore"),
+            (bool(req.suggest), "suggest"),
+            (req.terminate_after is not None, "terminate_after"),
+        ) if cond]
+        if bad:
+            raise QueryParsingError(
+                f"[knn] cannot be combined with {bad} — use the knn "
+                f"section's own [filter] for filtering")
     return req
 
 
@@ -368,6 +392,11 @@ class ShardSearcher:
         plan/trace seam is guarded — errors in parsing/aggs/sort raise
         normally without double execution."""
         from elasticsearch_tpu.search import jit_exec
+        if req.knn is not None:
+            # dense / late-interaction lane: compiled knn (or hybrid
+            # fusion) program with an eager per-segment fallback —
+            # breaker-gated and reason-labeled inside
+            return self._knn_query_phase(req)
         rewritten = self._rewrite_joins(req.query)
         if rewritten is not req.query or (
                 req.post_filter is not None):
@@ -524,7 +553,14 @@ class ShardSearcher:
             # per-request fallback lands on query_phase, which routes to
             # the eager executor under the same gate
             return None
-        # impact-ordered lane first: an opted-in index serves eligible
+        # knn/hybrid lane first: requests carrying a top-level knn
+        # section are served by the dedicated vector programs (mixed
+        # knn/non-knn batches decline — the caller retries per request)
+        if any(r.knn is not None for r in reqs):
+            if not all(r.knn is not None for r in reqs):
+                return None
+            return self._knn_batch_launch(reqs)
+        # impact-ordered lane next: an opted-in index serves eligible
         # disjunctive BM25 shapes from the quantized impact columns
         # (score-order search_after cursors included — the generic
         # screen below rejects those); ineligible requests fall through
@@ -538,7 +574,8 @@ class ShardSearcher:
                     or req.min_score is not None
                     or req.search_after is not None or req.suggest
                     or req.terminate_after is not None
-                    or req.timeout_ms is not None or req.rescore):
+                    or req.timeout_ms is not None or req.rescore
+                    or req.knn is not None):
                 return None
         k = max(max(req.from_ + req.size, 1) for req in reqs)
         queries = [req.query for req in reqs]
@@ -674,6 +711,217 @@ class ShardSearcher:
                 pass
         return ("impact", reqs, k, out, prune, pack.total_blocks)
 
+    # ---- dense / late-interaction lane (top-level "knn" section) ----------
+
+    def _validate_knn(self, knn: q.KnnSection) -> None:
+        """Parse-time mapping validation: the field must be mapped
+        dense_vector (flat query_vector) or rank_vectors (list-of-
+        vectors), and the query's dims must match the mapping — a clear
+        400 before any device work, not a score-time shape error."""
+        fm = self.mapper_service.field_mapper(knn.field)
+        kind = getattr(fm, "kind", None)
+        if fm is None or kind not in ("vector", "mvector"):
+            raise QueryParsingError(
+                f"[knn] field [{knn.field}] is not mapped as "
+                f"dense_vector or rank_vectors")
+        if knn.multi and kind != "mvector":
+            raise QueryParsingError(
+                f"[knn] field [{knn.field}] is dense_vector but "
+                f"query_vector is a list of vectors — flat [dims] "
+                f"expected")
+        if not knn.multi and kind != "vector":
+            raise QueryParsingError(
+                f"[knn] field [{knn.field}] is rank_vectors — "
+                f"query_vector must be a list of [dims] token vectors")
+        dims = int(getattr(fm, "dims", 0))
+        qdims = len(knn.query_vector[0]) if knn.multi \
+            else len(knn.query_vector)
+        if qdims != dims:
+            raise QueryParsingError(
+                f"[knn] query_vector dims [{qdims}] != mapped dims "
+                f"[{dims}] of field [{knn.field}]")
+
+    @staticmethod
+    def _knn_limit(req: ParsedSearchRequest) -> int:
+        """Hits a knn request may return: the from/size window, capped
+        by the section's k for pure knn (k IS "how many neighbors");
+        hybrid windows read from the fused list (depth bounded by
+        num_candidates per lane)."""
+        lim = max(req.from_ + req.size, 1)
+        return lim if req.knn.hybrid else min(lim, req.knn.k)
+
+    def _rewrite_knn(self, req: ParsedSearchRequest) -> ParsedSearchRequest:
+        """Join-rewrite the hybrid lexical query and the knn filter."""
+        import dataclasses as _dc
+        knn = req.knn
+        new_q = self._rewrite_joins(req.query) if knn.hybrid else req.query
+        new_f = self._rewrite_joins(knn.filter) \
+            if knn.filter is not None else None
+        if new_q is req.query and new_f is knn.filter:
+            return req
+        return _dc.replace(req, query=new_q,
+                           knn=_dc.replace(knn, filter=new_f))
+
+    def _knn_batch_launch(self, reqs: list):
+        """knn-lane admission + dispatch: serve B knn/hybrid requests
+        as ONE compiled program (jit_exec.run_knn_hybrid_batch) over
+        the reader's block-cached vector columns. Returns a drain
+        handle or None (callers retry per request / fall back to the
+        eager per-segment lane); declines are reason-labeled via
+        note_knn_fallback, mirroring the impact lane's admission
+        accounting. Mapping violations raise QueryParsingError — those
+        are request errors (400), never fallbacks."""
+        from elasticsearch_tpu.search import jit_exec
+        for r in reqs:
+            self._validate_knn(r.knn)
+        if not self.reader.segments:
+            return ("empty", reqs)
+        knns = [r.knn for r in reqs]
+        if len({(kn.field, kn.hybrid, kn.multi, kn.num_candidates)
+                for kn in knns}) != 1:
+            jit_exec.note_knn_fallback("mixed-shapes")
+            return None
+        if any(not getattr(s, "resident", True)
+               for s in self.reader.segments):
+            jit_exec.note_knn_fallback("streamed-reader")
+            return None
+        reqs = [self._rewrite_knn(r) for r in reqs]
+        cfg = jit_exec.knn_plane_config(self.ctx.index_name)
+        k_prog = max(self._knn_limit(r) for r in reqs)
+        try:
+            pack = jit_exec.vector_pack_for(self.reader, knns[0].field,
+                                            cfg)
+            if pack is None:
+                # mapped but no segment carries vectors yet: the eager
+                # lane returns the same empty result without a compile
+                jit_exec.note_knn_fallback("no-vector-columns")
+                return None
+            if pack.multi != knns[0].multi:
+                jit_exec.note_knn_fallback("mixed-shapes")
+                return None
+            out = jit_exec.run_knn_hybrid_batch(
+                self.reader, self.ctx, reqs, pack, cfg, k=k_prog,
+                num_candidates=knns[0].num_candidates)
+        except QueryParsingError:
+            raise
+        except Exception as e:            # noqa: BLE001 — fallback seam
+            jit_exec.note_fallback(e, reason="device-error")
+            jit_exec.note_device_error(e)
+            jit_exec.note_knn_fallback("device-error")
+            return None
+        if out is None:                   # mixed plan signatures
+            jit_exec.note_knn_fallback("mixed-shapes")
+            return None
+        jit_exec.plane_breaker.record_success()
+        hybrid = knns[0].hybrid
+        jit_exec.note_knn_served(
+            self.ctx.index_name, len(reqs),
+            fused=len(reqs) if hybrid else 0,
+            maxsim=len(reqs) if pack.multi else 0)
+        for name in ("top_scores", "top_docs", "count"):
+            try:
+                out[name].copy_to_host_async()
+            except Exception:             # noqa: BLE001 — optional
+                pass
+        return ("knn", reqs, k_prog, out)
+
+    def _knn_query_phase(self, req: ParsedSearchRequest
+                         ) -> ShardQueryResult:
+        """Single-request knn/hybrid entry: compiled lane when the
+        breaker admits it, eager per-segment fallback otherwise."""
+        from elasticsearch_tpu.search import jit_exec
+        self._validate_knn(req.knn)
+        if jit_exec.plane_breaker.allow():
+            handle = self._knn_batch_launch([req])
+            if handle is not None:
+                return self.query_phase_batch_drain(handle)[0]
+        else:
+            jit_exec.note_breaker_skip()
+            jit_exec.note_knn_fallback("breaker-open")
+        return self._knn_query_phase_eager(req)
+
+    def _knn_query_phase_eager(self, req: ParsedSearchRequest
+                               ) -> ShardQueryResult:
+        """Eager fallback lane: host-side per-segment scoring from the
+        SAME cached host columns (normalized f32 / int8 snapshot) the
+        compiled pack uploads, host candidate selection and host
+        fusion — the reference implementation the compiled program is
+        tested against."""
+        from elasticsearch_tpu.search import jit_exec
+        req = self._rewrite_knn(req)
+        knn = req.knn
+        cfg = jit_exec.knn_plane_config(self.ctx.index_name)
+        task, deadline = _task_budget(req)
+        qv = np.asarray(knn.query_vector, np.float32)
+        if knn.multi:
+            qn = qv / np.maximum(
+                np.linalg.norm(qv, axis=1, keepdims=True), 1e-12)
+        else:
+            qn = qv / max(float(np.linalg.norm(qv)), 1e-12)
+        knn_s, knn_d = [], []
+        lex_s, lex_d = [], []
+        eligible = 0
+        for dseg in self.reader.segments:
+            _checkpoint(task)
+            base = dseg.doc_base
+            live = np.asarray(dseg.live)
+            fmask = None
+            if knn.filter is not None:
+                ex = SegmentExecutor(dseg, self.ctx)
+                fmask = np.asarray(ex.match_mask(knn.filter))
+            if knn.hybrid:
+                ex = SegmentExecutor(dseg, self.ctx)
+                scores, mask = ex.execute(req.query)
+                m = np.asarray(mask) & live
+                s = np.asarray(scores)
+                idx = np.nonzero(m)[0]
+                lex_s.append(s[idx].astype(np.float32))
+                lex_d.append(idx.astype(np.int64) + base)
+            entry = jit_exec._host_knn_column(dseg.seg, knn.field,
+                                              cfg.quantization)
+            if entry is None:
+                continue
+            host, multi, _dims = entry
+            exists = host["exists"]
+            elig = exists & live[:exists.shape[0]]
+            if fmask is not None:
+                elig = elig & fmask[:exists.shape[0]]
+            if multi:
+                s = _maxsim_host(host, qn)
+            elif host["qcol"] is not None:
+                s = (host["vecs"].astype(np.float32) @ qn) \
+                    * np.float32(host["scale"]) \
+                    + np.float32(host["offset"]) * np.float32(qn.sum())
+            else:
+                s = host["vecs"] @ qn
+            eligible += int(elig.sum())
+            idx = np.nonzero(elig)[0]
+            knn_s.append(s[idx].astype(np.float32))
+            knn_d.append(idx.astype(np.int64) + base)
+        c = knn.num_candidates
+
+        def topc(scores_l, docs_l, depth):
+            s = np.concatenate(scores_l) if scores_l \
+                else np.zeros(0, np.float32)
+            d = np.concatenate(docs_l) if docs_l \
+                else np.zeros(0, np.int64)
+            order = np.lexsort((d, -s.astype(np.float64)))[:depth]
+            return s[order], d[order]
+        ds, dd = topc(knn_s, knn_d, c)
+        kq = self._knn_limit(req)
+        if not knn.hybrid:
+            s_ = (ds * np.float32(knn.boost))[:kq]
+            d_ = dd[:kq]
+            total = eligible
+        else:
+            ls, ld = topc(lex_s, lex_d, c)
+            s_, d_, total = fuse_host(ls, ld, ds, dd, knn.boost, cfg, kq)
+        return ShardQueryResult(
+            self.shard_id, int(total),
+            float(s_[0]) if len(s_) else None,
+            np.asarray(d_, np.int32), np.asarray(s_, np.float32),
+            None, {}, self.reader)
+
     def query_phase_batch_drain(self, handle
                                 ) -> list[ShardQueryResult]:
         """Phase 2: block until the launched batch's results are on host
@@ -685,6 +933,22 @@ class ShardSearcher:
                                      np.zeros(0, np.int32),
                                      np.zeros(0, np.float32), None, {},
                                      self.reader) for _ in reqs]
+        if tag == "knn":
+            _, _, _k, out = handle
+            ms = np.asarray(out["top_scores"])
+            md = np.asarray(out["top_docs"])
+            totals = np.asarray(out["count"])
+            results = []
+            for bi, req in enumerate(reqs):
+                kq = self._knn_limit(req)
+                valid = md[bi] >= 0
+                s_, d_ = ms[bi][valid][:kq], md[bi][valid][:kq]
+                results.append(ShardQueryResult(
+                    self.shard_id, int(totals[bi]),
+                    float(s_[0]) if s_.size else None,
+                    d_.astype(np.int32), s_.astype(np.float32), None,
+                    {}, self.reader))
+            return results
         if tag == "impact":
             from elasticsearch_tpu.observability import attribution
             from elasticsearch_tpu.search import jit_exec
@@ -1328,6 +1592,66 @@ def _filter_source(src: dict, spec) -> dict | None:
         return out
 
     return walk(src, "")
+
+
+def _maxsim_host(host: dict, qn: np.ndarray) -> np.ndarray:
+    """Host (numpy) MaxSim over one segment's cached knn column — the
+    eager lane's scorer and the kernel tests' oracle. ``qn``: [Qt, D]
+    row-normalized query tokens."""
+    vecs = host["vecs"].astype(np.float32)        # [N, T, D] (int8→f32)
+    lens = host["lens"]
+    sim = np.einsum("ntd,qd->nqt", vecs, qn.astype(np.float32))
+    t = vecs.shape[1]
+    pad = np.arange(t)[None, None, :] >= lens[:, None, None]
+    sim = np.where(pad, -np.inf, sim)
+    tokmax = sim.max(axis=2)                      # [N, Qt]
+    if host["qcol"] is not None:
+        tokmax = tokmax * np.float32(host["scale"]) \
+            + np.float32(host["offset"]) * qn.sum(axis=1)[None, :] \
+            .astype(np.float32)
+    tokmax = np.where(np.isfinite(tokmax), tokmax, 0.0)
+    return tokmax.sum(axis=1).astype(np.float32)
+
+
+def fuse_host(ls, ld, ds, dd, boost: float, cfg, k: int):
+    """Host-side hybrid fusion — the oracle the in-program fusion is
+    bit-matched against (f32 arithmetic, (score desc, doc asc) ties).
+
+    ls/ld: lexical candidates (scores f32, global doc ids) in rank
+    order; ds/dd: knn lane; boost scales the knn contribution.
+    → (scores [<=k] f32, docs [<=k], fused candidate count)."""
+    ls = np.asarray(ls, np.float32)
+    ds = np.asarray(ds, np.float32)
+    fused: dict[int, np.float32] = {}
+    if cfg.fusion_mode == "weighted":
+        def norm(s):
+            if not len(s):
+                return s
+            lo, hi = np.float32(s.min()), np.float32(s.max())
+            rng = (hi - lo) if hi > lo else np.float32(1.0)
+            return ((s - lo) / rng).astype(np.float32)
+        for d, v in zip(ld, np.float32(cfg.lexical_weight) * norm(ls)):
+            fused[int(d)] = fused.get(int(d), np.float32(0.0)) + v
+        wd = np.float32(1.0 - cfg.lexical_weight) * np.float32(boost)
+        for d, v in zip(dd, wd * norm(ds)):
+            fused[int(d)] = fused.get(int(d), np.float32(0.0)) + v
+    else:
+        # strict f32 arithmetic mirroring the device body: the rank
+        # denominators are small integers (exact in f32), the division
+        # and the boost multiply run in f32, and each doc receives at
+        # most one contribution per lane (lex first) — so the fused
+        # score is BIT-IDENTICAL to the in-program reduction
+        k0 = int(cfg.rank_constant)
+        bf = np.float32(boost)
+        for rank, d in enumerate(ld):
+            c = np.float32(1.0) / np.float32(k0 + rank + 1)
+            fused[int(d)] = fused.get(int(d), np.float32(0.0)) + c
+        for rank, d in enumerate(dd):
+            c = (np.float32(1.0) / np.float32(k0 + rank + 1)) * bf
+            fused[int(d)] = fused.get(int(d), np.float32(0.0)) + c
+    ranked = sorted(fused.items(), key=lambda kv: (-kv[1], kv[0]))[:k]
+    return (np.asarray([s for _, s in ranked], np.float32),
+            np.asarray([d for d, _ in ranked], np.int64), len(fused))
 
 
 def _sort_value_out(v):

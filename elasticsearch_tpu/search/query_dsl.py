@@ -338,9 +338,107 @@ class ScriptScoreQuery(Query):
 
 @dataclass
 class KnnQuery(Query):
+    """Query-DSL leaf form (back-compat alias of the top-level ``knn``
+    search section): scores every vector-carrying doc by cosine through
+    the generic compiled path. New callers should use the top-level
+    section (:class:`KnnSection`), which rides the dedicated knn lane
+    with candidate oversampling, filters and hybrid fusion."""
     field: str = ""
     query_vector: list[float] = dc_field(default_factory=list)
     num_candidates: int | None = None
+
+
+#: num_candidates ceiling (the ES bound) — a request past it is a 400
+MAX_NUM_CANDIDATES = 10_000
+
+
+@dataclass
+class KnnSection:
+    """The TOP-LEVEL ``"knn"`` search section (field, query_vector, k,
+    num_candidates, filter, boost), combinable with a ``"query"`` clause
+    for hybrid BM25+vector fusion. ``query_vector`` is a flat [D] list
+    for ``dense_vector`` fields or a [T, D] list-of-lists for
+    ``rank_vectors`` (late-interaction MaxSim). Search is EXACT
+    (brute-force scoring of every live vector): ``num_candidates`` is
+    the per-shard candidate depth each lane feeds into filtering and
+    hybrid fusion — unlike ANN engines it never trades recall, it only
+    bounds the fusion/merge width."""
+    field: str = ""
+    query_vector: list = dc_field(default_factory=list)
+    k: int = 10
+    num_candidates: int = 100
+    filter: Query | None = None
+    boost: float = 1.0
+    multi: bool = False        # [T, D] late-interaction query
+    hybrid: bool = False       # request also carries a "query" clause
+
+
+def parse_knn_section(body) -> KnnSection:
+    """Parse + validate the top-level ``knn`` section. Violations raise
+    :class:`QueryParsingError` (the 400 the REST layer maps) at parse
+    time — before any device work."""
+    if not isinstance(body, dict):
+        raise QueryParsingError("[knn] must be an object")
+    field = body.get("field")
+    if not field:
+        raise QueryParsingError("[knn] requires [field]")
+    qv = body.get("query_vector")
+    if not isinstance(qv, list) or not qv:
+        raise QueryParsingError(
+            "[knn] requires a non-empty [query_vector]")
+    multi = isinstance(qv[0], (list, tuple))
+    if multi:
+        dims = len(qv[0])
+        for row in qv:
+            if not isinstance(row, (list, tuple)) or len(row) != dims \
+                    or not row:
+                raise QueryParsingError(
+                    "[knn] multi-vector query_vector rows must all "
+                    "share one dimension")
+        qv = [[float(x) for x in row] for row in qv]
+    else:
+        qv = [float(x) for x in qv]
+    try:
+        k = int(body.get("k", 10))
+    except (TypeError, ValueError):
+        raise QueryParsingError(
+            f"[knn] k must be an integer, got [{body.get('k')}]") \
+            from None
+    if k < 1:
+        raise QueryParsingError(f"[knn] k must be >= 1, got {k}")
+    raw_nc = body.get("num_candidates", max(k, 100))
+    try:
+        nc = int(raw_nc)
+    except (TypeError, ValueError):
+        raise QueryParsingError(
+            f"[knn] num_candidates must be an integer, got [{raw_nc}]") \
+            from None
+    if nc < k:
+        raise QueryParsingError(
+            f"[knn] num_candidates [{nc}] must be >= k [{k}]")
+    if nc > MAX_NUM_CANDIDATES:
+        raise QueryParsingError(
+            f"[knn] num_candidates [{nc}] must be <= "
+            f"{MAX_NUM_CANDIDATES}")
+    boost = float(body.get("boost", 1.0))
+    if boost <= 0:
+        raise QueryParsingError(
+            f"[knn] boost must be > 0, got {boost}")
+    filt = None
+    if body.get("filter") is not None:
+        raw_f = body["filter"]
+        if isinstance(raw_f, list):     # ES accepts a list of filters
+            filt = BoolQuery(filter=[parse_query(f) for f in raw_f])
+        else:
+            filt = parse_query(raw_f)
+    unknown = set(body) - {"field", "query_vector", "k",
+                           "num_candidates", "filter", "boost"}
+    if unknown:
+        raise QueryParsingError(
+            f"[knn] unknown parameter(s) {sorted(unknown)}")
+    return KnnSection(field=str(field), query_vector=qv, k=k,
+                      num_candidates=nc, filter=filt, boost=boost,
+                      multi=multi)
 
 
 @dataclass

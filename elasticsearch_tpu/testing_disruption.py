@@ -402,7 +402,12 @@ DEVICE_FAULT_SITES = ("dispatch", "compile", "upload", "compose",
                       # column/block-max upload, pack-level compose,
                       # and the block-max sweep dispatch
                       "impact-upload", "blockmax-compose",
-                      "pruning-dispatch")
+                      "pruning-dispatch",
+                      # dense/late-interaction lane touchpoints:
+                      # vector block upload, fused MaxSim dispatch,
+                      # and the in-program hybrid fusion dispatch
+                      "vector-upload", "maxsim-dispatch",
+                      "fusion-dispatch")
 READER_UPLOAD_SITE = "reader-upload"
 
 

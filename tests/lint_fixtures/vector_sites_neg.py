@@ -1,0 +1,33 @@
+"""Negative fixtures: the knn lane's device seams done RIGHT — every
+new site class (vector-upload, maxsim-dispatch, fusion-dispatch)
+guarded, span-scoped, and of the correct family. Must lint clean under
+the seam-module config.
+"""
+
+import jax
+
+
+def device_fault_point(site):
+    pass
+
+
+def device_span(site):
+    pass
+
+
+def vector_block_upload(arr):
+    with device_span("vector-upload"):
+        device_fault_point("vector-upload")
+        return jax.device_put(arr)
+
+
+def maxsim_dispatch(fn, args):
+    with device_span("maxsim-dispatch"):
+        device_fault_point("maxsim-dispatch")
+        return fn(*args)
+
+
+def fusion_dispatch(fn, args):
+    with device_span("fusion-dispatch"):
+        device_fault_point("fusion-dispatch")
+        return fn(*args)

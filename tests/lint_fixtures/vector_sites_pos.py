@@ -1,0 +1,37 @@
+"""Positive fixtures: knn-lane device seams done WRONG.
+
+The dense/late-interaction lane added three site classes
+(vector-upload, maxsim-dispatch, fusion-dispatch). These shapes must
+each fire: a vector upload with no span pairing, a device_put
+"guarded" by a dispatch-class site (not an upload-class one), and a
+typo'd site the chaos scheme would never draw.
+"""
+
+import jax
+
+
+def device_fault_point(site):
+    pass
+
+
+def device_span(site):
+    pass
+
+
+def unspanned_vector_upload(arr):
+    device_fault_point("vector-upload")   # span-unscoped-site
+    return jax.device_put(arr)
+
+
+def fusion_guarding_an_upload(arr):
+    with device_span("fusion-dispatch"):
+        device_fault_point("fusion-dispatch")
+        # device-unguarded: fusion-dispatch is not an upload-class
+        # site, so this transfer is invisible to upload fault draws
+        return jax.device_put(arr)
+
+
+def typoed_site(fn, args):
+    with device_span("maxsim-dispatch"):
+        device_fault_point("maxsim-dispach")   # device-unknown-site
+        return fn(*args)

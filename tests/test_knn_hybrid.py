@@ -1,0 +1,603 @@
+"""Dense + late-interaction retrieval lane (tier-1 guards).
+
+Top-level `knn` search section, rank_vectors MaxSim, and in-program
+hybrid fusion (ISSUE 10 / ROADMAP item 4):
+
+* exactness — brute-force kNN and MaxSim hits match an independent
+  float64 numpy oracle (recall@k = 1.0 with tie tolerance) across
+  missing-vector docs, filters, and delete churn over refresh/merge;
+  int8 quantized scores stay within the stamped per-segment bound;
+* fusion — a hybrid (BM25+kNN RRF) request is ONE device dispatch
+  (program-cache counter-verified; fusion_dispatches reconciles with
+  request count) and its hits match the host-side fusion oracle
+  EXACTLY at f32 (ids and bit-equal scores);
+* PR 5 discipline — vector columns ride the per-segment device-block
+  cache: refreshes upload vector bytes only for NEW segments,
+  delete-only refreshes upload zero, engine close strands nothing;
+* admission — mapping/parse violations are clear 400s, declines are
+  reason-labeled, the eager fallback lane agrees with the compiled
+  lane, and the collective plane hands knn bodies to this lane.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import (IllegalArgumentError,
+                                             QueryParsingError)
+from elasticsearch_tpu.index.device_reader import device_reader_for
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.parallel import mesh_engine
+from elasticsearch_tpu.search import jit_exec
+from elasticsearch_tpu.search.phase import (ShardSearcher, fuse_host,
+                                            parse_search_request)
+
+
+@pytest.fixture
+def node(tmp_path):
+    jit_exec.clear_cache()
+    n = Node({}, data_path=tmp_path / "n").start()
+    yield n
+    n.close()
+    jit_exec.clear_cache()
+
+
+DIMS = 8
+
+
+def _mk_vec_index(node, name, *, dims=DIMS, quant="f32", shards=1,
+                  rank=False, extra_settings=None, plane=False):
+    settings = {"number_of_shards": shards, "number_of_replicas": 0,
+                "index.search.collective_plane": plane,
+                "index.knn.quantization": quant}
+    settings.update(extra_settings or {})
+    props = {"body": {"type": "text", "analyzer": "whitespace"},
+             "tag": {"type": "keyword"}}
+    if rank:
+        props["vec"] = {"type": "rank_vectors", "dims": dims,
+                        "max_tokens": 8}
+    else:
+        props["vec"] = {"type": "dense_vector", "dims": dims}
+    node.indices_service.create_index(name, {
+        "settings": settings,
+        "mappings": {"_doc": {"properties": props}}})
+
+
+def _vec_docs(rng, n, *, dims=DIMS, missing=0.2, rank=False):
+    """→ list of (source, vec|None). Vec is float64 (the oracle's
+    precision); the engine sees the same values as JSON floats."""
+    docs = []
+    for i in range(n):
+        src = {"body": f"w{i % 7} w{int(rng.integers(0, 10))}",
+               "tag": f"g{i % 3}"}
+        if rng.random() < missing:
+            docs.append((src, None))
+            continue
+        if rank:
+            t = int(rng.integers(1, 6))
+            v = rng.standard_normal((t, dims))
+        else:
+            v = rng.standard_normal(dims)
+        src["vec"] = v.tolist()
+        docs.append((src, v))
+    return docs
+
+
+def _index_docs(node, name, docs):
+    for i, (src, _v) in enumerate(docs):
+        node.index_doc(name, str(i), src)
+    node.broadcast_actions.refresh(name)
+
+
+def _searcher(node, name, shard=0):
+    svc = node.indices_service.indices[name]
+    return ShardSearcher(shard, device_reader_for(svc.engine(shard)),
+                         svc.mapper_service, index_name=name)
+
+
+def _cosine_oracle(vec, q):
+    v = np.asarray(vec, np.float64)
+    qq = np.asarray(q, np.float64)
+    return float(v @ qq / (np.linalg.norm(v) * np.linalg.norm(qq)
+                           + 1e-300))
+
+
+def _maxsim_oracle(mat, q):
+    """Float64 MaxSim: Σ_i max_j cos(q_i, d_j)."""
+    d = np.asarray(mat, np.float64)
+    qq = np.asarray(q, np.float64)
+    dn = d / np.maximum(np.linalg.norm(d, axis=1, keepdims=True), 1e-300)
+    qn = qq / np.maximum(np.linalg.norm(qq, axis=1, keepdims=True),
+                         1e-300)
+    return float((qn @ dn.T).max(axis=1).sum())
+
+
+def _oracle_scores(docs, q, *, alive=None, tags=None, rank=False):
+    """doc index → oracle score for every eligible doc."""
+    out = {}
+    for i, (src, v) in enumerate(docs):
+        if v is None:
+            continue
+        if alive is not None and i not in alive:
+            continue
+        if tags is not None and src["tag"] not in tags:
+            continue
+        out[i] = _maxsim_oracle(v, q) if rank else _cosine_oracle(v, q)
+    return out
+
+
+def _assert_topk_matches_oracle(searcher, res, docs, oracle, k,
+                                tol=2e-5):
+    """Every returned hit must be eligible, and collectively they must
+    be the oracle's top-k up to score ties within tol."""
+    ids = [searcher.reader.doc_id(int(g)) for g in res.doc_ids]
+    assert len(ids) == min(k, len(oracle)), (ids, len(oracle))
+    kth = sorted(oracle.values(), reverse=True)[
+        min(k, len(oracle)) - 1] if oracle else 0.0
+    for did in ids:
+        assert int(did) in oracle, f"ineligible hit {did}"
+        assert oracle[int(did)] >= kth - tol, \
+            f"doc {did} score {oracle[int(did)]} below kth {kth}"
+
+
+# ---------------------------------------------------------------------------
+# parse / mapping validation (400s)
+# ---------------------------------------------------------------------------
+
+def test_knn_section_parse_400s():
+    good = {"field": "v", "query_vector": [0.1, 0.2]}
+    parse_search_request({"knn": good})
+    for bad in [
+        {},                                              # no field
+        {"field": "v"},                                  # no vector
+        {"field": "v", "query_vector": []},              # empty vector
+        {**good, "k": 0},
+        {**good, "k": "x"},
+        {**good, "k": 5, "num_candidates": 4},           # nc < k
+        {**good, "num_candidates": 100_001},             # nc > cap
+        {**good, "boost": 0},
+        {**good, "nope": 1},                             # unknown param
+        {"field": "v", "query_vector": [[0.1], [0.1, 0.2]]},  # ragged
+    ]:
+        with pytest.raises(QueryParsingError):
+            parse_search_request({"knn": bad})
+
+
+def test_knn_incompatible_options_400():
+    knn = {"field": "v", "query_vector": [0.1, 0.2]}
+    for extra in [{"sort": [{"tag": "asc"}]},
+                  {"aggs": {"a": {"terms": {"field": "tag"}}}},
+                  {"post_filter": {"term": {"tag": "g0"}}},
+                  {"min_score": 1.0},
+                  {"search_after": [1.0, 2]},
+                  {"rescore": {"query": {"rescore_query":
+                                         {"match_all": {}}}}},
+                  {"terminate_after": 5}]:
+        with pytest.raises(QueryParsingError):
+            parse_search_request({"knn": knn, **extra})
+
+
+def test_knn_mapping_validation_400(node, rng):
+    _mk_vec_index(node, "mv", dims=4)
+    _index_docs(node, "mv", _vec_docs(rng, 5, dims=4, missing=0.0))
+    s = _searcher(node, "mv")
+    for knn in [
+        {"field": "vec", "query_vector": [0.1] * 3},     # wrong dims
+        {"field": "vec", "query_vector": [[0.1] * 4]},   # multi vs dense
+        {"field": "body", "query_vector": [0.1] * 4},    # not a vector
+        {"field": "nope", "query_vector": [0.1] * 4},    # unmapped
+    ]:
+        with pytest.raises(QueryParsingError):
+            s.query_phase(parse_search_request({"knn": knn}))
+
+
+def test_vector_mapping_bounds_400(node):
+    with pytest.raises(IllegalArgumentError):
+        node.indices_service.create_index("b1", {"mappings": {"_doc": {
+            "properties": {"v": {"type": "dense_vector",
+                                 "dims": 5000}}}}})
+    with pytest.raises(IllegalArgumentError):
+        node.indices_service.create_index("b2", {"mappings": {"_doc": {
+            "properties": {"v": {"type": "rank_vectors", "dims": 4,
+                                 "max_tokens": 100000}}}}})
+
+
+def test_knn_settings_validated_at_create(node):
+    for bad in [{"index.knn.quantization": "int4"},
+                {"index.search.hybrid.mode": "maxfuse"},
+                {"index.search.hybrid.rank_constant": 0},
+                {"index.search.hybrid.lexical_weight": 1.5}]:
+        with pytest.raises(IllegalArgumentError):
+            node.indices_service.create_index(
+                "badset", {"settings": bad})
+    assert "badset" not in node.indices_service.indices
+
+
+# ---------------------------------------------------------------------------
+# knn-only + MaxSim oracle fuzz (filters, missing vectors, churn)
+# ---------------------------------------------------------------------------
+
+def test_knn_oracle_fuzz_with_filters_and_churn(node, rng):
+    docs = _vec_docs(rng, 120)
+    _mk_vec_index(node, "fz")
+    _index_docs(node, "fz", docs)
+    alive = set(range(len(docs)))
+    for round_ in range(3):
+        q = rng.standard_normal(DIMS)
+        use_filter = round_ % 2 == 1
+        knn = {"field": "vec", "query_vector": q.tolist(), "k": 10,
+               "num_candidates": 40}
+        if use_filter:
+            knn["filter"] = {"term": {"tag": "g1"}}
+        s = _searcher(node, "fz")
+        res = s.query_phase(parse_search_request({"knn": knn,
+                                                  "size": 10}))
+        oracle = _oracle_scores(docs, q, alive=alive,
+                                tags={"g1"} if use_filter else None)
+        _assert_topk_matches_oracle(s, res, docs, oracle, 10)
+        assert res.total == len(oracle)
+        # eager lane equality (ids; scores to f32 tolerance)
+        res_e = s._knn_query_phase_eager(
+            parse_search_request({"knn": knn, "size": 10}))
+        assert list(res.doc_ids) == list(res_e.doc_ids)
+        np.testing.assert_allclose(res.scores, res_e.scores,
+                                   rtol=2e-5, atol=2e-6)
+        # churn between rounds: delete a slice, then refresh; last
+        # round adds a force-merge so candidates cross a merge too
+        drop = [i for i in list(alive)[: 12 + round_ * 5]]
+        for did in drop:
+            node.document_actions.delete_doc("fz", str(did))
+            alive.discard(did)
+        node.broadcast_actions.refresh("fz")
+        if round_ == 1:
+            node.indices_service.indices["fz"].force_merge(1)
+            node.broadcast_actions.refresh("fz")
+    # post-churn: deleted docs never surface
+    q = rng.standard_normal(DIMS)
+    s = _searcher(node, "fz")
+    res = s.query_phase(parse_search_request(
+        {"knn": {"field": "vec", "query_vector": q.tolist(), "k": 10,
+                 "num_candidates": 40}, "size": 10}))
+    oracle = _oracle_scores(docs, q, alive=alive)
+    _assert_topk_matches_oracle(s, res, docs, oracle, 10)
+
+
+def test_maxsim_oracle_fuzz(node, rng):
+    docs = _vec_docs(rng, 80, rank=True)
+    _mk_vec_index(node, "ms", rank=True)
+    _index_docs(node, "ms", docs)
+    s = _searcher(node, "ms")
+    for _ in range(3):
+        q = rng.standard_normal((int(rng.integers(1, 5)), DIMS))
+        res = s.query_phase(parse_search_request(
+            {"knn": {"field": "vec", "query_vector": q.tolist(),
+                     "k": 8, "num_candidates": 30}, "size": 8}))
+        oracle = _oracle_scores(docs, q, rank=True)
+        _assert_topk_matches_oracle(s, res, docs, oracle, 8)
+        res_e = s._knn_query_phase_eager(parse_search_request(
+            {"knn": {"field": "vec", "query_vector": q.tolist(),
+                     "k": 8, "num_candidates": 30}, "size": 8}))
+        assert list(res.doc_ids) == list(res_e.doc_ids)
+
+
+def test_int8_quantization_bound(node, rng):
+    docs = _vec_docs(rng, 100, missing=0.0)
+    _mk_vec_index(node, "q8", quant="int8")
+    _mk_vec_index(node, "qf", quant="f32")
+    _index_docs(node, "q8", docs)
+    _index_docs(node, "qf", docs)
+    s8 = _searcher(node, "q8")
+    sf = _searcher(node, "qf")
+    hits = 0
+    total = 0
+    for _ in range(4):
+        q = rng.standard_normal(DIMS)
+        body = {"knn": {"field": "vec", "query_vector": q.tolist(),
+                        "k": 10, "num_candidates": 40}, "size": 10}
+        r8 = s8.query_phase(parse_search_request(body))
+        rf = sf.query_phase(parse_search_request(body))
+        # stamped bound: every int8 score within the pack's
+        # quantization envelope of the float64 oracle score
+        cfg = jit_exec.knn_plane_config("q8")
+        pack = jit_exec.vector_pack_for(s8.reader, "vec", cfg)
+        qn = np.asarray(q, np.float64)
+        qn = qn / np.linalg.norm(qn)
+        bound = pack.score_bound(qn) + 1e-4
+        for g, sc in zip(r8.doc_ids, r8.scores):
+            did = int(s8.reader.doc_id(int(g)))
+            assert abs(sc - _cosine_oracle(docs[did][1], q)) <= bound
+        f32_ids = {sf.reader.doc_id(int(g)) for g in rf.doc_ids}
+        hits += len({s8.reader.doc_id(int(g))
+                     for g in r8.doc_ids} & f32_ids)
+        total += len(f32_ids)
+    assert hits / total >= 0.7, f"int8 recall@10 too low: {hits}/{total}"
+
+
+# ---------------------------------------------------------------------------
+# hybrid fusion
+# ---------------------------------------------------------------------------
+
+def test_hybrid_rrf_matches_host_oracle_exactly(node, rng):
+    docs = _vec_docs(rng, 90, missing=0.1)
+    _mk_vec_index(node, "hy")
+    _index_docs(node, "hy", docs)
+    s = _searcher(node, "hy")
+    c = 25
+    for _ in range(3):
+        q = rng.standard_normal(DIMS)
+        text = f"w{int(rng.integers(0, 7))} w{int(rng.integers(0, 10))}"
+        boost = float(rng.choice([1.0, 2.0]))
+        body = {"query": {"match": {"body": text}},
+                "knn": {"field": "vec", "query_vector": q.tolist(),
+                        "k": 10, "num_candidates": c, "boost": boost},
+                "size": 10}
+        res = s.query_phase(parse_search_request(body))
+        # independent lane rankings: the engine's own lexical-only and
+        # knn-only results at depth C feed the host fusion oracle
+        lex = s.query_phase(parse_search_request(
+            {"query": {"match": {"body": text}}, "size": c}))
+        kn = s.query_phase(parse_search_request(
+            {"knn": {"field": "vec", "query_vector": q.tolist(),
+                     "k": c, "num_candidates": c}, "size": c}))
+        cfg = jit_exec.knn_plane_config("hy")
+        os_, od_, ocount = fuse_host(
+            lex.scores, lex.doc_ids.astype(np.int64),
+            kn.scores / np.float32(1.0), kn.doc_ids.astype(np.int64),
+            boost, cfg, 10)
+        assert list(res.doc_ids) == list(od_), (res.doc_ids, od_)
+        assert np.array_equal(res.scores, os_), \
+            f"fused scores not bit-equal: {res.scores} vs {os_}"
+        assert res.total == ocount
+
+
+def test_hybrid_weighted_mode(node, rng):
+    docs = _vec_docs(rng, 70, missing=0.1)
+    _mk_vec_index(node, "hw", extra_settings={
+        "index.search.hybrid.mode": "weighted",
+        "index.search.hybrid.lexical_weight": 0.3})
+    _index_docs(node, "hw", docs)
+    s = _searcher(node, "hw")
+    q = rng.standard_normal(DIMS)
+    body = {"query": {"match": {"body": "w1 w2"}},
+            "knn": {"field": "vec", "query_vector": q.tolist(),
+                    "k": 10, "num_candidates": 30}, "size": 10}
+    res = s.query_phase(parse_search_request(body))
+    res_e = s._knn_query_phase_eager(parse_search_request(body))
+    assert list(res.doc_ids) == list(res_e.doc_ids)
+    np.testing.assert_allclose(res.scores, res_e.scores, rtol=2e-5,
+                               atol=2e-6)
+
+
+def test_hybrid_one_dispatch_and_program_cache(node, rng):
+    """The one-dispatch proof: repeated hybrid shapes re-trace ≤1×
+    (program-cache misses stable after warmup) and fusion_dispatches
+    reconciles with the hybrid request count."""
+    docs = _vec_docs(rng, 60, missing=0.0)
+    _mk_vec_index(node, "od")
+    _index_docs(node, "od", docs)
+    s = _searcher(node, "od")
+
+    def body(i):
+        q = rng.standard_normal(DIMS)
+        return {"query": {"match": {"body": f"w{i % 7}"}},
+                "knn": {"field": "vec", "query_vector": q.tolist(),
+                        "k": 5, "num_candidates": 20}, "size": 5}
+    reqs = [parse_search_request(body(i)) for i in range(4)]
+    base_f = jit_exec.cache_stats()["fusion_dispatches"]
+    out = s.query_phase_batch(reqs)
+    assert out is not None and len(out) == 4
+    st = jit_exec.cache_stats()
+    assert st["fusion_dispatches"] - base_f == 4
+    misses0 = st["misses"]
+    reqs2 = [parse_search_request(body(i + 10)) for i in range(4)]
+    out2 = s.query_phase_batch(reqs2)
+    assert out2 is not None
+    st2 = jit_exec.cache_stats()
+    assert st2["misses"] == misses0, "repeated hybrid shape re-traced"
+    assert st2["fusion_dispatches"] - base_f == 8
+    assert st2["knn_admissions"] >= 8
+
+
+# ---------------------------------------------------------------------------
+# PR 5 discipline: incremental vector blocks + engine close
+# ---------------------------------------------------------------------------
+
+def _vector_bytes():
+    dl = jit_exec.cache_stats()["data_layer"]
+    return dl["vector_bytes_uploaded"], dl["vector_bytes_reused"]
+
+
+def test_vector_blocks_incremental(node, rng):
+    docs = _vec_docs(rng, 50, missing=0.0)
+    _mk_vec_index(node, "inc")
+    _index_docs(node, "inc", docs)
+    s = _searcher(node, "inc")
+    q = rng.standard_normal(DIMS)
+    body = {"knn": {"field": "vec", "query_vector": q.tolist(),
+                    "k": 5, "num_candidates": 20}, "size": 5}
+    s.query_phase(parse_search_request(body))
+    up0, re0 = _vector_bytes()
+    assert up0 > 0 and re0 == 0
+    # unrelated-segment refresh: resident segment blocks reuse, only
+    # the NEW segment's vector bytes upload
+    for i in range(8):
+        src, _ = _vec_docs(rng, 1, missing=0.0)[0]
+        node.index_doc("inc", f"n{i}", src)
+    node.broadcast_actions.refresh("inc")
+    s2 = _searcher(node, "inc")
+    s2.query_phase(parse_search_request(body))
+    up1, re1 = _vector_bytes()
+    assert re1 >= up0, "resident vector blocks must be reused"
+    newseg = s2.reader.segments[-1].seg
+    host, _multi, _d = jit_exec._host_knn_column(newseg, "vec", "f32")
+    expected = host["vecs"].nbytes + host["exists"].nbytes
+    assert up1 - up0 == expected, \
+        f"refresh must upload only the new segment " \
+        f"({up1 - up0} vs {expected})"
+    # delete-only refresh: ZERO new vector bytes
+    node.document_actions.delete_doc("inc", "3")
+    node.broadcast_actions.refresh("inc")
+    s3 = _searcher(node, "inc")
+    res = s3.query_phase(parse_search_request(body))
+    up2, _re2 = _vector_bytes()
+    assert up2 == up1, "delete-only refresh uploaded vector bytes"
+    assert "3" not in {s3.reader.doc_id(int(g)) for g in res.doc_ids}
+
+
+def test_engine_close_releases_vector_blocks(node, rng):
+    docs = _vec_docs(rng, 40, missing=0.0)
+    _mk_vec_index(node, "rel")
+    _index_docs(node, "rel", docs)
+    s = _searcher(node, "rel")
+    q = rng.standard_normal(DIMS)
+    s.query_phase(parse_search_request(
+        {"knn": {"field": "vec", "query_vector": q.tolist(), "k": 5,
+                 "num_candidates": 10}, "size": 5}))
+    svc = node.indices_service.indices["rel"]
+    uuids = {e.engine_uuid for e in svc.shard_engines}
+    assert any(key[0] in uuids and isinstance(key[2], tuple)
+               and key[2] and key[2][0] == "vector"
+               for key in mesh_engine.block_cache_keys())
+    node.indices_service.delete_index("rel")
+    assert not any(key[0] in uuids
+                   for key in mesh_engine.block_cache_keys()), \
+        "engine close must drop its vector blocks"
+
+
+# ---------------------------------------------------------------------------
+# fallback lane, device faults, plane handoff
+# ---------------------------------------------------------------------------
+
+def test_breaker_open_serves_eager_lane(node, rng):
+    docs = _vec_docs(rng, 50, missing=0.1)
+    _mk_vec_index(node, "brk")
+    _index_docs(node, "brk", docs)
+    s = _searcher(node, "brk")
+    q = rng.standard_normal(DIMS)
+    body = {"query": {"match": {"body": "w1"}},
+            "knn": {"field": "vec", "query_vector": q.tolist(),
+                    "k": 5, "num_candidates": 20}, "size": 5}
+    res = s.query_phase(parse_search_request(body))
+    try:
+        for _ in range(jit_exec.plane_breaker.threshold):
+            jit_exec.plane_breaker.record_error(RuntimeError("boom"))
+        assert not jit_exec.plane_breaker.allow()
+        res_e = s.query_phase(parse_search_request(body))
+        assert list(res.doc_ids) == list(res_e.doc_ids)
+        assert jit_exec.cache_stats()["knn_fallback_reasons"].get(
+            "breaker-open", 0) >= 1
+    finally:
+        jit_exec.plane_breaker.reset()
+
+
+def test_device_fault_falls_back_and_recovers(node, rng):
+    from elasticsearch_tpu.testing_disruption import DeviceFaultScheme
+    docs = _vec_docs(rng, 50, missing=0.0)
+    _mk_vec_index(node, "flt")
+    _index_docs(node, "flt", docs)
+    s = _searcher(node, "flt")
+    q = rng.standard_normal(DIMS)
+    body = {"query": {"match": {"body": "w2"}},
+            "knn": {"field": "vec", "query_vector": q.tolist(),
+                    "k": 5, "num_candidates": 20}, "size": 5}
+    res = s.query_phase(parse_search_request(body))
+    scheme = DeviceFaultScheme(seed=7, p=0.0,
+                               p_by_site={"fusion-dispatch": 1.0})
+    with scheme.applied():
+        res_f = s.query_phase(parse_search_request(body))
+        assert scheme.injected.get("fusion-dispatch", 0) >= 1
+        assert list(res_f.doc_ids) == list(res.doc_ids)
+        assert jit_exec.cache_stats()["knn_fallback_reasons"].get(
+            "device-error", 0) >= 1
+    res_h = s.query_phase(parse_search_request(body))
+    assert list(res_h.doc_ids) == list(res.doc_ids)
+
+
+def test_collective_plane_hands_knn_to_the_lane(node, rng):
+    docs = _vec_docs(rng, 60, missing=0.0)
+    _mk_vec_index(node, "pl", shards=2, plane=True)
+    _index_docs(node, "pl", docs)
+    q = rng.standard_normal(DIMS)
+    resp = node.search("pl", {
+        "query": {"match": {"body": "w1 w3"}},
+        "knn": {"field": "vec", "query_vector": q.tolist(), "k": 5,
+                "num_candidates": 20}, "size": 5})
+    assert resp["hits"]["hits"]
+    svc = node.indices_service.indices["pl"]
+    assert svc.plane_stats["fallback"].get("knn-lane", 0) >= 1
+    st = jit_exec.cache_stats()
+    assert st["knn_admissions"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# back-compat alias + surfaces
+# ---------------------------------------------------------------------------
+
+def test_query_dsl_leaf_alias_parity(node, rng):
+    """The query-DSL `knn` leaf (back-compat) ranks like the top-level
+    section on vector-carrying docs (leaf scores are cosine+1, section
+    scores raw cosine — ranks must agree)."""
+    docs = _vec_docs(rng, 60, missing=0.0)
+    _mk_vec_index(node, "alias")
+    _index_docs(node, "alias", docs)
+    s = _searcher(node, "alias")
+    q = rng.standard_normal(DIMS)
+    leaf = s.query_phase(parse_search_request(
+        {"query": {"knn": {"field": "vec",
+                           "query_vector": q.tolist()}}, "size": 8}))
+    sect = s.query_phase(parse_search_request(
+        {"knn": {"field": "vec", "query_vector": q.tolist(), "k": 8,
+                 "num_candidates": 30}, "size": 8}))
+    assert list(leaf.doc_ids) == list(sect.doc_ids)
+    np.testing.assert_allclose(np.asarray(leaf.scores) - 1.0,
+                               sect.scores, rtol=2e-5, atol=2e-6)
+
+
+def test_stats_and_cat_surfaces(node, rng):
+    from elasticsearch_tpu.rest.controller import RestController
+    from elasticsearch_tpu.rest.handlers import register_all
+    docs = _vec_docs(rng, 50, missing=0.0)
+    _mk_vec_index(node, "surf")
+    _index_docs(node, "surf", docs)
+    q = rng.standard_normal(DIMS)
+    resp = node.search("surf", {
+        "query": {"match": {"body": "w1"}},
+        "knn": {"field": "vec", "query_vector": q.tolist(), "k": 5,
+                "num_candidates": 20}, "size": 5})
+    assert resp["hits"]["hits"]
+    svc = node.indices_service.indices["surf"]
+    knn_st = svc.stats()["search"]["knn"]
+    assert knn_st["admissions"] >= 1, (
+        knn_st, jit_exec.cache_stats()["knn_fallback_reasons"],
+        jit_exec.cache_stats()["fallback_reasons"])
+    assert knn_st["fusion_dispatches"] >= 1
+    jit = node.local_node_stats()["indices"]["jit"]
+    assert jit["knn_admissions"] >= 1
+    assert jit["fusion_dispatches"] >= 1
+    assert "vector_bytes_uploaded" in jit["data_layer"]
+    c = RestController()
+    register_all(c, node)
+    st, cat = c.dispatch(
+        "GET", "/_cat/indices?h=index,knn.admissions,knn.fusion", b"")
+    assert st == 200, cat
+    cells = [ln for ln in cat.splitlines()
+             if ln.startswith("surf ")][0].split()
+    assert int(cells[1]) >= 1
+    assert int(cells[2]) >= 1
+
+
+def test_knn_hits_render_source_and_fields(node, rng):
+    docs = _vec_docs(rng, 30, missing=0.0)
+    _mk_vec_index(node, "rend")
+    _index_docs(node, "rend", docs)
+    q = rng.standard_normal(DIMS)
+    resp = node.search("rend", {
+        "knn": {"field": "vec", "query_vector": q.tolist(), "k": 3,
+                "num_candidates": 10},
+        "size": 3, "_source": ["tag"]})
+    hits = resp["hits"]["hits"]
+    assert len(hits) == 3
+    for h in hits:
+        assert set(h["_source"]) == {"tag"}
+        assert h["_score"] is not None

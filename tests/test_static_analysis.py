@@ -527,3 +527,50 @@ def test_impact_sites_negative():
         "\n".join(f.render() for f in r.unsuppressed)
     assert open_family(r, "span-discipline") == [], \
         "\n".join(f.render() for f in r.unsuppressed)
+
+
+# ---------------------------------------------------------------------------
+# knn-lane site classes (vector-upload / maxsim-dispatch /
+# fusion-dispatch)
+# ---------------------------------------------------------------------------
+
+VECTOR_FIX_CFG = LintConfig(seam_modules=("*/vector_sites_*.py",),
+                            hot_modules=("*/hot_mod_*.py",))
+
+
+def vector_fixture(name: str):
+    return lint_paths([str(FIXDIR / name)], VECTOR_FIX_CFG)
+
+
+def test_vector_sites_registered():
+    """The three knn-lane site classes are first-class citizens of
+    every discipline: lint vocabulary, family membership (upload vs
+    dispatch), and the default chaos draw."""
+    from elasticsearch_tpu.testing_disruption import DEVICE_FAULT_SITES
+    for site in ("vector-upload", "maxsim-dispatch", "fusion-dispatch"):
+        assert site in DEFAULT_CONFIG.known_sites
+        assert site in DEVICE_FAULT_SITES
+    assert "vector-upload" in DEFAULT_CONFIG.upload_sites
+    assert "maxsim-dispatch" in DEFAULT_CONFIG.dispatch_sites
+    assert "fusion-dispatch" in DEFAULT_CONFIG.dispatch_sites
+    assert "fusion-dispatch" not in DEFAULT_CONFIG.upload_sites
+
+
+def test_vector_sites_positive():
+    r = vector_fixture("vector_sites_pos.py")
+    unguarded = open_rules(r, "device-unguarded")
+    assert len(unguarded) == 1, "\n".join(f.render() for f in unguarded)
+    assert "fusion_guarding_an_upload" in unguarded[0].message
+    unknown = open_rules(r, "device-unknown-site")
+    assert len(unknown) == 1
+    unscoped = open_rules(r, "span-unscoped-site")
+    messages = " ".join(f.message for f in unscoped)
+    assert "unspanned_vector_upload" in messages
+
+
+def test_vector_sites_negative():
+    r = vector_fixture("vector_sites_neg.py")
+    assert open_family(r, "device-seam") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+    assert open_family(r, "span-discipline") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
