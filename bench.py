@@ -42,6 +42,18 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def lat_pcts(ms) -> dict:
+    """The one latency-summary discipline: p50 AND the tail (p99/p999)
+    of a sample array in ms. Every leg that stamps latencies uses these
+    keys, so the tail_tolerance leg's numbers have comparable baselines
+    across the artifact. (p999 at small n degenerates toward the max —
+    still stamped, honestly near-max.)"""
+    arr = np.asarray(ms, dtype=np.float64)
+    return {"p50_ms": round(float(np.percentile(arr, 50)), 2),
+            "p99_ms": round(float(np.percentile(arr, 99)), 2),
+            "p999_ms": round(float(np.percentile(arr, 99.9)), 2)}
+
+
 def timed_throughput(run, batches, n_threads: int = 1):
     """The one measurement discipline for every engine-path config: one
     warm run (the compile-cache hit), then either the full batch list
@@ -866,13 +878,14 @@ def main() -> int:
             cl_dt = time.perf_counter() - t0
             batcher.close()
             cl = np.array(cl_lat) * 1e3
-            p50 = float(np.percentile(cl, 50))
+            pcts = lat_pcts(cl)
+            p50 = pcts["p50_ms"]
             qps = len(cl_lat) / cl_dt
             log(f"[bench] engine ({n_clients} request-at-a-time clients, "
                 f"pipelined micro-batch={max_batch}): p50 {p50:.1f} ms, "
-                f"{qps:.1f} QPS")
+                f"p99 {pcts['p99_ms']:.1f} ms, {qps:.1f} QPS")
             return {"clients": n_clients, "max_batch": max_batch,
-                    "p50_ms": round(p50, 2), "qps": round(qps, 2)}
+                    **pcts, "qps": round(qps, 2)}
 
         warmed: set = set()
         n_clients = int(os.environ.get("BENCH_CLIENTS", 32))
@@ -883,9 +896,12 @@ def main() -> int:
         conc = max(conc_rounds, key=lambda r: r["qps"])
         conc_p50, conc_qps = conc["p50_ms"], conc["qps"]
         n_clients = conc["clients"]
+        serial_pcts = lat_pcts(lat)
         engine = {"qps": round(engine_qps, 2),
                   "serial_qps": round(serial_qps, 2),
                   "serial_p50_ms": round(serial_p50, 2),
+                  "serial_p99_ms": serial_pcts["p99_ms"],
+                  "serial_p999_ms": serial_pcts["p999_ms"],
                   "rtt_floor_ms": round(rtt_ms, 2),
                   "oracle_recall_at_k": (round(oracle_recall, 5)
                                          if oracle_recall is not None
@@ -1484,8 +1500,11 @@ def main() -> int:
             if not record_rounds:
                 return None
             rs = sorted(rounds)
+            tail = lat_pcts(rounds)
             return {"refresh_to_first_search_ms_p50":
                     round(rs[len(rs) // 2], 2),
+                    "refresh_to_first_search_ms_p99": tail["p99_ms"],
+                    "refresh_to_first_search_ms_p999": tail["p999_ms"],
                     "refresh_to_first_search_ms_mean":
                     round(sum(rounds) / len(rounds), 2),
                     "bytes_uploaded_per_refresh":
@@ -1818,6 +1837,117 @@ def main() -> int:
         finally:
             fr_node.close()
 
+    # ---- tail_tolerance leg: hedged scatter-gather under a brownout -------
+    # One replica copy browns out (sustained service delay, no drops).
+    # tail_off (ARS + hedging disabled — the pre-PR next-copy-on-error
+    # model) pays the brownout delay on every search that touches the
+    # slow copy: p99 degrades to the delay. tail_on (defaults) hedges
+    # the first slow request at the shard group's latency-histogram
+    # quantile and then ARS re-ranks the browned copy last, so p99
+    # stays near healthy. Stamps p50/p99/p999 per phase plus the
+    # hedges_* counters, reconciled.
+    tt_record = None
+    if os.environ.get("BENCH_TAIL", "1") == "1":
+        from elasticsearch_tpu.testing import InternalTestCluster
+        from elasticsearch_tpu.testing_disruption import BrownoutScheme
+
+        tt_docs = int(os.environ.get("BENCH_TT_DOCS", 600))
+        tt_queries = int(os.environ.get("BENCH_TT_QUERIES", 150))
+        tt_delay_ms = float(os.environ.get("BENCH_TT_DELAY_MS", 150.0))
+        tt_body = {"query": {"match": {"body": "shared"}}, "size": 5}
+
+        def tt_lat(coord, n) -> "np.ndarray":
+            lat = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                out = coord.search("tail_bench", dict(tt_body))
+                assert out["_shards"]["failed"] == 0, out["_shards"]
+                lat.append((time.perf_counter() - t0) * 1e3)
+            return np.array(lat)
+
+        def tt_phase(tail_on: bool) -> dict:
+            settings = {} if tail_on else {
+                "search.ars.enabled": "false",
+                "search.hedge.enabled": "false"}
+            c = InternalTestCluster(num_nodes=2, settings=settings)
+            try:
+                a = c.nodes[0]
+                a.indices_service.create_index("tail_bench", {"settings": {
+                    "number_of_shards": 2, "number_of_replicas": 1,
+                    # the leg measures the RPC scatter-gather — the
+                    # copy-selection path — not the all-local plane
+                    "index.search.collective_plane": "false"}})
+                a.wait_for_health("green", timeout=30)
+                for i in range(tt_docs):
+                    a.index_doc("tail_bench", str(i),
+                                {"n": i, "body": f"tok{i % 7} shared"})
+                a.broadcast_actions.refresh("tail_bench")
+                # coordinator == browned node: its LOCAL copies are the
+                # baseline try-order, so the tail layer must actively
+                # dodge them (tail_off pays the delay every time)
+                coord = c.nodes[0]
+                healthy = tt_lat(coord, tt_queries)
+                if tail_on:
+                    # deterministic hedge demonstration: between two
+                    # HEALTHY copies the post-warm-up order is a coin
+                    # flip, so re-seed the ARS table with the browned
+                    # local copy ranked first — the first browned
+                    # search then MUST hedge, and ARS re-ranks from
+                    # the hedge's latency-floor observation
+                    from elasticsearch_tpu.action.replica_stats import \
+                        ReplicaStatsTable
+                    rs = ReplicaStatsTable()
+                    coord.search_actions.replica_stats = rs
+                    rs.observe(coord.node_id, 3.0, service_ms=2.0,
+                               queue=0)
+                    rs.observe(c.nodes[1].node_id, 4.0, service_ms=3.0,
+                               queue=0)
+                    for sid in range(2):
+                        for _ in range(10):
+                            rs.observe_group(("tail_bench", sid), 4.0)
+                scheme = BrownoutScheme([coord],
+                                        delay_s=tt_delay_ms / 1e3)
+                scheme.start_disrupting()
+                try:
+                    browned = tt_lat(
+                        coord, tt_queries if tail_on
+                        else max(tt_queries // 4, 20))
+                finally:
+                    scheme.stop_disrupting()
+                hs = coord.search_actions.replica_stats.hedge_stats()
+                return {"healthy": lat_pcts(healthy),
+                        "browned": lat_pcts(browned), "hedging": hs}
+            finally:
+                c.close(check_leaks=False)
+
+        off = tt_phase(False)
+        on = tt_phase(True)
+        hs = on["hedging"]
+        tt_record = {
+            "n_docs": tt_docs, "queries": tt_queries,
+            "brownout_delay_ms": tt_delay_ms,
+            "tail_off": off, "tail_on": on,
+            # the acceptance pair: unhedged p99 degrades to the
+            # brownout delay; hedged p99 stays within 3x healthy
+            "unhedged_p99_degraded_to_delay":
+                off["browned"]["p99_ms"] >= 0.8 * tt_delay_ms,
+            "hedged_p99_within_3x_healthy":
+                on["browned"]["p99_ms"]
+                <= 3.0 * max(on["healthy"]["p99_ms"], 1.0),
+            "counters_reconciled":
+                hs["hedges_in_flight"] == 0
+                and hs["hedges_launched"]
+                == hs["hedges_won"] + hs["hedges_cancelled"],
+        }
+        log(f"[bench] tail_tolerance: healthy p99 "
+            f"{on['healthy']['p99_ms']} ms; browned p99 unhedged "
+            f"{off['browned']['p99_ms']} ms vs hedged "
+            f"{on['browned']['p99_ms']} ms "
+            f"(delay {tt_delay_ms} ms, hedges {hs}); "
+            f"within_3x={tt_record['hedged_p99_within_3x_healthy']}, "
+            f"degraded={tt_record['unhedged_p99_degraded_to_delay']}, "
+            f"reconciled={tt_record['counters_reconciled']}")
+
     oracle_recall = engine.get("oracle_recall_at_k")
     recall_ok = bool(kernel_ok and engine_ok and
                      (oracle_recall is None or oracle_recall >= 0.999))
@@ -1864,6 +1994,7 @@ def main() -> int:
         "refresh_interleave": ri_record,
         "fault_recovery": fr_record,
         "impact_pruning": imp_record,
+        "tail_tolerance": tt_record,
     }
 
     # ---- MS-MARCO-scale headline (BASELINE.json's stated metric) -------
@@ -1888,7 +2019,7 @@ def main() -> int:
                          BENCH_MESH="0", BENCH_STREAM="0",
                          BENCH_ORACLE="0", BENCH_HEADLINE_8M8="0",
                          BENCH_PERCOLATE="0", BENCH_IMPACT="0",
-                         BENCH_CPU_QUERIES="32")
+                         BENCH_TAIL="0", BENCH_CPU_QUERIES="32")
         log(f"[bench] headline corpus: {docs_8m8} docs msmarco "
             f"statistics (engine-only child run)")
         try:
@@ -1927,6 +2058,7 @@ def main() -> int:
                 "refresh_interleave": ri_record,
                 "fault_recovery": fr_record,
                 "impact_pruning": imp_record,
+                "tail_tolerance": tt_record,
                 "corpora": {
                     f"zipf_{n_docs // 1_000_000}m": {
                         k_: v_ for k_, v_ in record.items()

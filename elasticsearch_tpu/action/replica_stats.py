@@ -1,0 +1,186 @@
+"""Adaptive replica selection + hedged-request accounting.
+
+Reference: the reference engine's adaptive replica selection
+(``AdaptiveSelectionStats`` / ``ResponseCollectorService``), itself an
+implementation of the C3 replica-ranking function (Suresh et al.,
+NSDI'15): each coordinating node keeps, per target node, an EWMA of the
+response time it observed, an EWMA of the SERVICE time the data node
+reports for the work itself, the data node's search-pool queue depth
+(piggybacked on every shard payload the way the reference ships queue
+stats on the QuerySearchResult), and the number of requests currently
+outstanding. Copy try-order ranks ascending by
+
+    Ψ(s) = R̄(s) − µ̄(s) + q̂(s)³ · µ̄(s),   q̂ = 1 + outstanding + queue
+
+— C3's cubic queue penalty: R̄ − µ̄ isolates the network/transit share,
+and the q̂³·µ̄ term makes a loaded (or browned-out) copy's rank explode
+long before its EWMA alone would sink it. Unobserved nodes rank 0.0, so
+cold copies are explored first and acquire real ranks after one
+response.
+
+The table also owns the HEDGING side of tail tolerance ("The Tail at
+Scale", Dean & Barroso): per shard group, a fixed-bucket latency
+histogram of observed response times whose p-quantile (floor/ceiling
+bounded) is the adaptive hedge delay, and the
+``hedges_launched / hedges_won / hedges_cancelled`` counters the
+acceptance gate reconciles (``launched == won + cancelled + in_flight``
+at every instant).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from elasticsearch_tpu.observability.histograms import LatencyHistogram
+
+
+class ReplicaStatsTable:
+    """Per-coordinating-node replica health table (one per
+    SearchActions). All methods are thread-safe — the fan-out pool
+    feeds it concurrently."""
+
+    def __init__(self, alpha: float = 0.3):
+        #: EWMA smoothing factor (``search.ars.alpha``): weight of the
+        #: NEWEST observation; the reference uses the same one-knob EWMA
+        self.alpha = min(max(float(alpha), 0.0), 1.0)
+        self._lock = threading.Lock()
+        self._nodes: dict[str, dict] = {}
+        #: (index, shard) → latency histogram of observed response times
+        #: — the per-shard-group distribution the hedge delay quantile
+        #: reads (fixed √2-spaced buckets, O(1) record)
+        self._group_hist: dict[tuple, LatencyHistogram] = {}
+        self.hedges_launched = 0
+        self.hedges_won = 0
+        self.hedges_cancelled = 0
+
+    # ---- per-node health ---------------------------------------------------
+
+    def _node(self, node_id: str) -> dict:
+        st = self._nodes.get(node_id)
+        if st is None:
+            st = self._nodes[node_id] = {
+                "ewma_response_ms": None, "ewma_service_ms": None,
+                "queue": 0, "outstanding": 0, "observations": 0}
+        return st
+
+    def begin(self, node_id: str) -> None:
+        """A request to ``node_id`` is now in flight."""
+        with self._lock:
+            self._node(node_id)["outstanding"] += 1
+
+    def end(self, node_id: str) -> None:
+        with self._lock:
+            st = self._node(node_id)
+            st["outstanding"] = max(st["outstanding"] - 1, 0)
+
+    def observe(self, node_id: str, response_ms: float,
+                service_ms: float | None = None,
+                queue: int | None = None) -> None:
+        """Fold one observed response into the node's EWMAs.
+        ``service_ms``/``queue`` come from the payload's piggybacked
+        ``_ars`` block (absent on failures and latency-floor samples)."""
+        with self._lock:
+            st = self._node(node_id)
+            st["observations"] += 1
+            for key, val in (("ewma_response_ms", response_ms),
+                             ("ewma_service_ms", service_ms)):
+                if val is None:
+                    continue
+                cur = st[key]
+                st[key] = float(val) if cur is None else \
+                    (1.0 - self.alpha) * cur + self.alpha * float(val)
+            if queue is not None:
+                st["queue"] = int(queue)
+
+    def _rank_locked(self, node_id: str) -> float:
+        st = self._nodes.get(node_id)
+        if st is None or not st["observations"]:
+            return 0.0                    # unobserved: explore first
+        r = st["ewma_response_ms"] or 0.0
+        mu = st["ewma_service_ms"] if st["ewma_service_ms"] is not None \
+            else r
+        q_hat = 1.0 + st["outstanding"] + st["queue"]
+        return r - mu + (q_hat ** 3) * mu
+
+    def rank(self, node_id: str) -> float:
+        with self._lock:
+            return self._rank_locked(node_id)
+
+    def order(self, copies: list) -> list:
+        """Re-rank a copy try-order by ascending C3 score. The sort is
+        STABLE, so ties (and the all-unobserved cold start) keep the
+        caller's baseline order — local-first rotation under the default
+        preference."""
+        with self._lock:
+            return sorted(copies,
+                          key=lambda c: self._rank_locked(c.node_id))
+
+    # ---- hedge delay -------------------------------------------------------
+
+    def observe_group(self, group_key: tuple, response_ms: float) -> None:
+        with self._lock:
+            h = self._group_hist.get(group_key)
+            if h is None:
+                h = self._group_hist[group_key] = LatencyHistogram()
+        h.observe(response_ms)            # histogram has its own lock
+
+    def hedge_delay_ms(self, group_key: tuple, quantile: float,
+                       floor_ms: float, ceiling_ms: float) -> float:
+        """Adaptive hedge delay for one shard group: the observed
+        latency distribution's p-quantile, bounded below (don't hedge
+        into ordinary jitter) and above (a pathological history must
+        not disable hedging). No history yet → the ceiling, so a cold
+        coordinator never hedge-storms."""
+        with self._lock:
+            h = self._group_hist.get(group_key)
+        if h is None or h.count == 0:
+            return float(ceiling_ms)
+        return min(max(h.percentile(quantile), float(floor_ms)),
+                   float(ceiling_ms))
+
+    # ---- hedge counters ----------------------------------------------------
+
+    def note_hedge_launched(self) -> None:
+        with self._lock:
+            self.hedges_launched += 1
+
+    def note_hedge_won(self) -> None:
+        with self._lock:
+            self.hedges_won += 1
+
+    def note_hedge_cancelled(self) -> None:
+        with self._lock:
+            self.hedges_cancelled += 1
+
+    def hedge_stats(self) -> dict:
+        with self._lock:
+            return {
+                "hedges_launched": self.hedges_launched,
+                "hedges_won": self.hedges_won,
+                "hedges_cancelled": self.hedges_cancelled,
+                # reconciliation invariant: launched == won + cancelled
+                # + in_flight — every launched hedge terminally either
+                # wins or is cancelled
+                "hedges_in_flight": self.hedges_launched
+                - self.hedges_won - self.hedges_cancelled,
+            }
+
+    # ---- stats surface (_nodes/stats.adaptive_selection) -------------------
+
+    def stats_dict(self) -> dict:
+        with self._lock:
+            nodes = {}
+            for nid, st in sorted(self._nodes.items()):
+                nodes[nid] = {
+                    "rank": round(self._rank_locked(nid), 3),
+                    "ewma_response_ms":
+                        round(st["ewma_response_ms"], 3)
+                        if st["ewma_response_ms"] is not None else None,
+                    "ewma_service_ms":
+                        round(st["ewma_service_ms"], 3)
+                        if st["ewma_service_ms"] is not None else None,
+                    "queue": st["queue"],
+                    "outstanding": st["outstanding"],
+                    "observations": st["observations"],
+                }
+        return {"nodes": nodes, "hedging": self.hedge_stats()}

@@ -36,6 +36,7 @@ import numpy as np
 from elasticsearch_tpu.common.errors import (
     ElasticsearchTpuError, QueryParsingError, SearchContextMissingError,
     TaskCancelledError)
+from elasticsearch_tpu.action.replica_stats import ReplicaStatsTable
 from elasticsearch_tpu.common.settings import parse_time_value
 from elasticsearch_tpu.index.device_reader import device_reader_for
 from elasticsearch_tpu.observability import attribution
@@ -362,6 +363,18 @@ class SearchActions:
     # bytes query_and_fetch ships (see `search` docstring)
     QTF_WINDOW_THRESHOLD = 100
 
+    #: coordinator-side wrapper task one hedged copy attempt runs under:
+    #: cancelling THIS task (ban machinery) cancels exactly that
+    #: attempt's shard work, nothing else in the fan-out
+    HEDGE_ACTION = "indices:data/read/search[hedge]"
+
+    #: extra seconds the deadline-bounded collector waits past the
+    #: request deadline before abandoning a shard group: shards received
+    #: the REMAINING budget at dispatch, so in-budget partials need only
+    #: transit time to land — anything slower is the tail the partial
+    #: response exists to cut off
+    PARTIAL_GRACE_S = 0.1
+
     def __init__(self, node):
         self.node = node
         self._pool = ThreadPoolExecutor(max_workers=16,
@@ -423,6 +436,29 @@ class SearchActions:
                     "search.plane_breaker.backoff_seconds"),
                 max_backoff_s=node.settings.get(
                     "search.plane_breaker.max_backoff_seconds"))
+        # ---- tail-tolerance layer (ARS + hedging + partial results) ----
+        # adaptive replica selection: per-node EWMAs + C3 ranks feeding
+        # _copy_try_order; hedged requests: per-shard-group latency
+        # histograms + the hedge counters (replica_stats.py)
+        get = node.settings.get if hasattr(node, "settings") \
+            else (lambda *a: None)
+
+        def _flag(key: str, default: bool) -> bool:
+            val = get(key)
+            return default if val is None \
+                else str(val).lower() not in ("false", "0")
+        self.ars_enabled = _flag("search.ars.enabled", True)
+        self.replica_stats = ReplicaStatsTable(
+            alpha=float(get("search.ars.alpha") or 0.3))
+        self.hedge_enabled = _flag("search.hedge.enabled", True)
+        self.hedge_quantile = float(get("search.hedge.quantile") or 0.9)
+        self.hedge_floor_ms = float(get("search.hedge.floor_ms") or 50.0)
+        self.hedge_ceiling_ms = float(
+            get("search.hedge.ceiling_ms") or 1000.0)
+        # deadline-bounded partial results: request param
+        # allow_partial_search_results overrides this node default
+        self.default_allow_partial = _flag(
+            "search.default_allow_partial_results", True)
         # background pack-build (plane warm) failure tracking: per-index
         # consecutive failures drive the retry backoff and, past
         # PLANE_WARM_MAX_RETRIES, the plane-degraded marking
@@ -639,13 +675,31 @@ class SearchActions:
                            "spans": obs_trace.build_tree(spans)}
         return out
 
+    def _attach_ars(self, out: dict, t0: float) -> dict:
+        """Piggyback this data node's adaptive-selection signals on the
+        shard payload (the reference ships queue/service stats on the
+        QuerySearchResult the same way): search-pool queue depth — the
+        _cat/thread_pool accounting — plus the measured service time.
+        Shallow-copied so request-cache entries never carry a stale
+        snapshot."""
+        try:
+            queue = self.node.thread_pool.executor(
+                "search").stats()["queue"]
+        except Exception:        # noqa: BLE001 — pool closed/minimal node
+            queue = 0
+        out = dict(out)
+        out["_ars"] = {"queue": queue,
+                       "took_ms": (time.perf_counter() - t0) * 1e3}
+        return out
+
     def _execute_shard_query(self, name: str, shard: int, body: dict,
                              doc_slot: int | None, dfs: dict | None,
                              pin: dict, budget_ms=None) -> dict:
-        return self._shard_traced(
+        t0 = time.perf_counter()
+        return self._attach_ars(self._shard_traced(
             "shard-query", name, shard,
             lambda: self._execute_shard_query_inner(
-                name, shard, body, doc_slot, dfs, pin, budget_ms))
+                name, shard, body, doc_slot, dfs, pin, budget_ms)), t0)
 
     def _execute_shard_query_inner(self, name: str, shard: int,
                                    body: dict, doc_slot: int | None,
@@ -837,11 +891,12 @@ class SearchActions:
                        dfs: dict | None = None,
                        scroll_pin: dict | None = None,
                        budget_ms=None) -> dict:
-        return self._shard_traced(
+        t0 = time.perf_counter()
+        return self._attach_ars(self._shard_traced(
             "shard", name, shard,
             lambda: self._execute_shard_inner(
                 name, shard, body, doc_slot=doc_slot, dfs=dfs,
-                scroll_pin=scroll_pin, budget_ms=budget_ms))
+                scroll_pin=scroll_pin, budget_ms=budget_ms)), t0)
 
     def _execute_shard_inner(self, name: str, shard: int, body: dict,
                              doc_slot: int | None = None,
@@ -964,8 +1019,22 @@ class SearchActions:
                 # reference raises rather than silently shrinking the
                 # result set)
                 groups.append((name, sid,
-                               self._order_copies(copies, pref, rot)))
+                               self._copy_try_order(copies, pref, rot)))
         return groups
+
+    def _copy_try_order(self, copies: list, pref: str | None, rot: int):
+        """Adaptive replica selection: the static preference grammar
+        still wins when the caller pinned placement (an explicit
+        preference IS an ordering instruction), but the default
+        try-order is re-ranked by each copy's observed health — C3
+        score ascending over the ReplicaStatsTable's per-node EWMAs,
+        queue depth and outstanding count — instead of blind rotation.
+        The rank sort is stable, so unobserved/healthy-equal copies
+        keep the local-first rotated baseline."""
+        ordered = self._order_copies(copies, pref, rot)
+        if pref is not None or not self.ars_enabled or len(ordered) < 2:
+            return ordered
+        return self.replica_stats.order(ordered)
 
     def _order_copies(self, copies: list, pref: str | None, rot: int):
         """Copy try-order under a preference (OperationRouting's
@@ -1006,63 +1075,131 @@ class SearchActions:
                    dfs: dict | None = None,
                    scroll_pin: dict | None = None,
                    qtf_pin: dict | None = None,
-                   budget_deadline: float | None = None):
+                   budget_deadline: float | None = None,
+                   allow_hedge: bool = True):
         """→ ("ok", payload, node_id) or ("fail", reason-dict, None).
         Walks the copy list (shard-failover retry,
         TransportSearchTypeAction.java:205-247). With `qtf_pin`, runs the
         query-ONLY phase (descriptors, reader pinned) instead of
         query+fetch; the returned node_id tells the coordinator where the
         pin — and thus the fetch round — lives. ``budget_deadline`` is
-        the request's absolute perf_counter deadline: the shard receives
-        only the REMAINING milliseconds, so its ``timed_out`` reflects
-        total elapsed time."""
+        the request's absolute perf_counter deadline: EACH attempt
+        receives only the milliseconds still remaining when IT launches
+        (a retried copy must not restart the budget), so per-shard
+        ``timed_out`` reflects total elapsed time.
+
+        Single-round requests with ≥2 copies ride the HEDGED path
+        (tail tolerance): pinned contexts stay sequential — a hedge
+        would pin readers on the losing node the fetch round never
+        frees."""
+        if (self.hedge_enabled and allow_hedge and len(copies) > 1
+                and scroll_pin is None and qtf_pin is None):
+            return self._try_shard_hedged(state, name, sid, copies, body,
+                                          doc_slot, dfs, budget_deadline)
+        return self._try_shard_seq(state, name, sid, copies, body,
+                                   doc_slot, dfs, scroll_pin, qtf_pin,
+                                   budget_deadline)
+
+    def _remaining_budget_ms(self, budget_deadline: float | None):
+        """Milliseconds left on the request's absolute deadline at THIS
+        instant — what a (re)launched copy attempt is allowed to spend
+        (the 'shards get the REMAINING budget' rule, applied per
+        attempt)."""
+        if budget_deadline is None:
+            return None
+        return max((budget_deadline - time.perf_counter()) * 1000.0, 1.0)
+
+    def _launch_copy(self, state, c, name: str, sid: int, body: dict,
+                     doc_slot, dfs, scroll_pin, qtf_pin, budget_ms):
+        """Launch ONE copy attempt asynchronously → Future resolving to
+        the shard payload, or None when the copy's node left the
+        cluster state. Local copies still execute ON the bounded search
+        pool (the reference dispatches local shard ops to the SEARCH
+        threadpool too) so saturation rejects instead of queueing
+        unboundedly; a rejection fails over like any shard failure."""
+        if c.node_id == self.node.node_id:
+            if qtf_pin is not None:
+                return self.node.thread_pool.submit(
+                    "search", self._execute_shard_query, name, sid,
+                    body, doc_slot, dfs, qtf_pin, budget_ms)
+            return self.node.thread_pool.submit(
+                "search", self._execute_shard, name, sid, body,
+                doc_slot=doc_slot, dfs=dfs, scroll_pin=scroll_pin,
+                budget_ms=budget_ms)
+        target = state.node(c.node_id)
+        if target is None:
+            return None
+        if qtf_pin is not None:
+            action = self.QUERY_ID
+            request = {"index": name, "shard": sid, "body": body,
+                       "doc_slot": doc_slot, "dfs": dfs,
+                       "pin": qtf_pin, "budget_ms": budget_ms}
+        else:
+            action = self.QUERY_FETCH
+            request = {"index": name, "shard": sid, "body": body,
+                       "doc_slot": doc_slot, "dfs": dfs,
+                       "scroll_pin": scroll_pin, "budget_ms": budget_ms}
+        return self.node.transport_service.send_request(
+            target, action, request, timeout=30.0)
+
+    def _note_copy_response(self, c, name: str, sid: int, t_att: float,
+                            payload: dict) -> dict:
+        """Feed one consumed copy response into the adaptive-selection
+        table: observed response time, plus the piggybacked ``_ars``
+        service-time/queue-depth block (popped — it must not leak into
+        the merged response), and the shard group's latency histogram
+        the hedge delay reads."""
+        resp_ms = (time.perf_counter() - t_att) * 1e3
+        ars = payload.pop("_ars", None) if isinstance(payload, dict) \
+            else None
+        self.replica_stats.observe(
+            c.node_id, resp_ms,
+            service_ms=(ars or {}).get("took_ms"),
+            queue=(ars or {}).get("queue"))
+        self.replica_stats.observe_group((name, sid), resp_ms)
+        return payload
+
+    @staticmethod
+    def _shard_failure(name: str, sid: int, last: Exception | None) -> dict:
+        fail = {"shard": sid, "index": name,
+                "reason": {"type": "shard_search_failure",
+                           "reason": str(last) if last
+                           else "no active copy"}}
+        if isinstance(last, ElasticsearchTpuError):
+            fail["reason"] = last.to_xcontent()
+            fail["status"] = last.status
+        return fail
+
+    def _try_shard_seq(self, state, name: str, sid: int, copies: list,
+                       body: dict, doc_slot=None, dfs=None,
+                       scroll_pin=None, qtf_pin=None,
+                       budget_deadline: float | None = None,
+                       last: Exception | None = None):
+        """Sequential next-copy failover (the pre-hedging model, and the
+        hedged path's tail for copies beyond the first two)."""
         from elasticsearch_tpu.action.replication import unwrap_remote
         from elasticsearch_tpu.common.errors import (
             IllegalArgumentError, MapperParsingError, QueryParsingError)
-        budget_ms = None
-        if budget_deadline is not None:
-            budget_ms = max(
-                (budget_deadline - time.perf_counter()) * 1000.0, 1.0)
-        last: Exception | None = None
+        rs = self.replica_stats
         for c in copies:
+            # per-copy retry budget: remaining time at THIS attempt's
+            # launch, never the original full budget
+            budget_ms = self._remaining_budget_ms(budget_deadline)
+            rs.begin(c.node_id)
+            t_att = time.perf_counter()
             try:
-                if c.node_id == self.node.node_id:
-                    # local copies still execute ON the bounded search pool
-                    # (the reference dispatches local shard ops to the
-                    # SEARCH threadpool too) so saturation rejects instead
-                    # of queueing unboundedly; a rejection fails over to
-                    # the next copy like any shard failure
-                    if qtf_pin is not None:
-                        fut = self.node.thread_pool.submit(
-                            "search", self._execute_shard_query, name, sid,
-                            body, doc_slot, dfs, qtf_pin, budget_ms)
-                    else:
-                        fut = self.node.thread_pool.submit(
-                            "search", self._execute_shard, name, sid, body,
-                            doc_slot=doc_slot, dfs=dfs,
-                            scroll_pin=scroll_pin, budget_ms=budget_ms)
-                    try:
-                        return "ok", fut.result(35.0), c.node_id
-                    except Exception:
-                        fut.cancel()     # don't leave abandoned work queued
-                        raise
-                target = state.node(c.node_id)
-                if target is None:
+                fut = self._launch_copy(state, c, name, sid, body,
+                                        doc_slot, dfs, scroll_pin,
+                                        qtf_pin, budget_ms)
+                if fut is None:
                     continue
-                if qtf_pin is not None:
-                    action = self.QUERY_ID
-                    request = {"index": name, "shard": sid, "body": body,
-                               "doc_slot": doc_slot, "dfs": dfs,
-                               "pin": qtf_pin, "budget_ms": budget_ms}
-                else:
-                    action = self.QUERY_FETCH
-                    request = {"index": name, "shard": sid, "body": body,
-                               "doc_slot": doc_slot, "dfs": dfs,
-                               "scroll_pin": scroll_pin,
-                               "budget_ms": budget_ms}
-                return "ok", self.node.transport_service.send_request(
-                    target, action, request,
-                    timeout=30.0).result(35.0), c.node_id
+                try:
+                    payload = fut.result(35.0)
+                except Exception:
+                    fut.cancel()     # don't leave abandoned work queued
+                    raise
+                return "ok", self._note_copy_response(
+                    c, name, sid, t_att, payload), c.node_id
             except Exception as e:               # noqa: BLE001 — classify
                 e = unwrap_remote(e)
                 if isinstance(e, TaskCancelledError):
@@ -1080,14 +1217,228 @@ class SearchActions:
                                   MapperParsingError)):
                     raise e from None
                 last = e
-        fail = {"shard": sid, "index": name,
-                "reason": {"type": "shard_search_failure",
-                           "reason": str(last) if last
-                           else "no active copy"}}
-        if isinstance(last, ElasticsearchTpuError):
-            fail["reason"] = last.to_xcontent()
-            fail["status"] = last.status
-        return "fail", fail, None
+            finally:
+                rs.end(c.node_id)
+        return "fail", self._shard_failure(name, sid, last), None
+
+    # ---- hedged shard requests (tail tolerance) ----------------------------
+
+    def _hedge_attempt(self, state, c, name: str, sid: int, body: dict,
+                       doc_slot, dfs, budget_deadline):
+        """Launch one hedged copy attempt under its OWN wrapper task —
+        a child of the coordinating task, so the remote shard task
+        parents on it and a ban on the wrapper id cancels exactly this
+        attempt's work (the PR 2 machinery, scoped to one copy).
+        → (future, wrapper-task-or-None); raises on synchronous launch
+        failure (pool rejection / serialization)."""
+        budget_ms = self._remaining_budget_ms(budget_deadline)
+        tm = self._task_manager()
+        task = None
+        if tm is not None:
+            task = tm.register(
+                self.HEDGE_ACTION,
+                description=f"[{name}][{sid}] copy[{c.node_id}]")
+        ctx = tasks.use_task(task) if task is not None \
+            else contextlib.nullcontext()
+        try:
+            with ctx:
+                fut = self._launch_copy(state, c, name, sid, body,
+                                        doc_slot, dfs, None, None,
+                                        budget_ms)
+        except BaseException:
+            if tm is not None:
+                tm.unregister(task)
+            raise
+        if fut is None:
+            if tm is not None:
+                tm.unregister(task)
+            raise ElasticsearchTpuError(
+                f"node [{c.node_id}] left the cluster")
+        return fut, task
+
+    def _cancel_hedge_loser(self, c, fut, task,
+                            reason: str = "hedged request lost") -> None:
+        """First response won: cancel the losing attempt through the
+        task-ban machinery — the wrapper task (and, via the broadcast
+        ban on its id, the remote shard task parented on it) cancels,
+        the losing shard work aborts at its next cooperative checkpoint
+        releasing every breaker byte and closing every span, and the
+        ban lifts when the wrapper unregisters (done-callback: transport
+        futures always complete — response, timeout or disconnect)."""
+        tm = self._task_manager()
+        if tm is not None and task is not None:
+            tm.cancel(task, reason)
+            if tm.ban_broadcaster is not None:
+                # remote children (current and in-flight registrations)
+                # cancel via the cluster-wide ban on the wrapper id
+                task.ban_sent = True     # unregister lifts it
+                try:
+                    tm.ban_broadcaster(task.task_id, True, reason)
+                except Exception:        # noqa: BLE001 — best effort
+                    pass
+
+        def _settle(f):
+            self.replica_stats.end(c.node_id)
+            if tm is not None and task is not None:
+                tm.unregister(task)
+            if not f.cancelled():
+                f.exception()            # consume, never propagate
+        fut.add_done_callback(_settle)
+        fut.cancel()                     # unstarted local work: drop now
+
+    def _try_shard_hedged(self, state, name: str, sid: int, copies: list,
+                          body: dict, doc_slot, dfs,
+                          budget_deadline: float | None):
+        """Hedged single-round shard execution ("The Tail at Scale"):
+        launch the best-ranked copy; if no response lands within the
+        shard group's ADAPTIVE hedge delay (latency-histogram
+        p-quantile, floor/ceiling bounded), fire ONE backup at the
+        next-ranked copy. First response wins; the loser is cancelled
+        through the task-ban machinery and its counters reconcile as
+        ``hedges_launched == hedges_won + hedges_cancelled +
+        in_flight``. Copies beyond the first two remain sequential
+        failover via _try_shard_seq."""
+        from concurrent.futures import FIRST_COMPLETED
+        from concurrent.futures import wait as futures_wait
+        from elasticsearch_tpu.action.replication import unwrap_remote
+        from elasticsearch_tpu.common.errors import (
+            IllegalArgumentError, MapperParsingError, QueryParsingError)
+        rs = self.replica_stats
+        deterministic = (QueryParsingError, IllegalArgumentError,
+                         MapperParsingError)
+        primary, backup = copies[0], copies[1]
+        delay_s = rs.hedge_delay_ms(
+            (name, sid), self.hedge_quantile, self.hedge_floor_ms,
+            self.hedge_ceiling_ms) / 1000.0
+        rs.begin(primary.node_id)
+        t0 = time.perf_counter()
+        try:
+            fut0, task0 = self._hedge_attempt(
+                state, primary, name, sid, body, doc_slot, dfs,
+                budget_deadline)
+        except Exception as e:               # noqa: BLE001 — classify
+            rs.end(primary.node_id)
+            e = unwrap_remote(e)
+            if isinstance(e, deterministic):
+                raise e from None
+            return self._try_shard_seq(state, name, sid, copies[1:],
+                                       body, doc_slot, dfs, None, None,
+                                       budget_deadline, last=e)
+        pend: dict = {fut0: (primary, task0, t0)}
+        hedged_fut = None
+        last: Exception | None = None
+        tried = 1          # copies consumed by this hedged round
+        # phase 1: give the primary its hedge-delay head start
+        done, _ = futures_wait([fut0], timeout=delay_s)
+        if not done:
+            # the primary blew the hedge delay — that elapsed wait is a
+            # FLOOR on its true latency; recording it is how a browned-
+            # out (slow, not failed) copy sinks in the ARS ranks even
+            # though its response is never consumed
+            rs.observe(primary.node_id,
+                       (time.perf_counter() - t0) * 1e3)
+            rs.note_hedge_launched()
+            rs.begin(backup.node_id)
+            try:
+                fut1, task1 = self._hedge_attempt(
+                    state, backup, name, sid, body, doc_slot, dfs,
+                    budget_deadline)
+                hedged_fut = fut1
+                pend[fut1] = (backup, task1, time.perf_counter())
+                tried = 2
+            except Exception as e:           # noqa: BLE001 — still-born
+                rs.end(backup.node_id)
+                rs.note_hedge_cancelled()
+                tried = 2
+                e = unwrap_remote(e)
+                if isinstance(e, deterministic):
+                    self._cancel_hedge_loser(primary, fut0, task0,
+                                             "request aborted")
+                    raise e from None
+                last = e
+        # phase 2: first successful response wins. The wait is SLICED so
+        # a cancel of the coordinating request propagates promptly: the
+        # local ban recursion cancels the hedge WRAPPER tasks, but the
+        # remote shard tasks parent on the wrapper ids — broadcasting
+        # the wrapper bans (via _cancel_hedge_loser) is what reaches
+        # them, and only this loop knows the wrappers
+        cur = tasks.current_task()
+        hard_deadline = time.monotonic() + 35.0
+        while pend:
+            remaining = hard_deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            done, _ = futures_wait(list(pend),
+                                   timeout=min(0.1, remaining),
+                                   return_when=FIRST_COMPLETED)
+            if not done:
+                if cur is not None and cur.cancelled:
+                    for lf, (lc, ltask, _) in pend.items():
+                        if lf is hedged_fut:
+                            rs.note_hedge_cancelled()
+                        self._cancel_hedge_loser(lc, lf, ltask,
+                                                 "request cancelled")
+                    pend.clear()
+                    last = TaskCancelledError(
+                        f"task [{cur.task_id}] was cancelled "
+                        f"[{cur.cancel_reason or 'unknown'}]")
+                continue
+            for f in done:
+                c, task, t_att = pend.pop(f)
+                tm = self._task_manager()
+                try:
+                    payload = f.result(0)
+                except Exception as e:       # noqa: BLE001 — classify
+                    rs.end(c.node_id)
+                    if tm is not None and task is not None:
+                        tm.unregister(task)
+                    if f is hedged_fut:
+                        rs.note_hedge_cancelled()   # backup lost by dying
+                    e = unwrap_remote(e)
+                    if isinstance(e, TaskCancelledError):
+                        # the REQUEST was cancelled: stop, stay partial
+                        last = e
+                        for lf, (lc, ltask, _) in pend.items():
+                            self._cancel_hedge_loser(lc, lf, ltask,
+                                                     "request cancelled")
+                        pend.clear()
+                        break
+                    if isinstance(e, deterministic):
+                        for lf, (lc, ltask, _) in pend.items():
+                            self._cancel_hedge_loser(lc, lf, ltask,
+                                                     "request aborted")
+                        raise e from None
+                    last = e
+                    continue
+                # winner: cancel every still-pending loser
+                rs.end(c.node_id)
+                if tm is not None and task is not None:
+                    tm.unregister(task)
+                if f is hedged_fut:
+                    rs.note_hedge_won()
+                for lf, (lc, ltask, _) in pend.items():
+                    if lf is hedged_fut:
+                        rs.note_hedge_cancelled()
+                    self._cancel_hedge_loser(lc, lf, ltask)
+                return "ok", self._note_copy_response(
+                    c, name, sid, t_att, payload), c.node_id
+        if pend:
+            # hard deadline blown with attempts still in flight: abandon
+            # them (their transport timeouts settle the callbacks)
+            for lf, (lc, ltask, _) in pend.items():
+                if lf is hedged_fut:
+                    rs.note_hedge_cancelled()
+                self._cancel_hedge_loser(lc, lf, ltask,
+                                         "shard request timed out")
+            if last is None:
+                last = ElasticsearchTpuError(
+                    f"[{name}][{sid}] no copy responded in time")
+        if not isinstance(last, TaskCancelledError) and \
+                len(copies) > tried:
+            return self._try_shard_seq(state, name, sid, copies[tried:],
+                                       body, doc_slot, dfs, None, None,
+                                       budget_deadline, last=last)
+        return "fail", self._shard_failure(name, sid, last), None
 
     # accepted search types (ref: SearchType.fromString,
     # core/action/search/SearchType.java:29 — scan/count are deprecated
@@ -1181,6 +1532,10 @@ class SearchActions:
             search_type = "dfs_query_then_fetch"
         t0 = time.perf_counter()
         body = dict(body or {})
+        # deadline-bounded partial results: stripped BEFORE the fan-out
+        # (like "profile") so shards execute the byte-identical request;
+        # None defers to search.default_allow_partial_results
+        allow_partial = body.pop("allow_partial_search_results", None)
         if search_type == "count":
             # deprecated alias for size=0 (SearchType.COUNT): hit counting
             # + aggregations, no fetch phase
@@ -1218,7 +1573,8 @@ class SearchActions:
                                      dfs_cache=dfs_cache,
                                      scroll_pin=scroll_pin,
                                      routing=routing,
-                                     preference=preference)
+                                     preference=preference,
+                                     allow_partial=allow_partial)
             # cursor not advanced: the first scroll() call reads page one
             resp["_scroll_id"] = self._open_scroll(
                 index_expr, body, scroll, {"hits": {"hits": [{}]}},
@@ -1230,7 +1586,8 @@ class SearchActions:
                                  dfs_cache=dfs_cache,
                                  scroll_pin=scroll_pin,
                                  routing=routing,
-                                 preference=preference)
+                                 preference=preference,
+                                 allow_partial=allow_partial)
         if scroll is not None:
             resp["_scroll_id"] = self._open_scroll(index_expr, body, scroll,
                                                    resp,
@@ -1697,12 +2054,47 @@ class SearchActions:
             # fail over / report the shard failure itself
         return aggregate_dfs(results)
 
+    def _resolve_allow_partial(self, allow_partial) -> bool:
+        """Request-level ``allow_partial_search_results`` overrides the
+        node's ``search.default_allow_partial_results`` setting."""
+        if allow_partial is None:
+            return self.default_allow_partial
+        return str(allow_partial).lower() not in ("false", "0")
+
+    def _collect_shard_result(self, fut, name: str, sid: int,
+                              deadline_at: float | None,
+                              allow_partial: bool):
+        """Collect one shard group's fan-out future. When partial
+        results are allowed and the request deadline expires before the
+        group responds, ABANDON it — deadline-bounded partial results:
+        the group is accounted as a failed shard with a timed-out
+        reason, and the response ships whatever completed. The
+        abandoned shard work self-cancels: it carries the remaining
+        budget as its task deadline."""
+        from concurrent.futures import TimeoutError as FutTimeout
+        if allow_partial and deadline_at is not None:
+            wait = max(deadline_at - time.perf_counter(), 0.0) \
+                + self.PARTIAL_GRACE_S
+            try:
+                return fut.result(wait)
+            except FutTimeout:
+                return "deadline", {
+                    "shard": sid, "index": name,
+                    "reason": {
+                        "type": "timed_out_exception",
+                        "reason": "shard group did not respond within "
+                                  "the request timeout; partial results "
+                                  "returned"},
+                    "status": 504}, None
+        return fut.result()
+
     def _search_once(self, index_expr: str, body: dict, t0: float,
                      search_type: str | None = None,
                      dfs_cache: dict | None = None,
                      scroll_pin: dict | None = None,
                      routing: str | None = None,
-                     preference: str | None = None) -> dict:
+                     preference: str | None = None,
+                     allow_partial=None) -> dict:
         with obs_trace.span("parse"):
             names = self.node.indices_service.resolve_open(index_expr)
             body = rewrite_mlt_likes(self.node, body,
@@ -1768,18 +2160,26 @@ class SearchActions:
         # timeout (wired through the task's deadline on the shard side)
         deadline_at = None if req.timeout_ms is None \
             else t0 + req.timeout_ms / 1000.0
+        allow_partial = self._resolve_allow_partial(allow_partial)
+        # hedging needs the freedom to pick the copy — an explicit
+        # preference pinned placement, so it stays sequential
+        allow_hedge = preference is None
         if use_qtf:
             return self._query_then_fetch(state, groups, body, req, t0,
-                                          slot_of, dfs, deadline_at)
+                                          slot_of, dfs, deadline_at,
+                                          allow_partial=allow_partial,
+                                          allow_hedge=allow_hedge)
         q_t0 = time.perf_counter()
         payloads, failures = [], []
         with obs_trace.span("query", shards=len(groups)):
             futures = [self._submit(self._try_shard, state, n, s, copies,
                                     body, slot_of[(n, s)], dfs,
-                                    scroll_pin, None, deadline_at)
+                                    scroll_pin, None, deadline_at,
+                                    allow_hedge)
                        for n, s, copies in groups]
-            for fut in futures:
-                status, payload, _node = fut.result()
+            for (n, s, _c), fut in zip(groups, futures):
+                status, payload, _node = self._collect_shard_result(
+                    fut, n, s, deadline_at, allow_partial)
                 if status == "ok":
                     obs_trace.sink_shard_profile(
                         payload.pop("_profile", None))
@@ -1808,7 +2208,9 @@ class SearchActions:
 
     def _query_then_fetch(self, state, groups, body: dict, req, t0: float,
                           slot_of: dict, dfs: dict | None,
-                          budget_deadline: float | None = None) -> dict:
+                          budget_deadline: float | None = None,
+                          allow_partial: bool = False,
+                          allow_hedge: bool = True) -> dict:
         """Two-round distributed search: query (descriptors only) →
         coordinator merge → winner-only fetch → assemble."""
         import uuid as _uuid
@@ -1819,10 +2221,12 @@ class SearchActions:
         with obs_trace.span("query", shards=len(groups)):
             futures = [self._submit(self._try_shard, state, n, s, copies,
                                     body, slot_of[(n, s)], dfs,
-                                    None, pin, budget_deadline)
+                                    None, pin, budget_deadline,
+                                    allow_hedge)
                        for n, s, copies in groups]
             for (n, s, _), fut in zip(groups, futures):
-                status, payload, node_id = fut.result()
+                status, payload, node_id = self._collect_shard_result(
+                    fut, n, s, budget_deadline, allow_partial)
                 if status == "ok":
                     obs_trace.sink_shard_profile(
                         payload.pop("_profile", None))
@@ -1882,7 +2286,15 @@ class SearchActions:
                         if fut is None:
                             raise ElasticsearchTpuError(
                                 "fetch target node left the cluster")
-                        payload_f = fut.result(35.0)
+                        wait = 35.0
+                        if allow_partial and budget_deadline is not None:
+                            # deadline-bounded fetch too: a browned-out
+                            # pin holder must not stall the partial
+                            # response past the deadline
+                            wait = min(wait, max(
+                                budget_deadline - time.perf_counter(),
+                                0.0) + self.PARTIAL_GRACE_S)
+                        payload_f = fut.result(wait)
                         obs_trace.sink_shard_profile(
                             payload_f.pop("_profile", None))
                         hits = payload_f["hits"]

@@ -901,6 +901,12 @@ class Node:
             "breakers": self.breaker_service.stats(),
             "thread_pool": pools,
             "tasks": self.task_manager.stats(),
+            # adaptive replica selection: per-target-node C3 ranks/EWMAs
+            # this coordinator observed, plus the hedged-request counters
+            # (hedges_launched == hedges_won + hedges_cancelled +
+            # in_flight at every instant)
+            "adaptive_selection":
+                self.search_actions.replica_stats.stats_dict(),
             # per-lane latency distributions (fixed-bucket histograms,
             # always on) + this node's span-store accounting
             "latency": _hist.summaries(self.node_id),

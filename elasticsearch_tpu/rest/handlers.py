@@ -1497,6 +1497,11 @@ class Handlers:
         t0 = time.perf_counter()
         body = self._search_body(req)
         parse_us = int((time.perf_counter() - t0) * 1e6)
+        if req.param("allow_partial_search_results") is not None:
+            # deadline-bounded partial results (request param overrides
+            # the search.default_allow_partial_results node setting)
+            body["allow_partial_search_results"] = \
+                req.param_as_bool("allow_partial_search_results")
         resp = self.node.search(req.path_params["index"], body,
                                 scroll=req.param("scroll"),
                                 search_type=self._rest_search_type(req),
@@ -1518,7 +1523,11 @@ class Handlers:
                          "_shards": {"total": 0, "successful": 0, "failed": 0},
                          "hits": {"total": 0,
                                   "max_score": None, "hits": []}}
-        resp = self.node.search("_all", self._search_body(req),
+        body = self._search_body(req)
+        if req.param("allow_partial_search_results") is not None:
+            body["allow_partial_search_results"] = \
+                req.param_as_bool("allow_partial_search_results")
+        resp = self.node.search("_all", body,
                                 scroll=req.param("scroll"),
                                 search_type=self._rest_search_type(req),
                                 routing=req.param("routing"),
@@ -2173,8 +2182,11 @@ class Handlers:
             # (the reference tracks restore completion in the
             # RestoreInProgress custom)
             indices = set(out.get("snapshot", {}).get("indices", []))
-            deadline = time.time() + 30.0
-            while time.time() < deadline:
+            # monotonic, not wall-clock: a clock step must neither wedge
+            # nor truncate the wait loop (the rest of the tree's deadline
+            # discipline)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
                 state = self.node.cluster_service.state()
                 pending = [
                     s for n in indices

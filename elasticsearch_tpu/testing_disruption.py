@@ -386,6 +386,52 @@ class DiskFaultScheme:
             self.stop_disrupting()
 
 
+# ---- brownout scheme (tail-tolerance chaos) ---------------------------------
+
+class BrownoutScheme:
+    """Sustained per-node SERVICE delay — browned out, not failed.
+
+    Every shard search executing on an affected node is held
+    ``delay_s`` seconds at a cooperative cancellation checkpoint before
+    serving (the ``SearchActions.shard_query_delay`` seam). Distinct
+    from :class:`NetworkDelaysPartition` in kind, not just degree: the
+    delay sits INSIDE the serve path, on the search pool's threads, so
+    it occupies pool capacity, shows up as queue depth in
+    ``_cat/thread_pool`` / the piggybacked ARS signals, and is
+    cancellable mid-hold (a hedged request's losing copy aborts at the
+    checkpoint, releasing its breaker bytes) — a transit delay has none
+    of those properties. Nothing is ever dropped: every request on a
+    browned node eventually answers, correctly, just slowly. That is
+    exactly the failure mode the tail-tolerance layer (ARS ranks,
+    hedged requests, deadline-bounded partial results) exists for, and
+    what plain next-copy-on-error failover cannot see."""
+
+    def __init__(self, nodes: list, delay_s: float = 0.3,
+                 seed: int = 0):
+        self.nodes = list(nodes)
+        self.delay_s = float(delay_s)
+        self.seed = seed                   # replay-line provenance only
+        self._saved: list[tuple] = []
+
+    def start_disrupting(self) -> None:
+        for n in self.nodes:
+            self._saved.append((n, n.search_actions.shard_query_delay))
+            n.search_actions.shard_query_delay = self.delay_s
+
+    def stop_disrupting(self) -> None:
+        for n, prev in reversed(self._saved):
+            n.search_actions.shard_query_delay = prev
+        self._saved.clear()
+
+    @contextlib.contextmanager
+    def applied(self):
+        self.start_disrupting()
+        try:
+            yield self
+        finally:
+            self.stop_disrupting()
+
+
 # ---- device-fault scheme (accelerator chaos) --------------------------------
 
 #: the device touchpoints the DEFAULT chaos draw covers (jit_exec.
@@ -615,6 +661,9 @@ SCHEME_NAMES = (
     # every in-process node shares the one device)
     "device_flaky",
     "device_oom",
+    # sustained per-node service delay (browned out, not failed) — the
+    # tail-tolerance layer's target failure mode
+    "brownout",
 )
 
 
@@ -632,6 +681,14 @@ def build_scheme(name: str, nodes: list, rnd: random.Random):
         # HBM-OOM shape: cold-block eviction then degrade
         return DeviceFaultScheme(seed=seed, p=rnd.uniform(0.05, 0.2),
                                  oom_fraction=1.0)
+    if name == "brownout":
+        # brown out ONE node's serve path: delay without drop. The delay
+        # stays under the shard RPC timeout by orders of magnitude —
+        # everything completes, just slowly (searches route around it
+        # via ARS/hedging; writes are merely late)
+        victim = nodes[rnd.randrange(len(nodes))]
+        return BrownoutScheme([victim],
+                              delay_s=rnd.uniform(0.1, 0.3), seed=seed)
     if name == "none" or len(nodes) < 2:
         return None
     if name == "partition_minority":

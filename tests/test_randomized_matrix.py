@@ -55,6 +55,8 @@ SCENARIOS = [
     # v3 accelerator-fault combination scenarios
     "device_fault_during_refresh_storm",
     "device_fault_during_relocation",
+    # v4 tail-tolerance combination scenario
+    "brownout_during_search_storm",
 ]
 
 #: scenarios that stage their own disruption — layering a random scheme
@@ -64,6 +66,7 @@ SELF_DISRUPTING = {
     "recovery_during_relocation", "snapshot_during_churn",
     "master_failover_during_bulk", "disk_fault_failover",
     "device_fault_during_refresh_storm", "device_fault_during_relocation",
+    "brownout_during_search_storm",
 }
 
 #: schemes a write-exercising scenario can carry while still asserting
@@ -72,14 +75,18 @@ SELF_DISRUPTING = {
 #: the self-disrupting scenarios and tests/test_chaos_faults.py, where
 #: assertions use acked-sets instead of exact totals.
 #: device-fault schemes join the soft set: an accelerator fault degrades
-#: the serving path (plane → fan-out → eager), it never drops an ack
+#: the serving path (plane → fan-out → eager), it never drops an ack;
+#: brownout joins it too — a browned-out node answers everything,
+#: correctly, just slowly (delay without drop)
 SOFT_SCHEMES = ("none", "delays", "flaky_delay", "duplicate", "reorder",
-                "slow_state_one", "device_flaky", "device_oom")
+                "slow_state_one", "device_flaky", "device_oom",
+                "brownout")
 
 #: deterministic tier-1 smoke subset (the full matrix is `slow`)
 SMOKE = ["crud_search", "partition_minority", "recovery_during_relocation",
          "master_failover_during_bulk", "disk_fault_failover",
-         "device_fault_during_refresh_storm"]
+         "device_fault_during_refresh_storm",
+         "brownout_during_search_storm"]
 
 VARIANTS = int(os.environ.get("ESTPU_MATRIX_VARIANTS", "3"))
 
@@ -898,3 +905,106 @@ def _scenario_device_fault_during_relocation(c, rnd, spec):
         for n in c.nodes if n._started), timeout=15.0), \
         [(n.node_name, n.breaker_service.breaker("fielddata").used)
          for n in c.nodes if n._started]
+
+
+def _scenario_brownout_during_search_storm(c, rnd, spec):
+    """Combination: one node's SERVE path browns out (sustained service
+    delay, no drops — BrownoutScheme) while concurrent searches storm a
+    healthy coordinator. The tail-tolerance layer must: (1) keep every
+    storm search correct with ZERO shard failures — a slow copy is not
+    a failed copy; (2) reconcile its hedge counters
+    (launched == won + cancelled once in-flight drains); (3) honor an
+    allow_partial_search_results deadline pinned onto the browned node
+    with ``timed_out: true`` and exact ``_shards`` accounting; and
+    (4) leave zero open spans and zero request-breaker bytes once the
+    storm settles — cancelled hedges leak nothing."""
+    from elasticsearch_tpu.observability import tracing as obs_trace
+    from elasticsearch_tpu.testing_disruption import (BrownoutScheme,
+                                                      wait_until)
+    a = c.master()
+    shards = rnd.randint(2, 3)
+    a.indices_service.create_index("m_brown", {"settings": {
+        "number_of_shards": shards,
+        "number_of_replicas": 1,
+        # force the RPC scatter-gather: an all-local collective-plane
+        # dispatch would never touch the browned copy — the fan-out's
+        # copy selection/hedging is exactly what this scenario tests
+        "index.search.collective_plane": "false"}})
+    _green(a)
+    n_docs = rnd.randint(30, 60)
+    for i in range(n_docs):
+        a.index_doc("m_brown", str(i),
+                    {"n": i, "body": f"tok{i % 5} shared"})
+    a.broadcast_actions.refresh("m_brown")
+    body = {"query": {"match": {"body": "shared"}}, "size": 5}
+    # the victim must actually HOLD a copy (or the brownout is vacuous);
+    # the coordinator must be a different, healthy node
+    st = c.master().cluster_service.state()
+    holders = {s.node_id for sid in range(shards)
+               for s in st.routing_table.shard_copies("m_brown", sid)
+               if s.assigned}
+    holder_nodes = [n for n in c.nodes
+                    if n._started and n.node_id in holders]
+    victim = holder_nodes[rnd.randrange(len(holder_nodes))]
+    coordinator = next(n for n in c.nodes
+                       if n._started and n is not victim)
+    for _ in range(8):                   # healthy warm-up: ARS baselines
+        r = coordinator.search("m_brown", dict(body))   # + hedge-delay
+        assert r["hits"]["total"] == n_docs             # histograms
+        assert r["_shards"]["failed"] == 0, r["_shards"]
+    delay_s = rnd.uniform(0.3, 0.5)
+    errors: list = []
+    with BrownoutScheme([victim], delay_s=delay_s,
+                        seed=rnd.randrange(2 ** 31)).applied():
+        def storm_client(ci: int) -> None:
+            for _ in range(4):
+                try:
+                    r = coordinator.search("m_brown", dict(body))
+                    if r["hits"]["total"] != n_docs or \
+                            r["_shards"]["failed"]:
+                        errors.append(("shards", r["_shards"]))
+                except Exception as e:   # noqa: BLE001 — surfaced below
+                    errors.append(("raised", e))
+        threads = [threading.Thread(target=storm_client, args=(ci,),
+                                    daemon=True) for ci in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not any(t.is_alive() for t in threads), \
+            "storm wedged under brownout"
+        assert not errors, errors[:3]
+        # deadline-bounded partial results, pinned onto the browned
+        # node: a timeout far below its service delay must return an
+        # honest partial — timed_out, exact _shards — never block
+        part = coordinator.search(
+            "m_brown", {**body, "timeout": "50ms",
+                        "allow_partial_search_results": True},
+            preference=f"_prefer_node:{victim.node_id}")
+        assert part["timed_out"] is True, part.get("_shards")
+        sh = part["_shards"]
+        assert sh["successful"] + sh["failed"] == sh["total"] == shards, sh
+        assert sh["failed"] >= 1 and any(
+            f["reason"].get("type") == "timed_out_exception"
+            for f in sh.get("failures", [])), sh
+    # settle: hedge counters reconcile, nothing leaks
+    hs = coordinator.search_actions.replica_stats
+    assert wait_until(
+        lambda: hs.hedge_stats()["hedges_in_flight"] == 0,
+        timeout=10.0), hs.hedge_stats()
+    stats = hs.hedge_stats()
+    assert stats["hedges_launched"] == \
+        stats["hedges_won"] + stats["hedges_cancelled"], stats
+    assert wait_until(lambda: all(
+        n.breaker_service.breaker("request").used == 0
+        for n in c.nodes if n._started), timeout=15.0), \
+        [(n.node_name, n.breaker_service.breaker("request").used)
+         for n in c.nodes if n._started]
+    assert all(obs_trace.open_span_count(n.node_id) == 0
+               for n in c.nodes if n._started), \
+        [(n.node_name, obs_trace.store_stats(n.node_id))
+         for n in c.nodes if n._started]
+    # the browned copy healed: counts stay exact on the same fan-out
+    r = coordinator.search("m_brown", dict(body))
+    assert r["hits"]["total"] == n_docs
+    assert r["_shards"]["failed"] == 0, r["_shards"]

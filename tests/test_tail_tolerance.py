@@ -1,0 +1,371 @@
+"""Tail-tolerant scatter-gather: adaptive replica selection (C3-style
+ranks over per-copy EWMAs), hedged shard requests ("The Tail at Scale"
+— first response wins, the loser cancels through the task-ban
+machinery), and deadline-bounded partial results
+(``allow_partial_search_results``).
+
+The cluster tests drive the failure mode the layer exists for — a
+browned-out copy that is SLOW, not failed (BrownoutScheme: sustained
+service delay without drops) — and pin the distinctions the layer
+relies on: slow ≠ failed in ``_shards`` accounting, a cancelled hedge
+loser leaks zero breaker bytes and zero open spans, and the hedge
+counters reconcile at every instant."""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.action.replica_stats import ReplicaStatsTable
+from elasticsearch_tpu.observability import tracing as obs_trace
+from elasticsearch_tpu.testing import InternalTestCluster
+from elasticsearch_tpu.testing_disruption import (BrownoutScheme,
+                                                  NetworkDelaysPartition,
+                                                  wait_until)
+
+
+# ---------------------------------------------------------------------------
+# ReplicaStatsTable units (no cluster)
+# ---------------------------------------------------------------------------
+
+class _Copy:
+    def __init__(self, node_id):
+        self.node_id = node_id
+
+
+def test_ars_ewma_and_rank_sink_slow_node():
+    t = ReplicaStatsTable(alpha=0.5)
+    for _ in range(4):
+        t.observe("fast", 5.0, service_ms=4.0, queue=0)
+        t.observe("slow", 500.0, service_ms=480.0, queue=3)
+    assert t.rank("slow") > t.rank("fast") > 0.0
+    # EWMA, not last-sample: one good response does not absolve a
+    # browned node
+    t.observe("slow", 5.0, service_ms=4.0, queue=0)
+    assert t.rank("slow") > t.rank("fast")
+
+
+def test_ars_order_stable_when_cold():
+    t = ReplicaStatsTable()
+    copies = [_Copy("a"), _Copy("b"), _Copy("c")]
+    # no observations: the caller's (local-first rotated) order survives
+    assert [c.node_id for c in t.order(copies)] == ["a", "b", "c"]
+    for _ in range(3):
+        t.observe("a", 800.0)
+        t.observe("c", 3.0)
+    # unobserved copies rank 0.0 — explored ahead of known-good ones;
+    # the slow copy sinks to last
+    assert [c.node_id for c in t.order(copies)] == ["b", "c", "a"]
+
+
+def test_ars_outstanding_cubic_penalty():
+    t = ReplicaStatsTable()
+    t.observe("a", 10.0, service_ms=10.0, queue=0)
+    base = t.rank("a")
+    for _ in range(4):
+        t.begin("a")
+    assert t.rank("a") > base * 10    # q̂³ blows up under load
+    for _ in range(4):
+        t.end("a")
+    assert t.rank("a") == pytest.approx(base)
+
+
+def test_hedge_delay_bounds():
+    t = ReplicaStatsTable()
+    key = ("i", 0)
+    # no history: the ceiling — a cold coordinator never hedge-storms
+    assert t.hedge_delay_ms(key, 0.9, 50.0, 1000.0) == 1000.0
+    for _ in range(20):
+        t.observe_group(key, 4.0)
+    # observed p90 ~4 ms clamps up to the floor
+    assert t.hedge_delay_ms(key, 0.9, 50.0, 1000.0) == 50.0
+    for _ in range(50):
+        t.observe_group(key, 5000.0)
+    # pathological history clamps down to the ceiling
+    assert t.hedge_delay_ms(key, 0.9, 50.0, 1000.0) == 1000.0
+
+
+def test_hedge_counters_reconcile_by_construction():
+    t = ReplicaStatsTable()
+    t.note_hedge_launched()
+    t.note_hedge_launched()
+    assert t.hedge_stats()["hedges_in_flight"] == 2
+    t.note_hedge_won()
+    t.note_hedge_cancelled()
+    s = t.hedge_stats()
+    assert s["hedges_launched"] == \
+        s["hedges_won"] + s["hedges_cancelled"] + s["hedges_in_flight"]
+    assert s["hedges_in_flight"] == 0
+
+
+# ---------------------------------------------------------------------------
+# cluster tests — brownout, hedging, partial results
+# ---------------------------------------------------------------------------
+
+BODY = {"query": {"match": {"body": "shared"}}, "size": 5}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = InternalTestCluster(
+        num_nodes=2,
+        settings={"search.hedge.floor_ms": 100.0})
+    try:
+        a = c.nodes[0]
+        a.indices_service.create_index("tail", {"settings": {
+            "number_of_shards": 1, "number_of_replicas": 1,
+            # force the RPC scatter-gather — the copy-selection/hedging
+            # path — rather than an all-local one-program dispatch
+            "index.search.collective_plane": "false"}})
+        h = a.wait_for_health("green", timeout=30)
+        assert h["status"] == "green", h
+        for i in range(30):
+            a.index_doc("tail", str(i), {"n": i, "body": "shared tok"})
+        a.broadcast_actions.refresh("tail")
+        yield c
+    finally:
+        c.close(check_leaks=False)
+
+
+def _warm(node, n=8):
+    for _ in range(n):
+        r = node.search("tail", dict(BODY))
+        assert r["hits"]["total"] == 30
+        assert r["_shards"]["failed"] == 0, r["_shards"]
+
+
+def _fresh_ars(coord, other_id):
+    """Deterministic ARS baseline: a FRESH ReplicaStatsTable seeded so
+    the coordinator's local copy ranks first (both healthy-typical) and
+    the shard group's hedge delay clamps to the floor — removing
+    cross-test EWMA state and cold-start ordering ambiguity from the
+    hedge-mechanics assertions."""
+    rs = ReplicaStatsTable()
+    coord.search_actions.replica_stats = rs
+    rs.observe(coord.node_id, 3.0, service_ms=2.0, queue=0)
+    rs.observe(other_id, 4.0, service_ms=3.0, queue=0)
+    for _ in range(10):
+        rs.observe_group(("tail", 0), 4.0)
+    return rs
+
+
+def test_hedged_request_beats_brownout_and_leaks_nothing(cluster):
+    """Tier-1 guard: a browned-out primary copy is dodged by the hedge
+    (first response wins), the cancelled loser releases every breaker
+    byte and closes every span (tracer ON via profile), and the hedge
+    counters reconcile."""
+    c = cluster
+    coord = c.nodes[0]          # also the browned node: its LOCAL copy
+    _warm(coord)                # is ranked first, so hedging must save
+    rs = _fresh_ars(coord, c.nodes[1].node_id)   # the search, not luck
+    with BrownoutScheme([coord], delay_s=1.0).applied():
+        t0 = time.perf_counter()
+        r = coord.search("tail", {**BODY, "profile": True})
+        took_s = time.perf_counter() - t0
+    assert r["hits"]["total"] == 30
+    assert r["_shards"]["failed"] == 0, r["_shards"]
+    assert "profile" in r
+    after = rs.hedge_stats()
+    assert after["hedges_launched"] == 1, after
+    assert after["hedges_won"] == 1, after
+    # the hedge fired at ~floor_ms and the healthy copy answered — the
+    # response must not have waited out the full 1 s brownout
+    assert took_s < 0.9, took_s
+    # reconciliation + leak guards: the cancelled loser aborts at its
+    # next checkpoint, releasing breaker bytes; spans all close
+    assert wait_until(
+        lambda: rs.hedge_stats()["hedges_in_flight"] == 0, timeout=10.0), \
+        rs.hedge_stats()
+    s = rs.hedge_stats()
+    assert s["hedges_launched"] == s["hedges_won"] + s["hedges_cancelled"]
+    assert wait_until(lambda: all(
+        n.breaker_service.breaker("request").used == 0
+        for n in c.nodes), timeout=10.0), \
+        [(n.node_name, n.breaker_service.breaker("request").used)
+         for n in c.nodes]
+    assert wait_until(lambda: all(
+        obs_trace.open_span_count(n.node_id) == 0
+        for n in c.nodes), timeout=10.0), \
+        [(n.node_name, obs_trace.store_stats(n.node_id))
+         for n in c.nodes]
+
+
+def test_ars_reranks_browned_copy_last(cluster):
+    """After observing a brownout, the C3 rank re-orders the try-order
+    so the browned copy is tried LAST — later searches pay healthy
+    latency with no hedge at all."""
+    c = cluster
+    coord = c.nodes[0]
+    other = c.nodes[1]
+    _warm(coord)
+    rs = _fresh_ars(coord, other.node_id)    # local (browned) copy first
+    with BrownoutScheme([coord], delay_s=1.0).applied():
+        coord.search("tail", dict(BODY))     # teaches ARS the hard way:
+        # the hedge-delay wait the primary blew is recorded as a latency
+        # FLOOR sample, sinking the browned copy's rank
+        assert rs.rank(coord.node_id) > rs.rank(other.node_id)
+        state = coord.cluster_service.state()
+        copies = [s for s in state.routing_table.shard_copies("tail", 0)
+                  if s.active]
+        order = coord.search_actions._copy_try_order(copies, None, 0)
+        assert order[0].node_id == other.node_id, \
+            [(s.node_id, rs.rank(s.node_id)) for s in order]
+        # and the next search is fast without needing the hedge
+        launched0 = rs.hedge_stats()["hedges_launched"]
+        t0 = time.perf_counter()
+        r = coord.search("tail", dict(BODY))
+        assert (time.perf_counter() - t0) < 0.5
+        assert r["_shards"]["failed"] == 0
+        assert rs.hedge_stats()["hedges_launched"] == launched0
+
+
+def test_delayed_but_alive_copy_is_not_a_shard_failure(cluster):
+    """Regression pin for the failed-vs-slow distinction the tentpole
+    relies on: a copy serving through a NetworkDelaysPartition transit
+    delay answers LATE but answers — it must land in
+    ``_shards.successful``, never in the failures list."""
+    c = cluster
+    holder = c.primary_node("tail", 0)
+    coord = next(n for n in c.nodes if n is not holder)
+    _warm(coord)
+    with NetworkDelaysPartition([coord], [holder], min_delay=0.1,
+                                max_delay=0.25, seed=7).applied():
+        # pin the try-order onto the DELAYED holder (both nodes hold a
+        # copy; without the pin the coordinator would serve its own)
+        r = coord.search("tail", dict(BODY),
+                         preference=f"_only_node:{holder.node_id}")
+    assert r["hits"]["total"] == 30
+    assert r["_shards"]["failed"] == 0, r["_shards"]
+    assert r["_shards"]["successful"] == r["_shards"]["total"]
+    assert "failures" not in r["_shards"]
+
+
+def test_allow_partial_deadline_returns_honest_partial(cluster):
+    """Deadline-bounded partial results: with the try-order pinned onto
+    a browned copy and a timeout far below its service delay,
+    ``allow_partial_search_results=true`` returns at the deadline with
+    ``timed_out: true`` and exact ``_shards`` accounting, while
+    ``false`` keeps today's block-until-done semantics."""
+    c = cluster
+    coord = c.nodes[1]
+    victim = c.nodes[0]
+    _warm(coord)
+    pref = f"_only_node:{victim.node_id}"
+    with BrownoutScheme([victim], delay_s=1.0).applied():
+        t0 = time.perf_counter()
+        part = coord.search(
+            "tail", {**BODY, "timeout": "80ms",
+                     "allow_partial_search_results": True},
+            preference=pref)
+        partial_took = time.perf_counter() - t0
+        assert part["timed_out"] is True
+        sh = part["_shards"]
+        assert sh["total"] == 1 and sh["successful"] == 0 \
+            and sh["failed"] == 1, sh
+        assert sh["failures"][0]["reason"]["type"] == \
+            "timed_out_exception", sh
+        assert partial_took < 0.8, partial_took      # did NOT wait out
+        # allow_partial=false: all-or-block — the same request WAITS for
+        # the slow copy's (budget-truncated, per-shard timed-out)
+        # answer instead of abandoning it: no shard failure recorded
+        t1 = time.perf_counter()
+        full = coord.search(
+            "tail", {**BODY, "timeout": "80ms",
+                     "allow_partial_search_results": False},
+            preference=pref)
+        assert (time.perf_counter() - t1) > 0.8      # blocked through
+        assert full["_shards"]["failed"] == 0        # the brownout
+        assert full["_shards"]["successful"] == 1
+        assert full["timed_out"] is True     # elapsed-time truth holds
+    assert wait_until(lambda: all(
+        n.breaker_service.breaker("request").used == 0
+        for n in c.nodes), timeout=10.0)
+
+
+def test_partial_results_default_and_no_timeout_unaffected(cluster):
+    """Without a timeout there is no deadline to bound — partial-result
+    collection never abandons anything, browned or not."""
+    c = cluster
+    coord = c.nodes[1]
+    with BrownoutScheme([c.nodes[0]], delay_s=0.3).applied():
+        r = coord.search("tail", dict(BODY),
+                         preference=f"_only_node:{c.nodes[0].node_id}")
+    assert r["hits"]["total"] == 30
+    assert r["_shards"]["failed"] == 0
+
+
+def test_adaptive_selection_in_nodes_stats(cluster):
+    """_nodes/stats surfaces the per-copy ARS ranks and the hedge
+    counters (the tentpole's observability contract)."""
+    c = cluster
+    coord = c.nodes[0]
+    _warm(coord)
+    stats = coord.local_node_stats()
+    ads = stats["adaptive_selection"]
+    assert "nodes" in ads and "hedging" in ads
+    assert ads["nodes"], ads
+    ranked = next(iter(ads["nodes"].values()))
+    for key in ("rank", "ewma_response_ms", "ewma_service_ms", "queue",
+                "outstanding", "observations"):
+        assert key in ranked, ranked
+    h = ads["hedging"]
+    assert h["hedges_launched"] == \
+        h["hedges_won"] + h["hedges_cancelled"] + h["hedges_in_flight"]
+
+
+def test_cancel_during_hedged_flight_reaps_everything(cluster):
+    """Cancelling the coordinating task while BOTH hedge attempts are
+    in flight (both copies browned) must reach the remote shard work
+    through the broadcast wrapper-task bans: every task reaps, breaker
+    bytes drain to zero, and the response reports ``cancelled``."""
+    import threading
+
+    c = cluster
+    coord = c.nodes[0]
+    _fresh_ars(coord, c.nodes[1].node_id)
+    done: dict = {}
+    with BrownoutScheme(list(c.nodes), delay_s=6.0).applied():
+        def fire():
+            try:
+                done["resp"] = coord.search("tail", dict(BODY))
+            except Exception as e:       # noqa: BLE001 — surfaced below
+                done["err"] = e
+        t = threading.Thread(target=fire, daemon=True)
+        t.start()
+
+        def search_task_id():
+            for tid, tsk in coord.task_manager.list_tasks().items():
+                if tsk["action"] == "indices:data/read/search":
+                    return tid
+            return None
+        assert wait_until(lambda: search_task_id() is not None,
+                          timeout=5.0)
+        # the hedged path engaged: wrapper tasks visible on the registry
+        assert wait_until(lambda: any(
+            tsk["action"] == "indices:data/read/search[hedge]"
+            for tsk in coord.task_manager.list_tasks().values()),
+            timeout=5.0), coord.task_manager.list_tasks()
+        coord.cancel_task(search_task_id(), "test cancel")
+        t.join(10)
+        assert not t.is_alive(), "search wedged after cancel"
+    assert "err" not in done, done
+    assert done["resp"].get("cancelled") is True, done["resp"]
+    # the 6 s holds were cut short: wrappers, shard tasks and breaker
+    # bytes all reap promptly on every node
+    assert wait_until(lambda: all(
+        n.task_manager.active_count() == 0 for n in c.nodes),
+        timeout=10.0), \
+        [(n.node_name, n.task_manager.list_tasks()) for n in c.nodes]
+    assert wait_until(lambda: all(
+        n.breaker_service.breaker("request").used == 0
+        for n in c.nodes), timeout=10.0)
+    assert wait_until(
+        lambda: coord.search_actions.replica_stats
+        .hedge_stats()["hedges_in_flight"] == 0, timeout=10.0)
+
+
+def test_brownout_scheme_restores_seam(cluster):
+    n = cluster.nodes[0]
+    assert n.search_actions.shard_query_delay is None
+    with BrownoutScheme([n], delay_s=0.2).applied():
+        assert n.search_actions.shard_query_delay == 0.2
+    assert n.search_actions.shard_query_delay is None
