@@ -2069,6 +2069,23 @@ def main() -> int:
                 },
             }
 
+    # analyzer cost is tracked like any other leg: stamp the wall time of
+    # a full-tree plane-lint v2 run (whole-program pass) so regressions
+    # in the lint gate's budget show up in artifacts, not just CI
+    if os.environ.get("BENCH_LINT", "1") == "1":
+        try:
+            from elasticsearch_tpu.analysis.lint import lint_paths
+            _lint_t0 = time.monotonic()
+            _lint = lint_paths([os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "elasticsearch_tpu")])
+            record["lint_wall_s"] = round(time.monotonic() - _lint_t0, 2)
+            record["lint_open_findings"] = len(_lint.unsuppressed)
+            log(f"[bench] plane-lint: {record['lint_wall_s']}s wall, "
+                f"{record['lint_open_findings']} open finding(s)")
+        except Exception as e:             # noqa: BLE001 — bench must record
+            log(f"[bench] plane-lint leg failed ({e}); skipping stamp")
+
     print(json.dumps(record))
     # the parity check gates the metric: a fast-but-wrong result must not
     # be recorded as a pass

@@ -1,20 +1,39 @@
-"""plane-lint: AST-level invariant analysis for the accelerator plane.
+"""plane-lint v2: whole-program invariant analysis for the accelerator
+plane.
 
-Six rule families over the ``elasticsearch_tpu`` tree — breaker
+Nine rule families over the ``elasticsearch_tpu`` tree — breaker
 discipline, device-seam coverage, recompile hazards, lock discipline,
-host-sync hazards, span discipline — each with inline suppressions
+host-sync hazards, span discipline, trace purity, counter discipline,
+fallback taxonomy — each with inline suppressions
 (``# estpu: allow[rule-id] <reason>``), machine-readable output, and a
 tier-1 tree-is-clean gate (tests/test_static_analysis.py).
+
+v2 upgraded the analyzer from per-file AST matching to a whole-program
+pass: every run builds a project-wide symbol table and call graph
+(:class:`~elasticsearch_tpu.analysis.lint.program.ProgramIndex`), so
+breaker release-reachability, lock-order edges and host-sync detection
+follow calls across module boundaries, and three interprocedural
+families ride the same index — trace-purity (nothing reachable from a
+``seam_jit``/``jax.jit``/``vmap``/``lax.scan`` region may import,
+write module state, or side-effect), counter-discipline (every bump
+registered in ``search/lanes.py``, every registered key bumped), and
+fallback-taxonomy (one closed decline-reason vocabulary per lane).
+The taxonomy pass doubles as an extractor: ``estpu-lint
+--emit-lane-graph`` writes ``analysis/lane_graph.json``
+(:mod:`~elasticsearch_tpu.analysis.lint.lane_graph`).
 
 Run it::
 
     python -m elasticsearch_tpu.analysis [paths] [--json]
     estpu-lint elasticsearch_tpu/
+    estpu-lint --diff origin/main          # findings in changed files only
+    estpu-lint --emit-lane-graph           # + write the lane model
 
 API::
 
     result = lint_paths(["elasticsearch_tpu"])
     result.unsuppressed        # findings the gate fails on
+    result.warnings            # stale-suppression audit (warning tier)
     result.to_json()           # stamped with per-family rule counts
 """
 
@@ -27,8 +46,9 @@ from dataclasses import dataclass, field
 from elasticsearch_tpu.analysis.lint.context import (
     DEFAULT_CONFIG, Finding, LintConfig, ModuleContext, RULE_FAMILIES)
 from elasticsearch_tpu.analysis.lint import (
-    rule_breaker, rule_device, rule_hostsync, rule_locks, rule_recompile,
-    rule_spans)
+    rule_breaker, rule_counters, rule_device, rule_fallback,
+    rule_hostsync, rule_locks, rule_recompile, rule_spans, rule_trace)
+from elasticsearch_tpu.analysis.lint.program import ProgramIndex
 
 __all__ = ["Finding", "LintConfig", "LintResult", "DEFAULT_CONFIG",
            "RULE_FAMILIES", "lint_paths", "iter_py_files"]
@@ -36,6 +56,8 @@ __all__ = ["Finding", "LintConfig", "LintResult", "DEFAULT_CONFIG",
 _PER_MODULE_RULES = (rule_breaker.check, rule_device.check,
                      rule_recompile.check, rule_hostsync.check,
                      rule_locks.check_state, rule_spans.check)
+_PROGRAM_RULES = (rule_trace.check_program, rule_counters.check_program,
+                  rule_fallback.check_program)
 
 
 @dataclass
@@ -43,35 +65,48 @@ class LintResult:
     findings: list = field(default_factory=list)
     files: int = 0
     errors: list = field(default_factory=list)   # unparseable files
+    #: the whole-program index the rules ran over (lane-graph emission
+    #: and the test suite reuse it)
+    program: "ProgramIndex | None" = None
 
     @property
     def unsuppressed(self) -> list:
-        return [f for f in self.findings if not f.suppressed]
+        return [f for f in self.findings
+                if not f.suppressed and not f.warning]
 
     @property
     def suppressed(self) -> list:
         return [f for f in self.findings if f.suppressed]
 
+    @property
+    def warnings(self) -> list:
+        return [f for f in self.findings
+                if f.warning and not f.suppressed]
+
     def counts(self) -> dict:
         by_rule: dict = {}
         by_family: dict = {}
         for f in self.findings:
-            key = "suppressed" if f.suppressed else "open"
-            by_rule.setdefault(f.rule, {"open": 0, "suppressed": 0})
+            key = "suppressed" if f.suppressed else \
+                ("warning" if f.warning else "open")
+            by_rule.setdefault(f.rule, {"open": 0, "suppressed": 0,
+                                        "warning": 0})
             by_rule[f.rule][key] += 1
-            by_family.setdefault(f.family, {"open": 0, "suppressed": 0})
+            by_family.setdefault(f.family, {"open": 0, "suppressed": 0,
+                                            "warning": 0})
             by_family[f.family][key] += 1
         return {"rules": by_rule, "families": by_family}
 
     def to_json(self) -> str:
         return json.dumps({
             "tool": "plane-lint",
-            "version": 1,
+            "version": 2,
             "files": self.files,
             "findings": [f.to_dict() for f in self.findings],
             "counts": self.counts(),
             "open": len(self.unsuppressed),
             "suppressed": len(self.suppressed),
+            "warnings": len(self.warnings),
             "parse_errors": self.errors,
         }, indent=2, sort_keys=True)
 
@@ -83,6 +118,7 @@ class LintResult:
                         for name, c in sorted(counts.items()))
         lines.append(
             f"plane-lint: {len(self.unsuppressed)} finding(s), "
+            f"{len(self.warnings)} warning(s), "
             f"{len(self.suppressed)} allowed, {self.files} file(s)"
             + (f" [{fam}]" if fam else ""))
         for path, err in self.errors:
@@ -109,53 +145,69 @@ def _relpath(path: str) -> str:
     return rel.replace(os.sep, "/")
 
 
-def lint_paths(paths, config: LintConfig = DEFAULT_CONFIG) -> LintResult:
-    result = LintResult()
-    contexts = []
+def parse_contexts(paths) -> "tuple[list, list]":
+    """([ModuleContext], [(relpath, error)]) over every .py under
+    `paths` — the parse front half of lint_paths, reusable by the
+    lane-graph emitter."""
+    contexts, errors = [], []
     for path in iter_py_files(paths):
         rel = _relpath(path)
         try:
             with open(path, encoding="utf-8") as fh:
                 src = fh.read()
-            ctx = ModuleContext(rel, src)
+            contexts.append(ModuleContext(rel, src))
         except (SyntaxError, UnicodeDecodeError, OSError) as exc:
-            result.errors.append((rel, str(exc)))
-            continue
-        contexts.append(ctx)
+            errors.append((rel, str(exc)))
+    return contexts, errors
+
+
+def lint_paths(paths, config: LintConfig = DEFAULT_CONFIG, *,
+               strict_suppressions: bool = False) -> LintResult:
+    result = LintResult()
+    contexts, result.errors = parse_contexts(paths)
     result.files = len(contexts)
+    program = ProgramIndex(contexts, config)
+    result.program = program
 
     lock_infos = []
     by_rel = {}
     for ctx in contexts:
         by_rel[ctx.relpath] = ctx
         for rule in _PER_MODULE_RULES:
-            result.findings.extend(rule(ctx, config))
+            result.findings.extend(rule(ctx, config, program))
         result.findings.extend(ctx.meta_findings())
         lock_infos.append(rule_locks.collect(ctx, config))
 
+    # whole-program rule families (trace purity / counters / taxonomy)
+    for rule in _PROGRAM_RULES:
+        result.findings.extend(rule(program, config))
+
     # cross-module lock-order pass (suppressible at the acquisition line)
-    for f in rule_locks.finalize(lock_infos, config):
+    for f in rule_locks.finalize(lock_infos, config, program):
         ctx = by_rel.get(f.path)
         if ctx is not None:
             for line in (f.line - 1, f.line):
                 for rid, reason in ctx.suppressions.get(line, ()):
                     if rid == f.rule and reason:
+                        ctx.used_suppressions.add((line, rid))
                         f.suppressed = True
                         f.suppress_reason = reason
         result.findings.append(f)
+
+    # stale-suppression audit: runs LAST, after every rule consumed its
+    # allows — a reasoned allow nothing matched is dead weight
+    for ctx in contexts:
+        result.findings.extend(ctx.stale_findings(strict_suppressions))
     return result
 
 
 def lock_graph_for(paths, config: LintConfig = DEFAULT_CONFIG):
     """(edges, ranks) of the static lock-acquisition graph — the runtime
-    watchdog (elasticsearch_tpu.analysis.watchdog) consumes this."""
-    infos = []
-    for path in iter_py_files(paths):
-        try:
-            with open(path, encoding="utf-8") as fh:
-                ctx = ModuleContext(_relpath(path), fh.read())
-        except (SyntaxError, UnicodeDecodeError, OSError):
-            continue
-        infos.append(rule_locks.collect(ctx, config))
-    edges = rule_locks.lock_graph(infos, config)
+    watchdog (elasticsearch_tpu.analysis.watchdog) consumes this. Rides
+    the same whole-program index as the lint rules, so the watchdog
+    asserts exactly the graph the static rule reports on."""
+    contexts, _ = parse_contexts(paths)
+    program = ProgramIndex(contexts, config)
+    infos = [rule_locks.collect(ctx, config) for ctx in contexts]
+    edges = rule_locks.lock_graph(infos, config, program)
     return edges, rule_locks.lock_ranks(edges)

@@ -1,25 +1,58 @@
-"""plane-lint command line: ``estpu-lint [paths] [--json] [--rule ID]``.
+"""plane-lint command line.
+
+``estpu-lint [paths] [--json] [--rule ID] [--diff REF]
+[--strict-suppressions] [--emit-lane-graph [PATH]]``
 
 Exit status 0 when every finding is suppressed (with a reason), 1 when
 open findings remain, 2 on usage/parse errors — so the tier-1 gate and
-any CI step can ride the exit code directly.
+any CI step (scripts/lint_gate.sh) can ride the exit code directly.
+
+``--diff REF`` is the incremental mode for local iteration: the
+whole-program symbol table and call graph are still built over every
+path (interprocedural findings need the full picture), but the REPORT
+is filtered to files changed vs the git ref — so the exit code answers
+"did MY change introduce a finding" without wading through the tree.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
 
 from elasticsearch_tpu.analysis.lint import (
     DEFAULT_CONFIG, RULE_FAMILIES, lint_paths)
 
+DEFAULT_LANE_GRAPH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "lane_graph.json")
+
+
+def _changed_files(ref: str) -> "set | None":
+    """Absolute paths of .py files changed vs `ref` (staged, unstaged
+    and committed-after-ref), or None when git is unavailable."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True).stdout.strip()
+        out = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--", "*.py"],
+            capture_output=True, text=True, check=True, cwd=top).stdout
+    except (OSError, subprocess.CalledProcessError) as exc:
+        print(f"estpu-lint: --diff {ref} failed: {exc}", file=sys.stderr)
+        return None
+    return {os.path.abspath(os.path.join(top, line.strip()))
+            for line in out.splitlines() if line.strip()}
+
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="estpu-lint",
-        description="plane-lint: AST invariant analysis for the "
-                    "accelerator plane (breaker / device-seam / "
-                    "recompile / lock / host-sync discipline)")
+        description="plane-lint v2: whole-program invariant analysis "
+                    "for the accelerator plane (breaker / device-seam / "
+                    "recompile / lock / host-sync / span / trace-purity "
+                    "/ counter / fallback-taxonomy discipline)")
     parser.add_argument("paths", nargs="*", default=["elasticsearch_tpu"],
                         help="files or directories (default: "
                              "elasticsearch_tpu)")
@@ -29,6 +62,17 @@ def main(argv=None) -> int:
     parser.add_argument("--rule", action="append", default=None,
                         metavar="ID",
                         help="only report these rule ids (repeatable)")
+    parser.add_argument("--diff", metavar="REF", default=None,
+                        help="report only findings in files changed vs "
+                             "this git ref (the whole-program pass "
+                             "still sees everything)")
+    parser.add_argument("--strict-suppressions", action="store_true",
+                        help="promote allow-stale warnings to "
+                             "gate-failing findings")
+    parser.add_argument("--emit-lane-graph", nargs="?", metavar="PATH",
+                        const=DEFAULT_LANE_GRAPH, default=None,
+                        help="write the machine-readable lane-admission "
+                             "graph (default: analysis/lane_graph.json)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print rule ids and families, then exit")
     args = parser.parse_args(argv)
@@ -38,7 +82,14 @@ def main(argv=None) -> int:
             print(f"{rid:28s} {family}")
         return 0
 
-    result = lint_paths(args.paths, DEFAULT_CONFIG)
+    result = lint_paths(args.paths, DEFAULT_CONFIG,
+                        strict_suppressions=args.strict_suppressions)
+    if args.diff is not None:
+        changed = _changed_files(args.diff)
+        if changed is None:
+            return 2
+        result.findings = [f for f in result.findings
+                           if os.path.abspath(f.path) in changed]
     if args.rule:
         unknown = set(args.rule) - set(RULE_FAMILIES)
         if unknown:
@@ -48,6 +99,14 @@ def main(argv=None) -> int:
         result.findings = [f for f in result.findings
                            if f.rule in args.rule]
     print(result.to_json() if args.json else result.render())
+    if args.emit_lane_graph is not None:
+        from elasticsearch_tpu.analysis.lint.lane_graph import \
+            emit_lane_graph
+        graph = emit_lane_graph(result.program, args.emit_lane_graph,
+                                DEFAULT_CONFIG)
+        print(f"plane-lint: lane graph ({len(graph['lanes'])} lanes, "
+              f"{len(graph['decline_edges'])} decline edges) → "
+              f"{args.emit_lane_graph}", file=sys.stderr)
     if result.errors:
         return 2
     return 1 if result.unsuppressed else 0

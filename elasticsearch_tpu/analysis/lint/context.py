@@ -38,7 +38,25 @@ RULE_FAMILIES = {
     "host-sync-hot-loop": "host-sync",
     "span-unscoped-site": "span-discipline",
     "span-unended": "span-discipline",
+    # trace-purity: nothing reachable from inside a traced body may
+    # touch host state (the PR 10 trace-time-import bug class)
+    "trace-impure-import": "trace-purity",
+    "trace-impure-global": "trace-purity",
+    "trace-impure-state-write": "trace-purity",
+    "trace-impure-call": "trace-purity",
+    "trace-impure-capture": "trace-purity",
+    # counter-discipline: every bump registered, every registered key
+    # bumped, every store surfaced from the registry
+    "counter-unregistered": "counter-discipline",
+    "counter-unbumped": "counter-discipline",
+    "counter-unsurfaced": "counter-discipline",
+    # fallback-taxonomy: one closed reason vocabulary per lane
+    "fallback-unknown-reason": "fallback-taxonomy",
+    "fallback-duplicate-reason": "fallback-taxonomy",
+    "fallback-unused-reason": "fallback-taxonomy",
+    "fallback-unresolved-reason": "fallback-taxonomy",
     "allow-missing-reason": "meta",
+    "allow-stale": "meta",
 }
 
 
@@ -50,6 +68,10 @@ class Finding:
     message: str
     suppressed: bool = False
     suppress_reason: str | None = None
+    #: warning-tier findings (the stale-suppression audit) are reported
+    #: but do not fail the gate unless --strict-suppressions promotes
+    #: them
+    warning: bool = False
 
     @property
     def family(self) -> str:
@@ -59,10 +81,12 @@ class Finding:
         return {"rule": self.rule, "family": self.family,
                 "path": self.path, "line": self.line,
                 "message": self.message, "suppressed": self.suppressed,
-                "suppress_reason": self.suppress_reason}
+                "suppress_reason": self.suppress_reason,
+                "warning": self.warning}
 
     def render(self) -> str:
-        tag = "allowed" if self.suppressed else "error"
+        tag = "allowed" if self.suppressed else \
+            ("warning" if self.warning else "error")
         out = (f"{self.path}:{self.line}: [{self.rule}] {tag}: "
                f"{self.message}")
         if self.suppressed and self.suppress_reason:
@@ -139,6 +163,58 @@ class LintConfig:
                        "popitem", "setdefault", "extend", "remove",
                        "discard", "move_to_end", "insert")
 
+    # ---- trace-purity (whole-program) ------------------------------------
+    #: callables whose function argument executes at TRACE time, matched
+    #: by last name (``seam_jit(fn)``, ``jax.vmap(fn)``, ``@jax.jit``)
+    trace_stagers: tuple = ("jit", "vmap", "pmap", "seam_jit",
+                            "shard_map", "shard_map_compat")
+    #: …and by dotted suffix, for names too generic to match bare
+    #: (``lax.map`` must not swallow the builtin ``map``)
+    trace_stagers_dotted: tuple = ("lax.scan", "lax.map", "lax.cond",
+                                   "lax.while_loop", "lax.fori_loop",
+                                   "lax.switch", "jax.checkpoint",
+                                   "jax.remat")
+    #: fnmatch patterns over a callee's dotted name: calling one of
+    #: these from trace-reachable code is a side effect (counter bumps,
+    #: logging, IO — they run at TRACE time, once per compile, not per
+    #: request; under concurrency, with foreign tracers in scope)
+    trace_side_effects: tuple = ("print", "open", "input", "note_*",
+                                 "_bump", "logging.*", "*.warning",
+                                 "*.info", "*.debug", "*.error")
+
+    # ---- counter-discipline (whole-program) ------------------------------
+    #: modules whose counter stores the rule polices
+    counter_modules: tuple = ("*/search/jit_exec.py",
+                              "*/parallel/mesh_engine.py",
+                              "*/search/percolator.py")
+    #: the registry module (parsed for the declared key sets)
+    counter_registry_modules: tuple = ("*/search/lanes.py",)
+    #: names of the registry dicts inside the registry module
+    counter_registry_names: tuple = ("JIT_COUNTERS",
+                                     "DATA_LAYER_COUNTERS",
+                                     "PERCOLATE_COUNTERS")
+    #: last name of a counter-store dict (``_stats[...] += n`` /
+    #: ``self.stats[...] += n``) inside a counter module
+    counter_stores: tuple = ("_stats", "_data_layer", "stats")
+    #: functions whose first argument is a counter key
+    counter_bump_fns: tuple = ("_bump",)
+
+    # ---- fallback-taxonomy (whole-program) -------------------------------
+    #: reason-noting callables, by last name → lane whose vocabulary
+    #: the literal reason must come from
+    fallback_noters: tuple = (("note_plane_fallback", "plane"),
+                              ("_note_plane_fallback", "plane"),
+                              ("note_fallback", "plane"),
+                              ("note_impact_fallback", "impact"),
+                              ("note_knn_fallback", "knn"),
+                              ("note_percolate_fallback", "percolate"))
+    #: the lane-registry module and its vocabulary / edge / admission
+    #: dict names (the --emit-lane-graph source of truth)
+    lane_registry_modules: tuple = ("*/search/lanes.py",)
+    lane_reasons_name: str = "LANE_REASONS"
+    lane_edges_name: str = "DECLINE_EDGES"
+    lane_admissions_name: str = "LANE_ADMISSIONS"
+
 
 DEFAULT_CONFIG = LintConfig()
 
@@ -164,6 +240,9 @@ class ModuleContext:
     source: str
     tree: ast.Module = None
     suppressions: dict = field(default_factory=dict)   # line → [(rule, reason)]
+    #: (comment line, rule) pairs a finding actually consumed — the
+    #: complement is the stale-suppression audit's input
+    used_suppressions: set = field(default_factory=set)
     functions: list = field(default_factory=list)
     _fn_of_node: dict = field(default_factory=dict)    # id(node) → FunctionInfo
     import_aliases: dict = field(default_factory=dict)  # alias → module path
@@ -206,6 +285,7 @@ class ModuleContext:
         for line in range(lo - 1, hi + 1):
             for rid, reason in self.suppressions.get(line, ()):
                 if rid == rule:
+                    self.used_suppressions.add((line, rule))
                     return (reason,)
         return None
 
@@ -223,6 +303,27 @@ class ModuleContext:
                     out.append(Finding(
                         "allow-missing-reason", self.relpath, line,
                         f"suppression names unknown rule id [{rid}]"))
+        return out
+
+    def stale_findings(self, strict: bool = False) -> list:
+        """The stale-suppression audit: a reasoned ``allow[rule]`` whose
+        rule no longer fires on its statement suppresses nothing — it is
+        dead weight that silently blesses FUTURE violations on that
+        line. Warning tier by default; ``--strict-suppressions``
+        promotes to a gate-failing finding. Runs AFTER every rule has
+        consumed its suppressions."""
+        out = []
+        for line, entries in sorted(self.suppressions.items()):
+            for rid, reason in entries:
+                if not reason or rid not in RULE_FAMILIES:
+                    continue              # allow-missing-reason's problem
+                if (line, rid) not in self.used_suppressions:
+                    out.append(Finding(
+                        "allow-stale", self.relpath, line,
+                        f"suppression allow[{rid}] no longer matches a "
+                        f"finding on this statement — drop it (or fix "
+                        f"the drift that moved the finding)",
+                        warning=not strict))
         return out
 
     # ---- structure --------------------------------------------------------

@@ -1,0 +1,123 @@
+"""Machine-readable lane-admission graph (``estpu-lint --emit-lane-graph``).
+
+The fallback-taxonomy pass doubles as an extractor: this module renders
+the lane registry (``elasticsearch_tpu.search.lanes``) TOGETHER with
+what the whole-program analysis actually found on the tree —
+
+* per lane: the admission predicate's resolved source location and the
+  reason vocabulary with the file:line of every reason-labeled decline
+  site;
+* the pairwise decline edges (``plane`` cedes to ``impact`` under
+  ``impact-preferred``, …) with their sites;
+* the counter registries (so the planner sees the lanes' observable
+  surface too).
+
+The emitted ``analysis/lane_graph.json`` is the lane model ROADMAP
+item 3's unified planner consumes; tests/test_lane_graph.py round-trips
+it against the live runtime registries every tier-1 run, so the
+artifact can never drift from the code. Paths are normalized to be
+package-relative and the JSON is key-sorted — the file is byte-stable
+across working directories.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+
+from elasticsearch_tpu.analysis.lint.context import DEFAULT_CONFIG
+from elasticsearch_tpu.analysis.lint.program import (
+    const_of, literal_assignment)
+from elasticsearch_tpu.analysis.lint import rule_fallback
+
+
+def _norm(relpath: str) -> str:
+    """Package-relative path: byte-identical no matter where the lint
+    ran from."""
+    rel = relpath.replace("\\", "/")
+    marker = "elasticsearch_tpu/"
+    idx = rel.rfind(marker)
+    return rel[idx:] if idx >= 0 else rel
+
+
+def _registry_value(program, cfg, name):
+    for ctx in program.registry_contexts(cfg.lane_registry_modules):
+        value = literal_assignment(ctx.tree, name)
+        if value is not None:
+            try:
+                return const_of(value)
+            except ValueError:
+                return None
+    return None
+
+
+def _admission_location(program, spec: str) -> "dict | None":
+    """Resolve "pkg-relative-path::Qualname" against the program's
+    function table → {"function", "path", "line"}, or None when the
+    spec no longer matches (the round-trip test fails loudly on that)."""
+    path, _, qual = spec.partition("::")
+    for fqn, (ctx, info) in program.functions.items():
+        if info.qualname == qual and \
+                _norm(ctx.relpath) == _norm(path):
+            return {"function": qual, "path": _norm(ctx.relpath),
+                    "line": info.node.lineno}
+    return None
+
+
+def build_lane_graph(program, cfg=DEFAULT_CONFIG) -> dict:
+    reasons_reg = _registry_value(program, cfg, cfg.lane_reasons_name) \
+        or {}
+    edges_reg = _registry_value(program, cfg, cfg.lane_edges_name) or ()
+    admissions_reg = _registry_value(program, cfg,
+                                     cfg.lane_admissions_name) or {}
+
+    sites: dict = {}                      # (lane, reason) → [{path, line}]
+    for lane, reasons, ctx, node in rule_fallback.iter_reason_sites(
+            program, cfg):
+        for r in reasons or ():
+            sites.setdefault((lane, r), []).append(
+                {"path": _norm(ctx.relpath), "line": node.lineno})
+    for key in sites:
+        sites[key].sort(key=lambda s: (s["path"], s["line"]))
+
+    lanes_out: dict = {}
+    for lane in sorted(reasons_reg):
+        spec = admissions_reg.get(lane)
+        lanes_out[lane] = {
+            "admission": (_admission_location(program, spec)
+                          if spec else None),
+            "reasons": {r: sites.get((lane, r), [])
+                        for r in reasons_reg[lane]},
+        }
+
+    edges_out = [{"from": a, "to": b, "reason": r,
+                  "sites": sites.get((a, r), [])}
+                 for a, b, r in edges_reg]
+
+    counters_out = {}
+    for name in cfg.counter_registry_names:
+        for ctx in program.registry_contexts(cfg.counter_registry_modules):
+            value = literal_assignment(ctx.tree, name)
+            if isinstance(value, ast.Dict):
+                counters_out[name] = sorted(
+                    k.value for k in value.keys
+                    if isinstance(k, ast.Constant))
+
+    return {
+        "version": 1,
+        "tool": "plane-lint",
+        "lanes": lanes_out,
+        "decline_edges": edges_out,
+        "counters": counters_out,
+    }
+
+
+def render_lane_graph(graph: dict) -> str:
+    return json.dumps(graph, indent=2, sort_keys=True) + "\n"
+
+
+def emit_lane_graph(program, out_path: str, cfg=DEFAULT_CONFIG) -> dict:
+    graph = build_lane_graph(program, cfg)
+    with open(out_path, "w", encoding="utf-8") as fh:
+        fh.write(render_lane_graph(graph))
+    return graph

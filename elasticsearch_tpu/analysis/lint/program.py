@@ -1,0 +1,379 @@
+"""Whole-program plumbing for plane-lint v2.
+
+One :class:`ProgramIndex` per lint run: every module's
+:class:`~elasticsearch_tpu.analysis.lint.context.ModuleContext` plus the
+project-wide symbol table and call graph the interprocedural rule
+families walk —
+
+* **module table** — dotted modkey → context, with suffix matching so
+  ``from elasticsearch_tpu.search import jit_exec`` resolves no matter
+  what working directory the relpaths were computed from;
+* **function table** — fully-qualified name (``modkey.Qual.name``) →
+  (context, FunctionInfo), covering nested defs and methods;
+* **call graph** — resolved edges for: bare names through the lexical
+  scope chain, ``from``-imported functions, ``module.fn`` attribute
+  calls, ``self.method`` / singleton / constructor-inferred receivers
+  (``x = ClassName(...)`` then ``x.method()`` — the known seam classes
+  resolve this way), and ``self.attr.method()`` through ``__init__``
+  attribute types;
+* **trace regions** — functions staged by ``seam_jit`` / ``jax.jit`` /
+  ``vmap`` / ``lax.scan`` / ``lax.map`` (decorated, passed by name,
+  inside a ``partial``, or called from a staged lambda), closed over
+  the call graph. ``trace_parents`` keeps BFS back-pointers so a
+  finding can print the call path from the staged seed to the impure
+  statement.
+
+Resolution is deliberately CONSERVATIVE-precise: a callee that cannot
+be statically pinned (dynamic dispatch, foreign libraries) resolves to
+nothing rather than to every same-named function — interprocedural
+rules prefer a missed edge over a storm of false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from elasticsearch_tpu.analysis.lint.context import (
+    dotted, last_name, module_matches)
+
+
+def modkey_for(relpath: str) -> str:
+    return relpath.replace("\\", "/").rsplit(".py", 1)[0].replace("/", ".")
+
+
+@dataclass
+class _ModuleInfo:
+    ctx: object
+    modkey: str
+    #: top-level bound names (module globals)
+    module_names: set = field(default_factory=set)
+    #: module-level singleton name → class name
+    singletons: dict = field(default_factory=dict)
+    #: module-level function name → fqn
+    top_functions: dict = field(default_factory=dict)
+
+
+class ProgramIndex:
+    def __init__(self, contexts: list, cfg):
+        self.cfg = cfg
+        self.contexts = list(contexts)
+        self.modules: dict[str, _ModuleInfo] = {}
+        self.functions: dict = {}          # fqn → (ctx, FunctionInfo)
+        self._fqn_of_info: dict = {}       # id(info) → fqn
+        self.methods: dict = {}            # (class, name) → [fqn]
+        self.class_attr_types: dict = {}   # (class, attr) → class name
+        self.calls: dict = {}              # fqn → [(Call node, set(fqns))]
+        self.call_graph: dict = {}         # fqn → set(fqns)
+        self._local_ctor_vars: dict = {}   # fqn → {var → class name}
+        self._build_tables()
+        self._build_call_graph()
+        self._traced: "tuple | None" = None
+
+    # ------------------------------------------------------------------ #
+    # symbol tables
+    # ------------------------------------------------------------------ #
+
+    def _build_tables(self) -> None:
+        for ctx in self.contexts:
+            mod = _ModuleInfo(ctx, modkey_for(ctx.relpath))
+            self.modules[mod.modkey] = mod
+            for node in ctx.tree.body:
+                if isinstance(node, ast.Assign):
+                    mod.module_names.update(
+                        t.id for t in node.targets
+                        if isinstance(t, ast.Name))
+                    if isinstance(node.value, ast.Call):
+                        ctor = last_name(node.value.func)
+                        if ctor and ctor[0].isupper():
+                            for t in node.targets:
+                                if isinstance(t, ast.Name):
+                                    mod.singletons[t.id] = ctor
+                elif isinstance(node, ast.AnnAssign) and \
+                        isinstance(node.target, ast.Name):
+                    mod.module_names.add(node.target.id)
+            for info in ctx.functions:
+                fqn = f"{mod.modkey}.{info.qualname}"
+                self.functions[fqn] = (ctx, info)
+                self._fqn_of_info[id(info)] = fqn
+                if info.parent is None and info.class_name is None:
+                    mod.top_functions[info.name] = fqn
+                if info.class_name is not None and info.parent is None:
+                    self.methods.setdefault(
+                        (info.class_name, info.name), []).append(fqn)
+                # constructor-typed locals: `v = ClassName(...)`
+                locals_: dict = {}
+                for n in ast.walk(info.node):
+                    if isinstance(n, ast.Assign) and \
+                            isinstance(n.value, ast.Call):
+                        ctor = last_name(n.value.func)
+                        if ctor and ctor[0].isupper():
+                            for t in n.targets:
+                                if isinstance(t, ast.Name):
+                                    locals_[t.id] = ctor
+                                elif isinstance(t, ast.Attribute) and \
+                                        isinstance(t.value, ast.Name) and \
+                                        t.value.id == "self" and \
+                                        info.class_name:
+                                    self.class_attr_types[
+                                        (info.class_name, t.attr)] = ctor
+                self._local_ctor_vars[fqn] = locals_
+
+    def fqn(self, info) -> str | None:
+        return self._fqn_of_info.get(id(info))
+
+    def resolve_module(self, dotted_path: str) -> "_ModuleInfo | None":
+        """Module by dotted import path, suffix-matched against the
+        relpath-derived modkeys."""
+        hit = self.modules.get(dotted_path)
+        if hit is not None:
+            return hit
+        want = "." + dotted_path
+        for key, mod in self.modules.items():
+            if key.endswith(want):
+                return mod
+        return None
+
+    # ------------------------------------------------------------------ #
+    # callee resolution
+    # ------------------------------------------------------------------ #
+
+    def resolve_callable(self, ctx, expr, caller_info) -> set:
+        """fqns of function DEFINITIONS the Name/Attribute `expr` may
+        refer to (empty when not statically resolvable)."""
+        mod = self.modules.get(modkey_for(ctx.relpath))
+        if mod is None:
+            return set()
+        if isinstance(expr, ast.Name):
+            return self._resolve_bare(ctx, mod, expr.id, caller_info)
+        if isinstance(expr, ast.Attribute):
+            return self._resolve_attr(ctx, mod, expr, caller_info)
+        return set()
+
+    def _resolve_bare(self, ctx, mod, name: str, caller_info) -> set:
+        # innermost nested def in the lexical chain wins
+        info = caller_info
+        while info is not None:
+            cand = f"{mod.modkey}.{info.qualname}.{name}"
+            if cand in self.functions:
+                return {cand}
+            info = info.parent
+        if name in mod.top_functions:
+            return {mod.top_functions[name]}
+        target = ctx.import_aliases.get(name)
+        if target is not None:
+            # from pkg.mod import fn  (alias → "pkg.mod.fn")
+            head, _, attr = target.rpartition(".")
+            tmod = self.resolve_module(head)
+            if tmod is not None and attr in tmod.top_functions:
+                return {tmod.top_functions[attr]}
+        return set()
+
+    def _resolve_attr(self, ctx, mod, expr: ast.Attribute,
+                      caller_info) -> set:
+        base, attr = expr.value, expr.attr
+        if isinstance(base, ast.Name):
+            if base.id == "self" and caller_info is not None and \
+                    caller_info.class_name:
+                return set(self.methods.get(
+                    (caller_info.class_name, attr), ()))
+            cls = mod.singletons.get(base.id)
+            if cls is None and caller_info is not None:
+                fqn = self.fqn(caller_info)
+                cls = self._local_ctor_vars.get(fqn, {}).get(base.id)
+            if cls is not None:
+                return set(self.methods.get((cls, attr), ()))
+            target = ctx.import_aliases.get(base.id)
+            if target is not None:
+                tmod = self.resolve_module(target)
+                if tmod is not None and attr in tmod.top_functions:
+                    return {tmod.top_functions[attr]}
+        elif isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id == "self" and caller_info is not None and \
+                caller_info.class_name:
+            cls = self.class_attr_types.get(
+                (caller_info.class_name, base.attr))
+            if cls is not None:
+                return set(self.methods.get((cls, attr), ()))
+        return set()
+
+    def _build_call_graph(self) -> None:
+        for ctx in self.contexts:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                caller = ctx.enclosing_function(node)
+                if caller is None:
+                    continue
+                fqn = self.fqn(caller)
+                if fqn is None:
+                    continue
+                targets = self.resolve_callable(ctx, node.func, caller)
+                self.calls.setdefault(fqn, []).append((node, targets))
+                if targets:
+                    self.call_graph.setdefault(fqn, set()).update(targets)
+
+    def reachable_from(self, seeds: set) -> set:
+        out = set(seeds)
+        stack = list(seeds)
+        while stack:
+            cur = stack.pop()
+            for nxt in self.call_graph.get(cur, ()):
+                if nxt not in out:
+                    out.add(nxt)
+                    stack.append(nxt)
+        return out
+
+    def transitive_marked(self, direct: set) -> set:
+        """Functions that reach (call, transitively) any of `direct` —
+        the reverse closure, for "does this callee eventually X" rules
+        like release-reachability and host-sync."""
+        rev: dict = {}
+        for src, dsts in self.call_graph.items():
+            for d in dsts:
+                rev.setdefault(d, set()).add(src)
+        out = set(direct)
+        stack = list(direct)
+        while stack:
+            cur = stack.pop()
+            for prev in rev.get(cur, ()):
+                if prev not in out:
+                    out.add(prev)
+                    stack.append(prev)
+        return out
+
+    # ------------------------------------------------------------------ #
+    # trace regions
+    # ------------------------------------------------------------------ #
+
+    def _is_stager(self, call: ast.Call) -> bool:
+        cfg = self.cfg
+        if last_name(call.func) in cfg.trace_stagers:
+            return True
+        d = dotted(call.func)
+        return bool(d) and any(d == s or d.endswith("." + s)
+                               for s in cfg.trace_stagers_dotted)
+
+    def _staged_refs(self, ctx, arg, scope_info) -> set:
+        """Function fqns a stager ARGUMENT stages: a direct
+        Name/Attribute reference, names called from a lambda body, or
+        (one level) the arguments of a ``partial(...)`` wrapper."""
+        out: set = set()
+        if isinstance(arg, (ast.Name, ast.Attribute)):
+            out |= self.resolve_callable(ctx, arg, scope_info)
+        elif isinstance(arg, ast.Lambda):
+            for n in ast.walk(arg.body):
+                if isinstance(n, ast.Call):
+                    out |= self.resolve_callable(ctx, n.func, scope_info)
+        elif isinstance(arg, ast.Call) and \
+                last_name(arg.func) == "partial":
+            for sub in list(arg.args) + [kw.value for kw in arg.keywords]:
+                out |= self._staged_refs(ctx, sub, scope_info)
+        return out
+
+    def traced(self) -> "tuple[set, dict]":
+        """(trace-reachable fqns, BFS back-pointers). Seeds are staged
+        functions; the closure follows the call graph — everything in
+        the set runs at TRACE time (with tracers in scope), so the
+        trace-purity rule polices its statements."""
+        if self._traced is not None:
+            return self._traced
+        seeds: dict = {}                  # fqn → (relpath, line) of stage site
+        for ctx in self.contexts:
+            for info in ctx.functions:
+                for dec in info.node.decorator_list:
+                    d = ast.dump(dec)
+                    if any(f"'{s}'" in d for s in self.cfg.trace_stagers):
+                        fqn = self.fqn(info)
+                        seeds.setdefault(
+                            fqn, (ctx.relpath, info.node.lineno))
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call) or \
+                        not self._is_stager(node):
+                    continue
+                scope = ctx.enclosing_function(node)
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    for fqn in self._staged_refs(ctx, arg, scope):
+                        seeds.setdefault(fqn, (ctx.relpath, node.lineno))
+        parents: dict = {fqn: None for fqn in seeds}
+        queue = sorted(seeds)
+        reached = set(seeds)
+        while queue:
+            nxt_queue = []
+            for cur in queue:
+                for nxt in sorted(self.call_graph.get(cur, ())):
+                    if nxt not in reached:
+                        reached.add(nxt)
+                        parents[nxt] = cur
+                        nxt_queue.append(nxt)
+            queue = nxt_queue
+        self._traced = (reached, parents)
+        return self._traced
+
+    def trace_path(self, fqn: str) -> str:
+        """"seed → … → fqn" rendered from the BFS back-pointers."""
+        _, parents = self.traced()
+        chain = [fqn]
+        seen = {fqn}
+        while parents.get(chain[0]) is not None and \
+                parents[chain[0]] not in seen:
+            chain.insert(0, parents[chain[0]])
+            seen.add(chain[0])
+        return " → ".join(short_fqn(c) for c in chain)
+
+    # ------------------------------------------------------------------ #
+    # registry-module helpers (counter / fallback / lane-graph rules)
+    # ------------------------------------------------------------------ #
+
+    def registry_contexts(self, patterns: tuple) -> list:
+        return [ctx for ctx in self.contexts
+                if module_matches(ctx.relpath, patterns)]
+
+
+def short_fqn(fqn: str) -> str:
+    """Drop the package prefix for readable messages: keep the module's
+    last component plus the qualname tail."""
+    parts = fqn.split(".")
+    return ".".join(parts[-3:]) if len(parts) > 3 else fqn
+
+
+def literal_dict_keys(tree: ast.Module, name: str) -> "list | None":
+    """Keys of a module-level ``NAME = {literal dict}`` assignment (the
+    registry-parsing primitive), or None when absent."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            if isinstance(node.value, ast.Dict):
+                return [k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)]
+    return None
+
+
+def literal_assignment(tree: ast.Module, name: str):
+    """The value AST of a module-level ``NAME = ...`` assignment."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            return node.value
+    return None
+
+
+def const_of(node):
+    """Python value of a literal AST (constants, tuples, lists, dicts of
+    literals) — the registry dicts are plain literals by contract."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(const_of(e) for e in node.elts)
+    if isinstance(node, ast.Dict):
+        return {const_of(k): const_of(v)
+                for k, v in zip(node.keys, node.values)}
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left, right = const_of(node.left), const_of(node.right)
+        if isinstance(left, str) and isinstance(right, str):
+            return left + right
+    raise ValueError(f"not a literal: {ast.dump(node)[:80]}")

@@ -7,7 +7,11 @@ passes when the reservation provably has a release path:
 * the charge's enclosing class itself defines ``release`` (the pairing
   primitive — OneShotCharge.charge lives next to its release);
 * the charge sits inside a ``try`` whose ``finally`` (or an ``except``
-  handler) calls or registers ``.release`` — the straight-line pairing;
+  handler) calls or registers ``.release`` — the straight-line pairing —
+  or calls a function that TRANSITIVELY releases (the v2
+  interprocedural extension: ``finally: self._teardown()`` where
+  ``_teardown`` walks a cleanup helper in another module that releases
+  counts, via the whole-program call graph);
 * the same receiver has a ``.release`` call elsewhere in the function
   (the charge-before-try and delta-accounting shapes: ES charges OUTSIDE
   the try so a failed reservation is never double-released);
@@ -45,11 +49,34 @@ def _is_charge_call(node: ast.Call, aliases: set) -> str | None:
     return None
 
 
-def _release_in(suites) -> bool:
+def _releasing_fqns(program) -> set:
+    """Functions that (transitively) contain a ``.release`` reference —
+    computed once per program, cached on it."""
+    cached = getattr(program, "_breaker_releasing", None)
+    if cached is not None:
+        return cached
+    direct = set()
+    for fqn, (ctx, info) in program.functions.items():
+        for n in ast.walk(info.node):
+            if isinstance(n, ast.Attribute) and n.attr == "release":
+                direct.add(fqn)
+                break
+    out = program.transitive_marked(direct)
+    program._breaker_releasing = out
+    return out
+
+
+def _release_in(suites, ctx=None, program=None) -> bool:
+    releasing = _releasing_fqns(program) if program is not None else set()
     for sub in suites:
         for n in ast.walk(sub):
             if isinstance(n, ast.Attribute) and n.attr == "release":
                 return True
+            if program is not None and isinstance(n, ast.Call):
+                caller = ctx.enclosing_function(n)
+                if program.resolve_callable(ctx, n.func, caller) & \
+                        releasing:
+                    return True           # cleanup helper releases for us
     return False
 
 
@@ -65,12 +92,13 @@ def _class_defines_release(ctx, fn) -> bool:
     return False
 
 
-def _in_guarded_try(ctx, call, fn_node) -> bool:
+def _in_guarded_try(ctx, call, fn_node, program=None) -> bool:
     for anc in ctx.ancestors(call):
         if anc is fn_node:
             break
         if isinstance(anc, ast.Try):
-            if _release_in(anc.finalbody) or _release_in(anc.handlers):
+            if _release_in(anc.finalbody, ctx, program) or \
+                    _release_in(anc.handlers, ctx, program):
                 return True
     return False
 
@@ -87,6 +115,21 @@ def _receiver_released_in_fn(call, fn_node) -> bool:
         if isinstance(n, ast.Attribute) and n.attr == "release" and \
                 dotted(n.value) == recv:
             return True
+    return False
+
+
+def _releasing_call_in_fn(ctx, program, fn_node) -> bool:
+    """v2 interprocedural pairing: the function calls something that
+    TRANSITIVELY releases (the charge-before-try + ``finally:
+    cleanup_helper()`` idiom, with the helper in any module)."""
+    if program is None:
+        return False
+    releasing = _releasing_fqns(program)
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Call):
+            caller = ctx.enclosing_function(n)
+            if program.resolve_callable(ctx, n.func, caller) & releasing:
+                return True
     return False
 
 
@@ -147,7 +190,7 @@ def _name_escapes(names: list, fn_node, origin) -> bool:
     return False
 
 
-def check(ctx, cfg) -> list:
+def check(ctx, cfg, program=None) -> list:
     aliases = _charge_aliases(ctx, cfg)
     findings, nodes = [], []
     for node in ast.walk(ctx.tree):
@@ -160,8 +203,9 @@ def check(ctx, cfg) -> list:
         if fn is None:
             continue                    # module scope: test scaffolding
         if _class_defines_release(ctx, fn) or \
-                _in_guarded_try(ctx, node, fn.node) or \
+                _in_guarded_try(ctx, node, fn.node, program) or \
                 _receiver_released_in_fn(node, fn.node) or \
+                _releasing_call_in_fn(ctx, program, fn.node) or \
                 _escapes(ctx, node, fn.node):
             continue
         findings.append(Finding(

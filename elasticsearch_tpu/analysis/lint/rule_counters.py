@@ -1,0 +1,247 @@
+"""counter-discipline: no silent counters, no dead keys.
+
+Every stats counter bumped in the counter modules (jit_exec /
+mesh_engine / percolator) must be declared in the central lane registry
+(``elasticsearch_tpu.search.lanes``), and every registered key must be
+bumped somewhere — the two orphan directions:
+
+* ``counter-unregistered`` — a bump (``_bump("key")``,
+  ``_stats["key"] += n``, ``self.stats["key"] += n``) whose key is not
+  in any registry dict, or whose key cannot be statically resolved: a
+  counter nobody can find in ``_nodes/stats`` documentation, or a typo
+  that silently splits a metric;
+* ``counter-unbumped`` — a registered key with zero bump sites across
+  the whole program: it surfaces as an eternally-zero stat that reads
+  like a healthy system;
+* ``counter-unsurfaced`` — a counter STORE in a counter module
+  initialized from a hand-written dict literal instead of the registry
+  (``{k: 0 for k in lanes.X}``): the store's keys and the registry
+  drift apart invisibly.
+
+Bump recognition: AugAssign on a store subscript, a positive-constant
+Assign (``stats["builds"] = 1`` — counted at construction), and
+``_bump(key)`` calls; keys resolve through string constants, either
+branch of a conditional expression, and one level of
+``key = {...}[kind]`` dict-literal indirection. Inside a bump helper
+itself (``_bump``), the forwarded parameter is exempt — its literals
+are checked at every call site instead.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticsearch_tpu.analysis.lint.context import (
+    Finding, apply_suppressions, last_name, module_matches)
+from elasticsearch_tpu.analysis.lint.program import (
+    literal_assignment, modkey_for)
+
+
+def _registry(program, cfg) -> "dict | None":
+    """key → (registry name, relpath, line) over every registry dict, or
+    None when no registry module is in the linted set (single-file runs
+    skip the rule rather than flagging everything)."""
+    out: dict = {}
+    found = False
+    for ctx in program.registry_contexts(cfg.counter_registry_modules):
+        for name in cfg.counter_registry_names:
+            value = literal_assignment(ctx.tree, name)
+            if not isinstance(value, ast.Dict):
+                continue
+            found = True
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out[k.value] = (name, ctx.relpath, k.lineno)
+    return out if found else None
+
+
+def _key_literals(ctx, fn_node, expr) -> "list | None":
+    """String keys an index/argument expression can take: constants,
+    conditional-expression branches, and a Name bound (once, in this
+    function) to a dict-literal subscript — ``key = {...}[kind]`` takes
+    every dict VALUE. None when not statically resolvable."""
+    if isinstance(expr, ast.Constant):
+        return [expr.value] if isinstance(expr.value, str) else None
+    if isinstance(expr, ast.IfExp):
+        a = _key_literals(ctx, fn_node, expr.body)
+        b = _key_literals(ctx, fn_node, expr.orelse)
+        if a is not None and b is not None:
+            return a + b
+        return None
+    if isinstance(expr, ast.Name):
+        bound = None
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == expr.id
+                    for t in n.targets):
+                bound = n.value
+        if isinstance(bound, ast.Subscript) and \
+                isinstance(bound.value, ast.Dict):
+            vals = [v.value for v in bound.value.values
+                    if isinstance(v, ast.Constant)]
+            return vals if len(vals) == len(bound.value.values) else None
+        if bound is not None:
+            return _key_literals(ctx, fn_node, bound)
+    return None
+
+
+def _store_match(ctx, mod_names: set, target, cfg) -> str | None:
+    """Is `target` (the subscripted value) a counter store? Bare names
+    must be module-level (a function-local ``stats = {...}`` scratch
+    dict is not a store); ``self.<store>`` attributes always match."""
+    if isinstance(target, ast.Name):
+        if target.id in cfg.counter_stores and target.id in mod_names:
+            return target.id
+    elif isinstance(target, ast.Attribute) and \
+            isinstance(target.value, ast.Name) and \
+            target.value.id == "self" and target.attr in cfg.counter_stores:
+        return target.attr
+    return None
+
+
+def check_program(program, cfg) -> list:
+    registry = _registry(program, cfg)
+    if registry is None:
+        return []
+    counter_ctxs = [ctx for ctx in program.contexts
+                    if module_matches(ctx.relpath, cfg.counter_modules)]
+    if not counter_ctxs:
+        return []
+
+    bumped: set = set()
+    by_ctx: dict = {}
+
+    def report(ctx, rule, node, message):
+        _, findings, nodes = by_ctx.setdefault(ctx.relpath, (ctx, [], []))
+        findings.append(Finding(rule, ctx.relpath, node.lineno, message))
+        nodes.append(node)
+
+    for ctx in counter_ctxs:
+        mod = program.modules.get(modkey_for(ctx.relpath))
+        mod_names = mod.module_names if mod is not None else set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.stmt, ast.expr)):
+                continue                  # ctx/operator singletons share
+                                          # parent links across trees
+            fn = ctx.enclosing_function(node)
+            fn_node = fn.node if fn is not None else ctx.tree
+            # ---- store subscript writes ------------------------------
+            target = slice_expr = None
+            counted = True
+            if isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Subscript):
+                target, slice_expr = node.target.value, node.target.slice
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        target, slice_expr = t.value, t.slice
+                # plain assignment only counts as a bump for a positive
+                # constant (counted-at-construction); zero re-inits are
+                # declarations
+                counted = isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, (int, float)) and \
+                    node.value.value > 0
+                # store initialized from a literal dict: keys must come
+                # from the registry comprehension, not hand-written
+                if target is None and isinstance(
+                        node.value, (ast.Dict, ast.DictComp)):
+                    store = None
+                    for t in node.targets:
+                        store = store or _store_match(
+                            ctx, mod_names, t, cfg)
+                    if store is not None:
+                        if isinstance(node.value, ast.DictComp):
+                            it = node.value.generators[0].iter
+                            if last_name(it) not in \
+                                    cfg.counter_registry_names:
+                                report(ctx, "counter-unsurfaced", node,
+                                       f"counter store [{store}] is "
+                                       f"built from "
+                                       f"[{last_name(it) or '?'}], not "
+                                       f"a registry dict — registry "
+                                       f"and surface drift apart")
+                        else:
+                            report(ctx, "counter-unsurfaced", node,
+                                   f"counter store [{store}] is "
+                                   f"initialized from a hand-written "
+                                   f"literal — build it from the "
+                                   f"registry ({{k: 0 for k in "
+                                   f"lanes.<REGISTRY>}}) so every "
+                                   f"registered key is surfaced by "
+                                   f"construction")
+                    continue
+            if target is not None:
+                store = _store_match(ctx, mod_names, target, cfg)
+                if store is None or not counted:
+                    continue
+                # a bump-helper's own forwarded parameter: literals are
+                # checked at its call sites
+                if isinstance(slice_expr, ast.Name) and fn is not None \
+                        and fn.name in cfg.counter_bump_fns and \
+                        slice_expr.id in {
+                            a.arg for a in fn.node.args.args +
+                            fn.node.args.kwonlyargs}:
+                    continue
+                keys = _key_literals(ctx, fn_node, slice_expr)
+                if keys is None:
+                    report(ctx, "counter-unregistered", node,
+                           f"counter key into [{store}] is not "
+                           f"statically resolvable — use a string "
+                           f"literal (or a dict-literal lookup) so the "
+                           f"registry check can see it")
+                    continue
+                for key in keys:
+                    bumped.add(key)
+                    if key not in registry:
+                        report(ctx, "counter-unregistered", node,
+                               f"counter [{key}] bumped into [{store}] "
+                               f"is not declared in the lane registry "
+                               f"— a silent counter (or a typo "
+                               f"splitting a metric)")
+                continue
+            # ---- bump-helper calls -----------------------------------
+            if isinstance(node, ast.Call) and \
+                    last_name(node.func) in cfg.counter_bump_fns and \
+                    node.args:
+                keys = _key_literals(ctx, fn_node, node.args[0])
+                if keys is None:
+                    report(ctx, "counter-unregistered", node,
+                           f"{last_name(node.func)}() key is not "
+                           f"statically resolvable — use a string "
+                           f"literal so the registry check can see it")
+                    continue
+                for key in keys:
+                    bumped.add(key)
+                    if key not in registry:
+                        report(ctx, "counter-unregistered", node,
+                               f"counter [{key}] bumped via "
+                               f"{last_name(node.func)}() is not "
+                               f"declared in the lane registry")
+
+    out = []
+    for ctx, findings, nodes in by_ctx.values():
+        out.extend(apply_suppressions(ctx, findings, nodes))
+
+    # ---- the reverse orphan: registered but never bumped -----------------
+    reg_by_path = {ctx.relpath: ctx for ctx in
+                   program.registry_contexts(cfg.counter_registry_modules)}
+    for key, (name, relpath, line) in sorted(registry.items()):
+        if key in bumped:
+            continue
+        f = Finding("counter-unbumped", relpath, line,
+                    f"registered counter [{key}] ({name}) has no bump "
+                    f"site anywhere in the program — it surfaces as an "
+                    f"eternally-zero stat that reads like a healthy "
+                    f"system")
+        ctx = reg_by_path.get(relpath)
+        if ctx is not None:
+            hit = None
+            for ln in (line - 1, line):
+                for rid, reason in ctx.suppressions.get(ln, ()):
+                    if rid == f.rule and reason:
+                        hit = (ln, reason)
+            if hit is not None:
+                ctx.used_suppressions.add((hit[0], f.rule))
+                f.suppressed, f.suppress_reason = True, hit[1]
+        out.append(f)
+    return out

@@ -113,7 +113,7 @@ def _effective_function(ctx, node):
     return fn
 
 
-def check(ctx, cfg) -> list:
+def check(ctx, cfg, program=None) -> list:
     in_seam = module_matches(ctx.relpath, cfg.seam_modules)
     findings, nodes = [], []
 

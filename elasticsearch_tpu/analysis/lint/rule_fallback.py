@@ -1,0 +1,186 @@
+"""fallback-taxonomy: one closed reason vocabulary per lane.
+
+Every ``note_*_fallback`` / decline call's reason string must come from
+the lane's registered vocabulary
+(``elasticsearch_tpu.search.lanes.LANE_REASONS``):
+
+* ``fallback-unknown-reason`` — a literal reason not in the lane's
+  vocabulary (a typo forks the taxonomy: dashboards, slowlog labels
+  and the lane-graph artifact all disagree);
+* ``fallback-unresolved-reason`` — a reason the analyzer cannot pin to
+  literals (and that is not a noter-wrapper's forwarded parameter):
+  dynamic reasons bypass the closed vocabulary entirely;
+* ``fallback-duplicate-reason`` — the registry lists the same reason
+  twice within one lane;
+* ``fallback-unused-reason`` — a registered reason no call site ever
+  notes (emitted only when the program actually contains call sites
+  for that lane, so linting the registry file alone stays quiet).
+
+The same reason-site extraction feeds ``--emit-lane-graph``
+(:mod:`elasticsearch_tpu.analysis.lint.lane_graph`), which records each
+lane's vocabulary WITH the file:line of every decline site — the
+machine-readable half of this rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticsearch_tpu.analysis.lint.context import (
+    Finding, apply_suppressions, last_name)
+from elasticsearch_tpu.analysis.lint.program import (
+    const_of, literal_assignment)
+
+#: noter name → 0-based positional index of the reason argument; None
+#: means keyword-only (``reason=...``) — a call without the keyword
+#: notes no reason and is skipped.
+_REASON_ARG = {"note_plane_fallback": 0, "_note_plane_fallback": 1,
+               "note_fallback": None, "note_impact_fallback": 0,
+               "note_knn_fallback": 0, "note_percolate_fallback": 0}
+
+
+def lane_registry(program, cfg) -> "tuple | None":
+    """((lane → reasons tuple), registry ctx, {lane → key lineno}) from
+    the lane-registry module's literal AST, or None when absent."""
+    for ctx in program.registry_contexts(cfg.lane_registry_modules):
+        value = literal_assignment(ctx.tree, cfg.lane_reasons_name)
+        if not isinstance(value, ast.Dict):
+            continue
+        try:
+            reasons = const_of(value)
+        except ValueError:
+            continue
+        lines = {k.value: k.lineno for k in value.keys
+                 if isinstance(k, ast.Constant)}
+        return reasons, ctx, lines
+    return None
+
+
+def _reason_expr(call: ast.Call, noter: str):
+    """The reason argument's AST, or None when the call notes none."""
+    for kw in call.keywords:
+        if kw.arg == "reason":
+            return kw.value
+    idx = _REASON_ARG.get(noter)
+    if idx is not None and len(call.args) > idx:
+        return call.args[idx]
+    return None
+
+
+def _literal_reasons(ctx, fn_node, expr) -> "list | None":
+    if isinstance(expr, ast.Constant):
+        return [expr.value] if isinstance(expr.value, str) else None
+    if isinstance(expr, ast.IfExp):
+        a = _literal_reasons(ctx, fn_node, expr.body)
+        b = _literal_reasons(ctx, fn_node, expr.orelse)
+        return a + b if a is not None and b is not None else None
+    if isinstance(expr, ast.Name) and fn_node is not None:
+        bound = None
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == expr.id
+                    for t in n.targets):
+                bound = n.value
+        if bound is not None:
+            return _literal_reasons(ctx, fn_node, bound)
+    return None
+
+
+def iter_reason_sites(program, cfg):
+    """Yield (lane, reasons | None, ctx, call node) for every noter
+    call with a reason argument; ``reasons`` is None when not statically
+    resolvable (a forwarded noter-wrapper parameter yields nothing —
+    its literals appear at the wrapper's own call sites)."""
+    noters = dict(cfg.fallback_noters)
+    for ctx in program.contexts:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = last_name(node.func)
+            lane = noters.get(name)
+            if lane is None:
+                continue
+            fn = ctx.enclosing_function(node)
+            if fn is not None and fn.name in noters:
+                continue                  # wrapper body forwards its param
+            expr = _reason_expr(node, name)
+            if expr is None:
+                continue                  # notes no reason (note_fallback(e))
+            reasons = _literal_reasons(
+                ctx, fn.node if fn is not None else None, expr)
+            yield lane, reasons, ctx, node
+
+
+def check_program(program, cfg) -> list:
+    hit = lane_registry(program, cfg)
+    if hit is None:
+        return []
+    vocab, reg_ctx, reg_lines = hit
+
+    by_ctx: dict = {}
+
+    def report(ctx, rule, node_or_line, message):
+        _, findings, nodes = by_ctx.setdefault(ctx.relpath, (ctx, [], []))
+        line = node_or_line if isinstance(node_or_line, int) \
+            else node_or_line.lineno
+        findings.append(Finding(rule, ctx.relpath, line, message))
+        nodes.append(None if isinstance(node_or_line, int)
+                     else node_or_line)
+
+    # registry self-checks: duplicates within a lane
+    for lane, reasons in sorted(vocab.items()):
+        seen = set()
+        for r in reasons:
+            if r in seen:
+                report(reg_ctx, "fallback-duplicate-reason",
+                       reg_lines.get(lane, 1),
+                       f"reason [{r}] is registered twice in the "
+                       f"[{lane}] lane vocabulary")
+            seen.add(r)
+
+    used: dict = {lane: set() for lane in vocab}
+    lanes_with_sites: set = set()
+    for lane, reasons, ctx, node in iter_reason_sites(program, cfg):
+        lanes_with_sites.add(lane)
+        if reasons is None:
+            report(ctx, "fallback-unresolved-reason", node,
+                   f"[{lane}]-lane fallback reason is not statically "
+                   f"resolvable — use a string literal (or a "
+                   f"conditional of literals) so the closed vocabulary "
+                   f"holds")
+            continue
+        for r in reasons:
+            used.setdefault(lane, set()).add(r)
+            if r not in vocab.get(lane, ()):
+                report(ctx, "fallback-unknown-reason", node,
+                       f"[{r}] is not in the registered [{lane}]-lane "
+                       f"vocabulary — add it to lanes.LANE_REASONS"
+                       f"[{lane!r}] (or fix the typo: the taxonomy is "
+                       f"closed)")
+
+    for lane, reasons in sorted(vocab.items()):
+        if lane not in lanes_with_sites:
+            continue                      # lane code not in the linted set
+        for r in reasons:
+            if r not in used.get(lane, ()):
+                report(reg_ctx, "fallback-unused-reason",
+                       reg_lines.get(lane, 1),
+                       f"registered [{lane}]-lane reason [{r}] is "
+                       f"never noted by any call site — dead "
+                       f"vocabulary misleads the lane graph")
+
+    out = []
+    for ctx, findings, nodes in by_ctx.values():
+        anchored = [(f, n) for f, n in zip(findings, nodes)
+                    if n is not None]
+        line_only = [f for f, n in zip(findings, nodes) if n is None]
+        out.extend(apply_suppressions(
+            ctx, [f for f, _ in anchored], [n for _, n in anchored]))
+        for f in line_only:
+            for ln in (f.line - 1, f.line):
+                for rid, reason in ctx.suppressions.get(ln, ()):
+                    if rid == f.rule and reason:
+                        ctx.used_suppressions.add((ln, rid))
+                        f.suppressed, f.suppress_reason = True, reason
+            out.append(f)
+    return out

@@ -14,7 +14,12 @@ the moment the loop body forces a transfer. Flagged syncs:
 * ``np.asarray(...)`` / ``.item()`` on anything;
 * ``jax.block_until_ready`` / ``.block_until_ready()``;
 * ``float()`` / ``int()`` / ``bool()`` applied to a dispatch RESULT —
-  a name bound from calling a ``_get_compiled``-produced program.
+  a name bound from calling a ``_get_compiled``-produced program;
+* (v2, interprocedural) a call to a function that TRANSITIVELY
+  host-syncs — resolved through the whole-program call graph, so
+  hoisting the ``np.asarray`` into a helper no longer hides it from
+  the rule. Seam wrappers (``seam_device_put``) are exempt: their
+  transfer is host→device staging, not a pipeline stall.
 
 Syncs after the loop (drain-at-the-end) are the intended shape and pass.
 """
@@ -89,7 +94,48 @@ def _sync_calls(loop, results: set):
                       f"the device")
 
 
-def check(ctx, cfg) -> list:
+def _syncing_fqns(program):
+    """fqn → first direct sync line for functions whose body host-syncs,
+    plus the transitive closure of their callers' view: everything that
+    REACHES a sync. Cached on the program."""
+    cached = getattr(program, "_hostsync_syncing", None)
+    if cached is not None:
+        return cached
+    direct: dict = {}
+    for fqn, (fctx, info) in program.functions.items():
+        for call, why in _sync_calls(info.node, set()):
+            direct[fqn] = (fctx.relpath, call.lineno, why)
+            break
+    marked = program.transitive_marked(set(direct))
+    cached = (direct, marked)
+    program._hostsync_syncing = cached
+    return cached
+
+
+def _callee_syncs(ctx, cfg, program, fn, loop):
+    """(call node, message) for loop-body calls that resolve to a
+    function which (transitively) host-syncs."""
+    if program is None:
+        return
+    direct, marked = _syncing_fqns(program)
+    for n in ast.walk(loop):
+        if not isinstance(n, ast.Call):
+            continue
+        name = last_name(n.func)
+        if name in cfg.seam_wrappers or name in cfg.fault_point_names \
+                or name in cfg.span_fns or name in cfg.trampolines:
+            continue                    # staging/guard seams, not syncs
+        targets = program.resolve_callable(ctx, n.func, fn)
+        hit = sorted(t for t in targets if t in marked)
+        if not hit:
+            continue
+        site = direct.get(hit[0])
+        where = f" (sync at {site[0]}:{site[1]})" if site else ""
+        yield n, (f"call to {hit[0].rsplit('.', 1)[-1]}() which "
+                  f"transitively forces a device→host sync{where}")
+
+
+def check(ctx, cfg, program=None) -> list:
     if not module_matches(ctx.relpath, cfg.hot_modules):
         return []
     findings, nodes = [], []
@@ -106,7 +152,12 @@ def check(ctx, cfg) -> list:
                             if _contains(loop, m)}
             if not marker_lines:
                 continue
-            for call, why in _sync_calls(loop, results):
+            direct_syncs = list(_sync_calls(loop, results))
+            direct_ids = {id(c) for c, _ in direct_syncs}
+            indirect = [(c, w) for c, w in
+                        _callee_syncs(ctx, cfg, program, fn, loop)
+                        if id(c) not in direct_ids]
+            for call, why in direct_syncs + indirect:
                 if id(call) in seen:
                     continue
                 seen.add(id(call))

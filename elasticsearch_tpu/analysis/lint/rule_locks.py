@@ -196,15 +196,20 @@ def _callee_repr(ctx, info, call, class_name) -> "tuple | None":
     return None
 
 
-def lock_graph(infos: list, cfg=None) -> dict:
+def lock_graph(infos: list, cfg=None, program=None) -> dict:
     """(outer, inner) → (LockSite outer, LockSite inner) over the whole
-    tree: every lexically-nested acquisition plus one-level
-    interprocedural resolution (module-local functions, same-class /
-    singleton methods, imported-module functions called while holding a
-    lock). finalize() reports on this graph; the runtime watchdog
+    tree: every lexically-nested acquisition plus interprocedural
+    resolution of calls made while holding a lock — one level through
+    the module-local tables (module-local functions, same-class /
+    singleton methods, imported-module functions), and, when the
+    whole-program index is available, the TRANSITIVE closure of the
+    callee's call-graph reach (v2: while holding A, a helper three
+    modules away that acquires B still contributes the A→B edge).
+    finalize() reports on this graph; the runtime watchdog
     (elasticsearch_tpu.analysis.watchdog) asserts it."""
     local_fns: dict = {}      # (modkey, name) → [lock identities]
     method_fns: dict = {}     # (class, name) → [[lock identities]]
+    locks_by_fqn: dict = {}   # program fqn → [lock identities]
     for info in infos:
         for qual, locks in info.fn_locks.items():
             parts = qual.split(".")
@@ -212,24 +217,40 @@ def lock_graph(infos: list, cfg=None) -> dict:
             local_fns.setdefault((info.modkey, name), []).extend(locks)
             if len(parts) >= 2:
                 method_fns.setdefault((parts[-2], name), []).append(locks)
+            locks_by_fqn[f"{info.modkey}.{qual}"] = list(locks)
     modkey_of = {info.modkey.rsplit(".", 1)[-1]: info.modkey
                  for info in infos}
+
+    def closure_locks(fqns) -> list:
+        if program is None:
+            return []
+        out = []
+        for fqn in program.reachable_from(set(fqns)):
+            out.extend(locks_by_fqn.get(fqn, ()))
+        return out
 
     edges: dict = {}
     for info in infos:
         edges.update(info.edges)
         for held, callee, site in info.held_calls:
             targets = []
+            fqn_seeds = []
             if callee[0] == "local":
                 targets = local_fns.get((info.modkey, callee[1]), [])
+                fqn_seeds = [f"{info.modkey}.{callee[1]}"]
             elif callee[0] == "method":
                 for locks in method_fns.get((callee[1], callee[2]), ()):
                     targets.extend(locks)
+                if program is not None:
+                    fqn_seeds = list(program.methods.get(
+                        (callee[1], callee[2]), ()))
             elif callee[0] == "module":
                 mod = callee[1]
                 key = modkey_of.get(mod.rsplit(".", 1)[-1])
                 if key is not None:
                     targets = local_fns.get((key, callee[2]), [])
+                    fqn_seeds = [f"{key}.{callee[2]}"]
+            targets = list(targets) + closure_locks(fqn_seeds)
             for inner in targets:
                 edges.setdefault((held, inner),
                                  (site, LockSite(inner, site.relpath,
@@ -237,10 +258,10 @@ def lock_graph(infos: list, cfg=None) -> dict:
     return edges
 
 
-def finalize(infos: list, cfg) -> list:
+def finalize(infos: list, cfg, program=None) -> list:
     """Cross-module pass: resolve held calls into edges, then report
     inconsistent lock-order pairs (and non-reentrant self cycles)."""
-    edges = lock_graph(infos, cfg)
+    edges = lock_graph(infos, cfg, program)
 
     reentrant: dict = {}
     for info in infos:
@@ -304,7 +325,7 @@ def lock_ranks(edges: dict) -> dict:
 # lock-unguarded-state (per module)
 # ---------------------------------------------------------------------------
 
-def check_state(ctx, cfg) -> list:
+def check_state(ctx, cfg, program=None) -> list:
     info = collect(ctx, cfg)
     candidates = _state_candidates(ctx)
     if not candidates:

@@ -151,7 +151,7 @@ def _is_memo_site(ctx, call: ast.Call) -> bool:
     return False
 
 
-def check(ctx, cfg) -> list:
+def check(ctx, cfg, program=None) -> list:
     findings, nodes = [], []
     traced = _traced_functions(ctx)
     builders = _builders(ctx, cfg)

@@ -61,7 +61,7 @@ def _site_covered(ctx, cfg, fn, site_arg) -> bool:
     return False
 
 
-def check(ctx, cfg) -> list:
+def check(ctx, cfg, program=None) -> list:
     exempt = module_matches(ctx.relpath, cfg.span_exempt_modules)
     findings, nodes = [], []
     for node in ast.walk(ctx.tree):

@@ -1,0 +1,252 @@
+"""trace-purity: nothing reachable from a traced body touches host state.
+
+A function is TRACE-REACHABLE when it is staged by ``seam_jit`` /
+``jax.jit`` / ``vmap`` / ``lax.scan`` / ``lax.map`` (decorated, passed
+by name, wrapped in ``partial``, or called from a staged lambda), or
+transitively called from one (ProgramIndex.traced). Python statements
+in such a function run at TRACE time: once per compile — not per
+request — and, under concurrent tracing, with FOREIGN tracers live on
+the stack. The canonical bug (PR 10, distilled in
+tests/lint_fixtures/trace_purity_pos.py): an ``import`` executed inside
+a traced body let jax cache another request's tracers into the imported
+module's globals — "compiled for N+3 inputs" under concurrency.
+
+Rules, each anchored at the impure statement with the call path from
+the staged seed:
+
+* ``trace-impure-import`` — any ``import`` / ``from … import``
+  statement (module-cache writes + arbitrary module-level execution at
+  trace time);
+* ``trace-impure-global`` — a ``global`` declaration (rebinding module
+  state per compile);
+* ``trace-impure-state-write`` — mutating a module-level container
+  (``_cache[k] = v``, ``.append``, ``+=`` …), directly or through an
+  imported module's attribute;
+* ``trace-impure-call`` — calling a configured side-effecting function
+  (``note_*`` counter bumps, ``print``/``open``, logging): the effect
+  fires per compile, silently wrong under the program cache;
+* ``trace-impure-capture`` — READING module-level mutable state (a
+  dict/list/set that something, somewhere, mutates): the value is baked
+  at trace time, so later mutations never reach the compiled program —
+  and tracer objects can leak INTO it. Constant lookup tables (never
+  mutated) pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+from elasticsearch_tpu.analysis.lint.context import (
+    Finding, apply_suppressions, dotted, last_name)
+from elasticsearch_tpu.analysis.lint.program import modkey_for, short_fqn
+
+_MUTABLE_CTORS = ("dict", "list", "set", "OrderedDict", "defaultdict",
+                  "deque")
+
+
+def _is_mutable_literal(value) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+        return True
+    return isinstance(value, ast.Call) and \
+        last_name(value.func) in _MUTABLE_CTORS
+
+
+def _mutation_target(node, cfg):
+    """The expression naming a mutated container, or None (the
+    lock-discipline detection, shared shape)."""
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                return t.value
+    elif isinstance(node, ast.AugAssign):
+        t = node.target
+        return t.value if isinstance(t, ast.Subscript) else t
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                return t.value
+    elif isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in cfg.mutators:
+        return node.func.value
+    return None
+
+
+def _mutable_module_state(program) -> set:
+    """(modkey, name) of module-level mutable containers that some
+    function ANYWHERE in the program mutates — true shared state, as
+    opposed to constant lookup tables."""
+    declared: set = set()
+    for modkey, mod in program.modules.items():
+        for node in mod.ctx.tree.body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                if node.value is not None and \
+                        _is_mutable_literal(node.value):
+                    declared.update(
+                        (modkey, t.id) for t in targets
+                        if isinstance(t, ast.Name))
+    mutated: set = set()
+    for ctx in program.contexts:
+        modkey = modkey_for(ctx.relpath)
+        for node in ast.walk(ctx.tree):
+            target = _mutation_target(node, program.cfg)
+            if target is None or ctx.enclosing_function(node) is None:
+                continue                  # module-scope init is declaration
+            if isinstance(target, ast.Name):
+                mutated.add((modkey, target.id))
+            elif isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name):
+                imported = ctx.import_aliases.get(target.value.id)
+                if imported is not None:
+                    tmod = program.resolve_module(imported)
+                    if tmod is not None:
+                        mutated.add((tmod.modkey, target.attr))
+    return declared & mutated
+
+
+def _local_names(fn_node) -> set:
+    """Names bound locally in a function (params, plain assignments,
+    loop/with/except targets, comprehension vars) — these shadow module
+    globals for the state rules."""
+    out = set()
+    args = fn_node.args
+    for a in (args.args + args.kwonlyargs + args.posonlyargs
+              if hasattr(args, "posonlyargs")
+              else args.args + args.kwonlyargs):
+        out.add(a.arg)
+    for extra in (args.vararg, args.kwarg):
+        if extra is not None:
+            out.add(extra.arg)
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name) and \
+                            isinstance(sub.ctx, ast.Store):
+                        out.add(sub.id)
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            for sub in ast.walk(n.target):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+        elif isinstance(n, (ast.withitem,)) and n.optional_vars is not None:
+            for sub in ast.walk(n.optional_vars):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+        elif isinstance(n, ast.comprehension):
+            for sub in ast.walk(n.target):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+        elif isinstance(n, ast.ExceptHandler) and n.name:
+            out.add(n.name)
+    return out
+
+
+def _side_effect_match(call: ast.Call, cfg) -> str | None:
+    d = dotted(call.func)
+    name = last_name(call.func)
+    for pat in cfg.trace_side_effects:
+        if (d and fnmatch.fnmatch(d, pat)) or \
+                (name and fnmatch.fnmatch(name, pat)):
+            return d or name
+    return None
+
+
+def check_program(program, cfg) -> list:
+    reached, _ = program.traced()
+    mutable_state = _mutable_module_state(program)
+    by_ctx: dict = {}                     # ctx → (findings, nodes)
+
+    def report(ctx, rule, node, message):
+        _, findings, nodes = by_ctx.setdefault(ctx.relpath, (ctx, [], []))
+        findings.append(Finding(rule, ctx.relpath, node.lineno, message))
+        nodes.append(node)
+
+    for fqn in sorted(reached):
+        entry = program.functions.get(fqn)
+        if entry is None:
+            continue
+        ctx, info = entry
+        modkey = modkey_for(ctx.relpath)
+        mod = program.modules.get(modkey)
+        locals_ = _local_names(info.node)
+        path = program.trace_path(fqn)
+        reported_state: set = set()       # (lineno, name): write > capture
+        for node in ast.walk(info.node):
+            if not isinstance(node, (ast.stmt, ast.expr)):
+                continue                  # ctx/operator singletons share
+                                          # parent links across trees
+            if node is not info.node and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue                  # nested defs report as themselves
+            owner = ctx.enclosing_function(node)
+            if owner is not info:
+                continue
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                names = ", ".join(a.name for a in node.names)
+                report(ctx, "trace-impure-import", node,
+                       f"import of [{names}] inside the traced body of "
+                       f"{short_fqn(fqn)}() — imports at trace time "
+                       f"cache foreign tracers into module globals (the "
+                       f"PR 10 'compiled for N+3 inputs' bug); import "
+                       f"at module level instead (trace path: {path})")
+                continue
+            if isinstance(node, ast.Global):
+                report(ctx, "trace-impure-global", node,
+                       f"`global {', '.join(node.names)}` inside the "
+                       f"traced body of {short_fqn(fqn)}() rebinds "
+                       f"module state once per COMPILE, not per request "
+                       f"(trace path: {path})")
+                continue
+            target = _mutation_target(node, cfg)
+            if target is not None:
+                state = None
+                if isinstance(target, ast.Name) and \
+                        target.id not in locals_ and mod is not None and \
+                        target.id in mod.module_names:
+                    state = target.id
+                elif isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id in ctx.import_aliases:
+                    tmod = program.resolve_module(
+                        ctx.import_aliases[target.value.id])
+                    if tmod is not None and \
+                            target.attr in tmod.module_names:
+                        state = f"{target.value.id}.{target.attr}"
+                if state is not None:
+                    reported_state.add((node.lineno, state.split(".")[-1]))
+                    report(ctx, "trace-impure-state-write", node,
+                           f"traced body of {short_fqn(fqn)}() mutates "
+                           f"module state [{state}] — the write happens "
+                           f"at trace time, once per compile, possibly "
+                           f"holding tracer objects (trace path: "
+                           f"{path})")
+            if isinstance(node, ast.Call):
+                hit = _side_effect_match(node, cfg)
+                if hit is not None:
+                    report(ctx, "trace-impure-call", node,
+                           f"side-effecting call {hit}() inside the "
+                           f"traced body of {short_fqn(fqn)}() fires "
+                           f"once per COMPILE (program-cache hits skip "
+                           f"it entirely) — hoist it to the dispatch "
+                           f"site (trace path: {path})")
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id not in locals_ and \
+                    (modkey, node.id) in mutable_state and \
+                    (node.lineno, node.id) not in reported_state:
+                report(ctx, "trace-impure-capture", node,
+                       f"traced body of {short_fqn(fqn)}() captures "
+                       f"mutable module state [{node.id}] — the value "
+                       f"is baked at trace time, so later mutations "
+                       f"never reach the compiled program; pass it as "
+                       f"an argument or snapshot an immutable view "
+                       f"(trace path: {path})")
+
+    out = []
+    for ctx, findings, nodes in by_ctx.values():
+        out.extend(apply_suppressions(ctx, findings, nodes))
+    return out

@@ -32,6 +32,12 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# module-level import, NEVER inside a traced body: an import executed
+# at trace time caches foreign tracers into the imported module's
+# globals ("compiled for N+3 inputs" under concurrency — the PR 10 bug
+# plane-lint's trace-purity family now guards against)
+from elasticsearch_tpu.ops import topk as topk_ops
+
 NEG_INF = jnp.float32(-jnp.inf)
 #: doc-id sort key for empty slots: past any real doc id so -inf ties
 #: never displace real candidates
@@ -103,7 +109,6 @@ def eager_segment_topk(uterms, qimp, live, qtids, scale_boost, k: int,
     ``scale_boost`` = segment dequant scale × query boost (traced);
     ``cursor_s``/``cursor_d`` implement the score-order search_after
     continuation (pass +inf / -1 for no cursor)."""
-    from elasticsearch_tpu.ops import topk as topk_ops
     n = uterms.shape[0]
     qsum, anyhit = impact_scores(uterms, qimp, qtids)
     sf = qsum.astype(jnp.float32) * scale_boost

@@ -99,6 +99,10 @@ from elasticsearch_tpu.index.device_reader import (
 from elasticsearch_tpu.index.segment import (
     KeywordFieldColumn, Segment, TextFieldColumn)
 from elasticsearch_tpu.observability.tracing import device_span
+# module-level on purpose: step_local runs under shard_map tracing, and
+# an import executed at trace time caches foreign tracers into the
+# imported module's globals (trace-purity rule)
+from elasticsearch_tpu.ops import aggs_ops
 from elasticsearch_tpu.search import dfs as dfs_mod
 from elasticsearch_tpu.search.execute import ExecutionContext
 from elasticsearch_tpu.search.jit_exec import (
@@ -1252,7 +1256,6 @@ class MeshEngineSearcher:
         def step_local(flats, consts, cursors, kwsorts):
             # flats[j]: arrays [spd, Np_j, ...]; consts[j]: [spd, B_local, ...]
             # kwsorts: [spd, n_kw, stride] keyword-sort union-rank lanes
-            from elasticsearch_tpu.ops import aggs_ops
             dev_idx = jax.lax.axis_index("shard").astype(jnp.int32)
             cand = []                    # per-block payload dicts [B, k]
             counts_blocks = []           # per-block [B] hit counts

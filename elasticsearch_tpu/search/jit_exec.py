@@ -44,6 +44,7 @@ from elasticsearch_tpu.observability.context import current_node_id
 from elasticsearch_tpu.observability.tracing import device_span
 from elasticsearch_tpu.ops import blockmax as blockmax_ops
 from elasticsearch_tpu.ops import topk as topk_ops
+from elasticsearch_tpu.search import lanes
 from elasticsearch_tpu.search.execute import (
     ConstTable, EmitCtx, ExecutionContext, SegmentResolver)
 
@@ -302,33 +303,11 @@ def note_breaker_skip() -> None:
 # counts ADMISSION declines (the request still succeeds on the RPC
 # fan-out) — kept apart from `fallbacks`, which tracks compiled-program
 # executions degrading to eager and is held at zero by the jit suites.
-_stats = {"hits": 0, "misses": 0, "fallbacks": 0,
-          "mesh_program_hits": 0, "mesh_program_misses": 0,
-          "plane_fallbacks": 0,
-          # percolate_program_* count the percolator's fused-lane program
-          # cache (run_percolate_lanes): a miss is a fresh trace+compile
-          # for a (probe layout × query-shape set) never seen before, a
-          # hit re-dispatches against new stacked constants — the counters
-          # behind the tier-1 "≤1 compile per plan shape" registry guard.
-          "percolate_program_hits": 0, "percolate_program_misses": 0,
-          # degraded-mode serving: requests the open plane breaker routed
-          # to the fan-out/eager path (zero device dispatches), and
-          # HBM-OOM responses (cold-block evictions before degrading)
-          "breaker_open_skips": 0, "oom_evictions": 0,
-          "oom_bytes_evicted": 0,
-          # impact-ordered lane: requests admitted to quantized-impact
-          # scoring, block-max sweep work accounting (scored vs skipped
-          # blocks — the effective-work/sublinearity evidence), and
-          # impact requantizations forced by cross-segment df drift
-          # (steady-state refreshes must NOT bump this)
-          "impact_admissions": 0, "impact_blocks_scored": 0,
-          "impact_blocks_skipped": 0, "impact_requant_refreshes": 0,
-          # dense/late-interaction retrieval lane: requests served by
-          # the compiled knn path, hybrid fusion dispatches (must
-          # reconcile with the hybrid request count — the one-dispatch
-          # proof), and fused MaxSim dispatches over rank_vectors
-          "knn_admissions": 0, "fusion_dispatches": 0,
-          "maxsim_dispatches": 0}
+# Keys (and their meanings) live in the lane registry — the store is
+# built FROM it so every registered counter is surfaced through
+# cache_stats() → _nodes/stats by construction, and plane-lint's
+# counter-discipline rule can prove registry ⇔ bump-site agreement.
+_stats = {k: 0 for k in lanes.JIT_COUNTERS}
 #: why searches left the compiled/collective path, by label
 #: (ineligible-shape / parse-error / refresh-race / device-error / …)
 _fallback_reasons: dict[str, int] = {}
@@ -339,6 +318,9 @@ _impact_fallback_reasons: dict[str, int] = {}
 #: why knn/hybrid requests left the compiled lane (the eager
 #: per-segment fallback served them), by label
 _knn_fallback_reasons: dict[str, int] = {}
+#: why fused-percolate dispatches fell to the per-query eager lane
+#: (breaker-open / device-error), by label
+_percolate_fallback_reasons: dict[str, int] = {}
 #: per-INDEX knn-lane accounting — feeds the per-index _stats
 #: "search.knn" section and the _cat/indices knn.* columns
 _knn_index_stats: dict[str, dict] = {}
@@ -376,19 +358,7 @@ def _bump(key: str, n: int = 1) -> None:
 # guards pin down: a one-segment refresh is `incremental` (uploads O(new
 # segment)), a delete-only refresh is `mask_only` (ZERO column bytes),
 # and only a cold/changed-layout build is a `full_rebuild`.
-_data_layer = {"bytes_uploaded": 0, "bytes_reused": 0,
-               "col_bytes_uploaded": 0, "mask_bytes_uploaded": 0,
-               "incremental_refreshes": 0, "full_rebuilds": 0,
-               "mask_only_refreshes": 0,
-               # impact-column traffic rides the same per-segment block
-               # cache: a refresh uploads impact bytes ONLY for segments
-               # that are new (or requantized) — resident segments count
-               # under impact_bytes_reused (tier-1 guard)
-               "impact_bytes_uploaded": 0, "impact_bytes_reused": 0,
-               # knn-lane vector columns ride the same per-segment block
-               # cache: a refresh uploads vector bytes ONLY for new
-               # segments (tier-1 guard); delete-only refreshes zero
-               "vector_bytes_uploaded": 0, "vector_bytes_reused": 0}
+_data_layer = {k: 0 for k in lanes.DATA_LAYER_COUNTERS}
 
 
 def cache_stats(node_id: str | None = None) -> dict:
@@ -406,6 +376,8 @@ def cache_stats(node_id: str | None = None) -> dict:
         out = {**_stats, "fallback_reasons": dict(_fallback_reasons),
                "impact_fallback_reasons": dict(_impact_fallback_reasons),
                "knn_fallback_reasons": dict(_knn_fallback_reasons),
+               "percolate_fallback_reasons":
+                   dict(_percolate_fallback_reasons),
                "data_layer": dict(_data_layer)}
     out["plane_breaker"] = plane_breaker.stats()
     return out
@@ -440,6 +412,7 @@ def note_mesh_program(hit: bool) -> None:
 
 def note_plane_fallback(reason: str) -> None:
     """One collective-plane admission decline, reason-labeled."""
+    lanes.check_reason("plane", reason)
     _attribution.label("fallback", reason)
     with _cache_lock:
         _bump("plane_fallbacks")
@@ -455,6 +428,9 @@ _logged_fallbacks: set = set()
 
 def note_fallback(exc: BaseException | None = None,
                   reason: str | None = None) -> None:
+    if reason is not None:
+        # compiled-path degradations share the plane vocabulary
+        lanes.check_reason("plane", reason)
     with _cache_lock:
         _bump("fallbacks")
         if reason is not None:
@@ -482,6 +458,7 @@ def clear_cache() -> None:
         _impact_index_stats.clear()
         _knn_fallback_reasons.clear()
         _knn_index_stats.clear()
+        _percolate_fallback_reasons.clear()
         _data_layer.update({k: 0 for k in _data_layer})
         _node_stats.clear()
         _node_fallback_reasons.clear()
@@ -1316,6 +1293,7 @@ def impact_plane_config(index_name: str | None) -> ImpactPlaneConfig | None:
 def note_impact_fallback(reason: str) -> None:
     """One impact-lane admission decline (the request proceeds on the
     exact scorer), reason-labeled like note_plane_fallback."""
+    lanes.check_reason("impact", reason)
     _attribution.label("impact_fallback", reason)
     with _cache_lock:
         _impact_fallback_reasons[reason] = \
@@ -1796,10 +1774,21 @@ def knn_plane_config(index_name: str | None) -> KnnPlaneConfig:
 def note_knn_fallback(reason: str) -> None:
     """One knn/hybrid request served by the eager per-segment fallback
     lane instead of the compiled program, reason-labeled."""
+    lanes.check_reason("knn", reason)
     _attribution.label("knn_fallback", reason)
     with _cache_lock:
         _knn_fallback_reasons[reason] = \
             _knn_fallback_reasons.get(reason, 0) + 1
+
+
+def note_percolate_fallback(reason: str) -> None:
+    """One fused-percolate dispatch served by the per-query eager lane
+    instead (breaker open / device error), reason-labeled like the
+    other lanes so the percolator's declines ride the same taxonomy."""
+    lanes.check_reason("percolate", reason)
+    with _cache_lock:
+        _percolate_fallback_reasons[reason] = \
+            _percolate_fallback_reasons.get(reason, 0) + 1
 
 
 def note_knn_served(index_name: str | None, n_requests: int,

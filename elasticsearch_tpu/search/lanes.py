@@ -1,0 +1,177 @@
+"""Lane-admission registry: the single source of truth for the four
+compiled serving lanes' fallback vocabularies, their pairwise decline
+edges, and the stats counters the lanes bump.
+
+Everything in this module is a PLAIN LITERAL on purpose: plane-lint's
+whole-program pass parses this file's AST (rule families
+``counter-discipline`` and ``fallback-taxonomy``) and the
+``estpu-lint --emit-lane-graph`` extractor emits it — together with the
+source locations of every admission predicate and reason-labeled
+decline site — as ``analysis/lane_graph.json``, the machine-readable
+lane model the unified-planner refactor (ROADMAP item 3) consumes. A
+tier-1 test (tests/test_lane_graph.py) round-trips the emitted graph
+against these live registries, so registry, runtime and artifact cannot
+drift apart.
+
+Runtime consumers:
+
+* :mod:`elasticsearch_tpu.search.jit_exec` initializes its ``_stats`` /
+  ``_data_layer`` counter stores from :data:`JIT_COUNTERS` /
+  :data:`DATA_LAYER_COUNTERS` (so every registered counter is surfaced
+  through ``cache_stats`` → ``_nodes/stats`` by construction) and
+  asserts every ``note_*_fallback`` reason against
+  :data:`LANE_REASONS`;
+* :mod:`elasticsearch_tpu.search.percolator` initializes each
+  registry's ``stats`` dict from :data:`PERCOLATE_COUNTERS`.
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# Counters: every key must be bumped somewhere (plane-lint
+# counter-discipline flags orphans in BOTH directions: a bump of an
+# unregistered key, and a registered key nothing bumps).
+# ---------------------------------------------------------------------------
+
+#: jit_exec._stats — the compiled-path program/cache/lane counters
+#: surfaced verbatim under ``_nodes/stats`` ``indices.jit``.
+JIT_COUNTERS = {
+    "hits": "per-segment program cache hits",
+    "misses": "per-segment program cache misses (one trace+compile)",
+    "fallbacks": "compiled-program executions degraded to eager",
+    "mesh_program_hits": "collective-plane program-layer cache hits",
+    "mesh_program_misses": "collective-plane program trace+compiles",
+    "plane_fallbacks": "collective-plane admission declines "
+                       "(request served by the RPC fan-out)",
+    "percolate_program_hits": "fused percolate lane program cache hits",
+    "percolate_program_misses": "fused percolate lane trace+compiles",
+    "breaker_open_skips": "requests the open plane breaker routed to "
+                          "the fan-out/eager path (zero dispatches)",
+    "oom_evictions": "HBM-OOM cold-block eviction sweeps",
+    "oom_bytes_evicted": "device-block bytes freed by OOM sweeps",
+    "impact_admissions": "requests served by the impact lane",
+    "impact_blocks_scored": "impact blocks scored by the block-max sweep",
+    "impact_blocks_skipped": "impact blocks skipped below the running "
+                             "theta (the sublinearity evidence)",
+    "impact_requant_refreshes": "impact requantizations forced by "
+                                "cross-segment df drift",
+    "knn_admissions": "requests served by the compiled knn lane",
+    "fusion_dispatches": "in-program hybrid fusion dispatches",
+    "maxsim_dispatches": "fused MaxSim dispatches over rank_vectors",
+}
+
+#: jit_exec._data_layer — incremental data-plane traffic accounting
+#: (surfaced under ``indices.jit.data_layer`` and the per-index /
+#: collective-plane mirrors).
+DATA_LAYER_COUNTERS = {
+    "bytes_uploaded": "host→device bytes (columns + live masks)",
+    "bytes_reused": "resident-block column bytes composed, not re-sent",
+    "col_bytes_uploaded": "column bytes uploaded",
+    "mask_bytes_uploaded": "live-mask bytes uploaded",
+    "incremental_refreshes": "rebuilds that uploaded O(new segment)",
+    "full_rebuilds": "cold / changed-layout full pack builds",
+    "mask_only_refreshes": "delete-only refreshes (zero column bytes)",
+    "impact_bytes_uploaded": "impact-column bytes uploaded",
+    "impact_bytes_reused": "resident impact-block bytes reused",
+    "vector_bytes_uploaded": "knn vector-column bytes uploaded",
+    "vector_bytes_reused": "resident vector-block bytes reused",
+}
+
+#: PercolatorRegistry.stats — per-index registry/evaluation counters
+#: (surfaced via the ``_stats`` percolate section and `_nodes/stats`).
+PERCOLATE_COUNTERS = {
+    "builds": "registry constructions from scratch",
+    "syncs": "metadata syncs that applied a change",
+    "adds": "query registrations",
+    "removes": "query unregistrations",
+    "bucket_invalidations": "shape buckets touched by syncs",
+    "mapper_rebuilds": "scratch MapperService rebuilds",
+    "count": "percolate ops (one per probe doc)",
+    "time_ms": "wall milliseconds in percolate ops",
+    "fused_queries": "query evaluations on the fused device lane",
+    "fallback_queries": "query evaluations on the per-query eager lane",
+    "breaker_skips": "fused dispatches the open breaker routed eager",
+}
+
+# ---------------------------------------------------------------------------
+# Fallback taxonomy: ONE registered reason vocabulary per lane.
+# note_plane_fallback / note_impact_fallback / note_knn_fallback /
+# note_percolate_fallback assert membership at runtime; plane-lint's
+# fallback-taxonomy rule checks every literal call site statically and
+# flags unknown, duplicated, and never-noted reasons.
+# ---------------------------------------------------------------------------
+
+LANE_REASONS = {
+    # collective plane (mesh) admission declines, search_action
+    "plane": (
+        "ineligible-shape",     # sort/agg/cursor shape the mesh can't serve
+        "parse-error",          # body failed the plane's re-parse
+        "refresh-race",         # pack vs fetch-reader generation raced twice
+        "device-error",         # mesh build/dispatch raised: eager rescue
+        "not-local",            # not every target shard lives on this node
+        "breaker-open",         # plane breaker open: zero-dispatch decline
+        "impact-preferred",     # ceded to the impact lane (decline edge)
+        "knn-lane",             # ceded to the vector lane (decline edge)
+    ),
+    # impact-ordered lane admission declines, phase._impact_batch_launch
+    "impact": (
+        "dfs-stats",            # DFS global idf vs reader-local impacts
+        "streamed-reader",      # non-resident segments can't pack impacts
+        "ineligible-shape",     # aggs/sort/rescore/... shape screen
+        "ineligible-cursor",    # search_after arity the lane can't resume
+        "ineligible-query",     # not an impact-scorable term disjunction
+        "mixed-fields",         # batch spans more than one impact field
+        "no-impact-columns",    # opted in but no segment built impacts
+        "cross-lane-cursor",    # cursor minted outside the quantized lane
+        "device-error",         # impact pack/dispatch raised: exact rescue
+    ),
+    # dense / late-interaction lane declines, phase._knn_batch_launch
+    "knn": (
+        "mixed-shapes",         # batch spans fields/modes/plan signatures
+        "streamed-reader",      # non-resident segments can't pack vectors
+        "no-vector-columns",    # mapped but no segment carries vectors
+        "device-error",         # vector pack/dispatch raised: eager rescue
+        "breaker-open",         # plane breaker open: straight to eager
+    ),
+    # fused percolate lane declines, percolator.PercolatorRegistry.run
+    "percolate": (
+        "device-error",         # fused dispatch raised: eager rescue
+        "breaker-open",         # plane breaker open: eager lane serves
+    ),
+}
+
+#: (declining lane, serving lane, reason the decliner labels): the
+#: pairwise admission-handoff edges the unified planner composes over.
+DECLINE_EDGES = (
+    # every target opted into the impact plane and every body is
+    # impact-scorable: the mesh cedes so block-max pruning serves it
+    ("plane", "impact", "impact-preferred"),
+    # a top-level knn section is served by the vector lane on the
+    # fan-out path; the mesh program has no vector/fusion lanes
+    ("plane", "knn", "knn-lane"),
+)
+
+#: lane → "pkg-relative module path::Qualname" of the admission
+#: predicate (the function whose declines bump that lane's reasons).
+#: The lane-graph extractor resolves these to file:line against the
+#: live tree, so a rename breaks the tier-1 round-trip loudly.
+LANE_ADMISSIONS = {
+    "plane": "elasticsearch_tpu/action/search_action.py"
+             "::SearchActions._try_collective_plane",
+    "impact": "elasticsearch_tpu/search/phase.py"
+              "::ShardSearcher._impact_batch_launch",
+    "knn": "elasticsearch_tpu/search/phase.py"
+           "::ShardSearcher._knn_batch_launch",
+    "percolate": "elasticsearch_tpu/search/percolator.py"
+                 "::PercolatorRegistry.run",
+}
+
+
+def check_reason(lane: str, reason: str) -> str:
+    """Assert-style guard the ``note_*_fallback`` seams call: an
+    unregistered reason is a programming error (the taxonomy is closed;
+    plane-lint checks literals statically, this catches dynamic ones)."""
+    assert reason in LANE_REASONS[lane], (
+        f"unregistered {lane}-lane fallback reason {reason!r} — add it "
+        f"to elasticsearch_tpu.search.lanes.LANE_REASONS[{lane!r}]")
+    return reason

@@ -57,6 +57,7 @@ from elasticsearch_tpu.index.device_reader import DeviceReader
 from elasticsearch_tpu.index.engine import SearcherView
 from elasticsearch_tpu.index.segment import SegmentBuilder
 from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.search import lanes
 from elasticsearch_tpu.search import query_dsl as q
 from elasticsearch_tpu.search.execute import (ConstTable, ExecutionContext,
                                               SegmentResolver)
@@ -156,19 +157,11 @@ class PercolatorRegistry:
     def __init__(self, meta):
         self.name = meta.name
         self.uuid = meta.uuid
-        self.stats = {
-            "builds": 1,                 # registry constructions from scratch
-            "syncs": 0,                  # syncs that applied a change
-            "adds": 0, "removes": 0,
-            "bucket_invalidations": 0,   # shape buckets touched by syncs
-            "mapper_rebuilds": 0,        # scratch MapperService rebuilds
-            "count": 0,                  # percolate ops (one per probe doc)
-            "time_ms": 0.0,
-            "fused_queries": 0,          # query evaluations on the fused lane
-            "fallback_queries": 0,       # ... on the per-query eager lane
-            "breaker_skips": 0,          # fused dispatches the open plane
-                                         # breaker routed to the eager lane
-        }
+        # keys (and meanings) live in the lane registry so plane-lint's
+        # counter-discipline rule can prove every surfaced key is bumped
+        self.stats = {k: 0 for k in lanes.PERCOLATE_COUNTERS}
+        self.stats["builds"] = 1         # this construction is the first
+        self.stats["time_ms"] = 0.0      # float accumulator
         self._lock = threading.RLock()
         self._snap: dict | None = None   # meta.percolators as last synced
         self._version = -1
@@ -470,6 +463,7 @@ class PercolatorRegistry:
             # every fused query on the eager lane instead of re-paying
             # the failing dispatch per percolate call
             jit_exec.note_breaker_skip()
+            jit_exec.note_percolate_fallback("breaker-open")
             with self._lock:
                 self.stats["breaker_skips"] += 1
             self._eager_rescue(items, per_item)
@@ -496,6 +490,7 @@ class PercolatorRegistry:
             except Exception as e:       # noqa: BLE001 — fallback seam
                 jit_exec.note_fallback(e, reason="device-error")
                 jit_exec.note_device_error(e)
+                jit_exec.note_percolate_fallback("device-error")
                 self._eager_rescue(items, per_item)
         # ---- per-item rendering ------------------------------------------
         results = []

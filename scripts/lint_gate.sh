@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Pre-PR static-analysis gate (see TESTING.md "Lint gate"):
+#
+#   1. full-tree plane-lint v2 (whole-program pass) with --json report;
+#   2. lane-graph emission (analysis/lane_graph.json must come out
+#      byte-identical to the committed artifact — the tier-1 round-trip
+#      test in tests/test_lane_graph.py enforces the same);
+#   3. a wall-clock budget assertion: the full-tree lint must finish in
+#      under 30 s on CPU, so the analyzer's own cost stays a tracked
+#      quantity (bench.py stamps the same number as `lint_wall_s`).
+#
+# Exit 0 only when the tree is clean, the graph is fresh, and the
+# budget holds.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+BUDGET_S="${LINT_BUDGET_S:-30}"
+REPORT="${LINT_REPORT:-/tmp/plane_lint_report.json}"
+GRAPH="elasticsearch_tpu/analysis/lane_graph.json"
+
+start=$(python -c 'import time; print(time.monotonic())')
+JAX_PLATFORMS=cpu python -m elasticsearch_tpu.analysis elasticsearch_tpu \
+    --json --emit-lane-graph "$GRAPH" > "$REPORT"
+end=$(python -c 'import time; print(time.monotonic())')
+
+wall=$(python -c "print(round($end - $start, 2))")
+open=$(python -c "import json; print(json.load(open('$REPORT'))['open'])")
+warn=$(python -c "import json; print(json.load(open('$REPORT'))['warnings'])")
+echo "lint_gate: ${open} open finding(s), ${warn} warning(s), ${wall}s wall"
+
+if [ "$open" != "0" ]; then
+    echo "lint_gate: FAIL — open findings (see $REPORT)" >&2
+    exit 1
+fi
+if ! git diff --quiet -- "$GRAPH"; then
+    echo "lint_gate: FAIL — $GRAPH changed; commit the regenerated" \
+         "lane graph" >&2
+    git --no-pager diff --stat -- "$GRAPH" >&2
+    exit 1
+fi
+python -c "import sys; sys.exit(0 if $wall < $BUDGET_S else 1)" || {
+    echo "lint_gate: FAIL — full-tree lint took ${wall}s" \
+         "(budget ${BUDGET_S}s)" >&2
+    exit 1
+}
+echo "lint_gate: OK (lane graph fresh, budget ${BUDGET_S}s held)"

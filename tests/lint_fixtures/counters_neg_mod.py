@@ -1,0 +1,38 @@
+"""Negative counter-discipline fixture module: stores built from the
+registry, literal bumps, conditional keys, dict-literal indirection,
+and a counted-at-construction key. Parsed, never imported."""
+
+import counters_neg_reg as reg
+
+_stats = {k: 0 for k in reg.FIX_COUNTERS}
+
+
+class Registry:
+    def __init__(self):
+        self.stats = {k: 0 for k in reg.FIX_COUNTERS}
+        self.stats["builds"] = 1              # counted at construction
+        self.stats["time_ms"] = 0.0           # float re-init: declaration
+
+    def tick(self, dt):
+        self.stats["time_ms"] += dt
+
+
+def _bump(key, n=1):
+    _stats[key] += n
+
+
+def serve(hit):
+    _bump("served")
+    _bump("hits" if hit else "misses")        # both branches registered
+
+
+def refresh(kind):
+    key = {"full": "rebuilds_full",
+           "incr": "rebuilds_incremental"}[kind]
+    _stats[key] += 1                          # dict-literal indirection
+
+
+def scratch(xs):
+    stats = {"put_wait_s": 0.0}               # function-local scratch dict:
+    stats["put_wait_s"] += len(xs)            # NOT a counter store
+    return stats

@@ -1,0 +1,12 @@
+"""Negative counter-discipline fixture registry. Parsed, never
+imported."""
+
+FIX_COUNTERS = {
+    "served": "requests served",
+    "hits": "cache hits",
+    "misses": "cache misses",
+    "rebuilds_full": "full rebuilds",
+    "rebuilds_incremental": "incremental rebuilds",
+    "builds": "constructions (counted at construction)",
+    "time_ms": "wall milliseconds",
+}

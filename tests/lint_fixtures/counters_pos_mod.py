@@ -1,0 +1,17 @@
+"""Positive counter-discipline fixture module: a hand-written literal
+store, a typo'd bump, and a dynamic key. Parsed, never imported."""
+
+_stats = {"served": 0, "typo_servd": 0}      # counter-unsurfaced: literal
+
+
+def _bump(key, n=1):
+    _stats[key] += n                          # forwarded param: exempt
+
+
+def serve():
+    _bump("served")
+    _bump("typo_servd")                       # counter-unregistered
+
+
+def debug_tap(key):
+    _bump(key)                                # counter-unregistered (dynamic)

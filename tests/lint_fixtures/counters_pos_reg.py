@@ -1,0 +1,7 @@
+"""Positive counter-discipline fixture registry. Parsed, never
+imported."""
+
+FIX_COUNTERS = {
+    "served": "requests served by the fixture lane",
+    "ghost_total": "registered but nothing ever bumps it",
+}

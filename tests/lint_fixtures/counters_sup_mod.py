@@ -1,0 +1,15 @@
+"""Suppressed counter-discipline fixture module. Parsed, never
+imported."""
+
+import counters_sup_reg as reg
+
+_stats = {k: 0 for k in reg.FIX_COUNTERS}
+
+
+def _bump(key, n=1):
+    _stats[key] += n
+
+
+def serve():
+    _bump("served")
+    _bump("scratch_probe")  # estpu: allow[counter-unregistered] local debugging tap, stripped before the metric lands in the registry

@@ -1,0 +1,6 @@
+"""Suppressed counter-discipline fixture registry. Parsed, never
+imported."""
+
+FIX_COUNTERS = {
+    "served": "requests served",
+}

@@ -1,0 +1,29 @@
+"""Negative fallback-taxonomy fixture module: literal reasons,
+conditional literals, a forwarding wrapper, and a reason-less
+note_fallback. Parsed, never imported."""
+
+
+def note_plane_fallback(reason):
+    pass
+
+
+def note_impact_fallback(reason):
+    pass
+
+
+def note_fallback(exc=None, reason=None):
+    pass
+
+
+def _note_plane_fallback(indices, reason):
+    note_plane_fallback(reason)                  # forwarded param: exempt
+
+
+def admit(ok, e):
+    _note_plane_fallback([], "ineligible-shape" if ok else "parse-error")
+    note_fallback(e)                             # no reason: fine
+    note_impact_fallback("dfs-stats")
+
+
+def rescue(e):
+    note_fallback(e, reason="device-error")

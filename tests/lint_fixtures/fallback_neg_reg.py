@@ -1,0 +1,7 @@
+"""Negative fallback-taxonomy fixture registry. Parsed, never
+imported."""
+
+LANE_REASONS = {
+    "plane": ("ineligible-shape", "parse-error", "device-error"),
+    "impact": ("dfs-stats",),
+}

@@ -1,0 +1,18 @@
+"""Positive fallback-taxonomy fixture module: an unknown reason and a
+dynamic one. Parsed, never imported."""
+
+
+def note_plane_fallback(reason):
+    pass
+
+
+def note_knn_fallback(reason):
+    pass
+
+
+def admit(req, label):
+    if req:
+        note_plane_fallback("ineligible-shape")
+    note_plane_fallback("not-registered")        # fallback-unknown-reason
+    note_knn_fallback(label)                     # fallback-unresolved-reason
+    note_knn_fallback("mixed-shapes")

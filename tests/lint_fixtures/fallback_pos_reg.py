@@ -1,0 +1,7 @@
+"""Positive fallback-taxonomy fixture registry: a duplicated reason and
+a dead one. Parsed, never imported."""
+
+LANE_REASONS = {
+    "plane": ("ineligible-shape", "ineligible-shape", "never-noted"),
+    "knn": ("mixed-shapes",),
+}

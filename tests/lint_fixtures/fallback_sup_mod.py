@@ -1,0 +1,11 @@
+"""Suppressed fallback-taxonomy fixture module. Parsed, never
+imported."""
+
+
+def note_plane_fallback(reason):
+    pass
+
+
+def admit():
+    note_plane_fallback("ineligible-shape")
+    note_plane_fallback("experimental-shape")  # estpu: allow[fallback-unknown-reason] staged rollout label — the registry entry lands with the lane PR

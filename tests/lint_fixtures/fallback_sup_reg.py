@@ -1,0 +1,6 @@
+"""Suppressed fallback-taxonomy fixture registry. Parsed, never
+imported."""
+
+LANE_REASONS = {
+    "plane": ("ineligible-shape",),
+}

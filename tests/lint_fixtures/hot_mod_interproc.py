@@ -1,0 +1,24 @@
+"""Interprocedural host-sync fixture: the per-iteration sync is hoisted
+into a helper — v1 saw only the loop body, v2 resolves the call and
+still flags it. Parsed, never imported."""
+
+import numpy as np
+
+
+def _drain_one(out):
+    return int(np.asarray(out))           # the hidden device→host sync
+
+
+def _shape_of(seg):
+    return len(seg)                       # no sync: resolved and ignored
+
+
+def run_batch(segments):
+    outs = []
+    fn = _get_compiled(("batch",))
+    for seg in segments:
+        device_fault_point("dispatch")
+        o = fn(seg)
+        _shape_of(seg)
+        outs.append(_drain_one(o))        # host-sync-hot-loop (v2)
+    return outs

@@ -1,0 +1,28 @@
+"""Interprocedural breaker fixture (module A): the charge's finally
+calls a cleanup helper in ANOTHER module that releases — v1 stopped at
+the function edge and flagged this; v2 follows the call graph. Parsed,
+never imported."""
+
+from interproc_breaker_b import drain_all
+
+
+class BlockCache:
+    def __init__(self, breaker):
+        self.breaker = breaker
+        self.used = 0
+
+    def reserve(self, n):
+        self.breaker.add_estimate(n)
+        self.used += n
+        try:
+            self.fill(n)
+        finally:
+            drain_all(self)               # cross-module release path
+
+    def fill(self, n):
+        pass
+
+
+def unpaired(breaker):
+    breaker.add_estimate(64)              # breaker-unreleased: no release
+    return 64                             # reachable anywhere from here

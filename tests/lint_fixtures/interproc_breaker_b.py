@@ -1,0 +1,11 @@
+"""Interprocedural breaker fixture (module B): the cleanup helper that
+actually releases. Parsed, never imported."""
+
+
+def drain_all(cache):
+    flush(cache)
+
+
+def flush(cache):
+    cache.breaker.release(cache.used)
+    cache.used = 0

@@ -1,0 +1,21 @@
+"""Interprocedural lock-order fixture (module A). Holding _a_lock,
+calls into module B whose call chain acquires _b_lock two hops down —
+module B holds the inverse order. v1's one-level resolution missed
+this pair; v2's call-graph closure reports it. Parsed, never
+imported."""
+
+import threading
+
+import interproc_locks_b as b
+
+_a_lock = threading.Lock()
+
+
+def hold_a_then_b():
+    with _a_lock:
+        b.step()                          # … → with _b_lock (two hops)
+
+
+def enter_a():
+    with _a_lock:
+        pass

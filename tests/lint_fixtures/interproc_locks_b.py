@@ -1,0 +1,30 @@
+"""Interprocedural lock-order fixture (module B): the inverse order,
+also through intermediate hops. Parsed, never imported."""
+
+import threading
+
+import interproc_locks_a as a
+
+_b_lock = threading.Lock()
+
+
+def step():
+    middle()
+
+
+def middle():
+    inner()
+
+
+def inner():
+    with _b_lock:
+        pass
+
+
+def hold_b_then_a():
+    with _b_lock:
+        chain()                           # … → a.enter_a() (two hops)
+
+
+def chain():
+    a.enter_a()

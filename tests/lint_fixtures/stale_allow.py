@@ -1,0 +1,23 @@
+"""Stale-suppression audit fixture: one allow that a real finding
+consumes (stays quiet) and one whose rule no longer fires on its line
+(reported allow-stale). Parsed, never imported."""
+
+import threading
+
+_cache_lock = threading.Lock()
+_cache = {}
+
+
+def locked_evict():
+    with _cache_lock:
+        _cache.pop("k", None)
+
+
+def racey_evict():
+    _cache.pop("k", None)  # estpu: allow[lock-unguarded-state] eviction races are benign here: the cache is re-fillable and entries are immutable
+
+
+def fine():
+    local = {}
+    local["k"] = 1  # estpu: allow[lock-unguarded-state] a local dict never needs the lock (this allow is dead weight)
+    return local

@@ -1,0 +1,41 @@
+"""Negative trace-purity fixtures: disciplined staged code — imports at
+module level, constant-table capture, nested scan bodies, helpers
+reached through the call graph, and HOST code (not trace-reachable)
+importing and printing freely. Parsed by the analyzer, never
+imported."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_TABLE = {"boost": 2.0}      # read-only everywhere: a constant, not state
+
+
+def helper(x):
+    return x + jnp.float32(_TABLE["boost"])
+
+
+def outer(x):
+    def scan_body(carry, el):
+        return carry + helper(el), ()
+    out, _ = jax.lax.scan(scan_body, x, jnp.arange(3))
+    return out
+
+
+@partial(jax.jit, static_argnums=0)
+def decorated(k, x):
+    return helper(x) * k
+
+
+fn = jax.jit(outer)
+
+
+def host_dispatch(xs):
+    """Host-side driver: imports, prints and mutation are all fine out
+    here — only TRACED bodies are policed."""
+    import json
+    print(json.dumps({"n": len(xs)}))
+    _TABLE_COPY = dict(_TABLE)
+    _TABLE_COPY["n"] = len(xs)
+    return [float(x) for x in xs]

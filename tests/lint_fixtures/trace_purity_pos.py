@@ -1,0 +1,62 @@
+"""Positive trace-purity fixtures: every staged function below commits
+one impurity class. ``pr10_trace_time_import`` is THE canonical bug,
+distilled from the real PR 10 incident: an ``import`` executed inside a
+``seam_jit``-staged body cached foreign tracers into the imported
+module's jnp globals — "compiled for N+3 inputs" under concurrent
+multi-shard searches. Parsed by the analyzer, never imported."""
+
+import jax
+import jax.numpy as jnp
+
+from elasticsearch_tpu.search.jit_exec import seam_jit
+
+_CACHE = {}                  # mutated below → mutable module state
+_TABLE = {"boost": 2.0}      # never mutated → constant, freely capturable
+
+
+def pr10_trace_time_import(x):
+    from elasticsearch_tpu.ops import blockmax       # trace-impure-import
+    return blockmax.impact_scores(x, x, x)
+
+
+def global_rebinding(x):
+    global _CACHE                                    # trace-impure-global
+    _CACHE = {}
+    return x
+
+
+def state_write(x):
+    _CACHE["last"] = 1                               # trace-impure-state-write
+    return x * jnp.float32(2.0)
+
+
+def side_effect(x):
+    print("tracing now")                             # trace-impure-call
+    return x + 1
+
+
+def closure_capture(x):
+    return x * len(_CACHE)                           # trace-impure-capture
+
+
+def helper_with_import(x):
+    import numpy                                     # trace-impure-import
+    return numpy.asarray(x)                          # (reached via call graph)
+
+
+def calls_helper(x):
+    return helper_with_import(x)
+
+
+def evict():
+    """Host-side maintenance: the mutation that makes _CACHE mutable
+    STATE rather than a constant table."""
+    _CACHE.pop("last", None)
+
+
+fn1 = seam_jit(pr10_trace_time_import)
+fn2 = jax.jit(global_rebinding)
+fn3 = jax.vmap(state_write)
+fn4 = seam_jit(side_effect)
+fn5 = jax.jit(closure_capture)
+fn6 = jax.jit(calls_helper)

@@ -1,0 +1,18 @@
+"""Suppressed trace-purity fixture: a reasoned allow on a deliberate
+trace-time tally. Parsed by the analyzer, never imported."""
+
+import jax
+
+_REGISTRY = {}
+
+
+def host_reset():
+    _REGISTRY.pop("trace_count", None)
+
+
+def audited(x):
+    _REGISTRY["trace_count"] = 1  # estpu: allow[trace-impure-state-write] build-time tally read only by the compile-budget test — tracing is single-threaded there
+    return x
+
+
+fn = jax.jit(audited)

@@ -1,13 +1,16 @@
-"""plane-lint (tier-1): the five rule families against fixture snippets,
-the tree-is-clean gate over ``elasticsearch_tpu/``, suppression
-mechanics, CLI/JSON output, and the runtime lock-order watchdog that
-cross-checks the static lock graph.
+"""plane-lint v2 (tier-1): the nine rule families against fixture
+snippets, the tree-is-clean gate over ``elasticsearch_tpu/``, the
+interprocedural upgrades (cross-module breaker release-reachability,
+transitive lock-order, callee host-sync), the stale-suppression audit,
+suppression mechanics, CLI/JSON output, and the runtime lock-order
+watchdog that cross-checks the static lock graph.
 
 Fixtures live under tests/lint_fixtures/ — they are PARSED by the
 analyzer, never imported. Each rule family has at least one positive
 (findings fire), one negative (clean), and one suppressed (reasoned
-allow) fixture; the *_regression functions are distilled from the real
-violations this PR fixed on the tree (see their docstrings).
+allow) fixture; the *_regression functions and the trace-purity
+positive fixture are distilled from REAL violations fixed on the tree
+(PR 7's twenty, PR 10's trace-time import — see their docstrings).
 """
 
 from __future__ import annotations
@@ -34,8 +37,22 @@ FIX_CFG = LintConfig(seam_modules=("*/seam_mod_*.py",),
                      hot_modules=("*/hot_mod_*.py",))
 
 
-def lint_fixture(name: str):
-    return lint_paths([str(FIXDIR / name)], FIX_CFG)
+def lint_fixture(*names, cfg=FIX_CFG, **kwargs):
+    return lint_paths([str(FIXDIR / n) for n in names], cfg, **kwargs)
+
+
+_TREE_RESULT = None
+
+
+def tree_result():
+    """One whole-program lint of elasticsearch_tpu/, shared by every
+    tree-wide assertion in this module (the v2 pass builds a full
+    symbol table + call graph — worth amortizing)."""
+    global _TREE_RESULT
+    if _TREE_RESULT is None:
+        _TREE_RESULT = lint_paths([str(REPO / "elasticsearch_tpu")],
+                                  DEFAULT_CONFIG)
+    return _TREE_RESULT
 
 
 def open_rules(result, *rule_ids):
@@ -51,7 +68,7 @@ def open_family(result, family):
 # ---------------------------------------------------------------------------
 
 def test_tree_is_clean():
-    result = lint_paths([str(REPO / "elasticsearch_tpu")], DEFAULT_CONFIG)
+    result = tree_result()
     assert result.errors == [], result.errors
     assert result.files > 100            # the whole package was scanned
     pretty = "\n".join(f.render() for f in result.unsuppressed)
@@ -66,7 +83,7 @@ def test_tree_breaker_pairing_is_clean():
     call site (common/breaker.py and its consumers): no unpaired charge
     and no suppression in the breaker family anywhere on the tree —
     DeviceFaultScheme.stop()/engine-close teardown paths all pair."""
-    result = lint_paths([str(REPO / "elasticsearch_tpu")], DEFAULT_CONFIG)
+    result = tree_result()
     fam = [f for f in result.findings
            if f.family == "breaker-discipline"]
     assert fam == [], "\n".join(f.render() for f in fam)
@@ -265,9 +282,221 @@ def test_spans_tree_every_site_class_is_covered():
     device_fault_point call on the real tree sits in scope of a
     matching device_span — zero open OR suppressed span findings (a
     suppression here would be a seam the tracer silently misses)."""
-    result = lint_paths([str(REPO / "elasticsearch_tpu")], DEFAULT_CONFIG)
+    result = tree_result()
     fam = [f for f in result.findings if f.family == "span-discipline"]
     assert fam == [], "\n".join(f.render() for f in fam)
+
+
+# ---------------------------------------------------------------------------
+# trace-purity (whole-program)
+# ---------------------------------------------------------------------------
+
+def test_trace_purity_positive():
+    """The PR 10 bug class, reintroduced in fixtures, is caught: the
+    trace-time import (direct AND through a call-graph hop), global
+    rebinding, module-state writes, side-effecting calls, and mutable
+    closure capture."""
+    r = lint_fixture("trace_purity_pos.py")
+    imports = open_rules(r, "trace-impure-import")
+    assert len(imports) == 2, "\n".join(f.render() for f in imports)
+    messages = " ".join(f.message for f in imports)
+    assert "pr10_trace_time_import" in messages     # the canonical repro
+    assert "helper_with_import" in messages         # reached via call graph
+    assert "calls_helper" in messages               # …with the trace path
+    assert len(open_rules(r, "trace-impure-global")) == 1
+    assert len(open_rules(r, "trace-impure-state-write")) == 1
+    capture = open_rules(r, "trace-impure-capture")
+    assert len(capture) == 1 and "_CACHE" in capture[0].message
+    assert len(open_rules(r, "trace-impure-call")) == 1
+
+
+def test_trace_purity_negative():
+    r = lint_fixture("trace_purity_neg.py")
+    assert open_family(r, "trace-purity") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+
+
+def test_trace_purity_suppressed():
+    r = lint_fixture("trace_purity_sup.py")
+    assert open_family(r, "trace-purity") == []
+    sup = [f for f in r.suppressed
+           if f.rule == "trace-impure-state-write"]
+    assert len(sup) == 1 and "tally" in sup[0].suppress_reason
+
+
+# ---------------------------------------------------------------------------
+# counter-discipline (whole-program)
+# ---------------------------------------------------------------------------
+
+CTR_CFG = LintConfig(counter_modules=("*/counters_*_mod.py",),
+                     counter_registry_modules=("*/counters_*_reg.py",),
+                     counter_registry_names=("FIX_COUNTERS",))
+
+
+def test_counters_positive():
+    r = lint_fixture("counters_pos_reg.py", "counters_pos_mod.py",
+                     cfg=CTR_CFG)
+    unreg = open_rules(r, "counter-unregistered")
+    assert len(unreg) == 2, "\n".join(f.render() for f in unreg)
+    messages = " ".join(f.message for f in unreg)
+    assert "typo_servd" in messages
+    assert "not statically resolvable" in messages
+    unbumped = open_rules(r, "counter-unbumped")
+    assert len(unbumped) == 1 and "ghost_total" in unbumped[0].message
+    assert unbumped[0].path.endswith("counters_pos_reg.py")
+    unsurfaced = open_rules(r, "counter-unsurfaced")
+    assert len(unsurfaced) == 1 and "_stats" in unsurfaced[0].message
+
+
+def test_counters_negative():
+    r = lint_fixture("counters_neg_reg.py", "counters_neg_mod.py",
+                     cfg=CTR_CFG)
+    assert open_family(r, "counter-discipline") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+
+
+def test_counters_suppressed():
+    r = lint_fixture("counters_sup_reg.py", "counters_sup_mod.py",
+                     cfg=CTR_CFG)
+    assert open_family(r, "counter-discipline") == []
+    sup = [f for f in r.suppressed if f.rule == "counter-unregistered"]
+    assert len(sup) == 1 and "debugging tap" in sup[0].suppress_reason
+
+
+def test_counters_skip_without_registry():
+    # a single-module run (no registry in scope) must not flag the world
+    r = lint_fixture("counters_pos_mod.py", cfg=CTR_CFG)
+    assert open_family(r, "counter-discipline") == []
+
+
+def test_tree_counter_discipline_is_clean():
+    """The acceptance orphan check on the REAL tree: every bump in
+    jit_exec/mesh_engine/percolator registered, every registered key
+    bumped, both stores built from the registry — zero findings, zero
+    suppressions."""
+    result = tree_result()
+    fam = [f for f in result.findings
+           if f.family == "counter-discipline"]
+    assert fam == [], "\n".join(f.render() for f in fam)
+
+
+# ---------------------------------------------------------------------------
+# fallback-taxonomy (whole-program)
+# ---------------------------------------------------------------------------
+
+FB_CFG = LintConfig(lane_registry_modules=("*/fallback_*_reg.py",))
+
+
+def test_fallback_positive():
+    r = lint_fixture("fallback_pos_reg.py", "fallback_pos_mod.py",
+                     cfg=FB_CFG)
+    unknown = open_rules(r, "fallback-unknown-reason")
+    assert len(unknown) == 1 and "not-registered" in unknown[0].message
+    unresolved = open_rules(r, "fallback-unresolved-reason")
+    assert len(unresolved) == 1
+    dup = open_rules(r, "fallback-duplicate-reason")
+    assert len(dup) == 1 and "ineligible-shape" in dup[0].message
+    unused = open_rules(r, "fallback-unused-reason")
+    assert len(unused) == 1 and "never-noted" in unused[0].message
+
+
+def test_fallback_negative():
+    r = lint_fixture("fallback_neg_reg.py", "fallback_neg_mod.py",
+                     cfg=FB_CFG)
+    assert open_family(r, "fallback-taxonomy") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+
+
+def test_fallback_suppressed():
+    r = lint_fixture("fallback_sup_reg.py", "fallback_sup_mod.py",
+                     cfg=FB_CFG)
+    assert open_family(r, "fallback-taxonomy") == []
+    sup = [f for f in r.suppressed
+           if f.rule == "fallback-unknown-reason"]
+    assert len(sup) == 1 and "rollout" in sup[0].suppress_reason
+
+
+def test_tree_fallback_taxonomy_is_clean():
+    """Every reason string on the real tree comes from the registered
+    per-lane vocabulary, every registered reason is noted somewhere —
+    zero findings, zero suppressions."""
+    result = tree_result()
+    fam = [f for f in result.findings
+           if f.family == "fallback-taxonomy"]
+    assert fam == [], "\n".join(f.render() for f in fam)
+
+
+# ---------------------------------------------------------------------------
+# interprocedural upgrades of the v1 families
+# ---------------------------------------------------------------------------
+
+def test_breaker_release_follows_calls_across_modules():
+    """finally → cross-module cleanup helper → release: v1 stopped at
+    the function edge; the v2 call graph proves the pairing. The
+    genuinely-unpaired charge in the same fixture still fires."""
+    r = lint_fixture("interproc_breaker_a.py", "interproc_breaker_b.py")
+    unreleased = open_rules(r, "breaker-unreleased")
+    assert len(unreleased) == 1, \
+        "\n".join(f.render() for f in unreleased)
+    assert "unpaired" in unreleased[0].message
+
+
+def test_lock_order_follows_calls_transitively():
+    """A→B through two call hops in one module, B→A through two hops in
+    the other: only the transitive closure sees the inverted pair."""
+    r = lint_fixture("interproc_locks_a.py", "interproc_locks_b.py")
+    order = open_rules(r, "lock-order")
+    assert any("potential deadlock" in f.message for f in order), \
+        "\n".join(f.render() for f in r.findings)
+
+
+def test_hostsync_follows_calls():
+    """The per-iteration sync hoisted into a helper is still flagged at
+    the loop call site."""
+    r = lint_fixture("hot_mod_interproc.py")
+    hot = open_rules(r, "host-sync-hot-loop")
+    assert len(hot) == 1, "\n".join(f.render() for f in r.findings)
+    assert "_drain_one" in hot[0].message
+    assert "transitively" in hot[0].message
+
+
+def test_streamed_suppression_is_statement_scoped_and_live():
+    """The run_segments_streamed backpressure sync is the tree's ONE
+    reasoned allow: re-verified against the interprocedural rule, still
+    consumed (not stale), and scoped to the exact statement — the rest
+    of the function stays policed."""
+    result = tree_result()
+    sup = result.suppressed
+    assert len(sup) == 1, "\n".join(f.render() for f in sup)
+    f = sup[0]
+    assert f.rule == "host-sync-hot-loop"
+    assert f.path.endswith("search/jit_exec.py")
+    assert "run_segments_streamed" in f.message
+    assert result.warnings == [], \
+        "\n".join(w.render() for w in result.warnings)  # nothing stale
+
+
+# ---------------------------------------------------------------------------
+# stale-suppression audit
+# ---------------------------------------------------------------------------
+
+def test_stale_allow_is_reported_as_warning():
+    r = lint_fixture("stale_allow.py")
+    # the live allow is consumed silently…
+    used = [f for f in r.suppressed if f.rule == "lock-unguarded-state"]
+    assert len(used) == 1
+    # …the dead one surfaces as a warning that does NOT fail the gate
+    stale = r.warnings
+    assert len(stale) == 1 and stale[0].rule == "allow-stale"
+    assert "lock-unguarded-state" in stale[0].message
+    assert r.unsuppressed == []
+
+
+def test_strict_suppressions_promotes_stale_to_finding():
+    r = lint_fixture("stale_allow.py", strict_suppressions=True)
+    stale = [f for f in r.unsuppressed if f.rule == "allow-stale"]
+    assert len(stale) == 1
+    assert r.warnings == []
 
 
 # ---------------------------------------------------------------------------
