@@ -245,6 +245,12 @@ def main() -> int:
     log(f"[bench] device: {dev.platform} ({dev})  corpus={n_docs} docs, "
         f"vocab={vocab}, k={k}, batch={batch}")
 
+    # telemetry baseline: one ring snapshot of the process-wide counters
+    # before any leg runs, so the end-of-run stamp reads honest windowed
+    # rates (delta over the whole run) instead of an empty window
+    from elasticsearch_tpu.observability import timeseries as _ts
+    _ts.tick("", force=True)
+
     rng = np.random.default_rng(1234)
     t0 = time.perf_counter()
     uterms, utf, lens, df, toks = make_corpus(
@@ -2068,6 +2074,33 @@ def main() -> int:
                         if k_ != "metric"},
                 },
             }
+
+    # live telemetry stamp: the HBM ledger's per-component/per-index
+    # occupancy (the BENCH_r06 chip capture reads device residency for
+    # free from here) plus end-of-run windowed rates per attributed
+    # node id ("_process" is unattributed module-level activity)
+    if os.environ.get("BENCH_TELEMETRY", "1") == "1":
+        try:
+            from elasticsearch_tpu.observability import ledger as _led
+            from elasticsearch_tpu.observability import (
+                histograms as _hist_mod)
+            tel_ids = sorted(set(_ts.node_ids()) |
+                             set(_hist_mod.node_ids()) | {""})
+            for nid in tel_ids:
+                _ts.tick(nid, force=True)
+            record["telemetry"] = {
+                "device_memory": _led.global_snapshot(),
+                "rates": {nid or "_process": _ts.rates(nid)
+                          for nid in tel_ids},
+            }
+            dm = record["telemetry"]["device_memory"]
+            log(f"[bench] telemetry: HBM ledger "
+                f"{dm['total_bytes']} bytes across {dm['entries']} "
+                f"entries; components "
+                + ", ".join(f"{c}={b}" for c, b in
+                            dm["by_component"].items() if b))
+        except Exception as e:         # noqa: BLE001 — bench must record
+            log(f"[bench] telemetry stamp failed ({e}); skipping")
 
     # analyzer cost is tracked like any other leg: stamp the wall time of
     # a full-tree plane-lint v2 run (whole-program pass) so regressions

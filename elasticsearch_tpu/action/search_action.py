@@ -1966,7 +1966,10 @@ class SearchActions:
             str(index.index_settings.get(
                 "index.search.plane_incremental", "true")).lower()
             not in ("false", "0") for index in indices)
-        charge = _PackCharge(bs, new_bytes if bs is not None else 0)
+        charge = _PackCharge(bs, new_bytes if bs is not None else 0,
+                             component="pack",
+                             index=",".join(index.name
+                                            for index in indices))
         charge.charge(f"mesh plane "
                       f"[{','.join(index.name for index in indices)}]")
         try:

@@ -46,10 +46,12 @@ RULE_FAMILIES = {
     "trace-impure-call": "trace-purity",
     "trace-impure-capture": "trace-purity",
     # counter-discipline: every bump registered, every registered key
-    # bumped, every store surfaced from the registry
+    # bumped, every store surfaced from the registry, every registry
+    # reachable from the /_prometheus exposition
     "counter-unregistered": "counter-discipline",
     "counter-unbumped": "counter-discipline",
     "counter-unsurfaced": "counter-discipline",
+    "counter-unexported": "counter-discipline",
     # fallback-taxonomy: one closed reason vocabulary per lane
     "fallback-unknown-reason": "fallback-taxonomy",
     "fallback-duplicate-reason": "fallback-taxonomy",
@@ -198,6 +200,11 @@ class LintConfig:
     counter_stores: tuple = ("_stats", "_data_layer", "stats")
     #: functions whose first argument is a counter key
     counter_bump_fns: tuple = ("_bump",)
+    #: the OpenMetrics exporter module(s): every registry dict must be
+    #: REFERENCED there (the exposition iterates the registries, so a
+    #: referenced registry exports every key by construction — and an
+    #: unreferenced one is a whole counter family invisible to scrapes)
+    exporter_modules: tuple = ("*/observability/openmetrics.py",)
 
     # ---- fallback-taxonomy (whole-program) -------------------------------
     #: reason-noting callables, by last name → lane whose vocabulary

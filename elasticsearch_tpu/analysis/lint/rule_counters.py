@@ -16,7 +16,13 @@ bumped somewhere — the two orphan directions:
 * ``counter-unsurfaced`` — a counter STORE in a counter module
   initialized from a hand-written dict literal instead of the registry
   (``{k: 0 for k in lanes.X}``): the store's keys and the registry
-  drift apart invisibly.
+  drift apart invisibly;
+* ``counter-unexported`` — a registry dict the OpenMetrics exporter
+  module never references: the exposition is registry-DRIVEN (it
+  iterates each registry, so a referenced registry exports every key
+  by construction), which makes an unreferenced registry a whole
+  counter family invisible to ``/_prometheus/metrics``. Skipped when
+  no exporter module is in the linted set (fixture runs).
 
 Bump recognition: AugAssign on a store subscript, a positive-constant
 Assign (``stats["builds"] = 1`` — counted at construction), and
@@ -222,9 +228,46 @@ def check_program(program, cfg) -> list:
     for ctx, findings, nodes in by_ctx.values():
         out.extend(apply_suppressions(ctx, findings, nodes))
 
-    # ---- the reverse orphan: registered but never bumped -----------------
+    # ---- the exporter orphan: registered but never exported --------------
     reg_by_path = {ctx.relpath: ctx for ctx in
                    program.registry_contexts(cfg.counter_registry_modules)}
+    exporter_ctxs = [ctx for ctx in program.contexts
+                     if module_matches(ctx.relpath, cfg.exporter_modules)]
+    if exporter_ctxs:
+        referenced: set = set()
+        for ctx in exporter_ctxs:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Attribute):
+                    referenced.add(node.attr)
+                elif isinstance(node, ast.Name):
+                    referenced.add(node.id)
+        reg_lines: dict = {}
+        for key, (name, relpath, line) in registry.items():
+            cur = reg_lines.get(name)
+            if cur is None or line < cur[1]:
+                reg_lines[name] = (relpath, line)
+        for name in sorted(reg_lines):
+            if name in referenced:
+                continue
+            relpath, line = reg_lines[name]
+            f = Finding(
+                "counter-unexported", relpath, line,
+                f"registry [{name}] is never referenced by the "
+                f"OpenMetrics exporter "
+                f"({', '.join(c.relpath for c in exporter_ctxs)}) — "
+                f"its whole counter family is invisible to "
+                f"/_prometheus/metrics; iterate it in the exposition "
+                f"so every key exports by construction")
+            ctx = reg_by_path.get(relpath)
+            if ctx is not None:
+                for ln in (line - 1, line):
+                    for rid, reason in ctx.suppressions.get(ln, ()):
+                        if rid == f.rule and reason:
+                            ctx.used_suppressions.add((ln, rid))
+                            f.suppressed, f.suppress_reason = True, reason
+            out.append(f)
+
+    # ---- the reverse orphan: registered but never bumped -----------------
     for key, (name, relpath, line) in sorted(registry.items()):
         if key in bumped:
             continue

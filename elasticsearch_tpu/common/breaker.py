@@ -89,15 +89,34 @@ class OneShotCharge:
     fires first (supersession, cache eviction, engine/index close). Device-
     resident artifacts with several owners — the collective plane's stacked
     packs and per-segment blocks — hang their breaker accounting on this so
-    competing teardown paths cannot double-release or strand bytes."""
+    competing teardown paths cannot double-release or strand bytes.
 
-    __slots__ = ("breaker_service", "breaker_name", "nbytes")
+    Every fielddata charge also records in the breaker service's
+    device-memory ledger (observability/ledger.py) — tagged with its
+    component / index / engine / block identity when the site passes
+    one, under ``untracked`` otherwise — so the ledger's charged total
+    reconciles with ``fielddata.used`` BY CONSTRUCTION: there is no way
+    to reserve HBM budget through this class without a ledger row."""
+
+    __slots__ = ("breaker_service", "breaker_name", "nbytes",
+                 "_ledger_meta", "_ledger_token")
 
     def __init__(self, breaker_service, nbytes: int,
-                 breaker_name: str = "fielddata"):
+                 breaker_name: str = "fielddata", *,
+                 component: str = "untracked", index: str = "",
+                 engine_uuid: str = "", block_id=None,
+                 parts: dict | None = None):
         self.breaker_service = breaker_service
         self.breaker_name = breaker_name
         self.nbytes = int(nbytes)
+        self._ledger_meta = (component, index, engine_uuid, block_id,
+                             parts)
+        self._ledger_token = None
+
+    def _ledger(self):
+        if self.breaker_name != "fielddata":
+            return None          # the ledger books HBM residency only
+        return getattr(self.breaker_service, "device_ledger", None)
 
     def charge(self, label: str = "<unknown>") -> "OneShotCharge":
         """Reserve the budget (raises CircuitBreakingError on overflow —
@@ -105,17 +124,46 @@ class OneShotCharge:
         if self.breaker_service is not None and self.nbytes:
             self.breaker_service.breaker(self.breaker_name).add_estimate(
                 self.nbytes, label)
+            led = self._ledger()
+            if led is not None:
+                comp, index, engine_uuid, block_id, parts = \
+                    self._ledger_meta
+                self._ledger_token = led.record(
+                    self.nbytes, component=comp, index=index,
+                    engine_uuid=engine_uuid, block_id=block_id,
+                    parts=parts)
         return self
+
+    def touch(self) -> None:
+        """Refresh the ledger's last-access stamp (a cache hit on the
+        charged artifact — the /_cat/hbm hot/cold recency signal)."""
+        if self._ledger_token is not None:
+            led = self._ledger()
+            if led is not None:
+                led.touch(self._ledger_token)
 
     def release(self) -> None:
         bs, n = self.breaker_service, self.nbytes
         self.nbytes = 0
         if bs is not None and n:
             bs.breaker(self.breaker_name).release(n)
+            token, self._ledger_token = self._ledger_token, None
+            if token is not None:
+                led = self._ledger()
+                if led is not None:
+                    led.forget(token)
 
 
 class HierarchyCircuitBreakerService:
     def __init__(self, settings: Settings = Settings.EMPTY):
+        # the per-node device-memory ledger: every fielddata reservation
+        # (OneShotCharge / ledger.account_absolute) records a row here,
+        # so `device_ledger.total_bytes()` reconciles bit-exactly with
+        # breaker("fielddata").used (lazy import: observability pulls in
+        # the task manager, which must not load under this module)
+        from elasticsearch_tpu.observability.ledger import \
+            DeviceMemoryLedger
+        self.device_ledger = DeviceMemoryLedger()
         total = _parse_limit(settings.get("indices.breaker.total.limit"),
                              DEFAULT_TOTAL)
         self.total_limit = total

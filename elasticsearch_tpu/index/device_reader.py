@@ -373,12 +373,14 @@ def device_reader_for(engine, view: SearcherView | None = None,
         new_bytes = resident_prefix_bytes(view, budget)
         old_bytes = getattr(cached, "_accounted_bytes", 0) if cached else 0
         if bs is not None:
-            fd = bs.breaker("fielddata")
-            if new_bytes > old_bytes:
-                fd.add_estimate(new_bytes - old_bytes,
-                                f"segments gen {view.generation}")
-            else:
-                fd.release(old_bytes - new_bytes)
+            # delta accounting rides the device-memory ledger so the
+            # reader's resident columns appear in _nodes/stats
+            # .device_memory / _cat/hbm next to the block-cache charges
+            from elasticsearch_tpu.observability.ledger import \
+                account_absolute
+            account_absolute(bs, engine.engine_uuid, "reader-columns",
+                             old_bytes, new_bytes,
+                             f"segments gen {view.generation}")
         if cached is not None:
             # the retiring generation's filter-cache counters fold into a
             # cumulative per-engine tally — ES cache stats survive reader
@@ -417,7 +419,10 @@ def release_device_reader(engine) -> None:
         cached = getattr(engine, "_device_reader_cache", None)
         bs = getattr(engine, "breaker_service", None)
         if cached is not None and bs is not None:
-            bs.breaker("fielddata").release(
-                getattr(cached, "_accounted_bytes", 0))
+            from elasticsearch_tpu.observability.ledger import \
+                account_absolute
+            account_absolute(bs, engine.engine_uuid, "reader-columns",
+                             getattr(cached, "_accounted_bytes", 0), 0,
+                             "reader close")
         if cached is not None:
             engine._device_reader_cache = None

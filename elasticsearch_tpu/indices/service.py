@@ -770,7 +770,7 @@ class IndicesService:
         """Recovery-executor body: run the peer-recovery hook, then report
         started (or failed) to the master via the Node's callbacks."""
         from elasticsearch_tpu.indices.recovery import DelayRecoveryError
-        t0 = time.time()
+        t0 = time.monotonic()           # duration measurement, not epoch
         try:
             if self.prepare_shard is not None:
                 self.prepare_shard(s, engine)
@@ -834,7 +834,7 @@ class IndicesService:
             snapshot = meta.settings.get("index.restore.snapshot", "n/a")
         self.recovery_records.append({
             "index": s.index, "shard": s.shard,
-            "time_ms": max(int((time.time() - t0) * 1000), 1),
+            "time_ms": max(int((time.monotonic() - t0) * 1000), 1),
             "type": rtype,
             "stage": "done",
             "source_host": node_name(source),
@@ -936,7 +936,7 @@ class IndicesService:
                 aliases={a: normalize_alias(v)
                          for a, v in body.get("aliases", {}).items()},
                 warmers=dict(body.get("warmers", {})),
-                creation_date=int(time.time() * 1000),
+                creation_date=int(time.time() * 1000),  # wall-clock ok
                 uuid=uuid.uuid4().hex[:22])
             new = state.with_(
                 indices={**state.indices, name: meta},

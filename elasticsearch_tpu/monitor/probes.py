@@ -10,18 +10,21 @@ import os
 import resource
 import time
 
-_START = time.time()
+# uptime is a DURATION — measured on the monotonic clock so an NTP step
+# can never report negative (or wildly wrong) uptime; the `timestamp`
+# fields below stay wall-clock (epoch millis is their contract)
+_START_MONO = time.monotonic()
 
 
 def process_stats() -> dict:
     ru = resource.getrusage(resource.RUSAGE_SELF)
     return {
-        "timestamp": int(time.time() * 1000),
+        "timestamp": int(time.time() * 1000),    # wall-clock ok: epoch
         "id": os.getpid(),
         "open_file_descriptors": _open_fds(),
         "cpu": {"total_in_millis": int((ru.ru_utime + ru.ru_stime) * 1000)},
         "mem": {"resident_in_bytes": ru.ru_maxrss * 1024},
-        "uptime_in_millis": int((time.time() - _START) * 1000),
+        "uptime_in_millis": int((time.monotonic() - _START_MONO) * 1000),
     }
 
 
@@ -33,7 +36,7 @@ def _open_fds() -> int:
 
 
 def os_stats() -> dict:
-    out = {"timestamp": int(time.time() * 1000)}
+    out = {"timestamp": int(time.time() * 1000)}  # wall-clock ok: epoch
     try:
         load1, load5, load15 = os.getloadavg()
         out["cpu"] = {"load_average": {"1m": round(load1, 2),

@@ -134,6 +134,10 @@ class Node:
         from elasticsearch_tpu.common.breaker import (
             HierarchyCircuitBreakerService)
         self.breaker_service = HierarchyCircuitBreakerService(self.settings)
+        # SLO targets (observability.slo.* settings) — installed once so
+        # the histogram seam classifies good/bad from the first event
+        from elasticsearch_tpu.observability import slo as _slo
+        _slo.configure(self.node_id, self.settings)
         self.indices_service = IndicesService(self.data_path,
                                               self.cluster_service,
                                               self.node_id,
@@ -893,12 +897,31 @@ class Node:
         heap = ps["mem"]["resident_in_bytes"]
         total_mem = osx.get("mem", {}).get("total_in_bytes", heap or 1)
         from elasticsearch_tpu.observability import histograms as _hist
+        from elasticsearch_tpu.observability import slo as _slo
+        from elasticsearch_tpu.observability import timeseries as _ts
         from elasticsearch_tpu.observability import tracing as _tracing
+        # every stats read advances the telemetry ring (throttled), so
+        # the windowed sections below always reflect this scrape
+        self.telemetry_tick()
+        rates_doc = _ts.rates(self.node_id)
+        rates_doc["slo_burn"] = _slo.windowed_burn(self.node_id,
+                                                   rates_doc)
         return {
             "name": self.node_name,
             "timestamp": int(time.time() * 1000),
             "indices": indices_total,
             "breakers": self.breaker_service.stats(),
+            # the device-memory ledger: every HBM reservation on this
+            # node keyed (index, engine, component), reconciling with
+            # breakers.fielddata.estimated_size_in_bytes
+            "device_memory": self.breaker_service.device_ledger.snapshot(
+                resolve_index=self.resolve_engine_index),
+            # rolling-window rates + windowed percentiles (1m/5m/15m)
+            # from the telemetry ring, plus per-window SLO burn rates
+            "rates": rates_doc,
+            # SLO burn accounting: objective, per-lane good/bad totals,
+            # cumulative burn rate
+            "slo": _slo.stats(self.node_id),
             "thread_pool": pools,
             "tasks": self.task_manager.stats(),
             # adaptive replica selection: per-target-node C3 ranks/EWMAs
@@ -1100,8 +1123,13 @@ class Node:
         trace_id = request.get("trace_id")
         spans = tracing.spans_for(self.node_id, trace_id) if trace_id \
             else tracing.all_spans(self.node_id)
+        from elasticsearch_tpu.observability import timeseries
         return {"name": self.node_name, "spans": spans,
-                "stats": tracing.store_stats(self.node_id)}
+                "stats": tracing.store_stats(self.node_id),
+                # the telemetry ring's samples ride along so the Chrome
+                # export can draw per-node counter tracks (ledger bytes,
+                # lane counts) under the span timeline
+                "counters": timeseries.ring_samples(self.node_id)}
 
     def collect_trace(self, trace_id: str) -> dict:
         """GET /_tasks/{id}/trace — gather one trace's spans from every
@@ -1126,12 +1154,44 @@ class Node:
         trace) as a Chrome Trace Event Format document for offline
         viewing in chrome://tracing / Perfetto."""
         from elasticsearch_tpu.observability import chrome
+        self.telemetry_tick()            # the export's final sample
         per_node = self._fan_out_nodes(
             self.TRACE_COLLECT_ACTION,
             {"trace_id": trace_id} if trace_id else {})
         spans = [s for doc in per_node.values() for s in doc["spans"]]
         spans.sort(key=lambda s: s["start_us"])
-        return chrome.chrome_trace(spans)
+        counters = {nid: doc.get("counters") or []
+                    for nid, doc in per_node.items()}
+        return chrome.chrome_trace(spans, counters=counters)
+
+    # ---- live telemetry plane (observability/{ledger,timeseries}) ---------
+
+    def resolve_engine_index(self, engine_uuid: str) -> str | None:
+        """engine uuid → index name, for ledger rows whose charge site
+        didn't know the index (the block cache keys by engine only)."""
+        for name, svc in self.indices_service.indices.items():
+            for engine in svc.engines.values():
+                if engine.engine_uuid == engine_uuid:
+                    return name
+        return None
+
+    def telemetry_tick(self, force: bool = False) -> bool:
+        """Snapshot this node's cumulative counters into the timeseries
+        ring (scrape-driven and throttled: search hot paths never pay
+        for windowing). Hedge counters ride as extra series next to the
+        lane/jit/slo/ledger sample."""
+        from elasticsearch_tpu.observability import timeseries
+        extra = {}
+        try:
+            for k, v in self.search_actions.replica_stats.hedge_stats() \
+                    .items():
+                if isinstance(v, (int, float)):
+                    extra[f"hedge.{k}"] = v
+        except Exception:                # noqa: BLE001 — pre-start tick
+            pass
+        return timeseries.tick(
+            self.node_id, extra=extra,
+            ledger=self.breaker_service.device_ledger, force=force)
 
     def collect_hot_threads(self, **params) -> str:
         per_node = self._fan_out_nodes(self.HOT_THREADS_ACTION, params)

@@ -1,5 +1,7 @@
 """Observability: span tracing, per-lane latency histograms, slow-log
-attribution, Chrome-trace export.
+attribution, Chrome-trace export — and the live telemetry plane: the
+device-memory ledger, rolling-window metrics, OpenMetrics export and
+SLO burn accounting.
 
 Submodules (import what you feed, re-exported here for convenience):
 
@@ -8,18 +10,31 @@ Submodules (import what you feed, re-exported here for convenience):
   on the task parent-link seams, per-node stores, device-seam spans.
 * :mod:`~elasticsearch_tpu.observability.histograms` — always-on
   fixed-bucket latency histograms per lane per node (``_nodes/stats``).
+* :mod:`~elasticsearch_tpu.observability.ledger` — the device-memory
+  ledger: every HBM reservation in one per-node table keyed (index,
+  engine uuid, component, block), reconciling bit-exactly with the
+  fielddata breaker (``_nodes/stats.device_memory``, ``/_cat/hbm``).
+* :mod:`~elasticsearch_tpu.observability.timeseries` — ring-buffered
+  snapshots turning cumulative counters into 1m/5m/15m rates and the
+  histograms into windowed percentiles (``_nodes/stats.rates``).
+* :mod:`~elasticsearch_tpu.observability.slo` — per-lane latency /
+  queue-time SLO targets, good/bad counters, burn rates.
+* :mod:`~elasticsearch_tpu.observability.openmetrics` — the
+  ``/_prometheus/metrics`` exposition, generated FROM the lane
+  registry (imported lazily by the REST handler — it pulls in
+  ``search.lanes``, which this package must not import at load time).
 * :mod:`~elasticsearch_tpu.observability.attribution` — per-request
   plane attribution for slow-log lines.
 * :mod:`~elasticsearch_tpu.observability.chrome` — Trace Event Format
-  export for chrome://tracing / Perfetto.
+  export for chrome://tracing / Perfetto (spans + counter tracks).
 * :mod:`~elasticsearch_tpu.observability.context` — node attribution
   (which node's books an event lands on).
 """
 
 from elasticsearch_tpu.observability import (  # noqa: F401
-    attribution, chrome, histograms, tracing)
+    attribution, chrome, histograms, ledger, slo, timeseries, tracing)
 from elasticsearch_tpu.observability.context import (  # noqa: F401
     current_node_id, use_node)
 
-__all__ = ["attribution", "chrome", "histograms", "tracing",
-           "current_node_id", "use_node"]
+__all__ = ["attribution", "chrome", "histograms", "ledger", "slo",
+           "timeseries", "tracing", "current_node_id", "use_node"]

@@ -24,6 +24,7 @@ from __future__ import annotations
 import bisect
 import threading
 
+from elasticsearch_tpu.observability import slo
 from elasticsearch_tpu.observability.context import current_node_id
 
 #: log-spaced bucket upper bounds in ms: 0.01 ms → ~650 s, ×√2 per step.
@@ -110,9 +111,47 @@ def _hist(node_id: str, lane: str) -> LatencyHistogram:
 
 def observe_lane(lane: str, ms: float, node_id: str | None = None) -> None:
     """Record one latency sample on ``lane`` for the current node (or an
-    explicit ``node_id``)."""
+    explicit ``node_id``), and classify it against the node's SLO target
+    (slo.py) — the same seam feeds both books so they cannot drift."""
     nid = node_id if node_id is not None else (current_node_id() or "")
     _hist(nid, lane).observe(ms)
+    slo.observe(lane, ms, nid)
+
+
+def percentile_from_counts(counts, q: float) -> float:
+    """Bucket-resolved percentile over a raw count vector (the windowed
+    DELTA between two snapshots of one histogram's buckets) — the same
+    interpolation as :meth:`LatencyHistogram.percentile`, minus the
+    observed-max cap (deltas carry no max)."""
+    total = sum(c for c in counts if c > 0)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= target:
+            lo = BOUNDS_MS[i - 1] if i > 0 else 0.0
+            hi = BOUNDS_MS[i] if i < len(BOUNDS_MS) else BOUNDS_MS[-1]
+            frac = (target - cum) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        cum += c
+    return BOUNDS_MS[-1]
+
+
+def bucket_counts(node_id: str) -> dict:
+    """{lane: (bucket counts tuple, count, sum_ms, max_ms)} — the raw
+    cumulative vectors the timeseries ring snapshots for windowed
+    percentiles. Only lanes with observations appear (an idle node
+    snapshots an empty dict, not |LANES| zero vectors)."""
+    with _reg_lock:
+        lanes = dict(_registry.get(node_id, {}))
+    out = {}
+    for lane, h in sorted(lanes.items()):
+        with h._lock:
+            out[lane] = (tuple(h.counts), h.count, h.sum_ms, h.max_ms)
+    return out
 
 
 def summaries(node_id: str) -> dict:

@@ -1,0 +1,213 @@
+"""OpenMetrics exporter — ``GET /_prometheus/metrics``, generated FROM
+the lane registry so every registered counter is exported by
+construction.
+
+The exposition is registry-driven on purpose: the counter families
+iterate :data:`lanes.JIT_COUNTERS` / :data:`lanes.DATA_LAYER_COUNTERS` /
+:data:`lanes.PERCOLATE_COUNTERS` and the fallback families zero-fill
+from :data:`lanes.LANE_REASONS`, so adding a counter to the registry
+adds it to the scrape with no exporter edit — and plane-lint's
+``counter-unexported`` rule (rule_counters.py) statically verifies this
+module references every registry dict, with a tier-1 round-trip test
+asserting each registered key appears in the rendered text.
+
+Families (``estpu_`` namespace, all values cumulative unless gauge):
+
+* ``estpu_jit_<counter>_total`` — the compiled-path counters (process-
+  global: in-process nodes share one device);
+* ``estpu_data_layer_<counter>_total`` — incremental data-plane traffic;
+* ``estpu_percolate_<counter>_total{index=}`` — per-registry counters;
+* ``estpu_lane_fallbacks_total{lane=,reason=}`` — the closed decline
+  taxonomy, every registered reason present (0 until first decline);
+* ``estpu_lane_latency_ms`` — per-lane histograms (bucket/_count/_sum);
+* ``estpu_device_memory_bytes{component=,index=}`` — ledger gauges;
+* ``estpu_breaker_*`` — breaker occupancy/limit/trip gauges;
+* ``estpu_slo_*`` — good/bad counters, target and burn-rate gauges.
+
+Rendering allocates only on the scrape path; nothing here runs during
+request serving.
+"""
+
+from __future__ import annotations
+
+from elasticsearch_tpu.observability import histograms, slo
+from elasticsearch_tpu.search import lanes
+
+
+def _sanitize(value: str) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", " ")
+
+
+def _fmt(value) -> str:
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, float):
+        return repr(round(value, 6))
+    return str(int(value))
+
+
+class _Writer:
+    def __init__(self):
+        self.lines: list = []
+
+    def family(self, name: str, mtype: str, help_: str) -> None:
+        self.lines.append(f"# HELP {name} {_sanitize(help_)}")
+        self.lines.append(f"# TYPE {name} {mtype}")
+
+    def sample(self, name: str, labels: dict | None, value) -> None:
+        if labels:
+            body = ",".join(f'{k}="{_sanitize(v)}"'
+                            for k, v in labels.items())
+            self.lines.append(f"{name}{{{body}}} {_fmt(value)}")
+        else:
+            self.lines.append(f"{name} {_fmt(value)}")
+
+    def render(self) -> str:
+        return "\n".join(self.lines) + "\n# EOF\n"
+
+
+def render(node_id: str, jit_stats: dict, percolate_stats: dict,
+           ledger_snapshot: dict, breaker_stats: dict,
+           node_name: str = "") -> str:
+    """One node's scrape document. The caller (rest handler / tests)
+    passes the already-collected stats dicts so rendering stays a pure
+    function of its inputs."""
+    w = _Writer()
+    w.family("estpu_build_info", "gauge",
+             "constant 1, labeled with the scraped node")
+    w.sample("estpu_build_info",
+             {"node": node_id, "name": node_name}, 1)
+
+    # ---- lane-registry counters (registry-driven by construction) ------
+    for key, help_ in lanes.JIT_COUNTERS.items():
+        name = f"estpu_jit_{key}_total"
+        w.family(name, "counter", help_)
+        w.sample(name, None, jit_stats.get(key, 0))
+    data_layer = jit_stats.get("data_layer", {})
+    for key, help_ in lanes.DATA_LAYER_COUNTERS.items():
+        name = f"estpu_data_layer_{key}_total"
+        w.family(name, "counter", help_)
+        w.sample(name, None, data_layer.get(key, 0))
+    for key, help_ in lanes.PERCOLATE_COUNTERS.items():
+        name = f"estpu_percolate_{key}_total"
+        w.family(name, "counter", help_)
+        if percolate_stats:
+            for index, st in percolate_stats.items():
+                w.sample(name, {"index": index}, st.get(key, 0))
+        else:
+            w.sample(name, {"index": "_none"}, 0)
+
+    # ---- fallback taxonomy (zero-filled from the closed vocabulary) ----
+    w.family("estpu_lane_fallbacks_total", "counter",
+             "lane admission declines by (lane, registered reason)")
+    reason_counts = {
+        "plane": jit_stats.get("fallback_reasons", {}),
+        "impact": jit_stats.get("impact_fallback_reasons", {}),
+        "knn": jit_stats.get("knn_fallback_reasons", {}),
+        "percolate": jit_stats.get("percolate_fallback_reasons", {}),
+    }
+    for lane, reasons in lanes.LANE_REASONS.items():
+        counts = reason_counts.get(lane, {})
+        for reason in reasons:
+            w.sample("estpu_lane_fallbacks_total",
+                     {"lane": lane, "reason": reason},
+                     counts.get(reason, 0))
+
+    # ---- latency histograms (per lane, OpenMetrics cumulative-le) ------
+    w.family("estpu_lane_latency_ms", "histogram",
+             "per-lane latency distribution (fixed sqrt2 buckets)")
+    for lane, (counts, count, sum_ms, _mx) in \
+            histograms.bucket_counts(node_id).items():
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            le = f"{histograms.BOUNDS_MS[i]:.6g}" \
+                if i < len(histograms.BOUNDS_MS) else "+Inf"
+            w.sample("estpu_lane_latency_ms_bucket",
+                     {"lane": lane, "le": le}, cum)
+        w.sample("estpu_lane_latency_ms_count", {"lane": lane}, count)
+        w.sample("estpu_lane_latency_ms_sum", {"lane": lane},
+                 round(sum_ms, 3))
+
+    # ---- device-memory ledger gauges -----------------------------------
+    w.family("estpu_device_memory_bytes", "gauge",
+             "HBM-resident bytes by (component, index) — the ledger")
+    for index, idx in ledger_snapshot.get("indices", {}).items():
+        for comp, b in sorted(idx["components"].items()):
+            w.sample("estpu_device_memory_bytes",
+                     {"component": comp, "index": index}, b)
+    w.family("estpu_device_memory_total_bytes", "gauge",
+             "total ledger bytes (charged + uncharged)")
+    w.sample("estpu_device_memory_total_bytes", None,
+             ledger_snapshot.get("total_bytes", 0))
+    w.family("estpu_device_memory_charged_bytes", "gauge",
+             "ledger bytes reconciling with the fielddata breaker")
+    w.sample("estpu_device_memory_charged_bytes", None,
+             ledger_snapshot.get("charged_bytes", 0))
+
+    # ---- plane breaker (device health) ----------------------------------
+    pb = jit_stats.get("plane_breaker", {})
+    if pb:
+        w.family("estpu_plane_breaker_state", "gauge",
+                 "0=closed 1=half-open 2=open")
+        w.sample("estpu_plane_breaker_state", None,
+                 {"closed": 0, "half-open": 1, "open": 2}
+                 .get(pb.get("state"), 0))
+        w.family("estpu_plane_breaker_trips_total", "counter",
+                 "plane-breaker open transitions")
+        w.sample("estpu_plane_breaker_trips_total", None,
+                 pb.get("trips", 0))
+
+    # ---- breakers -------------------------------------------------------
+    w.family("estpu_breaker_used_bytes", "gauge",
+             "circuit-breaker estimated bytes")
+    w.family("estpu_breaker_limit_bytes", "gauge",
+             "circuit-breaker byte limit")
+    w.family("estpu_breaker_tripped_total", "counter",
+             "circuit-breaker trips")
+    for name, st in sorted(breaker_stats.items()):
+        used = st.get("estimated_size_in_bytes", 0)
+        w.sample("estpu_breaker_used_bytes", {"breaker": name}, used)
+        w.sample("estpu_breaker_limit_bytes", {"breaker": name},
+                 st.get("limit_size_in_bytes", 0))
+        w.sample("estpu_breaker_tripped_total", {"breaker": name},
+                 st.get("tripped", 0))
+
+    # ---- SLO burn accounting --------------------------------------------
+    slo_doc = slo.stats(node_id)
+    w.family("estpu_slo_objective", "gauge",
+             "fraction of events that must meet the lane target")
+    w.sample("estpu_slo_objective", None, slo_doc["objective"])
+    w.family("estpu_slo_good_total", "counter",
+             "events meeting the lane latency target")
+    w.family("estpu_slo_bad_total", "counter",
+             "events missing the lane latency target")
+    w.family("estpu_slo_target_ms", "gauge", "lane latency target")
+    w.family("estpu_slo_burn_rate", "gauge",
+             "cumulative error-budget burn rate (1.0 = at objective)")
+    for lane, st in slo_doc["lanes"].items():
+        w.sample("estpu_slo_good_total", {"lane": lane}, st["good"])
+        w.sample("estpu_slo_bad_total", {"lane": lane}, st["bad"])
+        w.sample("estpu_slo_target_ms", {"lane": lane}, st["target_ms"])
+        w.sample("estpu_slo_burn_rate", {"lane": lane},
+                 st["burn_rate"])
+    return w.render()
+
+
+def render_for_node(node) -> str:
+    """Scrape document for a live Node: gather its stats and render.
+    Ticks the node's timeseries ring first so windowed rates advance on
+    every scrape without a second collection pass."""
+    from elasticsearch_tpu.search import jit_exec
+    from elasticsearch_tpu.search.percolator import all_registry_stats
+    node.telemetry_tick()
+    return render(
+        node.node_id,
+        jit_exec.cache_stats(),
+        all_registry_stats(),
+        node.breaker_service.device_ledger.snapshot(
+            resolve_index=node.resolve_engine_index),
+        node.breaker_service.stats(),
+        node_name=node.node_name,
+    )

@@ -1,0 +1,150 @@
+"""SLO burn accounting — per-lane latency / queue-time objectives,
+good/bad event counters, and burn rates.
+
+The continuous-batching scheduler (ROADMAP item 6) sheds load on a
+SIGNAL, not on a dashboard: "this lane is burning its error budget N×
+too fast". The standard SRE framing:
+
+* a lane has a latency TARGET (ms) and an OBJECTIVE (the fraction of
+  events that must meet it, e.g. 0.99);
+* every observation is good (≤ target) or bad (> target) — two plain
+  integer counters per (node, lane), bumped from the same seam that
+  feeds the latency histograms, so the hot path pays two compares;
+* burn rate = (bad / total) / (1 − objective): 1.0 burns the budget
+  exactly at the objective's pace, >1 exhausts it early. Windowed burn
+  rates ride the timeseries ring (the good/bad counters are part of
+  every snapshot), cumulative burn is read directly here.
+
+Targets come from node settings — ``observability.slo.objective`` and
+``observability.slo.<lane>.latency_ms`` (``queue_wait`` is the
+queue-time SLO) — with serving defaults for every lane the latency
+histograms track except ``device_rtt`` (a hardware figure, not a
+promise to users).
+"""
+
+from __future__ import annotations
+
+import threading
+
+#: events-meeting-target fraction the error budget is budgeted against
+DEFAULT_OBJECTIVE = 0.99
+
+#: default per-lane latency targets (ms); ``queue_wait`` is the
+#: queue-time SLO the scheduler sheds on
+DEFAULT_TARGETS_MS = {
+    "plane": 100.0,
+    "fanout": 200.0,
+    "percolate": 200.0,
+    "bulk": 500.0,
+    "queue_wait": 50.0,
+}
+
+_lock = threading.Lock()
+#: node id → {"objective": float, "targets": {lane: ms}}
+_conf: dict = {}
+#: node id → lane → [good, bad]
+_state: dict = {}
+
+
+def configure(node_id: str, settings=None) -> None:
+    """Install one node's targets from its settings (unconfigured nodes
+    serve the defaults)."""
+    objective = DEFAULT_OBJECTIVE
+    targets = dict(DEFAULT_TARGETS_MS)
+    if settings is not None:
+        raw = settings.get("observability.slo.objective")
+        if raw is not None:
+            objective = min(max(float(raw), 0.0), 0.99999)
+        for lane in list(targets):
+            raw = settings.get(f"observability.slo.{lane}.latency_ms")
+            if raw is not None:
+                targets[lane] = float(raw)
+    with _lock:
+        _conf[node_id] = {"objective": objective, "targets": targets}
+
+
+def _conf_for(node_id: str) -> dict:
+    conf = _conf.get(node_id)
+    if conf is None:
+        conf = {"objective": DEFAULT_OBJECTIVE,
+                "targets": DEFAULT_TARGETS_MS}
+    return conf
+
+
+def observe(lane: str, ms: float, node_id: str) -> None:
+    """Classify one latency event against the node's lane target. Lanes
+    without a target (device_rtt, ad-hoc) are not SLO-tracked."""
+    target = _conf_for(node_id)["targets"].get(lane)
+    if target is None:
+        return
+    with _lock:
+        lanes = _state.setdefault(node_id, {})
+        gb = lanes.get(lane)
+        if gb is None:
+            gb = lanes[lane] = [0, 0]
+        gb[ms > target] += 1
+
+
+def burn_rate(good: int, bad: int, objective: float) -> float:
+    """(bad fraction) / (error budget): 1.0 = burning exactly at the
+    objective's allowance, 0 with no events."""
+    total = good + bad
+    if total <= 0:
+        return 0.0
+    return (bad / total) / max(1.0 - objective, 1e-9)
+
+
+def counters(node_id: str) -> dict:
+    """{lane: {"target_ms", "good", "bad"}} — every targeted lane
+    present (zeroed before first observation) so snapshots and the
+    exporter see a stable shape."""
+    conf = _conf_for(node_id)
+    with _lock:
+        lanes = {k: list(v) for k, v in _state.get(node_id, {}).items()}
+    out = {}
+    for lane, target in sorted(conf["targets"].items()):
+        good, bad = lanes.get(lane, (0, 0))
+        out[lane] = {"target_ms": target, "good": good, "bad": bad}
+    return out
+
+
+def stats(node_id: str) -> dict:
+    """The ``_nodes/stats.slo`` document: objective plus per-lane
+    good/bad totals and the cumulative burn rate (windowed burn rates
+    live in ``_nodes/stats.rates`` via the timeseries ring)."""
+    conf = _conf_for(node_id)
+    lanes = {}
+    for lane, st in counters(node_id).items():
+        lanes[lane] = {
+            **st,
+            "burn_rate": round(burn_rate(st["good"], st["bad"],
+                                         conf["objective"]), 4),
+        }
+    return {"objective": conf["objective"], "lanes": lanes}
+
+
+def windowed_burn(node_id: str, rates_doc: dict) -> dict:
+    """Per-window burn rates derived from a ``timeseries.rates``
+    document (the slo.* series deltas are already per-second; burn is
+    scale-free so the ratio of rates is the windowed burn)."""
+    conf = _conf_for(node_id)
+    out = {}
+    for wkey, wdoc in rates_doc.items():
+        per_s = wdoc.get("per_second", {})
+        lanes = {}
+        for lane in conf["targets"]:
+            good = per_s.get(f"slo.{lane}.good", 0.0)
+            bad = per_s.get(f"slo.{lane}.bad", 0.0)
+            if good + bad <= 0:
+                continue
+            lanes[lane] = round(
+                burn_rate(good, bad, conf["objective"]), 4)
+        out[wkey] = lanes
+    return out
+
+
+def reset() -> None:
+    """Drop every tally and configuration (tests)."""
+    with _lock:
+        _state.clear()
+        _conf.clear()

@@ -216,6 +216,8 @@ class _DeviceBlockCache:
             blk = self._lru.get(key)
             if blk is not None:
                 self._lru.move_to_end(key)
+                if blk.charge is not None:
+                    blk.charge.touch()     # ledger recency (hot/cold)
                 mask_up = 0
                 if live_np is not None and \
                         not np.array_equal(blk.live_np, live_np):
@@ -255,7 +257,10 @@ class _DeviceBlockCache:
         if breaker_service is not None:
             from elasticsearch_tpu.common.breaker import OneShotCharge
             charge = OneShotCharge(
-                breaker_service, col_bytes + mask_bytes).charge(label)
+                breaker_service, col_bytes + mask_bytes,
+                engine_uuid=engine_uuid, block_id=uid,
+                parts={"mesh-columns": col_bytes,
+                       "masks": mask_bytes}).charge(label)
         blk = _Block(key, template, arrays, template.live, col_bytes,
                      extrema, charge)
         evicted = []
@@ -279,7 +284,8 @@ class _DeviceBlockCache:
         return blk.template, blk.arrays, blk.extrema, col_bytes, \
             mask_bytes, 0
 
-    def fetch_aux(self, key: tuple, build_np, breaker_service, label: str):
+    def fetch_aux(self, key: tuple, build_np, breaker_service, label: str,
+                  component: str = "impact"):
         """Auxiliary per-segment device arrays (the impact lane's
         quantized columns + block maxima) in the SAME LRU as the column
         blocks — same keying discipline (engine uuid, block uid, sig),
@@ -295,6 +301,8 @@ class _DeviceBlockCache:
             blk = self._lru.get(key)
             if blk is not None:
                 self._lru.move_to_end(key)
+                if blk.charge is not None:
+                    blk.charge.touch()     # ledger recency (hot/cold)
                 return blk.arrays, 0, blk.col_bytes
         flat_np = [np.ascontiguousarray(a) for a in build_np()
                    if a is not None]
@@ -307,8 +315,10 @@ class _DeviceBlockCache:
         charge = None
         if breaker_service is not None:
             from elasticsearch_tpu.common.breaker import OneShotCharge
-            charge = OneShotCharge(breaker_service, col_bytes).charge(
-                label)
+            charge = OneShotCharge(breaker_service, col_bytes,
+                                   component=component,
+                                   engine_uuid=str(key[0]),
+                                   block_id=key[1]).charge(label)
         blk = _Block(key, None, arrays, np.zeros(0, bool), col_bytes,
                      {}, charge)
         evicted = []
@@ -350,10 +360,13 @@ class _DeviceBlockCache:
             if blk is None:
                 return None
             self._lru.move_to_end(key)
+            if blk.charge is not None:
+                blk.charge.touch()         # ledger recency (hot/cold)
             return blk.arrays, blk.col_bytes
 
     def aux_install(self, key: tuple, arrays: list, col_bytes: int,
-                    breaker_service, label: str):
+                    breaker_service, label: str,
+                    component: str = "vector"):
         """Install an already-uploaded auxiliary block → (arrays,
         uploaded, reused). A raced duplicate build keeps the incumbent
         and reports OUR bytes as REUSED (the loser's transfer must not
@@ -361,8 +374,10 @@ class _DeviceBlockCache:
         charge = None
         if breaker_service is not None:
             from elasticsearch_tpu.common.breaker import OneShotCharge
-            charge = OneShotCharge(breaker_service, col_bytes).charge(
-                label)
+            charge = OneShotCharge(breaker_service, col_bytes,
+                                   component=component,
+                                   engine_uuid=str(key[0]),
+                                   block_id=key[1]).charge(label)
         blk = _Block(key, None, arrays, np.zeros(0, bool), col_bytes,
                      {}, charge)
         evicted = []

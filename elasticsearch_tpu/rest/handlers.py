@@ -336,6 +336,9 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/_cat/thread_pool", h.cat_thread_pool)
     r("GET", "/_cat/fielddata", h.cat_fielddata)
     r("GET", "/_cat/fielddata/{fields}", h.cat_fielddata)
+    r("GET", "/_cat/hbm", h.cat_hbm)
+    # OpenMetrics scrape endpoint (observability/openmetrics.py)
+    r("GET", "/_prometheus/metrics", h.prometheus_metrics)
     r("GET", "/_cat/plugins", h.cat_plugins)
     r("GET", "/_cat/snapshots/{repo}", h.cat_snapshots)
     r("GET", "/_cat/templates", h.cat_templates)
@@ -3147,7 +3150,8 @@ class Handlers:
 
     def cat_help(self, req: RestRequest):
         paths = ["/_cat/aliases", "/_cat/allocation", "/_cat/count",
-                 "/_cat/fielddata", "/_cat/health", "/_cat/indices",
+                 "/_cat/fielddata", "/_cat/hbm",
+                 "/_cat/health", "/_cat/indices",
                  "/_cat/master", "/_cat/nodeattrs", "/_cat/nodes",
                  "/_cat/pending_tasks", "/_cat/plugins", "/_cat/recovery",
                  "/_cat/segments", "/_cat/shards",
@@ -3280,6 +3284,54 @@ class Handlers:
         row.update({f: fmt_bytes(b) for f, b in per_field.items()})
         t.add(**row)
         return t.render(req)
+
+    def cat_hbm(self, req: RestRequest):
+        """GET /_cat/hbm — the device-memory ledger's resident blocks on
+        this node: one row per reservation (index, engine, component,
+        block id, bytes) with hot/cold classification by last-access
+        recency (``?hot_s=`` overrides the 300 s default). The `bytes`
+        column totals reconcile with /_cat/fielddata's breaker figure —
+        the ledger invariant, broken down per block."""
+        node = self.node
+        hot_s = float(req.param("hot_s", "300"))
+        rows = node.breaker_service.device_ledger.rows(
+            resolve_index=node.resolve_engine_index, hot_s=hot_s)
+        cols = [
+            Col("node", ("n",), "node name"),
+            Col("index", ("i", "idx"), "index the bytes serve"),
+            Col("engine", ("e",), "engine incarnation uuid",
+                default=False),
+            Col("component", ("c", "comp"),
+                "mesh-columns|masks|impact|vector|pack|reader-columns|"
+                "percolate"),
+            Col("block", ("b",), "block uid (- for non-block entries)",
+                right=True),
+            Col("bytes", ("by",), "resident bytes", right=True),
+            Col("size", ("s",), "resident bytes, human", right=True),
+            Col("charged", ("ch",), "counted against the fielddata "
+                "breaker"),
+            Col("idle", ("id", "idle_s"), "seconds since last access",
+                right=True),
+            Col("temp", ("t",), "hot (accessed within hot_s) or cold"),
+        ]
+        t = CatTable(cols)
+        for r in rows:
+            t.add(node=node.node_name, index=r["index"],
+                  engine=r["engine"][:8] if r["engine"] else "-",
+                  component=r["component"], block=r["block"],
+                  bytes=r["bytes"], size=fmt_bytes(r["bytes"]),
+                  charged="true" if r["charged"] else "false",
+                  idle=r["idle_s"], temp=r["temp"])
+        return t.render(req)
+
+    def prometheus_metrics(self, req: RestRequest):
+        """GET /_prometheus/metrics — the OpenMetrics exposition for
+        THIS node, generated from the lane registry (every counter in
+        search/lanes.py is exported by construction; plane-lint's
+        counter-unexported rule and a tier-1 round-trip test hold the
+        contract)."""
+        from elasticsearch_tpu.observability import openmetrics
+        return 200, openmetrics.render_for_node(self.node)
 
     def cat_health(self, req: RestRequest):
         h = self.node.cluster_service.state().health()

@@ -640,6 +640,14 @@ def registry_stats(name: str) -> dict | None:
     return reg.stats_dict() if reg is not None else None
 
 
+def all_registry_stats() -> dict:
+    """{index name: stats_dict} over every live registry — the
+    OpenMetrics exporter's per-index percolate counter source."""
+    with _REG_LOCK:
+        regs = dict(_REGISTRIES)
+    return {name: reg.stats_dict() for name, reg in sorted(regs.items())}
+
+
 def clear_registries() -> None:
     with _REG_LOCK:
         _REGISTRIES.clear()

@@ -114,7 +114,7 @@ class SnapshotsService:
             else body.get("indices", "_all")
         names = [n for n in self.node.indices_service._resolve(state, expr)
                  if state.indices[n].state == "open"]
-        t0 = time.time()
+        t0 = time.time()                # wall-clock ok: start_time epoch
         # visibility + concurrency gate (SnapshotsInProgress custom)
         self._set_in_progress({"repository": repo, "snapshot": snapshot,
                                "state": "STARTED", "indices": names})
@@ -148,7 +148,7 @@ class SnapshotsService:
             "indices": indices_meta,
             "state": "SUCCESS" if not shards_failed else "PARTIAL",
             "start_time_in_millis": int(t0 * 1000),
-            "end_time_in_millis": int(time.time() * 1000),
+            "end_time_in_millis": int(time.time() * 1000),  # wall-clock ok
             "shards": {"total": shards_ok + shards_failed,
                        "successful": shards_ok, "failed": shards_failed},
             "failures": failures,

@@ -7,12 +7,32 @@
 #      test in tests/test_lane_graph.py enforces the same);
 #   3. a wall-clock budget assertion: the full-tree lint must finish in
 #      under 30 s on CPU, so the analyzer's own cost stays a tracked
-#      quantity (bench.py stamps the same number as `lint_wall_s`).
+#      quantity (bench.py stamps the same number as `lint_wall_s`);
+#   4. a host-sync-family grep gate: `time.time()` is banned from the
+#      hot/measurement modules — durations measured on the wall clock
+#      go backwards under NTP steps and smear every latency figure.
+#      A genuinely wall-clock use (epoch timestamps in metadata) must
+#      carry a `wall-clock ok` comment on its line to pass.
 #
-# Exit 0 only when the tree is clean, the graph is fresh, and the
-# budget holds.
+# Exit 0 only when the tree is clean, the graph is fresh, the budget
+# holds, and no unannotated wall-clock measurement landed.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+# ---- wall-clock measurement gate (hot/measurement modules) -----------
+HOT_DIRS="elasticsearch_tpu/search elasticsearch_tpu/parallel \
+elasticsearch_tpu/ops elasticsearch_tpu/observability \
+elasticsearch_tpu/index elasticsearch_tpu/indices \
+elasticsearch_tpu/monitor elasticsearch_tpu/snapshots \
+elasticsearch_tpu/analysis"
+# shellcheck disable=SC2086
+if grep -rn "time\.time()" $HOT_DIRS --include='*.py' \
+        | grep -v "wall-clock ok"; then
+    echo "lint_gate: FAIL — time.time() on a hot/measurement path;" \
+         "use time.monotonic() (or annotate an epoch-timestamp use" \
+         "with '# wall-clock ok: <why>')" >&2
+    exit 1
+fi
 
 BUDGET_S="${LINT_BUDGET_S:-30}"
 REPORT="${LINT_REPORT:-/tmp/plane_lint_report.json}"

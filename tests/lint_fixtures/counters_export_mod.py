@@ -1,0 +1,11 @@
+"""counter-unexported fixture counter module: bumps every registered
+key (so the export fixtures exercise ONLY the exporter direction, with
+no unbumped/unregistered noise). Parsed, never imported."""
+
+_stats = {k: 0 for k in EXPA_COUNTERS}        # noqa: F821 — parsed only
+_data_layer = {k: 0 for k in EXPB_COUNTERS}   # noqa: F821 — parsed only
+
+
+def serve():
+    _stats["served"] += 1
+    _data_layer["bytes_up"] += 1024

@@ -1,0 +1,12 @@
+"""counter-unexported NEGATIVE exporter fixture: iterates BOTH registry
+dicts — every family reaches the exposition, zero findings. Parsed,
+never imported."""
+
+
+def render(stats, data_layer):
+    lines = []
+    for key, help_ in EXPA_COUNTERS.items():   # noqa: F821 — parsed only
+        lines.append(f"fix_{key}_total {stats.get(key, 0)}")
+    for key, help_ in EXPB_COUNTERS.items():   # noqa: F821 — parsed only
+        lines.append(f"fix_dl_{key}_total {data_layer.get(key, 0)}")
+    return "\n".join(lines)

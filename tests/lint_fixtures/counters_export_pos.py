@@ -1,0 +1,11 @@
+"""counter-unexported POSITIVE exporter fixture: iterates only
+EXPA_COUNTERS — EXPB_COUNTERS never reaches the exposition, so the rule
+must flag it (one finding, at the registry). Parsed, never imported."""
+
+
+def render(stats):
+    lines = []
+    for key, help_ in EXPA_COUNTERS.items():   # noqa: F821 — parsed only
+        lines.append(f"# HELP fix_{key}_total {help_}")
+        lines.append(f"fix_{key}_total {stats.get(key, 0)}")
+    return "\n".join(lines)

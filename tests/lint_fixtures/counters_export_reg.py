@@ -1,0 +1,11 @@
+"""counter-unexported fixture registry: two counter families, of which
+the positive-case exporter references only one. Parsed, never
+imported."""
+
+EXPA_COUNTERS = {
+    "served": "requests served by the fixture lane",
+}
+
+EXPB_COUNTERS = {
+    "bytes_up": "bytes uploaded by the fixture data layer",
+}

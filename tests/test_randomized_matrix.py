@@ -842,12 +842,30 @@ def _scenario_device_fault_during_refresh_storm(c, rnd, spec):
             assert uid == 0 or uid in live[uuid], \
                 f"stale block_uid {uid} cached for engine {uuid[:8]} " \
                 f"(injected={scheme.injected})"
-    # teardown drains the data plane's breaker bytes entirely
+    # the device-memory ledger reconciles bit-exactly with the breaker
+    # after the fault storm: every charge the churn / eviction / rescue
+    # paths took or returned left a matching ledger row (wait_until
+    # rides out a background pack build caught mid-charge)
+    for n in c.nodes:
+        if not n._started:
+            continue
+        bs = n.breaker_service
+        assert wait_until(
+            lambda: bs.device_ledger.total_bytes()
+            == bs.breaker("fielddata").used, timeout=10.0), \
+            f"ledger/breaker drift on {n.node_name} after fault " \
+            f"storm: ledger={bs.device_ledger.total_bytes()} " \
+            f"fielddata={bs.breaker('fielddata').used} " \
+            f"(injected={scheme.injected})"
+    # teardown drains the data plane's breaker bytes entirely — and the
+    # ledger empties with it (same instant, same books)
     a.indices_service.delete_index("m_devrs")
     assert wait_until(lambda: all(
         n.breaker_service.breaker("fielddata").used == 0
+        and n.breaker_service.device_ledger.total_bytes() == 0
         for n in c.nodes if n._started), timeout=15.0), \
-        [(n.node_name, n.breaker_service.breaker("fielddata").used)
+        [(n.node_name, n.breaker_service.breaker("fielddata").used,
+          n.breaker_service.device_ledger.total_bytes())
          for n in c.nodes if n._started]
 
 

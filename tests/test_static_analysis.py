@@ -369,6 +369,56 @@ def test_counters_skip_without_registry():
     assert open_family(r, "counter-discipline") == []
 
 
+EXP_CFG = LintConfig(counter_modules=("*/counters_export_mod.py",),
+                     counter_registry_modules=("*/counters_export_reg.py",),
+                     counter_registry_names=("EXPA_COUNTERS",
+                                             "EXPB_COUNTERS"),
+                     exporter_modules=("*/counters_export_pos.py",
+                                       "*/counters_export_neg.py"))
+
+
+def test_counter_unexported_positive():
+    """An exporter that iterates only one of two registry dicts leaves
+    the other family invisible to /_prometheus — one finding, anchored
+    at the registry."""
+    r = lint_fixture("counters_export_reg.py", "counters_export_mod.py",
+                     "counters_export_pos.py", cfg=EXP_CFG)
+    unexported = open_rules(r, "counter-unexported")
+    assert len(unexported) == 1, \
+        "\n".join(f.render() for f in open_family(r, "counter-discipline"))
+    assert "EXPB_COUNTERS" in unexported[0].message
+    assert unexported[0].path.endswith("counters_export_reg.py")
+    # the referenced family is NOT flagged, and no other orphan fires
+    assert open_rules(r, "counter-unregistered", "counter-unbumped",
+                      "counter-unsurfaced") == []
+
+
+def test_counter_unexported_negative():
+    r = lint_fixture("counters_export_reg.py", "counters_export_mod.py",
+                     "counters_export_neg.py", cfg=EXP_CFG)
+    assert open_family(r, "counter-discipline") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+
+
+def test_counter_unexported_skips_without_exporter():
+    """A fixture run with no exporter module in scope must not flag
+    every registry (the fixture suites for OTHER counter rules would
+    drown in noise otherwise)."""
+    r = lint_fixture("counters_export_reg.py", "counters_export_mod.py",
+                     cfg=EXP_CFG)
+    assert open_rules(r, "counter-unexported") == []
+
+
+def test_tree_counter_export_contract():
+    """The real-tree acceptance check: every registry dict in
+    search/lanes.py is referenced by observability/openmetrics.py (the
+    exposition iterates the registries, so every registered counter is
+    exported by construction) — zero counter-unexported findings."""
+    result = tree_result()
+    fam = [f for f in result.findings if f.rule == "counter-unexported"]
+    assert fam == [], "\n".join(f.render() for f in fam)
+
+
 def test_tree_counter_discipline_is_clean():
     """The acceptance orphan check on the REAL tree: every bump in
     jit_exec/mesh_engine/percolator registered, every registered key

@@ -1,0 +1,455 @@
+"""Live telemetry plane (tier-1): the device-memory ledger's
+breaker reconciliation (unit + churn/fault fuzz), rolling-window rates
+and percentiles against an offline oracle, the /_prometheus round-trip
+against the live lane registry, /_cat/hbm, SLO burn accounting, and the
+idle-hot-path no-allocation guard."""
+
+from __future__ import annotations
+
+import math
+import random
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.breaker import (
+    HierarchyCircuitBreakerService, OneShotCharge)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.observability import (
+    histograms, ledger, slo, timeseries)
+from elasticsearch_tpu.search import lanes
+from elasticsearch_tpu.testing import InternalTestCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    yield
+    timeseries.reset()
+    slo.reset()
+
+
+# ---------------------------------------------------------------------------
+# ledger unit
+# ---------------------------------------------------------------------------
+
+def test_ledger_reconciles_by_construction():
+    """Every OneShotCharge fielddata path records a ledger row; release
+    forgets it — charged total tracks breaker.used through any
+    interleaving."""
+    svc = HierarchyCircuitBreakerService(Settings({}))
+    fd = svc.breaker("fielddata")
+    charges = []
+    rnd = random.Random(7)
+    for i in range(50):
+        if charges and rnd.random() < 0.4:
+            charges.pop(rnd.randrange(len(charges))).release()
+        else:
+            c = OneShotCharge(
+                svc, rnd.randrange(1, 10_000),
+                component=rnd.choice(ledger.COMPONENTS),
+                engine_uuid=f"e{i % 5}", block_id=i).charge(f"t{i}")
+            charges.append(c)
+        assert svc.device_ledger.total_bytes() == fd.used
+    for c in charges:
+        c.release()
+        c.release()                      # double-release stays exact
+        assert svc.device_ledger.total_bytes() == fd.used
+    assert fd.used == 0
+    assert svc.device_ledger.snapshot()["entries"] == 0
+
+
+def test_ledger_parts_split_components():
+    svc = HierarchyCircuitBreakerService(Settings({}))
+    c = OneShotCharge(svc, 700, engine_uuid="e1", block_id=3,
+                      parts={"mesh-columns": 600, "masks": 100}
+                      ).charge("blk")
+    snap = svc.device_ledger.snapshot()
+    assert snap["by_component"]["mesh-columns"] == 600
+    assert snap["by_component"]["masks"] == 100
+    assert snap["total_bytes"] == svc.breaker("fielddata").used == 700
+    c.release()
+    assert svc.device_ledger.total_bytes() == 0
+
+
+def test_ledger_absolute_accounting_and_rows():
+    svc = HierarchyCircuitBreakerService(Settings({}))
+    fd = svc.breaker("fielddata")
+    ledger.account_absolute(svc, "e9", "reader-columns", 0, 500, "gen1",
+                            index="idx")
+    ledger.account_absolute(svc, "e9", "reader-columns", 500, 200, "gen2")
+    assert fd.used == 200
+    assert svc.device_ledger.total_bytes() == 200
+    rows = svc.device_ledger.rows()
+    assert len(rows) == 1 and rows[0]["index"] == "idx"
+    assert rows[0]["component"] == "reader-columns"
+    ledger.account_absolute(svc, "e9", "reader-columns", 200, 0, "close")
+    assert fd.used == 0 and svc.device_ledger.rows() == []
+
+
+def test_ledger_hot_cold_by_recency():
+    led = ledger.DeviceMemoryLedger()
+    tok = led.record(100, component="impact", engine_uuid="e")
+    rows = led.rows(now=led._entries[tok][0].created_s + 1000.0)
+    assert rows[0]["temp"] == "cold"
+    led.touch(tok)
+    rows = led.rows()
+    assert rows[0]["temp"] == "hot"
+
+
+def test_ledger_resolves_index_at_render():
+    led = ledger.DeviceMemoryLedger()
+    led.record(64, component="vector", engine_uuid="abc")
+    snap = led.snapshot(resolve_index=lambda e: "resolved"
+                        if e == "abc" else None)
+    assert snap["indices"] == {"resolved": {
+        "total_bytes": 64, "components": {"vector": 64}}}
+
+
+# ---------------------------------------------------------------------------
+# ledger-vs-breaker fuzz under churn + device faults (cluster)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [11])
+def test_ledger_breaker_reconcile_under_churn(tmp_path, seed):
+    """Index/refresh/merge/search churn with injected device faults and
+    an OOM eviction sweep: the ledger's charged total equals the
+    fielddata breaker's used bytes at every checkpoint, and both drain
+    to zero when the index dies."""
+    from elasticsearch_tpu.parallel import mesh_engine
+    from elasticsearch_tpu.testing_disruption import (DeviceFaultScheme,
+                                                      wait_until)
+    rnd = random.Random(seed)
+    with InternalTestCluster(1, base_path=tmp_path) as c:
+        n = c.nodes[0]
+        n.indices_service.create_index("led", {"settings": {
+            "number_of_shards": 2, "number_of_replicas": 0}})
+        c.wait_for_health("green")
+
+        def check(where):
+            # wait_until rides out a background plane rebuild caught
+            # between its breaker reservation and the quiescent point
+            bs = n.breaker_service
+            assert wait_until(
+                lambda: bs.device_ledger.total_bytes()
+                == bs.breaker("fielddata").used, timeout=10.0), \
+                f"{where}: ledger={bs.device_ledger.total_bytes()} " \
+                f"fielddata={bs.breaker('fielddata').used}"
+
+        doc = 0
+        scheme = DeviceFaultScheme(seed=rnd.randrange(2 ** 31), p=0.3,
+                                   oom_fraction=0.3)
+        with scheme.applied():
+            for r in range(4):
+                for _ in range(rnd.randint(5, 12)):
+                    n.index_doc("led", str(doc),
+                                {"msg": f"tok{doc % 7} churn", "n": doc})
+                    doc += 1
+                n.broadcast_actions.refresh("led")
+                n.search("led", {"query": {"match": {"msg": "churn"}}})
+                check(f"round {r}")
+        # healed: a merge supersedes source segments (exact per-block
+        # release), an explicit cold-eviction sweep returns more
+        n.broadcast_actions.refresh("led")
+        for e in n.indices_service.indices["led"].engines.values():
+            e.force_merge()
+        n.broadcast_actions.refresh("led")
+        n.search("led", {"query": {"match": {"msg": "churn"}}})
+        check("post-merge")
+        mesh_engine.evict_cold_blocks(0.5)
+        check("post-evict")
+        n.indices_service.delete_index("led")
+        assert wait_until(
+            lambda: n.breaker_service.breaker("fielddata").used == 0
+            and n.breaker_service.device_ledger.total_bytes() == 0,
+            timeout=15.0)
+        assert n.breaker_service.device_ledger.snapshot()["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# rolling windows vs an offline oracle
+# ---------------------------------------------------------------------------
+
+def test_windowed_rates_match_offline_oracle():
+    """Synthetic counter stream with known per-window deltas: the ring's
+    per-second rates are exact (they are arithmetic on snapshots, not
+    estimates)."""
+    nid = "ts-oracle"
+    # 0..1200 s, one snapshot every 10 s; counter advances 7/s for the
+    # first 600 s then 23/s
+    total = 0.0
+    for step in range(121):
+        t = step * 10.0
+        total = 7.0 * min(t, 600.0) + 23.0 * max(t - 600.0, 0.0)
+        timeseries.record(nid, {"events": total}, now=t, force=True)
+    r = timeseries.rates(nid, now=1200.0)
+    assert r["window_1m"]["per_second"]["events"] == pytest.approx(23.0)
+    # 5m window: entirely inside the 23/s regime
+    assert r["window_5m"]["per_second"]["events"] == pytest.approx(23.0)
+    # 15m window truncates to retained history (span reported honestly:
+    # the ring prunes past its horizon) — the rate must equal the true
+    # counter delta over exactly that reported span
+    w15 = r["window_15m"]
+    assert 900.0 <= w15["span_s"] <= 1200.0
+
+    def events_at(t):
+        return 7.0 * min(t, 600.0) + 23.0 * max(t - 600.0, 0.0)
+
+    t_base = 1200.0 - w15["span_s"]
+    expected = (total - events_at(t_base)) / w15["span_s"]
+    assert w15["per_second"]["events"] == pytest.approx(expected,
+                                                        rel=0.01)
+
+
+def test_windowed_percentiles_vs_offline_oracle():
+    """Windowed p50/p95/p99 from bucket deltas vs numpy percentiles of
+    exactly the events inside the window — must agree within one sqrt2
+    bucket (the histogram's resolution bound)."""
+    nid = "pct-oracle"
+    rnd = np.random.default_rng(3)
+    # regime A (old, outside the 1m window): slow requests
+    for ms in rnd.lognormal(5.0, 0.4, size=400):
+        histograms.observe_lane("fanout", float(ms), node_id=nid)
+    counters, buckets = timeseries.collect_sample(nid)
+    timeseries.record(nid, counters, buckets, now=0.0, force=True)
+    # regime B (inside the window): fast requests — the window must see
+    # ONLY these, not the old slow mass
+    window_events = [float(ms) for ms in
+                     rnd.lognormal(2.0, 0.5, size=500)]
+    for ms in window_events:
+        histograms.observe_lane("fanout", ms, node_id=nid)
+    counters, buckets = timeseries.collect_sample(nid)
+    timeseries.record(nid, counters, buckets, now=30.0, force=True)
+    lat = timeseries.rates(nid, now=30.0)["window_1m"]["latency"]["fanout"]
+    assert lat["count"] == 500
+    for key, q in (("p50_ms", 50), ("p95_ms", 95), ("p99_ms", 99)):
+        oracle = float(np.percentile(window_events, q))
+        # one sqrt2-spaced bucket of tolerance each way
+        assert oracle / math.sqrt(2) * 0.99 <= lat[key] \
+            <= oracle * math.sqrt(2) * 1.01, (key, lat[key], oracle)
+    # cumulative percentiles (both regimes) sit far above the windowed
+    # p50 — proving the window isolated the recent regime
+    cum = histograms.summaries(nid)["fanout"]
+    assert cum["p50_ms"] > lat["p50_ms"]
+
+
+def test_ring_is_scrape_driven_not_hot_path():
+    """The acceptance guard: observing latencies (the request hot path)
+    never grows the ring or takes snapshots — only ticks do — and the
+    sub-second scrape throttle coalesces storms."""
+    nid = "idle-guard"
+    timeseries.tick(nid, force=True)
+    n0 = timeseries.ring_len(nid)
+    for _ in range(200):
+        histograms.observe_lane("plane", 1.0, node_id=nid)
+    assert timeseries.ring_len(nid) == n0
+    # throttle: a scrape storm within MIN_INTERVAL_S records once
+    assert timeseries.tick(nid) is False
+    assert timeseries.ring_len(nid) == n0
+
+
+def test_ring_prunes_beyond_horizon():
+    nid = "prune"
+    for step in range(3000):
+        timeseries.record(nid, {"x": step}, now=float(step * 2),
+                          force=True)
+    assert timeseries.ring_len(nid) <= timeseries._CAP
+    r = timeseries.rates(nid, now=6000.0)
+    assert r["window_15m"]["per_second"]["x"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn accounting
+# ---------------------------------------------------------------------------
+
+def test_slo_burn_rate_simulated_streams():
+    """Simulated good/bad streams: burn 1.0 at exactly the objective's
+    bad fraction, N× when N× over budget, 0 on an all-good stream."""
+    nid = "slo-sim"
+    slo.configure(nid, Settings({"observability.slo.objective": "0.99",
+                                 "observability.slo.plane.latency_ms":
+                                     "100"}))
+    # 1% bad = exactly at objective → burn 1.0
+    for i in range(1000):
+        slo.observe("plane", 500.0 if i < 10 else 10.0, nid)
+    st = slo.stats(nid)["lanes"]["plane"]
+    assert st["good"] == 990 and st["bad"] == 10
+    assert st["burn_rate"] == pytest.approx(1.0)
+    # 5% bad → burn 5.0
+    nid2 = "slo-sim-2"
+    slo.configure(nid2, Settings({"observability.slo.objective": "0.99"}))
+    for i in range(200):
+        slo.observe("fanout", 10_000.0 if i % 20 == 0 else 1.0, nid2)
+    assert slo.stats(nid2)["lanes"]["fanout"]["burn_rate"] == \
+        pytest.approx(5.0)
+    # all-good stream burns nothing
+    nid3 = "slo-sim-3"
+    for _ in range(50):
+        slo.observe("bulk", 1.0, nid3)
+    assert slo.stats(nid3)["lanes"]["bulk"]["burn_rate"] == 0.0
+
+
+def test_slo_windowed_burn_from_ring():
+    """Windowed burn isolates the recent regime: an old bad burst
+    outside the window does not bleed into the 1m figure."""
+    nid = "slo-win"
+    slo.configure(nid, Settings({}))
+    for _ in range(100):                     # old: 100% bad
+        slo.observe("plane", 10_000.0, nid)
+    counters, buckets = timeseries.collect_sample(nid)
+    timeseries.record(nid, counters, buckets, now=0.0, force=True)
+    for _ in range(100):                     # recent: all good
+        slo.observe("plane", 1.0, nid)
+    counters, buckets = timeseries.collect_sample(nid)
+    timeseries.record(nid, counters, buckets, now=30.0, force=True)
+    burn = slo.windowed_burn(nid, timeseries.rates(nid, now=30.0))
+    assert burn["window_1m"]["plane"] == 0.0
+    cumulative = slo.stats(nid)["lanes"]["plane"]["burn_rate"]
+    assert cumulative == pytest.approx(50.0)   # 50% bad vs 1% budget
+
+
+def test_slo_observe_rides_histogram_seam():
+    nid = "slo-seam"
+    histograms.observe_lane("plane", 1.0, node_id=nid)
+    histograms.observe_lane("plane", 99_999.0, node_id=nid)
+    st = slo.stats(nid)["lanes"]["plane"]
+    assert (st["good"], st["bad"]) == (1, 1)
+    # device_rtt is a hardware figure, not a promise: untracked
+    histograms.observe_lane("device_rtt", 99_999.0, node_id=nid)
+    assert "device_rtt" not in slo.stats(nid)["lanes"]
+
+
+# ---------------------------------------------------------------------------
+# cluster surfaces: _nodes/stats, /_prometheus, /_cat/hbm, chrome track
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    with InternalTestCluster(
+            2, base_path=tmp_path_factory.mktemp("telem")) as c:
+        c.wait_for_nodes(2)
+        m = c.master()
+        m.indices_service.create_index(
+            "tp", {"settings": {"number_of_shards": 2,
+                                "number_of_replicas": 1}})
+        c.wait_for_health("green")
+        for i in range(25):
+            m.index_doc("tp", str(i), {"msg": f"hello tok{i % 5}",
+                                       "n": i})
+        m.broadcast_actions.refresh("tp")
+        m.search("tp", {"query": {"match": {"msg": "hello"}}})
+        yield c
+
+
+def test_nodes_stats_device_memory_reconciles(cluster):
+    from elasticsearch_tpu.testing_disruption import wait_until
+    for n in cluster.nodes:
+        # ride out any background pack build still charging
+        assert wait_until(
+            lambda: n.breaker_service.device_ledger.total_bytes()
+            == n.breaker_service.breaker("fielddata").used, timeout=10.0)
+        st = n.local_node_stats()
+        dm = st["device_memory"]
+        assert dm["charged_bytes"] == \
+            st["breakers"]["fielddata"]["estimated_size_in_bytes"]
+        assert set(ledger.COMPONENTS) <= set(dm["by_component"])
+    m = cluster.master()
+    dm = m.local_node_stats()["device_memory"]
+    # the serving node attributes its residency to the index by name
+    assert dm["total_bytes"] > 0
+    assert "tp" in dm["indices"]
+    comps = dm["indices"]["tp"]["components"]
+    assert comps.get("reader-columns", 0) > 0 or \
+        comps.get("mesh-columns", 0) > 0
+
+
+def test_nodes_stats_rates_and_slo_sections(cluster):
+    m = cluster.master()
+    m.telemetry_tick(force=True)
+    m.search("tp", {"query": {"match": {"msg": "hello"}}})
+    m.telemetry_tick(force=True)
+    st = m.local_node_stats()
+    for wkey in ("window_1m", "window_5m", "window_15m"):
+        assert wkey in st["rates"]
+        assert "per_second" in st["rates"][wkey]
+    w1 = st["rates"]["window_1m"]["per_second"]
+    lane_keys = [k for k in w1 if k.startswith("lane.")
+                 and k.endswith(".count")]
+    assert lane_keys, w1.keys()
+    assert any(v > 0 for k, v in w1.items() if k in lane_keys)
+    assert "slo_burn" in st["rates"]
+    assert st["slo"]["objective"] > 0
+    assert "plane" in st["slo"]["lanes"]
+
+
+def test_prometheus_round_trip_vs_lane_registry(cluster):
+    """The acceptance contract: every counter registered in
+    search/lanes.py appears in the /_prometheus exposition, every
+    registered fallback reason is a labeled series, and the ledger /
+    breaker / slo gauges render."""
+    from elasticsearch_tpu.observability import openmetrics
+    m = cluster.master()
+    text = openmetrics.render_for_node(m)
+    for key in lanes.JIT_COUNTERS:
+        assert f"estpu_jit_{key}_total" in text, key
+    for key in lanes.DATA_LAYER_COUNTERS:
+        assert f"estpu_data_layer_{key}_total" in text, key
+    for key in lanes.PERCOLATE_COUNTERS:
+        assert f"estpu_percolate_{key}_total" in text, key
+    for lane, reasons in lanes.LANE_REASONS.items():
+        for reason in reasons:
+            assert f'lane="{lane}",reason="{reason}"' in text, \
+                (lane, reason)
+    assert "estpu_device_memory_bytes" in text
+    assert "estpu_breaker_used_bytes" in text
+    assert "estpu_slo_burn_rate" in text
+    assert text.endswith("# EOF\n")
+    # gauge value reconciles with the breaker figure in the same scrape
+    for line in text.splitlines():
+        if line.startswith("estpu_device_memory_charged_bytes "):
+            assert int(line.split()[-1]) == \
+                m.breaker_service.breaker("fielddata").used
+
+
+def test_prometheus_rest_endpoint_and_cat_hbm(cluster):
+    from elasticsearch_tpu.rest.controller import RestController
+    from elasticsearch_tpu.rest.handlers import register_all
+    m = cluster.master()
+    rc = RestController()
+    register_all(rc, m)
+    status, body = rc.dispatch("GET", "/_prometheus/metrics", b"")
+    assert status == 200 and "estpu_jit_hits_total" in body
+    status, body = rc.dispatch("GET", "/_cat/hbm?v=true", b"")
+    assert status == 200
+    header = body.splitlines()[0]
+    for col in ("index", "component", "bytes", "temp"):
+        assert col in header
+    assert "reader-columns" in body or "mesh-columns" in body
+    # ?h= column selection works like every other cat table
+    status, body = rc.dispatch("GET", "/_cat/hbm?h=component,bytes", b"")
+    assert status == 200
+    # ledger rows total == the breaker figure (cat view of the invariant)
+    total = sum(int(ln.split()[-1]) for ln in body.splitlines() if ln)
+    assert total == m.breaker_service.breaker("fielddata").used
+
+
+def test_chrome_trace_counter_track(cluster):
+    m = cluster.master()
+    m.telemetry_tick(force=True)
+    doc = m.collect_chrome_trace()
+    cevents = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+    assert cevents, "no counter track in the chrome export"
+    names = {e["name"] for e in cevents}
+    assert any(n.startswith("gauge.hbm.") for n in names)
+    for e in cevents:
+        assert "value" in e["args"]
+
+
+def test_chrome_trace_counters_unit():
+    from elasticsearch_tpu.observability import chrome
+    doc = chrome.chrome_trace(
+        [], counters={"n1": [(1000, {"gauge.hbm.total.bytes": 42.0,
+                                     "lane.plane.count": 7})]})
+    cs = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert {e["name"] for e in cs} == {"gauge.hbm.total.bytes",
+                                      "lane.plane.count"}
+    assert all(e["ts"] == 1000 for e in cs)
