@@ -459,6 +459,15 @@ class SearchActions:
         # allow_partial_search_results overrides this node default
         self.default_allow_partial = _flag(
             "search.default_allow_partial_results", True)
+        # ---- continuous-batching scheduler (ROADMAP item 6) ----
+        # per-node device feeder: concurrent single-search traffic on
+        # the shard path coalesces into the same batched programs the
+        # msearch path uses, with one dispatch always in flight
+        # (search/scheduler.py; settings search.scheduler.*)
+        from elasticsearch_tpu.search.scheduler import (
+            ContinuousBatchScheduler, settings_for)
+        self.scheduler = ContinuousBatchScheduler(
+            node_id=getattr(node, "node_id", None), **settings_for(get))
         # background pack-build (plane warm) failure tracking: per-index
         # consecutive failures drive the retry backoff and, past
         # PLANE_WARM_MAX_RETRIES, the plane-degraded marking
@@ -516,6 +525,7 @@ class SearchActions:
 
     def close(self):
         self._closed = True
+        self.scheduler.close()
         self._pool.shutdown(wait=False, cancel_futures=True)
         self._msearch_pool.shutdown(wait=False, cancel_futures=True)
         self._plane_warm_pool.shutdown(wait=False, cancel_futures=True)
@@ -631,6 +641,36 @@ class SearchActions:
             cur.deadline = dl if cur.deadline is None \
                 else min(cur.deadline, dl)
 
+    def _scheduled_query_phase(self, searcher, req):
+        """Shard-side query phase through the continuous-batching
+        scheduler: concurrent single-search traffic targeting the same
+        (reader, lane, shape) coalesces into ONE batched device program
+        — the request-at-a-time gap BENCH_r04 measured. Falls back to
+        the serial :meth:`ShardSearcher.query_phase` when the request's
+        shape is unbatchable, the scheduler declines (ineligible batch,
+        device fallback, shutdown), or the plane breaker is open (the
+        serial path owns the breaker-gated eager fallback — the
+        scheduler never queues toward an unhealthy device). SLO-burn
+        sheds raise the typed 429 (SchedulerRejectedError) through to
+        the coordinator."""
+        sched = self.scheduler
+        if sched is None or not sched.enabled:
+            return searcher.query_phase(req)
+        from elasticsearch_tpu.search import jit_exec
+        from elasticsearch_tpu.search import scheduler as sched_mod
+        lane, shape = sched_mod.classify(req, searcher)
+        if lane is None or not jit_exec.plane_breaker.allow():
+            return searcher.query_phase(req)
+        out = sched.execute(
+            lane,
+            (searcher.ctx.index_name, searcher.shard_id, lane, shape,
+             id(searcher.reader)),
+            req, searcher.query_phase_batch_launch,
+            searcher.query_phase_batch_drain)
+        if out is None:
+            return searcher.query_phase(req)
+        return out
+
     def _hold_for_test(self) -> None:
         """Cancellation-checkpointed hold (see ``shard_query_delay``)."""
         delay = self.shard_query_delay
@@ -730,7 +770,7 @@ class SearchActions:
             req = parse_search_request(body)
             self._apply_budget(req, budget_ms)
             self._hold_for_test()
-            result = searcher.query_phase(req)
+            result = self._scheduled_query_phase(searcher, req)
             q_ms = (time.perf_counter() - t0) * 1000.0
             svc.note_search(body.get("stats"), q_ms)
             k = min(len(result.doc_ids), req.from_ + req.size)
@@ -942,7 +982,7 @@ class SearchActions:
             req = parse_search_request(body)
             self._apply_budget(req, budget_ms)
             self._hold_for_test()
-            result = searcher.query_phase(req)
+            result = self._scheduled_query_phase(searcher, req)
             q_ms = (time.perf_counter() - t0) * 1000.0
             k = min(len(result.doc_ids), req.from_ + req.size)
             hits = searcher.fetch_phase(req, result, name, list(range(k)))

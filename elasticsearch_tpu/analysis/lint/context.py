@@ -214,7 +214,8 @@ class LintConfig:
                               ("note_fallback", "plane"),
                               ("note_impact_fallback", "impact"),
                               ("note_knn_fallback", "knn"),
-                              ("note_percolate_fallback", "percolate"))
+                              ("note_percolate_fallback", "percolate"),
+                              ("note_scheduler_shed", "scheduler"))
     #: the lane-registry module and its vocabulary / edge / admission
     #: dict names (the --emit-lane-graph source of truth)
     lane_registry_modules: tuple = ("*/search/lanes.py",)

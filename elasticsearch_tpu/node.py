@@ -930,6 +930,11 @@ class Node:
             # in_flight at every instant)
             "adaptive_selection":
                 self.search_actions.replica_stats.stats_dict(),
+            # continuous-batching scheduler: queue depths, batches
+            # launched/in-flight/drained, shed counts by reason, and the
+            # sample-time reconciliation verdict (submitted == queued +
+            # in_flight + delivered + declined + shed)
+            "scheduler": self.search_actions.scheduler.stats(),
             # per-lane latency distributions (fixed-bucket histograms,
             # always on) + this node's span-store accounting
             "latency": _hist.summaries(self.node_id),

@@ -106,6 +106,7 @@ def render(node_id: str, jit_stats: dict, percolate_stats: dict,
         "impact": jit_stats.get("impact_fallback_reasons", {}),
         "knn": jit_stats.get("knn_fallback_reasons", {}),
         "percolate": jit_stats.get("percolate_fallback_reasons", {}),
+        "scheduler": jit_stats.get("scheduler_shed_reasons", {}),
     }
     for lane, reasons in lanes.LANE_REASONS.items():
         counts = reason_counts.get(lane, {})

@@ -1700,8 +1700,26 @@ class Handlers:
             entry["aggregations"] = out["aggregations"]
         return entry
 
-    def _percolate(self, req: RestRequest) -> dict:
+    def _percolate_scheduled(self, meta, item: dict) -> dict:
+        """One percolate item through the node's continuous-batching
+        scheduler: concurrent single-doc percolates against the same
+        index coalesce into ONE ``percolate_many`` batch (the fused
+        multi-doc dispatch the _mpercolate path already rides), on the
+        scheduler's low-priority percolate queue — weighted-fair pickup
+        keeps it served under a query storm, SLO-burn shedding drops it
+        FIRST (429) when the node melts."""
         from elasticsearch_tpu.search.percolator import percolate_many
+        sched = getattr(self.node.search_actions, "scheduler", None)
+        if sched is not None and sched.enabled:
+            out = sched.execute(
+                "percolate", ("percolate", meta.name,
+                              getattr(meta, "uuid", None)),
+                item, lambda items: percolate_many(meta, items))
+            if out is not None:
+                return out
+        return percolate_many(meta, [item])[0]
+
+    def _percolate(self, req: RestRequest) -> dict:
         index = self.node.indices_service.resolve(
             req.path_params["index"])[0]
         meta = self.node.cluster_service.state().indices[index]
@@ -1709,7 +1727,7 @@ class Handlers:
         if body.get("doc") is None:
             from elasticsearch_tpu.common.errors import IllegalArgumentError
             raise IllegalArgumentError("percolate requires a [doc]")
-        out = percolate_many(meta, [self._percolate_item(body)])[0]
+        out = self._percolate_scheduled(meta, self._percolate_item(body))
         if "_exception" in out:
             raise out["_exception"]
         return out
@@ -1753,11 +1771,10 @@ class Handlers:
                 int(got.get("_version", 0)), int(want_version))
         perc_index = req.param("percolate_index", doc_index)
         body = req.body or {}
-        from elasticsearch_tpu.search.percolator import percolate_many
         name = self.node.indices_service.resolve(perc_index)[0]
         meta = self.node.cluster_service.state().indices[name]
         item = self._percolate_item({**body, "doc": got["_source"]})
-        out = percolate_many(meta, [item])[0]
+        out = self._percolate_scheduled(meta, item)
         if "_exception" in out:
             raise out["_exception"]
         return 200, self._percolate_render(out,
@@ -3920,6 +3937,17 @@ class Handlers:
                     f"{fname} for {pool} pool",
                     right=fname != "type",
                     default=(pool, fname) in default_on))
+        # the continuous-batching scheduler is the device's admission
+        # queue — its depth/rejections belong in the backpressure
+        # picture next to the thread pools
+        cols.append(Col("scheduler.queue", ("schq",),
+                        "scheduler admission-queue depth", right=True))
+        cols.append(Col("scheduler.inflight", ("schif",),
+                        "scheduler batches launched, not yet drained",
+                        right=True, default=False))
+        cols.append(Col("scheduler.rejected", ("schr",),
+                        "requests the scheduler shed (deadline / "
+                        "SLO-burn / capacity)", right=True))
         t = CatTable(cols)
         # one row per CLUSTER node (the reference's nodes-stats fan-out):
         # queue depths and rejection counts are the cluster-wide
@@ -3950,6 +3978,10 @@ class Handlers:
                 row[f"{pool}.min"] = ""
                 row[f"{pool}.max"] = ""
                 row[f"{pool}.keepAlive"] = ""
+            sched = stats.get("scheduler", {})
+            row["scheduler.queue"] = sched.get("queue_depth", 0)
+            row["scheduler.inflight"] = sched.get("batches_in_flight", 0)
+            row["scheduler.rejected"] = sched.get("shed", 0)
             t.add(**row)
         return t.render(req)
 

@@ -20,6 +20,7 @@ optimization layer — results are produced by the same
 
 from __future__ import annotations
 
+import inspect
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 
@@ -70,12 +71,19 @@ class AdaptiveBatcher:
             self._drain_pool = None
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
-        # Pad formed batches up to the next power of two (cycling the
-        # queued requests) so a jitted run_batch compiles O(log B) programs
-        # instead of one per distinct arrival count — jagged batch sizes
-        # are the norm under a deadline trigger. Requires run_batch to be
-        # a pure function of the request list (query_phase_batch is).
+        # Pad formed batches up to the next power of two so a jitted
+        # run_batch compiles O(log B) programs instead of one per
+        # distinct arrival count — jagged batch sizes are the norm under
+        # a deadline trigger. Padding replicates the FIRST request as a
+        # no-op row (results sliced off before delivery); run_batch
+        # callables that take `n_real` get the real-row count so lane
+        # stats never count pad rows (query_phase_batch_launch does).
         self.pad_to_bucket = pad_to_bucket
+        try:
+            self._pass_n_real = "n_real" in \
+                inspect.signature(run_batch).parameters
+        except (TypeError, ValueError):      # builtins / C callables
+            self._pass_n_real = False
         self._lock = threading.Lock()
         self._queue: list[tuple[object, Future]] = []
         self._timer: threading.Timer | None = None
@@ -156,14 +164,22 @@ class AdaptiveBatcher:
 
     def _dispatch(self, batch: list) -> None:
         reqs = [r for r, _ in batch]
+        n_real = len(reqs)
         if self.pad_to_bucket and len(reqs) < self.max_batch:
             # bucket sizes that can reach run_batch: powers of two below
             # max_batch, plus max_batch itself (full batches form at
             # exactly max_batch anyway) — O(log B) distinct compiles even
-            # for a non-power-of-two max_batch
+            # for a non-power-of-two max_batch. Pad rows replicate the
+            # first request only: cycling every queued request re-ran
+            # real work through the program a second time and (on the
+            # impact/knn lanes) double-counted admission stats
             bucket = pow2_bucket(len(reqs), self.max_batch)
-            reqs = reqs + [reqs[i % len(reqs)]
-                           for i in range(bucket - len(reqs))]
+            reqs = reqs + [reqs[0]] * (bucket - len(reqs))
+
+        def run(rs):
+            if self._pass_n_real and len(rs) != n_real:
+                return self._run_batch(rs, n_real=n_real)
+            return self._run_batch(rs)
         if self._drain_batch is not None:
             # pipelined: launch here (async device dispatch, fast), drain
             # on a worker — the next batch forms and launches while this
@@ -182,7 +198,7 @@ class AdaptiveBatcher:
                         fut.set_result(None)
                 return
             try:
-                handle = self._run_batch(reqs)
+                handle = run(reqs)
             except Exception as e:           # noqa: BLE001 — fan the error out
                 self._inflight.release()
                 for _, fut in batch:
@@ -204,7 +220,7 @@ class AdaptiveBatcher:
                 self._drain_and_deliver(handle, batch)
             return
         try:
-            results = self._run_batch(reqs)
+            results = run(reqs)
         except Exception as e:               # noqa: BLE001 — fan the error out
             for _, fut in batch:
                 if not fut.done():

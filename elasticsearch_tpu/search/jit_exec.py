@@ -321,6 +321,9 @@ _knn_fallback_reasons: dict[str, int] = {}
 #: why fused-percolate dispatches fell to the per-query eager lane
 #: (breaker-open / device-error), by label
 _percolate_fallback_reasons: dict[str, int] = {}
+#: why the continuous-batching scheduler shed requests (queue-deadline /
+#: slo-shed / queue-full / task-cancelled / closed), by label
+_scheduler_shed_reasons: dict[str, int] = {}
 #: per-INDEX knn-lane accounting — feeds the per-index _stats
 #: "search.knn" section and the _cat/indices knn.* columns
 _knn_index_stats: dict[str, dict] = {}
@@ -378,6 +381,7 @@ def cache_stats(node_id: str | None = None) -> dict:
                "knn_fallback_reasons": dict(_knn_fallback_reasons),
                "percolate_fallback_reasons":
                    dict(_percolate_fallback_reasons),
+               "scheduler_shed_reasons": dict(_scheduler_shed_reasons),
                "data_layer": dict(_data_layer)}
     out["plane_breaker"] = plane_breaker.stats()
     return out
@@ -459,6 +463,7 @@ def clear_cache() -> None:
         _knn_fallback_reasons.clear()
         _knn_index_stats.clear()
         _percolate_fallback_reasons.clear()
+        _scheduler_shed_reasons.clear()
         _data_layer.update({k: 0 for k in _data_layer})
         _node_stats.clear()
         _node_fallback_reasons.clear()
@@ -1789,6 +1794,35 @@ def note_percolate_fallback(reason: str) -> None:
     with _cache_lock:
         _percolate_fallback_reasons[reason] = \
             _percolate_fallback_reasons.get(reason, 0) + 1
+
+
+def note_scheduler_batch(n_real: int, pad_rows: int = 0) -> None:
+    """One continuous-batching scheduler micro-batch launched:
+    ``n_real`` queued requests admitted (pad rows counted separately —
+    they are no-op replicas, never delivered)."""
+    with _cache_lock:
+        _bump("scheduler_batches_launched")
+        _bump("scheduler_requests_admitted", int(n_real))
+        if pad_rows:
+            _bump("scheduler_pad_rows", int(pad_rows))
+
+
+def note_scheduler_drain() -> None:
+    """One scheduler batch's device→host drain completed (launched −
+    drained = batches in flight, the pipelining evidence)."""
+    with _cache_lock:
+        _bump("scheduler_batches_drained")
+
+
+def note_scheduler_shed(reason: str, n: int = 1) -> None:
+    """``n`` requests the scheduler shed instead of queueing toward a
+    blown deadline / burning SLO, reason-labeled against the closed
+    ``scheduler`` vocabulary like the admission lanes."""
+    lanes.check_reason("scheduler", reason)
+    with _cache_lock:
+        _bump("scheduler_requests_shed", int(n))
+        _scheduler_shed_reasons[reason] = \
+            _scheduler_shed_reasons.get(reason, 0) + int(n)
 
 
 def note_knn_served(index_name: str | None, n_requests: int,

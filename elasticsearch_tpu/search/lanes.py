@@ -58,6 +58,18 @@ JIT_COUNTERS = {
     "knn_admissions": "requests served by the compiled knn lane",
     "fusion_dispatches": "in-program hybrid fusion dispatches",
     "maxsim_dispatches": "fused MaxSim dispatches over rank_vectors",
+    # continuous-batching scheduler (search/scheduler.py): the live
+    # serving path's device feeder
+    "scheduler_batches_launched": "micro-batches the continuous-batching "
+                                  "scheduler dispatched",
+    "scheduler_batches_drained": "scheduler batches whose device→host "
+                                 "drain completed",
+    "scheduler_requests_admitted": "requests served through scheduler "
+                                   "batches (pad rows excluded)",
+    "scheduler_requests_shed": "requests the scheduler shed "
+                               "(deadline / SLO-burn / capacity)",
+    "scheduler_pad_rows": "no-op pad rows appended to reach the pow2 "
+                          "program bucket (never delivered or counted)",
 }
 
 #: jit_exec._data_layer — incremental data-plane traffic accounting
@@ -138,6 +150,14 @@ LANE_REASONS = {
         "device-error",         # fused dispatch raised: eager rescue
         "breaker-open",         # plane breaker open: eager lane serves
     ),
+    # continuous-batching scheduler sheds, scheduler.submit / pickup
+    "scheduler": (
+        "queue-deadline",       # deadline blown while queued: serial path
+        "task-cancelled",       # task cancelled while queued: abort
+        "slo-shed",             # queue_wait SLO burn: typed 429 rejection
+        "queue-full",           # admission queue at capacity: typed 429
+        "closed",               # node shutting down: serial fallback
+    ),
 }
 
 #: (declining lane, serving lane, reason the decliner labels): the
@@ -164,6 +184,8 @@ LANE_ADMISSIONS = {
            "::ShardSearcher._knn_batch_launch",
     "percolate": "elasticsearch_tpu/search/percolator.py"
                  "::PercolatorRegistry.run",
+    "scheduler": "elasticsearch_tpu/search/scheduler.py"
+                 "::ContinuousBatchScheduler.submit",
 }
 
 

@@ -534,7 +534,8 @@ class ShardSearcher:
             return None
         return self.query_phase_batch_drain(handle)
 
-    def query_phase_batch_launch(self, reqs: list[ParsedSearchRequest]):
+    def query_phase_batch_launch(self, reqs: list[ParsedSearchRequest],
+                                 n_real: int | None = None):
         """Phase 1 of the batched query phase: eligibility screen, ONE
         async device dispatch, and an async device→host copy kick-off.
         Returns an opaque handle for :meth:`query_phase_batch_drain`, or
@@ -542,7 +543,12 @@ class ShardSearcher:
         :meth:`query_phase`). Never blocks on device results — JAX's
         async dispatch returns immediately and ``copy_to_host_async``
         starts the transfer in the background, so consecutive launches
-        pipeline on the device while earlier drains ride the link."""
+        pipeline on the device while earlier drains ride the link.
+
+        ``n_real`` (batching layers only): the first ``n_real`` rows are
+        real queued requests, the rest pow2-bucket padding — lane
+        admission stats count only the real rows, so a padded batch
+        never double-counts."""
         from elasticsearch_tpu.search import jit_exec
         from elasticsearch_tpu.tasks import current_task
         _checkpoint(current_task())
@@ -559,13 +565,13 @@ class ShardSearcher:
         if any(r.knn is not None for r in reqs):
             if not all(r.knn is not None for r in reqs):
                 return None
-            return self._knn_batch_launch(reqs)
+            return self._knn_batch_launch(reqs, n_real=n_real)
         # impact-ordered lane next: an opted-in index serves eligible
         # disjunctive BM25 shapes from the quantized impact columns
         # (score-order search_after cursors included — the generic
         # screen below rejects those); ineligible requests fall through
         # to the exact batched program
-        imp = self._impact_batch_launch(reqs)
+        imp = self._impact_batch_launch(reqs, n_real=n_real)
         if imp is not None:
             return imp
         for req in reqs:
@@ -614,7 +620,7 @@ class ShardSearcher:
                 pass                      # drain's np.asarray still works
         return ("device", reqs, k, pack, out)
 
-    def _impact_batch_launch(self, reqs: list):
+    def _impact_batch_launch(self, reqs: list, n_real: int | None = None):
         """Impact-lane admission + dispatch: serve B eligible requests
         from the quantized impact columns (jit_exec.run_impact_batch),
         with the block-max pruned sweep when no request tracks totals
@@ -709,7 +715,8 @@ class ShardSearcher:
                 out[name].copy_to_host_async()
             except Exception:             # noqa: BLE001 — optional
                 pass
-        return ("impact", reqs, k, out, prune, pack.total_blocks)
+        return ("impact", reqs, k, out, prune, pack.total_blocks,
+                n_real if n_real is not None else len(reqs))
 
     # ---- dense / late-interaction lane (top-level "knn" section) ----------
 
@@ -762,7 +769,7 @@ class ShardSearcher:
         return _dc.replace(req, query=new_q,
                            knn=_dc.replace(knn, filter=new_f))
 
-    def _knn_batch_launch(self, reqs: list):
+    def _knn_batch_launch(self, reqs: list, n_real: int | None = None):
         """knn-lane admission + dispatch: serve B knn/hybrid requests
         as ONE compiled program (jit_exec.run_knn_hybrid_batch) over
         the reader's block-cached vector columns. Returns a drain
@@ -814,10 +821,11 @@ class ShardSearcher:
             return None
         jit_exec.plane_breaker.record_success()
         hybrid = knns[0].hybrid
+        n = n_real if n_real is not None else len(reqs)
         jit_exec.note_knn_served(
-            self.ctx.index_name, len(reqs),
-            fused=len(reqs) if hybrid else 0,
-            maxsim=len(reqs) if pack.multi else 0)
+            self.ctx.index_name, n,
+            fused=n if hybrid else 0,
+            maxsim=n if pack.multi else 0)
         for name in ("top_scores", "top_docs", "count"):
             try:
                 out[name].copy_to_host_async()
@@ -952,10 +960,7 @@ class ShardSearcher:
         if tag == "impact":
             from elasticsearch_tpu.observability import attribution
             from elasticsearch_tpu.search import jit_exec
-            _, _, k, out, pruned, total_blocks = handle
-            ms = np.asarray(out["top_scores"])
-            md = np.asarray(out["top_docs"])
-            totals = np.asarray(out["count"])
+            _, _, k, out, pruned, total_blocks, n_real = handle
             if pruned:
                 scored = int(np.asarray(out["blocks_scored"]).sum())
                 skipped = int(np.asarray(out["blocks_skipped"]).sum())
@@ -964,8 +969,12 @@ class ShardSearcher:
             else:
                 # eager impact scoring touches every block — honest
                 # effective-work accounting for the skip-ratio surfaces
-                scored, skipped = total_blocks * len(reqs), 0
-            jit_exec.note_impact_served(self.ctx.index_name, len(reqs),
+                # (real rows only: pad replicas are not admissions)
+                scored, skipped = total_blocks * n_real, 0
+            ms = np.asarray(out["top_scores"])
+            md = np.asarray(out["top_docs"])
+            totals = np.asarray(out["count"])
+            jit_exec.note_impact_served(self.ctx.index_name, n_real,
                                         scored, skipped)
         elif tag == "host":
             _, _, k, (ms, md, totals) = handle

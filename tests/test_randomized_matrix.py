@@ -57,6 +57,8 @@ SCENARIOS = [
     "device_fault_during_relocation",
     # v4 tail-tolerance combination scenario
     "brownout_during_search_storm",
+    # v5 continuous-batching-scheduler combination scenario
+    "scheduler_mixed_storm",
 ]
 
 #: scenarios that stage their own disruption — layering a random scheme
@@ -66,7 +68,7 @@ SELF_DISRUPTING = {
     "recovery_during_relocation", "snapshot_during_churn",
     "master_failover_during_bulk", "disk_fault_failover",
     "device_fault_during_refresh_storm", "device_fault_during_relocation",
-    "brownout_during_search_storm",
+    "brownout_during_search_storm", "scheduler_mixed_storm",
 }
 
 #: schemes a write-exercising scenario can carry while still asserting
@@ -86,7 +88,7 @@ SOFT_SCHEMES = ("none", "delays", "flaky_delay", "duplicate", "reorder",
 SMOKE = ["crud_search", "partition_minority", "recovery_during_relocation",
          "master_failover_during_bulk", "disk_fault_failover",
          "device_fault_during_refresh_storm",
-         "brownout_during_search_storm"]
+         "brownout_during_search_storm", "scheduler_mixed_storm"]
 
 VARIANTS = int(os.environ.get("ESTPU_MATRIX_VARIANTS", "3"))
 
@@ -1026,3 +1028,151 @@ def _scenario_brownout_during_search_storm(c, rnd, spec):
     r = coordinator.search("m_brown", dict(body))
     assert r["hits"]["total"] == n_docs
     assert r["_shards"]["failed"] == 0, r["_shards"]
+
+
+def _scenario_scheduler_mixed_storm(c, rnd, spec):
+    """Combination: a mixed query/knn/percolate/bulk workload drives the
+    continuous-batching scheduler on every data node while one node's
+    serve path browns out (BrownoutScheme) AND the device injects
+    seeded faults (DeviceFaultScheme). The scheduler must: (1) starve
+    nobody — every client completes, every search correct, with any
+    SLO-burn shed surfacing ONLY as the typed 429 (never a hang or a
+    wrong result); (2) reconcile its counters exactly once the storm
+    drains (submitted == delivered + declined + shed, zero queued, zero
+    in flight, launched == drained); (3) leak nothing — zero request-
+    breaker bytes and zero open spans on every node after settle."""
+    from elasticsearch_tpu.observability import tracing as obs_trace
+    from elasticsearch_tpu.search.scheduler import SchedulerRejectedError
+    from elasticsearch_tpu.testing_disruption import (BrownoutScheme,
+                                                      DeviceFaultScheme,
+                                                      wait_until)
+    a = c.master()
+    a.indices_service.create_index("m_sched", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 1},
+        "mappings": {"doc": {"properties": {
+            "v": {"type": "dense_vector", "dims": 4}}}}})
+    _green(a)
+    n_docs = rnd.randint(24, 40)
+    for i in range(n_docs):
+        a.index_doc("m_sched", str(i),
+                    {"body": f"tok{i % 5} shared", "n": i,
+                     "v": [float(i % 7), 1.0, float(i % 3), 0.5]})
+    a.broadcast_actions.refresh("m_sched")
+    a.indices_service.put_percolator(
+        "m_sched", "pq1", {"query": {"match": {"body": "shared"}}})
+    a.indices_service.put_percolator(
+        "m_sched", "pq2", {"query": {"match": {"body": "absent-tok"}}})
+    started = [n for n in c.nodes if n._started]
+    coordinator = started[rnd.randrange(len(started))]
+    victim = next(n for n in started if n is not coordinator)
+    from elasticsearch_tpu.rest.controller import RestController
+    from elasticsearch_tpu.rest.handlers import register_all
+    rc = RestController()
+    register_all(rc, coordinator)
+    q_body = {"query": {"match": {"body": "shared"}}, "size": 5}
+    r = coordinator.search("m_sched", dict(q_body))     # healthy warm-up
+    assert r["hits"]["total"] == n_docs
+    errors: list = []
+    shed_429: list = []
+
+    def query_client(ci):
+        for qi in range(4):
+            try:
+                r = coordinator.search("m_sched", dict(q_body))
+                if r["hits"]["total"] != n_docs or r["_shards"]["failed"]:
+                    errors.append(("query", r["_shards"],
+                                   r["hits"]["total"]))
+            except SchedulerRejectedError as e:
+                shed_429.append(("query", e.reason))
+            except Exception as e:   # noqa: BLE001 — surfaced below
+                errors.append(("query-raised", e))
+
+    def knn_client(ci):
+        for qi in range(3):
+            try:
+                r = coordinator.search("m_sched", {
+                    "knn": {"field": "v",
+                            "query_vector": [1.0, 0.5, float(qi), 0.1],
+                            "k": 3, "num_candidates": 16}, "size": 3})
+                if r["_shards"]["failed"] or \
+                        len(r["hits"]["hits"]) != 3:
+                    errors.append(("knn", r["_shards"]))
+            except SchedulerRejectedError as e:
+                shed_429.append(("knn", e.reason))
+            except Exception as e:   # noqa: BLE001 — surfaced below
+                errors.append(("knn-raised", e))
+
+    def percolate_client(ci):
+        import json as _json
+        for qi in range(3):
+            try:
+                st, out = rc.dispatch(
+                    "GET", "/m_sched/doc/_percolate",
+                    _json.dumps({"doc": {
+                        "body": "shared probe"}}).encode())
+                if st == 429:
+                    shed_429.append(("percolate", "slo-shed"))
+                elif st != 200 or out["total"] != 1:
+                    errors.append(("percolate", st, out))
+            except SchedulerRejectedError as e:
+                shed_429.append(("percolate", e.reason))
+            except Exception as e:   # noqa: BLE001 — surfaced below
+                errors.append(("percolate-raised", e))
+
+    def bulk_client(ci):
+        for qi in range(6):
+            try:
+                a.index_doc("m_sched", f"bulk-{ci}-{qi}",
+                            {"body": "bulkdoc", "n": 1000 + qi,
+                             "v": [0.1, 0.2, 0.3, 0.4]})
+            except Exception as e:   # noqa: BLE001 — surfaced below
+                errors.append(("bulk-raised", e))
+    scheme_seed = rnd.randrange(2 ** 31)
+    with BrownoutScheme([victim], delay_s=rnd.uniform(0.1, 0.25),
+                        seed=scheme_seed).applied(), \
+            DeviceFaultScheme(seed=scheme_seed,
+                              p=rnd.uniform(0.03, 0.1)).applied():
+        threads = [threading.Thread(target=fn, args=(ci,), daemon=True)
+                   for ci, fn in enumerate(
+                       [query_client, query_client, query_client,
+                        knn_client, percolate_client, bulk_client])]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(90)
+        assert not any(t.is_alive() for t in threads), \
+            "mixed storm wedged: a scheduler client never completed " \
+            "(starvation)"
+        assert not errors, errors[:3]
+    # exact counter reconciliation once the storm drains, on every node
+    for n in started:
+        sched = n.search_actions.scheduler
+        assert wait_until(
+            lambda s=sched: (lambda st: st["queue_depth"] == 0
+                             and st["in_flight_requests"] == 0
+                             and st["batches_in_flight"] == 0)(s.stats()),
+            timeout=10.0), (n.node_name, sched.stats())
+        st = sched.stats()
+        assert st["reconciled"], (n.node_name, st)
+        assert st["submitted"] == st["delivered"] + st["declined"] + \
+            st["shed"], (n.node_name, st)
+        assert st["batches_launched"] == st["batches_drained"], \
+            (n.node_name, st)
+    # any shed surfaced as the typed 429 with a registered reason
+    from elasticsearch_tpu.search import lanes as lane_reg
+    for _, reason in shed_429:
+        assert reason in lane_reg.LANE_REASONS["scheduler"], shed_429
+    # nothing leaks: request-breaker bytes and open spans drain to zero
+    assert wait_until(lambda: all(
+        n.breaker_service.breaker("request").used == 0
+        for n in started), timeout=15.0), \
+        [(n.node_name, n.breaker_service.breaker("request").used)
+         for n in started]
+    assert all(obs_trace.open_span_count(n.node_id) == 0
+               for n in started), \
+        [(n.node_name, obs_trace.store_stats(n.node_id))
+         for n in started]
+    # healed: the same mixed shapes stay exact after the faults lift
+    r = coordinator.search("m_sched", dict(q_body))
+    assert r["hits"]["total"] >= n_docs and \
+        r["_shards"]["failed"] == 0, r["_shards"]
