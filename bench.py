@@ -54,6 +54,45 @@ def lat_pcts(ms) -> dict:
             "p999_ms": round(float(np.percentile(arr, 99.9)), 2)}
 
 
+def program_costs_snapshot(lane_filter=None, top: int = 8) -> dict:
+    """The program cost observatory's leg record: per-lane rollups
+    (aggregated over every attributed node table) plus the hottest
+    programs, each carrying predicted vs measured µs, the accuracy
+    ratio and the roofline regime — the per-(lane, shape) cost table
+    the BENCH_r06 chip capture stamps next to its latency figures."""
+    from elasticsearch_tpu.observability import costs as _costs
+    lanes_agg: dict = {}
+    rows: list = []
+    for nid in (_costs.node_ids() or [""]):
+        for lane, ent in _costs.lane_rollup(nid).items():
+            if lane_filter is not None and lane not in lane_filter:
+                continue
+            agg = lanes_agg.setdefault(lane, dict(ent))
+            if agg is not ent:
+                for key in ("resident", "compiles", "compile_ms",
+                            "dispatches", "device_time_us", "requests",
+                            "rows"):
+                    agg[key] += ent[key]
+        rows.extend(r for r in _costs.top_programs(nid, n=top)
+                    if lane_filter is None or r["lane"] in lane_filter)
+    rows.sort(key=lambda r: -r["device_time_us"])
+    return {"lanes": lanes_agg, "top": rows[:top]}
+
+
+def program_cost_floor_ms(lane_filter=None):
+    """The cost table's measured dispatch floor (min EWMA over
+    dispatched programs, ms) — cross-checked against the span-derived
+    ``rtt_floor_ms_spans``: two independent books measuring the same
+    device round trips must agree to a small factor."""
+    from elasticsearch_tpu.observability import costs as _costs
+    floors = [rec.ewma_us / 1e3
+              for nid in (_costs.node_ids() or [""])
+              for rec in _costs.table(nid).records()
+              if rec.dispatches > 0 and
+              (lane_filter is None or rec.lane in lane_filter)]
+    return round(min(floors), 3) if floors else None
+
+
 def timed_throughput(run, batches, n_threads: int = 1):
     """The one measurement discipline for every engine-path config: one
     warm run (the compile-cache hit), then either the full batch list
@@ -640,8 +679,10 @@ def main() -> int:
                 for qi in range(ncq):
                     t1, t2 = qtids_all[qi][0], qtids_all[qi][1]
                     d = int(rng.integers(0, n_docs))
-                    p = int(rng.integers(0, max(int(lens[d]) - 1, 1)))
-                    a, b_ = int(toks[d, p]), int(toks[d, p + 1])
+                    # NOT `p` — that name is the run-wide BM25Params,
+                    # which the impact leg reads as p.k1 much later
+                    pos = int(rng.integers(0, max(int(lens[d]) - 1, 1)))
+                    a, b_ = int(toks[d, pos]), int(toks[d, pos + 1])
                     if a < 0 or b_ < 0:
                         a, b_ = int(toks[d, 0]), int(toks[d, 1])
                     bodies.append({"query": {"bool": {
@@ -891,6 +932,18 @@ def main() -> int:
             "overhead_ok": spans_off_delta == 0,
             "histograms": obs_hist.summaries("bench"),
         }
+        # cross-check: the cost table's measured dispatch floor vs the
+        # span-derived RTT floor — two independent books over the same
+        # device round trips; "consistent" means within a 10x band
+        # (spans time ONE dispatch+fetch, the EWMA smooths many and CPU
+        # overheads differ) and both present — honest on divergence
+        cost_floor = program_cost_floor_ms()
+        trace_art["rtt_floor_ms_costs"] = cost_floor
+        span_floor = trace_art["rtt_floor_ms_spans"]
+        trace_art["rtt_floor_consistent"] = (
+            bool(span_floor and cost_floor and
+                 0.1 <= span_floor / cost_floor <= 10.0)
+            if (span_floor and cost_floor) else None)
         trace_path = os.environ.get("BENCH_TRACE_OUT",
                                     "TRACE_engine.json")
         try:
@@ -1054,7 +1107,10 @@ def main() -> int:
                   "compile_s": round(compile_s, 1),
                   "trace": trace_art,
                   "configs": configs,
-                  "rag_hybrid": rag_hybrid}
+                  "rag_hybrid": rag_hybrid,
+                  # per-(lane, shape) predicted-vs-measured cost books
+                  # accumulated over this leg's programs
+                  "program_costs": program_costs_snapshot()}
         eng.close()
 
         # ---- BASELINE config 5: 8-shard query_then_fetch top-1000 ------
@@ -1520,6 +1576,8 @@ def main() -> int:
                 "program_hits": js1["percolate_program_hits"],
                 "program_misses": js1["percolate_program_misses"],
                 "registry": reg_st,
+                "program_costs": program_costs_snapshot(
+                    lane_filter=("percolate",)),
             }
             log(f"[bench] percolate {n_regs} regs: serial "
                 f"{serial_ms:.1f} ms/probe vs batched {batched_ms:.1f} "
@@ -1862,6 +1920,8 @@ def main() -> int:
             "rank_identical_to_exact": imp_rank_identical,
             "pruned_identical_to_eager": pruned_identical,
             "bound_per_term": round(float(pack.bound_per_term), 6),
+            "program_costs": program_costs_snapshot(
+                lane_filter=("impact-eager", "impact-pruned")),
         }
         log(f"[bench] impact_pruning: exact {exact_ms:.1f} ms/batch, "
             f"eager {eager_ms:.1f} ms/batch "
@@ -2100,6 +2160,7 @@ def main() -> int:
         "admission_rate": round(
             _m_total / max(_m_total + _js["plane_fallbacks"], 1), 3),
         "fallback_reasons": _js["fallback_reasons"],
+        "program_costs": program_costs_snapshot(lane_filter=("mesh",)),
     }
     log(f"[bench] collective plane: {_m_total} mesh dispatches, "
         f"{_js['mesh_program_misses']} program compiles, "
@@ -2221,6 +2282,10 @@ def main() -> int:
                 "rates": {nid or "_process": _ts.rates(nid)
                           for nid in tel_ids},
             }
+            # the whole run's program cost books: per-lane predicted vs
+            # measured µs + the hottest programs — the cost observatory
+            # record the chip capture reads residency/latency from
+            record["program_costs"] = program_costs_snapshot(top=12)
             dm = record["telemetry"]["device_memory"]
             log(f"[bench] telemetry: HBM ledger "
                 f"{dm['total_bytes']} bytes across {dm['entries']} "

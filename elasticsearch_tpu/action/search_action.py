@@ -705,14 +705,20 @@ class SearchActions:
         if not obs_trace.active():
             with attribution.collect(admission="fanout"):
                 return fn()
+        from elasticsearch_tpu.observability import costs as obs_costs
         with attribution.collect(admission="fanout"), \
+                obs_costs.collect_programs() as progs, \
                 obs_trace.collect_spans() as spans, \
                 obs_trace.span(phase, index=name, shard=shard):
             out = fn()
         out = dict(out)
         out["_profile"] = {"index": name, "shard": shard,
                            "node": self.node.node_id,
-                           "spans": obs_trace.build_tree(spans)}
+                           "spans": obs_trace.build_tree(spans),
+                           # this shard phase's compiled programs (cost-
+                           # observatory keys + measured µs), hottest
+                           # first — joins the spans to /_cat/programs
+                           "programs": obs_costs.render_rows(progs)}
         return out
 
     def _attach_ars(self, out: dict, t0: float) -> dict:
@@ -1535,8 +1541,11 @@ class SearchActions:
                 # trace id IS the coordinating task id: the span tree
                 # and the task tree describe the same request, and
                 # GET /_tasks/{id}/trace joins them back up
+                from elasticsearch_tpu.observability import \
+                    costs as obs_costs
                 with obs_trace.trace(task.task_id, self.node.node_id), \
                         obs_trace.profile_sink() as shard_profiles, \
+                        obs_costs.collect_programs() as coord_progs, \
                         obs_trace.collect_spans() as coord_spans, \
                         obs_trace.span("search", index=index_expr):
                     resp = self._search(index_expr, body, scroll=scroll,
@@ -1549,6 +1558,12 @@ class SearchActions:
                         "coordinator":
                             obs_trace.build_tree(coord_spans),
                         "shards": shard_profiles,
+                        # coordinator-dispatched compiled programs (the
+                        # collective plane, scheduler batches bound to
+                        # this request) with cost-observatory keys +
+                        # measured µs; per-shard rows ride each shard's
+                        # profile payload
+                        "programs": obs_costs.render_rows(coord_progs),
                     }
             else:
                 resp = self._search(index_expr, body, scroll=scroll,

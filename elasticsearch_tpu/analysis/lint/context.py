@@ -57,6 +57,11 @@ RULE_FAMILIES = {
     "fallback-duplicate-reason": "fallback-taxonomy",
     "fallback-unused-reason": "fallback-taxonomy",
     "fallback-unresolved-reason": "fallback-taxonomy",
+    # program-cost-discipline: every program compile flows through the
+    # observed_compile seam (so the cost observatory sees it), under a
+    # registered program-lane literal
+    "program-cost-unobserved": "program-cost-discipline",
+    "program-cost-unknown-lane": "program-cost-discipline",
     "allow-missing-reason": "meta",
     "allow-stale": "meta",
 }
@@ -145,8 +150,10 @@ class LintConfig:
     #: constructors are DEFINED there, not leaked)
     span_exempt_modules: tuple = ("*/observability/*",)
     #: closures passed (by name) to these functions are compiled behind
-    #: a guarded, cache-keyed trampoline
-    trampolines: tuple = ("_get_compiled",)
+    #: a guarded, cache-keyed trampoline (observed_compile owns the
+    #: fault point + cost-table stamp for the lowered program it
+    #: receives)
+    trampolines: tuple = ("_get_compiled", "observed_compile")
     #: referencing any of these inside a function counts as consulting
     #: the PROGRAM-layer cache (recompile rule)
     cache_markers: tuple = ("_get_compiled", "_program_cache",
@@ -205,6 +212,30 @@ class LintConfig:
     #: referenced registry exports every key by construction — and an
     #: unreferenced one is a whole counter family invisible to scrapes)
     exporter_modules: tuple = ("*/observability/openmetrics.py",)
+
+    # ---- program-cost-discipline -----------------------------------------
+    #: modules whose program compiles must flow through the
+    #: observed_compile seam (the compiled-program homes)
+    cost_seam_modules: tuple = ("*/search/jit_exec.py",
+                                "*/parallel/mesh_engine.py")
+    #: the seam functions allowed to call ``.compile()`` on a lowered
+    #: program (everything else routes through them)
+    cost_seam_fns: tuple = ("observed_compile",)
+    #: callables whose ``lane`` argument must be a PROGRAM_LANES string
+    #: literal at the call site (forwarded parameters inside these
+    #: functions themselves are exempt, the seam-wrapper discipline)
+    cost_lane_callers: tuple = ("observed_compile", "_get_compiled")
+    #: the registered program lanes (mirrors lanes.PROGRAM_LANES; the
+    #: tier-1 fixture suite asserts the two stay in sync)
+    program_lanes: tuple = ("segment", "segment-batch", "reader-batch",
+                            "streamed", "percolate", "impact-eager",
+                            "impact-pruned", "knn", "mesh")
+    #: gauge registries in the lane-registry module: emitted into
+    #: lane_graph.json next to the counter registries and required (by
+    #: counter-unexported) to be referenced by the exporter, but their
+    #: keys are computed gauges — never bumped, so the unbumped check
+    #: skips them
+    gauge_registry_names: tuple = ("PROGRAM_COST",)
 
     # ---- fallback-taxonomy (whole-program) -------------------------------
     #: reason-noting callables, by last name → lane whose vocabulary

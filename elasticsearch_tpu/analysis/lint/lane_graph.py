@@ -95,7 +95,12 @@ def build_lane_graph(program, cfg=DEFAULT_CONFIG) -> dict:
                  for a, b, r in edges_reg]
 
     counters_out = {}
-    for name in cfg.counter_registry_names:
+    # gauge registries (PROGRAM_COST — the cost observatory's exported
+    # surface) ride next to the counter registries: the planner reads
+    # the lanes' observable cost fields from the same artifact as their
+    # admission model
+    for name in (tuple(cfg.counter_registry_names) +
+                 tuple(getattr(cfg, "gauge_registry_names", ()))):
         for ctx in program.registry_contexts(cfg.counter_registry_modules):
             value = literal_assignment(ctx.tree, name)
             if isinstance(value, ast.Dict):
@@ -103,12 +108,24 @@ def build_lane_graph(program, cfg=DEFAULT_CONFIG) -> dict:
                     k.value for k in value.keys
                     if isinstance(k, ast.Constant))
 
+    # the program-lane vocabulary (lanes.PROGRAM_LANES) — the cost
+    # observatory's lane axis, alongside the serving-lane reasons
+    program_lanes = None
+    for ctx in program.registry_contexts(cfg.lane_registry_modules):
+        value = literal_assignment(ctx.tree, "PROGRAM_LANES")
+        if value is not None:
+            try:
+                program_lanes = sorted(const_of(value))
+            except ValueError:
+                program_lanes = None
+
     return {
         "version": 1,
         "tool": "plane-lint",
         "lanes": lanes_out,
         "decline_edges": edges_out,
         "counters": counters_out,
+        "program_lanes": program_lanes or [],
     }
 
 

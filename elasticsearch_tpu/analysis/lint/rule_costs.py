@@ -1,0 +1,136 @@
+"""program-cost-discipline: every program compile is observed.
+
+The cost observatory (observability/costs.py) can only model what it
+sees: a ``.lower(...).compile(...)`` that bypasses the
+``jit_exec.observed_compile`` seam produces a compiled program with no
+cost-table row — invisible to ``/_cat/programs``, unpriceable by the
+planner's ``estimate()``, and missing from the predicted-vs-measured
+accounting. Config-driven like the device-seam upload_sites family:
+
+* ``program-cost-unobserved`` — inside the cost seam modules
+  (``cfg.cost_seam_modules``: jit_exec / mesh_engine), a ``.compile()``
+  call on a lowered program — the direct
+  ``jax.jit(f).lower(...).compile()`` chain, or a ``.compile()`` on a
+  local previously bound to a ``.lower(...)`` result — anywhere except
+  inside a registered seam function (``cfg.cost_seam_fns``:
+  ``observed_compile``) is an error: route the LOWERED program through
+  the seam and let it own the ``.compile()``.
+
+* ``program-cost-unknown-lane`` — a call to a lane-taking entry point
+  (``cfg.cost_lane_callers``: ``observed_compile`` / ``_get_compiled``)
+  whose ``lane`` argument is not a string literal from
+  ``cfg.program_lanes`` (mirroring ``lanes.PROGRAM_LANES``) — or is
+  missing entirely. The closed-vocabulary discipline of
+  ``device-unknown-site``: a misspelled lane silently splits a
+  program's books. Inside a lane caller itself a forwarded ``lane``
+  parameter is exempt (its literals are checked at every call site —
+  the seam-wrapper idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticsearch_tpu.analysis.lint.context import (
+    Finding, apply_suppressions, last_name, module_matches)
+
+
+def _is_lower_call(node) -> bool:
+    return isinstance(node, ast.Call) and \
+        isinstance(node.func, ast.Attribute) and \
+        node.func.attr == "lower"
+
+
+def _lower_bound_names(fn_node) -> set:
+    """Names bound (anywhere in `fn_node`) to a ``.lower(...)`` call
+    result — ``lowered = jax.jit(f).lower(*shapes)``."""
+    out = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Assign) and _is_lower_call(n.value):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _in_seam_fn(ctx, node, cfg) -> bool:
+    for info in ctx.enclosing_chain(node):
+        if info.name in cfg.cost_seam_fns:
+            return True
+    return False
+
+
+def _lane_arg(call: ast.Call, fn_name: str):
+    """The ``lane`` argument expression of a lane-caller call, or None
+    when absent. observed_compile takes lane positionally first;
+    _get_compiled takes it as the third positional or ``lane=``."""
+    for kw in call.keywords:
+        if kw.arg == "lane":
+            return kw.value
+    if fn_name == "observed_compile" and call.args:
+        return call.args[0]
+    if fn_name == "_get_compiled" and len(call.args) >= 3:
+        return call.args[2]
+    return None
+
+
+def check(ctx, cfg, program=None) -> list:
+    findings, nodes = [], []
+    in_cost_seam = module_matches(ctx.relpath, cfg.cost_seam_modules)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+
+        # ---- lane literals at the seam entry points ----------------------
+        fn_name = last_name(node.func)
+        if fn_name in cfg.cost_lane_callers:
+            lane = _lane_arg(node, fn_name)
+            ok = isinstance(lane, ast.Constant) and \
+                lane.value in cfg.program_lanes
+            if not ok and isinstance(lane, ast.Name):
+                # forwarded parameter inside a lane caller itself:
+                # checked at that caller's call sites instead
+                enc = ctx.enclosing_function(node)
+                if enc is not None and enc.name in cfg.cost_lane_callers:
+                    params = {a.arg for a in enc.node.args.args +
+                              enc.node.args.kwonlyargs}
+                    ok = lane.id in params
+            if not ok:
+                findings.append(Finding(
+                    "program-cost-unknown-lane", ctx.relpath,
+                    node.lineno,
+                    f"{fn_name}() lane must be a string literal from "
+                    f"{sorted(cfg.program_lanes)} "
+                    f"(lanes.PROGRAM_LANES) — an unregistered lane "
+                    f"splits the program's cost books"))
+                nodes.append(node)
+            continue
+
+        # ---- unobserved compiles inside the seam modules -----------------
+        if not in_cost_seam:
+            continue
+        if not (isinstance(node.func, ast.Attribute) and
+                node.func.attr == "compile"):
+            continue
+        recv = node.func.value
+        direct = _is_lower_call(recv)
+        via_name = False
+        if isinstance(recv, ast.Name):
+            fn = ctx.enclosing_function(node)
+            scope = fn.node if fn is not None else ctx.tree
+            via_name = recv.id in _lower_bound_names(scope)
+        if not (direct or via_name):
+            continue
+        if _in_seam_fn(ctx, node, cfg):
+            continue
+        findings.append(Finding(
+            "program-cost-unobserved", ctx.relpath, node.lineno,
+            f".lower(...).compile(...) outside "
+            f"{'/'.join(cfg.cost_seam_fns)} — this program never "
+            f"reaches the cost observatory (no /_cat/programs row, no "
+            f"estimate()); return the LOWERED program and route it "
+            f"through jit_exec.observed_compile"))
+        nodes.append(node)
+
+    return apply_suppressions(ctx, findings, nodes)

@@ -896,6 +896,8 @@ class Node:
         osx = os_stats()
         heap = ps["mem"]["resident_in_bytes"]
         total_mem = osx.get("mem", {}).get("total_in_bytes", heap or 1)
+        from elasticsearch_tpu.observability import costs as _costs
+        from elasticsearch_tpu.observability import flightrec as _flight
         from elasticsearch_tpu.observability import histograms as _hist
         from elasticsearch_tpu.observability import slo as _slo
         from elasticsearch_tpu.observability import timeseries as _ts
@@ -935,6 +937,15 @@ class Node:
             # sample-time reconciliation verdict (submitted == queued +
             # in_flight + delivered + declined + shed)
             "scheduler": self.search_actions.scheduler.stats(),
+            # program cost observatory: per-lane rollups over the
+            # resident compiled programs (XLA static cost + live
+            # dispatch stats, predicted vs measured) and the top
+            # programs by device time; table accounting reconciles
+            # (inserted == resident + evicted + dropped)
+            "programs": _costs.stats_doc(self.node_id),
+            # anomaly flight recorder occupancy (full ring via
+            # GET /_nodes/diagnostics)
+            "flight_recorder": _flight.stats(self.node_id),
             # per-lane latency distributions (fixed-bucket histograms,
             # always on) + this node's span-store accounting
             "latency": _hist.summaries(self.node_id),
@@ -1197,6 +1208,41 @@ class Node:
         return timeseries.tick(
             self.node_id, extra=extra,
             ledger=self.breaker_service.device_ledger, force=force)
+
+    def collect_diagnostics(self, top: int = 25) -> dict:
+        """GET /_nodes/diagnostics — the anomaly flight recorder's ring
+        plus every book an operator needs next to it to diagnose a
+        blown SLO after the fact, as ONE bundle: the program cost table
+        (top programs + per-lane rollups), the device-memory ledger,
+        windowed rates + SLO burn, scheduler depths, and breaker
+        states (plane + byte breakers)."""
+        from elasticsearch_tpu.observability import costs as _costs
+        from elasticsearch_tpu.observability import flightrec as _flight
+        from elasticsearch_tpu.observability import slo as _slo
+        from elasticsearch_tpu.observability import timeseries as _ts
+        from elasticsearch_tpu.search import jit_exec as _jit_exec
+        self.telemetry_tick()
+        rates_doc = _ts.rates(self.node_id)
+        rates_doc["slo_burn"] = _slo.windowed_burn(self.node_id,
+                                                   rates_doc)
+        return {
+            "name": self.node_name,
+            "timestamp": int(time.time() * 1000),
+            "flight_recorder": {
+                **_flight.stats(self.node_id),
+                "events": _flight.events(self.node_id),
+            },
+            "programs": _costs.stats_doc(self.node_id, top=top),
+            "device_memory": self.breaker_service.device_ledger.snapshot(
+                resolve_index=self.resolve_engine_index),
+            "rates": rates_doc,
+            "slo": _slo.stats(self.node_id),
+            "scheduler": self.search_actions.scheduler.stats(),
+            "breakers": {
+                "plane": _jit_exec.plane_breaker.stats(),
+                "bytes": self.breaker_service.stats(),
+            },
+        }
 
     def collect_hot_threads(self, **params) -> str:
         per_node = self._fan_out_nodes(self.HOT_THREADS_ACTION, params)

@@ -23,6 +23,15 @@ Submodules (import what you feed, re-exported here for convenience):
   ``/_prometheus/metrics`` exposition, generated FROM the lane
   registry (imported lazily by the REST handler — it pulls in
   ``search.lanes``, which this package must not import at load time).
+* :mod:`~elasticsearch_tpu.observability.costs` — the program cost
+  observatory: per-compiled-program XLA cost/memory analysis joined
+  with live dispatch statistics, predicted-vs-measured accounting and
+  the planner's ``estimate()`` API (``_nodes/stats.programs``,
+  ``/_cat/programs``).
+* :mod:`~elasticsearch_tpu.observability.flightrec` — the anomaly
+  flight recorder: a bounded ring of typed events (dispatch overruns,
+  compile storms, shed bursts, breaker transitions) dumped by
+  ``GET /_nodes/diagnostics``.
 * :mod:`~elasticsearch_tpu.observability.attribution` — per-request
   plane attribution for slow-log lines.
 * :mod:`~elasticsearch_tpu.observability.chrome` — Trace Event Format
@@ -32,9 +41,11 @@ Submodules (import what you feed, re-exported here for convenience):
 """
 
 from elasticsearch_tpu.observability import (  # noqa: F401
-    attribution, chrome, histograms, ledger, slo, timeseries, tracing)
+    attribution, chrome, costs, flightrec, histograms, ledger, slo,
+    timeseries, tracing)
 from elasticsearch_tpu.observability.context import (  # noqa: F401
     current_node_id, use_node)
 
-__all__ = ["attribution", "chrome", "histograms", "ledger", "slo",
-           "timeseries", "tracing", "current_node_id", "use_node"]
+__all__ = ["attribution", "chrome", "costs", "flightrec", "histograms",
+           "ledger", "slo", "timeseries", "tracing", "current_node_id",
+           "use_node"]

@@ -47,7 +47,7 @@ def collect(**labels):
     """Install a fresh attribution record for the duration; initial
     ``labels`` (e.g. ``admission="fanout"``) seed it."""
     prev = _install({"labels": dict(labels), "counts": {},
-                     "device_ms": {}})
+                     "device_ms": {}, "programs": {}})
     try:
         yield _tls.attr
     finally:
@@ -72,6 +72,20 @@ def device_ms(site: str, ms: float) -> None:
     if a is not None:
         d = a["device_ms"]
         d[site] = d.get(site, 0.0) + ms
+
+
+def program(lane: str, key_id: str, dur_us: float) -> None:
+    """One program dispatch attributed to the in-flight request (the
+    cost observatory's seam feeds this): per-program dispatch count +
+    device µs, so the slow log can name the HOT program."""
+    a = getattr(_tls, "attr", None)
+    if a is not None:
+        p = a.setdefault("programs", {})
+        ent = p.get((lane, key_id))
+        if ent is None:
+            ent = p[(lane, key_id)] = [0, 0.0]
+        ent[0] += 1
+        ent[1] += dur_us
 
 
 def render_current(took_s: float | None = None) -> str | None:
@@ -99,8 +113,17 @@ def render_current(took_s: float | None = None) -> str | None:
         c.get("percolate_program_hits", 0)
     misses = c.get("misses", 0) + c.get("mesh_program_misses", 0) + \
         c.get("percolate_program_misses", 0)
-    if hits or misses:
-        parts.append(f"programs[{hits}h/{misses}m]")
+    progs = a.get("programs") or {}
+    if hits or misses or progs:
+        frag = f"programs[{hits}h/{misses}m"
+        if progs:
+            # name the request's HOT program (most device time) with
+            # its measured µs — the cost-observatory join key: the
+            # same lane:key digest /_cat/programs prints
+            (lane, key_id), (n, us) = max(progs.items(),
+                                          key=lambda kv: kv[1][1])
+            frag += f" hot={lane}:{key_id}/{us:.0f}us×{n}"
+        parts.append(frag + "]")
     if c.get("fallbacks"):
         parts.append(f"eager_fallbacks[{c['fallbacks']}]")
     dev_total = sum(a["device_ms"].values())

@@ -1,0 +1,182 @@
+"""Anomaly flight recorder — a bounded ring of typed events, dumped as
+one bundle by ``GET /_nodes/diagnostics`` so a blown SLO is diagnosable
+after the fact.
+
+The telemetry plane (PR 13) answers "what is the rate RIGHT NOW"; the
+flight recorder answers "what HAPPENED around 14:03:07". Four event
+classes, each a closed, registered type (:data:`EVENT_TYPES` — an
+unregistered type is a programming error, the lane-reason discipline):
+
+* ``dispatch-overrun`` — a dispatch ≥ :data:`~elasticsearch_tpu.
+  observability.costs.ANOMALY_FACTOR`× its program's predicted+EWMA
+  envelope (the cost observatory's anomaly check);
+* ``compile-storm`` — a program compile hitting a previously-hot key
+  (the program cache stopped holding the working set);
+* ``shed-burst`` — scheduler sheds, coalesced per reason: sheds within
+  :data:`BURST_GAP_S` of each other fold into one event whose ``count``
+  grows, so a 429 storm is one ring entry, not a ring wipe;
+* ``breaker-open`` / ``breaker-half-open`` / ``breaker-closed`` — the
+  plane breaker's state transitions.
+
+Every event stamps wall-clock µs plus the active trace id and task id
+when a request context is live, so the ring joins back to
+``/_tasks/{id}/trace`` and the slow log. Rings are per node (the
+context.py attribution), bounded at :data:`RING_CAP` with an exact
+``recorded``/``overflowed`` tally, and nothing allocates when nothing
+anomalous happens — the hot path never touches this module.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from elasticsearch_tpu.observability.context import current_node_id
+
+#: the closed event vocabulary (check_event asserts membership — an
+#: unregistered type is a programming error, like a lane reason)
+EVENT_TYPES = {
+    "dispatch-overrun": "dispatch blew its program's predicted+EWMA "
+                        "envelope by the anomaly factor",
+    "compile-storm": "program compile on a previously-hot key (working "
+                     "set fell out of the program cache)",
+    "shed-burst": "scheduler shed burst, coalesced per reason",
+    "breaker-open": "plane breaker tripped open (device declared "
+                    "unhealthy; compiled lanes decline)",
+    "breaker-half-open": "plane breaker probing (one request admitted)",
+    "breaker-closed": "plane breaker closed (probe succeeded; compiled "
+                      "lanes readmit)",
+}
+
+#: ring capacity per node
+RING_CAP = 256
+#: sheds closer together than this coalesce into one burst event
+BURST_GAP_S = 1.0
+
+
+def check_event(event_type: str) -> str:
+    assert event_type in EVENT_TYPES, (
+        f"unregistered flight-recorder event type {event_type!r} — add "
+        f"it to elasticsearch_tpu.observability.flightrec.EVENT_TYPES")
+    return event_type
+
+
+class _Ring:
+    __slots__ = ("events", "recorded", "overflowed", "_lock",
+                 "_burst_key", "_burst_t", "_burst_event")
+
+    def __init__(self):
+        self.events: deque = deque(maxlen=RING_CAP)
+        self.recorded = 0
+        self.overflowed = 0
+        self._lock = threading.Lock()
+        self._burst_key = None          # (event type, reason) coalescing
+        self._burst_t = 0.0
+        self._burst_event: dict | None = None
+
+
+_rings: dict = {}
+_rings_lock = threading.Lock()
+
+
+def _ring(node_id: str) -> _Ring:
+    r = _rings.get(node_id)
+    if r is None:
+        with _rings_lock:
+            r = _rings.setdefault(node_id, _Ring())
+    return r
+
+
+def _context_ids() -> dict:
+    """The live request's trace/task ids, when one is active — the join
+    key back to /_tasks/{id}/trace and the slow log."""
+    out = {}
+    try:
+        from elasticsearch_tpu.observability import tracing
+        ctx = tracing.current_ctx()
+        if ctx is not None:
+            out["trace_id"] = ctx.trace_id
+    except Exception:                    # noqa: BLE001 — best-effort join
+        pass
+    try:
+        from elasticsearch_tpu.tasks import current_task
+        task = current_task()
+        if task is not None:
+            out["task_id"] = task.task_id
+    except Exception:                    # noqa: BLE001 — best-effort join
+        pass
+    return out
+
+
+def note(event_type: str, node_id: str | None = None, **attrs) -> dict:
+    """Record one typed event on the node's ring → the event dict."""
+    check_event(event_type)
+    nid = node_id if node_id is not None else (current_node_id() or "")
+    event = {"type": event_type,
+             "epoch_us": time.time_ns() // 1000,
+             **_context_ids(), **attrs}
+    r = _ring(nid)
+    with r._lock:
+        if len(r.events) == r.events.maxlen:
+            r.overflowed += 1
+        r.events.append(event)
+        r.recorded += 1
+    return event
+
+
+def note_shed(reason: str, n: int = 1,
+              node_id: str | None = None) -> None:
+    """Scheduler sheds, burst-coalesced: sheds of the same reason
+    within :data:`BURST_GAP_S` fold into the open burst event's count
+    instead of minting a new ring entry each."""
+    nid = node_id if node_id is not None else (current_node_id() or "")
+    r = _ring(nid)
+    now = time.monotonic()
+    with r._lock:
+        ev = r._burst_event
+        if ev is not None and r._burst_key == ("shed-burst", reason) \
+                and now - r._burst_t < BURST_GAP_S \
+                and r.events and r.events[-1] is ev:
+            ev["count"] += int(n)
+            r._burst_t = now
+            return
+        r._burst_key = ("shed-burst", reason)
+        r._burst_t = now
+    ev = note("shed-burst", node_id=nid, reason=reason, count=int(n))
+    with r._lock:
+        r._burst_event = ev
+
+
+def events(node_id: str | None = None, limit: int | None = None) -> list:
+    """One node's ring, oldest first (optionally the newest ``limit``)."""
+    nid = node_id if node_id is not None else (current_node_id() or "")
+    r = _ring(nid)
+    with r._lock:
+        out = list(r.events)
+    if limit is not None:
+        out = out[-max(int(limit), 0):]
+    return out
+
+
+def stats(node_id: str | None = None) -> dict:
+    nid = node_id if node_id is not None else (current_node_id() or "")
+    r = _ring(nid)
+    with r._lock:
+        by_type: dict = {}
+        for ev in r.events:
+            by_type[ev["type"]] = by_type.get(ev["type"], 0) + 1
+        return {"resident": len(r.events), "recorded": r.recorded,
+                "overflowed": r.overflowed, "cap": RING_CAP,
+                "by_type": by_type}
+
+
+def node_ids() -> list:
+    with _rings_lock:
+        return sorted(_rings)
+
+
+def reset() -> None:
+    """Drop every ring (tests)."""
+    with _rings_lock:
+        _rings.clear()

@@ -30,7 +30,7 @@ request serving.
 
 from __future__ import annotations
 
-from elasticsearch_tpu.observability import histograms, slo
+from elasticsearch_tpu.observability import costs, histograms, slo
 from elasticsearch_tpu.search import lanes
 
 
@@ -130,6 +130,19 @@ def render(node_id: str, jit_stats: dict, percolate_stats: dict,
         w.sample("estpu_lane_latency_ms_count", {"lane": lane}, count)
         w.sample("estpu_lane_latency_ms_sum", {"lane": lane},
                  round(sum_ms, 3))
+
+    # ---- program cost observatory (registry-driven gauges) -------------
+    # one estpu_program_cost_<field>{lane=} gauge per PROGRAM_COST key:
+    # the rollup dicts carry exactly the registry's fields, so a new
+    # registry entry exports with no exporter edit — the counter-
+    # registry construction discipline, applied to gauges
+    cost_lanes = costs.lane_rollup(node_id)
+    for key, help_ in lanes.PROGRAM_COST.items():
+        name = f"estpu_program_cost_{key}"
+        w.family(name, "gauge", help_)
+        for lane in sorted(cost_lanes):
+            w.sample(name, {"lane": lane},
+                     cost_lanes[lane].get(key, 0) or 0)
 
     # ---- device-memory ledger gauges -----------------------------------
     w.family("estpu_device_memory_bytes", "gauge",

@@ -271,13 +271,21 @@ def span(name: str, **attrs):
 class _DeviceSpan:
     """Device-seam region: always times (feeding the ``device_rtt``
     histogram for dispatch-class sites and the slow-log attribution),
-    allocates a real Span only when a trace is active."""
+    allocates a real Span only when a trace is active.
 
-    __slots__ = ("site", "_t0", "_span")
+    ``cost`` — a ``(lane, shape_key, n_real, rows)`` program identity —
+    additionally feeds the span's duration to the program cost
+    observatory (:mod:`~elasticsearch_tpu.observability.costs`) as one
+    dispatch sample. Recording happens on CLEAN exits only: a failed
+    dispatch (device fault, breaker-bound error) must never poison the
+    program's EWMA or histogram — the chaos suites pin this."""
 
-    def __init__(self, site: str):
+    __slots__ = ("site", "_t0", "_span", "_cost")
+
+    def __init__(self, site: str, cost: tuple | None = None):
         self.site = site
         self._span = None
+        self._cost = cost
 
     def __enter__(self):
         ctx = getattr(_tls, "ctx", None)
@@ -299,11 +307,16 @@ class _DeviceSpan:
         attribution.device_ms(self.site, dur_ms)
         if self.site in RTT_SITES:
             histograms.observe_lane("device_rtt", dur_ms)
+        if self._cost is not None and exc_type is None:
+            from elasticsearch_tpu.observability import costs
+            lane, shape_key, n_real, rows = self._cost
+            costs.note_dispatch(lane, shape_key, dur_ms,
+                                n_real=n_real, rows=rows)
         return False
 
 
-def device_span(site: str) -> _DeviceSpan:
-    return _DeviceSpan(site)
+def device_span(site: str, cost: tuple | None = None) -> _DeviceSpan:
+    return _DeviceSpan(site, cost)
 
 
 # ---------------------------------------------------------------------------
@@ -351,13 +364,15 @@ def bind_context(fn):
     collectors, profile sink, node override, attribution record) so
     ``fn`` runs under it on another thread — composed into
     ``tasks.bind_current`` so every existing submit seam carries it."""
+    from elasticsearch_tpu.observability import costs as _costs
     ctx = getattr(_tls, "ctx", None)
     colls = list(getattr(_tls, "collectors", ()) or ())
     sink = getattr(_tls, "sink", None)
     override = _current_override()
     attr = attribution.current()
+    prog_colls = _costs.current_collectors()
     if ctx is None and not colls and sink is None and override is None \
-            and attr is None:
+            and attr is None and prog_colls is None:
         return fn
 
     def bound(*args, **kwargs):
@@ -365,6 +380,7 @@ def bind_context(fn):
         prev_colls = getattr(_tls, "collectors", None)
         prev_sink = getattr(_tls, "sink", None)
         prev_attr = attribution._install(attr)
+        prev_prog = _costs.install_collectors(prog_colls)
         _tls.ctx = ctx
         _tls.collectors = colls
         _tls.sink = sink
@@ -378,6 +394,7 @@ def bind_context(fn):
             _tls.collectors = prev_colls
             _tls.sink = prev_sink
             attribution._install(prev_attr)
+            _costs.install_collectors(prev_prog)
 
     return bound
 

@@ -593,6 +593,12 @@ class _EngineBlocksRelease:
 
     def release(self) -> None:
         _block_cache.release_engine(self.engine_uuid)
+        # the cost observatory drains with the engine too: programs
+        # owned by this incarnation leave the table the same instant
+        # their device blocks leave the cache (no rows for closed
+        # engines — the ledger discipline)
+        from elasticsearch_tpu.observability import costs
+        costs.drop_owner(self.engine_uuid)
 
 
 def _segment_extrema(seg) -> dict:
@@ -1213,7 +1219,15 @@ class MeshEngineSearcher:
 
     def _program(self, sigs, layouts, k: int, b_pad: int, consts_tree,
                  emits, pfs, refss, templates0, agg_spec=None,
-                 bucket_specs=None, sort_specs=None, has_cursor=False):
+                 bucket_specs=None, sort_specs=None, has_cursor=False,
+                 cursors=None, kwsorts=None):
+        """→ (compiled program, program key). ``cursors``/``kwsorts``
+        are the dispatch-ready operands — a cache miss AOT-lowers
+        against them (through ``jit_exec.observed_compile``, which
+        stamps the XLA cost/memory analyses per program key) so the
+        cached object is the bare executable, same discipline as
+        ``_get_compiled``; the key pins every static the shapes derive
+        from, so re-dispatches against new data-layer packs match."""
         from elasticsearch_tpu.search import jit_exec
         # metric lanes return a field-ordered TUPLE, so only WHICH
         # fields get partials matters (renamed metric aggs share the
@@ -1250,8 +1264,7 @@ class MeshEngineSearcher:
                 _program_cache.move_to_end(key)
         jit_exec.note_mesh_program(fn is not None)
         if fn is not None:
-            return fn
-        jit_exec.device_fault_point("compile")
+            return fn, key
         n_slots = self.n_slots
         slot_bases = self.slot_bases
         stride = self.shard_stride
@@ -1624,21 +1637,29 @@ class MeshEngineSearcher:
             if h_named:
                 out_specs["histo"] = h_named
         from elasticsearch_tpu.parallel.mesh import shard_map_compat
-        with device_span("compile") as dsp:
+
+        def lower_fn():
             mapped = shard_map_compat(
                 step_local, mesh=self.mesh,
                 in_specs=(flat_specs, const_specs, cursor_spec,
                           kwsort_spec),
                 out_specs=out_specs)
-            fn = jax.jit(mapped)
-            dsp.set(layer="mesh-program")
+            # AOT-lower against the dispatch-ready operands: their
+            # shapes/shardings are pure functions of the key's statics,
+            # so the compiled executable re-dispatches across data-layer
+            # generations exactly like the jit closure did — but the
+            # observatory gets XLA's cost/memory analyses for the plane
+            return jax.jit(mapped).lower(self._flats, consts_tree,
+                                         cursors, kwsorts)
+
+        fn = jit_exec.observed_compile("mesh", key, lower_fn)
         # built OUTSIDE the lock (tracing is slow); a racing duplicate
         # build is harmless — last one wins the slot, like _get_compiled
         with _program_lock:
             _program_cache[key] = fn
             while len(_program_cache) > _PROGRAM_CACHE_CAP:
                 _program_cache.popitem(last=False)
-        return fn
+        return fn, key
 
     def search_batch(self, bodies: list[dict], global_stats: bool = True):
         """Execute B query-DSL request bodies as one mesh program →
@@ -1838,17 +1859,20 @@ class MeshEngineSearcher:
         kwsorts = self._kw_rank_operand(sort_specs)
 
         t1 = time.perf_counter()
-        fn = self._program(sigs, layouts, k, b_pad, consts_dev,
-                           emits, pfs, refss,
-                           [self._templates[0][j]
-                            for j in range(self.n_slots)],
-                           agg_spec=agg_spec, bucket_specs=bucket_specs,
-                           sort_specs=sort_specs, has_cursor=has_cursor)
+        fn, prog_key = self._program(
+            sigs, layouts, k, b_pad, consts_dev,
+            emits, pfs, refss,
+            [self._templates[0][j] for j in range(self.n_slots)],
+            agg_spec=agg_spec, bucket_specs=bucket_specs,
+            sort_specs=sort_specs, has_cursor=has_cursor,
+            cursors=cursors, kwsorts=kwsorts)
         from elasticsearch_tpu.search.jit_exec import device_fault_point
         # the span covers dispatch AND the first host fetches — the
         # np.asarray calls are where the host actually waits on the
         # device, so this duration IS the plane's device round trip
-        with device_span("plane-dispatch") as dsp:
+        with device_span("plane-dispatch",
+                         cost=("mesh", prog_key, len(reqs),
+                               b_pad)) as dsp:
             device_fault_point("plane-dispatch")
             outs = fn(self._flats, consts_dev, cursors, kwsorts)
             t2 = time.perf_counter()

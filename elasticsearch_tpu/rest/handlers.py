@@ -337,6 +337,13 @@ def register_all(rc: RestController, node) -> None:
     r("GET", "/_cat/fielddata", h.cat_fielddata)
     r("GET", "/_cat/fielddata/{fields}", h.cat_fielddata)
     r("GET", "/_cat/hbm", h.cat_hbm)
+    # program cost observatory (observability/costs.py): one row per
+    # resident compiled program
+    r("GET", "/_cat/programs", h.cat_programs)
+    # anomaly flight recorder + cost/ledger/rates/scheduler/breaker
+    # bundle (observability/flightrec.py)
+    r("GET", "/_nodes/diagnostics", h.nodes_diagnostics)
+    r("GET", "/_nodes/{node}/diagnostics", h.nodes_diagnostics)
     # OpenMetrics scrape endpoint (observability/openmetrics.py)
     r("GET", "/_prometheus/metrics", h.prometheus_metrics)
     r("GET", "/_cat/plugins", h.cat_plugins)
@@ -3170,7 +3177,8 @@ class Handlers:
                  "/_cat/fielddata", "/_cat/hbm",
                  "/_cat/health", "/_cat/indices",
                  "/_cat/master", "/_cat/nodeattrs", "/_cat/nodes",
-                 "/_cat/pending_tasks", "/_cat/plugins", "/_cat/recovery",
+                 "/_cat/pending_tasks", "/_cat/plugins",
+                 "/_cat/programs", "/_cat/recovery",
                  "/_cat/segments", "/_cat/shards",
                  "/_cat/snapshots/{repo}", "/_cat/tasks",
                  "/_cat/templates", "/_cat/thread_pool"]
@@ -3340,6 +3348,118 @@ class Handlers:
                   charged="true" if r["charged"] else "false",
                   idle=r["idle_s"], temp=r["temp"])
         return t.render(req)
+
+    @staticmethod
+    def _int_param(req: RestRequest, name: str, default: int,
+                   lo: int = 1, hi: int = 10000) -> int:
+        """Validated integer query param — the create-index settings
+        idiom: a typo is a typed 400 at the request, never a 500 from
+        deep inside a render loop."""
+        from elasticsearch_tpu.common.errors import IllegalArgumentError
+        raw = req.param(name)
+        if raw is None or raw == "":
+            return default
+        try:
+            val = int(raw)
+        except (TypeError, ValueError):
+            raise IllegalArgumentError(
+                f"[{name}] must be an integer, got [{raw}]") from None
+        if not lo <= val <= hi:
+            raise IllegalArgumentError(
+                f"[{name}] must be in [{lo}, {hi}], got {val}")
+        return val
+
+    def cat_programs(self, req: RestRequest):
+        """GET /_cat/programs — the program cost observatory's resident
+        rows on this node: one row per compiled program (lane × shape-
+        key digest) with its XLA static cost (flops, bytes, arithmetic
+        intensity, HBM peak), roofline regime and prediction, and the
+        live dispatch books (dispatches, occupancy under the n_real
+        contract, measured EWMA µs, accuracy ratio). ``?lane=`` filters
+        to one registered program lane (400 on an unknown one — the
+        closed-vocabulary discipline), ``?top=`` bounds rows (device-
+        time order)."""
+        from elasticsearch_tpu.common.errors import IllegalArgumentError
+        from elasticsearch_tpu.observability import costs
+        from elasticsearch_tpu.search import lanes as lane_reg
+        node = self.node
+        top = self._int_param(req, "top", 100)
+        lane = req.param("lane")
+        if lane is not None and lane not in lane_reg.PROGRAM_LANES:
+            raise IllegalArgumentError(
+                f"[lane] must be one of "
+                f"{sorted(lane_reg.PROGRAM_LANES)}, got [{lane}]")
+        rows = costs.top_programs(node.node_id, n=top, lane=lane)
+        cols = [
+            Col("node", ("n",), "node name"),
+            Col("lane", ("l",), "program lane (lanes.PROGRAM_LANES)"),
+            Col("key", ("k",), "program shape-key digest"),
+            Col("compiles", ("c",), "trace+compiles", right=True),
+            Col("compile_ms", ("cms",), "compile wall ms", right=True),
+            Col("dispatches", ("d",), "dispatches recorded", right=True),
+            Col("occupancy", ("occ",), "real requests / padded rows",
+                right=True),
+            Col("flops", ("f",), "XLA flop estimate", right=True,
+                default=False),
+            Col("bytes", ("by",), "XLA bytes-accessed estimate",
+                right=True, default=False),
+            Col("ai", desc="arithmetic intensity (flop/byte)",
+                right=True),
+            Col("hbm_peak", ("hp",), "argument+output+temp bytes",
+                right=True, default=False),
+            Col("regime", ("r",), "roofline wall: memory|compute"),
+            Col("predicted_us", ("p",), "roofline prediction (µs)",
+                right=True),
+            Col("measured_us", ("m",), "dispatch EWMA (µs)", right=True),
+            Col("accuracy", ("a",), "measured / predicted", right=True),
+            Col("device_ms", ("dms",), "accumulated device ms",
+                right=True),
+        ]
+        t = CatTable(cols)
+        for r in rows:
+            t.add(node=node.node_name, lane=r["lane"], key=r["key"],
+                  compiles=r["compiles"], compile_ms=r["compile_ms"],
+                  dispatches=r["dispatches"],
+                  occupancy="-" if r["occupancy"] is None
+                  else r["occupancy"],
+                  flops=int(r["flops"]), bytes=int(r["bytes_accessed"]),
+                  ai="-" if r["arithmetic_intensity"] is None
+                  else r["arithmetic_intensity"],
+                  hbm_peak=r["hbm_peak_bytes"], regime=r["regime"],
+                  predicted_us=r["predicted_us"],
+                  measured_us=r["measured_us"],
+                  accuracy="-" if r["accuracy_ratio"] is None
+                  else r["accuracy_ratio"],
+                  device_ms=round(r["device_time_us"] / 1e3, 3))
+        return t.render(req)
+
+    def nodes_diagnostics(self, req: RestRequest):
+        """GET /_nodes/diagnostics — the anomaly flight recorder's ring
+        plus the cost table, device-memory ledger, windowed rates + SLO
+        burn, scheduler depths and breaker states, as ONE bundle: the
+        after-the-fact diagnosis surface for a blown SLO. 404 on an
+        unknown {node} (only the local node's books live here)."""
+        node = self.node
+        target = req.path_params.get("node")
+        if target is not None and target not in (
+                "_all", "_local", node.node_id, node.node_name):
+            state = node.cluster_service.state()
+            n = state.nodes.get(target)
+            if n is None and not any(
+                    nn.name == target for nn in state.nodes.values()):
+                return 404, {"error": {
+                    "type": "resource_not_found_exception",
+                    "reason": f"no such node [{target}]"},
+                    "status": 404}
+            return 400, {"error": {
+                "type": "illegal_argument_exception",
+                "reason": f"diagnostics are node-local — ask "
+                          f"[{target}] directly (this node is "
+                          f"[{node.node_name}])"},
+                "status": 400}
+        top = self._int_param(req, "top", 25)
+        return 200, {"nodes": {
+            node.node_id: node.collect_diagnostics(top=top)}}
 
     def prometheus_metrics(self, req: RestRequest):
         """GET /_prometheus/metrics — the OpenMetrics exposition for

@@ -125,6 +125,37 @@ def seam_jit(fn, **kwargs):
         return jax.jit(fn, **kwargs)
 
 
+def observed_compile(lane: str, shape_key, lower_fn, *,
+                     owner: str | None = None):
+    """THE program-compile seam: every ``.lower(...).compile(...)`` in
+    the seam modules flows through here (plane-lint rule family
+    ``program-cost-discipline`` holds the tree to it).
+
+    ``lower_fn()`` returns the ``jax.stages.Lowered``; this seam owns
+    the ``.compile()`` so it can stamp, per program key (``lane`` ×
+    ``shape_key`` — the program cache's own key), the XLA static cost
+    analyses and the compile wall time into the per-node
+    ProgramCostTable (observability/costs.py). ``lane`` must be a
+    string literal from ``lanes.PROGRAM_LANES`` at the call site;
+    ``owner`` (an engine incarnation uuid, when the caller knows one)
+    lets the table drain the program's row when the engine closes.
+    The fault point and the compile span live here too, so chaos
+    injection and the tracer see exactly one compile per flow."""
+    assert lane in lanes.PROGRAM_LANES, (
+        f"unregistered program lane {lane!r} — add it to "
+        f"elasticsearch_tpu.search.lanes.PROGRAM_LANES")
+    from elasticsearch_tpu.observability import costs
+    with device_span("compile") as dsp:
+        device_fault_point("compile")
+        t0 = time.perf_counter()
+        compiled = lower_fn().compile()
+        compile_ms = (time.perf_counter() - t0) * 1e3
+        dsp.set(lane=lane, compile_ms=round(compile_ms, 3))
+    costs.note_compile(lane, shape_key, compiled, compile_ms,
+                       owner=owner)
+    return compiled
+
+
 def is_device_oom(exc: BaseException) -> bool:
     """Does this exception look like device memory exhaustion? Covers
     the injected :class:`DeviceOomError` and the strings real XLA
@@ -177,9 +208,25 @@ class PlaneBreaker:
         self._retry_at = 0.0
         self._probe_deadline: float | None = None
 
+    #: breaker state → registered flight-recorder event type
+    _TRANSITION_EVENTS = {"open": "breaker-open",
+                          "half_open": "breaker-half-open",
+                          "closed": "breaker-closed"}
+
+    @staticmethod
+    def _note_transition(state: str, **attrs) -> None:
+        """One breaker state transition on the flight recorder (called
+        AFTER the breaker lock releases — the ring lock stays a leaf)."""
+        from elasticsearch_tpu.observability import flightrec
+        flightrec.note(PlaneBreaker._TRANSITION_EVENTS[state],
+                       state=state, **attrs)
+
     def reset(self) -> None:
         with self._lock:
+            was = self.state
             self._reset_locked()
+        if was != "closed":
+            self._note_transition("closed", reset=True)
 
     def configure(self, threshold=None, backoff_s=None,
                   max_backoff_s=None) -> None:
@@ -199,6 +246,7 @@ class PlaneBreaker:
         backoff elapses); half-open → True for exactly one caller (the
         probe), False for everyone else."""
         now = time.monotonic()
+        probing = False
         with self._lock:
             if self.state == "closed":
                 return True
@@ -208,29 +256,38 @@ class PlaneBreaker:
                 self.state = "half_open"
                 self.probes += 1
                 self._probe_deadline = now + self.PROBE_TIMEOUT_S
-                return True
-            # half_open: one probe in flight at a time
-            if self._probe_deadline is not None and \
+                probing = True
+            elif self._probe_deadline is not None and \
                     now < self._probe_deadline:
+                # half_open: one probe in flight at a time
                 return False
-            self.probes += 1
-            self._probe_deadline = now + self.PROBE_TIMEOUT_S
-            return True
+            else:
+                self.probes += 1
+                self._probe_deadline = now + self.PROBE_TIMEOUT_S
+                return True
+        if probing:
+            self._note_transition("half_open", probes=self.probes)
+        return True
 
     def record_success(self) -> None:
         """A device dispatch completed: closes a half-open probe, resets
         the consecutive-error count."""
+        closed = False
         with self._lock:
             if self.state == "half_open":
                 self.state = "closed"
                 self._backoff_s = self.base_backoff_s
+                closed = True
             self.consecutive_errors = 0
             self._probe_deadline = None
+        if closed:
+            self._note_transition("closed", probes=self.probes)
 
     def record_error(self, exc: BaseException) -> None:
         """A device dispatch failed: counts toward the trip threshold;
         a failed half-open probe re-opens with doubled backoff."""
         now = time.monotonic()
+        opened = None
         with self._lock:
             self.errors_total += 1
             self.last_error = f"{type(exc).__name__}: {str(exc)[:160]}"
@@ -242,11 +299,19 @@ class PlaneBreaker:
                                       self.max_backoff_s)
                 self._retry_at = now + self._backoff_s
                 self._probe_deadline = None
+                opened = "probe-failed"
             elif self.state == "closed" and \
                     self.consecutive_errors >= self.threshold:
                 self.state = "open"
                 self.trips += 1
                 self._retry_at = now + self._backoff_s
+                opened = "threshold"
+        if opened is not None:
+            self._note_transition(
+                "open", cause=opened, trips=self.trips,
+                consecutive_errors=self.consecutive_errors,
+                error=self.last_error,
+                backoff_seconds=round(self._backoff_s, 3))
 
     def stats(self) -> dict:
         now = time.monotonic()
@@ -467,6 +532,11 @@ def clear_cache() -> None:
         _data_layer.update({k: 0 for k in _data_layer})
         _node_stats.clear()
         _node_fallback_reasons.clear()
+    # the cost observatory and flight recorder reset with the program
+    # cache: their books describe the programs the cache holds
+    from elasticsearch_tpu.observability import costs, flightrec
+    costs.reset()
+    flightrec.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -657,7 +727,12 @@ def _build(view, consts, emit_q, emit_pf, refs, flags, k: int):
     return outs
 
 
-def _get_compiled(key, build_fn):
+def _get_compiled(key, lower_fn, lane: str = "segment",
+                  owner: str | None = None):
+    """Program-cache trampoline: ``lower_fn`` returns the LOWERED
+    program; a miss routes it through :func:`observed_compile` (which
+    owns the ``.compile()``, the fault point and the cost-table stamp)
+    under ``lane``'s books."""
     with _cache_lock:
         fn = _cache.get(key)
         if fn is not None:
@@ -668,9 +743,7 @@ def _get_compiled(key, build_fn):
     # harmless — last one wins the cache slot
     with _cache_lock:
         _bump("misses")
-    with device_span("compile"):
-        device_fault_point("compile")
-        fn = build_fn()
+    fn = observed_compile(lane, key, lower_fn, owner=owner)
     with _cache_lock:
         _cache[key] = fn
         while len(_cache) > _CACHE_CAP:
@@ -717,17 +790,19 @@ def run_segment(seg: DeviceSegment, ctx: ExecutionContext, query,
             view = seg_rebuild(seg, flat_in, pos_for, vecs)
             return _build(view, consts_in, emit_q, emit_pf, refs, flags,
                           k_static)
-        # AOT lower+compile and cache ONLY the executable: a cached
-        # jax.jit closure would pin the whole DeviceSegment/DeviceReader
-        # (every column's device arrays) for the life of the cache entry —
-        # an accumulating device-memory leak across index churn
+        # AOT lower (observed_compile owns the .compile()) and cache
+        # ONLY the executable: a cached jax.jit closure would pin the
+        # whole DeviceSegment/DeviceReader (every column's device
+        # arrays) for the life of the cache entry — an accumulating
+        # device-memory leak across index churn
         shapes = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             (flat, consts))
-        return jax.jit(run).lower(*shapes).compile()
+        return jax.jit(run).lower(*shapes)
 
-    fn = _get_compiled(key, compile_fn)
-    with device_span("dispatch"):
+    fn = _get_compiled(key, compile_fn, lane="segment",
+                       owner=getattr(ctx.reader, "engine_uuid", None))
+    with device_span("dispatch", cost=("segment", key, 1, 1)):
         device_fault_point("dispatch")
         return fn(flat, consts)
 
@@ -815,7 +890,7 @@ def _lane_fn(plan: dict, view: DeviceSegment):
 
 
 def run_reader_batch(segments: list, ctx: ExecutionContext, queries: list,
-                     *, k: int, pack: bool):
+                     *, k: int, pack: bool, n_real: int | None = None):
     """The whole reader's batched query phase as ONE compiled program:
     per-segment vmapped scoring + top-k, cross-segment merge to
     reader-global doc ids (TopDocs.merge tie-break — concat in segment
@@ -878,10 +953,13 @@ def run_reader_batch(segments: list, ctx: ExecutionContext, queries: list,
         shapes = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             (flats, packeds))
-        return jax.jit(run).lower(*shapes).compile()
+        return jax.jit(run).lower(*shapes)
 
-    fn = _get_compiled(key, compile_fn)
-    with device_span("dispatch"):
+    fn = _get_compiled(key, compile_fn, lane="reader-batch",
+                       owner=getattr(ctx.reader, "engine_uuid", None))
+    with device_span("dispatch",
+                     cost=("reader-batch", key,
+                           n_real if n_real is not None else b, b_pad)):
         device_fault_point("dispatch")
         out = fn(flats, packeds)
     if b_pad != b:
@@ -928,10 +1006,13 @@ def run_segments_streamed(segments: list, ctx: ExecutionContext,
             shapes = jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                 (plan["flat"], plan["packed"]))
-            return jax.jit(run).lower(*shapes).compile()
+            return jax.jit(run).lower(*shapes)
         # same key space as run_segment_batch: bucketized segments with a
         # common layout share ONE compiled program across the whole sweep
-        return _get_compiled(("batch",) + plan["key"], compile_fn)
+        return _get_compiled(("batch",) + plan["key"], compile_fn,
+                             lane="streamed",
+                             owner=getattr(ctx.reader, "engine_uuid",
+                                           None))
 
     # transfers run on a DEDICATED feeder thread, one segment ahead:
     # host→HBM DMA overlaps the in-flight program's compute even when
@@ -976,7 +1057,9 @@ def run_segments_streamed(segments: list, ctx: ExecutionContext,
             packed = {dt: jnp.asarray(buf)
                       for dt, buf in plan["packed"].items()}
             t1 = time.perf_counter()
-            with device_span("dispatch"):
+            with device_span("dispatch",
+                             cost=("streamed", ("batch",) + plan["key"],
+                                   len(queries), plan["b_pad"])):
                 device_fault_point("dispatch")
                 outs = fn(cur, packed)      # async dispatch
             stats["dispatch_s"] += time.perf_counter() - t1
@@ -1121,15 +1204,16 @@ def run_percolate_lanes(lanes: list) -> list:
             shapes = jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                 (flats, packed))
-            return jax.jit(run).lower(*shapes).compile()
+            return jax.jit(run).lower(*shapes)
 
         full_key = ("percolate", key, n_pad)
         with _cache_lock:
             hit = full_key in _cache
             _bump("percolate_program_hits" if hit
                   else "percolate_program_misses")
-        fn = _get_compiled(full_key, compile_fn)
-        with device_span("percolate"):
+        fn = _get_compiled(full_key, compile_fn, lane="percolate")
+        with device_span("percolate",
+                         cost=("percolate", full_key, n, n_pad)):
             device_fault_point("percolate")
             out = fn(flats, packed)     # async dispatch: groups pipeline
         pending.append((idxs, out))
@@ -1143,7 +1227,8 @@ def run_percolate_lanes(lanes: list) -> list:
 
 
 def run_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,
-                      queries: list, *, k: int) -> dict | None:
+                      queries: list, *, k: int,
+                      n_real: int | None = None) -> dict | None:
     """Execute a BATCH of queries against one device segment as ONE vmapped
     compiled program.
 
@@ -1187,10 +1272,14 @@ def run_segment_batch(seg: DeviceSegment, ctx: ExecutionContext,
         shapes = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             (flat, packed))
-        return jax.jit(run).lower(*shapes).compile()
+        return jax.jit(run).lower(*shapes)
 
-    fn = _get_compiled(key, compile_fn)
-    with device_span("dispatch"):
+    fn = _get_compiled(key, compile_fn, lane="segment-batch",
+                       owner=getattr(ctx.reader, "engine_uuid", None))
+    with device_span("dispatch",
+                     cost=("segment-batch", key,
+                           n_real if n_real is not None else b,
+                           plan["b_pad"])):
         device_fault_point("dispatch")
         outs = fn(flat, packed)
     if plan["b_pad"] != b:
@@ -1341,7 +1430,8 @@ class _ImpactPack:
     host ImpactColumns (term dictionaries + quantization metadata)."""
 
     __slots__ = ("field", "cfg", "k1", "b", "segs", "bases", "can_prune",
-                 "total_blocks", "bound_per_term", "scales")
+                 "total_blocks", "bound_per_term", "scales",
+                 "engine_uuid")
 
     def __init__(self, field, cfg, k1, b):
         self.field = field
@@ -1353,6 +1443,7 @@ class _ImpactPack:
         self.total_blocks = 0
         self.bound_per_term = 0.0
         self.scales = None      # [S] f32 device constant (compose step)
+        self.engine_uuid = None  # cost-table owner (drains on close)
 
     def sig(self) -> tuple:
         out = [self.field, self.cfg.bits, float(self.k1), float(self.b)]
@@ -1450,6 +1541,7 @@ def impact_pack_for(reader, field: str, cfg: ImpactPlaneConfig,
         f"reader:{id(reader)}"
     breaker_service = getattr(reader, "breaker_service", None)
     pack = _ImpactPack(field, cfg, k1, b)
+    pack.engine_uuid = getattr(reader, "engine_uuid", None)
     uploaded = reused = 0
     for dseg in reader.segments:
         icol = _host_impact_column(reader, dseg, field, cfg, k1, b,
@@ -1578,7 +1670,8 @@ def _impact_query_inputs(pack: _ImpactPack, term_lists: list,
 
 
 def run_impact_batch(pack: _ImpactPack, term_lists: list, boosts: list,
-                     cursors: list, *, k: int) -> dict:
+                     cursors: list, *, k: int,
+                     n_real: int | None = None) -> dict:
     """Eager quantized-impact scoring of B queries over the whole
     reader as ONE compiled program: per-segment dense compare + integer
     gather/sum over the precomputed impacts (no per-doc BM25 float
@@ -1621,10 +1714,13 @@ def run_impact_batch(pack: _ImpactPack, term_lists: list, boosts: list,
         shapes = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             (seg_arrs, qtids, pack.scales, boosts_a, cs, cd))
-        return jax.jit(run).lower(*shapes).compile()
+        return jax.jit(run).lower(*shapes)
 
-    fn = _get_compiled(key, compile_fn)
-    with device_span("dispatch"):
+    fn = _get_compiled(key, compile_fn, lane="impact-eager",
+                       owner=pack.engine_uuid)
+    with device_span("dispatch",
+                     cost=("impact-eager", key,
+                           n_real if n_real is not None else b, b_pad)):
         device_fault_point("dispatch")
         out = fn(seg_arrs, qtids, pack.scales, boosts_a, cs, cd)
     if b_pad != b:
@@ -1633,7 +1729,8 @@ def run_impact_batch(pack: _ImpactPack, term_lists: list, boosts: list,
 
 
 def run_impact_pruned(pack: _ImpactPack, term_lists: list, boosts: list,
-                      cursors: list, *, k: int) -> dict:
+                      cursors: list, *, k: int,
+                      n_real: int | None = None) -> dict:
     """Block-max pruned top-k of B queries: blocks sweep in descending
     upper-bound order with the running k-th score as the skip threshold,
     carried ACROSS segments so early segments' candidates prune later
@@ -1675,10 +1772,13 @@ def run_impact_pruned(pack: _ImpactPack, term_lists: list, boosts: list,
         shapes = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             (seg_arrs, qtids, pack.scales, boosts_a, cs, cd))
-        return jax.jit(run).lower(*shapes).compile()
+        return jax.jit(run).lower(*shapes)
 
-    fn = _get_compiled(key, compile_fn)
-    with device_span("pruning-dispatch"):
+    fn = _get_compiled(key, compile_fn, lane="impact-pruned",
+                       owner=pack.engine_uuid)
+    with device_span("pruning-dispatch",
+                     cost=("impact-pruned", key,
+                           n_real if n_real is not None else b, b_pad)):
         device_fault_point("pruning-dispatch")
         out = fn(seg_arrs, qtids, pack.scales, boosts_a, cs, cd)
     if b_pad != b:
@@ -1817,12 +1917,16 @@ def note_scheduler_drain() -> None:
 def note_scheduler_shed(reason: str, n: int = 1) -> None:
     """``n`` requests the scheduler shed instead of queueing toward a
     blown deadline / burning SLO, reason-labeled against the closed
-    ``scheduler`` vocabulary like the admission lanes."""
+    ``scheduler`` vocabulary like the admission lanes. Sheds also land
+    on the flight recorder, burst-coalesced, so a 429 storm is
+    diagnosable from ``_nodes/diagnostics`` after the fact."""
     lanes.check_reason("scheduler", reason)
     with _cache_lock:
         _bump("scheduler_requests_shed", int(n))
         _scheduler_shed_reasons[reason] = \
             _scheduler_shed_reasons.get(reason, 0) + int(n)
+    from elasticsearch_tpu.observability import flightrec
+    flightrec.note_shed(reason, int(n))
 
 
 def note_knn_served(index_name: str | None, n_requests: int,
@@ -2153,7 +2257,7 @@ def _knn_query_inputs(reqs, pack):
 
 def run_knn_hybrid_batch(reader, ctx, reqs, pack: _VectorPack,
                          cfg: KnnPlaneConfig, *, k: int,
-                         num_candidates: int):
+                         num_candidates: int, n_real: int | None = None):
     """B knn (or hybrid BM25+knn) requests over the whole reader as ONE
     compiled program.
 
@@ -2321,22 +2425,24 @@ def run_knn_hybrid_batch(reader, ctx, reqs, pack: _VectorPack,
         def run_outer(*a):
             return run(a[0], a[1], a[2], a[3], a[4], a[5],
                        a[6] if qmask is not None else None, a[7])
-        return jax.jit(run_outer).lower(*shapes).compile()
+        return jax.jit(run_outer).lower(*shapes)
 
-    fn = _get_compiled(key, compile_fn)
+    fn = _get_compiled(key, compile_fn, lane="knn",
+                       owner=getattr(reader, "engine_uuid", None))
     args = (flats, packeds, vec_arrs, pack.scales, pack.offsets,
             qv, qmask if qmask is not None else jnp.zeros(0, bool),
             boosts)
+    cost = ("knn", key, n_real if n_real is not None else b, b_pad)
     if hybrid:
-        with device_span("fusion-dispatch"):
+        with device_span("fusion-dispatch", cost=cost):
             device_fault_point("fusion-dispatch")
             out = fn(*args)
     elif pack.multi:
-        with device_span("maxsim-dispatch"):
+        with device_span("maxsim-dispatch", cost=cost):
             device_fault_point("maxsim-dispatch")
             out = fn(*args)
     else:
-        with device_span("dispatch"):
+        with device_span("dispatch", cost=cost):
             device_fault_point("dispatch")
             out = fn(*args)
     if b_pad != b:

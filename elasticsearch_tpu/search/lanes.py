@@ -105,6 +105,42 @@ PERCOLATE_COUNTERS = {
     "breaker_skips": "fused dispatches the open breaker routed eager",
 }
 
+#: the program lanes of the cost observatory — one per compiled-program
+#: class (every ``jit_exec.observed_compile`` call names one; plane-lint
+#: rule ``program-cost-unknown-lane`` checks the literals). These are
+#: PROGRAM classes, finer than the four serving lanes: the planner costs
+#: "impact-pruned at this shape", not "the impact lane".
+PROGRAM_LANES = (
+    "segment",          # run_segment: one query × one device segment
+    "segment-batch",    # run_segment_batch: B queries × one segment
+    "reader-batch",     # run_reader_batch: whole-reader fused program
+    "streamed",         # run_segments_streamed: host-pool segment sweep
+    "percolate",        # run_percolate_lanes: fused percolate groups
+    "impact-eager",     # run_impact_batch: quantized eager impacts
+    "impact-pruned",    # run_impact_pruned: block-max sweep
+    "knn",              # run_knn_hybrid_batch: vector/hybrid programs
+    "mesh",             # mesh_engine._program: the collective plane
+)
+
+#: the program cost observatory's per-lane gauge registry — the
+#: OpenMetrics exposition renders one ``estpu_program_cost_<key>{lane=}``
+#: gauge per entry from ``costs.lane_rollup()`` (whose rollup dicts
+#: carry exactly these keys), so adding a field here adds it to the
+#: scrape by construction. Emitted into ``lane_graph.json`` next to the
+#: counter registries — the planner reads the lanes' observable cost
+#: surface from the same artifact as their admission model.
+PROGRAM_COST = {
+    "resident": "programs resident in the cost table",
+    "compiles": "program trace+compiles (sum over resident programs)",
+    "compile_ms": "wall milliseconds spent compiling",
+    "dispatches": "program dispatches recorded",
+    "device_time_us": "accumulated device time (µs, span-measured)",
+    "requests": "real requests served (the n_real contract)",
+    "rows": "program batch rows dispatched (incl. pow2 padding)",
+    "predicted_us": "dispatch-weighted roofline prediction (µs)",
+    "measured_us": "dispatch-weighted measured EWMA (µs)",
+}
+
 # ---------------------------------------------------------------------------
 # Fallback taxonomy: ONE registered reason vocabulary per lane.
 # note_plane_fallback / note_impact_fallback / note_knn_fallback /

@@ -602,7 +602,7 @@ class ShardSearcher:
         try:
             out = jit_exec.run_reader_batch(self.reader.segments,
                                             self.ctx, queries, k=k,
-                                            pack=pack)
+                                            pack=pack, n_real=n_real)
         except QueryParsingError:
             raise
         except Exception as e:            # noqa: BLE001 — fallback seam
@@ -701,7 +701,8 @@ class ShardSearcher:
                 prune = False               # block tables over budget
             run = jit_exec.run_impact_pruned if prune \
                 else jit_exec.run_impact_batch
-            out = run(pack, term_lists, boosts, cursors, k=k)
+            out = run(pack, term_lists, boosts, cursors, k=k,
+                      n_real=n_real)
         except QueryParsingError:
             raise
         except Exception as e:            # noqa: BLE001 — fallback seam
@@ -808,7 +809,7 @@ class ShardSearcher:
                 return None
             out = jit_exec.run_knn_hybrid_batch(
                 self.reader, self.ctx, reqs, pack, cfg, k=k_prog,
-                num_candidates=knns[0].num_candidates)
+                num_candidates=knns[0].num_candidates, n_real=n_real)
         except QueryParsingError:
             raise
         except Exception as e:            # noqa: BLE001 — fallback seam

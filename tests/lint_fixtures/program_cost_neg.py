@@ -1,0 +1,33 @@
+"""program-cost-discipline NEGATIVE fixture (clean).
+
+The blessed shapes: lowering stays with the call site, the ``.compile()``
+lives inside the registered seam (``observed_compile``), and every lane
+argument is a PROGRAM_LANES literal — the jit_exec/mesh_engine idiom.
+"""
+
+import jax
+
+
+def observed_compile(lane, shape_key, lower_fn, *, owner=None):
+    # the ONE place a lowered program may compile: the seam function
+    # itself (cfg.cost_seam_fns) — it stamps the cost table
+    compiled = lower_fn().compile()
+    return compiled
+
+
+def _get_compiled(key, lower_fn, lane="segment", owner=None):
+    # lane caller forwarding its own lane parameter: literals are
+    # checked at every call site instead (the seam-wrapper discipline)
+    return observed_compile(lane, key, lower_fn, owner=owner)
+
+
+def site_segment(run, shapes, key):
+    def lower_fn():
+        return jax.jit(run).lower(*shapes)
+    return _get_compiled(key, lower_fn, lane="segment")
+
+
+def site_mesh(mapped, flats, consts, key):
+    def lower_fn():
+        return jax.jit(mapped).lower(flats, consts)
+    return observed_compile("mesh", key, lower_fn)

@@ -1,0 +1,43 @@
+"""program-cost-discipline POSITIVE fixture (findings fire).
+
+Scoped as a cost-seam module via the fixture config
+(cost_seam_modules=("*/program_cost_*.py",)). Models the violation
+classes the family exists for: compiled programs built past the
+observed_compile seam (no cost-table row), and lane arguments the
+closed PROGRAM_LANES vocabulary cannot account for.
+"""
+
+import jax
+
+
+def direct_chain_bypass(run, shapes, consts):
+    # finding: .lower(...).compile(...) outside observed_compile — the
+    # program compiles but the cost observatory never sees it
+    fn = jax.jit(run).lower(*shapes).compile()
+    return fn(consts)
+
+
+def bound_name_bypass(run, shapes, consts):
+    lowered = jax.jit(run).lower(*shapes)
+    # finding: .compile() on a local bound to a .lower(...) result —
+    # the split-across-statements form of the same bypass
+    fn = lowered.compile()
+    return fn(consts)
+
+
+def unknown_lane(observed_compile, key, lower_fn):
+    # finding: "warp" is not in lanes.PROGRAM_LANES — an unregistered
+    # lane silently splits the program's cost books
+    return observed_compile("warp", key, lower_fn)
+
+
+def dynamic_lane(observed_compile, key, lower_fn, lane):
+    # finding: a non-literal lane outside a registered lane caller —
+    # the closed vocabulary cannot be checked statically
+    return observed_compile(lane, key, lower_fn)
+
+
+def missing_lane(_get_compiled, key, build):
+    # finding: no lane argument at all — the trampoline would file the
+    # program under a default nobody chose
+    return _get_compiled(key, build)

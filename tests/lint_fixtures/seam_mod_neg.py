@@ -49,5 +49,5 @@ def seam_device_put(a, device=None, site="upload"):
 def dispatch_via_trampoline(_get_compiled, key, emit, consts):
     def build():
         return jax.jit(emit)
-    program = _get_compiled(key, build)
+    program = _get_compiled(key, build, lane="segment")
     return program(consts)

@@ -31,6 +31,39 @@ SUBS = {"index": "idx", "type": "t", "id": "1", "name": "nm",
         "field": "f", "index_metric": "docs"}
 
 
+def test_observatory_routes_registered_with_validation(tmp_path):
+    """The cost-observatory surfaces are REGISTERED routes with typed
+    param validation: /_cat/programs (?top=, ?lane=) and
+    /_nodes/diagnostics (+ per-node form) resolve to their handlers, a
+    bad param is a typed 400 and an unknown node a typed 404 — never a
+    fall-through to a generic handler or a 500."""
+    n = Node({}, data_path=tmp_path / "n").start()
+    try:
+        c = RestController()
+        register_all(c, n)
+        for path in ("/_cat/programs", "/_nodes/diagnostics",
+                     "/_nodes/n1/diagnostics"):
+            h, _ = c.resolve("GET", path)
+            assert h is not None, path
+            assert getattr(h, "__name__", "") in (
+                "cat_programs", "nodes_diagnostics"), (path, h)
+        st, _ = c.dispatch("GET", "/_cat/programs", b"")
+        assert st == 200
+        st, err = c.dispatch("GET", "/_cat/programs?top=-3", b"")
+        assert st == 400 and \
+            err["error"]["type"] == "illegal_argument_exception"
+        st, err = c.dispatch("GET", "/_cat/programs?lane=bogus", b"")
+        assert st == 400 and \
+            err["error"]["type"] == "illegal_argument_exception"
+        st, out = c.dispatch("GET", "/_nodes/diagnostics?top=5", b"")
+        assert st == 200 and n.node_id in out["nodes"]
+        st, err = c.dispatch("GET", "/_nodes/ghost/diagnostics", b"")
+        assert st == 404 and \
+            err["error"]["type"] == "resource_not_found_exception"
+    finally:
+        n.close()
+
+
 @pytest.mark.skipif(not SPEC_DIR.exists(), reason="reference spec absent")
 def test_every_spec_path_resolves(tmp_path):
     n = Node({}, data_path=tmp_path / "n").start()

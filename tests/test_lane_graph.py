@@ -63,6 +63,11 @@ def test_graph_counters_match_runtime_registry(graph):
         sorted(lanes.DATA_LAYER_COUNTERS)
     assert graph["counters"]["PERCOLATE_COUNTERS"] == \
         sorted(lanes.PERCOLATE_COUNTERS)
+    # the cost observatory's gauge registry + program-lane vocabulary
+    # ride the same artifact (the planner's observable cost surface)
+    assert graph["counters"]["PROGRAM_COST"] == \
+        sorted(lanes.PROGRAM_COST)
+    assert graph["program_lanes"] == sorted(lanes.PROGRAM_LANES)
 
 
 def test_graph_admissions_resolve_to_live_defs(graph):

@@ -817,6 +817,7 @@ def _scenario_device_fault_during_refresh_storm(c, rnd, spec):
     scheme = DeviceFaultScheme(seed=rnd.randrange(2 ** 31),
                                p=rnd.uniform(0.2, 0.6),
                                oom_fraction=0.2)
+    from elasticsearch_tpu.search import jit_exec as _jx
     with scheme.applied():
         for r in range(rnd.randint(3, 5)):       # the refresh storm
             for i in range(rnd.randint(5, 10)):
@@ -827,6 +828,33 @@ def _scenario_device_fault_during_refresh_storm(c, rnd, spec):
             got = _any_node(c, rnd).search(
                 "m_devrs", {"size": 0})["hits"]["total"]
             assert got == total, (got, total, scheme.injected)
+        # read the breaker's trip count BEFORE scheme.stop resets it:
+        # the flight recorder must have captured every open transition
+        storm_trips = _jx.plane_breaker.stats()["trips"]
+    # failed dispatches never poison a program's books: every recorded
+    # sample is a COMPLETE dispatch (histogram mass == dispatch count)
+    # and every figure stays finite despite the injected faults
+    import math as _math
+    from elasticsearch_tpu.observability import costs as _costs
+    from elasticsearch_tpu.observability import flightrec as _flight
+    for nid in _costs.node_ids():
+        for rec in _costs.table(nid).records():
+            assert sum(rec.hist) == rec.dispatches, \
+                (rec.lane, rec.key_id, scheme.injected)
+            for val in (rec.ewma_us, rec.sum_us, rec.predicted_us):
+                assert _math.isfinite(val) and val >= 0.0, \
+                    (rec.lane, rec.key_id, val)
+    # every open transition landed on the flight recorder as the
+    # REGISTERED breaker-open event type (with its typed attributes),
+    # and nothing unregistered snuck onto any ring
+    flight_events = [e for nid in (_flight.node_ids() or [""])
+                     for e in _flight.events(nid)]
+    for e in flight_events:
+        assert e["type"] in _flight.EVENT_TYPES, e
+    opens = [e for e in flight_events if e["type"] == "breaker-open"]
+    assert len(opens) >= storm_trips, (storm_trips, flight_events)
+    for e in opens:
+        assert e["cause"] in ("threshold", "probe-failed"), e
     # healed (scheme stop reset the breaker): serving continues, and the
     # block cache must hold no block_uid that left its engine's reader
     a.broadcast_actions.refresh("m_devrs")
@@ -869,6 +897,13 @@ def _scenario_device_fault_during_refresh_storm(c, rnd, spec):
         [(n.node_name, n.breaker_service.breaker("fielddata").used,
           n.breaker_service.device_ledger.total_bytes())
          for n in c.nodes if n._started]
+    # the program cost table drains with the engines (no rows for
+    # closed engines — the ledger discipline, applied to cost books)
+    stale = [(rec.lane, rec.key_id, rec.owner)
+             for nid in _costs.node_ids()
+             for rec in _costs.table(nid).records()
+             if rec.owner in live]
+    assert stale == [], stale
 
 
 def _scenario_device_fault_during_relocation(c, rnd, spec):
@@ -1162,6 +1197,29 @@ def _scenario_scheduler_mixed_storm(c, rnd, spec):
     from elasticsearch_tpu.search import lanes as lane_reg
     for _, reason in shed_429:
         assert reason in lane_reg.LANE_REASONS["scheduler"], shed_429
+    # the flight recorder saw the storm with REGISTERED event types
+    # only, and failed dispatches never poisoned a program's books
+    # (histogram mass == dispatch count, every figure finite)
+    import math as _math
+    from elasticsearch_tpu.observability import costs as _costs
+    from elasticsearch_tpu.observability import flightrec as _flight
+    flight_events = [e for nid in (_flight.node_ids() or [""])
+                     for e in _flight.events(nid)]
+    for e in flight_events:
+        assert e["type"] in _flight.EVENT_TYPES, e
+    if shed_429:
+        bursts = [e for e in flight_events if e["type"] == "shed-burst"]
+        assert bursts, flight_events
+        for e in bursts:
+            assert e["reason"] in lane_reg.LANE_REASONS["scheduler"], e
+    for nid in _costs.node_ids():
+        for rec in _costs.table(nid).records():
+            assert sum(rec.hist) == rec.dispatches, \
+                (rec.lane, rec.key_id)
+            assert _math.isfinite(rec.ewma_us) and rec.ewma_us >= 0.0
+        ct = _costs.table(nid).counters()
+        assert ct["inserted"] == \
+            ct["resident"] + ct["evicted"] + ct["dropped"], ct
     # nothing leaks: request-breaker bytes and open spans drain to zero
     assert wait_until(lambda: all(
         n.breaker_service.breaker("request").used == 0

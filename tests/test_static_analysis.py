@@ -1,4 +1,4 @@
-"""plane-lint v2 (tier-1): the nine rule families against fixture
+"""plane-lint v2 (tier-1): the ten rule families against fixture
 snippets, the tree-is-clean gate over ``elasticsearch_tpu/``, the
 interprocedural upgrades (cross-module breaker release-reachability,
 transitive lock-order, callee host-sync), the stale-suppression audit,
@@ -473,6 +473,61 @@ def test_tree_fallback_taxonomy_is_clean():
     result = tree_result()
     fam = [f for f in result.findings
            if f.family == "fallback-taxonomy"]
+    assert fam == [], "\n".join(f.render() for f in fam)
+
+
+# ---------------------------------------------------------------------------
+# program-cost-discipline
+# ---------------------------------------------------------------------------
+
+#: cost fixtures double as seam modules so device-raw noise stays out
+#: of the picture and the trampoline exemptions are exercised for real
+COST_CFG = LintConfig(seam_modules=("*/program_cost_*.py",),
+                      cost_seam_modules=("*/program_cost_*.py",))
+
+
+def test_program_cost_positive():
+    r = lint_fixture("program_cost_pos.py", cfg=COST_CFG)
+    unobs = open_rules(r, "program-cost-unobserved")
+    # the direct .lower().compile() chain and the bound-name variant
+    assert len(unobs) == 2, "\n".join(f.render() for f in unobs)
+    assert "observed_compile" in unobs[0].message
+    lane = open_rules(r, "program-cost-unknown-lane")
+    # unknown literal, dynamic lane, and the missing-lane trampoline
+    assert len(lane) == 3, "\n".join(f.render() for f in lane)
+    assert all("PROGRAM_LANES" in f.message for f in lane)
+
+
+def test_program_cost_negative():
+    r = lint_fixture("program_cost_neg.py", cfg=COST_CFG)
+    assert open_family(r, "program-cost-discipline") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+
+
+def test_program_cost_suppressed():
+    r = lint_fixture("program_cost_sup.py", cfg=COST_CFG)
+    assert open_family(r, "program-cost-discipline") == []
+    sup = {f.rule for f in r.suppressed}
+    assert {"program-cost-unobserved",
+            "program-cost-unknown-lane"} <= sup
+
+
+def test_program_cost_config_mirrors_lane_registry():
+    """The lint config's closed lane vocabulary IS lanes.PROGRAM_LANES
+    — config and registry cannot drift apart."""
+    from elasticsearch_tpu.search import lanes as lane_reg
+    assert tuple(DEFAULT_CONFIG.program_lanes) == \
+        tuple(lane_reg.PROGRAM_LANES)
+
+
+def test_tree_program_cost_discipline_is_clean():
+    """Every program compile on the real tree flows through the
+    observed_compile seam under a registered lane — zero findings,
+    zero suppressions (the acceptance gate for the cost observatory's
+    coverage claim)."""
+    result = tree_result()
+    fam = [f for f in result.findings
+           if f.family == "program-cost-discipline"]
     assert fam == [], "\n".join(f.render() for f in fam)
 
 
