@@ -375,6 +375,12 @@ class SearchActions:
     #: response exists to cut off
     PARTIAL_GRACE_S = 0.1
 
+    #: stall ceiling on coordinator shard-future waits with NO request
+    #: deadline: a wedged shard (hung device dispatch) becomes a typed
+    #: shard failure after this long, never a hung request — the
+    #: deadline-less analog of the PARTIAL_GRACE_S bounded collect
+    SHARD_WAIT_CEILING_S = 60.0
+
     def __init__(self, node):
         self.node = node
         self._pool = ThreadPoolExecutor(max_workers=16,
@@ -468,6 +474,13 @@ class SearchActions:
             ContinuousBatchScheduler, settings_for)
         self.scheduler = ContinuousBatchScheduler(
             node_id=getattr(node, "node_id", None), **settings_for(get))
+        # ---- dispatch watchdog (stall tolerance) ----
+        # the module singleton guards every registered device wait (one
+        # process = one device, the plane_breaker discipline); each node
+        # applies its search.watchdog.* settings to it
+        from elasticsearch_tpu.search import watchdog as _watchdog
+        self.watchdog = _watchdog.dispatch_watchdog
+        self.watchdog.configure(**_watchdog.settings_for(get))
         # background pack-build (plane warm) failure tracking: per-index
         # consecutive failures drive the retry backoff and, past
         # PLANE_WARM_MAX_RETRIES, the plane-degraded marking
@@ -1767,6 +1780,14 @@ class SearchActions:
             except QueryParsingError:     # vector/geo/nested layouts
                 self._note_plane_fallback(indices, "ineligible-shape")
                 return None
+            except jit_exec.DeviceStallError as e:
+                # a watchdog-abandoned wait surfacing through the pack:
+                # distinct reason so the lane graph separates wedged
+                # hardware from ordinary device faults
+                jit_exec.note_fallback(e)
+                jit_exec.note_device_error(e)
+                self._note_plane_fallback(indices, "device-stall")
+                return None
             except Exception as e:        # noqa: BLE001 — fallback seam
                 jit_exec.note_fallback(e)
                 jit_exec.note_device_error(e)
@@ -1791,6 +1812,11 @@ class SearchActions:
                 return None
             except TaskCancelledError:
                 raise
+            except jit_exec.DeviceStallError as e:
+                jit_exec.note_fallback(e)
+                jit_exec.note_device_error(e)
+                self._note_plane_fallback(indices, "device-stall")
+                return None
             except Exception as e:        # noqa: BLE001 — fallback seam
                 jit_exec.note_fallback(e)
                 jit_exec.note_device_error(e)
@@ -2094,18 +2120,38 @@ class SearchActions:
                 self._release_pack(old)
             return entry[1]
 
-    def _dfs_phase(self, state, groups, body: dict) -> dict:
+    def _shard_wait_s(self, deadline_at: float | None) -> float:
+        """Every coordinator wait on a shard future is BOUNDED: the
+        remaining request deadline (+ grace) when one exists, the stall
+        ceiling otherwise — a wedged shard becomes a typed shard
+        failure / partial result, never a hung request."""
+        if deadline_at is None:
+            return self.SHARD_WAIT_CEILING_S
+        return min(self.SHARD_WAIT_CEILING_S,
+                   max(deadline_at - time.perf_counter(), 0.0)
+                   + self.PARTIAL_GRACE_S)
+
+    def _dfs_phase(self, state, groups, body: dict,
+                   deadline_at: float | None = None) -> dict:
         """The DFS round preceding the query round
         (executeDfsPhase, core/search/SearchService.java:264 +
         aggregateDfs SearchPhaseController.java:105): gather each shard's
         term/collection statistics, reduce to global stats."""
+        from concurrent.futures import TimeoutError as FutTimeout
         from elasticsearch_tpu.search.dfs import aggregate_dfs
         futures = [self._submit(
             self._try_shard_action, state, n, s, copies, self.DFS,
             self._handle_shard_dfs, body) for n, s, copies in groups]
         results = []
         for fut in futures:
-            status, payload = fut.result()
+            try:
+                status, payload = fut.result(
+                    self._shard_wait_s(deadline_at))
+            except FutTimeout:
+                # a stalled dfs shard contributes no stats, exactly
+                # like a failed one — its query round reports the
+                # failure; the dfs wait must never wedge the request
+                continue
             if status == "ok":
                 results.append(payload)
             # a failed shard contributes no stats — its query round will
@@ -2144,7 +2190,23 @@ class SearchActions:
                                   "the request timeout; partial results "
                                   "returned"},
                     "status": 504}, None
-        return fut.result()
+        # no deadline (or partial results disallowed — all-or-block
+        # semantics wait out a merely-slow shard): still BOUNDED, by
+        # the stall ceiling alone. A shard whose device dispatch
+        # wedged must surface as a typed shard failure, never hold
+        # the coordinator thread forever.
+        try:
+            return fut.result(self._shard_wait_s(None))
+        except FutTimeout:
+            return "stalled", {
+                "shard": sid, "index": name,
+                "reason": {
+                    "type": "shard_stall_exception",
+                    "reason": "shard group did not respond within the "
+                              "coordinator stall ceiling; the wait was "
+                              "abandoned (the shard task may still be "
+                              "running)"},
+                "status": 504}, None
 
     def _search_once(self, index_expr: str, body: dict, t0: float,
                      search_type: str | None = None,
@@ -2194,7 +2256,10 @@ class SearchActions:
             if dfs_cache is not None and "wire" in dfs_cache:
                 dfs = dfs_cache["wire"]
             else:
-                dfs = self._dfs_phase(state, groups, body)
+                dfs = self._dfs_phase(
+                    state, groups, body,
+                    deadline_at=None if req.timeout_ms is None
+                    else t0 + req.timeout_ms / 1000.0)
                 if dfs_cache is not None:
                     dfs_cache["wire"] = dfs
         # dense, deterministic _doc slots per (index, shard): sorted so a
@@ -2433,11 +2498,23 @@ class SearchActions:
                 stype) for expr, stype, idxs in groups]
             return self._collect_msearch(groups, futures, responses)
 
-    @staticmethod
-    def _collect_msearch(groups, futures, responses) -> dict:
+    def _collect_msearch(self, groups, futures, responses) -> dict:
+        from concurrent.futures import TimeoutError as FutTimeout
         for (expr, stype, idxs), fut in zip(groups, futures):
             try:
-                outs = fut.result()
+                # BOUNDED backstop: every wait inside a group is itself
+                # deadline/ceiling bounded, so 2x the shard stall
+                # ceiling only fires if a group wedges outside those
+                # bounds — the msearch then reports per-item stall
+                # errors instead of hanging the whole multi-request
+                outs = fut.result(2 * self.SHARD_WAIT_CEILING_S)
+            except FutTimeout:
+                cause = {"type": "shard_stall_exception",
+                         "reason": "msearch group did not respond within "
+                                   "the coordinator stall ceiling; the "
+                                   "wait was abandoned"}
+                outs = [{"error": {"root_cause": [cause], **cause}}] \
+                    * len(idxs)
             except Exception as e:           # noqa: BLE001 — per-group error
                 from elasticsearch_tpu.common.errors import (
                     ElasticsearchTpuError)
@@ -2497,13 +2574,31 @@ class SearchActions:
             # _msearch_pool and _search_once fans shards onto _pool —
             # same-pool nesting deadlocks under saturation
             from concurrent.futures import ThreadPoolExecutor as _TPE
-            with _TPE(max_workers=min(len(valid), 4)) as pool:
+            from concurrent.futures import TimeoutError as FutTimeout
+            pool = _TPE(max_workers=min(len(valid), 4))
+            try:
                 futs = {i: pool.submit(
                     tasks.bind_current(self._search_once), index_expr,
                     bodies[i], t0, "dfs_query_then_fetch")
                         for i in valid}
                 for i in valid:
-                    outs[i] = futs[i].result()
+                    try:
+                        outs[i] = futs[i].result(
+                            2 * self.SHARD_WAIT_CEILING_S)
+                    except FutTimeout:
+                        futs[i].cancel()
+                        outs[i] = {"error": {
+                            "type": "shard_stall_exception",
+                            "reason": "dfs msearch item did not respond "
+                                      "within the coordinator stall "
+                                      "ceiling; the wait was abandoned"}}
+            finally:
+                # NOT wait=True: joining a wedged worker here would
+                # re-introduce the unbounded wait this path just shed —
+                # queued items are cancelled, running ones are
+                # deadline/ceiling bounded and the pool threads exit
+                # on their own when those bounds fire
+                pool.shutdown(wait=False, cancel_futures=True)
             return [o for o in outs]
         state = self.node.cluster_service.state()
         groups = self._shard_groups(state, names)
@@ -2515,8 +2610,19 @@ class SearchActions:
             {"bodies": send_bodies, "doc_slot": slot_of[(n, s)]})
             for n, s, copies in groups]
         per_shard, group_failures = [], []
+        from concurrent.futures import TimeoutError as FutTimeout
         for (n, s, _copies), fut in zip(groups, futures):
-            status, payload = fut.result()
+            try:
+                status, payload = fut.result(self._shard_wait_s(None))
+            except FutTimeout:
+                status, payload = "stalled", {
+                    "shard": s, "index": n,
+                    "reason": {
+                        "type": "shard_stall_exception",
+                        "reason": "msearch shard group did not respond "
+                                  "within the coordinator stall ceiling; "
+                                  "the wait was abandoned"},
+                    "status": 504}
             if status == "ok":
                 per_shard.append((n, s, payload["payloads"]))
             else:
@@ -2592,8 +2698,15 @@ class SearchActions:
                         cur["type_conflict"] = True
                     else:
                         cur[k] = pick(cur[k], st[k])
+        from concurrent.futures import TimeoutError as FutTimeout
         for (n, _s, _c), fut in zip(groups, futures):
-            status, payload = fut.result()
+            try:
+                status, payload = fut.result(self._shard_wait_s(None))
+            except FutTimeout:
+                # a stalled field-stats shard counts as failed — the
+                # reduce ships whatever responded inside the ceiling
+                failed += 1
+                continue
             if status != "ok":
                 failed += 1
                 continue

@@ -52,7 +52,8 @@ def main(argv=None) -> int:
         description="plane-lint v2: whole-program invariant analysis "
                     "for the accelerator plane (breaker / device-seam / "
                     "recompile / lock / host-sync / span / trace-purity "
-                    "/ counter / fallback-taxonomy discipline)")
+                    "/ counter / fallback-taxonomy / program-cost / "
+                    "unbounded-wait discipline)")
     parser.add_argument("paths", nargs="*", default=["elasticsearch_tpu"],
                         help="files or directories (default: "
                              "elasticsearch_tpu)")

@@ -62,6 +62,10 @@ RULE_FAMILIES = {
     # registered program-lane literal
     "program-cost-unobserved": "program-cost-discipline",
     "program-cost-unknown-lane": "program-cost-discipline",
+    # unbounded-wait: every blocking wait on the serving path carries a
+    # timeout (a wedged dispatch must become a typed failover, never a
+    # hung request — the stall-tolerance ladder's static half)
+    "unbounded-wait": "unbounded-wait",
     "allow-missing-reason": "meta",
     "allow-stale": "meta",
 }
@@ -236,6 +240,19 @@ class LintConfig:
     #: keys are computed gauges — never bumped, so the unbumped check
     #: skips them
     gauge_registry_names: tuple = ("PROGRAM_COST",)
+
+    # ---- unbounded-wait --------------------------------------------------
+    #: modules where every blocking ``.result()``/``.join()``/``.get()``/
+    #: ``.wait()`` must carry a timeout: the device executor, the
+    #: dispatcher, the admission batcher, and the coordinator fan-out —
+    #: the layers a wedged device dispatch would otherwise hang.
+    #: Worker-loop homes (threadpool, cluster service) stay out: a
+    #: worker idling for its next task may block without bound.
+    wait_modules: tuple = ("*/search/jit_exec.py",
+                           "*/search/scheduler.py",
+                           "*/search/batching.py",
+                           "*/search/watchdog.py",
+                           "*/action/search_action.py")
 
     # ---- fallback-taxonomy (whole-program) -------------------------------
     #: reason-noting callables, by last name → lane whose vocabulary

@@ -1,0 +1,73 @@
+"""unbounded-wait: every blocking wait on the serving path is bounded.
+
+``unbounded-wait``: a zero-argument ``.result()`` / ``.join()`` /
+``.get()`` / ``.wait()`` call — no positional timeout, no ``timeout=``
+keyword — inside a wait-policed module (``cfg.wait_modules``: the
+dispatcher, the device executor, the admission batcher, the
+coordinator). An accelerator dispatch or transfer that wedges cannot be
+cancelled from Python; the ONLY stall-tolerance mechanism the serving
+path has is that every wait on such work carries a deadline and fails
+over when it fires (watchdog envelope, request deadline, stall
+ceiling). One unbounded ``fut.result()`` reintroduces the hung-request
+mode the whole ladder exists to prevent — the wait parks a pool thread
+forever and the caller's caller inherits the hang.
+
+The attribute-name match is deliberately coarse (any ``.get()`` with
+zero arguments, not just ``queue.Queue.get``): in these modules a
+bare blocking accessor is wrong regardless of receiver type, and
+bounded calls — ``fut.result(wait_s)``, ``q.get(timeout=0.25)``,
+``t.join(5.0)`` — never match. Intentional forever-waits (a worker
+loop idling for its next task) live outside ``wait_modules`` or carry
+an ``# estpu: allow[unbounded-wait] <reason>`` with the argument for
+why that thread may legitimately block without bound.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticsearch_tpu.analysis.lint.context import (
+    Finding, apply_suppressions, module_matches)
+
+#: blocking-call attribute names the rule polices when called with no
+#: timeout: Future.result / Thread.join / Queue.get / Event.wait
+WAIT_ATTRS = ("result", "join", "get", "wait")
+
+
+def _is_unbounded_wait(node: ast.Call) -> str | None:
+    """→ the wait attr name when `node` is a zero-timeout blocking call."""
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    attr = node.func.attr
+    if attr not in WAIT_ATTRS:
+        return None
+    if node.args:
+        return None                    # positional timeout (or a key/arg)
+    if any(kw.arg == "timeout" for kw in node.keywords):
+        return None
+    if any(kw.arg is None for kw in node.keywords):
+        return None                    # **kwargs may carry a timeout
+    return attr
+
+
+def check(ctx, cfg, program=None) -> list:
+    if not module_matches(ctx.relpath, cfg.wait_modules):
+        return []
+    findings, nodes = [], []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        attr = _is_unbounded_wait(node)
+        if attr is None:
+            continue
+        fn = ctx.enclosing_function(node)
+        where = f" in {fn.qualname}()" if fn is not None else ""
+        findings.append(Finding(
+            "unbounded-wait", ctx.relpath, node.lineno,
+            f".{attr}() with no timeout{where} — a wedged device "
+            f"dispatch cannot be cancelled, so every serving-path wait "
+            f"must carry a deadline and fail over when it fires; pass "
+            f"a timeout (remaining deadline, watchdog envelope, or "
+            f"stall ceiling) and handle the expiry"))
+        nodes.append(node)
+    return apply_suppressions(ctx, findings, nodes)

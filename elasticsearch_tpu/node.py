@@ -937,6 +937,11 @@ class Node:
             # sample-time reconciliation verdict (submitted == queued +
             # in_flight + delivered + declined + shed)
             "scheduler": self.search_actions.scheduler.stats(),
+            # dispatch watchdog: live in-flight device waits (with the
+            # oldest wait's age — the stall liveness gauge), the
+            # escalation tallies (stalls/abandoned/quarantines/
+            # probe_reopens), and the envelope config
+            "watchdog": self.search_actions.watchdog.stats(),
             # program cost observatory: per-lane rollups over the
             # resident compiled programs (XLA static cost + live
             # dispatch stats, predicted vs measured) and the top
@@ -1214,8 +1219,8 @@ class Node:
         plus every book an operator needs next to it to diagnose a
         blown SLO after the fact, as ONE bundle: the program cost table
         (top programs + per-lane rollups), the device-memory ledger,
-        windowed rates + SLO burn, scheduler depths, and breaker
-        states (plane + byte breakers)."""
+        windowed rates + SLO burn, scheduler depths, dispatch-watchdog
+        stall state, and breaker states (plane + byte breakers)."""
         from elasticsearch_tpu.observability import costs as _costs
         from elasticsearch_tpu.observability import flightrec as _flight
         from elasticsearch_tpu.observability import slo as _slo
@@ -1238,6 +1243,10 @@ class Node:
             "rates": rates_doc,
             "slo": _slo.stats(self.node_id),
             "scheduler": self.search_actions.scheduler.stats(),
+            # the hang half of the fault model next to the raise half
+            # (breakers below): stalls, abandoned waits, quarantine
+            # state, and the oldest in-flight wait's age
+            "watchdog": self.search_actions.watchdog.stats(),
             "breakers": {
                 "plane": _jit_exec.plane_breaker.stats(),
                 "bytes": self.breaker_service.stats(),

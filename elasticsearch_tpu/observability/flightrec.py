@@ -47,6 +47,12 @@ EVENT_TYPES = {
     "breaker-half-open": "plane breaker probing (one request admitted)",
     "breaker-closed": "plane breaker closed (probe succeeded; compiled "
                       "lanes readmit)",
+    "dispatch-stall": "a device wait outlived its predicted envelope; "
+                      "the watchdog abandoned the wait (the program may "
+                      "still own the device)",
+    "quarantine": "watchdog quarantine transition: entered after "
+                  "repeated stalls, or released by a successful "
+                  "background probe program",
 }
 
 #: ring capacity per node

@@ -22,6 +22,9 @@ Families (``estpu_`` namespace, all values cumulative unless gauge):
 * ``estpu_lane_latency_ms`` — per-lane histograms (bucket/_count/_sum);
 * ``estpu_device_memory_bytes{component=,index=}`` — ledger gauges;
 * ``estpu_breaker_*`` — breaker occupancy/limit/trip gauges;
+* ``estpu_watchdog_*`` — dispatch-watchdog liveness gauges (oldest
+  in-flight wait age, outstanding waits, quarantine state); the
+  stall/abandon/quarantine/probe-reopen COUNTERS ride the jit family;
 * ``estpu_slo_*`` — good/bad counters, target and burn-rate gauges.
 
 Rendering allocates only on the scrape path; nothing here runs during
@@ -172,6 +175,25 @@ def render(node_id: str, jit_stats: dict, percolate_stats: dict,
                  "plane-breaker open transitions")
         w.sample("estpu_plane_breaker_trips_total", None,
                  pb.get("trips", 0))
+
+    # ---- dispatch watchdog (hang half of the fault model) ---------------
+    # the watchdog_* counters export via JIT_COUNTERS above; the gauges
+    # here are its live stall-liveness signals: an oldest-wait age that
+    # keeps CLIMBING is a wedge in progress before any envelope fires
+    from elasticsearch_tpu.search.watchdog import dispatch_watchdog
+    wd = dispatch_watchdog.stats()
+    w.family("estpu_watchdog_oldest_wait_age_seconds", "gauge",
+             "age of the oldest in-flight registered device wait")
+    w.sample("estpu_watchdog_oldest_wait_age_seconds", None,
+             wd["oldest_wait_age_seconds"])
+    w.family("estpu_watchdog_in_flight_waits", "gauge",
+             "registered device waits currently outstanding")
+    w.sample("estpu_watchdog_in_flight_waits", None,
+             wd["in_flight_waits"])
+    w.family("estpu_watchdog_quarantined", "gauge",
+             "1 while the plane breaker is quarantined pending a probe")
+    w.sample("estpu_watchdog_quarantined", None,
+             int(bool(wd["quarantined"])))
 
     # ---- breakers -------------------------------------------------------
     w.family("estpu_breaker_used_bytes", "gauge",

@@ -23,6 +23,12 @@ from __future__ import annotations
 import inspect
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutTimeout
+
+#: ceiling on `execute()`'s blocking wait for its own batch result — a
+#: wedged run/drain must surface as a serial fallback (None) with a
+#: `stalled` tally, never a caller thread parked forever
+EXECUTE_STALL_S = 60.0
 
 
 def pow2_bucket(n: int, cap: int | None = None) -> int:
@@ -93,6 +99,8 @@ class AdaptiveBatcher:
         # threads)
         self.batches = 0
         self.requests = 0
+        # execute() waits that hit the stall ceiling and fell back serial
+        self.stalled = 0
 
     def bucket_sizes(self) -> list[int]:
         """Every batch size _dispatch can hand to run_batch: powers of two
@@ -132,8 +140,19 @@ class AdaptiveBatcher:
         return fut
 
     def execute(self, req):
-        """Blocking convenience: submit and wait. → result | None."""
-        return self.submit(req).result()
+        """Blocking convenience: submit and wait. → result | None.
+
+        BOUNDED: when the batch wedges past ``EXECUTE_STALL_S`` (hung
+        device dispatch or drain) the wait is abandoned and the caller
+        gets None — the serial-fallback contract — with the stall
+        tallied. The batch thread still owns its futures; a late result
+        resolves a future nobody reads, which is harmless."""
+        try:
+            return self.submit(req).result(EXECUTE_STALL_S)
+        except FutTimeout:
+            with self._lock:
+                self.stalled += 1
+            return None
 
     def close(self) -> None:
         with self._lock:

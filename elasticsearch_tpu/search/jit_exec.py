@@ -70,6 +70,14 @@ class DeviceOomError(DeviceFaultError):
     evict cold device blocks and let the next build retry smaller."""
 
 
+class DeviceStallError(DeviceFaultError):
+    """A device wait outlived its predicted envelope and the watchdog
+    abandoned it. HONESTY: Python cannot cancel a wedged XLA dispatch or
+    transfer — the underlying program may still own the device; what was
+    abandoned is the *wait*, so the caller fails over while the wedged
+    thread is left to finish (or not) on its own."""
+
+
 #: chaos seam: a callable(site: str) that may raise at each device
 #: touchpoint — ``dispatch`` (compiled per-segment/reader programs),
 #: ``compile`` (program build), ``upload`` (host→device block/column
@@ -207,6 +215,10 @@ class PlaneBreaker:
         self._backoff_s = self.base_backoff_s
         self._retry_at = 0.0
         self._probe_deadline: float | None = None
+        # watchdog quarantine: while set, allow() is False for live
+        # traffic unconditionally — reopen is gated on the watchdog's
+        # background probe program, never on a live-request probe
+        self.quarantined = False
 
     #: breaker state → registered flight-recorder event type
     _TRANSITION_EVENTS = {"open": "breaker-open",
@@ -248,6 +260,8 @@ class PlaneBreaker:
         now = time.monotonic()
         probing = False
         with self._lock:
+            if self.quarantined:
+                return False
             if self.state == "closed":
                 return True
             if self.state == "open":
@@ -313,6 +327,32 @@ class PlaneBreaker:
                 error=self.last_error,
                 backoff_seconds=round(self._backoff_s, 3))
 
+    def quarantine(self) -> None:
+        """Watchdog escalation: hold the breaker open unconditionally.
+        While quarantined, ``allow()`` declines every live request (no
+        half-open probe on live traffic); only
+        :meth:`release_quarantine` — called by the watchdog after its
+        background probe program completes — readmits."""
+        with self._lock:
+            already = self.quarantined
+            self.quarantined = True
+            if self.state != "open":
+                self.state = "open"
+                self.trips += 1
+            self._probe_deadline = None
+        if not already:
+            self._note_transition("open", cause="quarantine",
+                                  trips=self.trips)
+
+    def release_quarantine(self) -> None:
+        """The watchdog's probe program completed: fully reset to
+        closed (the device proved itself end to end)."""
+        with self._lock:
+            was = self.quarantined
+            self._reset_locked()
+        if was:
+            self._note_transition("closed", probe_reopen=True)
+
     def stats(self) -> dict:
         now = time.monotonic()
         with self._lock:
@@ -324,10 +364,12 @@ class PlaneBreaker:
                 "probes": self.probes,
                 "errors_total": self.errors_total,
                 "last_error": self.last_error,
+                "quarantined": self.quarantined,
                 "backoff_seconds": round(self._backoff_s, 3),
                 "open_remaining_seconds":
                     round(max(self._retry_at - now, 0.0), 3)
-                    if self.state == "open" else 0.0,
+                    if self.state == "open" and not self.quarantined
+                    else 0.0,
             }
 
 
@@ -967,6 +1009,12 @@ def run_reader_batch(segments: list, ctx: ExecutionContext, queries: list,
     return out
 
 
+#: how long the streamed consumer waits on the feeder (per segment, and
+#: for the teardown join) before declaring the feeder's host→device
+#: transfer wedged — generous vs any real DMA; stall tests shrink it
+STREAM_FEEDER_STALL_S = 60.0
+
+
 def run_segments_streamed(segments: list, ctx: ExecutionContext,
                           queries: list, *, k: int,
                           device=None) -> list | None:
@@ -1049,7 +1097,19 @@ def run_segments_streamed(segments: list, ctx: ExecutionContext,
     try:
         for i, (seg, plan) in enumerate(zip(segments, plans)):
             t0 = time.perf_counter()
-            cur = prefetch.get()
+            stall_at = t0 + STREAM_FEEDER_STALL_S
+            while True:
+                try:
+                    cur = prefetch.get(timeout=0.25)
+                    break
+                except queue.Empty:
+                    if feed_err:
+                        raise feed_err[0]
+                    if time.perf_counter() > stall_at:
+                        raise DeviceStallError(
+                            f"hbm-stream feeder stalled staging segment "
+                            f"{i}/{len(plans)} (no transfer completed in "
+                            f"{STREAM_FEEDER_STALL_S:.0f}s)")
             if cur is None:
                 raise feed_err[0]
             stats["put_wait_s"] += time.perf_counter() - t0
@@ -1075,7 +1135,23 @@ def run_segments_streamed(segments: list, ctx: ExecutionContext,
                 slots.release()
     finally:
         stop.set()                          # unblocks a waiting feeder on
-        feeder.join()                       # any consumer-side error
+        feeder.join(timeout=STREAM_FEEDER_STALL_S)  # any consumer error
+        if feeder.is_alive():
+            # the feeder is wedged inside a host→device transfer Python
+            # cannot cancel: abandon the daemon thread, record the stall
+            # (breaker + flight recorder), and let teardown proceed —
+            # raising here would mask a propagating consumer error
+            stalled = DeviceStallError(
+                "hbm-stream feeder wedged in a host→device transfer; "
+                "teardown abandoned the join (thread left to finish)")
+            note_device_error(stalled)
+            from elasticsearch_tpu.observability import flightrec
+            flightrec.note("dispatch-stall", site="upload",
+                           lane="streamed", where="feeder-join",
+                           wait_seconds=STREAM_FEEDER_STALL_S)
+            feed_err.append(stalled)
+    if feed_err:
+        raise feed_err[0]
     run_segments_streamed.last_stats = stats
     return outs_all
 
@@ -1927,6 +2003,47 @@ def note_scheduler_shed(reason: str, n: int = 1) -> None:
             _scheduler_shed_reasons.get(reason, 0) + int(n)
     from elasticsearch_tpu.observability import flightrec
     flightrec.note_shed(reason, int(n))
+
+
+def note_watchdog_stall() -> None:
+    """One registered device wait outlived its predicted envelope (the
+    watchdog flight-recorded a ``dispatch-stall`` for it)."""
+    with _cache_lock:
+        _bump("watchdog_stalls")
+
+
+def note_watchdog_abandoned() -> None:
+    """One stalled wait the watchdog abandoned — the waiter failed over
+    while the wedged thread keeps whatever it holds (non-cancellable)."""
+    with _cache_lock:
+        _bump("watchdog_abandoned")
+
+
+def note_watchdog_quarantine() -> None:
+    """One quarantine entry: repeated stalls held the breaker open with
+    reopen gated on the background probe program."""
+    with _cache_lock:
+        _bump("watchdog_quarantines")
+
+
+def note_watchdog_probe_reopen() -> None:
+    """One quarantine lifted by a successful background probe program."""
+    with _cache_lock:
+        _bump("watchdog_probe_reopens")
+
+
+def run_probe_program(device=None) -> float:
+    """The watchdog's tiny quarantine probe: one host→device transfer
+    plus one dispatched reduction, routed through the SAME fault seam as
+    live traffic (``upload`` then ``dispatch`` fault points), so a
+    still-wedged device holds the probe exactly like it held the
+    request that tripped quarantine. Blocks until the device answers —
+    run it from a disposable thread with a bounded join."""
+    a = jnp.arange(8, dtype=jnp.float32)
+    buf = seam_device_put(a, device, site="upload")
+    with device_span("dispatch"):
+        device_fault_point("dispatch")
+        return float(jnp.dot(buf, buf))
 
 
 def note_knn_served(index_name: str | None, n_requests: int,

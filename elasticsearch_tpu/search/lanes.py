@@ -70,6 +70,16 @@ JIT_COUNTERS = {
                                "(deadline / SLO-burn / capacity)",
     "scheduler_pad_rows": "no-op pad rows appended to reach the pow2 "
                           "program bucket (never delivered or counted)",
+    # dispatch watchdog (search/watchdog.py): stall detection on every
+    # registered device wait
+    "watchdog_stalls": "device waits that outlived their predicted "
+                       "envelope (flight-recorded dispatch-stall)",
+    "watchdog_abandoned": "stalled waits the watchdog abandoned (the "
+                          "wedged program may still own the device)",
+    "watchdog_quarantines": "quarantine entries after repeated stalls "
+                            "(breaker held open, probe-gated reopen)",
+    "watchdog_probe_reopens": "quarantines lifted by a successful "
+                              "background probe program",
 }
 
 #: jit_exec._data_layer — incremental data-plane traffic accounting
@@ -158,6 +168,7 @@ LANE_REASONS = {
         "device-error",         # mesh build/dispatch raised: eager rescue
         "not-local",            # not every target shard lives on this node
         "breaker-open",         # plane breaker open: zero-dispatch decline
+        "device-stall",         # watchdog abandoned a wedged device wait
         "impact-preferred",     # ceded to the impact lane (decline edge)
         "knn-lane",             # ceded to the vector lane (decline edge)
     ),
@@ -193,6 +204,8 @@ LANE_REASONS = {
         "slo-shed",             # queue_wait SLO burn: typed 429 rejection
         "queue-full",           # admission queue at capacity: typed 429
         "closed",               # node shutting down: serial fallback
+        "device-stall",         # batch abandoned by the dispatch
+                                # watchdog: waiters redirected serial
     ),
 }
 

@@ -51,7 +51,8 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutTimeout
 from contextlib import nullcontext
 
 from elasticsearch_tpu.common.threadpool import EsRejectedExecutionError
@@ -183,6 +184,27 @@ class _Waiter:
         self.bound_run = obs_trace.bind_context(_invoke)
 
 
+#: caller-side backstop on ``execute()``'s picked/result waits — the
+#: watchdog abandon resolves every batch's waiters long before this;
+#: the backstop only guards a disabled/dead watchdog (a timed-out
+#: caller runs its serial path; the waiter's accounting is untouched)
+EXECUTE_BACKSTOP_S = 600.0
+
+
+class _BatchState:
+    """Per-launched-batch abandon/finish state: the scheduler lock
+    arbitrates the race between the drain worker finishing and the
+    watchdog monitor abandoning, so the in-flight permit releases
+    exactly once and a late (post-abandon) completion is discarded."""
+
+    __slots__ = ("live", "finished", "abandoned")
+
+    def __init__(self, live):
+        self.live = live
+        self.finished = False
+        self.abandoned = False
+
+
 class _LaneQueue:
     __slots__ = ("key", "lane", "waiters", "launch", "drain")
 
@@ -231,9 +253,6 @@ class ContinuousBatchScheduler:
             self._wrr.extend([lane] * max(int(self.weights[lane]), 1))
         self._wrr_pos = 0
         self._inflight_sem = threading.BoundedSemaphore(self.max_in_flight)
-        self._drain_pool = ThreadPoolExecutor(
-            max_workers=self.max_in_flight + 1,
-            thread_name_prefix="sched-drain")
         self._dispatcher: threading.Thread | None = None
         self._closed = False
         # counters (all under _lock; stats() snapshots one consistent
@@ -249,6 +268,7 @@ class ContinuousBatchScheduler:
         self._batches_launched = 0
         self._batches_inflight = 0
         self._batches_drained = 0
+        self._batches_abandoned = 0
         self._inflight_hw = 0
         self._pad_rows = 0
         # SLO-burn shed gate: the scheduler's OWN queue-wait good/bad
@@ -347,9 +367,16 @@ class ContinuousBatchScheduler:
         w = self.submit(lane, key, req, launch, drain)
         if obs_trace.active():
             with obs_trace.span("scheduler.queue", lane=lane) as sp:
-                w.picked.wait()
+                w.picked.wait(EXECUTE_BACKSTOP_S)
                 sp.set(queue_ms=round(w.queue_ms, 3))
-        out = w.future.result()
+        try:
+            out = w.future.result(timeout=EXECUTE_BACKSTOP_S)
+        except FutTimeout:
+            # the watchdog should have abandoned this batch long ago;
+            # the backstop fails the CALLER over to its serial path
+            # without touching the waiter's books (a late delivery
+            # still reconciles — the caller just isn't listening)
+            return None
         if out is DECLINED:
             return None
         return out
@@ -441,8 +468,21 @@ class ContinuousBatchScheduler:
         from elasticsearch_tpu.search import jit_exec
         now_m = time.monotonic()
         now_p = time.perf_counter()
+        # the watchdog quarantined the device: redirect the whole
+        # pickup to the serial path instead of launching into a known
+        # wedge (new arrivals stop at the caller's breaker check; this
+        # drains what queued before the quarantine)
+        quarantined = jit_exec.plane_breaker.quarantined
         live = []
         for w in batch:
+            if quarantined:
+                jit_exec.note_scheduler_shed("device-stall")
+                with self._lock:
+                    self._inflight_reqs -= 1
+                    self._note_shed_locked("device-stall")
+                w.picked.set()
+                w.future.set_result(DECLINED)
+                continue
             if w.task is not None and w.task.cancelled:
                 jit_exec.note_scheduler_shed("task-cancelled")
                 with self._lock:
@@ -467,10 +507,15 @@ class ContinuousBatchScheduler:
         return live
 
     def _launch_batch(self, q: _LaneQueue, live: list) -> None:
-        """Launch one formed batch. Pipelined queues (drain set) launch
-        on THIS thread — an async device dispatch — and hand the drain
-        to a worker; sync queues (percolate) run whole on the worker so
-        the dispatcher keeps feeding the compiled lanes."""
+        """Commit one formed batch to a drain worker. The worker owns
+        BOTH launch and drain — a device dispatch can *hang*, and a
+        hang on the dispatcher's own thread would wedge the whole
+        scheduler; on a worker the watchdog abandons the wait and the
+        dispatcher keeps feeding (the stall-tolerance contract). A
+        batch counts ``launched`` when committed here and leaves the
+        books exactly once: ``drained`` (worker finished — even on a
+        launch error, matching the sync lane's accounting) or
+        ``abandoned`` (watchdog gave up on the wait)."""
         from elasticsearch_tpu.observability import histograms as obs_hist
         from elasticsearch_tpu.search import jit_exec
         t_pick = time.perf_counter()
@@ -484,6 +529,10 @@ class ContinuousBatchScheduler:
         with self._lock:
             self._qw_good += len(live) - bad
             self._qw_bad += bad
+        state = _BatchState(live)
+        runner = live[0].bound_run if len(live) == 1 else None
+        if runner is _invoke:
+            runner = None               # no context was active at submit
         if q.drain is None:
             with self._lock:
                 self._batches_launched += 1
@@ -491,11 +540,8 @@ class ContinuousBatchScheduler:
                 self._inflight_hw = max(self._inflight_hw,
                                         self._batches_inflight)
             jit_exec.note_scheduler_batch(len(live), 0)
-            self._drain_pool.submit(self._run_sync, q, live)
+            self._spawn_worker(self._run_sync, q, live, runner, state)
             return
-        runner = live[0].bound_run if len(live) == 1 else None
-        if runner is _invoke:
-            runner = None               # no context was active at submit
         reqs = [w.req for w in live]
         padded = 0
         if self.pad_to_bucket and len(reqs) < self.max_batch:
@@ -507,19 +553,6 @@ class ContinuousBatchScheduler:
             bucket = pow2_bucket(len(reqs), self.max_batch)
             padded = bucket - len(reqs)
             reqs = reqs + [reqs[0]] * padded
-        try:
-            if runner is not None:
-                handle = runner(q.launch, reqs, n_real=len(live))
-            else:
-                handle = q.launch(reqs, n_real=len(live))
-        except Exception:                # noqa: BLE001 — decline the batch:
-            self._deliver_declined(live)     # the serial retry owns the
-            self._inflight_sem.release()     # real error semantics
-            return
-        if handle is None:
-            self._deliver_declined(live)
-            self._inflight_sem.release()
-            return
         with self._lock:
             self._batches_launched += 1
             self._batches_inflight += 1
@@ -527,47 +560,118 @@ class ContinuousBatchScheduler:
             self._inflight_hw = max(self._inflight_hw,
                                     self._batches_inflight)
         jit_exec.note_scheduler_batch(len(live), padded)
-        try:
-            self._drain_pool.submit(self._drain_and_deliver, q, handle,
-                                    live, runner)
-        except RuntimeError:             # pool shut down mid-close
-            self._drain_and_deliver(q, handle, live, runner)
+        self._spawn_worker(self._run_pipelined, q, live, runner, reqs,
+                           state)
 
-    def _run_sync(self, q: _LaneQueue, live: list) -> None:
-        """Whole-batch execution for sync (launch-only) lanes."""
-        from elasticsearch_tpu.search import jit_exec
-        runner = live[0].bound_run if len(live) == 1 else None
-        if runner is _invoke:
-            runner = None
+    def _spawn_worker(self, fn, *args) -> None:
+        """One DAEMON worker thread per committed batch. Not a bounded
+        pool on purpose: a wedged batch parks its worker on the device
+        indefinitely (non-cancellable), and under repeated stalls a
+        bounded pool starves — batches queue behind wedged threads and
+        never even reach watchdog registration. Concurrency is still
+        bounded by ``_inflight_sem`` (abandons release the permit, so
+        live batches, not wedged threads, own the window), and daemon
+        threads never block interpreter exit on a wedge. Each worker
+        runs under this scheduler's node context so compiles, costs,
+        spans and ledger charges attribute to the owning node exactly
+        like the dispatcher thread."""
+        def run() -> None:
+            from elasticsearch_tpu.observability import use_node
+            ctx = use_node(self.node_id) if self.node_id is not None \
+                else nullcontext()
+            with ctx:
+                fn(*args)
+
+        threading.Thread(target=run, daemon=True,
+                         name="sched-batch").start()
+
+    def _run_sync(self, q: _LaneQueue, live: list, runner,
+                  state: _BatchState) -> None:
+        """Whole-batch execution for sync (launch-only) lanes, under a
+        registered watchdog wait."""
+        from elasticsearch_tpu.search import watchdog as wd
+        entry = wd.dispatch_watchdog.register(
+            site="dispatch", lane=q.lane, shape_key=q.key,
+            n_real=len(live),
+            on_stall=lambda err: self._abandon_batch(state))
         try:
             reqs = [w.req for w in live]
             results = runner(q.launch, reqs) if runner is not None \
                 else q.launch(reqs)
         except Exception:                # noqa: BLE001 — serial retry owns it
             results = None
-        finally:
-            with self._lock:
-                self._batches_inflight -= 1
-                self._batches_drained += 1
-            self._inflight_sem.release()
+        wd.dispatch_watchdog.complete(entry)
+        self._finish_batch(state, live, results)
+
+    def _run_pipelined(self, q: _LaneQueue, live: list, runner,
+                       reqs: list, state: _BatchState) -> None:
+        """Launch + drain for pipelined lanes, on a worker thread: the
+        async launch overlaps the previous batch's drain exactly as
+        before (the dispatcher keeps forming batches while this worker
+        blocks on the device), but a wedged dispatch now wedges only
+        THIS worker — the watchdog abandons the wait and the in-flight
+        permit, and the dispatcher never stops."""
+        from elasticsearch_tpu.search import watchdog as wd
+        entry = wd.dispatch_watchdog.register(
+            site="dispatch", lane=q.lane, shape_key=q.key,
+            n_real=len(live),
+            on_stall=lambda err: self._abandon_batch(state))
+        results = None
+        try:
+            if runner is not None:
+                handle = runner(q.launch, reqs, n_real=len(live))
+            else:
+                handle = q.launch(reqs, n_real=len(live))
+            if handle is not None:
+                results = runner(q.drain, handle) if runner is not None \
+                    else q.drain(handle)
+        except Exception:                # noqa: BLE001 — serial retry owns it
+            results = None
+        wd.dispatch_watchdog.complete(entry)
+        self._finish_batch(state, live, results)
+
+    def _finish_batch(self, state: _BatchState, live: list,
+                      results) -> None:
+        """Worker-side batch completion: exactly one of finish/abandon
+        wins under the lock. A late completion of an abandoned batch
+        discards its results — the waiters already failed over and the
+        abandon path already released the permit and settled the
+        books."""
+        from elasticsearch_tpu.search import jit_exec
+        with self._lock:
+            if state.abandoned:
+                return
+            state.finished = True
+            self._batches_inflight -= 1
+            self._batches_drained += 1
+        self._inflight_sem.release()
         jit_exec.note_scheduler_drain()
         self._deliver(live, results)
 
-    def _drain_and_deliver(self, q: _LaneQueue, handle, live: list,
-                           runner=None) -> None:
+    def _abandon_batch(self, state: _BatchState) -> bool:
+        """Watchdog-side batch abandonment (runs on the monitor thread;
+        the wedged worker still blocks on the device — only the WAIT is
+        abandoned): shed every waiter back to its serial path with
+        registered reason ``device-stall``, settle the batch books, and
+        release the in-flight permit so the dispatcher's window never
+        shrinks under a wedge."""
         from elasticsearch_tpu.search import jit_exec
-        try:
-            results = runner(q.drain, handle) if runner is not None \
-                else q.drain(handle)
-        except Exception:                # noqa: BLE001 — serial retry owns it
-            results = None
-        finally:
-            with self._lock:
-                self._batches_inflight -= 1
-                self._batches_drained += 1
-            self._inflight_sem.release()
-        jit_exec.note_scheduler_drain()
-        self._deliver(live, results)
+        with self._lock:
+            if state.finished or state.abandoned:
+                return False
+            state.abandoned = True
+            self._batches_inflight -= 1
+            self._batches_abandoned += 1
+            self._inflight_reqs -= len(state.live)
+            for _ in state.live:
+                self._note_shed_locked("device-stall")
+        jit_exec.note_scheduler_shed("device-stall", len(state.live))
+        self._inflight_sem.release()
+        for w in state.live:
+            w.picked.set()
+            if not w.future.done():
+                w.future.set_result(DECLINED)
+        return True
 
     def _deliver(self, live: list, results) -> None:
         if results is None:
@@ -660,13 +764,14 @@ class ContinuousBatchScheduler:
                 "batches_launched": self._batches_launched,
                 "batches_in_flight": self._batches_inflight,
                 "batches_drained": self._batches_drained,
+                "batches_abandoned": self._batches_abandoned,
                 "in_flight_high_water": self._inflight_hw,
                 "pad_rows": self._pad_rows,
                 "reconciled": (
                     self._submitted == self._queued + self._inflight_reqs
                     + self._delivered + self._declined + self._shed
                     and self._batches_launched == self._batches_drained
-                    + self._batches_inflight),
+                    + self._batches_inflight + self._batches_abandoned),
             }
         return doc
 
@@ -702,8 +807,9 @@ class ContinuousBatchScheduler:
             dispatcher.join(timeout=5.0)
         else:
             self._flush_closed()
-        # let in-flight drains finish so no waiter hangs forever
-        self._drain_pool.shutdown(wait=True)
+        # in-flight batch workers are daemon threads that resolve their
+        # own waiters (or the watchdog abandons them) — close() never
+        # waits on a possibly-wedged device drain
 
 
 def settings_for(get) -> dict:

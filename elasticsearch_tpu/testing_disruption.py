@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import contextlib
 import random
+import threading
 import time
 
 from elasticsearch_tpu.transport.service import DROP, DUPLICATE, REORDER
@@ -563,6 +564,127 @@ class DeviceFaultScheme:
             self.stop_disrupting()
 
 
+class StallScheme:
+    """Seeded device-HANG injection on jit_exec's device-fault seam:
+    the other half of the fault model. :class:`DeviceFaultScheme`
+    raises — breakers and fallbacks see a typed error immediately; this
+    scheme *holds*: with probability ``p`` (overridable per site via
+    ``p_by_site``) a touchpoint simply blocks, the way a wedged XLA
+    program, stuck H2D transfer or runaway compile behaves. Nothing
+    raises at the seam, so only deadline-bounded waits and the dispatch
+    watchdog make the hang observable.
+
+    Two hold modes, drawn per injection from the replayable rng:
+
+    * finite delay — hold for ``uniform(*delay_range)`` seconds (a slow
+      wedge that eventually completes);
+    * permanent wedge (``wedge_fraction`` of injections, or a
+      ``delay_range`` of None) — hold until released.
+
+    Every hold (finite or permanent) blocks on ONE shared release
+    event, so :meth:`heal` / :meth:`stop_disrupting` release every held
+    site immediately — the 'hang clears' half of a recovery scenario.
+    Counters mirror DeviceFaultScheme: ``calls``/``calls_by_site``
+    count touchpoints reached, ``injected`` counts holds by site,
+    ``holding`` gauges threads currently held.
+    """
+
+    def __init__(self, seed: int = 0, p: float = 0.0,
+                 sites: tuple = DEVICE_FAULT_SITES,
+                 p_by_site: dict | None = None,
+                 delay_range: tuple | None = (0.02, 0.12),
+                 wedge_fraction: float = 0.0,
+                 reset_breaker_on_stop: bool = True):
+        self.seed = seed
+        self.p = float(p)
+        self.sites = tuple(sites)
+        self.p_by_site = dict(p_by_site or {})
+        self.delay_range = delay_range
+        self.wedge_fraction = float(wedge_fraction)
+        self.reset_breaker_on_stop = reset_breaker_on_stop
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+        self._release = threading.Event()
+        self._prev = None
+        self._active = False
+        self.injected: dict[str, int] = {}
+        self.calls = 0
+        self.calls_by_site: dict[str, int] = {}
+        #: threads currently held at the seam (gauge, not a counter)
+        self.holding = 0
+
+    @property
+    def total_injected(self) -> int:
+        return sum(self.injected.values())
+
+    def heal(self) -> None:
+        """Stop injecting AND release every held site (the hook stays
+        installed and keeps counting touchpoints) — after heal() the
+        device serves again and quarantine may probe-reopen."""
+        self.p = 0.0
+        self.p_by_site = {}
+        self._release.set()
+
+    def _hook(self, site: str) -> None:
+        with self._rng_lock:
+            self.calls += 1
+            self.calls_by_site[site] = \
+                self.calls_by_site.get(site, 0) + 1
+            p = self.p_by_site.get(site,
+                                   self.p if site in self.sites else 0.0)
+            if p <= 0.0 or self._rng.random() >= p:
+                return
+            self.injected[site] = self.injected.get(site, 0) + 1
+            wedge = self.delay_range is None or (
+                self.wedge_fraction
+                and self._rng.random() < self.wedge_fraction)
+            dur = None if wedge else self._rng.uniform(*self.delay_range)
+            self.holding += 1
+        try:
+            # cooperative hold: waits on the shared release event so
+            # heal()/stop_disrupting() free every held thread at once;
+            # a finite delay is the same wait with a timeout
+            if dur is None:
+                self._release.wait()
+            else:
+                self._release.wait(dur)
+        finally:
+            with self._rng_lock:
+                self.holding -= 1
+
+    def _chained(self, site: str) -> None:
+        if self._prev is not None:
+            self._prev(site)
+        self._hook(site)
+
+    def start_disrupting(self) -> None:
+        if self._active:
+            return
+        from elasticsearch_tpu.search import jit_exec
+        self._release.clear()
+        self._prev = jit_exec.set_device_fault_hook(self._chained)
+        self._active = True
+
+    def stop_disrupting(self) -> None:
+        if not self._active:
+            return
+        from elasticsearch_tpu.search import jit_exec
+        self._release.set()             # free every held thread
+        jit_exec.set_device_fault_hook(self._prev)
+        self._prev = None
+        self._active = False
+        if self.reset_breaker_on_stop:
+            jit_exec.plane_breaker.reset()
+
+    @contextlib.contextmanager
+    def applied(self):
+        self.start_disrupting()
+        try:
+            yield self
+        finally:
+            self.stop_disrupting()
+
+
 # ---- coordinator-kill scenario (task-management chaos) ----------------------
 
 def run_coordinator_kill_case(seed: int, transport: str = "local") -> dict:
@@ -661,6 +783,10 @@ SCHEME_NAMES = (
     # every in-process node shares the one device)
     "device_flaky",
     "device_oom",
+    # device HANGS (the stall half of the fault model): finite holds at
+    # the same seam — bounded waits + the dispatch watchdog must keep
+    # every request inside its deadline
+    "device_stall",
     # sustained per-node service delay (browned out, not failed) — the
     # tail-tolerance layer's target failure mode
     "brownout",
@@ -681,6 +807,13 @@ def build_scheme(name: str, nodes: list, rnd: random.Random):
         # HBM-OOM shape: cold-block eviction then degrade
         return DeviceFaultScheme(seed=seed, p=rnd.uniform(0.05, 0.2),
                                  oom_fraction=1.0)
+    if name == "device_stall":
+        # finite holds only (the matrix must complete): slow-wedge
+        # delays well under every deadline; the permanent-wedge mode
+        # runs in the targeted stall scenarios/suite, which own the
+        # heal/quarantine assertions
+        return StallScheme(seed=seed, p=rnd.uniform(0.05, 0.2),
+                           delay_range=(0.02, 0.1))
     if name == "brownout":
         # brown out ONE node's serve path: delay without drop. The delay
         # stays under the shard RPC timeout by orders of magnitude —

@@ -28,6 +28,17 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# ---- hang tripwire (the stall-tolerance PR's own honesty check) -----------
+# The tier-1 gate runs under `timeout -k 10 870`; a genuine hang (a wait
+# this PR failed to bound) would burn the whole wall and die with no
+# evidence. Dump every thread's stack shortly BEFORE the outer timeout so
+# the wedged wait is named in the log. repeat=False, exit=False: purely
+# diagnostic — pytest (or the outer timeout) still owns the verdict.
+import faulthandler  # noqa: E402
+
+if hasattr(faulthandler, "dump_traceback_later"):
+    faulthandler.dump_traceback_later(840, exit=False)
+
 
 # ---- randomized-seed harness (ESTestCase / TESTING.asciidoc:1-60) ---------
 # Every session draws a master seed (override: ESTPU_TEST_SEED=<n>); each

@@ -1,0 +1,34 @@
+"""Negative fixture: bounded waits and non-blocking look-alikes — all
+clean under the unbounded-wait rule."""
+
+WAIT_S = 60.0
+
+
+def bounded_collect(fut, deadline_s):
+    return fut.result(deadline_s)
+
+
+def bounded_collect_kw(fut):
+    return fut.result(timeout=WAIT_S)
+
+
+def bounded_teardown(thread):
+    thread.join(WAIT_S)
+
+
+def bounded_consume(prefetch):
+    return prefetch.get(timeout=0.25)
+
+
+def bounded_pickup(event):
+    return event.wait(WAIT_S)
+
+
+def lookalikes(mapping, parts, opts):
+    # .get with a key and str.join with an argument are accessors, not
+    # blocking waits; **kwargs may carry a timeout and gets the benefit
+    # of the doubt
+    val = mapping.get("key")
+    joined = ",".join(parts)
+    flexible = opts["fut"].result(**opts["kw"])
+    return val, joined, flexible

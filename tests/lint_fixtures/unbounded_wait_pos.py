@@ -1,0 +1,25 @@
+"""Positive fixtures: zero-timeout blocking waits on the serving path
+(the fixture LintConfig maps ``*/unbounded_wait_*.py`` to the
+wait-policed modules).
+
+``coordinator_collect_regression`` is the shape plane-lint exists to
+catch on the real tree: the pre-PR-16 ``_collect_shard_result`` tail
+(`action/search_action.py`) returned ``fut.result()`` with no timeout,
+so a shard whose device dispatch wedged parked the coordinator thread
+forever instead of becoming a typed shard failure."""
+
+
+def coordinator_collect_regression(fut):
+    return fut.result()
+
+
+def feeder_teardown(thread):
+    thread.join()
+
+
+def consume_staged(prefetch):
+    return prefetch.get()
+
+
+def wait_for_pickup(event):
+    event.wait()

@@ -1,0 +1,10 @@
+"""Suppressed fixture: a worker loop that may legitimately idle forever
+for its next task, with the reasoned allow arguing why."""
+
+
+def worker_loop(q, handle):
+    while True:
+        task = q.get()  # estpu: allow[unbounded-wait] idle worker awaiting its next task — no device work is held across this wait
+        if task is None:
+            return
+        handle(task)

@@ -59,6 +59,8 @@ SCENARIOS = [
     "brownout_during_search_storm",
     # v5 continuous-batching-scheduler combination scenario
     "scheduler_mixed_storm",
+    # v6 stall-tolerance combination scenario (hang, not raise)
+    "stall_during_search_storm",
 ]
 
 #: scenarios that stage their own disruption — layering a random scheme
@@ -69,6 +71,7 @@ SELF_DISRUPTING = {
     "master_failover_during_bulk", "disk_fault_failover",
     "device_fault_during_refresh_storm", "device_fault_during_relocation",
     "brownout_during_search_storm", "scheduler_mixed_storm",
+    "stall_during_search_storm",
 }
 
 #: schemes a write-exercising scenario can carry while still asserting
@@ -82,13 +85,14 @@ SELF_DISRUPTING = {
 #: correctly, just slowly (delay without drop)
 SOFT_SCHEMES = ("none", "delays", "flaky_delay", "duplicate", "reorder",
                 "slow_state_one", "device_flaky", "device_oom",
-                "brownout")
+                "brownout", "device_stall")
 
 #: deterministic tier-1 smoke subset (the full matrix is `slow`)
 SMOKE = ["crud_search", "partition_minority", "recovery_during_relocation",
          "master_failover_during_bulk", "disk_fault_failover",
          "device_fault_during_refresh_storm",
-         "brownout_during_search_storm", "scheduler_mixed_storm"]
+         "brownout_during_search_storm", "scheduler_mixed_storm",
+         "stall_during_search_storm"]
 
 VARIANTS = int(os.environ.get("ESTPU_MATRIX_VARIANTS", "3"))
 
@@ -1234,3 +1238,196 @@ def _scenario_scheduler_mixed_storm(c, rnd, spec):
     r = coordinator.search("m_sched", dict(q_body))
     assert r["hits"]["total"] >= n_docs and \
         r["_shards"]["failed"] == 0, r["_shards"]
+
+
+def _scenario_stall_during_search_storm(c, rnd, spec):
+    """Combination: the device WEDGES (StallScheme permanent hold at
+    the ``dispatch`` fault site — nothing raises, threads just hang)
+    while a concurrent search storm runs. The stall-tolerance ladder
+    must: (1) keep deadline-bounded searches bounded — a wedged shard
+    becomes a timed-out/stalled shard failure within the deadline plus
+    grace, never a hung request; (2) have the dispatch watchdog abandon
+    the wedged scheduler batch (stalls/abandoned tallies, a
+    ``dispatch-stall`` flight-recorder event) and, after the configured
+    consecutive stalls, QUARANTINE the plane — breaker held open, live
+    traffic shed serial; (3) keep the quarantine closed to probes while
+    the device stays wedged (probes attempted, zero reopens); (4) after
+    ``heal()``, reopen ONLY via a fresh successful probe program; and
+    (5) reconcile every ledger once the storm drains — scheduler
+    counters (launched == drained + abandoned), zero request-breaker
+    bytes, zero open spans — with the same search exact afterwards."""
+    from elasticsearch_tpu.observability import flightrec as _flight
+    from elasticsearch_tpu.observability import tracing as obs_trace
+    from elasticsearch_tpu.search import jit_exec
+    from elasticsearch_tpu.search import watchdog as wd_mod
+    from elasticsearch_tpu.testing_disruption import (StallScheme,
+                                                      wait_until)
+    a = c.master()
+    a.indices_service.create_index("m_stall", {"settings": {
+        "number_of_shards": 2,
+        "number_of_replicas": 1,
+        # force the per-shard fan-out: the bounded coordinator collects
+        # + the shard-side scheduler path are what this scenario tests
+        "index.search.collective_plane": "false"}})
+    _green(a)
+    n_docs = rnd.randint(24, 40)
+    for i in range(n_docs):
+        a.index_doc("m_stall", str(i),
+                    {"n": i, "body": f"tok{i % 5} shared"})
+    a.broadcast_actions.refresh("m_stall")
+    body = {"query": {"match": {"body": "shared"}}, "size": 5}
+    started = [n for n in c.nodes if n._started]
+    coordinator = started[rnd.randrange(len(started))]
+    r = coordinator.search("m_stall", dict(body))       # healthy warm-up
+    assert r["hits"]["total"] == n_docs
+    wd = wd_mod.dispatch_watchdog
+    saved = {"stall_multiplier": wd.stall_multiplier,
+             "floor_s": wd.floor_s, "cold_floor_s": wd.cold_floor_s,
+             "ceiling_s": wd.ceiling_s,
+             "quarantine_stalls": wd.quarantine_stalls,
+             "tick_s": wd.tick_s,
+             "probe_interval_s": wd.probe_interval_s,
+             "probe_budget_s": wd.probe_budget_s}
+    base = wd.stats()
+    errors: list = []
+    shed_429: list = []
+
+    def storm_client(ci: int) -> None:
+        from elasticsearch_tpu.search.scheduler import \
+            SchedulerRejectedError
+        try:
+            r = coordinator.search("m_stall", dict(body))
+            if r["hits"]["total"] != n_docs or r["_shards"]["failed"]:
+                errors.append(("shards", r["_shards"]))
+        except SchedulerRejectedError as e:
+            shed_429.append(("query", e.reason))
+        except Exception as e:       # noqa: BLE001 — surfaced below
+            errors.append(("raised", e))
+    threads = [threading.Thread(target=storm_client, args=(ci,),
+                                daemon=True) for ci in range(3)]
+    scheme = StallScheme(seed=rnd.randrange(2 ** 31),
+                         p_by_site={"dispatch": 1.0},
+                         delay_range=None)        # permanent wedge
+    try:
+        # tiny envelopes so the CPU-scale storm stalls within the case
+        # budget; quarantine on the FIRST abandoned wait
+        wd.configure(stall_multiplier=1.0, floor_s=0.4,
+                     cold_floor_s=0.4, ceiling_s=0.6,
+                     quarantine_stalls=1, tick_s=0.02,
+                     probe_interval_s=0.1, probe_budget_s=5.0)
+        with scheme.applied():
+            # (1) bounded latency against the RAW wedge (breaker still
+            # closed, so the eager path truly dispatches and hangs): a
+            # deadline-bounded search returns an honest partial —
+            # timed_out, exact _shards — within deadline + grace, never
+            # a hung request. Fresh query text so no cache layer can
+            # answer without touching the device.
+            t0 = time.perf_counter()
+            part = coordinator.search(
+                "m_stall", {"query": {"match": {"body": "tok1 shared"}},
+                            "size": 5, "timeout": "150ms",
+                            "allow_partial_search_results": True})
+            elapsed = time.perf_counter() - t0
+            assert elapsed < 20.0, \
+                f"timed search took {elapsed:.1f}s under a wedge"
+            assert part["timed_out"] is True, part.get("_shards")
+            sh = part["_shards"]
+            assert sh["successful"] + sh["failed"] == sh["total"], sh
+            assert sh["failed"] >= 1, sh
+            for t in threads:
+                t.start()
+            # (2) the wedged scheduler batch is abandoned and the plane
+            # quarantined — watched via the singleton's tallies
+            assert wait_until(
+                lambda: (lambda s: s["abandoned"] > base["abandoned"]
+                         and s["quarantined"])(wd.stats()),
+                timeout=30.0), wd.stats()
+            # while quarantined, live traffic is still served AND still
+            # bounded: the breaker-open serial path fails over to the
+            # host scorer, so a timed search may even fully succeed —
+            # the invariant is the latency bound + coherent accounting
+            for _ in range(2):
+                t0 = time.perf_counter()
+                try:
+                    part = coordinator.search(
+                        "m_stall", {**body, "timeout": "150ms",
+                                    "allow_partial_search_results": True})
+                except Exception:    # noqa: BLE001 — a typed all-shards
+                    part = None      # failure is bounded too
+                elapsed = time.perf_counter() - t0
+                assert elapsed < 20.0, \
+                    f"timed search took {elapsed:.1f}s under quarantine"
+                if part is not None:
+                    sh = part["_shards"]
+                    assert sh["successful"] + sh["failed"] == \
+                        sh["total"], sh
+            # (3) probes run but cannot reopen while wedged: the probe
+            # program routes through the SAME fault seam and hangs
+            assert wait_until(
+                lambda: wd.stats()["probes_attempted"] >
+                base["probes_attempted"], timeout=10.0), wd.stats()
+            st = wd.stats()
+            assert st["quarantined"] and \
+                st["probe_reopens"] == base["probe_reopens"], st
+            # the stall was flight-recorded with its envelope + join ids
+            stalls = [e for nid in (_flight.node_ids() or [""])
+                      for e in _flight.events(nid)
+                      if e["type"] == "dispatch-stall"]
+            assert stalls, "no dispatch-stall event recorded"
+            assert any(e.get("site") == "dispatch" and
+                       "budget_seconds" in e for e in stalls), stalls[:3]
+            # (4) heal releases every held thread; the quarantine lifts
+            # ONLY via a fresh successful probe program
+            scheme.heal()
+            assert wait_until(
+                lambda: not wd.stats()["quarantined"], timeout=30.0), \
+                wd.stats()
+            st = wd.stats()
+            assert st["probe_reopens"] > base["probe_reopens"], st
+            assert jit_exec.plane_breaker.allow(), \
+                jit_exec.plane_breaker.stats()
+        for t in threads:
+            t.join(60)
+        assert not any(t.is_alive() for t in threads), \
+            "storm wedged past heal: a client never completed"
+        assert not errors, errors[:3]
+        from elasticsearch_tpu.search import lanes as lane_reg
+        for _, reason in shed_429:
+            assert reason in lane_reg.LANE_REASONS["scheduler"], shed_429
+        # (5) every ledger reconciles once the storm drains
+        abandoned_total = 0
+        for n in started:
+            sched = n.search_actions.scheduler
+            assert wait_until(
+                lambda s=sched: (lambda st: st["queue_depth"] == 0
+                                 and st["in_flight_requests"] == 0
+                                 and st["batches_in_flight"] == 0)(
+                                     s.stats()),
+                timeout=15.0), (n.node_name, sched.stats())
+            st = sched.stats()
+            assert st["reconciled"], (n.node_name, st)
+            assert st["batches_launched"] == st["batches_drained"] + \
+                st["batches_in_flight"] + st["batches_abandoned"], \
+                (n.node_name, st)
+            abandoned_total += st["batches_abandoned"]
+        assert abandoned_total >= 1, \
+            "watchdog tallied an abandon but no scheduler batch " \
+            "was abandoned"
+        assert wait_until(lambda: all(
+            n.breaker_service.breaker("request").used == 0
+            for n in started), timeout=15.0), \
+            [(n.node_name, n.breaker_service.breaker("request").used)
+             for n in started]
+        assert wait_until(lambda: all(
+            obs_trace.open_span_count(n.node_id) == 0
+            for n in started), timeout=15.0), \
+            [(n.node_name, obs_trace.store_stats(n.node_id))
+             for n in started]
+        # healed: the same search stays exact on the same fan-out
+        r = coordinator.search("m_stall", dict(body))
+        assert r["hits"]["total"] == n_docs
+        assert r["_shards"]["failed"] == 0, r["_shards"]
+    finally:
+        wd.configure(**saved)
+        wd.reset()
+        jit_exec.plane_breaker.reset()

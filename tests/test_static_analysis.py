@@ -1,4 +1,4 @@
-"""plane-lint v2 (tier-1): the ten rule families against fixture
+"""plane-lint v2 (tier-1): the eleven rule families against fixture
 snippets, the tree-is-clean gate over ``elasticsearch_tpu/``, the
 interprocedural upgrades (cross-module breaker release-reachability,
 transitive lock-order, callee host-sync), the stale-suppression audit,
@@ -528,6 +528,53 @@ def test_tree_program_cost_discipline_is_clean():
     result = tree_result()
     fam = [f for f in result.findings
            if f.family == "program-cost-discipline"]
+    assert fam == [], "\n".join(f.render() for f in fam)
+
+
+# ---------------------------------------------------------------------------
+# unbounded-wait
+# ---------------------------------------------------------------------------
+
+WAIT_CFG = LintConfig(wait_modules=("*/unbounded_wait_*.py",))
+
+
+def test_unbounded_wait_positive():
+    r = lint_fixture("unbounded_wait_pos.py", cfg=WAIT_CFG)
+    hits = open_rules(r, "unbounded-wait")
+    # .result() / .join() / .get() / .wait(), each with no timeout
+    assert len(hits) == 4, "\n".join(f.render() for f in hits)
+    assert {".result()", ".join()", ".get()", ".wait()"} == \
+        {f.message.split(" ", 1)[0] for f in hits}
+    assert all("timeout" in f.message for f in hits)
+
+
+def test_unbounded_wait_negative():
+    r = lint_fixture("unbounded_wait_neg.py", cfg=WAIT_CFG)
+    assert open_family(r, "unbounded-wait") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+
+
+def test_unbounded_wait_suppressed():
+    r = lint_fixture("unbounded_wait_sup.py", cfg=WAIT_CFG)
+    assert open_family(r, "unbounded-wait") == []
+    sup = [f for f in r.suppressed if f.rule == "unbounded-wait"]
+    assert len(sup) == 1 and sup[0].suppress_reason
+
+
+def test_unbounded_wait_scope_is_wait_modules_only():
+    """The same zero-timeout waits outside cfg.wait_modules are not
+    findings — worker-loop homes may idle forever by design."""
+    r = lint_fixture("unbounded_wait_pos.py", cfg=FIX_CFG)
+    assert open_family(r, "unbounded-wait") == []
+
+
+def test_tree_unbounded_wait_is_clean():
+    """Every blocking wait in the wait-policed serving modules
+    (dispatcher, device executor, admission batcher, coordinator)
+    carries a timeout — zero findings AND zero suppressions: the
+    stall-tolerance ladder's static acceptance gate."""
+    result = tree_result()
+    fam = [f for f in result.findings if f.family == "unbounded-wait"]
     assert fam == [], "\n".join(f.render() for f in fam)
 
 
