@@ -2142,6 +2142,180 @@ def main() -> int:
             f"degraded={tt_record['unhedged_p99_degraded_to_delay']}, "
             f"reconciled={tt_record['counters_reconciled']}")
 
+    # ---- planner_fusion leg: composed rescore dispatch vs per-lane serial --
+    # The cost-driven planner composes impact candidate generation and
+    # the window rescore into ONE device dispatch per admitted batch;
+    # the pre-planner serving of the same requests is the general
+    # per-segment path plus a host re-rank pass per request. Stamps
+    # dispatches-per-request on both paths, the fused-vs-sequential RTT
+    # ratio, the predicted-vs-measured plan cost error from a profiled
+    # response, and the planner admission counters (reconciled against
+    # the request count).
+    pf_record = None
+    if os.environ.get("BENCH_PLANNER", "1") == "1":
+        import tempfile as _pf_tmp
+        from pathlib import Path as _PfPath
+
+        from elasticsearch_tpu.index.device_reader import \
+            device_reader_for as _pf_reader
+        from elasticsearch_tpu.node import Node as _PfNode
+        from elasticsearch_tpu.observability import costs as _pf_costs
+        from elasticsearch_tpu.search import jit_exec as _jx_pf
+        from elasticsearch_tpu.search.phase import (
+            ShardSearcher as _PfSearcher,
+            parse_search_request as _pf_parse)
+
+        pf_docs = int(os.environ.get("BENCH_PLANNER_DOCS", 4000))
+        pf_batch = int(os.environ.get("BENCH_PLANNER_BATCH", 16))
+        pf_rounds = int(os.environ.get("BENCH_PLANNER_ROUNDS", 6))
+        pf_vocab = 120
+        pf_rng = np.random.default_rng(31337)
+        node_pf = _PfNode({}, data_path=_PfPath(
+            _pf_tmp.mkdtemp(prefix="bench_planner_")) / "n").start()
+        try:
+            node_pf.indices_service.create_index("planner_bench", {
+                "settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0,
+                             "index.search.collective_plane": False,
+                             "index.search.impact_plane": True,
+                             "index.search.impact.block_rows": 64},
+                "mappings": {"_doc": {"properties": {
+                    "t": {"type": "text",
+                          "analyzer": "whitespace"}}}}})
+            for di in range(pf_docs):
+                nw = int(pf_rng.integers(4, 13))
+                node_pf.index_doc("planner_bench", str(di), {
+                    "t": " ".join(
+                        f"w{int(w)}" for w in
+                        pf_rng.integers(0, pf_vocab, size=nw))})
+            node_pf.broadcast_actions.refresh("planner_bench")
+            svc_pf = node_pf.indices_service.indices["planner_bench"]
+            reader_pf = _pf_reader(svc_pf.engine(0))
+            s_fused = _PfSearcher(0, reader_pf, svc_pf.mapper_service,
+                                  index_name="planner_bench")
+            # the sequential comparator: SAME reader, the composed arm
+            # disabled — every rescore request then declines batching
+            # (the quantized/exact arms screen out rescore) and serves
+            # on the general per-segment path + host re-rank, the
+            # pre-planner ladder
+            s_seq = _PfSearcher(0, reader_pf, svc_pf.mapper_service,
+                                index_name="planner_bench")
+            s_seq._rescore_batch_launch = \
+                lambda reqs, n_real=None: None
+            pf_nreq = pf_batch * pf_rounds
+            pf_bodies = []
+            for qi in range(pf_nreq):
+                t1, t2, t3, t4 = (int(w) for w in
+                                  pf_rng.integers(0, pf_vocab, 4))
+                pf_bodies.append({
+                    "query": {"match": {"t": f"w{t1} w{t2}"}},
+                    "size": 10,
+                    "rescore": {"window_size": 24, "query": {
+                        "rescore_query": {
+                            "match": {"t": f"w{t3} w{t4}"}},
+                        "query_weight": 1.0,
+                        "rescore_query_weight": 1.5,
+                        "score_mode": "total"}}})
+            pf_reqs = [_pf_parse(b) for b in pf_bodies]
+            pf_batches = [pf_reqs[i:i + pf_batch]
+                          for i in range(0, pf_nreq, pf_batch)]
+
+            def _pf_disp() -> int:
+                return sum(r["dispatches"] for r in
+                           _pf_costs.lane_rollup().values())
+
+            t0 = time.perf_counter()
+            warm = s_fused.query_phase_batch(pf_batches[0])
+            pf_compile_s = time.perf_counter() - t0
+            assert warm is not None, "planner_fusion batch fell back"
+            d0, st0 = _pf_disp(), _jx_pf.cache_stats()
+            t0 = time.perf_counter()
+            fused_outs = []
+            for pb in pf_batches:
+                outs = s_fused.query_phase_batch(pb)
+                assert outs is not None, "planner_fusion batch declined"
+                fused_outs.extend(outs)
+            fused_s = time.perf_counter() - t0
+            d1, st1 = _pf_disp(), _jx_pf.cache_stats()
+            pf_plans = st1["planner_plans"] - st0["planner_plans"]
+            pf_fused = st1["rescore_fused_dispatches"] - \
+                st0["rescore_fused_dispatches"]
+            # sequential leg: warm the general path's programs first,
+            # then time a bounded sample request-at-a-time
+            s_seq.query_phase(pf_reqs[0])
+            pf_nseq = min(pf_nreq, max(pf_batch * 2, 16))
+            d2 = _pf_disp()
+            t0 = time.perf_counter()
+            seq_outs = [s_seq.query_phase(r) for r in
+                        pf_reqs[:pf_nseq]]
+            seq_s = time.perf_counter() - t0
+            d3 = _pf_disp()
+            fused_ms = fused_s * 1e3 / pf_nreq
+            seq_ms = seq_s * 1e3 / pf_nseq
+            # quantized-vs-exact member overlap (score domains differ
+            # by design — the impact index opted into quantization)
+            overlap = total_top = 0
+            for fo, so in zip(fused_outs[:pf_nseq], seq_outs):
+                f_ids = set(np.asarray(fo.doc_ids).tolist())
+                overlap += len(f_ids &
+                               set(np.asarray(so.doc_ids).tolist()))
+                total_top += len(f_ids)
+            # predicted-vs-measured: the drain stamps cost_error on the
+            # plan.cost span once the lane has a WARM measured price
+            # UNDER THIS NODE'S id (cost attribution is per node; the
+            # direct-searcher rounds above ran outside a node context),
+            # so warm the node-scoped price first, then read the stamp
+            # off one profiled response
+            for b_pf in pf_bodies[:3]:
+                node_pf.search_actions.search("planner_bench", b_pf)
+            prof = node_pf.search_actions.search(
+                "planner_bench", {**pf_bodies[0], "profile": True})
+            pf_cost_error = None
+            stack = [t for e in prof["profile"]["shards"]
+                     for t in e["spans"]]
+            while stack:
+                t = stack.pop()
+                if t["name"] == "plan.cost" and \
+                        "cost_error" in t.get("attrs", {}):
+                    pf_cost_error = float(t["attrs"]["cost_error"])
+                stack.extend(t.get("children", ()))
+            pf_record = {
+                "n_docs": pf_docs, "batch": pf_batch,
+                "requests_fused": pf_nreq,
+                "requests_sequential": pf_nseq,
+                "compile_s": round(pf_compile_s, 1),
+                "fused_ms_per_request": round(fused_ms, 3),
+                "sequential_ms_per_request": round(seq_ms, 3),
+                "fused_vs_sequential_rtt_ratio": round(
+                    seq_ms / max(fused_ms, 1e-9), 3),
+                "dispatches_per_request_fused": round(
+                    (d1 - d0) / max(pf_nreq, 1), 4),
+                "dispatches_per_request_sequential": round(
+                    (d3 - d2) / max(pf_nseq, 1), 4),
+                "planner_plans": pf_plans,
+                "rescore_fused_dispatches": pf_fused,
+                "counters_reconciled": bool(
+                    pf_plans == len(pf_batches)
+                    and pf_fused == pf_nreq),
+                "fused_vs_sequential_recall_at_10": round(
+                    overlap / max(total_top, 1), 4),
+                "predicted_vs_measured_cost_error": pf_cost_error,
+                "planner_fallback_reasons":
+                    dict(st1.get("planner_fallback_reasons", {})),
+                "program_costs": program_costs_snapshot(
+                    lane_filter=("impact-rescore",)),
+            }
+            log(f"[bench] planner_fusion: fused {fused_ms:.2f} "
+                f"ms/req ({pf_record['dispatches_per_request_fused']} "
+                f"dispatches/req) vs sequential {seq_ms:.2f} ms/req "
+                f"({pf_record['dispatches_per_request_sequential']}"
+                f" dispatches/req) — "
+                f"{pf_record['fused_vs_sequential_rtt_ratio']}x, "
+                f"cost_error={pf_cost_error}, reconciled="
+                f"{pf_record['counters_reconciled']}")
+        finally:
+            node_pf.close()
+
     oracle_recall = engine.get("oracle_recall_at_k")
     recall_ok = bool(kernel_ok and engine_ok and
                      (oracle_recall is None or oracle_recall >= 0.999))
@@ -2190,6 +2364,7 @@ def main() -> int:
         "fault_recovery": fr_record,
         "impact_pruning": imp_record,
         "tail_tolerance": tt_record,
+        "planner_fusion": pf_record,
     }
 
     # ---- MS-MARCO-scale headline (BASELINE.json's stated metric) -------
@@ -2214,7 +2389,8 @@ def main() -> int:
                          BENCH_MESH="0", BENCH_STREAM="0",
                          BENCH_ORACLE="0", BENCH_HEADLINE_8M8="0",
                          BENCH_PERCOLATE="0", BENCH_IMPACT="0",
-                         BENCH_TAIL="0", BENCH_CPU_QUERIES="32")
+                         BENCH_TAIL="0", BENCH_PLANNER="0",
+                         BENCH_CPU_QUERIES="32")
         log(f"[bench] headline corpus: {docs_8m8} docs msmarco "
             f"statistics (engine-only child run)")
         try:
@@ -2254,6 +2430,7 @@ def main() -> int:
                 "fault_recovery": fr_record,
                 "impact_pruning": imp_record,
                 "tail_tolerance": tt_record,
+                "planner_fusion": pf_record,
                 "corpora": {
                     f"zipf_{n_docs // 1_000_000}m": {
                         k_: v_ for k_, v_ in record.items()

@@ -1712,14 +1712,20 @@ class SearchActions:
                     in ("false", "0"):
                 return None               # explicit opt-out
             indices.append(index)
-        if self._impact_preferred(indices, reqs, search_type):
-            # every target index opted into the impact plane and every
-            # body is an impact-scorable shape: decline the mesh so the
-            # fan-out's ShardSearcher serves it from the quantized
-            # impact columns (sublinear block-max work beats one more
-            # dense mesh dispatch)
-            self._note_plane_fallback(indices, "impact-preferred")
-            return None
+        has_knn = any(req.knn is not None for req in reqs)
+        if has_knn or self._impact_preferred(indices, reqs, search_type):
+            # the planner owns the mesh-vs-lane routing that used to be
+            # the pairwise impact-preferred / knn-lane decline edges: a
+            # knn section ALWAYS routes to the vector lane (the mesh
+            # program has no vector/fusion arms — silently dropping the
+            # section would return lexical-only hits); an impact-
+            # scorable batch on opted-in indices routes to the
+            # quantized impact arm unless the cost observatory has
+            # MEASURED the mesh strictly cheaper
+            from elasticsearch_tpu.search import planner
+            if planner.route_plane(indices, not has_knn,
+                                   has_knn) is not None:
+                return None
         owners = []                       # (index, local shard id)
         for index in indices:
             nshards = index.meta.number_of_shards
@@ -1744,14 +1750,6 @@ class SearchActions:
         for req in reqs:
             if req.suggest or req.rescore:
                 self._note_plane_fallback(indices, "ineligible-shape")
-                return None
-            if req.knn is not None:
-                # a top-level knn section is served by the dedicated
-                # vector lane (ShardSearcher._knn_batch_launch) on the
-                # fan-out path — the mesh program has no vector/fusion
-                # lanes, and silently dropping the section would return
-                # lexical-only hits
-                self._note_plane_fallback(indices, "knn-lane")
                 return None
         if not all(self._plane_precheck(index, reqs)
                    for index in indices):

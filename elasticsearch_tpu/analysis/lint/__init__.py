@@ -1,10 +1,11 @@
 """plane-lint v2: whole-program invariant analysis for the accelerator
 plane.
 
-Eleven rule families over the ``elasticsearch_tpu`` tree — breaker
+Twelve rule families over the ``elasticsearch_tpu`` tree — breaker
 discipline, device-seam coverage, recompile hazards, lock discipline,
 host-sync hazards, span discipline, trace purity, counter discipline,
-fallback taxonomy, program-cost discipline, unbounded-wait — each with
+fallback taxonomy, program-cost discipline, unbounded-wait,
+plan-node-spans — each with
 inline suppressions
 (``# estpu: allow[rule-id] <reason>``), machine-readable output, and a
 tier-1 tree-is-clean gate (tests/test_static_analysis.py).
@@ -48,8 +49,8 @@ from elasticsearch_tpu.analysis.lint.context import (
     DEFAULT_CONFIG, Finding, LintConfig, ModuleContext, RULE_FAMILIES)
 from elasticsearch_tpu.analysis.lint import (
     rule_breaker, rule_costs, rule_counters, rule_device, rule_fallback,
-    rule_hostsync, rule_locks, rule_recompile, rule_spans, rule_trace,
-    rule_waits)
+    rule_hostsync, rule_locks, rule_planspans, rule_recompile,
+    rule_spans, rule_trace, rule_waits)
 from elasticsearch_tpu.analysis.lint.program import ProgramIndex
 
 __all__ = ["Finding", "LintConfig", "LintResult", "DEFAULT_CONFIG",
@@ -61,7 +62,8 @@ _PER_MODULE_RULES = (rule_breaker.check, rule_costs.check,
                      rule_locks.check_state, rule_spans.check,
                      rule_waits.check)
 _PROGRAM_RULES = (rule_trace.check_program, rule_counters.check_program,
-                  rule_fallback.check_program)
+                  rule_fallback.check_program,
+                  rule_planspans.check_program)
 
 
 @dataclass

@@ -66,6 +66,11 @@ RULE_FAMILIES = {
     # timeout (a wedged dispatch must become a typed failover, never a
     # hung request — the stall-tolerance ladder's static half)
     "unbounded-wait": "unbounded-wait",
+    # plan-node-spans: every planner-emitted plan node opens a literal
+    # ``plan.*`` span and carries a registered planner fallback reason
+    # (the cost-driven planner's observability contract)
+    "plan-node-unspanned": "plan-node-spans",
+    "plan-node-unregistered-reason": "plan-node-spans",
     "allow-missing-reason": "meta",
     "allow-stale": "meta",
 }
@@ -134,11 +139,13 @@ class LintConfig:
                           # upload, fused MaxSim + hybrid-fusion
                           # dispatches
                           "vector-upload", "maxsim-dispatch",
-                          "fusion-dispatch")
+                          "fusion-dispatch",
+                          # the planner's composed impact→rescore arm
+                          "rescore-dispatch")
     #: site classes that mark a LOOP as a dispatch loop (host-sync rule)
     dispatch_sites: tuple = ("dispatch", "plane-dispatch", "percolate",
                              "pruning-dispatch", "maxsim-dispatch",
-                             "fusion-dispatch")
+                             "fusion-dispatch", "rescore-dispatch")
     #: site classes that dominate a raw ``jax.device_put`` inside a seam
     #: module (the upload/compose family of device touchpoints)
     upload_sites: tuple = ("upload", "compose", "reader-upload",
@@ -233,7 +240,8 @@ class LintConfig:
     #: tier-1 fixture suite asserts the two stay in sync)
     program_lanes: tuple = ("segment", "segment-batch", "reader-batch",
                             "streamed", "percolate", "impact-eager",
-                            "impact-pruned", "knn", "mesh")
+                            "impact-pruned", "impact-rescore", "knn",
+                            "mesh")
     #: gauge registries in the lane-registry module: emitted into
     #: lane_graph.json next to the counter registries and required (by
     #: counter-unexported) to be referenced by the exporter, but their
@@ -263,13 +271,27 @@ class LintConfig:
                               ("note_impact_fallback", "impact"),
                               ("note_knn_fallback", "knn"),
                               ("note_percolate_fallback", "percolate"),
-                              ("note_scheduler_shed", "scheduler"))
+                              ("note_scheduler_shed", "scheduler"),
+                              ("note_planner_fallback", "planner"))
     #: the lane-registry module and its vocabulary / edge / admission
     #: dict names (the --emit-lane-graph source of truth)
     lane_registry_modules: tuple = ("*/search/lanes.py",)
     lane_reasons_name: str = "LANE_REASONS"
     lane_edges_name: str = "DECLINE_EDGES"
     lane_admissions_name: str = "LANE_ADMISSIONS"
+
+    # ---- plan-node-spans (whole-program) ---------------------------------
+    #: the planner module(s): every plan-node constructor call there
+    #: must pass a literal ``plan.*`` span and a registered planner
+    #: fallback reason
+    planner_modules: tuple = ("*/search/planner.py",)
+    #: plan-node constructor names the rule scans for
+    plan_node_ctors: tuple = ("PlanNode",)
+    #: required prefix of a plan node's span literal
+    plan_span_prefix: str = "plan."
+    #: the lane whose vocabulary plan-node ``fallback=`` literals must
+    #: come from
+    plan_reason_lane: str = "planner"
 
 
 DEFAULT_CONFIG = LintConfig()

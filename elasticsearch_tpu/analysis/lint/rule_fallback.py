@@ -37,7 +37,7 @@ from elasticsearch_tpu.analysis.lint.program import (
 _REASON_ARG = {"note_plane_fallback": 0, "_note_plane_fallback": 1,
                "note_fallback": None, "note_impact_fallback": 0,
                "note_knn_fallback": 0, "note_percolate_fallback": 0,
-               "note_scheduler_shed": 0}
+               "note_scheduler_shed": 0, "note_planner_fallback": 0}
 
 
 def lane_registry(program, cfg) -> "tuple | None":

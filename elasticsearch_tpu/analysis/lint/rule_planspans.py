@@ -1,0 +1,87 @@
+"""plan-node-spans: every planner node is observable and taxonomized.
+
+The cost-driven planner (``search/planner.py``) composes lane-served
+sub-plan nodes into one compiled dispatch; the only evidence a node
+ever existed is its span (profiled responses, the
+predicted-vs-measured cost ledger) and its fallback reason (the lane
+graph). Two rules keep both closed:
+
+* ``plan-node-unspanned`` — every ``PlanNode(...)`` construction in a
+  planner module must pass a literal ``span=`` beginning with the
+  ``plan.`` prefix. An unspanned node launches a real device program
+  that never appears in profiled responses — the fused dispatch
+  becomes invisible to the cost observatory;
+* ``plan-node-unregistered-reason`` — every node's ``fallback=`` must
+  be a string literal from the registered planner-lane vocabulary
+  (``lanes.LANE_REASONS["planner"]``). An unregistered reason forks
+  the fallback taxonomy exactly like a typo'd ``note_*_fallback``
+  reason would — dashboards and the lane-graph artifact disagree.
+  Skipped when the lane registry is not part of the linted set
+  (single-file fixture runs), like fallback-unused-reason.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticsearch_tpu.analysis.lint.context import (
+    Finding, apply_suppressions, last_name, module_matches)
+from elasticsearch_tpu.analysis.lint.rule_fallback import lane_registry
+
+#: ctor signature when arguments are passed positionally:
+#: ``PlanNode(lane, span, fallback, ...)``
+_SPAN_ARG, _FALLBACK_ARG = 1, 2
+
+
+def _arg(call: ast.Call, kwname: str, idx: int):
+    for kw in call.keywords:
+        if kw.arg == kwname:
+            return kw.value
+    if len(call.args) > idx:
+        return call.args[idx]
+    return None
+
+
+def check_program(program, cfg) -> list:
+    hit = lane_registry(program, cfg)
+    vocab = hit[0].get(cfg.plan_reason_lane) if hit is not None else None
+
+    out: list = []
+    for ctx in program.contexts:
+        if not module_matches(ctx.relpath, cfg.planner_modules):
+            continue
+        findings, nodes = [], []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or \
+                    last_name(node.func) not in cfg.plan_node_ctors:
+                continue
+            span = _arg(node, "span", _SPAN_ARG)
+            if not (isinstance(span, ast.Constant)
+                    and isinstance(span.value, str)
+                    and span.value.startswith(cfg.plan_span_prefix)):
+                findings.append(Finding(
+                    "plan-node-unspanned", ctx.relpath, node.lineno,
+                    f"plan node is constructed without a literal span= "
+                    f"starting with [{cfg.plan_span_prefix}] — an "
+                    f"unspanned node never reaches profiled responses "
+                    f"or the predicted-vs-measured cost ledger"))
+                nodes.append(node)
+            if vocab is None:
+                continue              # registry not in the linted set
+            fb = _arg(node, "fallback", _FALLBACK_ARG)
+            if not (isinstance(fb, ast.Constant)
+                    and isinstance(fb.value, str) and fb.value in vocab):
+                shown = fb.value if isinstance(fb, ast.Constant) \
+                    else "<dynamic>"
+                findings.append(Finding(
+                    "plan-node-unregistered-reason", ctx.relpath,
+                    node.lineno,
+                    f"plan-node fallback [{shown}] is not a literal "
+                    f"from the registered "
+                    f"[{cfg.plan_reason_lane}]-lane vocabulary — add "
+                    f"it to lanes.LANE_REASONS"
+                    f"[{cfg.plan_reason_lane!r}] (the taxonomy is "
+                    f"closed)"))
+                nodes.append(node)
+        out.extend(apply_suppressions(ctx, findings, nodes))
+    return out

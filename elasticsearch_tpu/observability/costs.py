@@ -551,31 +551,86 @@ def render_rows(collector: _ProgramCollector) -> list:
 # read side: estimates, rollups, stats documents
 # ---------------------------------------------------------------------------
 
-def estimate(lane: str, shape_key=None,
-             node_id: str | None = None) -> "float | None":
-    """The planner's cost query → predicted µs for one program, or
-    None when the observatory has nothing to say.
+class CostEstimate(float):
+    """A priced program cost (µs) that carries its own provenance.
 
-    Resolution order: the exact program's MEASURED EWMA (hot shape),
-    its static roofline prediction (compiled but never dispatched),
-    then the lane's dispatch-weighted mean measured cost (a cold shape
-    on a known lane). Every non-None return is finite and positive."""
+    Plain ``float`` subclass, so every existing arithmetic consumer
+    (the watchdog's stall envelope, the planner's plan pricing, test
+    equality against a record's EWMA) keeps working unchanged. The
+    extra attributes tell the planner how much to trust the number:
+
+    * ``cold`` — True when no dispatch of the exact ``(lane,
+      shape_key)`` was ever measured: the value is static analysis
+      (roofline prediction) or a lane-level aggregate, not this
+      program's own EWMA. A cold plan is still priceable — the planner
+      no longer special-cases ``None`` — but ties break toward the
+      measured candidate.
+    * ``source`` — where the number came from: ``"measured"`` (exact
+      EWMA), ``"static"`` (roofline prediction, never dispatched), or
+      ``"lane-mean"`` (dispatch-weighted mean over the lane's hot
+      programs).
+    """
+
+    __slots__ = ("cold", "source")
+
+    def __new__(cls, value: float, *, cold: bool, source: str):
+        self = super().__new__(cls, value)
+        self.cold = bool(cold)
+        self.source = source
+        return self
+
+    def __repr__(self) -> str:          # debugging/log readability
+        return (f"CostEstimate({float(self):.1f}us, cold={self.cold}, "
+                f"source={self.source!r})")
+
+
+def estimate(lane: str, shape_key=None,
+             node_id: str | None = None) -> "CostEstimate | None":
+    """The planner's cost query → predicted µs for one program
+    (a :class:`CostEstimate`), or None when the observatory has
+    nothing to say about the lane at all.
+
+    Resolution order: the exact program's MEASURED EWMA (hot shape,
+    ``cold=False``), its static roofline prediction (compiled but
+    never dispatched, ``cold=True``), the lane's dispatch-weighted
+    mean measured cost (a cold shape on a hot lane, ``cold=True``),
+    then the mean static prediction over the lane's compiled-but-idle
+    programs (``cold=True`` — the never-dispatched-lane case the
+    planner prices first requests with). Every non-None return is
+    finite and positive."""
     t = table(node_id)
     if shape_key is not None:
         rec = t.lookup(lane, shape_key)
         if rec is not None:
-            val = rec.ewma_us if rec.dispatches > 0 else rec.predicted_us
+            if rec.dispatches > 0:
+                val = rec.ewma_us
+                if val > 0 and math.isfinite(val):
+                    return CostEstimate(val, cold=False,
+                                        source="measured")
+            val = rec.predicted_us
             if val > 0 and math.isfinite(val):
-                return float(val)
+                return CostEstimate(val, cold=True, source="static")
     total_us = 0.0
     total_n = 0
+    pred_us = 0.0
+    pred_n = 0
     for rec in t.records():
-        if rec.lane != lane or rec.dispatches == 0:
+        if rec.lane != lane:
             continue
-        total_us += rec.sum_us
-        total_n += rec.dispatches
-    if total_n > 0 and math.isfinite(total_us):
-        return total_us / total_n
+        if rec.dispatches > 0:
+            total_us += rec.sum_us
+            total_n += rec.dispatches
+        elif rec.predicted_us > 0 and math.isfinite(rec.predicted_us):
+            pred_us += rec.predicted_us
+            pred_n += 1
+    if total_n > 0 and math.isfinite(total_us) and total_us > 0:
+        return CostEstimate(total_us / total_n, cold=True,
+                            source="lane-mean")
+    if pred_n > 0:
+        # never-dispatched lane: static analysis is all there is, and
+        # a typed cold estimate beats forcing callers to handle None
+        return CostEstimate(pred_us / pred_n, cold=True,
+                            source="static")
     return None
 
 

@@ -53,6 +53,9 @@ EVENT_TYPES = {
     "quarantine": "watchdog quarantine transition: entered after "
                   "repeated stalls, or released by a successful "
                   "background probe program",
+    "plan-mispriced": "a served plan's measured wall time blew its "
+                      "WARM predicted cost by the misprice ratio (the "
+                      "planner chose on a number the device disproved)",
 }
 
 #: ring capacity per node

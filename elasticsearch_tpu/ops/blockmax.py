@@ -43,6 +43,13 @@ NEG_INF = jnp.float32(-jnp.inf)
 #: never displace real candidates
 _PAD_DOC = jnp.int32(1 << 30)
 
+#: term-batch width of the score/bound reductions: terms reduce in
+#: chunks of TB through one broadcast compare instead of one unrolled
+#: [N, U] pass per term, so program size grows with ceil(T/TB) — the
+#: widened 64-term admission cap (expansion-sized queries, the SPLADE
+#: arm's pre-work) compiles to 8 fused passes instead of 64
+_TERM_BATCH = 8
+
 
 def impact_scores(uterms, qimp, qtids):
     """Quantized eager scoring of one query against impact columns.
@@ -54,36 +61,44 @@ def impact_scores(uterms, qimp, qtids):
     arithmetic; anyhit [N] bool — OR-semantics match mask, identical to
     the exact kernel's msm1 mask).
 
-    Score and match count share ONE reduction per term: each entry
-    packs ``(q << 8) | 1`` so the sum carries Σq in the high bits and
-    the match count in the low byte — halving the [N, U] reduction
-    passes vs separate sum + any. Exact because uterms slots are UNIQUE
-    per doc (≤ 1 hit per term per doc → count ≤ T ≤ 255) and
-    Σq ≤ T·(2¹⁶−1) keeps the shifted sum far inside int32."""
+    Score and match count share ONE reduction per term chunk: each
+    entry packs ``(q << 8) | 1`` so the sum carries Σq in the high bits
+    and the match count in the low byte — halving the [N, U, TB]
+    reduction passes vs separate sum + any. Exact because uterms slots
+    are UNIQUE per doc (≤ 1 hit per term per doc → count ≤ T ≤ 255) and
+    Σq·256 + T stays inside int32 for the validated caps (T ≤ 255 at
+    8-bit impacts, T ≤ 127 at 16-bit — validate_impact_settings pins
+    both). Integer addition is associative, so the chunked sum is
+    bit-identical to the per-term unroll at any chunk width."""
     n = uterms.shape[0]
     enc = (qimp.astype(jnp.int32) << 8) + 1
     acc = jnp.zeros(n, jnp.int32)
-    for t in range(qtids.shape[0]):   # T static: unrolled/fused by XLA
-        tid = qtids[t]
-        hit = (uterms == tid) & (tid >= 0)
-        acc = acc + jnp.where(hit, enc, 0).sum(axis=1)
+    t = qtids.shape[0]                # static: chunk count fixed at trace
+    for lo in range(0, t, _TERM_BATCH):
+        chunk = qtids[lo:lo + _TERM_BATCH]            # [C] i32
+        hit = (uterms[:, :, None] == chunk[None, None, :]) & \
+            (chunk >= 0)[None, None, :]               # [N, U, C]
+        acc = acc + jnp.where(hit, enc[:, :, None], 0).sum(axis=(1, 2))
     return acc >> 8, (acc & 0xFF) > 0
 
 
 def block_bounds(block_max, qtids):
     """Per-block integer upper bounds: Σ_t block_max[:, t] over the
-    query terms. ≥ every in-block quantized score (per-term max is an
-    upper bound of per-term contribution; sums preserve it — the
-    occupancy floor of 1 on present cells only loosens the bound by one
-    quantization unit per term). Because absent cells are exactly 0 and
-    present cells ≥ 1, ``ub > 0`` ⟺ some query term OCCURS in the
+    query terms, reduced in the same :data:`_TERM_BATCH` chunks as
+    :func:`impact_scores`. ≥ every in-block quantized score (per-term
+    max is an upper bound of per-term contribution; sums preserve it —
+    the occupancy floor of 1 on present cells only loosens the bound by
+    one quantization unit per term). Because absent cells are exactly 0
+    and present cells ≥ 1, ``ub > 0`` ⟺ some query term OCCURS in the
     block — the presence test the pruning sweep keys its skip on."""
     nb = block_max.shape[0]
     ub = jnp.zeros(nb, jnp.int32)
-    for t in range(qtids.shape[0]):
-        tid = qtids[t]
-        col = jnp.take(block_max, jnp.maximum(tid, 0), axis=1)
-        ub = ub + jnp.where(tid >= 0, col.astype(jnp.int32), 0)
+    t = qtids.shape[0]
+    for lo in range(0, t, _TERM_BATCH):
+        chunk = qtids[lo:lo + _TERM_BATCH]            # [C] i32
+        cols = jnp.take(block_max, jnp.maximum(chunk, 0),
+                        axis=1).astype(jnp.int32)     # [NB, C]
+        ub = ub + jnp.where((chunk >= 0)[None, :], cols, 0).sum(axis=1)
     return ub
 
 
@@ -180,3 +195,83 @@ def pruned_carry_init(k: int):
     return (jnp.full(k, NEG_INF, jnp.float32),
             jnp.full(k, -1, jnp.int32),
             jnp.int32(0), jnp.int32(0), jnp.int32(0))
+
+
+# ---------------------------------------------------------------------------
+# device-side rescore stage: impact candidate generation feeds the
+# window combine IN-PROGRAM (the planner's impact-rescore arm), so a
+# rescore request is one composed dispatch instead of a primary
+# dispatch plus a host re-rank pass
+# ---------------------------------------------------------------------------
+
+def rescore_gather(uterms, qimp, docs, qtids, doc_base: int):
+    """Secondary impact scoring of W candidate GLOBAL doc ids against
+    ONE segment's columns: each candidate falling inside this segment
+    gathers its impact row and scores against the rescore query's term
+    ids (same packed reduction as :func:`impact_scores`).
+
+    → (qsum [W] i32 — zero outside the segment; hit [W] bool — matched
+    AND in-segment). Out-of-segment candidates gather a clipped row but
+    their result is masked to (0, False), so summing per-segment
+    outputs composes the full-reader secondary score exactly (every doc
+    lives in exactly one segment)."""
+    np_docs = uterms.shape[0]
+    local = docs - doc_base
+    in_seg = (docs >= 0) & (local >= 0) & (local < np_docs)
+    idx = jnp.clip(local, 0, np_docs - 1)
+    qsum, anyhit = impact_scores(jnp.take(uterms, idx, axis=0),
+                                 jnp.take(qimp, idx, axis=0), qtids)
+    return jnp.where(in_seg, qsum, 0), anyhit & in_seg
+
+
+def rescore_window(scores, docs, sec, sec_hit, window, qw, rw,
+                   mode: str):
+    """QueryRescorer's window combine + re-sort for ONE query, in
+    program — the exact float32 op order of the host oracle
+    (phase._apply_rescore): ``prim = score·qw``; matched docs combine
+    ``prim`` with ``sec·rw`` per ``mode``; unmatched window docs keep
+    ``prim``; ONLY the window re-sorts (score desc, doc asc — the
+    host's ``np.lexsort((d, -comb))``) while the tail keeps its
+    ORIGINAL primary scores and order.
+
+    scores/docs: [K] primary top-k (score desc, -1-padded); sec: [K]
+    f32 secondary scores (already segment-scaled × rescore-query
+    boost); window/qw/rw: traced per-query scalars; ``mode`` static —
+    the score_mode is part of the compiled-program key."""
+    k = scores.shape[0]
+    pos = jnp.arange(k, dtype=jnp.int32)
+    n_valid = (docs >= 0).sum(dtype=jnp.int32)
+    wi = jnp.minimum(window, n_valid)
+    in_w = pos < wi
+    # both products route through a data-dependent select so no fmul
+    # feeds an fadd directly: the CPU backend otherwise contracts
+    # mul+add into an fma whose single rounding diverges from the host
+    # oracle by 1 ulp. The shield predicates must differ from each
+    # other AND from the ``sec_hit`` combine select (same-condition
+    # nested selects simplify, re-exposing the contraction edge), and
+    # neither false arm may be a constant (constant-arm selects fold
+    # into the binop). False arms never reach the output: ``in_w`` rows
+    # have valid docs (padding sorts last) and ``comb`` only survives
+    # on ``sec_hit & in_w`` rows.
+    prim = jnp.where(docs >= 0, scores * qw, scores)
+    sec_w = jnp.where(in_w, sec * rw, sec)
+    if mode == "total":
+        comb = prim + sec_w
+    elif mode == "multiply":
+        comb = prim * sec_w
+    elif mode == "avg":
+        comb = (prim + sec_w) / 2.0
+    elif mode == "max":
+        comb = jnp.maximum(prim, sec_w)
+    else:                              # min
+        comb = jnp.minimum(prim, sec_w)
+    comb = jnp.where(sec_hit, comb, prim)
+    new_s = jnp.where(in_w, comb, scores)
+    # one lexsort re-sorts the window and keeps the tail fixed: primary
+    # key splits window/tail, window items sort by (-score, doc), tail
+    # items by original position (positions < 2²⁴ are exact in f32)
+    group = (~in_w).astype(jnp.int32)
+    mainkey = jnp.where(in_w, -new_s, pos.astype(jnp.float32))
+    tiebreak = jnp.where(in_w, docs, 0)
+    order = jnp.lexsort((tiebreak, mainkey, group))
+    return new_s[order], docs[order]

@@ -133,7 +133,7 @@ class ExecutionContext:
 
 
 def impact_terms(query: "q.Query", mapper_service,
-                 max_terms: int = 16) -> tuple | None:
+                 max_terms: int = 64) -> tuple | None:
     """Impact-lane eligibility: can this query be scored from the
     quantized per-(term, doc) impact columns alone?
 

@@ -431,6 +431,10 @@ _percolate_fallback_reasons: dict[str, int] = {}
 #: why the continuous-batching scheduler shed requests (queue-deadline /
 #: slo-shed / queue-full / task-cancelled / closed), by label
 _scheduler_shed_reasons: dict[str, int] = {}
+#: planner admission outcomes by label (routed-impact / routed-knn /
+#: breaker-open / no-plan / plan-error) — the vocabulary that replaced
+#: the pairwise decline edges
+_planner_fallback_reasons: dict[str, int] = {}
 #: per-INDEX knn-lane accounting — feeds the per-index _stats
 #: "search.knn" section and the _cat/indices knn.* columns
 _knn_index_stats: dict[str, dict] = {}
@@ -489,6 +493,8 @@ def cache_stats(node_id: str | None = None) -> dict:
                "percolate_fallback_reasons":
                    dict(_percolate_fallback_reasons),
                "scheduler_shed_reasons": dict(_scheduler_shed_reasons),
+               "planner_fallback_reasons":
+                   dict(_planner_fallback_reasons),
                "data_layer": dict(_data_layer)}
     out["plane_breaker"] = plane_breaker.stats()
     return out
@@ -571,6 +577,7 @@ def clear_cache() -> None:
         _knn_index_stats.clear()
         _percolate_fallback_reasons.clear()
         _scheduler_shed_reasons.clear()
+        _planner_fallback_reasons.clear()
         _data_layer.update({k: 0 for k in _data_layer})
         _node_stats.clear()
         _node_fallback_reasons.clear()
@@ -1391,7 +1398,9 @@ class ImpactPlaneConfig:
     bits: int = 8
     block_rows: int = 2048
     prune: bool = True          # block-max sweep when totals not tracked
-    max_terms: int = 16         # T cap (programs unroll per term)
+    max_terms: int = 64         # T cap (term-batched reduction chunks
+                                # keep program size ~T/8, so expansion-
+                                # sized queries fit the impact arm)
 
 
 #: index name → config for indices that opted in (None = lane off)
@@ -1428,11 +1437,19 @@ def validate_impact_settings(settings) -> tuple:
         raise IllegalArgumentError(
             "index.search.impact.block_rows must be a power of two, "
             f"got {block_rows}")
-    max_terms = setting("index.search.impact.max_terms", 16)
+    max_terms = setting("index.search.impact.max_terms", 64)
     if max_terms < 1:
         raise IllegalArgumentError(
             f"index.search.impact.max_terms must be >= 1, got "
             f"{max_terms}")
+    # the packed (Σq·256 + matches) reduction must stay inside int32:
+    # the match count needs T ≤ 255 (one byte), and 16-bit impacts need
+    # T·65535·256 < 2³¹ → T ≤ 127 (ops/blockmax.impact_scores)
+    cap = 127 if bits == 16 else 255
+    if max_terms > cap:
+        raise IllegalArgumentError(
+            f"index.search.impact.max_terms must be <= {cap} at "
+            f"{bits}-bit impacts, got {max_terms}")
     return bits, block_rows, max_terms
 
 
@@ -1862,6 +1879,118 @@ def run_impact_pruned(pack: _ImpactPack, term_lists: list, boosts: list,
     return out
 
 
+def run_impact_rescore(pack: _ImpactPack, term_lists: list,
+                       boosts: list, sec_term_lists: list,
+                       sec_boosts: list, windows: list, qws: list,
+                       rws: list, score_mode: str, *, k: int,
+                       n_real: int | None = None) -> dict:
+    """The planner's composed impact→rescore plan as ONE compiled
+    dispatch: eager quantized candidate generation (primary top-k over
+    the whole reader, k already widened to the largest rescore window),
+    per-candidate secondary impact scoring via per-segment row gathers,
+    and the QueryRescorer window combine + re-sort — all in-program, so
+    a rescore request costs one dispatch instead of a primary dispatch
+    plus a host re-rank pass (ops/blockmax.rescore_gather /
+    rescore_window hold the kernels and the f32 op-order contract).
+
+    Both stages score in the QUANTIZED domain (the impact lane's
+    opt-in semantics): the bit-identity oracle is the sequential
+    recompute — run_impact_batch primary, host-side secondary from the
+    same columns, host window combine in the same float32 order.
+    ``score_mode`` is static (part of the program key); windows /
+    query weights are traced per-query inputs, so heterogeneous
+    windows share one program."""
+    from elasticsearch_tpu.ops import blockmax as bm_ops
+    from elasticsearch_tpu.ops import topk as topk_ops
+    b = len(term_lists)
+    k_static = int(k)
+    none_cursors = [None] * b
+    qtids, boosts_a, cs, cd, b_pad, t_pad = _impact_query_inputs(
+        pack, term_lists, boosts, none_cursors)
+    qtids2, boosts2_a, _, _, _, t2_pad = _impact_query_inputs(
+        pack, sec_term_lists, sec_boosts, none_cursors)
+
+    def pad_b(vals, dtype):
+        vals = list(vals) + [vals[-1]] * (b_pad - b)
+        return jnp.asarray(np.asarray(vals, dtype))
+    windows_a = pad_b(windows, np.int32)
+    qws_a = pad_b(qws, np.float32)
+    rws_a = pad_b(rws, np.float32)
+    bases = tuple(pack.bases)
+    key = ("impact-rescore", pack.sig(), k_static, b_pad, t_pad,
+           t2_pad, str(score_mode))
+    seg_arrs = [(s["uterms"], s["qimp"], s["live"]) for s in pack.segs]
+
+    def compile_fn():
+        def run(seg_arrs_in, qtids_in, scales_in, boosts_in, cs_in,
+                cd_in, qtids2_in, boosts2_in, windows_in, qw_in,
+                rw_in):
+            # stage 1: eager primary candidate generation (identical
+            # arithmetic to run_impact_batch — the oracle's stage 1)
+            ts_list, td_list = [], []
+            counts = None
+            for i, (ut, qi, lv) in enumerate(seg_arrs_in):
+                base = bases[i]
+
+                def one(qt, bo, c1, c2, ut=ut, qi=qi, lv=lv, i=i,
+                        base=base):
+                    return bm_ops.eager_segment_topk(
+                        ut, qi, lv, qt, scales_in[i] * bo, k_static,
+                        base, c1, c2)
+                ts, td, cnt = jax.vmap(one)(qtids_in[i], boosts_in,
+                                            cs_in, cd_in)
+                ts_list.append(ts)
+                td_list.append(td)
+                counts = cnt if counts is None else counts + cnt
+            top_s, top_d = topk_ops.merge_top_k_batch_body(
+                ts_list, td_list, k_static, bases)
+            # stage 2: secondary scoring of the [B, K] candidates —
+            # each segment gathers only ITS candidates' rows; summing
+            # per-segment contributions composes the reader-wide score
+            sec = jnp.zeros(top_s.shape, jnp.float32)
+            hit = jnp.zeros(top_s.shape, bool)
+            for i, (ut, qi, lv) in enumerate(seg_arrs_in):
+                base = bases[i]
+
+                def sec_one(docs_row, qt2, bo2, ut=ut, qi=qi, i=i,
+                            base=base):
+                    qsum, h = bm_ops.rescore_gather(ut, qi, docs_row,
+                                                    qt2, base)
+                    return (qsum.astype(jnp.float32) *
+                            (scales_in[i] * bo2), h)
+                s_i, h_i = jax.vmap(sec_one)(top_d, qtids2_in[i],
+                                             boosts2_in)
+                sec = sec + s_i
+                hit = hit | h_i
+            # stage 3: window combine + re-sort (the _apply_rescore
+            # contract: tail keeps ORIGINAL unweighted primary scores)
+            new_s, new_d = jax.vmap(
+                lambda s_, d_, se, h, w, qw, rw:
+                bm_ops.rescore_window(s_, d_, se, h, w, qw, rw,
+                                      score_mode)
+            )(top_s, top_d, sec, hit, windows_in, qw_in, rw_in)
+            return {"top_scores": new_s, "top_docs": new_d,
+                    "count": counts}
+
+        shapes = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            (seg_arrs, qtids, pack.scales, boosts_a, cs, cd, qtids2,
+             boosts2_a, windows_a, qws_a, rws_a))
+        return jax.jit(run).lower(*shapes)
+
+    fn = _get_compiled(key, compile_fn, lane="impact-rescore",
+                       owner=pack.engine_uuid)
+    with device_span("rescore-dispatch",
+                     cost=("impact-rescore", key,
+                           n_real if n_real is not None else b, b_pad)):
+        device_fault_point("rescore-dispatch")
+        out = fn(seg_arrs, qtids, pack.scales, boosts_a, cs, cd,
+                 qtids2, boosts2_a, windows_a, qws_a, rws_a)
+    if b_pad != b:
+        out = {name: v[:b] for name, v in out.items()}
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Dense + late-interaction retrieval lane (top-level `knn` search section)
 #
@@ -2003,6 +2132,39 @@ def note_scheduler_shed(reason: str, n: int = 1) -> None:
             _scheduler_shed_reasons.get(reason, 0) + int(n)
     from elasticsearch_tpu.observability import flightrec
     flightrec.note_shed(reason, int(n))
+
+
+def note_planner_fallback(reason: str) -> None:
+    """One planner admission outcome that left the compiled arms (or
+    rerouted the mesh onto a cheaper arm), reason-labeled against the
+    closed ``planner`` vocabulary — the taxonomy that replaced the
+    pairwise ``impact-preferred``/``knn-lane`` decline edges."""
+    lanes.check_reason("planner", reason)
+    _attribution.label("planner_fallback", reason)
+    with _cache_lock:
+        _bump("planner_fallbacks")
+        _planner_fallback_reasons[reason] = \
+            _planner_fallback_reasons.get(reason, 0) + 1
+
+
+def note_planner_plan(n_nodes: int, cold: bool = False) -> None:
+    """One batch the query planner priced and routed onto a compiled
+    arm (``n_nodes`` composed sub-plan nodes rode ONE dispatch);
+    ``cold`` marks a plan priced without any measured EWMA — the
+    pricing-confidence split the bench's cost-error leg reads."""
+    with _cache_lock:
+        _bump("planner_plans")
+        if cold:
+            _bump("planner_cold_plans")
+    _attribution.label("plan_nodes", str(int(n_nodes)))
+
+
+def note_rescore_fused(n: int = 1) -> None:
+    """``n`` impact→rescore plans served as one composed device
+    dispatch (candidate generation + secondary scoring + window
+    re-sort in-program, no second dispatch for the rescore pass)."""
+    with _cache_lock:
+        _bump("rescore_fused_dispatches", int(n))
 
 
 def note_watchdog_stall() -> None:

@@ -58,6 +58,16 @@ JIT_COUNTERS = {
     "knn_admissions": "requests served by the compiled knn lane",
     "fusion_dispatches": "in-program hybrid fusion dispatches",
     "maxsim_dispatches": "fused MaxSim dispatches over rank_vectors",
+    "rescore_fused_dispatches": "impact→rescore plans composed into one "
+                                "device-side dispatch",
+    # cost-driven query planner (search/planner.py): the single
+    # admission surface over the compiled lanes
+    "planner_plans": "batches the query planner priced and routed onto "
+                     "a compiled arm",
+    "planner_cold_plans": "plans priced on a cold estimate (static "
+                          "analysis / lane aggregate, no measured EWMA)",
+    "planner_fallbacks": "planner admission outcomes that left the "
+                         "compiled arms (reason-labeled)",
     # continuous-batching scheduler (search/scheduler.py): the live
     # serving path's device feeder
     "scheduler_batches_launched": "micro-batches the continuous-batching "
@@ -128,6 +138,8 @@ PROGRAM_LANES = (
     "percolate",        # run_percolate_lanes: fused percolate groups
     "impact-eager",     # run_impact_batch: quantized eager impacts
     "impact-pruned",    # run_impact_pruned: block-max sweep
+    "impact-rescore",   # run_impact_rescore: impact candidates + fused
+                        # device-side rescore stage, one dispatch
     "knn",              # run_knn_hybrid_batch: vector/hybrid programs
     "mesh",             # mesh_engine._program: the collective plane
 )
@@ -169,8 +181,9 @@ LANE_REASONS = {
         "not-local",            # not every target shard lives on this node
         "breaker-open",         # plane breaker open: zero-dispatch decline
         "device-stall",         # watchdog abandoned a wedged device wait
-        "impact-preferred",     # ceded to the impact lane (decline edge)
-        "knn-lane",             # ceded to the vector lane (decline edge)
+        "routed-impact",        # planner priced the impact arm cheaper
+        "routed-knn",           # planner routed the knn lane (knn never
+                                # rides the mesh)
     ),
     # impact-ordered lane admission declines, phase._impact_batch_launch
     "impact": (
@@ -207,18 +220,31 @@ LANE_REASONS = {
         "device-stall",         # batch abandoned by the dispatch
                                 # watchdog: waiters redirected serial
     ),
+    # cost-driven query planner, planner.plan_batch — the single
+    # admission surface that replaced the pairwise decline edges: the
+    # plane no longer hardcodes "impact-preferred"/"knn-lane" handoffs,
+    # it asks the planner which priced arm serves the request
+    "planner": (
+        "routed-impact",        # plan chose the impact arm over the mesh
+        "routed-knn",           # plan chose the vector/hybrid arm (the
+                                # mesh program has no vector lanes)
+        "breaker-open",         # breaker open/quarantined: every device
+                                # candidate excluded from the plan
+        "no-plan",              # no candidate sub-plan admissible: the
+                                # serial per-request path serves
+        "plan-error",           # planner raised: legacy admission order
+                                # served the batch (degraded, counted)
+    ),
 }
 
 #: (declining lane, serving lane, reason the decliner labels): the
-#: pairwise admission-handoff edges the unified planner composes over.
-DECLINE_EDGES = (
-    # every target opted into the impact plane and every body is
-    # impact-scorable: the mesh cedes so block-max pruning serves it
-    ("plane", "impact", "impact-preferred"),
-    # a top-level knn section is served by the vector lane on the
-    # fan-out path; the mesh program has no vector/fusion lanes
-    ("plane", "knn", "knn-lane"),
-)
+#: pairwise admission-handoff edges. EMPTY since the cost-driven
+#: planner (search/planner.py) replaced the hardcoded handoffs — lane
+#: choice is one priced decision surfaced through the ``planner``
+#: vocabulary above (``routed-impact`` / ``routed-knn``), not an N×N
+#: decline matrix. The tuple stays registered so the lane-graph
+#: artifact keeps recording "no pairwise edges" machine-checkably.
+DECLINE_EDGES = ()
 
 #: lane → "pkg-relative module path::Qualname" of the admission
 #: predicate (the function whose declines bump that lane's reasons).
@@ -235,6 +261,8 @@ LANE_ADMISSIONS = {
                  "::PercolatorRegistry.run",
     "scheduler": "elasticsearch_tpu/search/scheduler.py"
                  "::ContinuousBatchScheduler.submit",
+    "planner": "elasticsearch_tpu/search/planner.py"
+               "::plan_batch",
 }
 
 

@@ -549,31 +549,33 @@ class ShardSearcher:
         real queued requests, the rest pow2-bucket padding — lane
         admission stats count only the real rows, so a padded batch
         never double-counts."""
-        from elasticsearch_tpu.search import jit_exec
+        from elasticsearch_tpu.search import planner
         from elasticsearch_tpu.tasks import current_task
         _checkpoint(current_task())
         if not reqs:
             return ("empty", [])
-        if not jit_exec.plane_breaker.allow():
-            # open breaker: decline the batched device path; the caller's
-            # per-request fallback lands on query_phase, which routes to
-            # the eager executor under the same gate
+        # mixed knn/non-knn batches decline before planning — no single
+        # compiled arm serves both shapes (the caller retries per
+        # request, where each request plans onto its own arm)
+        if any(r.knn is not None for r in reqs) and \
+                not all(r.knn is not None for r in reqs):
             return None
-        # knn/hybrid lane first: requests carrying a top-level knn
-        # section are served by the dedicated vector programs (mixed
-        # knn/non-knn batches decline — the caller retries per request)
-        if any(r.knn is not None for r in reqs):
-            if not all(r.knn is not None for r in reqs):
-                return None
-            return self._knn_batch_launch(reqs, n_real=n_real)
-        # impact-ordered lane next: an opted-in index serves eligible
-        # disjunctive BM25 shapes from the quantized impact columns
-        # (score-order search_after cursors included — the generic
-        # screen below rejects those); ineligible requests fall through
-        # to the exact batched program
-        imp = self._impact_batch_launch(reqs, n_real=n_real)
-        if imp is not None:
-            return imp
+        # the planner owns admission from here: it decomposes the batch
+        # into priced candidate arms (knn/hybrid fusion, composed
+        # impact→rescore, quantized impact, exact batch — each arm's
+        # own eligibility screen retained), excludes device arms under
+        # an open/quarantined breaker, and launches the cheapest
+        # admissible arm under per-plan-node spans
+        plan = planner.plan_batch(self, reqs, n_real=n_real)
+        if plan is None:
+            return None
+        return planner.launch_plan(plan)
+
+    def _exact_batch_launch(self, reqs: list, n_real: int | None = None):
+        """The exact batched arm (the planner's tier-3 catch-all):
+        generic eligibility screen + ONE reader-batch (or streamed)
+        dispatch — the pre-planner default path, unchanged."""
+        from elasticsearch_tpu.search import jit_exec
         for req in reqs:
             if (req.aggs or not _is_score_order(req.sort)
                     or req.post_filter is not None
@@ -717,6 +719,93 @@ class ShardSearcher:
             except Exception:             # noqa: BLE001 — optional
                 pass
         return ("impact", reqs, k, out, prune, pack.total_blocks,
+                n_real if n_real is not None else len(reqs))
+
+    def _rescore_batch_launch(self, reqs: list,
+                              n_real: int | None = None):
+        """The planner's composed impact→rescore arm: impact-pruned/
+        eager candidate generation feeding the QueryRescorer window
+        combine as a device-side stage — one dispatch for primary
+        scoring, secondary scoring AND the window re-sort
+        (jit_exec.run_impact_rescore). Admission: the index opted into
+        the impact plane, every request carries exactly ONE rescore
+        pass with a shared score_mode, both the primary query and the
+        rescore query are impact-scorable on the SAME field, and no
+        cursors (rescore + search_after pagination stays serial).
+        Declines return None — the quantized-impact and exact arms
+        screen next (both reject rescore shapes, so the serial path
+        serves the request as before this arm existed)."""
+        from elasticsearch_tpu.search import jit_exec
+        from elasticsearch_tpu.search.execute import impact_terms
+        cfg = jit_exec.impact_plane_config(self.ctx.index_name)
+        if cfg is None or not reqs or not self.reader.segments:
+            return None
+        if self.ctx.dfs_stats is not None:
+            return None                   # impacts bake reader-local idf
+        if any(not getattr(s, "resident", True)
+               for s in self.reader.segments):
+            return None
+        specs, specs2, windows, qws, rws, modes = [], [], [], [], [], []
+        for req in reqs:
+            if (len(req.rescore) != 1 or req.aggs
+                    or not _is_score_order(req.sort)
+                    or req.post_filter is not None
+                    or req.min_score is not None or req.suggest
+                    or req.terminate_after is not None
+                    or req.timeout_ms is not None or req.explain
+                    or req.search_after is not None
+                    or req.knn is not None):
+                return None
+            rs = req.rescore[0]
+            spec = impact_terms(req.query, self.mapper_service,
+                                max_terms=cfg.max_terms)
+            spec2 = impact_terms(rs.query, self.mapper_service,
+                                 max_terms=cfg.max_terms)
+            if spec is None or spec2 is None:
+                jit_exec.note_impact_fallback("ineligible-query")
+                return None
+            specs.append(spec)
+            specs2.append(spec2)
+            windows.append(int(rs.window_size))
+            qws.append(float(rs.query_weight))
+            rws.append(float(rs.rescore_query_weight))
+            modes.append(rs.score_mode)
+        if len({f for f, _, _ in specs} |
+               {f for f, _, _ in specs2}) != 1:
+            jit_exec.note_impact_fallback("mixed-fields")
+            return None
+        if len(set(modes)) != 1:
+            return None                   # score_mode is program-static
+        field = specs[0][0]
+        k = max(max(req.from_ + req.size, 1, w)
+                for req, w in zip(reqs, windows))
+        try:
+            pack = jit_exec.impact_pack_for(
+                self.reader, field, cfg, k1=self.ctx.bm25.k1,
+                b=self.ctx.bm25.b)
+            if pack is None:
+                jit_exec.note_impact_fallback("no-impact-columns")
+                return None
+            out = jit_exec.run_impact_rescore(
+                pack, [t for _, t, _ in specs],
+                [bo for _, _, bo in specs],
+                [t for _, t, _ in specs2],
+                [bo for _, _, bo in specs2],
+                windows, qws, rws, modes[0], k=k, n_real=n_real)
+        except QueryParsingError:
+            raise
+        except Exception as e:            # noqa: BLE001 — fallback seam
+            jit_exec.note_fallback(e, reason="device-error")
+            jit_exec.note_device_error(e)
+            jit_exec.note_impact_fallback("device-error")
+            return None
+        jit_exec.plane_breaker.record_success()
+        for name in ("top_scores", "top_docs", "count"):
+            try:
+                out[name].copy_to_host_async()
+            except Exception:             # noqa: BLE001 — optional
+                pass
+        return ("rescore", reqs, k, out, pack.total_blocks,
                 n_real if n_real is not None else len(reqs))
 
     # ---- dense / late-interaction lane (top-level "knn" section) ----------
@@ -936,6 +1025,16 @@ class ShardSearcher:
         """Phase 2: block until the launched batch's results are on host
         (one RTT, overlappable across batches — concurrent drains share
         the link's latency) and build per-request ShardQueryResults."""
+        if handle[0] == "plan":
+            # planner-wrapped handle: drain the inner arm, then stamp
+            # predicted-vs-measured plan cost (a drain-side plan.cost
+            # span on profiled responses; mispriced warm plans land on
+            # the flight recorder)
+            from elasticsearch_tpu.search import planner
+            _, node, plan, t0, inner = handle
+            results = self.query_phase_batch_drain(inner)
+            planner.finish_plan(node, plan, t0)
+            return results
         tag, reqs = handle[0], handle[1]
         if tag == "empty":
             return [ShardQueryResult(self.shard_id, 0, None,
@@ -977,6 +1076,18 @@ class ShardSearcher:
             totals = np.asarray(out["count"])
             jit_exec.note_impact_served(self.ctx.index_name, n_real,
                                         scored, skipped)
+        elif tag == "rescore":
+            from elasticsearch_tpu.search import jit_exec
+            _, _, k, out, total_blocks, n_real = handle
+            ms = np.asarray(out["top_scores"])
+            md = np.asarray(out["top_docs"])
+            totals = np.asarray(out["count"])
+            # the composed plan's candidate stage is eager — every
+            # block scored — and the whole rescore rode the one
+            # dispatch (the counter the fusion bench reconciles)
+            jit_exec.note_impact_served(self.ctx.index_name, n_real,
+                                        total_blocks * n_real, 0)
+            jit_exec.note_rescore_fused(n_real)
         elif tag == "host":
             _, _, k, (ms, md, totals) = handle
         else:

@@ -147,14 +147,35 @@ def classify(req, searcher):
                  pow2_bucket(max(req.from_ + req.size, 1)))
         if kn.hybrid:
             shape = shape + (query_shape(req.query),)
+        if kn.filter is not None:
+            # the filter mask resolves IN-PROGRAM (the fused lane's
+            # filter machinery) but its structure is part of the
+            # compiled plan — fingerprint it so filtered and
+            # unfiltered knn never share a queue and mixed-filter
+            # batches don't decline at launch
+            shape = shape + (("filter", query_shape(kn.filter)),)
         return "knn", shape
     if (req.aggs or not _is_score_order(req.sort)
             or req.post_filter is not None or req.min_score is not None
             or req.search_after is not None or req.suggest
             or req.terminate_after is not None
-            or req.timeout_ms is not None or req.rescore):
+            or req.timeout_ms is not None):
         return None, None               # the batch programs decline these
     k = pow2_bucket(max(req.from_ + req.size, 1))
+    if req.rescore:
+        # single-pass rescore over an impact-opted index rides the
+        # planner's composed impact→rescore arm — its own
+        # "fused-program" bucket (window/score_mode/rescore-query are
+        # program-static) so continuous batching keeps one-in-flight
+        # semantics for fused plans too
+        if len(req.rescore) != 1 or jit_exec.impact_plane_config(
+                searcher.ctx.index_name) is None:
+            return None, None           # multi-pass / exact-lane rescore
+        rs = req.rescore[0]
+        return "impact", ("fused-program", k,
+                          pow2_bucket(max(int(rs.window_size), 1)),
+                          str(rs.score_mode), query_shape(req.query),
+                          query_shape(rs.query))
     lane = "impact" if jit_exec.impact_plane_config(
         searcher.ctx.index_name) is not None else "plane"
     return lane, (k, query_shape(req.query))

@@ -454,7 +454,9 @@ DEVICE_FAULT_SITES = ("dispatch", "compile", "upload", "compose",
                       # vector block upload, fused MaxSim dispatch,
                       # and the in-program hybrid fusion dispatch
                       "vector-upload", "maxsim-dispatch",
-                      "fusion-dispatch")
+                      "fusion-dispatch",
+                      # the planner's fused impact→rescore dispatch
+                      "rescore-dispatch")
 READER_UPLOAD_SITE = "reader-upload"
 
 

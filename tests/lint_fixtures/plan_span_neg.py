@@ -1,0 +1,19 @@
+"""Negative plan-node-spans fixture: every node carries a literal
+``plan.``-prefixed span and a registered planner-lane fallback, via
+keywords and positionally. Parsed, never imported."""
+
+LANE_REASONS = {
+    "planner": ("routed-impact", "routed-knn", "no-plan"),
+}
+
+
+class PlanNode:
+    def __init__(self, lane, span=None, fallback=None, launch=None):
+        pass
+
+
+def plan():
+    PlanNode("impact", "plan.impact", "no-plan")
+    PlanNode(lane="knn", span="plan.knn", fallback="routed-knn")
+    PlanNode("exact", span="plan.exact", fallback="routed-impact",
+             launch=lambda: None)

@@ -1,0 +1,22 @@
+"""Positive plan-node-spans fixture: a node whose span misses the
+``plan.`` prefix, a node with no span at all, a typo'd fallback reason
+and a dynamic one. Doubles as its own lane registry so the
+closed-vocabulary half of the rule runs single-file. Parsed, never
+imported."""
+
+LANE_REASONS = {
+    "planner": ("routed-impact", "no-plan"),
+}
+
+
+class PlanNode:
+    def __init__(self, lane, span=None, fallback=None, launch=None):
+        pass
+
+
+def plan(reason):
+    PlanNode("impact", "impact-span", "no-plan")       # plan-node-unspanned
+    PlanNode(lane="knn", fallback="no-plan")           # plan-node-unspanned
+    PlanNode("knn", span="plan.knn", fallback="oops")  # unregistered-reason
+    PlanNode("exact", span="plan.exact", fallback=reason)  # dynamic reason
+    PlanNode("ok", span="plan.ok", fallback="routed-impact")

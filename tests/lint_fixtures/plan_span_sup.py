@@ -1,0 +1,15 @@
+"""Suppressed plan-node-spans fixture. Parsed, never imported."""
+
+LANE_REASONS = {
+    "planner": ("no-plan",),
+}
+
+
+class PlanNode:
+    def __init__(self, lane, span=None, fallback=None):
+        pass
+
+
+def plan():
+    PlanNode("impact", span="plan.impact", fallback="no-plan")
+    PlanNode("probe", fallback="no-plan")  # estpu: allow[plan-node-unspanned] synthetic probe node — never dispatched, costed out-of-band

@@ -630,8 +630,8 @@ def test_stats_and_cat_surfaces(node, rng):
 def test_e2e_hits_match_plane(node, rng):
     """End-to-end parity: impact-lane hits equal the exact
     collective-plane hits on doc ids for a skew query whose gaps exceed
-    the quantization bound — and the coordinator's mesh admission
-    labels the decline impact-preferred."""
+    the quantization bound — and the query planner's routing labels the
+    mesh decline routed-impact."""
     docs = _skewed_docs(rng, 260)
     _mk_index(node, "ea", docs, impact=True, plane=True, shards=2)
     _mk_index(node, "eb", docs, impact=False, plane=True, shards=2)
@@ -647,7 +647,7 @@ def test_e2e_hits_match_plane(node, rng):
         if ha["_id"] != hb["_id"]:
             assert abs(ha["_score"] - hb["_score"]) <= tol, (ha, hb)
     svc = node.indices_service.indices["ea"]
-    assert svc.plane_stats["fallback"].get("impact-preferred", 0) >= 1
+    assert svc.plane_stats["fallback"].get("routed-impact", 0) >= 1
     assert jit_exec.cache_stats()["impact_admissions"] >= 1
 
 

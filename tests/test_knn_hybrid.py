@@ -525,7 +525,7 @@ def test_collective_plane_hands_knn_to_the_lane(node, rng):
                 "num_candidates": 20}, "size": 5})
     assert resp["hits"]["hits"]
     svc = node.indices_service.indices["pl"]
-    assert svc.plane_stats["fallback"].get("knn-lane", 0) >= 1
+    assert svc.plane_stats["fallback"].get("routed-knn", 0) >= 1
     st = jit_exec.cache_stats()
     assert st["knn_admissions"] >= 1
 

@@ -1,0 +1,846 @@
+"""Cost-driven query planner (tier-1 guards).
+
+Plan composition over the compiled batch arms (ISSUE 18 / ROADMAP
+item 3):
+
+* pricing — ``costs.estimate`` resolves measured EWMA → static roofline
+  → lane aggregates with a typed ``cold`` flag, and ``order_nodes``
+  sorts candidate arms by (admission tier, price) WITHOUT ever letting
+  price flip a batch between score domains;
+* exclusion — an open or quarantined breaker excludes every compiled
+  arm (``breaker-open``), planner explosions land on the defensive
+  seam (``plan-error``), and a plan with no admissible arm declines to
+  the serial path (``no-plan``);
+* fusion bit-identity — a hybrid (BM25+kNN+RRF, in-program filter)
+  batch and a composed impact→rescore batch each run as ONE compiled
+  dispatch whose hits are bit-identical to the sequential per-lane
+  oracle (per-request dispatches / primary dispatch + host window
+  combine in the quantized domain);
+* wide queries — 10–50-term match queries ride the impact arm under
+  the widened 64-term default cap, with the pruned sweep bit-identical
+  to the eager lane, and the packed-reduction caps enforced at
+  create-index time;
+* observability — profiled responses carry per-plan-node ``plan.*``
+  spans plus the drain-side ``plan.cost`` predicted-vs-measured stamp,
+  and a watchdog-abandoned fused dispatch reconciles counters, spans
+  and breaker bytes exactly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import (IllegalArgumentError,
+                                             QueryParsingError)
+from elasticsearch_tpu.index.device_reader import device_reader_for
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.observability import costs
+from elasticsearch_tpu.search import jit_exec, planner
+from elasticsearch_tpu.search.execute import impact_terms
+from elasticsearch_tpu.search.phase import (ShardSearcher,
+                                            parse_search_request)
+from elasticsearch_tpu.search.planner import (Plan, PlanNode,
+                                              order_nodes)
+from elasticsearch_tpu.search.scheduler import (ContinuousBatchScheduler,
+                                                classify)
+from elasticsearch_tpu.search.watchdog import dispatch_watchdog
+from elasticsearch_tpu.testing_disruption import StallScheme, wait_until
+
+
+@pytest.fixture
+def node(tmp_path):
+    jit_exec.clear_cache()
+    n = Node({}, data_path=tmp_path / "n").start()
+    yield n
+    n.close()
+    jit_exec.clear_cache()
+
+
+def _searcher(node, name, shard=0):
+    svc = node.indices_service.indices[name]
+    return ShardSearcher(shard, device_reader_for(svc.engine(shard)),
+                         svc.mapper_service, index_name=name)
+
+
+def _mk_impact_index(node, name, docs, *, block_rows=64, plane=False,
+                     impact=True, extra=None):
+    settings = {"number_of_shards": 1, "number_of_replicas": 0,
+                "index.search.collective_plane": plane,
+                "index.search.impact_plane": impact,
+                "index.search.impact.block_rows": block_rows}
+    settings.update(extra or {})
+    node.indices_service.create_index(name, {
+        "settings": settings,
+        "mappings": {"_doc": {"properties": {
+            "t": {"type": "text", "analyzer": "whitespace"}}}}})
+    for i, doc in enumerate(docs):
+        node.index_doc(name, str(i), doc)
+    node.broadcast_actions.refresh(name)
+
+
+def _term_docs(rng, n, vocab=60, lo=4, hi=12):
+    docs = []
+    for _ in range(n):
+        k = int(rng.integers(lo, hi + 1))
+        words = [f"w{int(w)}" for w in rng.integers(0, vocab, size=k)]
+        docs.append({"t": " ".join(words)})
+    return docs
+
+
+DIMS = 8
+
+
+def _mk_vec_index(node, name):
+    node.indices_service.create_index(name, {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0,
+                     "index.search.collective_plane": False},
+        "mappings": {"_doc": {"properties": {
+            "body": {"type": "text", "analyzer": "whitespace"},
+            "tag": {"type": "keyword"},
+            "vec": {"type": "dense_vector", "dims": DIMS}}}}})
+
+
+def _vec_docs(rng, n, missing=0.2):
+    docs = []
+    for i in range(n):
+        src = {"body": f"w{i % 7} w{int(rng.integers(0, 10))}",
+               "tag": f"g{i % 3}"}
+        if rng.random() >= missing:
+            src["vec"] = rng.standard_normal(DIMS).tolist()
+        docs.append(src)
+    return docs
+
+
+def _planner_reasons():
+    return jit_exec.cache_stats()["planner_fallback_reasons"]
+
+
+def _stat(key):
+    return jit_exec.cache_stats()[key]
+
+
+def _total_dispatches():
+    return sum(ent["dispatches"] for ent in costs.lane_rollup().values())
+
+
+_ANALYSIS = {"flops": 1.0e9, "bytes_accessed": 2.0e9,
+             "argument_bytes": 0, "output_bytes": 0, "temp_bytes": 0,
+             "peak_bytes": 0, "analyzed": True}
+
+
+# ---------------------------------------------------------------------------
+# pricing: typed cold-shape estimates and plan ordering
+# ---------------------------------------------------------------------------
+
+def test_cost_estimate_resolution_and_cold_flag():
+    costs.reset()
+    try:
+        t = costs.table("nid")
+        t.note_compile("impact-rescore", ("k",), dict(_ANALYSIS), 5.0,
+                       None)
+        # compiled but never dispatched → static roofline, cold=True
+        est = costs.estimate("impact-rescore", ("k",), node_id="nid")
+        assert isinstance(est, costs.CostEstimate) and isinstance(est,
+                                                                  float)
+        assert est.cold and est.source == "static" and float(est) > 0
+        assert "cold=True" in repr(est)
+        # lane-level on a never-dispatched lane: mean static prediction
+        lane = costs.estimate("impact-rescore", node_id="nid")
+        assert lane.cold and lane.source == "static"
+        # a dispatch warms the exact shape...
+        t.note_dispatch("impact-rescore", ("k",), 321.0, 1, 1)
+        est = costs.estimate("impact-rescore", ("k",), node_id="nid")
+        assert not est.cold and est.source == "measured"
+        assert float(est) == pytest.approx(321.0)
+        # ...but lane-level aggregates stay typed cold (a mean over the
+        # lane is never this shape's own EWMA)
+        lane = costs.estimate("impact-rescore", node_id="nid")
+        assert lane.cold and lane.source == "lane-mean"
+        assert float(lane) == pytest.approx(321.0)
+        # a cold shape on a hot lane falls back to the lane mean
+        other = costs.estimate("impact-rescore", ("other",),
+                               node_id="nid")
+        assert other.cold and other.source == "lane-mean"
+        # nothing to say at all → None (the planner's unpriced arm)
+        assert costs.estimate("never-lane", node_id="nid") is None
+        assert costs.estimate("impact-rescore",
+                              node_id="no-such-node") is None
+    finally:
+        costs.reset()
+
+
+def test_order_nodes_tier_then_price_stable():
+    CE = costs.CostEstimate
+
+    def n(lane, tier, cost):
+        return PlanNode(lane=lane, span="plan.exact",
+                        fallback="plan-error", tier=tier, cost=cost)
+    cheap = n("impact-pruned", 2, CE(10.0, cold=True, source="static"))
+    dear = n("impact-pruned", 2, CE(99.0, cold=True, source="static"))
+    unpriced = n("impact-pruned", 2, None)
+    upper = n("impact-rescore", 1, CE(1e6, cold=False,
+                                      source="measured"))
+    # tier dominates price; unpriced arms sort after priced ones
+    assert order_nodes([unpriced, dear, upper, cheap]) == \
+        [upper, cheap, dear, unpriced]
+    # equal price keeps submission order (stable sort)
+    a = n("reader-batch", 3, CE(5.0, cold=True, source="static"))
+    b = n("reader-batch", 3, CE(5.0, cold=True, source="static"))
+    assert order_nodes([a, b]) == [a, b]
+    assert order_nodes([b, a]) == [b, a]
+    # plan-level cold: False as soon as ONE arm priced from a
+    # measurement; predicted_us is the chosen (first priced) arm's
+    plan = Plan(nodes=[upper, cheap])
+    assert not plan.cold
+    assert plan.predicted_us == pytest.approx(1e6)
+    assert Plan(nodes=[cheap, unpriced]).cold
+    assert Plan(nodes=[unpriced]).predicted_us is None
+    assert Plan(nodes=[]).cold
+
+
+# ---------------------------------------------------------------------------
+# exclusion: breaker / quarantine / defensive seam / no-plan
+# ---------------------------------------------------------------------------
+
+class _StubBreaker:
+    def __init__(self, allow=True, quarantined=False):
+        self._allow = allow
+        self.quarantined = quarantined
+
+    def allow(self):
+        return self._allow
+
+    def stats(self):
+        return {}
+
+
+def test_plan_batch_breaker_open_excludes_every_arm(monkeypatch):
+    before = _planner_reasons().get("breaker-open", 0)
+    monkeypatch.setattr(jit_exec, "plane_breaker", _StubBreaker(
+        allow=False))
+    assert planner.plan_batch(None, [object()]) is None
+    assert _planner_reasons().get("breaker-open", 0) == before + 1
+
+
+def test_plan_batch_quarantine_excludes_every_arm(monkeypatch):
+    before = _planner_reasons().get("breaker-open", 0)
+    monkeypatch.setattr(jit_exec, "plane_breaker", _StubBreaker(
+        allow=True, quarantined=True))
+    assert planner.plan_batch(None, [object()]) is None
+    assert _planner_reasons().get("breaker-open", 0) == before + 1
+
+
+def test_plan_batch_defensive_seam_notes_plan_error(monkeypatch):
+    monkeypatch.setattr(jit_exec, "plane_breaker", _StubBreaker())
+    before = _planner_reasons().get("plan-error", 0)
+    # a malformed request explodes inside plan composition — the
+    # planner absorbs it (None → serial path), never raises
+    assert planner.plan_batch(None, [object()]) is None
+    assert _planner_reasons().get("plan-error", 0) == before + 1
+
+
+def test_launch_plan_walks_arms_and_wraps_winner():
+    def boom():
+        raise RuntimeError("arm exploded")
+    n1 = PlanNode(lane="impact-rescore", span="plan.rescore",
+                  fallback="plan-error", launch=boom, tier=1)
+    n2 = PlanNode(lane="impact-pruned", span="plan.impact",
+                  fallback="plan-error", launch=lambda: None, tier=2)
+    n3 = PlanNode(lane="reader-batch", span="plan.exact",
+                  fallback="plan-error", launch=lambda: ("empty", []),
+                  tier=3)
+    plan = Plan(nodes=[n1, n2, n3])
+    plans_before = _stat("planner_plans")
+    err_before = _planner_reasons().get("plan-error", 0)
+    out = planner.launch_plan(plan)
+    # the exploding arm was noted and walked past; the declining arm
+    # (None) was walked past silently; the winner's handle is wrapped
+    assert out is not None and out[0] == "plan"
+    assert out[1] is n3 and out[2] is plan
+    assert out[4] == ("empty", [])
+    assert _stat("planner_plans") == plans_before + 1
+    assert _planner_reasons().get("plan-error", 0) == err_before + 1
+    # every arm declining = no plan → None + "no-plan"
+    none_plan = Plan(nodes=[PlanNode(
+        lane="reader-batch", span="plan.exact", fallback="plan-error",
+        launch=lambda: None, tier=3)])
+    np_before = _planner_reasons().get("no-plan", 0)
+    assert planner.launch_plan(none_plan) is None
+    assert _planner_reasons().get("no-plan", 0) == np_before + 1
+    # a parse error is a 400 on EVERY arm — it propagates, never walks
+    def bad():
+        raise QueryParsingError("bad query")
+    with pytest.raises(QueryParsingError):
+        planner.launch_plan(Plan(nodes=[PlanNode(
+            lane="reader-batch", span="plan.exact",
+            fallback="plan-error", launch=bad, tier=3)]))
+
+
+def test_finish_plan_stamps_cost_and_flightrecs_misprice():
+    from elasticsearch_tpu.observability import flightrec
+    CE = costs.CostEstimate
+
+    def mispriced():
+        return [e for nid in (flightrec.node_ids() or [""])
+                for e in flightrec.events(nid)
+                if e["type"] == "plan-mispriced"]
+    warm = PlanNode(lane="impact-rescore", span="plan.rescore",
+                    fallback="plan-error", tier=1,
+                    cost=CE(1.0, cold=False, source="measured"))
+    plan = Plan(nodes=[warm])
+    before = len(mispriced())
+    attrs = planner.finish_plan(warm, plan, time.perf_counter() - 0.05)
+    assert attrs["lane"] == "impact-rescore" and not attrs["cold"]
+    assert attrs["predicted_us"] == pytest.approx(1.0)
+    assert attrs["measured_us"] > 0
+    # ~50ms measured vs 1µs predicted — far past MISPRICE_RATIO
+    assert attrs["cost_error"] >= planner.MISPRICE_RATIO
+    assert len(mispriced()) == before + 1
+    # a COLD plan missing its static guess is expected, not an anomaly
+    cold = PlanNode(lane="impact-pruned", span="plan.impact",
+                    fallback="plan-error", tier=2,
+                    cost=CE(1.0, cold=True, source="static"))
+    attrs = planner.finish_plan(cold, Plan(nodes=[cold]),
+                                time.perf_counter() - 0.05)
+    assert attrs["cold"] and "cost_error" in attrs
+    assert len(mispriced()) == before + 1
+
+
+# ---------------------------------------------------------------------------
+# plane routing: the retired decline matrix's replacement
+# ---------------------------------------------------------------------------
+
+class _FakeIndex:
+    def __init__(self):
+        self.noted = []
+
+    def note_plane_fallback(self, reason):
+        self.noted.append(reason)
+
+
+def test_route_plane_knn_and_impact_defaults():
+    jit_exec.clear_cache()
+    try:
+        fi = _FakeIndex()
+        before = dict(_planner_reasons())
+        # knn ALWAYS routes — the mesh has no vector lanes
+        assert planner.route_plane([fi], True, True) == "knn"
+        assert fi.noted == ["routed-knn"]
+        # impact-eligible with no cost signal: the opt-in default
+        fi = _FakeIndex()
+        assert planner.route_plane([fi], True, False) == "impact"
+        assert fi.noted == ["routed-impact"]
+        after = _planner_reasons()
+        assert after.get("routed-knn", 0) == \
+            before.get("routed-knn", 0) + 1
+        assert after.get("routed-impact", 0) == \
+            before.get("routed-impact", 0) + 1
+        # neither knn nor impact-eligible: the mesh keeps the batch
+        assert planner.route_plane([_FakeIndex()], False, False) is None
+    finally:
+        jit_exec.clear_cache()
+
+
+def test_route_plane_measured_mesh_win_keeps_the_plane():
+    jit_exec.clear_cache()
+    try:
+        # static-only mesh pricing never overrides the opt-in default
+        costs.table("").note_compile("mesh", ("m",), dict(_ANALYSIS),
+                                     1.0, None)
+        costs.note_dispatch("impact-pruned", ("i",), 5.0)
+        assert planner.route_plane([_FakeIndex()], True, False) == \
+            "impact"
+        # MEASURED mesh strictly cheaper than measured impact → the
+        # plane keeps the batch, and no per-index decline is noted
+        costs.note_dispatch("mesh", ("m",), 1.0)
+        fi = _FakeIndex()
+        assert planner.route_plane([fi], True, False) is None
+        assert fi.noted == []
+        # measured but dearer mesh still routes to the impact arm
+        costs.reset()
+        costs.note_dispatch("mesh", ("m",), 50.0)
+        costs.note_dispatch("impact-pruned", ("i",), 5.0)
+        assert planner.route_plane([_FakeIndex()], True, False) == \
+            "impact"
+    finally:
+        jit_exec.clear_cache()
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration: fused-program buckets
+# ---------------------------------------------------------------------------
+
+def test_classify_rescore_gets_fused_program_bucket(node, rng):
+    _mk_impact_index(node, "imp", _term_docs(rng, 40))
+    _mk_impact_index(node, "plain", _term_docs(rng, 40), impact=False)
+    s = _searcher(node, "imp")
+    body = {"query": {"match": {"t": "w1 w2"}}, "size": 5,
+            "rescore": {"window_size": 10, "query": {
+                "rescore_query": {"match": {"t": "w3"}},
+                "rescore_query_weight": 1.5, "query_weight": 1.0,
+                "score_mode": "total"}}}
+    lane, shape = classify(parse_search_request(dict(body)), s)
+    assert lane == "impact" and shape[0] == "fused-program"
+    assert "total" in shape
+    # a plain shape on the same index buckets by (k, query shape)
+    lane2, shape2 = classify(parse_search_request(
+        {"query": {"match": {"t": "w1"}}, "size": 5}), s)
+    assert lane2 == "impact" and shape2[0] != "fused-program"
+    # rescore over a non-impact index has no fused arm — stays serial
+    sp = _searcher(node, "plain")
+    assert classify(parse_search_request(dict(body)), sp) == (None,
+                                                              None)
+
+
+def test_classify_knn_filter_fingerprints_the_bucket(node, rng):
+    _mk_vec_index(node, "vec")
+    for i, src in enumerate(_vec_docs(rng, 30)):
+        node.index_doc("vec", str(i), src)
+    node.broadcast_actions.refresh("vec")
+    s = _searcher(node, "vec")
+    base = {"knn": {"field": "vec",
+                    "query_vector": [0.1] * DIMS, "k": 5,
+                    "num_candidates": 20}, "size": 5}
+    lane_a, shape_a = classify(parse_search_request(dict(base)), s)
+    filt = dict(base)
+    filt["knn"] = {**base["knn"], "filter": {"term": {"tag": "g1"}}}
+    lane_b, shape_b = classify(parse_search_request(filt), s)
+    assert lane_a == lane_b == "knn"
+    # filtered and unfiltered knn never share a queue
+    assert shape_a != shape_b
+
+
+def test_mixed_knn_batch_declines_before_planning(node, rng):
+    _mk_vec_index(node, "vec")
+    for i, src in enumerate(_vec_docs(rng, 20)):
+        node.index_doc("vec", str(i), src)
+    node.broadcast_actions.refresh("vec")
+    s = _searcher(node, "vec")
+    knn_req = parse_search_request(
+        {"knn": {"field": "vec", "query_vector": [0.1] * DIMS,
+                 "k": 3, "num_candidates": 10}, "size": 3})
+    lex_req = parse_search_request(
+        {"query": {"match": {"body": "w1"}}, "size": 3})
+    assert s.query_phase_batch_launch([knn_req, lex_req]) is None
+
+
+# ---------------------------------------------------------------------------
+# fused hybrid/filtered-knn: one dispatch, bit-identical to serial
+# ---------------------------------------------------------------------------
+
+def test_hybrid_and_filtered_knn_one_dispatch_matches_serial(node, rng):
+    _mk_vec_index(node, "vec")
+    for i, src in enumerate(_vec_docs(rng, 60)):
+        node.index_doc("vec", str(i), src)
+    node.broadcast_actions.refresh("vec")
+    s = _searcher(node, "vec")
+    for round_i in range(3):
+        hybrid = round_i != 1          # round 1: pure filtered knn
+        # filter structure is part of the compiled plan — the
+        # scheduler's shape key keeps filtered and unfiltered knn in
+        # separate queues, so a formed batch is filter-uniform
+        filtered = round_i != 2
+        reqs = []
+        for _ in range(3):
+            body = {"knn": {"field": "vec",
+                            "query_vector": rng.standard_normal(
+                                DIMS).tolist(),
+                            "k": 8, "num_candidates": 24},
+                    "size": int(rng.integers(3, 9))}
+            if hybrid:
+                body["query"] = {"match": {
+                    "body": f"w{int(rng.integers(0, 7))}"}}
+            if filtered:
+                body["knn"]["filter"] = {"term": {
+                    "tag": f"g{int(rng.integers(0, 3))}"}}
+            reqs.append(parse_search_request(body))
+        # the sequential per-lane oracle: one dispatch per request
+        refs = [s.query_phase(r) for r in reqs]
+        before = _total_dispatches()
+        handle = s.query_phase_batch_launch(reqs)
+        assert handle is not None and handle[0] == "plan"
+        assert handle[1].lane == "knn"
+        assert handle[4][0] in ("knn", "empty")
+        res = s.query_phase_batch_drain(handle)
+        # the WHOLE hybrid batch (lexical + vector + fusion + filter)
+        # was one compiled dispatch
+        assert _total_dispatches() == before + 1
+        for got, ref in zip(res, refs):
+            assert got.total == ref.total
+            assert np.array_equal(got.doc_ids, ref.doc_ids)
+            assert np.array_equal(got.scores, ref.scores)
+
+
+# ---------------------------------------------------------------------------
+# fused impact→rescore: one dispatch, bit-identical to the sequential
+# quantized oracle (primary dispatch + host window combine)
+# ---------------------------------------------------------------------------
+
+def _host_secondary(pack, top_d_row, terms2, boost2, k):
+    """Stage-2 mirror of jit_exec.run_impact_rescore: per-segment host
+    row gathers with the kernel's exact f32 op order
+    (``qsum_f32 · (scale_f32 · boost_f32)``, summed over segments —
+    every doc lives in exactly one, so the sum is the one segment's
+    term)."""
+    sec = np.zeros(k, np.float32)
+    hit = np.zeros(k, bool)
+    for seg in pack.segs:
+        base, nd = seg["doc_base"], seg["np_docs"]
+        tidx = seg["host"].term_index
+        sb = np.float32(seg["scale"]) * np.float32(boost2)
+        for j, doc in enumerate(np.asarray(top_d_row)):
+            doc = int(doc)
+            if doc < base or doc >= base + nd:
+                continue
+            ut = np.asarray(seg["host"].uterms[doc - base])
+            qi = seg["col"].qimp[doc - base].astype(np.int64)
+            qsum, matched = 0, False
+            for term in terms2:
+                tid = tidx.get(term, -1)
+                if tid >= 0:
+                    qsum += int(qi[ut == tid].sum())
+                    matched = matched or bool((ut == tid).any())
+            sec[j] = np.float32(np.float32(qsum) * sb)
+            hit[j] = matched
+    return sec, hit
+
+
+def _host_window(top_s, top_d, sec, hit, window, qw, rw, mode):
+    """Stage-3 mirror of ops/blockmax.rescore_window (the host
+    ``np.lexsort`` twin of the in-program window re-sort)."""
+    k = top_s.shape[0]
+    pos = np.arange(k, dtype=np.int32)
+    wi = min(int(window), int((top_d >= 0).sum()))
+    in_w = pos < wi
+    prim = top_s * np.float32(qw)
+    sec_w = sec * np.float32(rw)
+    if mode == "total":
+        comb = prim + sec_w
+    elif mode == "multiply":
+        comb = prim * sec_w
+    elif mode == "avg":
+        comb = (prim + sec_w) / np.float32(2.0)
+    elif mode == "max":
+        comb = np.maximum(prim, sec_w)
+    else:                              # min
+        comb = np.minimum(prim, sec_w)
+    comb = np.where(hit, comb, prim).astype(np.float32)
+    new_s = np.where(in_w, comb, top_s).astype(np.float32)
+    group = (~in_w).astype(np.int32)
+    mainkey = np.where(in_w, -new_s, pos.astype(np.float32))
+    tiebreak = np.where(in_w, top_d, 0)
+    order = np.lexsort((tiebreak, mainkey, group))
+    return new_s[order], top_d[order]
+
+
+def test_fused_rescore_bit_identical_to_sequential_oracle(node, rng):
+    _mk_impact_index(node, "imp", _term_docs(rng, 220))
+    s = _searcher(node, "imp")
+    cfg = jit_exec.impact_plane_config("imp")
+    modes = ("total", "multiply", "avg", "max", "min")
+    for round_i in range(3):
+        mode = modes[round_i % len(modes)]
+        reqs, bodies = [], []
+        for _ in range(3):
+            prim_t = " ".join(f"w{int(w)}" for w in
+                              rng.integers(0, 60, size=3))
+            sec_t = " ".join(f"w{int(w)}" for w in
+                             rng.integers(0, 60, size=2))
+            body = {"query": {"match": {"t": prim_t}},
+                    "size": int(rng.integers(3, 11)),
+                    "rescore": {
+                        "window_size": int(rng.integers(5, 26)),
+                        "query": {
+                            "rescore_query": {"match": {"t": sec_t}},
+                            "rescore_query_weight": round(
+                                float(rng.uniform(0.5, 2.0)), 2),
+                            "query_weight": round(
+                                float(rng.uniform(0.5, 2.0)), 2),
+                            "score_mode": mode}}}
+            bodies.append(body)
+            reqs.append(parse_search_request(body))
+        plans_before = _stat("planner_plans")
+        fused_before = _stat("rescore_fused_dispatches")
+        disp_before = _total_dispatches()
+        handle = s.query_phase_batch_launch(reqs)
+        assert handle is not None and handle[0] == "plan", round_i
+        assert handle[1].lane == "impact-rescore"
+        assert handle[4][0] == "rescore"
+        res = s.query_phase_batch_drain(handle)
+        # primary scoring, secondary scoring AND the window re-sort
+        # all rode ONE compiled dispatch
+        assert _total_dispatches() == disp_before + 1
+        assert _stat("planner_plans") == plans_before + 1
+        assert _stat("rescore_fused_dispatches") == fused_before + 3
+        # the sequential quantized oracle: the impact lane's primary
+        # dispatch at the same widened k + a host window combine
+        k = max(max(r.from_ + r.size, 1, r.rescore[0].window_size)
+                for r in reqs)
+        pack = jit_exec.impact_pack_for(s.reader, "t", cfg,
+                                        k1=s.ctx.bm25.k1,
+                                        b=s.ctx.bm25.b)
+        specs = [impact_terms(r.query, s.mapper_service,
+                              max_terms=cfg.max_terms) for r in reqs]
+        specs2 = [impact_terms(r.rescore[0].query, s.mapper_service,
+                               max_terms=cfg.max_terms) for r in reqs]
+        prim = jit_exec.run_impact_batch(
+            pack, [t for _, t, _ in specs], [b for _, _, b in specs],
+            [None] * len(reqs), k=k)
+        pms = np.asarray(prim["top_scores"])
+        pmd = np.asarray(prim["top_docs"])
+        ptotals = np.asarray(prim["count"])
+        for bi, req in enumerate(reqs):
+            rs = req.rescore[0]
+            _, terms2, boost2 = specs2[bi]
+            sec, hit = _host_secondary(pack, pmd[bi], terms2, boost2, k)
+            exp_s, exp_d = _host_window(
+                pms[bi], pmd[bi], sec, hit, rs.window_size,
+                rs.query_weight, rs.rescore_query_weight, mode)
+            kq = max(req.from_ + req.size, 1)
+            valid = exp_d >= 0
+            exp_s = exp_s[valid][:kq].astype(np.float32)
+            exp_d = exp_d[valid][:kq].astype(np.int32)
+            got = res[bi]
+            assert got.total == int(ptotals[bi]), (round_i, bi)
+            assert np.array_equal(got.doc_ids, exp_d), (round_i, bi)
+            # bit-identical: the fused program's f32 op order IS the
+            # oracle's
+            assert np.array_equal(got.scores, exp_s), (round_i, bi)
+
+
+# ---------------------------------------------------------------------------
+# widened term cap: 10–50-term queries on the impact arm
+# ---------------------------------------------------------------------------
+
+def test_wide_term_queries_ride_impact_and_prune_identically(node, rng):
+    _mk_impact_index(node, "wide", _term_docs(rng, 260, vocab=80))
+    s = _searcher(node, "wide")
+    cfg = jit_exec.impact_plane_config("wide")
+    assert cfg.max_terms == 64          # the widened default cap
+    for _ in range(3):
+        nt = int(rng.integers(10, 51))
+        terms = [f"w{int(w)}" for w in
+                 rng.choice(80, size=nt, replace=False)]
+        reqs = [parse_search_request(
+            {"query": {"match": {"t": " ".join(terms)}},
+             "size": 10, "track_total_hits": False})
+            for _ in range(2)]
+        handle = s.query_phase_batch_launch(reqs)
+        # >16-term queries are admitted to the quantized impact arm
+        # (term-batched reduction — the program no longer unrolls one
+        # pass per term)
+        assert handle is not None and handle[0] == "plan", nt
+        assert handle[4][0] == "impact", nt
+        s.query_phase_batch_drain(handle)
+        # pruned ≡ unpruned at every admitted width: bit-equal hits
+        spec = impact_terms(reqs[0].query, s.mapper_service,
+                            max_terms=cfg.max_terms)
+        assert spec is not None and len(spec[1]) == nt
+        pack = jit_exec.impact_pack_for(s.reader, "t", cfg,
+                                        k1=s.ctx.bm25.k1,
+                                        b=s.ctx.bm25.b)
+        eager = jit_exec.run_impact_batch(pack, [spec[1]], [spec[2]],
+                                          [None], k=10)
+        pruned = jit_exec.run_impact_pruned(pack, [spec[1]], [spec[2]],
+                                            [None], k=10)
+        assert np.array_equal(np.asarray(eager["top_scores"]),
+                              np.asarray(pruned["top_scores"])), nt
+        assert np.array_equal(np.asarray(eager["top_docs"]),
+                              np.asarray(pruned["top_docs"])), nt
+
+
+def test_impact_max_terms_validation_caps():
+    from elasticsearch_tpu.search.jit_exec import \
+        validate_impact_settings
+    # defaults: 8-bit impacts, 64-term cap
+    assert validate_impact_settings(None)[2] == 64
+    # the packed (Σq·256 + matches) reduction bounds the cap: one byte
+    # of match count at 8-bit impacts, int32 headroom at 16-bit
+    assert validate_impact_settings(
+        {"index.search.impact.max_terms": 255})[2] == 255
+    with pytest.raises(IllegalArgumentError):
+        validate_impact_settings(
+            {"index.search.impact.max_terms": 256})
+    assert validate_impact_settings(
+        {"index.search.impact.bits": 16,
+         "index.search.impact.max_terms": 127})[0] == 16
+    with pytest.raises(IllegalArgumentError):
+        validate_impact_settings(
+            {"index.search.impact.bits": 16,
+             "index.search.impact.max_terms": 128})
+    with pytest.raises(IllegalArgumentError):
+        validate_impact_settings(
+            {"index.search.impact.max_terms": 0})
+
+
+# ---------------------------------------------------------------------------
+# observability: plan spans on profiled responses
+# ---------------------------------------------------------------------------
+
+def test_profiled_response_carries_plan_spans(node, rng):
+    _mk_impact_index(node, "prof", _term_docs(rng, 80))
+    body = {"query": {"match": {"t": "w1 w2"}}, "size": 5,
+            "rescore": {"window_size": 10, "query": {
+                "rescore_query": {"match": {"t": "w3"}},
+                "rescore_query_weight": 1.5, "query_weight": 1.0,
+                "score_mode": "total"}},
+            "profile": True}
+    resp = node.search_actions.search("prof", body)
+    spans = []
+
+    def walk(t):
+        spans.append(t)
+        for c in t.get("children", ()):
+            walk(c)
+    for entry in resp["profile"]["shards"]:
+        for root in entry["spans"]:
+            walk(root)
+    names = [t["name"] for t in spans]
+    # the winning arm's plan node span and the drain-side cost stamp
+    assert "plan.rescore" in names, names
+    assert "plan.cost" in names, names
+    cost = next(t for t in spans if t["name"] == "plan.cost")
+    attrs = cost.get("attrs", {})
+    assert attrs.get("lane") == "impact-rescore", attrs
+    assert "measured_us" in attrs, attrs
+    # predicted-vs-measured stamped whenever the plan was priced
+    if "predicted_us" in attrs:
+        assert "cost_error" in attrs, attrs
+    node_span = next(t for t in spans if t["name"] == "plan.rescore")
+    assert node_span.get("attrs", {}).get("lane") == "impact-rescore"
+
+
+# ---------------------------------------------------------------------------
+# watchdog-abandoned fused dispatch: exact reconciliation
+# ---------------------------------------------------------------------------
+
+TINY = dict(stall_multiplier=1.0, floor_s=0.3, cold_floor_s=0.3,
+            ceiling_s=0.5, tick_s=0.02, probe_interval_s=0.05,
+            probe_budget_s=2.0)
+
+_SAVE_KEYS = ("enabled", "stall_multiplier", "floor_s", "cold_floor_s",
+              "ceiling_s", "quarantine_stalls", "tick_s",
+              "probe_interval_s", "probe_budget_s")
+
+
+@pytest.fixture
+def tiny_watchdog():
+    wd = dispatch_watchdog
+    saved = {k: getattr(wd, k) for k in _SAVE_KEYS}
+    try:
+        yield wd
+    finally:
+        wd.configure(**saved)
+        wd.reset()
+        jit_exec.plane_breaker.reset()
+
+
+def test_wedged_fused_rescore_abandons_and_reconciles(node, rng,
+                                                      tiny_watchdog):
+    _mk_impact_index(node, "imp", _term_docs(rng, 120))
+    s = _searcher(node, "imp")
+    reqs = [parse_search_request(
+        {"query": {"match": {"t": f"w{i % 5} w{(i + 7) % 11}"}},
+         "size": 8,
+         "rescore": {"window_size": 12, "query": {
+             "rescore_query": {"match": {"t": f"w{i % 3}"}},
+             "rescore_query_weight": 1.5, "query_weight": 1.0,
+             "score_mode": "total"}}})
+        for i in range(6)]
+    # the serial oracle (exact scorer + host rescore) — the failover
+    # path an abandoned waiter lands on
+    refs = [s.query_phase(r) for r in reqs]
+    tiny_watchdog.configure(quarantine_stalls=99, **TINY)
+    base_abandoned = tiny_watchdog.stats()["abandoned"]
+    plans_before = _stat("planner_plans")
+    sched = ContinuousBatchScheduler(node_id=node.node_id, max_batch=8,
+                                     max_in_flight=2)
+    # wedge the planner's composed dispatch site, permanently
+    scheme = StallScheme(seed=1818,
+                         p_by_site={"rescore-dispatch": 1.0},
+                         delay_range=None)
+    outs: dict = {}
+    errs: list = []
+
+    def client(i):
+        try:
+            lane, shape = classify(reqs[i], s)
+            assert lane == "impact" and shape[0] == "fused-program"
+            outs[i] = sched.execute(
+                lane, ("imp", 0, lane, shape, id(s.reader)),
+                reqs[i], s.query_phase_batch_launch,
+                s.query_phase_batch_drain)
+        except Exception as e:          # noqa: BLE001 — surfaced below
+            errs.append((i, repr(e)))
+
+    try:
+        with scheme.applied():
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(len(reqs))]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30.0)
+            waited = time.perf_counter() - t0
+            assert not any(t.is_alive() for t in threads), \
+                "a client stayed wedged past the watchdog envelope"
+            assert waited < 15.0, waited
+            assert not errs, errs
+            assert scheme.holding >= 1, \
+                "the wedge never held the fused dispatch"
+            st = tiny_watchdog.stats()
+            assert st["abandoned"] > base_abandoned, st
+            scheme.heal()
+        # abandoned waiters came back DECLINED → serial failover must
+        # equal the serial oracle bit-exactly; a waiter the fused lane
+        # did serve scored in the QUANTIZED domain, whose match mask
+        # (and so total) still agrees with the exact kernel's
+        assert sorted(outs) == list(range(len(reqs)))
+        assert any(outs[i] is None for i in outs), \
+            "no waiter was actually abandoned to the serial path"
+        for i, out in outs.items():
+            if out is None:
+                got = s.query_phase(reqs[i])
+                assert got.total == refs[i].total, i
+                assert np.array_equal(got.doc_ids, refs[i].doc_ids), i
+                assert np.array_equal(got.scores, refs[i].scores), i
+            else:
+                assert out.total == refs[i].total, i
+        # exact batch books: launched == drained + in_flight + abandoned
+        assert wait_until(
+            lambda: sched.stats()["batches_in_flight"] == 0
+            and sched.stats()["in_flight_requests"] == 0,
+            timeout=15.0), sched.stats()
+        st = sched.stats()
+        assert st["batches_abandoned"] >= 1, st
+        assert st["batches_launched"] == st["batches_drained"] \
+            + st["batches_in_flight"] + st["batches_abandoned"], st
+        assert st["shed_reasons"].get("device-stall", 0) >= 1, st
+        assert st["reconciled"], st
+        # the healed launch completed: the plan was still booked once
+        assert wait_until(
+            lambda: _stat("planner_plans") > plans_before,
+            timeout=15.0), jit_exec.cache_stats()["planner_plans"]
+        # nothing leaked: breaker bytes and open spans drain to zero
+        assert wait_until(
+            lambda: node.breaker_service.breaker("request").used == 0,
+            timeout=15.0), node.breaker_service.breaker("request").used
+        from elasticsearch_tpu.observability import tracing as obs_trace
+        assert wait_until(
+            lambda: obs_trace.open_span_count(node.node_id) == 0,
+            timeout=15.0), obs_trace.store_stats(node.node_id)
+        # the scheduler still serves fused plans after recovery
+        lane, shape = classify(reqs[0], s)
+        out = sched.execute(lane, ("imp", 0, lane, shape,
+                                   id(s.reader)),
+                            reqs[0], s.query_phase_batch_launch,
+                            s.query_phase_batch_drain)
+        got = out if out is not None else s.query_phase(reqs[0])
+        assert got.total == refs[0].total
+    finally:
+        sched.close()

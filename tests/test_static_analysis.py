@@ -955,3 +955,78 @@ def test_vector_sites_negative():
         "\n".join(f.render() for f in r.unsuppressed)
     assert open_family(r, "span-discipline") == [], \
         "\n".join(f.render() for f in r.unsuppressed)
+
+
+# ---------------------------------------------------------------------------
+# plan-node-spans (whole-program): planner nodes observable + taxonomized
+# ---------------------------------------------------------------------------
+
+#: fixtures are their own planner module AND their own lane registry —
+#: the closed-vocabulary half of the rule runs single-file
+PLAN_CFG = LintConfig(planner_modules=("*/plan_span_*.py",),
+                      lane_registry_modules=("*/plan_span_*.py",))
+
+
+def test_rescore_site_registered():
+    """The planner's fused impact→rescore dispatch site is a
+    first-class citizen of every discipline: lint vocabulary, family
+    membership (dispatch, not upload), and the default chaos draw."""
+    from elasticsearch_tpu.testing_disruption import DEVICE_FAULT_SITES
+    assert "rescore-dispatch" in DEFAULT_CONFIG.known_sites
+    assert "rescore-dispatch" in DEVICE_FAULT_SITES
+    assert "rescore-dispatch" in DEFAULT_CONFIG.dispatch_sites
+    assert "rescore-dispatch" not in DEFAULT_CONFIG.upload_sites
+
+
+def test_planspans_family_registered():
+    assert RULE_FAMILIES["plan-node-unspanned"] == "plan-node-spans"
+    assert RULE_FAMILIES["plan-node-unregistered-reason"] == \
+        "plan-node-spans"
+
+
+def test_planspans_positive():
+    r = lint_fixture("plan_span_pos.py", cfg=PLAN_CFG)
+    unspanned = open_rules(r, "plan-node-unspanned")
+    assert len(unspanned) == 2, "\n".join(f.render() for f in unspanned)
+    unreg = open_rules(r, "plan-node-unregistered-reason")
+    assert len(unreg) == 2, "\n".join(f.render() for f in unreg)
+    messages = " ".join(f.message for f in unreg)
+    assert "[oops]" in messages                  # the typo'd literal
+    assert "<dynamic>" in messages               # the forwarded variable
+
+
+def test_planspans_negative():
+    r = lint_fixture("plan_span_neg.py", cfg=PLAN_CFG)
+    assert open_family(r, "plan-node-spans") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+
+
+def test_planspans_suppressed():
+    r = lint_fixture("plan_span_sup.py", cfg=PLAN_CFG)
+    assert open_family(r, "plan-node-spans") == []
+    sup = [f for f in r.suppressed if f.rule == "plan-node-unspanned"]
+    assert len(sup) == 1 and "probe node" in sup[0].suppress_reason
+
+
+def test_planspans_registry_absent_skips_reason_check():
+    """Linting a planner module WITHOUT the lane registry in the set
+    still polices spans, but cannot police the closed vocabulary —
+    mirror of fallback-unused-reason's single-file behavior."""
+    cfg = LintConfig(planner_modules=("*/plan_span_*.py",))
+    r = lint_fixture("plan_span_pos.py", cfg=cfg)
+    assert len(open_rules(r, "plan-node-unspanned")) == 2
+    assert open_rules(r, "plan-node-unregistered-reason") == []
+
+
+def test_tree_planspans_covers_real_planner():
+    """The real planner module is in scope of the rule (the pattern
+    matches) and every PlanNode construction there passes it — the
+    family appears in the tree gate with zero findings."""
+    result = tree_result()
+    fam = [f for f in result.findings if f.family == "plan-node-spans"]
+    assert fam == [], "\n".join(f.render() for f in fam)
+    import fnmatch
+    planner = [c for c in result.program.contexts
+               if any(fnmatch.fnmatch(c.relpath, p)
+                      for p in DEFAULT_CONFIG.planner_modules)]
+    assert planner, "search/planner.py is not matched by planner_modules"
