@@ -2316,6 +2316,152 @@ def main() -> int:
         finally:
             node_pf.close()
 
+    # ---- multichip_lanes leg: pod-slice mesh-served impact lane --------
+    # Per-geometry QPS of the mesh-sharded block-max lane (ONE compiled
+    # shard_map dispatch per geometry: doc-axis sharded columns, θ
+    # exchanged cross-chip, all_gather + re-top-k merge), the θ-exchange
+    # round count each pruned sweep pays, and the pod-slice scaling
+    # ratio vs the single-chip lane — the MULTICHIP_r06 capture's
+    # companion numbers. Calls the lane entry points directly (not the
+    # searcher) so the planner's measured-cost routing can't bounce the
+    # sweep back to the single-chip arm mid-measurement.
+    mc_record = None
+    if os.environ.get("BENCH_MULTICHIP_LANES", "1") == "1":
+        mc_ndev = jax.device_count()
+        if mc_ndev < 2:
+            mc_record = {"skipped":
+                         f"{mc_ndev} device(s); mesh lanes need >= 2"}
+            log(f"[bench] multichip_lanes: skipped ({mc_ndev} device)")
+        else:
+            import tempfile as _mc_tmp
+            from pathlib import Path as _McPath
+
+            from elasticsearch_tpu.index.device_reader import \
+                device_reader_for as _mc_reader_for
+            from elasticsearch_tpu.node import Node as _McNode
+            from elasticsearch_tpu.ops import blockmax as _mc_bm
+            from elasticsearch_tpu.parallel.mesh import (
+                make_mesh as _mc_make_mesh,
+                valid_geometries as _mc_geoms)
+            from elasticsearch_tpu.search import jit_exec as _jx_mc
+
+            mc_docs = int(os.environ.get("BENCH_MULTICHIP_DOCS", 6000))
+            mc_batch = int(os.environ.get("BENCH_MULTICHIP_BATCH", 16))
+            mc_nb = int(os.environ.get("BENCH_MULTICHIP_BATCHES", 4))
+            mc_k, mc_t, mc_vocab = 10, 3, 120
+            mc_rng = np.random.default_rng(60613)
+            node_mc = _McNode({}, data_path=_McPath(
+                _mc_tmp.mkdtemp(prefix="bench_multichip_")) / "n"
+            ).start()
+            try:
+                node_mc.indices_service.create_index("mc_bench", {
+                    "settings": {"number_of_shards": 1,
+                                 "number_of_replicas": 0,
+                                 "index.search.collective_plane": False,
+                                 "index.search.impact_plane": True,
+                                 "index.search.impact.block_rows": 64},
+                    "mappings": {"_doc": {"properties": {
+                        "t": {"type": "text",
+                              "analyzer": "whitespace"}}}}})
+                for di in range(mc_docs):
+                    nw = int(mc_rng.integers(4, 13))
+                    node_mc.index_doc("mc_bench", str(di), {
+                        "t": " ".join(
+                            f"w{int(w)}" for w in
+                            mc_rng.integers(0, mc_vocab, size=nw))})
+                node_mc.broadcast_actions.refresh("mc_bench")
+                svc_mc = node_mc.indices_service.indices["mc_bench"]
+                reader_mc = _mc_reader_for(svc_mc.engine(0))
+                mc_cfg = _jx_mc.ImpactPlaneConfig(block_rows=64)
+                pack_mc = _jx_mc.impact_pack_for(reader_mc, "t", mc_cfg)
+                assert pack_mc is not None and pack_mc.can_prune, \
+                    "multichip_lanes: no prunable impact columns"
+                mc_rows = [[f"w{int(w)}" for w in
+                            mc_rng.integers(0, mc_vocab, size=mc_t)]
+                           for _ in range(mc_batch)]
+                mc_ones = [1.0] * mc_batch
+                mc_nocur = [None] * mc_batch
+
+                def mc_single():
+                    return _jx_mc.run_impact_pruned(
+                        pack_mc, mc_rows, mc_ones, mc_nocur, k=mc_k)
+
+                def mc_ms(run):
+                    t0 = time.perf_counter()
+                    for _ in range(mc_nb):
+                        run()
+                    return (time.perf_counter() - t0) * 1e3 / mc_nb
+
+                ref = mc_single()            # warm OUTSIDE the window
+                ref_d = np.asarray(ref["top_docs"])
+                ref_s = np.asarray(ref["top_scores"])
+                single_ms = mc_ms(mc_single)
+                mc_geo_recs = {}
+                mc_parity = True
+                best_qps = 0.0
+                for mc_dp, mc_sh in _mc_geoms(mc_ndev):
+                    mesh_g = _mc_make_mesh(dp=mc_dp, shard=mc_sh)
+
+                    def mc_mesh(mesh_g=mesh_g):
+                        return _jx_mc.run_impact_mesh(
+                            reader_mc, pack_mc, mesh_g, mc_rows,
+                            mc_ones, mc_nocur, k=mc_k, prune=True)
+                    dl0 = _jx_mc.cache_stats()["data_layer"]
+                    t0 = time.perf_counter()
+                    got = mc_mesh()          # warm: compile + placement
+                    g_compile_s = time.perf_counter() - t0
+                    dl1 = _jx_mc.cache_stats()["data_layer"]
+                    g_ok = bool(
+                        np.array_equal(np.asarray(got["top_docs"]),
+                                       ref_d)
+                        and np.array_equal(
+                            np.asarray(got["top_scores"]), ref_s))
+                    mc_parity &= g_ok
+                    g_ms = mc_ms(mc_mesh)
+                    g_qps = mc_batch * 1e3 / max(g_ms, 1e-9)
+                    best_qps = max(best_qps, g_qps)
+                    mc_geo_recs[f"dp{mc_dp}x{mc_sh}"] = {
+                        "dp": mc_dp, "shard": mc_sh,
+                        "ms_per_batch": round(g_ms, 2),
+                        "qps": round(g_qps, 1),
+                        "vs_single_chip": round(
+                            single_ms / max(g_ms, 1e-9), 3),
+                        "compile_s": round(g_compile_s, 1),
+                        "placement_bytes_uploaded":
+                            dl1["placement_bytes_uploaded"]
+                            - dl0["placement_bytes_uploaded"],
+                        "placement_bytes_reused":
+                            dl1["placement_bytes_reused"]
+                            - dl0["placement_bytes_reused"],
+                        "identical_to_single_chip": g_ok,
+                    }
+                single_qps = mc_batch * 1e3 / max(single_ms, 1e-9)
+                mc_record = {
+                    "n_docs": mc_docs, "k": mc_k, "terms": mc_t,
+                    "batch": mc_batch, "n_devices": mc_ndev,
+                    "single_chip_ms_per_batch": round(single_ms, 2),
+                    "single_chip_qps": round(single_qps, 1),
+                    "geometries": mc_geo_recs,
+                    "theta_exchange_rounds":
+                        _mc_bm.THETA_EXCHANGE_ROUNDS,
+                    "scaling_ratio": round(
+                        best_qps / max(single_qps, 1e-9), 3),
+                    "parity_all_geometries": mc_parity,
+                    "program_costs": program_costs_snapshot(
+                        lane_filter=("impact-mesh", "knn-mesh")),
+                }
+                log(f"[bench] multichip_lanes: single-chip "
+                    f"{single_ms:.1f} ms/batch; "
+                    + ", ".join(
+                        f"{gk} {gv['ms_per_batch']}ms "
+                        f"({gv['vs_single_chip']}x)"
+                        for gk, gv in mc_geo_recs.items())
+                    + f"; θ rounds={mc_record['theta_exchange_rounds']}"
+                    f", scaling {mc_record['scaling_ratio']}x, parity "
+                    f"{mc_parity}")
+            finally:
+                node_mc.close()
+
     oracle_recall = engine.get("oracle_recall_at_k")
     recall_ok = bool(kernel_ok and engine_ok and
                      (oracle_recall is None or oracle_recall >= 0.999))
@@ -2365,6 +2511,7 @@ def main() -> int:
         "impact_pruning": imp_record,
         "tail_tolerance": tt_record,
         "planner_fusion": pf_record,
+        "multichip_lanes": mc_record,
     }
 
     # ---- MS-MARCO-scale headline (BASELINE.json's stated metric) -------
@@ -2390,6 +2537,7 @@ def main() -> int:
                          BENCH_ORACLE="0", BENCH_HEADLINE_8M8="0",
                          BENCH_PERCOLATE="0", BENCH_IMPACT="0",
                          BENCH_TAIL="0", BENCH_PLANNER="0",
+                         BENCH_MULTICHIP_LANES="0",
                          BENCH_CPU_QUERIES="32")
         log(f"[bench] headline corpus: {docs_8m8} docs msmarco "
             f"statistics (engine-only child run)")
@@ -2431,6 +2579,7 @@ def main() -> int:
                 "impact_pruning": imp_record,
                 "tail_tolerance": tt_record,
                 "planner_fusion": pf_record,
+                "multichip_lanes": mc_record,
                 "corpora": {
                     f"zipf_{n_docs // 1_000_000}m": {
                         k_: v_ for k_, v_ in record.items()

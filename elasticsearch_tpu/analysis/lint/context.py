@@ -141,16 +141,22 @@ class LintConfig:
                           "vector-upload", "maxsim-dispatch",
                           "fusion-dispatch",
                           # the planner's composed impact→rescore arm
-                          "rescore-dispatch")
+                          "rescore-dispatch",
+                          # mesh-sharded retrieval lanes: placed block
+                          # upload, pod-slice impact sweep dispatch,
+                          # cross-chip knn candidate merge dispatch
+                          "block-placement-upload",
+                          "impact-shard-dispatch", "knn-mesh-merge")
     #: site classes that mark a LOOP as a dispatch loop (host-sync rule)
     dispatch_sites: tuple = ("dispatch", "plane-dispatch", "percolate",
                              "pruning-dispatch", "maxsim-dispatch",
-                             "fusion-dispatch", "rescore-dispatch")
+                             "fusion-dispatch", "rescore-dispatch",
+                             "impact-shard-dispatch", "knn-mesh-merge")
     #: site classes that dominate a raw ``jax.device_put`` inside a seam
     #: module (the upload/compose family of device touchpoints)
     upload_sites: tuple = ("upload", "compose", "reader-upload",
                            "impact-upload", "blockmax-compose",
-                           "vector-upload")
+                           "vector-upload", "block-placement-upload")
     #: the seam entry points (calls routed through these are guarded)
     fault_point_names: tuple = ("device_fault_point",)
     seam_wrappers: tuple = ("seam_device_put", "seam_jit")
@@ -241,7 +247,7 @@ class LintConfig:
     program_lanes: tuple = ("segment", "segment-batch", "reader-batch",
                             "streamed", "percolate", "impact-eager",
                             "impact-pruned", "impact-rescore", "knn",
-                            "mesh")
+                            "mesh", "impact-mesh", "knn-mesh")
     #: gauge registries in the lane-registry module: emitted into
     #: lane_graph.json next to the counter registries and required (by
     #: counter-unexported) to be referenced by the exporter, but their

@@ -105,12 +105,13 @@ class OneShotCharge:
                  breaker_name: str = "fielddata", *,
                  component: str = "untracked", index: str = "",
                  engine_uuid: str = "", block_id=None,
-                 parts: dict | None = None):
+                 parts: dict | None = None, device: str = "",
+                 device_parts: dict | None = None):
         self.breaker_service = breaker_service
         self.breaker_name = breaker_name
         self.nbytes = int(nbytes)
         self._ledger_meta = (component, index, engine_uuid, block_id,
-                             parts)
+                             parts, device, device_parts)
         self._ledger_token = None
 
     def _ledger(self):
@@ -126,12 +127,13 @@ class OneShotCharge:
                 self.nbytes, label)
             led = self._ledger()
             if led is not None:
-                comp, index, engine_uuid, block_id, parts = \
-                    self._ledger_meta
+                comp, index, engine_uuid, block_id, parts, device, \
+                    device_parts = self._ledger_meta
                 self._ledger_token = led.record(
                     self.nbytes, component=comp, index=index,
                     engine_uuid=engine_uuid, block_id=block_id,
-                    parts=parts)
+                    parts=parts, device=device,
+                    device_parts=device_parts)
         return self
 
     def touch(self) -> None:

@@ -396,6 +396,14 @@ class ProgramCostTable:
         with self._lock:
             return list(self._recs.values())
 
+    def items(self) -> list:
+        """``[((lane, shape_key), record), ...]`` — records WITH their
+        raw table keys. Records themselves carry only the key digest;
+        geometry-scoped aggregation (the planner's per-mesh pricing)
+        needs the raw shape_key, which lives in the table key."""
+        with self._lock:
+            return list(self._recs.items())
+
     def counters(self) -> dict:
         with self._lock:
             return {"resident": len(self._recs),
@@ -584,8 +592,38 @@ class CostEstimate(float):
                 f"source={self.source!r})")
 
 
+def mesh_axis(mesh):
+    """Normalize the planner's mesh argument to the hashable geometry
+    component the mesh-served lanes embed in their program keys.
+
+    Accepts a live ``jax.sharding.Mesh``, an already-normalized
+    geometry tuple (``(axis_sizes, device_ids)``), or None (single-chip
+    — no geometry axis). The normal form matches
+    ``jit_exec.mesh_geom`` exactly, so an estimate keyed through this
+    helper resolves against programs compiled for the same pod slice."""
+    if mesh is None:
+        return None
+    shape = getattr(mesh, "shape", None)
+    devices = getattr(mesh, "devices", None)
+    if shape is not None and devices is not None:
+        return (tuple(sorted((str(k), int(v)) for k, v in shape.items())),
+                tuple(int(d.id) for d in devices.flat))
+    return tuple(mesh)
+
+
+def _key_has_geom(shape_key, geom) -> bool:
+    """Does a raw program shape_key carry this geometry component?
+    Mesh-lane keys end with the geom tuple; anything else is a
+    single-chip program and never matches."""
+    try:
+        return geom in tuple(shape_key)
+    except TypeError:
+        return False
+
+
 def estimate(lane: str, shape_key=None,
-             node_id: str | None = None) -> "CostEstimate | None":
+             node_id: str | None = None,
+             mesh=None) -> "CostEstimate | None":
     """The planner's cost query → predicted µs for one program
     (a :class:`CostEstimate`), or None when the observatory has
     nothing to say about the lane at all.
@@ -597,11 +635,28 @@ def estimate(lane: str, shape_key=None,
     then the mean static prediction over the lane's compiled-but-idle
     programs (``cold=True`` — the never-dispatched-lane case the
     planner prices first requests with). Every non-None return is
-    finite and positive."""
+    finite and positive.
+
+    ``mesh`` adds a geometry axis to resolution (a Mesh, a normalized
+    geometry tuple, or None — see :func:`mesh_axis`). With a geometry:
+    the exact lookup first tries the geometry-qualified key
+    (``shape_key + (geom,)`` — how the mesh lanes key their programs),
+    and the lane-level fallbacks aggregate ONLY over programs compiled
+    for that geometry, falling back to the whole lane when the
+    geometry has no history yet. This is what lets the planner price
+    the same logical shape on a 1-chip lane vs two different pod
+    slices and get three distinct numbers."""
     t = table(node_id)
+    geom = mesh_axis(mesh)
     if shape_key is not None:
-        rec = t.lookup(lane, shape_key)
-        if rec is not None:
+        keys = [shape_key]
+        if geom is not None and isinstance(shape_key, tuple) and \
+                (len(shape_key) == 0 or shape_key[-1] != geom):
+            keys.insert(0, tuple(shape_key) + (geom,))
+        for sk in keys:
+            rec = t.lookup(lane, sk)
+            if rec is None:
+                continue
             if rec.dispatches > 0:
                 val = rec.ewma_us
                 if val > 0 and math.isfinite(val):
@@ -610,27 +665,34 @@ def estimate(lane: str, shape_key=None,
             val = rec.predicted_us
             if val > 0 and math.isfinite(val):
                 return CostEstimate(val, cold=True, source="static")
-    total_us = 0.0
-    total_n = 0
-    pred_us = 0.0
-    pred_n = 0
-    for rec in t.records():
-        if rec.lane != lane:
+    # lane-level aggregates: tally the geometry-scoped and unscoped
+    # sums in one pass, prefer the scoped figures when they exist
+    scoped = {"sum": 0.0, "n": 0, "psum": 0.0, "pn": 0}
+    unscoped = {"sum": 0.0, "n": 0, "psum": 0.0, "pn": 0}
+    for (rec_lane, rec_key), rec in t.items():
+        if rec_lane != lane:
             continue
-        if rec.dispatches > 0:
-            total_us += rec.sum_us
-            total_n += rec.dispatches
-        elif rec.predicted_us > 0 and math.isfinite(rec.predicted_us):
-            pred_us += rec.predicted_us
-            pred_n += 1
-    if total_n > 0 and math.isfinite(total_us) and total_us > 0:
-        return CostEstimate(total_us / total_n, cold=True,
-                            source="lane-mean")
-    if pred_n > 0:
-        # never-dispatched lane: static analysis is all there is, and
-        # a typed cold estimate beats forcing callers to handle None
-        return CostEstimate(pred_us / pred_n, cold=True,
-                            source="static")
+        buckets = [unscoped]
+        if geom is not None and _key_has_geom(rec_key, geom):
+            buckets.append(scoped)
+        for b in buckets:
+            if rec.dispatches > 0:
+                b["sum"] += rec.sum_us
+                b["n"] += rec.dispatches
+            elif rec.predicted_us > 0 and \
+                    math.isfinite(rec.predicted_us):
+                b["psum"] += rec.predicted_us
+                b["pn"] += 1
+    for b in ((scoped, unscoped) if geom is not None else (unscoped,)):
+        if b["n"] > 0 and math.isfinite(b["sum"]) and b["sum"] > 0:
+            return CostEstimate(b["sum"] / b["n"], cold=True,
+                                source="lane-mean")
+        if b["pn"] > 0:
+            # never-dispatched lane: static analysis is all there is,
+            # and a typed cold estimate beats forcing callers to
+            # handle None
+            return CostEstimate(b["psum"] / b["pn"], cold=True,
+                                source="static")
     return None
 
 

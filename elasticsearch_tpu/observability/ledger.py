@@ -58,10 +58,11 @@ DEFAULT_HOT_S = 300.0
 
 class LedgerEntry:
     __slots__ = ("index", "engine_uuid", "component", "block_id",
-                 "nbytes", "charged", "created_s", "last_access_s")
+                 "nbytes", "charged", "created_s", "last_access_s",
+                 "device")
 
     def __init__(self, index, engine_uuid, component, block_id, nbytes,
-                 charged, now):
+                 charged, now, device: str = ""):
         self.index = index
         self.engine_uuid = engine_uuid
         self.component = component
@@ -70,6 +71,12 @@ class LedgerEntry:
         self.charged = bool(charged)
         self.created_s = now
         self.last_access_s = now
+        # device placement tag ("" = unplaced / default device): the
+        # mesh-sharded lanes' placed blocks record one entry per owning
+        # device, so the per_device rollup reconciles bit-exactly with
+        # the node total by construction (every entry has exactly one
+        # device attribution)
+        self.device = device
 
 
 #: every live ledger (one per breaker service) — the process-wide view
@@ -92,15 +99,25 @@ class DeviceMemoryLedger:
     def record(self, nbytes: int, component: str = "untracked",
                index: str = "", engine_uuid: str = "",
                block_id=None, charged: bool = True,
-               parts: dict | None = None) -> int:
+               parts: dict | None = None, device: str = "",
+               device_parts: dict | None = None) -> int:
         """One reservation → one token. ``parts`` splits a single charge
         into per-component rows (the mesh block's column vs mask bytes)
-        that live and die together under the returned token."""
+        that live and die together under the returned token.
+        ``device_parts`` (device → bytes) splits it into per-device rows
+        instead — the placed-block path, where each owning device holds
+        its shard slice; ``device`` tags every row of a non-split charge
+        with one placement."""
         now = time.monotonic()
-        split = parts if parts else {component: nbytes}
-        entries = [LedgerEntry(index, engine_uuid, comp, block_id, b,
-                               charged, now)
-                   for comp, b in split.items()]
+        if device_parts:
+            entries = [LedgerEntry(index, engine_uuid, component,
+                                   block_id, b, charged, now, device=d)
+                       for d, b in device_parts.items()]
+        else:
+            split = parts if parts else {component: nbytes}
+            entries = [LedgerEntry(index, engine_uuid, comp, block_id,
+                                   b, charged, now, device=device)
+                       for comp, b in split.items()]
         with self._lock:
             self._seq += 1
             token = self._seq
@@ -162,6 +179,7 @@ class DeviceMemoryLedger:
         entries = self._all_entries()
         by_component = {c: 0 for c in COMPONENTS}
         by_index: dict = {}
+        per_device: dict = {}
         charged = uncharged = 0
         for e in entries:
             by_component[e.component] = \
@@ -173,6 +191,11 @@ class DeviceMemoryLedger:
             idx["total_bytes"] += e.nbytes
             idx["components"][e.component] = \
                 idx["components"].get(e.component, 0) + e.nbytes
+            # "-" = unplaced (single-device residency): every entry
+            # lands in exactly one bucket, so
+            # Σ per_device == total_bytes bit-exactly by construction
+            per_device[e.device or "-"] = \
+                per_device.get(e.device or "-", 0) + e.nbytes
             if e.charged:
                 charged += e.nbytes
             else:
@@ -183,6 +206,7 @@ class DeviceMemoryLedger:
             "uncharged_bytes": uncharged,
             "entries": len(entries),
             "by_component": by_component,
+            "per_device": {k: per_device[k] for k in sorted(per_device)},
             "indices": {k: by_index[k] for k in sorted(by_index)},
         }
 
@@ -199,6 +223,7 @@ class DeviceMemoryLedger:
                 or "_unknown",
                 "engine": e.engine_uuid,
                 "component": e.component,
+                "device": e.device or "-",
                 "block": e.block_id if e.block_id is not None else "-",
                 "bytes": e.nbytes,
                 "charged": e.charged,
@@ -234,7 +259,8 @@ def global_snapshot() -> dict:
     sees the device reader / block cache charges)."""
     totals = {"total_bytes": 0, "charged_bytes": 0, "uncharged_bytes": 0,
               "entries": 0,
-              "by_component": {c: 0 for c in COMPONENTS}, "indices": {}}
+              "by_component": {c: 0 for c in COMPONENTS},
+              "per_device": {}, "indices": {}}
     for led in list(_ALL):
         snap = led.snapshot()
         for k in ("total_bytes", "charged_bytes", "uncharged_bytes",
@@ -243,6 +269,9 @@ def global_snapshot() -> dict:
         for comp, b in snap["by_component"].items():
             totals["by_component"][comp] = \
                 totals["by_component"].get(comp, 0) + b
+        for dev, b in snap["per_device"].items():
+            totals["per_device"][dev] = \
+                totals["per_device"].get(dev, 0) + b
         for name, idx in snap["indices"].items():
             dst = totals["indices"].setdefault(
                 name, {"total_bytes": 0, "components": {}})

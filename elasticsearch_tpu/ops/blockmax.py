@@ -102,18 +102,32 @@ def block_bounds(block_max, qtids):
     return ub
 
 
+def topk_flat_by_doc(scores, docs, k: int):
+    """Top-k of ONE flat candidate list by (score desc, doc id asc) —
+    the exact scorer's tie order. Empty slots: (-inf, -1). Lists
+    shorter than k pad out; candidates carrying GLOBAL doc ids keep
+    them (the cross-chip merge re-selects gathered per-shard top-k
+    lists through this, so the mesh lanes' final order is the same
+    doc-asc tie-break the single-chip merge applies)."""
+    n = scores.shape[0]
+    if n < k:
+        scores = jnp.pad(scores, (0, k - n), constant_values=NEG_INF)
+        docs = jnp.pad(docs, (0, k - n), constant_values=-1)
+    key_d = jnp.where(docs >= 0, docs, _PAD_DOC)
+    by_doc = jnp.argsort(key_d)                       # doc asc
+    by_score = jnp.argsort(-scores[by_doc])           # stable: doc ties
+    sel = by_doc[by_score][:k]
+    ts = scores[sel]
+    return ts, jnp.where(ts > NEG_INF, docs[sel], -1)
+
+
 def merge_topk_by_doc(scores_a, docs_a, scores_b, docs_b, k: int):
     """Top-k of the concatenation by (score desc, doc id asc) — the
     exact scorer's merge tie order, made explicit because block-sweep
     candidates arrive out of doc order. Empty slots: (-inf, -1)."""
     s = jnp.concatenate([scores_a, scores_b])
     d = jnp.concatenate([docs_a, docs_b])
-    key_d = jnp.where(d >= 0, d, _PAD_DOC)
-    by_doc = jnp.argsort(key_d)                       # doc asc
-    by_score = jnp.argsort(-s[by_doc])                # stable: doc ties
-    sel = by_doc[by_score][:k]
-    ts = s[sel]
-    return ts, jnp.where(ts > NEG_INF, d[sel], -1)
+    return topk_flat_by_doc(s, d, k)
 
 
 def eager_segment_topk(uterms, qimp, live, qtids, scale_boost, k: int,
@@ -195,6 +209,103 @@ def pruned_carry_init(k: int):
     return (jnp.full(k, NEG_INF, jnp.float32),
             jnp.full(k, -1, jnp.int32),
             jnp.int32(0), jnp.int32(0), jnp.int32(0))
+
+
+#: θ-exchange cadence of the mesh sweep: the shard-local block order is
+#: split into this many chunks, and shards exchange their running k-th
+#: score (one ``pmax`` over the shard axis) at each chunk boundary.
+#: More rounds → tighter cross-chip pruning, more ICI latency; 4 keeps
+#: the exchange cost below one block's HBM read at validated shapes.
+THETA_EXCHANGE_ROUNDS = 4
+
+
+def pruned_segment_topk_mesh(carry, uterms, qimp, live, block_max,
+                             qtids, scale_boost, k: int, doc_base,
+                             cursor_s, cursor_d, *,
+                             axis_name: str = "shard",
+                             rounds: int = THETA_EXCHANGE_ROUNDS):
+    """One query's block-max sweep over one segment's SHARD-LOCAL block
+    partition, inside ``shard_map`` — the pod-slice half of the impact
+    lane. Identical contract to :func:`pruned_segment_topk` except:
+
+    * ``doc_base`` is TRACED (global base of this shard's row slice =
+      segment base + shard index × local rows; it only enters the
+      kernel additively, so tracing it costs nothing);
+    * the sweep runs in ``rounds`` chunks of the local descending
+      upper-bound order, and at each chunk boundary the shards exchange
+      their running k-th score via ``lax.pmax`` over ``axis_name``. A
+      block then runs only when its bound can still reach
+      ``max(θ_local, θ_external)``.
+
+    Cross-chip pruning stays conservative — hence the gathered per-shard
+    top-k lists re-merge to EXACTLY the single-chip result: θ_external
+    is some shard's k-th local score at exchange time, every one of that
+    shard's local top-k candidates scores ≥ θ_external, so the global
+    k-th final score is ≥ θ_external; skipping a block with bound <
+    θ_external can therefore never drop a global-top-k doc (a
+    global-top-k doc is always in its own shard's local top-k — local
+    top-k ⊇ the shard's global-top-k members). The run condition keeps
+    ``>=`` so boundary ties survive, exactly as on one chip. Counters
+    remain exact per shard (pad sentinels count neither scored nor
+    skipped); their ``psum`` differs from the single-chip sweep's split
+    only in how MUCH the tighter/staler θ prunes, never in the scores.
+
+    Blocks appended by the S-divisibility pad carry all-zero
+    ``block_max`` rows → ``ub_i == 0`` → never run, and ``order``
+    chunks shorter than the round width pad with -1 sentinels."""
+    np_docs, u = uterms.shape
+    n_blocks = block_max.shape[0]
+    r = np_docs // n_blocks
+    ub_i = block_bounds(block_max, qtids)
+    ub_f = ub_i.astype(jnp.float32) * scale_boost
+    order = jnp.argsort(-ub_f).astype(jnp.int32)
+    n_rounds = max(1, min(int(rounds), n_blocks))       # static
+    chunk = -(-n_blocks // n_rounds)
+    pad = n_rounds * chunk - n_blocks
+    if pad:
+        order = jnp.concatenate(
+            [order, jnp.full(pad, -1, jnp.int32)])
+    order = order.reshape(n_rounds, chunk)
+
+    def make_step(theta_ext):
+        def step(c, bi):
+            ts, td, n_scored, n_skipped, n_matched = c
+            theta = jnp.maximum(ts[k - 1], theta_ext)
+            bix = jnp.maximum(bi, 0)          # sentinel-safe index
+            run = (bi >= 0) & (ub_i[bix] > 0) & (ub_f[bix] >= theta)
+
+            def hot(c):
+                ts, td, n_scored, n_skipped, n_matched = c
+                ru = jax.lax.dynamic_slice(uterms, (bix * r, 0), (r, u))
+                rq = jax.lax.dynamic_slice(qimp, (bix * r, 0), (r, u))
+                rl = jax.lax.dynamic_slice(live, (bix * r,), (r,))
+                qsum, anyhit = impact_scores(ru, rq, qtids)
+                sf = qsum.astype(jnp.float32) * scale_boost
+                docs = bix * r + jnp.arange(r, dtype=jnp.int32) + doc_base
+                valid = anyhit & rl & \
+                    ((sf < cursor_s) |
+                     ((sf == cursor_s) & (docs > cursor_d)))
+                sf = jnp.where(valid, sf, NEG_INF)
+                docs = jnp.where(valid, docs, -1)
+                ts2, td2 = merge_topk_by_doc(ts, td, sf, docs, k)
+                return (ts2, td2, n_scored + 1, n_skipped,
+                        n_matched + valid.sum(dtype=jnp.int32))
+
+            def cold(c):
+                ts, td, n_scored, n_skipped, n_matched = c
+                return (ts, td, n_scored,
+                        n_skipped + (bi >= 0).astype(jnp.int32),
+                        n_matched)
+
+            return jax.lax.cond(run, hot, cold, c), None
+        return step
+
+    for ri in range(n_rounds):
+        # stale-but-conservative: θ_external was ≤ the global k-th score
+        # when exchanged, and the global k-th only grows
+        theta_ext = jax.lax.pmax(carry[0][k - 1], axis_name)
+        carry, _ = jax.lax.scan(make_step(theta_ext), carry, order[ri])
+    return carry
 
 
 # ---------------------------------------------------------------------------

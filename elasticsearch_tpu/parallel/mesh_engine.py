@@ -496,6 +496,7 @@ _block_cache = _DeviceBlockCache()
 
 def clear_block_cache() -> None:
     _block_cache.clear()
+    _placed_cache.clear()
 
 
 def block_cache_stats() -> dict:
@@ -569,6 +570,208 @@ def fetch_vector_block(engine_uuid: str, block_uid: int, field: str,
         f"vector block [{engine_uuid[:8]}]")
 
 
+# ---------------------------------------------------------------------------
+# Placement-aware block cache: the mesh-sharded retrieval lanes' sibling
+# of _DeviceBlockCache. Where the plain cache parks a block on the
+# default device, this one PINS each block's rows to owning devices —
+# the host arrays (padded so axis 0 divides by the mesh's shard count)
+# upload once under NamedSharding(mesh, P("shard")), and a refresh that
+# changes only some rows (a delete flipping one shard's live-mask
+# slice, one shard's new segment rows) re-ships ONLY the changed shard
+# slices to their owning devices, rebuilding the global array around
+# the other shards' still-resident buffers. Keys carry the mesh
+# geometry, so a dp×shard re-shape never aliases stale placements.
+# Counter contract (data_layer.placement_bytes_{uploaded,reused}):
+# uploaded = host bytes of shard slices actually shipped, reused =
+# resident slice bytes a fetch did not re-ship.
+# ---------------------------------------------------------------------------
+_PLACED_CACHE_CAP = 256
+
+
+class _PlacedBlock:
+    __slots__ = ("arrays", "host_slices", "nbytes", "charge")
+
+    def __init__(self, arrays, host_slices, nbytes, charge):
+        self.arrays = arrays            # placed jax arrays (shard axis 0)
+        self.host_slices = host_slices  # per array: S host slice copies
+        self.nbytes = nbytes            # charged host bytes (one copy)
+        self.charge = charge            # OneShotCharge | None
+
+
+def _replace_shard_slices(arr, shape, col_slices, changed_cols, mesh):
+    """Rebuild ONE placed array with fresh buffers only on the owning
+    devices of the changed shard columns, reusing every other shard's
+    resident device buffer — the delta-refresh half of the placement
+    contract."""
+    from elasticsearch_tpu.search import jit_exec
+    s_axis = int(mesh.shape["shard"])
+    rows = shape[0] // s_axis
+    sharding = NamedSharding(mesh, P("shard"))
+    bufs = []
+    with device_span("block-placement-upload"):
+        jit_exec.device_fault_point("block-placement-upload")
+        for sh in arr.addressable_shards:
+            col = int(sh.index[0].start or 0) // rows
+            if col in changed_cols:
+                bufs.append(jax.device_put(col_slices[col], sh.device))
+            else:
+                bufs.append(sh.data)
+        return jax.make_array_from_single_device_arrays(shape, sharding,
+                                                        bufs)
+
+
+class _PlacedBlockCache:
+    def __init__(self, cap: int = _PLACED_CACHE_CAP):
+        self.cap = cap
+        self._lru: "OrderedDict[tuple, _PlacedBlock]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def fetch(self, mesh, key: tuple, build_np, breaker_service,
+              label: str, component: str = "impact"):
+        """→ (placed device arrays, uploaded bytes, reused bytes).
+        ``build_np`` returns the host arrays, every axis-0 length
+        divisible by the mesh's shard count (the caller pads). Called
+        on EVERY fetch — the arrays are views over segment columns, and
+        the per-slice diff against the resident host copies is what
+        routes a refresh delta to owning devices only."""
+        from elasticsearch_tpu.search import jit_exec
+        s_axis = int(mesh.shape["shard"])
+        geom = (tuple(sorted(mesh.shape.items())),
+                tuple(int(d.id) for d in mesh.devices.flat))
+        full_key = tuple(key) + (geom,)
+        flat_np = [np.ascontiguousarray(a) for a in build_np()
+                   if a is not None]
+        slices = [[np.ascontiguousarray(s)
+                   for s in np.split(a, s_axis, axis=0)]
+                  for a in flat_np]
+        with self._lock:
+            blk = self._lru.get(full_key)
+            if blk is not None:
+                self._lru.move_to_end(full_key)
+                if blk.charge is not None:
+                    blk.charge.touch()     # ledger recency (hot/cold)
+                changed = [(ai, si)
+                           for ai, (old_sl, new_sl)
+                           in enumerate(zip(blk.host_slices, slices))
+                           for si in range(s_axis)
+                           if not np.array_equal(old_sl[si], new_sl[si])]
+                if not changed:
+                    return blk.arrays, 0, blk.nbytes
+                up = sum(int(slices[ai][si].nbytes)
+                         for ai, si in changed)
+                # delta refresh: re-ship ONLY the changed shard slices
+                # to their owning devices (updated under the lock so a
+                # racing fetch sees a consistent arrays/host pair; a
+                # fault raise leaves the block whole on the old data)
+                with device_span("block-placement-upload") as dsp:
+                    jit_exec.device_fault_point("block-placement-upload")
+                    new_arrays = list(blk.arrays)
+                    for ai in sorted({a for a, _ in changed}):
+                        cols = {si for a2, si in changed if a2 == ai}
+                        new_arrays[ai] = _replace_shard_slices(
+                            blk.arrays[ai], flat_np[ai].shape,
+                            slices[ai], cols, mesh)
+                    dsp.set(bytes=up, kind="placed-delta")
+                blk.arrays = new_arrays
+                blk.host_slices = slices
+                return blk.arrays, up, blk.nbytes - up
+        with device_span("block-placement-upload") as dsp:
+            jit_exec.device_fault_point("block-placement-upload")
+            arrays = [jax.device_put(a, NamedSharding(mesh, P("shard")))
+                      for a in flat_np]
+            nbytes = int(sum(a.nbytes for a in flat_np))
+            dsp.set(bytes=nbytes, kind="placed-block")
+        charge = None
+        if breaker_service is not None:
+            from elasticsearch_tpu.common.breaker import OneShotCharge
+            # one ledger row per owning device (the shard column's
+            # first-row device — dp replicas share its attribution), so
+            # _cat/hbm and _nodes/stats.device_memory.per_device show
+            # the placement while Σ per_device stays the host bytes
+            per_dev: dict = {}
+            for si in range(s_axis):
+                dev = str(int(mesh.devices[0, si].id))
+                per_dev[dev] = per_dev.get(dev, 0) + sum(
+                    int(sl[si].nbytes) for sl in slices)
+            charge = OneShotCharge(
+                breaker_service, nbytes, component=component,
+                engine_uuid=str(key[0]), block_id=key[1],
+                device_parts=per_dev).charge(label)
+        blk = _PlacedBlock(arrays, slices, nbytes, charge)
+        evicted = []
+        lost_race = False
+        with self._lock:
+            cur = self._lru.get(full_key)
+            if cur is not None:
+                # raced duplicate build: keep the incumbent, report our
+                # bytes as REUSED (the counter proofs' discipline —
+                # same as _DeviceBlockCache.fetch_aux)
+                self._lru.move_to_end(full_key)
+                if charge is not None:
+                    charge.release()
+                blk = cur
+                lost_race = True
+            else:
+                self._lru[full_key] = blk
+                while len(self._lru) > self.cap:
+                    evicted.append(self._lru.popitem(last=False)[1])
+        for old in evicted:
+            if old.charge is not None:
+                old.charge.release()
+        if lost_race:
+            return blk.arrays, 0, blk.nbytes
+        return blk.arrays, nbytes, 0
+
+    def release_engine(self, engine_uuid: str) -> None:
+        with self._lock:
+            dead = [k for k in self._lru if k[0] == engine_uuid]
+            gone = [self._lru.pop(k) for k in dead]
+        for blk in gone:
+            if blk.charge is not None:
+                blk.charge.release()
+
+    def clear(self) -> None:
+        with self._lock:
+            gone = list(self._lru.values())
+            self._lru.clear()
+        for blk in gone:
+            if blk.charge is not None:
+                blk.charge.release()
+
+    def stats(self) -> dict:
+        with self._lock:
+            blocks = list(self._lru.values())
+        return {"entries": len(blocks),
+                "resident_bytes": sum(b.nbytes for b in blocks),
+                "charged_bytes": sum(b.charge.nbytes for b in blocks
+                                     if b.charge is not None)}
+
+
+_placed_cache = _PlacedBlockCache()
+
+
+def fetch_placed_block(mesh, engine_uuid: str, block_uid: int,
+                       sig: tuple, build_np, breaker_service,
+                       component: str = "impact"):
+    """One segment's mesh-lane arrays pinned to their owning devices —
+    → (placed device arrays, uploaded bytes, reused bytes). ``sig``
+    distinguishes lanes/layouts (and must carry anything whose change
+    should force a re-place, e.g. the impact quantization generation);
+    the mesh geometry joins the key here."""
+    key = (engine_uuid, block_uid, tuple(sig))
+    return _placed_cache.fetch(
+        mesh, key, build_np, breaker_service,
+        f"placed block [{engine_uuid[:8]}]", component)
+
+
+def clear_placed_cache() -> None:
+    _placed_cache.clear()
+
+
+def placed_cache_stats() -> dict:
+    return _placed_cache.stats()
+
+
 def hook_engine_block_release(engine) -> None:
     """Install the engine-close listener that returns every cached
     device block (columns AND impact blocks) charged against this
@@ -593,6 +796,7 @@ class _EngineBlocksRelease:
 
     def release(self) -> None:
         _block_cache.release_engine(self.engine_uuid)
+        _placed_cache.release_engine(self.engine_uuid)
         # the cost observatory drains with the engine too: programs
         # owned by this incarnation leave the table the same instant
         # their device blocks leave the cache (no rows for closed

@@ -3316,9 +3316,16 @@ class Handlers:
         block id, bytes) with hot/cold classification by last-access
         recency (``?hot_s=`` overrides the 300 s default). The `bytes`
         column totals reconcile with /_cat/fielddata's breaker figure —
-        the ledger invariant, broken down per block."""
+        the ledger invariant, broken down per block. The `device`
+        column shows placement (mesh-sharded lanes pin blocks to an
+        owning device; "-" = unplaced/default device); ``?totals=true``
+        appends one ``total`` summary row per device — the same rollup
+        ``_nodes/stats.device_memory.per_device`` reports (off by
+        default so the bytes column still sums to the breaker
+        figure)."""
         node = self.node
         hot_s = float(req.param("hot_s", "300"))
+        totals = req.param("totals", "false") in ("true", "")
         rows = node.breaker_service.device_ledger.rows(
             resolve_index=node.resolve_engine_index, hot_s=hot_s)
         cols = [
@@ -3329,6 +3336,8 @@ class Handlers:
             Col("component", ("c", "comp"),
                 "mesh-columns|masks|impact|vector|pack|reader-columns|"
                 "percolate"),
+            Col("device", ("d", "dev"),
+                "owning device (- = unplaced/default)"),
             Col("block", ("b",), "block uid (- for non-block entries)",
                 right=True),
             Col("bytes", ("by",), "resident bytes", right=True),
@@ -3340,13 +3349,24 @@ class Handlers:
             Col("temp", ("t",), "hot (accessed within hot_s) or cold"),
         ]
         t = CatTable(cols)
+        per_device: dict = {}
         for r in rows:
+            per_device[r["device"]] = \
+                per_device.get(r["device"], 0) + r["bytes"]
             t.add(node=node.node_name, index=r["index"],
                   engine=r["engine"][:8] if r["engine"] else "-",
-                  component=r["component"], block=r["block"],
+                  component=r["component"], device=r["device"],
+                  block=r["block"],
                   bytes=r["bytes"], size=fmt_bytes(r["bytes"]),
                   charged="true" if r["charged"] else "false",
                   idle=r["idle_s"], temp=r["temp"])
+        if totals:
+            for dev in sorted(per_device):
+                t.add(node=node.node_name, index="_total", engine="-",
+                      component="total", device=dev, block="-",
+                      bytes=per_device[dev],
+                      size=fmt_bytes(per_device[dev]), charged="-",
+                      idle="-", temp="-")
         return t.render(req)
 
     @staticmethod

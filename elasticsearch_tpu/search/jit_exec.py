@@ -1657,6 +1657,7 @@ def impact_pack_for(reader, field: str, cfg: ImpactPlaneConfig,
             "u": int(icol.qimp.shape[1]),
             "doc_base": int(dseg.doc_base),
             "n_blocks": int(n_blocks),
+            "block_uid": int(dseg.seg.block_uid),
         })
         pack.bases.append(int(dseg.doc_base))
         pack.total_blocks += int(n_blocks)
@@ -2375,6 +2376,7 @@ def vector_pack_for(reader, field: str,
             "np_docs": int(dseg.padded_docs),
             "t": int(host["vecs"].shape[1]) if multi else 0,
             "doc_base": int(dseg.doc_base),
+            "block_uid": int(dseg.seg.block_uid),
         })
     if not any_field:
         return None
@@ -2724,6 +2726,546 @@ def run_knn_hybrid_batch(reader, ctx, reqs, pack: _VectorPack,
         with device_span("dispatch", cost=cost):
             device_fault_point("dispatch")
             out = fn(*args)
+    if b_pad != b:
+        out = {name: v[:b] for name, v in out.items()}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded retrieval lanes: the impact and knn/hybrid lanes served by
+# a pod slice as ONE compiled shard_map program.
+#
+# Partitioning: each segment's impact rows / block-max tables / vector
+# columns are doc-axis sharded over the mesh's ``shard`` axis through the
+# placement-aware block cache (mesh_engine.fetch_placed_block — blocks
+# pinned to owning devices, refresh deltas routed to the owner only),
+# while the query batch shards over ``dp``. In-program, each shard runs
+# the SAME per-segment kernels the single-chip lanes run on its local
+# rows, then the per-shard top-k candidate lists (GLOBAL doc ids)
+# all_gather over ICI and re-select under the identical
+# (score desc, doc asc) order — so mesh-served results are bit-identical
+# to the single-chip lanes (tests/test_mesh_lanes.py fuzzes the
+# equivalence across geometries, delete churn and refresh). The pruned
+# sweep additionally exchanges the running k-th score across chips
+# (ops/blockmax.pruned_segment_topk_mesh's θ-exchange rounds) so
+# cross-chip pruning stays conservative.
+#
+# The serving mesh is an OPT-IN module hook (set_serving_mesh): when no
+# mesh is installed every production path is byte-for-byte the
+# single-chip lane — the hook gates phase routing, scheduler shape keys
+# and planner pricing.
+# ---------------------------------------------------------------------------
+
+_serving_mesh = None
+
+
+def set_serving_mesh(mesh) -> None:
+    """Install (or with None, remove) the pod-slice serving mesh the
+    retrieval lanes shard over. Returns nothing; callers own clearing
+    the program cache when they swap geometries mid-process (the
+    program keys carry the geometry, so stale entries are merely
+    unused, never wrong)."""
+    global _serving_mesh
+    _serving_mesh = mesh
+
+
+def serving_mesh():
+    """The installed serving mesh, or None (single-chip serving)."""
+    return _serving_mesh
+
+
+def mesh_geom(mesh) -> tuple:
+    """The geometry component mesh-lane program keys and scheduler
+    shape buckets carry: axis sizes + flat device ids, so the same
+    request shape on two geometries compiles (at most) twice and never
+    aliases across device re-enumeration."""
+    return (tuple(sorted((str(k), int(v))
+                         for k, v in mesh.shape.items())),
+            tuple(int(d.id) for d in mesh.devices.flat))
+
+
+def note_data_blocks_placed(uploaded: int, reused: int) -> None:
+    """Placed-block (mesh-lane) cache traffic from one lane build."""
+    with _cache_lock:
+        _data_layer["placement_bytes_uploaded"] += int(uploaded)
+        _data_layer["placement_bytes_reused"] += int(reused)
+
+
+def _pad_batch_rows(arrs: list, b_new: int) -> list:
+    """Pad each array's leading (batch) axis to ``b_new`` by repeating
+    the last row — the dp-divisibility companion of the pow2 batch
+    bucket (padded rows are trimmed from the output like pad rows)."""
+    out = []
+    for a in arrs:
+        extra = b_new - a.shape[0]
+        out.append(a if extra == 0 else
+                   jnp.concatenate([a, jnp.repeat(a[-1:], extra,
+                                                  axis=0)]))
+    return out
+
+
+def _mesh_place(tree, mesh, spec, kind: str):
+    """Commit query-side operands to the serving mesh (dp-sharded batch
+    consts or replicated scalars) under the plane's upload seam, so
+    chaos injection and the tracer see the transfer like every other
+    host→device move."""
+    from jax.sharding import NamedSharding
+    sh = NamedSharding(mesh, spec)
+    leaves = jax.tree.leaves(tree)
+    with device_span("upload") as dsp:
+        device_fault_point("upload")
+        out = jax.tree.map(lambda a: jax.device_put(a, sh), tree)
+        dsp.set(bytes=int(sum(int(a.nbytes) for a in leaves)),
+                kind=kind)
+    return out
+
+
+def _placed_impact_arrays(reader, pack: _ImpactPack, mesh) -> list:
+    """Per-segment placed (uterms, qimp, live[, block_max]) device
+    arrays for the mesh impact lane: rows pad to a whole number of
+    blocks per shard (appended blocks carry all-zero block_max rows →
+    never swept; pad rows are uterms=-1/live=False → never match), then
+    pin to owning devices through the placement-aware block cache. A
+    refresh re-ships only the shard slices that changed (the
+    placement_bytes_* counters prove it)."""
+    from elasticsearch_tpu.parallel.mesh_engine import fetch_placed_block
+    s_axis = int(mesh.shape["shard"])
+    engine_uuid = getattr(reader, "engine_uuid", None) or \
+        f"reader:{id(reader)}"
+    breaker_service = getattr(reader, "breaker_service", None)
+    seg_arrs = []
+    uploaded = reused = 0
+    for s in pack.segs:
+        icol = s["col"]
+        n_blocks = s["n_blocks"]
+        r = s["np_docs"] // n_blocks
+        nb_pad = -(-n_blocks // s_axis) * s_axis
+        rows_pad = nb_pad * r
+        has_bm = s["block_max"] is not None
+
+        def build(s=s, nb_pad=nb_pad, rows_pad=rows_pad,
+                  n_blocks=n_blocks, has_bm=has_bm):
+            pad = rows_pad - s["np_docs"]
+            ut = np.pad(np.asarray(s["uterms"]), ((0, pad), (0, 0)),
+                        constant_values=-1)
+            qi = np.pad(np.asarray(s["qimp"]), ((0, pad), (0, 0)))
+            lv = np.pad(np.asarray(s["live"]), (0, pad))
+            out = [ut, qi, lv]
+            if has_bm:
+                out.append(np.pad(np.asarray(s["block_max"]),
+                                  ((0, nb_pad - n_blocks), (0, 0))))
+            return out
+
+        sig = ("impact-mesh", pack.field, pack.cfg.bits,
+               icol.block_rows, icol.quant_gen, has_bm, nb_pad)
+        arrs, up, re = fetch_placed_block(
+            mesh, engine_uuid, s["block_uid"], sig, build,
+            breaker_service, component="impact")
+        seg_arrs.append(tuple(arrs))
+        uploaded += up
+        reused += re
+    note_data_blocks_placed(uploaded, reused)
+    return seg_arrs
+
+
+def run_impact_mesh(reader, pack: _ImpactPack, mesh, term_lists: list,
+                    boosts: list, cursors: list, *, k: int,
+                    prune: bool = False,
+                    n_real: int | None = None) -> dict:
+    """The impact lane served by the pod slice as ONE compiled
+    shard_map dispatch: impact columns and block-max tables doc-axis
+    sharded over ``shard``, the query batch over ``dp``; per-shard
+    sweeps (eager, or block-max pruned with cross-chip θ-exchange),
+    then an in-program all_gather + re-top-k merge. Output contract
+    and bits match run_impact_batch / run_impact_pruned exactly —
+    except the pruned lane's blocks_scored/blocks_skipped, which
+    depend on how much the exchanged θ pruned (counts stay exact
+    partitions for the eager lane, psum'd)."""
+    from jax.sharding import PartitionSpec as P
+    from elasticsearch_tpu.parallel.mesh import shard_map_compat
+    if prune and not pack.can_prune:
+        raise ValueError("pack has segments without block maxima")
+    b = len(term_lists)
+    k_static = int(k)
+    dp = int(mesh.shape["dp"])
+    qtids, boosts_a, cs, cd, b_pad, t_pad = _impact_query_inputs(
+        pack, term_lists, boosts, cursors)
+    b_pad_m = -(-b_pad // dp) * dp
+    if b_pad_m != b_pad:
+        qtids = _pad_batch_rows(qtids, b_pad_m)
+        boosts_a, cs, cd = _pad_batch_rows([boosts_a, cs, cd], b_pad_m)
+        b_pad = b_pad_m
+    placed = _placed_impact_arrays(reader, pack, mesh)
+    seg_arrs = tuple(a if prune else a[:3] for a in placed)
+    bases = tuple(pack.bases)
+    geom = mesh_geom(mesh)
+    key = ("impact-mesh", pack.sig(), k_static, b_pad, t_pad,
+           bool(prune), geom)
+    qtids = _mesh_place(qtids, mesh, P("dp"), "mesh-query-consts")
+    boosts_a, cs, cd = _mesh_place([boosts_a, cs, cd], mesh, P("dp"),
+                                   "mesh-query-consts")
+    scales = _mesh_place(pack.scales, mesh, P(), "mesh-scales")
+
+    def compile_fn():
+        def step_local(seg_in, qtids_in, scales_in, boosts_in, cs_in,
+                       cd_in):
+            sidx = jax.lax.axis_index("shard")
+            if prune:
+                def per_query(args):
+                    qts, bo, c1, c2 = args
+                    carry = blockmax_ops.pruned_carry_init(k_static)
+                    for i, (ut, qi, lv, bmx) in enumerate(seg_in):
+                        base = bases[i] + sidx * ut.shape[0]
+                        carry = blockmax_ops.pruned_segment_topk_mesh(
+                            carry, ut, qi, lv, bmx, qts[i],
+                            scales_in[i] * bo, k_static, base, c1, c2)
+                    return carry
+                ts, td, n_scored, n_skipped, n_matched = jax.lax.map(
+                    per_query,
+                    (tuple(qtids_in), boosts_in, cs_in, cd_in))
+                out = {"count": jax.lax.psum(n_matched, "shard"),
+                       "blocks_scored": jax.lax.psum(n_scored, "shard"),
+                       "blocks_skipped": jax.lax.psum(n_skipped,
+                                                      "shard")}
+            else:
+                ts_list, td_list, base_list = [], [], []
+                counts = None
+                for i, (ut, qi, lv) in enumerate(seg_in):
+                    base = bases[i] + sidx * ut.shape[0]
+
+                    def one(qt, bo, c1, c2, ut=ut, qi=qi, lv=lv, i=i,
+                            base=base):
+                        return blockmax_ops.eager_segment_topk(
+                            ut, qi, lv, qt, scales_in[i] * bo,
+                            k_static, base, c1, c2)
+                    s_i, d_i, cnt = jax.vmap(one)(qtids_in[i],
+                                                  boosts_in, cs_in,
+                                                  cd_in)
+                    ts_list.append(s_i)
+                    td_list.append(d_i)
+                    base_list.append(base)
+                    counts = cnt if counts is None else counts + cnt
+                ts, td = topk_ops.merge_top_k_batch_body(
+                    ts_list, td_list, k_static, tuple(base_list))
+                out = {"count": jax.lax.psum(counts, "shard")}
+            # cross-chip merge: gather every shard's candidate list
+            # (GLOBAL doc ids) over ICI and re-select under the same
+            # (score desc, doc asc) order — bit-identical to 1-chip
+            # because a global-top-k doc is always in its own shard's
+            # local top-k
+            ag_s = jax.lax.all_gather(ts, "shard")
+            ag_d = jax.lax.all_gather(td, "shard")
+            bl = ts.shape[0]
+            flat_s = jnp.moveaxis(ag_s, 0, 1).reshape(bl, -1)
+            flat_d = jnp.moveaxis(ag_d, 0, 1).reshape(bl, -1)
+
+            def refine(s_row, d_row):
+                return blockmax_ops.topk_flat_by_doc(s_row, d_row,
+                                                     k_static)
+            out["top_scores"], out["top_docs"] = jax.vmap(refine)(
+                flat_s, flat_d)
+            return out
+
+        seg_specs = tuple(tuple(P("shard") for _ in arrs)
+                          for arrs in seg_arrs)
+        out_specs = {"top_scores": P("dp"), "top_docs": P("dp"),
+                     "count": P("dp")}
+        if prune:
+            out_specs["blocks_scored"] = P("dp")
+            out_specs["blocks_skipped"] = P("dp")
+        mapped = shard_map_compat(
+            step_local, mesh=mesh,
+            in_specs=(seg_specs, [P("dp")] * len(qtids), P(),
+                      P("dp"), P("dp"), P("dp")),
+            out_specs=out_specs)
+        return jax.jit(mapped).lower(seg_arrs, qtids, scales,
+                                     boosts_a, cs, cd)
+
+    fn = _get_compiled(key, compile_fn, lane="impact-mesh",
+                       owner=pack.engine_uuid)
+    with device_span("impact-shard-dispatch",
+                     cost=("impact-mesh", key,
+                           n_real if n_real is not None else b, b_pad)):
+        device_fault_point("impact-shard-dispatch")
+        out = fn(seg_arrs, qtids, scales, boosts_a, cs, cd)
+    if b_pad != b:
+        out = {name: v[:b] for name, v in out.items()}
+    return out
+
+
+def _placed_vector_arrays(reader, pack: _VectorPack, mesh) -> list:
+    """Per-segment placed (vecs, exists, live[, lens]) device arrays
+    for the mesh knn lane — doc axis padded to the shard count (pad
+    rows exists=False/live=False → never eligible) and pinned to owning
+    devices through the placement-aware block cache. Aligned 1:1 with
+    pack.segs (() entries for segments without the field)."""
+    from elasticsearch_tpu.parallel.mesh_engine import fetch_placed_block
+    s_axis = int(mesh.shape["shard"])
+    engine_uuid = getattr(reader, "engine_uuid", None) or \
+        f"reader:{id(reader)}"
+    breaker_service = getattr(reader, "breaker_service", None)
+    placed = []
+    uploaded = reused = 0
+    for s in pack.segs:
+        if s is None:
+            placed.append(())
+            continue
+        np_pad = -(-s["np_docs"] // s_axis) * s_axis
+
+        def build(s=s, np_pad=np_pad):
+            pad = np_pad - s["np_docs"]
+            vecs = np.asarray(s["vecs"])
+            out = [np.pad(vecs,
+                          ((0, pad),) + ((0, 0),) * (vecs.ndim - 1)),
+                   np.pad(np.asarray(s["exists"]), (0, pad)),
+                   np.pad(np.asarray(s["live"]), (0, pad))]
+            if s["lens"] is not None:
+                out.append(np.pad(np.asarray(s["lens"]), (0, pad)))
+            return out
+
+        sig = ("knn-mesh", pack.field, pack.quant, pack.multi, np_pad)
+        arrs, up, re = fetch_placed_block(
+            mesh, engine_uuid, s["block_uid"], sig, build,
+            breaker_service, component="vector")
+        placed.append(tuple(arrs))
+        uploaded += up
+        reused += re
+    note_data_blocks_placed(uploaded, reused)
+    return placed
+
+
+def run_knn_hybrid_mesh(reader, ctx, reqs, pack: _VectorPack,
+                        cfg: KnnPlaneConfig, mesh, *, k: int,
+                        num_candidates: int,
+                        n_real: int | None = None):
+    """The knn/hybrid lane served by the pod slice as ONE compiled
+    shard_map dispatch: vector/token columns doc-axis sharded over
+    ``shard`` (per-doc scoring is row-independent, so per-shard scores
+    are bit-identical to the full-column pass), per-shard
+    top-num_candidates, then an in-program cross-chip all_gather +
+    re-top-k BEFORE fusion. A hybrid request's lexical side runs
+    replicated on every shard (full segment columns — identical on all
+    shards), so RRF / weighted fusion computes replicated from the
+    merged global candidate lists and bit-matches run_knn_hybrid_batch.
+    Returns the single-chip lane's contract, or None on mixed plan
+    signatures (callers retry per-request)."""
+    from jax.sharding import PartitionSpec as P
+    from elasticsearch_tpu.ops import maxsim as maxsim_ops
+    from elasticsearch_tpu.ops import vector as vector_ops
+    from elasticsearch_tpu.parallel.mesh import shard_map_compat
+    segments = reader.segments
+    if not segments or not reqs:
+        return None
+    hybrid = reqs[0].knn.hybrid
+    b = len(reqs)
+    k_static = int(k)
+    c_static = int(num_candidates)
+    dp = int(mesh.shape["dp"])
+    s_axis = int(mesh.shape["shard"])
+    need_seg = hybrid or any(r.knn.filter is not None for r in reqs)
+    plans = None
+    if need_seg:
+        plans = []
+        for dseg in segments:
+            plan = _plan_knn_segment(dseg, ctx, reqs)
+            if plan is None:
+                return None
+            plans.append(plan)
+    qv, qmask, boosts, b_pad = _knn_query_inputs(reqs, pack)
+    if need_seg:
+        for plan in plans:
+            if plan["b_pad"] is not None and plan["b_pad"] != b_pad:
+                return None
+    packeds = [{dt: jnp.asarray(buf) for dt, buf in p["packed"].items()}
+               for p in plans] if need_seg else []
+    b_pad_m = -(-b_pad // dp) * dp
+    if b_pad_m != b_pad:
+        qv, boosts = _pad_batch_rows([qv, boosts], b_pad_m)
+        if qmask is not None:
+            (qmask,) = _pad_batch_rows([qmask], b_pad_m)
+        packeds = [{dt: _pad_batch_rows([buf], b_pad_m)[0]
+                    for dt, buf in pk.items()} for pk in packeds]
+        b_pad = b_pad_m
+    placed = _placed_vector_arrays(reader, pack, mesh)
+    bases = tuple(int(s.doc_base) for s in segments)
+    vec_bases = tuple(s["doc_base"] for s in pack.segs if s is not None)
+    fusion_key = (cfg.fusion_mode, int(cfg.rank_constant),
+                  float(cfg.lexical_weight)) if hybrid else None
+    geom = mesh_geom(mesh)
+    key = ("knn-mesh", pack.sig(), hybrid, need_seg, bases, k_static,
+           c_static, b_pad,
+           None if qmask is None else tuple(qmask.shape), fusion_key,
+           tuple(p["key"] for p in plans) if need_seg else None,
+           tuple(tuple(p["specs"]) for p in plans) if need_seg else None,
+           geom)
+    flats = [p["flat"] for p in plans] if need_seg else []
+    # lexical columns serve REPLICATED (every shard scores the full
+    # segment — the lexical candidate lists must be global); the vector
+    # columns are the sharded half
+    flats = _mesh_place(flats, mesh, P(), "mesh-lexical-replicate")
+    packeds = _mesh_place(packeds, mesh, P("dp"), "mesh-query-consts")
+    qv, boosts = _mesh_place([qv, boosts], mesh, P("dp"),
+                             "mesh-query-consts")
+    if qmask is not None:
+        (qmask,) = _mesh_place([qmask], mesh, P("dp"),
+                               "mesh-query-consts")
+    scales, offsets = _mesh_place([pack.scales, pack.offsets], mesh,
+                                  P(), "mesh-scales")
+
+    def compile_fn():
+        def step_local(flats_in, packeds_in, vec_in, scales_in,
+                       offsets_in, qv_in, qmask_in, boosts_in):
+            sidx = jax.lax.axis_index("shard")
+            bl = qv_in.shape[0]
+            # ---- lexical scores / filter masks (replicated) ---------
+            lex_ts, lex_td = [], []
+            fmasks = [None] * len(segments)
+            if need_seg:
+                for i, (plan, flat_in, packed_in) in enumerate(
+                        zip(plans, flats_in, packeds_in)):
+                    view = seg_rebuild(plan["seg"], flat_in,
+                                       plan["pos"], plan["vecs"])
+
+                    def lane(packed_one, plan=plan, view=view):
+                        consts_one = [
+                            packed_one[dt][off:off + size].reshape(shape)
+                            for dt, off, shape, size in plan["specs"]]
+                        em = EmitCtx(view, consts_one)
+                        out = {}
+                        if plan["emit_q"] is not None:
+                            scores, mask = plan["emit_q"](em)
+                            mask = mask & view.live
+                            ts, td = topk_ops.top_k(
+                                scores, mask,
+                                min(c_static, view.padded_docs), 0)
+                            out["ts"], out["td"] = ts, td
+                        if plan["emit_f"] is not None:
+                            out["fmask"] = plan["emit_f"](em)
+                        return out
+
+                    if plan["specs"]:
+                        outs = jax.vmap(lane)(packed_in)
+                    else:
+                        one = lane({})
+                        outs = {kk: jnp.broadcast_to(
+                            v, (bl,) + v.shape)
+                            for kk, v in one.items()}
+                    if hybrid:
+                        lex_ts.append(outs["ts"])
+                        lex_td.append(outs["td"])
+                    if "fmask" in outs:
+                        fmasks[i] = outs["fmask"]
+            # ---- per-shard knn candidates ---------------------------
+            knn_ts, knn_td = [], []
+            knn_counts = jnp.zeros(bl, jnp.int32)
+            vi = 0
+            for i, arrs in enumerate(vec_in):
+                if not arrs:
+                    continue
+                if pack.multi:
+                    vecs, exists, live, lens = arrs
+                else:
+                    vecs, exists, live = arrs
+                n_loc = vecs.shape[0]
+                if pack.multi and pack.quant == "int8":
+                    scores = maxsim_ops.maxsim_scores_int8_batch_body(
+                        vecs, scales_in[vi], offsets_in[vi], lens,
+                        qv_in, qmask_in)
+                elif pack.multi:
+                    scores = maxsim_ops.maxsim_scores_batch_body(
+                        vecs, lens, qv_in, qmask_in)
+                elif pack.quant == "int8":
+                    scores = vector_ops.cosine_scores_int8_batch(
+                        vecs, scales_in[vi], offsets_in[vi], exists,
+                        qv_in)
+                else:
+                    scores = jnp.where(exists[None, :],
+                                       qv_in @ vecs.T, 0.0)
+                if not hybrid:
+                    scores = scores * boosts_in[:, None]
+                elig = exists & live
+                masks = jnp.broadcast_to(elig[None, :], (bl, n_loc))
+                if fmasks[i] is not None:
+                    # the replicated filter mask covers the full
+                    # (lexical-padded) doc axis — pad to the vector
+                    # lane's shard-divisible width, slice our rows
+                    fm = fmasks[i]
+                    np_pad_i = n_loc * s_axis
+                    if fm.shape[1] < np_pad_i:
+                        fm = jnp.pad(
+                            fm, ((0, 0), (0, np_pad_i - fm.shape[1])))
+                    masks = masks & jax.lax.dynamic_slice_in_dim(
+                        fm, sidx * n_loc, n_loc, axis=1)
+                ts, td = vector_ops.filtered_topk_batch(
+                    scores, masks, min(c_static, n_loc),
+                    sidx * n_loc)
+                knn_ts.append(ts)
+                knn_td.append(td)
+                knn_counts = knn_counts + masks.sum(axis=1,
+                                                    dtype=jnp.int32)
+                vi += 1
+            ds, dd = topk_ops.merge_top_k_batch_body(
+                knn_ts, knn_td, c_static, vec_bases)
+            # ---- cross-chip merge: gather per-shard candidates and
+            # re-top-k BEFORE fusion, so the fused ranking sees the
+            # same global candidate lists the single-chip lane builds
+            ag_s = jax.lax.all_gather(ds, "shard")
+            ag_d = jax.lax.all_gather(dd, "shard")
+            flat_s = jnp.moveaxis(ag_s, 0, 1).reshape(bl, -1)
+            flat_d = jnp.moveaxis(ag_d, 0, 1).reshape(bl, -1)
+
+            def refine(s_row, d_row):
+                return blockmax_ops.topk_flat_by_doc(s_row, d_row,
+                                                     c_static)
+            ds, dd = jax.vmap(refine)(flat_s, flat_d)
+            knn_counts = jax.lax.psum(knn_counts, "shard")
+            if not hybrid:
+                return {"top_scores": ds[:, :k_static],
+                        "top_docs": dd[:, :k_static],
+                        "count": knn_counts}
+            ls, ld = topk_ops.merge_top_k_batch_body(
+                lex_ts, lex_td, c_static, bases)
+            if cfg.fusion_mode == "weighted":
+                ts, td, count = _weighted_fuse_body(
+                    ls, ld, ds, dd, boosts_in,
+                    float(cfg.lexical_weight), k_static)
+            else:
+                ts, td, count = _rrf_fuse_body(
+                    ls, ld, ds, dd, boosts_in,
+                    float(cfg.rank_constant), k_static)
+            return {"top_scores": ts, "top_docs": td, "count": count}
+
+        flat_specs = jax.tree.map(lambda _: P(), flats)
+        packed_specs = jax.tree.map(lambda _: P("dp"), packeds)
+        vec_specs = tuple(tuple(P("shard") for _ in arrs)
+                          for arrs in placed)
+        qmask_spec = P() if qmask is None else P("dp")
+        out_specs = {"top_scores": P("dp"), "top_docs": P("dp"),
+                     "count": P("dp")}
+        mapped = shard_map_compat(
+            step_local, mesh=mesh,
+            in_specs=(flat_specs, packed_specs, vec_specs, P(), P(),
+                      P("dp"), qmask_spec, P("dp")),
+            out_specs=out_specs)
+
+        def run_outer(*a):
+            return mapped(a[0], a[1], a[2], a[3], a[4], a[5],
+                          a[6] if qmask is not None else None, a[7])
+        dummy = jnp.zeros(0, bool) if qmask is None else qmask
+        return jax.jit(run_outer).lower(
+            flats, packeds, tuple(placed), scales, offsets, qv,
+            dummy, boosts)
+
+    fn = _get_compiled(key, compile_fn, lane="knn-mesh",
+                       owner=getattr(reader, "engine_uuid", None))
+    dummy = jnp.zeros(0, bool) if qmask is None else qmask
+    args = (flats, packeds, tuple(placed), scales, offsets, qv, dummy,
+            boosts)
+    with device_span("knn-mesh-merge",
+                     cost=("knn-mesh", key,
+                           n_real if n_real is not None else b, b_pad)):
+        device_fault_point("knn-mesh-merge")
+        out = fn(*args)
     if b_pad != b:
         out = {name: v[:b] for name, v in out.items()}
     return out

@@ -107,6 +107,11 @@ DATA_LAYER_COUNTERS = {
     "impact_bytes_reused": "resident impact-block bytes reused",
     "vector_bytes_uploaded": "knn vector-column bytes uploaded",
     "vector_bytes_reused": "resident vector-block bytes reused",
+    "placement_bytes_uploaded": "placed mesh-lane block bytes shipped "
+                                "to owning devices (delta refreshes "
+                                "count changed shard slices only)",
+    "placement_bytes_reused": "placed block bytes reused in place "
+                              "(unchanged shard slices of a refresh)",
 }
 
 #: PercolatorRegistry.stats — per-index registry/evaluation counters
@@ -142,6 +147,11 @@ PROGRAM_LANES = (
                         # device-side rescore stage, one dispatch
     "knn",              # run_knn_hybrid_batch: vector/hybrid programs
     "mesh",             # mesh_engine._program: the collective plane
+    "impact-mesh",      # run_impact_mesh: pod-slice block-max sweep
+                        # (per-shard sweeps + θ-exchange + cross-chip
+                        # top-k merge, one shard_map program)
+    "knn-mesh",         # run_knn_hybrid_mesh: doc-sharded vector/
+                        # MaxSim scoring + cross-chip candidate merge
 )
 
 #: the program cost observatory's per-lane gauge registry — the

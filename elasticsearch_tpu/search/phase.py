@@ -701,10 +701,21 @@ class ShardSearcher:
                 cursors.append(cur)
             if prune and not pack.can_prune:
                 prune = False               # block tables over budget
-            run = jit_exec.run_impact_pruned if prune \
-                else jit_exec.run_impact_batch
-            out = run(pack, term_lists, boosts, cursors, k=k,
-                      n_real=n_real)
+            mesh = jit_exec.serving_mesh()
+            if mesh is not None:
+                from elasticsearch_tpu.search.planner import \
+                    prefer_mesh_serving
+                if not prefer_mesh_serving("impact"):
+                    mesh = None          # measured single-chip win
+            if mesh is not None:
+                out = jit_exec.run_impact_mesh(
+                    self.reader, pack, mesh, term_lists, boosts,
+                    cursors, k=k, prune=prune, n_real=n_real)
+            else:
+                run = jit_exec.run_impact_pruned if prune \
+                    else jit_exec.run_impact_batch
+                out = run(pack, term_lists, boosts, cursors, k=k,
+                          n_real=n_real)
         except QueryParsingError:
             raise
         except Exception as e:            # noqa: BLE001 — fallback seam
@@ -896,9 +907,22 @@ class ShardSearcher:
             if pack.multi != knns[0].multi:
                 jit_exec.note_knn_fallback("mixed-shapes")
                 return None
-            out = jit_exec.run_knn_hybrid_batch(
-                self.reader, self.ctx, reqs, pack, cfg, k=k_prog,
-                num_candidates=knns[0].num_candidates, n_real=n_real)
+            mesh = jit_exec.serving_mesh()
+            if mesh is not None:
+                from elasticsearch_tpu.search.planner import \
+                    prefer_mesh_serving
+                if not prefer_mesh_serving("knn"):
+                    mesh = None          # measured single-chip win
+            if mesh is not None:
+                out = jit_exec.run_knn_hybrid_mesh(
+                    self.reader, self.ctx, reqs, pack, cfg, mesh,
+                    k=k_prog, num_candidates=knns[0].num_candidates,
+                    n_real=n_real)
+            else:
+                out = jit_exec.run_knn_hybrid_batch(
+                    self.reader, self.ctx, reqs, pack, cfg, k=k_prog,
+                    num_candidates=knns[0].num_candidates,
+                    n_real=n_real)
         except QueryParsingError:
             raise
         except Exception as e:            # noqa: BLE001 — fallback seam

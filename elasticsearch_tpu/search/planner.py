@@ -51,7 +51,8 @@ from typing import Any, Callable
 from elasticsearch_tpu.observability import costs, tracing
 
 __all__ = ["PlanNode", "Plan", "plan_batch", "launch_plan",
-           "finish_plan", "route_plane", "order_nodes"]
+           "finish_plan", "route_plane", "order_nodes",
+           "prefer_mesh_serving"]
 
 
 @dataclass
@@ -114,17 +115,52 @@ def order_nodes(nodes: list) -> list:
                        else float("inf")))
 
 
-def _priced(lane: str, node_id=None) -> "costs.CostEstimate | None":
+def _priced(lane: str, node_id=None,
+            mesh=None) -> "costs.CostEstimate | None":
     """Lane-level price: the dispatch-weighted measured mean when the
     lane has served traffic on this node, the static-analysis mean when
     it has only compiled (``cold=True``), None when the cost observatory
     has never seen the lane. Shape-exact pricing needs the compiled
     program key, which only exists after the arm commits — lane-level
-    is the honest pre-dispatch signal."""
+    is the honest pre-dispatch signal. ``mesh`` scopes the price to
+    one pod-slice geometry (costs.estimate's mesh axis)."""
     try:
-        return costs.estimate(lane, node_id=node_id)
+        return costs.estimate(lane, node_id=node_id, mesh=mesh)
     except Exception:            # noqa: BLE001 — pricing must never veto
         return None
+
+
+def prefer_mesh_serving(lane: str) -> bool:
+    """Geometry routing: serve this batch on the pod-slice mesh lane
+    (``impact-mesh`` / ``knn-mesh``) or the single-chip lane?
+
+    Only meaningful when a serving mesh is installed (False
+    otherwise). Same pricing discipline as :func:`route_plane`: the
+    installed mesh is the operator's opt-in default, so it wins
+    UNLESS both arms carry dispatch-backed estimates (``measured`` /
+    ``lane-mean`` — a static roofline never overrides the opt-in) and
+    the single-chip arm is strictly cheaper than the mesh arm priced
+    at the serving geometry. Bit-identity between the arms is proven
+    by the mesh-equality suite, so routing is purely a cost decision —
+    it can never change a response."""
+    from elasticsearch_tpu.search import jit_exec
+    mesh = jit_exec.serving_mesh()
+    if mesh is None:
+        return False
+    mesh_lane = {"impact": "impact-mesh", "knn": "knn-mesh"}.get(lane)
+    if mesh_lane is None:
+        return False
+    m = _priced(mesh_lane, mesh=mesh)
+    if lane == "impact":
+        single = _priced("impact-pruned") or _priced("impact-eager")
+    else:
+        single = _priced("knn")
+    backed = ("measured", "lane-mean")
+    if m is not None and single is not None and \
+            m.source in backed and single.source in backed and \
+            float(single) < float(m):
+        return False             # measured single-chip win
+    return True
 
 
 def plan_batch(shard, reqs: list, n_real: int | None = None

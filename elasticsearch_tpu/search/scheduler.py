@@ -128,12 +128,28 @@ def query_shape(q_node) -> tuple:
     return tuple(parts)
 
 
+def _with_geometry(shape):
+    """Append the serving mesh's geometry to a shape bucket. Programs
+    compiled for different pod slices (or for single-chip vs mesh
+    serving) are distinct executables, so requests classified under
+    different geometries must never share a queue — one compile per
+    (shape, geometry), not a decline-then-recompile churn when the
+    serving mesh changes."""
+    from elasticsearch_tpu.search import jit_exec
+    mesh = jit_exec.serving_mesh()
+    if mesh is None:
+        return shape
+    return shape + (("mesh-geometry",) + jit_exec.mesh_geom(mesh),)
+
+
 def classify(req, searcher):
     """→ ``(lane, shape key)`` for a request the batched programs can
     serve, ``(None, None)`` otherwise (caller stays serial). The shape
     key mirrors the program caches' pow2 bucketing plus the query's
     structural fingerprint, so one queue's requests share a compiled
-    plan family — a formed batch rarely declines on mixed shapes."""
+    plan family — a formed batch rarely declines on mixed shapes.
+    When a serving mesh is installed the bucket also carries the mesh
+    geometry (see :func:`_with_geometry`)."""
     from elasticsearch_tpu.search import jit_exec
     from elasticsearch_tpu.search.phase import _is_score_order
     if searcher.ctx.dfs_stats is not None:
@@ -154,7 +170,7 @@ def classify(req, searcher):
             # unfiltered knn never share a queue and mixed-filter
             # batches don't decline at launch
             shape = shape + (("filter", query_shape(kn.filter)),)
-        return "knn", shape
+        return "knn", _with_geometry(shape)
     if (req.aggs or not _is_score_order(req.sort)
             or req.post_filter is not None or req.min_score is not None
             or req.search_after is not None or req.suggest
@@ -172,13 +188,14 @@ def classify(req, searcher):
                 searcher.ctx.index_name) is None:
             return None, None           # multi-pass / exact-lane rescore
         rs = req.rescore[0]
-        return "impact", ("fused-program", k,
-                          pow2_bucket(max(int(rs.window_size), 1)),
-                          str(rs.score_mode), query_shape(req.query),
-                          query_shape(rs.query))
+        return "impact", _with_geometry(
+            ("fused-program", k,
+             pow2_bucket(max(int(rs.window_size), 1)),
+             str(rs.score_mode), query_shape(req.query),
+             query_shape(rs.query)))
     lane = "impact" if jit_exec.impact_plane_config(
         searcher.ctx.index_name) is not None else "plane"
-    return lane, (k, query_shape(req.query))
+    return lane, _with_geometry((k, query_shape(req.query)))
 
 
 class _Waiter:

@@ -456,7 +456,12 @@ DEVICE_FAULT_SITES = ("dispatch", "compile", "upload", "compose",
                       "vector-upload", "maxsim-dispatch",
                       "fusion-dispatch",
                       # the planner's fused impact→rescore dispatch
-                      "rescore-dispatch")
+                      "rescore-dispatch",
+                      # mesh-sharded retrieval lanes: placed block
+                      # upload to owning devices, the pod-slice impact
+                      # sweep dispatch, and the cross-chip knn merge
+                      "block-placement-upload", "impact-shard-dispatch",
+                      "knn-mesh-merge")
 READER_UPLOAD_SITE = "reader-upload"
 
 

@@ -1,0 +1,33 @@
+"""Negative fixtures: the mesh lanes' device seams done RIGHT — every
+new site class (block-placement-upload, impact-shard-dispatch,
+knn-mesh-merge) guarded, span-scoped, and of the correct family. Must
+lint clean under the seam-module config.
+"""
+
+import jax
+
+
+def device_fault_point(site):
+    pass
+
+
+def device_span(site):
+    pass
+
+
+def placed_block_upload(arr):
+    with device_span("block-placement-upload"):
+        device_fault_point("block-placement-upload")
+        return jax.device_put(arr)
+
+
+def impact_shard_dispatch(fn, args):
+    with device_span("impact-shard-dispatch"):
+        device_fault_point("impact-shard-dispatch")
+        return fn(*args)
+
+
+def knn_mesh_merge(fn, args):
+    with device_span("knn-mesh-merge"):
+        device_fault_point("knn-mesh-merge")
+        return fn(*args)

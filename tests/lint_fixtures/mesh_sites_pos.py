@@ -1,0 +1,38 @@
+"""Positive fixtures: mesh-lane device seams done WRONG.
+
+The pod-slice serving lanes added three site classes
+(block-placement-upload, impact-shard-dispatch, knn-mesh-merge).
+These shapes must each fire: a placement upload with no span pairing,
+a device_put "guarded" by a dispatch-class site (not an upload-class
+one), and a typo'd site the chaos scheme would never draw.
+"""
+
+import jax
+
+
+def device_fault_point(site):
+    pass
+
+
+def device_span(site):
+    pass
+
+
+def unspanned_placement_upload(arr):
+    device_fault_point("block-placement-upload")   # span-unscoped-site
+    return jax.device_put(arr)
+
+
+def shard_dispatch_guarding_an_upload(arr):
+    with device_span("impact-shard-dispatch"):
+        device_fault_point("impact-shard-dispatch")
+        # device-unguarded: impact-shard-dispatch is not an
+        # upload-class site, so this transfer is invisible to upload
+        # fault draws
+        return jax.device_put(arr)
+
+
+def typoed_site(fn, args):
+    with device_span("knn-mesh-merge"):
+        device_fault_point("knn-mesh-merg")   # device-unknown-site
+        return fn(*args)

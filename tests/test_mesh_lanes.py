@@ -1,0 +1,491 @@
+"""Mesh-sharded retrieval lanes (tier-1 guards).
+
+Pod-slice serving of the impact and knn/hybrid lanes as ONE compiled
+shard_map program (ISSUE 20):
+
+* bit-identity — mesh-served results (ids, rank order, bit-equal
+  scores, totals) match the single-chip lanes exactly across dp×shard
+  geometries of the forced 8-device host, for the eager impact sweep,
+  the block-max pruned sweep with cross-chip θ-exchange (pruned ≡
+  unpruned ≡ 1-chip), and knn / filtered-knn / hybrid-RRF fusion —
+  surviving delete churn and refresh;
+* placement discipline — columns pin to owning devices through the
+  placement-aware block cache: steady state re-uploads nothing, a
+  delete-only churn re-ships ONLY the changed shard slices
+  (placement_bytes_{uploaded,reused} counter-verified), and the
+  per-device ledger rollup reconciles bit-exactly with the total;
+* compile economy — the scheduler's shape buckets carry the mesh
+  geometry, so the same request shape on two geometries compiles
+  exactly twice (once per geometry), never once-per-batch;
+* pricing — costs.estimate's mesh axis returns distinct per-geometry
+  estimates and the planner's geometry router prefers the mesh opt-in
+  unless the single-chip arm is measured strictly cheaper.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.index.device_reader import device_reader_for
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.observability import costs
+from elasticsearch_tpu.parallel.mesh import make_mesh, valid_geometries
+from elasticsearch_tpu.search import jit_exec
+from elasticsearch_tpu.search.phase import (ShardSearcher,
+                                            parse_search_request)
+
+GEOMETRIES = [(1, 8), (2, 4), (4, 2), (8, 1)]
+
+
+@pytest.fixture
+def node(tmp_path):
+    jit_exec.clear_cache()
+    jit_exec.set_serving_mesh(None)
+    n = Node({}, data_path=tmp_path / "n").start()
+    yield n
+    n.close()
+    jit_exec.set_serving_mesh(None)
+    jit_exec.clear_cache()
+
+
+def _searcher(node, name, shard=0):
+    svc = node.indices_service.indices[name]
+    return ShardSearcher(shard, device_reader_for(svc.engine(shard)),
+                         svc.mapper_service, index_name=name)
+
+
+def _placement():
+    dl = jit_exec.cache_stats()["data_layer"]
+    return (dl["placement_bytes_uploaded"],
+            dl["placement_bytes_reused"])
+
+
+def _mesh_query(s, body):
+    """Run one query on the installed serving mesh with pricing
+    history cleared — these tests prove bit-identity, so the router's
+    measured-cost preference (exercised separately below) must not
+    silently bounce the request back to the single-chip arm."""
+    costs.reset()
+    return s.query_phase(parse_search_request(body))
+
+
+# ---------------------------------------------------------------------------
+# geometry construction
+# ---------------------------------------------------------------------------
+
+def test_make_mesh_rejects_bad_geometry():
+    for kwargs in ({"dp": 3}, {"shard": 5}, {"dp": 2, "shard": 3},
+                   {"dp": 0}, {"shard": -1}):
+        with pytest.raises(IllegalArgumentError) as ei:
+            make_mesh(**kwargs)
+        # the rejection carries the valid menu — operators fix the
+        # setting without reading source
+        assert str(valid_geometries(8)) in str(ei.value)
+    for dp, shard in GEOMETRIES:
+        m = make_mesh(dp, shard)
+        assert dict(m.shape) == {"dp": dp, "shard": shard}
+
+
+def test_valid_geometries_menu():
+    assert valid_geometries(8) == [(1, 8), (2, 4), (4, 2), (8, 1)]
+    assert valid_geometries(1) == [(1, 1)]
+
+
+# ---------------------------------------------------------------------------
+# impact lane: mesh ≡ single-chip (eager, and pruned ≡ unpruned)
+# ---------------------------------------------------------------------------
+
+def _mk_impact_index(node, name, docs, *, block_rows=64):
+    node.indices_service.create_index(name, {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0,
+                     "index.search.impact_plane": True,
+                     "index.search.impact.block_rows": block_rows},
+        "mappings": {"_doc": {"properties": {
+            "t": {"type": "text", "analyzer": "whitespace"},
+            "v": {"type": "long"}}}}})
+    for i, doc in enumerate(docs):
+        node.index_doc(name, str(i), doc)
+    node.broadcast_actions.refresh(name)
+
+
+def _skewed_docs(rng, n, vocab=80):
+    docs = []
+    for i in range(n):
+        words = [f"w{min(int(x), vocab)}" for x in rng.zipf(1.3, 8)]
+        docs.append({"t": " ".join(words) or "w1", "v": i})
+    return docs
+
+
+def test_impact_mesh_equality_fuzz(node, rng):
+    """Eager and pruned impact sweeps on every dp×shard geometry are
+    bit-identical to the single-chip lane: same doc ids in the same
+    order, bit-equal f32 scores, same totals — and the pruned mesh
+    sweep (cross-chip θ-exchange) equals the unpruned mesh sweep."""
+    docs = _skewed_docs(rng, 420)
+    _mk_impact_index(node, "imx", docs)
+    s = _searcher(node, "imx")
+    queries = ["w1", "w1 w7", "w40 w1", "w3 w12 w5"]
+    sizes = [1, 5, 17]
+    base = {}
+    for q in queries:
+        for k in sizes:
+            for tt in (True, False):
+                body = {"query": {"match": {"t": q}}, "size": k,
+                        "track_total_hits": tt}
+                base[(q, k, tt)] = s.query_phase(
+                    parse_search_request(body))
+    for dp, shard in GEOMETRIES:
+        jit_exec.set_serving_mesh(make_mesh(dp, shard))
+        try:
+            for (q, k, tt), want in base.items():
+                body = {"query": {"match": {"t": q}}, "size": k,
+                        "track_total_hits": tt}
+                got = _mesh_query(s, body)
+                tag = f"{q!r} k={k} tt={tt} geom={dp}x{shard}"
+                np.testing.assert_array_equal(
+                    got.doc_ids, want.doc_ids, err_msg=tag)
+                np.testing.assert_array_equal(
+                    got.scores, want.scores, err_msg=tag)
+                if tt:
+                    # eager totals are exact partitions (psum'd);
+                    # the pruned lane's total is a LOWER BOUND that
+                    # depends on how much θ pruned — cross-chip
+                    # θ-exchange prunes differently, so only the
+                    # bound's validity carries over, not its value
+                    assert got.total == want.total, tag
+                else:
+                    assert got.total >= len(got.doc_ids), tag
+        finally:
+            jit_exec.set_serving_mesh(None)
+
+
+def test_impact_mesh_cursor_pages(node, rng):
+    """search_after continuation on the mesh lane: page 2 from a
+    mesh-minted cursor equals the single-chip page 2 and the two pages
+    tile the unpaginated list."""
+    docs = _skewed_docs(rng, 300)
+    _mk_impact_index(node, "imc", docs)
+    s = _searcher(node, "imc")
+    body = {"query": {"match": {"t": "w1 w3"}}, "size": 6,
+            "track_total_hits": False}
+    full = s.query_phase(parse_search_request(
+        {**body, "size": 12}))
+    page1 = s.query_phase(parse_search_request(body))
+    cursor = [float(page1.scores[-1]), int(page1.doc_ids[-1])]
+    page2 = s.query_phase(parse_search_request(
+        {**body, "search_after": cursor}))
+    jit_exec.set_serving_mesh(make_mesh(2, 4))
+    try:
+        mp1 = _mesh_query(s, body)
+        np.testing.assert_array_equal(mp1.doc_ids, page1.doc_ids)
+        np.testing.assert_array_equal(mp1.scores, page1.scores)
+        mcur = [float(mp1.scores[-1]), int(mp1.doc_ids[-1])]
+        mp2 = _mesh_query(s, {**body, "search_after": mcur})
+        np.testing.assert_array_equal(mp2.doc_ids, page2.doc_ids)
+        np.testing.assert_array_equal(mp2.scores, page2.scores)
+        np.testing.assert_array_equal(
+            np.concatenate([mp1.doc_ids, mp2.doc_ids]), full.doc_ids)
+    finally:
+        jit_exec.set_serving_mesh(None)
+
+
+def test_impact_mesh_delete_churn_and_refresh(node, rng):
+    """Parity survives tombstones and new segments; the placed-block
+    cache re-ships ONLY changed shard slices on a delete-only churn
+    (live-mask delta ≪ the first full placement) and nothing in steady
+    state."""
+    docs = _skewed_docs(rng, 300)
+    _mk_impact_index(node, "imd", docs)
+    body = {"query": {"match": {"t": "w1 w7"}}, "size": 6}
+    jit_exec.set_serving_mesh(make_mesh(2, 4))
+    try:
+        s = _searcher(node, "imd")
+        _mesh_query(s, body)
+        up_full, _ = _placement()
+        assert up_full > 0
+        # steady state: resident placement, zero new bytes
+        _mesh_query(s, body)
+        up1, re1 = _placement()
+        assert up1 == up_full
+        assert re1 > 0
+        # delete-only churn: only the owning shards' live slices ship
+        for i in (5, 77, 130):
+            node.delete_doc("imd", str(i))
+        node.broadcast_actions.refresh("imd")
+        jit_exec.set_serving_mesh(None)
+        s = _searcher(node, "imd")
+        want = s.query_phase(parse_search_request(body))
+        jit_exec.set_serving_mesh(make_mesh(2, 4))
+        s = _searcher(node, "imd")
+        got = _mesh_query(s, body)
+        np.testing.assert_array_equal(got.doc_ids, want.doc_ids)
+        np.testing.assert_array_equal(got.scores, want.scores)
+        assert got.total == want.total
+        up2, _ = _placement()
+        assert up2 > up1, "changed live slices must re-ship"
+        assert up2 - up1 < up_full, \
+            "a delta refresh must ship less than the full placement"
+        # new segment: parity again (new blocks place, old ones delta)
+        for i in range(3):
+            node.index_doc("imd", f"nx{i}",
+                           {"t": "w1 w7 w2", "v": 900 + i})
+        node.broadcast_actions.refresh("imd")
+        jit_exec.set_serving_mesh(None)
+        s = _searcher(node, "imd")
+        want2 = s.query_phase(parse_search_request(body))
+        jit_exec.set_serving_mesh(make_mesh(2, 4))
+        s = _searcher(node, "imd")
+        got2 = _mesh_query(s, body)
+        np.testing.assert_array_equal(got2.doc_ids, want2.doc_ids)
+        np.testing.assert_array_equal(got2.scores, want2.scores)
+        assert got2.total == want2.total
+    finally:
+        jit_exec.set_serving_mesh(None)
+
+
+# ---------------------------------------------------------------------------
+# knn / hybrid lane: mesh ≡ single-chip (rank + ids, RRF bit-parity)
+# ---------------------------------------------------------------------------
+
+DIMS = 8
+
+
+def _mk_vec_index(node, name, rng, n=160, missing=0.2):
+    node.indices_service.create_index(name, {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"_doc": {"properties": {
+            "body": {"type": "text", "analyzer": "whitespace"},
+            "tag": {"type": "keyword"},
+            "vec": {"type": "dense_vector", "dims": DIMS}}}}})
+    for i in range(n):
+        src = {"body": f"w{i % 7} w{int(rng.integers(0, 10))}",
+               "tag": f"g{i % 3}"}
+        if rng.random() >= missing:
+            src["vec"] = rng.standard_normal(DIMS).tolist()
+        node.index_doc(name, str(i), src)
+    node.broadcast_actions.refresh(name)
+
+
+def _knn_bodies(rng):
+    q = rng.standard_normal(DIMS).tolist()
+    return {
+        "knn": {"knn": {"field": "vec", "query_vector": q, "k": 7,
+                        "num_candidates": 40}, "size": 7},
+        "knn-filter": {"knn": {"field": "vec", "query_vector": q,
+                               "k": 7, "num_candidates": 40,
+                               "filter": {"term": {"tag": "g1"}}},
+                       "size": 7},
+        "hybrid-rrf": {"query": {"match": {"body": "w1 w3"}},
+                       "knn": {"field": "vec", "query_vector": q,
+                               "k": 7, "num_candidates": 40},
+                       "size": 7},
+    }
+
+
+def test_knn_mesh_equality_fuzz(node, rng):
+    """knn, filtered knn and hybrid RRF fusion on every geometry are
+    bit-identical to the single-chip compiled lane (cross-chip
+    all_gather + re-top-k BEFORE fusion reproduces the global
+    candidate lists exactly)."""
+    _mk_vec_index(node, "vmx", rng)
+    s = _searcher(node, "vmx")
+    bodies = _knn_bodies(rng)
+    base = {name: s.query_phase(parse_search_request(b))
+            for name, b in bodies.items()}
+    assert any(len(r.doc_ids) for r in base.values())
+    for dp, shard in GEOMETRIES:
+        jit_exec.set_serving_mesh(make_mesh(dp, shard))
+        try:
+            for name, b in bodies.items():
+                got = _mesh_query(s, b)
+                tag = f"{name} geom={dp}x{shard}"
+                np.testing.assert_array_equal(
+                    got.doc_ids, base[name].doc_ids, err_msg=tag)
+                np.testing.assert_array_equal(
+                    got.scores, base[name].scores, err_msg=tag)
+                assert got.total == base[name].total, tag
+        finally:
+            jit_exec.set_serving_mesh(None)
+
+
+def test_knn_mesh_delete_churn(node, rng):
+    """Vector-lane parity survives tombstones: deleting docs flips the
+    replicated live masks and the placed vector columns' live slices —
+    the mesh lane must agree with the single-chip lane afterwards."""
+    _mk_vec_index(node, "vmd", rng)
+    bodies = _knn_bodies(rng)
+    s = _searcher(node, "vmd")
+    jit_exec.set_serving_mesh(make_mesh(4, 2))
+    try:
+        for b in bodies.values():
+            _mesh_query(s, b)
+        jit_exec.set_serving_mesh(None)
+        for i in (4, 31, 77, 102):
+            node.delete_doc("vmd", str(i))
+        node.broadcast_actions.refresh("vmd")
+        s = _searcher(node, "vmd")
+        want = {n: s.query_phase(parse_search_request(b))
+                for n, b in bodies.items()}
+        jit_exec.set_serving_mesh(make_mesh(4, 2))
+        s = _searcher(node, "vmd")
+        for name, b in bodies.items():
+            got = _mesh_query(s, b)
+            np.testing.assert_array_equal(
+                got.doc_ids, want[name].doc_ids, err_msg=name)
+            np.testing.assert_array_equal(
+                got.scores, want[name].scores, err_msg=name)
+    finally:
+        jit_exec.set_serving_mesh(None)
+
+
+# ---------------------------------------------------------------------------
+# compile economy: one program per (shape, geometry)
+# ---------------------------------------------------------------------------
+
+def test_scheduler_shape_buckets_carry_geometry(node, rng):
+    """classify() appends the serving geometry to every lane's shape
+    bucket — requests classified under different geometries never
+    share a queue — and removing the mesh restores the bare bucket."""
+    from elasticsearch_tpu.search.scheduler import classify
+    docs = _skewed_docs(rng, 60)
+    _mk_impact_index(node, "sgx", docs)
+    s = _searcher(node, "sgx")
+    req = parse_search_request({"query": {"match": {"t": "w1"}},
+                                "size": 5})
+    lane0, bare = classify(req, s)
+    assert lane0 == "impact"
+    shapes = {None: bare}
+    for dp, shard in ((1, 8), (2, 4)):
+        jit_exec.set_serving_mesh(make_mesh(dp, shard))
+        try:
+            lane, shape = classify(req, s)
+        finally:
+            jit_exec.set_serving_mesh(None)
+        assert lane == lane0
+        assert shape[:-1] == bare
+        assert shape[-1][0] == "mesh-geometry"
+        shapes[(dp, shard)] = shape
+    assert len(set(shapes.values())) == 3, \
+        "each geometry (and no-mesh) must bucket distinctly"
+
+
+def test_one_compile_per_shape_and_geometry(node, rng):
+    """The same request shape served on two geometries compiles
+    exactly two mesh programs (program keys carry the geometry);
+    re-serving either geometry compiles nothing new."""
+    docs = _skewed_docs(rng, 240)
+    _mk_impact_index(node, "cgx", docs)
+    s = _searcher(node, "cgx")
+    body = {"query": {"match": {"t": "w1 w3"}}, "size": 5}
+    geoms = ((1, 8), (2, 4))
+    for dp, shard in geoms:
+        jit_exec.set_serving_mesh(make_mesh(dp, shard))
+        try:
+            _mesh_query(s, body)
+        finally:
+            jit_exec.set_serving_mesh(None)
+    misses0 = jit_exec.cache_stats()["misses"]
+    for dp, shard in geoms:
+        jit_exec.set_serving_mesh(make_mesh(dp, shard))
+        try:
+            _mesh_query(s, body)
+        finally:
+            jit_exec.set_serving_mesh(None)
+    assert jit_exec.cache_stats()["misses"] == misses0, \
+        "re-serving a known (shape, geometry) must not recompile"
+
+
+# ---------------------------------------------------------------------------
+# pricing: per-geometry estimates and the geometry router
+# ---------------------------------------------------------------------------
+
+def test_costs_estimate_mesh_axis():
+    """estimate(lane, shape_key, mesh=…) resolves per geometry: the
+    same logical shape measured on two pod slices (geometry-qualified
+    program keys) prices distinctly, and the geometry-scoped lane mean
+    ignores the other slice's traffic."""
+    costs.reset()
+    g1 = costs.mesh_axis(make_mesh(1, 8))
+    g2 = costs.mesh_axis(make_mesh(2, 4))
+    assert g1 != g2
+    shape = ("impact-mesh", "sig", 8, 16)
+    costs.note_dispatch("impact-mesh", shape + (g1,), 2.0)
+    costs.note_dispatch("impact-mesh", shape + (g2,), 10.0)
+    e1 = costs.estimate("impact-mesh", shape, mesh=make_mesh(1, 8))
+    e2 = costs.estimate("impact-mesh", shape, mesh=make_mesh(2, 4))
+    assert e1.source == "measured" and e2.source == "measured"
+    assert float(e1) == pytest.approx(2000.0)
+    assert float(e2) == pytest.approx(10000.0)
+    # geometry-scoped lane mean: an unknown shape on g1 prices from
+    # g1's traffic only
+    lm = costs.estimate("impact-mesh", ("other", "shape"),
+                        mesh=make_mesh(1, 8))
+    assert lm.source == "lane-mean"
+    assert float(lm) == pytest.approx(2000.0)
+    # no-geometry estimate sees the whole lane
+    lane = costs.estimate("impact-mesh")
+    assert float(lane) == pytest.approx(6000.0)
+    costs.reset()
+
+
+def test_planner_geometry_routing():
+    """prefer_mesh_serving: the installed mesh is the default; a
+    dispatch-BACKED single-chip win (measured/lane-mean on both arms)
+    routes back to the single-chip lane; no mesh installed never
+    prefers the mesh."""
+    from elasticsearch_tpu.search.planner import prefer_mesh_serving
+    costs.reset()
+    assert prefer_mesh_serving("impact") is False   # no mesh installed
+    mesh = make_mesh(2, 4)
+    geom = costs.mesh_axis(mesh)
+    jit_exec.set_serving_mesh(mesh)
+    try:
+        # cold: the opt-in default wins
+        assert prefer_mesh_serving("impact") is True
+        assert prefer_mesh_serving("knn") is True
+        assert prefer_mesh_serving("plane") is False  # no mesh twin
+        # measured mesh cheaper: mesh keeps serving
+        costs.note_dispatch("impact-mesh", ("k", geom), 1.0)
+        costs.note_dispatch("impact-eager", ("k",), 5.0)
+        assert prefer_mesh_serving("impact") is True
+        # measured single-chip strictly cheaper: route back
+        costs.reset()
+        costs.note_dispatch("impact-mesh", ("k", geom), 5.0)
+        costs.note_dispatch("impact-eager", ("k",), 1.0)
+        assert prefer_mesh_serving("impact") is False
+        costs.reset()
+        costs.note_dispatch("knn-mesh", ("k", geom), 5.0)
+        costs.note_dispatch("knn", ("k",), 1.0)
+        assert prefer_mesh_serving("knn") is False
+    finally:
+        jit_exec.set_serving_mesh(None)
+        costs.reset()
+
+
+# ---------------------------------------------------------------------------
+# placement observability: per-device ledger rollup
+# ---------------------------------------------------------------------------
+
+def test_ledger_per_device_rollup(node, rng):
+    """Placed blocks charge the ledger per owning device: the
+    ``per_device`` rollup sums bit-exactly to the total and shows one
+    entry per shard-owning device of the serving mesh."""
+    docs = _skewed_docs(rng, 300)
+    _mk_impact_index(node, "ldx", docs)
+    mesh = make_mesh(2, 4)
+    jit_exec.set_serving_mesh(mesh)
+    try:
+        s = _searcher(node, "ldx")
+        _mesh_query(s, {"query": {"match": {"t": "w1"}}, "size": 5})
+    finally:
+        jit_exec.set_serving_mesh(None)
+    svc = node.indices_service.indices["ldx"]
+    led = svc.engine(0).breaker_service.device_ledger
+    snap = led.snapshot()
+    assert sum(snap["per_device"].values()) == snap["total_bytes"]
+    owners = {str(mesh.devices[0, si].id)
+              for si in range(mesh.shape["shard"])}
+    assert owners <= set(snap["per_device"]), \
+        (owners, set(snap["per_device"]))

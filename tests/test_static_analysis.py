@@ -958,6 +958,54 @@ def test_vector_sites_negative():
 
 
 # ---------------------------------------------------------------------------
+# mesh-lane site classes (block-placement-upload /
+# impact-shard-dispatch / knn-mesh-merge)
+# ---------------------------------------------------------------------------
+
+MESH_FIX_CFG = LintConfig(seam_modules=("*/mesh_sites_*.py",),
+                          hot_modules=("*/hot_mod_*.py",))
+
+
+def mesh_fixture(name: str):
+    return lint_paths([str(FIXDIR / name)], MESH_FIX_CFG)
+
+
+def test_mesh_sites_registered():
+    """The three mesh-lane site classes are first-class citizens of
+    every discipline: lint vocabulary, family membership (upload vs
+    dispatch), and the default chaos draw."""
+    from elasticsearch_tpu.testing_disruption import DEVICE_FAULT_SITES
+    for site in ("block-placement-upload", "impact-shard-dispatch",
+                 "knn-mesh-merge"):
+        assert site in DEFAULT_CONFIG.known_sites
+        assert site in DEVICE_FAULT_SITES
+    assert "block-placement-upload" in DEFAULT_CONFIG.upload_sites
+    assert "impact-shard-dispatch" in DEFAULT_CONFIG.dispatch_sites
+    assert "knn-mesh-merge" in DEFAULT_CONFIG.dispatch_sites
+    assert "impact-shard-dispatch" not in DEFAULT_CONFIG.upload_sites
+
+
+def test_mesh_sites_positive():
+    r = mesh_fixture("mesh_sites_pos.py")
+    unguarded = open_rules(r, "device-unguarded")
+    assert len(unguarded) == 1, "\n".join(f.render() for f in unguarded)
+    assert "shard_dispatch_guarding_an_upload" in unguarded[0].message
+    unknown = open_rules(r, "device-unknown-site")
+    assert len(unknown) == 1
+    unscoped = open_rules(r, "span-unscoped-site")
+    messages = " ".join(f.message for f in unscoped)
+    assert "unspanned_placement_upload" in messages
+
+
+def test_mesh_sites_negative():
+    r = mesh_fixture("mesh_sites_neg.py")
+    assert open_family(r, "device-seam") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+    assert open_family(r, "span-discipline") == [], \
+        "\n".join(f.render() for f in r.unsuppressed)
+
+
+# ---------------------------------------------------------------------------
 # plan-node-spans (whole-program): planner nodes observable + taxonomized
 # ---------------------------------------------------------------------------
 
